@@ -1,0 +1,61 @@
+"""skypilot_tpu: a TPU-native infrastructure orchestration framework.
+
+Capabilities of SkyPilot (the reference at /root/reference), re-designed
+TPU-first: `accelerators: tpu-v5p:8` is a first-class request that
+provisions a TPU-VM slice, gang-runs every host with jax.distributed
+coordinates, recovers managed jobs from preemption, and serves models
+behind an autoscaled load balancer.
+
+Public API mirrors the reference's `import sky` surface:
+
+    import skypilot_tpu as sky
+    task = sky.Task.from_yaml('examples/minimal.yaml')
+    sky.launch(task, cluster_name='dev')
+"""
+import importlib
+from typing import Any
+
+__version__ = '0.1.0'
+
+# Eager: the lightweight core data model.
+from skypilot_tpu.dag import Dag
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+# Lazy: everything that pulls heavier deps or cloud SDKs.
+_LAZY_ATTRS = {
+    'launch': ('skypilot_tpu.execution', 'launch'),
+    'exec': ('skypilot_tpu.execution', 'exec_cmd'),
+    'optimize': ('skypilot_tpu.optimizer', 'Optimizer'),
+    'status': ('skypilot_tpu.core', 'status'),
+    'start': ('skypilot_tpu.core', 'start'),
+    'stop': ('skypilot_tpu.core', 'stop'),
+    'down': ('skypilot_tpu.core', 'down'),
+    'autostop': ('skypilot_tpu.core', 'autostop'),
+    'queue': ('skypilot_tpu.core', 'queue'),
+    'cancel': ('skypilot_tpu.core', 'cancel'),
+    'tail_logs': ('skypilot_tpu.core', 'tail_logs'),
+    'cost_report': ('skypilot_tpu.core', 'cost_report'),
+    # `sky.check` is the submodule (sky.check.check() runs the probe);
+    # exposing the function here would shadow the submodule name.
+    'check': ('skypilot_tpu.check', None),
+    'ClusterStatus': ('skypilot_tpu.state', 'ClusterStatus'),
+    'JobStatus': ('skypilot_tpu.skylet.job_lib', 'JobStatus'),
+    'Optimizer': ('skypilot_tpu.optimizer', 'Optimizer'),
+    'OptimizeTarget': ('skypilot_tpu.optimizer', 'OptimizeTarget'),
+    'clouds': ('skypilot_tpu.clouds', None),
+}
+
+
+def __getattr__(name: str) -> Any:
+    target = _LAZY_ATTRS.get(name)
+    if target is None:
+        raise AttributeError(f'module {__name__!r} has no attribute {name!r}')
+    module_name, attr = target
+    module = importlib.import_module(module_name)
+    value = module if attr is None else getattr(module, attr)
+    globals()[name] = value
+    return value
+
+
+__all__ = ['Dag', 'Resources', 'Task', '__version__'] + list(_LAZY_ATTRS)

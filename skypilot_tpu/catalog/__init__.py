@@ -1,0 +1,57 @@
+"""Catalog: instance type / accelerator / price lookups, per cloud.
+
+Reference analog: sky/catalog/__init__.py:57 (list_accelerators),
+:248 (instance for accelerator), :337 (get_tpus).
+"""
+import importlib
+from typing import Dict, List, Optional
+
+from skypilot_tpu.catalog.common import InstanceTypeInfo
+
+_CATALOG_MODULES = {
+    'gcp': 'skypilot_tpu.catalog.gcp_catalog',
+    'local': 'skypilot_tpu.catalog.local_catalog',
+    'kubernetes': 'skypilot_tpu.catalog.kubernetes_catalog',
+}
+
+
+def _catalog(cloud: str):
+    mod = _CATALOG_MODULES.get(cloud.lower())
+    if mod is None:
+        return None
+    try:
+        return importlib.import_module(mod)
+    except ImportError:
+        return None
+
+
+def supported_clouds() -> List[str]:
+    return sorted(_CATALOG_MODULES)
+
+
+def list_accelerators(name_filter: Optional[str] = None,
+                      clouds: Optional[List[str]] = None
+                      ) -> Dict[str, List[InstanceTypeInfo]]:
+    out: Dict[str, List[InstanceTypeInfo]] = {}
+    for cloud in clouds or supported_clouds():
+        cat = _catalog(cloud)
+        if cat is None or not hasattr(cat, 'list_accelerators'):
+            continue
+        for name, rows in cat.list_accelerators(name_filter).items():
+            out.setdefault(name, []).extend(rows)
+    return out
+
+
+def get_feasible(cloud: str, resources) -> List[InstanceTypeInfo]:
+    cat = _catalog(cloud)
+    if cat is None:
+        return []
+    return cat.get_feasible(resources)
+
+
+def validate_region_zone(cloud: str, region: Optional[str],
+                         zone: Optional[str]) -> bool:
+    cat = _catalog(cloud)
+    if cat is None or not hasattr(cat, 'validate_region_zone'):
+        return True
+    return cat.validate_region_zone(region, zone)

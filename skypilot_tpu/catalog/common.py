@@ -1,0 +1,58 @@
+"""Catalog plumbing: CSV-backed instance/accelerator/price database.
+
+Reference analog: sky/catalog/common.py:123 (`LazyDataFrame`, `read_catalog`).
+Ours ships the CSVs in-package (authored from public pricing pages, see
+data/README.md) instead of lazy-downloading; a fetcher can refresh them.
+"""
+import dataclasses
+import functools
+import os
+from typing import Dict, List, Optional
+
+_DATA_DIR = os.path.join(os.path.dirname(__file__), 'data')
+
+
+@dataclasses.dataclass(frozen=True)
+class InstanceTypeInfo:
+    """One (instance type, accelerator, region/zone) catalog row."""
+    cloud: str
+    instance_type: str
+    accelerator_name: Optional[str]
+    accelerator_count: float
+    cpus: Optional[float]
+    memory_gb: Optional[float]
+    price: float              # $/hr on-demand for the whole node
+    spot_price: Optional[float]
+    region: str
+    zone: Optional[str]
+
+    def cost(self, use_spot: bool) -> float:
+        if use_spot:
+            if self.spot_price is None:
+                return float('inf')
+            return self.spot_price
+        return self.price
+
+
+@functools.lru_cache(maxsize=None)
+def read_catalog(cloud: str, name: str):
+    """Load `data/<cloud>/<name>.csv` as a pandas DataFrame (cached)."""
+    import pandas as pd  # lazy: keep `import skypilot_tpu` pandas-free
+    path = os.path.join(_DATA_DIR, cloud, f'{name}.csv')
+    if not os.path.isfile(path):
+        return pd.DataFrame()
+    return pd.read_csv(path)
+
+
+def catalog_path(cloud: str, name: str) -> str:
+    return os.path.join(_DATA_DIR, cloud, f'{name}.csv')
+
+
+def _float_or_none(v) -> Optional[float]:
+    import pandas as pd
+    if v is None or (isinstance(v, float) and pd.isna(v)):
+        return None
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return None

@@ -1,0 +1,155 @@
+"""GCP catalog: VMs, GPUs, and TPU slices from the shipped CSVs.
+
+Reference analog: sky/catalog/gcp_catalog.py (675 LoC). Key TPU-first
+difference: TPU-VM slices are priced per chip-hour (host VMs included, per
+GCP TPU pricing), so a feasible TPU row is *synthesized* from
+(generation, chips, zone) instead of looked up as an instance type —
+`tpu-v5p:8` becomes a `v5p-16` slice entry with price = 8 x chip price.
+"""
+from typing import Dict, List, Optional
+
+from skypilot_tpu.catalog import common
+from skypilot_tpu.utils import accelerators as acc_lib
+
+
+def _tpu_df():
+    return common.read_catalog('gcp', 'tpus')
+
+
+def _vm_df():
+    return common.read_catalog('gcp', 'vms')
+
+
+def list_accelerators(name_filter: Optional[str] = None
+                      ) -> Dict[str, List[common.InstanceTypeInfo]]:
+    """All accelerators (GPUs and TPU generations) with one row per zone."""
+    out: Dict[str, List[common.InstanceTypeInfo]] = {}
+    df = _vm_df()
+    if len(df):
+        gpu = df[df['accelerator_name'].notna()]
+        for row in gpu.itertuples():
+            name = row.accelerator_name
+            if name_filter and name_filter.lower() not in name.lower():
+                continue
+            out.setdefault(name, []).append(_vm_row_to_info(row))
+    tdf = _tpu_df()
+    for row in tdf.itertuples():
+        name = row.generation
+        if name_filter and name_filter.lower() not in name.lower():
+            continue
+        gen = acc_lib.tpu_gen(name)
+        out.setdefault(name, []).append(
+            common.InstanceTypeInfo(
+                cloud='gcp', instance_type=gen.slice_type(1),
+                accelerator_name=name, accelerator_count=1,
+                cpus=None, memory_gb=gen.hbm_gb_per_chip,
+                price=float(row.price_per_chip),
+                spot_price=common._float_or_none(row.spot_price_per_chip),
+                region=row.region, zone=row.zone))
+    return out
+
+
+def _vm_row_to_info(row) -> common.InstanceTypeInfo:
+    import pandas as pd
+    acc = row.accelerator_name
+    if isinstance(acc, float) and pd.isna(acc):
+        acc = None
+    return common.InstanceTypeInfo(
+        cloud='gcp', instance_type=row.instance_type,
+        accelerator_name=acc,
+        accelerator_count=float(row.accelerator_count),
+        cpus=common._float_or_none(row.cpus),
+        memory_gb=common._float_or_none(row.memory_gb),
+        price=float(row.price),
+        spot_price=common._float_or_none(row.spot_price),
+        region=row.region, zone=row.zone)
+
+
+def get_feasible(resources) -> List[common.InstanceTypeInfo]:
+    """Catalog rows that satisfy a (partial) Resources spec, cheapest first.
+
+    TPU requests synthesize slice rows; GPU/CPU requests filter VM rows.
+    """
+    rows: List[common.InstanceTypeInfo] = []
+    acc = resources.sole_accelerator()
+    if resources.accelerators and acc is None:
+        # Multi-accelerator dicts must be expanded via get_candidate_set()
+        # before reaching the catalog; refusing here prevents a GPU/TPU
+        # request from silently matching CPU-only rows.
+        return []
+    if acc is not None and acc_lib.is_tpu(acc[0]):
+        gen = acc_lib.tpu_gen(acc[0])
+        chips = int(acc[1])
+        tdf = _tpu_df()
+        if not len(tdf):
+            return []
+        tdf = tdf[tdf['generation'] == gen.name]
+        for row in tdf.itertuples():
+            if resources.region and row.region != resources.region:
+                continue
+            if resources.zone and row.zone != resources.zone:
+                continue
+            spot = common._float_or_none(row.spot_price_per_chip)
+            rows.append(common.InstanceTypeInfo(
+                cloud='gcp',
+                instance_type=f'tpu-{gen.slice_type(chips)}',
+                accelerator_name=gen.name, accelerator_count=chips,
+                cpus=None, memory_gb=gen.hbm_gb_per_chip * chips,
+                price=float(row.price_per_chip) * chips,
+                spot_price=None if spot is None else spot * chips,
+                region=row.region, zone=row.zone))
+    else:
+        df = _vm_df()
+        if not len(df):
+            return []
+        for row in df.itertuples():
+            info = _vm_row_to_info(row)
+            if not _vm_feasible(info, resources, acc):
+                continue
+            rows.append(info)
+    rows.sort(key=lambda r: r.cost(resources.use_spot))
+    return rows
+
+
+def _vm_feasible(info: common.InstanceTypeInfo, resources, acc) -> bool:
+    if resources.instance_type and info.instance_type != \
+            resources.instance_type:
+        return False
+    if resources.region and info.region != resources.region:
+        return False
+    if resources.zone and info.zone != resources.zone:
+        return False
+    if acc is not None:
+        name, count = acc
+        if info.accelerator_name != name or info.accelerator_count < count:
+            return False
+    elif info.accelerator_name is not None and not resources.instance_type:
+        # Don't hand out GPU nodes for pure-CPU requests.
+        return False
+    if resources.cpus is not None:
+        if info.cpus is None or info.cpus < resources.cpus:
+            return False
+    if resources.memory is not None:
+        if info.memory_gb is None or info.memory_gb < resources.memory:
+            return False
+    if resources.use_spot and info.spot_price is None:
+        return False
+    return True
+
+
+def validate_region_zone(region: Optional[str], zone: Optional[str]) -> bool:
+    import pandas as pd
+    frames = []
+    vdf, tdf = _vm_df(), _tpu_df()
+    if len(vdf):
+        frames.append(vdf[['region', 'zone']])
+    if len(tdf):
+        frames.append(tdf[['region', 'zone']])
+    if not frames:
+        return True
+    all_rz = pd.concat(frames)
+    if region is not None and region not in set(all_rz['region']):
+        return False
+    if zone is not None and zone not in set(all_rz['zone']):
+        return False
+    return True

@@ -1,0 +1,44 @@
+"""Catalog for the 'local' cloud: this machine, free of charge.
+
+The local cloud backs the end-to-end test path (launch -> provision ->
+job queue -> logs) without any cloud credentials, the way the reference
+uses moto-mocked EC2 (tests/common_test_fixtures.py:414). Unlike a mock,
+it actually runs jobs as local processes.
+"""
+from typing import Dict, List, Optional
+
+from skypilot_tpu.catalog import common
+
+
+def _rows() -> List[common.InstanceTypeInfo]:
+    import os
+    try:
+        cpus = float(os.cpu_count() or 1)
+    except Exception:  # pragma: no cover
+        cpus = 1.0
+    return [
+        common.InstanceTypeInfo(
+            cloud='local', instance_type='localhost',
+            accelerator_name=None, accelerator_count=0,
+            cpus=cpus, memory_gb=None, price=0.0, spot_price=0.0,
+            region='local', zone='local')
+    ]
+
+
+def list_accelerators(name_filter: Optional[str] = None
+                      ) -> Dict[str, List[common.InstanceTypeInfo]]:
+    return {}
+
+
+def get_feasible(resources) -> List[common.InstanceTypeInfo]:
+    if resources.accelerators:
+        return []
+    if resources.instance_type not in (None, 'localhost'):
+        return []
+    if resources.use_spot:
+        return []
+    return _rows()
+
+
+def validate_region_zone(region: Optional[str], zone: Optional[str]) -> bool:
+    return region in (None, 'local') and zone in (None, 'local')

@@ -1,0 +1,66 @@
+"""Credential probing + enabled-cloud cache.
+
+Reference analog: sky/check.py:53 (`check_capabilities`),
+:356 (`get_cached_enabled_clouds_or_refresh`).
+"""
+import json
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu import clouds as clouds_lib
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+_CACHE_PATH = '~/.skytpu/enabled_clouds.json'
+_lock = threading.Lock()
+
+
+def check_credentials(cloud_names: Optional[List[str]] = None
+                      ) -> Dict[str, Tuple[bool, Optional[str]]]:
+    """Probe credentials for each cloud; returns {cloud: (ok, reason)}."""
+    results: Dict[str, Tuple[bool, Optional[str]]] = {}
+    for name in cloud_names or CLOUD_REGISTRY.names():
+        cloud = clouds_lib.get_cloud(name)
+        try:
+            results[name] = cloud.check_credentials()
+        except Exception as e:  # noqa: BLE001 — a broken SDK != fatal
+            results[name] = (False, f'credential check error: {e}')
+    return results
+
+
+def check(refresh: bool = True, quiet: bool = True) -> List[str]:
+    """Probe all clouds, persist the enabled set, return it."""
+    allowed = config_lib.get_nested(('allowed_clouds',), None)
+    names = [n for n in CLOUD_REGISTRY.names()
+             if allowed is None or n in allowed]
+    results = check_credentials(names)
+    enabled = sorted(n for n, (ok, _) in results.items() if ok)
+    path = os.path.expanduser(_CACHE_PATH)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with _lock, open(path, 'w', encoding='utf-8') as f:
+        json.dump({'enabled': enabled}, f)
+    if not quiet:
+        for name, (ok, reason) in sorted(results.items()):
+            mark = 'enabled' if ok else f'disabled: {reason}'
+            print(f'  {name}: {mark}')
+    return enabled
+
+
+def get_cached_enabled_clouds_or_refresh(
+        raise_if_no_cloud_access: bool = False) -> List[str]:
+    path = os.path.expanduser(_CACHE_PATH)
+    enabled: Optional[List[str]] = None
+    if os.path.isfile(path):
+        try:
+            with open(path, 'r', encoding='utf-8') as f:
+                enabled = json.load(f).get('enabled')
+        except (json.JSONDecodeError, OSError):
+            enabled = None
+    if enabled is None:
+        enabled = check(quiet=True)
+    if raise_if_no_cloud_access and not enabled:
+        raise exceptions.NoCloudEnabledError(
+            'No cloud is enabled. Run `tsky check` for details.')
+    return enabled

@@ -1,0 +1,22 @@
+"""Cloud policy classes. Importing this package registers all clouds."""
+from skypilot_tpu.clouds.cloud import Cloud, CloudCapability
+from skypilot_tpu.clouds import gcp as _gcp  # noqa: F401 (registers)
+from skypilot_tpu.clouds import local as _local  # noqa: F401 (registers)
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+GCP = _gcp.GCP
+Local = _local.Local
+
+try:  # kubernetes is optional until round 2+
+    from skypilot_tpu.clouds import kubernetes as _k8s  # noqa: F401
+    Kubernetes = _k8s.Kubernetes
+except ImportError:  # pragma: no cover
+    Kubernetes = None
+
+
+def get_cloud(name: str) -> Cloud:
+    return CLOUD_REGISTRY.get(name)()
+
+
+__all__ = ['Cloud', 'CloudCapability', 'GCP', 'Local', 'get_cloud',
+           'CLOUD_REGISTRY']

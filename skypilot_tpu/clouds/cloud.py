@@ -1,0 +1,79 @@
+"""Cloud policy base class: capabilities, feasibility, deploy variables.
+
+Reference analog: sky/clouds/cloud.py:136 (`Cloud`) with feature flags at
+:32 (`CloudImplementationFeatures`). Ours keeps the same
+capabilities-as-flags shape so backends can gate behavior uniformly
+(e.g. TPU pods: STOP unsupported -> autostop must terminate).
+"""
+import enum
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu import exceptions
+from skypilot_tpu.catalog.common import InstanceTypeInfo
+
+
+class CloudCapability(enum.Enum):
+    MULTI_NODE = 'multi_node'
+    SPOT_INSTANCE = 'spot_instance'
+    STOP = 'stop'                      # stop (vs only terminate)
+    AUTOSTOP = 'autostop'
+    OPEN_PORTS = 'open_ports'
+    STORAGE_MOUNT = 'storage_mount'
+    TPU = 'tpu'
+    CUSTOM_IMAGE = 'custom_image'
+    HOST_CONTROLLERS = 'host_controllers'
+
+
+class Cloud:
+    """Per-cloud policy: what it can do and how to ask for it."""
+
+    NAME: str = ''
+    CAPABILITIES: frozenset = frozenset()
+    # Max cloud-resource-name length (cluster name on cloud).
+    MAX_CLUSTER_NAME_LENGTH: Optional[int] = None
+
+    def supports(self, cap: CloudCapability) -> bool:
+        return cap in self.CAPABILITIES
+
+    def check_capability(self, cap: CloudCapability) -> None:
+        if not self.supports(cap):
+            raise exceptions.NotSupportedError(
+                f'{self.NAME} does not support {cap.value}')
+
+    # --- feasibility (optimizer entry) -------------------------------------
+
+    def get_feasible(self, resources) -> List[InstanceTypeInfo]:
+        """Catalog rows satisfying `resources`, cheapest first."""
+        rows = catalog.get_feasible(self.NAME, resources)
+        if resources.use_spot:
+            rows = [r for r in rows if r.spot_price is not None]
+            if rows and not self.supports(CloudCapability.SPOT_INSTANCE):
+                return []
+        return rows
+
+    def validate_region_zone(self, region: Optional[str],
+                             zone: Optional[str]) -> bool:
+        return catalog.validate_region_zone(self.NAME, region, zone)
+
+    # --- provisioning handoff ----------------------------------------------
+
+    def provision_module(self) -> str:
+        """Dotted path of the provision implementation module."""
+        raise NotImplementedError
+
+    def make_deploy_variables(self, resources, cluster_name_on_cloud: str,
+                              region: str, zone: Optional[str]
+                              ) -> Dict[str, object]:
+        """Variables consumed by the provisioner for this cloud."""
+        raise NotImplementedError
+
+    # --- credentials --------------------------------------------------------
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        """(ok, reason-if-not)."""
+        return False, f'{self.NAME}: no credential check implemented'
+
+    def __repr__(self) -> str:
+        return self.NAME.upper() if self.NAME == 'gcp' else \
+            self.NAME.capitalize()

@@ -1,0 +1,93 @@
+"""GCP cloud policy — the flagship cloud: TPU-VMs and TPU pod slices.
+
+Reference analog: sky/clouds/gcp.py (1505 LoC; TPU template vars :495-530,
+TPU-VM host sizing :688-740). Ours collapses the reference's
+TPU-node/TPU-VM split: only TPU-VM (the modern architecture) exists, and a
+pod slice is one logical node with `num_hosts` workers.
+"""
+import os
+import subprocess
+from typing import Dict, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.utils import registry
+
+
+@registry.CLOUD_REGISTRY.register(name='gcp')
+class GCP(cloud.Cloud):
+    NAME = 'gcp'
+    CAPABILITIES = frozenset({
+        cloud.CloudCapability.MULTI_NODE,
+        cloud.CloudCapability.SPOT_INSTANCE,
+        cloud.CloudCapability.STOP,
+        cloud.CloudCapability.AUTOSTOP,
+        cloud.CloudCapability.OPEN_PORTS,
+        cloud.CloudCapability.STORAGE_MOUNT,
+        cloud.CloudCapability.TPU,
+        cloud.CloudCapability.CUSTOM_IMAGE,
+        cloud.CloudCapability.HOST_CONTROLLERS,
+    })
+    MAX_CLUSTER_NAME_LENGTH = 35
+
+    def supports(self, cap: cloud.CloudCapability) -> bool:
+        return cap in self.CAPABILITIES
+
+    def supports_for(self, cap: cloud.CloudCapability, resources) -> bool:
+        """Per-resource capability: TPU slices cannot STOP, only terminate
+        (reference clouds/gcp.py:216-226) — autostop must tear down."""
+        if cap == cloud.CloudCapability.STOP and resources.is_tpu:
+            return False
+        return self.supports(cap)
+
+    def provision_module(self) -> str:
+        return 'skypilot_tpu.provision.gcp'
+
+    def make_deploy_variables(self, resources, cluster_name_on_cloud: str,
+                              region: str, zone: Optional[str]
+                              ) -> Dict[str, object]:
+        resources.assert_launchable()
+        variables: Dict[str, object] = {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region,
+            'zone': zone,
+            'instance_type': resources.instance_type,
+            'use_spot': resources.use_spot,
+            'disk_size': resources.disk_size,
+            'labels': dict(resources.labels),
+            'ports': list(resources.ports or []),
+            'num_nodes': None,  # filled by provisioner from cluster config
+        }
+        gen = resources.tpu_gen
+        if gen is not None:
+            variables.update({
+                'tpu_vm': True,
+                'tpu_generation': gen.name,
+                'accelerator_type': resources.tpu_slice_type,
+                'runtime_version': resources.cluster_config_overrides.get(
+                    'runtime_version', gen.default_runtime_version),
+                'num_hosts': resources.num_hosts_per_node,
+            })
+        else:
+            variables['tpu_vm'] = False
+            if resources.image_id:
+                variables['image_id'] = resources.image_id
+        return variables
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        # Application-default credentials or an active gcloud account.
+        adc = os.path.expanduser(
+            '~/.config/gcloud/application_default_credentials.json')
+        if os.path.isfile(adc) or os.environ.get(
+                'GOOGLE_APPLICATION_CREDENTIALS'):
+            return True, None
+        try:
+            proc = subprocess.run(
+                ['gcloud', 'auth', 'list',
+                 '--filter=status:ACTIVE', '--format=value(account)'],
+                capture_output=True, timeout=10, check=False)
+            if proc.returncode == 0 and proc.stdout.strip():
+                return True, None
+        except (FileNotFoundError, subprocess.TimeoutExpired):
+            pass
+        return False, ('GCP credentials not found. Run `gcloud auth '
+                       'application-default login`.')

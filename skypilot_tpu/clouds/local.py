@@ -1,0 +1,42 @@
+"""Local cloud policy: run on this machine (dev/test path).
+
+Replaces the reference's LocalDockerBackend toy
+(sky/backends/local_docker_backend.py:47) with a real provision-layer
+implementation so the *entire* stack (provision -> setup -> skylet job
+queue -> logs -> autostop) is exercised without credentials.
+"""
+from typing import Dict, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.utils import registry
+
+
+@registry.CLOUD_REGISTRY.register(name='local')
+class Local(cloud.Cloud):
+    NAME = 'local'
+    CAPABILITIES = frozenset({
+        cloud.CloudCapability.AUTOSTOP,
+        cloud.CloudCapability.OPEN_PORTS,
+        cloud.CloudCapability.STOP,
+    })
+
+    def supports_for(self, cap: cloud.CloudCapability, resources) -> bool:
+        return self.supports(cap)
+
+    def provision_module(self) -> str:
+        return 'skypilot_tpu.provision.local'
+
+    def make_deploy_variables(self, resources, cluster_name_on_cloud: str,
+                              region: str, zone: Optional[str]
+                              ) -> Dict[str, object]:
+        return {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': 'local',
+            'zone': None,
+            'instance_type': 'localhost',
+            'use_spot': False,
+            'tpu_vm': False,
+        }
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        return True, None

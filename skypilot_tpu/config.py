@@ -1,0 +1,109 @@
+"""Layered YAML configuration.
+
+Reference analog: sky/skypilot_config.py:88-117 (resolution order). Layers,
+lowest to highest precedence:
+
+    1. user config      ~/.skytpu/config.yaml
+    2. project config   ./.skytpu.yaml
+    3. env override     $SKYTPU_CONFIG (path to a YAML file)
+    4. per-request overrides (dict pushed via `override()` context manager)
+
+`get_nested(('jobs','controller','resources'), default)` reads through the
+merged view.
+"""
+import contextlib
+import copy
+import os
+import threading
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+import yaml
+
+USER_CONFIG_PATH = '~/.skytpu/config.yaml'
+PROJECT_CONFIG_PATH = '.skytpu.yaml'
+ENV_VAR_CONFIG = 'SKYTPU_CONFIG'
+
+_local = threading.local()
+_cache_lock = threading.Lock()
+_cached: Optional[Dict[str, Any]] = None
+
+
+def _deep_merge(base: Dict[str, Any], over: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(base)
+    for k, v in over.items():
+        if (k in out and isinstance(out[k], dict) and isinstance(v, dict)):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = copy.deepcopy(v)
+    return out
+
+
+def _load_file(path: str) -> Dict[str, Any]:
+    path = os.path.abspath(os.path.expanduser(path))
+    if not os.path.isfile(path):
+        return {}
+    with open(path, 'r', encoding='utf-8') as f:
+        data = yaml.safe_load(f)
+    if data is None:
+        return {}
+    if not isinstance(data, dict):
+        raise ValueError(f'Config file {path} must contain a mapping.')
+    return data
+
+
+def _base_config() -> Dict[str, Any]:
+    global _cached
+    with _cache_lock:
+        if _cached is None:
+            merged: Dict[str, Any] = {}
+            for layer in (USER_CONFIG_PATH, PROJECT_CONFIG_PATH):
+                merged = _deep_merge(merged, _load_file(layer))
+            env_path = os.environ.get(ENV_VAR_CONFIG)
+            if env_path:
+                merged = _deep_merge(merged, _load_file(env_path))
+            _cached = merged
+        return _cached
+
+
+def reload() -> None:
+    """Drop the cached merged config (tests, config edits)."""
+    global _cached
+    with _cache_lock:
+        _cached = None
+
+
+def _effective() -> Dict[str, Any]:
+    cfg = _base_config()
+    for over in getattr(_local, 'overrides', []):
+        cfg = _deep_merge(cfg, over)
+    return cfg
+
+
+def get_nested(keys: Tuple[str, ...], default: Any = None,
+               override_configs: Optional[Dict[str, Any]] = None) -> Any:
+    cfg: Any = _effective()
+    if override_configs:
+        cfg = _deep_merge(cfg, override_configs)
+    for k in keys:
+        if not isinstance(cfg, dict) or k not in cfg:
+            return default
+        cfg = cfg[k]
+    return copy.deepcopy(cfg)
+
+
+def to_dict() -> Dict[str, Any]:
+    return copy.deepcopy(_effective())
+
+
+@contextlib.contextmanager
+def override(config: Dict[str, Any]) -> Iterator[None]:
+    """Per-request override layer (server executor uses this per request)."""
+    stack = getattr(_local, 'overrides', None)
+    if stack is None:
+        stack = []
+        _local.overrides = stack
+    stack.append(config or {})
+    try:
+        yield
+    finally:
+        stack.pop()

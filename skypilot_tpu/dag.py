@@ -1,0 +1,94 @@
+"""Dag: a DAG of Tasks with a context-manager builder.
+
+Reference analog: sky/dag.py:11 (113 LoC). Chains are the common case
+(managed-job pipelines); general DAGs validate acyclicity via networkx.
+"""
+import threading
+from typing import List, Optional
+
+from skypilot_tpu import exceptions
+
+_dag_context = threading.local()
+
+
+def _dag_stack() -> List['Dag']:
+    stack = getattr(_dag_context, 'stack', None)
+    if stack is None:
+        stack = []
+        _dag_context.stack = stack
+    return stack
+
+
+def get_current_dag() -> Optional['Dag']:
+    stack = _dag_stack()
+    return stack[-1] if stack else None
+
+
+class Dag:
+    def __init__(self, name: Optional[str] = None):
+        self.name = name
+        self.tasks: List = []
+        self._edges: List = []  # (from_task, to_task)
+
+    # --- building -----------------------------------------------------------
+
+    def add(self, task) -> None:
+        if task not in self.tasks:
+            task.dag = self
+            self.tasks.append(task)
+
+    def remove(self, task) -> None:
+        self.tasks.remove(task)
+        self._edges = [(a, b) for a, b in self._edges
+                       if a is not task and b is not task]
+
+    def add_edge(self, a, b) -> None:
+        self.add(a)
+        self.add(b)
+        self._edges.append((a, b))
+
+    def __enter__(self) -> 'Dag':
+        _dag_stack().append(self)
+        return self
+
+    def __exit__(self, *args) -> None:
+        stack = _dag_stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+
+    def __len__(self) -> int:
+        return len(self.tasks)
+
+    # --- queries ------------------------------------------------------------
+
+    def is_chain(self) -> bool:
+        if len(self.tasks) <= 1:
+            return True
+        if len(self._edges) != len(self.tasks) - 1:
+            return False
+        order = self.topological_order()
+        return all((order[i], order[i + 1]) in
+                   {(a, b) for a, b in self._edges}
+                   for i in range(len(order) - 1))
+
+    def topological_order(self) -> List:
+        import networkx as nx  # lazy
+        g = nx.DiGraph()
+        for t in self.tasks:
+            g.add_node(id(t))
+        for a, b in self._edges:
+            g.add_edge(id(a), id(b))
+        if not nx.is_directed_acyclic_graph(g):
+            raise exceptions.InvalidDagError(f'Dag {self.name!r} has a cycle')
+        by_id = {id(t): t for t in self.tasks}
+        # Stable: prefer insertion order among ready nodes.
+        order_ids = list(nx.lexicographical_topological_sort(
+            g, key=lambda n: self.tasks.index(by_id[n])))
+        return [by_id[i] for i in order_ids]
+
+    def validate(self) -> None:
+        self.topological_order()
+
+    def __repr__(self) -> str:
+        return (f'Dag({self.name!r}, tasks={len(self.tasks)}, '
+                f'edges={len(self._edges)})')

@@ -1,0 +1,182 @@
+"""Typed exception hierarchy for skypilot_tpu.
+
+Mirrors the role of the reference error taxonomy (sky/exceptions.py:142):
+typed errors drive the provisioning failover engine and surface clean
+messages at the CLI. Ours is leaner: one module, no error codes stuffed
+into strings.
+"""
+from typing import List, Optional
+
+
+class SkyTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+# --- resource & validation errors -----------------------------------------
+
+class InvalidResourcesError(SkyTpuError, ValueError):
+    """A Resources spec is malformed or internally inconsistent."""
+
+
+class InvalidTaskError(SkyTpuError, ValueError):
+    """A Task / task YAML is malformed."""
+
+
+class InvalidDagError(SkyTpuError, ValueError):
+    """A DAG is malformed (cycles, dangling edges)."""
+
+
+class InvalidInfraError(SkyTpuError, ValueError):
+    """An infra string (e.g. 'gcp/us-central2-b') cannot be parsed."""
+
+
+class AcceleratorNotFoundError(SkyTpuError, ValueError):
+    """Accelerator name not present in any enabled catalog."""
+
+
+# --- optimizer / provisioning ---------------------------------------------
+
+class ResourcesUnavailableError(SkyTpuError):
+    """No (cloud, region, zone) could satisfy the request.
+
+    Carries the failover history so callers can display the per-zone
+    reasons, like the reference failover driver does.
+    """
+
+    def __init__(self, message: str,
+                 failover_history: Optional[List[Exception]] = None):
+        super().__init__(message)
+        self.failover_history: List[Exception] = failover_history or []
+
+    def with_failover_history(
+            self, history: List[Exception]) -> 'ResourcesUnavailableError':
+        self.failover_history = history
+        return self
+
+
+class ResourcesMismatchError(SkyTpuError):
+    """Requested resources do not match what the cluster has."""
+
+
+class NoCloudEnabledError(SkyTpuError):
+    """No cloud has valid credentials / is enabled."""
+
+
+class ProvisionError(SkyTpuError):
+    """A cloud API call failed during provisioning."""
+
+    def __init__(self, message: str, retryable: bool = True):
+        super().__init__(message)
+        self.retryable = retryable
+
+
+class QuotaExceededError(ProvisionError):
+    """Out of quota in this region — blocklist region, keep failing over."""
+
+    def __init__(self, message: str):
+        super().__init__(message, retryable=True)
+
+
+class CapacityError(ProvisionError):
+    """Stockout: capacity not available in this zone right now."""
+
+    def __init__(self, message: str):
+        super().__init__(message, retryable=True)
+
+
+# --- cluster lifecycle -----------------------------------------------------
+
+class ClusterNotUpError(SkyTpuError):
+    """Operation requires an UP cluster."""
+
+    def __init__(self, message: str, cluster_status=None, handle=None):
+        super().__init__(message)
+        self.cluster_status = cluster_status
+        self.handle = handle
+
+
+class ClusterDoesNotExist(SkyTpuError, ValueError):
+    """Named cluster not found in the state DB."""
+
+
+class ClusterOwnerIdentityMismatchError(SkyTpuError):
+    """Cluster belongs to a different cloud identity."""
+
+
+class NotSupportedError(SkyTpuError):
+    """The operation is not supported by this cloud/backend."""
+
+
+class ClusterSetUpError(SkyTpuError):
+    """Runtime setup (deps install, skylet start) failed on the cluster."""
+
+
+# --- execution -------------------------------------------------------------
+
+class CommandError(SkyTpuError):
+    """A remote or local command exited non-zero."""
+
+    def __init__(self, returncode: int, command: str, error_msg: str = '',
+                 detailed_reason: Optional[str] = None):
+        self.returncode = returncode
+        self.command = command
+        self.error_msg = error_msg
+        self.detailed_reason = detailed_reason
+        cmd = command if len(command) < 100 else command[:100] + '...'
+        super().__init__(
+            f'Command {cmd!r} failed with return code {returncode}.'
+            + (f' {error_msg}' if error_msg else ''))
+
+
+class JobNotFoundError(SkyTpuError):
+    """Job id not present in the cluster job queue."""
+
+
+class JobExitNonZeroError(SkyTpuError):
+    """User job finished with a non-zero exit code."""
+
+
+# --- server / client -------------------------------------------------------
+
+class ApiServerError(SkyTpuError):
+    """API server returned an error response."""
+
+
+class RequestCancelled(SkyTpuError):
+    """An async request was cancelled by the user."""
+
+
+class ApiVersionMismatchError(SkyTpuError):
+    """Client and server speak incompatible API versions."""
+
+
+# --- storage ---------------------------------------------------------------
+
+class StorageError(SkyTpuError):
+    """Base for storage subsystem errors."""
+
+
+class StorageBucketCreateError(StorageError):
+    pass
+
+
+class StorageBucketGetError(StorageError):
+    pass
+
+
+class StorageUploadError(StorageError):
+    pass
+
+
+# --- managed jobs / serve --------------------------------------------------
+
+class ManagedJobReachedMaxRetriesError(SkyTpuError):
+    """Managed job exhausted recovery attempts."""
+
+
+class ManagedJobStatusError(SkyTpuError):
+    """Unexpected managed-job state transition."""
+
+
+class ServeError(SkyTpuError):
+    """SkyServe-analog subsystem error."""

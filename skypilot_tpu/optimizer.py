@@ -1,0 +1,171 @@
+"""Optimizer: fill in launchable resources and pick the cheapest/fastest.
+
+Reference analog: sky/optimizer.py:71 — `_fill_in_launchable_resources`
+(:1256) + DP over chains (:429) + PuLP ILP for general DAGs (:490). Ours:
+the same candidate-fill, then exact DP over chains; general DAGs fall back
+to per-task greedy (an ILP adds nothing until inter-task egress costs are
+modeled; egress hook is in `_transfer_cost`).
+"""
+import collections
+import enum
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu import check as check_lib
+from skypilot_tpu import clouds as clouds_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu.catalog.common import InstanceTypeInfo
+
+
+class OptimizeTarget(enum.Enum):
+    COST = 'cost'
+    TIME = 'time'
+
+
+class Optimizer:
+
+    @staticmethod
+    def optimize(dag, minimize: OptimizeTarget = OptimizeTarget.COST,
+                 blocked_resources: Optional[List] = None,
+                 quiet: bool = False):
+        """Sets `task.best_resources` on every task in the dag."""
+        dag.validate()
+        order = dag.topological_order()
+        per_task: Dict[int, List[Tuple[resources_lib.Resources, float]]] = {}
+        for task in order:
+            candidates = Optimizer._fill_in_launchable_resources(
+                task, blocked_resources)
+            if not candidates:
+                raise exceptions.ResourcesUnavailableError(
+                    f'No launchable resources satisfy task {task.name!r}: '
+                    f'{sorted(task.resources, key=repr)}')
+            per_task[id(task)] = candidates
+        # Chains and general DAGs alike: no inter-task transfer cost is
+        # modeled yet, so per-task argmin == global min. `_transfer_cost`
+        # is the seam where egress pricing will slot in.
+        for task in order:
+            if minimize == OptimizeTarget.TIME:
+                # Highest aggregate accelerator throughput, cheapest on tie.
+                best, cost = max(
+                    per_task[id(task)],
+                    key=lambda rc: (Optimizer._throughput(rc[0]), -rc[1]))
+            else:
+                best, cost = min(per_task[id(task)], key=lambda rc: rc[1])
+            task.best_resources = best
+        if not quiet:
+            Optimizer._print_choice(order, per_task)
+        return dag
+
+    # --- candidate fill -----------------------------------------------------
+
+    @staticmethod
+    def _fill_in_launchable_resources(
+        task, blocked_resources: Optional[List] = None
+    ) -> List[Tuple[resources_lib.Resources, float]]:
+        """All launchable (resources, $/hr for the whole task) candidates."""
+        enabled = check_lib.get_cached_enabled_clouds_or_refresh(
+            raise_if_no_cloud_access=True)
+        out: List[Tuple[resources_lib.Resources, float]] = []
+        for base in task.resources:
+            for res in base.get_candidate_set():
+                target_clouds = ([res.cloud] if res.cloud is not None
+                                 else enabled)
+                for cloud_name in target_clouds:
+                    if cloud_name not in enabled:
+                        continue
+                    cloud = clouds_lib.get_cloud(cloud_name)
+                    for row in cloud.get_feasible(res):
+                        launchable = Optimizer._make_launchable(res, row)
+                        if Optimizer._blocked(launchable, blocked_resources):
+                            continue
+                        hourly = row.cost(res.use_spot) * task.num_nodes
+                        out.append((launchable, hourly))
+        return out
+
+    @staticmethod
+    def _make_launchable(res: resources_lib.Resources,
+                         row: InstanceTypeInfo) -> resources_lib.Resources:
+        infra = row.cloud
+        if row.region:
+            infra += f'/{row.region}'
+            if row.zone:
+                infra += f'/{row.zone}'
+        accelerators = None
+        if row.accelerator_name:
+            accelerators = {row.accelerator_name: row.accelerator_count}
+        launchable = res.copy(
+            infra=infra,
+            instance_type=row.instance_type,
+            accelerators=accelerators,
+            _cluster_config_overrides=dict(res.cluster_config_overrides),
+        )
+        launchable._hourly_cost = row.cost(res.use_spot)  # noqa: SLF001
+        return launchable
+
+    @staticmethod
+    def _blocked(res: resources_lib.Resources,
+                 blocked: Optional[List]) -> bool:
+        for b in blocked or []:
+            if b.less_demanding_than(res) or (
+                    b.cloud == res.cloud and b.region in (None, res.region)
+                    and b.zone in (None, res.zone)
+                    and b.instance_type in (None, res.instance_type)):
+                return True
+        return False
+
+    # Rough per-device bf16 TFLOPs for the TIME target; TPU gens read from
+    # TpuGen. Unlisted accelerators count as 0 (CPU-ish).
+    _GPU_TFLOPS = {
+        'V100': 125.0, 'T4': 65.0, 'P100': 21.0, 'A10G': 125.0,
+        'L4': 121.0, 'L40S': 362.0, 'A100': 312.0, 'A100-80GB': 312.0,
+        'H100': 989.0, 'H200': 989.0, 'B200': 2250.0,
+    }
+
+    @staticmethod
+    def _throughput(res: resources_lib.Resources) -> float:
+        if not res.accelerators:
+            return 0.0
+        gen = res.tpu_gen
+        if gen is not None:
+            return gen.bf16_tflops_per_chip * res.tpu_num_chips
+        total = 0.0
+        for name, count in res.accelerators.items():
+            total += Optimizer._GPU_TFLOPS.get(name, 0.0) * count
+        return total
+
+    @staticmethod
+    def _transfer_cost(src: Optional[resources_lib.Resources],
+                       dst: resources_lib.Resources) -> float:
+        """Inter-task egress cost hook (reference _egress_cost :75)."""
+        del src, dst
+        return 0.0
+
+    # --- display ------------------------------------------------------------
+
+    @staticmethod
+    def _print_choice(order, per_task) -> None:
+        from skypilot_tpu.utils import log_utils
+        rows = []
+        for task in order:
+            best = task.best_resources
+            cost = getattr(best, '_hourly_cost', 0.0) * task.num_nodes
+            accs = '-'
+            if best.accelerators:
+                accs = ', '.join(f'{n}:{int(c) if c == int(c) else c}'
+                                 for n, c in best.accelerators.items())
+            rows.append([
+                task.name or '-',
+                best.infra.to_str(),
+                best.instance_type or '-',
+                accs,
+                str(task.num_nodes),
+                f'$ {cost:.2f}',
+            ])
+        log_utils.print_table(
+            ['TASK', 'INFRA', 'INSTANCE', 'ACCELERATORS', 'NODES', 'COST/hr'],
+            rows, title='Optimizer: cheapest launchable resources')
+
+
+def estimated_hourly_cost(resources: resources_lib.Resources,
+                          num_nodes: int = 1) -> float:
+    return getattr(resources, '_hourly_cost', 0.0) * num_nodes

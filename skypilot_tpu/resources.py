@@ -1,0 +1,439 @@
+"""Resources: the user's hardware request, canonicalized and launchable.
+
+Reference analog: sky/resources.py:93 (`Resources`, 2357 LoC). Ours folds
+TPUs into the single accelerator path (see utils/accelerators.py) instead of
+special-casing them: a `tpu-v5p:8` request flows through the same
+canonicalize -> catalog -> optimizer -> provision pipeline as `A100:8`, and
+multi-host TPU slices surface as `num_hosts > 1` on the *same* node
+abstraction (one "node" == one slice, reference num_ips_per_node shape,
+cloud_vm_ray_backend.py:2613).
+"""
+import dataclasses
+from typing import Any, Dict, List, Optional, Set, Tuple, Union
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import accelerators as acc_lib
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import infra_utils
+
+_DEFAULT_DISK_SIZE_GB = 256
+
+
+@dataclasses.dataclass
+class AutostopConfig:
+    """Autostop policy carried on Resources (reference sky/resources.py:43)."""
+    enabled: bool = False
+    idle_minutes: int = 5
+    down: bool = False  # terminate instead of stop (TPU pods: must terminate)
+
+    @classmethod
+    def from_config(cls, cfg: Union[None, bool, int, str, Dict[str, Any]]
+                    ) -> Optional['AutostopConfig']:
+        if cfg is None:
+            return None
+        if isinstance(cfg, bool):
+            return cls(enabled=cfg)
+        if isinstance(cfg, (int, float)):
+            return cls(enabled=True, idle_minutes=int(cfg))
+        if isinstance(cfg, str):
+            return cls(enabled=True, idle_minutes=int(cfg.rstrip('m')))
+        if isinstance(cfg, dict):
+            return cls(enabled=bool(cfg.get('enabled', True)),
+                       idle_minutes=int(cfg.get('idle_minutes', 5)),
+                       down=bool(cfg.get('down', False)))
+        raise exceptions.InvalidResourcesError(f'Invalid autostop: {cfg!r}')
+
+    def to_config(self) -> Dict[str, Any]:
+        return {'enabled': self.enabled, 'idle_minutes': self.idle_minutes,
+                'down': self.down}
+
+
+class Resources:
+    """A (possibly partial) hardware requirement.
+
+    Partial specs ('any cloud with 8 v5e chips') are *filled in* by the
+    optimizer into launchable specs (cloud + region + instance type pinned).
+    """
+
+    def __init__(
+        self,
+        infra: Optional[str] = None,
+        accelerators: Union[None, str, Dict[str, float], List[str]] = None,
+        cpus: Union[None, int, float, str] = None,
+        memory: Union[None, int, float, str] = None,
+        instance_type: Optional[str] = None,
+        use_spot: Optional[bool] = None,
+        disk_size: Union[None, int, str] = None,
+        disk_tier: Optional[str] = None,
+        ports: Union[None, int, str, List[Union[int, str]]] = None,
+        image_id: Optional[str] = None,
+        labels: Optional[Dict[str, str]] = None,
+        autostop: Union[None, bool, int, str, Dict[str, Any]] = None,
+        job_recovery: Optional[Union[str, Dict[str, Any]]] = None,
+        any_of: Optional[List[Dict[str, Any]]] = None,
+        # Internal: set by the optimizer when making a spec launchable.
+        _cluster_config_overrides: Optional[Dict[str, Any]] = None,
+    ):
+        infra_info = infra_utils.InfraInfo.from_str(infra)
+        self._cloud: Optional[str] = infra_info.cloud
+        self._region: Optional[str] = infra_info.region
+        self._zone: Optional[str] = infra_info.zone
+
+        self._accelerators = acc_lib.parse_accelerator_spec(accelerators)
+
+        self._cpus: Optional[float] = None
+        self._cpus_plus = False
+        if cpus is not None:
+            self._cpus, self._cpus_plus = common_utils.parse_count_with_plus(
+                cpus)
+
+        self._memory: Optional[float] = None
+        self._memory_plus = False
+        if memory is not None:
+            self._memory_plus = str(memory).strip().endswith('+')
+            self._memory = common_utils.parse_memory_size(memory)
+
+        self._instance_type = instance_type
+        self._use_spot_specified = use_spot is not None
+        self._use_spot = bool(use_spot) if use_spot is not None else False
+        self._disk_size = (int(common_utils.parse_memory_size(
+            disk_size, 'disk_size')) if disk_size is not None
+            else _DEFAULT_DISK_SIZE_GB)
+        self._disk_tier = disk_tier
+        self._ports = self._parse_ports(ports)
+        self._image_id = image_id
+        self._labels = dict(labels) if labels else {}
+        self._autostop = AutostopConfig.from_config(autostop)
+        self._job_recovery = job_recovery
+        self._any_of = any_of
+        self._cluster_config_overrides = _cluster_config_overrides or {}
+        self._validate()
+
+    # --- parsing / validation ---------------------------------------------
+
+    @staticmethod
+    def _parse_ports(ports) -> Optional[List[str]]:
+        if ports is None:
+            return None
+        if isinstance(ports, (int, str)):
+            ports = [ports]
+        out = []
+        for p in ports:
+            s = str(p).strip()
+            if '-' in s:
+                lo, _, hi = s.partition('-')
+                lo_i, hi_i = int(lo), int(hi)
+                if not (0 < lo_i <= hi_i <= 65535):
+                    raise exceptions.InvalidResourcesError(
+                        f'Invalid port range: {s!r}')
+            elif not 0 < int(s) <= 65535:
+                raise exceptions.InvalidResourcesError(f'Invalid port: {s!r}')
+            out.append(s)
+        return out
+
+    def _validate(self) -> None:
+        if self._accelerators is not None:
+            for name, count in self._accelerators.items():
+                if count <= 0:
+                    raise exceptions.InvalidResourcesError(
+                        f'Accelerator count must be positive: {name}:{count}')
+                if acc_lib.is_tpu(name):
+                    gen = acc_lib.tpu_gen(name)
+                    if count != int(count):
+                        raise exceptions.InvalidResourcesError(
+                            f'TPU chip count must be an integer: '
+                            f'{name}:{count}')
+                    if count > gen.max_chips:
+                        raise exceptions.InvalidResourcesError(
+                            f'{name}:{int(count)} exceeds the largest '
+                            f'{name} slice ({gen.max_chips} chips)')
+                    if not gen.valid_chip_count(int(count)):
+                        raise exceptions.InvalidResourcesError(
+                            f'No {name} slice with {int(count)} chips '
+                            f'exists; pick a valid slice size (e.g. 4, 8, '
+                            f'16, ...).')
+            if len(self._accelerators) > 1 and self._instance_type:
+                raise exceptions.InvalidResourcesError(
+                    'Cannot pin instance_type with multiple accelerator '
+                    'candidates.')
+        if self._zone is not None and self._region is None:
+            raise exceptions.InvalidResourcesError(
+                'zone requires region to be set')
+
+    # --- accessors ---------------------------------------------------------
+
+    @property
+    def cloud(self) -> Optional[str]:
+        return self._cloud
+
+    @property
+    def region(self) -> Optional[str]:
+        return self._region
+
+    @property
+    def zone(self) -> Optional[str]:
+        return self._zone
+
+    @property
+    def infra(self) -> infra_utils.InfraInfo:
+        return infra_utils.InfraInfo(self._cloud, self._region, self._zone)
+
+    @property
+    def accelerators(self) -> Optional[Dict[str, float]]:
+        return self._accelerators
+
+    @property
+    def cpus(self) -> Optional[float]:
+        return self._cpus
+
+    @property
+    def memory(self) -> Optional[float]:
+        return self._memory
+
+    @property
+    def instance_type(self) -> Optional[str]:
+        return self._instance_type
+
+    @property
+    def use_spot(self) -> bool:
+        return self._use_spot
+
+    @property
+    def use_spot_specified(self) -> bool:
+        return self._use_spot_specified
+
+    @property
+    def disk_size(self) -> int:
+        return self._disk_size
+
+    @property
+    def disk_tier(self) -> Optional[str]:
+        return self._disk_tier
+
+    @property
+    def ports(self) -> Optional[List[str]]:
+        return self._ports
+
+    @property
+    def image_id(self) -> Optional[str]:
+        return self._image_id
+
+    @property
+    def labels(self) -> Dict[str, str]:
+        return self._labels
+
+    @property
+    def autostop(self) -> Optional[AutostopConfig]:
+        return self._autostop
+
+    @property
+    def job_recovery(self):
+        return self._job_recovery
+
+    @property
+    def any_of(self):
+        return self._any_of
+
+    @property
+    def cluster_config_overrides(self) -> Dict[str, Any]:
+        return self._cluster_config_overrides
+
+    # --- TPU-specific derived views (single accelerator path) --------------
+
+    def sole_accelerator(self) -> Optional[Tuple[str, float]]:
+        if not self._accelerators:
+            return None
+        if len(self._accelerators) != 1:
+            return None
+        return next(iter(self._accelerators.items()))
+
+    @property
+    def is_tpu(self) -> bool:
+        acc = self.sole_accelerator()
+        return acc is not None and acc_lib.is_tpu(acc[0])
+
+    @property
+    def tpu_gen(self) -> Optional[acc_lib.TpuGen]:
+        acc = self.sole_accelerator()
+        if acc is None or not acc_lib.is_tpu(acc[0]):
+            return None
+        return acc_lib.tpu_gen(acc[0])
+
+    @property
+    def tpu_num_chips(self) -> Optional[int]:
+        acc = self.sole_accelerator()
+        if acc is None or not acc_lib.is_tpu(acc[0]):
+            return None
+        return int(acc[1])
+
+    @property
+    def tpu_slice_type(self) -> Optional[str]:
+        """GCP acceleratorType string, e.g. 'v5p-16' for tpu-v5p:8."""
+        gen = self.tpu_gen
+        if gen is None:
+            return None
+        return gen.slice_type(self.tpu_num_chips)
+
+    @property
+    def num_hosts_per_node(self) -> int:
+        """Host VMs backing one logical node (== one TPU slice).
+
+        1 for GPUs/CPU nodes and single-host TPUs; >1 for pod slices.
+        """
+        gen = self.tpu_gen
+        if gen is None:
+            return 1
+        return gen.num_hosts(self.tpu_num_chips)
+
+    # --- launchability ------------------------------------------------------
+
+    def is_launchable(self) -> bool:
+        return self._cloud is not None and (self._instance_type is not None or
+                                            self.is_tpu)
+
+    def assert_launchable(self) -> 'Resources':
+        if not self.is_launchable():
+            raise exceptions.InvalidResourcesError(
+                f'Resources not launchable (optimizer not run?): {self}')
+        return self
+
+    # --- copy / serialization ----------------------------------------------
+
+    def copy(self, **override) -> 'Resources':
+        cfg = self.to_yaml_config()
+        internal = {}
+        if '_cluster_config_overrides' in override:
+            internal['_cluster_config_overrides'] = override.pop(
+                '_cluster_config_overrides')
+        cfg.update(override)
+        res = Resources.from_yaml_config(cfg)
+        if internal:
+            res._cluster_config_overrides = internal[
+                '_cluster_config_overrides']
+        return res
+
+    @classmethod
+    def from_yaml_config(cls, config: Optional[Dict[str, Any]]) -> 'Resources':
+        if config is None:
+            return cls()
+        config = dict(config)
+        known = {
+            'infra', 'accelerators', 'cpus', 'memory', 'instance_type',
+            'use_spot', 'disk_size', 'disk_tier', 'ports', 'image_id',
+            'labels', 'autostop', 'job_recovery', 'any_of',
+        }
+        # Back-compat sugar: cloud/region/zone keys fold into infra.
+        if any(k in config for k in ('cloud', 'region', 'zone')):
+            info = infra_utils.InfraInfo(
+                cloud=config.pop('cloud', None),
+                region=config.pop('region', None),
+                zone=config.pop('zone', None))
+            if info.zone and not info.region:
+                raise exceptions.InvalidResourcesError(
+                    'zone requires region to be set')
+            config.setdefault('infra', info.to_str() or None)
+        unknown = set(config) - known
+        if unknown:
+            raise exceptions.InvalidResourcesError(
+                f'Unknown resources fields: {sorted(unknown)}')
+        if 'any_of' in config and config['any_of'] is not None:
+            base = {k: v for k, v in config.items() if k != 'any_of'}
+            return cls(**base, any_of=config['any_of'])
+        return cls(**config)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        cfg: Dict[str, Any] = {}
+        infra = self.infra.to_str()
+        if infra:
+            cfg['infra'] = infra
+        if self._accelerators:
+            cfg['accelerators'] = {
+                k: (int(v) if v == int(v) else v)
+                for k, v in self._accelerators.items()
+            }
+        if self._cpus is not None:
+            cfg['cpus'] = (f'{common_utils.format_float(self._cpus)}+'
+                           if self._cpus_plus
+                           else common_utils.format_float(self._cpus))
+        if self._memory is not None:
+            cfg['memory'] = (f'{common_utils.format_float(self._memory)}+'
+                             if self._memory_plus
+                             else common_utils.format_float(self._memory))
+        if self._instance_type:
+            cfg['instance_type'] = self._instance_type
+        if self._use_spot_specified:
+            cfg['use_spot'] = self._use_spot
+        if self._disk_size != _DEFAULT_DISK_SIZE_GB:
+            cfg['disk_size'] = self._disk_size
+        if self._disk_tier:
+            cfg['disk_tier'] = self._disk_tier
+        if self._ports:
+            cfg['ports'] = list(self._ports)
+        if self._image_id:
+            cfg['image_id'] = self._image_id
+        if self._labels:
+            cfg['labels'] = dict(self._labels)
+        if self._autostop:
+            cfg['autostop'] = self._autostop.to_config()
+        if self._job_recovery:
+            cfg['job_recovery'] = self._job_recovery
+        if self._any_of:
+            cfg['any_of'] = self._any_of
+        return cfg
+
+    def get_candidate_set(self) -> List['Resources']:
+        """Expand any_of / multi-accelerator dict into concrete candidates."""
+        if self._any_of:
+            base = self.to_yaml_config()
+            base.pop('any_of', None)
+            out = []
+            for override in self._any_of:
+                cfg = dict(base)
+                cfg.update(override)
+                out.append(Resources.from_yaml_config(cfg))
+            return out
+        if self._accelerators and len(self._accelerators) > 1:
+            return [self.copy(accelerators={n: c})
+                    for n, c in self._accelerators.items()]
+        return [self]
+
+    # --- matching -----------------------------------------------------------
+
+    def less_demanding_than(self, other: 'Resources') -> bool:
+        """True if `other` (an existing cluster's resources) satisfies us."""
+        if self._cloud is not None and self._cloud != other.cloud:
+            return False
+        if self._region is not None and self._region != other.region:
+            return False
+        if self._zone is not None and self._zone != other.zone:
+            return False
+        if self._accelerators:
+            theirs = other.accelerators or {}
+            for name, count in self._accelerators.items():
+                if theirs.get(name, 0) < count:
+                    return False
+        if self._use_spot_specified and self._use_spot != other.use_spot:
+            return False
+        if (self._instance_type is not None and
+                self._instance_type != other.instance_type):
+            return False
+        return True
+
+    def __repr__(self) -> str:
+        parts = []
+        infra = self.infra.to_str()
+        parts.append(infra if infra else '*')
+        if self._instance_type:
+            parts.append(self._instance_type)
+        if self._accelerators:
+            accs = ', '.join(
+                f'{n}:{common_utils.format_float(c)}'
+                for n, c in self._accelerators.items())
+            parts.append(f'{{{accs}}}')
+        if self._cpus is not None:
+            parts.append(
+                f'cpus={common_utils.format_float(self._cpus)}'
+                f'{"+" if self._cpus_plus else ""}')
+        if self._use_spot:
+            parts.append('[spot]')
+        return 'Resources(' + ' '.join(parts) + ')'

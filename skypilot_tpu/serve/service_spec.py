@@ -1,0 +1,103 @@
+"""ServiceSpec: the `service:` section of a task YAML.
+
+Reference analog: sky/serve/service_spec.py (422 LoC). Round 1 carries the
+schema + validation; the controller/LB consume it in the serve subsystem.
+"""
+import dataclasses
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+
+
+@dataclasses.dataclass
+class ReadinessProbe:
+    path: str = '/'
+    initial_delay_seconds: int = 1200
+    timeout_seconds: int = 15
+    post_data: Optional[Dict[str, Any]] = None
+
+    @classmethod
+    def from_config(cls, cfg) -> 'ReadinessProbe':
+        if isinstance(cfg, str):
+            return cls(path=cfg)
+        if isinstance(cfg, dict):
+            return cls(
+                path=cfg.get('path', '/'),
+                initial_delay_seconds=int(
+                    cfg.get('initial_delay_seconds', 1200)),
+                timeout_seconds=int(cfg.get('timeout_seconds', 15)),
+                post_data=cfg.get('post_data'))
+        raise exceptions.InvalidTaskError(
+            f'Invalid readiness_probe: {cfg!r}')
+
+
+@dataclasses.dataclass
+class ServiceSpec:
+    readiness_probe: ReadinessProbe
+    min_replicas: int = 1
+    max_replicas: Optional[int] = None
+    target_qps_per_replica: Optional[float] = None
+    upscale_delay_seconds: int = 300
+    downscale_delay_seconds: int = 1200
+    replica_port: int = 8080
+    load_balancing_policy: str = 'least_load'
+
+    @classmethod
+    def from_yaml_config(cls, cfg: Dict[str, Any]) -> 'ServiceSpec':
+        if 'readiness_probe' not in cfg:
+            raise exceptions.InvalidTaskError(
+                'service: requires a readiness_probe')
+        rp = ReadinessProbe.from_config(cfg['readiness_probe'])
+        replicas = cfg.get('replicas')
+        policy = cfg.get('replica_policy') or {}
+        min_replicas = int(policy.get('min_replicas',
+                                      replicas if replicas else 1))
+        max_replicas = policy.get('max_replicas')
+        spec = cls(
+            readiness_probe=rp,
+            min_replicas=min_replicas,
+            max_replicas=int(max_replicas) if max_replicas else None,
+            target_qps_per_replica=policy.get('target_qps_per_replica'),
+            upscale_delay_seconds=int(
+                policy.get('upscale_delay_seconds', 300)),
+            downscale_delay_seconds=int(
+                policy.get('downscale_delay_seconds', 1200)),
+            replica_port=int(cfg.get('replica_port', 8080)),
+            load_balancing_policy=cfg.get('load_balancing_policy',
+                                          'least_load'),
+        )
+        if spec.max_replicas is not None and \
+                spec.max_replicas < spec.min_replicas:
+            raise exceptions.InvalidTaskError(
+                'service: max_replicas < min_replicas')
+        if (spec.max_replicas is not None and
+                spec.max_replicas > spec.min_replicas and
+                spec.target_qps_per_replica is None):
+            raise exceptions.InvalidTaskError(
+                'service: autoscaling (max>min) requires '
+                'target_qps_per_replica')
+        return spec
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        cfg: Dict[str, Any] = {
+            'readiness_probe': {
+                'path': self.readiness_probe.path,
+                'initial_delay_seconds':
+                    self.readiness_probe.initial_delay_seconds,
+                'timeout_seconds': self.readiness_probe.timeout_seconds,
+            },
+            'replica_policy': {
+                'min_replicas': self.min_replicas,
+            },
+            'replica_port': self.replica_port,
+            'load_balancing_policy': self.load_balancing_policy,
+        }
+        if self.readiness_probe.post_data is not None:
+            cfg['readiness_probe']['post_data'] = \
+                self.readiness_probe.post_data
+        pol = cfg['replica_policy']
+        if self.max_replicas is not None:
+            pol['max_replicas'] = self.max_replicas
+        if self.target_qps_per_replica is not None:
+            pol['target_qps_per_replica'] = self.target_qps_per_replica
+        return cfg

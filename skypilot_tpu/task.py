@@ -1,0 +1,231 @@
+"""Task: one unit of work — setup + run commands on N nodes with resources.
+
+Reference analog: sky/task.py:236 (`Task`, from_yaml_config :497,
+to_yaml_config :1408). TPU-first difference: `num_nodes` counts *logical*
+nodes where one node == one TPU slice (possibly many host VMs); the
+execution layer fans each node's command out to every host in the slice
+with jax.distributed coordinates injected (see backends/codegen.py).
+"""
+import os
+import re
+from typing import Any, Callable, Dict, List, Optional, Set, Union
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu.utils import common_utils
+
+_VALID_NAME_RE = re.compile(r'^[a-zA-Z0-9]([-_.a-zA-Z0-9]*[a-zA-Z0-9])?$')
+
+CommandOrGen = Union[None, str, Callable[[int, List[str]], Optional[str]]]
+
+_TASK_FIELDS = {
+    'name', 'workdir', 'setup', 'run', 'num_nodes', 'envs', 'secrets',
+    'file_mounts', 'resources', 'service',
+}
+
+
+class Task:
+    """A coarse-grained unit of work: bash `setup` then bash `run`."""
+
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        *,
+        setup: Optional[str] = None,
+        run: CommandOrGen = None,
+        workdir: Optional[str] = None,
+        num_nodes: Optional[int] = None,
+        envs: Optional[Dict[str, str]] = None,
+        secrets: Optional[Dict[str, str]] = None,
+        file_mounts: Optional[Dict[str, str]] = None,
+    ):
+        self.name = name
+        self.setup = setup
+        self.run = run
+        self.workdir = workdir
+        self.num_nodes = num_nodes if num_nodes is not None else 1
+        self._envs = dict(envs) if envs else {}
+        self._secrets = dict(secrets) if secrets else {}
+        self.file_mounts = dict(file_mounts) if file_mounts else None
+        self.storage_mounts: Dict[str, Any] = {}
+        self.service = None  # serve.SchemaSpec, set via set_service
+        self.resources: Set[resources_lib.Resources] = {
+            resources_lib.Resources()
+        }
+        self.best_resources: Optional[resources_lib.Resources] = None
+        # DAG wiring (set by Dag)
+        self.dag = None
+        self._validate()
+
+    def _validate(self) -> None:
+        if self.name is not None and not _VALID_NAME_RE.match(self.name):
+            raise exceptions.InvalidTaskError(f'Invalid task name: '
+                                              f'{self.name!r}')
+        if self.num_nodes < 1:
+            raise exceptions.InvalidTaskError(
+                f'num_nodes must be >= 1, got {self.num_nodes}')
+        if self.run is not None and not (isinstance(self.run, str) or
+                                         callable(self.run)):
+            raise exceptions.InvalidTaskError(
+                f'run must be a string or callable, got '
+                f'{type(self.run).__name__}')
+        for k in self._envs:
+            if not re.match(r'^[A-Za-z_][A-Za-z0-9_]*$', k):
+                raise exceptions.InvalidTaskError(f'Invalid env name: {k!r}')
+        if self.workdir is not None:
+            expanded = common_utils.expand_path(self.workdir)
+            if not os.path.isdir(expanded):
+                raise exceptions.InvalidTaskError(
+                    f'workdir is not a directory: {self.workdir!r}')
+
+    # --- envs / secrets -----------------------------------------------------
+
+    @property
+    def envs(self) -> Dict[str, str]:
+        return dict(self._envs)
+
+    @property
+    def secrets(self) -> Dict[str, str]:
+        return dict(self._secrets)
+
+    @property
+    def envs_and_secrets(self) -> Dict[str, str]:
+        out = dict(self._envs)
+        out.update(self._secrets)
+        return out
+
+    def update_envs(self, envs: Optional[Dict[str, str]]) -> 'Task':
+        for k, v in (envs or {}).items():
+            if v is None:
+                raise exceptions.InvalidTaskError(
+                    f'Env {k!r} requires a value (use --env {k}=VALUE or '
+                    'export it locally).')
+            self._envs[k] = str(v)
+        return self
+
+    def update_secrets(self, secrets: Optional[Dict[str, str]]) -> 'Task':
+        for k, v in (secrets or {}).items():
+            self._secrets[k] = str(v)
+        return self
+
+    # --- resources ----------------------------------------------------------
+
+    def set_resources(
+        self, resources: Union[resources_lib.Resources,
+                               Set[resources_lib.Resources],
+                               List[resources_lib.Resources]]
+    ) -> 'Task':
+        if isinstance(resources, resources_lib.Resources):
+            resources = {resources}
+        self.resources = set(resources)
+        return self
+
+    def set_service(self, service) -> 'Task':
+        self.service = service
+        return self
+
+    # --- YAML ---------------------------------------------------------------
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any],
+                         env_overrides: Optional[Dict[str, str]] = None
+                         ) -> 'Task':
+        if not isinstance(config, dict):
+            raise exceptions.InvalidTaskError(
+                f'Task YAML must be a mapping, got {type(config).__name__}')
+        unknown = set(config) - _TASK_FIELDS
+        if unknown:
+            raise exceptions.InvalidTaskError(
+                f'Unknown task fields: {sorted(unknown)}')
+        envs = dict(config.get('envs') or {})
+        for k, v in (env_overrides or {}).items():
+            envs[k] = v
+        # Env/secret values of None must be overridden at launch time.
+        missing = [k for k, v in envs.items() if v is None]
+        if missing:
+            raise exceptions.InvalidTaskError(
+                f'Env(s) {missing} have no value; pass --env.')
+        secrets = dict(config.get('secrets') or {})
+        missing = [k for k, v in secrets.items() if v is None]
+        if missing:
+            raise exceptions.InvalidTaskError(
+                f'Secret(s) {missing} have no value; pass --secret.')
+        task = cls(
+            name=config.get('name'),
+            setup=config.get('setup'),
+            run=config.get('run'),
+            workdir=config.get('workdir'),
+            num_nodes=config.get('num_nodes'),
+            envs={k: str(v) for k, v in envs.items()},
+            secrets={k: str(v) for k, v in secrets.items()},
+            file_mounts=config.get('file_mounts'),
+        )
+        if 'resources' in config and config['resources'] is not None:
+            res = resources_lib.Resources.from_yaml_config(
+                config['resources'])
+            task.set_resources(res)
+        if 'service' in config and config['service'] is not None:
+            from skypilot_tpu.serve import service_spec
+            task.set_service(
+                service_spec.ServiceSpec.from_yaml_config(config['service']))
+        return task
+
+    @classmethod
+    def from_yaml(cls, path: str,
+                  env_overrides: Optional[Dict[str, str]] = None) -> 'Task':
+        configs = common_utils.read_yaml_all(common_utils.expand_path(path))
+        configs = [c for c in configs if c is not None]
+        if len(configs) != 1:
+            raise exceptions.InvalidTaskError(
+                f'{path}: expected exactly one task document, found '
+                f'{len(configs)} (use Dag.from_yaml for pipelines).')
+        return cls.from_yaml_config(configs[0], env_overrides)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        cfg: Dict[str, Any] = {}
+        if self.name:
+            cfg['name'] = self.name
+        res = list(self.resources)
+        if len(res) == 1:
+            rc = res[0].to_yaml_config()
+            if rc:
+                cfg['resources'] = rc
+        elif len(res) > 1:
+            cfg['resources'] = {
+                'any_of': [r.to_yaml_config() for r in res]
+            }
+        if self.num_nodes != 1:
+            cfg['num_nodes'] = self.num_nodes
+        if self.workdir:
+            cfg['workdir'] = self.workdir
+        if self.setup:
+            cfg['setup'] = self.setup
+        if isinstance(self.run, str):
+            cfg['run'] = self.run
+        if self._envs:
+            cfg['envs'] = dict(self._envs)
+        if self._secrets:
+            cfg['secrets'] = dict(self._secrets)
+        if self.file_mounts:
+            cfg['file_mounts'] = dict(self.file_mounts)
+        if self.service is not None:
+            cfg['service'] = self.service.to_yaml_config()
+        return cfg
+
+    # --- DAG sugar ----------------------------------------------------------
+
+    def __rshift__(self, other: 'Task') -> 'Task':
+        """task_a >> task_b adds an edge in the ambient Dag context."""
+        from skypilot_tpu import dag as dag_lib
+        dag = dag_lib.get_current_dag()
+        if dag is None:
+            raise exceptions.InvalidDagError(
+                'task_a >> task_b requires an active `with Dag():` context.')
+        dag.add_edge(self, other)
+        return other
+
+    def __repr__(self) -> str:
+        name = self.name or '<unnamed>'
+        res = list(self.resources)
+        res_str = repr(res[0]) if len(res) == 1 else f'{len(res)} candidates'
+        return f'Task({name}, num_nodes={self.num_nodes}, {res_str})'

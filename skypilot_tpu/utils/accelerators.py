@@ -1,0 +1,189 @@
+"""Accelerator canonicalization — TPUs are first-class.
+
+The reference special-cases TPUs throughout (sky/resources.py:737 accelerator
+canonicalization, sky/clouds/utils/gcp_utils.py:29 `is_tpu` predicates,
+sky/catalog/gcp_catalog.py TPU branches). Here there is ONE accelerator
+grammar and TPUs flow through the same path as GPUs:
+
+    A100:8            -> 8x A100 GPUs on one node
+    tpu-v5p:8         -> an 8-chip v5p slice (topology auto-selected)
+    tpu-v5p-16        -> GCP slice-type spelling: 16 TensorCores == 8 chips
+    tpu-v6e:256       -> a 256-chip v6e pod slice (multi-host)
+
+For TPUs the framework, not the user, derives: the GCP acceleratorType
+string, the chip<->core conversion, hosts per slice, and the default
+ICI topology. All of that lives in `TpuGen` below.
+"""
+import dataclasses
+import re
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuGen:
+    """Static description of one TPU generation."""
+    name: str                 # canonical: 'tpu-v5p'
+    gcp_prefix: str           # GCP acceleratorType prefix: 'v5p'
+    size_unit: str            # 'cores' (v2-v4, v5p) or 'chips' (v5e, v6e)
+    cores_per_chip: int       # for core-named gens: 2
+    chips_per_host: int       # host VMs per slice = chips / chips_per_host
+    hbm_gb_per_chip: float
+    bf16_tflops_per_chip: float
+    max_chips: int            # largest single-slice size
+    default_runtime_version: str
+
+    def slice_type(self, num_chips: int) -> str:
+        """GCP acceleratorType, e.g. 8 chips of v5p -> 'v5p-16'."""
+        if self.size_unit == 'cores':
+            return f'{self.gcp_prefix}-{num_chips * self.cores_per_chip}'
+        return f'{self.gcp_prefix}-{num_chips}'
+
+    def chips_from_slice_size(self, size: int) -> int:
+        if self.size_unit == 'cores':
+            if size % self.cores_per_chip != 0:
+                raise exceptions.InvalidResourcesError(
+                    f'{self.gcp_prefix}-{size}: size must be a multiple of '
+                    f'{self.cores_per_chip} (cores per chip)')
+            return size // self.cores_per_chip
+        return size
+
+    def num_hosts(self, num_chips: int) -> int:
+        return max(1, -(-num_chips // self.chips_per_host))
+
+    def valid_chip_count(self, num_chips: int) -> bool:
+        """Whether a slice of this many chips exists on GCP.
+
+        Chip-unit gens (v5e/v6e) offer 1/4/8 then powers of two; core-unit
+        gens (v2-v4, v5p) start at 4 chips and grow as 3D-torus multiples
+        of 4.
+        """
+        if num_chips < 1 or num_chips > self.max_chips:
+            return False
+        if self.size_unit == 'chips':
+            return num_chips in (1, 4) or (
+                num_chips % 8 == 0 and (num_chips & (num_chips - 1)) == 0)
+        return num_chips == 4 or (num_chips >= 8 and num_chips % 4 == 0)
+
+
+# Public TPU generation data (cloud.google.com/tpu/docs). v5p/v6e are the
+# flagship targets; older gens kept for catalog completeness.
+TPU_GENERATIONS: Dict[str, TpuGen] = {
+    g.name: g for g in [
+        TpuGen('tpu-v2', 'v2', 'cores', 2, 4, 8.0, 23.0, 256, 'tpu-vm-base'),
+        TpuGen('tpu-v3', 'v3', 'cores', 2, 4, 16.0, 61.0, 1024,
+               'tpu-vm-base'),
+        TpuGen('tpu-v4', 'v4', 'cores', 2, 4, 32.0, 137.5, 4096,
+               'tpu-vm-v4-base'),
+        TpuGen('tpu-v5e', 'v5litepod', 'chips', 1, 8, 16.0, 197.0, 256,
+               'v2-alpha-tpuv5-lite'),
+        TpuGen('tpu-v5p', 'v5p', 'cores', 2, 4, 95.0, 459.0, 8960,
+               'v2-alpha-tpuv5'),
+        TpuGen('tpu-v6e', 'v6e', 'chips', 1, 8, 32.0, 918.0, 256,
+               'v2-alpha-tpuv6e'),
+    ]
+}
+
+_TPU_ALIASES = {
+    'tpu-v5litepod': 'tpu-v5e',
+    'tpu-v5lite': 'tpu-v5e',
+    'tpu-trillium': 'tpu-v6e',
+}
+
+# Canonical GPU names (subset; catalog carries the full per-cloud list).
+_GPU_CANONICAL = [
+    'A100', 'A100-80GB', 'H100', 'H200', 'B200', 'L4', 'T4', 'V100', 'P100',
+    'A10G', 'L40S',
+]
+_GPU_LOWER = {g.lower(): g for g in _GPU_CANONICAL}
+
+_TPU_SLICE_RE = re.compile(r'^(tpu-)?(v\d+[a-z]*|v5litepod)-(\d+)$',
+                           re.IGNORECASE)
+_TPU_GEN_RE = re.compile(r'^(tpu-)?(v\d+[a-z]*|v5litepod|trillium)$',
+                         re.IGNORECASE)
+
+
+def _lookup_gen(gen_token: str) -> Optional[TpuGen]:
+    name = f'tpu-{gen_token.lower()}'
+    name = _TPU_ALIASES.get(name, name)
+    if name == 'tpu-v5litepod':
+        name = 'tpu-v5e'
+    return TPU_GENERATIONS.get(name)
+
+
+def is_tpu(acc_name: Optional[str]) -> bool:
+    return acc_name is not None and acc_name.lower().startswith('tpu-')
+
+
+def canonicalize(name: str, count: float) -> Tuple[str, float]:
+    """Canonicalize an accelerator (name, count) pair.
+
+    TPU slice-type spellings ('tpu-v5p-16', 'v5litepod-8') fold into
+    (generation, chip-count). GPU names are case-corrected. Unknown names
+    pass through unchanged (catalog decides launchability later).
+    """
+    m = _TPU_SLICE_RE.match(name)
+    if m:
+        gen = _lookup_gen(m.group(2))
+        if gen is not None:
+            if count != 1:
+                raise exceptions.InvalidResourcesError(
+                    f'{name}:{count}: slice-type TPU names already encode '
+                    f'size; use {gen.name}:<chips> to request chips.')
+            return gen.name, float(gen.chips_from_slice_size(int(m.group(3))))
+    m = _TPU_GEN_RE.match(name)
+    if m:
+        gen = _lookup_gen(m.group(2))
+        if gen is not None:
+            return gen.name, count
+    return _GPU_LOWER.get(name.lower(), name), count
+
+
+def tpu_gen(acc_name: str) -> TpuGen:
+    gen = TPU_GENERATIONS.get(_TPU_ALIASES.get(acc_name.lower(),
+                                               acc_name.lower()))
+    if gen is None:
+        raise exceptions.AcceleratorNotFoundError(
+            f'Unknown TPU generation: {acc_name!r}. '
+            f'Known: {sorted(TPU_GENERATIONS)}')
+    return gen
+
+
+def parse_accelerator_spec(spec) -> Optional[Dict[str, float]]:
+    """Parse the user-facing `accelerators:` field.
+
+    Accepts 'A100', 'A100:4', 'tpu-v5p:8', 'tpu-v5p-16', {'A100': 4},
+    ['A100:8', 'tpu-v5e:8'] (ordered preference list -> dict).
+    Returns canonicalized {name: count} or None.
+    """
+    if spec is None:
+        return None
+    if isinstance(spec, dict):
+        out: Dict[str, float] = {}
+        for k, v in spec.items():
+            name, count = canonicalize(str(k), float(v))
+            out[name] = count
+        return out
+    if isinstance(spec, str):
+        specs = [spec]
+    elif isinstance(spec, (list, tuple)):
+        specs = [str(s) for s in spec]
+    else:
+        raise exceptions.InvalidResourcesError(
+            f'Invalid accelerators spec: {spec!r}')
+    out = {}
+    for s in specs:
+        s = s.strip()
+        if ':' in s:
+            name, _, count_str = s.partition(':')
+            try:
+                count = float(count_str)
+            except ValueError as e:
+                raise exceptions.InvalidResourcesError(
+                    f'Invalid accelerator count in {s!r}') from e
+        else:
+            name, count = s, 1.0
+        cname, ccount = canonicalize(name.strip(), count)
+        out[cname] = ccount
+    return out

@@ -1,0 +1,119 @@
+"""Small shared helpers: ids, name validation, size parsing, yaml io."""
+import hashlib
+import os
+import re
+import socket
+import uuid
+from typing import Any, Dict, List, Optional, Union
+
+import yaml
+
+from skypilot_tpu import exceptions
+
+_CLUSTER_NAME_RE = re.compile(r'^[a-zA-Z]([-_a-zA-Z0-9]*[a-zA-Z0-9])?$')
+
+_SIZE_UNITS = {
+    '': 1, 'b': 1,
+    'k': 2**10, 'kb': 2**10,
+    'm': 2**20, 'mb': 2**20,
+    'g': 2**30, 'gb': 2**30,
+    't': 2**40, 'tb': 2**40,
+}
+
+
+def get_user_hash() -> str:
+    """Stable per-user hash used in default cluster names and telemetry."""
+    user = os.environ.get('USER', 'unknown')
+    host = socket.gethostname()
+    return hashlib.md5(f'{user}@{host}'.encode()).hexdigest()[:8]
+
+
+def get_usage_run_id() -> str:
+    return str(uuid.uuid4())
+
+
+def check_cluster_name_is_valid(name: str) -> str:
+    if not name or not _CLUSTER_NAME_RE.match(name):
+        raise exceptions.InvalidTaskError(
+            f'Cluster name {name!r} is invalid: must start with a letter and '
+            'contain only letters, digits, "-" and "_".')
+    return name
+
+
+def make_cluster_name_on_cloud(name: str, max_len: int = 35) -> str:
+    """Cloud-safe resource name: lowercase, deduped by hash when truncated."""
+    safe = re.sub(r'[^a-z0-9-]', '-', name.lower())
+    if len(safe) <= max_len:
+        return safe
+    digest = hashlib.md5(name.encode()).hexdigest()[:6]
+    return f'{safe[:max_len - 7]}-{digest}'
+
+
+def parse_memory_size(mem: Union[str, int, float],
+                      field: str = 'memory') -> float:
+    """'16', '16GB', '0.5tb', 16 -> GiB as float. A trailing '+' means
+    at-least and is stripped (caller tracks the plus separately)."""
+    if isinstance(mem, (int, float)):
+        return float(mem)
+    s = str(mem).strip().lower().rstrip('+')
+    m = re.match(r'^([0-9.]+)\s*([a-z]*)$', s)
+    if not m or m.group(2) not in _SIZE_UNITS:
+        raise exceptions.InvalidResourcesError(
+            f'Invalid {field} spec: {mem!r}')
+    bytes_val = float(m.group(1)) * _SIZE_UNITS[m.group(2)]
+    if m.group(2) in ('', 'b') and bytes_val < 2**20:
+        # Bare numbers are GiB by convention ('16' == 16 GiB).
+        return float(m.group(1))
+    return bytes_val / 2**30
+
+
+def parse_count_with_plus(value: Union[str, int, float]) -> tuple:
+    """'8+' -> (8.0, True); 8 -> (8.0, False)."""
+    if isinstance(value, (int, float)):
+        return float(value), False
+    s = str(value).strip()
+    plus = s.endswith('+')
+    return float(s.rstrip('+')), plus
+
+
+def read_yaml(path: str) -> Any:
+    with open(path, 'r', encoding='utf-8') as f:
+        return yaml.safe_load(f)
+
+
+def read_yaml_all(path: str) -> List[Any]:
+    with open(path, 'r', encoding='utf-8') as f:
+        return list(yaml.safe_load_all(f))
+
+
+def dump_yaml(path: str, config: Any) -> None:
+    with open(path, 'w', encoding='utf-8') as f:
+        yaml.safe_dump(config, f, default_flow_style=False, sort_keys=False)
+
+
+def dump_yaml_str(config: Any) -> str:
+    return yaml.safe_dump(config, default_flow_style=False, sort_keys=False)
+
+
+def deterministic_hash(obj: Any) -> str:
+    """Stable hash of a JSON-able structure (cluster-config idempotency)."""
+    canonical = yaml.safe_dump(obj, sort_keys=True)
+    return hashlib.sha256(canonical.encode()).hexdigest()[:16]
+
+
+def format_float(x: Optional[float], precision: int = 2) -> str:
+    if x is None:
+        return '-'
+    if abs(x - round(x)) < 1e-9:
+        return str(int(round(x)))
+    return f'{x:.{precision}f}'
+
+
+def expand_path(path: str) -> str:
+    return os.path.abspath(os.path.expanduser(path))
+
+
+def fill_template(template: str, variables: Dict[str, Any]) -> str:
+    import jinja2  # lazy: keep base import light
+    return jinja2.Template(template,
+                           undefined=jinja2.StrictUndefined).render(**variables)

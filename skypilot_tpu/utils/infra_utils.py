@@ -1,0 +1,52 @@
+"""Parse/format infra strings: 'gcp', 'gcp/us-central2', 'gcp/us-central2/us-central2-b',
+'k8s/my-context', 'local'.
+
+Reference analog: sky/utils/infra_utils.py (195 LoC).
+"""
+import dataclasses
+from typing import Optional
+
+from skypilot_tpu import exceptions
+
+_WILDCARD = '*'
+
+
+@dataclasses.dataclass
+class InfraInfo:
+    cloud: Optional[str] = None
+    region: Optional[str] = None
+    zone: Optional[str] = None
+
+    @classmethod
+    def from_str(cls, infra: Optional[str]) -> 'InfraInfo':
+        if infra is None or infra.strip() in ('', _WILDCARD):
+            return cls()
+        parts = [p.strip() for p in infra.strip().strip('/').split('/')]
+        if any(not p for p in parts):
+            raise exceptions.InvalidInfraError(
+                f'Invalid infra string: {infra!r}')
+        cloud = parts[0].lower()
+        if cloud == _WILDCARD:
+            cloud = None
+        if cloud in ('k8s', 'kubernetes'):
+            # k8s/<context-with-possible-slashes>
+            context = '/'.join(parts[1:]) or None
+            return cls(cloud='kubernetes', region=context)
+        if len(parts) > 3:
+            raise exceptions.InvalidInfraError(
+                f'Invalid infra string (too many parts): {infra!r}')
+        region = parts[1] if len(parts) > 1 and parts[1] != _WILDCARD else None
+        zone = parts[2] if len(parts) > 2 and parts[2] != _WILDCARD else None
+        return cls(cloud=cloud, region=region, zone=zone)
+
+    def to_str(self) -> str:
+        parts = [self.cloud or _WILDCARD]
+        if self.region:
+            parts.append(self.region)
+        if self.zone:
+            parts.append(self.zone)
+        s = '/'.join(parts)
+        return '' if s == _WILDCARD else s
+
+    def __bool__(self) -> bool:
+        return any([self.cloud, self.region, self.zone])

@@ -1,0 +1,57 @@
+"""Table/duration formatting helpers for CLI output."""
+import time
+from typing import List, Optional
+
+
+def print_table(headers: List[str], rows: List[List[str]],
+                title: Optional[str] = None) -> None:
+    try:
+        import rich.console
+        import rich.table
+        table = rich.table.Table(title=title, box=None,
+                                 header_style='bold')
+        for h in headers:
+            table.add_column(h)
+        for row in rows:
+            table.add_row(*[str(c) for c in row])
+        rich.console.Console().print(table)
+    except ImportError:  # pragma: no cover
+        widths = [max(len(str(h)), *(len(str(r[i])) for r in rows))
+                  if rows else len(str(h)) for i, h in enumerate(headers)]
+        if title:
+            print(title)
+        print('  '.join(h.ljust(w) for h, w in zip(headers, widths)))
+        for row in rows:
+            print('  '.join(str(c).ljust(w) for c, w in zip(row, widths)))
+
+
+def format_table(headers: List[str], rows: List[List[str]]) -> str:
+    if not rows:
+        widths = [len(h) for h in headers]
+    else:
+        widths = [max(len(str(h)), *(len(str(r[i])) for r in rows))
+                  for i, h in enumerate(headers)]
+    lines = ['  '.join(h.ljust(w) for h, w in zip(headers, widths))]
+    for row in rows:
+        lines.append('  '.join(str(c).ljust(w) for c, w in zip(row, widths)))
+    return '\n'.join(lines)
+
+
+def readable_time_duration(start: Optional[float],
+                           end: Optional[float] = None,
+                           absolute: bool = False) -> str:
+    if start is None:
+        return '-'
+    if end is None:
+        end = time.time()
+    secs = max(0, int(end - start))
+    if secs < 60:
+        return f'{secs}s'
+    mins, secs = divmod(secs, 60)
+    if mins < 60:
+        return f'{mins}m {secs}s' if absolute else f'{mins}m'
+    hours, mins = divmod(mins, 60)
+    if hours < 24:
+        return f'{hours}h {mins}m'
+    days, hours = divmod(hours, 24)
+    return f'{days}d {hours}h'

@@ -1,0 +1,57 @@
+"""Name → class registries for clouds and backends.
+
+Same role as the reference registry (sky/utils/registry.py:16) but with a
+plain-dict implementation and alias support.
+"""
+from typing import Callable, Dict, Generic, List, Optional, Type, TypeVar
+
+T = TypeVar('T')
+
+
+class Registry(Generic[T]):
+    """Case-insensitive name → instance/class registry with aliases."""
+
+    def __init__(self, registry_name: str):
+        self._registry_name = registry_name
+        self._entries: Dict[str, T] = {}
+        self._aliases: Dict[str, str] = {}
+
+    def register(self, name: Optional[str] = None,
+                 aliases: Optional[List[str]] = None) -> Callable[[Type], Type]:
+        def decorator(cls: Type) -> Type:
+            key = (name or cls.__name__).lower()
+            if key in self._entries:
+                raise ValueError(
+                    f'{self._registry_name} {key!r} already registered')
+            self._entries[key] = cls
+            for alias in aliases or []:
+                self._aliases[alias.lower()] = key
+            return cls
+        return decorator
+
+    def canonical_name(self, name: str) -> str:
+        key = name.lower()
+        return self._aliases.get(key, key)
+
+    def get(self, name: str) -> T:
+        key = self.canonical_name(name)
+        if key not in self._entries:
+            raise ValueError(
+                f'Unknown {self._registry_name}: {name!r}. '
+                f'Available: {sorted(self._entries)}')
+        return self._entries[key]
+
+    def try_get(self, name: str) -> Optional[T]:
+        return self._entries.get(self.canonical_name(name))
+
+    def names(self) -> List[str]:
+        return sorted(self._entries)
+
+    def __contains__(self, name: str) -> bool:
+        return self.canonical_name(name) in self._entries
+
+
+# Instantiated registries. Clouds register at import of skypilot_tpu.clouds;
+# backends at import of skypilot_tpu.backends.
+CLOUD_REGISTRY: Registry = Registry('cloud')
+BACKEND_REGISTRY: Registry = Registry('backend')

@@ -1,0 +1,47 @@
+"""Test env: force JAX onto a virtual 8-device CPU mesh, isolate state dirs.
+
+Mirrors the reference's zero-credential strategy
+(tests/common_test_fixtures.py:191 `enable_all_clouds`): unit tests run the
+real code paths against the local cloud and mocked GCP REST, never a real
+cloud.
+"""
+import os
+
+# Must be set before any jax import anywhere in the test session.
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+xla_flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in xla_flags:
+    os.environ['XLA_FLAGS'] = (
+        xla_flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def isolated_state(tmp_path, monkeypatch):
+    """Point all on-disk state (~/.skytpu) at a per-test tmp dir."""
+    home = tmp_path / 'home'
+    home.mkdir()
+    monkeypatch.setenv('HOME', str(home))
+    monkeypatch.setenv('SKYTPU_STATE_DIR', str(home / '.skytpu'))
+    # Drop caches that may hold paths from a previous HOME.
+    from skypilot_tpu import config as config_lib
+    config_lib.reload()
+    try:
+        from skypilot_tpu import state as state_lib
+        state_lib.reset_for_tests()
+    except ImportError:
+        pass
+    yield
+
+
+@pytest.fixture
+def enable_clouds(monkeypatch):
+    """Enable a fixed set of clouds without probing credentials."""
+    def _enable(*names):
+        from skypilot_tpu import check as check_lib
+        monkeypatch.setattr(
+            check_lib, 'get_cached_enabled_clouds_or_refresh',
+            lambda raise_if_no_cloud_access=False: sorted(names))
+        return sorted(names)
+    return _enable

@@ -1,0 +1,124 @@
+"""Catalog + optimizer tests — the reference's optimizer dryruns analog
+(tests/test_optimizer_dryruns.py) with a TPU-first catalog."""
+import pytest
+
+from skypilot_tpu import Dag, Resources, Task, catalog, exceptions
+from skypilot_tpu.optimizer import Optimizer
+
+
+class TestCatalog:
+
+    def test_tpu_feasible_synthesized(self):
+        rows = catalog.get_feasible('gcp', Resources(
+            accelerators='tpu-v5p:8'))
+        assert rows
+        row = rows[0]
+        assert row.instance_type == 'tpu-v5p-16'
+        assert row.accelerator_count == 8
+        assert row.price == pytest.approx(8 * 4.2)
+        # cheapest-first ordering
+        assert rows == sorted(rows, key=lambda r: r.price)
+
+    def test_tpu_region_filter(self):
+        rows = catalog.get_feasible(
+            'gcp', Resources(infra='gcp/europe-west4',
+                             accelerators='tpu-v5e:8'))
+        assert rows and all(r.region == 'europe-west4' for r in rows)
+
+    def test_gpu_feasible(self):
+        rows = catalog.get_feasible('gcp', Resources(accelerators='A100:8'))
+        assert rows and all(r.accelerator_count >= 8 for r in rows)
+
+    def test_cpu_request_excludes_gpu_nodes(self):
+        rows = catalog.get_feasible('gcp', Resources(cpus='8+'))
+        assert rows and all(r.accelerator_name is None for r in rows)
+        assert all(r.cpus >= 8 for r in rows)
+
+    def test_spot_requires_spot_price(self):
+        rows = catalog.get_feasible(
+            'gcp', Resources(accelerators='tpu-v5e:4', use_spot=True))
+        assert rows and all(r.spot_price is not None for r in rows)
+
+    def test_list_accelerators_includes_tpus(self):
+        accs = catalog.list_accelerators('tpu')
+        assert 'tpu-v5p' in accs and 'tpu-v6e' in accs
+
+    def test_local_cloud_free(self):
+        rows = catalog.get_feasible('local', Resources())
+        assert len(rows) == 1 and rows[0].price == 0.0
+
+
+class TestOptimizer:
+
+    def test_picks_cheapest_tpu_zone(self, enable_clouds):
+        enable_clouds('gcp')
+        with Dag() as dag:
+            t = Task('t', run='true')
+            t.set_resources(Resources(accelerators='tpu-v5p:8'))
+            dag.add(t)
+        Optimizer.optimize(dag, quiet=True)
+        best = t.best_resources
+        assert best.is_launchable()
+        assert best.cloud == 'gcp'
+        # us-east5 / us-central1 at $4.2/chip beat europe/asia.
+        assert best.region in ('us-east5', 'us-central1')
+        assert best.instance_type == 'tpu-v5p-16'
+
+    def test_spot_cheaper_than_on_demand(self, enable_clouds):
+        enable_clouds('gcp')
+
+        def best_cost(use_spot):
+            with Dag() as dag:
+                t = Task('t', run='true')
+                t.set_resources(Resources(accelerators='tpu-v5e:8',
+                                          use_spot=use_spot))
+                dag.add(t)
+            Optimizer.optimize(dag, quiet=True)
+            return t.best_resources._hourly_cost
+
+        assert best_cost(True) < best_cost(False)
+
+    def test_unsatisfiable_raises(self, enable_clouds):
+        enable_clouds('gcp')
+        with Dag() as dag:
+            t = Task('t', run='true')
+            t.set_resources(Resources(infra='gcp/nowhere',
+                                      accelerators='tpu-v5p:8'))
+            dag.add(t)
+        with pytest.raises(exceptions.ResourcesUnavailableError):
+            Optimizer.optimize(dag, quiet=True)
+
+    def test_any_of_picks_cheapest_candidate(self, enable_clouds):
+        enable_clouds('gcp')
+        with Dag() as dag:
+            t = Task('t', run='true')
+            t.set_resources(Resources.from_yaml_config({'any_of': [
+                {'infra': 'gcp', 'accelerators': 'H100:8'},
+                {'infra': 'gcp', 'accelerators': 'tpu-v5e:8'},
+            ]}))
+            dag.add(t)
+        Optimizer.optimize(dag, quiet=True)
+        # 8 v5e chips @1.2 = $9.6/hr beats a3-highgpu-8g @ $88.
+        assert t.best_resources.is_tpu
+
+    def test_blocked_resources_failover(self, enable_clouds):
+        enable_clouds('gcp')
+        with Dag() as dag:
+            t = Task('t', run='true')
+            t.set_resources(Resources(accelerators='tpu-v5p:8'))
+            dag.add(t)
+        Optimizer.optimize(dag, quiet=True)
+        first = t.best_resources
+        blocked = Resources(
+            infra=f'gcp/{first.region}', accelerators='tpu-v5p:8')
+        Optimizer.optimize(dag, blocked_resources=[blocked], quiet=True)
+        assert t.best_resources.region != first.region
+
+    def test_local_cloud_end_to_end(self, enable_clouds):
+        enable_clouds('local')
+        with Dag() as dag:
+            t = Task('t', run='true')
+            dag.add(t)
+        Optimizer.optimize(dag, quiet=True)
+        assert t.best_resources.cloud == 'local'
+        assert t.best_resources.instance_type == 'localhost'

@@ -1,0 +1,125 @@
+"""Resources + accelerator canonicalization tests.
+
+Covers the TPU-first grammar: generation:chips, slice-type folding,
+topology/host derivation (reference parity: sky/resources.py:737,
+sky/clouds/utils/gcp_utils.py:29-49).
+"""
+import pytest
+
+from skypilot_tpu import Resources, exceptions
+from skypilot_tpu.utils import accelerators as acc_lib
+
+
+class TestAcceleratorCanonicalization:
+
+    def test_gpu_case_insensitive(self):
+        assert acc_lib.canonicalize('a100', 1) == ('A100', 1)
+        assert acc_lib.canonicalize('h100', 8) == ('H100', 8)
+        assert acc_lib.canonicalize('A100-80gb', 4) == ('A100-80GB', 4)
+
+    def test_tpu_generation_colon_chips(self):
+        r = Resources(accelerators='tpu-v5p:8')
+        assert r.accelerators == {'tpu-v5p': 8}
+        assert r.is_tpu
+        assert r.tpu_num_chips == 8
+        assert r.tpu_slice_type == 'v5p-16'  # 8 chips == 16 cores
+        assert r.num_hosts_per_node == 2     # 4 chips per host
+
+    def test_tpu_slice_type_folds_to_chips(self):
+        r = Resources(accelerators='tpu-v4-8')
+        assert r.accelerators == {'tpu-v4': 4}  # 8 cores == 4 chips
+        r = Resources(accelerators='v5litepod-8')
+        assert r.accelerators == {'tpu-v5e': 8}
+
+    def test_tpu_aliases(self):
+        r = Resources(accelerators='tpu-trillium:16')
+        assert r.accelerators == {'tpu-v6e': 16}
+
+    def test_tpu_chips_unit_generations(self):
+        r = Resources(accelerators='tpu-v6e:256')
+        assert r.tpu_slice_type == 'v6e-256'
+        assert r.num_hosts_per_node == 32  # 8 chips per v6e host
+
+    def test_slice_name_with_count_rejected(self):
+        with pytest.raises(exceptions.InvalidResourcesError):
+            Resources(accelerators='tpu-v5p-16:2')
+
+    def test_oversize_slice_rejected(self):
+        with pytest.raises(exceptions.InvalidResourcesError):
+            Resources(accelerators='tpu-v6e:10000')
+
+    def test_dict_and_list_forms(self):
+        r = Resources(accelerators={'tpu-v5e': 8})
+        assert r.accelerators == {'tpu-v5e': 8}
+        r = Resources(accelerators=['A100:8', 'tpu-v5e:8'])
+        assert r.accelerators == {'A100': 8, 'tpu-v5e': 8}
+        assert len(r.get_candidate_set()) == 2
+
+
+class TestResources:
+
+    def test_infra_parsing(self):
+        r = Resources(infra='gcp/us-central1/us-central1-a')
+        assert (r.cloud, r.region, r.zone) == \
+            ('gcp', 'us-central1', 'us-central1-a')
+        r = Resources(infra='gcp')
+        assert r.cloud == 'gcp' and r.region is None
+
+    def test_k8s_infra_context(self):
+        r = Resources(infra='k8s/my/context')
+        assert r.cloud == 'kubernetes'
+        assert r.region == 'my/context'
+
+    def test_cpus_plus(self):
+        r = Resources(cpus='8+')
+        assert r.cpus == 8
+
+    def test_memory_units(self):
+        assert Resources(memory='16').memory == 16
+        assert Resources(memory='32GB').memory == 32
+        assert Resources(memory=64).memory == 64
+
+    def test_yaml_roundtrip(self):
+        r = Resources(infra='gcp/us-east5', accelerators='tpu-v5p:8',
+                      use_spot=True, disk_size=512,
+                      labels={'team': 'ml'}, ports=[8080, '9000-9010'])
+        cfg = r.to_yaml_config()
+        r2 = Resources.from_yaml_config(cfg)
+        assert r2.to_yaml_config() == cfg
+        assert r2.accelerators == {'tpu-v5p': 8}
+        assert r2.use_spot
+        assert r2.ports == ['8080', '9000-9010']
+
+    def test_autostop_forms(self):
+        assert Resources(autostop=10).autostop.idle_minutes == 10
+        assert Resources(autostop=True).autostop.enabled
+        r = Resources(autostop={'idle_minutes': 3, 'down': True})
+        assert r.autostop.down
+
+    def test_less_demanding_than(self):
+        want = Resources(accelerators='tpu-v5e:4')
+        have = Resources(infra='gcp/us-central1', accelerators='tpu-v5e:8')
+        assert want.less_demanding_than(have)
+        assert not Resources(accelerators='tpu-v5p:4').less_demanding_than(
+            have)
+
+    def test_launchable_requires_cloud(self):
+        assert not Resources(accelerators='A100:8').is_launchable()
+        with pytest.raises(exceptions.InvalidResourcesError):
+            Resources(accelerators='A100:8').assert_launchable()
+        assert Resources(infra='gcp', accelerators='tpu-v5e:8',
+                         ).is_launchable()
+
+    def test_zone_requires_region(self):
+        with pytest.raises(exceptions.InvalidResourcesError):
+            Resources.from_yaml_config(
+                {'cloud': 'gcp', 'zone': 'us-central1-a'})
+
+    def test_any_of_expansion(self):
+        r = Resources.from_yaml_config({
+            'any_of': [{'infra': 'gcp', 'accelerators': 'tpu-v5e:8'},
+                       {'infra': 'gcp', 'accelerators': 'A100:8'}]
+        })
+        cands = r.get_candidate_set()
+        assert len(cands) == 2
+        assert cands[0].is_tpu and not cands[1].is_tpu

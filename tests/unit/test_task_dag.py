@@ -1,0 +1,100 @@
+"""Task YAML + Dag tests (reference parity: sky/task.py:497, sky/dag.py)."""
+import textwrap
+
+import pytest
+
+from skypilot_tpu import Dag, Resources, Task, exceptions
+
+
+class TestTask:
+
+    def test_from_yaml_config(self):
+        task = Task.from_yaml_config({
+            'name': 'train',
+            'resources': {'accelerators': 'tpu-v5p:8'},
+            'num_nodes': 2,
+            'setup': 'pip install -e .',
+            'run': 'python train.py',
+            'envs': {'MODEL': 'llama3-8b'},
+        })
+        assert task.name == 'train'
+        assert task.num_nodes == 2
+        res = next(iter(task.resources))
+        assert res.accelerators == {'tpu-v5p': 8}
+        assert task.envs == {'MODEL': 'llama3-8b'}
+
+    def test_unknown_field_rejected(self):
+        with pytest.raises(exceptions.InvalidTaskError):
+            Task.from_yaml_config({'run': 'true', 'nodes': 2})
+
+    def test_none_env_requires_override(self):
+        with pytest.raises(exceptions.InvalidTaskError):
+            Task.from_yaml_config({'run': 'x', 'envs': {'HF_TOKEN': None}})
+        task = Task.from_yaml_config({'run': 'x',
+                                      'envs': {'HF_TOKEN': None}},
+                                     env_overrides={'HF_TOKEN': 'abc'})
+        assert task.envs['HF_TOKEN'] == 'abc'
+
+    def test_yaml_roundtrip(self, tmp_path):
+        yaml_text = textwrap.dedent("""\
+            name: serve
+            resources:
+              infra: gcp
+              accelerators: tpu-v5e:8
+            run: |
+              python serve.py
+        """)
+        p = tmp_path / 'task.yaml'
+        p.write_text(yaml_text)
+        task = Task.from_yaml(str(p))
+        cfg = task.to_yaml_config()
+        task2 = Task.from_yaml_config(cfg)
+        assert task2.to_yaml_config() == cfg
+
+    def test_secrets_separate_from_envs(self):
+        t = Task(run='x', envs={'A': '1'}, secrets={'S': 'hush'})
+        assert t.envs == {'A': '1'}
+        assert t.envs_and_secrets == {'A': '1', 'S': 'hush'}
+
+    def test_invalid_num_nodes(self):
+        with pytest.raises(exceptions.InvalidTaskError):
+            Task(run='x', num_nodes=0)
+
+
+class TestDag:
+
+    def test_chain_detection(self):
+        with Dag('pipe') as dag:
+            a = Task('a', run='true')
+            b = Task('b', run='true')
+            c = Task('c', run='true')
+            a >> b >> c
+        assert len(dag) == 3
+        assert dag.is_chain()
+        assert [t.name for t in dag.topological_order()] == ['a', 'b', 'c']
+
+    def test_cycle_detected(self):
+        with Dag() as dag:
+            a = Task('a', run='true')
+            b = Task('b', run='true')
+            a >> b
+            b >> a
+        with pytest.raises(exceptions.InvalidDagError):
+            dag.validate()
+
+    def test_diamond_not_chain(self):
+        with Dag() as dag:
+            a, b, c, d = (Task(n, run='true') for n in 'abcd')
+            a >> b
+            a >> c
+            b >> d
+            c >> d
+        assert not dag.is_chain()
+        order = dag.topological_order()
+        assert order[0].name == 'a' and order[-1].name == 'd'
+
+    def test_rshift_outside_context_fails(self):
+        a = Task('a', run='true')
+        b = Task('b', run='true')
+        with pytest.raises(exceptions.InvalidDagError):
+            a >> b
