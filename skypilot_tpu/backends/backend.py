@@ -1,0 +1,60 @@
+"""Backend interface: provision/sync/setup/execute/teardown lifecycle.
+
+Reference analog: sky/backends/backend.py:30 (`Backend`, `ResourceHandle`
+:24).
+"""
+from typing import Any, Dict, Generic, Optional, TypeVar
+
+from skypilot_tpu import resources as resources_lib
+
+
+class ResourceHandle:
+    """Opaque, picklable identity of a provisioned cluster."""
+
+    def get_cluster_name(self) -> str:
+        raise NotImplementedError
+
+
+H = TypeVar('H', bound=ResourceHandle)
+
+
+class Backend(Generic[H]):
+    NAME = 'backend'
+
+    # --- lifecycle ----------------------------------------------------------
+
+    def provision(self, task, to_provision: Optional[
+            resources_lib.Resources], *, dryrun: bool = False,
+            stream_logs: bool = True, cluster_name: str,
+            retry_until_up: bool = False) -> Optional[H]:
+        raise NotImplementedError
+
+    def sync_workdir(self, handle: H, workdir: str) -> None:
+        raise NotImplementedError
+
+    def sync_file_mounts(self, handle: H,
+                         file_mounts: Optional[Dict[str, str]],
+                         storage_mounts: Optional[Dict[str, Any]]) -> None:
+        raise NotImplementedError
+
+    def execute(self, handle: H, task, *, detach_run: bool = False,
+                dryrun: bool = False) -> Optional[int]:
+        """Submit the task as a job; returns job id."""
+        raise NotImplementedError
+
+    def teardown(self, handle: H, *, terminate: bool,
+                 purge: bool = False) -> None:
+        raise NotImplementedError
+
+    # --- job control --------------------------------------------------------
+
+    def tail_logs(self, handle: H, job_id: Optional[int], *,
+                  follow: bool = True, tail: int = 0) -> int:
+        raise NotImplementedError
+
+    def cancel_jobs(self, handle: H, job_ids=None,
+                    cancel_all: bool = False):
+        raise NotImplementedError
+
+    def get_job_queue(self, handle: H):
+        raise NotImplementedError

@@ -1,0 +1,146 @@
+"""Core API: status / start / stop / down / queue / cancel / logs /
+autostop / cost-report.
+
+Reference analog: sky/core.py (status :91, start :407, down :482,
+stop :517, autostop :577, cancel :742).
+"""
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import state
+from skypilot_tpu.backends import gang_backend
+
+
+def _backend() -> gang_backend.GangBackend:
+    return gang_backend.GangBackend()
+
+
+def _get_handle(cluster_name: str, *,
+                require_up: bool = False) -> gang_backend.ClusterHandle:
+    record = state.get_cluster_from_name(cluster_name)
+    if record is None or record['handle'] is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist.')
+    if require_up and record['status'] != state.ClusterStatus.UP:
+        raise exceptions.ClusterNotUpError(
+            f'Cluster {cluster_name!r} is {record["status"].value}.',
+            cluster_status=record['status'])
+    return record['handle']
+
+
+def status(cluster_names: Optional[List[str]] = None,
+           refresh: bool = False) -> List[Dict[str, Any]]:
+    """Cluster records; with refresh=True, reconcile against the cloud
+    (reference refresh_cluster_record backend_utils.py:2145)."""
+    records = state.get_clusters()
+    if cluster_names:
+        names = set(cluster_names)
+        records = [r for r in records if r['name'] in names]
+        missing = names - {r['name'] for r in records}
+        if missing:
+            raise exceptions.ClusterDoesNotExist(
+                f'Cluster(s) not found: {sorted(missing)}')
+    if refresh:
+        backend = _backend()
+        for r in records:
+            handle = r['handle']
+            if handle is None:
+                continue
+            try:
+                live = backend.query_status(handle)
+            except Exception:  # noqa: BLE001 — cloud probe failure
+                continue
+            if live is None:
+                # Gone from the cloud: drop the record.
+                state.remove_cluster(r['name'], terminate=True)
+                r['status'] = None
+            elif live != r['status']:
+                state.update_cluster_status(r['name'], live)
+                r['status'] = live
+        records = [r for r in records if r['status'] is not None]
+    return records
+
+
+def start(cluster_name: str, idle_minutes_to_autostop: Optional[int] = None,
+          down: bool = False) -> None:
+    record = state.get_cluster_from_name(cluster_name)
+    if record is None or record['handle'] is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist.')
+    handle = record['handle']
+    backend = _backend()
+
+    class _Shim:
+        num_nodes = handle.num_nodes
+        name = cluster_name
+
+    backend.provision(_Shim(), None, cluster_name=cluster_name)
+    if idle_minutes_to_autostop is not None:
+        backend.set_autostop(handle, idle_minutes_to_autostop, down)
+
+
+def stop(cluster_name: str) -> None:
+    handle = _get_handle(cluster_name)
+    _backend().teardown(handle, terminate=False)
+
+
+def down(cluster_name: str, purge: bool = False) -> None:
+    handle = _get_handle(cluster_name)
+    _backend().teardown(handle, terminate=True, purge=purge)
+
+
+def autostop(cluster_name: str, idle_minutes: Optional[int],
+             down_after: bool = False) -> None:
+    handle = _get_handle(cluster_name, require_up=True)
+    if idle_minutes is not None and idle_minutes < 0:
+        idle_minutes = None  # negative == cancel, CLI sugar
+    _backend().set_autostop(handle, idle_minutes, down_after)
+
+
+def queue(cluster_name: str) -> List[Dict[str, Any]]:
+    handle = _get_handle(cluster_name, require_up=True)
+    return _backend().get_job_queue(handle)
+
+
+def cancel(cluster_name: str, job_ids: Optional[List[int]] = None,
+           all_jobs: bool = False) -> List[int]:
+    handle = _get_handle(cluster_name, require_up=True)
+    if not job_ids and not all_jobs:
+        raise ValueError('Specify job_ids or all_jobs=True.')
+    return _backend().cancel_jobs(handle, job_ids, cancel_all=all_jobs)
+
+
+def tail_logs(cluster_name: str, job_id: Optional[int] = None,
+              follow: bool = True, tail: int = 0) -> int:
+    handle = _get_handle(cluster_name, require_up=True)
+    return _backend().tail_logs(handle, job_id, follow=follow, tail=tail)
+
+
+def cost_report() -> List[Dict[str, Any]]:
+    """Per-cluster cost so far (live clusters + history)."""
+    out = []
+    now = time.time()
+    for r in state.get_clusters():
+        handle = r['handle']
+        hourly = 0.0
+        if handle is not None:
+            hourly = getattr(handle.launched_resources, '_hourly_cost', 0.0)
+            hourly *= handle.num_nodes
+        duration = now - (r['launched_at'] or now)
+        out.append({
+            'name': r['name'],
+            'status': r['status'],
+            'duration_s': duration,
+            'hourly_cost': hourly,
+            'total_cost': hourly * duration / 3600.0,
+        })
+    for h in state.get_cluster_history():
+        out.append({
+            'name': h['name'] + ' (terminated)',
+            'status': None,
+            'duration_s': h['duration_s'],
+            'hourly_cost': None,
+            'total_cost': None,
+        })
+    return out

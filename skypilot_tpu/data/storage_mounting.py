@@ -1,0 +1,47 @@
+"""Storage mount execution on cluster hosts (gcsfuse first).
+
+Reference analog: sky/data/mounting_utils.py:41-130. Round 1: gcsfuse
+MOUNT + COPY-mode fetch; S3 via gsutil-interop later.
+"""
+import shlex
+from typing import Dict, List
+
+from skypilot_tpu import exceptions
+
+_GCSFUSE_INSTALL = (
+    'command -v gcsfuse >/dev/null 2>&1 || '
+    '(curl -fsSL https://github.com/GoogleCloudPlatform/gcsfuse/releases/'
+    'download/v2.4.0/gcsfuse_2.4.0_amd64.deb -o /tmp/gcsfuse.deb && '
+    'sudo dpkg -i /tmp/gcsfuse.deb)')
+
+
+def mount_cmd(store_type: str, bucket: str, mount_path: str,
+              mode: str = 'MOUNT') -> str:
+    q_path = shlex.quote(mount_path)
+    q_bucket = shlex.quote(bucket)
+    if mode == 'COPY':
+        if store_type == 'gcs':
+            return (f'mkdir -p {q_path} && '
+                    f'gsutil -m rsync -r gs://{q_bucket} {q_path}')
+        if store_type == 's3':
+            return (f'mkdir -p {q_path} && '
+                    f'aws s3 sync s3://{q_bucket} {q_path}')
+        raise exceptions.StorageError(f'COPY: unsupported store '
+                                      f'{store_type}')
+    if store_type == 'gcs':
+        return (f'{_GCSFUSE_INSTALL} && mkdir -p {q_path} && '
+                f'mountpoint -q {q_path} || '
+                f'gcsfuse --implicit-dirs {q_bucket} {q_path}')
+    raise exceptions.StorageError(f'MOUNT: unsupported store {store_type}')
+
+
+def mount_all(runners: List, storage_mounts: Dict[str, Dict]) -> None:
+    for mount_path, spec in storage_mounts.items():
+        cmd = mount_cmd(spec.get('store', 'gcs'), spec['bucket'],
+                        mount_path, spec.get('mode', 'MOUNT'))
+        for runner in runners:
+            rc, out, err = runner.run(cmd, require_outputs=True)
+            if rc != 0:
+                raise exceptions.StorageError(
+                    f'Failed mounting {spec["bucket"]} at {mount_path}: '
+                    f'{err or out}')

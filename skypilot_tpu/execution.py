@@ -1,0 +1,123 @@
+"""Execution driver: the launch/exec stage pipeline.
+
+Reference analog: sky/execution.py:99 (`_execute`), :217 (`_execute_dag`),
+Stage enum :35. Cloud-level failover lives here: when the backend
+exhausts every zone of the chosen cloud, we re-optimize with the failed
+resources blocked and try the next-best placement (reference
+provision_with_retries drives this inside the backend; ours splits it so
+the optimizer stays the single source of placement truth).
+"""
+import enum
+from typing import List, Optional, Tuple
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu import state
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.backends import gang_backend
+
+_MAX_CLOUD_FAILOVERS = 8
+
+
+class Stage(enum.Enum):
+    OPTIMIZE = 'optimize'
+    PROVISION = 'provision'
+    SYNC_WORKDIR = 'sync_workdir'
+    SYNC_FILE_MOUNTS = 'sync_file_mounts'
+    EXEC = 'exec'
+    DOWN = 'down'
+
+
+def _as_dag(task_or_dag) -> dag_lib.Dag:
+    if isinstance(task_or_dag, dag_lib.Dag):
+        return task_or_dag
+    dag = dag_lib.Dag()
+    dag.add(task_or_dag)
+    return dag
+
+
+def launch(task_or_dag, *, cluster_name: str,
+           dryrun: bool = False, stream_logs: bool = True,
+           detach_run: bool = False, optimize_target=None,
+           no_setup: bool = False,
+           backend: Optional[gang_backend.GangBackend] = None
+           ) -> Tuple[Optional[int], Optional[gang_backend.ClusterHandle]]:
+    """Provision (if needed) + sync + run. Returns (job_id, handle)."""
+    dag = _as_dag(task_or_dag)
+    if len(dag.tasks) != 1:
+        raise exceptions.InvalidDagError(
+            'launch() takes a single task; use managed jobs for pipelines.')
+    task = dag.tasks[0]
+    backend = backend or gang_backend.GangBackend()
+    optimize_target = optimize_target or optimizer_lib.OptimizeTarget.COST
+
+    existing = state.get_cluster_from_name(cluster_name)
+    reuse = (existing is not None and existing['handle'] is not None and
+             existing['status'] == state.ClusterStatus.UP)
+
+    handle = None
+    blocked: List = []
+    for attempt in range(_MAX_CLOUD_FAILOVERS):
+        if reuse:
+            to_provision = None
+        else:
+            optimizer_lib.Optimizer.optimize(
+                dag, minimize=optimize_target, blocked_resources=blocked,
+                quiet=(dryrun or not stream_logs))
+            to_provision = task.best_resources
+        if dryrun:
+            return None, None
+        try:
+            handle = backend.provision(
+                task, to_provision, dryrun=dryrun,
+                stream_logs=stream_logs, cluster_name=cluster_name)
+            break
+        except exceptions.ResourcesUnavailableError as e:
+            if reuse or to_provision is None:
+                raise
+            blocked.append(to_provision)
+            if attempt == _MAX_CLOUD_FAILOVERS - 1:
+                raise exceptions.ResourcesUnavailableError(
+                    f'Exhausted placement candidates for {task}.',
+                    failover_history=e.failover_history) from e
+            continue
+    assert handle is not None
+
+    if task.workdir:
+        backend.sync_workdir(handle, task.workdir)
+    if task.file_mounts or task.storage_mounts:
+        backend.sync_file_mounts(handle, task.file_mounts,
+                                 task.storage_mounts)
+    job_id = None
+    if task.run is not None or task.setup is not None:
+        job_id = backend.execute(handle, task, detach_run=detach_run,
+                                 include_setup=not no_setup)
+    return job_id, handle
+
+
+def exec_cmd(task_or_dag, *, cluster_name: str, dryrun: bool = False,
+             detach_run: bool = False,
+             backend: Optional[gang_backend.GangBackend] = None
+             ) -> Tuple[Optional[int], Optional[gang_backend.ClusterHandle]]:
+    """Run on an existing UP cluster; skips provision/sync/setup
+    (reference sky/execution.py:663)."""
+    dag = _as_dag(task_or_dag)
+    task = dag.tasks[0]
+    backend = backend or gang_backend.GangBackend()
+    record = state.get_cluster_from_name(cluster_name)
+    if record is None or record['handle'] is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist; use launch().')
+    if record['status'] != state.ClusterStatus.UP:
+        raise exceptions.ClusterNotUpError(
+            f'Cluster {cluster_name!r} is {record["status"].value}; '
+            'start it first.', cluster_status=record['status'])
+    handle = record['handle']
+    if dryrun:
+        return None, handle
+    if task.workdir:
+        backend.sync_workdir(handle, task.workdir)
+    job_id = backend.execute(handle, task, detach_run=detach_run,
+                             include_setup=False)
+    return job_id, handle

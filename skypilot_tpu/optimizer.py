@@ -15,6 +15,7 @@ from skypilot_tpu import clouds as clouds_lib
 from skypilot_tpu import exceptions
 from skypilot_tpu import resources as resources_lib
 from skypilot_tpu.catalog.common import InstanceTypeInfo
+from skypilot_tpu.utils import accelerators as acc_lib
 
 
 class OptimizeTarget(enum.Enum):
@@ -93,11 +94,22 @@ class Optimizer:
         accelerators = None
         if row.accelerator_name:
             accelerators = {row.accelerator_name: row.accelerator_count}
+        overrides: Dict[str, object] = {}
+        # Carry the node's actual host capacity so later requests against
+        # this cluster can be satisfiability-checked. Only when the row
+        # really knows it: None must not erase the user's constraint, and
+        # TPU rows' memory_gb is HBM, not host RAM.
+        row_is_tpu = acc_lib.is_tpu(row.accelerator_name)
+        if row.cpus is not None:
+            overrides['cpus'] = row.cpus
+        if row.memory_gb is not None and not row_is_tpu:
+            overrides['memory'] = row.memory_gb
         launchable = res.copy(
             infra=infra,
             instance_type=row.instance_type,
             accelerators=accelerators,
             _cluster_config_overrides=dict(res.cluster_config_overrides),
+            **overrides,
         )
         launchable._hourly_cost = row.cost(res.use_spot)  # noqa: SLF001
         return launchable

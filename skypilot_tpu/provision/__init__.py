@@ -1,0 +1,74 @@
+"""Provision layer: uniform per-cloud low-level API, routed by module.
+
+Reference analog: sky/provision/__init__.py:40 (`_route_to_cloud_impl`).
+Every cloud module under skypilot_tpu/provision/<cloud>/ implements the
+functions below with identical signatures.
+"""
+import importlib
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.provision import common  # noqa: F401 (re-export)
+
+
+def _impl(provider_name: str):
+    return importlib.import_module(
+        f'skypilot_tpu.provision.{provider_name.lower()}')
+
+
+def run_instances(provider_name: str, region: str,
+                  cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    return _impl(provider_name).run_instances(region, cluster_name_on_cloud,
+                                              config)
+
+
+def wait_instances(provider_name: str, region: str,
+                   cluster_name_on_cloud: str,
+                   state: Optional[str] = None) -> None:
+    return _impl(provider_name).wait_instances(region, cluster_name_on_cloud,
+                                               state)
+
+
+def stop_instances(provider_name: str, cluster_name_on_cloud: str,
+                   provider_config: Dict[str, Any]) -> None:
+    return _impl(provider_name).stop_instances(cluster_name_on_cloud,
+                                               provider_config)
+
+
+def terminate_instances(provider_name: str, cluster_name_on_cloud: str,
+                        provider_config: Dict[str, Any]) -> None:
+    return _impl(provider_name).terminate_instances(cluster_name_on_cloud,
+                                                    provider_config)
+
+
+def query_instances(provider_name: str, cluster_name_on_cloud: str,
+                    provider_config: Dict[str, Any]
+                    ) -> Dict[str, Optional[str]]:
+    """instance_id -> status ('running'|'stopped'|'terminated'|None)."""
+    return _impl(provider_name).query_instances(cluster_name_on_cloud,
+                                                provider_config)
+
+
+def get_cluster_info(provider_name: str, region: str,
+                     cluster_name_on_cloud: str,
+                     provider_config: Dict[str, Any]) -> common.ClusterInfo:
+    return _impl(provider_name).get_cluster_info(region,
+                                                 cluster_name_on_cloud,
+                                                 provider_config)
+
+
+def open_ports(provider_name: str, cluster_name_on_cloud: str,
+               ports: List[str], provider_config: Dict[str, Any]) -> None:
+    impl = _impl(provider_name)
+    if not hasattr(impl, 'open_ports'):
+        from skypilot_tpu import exceptions
+        raise exceptions.NotSupportedError(
+            f'{provider_name} cannot open ports (requested: {ports}).')
+    impl.open_ports(cluster_name_on_cloud, ports, provider_config)
+
+
+def get_command_runners(provider_name: str, cluster_info: common.ClusterInfo
+                        ) -> List:
+    """One CommandRunner per *host* (a pod slice contributes several),
+    ordered head-host first."""
+    return _impl(provider_name).get_command_runners(cluster_info)

@@ -1,0 +1,102 @@
+"""Shared provision-layer types.
+
+Reference analog: sky/provision/common.py (ProvisionConfig :39,
+ProvisionRecord :63, InstanceInfo :92, ClusterInfo :109). TPU-first shape:
+one logical *node* may be backed by several host VMs (a pod slice);
+`InstanceInfo.hosts` carries every host of the slice so gang execution can
+fan out to all of them (reference num_ips_per_node,
+cloud_vm_ray_backend.py:2613).
+"""
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class HostInfo:
+    """One reachable host VM (a slice worker or a standalone VM)."""
+    host_id: str
+    internal_ip: str
+    external_ip: Optional[str] = None
+    ssh_port: int = 22
+
+    def get_ip(self, use_internal: bool = False) -> str:
+        if use_internal or not self.external_ip:
+            return self.internal_ip
+        return self.external_ip
+
+
+@dataclasses.dataclass
+class InstanceInfo:
+    """One logical node: a VM, or a whole TPU slice with N hosts."""
+    instance_id: str
+    hosts: List[HostInfo]
+    status: str = 'running'
+    tags: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    @property
+    def num_hosts(self) -> int:
+        return len(self.hosts)
+
+
+@dataclasses.dataclass
+class ProvisionConfig:
+    """Everything a cloud provisioner needs to create instances."""
+    provider_config: Dict[str, Any]      # cloud-specific deploy variables
+    authentication_config: Dict[str, Any]
+    node_config: Dict[str, Any]
+    count: int                           # logical nodes (slices)
+    tags: Dict[str, str] = dataclasses.field(default_factory=dict)
+    resume_stopped_nodes: bool = True
+    ports_to_open_on_launch: List[str] = dataclasses.field(
+        default_factory=list)
+
+
+@dataclasses.dataclass
+class ProvisionRecord:
+    """Result of run_instances."""
+    provider_name: str
+    region: str
+    zone: Optional[str]
+    cluster_name_on_cloud: str
+    head_instance_id: str
+    created_instance_ids: List[str]
+    resumed_instance_ids: List[str] = dataclasses.field(default_factory=list)
+
+    def is_instance_just_booted(self, instance_id: str) -> bool:
+        return (instance_id in self.created_instance_ids or
+                instance_id in self.resumed_instance_ids)
+
+
+@dataclasses.dataclass
+class ClusterInfo:
+    """Queryable state of a provisioned cluster."""
+    instances: Dict[str, InstanceInfo]
+    head_instance_id: Optional[str]
+    provider_name: str
+    provider_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    ssh_user: str = ''
+    ssh_private_key: Optional[str] = None
+    # docker_user etc. would slot in here.
+
+    def get_head_instance(self) -> Optional[InstanceInfo]:
+        if self.head_instance_id is None:
+            return None
+        return self.instances.get(self.head_instance_id)
+
+    def ordered_instances(self) -> List[InstanceInfo]:
+        """Head first, then workers sorted by instance id (stable ranks)."""
+        out = []
+        head = self.get_head_instance()
+        if head is not None:
+            out.append(head)
+        for iid in sorted(self.instances):
+            if iid != self.head_instance_id:
+                out.append(self.instances[iid])
+        return out
+
+    def all_hosts(self) -> List[HostInfo]:
+        return [h for inst in self.ordered_instances() for h in inst.hosts]
+
+    @property
+    def num_instances(self) -> int:
+        return len(self.instances)

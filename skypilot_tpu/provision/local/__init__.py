@@ -1,0 +1,128 @@
+"""Local 'cloud' provisioner: instances are per-cluster runtime directories
+on this machine; commands run as subprocesses.
+
+This is a real provision-layer implementation (not a mock): the backend,
+skylet job queue, log tailing and autostop all run against it, which is how
+the end-to-end path stays testable with zero credentials (the reference
+leans on moto for this; tests/common_test_fixtures.py:414).
+"""
+import json
+import os
+import shutil
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.provision import common
+from skypilot_tpu.utils import command_runner
+from skypilot_tpu.utils import paths
+
+
+def _cluster_dir(cluster_name_on_cloud: str) -> str:
+    return os.path.join(paths.local_clusters_dir(), cluster_name_on_cloud)
+
+
+def _meta_path(cluster_name_on_cloud: str) -> str:
+    return os.path.join(_cluster_dir(cluster_name_on_cloud), 'metadata.json')
+
+
+def _load_meta(cluster_name_on_cloud: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(_meta_path(cluster_name_on_cloud), 'r',
+                  encoding='utf-8') as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    del region
+    d = _cluster_dir(cluster_name_on_cloud)
+    os.makedirs(d, exist_ok=True)
+    meta = _load_meta(cluster_name_on_cloud)
+    created: List[str] = []
+    resumed: List[str] = []
+    if meta is None or meta.get('state') == 'terminated':
+        meta = {
+            'state': 'running',
+            'count': config.count,
+            'runtime_dir': d,
+        }
+        created = [f'{cluster_name_on_cloud}-{i}'
+                   for i in range(config.count)]
+    elif meta.get('state') == 'stopped':
+        meta['state'] = 'running'
+        resumed = [f'{cluster_name_on_cloud}-{i}'
+                   for i in range(meta['count'])]
+    with open(_meta_path(cluster_name_on_cloud), 'w', encoding='utf-8') as f:
+        json.dump(meta, f)
+    return common.ProvisionRecord(
+        provider_name='local', region='local', zone=None,
+        cluster_name_on_cloud=cluster_name_on_cloud,
+        head_instance_id=f'{cluster_name_on_cloud}-0',
+        created_instance_ids=created,
+        resumed_instance_ids=resumed)
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: Optional[str] = None) -> None:
+    del region, cluster_name_on_cloud, state  # directories are instant
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Dict[str, Any]) -> None:
+    del provider_config
+    meta = _load_meta(cluster_name_on_cloud)
+    if meta is not None:
+        meta['state'] = 'stopped'
+        with open(_meta_path(cluster_name_on_cloud), 'w',
+                  encoding='utf-8') as f:
+            json.dump(meta, f)
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Dict[str, Any]) -> None:
+    del provider_config
+    d = _cluster_dir(cluster_name_on_cloud)
+    shutil.rmtree(d, ignore_errors=True)
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Dict[str, Any]
+                    ) -> Dict[str, Optional[str]]:
+    del provider_config
+    meta = _load_meta(cluster_name_on_cloud)
+    if meta is None:
+        return {}
+    return {f'{cluster_name_on_cloud}-{i}': meta.get('state', 'running')
+            for i in range(meta.get('count', 1))}
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Dict[str, Any]) -> common.ClusterInfo:
+    del region
+    meta = _load_meta(cluster_name_on_cloud) or {'count': 1}
+    instances = {}
+    for i in range(meta.get('count', 1)):
+        iid = f'{cluster_name_on_cloud}-{i}'
+        instances[iid] = common.InstanceInfo(
+            instance_id=iid,
+            hosts=[common.HostInfo(host_id=iid, internal_ip='127.0.0.1')])
+    return common.ClusterInfo(
+        instances=instances,
+        head_instance_id=f'{cluster_name_on_cloud}-0',
+        provider_name='local',
+        provider_config=dict(provider_config,
+                             runtime_dir=_cluster_dir(cluster_name_on_cloud)),
+        ssh_user=os.environ.get('USER', 'root'))
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str],
+               provider_config: Dict[str, Any]) -> None:
+    """No firewall on localhost — ports are inherently open."""
+    del cluster_name_on_cloud, ports, provider_config
+
+
+def get_command_runners(cluster_info: common.ClusterInfo) -> List:
+    return [command_runner.LocalProcessRunner(h.host_id)
+            for inst in cluster_info.ordered_instances()
+            for h in inst.hosts]

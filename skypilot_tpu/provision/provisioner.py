@@ -1,0 +1,160 @@
+"""Provisioner orchestration: create instances, wait, set up the runtime.
+
+Reference analog: sky/provision/provisioner.py:104 (bulk_provision),
+:365 (wait_for_ssh), :416 (_post_provision_setup), :671
+(post_provision_runtime_setup). TPU-first difference in runtime setup:
+instead of ray head/worker bootstrap, we write the slice topology file the
+gang runner reads, ship the package, and start skylet — XLA owns the
+intra-slice fabric, so there is no equivalent of `ray start`.
+"""
+import json
+import os
+import shlex
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import provision
+from skypilot_tpu.provision import common
+from skypilot_tpu.skylet import constants as skylet_constants
+from skypilot_tpu.utils import command_runner as runner_lib
+
+_PKG_REMOTE_DIR = '~/.skytpu_runtime/pkg'
+
+
+def bulk_provision(provider_name: str, region: str, zone: Optional[str],
+                   cluster_name_on_cloud: str,
+                   config: common.ProvisionConfig
+                   ) -> common.ProvisionRecord:
+    record = provision.run_instances(provider_name, region,
+                                     cluster_name_on_cloud, config)
+    provision.wait_instances(provider_name, region, cluster_name_on_cloud,
+                             state='running')
+    if config.ports_to_open_on_launch:
+        provision.open_ports(provider_name, cluster_name_on_cloud,
+                             config.ports_to_open_on_launch,
+                             config.provider_config)
+    return record
+
+
+def wait_for_connection(runners: List[runner_lib.CommandRunner],
+                        timeout: float = 600.0) -> None:
+    """Block until every host answers a trivial command (reference
+    wait_for_ssh :365)."""
+    deadline = time.time() + timeout
+    for runner in runners:
+        while True:
+            if runner.check_connection():
+                break
+            if time.time() > deadline:
+                raise exceptions.ClusterSetUpError(
+                    f'Host {runner.node_id} unreachable after '
+                    f'{timeout:.0f}s')
+            time.sleep(5)
+
+
+def runtime_dir_for(cluster_info: common.ClusterInfo) -> str:
+    """Local clusters get a private runtime dir; remote ones the default."""
+    if cluster_info.provider_name == 'local':
+        return os.path.join(
+            cluster_info.provider_config['runtime_dir'], 'runtime')
+    return os.path.expanduser(skylet_constants.DEFAULT_RUNTIME_DIR)
+
+
+def build_topology(cluster_name: str, cluster_info: common.ClusterInfo,
+                   ssh_user: str = '', ssh_key: Optional[str] = None
+                   ) -> Dict[str, Any]:
+    """The file the gang runner reads: logical nodes -> host lists."""
+    nodes = []
+    local = cluster_info.provider_name == 'local'
+    for inst in cluster_info.ordered_instances():
+        hosts = []
+        for h in inst.hosts:
+            host: Dict[str, Any] = {'ip': h.get_ip(use_internal=True)}
+            if local:
+                host['local'] = True
+            else:
+                host['ssh_user'] = ssh_user or cluster_info.ssh_user
+                host['ssh_key'] = ssh_key or cluster_info.ssh_private_key
+                host['ssh_port'] = h.ssh_port
+            hosts.append(host)
+        nodes.append({'instance_id': inst.instance_id, 'hosts': hosts})
+    return {'cluster_name': cluster_name, 'nodes': nodes}
+
+
+def post_provision_runtime_setup(provider_name: str, cluster_name: str,
+                                 cluster_info: common.ClusterInfo,
+                                 stream_logs: bool = False) -> str:
+    """Make the cluster runnable: connectivity, topology file, package,
+    skylet. Returns the runtime dir. Idempotent."""
+    runners = provision.get_command_runners(provider_name, cluster_info)
+    wait_for_connection(runners)
+    rt = runtime_dir_for(cluster_info)
+    head = runners[0]
+    local = isinstance(head, runner_lib.LocalProcessRunner)
+
+    topology = build_topology(cluster_name, cluster_info)
+    if local:
+        os.makedirs(rt, exist_ok=True)
+        with open(skylet_constants.topology_path(rt), 'w',
+                  encoding='utf-8') as f:
+            json.dump(topology, f, indent=1)
+    else:
+        _ship_package(runners)
+        payload = shlex.quote(json.dumps(topology))
+        for runner in runners:
+            runner.run(f'mkdir -p {rt} && '
+                       f'echo {payload} > {rt}/cluster_topology.json')
+
+    rc, out, err = head.run(
+        _skylet_cli_cmd(local, rt, 'start-skylet'),
+        require_outputs=True)
+    if rc != 0:
+        raise exceptions.ClusterSetUpError(
+            f'Failed to start skylet on head: {err or out}')
+    return rt
+
+
+def _ship_package(runners: List[runner_lib.CommandRunner]) -> None:
+    """Rsync the framework package to every host (reference wheel shipping,
+    sky/backends/wheel_utils.py — we sync sources instead of a wheel)."""
+    import skypilot_tpu
+    pkg_dir = os.path.dirname(os.path.abspath(skypilot_tpu.__file__))
+    for runner in runners:
+        runner.run(f'mkdir -p {_PKG_REMOTE_DIR}')
+        runner.rsync(pkg_dir, f'{_PKG_REMOTE_DIR}/', up=True,
+                     excludes=['__pycache__', '*.pyc'])
+
+
+def _skylet_cli_cmd(local: bool, rt: str, subcmd: str, *args: str) -> str:
+    """Shell command that invokes the skylet CLI on a host."""
+    quoted = ' '.join(shlex.quote(a) for a in args)
+    if local:
+        import skypilot_tpu
+        pkg_parent = os.path.dirname(os.path.dirname(
+            os.path.abspath(skypilot_tpu.__file__)))
+        py = shlex.quote(sys.executable)
+        return (f'PYTHONPATH={shlex.quote(pkg_parent)}:$PYTHONPATH '
+                f'{py} -m skypilot_tpu.skylet.cli '
+                f'--runtime-dir {shlex.quote(rt)} {subcmd} {quoted}')
+    return (f'PYTHONPATH={_PKG_REMOTE_DIR}:$PYTHONPATH python3 -m '
+            f'skypilot_tpu.skylet.cli --runtime-dir {shlex.quote(rt)} '
+            f'{subcmd} {quoted}')
+
+
+def skylet_cli_cmd_for(runner: runner_lib.CommandRunner, rt: str,
+                       subcmd: str, *args: str) -> str:
+    return _skylet_cli_cmd(isinstance(runner, runner_lib.LocalProcessRunner),
+                           rt, subcmd, *args)
+
+
+def teardown_cluster(provider_name: str, cluster_name_on_cloud: str,
+                     provider_config: Dict[str, Any],
+                     terminate: bool) -> None:
+    if terminate:
+        provision.terminate_instances(provider_name, cluster_name_on_cloud,
+                                      provider_config)
+    else:
+        provision.stop_instances(provider_name, cluster_name_on_cloud,
+                                 provider_config)

@@ -417,6 +417,12 @@ class Resources:
         if (self._instance_type is not None and
                 self._instance_type != other.instance_type):
             return False
+        if (self._cpus is not None and other.cpus is not None and
+                other.cpus < self._cpus):
+            return False
+        if (self._memory is not None and other.memory is not None and
+                other.memory < self._memory):
+            return False
         return True
 
     def __repr__(self) -> str:

@@ -1,0 +1,73 @@
+"""Autostop config + enforcement, on-cluster.
+
+Reference analog: sky/skylet/autostop_lib.py (set_autostop :60) with
+enforcement in events.py:102. TPU twist: slices cannot stop, so the
+backend always sets down=True for TPU clusters (clouds/gcp.py analog of
+reference clouds/gcp.py:216-226).
+"""
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+from skypilot_tpu.skylet import constants
+from skypilot_tpu.skylet import job_lib
+
+
+def set_autostop(rt: str, idle_minutes: Optional[int], down: bool,
+                 provider_name: str, cluster_name_on_cloud: str,
+                 provider_config: Dict[str, Any]) -> None:
+    """idle_minutes=None disables autostop."""
+    path = constants.autostop_config_path(rt)
+    if idle_minutes is None:
+        if os.path.exists(path):
+            os.remove(path)
+        return
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump({
+            'idle_minutes': idle_minutes,
+            'down': down,
+            'provider_name': provider_name,
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'provider_config': provider_config,
+            'set_at': time.time(),
+        }, f)
+
+
+def get_autostop_config(rt: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(constants.autostop_config_path(rt), 'r',
+                  encoding='utf-8') as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None
+
+
+def should_autostop(rt: str) -> bool:
+    cfg = get_autostop_config(rt)
+    if cfg is None:
+        return False
+    if not job_lib.is_cluster_idle(rt):
+        return False
+    idle_anchor = max(job_lib.last_activity_time(rt), cfg['set_at'])
+    return (time.time() - idle_anchor) >= cfg['idle_minutes'] * 60
+
+
+def execute_autostop(rt: str) -> None:
+    """Stop/terminate this cluster from within (reference
+    events.py:102 -> _stop_cluster_with_new_provisioner)."""
+    cfg = get_autostop_config(rt)
+    if cfg is None:
+        return
+    from skypilot_tpu import provision
+    # Drop the config first: if the stop partially succeeds we must not
+    # loop forever re-stopping.
+    os.remove(constants.autostop_config_path(rt))
+    if cfg['down']:
+        provision.terminate_instances(cfg['provider_name'],
+                                      cfg['cluster_name_on_cloud'],
+                                      cfg['provider_config'])
+    else:
+        provision.stop_instances(cfg['provider_name'],
+                                 cfg['cluster_name_on_cloud'],
+                                 cfg['provider_config'])

@@ -1,0 +1,164 @@
+"""On-cluster command surface, invoked by the backend over the runner.
+
+Replaces the reference's CodeGen-classes-serializing-python-into-
+`python -c` payloads (job_lib.py:1040, autostop_lib.py:110) with a real
+argparse CLI: every control-plane operation on the cluster is
+
+    python -m skypilot_tpu.skylet.cli <subcommand> --runtime-dir D ...
+
+Machine-readable results go to stdout as one JSON document.
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+from typing import Optional
+
+from skypilot_tpu.skylet import autostop_lib
+from skypilot_tpu.skylet import constants
+from skypilot_tpu.skylet import job_lib
+from skypilot_tpu.skylet import log_lib
+
+
+def _cmd_submit(args) -> int:
+    if args.spec_file:
+        with open(args.spec_file, 'r', encoding='utf-8') as f:
+            spec = json.load(f)
+    else:
+        spec = json.load(sys.stdin)
+    job_id = job_lib.add_job(args.runtime_dir, spec.get('name') or '-',
+                             spec.get('num_nodes', 1),
+                             spec.get('resources_str', ''))
+    job_lib.write_job_spec(args.runtime_dir, job_id, spec)
+    # Start immediately (don't wait for the daemon tick).
+    job_lib.schedule_step(args.runtime_dir)
+    print(json.dumps({'job_id': job_id}))
+    return 0
+
+
+def _cmd_queue(args) -> int:
+    jobs = job_lib.get_jobs(args.runtime_dir)
+    out = []
+    for j in jobs:
+        j = dict(j)
+        j['status'] = j['status'].value
+        out.append(j)
+    print(json.dumps(out))
+    return 0
+
+
+def _cmd_job_status(args) -> int:
+    statuses = {}
+    for job_id in args.job_ids:
+        job = job_lib.get_job(args.runtime_dir, job_id)
+        statuses[str(job_id)] = job['status'].value if job else None
+    print(json.dumps(statuses))
+    return 0
+
+
+def _cmd_cancel(args) -> int:
+    cancelled = job_lib.cancel_jobs(
+        args.runtime_dir,
+        job_ids=args.job_ids or None,
+        all_jobs=args.all)
+    print(json.dumps({'cancelled': cancelled}))
+    return 0
+
+
+def _cmd_tail(args) -> int:
+    return log_lib.tail_logs(args.runtime_dir,
+                             args.job_id,
+                             follow=args.follow,
+                             tail=args.tail)
+
+
+def _cmd_set_autostop(args) -> int:
+    provider_config = json.loads(args.provider_config or '{}')
+    idle = None if args.cancel else args.idle_minutes
+    autostop_lib.set_autostop(args.runtime_dir, idle, args.down,
+                              args.provider_name,
+                              args.cluster_name_on_cloud, provider_config)
+    print(json.dumps({'ok': True}))
+    return 0
+
+
+def _cmd_start_skylet(args) -> int:
+    """Idempotent daemon start (reference attempt_skylet.py)."""
+    rt = args.runtime_dir
+    pid_path = constants.skylet_pid_path(rt)
+    if os.path.exists(pid_path):
+        try:
+            with open(pid_path, 'r', encoding='utf-8') as f:
+                pid = int(f.read().strip())
+            os.kill(pid, 0)
+            print(json.dumps({'status': 'already_running', 'pid': pid}))
+            return 0
+        except (ValueError, ProcessLookupError, PermissionError):
+            pass
+    log_f = open(constants.skylet_log_path(rt), 'ab')
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.skylet.skylet',
+         '--runtime-dir', rt],
+        stdout=log_f, stderr=subprocess.STDOUT,
+        start_new_session=True)
+    print(json.dumps({'status': 'started', 'pid': proc.pid}))
+    return 0
+
+
+def _cmd_is_idle(args) -> int:
+    print(json.dumps({'idle': job_lib.is_cluster_idle(args.runtime_dir)}))
+    return 0
+
+
+def main(argv: Optional[list] = None) -> int:
+    parser = argparse.ArgumentParser(prog='skylet-cli')
+    parser.add_argument('--runtime-dir', default=None)
+    sub = parser.add_subparsers(dest='cmd', required=True)
+
+    p = sub.add_parser('submit')
+    p.add_argument('--spec-file', default=None)
+    p.set_defaults(fn=_cmd_submit)
+
+    p = sub.add_parser('queue')
+    p.set_defaults(fn=_cmd_queue)
+
+    p = sub.add_parser('job-status')
+    p.add_argument('--job-ids', type=int, nargs='+', required=True)
+    p.set_defaults(fn=_cmd_job_status)
+
+    p = sub.add_parser('cancel')
+    p.add_argument('--job-ids', type=int, nargs='*', default=None)
+    p.add_argument('--all', action='store_true')
+    p.set_defaults(fn=_cmd_cancel)
+
+    p = sub.add_parser('tail')
+    p.add_argument('--job-id', type=int, default=None)
+    p.add_argument('--follow', action=argparse.BooleanOptionalAction,
+                   default=True)
+    p.add_argument('--tail', type=int, default=0)
+    p.set_defaults(fn=_cmd_tail)
+
+    p = sub.add_parser('set-autostop')
+    p.add_argument('--idle-minutes', type=int, default=5)
+    p.add_argument('--down', action='store_true')
+    p.add_argument('--cancel', action='store_true')
+    p.add_argument('--provider-name', default='local')
+    p.add_argument('--cluster-name-on-cloud', default='')
+    p.add_argument('--provider-config', default='{}')
+    p.set_defaults(fn=_cmd_set_autostop)
+
+    p = sub.add_parser('start-skylet')
+    p.set_defaults(fn=_cmd_start_skylet)
+
+    p = sub.add_parser('is-idle')
+    p.set_defaults(fn=_cmd_is_idle)
+
+    args = parser.parse_args(argv)
+    if args.runtime_dir is None:
+        args.runtime_dir = constants.runtime_dir()
+    return args.fn(args)
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,76 @@
+"""On-cluster runtime constants & path resolution.
+
+Reference analog: sky/skylet/constants.py (:9-60 runtime env, :350
+SKYPILOT_NUM_NODES etc.). The runtime directory is overridable via
+$SKYTPU_RUNTIME_DIR so the local cloud can give every cluster its own
+runtime on one machine.
+"""
+import os
+
+DEFAULT_RUNTIME_DIR = '~/.skytpu_runtime'
+RUNTIME_DIR_ENV_VAR = 'SKYTPU_RUNTIME_DIR'
+
+# Env vars injected into every job process (the reference's SKYPILOT_NODE_*
+# contract, cloud_vm_ray_backend.py:606-670, re-spelled for jax).
+ENV_NUM_NODES = 'SKYTPU_NUM_NODES'            # logical nodes (slices)
+ENV_NODE_RANK = 'SKYTPU_NODE_RANK'            # this host's slice index
+ENV_NODE_IPS = 'SKYTPU_NODE_IPS'              # newline-sep head-host IPs
+ENV_NUM_PROCESSES = 'SKYTPU_NUM_PROCESSES'    # total host processes
+ENV_PROCESS_ID = 'SKYTPU_PROCESS_ID'          # global host index
+ENV_COORDINATOR = 'SKYTPU_COORDINATOR_ADDR'   # ip:port of process 0
+ENV_JOB_ID = 'SKYTPU_JOB_ID'
+ENV_CLUSTER_NAME = 'SKYTPU_CLUSTER_NAME'
+ENV_ACCELERATORS_PER_NODE = 'SKYTPU_ACCELERATORS_PER_NODE'
+
+# jax.distributed / multi-slice (DCN) coordinates. Within one slice libtpu
+# does its own ICI rendezvous; across slices (one logical node == one
+# slice) megascale needs these.
+ENV_MEGASCALE_COORD = 'MEGASCALE_COORDINATOR_ADDRESS'
+ENV_MEGASCALE_NUM_SLICES = 'MEGASCALE_NUM_SLICES'
+ENV_MEGASCALE_SLICE_ID = 'MEGASCALE_SLICE_ID'
+ENV_TPU_WORKER_ID = 'TPU_WORKER_ID'
+ENV_TPU_WORKER_HOSTNAMES = 'TPU_WORKER_HOSTNAMES'
+
+JAX_COORDINATOR_PORT = 8476
+MEGASCALE_PORT = 8477
+
+SKYLET_DAEMON_INTERVAL_SECONDS = 20
+
+
+def runtime_dir() -> str:
+    d = os.environ.get(RUNTIME_DIR_ENV_VAR,
+                       os.path.expanduser(DEFAULT_RUNTIME_DIR))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def jobs_dir(rt: str) -> str:
+    d = os.path.join(rt, 'jobs')
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def job_dir(rt: str, job_id: int) -> str:
+    d = os.path.join(jobs_dir(rt), str(job_id))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def job_db_path(rt: str) -> str:
+    return os.path.join(rt, 'jobs.db')
+
+
+def topology_path(rt: str) -> str:
+    return os.path.join(rt, 'cluster_topology.json')
+
+
+def autostop_config_path(rt: str) -> str:
+    return os.path.join(rt, 'autostop.json')
+
+
+def skylet_pid_path(rt: str) -> str:
+    return os.path.join(rt, 'skylet.pid')
+
+
+def skylet_log_path(rt: str) -> str:
+    return os.path.join(rt, 'skylet.log')

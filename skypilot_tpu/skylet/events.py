@@ -1,0 +1,48 @@
+"""Skylet daemon events, ticked by skylet.py.
+
+Reference analog: sky/skylet/events.py:65-243 (AutostopEvent,
+JobSchedulerEvent, ...).
+"""
+import time
+import traceback
+
+from skypilot_tpu.skylet import autostop_lib
+from skypilot_tpu.skylet import job_lib
+
+
+class SkyletEvent:
+    EVENT_INTERVAL_SECONDS = 20
+
+    def __init__(self, rt: str):
+        self.rt = rt
+        self._last = 0.0
+
+    def tick(self) -> None:
+        now = time.time()
+        if now - self._last < self.EVENT_INTERVAL_SECONDS:
+            return
+        self._last = now
+        try:
+            self._run()
+        except Exception:  # noqa: BLE001 — daemon must survive anything
+            traceback.print_exc()
+
+    def _run(self) -> None:
+        raise NotImplementedError
+
+
+class JobSchedulerEvent(SkyletEvent):
+    """Start PENDING jobs; reconcile dead drivers."""
+    EVENT_INTERVAL_SECONDS = 2
+
+    def _run(self) -> None:
+        job_lib.update_job_statuses(self.rt)
+        job_lib.schedule_step(self.rt)
+
+
+class AutostopEvent(SkyletEvent):
+    EVENT_INTERVAL_SECONDS = 20
+
+    def _run(self) -> None:
+        if autostop_lib.should_autostop(self.rt):
+            autostop_lib.execute_autostop(self.rt)

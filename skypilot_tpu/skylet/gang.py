@@ -1,0 +1,247 @@
+"""Gang runner: the TPU-native replacement for the reference's Ray driver.
+
+Reference analog: RayCodeGen (cloud_vm_ray_backend.py:232-726) — a
+generated Ray program that gang-schedules placement groups and runs bash on
+each node with SKYPILOT_* env vars. On TPU there is nothing for Ray to do:
+XLA owns intra-slice collectives, so gang execution is just "run the
+command on every host of every slice with the right coordinates, and if
+any host fails, kill them all". That is this module.
+
+Runs on the head host as a detached driver process per job:
+
+    python -m skypilot_tpu.skylet.gang --runtime-dir D --job-id N
+
+Topology comes from cluster_topology.json (written at provision time);
+the job's commands/envs from jobs/N/spec.json.
+
+Injected coordinates (skylet/constants.py): SKYTPU_NUM_NODES / NODE_RANK /
+NODE_IPS / NUM_PROCESSES / PROCESS_ID / COORDINATOR_ADDR, plus
+MEGASCALE_* + TPU_WORKER_* for multi-slice TPU jobs — these are exactly
+what `jax.distributed.initialize()` and megascale DCN bootstrap consume.
+"""
+import argparse
+import json
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.skylet import constants
+from skypilot_tpu.skylet import job_lib
+
+
+def load_topology(rt: str) -> Dict[str, Any]:
+    with open(constants.topology_path(rt), 'r', encoding='utf-8') as f:
+        return json.load(f)
+
+
+def _host_argv(host: Dict[str, Any], cmd: str,
+               env: Dict[str, str]) -> List[str]:
+    """argv that runs `cmd` with `env` on `host` (local or over ssh)."""
+    exports = ' '.join(f'export {k}={shlex.quote(str(v))};'
+                       for k, v in env.items())
+    full = f'{exports} {cmd}'
+    if host.get('local', False):
+        return ['bash', '-c', full]
+    ssh_opts = [
+        '-o', 'StrictHostKeyChecking=no',
+        '-o', 'UserKnownHostsFile=/dev/null',
+        '-o', 'LogLevel=ERROR',
+        '-o', 'ConnectTimeout=30',
+        '-p', str(host.get('ssh_port', 22)),
+    ]
+    if host.get('ssh_key'):
+        ssh_opts += ['-i', os.path.expanduser(host['ssh_key'])]
+    target = f"{host.get('ssh_user', 'root')}@{host['ip']}"
+    return (['ssh'] + ssh_opts + [target,
+            f'bash --login -c {shlex.quote(full)}'])
+
+
+class GangRun:
+    """Spawn one process per (node, host); kill-all on first failure."""
+
+    def __init__(self, rt: str, job_id: int, spec: Dict[str, Any],
+                 topology: Dict[str, Any]):
+        self.rt = rt
+        self.job_id = job_id
+        self.spec = spec
+        self.nodes: List[Dict[str, Any]] = topology['nodes']
+        self.cluster_name = topology.get('cluster_name', '')
+        self.log_path = job_lib.job_log_path(rt, job_id)
+        self._log_lock = threading.Lock()
+        self._procs: List[subprocess.Popen] = []
+        self._failed = threading.Event()
+        self._exit_codes: List[Optional[int]] = []
+        self._first_failure_code: Optional[int] = None
+        self._failure_lock = threading.Lock()
+
+    # --- env injection ------------------------------------------------------
+
+    def _env_for(self, node_rank: int, host_rank: int,
+                 process_id: int) -> Dict[str, str]:
+        num_nodes = len(self.nodes)
+        total_procs = sum(len(n['hosts']) for n in self.nodes)
+        node_head_ips = [n['hosts'][0]['ip'] for n in self.nodes]
+        coordinator = (f'{node_head_ips[0]}:'
+                       f'{constants.JAX_COORDINATOR_PORT}')
+        env: Dict[str, str] = dict(self.spec.get('envs', {}))
+        env.update({
+            constants.ENV_NUM_NODES: str(num_nodes),
+            constants.ENV_NODE_RANK: str(node_rank),
+            constants.ENV_NODE_IPS: '\n'.join(node_head_ips),
+            constants.ENV_NUM_PROCESSES: str(total_procs),
+            constants.ENV_PROCESS_ID: str(process_id),
+            constants.ENV_COORDINATOR: coordinator,
+            constants.ENV_JOB_ID: str(self.job_id),
+            constants.ENV_CLUSTER_NAME: self.cluster_name,
+        })
+        accs = self.spec.get('accelerators_per_node')
+        if accs:
+            env[constants.ENV_ACCELERATORS_PER_NODE] = str(accs)
+        if self.spec.get('is_tpu', False):
+            hosts = self.nodes[node_rank]['hosts']
+            env[constants.ENV_TPU_WORKER_ID] = str(host_rank)
+            env[constants.ENV_TPU_WORKER_HOSTNAMES] = ','.join(
+                h['ip'] for h in hosts)
+            if num_nodes > 1:
+                # Multi-slice: each logical node is one slice; DCN
+                # coordination via megascale.
+                env[constants.ENV_MEGASCALE_COORD] = (
+                    f'{node_head_ips[0]}:{constants.MEGASCALE_PORT}')
+                env[constants.ENV_MEGASCALE_NUM_SLICES] = str(num_nodes)
+                env[constants.ENV_MEGASCALE_SLICE_ID] = str(node_rank)
+        return env
+
+    # --- logging ------------------------------------------------------------
+
+    def _pump(self, proc: subprocess.Popen, prefix: str, idx: int) -> None:
+        assert proc.stdout is not None
+        with open(self.log_path, 'ab') as f:
+            for line in iter(proc.stdout.readline, b''):
+                with self._log_lock:
+                    f.write(prefix.encode() + line)
+                    f.flush()
+        rc = proc.wait()
+        self._exit_codes[idx] = rc
+        if rc != 0:
+            with self._failure_lock:
+                # Record the CAUSAL failure: a process that died before
+                # the gang kill, not one we SIGTERMed as collateral.
+                if self._first_failure_code is None and \
+                        not self._failed.is_set():
+                    self._first_failure_code = rc
+            self._failed.set()
+
+    def _log(self, msg: str) -> None:
+        with self._log_lock, open(self.log_path, 'ab') as f:
+            f.write(f'[gang] {msg}\n'.encode())
+
+    # --- phases -------------------------------------------------------------
+
+    def run_phase(self, cmd: str, phase: str) -> int:
+        """Run `cmd` on every (node, host); return worst exit code."""
+        self._procs = []
+        self._failed.clear()
+        threads = []
+        total = sum(len(n['hosts']) for n in self.nodes)
+        self._exit_codes = [None] * total
+        self._log(f'{phase}: launching on {len(self.nodes)} node(s), '
+                  f'{total} host process(es)')
+        process_id = 0
+        for node_rank, node in enumerate(self.nodes):
+            for host_rank, host in enumerate(node['hosts']):
+                env = self._env_for(node_rank, host_rank, process_id)
+                argv = _host_argv(host, cmd, env)
+                proc = subprocess.Popen(
+                    argv, stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT,
+                    start_new_session=True)
+                self._procs.append(proc)
+                multi_host = len(node['hosts']) > 1
+                prefix = (f'({node_rank},{host_rank}) ' if multi_host
+                          else (f'(node-{node_rank}) '
+                                if len(self.nodes) > 1 else ''))
+                t = threading.Thread(target=self._pump,
+                                     args=(proc, prefix, process_id),
+                                     daemon=True)
+                t.start()
+                threads.append(t)
+                process_id += 1
+        # Gang watchdog: first failure kills the rest.
+        while any(t.is_alive() for t in threads):
+            if self._failed.is_set():
+                self._kill_all()
+                break
+            time.sleep(0.2)
+        for t in threads:
+            t.join()
+        codes = [c if c is not None else -1 for c in self._exit_codes]
+        worst = self._first_failure_code
+        if worst is None:
+            worst = next((c for c in codes if c != 0), 0)
+        self._log(f'{phase}: done, exit codes {codes}')
+        return worst
+
+    def _kill_all(self) -> None:
+        for proc in self._procs:
+            if proc.poll() is None:
+                try:
+                    os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        deadline = time.time() + 10
+        for proc in self._procs:
+            timeout = max(0.1, deadline - time.time())
+            try:
+                proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--runtime-dir', required=True)
+    parser.add_argument('--job-id', type=int, required=True)
+    args = parser.parse_args(argv)
+    rt = args.runtime_dir
+    job_id = args.job_id
+
+    spec = job_lib.read_job_spec(rt, job_id)
+    topology = load_topology(rt)
+    num_nodes = spec.get('num_nodes', 1)
+    # A job may use fewer nodes than the cluster has.
+    topology = dict(topology, nodes=topology['nodes'][:num_nodes])
+    run = GangRun(rt, job_id, spec, topology)
+
+    setup_cmd = spec.get('setup')
+    if setup_cmd:
+        job_lib.set_status(rt, job_id, job_lib.JobStatus.SETTING_UP)
+        rc = run.run_phase(setup_cmd, 'setup')
+        if rc != 0:
+            job_lib.set_status(rt, job_id, job_lib.JobStatus.FAILED_SETUP,
+                               exit_code=rc)
+            return rc
+
+    run_cmd = spec.get('run')
+    if not run_cmd:
+        job_lib.set_status(rt, job_id, job_lib.JobStatus.SUCCEEDED,
+                           exit_code=0)
+        return 0
+    job_lib.set_status(rt, job_id, job_lib.JobStatus.RUNNING)
+    rc = run.run_phase(run_cmd, 'run')
+    job_lib.set_status(
+        rt, job_id,
+        job_lib.JobStatus.SUCCEEDED if rc == 0 else job_lib.JobStatus.FAILED,
+        exit_code=rc)
+    return rc
+
+
+if __name__ == '__main__':
+    sys.exit(main())

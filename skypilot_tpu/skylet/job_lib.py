@@ -1,0 +1,267 @@
+"""On-cluster job queue: sqlite-backed, driven by the gang runner.
+
+Reference analog: sky/skylet/job_lib.py (JobStatus :147, FIFOScheduler
+:309, JobLibCodeGen :1040). Differences, TPU-first:
+- No Ray: the scheduler spawns `python -m skypilot_tpu.skylet.gang` driver
+  processes directly; gang semantics live in gang.py.
+- No codegen strings: the backend invokes `skypilot_tpu.skylet.cli`
+  subcommands over the command runner.
+"""
+import enum
+import getpass
+import json
+import os
+import signal
+import sqlite3
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.skylet import constants
+
+
+class JobStatus(enum.Enum):
+    INIT = 'INIT'
+    PENDING = 'PENDING'
+    SETTING_UP = 'SETTING_UP'
+    RUNNING = 'RUNNING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    FAILED_SETUP = 'FAILED_SETUP'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in _TERMINAL
+
+    @classmethod
+    def nonterminal_statuses(cls) -> List['JobStatus']:
+        return [s for s in cls if not s.is_terminal()]
+
+
+_TERMINAL = {JobStatus.SUCCEEDED, JobStatus.FAILED, JobStatus.FAILED_SETUP,
+             JobStatus.CANCELLED}
+
+
+def _conn(rt: str) -> sqlite3.Connection:
+    conn = sqlite3.connect(constants.job_db_path(rt), timeout=30.0)
+    conn.execute('PRAGMA journal_mode=WAL')
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS jobs (
+            job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+            name TEXT,
+            username TEXT,
+            submitted_at REAL,
+            status TEXT,
+            run_timestamp TEXT,
+            start_at REAL,
+            end_at REAL,
+            resources TEXT,
+            num_nodes INTEGER,
+            driver_pid INTEGER,
+            exit_code INTEGER
+        )""")
+    conn.commit()
+    return conn
+
+
+# --- submission -------------------------------------------------------------
+
+def add_job(rt: str, name: str, num_nodes: int,
+            resources_str: str = '') -> int:
+    conn = _conn(rt)
+    run_timestamp = time.strftime('sky-%Y-%m-%d-%H-%M-%S-%f')
+    cur = conn.execute(
+        """INSERT INTO jobs (name, username, submitted_at, status,
+           run_timestamp, num_nodes, resources)
+           VALUES (?,?,?,?,?,?,?)""",
+        (name, getpass.getuser(), time.time(), JobStatus.PENDING.value,
+         run_timestamp, num_nodes, resources_str))
+    conn.commit()
+    job_id = int(cur.lastrowid)
+    conn.close()
+    return job_id
+
+
+def schedule_step(rt: str) -> None:
+    """FIFO: start every PENDING job whose predecessors aren't PENDING.
+
+    Jobs run concurrently (like the reference when resources allow); the
+    spawn is the gang driver process, detached from the caller.
+    """
+    conn = _conn(rt)
+    rows = conn.execute(
+        'SELECT job_id FROM jobs WHERE status=? ORDER BY job_id',
+        (JobStatus.PENDING.value,)).fetchall()
+    conn.close()
+    for (job_id,) in rows:
+        _start_job(rt, job_id)
+
+
+def _start_job(rt: str, job_id: int) -> None:
+    log_path = os.path.join(constants.job_dir(rt, job_id), 'driver.log')
+    env = dict(os.environ)
+    env[constants.RUNTIME_DIR_ENV_VAR] = rt
+    # The driver must import skypilot_tpu regardless of cwd.
+    pkg_parent = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env['PYTHONPATH'] = pkg_parent + (
+        ':' + env['PYTHONPATH'] if env.get('PYTHONPATH') else '')
+    with open(log_path, 'ab') as log_f:
+        proc = subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.skylet.gang',
+             '--runtime-dir', rt, '--job-id', str(job_id)],
+            stdout=log_f, stderr=subprocess.STDOUT, env=env,
+            start_new_session=True)
+    conn = _conn(rt)
+    conn.execute(
+        'UPDATE jobs SET status=?, start_at=?, driver_pid=? WHERE job_id=?'
+        ' AND status=?',
+        (JobStatus.SETTING_UP.value, time.time(), proc.pid, job_id,
+         JobStatus.PENDING.value))
+    conn.commit()
+    conn.close()
+
+
+# --- state transitions (called by the gang driver) --------------------------
+
+def set_status(rt: str, job_id: int, status: JobStatus,
+               exit_code: Optional[int] = None) -> None:
+    conn = _conn(rt)
+    if status.is_terminal():
+        conn.execute(
+            'UPDATE jobs SET status=?, end_at=?, exit_code=? WHERE job_id=?',
+            (status.value, time.time(), exit_code, job_id))
+    else:
+        conn.execute('UPDATE jobs SET status=? WHERE job_id=?',
+                     (status.value, job_id))
+    conn.commit()
+    conn.close()
+
+
+# --- queries ----------------------------------------------------------------
+
+def get_job(rt: str, job_id: int) -> Optional[Dict[str, Any]]:
+    conn = _conn(rt)
+    row = conn.execute(
+        'SELECT job_id, name, username, submitted_at, status, run_timestamp,'
+        ' start_at, end_at, resources, num_nodes, driver_pid, exit_code'
+        ' FROM jobs WHERE job_id=?', (job_id,)).fetchone()
+    conn.close()
+    return _row_to_dict(row) if row else None
+
+
+def get_jobs(rt: str, statuses: Optional[List[JobStatus]] = None
+             ) -> List[Dict[str, Any]]:
+    conn = _conn(rt)
+    if statuses:
+        qmarks = ','.join('?' * len(statuses))
+        rows = conn.execute(
+            f'SELECT job_id, name, username, submitted_at, status,'
+            f' run_timestamp, start_at, end_at, resources, num_nodes,'
+            f' driver_pid, exit_code FROM jobs WHERE status IN ({qmarks})'
+            f' ORDER BY job_id DESC',
+            [s.value for s in statuses]).fetchall()
+    else:
+        rows = conn.execute(
+            'SELECT job_id, name, username, submitted_at, status,'
+            ' run_timestamp, start_at, end_at, resources, num_nodes,'
+            ' driver_pid, exit_code FROM jobs ORDER BY job_id DESC'
+        ).fetchall()
+    conn.close()
+    return [_row_to_dict(r) for r in rows]
+
+
+def _row_to_dict(row) -> Dict[str, Any]:
+    return {
+        'job_id': row[0], 'job_name': row[1], 'username': row[2],
+        'submitted_at': row[3], 'status': JobStatus(row[4]),
+        'run_timestamp': row[5], 'start_at': row[6], 'end_at': row[7],
+        'resources': row[8], 'num_nodes': row[9], 'driver_pid': row[10],
+        'exit_code': row[11],
+    }
+
+
+def get_latest_job_id(rt: str) -> Optional[int]:
+    conn = _conn(rt)
+    row = conn.execute('SELECT MAX(job_id) FROM jobs').fetchone()
+    conn.close()
+    return row[0] if row and row[0] is not None else None
+
+
+def is_cluster_idle(rt: str) -> bool:
+    """No job in a non-terminal state (autostop predicate,
+    reference job_lib.py:817)."""
+    return not get_jobs(rt, JobStatus.nonterminal_statuses())
+
+
+def last_activity_time(rt: str) -> float:
+    """Most recent job end/submit time, for idle-minutes accounting."""
+    conn = _conn(rt)
+    row = conn.execute(
+        'SELECT MAX(COALESCE(end_at, start_at, submitted_at)) FROM jobs'
+    ).fetchone()
+    conn.close()
+    return float(row[0]) if row and row[0] else 0.0
+
+
+# --- liveness reconciliation ------------------------------------------------
+
+def _pid_alive(pid: Optional[int]) -> bool:
+    if not pid:
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except (ProcessLookupError, PermissionError):
+        return False
+
+
+def update_job_statuses(rt: str) -> None:
+    """Mark jobs whose driver died without reporting as FAILED
+    (reference update_job_status :644 driver-liveness check)."""
+    for job in get_jobs(rt, [JobStatus.SETTING_UP, JobStatus.RUNNING]):
+        if not _pid_alive(job['driver_pid']):
+            set_status(rt, job['job_id'], JobStatus.FAILED, exit_code=-1)
+
+
+# --- cancellation -----------------------------------------------------------
+
+def cancel_jobs(rt: str, job_ids: Optional[List[int]] = None,
+                all_jobs: bool = False) -> List[int]:
+    if all_jobs:
+        jobs = get_jobs(rt, JobStatus.nonterminal_statuses())
+        job_ids = [j['job_id'] for j in jobs]
+    cancelled = []
+    for job_id in job_ids or []:
+        job = get_job(rt, job_id)
+        if job is None or job['status'].is_terminal():
+            continue
+        pid = job['driver_pid']
+        if pid and _pid_alive(pid):
+            try:
+                os.killpg(os.getpgid(pid), signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+        set_status(rt, job_id, JobStatus.CANCELLED)
+        cancelled.append(job_id)
+    return cancelled
+
+
+# --- spec files -------------------------------------------------------------
+
+def write_job_spec(rt: str, job_id: int, spec: Dict[str, Any]) -> str:
+    path = os.path.join(constants.job_dir(rt, job_id), 'spec.json')
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump(spec, f, indent=1)
+    return path
+
+
+def read_job_spec(rt: str, job_id: int) -> Dict[str, Any]:
+    path = os.path.join(constants.job_dir(rt, job_id), 'spec.json')
+    with open(path, 'r', encoding='utf-8') as f:
+        return json.load(f)
+
+
+def job_log_path(rt: str, job_id: int) -> str:
+    return os.path.join(constants.job_dir(rt, job_id), 'run.log')

@@ -1,0 +1,92 @@
+"""Log tailing with job-status-aware termination.
+
+Reference analog: sky/skylet/log_lib.py (run_with_log :152, tail_logs :441,
+_follow_job_logs :357).
+"""
+import os
+import sys
+import time
+from typing import Optional
+
+from skypilot_tpu.skylet import job_lib
+
+_POLL_INTERVAL = 0.5
+
+
+def tail_logs(rt: str, job_id: Optional[int] = None, *,
+              follow: bool = True, tail: int = 0,
+              out=None) -> int:
+    """Stream a job's run.log; returns the job's exit code (0 if unknown).
+
+    With follow=True, keeps streaming until the job reaches a terminal
+    status AND the file is drained (the reference's status-aware loop).
+    """
+    out = out or sys.stdout
+    if job_id is None:
+        job_id = job_lib.get_latest_job_id(rt)
+        if job_id is None:
+            print('No jobs found on cluster.', file=out)
+            return 1
+    job = job_lib.get_job(rt, job_id)
+    if job is None:
+        print(f'Job {job_id} not found.', file=out)
+        return 1
+    log_path = job_lib.job_log_path(rt, job_id)
+
+    # Wait for the driver to create the log file.
+    deadline = time.time() + 30
+    while follow and not os.path.exists(log_path):
+        job = job_lib.get_job(rt, job_id)
+        if job is not None and job['status'].is_terminal():
+            break
+        if time.time() > deadline:
+            break
+        time.sleep(_POLL_INTERVAL)
+
+    if not os.path.exists(log_path):
+        driver_log = os.path.join(os.path.dirname(log_path), 'driver.log')
+        if os.path.exists(driver_log):
+            log_path = driver_log
+        else:
+            print(f'No logs for job {job_id} (status: '
+                  f'{job["status"].value}).', file=out)
+            return _exit_code(job)
+
+    with open(log_path, 'r', encoding='utf-8', errors='replace') as f:
+        if tail > 0:
+            lines = f.readlines()
+            for line in lines[-tail:]:
+                out.write(line)
+            out.flush()
+        else:
+            for line in f:
+                out.write(line)
+            out.flush()
+        if not follow:
+            job = job_lib.get_job(rt, job_id)
+            return _exit_code(job)
+        # Follow: poll file + status.
+        while True:
+            line = f.readline()
+            if line:
+                out.write(line)
+                out.flush()
+                continue
+            job = job_lib.get_job(rt, job_id)
+            if job is not None and job['status'].is_terminal():
+                # Drain whatever arrived between readline and the check.
+                rest = f.read()
+                if rest:
+                    out.write(rest)
+                    out.flush()
+                return _exit_code(job)
+            time.sleep(_POLL_INTERVAL)
+
+
+def _exit_code(job) -> int:
+    if job is None:
+        return 1
+    code = job.get('exit_code')
+    if code is None:
+        return 0
+    return int(code)

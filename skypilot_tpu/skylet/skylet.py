@@ -1,0 +1,33 @@
+"""The skylet daemon: runs on the head host, ticks events forever.
+
+Reference analog: sky/skylet/skylet.py:17-34.
+
+    python -m skypilot_tpu.skylet.skylet --runtime-dir D
+"""
+import argparse
+import os
+import time
+
+from skypilot_tpu.skylet import constants
+from skypilot_tpu.skylet import events
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--runtime-dir', default=None)
+    args = parser.parse_args()
+    rt = args.runtime_dir or constants.runtime_dir()
+    os.environ[constants.RUNTIME_DIR_ENV_VAR] = rt
+
+    with open(constants.skylet_pid_path(rt), 'w', encoding='utf-8') as f:
+        f.write(str(os.getpid()))
+
+    evts = [events.JobSchedulerEvent(rt), events.AutostopEvent(rt)]
+    while True:
+        for e in evts:
+            e.tick()
+        time.sleep(1)
+
+
+if __name__ == '__main__':
+    main()

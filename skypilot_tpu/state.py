@@ -1,0 +1,227 @@
+"""Client-side cluster/state database (sqlite3, WAL).
+
+Reference analog: sky/global_user_state.py (SQLAlchemy tables :55-150,
+pickled handles). Ours uses stdlib sqlite3 with the same lock discipline
+(WAL + busy timeout) and pickles the backend's ResourceHandle the same way.
+"""
+import enum
+import json
+import os
+import pickle
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import paths
+
+_lock = threading.Lock()
+_conn: Optional[sqlite3.Connection] = None
+_conn_path: Optional[str] = None
+
+
+class ClusterStatus(enum.Enum):
+    INIT = 'INIT'          # provisioning in progress / unknown health
+    UP = 'UP'              # provisioned + runtime healthy
+    STOPPED = 'STOPPED'    # instances stopped, disk kept
+
+    def colored(self) -> str:
+        return self.value
+
+
+def _get_conn() -> sqlite3.Connection:
+    global _conn, _conn_path
+    path = paths.state_db_path()
+    with _lock:
+        if _conn is None or _conn_path != path:
+            _conn = sqlite3.connect(path, check_same_thread=False,
+                                    timeout=30.0)
+            _conn.execute('PRAGMA journal_mode=WAL')
+            _create_tables(_conn)
+            _conn_path = path
+        return _conn
+
+
+def reset_for_tests() -> None:
+    global _conn, _conn_path
+    with _lock:
+        if _conn is not None:
+            _conn.close()
+        _conn = None
+        _conn_path = None
+
+
+def _create_tables(conn: sqlite3.Connection) -> None:
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS clusters (
+            name TEXT PRIMARY KEY,
+            launched_at INTEGER,
+            handle BLOB,
+            last_use TEXT,
+            status TEXT,
+            autostop_json TEXT,
+            owner TEXT,
+            cluster_hash TEXT,
+            resources_json TEXT,
+            num_nodes INTEGER,
+            to_down INTEGER DEFAULT 0
+        )""")
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS cluster_history (
+            cluster_hash TEXT,
+            name TEXT,
+            launched_at INTEGER,
+            duration_s REAL,
+            resources_json TEXT,
+            num_nodes INTEGER,
+            usage_intervals TEXT,
+            PRIMARY KEY (cluster_hash, launched_at)
+        )""")
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS config (
+            key TEXT PRIMARY KEY,
+            value TEXT
+        )""")
+    conn.commit()
+
+
+# --- clusters ---------------------------------------------------------------
+
+def add_or_update_cluster(cluster_name: str, handle: Any,
+                          requested_resources_str: str, num_nodes: int,
+                          ready: bool,
+                          autostop: Optional[Dict[str, Any]] = None,
+                          cluster_hash: Optional[str] = None) -> None:
+    conn = _get_conn()
+    status = ClusterStatus.UP if ready else ClusterStatus.INIT
+    now = int(time.time())
+    with _lock:
+        existing = conn.execute(
+            'SELECT launched_at FROM clusters WHERE name=?',
+            (cluster_name,)).fetchone()
+        launched_at = existing[0] if existing else now
+        conn.execute(
+            """INSERT INTO clusters
+               (name, launched_at, handle, last_use, status, autostop_json,
+                owner, cluster_hash, resources_json, num_nodes, to_down)
+               VALUES (?,?,?,?,?,?,?,?,?,?,?)
+               ON CONFLICT(name) DO UPDATE SET
+                 handle=excluded.handle, last_use=excluded.last_use,
+                 status=excluded.status,
+                 autostop_json=excluded.autostop_json,
+                 cluster_hash=excluded.cluster_hash,
+                 resources_json=excluded.resources_json,
+                 num_nodes=excluded.num_nodes""",
+            (cluster_name, launched_at, pickle.dumps(handle),
+             str(int(now)), status.value,
+             json.dumps(autostop) if autostop else None,
+             os.environ.get('USER', 'unknown'), cluster_hash,
+             requested_resources_str, num_nodes, 0))
+        conn.commit()
+
+
+def update_cluster_status(cluster_name: str,
+                          status: ClusterStatus) -> None:
+    conn = _get_conn()
+    with _lock:
+        conn.execute('UPDATE clusters SET status=? WHERE name=?',
+                     (status.value, cluster_name))
+        conn.commit()
+
+
+def update_cluster_handle(cluster_name: str, handle: Any) -> None:
+    conn = _get_conn()
+    with _lock:
+        conn.execute('UPDATE clusters SET handle=? WHERE name=?',
+                     (pickle.dumps(handle), cluster_name))
+        conn.commit()
+
+
+def update_last_use(cluster_name: str) -> None:
+    conn = _get_conn()
+    with _lock:
+        conn.execute('UPDATE clusters SET last_use=? WHERE name=?',
+                     (str(int(time.time())), cluster_name))
+        conn.commit()
+
+
+def set_autostop(cluster_name: str,
+                 autostop: Optional[Dict[str, Any]]) -> None:
+    conn = _get_conn()
+    with _lock:
+        conn.execute('UPDATE clusters SET autostop_json=? WHERE name=?',
+                     (json.dumps(autostop) if autostop else None,
+                      cluster_name))
+        conn.commit()
+
+
+def remove_cluster(cluster_name: str, terminate: bool) -> None:
+    conn = _get_conn()
+    with _lock:
+        if terminate:
+            row = conn.execute(
+                'SELECT launched_at, cluster_hash, resources_json, num_nodes'
+                ' FROM clusters WHERE name=?', (cluster_name,)).fetchone()
+            if row is not None and row[1] is not None:
+                conn.execute(
+                    """INSERT OR REPLACE INTO cluster_history
+                       (cluster_hash, name, launched_at, duration_s,
+                        resources_json, num_nodes, usage_intervals)
+                       VALUES (?,?,?,?,?,?,?)""",
+                    (row[1], cluster_name, row[0],
+                     time.time() - (row[0] or time.time()), row[2], row[3],
+                     None))
+            conn.execute('DELETE FROM clusters WHERE name=?',
+                         (cluster_name,))
+        else:
+            conn.execute(
+                'UPDATE clusters SET status=?, handle=handle WHERE name=?',
+                (ClusterStatus.STOPPED.value, cluster_name))
+        conn.commit()
+
+
+def _row_to_record(row) -> Dict[str, Any]:
+    (name, launched_at, handle_blob, last_use, status, autostop_json,
+     owner, cluster_hash, resources_json, num_nodes, to_down) = row
+    return {
+        'name': name,
+        'launched_at': launched_at,
+        'handle': pickle.loads(handle_blob) if handle_blob else None,
+        'last_use': last_use,
+        'status': ClusterStatus(status),
+        'autostop': json.loads(autostop_json) if autostop_json else None,
+        'owner': owner,
+        'cluster_hash': cluster_hash,
+        'resources_str': resources_json,
+        'num_nodes': num_nodes,
+        'to_down': bool(to_down),
+    }
+
+
+_COLS = ('name, launched_at, handle, last_use, status, autostop_json, '
+         'owner, cluster_hash, resources_json, num_nodes, to_down')
+
+
+def get_cluster_from_name(cluster_name: str) -> Optional[Dict[str, Any]]:
+    conn = _get_conn()
+    row = conn.execute(f'SELECT {_COLS} FROM clusters WHERE name=?',
+                       (cluster_name,)).fetchone()
+    return _row_to_record(row) if row else None
+
+
+def get_clusters() -> List[Dict[str, Any]]:
+    conn = _get_conn()
+    rows = conn.execute(
+        f'SELECT {_COLS} FROM clusters ORDER BY launched_at DESC').fetchall()
+    return [_row_to_record(r) for r in rows]
+
+
+def get_cluster_history() -> List[Dict[str, Any]]:
+    conn = _get_conn()
+    rows = conn.execute(
+        'SELECT cluster_hash, name, launched_at, duration_s, resources_json,'
+        ' num_nodes FROM cluster_history ORDER BY launched_at DESC'
+    ).fetchall()
+    return [{'cluster_hash': r[0], 'name': r[1], 'launched_at': r[2],
+             'duration_s': r[3], 'resources_str': r[4], 'num_nodes': r[5]}
+            for r in rows]

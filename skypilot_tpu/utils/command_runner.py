@@ -1,0 +1,216 @@
+"""Command runners — the control-plane communication backend.
+
+Reference analog: sky/utils/command_runner.py:168 (`CommandRunner`,
+`SSHCommandRunner` :439 with ControlMaster + proxy jump,
+`KubernetesCommandRunner` :716). Ours adds `LocalProcessRunner` so the
+local cloud exercises the identical interface with plain subprocesses.
+"""
+import os
+import shlex
+import subprocess
+import tempfile
+from typing import Dict, List, Optional, Tuple, Union
+
+from skypilot_tpu import exceptions
+
+_SSH_CONTROL_DIR = '~/.skytpu/ssh_control'
+
+
+def _write_log(log_path: Optional[str], data: bytes) -> None:
+    if not log_path:
+        return
+    os.makedirs(os.path.dirname(os.path.expanduser(log_path)) or '.',
+                exist_ok=True)
+    with open(os.path.expanduser(log_path), 'ab') as f:
+        f.write(data)
+
+
+class CommandRunner:
+    """Run shell commands and rsync files against one host."""
+
+    def __init__(self, node_id: str):
+        self.node_id = node_id
+
+    def run(self,
+            cmd: Union[str, List[str]],
+            *,
+            env: Optional[Dict[str, str]] = None,
+            stream_logs: bool = False,
+            log_path: Optional[str] = None,
+            cwd: Optional[str] = None,
+            require_outputs: bool = False,
+            timeout: Optional[float] = None
+            ) -> Union[int, Tuple[int, str, str]]:
+        raise NotImplementedError
+
+    def rsync(self, source: str, target: str, *, up: bool,
+              excludes: Optional[List[str]] = None,
+              log_path: Optional[str] = None) -> None:
+        raise NotImplementedError
+
+    def check_connection(self) -> bool:
+        try:
+            rc = self.run('true', timeout=15)
+            return rc == 0
+        except Exception:  # noqa: BLE001
+            return False
+
+    # --- shared subprocess plumbing ----------------------------------------
+
+    @staticmethod
+    def _run_subprocess(argv: List[str], *, env=None, stream_logs=False,
+                        log_path=None, cwd=None, require_outputs=False,
+                        timeout=None, shell=False):
+        stdout_chunks: List[bytes] = []
+        stderr_chunks: List[bytes] = []
+        proc = subprocess.Popen(
+            argv, shell=shell, cwd=cwd,
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT if stream_logs and not require_outputs
+            else subprocess.PIPE,
+            start_new_session=True)
+        try:
+            if stream_logs and not require_outputs:
+                assert proc.stdout is not None
+                for line in iter(proc.stdout.readline, b''):
+                    stdout_chunks.append(line)
+                    print(line.decode(errors='replace'), end='', flush=True)
+                    _write_log(log_path, line)
+                proc.wait(timeout=timeout)
+                out, err = b''.join(stdout_chunks), b''
+            else:
+                out, err = proc.communicate(timeout=timeout)
+                out = out or b''
+                err = err or b''
+                _write_log(log_path, out + err)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+            raise
+        if require_outputs:
+            return proc.returncode, out.decode(errors='replace'), \
+                err.decode(errors='replace')
+        return proc.returncode
+
+
+class LocalProcessRunner(CommandRunner):
+    """Run on this machine. Backs the `local` cloud."""
+
+    def __init__(self, node_id: str = 'localhost'):
+        super().__init__(node_id)
+
+    def run(self, cmd, *, env=None, stream_logs=False, log_path=None,
+            cwd=None, require_outputs=False, timeout=None):
+        if isinstance(cmd, list):
+            cmd = ' '.join(shlex.quote(c) for c in cmd)
+        full_env = dict(os.environ)
+        if env:
+            full_env.update(env)
+        return self._run_subprocess(
+            ['bash', '-c', cmd], env=full_env, stream_logs=stream_logs,
+            log_path=log_path, cwd=cwd, require_outputs=require_outputs,
+            timeout=timeout)
+
+    def rsync(self, source: str, target: str, *, up: bool,
+              excludes=None, log_path=None):
+        del up  # local: both directions identical
+        import shutil
+        source = os.path.expanduser(source)
+        target = os.path.expanduser(target)
+        os.makedirs(os.path.dirname(target.rstrip('/')) or '.',
+                    exist_ok=True)
+        if shutil.which('rsync'):
+            argv = ['rsync', '-a']
+            for e in excludes or []:
+                argv += ['--exclude', e]
+            argv += [source, target]
+            rc, out, err = self._run_subprocess(argv, require_outputs=True,
+                                                env=dict(os.environ))
+            if rc != 0:
+                raise exceptions.CommandError(rc, ' '.join(argv), err)
+            return
+        # Pure-python fallback (minimal images without rsync), keeping
+        # rsync's trailing-slash semantics.
+        ignore = (shutil.ignore_patterns(*excludes) if excludes else None)
+        if os.path.isdir(source):
+            if not source.endswith('/'):
+                target = os.path.join(target,
+                                      os.path.basename(source.rstrip('/')))
+            shutil.copytree(source, target, dirs_exist_ok=True,
+                            ignore=ignore)
+        else:
+            if target.endswith('/') or os.path.isdir(target):
+                os.makedirs(target, exist_ok=True)
+                target = os.path.join(target, os.path.basename(source))
+            shutil.copy2(source, target)
+
+
+class SSHCommandRunner(CommandRunner):
+    """SSH + rsync against a remote host, with connection multiplexing."""
+
+    def __init__(self, host: str, *, user: str,
+                 private_key: Optional[str] = None, port: int = 22,
+                 proxy_jump: Optional[str] = None):
+        super().__init__(f'{user}@{host}:{port}')
+        self.host = host
+        self.user = user
+        self.private_key = private_key
+        self.port = port
+        self.proxy_jump = proxy_jump
+
+    def _ssh_base(self) -> List[str]:
+        control_dir = os.path.expanduser(_SSH_CONTROL_DIR)
+        os.makedirs(control_dir, exist_ok=True)
+        opts = [
+            '-o', 'StrictHostKeyChecking=no',
+            '-o', 'UserKnownHostsFile=/dev/null',
+            '-o', 'LogLevel=ERROR',
+            '-o', 'IdentitiesOnly=yes',
+            '-o', 'ConnectTimeout=30',
+            '-o', 'ServerAliveInterval=5',
+            '-o', 'ServerAliveCountMax=3',
+            '-o', 'ControlMaster=auto',
+            '-o', f'ControlPath={control_dir}/%C',
+            '-o', 'ControlPersist=300s',
+            '-p', str(self.port),
+        ]
+        if self.private_key:
+            opts += ['-i', os.path.expanduser(self.private_key)]
+        if self.proxy_jump:
+            opts += ['-J', self.proxy_jump]
+        return ['ssh'] + opts + [f'{self.user}@{self.host}']
+
+    def run(self, cmd, *, env=None, stream_logs=False, log_path=None,
+            cwd=None, require_outputs=False, timeout=None):
+        if isinstance(cmd, list):
+            cmd = ' '.join(shlex.quote(c) for c in cmd)
+        prefix = ''
+        if env:
+            exports = ' '.join(f'export {k}={shlex.quote(str(v))};'
+                               for k, v in env.items())
+            prefix += exports
+        if cwd:
+            prefix += f'cd {shlex.quote(cwd)} && '
+        wrapped = f'bash --login -c {shlex.quote(prefix + cmd)}'
+        argv = self._ssh_base() + [wrapped]
+        return self._run_subprocess(
+            argv, env=dict(os.environ), stream_logs=stream_logs,
+            log_path=log_path, require_outputs=require_outputs,
+            timeout=timeout)
+
+    def rsync(self, source: str, target: str, *, up: bool,
+              excludes=None, log_path=None):
+        ssh_cmd = ' '.join(self._ssh_base()[:-1])  # drop user@host
+        argv = ['rsync', '-az', '-e', ssh_cmd]
+        for e in excludes or []:
+            argv += ['--exclude', e]
+        remote = f'{self.user}@{self.host}:{target}'
+        if up:
+            argv += [os.path.expanduser(source), remote]
+        else:
+            argv += [remote, os.path.expanduser(target)]
+        rc, out, err = self._run_subprocess(argv, require_outputs=True,
+                                            env=dict(os.environ))
+        if rc != 0:
+            raise exceptions.CommandError(rc, 'rsync', err)

@@ -1,0 +1,31 @@
+"""Canonical on-disk locations (client side and on-cluster runtime)."""
+import os
+
+
+def state_dir() -> str:
+    """Client-side state root (~/.skytpu or $SKYTPU_STATE_DIR)."""
+    d = os.environ.get('SKYTPU_STATE_DIR', os.path.expanduser('~/.skytpu'))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def state_db_path() -> str:
+    return os.path.join(state_dir(), 'state.db')
+
+
+def cluster_yaml_dir() -> str:
+    d = os.path.join(state_dir(), 'generated')
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def local_clusters_dir() -> str:
+    d = os.path.join(state_dir(), 'local_clusters')
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def client_logs_dir() -> str:
+    d = os.path.join(state_dir(), 'logs')
+    os.makedirs(d, exist_ok=True)
+    return d

@@ -9,6 +9,10 @@ import os
 
 # Must be set before any jax import anywhere in the test session.
 os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+# This machine's TPU tunnel registers a PJRT backend in sitecustomize at
+# EVERY interpreter start (~2.4s). Control-plane subprocesses (skylet,
+# gang driver) never touch jax; tests don't need the real chip.
+os.environ.pop('PALLAS_AXON_POOL_IPS', None)
 xla_flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in xla_flags:
     os.environ['XLA_FLAGS'] = (
