@@ -1,0 +1,4 @@
+"""Model zoo (TPU-native JAX). Flagship: llama."""
+from skypilot_tpu.models import llama
+
+__all__ = ['llama']

@@ -1,0 +1,10 @@
+"""TPU compute ops: attention variants (dense/blockwise/ring/flash)."""
+from skypilot_tpu.ops import attention  # submodule, keep unshadowed
+from skypilot_tpu.ops.attention import (blockwise_attention, dense_attention,
+                                        ring_attention)
+
+# Dispatching entry point (impl='dense'|'blockwise'|'ring'|'flash').
+attention_fn = attention.attention
+
+__all__ = ['attention', 'attention_fn', 'blockwise_attention',
+           'dense_attention', 'ring_attention']

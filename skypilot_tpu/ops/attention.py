@@ -1,0 +1,285 @@
+"""Attention ops: dense, blockwise (online softmax), and ring attention.
+
+The reference has NO sequence-length scaling machinery — long context is
+reached only through user recipes (SURVEY.md §2.11). Here it is a core
+op: `ring_attention` shards the sequence over the mesh's `context` axis
+and rotates KV blocks around the ring with `lax.ppermute`, overlapping
+ICI transfers with the per-block attention compute that XLA schedules on
+the MXU. All variants use the same online-softmax accumulator, so the
+ring result is bitwise-comparable to dense attention up to reduction
+order.
+
+Shapes (query-grouped attention throughout):
+  q: [batch, q_len, num_heads, head_dim]
+  k,v: [batch, kv_len, num_kv_heads, head_dim]
+Output: [batch, q_len, num_heads, head_dim]
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def _repeat_kv(kv: jax.Array, num_heads: int) -> jax.Array:
+    """[B,S,Hkv,D] → [B,S,H,D] by repeating each kv head H/Hkv times."""
+    b, s, hkv, d = kv.shape
+    if hkv == num_heads:
+        return kv
+    reps = num_heads // hkv
+    kv = jnp.broadcast_to(kv[:, :, :, None, :], (b, s, hkv, reps, d))
+    return kv.reshape(b, s, num_heads, d)
+
+
+def dense_attention(q: jax.Array,
+                    k: jax.Array,
+                    v: jax.Array,
+                    causal: bool = True,
+                    q_offset: int = 0,
+                    kv_offset: int = 0) -> jax.Array:
+    """Plain softmax attention; the correctness reference for the rest.
+
+    q_offset/kv_offset are the global positions of element 0 — needed
+    when sequence is sharded and this rank sees only a slice.
+    """
+    num_heads = q.shape[2]
+    k = _repeat_kv(k, num_heads)
+    v = _repeat_kv(v, num_heads)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum('bqhd,bkhd->bhqk', q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        k_pos = kv_offset + jnp.arange(k.shape[1])
+        mask = q_pos[:, None] >= k_pos[None, :]
+        scores = jnp.where(mask[None, None], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum('bhqk,bkhd->bqhd', probs, v)
+
+
+def _block_update(q, k, v, scores_mask, acc_o, acc_m, acc_l):
+    """One online-softmax step: fold a KV block into the accumulators.
+
+    acc_o: [B,Q,H,D] f32 weighted values; acc_m/acc_l: [B,H,Q] f32
+    running max / normalizer.
+    """
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum('bqhd,bkhd->bhqk', q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if scores_mask is not None:
+        scores = jnp.where(scores_mask, scores, _NEG_INF)
+    block_max = jnp.max(scores, axis=-1)
+    new_m = jnp.maximum(acc_m, block_max)
+    # safe_m: when every key seen so far is masked, new_m is still
+    # _NEG_INF; subtracting it would turn exp(-inf - -inf) into 1s.
+    # Shift by 0 instead so fully-masked rows keep probs == 0.
+    safe_m = jnp.where(new_m <= _NEG_INF * 0.5, 0.0, new_m)
+    probs = jnp.exp(scores - safe_m[..., None])
+    correction = jnp.exp(acc_m - safe_m)
+    new_l = acc_l * correction + jnp.sum(probs, axis=-1)
+    pv = jnp.einsum('bhqk,bkhd->bqhd', probs.astype(v.dtype), v,
+                    preferred_element_type=jnp.float32)
+    new_o = acc_o * jnp.transpose(correction, (0, 2, 1))[..., None] + pv
+    return new_o, new_m, new_l
+
+
+def _finalize(acc_o, acc_m, acc_l, dtype):
+    norm = jnp.transpose(acc_l, (0, 2, 1))[..., None]
+    norm = jnp.where(norm == 0.0, 1.0, norm)
+    return (acc_o / norm).astype(dtype)
+
+
+def blockwise_attention(q: jax.Array,
+                        k: jax.Array,
+                        v: jax.Array,
+                        causal: bool = True,
+                        block_size: int = 512,
+                        q_offset: int = 0,
+                        kv_offset: int = 0) -> jax.Array:
+    """Memory-efficient attention: scan over KV blocks, never
+    materializing the full [Q,K] score matrix. O(S) memory in sequence.
+    """
+    b, q_len, num_heads, d = q.shape
+    kv_len = k.shape[1]
+    k = _repeat_kv(k, num_heads)
+    v = _repeat_kv(v, num_heads)
+    block_size = min(block_size, kv_len)
+    num_blocks = -(-kv_len // block_size)
+    pad = num_blocks * block_size - kv_len
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    k_blocks = k.reshape(b, num_blocks, block_size, num_heads, d)
+    v_blocks = v.reshape(b, num_blocks, block_size, num_heads, d)
+
+    q_pos = q_offset + jnp.arange(q_len)
+
+    def body(carry, blk):
+        acc_o, acc_m, acc_l = carry
+        blk_idx, k_blk, v_blk = blk
+        k_pos = kv_offset + blk_idx * block_size + jnp.arange(block_size)
+        mask = k_pos[None, :] < kv_offset + kv_len  # padding mask
+        if causal:
+            mask = mask & (q_pos[:, None] >= k_pos[None, :])
+        else:
+            mask = jnp.broadcast_to(mask, (q_len, block_size))
+        carry = _block_update(q, k_blk, v_blk, mask[None, None], acc_o,
+                              acc_m, acc_l)
+        return carry, None
+
+    acc = (jnp.zeros((b, q_len, num_heads, d), jnp.float32),
+           jnp.full((b, num_heads, q_len), _NEG_INF, jnp.float32),
+           jnp.zeros((b, num_heads, q_len), jnp.float32))
+    xs = (jnp.arange(num_blocks),
+          jnp.moveaxis(k_blocks, 1, 0), jnp.moveaxis(v_blocks, 1, 0))
+    (acc_o, acc_m, acc_l), _ = lax.scan(body, acc, xs)
+    return _finalize(acc_o, acc_m, acc_l, q.dtype)
+
+
+def ring_attention(q: jax.Array,
+                   k: jax.Array,
+                   v: jax.Array,
+                   mesh: Any,
+                   axis: str = 'context',
+                   causal: bool = True,
+                   block_size: int = 512) -> jax.Array:
+    """Ring attention over the mesh's sequence-parallel axis.
+
+    Inputs are GLOBAL arrays whose seq dim is (or will be) sharded over
+    `axis`; inside shard_map each rank holds one contiguous slice.
+    Every step each rank attends q_local × kv_block then ppermutes the
+    KV block (and its global offset) to the next rank — after
+    ring_size steps every rank has seen the full sequence. Transfers are
+    neighbor-only, so they ride ICI at full bandwidth.
+
+    Design follows the public blockwise/ring-attention formulation
+    (Liu et al.; see PAPERS.md) — not the reference, which has no such
+    machinery (SURVEY.md §2.11: 'Not implemented anywhere in-tree').
+    """
+    from jax.sharding import PartitionSpec as P
+
+    ring_size = mesh.shape[axis]
+    if ring_size == 1:
+        return blockwise_attention(q, k, v, causal=causal,
+                                   block_size=block_size)
+    seq_len = q.shape[1]
+    if seq_len % ring_size:
+        raise ValueError(f'seq_len {seq_len} % ring {ring_size} != 0')
+    local_len = seq_len // ring_size
+    # Sub-block each ring step so per-step score matrices stay
+    # [local_len, sub_len] regardless of chunk size.
+    sub_len = block_size if local_len % block_size == 0 else local_len
+    n_sub = local_len // sub_len
+
+    # Partition batch over the data axes and heads over tensor, matching
+    # DEFAULT_RULES — otherwise shard_map would gather the full global
+    # batch onto every rank. Fall back to replication per-dim when the
+    # (static) shape doesn't divide the mesh axes (small test inputs).
+    batch_axes = tuple(a for a in ('data', 'fsdp') if a in mesh.shape)
+    batch_div = math.prod(mesh.shape[a] for a in batch_axes) or 1
+    if q.shape[0] % batch_div:
+        batch_axes = ()
+    head_axis = 'tensor' if 'tensor' in mesh.shape else None
+    if head_axis and (q.shape[2] % mesh.shape[head_axis]
+                      or k.shape[2] % mesh.shape[head_axis]):
+        head_axis = None
+    qspec = P(batch_axes or None, axis, head_axis, None)
+
+    def local_fn(q_loc, k_loc, v_loc):
+        my_idx = lax.axis_index(axis)
+        q_off = my_idx * local_len
+        b, _, num_heads, d = q_loc.shape
+        q_pos = q_off + jnp.arange(local_len)
+
+        def fold_chunk(acc_o, acc_m, acc_l, k_blk, v_blk, kv_off):
+            """Online-softmax the whole received chunk, sub-block at a
+            time (inner scan keeps memory at [local_len, sub_len])."""
+            k_sub = k_blk.reshape(b, n_sub, sub_len, *k_blk.shape[2:])
+            v_sub = v_blk.reshape(b, n_sub, sub_len, *v_blk.shape[2:])
+
+            def sub_body(carry, idx_kv):
+                acc_o, acc_m, acc_l = carry
+                s_idx, k_s, v_s = idx_kv
+                k_pos = kv_off + s_idx * sub_len + jnp.arange(sub_len)
+                if causal:
+                    mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
+                else:
+                    mask = None
+                # Repeat GQA heads only for local compute; the ring
+                # carries compact kv so ICI traffic stays kv-sized.
+                carry = _block_update(
+                    q_loc, _repeat_kv(k_s, num_heads),
+                    _repeat_kv(v_s, num_heads), mask, acc_o, acc_m,
+                    acc_l)
+                return carry, None
+
+            xs = (jnp.arange(n_sub), jnp.moveaxis(k_sub, 1, 0),
+                  jnp.moveaxis(v_sub, 1, 0))
+            (acc_o, acc_m, acc_l), _ = lax.scan(
+                sub_body, (acc_o, acc_m, acc_l), xs)
+            return acc_o, acc_m, acc_l
+
+        def body(carry, _):
+            acc_o, acc_m, acc_l, k_blk, v_blk, blk_idx = carry
+            # Masking uses GLOBAL positions (f32 accumulators keep the
+            # softmax exact across the ring).
+            acc_o, acc_m, acc_l = fold_chunk(
+                acc_o, acc_m, acc_l, k_blk, v_blk, blk_idx * local_len)
+            perm = [(i, (i + 1) % ring_size) for i in range(ring_size)]
+            k_blk = lax.ppermute(k_blk, axis, perm)
+            v_blk = lax.ppermute(v_blk, axis, perm)
+            blk_idx = lax.ppermute(blk_idx, axis, perm)
+            return (acc_o, acc_m, acc_l, k_blk, v_blk, blk_idx), None
+
+        # pvary: mark the zero-init accumulators as device-varying over
+        # every mesh axis the inputs vary over, so scan's carry typing
+        # matches (jax>=0.7 varying-manual-axes).
+        vary = tuple(a for a in (*batch_axes, axis, head_axis) if a)
+        acc = (lax.pvary(jnp.zeros((b, local_len, num_heads, d),
+                                   jnp.float32), vary),
+               lax.pvary(jnp.full((b, num_heads, local_len), _NEG_INF,
+                                  jnp.float32), vary),
+               lax.pvary(jnp.zeros((b, num_heads, local_len),
+                                   jnp.float32), vary),
+               k_loc, v_loc, my_idx)
+        (acc_o, acc_m, acc_l, *_), _ = lax.scan(
+            body, acc, None, length=ring_size)
+        return _finalize(acc_o, acc_m, acc_l, q_loc.dtype)
+
+    shard_mapped = jax.shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(qspec, qspec, qspec),
+        out_specs=qspec)
+    return shard_mapped(q, k, v)
+
+
+def attention(q: jax.Array,
+              k: jax.Array,
+              v: jax.Array,
+              causal: bool = True,
+              impl: str = 'dense',
+              mesh: Optional[Any] = None,
+              block_size: int = 512) -> jax.Array:
+    """Dispatch: 'dense' | 'blockwise' | 'ring' | 'flash' (TPU pallas)."""
+    if impl == 'ring':
+        if mesh is None:
+            raise ValueError('ring attention requires a mesh')
+        return ring_attention(q, k, v, mesh, causal=causal,
+                              block_size=block_size)
+    if impl == 'blockwise':
+        return blockwise_attention(q, k, v, causal=causal,
+                                   block_size=block_size)
+    if impl == 'flash':
+        from skypilot_tpu.ops import flash_attention as fa
+        return fa.flash_attention(q, k, v, causal=causal)
+    if impl == 'dense':
+        return dense_attention(q, k, v, causal=causal)
+    raise ValueError(
+        f'Unknown attention impl {impl!r}; '
+        "expected 'dense' | 'blockwise' | 'ring' | 'flash'")

@@ -1,0 +1,163 @@
+"""Fused flash attention as a Pallas TPU kernel.
+
+Forward pass is a hand-written kernel: grid over (batch, head, q-block,
+kv-block), online-softmax accumulators live in VMEM scratch that
+persists across the sequential innermost grid dimension (TPU grids are
+sequential, so the kv loop accumulates in-place), and the [bq, bk] score
+tile never leaves VMEM — HBM traffic is O(S·D) instead of O(S²).
+
+Backward uses a custom VJP that recomputes attention blockwise — flash
+memory behavior (no stored probs) at the cost of one recompute, matching
+`jax.checkpoint` economics. A dedicated backward kernel is a later
+optimization.
+
+GQA is folded into the index maps: kv blocks for head h come from kv
+head h // (num_heads // num_kv_heads), so no materialized repeat.
+
+No reference equivalent (SkyPilot ships no kernels; SURVEY.md §2.11).
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                causal: bool, scale: float, bq: int, bk: int,
+                n_kv_blocks: int):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q_start = iq * bq
+    k_start = ik * bk
+
+    def _compute():
+        # Keep operands in their native (bf16) dtype so the MXU runs at
+        # full rate; accumulate f32 via preferred_element_type.
+        q = q_ref[0, 0]                               # [bq, d]
+        k = k_ref[0, 0]                               # [bk, d]
+        v = v_ref[0, 0]                               # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk] f32
+        if causal:
+            q_pos = q_start + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            k_pos = k_start + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_prev = m_ref[:, :1]                         # [bq, 1]
+        m_blk = jnp.max(s, axis=1, keepdims=True)     # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_blk)
+        safe_m = jnp.where(m_new <= _NEG_INF * 0.5, 0.0, m_new)
+        p = jnp.exp(s - safe_m)                       # [bq, bk]
+        correction = jnp.exp(m_prev - safe_m)         # [bq, 1]
+        l_ref[:] = (l_ref[:] * correction +
+                    jnp.sum(p, axis=1, keepdims=True))
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bq, d]
+        acc_ref[:] = acc_ref[:] * correction + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    if causal:
+        # Skip kv blocks strictly above the causal diagonal.
+        pl.when(k_start < q_start + bq)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ik == n_kv_blocks - 1)
+    def _finalize():
+        norm = l_ref[:]
+        norm = jnp.where(norm == 0.0, 1.0, norm)
+        o_ref[0, 0] = (acc_ref[:] / norm).astype(o_ref.dtype)
+
+
+def _flash_fwd_impl(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool, block_q: int, block_k: int,
+                    interpret: bool) -> jax.Array:
+    b, s_q, h, d = q.shape
+    s_kv, h_kv = k.shape[1], k.shape[2]
+    group = h // h_kv
+    bq = min(block_q, s_q)
+    bk = min(block_k, s_kv)
+    if s_q % bq or s_kv % bk:
+        raise ValueError(f'seq lens ({s_q},{s_kv}) must divide block '
+                         f'sizes ({bq},{bk})')
+    n_q, n_k = s_q // bq, s_kv // bk
+    scale = 1.0 / math.sqrt(d)
+
+    # [B,S,H,D] → [B,H,S,D]: the kernel tiles (seq, head_dim).
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+
+    kernel = functools.partial(
+        _fwd_kernel, causal=causal, scale=scale, bq=bq, bk=bk,
+        n_kv_blocks=n_k)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, h, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik:
+                         (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, iq, ik:
+                         (b_, h_ // group, ik, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, iq, ik:
+                         (b_, h_ // group, ik, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik:
+                               (b_, h_, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, s_q, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != 'tpu'
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True, block_q: int = 512,
+                    block_k: int = 512) -> jax.Array:
+    """Flash attention. q:[B,Sq,H,D], k/v:[B,Skv,Hkv,D] → [B,Sq,H,D]."""
+    return _flash_fwd_impl(q, k, v, causal, block_q, block_k,
+                           interpret=_use_interpret())
+
+
+def _fwd(q, k, v, causal, block_q, block_k):
+    out = _flash_fwd_impl(q, k, v, causal, block_q, block_k,
+                          interpret=_use_interpret())
+    return out, (q, k, v)
+
+
+def _bwd(causal, block_q, block_k, res, g):
+    from skypilot_tpu.ops import attention as attention_ops
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: attention_ops.blockwise_attention(
+            q_, k_, v_, causal=causal, block_size=block_k), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)

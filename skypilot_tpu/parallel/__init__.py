@@ -1,0 +1,17 @@
+"""TPU parallelism layer: mesh construction + logical sharding rules.
+
+First-class in this framework (the reference delegates all parallelism to
+user recipes via env vars — SURVEY.md §2.11).
+"""
+from skypilot_tpu.parallel.mesh import (AXIS_ORDER, MeshSpec, use_mesh,
+                                        initialize_distributed,
+                                        make_hybrid_mesh, make_mesh,
+                                        mesh_from_env)
+from skypilot_tpu.parallel.sharding import (DEFAULT_RULES, named_sharding,
+                                            shard, spec_for, tree_shardings)
+
+__all__ = [
+    'AXIS_ORDER', 'MeshSpec', 'initialize_distributed', 'make_hybrid_mesh',
+    'make_mesh', 'mesh_from_env', 'use_mesh', 'DEFAULT_RULES', 'named_sharding', 'shard',
+    'spec_for', 'tree_shardings',
+]

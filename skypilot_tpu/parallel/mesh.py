@@ -1,0 +1,223 @@
+"""Device-mesh construction for TPU-first parallelism.
+
+The reference (SkyPilot) has no in-tree parallelism machinery — its
+recipes export `SKYPILOT_NODE_*` env vars and let torchrun/NCCL assemble
+the job (reference: sky/backends/cloud_vm_ray_backend.py:606-670). Here
+parallelism is a first-class library: a `MeshSpec` names the axes, this
+module turns it into a `jax.sharding.Mesh` laid out so that the
+bandwidth-hungry axes (tensor, context) ride ICI and only the data axis
+crosses DCN slice boundaries.
+
+Axes (in fixed order, outermost → innermost):
+  data    — pure data parallel; gradients all-reduced.
+  fsdp    — data parallel with fully-sharded params (ZeRO-3 style).
+  expert  — expert parallel for MoE layers (all_to_all dispatch).
+  context — sequence/context parallel (ring attention over this axis).
+  tensor  — megatron-style tensor parallel (activations all-reduced).
+
+The innermost axes get the most ICI locality from
+`mesh_utils.create_device_mesh`, which is why tensor/context sit last.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+AXIS_ORDER = ('data', 'fsdp', 'expert', 'context', 'tensor')
+
+# Aliases accepted from YAML / CLI knobs.
+_AXIS_ALIASES = {
+    'dp': 'data',
+    'data_parallel': 'data',
+    'zero': 'fsdp',
+    'fsdp_parallel': 'fsdp',
+    'ep': 'expert',
+    'expert_parallel': 'expert',
+    'sp': 'context',
+    'cp': 'context',
+    'sequence': 'context',
+    'context_parallel': 'context',
+    'ring': 'context',
+    'tp': 'tensor',
+    'model': 'tensor',
+    'tensor_parallel': 'tensor',
+}
+
+
+def canonical_axis(name: str) -> str:
+    name = name.lower()
+    name = _AXIS_ALIASES.get(name, name)
+    if name not in AXIS_ORDER:
+        raise ValueError(
+            f'Unknown mesh axis {name!r}; valid: {AXIS_ORDER} '
+            f'(aliases: {sorted(_AXIS_ALIASES)})')
+    return name
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Named parallelism degrees. -1 on at most one axis means "fill".
+
+    Examples:
+        MeshSpec(fsdp=-1)                      # pure FSDP over all chips
+        MeshSpec(data=2, fsdp=4, tensor=4)     # 32-chip 3D mesh
+        MeshSpec.from_dict({'dp': 2, 'tp': 8})
+    """
+    data: int = 1
+    fsdp: int = -1
+    expert: int = 1
+    context: int = 1
+    tensor: int = 1
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, int]) -> 'MeshSpec':
+        kwargs: Dict[str, int] = {}
+        for key, value in d.items():
+            axis = canonical_axis(key)
+            if axis in kwargs and kwargs[axis] != int(value):
+                raise ValueError(f'Axis {axis!r} specified twice via aliases')
+            kwargs[axis] = int(value)
+        return cls(**kwargs)
+
+    def sizes(self) -> Dict[str, int]:
+        return {axis: getattr(self, axis) for axis in AXIS_ORDER}
+
+    def resolve(self, n_devices: int) -> 'MeshSpec':
+        """Fill the single -1 axis so the product equals n_devices."""
+        sizes = self.sizes()
+        fill_axes = [a for a, s in sizes.items() if s == -1]
+        if len(fill_axes) > 1:
+            raise ValueError(f'At most one -1 axis allowed, got {fill_axes}')
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if fill_axes:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f'{n_devices} devices not divisible by fixed axes '
+                    f'product {fixed} ({sizes})')
+            sizes[fill_axes[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f'Mesh {sizes} needs {fixed} devices, have {n_devices}')
+        return MeshSpec(**sizes)
+
+    def axis_names(self) -> Tuple[str, ...]:
+        return AXIS_ORDER
+
+    def shape(self) -> Tuple[int, ...]:
+        sizes = self.sizes()
+        if any(s == -1 for s in sizes.values()):
+            raise ValueError('Call resolve() before shape()')
+        return tuple(sizes[a] for a in AXIS_ORDER)
+
+
+def make_mesh(spec: MeshSpec,
+              devices: Optional[Sequence[Any]] = None) -> Any:
+    """Build a `jax.sharding.Mesh` honoring TPU ICI topology.
+
+    `mesh_utils.create_device_mesh` places the trailing (fastest-varying)
+    mesh axes on physically adjacent chips, so tensor/context collectives
+    ride ICI neighbors. Falls back to a plain reshape off-TPU (CPU test
+    meshes have no topology).
+    """
+    import jax
+    from jax.experimental import mesh_utils
+
+    if devices is None:
+        devices = jax.devices()
+    spec = spec.resolve(len(devices))
+    shape = spec.shape()
+    try:
+        device_array = mesh_utils.create_device_mesh(
+            shape, devices=list(devices))
+    except (ValueError, AssertionError, NotImplementedError):
+        import numpy as np
+        device_array = np.asarray(list(devices)).reshape(shape)
+    return jax.sharding.Mesh(device_array, spec.axis_names())
+
+
+def make_hybrid_mesh(spec: MeshSpec,
+                     num_slices: int,
+                     devices: Optional[Sequence[Any]] = None) -> Any:
+    """Multi-slice mesh: `data` spans DCN (slices), the rest stay on ICI.
+
+    Mirrors `mesh_utils.create_hybrid_device_mesh`: the data axis is the
+    only one allowed to cross the slow DCN boundary, matching how the
+    provisioner wires MEGASCALE_* coordinates (skylet/constants.py:28).
+    """
+    import jax
+    from jax.experimental import mesh_utils
+
+    if devices is None:
+        devices = jax.devices()
+    spec = spec.resolve(len(devices))
+    sizes = spec.sizes()
+    if sizes['data'] % num_slices != 0:
+        raise ValueError(
+            f"data axis ({sizes['data']}) must be a multiple of "
+            f'num_slices ({num_slices}) — only data parallel crosses DCN')
+    ici_shape = list(spec.shape())
+    dcn_shape = [1] * len(ici_shape)
+    dcn_shape[0] = num_slices
+    ici_shape[0] = sizes['data'] // num_slices
+    try:
+        device_array = mesh_utils.create_hybrid_device_mesh(
+            tuple(ici_shape), tuple(dcn_shape), devices=list(devices))
+    except (ValueError, AssertionError, NotImplementedError, KeyError):
+        import numpy as np
+        device_array = np.asarray(list(devices)).reshape(spec.shape())
+    return jax.sharding.Mesh(device_array, spec.axis_names())
+
+
+def use_mesh(mesh: Any):
+    """Context manager setting the ambient mesh (jax version compat)."""
+    import jax
+    if hasattr(jax.sharding, 'use_mesh'):
+        return jax.sharding.use_mesh(mesh)
+    if hasattr(jax, 'set_mesh'):
+        return jax.set_mesh(mesh)  # jax>=0.7: context manager form
+    return mesh  # Mesh is itself a context manager
+
+
+def initialize_distributed(coordinator: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> bool:
+    """`jax.distributed.initialize` from SKYTPU_* gang coordinates.
+
+    The gang driver (skylet/gang.py) injects SKYTPU_COORDINATOR_ADDR /
+    NUM_PROCESSES / PROCESS_ID on every host — the TPU-native analog of
+    the reference's SKYPILOT_NODE_RANK-for-torchrun contract. Returns
+    False (no-op) for single-process jobs so the same program runs
+    unmodified on one host.
+    """
+    from skypilot_tpu.skylet import constants
+
+    coordinator = coordinator or os.environ.get(constants.ENV_COORDINATOR)
+    if num_processes is None:
+        num_processes = int(
+            os.environ.get(constants.ENV_NUM_PROCESSES, '1'))
+    if process_id is None:
+        process_id = int(os.environ.get(constants.ENV_PROCESS_ID, '0'))
+    if num_processes <= 1 or not coordinator:
+        return False
+    import jax
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return True
+
+
+def mesh_from_env(spec: Optional[MeshSpec] = None) -> Any:
+    """One-call bootstrap: init jax.distributed (if gang) then build the
+    mesh over all global devices, hybrid across slices when MEGASCALE
+    coordinates are present."""
+    from skypilot_tpu.skylet import constants
+
+    initialize_distributed()
+    import jax
+    spec = spec or MeshSpec()
+    num_slices = int(os.environ.get(constants.ENV_MEGASCALE_NUM_SLICES, '1'))
+    if num_slices > 1:
+        return make_hybrid_mesh(spec, num_slices)
+    return make_mesh(spec)

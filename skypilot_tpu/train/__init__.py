@@ -1,0 +1,4 @@
+"""Training: sharded trainer + MFU accounting."""
+from skypilot_tpu.train import trainer
+
+__all__ = ['trainer']

@@ -1,0 +1,153 @@
+"""Training loop: sharded init, jitted train step, MFU accounting.
+
+The TPU-native replacement for the reference's 'finetuning recipe shells
+out to MaxText/DeepSpeed' pattern (reference: llm/llama-3_1-finetuning,
+examples/deepspeed-multinode — orchestration-only, SURVEY.md §2.11).
+Everything here is mesh-parametric: the same step runs single-chip, a
+v5p pod (FSDP+TP), or multi-slice (hybrid mesh, DP over DCN).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.models import llama
+import skypilot_tpu.parallel as parallel
+from skypilot_tpu.parallel import sharding
+
+
+@dataclasses.dataclass
+class TrainerConfig:
+    model: str = 'tiny'
+    learning_rate: float = 3e-4
+    weight_decay: float = 0.1
+    warmup_steps: int = 100
+    max_steps: int = 1000
+    batch_size: int = 8          # global
+    seq_len: int = 512
+    grad_clip: float = 1.0
+
+    def model_config(self) -> llama.LlamaConfig:
+        return llama.CONFIGS[self.model]
+
+
+def make_optimizer(cfg: TrainerConfig):
+    import optax
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=cfg.learning_rate,
+        warmup_steps=cfg.warmup_steps,
+        decay_steps=max(cfg.max_steps, cfg.warmup_steps + 1))
+    return optax.chain(
+        optax.clip_by_global_norm(cfg.grad_clip),
+        optax.adamw(schedule, b1=0.9, b2=0.95,
+                    weight_decay=cfg.weight_decay),
+    )
+
+
+def batch_shardings(mesh: Any) -> Dict[str, Any]:
+    return {
+        'tokens': sharding.named_sharding(mesh, ('batch', 'seq')),
+        'mask': sharding.named_sharding(mesh, ('batch', 'seq')),
+    }
+
+
+def make_train_state(cfg: TrainerConfig, mesh: Any,
+                     key: Optional[jax.Array] = None) -> Dict[str, Any]:
+    """Init params + opt state DIRECTLY sharded (never materialized on
+    one device): jit with out_shardings does the placement."""
+    mcfg = cfg.model_config()
+    key = key if key is not None else jax.random.key(0)
+    optimizer = make_optimizer(cfg)
+
+    logical = llama.param_logical_axes(mcfg)
+    param_sh = sharding.tree_shardings(mesh, logical)
+
+    with parallel.use_mesh(mesh):
+        params = jax.jit(
+            functools.partial(llama.init_params, mcfg),
+            out_shardings=param_sh)(key)
+        opt_state = jax.jit(
+            optimizer.init,
+            # optimizer state mirrors param sharding where shaped like
+            # params; scalars replicate (jit infers from input sharding).
+        )(params)
+    return {'params': params, 'opt_state': opt_state,
+            'step': jnp.zeros((), jnp.int32)}
+
+
+def make_train_step(cfg: TrainerConfig,
+                    mesh: Any) -> Callable[[Dict[str, Any], Dict[str, Any]],
+                                           Tuple[Dict[str, Any], Dict[str, Any]]]:
+    """Returns jitted (state, batch) → (state, metrics)."""
+    mcfg = cfg.model_config()
+    optimizer = make_optimizer(cfg)
+
+    def step_fn(state, batch):
+        import optax
+        params = state['params']
+        loss, grads = jax.value_and_grad(llama.loss_fn)(
+            params, batch, mcfg, mesh)
+        updates, opt_state = optimizer.update(
+            grads, state['opt_state'], params)
+        params = optax.apply_updates(params, updates)
+        metrics = {
+            'loss': loss,
+            'grad_norm': optax.global_norm(grads),
+            'step': state['step'] + 1,
+        }
+        return {'params': params, 'opt_state': opt_state,
+                'step': state['step'] + 1}, metrics
+
+    return jax.jit(step_fn, donate_argnums=(0,))
+
+
+def synthetic_batch(cfg: TrainerConfig, mesh: Any,
+                    key: Optional[jax.Array] = None) -> Dict[str, Any]:
+    """Random-token batch laid out with the right sharding (bench/tests)."""
+    mcfg = cfg.model_config()
+    key = key if key is not None else jax.random.key(1)
+    sh = batch_shardings(mesh)
+    with parallel.use_mesh(mesh):
+        tokens = jax.jit(
+            lambda k: jax.random.randint(
+                k, (cfg.batch_size, cfg.seq_len), 0, mcfg.vocab_size,
+                jnp.int32),
+            out_shardings=sh['tokens'])(key)
+        mask = jax.jit(
+            lambda: jnp.ones((cfg.batch_size, cfg.seq_len), jnp.float32),
+            out_shardings=sh['mask'])()
+    return {'tokens': tokens, 'mask': mask}
+
+
+def mfu(tokens_per_sec: float, config: llama.LlamaConfig, seq_len: int,
+        peak_flops_per_chip: float, num_chips: int = 1) -> float:
+    """Model FLOPs utilization against the chip's peak."""
+    achieved = tokens_per_sec * config.flops_per_token(seq_len)
+    return achieved / (peak_flops_per_chip * num_chips)
+
+
+# Peak bf16 FLOPs/s per chip (public spec sheets).
+PEAK_FLOPS = {
+    'v4': 275e12,
+    'v5e': 197e12,
+    'v5p': 459e12,
+    'v6e': 918e12,
+    'cpu': 1e12,  # arbitrary for tests
+}
+
+
+def detect_chip() -> str:
+    d = jax.devices()[0]
+    kind = getattr(d, 'device_kind', '').lower()
+    for name in ('v6e', 'v5p', 'v5e', 'v4'):
+        if name in kind:
+            return name
+    if 'tpu v6' in kind:
+        return 'v6e'
+    if 'tpu v5 lite' in kind or 'v5litepod' in kind:
+        return 'v5e'
+    return 'cpu' if d.platform == 'cpu' else 'v5e'

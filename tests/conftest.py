@@ -7,16 +7,27 @@ cloud.
 """
 import os
 
-# Must be set before any jax import anywhere in the test session.
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
-# This machine's TPU tunnel registers a PJRT backend in sitecustomize at
-# EVERY interpreter start (~2.4s). Control-plane subprocesses (skylet,
-# gang driver) never touch jax; tests don't need the real chip.
+# Tests run on a virtual 8-device CPU mesh, never the real chip.
+# The axon sitecustomize sets JAX_PLATFORMS=axon AND initializes the
+# TPU backend at interpreter start, so env vars alone are too late —
+# re-point the env and clear the already-initialized backends.
+os.environ['JAX_PLATFORMS'] = 'cpu'
+# Keep control-plane subprocesses (skylet, gang driver) off the tunnel.
 os.environ.pop('PALLAS_AXON_POOL_IPS', None)
 xla_flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in xla_flags:
     os.environ['XLA_FLAGS'] = (
         xla_flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import jax
+
+jax.config.update('jax_platforms', 'cpu')
+try:
+    from jax.extend import backend as _jexb
+    _jexb.clear_backends()
+except Exception:  # pragma: no cover - older jax
+    jax.clear_backends()
+assert jax.devices()[0].platform == 'cpu'
 
 import pytest
 

@@ -1,0 +1,143 @@
+"""Attention op correctness: blockwise + ring vs dense reference."""
+import jax
+import numpy as np
+import pytest
+
+from skypilot_tpu.ops import attention as attention_ops
+from skypilot_tpu.parallel import MeshSpec, make_mesh
+
+B, S, H, KV, D = 2, 64, 4, 2, 16
+
+
+@pytest.fixture(scope='module')
+def qkv():
+    q = jax.random.normal(jax.random.key(1), (B, S, H, D))
+    k = jax.random.normal(jax.random.key(2), (B, S, KV, D))
+    v = jax.random.normal(jax.random.key(3), (B, S, KV, D))
+    return q, k, v
+
+
+@pytest.mark.parametrize('causal', [True, False])
+def test_blockwise_matches_dense(qkv, causal):
+    q, k, v = qkv
+    dense = attention_ops.dense_attention(q, k, v, causal=causal)
+    block = attention_ops.blockwise_attention(q, k, v, causal=causal,
+                                              block_size=16)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(block),
+                               atol=2e-5)
+
+
+def test_blockwise_ragged_blocks(qkv):
+    q, k, v = qkv
+    dense = attention_ops.dense_attention(q, k, v)
+    block = attention_ops.blockwise_attention(q, k, v, block_size=24)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(block),
+                               atol=2e-5)
+
+
+@pytest.mark.parametrize('ring_size', [2, 4, 8])
+@pytest.mark.parametrize('causal', [True, False])
+def test_ring_matches_dense(qkv, ring_size, causal):
+    q, k, v = qkv
+    spec = MeshSpec(data=8 // ring_size, fsdp=1, context=ring_size)
+    mesh = make_mesh(spec)
+    dense = attention_ops.dense_attention(q, k, v, causal=causal)
+    ring = attention_ops.ring_attention(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(ring),
+                               atol=2e-5)
+
+
+def test_ring_size_one_falls_back(qkv):
+    q, k, v = qkv
+    mesh = make_mesh(MeshSpec(data=8, fsdp=1, context=1))
+    out = attention_ops.ring_attention(q, k, v, mesh)
+    dense = attention_ops.dense_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(out),
+                               atol=2e-5)
+
+
+def test_ring_uneven_seq_raises(qkv):
+    q = jax.random.normal(jax.random.key(1), (B, 63, H, D))
+    k = jax.random.normal(jax.random.key(2), (B, 63, KV, D))
+    mesh = make_mesh(MeshSpec(data=4, fsdp=1, context=2))
+    with pytest.raises(ValueError):
+        attention_ops.ring_attention(q, k, k, mesh)
+
+
+def test_dispatch_requires_mesh_for_ring(qkv):
+    q, k, v = qkv
+    with pytest.raises(ValueError):
+        attention_ops.attention(q, k, v, impl='ring')
+
+
+def test_offsets_shift_mask():
+    """q_offset lets a rank that holds a later slice mask correctly."""
+    q = jax.random.normal(jax.random.key(1), (1, 8, 2, 8))
+    k = jax.random.normal(jax.random.key(2), (1, 16, 2, 8))
+    v = jax.random.normal(jax.random.key(3), (1, 16, 2, 8))
+    # q holds global positions 8..15 of the same sequence as k/v 0..15.
+    full_q = jax.random.normal(jax.random.key(4), (1, 16, 2, 8))
+    full_q = full_q.at[:, 8:].set(q)
+    full = attention_ops.dense_attention(full_q, k, v, causal=True)
+    part = attention_ops.dense_attention(q, k, v, causal=True, q_offset=8)
+    np.testing.assert_allclose(np.asarray(full[:, 8:]), np.asarray(part),
+                               atol=2e-5)
+
+
+class TestFlash:
+    """Pallas kernel in interpret mode on CPU (compiled path on TPU)."""
+
+    @pytest.mark.parametrize('causal', [True, False])
+    def test_matches_dense(self, qkv, causal):
+        q, k, v = qkv
+        dense = attention_ops.dense_attention(q, k, v, causal=causal)
+        flash = attention_ops.attention(q, k, v, causal=causal,
+                                        impl='flash')
+        np.testing.assert_allclose(np.asarray(dense), np.asarray(flash),
+                                   atol=2e-5)
+
+    def test_grads_match_dense(self, qkv):
+        q, k, v = qkv
+        from skypilot_tpu.ops import flash_attention as fa
+
+        def loss(fn):
+            return lambda q_, k_, v_: (fn(q_, k_, v_) ** 2).sum()
+
+        gd = jax.grad(loss(attention_ops.dense_attention),
+                      argnums=(0, 1, 2))(q, k, v)
+        gf = jax.grad(loss(fa.flash_attention), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gd, gf):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4)
+
+    def test_indivisible_block_raises(self, qkv):
+        from skypilot_tpu.ops import flash_attention as fa
+        q = jax.random.normal(jax.random.key(1), (1, 48, 2, 16))
+        with pytest.raises(ValueError):
+            fa.flash_attention(q, q[:, :, :2], q[:, :, :2], True, 32, 32)
+
+
+def test_unknown_impl_raises(qkv):
+    q, k, v = qkv
+    with pytest.raises(ValueError, match='Unknown attention impl'):
+        attention_ops.attention(q, k, v, impl='blockwsie')
+
+
+def test_fully_masked_rows_are_zero():
+    """Rank holding early queries vs strictly-later KV slice → zeros."""
+    q = jax.random.normal(jax.random.key(1), (1, 8, 2, 8))
+    k = jax.random.normal(jax.random.key(2), (1, 8, 2, 8))
+    v = jax.random.normal(jax.random.key(3), (1, 8, 2, 8))
+    out = attention_ops.blockwise_attention(
+        q, k, v, causal=True, q_offset=0, kv_offset=8, block_size=4)
+    np.testing.assert_allclose(np.asarray(out), 0.0, atol=1e-6)
+
+
+def test_ring_subblocking_matches(qkv):
+    q, k, v = qkv
+    mesh = make_mesh(MeshSpec(data=4, fsdp=1, context=2))
+    dense = attention_ops.dense_attention(q, k, v)
+    # local_len=32, block_size=8 → 4 sub-blocks per ring step
+    ring = attention_ops.ring_attention(q, k, v, mesh, block_size=8)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(ring),
+                               atol=2e-5)

@@ -1,0 +1,71 @@
+"""Mesh + sharding rules unit tests (8-device CPU mesh)."""
+import jax
+import pytest
+
+from skypilot_tpu.parallel import (AXIS_ORDER, MeshSpec, make_mesh, spec_for)
+
+
+class TestMeshSpec:
+
+    def test_resolve_fill(self):
+        spec = MeshSpec(fsdp=-1).resolve(8)
+        assert spec.fsdp == 8
+        assert spec.shape() == (1, 8, 1, 1, 1)
+
+    def test_resolve_exact(self):
+        spec = MeshSpec(data=2, fsdp=2, tensor=2).resolve(8)
+        assert spec.shape() == (2, 2, 1, 1, 2)
+
+    def test_resolve_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            MeshSpec(data=3, fsdp=1).resolve(8)
+
+    def test_two_fill_axes_raise(self):
+        with pytest.raises(ValueError):
+            MeshSpec(data=-1, fsdp=-1).resolve(8)
+
+    def test_from_dict_aliases(self):
+        spec = MeshSpec.from_dict({'dp': 2, 'tp': 2, 'sp': 2, 'fsdp': 1})
+        assert (spec.data, spec.tensor, spec.context) == (2, 2, 2)
+
+    def test_alias_conflict_raises(self):
+        with pytest.raises(ValueError):
+            MeshSpec.from_dict({'tp': 2, 'tensor': 4})
+
+    def test_unknown_axis_raises(self):
+        with pytest.raises(ValueError):
+            MeshSpec.from_dict({'bogus': 2})
+
+
+class TestMakeMesh:
+
+    def test_axis_names_and_shape(self):
+        mesh = make_mesh(MeshSpec(data=2, fsdp=2, tensor=2))
+        assert mesh.axis_names == AXIS_ORDER
+        assert mesh.shape['data'] == 2
+        assert mesh.shape['tensor'] == 2
+        assert mesh.devices.size == 8
+
+    def test_full_fsdp(self):
+        mesh = make_mesh(MeshSpec(fsdp=-1))
+        assert mesh.shape['fsdp'] == 8
+
+
+class TestSpecFor:
+
+    def test_batch_maps_to_data_fsdp(self):
+        spec = spec_for(('batch', 'seq', 'embed'))
+        assert spec[0] == ('data', 'fsdp')
+        assert spec[1] == 'context'
+        # embed wants ('fsdp',) but fsdp already used by batch → None
+        assert spec[2] is None
+
+    def test_weight_spec(self):
+        spec = spec_for(('embed', 'heads', 'head_dim'))
+        assert spec[0] == 'fsdp'
+        assert spec[1] == 'tensor'
+        assert spec[2] is None
+
+    def test_none_axes(self):
+        spec = spec_for((None, 'embed'))
+        assert spec[0] is None
