@@ -1,0 +1,82 @@
+"""Benchmark: Llama train-step tokens/sec/chip + MFU on the local chip(s).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baseline: BASELINE.md's north-star of >=40% MFU for Llama finetune
+(the reference publishes no model-compute numbers — it is an
+orchestrator; SURVEY.md §6). vs_baseline = achieved_mfu / 0.40.
+"""
+import json
+import os
+import time
+
+
+def main() -> None:
+    import jax
+
+    from skypilot_tpu.train import trainer as train_lib
+    from skypilot_tpu.parallel import mesh as mesh_lib
+
+    n_devices = jax.device_count()
+    on_tpu = jax.devices()[0].platform == 'tpu'
+
+    # Bench config: ~1B model on TPU (fits one ~16G-HBM chip in bf16 with
+    # adam states + remat at batch 2), tiny on CPU.
+    model = 'bench-1b' if on_tpu else 'tiny'
+    seq_len = 2048 if on_tpu else 128
+    per_chip_batch = 2 if on_tpu else 2
+
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(fsdp=-1))
+    cfg = train_lib.TrainerConfig(
+        model=model,
+        batch_size=per_chip_batch * n_devices,
+        seq_len=seq_len,
+        max_steps=100,
+        warmup_steps=10,
+    )
+    mcfg = cfg.model_config()
+
+    state = train_lib.make_train_state(cfg, mesh)
+    batch = train_lib.synthetic_batch(cfg, mesh)
+    step = train_lib.make_train_step(cfg, mesh)
+
+    with mesh_lib.use_mesh(mesh):
+        # Warmup: compile + 2 steps.
+        for _ in range(3):
+            state, metrics = step(state, batch)
+        jax.block_until_ready(metrics['loss'])
+
+        n_steps = 10 if on_tpu else 3
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            state, metrics = step(state, batch)
+        jax.block_until_ready(metrics['loss'])
+        dt = time.perf_counter() - t0
+
+    tokens_per_step = cfg.batch_size * cfg.seq_len
+    tokens_per_sec = tokens_per_step * n_steps / dt
+    tokens_per_sec_chip = tokens_per_sec / n_devices
+
+    chip = train_lib.detect_chip()
+    peak = train_lib.PEAK_FLOPS[chip]
+    mfu = train_lib.mfu(tokens_per_sec, mcfg, cfg.seq_len, peak, n_devices)
+
+    result = {
+        'metric': f'llama_{model}_train_tokens_per_sec_per_chip_{chip}',
+        'value': round(tokens_per_sec_chip, 2),
+        'unit': 'tokens/s/chip',
+        'vs_baseline': round(mfu / 0.40, 4),
+        'extra': {
+            'mfu': round(mfu, 4),
+            'n_devices': n_devices,
+            'seq_len': cfg.seq_len,
+            'global_batch': cfg.batch_size,
+            'model_params': mcfg.num_params(),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,6 @@
+"""Cloud SDK adaptors: lazy, dependency-free imports of cloud APIs.
+
+Reference analog: sky/adaptors/ (LazyImport, sky/adaptors/common.py:9).
+Ours are thin REST clients over urllib so `import skypilot_tpu` never
+pulls a cloud SDK; tests inject fake transports.
+"""

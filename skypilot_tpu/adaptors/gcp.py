@@ -1,0 +1,169 @@
+"""GCP REST adaptor: auth + JSON transport for tpu/compute APIs.
+
+Reference analog: sky/adaptors/gcp.py wraps googleapiclient; ours talks
+REST directly via urllib (no SDK dependency) behind an injectable
+transport so unit tests run the full provisioner against a fake API
+(the reference leans on googleapiclient mocks / moto for the same).
+"""
+import json
+import os
+import subprocess
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Callable, Dict, Optional
+
+from skypilot_tpu import exceptions
+
+TPU_API = 'https://tpu.googleapis.com/v2'
+COMPUTE_API = 'https://compute.googleapis.com/compute/v1'
+
+
+class GcpApiError(exceptions.ProvisionError):
+    """HTTP-level error from a GCP API."""
+
+    def __init__(self, message: str, status: int = 0,
+                 reason: str = '') -> None:
+        super().__init__(message)
+        self.status = status
+        self.reason = reason
+
+
+def classify_api_error(err: 'GcpApiError') -> exceptions.ProvisionError:
+    """Map an API error onto the failover taxonomy (reference
+    FailoverCloudErrorHandlerV2, cloud_vm_ray_backend.py:876): quota and
+    stockout errors are retryable-in-another-zone."""
+    text = f'{err.reason} {err}'.lower()
+    if err.status == 429 or 'quota' in text or 'rate limit' in text:
+        return exceptions.QuotaExceededError(str(err))
+    if ('resource_exhausted' in text or 'stockout' in text or
+            'no more capacity' in text or 'out of capacity' in text or
+            'insufficient' in text or err.status == 503):
+        return exceptions.CapacityError(str(err))
+    return err
+
+
+class Transport:
+    """Real HTTP transport with bearer-token auth."""
+
+    def __init__(self, token_fn: Callable[[], str]) -> None:
+        self._token_fn = token_fn
+
+    def request(self, method: str, url: str,
+                params: Optional[Dict[str, str]] = None,
+                json_body: Optional[Dict[str, Any]] = None
+                ) -> Dict[str, Any]:
+        if params:
+            url = f'{url}?{urllib.parse.urlencode(params)}'
+        data = None
+        headers = {'Authorization': f'Bearer {self._token_fn()}'}
+        if json_body is not None:
+            data = json.dumps(json_body).encode()
+            headers['Content-Type'] = 'application/json'
+        req = urllib.request.Request(url, data=data, headers=headers,
+                                     method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                body = resp.read()
+        except urllib.error.HTTPError as e:
+            payload = e.read().decode(errors='replace')
+            try:
+                reason = json.loads(payload)['error'].get('status', '')
+            except (json.JSONDecodeError, KeyError, TypeError):
+                reason = ''
+            raise GcpApiError(f'{method} {url}: HTTP {e.code}: {payload}',
+                              status=e.code, reason=reason) from e
+        except urllib.error.URLError as e:
+            raise GcpApiError(f'{method} {url}: {e.reason}') from e
+        return json.loads(body) if body else {}
+
+
+def _gcloud_token() -> str:
+    proc = subprocess.run(['gcloud', 'auth', 'print-access-token'],
+                          capture_output=True, timeout=30, check=False)
+    if proc.returncode != 0:
+        raise exceptions.ProvisionError(
+            'Cannot obtain a GCP access token: '
+            f'{proc.stderr.decode(errors="replace").strip()}')
+    return proc.stdout.decode().strip()
+
+
+class _CachedToken:
+    """Access tokens are valid ~1h; refresh with slack."""
+
+    def __init__(self, fetch: Callable[[], str], ttl: float = 2700.0) -> None:
+        self._fetch = fetch
+        self._ttl = ttl
+        self._token: Optional[str] = None
+        self._expiry = 0.0
+        self._lock = threading.Lock()
+
+    def __call__(self) -> str:
+        with self._lock:
+            if self._token is None or time.time() > self._expiry:
+                self._token = self._fetch()
+                self._expiry = time.time() + self._ttl
+            return self._token
+
+
+_transport_factory: Callable[[], Any] = lambda: Transport(
+    _CachedToken(_gcloud_token))
+_transport: Optional[Any] = None
+_transport_lock = threading.Lock()
+
+
+def set_transport_factory(factory: Callable[[], Any]) -> None:
+    """Test hook: inject a fake transport (and drop any cached one)."""
+    global _transport_factory, _transport
+    with _transport_lock:
+        _transport_factory = factory
+        _transport = None
+
+
+def transport() -> Any:
+    global _transport
+    with _transport_lock:
+        if _transport is None:
+            _transport = _transport_factory()
+        return _transport
+
+
+def default_project() -> str:
+    project = os.environ.get('GOOGLE_CLOUD_PROJECT') or os.environ.get(
+        'CLOUDSDK_CORE_PROJECT')
+    if project:
+        return project
+    proc = subprocess.run(['gcloud', 'config', 'get-value', 'project'],
+                          capture_output=True, timeout=15, check=False)
+    project = proc.stdout.decode().strip()
+    if proc.returncode != 0 or not project or project == '(unset)':
+        raise exceptions.ProvisionError(
+            'No GCP project configured; set GOOGLE_CLOUD_PROJECT or run '
+            '`gcloud config set project`.')
+    return project
+
+
+def wait_operation(op: Dict[str, Any], poll_url: str,
+                   timeout: float = 900.0, interval: float = 5.0
+                   ) -> Dict[str, Any]:
+    """Poll a long-running operation until done (both tpu.* and compute.*
+    operation shapes)."""
+    deadline = time.time() + timeout
+    while True:
+        done = op.get('done', False) or op.get('status') == 'DONE'
+        if done:
+            error = op.get('error')
+            if error:
+                message = error.get('message') or json.dumps(error)
+                raise classify_api_error(
+                    GcpApiError(f'Operation failed: {message}',
+                                reason=str(error.get('status', ''))))
+            return op
+        if time.time() > deadline:
+            raise exceptions.ProvisionError(
+                f'Operation timed out after {timeout:.0f}s: '
+                f'{op.get("name", poll_url)}')
+        time.sleep(interval)
+        op = transport().request('GET', poll_url)

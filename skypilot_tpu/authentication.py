@@ -1,0 +1,57 @@
+"""SSH keypair management for cluster access.
+
+Reference analog: sky/authentication.py:139 (`get_or_generate_keys`) +
+per-cloud key injection (:223 GCP). Ours injects keys through instance
+metadata at create time (provision/gcp), so there is no per-cloud
+OS-Login/metadata dance here — just deterministic local keypair state.
+"""
+import functools
+import os
+import subprocess
+from typing import Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import paths
+
+_KEY_NAME = 'skytpu-key'
+DEFAULT_SSH_USER = 'skytpu'
+
+
+def _keys_dir() -> str:
+    return os.path.join(paths.state_dir(), 'keys')
+
+
+@functools.lru_cache(maxsize=1)
+def get_or_generate_keys() -> Tuple[str, str]:
+    """Returns (private_key_path, public_key_path), creating once."""
+    d = _keys_dir()
+    os.makedirs(d, exist_ok=True)
+    private = os.path.join(d, _KEY_NAME)
+    public = private + '.pub'
+    if not (os.path.isfile(private) and os.path.isfile(public)):
+        proc = subprocess.run(
+            ['ssh-keygen', '-t', 'ed25519', '-N', '', '-q', '-f', private,
+             '-C', 'skypilot-tpu'],
+            capture_output=True, check=False)
+        if proc.returncode != 0:
+            raise exceptions.ClusterSetUpError(
+                'ssh-keygen failed: '
+                f'{proc.stderr.decode(errors="replace")}')
+        os.chmod(private, 0o600)
+    return private, public
+
+
+def public_key_content() -> str:
+    _, public = get_or_generate_keys()
+    with open(public, 'r', encoding='utf-8') as f:
+        return f.read().strip()
+
+
+def authentication_config() -> dict:
+    """The ProvisionConfig.authentication_config payload."""
+    private, _ = get_or_generate_keys()
+    return {
+        'ssh_user': DEFAULT_SSH_USER,
+        'ssh_private_key': private,
+        'ssh_public_key_content': public_key_content(),
+    }

@@ -107,7 +107,7 @@ class RetryingProvisioner:
                 cluster_name_on_cloud, row.region, row.zone)
             config = provision_common.ProvisionConfig(
                 provider_config=variables,
-                authentication_config={},
+                authentication_config=self.cloud.authentication_config(),
                 node_config={'use_spot': to_provision.use_spot},
                 count=num_nodes,
                 tags={'skytpu-cluster-name': cluster_name},

@@ -1,0 +1,318 @@
+"""`tsky` — the CLI. Thin wrappers over the client SDK.
+
+Reference analog: sky/client/cli/command.py (cli group :748, launch :901,
+exec :1076); every command submits an async request and streams/polls.
+"""
+import json
+import os
+import sys
+from typing import List, Optional
+
+import click
+
+from skypilot_tpu import exceptions
+
+
+def _task_from_args(entrypoint, cluster_name: Optional[str], num_nodes,
+                    accelerators, cloud, workdir, env, name):
+    """YAML path -> Task; bare command -> inline Task (reference
+    _make_task_or_dag_from_entrypoint)."""
+    from skypilot_tpu import task as task_lib
+    entry = ' '.join(entrypoint) if entrypoint else None
+    is_yaml = bool(entry) and (entry.endswith(('.yaml', '.yml'))
+                               and os.path.isfile(os.path.expanduser(entry)))
+    if is_yaml:
+        task = task_lib.Task.from_yaml(os.path.expanduser(entry))
+    else:
+        task = task_lib.Task(run=entry, name=name)
+    if name:
+        task.name = name
+    if workdir:
+        task.workdir = workdir
+    if num_nodes:
+        task.num_nodes = num_nodes
+    envs = dict(e.split('=', 1) for e in env or [])
+    if envs:
+        task.update_envs(envs)
+    if accelerators or cloud:
+        from skypilot_tpu import resources as resources_lib
+        base = next(iter(task.resources)) if task.resources else \
+            resources_lib.Resources()
+        overrides = {}
+        if accelerators:
+            overrides['accelerators'] = accelerators
+        if cloud:
+            overrides['infra'] = cloud
+        task.set_resources({base.copy(**overrides)})
+    return task
+
+
+def _run_and_stream(request_id: str) -> None:
+    from skypilot_tpu.client import sdk
+    try:
+        sdk.stream(request_id)
+        sdk.get(request_id)
+    except KeyboardInterrupt:
+        click.echo(f'\nInterrupted. Request {request_id} keeps running; '
+                   f'cancel with: tsky api cancel {request_id}')
+        raise
+
+
+@click.group()
+@click.version_option(message='%(version)s',
+                      package_name='skypilot_tpu',
+                      version=__import__('skypilot_tpu').__version__)
+def cli():
+    """tsky: run AI workloads on TPU infrastructure."""
+
+
+@cli.command()
+@click.argument('entrypoint', nargs=-1)
+@click.option('--cluster', '-c', default=None, help='Cluster name.')
+@click.option('--name', '-n', default=None, help='Task name.')
+@click.option('--num-nodes', type=int, default=None)
+@click.option('--gpus', '--accelerators', 'accelerators', default=None,
+              help='Accelerator spec, e.g. tpu-v5p:8 or A100:1.')
+@click.option('--infra', '--cloud', 'cloud', default=None,
+              help='Infra to use, e.g. gcp, gcp/us-central2, local.')
+@click.option('--workdir', default=None)
+@click.option('--env', multiple=True, help='KEY=VALUE env overrides.')
+@click.option('--detach-run', '-d', is_flag=True)
+@click.option('--dryrun', is_flag=True)
+@click.option('--no-setup', is_flag=True)
+@click.option('--down', is_flag=True,
+              help='Autodown the cluster when the job finishes.')
+@click.option('--idle-minutes-to-autostop', '-i', type=int, default=None)
+def launch(entrypoint, cluster, name, num_nodes, accelerators, cloud,
+           workdir, env, detach_run, dryrun, no_setup, down,
+           idle_minutes_to_autostop):
+    """Launch a task (provision + setup + run)."""
+    from skypilot_tpu.client import sdk
+    from skypilot_tpu.utils import common_utils
+    task = _task_from_args(entrypoint, cluster, num_nodes, accelerators,
+                           cloud, workdir, env, name)
+    if idle_minutes_to_autostop is not None or down:
+        autostop_cfg = {'idle_minutes': idle_minutes_to_autostop
+                        if idle_minutes_to_autostop is not None else 5,
+                        'down': down}
+        task.set_resources({r.copy(autostop=autostop_cfg)
+                            for r in task.resources} or
+                           None)
+    cluster = cluster or common_utils.generate_cluster_name()
+    click.echo(f'Launching on cluster {cluster!r}...')
+    request_id = sdk.launch(task, cluster, dryrun=dryrun,
+                            detach_run=detach_run, no_setup=no_setup)
+    _run_and_stream(request_id)
+
+
+@cli.command('exec')
+@click.argument('cluster')
+@click.argument('entrypoint', nargs=-1, required=True)
+@click.option('--name', '-n', default=None)
+@click.option('--num-nodes', type=int, default=None)
+@click.option('--workdir', default=None)
+@click.option('--env', multiple=True)
+@click.option('--detach-run', '-d', is_flag=True)
+def exec_command(cluster, entrypoint, name, num_nodes, workdir, env,
+                 detach_run):
+    """Run a command/task on an existing cluster (skips provision/setup)."""
+    from skypilot_tpu.client import sdk
+    task = _task_from_args(entrypoint, cluster, num_nodes, None, None,
+                           workdir, env, name)
+    request_id = sdk.exec_cmd(task, cluster, detach_run=detach_run)
+    _run_and_stream(request_id)
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1)
+@click.option('--refresh', '-r', is_flag=True,
+              help='Reconcile against the cloud.')
+def status(clusters, refresh):
+    """Show clusters."""
+    from skypilot_tpu.client import sdk
+    records = sdk.get(sdk.status(list(clusters) or None, refresh=refresh))
+    if not records:
+        click.echo('No existing clusters.')
+        return
+    fmt = '{:<20} {:<28} {:<10} {:<8} {}'
+    click.echo(fmt.format('NAME', 'RESOURCES', 'STATUS', 'NODES',
+                          'AUTOSTOP'))
+    for r in records:
+        autostop = r.get('autostop') or {}
+        autostop_str = (f'{autostop.get("idle_minutes")}m'
+                        f'{" (down)" if autostop.get("down") else ""}'
+                        if autostop else '-')
+        click.echo(fmt.format(r['name'], r.get('resources_str') or '-',
+                              r['status'], r.get('num_nodes') or 1,
+                              autostop_str))
+
+
+@cli.command()
+@click.argument('cluster')
+@click.option('--idle-minutes-to-autostop', '-i', type=int, default=None)
+@click.option('--down', is_flag=True)
+def start(cluster, idle_minutes_to_autostop, down):
+    """Restart a stopped cluster."""
+    from skypilot_tpu.client import sdk
+    sdk.stream_and_get(sdk.start(cluster, idle_minutes_to_autostop, down))
+    click.echo(f'Cluster {cluster!r} started.')
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True)
+def stop(clusters, yes):
+    """Stop cluster(s) (kept on disk; restart with tsky start)."""
+    from skypilot_tpu.client import sdk
+    if not yes:
+        click.confirm(f'Stop {", ".join(clusters)}?', abort=True)
+    for c in clusters:
+        sdk.stream_and_get(sdk.stop(c))
+        click.echo(f'Cluster {c!r} stopped.')
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True)
+@click.option('--purge', is_flag=True,
+              help='Drop the record even if cloud teardown fails.')
+def down(clusters, yes, purge):
+    """Terminate cluster(s)."""
+    from skypilot_tpu.client import sdk
+    if not yes:
+        click.confirm(f'Terminate {", ".join(clusters)}?', abort=True)
+    for c in clusters:
+        sdk.stream_and_get(sdk.down(c, purge=purge))
+        click.echo(f'Cluster {c!r} terminated.')
+
+
+@cli.command()
+@click.argument('cluster')
+@click.option('--idle-minutes', '-i', type=int, default=None,
+              help='Idle minutes before autostop; -1 cancels.')
+@click.option('--cancel', 'cancel_flag', is_flag=True)
+@click.option('--down', is_flag=True)
+def autostop(cluster, idle_minutes, cancel_flag, down):
+    """Configure autostop/autodown on a cluster."""
+    from skypilot_tpu.client import sdk
+    if cancel_flag:
+        idle_minutes = None
+    elif idle_minutes is None:
+        idle_minutes = 5
+    sdk.get(sdk.autostop(cluster, idle_minutes, down))
+    click.echo('Autostop updated.')
+
+
+@cli.command()
+@click.argument('cluster')
+def queue(cluster):
+    """Show a cluster's job queue."""
+    from skypilot_tpu.client import sdk
+    jobs = sdk.get(sdk.queue(cluster))
+    fmt = '{:<6} {:<20} {:<12} {}'
+    click.echo(fmt.format('ID', 'NAME', 'STATUS', 'RESOURCES'))
+    for j in jobs:
+        click.echo(fmt.format(j['job_id'], j.get('name') or '-',
+                              j['status'], j.get('resources_str') or '-'))
+
+
+@cli.command()
+@click.argument('cluster')
+@click.argument('job_ids', nargs=-1, type=int)
+@click.option('--all', 'all_jobs', is_flag=True)
+def cancel(cluster, job_ids, all_jobs):
+    """Cancel job(s) on a cluster."""
+    from skypilot_tpu.client import sdk
+    result = sdk.get(sdk.cancel(cluster, list(job_ids) or None, all_jobs))
+    click.echo(f'Cancelled jobs: {result["cancelled"]}')
+
+
+@cli.command()
+@click.argument('cluster')
+@click.argument('job_id', required=False, type=int)
+@click.option('--no-follow', is_flag=True)
+@click.option('--tail', type=int, default=0)
+def logs(cluster, job_id, no_follow, tail):
+    """Tail a job's logs."""
+    from skypilot_tpu.client import sdk
+    request_id = sdk.tail_logs(cluster, job_id, follow=not no_follow,
+                               tail=tail)
+    _run_and_stream(request_id)
+
+
+@cli.command()
+def check():
+    """Probe cloud credentials and cache enabled clouds."""
+    from skypilot_tpu.client import sdk
+    enabled = sdk.get(sdk.check())
+    if enabled:
+        click.echo('Enabled infra: ' + ', '.join(enabled))
+    else:
+        click.echo('No cloud credentials found. The `local` cloud is '
+                   'always available for dev runs.')
+
+
+@cli.command('cost-report')
+def cost_report():
+    """Estimated costs for live + historical clusters."""
+    from skypilot_tpu.client import sdk
+    rows = sdk.get(sdk.cost_report())
+    fmt = '{:<24} {:<10} {:<12} {}'
+    click.echo(fmt.format('NAME', 'STATUS', 'DURATION', 'COST ($)'))
+    for r in rows:
+        dur_h = (r.get('duration_s') or 0) / 3600.0
+        cost = r.get('total_cost')
+        click.echo(fmt.format(
+            r['name'], r.get('status') or '-', f'{dur_h:.1f}h',
+            f'{cost:.2f}' if cost is not None else '-'))
+
+
+@cli.group()
+def api():
+    """Manage the API server."""
+
+
+@api.command('status')
+def api_status_cmd():
+    """List recent requests."""
+    from skypilot_tpu.client import sdk
+    rows = sdk.api_status()
+    fmt = '{:<18} {:<12} {:<10} {}'
+    click.echo(fmt.format('REQUEST', 'NAME', 'STATUS', 'CREATED'))
+    for r in rows:
+        click.echo(fmt.format(r['request_id'], r['name'], r['status'],
+                              r.get('created_at') or '-'))
+
+
+@api.command('cancel')
+@click.argument('request_id')
+def api_cancel(request_id):
+    from skypilot_tpu.client import sdk
+    ok = sdk.cancel_request(request_id)
+    click.echo('Cancelled.' if ok else 'Request already finished.')
+
+
+@api.command('start')
+def api_start():
+    from skypilot_tpu.client import sdk
+    sdk.ensure_server_running()
+    click.echo(f'API server running at {sdk.api_server_url()}')
+
+
+@api.command('logs')
+@click.argument('request_id')
+def api_logs(request_id):
+    from skypilot_tpu.client import sdk
+    sdk.stream(request_id, follow=False)
+
+
+def main():
+    try:
+        cli(standalone_mode=True)
+    except exceptions.SkyTpuError as e:
+        click.echo(f'Error: {e}', err=True)
+        sys.exit(1)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,226 @@
+"""Client SDK: async HTTP calls to the API server, with auto-start.
+
+Reference analog: sky/client/sdk.py (launch :361, exec :633, tail_logs
+:717, stream_response :74; @check_server_healthy_or_start). Every call
+returns a `request_id`; `get()` blocks for the result, `stream_and_get()`
+also relays the server-side log stream to stdout.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.server import app as server_app
+from skypilot_tpu.utils import paths
+
+_API_PREFIX = server_app.API_PREFIX
+
+
+def api_server_url() -> str:
+    url = os.environ.get('SKYTPU_API_SERVER_URL')
+    if url:
+        return url.rstrip('/')
+    return f'http://127.0.0.1:{server_app.DEFAULT_PORT}'
+
+
+def _request_raw(method: str, path: str,
+                 payload: Optional[Dict[str, Any]] = None,
+                 stream: bool = False, timeout: float = 300.0):
+    url = f'{api_server_url()}{_API_PREFIX}{path}'
+    data = None
+    headers = {}
+    if payload is not None:
+        data = json.dumps(payload).encode()
+        headers['Content-Type'] = 'application/json'
+    req = urllib.request.Request(url, data=data, headers=headers,
+                                 method=method)
+    try:
+        resp = urllib.request.urlopen(req, timeout=timeout)
+    except urllib.error.HTTPError as e:
+        body = e.read().decode(errors='replace')
+        raise exceptions.ApiServerError(
+            f'{method} {path}: HTTP {e.code}: {body}') from e
+    except urllib.error.URLError as e:
+        raise exceptions.ApiServerError(
+            f'API server unreachable at {api_server_url()}: '
+            f'{e.reason}') from e
+    if stream:
+        return resp
+    with resp:
+        body = resp.read()
+    return json.loads(body) if body else None
+
+
+def server_healthy() -> bool:
+    try:
+        info = _request_raw('GET', '/health', timeout=2.0)
+        return info is not None and info.get('status') == 'healthy'
+    except exceptions.ApiServerError:
+        return False
+
+
+def ensure_server_running(start_timeout: float = 30.0) -> None:
+    """Auto-start a local API server when none is reachable (reference
+    @check_server_healthy_or_start, sky/server/common.py)."""
+    if server_healthy():
+        return
+    if os.environ.get('SKYTPU_API_SERVER_URL'):
+        raise exceptions.ApiServerError(
+            f'Configured API server {api_server_url()} is unreachable.')
+    log_path = os.path.join(paths.client_logs_dir(), 'api_server.log')
+    with open(log_path, 'ab') as log_f:
+        subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.server.app',
+             '--port', str(server_app.DEFAULT_PORT)],
+            stdout=log_f, stderr=log_f,
+            start_new_session=True,
+            env=dict(os.environ, JAX_PLATFORMS='cpu'))
+    deadline = time.time() + start_timeout
+    while time.time() < deadline:
+        if server_healthy():
+            return
+        time.sleep(0.5)
+    raise exceptions.ApiServerError(
+        f'API server failed to start within {start_timeout:.0f}s; see '
+        f'{log_path}')
+
+
+def _submit(name: str, payload: Dict[str, Any]) -> str:
+    ensure_server_running()
+    resp = _request_raw('POST', f'/{name}', payload)
+    return resp['request_id']
+
+
+# --- request lifecycle ------------------------------------------------------
+
+def get(request_id: str, timeout: Optional[float] = None) -> Any:
+    """Block until the request finishes; return its result or raise."""
+    deadline = None if timeout is None else time.time() + timeout
+    while True:
+        record = _request_raw('GET', f'/requests/{request_id}')
+        status = record['status']
+        if status == 'SUCCEEDED':
+            return record['result']
+        if status == 'CANCELLED':
+            raise exceptions.RequestCancelled(
+                f'Request {request_id} was cancelled.')
+        if status == 'FAILED':
+            raise exceptions.ApiServerError(
+                f'Request {record["name"]} ({request_id}) failed: '
+                f'{record["error"]}')
+        if deadline is not None and time.time() > deadline:
+            raise TimeoutError(
+                f'Request {request_id} still {status} after {timeout}s')
+        time.sleep(0.5)
+
+
+def stream(request_id: str, output=None, follow: bool = True) -> None:
+    """Relay the request's server-side log to `output` (default stdout)."""
+    output = output or sys.stdout
+    params = urllib.parse.urlencode({'follow': str(follow).lower()})
+    resp = _request_raw('GET', f'/requests/{request_id}/stream?{params}',
+                        stream=True, timeout=86400.0)
+    with resp:
+        while True:
+            chunk = resp.read(4096)
+            if not chunk:
+                break
+            output.write(chunk.decode(errors='replace'))
+            output.flush()
+
+
+def stream_and_get(request_id: str) -> Any:
+    stream(request_id)
+    return get(request_id)
+
+
+def cancel_request(request_id: str) -> bool:
+    resp = _request_raw('POST', f'/requests/{request_id}/cancel')
+    return resp['cancelled']
+
+
+def api_status(limit: int = 100) -> List[Dict[str, Any]]:
+    ensure_server_running()
+    return _request_raw('GET', f'/requests?limit={limit}')
+
+
+# --- commands (each returns a request_id) -----------------------------------
+
+def launch(task, cluster_name: str, *, dryrun: bool = False,
+           detach_run: bool = False, no_setup: bool = False) -> str:
+    return _submit('launch', {
+        'task': task.to_yaml_config(),
+        'cluster_name': cluster_name,
+        'dryrun': dryrun,
+        'detach_run': detach_run,
+        'no_setup': no_setup,
+    })
+
+
+def exec_cmd(task, cluster_name: str, *, detach_run: bool = False) -> str:
+    return _submit('exec', {
+        'task': task.to_yaml_config(),
+        'cluster_name': cluster_name,
+        'detach_run': detach_run,
+    })
+
+
+def status(cluster_names: Optional[List[str]] = None,
+           refresh: bool = False) -> str:
+    return _submit('status', {'cluster_names': cluster_names,
+                              'refresh': refresh})
+
+
+def start(cluster_name: str, idle_minutes: Optional[int] = None,
+          down: bool = False) -> str:
+    return _submit('start', {'cluster_name': cluster_name,
+                             'idle_minutes': idle_minutes, 'down': down})
+
+
+def stop(cluster_name: str) -> str:
+    return _submit('stop', {'cluster_name': cluster_name})
+
+
+def down(cluster_name: str, purge: bool = False) -> str:
+    return _submit('down', {'cluster_name': cluster_name, 'purge': purge})
+
+
+def autostop(cluster_name: str, idle_minutes: Optional[int],
+             down: bool = False) -> str:
+    return _submit('autostop', {'cluster_name': cluster_name,
+                                'idle_minutes': idle_minutes,
+                                'down': down})
+
+
+def queue(cluster_name: str) -> str:
+    return _submit('queue', {'cluster_name': cluster_name})
+
+
+def cancel(cluster_name: str, job_ids: Optional[List[int]] = None,
+           all_jobs: bool = False) -> str:
+    return _submit('cancel', {'cluster_name': cluster_name,
+                              'job_ids': job_ids, 'all_jobs': all_jobs})
+
+
+def tail_logs(cluster_name: str, job_id: Optional[int] = None,
+              follow: bool = True, tail: int = 0) -> str:
+    return _submit('logs', {'cluster_name': cluster_name, 'job_id': job_id,
+                            'follow': follow, 'tail': tail})
+
+
+def cost_report() -> str:
+    return _submit('cost_report', {})
+
+
+def check() -> str:
+    return _submit('check', {})
+
+
+def optimize(task, minimize: str = 'COST') -> str:
+    return _submit('optimize', {'task': task.to_yaml_config(),
+                                'minimize': minimize})

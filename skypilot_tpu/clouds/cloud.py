@@ -74,6 +74,12 @@ class Cloud:
         """(ok, reason-if-not)."""
         return False, f'{self.NAME}: no credential check implemented'
 
+    def authentication_config(self) -> Dict[str, object]:
+        """SSH identity for reaching this cloud's instances
+        (ProvisionConfig.authentication_config). Key-less clouds (local)
+        return {}."""
+        return {}
+
     def __repr__(self) -> str:
         return self.NAME.upper() if self.NAME == 'gcp' else \
             self.NAME.capitalize()

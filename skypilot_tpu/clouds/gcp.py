@@ -46,8 +46,18 @@ class GCP(cloud.Cloud):
                               region: str, zone: Optional[str]
                               ) -> Dict[str, object]:
         resources.assert_launchable()
+        from skypilot_tpu import config as config_lib
+        auth = self.authentication_config()
         variables: Dict[str, object] = {
             'cluster_name_on_cloud': cluster_name_on_cloud,
+            'project_id': config_lib.get_nested(('gcp', 'project_id')),
+            'network': config_lib.get_nested(('gcp', 'network')),
+            'subnetwork': config_lib.get_nested(('gcp', 'subnetwork')),
+            'use_internal_ips': bool(
+                config_lib.get_nested(('gcp', 'use_internal_ips'),
+                                      default=False)),
+            'ssh_user': auth.get('ssh_user'),
+            'ssh_private_key': auth.get('ssh_private_key'),
             'region': region,
             'zone': zone,
             'instance_type': resources.instance_type,
@@ -72,6 +82,10 @@ class GCP(cloud.Cloud):
             if resources.image_id:
                 variables['image_id'] = resources.image_id
         return variables
+
+    def authentication_config(self) -> Dict[str, object]:
+        from skypilot_tpu import authentication
+        return authentication.authentication_config()
 
     def check_credentials(self) -> Tuple[bool, Optional[str]]:
         # Application-default credentials or an active gcloud account.

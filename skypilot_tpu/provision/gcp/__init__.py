@@ -1,0 +1,81 @@
+"""GCP provisioner: TPU-VM slices (the flagship path) + compute VMs.
+
+Reference analog: sky/provision/gcp/ (GCPTPUVMInstance
+instance_utils.py:1205, REST against tpu.googleapis.com, per-host SSH via
+networkEndpoints; GCPComputeInstance :311). TPU-first shape: one logical
+node == one TPU slice with N host VMs (`InstanceInfo.hosts`), so a
+v5p-128 "cluster" of count=2 is two slices gang-scheduled together.
+
+Routing: `provider_config['tpu_vm']` selects the TPU or compute
+implementation; both expose the uniform provision API.
+"""
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.gcp import compute as compute_impl
+from skypilot_tpu.provision.gcp import tpu as tpu_impl
+from skypilot_tpu.utils import command_runner
+
+
+def _impl(provider_config: Dict[str, Any]):
+    return tpu_impl if provider_config.get('tpu_vm') else compute_impl
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    return _impl(config.provider_config).run_instances(
+        region, cluster_name_on_cloud, config)
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: Optional[str] = None) -> None:
+    # run_instances already waits for its long-running operations; both
+    # implementations re-verify in get_cluster_info.
+    del region, cluster_name_on_cloud, state
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Dict[str, Any]) -> None:
+    _impl(provider_config).stop_instances(cluster_name_on_cloud,
+                                          provider_config)
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Dict[str, Any]) -> None:
+    _impl(provider_config).terminate_instances(cluster_name_on_cloud,
+                                               provider_config)
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Dict[str, Any]
+                    ) -> Dict[str, Optional[str]]:
+    return _impl(provider_config).query_instances(cluster_name_on_cloud,
+                                                  provider_config)
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Dict[str, Any]) -> common.ClusterInfo:
+    return _impl(provider_config).get_cluster_info(region,
+                                                   cluster_name_on_cloud,
+                                                   provider_config)
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str],
+               provider_config: Dict[str, Any]) -> None:
+    compute_impl.open_ports(cluster_name_on_cloud, ports, provider_config)
+
+
+def get_command_runners(cluster_info: common.ClusterInfo
+                        ) -> List[command_runner.CommandRunner]:
+    """One SSH runner per host; a pod slice contributes one per host VM."""
+    runners: List[command_runner.CommandRunner] = []
+    use_internal = bool(
+        cluster_info.provider_config.get('use_internal_ips', False))
+    for inst in cluster_info.ordered_instances():
+        for host in inst.hosts:
+            runners.append(command_runner.SSHCommandRunner(
+                host.get_ip(use_internal=use_internal),
+                user=cluster_info.ssh_user or 'skytpu',
+                private_key=cluster_info.ssh_private_key,
+                port=host.ssh_port))
+    return runners

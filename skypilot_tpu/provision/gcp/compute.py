@@ -1,0 +1,268 @@
+"""Compute-Engine VM provisioning (controllers, CPU workers, GPU VMs).
+
+Reference analog: sky/provision/gcp/instance_utils.py:311
+(`GCPComputeInstance`). The TPU path lives in tpu.py; this covers the
+plain-VM needs: jobs/serve controller hosts and CPU data-prep nodes.
+"""
+import logging
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.adaptors import gcp as gcp_adaptor
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.gcp import tpu as tpu_impl
+
+logger = logging.getLogger(__name__)
+
+
+def _project_zone(pc):
+    project = pc.get('project_id')
+    if not project:
+        project = gcp_adaptor.default_project()
+        pc['project_id'] = project
+    return project, pc['zone']
+
+CLUSTER_LABEL = tpu_impl.CLUSTER_LABEL
+HEAD_LABEL = tpu_impl.HEAD_LABEL
+
+_DEFAULT_IMAGE = ('projects/ubuntu-os-cloud/global/images/family/'
+                  'ubuntu-2204-lts')
+
+_STATE_MAP = {
+    'PROVISIONING': 'pending',
+    'STAGING': 'pending',
+    'RUNNING': 'running',
+    'STOPPING': 'stopping',
+    'SUSPENDING': 'stopping',
+    'SUSPENDED': 'stopped',
+    'TERMINATED': 'stopped',  # compute TERMINATED == stopped-but-exists
+    'REPAIRING': 'pending',
+}
+
+
+def _zone_url(project: str, zone: str) -> str:
+    return f'{gcp_adaptor.COMPUTE_API}/projects/{project}/zones/{zone}'
+
+
+def _list_cluster_vms(project: str, zone: str,
+                      cluster_name_on_cloud: str) -> List[Dict[str, Any]]:
+    t = gcp_adaptor.transport()
+    out: List[Dict[str, Any]] = []
+    page_token: Optional[str] = None
+    while True:
+        params = {
+            'filter': f'labels.{CLUSTER_LABEL}={cluster_name_on_cloud}',
+            'maxResults': '100',
+        }
+        if page_token:
+            params['pageToken'] = page_token
+        resp = t.request('GET', f'{_zone_url(project, zone)}/instances',
+                         params=params)
+        out.extend(resp.get('items', []))
+        page_token = resp.get('nextPageToken')
+        if not page_token:
+            return out
+
+
+def _vm_status(vm: Dict[str, Any]) -> str:
+    return _STATE_MAP.get(vm.get('status', ''), 'pending')
+
+
+def _create_body(config: common.ProvisionConfig, index: int,
+                 cluster_name_on_cloud: str, project: str,
+                 zone: str) -> Dict[str, Any]:
+    pc = config.provider_config
+    nc = {**pc, **config.node_config}
+    name = f'{cluster_name_on_cloud}-{index}'
+    labels = dict(nc.get('labels', {}))
+    labels.update(config.tags)
+    labels[CLUSTER_LABEL] = cluster_name_on_cloud
+    labels[HEAD_LABEL] = 'true' if index == 0 else 'false'
+    machine_type = nc.get('instance_type', 'n2-standard-8')
+    network_interface: Dict[str, Any] = {
+        'network': pc.get('network') or 'global/networks/default',
+    }
+    if pc.get('subnetwork'):
+        network_interface['subnetwork'] = pc['subnetwork']
+    if not pc.get('use_internal_ips', False):
+        network_interface['accessConfigs'] = [{
+            'name': 'External NAT', 'type': 'ONE_TO_ONE_NAT'}]
+    body: Dict[str, Any] = {
+        'name': name,
+        'machineType': f'zones/{zone}/machineTypes/{machine_type}',
+        'labels': labels,
+        'disks': [{
+            'boot': True,
+            'autoDelete': True,
+            'initializeParams': {
+                'sourceImage': nc.get('image_id', _DEFAULT_IMAGE),
+                'diskSizeGb': str(nc.get('disk_size', 256)),
+            },
+        }],
+        'networkInterfaces': [network_interface],
+        'metadata': {'items': []},
+        'scheduling': {},
+    }
+    if nc.get('use_spot'):
+        body['scheduling'] = {
+            'provisioningModel': 'SPOT',
+            'instanceTerminationAction': 'STOP',
+        }
+    ssh_pub = config.authentication_config.get('ssh_public_key_content')
+    ssh_user = config.authentication_config.get('ssh_user', 'skytpu')
+    if ssh_pub:
+        body['metadata']['items'].append(
+            {'key': 'ssh-keys', 'value': f'{ssh_user}:{ssh_pub}'})
+    startup = nc.get('startup_script')
+    if startup:
+        body['metadata']['items'].append(
+            {'key': 'startup-script', 'value': startup})
+    return body
+
+
+def _wait_zone_op(project: str, zone: str, op: Dict[str, Any],
+                  timeout: float = 600.0) -> None:
+    if not op.get('name'):
+        return
+    gcp_adaptor.wait_operation(
+        op, f'{_zone_url(project, zone)}/operations/{op["name"]}',
+        timeout=timeout)
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    pc = config.provider_config
+    project, zone = _project_zone(pc)
+    t = gcp_adaptor.transport()
+
+    existing = {vm['name']: vm
+                for vm in _list_cluster_vms(project, zone,
+                                            cluster_name_on_cloud)}
+    created: List[str] = []
+    resumed: List[str] = []
+    ops: List[Dict[str, Any]] = []
+    for i in range(config.count):
+        name = f'{cluster_name_on_cloud}-{i}'
+        vm = existing.get(name)
+        status = _vm_status(vm) if vm else None
+        if status == 'running':
+            continue
+        try:
+            if status == 'stopped' and config.resume_stopped_nodes:
+                ops.append(t.request(
+                    'POST',
+                    f'{_zone_url(project, zone)}/instances/{name}/start'))
+                resumed.append(name)
+            elif status is None:
+                ops.append(t.request(
+                    'POST', f'{_zone_url(project, zone)}/instances',
+                    json_body=_create_body(config, i, cluster_name_on_cloud,
+                                           project, zone)))
+                created.append(name)
+            else:
+                created.append(name)  # pending from a prior attempt
+        except gcp_adaptor.GcpApiError as e:
+            raise gcp_adaptor.classify_api_error(e) from e
+    for op in ops:
+        _wait_zone_op(project, zone, op,
+                      timeout=float(pc.get('provision_timeout', 600)))
+    return common.ProvisionRecord(
+        provider_name='gcp', region=pc.get('region', zone.rsplit('-', 1)[0]),
+        zone=zone, cluster_name_on_cloud=cluster_name_on_cloud,
+        head_instance_id=f'{cluster_name_on_cloud}-0',
+        created_instance_ids=created, resumed_instance_ids=resumed)
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Dict[str, Any]) -> None:
+    project, zone = _project_zone(provider_config)
+    t = gcp_adaptor.transport()
+    for vm in _list_cluster_vms(project, zone, cluster_name_on_cloud):
+        if _vm_status(vm) == 'running':
+            op = t.request(
+                'POST',
+                f'{_zone_url(project, zone)}/instances/{vm["name"]}/stop')
+            _wait_zone_op(project, zone, op)
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Dict[str, Any]) -> None:
+    project, zone = _project_zone(provider_config)
+    t = gcp_adaptor.transport()
+    ops = []
+    for vm in _list_cluster_vms(project, zone, cluster_name_on_cloud):
+        try:
+            ops.append(t.request(
+                'DELETE',
+                f'{_zone_url(project, zone)}/instances/{vm["name"]}'))
+        except gcp_adaptor.GcpApiError as e:
+            if e.status != 404:
+                raise
+    for op in ops:
+        _wait_zone_op(project, zone, op)
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Dict[str, Any]
+                    ) -> Dict[str, Optional[str]]:
+    project, zone = _project_zone(provider_config)
+    return {vm['name']: _vm_status(vm)
+            for vm in _list_cluster_vms(project, zone,
+                                        cluster_name_on_cloud)}
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Dict[str, Any]) -> common.ClusterInfo:
+    del region
+    project, zone = _project_zone(provider_config)
+    instances: Dict[str, common.InstanceInfo] = {}
+    head_id: Optional[str] = None
+    for vm in _list_cluster_vms(project, zone, cluster_name_on_cloud):
+        if _vm_status(vm) != 'running':
+            continue
+        nic = (vm.get('networkInterfaces') or [{}])[0]
+        external = None
+        for ac in nic.get('accessConfigs', []):
+            external = ac.get('natIP') or external
+        instances[vm['name']] = common.InstanceInfo(
+            instance_id=vm['name'],
+            hosts=[common.HostInfo(host_id=vm['name'],
+                                   internal_ip=nic.get('networkIP', ''),
+                                   external_ip=external)],
+            status='running', tags=dict(vm.get('labels', {})))
+        if vm.get('labels', {}).get(HEAD_LABEL) == 'true':
+            head_id = vm['name']
+    if head_id is None and instances:
+        head_id = sorted(instances)[0]
+    return common.ClusterInfo(
+        instances=instances, head_instance_id=head_id,
+        provider_name='gcp', provider_config=provider_config,
+        ssh_user=provider_config.get('ssh_user', 'skytpu'),
+        ssh_private_key=provider_config.get('ssh_private_key'))
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str],
+               provider_config: Dict[str, Any]) -> None:
+    """One firewall rule per cluster allowing the requested TCP ports."""
+    project = provider_config['project_id']
+    network = provider_config.get('network') or 'global/networks/default'
+    t = gcp_adaptor.transport()
+    rule_name = f'{cluster_name_on_cloud}-open-ports'
+    body = {
+        'name': rule_name,
+        'network': network,
+        'direction': 'INGRESS',
+        'allowed': [{'IPProtocol': 'tcp', 'ports': list(ports)}],
+        'sourceRanges': ['0.0.0.0/0'],
+        'targetTags': [],
+    }
+    url = f'{gcp_adaptor.COMPUTE_API}/projects/{project}/global/firewalls'
+    try:
+        t.request('POST', url, json_body=body)
+    except gcp_adaptor.GcpApiError as e:
+        if e.status == 409:  # already exists: update in place
+            t.request('PATCH', f'{url}/{rule_name}',
+                      json_body={'allowed': body['allowed']})
+        else:
+            raise exceptions.ProvisionError(
+                f'Failed to open ports {ports}: {e}') from e

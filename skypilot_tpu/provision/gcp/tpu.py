@@ -1,0 +1,288 @@
+"""TPU-VM slice provisioning over the tpu.googleapis.com v2 REST API.
+
+Reference analog: sky/provision/gcp/instance_utils.py:1205
+(`GCPTPUVMInstance`: create :1438, per-host SSH via `networkEndpoints`).
+Differences: slices are first-class logical nodes (no TPU-node legacy
+path), and preempted slices map straight to 'terminated' so the managed
+-job recovery path terminates+relaunches (TPU slices cannot restart in
+place; reference clouds/gcp.py:1066).
+"""
+import logging
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.adaptors import gcp as gcp_adaptor
+from skypilot_tpu.provision import common
+
+logger = logging.getLogger(__name__)
+
+
+def _project_zone(pc):
+    project = pc.get('project_id')
+    if not project:
+        project = gcp_adaptor.default_project()
+        pc['project_id'] = project
+    return project, pc['zone']
+
+CLUSTER_LABEL = 'skytpu-cluster'
+HEAD_LABEL = 'skytpu-head'
+
+# TPU node states → provision-layer status.
+_STATE_MAP = {
+    'CREATING': 'pending',
+    'STARTING': 'pending',
+    'RESTARTING': 'pending',
+    'REPAIRING': 'pending',
+    'READY': 'running',
+    'STOPPING': 'stopping',
+    'STOPPED': 'stopped',
+    'SUSPENDING': 'stopping',
+    'SUSPENDED': 'stopped',
+    'DELETING': 'terminated',
+    'PREEMPTED': 'terminated',
+    'TERMINATED': 'terminated',
+    'HIDING': 'terminated',
+    'HIDDEN': 'terminated',
+    'UNHIDING': 'pending',
+}
+
+
+def _parent(project: str, zone: str) -> str:
+    return (f'{gcp_adaptor.TPU_API}/projects/{project}/locations/{zone}')
+
+
+def _node_name(cluster_name_on_cloud: str, index: int) -> str:
+    return f'{cluster_name_on_cloud}-{index}'
+
+
+def _list_cluster_nodes(project: str, zone: str,
+                        cluster_name_on_cloud: str) -> List[Dict[str, Any]]:
+    t = gcp_adaptor.transport()
+    nodes: List[Dict[str, Any]] = []
+    page_token: Optional[str] = None
+    while True:
+        params = {'pageSize': '100'}
+        if page_token:
+            params['pageToken'] = page_token
+        resp = t.request('GET', f'{_parent(project, zone)}/nodes',
+                         params=params)
+        for node in resp.get('nodes', []):
+            if node.get('labels', {}).get(
+                    CLUSTER_LABEL) == cluster_name_on_cloud:
+                nodes.append(node)
+        page_token = resp.get('nextPageToken')
+        if not page_token:
+            return nodes
+
+
+def _short_name(node: Dict[str, Any]) -> str:
+    return node['name'].rsplit('/', 1)[-1]
+
+
+def _node_status(node: Dict[str, Any]) -> str:
+    return _STATE_MAP.get(node.get('state', ''), 'pending')
+
+
+def _create_body(config: common.ProvisionConfig, index: int,
+                 cluster_name_on_cloud: str) -> Dict[str, Any]:
+    pc = config.provider_config
+    # Deploy variables may arrive via provider_config (backend path) or
+    # node_config (direct provision-API use); node_config wins.
+    nc = {**pc, **config.node_config}
+    labels = dict(nc.get('labels', {}))
+    labels.update(config.tags)
+    labels[CLUSTER_LABEL] = cluster_name_on_cloud
+    labels[HEAD_LABEL] = 'true' if index == 0 else 'false'
+    body: Dict[str, Any] = {
+        'acceleratorType': nc['accelerator_type'],
+        'runtimeVersion': nc['runtime_version'],
+        'labels': labels,
+        'networkConfig': {
+            'enableExternalIps': not pc.get('use_internal_ips', False),
+        },
+        'schedulingConfig': {
+            'preemptible': bool(nc.get('use_spot', False)),
+        },
+        'metadata': {},
+    }
+    if nc.get('use_spot') and pc.get('spot_as_spot', True):
+        # Modern flag (spot) over legacy preemptible where supported.
+        body['schedulingConfig'] = {'spot': True}
+    network = pc.get('network')
+    if network:
+        body['networkConfig']['network'] = network
+    subnetwork = pc.get('subnetwork')
+    if subnetwork:
+        body['networkConfig']['subnetwork'] = subnetwork
+    ssh_pub = config.authentication_config.get('ssh_public_key_content')
+    ssh_user = config.authentication_config.get('ssh_user', 'skytpu')
+    if ssh_pub:
+        body['metadata']['ssh-keys'] = f'{ssh_user}:{ssh_pub}'
+    startup = nc.get('startup_script')
+    if startup:
+        body['metadata']['startup-script'] = startup
+    reservation = nc.get('reservation')
+    if reservation:
+        body['schedulingConfig']['reserved'] = True
+        body['reservedResource'] = {'reservationName': reservation}
+    return body
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    del region  # TPU API is zonal
+    pc = config.provider_config
+    project, zone = _project_zone(pc)
+    t = gcp_adaptor.transport()
+
+    existing = {_short_name(n): n
+                for n in _list_cluster_nodes(project, zone,
+                                             cluster_name_on_cloud)}
+    created: List[str] = []
+    resumed: List[str] = []
+    operations: List[Dict[str, Any]] = []
+    for i in range(config.count):
+        name = _node_name(cluster_name_on_cloud, i)
+        node = existing.get(name)
+        status = _node_status(node) if node else None
+        if status == 'running':
+            continue
+        if status == 'stopped' and config.resume_stopped_nodes:
+            try:
+                op = t.request(
+                    'POST', f'{_parent(project, zone)}/nodes/{name}:start')
+            except gcp_adaptor.GcpApiError as e:
+                raise gcp_adaptor.classify_api_error(e) from e
+            operations.append(op)
+            resumed.append(name)
+            continue
+        if status in ('pending', 'stopping'):
+            # In-flight from a previous attempt; wait below via state poll.
+            created.append(name)
+            continue
+        try:
+            op = t.request('POST', f'{_parent(project, zone)}/nodes',
+                           params={'nodeId': name},
+                           json_body=_create_body(config, i,
+                                                  cluster_name_on_cloud))
+        except gcp_adaptor.GcpApiError as e:
+            raise gcp_adaptor.classify_api_error(e) from e
+        operations.append(op)
+        created.append(name)
+
+    for op in operations:
+        if op.get('name'):
+            gcp_adaptor.wait_operation(
+                op, f'{gcp_adaptor.TPU_API}/{op["name"]}',
+                timeout=float(pc.get('provision_timeout', 900)))
+    _wait_all_ready(project, zone, cluster_name_on_cloud, config.count,
+                    timeout=float(pc.get('provision_timeout', 900)))
+    return common.ProvisionRecord(
+        provider_name='gcp', region=pc.get('region', zone.rsplit('-', 1)[0]),
+        zone=zone, cluster_name_on_cloud=cluster_name_on_cloud,
+        head_instance_id=_node_name(cluster_name_on_cloud, 0),
+        created_instance_ids=created, resumed_instance_ids=resumed)
+
+
+def _wait_all_ready(project: str, zone: str, cluster_name_on_cloud: str,
+                    count: int, timeout: float) -> None:
+    import time
+    deadline = time.time() + timeout
+    while True:
+        nodes = _list_cluster_nodes(project, zone, cluster_name_on_cloud)
+        statuses = {_short_name(n): _node_status(n) for n in nodes}
+        running = [n for n, s in statuses.items() if s == 'running']
+        if len(running) >= count:
+            return
+        bad = {n: s for n, s in statuses.items()
+               if s in ('terminated', 'stopped')}
+        if bad:
+            raise exceptions.CapacityError(
+                f'TPU slice(s) failed to reach READY: {bad}')
+        if time.time() > deadline:
+            raise exceptions.ProvisionError(
+                f'TPU slices not READY after {timeout:.0f}s: {statuses}')
+        time.sleep(5)
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Dict[str, Any]) -> None:
+    """Single-host TPU-VMs can stop; pod slices cannot (reference
+    clouds/gcp.py:216) — callers must terminate those instead."""
+    project, zone = _project_zone(provider_config)
+    t = gcp_adaptor.transport()
+    for node in _list_cluster_nodes(project, zone, cluster_name_on_cloud):
+        if len(node.get('networkEndpoints', [])) > 1:
+            raise exceptions.NotSupportedError(
+                f'TPU pod slice {_short_name(node)} cannot be stopped; '
+                'terminate it instead.')
+        if _node_status(node) == 'running':
+            op = t.request('POST', f'{gcp_adaptor.TPU_API}/{node["name"]}'
+                           ':stop')
+            if op.get('name'):
+                gcp_adaptor.wait_operation(
+                    op, f'{gcp_adaptor.TPU_API}/{op["name"]}')
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Dict[str, Any]) -> None:
+    project, zone = _project_zone(provider_config)
+    t = gcp_adaptor.transport()
+    ops = []
+    for node in _list_cluster_nodes(project, zone, cluster_name_on_cloud):
+        if _node_status(node) == 'terminated' and \
+                node.get('state') != 'PREEMPTED':
+            continue
+        # PREEMPTED slices still occupy quota until deleted (reference
+        # clouds/gcp.py:1066 cleanup-after-preemption).
+        try:
+            ops.append(t.request(
+                'DELETE', f'{gcp_adaptor.TPU_API}/{node["name"]}'))
+        except gcp_adaptor.GcpApiError as e:
+            if e.status != 404:
+                raise
+    for op in ops:
+        if op.get('name'):
+            gcp_adaptor.wait_operation(
+                op, f'{gcp_adaptor.TPU_API}/{op["name"]}')
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Dict[str, Any]
+                    ) -> Dict[str, Optional[str]]:
+    project, zone = _project_zone(provider_config)
+    return {
+        _short_name(n): _node_status(n)
+        for n in _list_cluster_nodes(project, zone, cluster_name_on_cloud)
+    }
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Dict[str, Any]) -> common.ClusterInfo:
+    del region
+    project, zone = _project_zone(provider_config)
+    instances: Dict[str, common.InstanceInfo] = {}
+    head_id: Optional[str] = None
+    for node in _list_cluster_nodes(project, zone, cluster_name_on_cloud):
+        if _node_status(node) != 'running':
+            continue
+        name = _short_name(node)
+        hosts = []
+        for idx, ep in enumerate(node.get('networkEndpoints', [])):
+            external = (ep.get('accessConfig') or {}).get('externalIp')
+            hosts.append(common.HostInfo(
+                host_id=f'{name}-w{idx}',
+                internal_ip=ep.get('ipAddress', ''),
+                external_ip=external))
+        instances[name] = common.InstanceInfo(
+            instance_id=name, hosts=hosts, status='running',
+            tags=dict(node.get('labels', {})))
+        if node.get('labels', {}).get(HEAD_LABEL) == 'true':
+            head_id = name
+    if head_id is None and instances:
+        head_id = sorted(instances)[0]
+    return common.ClusterInfo(
+        instances=instances, head_instance_id=head_id,
+        provider_name='gcp', provider_config=provider_config,
+        ssh_user=provider_config.get('ssh_user', 'skytpu'),
+        ssh_private_key=provider_config.get('ssh_private_key'))

@@ -1,0 +1,198 @@
+"""The API server: async request queue over HTTP (aiohttp).
+
+Reference analog: sky/server/server.py (FastAPI routes :409-1470,
+/api/stream :1158). Same contract, TPU build: every command POST returns
+a `request_id` immediately; clients poll GET /api/v1/requests/{id} or
+stream its log. The server process is shared by all clients on a machine
+(auto-started by the SDK, sky/server/common.py pattern).
+"""
+import asyncio
+import json
+import os
+from typing import Any, Dict
+
+import skypilot_tpu
+from skypilot_tpu.server import executor
+from skypilot_tpu.server import impl  # noqa: F401 — populates REGISTRY
+from skypilot_tpu.server import requests_db
+
+DEFAULT_PORT = 46590
+API_PREFIX = '/api/v1'
+
+# Commands that are quick DB/metadata reads → SHORT workers.
+_SHORT_REQUESTS = frozenset({
+    'status', 'queue', 'cost_report', 'check', 'optimize', 'autostop',
+    'cancel',
+})
+
+
+def _json_response(data: Any, status: int = 200):
+    from aiohttp import web
+    return web.json_response(data, status=status)
+
+
+async def _handle_command(request):
+    from aiohttp import web
+    name = request.match_info['name']
+    if name not in executor.REGISTRY:
+        raise web.HTTPNotFound(text=f'Unknown command {name!r}')
+    try:
+        payload: Dict[str, Any] = await request.json()
+    except json.JSONDecodeError:
+        payload = {}
+    schedule = 'short' if name in _SHORT_REQUESTS else 'long'
+    request_id = executor.get_executor().schedule(name, payload, schedule)
+    return _json_response({'request_id': request_id}, status=202)
+
+
+def _serialize_request(record: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(record)
+    out['status'] = record['status'].value
+    return out
+
+
+async def _handle_get_request(request):
+    from aiohttp import web
+    record = requests_db.get_request(request.match_info['request_id'])
+    if record is None:
+        raise web.HTTPNotFound(text='No such request')
+    return _json_response(_serialize_request(record))
+
+
+async def _handle_list_requests(request):
+    limit = int(request.query.get('limit', '100'))
+    return _json_response(
+        [_serialize_request(r) for r in requests_db.list_requests(limit)])
+
+
+async def _handle_cancel_request(request):
+    ok = executor.get_executor().cancel(request.match_info['request_id'])
+    return _json_response({'cancelled': ok})
+
+
+async def _handle_stream(request):
+    """Chunked-stream a request's log until it reaches a terminal state.
+
+    Reference analog: /api/stream (sky/server/server.py:1158) +
+    stream_utils.py. tail -f semantics over the request log file.
+    """
+    from aiohttp import web
+    request_id = request.match_info['request_id']
+    record = requests_db.get_request(request_id)
+    if record is None:
+        raise web.HTTPNotFound(text='No such request')
+    follow = request.query.get('follow', 'true').lower() != 'false'
+    resp = web.StreamResponse()
+    resp.content_type = 'text/plain'
+    await resp.prepare(request)
+    log_path = requests_db.request_log_path(request_id)
+    pos = 0
+    while True:
+        try:
+            with open(log_path, 'rb') as f:
+                f.seek(pos)
+                chunk = f.read()
+        except FileNotFoundError:
+            chunk = b''
+        if chunk:
+            pos += len(chunk)
+            await resp.write(chunk)
+        record = requests_db.get_request(request_id)
+        if not follow or record is None or record['status'].is_terminal:
+            if follow and record is not None:
+                # Drain anything written between read and status check.
+                with open(log_path, 'rb') as f:
+                    f.seek(pos)
+                    tail_chunk = f.read()
+                if tail_chunk:
+                    await resp.write(tail_chunk)
+            break
+        await asyncio.sleep(0.2)
+    await resp.write_eof()
+    return resp
+
+
+async def _handle_health(request):
+    return _json_response({
+        'status': 'healthy',
+        'version': skypilot_tpu.__version__,
+        'pid': os.getpid(),
+    })
+
+
+def create_app():
+    from aiohttp import web
+    app = web.Application()
+    app.router.add_get(f'{API_PREFIX}/health', _handle_health)
+    app.router.add_get(f'{API_PREFIX}/requests', _handle_list_requests)
+    app.router.add_get(f'{API_PREFIX}/requests/{{request_id}}',
+                       _handle_get_request)
+    app.router.add_post(f'{API_PREFIX}/requests/{{request_id}}/cancel',
+                        _handle_cancel_request)
+    app.router.add_get(f'{API_PREFIX}/requests/{{request_id}}/stream',
+                       _handle_stream)
+    app.router.add_post(f'{API_PREFIX}/{{name}}', _handle_command)
+    return app
+
+
+def run(host: str = '127.0.0.1', port: int = DEFAULT_PORT) -> None:
+    from aiohttp import web
+    web.run_app(create_app(), host=host, port=port, print=None)
+
+
+class ServerThread:
+    """In-process server for tests and the SDK's fallback mode."""
+
+    def __init__(self, port: int = 0) -> None:
+        self.port = port
+        self._loop = None
+        self._runner = None
+        self._thread = None
+
+    def __enter__(self) -> 'ServerThread':
+        import threading
+        ready = threading.Event()
+
+        def _serve():
+            from aiohttp import web
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+
+            async def _start():
+                self._runner = web.AppRunner(create_app())
+                await self._runner.setup()
+                site = web.TCPSite(self._runner, '127.0.0.1', self.port)
+                await site.start()
+                sock = site._server.sockets[0]  # noqa: SLF001
+                self.port = sock.getsockname()[1]
+            self._loop.run_until_complete(_start())
+            ready.set()
+            self._loop.run_forever()
+
+        self._thread = threading.Thread(target=_serve, daemon=True)
+        self._thread.start()
+        ready.wait(timeout=10)
+        return self
+
+    @property
+    def url(self) -> str:
+        return f'http://127.0.0.1:{self.port}'
+
+    def __exit__(self, *exc) -> None:
+        if self._loop is not None:
+            async def _stop():
+                if self._runner is not None:
+                    await self._runner.cleanup()
+            fut = asyncio.run_coroutine_threadsafe(_stop(), self._loop)
+            fut.result(timeout=10)
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(timeout=10)
+
+
+if __name__ == '__main__':
+    import argparse
+    parser = argparse.ArgumentParser(description='skypilot-tpu API server')
+    parser.add_argument('--host', default='127.0.0.1')
+    parser.add_argument('--port', type=int, default=DEFAULT_PORT)
+    args = parser.parse_args()
+    run(args.host, args.port)

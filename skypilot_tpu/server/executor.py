@@ -1,0 +1,128 @@
+"""Request executor: forked worker per request, bounded per schedule class.
+
+Reference analog: sky/server/requests/executor.py (`RequestWorker` :131,
+LONG/SHORT schedule classes :588, per-request fork
+`_request_execution_wrapper` :312). Each request runs in its own forked
+process with stdout/stderr teed to the request log file; results/errors
+land in the requests DB. Cancellation kills the process group.
+"""
+import json
+import multiprocessing
+import os
+import signal
+import threading
+import traceback
+from typing import Any, Callable, Dict, Optional
+
+from skypilot_tpu.server import requests_db
+
+# name -> callable(payload) -> JSON-able result. Populated by impl.py.
+REGISTRY: Dict[str, Callable[[Dict[str, Any]], Any]] = {}
+
+# Parallelism caps (reference sizes these by host memory; executor.py:588).
+_MAX_PARALLEL = {'long': 4, 'short': 16}
+
+_mp = multiprocessing.get_context('fork')
+
+
+def register(name: str):
+    def deco(fn):
+        REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+def _run_in_child(request_id: str, name: str,
+                  payload: Dict[str, Any]) -> None:
+    """Child-process body: redirect output, run, persist outcome."""
+    os.setsid()  # own process group => cancellable subtree
+    requests_db.reset_for_tests()  # never share the parent's connection
+    log_path = requests_db.request_log_path(request_id)
+    log_fd = os.open(log_path, os.O_WRONLY | os.O_APPEND | os.O_CREAT,
+                     0o644)
+    os.dup2(log_fd, 1)
+    os.dup2(log_fd, 2)
+    import sys
+    sys.stdout = os.fdopen(1, 'w', buffering=1)
+    sys.stderr = os.fdopen(2, 'w', buffering=1)
+    try:
+        fn = REGISTRY[name]
+        result = fn(payload)
+        json.dumps(result)  # fail loudly here, not in the DB layer
+        requests_db.set_result(request_id, result)
+    except BaseException as e:  # noqa: BLE001 — persist any failure
+        traceback.print_exc()
+        requests_db.set_error(request_id,
+                              f'{type(e).__name__}: {e}')
+        raise SystemExit(1) from e
+
+
+class Executor:
+    """Schedules requests onto forked workers with per-class caps."""
+
+    def __init__(self) -> None:
+        self._sems = {cls: threading.Semaphore(cap)
+                      for cls, cap in _MAX_PARALLEL.items()}
+        self._procs: Dict[str, multiprocessing.Process] = {}
+        self._lock = threading.Lock()
+
+    def schedule(self, name: str, payload: Dict[str, Any],
+                 schedule: str = 'long') -> str:
+        if name not in REGISTRY:
+            raise KeyError(f'Unknown request type {name!r}')
+        request_id = requests_db.create_request(name, payload, schedule)
+        thread = threading.Thread(
+            target=self._dispatch, args=(request_id, name, payload,
+                                         schedule),
+            daemon=True)
+        thread.start()
+        return request_id
+
+    def _dispatch(self, request_id: str, name: str,
+                  payload: Dict[str, Any], schedule: str) -> None:
+        sem = self._sems.get(schedule, self._sems['long'])
+        with sem:
+            record = requests_db.get_request(request_id)
+            if record is None or record['status'].is_terminal:
+                return  # cancelled while queued
+            proc = _mp.Process(target=_run_in_child,
+                               args=(request_id, name, payload))
+            proc.start()
+            with self._lock:
+                self._procs[request_id] = proc
+            requests_db.set_running(request_id, proc.pid or 0)
+            proc.join()
+            with self._lock:
+                self._procs.pop(request_id, None)
+            if proc.exitcode != 0:
+                # Crash without a DB write (OOM/SIGKILL): record it.
+                requests_db.set_error(
+                    request_id,
+                    f'Worker exited with code {proc.exitcode}')
+
+    def cancel(self, request_id: str) -> bool:
+        record = requests_db.get_request(request_id)
+        if record is None or record['status'].is_terminal:
+            return False
+        requests_db.set_error(request_id, 'Cancelled by user',
+                              cancelled=True)
+        with self._lock:
+            proc = self._procs.get(request_id)
+        if proc is not None and proc.pid:
+            try:
+                os.killpg(proc.pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+        return True
+
+
+_executor: Optional[Executor] = None
+_executor_lock = threading.Lock()
+
+
+def get_executor() -> Executor:
+    global _executor
+    with _executor_lock:
+        if _executor is None:
+            _executor = Executor()
+        return _executor

@@ -1,0 +1,170 @@
+"""Server-side request implementations: the executor's function registry.
+
+Reference analog: the reference executes sky/core.py + sky/execution.py
+functions inside forked workers (sky/server/requests/executor.py:312);
+this module is that binding layer — payload dict in, JSON-able result
+out. Log output (provision progress, job logs) goes to the request log
+file via the executor's fd redirection, which is what clients stream.
+"""
+import getpass
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.server import executor
+
+
+def _serialize_handle(handle) -> Optional[Dict[str, Any]]:
+    if handle is None:
+        return None
+    return {
+        'cluster_name': handle.cluster_name,
+        'cluster_name_on_cloud': handle.cluster_name_on_cloud,
+        'num_nodes': handle.num_nodes,
+        'resources': repr(handle.launched_resources),
+        'cloud': handle.cloud,
+        'head_ip': handle.head_ip(),
+    }
+
+
+def _serialize_record(record: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(record)
+    out['handle'] = _serialize_handle(record.get('handle'))
+    status = out.get('status')
+    if status is not None:
+        out['status'] = status.value
+    return out
+
+
+def _load_task(payload: Dict[str, Any]):
+    from skypilot_tpu import task as task_lib
+    return task_lib.Task.from_yaml_config(payload['task'],
+                                          env_overrides=payload.get('envs'))
+
+
+@executor.register('launch')
+def launch(payload: Dict[str, Any]) -> Dict[str, Any]:
+    from skypilot_tpu import execution
+    task = _load_task(payload)
+    job_id, handle = execution.launch(
+        task,
+        cluster_name=payload['cluster_name'],
+        dryrun=payload.get('dryrun', False),
+        stream_logs=True,
+        detach_run=payload.get('detach_run', False),
+        no_setup=payload.get('no_setup', False))
+    return {'job_id': job_id, 'handle': _serialize_handle(handle)}
+
+
+@executor.register('exec')
+def exec_cmd(payload: Dict[str, Any]) -> Dict[str, Any]:
+    from skypilot_tpu import execution
+    task = _load_task(payload)
+    job_id, handle = execution.exec_cmd(
+        task, cluster_name=payload['cluster_name'],
+        detach_run=payload.get('detach_run', False))
+    return {'job_id': job_id, 'handle': _serialize_handle(handle)}
+
+
+@executor.register('status')
+def status(payload: Dict[str, Any]) -> List[Dict[str, Any]]:
+    from skypilot_tpu import core
+    records = core.status(cluster_names=payload.get('cluster_names'),
+                          refresh=payload.get('refresh', False))
+    return [_serialize_record(r) for r in records]
+
+
+@executor.register('start')
+def start(payload: Dict[str, Any]) -> None:
+    from skypilot_tpu import core
+    core.start(payload['cluster_name'],
+               idle_minutes_to_autostop=payload.get('idle_minutes'),
+               down=payload.get('down', False))
+
+
+@executor.register('stop')
+def stop(payload: Dict[str, Any]) -> None:
+    from skypilot_tpu import core
+    core.stop(payload['cluster_name'])
+
+
+@executor.register('down')
+def down(payload: Dict[str, Any]) -> None:
+    from skypilot_tpu import core
+    core.down(payload['cluster_name'], purge=payload.get('purge', False))
+
+
+@executor.register('autostop')
+def autostop(payload: Dict[str, Any]) -> None:
+    from skypilot_tpu import core
+    core.autostop(payload['cluster_name'], payload.get('idle_minutes'),
+                  down_after=payload.get('down', False))
+
+
+@executor.register('queue')
+def queue(payload: Dict[str, Any]) -> List[Dict[str, Any]]:
+    from skypilot_tpu import core
+    return core.queue(payload['cluster_name'])
+
+
+@executor.register('cancel')
+def cancel(payload: Dict[str, Any]) -> Dict[str, Any]:
+    from skypilot_tpu import core
+    cancelled = core.cancel(payload['cluster_name'],
+                            job_ids=payload.get('job_ids'),
+                            all_jobs=payload.get('all_jobs', False))
+    return {'cancelled': cancelled}
+
+
+@executor.register('logs')
+def logs(payload: Dict[str, Any]) -> Dict[str, Any]:
+    """Job logs stream into THIS request's log file; clients stream it."""
+    from skypilot_tpu import core
+    rc = core.tail_logs(payload['cluster_name'],
+                        job_id=payload.get('job_id'),
+                        follow=payload.get('follow', True),
+                        tail=payload.get('tail', 0))
+    return {'exit_code': rc}
+
+
+@executor.register('cost_report')
+def cost_report(payload: Dict[str, Any]) -> List[Dict[str, Any]]:
+    from skypilot_tpu import core
+    out = []
+    for row in core.cost_report():
+        row = dict(row)
+        if row.get('status') is not None:
+            row['status'] = row['status'].value
+        out.append(row)
+    return out
+
+
+@executor.register('check')
+def check(payload: Dict[str, Any]) -> List[str]:
+    from skypilot_tpu import check as check_lib
+    return check_lib.check(refresh=True, quiet=True)
+
+
+@executor.register('optimize')
+def optimize(payload: Dict[str, Any]) -> Dict[str, Any]:
+    from skypilot_tpu import dag as dag_lib
+    from skypilot_tpu import optimizer as optimizer_lib
+    task = _load_task(payload)
+    dag = dag_lib.Dag()
+    dag.add(task)
+    optimizer_lib.Optimizer.optimize(
+        dag, minimize=optimizer_lib.OptimizeTarget[
+            payload.get('minimize', 'COST')])
+    chosen = task.best_resources
+    return {
+        'cloud': chosen.cloud,
+        'instance_type': chosen.instance_type,
+        'region': chosen.region,
+        'zone': chosen.zone,
+        'hourly_cost': getattr(chosen, '_hourly_cost', None),
+    }
+
+
+def server_user() -> str:
+    try:
+        return getpass.getuser()
+    except (KeyError, OSError):  # pragma: no cover
+        return 'unknown'

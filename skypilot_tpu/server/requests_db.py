@@ -1,0 +1,170 @@
+"""API-server request persistence: SQLite records + per-request logs.
+
+Reference analog: sky/server/requests/requests.py:121 (`Request`
+dataclass, create_table :396, per-request log file). Every API call
+becomes an async request executed by the executor; clients poll
+`get_request` or stream the log file.
+"""
+import enum
+import json
+import os
+import sqlite3
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import paths
+
+_lock = threading.Lock()
+_conn: Optional[sqlite3.Connection] = None
+_conn_path: Optional[str] = None
+
+
+class RequestStatus(enum.Enum):
+    PENDING = 'PENDING'
+    RUNNING = 'RUNNING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    CANCELLED = 'CANCELLED'
+
+    @property
+    def is_terminal(self) -> bool:
+        return self in (RequestStatus.SUCCEEDED, RequestStatus.FAILED,
+                        RequestStatus.CANCELLED)
+
+
+def requests_db_path() -> str:
+    return os.path.join(paths.state_dir(), 'api_requests.db')
+
+
+def request_log_path(request_id: str) -> str:
+    d = os.path.join(paths.state_dir(), 'api_logs')
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, f'{request_id}.log')
+
+
+def _get_conn() -> sqlite3.Connection:
+    global _conn, _conn_path
+    path = requests_db_path()
+    with _lock:
+        if _conn is None or _conn_path != path:
+            _conn = sqlite3.connect(path, check_same_thread=False,
+                                    timeout=30.0)
+            _conn.execute('PRAGMA journal_mode=WAL')
+            _conn.execute("""
+                CREATE TABLE IF NOT EXISTS requests (
+                    request_id TEXT PRIMARY KEY,
+                    name TEXT,
+                    payload TEXT,
+                    status TEXT,
+                    schedule TEXT,
+                    created_at REAL,
+                    started_at REAL,
+                    finished_at REAL,
+                    result TEXT,
+                    error TEXT,
+                    pid INTEGER
+                )""")
+            _conn.commit()
+            _conn_path = path
+        return _conn
+
+
+def reset_for_tests() -> None:
+    global _conn, _conn_path
+    with _lock:
+        if _conn is not None:
+            _conn.close()
+        _conn = None
+        _conn_path = None
+
+
+def create_request(name: str, payload: Dict[str, Any],
+                   schedule: str = 'long') -> str:
+    request_id = uuid.uuid4().hex[:16]
+    conn = _get_conn()
+    with _lock:
+        conn.execute(
+            'INSERT INTO requests (request_id, name, payload, status, '
+            'schedule, created_at) VALUES (?,?,?,?,?,?)',
+            (request_id, name, json.dumps(payload),
+             RequestStatus.PENDING.value, schedule, time.time()))
+        conn.commit()
+    # Touch the log file so streams can open it immediately.
+    open(request_log_path(request_id), 'a', encoding='utf-8').close()
+    return request_id
+
+
+def set_running(request_id: str, pid: int) -> None:
+    conn = _get_conn()
+    with _lock:
+        conn.execute(
+            'UPDATE requests SET status=?, started_at=?, pid=? '
+            'WHERE request_id=? AND status=?',
+            (RequestStatus.RUNNING.value, time.time(), pid, request_id,
+             RequestStatus.PENDING.value))
+        conn.commit()
+
+
+def set_result(request_id: str, result: Any) -> None:
+    conn = _get_conn()
+    with _lock:
+        conn.execute(
+            'UPDATE requests SET status=?, finished_at=?, result=? '
+            'WHERE request_id=?',
+            (RequestStatus.SUCCEEDED.value, time.time(),
+             json.dumps(result), request_id))
+        conn.commit()
+
+
+def set_error(request_id: str, error: str,
+              cancelled: bool = False) -> None:
+    status = (RequestStatus.CANCELLED if cancelled else
+              RequestStatus.FAILED)
+    conn = _get_conn()
+    with _lock:
+        conn.execute(
+            'UPDATE requests SET status=?, finished_at=?, error=? '
+            'WHERE request_id=? AND status IN (?,?)',
+            (status.value, time.time(), error, request_id,
+             RequestStatus.PENDING.value, RequestStatus.RUNNING.value))
+        conn.commit()
+
+
+_COLS = ('request_id, name, payload, status, schedule, created_at, '
+         'started_at, finished_at, result, error, pid')
+
+
+def _row_to_record(row) -> Dict[str, Any]:
+    (request_id, name, payload, status, schedule, created_at, started_at,
+     finished_at, result, error, pid) = row
+    return {
+        'request_id': request_id,
+        'name': name,
+        'payload': json.loads(payload) if payload else None,
+        'status': RequestStatus(status),
+        'schedule': schedule,
+        'created_at': created_at,
+        'started_at': started_at,
+        'finished_at': finished_at,
+        'result': json.loads(result) if result else None,
+        'error': error,
+        'pid': pid,
+    }
+
+
+def get_request(request_id: str) -> Optional[Dict[str, Any]]:
+    conn = _get_conn()
+    row = conn.execute(
+        f'SELECT {_COLS} FROM requests WHERE request_id=?',
+        (request_id,)).fetchone()
+    return _row_to_record(row) if row else None
+
+
+def list_requests(limit: int = 100) -> List[Dict[str, Any]]:
+    conn = _get_conn()
+    rows = conn.execute(
+        f'SELECT {_COLS} FROM requests ORDER BY created_at DESC LIMIT ?',
+        (limit,)).fetchall()
+    return [_row_to_record(r) for r in rows]

@@ -117,3 +117,9 @@ def fill_template(template: str, variables: Dict[str, Any]) -> str:
     import jinja2  # lazy: keep base import light
     return jinja2.Template(template,
                            undefined=jinja2.StrictUndefined).render(**variables)
+
+
+def generate_cluster_name() -> str:
+    """tsky-<user>-<4 hex> (reference generate_cluster_name pattern)."""
+    user = re.sub(r'[^a-z0-9-]', '', os.environ.get('USER', 'user').lower())
+    return f'tsky-{user or "user"}-{uuid.uuid4().hex[:4]}'

@@ -1,0 +1,243 @@
+"""GCP provisioner against a fake tpu/compute REST API.
+
+Mirrors the reference's zero-credential strategy (moto-backed provisioning
+tests, tests/common_test_fixtures.py:414 mock_aws_backend): the REAL
+provisioner code runs end-to-end; only the HTTP transport is fake.
+"""
+import re
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.adaptors import gcp as gcp_adaptor
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision import gcp as gcp_provision
+
+
+class FakeGcpApi:
+    """In-memory TPU + compute API with the REST shapes we use."""
+
+    def __init__(self):
+        self.tpu_nodes = {}   # name -> node dict
+        self.vms = {}         # name -> vm dict
+        self.fail_create_with = None  # optional GcpApiError to raise
+        self.create_calls = []
+
+    # -- transport interface --
+    def request(self, method, url, params=None, json_body=None):
+        params = params or {}
+        if 'tpu.googleapis.com' in url:
+            return self._tpu(method, url, params, json_body)
+        return self._compute(method, url, params, json_body)
+
+    def _tpu(self, method, url, params, body):
+        m = re.search(r'projects/(?P<p>[^/]+)/locations/(?P<z>[^/]+)', url)
+        if method == 'GET' and url.endswith('/nodes'):
+            return {'nodes': list(self.tpu_nodes.values())}
+        if method == 'POST' and url.endswith('/nodes'):
+            self.create_calls.append(body)
+            if self.fail_create_with is not None:
+                raise self.fail_create_with
+            name = params['nodeId']
+            n_hosts = self._hosts_for(body['acceleratorType'])
+            node = dict(
+                body,
+                name=f'projects/{m["p"]}/locations/{m["z"]}/nodes/{name}',
+                state='READY',
+                networkEndpoints=[
+                    {'ipAddress': f'10.0.0.{i + 1}',
+                     'accessConfig': {'externalIp': f'34.1.0.{i + 1}'}}
+                    for i in range(n_hosts)
+                ])
+            self.tpu_nodes[name] = node
+            return {'done': True}
+        if method == 'POST' and url.endswith(':stop'):
+            name = url.rsplit('/', 1)[-1][:-len(':stop')]
+            self.tpu_nodes[name]['state'] = 'STOPPED'
+            return {'done': True}
+        if method == 'POST' and url.endswith(':start'):
+            name = url.rsplit('/', 1)[-1][:-len(':start')]
+            self.tpu_nodes[name]['state'] = 'READY'
+            return {'done': True}
+        if method == 'DELETE':
+            name = url.rsplit('/', 1)[-1]
+            self.tpu_nodes.pop(name, None)
+            return {'done': True}
+        raise AssertionError(f'unexpected TPU call {method} {url}')
+
+    @staticmethod
+    def _hosts_for(accelerator_type):
+        gen, size = accelerator_type.rsplit('-', 1)
+        chips = int(size) // (1 if gen in ('v5litepod', 'v6e') else 2)
+        per_host = 8 if gen in ('v5litepod', 'v6e') else 4
+        return max(1, -(-chips // per_host))
+
+    def _compute(self, method, url, params, body):
+        if method == 'GET' and url.endswith('/instances'):
+            flt = params.get('filter', '')
+            m = re.search(r'labels\.(\S+)=(\S+)', flt)
+            items = [v for v in self.vms.values()
+                     if not m or v['labels'].get(m[1]) == m[2]]
+            return {'items': items}
+        if method == 'POST' and url.endswith('/instances'):
+            if self.fail_create_with is not None:
+                raise self.fail_create_with
+            vm = dict(body, status='RUNNING', networkInterfaces=[{
+                'networkIP': f'10.1.0.{len(self.vms) + 1}',
+                'accessConfigs': [{'natIP': f'34.2.0.{len(self.vms) + 1}'}],
+            }])
+            self.vms[body['name']] = vm
+            return {'status': 'DONE'}
+        if method == 'POST' and url.endswith('/stop'):
+            name = url.rsplit('/', 2)[-2]
+            self.vms[name]['status'] = 'TERMINATED'
+            return {'status': 'DONE'}
+        if method == 'POST' and url.endswith('/start'):
+            name = url.rsplit('/', 2)[-2]
+            self.vms[name]['status'] = 'RUNNING'
+            return {'status': 'DONE'}
+        if method == 'DELETE':
+            self.vms.pop(url.rsplit('/', 1)[-1], None)
+            return {'status': 'DONE'}
+        if method == 'POST' and url.endswith('/firewalls'):
+            return {'status': 'DONE'}
+        raise AssertionError(f'unexpected compute call {method} {url}')
+
+
+@pytest.fixture
+def fake_api(monkeypatch):
+    api = FakeGcpApi()
+    gcp_adaptor.set_transport_factory(lambda: api)
+    yield api
+    gcp_adaptor.set_transport_factory(
+        lambda: (_ for _ in ()).throw(AssertionError('no transport')))
+
+
+def _tpu_config(count=1, accelerator_type='v5litepod-8', use_spot=False):
+    return common.ProvisionConfig(
+        provider_config={'project_id': 'proj', 'zone': 'us-west4-a',
+                         'tpu_vm': True, 'region': 'us-west4'},
+        authentication_config={'ssh_user': 'skytpu',
+                               'ssh_public_key_content': 'ssh-ed25519 KEY'},
+        node_config={'accelerator_type': accelerator_type,
+                     'runtime_version': 'v2-alpha-tpuv5-lite',
+                     'use_spot': use_spot},
+        count=count)
+
+
+def test_tpu_create_single_host(fake_api):
+    record = gcp_provision.run_instances('us-west4', 'c-abc12',
+                                         _tpu_config())
+    assert record.head_instance_id == 'c-abc12-0'
+    assert record.created_instance_ids == ['c-abc12-0']
+    info = gcp_provision.get_cluster_info(
+        'us-west4', 'c-abc12',
+        {'project_id': 'proj', 'zone': 'us-west4-a', 'tpu_vm': True})
+    assert info.num_instances == 1
+    inst = info.get_head_instance()
+    assert inst.num_hosts == 1
+    assert inst.hosts[0].internal_ip == '10.0.0.1'
+    # ssh key landed in metadata
+    assert 'ssh-keys' in fake_api.create_calls[0]['metadata']
+
+
+def test_tpu_pod_slice_multi_host(fake_api):
+    # v5litepod-32: 32 chips, 8 per host -> 4 host VMs in one logical node.
+    gcp_provision.run_instances(
+        'us-west4', 'pod-1', _tpu_config(accelerator_type='v5litepod-32'))
+    info = gcp_provision.get_cluster_info(
+        'us-west4', 'pod-1',
+        {'project_id': 'proj', 'zone': 'us-west4-a', 'tpu_vm': True})
+    assert info.get_head_instance().num_hosts == 4
+    runners = gcp_provision.get_command_runners(info)
+    assert len(runners) == 4
+
+
+def test_tpu_idempotent_relaunch(fake_api):
+    cfg = _tpu_config()
+    gcp_provision.run_instances('us-west4', 'c-1', cfg)
+    record = gcp_provision.run_instances('us-west4', 'c-1', cfg)
+    assert record.created_instance_ids == []  # already READY: no new create
+    assert len(fake_api.create_calls) == 1
+
+
+def test_tpu_resume_stopped(fake_api):
+    pc = {'project_id': 'proj', 'zone': 'us-west4-a', 'tpu_vm': True}
+    gcp_provision.run_instances('us-west4', 'c-1', _tpu_config())
+    gcp_provision.stop_instances('c-1', pc)
+    assert gcp_provision.query_instances('c-1', pc) == {'c-1-0': 'stopped'}
+    record = gcp_provision.run_instances('us-west4', 'c-1', _tpu_config())
+    assert record.resumed_instance_ids == ['c-1-0']
+    assert gcp_provision.query_instances('c-1', pc) == {'c-1-0': 'running'}
+
+
+def test_tpu_pod_cannot_stop(fake_api):
+    pc = {'project_id': 'proj', 'zone': 'us-west4-a', 'tpu_vm': True}
+    gcp_provision.run_instances(
+        'us-west4', 'pod-1', _tpu_config(accelerator_type='v5litepod-32'))
+    with pytest.raises(exceptions.NotSupportedError):
+        gcp_provision.stop_instances('pod-1', pc)
+
+
+def test_tpu_terminate(fake_api):
+    pc = {'project_id': 'proj', 'zone': 'us-west4-a', 'tpu_vm': True}
+    gcp_provision.run_instances('us-west4', 'c-1', _tpu_config())
+    gcp_provision.terminate_instances('c-1', pc)
+    assert gcp_provision.query_instances('c-1', pc) == {}
+
+
+def test_tpu_preempted_maps_terminated_and_cleanup(fake_api):
+    pc = {'project_id': 'proj', 'zone': 'us-west4-a', 'tpu_vm': True}
+    gcp_provision.run_instances('us-west4', 'c-1',
+                                _tpu_config(use_spot=True))
+    fake_api.tpu_nodes['c-1-0']['state'] = 'PREEMPTED'
+    assert gcp_provision.query_instances('c-1', pc) == {
+        'c-1-0': 'terminated'}
+    # terminate must delete the preempted node (it still holds quota).
+    gcp_provision.terminate_instances('c-1', pc)
+    assert fake_api.tpu_nodes == {}
+
+
+def test_quota_error_classified(fake_api):
+    fake_api.fail_create_with = gcp_adaptor.GcpApiError(
+        'quota exceeded for TPUS_PER_PROJECT', status=403,
+        reason='QUOTA_EXCEEDED')
+    with pytest.raises(exceptions.QuotaExceededError):
+        gcp_provision.run_instances('us-west4', 'c-1', _tpu_config())
+
+
+def test_stockout_error_classified(fake_api):
+    fake_api.fail_create_with = gcp_adaptor.GcpApiError(
+        'There is no more capacity in the zone', status=429)
+    with pytest.raises(exceptions.ProvisionError):
+        gcp_provision.run_instances('us-west4', 'c-1', _tpu_config())
+
+
+def test_spot_flag_in_create_body(fake_api):
+    gcp_provision.run_instances('us-west4', 'c-1',
+                                _tpu_config(use_spot=True))
+    body = fake_api.create_calls[0]
+    assert body['schedulingConfig'] == {'spot': True}
+
+
+def test_compute_vm_lifecycle(fake_api):
+    pc = {'project_id': 'proj', 'zone': 'us-central1-a', 'tpu_vm': False}
+    cfg = common.ProvisionConfig(
+        provider_config=pc,
+        authentication_config={'ssh_user': 'skytpu',
+                               'ssh_public_key_content': 'k'},
+        node_config={'instance_type': 'n2-standard-8', 'disk_size': 100},
+        count=2)
+    record = gcp_provision.run_instances('us-central1', 'ctrl', cfg)
+    assert len(record.created_instance_ids) == 2
+    info = gcp_provision.get_cluster_info('us-central1', 'ctrl', pc)
+    assert info.num_instances == 2
+    assert info.head_instance_id == 'ctrl-0'
+    gcp_provision.stop_instances('ctrl', pc)
+    assert set(gcp_provision.query_instances('ctrl', pc).values()) == {
+        'stopped'}
+    gcp_provision.run_instances('us-central1', 'ctrl', cfg)
+    assert set(gcp_provision.query_instances('ctrl', pc).values()) == {
+        'running'}
+    gcp_provision.terminate_instances('ctrl', pc)
+    assert gcp_provision.query_instances('ctrl', pc) == {}
