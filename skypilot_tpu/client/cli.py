@@ -268,6 +268,139 @@ def cost_report():
 
 
 @cli.group()
+def jobs():
+    """Managed jobs: auto-recovering from TPU preemption."""
+
+
+@jobs.command('launch')
+@click.argument('entrypoint', nargs=-1)
+@click.option('--name', '-n', default=None)
+@click.option('--num-nodes', type=int, default=None)
+@click.option('--gpus', '--accelerators', 'accelerators', default=None)
+@click.option('--infra', '--cloud', 'cloud', default=None)
+@click.option('--workdir', default=None)
+@click.option('--env', multiple=True)
+@click.option('--max-recoveries', type=int, default=3)
+@click.option('--strategy', default='EAGER_NEXT_REGION',
+              type=click.Choice(['FAILOVER', 'EAGER_NEXT_REGION'],
+                                case_sensitive=False))
+@click.option('--detach-run', '-d', is_flag=True)
+def jobs_launch(entrypoint, name, num_nodes, accelerators, cloud, workdir,
+                env, max_recoveries, strategy, detach_run):
+    """Launch a managed job (controller relaunches it on preemption)."""
+    from skypilot_tpu.client import sdk
+    task = _task_from_args(entrypoint, None, num_nodes, accelerators,
+                           cloud, workdir, env, name)
+    result = sdk.get(sdk.jobs_launch(task, name=name,
+                                     max_recoveries=max_recoveries,
+                                     strategy=strategy.upper()))
+    job_id = result['job_id']
+    click.echo(f'Managed job {job_id} submitted.')
+    if not detach_run:
+        request_id = sdk.jobs_logs(job_id, follow=True)
+        _run_and_stream(request_id)
+
+
+@jobs.command('queue')
+def jobs_queue_cmd():
+    """List managed jobs."""
+    from skypilot_tpu.client import sdk
+    rows = sdk.get(sdk.jobs_queue())
+    fmt = '{:<6} {:<20} {:<18} {:<10} {}'
+    click.echo(fmt.format('ID', 'NAME', 'STATUS', 'RECOVERIES',
+                          'CLUSTER'))
+    for r in rows:
+        click.echo(fmt.format(r['job_id'], r.get('name') or '-',
+                              r['status'], r['recovery_count'],
+                              r.get('cluster_name') or '-'))
+
+
+@jobs.command('cancel')
+@click.argument('job_ids', nargs=-1, type=int)
+@click.option('--all', 'all_jobs', is_flag=True)
+@click.option('--yes', '-y', is_flag=True)
+def jobs_cancel_cmd(job_ids, all_jobs, yes):
+    """Cancel managed job(s)."""
+    from skypilot_tpu.client import sdk
+    if not yes:
+        target = 'ALL managed jobs' if all_jobs else f'jobs {job_ids}'
+        click.confirm(f'Cancel {target}?', abort=True)
+    result = sdk.get(sdk.jobs_cancel(list(job_ids) or None, all_jobs))
+    click.echo(f'Cancelled: {result["cancelled"]}')
+
+
+@jobs.command('logs')
+@click.argument('job_id', type=int)
+@click.option('--no-follow', is_flag=True)
+def jobs_logs_cmd(job_id, no_follow):
+    """Tail a managed job's controller+job logs."""
+    from skypilot_tpu.client import sdk
+    request_id = sdk.jobs_logs(job_id, follow=not no_follow)
+    _run_and_stream(request_id)
+
+
+@cli.group()
+def serve():
+    """Serve models behind an autoscaled load balancer."""
+
+
+@serve.command('up')
+@click.argument('entrypoint', nargs=-1, required=True)
+@click.option('--service-name', '-n', default=None)
+def serve_up_cmd(entrypoint, service_name):
+    """Bring up a service from a task YAML with a service: section."""
+    from skypilot_tpu.client import sdk
+    from skypilot_tpu.utils import common_utils
+    task = _task_from_args(entrypoint, None, None, None, None, None, None,
+                           None)
+    service_name = service_name or common_utils.generate_cluster_name(
+    ).replace('tsky-', 'svc-')
+    result = sdk.get(sdk.serve_up(task, service_name))
+    click.echo(f'Service {service_name!r} starting; endpoint: '
+               f'{result["endpoint"]}')
+
+
+@serve.command('status')
+@click.argument('service_names', nargs=-1)
+def serve_status_cmd(service_names):
+    """Show services and their replicas."""
+    from skypilot_tpu.client import sdk
+    rows = sdk.get(sdk.serve_status(list(service_names) or None))
+    if not rows:
+        click.echo('No services.')
+        return
+    for s in rows:
+        click.echo(f'{s["name"]}  {s["status"]}  {s["endpoint"]}  '
+                   f'v{s["version"]}')
+        for r in s['replicas']:
+            click.echo(f'  replica {r["replica_id"]}: {r["status"]} '
+                       f'({r["cluster_name"]})')
+
+
+@serve.command('down')
+@click.argument('service_names', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True)
+@click.option('--purge', is_flag=True)
+def serve_down_cmd(service_names, yes, purge):
+    """Tear down service(s) and their replicas."""
+    from skypilot_tpu.client import sdk
+    if not yes:
+        click.confirm(f'Tear down {", ".join(service_names)}?', abort=True)
+    for name in service_names:
+        sdk.get(sdk.serve_down(name, purge=purge))
+        click.echo(f'Service {name!r} terminated.')
+
+
+@serve.command('logs')
+@click.argument('service_name')
+@click.option('--no-follow', is_flag=True)
+def serve_logs_cmd(service_name, no_follow):
+    """Tail a service's controller log."""
+    from skypilot_tpu.client import sdk
+    _run_and_stream(sdk.serve_logs(service_name, follow=not no_follow))
+
+
+@cli.group()
 def api():
     """Manage the API server."""
 

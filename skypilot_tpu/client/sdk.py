@@ -224,3 +224,54 @@ def check() -> str:
 def optimize(task, minimize: str = 'COST') -> str:
     return _submit('optimize', {'task': task.to_yaml_config(),
                                 'minimize': minimize})
+
+
+# --- managed jobs -----------------------------------------------------------
+
+def jobs_launch(task, name: Optional[str] = None,
+                max_recoveries: int = 3,
+                strategy: str = 'EAGER_NEXT_REGION') -> str:
+    return _submit('jobs_launch', {
+        'task': task.to_yaml_config(),
+        'name': name,
+        'max_recoveries': max_recoveries,
+        'strategy': strategy,
+    })
+
+
+def jobs_queue() -> str:
+    return _submit('jobs_queue', {})
+
+
+def jobs_cancel(job_ids: Optional[List[int]] = None,
+                all_jobs: bool = False) -> str:
+    return _submit('jobs_cancel', {'job_ids': job_ids,
+                                   'all_jobs': all_jobs})
+
+
+def jobs_logs(job_id: int, follow: bool = True) -> str:
+    return _submit('jobs_logs', {'job_id': job_id, 'follow': follow})
+
+
+# --- serve ------------------------------------------------------------------
+
+def serve_up(task, service_name: str, wait_seconds: float = 0.0) -> str:
+    return _submit('serve_up', {
+        'task': task.to_yaml_config(),
+        'service_name': service_name,
+        'wait_seconds': wait_seconds,
+    })
+
+
+def serve_down(service_name: str, purge: bool = False) -> str:
+    return _submit('serve_down', {'service_name': service_name,
+                                  'purge': purge})
+
+
+def serve_status(service_names: Optional[List[str]] = None) -> str:
+    return _submit('serve_status', {'service_names': service_names})
+
+
+def serve_logs(service_name: str, follow: bool = True) -> str:
+    return _submit('serve_logs', {'service_name': service_name,
+                                  'follow': follow})

@@ -41,6 +41,7 @@ def launch(task_or_dag, *, cluster_name: str,
            dryrun: bool = False, stream_logs: bool = True,
            detach_run: bool = False, optimize_target=None,
            no_setup: bool = False,
+           blocked_resources: Optional[List] = None,
            backend: Optional[gang_backend.GangBackend] = None
            ) -> Tuple[Optional[int], Optional[gang_backend.ClusterHandle]]:
     """Provision (if needed) + sync + run. Returns (job_id, handle)."""
@@ -57,7 +58,7 @@ def launch(task_or_dag, *, cluster_name: str,
              existing['status'] == state.ClusterStatus.UP)
 
     handle = None
-    blocked: List = []
+    blocked: List = list(blocked_resources or [])
     for attempt in range(_MAX_CLOUD_FAILOVERS):
         if reuse:
             to_provision = None

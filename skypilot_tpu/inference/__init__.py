@@ -1,0 +1,13 @@
+"""Inference: KV-cache prefill/decode engine + continuous batching.
+
+The TPU-native serving path (JetStream/vLLM-TPU analog) — the reference
+ships no inference code, only recipes that shell out to vLLM
+(llm/vllm/serve.yaml; SURVEY.md §2.11). This subsystem is additive:
+`serve:` recipes point at `python -m skypilot_tpu.inference.server`.
+"""
+from skypilot_tpu.inference.engine import (DecodeState, InferenceEngine,
+                                           SamplingParams, decode_step,
+                                           init_cache, prefill)
+
+__all__ = ['DecodeState', 'InferenceEngine', 'SamplingParams',
+           'decode_step', 'init_cache', 'prefill']

@@ -1,0 +1,390 @@
+"""KV-cache inference engine: jitted prefill + decode, continuous batching.
+
+TPU-first design:
+- The KV cache is ONE stacked array per k/v across layers
+  ([L, B, S_max, KV_heads, D], bf16) so the decode step is a single
+  `lax.scan` over layers — compile time O(1) in depth, and XLA pipelines
+  the per-layer cache reads from HBM.
+- Static shapes everywhere: the cache is padded to `max_seq_len`;
+  attention masks by position rather than slicing, so one compiled
+  decode step serves every request length (no recompiles mid-flight).
+- Continuous batching happens at the SLOT level: the jitted step always
+  processes [B] slots; the host-side engine inserts/evicts requests into
+  slots between steps (JetStream-style).
+- Per-slot sampling params (temperature/top-k) are jnp arrays, so mixed
+  greedy/sampled batches run in the same compiled step.
+
+Reference analog: none — SkyPilot recipes shell out to vLLM
+(llm/vllm/serve.yaml:26); this replaces that external dependency with a
+TPU-native engine.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from skypilot_tpu.models import llama
+
+Params = Dict[str, Any]
+Cache = Dict[str, jax.Array]
+
+_NEG_INF = -1e30
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    temperature: float = 0.0     # 0 => greedy
+    top_k: int = 0               # 0 => no top-k filtering
+    max_new_tokens: int = 128
+    eos_token_id: Optional[int] = None
+
+
+def init_cache(config: llama.LlamaConfig, batch_size: int,
+               max_seq_len: Optional[int] = None) -> Cache:
+    """Zeroed KV cache + per-slot lengths."""
+    c = config
+    s = max_seq_len or c.max_seq_len
+    shape = (c.num_layers, batch_size, s, c.num_kv_heads, c.head_dim)
+    return {
+        'k': jnp.zeros(shape, c.dtype),
+        'v': jnp.zeros(shape, c.dtype),
+        # Per-slot number of valid cache positions.
+        'length': jnp.zeros((batch_size,), jnp.int32),
+    }
+
+
+def _cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+                      q_positions: jax.Array,
+                      lengths: jax.Array) -> jax.Array:
+    """Attention of q [B,T,H,D] against the padded cache [B,S,KV,D].
+
+    Valid keys per slot b: positions < lengths[b] (the cache already
+    contains this step's keys). Masking by position keeps shapes static.
+    """
+    num_heads = q.shape[2]
+    b, s, hkv, d = k_cache.shape
+    if hkv != num_heads:
+        reps = num_heads // hkv
+        k_cache = jnp.broadcast_to(
+            k_cache[:, :, :, None, :], (b, s, hkv, reps, d)
+        ).reshape(b, s, num_heads, d)
+        v_cache = jnp.broadcast_to(
+            v_cache[:, :, :, None, :], (b, s, hkv, reps, d)
+        ).reshape(b, s, num_heads, d)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum('bqhd,bkhd->bhqk', q, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    k_pos = jnp.arange(s)
+    # causal within the written region: key visible iff pos <= q_position
+    # and pos < length.
+    visible = (k_pos[None, None, :] <= q_positions[:, :, None]) & \
+        (k_pos[None, None, :] < lengths[:, None, None])
+    scores = jnp.where(visible[:, None], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
+    return jnp.einsum('bhqk,bkhd->bqhd', probs, v_cache)
+
+
+def _layer_with_cache(x: jax.Array, layer_params: Params,
+                      k_cache: jax.Array, v_cache: jax.Array,
+                      positions: jax.Array, lengths: jax.Array,
+                      write_at: jax.Array,
+                      config: llama.LlamaConfig
+                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One transformer layer over T new tokens with KV-cache update.
+
+    x: [B,T,E]; positions: [B,T] global positions of the new tokens;
+    write_at: [B] cache index where token 0 of this chunk lands.
+    """
+    c = config
+    h = llama._rms_norm(x, layer_params['attn_norm'], c.rms_norm_eps)
+    q = jnp.einsum('bse,ehd->bshd', h, layer_params['wq'],
+                   preferred_element_type=jnp.float32).astype(c.dtype)
+    k = jnp.einsum('bse,ehd->bshd', h, layer_params['wk'],
+                   preferred_element_type=jnp.float32).astype(c.dtype)
+    v = jnp.einsum('bse,ehd->bshd', h, layer_params['wv'],
+                   preferred_element_type=jnp.float32).astype(c.dtype)
+    q = llama._rope(q, positions, c.rope_theta)
+    k = llama._rope(k, positions, c.rope_theta)
+
+    # Scatter the T new KV entries into the cache at write_at per slot.
+    def write_one(cache_b, new_b, at_b):
+        return lax.dynamic_update_slice_in_dim(cache_b, new_b, at_b,
+                                               axis=0)
+    k_cache = jax.vmap(write_one)(k_cache, k, write_at)
+    v_cache = jax.vmap(write_one)(v_cache, v, write_at)
+
+    attn = _cached_attention(q, k_cache, v_cache, positions, lengths)
+    attn_out = jnp.einsum('bshd,hde->bse', attn.astype(c.dtype),
+                          layer_params['wo'],
+                          preferred_element_type=jnp.float32).astype(c.dtype)
+    x = x + attn_out
+
+    h = llama._rms_norm(x, layer_params['mlp_norm'], c.rms_norm_eps)
+    gate = jnp.einsum('bse,em->bsm', h, layer_params['w_gate'],
+                      preferred_element_type=jnp.float32)
+    up = jnp.einsum('bse,em->bsm', h, layer_params['w_up'],
+                    preferred_element_type=jnp.float32)
+    act = (jax.nn.silu(gate) * up).astype(c.dtype)
+    down = jnp.einsum('bsm,me->bse', act, layer_params['w_down'],
+                      preferred_element_type=jnp.float32).astype(c.dtype)
+    return x + down, k_cache, v_cache
+
+
+def _forward_with_cache(params: Params, tokens: jax.Array,
+                        cache: Cache, positions: jax.Array,
+                        write_at: jax.Array, new_lengths: jax.Array,
+                        config: llama.LlamaConfig
+                        ) -> Tuple[jax.Array, Cache]:
+    """tokens [B,T] at `positions` → (logits [B,T,V], updated cache)."""
+    c = config
+    x = params['embed'].astype(c.dtype)[tokens]
+
+    def body(x, per_layer):
+        layer_params, k_cache, v_cache = per_layer
+        x, k_cache, v_cache = _layer_with_cache(
+            x, layer_params, k_cache, v_cache, positions, new_lengths,
+            write_at, c)
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = lax.scan(body, x,
+                                 (params['layers'], cache['k'],
+                                  cache['v']))
+    x = llama._rms_norm(x, params['final_norm'], c.rms_norm_eps)
+    logits = jnp.einsum('bse,ev->bsv', x, params['lm_head'],
+                        preferred_element_type=jnp.float32)
+    return logits, {'k': new_k, 'v': new_v, 'length': new_lengths}
+
+
+@functools.partial(jax.jit, static_argnames=('config',))
+def prefill(params: Params, tokens: jax.Array, prompt_lengths: jax.Array,
+            cache: Cache, slot_ids: jax.Array,
+            config: llama.LlamaConfig) -> Tuple[jax.Array, Cache]:
+    """Process padded prompts [N,P] into cache slots `slot_ids` [N].
+
+    Returns last-token logits [N,V] (at each prompt's true last position)
+    and the updated cache. Right-padded prompts: positions beyond
+    prompt_lengths[i] are masked out of every slot's visible region
+    because length is set to the true prompt length.
+    """
+    n, p = tokens.shape
+    # Gather the target slots' caches, run, scatter back.
+    sub_cache = {
+        'k': cache['k'][:, slot_ids],
+        'v': cache['v'][:, slot_ids],
+    }
+    positions = jnp.broadcast_to(jnp.arange(p)[None], (n, p))
+    write_at = jnp.zeros((n,), jnp.int32)
+    logits, new_sub = _forward_with_cache(
+        params, tokens, sub_cache, positions, write_at, prompt_lengths,
+        config)
+    new_cache = {
+        'k': cache['k'].at[:, slot_ids].set(new_sub['k']),
+        'v': cache['v'].at[:, slot_ids].set(new_sub['v']),
+        'length': cache['length'].at[slot_ids].set(prompt_lengths),
+    }
+    last = jnp.take_along_axis(
+        logits, (prompt_lengths - 1)[:, None, None], axis=1)[:, 0]
+    return last, new_cache
+
+
+def _sample(logits: jax.Array, temperature: jax.Array, top_k: jax.Array,
+            key: jax.Array) -> jax.Array:
+    """Per-slot temperature/top-k sampling; temperature 0 => greedy."""
+    vocab = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1)
+    # top-k filter (top_k == 0 -> keep all).
+    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+    k_idx = jnp.clip(top_k - 1, 0, vocab - 1)
+    kth = jnp.where(
+        top_k > 0,
+        jnp.take_along_axis(sorted_logits, k_idx[:, None], axis=-1)[:, 0],
+        jnp.full((logits.shape[0],), -jnp.inf, logits.dtype))
+    filtered = jnp.where(logits >= kth[:, None], logits, _NEG_INF)
+    scaled = filtered / jnp.maximum(temperature, 1e-6)[:, None]
+    sampled = jax.random.categorical(key, scaled, axis=-1)
+    return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=('config',))
+def decode_step(params: Params, cache: Cache, last_tokens: jax.Array,
+                active: jax.Array, temperature: jax.Array,
+                top_k: jax.Array, key: jax.Array,
+                config: llama.LlamaConfig
+                ) -> Tuple[jax.Array, Cache]:
+    """One token for every slot [B]; inactive slots don't advance."""
+    b = last_tokens.shape[0]
+    lengths = cache['length']
+    positions = lengths[:, None]  # next position per slot
+    new_lengths = jnp.where(active, lengths + 1, lengths)
+    logits, new_cache = _forward_with_cache(
+        params, last_tokens[:, None], cache, positions, lengths,
+        jnp.where(active, new_lengths, lengths), config)
+    next_tokens = _sample(logits[:, 0], temperature, top_k, key)
+    next_tokens = jnp.where(active, next_tokens, last_tokens)
+    # Inactive slots must not grow; restore their cache rows lazily via
+    # length (stale writes beyond `length` are invisible to the mask).
+    new_cache['length'] = new_lengths
+    return next_tokens, new_cache
+
+
+@dataclasses.dataclass
+class _Slot:
+    request_id: int
+    params: SamplingParams
+    generated: List[int]
+    prompt_len: int
+    done: bool = False
+
+
+class DecodeState:
+    """Host-side view of the device cache + slots."""
+
+    def __init__(self, config: llama.LlamaConfig, batch_size: int,
+                 max_seq_len: Optional[int] = None):
+        self.config = config
+        self.batch_size = batch_size
+        self.max_seq_len = max_seq_len or config.max_seq_len
+        self.cache = init_cache(config, batch_size, self.max_seq_len)
+        self.last_tokens = jnp.zeros((batch_size,), jnp.int32)
+        self.slots: List[Optional[_Slot]] = [None] * batch_size
+
+
+class InferenceEngine:
+    """Continuous batching over a fixed slot count.
+
+    submit() enqueues prompts; step() prefills into free slots and runs
+    one decode step for all active slots; results stream out of
+    `finished()`.
+    """
+
+    def __init__(self, params: Params, config: llama.LlamaConfig,
+                 batch_size: int = 8,
+                 max_seq_len: Optional[int] = None,
+                 seed: int = 0):
+        self.params = params
+        self.config = config
+        self.state = DecodeState(config, batch_size, max_seq_len)
+        self._queue: List[Tuple[int, List[int], SamplingParams]] = []
+        self._finished: Dict[int, List[int]] = {}
+        self._next_id = 0
+        self._key = jax.random.key(seed)
+
+    # -- public --------------------------------------------------------------
+
+    def submit(self, prompt_tokens: List[int],
+               sampling: Optional[SamplingParams] = None) -> int:
+        request_id = self._next_id
+        self._next_id += 1
+        self._queue.append((request_id, list(prompt_tokens),
+                            sampling or SamplingParams()))
+        return request_id
+
+    def finished(self) -> Dict[int, List[int]]:
+        out, self._finished = self._finished, {}
+        return out
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self._queue) or any(
+            s is not None for s in self.state.slots)
+
+    def run_to_completion(self, max_steps: int = 100000
+                          ) -> Dict[int, List[int]]:
+        results: Dict[int, List[int]] = {}
+        steps = 0
+        while self.has_work and steps < max_steps:
+            self.step()
+            results.update(self.finished())
+            steps += 1
+        return results
+
+    # -- internals -----------------------------------------------------------
+
+    def _insert_from_queue(self) -> None:
+        free = [i for i, s in enumerate(self.state.slots) if s is None]
+        if not free or not self._queue:
+            return
+        inserts: List[Tuple[int, List[int], SamplingParams]] = []
+        slot_ids: List[int] = []
+        while free and self._queue:
+            slot = free.pop(0)
+            request_id, tokens, sampling = self._queue.pop(0)
+            tokens = tokens[:self.state.max_seq_len - 1]
+            self.state.slots[slot] = _Slot(request_id, sampling, [],
+                                           len(tokens))
+            inserts.append((request_id, tokens, sampling))
+            slot_ids.append(slot)
+        # Bucket the pad length to powers of two so prefill compiles a
+        # bounded number of shapes (JetStream-style bucketing).
+        max_len = max(len(t) for _, t, _ in inserts)
+        bucket = 16
+        while bucket < max_len:
+            bucket *= 2
+        bucket = min(bucket, self.state.max_seq_len - 1)
+        padded = jnp.array(
+            [t + [0] * (bucket - len(t)) for _, t, _ in inserts],
+            jnp.int32)
+        lengths = jnp.array([len(t) for _, t, _ in inserts], jnp.int32)
+        slot_arr = jnp.array(slot_ids, jnp.int32)
+        logits, self.state.cache = prefill(
+            self.params, padded, lengths, self.state.cache, slot_arr,
+            self.config)
+        # First generated token comes straight from prefill logits.
+        self._key, sub = jax.random.split(self._key)
+        temps = jnp.array([s.temperature for _, _, s in inserts],
+                          jnp.float32)
+        topks = jnp.array([s.top_k for _, _, s in inserts], jnp.int32)
+        first = _sample(logits, temps, topks, sub)
+        first_host = jax.device_get(first)
+        last = jax.device_get(self.state.last_tokens).copy()
+        for i, slot in enumerate(slot_ids):
+            token = int(first_host[i])
+            self.state.slots[slot].generated.append(token)
+            last[slot] = token
+        self.state.last_tokens = jnp.asarray(last)
+
+    def _evict_finished(self) -> None:
+        for i, slot in enumerate(self.state.slots):
+            if slot is None:
+                continue
+            s = slot.params
+            hit_eos = (s.eos_token_id is not None and slot.generated and
+                       slot.generated[-1] == s.eos_token_id)
+            full = (slot.prompt_len + len(slot.generated) >=
+                    self.state.max_seq_len - 1)
+            if hit_eos or full or len(slot.generated) >= s.max_new_tokens:
+                self._finished[slot.request_id] = slot.generated
+                self.state.slots[i] = None
+                # Free the cache slot by zeroing its length.
+                self.state.cache['length'] = \
+                    self.state.cache['length'].at[i].set(0)
+
+    def step(self) -> None:
+        self._evict_finished()
+        self._insert_from_queue()
+        active_mask = [s is not None for s in self.state.slots]
+        if not any(active_mask):
+            return
+        self._key, sub = jax.random.split(self._key)
+        temps = jnp.array(
+            [s.params.temperature if s else 0.0
+             for s in self.state.slots], jnp.float32)
+        topks = jnp.array(
+            [s.params.top_k if s else 0 for s in self.state.slots],
+            jnp.int32)
+        active = jnp.array(active_mask)
+        next_tokens, self.state.cache = decode_step(
+            self.params, self.state.cache, self.state.last_tokens, active,
+            temps, topks, sub, self.config)
+        self.state.last_tokens = next_tokens
+        tokens_host = jax.device_get(next_tokens)
+        for i, slot in enumerate(self.state.slots):
+            if slot is not None:
+                slot.generated.append(int(tokens_host[i]))
+        self._evict_finished()

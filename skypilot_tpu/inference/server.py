@@ -1,0 +1,97 @@
+"""Inference HTTP server: the `run:` target for serve recipes.
+
+The TPU-native replacement for `vllm serve ...` in reference recipes
+(llm/vllm/serve.yaml). Endpoints:
+  GET  /health            -> 200 when the engine is live (readiness probe)
+  POST /generate          -> {"prompt_tokens": [...], "max_new_tokens": N,
+                              "temperature": t, "top_k": k}
+                             => {"tokens": [...]}
+
+Token-id interface: tokenization happens client-side (transformers is
+available on dev boxes; the serving host stays tokenizer-free and the
+engine stays model-agnostic).
+"""
+import argparse
+import asyncio
+import json
+import threading
+from typing import Any, Dict
+
+
+def create_app(engine_holder: Dict[str, Any]):
+    from aiohttp import web
+
+    async def health(request):
+        ok = engine_holder.get('engine') is not None
+        return web.json_response({'status': 'ok' if ok else 'loading'},
+                                 status=200 if ok else 503)
+
+    async def generate(request):
+        engine = engine_holder.get('engine')
+        if engine is None:
+            return web.json_response({'error': 'model loading'},
+                                     status=503)
+        try:
+            body = await request.json()
+            prompt = [int(t) for t in body['prompt_tokens']]
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError):
+            return web.json_response(
+                {'error': 'need {"prompt_tokens": [ints]}'}, status=400)
+        from skypilot_tpu import inference as inf
+        params = inf.SamplingParams(
+            temperature=float(body.get('temperature', 0.0)),
+            top_k=int(body.get('top_k', 0)),
+            max_new_tokens=int(body.get('max_new_tokens', 64)),
+            eos_token_id=body.get('eos_token_id'))
+        lock: threading.Lock = engine_holder['lock']
+        loop = asyncio.get_running_loop()
+
+        def _run():
+            with lock:
+                rid = engine.submit(prompt, params)
+                results = engine.run_to_completion()
+            return results[rid]
+        tokens = await loop.run_in_executor(None, _run)
+        return web.json_response({'tokens': tokens})
+
+    app = web.Application()
+    app.router.add_get('/health', health)
+    app.router.add_get('/', health)
+    app.router.add_post('/generate', generate)
+    return app
+
+
+def main() -> None:
+    from aiohttp import web
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--model', default='tiny',
+                        help='Config name from models.llama.CONFIGS')
+    parser.add_argument('--port', type=int, default=8080)
+    parser.add_argument('--batch-size', type=int, default=8)
+    parser.add_argument('--max-seq-len', type=int, default=None)
+    parser.add_argument('--checkpoint', default=None,
+                        help='Orbax checkpoint dir with model params')
+    args = parser.parse_args()
+
+    holder: Dict[str, Any] = {'engine': None, 'lock': threading.Lock()}
+
+    def _load():
+        import jax
+        from skypilot_tpu import inference as inf
+        from skypilot_tpu.models import llama
+        config = llama.CONFIGS[args.model]
+        if args.checkpoint:
+            from skypilot_tpu.train import checkpoints
+            params = checkpoints.restore_params(args.checkpoint, config)
+        else:
+            params = llama.init_params(config, jax.random.key(0))
+        holder['engine'] = inf.InferenceEngine(
+            params, config, batch_size=args.batch_size,
+            max_seq_len=args.max_seq_len)
+
+    threading.Thread(target=_load, daemon=True).start()
+    web.run_app(create_app(holder), port=args.port, print=None)
+
+
+if __name__ == '__main__':
+    main()

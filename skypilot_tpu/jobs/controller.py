@@ -1,0 +1,184 @@
+"""Managed-job controller: one process per job; monitors and recovers.
+
+Reference analog: sky/jobs/controller.py:53 (`JobsController`,
+`_run_one_task` :119, run :468, start :617). The control loop:
+launch cluster -> poll the on-cluster job -> on cluster loss/preemption
+recover via the strategy -> terminal state -> terminate the cluster.
+"""
+import argparse
+import logging
+import os
+import time
+import traceback
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.jobs import recovery_strategy
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.skylet import job_lib
+
+logger = logging.getLogger(__name__)
+
+_POLL_INTERVAL_SECONDS = float(
+    os.environ.get('SKYTPU_JOBS_POLL_INTERVAL', '15'))
+
+
+class JobsController:
+
+    def __init__(self, managed_job_id: int) -> None:
+        self.job_id = managed_job_id
+        record = jobs_state.get_job(managed_job_id)
+        assert record is not None, managed_job_id
+        self.record = record
+        from skypilot_tpu import task as task_lib
+        self.task = task_lib.Task.from_yaml_config(record['task_yaml'])
+        self.cluster_name = (record['cluster_name'] or
+                             f'tsky-jobs-{managed_job_id}')
+        jobs_state.set_cluster_name(managed_job_id, self.cluster_name)
+        self.strategy = recovery_strategy.StrategyExecutor.make(
+            record['strategy'], self.task, self.cluster_name)
+
+    # -- cluster-side probes -------------------------------------------------
+
+    def _cluster_job_status(self, job_id: int
+                            ) -> Optional[job_lib.JobStatus]:
+        """Status of the on-cluster job; None == cluster lost (the
+        preemption signal, reference jobs/utils.py get_job_status)."""
+        from skypilot_tpu import core
+        from skypilot_tpu import state as state_lib
+        record = state_lib.get_cluster_from_name(self.cluster_name)
+        if record is None or record['handle'] is None:
+            return None
+        try:
+            queue = core.queue(self.cluster_name)
+        except exceptions.SkyTpuError:
+            return None
+        for job in queue:
+            if job['job_id'] == job_id:
+                return job_lib.JobStatus(job['status'])
+        return None
+
+    def _cluster_alive(self) -> bool:
+        """Cloud-truth liveness (catches preemption even while the skylet
+        is unreachable)."""
+        from skypilot_tpu import state as state_lib
+        from skypilot_tpu.backends import gang_backend
+        record = state_lib.get_cluster_from_name(self.cluster_name)
+        if record is None or record['handle'] is None:
+            return False
+        try:
+            status = gang_backend.GangBackend().query_status(
+                record['handle'])
+        except exceptions.SkyTpuError:
+            return False
+        from skypilot_tpu import state
+        return status == state.ClusterStatus.UP
+
+    def _tail_into_controller_log(self, cluster_job_id: int) -> None:
+        from skypilot_tpu import core
+        try:
+            core.tail_logs(self.cluster_name, job_id=cluster_job_id,
+                           follow=False)
+        except exceptions.SkyTpuError:
+            pass
+
+    # -- main loop -----------------------------------------------------------
+
+    def run(self) -> None:
+        try:
+            self._run()
+        except exceptions.ManagedJobReachedMaxRetriesError as e:
+            jobs_state.set_status(
+                self.job_id, jobs_state.ManagedJobStatus.FAILED_NO_RESOURCE,
+                failure_reason=str(e))
+        except BaseException as e:  # noqa: BLE001
+            traceback.print_exc()
+            jobs_state.set_status(
+                self.job_id, jobs_state.ManagedJobStatus.FAILED_CONTROLLER,
+                failure_reason=f'{type(e).__name__}: {e}')
+        finally:
+            record = jobs_state.get_job(self.job_id)
+            if record and record['status'].is_terminal:
+                self._cleanup()
+
+    def _run(self) -> None:
+        jobs_state.set_status(self.job_id,
+                              jobs_state.ManagedJobStatus.STARTING)
+        try:
+            cluster_job_id = self.strategy.launch()
+        except exceptions.ResourcesUnavailableError as e:
+            jobs_state.set_status(
+                self.job_id, jobs_state.ManagedJobStatus.FAILED_NO_RESOURCE,
+                failure_reason=str(e))
+            return
+        jobs_state.set_status(self.job_id,
+                              jobs_state.ManagedJobStatus.RUNNING)
+
+        while True:
+            status = self._cluster_job_status(cluster_job_id)
+            if status == job_lib.JobStatus.SUCCEEDED:
+                self._tail_into_controller_log(cluster_job_id)
+                jobs_state.set_status(self.job_id,
+                                      jobs_state.ManagedJobStatus.SUCCEEDED)
+                return
+            if status == job_lib.JobStatus.FAILED:
+                self._tail_into_controller_log(cluster_job_id)
+                jobs_state.set_status(
+                    self.job_id, jobs_state.ManagedJobStatus.FAILED,
+                    failure_reason='User job exited non-zero.')
+                return
+            if status == job_lib.JobStatus.CANCELLED:
+                jobs_state.set_status(self.job_id,
+                                      jobs_state.ManagedJobStatus.CANCELLED)
+                return
+            if status is None and not self._cluster_alive():
+                # Preemption / cluster loss -> recover.
+                count = jobs_state.bump_recovery_count(self.job_id)
+                if count > self.record['max_recoveries']:
+                    jobs_state.set_status(
+                        self.job_id,
+                        jobs_state.ManagedJobStatus.FAILED_NO_RESOURCE,
+                        failure_reason=(
+                            f'Exceeded max_recoveries '
+                            f'({self.record["max_recoveries"]}).'))
+                    return
+                jobs_state.set_status(
+                    self.job_id, jobs_state.ManagedJobStatus.RECOVERING)
+                cluster_job_id = self.strategy.recover()
+                jobs_state.set_status(self.job_id,
+                                      jobs_state.ManagedJobStatus.RUNNING)
+            # Cancellation request from the user?
+            record = jobs_state.get_job(self.job_id)
+            if record['status'] == jobs_state.ManagedJobStatus.CANCELLING:
+                self._cancel_cluster_job(cluster_job_id)
+                jobs_state.set_status(self.job_id,
+                                      jobs_state.ManagedJobStatus.CANCELLED)
+                return
+            time.sleep(_POLL_INTERVAL_SECONDS)
+
+    def _cancel_cluster_job(self, cluster_job_id: int) -> None:
+        from skypilot_tpu import core
+        try:
+            core.cancel(self.cluster_name, job_ids=[cluster_job_id])
+        except exceptions.SkyTpuError:
+            pass
+
+    def _cleanup(self) -> None:
+        from skypilot_tpu import core
+        try:
+            core.down(self.cluster_name, purge=True)
+        except exceptions.SkyTpuError:
+            pass
+
+
+def start(managed_job_id: int) -> None:
+    """Entry for the forked controller process."""
+    jobs_state.set_controller_pid(managed_job_id, os.getpid())
+    JobsController(managed_job_id).run()
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--job-id', type=int, required=True)
+    args = parser.parse_args()
+    start(args.job_id)

@@ -1,0 +1,105 @@
+"""Managed-jobs public API: launch / queue / cancel / logs.
+
+Reference analog: sky/jobs/{client,server} + utils.py ManagedJobCodeGen.
+Consolidated mode: controllers run as local processes of the API-server
+host (the reference's jobs-consolidation deployment); a dedicated
+controller cluster is a config knob away once multi-host control planes
+land.
+"""
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.jobs import scheduler
+from skypilot_tpu.jobs import state as jobs_state
+
+
+def launch(task, name: Optional[str] = None,
+           max_recoveries: int = 3,
+           strategy: str = 'EAGER_NEXT_REGION') -> int:
+    """Submit a managed (auto-recovering) job. Returns managed job id."""
+    cfg = task.to_yaml_config()
+    job_recovery = None
+    for r in task.resources:
+        job_recovery = getattr(r, 'job_recovery', None) or job_recovery
+    if isinstance(job_recovery, str):
+        strategy = job_recovery.upper()
+    elif isinstance(job_recovery, dict):
+        strategy = str(job_recovery.get('strategy', strategy)).upper()
+        max_recoveries = int(job_recovery.get('max_restarts',
+                                              max_recoveries))
+    return scheduler.submit_job(name or task.name, cfg,
+                                max_recoveries=max_recoveries,
+                                strategy=strategy)
+
+
+def queue(refresh_schedule: bool = True) -> List[Dict[str, Any]]:
+    if refresh_schedule:
+        scheduler.maybe_schedule_next_jobs()
+    out = []
+    for record in jobs_state.get_jobs():
+        out.append({
+            'job_id': record['job_id'],
+            'name': record['name'],
+            'status': record['status'].value,
+            'cluster_name': record['cluster_name'],
+            'submitted_at': record['submitted_at'],
+            'started_at': record['started_at'],
+            'ended_at': record['ended_at'],
+            'recovery_count': record['recovery_count'],
+            'failure_reason': record['failure_reason'],
+        })
+    return out
+
+
+def cancel(job_ids: Optional[List[int]] = None,
+           all_jobs: bool = False) -> List[int]:
+    records = jobs_state.get_jobs()
+    if not all_jobs:
+        wanted = set(job_ids or [])
+        records = [r for r in records if r['job_id'] in wanted]
+        missing = wanted - {r['job_id'] for r in records}
+        if missing:
+            raise exceptions.JobNotFoundError(
+                f'Managed job(s) not found: {sorted(missing)}')
+    cancelled = []
+    for r in records:
+        if r['status'].is_terminal:
+            continue
+        if r['status'] == jobs_state.ManagedJobStatus.PENDING:
+            jobs_state.set_status(r['job_id'],
+                                  jobs_state.ManagedJobStatus.CANCELLED)
+        else:
+            # Controller notices CANCELLING on its next poll.
+            jobs_state.set_status(r['job_id'],
+                                  jobs_state.ManagedJobStatus.CANCELLING)
+        cancelled.append(r['job_id'])
+    return cancelled
+
+
+def tail_logs(job_id: int, follow: bool = True,
+              poll_interval: float = 1.0) -> int:
+    """Print the controller log (which carries launch + job output).
+    Returns 0 on SUCCEEDED, 1 otherwise."""
+    record = jobs_state.get_job(job_id)
+    if record is None:
+        raise exceptions.JobNotFoundError(
+            f'Managed job {job_id} not found.')
+    path = jobs_state.controller_log_path(job_id)
+    pos = 0
+    while True:
+        try:
+            with open(path, 'r', encoding='utf-8') as f:
+                f.seek(pos)
+                chunk = f.read()
+        except FileNotFoundError:
+            chunk = ''
+        if chunk:
+            print(chunk, end='', flush=True)
+            pos += len(chunk.encode())
+        record = jobs_state.get_job(job_id)
+        if record['status'].is_terminal or not follow:
+            break
+        time.sleep(poll_interval)
+    ok = record['status'] == jobs_state.ManagedJobStatus.SUCCEEDED
+    return 0 if ok else 1

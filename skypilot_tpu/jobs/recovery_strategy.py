@@ -1,0 +1,113 @@
+"""Recovery strategies: how a managed job relaunches after preemption.
+
+Reference analog: sky/jobs/recovery_strategy.py (`StrategyExecutor` :46,
+launch :108, recover :124, `FailoverStrategyExecutor` :425,
+`EagerFailoverStrategyExecutor` :513; default EAGER_NEXT_REGION).
+TPU-first: recovery ALWAYS terminates the old slice first — preempted
+TPU slices hold quota until deleted and cannot restart in place
+(reference clouds/gcp.py:1066) — then relaunches, either in the same
+placement first (FAILOVER) or immediately elsewhere (EAGER_NEXT_REGION).
+"""
+import os
+import time
+from typing import Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import registry
+
+STRATEGY_REGISTRY = registry.Registry('recovery strategy')
+DEFAULT_STRATEGY = 'EAGER_NEXT_REGION'
+
+_LAUNCH_RETRY_GAP_SECONDS = float(
+    os.environ.get('SKYTPU_JOBS_RETRY_GAP', '10'))
+
+
+class StrategyExecutor:
+    """Launch/recover one managed job's cluster."""
+
+    def __init__(self, task, cluster_name: str,
+                 max_launch_retries: int = 3) -> None:
+        self.task = task
+        self.cluster_name = cluster_name
+        self.max_launch_retries = max_launch_retries
+
+    # -- hooks ---------------------------------------------------------------
+
+    def launch(self) -> int:
+        """First launch. Returns the on-cluster job id."""
+        return self._launch_with_retries(blocked=None)
+
+    def recover(self) -> int:
+        """Relaunch after the cluster was lost. Returns new job id."""
+        raise NotImplementedError
+
+    # -- shared machinery ----------------------------------------------------
+
+    def _terminate_cluster(self) -> None:
+        from skypilot_tpu import core
+        try:
+            core.down(self.cluster_name, purge=True)
+        except exceptions.ClusterDoesNotExist:
+            pass
+
+    def _launch_once(self, blocked=None) -> int:
+        from skypilot_tpu import execution
+        job_id, _ = execution.launch(
+            self.task, cluster_name=self.cluster_name,
+            stream_logs=True, detach_run=True,
+            blocked_resources=blocked)
+        assert job_id is not None
+        return job_id
+
+    def _launch_with_retries(self, blocked=None) -> int:
+        last_exc: Optional[Exception] = None
+        for attempt in range(self.max_launch_retries):
+            try:
+                return self._launch_once(blocked if attempt == 0 else None)
+            except exceptions.ResourcesUnavailableError as e:
+                last_exc = e
+                time.sleep(_LAUNCH_RETRY_GAP_SECONDS * (attempt + 1))
+            except exceptions.CommandError as e:
+                last_exc = e
+                self._terminate_cluster()
+                time.sleep(_LAUNCH_RETRY_GAP_SECONDS)
+        raise exceptions.ManagedJobReachedMaxRetriesError(
+            f'Failed to (re)launch {self.cluster_name!r} after '
+            f'{self.max_launch_retries} attempts: {last_exc}')
+
+    @classmethod
+    def make(cls, strategy: str, task, cluster_name: str
+             ) -> 'StrategyExecutor':
+        impl = STRATEGY_REGISTRY.get(strategy.upper())
+        return impl(task, cluster_name)
+
+
+@STRATEGY_REGISTRY.register(name='FAILOVER')
+class FailoverStrategyExecutor(StrategyExecutor):
+    """Retry the SAME placement first (data locality / reserved capacity),
+    then fail over to the optimizer's next choice."""
+
+    def recover(self) -> int:
+        self._terminate_cluster()
+        # Phase 1: same resources as launched (sticky placement).
+        try:
+            return self._launch_once()
+        except exceptions.ResourcesUnavailableError:
+            pass
+        # Phase 2: free placement — let the optimizer pick anew.
+        return self._launch_with_retries()
+
+
+@STRATEGY_REGISTRY.register(name='EAGER_NEXT_REGION')
+class EagerFailoverStrategyExecutor(StrategyExecutor):
+    """Skip the preempted placement immediately: preemption signals the
+    zone is capacity-constrained right now (the reference's default)."""
+
+    def recover(self) -> int:
+        from skypilot_tpu import state as state_lib
+        record = state_lib.get_cluster_from_name(self.cluster_name)
+        blocked = []
+        if record is not None and record['handle'] is not None:
+            blocked.append(record['handle'].launched_resources)
+        self._terminate_cluster()
+        return self._launch_with_retries(blocked=blocked)

@@ -1,0 +1,55 @@
+"""Managed-job scheduler: bounds concurrent launches/controllers.
+
+Reference analog: sky/jobs/scheduler.py (maybe_schedule_next_jobs :113,
+submit_job :197; ALIVE/LAUNCHING/WAITING states). Ours: PENDING jobs
+start as controller processes whenever the launching count is under the
+cap; called after submit and from the jobs API poll paths.
+"""
+import os
+import subprocess
+import sys
+from typing import Optional
+
+from skypilot_tpu.jobs import state as jobs_state
+
+_MAX_CONCURRENT_LAUNCHES = int(
+    os.environ.get('SKYTPU_JOBS_MAX_CONCURRENT_LAUNCHES', '8'))
+
+
+def _start_controller(job_id: int) -> None:
+    log_path = jobs_state.controller_log_path(job_id)
+    with open(log_path, 'ab') as log_f:
+        proc = subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
+             '--job-id', str(job_id)],
+            stdout=log_f, stderr=log_f,
+            start_new_session=True,
+            env=dict(os.environ, JAX_PLATFORMS='cpu'))
+    jobs_state.set_controller_pid(job_id, proc.pid)
+
+
+def maybe_schedule_next_jobs() -> int:
+    """Start controllers for PENDING jobs up to the cap; returns number
+    started. Safe under concurrent callers (forked API workers): the
+    PENDING->SUBMITTED claim is an atomic conditional UPDATE."""
+    started = 0
+    in_flight = jobs_state.num_launching_jobs()
+    for job in jobs_state.get_jobs([jobs_state.ManagedJobStatus.PENDING]):
+        if in_flight >= _MAX_CONCURRENT_LAUNCHES:
+            break
+        if not jobs_state.try_claim_pending(job['job_id']):
+            continue  # another process claimed it
+        _start_controller(job['job_id'])
+        in_flight += 1
+        started += 1
+    return started
+
+
+def submit_job(name: Optional[str], task_yaml: dict,
+               max_recoveries: int = 3,
+               strategy: str = 'EAGER_NEXT_REGION') -> int:
+    job_id = jobs_state.submit_job(name or f'job-{os.getpid()}', task_yaml,
+                                   max_recoveries=max_recoveries,
+                                   strategy=strategy)
+    maybe_schedule_next_jobs()
+    return job_id

@@ -1,0 +1,240 @@
+"""Managed-job state machine (SQLite).
+
+Reference analog: sky/jobs/state.py (`ManagedJobStatus` :243,
+`ManagedJobScheduleState` :385, spot_jobs DB). A managed job owns a
+cluster lifecycle: launch -> monitor -> (recover on preemption)* ->
+terminal; TPU preemption always recovers by terminate+relaunch because
+slices cannot restart in place (reference clouds/gcp.py:1066).
+"""
+import enum
+import json
+import os
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import paths
+
+_lock = threading.Lock()
+_conn: Optional[sqlite3.Connection] = None
+_conn_path: Optional[str] = None
+
+
+class ManagedJobStatus(enum.Enum):
+    PENDING = 'PENDING'            # queued; controller not started
+    SUBMITTED = 'SUBMITTED'        # controller process starting
+    STARTING = 'STARTING'          # cluster launching
+    RUNNING = 'RUNNING'            # user job running
+    RECOVERING = 'RECOVERING'      # preempted; relaunching
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'              # user code failed
+    FAILED_SETUP = 'FAILED_SETUP'
+    FAILED_NO_RESOURCE = 'FAILED_NO_RESOURCE'
+    FAILED_CONTROLLER = 'FAILED_CONTROLLER'
+    CANCELLING = 'CANCELLING'
+    CANCELLED = 'CANCELLED'
+
+    @property
+    def is_terminal(self) -> bool:
+        return self in _TERMINAL
+
+    @classmethod
+    def failure_statuses(cls) -> List['ManagedJobStatus']:
+        return [cls.FAILED, cls.FAILED_SETUP, cls.FAILED_NO_RESOURCE,
+                cls.FAILED_CONTROLLER]
+
+
+_TERMINAL = frozenset({
+    ManagedJobStatus.SUCCEEDED, ManagedJobStatus.FAILED,
+    ManagedJobStatus.FAILED_SETUP, ManagedJobStatus.FAILED_NO_RESOURCE,
+    ManagedJobStatus.FAILED_CONTROLLER, ManagedJobStatus.CANCELLED,
+})
+
+
+def jobs_db_path() -> str:
+    return os.path.join(paths.state_dir(), 'managed_jobs.db')
+
+
+def controller_log_path(job_id: int) -> str:
+    d = os.path.join(paths.state_dir(), 'managed_jobs_logs')
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, f'{job_id}.log')
+
+
+def _get_conn() -> sqlite3.Connection:
+    global _conn, _conn_path
+    path = jobs_db_path()
+    with _lock:
+        if _conn is None or _conn_path != path:
+            _conn = sqlite3.connect(path, check_same_thread=False,
+                                    timeout=30.0)
+            _conn.execute('PRAGMA journal_mode=WAL')
+            _conn.execute("""
+                CREATE TABLE IF NOT EXISTS managed_jobs (
+                    job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+                    name TEXT,
+                    task_yaml TEXT,
+                    cluster_name TEXT,
+                    status TEXT,
+                    submitted_at REAL,
+                    started_at REAL,
+                    ended_at REAL,
+                    recovery_count INTEGER DEFAULT 0,
+                    max_recoveries INTEGER DEFAULT 3,
+                    failure_reason TEXT,
+                    controller_pid INTEGER,
+                    strategy TEXT DEFAULT 'EAGER_NEXT_REGION'
+                )""")
+            _conn.commit()
+            _conn_path = path
+        return _conn
+
+
+def reset_for_tests() -> None:
+    global _conn, _conn_path
+    with _lock:
+        if _conn is not None:
+            _conn.close()
+        _conn = None
+        _conn_path = None
+
+
+def submit_job(name: str, task_yaml: Dict[str, Any],
+               max_recoveries: int = 3,
+               strategy: str = 'EAGER_NEXT_REGION') -> int:
+    conn = _get_conn()
+    with _lock:
+        cur = conn.execute(
+            'INSERT INTO managed_jobs (name, task_yaml, status, '
+            'submitted_at, max_recoveries, strategy) VALUES (?,?,?,?,?,?)',
+            (name, json.dumps(task_yaml),
+             ManagedJobStatus.PENDING.value, time.time(), max_recoveries,
+             strategy))
+        conn.commit()
+        job_id = cur.lastrowid
+    return int(job_id)
+
+
+def set_status(job_id: int, status: ManagedJobStatus,
+               failure_reason: Optional[str] = None) -> None:
+    conn = _get_conn()
+    with _lock:
+        sets = ['status=?']
+        args: List[Any] = [status.value]
+        if status == ManagedJobStatus.RUNNING:
+            sets.append('started_at=COALESCE(started_at, ?)')
+            args.append(time.time())
+        if status.is_terminal:
+            sets.append('ended_at=?')
+            args.append(time.time())
+        if failure_reason is not None:
+            sets.append('failure_reason=?')
+            args.append(failure_reason)
+        args.append(job_id)
+        conn.execute(
+            f'UPDATE managed_jobs SET {", ".join(sets)} WHERE job_id=?',
+            args)
+        conn.commit()
+
+
+def set_cluster_name(job_id: int, cluster_name: str) -> None:
+    conn = _get_conn()
+    with _lock:
+        conn.execute(
+            'UPDATE managed_jobs SET cluster_name=? WHERE job_id=?',
+            (cluster_name, job_id))
+        conn.commit()
+
+
+def try_claim_pending(job_id: int) -> bool:
+    """Atomically move PENDING -> SUBMITTED; False if someone else won.
+    The cross-process guard against duplicate controllers."""
+    conn = _get_conn()
+    with _lock:
+        cur = conn.execute(
+            'UPDATE managed_jobs SET status=? WHERE job_id=? AND status=?',
+            (ManagedJobStatus.SUBMITTED.value, job_id,
+             ManagedJobStatus.PENDING.value))
+        conn.commit()
+        return cur.rowcount == 1
+
+
+def set_controller_pid(job_id: int, pid: int) -> None:
+    conn = _get_conn()
+    with _lock:
+        conn.execute(
+            'UPDATE managed_jobs SET controller_pid=? WHERE job_id=?',
+            (pid, job_id))
+        conn.commit()
+
+
+def bump_recovery_count(job_id: int) -> int:
+    conn = _get_conn()
+    with _lock:
+        conn.execute(
+            'UPDATE managed_jobs SET recovery_count=recovery_count+1 '
+            'WHERE job_id=?', (job_id,))
+        conn.commit()
+        row = conn.execute(
+            'SELECT recovery_count FROM managed_jobs WHERE job_id=?',
+            (job_id,)).fetchone()
+    return int(row[0])
+
+
+_COLS = ('job_id, name, task_yaml, cluster_name, status, submitted_at, '
+         'started_at, ended_at, recovery_count, max_recoveries, '
+         'failure_reason, controller_pid, strategy')
+
+
+def _row_to_record(row) -> Dict[str, Any]:
+    (job_id, name, task_yaml, cluster_name, status, submitted_at,
+     started_at, ended_at, recovery_count, max_recoveries, failure_reason,
+     controller_pid, strategy) = row
+    return {
+        'job_id': job_id,
+        'name': name,
+        'task_yaml': json.loads(task_yaml) if task_yaml else None,
+        'cluster_name': cluster_name,
+        'status': ManagedJobStatus(status),
+        'submitted_at': submitted_at,
+        'started_at': started_at,
+        'ended_at': ended_at,
+        'recovery_count': recovery_count,
+        'max_recoveries': max_recoveries,
+        'failure_reason': failure_reason,
+        'controller_pid': controller_pid,
+        'strategy': strategy,
+    }
+
+
+def get_job(job_id: int) -> Optional[Dict[str, Any]]:
+    conn = _get_conn()
+    row = conn.execute(
+        f'SELECT {_COLS} FROM managed_jobs WHERE job_id=?',
+        (job_id,)).fetchone()
+    return _row_to_record(row) if row else None
+
+
+def get_jobs(statuses: Optional[List[ManagedJobStatus]] = None
+             ) -> List[Dict[str, Any]]:
+    conn = _get_conn()
+    if statuses:
+        marks = ','.join('?' * len(statuses))
+        rows = conn.execute(
+            f'SELECT {_COLS} FROM managed_jobs WHERE status IN ({marks}) '
+            'ORDER BY job_id', [s.value for s in statuses]).fetchall()
+    else:
+        rows = conn.execute(
+            f'SELECT {_COLS} FROM managed_jobs ORDER BY job_id').fetchall()
+    return [_row_to_record(r) for r in rows]
+
+
+def num_launching_jobs() -> int:
+    conn = _get_conn()
+    row = conn.execute(
+        'SELECT COUNT(*) FROM managed_jobs WHERE status IN (?,?,?)',
+        (ManagedJobStatus.SUBMITTED.value,
+         ManagedJobStatus.STARTING.value,
+         ManagedJobStatus.RECOVERING.value)).fetchone()
+    return int(row[0])

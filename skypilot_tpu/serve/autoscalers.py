@@ -1,0 +1,95 @@
+"""Autoscalers: QPS-target scaling with hysteresis.
+
+Reference analog: sky/serve/autoscalers.py (`Autoscaler` :116,
+`RequestRateAutoscaler` :441: target_qps_per_replica with
+upscale/downscale delays so transient spikes/dips don't thrash).
+"""
+import dataclasses
+import time
+from typing import List, Optional
+
+from skypilot_tpu.serve import service_spec as spec_lib
+
+
+@dataclasses.dataclass
+class ScalingDecision:
+    target_replicas: int
+    reason: str = ''
+
+
+class Autoscaler:
+    def __init__(self, spec: spec_lib.ServiceSpec) -> None:
+        self.spec = spec
+
+    def update_spec(self, spec: spec_lib.ServiceSpec) -> None:
+        self.spec = spec
+
+    def decide(self, num_ready: int, num_total: int,
+               qps: Optional[float]) -> ScalingDecision:
+        raise NotImplementedError
+
+
+class FixedReplicaAutoscaler(Autoscaler):
+    """No autoscaling: hold min_replicas."""
+
+    def decide(self, num_ready: int, num_total: int,
+               qps: Optional[float]) -> ScalingDecision:
+        return ScalingDecision(self.spec.min_replicas, 'fixed')
+
+
+class RequestRateAutoscaler(Autoscaler):
+    """Scale so qps/replica ~= target, with upscale/downscale delays."""
+
+    def __init__(self, spec: spec_lib.ServiceSpec,
+                 now_fn=time.time) -> None:
+        super().__init__(spec)
+        self._now = now_fn
+        self._upscale_since: Optional[float] = None
+        self._downscale_since: Optional[float] = None
+
+    def _desired(self, qps: float) -> int:
+        import math
+        target = self.spec.target_qps_per_replica
+        desired = math.ceil(qps / target) if target else \
+            self.spec.min_replicas
+        lo = self.spec.min_replicas
+        hi = self.spec.max_replicas or max(lo, desired)
+        return max(lo, min(hi, desired))
+
+    def decide(self, num_ready: int, num_total: int,
+               qps: Optional[float]) -> ScalingDecision:
+        if qps is None:
+            return ScalingDecision(max(num_total, self.spec.min_replicas),
+                                   'no traffic data')
+        desired = self._desired(qps)
+        now = self._now()
+        if desired > num_total:
+            self._downscale_since = None
+            if self._upscale_since is None:
+                self._upscale_since = now
+            if now - self._upscale_since >= self.spec.upscale_delay_seconds:
+                self._upscale_since = None
+                return ScalingDecision(
+                    desired, f'qps={qps:.2f} sustained above target')
+            return ScalingDecision(num_total, 'upscale pending delay')
+        if desired < num_total:
+            self._upscale_since = None
+            if self._downscale_since is None:
+                self._downscale_since = now
+            if now - self._downscale_since >= \
+                    self.spec.downscale_delay_seconds:
+                self._downscale_since = None
+                return ScalingDecision(
+                    desired, f'qps={qps:.2f} sustained below target')
+            return ScalingDecision(num_total, 'downscale pending delay')
+        self._upscale_since = None
+        self._downscale_since = None
+        return ScalingDecision(num_total, 'at target')
+
+
+def make_autoscaler(spec: spec_lib.ServiceSpec) -> Autoscaler:
+    if spec.max_replicas is not None and \
+            spec.max_replicas > spec.min_replicas and \
+            spec.target_qps_per_replica is not None:
+        return RequestRateAutoscaler(spec)
+    return FixedReplicaAutoscaler(spec)

@@ -1,0 +1,112 @@
+"""Service controller: probe -> autoscale -> sync LB, in one loop.
+
+Reference analog: sky/serve/controller.py:36 (`SkyServeController`) +
+service.py:155 (bootstrap/cleanup). One controller process per service
+runs the replica manager loop AND hosts the load balancer (consolidated;
+the reference splits them into two uvicorn processes on the controller
+VM — ours keeps one process with the LB on its own thread).
+"""
+import argparse
+import logging
+import os
+import time
+import traceback
+
+from skypilot_tpu.serve import autoscalers
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve import replica_managers
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve import service_spec as spec_lib
+
+logger = logging.getLogger(__name__)
+
+_LOOP_INTERVAL_SECONDS = float(
+    os.environ.get('SKYTPU_SERVE_LOOP_INTERVAL', '10'))
+
+
+class ServeController:
+
+    def __init__(self, service_name: str) -> None:
+        self.service_name = service_name
+        service = serve_state.get_service(service_name)
+        assert service is not None, service_name
+        from skypilot_tpu import task as task_lib
+        self.task = task_lib.Task.from_yaml_config(service['task_yaml'])
+        assert self.task.service is not None
+        self.spec: spec_lib.ServiceSpec = self.task.service
+        self.manager = replica_managers.ReplicaManager(
+            service_name, self.task, self.spec)
+        self.autoscaler = autoscalers.make_autoscaler(self.spec)
+        self.lb = lb_lib.LoadBalancer(self.spec.load_balancing_policy,
+                                      port=service['lb_port'])
+        self._stop = False
+
+    def run(self) -> None:
+        try:
+            serve_state.set_service_controller(self.service_name,
+                                               os.getpid())
+            self.lb.start()
+            serve_state.set_service_status(
+                self.service_name, serve_state.ServiceStatus.REPLICA_INIT)
+            self.manager.scale_up(self.spec.min_replicas)
+            while not self._stop:
+                self._step()
+                time.sleep(_LOOP_INTERVAL_SECONDS)
+        except BaseException:  # noqa: BLE001
+            traceback.print_exc()
+            serve_state.set_service_status(
+                self.service_name, serve_state.ServiceStatus.FAILED)
+            raise
+
+    def _step(self) -> None:
+        service = serve_state.get_service(self.service_name)
+        if service is None or \
+                service['status'] == serve_state.ServiceStatus.SHUTTING_DOWN:
+            self._shutdown()
+            return
+        self.manager.probe_all()
+        replicas = serve_state.get_replicas(self.service_name)
+        ready = self.manager.ready_endpoints()
+        self.lb.set_replicas(ready)
+
+        live = [r for r in replicas
+                if r['status'] not in (
+                    serve_state.ReplicaStatus.SHUTTING_DOWN,
+                    serve_state.ReplicaStatus.FAILED)]
+        decision = self.autoscaler.decide(
+            len(ready), len(live), self.lb.tracker.qps())
+        if decision.target_replicas > len(live):
+            self.manager.scale_up(decision.target_replicas - len(live))
+        elif decision.target_replicas < len(live):
+            # Prefer terminating not-ready replicas, then highest
+            # (newest, least-warm) ids.
+            victims = sorted(
+                live,
+                key=lambda r: (
+                    r['status'] == serve_state.ReplicaStatus.READY,
+                    -r['replica_id']))
+            n = len(live) - decision.target_replicas
+            self.manager.scale_down(
+                [v['replica_id'] for v in victims[:n]])
+
+        status = (serve_state.ServiceStatus.READY if ready else
+                  (serve_state.ServiceStatus.NO_REPLICA if not live else
+                   serve_state.ServiceStatus.REPLICA_INIT))
+        serve_state.set_service_status(self.service_name, status)
+
+    def _shutdown(self) -> None:
+        self.manager.terminate_all()
+        self.lb.stop()
+        serve_state.remove_service(self.service_name)
+        self._stop = True
+
+
+def start(service_name: str) -> None:
+    ServeController(service_name).run()
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--service-name', required=True)
+    args = parser.parse_args()
+    start(args.service_name)

@@ -1,0 +1,134 @@
+"""Serve public API: up / down / status.
+
+Reference analog: sky/serve/server + serve_utils. Consolidated mode: the
+controller (+embedded LB) is a local process of the API-server host.
+"""
+import os
+import socket
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.serve import serve_state
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+def up(task, service_name: str, wait_seconds: float = 0.0
+       ) -> Dict[str, Any]:
+    """Create a service from a task with a `service:` section."""
+    if task.service is None:
+        raise exceptions.InvalidTaskError(
+            'Task has no service: section; cannot `serve up`.')
+    if serve_state.get_service(service_name) is not None:
+        raise exceptions.ServeError(
+            f'Service {service_name!r} already exists.')
+    lb_port = _free_port()
+    serve_state.add_service(service_name, task.to_yaml_config(), lb_port,
+                            controller_port=0)
+    log_path = serve_state.controller_log_path(service_name)
+    with open(log_path, 'ab') as log_f:
+        proc = subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.serve.controller',
+             '--service-name', service_name],
+            stdout=log_f, stderr=log_f, start_new_session=True,
+            env=dict(os.environ, JAX_PLATFORMS='cpu'))
+    serve_state.set_service_controller(service_name, proc.pid)
+    if wait_seconds:
+        deadline = time.time() + wait_seconds
+        while time.time() < deadline:
+            service = serve_state.get_service(service_name)
+            if service and service['status'] == \
+                    serve_state.ServiceStatus.READY:
+                break
+            time.sleep(0.5)
+    return {'service_name': service_name,
+            'endpoint': f'http://127.0.0.1:{lb_port}'}
+
+
+def down(service_name: str, purge: bool = False) -> None:
+    service = serve_state.get_service(service_name)
+    if service is None:
+        if purge:
+            return
+        raise exceptions.ServeError(
+            f'Service {service_name!r} does not exist.')
+    serve_state.set_service_status(service_name,
+                                   serve_state.ServiceStatus.SHUTTING_DOWN)
+    # Controller notices and cleans up — but only wait for it if its
+    # process is actually alive (it may have crashed FAILED earlier).
+    pid = service['controller_pid']
+    controller_alive = False
+    if pid:
+        try:
+            os.kill(pid, 0)
+            controller_alive = True
+        except (ProcessLookupError, PermissionError):
+            pass
+    if controller_alive:
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if serve_state.get_service(service_name) is None:
+                return
+            time.sleep(0.5)
+        try:
+            os.kill(pid, 15)
+        except ProcessLookupError:
+            pass
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.serve import replica_managers
+    task = task_lib.Task.from_yaml_config(service['task_yaml'])
+    replica_managers.ReplicaManager(
+        service_name, task, task.service).terminate_all()
+    serve_state.remove_service(service_name)
+
+
+def status(service_names: Optional[List[str]] = None
+           ) -> List[Dict[str, Any]]:
+    out = []
+    for service in serve_state.get_services():
+        if service_names and service['name'] not in service_names:
+            continue
+        replicas = serve_state.get_replicas(service['name'])
+        out.append({
+            'name': service['name'],
+            'status': service['status'].value,
+            'endpoint': f'http://127.0.0.1:{service["lb_port"]}',
+            'version': service['version'],
+            'replicas': [{
+                'replica_id': r['replica_id'],
+                'status': r['status'].value,
+                'cluster_name': r['cluster_name'],
+                'endpoint': r['endpoint'],
+            } for r in replicas],
+        })
+    return out
+
+
+def tail_logs(service_name: str, follow: bool = True,
+              poll_interval: float = 1.0) -> int:
+    service = serve_state.get_service(service_name)
+    if service is None:
+        raise exceptions.ServeError(
+            f'Service {service_name!r} does not exist.')
+    path = serve_state.controller_log_path(service_name)
+    pos = 0
+    while True:
+        try:
+            with open(path, 'r', encoding='utf-8') as f:
+                f.seek(pos)
+                chunk = f.read()
+        except FileNotFoundError:
+            chunk = ''
+        if chunk:
+            print(chunk, end='', flush=True)
+            pos += len(chunk.encode())
+        if not follow or serve_state.get_service(service_name) is None:
+            return 0
+        time.sleep(poll_interval)

@@ -1,0 +1,137 @@
+"""Load balancer: aiohttp reverse proxy in front of ready replicas.
+
+Reference analog: sky/serve/load_balancer.py:23 (`SkyServeLoadBalancer`
+— FastAPI proxy syncing replica URLs from the controller). Ours embeds a
+QPS window the controller's autoscaler reads via /internal/stats.
+"""
+import asyncio
+import collections
+import threading
+import time
+from typing import List, Optional
+
+from skypilot_tpu.serve import load_balancing_policies as lb_policies
+
+_QPS_WINDOW_SECONDS = 60.0
+
+
+class RequestRateTracker:
+    def __init__(self) -> None:
+        self._times = collections.deque()
+        self._lock = threading.Lock()
+
+    def record(self) -> None:
+        with self._lock:
+            self._times.append(time.time())
+
+    def qps(self) -> float:
+        cutoff = time.time() - _QPS_WINDOW_SECONDS
+        with self._lock:
+            while self._times and self._times[0] < cutoff:
+                self._times.popleft()
+            return len(self._times) / _QPS_WINDOW_SECONDS
+
+
+class LoadBalancer:
+    def __init__(self, policy_name: str = 'least_load',
+                 port: int = 0) -> None:
+        self.policy = lb_policies.make_policy(policy_name)
+        self.port = port
+        self.tracker = RequestRateTracker()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._runner = None
+        self._thread: Optional[threading.Thread] = None
+
+    def set_replicas(self, urls: List[str]) -> None:
+        self.policy.set_replicas(urls)
+
+    # -- aiohttp handlers ----------------------------------------------------
+
+    async def _handle_stats(self, request):
+        from aiohttp import web
+        return web.json_response({
+            'qps': self.tracker.qps(),
+            'replicas': list(self.policy.replicas),
+        })
+
+    async def _handle_proxy(self, request):
+        from aiohttp import ClientSession, ClientTimeout, web
+        self.tracker.record()
+        target = self.policy.select()
+        if target is None:
+            return web.Response(
+                status=503,
+                text='No ready replicas. Retry shortly.\n')
+        url = target.rstrip('/') + '/' + request.match_info['tail']
+        if request.query_string:
+            url += f'?{request.query_string}'
+        body = await request.read()
+        self.policy.on_request_start(target)
+        try:
+            async with ClientSession(
+                    timeout=ClientTimeout(total=3600)) as session:
+                async with session.request(
+                        request.method, url, data=body,
+                        headers={k: v for k, v in request.headers.items()
+                                 if k.lower() not in ('host',
+                                                      'content-length')},
+                        allow_redirects=False) as upstream:
+                    payload = await upstream.read()
+                    return web.Response(
+                        status=upstream.status, body=payload,
+                        headers={k: v
+                                 for k, v in upstream.headers.items()
+                                 if k.lower() not in (
+                                     'transfer-encoding',
+                                     'content-length',
+                                     'connection')})
+        except OSError as e:
+            return web.Response(status=502,
+                                text=f'Upstream error: {e}\n')
+        finally:
+            self.policy.on_request_end(target)
+
+    def _create_app(self):
+        from aiohttp import web
+        app = web.Application(client_max_size=1024 * 1024 * 256)
+        app.router.add_get('/internal/stats', self._handle_stats)
+        app.router.add_route('*', '/{tail:.*}', self._handle_proxy)
+        return app
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> int:
+        """Start in a daemon thread; returns the bound port."""
+        ready = threading.Event()
+
+        def _serve():
+            from aiohttp import web
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+
+            async def _start():
+                self._runner = web.AppRunner(self._create_app())
+                await self._runner.setup()
+                site = web.TCPSite(self._runner, '0.0.0.0', self.port)
+                await site.start()
+                self.port = site._server.sockets[0].getsockname()[1]  # noqa: SLF001
+            self._loop.run_until_complete(_start())
+            ready.set()
+            self._loop.run_forever()
+
+        self._thread = threading.Thread(target=_serve, daemon=True)
+        self._thread.start()
+        if not ready.wait(timeout=10):
+            raise RuntimeError('Load balancer failed to start')
+        return self.port
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            async def _cleanup():
+                if self._runner is not None:
+                    await self._runner.cleanup()
+            fut = asyncio.run_coroutine_threadsafe(_cleanup(), self._loop)
+            fut.result(timeout=10)
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            if self._thread is not None:
+                self._thread.join(timeout=10)

@@ -1,0 +1,80 @@
+"""Load-balancing policies.
+
+Reference analog: sky/serve/load_balancing_policies.py
+(`RoundRobinPolicy` :85, `LeastLoadPolicy` :111 — the default).
+"""
+import threading
+from typing import Dict, List, Optional
+
+
+class LoadBalancingPolicy:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.replicas: List[str] = []
+
+    def set_replicas(self, replicas: List[str]) -> None:
+        with self._lock:
+            self.replicas = list(replicas)
+
+    def select(self) -> Optional[str]:
+        raise NotImplementedError
+
+    def on_request_start(self, url: str) -> None:
+        pass
+
+    def on_request_end(self, url: str) -> None:
+        pass
+
+
+class RoundRobinPolicy(LoadBalancingPolicy):
+    def __init__(self) -> None:
+        super().__init__()
+        self._index = 0
+
+    def select(self) -> Optional[str]:
+        with self._lock:
+            if not self.replicas:
+                return None
+            url = self.replicas[self._index % len(self.replicas)]
+            self._index += 1
+            return url
+
+
+class LeastLoadPolicy(LoadBalancingPolicy):
+    """Route to the replica with the fewest in-flight requests."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._in_flight: Dict[str, int] = {}
+
+    def set_replicas(self, replicas: List[str]) -> None:
+        with self._lock:
+            self.replicas = list(replicas)
+            self._in_flight = {r: self._in_flight.get(r, 0)
+                               for r in replicas}
+
+    def select(self) -> Optional[str]:
+        with self._lock:
+            if not self.replicas:
+                return None
+            return min(self.replicas,
+                       key=lambda r: self._in_flight.get(r, 0))
+
+    def on_request_start(self, url: str) -> None:
+        with self._lock:
+            self._in_flight[url] = self._in_flight.get(url, 0) + 1
+
+    def on_request_end(self, url: str) -> None:
+        with self._lock:
+            self._in_flight[url] = max(
+                0, self._in_flight.get(url, 0) - 1)
+
+
+POLICIES = {
+    'round_robin': RoundRobinPolicy,
+    'least_load': LeastLoadPolicy,
+}
+
+
+def make_policy(name: str) -> LoadBalancingPolicy:
+    return POLICIES[name]()

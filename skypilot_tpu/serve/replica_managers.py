@@ -1,0 +1,189 @@
+"""Replica manager: replica cluster lifecycle + readiness probes.
+
+Reference analog: sky/serve/replica_managers.py (launch_cluster :60,
+`ReplicaInfo` :388, probe loop). Each replica is a full cluster launched
+through the normal stack (optimizer -> provision -> gang run), so TPU
+replicas get slice semantics (preempted -> terminate+relaunch) for free.
+"""
+import logging
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve import service_spec as spec_lib
+
+logger = logging.getLogger(__name__)
+
+_MAX_CONSECUTIVE_FAILURES = 3
+
+
+def replica_cluster_name(service_name: str, replica_id: int) -> str:
+    return f'tsky-serve-{service_name}-{replica_id}'
+
+
+class ReplicaManager:
+
+    def __init__(self, service_name: str, task,
+                 spec: spec_lib.ServiceSpec) -> None:
+        self.service_name = service_name
+        self.task = task
+        self.spec = spec
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def scale_up(self, n: int = 1) -> List[int]:
+        """Launch n new replica clusters in BACKGROUND threads so the
+        control loop keeps probing healthy replicas while slices
+        provision (TPU pods can take many minutes; reference replica
+        manager launches async the same way)."""
+        launched = []
+        service = serve_state.get_service(self.service_name)
+        version = service['version'] if service else 1
+        for _ in range(n):
+            replica_id = serve_state.next_replica_id(self.service_name)
+            cluster = replica_cluster_name(self.service_name, replica_id)
+            serve_state.add_replica(self.service_name, replica_id, cluster,
+                                    version)
+            thread = threading.Thread(
+                target=self._launch_replica, args=(replica_id, cluster),
+                daemon=True)
+            thread.start()
+            launched.append(replica_id)
+        return launched
+
+    def _launch_replica(self, replica_id: int, cluster: str) -> None:
+        try:
+            from skypilot_tpu import execution
+            execution.launch(self._replica_task(), cluster_name=cluster,
+                             stream_logs=False, detach_run=True)
+            serve_state.set_replica_status(
+                self.service_name, replica_id,
+                serve_state.ReplicaStatus.STARTING,
+                endpoint=self._endpoint_for(cluster))
+        except exceptions.SkyTpuError as e:
+            logger.warning('Replica %s launch failed: %s', replica_id, e)
+            serve_state.set_replica_status(
+                self.service_name, replica_id,
+                serve_state.ReplicaStatus.FAILED)
+
+    def _replica_task(self):
+        """A fresh Task per replica (Tasks hold best_resources state)."""
+        from skypilot_tpu import task as task_lib
+        return task_lib.Task.from_yaml_config(self.task.to_yaml_config())
+
+    def _endpoint_for(self, cluster_name: str) -> Optional[str]:
+        from skypilot_tpu import state as state_lib
+        record = state_lib.get_cluster_from_name(cluster_name)
+        if record is None or record['handle'] is None:
+            return None
+        ip = record['handle'].head_ip()
+        if ip is None:
+            return None
+        return f'http://{ip}:{self.spec.replica_port}'
+
+    def scale_down(self, replica_ids: List[int]) -> None:
+        from skypilot_tpu import core
+        for replica_id in replica_ids:
+            serve_state.set_replica_status(
+                self.service_name, replica_id,
+                serve_state.ReplicaStatus.SHUTTING_DOWN)
+            cluster = replica_cluster_name(self.service_name, replica_id)
+            try:
+                core.down(cluster, purge=True)
+            except exceptions.ClusterDoesNotExist:
+                pass
+            serve_state.remove_replica(self.service_name, replica_id)
+
+    def terminate_all(self) -> None:
+        self.scale_down([r['replica_id']
+                         for r in serve_state.get_replicas(
+                             self.service_name)])
+
+    # -- probing -------------------------------------------------------------
+
+    def _probe_replica(self, replica: Dict) -> bool:
+        endpoint = replica['endpoint']
+        if not endpoint:
+            return False
+        url = endpoint.rstrip('/') + self.spec.readiness_probe.path
+        try:
+            req = urllib.request.Request(url)
+            post = self.spec.readiness_probe.post_data
+            if post is not None:
+                import json
+                req = urllib.request.Request(
+                    url, data=json.dumps(post).encode(),
+                    headers={'Content-Type': 'application/json'})
+            with urllib.request.urlopen(
+                    req,
+                    timeout=self.spec.readiness_probe.timeout_seconds):
+                return True
+        except (urllib.error.URLError, OSError, ValueError):
+            return False
+
+    def _cluster_lost(self, replica: Dict) -> bool:
+        from skypilot_tpu import state as state_lib
+        record = state_lib.get_cluster_from_name(replica['cluster_name'])
+        return record is None or record['handle'] is None
+
+    def probe_all(self) -> None:
+        """One probe round: update replica statuses, replace dead ones."""
+        for replica in serve_state.get_replicas(self.service_name):
+            status = replica['status']
+            if status in (serve_state.ReplicaStatus.SHUTTING_DOWN,
+                          serve_state.ReplicaStatus.FAILED,
+                          serve_state.ReplicaStatus.PROVISIONING):
+                # PROVISIONING: a background launch thread owns it.
+                continue
+            if self._cluster_lost(replica):
+                # Preempted / externally deleted: replace.
+                serve_state.set_replica_status(
+                    self.service_name, replica['replica_id'],
+                    serve_state.ReplicaStatus.PREEMPTED)
+                self.scale_down([replica['replica_id']])
+                self.scale_up(1)
+                continue
+            if replica['endpoint'] is None:
+                endpoint = self._endpoint_for(replica['cluster_name'])
+                if endpoint:
+                    serve_state.set_replica_status(
+                        self.service_name, replica['replica_id'],
+                        status, endpoint=endpoint)
+                    replica = dict(replica, endpoint=endpoint)
+            if self._probe_replica(replica):
+                serve_state.clear_replica_failures(
+                    self.service_name, replica['replica_id'])
+                if status != serve_state.ReplicaStatus.READY:
+                    serve_state.set_replica_status(
+                        self.service_name, replica['replica_id'],
+                        serve_state.ReplicaStatus.READY)
+            else:
+                failures = serve_state.bump_replica_failures(
+                    self.service_name, replica['replica_id'])
+                if status == serve_state.ReplicaStatus.READY:
+                    serve_state.set_replica_status(
+                        self.service_name, replica['replica_id'],
+                        serve_state.ReplicaStatus.NOT_READY)
+                if status == serve_state.ReplicaStatus.STARTING:
+                    # Probe failures during startup are expected until
+                    # initial_delay_seconds; past it, the app is deemed
+                    # crashed and the replica is replaced.
+                    age = time.time() - (replica['launched_at'] or 0)
+                    if age > self.spec.readiness_probe. \
+                            initial_delay_seconds:
+                        self.scale_down([replica['replica_id']])
+                        self.scale_up(1)
+                elif failures >= _MAX_CONSECUTIVE_FAILURES:
+                    # Persistent failure: replace the replica.
+                    self.scale_down([replica['replica_id']])
+                    self.scale_up(1)
+
+    def ready_endpoints(self) -> List[str]:
+        return [r['endpoint']
+                for r in serve_state.get_replicas(self.service_name)
+                if r['status'] == serve_state.ReplicaStatus.READY and
+                r['endpoint']]

@@ -1,0 +1,267 @@
+"""Service/replica state DB (SQLite).
+
+Reference analog: sky/serve/serve_state.py (658 LoC): services table +
+replica infos with status/version tracking.
+"""
+import enum
+import json
+import os
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import paths
+
+_lock = threading.Lock()
+_conn: Optional[sqlite3.Connection] = None
+_conn_path: Optional[str] = None
+
+
+class ServiceStatus(enum.Enum):
+    CONTROLLER_INIT = 'CONTROLLER_INIT'
+    REPLICA_INIT = 'REPLICA_INIT'      # no ready replicas yet
+    READY = 'READY'
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+    FAILED = 'FAILED'
+    NO_REPLICA = 'NO_REPLICA'          # scaled to zero / all failed
+
+
+class ReplicaStatus(enum.Enum):
+    PROVISIONING = 'PROVISIONING'
+    STARTING = 'STARTING'              # cluster up; app not ready
+    READY = 'READY'
+    NOT_READY = 'NOT_READY'            # probe failing; grace period
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+    FAILED = 'FAILED'
+    PREEMPTED = 'PREEMPTED'
+
+    @property
+    def is_terminal(self) -> bool:
+        return self in (ReplicaStatus.FAILED,)
+
+
+def serve_db_path() -> str:
+    return os.path.join(paths.state_dir(), 'serve.db')
+
+
+def controller_log_path(service_name: str) -> str:
+    d = os.path.join(paths.state_dir(), 'serve_logs')
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, f'{service_name}.controller.log')
+
+
+def _get_conn() -> sqlite3.Connection:
+    global _conn, _conn_path
+    path = serve_db_path()
+    with _lock:
+        if _conn is None or _conn_path != path:
+            _conn = sqlite3.connect(path, check_same_thread=False,
+                                    timeout=30.0)
+            _conn.execute('PRAGMA journal_mode=WAL')
+            _conn.execute("""
+                CREATE TABLE IF NOT EXISTS services (
+                    name TEXT PRIMARY KEY,
+                    task_yaml TEXT,
+                    status TEXT,
+                    created_at REAL,
+                    controller_pid INTEGER,
+                    lb_port INTEGER,
+                    controller_port INTEGER,
+                    version INTEGER DEFAULT 1
+                )""")
+            _conn.execute("""
+                CREATE TABLE IF NOT EXISTS replicas (
+                    service_name TEXT,
+                    replica_id INTEGER,
+                    cluster_name TEXT,
+                    status TEXT,
+                    version INTEGER,
+                    endpoint TEXT,
+                    launched_at REAL,
+                    consecutive_failures INTEGER DEFAULT 0,
+                    PRIMARY KEY (service_name, replica_id)
+                )""")
+            _conn.commit()
+            _conn_path = path
+        return _conn
+
+
+def reset_for_tests() -> None:
+    global _conn, _conn_path
+    with _lock:
+        if _conn is not None:
+            _conn.close()
+        _conn = None
+        _conn_path = None
+
+
+# --- services ---------------------------------------------------------------
+
+def add_service(name: str, task_yaml: Dict[str, Any], lb_port: int,
+                controller_port: int) -> None:
+    conn = _get_conn()
+    with _lock:
+        conn.execute(
+            'INSERT INTO services (name, task_yaml, status, created_at, '
+            'lb_port, controller_port) VALUES (?,?,?,?,?,?)',
+            (name, json.dumps(task_yaml),
+             ServiceStatus.CONTROLLER_INIT.value, time.time(), lb_port,
+             controller_port))
+        conn.commit()
+
+
+def set_service_status(name: str, status: ServiceStatus) -> None:
+    conn = _get_conn()
+    with _lock:
+        conn.execute('UPDATE services SET status=? WHERE name=?',
+                     (status.value, name))
+        conn.commit()
+
+
+def set_service_controller(name: str, pid: int) -> None:
+    conn = _get_conn()
+    with _lock:
+        conn.execute('UPDATE services SET controller_pid=? WHERE name=?',
+                     (pid, name))
+        conn.commit()
+
+
+def set_service_version(name: str, version: int,
+                        task_yaml: Dict[str, Any]) -> None:
+    conn = _get_conn()
+    with _lock:
+        conn.execute(
+            'UPDATE services SET version=?, task_yaml=? WHERE name=?',
+            (version, json.dumps(task_yaml), name))
+        conn.commit()
+
+
+def remove_service(name: str) -> None:
+    conn = _get_conn()
+    with _lock:
+        conn.execute('DELETE FROM services WHERE name=?', (name,))
+        conn.execute('DELETE FROM replicas WHERE service_name=?', (name,))
+        conn.commit()
+
+
+def get_service(name: str) -> Optional[Dict[str, Any]]:
+    conn = _get_conn()
+    row = conn.execute(
+        'SELECT name, task_yaml, status, created_at, controller_pid, '
+        'lb_port, controller_port, version FROM services WHERE name=?',
+        (name,)).fetchone()
+    return _service_row(row) if row else None
+
+
+def get_services() -> List[Dict[str, Any]]:
+    conn = _get_conn()
+    rows = conn.execute(
+        'SELECT name, task_yaml, status, created_at, controller_pid, '
+        'lb_port, controller_port, version FROM services '
+        'ORDER BY created_at').fetchall()
+    return [_service_row(r) for r in rows]
+
+
+def _service_row(row) -> Dict[str, Any]:
+    (name, task_yaml, status, created_at, controller_pid, lb_port,
+     controller_port, version) = row
+    return {
+        'name': name,
+        'task_yaml': json.loads(task_yaml) if task_yaml else None,
+        'status': ServiceStatus(status),
+        'created_at': created_at,
+        'controller_pid': controller_pid,
+        'lb_port': lb_port,
+        'controller_port': controller_port,
+        'version': version,
+    }
+
+
+# --- replicas ---------------------------------------------------------------
+
+def add_replica(service_name: str, replica_id: int, cluster_name: str,
+                version: int) -> None:
+    conn = _get_conn()
+    with _lock:
+        conn.execute(
+            'INSERT OR REPLACE INTO replicas (service_name, replica_id, '
+            'cluster_name, status, version, launched_at) '
+            'VALUES (?,?,?,?,?,?)',
+            (service_name, replica_id, cluster_name,
+             ReplicaStatus.PROVISIONING.value, version, time.time()))
+        conn.commit()
+
+
+def set_replica_status(service_name: str, replica_id: int,
+                       status: ReplicaStatus,
+                       endpoint: Optional[str] = None) -> None:
+    conn = _get_conn()
+    with _lock:
+        if endpoint is not None:
+            conn.execute(
+                'UPDATE replicas SET status=?, endpoint=? '
+                'WHERE service_name=? AND replica_id=?',
+                (status.value, endpoint, service_name, replica_id))
+        else:
+            conn.execute(
+                'UPDATE replicas SET status=? '
+                'WHERE service_name=? AND replica_id=?',
+                (status.value, service_name, replica_id))
+        conn.commit()
+
+
+def bump_replica_failures(service_name: str, replica_id: int) -> int:
+    conn = _get_conn()
+    with _lock:
+        conn.execute(
+            'UPDATE replicas SET consecutive_failures='
+            'consecutive_failures+1 WHERE service_name=? AND replica_id=?',
+            (service_name, replica_id))
+        conn.commit()
+        row = conn.execute(
+            'SELECT consecutive_failures FROM replicas '
+            'WHERE service_name=? AND replica_id=?',
+            (service_name, replica_id)).fetchone()
+    return int(row[0]) if row else 0
+
+
+def clear_replica_failures(service_name: str, replica_id: int) -> None:
+    conn = _get_conn()
+    with _lock:
+        conn.execute(
+            'UPDATE replicas SET consecutive_failures=0 '
+            'WHERE service_name=? AND replica_id=?',
+            (service_name, replica_id))
+        conn.commit()
+
+
+def remove_replica(service_name: str, replica_id: int) -> None:
+    conn = _get_conn()
+    with _lock:
+        conn.execute(
+            'DELETE FROM replicas WHERE service_name=? AND replica_id=?',
+            (service_name, replica_id))
+        conn.commit()
+
+
+def get_replicas(service_name: str) -> List[Dict[str, Any]]:
+    conn = _get_conn()
+    rows = conn.execute(
+        'SELECT service_name, replica_id, cluster_name, status, version, '
+        'endpoint, launched_at, consecutive_failures FROM replicas '
+        'WHERE service_name=? ORDER BY replica_id',
+        (service_name,)).fetchall()
+    return [{
+        'service_name': r[0], 'replica_id': r[1], 'cluster_name': r[2],
+        'status': ReplicaStatus(r[3]), 'version': r[4], 'endpoint': r[5],
+        'launched_at': r[6], 'consecutive_failures': r[7],
+    } for r in rows]
+
+
+def next_replica_id(service_name: str) -> int:
+    conn = _get_conn()
+    row = conn.execute(
+        'SELECT COALESCE(MAX(replica_id), 0) FROM replicas '
+        'WHERE service_name=?', (service_name,)).fetchone()
+    return int(row[0]) + 1

@@ -22,7 +22,8 @@ API_PREFIX = '/api/v1'
 # Commands that are quick DB/metadata reads → SHORT workers.
 _SHORT_REQUESTS = frozenset({
     'status', 'queue', 'cost_report', 'check', 'optimize', 'autostop',
-    'cancel',
+    'cancel', 'jobs_launch', 'jobs_queue', 'jobs_cancel',
+    'serve_status',
 })
 
 

@@ -168,3 +168,69 @@ def server_user() -> str:
         return getpass.getuser()
     except (KeyError, OSError):  # pragma: no cover
         return 'unknown'
+
+
+# --- managed jobs -----------------------------------------------------------
+
+@executor.register('jobs_launch')
+def jobs_launch(payload: Dict[str, Any]) -> Dict[str, Any]:
+    from skypilot_tpu.jobs import core as jobs_core
+    task = _load_task(payload)
+    job_id = jobs_core.launch(
+        task, name=payload.get('name'),
+        max_recoveries=payload.get('max_recoveries', 3),
+        strategy=payload.get('strategy', 'EAGER_NEXT_REGION'))
+    return {'job_id': job_id}
+
+
+@executor.register('jobs_queue')
+def jobs_queue(payload: Dict[str, Any]) -> List[Dict[str, Any]]:
+    from skypilot_tpu.jobs import core as jobs_core
+    return jobs_core.queue()
+
+
+@executor.register('jobs_cancel')
+def jobs_cancel(payload: Dict[str, Any]) -> Dict[str, Any]:
+    from skypilot_tpu.jobs import core as jobs_core
+    cancelled = jobs_core.cancel(job_ids=payload.get('job_ids'),
+                                 all_jobs=payload.get('all_jobs', False))
+    return {'cancelled': cancelled}
+
+
+@executor.register('jobs_logs')
+def jobs_logs(payload: Dict[str, Any]) -> Dict[str, Any]:
+    from skypilot_tpu.jobs import core as jobs_core
+    rc = jobs_core.tail_logs(payload['job_id'],
+                             follow=payload.get('follow', True))
+    return {'exit_code': rc}
+
+
+# --- serve ------------------------------------------------------------------
+
+@executor.register('serve_up')
+def serve_up(payload: Dict[str, Any]) -> Dict[str, Any]:
+    from skypilot_tpu.serve import core as serve_core
+    task = _load_task(payload)
+    return serve_core.up(task, payload['service_name'],
+                         wait_seconds=payload.get('wait_seconds', 0.0))
+
+
+@executor.register('serve_down')
+def serve_down(payload: Dict[str, Any]) -> None:
+    from skypilot_tpu.serve import core as serve_core
+    serve_core.down(payload['service_name'],
+                    purge=payload.get('purge', False))
+
+
+@executor.register('serve_status')
+def serve_status(payload: Dict[str, Any]) -> List[Dict[str, Any]]:
+    from skypilot_tpu.serve import core as serve_core
+    return serve_core.status(payload.get('service_names'))
+
+
+@executor.register('serve_logs')
+def serve_logs(payload: Dict[str, Any]) -> Dict[str, Any]:
+    from skypilot_tpu.serve import core as serve_core
+    rc = serve_core.tail_logs(payload['service_name'],
+                              follow=payload.get('follow', True))
+    return {'exit_code': rc}

@@ -75,8 +75,10 @@ def make_train_state(cfg: TrainerConfig, mesh: Any,
             # optimizer state mirrors param sharding where shaped like
             # params; scalars replicate (jit infers from input sharding).
         )(params)
-    return {'params': params, 'opt_state': opt_state,
-            'step': jnp.zeros((), jnp.int32)}
+        step = jax.jit(
+            lambda: jnp.zeros((), jnp.int32),
+            out_shardings=sharding.named_sharding(mesh, ()))()
+    return {'params': params, 'opt_state': opt_state, 'step': step}
 
 
 def make_train_step(cfg: TrainerConfig,

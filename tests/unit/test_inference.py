@@ -1,0 +1,107 @@
+"""Inference engine: KV-cache decode must match the full forward pass.
+
+Greedy decoding with the cache is checked token-for-token against
+argmax over repeated full forwards — the strongest correctness oracle
+for cache bookkeeping (positions, RoPE offsets, masking).
+"""
+import jax
+import jax.numpy as jnp
+import pytest
+
+from skypilot_tpu import inference
+from skypilot_tpu.models import llama
+
+
+@pytest.fixture(scope='module')
+def tiny():
+    config = llama.CONFIGS['tiny']
+    params = llama.init_params(config, jax.random.key(7))
+    return config, params
+
+
+def _greedy_reference(params, config, prompt, steps):
+    """Argmax over a FULL forward pass each step (no cache)."""
+    tokens = list(prompt)
+    out = []
+    for _ in range(steps):
+        arr = jnp.array([tokens], jnp.int32)
+        logits = llama.forward(params, arr, config)
+        nxt = int(jnp.argmax(logits[0, -1]))
+        out.append(nxt)
+        tokens.append(nxt)
+    return out
+
+
+def test_prefill_decode_matches_full_forward(tiny):
+    config, params = tiny
+    prompt = [3, 17, 42, 9, 105, 8]
+    steps = 8
+    ref = _greedy_reference(params, config, prompt, steps)
+
+    engine = inference.InferenceEngine(params, config, batch_size=2,
+                                       max_seq_len=64)
+    rid = engine.submit(prompt, inference.SamplingParams(
+        temperature=0.0, max_new_tokens=steps))
+    results = engine.run_to_completion()
+    assert results[rid] == ref
+
+
+def test_continuous_batching_multiple_requests(tiny):
+    config, params = tiny
+    prompts = [[1, 2, 3], [10, 20, 30, 40], [7], [99, 98]]
+    refs = {i: _greedy_reference(params, config, p, 5)
+            for i, p in enumerate(prompts)}
+
+    # batch_size 2 < 4 requests forces slot reuse (continuous batching).
+    engine = inference.InferenceEngine(params, config, batch_size=2,
+                                       max_seq_len=64)
+    rids = {engine.submit(p, inference.SamplingParams(
+        temperature=0.0, max_new_tokens=5)): i
+        for i, p in enumerate(prompts)}
+    results = engine.run_to_completion()
+    assert set(results) == set(rids)
+    for rid, idx in rids.items():
+        assert results[rid] == refs[idx], f'prompt {idx} diverged'
+
+
+def test_eos_stops_generation(tiny):
+    config, params = tiny
+    prompt = [3, 17, 42]
+    ref = _greedy_reference(params, config, prompt, 12)
+    eos = ref[2]  # pretend the 3rd generated token is EOS
+    engine = inference.InferenceEngine(params, config, batch_size=1,
+                                       max_seq_len=64)
+    rid = engine.submit(prompt, inference.SamplingParams(
+        temperature=0.0, max_new_tokens=12, eos_token_id=eos))
+    results = engine.run_to_completion()
+    assert results[rid] == ref[:3]
+    assert results[rid][-1] == eos
+
+
+def test_sampling_respects_top_k_one(tiny):
+    """top_k=1 with temperature>0 must equal greedy."""
+    config, params = tiny
+    prompt = [5, 6, 7]
+    ref = _greedy_reference(params, config, prompt, 4)
+    engine = inference.InferenceEngine(params, config, batch_size=1,
+                                       max_seq_len=64, seed=123)
+    rid = engine.submit(prompt, inference.SamplingParams(
+        temperature=0.8, top_k=1, max_new_tokens=4))
+    results = engine.run_to_completion()
+    assert results[rid] == ref
+
+
+def test_cache_slot_reuse_isolation(tiny):
+    """A slot reused by a second request must not see stale KV."""
+    config, params = tiny
+    engine = inference.InferenceEngine(params, config, batch_size=1,
+                                       max_seq_len=64)
+    r1 = engine.submit([1, 2, 3, 4, 5],
+                       inference.SamplingParams(max_new_tokens=3))
+    first = engine.run_to_completion()
+    r2 = engine.submit([42, 43],
+                       inference.SamplingParams(max_new_tokens=3))
+    second = engine.run_to_completion()
+    ref = _greedy_reference(params, config, [42, 43], 3)
+    assert second[r2] == ref
+    assert first[r1] != second[r2] or True  # isolation asserted via ref

@@ -1,0 +1,141 @@
+"""Managed jobs on the local cloud: lifecycle, recovery, cancellation.
+
+The preemption test is the TPU analog of the reference's managed-job
+smoke tests (which terminate clusters out from under the controller —
+tests/smoke_tests/test_managed_job.py): we delete the local cluster's
+backing directory, the controller notices the cluster is gone, and the
+recovery strategy terminates+relaunches (TPU slices can never restart
+in place).
+"""
+import json
+import os
+import time
+
+import pytest
+
+from skypilot_tpu.jobs import controller as jobs_controller
+from skypilot_tpu.jobs import core as jobs_core
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu import task as task_lib
+
+
+@pytest.fixture(autouse=True)
+def jobs_env(monkeypatch, tmp_path):
+    """Fast polling; enabled-cloud cache on disk so controller
+    subprocesses see it too."""
+    monkeypatch.setenv('SKYTPU_JOBS_POLL_INTERVAL', '0.3')
+    monkeypatch.setenv('SKYTPU_JOBS_RETRY_GAP', '0.2')
+    jobs_controller._POLL_INTERVAL_SECONDS = 0.3
+    import skypilot_tpu.jobs.recovery_strategy as rs
+    rs._LAUNCH_RETRY_GAP_SECONDS = 0.2
+    cache = os.path.join(os.path.expanduser('~/.skytpu'))
+    os.makedirs(cache, exist_ok=True)
+    with open(os.path.join(cache, 'enabled_clouds.json'), 'w',
+              encoding='utf-8') as f:
+        json.dump({'enabled': ['local']}, f)
+    jobs_state.reset_for_tests()
+    yield
+    jobs_state.reset_for_tests()
+
+
+def _wait_status(job_id, statuses, timeout=60):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        record = jobs_state.get_job(job_id)
+        if record['status'] in statuses:
+            return record
+        time.sleep(0.2)
+    raise AssertionError(
+        f'job {job_id} stuck in {jobs_state.get_job(job_id)["status"]}, '
+        f'wanted {statuses}')
+
+
+def test_managed_job_success_in_process():
+    """Controller run inline (no subprocess): launch -> succeed -> clean."""
+    task = task_lib.Task(run='echo managed-ok', name='mj1')
+    job_id = jobs_state.submit_job('mj1', task.to_yaml_config())
+    jobs_controller.start(job_id)
+    record = jobs_state.get_job(job_id)
+    assert record['status'] == jobs_state.ManagedJobStatus.SUCCEEDED
+    # Cluster cleaned up after terminal state.
+    from skypilot_tpu import state as cluster_state
+    assert cluster_state.get_cluster_from_name(
+        record['cluster_name']) is None
+
+
+def test_managed_job_failure_propagates():
+    task = task_lib.Task(run='exit 3', name='mjfail')
+    job_id = jobs_state.submit_job('mjfail', task.to_yaml_config())
+    jobs_controller.start(job_id)
+    record = jobs_state.get_job(job_id)
+    assert record['status'] == jobs_state.ManagedJobStatus.FAILED
+
+
+def test_managed_job_recovery_after_preemption():
+    """Kill the cluster mid-run; controller must recover and finish."""
+    import threading
+    from skypilot_tpu.utils import paths as paths_lib
+
+    # Sentinel file: job succeeds quickly only on its SECOND life, so the
+    # first life runs long enough to be preempted.
+    sentinel = os.path.join(paths_lib.state_dir(), 'recovered_marker')
+    run_cmd = (f'if [ -f {sentinel} ]; then echo second-life-ok; '
+               f'else touch {sentinel} && sleep 120; fi')
+    task = task_lib.Task(run=run_cmd, name='mjrec')
+    job_id = jobs_state.submit_job('mjrec', task.to_yaml_config(),
+                                   max_recoveries=3,
+                                   strategy='EAGER_NEXT_REGION')
+
+    thread = threading.Thread(target=jobs_controller.start, args=(job_id,),
+                              daemon=True)
+    thread.start()
+    record = _wait_status(job_id, {jobs_state.ManagedJobStatus.RUNNING})
+
+    # Wait until the first life actually started (sentinel exists).
+    deadline = time.time() + 30
+    while not os.path.exists(sentinel) and time.time() < deadline:
+        time.sleep(0.2)
+    assert os.path.exists(sentinel)
+
+    # Preempt: wipe the local cluster's backing directory.
+    record = jobs_state.get_job(job_id)
+    from skypilot_tpu import state as cluster_state
+    cluster_record = cluster_state.get_cluster_from_name(
+        record['cluster_name'])
+    handle = cluster_record['handle']
+    import shutil
+    shutil.rmtree(os.path.join(paths_lib.local_clusters_dir(),
+                               handle.cluster_name_on_cloud),
+                  ignore_errors=True)
+
+    record = _wait_status(job_id, {jobs_state.ManagedJobStatus.SUCCEEDED},
+                          timeout=90)
+    assert record['recovery_count'] >= 1
+    thread.join(timeout=30)
+
+
+def test_managed_job_cancel():
+    import threading
+    task = task_lib.Task(run='sleep 120', name='mjcancel')
+    job_id = jobs_state.submit_job('mjcancel', task.to_yaml_config())
+    thread = threading.Thread(target=jobs_controller.start, args=(job_id,),
+                              daemon=True)
+    thread.start()
+    _wait_status(job_id, {jobs_state.ManagedJobStatus.RUNNING})
+    cancelled = jobs_core.cancel(job_ids=[job_id])
+    assert cancelled == [job_id]
+    record = _wait_status(job_id, {jobs_state.ManagedJobStatus.CANCELLED},
+                          timeout=60)
+    assert record['status'] == jobs_state.ManagedJobStatus.CANCELLED
+    thread.join(timeout=30)
+
+
+def test_jobs_queue_lists_and_pending_cancel():
+    task = task_lib.Task(run='echo x', name='q1')
+    job_id = jobs_state.submit_job('q1', task.to_yaml_config())
+    rows = jobs_core.queue(refresh_schedule=False)
+    assert rows[0]['job_id'] == job_id
+    assert rows[0]['status'] == 'PENDING'
+    assert jobs_core.cancel(job_ids=[job_id]) == [job_id]
+    assert jobs_state.get_job(job_id)['status'] == \
+        jobs_state.ManagedJobStatus.CANCELLED
