@@ -6,17 +6,24 @@ Prints ONE JSON line:
 Baseline: BASELINE.md's north-star of >=40% MFU for Llama finetune
 (the reference publishes no model-compute numbers — it is an
 orchestrator; SURVEY.md §6). vs_baseline = achieved_mfu / 0.40.
+
+Robustness: every timed step materializes the loss (true device sync —
+async dispatch through remote relays can make block_until_ready
+unreliable), and the loop stops at a wall-clock budget so a slow
+environment still reports a result.
 """
 import json
-import os
 import time
+
+_TIME_BUDGET_S = 240.0
+_MAX_STEPS = 10
 
 
 def main() -> None:
     import jax
 
-    from skypilot_tpu.train import trainer as train_lib
     from skypilot_tpu.parallel import mesh as mesh_lib
+    from skypilot_tpu.train import trainer as train_lib
 
     n_devices = jax.device_count()
     on_tpu = jax.devices()[0].platform == 'tpu'
@@ -25,7 +32,7 @@ def main() -> None:
     # adam states + remat at batch 2), tiny on CPU.
     model = 'bench-1b' if on_tpu else 'tiny'
     seq_len = 2048 if on_tpu else 128
-    per_chip_batch = 2 if on_tpu else 2
+    per_chip_batch = 2
 
     mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(fsdp=-1))
     cfg = train_lib.TrainerConfig(
@@ -41,26 +48,41 @@ def main() -> None:
     batch = train_lib.synthetic_batch(cfg, mesh)
     step = train_lib.make_train_step(cfg, mesh)
 
+    t_start = time.perf_counter()
+    step_times = []
     with mesh_lib.use_mesh(mesh):
-        # Warmup: compile + 2 steps.
+        # Warmup: compile + 2 steps (each synced via float()).
         for _ in range(3):
             state, metrics = step(state, batch)
-        jax.block_until_ready(metrics['loss'])
-
-        n_steps = 10 if on_tpu else 3
-        t0 = time.perf_counter()
-        for _ in range(n_steps):
+            loss = float(metrics['loss'])
+            if time.perf_counter() - t_start > _TIME_BUDGET_S:
+                break
+        while (len(step_times) < _MAX_STEPS and
+               time.perf_counter() - t_start < _TIME_BUDGET_S):
+            t0 = time.perf_counter()
             state, metrics = step(state, batch)
-        jax.block_until_ready(metrics['loss'])
-        dt = time.perf_counter() - t0
+            loss = float(metrics['loss'])  # device sync
+            step_times.append(time.perf_counter() - t0)
 
+    if not step_times:
+        print(json.dumps({
+            'metric': 'llama_train_tokens_per_sec_per_chip',
+            'value': 0.0, 'unit': 'tokens/s/chip', 'vs_baseline': 0.0,
+            'extra': {'error': 'no step finished within budget'},
+        }))
+        return
+
+    # Median step time is robust to stragglers.
+    step_times.sort()
+    dt = step_times[len(step_times) // 2]
     tokens_per_step = cfg.batch_size * cfg.seq_len
-    tokens_per_sec = tokens_per_step * n_steps / dt
+    tokens_per_sec = tokens_per_step / dt
     tokens_per_sec_chip = tokens_per_sec / n_devices
 
     chip = train_lib.detect_chip()
     peak = train_lib.PEAK_FLOPS[chip]
-    mfu = train_lib.mfu(tokens_per_sec, mcfg, cfg.seq_len, peak, n_devices)
+    mfu = train_lib.mfu(tokens_per_sec, mcfg, cfg.seq_len, peak,
+                        n_devices)
 
     result = {
         'metric': f'llama_{model}_train_tokens_per_sec_per_chip_{chip}',
@@ -73,6 +95,9 @@ def main() -> None:
             'seq_len': cfg.seq_len,
             'global_batch': cfg.batch_size,
             'model_params': mcfg.num_params(),
+            'median_step_s': round(dt, 4),
+            'steps_timed': len(step_times),
+            'final_loss': round(loss, 4),
         },
     }
     print(json.dumps(result))

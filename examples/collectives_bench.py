@@ -1,0 +1,103 @@
+"""ICI/DCN collectives benchmark: all-reduce/all-gather bus bandwidth.
+
+Replaces the reference's NCCL test recipe (examples/nccl_test.yaml:
+all_reduce_perf over 16 GPU ranks) with XLA collectives over the TPU
+fabric. busbw uses the standard ring-algorithm convention
+(2*(n-1)/n for all-reduce) so numbers are comparable to NCCL's.
+
+Run on any mesh:
+    python3 examples/collectives_bench.py --size-mb 256
+"""
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def bench_collective(name, fn, mesh, x, out_specs, iters=10):
+    from jax.experimental.shard_map import shard_map
+    try:
+        wrapped = jax.jit(shard_map(fn, mesh=mesh, in_specs=P('all'),
+                                    out_specs=out_specs,
+                                    check_vma=False))
+    except TypeError:  # older jax spells it check_rep
+        wrapped = jax.jit(shard_map(fn, mesh=mesh, in_specs=P('all'),
+                                    out_specs=out_specs,
+                                    check_rep=False))
+    out = wrapped(x)
+    jax.block_until_ready(out)  # compile + warmup
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = wrapped(x)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    return dt
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--size-mb', type=float, default=64.0)
+    parser.add_argument('--iters', type=int, default=10)
+    parser.add_argument('--force-cpu', type=int, default=0, metavar='N',
+                        help='Debug: N virtual CPU devices instead of '
+                        'the TPU.')
+    args = parser.parse_args()
+
+    if args.force_cpu:
+        import os
+        os.environ['XLA_FLAGS'] = (
+            os.environ.get('XLA_FLAGS', '') +
+            f' --xla_force_host_platform_device_count={args.force_cpu}'
+        ).strip()
+        jax.config.update('jax_platforms', 'cpu')
+        try:
+            from jax.extend import backend as _jexb
+            _jexb.clear_backends()
+        except Exception:  # noqa: BLE001
+            jax.clear_backends()
+
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    mesh_lib.initialize_distributed()
+    n = jax.device_count()
+    mesh = jax.sharding.Mesh(jax.devices(), ('all',))
+
+    nbytes = int(args.size_mb * 1e6)
+    nelem = nbytes // 4
+    x = jnp.zeros((nelem,), jnp.float32)
+    x = jax.device_put(
+        x, jax.sharding.NamedSharding(mesh, P('all')))
+
+    results = {}
+    dt = bench_collective(
+        'all_reduce', lambda s: jax.lax.psum(s, 'all'), mesh, x,
+        P(), args.iters)
+    algbw = nbytes / dt
+    results['all_reduce'] = {
+        'time_ms': dt * 1e3,
+        'algbw_GBps': algbw / 1e9,
+        'busbw_GBps': algbw * 2 * (n - 1) / n / 1e9,
+    }
+
+    dt = bench_collective(
+        'all_gather',
+        lambda s: jax.lax.all_gather(s, 'all', tiled=True), mesh, x,
+        P(), args.iters)
+    algbw = nbytes / dt
+    results['all_gather'] = {
+        'time_ms': dt * 1e3,
+        'algbw_GBps': algbw / 1e9,
+        'busbw_GBps': algbw * (n - 1) / n / 1e9,
+    }
+
+    print(json.dumps({
+        'devices': n,
+        'payload_mb': args.size_mb,
+        'results': results,
+    }, indent=1))
+
+
+if __name__ == '__main__':
+    main()

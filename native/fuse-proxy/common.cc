@@ -1,0 +1,105 @@
+#include "common.h"
+
+#include <cstring>
+#include <sstream>
+
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+namespace fuseproxy {
+
+std::string SerializeRequest(const Request& req) {
+  std::ostringstream out;
+  out << req.pid << '\n' << req.argv.size() << '\n';
+  for (const auto& a : req.argv) out << a << '\n';
+  out << (req.has_commfd ? 1 : 0) << '\n';
+  return out.str();
+}
+
+bool ParseRequest(const std::string& data, Request* req) {
+  std::istringstream in(data);
+  size_t argc = 0;
+  if (!(in >> req->pid >> argc)) return false;
+  in.ignore();  // trailing newline
+  req->argv.clear();
+  std::string line;
+  for (size_t i = 0; i < argc; i++) {
+    if (!std::getline(in, line)) return false;
+    req->argv.push_back(line);
+  }
+  int flag = 0;
+  if (!(in >> flag)) return false;
+  req->has_commfd = flag != 0;
+  return true;
+}
+
+std::string SerializeResponse(const Response& resp) {
+  std::ostringstream out;
+  out << resp.exit_code << '\n' << resp.output;
+  return out.str();
+}
+
+bool ParseResponse(const std::string& data, Response* resp) {
+  size_t nl = data.find('\n');
+  if (nl == std::string::npos) return false;
+  resp->exit_code = std::stoi(data.substr(0, nl));
+  resp->output = data.substr(nl + 1);
+  return true;
+}
+
+bool SendFrame(int sock, const std::string& payload, int fd) {
+  if (payload.size() > kMaxFrame) return false;
+  struct iovec iov;
+  iov.iov_base = const_cast<char*>(payload.data());
+  iov.iov_len = payload.size();
+  struct msghdr msg = {};
+  msg.msg_iov = &iov;
+  msg.msg_iovlen = 1;
+  char cmsgbuf[CMSG_SPACE(sizeof(int))];
+  if (fd >= 0) {
+    std::memset(cmsgbuf, 0, sizeof(cmsgbuf));
+    msg.msg_control = cmsgbuf;
+    msg.msg_controllen = sizeof(cmsgbuf);
+    struct cmsghdr* cmsg = CMSG_FIRSTHDR(&msg);
+    cmsg->cmsg_level = SOL_SOCKET;
+    cmsg->cmsg_type = SCM_RIGHTS;
+    cmsg->cmsg_len = CMSG_LEN(sizeof(int));
+    std::memcpy(CMSG_DATA(cmsg), &fd, sizeof(int));
+  }
+  return sendmsg(sock, &msg, 0) == static_cast<ssize_t>(payload.size());
+}
+
+bool RecvFrame(int sock, std::string* payload, int* fd) {
+  std::vector<char> buf(kMaxFrame);
+  struct iovec iov;
+  iov.iov_base = buf.data();
+  iov.iov_len = buf.size();
+  struct msghdr msg = {};
+  msg.msg_iov = &iov;
+  msg.msg_iovlen = 1;
+  char cmsgbuf[CMSG_SPACE(sizeof(int))];
+  msg.msg_control = cmsgbuf;
+  msg.msg_controllen = sizeof(cmsgbuf);
+  ssize_t n = recvmsg(sock, &msg, 0);
+  if (n < 0) return false;
+  payload->assign(buf.data(), static_cast<size_t>(n));
+  if (fd != nullptr) {
+    *fd = -1;
+    for (struct cmsghdr* cmsg = CMSG_FIRSTHDR(&msg); cmsg != nullptr;
+         cmsg = CMSG_NXTHDR(&msg, cmsg)) {
+      if (cmsg->cmsg_level == SOL_SOCKET &&
+          cmsg->cmsg_type == SCM_RIGHTS) {
+        std::memcpy(fd, CMSG_DATA(cmsg), sizeof(int));
+      }
+    }
+  }
+  return true;
+}
+
+std::string SocketPath() {
+  const char* env = getenv(kSocketEnv);
+  return env != nullptr ? env : kDefaultSocketPath;
+}
+
+}  // namespace fuseproxy

@@ -1,0 +1,41 @@
+// Shared protocol for fusermount-shim <-> fusermount-server.
+//
+// Frames over a SOCK_SEQPACKET unix socket; fds ride SCM_RIGHTS.
+// Reference architecture: skypilot addons/fuse-proxy (Go); this is an
+// independent C++ implementation.
+#pragma once
+
+#include <string>
+#include <vector>
+
+namespace fuseproxy {
+
+constexpr const char* kDefaultSocketPath = "/var/run/fusermount/server.sock";
+constexpr const char* kSocketEnv = "FUSERMOUNT_SERVER_SOCKET";
+constexpr const char* kRealFusermountEnv = "FUSERMOUNT_REAL_PATH";
+constexpr const char* kCommFdEnv = "_FUSE_COMMFD";
+constexpr size_t kMaxFrame = 1 << 20;
+
+struct Request {
+  int pid = 0;                       // caller pid (for /proc/<pid>/ns/mnt)
+  std::vector<std::string> argv;     // fusermount arguments
+  bool has_commfd = false;           // _FUSE_COMMFD fd attached?
+};
+
+struct Response {
+  int exit_code = 0;
+  std::string output;                // combined stdout+stderr
+};
+
+std::string SerializeRequest(const Request& req);
+bool ParseRequest(const std::string& data, Request* req);
+std::string SerializeResponse(const Response& resp);
+bool ParseResponse(const std::string& data, Response* resp);
+
+// Send/recv one frame with up to one attached fd (-1 = none).
+bool SendFrame(int sock, const std::string& payload, int fd);
+bool RecvFrame(int sock, std::string* payload, int* fd);
+
+std::string SocketPath();
+
+}  // namespace fuseproxy

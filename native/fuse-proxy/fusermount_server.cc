@@ -1,0 +1,136 @@
+// fusermount-server: privileged per-node daemon.
+//
+// Accepts shim requests, enters the caller's mount namespace
+// (setns(/proc/<pid>/ns/mnt)) in a forked child, and executes the real
+// fusermount with the forwarded argv + relayed _FUSE_COMMFD fd.
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <sched.h>
+#include <signal.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/un.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include "common.h"
+
+using fuseproxy::Request;
+using fuseproxy::Response;
+
+namespace {
+
+std::string RealFusermount() {
+  const char* env = getenv(fuseproxy::kRealFusermountEnv);
+  return env != nullptr ? env : "/usr/bin/fusermount";
+}
+
+Response HandleRequest(const Request& req, int commfd) {
+  Response resp;
+  int outpipe[2];
+  if (pipe(outpipe) != 0) {
+    resp.exit_code = 1;
+    resp.output = "server: pipe failed\n";
+    return resp;
+  }
+  pid_t child = fork();
+  if (child == 0) {
+    close(outpipe[0]);
+    dup2(outpipe[1], 1);
+    dup2(outpipe[1], 2);
+    // Join the caller's mount namespace so the mount lands in ITS view
+    // of the filesystem (the whole point of the proxy).
+    char ns_path[64];
+    snprintf(ns_path, sizeof(ns_path), "/proc/%d/ns/mnt", req.pid);
+    int nsfd = open(ns_path, O_RDONLY);
+    if (nsfd >= 0) {
+      if (setns(nsfd, CLONE_NEWNS) != 0) {
+        fprintf(stderr, "server: setns(%s): %s\n", ns_path,
+                strerror(errno));
+        _exit(111);
+      }
+      close(nsfd);
+    } else {
+      fprintf(stderr, "server: open(%s): %s (running un-namespaced)\n",
+              ns_path, strerror(errno));
+    }
+    std::vector<char*> argv;
+    std::string real = RealFusermount();
+    argv.push_back(const_cast<char*>(real.c_str()));
+    for (const auto& a : req.argv)
+      argv.push_back(const_cast<char*>(a.c_str()));
+    argv.push_back(nullptr);
+    if (req.has_commfd && commfd >= 0) {
+      char buf[16];
+      snprintf(buf, sizeof(buf), "%d", commfd);
+      setenv(fuseproxy::kCommFdEnv, buf, 1);
+    }
+    execv(argv[0], argv.data());
+    fprintf(stderr, "server: execv(%s): %s\n", argv[0], strerror(errno));
+    _exit(127);
+  }
+  close(outpipe[1]);
+  if (commfd >= 0) close(commfd);
+  char buf[4096];
+  ssize_t n;
+  while ((n = read(outpipe[0], buf, sizeof(buf))) > 0)
+    resp.output.append(buf, static_cast<size_t>(n));
+  close(outpipe[0]);
+  int status = 0;
+  waitpid(child, &status, 0);
+  resp.exit_code = WIFEXITED(status) ? WEXITSTATUS(status) : 128;
+  return resp;
+}
+
+}  // namespace
+
+int main() {
+  signal(SIGPIPE, SIG_IGN);
+  std::string path = fuseproxy::SocketPath();
+  // Socket dir must exist (shared hostPath volume in k8s).
+  unlink(path.c_str());
+  int sock = socket(AF_UNIX, SOCK_SEQPACKET, 0);
+  if (sock < 0) {
+    perror("server: socket");
+    return 1;
+  }
+  struct sockaddr_un addr = {};
+  addr.sun_family = AF_UNIX;
+  if (path.size() >= sizeof(addr.sun_path)) {
+    fprintf(stderr, "server: socket path too long\n");
+    return 1;
+  }
+  std::strncpy(addr.sun_path, path.c_str(), sizeof(addr.sun_path) - 1);
+  if (bind(sock, reinterpret_cast<struct sockaddr*>(&addr),
+           sizeof(addr)) != 0) {
+    perror("server: bind");
+    return 1;
+  }
+  chmod(path.c_str(), 0777);  // any pod user may call
+  if (listen(sock, 16) != 0) {
+    perror("server: listen");
+    return 1;
+  }
+  fprintf(stderr, "fusermount-server: listening on %s\n", path.c_str());
+  for (;;) {
+    int conn = accept(sock, nullptr, nullptr);
+    if (conn < 0) continue;
+    std::string payload;
+    int commfd = -1;
+    if (fuseproxy::RecvFrame(conn, &payload, &commfd)) {
+      Request req;
+      if (fuseproxy::ParseRequest(payload, &req)) {
+        Response resp = HandleRequest(req, commfd);
+        fuseproxy::SendFrame(conn, fuseproxy::SerializeResponse(resp),
+                             -1);
+      }
+    }
+    close(conn);
+  }
+}

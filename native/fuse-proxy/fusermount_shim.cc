@@ -1,0 +1,71 @@
+// fusermount-shim: masks fusermount(1) in unprivileged containers.
+//
+// Forwards argv (+ the _FUSE_COMMFD socket fd libfuse passed us, via
+// SCM_RIGHTS) to the privileged fusermount-server, which re-executes
+// the real fusermount inside OUR mount namespace. Output and exit code
+// are relayed back, so gcsfuse/goofys can't tell the difference.
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include "common.h"
+
+using fuseproxy::Request;
+using fuseproxy::Response;
+
+int main(int argc, char** argv) {
+  Request req;
+  req.pid = getpid();
+  for (int i = 1; i < argc; i++) req.argv.emplace_back(argv[i]);
+
+  int commfd = -1;
+  const char* commfd_env = getenv(fuseproxy::kCommFdEnv);
+  if (commfd_env != nullptr) {
+    commfd = atoi(commfd_env);
+    req.has_commfd = true;
+  }
+
+  int sock = socket(AF_UNIX, SOCK_SEQPACKET, 0);
+  if (sock < 0) {
+    perror("fusermount-shim: socket");
+    return 1;
+  }
+  struct sockaddr_un addr = {};
+  addr.sun_family = AF_UNIX;
+  std::string path = fuseproxy::SocketPath();
+  if (path.size() >= sizeof(addr.sun_path)) {
+    fprintf(stderr, "fusermount-shim: socket path too long: %s\n",
+            path.c_str());
+    return 1;
+  }
+  std::strncpy(addr.sun_path, path.c_str(), sizeof(addr.sun_path) - 1);
+  if (connect(sock, reinterpret_cast<struct sockaddr*>(&addr),
+              sizeof(addr)) != 0) {
+    fprintf(stderr, "fusermount-shim: cannot reach server at %s: %s\n",
+            path.c_str(), strerror(errno));
+    return 1;
+  }
+  if (!fuseproxy::SendFrame(sock, fuseproxy::SerializeRequest(req),
+                            commfd)) {
+    perror("fusermount-shim: send");
+    return 1;
+  }
+  std::string payload;
+  if (!fuseproxy::RecvFrame(sock, &payload, nullptr)) {
+    perror("fusermount-shim: recv");
+    return 1;
+  }
+  Response resp;
+  if (!fuseproxy::ParseResponse(payload, &resp)) {
+    fprintf(stderr, "fusermount-shim: bad response\n");
+    return 1;
+  }
+  fputs(resp.output.c_str(), stderr);
+  close(sock);
+  return resp.exit_code;
+}

@@ -1,0 +1,62 @@
+"""Admin policy: pluggable request mutation/validation hook.
+
+Reference analog: sky/admin_policy.py (applied at execution.py:137).
+Organizations point `admin_policy: mymodule.MyPolicy` in the config at
+a class implementing `validate_and_mutate`; every launch/exec flows
+through it (enforce labels, forbid clouds, cap resources, ...).
+"""
+import dataclasses
+import importlib
+from typing import Optional
+
+from skypilot_tpu import exceptions
+
+
+@dataclasses.dataclass
+class UserRequest:
+    task: 'object'               # skypilot_tpu.task.Task
+    cluster_name: Optional[str] = None
+    operation: str = 'launch'    # launch | exec | jobs_launch | serve_up
+
+
+@dataclasses.dataclass
+class MutatedUserRequest:
+    task: 'object'
+
+
+class AdminPolicy:
+    """Subclass and override; raise RejectedByPolicy to deny."""
+
+    def validate_and_mutate(self, request: UserRequest
+                            ) -> MutatedUserRequest:
+        return MutatedUserRequest(task=request.task)
+
+
+class RejectedByPolicy(exceptions.SkyTpuError):
+    """The admin policy rejected this request."""
+
+
+def _load_policy() -> Optional[AdminPolicy]:
+    from skypilot_tpu import config as config_lib
+    spec = config_lib.get_nested(('admin_policy',))
+    if not spec:
+        return None
+    module_name, _, class_name = str(spec).rpartition('.')
+    if not module_name:
+        raise exceptions.InvalidTaskError(
+            f'admin_policy must be module.Class, got {spec!r}')
+    cls = getattr(importlib.import_module(module_name), class_name)
+    return cls()
+
+
+def apply(task, cluster_name: Optional[str] = None,
+          operation: str = 'launch'):
+    """Run the configured policy over a task; returns the (possibly
+    mutated) task."""
+    policy = _load_policy()
+    if policy is None:
+        return task
+    mutated = policy.validate_and_mutate(
+        UserRequest(task=task, cluster_name=cluster_name,
+                    operation=operation))
+    return mutated.task

@@ -254,7 +254,11 @@ class GangBackend(backend_lib.Backend[ClusterHandle]):
                 runner.rsync(src_path, dst, up=True)
         if storage_mounts:
             from skypilot_tpu.data import storage_mounting
-            storage_mounting.mount_all(runners, storage_mounts)
+            specs = {
+                dst: (s.mount_spec() if hasattr(s, 'mount_spec') else s)
+                for dst, s in storage_mounts.items()
+            }
+            storage_mounting.mount_all(runners, specs)
 
     def _download_remote_source(self, runners, src: str, dst: str) -> None:
         if src.startswith('gs://'):

@@ -1,0 +1,67 @@
+"""Kubernetes 'catalog': rows synthesized from the request.
+
+Reference analog: sky/catalog/kubernetes_catalog.py (queries live
+cluster capacity). K8s has no price list; feasibility is decided by the
+scheduler at pod-admission time, so the catalog answers every request
+with a zero-cost row shaped like it (price 0 sorts k8s ahead of paid
+clouds when both are enabled, matching the reference's preference for
+bring-your-own capacity).
+"""
+from typing import Dict, List, Optional
+
+from skypilot_tpu.catalog import common
+from skypilot_tpu.utils import accelerators as acc_lib
+
+
+def list_accelerators(name_filter: Optional[str] = None
+                      ) -> Dict[str, List[common.InstanceTypeInfo]]:
+    out: Dict[str, List[common.InstanceTypeInfo]] = {}
+    for gen in acc_lib.TPU_GENERATIONS.values():
+        if name_filter and name_filter.lower() not in gen.name.lower():
+            continue
+        out[gen.name] = [common.InstanceTypeInfo(
+            cloud='kubernetes', instance_type=f'{gen.name}-node',
+            accelerator_name=gen.name, accelerator_count=1,
+            cpus=None, memory_gb=gen.hbm_gb_per_chip,
+            price=0.0, spot_price=None, region='in-cluster', zone=None)]
+    return out
+
+
+def get_feasible(resources) -> List[common.InstanceTypeInfo]:
+    acc = resources.sole_accelerator()
+    if resources.accelerators and acc is None:
+        return []
+    if resources.use_spot:
+        return []  # no spot notion in-cluster
+    if acc is not None and acc_lib.is_tpu(acc[0]):
+        gen = acc_lib.tpu_gen(acc[0])
+        chips = int(acc[1])
+        if chips > gen.chips_per_host:
+            # Multi-host GKE TPU slices need topology-aware node pools;
+            # gated until the multi-host pod path lands.
+            return []
+        return [common.InstanceTypeInfo(
+            cloud='kubernetes',
+            instance_type=f'tpu-{gen.slice_type(chips)}-pod',
+            accelerator_name=gen.name, accelerator_count=chips,
+            cpus=resources.cpus, memory_gb=None,
+            price=0.0, spot_price=None,
+            region='in-cluster', zone=None)]
+    if acc is not None:
+        # GPU pods: request nvidia.com/gpu (provision layer wires it).
+        return [common.InstanceTypeInfo(
+            cloud='kubernetes', instance_type=f'{acc[0]}-pod',
+            accelerator_name=acc[0], accelerator_count=acc[1],
+            cpus=resources.cpus, memory_gb=resources.memory,
+            price=0.0, spot_price=None,
+            region='in-cluster', zone=None)]
+    return [common.InstanceTypeInfo(
+        cloud='kubernetes', instance_type='cpu-pod',
+        accelerator_name=None, accelerator_count=0,
+        cpus=resources.cpus, memory_gb=resources.memory,
+        price=0.0, spot_price=None, region='in-cluster', zone=None)]
+
+
+def validate_region_zone(region: Optional[str],
+                         zone: Optional[str]) -> bool:
+    return zone is None

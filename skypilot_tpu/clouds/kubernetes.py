@@ -1,0 +1,71 @@
+"""Kubernetes cloud policy: pods as nodes, GKE TPU slices first-class.
+
+Reference analog: sky/clouds/kubernetes.py (989 LoC). Capability shape:
+no STOP (pods terminate), TPU via GKE node pools
+(`google.com/tpu` + gke-tpu-accelerator/topology selectors).
+"""
+import subprocess
+from typing import Dict, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.utils import registry
+
+
+@registry.CLOUD_REGISTRY.register(name='kubernetes', aliases=['k8s'])
+class Kubernetes(cloud.Cloud):
+    NAME = 'kubernetes'
+    CAPABILITIES = frozenset({
+        cloud.CloudCapability.MULTI_NODE,
+        cloud.CloudCapability.AUTOSTOP,      # auto-DOWN only
+        cloud.CloudCapability.OPEN_PORTS,
+        cloud.CloudCapability.TPU,
+        cloud.CloudCapability.CUSTOM_IMAGE,
+        cloud.CloudCapability.HOST_CONTROLLERS,
+        cloud.CloudCapability.STORAGE_MOUNT,
+    })
+    MAX_CLUSTER_NAME_LENGTH = 53  # pod-name suffix room under 63
+
+    def supports_for(self, cap: cloud.CloudCapability, resources) -> bool:
+        return self.supports(cap)
+
+    def provision_module(self) -> str:
+        return 'skypilot_tpu.provision.kubernetes'
+
+    def make_deploy_variables(self, resources, cluster_name_on_cloud: str,
+                              region: str, zone: Optional[str]
+                              ) -> Dict[str, object]:
+        from skypilot_tpu import config as config_lib
+        resources.assert_launchable()
+        variables: Dict[str, object] = {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'namespace': config_lib.get_nested(
+                ('kubernetes', 'namespace')) or 'default',
+            'instance_type': resources.instance_type,
+            'cpus': resources.cpus,
+            'memory': resources.memory,
+            'image_id': resources.image_id,
+            'labels': dict(resources.labels),
+        }
+        gen = resources.tpu_gen
+        if gen is not None:
+            chips = resources.tpu_num_chips
+            chips_per_node = min(chips, gen.chips_per_host)
+            variables.update({
+                'tpu_chips_per_node': chips_per_node,
+                'gke_accelerator': f'tpu-{gen.gcp_prefix}'
+                if not gen.gcp_prefix.startswith('v5litepod')
+                else 'tpu-v5-lite-podslice',
+                'tpu_topology': None,  # GKE infers for single-host sizes
+            })
+        return variables
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        try:
+            proc = subprocess.run(
+                ['kubectl', 'config', 'current-context'],
+                capture_output=True, timeout=10, check=False)
+            if proc.returncode == 0 and proc.stdout.strip():
+                return True, None
+            return False, 'kubectl has no current context configured.'
+        except (FileNotFoundError, subprocess.TimeoutExpired):
+            return False, 'kubectl not found on PATH.'

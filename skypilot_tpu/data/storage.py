@@ -1,0 +1,265 @@
+"""Storage abstraction: buckets with MOUNT / COPY modes.
+
+Reference analog: sky/data/storage.py (Storage :520, StoreType :118,
+S3Store :1347, GcsStore :1887 — 5.1k LoC driven by cloud SDKs). TPU-first
+cut: GCS is the primary store (TPU pods live on GCP; gcsfuse mounts feed
+training data and receive orbax checkpoints), S3 interops through the
+aws CLI. Store operations shell out to gsutil/aws (present on TPU-VM
+images) instead of binding SDKs, and tests register a LocalStore that
+backs "buckets" with directories — the whole Storage lifecycle runs with
+zero credentials.
+"""
+import enum
+import os
+import shutil
+import subprocess
+from typing import Any, Dict, List, Optional, Type
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import storage_utils
+
+
+class StoreType(enum.Enum):
+    GCS = 'gcs'
+    S3 = 's3'
+    LOCAL = 'local'   # directory-backed fake for tests/dev
+
+    @classmethod
+    def from_url(cls, url: str) -> 'StoreType':
+        if url.startswith('gs://'):
+            return cls.GCS
+        if url.startswith('s3://'):
+            return cls.S3
+        if url.startswith('local://'):
+            return cls.LOCAL
+        raise exceptions.StorageError(f'Cannot infer store from {url!r}')
+
+
+class StorageMode(enum.Enum):
+    MOUNT = 'MOUNT'
+    COPY = 'COPY'
+
+
+class AbstractStore:
+    """One bucket in one store."""
+
+    TYPE: StoreType
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+
+    # lifecycle
+    def exists(self) -> bool:
+        raise NotImplementedError
+
+    def create(self) -> None:
+        raise NotImplementedError
+
+    def delete(self) -> None:
+        raise NotImplementedError
+
+    def upload(self, source: str) -> None:
+        """Sync a local dir/file into the bucket root."""
+        raise NotImplementedError
+
+    def url(self) -> str:
+        return f'{self.TYPE.value}://{self.name}'
+
+    # remote-side command for the VM (mount or copy)
+    def mount_command(self, mount_path: str) -> str:
+        from skypilot_tpu.data import storage_mounting
+        return storage_mounting.mount_cmd(self.TYPE.value, self.name,
+                                          mount_path, mode='MOUNT')
+
+    def copy_command(self, mount_path: str) -> str:
+        from skypilot_tpu.data import storage_mounting
+        return storage_mounting.mount_cmd(self.TYPE.value, self.name,
+                                          mount_path, mode='COPY')
+
+
+def _run_cli(argv: List[str], what: str) -> str:
+    proc = subprocess.run(argv, capture_output=True, check=False,
+                          timeout=3600)
+    if proc.returncode != 0:
+        raise exceptions.StorageError(
+            f'{what} failed: '
+            f'{proc.stderr.decode(errors="replace").strip()}')
+    return proc.stdout.decode(errors='replace')
+
+
+class GcsStore(AbstractStore):
+    TYPE = StoreType.GCS
+
+    def exists(self) -> bool:
+        proc = subprocess.run(['gsutil', 'ls', '-b', f'gs://{self.name}'],
+                              capture_output=True, check=False, timeout=60)
+        return proc.returncode == 0
+
+    def create(self) -> None:
+        _run_cli(['gsutil', 'mb', f'gs://{self.name}'],
+                 f'creating gs://{self.name}')
+
+    def delete(self) -> None:
+        _run_cli(['gsutil', '-m', 'rm', '-r', f'gs://{self.name}'],
+                 f'deleting gs://{self.name}')
+
+    def upload(self, source: str) -> None:
+        source = os.path.expanduser(source)
+        if os.path.isdir(source):
+            argv = ['gsutil', '-m', 'rsync', '-r']
+            excludes = storage_utils.skyignore_excludes(source)
+            if excludes:
+                # gsutil -x takes ONE python regex; glob patterns must
+                # be translated and pipe-joined.
+                import fnmatch
+                regex = '|'.join(fnmatch.translate(p) for p in excludes)
+                argv += ['-x', regex]
+            argv += [source, f'gs://{self.name}']
+            _run_cli(argv, f'uploading {source}')
+        else:
+            _run_cli(['gsutil', 'cp', source, f'gs://{self.name}/'],
+                     f'uploading {source}')
+
+
+class S3Store(AbstractStore):
+    TYPE = StoreType.S3
+
+    def exists(self) -> bool:
+        proc = subprocess.run(
+            ['aws', 's3api', 'head-bucket', '--bucket', self.name],
+            capture_output=True, check=False, timeout=60)
+        return proc.returncode == 0
+
+    def create(self) -> None:
+        _run_cli(['aws', 's3', 'mb', f's3://{self.name}'],
+                 f'creating s3://{self.name}')
+
+    def delete(self) -> None:
+        _run_cli(['aws', 's3', 'rb', '--force', f's3://{self.name}'],
+                 f'deleting s3://{self.name}')
+
+    def upload(self, source: str) -> None:
+        source = os.path.expanduser(source)
+        if os.path.isdir(source):
+            argv = ['aws', 's3', 'sync', source, f's3://{self.name}']
+            for pattern in storage_utils.skyignore_excludes(source):
+                argv += ['--exclude', pattern]
+            _run_cli(argv, f'uploading {source}')
+        else:
+            _run_cli(['aws', 's3', 'cp', source, f's3://{self.name}/'],
+                     f'uploading {source}')
+
+
+class LocalStore(AbstractStore):
+    """Directory-backed store: local:// 'buckets' under the state dir.
+    The zero-credential path that keeps the full Storage lifecycle
+    testable (and usable with the local cloud)."""
+
+    TYPE = StoreType.LOCAL
+
+    @staticmethod
+    def root() -> str:
+        from skypilot_tpu.utils import paths
+        d = os.path.join(paths.state_dir(), 'local_buckets')
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def _dir(self) -> str:
+        return os.path.join(self.root(), self.name)
+
+    def exists(self) -> bool:
+        return os.path.isdir(self._dir())
+
+    def create(self) -> None:
+        os.makedirs(self._dir(), exist_ok=True)
+
+    def delete(self) -> None:
+        shutil.rmtree(self._dir(), ignore_errors=True)
+
+    def upload(self, source: str) -> None:
+        source = os.path.expanduser(source)
+        if not self.exists():
+            self.create()
+        excludes = storage_utils.skyignore_excludes(source)
+        if os.path.isdir(source):
+            ignore = (shutil.ignore_patterns(*excludes) if excludes
+                      else None)
+            shutil.copytree(source, self._dir(), dirs_exist_ok=True,
+                            ignore=ignore)
+        else:
+            shutil.copy2(source, self._dir())
+
+
+_STORE_CLASSES: Dict[StoreType, Type[AbstractStore]] = {
+    StoreType.GCS: GcsStore,
+    StoreType.S3: S3Store,
+    StoreType.LOCAL: LocalStore,
+}
+
+
+def make_store(store_type: StoreType, name: str) -> AbstractStore:
+    return _STORE_CLASSES[store_type](name)
+
+
+class Storage:
+    """A named storage object mountable into tasks.
+
+    YAML shape (reference-compatible, sky/data/storage.py):
+        file_mounts:
+          /data:
+            name: my-bucket          # bucket name
+            source: ./training_data  # optional local dir to upload
+            store: gcs               # gcs | s3 | local
+            mode: MOUNT              # MOUNT | COPY
+    """
+
+    def __init__(self, name: str, source: Optional[str] = None,
+                 store: Optional[str] = None,
+                 mode: str = 'MOUNT',
+                 persistent: bool = True) -> None:
+        if not name:
+            raise exceptions.StorageError('Storage needs a bucket name.')
+        self.name = name
+        self.source = source
+        self.mode = StorageMode(mode.upper())
+        self.persistent = persistent
+        if store is not None:
+            store_type = StoreType(store.lower())
+        elif source is not None and '://' in source:
+            store_type = StoreType.from_url(source)
+        else:
+            store_type = StoreType.GCS
+        self.store = make_store(store_type, name)
+
+    @classmethod
+    def from_yaml_config(cls, cfg: Dict[str, Any]) -> 'Storage':
+        return cls(name=cfg.get('name', ''),
+                   source=cfg.get('source'),
+                   store=cfg.get('store'),
+                   mode=cfg.get('mode', 'MOUNT'),
+                   persistent=cfg.get('persistent', True))
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        cfg: Dict[str, Any] = {'name': self.name,
+                               'store': self.store.TYPE.value,
+                               'mode': self.mode.value}
+        if self.source is not None:
+            cfg['source'] = self.source
+        if not self.persistent:
+            cfg['persistent'] = False
+        return cfg
+
+    def sync(self) -> None:
+        """Ensure the bucket exists; upload source if local."""
+        if not self.store.exists():
+            self.store.create()
+        if self.source and '://' not in self.source:
+            self.store.upload(self.source)
+
+    def delete(self) -> None:
+        self.store.delete()
+
+    def mount_spec(self) -> Dict[str, str]:
+        """The dict storage_mounting.mount_all consumes."""
+        return {'store': self.store.TYPE.value, 'bucket': self.name,
+                'mode': self.mode.value}

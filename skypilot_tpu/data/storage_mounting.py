@@ -19,7 +19,7 @@ def mount_cmd(store_type: str, bucket: str, mount_path: str,
               mode: str = 'MOUNT') -> str:
     q_path = shlex.quote(mount_path)
     q_bucket = shlex.quote(bucket)
-    if mode == 'COPY':
+    if mode == 'COPY' and store_type != 'local':
         if store_type == 'gcs':
             return (f'mkdir -p {q_path} && '
                     f'gsutil -m rsync -r gs://{q_bucket} {q_path}')
@@ -32,6 +32,15 @@ def mount_cmd(store_type: str, bucket: str, mount_path: str,
         return (f'{_GCSFUSE_INSTALL} && mkdir -p {q_path} && '
                 f'mountpoint -q {q_path} || '
                 f'gcsfuse --implicit-dirs {q_bucket} {q_path}')
+    if store_type == 'local':
+        # Directory-backed bucket (same machine): symlink is the mount.
+        from skypilot_tpu.data import storage as storage_lib
+        bucket_dir = shlex.quote(
+            f'{storage_lib.LocalStore.root()}/{bucket}')
+        if mode == 'MOUNT':
+            return (f'mkdir -p $(dirname {q_path}) && '
+                    f'ln -sfn {bucket_dir} {q_path}')
+        return f'mkdir -p {q_path} && cp -a {bucket_dir}/. {q_path}/'
     raise exceptions.StorageError(f'MOUNT: unsupported store {store_type}')
 
 

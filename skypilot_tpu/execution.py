@@ -49,7 +49,10 @@ def launch(task_or_dag, *, cluster_name: str,
     if len(dag.tasks) != 1:
         raise exceptions.InvalidDagError(
             'launch() takes a single task; use managed jobs for pipelines.')
-    task = dag.tasks[0]
+    from skypilot_tpu import admin_policy
+    task = admin_policy.apply(dag.tasks[0], cluster_name=cluster_name,
+                              operation='launch')
+    dag.tasks[0] = task
     backend = backend or gang_backend.GangBackend()
     optimize_target = optimize_target or optimizer_lib.OptimizeTarget.COST
 
@@ -88,6 +91,7 @@ def launch(task_or_dag, *, cluster_name: str,
     if task.workdir:
         backend.sync_workdir(handle, task.workdir)
     if task.file_mounts or task.storage_mounts:
+        task.sync_storage_mounts()
         backend.sync_file_mounts(handle, task.file_mounts,
                                  task.storage_mounts)
     job_id = None
@@ -104,7 +108,9 @@ def exec_cmd(task_or_dag, *, cluster_name: str, dryrun: bool = False,
     """Run on an existing UP cluster; skips provision/sync/setup
     (reference sky/execution.py:663)."""
     dag = _as_dag(task_or_dag)
-    task = dag.tasks[0]
+    from skypilot_tpu import admin_policy
+    task = admin_policy.apply(dag.tasks[0], cluster_name=cluster_name,
+                              operation='exec')
     backend = backend or gang_backend.GangBackend()
     record = state.get_cluster_from_name(cluster_name)
     if record is None or record['handle'] is None:

@@ -1,0 +1,233 @@
+"""Kubernetes provisioner: pods-as-nodes via kubectl.
+
+Reference analog: sky/provision/kubernetes/ (5.9k LoC; pods-as-nodes,
+instance.py:1342). TPU-first cut: drives `kubectl` as a subprocess (no
+python SDK dependency; the binary is ubiquitous and testable with a
+fake), one pod per logical node, GKE TPU pod slices via
+`google.com/tpu` resources + topology nodeSelectors.
+"""
+import json
+import shlex
+import subprocess
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.utils import command_runner
+
+CLUSTER_LABEL = 'skytpu-cluster'
+HEAD_LABEL = 'skytpu-head'
+
+_DEFAULT_IMAGE = 'python:3.11-slim'
+
+
+def _kubectl(args: List[str], namespace: Optional[str] = None,
+             input_data: Optional[str] = None) -> str:
+    argv = ['kubectl']
+    if namespace:
+        argv += ['-n', namespace]
+    argv += args
+    proc = subprocess.run(argv, capture_output=True, text=True,
+                          input=input_data, timeout=300, check=False)
+    if proc.returncode != 0:
+        raise exceptions.ProvisionError(
+            f'kubectl {" ".join(args[:3])}... failed: '
+            f'{proc.stderr.strip()}')
+    return proc.stdout
+
+
+def _pod_name(cluster_name_on_cloud: str, index: int) -> str:
+    return f'{cluster_name_on_cloud}-{index}'
+
+
+def _pod_manifest(config: common.ProvisionConfig, index: int,
+                  cluster_name_on_cloud: str) -> Dict[str, Any]:
+    pc = config.provider_config
+    nc = {**pc, **config.node_config}
+    name = _pod_name(cluster_name_on_cloud, index)
+    resources: Dict[str, Any] = {}
+    limits: Dict[str, Any] = {}
+    if nc.get('cpus'):
+        resources['cpu'] = str(nc['cpus'])
+    if nc.get('memory'):
+        resources['memory'] = f'{nc["memory"]}Gi'
+    tpu_chips = nc.get('tpu_chips_per_node')
+    node_selector: Dict[str, str] = dict(nc.get('node_selector', {}))
+    if tpu_chips:
+        # GKE TPU: request chips + pin accelerator/topology selectors.
+        limits['google.com/tpu'] = str(tpu_chips)
+        if nc.get('gke_accelerator'):
+            node_selector['cloud.google.com/gke-tpu-accelerator'] = \
+                nc['gke_accelerator']
+        if nc.get('tpu_topology'):
+            node_selector['cloud.google.com/gke-tpu-topology'] = \
+                nc['tpu_topology']
+    manifest = {
+        'apiVersion': 'v1',
+        'kind': 'Pod',
+        'metadata': {
+            'name': name,
+            'labels': {
+                CLUSTER_LABEL: cluster_name_on_cloud,
+                HEAD_LABEL: 'true' if index == 0 else 'false',
+                **nc.get('labels', {}),
+            },
+        },
+        'spec': {
+            'restartPolicy': 'Never',
+            'containers': [{
+                'name': 'main',
+                'image': nc.get('image_id', _DEFAULT_IMAGE),
+                'command': ['/bin/bash', '-c',
+                            'sleep infinity'],
+                'resources': ({'requests': resources,
+                               'limits': {**resources, **limits}}
+                              if resources or limits else {}),
+            }],
+        },
+    }
+    if node_selector:
+        manifest['spec']['nodeSelector'] = node_selector
+    return manifest
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    del region  # k8s "region" is the context/namespace
+    namespace = config.provider_config.get('namespace', 'default')
+    existing = query_instances(cluster_name_on_cloud,
+                               config.provider_config)
+    created: List[str] = []
+    for i in range(config.count):
+        name = _pod_name(cluster_name_on_cloud, i)
+        if existing.get(name) in ('running', 'pending'):
+            continue
+        manifest = _pod_manifest(config, i, cluster_name_on_cloud)
+        _kubectl(['apply', '-f', '-'], namespace=namespace,
+                 input_data=json.dumps(manifest))
+        created.append(name)
+    return common.ProvisionRecord(
+        provider_name='kubernetes',
+        region=namespace, zone=None,
+        cluster_name_on_cloud=cluster_name_on_cloud,
+        head_instance_id=_pod_name(cluster_name_on_cloud, 0),
+        created_instance_ids=created)
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: Optional[str] = None) -> None:
+    import time
+    deadline = time.time() + 600
+    while time.time() < deadline:
+        statuses = query_instances(cluster_name_on_cloud,
+                                   {'namespace': region})
+        if statuses and all(s == 'running' for s in statuses.values()):
+            return
+        if any(s == 'terminated' for s in statuses.values()):
+            raise exceptions.CapacityError(
+                f'Pod(s) failed: {statuses}')
+        time.sleep(2)
+    raise exceptions.ProvisionError(
+        f'Pods not running after 600s: {cluster_name_on_cloud}')
+
+
+def _list_pods(cluster_name_on_cloud: str,
+               namespace: str) -> List[Dict[str, Any]]:
+    out = _kubectl(['get', 'pods', '-l',
+                    f'{CLUSTER_LABEL}={cluster_name_on_cloud}',
+                    '-o', 'json'], namespace=namespace)
+    return json.loads(out).get('items', [])
+
+
+_PHASE_MAP = {
+    'Pending': 'pending',
+    'Running': 'running',
+    'Succeeded': 'terminated',
+    'Failed': 'terminated',
+    'Unknown': 'pending',
+}
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Dict[str, Any]
+                    ) -> Dict[str, Optional[str]]:
+    namespace = provider_config.get('namespace', 'default')
+    out: Dict[str, Optional[str]] = {}
+    for pod in _list_pods(cluster_name_on_cloud, namespace):
+        phase = pod.get('status', {}).get('phase', 'Unknown')
+        out[pod['metadata']['name']] = _PHASE_MAP.get(phase, 'pending')
+    return out
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Dict[str, Any]) -> None:
+    raise exceptions.NotSupportedError(
+        'Kubernetes pods cannot stop; terminate instead.')
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Dict[str, Any]) -> None:
+    namespace = provider_config.get('namespace', 'default')
+    _kubectl(['delete', 'pods', '-l',
+              f'{CLUSTER_LABEL}={cluster_name_on_cloud}',
+              '--ignore-not-found=true', '--wait=false'],
+             namespace=namespace)
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Dict[str, Any]) -> common.ClusterInfo:
+    del region
+    namespace = provider_config.get('namespace', 'default')
+    instances: Dict[str, common.InstanceInfo] = {}
+    head_id: Optional[str] = None
+    for pod in _list_pods(cluster_name_on_cloud, namespace):
+        if pod.get('status', {}).get('phase') != 'Running':
+            continue
+        name = pod['metadata']['name']
+        instances[name] = common.InstanceInfo(
+            instance_id=name,
+            hosts=[common.HostInfo(
+                host_id=name,
+                internal_ip=pod.get('status', {}).get('podIP', ''))],
+            status='running',
+            tags=dict(pod['metadata'].get('labels', {})))
+        if pod['metadata'].get('labels', {}).get(HEAD_LABEL) == 'true':
+            head_id = name
+    if head_id is None and instances:
+        head_id = sorted(instances)[0]
+    return common.ClusterInfo(
+        instances=instances, head_instance_id=head_id,
+        provider_name='kubernetes',
+        provider_config=provider_config,
+        ssh_user='root')
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str],
+               provider_config: Dict[str, Any]) -> None:
+    """Expose ports with a Service per cluster."""
+    namespace = provider_config.get('namespace', 'default')
+    manifest = {
+        'apiVersion': 'v1',
+        'kind': 'Service',
+        'metadata': {'name': f'{cluster_name_on_cloud}-svc',
+                     'labels': {CLUSTER_LABEL: cluster_name_on_cloud}},
+        'spec': {
+            'selector': {CLUSTER_LABEL: cluster_name_on_cloud,
+                         HEAD_LABEL: 'true'},
+            'ports': [{'name': f'p{p}', 'port': int(p),
+                       'targetPort': int(p)} for p in ports],
+            'type': provider_config.get('service_type', 'ClusterIP'),
+        },
+    }
+    _kubectl(['apply', '-f', '-'], namespace=namespace,
+             input_data=json.dumps(manifest))
+
+
+def get_command_runners(cluster_info: common.ClusterInfo
+                        ) -> List[command_runner.CommandRunner]:
+    namespace = cluster_info.provider_config.get('namespace', 'default')
+    return [
+        command_runner.KubernetesCommandRunner(inst.instance_id,
+                                               namespace=namespace)
+        for inst in cluster_info.ordered_instances()
+    ]

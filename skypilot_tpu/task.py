@@ -158,8 +158,19 @@ class Task:
             num_nodes=config.get('num_nodes'),
             envs={k: str(v) for k, v in envs.items()},
             secrets={k: str(v) for k, v in secrets.items()},
-            file_mounts=config.get('file_mounts'),
         )
+        # file_mounts: plain str values are path copies; dict values are
+        # Storage objects (reference sky/task.py:497 split).
+        file_mounts = config.get('file_mounts') or {}
+        plain: Dict[str, str] = {}
+        for dst, src in file_mounts.items():
+            if isinstance(src, dict):
+                from skypilot_tpu.data import storage as storage_lib
+                task.storage_mounts[dst] = \
+                    storage_lib.Storage.from_yaml_config(src)
+            else:
+                plain[dst] = src
+        task.file_mounts = plain or None
         if 'resources' in config and config['resources'] is not None:
             res = resources_lib.Resources.from_yaml_config(
                 config['resources'])
@@ -169,6 +180,13 @@ class Task:
             task.set_service(
                 service_spec.ServiceSpec.from_yaml_config(config['service']))
         return task
+
+    def sync_storage_mounts(self) -> 'Task':
+        """Create buckets + upload local sources (reference
+        sky/task.py:1222)."""
+        for storage in self.storage_mounts.values():
+            storage.sync()
+        return self
 
     @classmethod
     def from_yaml(cls, path: str,
@@ -206,8 +224,10 @@ class Task:
             cfg['envs'] = dict(self._envs)
         if self._secrets:
             cfg['secrets'] = dict(self._secrets)
-        if self.file_mounts:
-            cfg['file_mounts'] = dict(self.file_mounts)
+        if self.file_mounts or self.storage_mounts:
+            cfg['file_mounts'] = dict(self.file_mounts or {})
+            for dst, storage in self.storage_mounts.items():
+                cfg['file_mounts'][dst] = storage.to_yaml_config()
         if self.service is not None:
             cfg['service'] = self.service.to_yaml_config()
         return cfg

@@ -48,6 +48,16 @@ class CommandRunner:
               log_path: Optional[str] = None) -> None:
         raise NotImplementedError
 
+    @staticmethod
+    def _shell_prefix(env, cwd) -> str:
+        prefix = ''
+        if env:
+            prefix += ' '.join(f'export {k}={shlex.quote(str(v))};'
+                               for k, v in env.items())
+        if cwd:
+            prefix += f'cd {shlex.quote(cwd)} && '
+        return prefix
+
     def check_connection(self) -> bool:
         try:
             rc = self.run('true', timeout=15)
@@ -185,13 +195,7 @@ class SSHCommandRunner(CommandRunner):
             cwd=None, require_outputs=False, timeout=None):
         if isinstance(cmd, list):
             cmd = ' '.join(shlex.quote(c) for c in cmd)
-        prefix = ''
-        if env:
-            exports = ' '.join(f'export {k}={shlex.quote(str(v))};'
-                               for k, v in env.items())
-            prefix += exports
-        if cwd:
-            prefix += f'cd {shlex.quote(cwd)} && '
+        prefix = self._shell_prefix(env, cwd)
         wrapped = f'bash --login -c {shlex.quote(prefix + cmd)}'
         argv = self._ssh_base() + [wrapped]
         return self._run_subprocess(
@@ -214,3 +218,89 @@ class SSHCommandRunner(CommandRunner):
                                             env=dict(os.environ))
         if rc != 0:
             raise exceptions.CommandError(rc, 'rsync', err)
+
+
+class KubernetesCommandRunner(CommandRunner):
+    """kubectl exec / cp against one pod (reference
+    utils/command_runner.py:716)."""
+
+    def __init__(self, pod_name: str, *, namespace: str = 'default',
+                 container: str = 'main'):
+        super().__init__(f'{namespace}/{pod_name}')
+        self.pod_name = pod_name
+        self.namespace = namespace
+        self.container = container
+        self._pod_home = None
+
+    def _base(self) -> List[str]:
+        return ['kubectl', '-n', self.namespace]
+
+    def run(self, cmd, *, env=None, stream_logs=False, log_path=None,
+            cwd=None, require_outputs=False, timeout=None):
+        if isinstance(cmd, list):
+            cmd = ' '.join(shlex.quote(c) for c in cmd)
+        prefix = self._shell_prefix(env, cwd)
+        argv = self._base() + [
+            'exec', self.pod_name, '-c', self.container, '--',
+            'bash', '-c', prefix + cmd]
+        return self._run_subprocess(
+            argv, env=dict(os.environ), stream_logs=stream_logs,
+            log_path=log_path, require_outputs=require_outputs,
+            timeout=timeout)
+
+    def _resolve_home(self, path: str) -> str:
+        """'~/x' -> '$HOME/x' in the POD (kubectl cp and quoted shell
+        substitutions never tilde-expand)."""
+        if not path.startswith('~'):
+            return path
+        if self._pod_home is None:
+            rc, out, err = self.run('echo $HOME', require_outputs=True)
+            if rc != 0 or not out.strip():
+                raise exceptions.CommandError(rc, 'echo $HOME',
+                                              err or out)
+            self._pod_home = out.strip().splitlines()[-1]
+        rest = path[1:].lstrip('/')
+        return f'{self._pod_home}/{rest}' if rest else self._pod_home
+
+    def rsync(self, source: str, target: str, *, up: bool,
+              excludes=None, log_path=None):
+        """Directory sync via tar over kubectl exec (honors excludes);
+        single files via kubectl cp."""
+        source = os.path.expanduser(source)
+        if up:
+            target = self._resolve_home(target)
+            if os.path.isdir(source):
+                tar_args = ''.join(
+                    f'--exclude={shlex.quote(e)} ' for e in excludes or [])
+                dest = target.rstrip('/')
+                local = (f'tar -cz {tar_args}-C {shlex.quote(source)} .')
+                remote = (f'mkdir -p {shlex.quote(dest)} && '
+                          f'tar -xz -C {shlex.quote(dest)}')
+                argv = self._base() + [
+                    'exec', '-i', self.pod_name, '-c', self.container,
+                    '--', 'bash', '-c', remote]
+                import subprocess as sp
+                tar_proc = sp.Popen(['bash', '-c', local],
+                                    stdout=sp.PIPE)
+                rc = sp.run(argv, stdin=tar_proc.stdout,
+                            capture_output=True, check=False).returncode
+                tar_proc.wait()
+                if rc != 0 or tar_proc.returncode != 0:
+                    raise exceptions.CommandError(
+                        rc or tar_proc.returncode, 'tar|kubectl exec', '')
+                return
+            self.run(f'mkdir -p $(dirname {shlex.quote(target)})')
+            argv = self._base() + [
+                'cp', source,
+                f'{self.namespace}/{self.pod_name}:{target}',
+                '-c', self.container]
+        else:
+            argv = self._base() + [
+                'cp',
+                f'{self.namespace}/{self.pod_name}:'
+                f'{self._resolve_home(target)}',
+                source, '-c', self.container]
+        rc, out, err = self._run_subprocess(argv, require_outputs=True,
+                                            env=dict(os.environ))
+        if rc != 0:
+            raise exceptions.CommandError(rc, 'kubectl cp', err or out)

@@ -1,0 +1,55 @@
+"""Storage helpers: .skyignore handling + upload size accounting.
+
+Reference analog: sky/data/storage_utils.py (326 LoC).
+"""
+import os
+from typing import List
+
+SKYIGNORE_FILE = '.skyignore'
+GITIGNORE_FILE = '.gitignore'
+
+
+def skyignore_excludes(source: str) -> List[str]:
+    """Exclusion patterns for an upload rooted at `source`.
+
+    .skyignore wins when present; else .gitignore's simple patterns are
+    honored (reference behavior: storage_utils.get_excluded_files).
+    Comment lines and negations are skipped.
+    """
+    source = os.path.expanduser(source)
+    if not os.path.isdir(source):
+        return []
+    for fname in (SKYIGNORE_FILE, GITIGNORE_FILE):
+        path = os.path.join(source, fname)
+        if not os.path.isfile(path):
+            continue
+        patterns = []
+        with open(path, 'r', encoding='utf-8') as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith('#') or \
+                        line.startswith('!'):
+                    continue
+                patterns.append(line.rstrip('/'))
+        if fname == SKYIGNORE_FILE:
+            return patterns
+        if patterns:
+            return patterns + ['.git']
+    return []
+
+
+def du_bytes(path: str) -> int:
+    """Total size of a file/dir in bytes (pre-upload sanity checks)."""
+    path = os.path.expanduser(path)
+    if os.path.isfile(path):
+        return os.path.getsize(path)
+    total = 0
+    for root, _, files in os.walk(path):
+        for f in files:
+            full = os.path.join(root, f)
+            if not os.path.islink(full):
+                try:
+                    total += os.path.getsize(full)
+                except OSError:
+                    pass
+    return total

@@ -1,0 +1,94 @@
+"""Timeline profiling: spans -> Chrome trace JSON.
+
+Reference analog: sky/utils/timeline.py:22 (`Event`, `@timeline.event`
+:75; enabled via env var, viewable in chrome://tracing / Perfetto).
+Enable with SKYTPU_TIMELINE=/path/to/trace.json.
+"""
+import atexit
+import functools
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+_ENV_VAR = 'SKYTPU_TIMELINE'
+_events: List[Dict[str, Any]] = []
+_lock = threading.Lock()
+_registered = False
+
+
+def enabled() -> bool:
+    return bool(os.environ.get(_ENV_VAR))
+
+
+def _ensure_flush_registered() -> None:
+    global _registered
+    if not _registered:
+        atexit.register(save)
+        _registered = True
+
+
+class Event:
+    """Context manager emitting one complete ('X') trace event."""
+
+    def __init__(self, name: str, message: Optional[str] = None):
+        self._name = name
+        self._message = message
+        self._begin = 0.0
+
+    def __enter__(self) -> 'Event':
+        self._begin = time.time()
+        return self
+
+    def __exit__(self, *args) -> None:
+        if not enabled():
+            return
+        _ensure_flush_registered()
+        event = {
+            'name': self._name,
+            'ph': 'X',
+            'ts': self._begin * 1e6,
+            'dur': (time.time() - self._begin) * 1e6,
+            'pid': os.getpid(),
+            'tid': threading.get_ident() % (1 << 31),
+        }
+        if self._message:
+            event['args'] = {'message': self._message}
+        with _lock:
+            _events.append(event)
+
+
+def event(fn=None, *, name: Optional[str] = None):
+    """Decorator form: @timeline.event or @timeline.event(name=...)."""
+    def wrap(f):
+        label = name or f'{f.__module__}.{f.__qualname__}'
+
+        @functools.wraps(f)
+        def inner(*args, **kwargs):
+            with Event(label):
+                return f(*args, **kwargs)
+        return inner
+    if fn is not None:
+        return wrap(fn)
+    return wrap
+
+
+def save(path: Optional[str] = None) -> Optional[str]:
+    """Write accumulated events as a Chrome trace; returns the path."""
+    path = path or os.environ.get(_ENV_VAR)
+    if not path:
+        return None
+    with _lock:
+        events = list(_events)
+    if not events:
+        return None
+    path = os.path.expanduser(path)
+    os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
+    # One file per process: the server's forked workers each trace.
+    if os.path.exists(path):
+        root, ext = os.path.splitext(path)
+        path = f'{root}.{os.getpid()}{ext}'
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump({'traceEvents': events}, f)
+    return path

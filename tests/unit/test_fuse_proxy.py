@@ -1,0 +1,99 @@
+"""fuse-proxy C++ round trip: shim -> unix socket -> server -> fusermount.
+
+Builds the native binaries with make, runs the server with a FAKE
+fusermount (records argv, prints, exits with a chosen code), then calls
+the shim exactly as libfuse would — including the _FUSE_COMMFD fd-pass —
+and asserts argv/exit-code/output relay.
+"""
+import os
+import socket
+import subprocess
+import time
+
+import pytest
+
+NATIVE_DIR = os.path.join(os.path.dirname(__file__), '..', '..',
+                          'native', 'fuse-proxy')
+
+
+@pytest.fixture(scope='module')
+def binaries():
+    subprocess.run(['make', '-s'], cwd=NATIVE_DIR, check=True, timeout=120)
+    build = os.path.join(NATIVE_DIR, 'build')
+    return (os.path.join(build, 'fusermount-shim'),
+            os.path.join(build, 'fusermount-server'))
+
+
+@pytest.fixture
+def server(binaries, tmp_path):
+    _, server_bin = binaries
+    sock_path = str(tmp_path / 'server.sock')
+    fake = tmp_path / 'fake_fusermount.sh'
+    argv_log = tmp_path / 'argv.log'
+    fake.write_text(
+        '#!/bin/bash\n'
+        f'echo "$@" > {argv_log}\n'
+        'echo "fusermount-output: $1"\n'
+        'if [ "$1" = "--fail" ]; then exit 7; fi\n'
+        'if [ -n "$_FUSE_COMMFD" ]; then echo "commfd=$_FUSE_COMMFD"; fi\n'
+        'exit 0\n')
+    fake.chmod(0o755)
+    env = dict(os.environ,
+               FUSERMOUNT_SERVER_SOCKET=sock_path,
+               FUSERMOUNT_REAL_PATH=str(fake))
+    proc = subprocess.Popen([server_bin], env=env,
+                            stderr=subprocess.PIPE)
+    deadline = time.time() + 10
+    while not os.path.exists(sock_path) and time.time() < deadline:
+        time.sleep(0.05)
+    assert os.path.exists(sock_path), 'server did not bind'
+    yield {'sock': sock_path, 'argv_log': str(argv_log), 'env': env}
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+def _run_shim(binaries, server, args, extra_env=None, pass_fds=()):
+    shim, _ = binaries
+    env = dict(server['env'])
+    env.update(extra_env or {})
+    return subprocess.run([shim] + args, env=env, capture_output=True,
+                          timeout=30, pass_fds=pass_fds)
+
+
+def test_argv_and_output_relay(binaries, server):
+    result = _run_shim(binaries, server,
+                       ['-u', '/mnt/test', '-o', 'opt1,opt2'])
+    assert result.returncode == 0, result.stderr
+    assert b'fusermount-output: -u' in result.stderr
+    with open(server['argv_log']) as f:
+        assert f.read().strip() == '-u /mnt/test -o opt1,opt2'
+
+
+def test_exit_code_relay(binaries, server):
+    result = _run_shim(binaries, server, ['--fail'])
+    assert result.returncode == 7
+
+
+def test_commfd_fd_passing(binaries, server):
+    """The _FUSE_COMMFD socket fd must reach the real fusermount."""
+    left, right = socket.socketpair()
+    try:
+        fd = right.fileno()
+        result = _run_shim(binaries, server, ['/mnt/x'],
+                           extra_env={'_FUSE_COMMFD': str(fd)},
+                           pass_fds=(fd,))
+        assert result.returncode == 0, result.stderr
+        assert b'commfd=' in result.stderr
+    finally:
+        left.close()
+        right.close()
+
+
+def test_shim_fails_cleanly_without_server(binaries, tmp_path):
+    shim, _ = binaries
+    env = dict(os.environ,
+               FUSERMOUNT_SERVER_SOCKET=str(tmp_path / 'nope.sock'))
+    result = subprocess.run([shim, '-u', '/x'], env=env,
+                            capture_output=True, timeout=30)
+    assert result.returncode == 1
+    assert b'cannot reach server' in result.stderr

@@ -1,0 +1,73 @@
+"""Admin policy hook + timeline profiling."""
+import json
+import os
+
+import pytest
+
+from skypilot_tpu import admin_policy
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.utils import timeline
+
+
+# A policy class the config points at (module-level so importlib finds it).
+class ForbidNamelessPolicy(admin_policy.AdminPolicy):
+    def validate_and_mutate(self, request):
+        if request.task.name is None:
+            raise admin_policy.RejectedByPolicy('tasks must be named')
+        request.task.update_envs({'POLICY_APPLIED': '1'})
+        return admin_policy.MutatedUserRequest(task=request.task)
+
+
+def test_policy_applied_and_rejecting(monkeypatch, enable_clouds):
+    enable_clouds('local')
+    from skypilot_tpu import config as config_lib
+    monkeypatch.setattr(
+        config_lib, 'get_nested',
+        lambda keys, default=None: (
+            f'{__name__}.ForbidNamelessPolicy'
+            if keys == ('admin_policy',) else default))
+
+    import skypilot_tpu as sky
+    with pytest.raises(admin_policy.RejectedByPolicy):
+        sky.launch(task_lib.Task(run='echo x'), cluster_name='pol-test')
+
+    task = task_lib.Task(run='echo $POLICY_APPLIED', name='named')
+    job_id, handle = sky.launch(task, cluster_name='pol-test')
+    from skypilot_tpu.skylet import job_lib
+    log = open(job_lib.job_log_path(handle.runtime_dir, job_id)).read()
+    assert '1' in log
+    sky.down('pol-test')
+
+
+def test_no_policy_is_noop():
+    task = task_lib.Task(run='echo x')
+    assert admin_policy.apply(task) is task
+
+
+def test_timeline_records_and_saves(tmp_path, monkeypatch):
+    trace = tmp_path / 'trace.json'
+    monkeypatch.setenv('SKYTPU_TIMELINE', str(trace))
+    monkeypatch.setattr(timeline, '_events', [])
+
+    with timeline.Event('provision', 'cluster x'):
+        pass
+
+    @timeline.event
+    def do_work():
+        return 42
+
+    assert do_work() == 42
+    path = timeline.save()
+    data = json.load(open(path))
+    names = [e['name'] for e in data['traceEvents']]
+    assert 'provision' in names
+    assert any('do_work' in n for n in names)
+
+
+def test_timeline_disabled_is_free(monkeypatch):
+    monkeypatch.delenv('SKYTPU_TIMELINE', raising=False)
+    monkeypatch.setattr(timeline, '_events', [])
+    with timeline.Event('x'):
+        pass
+    assert timeline._events == []
+    assert timeline.save() is None
