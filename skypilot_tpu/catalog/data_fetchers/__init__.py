@@ -1,0 +1,1 @@
+"""Offline catalog generators (CSV builders from cloud pricing APIs)."""

@@ -1,0 +1,133 @@
+"""Generate the GCP TPU catalog CSV from the Cloud Billing Catalog API.
+
+Reference analog: sky/catalog/data_fetchers/fetch_gcp.py:34-67,456-536
+(TPU SKU scraping + hidden-zone patches). Ours walks the public
+cloudbilling v1 SKU list for the Compute Engine service, extracts TPU
+chip-hour SKUs (on-demand + spot; commitment SKUs excluded), and
+rewrites skypilot_tpu/catalog/data/gcp/tpus.csv. (vms.csv is shipped
+static; a VM core/ram fetcher is future work.) Runs through the same
+injectable transport as the provisioner, so tests feed it fake SKU
+pages.
+
+Usage:
+    python -m skypilot_tpu.catalog.data_fetchers.fetch_gcp --out-dir ...
+"""
+import argparse
+import csv
+import os
+import re
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from skypilot_tpu.adaptors import gcp as gcp_adaptor
+
+BILLING_API = 'https://cloudbilling.googleapis.com/v1'
+# Compute Engine's service id in the billing catalog (public constant).
+COMPUTE_SERVICE = 'services/6F81-5844-456A'
+
+# 'Tpu v5e' / 'Tpu-v4' / 'Tpu v5p' spellings seen in SKU descriptions.
+_TPU_DESC_RE = re.compile(
+    r'tpu[ -]?(v\d+[a-z]*)', re.IGNORECASE)
+
+_GEN_MAP = {
+    'v2': 'tpu-v2', 'v3': 'tpu-v3', 'v4': 'tpu-v4',
+    'v5e': 'tpu-v5e', 'v5p': 'tpu-v5p', 'v6e': 'tpu-v6e',
+}
+
+
+def _list_skus(page_size: int = 500) -> Iterable[Dict[str, Any]]:
+    t = gcp_adaptor.transport()
+    page_token: Optional[str] = None
+    while True:
+        params = {'pageSize': str(page_size)}
+        if page_token:
+            params['pageToken'] = page_token
+        resp = t.request('GET', f'{BILLING_API}/{COMPUTE_SERVICE}/skus',
+                         params=params)
+        yield from resp.get('skus', [])
+        page_token = resp.get('nextPageToken')
+        if not page_token:
+            return
+
+
+def _sku_usd_per_hour(sku: Dict[str, Any]) -> Optional[float]:
+    infos = sku.get('pricingInfo', [])
+    if not infos:
+        return None
+    expr = infos[0].get('pricingExpression', {})
+    rates = expr.get('tieredRates', [])
+    if not rates:
+        return None
+    price = rates[-1].get('unitPrice', {})
+    units = float(price.get('units', 0) or 0)
+    nanos = float(price.get('nanos', 0) or 0)
+    return units + nanos / 1e9
+
+
+def _usage_kind(sku: Dict[str, Any]) -> Optional[str]:
+    """'ondemand' | 'spot' | None (commitment SKUs are excluded — they
+    would otherwise undercut the on-demand column)."""
+    usage = sku.get('category', {}).get('usageType', '')
+    if usage == 'OnDemand':
+        return 'ondemand'
+    if usage in ('Preemptible', 'Spot'):
+        return 'spot'
+    return None
+
+
+def fetch_tpu_rows() -> List[Dict[str, Any]]:
+    """(generation, region, price/chip/hr, spot price) rows."""
+    by_key: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    for sku in _list_skus():
+        desc = sku.get('description', '')
+        match = _TPU_DESC_RE.search(desc)
+        if not match:
+            continue
+        gen = _GEN_MAP.get(match.group(1).lower())
+        if gen is None:
+            continue
+        kind = _usage_kind(sku)
+        if kind is None:
+            continue
+        price = _sku_usd_per_hour(sku)
+        if price is None or price <= 0:
+            continue
+        for region in sku.get('serviceRegions', []):
+            key = (gen, region)
+            row = by_key.setdefault(key, {
+                'generation': gen, 'region': region,
+                'zone': f'{region}-a',
+                'price_per_chip': None, 'spot_price_per_chip': None,
+            })
+            field = ('spot_price_per_chip' if kind == 'spot'
+                     else 'price_per_chip')
+            if row[field] is None or price < row[field]:
+                row[field] = price
+    return [r for r in by_key.values() if r['price_per_chip'] is not None]
+
+
+def write_tpu_csv(rows: List[Dict[str, Any]], path: str) -> int:
+    rows = sorted(rows, key=lambda r: (r['generation'], r['region']))
+    with open(path, 'w', newline='', encoding='utf-8') as f:
+        writer = csv.DictWriter(
+            f, fieldnames=['generation', 'region', 'zone',
+                           'price_per_chip', 'spot_price_per_chip'])
+        writer.writeheader()
+        for row in rows:
+            writer.writerow(row)
+    return len(rows)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    default_out = os.path.join(os.path.dirname(__file__), '..', 'data',
+                               'gcp')
+    parser.add_argument('--out-dir', default=default_out)
+    args = parser.parse_args()
+    os.makedirs(args.out_dir, exist_ok=True)
+    n = write_tpu_csv(fetch_tpu_rows(),
+                      os.path.join(args.out_dir, 'tpus.csv'))
+    print(f'wrote {n} TPU rows to {args.out_dir}/tpus.csv')
+
+
+if __name__ == '__main__':
+    main()

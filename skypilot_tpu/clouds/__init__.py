@@ -2,10 +2,12 @@
 from skypilot_tpu.clouds.cloud import Cloud, CloudCapability
 from skypilot_tpu.clouds import gcp as _gcp  # noqa: F401 (registers)
 from skypilot_tpu.clouds import local as _local  # noqa: F401 (registers)
+from skypilot_tpu.clouds import ssh as _ssh  # noqa: F401 (registers)
 from skypilot_tpu.utils.registry import CLOUD_REGISTRY
 
 GCP = _gcp.GCP
 Local = _local.Local
+SSH = _ssh.SSHCloud
 
 try:  # kubernetes is optional until round 2+
     from skypilot_tpu.clouds import kubernetes as _k8s  # noqa: F401

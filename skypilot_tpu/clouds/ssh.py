@@ -1,0 +1,86 @@
+"""SSH cloud policy: declared node pools of existing machines.
+
+Reference analog: sky/clouds/ssh.py. Pools come from config
+(`ssh.node_pools.<name>.hosts`); a pool name is the 'region'.
+"""
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu.catalog.common import InstanceTypeInfo
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.utils import registry
+
+
+@registry.CLOUD_REGISTRY.register(name='ssh')
+class SSHCloud(cloud.Cloud):
+    NAME = 'ssh'
+    CAPABILITIES = frozenset({
+        cloud.CloudCapability.MULTI_NODE,
+        cloud.CloudCapability.AUTOSTOP,   # auto-down (release) only
+        cloud.CloudCapability.OPEN_PORTS,
+        cloud.CloudCapability.TPU,        # on-prem TPU VMs in a pool
+    })
+    MAX_CLUSTER_NAME_LENGTH = 64
+
+    def supports_for(self, cap: cloud.CloudCapability, resources) -> bool:
+        if cap == cloud.CloudCapability.STOP:
+            return False
+        return self.supports(cap)
+
+    def provision_module(self) -> str:
+        return 'skypilot_tpu.provision.ssh'
+
+    def _pools(self) -> Dict[str, Dict]:
+        from skypilot_tpu import config as config_lib
+        return config_lib.get_nested(('ssh', 'node_pools'), {}) or {}
+
+    def get_feasible(self, resources) -> List[InstanceTypeInfo]:
+        if resources.use_spot:
+            return []
+        acc = resources.sole_accelerator()
+        if resources.accelerators and acc is None:
+            return []
+        rows = []
+        for pool, cfg in sorted(self._pools().items()):
+            if resources.region and resources.region != pool:
+                continue
+            if acc is not None:
+                pool_acc = cfg.get('accelerators')  # 'tpu-v4:8' style
+                if pool_acc is None:
+                    continue
+                name, _, count = str(pool_acc).partition(':')
+                from skypilot_tpu.utils import accelerators as acc_lib
+                canon, cnt = acc_lib.canonicalize(
+                    name, float(count or 1))
+                if canon != acc[0] or cnt < acc[1]:
+                    continue
+            rows.append(InstanceTypeInfo(
+                cloud='ssh', instance_type='ssh-node',
+                accelerator_name=acc[0] if acc else None,
+                accelerator_count=acc[1] if acc else 0,
+                cpus=None, memory_gb=None, price=0.0, spot_price=None,
+                region=pool, zone=None))
+        return rows
+
+    def validate_region_zone(self, region: Optional[str],
+                             zone: Optional[str]) -> bool:
+        return zone is None and (region is None or
+                                 region in self._pools())
+
+    def make_deploy_variables(self, resources, cluster_name_on_cloud: str,
+                              region: str, zone: Optional[str]
+                              ) -> Dict[str, object]:
+        return {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'pool': region,
+            'region': region,
+            'zone': None,
+            'instance_type': 'ssh-node',
+            'use_spot': False,
+            'tpu_vm': False,
+        }
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        if self._pools():
+            return True, None
+        return False, ('No ssh node pools configured '
+                       '(config: ssh.node_pools).')

@@ -1,4 +1,24 @@
-"""Model zoo (TPU-native JAX). Flagship: llama."""
+"""Model zoo (TPU-native JAX). Flagship: llama; MoE: mixtral-style.
+
+Each family module exposes the same functional surface:
+CONFIGS, init_params, param_logical_axes, forward, loss_fn — so the
+trainer/inference layers are family-agnostic. `resolve(name)` maps a
+config name ('llama3-8b', 'mixtral-8x7b', ...) to (module, config).
+"""
+from typing import Any, Tuple
+
 from skypilot_tpu.models import llama
 
-__all__ = ['llama']
+
+def resolve(name: str) -> Tuple[Any, Any]:
+    """Config name -> (family module, config dataclass)."""
+    if name in llama.CONFIGS:
+        return llama, llama.CONFIGS[name]
+    from skypilot_tpu.models import moe
+    if name in moe.CONFIGS:
+        return moe, moe.CONFIGS[name]
+    known = sorted(llama.CONFIGS) + sorted(moe.CONFIGS)
+    raise ValueError(f'Unknown model {name!r}; available: {known}')
+
+
+__all__ = ['llama', 'resolve']

@@ -1,0 +1,284 @@
+"""Mixture-of-Experts model family (Mixtral-style), expert-parallel.
+
+TPU-native MoE: GShard-style top-k routing with static capacity —
+dispatch/combine are dense one-hot einsums (no ragged shapes, so XLA
+tiles everything onto the MXU), and the stacked expert weights are
+sharded over the mesh's `expert` axis; GSPMD inserts the all_to_all
+for token dispatch across expert shards. The reference has no MoE (or
+any model) in-tree — its MoE recipes shell out to vLLM/DeepSpeed
+(llm/deepseek-r1/, SURVEY.md §2.11).
+
+Reuses Llama's attention block; only the MLP is replaced by the
+routed expert layer.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.parallel import sharding
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoeConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    max_seq_len: int = 8192
+    rope_theta: float = 1e6
+    rms_norm_eps: float = 1e-5
+    num_experts: int = 8
+    num_experts_per_tok: int = 2
+    capacity_factor: float = 1.25
+    router_aux_loss_coef: float = 0.02
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    attention_impl: str = 'dense'
+    attention_block_size: int = 512
+
+    def num_params(self) -> int:
+        e, m, v = self.hidden_size, self.intermediate_size, self.vocab_size
+        h, kv, d = self.num_heads, self.num_kv_heads, self.head_dim
+        x = self.num_experts
+        per_layer = (e * h * d + 2 * e * kv * d + h * d * e
+                     + 3 * e * m * x + e * x
+                     + 2 * e)
+        return self.num_layers * per_layer + 2 * v * e + e
+
+    def active_params(self) -> int:
+        """Params touched per token (top-k of the experts)."""
+        e, m = self.hidden_size, self.intermediate_size
+        h, kv, d = self.num_heads, self.num_kv_heads, self.head_dim
+        k = self.num_experts_per_tok
+        per_layer = (e * h * d + 2 * e * kv * d + h * d * e
+                     + 3 * e * m * k + e * self.num_experts + 2 * e)
+        return self.num_layers * per_layer + 2 * self.vocab_size * e + e
+
+    def flops_per_token(self, seq_len: int) -> float:
+        attn = 12 * self.num_layers * self.num_heads * self.head_dim * \
+            seq_len
+        return 6.0 * self.active_params() + attn
+
+
+CONFIGS: Dict[str, MoeConfig] = {
+    'mixtral-8x7b': MoeConfig(),
+    'tiny-moe': MoeConfig(vocab_size=256, hidden_size=64,
+                          intermediate_size=128, num_layers=2,
+                          num_heads=4, num_kv_heads=2, head_dim=16,
+                          max_seq_len=128, num_experts=4,
+                          num_experts_per_tok=2, dtype=jnp.float32,
+                          remat=False),
+}
+
+
+def param_logical_axes(config: MoeConfig) -> Params:
+    return {
+        'embed': ('vocab', 'embed'),
+        'layers': {
+            'attn_norm': ('layers', 'embed'),
+            'wq': ('layers', 'embed', 'heads', 'head_dim'),
+            'wk': ('layers', 'embed', 'kv_heads', 'head_dim'),
+            'wv': ('layers', 'embed', 'kv_heads', 'head_dim'),
+            'wo': ('layers', 'heads', 'head_dim', 'embed'),
+            'mlp_norm': ('layers', 'embed'),
+            'router': ('layers', 'embed', 'expert'),
+            'w_gate': ('layers', 'expert', 'embed', 'mlp'),
+            'w_up': ('layers', 'expert', 'embed', 'mlp'),
+            'w_down': ('layers', 'expert', 'mlp', 'embed'),
+        },
+        'final_norm': ('embed',),
+        'lm_head': ('embed', 'vocab'),
+    }
+
+
+def init_params(config: MoeConfig, key: jax.Array) -> Params:
+    c = config
+    keys = jax.random.split(key, 12)
+    dt = c.dtype
+
+    def normal(k, shape, fan_in):
+        scale = 1.0 / math.sqrt(fan_in)
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dt)
+
+    L, e, m = c.num_layers, c.hidden_size, c.intermediate_size
+    h, kv, d, x = c.num_heads, c.num_kv_heads, c.head_dim, c.num_experts
+    return {
+        'embed': normal(keys[0], (c.vocab_size, e), e),
+        'layers': {
+            'attn_norm': jnp.ones((L, e), dt),
+            'wq': normal(keys[1], (L, e, h, d), e),
+            'wk': normal(keys[2], (L, e, kv, d), e),
+            'wv': normal(keys[3], (L, e, kv, d), e),
+            'wo': normal(keys[4], (L, h, d, e), h * d),
+            'mlp_norm': jnp.ones((L, e), dt),
+            'router': normal(keys[5], (L, e, x), e).astype(jnp.float32),
+            'w_gate': normal(keys[6], (L, x, e, m), e),
+            'w_up': normal(keys[7], (L, x, e, m), e),
+            'w_down': normal(keys[8], (L, x, m, e), m),
+        },
+        'final_norm': jnp.ones((e,), dt),
+        'lm_head': normal(keys[9], (e, c.vocab_size), e),
+    }
+
+
+def _capacity(config: MoeConfig, num_tokens: int) -> int:
+    c = math.ceil(config.capacity_factor * num_tokens *
+                  config.num_experts_per_tok / config.num_experts)
+    return max(4, int(c))
+
+
+def _route(h: jax.Array, router: jax.Array, config: MoeConfig
+           ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-k routing with static capacity.
+
+    h: [G, E] flattened tokens. Returns (dispatch [G,X,C] one-hot,
+    combine [G,X,C] gate weights, aux_loss scalar).
+    """
+    c = config
+    g = h.shape[0]
+    cap = _capacity(c, g)
+    logits = jnp.einsum('ge,ex->gx', h.astype(jnp.float32),
+                        router)                       # [G,X]
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    # Aux load-balancing loss (Switch-style): mean prob * mean assignment.
+    top1 = jnp.argmax(probs, axis=-1)
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(top1, c.num_experts), axis=0)
+    aux_loss = c.num_experts * jnp.sum(me * ce)
+
+    # Top-k expert choice per token.
+    topk_probs, topk_idx = lax.top_k(probs, c.num_experts_per_tok)
+    topk_probs = topk_probs / jnp.maximum(
+        jnp.sum(topk_probs, axis=-1, keepdims=True), 1e-9)
+
+    dispatch = jnp.zeros((g, c.num_experts, cap), jnp.float32)
+    combine = jnp.zeros((g, c.num_experts, cap), jnp.float32)
+    for slot in range(c.num_experts_per_tok):
+        idx = topk_idx[:, slot]                       # [G]
+        onehot = jax.nn.one_hot(idx, c.num_experts)   # [G,X]
+        # Position of each token within its expert's capacity buffer =
+        # running count of this slot's prior assignments + slots already
+        # consumed by earlier top-k rounds.
+        base = jnp.sum(dispatch, axis=(0, 2))         # [X] used slots
+        position = jnp.cumsum(onehot, axis=0) - onehot + base[None, :]
+        pos = jnp.sum(position * onehot, axis=-1).astype(jnp.int32)
+        keep = (pos < cap) & (jnp.sum(onehot, axis=-1) > 0)
+        pos_oh = jax.nn.one_hot(jnp.clip(pos, 0, cap - 1), cap)
+        contrib = (onehot[:, :, None] * pos_oh[:, None, :] *
+                   keep[:, None, None])
+        dispatch = dispatch + contrib
+        combine = combine + contrib * topk_probs[:, slot][:, None, None]
+    return dispatch, combine, aux_loss
+
+
+def _moe_mlp(h: jax.Array, layer_params: Params, config: MoeConfig
+             ) -> Tuple[jax.Array, jax.Array]:
+    """h: [B,S,E] -> (out [B,S,E], aux_loss)."""
+    c = config
+    b, s, e = h.shape
+    flat = h.reshape(b * s, e)
+    dispatch, combine, aux_loss = _route(flat, layer_params['router'], c)
+    dispatch = dispatch.astype(c.dtype)
+
+    # Dispatch tokens to expert buffers: [X,C,E]. GSPMD turns this into
+    # an all_to_all when X is sharded over the expert axis.
+    expert_in = jnp.einsum('gxc,ge->xce', dispatch, flat)
+    expert_in = sharding.shard(expert_in, ('expert', None, 'embed'))
+    gate = jnp.einsum('xce,xem->xcm', expert_in, layer_params['w_gate'],
+                      preferred_element_type=jnp.float32)
+    up = jnp.einsum('xce,xem->xcm', expert_in, layer_params['w_up'],
+                    preferred_element_type=jnp.float32)
+    act = (jax.nn.silu(gate) * up).astype(c.dtype)
+    expert_out = jnp.einsum('xcm,xme->xce', act, layer_params['w_down'],
+                            preferred_element_type=jnp.float32
+                            ).astype(c.dtype)
+    out = jnp.einsum('gxc,xce->ge', combine.astype(c.dtype), expert_out)
+    return out.reshape(b, s, e), aux_loss
+
+
+def _layer(x: jax.Array, layer_params: Params, config: MoeConfig,
+           positions: jax.Array, mesh: Optional[Any]
+           ) -> Tuple[jax.Array, jax.Array]:
+    c = config
+    from skypilot_tpu.ops import attention as attention_ops
+
+    h = llama._rms_norm(x, layer_params['attn_norm'], c.rms_norm_eps)
+    q = jnp.einsum('bse,ehd->bshd', h, layer_params['wq'],
+                   preferred_element_type=jnp.float32).astype(c.dtype)
+    k = jnp.einsum('bse,ehd->bshd', h, layer_params['wk'],
+                   preferred_element_type=jnp.float32).astype(c.dtype)
+    v = jnp.einsum('bse,ehd->bshd', h, layer_params['wv'],
+                   preferred_element_type=jnp.float32).astype(c.dtype)
+    q = llama._rope(q, positions, c.rope_theta)
+    k = llama._rope(k, positions, c.rope_theta)
+    attn = attention_ops.attention(
+        q, k, v, causal=True, impl=c.attention_impl, mesh=mesh,
+        block_size=c.attention_block_size)
+    attn_out = jnp.einsum('bshd,hde->bse', attn, layer_params['wo'],
+                          preferred_element_type=jnp.float32
+                          ).astype(c.dtype)
+    x = x + attn_out
+
+    h = llama._rms_norm(x, layer_params['mlp_norm'], c.rms_norm_eps)
+    moe_out, aux_loss = _moe_mlp(h, layer_params, c)
+    return x + moe_out, aux_loss
+
+
+def forward(params: Params, tokens: jax.Array, config: MoeConfig,
+            mesh: Optional[Any] = None,
+            positions: Optional[jax.Array] = None
+            ) -> Tuple[jax.Array, jax.Array]:
+    """tokens [B,S] -> (logits [B,S,V] f32, total_aux_loss)."""
+    c = config
+    if positions is None:
+        positions = jnp.arange(tokens.shape[1])
+    x = params['embed'].astype(c.dtype)[tokens]
+    x = sharding.shard(x, ('batch', 'seq', 'embed'))
+
+    layer_fn = functools.partial(_layer, config=c, positions=positions,
+                                 mesh=mesh)
+    if c.remat:
+        layer_fn = jax.checkpoint(
+            layer_fn,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+
+    def scan_body(x, layer_params):
+        x, aux = layer_fn(x, layer_params)
+        return x, aux
+
+    x, aux_losses = lax.scan(scan_body, x, params['layers'])
+    x = llama._rms_norm(x, params['final_norm'], c.rms_norm_eps)
+    logits = jnp.einsum('bse,ev->bsv', x, params['lm_head'],
+                        preferred_element_type=jnp.float32)
+    return logits, jnp.sum(aux_losses)
+
+
+def loss_fn(params: Params, batch: Dict[str, jax.Array],
+            config: MoeConfig, mesh: Optional[Any] = None) -> jax.Array:
+    tokens = batch['tokens']
+    logits, aux_loss = forward(params, tokens, config, mesh=mesh)
+    targets = jnp.concatenate(
+        [tokens[:, 1:], jnp.zeros_like(tokens[:, :1])], axis=1)
+    mask = batch.get('mask')
+    if mask is None:
+        mask = jnp.ones_like(tokens, jnp.float32)
+    mask = mask.astype(jnp.float32).at[:, -1].set(0.0)
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    token_ll = jnp.take_along_axis(
+        logprobs, targets[..., None], axis=-1)[..., 0]
+    ce = -jnp.sum(token_ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return ce + config.router_aux_loss_coef * aux_loss

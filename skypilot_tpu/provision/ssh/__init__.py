@@ -1,0 +1,179 @@
+"""SSH 'cloud': bring-your-own machines (on-prem TPU VMs, dev boxes).
+
+Reference analog: sky/provision/ssh + the `ssh` cloud (node pools
+declared in config; no create/terminate — machines already exist).
+Config shape (~/.skytpu/config.yaml):
+
+    ssh:
+      node_pools:
+        my-pool:
+          user: ubuntu
+          identity_file: ~/.ssh/id_ed25519
+          hosts:
+            - 10.0.0.1
+            - host2.example.com
+"""
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.utils import command_runner
+from skypilot_tpu.utils import paths
+
+
+def _pool_config(pool: str) -> Dict[str, Any]:
+    from skypilot_tpu import config as config_lib
+    pools = config_lib.get_nested(('ssh', 'node_pools'), {}) or {}
+    if pool not in pools:
+        raise exceptions.ProvisionError(
+            f'ssh: node pool {pool!r} not in config '
+            f'(have: {sorted(pools)})')
+    return pools[pool]
+
+
+def _assignments_path() -> str:
+    d = os.path.join(paths.state_dir(), 'ssh_assignments')
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _assignment_file(cluster_name_on_cloud: str) -> str:
+    return os.path.join(_assignments_path(),
+                        f'{cluster_name_on_cloud}.json')
+
+
+def _load_assignment(cluster_name_on_cloud: str) -> Optional[Dict]:
+    try:
+        with open(_assignment_file(cluster_name_on_cloud),
+                  encoding='utf-8') as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None
+
+
+def _used_hosts(pool: str) -> List[str]:
+    used: List[str] = []
+    for fn in os.listdir(_assignments_path()):
+        try:
+            with open(os.path.join(_assignments_path(), fn),
+                      encoding='utf-8') as f:
+                a = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            continue
+        if a.get('pool') == pool:
+            used.extend(a.get('hosts', []))
+    return used
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    """'Provision' = reserve N free hosts from the pool.
+
+    The reserve-and-write section is file-locked: concurrent launches
+    from forked API workers must never double-assign a host.
+    """
+    import filelock
+    pool = region
+    lock = filelock.FileLock(
+        os.path.join(_assignments_path(), '.reserve.lock'))
+    with lock:
+        existing = _load_assignment(cluster_name_on_cloud)
+        if existing is not None:
+            hosts = existing['hosts']
+            if len(hosts) != config.count:
+                raise exceptions.ProvisionError(
+                    f'ssh cluster {cluster_name_on_cloud!r} already has '
+                    f'{len(hosts)} host(s) reserved but {config.count} '
+                    'were requested; tear it down first.')
+        else:
+            pool_cfg = _pool_config(pool)
+            all_hosts = [str(h) for h in pool_cfg.get('hosts', [])]
+            used = set(_used_hosts(pool))
+            free = [h for h in all_hosts if h not in used]
+            if len(free) < config.count:
+                raise exceptions.CapacityError(
+                    f'ssh pool {pool!r}: need {config.count} hosts, '
+                    f'{len(free)} free of {len(all_hosts)}')
+            hosts = free[:config.count]
+            with open(_assignment_file(cluster_name_on_cloud), 'w',
+                      encoding='utf-8') as f:
+                json.dump({'pool': pool, 'hosts': hosts}, f)
+    return common.ProvisionRecord(
+        provider_name='ssh', region=pool, zone=None,
+        cluster_name_on_cloud=cluster_name_on_cloud,
+        head_instance_id=hosts[0],
+        created_instance_ids=list(hosts))
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: Optional[str] = None) -> None:
+    pass  # machines already exist
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Dict[str, Any]) -> None:
+    raise exceptions.NotSupportedError(
+        'ssh machines cannot be stopped by the framework.')
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Dict[str, Any]) -> None:
+    """Terminate = release the reservation (machines keep running)."""
+    try:
+        os.unlink(_assignment_file(cluster_name_on_cloud))
+    except FileNotFoundError:
+        pass
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Dict[str, Any]
+                    ) -> Dict[str, Optional[str]]:
+    assignment = _load_assignment(cluster_name_on_cloud)
+    if assignment is None:
+        return {}
+    return {h: 'running' for h in assignment['hosts']}
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Dict[str, Any]) -> common.ClusterInfo:
+    assignment = _load_assignment(cluster_name_on_cloud)
+    if assignment is None:
+        return common.ClusterInfo(instances={}, head_instance_id=None,
+                                  provider_name='ssh',
+                                  provider_config=provider_config)
+    pool_cfg = _pool_config(assignment['pool'])
+    instances = {
+        h: common.InstanceInfo(
+            instance_id=h,
+            hosts=[common.HostInfo(host_id=h, internal_ip=h,
+                                   ssh_port=int(
+                                       pool_cfg.get('port', 22)))],
+            status='running')
+        for h in assignment['hosts']
+    }
+    return common.ClusterInfo(
+        instances=instances,
+        head_instance_id=assignment['hosts'][0],
+        provider_name='ssh',
+        provider_config=provider_config,
+        ssh_user=pool_cfg.get('user', os.environ.get('USER', 'root')),
+        ssh_private_key=pool_cfg.get('identity_file'))
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str],
+               provider_config: Dict[str, Any]) -> None:
+    pass  # user-managed firewalls
+
+
+def get_command_runners(cluster_info: common.ClusterInfo
+                        ) -> List[command_runner.CommandRunner]:
+    return [
+        command_runner.SSHCommandRunner(
+            host.internal_ip, user=cluster_info.ssh_user,
+            private_key=cluster_info.ssh_private_key,
+            port=host.ssh_port)
+        for inst in cluster_info.ordered_instances()
+        for host in inst.hosts
+    ]

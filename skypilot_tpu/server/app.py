@@ -113,6 +113,74 @@ async def _handle_stream(request):
     return resp
 
 
+async def _handle_dashboard(request):
+    """Minimal server-rendered dashboard: clusters / managed jobs /
+    services / recent requests (reference ships a 15k-LoC Next.js app;
+    this is the read-only core of it)."""
+    from aiohttp import web
+    import html as html_lib
+
+    def _rows(items, cols):
+        out = ''
+        for item in items:
+            cells = ''.join(
+                f'<td>{html_lib.escape(str(item.get(c, "")))}</td>'
+                for c in cols)
+            out += f'<tr>{cells}</tr>'
+        return out or f'<tr><td colspan={len(cols)}>none</td></tr>'
+
+    from skypilot_tpu import state as cluster_state
+    clusters = [{
+        'name': r['name'], 'status': r['status'].value,
+        'resources': r['resources_str'], 'nodes': r['num_nodes'],
+    } for r in cluster_state.get_clusters()]
+
+    jobs: list = []
+    try:
+        from skypilot_tpu.jobs import state as jobs_state
+        jobs = [{
+            'id': j['job_id'], 'name': j['name'],
+            'status': j['status'].value,
+            'recoveries': j['recovery_count'],
+        } for j in jobs_state.get_jobs()]
+    except Exception:  # noqa: BLE001
+        pass
+
+    services: list = []
+    try:
+        from skypilot_tpu.serve import serve_state
+        services = [{
+            'name': s['name'], 'status': s['status'].value,
+            'endpoint': f'http://127.0.0.1:{s["lb_port"]}',
+        } for s in serve_state.get_services()]
+    except Exception:  # noqa: BLE001
+        pass
+
+    reqs = [{
+        'id': r['request_id'], 'name': r['name'],
+        'status': r['status'].value,
+    } for r in requests_db.list_requests(25)]
+
+    def _table(title, items, cols):
+        head = ''.join(f'<th>{c}</th>' for c in cols)
+        return (f'<h2>{title}</h2><table border=1 cellpadding=4 '
+                f'cellspacing=0><tr>{head}</tr>{_rows(items, cols)}'
+                '</table>')
+
+    body = (
+        '<html><head><title>skypilot-tpu</title>'
+        '<meta http-equiv="refresh" content="10"></head><body>'
+        f'<h1>skypilot-tpu v{skypilot_tpu.__version__}</h1>'
+        + _table('Clusters', clusters,
+                 ['name', 'status', 'resources', 'nodes'])
+        + _table('Managed jobs', jobs,
+                 ['id', 'name', 'status', 'recoveries'])
+        + _table('Services', services, ['name', 'status', 'endpoint'])
+        + _table('Recent requests', reqs, ['id', 'name', 'status'])
+        + '</body></html>')
+    return web.Response(text=body, content_type='text/html')
+
+
 async def _handle_health(request):
     return _json_response({
         'status': 'healthy',
@@ -125,6 +193,7 @@ def create_app():
     from aiohttp import web
     app = web.Application()
     app.router.add_get(f'{API_PREFIX}/health', _handle_health)
+    app.router.add_get('/dashboard', _handle_dashboard)
     app.router.add_get(f'{API_PREFIX}/requests', _handle_list_requests)
     app.router.add_get(f'{API_PREFIX}/requests/{{request_id}}',
                        _handle_get_request)

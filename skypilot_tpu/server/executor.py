@@ -71,6 +71,12 @@ class Executor:
         if name not in REGISTRY:
             raise KeyError(f'Unknown request type {name!r}')
         request_id = requests_db.create_request(name, payload, schedule)
+        try:
+            from skypilot_tpu.usage import usage_lib
+            usage_lib.record_event('api.request', name=name,
+                                   request_id=request_id)
+        except Exception:  # noqa: BLE001 — telemetry must never block
+            pass
         thread = threading.Thread(
             target=self._dispatch, args=(request_id, name, payload,
                                          schedule),

@@ -31,8 +31,13 @@ class TrainerConfig:
     seq_len: int = 512
     grad_clip: float = 1.0
 
-    def model_config(self) -> llama.LlamaConfig:
-        return llama.CONFIGS[self.model]
+    def model_config(self):
+        import skypilot_tpu.models as models_lib
+        return models_lib.resolve(self.model)[1]
+
+    def model_family(self):
+        import skypilot_tpu.models as models_lib
+        return models_lib.resolve(self.model)[0]
 
 
 def make_optimizer(cfg: TrainerConfig):
@@ -63,12 +68,13 @@ def make_train_state(cfg: TrainerConfig, mesh: Any,
     key = key if key is not None else jax.random.key(0)
     optimizer = make_optimizer(cfg)
 
-    logical = llama.param_logical_axes(mcfg)
+    family = cfg.model_family()
+    logical = family.param_logical_axes(mcfg)
     param_sh = sharding.tree_shardings(mesh, logical)
 
     with parallel.use_mesh(mesh):
         params = jax.jit(
-            functools.partial(llama.init_params, mcfg),
+            functools.partial(family.init_params, mcfg),
             out_shardings=param_sh)(key)
         opt_state = jax.jit(
             optimizer.init,
@@ -86,12 +92,13 @@ def make_train_step(cfg: TrainerConfig,
                                            Tuple[Dict[str, Any], Dict[str, Any]]]:
     """Returns jitted (state, batch) → (state, metrics)."""
     mcfg = cfg.model_config()
+    family = cfg.model_family()
     optimizer = make_optimizer(cfg)
 
     def step_fn(state, batch):
         import optax
         params = state['params']
-        loss, grads = jax.value_and_grad(llama.loss_fn)(
+        loss, grads = jax.value_and_grad(family.loss_fn)(
             params, batch, mcfg, mesh)
         updates, opt_state = optimizer.update(
             grads, state['opt_state'], params)

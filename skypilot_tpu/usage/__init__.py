@@ -1,0 +1,1 @@
+"""Usage telemetry (opt-out, local-spool + optional endpoint)."""

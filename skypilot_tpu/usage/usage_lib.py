@@ -1,0 +1,80 @@
+"""Usage telemetry: command events spooled locally, shipped if configured.
+
+Reference analog: sky/usage/usage_lib.py:42 (messages to a Grafana Loki
+endpoint; heartbeats via skylet events). Ours: every recorded event is
+appended to a local JSONL spool (always — it doubles as an audit log);
+when SKYTPU_USAGE_ENDPOINT is set, events POST there best-effort.
+Disable entirely with SKYTPU_DISABLE_USAGE_COLLECTION=1.
+"""
+import contextlib
+import json
+import os
+import threading
+import time
+import urllib.request
+from typing import Any, Dict, Optional
+
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import paths
+
+_ENDPOINT_ENV = 'SKYTPU_USAGE_ENDPOINT'
+_DISABLE_ENV = 'SKYTPU_DISABLE_USAGE_COLLECTION'
+_lock = threading.Lock()
+
+
+def disabled() -> bool:
+    return os.environ.get(_DISABLE_ENV, '') not in ('', '0', 'false')
+
+
+def spool_path() -> str:
+    return os.path.join(paths.state_dir(), 'usage_events.jsonl')
+
+
+def record_event(event_name: str, **fields: Any
+                 ) -> Optional[Dict[str, Any]]:
+    """Append one event; ship best-effort if an endpoint is set."""
+    if disabled():
+        return None
+    event = {
+        'event': event_name,
+        'time': time.time(),
+        'user': common_utils.get_user_hash(),
+        'run_id': common_utils.get_usage_run_id(),
+        **fields,
+    }
+    with _lock, open(spool_path(), 'a', encoding='utf-8') as f:
+        f.write(json.dumps(event) + '\n')
+    endpoint = os.environ.get(_ENDPOINT_ENV)
+    if endpoint:
+        # Ship from a daemon thread: callers may be on the API server's
+        # event loop, and a slow endpoint must cost them nothing.
+        threading.Thread(target=_post, args=(endpoint, event),
+                         daemon=True).start()
+    return event
+
+
+def _post(endpoint: str, event: Dict[str, Any]) -> None:
+    try:
+        req = urllib.request.Request(
+            endpoint, data=json.dumps(event).encode(),
+            headers={'Content-Type': 'application/json'},
+            method='POST')
+        with urllib.request.urlopen(req, timeout=5):
+            pass
+    except Exception:  # noqa: BLE001 — telemetry must never break UX
+        pass
+
+
+@contextlib.contextmanager
+def timed_event(event_name: str, **fields: Any):
+    """Record <name>.start/.done(+duration)/.failed around a block."""
+    start = time.time()
+    record_event(f'{event_name}.start', **fields)
+    try:
+        yield
+    except BaseException as e:
+        record_event(f'{event_name}.failed', duration_s=time.time() - start,
+                     error=type(e).__name__, **fields)
+        raise
+    record_event(f'{event_name}.done', duration_s=time.time() - start,
+                 **fields)

@@ -1,0 +1,88 @@
+"""Catalog fetcher against fake Cloud Billing SKU pages."""
+import csv
+
+import pytest
+
+from skypilot_tpu.adaptors import gcp as gcp_adaptor
+from skypilot_tpu.catalog.data_fetchers import fetch_gcp
+
+
+class FakeBillingApi:
+    def __init__(self, skus):
+        self.skus = skus
+
+    def request(self, method, url, params=None, json_body=None):
+        assert method == 'GET' and url.endswith('/skus')
+        page = int((params or {}).get('pageToken') or 0)
+        per_page = 2
+        chunk = self.skus[page * per_page:(page + 1) * per_page]
+        resp = {'skus': chunk}
+        if (page + 1) * per_page < len(self.skus):
+            resp['nextPageToken'] = str(page + 1)
+        return resp
+
+
+def _sku(desc, price, regions, usage='OnDemand'):
+    return {
+        'description': desc,
+        'category': {'usageType': usage},
+        'serviceRegions': regions,
+        'pricingInfo': [{
+            'pricingExpression': {
+                'tieredRates': [{
+                    'unitPrice': {'units': str(int(price)),
+                                  'nanos': int((price % 1) * 1e9)},
+                }],
+            },
+        }],
+    }
+
+
+@pytest.fixture
+def fake_billing():
+    skus = [
+        _sku('Tpu v5e chip hour', 1.20, ['us-west4', 'us-east5']),
+        _sku('Tpu v5e chip hour (Spot)', 0.42, ['us-west4'],
+             usage='Spot'),
+        _sku('Tpu-v5p pod core hour', 4.20, ['us-east5']),
+        _sku('N2 Instance Core running in Americas', 0.03,
+             ['us-central1']),   # not a TPU: ignored
+        _sku('Tpu v9x futuristic', 9.9, ['us-x']),  # unknown gen: ignored
+    ]
+    gcp_adaptor.set_transport_factory(lambda: FakeBillingApi(skus))
+    yield
+    gcp_adaptor.set_transport_factory(
+        lambda: (_ for _ in ()).throw(AssertionError('no transport')))
+
+
+def test_fetch_and_write(fake_billing, tmp_path):
+    rows = fetch_gcp.fetch_tpu_rows()
+    by_key = {(r['generation'], r['region']): r for r in rows}
+    assert by_key[('tpu-v5e', 'us-west4')]['price_per_chip'] == \
+        pytest.approx(1.2)
+    assert by_key[('tpu-v5e', 'us-west4')]['spot_price_per_chip'] == \
+        pytest.approx(0.42)
+    assert by_key[('tpu-v5e', 'us-east5')]['spot_price_per_chip'] is None
+    assert ('tpu-v5p', 'us-east5') in by_key
+    assert not any(g == 'tpu-v9x' for g, _ in by_key)
+
+    out = tmp_path / 'tpus.csv'
+    n = fetch_gcp.write_tpu_csv(rows, str(out))
+    assert n == len(rows)
+    parsed = list(csv.DictReader(open(out)))
+    assert {p['generation'] for p in parsed} == {'tpu-v5e', 'tpu-v5p'}
+
+
+def test_commitment_skus_excluded(tmp_path):
+    skus = [
+        _sku('Tpu v5e chip hour', 1.20, ['us-west4']),
+        _sku('Tpu v5e chip hour Commit3Yr', 0.54, ['us-west4'],
+             usage='Commit3Yr'),
+    ]
+    gcp_adaptor.set_transport_factory(lambda: FakeBillingApi(skus))
+    try:
+        rows = fetch_gcp.fetch_tpu_rows()
+    finally:
+        gcp_adaptor.set_transport_factory(
+            lambda: (_ for _ in ()).throw(AssertionError('no transport')))
+    assert rows[0]['price_per_chip'] == pytest.approx(1.2)

@@ -102,3 +102,23 @@ def test_cli_check(server):
     runner = CliRunner()
     result = runner.invoke(cli_mod.cli, ['check'])
     assert result.exit_code == 0, result.output
+
+
+def test_dashboard_renders(server, enable_clouds):
+    enable_clouds('local')
+    import urllib.request
+    with urllib.request.urlopen(f'{server.url}/dashboard',
+                                timeout=10) as resp:
+        body = resp.read().decode()
+    assert 'skypilot-tpu' in body
+    assert 'Clusters' in body and 'Managed jobs' in body
+
+
+def test_usage_events_recorded(server):
+    from skypilot_tpu.usage import usage_lib
+    import json as json_lib
+    sdk.get(sdk.status(), timeout=30)
+    events = [json_lib.loads(l) for l in
+              open(usage_lib.spool_path())]
+    assert any(e['event'] == 'api.request' and e['name'] == 'status'
+               for e in events)

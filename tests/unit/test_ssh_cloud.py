@@ -1,0 +1,87 @@
+"""SSH cloud: pool reservation accounting + feasibility + config."""
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision import ssh as ssh_provision
+
+
+@pytest.fixture
+def pools(monkeypatch):
+    cfg = {
+        'ssh': {
+            'node_pools': {
+                'poolA': {'user': 'ubuntu', 'hosts': ['10.0.0.1',
+                                                      '10.0.0.2'],
+                          'identity_file': '~/.ssh/id'},
+                'tpus': {'user': 'tpu',
+                         'hosts': ['tpu-host-1'],
+                         'accelerators': 'tpu-v4:8'},
+            }
+        }
+    }
+
+    def fake_get_nested(keys, default=None):
+        node = cfg
+        for k in keys:
+            if not isinstance(node, dict) or k not in node:
+                return default
+            node = node[k]
+        return node
+    from skypilot_tpu import config as config_lib
+    monkeypatch.setattr(config_lib, 'get_nested', fake_get_nested)
+    return cfg
+
+
+def _cfg(count=1):
+    return common.ProvisionConfig(provider_config={'pool': 'poolA'},
+                                  authentication_config={},
+                                  node_config={}, count=count)
+
+
+def test_reserve_release_hosts(pools):
+    record = ssh_provision.run_instances('poolA', 'c1', _cfg(1))
+    assert record.created_instance_ids == ['10.0.0.1']
+    record2 = ssh_provision.run_instances('poolA', 'c2', _cfg(1))
+    assert record2.created_instance_ids == ['10.0.0.2']
+    # Pool exhausted.
+    with pytest.raises(exceptions.CapacityError):
+        ssh_provision.run_instances('poolA', 'c3', _cfg(1))
+    # Idempotent re-run of an existing cluster keeps its hosts.
+    again = ssh_provision.run_instances('poolA', 'c1', _cfg(1))
+    assert again.created_instance_ids == ['10.0.0.1']
+    # Release frees capacity.
+    ssh_provision.terminate_instances('c1', {})
+    record3 = ssh_provision.run_instances('poolA', 'c3', _cfg(1))
+    assert record3.created_instance_ids == ['10.0.0.1']
+
+
+def test_cluster_info_uses_pool_auth(pools):
+    ssh_provision.run_instances('poolA', 'c1', _cfg(2))
+    info = ssh_provision.get_cluster_info('poolA', 'c1', {})
+    assert info.ssh_user == 'ubuntu'
+    assert info.ssh_private_key == '~/.ssh/id'
+    assert info.num_instances == 2
+    runners = ssh_provision.get_command_runners(info)
+    assert len(runners) == 2
+
+
+def test_feasibility_and_tpu_pools(pools):
+    from skypilot_tpu import clouds as clouds_lib
+    ssh_cloud = clouds_lib.get_cloud('ssh')
+    rows = ssh_cloud.get_feasible(resources_lib.Resources())
+    assert {r.region for r in rows} == {'poolA', 'tpus'}
+    tpu_rows = ssh_cloud.get_feasible(
+        resources_lib.Resources(accelerators='tpu-v4:8'))
+    assert [r.region for r in tpu_rows] == ['tpus']
+    assert ssh_cloud.get_feasible(
+        resources_lib.Resources(accelerators='tpu-v5p:8')) == []
+    ok, _ = ssh_cloud.check_credentials()
+    assert ok
+
+
+def test_count_mismatch_rejected(pools):
+    ssh_provision.run_instances('poolA', 'c1', _cfg(1))
+    with pytest.raises(exceptions.ProvisionError, match='tear it down'):
+        ssh_provision.run_instances('poolA', 'c1', _cfg(2))
