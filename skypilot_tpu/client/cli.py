@@ -289,9 +289,22 @@ def jobs_launch(entrypoint, name, num_nodes, accelerators, cloud, workdir,
                 env, max_recoveries, strategy, detach_run):
     """Launch a managed job (controller relaunches it on preemption)."""
     from skypilot_tpu.client import sdk
-    task = _task_from_args(entrypoint, None, num_nodes, accelerators,
-                           cloud, workdir, env, name)
-    result = sdk.get(sdk.jobs_launch(task, name=name,
+    entry = ' '.join(entrypoint) if entrypoint else None
+    target = None
+    if entry and entry.endswith(('.yaml', '.yml')) and \
+            os.path.isfile(os.path.expanduser(entry)):
+        from skypilot_tpu.utils import common_utils
+        docs = [c for c in common_utils.read_yaml_all(
+            os.path.expanduser(entry)) if c]
+        if len(docs) > 1:  # multi-document YAML = pipeline
+            from skypilot_tpu.utils import dag_utils
+            target = dag_utils.load_chain_dag_from_yaml(
+                os.path.expanduser(entry),
+                dict(e.split('=', 1) for e in env or []) or None)
+    if target is None:
+        target = _task_from_args(entrypoint, None, num_nodes,
+                                 accelerators, cloud, workdir, env, name)
+    result = sdk.get(sdk.jobs_launch(target, name=name,
                                      max_recoveries=max_recoveries,
                                      strategy=strategy.upper()))
     job_id = result['job_id']
@@ -375,6 +388,19 @@ def serve_status_cmd(service_names):
         for r in s['replicas']:
             click.echo(f'  replica {r["replica_id"]}: {r["status"]} '
                        f'({r["cluster_name"]})')
+
+
+@serve.command('update')
+@click.argument('service_name')
+@click.argument('entrypoint', nargs=-1, required=True)
+def serve_update_cmd(service_name, entrypoint):
+    """Rolling-update a service to a new task YAML."""
+    from skypilot_tpu.client import sdk
+    task = _task_from_args(entrypoint, None, None, None, None, None, None,
+                           None)
+    result = sdk.get(sdk.serve_update(task, service_name))
+    click.echo(f'Service {service_name!r} updating to '
+               f'v{result["version"]} (rolling).')
 
 
 @serve.command('down')

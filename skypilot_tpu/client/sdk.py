@@ -228,15 +228,25 @@ def optimize(task, minimize: str = 'COST') -> str:
 
 # --- managed jobs -----------------------------------------------------------
 
-def jobs_launch(task, name: Optional[str] = None,
+def jobs_launch(task_or_dag, name: Optional[str] = None,
                 max_recoveries: int = 3,
                 strategy: str = 'EAGER_NEXT_REGION') -> str:
-    return _submit('jobs_launch', {
-        'task': task.to_yaml_config(),
+    from skypilot_tpu import dag as dag_lib
+    payload: Dict[str, Any] = {
         'name': name,
         'max_recoveries': max_recoveries,
         'strategy': strategy,
-    })
+    }
+    if isinstance(task_or_dag, dag_lib.Dag) and \
+            len(task_or_dag.tasks) > 1:
+        payload['pipeline'] = [t.to_yaml_config()
+                               for t in task_or_dag.topological_order()]
+        payload['name'] = name or task_or_dag.name
+    else:
+        task = (task_or_dag.tasks[0]
+                if isinstance(task_or_dag, dag_lib.Dag) else task_or_dag)
+        payload['task'] = task.to_yaml_config()
+    return _submit('jobs_launch', payload)
 
 
 def jobs_queue() -> str:
@@ -275,3 +285,8 @@ def serve_status(service_names: Optional[List[str]] = None) -> str:
 def serve_logs(service_name: str, follow: bool = True) -> str:
     return _submit('serve_logs', {'service_name': service_name,
                                   'follow': follow})
+
+
+def serve_update(task, service_name: str) -> str:
+    return _submit('serve_update', {'task': task.to_yaml_config(),
+                                    'service_name': service_name})

@@ -31,10 +31,21 @@ class JobsController:
         assert record is not None, managed_job_id
         self.record = record
         from skypilot_tpu import task as task_lib
-        self.task = task_lib.Task.from_yaml_config(record['task_yaml'])
-        self.cluster_name = (record['cluster_name'] or
-                             f'tsky-jobs-{managed_job_id}')
-        jobs_state.set_cluster_name(managed_job_id, self.cluster_name)
+        cfg = record['task_yaml']
+        if isinstance(cfg, dict) and 'pipeline' in cfg:
+            # A chain: one stage at a time, each on its own cluster
+            # (reference: managed-job pipelines, sky/jobs/controller.py
+            # _run_one_task per dag task).
+            self.tasks = [task_lib.Task.from_yaml_config(c)
+                          for c in cfg['pipeline']]
+        else:
+            self.tasks = [task_lib.Task.from_yaml_config(cfg)]
+        self.task = self.tasks[0]
+        self.base_cluster_name = (record['cluster_name'] or
+                                  f'tsky-jobs-{managed_job_id}')
+        self.cluster_name = self.base_cluster_name
+        jobs_state.set_cluster_name(managed_job_id,
+                                    self.base_cluster_name)
         self.strategy = recovery_strategy.StrategyExecutor.make(
             record['strategy'], self.task, self.cluster_name)
 
@@ -102,6 +113,26 @@ class JobsController:
                 self._cleanup()
 
     def _run(self) -> None:
+        for stage, task in enumerate(self.tasks):
+            self.task = task
+            self.cluster_name = (self.base_cluster_name if
+                                 len(self.tasks) == 1 else
+                                 f'{self.base_cluster_name}-s{stage}')
+            jobs_state.set_cluster_name(self.job_id, self.cluster_name)
+            self.strategy = recovery_strategy.StrategyExecutor.make(
+                self.record['strategy'], task, self.cluster_name)
+            final = stage == len(self.tasks) - 1
+            done = self._run_one_task(final=final)
+            if not done:
+                return  # terminal failure/cancel already recorded
+            if not final:
+                # Stage finished: release its cluster before the next.
+                self._cleanup()
+        # _run_one_task set SUCCEEDED on the last stage.
+
+    def _run_one_task(self, final: bool = True) -> bool:
+        """Run self.task to completion. True iff it succeeded; the
+        managed job only turns SUCCEEDED on the final stage."""
         jobs_state.set_status(self.job_id,
                               jobs_state.ManagedJobStatus.STARTING)
         try:
@@ -110,7 +141,7 @@ class JobsController:
             jobs_state.set_status(
                 self.job_id, jobs_state.ManagedJobStatus.FAILED_NO_RESOURCE,
                 failure_reason=str(e))
-            return
+            return False
         jobs_state.set_status(self.job_id,
                               jobs_state.ManagedJobStatus.RUNNING)
 
@@ -118,19 +149,21 @@ class JobsController:
             status = self._cluster_job_status(cluster_job_id)
             if status == job_lib.JobStatus.SUCCEEDED:
                 self._tail_into_controller_log(cluster_job_id)
-                jobs_state.set_status(self.job_id,
-                                      jobs_state.ManagedJobStatus.SUCCEEDED)
-                return
+                if final:
+                    jobs_state.set_status(
+                        self.job_id,
+                        jobs_state.ManagedJobStatus.SUCCEEDED)
+                return True
             if status == job_lib.JobStatus.FAILED:
                 self._tail_into_controller_log(cluster_job_id)
                 jobs_state.set_status(
                     self.job_id, jobs_state.ManagedJobStatus.FAILED,
                     failure_reason='User job exited non-zero.')
-                return
+                return False
             if status == job_lib.JobStatus.CANCELLED:
                 jobs_state.set_status(self.job_id,
                                       jobs_state.ManagedJobStatus.CANCELLED)
-                return
+                return False
             if status is None and not self._cluster_alive():
                 # Preemption / cluster loss -> recover.
                 count = jobs_state.bump_recovery_count(self.job_id)
@@ -141,7 +174,7 @@ class JobsController:
                         failure_reason=(
                             f'Exceeded max_recoveries '
                             f'({self.record["max_recoveries"]}).'))
-                    return
+                    return False
                 jobs_state.set_status(
                     self.job_id, jobs_state.ManagedJobStatus.RECOVERING)
                 cluster_job_id = self.strategy.recover()
@@ -153,7 +186,7 @@ class JobsController:
                 self._cancel_cluster_job(cluster_job_id)
                 jobs_state.set_status(self.job_id,
                                       jobs_state.ManagedJobStatus.CANCELLED)
-                return
+                return False
             time.sleep(_POLL_INTERVAL_SECONDS)
 
     def _cancel_cluster_job(self, cluster_job_id: int) -> None:

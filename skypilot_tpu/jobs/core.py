@@ -17,7 +17,25 @@ from skypilot_tpu.jobs import state as jobs_state
 def launch(task, name: Optional[str] = None,
            max_recoveries: int = 3,
            strategy: str = 'EAGER_NEXT_REGION') -> int:
-    """Submit a managed (auto-recovering) job. Returns managed job id."""
+    """Submit a managed (auto-recovering) job or pipeline.
+
+    Accepts a Task or a chain Dag; a chain becomes a pipeline the
+    controller runs stage by stage (each stage on its own cluster,
+    recovering independently — reference managed-job pipelines)."""
+    from skypilot_tpu import dag as dag_lib
+    if isinstance(task, dag_lib.Dag):
+        dag = task
+        if len(dag.tasks) == 1:
+            task = dag.tasks[0]
+        else:
+            if not dag.is_chain():
+                raise exceptions.InvalidDagError(
+                    'Managed-job pipelines must be linear chains.')
+            ordered = dag.topological_order()
+            cfg = {'pipeline': [t.to_yaml_config() for t in ordered]}
+            return scheduler.submit_job(
+                name or dag.name or ordered[0].name, cfg,
+                max_recoveries=max_recoveries, strategy=strategy)
     cfg = task.to_yaml_config()
     job_recovery = None
     for r in task.resources:

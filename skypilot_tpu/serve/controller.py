@@ -64,7 +64,9 @@ class ServeController:
                 service['status'] == serve_state.ServiceStatus.SHUTTING_DOWN:
             self._shutdown()
             return
+        self._maybe_reload_spec(service)
         self.manager.probe_all()
+        self._rolling_update(service)
         replicas = serve_state.get_replicas(self.service_name)
         ready = self.manager.ready_endpoints()
         self.lb.set_replicas(ready)
@@ -93,6 +95,45 @@ class ServeController:
                   (serve_state.ServiceStatus.NO_REPLICA if not live else
                    serve_state.ServiceStatus.REPLICA_INIT))
         serve_state.set_service_status(self.service_name, status)
+
+    def _maybe_reload_spec(self, service) -> None:
+        """Pick up a version bump from `serve update` (new task YAML)."""
+        if service['version'] == getattr(self, '_loaded_version', 1):
+            return
+        from skypilot_tpu import task as task_lib
+        self.task = task_lib.Task.from_yaml_config(service['task_yaml'])
+        self.spec = self.task.service
+        self.manager.task = self.task
+        self.manager.spec = self.spec
+        self.autoscaler.update_spec(self.spec)
+        self._loaded_version = service['version']
+
+    def _rolling_update(self, service) -> None:
+        """Replace old-version replicas one at a time, never dropping
+        below the ready set (reference rolling update,
+        replica_managers.py version tracking)."""
+        version = service['version']
+        replicas = serve_state.get_replicas(self.service_name)
+        old = [r for r in replicas if r['version'] < version and
+               r['status'] not in (serve_state.ReplicaStatus.SHUTTING_DOWN,
+                                   serve_state.ReplicaStatus.FAILED)]
+        if not old:
+            return
+        new_live = [r for r in replicas if r['version'] >= version and
+                    r['status'] not in (
+                        serve_state.ReplicaStatus.SHUTTING_DOWN,
+                        serve_state.ReplicaStatus.FAILED)]
+        new_ready = [r for r in new_live
+                     if r['status'] == serve_state.ReplicaStatus.READY]
+        # One surge replica at a time: launch a new-version replica if
+        # none is in flight; retire one old replica per ready new one.
+        if len(new_live) < self.spec.min_replicas + 1 and \
+                len(new_live) == len(new_ready):
+            self.manager.scale_up(1)
+        if new_ready:
+            victims = sorted(old, key=lambda r: r['replica_id'])
+            self.manager.scale_down(
+                [victims[0]['replica_id']])
 
     def _shutdown(self) -> None:
         self.manager.terminate_all()

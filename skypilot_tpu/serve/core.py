@@ -52,6 +52,22 @@ def up(task, service_name: str, wait_seconds: float = 0.0
             'endpoint': f'http://127.0.0.1:{lb_port}'}
 
 
+def update(task, service_name: str) -> Dict[str, Any]:
+    """Rolling update: bump the service version with a new task; the
+    controller replaces replicas one at a time, keeping capacity up."""
+    service = serve_state.get_service(service_name)
+    if service is None:
+        raise exceptions.ServeError(
+            f'Service {service_name!r} does not exist.')
+    if task.service is None:
+        raise exceptions.InvalidTaskError(
+            'Updated task has no service: section.')
+    new_version = service['version'] + 1
+    serve_state.set_service_version(service_name, new_version,
+                                    task.to_yaml_config())
+    return {'service_name': service_name, 'version': new_version}
+
+
 def down(service_name: str, purge: bool = False) -> None:
     service = serve_state.get_service(service_name)
     if service is None:

@@ -175,9 +175,23 @@ def server_user() -> str:
 @executor.register('jobs_launch')
 def jobs_launch(payload: Dict[str, Any]) -> Dict[str, Any]:
     from skypilot_tpu.jobs import core as jobs_core
-    task = _load_task(payload)
+    if payload.get('pipeline'):
+        from skypilot_tpu import dag as dag_lib
+        from skypilot_tpu import task as task_lib
+        dag = dag_lib.Dag(name=payload.get('name'))
+        prev = None
+        for cfg in payload['pipeline']:
+            stage = task_lib.Task.from_yaml_config(
+                cfg, env_overrides=payload.get('envs'))
+            dag.add(stage)
+            if prev is not None:
+                dag.add_edge(prev, stage)
+            prev = stage
+        target = dag
+    else:
+        target = _load_task(payload)
     job_id = jobs_core.launch(
-        task, name=payload.get('name'),
+        target, name=payload.get('name'),
         max_recoveries=payload.get('max_recoveries', 3),
         strategy=payload.get('strategy', 'EAGER_NEXT_REGION'))
     return {'job_id': job_id}
@@ -234,3 +248,10 @@ def serve_logs(payload: Dict[str, Any]) -> Dict[str, Any]:
     rc = serve_core.tail_logs(payload['service_name'],
                               follow=payload.get('follow', True))
     return {'exit_code': rc}
+
+
+@executor.register('serve_update')
+def serve_update(payload: Dict[str, Any]) -> Dict[str, Any]:
+    from skypilot_tpu.serve import core as serve_core
+    task = _load_task(payload)
+    return serve_core.update(task, payload['service_name'])

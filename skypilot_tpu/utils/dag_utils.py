@@ -1,0 +1,40 @@
+"""DAG YAML load/dump: multi-document YAML = a task chain.
+
+Reference analog: sky/utils/dag_utils.py (235 LoC). A pipeline file is
+several `---`-separated task documents; an optional leading document
+with only `name:` names the dag.
+"""
+from typing import Optional
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.utils import common_utils
+
+
+def load_chain_dag_from_yaml(path: str,
+                             env_overrides: Optional[dict] = None
+                             ) -> dag_lib.Dag:
+    configs = [c for c in common_utils.read_yaml_all(
+        common_utils.expand_path(path)) if c]
+    dag = dag_lib.Dag()
+    if configs and set(configs[0].keys()) == {'name'}:
+        dag.name = configs[0]['name']
+        configs = configs[1:]
+    prev = None
+    for cfg in configs:
+        task = task_lib.Task.from_yaml_config(cfg, env_overrides)
+        dag.add(task)
+        if prev is not None:
+            dag.add_edge(prev, task)
+        prev = task
+    return dag
+
+
+def dump_chain_dag_to_yaml(dag: dag_lib.Dag, path: str) -> None:
+    import yaml
+    docs = []
+    if dag.name:
+        docs.append({'name': dag.name})
+    docs.extend(t.to_yaml_config() for t in dag.topological_order())
+    with open(path, 'w', encoding='utf-8') as f:
+        yaml.safe_dump_all(docs, f, sort_keys=False)

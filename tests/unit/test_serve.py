@@ -150,3 +150,38 @@ def test_serve_end_to_end(serve_env):
     finally:
         serve_core.down('testsvc', purge=True)
     assert serve_core.status(['testsvc']) == []
+
+
+@pytest.mark.slow
+def test_serve_rolling_update(serve_env):
+    """Version bump replaces replicas without dropping availability."""
+    port_v1, port_v2 = 18491, 18492
+    task = _service_task(port_v1)
+    serve_core.up(task, 'updsvc')
+    try:
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            rows = serve_core.status(['updsvc'])
+            if rows and rows[0]['status'] == 'READY':
+                break
+            time.sleep(1)
+        assert serve_core.status(['updsvc'])[0]['status'] == 'READY'
+
+        new_task = _service_task(port_v2)
+        result = serve_core.update(new_task, 'updsvc')
+        assert result['version'] == 2
+
+        # Eventually every replica is v2 and the service is READY again.
+        deadline = time.time() + 120
+        ok = False
+        while time.time() < deadline:
+            replicas = serve_state.get_replicas('updsvc')
+            if replicas and all(r['version'] == 2 for r in replicas) and \
+                    any(r['status'] == serve_state.ReplicaStatus.READY
+                        for r in replicas):
+                ok = True
+                break
+            time.sleep(1)
+        assert ok, serve_state.get_replicas('updsvc')
+    finally:
+        serve_core.down('updsvc', purge=True)
