@@ -17,16 +17,49 @@ import time
 
 _TIME_BUDGET_S = 240.0
 _MAX_STEPS = 10
+_INIT_RETRIES = 3
+_INIT_BACKOFF_S = 30.0
+
+
+def _error_line(msg: str) -> None:
+    print(json.dumps({
+        'metric': 'llama_train_tokens_per_sec_per_chip',
+        'value': 0.0, 'unit': 'tokens/s/chip', 'vs_baseline': 0.0,
+        'extra': {'error': msg},
+    }))
+
+
+def _init_backend():
+    """jax backend init with retry — TPU attach can be transiently
+    UNAVAILABLE (axon tunnel warm-up); retry with backoff before
+    giving up with a JSON error line instead of a traceback."""
+    import jax
+    last_err = None
+    for attempt in range(_INIT_RETRIES):
+        try:
+            devices = jax.devices()
+            return jax, devices
+        except RuntimeError as e:
+            last_err = e
+            try:
+                from jax.extend import backend as _jexb
+                _jexb.clear_backends()
+            except Exception:
+                pass
+            if attempt < _INIT_RETRIES - 1:
+                time.sleep(_INIT_BACKOFF_S)
+    raise RuntimeError(f'backend init failed after {_INIT_RETRIES} '
+                       f'attempts: {last_err}')
 
 
 def main() -> None:
-    import jax
+    jax, devices = _init_backend()
 
     from skypilot_tpu.parallel import mesh as mesh_lib
     from skypilot_tpu.train import trainer as train_lib
 
-    n_devices = jax.device_count()
-    on_tpu = jax.devices()[0].platform == 'tpu'
+    n_devices = len(devices)
+    on_tpu = devices[0].platform == 'tpu'
 
     # Bench config: ~1B model on TPU (fits one ~16G-HBM chip in bf16 with
     # adam states + remat at batch 2), tiny on CPU.
@@ -104,4 +137,7 @@ def main() -> None:
 
 
 if __name__ == '__main__':
-    main()
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001 — always emit the JSON line
+        _error_line(f'{type(e).__name__}: {e}')

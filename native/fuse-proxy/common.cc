@@ -11,7 +11,7 @@ namespace fuseproxy {
 
 std::string SerializeRequest(const Request& req) {
   std::ostringstream out;
-  out << req.pid << '\n' << req.argv.size() << '\n';
+  out << req.argv.size() << '\n';
   for (const auto& a : req.argv) out << a << '\n';
   out << (req.has_commfd ? 1 : 0) << '\n';
   return out.str();
@@ -20,7 +20,7 @@ std::string SerializeRequest(const Request& req) {
 bool ParseRequest(const std::string& data, Request* req) {
   std::istringstream in(data);
   size_t argc = 0;
-  if (!(in >> req->pid >> argc)) return false;
+  if (!(in >> argc)) return false;
   in.ignore();  // trailing newline
   req->argv.clear();
   std::string line;
@@ -48,29 +48,30 @@ bool ParseResponse(const std::string& data, Response* resp) {
   return true;
 }
 
-bool SendFrame(int sock, const std::string& payload, int fd) {
-  if (payload.size() > kMaxFrame) return false;
+bool SendFrame(int sock, const std::string& payload,
+               const std::vector<int>& fds) {
+  if (payload.size() > kMaxFrame || fds.size() > kMaxFds) return false;
   struct iovec iov;
   iov.iov_base = const_cast<char*>(payload.data());
   iov.iov_len = payload.size();
   struct msghdr msg = {};
   msg.msg_iov = &iov;
   msg.msg_iovlen = 1;
-  char cmsgbuf[CMSG_SPACE(sizeof(int))];
-  if (fd >= 0) {
+  char cmsgbuf[CMSG_SPACE(sizeof(int) * kMaxFds)];
+  if (!fds.empty()) {
     std::memset(cmsgbuf, 0, sizeof(cmsgbuf));
     msg.msg_control = cmsgbuf;
-    msg.msg_controllen = sizeof(cmsgbuf);
+    msg.msg_controllen = CMSG_SPACE(sizeof(int) * fds.size());
     struct cmsghdr* cmsg = CMSG_FIRSTHDR(&msg);
     cmsg->cmsg_level = SOL_SOCKET;
     cmsg->cmsg_type = SCM_RIGHTS;
-    cmsg->cmsg_len = CMSG_LEN(sizeof(int));
-    std::memcpy(CMSG_DATA(cmsg), &fd, sizeof(int));
+    cmsg->cmsg_len = CMSG_LEN(sizeof(int) * fds.size());
+    std::memcpy(CMSG_DATA(cmsg), fds.data(), sizeof(int) * fds.size());
   }
   return sendmsg(sock, &msg, 0) == static_cast<ssize_t>(payload.size());
 }
 
-bool RecvFrame(int sock, std::string* payload, int* fd) {
+bool RecvFrame(int sock, std::string* payload, std::vector<int>* fds) {
   std::vector<char> buf(kMaxFrame);
   struct iovec iov;
   iov.iov_base = buf.data();
@@ -78,21 +79,40 @@ bool RecvFrame(int sock, std::string* payload, int* fd) {
   struct msghdr msg = {};
   msg.msg_iov = &iov;
   msg.msg_iovlen = 1;
-  char cmsgbuf[CMSG_SPACE(sizeof(int))];
+  char cmsgbuf[CMSG_SPACE(sizeof(int) * kMaxFds)];
   msg.msg_control = cmsgbuf;
   msg.msg_controllen = sizeof(cmsgbuf);
   ssize_t n = recvmsg(sock, &msg, 0);
   if (n < 0) return false;
   payload->assign(buf.data(), static_cast<size_t>(n));
-  if (fd != nullptr) {
-    *fd = -1;
-    for (struct cmsghdr* cmsg = CMSG_FIRSTHDR(&msg); cmsg != nullptr;
-         cmsg = CMSG_NXTHDR(&msg, cmsg)) {
-      if (cmsg->cmsg_level == SOL_SOCKET &&
-          cmsg->cmsg_type == SCM_RIGHTS) {
-        std::memcpy(fd, CMSG_DATA(cmsg), sizeof(int));
+  if (fds != nullptr) fds->clear();
+  // Collect every fd the kernel installed; a client sending more than
+  // kMaxFds must not be able to leak them into our fd table (the
+  // privileged server would hit EMFILE) — close the excess.
+  for (struct cmsghdr* cmsg = CMSG_FIRSTHDR(&msg); cmsg != nullptr;
+       cmsg = CMSG_NXTHDR(&msg, cmsg)) {
+    if (cmsg->cmsg_level == SOL_SOCKET && cmsg->cmsg_type == SCM_RIGHTS) {
+      size_t nfds = (cmsg->cmsg_len - CMSG_LEN(0)) / sizeof(int);
+      for (size_t i = 0; i < nfds; i++) {
+        int fd = -1;
+        std::memcpy(&fd, CMSG_DATA(cmsg) + i * sizeof(int), sizeof(int));
+        if (fds != nullptr && fds->size() < kMaxFds) {
+          fds->push_back(fd);
+        } else {
+          close(fd);
+        }
       }
     }
+  }
+  if (msg.msg_flags & MSG_CTRUNC) {
+    // Control data truncated: fds may have been dropped by the kernel
+    // before we could see them. Reject the frame (caller closes what
+    // we did record).
+    if (fds != nullptr) {
+      for (int fd : *fds) close(fd);
+      fds->clear();
+    }
+    return false;
   }
   return true;
 }

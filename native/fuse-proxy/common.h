@@ -1,6 +1,12 @@
 // Shared protocol for fusermount-shim <-> fusermount-server.
 //
 // Frames over a SOCK_SEQPACKET unix socket; fds ride SCM_RIGHTS.
+// The FIRST fd in every request frame is the caller's own
+// /proc/self/ns/mnt — unforgeable proof of which mount namespace the
+// request targets (the server setns()s on the received fd instead of
+// trusting a client-supplied pid, which a malicious pod could spoof to
+// enter another tenant's namespace). The optional SECOND fd is the
+// libfuse _FUSE_COMMFD socket.
 // Reference architecture: skypilot addons/fuse-proxy (Go); this is an
 // independent C++ implementation.
 #pragma once
@@ -15,9 +21,9 @@ constexpr const char* kSocketEnv = "FUSERMOUNT_SERVER_SOCKET";
 constexpr const char* kRealFusermountEnv = "FUSERMOUNT_REAL_PATH";
 constexpr const char* kCommFdEnv = "_FUSE_COMMFD";
 constexpr size_t kMaxFrame = 1 << 20;
+constexpr size_t kMaxFds = 2;
 
 struct Request {
-  int pid = 0;                       // caller pid (for /proc/<pid>/ns/mnt)
   std::vector<std::string> argv;     // fusermount arguments
   bool has_commfd = false;           // _FUSE_COMMFD fd attached?
 };
@@ -32,9 +38,10 @@ bool ParseRequest(const std::string& data, Request* req);
 std::string SerializeResponse(const Response& resp);
 bool ParseResponse(const std::string& data, Response* resp);
 
-// Send/recv one frame with up to one attached fd (-1 = none).
-bool SendFrame(int sock, const std::string& payload, int fd);
-bool RecvFrame(int sock, std::string* payload, int* fd);
+// Send/recv one frame with up to kMaxFds attached fds.
+bool SendFrame(int sock, const std::string& payload,
+               const std::vector<int>& fds);
+bool RecvFrame(int sock, std::string* payload, std::vector<int>* fds);
 
 std::string SocketPath();
 

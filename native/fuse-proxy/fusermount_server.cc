@@ -1,8 +1,10 @@
 // fusermount-server: privileged per-node daemon.
 //
-// Accepts shim requests, enters the caller's mount namespace
-// (setns(/proc/<pid>/ns/mnt)) in a forked child, and executes the real
-// fusermount with the forwarded argv + relayed _FUSE_COMMFD fd.
+// Accepts shim requests, enters the CALLER's mount namespace via the
+// namespace fd the shim sent over SCM_RIGHTS (unforgeable — a pid in
+// the payload could be spoofed to hijack another tenant's namespace),
+// and executes the real fusermount with the forwarded argv + relayed
+// _FUSE_COMMFD fd.
 #include <cerrno>
 #include <cstdio>
 #include <cstdlib>
@@ -31,12 +33,29 @@ std::string RealFusermount() {
   return env != nullptr ? env : "/usr/bin/fusermount";
 }
 
-Response HandleRequest(const Request& req, int commfd) {
+// True when the received ns fd refers to the namespace this process is
+// already in (then setns is a no-op we may skip — lets the round-trip
+// tests run without CAP_SYS_ADMIN).
+bool SameMountNamespace(int nsfd) {
+  struct stat self_st, ns_st;
+  if (fstat(nsfd, &ns_st) != 0) return false;
+  if (stat("/proc/self/ns/mnt", &self_st) != 0) return false;
+  return ns_st.st_ino == self_st.st_ino && ns_st.st_dev == self_st.st_dev;
+}
+
+Response HandleRequest(const Request& req, int nsfd, int commfd) {
   Response resp;
+  if (nsfd < 0) {
+    resp.exit_code = 1;
+    resp.output = "server: request carried no mount-namespace fd\n";
+    return resp;
+  }
   int outpipe[2];
   if (pipe(outpipe) != 0) {
     resp.exit_code = 1;
     resp.output = "server: pipe failed\n";
+    close(nsfd);
+    if (commfd >= 0) close(commfd);
     return resp;
   }
   pid_t child = fork();
@@ -46,20 +65,12 @@ Response HandleRequest(const Request& req, int commfd) {
     dup2(outpipe[1], 2);
     // Join the caller's mount namespace so the mount lands in ITS view
     // of the filesystem (the whole point of the proxy).
-    char ns_path[64];
-    snprintf(ns_path, sizeof(ns_path), "/proc/%d/ns/mnt", req.pid);
-    int nsfd = open(ns_path, O_RDONLY);
-    if (nsfd >= 0) {
-      if (setns(nsfd, CLONE_NEWNS) != 0) {
-        fprintf(stderr, "server: setns(%s): %s\n", ns_path,
-                strerror(errno));
-        _exit(111);
-      }
-      close(nsfd);
-    } else {
-      fprintf(stderr, "server: open(%s): %s (running un-namespaced)\n",
-              ns_path, strerror(errno));
+    if (!SameMountNamespace(nsfd) && setns(nsfd, CLONE_NEWNS) != 0) {
+      fprintf(stderr, "server: setns(caller ns fd): %s\n",
+              strerror(errno));
+      _exit(111);
     }
+    close(nsfd);
     std::vector<char*> argv;
     std::string real = RealFusermount();
     argv.push_back(const_cast<char*>(real.c_str()));
@@ -77,6 +88,7 @@ Response HandleRequest(const Request& req, int commfd) {
   }
   close(outpipe[1]);
   if (commfd >= 0) close(commfd);
+  close(nsfd);
   char buf[4096];
   ssize_t n;
   while ((n = read(outpipe[0], buf, sizeof(buf))) > 0)
@@ -122,13 +134,18 @@ int main() {
     int conn = accept(sock, nullptr, nullptr);
     if (conn < 0) continue;
     std::string payload;
-    int commfd = -1;
-    if (fuseproxy::RecvFrame(conn, &payload, &commfd)) {
+    std::vector<int> fds;
+    if (fuseproxy::RecvFrame(conn, &payload, &fds)) {
+      int nsfd = fds.empty() ? -1 : fds[0];
+      int commfd = fds.size() > 1 ? fds[1] : -1;
       Request req;
       if (fuseproxy::ParseRequest(payload, &req)) {
-        Response resp = HandleRequest(req, commfd);
+        Response resp = HandleRequest(req, nsfd, commfd);
         fuseproxy::SendFrame(conn, fuseproxy::SerializeResponse(resp),
-                             -1);
+                             {});
+      } else {
+        if (nsfd >= 0) close(nsfd);
+        if (commfd >= 0) close(commfd);
       }
     }
     close(conn);

@@ -1,14 +1,17 @@
 // fusermount-shim: masks fusermount(1) in unprivileged containers.
 //
-// Forwards argv (+ the _FUSE_COMMFD socket fd libfuse passed us, via
-// SCM_RIGHTS) to the privileged fusermount-server, which re-executes
-// the real fusermount inside OUR mount namespace. Output and exit code
-// are relayed back, so gcsfuse/goofys can't tell the difference.
+// Forwards argv to the privileged fusermount-server along with TWO
+// SCM_RIGHTS fds: our own /proc/self/ns/mnt (unforgeable proof of the
+// mount namespace the request targets — the server setns()s on it) and,
+// when libfuse passed one, the _FUSE_COMMFD socket fd. Output and exit
+// code are relayed back, so gcsfuse/goofys can't tell the difference.
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <string>
+#include <vector>
 
+#include <fcntl.h>
 #include <sys/socket.h>
 #include <sys/un.h>
 #include <unistd.h>
@@ -20,13 +23,21 @@ using fuseproxy::Response;
 
 int main(int argc, char** argv) {
   Request req;
-  req.pid = getpid();
   for (int i = 1; i < argc; i++) req.argv.emplace_back(argv[i]);
 
-  int commfd = -1;
+  // First fd is always our mount-namespace fd; the server refuses
+  // requests without it (a pid in the payload could be spoofed, an
+  // fd to our own namespace cannot).
+  int nsfd = open("/proc/self/ns/mnt", O_RDONLY);
+  if (nsfd < 0) {
+    perror("fusermount-shim: open(/proc/self/ns/mnt)");
+    return 1;
+  }
+  std::vector<int> fds = {nsfd};
+
   const char* commfd_env = getenv(fuseproxy::kCommFdEnv);
   if (commfd_env != nullptr) {
-    commfd = atoi(commfd_env);
+    fds.push_back(atoi(commfd_env));
     req.has_commfd = true;
   }
 
@@ -51,7 +62,7 @@ int main(int argc, char** argv) {
     return 1;
   }
   if (!fuseproxy::SendFrame(sock, fuseproxy::SerializeRequest(req),
-                            commfd)) {
+                            fds)) {
     perror("fusermount-shim: send");
     return 1;
   }

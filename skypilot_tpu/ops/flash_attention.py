@@ -1,15 +1,19 @@
-"""Fused flash attention as a Pallas TPU kernel.
+"""Fused flash attention as Pallas TPU kernels — forward AND backward.
 
-Forward pass is a hand-written kernel: grid over (batch, head, q-block,
-kv-block), online-softmax accumulators live in VMEM scratch that
-persists across the sequential innermost grid dimension (TPU grids are
-sequential, so the kv loop accumulates in-place), and the [bq, bk] score
-tile never leaves VMEM — HBM traffic is O(S·D) instead of O(S²).
+Forward: grid over (batch, head, q-block, kv-block), online-softmax
+accumulators live in VMEM scratch that persists across the sequential
+innermost grid dimension (TPU grids are sequential, so the kv loop
+accumulates in-place), and the [bq, bk] score tile never leaves VMEM —
+HBM traffic is O(S·D) instead of O(S²). The forward also emits the
+per-row logsumexp so backward never re-runs the softmax reduction.
 
-Backward uses a custom VJP that recomputes attention blockwise — flash
-memory behavior (no stored probs) at the cost of one recompute, matching
-`jax.checkpoint` economics. A dedicated backward kernel is a later
-optimization.
+Backward: the FlashAttention-2 formulation with two kernels —
+  dq: grid (b, h, q-block, kv-block), dq accumulates in VMEM across
+      the sequential kv dimension;
+  dkv: grid (b, h, kv-block, q-block), dk/dv accumulate across the
+      sequential q dimension. GQA head groups are summed outside.
+Both recompute p = exp(s - lse) from the saved logsumexp (one extra
+matmul, no stored probs) and apply delta = rowsum(do·o).
 
 GQA is folded into the index maps: kv blocks for head h come from kv
 head h // (num_heads // num_kv_heads), so no materialized repeat.
@@ -31,8 +35,8 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                causal: bool, scale: float, bq: int, bk: int,
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
+                l_ref, *, causal: bool, scale: float, bq: int, bk: int,
                 n_kv_blocks: int):
     iq = pl.program_id(2)
     ik = pl.program_id(3)
@@ -81,23 +85,133 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(ik == n_kv_blocks - 1)
     def _finalize():
-        norm = l_ref[:]
-        norm = jnp.where(norm == 0.0, 1.0, norm)
+        l = l_ref[:]
+        norm = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc_ref[:] / norm).astype(o_ref.dtype)
+        m = m_ref[:, :1]
+        safe_m = jnp.where(m <= _NEG_INF * 0.5, 0.0, m)
+        # Fully-masked rows (l == 0) get lse = +inf so backward's
+        # exp(s - lse) is exactly 0 for them.
+        lse = jnp.where(l > 0.0, safe_m + jnp.log(jnp.maximum(l, 1e-37)),
+                        jnp.inf)
+        lse_ref[0, 0] = lse
 
 
-def _flash_fwd_impl(q: jax.Array, k: jax.Array, v: jax.Array,
-                    causal: bool, block_q: int, block_k: int,
-                    interpret: bool) -> jax.Array:
-    b, s_q, h, d = q.shape
-    s_kv, h_kv = k.shape[1], k.shape[2]
-    group = h // h_kv
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_acc, *, causal: bool, scale: float, bq: int, bk: int,
+               n_kv_blocks: int):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q_start = iq * bq
+    k_start = ik * bk
+
+    def _compute():
+        q = q_ref[0, 0]                                # [bq, d]
+        k = k_ref[0, 0]                                # [bk, d]
+        v = v_ref[0, 0]                                # [bk, d]
+        do = do_ref[0, 0]                              # [bq, d]
+        lse = lse_ref[0, 0]                            # [bq, 1]
+        delta = delta_ref[0, 0]                        # [bq, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if causal:
+            q_pos = q_start + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            k_pos = k_start + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)                           # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bq, bk]
+        ds = p * (dp - delta) * scale                  # [bq, bk]
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bq, d]
+
+    if causal:
+        pl.when(k_start < q_start + bq)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ik == n_kv_blocks - 1)
+    def _store():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, causal: bool,
+                scale: float, bq: int, bk: int, n_q_blocks: int):
+    ik = pl.program_id(2)
+    iq = pl.program_id(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start = iq * bq
+    k_start = ik * bk
+
+    def _compute():
+        q = q_ref[0, 0]                                # [bq, d]
+        k = k_ref[0, 0]                                # [bk, d]
+        v = v_ref[0, 0]                                # [bk, d]
+        do = do_ref[0, 0]                              # [bq, d]
+        lse = lse_ref[0, 0]                            # [bq, 1]
+        delta = delta_ref[0, 0]                        # [bq, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if causal:
+            q_pos = q_start + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            k_pos = k_start + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)                           # [bq, bk]
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bk, d]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bq, bk]
+        ds = p * (dp - delta) * scale                  # [bq, bk]
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bk, d]
+
+    if causal:
+        # Skip q blocks entirely above the diagonal (no query in the
+        # block can see this kv block).
+        pl.when(q_start + bq > k_start)(_compute)
+    else:
+        _compute()
+
+    @pl.when(iq == n_q_blocks - 1)
+    def _store():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _blocks(s_q: int, s_kv: int, block_q: int, block_k: int):
     bq = min(block_q, s_q)
     bk = min(block_k, s_kv)
     if s_q % bq or s_kv % bk:
         raise ValueError(f'seq lens ({s_q},{s_kv}) must divide block '
                          f'sizes ({bq},{bk})')
-    n_q, n_k = s_q // bq, s_kv // bk
+    return bq, bk, s_q // bq, s_kv // bk
+
+
+def _flash_fwd_impl(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool, block_q: int, block_k: int,
+                    interpret: bool):
+    b, s_q, h, d = q.shape
+    s_kv, h_kv = k.shape[1], k.shape[2]
+    group = h // h_kv
+    bq, bk, n_q, n_k = _blocks(s_q, s_kv, block_q, block_k)
     scale = 1.0 / math.sqrt(d)
 
     # [B,S,H,D] → [B,H,S,D]: the kernel tiles (seq, head_dim).
@@ -108,7 +222,7 @@ def _flash_fwd_impl(q: jax.Array, k: jax.Array, v: jax.Array,
     kernel = functools.partial(
         _fwd_kernel, causal=causal, scale=scale, bq=bq, bk=bk,
         n_kv_blocks=n_k)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b, h, n_q, n_k),
         in_specs=[
@@ -119,9 +233,18 @@ def _flash_fwd_impl(q: jax.Array, k: jax.Array, v: jax.Array,
             pl.BlockSpec((1, 1, bk, d), lambda b_, h_, iq, ik:
                          (b_, h_ // group, ik, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik:
-                               (b_, h_, iq, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, h, s_q, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik:
+                         (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, iq, ik:
+                         (b_, h_, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s_q, d), q.dtype),
+            # [B,H,Sq,1]: trailing singleton keeps TPU block tiling
+            # legal ((bq, 1) is a valid last-two-dims block).
+            jax.ShapeDtypeStruct((b, h, s_q, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),
             pltpu.VMEM((bq, 1), jnp.float32),
@@ -129,7 +252,77 @@ def _flash_fwd_impl(q: jax.Array, k: jax.Array, v: jax.Array,
         ],
         interpret=interpret,
     )(qt, kt, vt)
-    return jnp.swapaxes(out, 1, 2)
+    return jnp.swapaxes(out, 1, 2), lse
+
+
+def _flash_bwd_impl(q, k, v, o, lse, do, causal, block_q, block_k,
+                    interpret):
+    b, s_q, h, d = q.shape
+    s_kv, h_kv = k.shape[1], k.shape[2]
+    group = h // h_kv
+    bq, bk, n_q, n_k = _blocks(s_q, s_kv, block_q, block_k)
+    scale = 1.0 / math.sqrt(d)
+
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    dot = jnp.swapaxes(do, 1, 2)
+    # delta = rowsum(do * o): one cheap elementwise pass outside pallas.
+    delta = jnp.sum(dot.astype(jnp.float32) *
+                    jnp.swapaxes(o, 1, 2).astype(jnp.float32),
+                    axis=-1, keepdims=True)            # [B,H,Sq,1] f32
+
+    q_spec = pl.BlockSpec((1, 1, bq, d),
+                          lambda b_, h_, iq, ik: (b_, h_, iq, 0))
+    kv_spec = pl.BlockSpec((1, 1, bk, d),
+                           lambda b_, h_, iq, ik: (b_, h_ // group, ik, 0))
+    row_spec = pl.BlockSpec((1, 1, bq, 1),
+                            lambda b_, h_, iq, ik: (b_, h_, iq, 0))
+
+    dqt = pl.pallas_call(
+        functools.partial(_dq_kernel, causal=causal, scale=scale, bq=bq,
+                          bk=bk, n_kv_blocks=n_k),
+        grid=(b, h, n_q, n_k),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, s_q, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    # dk/dv: kv-block major, q sequential innermost. Per-head partials;
+    # GQA groups summed below.
+    q_spec2 = pl.BlockSpec((1, 1, bq, d),
+                           lambda b_, h_, ik, iq: (b_, h_, iq, 0))
+    kv_spec2 = pl.BlockSpec((1, 1, bk, d),
+                            lambda b_, h_, ik, iq: (b_, h_ // group, ik, 0))
+    kv_out_spec = pl.BlockSpec((1, 1, bk, d),
+                               lambda b_, h_, ik, iq: (b_, h_, ik, 0))
+    row_spec2 = pl.BlockSpec((1, 1, bq, 1),
+                             lambda b_, h_, ik, iq: (b_, h_, iq, 0))
+    dkt_h, dvt_h = pl.pallas_call(
+        functools.partial(_dkv_kernel, causal=causal, scale=scale, bq=bq,
+                          bk=bk, n_q_blocks=n_q),
+        grid=(b, h, n_k, n_q),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2,
+                  row_spec2],
+        out_specs=[kv_out_spec, kv_out_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s_kv, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, s_kv, d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    dq = jnp.swapaxes(dqt, 1, 2)
+    if group > 1:
+        dkt_h = dkt_h.reshape(b, h_kv, group, s_kv, d).sum(axis=2)
+        dvt_h = dvt_h.reshape(b, h_kv, group, s_kv, d).sum(axis=2)
+    dk = jnp.swapaxes(dkt_h, 1, 2).astype(k.dtype)
+    dv = jnp.swapaxes(dvt_h, 1, 2).astype(v.dtype)
+    return dq, dk, dv
 
 
 def _use_interpret() -> bool:
@@ -141,23 +334,21 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True, block_q: int = 512,
                     block_k: int = 512) -> jax.Array:
     """Flash attention. q:[B,Sq,H,D], k/v:[B,Skv,Hkv,D] → [B,Sq,H,D]."""
-    return _flash_fwd_impl(q, k, v, causal, block_q, block_k,
-                           interpret=_use_interpret())
+    out, _ = _flash_fwd_impl(q, k, v, causal, block_q, block_k,
+                             interpret=_use_interpret())
+    return out
 
 
 def _fwd(q, k, v, causal, block_q, block_k):
-    out = _flash_fwd_impl(q, k, v, causal, block_q, block_k,
-                          interpret=_use_interpret())
-    return out, (q, k, v)
+    out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k,
+                               interpret=_use_interpret())
+    return out, (q, k, v, out, lse)
 
 
 def _bwd(causal, block_q, block_k, res, g):
-    from skypilot_tpu.ops import attention as attention_ops
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: attention_ops.blockwise_attention(
-            q_, k_, v_, causal=causal, block_size=block_k), q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    return _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k,
+                           interpret=_use_interpret())
 
 
 flash_attention.defvjp(_fwd, _bwd)

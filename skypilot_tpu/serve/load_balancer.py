@@ -6,6 +6,7 @@ QPS window the controller's autoscaler reads via /internal/stats.
 """
 import asyncio
 import collections
+import contextlib
 import threading
 import time
 from typing import List, Optional
@@ -65,8 +66,10 @@ class LoadBalancer:
         url = target.rstrip('/') + '/' + request.match_info['tail']
         if request.query_string:
             url += f'?{request.query_string}'
+        import aiohttp
         body = await request.read()
         self.policy.on_request_start(target)
+        response = None
         try:
             async with ClientSession(
                     timeout=ClientTimeout(total=3600)) as session:
@@ -76,18 +79,32 @@ class LoadBalancer:
                                  if k.lower() not in ('host',
                                                       'content-length')},
                         allow_redirects=False) as upstream:
-                    payload = await upstream.read()
-                    return web.Response(
-                        status=upstream.status, body=payload,
+                    # Stream the upstream body chunk-by-chunk: LLM
+                    # serving fronts SSE/chunked token streams, which
+                    # must flow as generated, not after completion.
+                    response = web.StreamResponse(
+                        status=upstream.status,
                         headers={k: v
                                  for k, v in upstream.headers.items()
                                  if k.lower() not in (
                                      'transfer-encoding',
                                      'content-length',
                                      'connection')})
-        except OSError as e:
-            return web.Response(status=502,
-                                text=f'Upstream error: {e}\n')
+                    await response.prepare(request)
+                    async for chunk in upstream.content.iter_chunked(
+                            64 * 1024):
+                        await response.write(chunk)
+                    await response.write_eof()
+                    return response
+        except (OSError, aiohttp.ClientError) as e:
+            if response is None or not response.prepared:
+                return web.Response(status=502,
+                                    text=f'Upstream error: {e}\n')
+            # Headers (and possibly bytes) already went out: the only
+            # honest signal left is truncating the stream.
+            with contextlib.suppress(Exception):
+                await response.write_eof()
+            return response
         finally:
             self.policy.on_request_end(target)
 

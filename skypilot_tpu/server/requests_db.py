@@ -110,11 +110,14 @@ def set_running(request_id: str, pid: int) -> None:
 def set_result(request_id: str, result: Any) -> None:
     conn = _get_conn()
     with _lock:
+        # Status guard mirrors set_error: a request cancelled while the
+        # forked worker was finishing must stay CANCELLED.
         conn.execute(
             'UPDATE requests SET status=?, finished_at=?, result=? '
-            'WHERE request_id=?',
+            'WHERE request_id=? AND status IN (?,?)',
             (RequestStatus.SUCCEEDED.value, time.time(),
-             json.dumps(result), request_id))
+             json.dumps(result), request_id,
+             RequestStatus.PENDING.value, RequestStatus.RUNNING.value))
         conn.commit()
 
 

@@ -205,15 +205,20 @@ class SSHCommandRunner(CommandRunner):
 
     def rsync(self, source: str, target: str, *, up: bool,
               excludes=None, log_path=None):
+        """up: local `source` → remote `target`; down: remote `source` →
+        local `target` (reference convention, command_runner.py:168)."""
         ssh_cmd = ' '.join(self._ssh_base()[:-1])  # drop user@host
         argv = ['rsync', '-az', '-e', ssh_cmd]
         for e in excludes or []:
             argv += ['--exclude', e]
-        remote = f'{self.user}@{self.host}:{target}'
         if up:
-            argv += [os.path.expanduser(source), remote]
+            argv += [os.path.expanduser(source),
+                     f'{self.user}@{self.host}:{target}']
         else:
-            argv += [remote, os.path.expanduser(target)]
+            local_target = os.path.expanduser(target)
+            os.makedirs(os.path.dirname(local_target.rstrip('/')) or '.',
+                        exist_ok=True)
+            argv += [f'{self.user}@{self.host}:{source}', local_target]
         rc, out, err = self._run_subprocess(argv, require_outputs=True,
                                             env=dict(os.environ))
         if rc != 0:
@@ -265,9 +270,10 @@ class KubernetesCommandRunner(CommandRunner):
     def rsync(self, source: str, target: str, *, up: bool,
               excludes=None, log_path=None):
         """Directory sync via tar over kubectl exec (honors excludes);
-        single files via kubectl cp."""
-        source = os.path.expanduser(source)
+        single files via kubectl cp. up: local `source` → pod `target`;
+        down: pod `source` → local `target` (reference convention)."""
         if up:
+            source = os.path.expanduser(source)
             target = self._resolve_home(target)
             if os.path.isdir(source):
                 tar_args = ''.join(
@@ -295,11 +301,14 @@ class KubernetesCommandRunner(CommandRunner):
                 f'{self.namespace}/{self.pod_name}:{target}',
                 '-c', self.container]
         else:
+            local_target = os.path.expanduser(target)
+            os.makedirs(os.path.dirname(local_target.rstrip('/')) or '.',
+                        exist_ok=True)
             argv = self._base() + [
                 'cp',
                 f'{self.namespace}/{self.pod_name}:'
-                f'{self._resolve_home(target)}',
-                source, '-c', self.container]
+                f'{self._resolve_home(source)}',
+                local_target, '-c', self.container]
         rc, out, err = self._run_subprocess(argv, require_outputs=True,
                                             env=dict(os.environ))
         if rc != 0:

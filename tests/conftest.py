@@ -60,3 +60,56 @@ def enable_clouds(monkeypatch):
             lambda raise_if_no_cloud_access=False: sorted(names))
         return sorted(names)
     return _enable
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Zero-leaked-processes guard: any control-plane daemon (skylet,
+    gang runner, controllers) still alive at session end is a test bug —
+    kill it and fail the run so leaks can't accumulate.
+
+    Scoped strictly to THIS session: a process counts as ours only when
+    its cmdline or environment references this run's tmp basetemp (every
+    test daemon inherits HOME/SKYTPU_STATE_DIR under it). A concurrent
+    pytest run or a real deployment on the same host is never touched.
+    """
+    import glob
+    import signal as _signal
+
+    try:
+        basetemp = str(
+            session.config._tmp_path_factory.getbasetemp())  # noqa: SLF001
+    except Exception:  # no tmp dir was ever created
+        return
+    marker = basetemp.encode()
+    me = os.getpid()
+    leaked = []
+    for pid_dir in glob.glob('/proc/[0-9]*'):
+        try:
+            pid = int(os.path.basename(pid_dir))
+        except ValueError:
+            continue
+        if pid == me:
+            continue
+        try:
+            with open(os.path.join(pid_dir, 'cmdline'), 'rb') as f:
+                cmd = f.read()
+            with open(os.path.join(pid_dir, 'environ'), 'rb') as f:
+                env = f.read()
+        except OSError:
+            continue
+        if marker not in cmd and marker not in env:
+            continue
+        leaked.append((pid, cmd.replace(b'\0', b' ').decode(
+            errors='replace').strip()))
+        try:
+            os.killpg(pid, _signal.SIGKILL)
+        except OSError:
+            try:
+                os.kill(pid, _signal.SIGKILL)
+            except OSError:
+                pass
+    if leaked:
+        print('\nLEAKED PROCESSES (killed by conftest guard):')
+        for pid, cmd in leaked:
+            print(f'  {pid}: {cmd[:140]}')
+        session.exitstatus = 1

@@ -1,4 +1,6 @@
 """Attention op correctness: blockwise + ring vs dense reference."""
+import functools
+
 import jax
 import numpy as np
 import pytest
@@ -96,16 +98,21 @@ class TestFlash:
         np.testing.assert_allclose(np.asarray(dense), np.asarray(flash),
                                    atol=2e-5)
 
-    def test_grads_match_dense(self, qkv):
+    @pytest.mark.parametrize('causal', [True, False])
+    def test_grads_match_dense(self, qkv, causal):
+        """Exercises the dedicated dq/dkv backward kernels, multi-block
+        (16-wide blocks over S=64) incl. GQA group summation."""
         q, k, v = qkv
         from skypilot_tpu.ops import flash_attention as fa
 
         def loss(fn):
             return lambda q_, k_, v_: (fn(q_, k_, v_) ** 2).sum()
 
-        gd = jax.grad(loss(attention_ops.dense_attention),
-                      argnums=(0, 1, 2))(q, k, v)
-        gf = jax.grad(loss(fa.flash_attention), argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss(functools.partial(
+            attention_ops.dense_attention, causal=causal)),
+            argnums=(0, 1, 2))(q, k, v)
+        gf = jax.grad(loss(lambda q_, k_, v_: fa.flash_attention(
+            q_, k_, v_, causal, 16, 16)), argnums=(0, 1, 2))(q, k, v)
         for a, b in zip(gd, gf):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=2e-4)

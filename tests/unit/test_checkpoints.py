@@ -40,6 +40,7 @@ def test_save_restore_roundtrip(tmp_path):
     _tree_equal(state['params'], restored['params'])
 
 
+@pytest.mark.slow
 def test_restore_onto_different_mesh(tmp_path):
     """FSDP-8 checkpoint restores onto a data×tensor mesh (resharding)."""
     mesh_a = mesh_lib.make_mesh(mesh_lib.MeshSpec(fsdp=-1))
@@ -61,6 +62,7 @@ def test_restore_onto_different_mesh(tmp_path):
     assert leaf.sharding.mesh.shape == mesh_b.shape
 
 
+@pytest.mark.slow
 def test_fit_resume_continues(tmp_path):
     """fit() to step 2, then resume run finishes 2->4 without restart."""
     mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(fsdp=-1))

@@ -1,0 +1,65 @@
+"""CommandRunner rsync direction semantics (shared convention).
+
+Reference: sky/utils/command_runner.py:168 — up means local `source` →
+remote `target`; down means remote `source` → local `target`. All
+runners must agree so callers can use the interface polymorphically.
+"""
+import os
+
+from skypilot_tpu.utils import command_runner
+
+
+def _capture_argv(monkeypatch, runner_cls):
+    calls = []
+
+    def fake_run_subprocess(argv, **kwargs):
+        calls.append(argv)
+        return (0, '', '') if kwargs.get('require_outputs') else 0
+
+    monkeypatch.setattr(runner_cls, '_run_subprocess',
+                        staticmethod(fake_run_subprocess))
+    return calls
+
+
+def test_ssh_rsync_up_direction(monkeypatch, tmp_path):
+    calls = _capture_argv(monkeypatch, command_runner.SSHCommandRunner)
+    r = command_runner.SSHCommandRunner('h1', user='u')
+    r.rsync(str(tmp_path), '/remote/dir', up=True)
+    argv = calls[-1]
+    assert argv[-2] == str(tmp_path)
+    assert argv[-1] == 'u@h1:/remote/dir'
+
+
+def test_ssh_rsync_down_direction(monkeypatch, tmp_path):
+    """down: remote `source` → local `target` — source must NOT be
+    ignored (the round-1 bug)."""
+    calls = _capture_argv(monkeypatch, command_runner.SSHCommandRunner)
+    r = command_runner.SSHCommandRunner('h1', user='u')
+    local_target = str(tmp_path / 'out')
+    r.rsync('/remote/logs/', local_target, up=False)
+    argv = calls[-1]
+    assert argv[-2] == 'u@h1:/remote/logs/'
+    assert argv[-1] == local_target
+
+
+def test_kubernetes_rsync_down_direction(monkeypatch, tmp_path):
+    calls = _capture_argv(monkeypatch,
+                          command_runner.KubernetesCommandRunner)
+    r = command_runner.KubernetesCommandRunner('pod1', namespace='ns')
+    local_target = str(tmp_path / 'job.log')
+    r.rsync('/pod/job.log', local_target, up=False)
+    argv = calls[-1]
+    assert 'ns/pod1:/pod/job.log' in argv
+    assert local_target in argv
+    # remote source comes before local target (kubectl cp SRC DST)
+    assert argv.index('ns/pod1:/pod/job.log') < argv.index(local_target)
+
+
+def test_local_rsync_roundtrip(tmp_path):
+    src = tmp_path / 'src'
+    src.mkdir()
+    (src / 'a.txt').write_text('hello')
+    dst = tmp_path / 'dst'
+    r = command_runner.LocalProcessRunner()
+    r.rsync(str(src) + '/', str(dst) + '/', up=True)
+    assert (dst / 'a.txt').read_text() == 'hello'

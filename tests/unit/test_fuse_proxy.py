@@ -97,3 +97,19 @@ def test_shim_fails_cleanly_without_server(binaries, tmp_path):
                             capture_output=True, timeout=30)
     assert result.returncode == 1
     assert b'cannot reach server' in result.stderr
+
+
+def test_server_refuses_request_without_ns_fd(server):
+    """A raw client that sends no SCM_RIGHTS namespace fd must be
+    refused — the server only ever setns()s on an unforgeable fd the
+    caller proved it owns, never on a claimed pid."""
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_SEQPACKET)
+    try:
+        s.connect(server['sock'])
+        s.sendall(b'1\n-u\n0\n')  # valid payload, no fds attached
+        payload = s.recv(1 << 20)
+    finally:
+        s.close()
+    code, _, output = payload.partition(b'\n')
+    assert code == b'1'
+    assert b'no mount-namespace fd' in output

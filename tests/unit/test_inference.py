@@ -46,6 +46,7 @@ def test_prefill_decode_matches_full_forward(tiny):
     assert results[rid] == ref
 
 
+@pytest.mark.slow
 def test_continuous_batching_multiple_requests(tiny):
     config, params = tiny
     prompts = [[1, 2, 3], [10, 20, 30, 40], [7], [99, 98]]

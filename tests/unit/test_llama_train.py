@@ -47,10 +47,13 @@ def _train_cfg(**kw):
     return trainer.TrainerConfig(**defaults)
 
 
+# The 4D spec is the default-run representative (it exercises every
+# axis); pure-DP/FSDP/TP×FSDP compile ~30 s each on one core → slow.
 @pytest.mark.parametrize('mesh_spec', [
-    MeshSpec(data=8, fsdp=1),
-    MeshSpec(data=1, fsdp=8),
-    MeshSpec(data=2, fsdp=2, tensor=2),
+    pytest.param(MeshSpec(data=8, fsdp=1), marks=pytest.mark.slow),
+    pytest.param(MeshSpec(data=1, fsdp=8), marks=pytest.mark.slow),
+    pytest.param(MeshSpec(data=2, fsdp=2, tensor=2),
+                 marks=pytest.mark.slow),
     MeshSpec(data=1, fsdp=2, context=2, tensor=2),
 ])
 def test_loss_decreases(mesh_spec):

@@ -86,6 +86,7 @@ def test_param_counts(tiny):
     assert config.active_params() < config.num_params()
 
 
+@pytest.mark.slow
 def test_moe_trainer_step():
     """The generic trainer drives the MoE family end-to-end."""
     from skypilot_tpu.train import trainer as trainer_lib
