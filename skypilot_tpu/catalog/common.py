@@ -56,3 +56,61 @@ def _float_or_none(v) -> Optional[float]:
         return float(v)
     except (TypeError, ValueError):
         return None
+
+
+def vm_row_to_info(cloud: str, row) -> InstanceTypeInfo:
+    """One vms.csv row → InstanceTypeInfo (shared across VM clouds)."""
+    import pandas as pd
+    acc = row.accelerator_name
+    if isinstance(acc, float) and pd.isna(acc):
+        acc = None
+    return InstanceTypeInfo(
+        cloud=cloud, instance_type=row.instance_type,
+        accelerator_name=acc,
+        accelerator_count=float(row.accelerator_count),
+        cpus=_float_or_none(row.cpus),
+        memory_gb=_float_or_none(row.memory_gb),
+        price=float(row.price),
+        spot_price=_float_or_none(row.spot_price),
+        region=row.region, zone=row.zone)
+
+
+def vm_feasible(info: InstanceTypeInfo, resources, acc) -> bool:
+    """Generic VM feasibility filter shared by the VM-cloud catalogs."""
+    if resources.instance_type and info.instance_type != \
+            resources.instance_type:
+        return False
+    if resources.region and info.region != resources.region:
+        return False
+    if resources.zone and info.zone != resources.zone:
+        return False
+    if acc is not None:
+        name, count = acc
+        if info.accelerator_name != name or info.accelerator_count < count:
+            return False
+    elif info.accelerator_name is not None and not resources.instance_type:
+        # Don't hand out GPU nodes for pure-CPU requests.
+        return False
+    if resources.cpus is not None:
+        if info.cpus is None or info.cpus < resources.cpus:
+            return False
+    if resources.memory is not None:
+        if info.memory_gb is None or info.memory_gb < resources.memory:
+            return False
+    if resources.use_spot and info.spot_price is None:
+        return False
+    return True
+
+
+def vm_catalog_feasible(cloud: str, df, resources) -> List[InstanceTypeInfo]:
+    """get_feasible over a vms.csv DataFrame, cheapest first."""
+    if not len(df):
+        return []
+    acc = resources.sole_accelerator()
+    if resources.accelerators and acc is None:
+        return []
+    rows = [info for row in df.itertuples()
+            if vm_feasible(info := vm_row_to_info(cloud, row), resources,
+                           acc)]
+    rows.sort(key=lambda r: r.cost(resources.use_spot))
+    return rows

@@ -50,19 +50,7 @@ def list_accelerators(name_filter: Optional[str] = None
 
 
 def _vm_row_to_info(row) -> common.InstanceTypeInfo:
-    import pandas as pd
-    acc = row.accelerator_name
-    if isinstance(acc, float) and pd.isna(acc):
-        acc = None
-    return common.InstanceTypeInfo(
-        cloud='gcp', instance_type=row.instance_type,
-        accelerator_name=acc,
-        accelerator_count=float(row.accelerator_count),
-        cpus=common._float_or_none(row.cpus),
-        memory_gb=common._float_or_none(row.memory_gb),
-        price=float(row.price),
-        spot_price=common._float_or_none(row.spot_price),
-        region=row.region, zone=row.zone)
+    return common.vm_row_to_info('gcp', row)
 
 
 def get_feasible(resources) -> List[common.InstanceTypeInfo]:
@@ -99,42 +87,9 @@ def get_feasible(resources) -> List[common.InstanceTypeInfo]:
                 spot_price=None if spot is None else spot * chips,
                 region=row.region, zone=row.zone))
     else:
-        df = _vm_df()
-        if not len(df):
-            return []
-        for row in df.itertuples():
-            info = _vm_row_to_info(row)
-            if not _vm_feasible(info, resources, acc):
-                continue
-            rows.append(info)
+        return common.vm_catalog_feasible('gcp', _vm_df(), resources)
     rows.sort(key=lambda r: r.cost(resources.use_spot))
     return rows
-
-
-def _vm_feasible(info: common.InstanceTypeInfo, resources, acc) -> bool:
-    if resources.instance_type and info.instance_type != \
-            resources.instance_type:
-        return False
-    if resources.region and info.region != resources.region:
-        return False
-    if resources.zone and info.zone != resources.zone:
-        return False
-    if acc is not None:
-        name, count = acc
-        if info.accelerator_name != name or info.accelerator_count < count:
-            return False
-    elif info.accelerator_name is not None and not resources.instance_type:
-        # Don't hand out GPU nodes for pure-CPU requests.
-        return False
-    if resources.cpus is not None:
-        if info.cpus is None or info.cpus < resources.cpus:
-            return False
-    if resources.memory is not None:
-        if info.memory_gb is None or info.memory_gb < resources.memory:
-            return False
-    if resources.use_spot and info.spot_price is None:
-        return False
-    return True
 
 
 def validate_region_zone(region: Optional[str], zone: Optional[str]) -> bool:

@@ -1,0 +1,71 @@
+"""AWS cloud policy — the second VM cloud.
+
+Reference analog: sky/clouds/aws.py (1203 LoC). No TPUs here: AWS
+carries controllers, CPU workers, and GPU recipes, and proves the
+multi-cloud abstraction (optimizer failover GCP↔AWS through the same
+blocked-resources loop).
+"""
+from typing import Dict, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.utils import registry
+
+
+@registry.CLOUD_REGISTRY.register(name='aws')
+class AWS(cloud.Cloud):
+    NAME = 'aws'
+    CAPABILITIES = frozenset({
+        cloud.CloudCapability.MULTI_NODE,
+        cloud.CloudCapability.SPOT_INSTANCE,
+        cloud.CloudCapability.STOP,
+        cloud.CloudCapability.AUTOSTOP,
+        cloud.CloudCapability.OPEN_PORTS,
+        cloud.CloudCapability.STORAGE_MOUNT,
+        cloud.CloudCapability.CUSTOM_IMAGE,
+        cloud.CloudCapability.HOST_CONTROLLERS,
+    })
+    # EC2 resource names land in tags; keep parity with the reference's
+    # cluster-name truncation behavior.
+    MAX_CLUSTER_NAME_LENGTH = 50
+
+    def provision_module(self) -> str:
+        return 'skypilot_tpu.provision.aws'
+
+    def make_deploy_variables(self, resources, cluster_name_on_cloud: str,
+                              region: str, zone: Optional[str]
+                              ) -> Dict[str, object]:
+        resources.assert_launchable()
+        from skypilot_tpu import config as config_lib
+        auth = self.authentication_config()
+        variables: Dict[str, object] = {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region,
+            'zone': zone,
+            'instance_type': resources.instance_type,
+            'use_spot': resources.use_spot,
+            'disk_size': resources.disk_size,
+            'labels': dict(resources.labels),
+            'ports': list(resources.ports or []),
+            'vpc_id': config_lib.get_nested(('aws', 'vpc_id')),
+            'use_internal_ips': bool(
+                config_lib.get_nested(('aws', 'use_internal_ips'),
+                                      default=False)),
+            'ssh_user': auth.get('ssh_user'),
+            'ssh_private_key': auth.get('ssh_private_key'),
+            'num_nodes': None,  # filled by the provisioner
+        }
+        if resources.image_id:
+            variables['image_id'] = resources.image_id
+        return variables
+
+    def authentication_config(self) -> Dict[str, object]:
+        from skypilot_tpu import authentication
+        return authentication.authentication_config()
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.adaptors import aws as aws_adaptor
+        if aws_adaptor.load_credentials() is not None:
+            return True, None
+        return False, ('AWS credentials not found; set AWS_ACCESS_KEY_ID/'
+                       'AWS_SECRET_ACCESS_KEY or populate '
+                       '~/.aws/credentials.')

@@ -81,5 +81,5 @@ class Cloud:
         return {}
 
     def __repr__(self) -> str:
-        return self.NAME.upper() if self.NAME == 'gcp' else \
-            self.NAME.capitalize()
+        return self.NAME.upper() if self.NAME in ('gcp', 'aws', 'ssh') \
+            else self.NAME.capitalize()

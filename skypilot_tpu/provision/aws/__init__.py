@@ -1,0 +1,355 @@
+"""AWS EC2 provisioner — the second VM cloud proving the multi-cloud
+abstraction.
+
+Reference analog: sky/provision/aws/instance.py (1735 LoC, boto3).
+Ours drives the EC2 Query API through the injectable adaptor client
+(skypilot_tpu/adaptors/aws.py) with the same uniform provision
+interface as GCP/Kubernetes: run/stop/terminate/query/get_cluster_info/
+open_ports/get_command_runners. SSH keys ride cloud-init user-data (the
+EC2 twin of GCP's ssh-keys metadata) so no ImportKeyPair state is
+needed; a per-cluster security group carries SSH + opened ports.
+"""
+import base64
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.adaptors import aws as aws_adaptor
+from skypilot_tpu.provision import common
+from skypilot_tpu.utils import command_runner
+
+logger = logging.getLogger(__name__)
+
+CLUSTER_TAG = 'skytpu-cluster'
+HEAD_TAG = 'skytpu-head'
+INDEX_TAG = 'skytpu-index'
+
+_STATE_MAP = {
+    'pending': 'pending',
+    'running': 'running',
+    'shutting-down': 'terminating',
+    'terminated': 'terminated',
+    'stopping': 'stopping',
+    'stopped': 'stopped',
+}
+
+# Ubuntu 22.04 LTS amd64 (public Canonical AMIs); overridable via
+# resources.image_id / provider_config['image_id'].
+DEFAULT_AMIS = {
+    'us-east-1': 'ami-0557a15b87f6559cf',
+    'us-east-2': 'ami-00eeedc4036573771',
+    'us-west-2': 'ami-0efcece6bed30fd98',
+    'eu-west-1': 'ami-0694d931cee176e7d',
+    'ap-northeast-1': 'ami-0d52744d6551d851e',
+}
+
+
+def _region(pc: Dict[str, Any]) -> str:
+    return pc['region']
+
+
+def _instances(client, cluster_name_on_cloud: str,
+               states: Optional[List[str]] = None) -> List[Dict[str, Any]]:
+    extra = {'instance-state-name': states} if states else {}
+    resp = client.call('DescribeInstances',
+                       aws_adaptor.tag_filters(cluster_name_on_cloud,
+                                               extra))
+    out: List[Dict[str, Any]] = []
+    for reservation in resp.get('reservationSet') or []:
+        out.extend(reservation.get('instancesSet') or [])
+    return out
+
+
+def _tags(inst: Dict[str, Any]) -> Dict[str, str]:
+    return {t['key']: t['value'] for t in inst.get('tagSet') or []}
+
+
+def _state(inst: Dict[str, Any]) -> str:
+    name = (inst.get('instanceState') or {}).get('name', 'pending')
+    return _STATE_MAP.get(name, 'pending')
+
+
+def _user_data(config: common.ProvisionConfig) -> str:
+    """cloud-init that authorizes our deterministic SSH key."""
+    auth = config.authentication_config
+    user = auth.get('ssh_user', 'skytpu')
+    pub = auth.get('ssh_public_key_content', '')
+    return (f'#cloud-config\n'
+            f'users:\n'
+            f'  - name: {user}\n'
+            f'    sudo: ALL=(ALL) NOPASSWD:ALL\n'
+            f'    shell: /bin/bash\n'
+            f'    ssh_authorized_keys:\n'
+            f'      - {pub}\n')
+
+
+def _default_vpc_id(client) -> str:
+    resp = client.call('DescribeVpcs', {
+        'Filter.1.Name': 'isDefault', 'Filter.1.Value.1': 'true'})
+    vpcs = resp.get('vpcSet') or []
+    if not vpcs:
+        raise exceptions.ProvisionError(
+            'No default VPC in region; set aws.vpc_id in config.')
+    return vpcs[0]['vpcId']
+
+
+def _ensure_security_group(client, cluster_name_on_cloud: str,
+                           pc: Dict[str, Any]) -> str:
+    """Per-cluster SG with SSH ingress; open_ports appends rules.
+
+    Lookup is scoped to the target VPC — a same-named group in another
+    VPC (e.g. after the user switches aws.vpc_id) must not be reused.
+    """
+    name = f'skytpu-{cluster_name_on_cloud}'
+    vpc_id = pc.get('vpc_id') or _default_vpc_id(client)
+    resp = client.call('DescribeSecurityGroups', {
+        'Filter.1.Name': 'group-name', 'Filter.1.Value.1': name,
+        'Filter.2.Name': 'vpc-id', 'Filter.2.Value.1': vpc_id})
+    groups = resp.get('securityGroupInfo') or []
+    if groups:
+        return groups[0]['groupId']
+    created = client.call('CreateSecurityGroup', {
+        'GroupName': name, 'VpcId': vpc_id,
+        'GroupDescription': f'skytpu cluster {cluster_name_on_cloud}'})
+    group_id = created['groupId']
+    _authorize_ports(client, group_id, ['22'])
+    return group_id
+
+
+def _authorize_ports(client, group_id: str, ports: List[str]) -> None:
+    for i, port in enumerate(ports, 1):
+        lo, _, hi = str(port).partition('-')
+        try:
+            client.call('AuthorizeSecurityGroupIngress', {
+                'GroupId': group_id,
+                'IpPermissions.1.IpProtocol': 'tcp',
+                'IpPermissions.1.FromPort': lo,
+                'IpPermissions.1.ToPort': hi or lo,
+                'IpPermissions.1.IpRanges.1.CidrIp': '0.0.0.0/0',
+            })
+        except aws_adaptor.AwsApiError as e:
+            if e.code != 'InvalidPermission.Duplicate':
+                raise
+
+
+def _image_id(config: common.ProvisionConfig, region: str) -> str:
+    nc = {**config.provider_config, **config.node_config}
+    image = nc.get('image_id')
+    if image:
+        return image
+    image = DEFAULT_AMIS.get(region)
+    if image is None:
+        raise exceptions.ProvisionError(
+            f'No default AMI known for region {region}; set image_id.')
+    return image
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    pc = config.provider_config
+    pc.setdefault('region', region)
+    client = aws_adaptor.client(region)
+    nc = {**pc, **config.node_config}
+
+    existing: Dict[int, Dict[str, Any]] = {}
+    for inst in _instances(client, cluster_name_on_cloud):
+        if _state(inst) == 'terminated':
+            continue
+        try:
+            existing[int(_tags(inst).get(INDEX_TAG, -1))] = inst
+        except ValueError:
+            continue
+
+    group_id = _ensure_security_group(client, cluster_name_on_cloud, pc)
+    created: List[str] = []
+    resumed: List[str] = []
+    head_instance_id: Optional[str] = None
+    try:
+        for i in range(config.count):
+            inst = existing.get(i)
+            status = _state(inst) if inst else None
+            if status in ('running', 'pending'):
+                pass
+            elif status == 'stopped' and config.resume_stopped_nodes:
+                client.call('StartInstances', {
+                    'InstanceId.1': inst['instanceId']})
+                resumed.append(inst['instanceId'])
+            elif status is None:
+                inst = _create_instance(client, config, i,
+                                        cluster_name_on_cloud, region,
+                                        group_id)
+                created.append(inst['instanceId'])
+            else:
+                raise exceptions.ProvisionError(
+                    f'Node {i} of {cluster_name_on_cloud} is {status}; '
+                    'cannot make progress.')
+            if i == 0:
+                head_instance_id = inst['instanceId']
+    except aws_adaptor.AwsApiError as e:
+        raise aws_adaptor.classify_api_error(e) from e
+    return common.ProvisionRecord(
+        provider_name='aws', region=region,
+        zone=nc.get('zone'), cluster_name_on_cloud=cluster_name_on_cloud,
+        head_instance_id=head_instance_id,
+        created_instance_ids=created, resumed_instance_ids=resumed)
+
+
+def _create_instance(client, config: common.ProvisionConfig, index: int,
+                     cluster_name_on_cloud: str, region: str,
+                     group_id: str) -> Dict[str, Any]:
+    nc = {**config.provider_config, **config.node_config}
+    tags = {
+        'Name': f'{cluster_name_on_cloud}-{index}',
+        CLUSTER_TAG: cluster_name_on_cloud,
+        HEAD_TAG: 'true' if index == 0 else 'false',
+        INDEX_TAG: str(index),
+        **config.tags,
+    }
+    params: Dict[str, str] = {
+        'ImageId': _image_id(config, region),
+        'InstanceType': nc.get('instance_type', 'm6i.2xlarge'),
+        'MinCount': '1', 'MaxCount': '1',
+        'SecurityGroupId.1': group_id,
+        'UserData': base64.b64encode(
+            _user_data(config).encode()).decode(),
+        'BlockDeviceMapping.1.DeviceName': '/dev/sda1',
+        'BlockDeviceMapping.1.Ebs.VolumeSize': str(
+            nc.get('disk_size', 256)),
+        'BlockDeviceMapping.1.Ebs.VolumeType': 'gp3',
+        'TagSpecification.1.ResourceType': 'instance',
+    }
+    for j, (k, v) in enumerate(sorted(tags.items()), 1):
+        params[f'TagSpecification.1.Tag.{j}.Key'] = k
+        params[f'TagSpecification.1.Tag.{j}.Value'] = v
+    if nc.get('zone'):
+        params['Placement.AvailabilityZone'] = nc['zone']
+    if nc.get('use_spot'):
+        params['InstanceMarketOptions.MarketType'] = 'spot'
+        params['InstanceMarketOptions.SpotOptions.SpotInstanceType'] = \
+            'one-time'
+        params['InstanceMarketOptions.SpotOptions.'
+               'InstanceInterruptionBehavior'] = 'terminate'
+    resp = client.call('RunInstances', params)
+    instances = resp.get('instancesSet') or []
+    if not instances:
+        raise exceptions.ProvisionError(
+            f'RunInstances returned no instance: {resp}')
+    return instances[0]
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: Optional[str] = None,
+                   timeout: float = 600.0) -> None:
+    client = aws_adaptor.client(region)
+    want = state or 'running'
+    deadline = time.time() + timeout
+    while True:
+        instances = [i for i in _instances(client, cluster_name_on_cloud)
+                     if _state(i) != 'terminated']
+        if instances and all(_state(i) == want for i in instances):
+            return
+        if time.time() > deadline:
+            states = {i['instanceId']: _state(i) for i in instances}
+            raise exceptions.ProvisionError(
+                f'Timed out waiting for {want}: {states}')
+        time.sleep(2.0)
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Dict[str, Any]) -> None:
+    client = aws_adaptor.client(_region(provider_config))
+    ids = [i['instanceId']
+           for i in _instances(client, cluster_name_on_cloud,
+                               states=['running', 'pending'])]
+    if ids:
+        client.call('StopInstances', aws_adaptor.flat_params(
+            'InstanceId', ids))
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Dict[str, Any]) -> None:
+    client = aws_adaptor.client(_region(provider_config))
+    ids = [i['instanceId']
+           for i in _instances(client, cluster_name_on_cloud)
+           if _state(i) != 'terminated']
+    if ids:
+        client.call('TerminateInstances', aws_adaptor.flat_params(
+            'InstanceId', ids))
+    # Best-effort SG cleanup (fails with DependencyViolation until
+    # instances fully terminate; harmless to leave behind).
+    name = f'skytpu-{cluster_name_on_cloud}'
+    try:
+        resp = client.call('DescribeSecurityGroups', {
+            'Filter.1.Name': 'group-name', 'Filter.1.Value.1': name})
+        for group in resp.get('securityGroupInfo') or []:
+            client.call('DeleteSecurityGroup',
+                        {'GroupId': group['groupId']})
+    except aws_adaptor.AwsApiError:
+        pass
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Dict[str, Any]
+                    ) -> Dict[str, Optional[str]]:
+    client = aws_adaptor.client(_region(provider_config))
+    out: Dict[str, Optional[str]] = {}
+    for inst in _instances(client, cluster_name_on_cloud):
+        state = _state(inst)
+        if state == 'terminated':
+            continue
+        out[inst['instanceId']] = {
+            'terminating': 'stopping'}.get(state, state)
+    return out
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Dict[str, Any]) -> common.ClusterInfo:
+    client = aws_adaptor.client(region or _region(provider_config))
+    instances: Dict[str, common.InstanceInfo] = {}
+    head_id: Optional[str] = None
+    ordered = sorted(
+        (i for i in _instances(client, cluster_name_on_cloud,
+                               states=['running'])),
+        key=lambda i: int(_tags(i).get(INDEX_TAG, 1 << 30)))
+    for inst in ordered:
+        iid = inst['instanceId']
+        instances[iid] = common.InstanceInfo(
+            instance_id=iid,
+            hosts=[common.HostInfo(
+                host_id=iid,
+                internal_ip=inst.get('privateIpAddress', ''),
+                external_ip=inst.get('ipAddress') or None)],
+            status='running', tags=_tags(inst))
+        if _tags(inst).get(HEAD_TAG) == 'true':
+            head_id = iid
+    if head_id is None and instances:
+        head_id = next(iter(instances))
+    return common.ClusterInfo(
+        instances=instances, head_instance_id=head_id,
+        provider_name='aws', provider_config=provider_config,
+        ssh_user=provider_config.get('ssh_user', 'skytpu'),
+        ssh_private_key=provider_config.get('ssh_private_key'))
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str],
+               provider_config: Dict[str, Any]) -> None:
+    client = aws_adaptor.client(_region(provider_config))
+    group_id = _ensure_security_group(client, cluster_name_on_cloud,
+                                      provider_config)
+    _authorize_ports(client, group_id, ports)
+
+
+def get_command_runners(cluster_info: common.ClusterInfo
+                        ) -> List[command_runner.CommandRunner]:
+    runners: List[command_runner.CommandRunner] = []
+    use_internal = bool(
+        cluster_info.provider_config.get('use_internal_ips', False))
+    for inst in cluster_info.ordered_instances():
+        for host in inst.hosts:
+            runners.append(command_runner.SSHCommandRunner(
+                host.get_ip(use_internal=use_internal),
+                user=cluster_info.ssh_user or 'skytpu',
+                private_key=cluster_info.ssh_private_key,
+                port=host.ssh_port))
+    return runners

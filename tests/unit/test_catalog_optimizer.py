@@ -122,3 +122,42 @@ class TestOptimizer:
         Optimizer.optimize(dag, quiet=True)
         assert t.best_resources.cloud == 'local'
         assert t.best_resources.instance_type == 'localhost'
+
+
+class TestCrossCloud:
+    """Second VM cloud (AWS) proving the multi-cloud abstraction."""
+
+    def test_cpu_request_picks_cheaper_cloud(self, enable_clouds):
+        enable_clouds('gcp', 'aws')
+        with Dag() as dag:
+            t = Task('t', run='true')
+            # AWS m6i.2xlarge $0.3840 < GCP n2-standard-8 $0.3885
+            t.set_resources(Resources(cpus=8))
+            dag.add(t)
+        Optimizer.optimize(dag, quiet=True)
+        assert t.best_resources.cloud == 'aws'
+        assert t.best_resources.instance_type == 'm6i.2xlarge'
+
+    def test_gpu_request_picks_cheaper_cloud(self, enable_clouds):
+        enable_clouds('gcp', 'aws')
+        with Dag() as dag:
+            t = Task('t', run='true')
+            # GCP a2-highgpu-8g $29.38 < AWS p4d.24xlarge $32.77
+            t.set_resources(Resources(accelerators='A100:8'))
+            dag.add(t)
+        Optimizer.optimize(dag, quiet=True)
+        assert t.best_resources.cloud == 'gcp'
+
+    def test_tpu_request_excludes_aws(self):
+        rows = catalog.get_feasible(
+            'aws', Resources(accelerators='tpu-v5p:8'))
+        assert rows == []
+
+    def test_infra_pin_restricts_to_cloud(self, enable_clouds):
+        enable_clouds('gcp', 'aws')
+        with Dag() as dag:
+            t = Task('t', run='true')
+            t.set_resources(Resources(infra='gcp', cpus=8))
+            dag.add(t)
+        Optimizer.optimize(dag, quiet=True)
+        assert t.best_resources.cloud == 'gcp'
