@@ -28,12 +28,25 @@ def api_server_url() -> str:
     return f'http://127.0.0.1:{server_app.DEFAULT_PORT}'
 
 
+def api_token() -> Optional[str]:
+    """Bearer token for the API server (env wins over config)."""
+    token = os.environ.get('SKYTPU_API_TOKEN')
+    if token:
+        return token
+    from skypilot_tpu import config as config_lib
+    return config_lib.get_nested(('api_server', 'token'), default=None)
+
+
 def _request_raw(method: str, path: str,
                  payload: Optional[Dict[str, Any]] = None,
                  stream: bool = False, timeout: float = 300.0):
+    from skypilot_tpu.server import auth as server_auth
     url = f'{api_server_url()}{_API_PREFIX}{path}'
     data = None
-    headers = {}
+    headers = {server_auth.VERSION_HEADER: str(server_auth.API_VERSION)}
+    token = api_token()
+    if token:
+        headers['Authorization'] = f'Bearer {token}'
     if payload is not None:
         data = json.dumps(payload).encode()
         headers['Content-Type'] = 'application/json'
@@ -43,6 +56,11 @@ def _request_raw(method: str, path: str,
         resp = urllib.request.urlopen(req, timeout=timeout)
     except urllib.error.HTTPError as e:
         body = e.read().decode(errors='replace')
+        if e.code == 426:
+            raise exceptions.ApiVersionMismatchError(body) from e
+        if e.code in (401, 403):
+            raise exceptions.PermissionDeniedError(
+                f'{method} {path}: HTTP {e.code}: {body}') from e
         raise exceptions.ApiServerError(
             f'{method} {path}: HTTP {e.code}: {body}') from e
     except urllib.error.URLError as e:
@@ -59,9 +77,19 @@ def _request_raw(method: str, path: str,
 def server_healthy() -> bool:
     try:
         info = _request_raw('GET', '/health', timeout=2.0)
-        return info is not None and info.get('status') == 'healthy'
     except exceptions.ApiServerError:
         return False
+    if info is None or info.get('status') != 'healthy':
+        return False
+    from skypilot_tpu.server import auth as server_auth
+    server_api = info.get('api_version')
+    if server_api is not None and server_api != server_auth.API_VERSION:
+        raise exceptions.ApiVersionMismatchError(
+            f'API server at {api_server_url()} speaks api_version '
+            f'{server_api}; this client speaks '
+            f'{server_auth.API_VERSION}. Upgrade the '
+            f'{"client" if server_api > server_auth.API_VERSION else "server"}.')
+    return True
 
 
 def ensure_server_running(start_timeout: float = 30.0) -> None:
@@ -152,13 +180,15 @@ def api_status(limit: int = 100) -> List[Dict[str, Any]]:
 # --- commands (each returns a request_id) -----------------------------------
 
 def launch(task, cluster_name: str, *, dryrun: bool = False,
-           detach_run: bool = False, no_setup: bool = False) -> str:
+           detach_run: bool = False, no_setup: bool = False,
+           retry_until_up: bool = False) -> str:
     return _submit('launch', {
         'task': task.to_yaml_config(),
         'cluster_name': cluster_name,
         'dryrun': dryrun,
         'detach_run': detach_run,
         'no_setup': no_setup,
+        'retry_until_up': retry_until_up,
     })
 
 

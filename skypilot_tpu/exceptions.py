@@ -138,6 +138,10 @@ class JobExitNonZeroError(SkyTpuError):
 
 # --- server / client -------------------------------------------------------
 
+class PermissionDeniedError(SkyTpuError):
+    """The authenticated user's role does not allow this command."""
+
+
 class ApiServerError(SkyTpuError):
     """API server returned an error response."""
 

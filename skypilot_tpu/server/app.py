@@ -12,8 +12,10 @@ import os
 from typing import Any, Dict
 
 import skypilot_tpu
+from skypilot_tpu.server import auth
 from skypilot_tpu.server import executor
 from skypilot_tpu.server import impl  # noqa: F401 — populates REGISTRY
+from skypilot_tpu.server import payloads
 from skypilot_tpu.server import requests_db
 
 DEFAULT_PORT = 46590
@@ -37,10 +39,20 @@ async def _handle_command(request):
     name = request.match_info['name']
     if name not in executor.REGISTRY:
         raise web.HTTPNotFound(text=f'Unknown command {name!r}')
+    auth.check_command_allowed(request, name)
     try:
         payload: Dict[str, Any] = await request.json()
     except json.JSONDecodeError:
         payload = {}
+    payload, errors = payloads.validate(name, payload)
+    if errors:
+        raise web.HTTPBadRequest(
+            text=json.dumps({'errors': errors}),
+            content_type='application/json')
+    user = request.get('user')
+    if user is not None:
+        payload['_user'] = user.name
+        payload['_workspace'] = user.workspace
     schedule = 'short' if name in _SHORT_REQUESTS else 'long'
     request_id = executor.get_executor().schedule(name, payload, schedule)
     return _json_response({'request_id': request_id}, status=202)
@@ -130,10 +142,12 @@ async def _handle_dashboard(request):
         return out or f'<tr><td colspan={len(cols)}>none</td></tr>'
 
     from skypilot_tpu import state as cluster_state
+    # Dashboard is the admin view: show every workspace.
     clusters = [{
-        'name': r['name'], 'status': r['status'].value,
+        'name': r['name'], 'workspace': r['workspace'],
+        'status': r['status'].value,
         'resources': r['resources_str'], 'nodes': r['num_nodes'],
-    } for r in cluster_state.get_clusters()]
+    } for r in cluster_state.get_clusters(all_workspaces=True)]
 
     jobs: list = []
     try:
@@ -172,7 +186,7 @@ async def _handle_dashboard(request):
         '<meta http-equiv="refresh" content="10"></head><body>'
         f'<h1>skypilot-tpu v{skypilot_tpu.__version__}</h1>'
         + _table('Clusters', clusters,
-                 ['name', 'status', 'resources', 'nodes'])
+                 ['name', 'workspace', 'status', 'resources', 'nodes'])
         + _table('Managed jobs', jobs,
                  ['id', 'name', 'status', 'recoveries'])
         + _table('Services', services, ['name', 'status', 'endpoint'])
@@ -185,13 +199,31 @@ async def _handle_health(request):
     return _json_response({
         'status': 'healthy',
         'version': skypilot_tpu.__version__,
+        'api_version': auth.API_VERSION,
         'pid': os.getpid(),
     })
 
 
+async def _recover_orphans(app):
+    """Server (re)start: controllers died with the previous process —
+    restart them in resume mode (reference jobs controller is_resume).
+    Runs in a thread so a slow recovery can't block startup."""
+    import asyncio
+    del app
+
+    def _recover():
+        try:
+            from skypilot_tpu.jobs import scheduler as jobs_scheduler
+            jobs_scheduler.recover_orphaned_controllers()
+        except Exception:  # noqa: BLE001 — never break server startup
+            pass
+    await asyncio.get_running_loop().run_in_executor(None, _recover)
+
+
 def create_app():
     from aiohttp import web
-    app = web.Application()
+    app = web.Application(middlewares=auth.middlewares())
+    app.on_startup.append(_recover_orphans)
     app.router.add_get(f'{API_PREFIX}/health', _handle_health)
     app.router.add_get('/dashboard', _handle_dashboard)
     app.router.add_get(f'{API_PREFIX}/requests', _handle_list_requests)
@@ -231,7 +263,8 @@ class ServerThread:
             async def _start():
                 self._runner = web.AppRunner(create_app())
                 await self._runner.setup()
-                site = web.TCPSite(self._runner, '127.0.0.1', self.port)
+                site = web.TCPSite(self._runner, '127.0.0.1', self.port,
+                                   shutdown_timeout=2.0)
                 await site.start()
                 sock = site._server.sockets[0]  # noqa: SLF001
                 self.port = sock.getsockname()[1]

@@ -1,0 +1,93 @@
+"""API-server middlewares: auth, RBAC, API-version handshake, request id.
+
+Reference analog: sky/server/server.py:174-400 (auth-proxy/basic-auth/
+RBAC/request-ID middlewares) + sky/server/common.py (version handshake).
+Auth is bearer-token (or HTTP Basic password) against the configured
+user list (skypilot_tpu/users); with no users configured the server is
+in open local mode — same default posture as the reference.
+"""
+import base64
+import uuid
+from typing import Optional
+
+from skypilot_tpu import users
+from skypilot_tpu.users import permission
+
+# Bumped on breaking API changes; the server accepts equal versions and
+# (header-less) curl/dashboard traffic, and rejects mismatches with 426.
+API_VERSION = 1
+VERSION_HEADER = 'X-Skytpu-Api-Version'
+
+# Paths every client may hit without auth (health is the handshake).
+_OPEN_PATHS = ('/api/v1/health',)
+
+
+def _token_from_request(request) -> Optional[str]:
+    header = request.headers.get('Authorization', '')
+    if header.startswith('Bearer '):
+        return header[len('Bearer '):].strip()
+    if header.startswith('Basic '):
+        try:
+            decoded = base64.b64decode(header[len('Basic '):]).decode()
+            _, _, password = decoded.partition(':')
+            return password or None
+        except (ValueError, UnicodeDecodeError):
+            return None
+    return None
+
+
+def middlewares():
+    from aiohttp import web
+
+    @web.middleware
+    async def request_id_middleware(request, handler):
+        request['request_uuid'] = uuid.uuid4().hex[:12]
+        response = await handler(request)
+        try:
+            response.headers['X-Skytpu-Request-Id'] = \
+                request['request_uuid']
+        except (AttributeError, RuntimeError):
+            pass  # streamed responses may have frozen headers
+        return response
+
+    @web.middleware
+    async def version_middleware(request, handler):
+        claimed = request.headers.get(VERSION_HEADER)
+        if claimed is not None:
+            try:
+                claimed_int = int(claimed)
+            except ValueError:
+                raise web.HTTPBadRequest(
+                    text=f'Bad {VERSION_HEADER}: {claimed!r}')
+            if claimed_int != API_VERSION:
+                # 426 Upgrade Required: tells old clients (or servers
+                # behind new clients) exactly what to do.
+                raise web.HTTPUpgradeRequired(
+                    text=f'API version mismatch: client {claimed_int}, '
+                         f'server {API_VERSION}. Upgrade the '
+                         f'{"client" if claimed_int < API_VERSION else "server"}.')
+        return await handler(request)
+
+    @web.middleware
+    async def auth_middleware(request, handler):
+        if request.path in _OPEN_PATHS:
+            return await handler(request)
+        user = users.user_for_token(_token_from_request(request))
+        if user is None:
+            raise web.HTTPUnauthorized(
+                text='Missing or invalid API token.',
+                headers={'WWW-Authenticate': 'Bearer'})
+        request['user'] = user
+        return await handler(request)
+
+    return [request_id_middleware, version_middleware, auth_middleware]
+
+
+def check_command_allowed(request, name: str) -> None:
+    """RBAC gate for command POSTs (403 on role violation)."""
+    from aiohttp import web
+    user = request.get('user', users.DEFAULT_USER)
+    if not permission.allowed(user, name):
+        raise web.HTTPForbidden(
+            text=f'User {user.name!r} (role {user.role}) may not run '
+                 f'{name!r}.')

@@ -46,6 +46,14 @@ def _run_in_child(request_id: str, name: str,
     sys.stdout = os.fdopen(1, 'w', buffering=1)
     sys.stderr = os.fdopen(2, 'w', buffering=1)
     try:
+        # Identity/workspace context rides env so deep layers (cluster
+        # registration) can stamp records without plumbing it through.
+        user = payload.pop('_user', None)
+        workspace = payload.pop('_workspace', None)
+        if user:
+            os.environ['SKYTPU_USER'] = str(user)
+        if workspace:
+            os.environ['SKYTPU_WORKSPACE'] = str(workspace)
         fn = REGISTRY[name]
         result = fn(payload)
         json.dumps(result)  # fail loudly here, not in the DB layer
@@ -91,8 +99,11 @@ class Executor:
             record = requests_db.get_request(request_id)
             if record is None or record['status'].is_terminal:
                 return  # cancelled while queued
+            # daemon: a wedged worker must never block process exit
+            # (it is SIGTERMed by mp atexit instead of joined).
             proc = _mp.Process(target=_run_in_child,
-                               args=(request_id, name, payload))
+                               args=(request_id, name, payload),
+                               daemon=True)
             proc.start()
             with self._lock:
                 self._procs[request_id] = proc

@@ -50,7 +50,8 @@ def launch(payload: Dict[str, Any]) -> Dict[str, Any]:
         dryrun=payload.get('dryrun', False),
         stream_logs=True,
         detach_run=payload.get('detach_run', False),
-        no_setup=payload.get('no_setup', False))
+        no_setup=payload.get('no_setup', False),
+        retry_until_up=payload.get('retry_until_up', False))
     return {'job_id': job_id, 'handle': _serialize_handle(handle)}
 
 

@@ -1,0 +1,183 @@
+"""Typed payload schemas for every API command.
+
+Reference analog: sky/server/requests/payloads.py (615 LoC of pydantic
+request bodies). pydantic isn't a dependency here, so this is a compact
+declarative validator: each command declares its fields (type, required,
+default); the server rejects malformed payloads with a 400 listing every
+violation BEFORE anything is scheduled, instead of failing deep inside a
+forked worker.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple, Type, Union
+
+
+@dataclasses.dataclass(frozen=True)
+class Field:
+    """One payload field: `types` is a tuple of accepted python types."""
+    types: Tuple[Type, ...]
+    required: bool = False
+    default: Any = None
+    # For list fields: element type.
+    element: Optional[Type] = None
+    choices: Optional[Tuple[Any, ...]] = None
+
+
+def _opt(*types: Type, **kw) -> Field:
+    return Field(types=types, **kw)
+
+
+def _req(*types: Type, **kw) -> Field:
+    return Field(types=types, required=True, **kw)
+
+
+_TASK = _req(dict)           # task YAML as a config mapping
+_NAME = _req(str)
+_BOOL = _opt(bool, default=False)
+
+
+SCHEMAS: Dict[str, Dict[str, Field]] = {
+    'launch': {
+        'task': _TASK,
+        'cluster_name': _NAME,
+        'dryrun': _BOOL,
+        'detach_run': _BOOL,
+        'no_setup': _BOOL,
+        'retry_until_up': _BOOL,
+        'envs': _opt(dict),
+    },
+    'exec': {
+        'task': _TASK,
+        'cluster_name': _NAME,
+        'detach_run': _BOOL,
+        'envs': _opt(dict),
+    },
+    'status': {
+        'cluster_names': _opt(list, element=str),
+        'refresh': _BOOL,
+    },
+    'start': {
+        'cluster_name': _NAME,
+        'idle_minutes': _opt(int, float),
+        'down': _BOOL,
+    },
+    'stop': {'cluster_name': _NAME},
+    'down': {'cluster_name': _NAME, 'purge': _BOOL},
+    'autostop': {
+        'cluster_name': _NAME,
+        'idle_minutes': _opt(int, float),
+        'down': _BOOL,
+    },
+    'queue': {'cluster_name': _NAME},
+    'cancel': {
+        'cluster_name': _NAME,
+        'job_ids': _opt(list, element=int),
+        'all_jobs': _BOOL,
+    },
+    'logs': {
+        'cluster_name': _NAME,
+        'job_id': _opt(int),
+        'follow': _opt(bool, default=True),
+        'tail': _opt(int, default=0),
+    },
+    'cost_report': {},
+    'check': {},
+    'optimize': {
+        'task': _TASK,
+        'minimize': _opt(str, choices=('COST', 'TIME'), default='COST'),
+        'envs': _opt(dict),
+    },
+    'jobs_launch': {
+        'task': _opt(dict),
+        'pipeline': _opt(list, element=dict),
+        'name': _opt(str),
+        'max_recoveries': _opt(int, default=3),
+        'strategy': _opt(str, choices=('FAILOVER', 'EAGER_NEXT_REGION'),
+                         default='EAGER_NEXT_REGION'),
+        'envs': _opt(dict),
+    },
+    'jobs_queue': {},
+    'jobs_cancel': {
+        'job_ids': _opt(list, element=int),
+        'all_jobs': _BOOL,
+    },
+    'jobs_logs': {
+        'job_id': _req(int),
+        'follow': _opt(bool, default=True),
+    },
+    'serve_up': {
+        'task': _TASK,
+        'service_name': _NAME,
+        'wait_seconds': _opt(int, float, default=0.0),
+    },
+    'serve_down': {'service_name': _NAME, 'purge': _BOOL},
+    'serve_status': {'service_names': _opt(list, element=str)},
+    'serve_logs': {
+        'service_name': _NAME,
+        'follow': _opt(bool, default=True),
+    },
+    'serve_update': {'task': _TASK, 'service_name': _NAME},
+}
+
+# Fields the server itself injects (identity/workspace context); allowed
+# on every command without being declared per-schema.
+_META_FIELDS = frozenset({'_user', '_workspace'})
+
+
+def validate(name: str, payload: Dict[str, Any]
+             ) -> Tuple[Dict[str, Any], List[str]]:
+    """Validate + normalize `payload` against the command's schema.
+
+    Returns (normalized_payload, errors). Unknown fields and type
+    mismatches are errors; optional fields get their defaults filled so
+    the worker sees a complete, typed payload.
+    """
+    schema = SCHEMAS.get(name)
+    if schema is None:
+        return payload, [f'unknown command {name!r}']
+    errors: List[str] = []
+    out: Dict[str, Any] = {}
+    for key in payload:
+        if key not in schema and key not in _META_FIELDS:
+            errors.append(f'unknown field {key!r}')
+    for key, field in schema.items():
+        if key not in payload or payload[key] is None:
+            if field.required:
+                errors.append(f'missing required field {key!r}')
+            else:
+                out[key] = field.default
+            continue
+        value = payload[key]
+        # bool is an int subclass; keep them distinct.
+        if isinstance(value, bool) and bool not in field.types:
+            errors.append(f'field {key!r}: expected '
+                          f'{_names(field.types)}, got bool')
+            continue
+        if not isinstance(value, field.types):
+            errors.append(f'field {key!r}: expected '
+                          f'{_names(field.types)}, got '
+                          f'{type(value).__name__}')
+            continue
+        if field.element is not None and isinstance(value, list):
+            bad = [v for v in value
+                   if not isinstance(v, field.element)
+                   or (isinstance(v, bool) and field.element is not bool)]
+            if bad:
+                errors.append(
+                    f'field {key!r}: every element must be '
+                    f'{field.element.__name__}')
+                continue
+        if field.choices is not None and value not in field.choices:
+            errors.append(f'field {key!r}: must be one of '
+                          f'{list(field.choices)}')
+            continue
+        out[key] = value
+    for key in _META_FIELDS:
+        if key in payload:
+            out[key] = payload[key]
+    return out, errors
+
+
+def _names(types: Tuple[Type, ...]) -> str:
+    return '|'.join(t.__name__ for t in types)

@@ -21,6 +21,19 @@ _conn: Optional[sqlite3.Connection] = None
 _conn_path: Optional[str] = None
 
 
+def _after_fork_in_child() -> None:
+    """Fresh lock + connection in forked children: the parent is
+    multi-threaded, so the inherited lock may be held by a thread that
+    does not exist in the child."""
+    global _lock, _conn, _conn_path
+    _lock = threading.Lock()
+    _conn = None
+    _conn_path = None
+
+
+os.register_at_fork(after_in_child=_after_fork_in_child)
+
+
 class RequestStatus(enum.Enum):
     PENDING = 'PENDING'
     RUNNING = 'RUNNING'

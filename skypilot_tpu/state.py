@@ -16,6 +16,16 @@ from typing import Any, Dict, List, Optional
 from skypilot_tpu.utils import paths
 
 _lock = threading.Lock()
+
+
+def _after_fork_in_child() -> None:
+    global _lock, _conn, _conn_path
+    _lock = threading.Lock()
+    _conn = None
+    _conn_path = None
+
+
+os.register_at_fork(after_in_child=_after_fork_in_child)
 _conn: Optional[sqlite3.Connection] = None
 _conn_path: Optional[str] = None
 
@@ -61,6 +71,7 @@ def _create_tables(conn: sqlite3.Connection) -> None:
             status TEXT,
             autostop_json TEXT,
             owner TEXT,
+            workspace TEXT DEFAULT 'default',
             cluster_hash TEXT,
             resources_json TEXT,
             num_nodes INTEGER,
@@ -82,6 +93,12 @@ def _create_tables(conn: sqlite3.Connection) -> None:
             key TEXT PRIMARY KEY,
             value TEXT
         )""")
+    # Migration for pre-workspace DBs.
+    cols = [r[1] for r in conn.execute('PRAGMA table_info(clusters)')]
+    if 'workspace' not in cols:
+        conn.execute(
+            "ALTER TABLE clusters ADD COLUMN workspace TEXT "
+            "DEFAULT 'default'")
     conn.commit()
 
 
@@ -103,8 +120,9 @@ def add_or_update_cluster(cluster_name: str, handle: Any,
         conn.execute(
             """INSERT INTO clusters
                (name, launched_at, handle, last_use, status, autostop_json,
-                owner, cluster_hash, resources_json, num_nodes, to_down)
-               VALUES (?,?,?,?,?,?,?,?,?,?,?)
+                owner, workspace, cluster_hash, resources_json, num_nodes,
+                to_down)
+               VALUES (?,?,?,?,?,?,?,?,?,?,?,?)
                ON CONFLICT(name) DO UPDATE SET
                  handle=excluded.handle, last_use=excluded.last_use,
                  status=excluded.status,
@@ -115,7 +133,9 @@ def add_or_update_cluster(cluster_name: str, handle: Any,
             (cluster_name, launched_at, pickle.dumps(handle),
              str(int(now)), status.value,
              json.dumps(autostop) if autostop else None,
-             os.environ.get('USER', 'unknown'), cluster_hash,
+             os.environ.get('SKYTPU_USER') or os.environ.get(
+                 'USER', 'unknown'),
+             active_workspace(), cluster_hash,
              requested_resources_str, num_nodes, 0))
         conn.commit()
 
@@ -180,9 +200,16 @@ def remove_cluster(cluster_name: str, terminate: bool) -> None:
         conn.commit()
 
 
+def active_workspace() -> str:
+    """The workspace this request acts in (set by the API server from
+    the authenticated user; 'default' in open local mode)."""
+    return os.environ.get('SKYTPU_WORKSPACE', 'default')
+
+
 def _row_to_record(row) -> Dict[str, Any]:
     (name, launched_at, handle_blob, last_use, status, autostop_json,
-     owner, cluster_hash, resources_json, num_nodes, to_down) = row
+     owner, workspace, cluster_hash, resources_json, num_nodes,
+     to_down) = row
     return {
         'name': name,
         'launched_at': launched_at,
@@ -191,6 +218,7 @@ def _row_to_record(row) -> Dict[str, Any]:
         'status': ClusterStatus(status),
         'autostop': json.loads(autostop_json) if autostop_json else None,
         'owner': owner,
+        'workspace': workspace,
         'cluster_hash': cluster_hash,
         'resources_str': resources_json,
         'num_nodes': num_nodes,
@@ -199,7 +227,8 @@ def _row_to_record(row) -> Dict[str, Any]:
 
 
 _COLS = ('name, launched_at, handle, last_use, status, autostop_json, '
-         'owner, cluster_hash, resources_json, num_nodes, to_down')
+         'owner, workspace, cluster_hash, resources_json, num_nodes, '
+         'to_down')
 
 
 def get_cluster_from_name(cluster_name: str) -> Optional[Dict[str, Any]]:
@@ -209,10 +238,18 @@ def get_cluster_from_name(cluster_name: str) -> Optional[Dict[str, Any]]:
     return _row_to_record(row) if row else None
 
 
-def get_clusters() -> List[Dict[str, Any]]:
+def get_clusters(all_workspaces: bool = False) -> List[Dict[str, Any]]:
+    """Clusters in the active workspace (all of them when asked)."""
     conn = _get_conn()
-    rows = conn.execute(
-        f'SELECT {_COLS} FROM clusters ORDER BY launched_at DESC').fetchall()
+    if all_workspaces:
+        rows = conn.execute(
+            f'SELECT {_COLS} FROM clusters '
+            'ORDER BY launched_at DESC').fetchall()
+    else:
+        rows = conn.execute(
+            f'SELECT {_COLS} FROM clusters WHERE workspace=? '
+            'ORDER BY launched_at DESC',
+            (active_workspace(),)).fetchall()
     return [_row_to_record(r) for r in rows]
 
 

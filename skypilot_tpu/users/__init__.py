@@ -1,0 +1,86 @@
+"""Users, roles, and workspaces — API-server multi-tenancy.
+
+Reference analog: sky/users/permission.py:8 (casbin RBAC enforcer),
+sky/workspaces/. Ours is config-driven (no casbin dependency): the
+`api_server.users` list in ~/.skytpu/config.yaml declares users with a
+token, role, and optional workspace; role policy lives in
+users/permission.py. With no users configured the server runs in open
+local mode as user 'default' (admin), matching the reference's
+no-auth-proxy default.
+
+    api_server:
+      auth: true
+      users:
+        - name: alice
+          token: secret-a
+          role: admin
+        - name: bob
+          token: secret-b
+          role: user
+          workspace: team-x
+        - name: carol
+          token: secret-c
+          role: viewer
+"""
+import dataclasses
+import hmac
+from typing import Dict, List, Optional
+
+ROLE_ADMIN = 'admin'
+ROLE_USER = 'user'
+ROLE_VIEWER = 'viewer'
+ROLES = (ROLE_ADMIN, ROLE_USER, ROLE_VIEWER)
+
+DEFAULT_WORKSPACE = 'default'
+
+
+@dataclasses.dataclass(frozen=True)
+class User:
+    name: str
+    role: str = ROLE_ADMIN
+    workspace: str = DEFAULT_WORKSPACE
+    token: Optional[str] = None
+
+
+DEFAULT_USER = User(name='default', role=ROLE_ADMIN)
+
+
+def configured_users() -> List[User]:
+    from skypilot_tpu import config as config_lib
+    raw = config_lib.get_nested(('api_server', 'users'), default=None)
+    users: List[User] = []
+    for entry in raw or []:
+        if not isinstance(entry, dict) or 'name' not in entry:
+            continue
+        role = entry.get('role', ROLE_USER)
+        if role not in ROLES:
+            role = ROLE_VIEWER  # unknown role: least privilege
+        users.append(User(
+            name=str(entry['name']), role=role,
+            workspace=str(entry.get('workspace', DEFAULT_WORKSPACE)),
+            token=entry.get('token')))
+    return users
+
+
+def auth_required() -> bool:
+    from skypilot_tpu import config as config_lib
+    if config_lib.get_nested(('api_server', 'auth'), default=False):
+        return True
+    return bool(configured_users())
+
+
+def user_for_token(token: Optional[str]) -> Optional[User]:
+    """Token → User; None when auth is on and the token is unknown."""
+    if not auth_required():
+        return DEFAULT_USER
+    if not token:
+        return None
+    for user in configured_users():
+        if user.token is not None and hmac.compare_digest(
+                user.token, token):
+            return user
+    return None
+
+
+def users_by_name() -> Dict[str, User]:
+    return {u.name: u for u in configured_users()}

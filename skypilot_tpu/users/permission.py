@@ -1,0 +1,39 @@
+"""Role policy: which API commands each role may execute.
+
+Reference analog: sky/users/permission.py (casbin model + policy; the
+reference's policy boils down to the same read/write/admin split).
+"""
+from typing import FrozenSet
+
+from skypilot_tpu import users
+
+# Read-only commands: cluster/job/service introspection.
+READ_COMMANDS: FrozenSet[str] = frozenset({
+    'status', 'queue', 'cost_report', 'check', 'optimize', 'logs',
+    'jobs_queue', 'jobs_logs', 'serve_status', 'serve_logs',
+})
+
+# Mutating commands available to ROLE_USER and above.
+WRITE_COMMANDS: FrozenSet[str] = frozenset({
+    'launch', 'exec', 'start', 'stop', 'down', 'autostop', 'cancel',
+    'jobs_launch', 'jobs_cancel', 'serve_up', 'serve_down',
+    'serve_update',
+})
+
+
+def allowed(user: 'users.User', command: str) -> bool:
+    if user.role == users.ROLE_ADMIN:
+        return True
+    if user.role == users.ROLE_USER:
+        return command in READ_COMMANDS or command in WRITE_COMMANDS
+    if user.role == users.ROLE_VIEWER:
+        return command in READ_COMMANDS
+    return False
+
+
+def check(user: 'users.User', command: str) -> None:
+    from skypilot_tpu import exceptions
+    if not allowed(user, command):
+        raise exceptions.PermissionDeniedError(
+            f'User {user.name!r} (role {user.role}) may not run '
+            f'{command!r}.')
