@@ -171,7 +171,9 @@ class Ec2Client:
 
     def call(self, action: str, params: Optional[Dict[str, str]] = None
              ) -> Dict[str, Any]:
-        creds = self._creds or load_credentials()
+        if self._creds is None:
+            self._creds = load_credentials()
+        creds = self._creds
         if creds is None:
             raise exceptions.ProvisionError(
                 'AWS credentials not found; set AWS_ACCESS_KEY_ID / '

@@ -26,7 +26,7 @@ class Backend(Generic[H]):
     def provision(self, task, to_provision: Optional[
             resources_lib.Resources], *, dryrun: bool = False,
             stream_logs: bool = True, cluster_name: str,
-            retry_until_up: bool = False) -> Optional[H]:
+            ) -> Optional[H]:
         raise NotImplementedError
 
     def sync_workdir(self, handle: H, workdir: str) -> None:

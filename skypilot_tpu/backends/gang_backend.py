@@ -141,8 +141,8 @@ class GangBackend(backend_lib.Backend[ClusterHandle]):
     # --- provision ----------------------------------------------------------
 
     def provision(self, task, to_provision, *, dryrun=False,
-                  stream_logs=True, cluster_name: str,
-                  retry_until_up=False) -> Optional[ClusterHandle]:
+                  stream_logs=True,
+                  cluster_name: str) -> Optional[ClusterHandle]:
         common_utils.check_cluster_name_is_valid(cluster_name)
         if dryrun:
             return None

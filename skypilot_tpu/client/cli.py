@@ -83,9 +83,11 @@ def cli():
 @click.option('--down', is_flag=True,
               help='Autodown the cluster when the job finishes.')
 @click.option('--idle-minutes-to-autostop', '-i', type=int, default=None)
+@click.option('--retry-until-up', '-r', is_flag=True,
+              help='Keep retrying provisioning until capacity is found.')
 def launch(entrypoint, cluster, name, num_nodes, accelerators, cloud,
            workdir, env, detach_run, dryrun, no_setup, down,
-           idle_minutes_to_autostop):
+           idle_minutes_to_autostop, retry_until_up):
     """Launch a task (provision + setup + run)."""
     from skypilot_tpu.client import sdk
     from skypilot_tpu.utils import common_utils
@@ -101,7 +103,8 @@ def launch(entrypoint, cluster, name, num_nodes, accelerators, cloud,
     cluster = cluster or common_utils.generate_cluster_name()
     click.echo(f'Launching on cluster {cluster!r}...')
     request_id = sdk.launch(task, cluster, dryrun=dryrun,
-                            detach_run=detach_run, no_setup=no_setup)
+                            detach_run=detach_run, no_setup=no_setup,
+                            retry_until_up=retry_until_up)
     _run_and_stream(request_id)
 
 

@@ -8,6 +8,8 @@ provision_with_retries drives this inside the backend; ours splits it so
 the optimizer stays the single source of placement truth).
 """
 import enum
+import os
+import time
 from typing import List, Optional, Tuple
 
 from skypilot_tpu import dag as dag_lib
@@ -42,9 +44,15 @@ def launch(task_or_dag, *, cluster_name: str,
            detach_run: bool = False, optimize_target=None,
            no_setup: bool = False,
            blocked_resources: Optional[List] = None,
+           retry_until_up: bool = False,
            backend: Optional[gang_backend.GangBackend] = None
            ) -> Tuple[Optional[int], Optional[gang_backend.ClusterHandle]]:
-    """Provision (if needed) + sync + run. Returns (job_id, handle)."""
+    """Provision (if needed) + sync + run. Returns (job_id, handle).
+
+    retry_until_up: when every placement candidate is exhausted (cloud
+    stockout), wait and re-run the whole failover sweep instead of
+    failing (reference `sky launch --retry-until-up`).
+    """
     dag = _as_dag(task_or_dag)
     if len(dag.tasks) != 1:
         raise exceptions.InvalidDagError(
@@ -60,32 +68,22 @@ def launch(task_or_dag, *, cluster_name: str,
     reuse = (existing is not None and existing['handle'] is not None and
              existing['status'] == state.ClusterStatus.UP)
 
+    retry_gap = float(os.environ.get('SKYTPU_RETRY_UNTIL_UP_GAP', '300'))
     handle = None
-    blocked: List = list(blocked_resources or [])
-    for attempt in range(_MAX_CLOUD_FAILOVERS):
-        if reuse:
-            to_provision = None
-        else:
-            optimizer_lib.Optimizer.optimize(
-                dag, minimize=optimize_target, blocked_resources=blocked,
-                quiet=(dryrun or not stream_logs))
-            to_provision = task.best_resources
-        if dryrun:
-            return None, None
+    while handle is None:
+        blocked: List = list(blocked_resources or [])
         try:
-            handle = backend.provision(
-                task, to_provision, dryrun=dryrun,
-                stream_logs=stream_logs, cluster_name=cluster_name)
-            break
-        except exceptions.ResourcesUnavailableError as e:
-            if reuse or to_provision is None:
+            handle, early = _provision_with_failover(
+                dag, task, backend, cluster_name, reuse, blocked,
+                optimize_target, dryrun, stream_logs)
+            if early:
+                return None, None  # dryrun
+        except exceptions.ResourcesUnavailableError:
+            if not retry_until_up:
                 raise
-            blocked.append(to_provision)
-            if attempt == _MAX_CLOUD_FAILOVERS - 1:
-                raise exceptions.ResourcesUnavailableError(
-                    f'Exhausted placement candidates for {task}.',
-                    failover_history=e.failover_history) from e
-            continue
+            print(f'[provision] all candidates exhausted; retrying in '
+                  f'{retry_gap:.0f}s (--retry-until-up)', flush=True)
+            time.sleep(retry_gap)
     assert handle is not None
 
     if task.workdir:
@@ -99,6 +97,37 @@ def launch(task_or_dag, *, cluster_name: str,
         job_id = backend.execute(handle, task, detach_run=detach_run,
                                  include_setup=not no_setup)
     return job_id, handle
+
+
+def _provision_with_failover(dag, task, backend, cluster_name: str,
+                             reuse: bool, blocked: List, optimize_target,
+                             dryrun: bool, stream_logs: bool):
+    """One failover sweep: optimize → provision, blocklisting failed
+    candidates, until success or candidates run out. Returns
+    (handle, dryrun_early_exit)."""
+    for attempt in range(_MAX_CLOUD_FAILOVERS):
+        if reuse:
+            to_provision = None
+        else:
+            optimizer_lib.Optimizer.optimize(
+                dag, minimize=optimize_target, blocked_resources=blocked,
+                quiet=(dryrun or not stream_logs))
+            to_provision = task.best_resources
+        if dryrun:
+            return None, True
+        try:
+            return backend.provision(
+                task, to_provision, dryrun=dryrun,
+                stream_logs=stream_logs, cluster_name=cluster_name), False
+        except exceptions.ResourcesUnavailableError as e:
+            if reuse or to_provision is None:
+                raise
+            blocked.append(to_provision)
+            if attempt == _MAX_CLOUD_FAILOVERS - 1:
+                raise exceptions.ResourcesUnavailableError(
+                    f'Exhausted placement candidates for {task}.',
+                    failover_history=e.failover_history) from e
+    raise AssertionError('unreachable')
 
 
 def exec_cmd(task_or_dag, *, cluster_name: str, dryrun: bool = False,

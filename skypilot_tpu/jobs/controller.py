@@ -8,6 +8,7 @@ recover via the strategy -> terminal state -> terminate the cluster.
 import argparse
 import logging
 import os
+import re
 import time
 import traceback
 from typing import Any, Dict, Optional
@@ -25,8 +26,9 @@ _POLL_INTERVAL_SECONDS = float(
 
 class JobsController:
 
-    def __init__(self, managed_job_id: int) -> None:
+    def __init__(self, managed_job_id: int, resume: bool = False) -> None:
         self.job_id = managed_job_id
+        self.resume = resume
         record = jobs_state.get_job(managed_job_id)
         assert record is not None, managed_job_id
         self.record = record
@@ -41,8 +43,12 @@ class JobsController:
         else:
             self.tasks = [task_lib.Task.from_yaml_config(cfg)]
         self.task = self.tasks[0]
-        self.base_cluster_name = (record['cluster_name'] or
-                                  f'tsky-jobs-{managed_job_id}')
+        stored = record['cluster_name'] or f'tsky-jobs-{managed_job_id}'
+        if len(self.tasks) > 1:
+            # The persisted name may be a per-stage name ('<base>-s<N>',
+            # written mid-run); recover the base for stage naming.
+            stored = re.sub(r'-s\d+$', '', stored)
+        self.base_cluster_name = stored
         self.cluster_name = self.base_cluster_name
         jobs_state.set_cluster_name(managed_job_id,
                                     self.base_cluster_name)
@@ -112,8 +118,24 @@ class JobsController:
             if record and record['status'].is_terminal:
                 self._cleanup()
 
+    def _resume_stage(self) -> int:
+        """Stage a crashed controller was on, from the persisted
+        cluster name (pipelines suffix -s<stage>)."""
+        current = self.record.get('cluster_name') or ''
+        prefix = f'{self.base_cluster_name}-s'
+        if len(self.tasks) > 1 and current.startswith(prefix):
+            try:
+                return min(int(current[len(prefix):]),
+                           len(self.tasks) - 1)
+            except ValueError:
+                return 0
+        return 0
+
     def _run(self) -> None:
+        first_stage = self._resume_stage() if self.resume else 0
         for stage, task in enumerate(self.tasks):
+            if stage < first_stage:
+                continue
             self.task = task
             self.cluster_name = (self.base_cluster_name if
                                  len(self.tasks) == 1 else
@@ -122,7 +144,8 @@ class JobsController:
             self.strategy = recovery_strategy.StrategyExecutor.make(
                 self.record['strategy'], task, self.cluster_name)
             final = stage == len(self.tasks) - 1
-            done = self._run_one_task(final=final)
+            done = self._run_one_task(
+                final=final, resume=self.resume and stage == first_stage)
             if not done:
                 return  # terminal failure/cancel already recorded
             if not final:
@@ -130,18 +153,40 @@ class JobsController:
                 self._cleanup()
         # _run_one_task set SUCCEEDED on the last stage.
 
-    def _run_one_task(self, final: bool = True) -> bool:
+    def _run_one_task(self, final: bool = True,
+                      resume: bool = False) -> bool:
         """Run self.task to completion. True iff it succeeded; the
-        managed job only turns SUCCEEDED on the final stage."""
-        jobs_state.set_status(self.job_id,
-                              jobs_state.ManagedJobStatus.STARTING)
-        try:
-            cluster_job_id = self.strategy.launch()
-        except exceptions.ResourcesUnavailableError as e:
-            jobs_state.set_status(
-                self.job_id, jobs_state.ManagedJobStatus.FAILED_NO_RESOURCE,
-                failure_reason=str(e))
-            return False
+        managed job only turns SUCCEEDED on the final stage.
+
+        resume: the previous controller crashed mid-flight (reference
+        is_resume, sky/jobs/controller.py:119) — reattach to the live
+        cluster job instead of relaunching when possible."""
+        cluster_job_id = None
+        if resume:
+            cluster_job_id = self.record.get('cluster_job_id')
+            if cluster_job_id is not None and self._cluster_alive():
+                logger.info('Resuming: monitoring existing cluster job '
+                            '%s on %s', cluster_job_id, self.cluster_name)
+            elif self.record['status'].is_terminal:
+                return self.record['status'] ==                     jobs_state.ManagedJobStatus.SUCCEEDED
+            else:
+                # Cluster gone while the controller was down: recover.
+                jobs_state.set_status(
+                    self.job_id, jobs_state.ManagedJobStatus.RECOVERING)
+                cluster_job_id = self.strategy.recover()
+                jobs_state.set_cluster_job_id(self.job_id, cluster_job_id)
+        if cluster_job_id is None:
+            jobs_state.set_status(self.job_id,
+                                  jobs_state.ManagedJobStatus.STARTING)
+            try:
+                cluster_job_id = self.strategy.launch()
+            except exceptions.ResourcesUnavailableError as e:
+                jobs_state.set_status(
+                    self.job_id,
+                    jobs_state.ManagedJobStatus.FAILED_NO_RESOURCE,
+                    failure_reason=str(e))
+                return False
+            jobs_state.set_cluster_job_id(self.job_id, cluster_job_id)
         jobs_state.set_status(self.job_id,
                               jobs_state.ManagedJobStatus.RUNNING)
 
@@ -178,6 +223,7 @@ class JobsController:
                 jobs_state.set_status(
                     self.job_id, jobs_state.ManagedJobStatus.RECOVERING)
                 cluster_job_id = self.strategy.recover()
+                jobs_state.set_cluster_job_id(self.job_id, cluster_job_id)
                 jobs_state.set_status(self.job_id,
                                       jobs_state.ManagedJobStatus.RUNNING)
             # Cancellation request from the user?
@@ -204,14 +250,15 @@ class JobsController:
             pass
 
 
-def start(managed_job_id: int) -> None:
+def start(managed_job_id: int, resume: bool = False) -> None:
     """Entry for the forked controller process."""
     jobs_state.set_controller_pid(managed_job_id, os.getpid())
-    JobsController(managed_job_id).run()
+    JobsController(managed_job_id, resume=resume).run()
 
 
 if __name__ == '__main__':
     parser = argparse.ArgumentParser()
     parser.add_argument('--job-id', type=int, required=True)
+    parser.add_argument('--resume', action='store_true')
     args = parser.parse_args()
-    start(args.job_id)
+    start(args.job_id, resume=args.resume)

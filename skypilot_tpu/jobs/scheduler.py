@@ -16,22 +16,67 @@ _MAX_CONCURRENT_LAUNCHES = int(
     os.environ.get('SKYTPU_JOBS_MAX_CONCURRENT_LAUNCHES', '8'))
 
 
-def _start_controller(job_id: int) -> None:
+def _start_controller(job_id: int, resume: bool = False) -> None:
     log_path = jobs_state.controller_log_path(job_id)
+    argv = [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
+            '--job-id', str(job_id)]
+    if resume:
+        argv.append('--resume')
     with open(log_path, 'ab') as log_f:
         proc = subprocess.Popen(
-            [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
-             '--job-id', str(job_id)],
-            stdout=log_f, stderr=log_f,
+            argv, stdout=log_f, stderr=log_f,
             start_new_session=True,
             env=dict(os.environ, JAX_PLATFORMS='cpu'))
     jobs_state.set_controller_pid(job_id, proc.pid)
+
+
+def _pid_alive(pid: Optional[int]) -> bool:
+    """Controller-process liveness via /proc: a zombie (died, not yet
+    reaped — e.g. our own Popen child) counts as DEAD, and the check
+    does not depend on signal permissions the way os.kill(pid, 0)
+    does."""
+    if not pid or pid < 0:
+        return False
+    try:
+        os.waitpid(pid, os.WNOHANG)  # reap if it was our child
+    except ChildProcessError:
+        pass
+    try:
+        with open(f'/proc/{pid}/stat', 'rb') as f:
+            stat = f.read()
+    except OSError:
+        return False
+    state = stat.rsplit(b')', 1)[-1].split()
+    return bool(state) and state[0] != b'Z'
+
+
+def recover_orphaned_controllers() -> int:
+    """Restart controllers for non-terminal jobs whose controller
+    process died (API-server crash, OOM, operator kill). The restarted
+    controller runs the resume path: reattach to the live cluster job,
+    or recover the cluster if it is gone (reference is_resume,
+    sky/jobs/controller.py:119). Returns number restarted."""
+    restarted = 0
+    for job in jobs_state.get_jobs():
+        status = job['status']
+        if status.is_terminal or \
+                status == jobs_state.ManagedJobStatus.PENDING:
+            continue
+        if _pid_alive(job['controller_pid']):
+            continue
+        if not jobs_state.try_claim_orphan(job['job_id'],
+                                           job['controller_pid']):
+            continue  # another process is restarting it
+        _start_controller(job['job_id'], resume=True)
+        restarted += 1
+    return restarted
 
 
 def maybe_schedule_next_jobs() -> int:
     """Start controllers for PENDING jobs up to the cap; returns number
     started. Safe under concurrent callers (forked API workers): the
     PENDING->SUBMITTED claim is an atomic conditional UPDATE."""
+    recover_orphaned_controllers()
     started = 0
     in_flight = jobs_state.num_launching_jobs()
     for job in jobs_state.get_jobs([jobs_state.ManagedJobStatus.PENDING]):

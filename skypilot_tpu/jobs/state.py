@@ -84,8 +84,14 @@ def _get_conn() -> sqlite3.Connection:
                     max_recoveries INTEGER DEFAULT 3,
                     failure_reason TEXT,
                     controller_pid INTEGER,
-                    strategy TEXT DEFAULT 'EAGER_NEXT_REGION'
+                    strategy TEXT DEFAULT 'EAGER_NEXT_REGION',
+                    cluster_job_id INTEGER
                 )""")
+            cols = [r[1] for r in _conn.execute(
+                'PRAGMA table_info(managed_jobs)')]
+            if 'cluster_job_id' not in cols:  # pre-resume DBs
+                _conn.execute('ALTER TABLE managed_jobs ADD COLUMN '
+                              'cluster_job_id INTEGER')
             _conn.commit()
             _conn_path = path
         return _conn
@@ -160,6 +166,38 @@ def try_claim_pending(job_id: int) -> bool:
         return cur.rowcount == 1
 
 
+def try_claim_orphan(job_id: int, dead_pid: Optional[int]) -> bool:
+    """Atomically claim an orphaned job for controller restart: only
+    one caller wins by clearing the dead pid (cross-process guard
+    against duplicate resumed controllers)."""
+    conn = _get_conn()
+    with _lock:
+        if dead_pid is None:
+            cur = conn.execute(
+                'UPDATE managed_jobs SET controller_pid=-1 '
+                'WHERE job_id=? AND controller_pid IS NULL', (job_id,))
+        else:
+            cur = conn.execute(
+                'UPDATE managed_jobs SET controller_pid=-1 '
+                'WHERE job_id=? AND controller_pid=?',
+                (job_id, dead_pid))
+        conn.commit()
+        return cur.rowcount == 1
+
+
+def set_cluster_job_id(job_id: int,
+                       cluster_job_id: Optional[int]) -> None:
+    """Remember the on-cluster job id so a restarted controller can
+    resume monitoring instead of relaunching (reference is_resume,
+    sky/jobs/controller.py:119)."""
+    conn = _get_conn()
+    with _lock:
+        conn.execute(
+            'UPDATE managed_jobs SET cluster_job_id=? WHERE job_id=?',
+            (cluster_job_id, job_id))
+        conn.commit()
+
+
 def set_controller_pid(job_id: int, pid: int) -> None:
     conn = _get_conn()
     with _lock:
@@ -184,13 +222,13 @@ def bump_recovery_count(job_id: int) -> int:
 
 _COLS = ('job_id, name, task_yaml, cluster_name, status, submitted_at, '
          'started_at, ended_at, recovery_count, max_recoveries, '
-         'failure_reason, controller_pid, strategy')
+         'failure_reason, controller_pid, strategy, cluster_job_id')
 
 
 def _row_to_record(row) -> Dict[str, Any]:
     (job_id, name, task_yaml, cluster_name, status, submitted_at,
      started_at, ended_at, recovery_count, max_recoveries, failure_reason,
-     controller_pid, strategy) = row
+     controller_pid, strategy, cluster_job_id) = row
     return {
         'job_id': job_id,
         'name': name,
@@ -204,6 +242,7 @@ def _row_to_record(row) -> Dict[str, Any]:
         'max_recoveries': max_recoveries,
         'failure_reason': failure_reason,
         'controller_pid': controller_pid,
+        'cluster_job_id': cluster_job_id,
         'strategy': strategy,
     }
 

@@ -277,16 +277,24 @@ def terminate_instances(cluster_name_on_cloud: str,
         client.call('TerminateInstances', aws_adaptor.flat_params(
             'InstanceId', ids))
     # Best-effort SG cleanup (fails with DependencyViolation until
-    # instances fully terminate; harmless to leave behind).
+    # instances fully terminate; harmless to leave behind). Scoped to
+    # the configured VPC when known, and per-group so one failure does
+    # not leak the others.
     name = f'skytpu-{cluster_name_on_cloud}'
+    params = {'Filter.1.Name': 'group-name', 'Filter.1.Value.1': name}
+    if provider_config.get('vpc_id'):
+        params['Filter.2.Name'] = 'vpc-id'
+        params['Filter.2.Value.1'] = provider_config['vpc_id']
     try:
-        resp = client.call('DescribeSecurityGroups', {
-            'Filter.1.Name': 'group-name', 'Filter.1.Value.1': name})
-        for group in resp.get('securityGroupInfo') or []:
+        resp = client.call('DescribeSecurityGroups', params)
+    except aws_adaptor.AwsApiError:
+        return
+    for group in resp.get('securityGroupInfo') or []:
+        try:
             client.call('DeleteSecurityGroup',
                         {'GroupId': group['groupId']})
-    except aws_adaptor.AwsApiError:
-        pass
+        except aws_adaptor.AwsApiError:
+            pass
 
 
 def query_instances(cluster_name_on_cloud: str,

@@ -101,6 +101,7 @@ def post_provision_runtime_setup(provider_name: str, cluster_name: str,
                   encoding='utf-8') as f:
             json.dump(topology, f, indent=1)
     else:
+        setup_runtime_dependencies(runners)
         _ship_package(runners)
         payload = shlex.quote(json.dumps(topology))
         for runner in runners:
@@ -114,6 +115,43 @@ def post_provision_runtime_setup(provider_name: str, cluster_name: str,
         raise exceptions.ClusterSetUpError(
             f'Failed to start skylet on head: {err or out}')
     return rt
+
+
+# Runtime the framework needs on every host. TPU-VM images ship
+# python3+jax; plain VMs (controllers, CPU workers) may lack jax — the
+# probe installs only what is missing, so reprovision is cheap
+# (reference instance_setup.py:206 setup_runtime_on_cluster, with its
+# retry loop around flaky first-boot package managers).
+_RUNTIME_PROBE = 'python3 -c "import sys; assert sys.version_info >= (3, 9)"'
+_RUNTIME_INSTALL = (
+    'python3 -c "import jax" 2>/dev/null || '
+    'pip3 install --quiet "jax[cpu]" pyyaml')
+_SETUP_RETRIES = 3
+_SETUP_RETRY_GAP_SECONDS = 10.0
+
+
+def setup_runtime_dependencies(
+        runners: List[runner_lib.CommandRunner],
+        retries: int = _SETUP_RETRIES,
+        retry_gap: float = _SETUP_RETRY_GAP_SECONDS) -> None:
+    """Probe + install the host runtime with retries: first boots race
+    cloud-init/apt locks, so one failed install must not fail the whole
+    provision."""
+    for runner in runners:
+        last = ''
+        for attempt in range(retries):
+            rc, out, err = runner.run(
+                f'{_RUNTIME_PROBE} && ({_RUNTIME_INSTALL})',
+                require_outputs=True)
+            if rc == 0:
+                break
+            last = err or out
+            if attempt < retries - 1:
+                time.sleep(retry_gap)
+        else:
+            raise exceptions.ClusterSetUpError(
+                f'Runtime setup failed on {runner.node_id} after '
+                f'{retries} attempts: {last}')
 
 
 def _ship_package(runners: List[runner_lib.CommandRunner]) -> None:

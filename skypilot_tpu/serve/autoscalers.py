@@ -87,7 +87,64 @@ class RequestRateAutoscaler(Autoscaler):
         return ScalingDecision(num_total, 'at target')
 
 
+@dataclasses.dataclass
+class MixedScalingDecision:
+    """Spot + on-demand targets (reference FallbackRequestRateAutoscaler,
+    autoscalers.py:557)."""
+    target_spot: int
+    target_ondemand: int
+    reason: str = ''
+
+    @property
+    def target_replicas(self) -> int:
+        return self.target_spot + self.target_ondemand
+
+
+class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
+    """Request-rate scaling over a spot fleet with on-demand fallback.
+
+    The traffic-derived target is served by spot. On top of that:
+    - base_ondemand_fallback_replicas are ALWAYS on-demand (a safety
+      floor that survives any spot stockout);
+    - with dynamic_ondemand_fallback, spot capacity lost to preemption
+      is covered by extra on-demand replicas until spot recovers.
+    """
+
+    def decide_mixed(self, num_ready_spot: int, num_spot: int,
+                     num_ondemand: int,
+                     qps: Optional[float]) -> MixedScalingDecision:
+        base = self.spec.base_ondemand_fallback_replicas
+        dynamic = self.spec.dynamic_ondemand_fallback
+        current = num_spot + num_ondemand
+        # Hysteresis-filtered total target over the whole fleet.
+        total = self.decide(num_ready_spot + num_ondemand, current,
+                            qps).target_replicas
+        if total == current:
+            # Hold: no resize is due (at target, or a scale is pending
+            # its hysteresis delay) — keep the pools as they are, only
+            # covering unready spot with on-demand if dynamic.
+            spot_target, ondemand_target = num_spot, num_ondemand
+            if dynamic:
+                shortfall = max(0, num_spot - num_ready_spot)
+                ondemand_target = min(max(total, num_ondemand),
+                                      num_ondemand + shortfall)
+        else:
+            spot_target = max(0, total - base)
+            ondemand_target = min(base, total)
+            if dynamic:
+                # Cover the spot shortfall (requested minus ready) with
+                # on-demand; shrinks automatically as spot recovers.
+                shortfall = max(0, spot_target - num_ready_spot)
+                ondemand_target = min(total, ondemand_target + shortfall)
+        return MixedScalingDecision(
+            spot_target, ondemand_target,
+            f'total={total} spot_ready={num_ready_spot}')
+
+
 def make_autoscaler(spec: spec_lib.ServiceSpec) -> Autoscaler:
+    if spec.use_spot and (spec.base_ondemand_fallback_replicas > 0
+                          or spec.dynamic_ondemand_fallback):
+        return FallbackRequestRateAutoscaler(spec)
     if spec.max_replicas is not None and \
             spec.max_replicas > spec.min_replicas and \
             spec.target_qps_per_replica is not None:

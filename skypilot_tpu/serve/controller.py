@@ -75,26 +75,59 @@ class ServeController:
                 if r['status'] not in (
                     serve_state.ReplicaStatus.SHUTTING_DOWN,
                     serve_state.ReplicaStatus.FAILED)]
-        decision = self.autoscaler.decide(
-            len(ready), len(live), self.lb.tracker.qps())
-        if decision.target_replicas > len(live):
-            self.manager.scale_up(decision.target_replicas - len(live))
-        elif decision.target_replicas < len(live):
-            # Prefer terminating not-ready replicas, then highest
-            # (newest, least-warm) ids.
-            victims = sorted(
-                live,
-                key=lambda r: (
-                    r['status'] == serve_state.ReplicaStatus.READY,
-                    -r['replica_id']))
-            n = len(live) - decision.target_replicas
-            self.manager.scale_down(
-                [v['replica_id'] for v in victims[:n]])
+        if isinstance(self.autoscaler,
+                      autoscalers.FallbackRequestRateAutoscaler):
+            self._scale_mixed(live)
+        else:
+            decision = self.autoscaler.decide(
+                len(ready), len(live), self.lb.tracker.qps())
+            if decision.target_replicas > len(live):
+                self.manager.scale_up(
+                    decision.target_replicas - len(live))
+            elif decision.target_replicas < len(live):
+                # Prefer terminating not-ready replicas, then highest
+                # (newest, least-warm) ids.
+                victims = sorted(
+                    live,
+                    key=lambda r: (
+                        r['status'] == serve_state.ReplicaStatus.READY,
+                        -r['replica_id']))
+                n = len(live) - decision.target_replicas
+                self.manager.scale_down(
+                    [v['replica_id'] for v in victims[:n]])
 
         status = (serve_state.ServiceStatus.READY if ready else
                   (serve_state.ServiceStatus.NO_REPLICA if not live else
                    serve_state.ServiceStatus.REPLICA_INIT))
         serve_state.set_service_status(self.service_name, status)
+
+    def _scale_mixed(self, live) -> None:
+        """Spot fleet with on-demand fallback: reconcile the two pools
+        separately toward the mixed decision."""
+        spot = [r for r in live if r.get('use_spot')]
+        ondemand = [r for r in live if not r.get('use_spot')]
+        ready_spot = [r for r in spot
+                      if r['status'] == serve_state.ReplicaStatus.READY]
+        decision = self.autoscaler.decide_mixed(
+            len(ready_spot), len(spot), len(ondemand),
+            self.lb.tracker.qps())
+
+        def reconcile(pool, target, use_spot):
+            if target > len(pool):
+                self.manager.scale_up(target - len(pool),
+                                      use_spot=use_spot)
+            elif target < len(pool):
+                victims = sorted(
+                    pool,
+                    key=lambda r: (
+                        r['status'] == serve_state.ReplicaStatus.READY,
+                        -r['replica_id']))
+                self.manager.scale_down(
+                    [v['replica_id']
+                     for v in victims[:len(pool) - target]])
+
+        reconcile(spot, decision.target_spot, True)
+        reconcile(ondemand, decision.target_ondemand, False)
 
     def _maybe_reload_spec(self, service) -> None:
         """Pick up a version bump from `serve update` (new task YAML)."""

@@ -32,33 +32,53 @@ class ReplicaManager:
         self.service_name = service_name
         self.task = task
         self.spec = spec
+        self.spot_placer = None
+        if spec.use_spot and spec.spot_zones:
+            from skypilot_tpu.serve import spot_placer as placer_lib
+            self.spot_placer = placer_lib.SpotPlacer(list(spec.spot_zones))
 
     # -- lifecycle -----------------------------------------------------------
 
-    def scale_up(self, n: int = 1) -> List[int]:
+    def scale_up(self, n: int = 1,
+                 use_spot: Optional[bool] = None) -> List[int]:
         """Launch n new replica clusters in BACKGROUND threads so the
         control loop keeps probing healthy replicas while slices
         provision (TPU pods can take many minutes; reference replica
-        manager launches async the same way)."""
+        manager launches async the same way).
+
+        use_spot overrides the spec default (the fallback autoscaler
+        launches on-demand replicas into a spot service).
+        """
         launched = []
         service = serve_state.get_service(self.service_name)
         version = service['version'] if service else 1
+        spot = self.spec.use_spot if use_spot is None else use_spot
         for _ in range(n):
             replica_id = serve_state.next_replica_id(self.service_name)
             cluster = replica_cluster_name(self.service_name, replica_id)
+            zone = None
+            if spot and self.spot_placer is not None:
+                counts: Dict[str, int] = {}
+                for r in serve_state.get_replicas(self.service_name):
+                    if r.get('zone'):
+                        counts[r['zone']] = counts.get(r['zone'], 0) + 1
+                zone = self.spot_placer.select(counts)
             serve_state.add_replica(self.service_name, replica_id, cluster,
-                                    version)
+                                    version, use_spot=spot, zone=zone)
             thread = threading.Thread(
-                target=self._launch_replica, args=(replica_id, cluster),
+                target=self._launch_replica,
+                args=(replica_id, cluster, spot, zone),
                 daemon=True)
             thread.start()
             launched.append(replica_id)
         return launched
 
-    def _launch_replica(self, replica_id: int, cluster: str) -> None:
+    def _launch_replica(self, replica_id: int, cluster: str,
+                        use_spot: bool, zone: Optional[str]) -> None:
         try:
             from skypilot_tpu import execution
-            execution.launch(self._replica_task(), cluster_name=cluster,
+            execution.launch(self._replica_task(use_spot, zone),
+                             cluster_name=cluster,
                              stream_logs=False, detach_run=True)
             serve_state.set_replica_status(
                 self.service_name, replica_id,
@@ -70,10 +90,21 @@ class ReplicaManager:
                 self.service_name, replica_id,
                 serve_state.ReplicaStatus.FAILED)
 
-    def _replica_task(self):
-        """A fresh Task per replica (Tasks hold best_resources state)."""
+    def _replica_task(self, use_spot: bool = False,
+                      zone: Optional[str] = None):
+        """A fresh Task per replica (Tasks hold best_resources state),
+        with the placer's spot/zone decision applied to every resource
+        option."""
         from skypilot_tpu import task as task_lib
-        return task_lib.Task.from_yaml_config(self.task.to_yaml_config())
+        task = task_lib.Task.from_yaml_config(self.task.to_yaml_config())
+        # Apply whenever the service runs mixed pools: an on-demand
+        # fallback replica must override a task-level use_spot: true.
+        if self.spec.use_spot or use_spot or zone is not None:
+            task.set_resources([
+                r.copy(use_spot=use_spot,
+                       **({'zone': zone} if zone else {}))
+                for r in task.resources])
+        return task
 
     def _endpoint_for(self, cluster_name: str) -> Optional[str]:
         from skypilot_tpu import state as state_lib
@@ -140,12 +171,16 @@ class ReplicaManager:
                 # PROVISIONING: a background launch thread owns it.
                 continue
             if self._cluster_lost(replica):
-                # Preempted / externally deleted: replace.
+                # Preempted / externally deleted: replace (same
+                # spot-ness; the placer steers the new replica away
+                # from the preempted zone).
                 serve_state.set_replica_status(
                     self.service_name, replica['replica_id'],
                     serve_state.ReplicaStatus.PREEMPTED)
+                if replica.get('use_spot') and self.spot_placer:
+                    self.spot_placer.handle_preemption(replica.get('zone'))
                 self.scale_down([replica['replica_id']])
-                self.scale_up(1)
+                self.scale_up(1, use_spot=replica.get('use_spot'))
                 continue
             if replica['endpoint'] is None:
                 endpoint = self._endpoint_for(replica['cluster_name'])
@@ -161,6 +196,8 @@ class ReplicaManager:
                     serve_state.set_replica_status(
                         self.service_name, replica['replica_id'],
                         serve_state.ReplicaStatus.READY)
+                    if replica.get('use_spot') and self.spot_placer:
+                        self.spot_placer.handle_active(replica.get('zone'))
             else:
                 failures = serve_state.bump_replica_failures(
                     self.service_name, replica['replica_id'])

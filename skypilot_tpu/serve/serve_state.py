@@ -80,8 +80,16 @@ def _get_conn() -> sqlite3.Connection:
                     endpoint TEXT,
                     launched_at REAL,
                     consecutive_failures INTEGER DEFAULT 0,
+                    use_spot INTEGER DEFAULT 0,
+                    zone TEXT,
                     PRIMARY KEY (service_name, replica_id)
                 )""")
+            cols = [r[1] for r in _conn.execute(
+                'PRAGMA table_info(replicas)')]
+            if 'use_spot' not in cols:  # pre-spot DBs
+                _conn.execute('ALTER TABLE replicas ADD COLUMN '
+                              'use_spot INTEGER DEFAULT 0')
+                _conn.execute('ALTER TABLE replicas ADD COLUMN zone TEXT')
             _conn.commit()
             _conn_path = path
         return _conn
@@ -181,15 +189,17 @@ def _service_row(row) -> Dict[str, Any]:
 # --- replicas ---------------------------------------------------------------
 
 def add_replica(service_name: str, replica_id: int, cluster_name: str,
-                version: int) -> None:
+                version: int, use_spot: bool = False,
+                zone: Optional[str] = None) -> None:
     conn = _get_conn()
     with _lock:
         conn.execute(
             'INSERT OR REPLACE INTO replicas (service_name, replica_id, '
-            'cluster_name, status, version, launched_at) '
-            'VALUES (?,?,?,?,?,?)',
+            'cluster_name, status, version, launched_at, use_spot, zone) '
+            'VALUES (?,?,?,?,?,?,?,?)',
             (service_name, replica_id, cluster_name,
-             ReplicaStatus.PROVISIONING.value, version, time.time()))
+             ReplicaStatus.PROVISIONING.value, version, time.time(),
+             int(use_spot), zone))
         conn.commit()
 
 
@@ -249,13 +259,14 @@ def get_replicas(service_name: str) -> List[Dict[str, Any]]:
     conn = _get_conn()
     rows = conn.execute(
         'SELECT service_name, replica_id, cluster_name, status, version, '
-        'endpoint, launched_at, consecutive_failures FROM replicas '
-        'WHERE service_name=? ORDER BY replica_id',
+        'endpoint, launched_at, consecutive_failures, use_spot, zone '
+        'FROM replicas WHERE service_name=? ORDER BY replica_id',
         (service_name,)).fetchall()
     return [{
         'service_name': r[0], 'replica_id': r[1], 'cluster_name': r[2],
         'status': ReplicaStatus(r[3]), 'version': r[4], 'endpoint': r[5],
         'launched_at': r[6], 'consecutive_failures': r[7],
+        'use_spot': bool(r[8]), 'zone': r[9],
     } for r in rows]
 
 

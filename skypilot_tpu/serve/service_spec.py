@@ -41,6 +41,15 @@ class ServiceSpec:
     downscale_delay_seconds: int = 1200
     replica_port: int = 8080
     load_balancing_policy: str = 'least_load'
+    # Spot policy (reference spot_placer.py + FallbackRequestRateAutoscaler
+    # autoscalers.py:557): run replicas on spot, optionally keep
+    # base_ondemand_fallback_replicas always-on-demand, and with
+    # dynamic_ondemand_fallback cover preempted spot capacity with
+    # on-demand until spot recovers.
+    use_spot: bool = False
+    spot_zones: Optional[list] = None
+    base_ondemand_fallback_replicas: int = 0
+    dynamic_ondemand_fallback: bool = False
 
     @classmethod
     def from_yaml_config(cls, cfg: Dict[str, Any]) -> 'ServiceSpec':
@@ -65,11 +74,23 @@ class ServiceSpec:
             replica_port=int(cfg.get('replica_port', 8080)),
             load_balancing_policy=cfg.get('load_balancing_policy',
                                           'least_load'),
+            use_spot=bool(policy.get('use_spot', False)),
+            spot_zones=policy.get('spot_zones'),
+            base_ondemand_fallback_replicas=int(
+                policy.get('base_ondemand_fallback_replicas', 0)),
+            dynamic_ondemand_fallback=bool(
+                policy.get('dynamic_ondemand_fallback', False)),
         )
         if spec.max_replicas is not None and \
                 spec.max_replicas < spec.min_replicas:
             raise exceptions.InvalidTaskError(
                 'service: max_replicas < min_replicas')
+        if not spec.use_spot and (
+                spec.base_ondemand_fallback_replicas > 0
+                or spec.dynamic_ondemand_fallback
+                or spec.spot_zones):
+            raise exceptions.InvalidTaskError(
+                'service: spot fallback/zone options require use_spot')
         if (spec.max_replicas is not None and
                 spec.max_replicas > spec.min_replicas and
                 spec.target_qps_per_replica is None):
@@ -100,4 +121,13 @@ class ServiceSpec:
             pol['max_replicas'] = self.max_replicas
         if self.target_qps_per_replica is not None:
             pol['target_qps_per_replica'] = self.target_qps_per_replica
+        if self.use_spot:
+            pol['use_spot'] = True
+            if self.spot_zones:
+                pol['spot_zones'] = list(self.spot_zones)
+            if self.base_ondemand_fallback_replicas:
+                pol['base_ondemand_fallback_replicas'] = \
+                    self.base_ondemand_fallback_replicas
+            if self.dynamic_ondemand_fallback:
+                pol['dynamic_ondemand_fallback'] = True
         return cfg

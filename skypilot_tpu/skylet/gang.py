@@ -161,6 +161,7 @@ class GangRun:
                     stderr=subprocess.STDOUT,
                     start_new_session=True)
                 self._procs.append(proc)
+                self._spawn_reaper(proc.pid)
                 multi_host = len(node['hosts']) > 1
                 prefix = (f'({node_rank},{host_rank}) ' if multi_host
                           else (f'(node-{node_rank}) '
@@ -185,6 +186,18 @@ class GangRun:
             worst = next((c for c in codes if c != 0), 0)
         self._log(f'{phase}: done, exit codes {codes}')
         return worst
+
+    def _spawn_reaper(self, child_pid: int) -> None:
+        """One orphan reaper per host process (reference
+        subprocess_daemon.py): if THIS driver dies, the child's whole
+        process group is torn down instead of outliving it."""
+        subprocess.Popen(
+            [sys.executable, '-m',
+             'skypilot_tpu.skylet.subprocess_daemon',
+             '--parent-pid', str(os.getpid()),
+             '--proc-pid', str(child_pid)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            start_new_session=True)
 
     def _kill_all(self) -> None:
         for proc in self._procs:

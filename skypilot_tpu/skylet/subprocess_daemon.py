@@ -1,0 +1,83 @@
+"""Orphan reaper: kills a process tree once its parent is gone.
+
+Reference analog: sky/skylet/subprocess_daemon.py (108 LoC). The gang
+driver spawns one reaper per host process; if the driver dies (crash,
+OOM, operator kill -9) the reaper notices within a second and tears
+down the orphaned process group — user jobs and their SSH sessions
+never outlive their driver.
+
+    python -m skypilot_tpu.skylet.subprocess_daemon \
+        --parent-pid <driver> --proc-pid <child>
+"""
+import argparse
+import os
+import signal
+import sys
+import time
+
+
+def _start_time(pid: int):
+    """Kernel start time of `pid` (field 22 of /proc/<pid>/stat), or
+    None when the process is gone. /proc is used instead of
+    os.kill(pid, 0) because the latter only works on processes we may
+    signal; liveness of an arbitrary pid must not depend on that."""
+    try:
+        with open(f'/proc/{pid}/stat', 'rb') as f:
+            stat = f.read()
+    except OSError:
+        return None
+    # comm can contain spaces/parens: split after the LAST ')'.
+    fields = stat.rsplit(b')', 1)[-1].split()
+    return fields[19] if len(fields) > 19 else None
+
+
+def _alive(pid: int, expected_start=None) -> bool:
+    start = _start_time(pid)
+    if start is None:
+        return False
+    if expected_start is not None and start != expected_start:
+        return False  # pid was reused by an unrelated process
+    return True
+
+
+def _kill_tree(pid: int) -> None:
+    """SIGTERM the process group, grace period, then SIGKILL."""
+    try:
+        pgid = os.getpgid(pid)
+    except ProcessLookupError:
+        return
+    try:
+        os.killpg(pgid, signal.SIGTERM)
+    except (ProcessLookupError, PermissionError):
+        return
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        if not _alive(pid):
+            return
+        time.sleep(0.2)
+    try:
+        os.killpg(pgid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        pass
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--parent-pid', type=int, required=True)
+    parser.add_argument('--proc-pid', type=int, required=True)
+    parser.add_argument('--poll-seconds', type=float, default=1.0)
+    args = parser.parse_args(argv)
+
+    parent_start = _start_time(args.parent_pid)
+    proc_start = _start_time(args.proc_pid)
+    while True:
+        if not _alive(args.proc_pid, proc_start):
+            return 0  # target finished normally: nothing to reap
+        if not _alive(args.parent_pid, parent_start):
+            _kill_tree(args.proc_pid)
+            return 0
+        time.sleep(args.poll_seconds)
+
+
+if __name__ == '__main__':
+    sys.exit(main())

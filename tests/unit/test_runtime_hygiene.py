@@ -1,0 +1,203 @@
+"""Runtime hygiene: orphan reaper, controller crash-resume,
+retry_until_up.
+
+Reference analogs: sky/skylet/subprocess_daemon.py (reaper),
+sky/jobs/controller.py:119 (is_resume), `sky launch --retry-until-up`.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import execution
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.backends import gang_backend
+from skypilot_tpu.jobs import scheduler as jobs_scheduler
+from skypilot_tpu.jobs import state as jobs_state
+
+
+def _alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+
+
+class TestSubprocessDaemon:
+
+    def test_reaps_tree_when_parent_dies(self):
+        parent = subprocess.Popen(['sleep', '300'])
+        child = subprocess.Popen(['bash', '-c', 'sleep 300 & sleep 300'],
+                                 start_new_session=True)
+        daemon = subprocess.Popen(
+            [sys.executable, '-m',
+             'skypilot_tpu.skylet.subprocess_daemon',
+             '--parent-pid', str(parent.pid),
+             '--proc-pid', str(child.pid),
+             '--poll-seconds', '0.1'])
+        try:
+            parent.kill()
+            parent.wait()
+            try:
+                child.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pytest.fail('orphan survived its reaper')
+            assert daemon.wait(timeout=10) == 0
+        finally:
+            for proc in (parent, child, daemon):
+                if proc.poll() is None:
+                    proc.kill()
+                proc.wait()
+
+    def test_exits_when_target_finishes(self):
+        parent = subprocess.Popen(['sleep', '300'])
+        child = subprocess.Popen(['true'])
+        child.wait()
+        daemon = subprocess.Popen(
+            [sys.executable, '-m',
+             'skypilot_tpu.skylet.subprocess_daemon',
+             '--parent-pid', str(parent.pid),
+             '--proc-pid', str(child.pid),
+             '--poll-seconds', '0.1'])
+        try:
+            assert daemon.wait(timeout=10) == 0
+        finally:
+            parent.kill()
+            parent.wait()
+
+
+class TestRetryUntilUp:
+
+    def test_launch_retries_after_exhaustion(self, enable_clouds,
+                                             monkeypatch):
+        enable_clouds('local')
+        monkeypatch.setenv('SKYTPU_RETRY_UNTIL_UP_GAP', '0')
+        calls = {'n': 0}
+        real_provision = gang_backend.GangBackend.provision
+
+        def flaky_provision(self, *args, **kwargs):
+            calls['n'] += 1
+            if calls['n'] == 1:
+                raise exceptions.ResourcesUnavailableError('stockout')
+            return real_provision(self, *args, **kwargs)
+
+        monkeypatch.setattr(gang_backend.GangBackend, 'provision',
+                            flaky_provision)
+        task = task_lib.Task(run='echo retried-ok', name='ru')
+        job_id, handle = execution.launch(task, cluster_name='ru-test',
+                                          retry_until_up=True,
+                                          stream_logs=False)
+        assert handle is not None and calls['n'] >= 2
+        from skypilot_tpu import core
+        core.down('ru-test', purge=True)
+
+    def test_without_flag_still_fails(self, enable_clouds, monkeypatch):
+        enable_clouds('local')
+
+        def always_fail(self, *args, **kwargs):
+            raise exceptions.ResourcesUnavailableError('stockout')
+
+        monkeypatch.setattr(gang_backend.GangBackend, 'provision',
+                            always_fail)
+        task = task_lib.Task(run='true', name='rf')
+        with pytest.raises(exceptions.ResourcesUnavailableError):
+            execution.launch(task, cluster_name='rf-test',
+                             stream_logs=False)
+
+
+class TestControllerCrashResume:
+
+    @pytest.fixture(autouse=True)
+    def jobs_env(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_JOBS_POLL_INTERVAL', '0.3')
+        cache = os.path.expanduser('~/.skytpu')
+        os.makedirs(cache, exist_ok=True)
+        with open(os.path.join(cache, 'enabled_clouds.json'), 'w',
+                  encoding='utf-8') as f:
+            json.dump({'enabled': ['local']}, f)
+        jobs_state.reset_for_tests()
+        yield
+        jobs_state.reset_for_tests()
+
+    def _wait(self, job_id, statuses, timeout=60):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            record = jobs_state.get_job(job_id)
+            if record['status'] in statuses:
+                return record
+            time.sleep(0.2)
+        raise AssertionError(
+            f'job stuck in {jobs_state.get_job(job_id)["status"]}')
+
+    def test_killed_controller_resumes_without_relaunch(self):
+        """SIGKILL the controller mid-run; the restarted controller must
+        REATTACH to the live cluster job (recovery_count stays 0)."""
+        task = task_lib.Task(run='sleep 4 && echo resumed-fin',
+                             name='crash')
+        job_id = jobs_state.submit_job('crash', task.to_yaml_config())
+        assert jobs_state.try_claim_pending(job_id)
+        jobs_scheduler._start_controller(job_id)
+        record = self._wait(job_id,
+                            {jobs_state.ManagedJobStatus.RUNNING})
+        assert record['cluster_job_id'] is not None
+
+        os.kill(record['controller_pid'], signal.SIGKILL)
+        deadline = time.time() + 10
+        while _alive(record['controller_pid']) and \
+                time.time() < deadline:
+            time.sleep(0.1)
+
+        restarted = jobs_scheduler.recover_orphaned_controllers()
+        assert restarted == 1
+        record = self._wait(job_id,
+                            {jobs_state.ManagedJobStatus.SUCCEEDED},
+                            timeout=90)
+        assert record['recovery_count'] == 0, \
+            'resume must reattach, not relaunch'
+
+    def test_recover_skips_live_and_terminal_controllers(self):
+        task = task_lib.Task(run='echo x', name='t')
+        job_id = jobs_state.submit_job('t', task.to_yaml_config())
+        # PENDING jobs belong to the normal scheduler, not recovery.
+        assert jobs_scheduler.recover_orphaned_controllers() == 0
+        from skypilot_tpu.jobs import controller as jobs_controller
+        assert jobs_state.try_claim_pending(job_id)
+        jobs_controller.start(job_id)  # runs to SUCCEEDED inline
+        assert jobs_scheduler.recover_orphaned_controllers() == 0
+
+
+class TestRuntimeDependencySetup:
+
+    class _FlakyRunner:
+        node_id = 'fake-host'
+
+        def __init__(self, fail_times):
+            self.fail_times = fail_times
+            self.calls = 0
+
+        def run(self, cmd, **kwargs):
+            self.calls += 1
+            if self.calls <= self.fail_times:
+                return 1, '', 'apt lock held'
+            return 0, 'ok', ''
+
+    def test_retries_then_succeeds(self):
+        from skypilot_tpu.provision import provisioner
+        runner = self._FlakyRunner(fail_times=2)
+        provisioner.setup_runtime_dependencies([runner], retries=3,
+                                               retry_gap=0.0)
+        assert runner.calls == 3
+
+    def test_persistent_failure_raises(self):
+        from skypilot_tpu.provision import provisioner
+        runner = self._FlakyRunner(fail_times=99)
+        with pytest.raises(exceptions.ClusterSetUpError,
+                           match='apt lock held'):
+            provisioner.setup_runtime_dependencies([runner], retries=2,
+                                                   retry_gap=0.0)

@@ -1,0 +1,150 @@
+"""Serve spot machinery: spot placer zone sets + fallback autoscaler.
+
+Reference analog: sky/serve/spot_placer.py:170,254 and
+sky/serve/autoscalers.py:557 (FallbackRequestRateAutoscaler).
+"""
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.serve import autoscalers
+from skypilot_tpu.serve import service_spec as spec_lib
+from skypilot_tpu.serve import spot_placer as placer_lib
+
+ZONES = ['us-a', 'us-b', 'us-c']
+
+
+def _spec(**policy):
+    cfg = {
+        'readiness_probe': '/health',
+        'replica_policy': {'min_replicas': 2, **policy},
+    }
+    return spec_lib.ServiceSpec.from_yaml_config(cfg)
+
+
+class TestSpotPlacer:
+
+    def test_spreads_across_active_zones(self):
+        placer = placer_lib.SpotPlacer(ZONES)
+        counts = {}
+        for _ in range(6):
+            z = placer.select(counts)
+            counts[z] = counts.get(z, 0) + 1
+        assert counts == {'us-a': 2, 'us-b': 2, 'us-c': 2}
+
+    def test_preemption_demotes_zone(self):
+        placer = placer_lib.SpotPlacer(ZONES)
+        placer.handle_preemption('us-a')
+        assert 'us-a' not in placer.active_zones
+        assert placer.preemptive_zones == ['us-a']
+        for _ in range(4):
+            assert placer.select({}) != 'us-a'
+
+    def test_all_preempted_resets_to_active(self):
+        """DynamicFallbackSpotPlacer behavior: when every zone has been
+        preempted, stale memory is cleared instead of starving."""
+        placer = placer_lib.SpotPlacer(ZONES)
+        for z in ZONES:
+            placer.handle_preemption(z)
+        assert sorted(placer.active_zones) == sorted(ZONES)
+        assert placer.preemptive_zones == []
+
+    def test_ready_replica_promotes_zone_back(self):
+        placer = placer_lib.SpotPlacer(ZONES)
+        placer.handle_preemption('us-b')
+        placer.handle_active('us-b')
+        assert 'us-b' in placer.active_zones
+        assert placer.preemptive_zones == []
+
+    def test_unknown_zone_feedback_is_harmless(self):
+        placer = placer_lib.SpotPlacer(ZONES)
+        placer.handle_preemption(None)
+        placer.handle_active('eu-x')
+        assert 'eu-x' in placer.active_zones
+
+    def test_empty_zone_list_rejected(self):
+        with pytest.raises(ValueError):
+            placer_lib.SpotPlacer([])
+
+
+class TestFallbackAutoscaler:
+
+    def _autoscaler(self, base=1, dynamic=True, target_qps=10,
+                    max_replicas=10):
+        spec = _spec(use_spot=True,
+                     base_ondemand_fallback_replicas=base,
+                     dynamic_ondemand_fallback=dynamic,
+                     max_replicas=max_replicas,
+                     target_qps_per_replica=target_qps)
+        t = {'now': 0.0}
+        a = autoscalers.FallbackRequestRateAutoscaler(
+            spec, now_fn=lambda: t['now'])
+        return a, t
+
+    def test_base_ondemand_always_reserved(self):
+        a, _ = self._autoscaler(base=1, dynamic=False)
+        # 40 qps @ 10/replica → 4 total; hysteresis satisfied when
+        # already at target.
+        d = a.decide_mixed(num_ready_spot=3, num_spot=3, num_ondemand=1,
+                           qps=40.0)
+        assert (d.target_spot, d.target_ondemand) == (3, 1)
+        assert d.target_replicas == 4
+
+    def test_dynamic_fallback_covers_spot_shortfall(self):
+        a, _ = self._autoscaler(base=0, dynamic=True)
+        # Target 4, but only 1 spot is actually ready (others preempted
+        # or still provisioning): 3 on-demand cover the gap.
+        d = a.decide_mixed(num_ready_spot=1, num_spot=4, num_ondemand=0,
+                           qps=40.0)
+        assert d.target_spot == 4
+        assert d.target_ondemand == 3
+
+    def test_fallback_shrinks_as_spot_recovers(self):
+        a, t = self._autoscaler(base=0, dynamic=True)
+        # 7 live (4 spot ready + 3 fallback) vs target 4: shrink is
+        # gated by downscale hysteresis, then drops the fallback pool.
+        d = a.decide_mixed(num_ready_spot=4, num_spot=4, num_ondemand=3,
+                           qps=40.0)
+        assert d.target_replicas == 7  # pending downscale delay
+        t['now'] += a.spec.downscale_delay_seconds + 1
+        d = a.decide_mixed(num_ready_spot=4, num_spot=4, num_ondemand=3,
+                           qps=40.0)
+        assert d.target_spot == 4
+        assert d.target_ondemand == 0
+
+    def test_base_plus_dynamic_capped_at_total(self):
+        a, _ = self._autoscaler(base=2, dynamic=True)
+        # Total target 2 (min_replicas floor): base alone covers it;
+        # never exceed total even with zero ready spot.
+        d = a.decide_mixed(num_ready_spot=0, num_spot=0, num_ondemand=2,
+                           qps=0.0)
+        assert d.target_spot == 0
+        assert d.target_ondemand == 2
+
+    def test_mixed_scaling_respects_hysteresis(self):
+        a, t = self._autoscaler(base=0, dynamic=True)
+        # Fleet at 2 (min); a qps spike must wait out upscale_delay.
+        d = a.decide_mixed(2, 2, 0, qps=100.0)
+        assert d.target_replicas == 2  # pending delay
+        t['now'] += a.spec.upscale_delay_seconds + 1
+        d = a.decide_mixed(2, 2, 0, qps=100.0)
+        assert d.target_spot == 10  # capped by max_replicas
+
+    def test_make_autoscaler_selects_fallback(self):
+        spec = _spec(use_spot=True, base_ondemand_fallback_replicas=1)
+        a = autoscalers.make_autoscaler(spec)
+        assert isinstance(a, autoscalers.FallbackRequestRateAutoscaler)
+
+    def test_spot_options_require_use_spot(self):
+        with pytest.raises(exceptions.InvalidTaskError):
+            _spec(base_ondemand_fallback_replicas=1)
+
+    def test_spec_roundtrips_spot_policy(self):
+        spec = _spec(use_spot=True, spot_zones=['us-a'],
+                     base_ondemand_fallback_replicas=2,
+                     dynamic_ondemand_fallback=True)
+        again = spec_lib.ServiceSpec.from_yaml_config(
+            {'readiness_probe': spec.to_yaml_config()['readiness_probe'],
+             **spec.to_yaml_config()})
+        assert again.use_spot and again.spot_zones == ['us-a']
+        assert again.base_ondemand_fallback_replicas == 2
+        assert again.dynamic_ondemand_fallback
