@@ -61,6 +61,10 @@ class Dag:
 
     # --- queries ------------------------------------------------------------
 
+    @property
+    def edges(self) -> List:
+        return list(self._edges)
+
     def is_chain(self) -> bool:
         if len(self.tasks) <= 1:
             return True

@@ -1,18 +1,48 @@
-"""Storage mount execution on cluster hosts (gcsfuse first).
+"""Storage mount execution on cluster hosts.
 
-Reference analog: sky/data/mounting_utils.py:41-130. Round 1: gcsfuse
-MOUNT + COPY-mode fetch; S3 via gsutil-interop later.
+Reference analog: sky/data/mounting_utils.py:41-130 (goofys/gcsfuse/
+blobfuse2/rclone install + mount command builders). Every store gets a
+MOUNT command (FUSE) and a COPY command (bulk fetch); install snippets
+are idempotent (`command -v` guard) so remount after reboot is cheap.
 """
 import shlex
 from typing import Dict, List
 
 from skypilot_tpu import exceptions
 
+
+def _r2_endpoint() -> str:
+    """Resolved CLIENT-side (config/env) and baked into the remote
+    command — cluster hosts don't inherit the client's env."""
+    from skypilot_tpu.data import storage as storage_lib
+    return shlex.quote(storage_lib.R2Store._endpoint())  # noqa: SLF001
+
 _GCSFUSE_INSTALL = (
     'command -v gcsfuse >/dev/null 2>&1 || '
     '(curl -fsSL https://github.com/GoogleCloudPlatform/gcsfuse/releases/'
     'download/v2.4.0/gcsfuse_2.4.0_amd64.deb -o /tmp/gcsfuse.deb && '
     'sudo dpkg -i /tmp/gcsfuse.deb)')
+
+# goofys: the reference's S3 FUSE of choice (mounting_utils.py:41).
+_GOOFYS_INSTALL = (
+    'command -v goofys >/dev/null 2>&1 || '
+    '(sudo curl -fsSL https://github.com/kahing/goofys/releases/latest/'
+    'download/goofys -o /usr/local/bin/goofys && '
+    'sudo chmod +x /usr/local/bin/goofys)')
+
+_BLOBFUSE2_INSTALL = (
+    'command -v blobfuse2 >/dev/null 2>&1 || '
+    '(sudo apt-get update -qq && sudo apt-get install -y -qq blobfuse2)')
+
+_RCLONE_INSTALL = (
+    'command -v rclone >/dev/null 2>&1 || '
+    '(curl -fsSL https://rclone.org/install.sh | sudo bash)')
+
+
+def _mount_guard(q_path: str, mount: str) -> str:
+    """mkdir + only mount when not already a mountpoint (idempotent)."""
+    return (f'mkdir -p {q_path} && '
+            f'mountpoint -q {q_path} || {mount}')
 
 
 def mount_cmd(store_type: str, bucket: str, mount_path: str,
@@ -26,12 +56,31 @@ def mount_cmd(store_type: str, bucket: str, mount_path: str,
         if store_type == 's3':
             return (f'mkdir -p {q_path} && '
                     f'aws s3 sync s3://{q_bucket} {q_path}')
+        if store_type == 'r2':
+            return (f'mkdir -p {q_path} && '
+                    f'aws s3 sync s3://{q_bucket} {q_path} '
+                    f'--endpoint-url {_r2_endpoint()}')
+        if store_type == 'azure':
+            return (f'mkdir -p {q_path} && az storage blob '
+                    f'download-batch --destination {q_path} '
+                    f'--source {q_bucket}')
         raise exceptions.StorageError(f'COPY: unsupported store '
                                       f'{store_type}')
     if store_type == 'gcs':
-        return (f'{_GCSFUSE_INSTALL} && mkdir -p {q_path} && '
-                f'mountpoint -q {q_path} || '
-                f'gcsfuse --implicit-dirs {q_bucket} {q_path}')
+        return (f'{_GCSFUSE_INSTALL} && ' + _mount_guard(
+            q_path, f'gcsfuse --implicit-dirs {q_bucket} {q_path}'))
+    if store_type == 's3':
+        return (f'{_GOOFYS_INSTALL} && ' + _mount_guard(
+            q_path, f'goofys {q_bucket} {q_path}'))
+    if store_type == 'r2':
+        # R2 is S3-compatible: goofys with the account endpoint.
+        return (f'{_GOOFYS_INSTALL} && ' + _mount_guard(
+            q_path,
+            f'goofys --endpoint {_r2_endpoint()} {q_bucket} {q_path}'))
+    if store_type == 'azure':
+        return (f'{_BLOBFUSE2_INSTALL} && ' + _mount_guard(
+            q_path,
+            f'blobfuse2 mount {q_path} --container-name {q_bucket}'))
     if store_type == 'local':
         # Directory-backed bucket (same machine): symlink is the mount.
         from skypilot_tpu.data import storage as storage_lib
@@ -42,6 +91,17 @@ def mount_cmd(store_type: str, bucket: str, mount_path: str,
                     f'ln -sfn {bucket_dir} {q_path}')
         return f'mkdir -p {q_path} && cp -a {bucket_dir}/. {q_path}/'
     raise exceptions.StorageError(f'MOUNT: unsupported store {store_type}')
+
+
+def rclone_mount_cmd(remote: str, bucket: str, mount_path: str) -> str:
+    """Generic fallback FUSE for any store rclone knows (reference
+    mounting_utils rclone path): used where goofys/blobfuse2 aren't
+    available for the platform."""
+    q_path = shlex.quote(mount_path)
+    return (f'{_RCLONE_INSTALL} && ' + _mount_guard(
+        q_path,
+        f'rclone mount {remote}:{shlex.quote(bucket)} {q_path} '
+        f'--daemon --allow-other --vfs-cache-mode writes'))
 
 
 def mount_all(runners: List, storage_mounts: Dict[str, Dict]) -> None:

@@ -1,10 +1,13 @@
 """Optimizer: fill in launchable resources and pick the cheapest/fastest.
 
 Reference analog: sky/optimizer.py:71 — `_fill_in_launchable_resources`
-(:1256) + DP over chains (:429) + PuLP ILP for general DAGs (:490). Ours:
-the same candidate-fill, then exact DP over chains; general DAGs fall back
-to per-task greedy (an ILP adds nothing until inter-task egress costs are
-modeled; egress hook is in `_transfer_cost`).
+(:1256) + DP over chains (:429) + PuLP ILP for general DAGs (:490) +
+`_egress_cost` (:75). Ours: the same candidate-fill; inter-task egress
+(cross-region / cross-cloud transfer of `task.outputs.
+estimated_size_gigabytes`) is minimized by an exact DP over chains and
+a MILP (scipy/HiGHS — PuLP isn't a dependency here) over general DAGs.
+Without egress-relevant edges, per-task argmin is already globally
+optimal and is used directly.
 """
 import collections
 import enum
@@ -41,21 +44,149 @@ class Optimizer:
                     f'No launchable resources satisfy task {task.name!r}: '
                     f'{sorted(task.resources, key=repr)}')
             per_task[id(task)] = candidates
-        # Chains and general DAGs alike: no inter-task transfer cost is
-        # modeled yet, so per-task argmin == global min. `_transfer_cost`
-        # is the seam where egress pricing will slot in.
-        for task in order:
-            if minimize == OptimizeTarget.TIME:
-                # Highest aggregate accelerator throughput, cheapest on tie.
-                best, cost = max(
-                    per_task[id(task)],
-                    key=lambda rc: (Optimizer._throughput(rc[0]), -rc[1]))
-            else:
-                best, cost = min(per_task[id(task)], key=lambda rc: rc[1])
-            task.best_resources = best
+
+        edges = dag.edges
+        egress_relevant = minimize == OptimizeTarget.COST and any(
+            (a.estimated_outputs_gigabytes or 0) > 0 for a, _ in edges)
+        if egress_relevant and dag.is_chain():
+            Optimizer._optimize_by_dp(order, per_task)
+        elif egress_relevant:
+            Optimizer._optimize_by_ilp(order, edges, per_task)
+        else:
+            # No egress-relevant edges: per-task argmin == global min.
+            for task in order:
+                if minimize == OptimizeTarget.TIME:
+                    # Highest aggregate accelerator throughput, cheapest
+                    # on tie.
+                    best, cost = max(
+                        per_task[id(task)],
+                        key=lambda rc: (Optimizer._throughput(rc[0]),
+                                        -rc[1]))
+                else:
+                    best, cost = min(per_task[id(task)],
+                                     key=lambda rc: rc[1])
+                task.best_resources = best
         if not quiet:
             Optimizer._print_choice(order, per_task)
         return dag
+
+    # --- chain DP / DAG ILP (egress-aware placement) ------------------------
+
+    @staticmethod
+    def _optimize_by_dp(order, per_task) -> float:
+        """Exact DP over a chain: minimize Σ hourly cost + Σ egress
+        (reference _optimize_by_dp, sky/optimizer.py:429). Returns the
+        optimal objective (for DP↔ILP equivalence tests)."""
+        cands = [per_task[id(t)] for t in order]
+        # dp[j] = (best objective ending with candidate j, backpointer)
+        dp = [(cost, None) for _, cost in cands[0]]
+        history = [dp]
+        for i in range(1, len(order)):
+            gb = order[i - 1].estimated_outputs_gigabytes or 0.0
+            nxt = []
+            for res_j, cost_j in cands[i]:
+                best_val, best_k = min(
+                    ((history[-1][k][0] +
+                      Optimizer._transfer_cost(res_k, res_j, gb), k)
+                     for k, (res_k, _) in enumerate(cands[i - 1])),
+                    key=lambda vk: vk[0])
+                nxt.append((best_val + cost_j, best_k))
+            history.append(nxt)
+        # Backtrack.
+        j = min(range(len(history[-1])), key=lambda j: history[-1][j][0])
+        objective = history[-1][j][0]
+        for i in range(len(order) - 1, -1, -1):
+            order[i].best_resources = cands[i][j][0]
+            j = history[i][j][1]
+        return objective
+
+    # Candidate cap for the ILP: edge variables are |Cu|·|Cv| per edge.
+    _ILP_MAX_CANDIDATES = 12
+
+    @staticmethod
+    def _optimize_by_ilp(order, edges, per_task) -> float:
+        """MILP over a general DAG (reference _optimize_by_ilp,
+        sky/optimizer.py:490, which uses PuLP; ours uses scipy's HiGHS).
+
+        Variables: x[t,c] selects candidate c for task t; y[e,cu,cv]
+        selects the (src,dst) pair for edge e. The transportation-style
+        linking constraints (row/column sums of y equal x) make the
+        relaxation tight. Candidates are pruned to the cheapest
+        _ILP_MAX_CANDIDATES per task to bound edge variables. Returns
+        the optimal objective.
+        """
+        import numpy as np
+        from scipy import optimize as sp_opt
+        from scipy import sparse
+
+        cands = {}
+        for t in order:
+            ranked = sorted(per_task[id(t)], key=lambda rc: rc[1])
+            cands[id(t)] = ranked[:Optimizer._ILP_MAX_CANDIDATES]
+
+        # Variable layout: x blocks per task, then y blocks per edge.
+        x_off = {}
+        n = 0
+        for t in order:
+            x_off[id(t)] = n
+            n += len(cands[id(t)])
+        y_off = {}
+        for e, (u, v) in enumerate(edges):
+            y_off[e] = n
+            n += len(cands[id(u)]) * len(cands[id(v)])
+
+        costs = np.zeros(n)
+        for t in order:
+            for c, (_, cost) in enumerate(cands[id(t)]):
+                costs[x_off[id(t)] + c] = cost
+        for e, (u, v) in enumerate(edges):
+            gb = u.estimated_outputs_gigabytes or 0.0
+            n_v = len(cands[id(v)])
+            for cu, (res_u, _) in enumerate(cands[id(u)]):
+                for cv, (res_v, _) in enumerate(cands[id(v)]):
+                    costs[y_off[e] + cu * n_v + cv] = \
+                        Optimizer._transfer_cost(res_u, res_v, gb)
+
+        rows, cols, vals, lo, hi = [], [], [], [], []
+
+        def add_eq(terms, rhs):
+            r = len(lo)
+            for col, val in terms:
+                rows.append(r)
+                cols.append(col)
+                vals.append(val)
+            lo.append(rhs)
+            hi.append(rhs)
+
+        for t in order:  # exactly one candidate per task
+            add_eq([(x_off[id(t)] + c, 1.0)
+                    for c in range(len(cands[id(t)]))], 1.0)
+        for e, (u, v) in enumerate(edges):
+            n_u, n_v = len(cands[id(u)]), len(cands[id(v)])
+            for cu in range(n_u):   # row sums: Σ_cv y = x_u[cu]
+                add_eq([(y_off[e] + cu * n_v + cv, 1.0)
+                        for cv in range(n_v)] +
+                       [(x_off[id(u)] + cu, -1.0)], 0.0)
+            for cv in range(n_v):   # col sums: Σ_cu y = x_v[cv]
+                add_eq([(y_off[e] + cu * n_v + cv, 1.0)
+                        for cu in range(n_u)] +
+                       [(x_off[id(v)] + cv, -1.0)], 0.0)
+
+        constraints = sp_opt.LinearConstraint(
+            sparse.csr_matrix((vals, (rows, cols)), shape=(len(lo), n)),
+            lo, hi)
+        result = sp_opt.milp(
+            c=costs, constraints=constraints,
+            integrality=np.ones(n),
+            bounds=sp_opt.Bounds(0, 1))
+        if not result.success:  # pragma: no cover — tiny feasible MILPs
+            raise exceptions.ResourcesUnavailableError(
+                f'ILP optimization failed: {result.message}')
+        for t in order:
+            off = x_off[id(t)]
+            c = int(np.argmax(result.x[off:off + len(cands[id(t)])]))
+            t.best_resources = cands[id(t)][c][0]
+        return float(result.fun)
 
     # --- candidate fill -----------------------------------------------------
 
@@ -145,11 +276,24 @@ class Optimizer:
             total += Optimizer._GPU_TFLOPS.get(name, 0.0) * count
         return total
 
+    # $/GB egress (typical public pricing; reference cloud.get_egress_cost
+    # per-cloud tables — a flat pair model keeps the catalog honest
+    # without per-cloud scrapers).
+    _EGRESS_PER_GB_CROSS_CLOUD = 0.09
+    _EGRESS_PER_GB_CROSS_REGION = 0.02
+
     @staticmethod
     def _transfer_cost(src: Optional[resources_lib.Resources],
-                       dst: resources_lib.Resources) -> float:
-        """Inter-task egress cost hook (reference _egress_cost :75)."""
-        del src, dst
+                       dst: resources_lib.Resources,
+                       gigabytes: float) -> float:
+        """Egress $ to move `gigabytes` from src's placement to dst's
+        (reference _egress_cost, sky/optimizer.py:75)."""
+        if src is None or gigabytes <= 0:
+            return 0.0
+        if src.cloud != dst.cloud:
+            return Optimizer._EGRESS_PER_GB_CROSS_CLOUD * gigabytes
+        if src.region != dst.region:
+            return Optimizer._EGRESS_PER_GB_CROSS_REGION * gigabytes
         return 0.0
 
     # --- display ------------------------------------------------------------

@@ -20,6 +20,7 @@ CommandOrGen = Union[None, str, Callable[[int, List[str]], Optional[str]]]
 
 _TASK_FIELDS = {
     'name', 'workdir', 'setup', 'run', 'num_nodes', 'envs', 'secrets',
+    'outputs',
     'file_mounts', 'resources', 'service',
 }
 
@@ -38,6 +39,7 @@ class Task:
         envs: Optional[Dict[str, str]] = None,
         secrets: Optional[Dict[str, str]] = None,
         file_mounts: Optional[Dict[str, str]] = None,
+        estimated_outputs_gigabytes: Optional[float] = None,
     ):
         self.name = name
         self.setup = setup
@@ -47,6 +49,10 @@ class Task:
         self._envs = dict(envs) if envs else {}
         self._secrets = dict(secrets) if secrets else {}
         self.file_mounts = dict(file_mounts) if file_mounts else None
+        # Size of this task's outputs consumed by downstream DAG tasks;
+        # drives the optimizer's egress cost (reference _egress_cost,
+        # sky/optimizer.py:75).
+        self.estimated_outputs_gigabytes = estimated_outputs_gigabytes
         self.storage_mounts: Dict[str, Any] = {}
         self.service = None  # serve.SchemaSpec, set via set_service
         self.resources: Set[resources_lib.Resources] = {
@@ -158,6 +164,9 @@ class Task:
             num_nodes=config.get('num_nodes'),
             envs={k: str(v) for k, v in envs.items()},
             secrets={k: str(v) for k, v in secrets.items()},
+            estimated_outputs_gigabytes=(
+                (config.get('outputs') or {}).get(
+                    'estimated_size_gigabytes')),
         )
         # file_mounts: plain str values are path copies; dict values are
         # Storage objects (reference sky/task.py:497 split).
@@ -214,6 +223,10 @@ class Task:
             }
         if self.num_nodes != 1:
             cfg['num_nodes'] = self.num_nodes
+        if self.estimated_outputs_gigabytes is not None:
+            cfg['outputs'] = {
+                'estimated_size_gigabytes':
+                    self.estimated_outputs_gigabytes}
         if self.workdir:
             cfg['workdir'] = self.workdir
         if self.setup:

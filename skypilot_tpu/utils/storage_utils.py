@@ -53,3 +53,20 @@ def du_bytes(path: str) -> int:
                 except OSError:
                     pass
     return total
+
+
+def filtered_source(source: str) -> str:
+    """`source` with .skyignore patterns applied: returns `source`
+    unchanged when nothing is excluded, else a temp copy with the
+    excluded entries removed (for uploaders without an exclude flag,
+    e.g. `az storage blob upload-batch`)."""
+    import shutil
+    import tempfile
+    source = os.path.expanduser(source)
+    excludes = skyignore_excludes(source)
+    if not excludes or not os.path.isdir(source):
+        return source
+    staged = tempfile.mkdtemp(prefix='skytpu-upload-')
+    shutil.copytree(source, staged, dirs_exist_ok=True,
+                    ignore=shutil.ignore_patterns(*excludes))
+    return staged

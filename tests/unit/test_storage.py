@@ -117,3 +117,109 @@ def test_storage_mount_end_to_end(tmp_path, enable_clouds):
     log = open(job_lib.job_log_path(rt, job_id)).read()
     assert 'TRAINDATA-42' in log
     sky.down('storage-e2e')
+
+
+class TestMountCommands:
+    """Mount/COPY command construction per store (reference
+    mounting_utils.py:41-130)."""
+
+    def test_s3_mount_uses_goofys(self):
+        from skypilot_tpu.data import storage_mounting
+        cmd = storage_mounting.mount_cmd('s3', 'buck', '/data')
+        assert 'goofys' in cmd and 'goofys buck /data' in cmd
+        assert 'mountpoint -q /data ||' in cmd  # idempotent
+
+    def test_gcs_mount_uses_gcsfuse(self):
+        from skypilot_tpu.data import storage_mounting
+        cmd = storage_mounting.mount_cmd('gcs', 'buck', '/data')
+        assert 'gcsfuse --implicit-dirs buck /data' in cmd
+
+    def test_azure_mount_uses_blobfuse2(self):
+        from skypilot_tpu.data import storage_mounting
+        cmd = storage_mounting.mount_cmd('azure', 'cont', '/data')
+        assert 'blobfuse2 mount /data --container-name cont' in cmd
+
+    def test_r2_mount_uses_goofys_with_endpoint(self, monkeypatch):
+        # The endpoint resolves CLIENT-side and is baked into the
+        # remote command (cluster hosts don't inherit client env).
+        monkeypatch.setenv('R2_ENDPOINT_URL', 'https://acct.r2.dev')
+        from skypilot_tpu.data import storage_mounting
+        cmd = storage_mounting.mount_cmd('r2', 'buck', '/data')
+        assert 'goofys --endpoint https://acct.r2.dev buck /data' in cmd
+
+    def test_copy_mode_commands(self, monkeypatch):
+        monkeypatch.setenv('R2_ENDPOINT_URL', 'https://acct.r2.dev')
+        from skypilot_tpu.data import storage_mounting
+        assert '--endpoint-url https://acct.r2.dev' in \
+            storage_mounting.mount_cmd('r2', 'b', '/d', mode='COPY')
+        assert 'aws s3 sync s3://b /d' in storage_mounting.mount_cmd(
+            's3', 'b', '/d', mode='COPY')
+        assert 'gsutil -m rsync -r gs://b /d' in \
+            storage_mounting.mount_cmd('gcs', 'b', '/d', mode='COPY')
+        assert 'download-batch' in storage_mounting.mount_cmd(
+            'azure', 'b', '/d', mode='COPY')
+
+    def test_rclone_fallback_mount(self):
+        from skypilot_tpu.data import storage_mounting
+        cmd = storage_mounting.rclone_mount_cmd('myremote', 'b', '/d')
+        assert 'rclone mount myremote:b /d' in cmd
+
+    def test_unknown_store_raises(self):
+        from skypilot_tpu import exceptions
+        from skypilot_tpu.data import storage_mounting
+        with pytest.raises(exceptions.StorageError):
+            storage_mounting.mount_cmd('ftp', 'b', '/d')
+
+
+class TestStoreRegistry:
+
+    def test_all_store_types_instantiable(self):
+        schemes = {'gcs': 'gs', 's3': 's3', 'azure': 'az', 'r2': 'r2',
+                   'local': 'local'}
+        for st in storage_lib.StoreType:
+            store = storage_lib.make_store(st, 'bname')
+            assert store.TYPE == st
+            assert store.url() == f'{schemes[st.value]}://bname'
+
+    def test_r2_requires_endpoint(self, monkeypatch):
+        from skypilot_tpu import exceptions
+        monkeypatch.delenv('R2_ENDPOINT_URL', raising=False)
+        store = storage_lib.make_store(storage_lib.StoreType.R2, 'b')
+        with pytest.raises(exceptions.StorageError, match='endpoint'):
+            store._endpoint()
+
+    def test_url_inference_new_stores(self):
+        assert storage_lib.StoreType.from_url('az://c') == \
+            storage_lib.StoreType.AZURE
+        assert storage_lib.StoreType.from_url('r2://b') == \
+            storage_lib.StoreType.R2
+
+
+class TestDataTransfer:
+
+    def test_local_to_local_transfer(self, tmp_path):
+        from skypilot_tpu.data import data_transfer
+        src = storage_lib.make_store(storage_lib.StoreType.LOCAL, 'srcb')
+        src.create()
+        payload = tmp_path / 'f.txt'
+        payload.write_text('transfer-me')
+        src.upload(str(payload))
+        data_transfer.transfer('local://srcb', 'local://dstb')
+        dst = storage_lib.make_store(storage_lib.StoreType.LOCAL, 'dstb')
+        assert dst.exists()
+        import os as _os
+        assert (_os.path.join(dst._dir(), 'f.txt'),
+                open(_os.path.join(dst._dir(), 'f.txt')).read()) == (
+            _os.path.join(dst._dir(), 'f.txt'), 'transfer-me')
+
+    def test_transfer_routes_gcs_pair_to_gsutil(self, monkeypatch):
+        from skypilot_tpu.data import data_transfer
+        calls = []
+        monkeypatch.setattr(data_transfer, '_run',
+                            lambda argv, what: calls.append(argv))
+        data_transfer.transfer('gs://a', 'gs://b')
+        assert calls[0][:2] == ['gsutil', '-m']
+        data_transfer.transfer('s3://a', 'gs://b')
+        assert 's3://a' in calls[1]
+        data_transfer.transfer('s3://a', 's3://b')
+        assert calls[2][:3] == ['aws', 's3', 'sync']
