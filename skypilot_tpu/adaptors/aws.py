@@ -212,6 +212,16 @@ _clients: Dict[str, Any] = {}
 _lock = threading.Lock()
 
 
+def _after_fork_in_child() -> None:
+    """Fresh lock in forked children (parent is multi-threaded)."""
+    global _lock
+    _lock = threading.Lock()
+    _clients.clear()
+
+
+os.register_at_fork(after_in_child=_after_fork_in_child)
+
+
 def set_client_factory(factory: Callable[[str], Any]) -> None:
     """Test hook: inject a fake EC2 (drops cached clients)."""
     global _client_factory

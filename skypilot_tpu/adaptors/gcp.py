@@ -114,6 +114,16 @@ _transport: Optional[Any] = None
 _transport_lock = threading.Lock()
 
 
+def _after_fork_in_child() -> None:
+    """Fresh lock in forked children (parent is multi-threaded)."""
+    global _transport_lock, _transport
+    _transport_lock = threading.Lock()
+    _transport = None
+
+
+os.register_at_fork(after_in_child=_after_fork_in_child)
+
+
 def set_transport_factory(factory: Callable[[], Any]) -> None:
     """Test hook: inject a fake transport (and drop any cached one)."""
     global _transport_factory, _transport

@@ -17,6 +17,15 @@ _CACHE_PATH = '~/.skytpu/enabled_clouds.json'
 _lock = threading.Lock()
 
 
+def _after_fork_in_child() -> None:
+    """Fresh lock in forked children (parent is multi-threaded)."""
+    global _lock
+    _lock = threading.Lock()
+
+
+os.register_at_fork(after_in_child=_after_fork_in_child)
+
+
 def check_credentials(cloud_names: Optional[List[str]] = None
                       ) -> Dict[str, Tuple[bool, Optional[str]]]:
     """Probe credentials for each cloud; returns {cloud: (ok, reason)}."""

@@ -23,6 +23,16 @@ def launch(task, name: Optional[str] = None,
     controller runs stage by stage (each stage on its own cluster,
     recovering independently — reference managed-job pipelines)."""
     from skypilot_tpu import dag as dag_lib
+    from skypilot_tpu.utils import controller_utils
+    dedicated = controller_utils.controller_mode('jobs') == 'dedicated'
+
+    def _prep(t):
+        # Dedicated controllers can't see client-local paths: 2-hop
+        # (reference maybe_translate_local_file_mounts_and_sync_up,
+        # controller_utils.py:837).
+        return (controller_utils.translate_local_file_mounts(t)
+                if dedicated else t)
+
     if isinstance(task, dag_lib.Dag):
         dag = task
         if len(dag.tasks) == 1:
@@ -32,11 +42,12 @@ def launch(task, name: Optional[str] = None,
                 raise exceptions.InvalidDagError(
                     'Managed-job pipelines must be linear chains.')
             ordered = dag.topological_order()
-            cfg = {'pipeline': [t.to_yaml_config() for t in ordered]}
+            cfg = {'pipeline': [_prep(t).to_yaml_config()
+                                for t in ordered]}
             return scheduler.submit_job(
                 name or dag.name or ordered[0].name, cfg,
                 max_recoveries=max_recoveries, strategy=strategy)
-    cfg = task.to_yaml_config()
+    cfg = _prep(task).to_yaml_config()
     job_recovery = None
     for r in task.resources:
         job_recovery = getattr(r, 'job_recovery', None) or job_recovery
@@ -103,6 +114,9 @@ def tail_logs(job_id: int, follow: bool = True,
     if record is None:
         raise exceptions.JobNotFoundError(
             f'Managed job {job_id} not found.')
+    from skypilot_tpu.utils import controller_utils
+    if controller_utils.controller_mode('jobs') == 'dedicated':
+        return _tail_dedicated_controller_logs(job_id, record, follow)
     path = jobs_state.controller_log_path(job_id)
     pos = 0
     while True:
@@ -119,5 +133,33 @@ def tail_logs(job_id: int, follow: bool = True,
         if record['status'].is_terminal or not follow:
             break
         time.sleep(poll_interval)
+    ok = record['status'] == jobs_state.ManagedJobStatus.SUCCEEDED
+    return 0 if ok else 1
+
+
+def _tail_dedicated_controller_logs(job_id: int, record, follow: bool
+                                    ) -> int:
+    """Dedicated mode: the controller runs as a cluster job on the
+    controller cluster, so its output lives in THAT job's log."""
+    from skypilot_tpu import core as sky_core
+    from skypilot_tpu import state as cluster_state
+    from skypilot_tpu.utils import controller_utils
+    spec = controller_utils.CONTROLLERS['jobs']
+    cluster = cluster_state.get_cluster_from_name(spec.cluster_name)
+    if cluster is None or cluster['handle'] is None:
+        print(f'Controller cluster {spec.cluster_name!r} is gone; '
+              'no logs available.')
+        return 0 if record['status'] == \
+            jobs_state.ManagedJobStatus.SUCCEEDED else 1
+    ctrl_job_id = None
+    for job in sky_core.queue(spec.cluster_name):
+        if job.get('job_name') == f'jobs-ctrl-{job_id}':
+            ctrl_job_id = job['job_id']
+    if ctrl_job_id is None:
+        print(f'No controller job found for managed job {job_id}.')
+        return 1
+    sky_core.tail_logs(spec.cluster_name, job_id=ctrl_job_id,
+                       follow=follow)
+    record = jobs_state.get_job(job_id)
     ok = record['status'] == jobs_state.ManagedJobStatus.SUCCEEDED
     return 0 if ok else 1

@@ -17,6 +17,10 @@ _MAX_CONCURRENT_LAUNCHES = int(
 
 
 def _start_controller(job_id: int, resume: bool = False) -> None:
+    from skypilot_tpu.utils import controller_utils
+    if controller_utils.controller_mode('jobs') == 'dedicated':
+        _start_controller_on_cluster(job_id, resume=resume)
+        return
     log_path = jobs_state.controller_log_path(job_id)
     argv = [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
             '--job-id', str(job_id)]
@@ -28,6 +32,25 @@ def _start_controller(job_id: int, resume: bool = False) -> None:
             start_new_session=True,
             env=dict(os.environ, JAX_PLATFORMS='cpu'))
     jobs_state.set_controller_pid(job_id, proc.pid)
+
+
+def _start_controller_on_cluster(job_id: int,
+                                 resume: bool = False) -> None:
+    """Dedicated mode: the controller runs as a cluster job on the
+    long-lived controller cluster (reference
+    templates/jobs-controller.yaml.j2 — ours execs through the normal
+    gang stack instead of rendering a template)."""
+    from skypilot_tpu import execution
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.utils import controller_utils
+    handle = controller_utils.ensure_controller_cluster('jobs')
+    args = ['--job-id', str(job_id)] + (['--resume'] if resume else [])
+    cmd = controller_utils.controller_run_command(
+        handle, 'skypilot_tpu.jobs.controller', *args)
+    ctrl = task_lib.Task(name=f'jobs-ctrl-{job_id}',
+                         run=f'JAX_PLATFORMS=cpu {cmd}')
+    execution.exec_cmd(ctrl, cluster_name=handle.cluster_name,
+                       detach_run=True)
 
 
 def _pid_alive(pid: Optional[int]) -> bool:
@@ -56,6 +79,11 @@ def recover_orphaned_controllers() -> int:
     controller runs the resume path: reattach to the live cluster job,
     or recover the cluster if it is gone (reference is_resume,
     sky/jobs/controller.py:119). Returns number restarted."""
+    from skypilot_tpu.utils import controller_utils
+    if controller_utils.controller_mode('jobs') == 'dedicated':
+        # Controller liveness is owned by the controller cluster's job
+        # queue; local pids are meaningless for remote controllers.
+        return 0
     restarted = 0
     for job in jobs_state.get_jobs():
         status = job['status']

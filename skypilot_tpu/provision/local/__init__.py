@@ -83,7 +83,36 @@ def terminate_instances(cluster_name_on_cloud: str,
                         provider_config: Dict[str, Any]) -> None:
     del provider_config
     d = _cluster_dir(cluster_name_on_cloud)
+    _kill_cluster_processes(d)
     shutil.rmtree(d, ignore_errors=True)
+
+
+def _kill_cluster_processes(cluster_dir: str) -> None:
+    """A real VM's processes die with the VM; the local cloud must
+    match: SIGKILL everything whose cmdline references this cluster's
+    directory (skylet, gang drivers, job processes) at terminate."""
+    import glob
+    import signal
+    marker = os.path.abspath(cluster_dir).encode()
+    me = os.getpid()
+    for pid_dir in glob.glob('/proc/[0-9]*'):
+        try:
+            pid = int(os.path.basename(pid_dir))
+            if pid == me:
+                continue
+            with open(os.path.join(pid_dir, 'cmdline'), 'rb') as f:
+                cmd = f.read()
+        except (OSError, ValueError):
+            continue
+        if marker not in cmd:
+            continue
+        try:
+            os.killpg(pid, signal.SIGKILL)
+        except OSError:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
 
 
 def query_instances(cluster_name_on_cloud: str,

@@ -63,9 +63,16 @@ def runtime_dir_for(cluster_info: common.ClusterInfo) -> str:
 
 
 def build_topology(cluster_name: str, cluster_info: common.ClusterInfo,
-                   ssh_user: str = '', ssh_key: Optional[str] = None
-                   ) -> Dict[str, Any]:
-    """The file the gang runner reads: logical nodes -> host lists."""
+                   ssh_user: str = '', ssh_key: Optional[str] = None,
+                   epoch: Optional[str] = None) -> Dict[str, Any]:
+    """The file the gang runner reads: logical nodes -> host lists.
+
+    `epoch` uniquely identifies one cluster incarnation: a
+    terminate+relaunch under the same name writes a new epoch, which
+    tells stale skylet/gang survivors of the old incarnation to die.
+    Re-setup of a LIVE incarnation must pass the existing epoch so its
+    daemons survive (post_provision_runtime_setup is idempotent)."""
+    import uuid
     nodes = []
     local = cluster_info.provider_name == 'local'
     for inst in cluster_info.ordered_instances():
@@ -80,7 +87,8 @@ def build_topology(cluster_name: str, cluster_info: common.ClusterInfo,
                 host['ssh_port'] = h.ssh_port
             hosts.append(host)
         nodes.append({'instance_id': inst.instance_id, 'hosts': hosts})
-    return {'cluster_name': cluster_name, 'nodes': nodes}
+    return {'cluster_name': cluster_name, 'nodes': nodes,
+            'epoch': epoch or uuid.uuid4().hex}
 
 
 def post_provision_runtime_setup(provider_name: str, cluster_name: str,
@@ -94,7 +102,8 @@ def post_provision_runtime_setup(provider_name: str, cluster_name: str,
     head = runners[0]
     local = isinstance(head, runner_lib.LocalProcessRunner)
 
-    topology = build_topology(cluster_name, cluster_info)
+    topology = build_topology(cluster_name, cluster_info,
+                              epoch=_existing_epoch(head, local, rt))
     if local:
         os.makedirs(rt, exist_ok=True)
         with open(skylet_constants.topology_path(rt), 'w',
@@ -115,6 +124,23 @@ def post_provision_runtime_setup(provider_name: str, cluster_name: str,
         raise exceptions.ClusterSetUpError(
             f'Failed to start skylet on head: {err or out}')
     return rt
+
+
+def _existing_epoch(head, local: bool, rt: str) -> Optional[str]:
+    """Epoch of an already-provisioned incarnation, if one is live:
+    re-running setup must NOT mint a new epoch (that would tell the
+    live skylet/gang daemons their cluster was replaced)."""
+    if local:
+        return skylet_constants.topology_epoch(rt)
+    try:
+        rc, out, _ = head.run(
+            f'cat {shlex.quote(rt)}/cluster_topology.json',
+            require_outputs=True)
+        if rc == 0 and out.strip():
+            return json.loads(out).get('epoch')
+    except Exception:  # noqa: BLE001 — fresh host: no topology yet
+        pass
+    return None
 
 
 # Runtime the framework needs on every host. TPU-VM images ship

@@ -32,14 +32,27 @@ def up(task, service_name: str, wait_seconds: float = 0.0
     lb_port = _free_port()
     serve_state.add_service(service_name, task.to_yaml_config(), lb_port,
                             controller_port=0)
-    log_path = serve_state.controller_log_path(service_name)
-    with open(log_path, 'ab') as log_f:
-        proc = subprocess.Popen(
-            [sys.executable, '-m', 'skypilot_tpu.serve.controller',
-             '--service-name', service_name],
-            stdout=log_f, stderr=log_f, start_new_session=True,
-            env=dict(os.environ, JAX_PLATFORMS='cpu'))
-    serve_state.set_service_controller(service_name, proc.pid)
+    from skypilot_tpu.utils import controller_utils
+    if controller_utils.controller_mode('serve') == 'dedicated':
+        from skypilot_tpu import execution
+        from skypilot_tpu import task as task_lib
+        handle = controller_utils.ensure_controller_cluster('serve')
+        cmd = controller_utils.controller_run_command(
+            handle, 'skypilot_tpu.serve.controller',
+            '--service-name', service_name)
+        ctrl = task_lib.Task(name=f'serve-ctrl-{service_name}',
+                             run=f'JAX_PLATFORMS=cpu {cmd}')
+        execution.exec_cmd(ctrl, cluster_name=handle.cluster_name,
+                           detach_run=True)
+    else:
+        log_path = serve_state.controller_log_path(service_name)
+        with open(log_path, 'ab') as log_f:
+            proc = subprocess.Popen(
+                [sys.executable, '-m', 'skypilot_tpu.serve.controller',
+                 '--service-name', service_name],
+                stdout=log_f, stderr=log_f, start_new_session=True,
+                env=dict(os.environ, JAX_PLATFORMS='cpu'))
+        serve_state.set_service_controller(service_name, proc.pid)
     if wait_seconds:
         deadline = time.time() + wait_seconds
         while time.time() < deadline:
@@ -79,24 +92,30 @@ def down(service_name: str, purge: bool = False) -> None:
                                    serve_state.ServiceStatus.SHUTTING_DOWN)
     # Controller notices and cleans up — but only wait for it if its
     # process is actually alive (it may have crashed FAILED earlier).
+    # A dedicated controller runs on its own cluster, where a local pid
+    # probe is meaningless: rely on its loop seeing SHUTTING_DOWN and
+    # removing the service row (its cluster job then exits).
+    from skypilot_tpu.utils import controller_utils
+    dedicated = controller_utils.controller_mode('serve') == 'dedicated'
     pid = service['controller_pid']
     controller_alive = False
-    if pid:
+    if pid and not dedicated:
         try:
             os.kill(pid, 0)
             controller_alive = True
         except (ProcessLookupError, PermissionError):
             pass
-    if controller_alive:
+    if controller_alive or dedicated:
         deadline = time.time() + 120
         while time.time() < deadline:
             if serve_state.get_service(service_name) is None:
                 return
             time.sleep(0.5)
-        try:
-            os.kill(pid, 15)
-        except ProcessLookupError:
-            pass
+        if pid and not dedicated:
+            try:
+                os.kill(pid, 15)
+            except ProcessLookupError:
+                pass
     from skypilot_tpu import task as task_lib
     from skypilot_tpu.serve import replica_managers
     task = task_lib.Task.from_yaml_config(service['task_yaml'])

@@ -14,6 +14,19 @@ from typing import Any, Dict, List, Optional
 from skypilot_tpu.utils import paths
 
 _lock = threading.Lock()
+
+
+def _after_fork_in_child() -> None:
+    """Fresh lock + connection in forked children: the parent process
+    is multi-threaded (API server), so the inherited lock may be held
+    by a thread that does not exist in the child."""
+    global _lock, _conn, _conn_path
+    _lock = threading.Lock()
+    _conn = None
+    _conn_path = None
+
+
+os.register_at_fork(after_in_child=_after_fork_in_child)
 _conn: Optional[sqlite3.Connection] = None
 _conn_path: Optional[str] = None
 

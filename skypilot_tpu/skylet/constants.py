@@ -74,3 +74,15 @@ def skylet_pid_path(rt: str) -> str:
 
 def skylet_log_path(rt: str) -> str:
     return os.path.join(rt, 'skylet.log')
+
+
+def topology_epoch(rt: str):
+    """Epoch of the current topology file, or None when it is gone.
+    Stale daemons from a previous incarnation of a same-named cluster
+    compare against this and exit on mismatch."""
+    import json
+    try:
+        with open(topology_path(rt), 'r', encoding='utf-8') as f:
+            return json.load(f).get('epoch')
+    except (OSError, ValueError):
+        return None

@@ -172,9 +172,16 @@ class GangRun:
                 t.start()
                 threads.append(t)
                 process_id += 1
-        # Gang watchdog: first failure kills the rest.
+        # Gang watchdog: first failure kills the rest; a vanished or
+        # re-provisioned (epoch change) topology kills everything too,
+        # so job processes never outlive their cluster incarnation.
+        epoch = constants.topology_epoch(self.rt)
         while any(t.is_alive() for t in threads):
             if self._failed.is_set():
+                self._kill_all()
+                break
+            if constants.topology_epoch(self.rt) != epoch:
+                self._log('cluster gone: killing gang')
                 self._kill_all()
                 break
             time.sleep(0.2)

@@ -23,7 +23,14 @@ def main() -> None:
         f.write(str(os.getpid()))
 
     evts = [events.JobSchedulerEvent(rt), events.AutostopEvent(rt)]
+    epoch = constants.topology_epoch(rt)
     while True:
+        # The topology file IS the cluster (written once per provision,
+        # never recreated by ticks). Gone → torn down behind our back;
+        # different epoch → the name was re-provisioned and we are the
+        # previous incarnation. Either way: die, don't linger.
+        if constants.topology_epoch(rt) != epoch:
+            return
         for e in evts:
             e.tick()
         time.sleep(1)

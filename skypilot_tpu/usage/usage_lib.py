@@ -22,6 +22,15 @@ _DISABLE_ENV = 'SKYTPU_DISABLE_USAGE_COLLECTION'
 _lock = threading.Lock()
 
 
+def _after_fork_in_child() -> None:
+    """Fresh lock in forked children (parent is multi-threaded)."""
+    global _lock
+    _lock = threading.Lock()
+
+
+os.register_at_fork(after_in_child=_after_fork_in_child)
+
+
 def disabled() -> bool:
     return os.environ.get(_DISABLE_ENV, '') not in ('', '0', 'false')
 

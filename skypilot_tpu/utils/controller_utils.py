@@ -1,0 +1,144 @@
+"""Dedicated controller clusters for managed jobs and serve.
+
+Reference analog: sky/utils/controller_utils.py:90 (`Controllers`
+registry: per-controller cluster name + default resources + config
+path) and :837 (`maybe_translate_local_file_mounts_and_sync_up`: the
+2-hop translation — a controller VM cannot see client-local files, so
+local file mounts/workdir are uploaded to a bucket and the task is
+rewritten to mount from there).
+
+Modes (config `jobs.controller.mode` / `serve.controller.mode`):
+  consolidated  (default) controllers run as processes of the API
+                server host — zero extra cost, single-host control
+                plane (the reference's jobs-consolidation deployment).
+  dedicated     controllers run as cluster jobs on a long-lived
+                controller cluster launched through the normal stack
+                (any cloud, incl. `local` for tests).
+"""
+import dataclasses
+import hashlib
+import os
+import shlex
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+
+
+@dataclasses.dataclass(frozen=True)
+class ControllerSpec:
+    kind: str                 # 'jobs' | 'serve'
+    cluster_name: str
+    default_resources: Dict[str, Any]
+
+
+CONTROLLERS: Dict[str, ControllerSpec] = {
+    'jobs': ControllerSpec(
+        kind='jobs', cluster_name='tsky-jobs-controller',
+        default_resources={'cpus': '4+', 'disk_size': 50}),
+    'serve': ControllerSpec(
+        kind='serve', cluster_name='tsky-serve-controller',
+        default_resources={'cpus': '4+', 'disk_size': 50}),
+}
+
+
+def controller_mode(kind: str) -> str:
+    from skypilot_tpu import config as config_lib
+    mode = config_lib.get_nested((kind, 'controller', 'mode'),
+                                 default='consolidated')
+    if mode not in ('consolidated', 'dedicated'):
+        raise exceptions.InvalidTaskError(
+            f'{kind}.controller.mode must be consolidated|dedicated, '
+            f'got {mode!r}')
+    return mode
+
+
+def controller_resources(kind: str):
+    """Resources for the controller cluster: config overrides merged
+    onto defaults (reference Controllers.controller_resources)."""
+    from skypilot_tpu import config as config_lib
+    from skypilot_tpu import resources as resources_lib
+    spec = CONTROLLERS[kind]
+    cfg = dict(spec.default_resources)
+    cfg.update(config_lib.get_nested((kind, 'controller', 'resources'),
+                                     default=None) or {})
+    return resources_lib.Resources.from_yaml_config(cfg)
+
+
+def ensure_controller_cluster(kind: str):
+    """Launch (or reuse) the dedicated controller cluster; returns its
+    handle. Idempotent: an UP cluster is reused by name."""
+    from skypilot_tpu import execution
+    from skypilot_tpu import state as state_lib
+    from skypilot_tpu import task as task_lib
+    spec = CONTROLLERS[kind]
+    record = state_lib.get_cluster_from_name(spec.cluster_name)
+    if record is not None and record['handle'] is not None and \
+            record['status'] == state_lib.ClusterStatus.UP:
+        return record['handle']
+    bootstrap = task_lib.Task(name=f'{kind}-controller-up', run=None)
+    bootstrap.set_resources(controller_resources(kind))
+    _, handle = execution.launch(bootstrap,
+                                 cluster_name=spec.cluster_name,
+                                 stream_logs=False)
+    return handle
+
+
+def controller_run_command(handle, module: str, *args: str) -> str:
+    """Shell command that runs `python -m <module> <args>` on the
+    controller cluster with the shipped package importable."""
+    from skypilot_tpu.provision import provisioner
+    from skypilot_tpu.utils import command_runner as runner_lib
+    from skypilot_tpu.backends import gang_backend
+    backend = gang_backend.GangBackend()
+    runners = backend._runners(handle)  # noqa: SLF001
+    local = isinstance(runners[0], runner_lib.LocalProcessRunner)
+    quoted = ' '.join(shlex.quote(a) for a in args)
+    if local:
+        import sys
+        import skypilot_tpu
+        pkg_parent = os.path.dirname(os.path.dirname(
+            os.path.abspath(skypilot_tpu.__file__)))
+        return (f'PYTHONPATH={shlex.quote(pkg_parent)}:$PYTHONPATH '
+                f'{shlex.quote(sys.executable)} -m {module} {quoted}')
+    return (f'PYTHONPATH={provisioner._PKG_REMOTE_DIR}'  # noqa: SLF001
+            f':$PYTHONPATH python3 -m {module} {quoted}')
+
+
+def translate_local_file_mounts(task, store_type: Optional[str] = None):
+    """2-hop file-mount translation (reference controller_utils.py:837):
+    a dedicated controller cannot read client-local paths, so every
+    local file mount (and the workdir) is uploaded into a bucket and
+    the task rewritten to COPY-mount from that bucket on the job
+    cluster. Returns the task (mutated)."""
+    from skypilot_tpu import config as config_lib
+    from skypilot_tpu.data import storage as storage_lib
+    store_type = store_type or config_lib.get_nested(
+        ('jobs', 'bucket', 'store'), default='local')
+    user = os.environ.get('SKYTPU_USER') or os.environ.get('USER', 'u')
+
+    def _bucketize(local_path: str, remote_dst: str) -> None:
+        digest = hashlib.sha1(
+            f'{user}:{local_path}:{remote_dst}'.encode()).hexdigest()[:10]
+        storage = storage_lib.Storage(
+            name=f'skytpu-mounts-{user}-{digest}',
+            source=local_path, store=store_type, mode='COPY',
+            persistent=False)
+        storage.sync()
+        # The upload happened HERE (first hop). Clear the client-local
+        # source so the controller host never tries to re-sync a path
+        # that only exists on the client.
+        storage.source = None
+        task.storage_mounts[remote_dst] = storage
+
+    if task.workdir and '://' not in task.workdir:
+        _bucketize(task.workdir, '~/sky_workdir')
+        task.workdir = None
+    for dst, src in list((task.file_mounts or {}).items()):
+        if '://' in src:
+            continue
+        if not os.path.exists(os.path.expanduser(src)):
+            raise exceptions.InvalidTaskError(
+                f'file_mount source {src!r} does not exist.')
+        _bucketize(os.path.expanduser(src), dst)
+        del task.file_mounts[dst]
+    return task

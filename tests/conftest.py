@@ -32,6 +32,37 @@ assert jax.devices()[0].platform == 'cpu'
 import pytest
 
 
+def _kill_processes_referencing(marker: str) -> None:
+    """SIGKILL processes whose cmdline/environ references `marker`
+    (a per-test HOME): tests may leave clusters UP on purpose, and
+    their skylet/gang daemons must die with the test's state dir."""
+    import glob
+    import signal as _signal
+
+    needle = marker.encode()
+    me = os.getpid()
+    for pid_dir in glob.glob('/proc/[0-9]*'):
+        try:
+            pid = int(os.path.basename(pid_dir))
+            if pid == me:
+                continue
+            with open(os.path.join(pid_dir, 'cmdline'), 'rb') as f:
+                cmd = f.read()
+            with open(os.path.join(pid_dir, 'environ'), 'rb') as f:
+                env = f.read()
+        except (OSError, ValueError):
+            continue
+        if needle not in cmd and needle not in env:
+            continue
+        try:
+            os.killpg(pid, _signal.SIGKILL)
+        except OSError:
+            try:
+                os.kill(pid, _signal.SIGKILL)
+            except OSError:
+                pass
+
+
 @pytest.fixture(autouse=True)
 def isolated_state(tmp_path, monkeypatch):
     """Point all on-disk state (~/.skytpu) at a per-test tmp dir."""
@@ -48,6 +79,7 @@ def isolated_state(tmp_path, monkeypatch):
     except ImportError:
         pass
     yield
+    _kill_processes_referencing(str(home))
 
 
 @pytest.fixture
@@ -82,25 +114,41 @@ def pytest_sessionfinish(session, exitstatus):
         return
     marker = basetemp.encode()
     me = os.getpid()
+
+    def _scan():
+        found = []
+        for pid_dir in glob.glob('/proc/[0-9]*'):
+            try:
+                pid = int(os.path.basename(pid_dir))
+            except ValueError:
+                continue
+            if pid == me:
+                continue
+            try:
+                with open(os.path.join(pid_dir, 'cmdline'), 'rb') as f:
+                    cmd = f.read()
+                with open(os.path.join(pid_dir, 'environ'), 'rb') as f:
+                    env = f.read()
+            except OSError:
+                continue
+            if marker in cmd or marker in env:
+                found.append((pid, cmd.replace(b'\0', b' ').decode(
+                    errors='replace').strip()))
+        return found
+
+    candidates = _scan()
+    if candidates:
+        # Grace re-check: orphan reapers and topology-watch daemons
+        # self-terminate within ~1s of their cluster dying — only
+        # processes that survive the grace window are true leaks.
+        import time as _time
+        _time.sleep(1.5)
+        alive = {pid for pid, _ in _scan()}
+        candidates = [(pid, cmd) for pid, cmd in candidates
+                      if pid in alive]
     leaked = []
-    for pid_dir in glob.glob('/proc/[0-9]*'):
-        try:
-            pid = int(os.path.basename(pid_dir))
-        except ValueError:
-            continue
-        if pid == me:
-            continue
-        try:
-            with open(os.path.join(pid_dir, 'cmdline'), 'rb') as f:
-                cmd = f.read()
-            with open(os.path.join(pid_dir, 'environ'), 'rb') as f:
-                env = f.read()
-        except OSError:
-            continue
-        if marker not in cmd and marker not in env:
-            continue
-        leaked.append((pid, cmd.replace(b'\0', b' ').decode(
-            errors='replace').strip()))
+    for pid, cmd in candidates:
+        leaked.append((pid, cmd))
         try:
             os.killpg(pid, _signal.SIGKILL)
         except OSError:

@@ -1,0 +1,177 @@
+"""Dedicated controller clusters + 2-hop file-mount translation.
+
+Reference analog: sky/utils/controller_utils.py:90 (Controllers),
+:837 (maybe_translate_local_file_mounts_and_sync_up),
+templates/jobs-controller.yaml.j2. The local cloud makes the full
+dedicated path real: the controller cluster is provisioned through the
+normal stack and the jobs controller runs as one of its cluster jobs.
+"""
+import json
+import os
+import time
+
+import pytest
+
+from skypilot_tpu import state as cluster_state
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.jobs import core as jobs_core
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.utils import controller_utils
+
+
+@pytest.fixture
+def dedicated_env(monkeypatch, enable_clouds):
+    """jobs.controller.mode=dedicated via the user config file so the
+    controller subprocess (spawned on the controller cluster) sees the
+    same mode; enabled-clouds cache on disk for the same reason."""
+    enable_clouds('local')
+    monkeypatch.setenv('SKYTPU_JOBS_POLL_INTERVAL', '0.3')
+    home = os.path.expanduser('~/.skytpu')
+    os.makedirs(home, exist_ok=True)
+    with open(os.path.join(home, 'config.yaml'), 'w',
+              encoding='utf-8') as f:
+        f.write('jobs:\n  controller:\n    mode: dedicated\n')
+    with open(os.path.join(home, 'enabled_clouds.json'), 'w',
+              encoding='utf-8') as f:
+        json.dump({'enabled': ['local']}, f)
+    from skypilot_tpu import config as config_lib
+    config_lib.reload()
+    jobs_state.reset_for_tests()
+    yield
+    config_lib.reload()
+    jobs_state.reset_for_tests()
+
+
+def _wait_status(job_id, statuses, timeout=90):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        record = jobs_state.get_job(job_id)
+        if record['status'] in statuses:
+            return record
+        time.sleep(0.3)
+    raise AssertionError(
+        f'job stuck in {jobs_state.get_job(job_id)["status"]}')
+
+
+class TestControllerRegistry:
+
+    def test_modes_config_driven(self, monkeypatch, tmp_path):
+        from skypilot_tpu import config as config_lib
+        assert controller_utils.controller_mode('jobs') == 'consolidated'
+        with config_lib.override(
+                {'jobs': {'controller': {'mode': 'dedicated'}}}):
+            assert controller_utils.controller_mode('jobs') == 'dedicated'
+        with config_lib.override(
+                {'jobs': {'controller': {'mode': 'nope'}}}):
+            with pytest.raises(Exception):
+                controller_utils.controller_mode('jobs')
+
+    def test_controller_resources_merge_config(self):
+        from skypilot_tpu import config as config_lib
+        res = controller_utils.controller_resources('jobs')
+        assert res.cpus == 4.0
+        with config_lib.override(
+                {'jobs': {'controller': {'resources': {'cpus': 16}}}}):
+            res = controller_utils.controller_resources('jobs')
+            assert res.cpus == 16.0
+
+
+class TestTwoHopTranslation:
+
+    def test_local_mounts_become_storage(self, tmp_path):
+        src = tmp_path / 'data'
+        src.mkdir()
+        (src / 'train.txt').write_text('2HOP-DATA')
+        wd = tmp_path / 'wd'
+        wd.mkdir()
+        (wd / 'main.py').write_text('print(1)')
+        task = task_lib.Task(run='true', workdir=str(wd),
+                             file_mounts={'/data': str(src)})
+        controller_utils.translate_local_file_mounts(task,
+                                                     store_type='local')
+        assert task.workdir is None
+        assert task.file_mounts == {}
+        assert set(task.storage_mounts) == {'~/sky_workdir', '/data'}
+        data_storage = task.storage_mounts['/data']
+        assert data_storage.mode.value == 'COPY'
+        # Upload really happened (local store = directory bucket).
+        from skypilot_tpu.data import storage as storage_lib
+        bucket_dir = data_storage.store._dir()  # noqa: SLF001
+        assert open(os.path.join(bucket_dir, 'train.txt')).read() == \
+            '2HOP-DATA'
+
+    def test_remote_sources_untouched(self):
+        task = task_lib.Task(run='true',
+                             file_mounts={'/d': 'gs://somebucket/x'})
+        controller_utils.translate_local_file_mounts(task,
+                                                     store_type='local')
+        assert task.file_mounts == {'/d': 'gs://somebucket/x'}
+        assert task.storage_mounts == {}
+
+    def test_missing_source_raises(self):
+        from skypilot_tpu import exceptions
+        task = task_lib.Task(run='true',
+                             file_mounts={'/d': '/definitely/not/here'})
+        with pytest.raises(exceptions.InvalidTaskError):
+            controller_utils.translate_local_file_mounts(
+                task, store_type='local')
+
+
+class TestDedicatedJobsController:
+
+    def test_job_runs_with_controller_on_cluster(self, dedicated_env,
+                                                 tmp_path):
+        """End-to-end: the controller itself executes as a cluster job
+        on tsky-jobs-controller; its managed job (with a 2-hop
+        translated mount) runs on a separate job cluster and succeeds."""
+        src = tmp_path / 'ds'
+        src.mkdir()
+        (src / 'f.txt').write_text('DEDICATED-OK')
+        task = task_lib.Task(run='cat /tmp/skytpu_2hop/f.txt',
+                             name='dj',
+                             file_mounts={'/tmp/skytpu_2hop': str(src)})
+        job_id = jobs_core.launch(task)
+        record = _wait_status(
+            job_id, {jobs_state.ManagedJobStatus.SUCCEEDED})
+        assert record['status'] == jobs_state.ManagedJobStatus.SUCCEEDED
+
+        # The controller cluster exists and ran the controller as one
+        # of ITS cluster jobs.
+        ctrl = cluster_state.get_cluster_from_name('tsky-jobs-controller')
+        assert ctrl is not None and ctrl['status'] == \
+            cluster_state.ClusterStatus.UP
+        from skypilot_tpu import core
+        queue = core.queue('tsky-jobs-controller')
+        assert any(f'jobs-ctrl-{job_id}' in str(j.get('job_name') or
+                                                j.get('name') or j)
+                   for j in queue), queue
+        core.down('tsky-jobs-controller', purge=True)
+
+    def test_recovery_with_dedicated_controller(self, dedicated_env,
+                                                tmp_path):
+        """Preempt the JOB cluster; the controller (on its own cluster)
+        must recover and finish (VERDICT round-1 done criterion)."""
+        from skypilot_tpu.utils import paths as paths_lib
+        sentinel = os.path.join(paths_lib.state_dir(), 'ded_marker')
+        run_cmd = (f'if [ -f {sentinel} ]; then echo second-life; '
+                   f'else touch {sentinel} && sleep 120; fi')
+        job_id = jobs_core.launch(task_lib.Task(run=run_cmd, name='djr'))
+        _wait_status(job_id, {jobs_state.ManagedJobStatus.RUNNING})
+        deadline = time.time() + 30
+        while not os.path.exists(sentinel) and time.time() < deadline:
+            time.sleep(0.2)
+        assert os.path.exists(sentinel)
+
+        record = jobs_state.get_job(job_id)
+        handle = cluster_state.get_cluster_from_name(
+            record['cluster_name'])['handle']
+        import shutil
+        shutil.rmtree(os.path.join(paths_lib.local_clusters_dir(),
+                                   handle.cluster_name_on_cloud),
+                      ignore_errors=True)
+
+        record = _wait_status(
+            job_id, {jobs_state.ManagedJobStatus.SUCCEEDED}, timeout=120)
+        assert record['recovery_count'] >= 1
+        from skypilot_tpu import core
+        core.down('tsky-jobs-controller', purge=True)
