@@ -429,6 +429,82 @@ def serve_logs_cmd(service_name, no_follow):
     _run_and_stream(sdk.serve_logs(service_name, follow=not no_follow))
 
 
+@cli.command('show-gpus')
+@click.argument('name_filter', required=False)
+def show_gpus(name_filter):
+    """List accelerators (GPUs and TPUs) with pricing per zone."""
+    from skypilot_tpu.client import sdk
+    accs = sdk.get(sdk.accelerators(name_filter), timeout=60)
+    fmt = '{:<12} {:<8} {:<20} {:>6} {:>10} {:>10}  {}'
+    click.echo(fmt.format('ACCELERATOR', 'CLOUD', 'INSTANCE', 'COUNT',
+                          '$/hr', 'SPOT$/hr', 'REGION'))
+    for name in sorted(accs):
+        for r in accs[name]:
+            spot = r['spot_price']
+            click.echo(fmt.format(
+                name, r['cloud'], r['instance_type'],
+                int(r['count']) if r['count'] == int(r['count'])
+                else r['count'],
+                f"{r['price']:.2f}",
+                f"{spot:.2f}" if spot is not None else '-',
+                r['region']))
+
+
+@cli.command('config')
+def show_config():
+    """Print the merged layered configuration."""
+    import yaml as _yaml
+    from skypilot_tpu import config as config_lib
+    config_lib.reload()
+    merged = config_lib.to_dict()
+    click.echo(_yaml.safe_dump(merged or {}, default_flow_style=False)
+               .rstrip() or '(empty)')
+
+
+@cli.command('dashboard')
+def dashboard_cmd():
+    """Print the dashboard URL (auto-starting the server)."""
+    from skypilot_tpu.client import sdk
+    sdk.ensure_server_running()
+    click.echo(f'{sdk.api_server_url()}/dashboard')
+
+
+@cli.group()
+def storage():
+    """Manage storage objects (buckets)."""
+
+
+@storage.command('ls')
+def storage_ls_cmd():
+    """List registered storage objects."""
+    from skypilot_tpu.client import sdk
+    rows = sdk.get(sdk.storage_ls(), timeout=60)
+    fmt = '{:<32} {:<8} {:<12} {}'
+    click.echo(fmt.format('NAME', 'STORE', 'WORKSPACE', 'SOURCE'))
+    for r in rows:
+        click.echo(fmt.format(r['name'], r['store'],
+                              r.get('workspace') or '-',
+                              r.get('source') or '-'))
+
+
+@storage.command('delete')
+@click.argument('names', nargs=-1)
+@click.option('--all', 'all_storage', is_flag=True)
+@click.option('--yes', '-y', is_flag=True)
+def storage_delete_cmd(names, all_storage, yes):
+    """Delete storage objects (bucket + record)."""
+    if not names and not all_storage:
+        raise click.UsageError('Pass storage names or --all.')
+    if not yes:
+        click.confirm(
+            f'Delete {"ALL storage" if all_storage else list(names)}?',
+            abort=True)
+    from skypilot_tpu.client import sdk
+    result = sdk.get(sdk.storage_delete(list(names) or None,
+                                        all_storage), timeout=300)
+    click.echo(f'Deleted: {result["deleted"]}')
+
+
 @cli.group()
 def api():
     """Manage the API server."""
@@ -466,6 +542,39 @@ def api_start():
 def api_logs(request_id):
     from skypilot_tpu.client import sdk
     sdk.stream(request_id, follow=False)
+
+
+@api.command('info')
+def api_info():
+    """Server URL, version, and API version."""
+    import json as _json
+    from skypilot_tpu.client import sdk
+    from skypilot_tpu.client.sdk import _request_raw
+    info = _request_raw('GET', '/health', timeout=5.0)
+    click.echo(f'URL: {sdk.api_server_url()}')
+    click.echo(_json.dumps(info, indent=1))
+
+
+@api.command('stop')
+def api_stop():
+    """Stop the local API server (reference `sky api stop`)."""
+    import os as _os
+    import signal as _signal
+    from skypilot_tpu.client import sdk
+    if _os.environ.get('SKYTPU_API_SERVER_URL'):
+        raise click.ClickException(
+            'Refusing to stop a remote API server '
+            '(SKYTPU_API_SERVER_URL is set); unset it to manage the '
+            'local one.')
+    pid = sdk.api_server_pid()
+    if pid is None:
+        click.echo('API server is not running.')
+        return
+    try:
+        _os.kill(pid, _signal.SIGTERM)
+    except ProcessLookupError:
+        pass
+    click.echo(f'Stopped API server (pid {pid}).')
 
 
 def main():

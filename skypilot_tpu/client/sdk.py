@@ -320,3 +320,26 @@ def serve_logs(service_name: str, follow: bool = True) -> str:
 def serve_update(task, service_name: str) -> str:
     return _submit('serve_update', {'task': task.to_yaml_config(),
                                     'service_name': service_name})
+
+
+def storage_ls() -> str:
+    return _submit('storage_ls', {})
+
+
+def storage_delete(names: Optional[List[str]] = None,
+                   all_storage: bool = False) -> str:
+    return _submit('storage_delete', {'names': names,
+                                      'all': all_storage})
+
+
+def accelerators(name_filter: Optional[str] = None) -> str:
+    return _submit('accelerators', {'name_filter': name_filter})
+
+
+def api_server_pid() -> Optional[int]:
+    """Pid of the (local) API server from its health endpoint."""
+    try:
+        info = _request_raw('GET', '/health', timeout=2.0)
+    except exceptions.ApiServerError:
+        return None
+    return info.get('pid') if info else None

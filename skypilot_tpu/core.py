@@ -144,3 +144,37 @@ def cost_report() -> List[Dict[str, Any]]:
             'total_cost': None,
         })
     return out
+
+
+# --- storage ---------------------------------------------------------------
+
+def storage_ls() -> List[Dict[str, Any]]:
+    """Registered storage objects (reference sky storage ls)."""
+    from skypilot_tpu import state as state_lib
+    return state_lib.get_storage()
+
+
+def storage_delete(names: Optional[List[str]] = None,
+                   all_storage: bool = False) -> List[str]:
+    """Delete storage objects: the backing bucket AND the record."""
+    from skypilot_tpu import state as state_lib
+    from skypilot_tpu.data import storage as storage_lib
+    records = state_lib.get_storage()
+    if not all_storage:
+        wanted = set(names or [])
+        records = [r for r in records if r['name'] in wanted]
+        missing = wanted - {r['name'] for r in records}
+        if missing:
+            raise exceptions.StorageError(
+                f'Storage not found: {sorted(missing)}')
+    deleted = []
+    for r in records:
+        store = storage_lib.make_store(
+            storage_lib.StoreType(r['store']), r['name'])
+        try:
+            store.delete()
+        except exceptions.StorageError:
+            pass  # bucket already gone: still drop the record
+        state_lib.remove_storage(r['name'])
+        deleted.append(r['name'])
+    return deleted

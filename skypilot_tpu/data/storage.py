@@ -369,9 +369,16 @@ class Storage:
             self.store.create()
         if self.source and '://' not in self.source:
             self.store.upload(self.source)
+        if self.persistent:
+            from skypilot_tpu import state as state_lib
+            state_lib.add_or_update_storage(self.name,
+                                            self.store.TYPE.value,
+                                            self.source)
 
     def delete(self) -> None:
         self.store.delete()
+        from skypilot_tpu import state as state_lib
+        state_lib.remove_storage(self.name)
 
     def mount_spec(self) -> Dict[str, str]:
         """The dict storage_mounting.mount_all consumes."""

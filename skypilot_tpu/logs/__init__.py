@@ -1,0 +1,33 @@
+"""External log shipping for task logs.
+
+Reference analog: sky/logs/__init__.py:10 (get_logging_agent),
+sky/logs/agent.py, sky/logs/gcp.py — a fluent-bit agent installed on
+cluster hosts tails the job log directory and ships to a cloud logging
+backend. Config:
+
+    logs:
+      store: gcp          # only backend implemented (TPU-first: logs
+                          # land next to the TPUs in Cloud Logging)
+      gcp:
+        project_id: my-project
+"""
+from typing import Optional
+
+from skypilot_tpu import exceptions
+
+
+def get_logging_agent() -> Optional['agent.LoggingAgent']:
+    """The configured agent, or None when shipping is disabled."""
+    from skypilot_tpu import config as config_lib
+    from skypilot_tpu.logs import agent as agent_lib
+    from skypilot_tpu.logs import gcp as gcp_logs
+    store = config_lib.get_nested(('logs', 'store'), default=None)
+    if store is None:
+        return None
+    if store == 'gcp':
+        return gcp_logs.GcpLoggingAgent()
+    raise exceptions.InvalidTaskError(
+        f'logs.store must be one of [gcp], got {store!r}')
+
+
+from skypilot_tpu.logs import agent  # noqa: E402,F401 (re-export)

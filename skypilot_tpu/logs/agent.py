@@ -1,0 +1,79 @@
+"""Logging-agent interface: install + run fluent-bit on cluster hosts.
+
+Reference analog: sky/logs/agent.py (FluentbitAgent: rendered config +
+idempotent install command run during provisioning).
+"""
+import shlex
+from typing import Dict, List
+
+# Job logs live under the runtime dir; the agent tails all of them.
+_FLUENTBIT_INSTALL = (
+    'command -v fluent-bit >/dev/null 2>&1 || '
+    '(curl -fsSL https://raw.githubusercontent.com/fluent/fluent-bit/'
+    'master/install.sh | sh)')
+
+
+class LoggingAgent:
+    """One external logging backend (subclass per cloud)."""
+
+    def fluentbit_output_config(self) -> Dict[str, str]:
+        """The [OUTPUT] section key/values for this backend."""
+        raise NotImplementedError
+
+    def render_config(self, runtime_dir: str, cluster_name: str) -> str:
+        """Full fluent-bit config tailing the cluster's job logs."""
+        output = ''.join(f'    {k} {v}\n'
+                         for k, v in
+                         self.fluentbit_output_config().items())
+        return (
+            '[SERVICE]\n'
+            '    Flush 5\n'
+            '    Daemon off\n'
+            '[INPUT]\n'
+            '    Name tail\n'
+            f'    Path {runtime_dir}/jobs/*/run.log\n'
+            '    Tag  skytpu.job\n'
+            '    Path_Key file\n'
+            '[FILTER]\n'
+            '    Name record_modifier\n'
+            '    Match *\n'
+            f'    Record cluster {cluster_name}\n'
+            '[OUTPUT]\n'
+            f'{output}')
+
+    def setup_command(self, runtime_dir: str, cluster_name: str) -> str:
+        """Idempotent shell: install fluent-bit, write config, (re)start
+        the agent in the background."""
+        config = self.render_config(runtime_dir, cluster_name)
+        conf_path = f'{runtime_dir}/fluentbit.conf'
+        pid_path = f'{runtime_dir}/fluentbit.pid'
+        q_conf, q_pid = shlex.quote(conf_path), shlex.quote(pid_path)
+        # Liveness via pidfile — a pgrep pattern would match the shell
+        # running THIS command (its cmdline contains the pattern).
+        return (
+            f'{_FLUENTBIT_INSTALL} && '
+            f'mkdir -p {shlex.quote(runtime_dir)} && '
+            f'printf %s {shlex.quote(config)} > {q_conf} && '
+            f'if ! (test -f {q_pid} && kill -0 $(cat {q_pid}) '
+            f'2>/dev/null); then '
+            f'nohup fluent-bit -c {q_conf} >/dev/null 2>&1 & '
+            f'echo $! > {q_pid}; fi')
+
+
+def setup_agent_on_cluster(runners: List, runtime_dir: str,
+                           cluster_name: str) -> None:
+    """Install + start the configured agent on every host (no-op when
+    log shipping is disabled). Failures are non-fatal: a cluster
+    without external logs is degraded, not broken."""
+    from skypilot_tpu import logs as logs_lib
+    from skypilot_tpu import sky_logging
+    logger = sky_logging.init_logger(__name__)
+    agent = logs_lib.get_logging_agent()
+    if agent is None:
+        return
+    cmd = agent.setup_command(runtime_dir, cluster_name)
+    for runner in runners:
+        rc, out, err = runner.run(cmd, require_outputs=True)
+        if rc != 0:
+            logger.warning('Log-shipping agent setup failed on %s: %s',
+                           runner.node_id, err or out)

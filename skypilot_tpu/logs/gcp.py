@@ -1,0 +1,26 @@
+"""GCP Cloud Logging backend for task-log shipping.
+
+Reference analog: sky/logs/gcp.py (fluentbit stackdriver output).
+TPU-first choice: clusters are TPU-VMs with a default service account
+that already holds logging.write, so no extra credential wiring is
+needed — the fluent-bit stackdriver output uses the metadata server.
+"""
+from typing import Dict
+
+from skypilot_tpu.logs import agent
+
+
+class GcpLoggingAgent(agent.LoggingAgent):
+
+    def fluentbit_output_config(self) -> Dict[str, str]:
+        from skypilot_tpu import config as config_lib
+        out = {
+            'Name': 'stackdriver',
+            'Match': '*',
+            'Resource': 'global',
+        }
+        project = config_lib.get_nested(('logs', 'gcp', 'project_id'),
+                                        default=None)
+        if project:
+            out['Project_ID'] = project
+        return out

@@ -287,7 +287,11 @@ def loss_fn(params: Params,
     if mask is None:
         mask = jnp.ones_like(tokens, jnp.float32)
     mask = mask.astype(jnp.float32).at[:, -1].set(0.0)
-    logprobs = jax.nn.log_softmax(logits, axis=-1)
-    token_ll = jnp.take_along_axis(
-        logprobs, targets[..., None], axis=-1)[..., 0]
+    # Fused CE: target logit minus logsumexp. Avoids materializing the
+    # full [B,S,V] log-probs tensor (536MB f32 at B2/S2048/V32k) that
+    # log_softmax+gather would keep live through the backward pass.
+    target_logit = jnp.take_along_axis(
+        logits, targets[..., None], axis=-1)[..., 0]
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    token_ll = target_logit - lse
     return -jnp.sum(token_ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)

@@ -123,6 +123,10 @@ def post_provision_runtime_setup(provider_name: str, cluster_name: str,
     if rc != 0:
         raise exceptions.ClusterSetUpError(
             f'Failed to start skylet on head: {err or out}')
+    if not local:
+        # Optional external log shipping (config logs.store).
+        from skypilot_tpu.logs import agent as logs_agent
+        logs_agent.setup_agent_on_cluster(runners, rt, cluster_name)
     return rt
 
 

@@ -9,6 +9,7 @@ stream its log. The server process is shared by all clients on a machine
 import asyncio
 import json
 import os
+import urllib.parse
 from typing import Any, Dict
 
 import skypilot_tpu
@@ -25,7 +26,7 @@ API_PREFIX = '/api/v1'
 _SHORT_REQUESTS = frozenset({
     'status', 'queue', 'cost_report', 'check', 'optimize', 'autostop',
     'cancel', 'jobs_launch', 'jobs_queue', 'jobs_cancel',
-    'serve_status',
+    'serve_status', 'storage_ls', 'accelerators',
 })
 
 
@@ -135,9 +136,12 @@ async def _handle_dashboard(request):
     def _rows(items, cols):
         out = ''
         for item in items:
-            cells = ''.join(
-                f'<td>{html_lib.escape(str(item.get(c, "")))}</td>'
-                for c in cols)
+            cells = ''
+            for c in cols:
+                value = str(item.get(c, ''))
+                if c != 'logs':  # logs cells carry trusted <a> markup
+                    value = html_lib.escape(value)
+                cells += f'<td>{value}</td>'
             out += f'<tr>{cells}</tr>'
         return out or f'<tr><td colspan={len(cols)}>none</td></tr>'
 
@@ -156,6 +160,8 @@ async def _handle_dashboard(request):
             'id': j['job_id'], 'name': j['name'],
             'status': j['status'].value,
             'recoveries': j['recovery_count'],
+            'logs': f'<a href="/dashboard/jobs/{j["job_id"]}/log">'
+                    'view</a>',
         } for j in jobs_state.get_jobs()]
     except Exception:  # noqa: BLE001
         pass
@@ -166,6 +172,9 @@ async def _handle_dashboard(request):
         services = [{
             'name': s['name'], 'status': s['status'].value,
             'endpoint': f'http://127.0.0.1:{s["lb_port"]}',
+            'logs': ('<a href="/dashboard/services/'
+                     + urllib.parse.quote(str(s['name']), safe='')
+                     + '/log">view</a>'),
         } for s in serve_state.get_services()]
     except Exception:  # noqa: BLE001
         pass
@@ -173,6 +182,8 @@ async def _handle_dashboard(request):
     reqs = [{
         'id': r['request_id'], 'name': r['name'],
         'status': r['status'].value,
+        'logs': f'<a href="/dashboard/requests/{r["request_id"]}/log">'
+                'view</a>',
     } for r in requests_db.list_requests(25)]
 
     def _table(title, items, cols):
@@ -188,11 +199,82 @@ async def _handle_dashboard(request):
         + _table('Clusters', clusters,
                  ['name', 'workspace', 'status', 'resources', 'nodes'])
         + _table('Managed jobs', jobs,
-                 ['id', 'name', 'status', 'recoveries'])
-        + _table('Services', services, ['name', 'status', 'endpoint'])
-        + _table('Recent requests', reqs, ['id', 'name', 'status'])
+                 ['id', 'name', 'status', 'recoveries', 'logs'])
+        + _table('Services', services,
+                 ['name', 'status', 'endpoint', 'logs'])
+        + _table('Recent requests', reqs,
+                 ['id', 'name', 'status', 'logs'])
         + '</body></html>')
     return web.Response(text=body, content_type='text/html')
+
+
+def _tail_file(path: str, limit: int = 200_000) -> str:
+    """Last `limit` bytes of a file without reading the whole thing."""
+    try:
+        with open(path, 'rb') as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - limit))
+            return f.read().decode('utf-8', errors='replace')
+    except FileNotFoundError:
+        return '(no log yet)'
+
+
+def _log_page(title: str, text: str) -> str:
+    import html as html_lib
+    return (
+        '<html><head><title>' + html_lib.escape(title) + '</title>'
+        '<meta http-equiv="refresh" content="5"></head>'
+        '<body style="font-family:monospace">'
+        f'<h2>{html_lib.escape(title)}</h2>'
+        '<a href="/dashboard">&larr; dashboard</a>'
+        f'<pre>{html_lib.escape(text)}</pre>'
+        '</body></html>')
+
+
+async def _handle_request_log(request):
+    """Log viewer for one API request (reference dashboard's xterm log
+    viewer, served as auto-refreshing HTML here)."""
+    from aiohttp import web
+    request_id = request.match_info['request_id']
+    record = requests_db.get_request(request_id)
+    if record is None:
+        raise web.HTTPNotFound(text='No such request')
+    text = _tail_file(requests_db.request_log_path(request_id))
+    title = f'request {request_id} [{record["name"]}] ' \
+            f'{record["status"].value}'
+    return web.Response(text=_log_page(title, text),
+                        content_type='text/html')
+
+
+async def _handle_job_log(request):
+    """Log viewer for a managed job's controller log."""
+    from aiohttp import web
+    try:
+        job_id = int(request.match_info['job_id'])
+    except ValueError:
+        raise web.HTTPNotFound(text='No such managed job')
+    from skypilot_tpu.jobs import state as jobs_state
+    record = jobs_state.get_job(job_id)
+    if record is None:
+        raise web.HTTPNotFound(text='No such managed job')
+    text = _tail_file(jobs_state.controller_log_path(job_id))
+    title = f'managed job {job_id} [{record["name"]}] ' \
+            f'{record["status"].value}'
+    return web.Response(text=_log_page(title, text),
+                        content_type='text/html')
+
+
+async def _handle_service_log(request):
+    """Log viewer for a service's controller log."""
+    from aiohttp import web
+    name = request.match_info['name']
+    from skypilot_tpu.serve import serve_state
+    if serve_state.get_service(name) is None:
+        raise web.HTTPNotFound(text='No such service')
+    text = _tail_file(serve_state.controller_log_path(name))
+    return web.Response(text=_log_page(f'service {name}', text),
+                        content_type='text/html')
 
 
 async def _handle_health(request):
@@ -226,6 +308,11 @@ def create_app():
     app.on_startup.append(_recover_orphans)
     app.router.add_get(f'{API_PREFIX}/health', _handle_health)
     app.router.add_get('/dashboard', _handle_dashboard)
+    app.router.add_get('/dashboard/requests/{request_id}/log',
+                       _handle_request_log)
+    app.router.add_get('/dashboard/jobs/{job_id}/log', _handle_job_log)
+    app.router.add_get('/dashboard/services/{name}/log',
+                       _handle_service_log)
     app.router.add_get(f'{API_PREFIX}/requests', _handle_list_requests)
     app.router.add_get(f'{API_PREFIX}/requests/{{request_id}}',
                        _handle_get_request)

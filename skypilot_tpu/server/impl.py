@@ -256,3 +256,35 @@ def serve_update(payload: Dict[str, Any]) -> Dict[str, Any]:
     from skypilot_tpu.serve import core as serve_core
     task = _load_task(payload)
     return serve_core.update(task, payload['service_name'])
+
+
+# --- storage ----------------------------------------------------------------
+
+@executor.register('storage_ls')
+def storage_ls(payload: Dict[str, Any]) -> List[Dict[str, Any]]:
+    from skypilot_tpu import core
+    return core.storage_ls()
+
+
+@executor.register('storage_delete')
+def storage_delete(payload: Dict[str, Any]) -> Dict[str, Any]:
+    from skypilot_tpu import core
+    deleted = core.storage_delete(names=payload.get('names'),
+                                  all_storage=payload.get('all', False))
+    return {'deleted': deleted}
+
+
+@executor.register('accelerators')
+def accelerators(payload: Dict[str, Any]) -> Dict[str, Any]:
+    """Accelerator availability/pricing across clouds (reference
+    `sky show-gpus`, catalog/__init__.py:57 list_accelerators)."""
+    from skypilot_tpu import catalog
+    out: Dict[str, Any] = {}
+    for name, rows in catalog.list_accelerators(
+            payload.get('name_filter')).items():
+        out[name] = [{
+            'cloud': r.cloud, 'instance_type': r.instance_type,
+            'count': r.accelerator_count, 'price': r.price,
+            'spot_price': r.spot_price, 'region': r.region,
+        } for r in rows]
+    return out

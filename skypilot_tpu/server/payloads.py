@@ -118,6 +118,12 @@ SCHEMAS: Dict[str, Dict[str, Field]] = {
         'follow': _opt(bool, default=True),
     },
     'serve_update': {'task': _TASK, 'service_name': _NAME},
+    'storage_ls': {},
+    'storage_delete': {
+        'names': _opt(list, element=str),
+        'all': _BOOL,
+    },
+    'accelerators': {'name_filter': _opt(str)},
 }
 
 # Fields the server itself injects (identity/workspace context); allowed

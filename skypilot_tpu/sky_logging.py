@@ -1,0 +1,101 @@
+"""Framework logging setup: per-module loggers with env-gated debug.
+
+Reference analog: sky/sky_logging.py (223 LoC). Usage:
+
+    from skypilot_tpu import sky_logging
+    logger = sky_logging.init_logger(__name__)
+
+Env vars:
+    SKYTPU_DEBUG=1                  everything at DEBUG
+    SKYTPU_DEBUG_MODULES=a,b        only modules whose dotted name
+                                    contains one of the fragments
+    SKYTPU_MINIMIZE_LOGGING=1       WARNING+ only (scripting/CI)
+"""
+import logging
+import os
+import sys
+import threading
+from typing import Optional
+
+_FORMAT = '%(levelname).1s %(asctime)s %(name)s:%(lineno)d] %(message)s'
+_DATE_FORMAT = '%m-%d %H:%M:%S'
+
+_lock = threading.Lock()
+_root_initialized = False
+
+
+def _debug_all() -> bool:
+    return os.environ.get('SKYTPU_DEBUG', '').lower() in ('1', 'true')
+
+
+def _debug_fragments():
+    raw = os.environ.get('SKYTPU_DEBUG_MODULES', '')
+    return [f.strip() for f in raw.split(',') if f.strip()]
+
+
+def _minimized() -> bool:
+    return os.environ.get('SKYTPU_MINIMIZE_LOGGING', '').lower() in (
+        '1', 'true')
+
+
+def _level_for(name: str) -> int:
+    if _debug_all():
+        return logging.DEBUG
+    for fragment in _debug_fragments():
+        if fragment in name:
+            return logging.DEBUG
+    if _minimized():
+        return logging.WARNING
+    return logging.INFO
+
+
+def _ensure_root_handler() -> None:
+    global _root_initialized
+    with _lock:
+        if _root_initialized:
+            return
+        root = logging.getLogger('skypilot_tpu')
+        if not root.handlers:
+            handler = logging.StreamHandler(sys.stderr)
+            handler.setFormatter(
+                logging.Formatter(_FORMAT, datefmt=_DATE_FORMAT))
+            root.addHandler(handler)
+        root.propagate = False
+        _root_initialized = True
+
+
+def init_logger(name: str) -> logging.Logger:
+    """Module logger with the env-derived level applied."""
+    _ensure_root_handler()
+    logger = logging.getLogger(name)
+    logger.setLevel(_level_for(name))
+    return logger
+
+
+def reload_levels() -> None:
+    """Re-apply env-derived levels to every existing framework logger
+    (tests / long-lived servers after env changes)."""
+    for name, logger in logging.Logger.manager.loggerDict.items():
+        if isinstance(logger, logging.Logger) and \
+                name.startswith('skypilot_tpu'):
+            logger.setLevel(_level_for(name))
+
+
+class SuppressOutput:
+    """Context manager silencing a logger temporarily (reference
+    sky_logging.silent())."""
+
+    def __init__(self, name: str = 'skypilot_tpu',
+                 level: int = logging.ERROR) -> None:
+        self._name = name
+        self._level = level
+        self._previous: Optional[int] = None
+
+    def __enter__(self):
+        logger = logging.getLogger(self._name)
+        self._previous = logger.level
+        logger.setLevel(self._level)
+        return self
+
+    def __exit__(self, *exc):
+        logging.getLogger(self._name).setLevel(self._previous)

@@ -93,6 +93,15 @@ def _create_tables(conn: sqlite3.Connection) -> None:
             key TEXT PRIMARY KEY,
             value TEXT
         )""")
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS storage (
+            name TEXT PRIMARY KEY,
+            store TEXT,
+            source TEXT,
+            launched_at INTEGER,
+            last_use TEXT,
+            workspace TEXT DEFAULT 'default'
+        )""")
     # Migration for pre-workspace DBs.
     cols = [r[1] for r in conn.execute('PRAGMA table_info(clusters)')]
     if 'workspace' not in cols:
@@ -262,3 +271,44 @@ def get_cluster_history() -> List[Dict[str, Any]]:
     return [{'cluster_hash': r[0], 'name': r[1], 'launched_at': r[2],
              'duration_s': r[3], 'resources_str': r[4], 'num_nodes': r[5]}
             for r in rows]
+
+
+# --- storage registry (reference global_user_state storage table :104) ------
+
+def add_or_update_storage(name: str, store: str,
+                          source: Optional[str] = None) -> None:
+    conn = _get_conn()
+    now = int(time.time())
+    with _lock:
+        conn.execute(
+            """INSERT INTO storage (name, store, source, launched_at,
+                                    last_use, workspace)
+               VALUES (?,?,?,?,?,?)
+               ON CONFLICT(name) DO UPDATE SET
+                 store=excluded.store, source=excluded.source,
+                 last_use=excluded.last_use,
+                 workspace=excluded.workspace""",
+            (name, store, source, now, str(now), active_workspace()))
+        conn.commit()
+
+
+def get_storage(all_workspaces: bool = False) -> List[Dict[str, Any]]:
+    conn = _get_conn()
+    q = ('SELECT name, store, source, launched_at, last_use, workspace '
+         'FROM storage')
+    if all_workspaces:
+        rows = conn.execute(q + ' ORDER BY launched_at DESC').fetchall()
+    else:
+        rows = conn.execute(
+            q + ' WHERE workspace=? ORDER BY launched_at DESC',
+            (active_workspace(),)).fetchall()
+    return [{'name': r[0], 'store': r[1], 'source': r[2],
+             'launched_at': r[3], 'last_use': r[4], 'workspace': r[5]}
+            for r in rows]
+
+
+def remove_storage(name: str) -> None:
+    conn = _get_conn()
+    with _lock:
+        conn.execute('DELETE FROM storage WHERE name=?', (name,))
+        conn.commit()

@@ -30,6 +30,9 @@ class TrainerConfig:
     batch_size: int = 8          # global
     seq_len: int = 512
     grad_clip: float = 1.0
+    # Adam first moment dtype: 'bfloat16' halves its HBM footprint
+    # (standard large-model practice); None keeps f32.
+    mu_dtype: Optional[str] = None
 
     def model_config(self):
         import skypilot_tpu.models as models_lib
@@ -46,10 +49,11 @@ def make_optimizer(cfg: TrainerConfig):
         init_value=0.0, peak_value=cfg.learning_rate,
         warmup_steps=cfg.warmup_steps,
         decay_steps=max(cfg.max_steps, cfg.warmup_steps + 1))
+    mu_dtype = jnp.bfloat16 if cfg.mu_dtype == 'bfloat16' else None
     return optax.chain(
         optax.clip_by_global_norm(cfg.grad_clip),
         optax.adamw(schedule, b1=0.9, b2=0.95,
-                    weight_decay=cfg.weight_decay),
+                    weight_decay=cfg.weight_decay, mu_dtype=mu_dtype),
     )
 
 

@@ -11,13 +11,14 @@ from skypilot_tpu import users
 READ_COMMANDS: FrozenSet[str] = frozenset({
     'status', 'queue', 'cost_report', 'check', 'optimize', 'logs',
     'jobs_queue', 'jobs_logs', 'serve_status', 'serve_logs',
+    'storage_ls', 'accelerators',
 })
 
 # Mutating commands available to ROLE_USER and above.
 WRITE_COMMANDS: FrozenSet[str] = frozenset({
     'launch', 'exec', 'start', 'stop', 'down', 'autostop', 'cancel',
     'jobs_launch', 'jobs_cancel', 'serve_up', 'serve_down',
-    'serve_update',
+    'serve_update', 'storage_delete',
 })
 
 

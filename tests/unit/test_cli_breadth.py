@@ -1,0 +1,113 @@
+"""CLI breadth: show-gpus, storage ls/delete, config, api info/stop.
+
+Reference analog: sky show-gpus / sky storage / sky api (client CLI,
+sky/client/cli/command.py).
+"""
+import pytest
+from click.testing import CliRunner
+
+from skypilot_tpu.client import cli as cli_mod
+from skypilot_tpu.server import app as app_mod
+from skypilot_tpu.server import requests_db
+
+
+@pytest.fixture
+def server(monkeypatch):
+    requests_db.reset_for_tests()
+    with app_mod.ServerThread() as srv:
+        monkeypatch.setenv('SKYTPU_API_SERVER_URL', srv.url)
+        yield srv
+    requests_db.reset_for_tests()
+
+
+def test_show_gpus_lists_tpus_and_gpus(server):
+    result = CliRunner().invoke(cli_mod.cli, ['show-gpus'])
+    assert result.exit_code == 0, result.output
+    assert 'tpu-v5p' in result.output
+    assert 'A100' in result.output
+    # AWS rows prove the multi-cloud catalog is consulted.
+    assert 'p4d.24xlarge' in result.output
+
+
+def test_show_gpus_filter(server):
+    result = CliRunner().invoke(cli_mod.cli, ['show-gpus', 'tpu'])
+    assert result.exit_code == 0
+    assert 'tpu-v5e' in result.output
+    assert 'A100' not in result.output
+
+
+def test_storage_ls_and_delete_roundtrip(server, tmp_path):
+    from skypilot_tpu.data import storage as storage_lib
+    src = tmp_path / 'd'
+    src.mkdir()
+    (src / 'x.txt').write_text('x')
+    storage = storage_lib.Storage(name='cli-bkt', source=str(src),
+                                  store='local')
+    storage.sync()
+
+    result = CliRunner().invoke(cli_mod.cli, ['storage', 'ls'])
+    assert result.exit_code == 0, result.output
+    assert 'cli-bkt' in result.output
+    assert 'local' in result.output
+
+    result = CliRunner().invoke(
+        cli_mod.cli, ['storage', 'delete', 'cli-bkt', '--yes'])
+    assert result.exit_code == 0, result.output
+    assert 'cli-bkt' in result.output
+    result = CliRunner().invoke(cli_mod.cli, ['storage', 'ls'])
+    assert 'cli-bkt' not in result.output
+    assert not storage.store.exists()
+
+
+def test_storage_delete_requires_target(server):
+    result = CliRunner().invoke(cli_mod.cli, ['storage', 'delete'])
+    assert result.exit_code != 0
+    assert '--all' in result.output
+
+
+def test_api_info(server):
+    result = CliRunner().invoke(cli_mod.cli, ['api', 'info'])
+    assert result.exit_code == 0, result.output
+    assert 'api_version' in result.output
+
+
+def test_api_stop_refuses_remote(server):
+    # SKYTPU_API_SERVER_URL is set by the fixture → treated as remote.
+    result = CliRunner().invoke(cli_mod.cli, ['api', 'stop'])
+    assert result.exit_code != 0
+    assert 'remote' in result.output.lower()
+
+
+def test_config_prints_merged_yaml(server, monkeypatch):
+    import os
+    cfg_path = os.path.expanduser('~/.skytpu/config.yaml')
+    os.makedirs(os.path.dirname(cfg_path), exist_ok=True)
+    with open(cfg_path, 'w', encoding='utf-8') as f:
+        f.write('jobs:\n  controller:\n    mode: consolidated\n')
+    result = CliRunner().invoke(cli_mod.cli, ['config'])
+    assert result.exit_code == 0
+    assert 'consolidated' in result.output
+
+
+def test_dashboard_log_viewer(server):
+    import urllib.request
+    from skypilot_tpu.client import sdk
+    request_id = sdk.status()
+    sdk.get(request_id, timeout=30)
+    with urllib.request.urlopen(f'{server.url}/dashboard',
+                                timeout=10) as resp:
+        page = resp.read().decode()
+    assert f'/dashboard/requests/{request_id}/log' in page
+    with urllib.request.urlopen(
+            f'{server.url}/dashboard/requests/{request_id}/log',
+            timeout=10) as resp:
+        log_page = resp.read().decode()
+    assert 'request ' + request_id in log_page
+    # Unknown ids 404 instead of leaking paths.
+    import urllib.error
+    try:
+        urllib.request.urlopen(
+            f'{server.url}/dashboard/requests/nope/log', timeout=10)
+        raise AssertionError('expected 404')
+    except urllib.error.HTTPError as e:
+        assert e.code == 404

@@ -1,0 +1,106 @@
+"""Logging subsystem: env-gated module loggers + log-shipping agent.
+
+Reference analog: sky/sky_logging.py and sky/logs/ (fluentbit agent).
+"""
+import logging
+
+import pytest
+
+from skypilot_tpu import sky_logging
+
+
+class TestSkyLogging:
+
+    def test_default_info(self, monkeypatch):
+        monkeypatch.delenv('SKYTPU_DEBUG', raising=False)
+        monkeypatch.delenv('SKYTPU_DEBUG_MODULES', raising=False)
+        logger = sky_logging.init_logger('skypilot_tpu.test.mod')
+        assert logger.level == logging.INFO
+
+    def test_debug_all(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_DEBUG', '1')
+        logger = sky_logging.init_logger('skypilot_tpu.test.mod2')
+        assert logger.level == logging.DEBUG
+
+    def test_debug_per_module(self, monkeypatch):
+        monkeypatch.delenv('SKYTPU_DEBUG', raising=False)
+        monkeypatch.setenv('SKYTPU_DEBUG_MODULES', 'provision,serve')
+        assert sky_logging.init_logger(
+            'skypilot_tpu.provision.gcp').level == logging.DEBUG
+        assert sky_logging.init_logger(
+            'skypilot_tpu.jobs.core').level == logging.INFO
+
+    def test_minimized(self, monkeypatch):
+        monkeypatch.delenv('SKYTPU_DEBUG', raising=False)
+        monkeypatch.delenv('SKYTPU_DEBUG_MODULES', raising=False)
+        monkeypatch.setenv('SKYTPU_MINIMIZE_LOGGING', '1')
+        assert sky_logging.init_logger(
+            'skypilot_tpu.x').level == logging.WARNING
+
+    def test_suppress_context(self):
+        logger = sky_logging.init_logger('skypilot_tpu.sup')
+        before = logger.level
+        with sky_logging.SuppressOutput('skypilot_tpu.sup'):
+            assert logging.getLogger(
+                'skypilot_tpu.sup').level == logging.ERROR
+        assert logging.getLogger('skypilot_tpu.sup').level == before
+
+
+class TestLogShipping:
+
+    def test_disabled_by_default(self):
+        from skypilot_tpu import logs as logs_lib
+        assert logs_lib.get_logging_agent() is None
+
+    def test_gcp_agent_from_config(self):
+        from skypilot_tpu import config as config_lib
+        from skypilot_tpu import logs as logs_lib
+        from skypilot_tpu.logs import gcp as gcp_logs
+        with config_lib.override(
+                {'logs': {'store': 'gcp',
+                          'gcp': {'project_id': 'proj-x'}}}):
+            agent = logs_lib.get_logging_agent()
+            assert isinstance(agent, gcp_logs.GcpLoggingAgent)
+            config = agent.render_config('/rt', 'c1')
+            assert 'stackdriver' in config
+            assert 'Project_ID proj-x' in config
+            assert '/rt/jobs/*/run.log' in config
+            assert 'Record cluster c1' in config
+
+    def test_unknown_store_rejected(self):
+        from skypilot_tpu import config as config_lib
+        from skypilot_tpu import exceptions
+        from skypilot_tpu import logs as logs_lib
+        with config_lib.override({'logs': {'store': 'splunk'}}):
+            with pytest.raises(exceptions.InvalidTaskError):
+                logs_lib.get_logging_agent()
+
+    def test_setup_runs_on_every_host_when_enabled(self):
+        from skypilot_tpu import config as config_lib
+        from skypilot_tpu.logs import agent as agent_lib
+
+        class FakeRunner:
+            node_id = 'h'
+
+            def __init__(self):
+                self.cmds = []
+
+            def run(self, cmd, **kw):
+                self.cmds.append(cmd)
+                return 0, '', ''
+
+        runners = [FakeRunner(), FakeRunner()]
+        with config_lib.override({'logs': {'store': 'gcp'}}):
+            agent_lib.setup_agent_on_cluster(runners, '/rt', 'c1')
+        assert all('fluent-bit' in r.cmds[0] for r in runners)
+
+    def test_setup_noop_when_disabled(self):
+        from skypilot_tpu.logs import agent as agent_lib
+
+        class Exploding:
+            node_id = 'h'
+
+            def run(self, cmd, **kw):
+                raise AssertionError('must not run')
+
+        agent_lib.setup_agent_on_cluster([Exploding()], '/rt', 'c1')
