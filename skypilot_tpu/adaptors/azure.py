@@ -1,0 +1,151 @@
+"""Azure Resource Manager (ARM) adaptor: JSON REST with az-CLI auth.
+
+Reference analog: sky/adaptors/azure.py wraps the azure SDK; ours talks
+the ARM REST API directly (the azure SDK stack is not a dependency in
+this build) behind an injectable client so unit tests run the full
+provisioner against an in-memory ARM fake — same pattern as the GCP
+transport and AWS client fakes.
+
+Client interface (real and fake):
+    request(method, path, params=None, json_body=None) -> dict
+`path` is relative to https://management.azure.com and must carry its
+api-version in `params`.
+"""
+import json
+import subprocess
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Callable, Dict, Optional
+
+from skypilot_tpu import exceptions
+
+ARM_ENDPOINT = 'https://management.azure.com'
+COMPUTE_API_VERSION = '2023-09-01'
+NETWORK_API_VERSION = '2023-09-01'
+
+
+class AzureApiError(exceptions.ProvisionError):
+    def __init__(self, message: str, code: str = '', status: int = 0):
+        super().__init__(message)
+        self.code = code
+        self.status = status
+
+
+def classify_api_error(err: 'AzureApiError') -> exceptions.ProvisionError:
+    """ARM error codes → failover taxonomy (stockout/quota errors are
+    retryable in another region), mirroring the reference's
+    FailoverCloudErrorHandler treatment of azure errors."""
+    code = err.code
+    if code in ('SkuNotAvailable', 'AllocationFailed',
+                'ZonalAllocationFailed', 'OverconstrainedAllocationRequest'):
+        return exceptions.CapacityError(str(err))
+    if code in ('QuotaExceeded', 'OperationNotAllowed') or \
+            'Quota' in code:
+        return exceptions.QuotaExceededError(str(err))
+    return err
+
+
+def _az_token() -> str:
+    proc = subprocess.run(
+        ['az', 'account', 'get-access-token', '--output', 'json'],
+        capture_output=True, timeout=30, check=False)
+    if proc.returncode != 0:
+        raise exceptions.ProvisionError(
+            'Cannot obtain an Azure access token: '
+            f'{proc.stderr.decode(errors="replace").strip()}')
+    return json.loads(proc.stdout)['accessToken']
+
+
+def default_subscription() -> str:
+    import os
+    sub = os.environ.get('AZURE_SUBSCRIPTION_ID')
+    if sub:
+        return sub
+    proc = subprocess.run(
+        ['az', 'account', 'show', '--query', 'id', '--output', 'tsv'],
+        capture_output=True, timeout=15, check=False)
+    sub = proc.stdout.decode().strip()
+    if proc.returncode != 0 or not sub:
+        raise exceptions.ProvisionError(
+            'No Azure subscription configured; set AZURE_SUBSCRIPTION_ID '
+            'or run `az login`.')
+    return sub
+
+
+class ArmClient:
+    """Real ARM REST client (bearer token from the az CLI)."""
+
+    def __init__(self) -> None:
+        self._token: Optional[str] = None
+        self._lock = threading.Lock()
+
+    def _headers(self) -> Dict[str, str]:
+        with self._lock:
+            if self._token is None:
+                self._token = _az_token()
+            return {'Authorization': f'Bearer {self._token}',
+                    'Content-Type': 'application/json'}
+
+    def request(self, method: str, path: str,
+                params: Optional[Dict[str, str]] = None,
+                json_body: Optional[Dict[str, Any]] = None
+                ) -> Dict[str, Any]:
+        url = f'{ARM_ENDPOINT}{path}'
+        if params:
+            url += f'?{urllib.parse.urlencode(params)}'
+        data = None
+        if json_body is not None:
+            data = json.dumps(json_body).encode()
+        req = urllib.request.Request(url, data=data,
+                                     headers=self._headers(),
+                                     method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                body = resp.read()
+        except urllib.error.HTTPError as e:
+            payload = e.read().decode(errors='replace')
+            code = ''
+            try:
+                code = json.loads(payload).get('error', {}).get('code', '')
+            except (json.JSONDecodeError, AttributeError):
+                pass
+            raise AzureApiError(
+                f'{method} {path}: HTTP {e.code}: {payload[:500]}',
+                code=code, status=e.code) from e
+        except urllib.error.URLError as e:
+            raise AzureApiError(f'{method} {path}: {e.reason}') from e
+        return json.loads(body) if body else {}
+
+
+_client_factory: Callable[[], Any] = ArmClient
+_client: Optional[Any] = None
+_lock = threading.Lock()
+
+
+def _after_fork_in_child() -> None:
+    """Fresh lock in forked children (parent is multi-threaded)."""
+    global _lock, _client
+    _lock = threading.Lock()
+    _client = None
+
+
+import os  # noqa: E402
+os.register_at_fork(after_in_child=_after_fork_in_child)
+
+
+def set_client_factory(factory: Callable[[], Any]) -> None:
+    """Test hook: inject a fake ARM (drops the cached client)."""
+    global _client_factory, _client
+    with _lock:
+        _client_factory = factory
+        _client = None
+
+
+def client() -> Any:
+    global _client
+    with _lock:
+        if _client is None:
+            _client = _client_factory()
+        return _client

@@ -11,6 +11,7 @@ from skypilot_tpu.catalog.common import InstanceTypeInfo
 _CATALOG_MODULES = {
     'gcp': 'skypilot_tpu.catalog.gcp_catalog',
     'aws': 'skypilot_tpu.catalog.aws_catalog',
+    'azure': 'skypilot_tpu.catalog.azure_catalog',
     'local': 'skypilot_tpu.catalog.local_catalog',
     'kubernetes': 'skypilot_tpu.catalog.kubernetes_catalog',
 }

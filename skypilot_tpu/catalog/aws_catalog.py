@@ -2,48 +2,9 @@
 
 Reference analog: sky/catalog/aws_catalog.py (CSV-backed lookups).
 No TPU rows — TPUs are GCP-only; AWS serves as the second VM cloud for
-controllers, CPU workers, and GPU recipes, proving the multi-cloud
-abstraction (VERDICT round-1 item #3).
+controllers, CPU workers, and GPU recipes.
 """
-from typing import Dict, List, Optional
-
 from skypilot_tpu.catalog import common
 
-
-def _vm_df():
-    return common.read_catalog('aws', 'vms')
-
-
-def list_accelerators(name_filter: Optional[str] = None
-                      ) -> Dict[str, List[common.InstanceTypeInfo]]:
-    out: Dict[str, List[common.InstanceTypeInfo]] = {}
-    df = _vm_df()
-    if not len(df):
-        return out
-    gpu = df[df['accelerator_name'].notna()]
-    for row in gpu.itertuples():
-        name = row.accelerator_name
-        if name_filter and name_filter.lower() not in name.lower():
-            continue
-        out.setdefault(name, []).append(common.vm_row_to_info('aws', row))
-    return out
-
-
-def get_feasible(resources) -> List[common.InstanceTypeInfo]:
-    from skypilot_tpu.utils import accelerators as acc_lib
-    acc = resources.sole_accelerator()
-    if acc is not None and acc_lib.is_tpu(acc[0]):
-        return []  # no TPUs on AWS
-    return common.vm_catalog_feasible('aws', _vm_df(), resources)
-
-
-def validate_region_zone(region: Optional[str],
-                         zone: Optional[str]) -> bool:
-    df = _vm_df()
-    if not len(df):
-        return True
-    if region is not None and region not in set(df['region']):
-        return False
-    if zone is not None and zone not in set(df['zone']):
-        return False
-    return True
+list_accelerators, get_feasible, validate_region_zone = \
+    common.make_vm_catalog('aws')

@@ -1,0 +1,11 @@
+"""Azure catalog: VM/GPU instance types from the shipped CSV.
+
+Reference analog: sky/catalog/azure_catalog.py. No TPU rows (GCP-only);
+zones are not modeled — Azure schedules within a region unless
+availability zones are pinned, which the CSV doesn't carry (the
+reference treats Azure zones the same way).
+"""
+from skypilot_tpu.catalog import common
+
+list_accelerators, get_feasible, validate_region_zone = \
+    common.make_vm_catalog('azure', zones_modeled=False)

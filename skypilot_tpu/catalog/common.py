@@ -64,6 +64,11 @@ def vm_row_to_info(cloud: str, row) -> InstanceTypeInfo:
     acc = row.accelerator_name
     if isinstance(acc, float) and pd.isna(acc):
         acc = None
+    zone = row.zone
+    if isinstance(zone, float) and pd.isna(zone):
+        # Zone-less catalogs (Azure): NaN is truthy and poisons
+        # 'infra/region/zone' strings — normalize to None.
+        zone = None
     return InstanceTypeInfo(
         cloud=cloud, instance_type=row.instance_type,
         accelerator_name=acc,
@@ -72,7 +77,7 @@ def vm_row_to_info(cloud: str, row) -> InstanceTypeInfo:
         memory_gb=_float_or_none(row.memory_gb),
         price=float(row.price),
         spot_price=_float_or_none(row.spot_price),
-        region=row.region, zone=row.zone)
+        region=row.region, zone=zone)
 
 
 def vm_feasible(info: InstanceTypeInfo, resources, acc) -> bool:
@@ -114,3 +119,46 @@ def vm_catalog_feasible(cloud: str, df, resources) -> List[InstanceTypeInfo]:
                            acc)]
     rows.sort(key=lambda r: r.cost(resources.use_spot))
     return rows
+
+
+def make_vm_catalog(cloud: str, zones_modeled: bool = True):
+    """Catalog module functions for a plain VM cloud (no TPUs):
+    (list_accelerators, get_feasible, validate_region_zone) over
+    data/<cloud>/vms.csv. AWS and Azure share this shape verbatim."""
+
+    def _vm_df():
+        return read_catalog(cloud, 'vms')
+
+    def list_accelerators(name_filter=None):
+        out = {}
+        df = _vm_df()
+        if not len(df):
+            return out
+        gpu = df[df['accelerator_name'].notna()]
+        for row in gpu.itertuples():
+            name = row.accelerator_name
+            if name_filter and name_filter.lower() not in name.lower():
+                continue
+            out.setdefault(name, []).append(vm_row_to_info(cloud, row))
+        return out
+
+    def get_feasible(resources):
+        from skypilot_tpu.utils import accelerators as acc_lib
+        acc = resources.sole_accelerator()
+        if acc is not None and acc_lib.is_tpu(acc[0]):
+            return []  # TPUs are GCP-only
+        return vm_catalog_feasible(cloud, _vm_df(), resources)
+
+    def validate_region_zone(region, zone):
+        df = _vm_df()
+        if not len(df):
+            return True
+        if region is not None and region not in set(df['region']):
+            return False
+        if zone is not None:
+            if not zones_modeled:
+                return False
+            return zone in set(df['zone'])
+        return True
+
+    return list_accelerators, get_feasible, validate_region_zone

@@ -4,10 +4,11 @@ Reference analog: sky/catalog/data_fetchers/fetch_gcp.py:34-67,456-536
 (TPU SKU scraping + hidden-zone patches). Ours walks the public
 cloudbilling v1 SKU list for the Compute Engine service, extracts TPU
 chip-hour SKUs (on-demand + spot; commitment SKUs excluded), and
-rewrites skypilot_tpu/catalog/data/gcp/tpus.csv. (vms.csv is shipped
-static; a VM core/ram fetcher is future work.) Runs through the same
-injectable transport as the provisioner, so tests feed it fake SKU
-pages.
+rewrites skypilot_tpu/catalog/data/gcp/tpus.csv. VM prices are
+assembled the way GCP bills them — per-core + per-GB-RAM SKUs per
+family, plus GPU SKUs for accelerator shapes — into vms.csv. Runs
+through the same injectable transport as the provisioner, so tests
+feed it fake SKU pages.
 
 Usage:
     python -m skypilot_tpu.catalog.data_fetchers.fetch_gcp --out-dir ...
@@ -74,10 +75,11 @@ def _usage_kind(sku: Dict[str, Any]) -> Optional[str]:
     return None
 
 
-def fetch_tpu_rows() -> List[Dict[str, Any]]:
+def fetch_tpu_rows(skus: Optional[List[Dict[str, Any]]] = None
+                   ) -> List[Dict[str, Any]]:
     """(generation, region, price/chip/hr, spot price) rows."""
     by_key: Dict[Tuple[str, str], Dict[str, Any]] = {}
-    for sku in _list_skus():
+    for sku in (skus if skus is not None else _list_skus()):
         desc = sku.get('description', '')
         match = _TPU_DESC_RE.search(desc)
         if not match:
@@ -105,6 +107,127 @@ def fetch_tpu_rows() -> List[Dict[str, Any]]:
     return [r for r in by_key.values() if r['price_per_chip'] is not None]
 
 
+# VM shapes priced from per-core/per-GB SKUs; (name, cpus, ram_gb,
+# gpus). GCP bills N2/A2 as core-hours + GB-hours (+ GPU-hours).
+_VM_SHAPES = {
+    'n2': [(f'n2-standard-{c}', c, 4 * c, 0) for c in (2, 4, 8, 16, 32)],
+    'a2': [(f'a2-highgpu-{g}g', 12 * g, 85 * g, g) for g in (1, 2, 4, 8)],
+}
+# Spot SKUs are described as 'Spot Preemptible <FAMILY> Instance ...',
+# so the family match must not be anchored at the start.
+_CORE_RE = re.compile(r'\b(N2|A2) Instance Core', re.IGNORECASE)
+_RAM_RE = re.compile(r'\b(N2|A2) Instance Ram', re.IGNORECASE)
+_GPU_RE = re.compile(r'Nvidia Tesla A100 GPU', re.IGNORECASE)
+
+
+def fetch_zones_by_region(project: str) -> Dict[str, List[str]]:
+    """region -> real zone names from the compute API (fabricating
+    '<region>-a/-b' would advertise zones some regions don't have,
+    e.g. us-east1 has only b/c/d)."""
+    t = gcp_adaptor.transport()
+    out: Dict[str, List[str]] = {}
+    page_token: Optional[str] = None
+    url = f'{gcp_adaptor.COMPUTE_API}/projects/{project}/zones'
+    while True:
+        params = {'maxResults': '500'}
+        if page_token:
+            params['pageToken'] = page_token
+        resp = t.request('GET', url, params=params)
+        for zone in resp.get('items', []):
+            name = zone.get('name', '')
+            region = name.rsplit('-', 1)[0]
+            out.setdefault(region, []).append(name)
+        page_token = resp.get('nextPageToken')
+        if not page_token:
+            return out
+
+
+def fetch_vm_rows(skus: Optional[List[Dict[str, Any]]] = None,
+                  zones_by_region: Optional[Dict[str, List[str]]] = None
+                  ) -> List[Dict[str, Any]]:
+    """vms.csv rows from core/ram/GPU SKUs (reference fetch_gcp VM
+    pricing assembly). `zones_by_region` comes from the compute zones
+    API; without it, '<region>-a/-b' are assumed (best-effort)."""
+    # (family, region) -> {'core': {kind: $}, 'ram': {...}}, and
+    # region -> {kind: $} for A100 GPUs.
+    parts: Dict[Tuple[str, str], Dict[str, Dict[str, float]]] = {}
+    gpu_prices: Dict[str, Dict[str, float]] = {}
+    for sku in (skus if skus is not None else _list_skus()):
+        desc = sku.get('description', '')
+        kind = _usage_kind(sku)
+        if kind is None:
+            continue
+        price = _sku_usd_per_hour(sku)
+        if price is None or price < 0:
+            continue
+        component = None
+        family = None
+        core_m = _CORE_RE.search(desc)
+        ram_m = _RAM_RE.search(desc)
+        if core_m:
+            component, family = 'core', core_m.group(1).lower()
+        elif ram_m:
+            component, family = 'ram', ram_m.group(1).lower()
+        elif _GPU_RE.search(desc):
+            for region in sku.get('serviceRegions', []):
+                entry = gpu_prices.setdefault(region, {})
+                if kind not in entry or price < entry[kind]:
+                    entry[kind] = price
+            continue
+        if component is None:
+            continue
+        for region in sku.get('serviceRegions', []):
+            slot = parts.setdefault((family, region),
+                                    {'core': {}, 'ram': {}})[component]
+            if kind not in slot or price < slot[kind]:
+                slot[kind] = price
+
+    rows: List[Dict[str, Any]] = []
+    for (family, region), price_parts in sorted(parts.items()):
+        core, ram = price_parts['core'], price_parts['ram']
+        if 'ondemand' not in core or 'ondemand' not in ram:
+            continue
+        for name, cpus, ram_gb, gpus in _VM_SHAPES.get(family, []):
+            gpu = gpu_prices.get(region, {})
+            if gpus and 'ondemand' not in gpu:
+                continue
+            price = (core['ondemand'] * cpus + ram['ondemand'] * ram_gb
+                     + gpu.get('ondemand', 0.0) * gpus)
+            spot = None
+            if 'spot' in core and 'spot' in ram and (
+                    not gpus or 'spot' in gpu):
+                spot = (core['spot'] * cpus + ram['spot'] * ram_gb
+                        + gpu.get('spot', 0.0) * gpus)
+            zones = (zones_by_region or {}).get(
+                region, [f'{region}-a', f'{region}-b'])[:2]
+            for zone in zones:
+                rows.append({
+                    'instance_type': name,
+                    'accelerator_name': 'A100' if gpus else '',
+                    'accelerator_count': gpus,
+                    'cpus': cpus, 'memory_gb': ram_gb,
+                    'price': round(price, 4),
+                    'spot_price': (round(spot, 4) if spot is not None
+                                   else ''),
+                    'region': region,
+                    'zone': zone,
+                })
+    return rows
+
+
+def write_vm_csv(rows: List[Dict[str, Any]], path: str) -> int:
+    rows = sorted(rows, key=lambda r: (r['instance_type'], r['zone']))
+    with open(path, 'w', newline='', encoding='utf-8') as f:
+        writer = csv.DictWriter(
+            f, fieldnames=['instance_type', 'accelerator_name',
+                           'accelerator_count', 'cpus', 'memory_gb',
+                           'price', 'spot_price', 'region', 'zone'])
+        writer.writeheader()
+        for row in rows:
+            writer.writerow(row)
+    return len(rows)
+
+
 def write_tpu_csv(rows: List[Dict[str, Any]], path: str) -> int:
     rows = sorted(rows, key=lambda r: (r['generation'], r['region']))
     with open(path, 'w', newline='', encoding='utf-8') as f:
@@ -124,9 +247,18 @@ def main() -> None:
     parser.add_argument('--out-dir', default=default_out)
     args = parser.parse_args()
     os.makedirs(args.out_dir, exist_ok=True)
-    n = write_tpu_csv(fetch_tpu_rows(),
+    skus = list(_list_skus())  # one paginated walk feeds both builders
+    n = write_tpu_csv(fetch_tpu_rows(skus),
                       os.path.join(args.out_dir, 'tpus.csv'))
     print(f'wrote {n} TPU rows to {args.out_dir}/tpus.csv')
+    zones = None
+    try:
+        zones = fetch_zones_by_region(gcp_adaptor.default_project())
+    except Exception as e:  # noqa: BLE001 — zone list is best-effort
+        print(f'zones API unavailable ({e}); assuming <region>-a/-b')
+    n = write_vm_csv(fetch_vm_rows(skus, zones),
+                     os.path.join(args.out_dir, 'vms.csv'))
+    print(f'wrote {n} VM rows to {args.out_dir}/vms.csv')
 
 
 if __name__ == '__main__':

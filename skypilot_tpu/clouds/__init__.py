@@ -1,12 +1,14 @@
 """Cloud policy classes. Importing this package registers all clouds."""
 from skypilot_tpu.clouds.cloud import Cloud, CloudCapability
 from skypilot_tpu.clouds import aws as _aws  # noqa: F401 (registers)
+from skypilot_tpu.clouds import azure as _azure  # noqa: F401 (registers)
 from skypilot_tpu.clouds import gcp as _gcp  # noqa: F401 (registers)
 from skypilot_tpu.clouds import local as _local  # noqa: F401 (registers)
 from skypilot_tpu.clouds import ssh as _ssh  # noqa: F401 (registers)
 from skypilot_tpu.utils.registry import CLOUD_REGISTRY
 
 AWS = _aws.AWS
+Azure = _azure.Azure
 GCP = _gcp.GCP
 Local = _local.Local
 SSH = _ssh.SSHCloud
@@ -22,5 +24,5 @@ def get_cloud(name: str) -> Cloud:
     return CLOUD_REGISTRY.get(name)()
 
 
-__all__ = ['Cloud', 'CloudCapability', 'AWS', 'GCP', 'Local', 'get_cloud',
+__all__ = ['Cloud', 'CloudCapability', 'AWS', 'Azure', 'GCP', 'Local', 'get_cloud',
            'CLOUD_REGISTRY']

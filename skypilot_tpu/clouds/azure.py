@@ -1,0 +1,80 @@
+"""Azure cloud policy — third VM cloud.
+
+Reference analog: sky/clouds/azure.py (725 LoC). No TPUs: Azure serves
+controllers, CPU workers, and GPU recipes, widening the failover pool
+the optimizer can draw from.
+"""
+import subprocess
+from typing import Dict, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.utils import registry
+
+
+@registry.CLOUD_REGISTRY.register(name='azure')
+class Azure(cloud.Cloud):
+    NAME = 'azure'
+    CAPABILITIES = frozenset({
+        cloud.CloudCapability.MULTI_NODE,
+        cloud.CloudCapability.SPOT_INSTANCE,
+        cloud.CloudCapability.STOP,
+        cloud.CloudCapability.AUTOSTOP,
+        cloud.CloudCapability.OPEN_PORTS,
+        cloud.CloudCapability.STORAGE_MOUNT,
+        cloud.CloudCapability.CUSTOM_IMAGE,
+        cloud.CloudCapability.HOST_CONTROLLERS,
+    })
+    # Cluster name feeds resource-group/VM names: RFC-1035-ish, and VM
+    # computer names cap at 64; leave headroom for '-<index>'.
+    MAX_CLUSTER_NAME_LENGTH = 42
+
+    def provision_module(self) -> str:
+        return 'skypilot_tpu.provision.azure'
+
+    def make_deploy_variables(self, resources, cluster_name_on_cloud: str,
+                              region: str, zone: Optional[str]
+                              ) -> Dict[str, object]:
+        resources.assert_launchable()
+        from skypilot_tpu import config as config_lib
+        auth = self.authentication_config()
+        variables: Dict[str, object] = {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region,
+            'zone': zone,
+            'instance_type': resources.instance_type,
+            'use_spot': resources.use_spot,
+            'disk_size': resources.disk_size,
+            'labels': dict(resources.labels),
+            'ports': list(resources.ports or []),
+            'subscription_id': config_lib.get_nested(
+                ('azure', 'subscription_id')),
+            'use_internal_ips': bool(
+                config_lib.get_nested(('azure', 'use_internal_ips'),
+                                      default=False)),
+            'ssh_user': auth.get('ssh_user'),
+            'ssh_private_key': auth.get('ssh_private_key'),
+            'num_nodes': None,  # filled by the provisioner
+        }
+        if resources.image_id:
+            variables['image_id'] = resources.image_id
+        return variables
+
+    def authentication_config(self) -> Dict[str, object]:
+        from skypilot_tpu import authentication
+        return authentication.authentication_config()
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        import os
+        if os.environ.get('AZURE_SUBSCRIPTION_ID'):
+            return True, None
+        try:
+            proc = subprocess.run(
+                ['az', 'account', 'show', '--query', 'id',
+                 '--output', 'tsv'],
+                capture_output=True, timeout=10, check=False)
+            if proc.returncode == 0 and proc.stdout.strip():
+                return True, None
+        except (FileNotFoundError, subprocess.TimeoutExpired):
+            pass
+        return False, ('Azure credentials not found. Run `az login` or '
+                       'set AZURE_SUBSCRIPTION_ID.')

@@ -109,9 +109,11 @@ def _provision_with_failover(dag, task, backend, cluster_name: str,
         if reuse:
             to_provision = None
         else:
+            # --dryrun exists to SHOW the plan: keep the optimizer
+            # table (reference `sky launch --dryrun` prints it too).
             optimizer_lib.Optimizer.optimize(
                 dag, minimize=optimize_target, blocked_resources=blocked,
-                quiet=(dryrun or not stream_logs))
+                quiet=not stream_logs)
             to_provision = task.best_resources
         if dryrun:
             return None, True

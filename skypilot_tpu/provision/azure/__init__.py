@@ -1,0 +1,411 @@
+"""Azure VM provisioner — third VM cloud behind the uniform interface.
+
+Reference analog: sky/provision/azure/ (1301 LoC, azure SDK). Ours
+drives ARM REST through the injectable adaptor client. Azure-first
+simplification: every cluster lives in its own resource group
+(`skytpu-<cluster>`), so terminate is a single resource-group delete
+and nothing can leak. VM/NIC/IP names are deterministic per node
+index; SSH keys ride osProfile.linuxConfiguration (no agent needed).
+"""
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.adaptors import azure as azure_adaptor
+from skypilot_tpu.provision import common
+from skypilot_tpu.utils import command_runner
+
+logger = logging.getLogger(__name__)
+
+CLUSTER_TAG = 'skytpu-cluster'
+HEAD_TAG = 'skytpu-head'
+INDEX_TAG = 'skytpu-index'
+
+_RG_API = '2021-04-01'
+_DEFAULT_IMAGE = {
+    'publisher': 'Canonical',
+    'offer': '0001-com-ubuntu-server-jammy',
+    'sku': '22_04-lts-gen2',
+    'version': 'latest',
+}
+
+_POWER_MAP = {
+    'PowerState/running': 'running',
+    'PowerState/starting': 'pending',
+    'PowerState/stopping': 'stopping',
+    'PowerState/stopped': 'stopped',
+    'PowerState/deallocating': 'stopping',
+    'PowerState/deallocated': 'stopped',
+}
+
+
+def _sub(pc: Dict[str, Any]) -> str:
+    sub = pc.get('subscription_id')
+    if not sub:
+        sub = azure_adaptor.default_subscription()
+        pc['subscription_id'] = sub
+    return sub
+
+
+def _rg(cluster_name_on_cloud: str) -> str:
+    return f'skytpu-{cluster_name_on_cloud}'
+
+
+def _rg_path(sub: str, rg: str) -> str:
+    return f'/subscriptions/{sub}/resourceGroups/{rg}'
+
+
+def _compute(sub: str, rg: str, kind: str, name: str = '') -> str:
+    base = (f'{_rg_path(sub, rg)}/providers/Microsoft.Compute/{kind}')
+    return f'{base}/{name}' if name else base
+
+
+def _network(sub: str, rg: str, kind: str, name: str = '') -> str:
+    base = (f'{_rg_path(sub, rg)}/providers/Microsoft.Network/{kind}')
+    return f'{base}/{name}' if name else base
+
+
+def _cparams() -> Dict[str, str]:
+    return {'api-version': azure_adaptor.COMPUTE_API_VERSION}
+
+
+def _nparams() -> Dict[str, str]:
+    return {'api-version': azure_adaptor.NETWORK_API_VERSION}
+
+
+def _ensure_network(client, sub: str, rg: str, region: str) -> None:
+    """VNet + subnet + SSH-open NSG, idempotent PUTs."""
+    client.request('PUT', _network(sub, rg, 'networkSecurityGroups',
+                                   'skytpu-nsg'),
+                   params=_nparams(), json_body={
+        'location': region,
+        'properties': {'securityRules': [{
+            'name': 'ssh',
+            'properties': {
+                'priority': 1000, 'direction': 'Inbound',
+                'access': 'Allow', 'protocol': 'Tcp',
+                'sourceAddressPrefix': '*', 'sourcePortRange': '*',
+                'destinationAddressPrefix': '*',
+                'destinationPortRange': '22',
+            }}]},
+    })
+    client.request('PUT', _network(sub, rg, 'virtualNetworks',
+                                   'skytpu-vnet'),
+                   params=_nparams(), json_body={
+        'location': region,
+        'properties': {
+            'addressSpace': {'addressPrefixes': ['10.10.0.0/16']},
+            'subnets': [{
+                'name': 'default',
+                'properties': {'addressPrefix': '10.10.0.0/24'},
+            }],
+        },
+    })
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    pc = config.provider_config
+    pc.setdefault('region', region)
+    sub = _sub(pc)
+    rg = _rg(cluster_name_on_cloud)
+    client = azure_adaptor.client()
+    nc = {**pc, **config.node_config}
+
+    try:
+        client.request('PUT', _rg_path(sub, rg),
+                       params={'api-version': _RG_API},
+                       json_body={'location': region,
+                                  'tags': {CLUSTER_TAG:
+                                           cluster_name_on_cloud}})
+        _ensure_network(client, sub, rg, region)
+
+        existing = {vm['name']: vm for vm in _list_vms(client, sub, rg)}
+        created: List[str] = []
+        resumed: List[str] = []
+        for i in range(config.count):
+            name = f'{cluster_name_on_cloud}-{i}'
+            vm = existing.get(name)
+            state = _vm_state(vm) if vm else None
+            if state in ('running', 'pending'):
+                continue
+            if state == 'stopped' and config.resume_stopped_nodes:
+                client.request(
+                    'POST',
+                    _compute(sub, rg, 'virtualMachines', name) + '/start',
+                    params=_cparams())
+                resumed.append(name)
+                continue
+            if state is not None:
+                # stopped-without-resume / stopping: re-PUTting the VM
+                # model would NOT power it on — refuse, like AWS.
+                raise exceptions.ProvisionError(
+                    f'Node {i} of {cluster_name_on_cloud} is {state}; '
+                    'cannot make progress.')
+            _create_vm(client, sub, rg, region, name, i,
+                       cluster_name_on_cloud, config, nc)
+            created.append(name)
+        _wait_running(client, sub, rg,
+                      timeout=float(pc.get('provision_timeout', 900)))
+    except azure_adaptor.AzureApiError as e:
+        raise azure_adaptor.classify_api_error(e) from e
+    return common.ProvisionRecord(
+        provider_name='azure', region=region, zone=None,
+        cluster_name_on_cloud=cluster_name_on_cloud,
+        head_instance_id=f'{cluster_name_on_cloud}-0',
+        created_instance_ids=created, resumed_instance_ids=resumed)
+
+
+def _create_vm(client, sub: str, rg: str, region: str, name: str,
+               index: int, cluster_name_on_cloud: str,
+               config: common.ProvisionConfig,
+               nc: Dict[str, Any]) -> None:
+    subnet_id = (f'{_network(sub, rg, "virtualNetworks", "skytpu-vnet")}'
+                 f'/subnets/default')
+    nsg_id = _network(sub, rg, 'networkSecurityGroups', 'skytpu-nsg')
+    client.request('PUT', _network(sub, rg, 'publicIPAddresses',
+                                   f'{name}-ip'),
+                   params=_nparams(), json_body={
+        'location': region,
+        'properties': {'publicIPAllocationMethod': 'Static'},
+    })
+    client.request('PUT', _network(sub, rg, 'networkInterfaces',
+                                   f'{name}-nic'),
+                   params=_nparams(), json_body={
+        'location': region,
+        'properties': {
+            'networkSecurityGroup': {'id': nsg_id},
+            'ipConfigurations': [{
+                'name': 'primary',
+                'properties': {
+                    'subnet': {'id': subnet_id},
+                    'publicIPAddress': {
+                        'id': _network(sub, rg, 'publicIPAddresses',
+                                       f'{name}-ip')},
+                },
+            }],
+        },
+    })
+    auth = config.authentication_config
+    ssh_user = auth.get('ssh_user', 'skytpu')
+    body = {
+        'location': region,
+        'tags': {
+            CLUSTER_TAG: cluster_name_on_cloud,
+            HEAD_TAG: 'true' if index == 0 else 'false',
+            INDEX_TAG: str(index),
+            **config.tags,
+        },
+        'properties': {
+            'hardwareProfile': {
+                'vmSize': nc.get('instance_type', 'Standard_D8s_v5')},
+            'storageProfile': {
+                'imageReference': nc.get('image_reference',
+                                         _DEFAULT_IMAGE),
+                'osDisk': {
+                    'createOption': 'FromImage',
+                    'diskSizeGB': int(nc.get('disk_size', 256)),
+                    'managedDisk': {
+                        'storageAccountType': 'Premium_LRS'},
+                },
+            },
+            'osProfile': {
+                'computerName': name,
+                'adminUsername': ssh_user,
+                'linuxConfiguration': {
+                    'disablePasswordAuthentication': True,
+                    'ssh': {'publicKeys': [{
+                        'path': f'/home/{ssh_user}/.ssh/authorized_keys',
+                        'keyData': auth.get('ssh_public_key_content',
+                                            ''),
+                    }]},
+                },
+            },
+            'networkProfile': {'networkInterfaces': [{
+                'id': _network(sub, rg, 'networkInterfaces',
+                               f'{name}-nic')}]},
+        },
+    }
+    if nc.get('use_spot'):
+        body['properties']['priority'] = 'Spot'
+        body['properties']['evictionPolicy'] = 'Deallocate'
+    client.request('PUT', _compute(sub, rg, 'virtualMachines', name),
+                   params=_cparams(), json_body=body)
+
+
+def _list_vms(client, sub: str, rg: str) -> List[Dict[str, Any]]:
+    try:
+        resp = client.request(
+            'GET', _compute(sub, rg, 'virtualMachines'),
+            params={**_cparams(), '$expand': 'instanceView'})
+    except azure_adaptor.AzureApiError as e:
+        if e.status == 404 or e.code == 'ResourceGroupNotFound':
+            return []
+        raise
+    return resp.get('value') or []
+
+
+def _vm_state(vm: Dict[str, Any]) -> str:
+    statuses = (vm.get('properties', {}).get('instanceView', {})
+                .get('statuses') or [])
+    for status in statuses:
+        mapped = _POWER_MAP.get(status.get('code', ''))
+        if mapped:
+            return mapped
+    prov = vm.get('properties', {}).get('provisioningState', 'Creating')
+    return 'running' if prov == 'Succeeded' else 'pending'
+
+
+def _wait_running(client, sub: str, rg: str,
+                  timeout: float = 900.0) -> None:
+    deadline = time.time() + timeout
+    while True:
+        vms = _list_vms(client, sub, rg)
+        if vms and all(_vm_state(v) == 'running' for v in vms):
+            return
+        if time.time() > deadline:
+            raise exceptions.ProvisionError(
+                'Timed out waiting for running: '
+                f'{ {v["name"]: _vm_state(v) for v in vms} }')
+        time.sleep(5.0)
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: Optional[str] = None) -> None:
+    # run_instances already waits for its VMs (the subscription id
+    # lives in provider_config, which this hook doesn't receive).
+    del region, cluster_name_on_cloud, state
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Dict[str, Any]) -> None:
+    sub = _sub(provider_config)
+    rg = _rg(cluster_name_on_cloud)
+    client = azure_adaptor.client()
+    for vm in _list_vms(client, sub, rg):
+        if _vm_state(vm) == 'running':
+            client.request(
+                'POST',
+                _compute(sub, rg, 'virtualMachines', vm['name']) +
+                '/deallocate', params=_cparams())
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Dict[str, Any]) -> None:
+    """Delete the whole resource group: VMs, NICs, IPs, disks — gone."""
+    sub = _sub(provider_config)
+    client = azure_adaptor.client()
+    try:
+        client.request('DELETE',
+                       _rg_path(sub, _rg(cluster_name_on_cloud)),
+                       params={'api-version': _RG_API})
+    except azure_adaptor.AzureApiError as e:
+        if e.status != 404 and e.code != 'ResourceGroupNotFound':
+            raise
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Dict[str, Any]
+                    ) -> Dict[str, Optional[str]]:
+    sub = _sub(provider_config)
+    client = azure_adaptor.client()
+    return {vm['name']: _vm_state(vm)
+            for vm in _list_vms(client, sub,
+                                _rg(cluster_name_on_cloud))}
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Dict[str, Any]) -> common.ClusterInfo:
+    del region
+    sub = _sub(provider_config)
+    rg = _rg(cluster_name_on_cloud)
+    client = azure_adaptor.client()
+    instances: Dict[str, common.InstanceInfo] = {}
+    head_id: Optional[str] = None
+    for vm in _list_vms(client, sub, rg):
+        if _vm_state(vm) != 'running':
+            continue
+        name = vm['name']
+        nic = client.request(
+            'GET', _network(sub, rg, 'networkInterfaces', f'{name}-nic'),
+            params=_nparams())
+        ipcfg = (nic.get('properties', {}).get('ipConfigurations')
+                 or [{}])[0].get('properties', {})
+        internal = ipcfg.get('privateIPAddress', '')
+        external = None
+        if ipcfg.get('publicIPAddress'):
+            ip_res = client.request(
+                'GET', _network(sub, rg, 'publicIPAddresses',
+                                f'{name}-ip'), params=_nparams())
+            external = ip_res.get('properties', {}).get('ipAddress')
+        tags = vm.get('tags') or {}
+        instances[name] = common.InstanceInfo(
+            instance_id=name,
+            hosts=[common.HostInfo(host_id=name, internal_ip=internal,
+                                   external_ip=external)],
+            status='running', tags=tags)
+        if tags.get(HEAD_TAG) == 'true':
+            head_id = name
+    if head_id is None and instances:
+        head_id = sorted(instances)[0]
+    return common.ClusterInfo(
+        instances=instances, head_instance_id=head_id,
+        provider_name='azure', provider_config=provider_config,
+        ssh_user=provider_config.get('ssh_user', 'skytpu'),
+        ssh_private_key=provider_config.get('ssh_private_key'))
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str],
+               provider_config: Dict[str, Any]) -> None:
+    """Extra inbound rules on the cluster NSG."""
+    sub = _sub(provider_config)
+    rg = _rg(cluster_name_on_cloud)
+    client = azure_adaptor.client()
+    nsg = client.request('GET', _network(sub, rg,
+                                         'networkSecurityGroups',
+                                         'skytpu-nsg'),
+                         params=_nparams())
+    rules = nsg.get('properties', {}).get('securityRules', [])
+    existing_names = {r.get('name') for r in rules}
+    priority = 1100 + len(rules)
+    added = 0
+    for port in ports:
+        lo, _, hi = str(port).partition('-')
+        name = f'skytpu-port-{lo}'
+        if name in existing_names:
+            continue  # idempotent relaunch: rule already present
+        rules.append({
+            'name': name,
+            'properties': {
+                'priority': priority + added, 'direction': 'Inbound',
+                'access': 'Allow', 'protocol': 'Tcp',
+                'sourceAddressPrefix': '*', 'sourcePortRange': '*',
+                'destinationAddressPrefix': '*',
+                'destinationPortRange': f'{lo}-{hi}' if hi else lo,
+            }})
+        added += 1
+    if not added:
+        return
+    client.request('PUT', _network(sub, rg, 'networkSecurityGroups',
+                                   'skytpu-nsg'),
+                   params=_nparams(), json_body={
+        'location': nsg.get('location',
+                            provider_config.get('region', '')),
+        'properties': {'securityRules': rules},
+    })
+
+
+def get_command_runners(cluster_info: common.ClusterInfo
+                        ) -> List[command_runner.CommandRunner]:
+    runners: List[command_runner.CommandRunner] = []
+    use_internal = bool(
+        cluster_info.provider_config.get('use_internal_ips', False))
+    for inst in cluster_info.ordered_instances():
+        for host in inst.hosts:
+            runners.append(command_runner.SSHCommandRunner(
+                host.get_ip(use_internal=use_internal),
+                user=cluster_info.ssh_user or 'skytpu',
+                private_key=cluster_info.ssh_private_key,
+                port=host.ssh_port))
+    return runners

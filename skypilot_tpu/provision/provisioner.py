@@ -7,12 +7,13 @@ instead of ray head/worker bootstrap, we write the slice topology file the
 gang runner reads, ship the package, and start skylet — XLA owns the
 intra-slice fabric, so there is no equivalent of `ray start`.
 """
+import concurrent.futures
 import json
 import os
 import shlex
 import sys
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from skypilot_tpu import exceptions
 from skypilot_tpu import provision
@@ -38,20 +39,48 @@ def bulk_provision(provider_name: str, region: str, zone: Optional[str],
     return record
 
 
+def _parallel_over_hosts(fn: Callable, runners: List,
+                         what: str) -> None:
+    """Run fn(runner) on every host concurrently (reference
+    _parallel_ssh_with_cache, instance_setup.py:139): pod slices have
+    up to dozens of host VMs and serial SSH setup dominates
+    launch-to-ready time."""
+    if not runners:
+        return
+    if len(runners) == 1:
+        fn(runners[0])
+        return
+    with concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(32, len(runners))) as pool:
+        futures = {pool.submit(fn, r): r for r in runners}
+        errors = []
+        for fut, runner in futures.items():
+            try:
+                fut.result()
+            except Exception as e:  # noqa: BLE001 — gather all failures
+                errors.append(f'{runner.node_id}: {e}')
+        if errors:
+            raise exceptions.ClusterSetUpError(
+                f'{what} failed on {len(errors)} host(s): '
+                + '; '.join(errors))
+
+
 def wait_for_connection(runners: List[runner_lib.CommandRunner],
                         timeout: float = 600.0) -> None:
     """Block until every host answers a trivial command (reference
-    wait_for_ssh :365)."""
+    wait_for_ssh :365); hosts are polled in parallel."""
     deadline = time.time() + timeout
-    for runner in runners:
+
+    def _wait_one(runner):
         while True:
             if runner.check_connection():
-                break
+                return
             if time.time() > deadline:
                 raise exceptions.ClusterSetUpError(
-                    f'Host {runner.node_id} unreachable after '
-                    f'{timeout:.0f}s')
+                    f'unreachable after {timeout:.0f}s')
             time.sleep(5)
+
+    _parallel_over_hosts(_wait_one, runners, 'connection wait')
 
 
 def runtime_dir_for(cluster_info: common.ClusterInfo) -> str:
@@ -167,21 +196,21 @@ def setup_runtime_dependencies(
     """Probe + install the host runtime with retries: first boots race
     cloud-init/apt locks, so one failed install must not fail the whole
     provision."""
-    for runner in runners:
+    def _setup_one(runner):
         last = ''
         for attempt in range(retries):
             rc, out, err = runner.run(
                 f'{_RUNTIME_PROBE} && ({_RUNTIME_INSTALL})',
                 require_outputs=True)
             if rc == 0:
-                break
+                return
             last = err or out
             if attempt < retries - 1:
                 time.sleep(retry_gap)
-        else:
-            raise exceptions.ClusterSetUpError(
-                f'Runtime setup failed on {runner.node_id} after '
-                f'{retries} attempts: {last}')
+        raise exceptions.ClusterSetUpError(
+            f'after {retries} attempts: {last}')
+
+    _parallel_over_hosts(_setup_one, runners, 'runtime setup')
 
 
 def _ship_package(runners: List[runner_lib.CommandRunner]) -> None:
@@ -189,10 +218,13 @@ def _ship_package(runners: List[runner_lib.CommandRunner]) -> None:
     sky/backends/wheel_utils.py — we sync sources instead of a wheel)."""
     import skypilot_tpu
     pkg_dir = os.path.dirname(os.path.abspath(skypilot_tpu.__file__))
-    for runner in runners:
+
+    def _ship_one(runner):
         runner.run(f'mkdir -p {_PKG_REMOTE_DIR}')
         runner.rsync(pkg_dir, f'{_PKG_REMOTE_DIR}/', up=True,
                      excludes=['__pycache__', '*.pyc'])
+
+    _parallel_over_hosts(_ship_one, runners, 'package shipping')
 
 
 def _skylet_cli_cmd(local: bool, rt: str, subcmd: str, *args: str) -> str:

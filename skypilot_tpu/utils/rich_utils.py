@@ -1,0 +1,70 @@
+"""Terminal status UX: spinner on TTYs, plain lines everywhere else.
+
+Reference analog: sky/utils/rich_utils.py (395 LoC around the rich
+library). rich isn't a dependency here; a thread-drawn spinner covers
+the interactive case and logs degrade to one line per update, which is
+what CI/pipes want anyway.
+"""
+import itertools
+import sys
+import threading
+import time
+from typing import Optional
+
+_SPINNER_FRAMES = '⠋⠙⠹⠸⠼⠴⠦⠧⠇⠏'
+
+
+class Status:
+    """`with rich_utils.status('Provisioning'):` — spinner + message.
+
+    update() swaps the message mid-flight; on non-TTY output each
+    message prints once, so logs stay readable.
+    """
+
+    def __init__(self, message: str, out=None) -> None:
+        self._message = message
+        self._out = out or sys.stderr
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    def _is_tty(self) -> bool:
+        return bool(getattr(self._out, 'isatty', lambda: False)())
+
+    def update(self, message: str) -> None:
+        with self._lock:
+            self._message = message
+        if not self._is_tty():
+            self._out.write(f'{message}\n')
+            self._out.flush()
+
+    def _spin(self) -> None:
+        for frame in itertools.cycle(_SPINNER_FRAMES):
+            if self._stop.is_set():
+                break
+            with self._lock:
+                message = self._message
+            self._out.write(f'\r\x1b[2K{frame} {message}')
+            self._out.flush()
+            time.sleep(0.1)
+        self._out.write('\r\x1b[2K')
+        self._out.flush()
+
+    def __enter__(self) -> 'Status':
+        if self._is_tty():
+            self._thread = threading.Thread(target=self._spin,
+                                            daemon=True)
+            self._thread.start()
+        else:
+            self._out.write(f'{self._message}\n')
+            self._out.flush()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+
+def status(message: str, out=None) -> Status:
+    return Status(message, out=out)

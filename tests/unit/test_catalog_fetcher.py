@@ -86,3 +86,70 @@ def test_commitment_skus_excluded(tmp_path):
         gcp_adaptor.set_transport_factory(
             lambda: (_ for _ in ()).throw(AssertionError('no transport')))
     assert rows[0]['price_per_chip'] == pytest.approx(1.2)
+
+
+class TestVmFetcher:
+
+    def test_vm_rows_assembled_from_core_ram_gpu_skus(self, monkeypatch):
+        skus = [
+            _sku('N2 Instance Core running in Americas', 0.03,
+                 ['us-central1']),
+            _sku('N2 Instance Ram running in Americas', 0.004,
+                 ['us-central1']),
+            _sku('Spot Preemptible N2 Instance Core running in Americas',
+                 0.007, ['us-central1'], usage='Preemptible'),
+            _sku('Spot Preemptible N2 Instance Ram running in Americas',
+                 0.001, ['us-central1'], usage='Preemptible'),
+            _sku('A2 Instance Core running in Americas', 0.04,
+                 ['us-central1']),
+            _sku('A2 Instance Ram running in Americas', 0.005,
+                 ['us-central1']),
+            _sku('Nvidia Tesla A100 GPU running in Americas', 2.9,
+                 ['us-central1']),
+        ]
+        gcp_adaptor.set_transport_factory(
+            lambda: FakeBillingApi(skus))
+        try:
+            rows = fetch_gcp.fetch_vm_rows()
+        finally:
+            gcp_adaptor.set_transport_factory(lambda: (
+                _ for _ in ()).throw(AssertionError('no transport')))
+        by_type = {}
+        for r in rows:
+            by_type.setdefault(r['instance_type'], r)
+        # n2-standard-8: 8 cores * 0.03 + 32 GB * 0.004 = 0.368
+        n2 = by_type['n2-standard-8']
+        assert n2['price'] == pytest.approx(0.368)
+        # spot: 8 * 0.007 + 32 * 0.001 = 0.088
+        assert n2['spot_price'] == pytest.approx(0.088)
+        assert n2['accelerator_name'] == ''
+        # a2-highgpu-1g: 12 * 0.04 + 85 * 0.005 + 1 * 2.9 = 3.805
+        a2 = by_type['a2-highgpu-1g']
+        assert a2['price'] == pytest.approx(3.805)
+        assert a2['accelerator_name'] == 'A100'
+        # No A2 spot core/ram SKUs -> no spot price for a2 shapes.
+        assert a2['spot_price'] == ''
+        # Two zones per region.
+        zones = {r['zone'] for r in rows
+                 if r['instance_type'] == 'n2-standard-8'}
+        assert zones == {'us-central1-a', 'us-central1-b'}
+
+    def test_csv_roundtrip(self, tmp_path, monkeypatch):
+        skus = [
+            _sku('N2 Instance Core running in EMEA', 0.033,
+                 ['europe-west4']),
+            _sku('N2 Instance Ram running in EMEA', 0.0044,
+                 ['europe-west4']),
+        ]
+        gcp_adaptor.set_transport_factory(lambda: FakeBillingApi(skus))
+        try:
+            rows = fetch_gcp.fetch_vm_rows()
+        finally:
+            gcp_adaptor.set_transport_factory(lambda: (
+                _ for _ in ()).throw(AssertionError('no transport')))
+        path = tmp_path / 'vms.csv'
+        n = fetch_gcp.write_vm_csv(rows, str(path))
+        assert n == len(rows) > 0
+        with open(path) as f:
+            parsed = list(csv.DictReader(f))
+        assert parsed[0]['instance_type'].startswith('n2-standard-')
