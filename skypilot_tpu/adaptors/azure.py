@@ -90,8 +90,8 @@ class ArmClient:
 
     def request(self, method: str, path: str,
                 params: Optional[Dict[str, str]] = None,
-                json_body: Optional[Dict[str, Any]] = None
-                ) -> Dict[str, Any]:
+                json_body: Optional[Dict[str, Any]] = None,
+                _retry_auth: bool = True) -> Dict[str, Any]:
         url = f'{ARM_ENDPOINT}{path}'
         if params:
             url += f'?{urllib.parse.urlencode(params)}'
@@ -105,6 +105,14 @@ class ArmClient:
             with urllib.request.urlopen(req, timeout=60) as resp:
                 body = resp.read()
         except urllib.error.HTTPError as e:
+            if e.code == 401 and _retry_auth:
+                # az tokens live ~1h; refresh once and retry (long-
+                # lived controllers outlast the first token).
+                with self._lock:
+                    self._token = None
+                return self.request(method, path, params=params,
+                                    json_body=json_body,
+                                    _retry_auth=False)
             payload = e.read().decode(errors='replace')
             code = ''
             try:

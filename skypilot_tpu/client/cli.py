@@ -429,6 +429,57 @@ def serve_logs_cmd(service_name, no_follow):
     _run_and_stream(sdk.serve_logs(service_name, follow=not no_follow))
 
 
+@cli.command('ssh')
+@click.argument('cluster')
+@click.option('--host-rank', type=int, default=0,
+              help='Host index within the (slice) cluster, 0 = head.')
+@click.option('--print-command', is_flag=True,
+              help='Print the command instead of executing it.')
+def ssh_cmd(cluster, host_rank, print_command):
+    """Interactive shell on a cluster host (reference `ssh <cluster>`
+    via generated ssh-config; ours builds the command from the stored
+    handle — kubernetes clusters get `kubectl exec`).
+
+    Needs the cluster state on THIS machine (consolidated API server);
+    with a remote SKYTPU_API_SERVER_URL, run it on the server host.
+    """
+    import os as _os
+    import shlex as _shlex
+    if _os.environ.get('SKYTPU_API_SERVER_URL'):
+        raise click.ClickException(
+            'ssh needs local cluster state; run it on the API-server '
+            'host (SKYTPU_API_SERVER_URL is set).')
+    from skypilot_tpu import state as state_lib
+    record = state_lib.get_cluster_from_name(cluster)
+    if record is None or record['handle'] is None:
+        raise click.ClickException(f'Cluster {cluster!r} does not exist.')
+    handle = record['handle']
+    info = handle.cluster_info
+    if info is None:
+        raise click.ClickException(f'Cluster {cluster!r} has no hosts.')
+    from skypilot_tpu import provision as provision_lib
+    from skypilot_tpu.utils import command_runner as runner_lib
+    runners = provision_lib.get_command_runners(info.provider_name, info)
+    if not 0 <= host_rank < len(runners):
+        raise click.ClickException(
+            f'host-rank {host_rank} out of range ({len(runners)} hosts).')
+    runner = runners[host_rank]
+    if isinstance(runner, runner_lib.LocalProcessRunner):
+        argv = ['bash']
+    elif isinstance(runner, runner_lib.SSHCommandRunner):
+        argv = runner.interactive_argv()
+    elif isinstance(runner, runner_lib.KubernetesCommandRunner):
+        argv = ['kubectl', '-n', runner.namespace, 'exec', '-it',
+                runner.pod_name, '-c', runner.container, '--', 'bash']
+    else:
+        raise click.ClickException(
+            f'No interactive path for {type(runner).__name__}.')
+    if print_command:
+        click.echo(_shlex.join(argv))
+        return
+    _os.execvp(argv[0], argv)
+
+
 @cli.command('show-gpus')
 @click.argument('name_filter', required=False)
 def show_gpus(name_filter):

@@ -132,6 +132,9 @@ def tail_logs(job_id: int, follow: bool = True,
         record = jobs_state.get_job(job_id)
         if record['status'].is_terminal or not follow:
             break
+        from skypilot_tpu.utils import context as context_lib
+        if context_lib.is_cancelled():
+            return 1  # cancelled request: stop the follow loop cleanly
         time.sleep(poll_interval)
     ok = record['status'] == jobs_state.ManagedJobStatus.SUCCEEDED
     return 0 if ok else 1

@@ -74,6 +74,11 @@ CONFIGS: Dict[str, LlamaConfig] = {
     'llama3-8b': LlamaConfig(),
     'llama3-70b': LlamaConfig(hidden_size=8192, intermediate_size=28672,
                               num_layers=80, num_heads=64, num_kv_heads=8),
+    'llama3-405b': LlamaConfig(hidden_size=16384,
+                               intermediate_size=53248, num_layers=126,
+                               num_heads=128, num_kv_heads=8,
+                               max_seq_len=8192,
+                               attention_impl='flash'),
     'llama3-1b': LlamaConfig(vocab_size=128256, hidden_size=2048,
                              intermediate_size=8192, num_layers=16,
                              num_heads=32, num_kv_heads=8, head_dim=64),

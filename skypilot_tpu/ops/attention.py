@@ -277,7 +277,8 @@ def attention(q: jax.Array,
                                    block_size=block_size)
     if impl == 'flash':
         from skypilot_tpu.ops import flash_attention as fa
-        return fa.flash_attention(q, k, v, causal=causal)
+        return fa.flash_attention(q, k, v, causal,
+                                  block_size, block_size)
     if impl == 'dense':
         return dense_attention(q, k, v, causal=causal)
     raise ValueError(

@@ -201,8 +201,11 @@ def _create_vm(client, sub: str, rg: str, region: str, name: str,
             'hardwareProfile': {
                 'vmSize': nc.get('instance_type', 'Standard_D8s_v5')},
             'storageProfile': {
-                'imageReference': nc.get('image_reference',
-                                         _DEFAULT_IMAGE),
+                # image_id: a full ARM image/gallery resource id.
+                'imageReference': ({'id': nc['image_id']}
+                                   if nc.get('image_id')
+                                   else nc.get('image_reference',
+                                               _DEFAULT_IMAGE)),
                 'osDisk': {
                     'createOption': 'FromImage',
                     'diskSizeGB': int(nc.get('disk_size', 256)),

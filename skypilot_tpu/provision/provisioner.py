@@ -125,30 +125,38 @@ def post_provision_runtime_setup(provider_name: str, cluster_name: str,
                                  stream_logs: bool = False) -> str:
     """Make the cluster runnable: connectivity, topology file, package,
     skylet. Returns the runtime dir. Idempotent."""
+    from skypilot_tpu.utils import rich_utils
     runners = provision.get_command_runners(provider_name, cluster_info)
-    wait_for_connection(runners)
-    rt = runtime_dir_for(cluster_info)
-    head = runners[0]
-    local = isinstance(head, runner_lib.LocalProcessRunner)
+    with rich_utils.status(
+            f'[{cluster_name}] waiting for {len(runners)} host(s)'
+            ) as spinner:
+        wait_for_connection(runners)
+        rt = runtime_dir_for(cluster_info)
+        head = runners[0]
+        local = isinstance(head, runner_lib.LocalProcessRunner)
 
-    topology = build_topology(cluster_name, cluster_info,
-                              epoch=_existing_epoch(head, local, rt))
-    if local:
-        os.makedirs(rt, exist_ok=True)
-        with open(skylet_constants.topology_path(rt), 'w',
-                  encoding='utf-8') as f:
-            json.dump(topology, f, indent=1)
-    else:
-        setup_runtime_dependencies(runners)
-        _ship_package(runners)
-        payload = shlex.quote(json.dumps(topology))
-        for runner in runners:
-            runner.run(f'mkdir -p {rt} && '
-                       f'echo {payload} > {rt}/cluster_topology.json')
+        topology = build_topology(cluster_name, cluster_info,
+                                  epoch=_existing_epoch(head, local, rt))
+        if local:
+            os.makedirs(rt, exist_ok=True)
+            with open(skylet_constants.topology_path(rt), 'w',
+                      encoding='utf-8') as f:
+                json.dump(topology, f, indent=1)
+        else:
+            spinner.update(f'[{cluster_name}] installing runtime '
+                           'dependencies')
+            setup_runtime_dependencies(runners)
+            spinner.update(f'[{cluster_name}] shipping package')
+            _ship_package(runners)
+            payload = shlex.quote(json.dumps(topology))
+            for runner in runners:
+                runner.run(f'mkdir -p {rt} && '
+                           f'echo {payload} > {rt}/cluster_topology.json')
 
-    rc, out, err = head.run(
-        _skylet_cli_cmd(local, rt, 'start-skylet'),
-        require_outputs=True)
+        spinner.update(f'[{cluster_name}] starting skylet')
+        rc, out, err = head.run(
+            _skylet_cli_cmd(local, rt, 'start-skylet'),
+            require_outputs=True)
     if rc != 0:
         raise exceptions.ClusterSetUpError(
             f'Failed to start skylet on head: {err or out}')

@@ -164,6 +164,9 @@ def tail_logs(service_name: str, follow: bool = True,
         if chunk:
             print(chunk, end='', flush=True)
             pos += len(chunk.encode())
+        from skypilot_tpu.utils import context as context_lib
+        if context_lib.is_cancelled():
+            return 1
         if not follow or serve_state.get_service(service_name) is None:
             return 0
         time.sleep(poll_interval)

@@ -21,6 +21,9 @@ REGISTRY: Dict[str, Callable[[Dict[str, Any]], Any]] = {}
 
 # Parallelism caps (reference sizes these by host memory; executor.py:588).
 _MAX_PARALLEL = {'long': 4, 'short': 16}
+# Cooperative-cancellation grace before SIGKILL.
+_CANCEL_GRACE_SECONDS = float(os.environ.get(
+    'SKYTPU_CANCEL_GRACE_SECONDS', '5'))
 
 _mp = multiprocessing.get_context('fork')
 
@@ -36,6 +39,8 @@ def _run_in_child(request_id: str, name: str,
                   payload: Dict[str, Any]) -> None:
     """Child-process body: redirect output, run, persist outcome."""
     os.setsid()  # own process group => cancellable subtree
+    from skypilot_tpu.utils import context as context_lib
+    context_lib.install_sigterm_handler()
     requests_db.reset_for_tests()  # never share the parent's connection
     log_path = requests_db.request_log_path(request_id)
     log_fd = os.open(log_path, os.O_WRONLY | os.O_APPEND | os.O_CREAT,
@@ -126,10 +131,21 @@ class Executor:
         with self._lock:
             proc = self._procs.get(request_id)
         if proc is not None and proc.pid:
+            # First SIGTERM is cooperative (the worker's context token
+            # flips and long loops exit at a safe point); escalate to
+            # SIGKILL after a grace window.
             try:
                 os.killpg(proc.pid, signal.SIGTERM)
             except ProcessLookupError:
-                pass
+                return True
+            def _escalate(p=proc):
+                p.join(timeout=_CANCEL_GRACE_SECONDS)
+                if p.is_alive() and p.pid:
+                    try:
+                        os.killpg(p.pid, signal.SIGKILL)
+                    except ProcessLookupError:
+                        pass
+            threading.Thread(target=_escalate, daemon=True).start()
         return True
 
 

@@ -191,6 +191,13 @@ class SSHCommandRunner(CommandRunner):
             opts += ['-J', self.proxy_jump]
         return ['ssh'] + opts + [f'{self.user}@{self.host}']
 
+    def interactive_argv(self) -> List[str]:
+        """argv for an interactive login shell on the host (same
+        option assembly as run/rsync — `tsky ssh` uses this). -t must
+        precede the destination or ssh treats it as a remote command."""
+        base = self._ssh_base()
+        return base[:-1] + ['-t'] + base[-1:]
+
     def run(self, cmd, *, env=None, stream_logs=False, log_path=None,
             cwd=None, require_outputs=False, timeout=None):
         if isinstance(cmd, list):

@@ -1,0 +1,78 @@
+"""Cooperative cancellation for request workers.
+
+Reference analog: sky/utils/context.py (contextvar-scoped cancellation
+the server checks inside long operations). Ours: each forked request
+worker installs a SIGTERM handler that flips the current token, giving
+in-flight code one grace window to stop at a safe point (flush state,
+release a lock) before the process-group kill lands.
+
+    from skypilot_tpu.utils import context
+    ...
+    while tailing_logs:
+        context.raise_if_cancelled()   # or: if context.is_cancelled()
+"""
+import contextvars
+import signal
+import threading
+from typing import Optional
+
+from skypilot_tpu import exceptions
+
+
+class CancellationToken:
+    def __init__(self) -> None:
+        self._event = threading.Event()
+
+    def cancel(self) -> None:
+        self._event.set()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._event.wait(timeout)
+
+
+_current: contextvars.ContextVar[Optional[CancellationToken]] = \
+    contextvars.ContextVar('skytpu_cancellation', default=None)
+
+
+def new_token() -> CancellationToken:
+    """Create + activate a token for the current context."""
+    token = CancellationToken()
+    _current.set(token)
+    return token
+
+
+def current() -> Optional[CancellationToken]:
+    return _current.get()
+
+
+def is_cancelled() -> bool:
+    token = _current.get()
+    return token is not None and token.cancelled
+
+
+def raise_if_cancelled() -> None:
+    if is_cancelled():
+        raise exceptions.RequestCancelled(
+            'Operation cancelled by the server.')
+
+
+def install_sigterm_handler() -> CancellationToken:
+    """Worker-process setup: SIGTERM flips the token FIRST (cooperative
+    window); a second SIGTERM — or the executor's follow-up SIGKILL —
+    still terminates hard."""
+    token = new_token()
+
+    def _handler(signum, frame):
+        del frame
+        if token.cancelled:
+            signal.signal(signum, signal.SIG_DFL)
+            signal.raise_signal(signum)
+            return
+        token.cancel()
+
+    signal.signal(signal.SIGTERM, _handler)
+    return token

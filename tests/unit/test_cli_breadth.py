@@ -111,3 +111,39 @@ def test_dashboard_log_viewer(server):
         raise AssertionError('expected 404')
     except urllib.error.HTTPError as e:
         assert e.code == 404
+
+
+def test_ssh_print_command_local_and_guards(server, enable_clouds):
+    enable_clouds('local')
+    import skypilot_tpu as sky
+    from skypilot_tpu import task as task_lib
+    sky.launch(task_lib.Task(run='true', name='s'), cluster_name='sshc')
+    result = CliRunner().invoke(
+        cli_mod.cli, ['ssh', 'sshc', '--print-command'],
+        env={'SKYTPU_API_SERVER_URL': ''})
+    assert result.exit_code == 0, result.output
+    assert result.output.strip() == 'bash'  # local cloud → local shell
+    # out-of-range host rank (incl. negative) is rejected
+    for rank in ('5', '-1'):
+        result = CliRunner().invoke(
+            cli_mod.cli, ['ssh', 'sshc', '--host-rank', rank,
+                          '--print-command'],
+            env={'SKYTPU_API_SERVER_URL': ''})
+        assert result.exit_code != 0
+    # remote API server → refuse with guidance
+    result = CliRunner().invoke(
+        cli_mod.cli, ['ssh', 'sshc', '--print-command'],
+        env={'SKYTPU_API_SERVER_URL': 'http://elsewhere:1'})
+    assert result.exit_code != 0
+    assert 'API-server host' in result.output
+    sky.down('sshc')
+
+
+def test_ssh_command_for_ssh_cluster_uses_runner_options():
+    from skypilot_tpu.utils import command_runner
+    runner = command_runner.SSHCommandRunner('1.2.3.4', user='u',
+                                             private_key='~/.ssh/k')
+    argv = runner.interactive_argv()
+    assert argv[0] == 'ssh' and argv[-1] == 'u@1.2.3.4'
+    assert argv[-2] == '-t'
+    assert 'ControlMaster=auto' in argv  # reuses the shared options

@@ -1,0 +1,70 @@
+"""Cooperative cancellation context (reference sky/utils/context.py)."""
+import signal
+import threading
+import time
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import context
+
+
+def test_token_lifecycle():
+    token = context.new_token()
+    assert context.current() is token
+    assert not context.is_cancelled()
+    token.cancel()
+    assert context.is_cancelled()
+    with pytest.raises(exceptions.RequestCancelled):
+        context.raise_if_cancelled()
+
+
+def test_sigterm_flips_token_then_escalates():
+    token = context.install_sigterm_handler()
+    try:
+        assert not token.cancelled
+        signal.raise_signal(signal.SIGTERM)  # first: cooperative
+        assert token.cancelled
+        # The process is still alive — the handler absorbed the signal.
+    finally:
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+        context.new_token()
+
+
+def test_cancelled_request_stops_log_tail(tmp_path):
+    """A follow-mode managed-job log tail exits promptly once the
+    request's cancellation token flips (the jobs/serve tail loops are
+    the ones that actually run inside cancellable workers)."""
+    import os
+    from skypilot_tpu.jobs import core as jobs_core
+    from skypilot_tpu.jobs import state as jobs_state
+
+    jobs_state.reset_for_tests()
+    job_id = jobs_state.submit_job('t', {'run': 'x'})
+    assert jobs_state.try_claim_pending(job_id)
+    jobs_state.set_status(job_id, jobs_state.ManagedJobStatus.RUNNING)
+    log_path = jobs_state.controller_log_path(job_id)
+    os.makedirs(os.path.dirname(log_path), exist_ok=True)
+    with open(log_path, 'w', encoding='utf-8') as f:
+        f.write('line-1\n')
+
+    token = context.new_token()
+    result = {}
+
+    def _tail():
+        # contextvars don't propagate into a bare Thread; re-activate.
+        context._current.set(token)  # noqa: SLF001
+        import contextlib, io
+        with contextlib.redirect_stdout(io.StringIO()):
+            result['rc'] = jobs_core.tail_logs(job_id, follow=True,
+                                               poll_interval=0.1)
+
+    thread = threading.Thread(target=_tail, daemon=True)
+    thread.start()
+    time.sleep(0.5)
+    assert thread.is_alive()  # following a RUNNING job
+    token.cancel()
+    thread.join(timeout=10)
+    assert not thread.is_alive(), 'tail did not stop on cancellation'
+    assert result['rc'] == 1
+    jobs_state.reset_for_tests()
