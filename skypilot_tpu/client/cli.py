@@ -449,11 +449,15 @@ def ssh_cmd(cluster, host_rank, print_command):
         raise click.ClickException(
             'ssh needs local cluster state; run it on the API-server '
             'host (SKYTPU_API_SERVER_URL is set).')
-    from skypilot_tpu import state as state_lib
-    record = state_lib.get_cluster_from_name(cluster)
-    if record is None or record['handle'] is None:
-        raise click.ClickException(f'Cluster {cluster!r} does not exist.')
-    handle = record['handle']
+    from skypilot_tpu import core as core_lib
+    from skypilot_tpu import exceptions as exceptions_lib
+    try:
+        # Same lookup every other command uses: clean errors for
+        # missing AND for stopped/INIT clusters.
+        handle = core_lib._get_handle(cluster,  # noqa: SLF001
+                                      require_up=True)
+    except exceptions_lib.SkyTpuError as e:
+        raise click.ClickException(str(e))
     info = handle.cluster_info
     if info is None:
         raise click.ClickException(f'Cluster {cluster!r} has no hosts.')
@@ -466,11 +470,8 @@ def ssh_cmd(cluster, host_rank, print_command):
     runner = runners[host_rank]
     if isinstance(runner, runner_lib.LocalProcessRunner):
         argv = ['bash']
-    elif isinstance(runner, runner_lib.SSHCommandRunner):
+    elif hasattr(runner, 'interactive_argv'):
         argv = runner.interactive_argv()
-    elif isinstance(runner, runner_lib.KubernetesCommandRunner):
-        argv = ['kubectl', '-n', runner.namespace, 'exec', '-it',
-                runner.pod_name, '-c', runner.container, '--', 'bash']
     else:
         raise click.ClickException(
             f'No interactive path for {type(runner).__name__}.')

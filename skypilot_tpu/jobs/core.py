@@ -114,6 +114,7 @@ def tail_logs(job_id: int, follow: bool = True,
     if record is None:
         raise exceptions.JobNotFoundError(
             f'Managed job {job_id} not found.')
+    from skypilot_tpu.utils import context as context_lib
     from skypilot_tpu.utils import controller_utils
     if controller_utils.controller_mode('jobs') == 'dedicated':
         return _tail_dedicated_controller_logs(job_id, record, follow)
@@ -132,7 +133,6 @@ def tail_logs(job_id: int, follow: bool = True,
         record = jobs_state.get_job(job_id)
         if record['status'].is_terminal or not follow:
             break
-        from skypilot_tpu.utils import context as context_lib
         if context_lib.is_cancelled():
             return 1  # cancelled request: stop the follow loop cleanly
         time.sleep(poll_interval)

@@ -10,6 +10,8 @@ crosses DCN slice boundaries.
 
 Axes (in fixed order, outermost → innermost):
   data    — pure data parallel; gradients all-reduced.
+  pipe    — pipeline parallel (GPipe microbatching; stage-to-stage
+            ppermute — tolerates slow links, so it sits outer).
   fsdp    — data parallel with fully-sharded params (ZeRO-3 style).
   expert  — expert parallel for MoE layers (all_to_all dispatch).
   context — sequence/context parallel (ring attention over this axis).
@@ -25,12 +27,16 @@ import math
 import os
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-AXIS_ORDER = ('data', 'fsdp', 'expert', 'context', 'tensor')
+AXIS_ORDER = ('data', 'pipe', 'fsdp', 'expert', 'context', 'tensor')
 
 # Aliases accepted from YAML / CLI knobs.
 _AXIS_ALIASES = {
     'dp': 'data',
     'data_parallel': 'data',
+    'pp': 'pipe',
+    'pipeline': 'pipe',
+    'pipeline_parallel': 'pipe',
+    'stage': 'pipe',
     'zero': 'fsdp',
     'fsdp_parallel': 'fsdp',
     'ep': 'expert',
@@ -66,6 +72,7 @@ class MeshSpec:
         MeshSpec.from_dict({'dp': 2, 'tp': 8})
     """
     data: int = 1
+    pipe: int = 1
     fsdp: int = -1
     expert: int = 1
     context: int = 1

@@ -1,0 +1,191 @@
+"""Pipeline parallelism: GPipe microbatching over the `pipe` mesh axis.
+
+The reference has no in-tree pipeline machinery (SURVEY.md §2.11 —
+reached only through user DeepSpeed recipes). TPU-native design:
+
+- The model's layer stack is already a STACKED pytree (leading dim =
+  layers, lax.scan'd); sharding that leading dim over `pipe` gives each
+  stage a contiguous chunk of layers with zero repacking.
+- Inside `jax.shard_map` every stage runs the same program: process the
+  activation it holds through its local layers (an inner scan), then
+  `lax.ppermute` it to the next stage. Stage 0 injects a fresh
+  microbatch each step; the last stage records finished microbatches.
+  After M + S - 1 steps every microbatch has crossed all S stages —
+  the classic GPipe schedule, with the bubble fraction (S-1)/(M+S-1).
+- ppermute is neighbor-only, so stage traffic rides ICI (or tolerates
+  DCN — `pipe` sits outer in the mesh for exactly that reason), and it
+  is differentiable: jax.grad produces the reverse schedule without a
+  hand-written backward pass.
+
+Composes with batch-dim sharding (`data`/`fsdp` on the microbatch dim)
+inside the same shard_map; tensor/context parallelism operate within a
+stage and are not combined with `pipe` here.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(layer_fn: Callable[[Any, jax.Array], jax.Array],
+                   stacked_params: Any,
+                   x: jax.Array,
+                   mesh: Any,
+                   num_microbatches: Optional[int] = None) -> jax.Array:
+    """Run `x` through the stacked layers, pipelined over `pipe`.
+
+    layer_fn(single_layer_params, activation) -> activation
+    stacked_params: pytree, every leaf with leading dim = num_layers
+                    (num_layers % pipe == 0).
+    x: [batch, ...] activations entering layer 0.
+    Returns activations after the last layer, same shape as x.
+    """
+    num_stages = dict(mesh.shape).get('pipe', 1)
+    if num_stages == 1:
+        def scan_all(carry, layer_params):
+            return layer_fn(layer_params, carry), None
+        out, _ = lax.scan(scan_all, x, stacked_params)
+        return out
+
+    batch = x.shape[0]
+    m = num_microbatches or num_stages
+    if batch % m:
+        raise ValueError(f'batch {batch} % microbatches {m} != 0')
+    num_layers = jax.tree.leaves(stacked_params)[0].shape[0]
+    if num_layers % num_stages:
+        raise ValueError(
+            f'layers {num_layers} % stages {num_stages} != 0')
+
+    # [M, mb, ...] microbatch-major view.
+    x_mb = x.reshape(m, batch // m, *x.shape[1:])
+
+    from jax.sharding import PartitionSpec as P
+    batch_axes = tuple(a for a in ('data', 'fsdp') if a in mesh.shape)
+    batch_div = 1
+    for a in batch_axes:
+        batch_div *= mesh.shape[a]
+    if (batch // m) % max(batch_div, 1):
+        batch_axes = ()  # tiny test batches: replicate instead
+    mb_spec = P(None, batch_axes or None)
+    # Output gains a leading `pipe` dim (one slot per stage); only the
+    # last stage's slot holds finished microbatches — sliced below,
+    # which avoids an all_gather inside the pipeline body.
+    out_spec = P('pipe', None, batch_axes or None)
+    param_spec = jax.tree.map(lambda _: P('pipe'), stacked_params)
+
+    fn = functools.partial(_stage_program, layer_fn=layer_fn,
+                           num_stages=num_stages, num_microbatches=m)
+    mapped = jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(param_spec, mb_spec),
+        out_specs=out_spec)
+    out_mb = mapped(stacked_params, x_mb)[num_stages - 1]
+    return out_mb.reshape(batch, *x.shape[1:])
+
+
+def _stage_program(local_params: Any, x_mb: jax.Array, *,
+                   layer_fn: Callable, num_stages: int,
+                   num_microbatches: int) -> jax.Array:
+    """Per-stage body (runs under shard_map, manual over every axis)."""
+    stage = lax.axis_index('pipe')
+    m = num_microbatches
+
+    def local_layers(state):
+        def body(carry, layer_params):
+            return layer_fn(layer_params, carry), None
+        out, _ = lax.scan(body, state, local_params)
+        return out
+
+    perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+
+    def step(carry, t):
+        state, collected = carry
+        # Stage 0 ingests microbatch t (clipped to stay in range during
+        # the drain phase — the injected value is ignored then).
+        inject = lax.dynamic_index_in_dim(
+            x_mb, jnp.clip(t, 0, m - 1), 0, keepdims=False)
+        state = jnp.where(stage == 0, inject, state)
+        state = local_layers(state)
+        # The last stage records microbatch (t - (S-1)) once it has
+        # crossed every stage.
+        out_idx = t - (num_stages - 1)
+        record = jnp.logical_and(
+            stage == num_stages - 1,
+            jnp.logical_and(out_idx >= 0, out_idx < m))
+        updated = lax.dynamic_update_index_in_dim(
+            collected, state, jnp.clip(out_idx, 0, m - 1), 0)
+        collected = jnp.where(record, updated, collected)
+        state = lax.ppermute(state, 'pipe', perm)
+        return (state, collected), None
+
+    # The carry BECOMES pipe-varying (axis_index + ppermute) even
+    # though x_mb enters replicated over 'pipe' — type the zeros to
+    # match the steady state.
+    zero_state = _pvary_like(jnp.zeros_like(x_mb[0]), x_mb,
+                             extra=('pipe',))
+    zero_out = _pvary_like(jnp.zeros_like(x_mb), x_mb, extra=('pipe',))
+    (_, collected), _ = lax.scan(
+        step, (zero_state, zero_out),
+        jnp.arange(m + num_stages - 1))
+    # [1, M, mb, ...] per stage — concatenated over `pipe` by the
+    # out_spec; the caller slices the last stage's slot.
+    return collected[None]
+
+
+def _pvary_like(zeros: jax.Array, ref: jax.Array,
+                extra: tuple = ()) -> jax.Array:
+    """Match scan-carry device-variance typing (jax>=0.7
+    varying-manual-axes; no-op on older versions): the input's varying
+    axes plus `extra` ones the loop body introduces."""
+    try:
+        vary = tuple(ref.aval.vma)  # type: ignore[attr-defined]
+    except AttributeError:
+        return zeros
+    vary = tuple(dict.fromkeys(vary + extra))
+    have = tuple(getattr(zeros.aval, 'vma', ()))
+    need = tuple(a for a in vary if a not in have)
+    if not need:
+        return zeros
+    return lax.pvary(zeros, need)
+
+
+# --- llama convenience ------------------------------------------------------
+
+def llama_pipeline_forward(params: Any, tokens: jax.Array, config: Any,
+                           mesh: Any,
+                           num_microbatches: Optional[int] = None
+                           ) -> jax.Array:
+    """llama.forward with the layer stack pipelined over `pipe`.
+
+    Embedding / final norm / lm_head are tiny next to the layer stack
+    and run replicated on every stage; attention inside a stage runs
+    without mesh collectives (pipe composes with batch-dim sharding,
+    not tensor/context parallelism).
+    """
+    from skypilot_tpu.models import llama
+
+    c = config
+    positions = jnp.arange(tokens.shape[1])
+    x = llama._embed_lookup(  # noqa: SLF001
+        params['embed'].astype(c.dtype), tokens, None)
+
+    def layer_fn(layer_params, h):
+        return llama._layer(h, layer_params, config=c,  # noqa: SLF001
+                            positions=positions, mesh=None)
+
+    if c.remat:
+        layer_fn_wrapped = jax.checkpoint(
+            layer_fn,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    else:
+        layer_fn_wrapped = layer_fn
+    x = pipeline_apply(layer_fn_wrapped, params['layers'], x, mesh,
+                       num_microbatches=num_microbatches)
+    x = llama._rms_norm(x, params['final_norm'],  # noqa: SLF001
+                        c.rms_norm_eps)
+    return jnp.einsum('bse,ev->bsv', x, params['lm_head'],
+                      preferred_element_type=jnp.float32)

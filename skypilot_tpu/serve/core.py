@@ -148,6 +148,7 @@ def status(service_names: Optional[List[str]] = None
 
 def tail_logs(service_name: str, follow: bool = True,
               poll_interval: float = 1.0) -> int:
+    from skypilot_tpu.utils import context as context_lib
     service = serve_state.get_service(service_name)
     if service is None:
         raise exceptions.ServeError(
@@ -164,7 +165,6 @@ def tail_logs(service_name: str, follow: bool = True,
         if chunk:
             print(chunk, end='', flush=True)
             pos += len(chunk.encode())
-        from skypilot_tpu.utils import context as context_lib
         if context_lib.is_cancelled():
             return 1
         if not follow or serve_state.get_service(service_name) is None:

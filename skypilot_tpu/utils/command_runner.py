@@ -247,6 +247,11 @@ class KubernetesCommandRunner(CommandRunner):
     def _base(self) -> List[str]:
         return ['kubectl', '-n', self.namespace]
 
+    def interactive_argv(self) -> List[str]:
+        """argv for an interactive shell in the pod (`tsky ssh`)."""
+        return self._base() + ['exec', '-it', self.pod_name,
+                               '-c', self.container, '--', 'bash']
+
     def run(self, cmd, *, env=None, stream_logs=False, log_path=None,
             cwd=None, require_outputs=False, timeout=None):
         if isinstance(cmd, list):

@@ -36,6 +36,11 @@ class CancellationToken:
 
 _current: contextvars.ContextVar[Optional[CancellationToken]] = \
     contextvars.ContextVar('skytpu_cancellation', default=None)
+# Worker processes are one-request-per-fork: install_sigterm_handler
+# also records the token process-globally so helper THREADS (bare
+# threading.Thread starts with a fresh context) observe cancellation
+# too. The contextvar layer keeps in-process tests isolated.
+_process_token: Optional[CancellationToken] = None
 
 
 def new_token() -> CancellationToken:
@@ -46,11 +51,11 @@ def new_token() -> CancellationToken:
 
 
 def current() -> Optional[CancellationToken]:
-    return _current.get()
+    return _current.get() or _process_token
 
 
 def is_cancelled() -> bool:
-    token = _current.get()
+    token = current()
     return token is not None and token.cancelled
 
 
@@ -64,7 +69,9 @@ def install_sigterm_handler() -> CancellationToken:
     """Worker-process setup: SIGTERM flips the token FIRST (cooperative
     window); a second SIGTERM — or the executor's follow-up SIGKILL —
     still terminates hard."""
+    global _process_token
     token = new_token()
+    _process_token = token
 
     def _handler(signum, frame):
         del frame

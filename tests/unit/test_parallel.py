@@ -10,11 +10,11 @@ class TestMeshSpec:
     def test_resolve_fill(self):
         spec = MeshSpec(fsdp=-1).resolve(8)
         assert spec.fsdp == 8
-        assert spec.shape() == (1, 8, 1, 1, 1)
+        assert spec.shape() == (1, 1, 8, 1, 1, 1)
 
     def test_resolve_exact(self):
         spec = MeshSpec(data=2, fsdp=2, tensor=2).resolve(8)
-        assert spec.shape() == (2, 2, 1, 1, 2)
+        assert spec.shape() == (2, 1, 2, 1, 1, 2)
 
     def test_resolve_mismatch_raises(self):
         with pytest.raises(ValueError):

@@ -1,0 +1,86 @@
+"""Pipeline parallelism: GPipe schedule over the `pipe` mesh axis.
+
+No reference equivalent (SkyPilot ships no parallelism machinery;
+SURVEY.md §2.11) — correctness oracle is the non-pipelined forward.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.parallel import MeshSpec, make_mesh
+from skypilot_tpu.parallel import pipeline
+
+
+@pytest.fixture(scope='module')
+def setup():
+    import dataclasses
+    # 4 layers so the stack splits across up to 4 stages.
+    config = dataclasses.replace(llama.CONFIGS['tiny'], num_layers=4)
+    params = llama.init_params(config, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (8, 32), 0,
+                                config.vocab_size, jnp.int32)
+    reference = llama.forward(params, tokens, config)
+    return config, params, tokens, reference
+
+
+@pytest.mark.parametrize('stages', [2, 4])
+def test_pipeline_matches_unpipelined(setup, stages):
+    config, params, tokens, reference = setup
+    mesh = make_mesh(MeshSpec(data=8 // stages, pipe=stages, fsdp=1))
+    out = pipeline.llama_pipeline_forward(params, tokens, config, mesh)
+    np.testing.assert_allclose(np.asarray(reference), np.asarray(out),
+                               atol=3e-4, rtol=1e-3)
+
+
+def test_more_microbatches_than_stages(setup):
+    config, params, tokens, reference = setup
+    mesh = make_mesh(MeshSpec(data=4, pipe=2, fsdp=1))
+    out = pipeline.llama_pipeline_forward(params, tokens, config, mesh,
+                                          num_microbatches=4)
+    np.testing.assert_allclose(np.asarray(reference), np.asarray(out),
+                               atol=3e-4, rtol=1e-3)
+
+
+def test_single_stage_fallback(setup):
+    config, params, tokens, reference = setup
+    mesh = make_mesh(MeshSpec(data=8, pipe=1, fsdp=1))
+    out = pipeline.llama_pipeline_forward(params, tokens, config, mesh)
+    np.testing.assert_allclose(np.asarray(reference), np.asarray(out),
+                               atol=3e-4, rtol=1e-3)
+
+
+def test_gradients_flow_through_pipeline(setup):
+    """jax.grad reverses the schedule; grads must match the oracle."""
+    config, params, tokens, _ = setup
+    mesh = make_mesh(MeshSpec(data=4, pipe=2, fsdp=1))
+
+    def ref_loss(p):
+        return (llama.forward(p, tokens, config).astype(
+            jnp.float32) ** 2).mean()
+
+    def pipe_loss(p):
+        return (pipeline.llama_pipeline_forward(
+            p, tokens, config, mesh).astype(jnp.float32) ** 2).mean()
+
+    g_ref = jax.grad(ref_loss)(params)
+    g_pipe = jax.grad(pipe_loss)(params)
+    for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_pipe)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-3)
+
+
+def test_uneven_layers_rejected(setup):
+    config, params, tokens, _ = setup  # 4 layers % 8 stages != 0
+    mesh = make_mesh(MeshSpec(data=1, pipe=8, fsdp=1))
+    with pytest.raises(ValueError, match='layers'):
+        pipeline.llama_pipeline_forward(params, tokens, config, mesh)
+
+
+def test_uneven_microbatches_rejected(setup):
+    config, params, tokens, _ = setup
+    mesh = make_mesh(MeshSpec(data=4, pipe=2, fsdp=1))
+    with pytest.raises(ValueError, match='microbatches'):
+        pipeline.llama_pipeline_forward(params, tokens, config, mesh,
+                                        num_microbatches=3)
