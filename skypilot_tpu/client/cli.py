@@ -446,35 +446,29 @@ def ssh_cmd(cluster, host_rank, print_command):
     import os as _os
     import shlex as _shlex
     if _os.environ.get('SKYTPU_API_SERVER_URL'):
-        raise click.ClickException(
-            'ssh needs local cluster state; run it on the API-server '
-            'host (SKYTPU_API_SERVER_URL is set).')
-    from skypilot_tpu import core as core_lib
+        # Remote API server: bridge this terminal over the server's
+        # websocket shell proxy (reference ws SSH proxy,
+        # sky/server/server.py:1338).
+        from skypilot_tpu.client import sdk
+        from skypilot_tpu.server import ws_proxy
+        if print_command:
+            click.echo(f'[ws-proxy] {sdk.api_server_url()}'
+                       f'/api/v1/clusters/{cluster}/shell'
+                       f'?host_rank={host_rank}')
+            return
+        try:
+            sys.exit(ws_proxy.connect_ws_shell(
+                sdk.api_server_url(), cluster, host_rank,
+                token=sdk.api_token()))
+        except exceptions.SkyTpuError as e:
+            raise click.ClickException(str(e))
     from skypilot_tpu import exceptions as exceptions_lib
+    from skypilot_tpu.server import ws_proxy
     try:
-        # Same lookup every other command uses: clean errors for
-        # missing AND for stopped/INIT clusters.
-        handle = core_lib._get_handle(cluster,  # noqa: SLF001
-                                      require_up=True)
+        # Single source of truth shared with the ws shell proxy.
+        argv = ws_proxy.interactive_argv_for(cluster, host_rank)
     except exceptions_lib.SkyTpuError as e:
         raise click.ClickException(str(e))
-    info = handle.cluster_info
-    if info is None:
-        raise click.ClickException(f'Cluster {cluster!r} has no hosts.')
-    from skypilot_tpu import provision as provision_lib
-    from skypilot_tpu.utils import command_runner as runner_lib
-    runners = provision_lib.get_command_runners(info.provider_name, info)
-    if not 0 <= host_rank < len(runners):
-        raise click.ClickException(
-            f'host-rank {host_rank} out of range ({len(runners)} hosts).')
-    runner = runners[host_rank]
-    if isinstance(runner, runner_lib.LocalProcessRunner):
-        argv = ['bash']
-    elif hasattr(runner, 'interactive_argv'):
-        argv = runner.interactive_argv()
-    else:
-        raise click.ClickException(
-            f'No interactive path for {type(runner).__name__}.')
     if print_command:
         click.echo(_shlex.join(argv))
         return

@@ -320,6 +320,9 @@ def create_app():
                         _handle_cancel_request)
     app.router.add_get(f'{API_PREFIX}/requests/{{request_id}}/stream',
                        _handle_stream)
+    from skypilot_tpu.server import ws_proxy
+    app.router.add_get(f'{API_PREFIX}/clusters/{{cluster}}/shell',
+                       ws_proxy.handle_ws_shell)
     app.router.add_post(f'{API_PREFIX}/{{name}}', _handle_command)
     return app
 

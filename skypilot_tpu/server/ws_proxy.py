@@ -1,0 +1,200 @@
+"""Websocket shell proxy: interactive access to cluster hosts THROUGH
+the API server.
+
+Reference analog: sky/server/server.py:1338 (/kubernetes-pod-ssh-proxy
+websocket). A client without direct network reach (no kubeconfig, no
+VPN route to pod IPs) opens a websocket to the API server, which runs
+the host's interactive command (kubectl exec for pods, ssh for VMs,
+bash for the local cloud) under a server-side PTY and bridges raw
+bytes — the same argv `tsky ssh` would exec locally, reused via each
+runner's interactive_argv().
+
+Access control: the websocket requires the same privilege as the
+`exec` command (RBAC WRITE) — a shell IS arbitrary execution.
+
+Protocol: binary ws messages carry terminal bytes both ways; the
+server's final TEXT message is `__SKYTPU_EXIT__<code>` so the client
+can propagate the shell's exit status.
+"""
+import asyncio
+import os
+from typing import List, Optional
+
+from skypilot_tpu import exceptions
+
+_EXIT_SENTINEL = '__SKYTPU_EXIT__'
+
+
+def interactive_argv_for(cluster: str, host_rank: int) -> List[str]:
+    """The host's interactive command (shared by `tsky ssh` and the ws
+    proxy so the two can never diverge)."""
+    from skypilot_tpu import core as core_lib
+    from skypilot_tpu import provision as provision_lib
+    from skypilot_tpu.utils import command_runner as runner_lib
+    handle = core_lib._get_handle(cluster, require_up=True)  # noqa: SLF001
+    info = handle.cluster_info
+    if info is None:
+        raise exceptions.SkyTpuError(
+            f'Cluster {cluster!r} has no hosts.')
+    runners = provision_lib.get_command_runners(info.provider_name, info)
+    if not 0 <= host_rank < len(runners):
+        raise exceptions.SkyTpuError(
+            f'host-rank {host_rank} out of range ({len(runners)} hosts).')
+    runner = runners[host_rank]
+    if isinstance(runner, runner_lib.LocalProcessRunner):
+        return ['bash']
+    if hasattr(runner, 'interactive_argv'):
+        return runner.interactive_argv()
+    raise exceptions.SkyTpuError(
+        f'No interactive path for {type(runner).__name__}.')
+
+
+async def handle_ws_shell(request):
+    """GET /api/v1/clusters/{cluster}/shell (websocket upgrade)."""
+    from aiohttp import WSMsgType, web
+
+    from skypilot_tpu.server import auth
+    # A shell is arbitrary execution: same RBAC bar as `exec`.
+    auth.check_command_allowed(request, 'exec')
+
+    cluster = request.match_info['cluster']
+    try:
+        host_rank = int(request.query.get('host_rank', '0'))
+    except ValueError:
+        raise web.HTTPBadRequest(text='host_rank must be an integer')
+    try:
+        argv = interactive_argv_for(cluster, host_rank)
+    except exceptions.SkyTpuError as e:
+        raise web.HTTPBadRequest(text=str(e))
+
+    ws = web.WebSocketResponse(max_msg_size=1 << 22)
+    await ws.prepare(request)
+
+    # A real PTY: ssh's -t and kubectl's -t silently downgrade on plain
+    # pipes (no prompt, no line editing, vim/password prompts hang).
+    master_fd, slave_fd = os.openpty()
+    proc = await asyncio.create_subprocess_exec(
+        *argv, stdin=slave_fd, stdout=slave_fd, stderr=slave_fd,
+        close_fds=True)
+    os.close(slave_fd)
+    loop = asyncio.get_running_loop()
+
+    async def pump_out():
+        try:
+            while True:
+                try:
+                    chunk = await loop.run_in_executor(
+                        None, os.read, master_fd, 4096)
+                except OSError:  # pty closed: shell exited
+                    break
+                if not chunk:
+                    break
+                await ws.send_bytes(chunk)
+        except (ConnectionResetError, RuntimeError):
+            pass
+        finally:
+            rc = await proc.wait()
+            if not ws.closed:
+                try:
+                    await ws.send_str(f'{_EXIT_SENTINEL}{rc}')
+                except (ConnectionResetError, RuntimeError):
+                    pass
+                await ws.close()
+
+    out_task = asyncio.ensure_future(pump_out())
+    try:
+        async for msg in ws:
+            if msg.type in (WSMsgType.BINARY, WSMsgType.TEXT):
+                data = (msg.data if isinstance(msg.data, bytes)
+                        else msg.data.encode())
+                try:
+                    await loop.run_in_executor(
+                        None, os.write, master_fd, data)
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    break  # shell already exited; close cleanly
+            elif msg.type in (WSMsgType.CLOSE, WSMsgType.ERROR):
+                break
+    finally:
+        if proc.returncode is None:
+            try:
+                proc.terminate()
+                await asyncio.wait_for(proc.wait(), timeout=5)
+            except (asyncio.TimeoutError, ProcessLookupError):
+                try:
+                    proc.kill()
+                except ProcessLookupError:
+                    pass
+        await out_task
+        try:
+            os.close(master_fd)
+        except OSError:
+            pass
+    return ws
+
+
+def connect_ws_shell(server_url: str, cluster: str,
+                     host_rank: int = 0,
+                     token: Optional[str] = None) -> int:
+    """Client side: bridge THIS terminal to the server's ws shell.
+
+    Returns the remote shell's exit code. Raises ApiServerError with
+    the server's message on handshake failure (bad cluster, 403, ...).
+    """
+    import sys
+    import threading
+
+    import aiohttp
+
+    async def _run() -> int:
+        headers = {}
+        if token:
+            headers['Authorization'] = f'Bearer {token}'
+        url = (f'{server_url}/api/v1/clusters/{cluster}/shell'
+               f'?host_rank={host_rank}')
+        loop = asyncio.get_running_loop()
+        exit_code = 1
+        async with aiohttp.ClientSession(headers=headers) as session:
+            try:
+                ws = await session.ws_connect(url, max_msg_size=1 << 22)
+            except aiohttp.WSServerHandshakeError as e:
+                raise exceptions.ApiServerError(
+                    f'Shell proxy refused (HTTP {e.status}): '
+                    f'{e.message}') from e
+            except aiohttp.ClientError as e:
+                raise exceptions.ApiServerError(
+                    f'Cannot reach shell proxy: {e}') from e
+            async with ws:
+                stop = threading.Event()
+
+                def read_stdin():
+                    while not stop.is_set():
+                        data = sys.stdin.buffer.read1(4096)
+                        if not data:
+                            asyncio.run_coroutine_threadsafe(
+                                ws.close(), loop)
+                            return
+                        asyncio.run_coroutine_threadsafe(
+                            ws.send_bytes(data), loop)
+
+                reader = threading.Thread(target=read_stdin, daemon=True)
+                reader.start()
+                try:
+                    async for msg in ws:
+                        if msg.type == aiohttp.WSMsgType.BINARY:
+                            sys.stdout.buffer.write(msg.data)
+                            sys.stdout.buffer.flush()
+                        elif msg.type == aiohttp.WSMsgType.TEXT:
+                            if msg.data.startswith(_EXIT_SENTINEL):
+                                try:
+                                    exit_code = int(
+                                        msg.data[len(_EXIT_SENTINEL):])
+                                except ValueError:
+                                    exit_code = 1
+                                break
+                            sys.stdout.write(msg.data)
+                            sys.stdout.flush()
+                finally:
+                    stop.set()
+        return exit_code
+
+    return asyncio.run(_run())

@@ -130,12 +130,13 @@ def test_ssh_print_command_local_and_guards(server, enable_clouds):
                           '--print-command'],
             env={'SKYTPU_API_SERVER_URL': ''})
         assert result.exit_code != 0
-    # remote API server → refuse with guidance
+    # remote API server → route through the websocket shell proxy
     result = CliRunner().invoke(
         cli_mod.cli, ['ssh', 'sshc', '--print-command'],
         env={'SKYTPU_API_SERVER_URL': 'http://elsewhere:1'})
-    assert result.exit_code != 0
-    assert 'API-server host' in result.output
+    assert result.exit_code == 0, result.output
+    assert '[ws-proxy]' in result.output
+    assert '/api/v1/clusters/sshc/shell' in result.output
     sky.down('sshc')
 
 
@@ -147,3 +148,69 @@ def test_ssh_command_for_ssh_cluster_uses_runner_options():
     assert argv[0] == 'ssh' and argv[-1] == 'u@1.2.3.4'
     assert argv[-2] == '-t'
     assert 'ControlMaster=auto' in argv  # reuses the shared options
+
+
+def test_websocket_shell_proxy(server, enable_clouds):
+    """ws shell bridges a remote client to a cluster host through the
+    API server (reference /kubernetes-pod-ssh-proxy)."""
+    import asyncio
+    import aiohttp
+    import skypilot_tpu as sky
+    from skypilot_tpu import task as task_lib
+
+    enable_clouds('local')
+    sky.launch(task_lib.Task(run='true', name='w'), cluster_name='wsc')
+
+    async def roundtrip():
+        async with aiohttp.ClientSession() as session:
+            url = f'{server.url}/api/v1/clusters/wsc/shell'
+            async with session.ws_connect(url) as ws:
+                await ws.send_bytes(b'echo WS-OK-$((40+2))\nexit\n')
+                collected = b''
+
+                async def _drain():
+                    nonlocal collected
+                    async for msg in ws:
+                        if msg.type == aiohttp.WSMsgType.BINARY:
+                            collected += msg.data
+                        if b'WS-OK-42' in collected:
+                            return
+
+                try:  # asyncio.timeout is 3.11+; wait_for runs on 3.10
+                    await asyncio.wait_for(_drain(), timeout=20)
+                except asyncio.TimeoutError:
+                    pass
+                return collected
+
+    out = asyncio.run(roundtrip())
+    assert b'WS-OK-42' in out, out[-300:]
+
+    async def bad_cluster():
+        async with aiohttp.ClientSession() as session:
+            url = f'{server.url}/api/v1/clusters/nope/shell'
+            resp = await session.get(url)
+            return resp.status
+
+    assert asyncio.run(bad_cluster()) == 400
+
+    # RBAC: a shell is `exec`-equivalent — viewers get 403.
+    import os
+    cfg_path = os.path.expanduser('~/.skytpu/config.yaml')
+    os.makedirs(os.path.dirname(cfg_path), exist_ok=True)
+    with open(cfg_path, 'w', encoding='utf-8') as f:
+        f.write('api_server:\n  auth: true\n  users:\n'
+                '    - {name: v, token: tok-v, role: viewer}\n')
+    from skypilot_tpu import config as config_lib
+    config_lib.reload()
+
+    async def viewer_shell():
+        async with aiohttp.ClientSession(
+                headers={'Authorization': 'Bearer tok-v'}) as session:
+            resp = await session.get(
+                f'{server.url}/api/v1/clusters/wsc/shell')
+            return resp.status
+
+    assert asyncio.run(viewer_shell()) == 403
+    os.remove(cfg_path)
+    config_lib.reload()
+    sky.down('wsc')

@@ -104,3 +104,33 @@ class TestLogShipping:
                 raise AssertionError('must not run')
 
         agent_lib.setup_agent_on_cluster([Exploding()], '/rt', 'c1')
+
+
+class TestRichUtils:
+
+    def test_non_tty_prints_plain_lines(self):
+        import io
+        from skypilot_tpu.utils import rich_utils
+        out = io.StringIO()  # not a TTY
+        with rich_utils.status('phase one', out=out) as s:
+            s.update('phase two')
+        text = out.getvalue()
+        assert 'phase one\n' in text
+        assert 'phase two\n' in text
+        assert '\r' not in text  # no control sequences off-TTY
+
+    def test_tty_spinner_clears_line(self):
+        import io
+        from skypilot_tpu.utils import rich_utils
+
+        class FakeTty(io.StringIO):
+            def isatty(self):
+                return True
+
+        out = FakeTty()
+        import time as _time
+        with rich_utils.status('working', out=out):
+            _time.sleep(0.3)
+        text = out.getvalue()
+        assert 'working' in text
+        assert text.endswith('\r\x1b[2K')  # line cleared on exit
