@@ -445,11 +445,13 @@ def ssh_cmd(cluster, host_rank, print_command):
     """
     import os as _os
     import shlex as _shlex
-    if _os.environ.get('SKYTPU_API_SERVER_URL'):
-        # Remote API server: bridge this terminal over the server's
-        # websocket shell proxy (reference ws SSH proxy,
-        # sky/server/server.py:1338).
-        from skypilot_tpu.client import sdk
+    from skypilot_tpu.client import sdk
+    from skypilot_tpu.server import app as _app
+    local_default = f'http://127.0.0.1:{_app.DEFAULT_PORT}'
+    if sdk.api_server_url() != local_default:
+        # Remote API server (env var OR api login-stored endpoint):
+        # bridge this terminal over the server's websocket shell proxy
+        # (reference ws SSH proxy, sky/server/server.py:1338).
         from skypilot_tpu.server import ws_proxy
         if print_command:
             click.echo(f'[ws-proxy] {sdk.api_server_url()}'
@@ -599,6 +601,45 @@ def api_info():
     info = _request_raw('GET', '/health', timeout=5.0)
     click.echo(f'URL: {sdk.api_server_url()}')
     click.echo(_json.dumps(info, indent=1))
+
+
+@api.command('login')
+@click.option('--endpoint', default=None,
+              help='API server URL (e.g. http://host:46590).')
+@click.option('--token', default=None,
+              help='Bearer token; prompted for when omitted.')
+def api_login(endpoint, token):
+    """Store API server endpoint + token in the user config
+    (reference sky api login / client/oauth.py)."""
+    import os as _os
+    import yaml as _yaml
+    from skypilot_tpu import config as config_lib
+    if token is None:
+        token = click.prompt('API token', hide_input=True, default='',
+                             show_default=False) or None
+    cfg_path = _os.path.expanduser(config_lib.USER_CONFIG_PATH)
+    _os.makedirs(_os.path.dirname(cfg_path), exist_ok=True)
+    try:
+        with open(cfg_path, 'r', encoding='utf-8') as f:
+            cfg = _yaml.safe_load(f) or {}
+    except FileNotFoundError:
+        cfg = {}
+    section = cfg.setdefault('api_server', {})
+    if token:
+        section['token'] = token
+    if endpoint:
+        section['endpoint'] = endpoint.rstrip('/')
+    # 0o600 from CREATION: chmod-after-write leaves a window where a
+    # default-umask file briefly exposes the token on shared hosts.
+    fd = _os.open(cfg_path, _os.O_WRONLY | _os.O_CREAT | _os.O_TRUNC,
+                  0o600)
+    with _os.fdopen(fd, 'w', encoding='utf-8') as f:
+        _yaml.safe_dump(cfg, f, default_flow_style=False)
+    _os.chmod(cfg_path, 0o600)  # pre-existing files keep tight perms
+    config_lib.reload()
+    stored = [k for k in ('token', 'endpoint') if section.get(k)]
+    click.echo(f'Stored {" + ".join(stored) or "nothing"} in '
+               f'{cfg_path}.')
 
 
 @api.command('stop')

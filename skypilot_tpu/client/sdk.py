@@ -25,6 +25,10 @@ def api_server_url() -> str:
     url = os.environ.get('SKYTPU_API_SERVER_URL')
     if url:
         return url.rstrip('/')
+    from skypilot_tpu import config as config_lib
+    url = config_lib.get_nested(('api_server', 'endpoint'), default=None)
+    if url:
+        return str(url).rstrip('/')
     return f'http://127.0.0.1:{server_app.DEFAULT_PORT}'
 
 

@@ -25,6 +25,24 @@ from skypilot_tpu import exceptions
 _EXIT_SENTINEL = '__SKYTPU_EXIT__'
 
 
+def _set_winsize(fd: int, rows, cols) -> None:
+    """Initial PTY window size from the client (openpty defaults to
+    0x0, which makes curses apps misrender or refuse to start)."""
+    import fcntl
+    import struct
+    import termios
+    try:
+        rows_i = int(rows or 24)
+        cols_i = int(cols or 80)
+    except (TypeError, ValueError):
+        rows_i, cols_i = 24, 80
+    try:
+        fcntl.ioctl(fd, termios.TIOCSWINSZ,
+                    struct.pack('HHHH', rows_i, cols_i, 0, 0))
+    except OSError:
+        pass
+
+
 def interactive_argv_for(cluster: str, host_rank: int) -> List[str]:
     """The host's interactive command (shared by `tsky ssh` and the ws
     proxy so the two can never diverge)."""
@@ -73,6 +91,8 @@ async def handle_ws_shell(request):
     # A real PTY: ssh's -t and kubectl's -t silently downgrade on plain
     # pipes (no prompt, no line editing, vim/password prompts hang).
     master_fd, slave_fd = os.openpty()
+    _set_winsize(slave_fd,
+                 request.query.get('rows'), request.query.get('cols'))
     proc = await asyncio.create_subprocess_exec(
         *argv, stdin=slave_fd, stdout=slave_fd, stderr=slave_fd,
         close_fds=True)
@@ -140,17 +160,21 @@ def connect_ws_shell(server_url: str, cluster: str,
     Returns the remote shell's exit code. Raises ApiServerError with
     the server's message on handshake failure (bad cluster, 403, ...).
     """
+    import shutil
     import sys
     import threading
 
     import aiohttp
+
+    size = shutil.get_terminal_size(fallback=(80, 24))
 
     async def _run() -> int:
         headers = {}
         if token:
             headers['Authorization'] = f'Bearer {token}'
         url = (f'{server_url}/api/v1/clusters/{cluster}/shell'
-               f'?host_rank={host_rank}')
+               f'?host_rank={host_rank}'
+               f'&rows={size.lines}&cols={size.columns}')
         loop = asyncio.get_running_loop()
         exit_code = 1
         async with aiohttp.ClientSession(headers=headers) as session:
@@ -197,4 +221,23 @@ def connect_ws_shell(server_url: str, cluster: str,
                     stop.set()
         return exit_code
 
-    return asyncio.run(_run())
+    # Raw mode: without it the cooked local TTY double-echoes, only
+    # sends on Enter, and eats Ctrl-C/Ctrl-D instead of forwarding
+    # them to the remote shell.
+    stdin_fd = None
+    saved = None
+    try:
+        import termios
+        import tty
+        if sys.stdin.isatty():
+            stdin_fd = sys.stdin.fileno()
+            saved = termios.tcgetattr(stdin_fd)
+            tty.setraw(stdin_fd)
+    except (ImportError, OSError):
+        saved = None
+    try:
+        return asyncio.run(_run())
+    finally:
+        if saved is not None:
+            import termios
+            termios.tcsetattr(stdin_fd, termios.TCSADRAIN, saved)

@@ -214,3 +214,24 @@ def test_websocket_shell_proxy(server, enable_clouds):
     os.remove(cfg_path)
     config_lib.reload()
     sky.down('wsc')
+
+
+def test_api_login_stores_credentials(server):
+    import os
+    from skypilot_tpu.client import sdk
+    result = CliRunner().invoke(
+        cli_mod.cli,
+        ['api', 'login', '--endpoint', 'http://far:46590/',
+         '--token', 'tok-login'])
+    assert result.exit_code == 0, result.output
+    cfg_path = os.path.expanduser('~/.skytpu/config.yaml')
+    assert oct(os.stat(cfg_path).st_mode & 0o777) == '0o600'
+    # Env (set by the server fixture) still wins over the config...
+    assert sdk.api_server_url() == os.environ['SKYTPU_API_SERVER_URL']
+    assert sdk.api_token() == 'tok-login'
+    # ...and without the env override the stored endpoint applies.
+    del os.environ['SKYTPU_API_SERVER_URL']
+    try:
+        assert sdk.api_server_url() == 'http://far:46590'
+    finally:
+        os.environ['SKYTPU_API_SERVER_URL'] = ''
