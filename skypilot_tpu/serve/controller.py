@@ -66,7 +66,7 @@ class ServeController:
             return
         self._maybe_reload_spec(service)
         self.manager.probe_all()
-        self._rolling_update(service)
+        updating = self._rolling_update(service)
         replicas = serve_state.get_replicas(self.service_name)
         ready = self.manager.ready_endpoints()
         self.lb.set_replicas(ready)
@@ -75,16 +75,20 @@ class ServeController:
                 if r['status'] not in (
                     serve_state.ReplicaStatus.SHUTTING_DOWN,
                     serve_state.ReplicaStatus.FAILED)]
+        # During a rolling update the ROLLOUT owns shrinking (the
+        # autoscaler would otherwise kill the surge replica every
+        # tick); scale-UP — including spot-preemption fallback — stays
+        # live so capacity never drains under load.
         if isinstance(self.autoscaler,
                       autoscalers.FallbackRequestRateAutoscaler):
-            self._scale_mixed(live)
+            self._scale_mixed(live, no_shrink=updating)
         else:
             decision = self.autoscaler.decide(
                 len(ready), len(live), self.lb.tracker.qps())
             if decision.target_replicas > len(live):
                 self.manager.scale_up(
                     decision.target_replicas - len(live))
-            elif decision.target_replicas < len(live):
+            elif decision.target_replicas < len(live) and not updating:
                 # Prefer terminating not-ready replicas, then highest
                 # (newest, least-warm) ids.
                 victims = sorted(
@@ -96,14 +100,18 @@ class ServeController:
                 self.manager.scale_down(
                     [v['replica_id'] for v in victims[:n]])
 
+        self._set_health_status(live, ready)
+
+    def _set_health_status(self, live, ready) -> None:
         status = (serve_state.ServiceStatus.READY if ready else
                   (serve_state.ServiceStatus.NO_REPLICA if not live else
                    serve_state.ServiceStatus.REPLICA_INIT))
         serve_state.set_service_status(self.service_name, status)
 
-    def _scale_mixed(self, live) -> None:
+    def _scale_mixed(self, live, no_shrink: bool = False) -> None:
         """Spot fleet with on-demand fallback: reconcile the two pools
-        separately toward the mixed decision."""
+        separately toward the mixed decision. no_shrink defers pool
+        shrinking to the rolling update that owns it."""
         spot = [r for r in live if r.get('use_spot')]
         ondemand = [r for r in live if not r.get('use_spot')]
         ready_spot = [r for r in spot
@@ -116,7 +124,7 @@ class ServeController:
             if target > len(pool):
                 self.manager.scale_up(target - len(pool),
                                       use_spot=use_spot)
-            elif target < len(pool):
+            elif target < len(pool) and not no_shrink:
                 victims = sorted(
                     pool,
                     key=lambda r: (
@@ -141,17 +149,18 @@ class ServeController:
         self.autoscaler.update_spec(self.spec)
         self._loaded_version = service['version']
 
-    def _rolling_update(self, service) -> None:
+    def _rolling_update(self, service) -> bool:
         """Replace old-version replicas one at a time, never dropping
         below the ready set (reference rolling update,
-        replica_managers.py version tracking)."""
+        replica_managers.py version tracking). Returns True while an
+        update is in progress (old-version replicas still live)."""
         version = service['version']
         replicas = serve_state.get_replicas(self.service_name)
         old = [r for r in replicas if r['version'] < version and
                r['status'] not in (serve_state.ReplicaStatus.SHUTTING_DOWN,
                                    serve_state.ReplicaStatus.FAILED)]
         if not old:
-            return
+            return False
         new_live = [r for r in replicas if r['version'] >= version and
                     r['status'] not in (
                         serve_state.ReplicaStatus.SHUTTING_DOWN,
@@ -167,6 +176,7 @@ class ServeController:
             victims = sorted(old, key=lambda r: r['replica_id'])
             self.manager.scale_down(
                 [victims[0]['replica_id']])
+        return True
 
     def _shutdown(self) -> None:
         self.manager.terminate_all()
