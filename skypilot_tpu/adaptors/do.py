@@ -1,0 +1,65 @@
+"""DigitalOcean adaptor: bearer-token REST v2 API.
+
+Reference analog: sky/provision/do/utils.py (the reference uses
+pydo/azure-core; the public v2 REST surface is plain JSON).
+Credential: DIGITALOCEAN_TOKEN env var or the doctl config's
+access-token.
+"""
+import os
+from typing import Dict, Optional
+
+from skypilot_tpu.adaptors import rest
+
+API_ENDPOINT = 'https://api.digitalocean.com'
+CREDENTIALS_PATH = '~/.config/doctl/config.yaml'
+
+RestApiError = rest.RestApiError
+
+
+def get_token() -> Optional[str]:
+    token = os.environ.get('DIGITALOCEAN_TOKEN')
+    if token:
+        return token
+    path = os.path.expanduser(CREDENTIALS_PATH)
+    if not os.path.isfile(path):
+        return None
+    try:
+        with open(path, 'r', encoding='utf-8') as f:
+            for line in f:
+                name, _, value = line.partition(':')
+                if name.strip() == 'access-token' and value.strip():
+                    return value.strip()
+    except OSError:
+        return None
+    return None
+
+
+def _make_client() -> rest.RestClient:
+    def _headers() -> Dict[str, str]:
+        token = get_token()
+        if not token:
+            from skypilot_tpu import exceptions
+            raise exceptions.ProvisionError(
+                'DigitalOcean token not found; set DIGITALOCEAN_TOKEN '
+                f'or configure doctl ({CREDENTIALS_PATH}).')
+        return {'Authorization': f'Bearer {token}'}
+
+    return rest.RestClient(
+        API_ENDPOINT, _headers,
+        error_code_fn=lambda payload: payload.get('id', ''))
+
+
+_slot = rest.ClientSlot(_make_client)
+client = _slot.get
+set_client_factory = _slot.set_factory
+
+
+def classify_api_error(err: RestApiError):
+    from skypilot_tpu import exceptions
+    text = str(err).lower()
+    if err.status == 422 and ('unavailable' in text
+                              or 'out of capacity' in text):
+        return exceptions.CapacityError(str(err))
+    if 'limit' in text and err.status in (403, 422):
+        return exceptions.QuotaExceededError(str(err))
+    return err

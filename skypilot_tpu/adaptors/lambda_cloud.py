@@ -1,0 +1,73 @@
+"""Lambda Cloud adaptor: bearer-token REST v1 API.
+
+Reference analog: sky/adaptors/... + sky/provision/lambda_cloud/
+lambda_utils.py (the reference wraps the same public API with
+`requests`). Credential: LAMBDA_API_KEY env var or
+~/.lambda_cloud/lambda_keys (`api_key = <key>` line, the format the
+reference's lambda_utils reads).
+"""
+import os
+from typing import Dict, Optional
+
+from skypilot_tpu.adaptors import rest
+
+API_ENDPOINT = 'https://cloud.lambdalabs.com/api/v1'
+CREDENTIALS_PATH = '~/.lambda_cloud/lambda_keys'
+
+RestApiError = rest.RestApiError
+
+
+def get_api_key() -> Optional[str]:
+    key = os.environ.get('LAMBDA_API_KEY')
+    if key:
+        return key
+    path = os.path.expanduser(CREDENTIALS_PATH)
+    if not os.path.isfile(path):
+        return None
+    try:
+        with open(path, 'r', encoding='utf-8') as f:
+            for line in f:
+                name, _, value = line.partition('=')
+                if name.strip() == 'api_key' and value.strip():
+                    return value.strip()
+    except OSError:
+        # Unreadable credentials == no credentials; check_credentials
+        # must report (False, reason), not crash the cloud check.
+        return None
+    return None
+
+
+def _make_client() -> rest.RestClient:
+    def _headers() -> Dict[str, str]:
+        key = get_api_key()
+        if not key:
+            from skypilot_tpu import exceptions
+            raise exceptions.ProvisionError(
+                'Lambda Cloud API key not found; set LAMBDA_API_KEY or '
+                f'create {CREDENTIALS_PATH}.')
+        return {'Authorization': f'Bearer {key}'}
+
+    return rest.RestClient(
+        API_ENDPOINT, _headers,
+        error_code_fn=lambda payload: payload['error']['code'])
+
+
+_slot = rest.ClientSlot(_make_client)
+client = _slot.get
+set_client_factory = _slot.set_factory
+
+
+def classify_api_error(err: RestApiError):
+    """Lambda error codes → failover taxonomy.
+
+    `insufficient-capacity` / `instance-operations/launch/
+    insufficient-capacity` style codes mean try another region;
+    `quota-exceeded` maps to the quota bucket.
+    """
+    from skypilot_tpu import exceptions
+    code = err.code or ''
+    if 'insufficient-capacity' in code or err.status == 503:
+        return exceptions.CapacityError(str(err))
+    if 'quota' in code:
+        return exceptions.QuotaExceededError(str(err))
+    return err

@@ -1,0 +1,57 @@
+"""Nebius AI Cloud adaptor: IAM-bearer REST over the compute v1 API.
+
+Reference analog: sky/provision/nebius/utils.py (the reference drives
+the `nebius` SDK; the same compute surface is reachable as JSON REST
+at the regional API endpoint). Credential: NEBIUS_IAM_TOKEN env var or
+~/.nebius/NEBIUS_IAM_TOKEN.txt (the SDK's drop location); the parent
+project id comes from provider config or NEBIUS_PROJECT_ID.
+"""
+import os
+from typing import Dict, Optional
+
+from skypilot_tpu.adaptors import rest
+
+API_ENDPOINT = 'https://api.eu.nebius.cloud'
+CREDENTIALS_PATH = '~/.nebius/NEBIUS_IAM_TOKEN.txt'
+
+RestApiError = rest.RestApiError
+
+
+def get_iam_token() -> Optional[str]:
+    return rest.env_or_file_credential('NEBIUS_IAM_TOKEN',
+                                       CREDENTIALS_PATH)
+
+
+def default_project_id() -> Optional[str]:
+    return os.environ.get('NEBIUS_PROJECT_ID')
+
+
+def _make_client() -> rest.RestClient:
+    def _headers() -> Dict[str, str]:
+        token = get_iam_token()
+        if not token:
+            from skypilot_tpu import exceptions
+            raise exceptions.ProvisionError(
+                'Nebius IAM token not found; set NEBIUS_IAM_TOKEN or '
+                f'create {CREDENTIALS_PATH}.')
+        return {'Authorization': f'Bearer {token}'}
+
+    return rest.RestClient(
+        API_ENDPOINT, _headers,
+        error_code_fn=lambda payload: payload.get('code', ''))
+
+
+_slot = rest.ClientSlot(_make_client)
+client = _slot.get
+set_client_factory = _slot.set_factory
+
+
+def classify_api_error(err: RestApiError):
+    from skypilot_tpu import exceptions
+    text = str(err).lower()
+    if ('resource_exhausted' in (err.code or '').lower()
+            or 'not enough capacity' in text or err.status == 503):
+        return exceptions.CapacityError(str(err))
+    if 'quota' in text:
+        return exceptions.QuotaExceededError(str(err))
+    return err

@@ -1,0 +1,135 @@
+"""Shared JSON-over-HTTPS plumbing for the flat REST VM clouds.
+
+Reference analog: sky/adaptors/common.py (LazyImport around cloud
+SDKs). The GPU-neocloud APIs (Lambda Cloud, RunPod, Nebius,
+DigitalOcean) are all bearer-token JSON REST — no SDK is worth the
+dependency, so each adaptor is a thin per-cloud wrapper over this
+module: one `RestClient` plus one injectable client slot so unit tests
+run the real provisioner against an in-memory fake API (same strategy
+as the GCP transport / AWS client / ARM fakes).
+"""
+import json
+import os
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Callable, Dict, Optional
+
+from skypilot_tpu import exceptions
+
+
+class RestApiError(exceptions.ProvisionError):
+    """HTTP-level failure from a cloud REST API."""
+
+    def __init__(self, message: str, code: str = '', status: int = 0):
+        super().__init__(message)
+        self.code = code
+        self.status = status
+
+
+class RestClient:
+    """Minimal JSON REST client.
+
+    `headers_fn` is called per request so short-lived tokens refresh
+    naturally; `error_code_fn` extracts a cloud-specific error code
+    string from the decoded error payload for failover taxonomy.
+    """
+
+    def __init__(self, base_url: str,
+                 headers_fn: Callable[[], Dict[str, str]],
+                 error_code_fn: Optional[Callable[[Any], str]] = None,
+                 timeout: float = 60.0):
+        self._base_url = base_url.rstrip('/')
+        self._headers_fn = headers_fn
+        self._error_code_fn = error_code_fn
+        self._timeout = timeout
+
+    def request(self, method: str, path: str,
+                params: Optional[Dict[str, str]] = None,
+                json_body: Optional[Any] = None) -> Any:
+        url = f'{self._base_url}{path}'
+        if params:
+            url += f'?{urllib.parse.urlencode(params)}'
+        data = None
+        headers = {'Content-Type': 'application/json',
+                   **self._headers_fn()}
+        if json_body is not None:
+            data = json.dumps(json_body).encode()
+        req = urllib.request.Request(url, data=data, headers=headers,
+                                     method=method)
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=self._timeout) as resp:
+                body = resp.read()
+        except urllib.error.HTTPError as e:
+            payload = e.read().decode(errors='replace')
+            code = ''
+            if self._error_code_fn is not None:
+                try:
+                    code = self._error_code_fn(json.loads(payload)) or ''
+                except (json.JSONDecodeError, AttributeError, KeyError,
+                        TypeError):
+                    code = ''
+            raise RestApiError(
+                f'{method} {path}: HTTP {e.code}: {payload[:500]}',
+                code=code, status=e.code) from e
+        except urllib.error.URLError as e:
+            raise RestApiError(f'{method} {path}: {e.reason}') from e
+        return json.loads(body) if body else {}
+
+
+class ClientSlot:
+    """Injectable, fork-safe, lazily-constructed client singleton.
+
+    Every REST-cloud adaptor owns one; tests swap the factory for an
+    in-memory fake. Forked executor children get a fresh lock and drop
+    the cached client (sockets don't survive fork).
+    """
+
+    def __init__(self, default_factory: Callable[[], Any]):
+        self._factory = default_factory
+        self._client: Optional[Any] = None
+        self._lock = threading.Lock()
+        os.register_at_fork(after_in_child=self._after_fork_in_child)
+
+    def _after_fork_in_child(self) -> None:
+        self._lock = threading.Lock()
+        self._client = None
+
+    def set_factory(self, factory: Callable[[], Any]) -> None:
+        with self._lock:
+            self._factory = factory
+            self._client = None
+
+    def get(self) -> Any:
+        with self._lock:
+            if self._client is None:
+                self._client = self._factory()
+            return self._client
+
+
+def env_or_file_credential(env_var: str, path: str,
+                           key: Optional[str] = None) -> Optional[str]:
+    """API key from env var, else from a file (~-expanded). When `key`
+    is given the file is parsed as JSON and that key is returned;
+    otherwise the stripped file body is the credential."""
+    value = os.environ.get(env_var)
+    if value:
+        return value
+    full = os.path.expanduser(path)
+    if not os.path.isfile(full):
+        return None
+    try:
+        with open(full, 'r', encoding='utf-8') as f:
+            body = f.read().strip()
+    except OSError:
+        return None
+    if not body:
+        return None
+    if key is None:
+        return body
+    try:
+        return json.loads(body).get(key)
+    except json.JSONDecodeError:
+        return None

@@ -1,0 +1,70 @@
+"""RunPod adaptor: bearer-token REST v1 API.
+
+Reference analog: sky/provision/runpod/utils.py (the reference drives
+the `runpod` SDK's GraphQL API; RunPod's newer REST surface at
+rest.runpod.io/v1 covers the same pod lifecycle with plain JSON, which
+is all we need). Credential: RUNPOD_API_KEY env var or
+~/.runpod/config.toml (`apikey = "<key>"` line, the SDK's location).
+"""
+import os
+from typing import Dict, Optional
+
+from skypilot_tpu.adaptors import rest
+
+API_ENDPOINT = 'https://rest.runpod.io/v1'
+CREDENTIALS_PATH = '~/.runpod/config.toml'
+
+RestApiError = rest.RestApiError
+
+
+def get_api_key() -> Optional[str]:
+    key = os.environ.get('RUNPOD_API_KEY')
+    if key:
+        return key
+    path = os.path.expanduser(CREDENTIALS_PATH)
+    if not os.path.isfile(path):
+        return None
+    try:
+        with open(path, 'r', encoding='utf-8') as f:
+            for line in f:
+                name, _, value = line.partition('=')
+                if name.strip() in ('apikey', 'api_key'):
+                    return value.strip().strip('"\'') or None
+    except OSError:
+        # Unreadable credentials == no credentials; check_credentials
+        # must report (False, reason), not crash the cloud check.
+        return None
+    return None
+
+
+def _make_client() -> rest.RestClient:
+    def _headers() -> Dict[str, str]:
+        key = get_api_key()
+        if not key:
+            from skypilot_tpu import exceptions
+            raise exceptions.ProvisionError(
+                'RunPod API key not found; set RUNPOD_API_KEY or '
+                f'create {CREDENTIALS_PATH}.')
+        return {'Authorization': f'Bearer {key}'}
+
+    return rest.RestClient(
+        API_ENDPOINT, _headers,
+        error_code_fn=lambda payload: payload.get('error', ''))
+
+
+_slot = rest.ClientSlot(_make_client)
+client = _slot.get
+set_client_factory = _slot.set_factory
+
+
+def classify_api_error(err: RestApiError):
+    """RunPod errors → failover taxonomy. Capacity exhaustion surfaces
+    as 'no instances available' style messages on create."""
+    from skypilot_tpu import exceptions
+    text = str(err).lower()
+    if ('no instances available' in text or 'not enough' in text
+            or 'unavailable' in text or err.status == 503):
+        return exceptions.CapacityError(str(err))
+    if 'quota' in text or 'limit' in text:
+        return exceptions.QuotaExceededError(str(err))
+    return err

@@ -12,6 +12,10 @@ _CATALOG_MODULES = {
     'gcp': 'skypilot_tpu.catalog.gcp_catalog',
     'aws': 'skypilot_tpu.catalog.aws_catalog',
     'azure': 'skypilot_tpu.catalog.azure_catalog',
+    'lambda': 'skypilot_tpu.catalog.lambda_catalog',
+    'runpod': 'skypilot_tpu.catalog.runpod_catalog',
+    'nebius': 'skypilot_tpu.catalog.nebius_catalog',
+    'do': 'skypilot_tpu.catalog.do_catalog',
     'local': 'skypilot_tpu.catalog.local_catalog',
     'kubernetes': 'skypilot_tpu.catalog.kubernetes_catalog',
 }

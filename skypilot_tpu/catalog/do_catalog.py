@@ -1,0 +1,9 @@
+"""DigitalOcean catalog: droplet sizes from the shipped CSV.
+
+Reference analog: sky/catalog/do_catalog.py. Regions are DO slugs
+(nyc3, sfo3, ...); no zones, no spot market.
+"""
+from skypilot_tpu.catalog import common
+
+list_accelerators, get_feasible, validate_region_zone = \
+    common.make_vm_catalog('do', zones_modeled=False)

@@ -1,0 +1,9 @@
+"""Lambda Cloud catalog: GPU instance types from the shipped CSV.
+
+Reference analog: sky/catalog/lambda_catalog.py. Prices from the
+public on-demand price list; no zones, no spot market.
+"""
+from skypilot_tpu.catalog import common
+
+list_accelerators, get_feasible, validate_region_zone = \
+    common.make_vm_catalog('lambda', zones_modeled=False)

@@ -1,0 +1,9 @@
+"""Nebius catalog: `<platform>_<preset>` instance types.
+
+Reference analog: sky/catalog/nebius_catalog.py. Prices from the
+public calculator; no zones, no spot market.
+"""
+from skypilot_tpu.catalog import common
+
+list_accelerators, get_feasible, validate_region_zone = \
+    common.make_vm_catalog('nebius', zones_modeled=False)

@@ -1,0 +1,9 @@
+"""RunPod catalog: synthetic `<count>x_<GPU>` instance types.
+
+Reference analog: sky/catalog/runpod_catalog.py. Regions are RunPod
+data centers; spot_price is the COMMUNITY/interruptible rate.
+"""
+from skypilot_tpu.catalog import common
+
+list_accelerators, get_feasible, validate_region_zone = \
+    common.make_vm_catalog('runpod', zones_modeled=False)

@@ -2,15 +2,23 @@
 from skypilot_tpu.clouds.cloud import Cloud, CloudCapability
 from skypilot_tpu.clouds import aws as _aws  # noqa: F401 (registers)
 from skypilot_tpu.clouds import azure as _azure  # noqa: F401 (registers)
+from skypilot_tpu.clouds import do as _do  # noqa: F401 (registers)
 from skypilot_tpu.clouds import gcp as _gcp  # noqa: F401 (registers)
+from skypilot_tpu.clouds import lambda_cloud as _lambda  # noqa: F401
 from skypilot_tpu.clouds import local as _local  # noqa: F401 (registers)
+from skypilot_tpu.clouds import nebius as _nebius  # noqa: F401
+from skypilot_tpu.clouds import runpod as _runpod  # noqa: F401
 from skypilot_tpu.clouds import ssh as _ssh  # noqa: F401 (registers)
 from skypilot_tpu.utils.registry import CLOUD_REGISTRY
 
 AWS = _aws.AWS
 Azure = _azure.Azure
+DigitalOcean = _do.DigitalOcean
 GCP = _gcp.GCP
+LambdaCloud = _lambda.LambdaCloud
 Local = _local.Local
+Nebius = _nebius.Nebius
+RunPod = _runpod.RunPod
 SSH = _ssh.SSHCloud
 
 try:  # kubernetes is optional until round 2+
@@ -24,5 +32,6 @@ def get_cloud(name: str) -> Cloud:
     return CLOUD_REGISTRY.get(name)()
 
 
-__all__ = ['Cloud', 'CloudCapability', 'AWS', 'Azure', 'GCP', 'Local', 'get_cloud',
-           'CLOUD_REGISTRY']
+__all__ = ['Cloud', 'CloudCapability', 'AWS', 'Azure', 'DigitalOcean',
+           'GCP', 'LambdaCloud', 'Local', 'Nebius', 'RunPod', 'SSH',
+           'get_cloud', 'CLOUD_REGISTRY']

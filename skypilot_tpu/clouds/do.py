@@ -1,0 +1,60 @@
+"""DigitalOcean policy — cheap CPU droplets + GPU droplets.
+
+Reference analog: sky/clouds/do.py. The cheapest HOST_CONTROLLERS
+cloud in the catalog: dedicated jobs/serve controllers land here when
+it wins the optimizer's cost race.
+"""
+from typing import Dict, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.utils import registry
+
+
+@registry.CLOUD_REGISTRY.register(name='do')
+class DigitalOcean(cloud.Cloud):
+    NAME = 'do'
+    CAPABILITIES = frozenset({
+        cloud.CloudCapability.MULTI_NODE,
+        cloud.CloudCapability.STOP,
+        cloud.CloudCapability.AUTOSTOP,
+        cloud.CloudCapability.STORAGE_MOUNT,
+        cloud.CloudCapability.CUSTOM_IMAGE,
+        cloud.CloudCapability.HOST_CONTROLLERS,
+    })
+    # Droplet names are DNS-ish; keep headroom for '-<index>'.
+    MAX_CLUSTER_NAME_LENGTH = 56
+
+    def provision_module(self) -> str:
+        return 'skypilot_tpu.provision.do'
+
+    def make_deploy_variables(self, resources, cluster_name_on_cloud: str,
+                              region: str, zone: Optional[str]
+                              ) -> Dict[str, object]:
+        resources.assert_launchable()
+        auth = self.authentication_config()
+        variables: Dict[str, object] = {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region,
+            'zone': None,
+            'instance_type': resources.instance_type,
+            'use_spot': False,  # no spot market
+            'disk_size': resources.disk_size,
+            'ssh_user': 'root',
+            'ssh_private_key': auth.get('ssh_private_key'),
+            'num_nodes': None,  # filled by the provisioner
+        }
+        if resources.image_id:
+            variables['image_id'] = resources.image_id
+        return variables
+
+    def authentication_config(self) -> Dict[str, object]:
+        from skypilot_tpu import authentication
+        return authentication.authentication_config()
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.adaptors import do as adaptor
+        if adaptor.get_token():
+            return True, None
+        return False, ('DigitalOcean token not found. Set '
+                       'DIGITALOCEAN_TOKEN or configure doctl '
+                       f'({adaptor.CREDENTIALS_PATH}).')

@@ -1,0 +1,53 @@
+"""Lambda Cloud policy — GPU neocloud.
+
+Reference analog: sky/clouds/lambda_cloud.py. Launch/terminate only:
+no stop, no custom images, no per-cluster firewall. GPU boxes only, so
+controllers are not hosted here (HOST_CONTROLLERS off keeps the
+dedicated jobs/serve controllers from landing on a $2/hr GPU node).
+"""
+from typing import Dict, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.utils import registry
+
+
+@registry.CLOUD_REGISTRY.register(name='lambda')
+class LambdaCloud(cloud.Cloud):
+    NAME = 'lambda'
+    CAPABILITIES = frozenset({
+        cloud.CloudCapability.MULTI_NODE,
+        cloud.CloudCapability.STORAGE_MOUNT,
+    })
+    # Instance `name` is free-form but keep parity with VM-name clouds.
+    MAX_CLUSTER_NAME_LENGTH = 56
+
+    def provision_module(self) -> str:
+        return 'skypilot_tpu.provision.lambda_cloud'
+
+    def make_deploy_variables(self, resources, cluster_name_on_cloud: str,
+                              region: str, zone: Optional[str]
+                              ) -> Dict[str, object]:
+        resources.assert_launchable()
+        auth = self.authentication_config()
+        return {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region,
+            'zone': None,  # Lambda has no zones
+            'instance_type': resources.instance_type,
+            'use_spot': False,  # no spot market
+            'ssh_user': 'ubuntu',
+            'ssh_private_key': auth.get('ssh_private_key'),
+            'num_nodes': None,  # filled by the provisioner
+        }
+
+    def authentication_config(self) -> Dict[str, object]:
+        from skypilot_tpu import authentication
+        return authentication.authentication_config()
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.adaptors import lambda_cloud as adaptor
+        if adaptor.get_api_key():
+            return True, None
+        return False, ('Lambda Cloud API key not found. Set '
+                       'LAMBDA_API_KEY or create '
+                       f'{adaptor.CREDENTIALS_PATH} with `api_key = ...`.')

@@ -1,0 +1,64 @@
+"""Nebius AI Cloud policy — H100/H200 GPU cloud with real stop/start.
+
+Reference analog: sky/clouds/nebius.py. Catalog instance types are
+`<platform>_<preset>` pairs (the API's native naming); region is the
+single API region the account points at.
+"""
+from typing import Dict, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.utils import registry
+
+
+@registry.CLOUD_REGISTRY.register(name='nebius')
+class Nebius(cloud.Cloud):
+    NAME = 'nebius'
+    CAPABILITIES = frozenset({
+        cloud.CloudCapability.MULTI_NODE,
+        cloud.CloudCapability.STOP,
+        cloud.CloudCapability.AUTOSTOP,
+        cloud.CloudCapability.STORAGE_MOUNT,
+        cloud.CloudCapability.CUSTOM_IMAGE,
+        cloud.CloudCapability.HOST_CONTROLLERS,
+    })
+    MAX_CLUSTER_NAME_LENGTH = 56
+
+    def provision_module(self) -> str:
+        return 'skypilot_tpu.provision.nebius'
+
+    def make_deploy_variables(self, resources, cluster_name_on_cloud: str,
+                              region: str, zone: Optional[str]
+                              ) -> Dict[str, object]:
+        resources.assert_launchable()
+        from skypilot_tpu import config as config_lib
+        auth = self.authentication_config()
+        variables: Dict[str, object] = {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region,
+            'zone': None,
+            'instance_type': resources.instance_type,
+            'use_spot': False,  # no spot market
+            'disk_size': resources.disk_size,
+            'project_id': config_lib.get_nested(
+                ('nebius', 'project_id')),
+            'subnet_id': config_lib.get_nested(
+                ('nebius', 'subnet_id'), default='') or '',
+            'ssh_user': auth.get('ssh_user'),
+            'ssh_private_key': auth.get('ssh_private_key'),
+            'num_nodes': None,  # filled by the provisioner
+        }
+        if resources.image_id:
+            variables['image_id'] = resources.image_id
+        return variables
+
+    def authentication_config(self) -> Dict[str, object]:
+        from skypilot_tpu import authentication
+        return authentication.authentication_config()
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.adaptors import nebius as adaptor
+        if adaptor.get_iam_token():
+            return True, None
+        return False, ('Nebius IAM token not found. Set '
+                       'NEBIUS_IAM_TOKEN or create '
+                       f'{adaptor.CREDENTIALS_PATH}.')

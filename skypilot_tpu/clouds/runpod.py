@@ -1,0 +1,71 @@
+"""RunPod cloud policy — container GPU cloud.
+
+Reference analog: sky/clouds/runpod.py. Pods stop (volume kept) and
+resume; "COMMUNITY" interruptible pods are the spot analog. The
+catalog models one synthetic instance type per (gpu, count):
+`<count>x_<GPU>` (e.g. `1x_A100-80GB`), which the provisioner splits
+back into gpuTypeId + gpuCount.
+"""
+from typing import Dict, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.utils import registry
+
+
+def split_instance_type(instance_type: str) -> Tuple[str, int]:
+    """'2x_A100-80GB' -> ('A100-80GB', 2)."""
+    count_s, _, gpu = instance_type.partition('x_')
+    try:
+        return gpu, int(count_s)
+    except ValueError:
+        return instance_type, 1
+
+
+@registry.CLOUD_REGISTRY.register(name='runpod')
+class RunPod(cloud.Cloud):
+    NAME = 'runpod'
+    CAPABILITIES = frozenset({
+        cloud.CloudCapability.MULTI_NODE,
+        cloud.CloudCapability.SPOT_INSTANCE,
+        cloud.CloudCapability.STOP,
+        cloud.CloudCapability.AUTOSTOP,
+        cloud.CloudCapability.CUSTOM_IMAGE,
+    })
+    MAX_CLUSTER_NAME_LENGTH = 56
+
+    def provision_module(self) -> str:
+        return 'skypilot_tpu.provision.runpod'
+
+    def make_deploy_variables(self, resources, cluster_name_on_cloud: str,
+                              region: str, zone: Optional[str]
+                              ) -> Dict[str, object]:
+        resources.assert_launchable()
+        auth = self.authentication_config()
+        gpu_type, gpu_count = split_instance_type(resources.instance_type)
+        variables: Dict[str, object] = {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region,
+            'zone': None,
+            'instance_type': resources.instance_type,
+            'gpu_type': gpu_type,
+            'gpu_count': gpu_count,
+            'use_spot': resources.use_spot,
+            'disk_size': resources.disk_size,
+            'ssh_user': 'root',
+            'ssh_private_key': auth.get('ssh_private_key'),
+            'num_nodes': None,  # filled by the provisioner
+        }
+        if resources.image_id:
+            variables['image_id'] = resources.image_id
+        return variables
+
+    def authentication_config(self) -> Dict[str, object]:
+        from skypilot_tpu import authentication
+        return authentication.authentication_config()
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.adaptors import runpod as adaptor
+        if adaptor.get_api_key():
+            return True, None
+        return False, ('RunPod API key not found. Set RUNPOD_API_KEY '
+                       f'or create {adaptor.CREDENTIALS_PATH}.')

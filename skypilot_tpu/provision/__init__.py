@@ -9,10 +9,16 @@ from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.provision import common  # noqa: F401 (re-export)
 
-
 def _impl(provider_name: str):
-    return importlib.import_module(
-        f'skypilot_tpu.provision.{provider_name.lower()}')
+    name = provider_name.lower()
+    try:
+        return importlib.import_module(f'skypilot_tpu.provision.{name}')
+    except ModuleNotFoundError:
+        # Cloud names that aren't importable module names ('lambda' is
+        # a keyword): the cloud policy class owns the real module path.
+        from skypilot_tpu import clouds as clouds_lib
+        return importlib.import_module(
+            clouds_lib.get_cloud(name).provision_module())
 
 
 def run_instances(provider_name: str, region: str,

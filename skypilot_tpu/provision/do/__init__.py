@@ -1,0 +1,215 @@
+"""DigitalOcean provisioner — droplets behind the uniform interface.
+
+Reference analog: sky/provision/do/instance.py. Droplets are tagged
+`skytpu:<cluster>` (tags are DO's native grouping primitive) and named
+`<cluster>-<i>`. The cluster SSH key is idempotently registered under
+a fingerprint-derived name; power_off/power_on give real stop/resume
+(disk persists, billing drops to disk-only).
+"""
+import hashlib
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.adaptors import do as do_adaptor
+from skypilot_tpu.provision import common
+from skypilot_tpu.utils import command_runner
+
+logger = logging.getLogger(__name__)
+
+_DEFAULT_IMAGE = 'ubuntu-22-04-x64'
+
+
+def _tag(cluster_name_on_cloud: str) -> str:
+    # DO tags allow letters/digits/:/-/_ .
+    return f'skytpu:{cluster_name_on_cloud}'
+
+
+def _droplet_state(droplet: Dict[str, Any]) -> str:
+    status = droplet.get('status', 'new')
+    return {'new': 'pending', 'active': 'running', 'off': 'stopped',
+            'archive': 'terminated'}.get(status, 'pending')
+
+
+def _cluster_droplets(client, cluster_name_on_cloud: str
+                      ) -> List[Dict[str, Any]]:
+    resp = client.request(
+        'GET', '/v2/droplets',
+        params={'tag_name': _tag(cluster_name_on_cloud),
+                'per_page': '200'})
+    return resp.get('droplets', [])
+
+
+def _ensure_ssh_key(client, public_key: str) -> int:
+    """Idempotently register the cluster public key; returns its id."""
+    digest = hashlib.sha256(public_key.encode()).hexdigest()[:12]
+    key_name = f'skytpu-{digest}'
+    resp = client.request('GET', '/v2/account/keys',
+                          params={'per_page': '200'})
+    for key in resp.get('ssh_keys', []):
+        if key.get('name') == key_name:
+            return key['id']
+    created = client.request('POST', '/v2/account/keys',
+                             json_body={'name': key_name,
+                                        'public_key': public_key})
+    return created['ssh_key']['id']
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    client = do_adaptor.client()
+    nc = {**config.provider_config, **config.node_config}
+    existing = {d['name']: d
+                for d in _cluster_droplets(client, cluster_name_on_cloud)}
+    created: List[str] = []
+    resumed: List[str] = []
+    try:
+        key_id = _ensure_ssh_key(
+            client, config.authentication_config.get(
+                'ssh_public_key_content', ''))
+        for i in range(config.count):
+            name = f'{cluster_name_on_cloud}-{i}'
+            droplet = existing.get(name)
+            state = _droplet_state(droplet) if droplet else None
+            if state in ('running', 'pending'):
+                continue
+            if state == 'stopped':
+                if not config.resume_stopped_nodes:
+                    raise exceptions.ProvisionError(
+                        f'Droplet {name} is stopped; pass '
+                        'resume_stopped_nodes to restart it.')
+                client.request(
+                    'POST', f'/v2/droplets/{droplet["id"]}/actions',
+                    json_body={'type': 'power_on'})
+                resumed.append(name)
+                continue
+            body = {
+                'name': name,
+                'region': region,
+                'size': nc['instance_type'],
+                'image': nc.get('image_id') or _DEFAULT_IMAGE,
+                'ssh_keys': [key_id],
+                'tags': [_tag(cluster_name_on_cloud)],
+                'monitoring': False,
+            }
+            client.request('POST', '/v2/droplets', json_body=body)
+            created.append(name)
+        _wait_active(client, cluster_name_on_cloud, config.count,
+                     timeout=float(config.provider_config.get(
+                         'provision_timeout', 900)))
+    except do_adaptor.RestApiError as e:
+        raise do_adaptor.classify_api_error(e) from e
+    return common.ProvisionRecord(
+        provider_name='do', region=region, zone=None,
+        cluster_name_on_cloud=cluster_name_on_cloud,
+        head_instance_id=f'{cluster_name_on_cloud}-0',
+        created_instance_ids=created, resumed_instance_ids=resumed)
+
+
+def _wait_active(client, cluster_name_on_cloud: str, count: int,
+                 timeout: float = 900.0) -> None:
+    deadline = time.time() + timeout
+    while True:
+        droplets = _cluster_droplets(client, cluster_name_on_cloud)
+        if len(droplets) >= count and all(
+                _droplet_state(d) == 'running' for d in droplets):
+            return
+        if time.time() > deadline:
+            raise exceptions.ProvisionError(
+                'Timed out waiting for active: '
+                f'{ {d["name"]: _droplet_state(d) for d in droplets} }')
+        time.sleep(5.0)
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: Optional[str] = None) -> None:
+    del region, cluster_name_on_cloud, state  # run_instances waits
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Dict[str, Any]) -> None:
+    client = do_adaptor.client()
+    for droplet in _cluster_droplets(client, cluster_name_on_cloud):
+        if _droplet_state(droplet) == 'running':
+            client.request('POST',
+                           f'/v2/droplets/{droplet["id"]}/actions',
+                           json_body={'type': 'power_off'})
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Dict[str, Any]) -> None:
+    client = do_adaptor.client()
+    try:
+        client.request(
+            'DELETE', '/v2/droplets',
+            params={'tag_name': _tag(cluster_name_on_cloud)})
+    except do_adaptor.RestApiError as e:
+        if e.status != 404:
+            raise
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Dict[str, Any]
+                    ) -> Dict[str, Optional[str]]:
+    client = do_adaptor.client()
+    out: Dict[str, Optional[str]] = {}
+    for droplet in _cluster_droplets(client, cluster_name_on_cloud):
+        state = _droplet_state(droplet)
+        if state == 'terminated':
+            continue
+        out[droplet['name']] = state
+    return out
+
+
+def _ips(droplet: Dict[str, Any]) -> Dict[str, Optional[str]]:
+    internal, external = '', None
+    for net in droplet.get('networks', {}).get('v4', []):
+        if net.get('type') == 'private':
+            internal = net.get('ip_address', '')
+        elif net.get('type') == 'public':
+            external = net.get('ip_address')
+    return {'internal': internal or (external or ''),
+            'external': external}
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Dict[str, Any]) -> common.ClusterInfo:
+    del region
+    client = do_adaptor.client()
+    instances: Dict[str, common.InstanceInfo] = {}
+    head_name = f'{cluster_name_on_cloud}-0'
+    head_id: Optional[str] = None
+    for droplet in _cluster_droplets(client, cluster_name_on_cloud):
+        if _droplet_state(droplet) != 'running':
+            continue
+        name = droplet['name']
+        ips = _ips(droplet)
+        instances[name] = common.InstanceInfo(
+            instance_id=name,
+            hosts=[common.HostInfo(host_id=str(droplet['id']),
+                                   internal_ip=ips['internal'],
+                                   external_ip=ips['external'])],
+            status='running', tags={})
+        if name == head_name:
+            head_id = name
+    if head_id is None and instances:
+        head_id = sorted(instances)[0]
+    return common.ClusterInfo(
+        instances=instances, head_instance_id=head_id,
+        provider_name='do', provider_config=provider_config,
+        ssh_user='root',
+        ssh_private_key=provider_config.get('ssh_private_key'))
+
+
+def get_command_runners(cluster_info: common.ClusterInfo
+                        ) -> List[command_runner.CommandRunner]:
+    runners: List[command_runner.CommandRunner] = []
+    for inst in cluster_info.ordered_instances():
+        for host in inst.hosts:
+            runners.append(command_runner.SSHCommandRunner(
+                host.get_ip(use_internal=False),
+                user=cluster_info.ssh_user or 'root',
+                private_key=cluster_info.ssh_private_key,
+                port=host.ssh_port))
+    return runners

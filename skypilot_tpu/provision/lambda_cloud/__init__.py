@@ -1,0 +1,200 @@
+"""Lambda Cloud provisioner — GPU neocloud behind the uniform interface.
+
+Reference analog: sky/provision/lambda_cloud/instance.py. The API is
+launch/list/terminate only (no stop, no custom images, no port
+controls): instances are identified by the `name` we assign
+(`<cluster>-<i>`), and all firewalling is account-global. Autostop
+therefore forces `--down`, the same gate the backend already applies
+to TPU pods.
+
+SSH keys: Lambda injects a *named* account-level key at launch; we
+idempotently register the cluster keypair under a deterministic name
+derived from the public key fingerprint.
+"""
+import hashlib
+import logging
+import re
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.adaptors import lambda_cloud as lambda_adaptor
+from skypilot_tpu.provision import common
+from skypilot_tpu.utils import command_runner
+
+logger = logging.getLogger(__name__)
+
+_STATE_MAP = {
+    'booting': 'pending',
+    'active': 'running',
+    'unhealthy': 'running',
+    'terminating': 'stopping',
+    'terminated': 'terminated',
+}
+
+
+def _cluster_instances(client, cluster_name_on_cloud: str
+                       ) -> List[Dict[str, Any]]:
+    resp = client.request('GET', '/instances')
+    # Exact `<cluster>-<index>` match: a bare prefix would also catch
+    # cluster 'train-2' when tearing down cluster 'train'.
+    pattern = re.compile(re.escape(cluster_name_on_cloud) + r'-\d+$')
+    return [inst for inst in resp.get('data', [])
+            if pattern.fullmatch(inst.get('name') or '')]
+
+
+def _state(inst: Dict[str, Any]) -> str:
+    return _STATE_MAP.get(inst.get('status', ''), 'pending')
+
+
+def _ensure_ssh_key(client, public_key: str) -> str:
+    """Idempotently register the cluster public key; returns its name."""
+    digest = hashlib.sha256(public_key.encode()).hexdigest()[:12]
+    key_name = f'skytpu-{digest}'
+    existing = client.request('GET', '/ssh-keys')
+    for key in existing.get('data', []):
+        if key.get('name') == key_name:
+            return key_name
+    client.request('POST', '/ssh-keys',
+                   json_body={'name': key_name,
+                              'public_key': public_key})
+    return key_name
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    client = lambda_adaptor.client()
+    nc = {**config.provider_config, **config.node_config}
+    existing = _cluster_instances(client, cluster_name_on_cloud)
+    alive = {inst['name']: inst for inst in existing
+             if _state(inst) in ('running', 'pending')}
+
+    created: List[str] = []
+    try:
+        key_name = _ensure_ssh_key(
+            client, config.authentication_config.get(
+                'ssh_public_key_content', ''))
+        for i in range(config.count):
+            name = f'{cluster_name_on_cloud}-{i}'
+            if name in alive:
+                continue
+            resp = client.request(
+                'POST', '/instance-operations/launch',
+                json_body={
+                    'region_name': region,
+                    'instance_type_name': nc['instance_type'],
+                    'ssh_key_names': [key_name],
+                    'quantity': 1,
+                    'name': name,
+                })
+            ids = resp.get('data', {}).get('instance_ids', [])
+            if not ids:
+                raise exceptions.ProvisionError(
+                    f'Lambda launch returned no instance id for {name}')
+            created.append(name)
+        _wait_active(client, cluster_name_on_cloud, config.count,
+                     timeout=float(config.provider_config.get(
+                         'provision_timeout', 900)))
+    except lambda_adaptor.RestApiError as e:
+        raise lambda_adaptor.classify_api_error(e) from e
+    return common.ProvisionRecord(
+        provider_name='lambda', region=region, zone=None,
+        cluster_name_on_cloud=cluster_name_on_cloud,
+        head_instance_id=f'{cluster_name_on_cloud}-0',
+        created_instance_ids=created, resumed_instance_ids=[])
+
+
+def _wait_active(client, cluster_name_on_cloud: str, count: int,
+                 timeout: float = 900.0) -> None:
+    deadline = time.time() + timeout
+    while True:
+        instances = _cluster_instances(client, cluster_name_on_cloud)
+        # Old terminated entries linger in /instances after a down;
+        # they must not block a relaunch's convergence check.
+        live = [i for i in instances
+                if _state(i) not in ('terminated', 'stopping')]
+        if len(live) >= count and all(_state(i) == 'running'
+                                      for i in live):
+            return
+        if time.time() > deadline:
+            raise exceptions.ProvisionError(
+                f'Timed out waiting for active: '
+                f'{ {i["name"]: _state(i) for i in instances} }')
+        time.sleep(5.0)
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: Optional[str] = None) -> None:
+    del region, cluster_name_on_cloud, state  # run_instances waits
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Dict[str, Any]) -> None:
+    raise exceptions.NotSupportedError(
+        'Lambda Cloud cannot stop instances; use terminate (down).')
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Dict[str, Any]) -> None:
+    client = lambda_adaptor.client()
+    ids = [inst['id']
+           for inst in _cluster_instances(client, cluster_name_on_cloud)
+           if _state(inst) not in ('terminated', 'stopping')]
+    if not ids:
+        return
+    client.request('POST', '/instance-operations/terminate',
+                   json_body={'instance_ids': ids})
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Dict[str, Any]
+                    ) -> Dict[str, Optional[str]]:
+    client = lambda_adaptor.client()
+    out: Dict[str, Optional[str]] = {}
+    for inst in _cluster_instances(client, cluster_name_on_cloud):
+        state = _state(inst)
+        if state == 'terminated':
+            continue
+        out[inst['name']] = state
+    return out
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Dict[str, Any]) -> common.ClusterInfo:
+    del region
+    client = lambda_adaptor.client()
+    instances: Dict[str, common.InstanceInfo] = {}
+    head_id: Optional[str] = None
+    head_name = f'{cluster_name_on_cloud}-0'
+    for inst in _cluster_instances(client, cluster_name_on_cloud):
+        if _state(inst) != 'running':
+            continue
+        name = inst['name']
+        instances[name] = common.InstanceInfo(
+            instance_id=name,
+            hosts=[common.HostInfo(host_id=inst['id'],
+                                   internal_ip=inst.get('private_ip', ''),
+                                   external_ip=inst.get('ip'))],
+            status='running', tags={})
+        if name == head_name:
+            head_id = name
+    if head_id is None and instances:
+        head_id = sorted(instances)[0]
+    return common.ClusterInfo(
+        instances=instances, head_instance_id=head_id,
+        provider_name='lambda', provider_config=provider_config,
+        ssh_user='ubuntu',
+        ssh_private_key=provider_config.get('ssh_private_key'))
+
+
+def get_command_runners(cluster_info: common.ClusterInfo
+                        ) -> List[command_runner.CommandRunner]:
+    runners: List[command_runner.CommandRunner] = []
+    for inst in cluster_info.ordered_instances():
+        for host in inst.hosts:
+            runners.append(command_runner.SSHCommandRunner(
+                host.get_ip(use_internal=False),
+                user=cluster_info.ssh_user or 'ubuntu',
+                private_key=cluster_info.ssh_private_key,
+                port=host.ssh_port))
+    return runners

@@ -1,0 +1,255 @@
+"""Nebius AI Cloud provisioner — H100/H200 platforms behind the
+uniform interface.
+
+Reference analog: sky/provision/nebius/instance.py (692 LoC over the
+SDK). Instances live under a parent project; names are deterministic
+(`<cluster>-<i>`) and the instance spec carries the platform + preset
+split of the catalog instance type (`<platform>_<preset>`, e.g.
+`gpu-h100-sxm_8gpu-128vcpu-1600gb`). Stop/start are first-class, so
+autostop can stop (unlike the terminate-only neoclouds).
+"""
+import logging
+import re
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.adaptors import nebius as nebius_adaptor
+from skypilot_tpu.provision import common
+from skypilot_tpu.utils import command_runner
+
+logger = logging.getLogger(__name__)
+
+_BASE = '/compute/v1/instances'
+
+_STATE_MAP = {
+    'CREATING': 'pending',
+    'STARTING': 'pending',
+    'RUNNING': 'running',
+    'STOPPING': 'stopping',
+    'STOPPED': 'stopped',
+    'DELETING': 'stopping',
+    'ERROR': 'terminated',
+}
+
+
+def _project(pc: Dict[str, Any]) -> str:
+    project = pc.get('project_id') or nebius_adaptor.default_project_id()
+    if not project:
+        raise exceptions.ProvisionError(
+            'Nebius project id missing: set nebius.project_id in config '
+            'or NEBIUS_PROJECT_ID.')
+    pc['project_id'] = project
+    return project
+
+
+def _state(inst: Dict[str, Any]) -> str:
+    return _STATE_MAP.get(
+        inst.get('status', {}).get('state', ''), 'pending')
+
+
+def _cluster_instances(client, project: str, cluster_name_on_cloud: str
+                       ) -> List[Dict[str, Any]]:
+    # Exact `<cluster>-<index>` match (a bare prefix would also catch
+    # cluster 'train-2' when tearing down 'train'), following
+    # nextPageToken so big projects can't truncate a cluster away.
+    pattern = re.compile(re.escape(cluster_name_on_cloud) + r'-\d+$')
+    out: List[Dict[str, Any]] = []
+    page_token = ''
+    while True:
+        params = {'parentId': project, 'pageSize': '500'}
+        if page_token:
+            params['pageToken'] = page_token
+        resp = client.request('GET', _BASE, params=params)
+        out.extend(
+            inst for inst in resp.get('items', [])
+            if pattern.fullmatch(inst.get('metadata', {}).get('name', '')))
+        page_token = resp.get('nextPageToken', '')
+        if not page_token:
+            return out
+
+
+def split_instance_type(instance_type: str) -> Dict[str, str]:
+    """'gpu-h100-sxm_8gpu-128vcpu-1600gb' -> platform + preset."""
+    platform, _, preset = instance_type.partition('_')
+    return {'platform': platform, 'preset': preset}
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    pc = config.provider_config
+    project = _project(pc)
+    client = nebius_adaptor.client()
+    nc = {**pc, **config.node_config}
+    spec_bits = split_instance_type(nc.get('instance_type', ''))
+    existing = {i['metadata']['name']: i for i in _cluster_instances(
+        client, project, cluster_name_on_cloud)}
+    created: List[str] = []
+    resumed: List[str] = []
+    try:
+        for i in range(config.count):
+            name = f'{cluster_name_on_cloud}-{i}'
+            inst = existing.get(name)
+            state = _state(inst) if inst else None
+            if state in ('running', 'pending'):
+                continue
+            if state == 'stopped':
+                if not config.resume_stopped_nodes:
+                    raise exceptions.ProvisionError(
+                        f'Instance {name} is stopped; pass '
+                        'resume_stopped_nodes to restart it.')
+                client.request(
+                    'POST', f'{_BASE}/{inst["metadata"]["id"]}:start')
+                resumed.append(name)
+                continue
+            ssh_user = config.authentication_config.get(
+                'ssh_user', 'skytpu')
+            public_key = config.authentication_config.get(
+                'ssh_public_key_content', '')
+            body = {
+                'metadata': {'parentId': project, 'name': name},
+                'spec': {
+                    'resources': {
+                        'platform': spec_bits['platform'],
+                        'preset': spec_bits['preset'],
+                    },
+                    'bootDisk': {
+                        'attachMode': 'READ_WRITE',
+                        'sizeGibibytes': int(nc.get('disk_size', 256)),
+                        'sourceImageFamily':
+                            nc.get('image_id') or 'ubuntu22.04-driverless',
+                    },
+                    'networkInterfaces': [{
+                        'name': 'eth0',
+                        'subnetId': nc.get('subnet_id', ''),
+                        'ipAddress': {},
+                        'publicIpAddress': {},
+                    }],
+                    'cloudInitUserData': (
+                        '#cloud-config\n'
+                        f'users:\n'
+                        f'  - name: {ssh_user}\n'
+                        '    sudo: ALL=(ALL) NOPASSWD:ALL\n'
+                        '    shell: /bin/bash\n'
+                        '    ssh_authorized_keys:\n'
+                        f'      - {public_key}\n'),
+                },
+            }
+            client.request('POST', _BASE, json_body=body)
+            created.append(name)
+        _wait_running(client, project, cluster_name_on_cloud,
+                      config.count,
+                      timeout=float(pc.get('provision_timeout', 900)))
+    except nebius_adaptor.RestApiError as e:
+        raise nebius_adaptor.classify_api_error(e) from e
+    return common.ProvisionRecord(
+        provider_name='nebius', region=region, zone=None,
+        cluster_name_on_cloud=cluster_name_on_cloud,
+        head_instance_id=f'{cluster_name_on_cloud}-0',
+        created_instance_ids=created, resumed_instance_ids=resumed)
+
+
+def _wait_running(client, project: str, cluster_name_on_cloud: str,
+                  count: int, timeout: float = 900.0) -> None:
+    deadline = time.time() + timeout
+    while True:
+        instances = _cluster_instances(client, project,
+                                       cluster_name_on_cloud)
+        # DELETING/ERROR leftovers must not block a relaunch.
+        live = [i for i in instances
+                if _state(i) not in ('terminated', 'stopping')]
+        if len(live) >= count and all(
+                _state(i) == 'running' for i in live):
+            return
+        if time.time() > deadline:
+            raise exceptions.ProvisionError(
+                'Timed out waiting for running: '
+                f'{ {i["metadata"]["name"]: _state(i) for i in instances} }')
+        time.sleep(5.0)
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: Optional[str] = None) -> None:
+    del region, cluster_name_on_cloud, state  # run_instances waits
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Dict[str, Any]) -> None:
+    project = _project(provider_config)
+    client = nebius_adaptor.client()
+    for inst in _cluster_instances(client, project,
+                                   cluster_name_on_cloud):
+        if _state(inst) == 'running':
+            client.request('POST',
+                           f'{_BASE}/{inst["metadata"]["id"]}:stop')
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Dict[str, Any]) -> None:
+    project = _project(provider_config)
+    client = nebius_adaptor.client()
+    for inst in _cluster_instances(client, project,
+                                   cluster_name_on_cloud):
+        client.request('DELETE', f'{_BASE}/{inst["metadata"]["id"]}')
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Dict[str, Any]
+                    ) -> Dict[str, Optional[str]]:
+    project = _project(provider_config)
+    client = nebius_adaptor.client()
+    out: Dict[str, Optional[str]] = {}
+    for inst in _cluster_instances(client, project,
+                                   cluster_name_on_cloud):
+        state = _state(inst)
+        if state == 'terminated':
+            continue
+        out[inst['metadata']['name']] = state
+    return out
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Dict[str, Any]) -> common.ClusterInfo:
+    del region
+    project = _project(provider_config)
+    client = nebius_adaptor.client()
+    instances: Dict[str, common.InstanceInfo] = {}
+    head_name = f'{cluster_name_on_cloud}-0'
+    head_id: Optional[str] = None
+    for inst in _cluster_instances(client, project,
+                                   cluster_name_on_cloud):
+        if _state(inst) != 'running':
+            continue
+        name = inst['metadata']['name']
+        nic = (inst.get('status', {}).get('networkInterfaces')
+               or [{}])[0]
+        instances[name] = common.InstanceInfo(
+            instance_id=name,
+            hosts=[common.HostInfo(
+                host_id=inst['metadata']['id'],
+                internal_ip=nic.get('ipAddress', {}).get('address', ''),
+                external_ip=nic.get('publicIpAddress', {})
+                .get('address'))],
+            status='running', tags={})
+        if name == head_name:
+            head_id = name
+    if head_id is None and instances:
+        head_id = sorted(instances)[0]
+    return common.ClusterInfo(
+        instances=instances, head_instance_id=head_id,
+        provider_name='nebius', provider_config=provider_config,
+        ssh_user=provider_config.get('ssh_user', 'skytpu'),
+        ssh_private_key=provider_config.get('ssh_private_key'))
+
+
+def get_command_runners(cluster_info: common.ClusterInfo
+                        ) -> List[command_runner.CommandRunner]:
+    runners: List[command_runner.CommandRunner] = []
+    for inst in cluster_info.ordered_instances():
+        for host in inst.hosts:
+            runners.append(command_runner.SSHCommandRunner(
+                host.get_ip(use_internal=False),
+                user=cluster_info.ssh_user or 'skytpu',
+                private_key=cluster_info.ssh_private_key,
+                port=host.ssh_port))
+    return runners

@@ -1,0 +1,463 @@
+"""The four flat-REST VM clouds (Lambda, RunPod, Nebius, DO) against
+in-memory fake APIs.
+
+Mirrors the AWS/Azure fake-transport strategy: the REAL provisioners
+run end-to-end; only the adaptor client is swapped. One fake per cloud
+models just the REST shapes the provisioner touches.
+"""
+import itertools
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.adaptors import do as do_adaptor
+from skypilot_tpu.adaptors import lambda_cloud as lambda_adaptor
+from skypilot_tpu.adaptors import nebius as nebius_adaptor
+from skypilot_tpu.adaptors import runpod as runpod_adaptor
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision import do as do_provision
+from skypilot_tpu.provision import lambda_cloud as lambda_provision
+from skypilot_tpu.provision import nebius as nebius_provision
+from skypilot_tpu.provision import runpod as runpod_provision
+
+
+def _config(instance_type, count=1, use_spot=False, extra_pc=None,
+            **node):
+    return common.ProvisionConfig(
+        provider_config={'region': 'r1', **(extra_pc or {})},
+        authentication_config={'ssh_user': 'skytpu',
+                               'ssh_public_key_content': 'ssh-ed25519 K'},
+        node_config={'instance_type': instance_type,
+                     'use_spot': use_spot, **node},
+        count=count)
+
+
+# --------------------------------------------------------------- lambda
+
+class FakeLambda:
+    def __init__(self):
+        self.instances = {}   # id -> dict
+        self.ssh_keys = []
+        self.fail_launch_with = None
+        self._ids = itertools.count()
+
+    def request(self, method, path, params=None, json_body=None):
+        if path == '/ssh-keys' and method == 'GET':
+            return {'data': list(self.ssh_keys)}
+        if path == '/ssh-keys' and method == 'POST':
+            self.ssh_keys.append(dict(json_body))
+            return {'data': dict(json_body)}
+        if path == '/instances' and method == 'GET':
+            return {'data': list(self.instances.values())}
+        if path == '/instance-operations/launch':
+            if self.fail_launch_with is not None:
+                raise self.fail_launch_with
+            assert json_body['ssh_key_names'], 'launch needs a key'
+            iid = f'i-{next(self._ids)}'
+            self.instances[iid] = {
+                'id': iid, 'name': json_body['name'],
+                'status': 'active', 'ip': '129.0.0.5',
+                'private_ip': '10.0.0.5',
+                'region': {'name': json_body['region_name']}}
+            return {'data': {'instance_ids': [iid]}}
+        if path == '/instance-operations/terminate':
+            for iid in json_body['instance_ids']:
+                self.instances[iid]['status'] = 'terminated'
+            return {'data': {}}
+        raise AssertionError(f'unexpected {method} {path}')
+
+
+@pytest.fixture
+def fake_lambda():
+    api = FakeLambda()
+    lambda_adaptor.set_client_factory(lambda: api)
+    yield api
+    lambda_adaptor.set_client_factory(
+        lambda: (_ for _ in ()).throw(AssertionError('no client')))
+
+
+def test_lambda_lifecycle(fake_lambda):
+    record = lambda_provision.run_instances(
+        'us-east-1', 'lc1', _config('gpu_8x_h100_sxm5', count=2))
+    assert len(record.created_instance_ids) == 2
+    assert record.head_instance_id == 'lc1-0'
+    # ssh key registered exactly once (idempotent across nodes).
+    assert len(fake_lambda.ssh_keys) == 1
+    assert lambda_provision.query_instances('lc1', {}) == {
+        'lc1-0': 'running', 'lc1-1': 'running'}
+    info = lambda_provision.get_cluster_info('us-east-1', 'lc1', {})
+    assert info.num_instances == 2
+    head = info.get_head_instance()
+    assert head.hosts[0].external_ip == '129.0.0.5'
+    runners = lambda_provision.get_command_runners(info)
+    assert len(runners) == 2
+    # relaunch is a no-op while instances are alive
+    record2 = lambda_provision.run_instances(
+        'us-east-1', 'lc1', _config('gpu_8x_h100_sxm5', count=2))
+    assert record2.created_instance_ids == []
+    lambda_provision.terminate_instances('lc1', {})
+    assert lambda_provision.query_instances('lc1', {}) == {}
+
+
+def test_lambda_cluster_name_no_prefix_collision(fake_lambda):
+    """Tearing down 'train' must not touch cluster 'train-2'."""
+    lambda_provision.run_instances('us-east-1', 'train',
+                                   _config('gpu_1x_a10'))
+    lambda_provision.run_instances('us-east-1', 'train-2',
+                                   _config('gpu_1x_a10'))
+    lambda_provision.terminate_instances('train', {})
+    assert lambda_provision.query_instances('train', {}) == {}
+    assert lambda_provision.query_instances('train-2', {}) == {
+        'train-2-0': 'running'}
+
+
+def test_lambda_relaunch_ignores_terminated_leftovers(fake_lambda):
+    """Old terminated entries linger in /instances after a down; a
+    relaunch of the same cluster name must still converge."""
+    lambda_provision.run_instances('us-east-1', 'lc1',
+                                   _config('gpu_1x_a10'))
+    lambda_provision.terminate_instances('lc1', {})
+    record = lambda_provision.run_instances('us-east-1', 'lc1',
+                                            _config('gpu_1x_a10'))
+    assert record.created_instance_ids == ['lc1-0']
+    assert lambda_provision.query_instances('lc1', {}) == {
+        'lc1-0': 'running'}
+
+
+def test_lambda_no_stop_and_capacity_taxonomy(fake_lambda):
+    with pytest.raises(exceptions.NotSupportedError):
+        lambda_provision.stop_instances('lc1', {})
+    fake_lambda.fail_launch_with = lambda_adaptor.RestApiError(
+        'sold out', code='instance-operations/launch/'
+        'insufficient-capacity', status=400)
+    with pytest.raises(exceptions.CapacityError):
+        lambda_provision.run_instances(
+            'us-east-1', 'lc2', _config('gpu_1x_h100_pcie'))
+
+
+# --------------------------------------------------------------- runpod
+
+class FakeRunPod:
+    def __init__(self):
+        self.pods = {}
+        self.fail_create_with = None
+        self._ids = itertools.count()
+
+    def request(self, method, path, params=None, json_body=None):
+        if path == '/pods' and method == 'GET':
+            return {'pods': list(self.pods.values())}
+        if path == '/pods' and method == 'POST':
+            if self.fail_create_with is not None:
+                raise self.fail_create_with
+            pid = f'pod-{next(self._ids)}'
+            self.pods[pid] = {
+                'id': pid, 'name': json_body['name'],
+                'desiredStatus': 'RUNNING',
+                'internalIp': '10.1.0.4',
+                'portMappings': [{'privatePort': 22,
+                                  'publicPort': 30022,
+                                  'ip': '194.0.0.7'}],
+                '_spec': json_body}
+            return self.pods[pid]
+        if method == 'POST' and path.endswith('/stop'):
+            self.pods[path.split('/')[2]]['desiredStatus'] = 'EXITED'
+            return {}
+        if method == 'POST' and path.endswith('/start'):
+            self.pods[path.split('/')[2]]['desiredStatus'] = 'RUNNING'
+            return {}
+        if method == 'DELETE':
+            del self.pods[path.split('/')[2]]
+            return {}
+        raise AssertionError(f'unexpected {method} {path}')
+
+
+@pytest.fixture
+def fake_runpod():
+    api = FakeRunPod()
+    runpod_adaptor.set_client_factory(lambda: api)
+    yield api
+    runpod_adaptor.set_client_factory(
+        lambda: (_ for _ in ()).throw(AssertionError('no client')))
+
+
+def test_runpod_lifecycle_and_ssh_port(fake_runpod):
+    record = runpod_provision.run_instances(
+        'US-GA-1', 'rp1',
+        _config('8x_H100-SXM', gpu_type='H100', gpu_count=8))
+    assert record.created_instance_ids == ['rp1-0']
+    pod = next(iter(fake_runpod.pods.values()))
+    assert pod['_spec']['gpuCount'] == 8
+    assert pod['_spec']['env']['PUBLIC_KEY'] == 'ssh-ed25519 K'
+    info = runpod_provision.get_cluster_info('US-GA-1', 'rp1', {})
+    host = info.get_head_instance().hosts[0]
+    assert host.external_ip == '194.0.0.7'
+    assert host.ssh_port == 30022  # SSH rides the public port mapping
+    runners = runpod_provision.get_command_runners(info)
+    assert runners[0].port == 30022
+
+
+def test_runpod_stop_resume_spot_and_capacity(fake_runpod):
+    runpod_provision.run_instances(
+        'US-GA-1', 'rp1',
+        _config('1x_A100-80GB', use_spot=True, gpu_type='A100-80GB',
+                gpu_count=1))
+    pod = next(iter(fake_runpod.pods.values()))
+    assert pod['_spec']['cloudType'] == 'COMMUNITY'
+    assert pod['_spec']['interruptible'] is True
+    runpod_provision.stop_instances('rp1', {})
+    assert runpod_provision.query_instances('rp1', {}) == {
+        'rp1-0': 'stopped'}
+    record = runpod_provision.run_instances(
+        'US-GA-1', 'rp1',
+        _config('1x_A100-80GB', gpu_type='A100-80GB', gpu_count=1))
+    assert record.resumed_instance_ids == ['rp1-0']
+    fake_runpod.fail_create_with = runpod_adaptor.RestApiError(
+        'There are no instances available', status=500)
+    with pytest.raises(exceptions.CapacityError):
+        runpod_provision.run_instances(
+            'US-GA-1', 'rp2',
+            _config('1x_H100-SXM', gpu_type='H100', gpu_count=1))
+
+
+def test_runpod_instance_type_split():
+    from skypilot_tpu.clouds import runpod as runpod_cloud
+    assert runpod_cloud.split_instance_type('8x_H100-SXM') == ('H100-SXM',
+                                                               8)
+    assert runpod_cloud.split_instance_type('1x_RTX4090') == ('RTX4090', 1)
+
+
+# --------------------------------------------------------------- nebius
+
+class FakeNebius:
+    page_size = 1000  # tests shrink this to exercise pagination
+
+    def __init__(self):
+        self.instances = {}
+        self._ids = itertools.count()
+
+    def request(self, method, path, params=None, json_body=None):
+        if path == '/compute/v1/instances' and method == 'GET':
+            assert params['parentId'] == 'proj-1'
+            items = sorted(self.instances.values(),
+                           key=lambda i: i['metadata']['id'])
+            start = int(params.get('pageToken') or 0)
+            page = items[start:start + self.page_size]
+            resp = {'items': page}
+            if start + self.page_size < len(items):
+                resp['nextPageToken'] = str(start + self.page_size)
+            return resp
+        if path == '/compute/v1/instances' and method == 'POST':
+            iid = f'computeinstance-{next(self._ids)}'
+            self.instances[iid] = {
+                'metadata': {'id': iid,
+                             'parentId': json_body['metadata']['parentId'],
+                             'name': json_body['metadata']['name']},
+                'spec': json_body['spec'],
+                'status': {'state': 'RUNNING', 'networkInterfaces': [{
+                    'ipAddress': {'address': '192.168.0.8'},
+                    'publicIpAddress': {'address': '84.0.0.3'}}]},
+            }
+            return self.instances[iid]
+        if method == 'POST' and path.endswith(':stop'):
+            iid = path.rsplit('/', 1)[-1].split(':')[0]
+            self.instances[iid]['status']['state'] = 'STOPPED'
+            return {}
+        if method == 'POST' and path.endswith(':start'):
+            iid = path.rsplit('/', 1)[-1].split(':')[0]
+            self.instances[iid]['status']['state'] = 'RUNNING'
+            return {}
+        if method == 'DELETE':
+            del self.instances[path.rsplit('/', 1)[-1]]
+            return {}
+        raise AssertionError(f'unexpected {method} {path}')
+
+
+@pytest.fixture
+def fake_nebius():
+    api = FakeNebius()
+    nebius_adaptor.set_client_factory(lambda: api)
+    yield api
+    nebius_adaptor.set_client_factory(
+        lambda: (_ for _ in ()).throw(AssertionError('no client')))
+
+
+NEBIUS_PC = {'project_id': 'proj-1'}
+
+
+def test_nebius_lifecycle_platform_preset(fake_nebius):
+    record = nebius_provision.run_instances(
+        'eu-north1', 'nb1',
+        _config('gpu-h100-sxm_8gpu-128vcpu-1600gb',
+                extra_pc=NEBIUS_PC))
+    assert record.created_instance_ids == ['nb1-0']
+    inst = next(iter(fake_nebius.instances.values()))
+    assert inst['spec']['resources']['platform'] == 'gpu-h100-sxm'
+    assert inst['spec']['resources']['preset'] == '8gpu-128vcpu-1600gb'
+    assert 'ssh-ed25519 K' in inst['spec']['cloudInitUserData']
+    info = nebius_provision.get_cluster_info('eu-north1', 'nb1',
+                                             dict(NEBIUS_PC))
+    host = info.get_head_instance().hosts[0]
+    assert host.internal_ip == '192.168.0.8'
+    assert host.external_ip == '84.0.0.3'
+    # stop → resume cycle
+    nebius_provision.stop_instances('nb1', dict(NEBIUS_PC))
+    assert nebius_provision.query_instances('nb1', dict(NEBIUS_PC)) == {
+        'nb1-0': 'stopped'}
+    record = nebius_provision.run_instances(
+        'eu-north1', 'nb1',
+        _config('gpu-h100-sxm_8gpu-128vcpu-1600gb',
+                extra_pc=NEBIUS_PC))
+    assert record.resumed_instance_ids == ['nb1-0']
+    nebius_provision.terminate_instances('nb1', dict(NEBIUS_PC))
+    assert nebius_provision.query_instances('nb1', dict(NEBIUS_PC)) == {}
+
+
+def test_nebius_listing_follows_pagination(fake_nebius):
+    """A big project must not truncate a cluster out of query results
+    (terminate leaking billed GPUs is the failure mode)."""
+    fake_nebius.page_size = 2
+    nebius_provision.run_instances(
+        'eu-north1', 'nb1',
+        _config('cpu-d3_8vcpu-32gb', count=5, extra_pc=NEBIUS_PC))
+    assert len(nebius_provision.query_instances(
+        'nb1', dict(NEBIUS_PC))) == 5
+    nebius_provision.terminate_instances('nb1', dict(NEBIUS_PC))
+    assert fake_nebius.instances == {}
+
+
+def test_nebius_requires_project_id(fake_nebius, monkeypatch):
+    monkeypatch.delenv('NEBIUS_PROJECT_ID', raising=False)
+    with pytest.raises(exceptions.ProvisionError, match='project id'):
+        nebius_provision.run_instances(
+            'eu-north1', 'nb1', _config('cpu-d3_8vcpu-32gb'))
+
+
+# ------------------------------------------------------------------- do
+
+class FakeDO:
+    def __init__(self):
+        self.droplets = {}
+        self.keys = []
+        self.fail_create_with = None
+        self._ids = itertools.count(100)
+
+    def request(self, method, path, params=None, json_body=None):
+        if path == '/v2/account/keys' and method == 'GET':
+            return {'ssh_keys': list(self.keys)}
+        if path == '/v2/account/keys' and method == 'POST':
+            key = dict(json_body, id=len(self.keys) + 1)
+            self.keys.append(key)
+            return {'ssh_key': key}
+        if path == '/v2/droplets' and method == 'GET':
+            tag = params['tag_name']
+            return {'droplets': [d for d in self.droplets.values()
+                                 if tag in d['tags']]}
+        if path == '/v2/droplets' and method == 'POST':
+            if self.fail_create_with is not None:
+                raise self.fail_create_with
+            did = next(self._ids)
+            self.droplets[did] = {
+                'id': did, 'name': json_body['name'], 'status': 'active',
+                'tags': list(json_body['tags']),
+                'networks': {'v4': [
+                    {'type': 'private', 'ip_address': '10.2.0.3'},
+                    {'type': 'public', 'ip_address': '164.0.0.2'}]},
+                '_spec': json_body}
+            return {'droplet': self.droplets[did]}
+        if path == '/v2/droplets' and method == 'DELETE':
+            tag = params['tag_name']
+            for did in [d for d, v in self.droplets.items()
+                        if tag in v['tags']]:
+                del self.droplets[did]
+            return {}
+        if method == 'POST' and path.endswith('/actions'):
+            did = int(path.split('/')[3])
+            self.droplets[did]['status'] = (
+                'off' if json_body['type'] == 'power_off' else 'active')
+            return {}
+        raise AssertionError(f'unexpected {method} {path}')
+
+
+@pytest.fixture
+def fake_do():
+    api = FakeDO()
+    do_adaptor.set_client_factory(lambda: api)
+    yield api
+    do_adaptor.set_client_factory(
+        lambda: (_ for _ in ()).throw(AssertionError('no client')))
+
+
+def test_do_lifecycle_tags_and_keys(fake_do):
+    record = do_provision.run_instances('nyc3', 'do1',
+                                        _config('s-4vcpu-8gb', count=2))
+    assert len(record.created_instance_ids) == 2
+    droplet = next(iter(fake_do.droplets.values()))
+    assert 'skytpu:do1' in droplet['tags']
+    assert droplet['_spec']['ssh_keys'] == [1]
+    assert len(fake_do.keys) == 1  # idempotent registration
+    info = do_provision.get_cluster_info('nyc3', 'do1', {})
+    assert info.num_instances == 2
+    assert info.get_head_instance().hosts[0].external_ip == '164.0.0.2'
+    # stop → resume
+    do_provision.stop_instances('do1', {})
+    assert set(do_provision.query_instances('do1', {}).values()) == {
+        'stopped'}
+    record = do_provision.run_instances('nyc3', 'do1',
+                                        _config('s-4vcpu-8gb', count=2))
+    assert sorted(record.resumed_instance_ids) == ['do1-0', 'do1-1']
+    # terminate by tag removes everything, idempotently
+    do_provision.terminate_instances('do1', {})
+    assert do_provision.query_instances('do1', {}) == {}
+    do_provision.terminate_instances('do1', {})
+
+
+def test_do_capacity_taxonomy(fake_do):
+    fake_do.fail_create_with = do_adaptor.RestApiError(
+        'droplet size unavailable in region', status=422)
+    with pytest.raises(exceptions.CapacityError):
+        do_provision.run_instances('nyc3', 'do2', _config('c-16'))
+
+
+# ------------------------------------------------- optimizer integration
+
+def test_optimizer_across_neoclouds(enable_clouds):
+    """H100:8 price race across the four new catalogs: RunPod secure
+    ($21.52) beats Lambda ($23.92), Nebius ($23.60), and DO ($23.92);
+    with spot, RunPod community ($10.76) wins outright. CPU-only
+    requests land on DO (cheapest) — the controller-hosting path."""
+    from skypilot_tpu import Dag, Resources, Task
+    from skypilot_tpu.optimizer import Optimizer
+    enable_clouds('lambda', 'runpod', 'nebius', 'do')
+
+    with Dag() as dag:
+        t = Task('t', run='true')
+        t.set_resources(Resources(accelerators='H100:8'))
+        dag.add(t)
+    Optimizer.optimize(dag, quiet=True)
+    assert t.best_resources.cloud == 'runpod'
+    assert t.best_resources.instance_type == '8x_H100-SXM'
+
+    with Dag() as dag:
+        t2 = Task('t2', run='true')
+        t2.set_resources(Resources(accelerators='H100:8', use_spot=True))
+        dag.add(t2)
+    Optimizer.optimize(dag, quiet=True)
+    assert t2.best_resources.cloud == 'runpod'
+    assert t2.best_resources.use_spot
+
+    with Dag() as dag:
+        t3 = Task('t3', run='true')
+        t3.set_resources(Resources(cpus=4))
+        dag.add(t3)
+    Optimizer.optimize(dag, quiet=True)
+    assert t3.best_resources.cloud == 'do'
+
+    # Region pinning flows through infra strings for the new clouds.
+    with Dag() as dag:
+        t4 = Task('t4', run='true')
+        t4.set_resources(Resources(infra='lambda/us-east-1',
+                                   accelerators='H100:8'))
+        dag.add(t4)
+    Optimizer.optimize(dag, quiet=True)
+    assert t4.best_resources.cloud == 'lambda'
+    assert t4.best_resources.region == 'us-east-1'
