@@ -5,7 +5,6 @@ pydo/azure-core; the public v2 REST surface is plain JSON).
 Credential: DIGITALOCEAN_TOKEN env var or the doctl config's
 access-token.
 """
-import os
 from typing import Dict, Optional
 
 from skypilot_tpu.adaptors import rest
@@ -17,21 +16,10 @@ RestApiError = rest.RestApiError
 
 
 def get_token() -> Optional[str]:
-    token = os.environ.get('DIGITALOCEAN_TOKEN')
-    if token:
-        return token
-    path = os.path.expanduser(CREDENTIALS_PATH)
-    if not os.path.isfile(path):
-        return None
-    try:
-        with open(path, 'r', encoding='utf-8') as f:
-            for line in f:
-                name, _, value = line.partition(':')
-                if name.strip() == 'access-token' and value.strip():
-                    return value.strip()
-    except OSError:
-        return None
-    return None
+    return rest.env_or_file_credential('DIGITALOCEAN_TOKEN',
+                                       CREDENTIALS_PATH,
+                                       line_keys=('access-token',),
+                                       sep=':')
 
 
 def _make_client() -> rest.RestClient:

@@ -6,7 +6,6 @@ lambda_utils.py (the reference wraps the same public API with
 ~/.lambda_cloud/lambda_keys (`api_key = <key>` line, the format the
 reference's lambda_utils reads).
 """
-import os
 from typing import Dict, Optional
 
 from skypilot_tpu.adaptors import rest
@@ -18,23 +17,9 @@ RestApiError = rest.RestApiError
 
 
 def get_api_key() -> Optional[str]:
-    key = os.environ.get('LAMBDA_API_KEY')
-    if key:
-        return key
-    path = os.path.expanduser(CREDENTIALS_PATH)
-    if not os.path.isfile(path):
-        return None
-    try:
-        with open(path, 'r', encoding='utf-8') as f:
-            for line in f:
-                name, _, value = line.partition('=')
-                if name.strip() == 'api_key' and value.strip():
-                    return value.strip()
-    except OSError:
-        # Unreadable credentials == no credentials; check_credentials
-        # must report (False, reason), not crash the cloud check.
-        return None
-    return None
+    return rest.env_or_file_credential('LAMBDA_API_KEY',
+                                       CREDENTIALS_PATH,
+                                       line_keys=('api_key',))
 
 
 def _make_client() -> rest.RestClient:

@@ -110,10 +110,17 @@ class ClientSlot:
 
 
 def env_or_file_credential(env_var: str, path: str,
-                           key: Optional[str] = None) -> Optional[str]:
-    """API key from env var, else from a file (~-expanded). When `key`
-    is given the file is parsed as JSON and that key is returned;
-    otherwise the stripped file body is the credential."""
+                           key: Optional[str] = None,
+                           line_keys: Optional[tuple] = None,
+                           sep: str = '=') -> Optional[str]:
+    """API key from env var, else from a file (~-expanded).
+
+    File interpretation: with `key` the body is JSON and that key is
+    returned; with `line_keys` the file is scanned for a
+    `<key><sep><value>` line (ini/toml/yaml-ish credential drops —
+    quotes stripped); otherwise the stripped body IS the credential.
+    Unreadable file == no credential (check_credentials must report
+    (False, reason), never crash)."""
     value = os.environ.get(env_var)
     if value:
         return value
@@ -122,9 +129,17 @@ def env_or_file_credential(env_var: str, path: str,
         return None
     try:
         with open(full, 'r', encoding='utf-8') as f:
-            body = f.read().strip()
+            body = f.read()
     except OSError:
         return None
+    if line_keys is not None:
+        for line in body.splitlines():
+            name, _, val = line.partition(sep)
+            val = val.strip().strip('"\'')
+            if name.strip() in line_keys and val:
+                return val
+        return None
+    body = body.strip()
     if not body:
         return None
     if key is None:

@@ -6,7 +6,6 @@ rest.runpod.io/v1 covers the same pod lifecycle with plain JSON, which
 is all we need). Credential: RUNPOD_API_KEY env var or
 ~/.runpod/config.toml (`apikey = "<key>"` line, the SDK's location).
 """
-import os
 from typing import Dict, Optional
 
 from skypilot_tpu.adaptors import rest
@@ -18,23 +17,9 @@ RestApiError = rest.RestApiError
 
 
 def get_api_key() -> Optional[str]:
-    key = os.environ.get('RUNPOD_API_KEY')
-    if key:
-        return key
-    path = os.path.expanduser(CREDENTIALS_PATH)
-    if not os.path.isfile(path):
-        return None
-    try:
-        with open(path, 'r', encoding='utf-8') as f:
-            for line in f:
-                name, _, value = line.partition('=')
-                if name.strip() in ('apikey', 'api_key'):
-                    return value.strip().strip('"\'') or None
-    except OSError:
-        # Unreadable credentials == no credentials; check_credentials
-        # must report (False, reason), not crash the cloud check.
-        return None
-    return None
+    return rest.env_or_file_credential('RUNPOD_API_KEY',
+                                       CREDENTIALS_PATH,
+                                       line_keys=('apikey', 'api_key'))
 
 
 def _make_client() -> rest.RestClient:

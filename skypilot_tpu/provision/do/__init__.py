@@ -32,27 +32,65 @@ def _droplet_state(droplet: Dict[str, Any]) -> str:
             'archive': 'terminated'}.get(status, 'pending')
 
 
-def _cluster_droplets(client, cluster_name_on_cloud: str
+def _cluster_droplets(client, cluster_name_on_cloud: str,
+                      region: Optional[str] = None
                       ) -> List[Dict[str, Any]]:
+    """Tag-matched droplets; `region` narrows to one region so a
+    failover retry elsewhere never adopts a dying droplet from the
+    failed region (teardown/query stay region-global)."""
     resp = client.request(
         'GET', '/v2/droplets',
         params={'tag_name': _tag(cluster_name_on_cloud),
                 'per_page': '200'})
-    return resp.get('droplets', [])
+    droplets = resp.get('droplets', [])
+    if region is not None:
+        droplets = [d for d in droplets
+                    if (d.get('region') or {}).get('slug') == region]
+    return droplets
+
+
+def _key_body(public_key: str) -> str:
+    """Comparable core of an authorized_keys line (type + base64 body,
+    comment dropped — DO rewrites comments)."""
+    return ' '.join(public_key.split()[:2])
+
+
+def _find_key_id(client, public_key: str) -> Optional[int]:
+    """Scan ALL account keys (paginated) for this public key,
+    regardless of the name it was registered under — DO rejects
+    duplicate fingerprints, so a key the user added via the web UI
+    must be reused, not re-POSTed."""
+    body = _key_body(public_key)
+    page = 1
+    while True:
+        resp = client.request('GET', '/v2/account/keys',
+                              params={'per_page': '200',
+                                      'page': str(page)})
+        keys = resp.get('ssh_keys', [])
+        for key in keys:
+            if _key_body(key.get('public_key', '')) == body:
+                return key['id']
+        if len(keys) < 200:
+            return None
+        page += 1
 
 
 def _ensure_ssh_key(client, public_key: str) -> int:
     """Idempotently register the cluster public key; returns its id."""
+    key_id = _find_key_id(client, public_key)
+    if key_id is not None:
+        return key_id
     digest = hashlib.sha256(public_key.encode()).hexdigest()[:12]
-    key_name = f'skytpu-{digest}'
-    resp = client.request('GET', '/v2/account/keys',
-                          params={'per_page': '200'})
-    for key in resp.get('ssh_keys', []):
-        if key.get('name') == key_name:
-            return key['id']
-    created = client.request('POST', '/v2/account/keys',
-                             json_body={'name': key_name,
-                                        'public_key': public_key})
+    try:
+        created = client.request('POST', '/v2/account/keys',
+                                 json_body={'name': f'skytpu-{digest}',
+                                            'public_key': public_key})
+    except do_adaptor.RestApiError as e:
+        if e.status == 422:  # raced: registered since our scan
+            key_id = _find_key_id(client, public_key)
+            if key_id is not None:
+                return key_id
+        raise
     return created['ssh_key']['id']
 
 
@@ -61,7 +99,8 @@ def run_instances(region: str, cluster_name_on_cloud: str,
     client = do_adaptor.client()
     nc = {**config.provider_config, **config.node_config}
     existing = {d['name']: d
-                for d in _cluster_droplets(client, cluster_name_on_cloud)}
+                for d in _cluster_droplets(client, cluster_name_on_cloud,
+                                           region=region)}
     created: List[str] = []
     resumed: List[str] = []
     try:
@@ -96,6 +135,7 @@ def run_instances(region: str, cluster_name_on_cloud: str,
             client.request('POST', '/v2/droplets', json_body=body)
             created.append(name)
         _wait_active(client, cluster_name_on_cloud, config.count,
+                     region=region,
                      timeout=float(config.provider_config.get(
                          'provision_timeout', 900)))
     except do_adaptor.RestApiError as e:
@@ -108,10 +148,12 @@ def run_instances(region: str, cluster_name_on_cloud: str,
 
 
 def _wait_active(client, cluster_name_on_cloud: str, count: int,
+                 region: Optional[str] = None,
                  timeout: float = 900.0) -> None:
     deadline = time.time() + timeout
     while True:
-        droplets = _cluster_droplets(client, cluster_name_on_cloud)
+        droplets = _cluster_droplets(client, cluster_name_on_cloud,
+                                     region=region)
         if len(droplets) >= count and all(
                 _droplet_state(d) == 'running' for d in droplets):
             return

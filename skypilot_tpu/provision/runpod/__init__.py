@@ -150,17 +150,30 @@ def query_instances(cluster_name_on_cloud: str,
 
 
 def _ssh_endpoint(pod: Dict[str, Any]) -> Optional[Dict[str, Any]]:
-    """Public (ip, port) for the pod's 22/tcp mapping."""
-    for mapping in pod.get('portMappings') or []:
-        if str(mapping.get('privatePort')) == '22':
-            return {'ip': mapping.get('ip'),
-                    'port': int(mapping.get('publicPort', 22))}
-    ports = pod.get('runtime', {}).get('ports') or []
-    for mapping in ports:
+    """Public (ip, port) for the pod's 22/tcp mapping.
+
+    The REST surface returns `portMappings` as an object keyed by
+    private port ({"22": 10341}) with the address in `publicIp`; the
+    GraphQL-era shape is a list of dicts under runtime.ports. Handle
+    both, and skip mappings whose public port isn't assigned yet.
+    """
+    mappings = pod.get('portMappings')
+    if isinstance(mappings, dict):
+        public = mappings.get('22')
+        if public:
+            return {'ip': pod.get('publicIp'), 'port': int(public)}
+    elif isinstance(mappings, list):
+        for mapping in mappings:
+            if str(mapping.get('privatePort')) == '22' and \
+                    mapping.get('publicPort'):
+                return {'ip': mapping.get('ip') or pod.get('publicIp'),
+                        'port': int(mapping['publicPort'])}
+    for mapping in pod.get('runtime', {}).get('ports') or []:
         if str(mapping.get('privatePort')) == '22' and \
-                mapping.get('isIpPublic', True):
+                mapping.get('isIpPublic', True) and \
+                mapping.get('publicPort'):
             return {'ip': mapping.get('ip'),
-                    'port': int(mapping.get('publicPort', 22))}
+                    'port': int(mapping['publicPort'])}
     return None
 
 

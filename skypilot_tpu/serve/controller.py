@@ -24,6 +24,16 @@ _LOOP_INTERVAL_SECONDS = float(
     os.environ.get('SKYTPU_SERVE_LOOP_INTERVAL', '10'))
 
 
+def _pick_victims(pool, n, protected=frozenset()):
+    """Replica ids to retire: not-ready first, then newest (highest
+    id, least-warm); never a protected (rolling-update surge) one."""
+    candidates = sorted(
+        (r for r in pool if r['replica_id'] not in protected),
+        key=lambda r: (r['status'] == serve_state.ReplicaStatus.READY,
+                       -r['replica_id']))
+    return [r['replica_id'] for r in candidates[:n]]
+
+
 class ServeController:
 
     def __init__(self, service_name: str) -> None:
@@ -75,30 +85,35 @@ class ServeController:
                 if r['status'] not in (
                     serve_state.ReplicaStatus.SHUTTING_DOWN,
                     serve_state.ReplicaStatus.FAILED)]
-        # During a rolling update the ROLLOUT owns shrinking (the
-        # autoscaler would otherwise kill the surge replica every
-        # tick); scale-UP — including spot-preemption fallback — stays
-        # live so capacity never drains under load.
+        # During a rolling update the ROLLOUT owns replacing old
+        # replicas; the autoscaler must neither kill the new-version
+        # surge replicas nor treat them as excess. Protection is
+        # CAPPED at the rollout's own entitlement (min_replicas + 1
+        # newest new-version replicas): autoscaler-spawned spike
+        # replicas also carry the new version, and blanket-protecting
+        # them would let a stalled update pin a scaled-up fleet at
+        # peak cost — the failure mode this gate exists to avoid.
+        surge = sorted(
+            (r for r in live
+             if updating and r['version'] >= service['version']),
+            key=lambda r: -r['replica_id'])
+        protected = frozenset(
+            r['replica_id']
+            for r in surge[:self.spec.min_replicas + 1])
         if isinstance(self.autoscaler,
                       autoscalers.FallbackRequestRateAutoscaler):
-            self._scale_mixed(live, no_shrink=updating)
+            self._scale_mixed(live, protected)
         else:
             decision = self.autoscaler.decide(
                 len(ready), len(live), self.lb.tracker.qps())
             if decision.target_replicas > len(live):
                 self.manager.scale_up(
                     decision.target_replicas - len(live))
-            elif decision.target_replicas < len(live) and not updating:
-                # Prefer terminating not-ready replicas, then highest
-                # (newest, least-warm) ids.
-                victims = sorted(
-                    live,
-                    key=lambda r: (
-                        r['status'] == serve_state.ReplicaStatus.READY,
-                        -r['replica_id']))
-                n = len(live) - decision.target_replicas
-                self.manager.scale_down(
-                    [v['replica_id'] for v in victims[:n]])
+            else:
+                n = len(live) - decision.target_replicas - len(protected)
+                if n > 0:
+                    self.manager.scale_down(
+                        _pick_victims(live, n, protected))
 
         self._set_health_status(live, ready)
 
@@ -108,10 +123,11 @@ class ServeController:
                    serve_state.ServiceStatus.REPLICA_INIT))
         serve_state.set_service_status(self.service_name, status)
 
-    def _scale_mixed(self, live, no_shrink: bool = False) -> None:
+    def _scale_mixed(self, live, protected=frozenset()) -> None:
         """Spot fleet with on-demand fallback: reconcile the two pools
-        separately toward the mixed decision. no_shrink defers pool
-        shrinking to the rolling update that owns it."""
+        separately toward the mixed decision. `protected` replicas
+        (rolling-update surge) are never victims and grant their pool
+        an equal headroom allowance."""
         spot = [r for r in live if r.get('use_spot')]
         ondemand = [r for r in live if not r.get('use_spot')]
         ready_spot = [r for r in spot
@@ -124,15 +140,13 @@ class ServeController:
             if target > len(pool):
                 self.manager.scale_up(target - len(pool),
                                       use_spot=use_spot)
-            elif target < len(pool) and not no_shrink:
-                victims = sorted(
-                    pool,
-                    key=lambda r: (
-                        r['status'] == serve_state.ReplicaStatus.READY,
-                        -r['replica_id']))
-                self.manager.scale_down(
-                    [v['replica_id']
-                     for v in victims[:len(pool) - target]])
+            else:
+                shielded = sum(1 for r in pool
+                               if r['replica_id'] in protected)
+                n = len(pool) - target - shielded
+                if n > 0:
+                    self.manager.scale_down(
+                        _pick_victims(pool, n, protected))
 
         reconcile(spot, decision.target_spot, True)
         reconcile(ondemand, decision.target_ondemand, False)
@@ -168,11 +182,15 @@ class ServeController:
         new_ready = [r for r in new_live
                      if r['status'] == serve_state.ReplicaStatus.READY]
         # One surge replica at a time: launch a new-version replica if
-        # none is in flight; retire one old replica per ready new one.
+        # none is in flight. Retire an old replica only while doing so
+        # keeps (old + new_ready) at or above min_replicas — retiring
+        # one per tick merely because SOME new replica is ready would
+        # collapse serving capacity while later surges still boot.
         if len(new_live) < self.spec.min_replicas + 1 and \
                 len(new_live) == len(new_ready):
             self.manager.scale_up(1)
-        if new_ready:
+        if new_ready and \
+                len(old) + len(new_ready) > self.spec.min_replicas:
             victims = sorted(old, key=lambda r: r['replica_id'])
             self.manager.scale_down(
                 [victims[0]['replica_id']])

@@ -150,13 +150,14 @@ class FakeRunPod:
             if self.fail_create_with is not None:
                 raise self.fail_create_with
             pid = f'pod-{next(self._ids)}'
+            # REST shape: portMappings is an object keyed by private
+            # port; the address lives in publicIp.
             self.pods[pid] = {
                 'id': pid, 'name': json_body['name'],
                 'desiredStatus': 'RUNNING',
                 'internalIp': '10.1.0.4',
-                'portMappings': [{'privatePort': 22,
-                                  'publicPort': 30022,
-                                  'ip': '194.0.0.7'}],
+                'publicIp': '194.0.0.7',
+                'portMappings': {'22': 30022},
                 '_spec': json_body}
             return self.pods[pid]
         if method == 'POST' and path.endswith('/stop'):
@@ -217,6 +218,26 @@ def test_runpod_stop_resume_spot_and_capacity(fake_runpod):
         runpod_provision.run_instances(
             'US-GA-1', 'rp2',
             _config('1x_H100-SXM', gpu_type='H100', gpu_count=1))
+
+
+def test_runpod_ssh_endpoint_shapes():
+    """Both API shapes resolve; unassigned public ports are skipped."""
+    ep = runpod_provision._ssh_endpoint(
+        {'portMappings': {'22': 30100}, 'publicIp': '1.2.3.4'})
+    assert ep == {'ip': '1.2.3.4', 'port': 30100}
+    ep = runpod_provision._ssh_endpoint(
+        {'portMappings': [{'privatePort': 22, 'publicPort': 30101,
+                           'ip': '5.6.7.8'}]})
+    assert ep == {'ip': '5.6.7.8', 'port': 30101}
+    # Not-yet-assigned mapping (publicPort null) must not crash.
+    assert runpod_provision._ssh_endpoint(
+        {'portMappings': [{'privatePort': 22, 'publicPort': None}]}) \
+        is None
+    assert runpod_provision._ssh_endpoint(
+        {'runtime': {'ports': [{'privatePort': 22, 'publicPort': 30102,
+                                'ip': '9.9.9.9',
+                                'isIpPublic': True}]}}) == {
+        'ip': '9.9.9.9', 'port': 30102}
 
 
 def test_runpod_instance_type_split():
@@ -345,6 +366,13 @@ class FakeDO:
         if path == '/v2/account/keys' and method == 'GET':
             return {'ssh_keys': list(self.keys)}
         if path == '/v2/account/keys' and method == 'POST':
+            body = ' '.join(json_body['public_key'].split()[:2])
+            if any(' '.join(k['public_key'].split()[:2]) == body
+                   for k in self.keys):
+                # DO rejects duplicate fingerprints regardless of name.
+                raise do_adaptor.RestApiError(
+                    'SSH Key is already in use on your account',
+                    status=422)
             key = dict(json_body, id=len(self.keys) + 1)
             self.keys.append(key)
             return {'ssh_key': key}
@@ -359,6 +387,7 @@ class FakeDO:
             self.droplets[did] = {
                 'id': did, 'name': json_body['name'], 'status': 'active',
                 'tags': list(json_body['tags']),
+                'region': {'slug': json_body['region']},
                 'networks': {'v4': [
                     {'type': 'private', 'ip_address': '10.2.0.3'},
                     {'type': 'public', 'ip_address': '164.0.0.2'}]},
@@ -409,6 +438,35 @@ def test_do_lifecycle_tags_and_keys(fake_do):
     do_provision.terminate_instances('do1', {})
     assert do_provision.query_instances('do1', {}) == {}
     do_provision.terminate_instances('do1', {})
+
+
+def test_do_reuses_key_registered_under_other_name(fake_do):
+    """The user's key added via the web UI (different name) must be
+    reused — DO 422s on duplicate fingerprints."""
+    fake_do.keys.append({'id': 77, 'name': 'my-laptop',
+                         'public_key': 'ssh-ed25519 K me@laptop'})
+    do_provision.run_instances('nyc3', 'do1', _config('s-4vcpu-8gb'))
+    droplet = next(iter(fake_do.droplets.values()))
+    assert droplet['_spec']['ssh_keys'] == [77]
+    assert len(fake_do.keys) == 1  # nothing re-registered
+
+
+def test_do_region_failover_ignores_other_region_droplets(fake_do):
+    """A retry in region B must not adopt a lingering region-A droplet
+    as its own node."""
+    do_provision.run_instances('nyc3', 'do1', _config('s-4vcpu-8gb'))
+    record = do_provision.run_instances('sfo3', 'do1',
+                                        _config('s-4vcpu-8gb'))
+    assert record.created_instance_ids == ['do1-0']
+    regions = {d['region']['slug'] for d in fake_do.droplets.values()}
+    assert regions == {'nyc3', 'sfo3'}
+    # query/terminate stay region-global (teardown sweeps everything,
+    # including the lingering region-A droplet).
+    assert len(fake_do.droplets) == 2
+    assert do_provision.query_instances('do1', {}) == {
+        'do1-0': 'running'}
+    do_provision.terminate_instances('do1', {})
+    assert fake_do.droplets == {}
 
 
 def test_do_capacity_taxonomy(fake_do):

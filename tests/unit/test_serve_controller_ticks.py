@@ -1,0 +1,255 @@
+"""Controller tick logic: rolling-update pacing × autoscaler shrink.
+
+Drives ServeController._step directly against the real serve_state DB
+with a fake replica manager (no processes, no probes), covering the
+interplay bugs: capacity collapse from retiring one old replica per
+tick, the surge replica being autoscaled away, and a stalled update
+pinning a scaled-up fleet at peak.
+"""
+import pytest
+
+from skypilot_tpu.serve import autoscalers
+from skypilot_tpu.serve import controller as controller_lib
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve import service_spec as spec_lib
+
+SVC = 'ticksvc'
+R = serve_state.ReplicaStatus
+
+
+def _spec(min_replicas=3, **policy):
+    return spec_lib.ServiceSpec.from_yaml_config({
+        'readiness_probe': '/',
+        'replica_policy': {
+            'min_replicas': min_replicas, 'max_replicas': 10,
+            'target_qps_per_replica': 10,
+            'upscale_delay_seconds': 0, 'downscale_delay_seconds': 0,
+            **policy},
+    })
+
+
+class FakeManager:
+    """Replica bookkeeping straight into serve_state; probes are the
+    tests' job (set_replica_status)."""
+
+    def __init__(self, service_name):
+        self.service_name = service_name
+        self.version = 1
+
+    def probe_all(self):
+        pass
+
+    def scale_up(self, n=1, use_spot=False):
+        for _ in range(n):
+            rid = serve_state.next_replica_id(self.service_name)
+            serve_state.add_replica(self.service_name, rid,
+                                    f'c-{rid}', self.version,
+                                    use_spot=use_spot)
+
+    def scale_down(self, replica_ids):
+        for rid in replica_ids:
+            serve_state.set_replica_status(self.service_name, rid,
+                                           R.SHUTTING_DOWN)
+
+    def ready_endpoints(self):
+        return [f'http://r{r["replica_id"]}'
+                for r in serve_state.get_replicas(self.service_name)
+                if r['status'] == R.READY]
+
+    def terminate_all(self):
+        pass
+
+
+class FakeTracker:
+    qps_value = 0.0
+
+    def qps(self):
+        return self.qps_value
+
+
+class FakeLB:
+    def __init__(self):
+        self.tracker = FakeTracker()
+        self.replicas = []
+
+    def set_replicas(self, endpoints):
+        self.replicas = endpoints
+
+    def stop(self):
+        pass
+
+
+@pytest.fixture
+def ctl(tmp_path, monkeypatch):
+    serve_state.reset_for_tests()
+    serve_state.add_service(SVC, {'run': 'true'}, lb_port=0,
+                            controller_port=0)
+
+    c = object.__new__(controller_lib.ServeController)
+    c.service_name = SVC
+    c.spec = _spec()
+    c.manager = FakeManager(SVC)
+    c.autoscaler = autoscalers.make_autoscaler(c.spec)
+    c.lb = FakeLB()
+    c._stop = False
+    c._loaded_version = 1
+    # Spec reload pulls from the stored task_yaml; keep the fixture's
+    # spec object authoritative instead.
+    c._maybe_reload_spec = lambda service: None
+    yield c
+    serve_state.reset_for_tests()
+
+
+def _mark_ready(*rids):
+    for rid in rids:
+        serve_state.set_replica_status(SVC, rid, R.READY)
+
+
+def _statuses():
+    return {r['replica_id']: r['status']
+            for r in serve_state.get_replicas(SVC)}
+
+
+def _live_ids():
+    return sorted(rid for rid, s in _statuses().items()
+                  if s not in (R.SHUTTING_DOWN, R.FAILED))
+
+
+def _ready_ids():
+    return sorted(rid for rid, s in _statuses().items() if s == R.READY)
+
+
+def test_steady_state_no_churn(ctl):
+    ctl.manager.scale_up(3)
+    _mark_ready(1, 2, 3)
+    for _ in range(3):
+        ctl._step()
+    assert _live_ids() == [1, 2, 3]
+    assert sorted(ctl.lb.replicas) == sorted(
+        ['http://r1', 'http://r2', 'http://r3'])
+
+
+def test_rolling_update_paces_retirement(ctl):
+    """One ready surge replica retires exactly ONE old replica — ready
+    capacity never collapses below min_replicas while later surges are
+    still booting (the retire-per-tick-while-any-new-ready bug)."""
+    ctl.manager.scale_up(3)           # v1 replicas 1,2,3
+    _mark_ready(1, 2, 3)
+    ctl._step()
+    serve_state.set_service_version(SVC, 2, {'run': 'true'})
+    ctl.manager.version = 2
+
+    ctl._step()                        # launches surge replica 4 (v2)
+    assert _live_ids() == [1, 2, 3, 4]
+    _mark_ready(4)
+
+    ctl._step()                        # retires old 1, launches surge 5
+    assert 1 not in _live_ids()
+    # Ticks with surge 5 still PROVISIONING must NOT retire 2 or 3:
+    # old(2) + new_ready(1) == min_replicas(3).
+    for _ in range(3):
+        ctl._step()
+    assert {2, 3} <= set(_live_ids())
+    assert len(_ready_ids()) >= 3
+
+    _mark_ready(5)
+    ctl._step()                        # now 2 can go
+    assert 2 not in _live_ids()
+    for _ in range(2):
+        ctl._step()
+        for r in serve_state.get_replicas(SVC):
+            if r['version'] == 2 and r['status'] == R.PROVISIONING:
+                _mark_ready(r['replica_id'])
+    assert 3 not in _live_ids()
+    ctl._step()  # update done: autoscaler reclaims the extra surge
+    # End state: fleet fully on v2, at min_replicas, all ready.
+    live = [r for r in serve_state.get_replicas(SVC)
+            if r['replica_id'] in _live_ids()]
+    assert all(r['version'] == 2 for r in live)
+    assert len(_ready_ids()) == 3
+
+
+def test_update_surge_survives_autoscaler(ctl):
+    """The v2 surge replica must not be picked as a scale-down victim
+    even though live (4) exceeds the autoscaler target (3)."""
+    ctl.manager.scale_up(3)
+    _mark_ready(1, 2, 3)
+    serve_state.set_service_version(SVC, 2, {'run': 'true'})
+    ctl.manager.version = 2
+    for _ in range(4):
+        ctl._step()                    # surge 4 provisioning throughout
+        assert 4 in _live_ids(), _statuses()
+
+
+def test_stalled_update_does_not_pin_scaled_up_fleet(ctl):
+    """Autoscaler shrink stays live during an update for non-surge
+    replicas: a stalled rollout (v2 never ready) can't keep a
+    QPS-spike fleet at peak cost forever."""
+    ctl.manager.scale_up(3)
+    _mark_ready(1, 2, 3)
+    ctl.lb.tracker.qps_value = 80.0    # spike: target 8 replicas
+    ctl._step()
+    for r in serve_state.get_replicas(SVC):
+        _mark_ready(r['replica_id'])
+    assert len(_live_ids()) == 8
+
+    serve_state.set_service_version(SVC, 2, {'run': 'true'})
+    ctl.manager.version = 2
+    ctl._step()                        # surge v2 launched, never ready
+    surge = max(_live_ids())
+
+    ctl.lb.tracker.qps_value = 0.0     # spike over
+    for _ in range(8):
+        ctl._step()
+    # Old fleet shrunk back to min (plus the protected surge).
+    live = _live_ids()
+    assert surge in live
+    assert len(live) == ctl.spec.min_replicas + 1, _statuses()
+
+
+def test_spike_during_stalled_update_is_bounded(ctl):
+    """Autoscaler-spawned replicas carry the new version too; the
+    surge protection must be capped at the rollout's entitlement
+    (min+1 newest) so a spike during a broken update is reclaimed
+    instead of protected forever."""
+    ctl.manager.scale_up(3)           # v1, ready
+    _mark_ready(1, 2, 3)
+    serve_state.set_service_version(SVC, 2, {'run': 'true'})
+    ctl.manager.version = 2
+    ctl._step()                        # surge v2 (never becomes ready)
+
+    ctl.lb.tracker.qps_value = 80.0    # spike mid-update: target 8
+    ctl._step()                        # spawns more v2, none get ready
+    peak = len(_live_ids())
+    assert peak >= 8
+
+    ctl.lb.tracker.qps_value = 0.0     # spike over, update still stuck
+    for _ in range(10):
+        ctl._step()
+    live = len(_live_ids())
+    # Bounded: old min fleet + at most (min+1) protected surge — NOT
+    # pinned at the spike's peak.
+    assert live < peak
+    assert live <= 2 * ctl.spec.min_replicas + 1, _statuses()
+
+
+def test_mixed_pools_respect_surge_protection(ctl):
+    """Fallback autoscaler path: protected surge in the spot pool is
+    shielded, on-demand fallback still shrinks when spot recovers."""
+    ctl.spec = _spec(use_spot=True, base_ondemand_fallback_replicas=1,
+                     dynamic_ondemand_fallback=True)
+    ctl.autoscaler = autoscalers.make_autoscaler(ctl.spec)
+    # 3 spot + 1 on-demand base, all ready.
+    ctl.manager.scale_up(3, use_spot=True)
+    ctl.manager.scale_up(1, use_spot=False)
+    _mark_ready(1, 2, 3, 4)
+    ctl._step()
+    baseline = set(_live_ids())
+
+    serve_state.set_service_version(SVC, 2, {'run': 'true'})
+    ctl.manager.version = 2
+    ctl._step()                        # spot surge v2
+    new = set(_live_ids()) - baseline
+    for _ in range(3):
+        ctl._step()
+        assert new <= set(_live_ids()), _statuses()
