@@ -85,9 +85,12 @@ def cli():
 @click.option('--idle-minutes-to-autostop', '-i', type=int, default=None)
 @click.option('--retry-until-up', '-r', is_flag=True,
               help='Keep retrying provisioning until capacity is found.')
+@click.option('--optimize-target', '-t',
+              type=click.Choice(['cost', 'time']), default='cost',
+              help='Minimize hourly cost or estimated completion time.')
 def launch(entrypoint, cluster, name, num_nodes, accelerators, cloud,
            workdir, env, detach_run, dryrun, no_setup, down,
-           idle_minutes_to_autostop, retry_until_up):
+           idle_minutes_to_autostop, retry_until_up, optimize_target):
     """Launch a task (provision + setup + run)."""
     from skypilot_tpu.client import sdk
     from skypilot_tpu.utils import common_utils
@@ -104,7 +107,8 @@ def launch(entrypoint, cluster, name, num_nodes, accelerators, cloud,
     click.echo(f'Launching on cluster {cluster!r}...')
     request_id = sdk.launch(task, cluster, dryrun=dryrun,
                             detach_run=detach_run, no_setup=no_setup,
-                            retry_until_up=retry_until_up)
+                            retry_until_up=retry_until_up,
+                            minimize=optimize_target.upper())
     _run_and_stream(request_id)
 
 

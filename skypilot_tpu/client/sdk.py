@@ -185,7 +185,8 @@ def api_status(limit: int = 100) -> List[Dict[str, Any]]:
 
 def launch(task, cluster_name: str, *, dryrun: bool = False,
            detach_run: bool = False, no_setup: bool = False,
-           retry_until_up: bool = False) -> str:
+           retry_until_up: bool = False,
+           minimize: str = 'COST') -> str:
     return _submit('launch', {
         'task': task.to_yaml_config(),
         'cluster_name': cluster_name,
@@ -193,6 +194,7 @@ def launch(task, cluster_name: str, *, dryrun: bool = False,
         'detach_run': detach_run,
         'no_setup': no_setup,
         'retry_until_up': retry_until_up,
+        'minimize': minimize,
     })
 
 

@@ -56,6 +56,8 @@ def _load_file(path: str) -> Dict[str, Any]:
         return {}
     if not isinstance(data, dict):
         raise ValueError(f'Config file {path} must contain a mapping.')
+    from skypilot_tpu.utils import schemas
+    schemas.validate_config(data, path=path)
     return data
 
 

@@ -30,6 +30,10 @@ class InvalidInfraError(SkyTpuError, ValueError):
     """An infra string (e.g. 'gcp/us-central2-b') cannot be parsed."""
 
 
+class ConfigError(SkyTpuError, ValueError):
+    """A layered config file (~/.skytpu/config.yaml etc.) is invalid."""
+
+
 class AcceleratorNotFoundError(SkyTpuError, ValueError):
     """Accelerator name not present in any enabled catalog."""
 

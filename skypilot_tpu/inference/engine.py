@@ -267,6 +267,25 @@ class InferenceEngine:
                  batch_size: int = 8,
                  max_seq_len: Optional[int] = None,
                  seed: int = 0):
+        # The cached decode path implements the llama architecture;
+        # reject family knobs it would silently get wrong (windowed
+        # cache masking, GeGLU, post-norms, softcaps are future work).
+        unsupported = {
+            'activation': config.activation != 'silu',
+            'tied_embeddings': config.tied_embeddings,
+            'embed_scale': config.embed_scale,
+            'norm_plus_one': config.norm_plus_one,
+            'post_norms': config.post_norms,
+            'attn_logit_softcap': config.attn_logit_softcap is not None,
+            'final_logit_softcap':
+                config.final_logit_softcap is not None,
+            'sliding_window': config.sliding_window is not None,
+        }
+        bad = sorted(k for k, v in unsupported.items() if v)
+        if bad:
+            raise NotImplementedError(
+                'InferenceEngine supports the llama family only; '
+                f'config uses: {bad}')
         self.params = params
         self.config = config
         self.state = DecodeState(config, batch_size, max_seq_len)

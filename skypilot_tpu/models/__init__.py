@@ -14,10 +14,14 @@ def resolve(name: str) -> Tuple[Any, Any]:
     """Config name -> (family module, config dataclass)."""
     if name in llama.CONFIGS:
         return llama, llama.CONFIGS[name]
+    from skypilot_tpu.models import gemma
+    from skypilot_tpu.models import mistral
     from skypilot_tpu.models import moe
-    if name in moe.CONFIGS:
-        return moe, moe.CONFIGS[name]
-    known = sorted(llama.CONFIGS) + sorted(moe.CONFIGS)
+    for family in (gemma, mistral, moe):
+        if name in family.CONFIGS:
+            return family, family.CONFIGS[name]
+    known = (sorted(llama.CONFIGS) + sorted(gemma.CONFIGS) +
+             sorted(mistral.CONFIGS) + sorted(moe.CONFIGS))
     raise ValueError(f'Unknown model {name!r}; available: {known}')
 
 

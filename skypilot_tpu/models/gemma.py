@@ -1,0 +1,60 @@
+"""Gemma family — the shared transformer core with Gemma's knobs.
+
+No reference equivalent (SkyPilot orchestrates user containers; our
+compute plane is additive, SURVEY.md §2.11). Architecture follows the
+published Gemma/Gemma-2 tables: GeGLU MLP, (1+w) RMSNorm with zero
+init, sqrt(hidden) embedding scale, tied embeddings, and for Gemma-2
+post-norms, logit soft-capping, and alternating local(4096)/global
+attention. All of that lives as config knobs on the one TPU core
+(`models/llama.py`) — one compiled layer body, MaxText-style, rather
+than a forked model file.
+"""
+import jax.numpy as jnp
+
+from skypilot_tpu.models import llama
+
+# Re-exported functional surface (families are config + shared core).
+LlamaConfig = llama.LlamaConfig
+init_params = llama.init_params
+param_logical_axes = llama.param_logical_axes
+forward = llama.forward
+loss_fn = llama.loss_fn
+
+_GEMMA = dict(
+    activation='gelu',
+    tied_embeddings=True,
+    embed_scale=True,
+    norm_plus_one=True,
+    rope_theta=10000.0,
+)
+_GEMMA2 = dict(
+    **_GEMMA,
+    post_norms=True,
+    attn_logit_softcap=50.0,
+    final_logit_softcap=30.0,
+    sliding_window=4096,
+    sliding_window_pattern=2,   # alternate local / global
+)
+
+CONFIGS = {
+    'gemma2-2b': LlamaConfig(
+        vocab_size=256128, hidden_size=2304, intermediate_size=9216,
+        num_layers=26, num_heads=8, num_kv_heads=4, head_dim=256,
+        max_seq_len=8192, **_GEMMA2),
+    'gemma2-9b': LlamaConfig(
+        vocab_size=256128, hidden_size=3584, intermediate_size=14336,
+        num_layers=42, num_heads=16, num_kv_heads=8, head_dim=256,
+        max_seq_len=8192, **_GEMMA2),
+    'gemma2-27b': LlamaConfig(
+        vocab_size=256128, hidden_size=4608, intermediate_size=36864,
+        num_layers=46, num_heads=32, num_kv_heads=16, head_dim=128,
+        max_seq_len=8192, query_pre_attn_scalar=144.0, **_GEMMA2),
+    # CPU-test scale: every gemma2 mechanism on — window smaller than
+    # seq so local masking bites, 2 layers so the local/global
+    # alternation has one of each while compiles stay cheap.
+    'tiny-gemma': LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+        max_seq_len=128, dtype=jnp.float32, remat=False,
+        **{**_GEMMA2, 'sliding_window': 16}),
+}

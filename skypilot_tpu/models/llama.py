@@ -54,14 +54,27 @@ class LlamaConfig:
     remat_policy: str = 'dots'
     attention_impl: str = 'dense'
     attention_block_size: int = 512
+    # --- family knobs (Gemma / Mistral share this core) ----------------
+    activation: str = 'silu'            # 'silu' (llama) | 'gelu' (gemma)
+    tied_embeddings: bool = False       # lm_head = embed.T (gemma)
+    embed_scale: bool = False           # x *= sqrt(hidden) (gemma)
+    norm_plus_one: bool = False         # RMSNorm scales by (1+w) (gemma)
+    post_norms: bool = False            # extra post-attn/mlp norms (gemma2)
+    attn_logit_softcap: Optional[float] = None    # gemma2: 50.0
+    final_logit_softcap: Optional[float] = None   # gemma2: 30.0
+    query_pre_attn_scalar: Optional[float] = None  # gemma2 q scaling
+    sliding_window: Optional[int] = None          # mistral/gemma2 local
+    # every Nth layer is GLOBAL (gemma2 alternates: 2); 1 = all local.
+    sliding_window_pattern: int = 1
 
     def num_params(self) -> int:
         e, m, v = self.hidden_size, self.intermediate_size, self.vocab_size
         h, kv, d = self.num_heads, self.num_kv_heads, self.head_dim
         per_layer = (e * h * d + 2 * e * kv * d + h * d * e  # attn
                      + 3 * e * m                              # mlp
-                     + 2 * e)                                 # norms
-        return self.num_layers * per_layer + 2 * v * e + e
+                     + (4 if self.post_norms else 2) * e)     # norms
+        head = v * e if not self.tied_embeddings else 0
+        return self.num_layers * per_layer + v * e + head + e
 
     def flops_per_token(self, seq_len: int) -> float:
         """Approx train-step FLOPs/token (fwd+bwd ≈ 6×params + attn)."""
@@ -99,22 +112,28 @@ CONFIGS: Dict[str, LlamaConfig] = {
 
 # Logical axes for every param leaf (pytree mirroring init_params).
 def param_logical_axes(config: LlamaConfig) -> Params:
-    return {
-        'embed': ('vocab', 'embed'),
-        'layers': {
-            'attn_norm': ('layers', 'embed'),
-            'wq': ('layers', 'embed', 'heads', 'head_dim'),
-            'wk': ('layers', 'embed', 'kv_heads', 'head_dim'),
-            'wv': ('layers', 'embed', 'kv_heads', 'head_dim'),
-            'wo': ('layers', 'heads', 'head_dim', 'embed'),
-            'mlp_norm': ('layers', 'embed'),
-            'w_gate': ('layers', 'embed', 'mlp'),
-            'w_up': ('layers', 'embed', 'mlp'),
-            'w_down': ('layers', 'mlp', 'embed'),
-        },
-        'final_norm': ('embed',),
-        'lm_head': ('embed', 'vocab'),
+    layers = {
+        'attn_norm': ('layers', 'embed'),
+        'wq': ('layers', 'embed', 'heads', 'head_dim'),
+        'wk': ('layers', 'embed', 'kv_heads', 'head_dim'),
+        'wv': ('layers', 'embed', 'kv_heads', 'head_dim'),
+        'wo': ('layers', 'heads', 'head_dim', 'embed'),
+        'mlp_norm': ('layers', 'embed'),
+        'w_gate': ('layers', 'embed', 'mlp'),
+        'w_up': ('layers', 'embed', 'mlp'),
+        'w_down': ('layers', 'mlp', 'embed'),
     }
+    if config.post_norms:
+        layers['post_attn_norm'] = ('layers', 'embed')
+        layers['post_mlp_norm'] = ('layers', 'embed')
+    out = {
+        'embed': ('vocab', 'embed'),
+        'layers': layers,
+        'final_norm': ('embed',),
+    }
+    if not config.tied_embeddings:
+        out['lm_head'] = ('embed', 'vocab')
+    return out
 
 
 def init_params(config: LlamaConfig, key: jax.Array) -> Params:
@@ -129,22 +148,30 @@ def init_params(config: LlamaConfig, key: jax.Array) -> Params:
 
     L, e, m = c.num_layers, c.hidden_size, c.intermediate_size
     h, kv, d = c.num_heads, c.num_kv_heads, c.head_dim
-    return {
-        'embed': normal(keys[0], (c.vocab_size, e), e),
-        'layers': {
-            'attn_norm': jnp.ones((L, e), dt),
-            'wq': normal(keys[1], (L, e, h, d), e),
-            'wk': normal(keys[2], (L, e, kv, d), e),
-            'wv': normal(keys[3], (L, e, kv, d), e),
-            'wo': normal(keys[4], (L, h, d, e), h * d),
-            'mlp_norm': jnp.ones((L, e), dt),
-            'w_gate': normal(keys[5], (L, e, m), e),
-            'w_up': normal(keys[6], (L, e, m), e),
-            'w_down': normal(keys[7], (L, m, e), m),
-        },
-        'final_norm': jnp.ones((e,), dt),
-        'lm_head': normal(keys[8], (e, c.vocab_size), e),
+    # (1+w)-style norms start at w=0, classic norms at w=1.
+    norm_init = jnp.zeros if c.norm_plus_one else jnp.ones
+    layers = {
+        'attn_norm': norm_init((L, e), dt),
+        'wq': normal(keys[1], (L, e, h, d), e),
+        'wk': normal(keys[2], (L, e, kv, d), e),
+        'wv': normal(keys[3], (L, e, kv, d), e),
+        'wo': normal(keys[4], (L, h, d, e), h * d),
+        'mlp_norm': norm_init((L, e), dt),
+        'w_gate': normal(keys[5], (L, e, m), e),
+        'w_up': normal(keys[6], (L, e, m), e),
+        'w_down': normal(keys[7], (L, m, e), m),
     }
+    if c.post_norms:
+        layers['post_attn_norm'] = norm_init((L, e), dt)
+        layers['post_mlp_norm'] = norm_init((L, e), dt)
+    out = {
+        'embed': normal(keys[0], (c.vocab_size, e), e),
+        'layers': layers,
+        'final_norm': norm_init((e,), dt),
+    }
+    if not c.tied_embeddings:
+        out['lm_head'] = normal(keys[8], (e, c.vocab_size), e)
+    return out
 
 
 def _mesh_axes_size(mesh: Any, axes: Any) -> int:
@@ -180,10 +207,12 @@ def _embed_lookup(embed: jax.Array, tokens: jax.Array,
     return embed[tokens]
 
 
-def _rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+def _rms_norm(x: jax.Array, weight: jax.Array, eps: float,
+              plus_one: bool = False) -> jax.Array:
     x32 = x.astype(jnp.float32)
     var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
-    return (x32 * lax.rsqrt(var + eps)).astype(x.dtype) * weight
+    normed = (x32 * lax.rsqrt(var + eps)).astype(x.dtype)
+    return normed * (1.0 + weight) if plus_one else normed * weight
 
 
 def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
@@ -205,12 +234,14 @@ def _layer(x: jax.Array,
            layer_params: Params,
            config: LlamaConfig,
            positions: jax.Array,
-           mesh: Optional[Any]) -> jax.Array:
+           mesh: Optional[Any],
+           window: Optional[jax.Array] = None) -> jax.Array:
     c = config
     rules = None  # default rule table; callers can monkey-patch later
+    plus_one = c.norm_plus_one
 
     # --- attention block ---
-    h = _rms_norm(x, layer_params['attn_norm'], c.rms_norm_eps)
+    h = _rms_norm(x, layer_params['attn_norm'], c.rms_norm_eps, plus_one)
     q = jnp.einsum('bse,ehd->bshd', h, layer_params['wq'],
                    preferred_element_type=jnp.float32).astype(c.dtype)
     k = jnp.einsum('bse,ehd->bshd', h, layer_params['wk'],
@@ -221,25 +252,38 @@ def _layer(x: jax.Array,
     k = sharding.shard(k, ('batch', 'seq', 'kv_heads', 'head_dim'), rules)
     q = _rope(q, positions, c.rope_theta)
     k = _rope(k, positions, c.rope_theta)
+    if c.query_pre_attn_scalar is not None:
+        # attention scales by head_dim^-0.5; fold in the ratio so the
+        # effective scale is query_pre_attn_scalar^-0.5 (gemma2-27b).
+        q = q * math.sqrt(c.head_dim / c.query_pre_attn_scalar)
     attn = attention_ops.attention(
         q, k, v, causal=True, impl=c.attention_impl, mesh=mesh,
-        block_size=c.attention_block_size)
+        block_size=c.attention_block_size, window=window,
+        softcap=c.attn_logit_softcap)
     from jax.ad_checkpoint import checkpoint_name
     attn = checkpoint_name(attn, 'attn_out')
     attn_out = jnp.einsum('bshd,hde->bse', attn, layer_params['wo'],
                           preferred_element_type=jnp.float32).astype(c.dtype)
+    if c.post_norms:
+        attn_out = _rms_norm(attn_out, layer_params['post_attn_norm'],
+                             c.rms_norm_eps, plus_one)
     x = x + sharding.shard(attn_out, ('batch', 'seq', 'embed'), rules)
 
-    # --- mlp block (SwiGLU) ---
-    h = _rms_norm(x, layer_params['mlp_norm'], c.rms_norm_eps)
+    # --- mlp block (SwiGLU / GeGLU) ---
+    h = _rms_norm(x, layer_params['mlp_norm'], c.rms_norm_eps, plus_one)
     gate = jnp.einsum('bse,em->bsm', h, layer_params['w_gate'],
                       preferred_element_type=jnp.float32)
     up = jnp.einsum('bse,em->bsm', h, layer_params['w_up'],
                     preferred_element_type=jnp.float32)
-    act = (jax.nn.silu(gate) * up).astype(c.dtype)
+    act_fn = (functools.partial(jax.nn.gelu, approximate=True)
+              if c.activation == 'gelu' else jax.nn.silu)
+    act = (act_fn(gate) * up).astype(c.dtype)
     act = sharding.shard(act, ('batch', 'seq', 'mlp'), rules)
     down = jnp.einsum('bsm,me->bse', act, layer_params['w_down'],
                       preferred_element_type=jnp.float32).astype(c.dtype)
+    if c.post_norms:
+        down = _rms_norm(down, layer_params['post_mlp_norm'],
+                         c.rms_norm_eps, plus_one)
     return x + sharding.shard(down, ('batch', 'seq', 'embed'), rules)
 
 
@@ -253,6 +297,8 @@ def forward(params: Params,
     if positions is None:
         positions = jnp.arange(tokens.shape[1])
     x = _embed_lookup(params['embed'].astype(c.dtype), tokens, mesh)
+    if c.embed_scale:
+        x = x * jnp.asarray(math.sqrt(c.hidden_size), c.dtype)
     x = sharding.shard(x, ('batch', 'seq', 'embed'))
 
     layer_fn = functools.partial(_layer, config=c, positions=positions,
@@ -265,13 +311,36 @@ def forward(params: Params,
                 jax.checkpoint_policies.save_only_these_names('attn_out'))
         layer_fn = jax.checkpoint(layer_fn, policy=policy)
 
-    def scan_body(x, layer_params):
-        return layer_fn(x, layer_params), None
+    if c.sliding_window is None:
+        def scan_body(x, layer_params):
+            return layer_fn(x, layer_params), None
 
-    x, _ = lax.scan(scan_body, x, params['layers'])
-    x = _rms_norm(x, params['final_norm'], c.rms_norm_eps)
-    logits = jnp.einsum('bse,ev->bsv', x, params['lm_head'],
+        x, _ = lax.scan(scan_body, x, params['layers'])
+    else:
+        # Per-layer local/global alternation rides the scan as a
+        # traced window scalar (gemma2-style every-Nth-global; one
+        # compiled layer body, no unrolling).
+        idx = jnp.arange(c.num_layers)
+        is_global = ((idx + 1) % c.sliding_window_pattern == 0) \
+            if c.sliding_window_pattern > 1 else jnp.zeros_like(idx,
+                                                               jnp.bool_)
+        windows = jnp.where(is_global, jnp.int32(2**30),
+                            jnp.int32(c.sliding_window))
+
+        def scan_body(x, xs):
+            layer_params, window = xs
+            return layer_fn(x, layer_params, window=window), None
+
+        x, _ = lax.scan(scan_body, x, (params['layers'], windows))
+    x = _rms_norm(x, params['final_norm'], c.rms_norm_eps,
+                  c.norm_plus_one)
+    lm_head = (params['embed'].astype(c.dtype).T
+               if c.tied_embeddings else params['lm_head'])
+    logits = jnp.einsum('bse,ev->bsv', x, lm_head,
                         preferred_element_type=jnp.float32)
+    if c.final_logit_softcap is not None:
+        cap = c.final_logit_softcap
+        logits = cap * jnp.tanh(logits / cap)
     return sharding.shard(logits, ('batch', 'seq', 'vocab'))
 
 

@@ -1,0 +1,29 @@
+"""Mistral family — llama core + sliding-window attention everywhere.
+
+No reference equivalent (compute plane is additive; SURVEY.md §2.11).
+Mistral-7B is architecturally llama with a 4096-token sliding window
+on every layer (and vocab 32k, theta 10k); the window rides the
+shared core's `sliding_window` knob with pattern 1 (all local).
+"""
+import jax.numpy as jnp
+
+from skypilot_tpu.models import llama
+
+LlamaConfig = llama.LlamaConfig
+init_params = llama.init_params
+param_logical_axes = llama.param_logical_axes
+forward = llama.forward
+loss_fn = llama.loss_fn
+
+CONFIGS = {
+    'mistral-7b': LlamaConfig(
+        vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+        num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128,
+        max_seq_len=8192, rope_theta=10000.0, sliding_window=4096),
+    # CPU-test scale; window < seq so the mask matters.
+    'tiny-mistral': LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+        max_seq_len=128, dtype=jnp.float32, remat=False,
+        rope_theta=10000.0, sliding_window=16),
+}

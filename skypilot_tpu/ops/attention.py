@@ -42,11 +42,17 @@ def dense_attention(q: jax.Array,
                     v: jax.Array,
                     causal: bool = True,
                     q_offset: int = 0,
-                    kv_offset: int = 0) -> jax.Array:
+                    kv_offset: int = 0,
+                    window: Optional[Any] = None,
+                    softcap: Optional[float] = None) -> jax.Array:
     """Plain softmax attention; the correctness reference for the rest.
 
     q_offset/kv_offset are the global positions of element 0 — needed
     when sequence is sharded and this rank sees only a slice.
+    window: sliding-window size (Mistral/Gemma local layers) — position
+    q attends k iff q_pos - k_pos < window; may be a traced scalar so
+    alternating local/global layers stay inside one lax.scan. softcap:
+    Gemma-style attn-logit soft-capping, cap*tanh(scores/cap).
     """
     num_heads = q.shape[2]
     k = _repeat_kv(k, num_heads)
@@ -54,16 +60,24 @@ def dense_attention(q: jax.Array,
     scale = 1.0 / math.sqrt(q.shape[-1])
     scores = jnp.einsum('bqhd,bkhd->bhqk', q, k,
                         preferred_element_type=jnp.float32) * scale
+    if softcap is not None:
+        scores = softcap * jnp.tanh(scores / softcap)
+    q_pos = q_offset + jnp.arange(q.shape[1])
+    k_pos = kv_offset + jnp.arange(k.shape[1])
     if causal:
-        q_pos = q_offset + jnp.arange(q.shape[1])
-        k_pos = kv_offset + jnp.arange(k.shape[1])
         mask = q_pos[:, None] >= k_pos[None, :]
+        if window is not None:
+            mask = mask & (q_pos[:, None] - k_pos[None, :] < window)
+        scores = jnp.where(mask[None, None], scores, _NEG_INF)
+    elif window is not None:
+        mask = jnp.abs(q_pos[:, None] - k_pos[None, :]) < window
         scores = jnp.where(mask[None, None], scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     return jnp.einsum('bhqk,bkhd->bqhd', probs, v)
 
 
-def _block_update(q, k, v, scores_mask, acc_o, acc_m, acc_l):
+def _block_update(q, k, v, scores_mask, acc_o, acc_m, acc_l,
+                  softcap=None):
     """One online-softmax step: fold a KV block into the accumulators.
 
     acc_o: [B,Q,H,D] f32 weighted values; acc_m/acc_l: [B,H,Q] f32
@@ -72,6 +86,8 @@ def _block_update(q, k, v, scores_mask, acc_o, acc_m, acc_l):
     scale = 1.0 / math.sqrt(q.shape[-1])
     scores = jnp.einsum('bqhd,bkhd->bhqk', q, k,
                         preferred_element_type=jnp.float32) * scale
+    if softcap is not None:
+        scores = softcap * jnp.tanh(scores / softcap)
     if scores_mask is not None:
         scores = jnp.where(scores_mask, scores, _NEG_INF)
     block_max = jnp.max(scores, axis=-1)
@@ -101,9 +117,14 @@ def blockwise_attention(q: jax.Array,
                         causal: bool = True,
                         block_size: int = 512,
                         q_offset: int = 0,
-                        kv_offset: int = 0) -> jax.Array:
+                        kv_offset: int = 0,
+                        window: Optional[Any] = None,
+                        softcap: Optional[float] = None) -> jax.Array:
     """Memory-efficient attention: scan over KV blocks, never
     materializing the full [Q,K] score matrix. O(S) memory in sequence.
+
+    window/softcap: sliding-window mask and Gemma logit soft-capping
+    (see dense_attention).
     """
     b, q_len, num_heads, d = q.shape
     kv_len = k.shape[1]
@@ -129,8 +150,12 @@ def blockwise_attention(q: jax.Array,
             mask = mask & (q_pos[:, None] >= k_pos[None, :])
         else:
             mask = jnp.broadcast_to(mask, (q_len, block_size))
+        if window is not None:
+            mask = mask & (q_pos[:, None] - k_pos[None, :] < window)
+            if not causal:
+                mask = mask & (k_pos[None, :] - q_pos[:, None] < window)
         carry = _block_update(q, k_blk, v_blk, mask[None, None], acc_o,
-                              acc_m, acc_l)
+                              acc_m, acc_l, softcap=softcap)
         return carry, None
 
     acc = (jnp.zeros((b, q_len, num_heads, d), jnp.float32),
@@ -265,22 +290,37 @@ def attention(q: jax.Array,
               causal: bool = True,
               impl: str = 'dense',
               mesh: Optional[Any] = None,
-              block_size: int = 512) -> jax.Array:
-    """Dispatch: 'dense' | 'blockwise' | 'ring' | 'flash' (TPU pallas)."""
+              block_size: int = 512,
+              window: Optional[Any] = None,
+              softcap: Optional[float] = None) -> jax.Array:
+    """Dispatch: 'dense' | 'blockwise' | 'ring' | 'flash' (TPU pallas).
+
+    window/softcap (sliding-window local attention, Gemma logit
+    capping) are handled by the dense and blockwise paths; the flash
+    kernel falls back to blockwise when they're set, and ring rejects
+    them (a window never spans the context shards ring targets).
+    """
     if impl == 'ring':
         if mesh is None:
             raise ValueError('ring attention requires a mesh')
+        if window is not None or softcap is not None:
+            raise ValueError('ring attention does not support '
+                             'window/softcap; use blockwise')
         return ring_attention(q, k, v, mesh, causal=causal,
                               block_size=block_size)
-    if impl == 'blockwise':
+    if impl == 'blockwise' or (impl == 'flash' and
+                               (window is not None or
+                                softcap is not None)):
         return blockwise_attention(q, k, v, causal=causal,
-                                   block_size=block_size)
+                                   block_size=block_size,
+                                   window=window, softcap=softcap)
     if impl == 'flash':
         from skypilot_tpu.ops import flash_attention as fa
         return fa.flash_attention(q, k, v, causal,
                                   block_size, block_size)
     if impl == 'dense':
-        return dense_attention(q, k, v, causal=causal)
+        return dense_attention(q, k, v, causal=causal, window=window,
+                               softcap=softcap)
     raise ValueError(
         f'Unknown attention impl {impl!r}; '
         "expected 'dense' | 'blockwise' | 'ring' | 'flash'")

@@ -32,7 +32,13 @@ class Optimizer:
     def optimize(dag, minimize: OptimizeTarget = OptimizeTarget.COST,
                  blocked_resources: Optional[List] = None,
                  quiet: bool = False):
-        """Sets `task.best_resources` on every task in the dag."""
+        """Sets `task.best_resources` on every task in the dag.
+
+        COST minimizes Σ hourly cost + Σ egress $; TIME minimizes
+        Σ estimated runtime + Σ transfer seconds (reference optimizer
+        minimizes the same pair of objectives, sky/optimizer.py:109).
+        Both reduce to per-task argmin when no DAG edge carries data.
+        """
         dag.validate()
         order = dag.topological_order()
         per_task: Dict[int, List[Tuple[resources_lib.Resources, float]]] = {}
@@ -43,40 +49,68 @@ class Optimizer:
                 raise exceptions.ResourcesUnavailableError(
                     f'No launchable resources satisfy task {task.name!r}: '
                     f'{sorted(task.resources, key=repr)}')
+            if minimize == OptimizeTarget.TIME:
+                candidates = Optimizer._with_time_values(task, candidates)
             per_task[id(task)] = candidates
 
         edges = dag.edges
-        egress_relevant = minimize == OptimizeTarget.COST and any(
+        edge_fn = (Optimizer._transfer_seconds
+                   if minimize == OptimizeTarget.TIME
+                   else Optimizer._transfer_cost)
+        egress_relevant = any(
             (a.estimated_outputs_gigabytes or 0) > 0 for a, _ in edges)
         if egress_relevant and dag.is_chain():
-            Optimizer._optimize_by_dp(order, per_task)
+            Optimizer._optimize_by_dp(order, per_task, edge_fn)
         elif egress_relevant:
-            Optimizer._optimize_by_ilp(order, edges, per_task)
+            Optimizer._optimize_by_ilp(order, edges, per_task, edge_fn)
         else:
             # No egress-relevant edges: per-task argmin == global min.
             for task in order:
-                if minimize == OptimizeTarget.TIME:
-                    # Highest aggregate accelerator throughput, cheapest
-                    # on tie.
-                    best, cost = max(
-                        per_task[id(task)],
-                        key=lambda rc: (Optimizer._throughput(rc[0]),
-                                        -rc[1]))
-                else:
-                    best, cost = min(per_task[id(task)],
-                                     key=lambda rc: rc[1])
+                best, _ = min(per_task[id(task)], key=lambda rc: rc[1])
                 task.best_resources = best
         if not quiet:
             Optimizer._print_choice(order, per_task)
         return dag
 
+    @staticmethod
+    def _with_time_values(task, candidates):
+        """Re-value candidates as estimated runtime seconds.
+
+        With a user estimator (task.set_time_estimator) that is
+        authoritative. Otherwise assume fixed compute work calibrated
+        to 1 h on the highest-throughput candidate (the reference
+        assumes a flat 1 h when no estimator is set — scaling by
+        throughput keeps faster accelerators preferred). Ties (e.g.
+        CPU-only fleets, all throughput 0) fall to the cheaper
+        candidate via a negligible cost epsilon.
+        """
+        estimator = getattr(task, 'time_estimator_fn', None)
+        max_thr = max((Optimizer._throughput(res)
+                       for res, _ in candidates), default=0.0)
+        out = []
+        for res, cost in candidates:
+            if estimator is not None:
+                seconds = float(estimator(res))
+            elif max_thr <= 0:
+                seconds = 3600.0
+            else:
+                thr = Optimizer._throughput(res)
+                # Zero-throughput candidates in a GPU race get a huge
+                # FINITE penalty: scipy's MILP rejects inf coefficients.
+                seconds = (3600.0 * max_thr / thr if thr > 0
+                           else 3600.0 * 1e6)
+            out.append((res, seconds + cost * 1e-6))
+        return out
+
     # --- chain DP / DAG ILP (egress-aware placement) ------------------------
 
     @staticmethod
-    def _optimize_by_dp(order, per_task) -> float:
-        """Exact DP over a chain: minimize Σ hourly cost + Σ egress
-        (reference _optimize_by_dp, sky/optimizer.py:429). Returns the
-        optimal objective (for DP↔ILP equivalence tests)."""
+    def _optimize_by_dp(order, per_task, edge_fn=None) -> float:
+        """Exact DP over a chain: minimize Σ node values + Σ edge values
+        ($ for COST, seconds for TIME; reference _optimize_by_dp,
+        sky/optimizer.py:429). Returns the optimal objective (for
+        DP↔ILP equivalence tests)."""
+        edge_fn = edge_fn or Optimizer._transfer_cost
         cands = [per_task[id(t)] for t in order]
         # dp[j] = (best objective ending with candidate j, backpointer)
         dp = [(cost, None) for _, cost in cands[0]]
@@ -86,8 +120,7 @@ class Optimizer:
             nxt = []
             for res_j, cost_j in cands[i]:
                 best_val, best_k = min(
-                    ((history[-1][k][0] +
-                      Optimizer._transfer_cost(res_k, res_j, gb), k)
+                    ((history[-1][k][0] + edge_fn(res_k, res_j, gb), k)
                      for k, (res_k, _) in enumerate(cands[i - 1])),
                     key=lambda vk: vk[0])
                 nxt.append((best_val + cost_j, best_k))
@@ -104,7 +137,7 @@ class Optimizer:
     _ILP_MAX_CANDIDATES = 12
 
     @staticmethod
-    def _optimize_by_ilp(order, edges, per_task) -> float:
+    def _optimize_by_ilp(order, edges, per_task, edge_fn=None) -> float:
         """MILP over a general DAG (reference _optimize_by_ilp,
         sky/optimizer.py:490, which uses PuLP; ours uses scipy's HiGHS).
 
@@ -119,6 +152,7 @@ class Optimizer:
         from scipy import optimize as sp_opt
         from scipy import sparse
 
+        edge_fn = edge_fn or Optimizer._transfer_cost
         cands = {}
         for t in order:
             ranked = sorted(per_task[id(t)], key=lambda rc: rc[1])
@@ -145,7 +179,7 @@ class Optimizer:
             for cu, (res_u, _) in enumerate(cands[id(u)]):
                 for cv, (res_v, _) in enumerate(cands[id(v)]):
                     costs[y_off[e] + cu * n_v + cv] = \
-                        Optimizer._transfer_cost(res_u, res_v, gb)
+                        edge_fn(res_u, res_v, gb)
 
         rows, cols, vals, lo, hi = [], [], [], [], []
 
@@ -294,6 +328,26 @@ class Optimizer:
             return Optimizer._EGRESS_PER_GB_CROSS_CLOUD * gigabytes
         if src.region != dst.region:
             return Optimizer._EGRESS_PER_GB_CROSS_REGION * gigabytes
+        return 0.0
+
+    # Sustained inter-site bandwidth for the TIME target (GB/s):
+    # cross-cloud rides the public internet, cross-region the cloud's
+    # backbone (reference _egress_time assumes a flat per-pair
+    # bandwidth the same way).
+    _GBPS_CROSS_CLOUD = 0.25
+    _GBPS_CROSS_REGION = 1.25
+
+    @staticmethod
+    def _transfer_seconds(src: Optional[resources_lib.Resources],
+                          dst: resources_lib.Resources,
+                          gigabytes: float) -> float:
+        """Seconds to move `gigabytes` from src's placement to dst's."""
+        if src is None or gigabytes <= 0:
+            return 0.0
+        if src.cloud != dst.cloud:
+            return gigabytes / Optimizer._GBPS_CROSS_CLOUD
+        if src.region != dst.region:
+            return gigabytes / Optimizer._GBPS_CROSS_REGION
         return 0.0
 
     # --- display ------------------------------------------------------------

@@ -196,7 +196,11 @@ def query_instances(cluster_name_on_cloud: str,
                     ) -> Dict[str, Optional[str]]:
     client = do_adaptor.client()
     out: Dict[str, Optional[str]] = {}
-    for droplet in _cluster_droplets(client, cluster_name_on_cloud):
+    # Scope to the handle's region when known: names collide across
+    # regions after a failover, and a dying other-region droplet must
+    # not shadow the real node's status.
+    for droplet in _cluster_droplets(client, cluster_name_on_cloud,
+                                     region=provider_config.get('region')):
         state = _droplet_state(droplet)
         if state == 'terminated':
             continue
@@ -217,12 +221,14 @@ def _ips(droplet: Dict[str, Any]) -> Dict[str, Optional[str]]:
 
 def get_cluster_info(region: str, cluster_name_on_cloud: str,
                      provider_config: Dict[str, Any]) -> common.ClusterInfo:
-    del region
     client = do_adaptor.client()
     instances: Dict[str, common.InstanceInfo] = {}
     head_name = f'{cluster_name_on_cloud}-0'
     head_id: Optional[str] = None
-    for droplet in _cluster_droplets(client, cluster_name_on_cloud):
+    # Region-scoped: a same-name droplet lingering in a failed-over
+    # region must not supply the head IP.
+    for droplet in _cluster_droplets(client, cluster_name_on_cloud,
+                                     region=region):
         if _droplet_state(droplet) != 'running':
             continue
         name = droplet['name']

@@ -36,6 +36,8 @@ class AutostopConfig:
         if isinstance(cfg, (int, float)):
             return cls(enabled=True, idle_minutes=int(cfg))
         if isinstance(cfg, str):
+            if cfg.endswith('h'):
+                return cls(enabled=True, idle_minutes=60 * int(cfg[:-1]))
             return cls(enabled=True, idle_minutes=int(cfg.rstrip('m')))
         if isinstance(cfg, dict):
             return cls(enabled=bool(cfg.get('enabled', True)),
@@ -316,6 +318,8 @@ class Resources:
     def from_yaml_config(cls, config: Optional[Dict[str, Any]]) -> 'Resources':
         if config is None:
             return cls()
+        from skypilot_tpu.utils import schemas
+        schemas.validate_resources(config)
         config = dict(config)
         known = {
             'infra', 'accelerators', 'cpus', 'memory', 'instance_type',

@@ -182,18 +182,28 @@ class ServeController:
         new_ready = [r for r in new_live
                      if r['status'] == serve_state.ReplicaStatus.READY]
         # One surge replica at a time: launch a new-version replica if
-        # none is in flight. Retire an old replica only while doing so
-        # keeps (old + new_ready) at or above min_replicas — retiring
-        # one per tick merely because SOME new replica is ready would
-        # collapse serving capacity while later surges still boot.
+        # none is in flight. Retirement pacing counts only READY old
+        # replicas as capacity: retire dead weight (not-ready old)
+        # freely once replacements appear, but retire a READY old one
+        # only while (old_ready + new_ready) stays above min_replicas —
+        # retiring per tick merely because SOME new replica is ready
+        # would collapse serving capacity while later surges boot.
         if len(new_live) < self.spec.min_replicas + 1 and \
                 len(new_live) == len(new_ready):
             self.manager.scale_up(1)
-        if new_ready and \
-                len(old) + len(new_ready) > self.spec.min_replicas:
-            victims = sorted(old, key=lambda r: r['replica_id'])
-            self.manager.scale_down(
-                [victims[0]['replica_id']])
+        if new_ready:
+            old_ready = [r for r in old if r['status'] ==
+                         serve_state.ReplicaStatus.READY]
+            old_not_ready = [r for r in old if r['status'] !=
+                             serve_state.ReplicaStatus.READY]
+            if old_not_ready:
+                victim = min(old_not_ready,
+                             key=lambda r: r['replica_id'])
+                self.manager.scale_down([victim['replica_id']])
+            elif old_ready and len(old_ready) + len(new_ready) > \
+                    self.spec.min_replicas:
+                victim = min(old_ready, key=lambda r: r['replica_id'])
+                self.manager.scale_down([victim['replica_id']])
         return True
 
     def _shutdown(self) -> None:

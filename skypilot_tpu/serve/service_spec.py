@@ -53,6 +53,8 @@ class ServiceSpec:
 
     @classmethod
     def from_yaml_config(cls, cfg: Dict[str, Any]) -> 'ServiceSpec':
+        from skypilot_tpu.utils import schemas
+        schemas.validate_service(cfg)
         if 'readiness_probe' not in cfg:
             raise exceptions.InvalidTaskError(
                 'service: requires a readiness_probe')

@@ -44,6 +44,7 @@ def _load_task(payload: Dict[str, Any]):
 def launch(payload: Dict[str, Any]) -> Dict[str, Any]:
     from skypilot_tpu import execution
     task = _load_task(payload)
+    from skypilot_tpu import optimizer as optimizer_lib
     job_id, handle = execution.launch(
         task,
         cluster_name=payload['cluster_name'],
@@ -51,6 +52,8 @@ def launch(payload: Dict[str, Any]) -> Dict[str, Any]:
         stream_logs=True,
         detach_run=payload.get('detach_run', False),
         no_setup=payload.get('no_setup', False),
+        optimize_target=optimizer_lib.OptimizeTarget[
+            payload.get('minimize', 'COST')],
         retry_until_up=payload.get('retry_until_up', False))
     return {'job_id': job_id, 'handle': _serialize_handle(handle)}
 

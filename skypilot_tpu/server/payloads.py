@@ -45,6 +45,7 @@ SCHEMAS: Dict[str, Dict[str, Field]] = {
         'detach_run': _BOOL,
         'no_setup': _BOOL,
         'retry_until_up': _BOOL,
+        'minimize': _opt(str, choices=('COST', 'TIME'), default='COST'),
         'envs': _opt(dict),
     },
     'exec': {

@@ -18,13 +18,6 @@ _VALID_NAME_RE = re.compile(r'^[a-zA-Z0-9]([-_.a-zA-Z0-9]*[a-zA-Z0-9])?$')
 
 CommandOrGen = Union[None, str, Callable[[int, List[str]], Optional[str]]]
 
-_TASK_FIELDS = {
-    'name', 'workdir', 'setup', 'run', 'num_nodes', 'envs', 'secrets',
-    'outputs',
-    'file_mounts', 'resources', 'service',
-}
-
-
 class Task:
     """A coarse-grained unit of work: bash `setup` then bash `run`."""
 
@@ -55,6 +48,7 @@ class Task:
         self.estimated_outputs_gigabytes = estimated_outputs_gigabytes
         self.storage_mounts: Dict[str, Any] = {}
         self.service = None  # serve.SchemaSpec, set via set_service
+        self.time_estimator_fn = None  # set via set_time_estimator
         self.resources: Set[resources_lib.Resources] = {
             resources_lib.Resources()
         }
@@ -130,6 +124,14 @@ class Task:
         self.service = service
         return self
 
+    def set_time_estimator(self, fn: Callable[[Any], float]) -> 'Task':
+        """Estimator for the TIME optimize target: launchable
+        Resources -> estimated runtime in SECONDS (reference
+        sky/task.py set_time_estimator). Without one, the optimizer
+        assumes fixed work calibrated by accelerator throughput."""
+        self.time_estimator_fn = fn
+        return self
+
     # --- YAML ---------------------------------------------------------------
 
     @classmethod
@@ -139,10 +141,8 @@ class Task:
         if not isinstance(config, dict):
             raise exceptions.InvalidTaskError(
                 f'Task YAML must be a mapping, got {type(config).__name__}')
-        unknown = set(config) - _TASK_FIELDS
-        if unknown:
-            raise exceptions.InvalidTaskError(
-                f'Unknown task fields: {sorted(unknown)}')
+        from skypilot_tpu.utils import schemas
+        schemas.validate_task(config)
         envs = dict(config.get('envs') or {})
         for k, v in (env_overrides or {}).items():
             envs[k] = v

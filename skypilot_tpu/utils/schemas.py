@@ -1,0 +1,360 @@
+"""Declarative JSON-schema validation for every user-authored YAML.
+
+Reference analog: sky/utils/schemas.py (1457 LoC of jsonschema dicts
+validating task/config/resources YAML). Ours covers the same three
+user surfaces — task YAML, resources section, layered config files —
+plus the service and storage sub-sections, and reports EVERY problem
+in one error with its YAML path (the reference shows one at a time).
+
+These schemas validate *shape* (types, enums, unknown keys); semantic
+checks that need context (catalog lookups, capability gates, path
+existence) stay in the owning classes.
+"""
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+
+# --- building blocks -------------------------------------------------------
+
+_STR = {'type': 'string'}
+_BOOL = {'type': 'boolean'}
+_INT = {'type': 'integer'}
+_NUM = {'type': 'number'}
+_NULL_OK_STR = {'type': ['string', 'null']}
+# YAML authors write `cpus: 8`, `cpus: 8+`, `memory: 64`: accept both.
+_NUM_OR_STR = {'type': ['number', 'string']}
+_STR_DICT = {'type': 'object',
+             'additionalProperties': {
+                 'type': ['string', 'number', 'boolean', 'null']}}
+
+_ACCELERATORS = {
+    'oneOf': [
+        {'type': 'string'},                       # 'tpu-v5p:8', 'A100:1'
+        {'type': 'object',                        # {'tpu-v5p': 8}
+         'additionalProperties': {'type': ['number', 'integer']}},
+        {'type': 'null'},
+    ]
+}
+
+_AUTOSTOP = {
+    'oneOf': [
+        {'type': 'boolean'},                      # autostop: true
+        {'type': 'integer'},                      # autostop: 10 (minutes)
+        # '10m' / '2h' — must stay in sync with AutostopConfig
+        # .from_config's parser (resources.py).
+        {'type': 'string', 'pattern': r'^[0-9]+[mh]?$'},
+        {'type': 'object',
+         'additionalProperties': False,
+         'properties': {
+             'enabled': _BOOL,   # emitted by AutostopConfig.to_config
+             'idle_minutes': _INT,
+             'down': _BOOL,
+         }},
+    ]
+}
+
+_PORTS = {
+    'oneOf': [
+        {'type': ['string', 'integer']},
+        {'type': 'array', 'items': {'type': ['string', 'integer']}},
+        {'type': 'null'},
+    ]
+}
+
+
+def _resources_properties() -> Dict[str, Any]:
+    return {
+        'infra': _NULL_OK_STR,
+        # Back-compat sugar, folded into infra by Resources:
+        'cloud': _NULL_OK_STR,
+        'region': _NULL_OK_STR,
+        'zone': _NULL_OK_STR,
+        'accelerators': _ACCELERATORS,
+        'cpus': {**_NUM_OR_STR, 'type': ['number', 'string', 'null']},
+        'memory': {**_NUM_OR_STR, 'type': ['number', 'string', 'null']},
+        'instance_type': _NULL_OK_STR,
+        'use_spot': _BOOL,
+        'disk_size': _INT,
+        'disk_tier': {'enum': ['low', 'medium', 'high', 'best', None]},
+        'ports': _PORTS,
+        'image_id': _NULL_OK_STR,
+        'labels': _STR_DICT,
+        'autostop': _AUTOSTOP,
+        'job_recovery': {'type': ['string', 'object', 'null']},
+    }
+
+
+RESOURCES_SCHEMA: Dict[str, Any] = {
+    '$schema': 'https://json-schema.org/draft/2020-12/schema',
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        **_resources_properties(),
+        'any_of': {
+            'type': 'array',
+            'items': {
+                'type': 'object',
+                'additionalProperties': False,
+                'properties': _resources_properties(),
+            },
+        },
+    },
+}
+
+SERVICE_SCHEMA: Dict[str, Any] = {
+    'type': 'object',
+    'additionalProperties': False,
+    'required': ['readiness_probe'],
+    'properties': {
+        'readiness_probe': {
+            'oneOf': [
+                {'type': 'string'},               # path shorthand
+                {'type': 'object',
+                 'additionalProperties': False,
+                 'properties': {
+                     'path': _STR,
+                     'initial_delay_seconds': _NUM,
+                     'timeout_seconds': _NUM,
+                     'post_data': {'type': ['object', 'string']},
+                 }},
+            ]
+        },
+        'replica_port': _INT,
+        'replicas': _INT,
+        'load_balancing_policy': {'enum': ['round_robin', 'least_load']},
+        'replica_policy': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'min_replicas': _INT,
+                'max_replicas': _INT,
+                'target_qps_per_replica': _NUM,
+                'upscale_delay_seconds': _NUM,
+                'downscale_delay_seconds': _NUM,
+                'use_spot': _BOOL,
+                'spot_zones': {'type': 'array', 'items': _STR},
+                'base_ondemand_fallback_replicas': _INT,
+                'dynamic_ondemand_fallback': _BOOL,
+            },
+        },
+    },
+}
+
+STORAGE_SCHEMA: Dict[str, Any] = {
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        'name': _STR,
+        'source': _NULL_OK_STR,
+        'store': {'enum': ['gcs', 's3', 'azure', 'r2', 'local', None]},
+        'mode': {'enum': ['MOUNT', 'COPY', 'mount', 'copy']},
+        'persistent': _BOOL,
+    },
+}
+
+TASK_SCHEMA: Dict[str, Any] = {
+    '$schema': 'https://json-schema.org/draft/2020-12/schema',
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        'name': _NULL_OK_STR,
+        'workdir': _NULL_OK_STR,
+        'setup': _NULL_OK_STR,
+        'run': _NULL_OK_STR,
+        'num_nodes': _INT,
+        'envs': {'type': ['object', 'null'],
+                 'additionalProperties': {
+                     'type': ['string', 'number', 'boolean', 'null']}},
+        'secrets': {'type': ['object', 'null'],
+                    'additionalProperties': {
+                        'type': ['string', 'number', 'boolean', 'null']}},
+        'outputs': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {'estimated_size_gigabytes': _NUM},
+        },
+        'file_mounts': {
+            'type': ['object', 'null'],
+            'additionalProperties': {
+                'oneOf': [{'type': 'string'}, STORAGE_SCHEMA],
+            },
+        },
+        'resources': {'oneOf': [RESOURCES_SCHEMA, {'type': 'null'}]},
+        'service': SERVICE_SCHEMA,
+    },
+}
+
+_CONTROLLER_SECTION = {
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        'controller': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'mode': {'enum': ['consolidated', 'dedicated']},
+                'resources': RESOURCES_SCHEMA,
+            },
+        },
+        # 2-hop file-mount staging bucket (controller_utils).
+        'bucket': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'store': {'enum': ['gcs', 's3', 'azure', 'r2', 'local']},
+                'name': _STR,
+            },
+        },
+    },
+}
+
+CONFIG_SCHEMA: Dict[str, Any] = {
+    '$schema': 'https://json-schema.org/draft/2020-12/schema',
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        'allowed_clouds': {'type': 'array', 'items': _STR},
+        'admin_policy': _STR,
+        'api_server': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'endpoint': _STR,
+                'token': _STR,
+                'auth': _BOOL,
+                'users': {'type': 'array', 'items': {
+                    'type': 'object',
+                    'additionalProperties': False,
+                    'properties': {
+                        'name': _STR, 'token': _STR,
+                        'role': {'enum': ['admin', 'user', 'viewer']},
+                        'workspace': _STR,
+                    }}},
+            },
+        },
+        'gcp': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'project_id': _STR,
+                'network': _STR,
+                'subnetwork': _STR,
+                'use_internal_ips': _BOOL,
+            },
+        },
+        'aws': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'vpc_id': _STR,
+                'use_internal_ips': _BOOL,
+            },
+        },
+        'azure': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'subscription_id': _STR,
+                'use_internal_ips': _BOOL,
+            },
+        },
+        'nebius': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'project_id': _STR,
+                'subnet_id': _STR,
+            },
+        },
+        'kubernetes': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {'namespace': _STR},
+        },
+        'r2': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {'endpoint_url': _STR},
+        },
+        'ssh': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {'node_pools': {'type': 'object'}},
+        },
+        'jobs': _CONTROLLER_SECTION,
+        'serve': _CONTROLLER_SECTION,
+        'logs': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'store': {'enum': ['gcp', None]},
+                'gcp': {
+                    'type': 'object',
+                    'additionalProperties': False,
+                    'properties': {'project_id': _STR},
+                },
+            },
+        },
+        'usage': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'enabled': _BOOL,
+                'endpoint': _STR,
+            },
+        },
+    },
+}
+
+
+# --- validation driver ------------------------------------------------------
+
+def _format_error(err) -> str:
+    path = '.'.join(str(p) for p in err.absolute_path) or '<top level>'
+    msg = err.message
+    # 'additionalProperties' errors bury the offending key in prose;
+    # surface valid keys so typos are one-glance fixable.
+    if err.validator == 'additionalProperties':
+        allowed = sorted((err.schema.get('properties') or {}).keys())
+        if allowed:
+            msg += f'. Valid keys: {allowed}'
+    return f'{path}: {msg}'
+
+
+def validate(instance: Any, schema: Dict[str, Any], what: str,
+             exc_type: type = exceptions.InvalidTaskError) -> None:
+    """Validate `instance`, raising `exc_type` listing EVERY violation
+    (one pass fixes all typos, not one per run)."""
+    import jsonschema
+    validator = jsonschema.Draft202012Validator(schema)
+    errors = sorted(validator.iter_errors(instance),
+                    key=lambda e: list(e.absolute_path))
+    if not errors:
+        return
+    # oneOf failures produce an unhelpful umbrella message plus precise
+    # sub-errors; prefer the sub-errors.
+    lines: List[str] = []
+    for err in errors:
+        best = jsonschema.exceptions.best_match([err])
+        lines.append(_format_error(best if best is not None else err))
+    detail = '\n  '.join(dict.fromkeys(lines))  # dedupe, keep order
+    raise exc_type(f'Invalid {what}:\n  {detail}')
+
+
+def validate_task(config: Dict[str, Any]) -> None:
+    validate(config, TASK_SCHEMA, 'task YAML')
+
+
+def validate_resources(config: Dict[str, Any]) -> None:
+    validate(config, RESOURCES_SCHEMA, 'resources',
+             exceptions.InvalidResourcesError)
+
+
+def validate_service(config: Dict[str, Any]) -> None:
+    validate(config, SERVICE_SCHEMA, 'service spec')
+
+
+def validate_config(config: Dict[str, Any],
+                    path: Optional[str] = None) -> None:
+    what = f'config ({path})' if path else 'config'
+    validate(config, CONFIG_SCHEMA, what, exceptions.ConfigError)

@@ -19,30 +19,48 @@ def tiny():
     return config, params
 
 
+@pytest.fixture(scope='module')
+def engine2(tiny):
+    """Shared 2-slot engine: prefill/decode compile once for the
+    whole module (sampling params are per-request, not per-compile);
+    run_to_completion drains all slots so tests don't interfere."""
+    config, params = tiny
+    return inference.InferenceEngine(params, config, batch_size=2,
+                                     max_seq_len=64, seed=123)
+
+
+_REF_PAD = 32
+
+
 def _greedy_reference(params, config, prompt, steps):
-    """Argmax over a FULL forward pass each step (no cache)."""
+    """Argmax over a FULL forward pass each step (no cache).
+
+    Inputs are padded to one fixed length: the model is causal, so
+    suffix padding can't affect the position we read — and one shape
+    means ONE llama.forward compile for the whole module instead of
+    one per sequence length."""
     tokens = list(prompt)
     out = []
     for _ in range(steps):
-        arr = jnp.array([tokens], jnp.int32)
+        assert len(tokens) <= _REF_PAD
+        arr = jnp.array([tokens + [0] * (_REF_PAD - len(tokens))],
+                        jnp.int32)
         logits = llama.forward(params, arr, config)
-        nxt = int(jnp.argmax(logits[0, -1]))
+        nxt = int(jnp.argmax(logits[0, len(tokens) - 1]))
         out.append(nxt)
         tokens.append(nxt)
     return out
 
 
-def test_prefill_decode_matches_full_forward(tiny):
+def test_prefill_decode_matches_full_forward(tiny, engine2):
     config, params = tiny
     prompt = [3, 17, 42, 9, 105, 8]
     steps = 8
     ref = _greedy_reference(params, config, prompt, steps)
 
-    engine = inference.InferenceEngine(params, config, batch_size=2,
-                                       max_seq_len=64)
-    rid = engine.submit(prompt, inference.SamplingParams(
+    rid = engine2.submit(prompt, inference.SamplingParams(
         temperature=0.0, max_new_tokens=steps))
-    results = engine.run_to_completion()
+    results = engine2.run_to_completion()
     assert results[rid] == ref
 
 
@@ -65,30 +83,26 @@ def test_continuous_batching_multiple_requests(tiny):
         assert results[rid] == refs[idx], f'prompt {idx} diverged'
 
 
-def test_eos_stops_generation(tiny):
+def test_eos_stops_generation(tiny, engine2):
     config, params = tiny
     prompt = [3, 17, 42]
     ref = _greedy_reference(params, config, prompt, 12)
     eos = ref[2]  # pretend the 3rd generated token is EOS
-    engine = inference.InferenceEngine(params, config, batch_size=1,
-                                       max_seq_len=64)
-    rid = engine.submit(prompt, inference.SamplingParams(
+    rid = engine2.submit(prompt, inference.SamplingParams(
         temperature=0.0, max_new_tokens=12, eos_token_id=eos))
-    results = engine.run_to_completion()
+    results = engine2.run_to_completion()
     assert results[rid] == ref[:3]
     assert results[rid][-1] == eos
 
 
-def test_sampling_respects_top_k_one(tiny):
+def test_sampling_respects_top_k_one(tiny, engine2):
     """top_k=1 with temperature>0 must equal greedy."""
     config, params = tiny
     prompt = [5, 6, 7]
     ref = _greedy_reference(params, config, prompt, 4)
-    engine = inference.InferenceEngine(params, config, batch_size=1,
-                                       max_seq_len=64, seed=123)
-    rid = engine.submit(prompt, inference.SamplingParams(
+    rid = engine2.submit(prompt, inference.SamplingParams(
         temperature=0.8, top_k=1, max_new_tokens=4))
-    results = engine.run_to_completion()
+    results = engine2.run_to_completion()
     assert results[rid] == ref
 
 

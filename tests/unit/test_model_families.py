@@ -1,0 +1,153 @@
+"""Gemma + Mistral families on the shared transformer core.
+
+Oracles: sliding-window masking is verified against the fact that the
+first `window` positions of a causal sequence see identical context
+with or without the window (so logits match there and must diverge
+after); gemma mechanisms are verified structurally (tied embeddings,
+zero-init (1+w) norms, softcap bound) and by a decreasing train loss.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import gemma, llama, mistral, resolve
+from skypilot_tpu.ops import attention as attention_ops
+from skypilot_tpu.parallel import MeshSpec, make_mesh, use_mesh
+from skypilot_tpu.train import trainer
+
+
+# --- sliding window ---------------------------------------------------------
+
+def test_window_masks_long_range_context():
+    cfg = mistral.CONFIGS['tiny-mistral']          # window 16
+    assert cfg.sliding_window == 16
+    full = dataclasses.replace(cfg, sliding_window=None)
+    params = mistral.init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (1, 48), 0,
+                                cfg.vocab_size, jnp.int32)
+    lw = np.asarray(mistral.forward(params, tokens, cfg))
+    lf = np.asarray(llama.forward(params, tokens, full))
+    # Positions < window see the same context either way.
+    np.testing.assert_allclose(lw[:, :16], lf[:, :16], atol=1e-5,
+                               rtol=1e-5)
+    # Later positions lose distant context: logits must differ.
+    assert not np.allclose(lw[:, 32:], lf[:, 32:], atol=1e-4)
+
+
+def test_window_blockwise_matches_dense():
+    """The online-softmax path must agree with dense under a window
+    that crosses block boundaries."""
+    key = jax.random.key(3)
+    q = jax.random.normal(key, (2, 40, 4, 16), jnp.float32)
+    k = jax.random.normal(jax.random.key(4), (2, 40, 2, 16), jnp.float32)
+    v = jax.random.normal(jax.random.key(5), (2, 40, 2, 16), jnp.float32)
+    dense = attention_ops.dense_attention(q, k, v, causal=True,
+                                          window=12)
+    block = attention_ops.blockwise_attention(q, k, v, causal=True,
+                                              block_size=8, window=12)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(block),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_softcap_blockwise_matches_dense():
+    key = jax.random.key(6)
+    q = jax.random.normal(key, (1, 24, 2, 8), jnp.float32) * 3
+    k = jax.random.normal(jax.random.key(7), (1, 24, 2, 8),
+                          jnp.float32) * 3
+    v = jax.random.normal(jax.random.key(8), (1, 24, 2, 8), jnp.float32)
+    dense = attention_ops.dense_attention(q, k, v, causal=True,
+                                          softcap=5.0)
+    block = attention_ops.blockwise_attention(q, k, v, causal=True,
+                                              block_size=8, softcap=5.0)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(block),
+                               atol=2e-5, rtol=2e-5)
+    # Capping actually changes the result vs uncapped.
+    uncapped = attention_ops.dense_attention(q, k, v, causal=True)
+    assert not np.allclose(np.asarray(dense), np.asarray(uncapped),
+                           atol=1e-4)
+
+
+def test_ring_rejects_window():
+    mesh = make_mesh(MeshSpec(data=1, context=8, fsdp=1))
+    q = jnp.zeros((1, 16, 2, 8))
+    with pytest.raises(ValueError, match='window'):
+        attention_ops.attention(q, q, q, impl='ring', mesh=mesh,
+                                window=8)
+
+
+# --- gemma structure --------------------------------------------------------
+
+def test_gemma_param_structure():
+    cfg = gemma.CONFIGS['tiny-gemma']
+    params = gemma.init_params(cfg, jax.random.key(0))
+    assert 'lm_head' not in params                  # tied embeddings
+    assert 'post_attn_norm' in params['layers']     # gemma2 post-norms
+    # (1+w) norms start at zero.
+    assert float(jnp.abs(params['layers']['attn_norm']).max()) == 0.0
+    axes = gemma.param_logical_axes(cfg)
+    assert 'lm_head' not in axes
+    assert axes['layers']['post_mlp_norm'] == ('layers', 'embed')
+    # num_params matches the actual tree.
+    actual = sum(x.size for x in jax.tree.leaves(params))
+    assert actual == cfg.num_params()
+
+
+def test_gemma_forward_softcap_bound():
+    cfg = gemma.CONFIGS['tiny-gemma']
+    params = gemma.init_params(cfg, jax.random.key(1))
+    tokens = jax.random.randint(jax.random.key(2), (2, 32), 0,
+                                cfg.vocab_size, jnp.int32)
+    logits = np.asarray(gemma.forward(params, tokens, cfg))
+    assert np.isfinite(logits).all()
+    assert np.abs(logits).max() <= cfg.final_logit_softcap + 1e-4
+
+
+def test_inference_engine_rejects_unsupported_families():
+    """The cached decode path is llama-only today; gemma/mistral
+    configs must be rejected loudly, not silently mis-decoded."""
+    from skypilot_tpu import inference
+    cfg = gemma.CONFIGS['tiny-gemma']
+    params = gemma.init_params(cfg, jax.random.key(0))
+    with pytest.raises(NotImplementedError, match='sliding_window'):
+        inference.InferenceEngine(params, cfg, batch_size=1)
+    mcfg = mistral.CONFIGS['tiny-mistral']
+    mparams = mistral.init_params(mcfg, jax.random.key(0))
+    with pytest.raises(NotImplementedError, match='sliding_window'):
+        inference.InferenceEngine(mparams, mcfg, batch_size=1)
+
+
+def test_resolve_finds_all_families():
+    for name in ('gemma2-9b', 'mistral-7b', 'tiny-gemma',
+                 'tiny-mistral'):
+        family, cfg = resolve(name)
+        assert hasattr(family, 'forward')
+        assert cfg.num_layers > 0
+    with pytest.raises(ValueError, match='tiny-gemma'):
+        resolve('no-such-model')
+
+
+# --- end-to-end train steps -------------------------------------------------
+
+@pytest.mark.parametrize('model', [
+    'tiny-gemma',
+    # mistral = llama + window; the window itself is oracle-tested
+    # above, so the trainer integration is redundant in default runs.
+    pytest.param('tiny-mistral', marks=pytest.mark.slow),
+])
+def test_family_loss_decreases(model):
+    cfg = trainer.TrainerConfig(model=model, batch_size=4, seq_len=32,
+                                warmup_steps=1, learning_rate=1e-2,
+                                max_steps=10)
+    mesh = make_mesh(MeshSpec(data=2, fsdp=2, tensor=2))
+    state = trainer.make_train_state(cfg, mesh)
+    batch = trainer.synthetic_batch(cfg, mesh)
+    step = trainer.make_train_step(cfg, mesh)
+    with use_mesh(mesh):
+        losses = []
+        for _ in range(3):
+            state, metrics = step(state, batch)
+            losses.append(float(metrics['loss']))
+    assert losses[-1] < losses[0], losses

@@ -1,0 +1,133 @@
+"""TIME optimize target: runtime estimators, throughput model, and
+transfer-time-aware placement.
+
+Reference analog: sky/optimizer.py:109 (minimize=TIME path with
+egress time) and sky/task.py set_time_estimator.
+"""
+import random
+
+from skypilot_tpu import Dag, Resources, Task
+from skypilot_tpu.optimizer import Optimizer, OptimizeTarget
+
+
+def _gpu_task(name, outputs_gb=None):
+    t = Task(name, run='true')
+    t.estimated_outputs_gigabytes = outputs_gb
+    t.set_resources(Resources(any_of=[
+        {'accelerators': 'A100:8'}, {'accelerators': 'H100:8'}]))
+    return t
+
+
+def test_time_prefers_faster_accelerator(enable_clouds):
+    """On gcp/aws A100:8 is far cheaper than H100:8, so COST picks
+    A100; TIME picks H100 (3x TFLOPs)."""
+    enable_clouds('gcp', 'aws')
+    with Dag() as dag:
+        t = _gpu_task('t')
+        dag.add(t)
+    Optimizer.optimize(dag, quiet=True)
+    cost_pick = set(t.best_resources.accelerators)
+    assert cost_pick == {'A100'}
+
+    with Dag() as dag:
+        t2 = _gpu_task('t2')
+        dag.add(t2)
+    Optimizer.optimize(dag, minimize=OptimizeTarget.TIME, quiet=True)
+    assert set(t2.best_resources.accelerators) == {'H100'}
+
+
+def test_time_cpu_tie_breaks_on_cost(enable_clouds):
+    """All-zero throughput (CPU task): TIME degrades to cheapest."""
+    enable_clouds('gcp', 'aws', 'do')
+    with Dag() as dag:
+        t = Task('t', run='true')
+        t.set_resources(Resources(cpus=4))
+        dag.add(t)
+    Optimizer.optimize(dag, minimize=OptimizeTarget.TIME, quiet=True)
+    assert t.best_resources.cloud == 'do'  # cheapest 4-cpu row
+
+
+def test_time_estimator_is_authoritative(enable_clouds):
+    """A user estimator can invert the throughput ranking (e.g. a
+    memory-bound job that runs faster on A100-80GB fleets)."""
+    enable_clouds('gcp', 'aws')
+    with Dag() as dag:
+        t = _gpu_task('t')
+        t.set_time_estimator(
+            lambda res: 100.0 if 'A100' in res.accelerators else 900.0)
+        dag.add(t)
+    Optimizer.optimize(dag, minimize=OptimizeTarget.TIME, quiet=True)
+    assert set(t.best_resources.accelerators) == {'A100'}
+
+
+def test_transfer_time_forces_colocation(enable_clouds):
+    """10 TB between stages: the chain colocates under TIME even when
+    a remote candidate is nominally faster (cross-cloud at 0.25 GB/s
+    is 11 hours)."""
+    enable_clouds('gcp', 'aws')
+    with Dag() as dag:
+        a = Task('a', run='true')
+        a.estimated_outputs_gigabytes = 10000.0
+        a.set_resources(Resources(cpus=8))
+        # Estimator: 'a' much faster on gcp, 'b' much faster on aws —
+        # without transfer time they'd split clouds.
+        a.set_time_estimator(
+            lambda res: 60.0 if res.cloud == 'gcp' else 600.0)
+        b = Task('b', run='true')
+        b.set_resources(Resources(cpus=8))
+        b.set_time_estimator(
+            lambda res: 60.0 if res.cloud == 'aws' else 600.0)
+        dag.add_edge(a, b)
+    Optimizer.optimize(dag, minimize=OptimizeTarget.TIME, quiet=True)
+    assert a.best_resources.cloud == b.best_resources.cloud
+
+    # Tiny outputs: the 540 s saving per task beats the transfer, so
+    # the split placement wins.
+    with Dag() as dag:
+        a2 = Task('a2', run='true')
+        a2.estimated_outputs_gigabytes = 0.5
+        a2.set_resources(Resources(cpus=8))
+        a2.set_time_estimator(
+            lambda res: 60.0 if res.cloud == 'gcp' else 600.0)
+        b2 = Task('b2', run='true')
+        b2.set_resources(Resources(cpus=8))
+        b2.set_time_estimator(
+            lambda res: 60.0 if res.cloud == 'aws' else 600.0)
+        dag.add_edge(a2, b2)
+    Optimizer.optimize(dag, minimize=OptimizeTarget.TIME, quiet=True)
+    assert a2.best_resources.cloud == 'gcp'
+    assert b2.best_resources.cloud == 'aws'
+
+
+def test_time_dp_ilp_equivalent_on_random_chains(enable_clouds):
+    """DP and ILP reach the same optimum under the TIME objective."""
+    enable_clouds('gcp', 'aws')
+    rng = random.Random(11)
+    for trial in range(4):
+        length = rng.randint(2, 4)
+        tasks = []
+        with Dag() as dag:
+            for i in range(length):
+                t = Task(f't{trial}-{i}', run='true')
+                t.estimated_outputs_gigabytes = rng.choice(
+                    [0.0, 10.0, 5000.0])
+                t.set_resources(Resources(cpus=rng.choice([2, 8])))
+                salt = rng.random()
+                t.set_time_estimator(
+                    lambda res, s=salt: 60.0 + 500.0 * (
+                        (hash((res.cloud, res.region)) % 97) / 97 + s))
+                if tasks:
+                    dag.add_edge(tasks[-1], t)
+                else:
+                    dag.add(t)
+                tasks.append(t)
+        order = dag.topological_order()
+        per_task = {
+            id(t): Optimizer._with_time_values(
+                t, Optimizer._fill_in_launchable_resources(t))
+            for t in order}
+        dp = Optimizer._optimize_by_dp(
+            order, per_task, Optimizer._transfer_seconds)
+        ilp = Optimizer._optimize_by_ilp(
+            order, dag.edges, per_task, Optimizer._transfer_seconds)
+        assert abs(dp - ilp) < 1e-6, (trial, dp, ilp)

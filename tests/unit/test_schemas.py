@@ -1,0 +1,180 @@
+"""Schema validation for task / resources / service / config YAML.
+
+Reference analog: sky/utils/schemas.py (jsonschema for every
+user-authored YAML surface). Checks both acceptance of valid shapes
+and that errors carry the YAML path + every violation at once.
+"""
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.utils import schemas
+
+
+# --- task -------------------------------------------------------------------
+
+def test_full_task_yaml_accepted():
+    task = task_lib.Task.from_yaml_config({
+        'name': 'train',
+        'num_nodes': 2,
+        'setup': 'pip install -e .',
+        'run': 'python train.py',
+        'envs': {'LR': 3e-4, 'DEBUG': True},
+        'secrets': {'WANDB_KEY': 'k'},
+        'outputs': {'estimated_size_gigabytes': 10.5},
+        'file_mounts': {
+            '/data': '/tmp',
+            '/ckpts': {'name': 'my-bucket', 'store': 'gcs',
+                       'mode': 'MOUNT'},
+        },
+        'resources': {'accelerators': 'tpu-v5p:8', 'use_spot': True},
+    })
+    assert task.num_nodes == 2
+    assert task.envs['LR'] == '0.0003'
+
+
+def test_unknown_task_field_lists_valid_keys():
+    with pytest.raises(exceptions.InvalidTaskError) as e:
+        task_lib.Task.from_yaml_config({'run': 'x', 'reources': {}})
+    msg = str(e.value)
+    assert 'reources' in msg
+    assert 'resources' in msg  # valid keys listed for typo fixing
+
+
+def test_all_violations_reported_at_once():
+    with pytest.raises(exceptions.InvalidTaskError) as e:
+        task_lib.Task.from_yaml_config({
+            'num_nodes': 'three',
+            'outputs': {'estimated_size_gigabytes': 'big'},
+        })
+    msg = str(e.value)
+    assert 'num_nodes' in msg
+    assert 'outputs.estimated_size_gigabytes' in msg
+
+
+def test_wrong_nested_type_has_path():
+    with pytest.raises(exceptions.InvalidTaskError) as e:
+        task_lib.Task.from_yaml_config(
+            {'run': 'x', 'service': {'readiness_probe': {'path': 42}}})
+    assert 'readiness_probe' in str(e.value)
+
+
+# --- resources --------------------------------------------------------------
+
+def test_resources_shapes_accepted():
+    resources_lib.Resources.from_yaml_config({
+        'infra': 'gcp/us-central2', 'accelerators': {'tpu-v5e': 8},
+        'cpus': '8+', 'memory': 64, 'disk_tier': 'best',
+        'ports': [8080, '9000-9010'], 'autostop': {'idle_minutes': 10,
+                                                   'down': True},
+    })
+    resources_lib.Resources.from_yaml_config(
+        {'any_of': [{'infra': 'gcp'}, {'infra': 'aws',
+                                       'accelerators': 'A100:8'}]})
+
+
+def test_resources_bad_enum_and_unknown_key():
+    with pytest.raises(exceptions.InvalidResourcesError) as e:
+        resources_lib.Resources.from_yaml_config({'disk_tier': 'turbo'})
+    assert 'disk_tier' in str(e.value)
+    with pytest.raises(exceptions.InvalidResourcesError):
+        resources_lib.Resources.from_yaml_config({'acelerators': 'A100'})
+
+
+def test_resources_nested_any_of_validated():
+    with pytest.raises(exceptions.InvalidResourcesError) as e:
+        resources_lib.Resources.from_yaml_config(
+            {'any_of': [{'use_spot': 'yes'}]})
+    assert 'any_of' in str(e.value)
+
+
+# --- service ----------------------------------------------------------------
+
+def test_service_schema():
+    schemas.validate_service({
+        'readiness_probe': {'path': '/health',
+                            'initial_delay_seconds': 30},
+        'replica_port': 8000,
+        'replica_policy': {'min_replicas': 1, 'max_replicas': 3,
+                           'target_qps_per_replica': 5},
+    })
+    with pytest.raises(exceptions.InvalidTaskError):
+        schemas.validate_service({})  # readiness_probe required
+    with pytest.raises(exceptions.InvalidTaskError) as e:
+        schemas.validate_service({
+            'readiness_probe': '/',
+            'replica_policy': {'min_repicas': 1}})
+    assert 'min_repicas' in str(e.value)
+
+
+# --- config -----------------------------------------------------------------
+
+def test_config_schema_valid():
+    schemas.validate_config({
+        'allowed_clouds': ['gcp', 'local'],
+        'gcp': {'project_id': 'p', 'use_internal_ips': False},
+        'nebius': {'project_id': 'proj-1'},
+        'jobs': {'controller': {'mode': 'dedicated',
+                                'resources': {'cpus': 4}}},
+        'api_server': {'auth': True,
+                       'users': [{'name': 'a', 'token': 't',
+                                  'role': 'admin',
+                                  'workspace': 'team-x'}]},
+        'logs': {'store': 'gcp', 'gcp': {'project_id': 'p'}},
+    })
+
+
+def test_config_schema_rejects_typo_with_path():
+    with pytest.raises(exceptions.ConfigError) as e:
+        schemas.validate_config({'gcp': {'projct_id': 'p'}})
+    assert 'gcp' in str(e.value) and 'projct_id' in str(e.value)
+    with pytest.raises(exceptions.ConfigError):
+        schemas.validate_config({'jobs': {'controller': {'mode': 'bad'}}})
+
+
+def test_autostop_roundtrip_and_duration_strings():
+    """AutostopConfig.to_config output must re-validate (the serve
+    controller re-parses task_yaml), and the '2h' form the schema
+    advertises must parse."""
+    r = resources_lib.Resources(autostop={'idle_minutes': 10,
+                                          'down': True})
+    task = task_lib.Task('t', run='x')
+    task.set_resources(r)
+    cfg = task.to_yaml_config()
+    assert cfg['resources']['autostop']['enabled'] is True
+    task_lib.Task.from_yaml_config(cfg)  # round-trip validates
+    r2 = resources_lib.Resources.from_yaml_config({'autostop': '2h'})
+    assert r2.autostop.idle_minutes == 120
+    with pytest.raises(exceptions.InvalidResourcesError):
+        resources_lib.Resources.from_yaml_config({'autostop': 'soon'})
+
+
+def test_config_keys_the_code_reads_are_valid():
+    """Every config key read via get_nested anywhere in the codebase
+    must be accepted by CONFIG_SCHEMA (strict additionalProperties
+    would otherwise reject working user configs)."""
+    schemas.validate_config({
+        'kubernetes': {'namespace': 'ml'},
+        'jobs': {'bucket': {'store': 'gcs', 'name': 'staging'}},
+        'serve': {'controller': {'mode': 'consolidated'}},
+        'ssh': {'node_pools': {'pool': {'hosts': []}}},
+        'r2': {'endpoint_url': 'https://x.r2.cloudflarestorage.com'},
+        'aws': {'vpc_id': 'vpc-1', 'use_internal_ips': True},
+        'azure': {'subscription_id': 's', 'use_internal_ips': False},
+        'admin_policy': 'mymod.Policy',
+        'usage': {'enabled': False},
+    })
+
+
+def test_config_file_load_validates(tmp_path, monkeypatch):
+    bad = tmp_path / 'config.yaml'
+    bad.write_text('gcp:\n  project: wrong-key\n')
+    monkeypatch.setenv('SKYTPU_CONFIG', str(bad))
+    from skypilot_tpu import config as config_lib
+    config_lib.reload()
+    with pytest.raises(exceptions.ConfigError) as e:
+        config_lib.get_nested(('gcp', 'project_id'))
+    assert 'project' in str(e.value)
+    monkeypatch.delenv('SKYTPU_CONFIG')
+    config_lib.reload()

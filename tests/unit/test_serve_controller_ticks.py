@@ -30,16 +30,21 @@ def _spec(min_replicas=3, **policy):
 
 class FakeManager:
     """Replica bookkeeping straight into serve_state; probes are the
-    tests' job (set_replica_status)."""
+    tests' job (set_replica_status). Mirrors the real ReplicaManager's
+    scale_up contract: use_spot=None means the SPEC default, so the
+    rolling-update surge of a spot service lands in the spot pool."""
 
     def __init__(self, service_name):
         self.service_name = service_name
         self.version = 1
+        self.spec_use_spot = False
 
     def probe_all(self):
         pass
 
-    def scale_up(self, n=1, use_spot=False):
+    def scale_up(self, n=1, use_spot=None):
+        if use_spot is None:
+            use_spot = self.spec_use_spot
         for _ in range(n):
             rid = serve_state.next_replica_id(self.service_name)
             serve_state.add_replica(self.service_name, rid,
@@ -207,6 +212,28 @@ def test_stalled_update_does_not_pin_scaled_up_fleet(ctl):
     assert len(live) == ctl.spec.min_replicas + 1, _statuses()
 
 
+def test_rollout_prefers_not_ready_old_victims(ctl):
+    """A not-ready old replica (e.g. mid-recovery) is retired before
+    any READY old one, and a READY old is kept while it is needed to
+    hold ready capacity at min_replicas."""
+    ctl.manager.scale_up(3)            # v1: 1,2,3
+    _mark_ready(1, 3)                  # 2 stuck PROVISIONING
+    serve_state.set_service_version(SVC, 2, {'run': 'true'})
+    ctl.manager.version = 2
+    ctl._step()                        # surge 4
+    _mark_ready(4)
+    ctl._step()                        # retires the NOT-READY old (2)
+    assert 2 not in _live_ids()
+    assert {1, 3} <= set(_live_ids())
+    # old_ready(2) + new_ready(1) == min(3): no READY old may go yet.
+    for _ in range(2):
+        ctl._step()
+        for r in serve_state.get_replicas(SVC):
+            if r['version'] == 2 and r['status'] == R.PROVISIONING:
+                break
+    assert {1, 3} <= set(_live_ids()), _statuses()
+
+
 def test_spike_during_stalled_update_is_bounded(ctl):
     """Autoscaler-spawned replicas carry the new version too; the
     surge protection must be capped at the rollout's entitlement
@@ -239,6 +266,7 @@ def test_mixed_pools_respect_surge_protection(ctl):
     ctl.spec = _spec(use_spot=True, base_ondemand_fallback_replicas=1,
                      dynamic_ondemand_fallback=True)
     ctl.autoscaler = autoscalers.make_autoscaler(ctl.spec)
+    ctl.manager.spec_use_spot = True   # surge defaults to the spot pool
     # 3 spot + 1 on-demand base, all ready.
     ctl.manager.scale_up(3, use_spot=True)
     ctl.manager.scale_up(1, use_spot=False)
@@ -250,6 +278,11 @@ def test_mixed_pools_respect_surge_protection(ctl):
     ctl.manager.version = 2
     ctl._step()                        # spot surge v2
     new = set(_live_ids()) - baseline
+    assert new, 'surge expected'
+    new_rows = [r for r in serve_state.get_replicas(SVC)
+                if r['replica_id'] in new]
+    assert all(r['use_spot'] for r in new_rows), \
+        'surge must land in the SPOT pool'
     for _ in range(3):
         ctl._step()
         assert new <= set(_live_ids()), _statuses()
