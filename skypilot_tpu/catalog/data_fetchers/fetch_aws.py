@@ -1,0 +1,166 @@
+"""Generate the AWS VM catalog CSV from the public EC2 offers files.
+
+Reference analog: sky/catalog/data_fetchers/fetch_aws.py (boto3
+pricing API). Ours reads the UNAUTHENTICATED per-region offer JSON
+(pricing.us-east-1.amazonaws.com/offers/...) for on-demand prices —
+no credentials needed to refresh the catalog — and, when credentials
+exist, asks DescribeSpotPriceHistory through the same injectable EC2
+client the provisioner uses for current spot prices.
+
+Usage:
+    python -m skypilot_tpu.catalog.data_fetchers.fetch_aws \
+        --regions us-east-1 us-west-2 --out-dir .../data/aws
+"""
+import argparse
+import csv
+import json
+import os
+import urllib.request
+from typing import Any, Callable, Dict, List, Optional
+
+OFFERS_URL = ('https://pricing.us-east-1.amazonaws.com/offers/v1.0'
+              '/aws/AmazonEC2/current/{region}/index.json')
+
+# Instance shapes the catalog models; (accelerator, count) per type.
+# The offers file carries thousands of shapes — curate the same
+# families the shipped CSV uses so the catalog stays reviewable.
+INSTANCE_ACCELERATORS: Dict[str, Any] = {
+    'm6i.large': None, 'm6i.xlarge': None, 'm6i.2xlarge': None,
+    'm6i.4xlarge': None, 'm6i.8xlarge': None,
+    'c6i.4xlarge': None, 'r6i.4xlarge': None,
+    'g5.xlarge': ('A10G', 1), 'g5.12xlarge': ('A10G', 4),
+    'g5.48xlarge': ('A10G', 8),
+    'p4d.24xlarge': ('A100-80GB', 8),
+    'p5.48xlarge': ('H100', 8),
+}
+
+
+def _http_get_json(url: str) -> Dict[str, Any]:
+    with urllib.request.urlopen(url, timeout=120) as resp:
+        return json.load(resp)
+
+
+def fetch_offers(region: str,
+                 http_get: Optional[Callable[[str], Dict[str, Any]]]
+                 = None) -> Dict[str, Any]:
+    return (http_get or _http_get_json)(
+        OFFERS_URL.format(region=region))
+
+
+def _ondemand_price(offers: Dict[str, Any], sku: str) -> Optional[float]:
+    terms = offers.get('terms', {}).get('OnDemand', {}).get(sku, {})
+    for term in terms.values():
+        for dim in term.get('priceDimensions', {}).values():
+            usd = dim.get('pricePerUnit', {}).get('USD')
+            if usd is not None and float(usd) > 0:
+                return float(usd)
+    return None
+
+
+def fetch_vm_rows(region: str, offers: Dict[str, Any],
+                  spot_prices: Optional[Dict[str, float]] = None
+                  ) -> List[Dict[str, Any]]:
+    """vms.csv rows for one region from its offers file."""
+    rows: List[Dict[str, Any]] = []
+    for sku, product in offers.get('products', {}).items():
+        attrs = product.get('attributes', {})
+        itype = attrs.get('instanceType')
+        if itype not in INSTANCE_ACCELERATORS:
+            continue
+        # One clean dimension: Linux, shared tenancy, no pre-installed
+        # software, 'Used' capacity (reference filters identically).
+        if (attrs.get('operatingSystem') != 'Linux'
+                or attrs.get('tenancy') != 'Shared'
+                or attrs.get('preInstalledSw') not in (None, 'NA')
+                or attrs.get('capacitystatus') not in (None, 'Used')):
+            continue
+        price = _ondemand_price(offers, sku)
+        if price is None:
+            continue
+        acc = INSTANCE_ACCELERATORS[itype]
+        memory = attrs.get('memory', '0 GiB').split()[0].replace(
+            ',', '')
+        spot = (spot_prices or {}).get(itype)
+        rows.append({
+            'instance_type': itype,
+            'accelerator_name': acc[0] if acc else '',
+            'accelerator_count': acc[1] if acc else 0,
+            'cpus': int(attrs.get('vcpu', 0)),
+            'memory_gb': float(memory),
+            'price': round(price, 4),
+            'spot_price': round(spot, 4) if spot is not None else '',
+            'region': region,
+            'zone': f'{region}a',
+        })
+    # The offers file repeats instanceType across reservation options;
+    # keep the cheapest row per type.
+    best: Dict[str, Dict[str, Any]] = {}
+    for row in rows:
+        cur = best.get(row['instance_type'])
+        if cur is None or row['price'] < cur['price']:
+            best[row['instance_type']] = row
+    return sorted(best.values(), key=lambda r: r['instance_type'])
+
+
+def fetch_spot_prices(region: str) -> Dict[str, float]:
+    """Current spot price per instance type via the EC2 API (needs
+    credentials; callers treat failures as 'no spot column')."""
+    from skypilot_tpu.adaptors import aws as aws_adaptor
+    client = aws_adaptor.client(region)
+    params = {'ProductDescription.1': 'Linux/UNIX',
+              'MaxResults': '500'}
+    for i, itype in enumerate(sorted(INSTANCE_ACCELERATORS), 1):
+        params[f'InstanceType.{i}'] = itype
+    resp = client.call('DescribeSpotPriceHistory', params)
+    out: Dict[str, float] = {}
+    items = resp.get('spotPriceHistorySet', {})
+    items = items.get('item', []) if isinstance(items, dict) else items
+    if isinstance(items, dict):
+        items = [items]
+    for item in items:
+        itype = item.get('instanceType')
+        try:
+            price = float(item.get('spotPrice', ''))
+        except ValueError:
+            continue
+        if itype and (itype not in out or price < out[itype]):
+            out[itype] = price
+    return out
+
+
+def write_vm_csv(rows: List[Dict[str, Any]], path: str) -> int:
+    with open(path, 'w', newline='', encoding='utf-8') as f:
+        writer = csv.DictWriter(
+            f, fieldnames=['instance_type', 'accelerator_name',
+                           'accelerator_count', 'cpus', 'memory_gb',
+                           'price', 'spot_price', 'region', 'zone'])
+        writer.writeheader()
+        for row in rows:
+            writer.writerow(row)
+    return len(rows)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    default_out = os.path.join(os.path.dirname(__file__), '..', 'data',
+                               'aws')
+    parser.add_argument('--regions', nargs='+',
+                        default=['us-east-1', 'us-west-2'])
+    parser.add_argument('--out-dir', default=default_out)
+    args = parser.parse_args()
+    os.makedirs(args.out_dir, exist_ok=True)
+    all_rows: List[Dict[str, Any]] = []
+    for region in args.regions:
+        offers = fetch_offers(region)
+        spot: Optional[Dict[str, float]] = None
+        try:
+            spot = fetch_spot_prices(region)
+        except Exception as e:  # noqa: BLE001 — spot is best-effort
+            print(f'{region}: spot prices unavailable ({e})')
+        all_rows.extend(fetch_vm_rows(region, offers, spot))
+    n = write_vm_csv(all_rows, os.path.join(args.out_dir, 'vms.csv'))
+    print(f'wrote {n} rows to {args.out_dir}/vms.csv')
+
+
+if __name__ == '__main__':
+    main()

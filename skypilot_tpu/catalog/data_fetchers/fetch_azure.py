@@ -1,0 +1,135 @@
+"""Generate the Azure VM catalog CSV from the public Retail Prices API.
+
+Reference analog: sky/catalog/data_fetchers/fetch_azure.py (azure SDK
++ auth). Ours reads prices.azure.com/api/retail/prices — public,
+unauthenticated, paginated via NextPageLink — and joins against a
+curated spec table (the retail API carries prices only, not
+vCPU/memory shapes). Spot rows come from the same feed ('Spot' meter
+names).
+
+Usage:
+    python -m skypilot_tpu.catalog.data_fetchers.fetch_azure \
+        --regions eastus westus2 --out-dir .../data/azure
+"""
+import argparse
+import csv
+import json
+import os
+import urllib.parse
+import urllib.request
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+RETAIL_URL = 'https://prices.azure.com/api/retail/prices'
+
+# armSkuName -> (cpus, memory_gb, accelerator, count). Prices join
+# against this; unknown SKUs in the feed are skipped.
+VM_SPECS: Dict[str, Tuple[int, float, Optional[str], int]] = {
+    'Standard_D2s_v5': (2, 8, None, 0),
+    'Standard_D4s_v5': (4, 16, None, 0),
+    'Standard_D8s_v5': (8, 32, None, 0),
+    'Standard_D16s_v5': (16, 64, None, 0),
+    'Standard_D32s_v5': (32, 128, None, 0),
+    'Standard_E8s_v5': (8, 64, None, 0),
+    'Standard_NC24ads_A100_v4': (24, 220, 'A100-80GB', 1),
+    'Standard_NC96ads_A100_v4': (96, 880, 'A100-80GB', 4),
+    'Standard_ND96isr_H100_v5': (96, 1900, 'H100', 8),
+}
+
+
+def _http_get_json(url: str) -> Dict[str, Any]:
+    req = urllib.request.Request(url, headers={'User-Agent': 'skytpu'})
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        return json.load(resp)
+
+
+def fetch_retail_items(region: str,
+                       http_get: Optional[
+                           Callable[[str], Dict[str, Any]]] = None
+                       ) -> List[Dict[str, Any]]:
+    """All Consumption VM price items for one region (paginated)."""
+    http_get = http_get or _http_get_json
+    filt = ("serviceName eq 'Virtual Machines' and "
+            f"armRegionName eq '{region}' and "
+            "priceType eq 'Consumption'")
+    url = f'{RETAIL_URL}?{urllib.parse.urlencode({"$filter": filt})}'
+    items: List[Dict[str, Any]] = []
+    while url:
+        page = http_get(url)
+        items.extend(page.get('Items', []))
+        url = page.get('NextPageLink') or ''
+    return items
+
+
+def fetch_vm_rows(region: str, items: List[Dict[str, Any]]
+                  ) -> List[Dict[str, Any]]:
+    """vms.csv rows: join retail prices with the spec table; 'Spot'
+    meters fill the spot column, Windows and Low Priority are
+    excluded (reference applies the same filters)."""
+    prices: Dict[str, Dict[str, float]] = {}
+    for item in items:
+        sku = item.get('armSkuName', '')
+        if sku not in VM_SPECS:
+            continue
+        if 'Windows' in item.get('productName', ''):
+            continue
+        meter = item.get('meterName', '')
+        if 'Low Priority' in meter:
+            continue
+        price = float(item.get('retailPrice', 0) or 0)
+        if price <= 0 or item.get('unitOfMeasure') != '1 Hour':
+            continue
+        kind = 'spot' if 'Spot' in meter else 'ondemand'
+        slot = prices.setdefault(sku, {})
+        if kind not in slot or price < slot[kind]:
+            slot[kind] = price
+
+    rows: List[Dict[str, Any]] = []
+    for sku, kinds in sorted(prices.items()):
+        if 'ondemand' not in kinds:
+            continue
+        cpus, mem, acc, count = VM_SPECS[sku]
+        rows.append({
+            'instance_type': sku,
+            'accelerator_name': acc or '',
+            'accelerator_count': count,
+            'cpus': cpus, 'memory_gb': mem,
+            'price': round(kinds['ondemand'], 4),
+            'spot_price': (round(kinds['spot'], 4)
+                           if 'spot' in kinds else ''),
+            'region': region,
+            'zone': '',  # Azure zones aren't modeled (see azure_catalog)
+        })
+    return rows
+
+
+def write_vm_csv(rows: List[Dict[str, Any]], path: str) -> int:
+    with open(path, 'w', newline='', encoding='utf-8') as f:
+        writer = csv.DictWriter(
+            f, fieldnames=['instance_type', 'accelerator_name',
+                           'accelerator_count', 'cpus', 'memory_gb',
+                           'price', 'spot_price', 'region', 'zone'])
+        writer.writeheader()
+        for row in rows:
+            writer.writerow(row)
+    return len(rows)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    default_out = os.path.join(os.path.dirname(__file__), '..', 'data',
+                               'azure')
+    parser.add_argument('--regions', nargs='+',
+                        default=['eastus', 'westus2'])
+    parser.add_argument('--out-dir', default=default_out)
+    args = parser.parse_args()
+    os.makedirs(args.out_dir, exist_ok=True)
+    all_rows: List[Dict[str, Any]] = []
+    for region in args.regions:
+        all_rows.extend(fetch_vm_rows(region,
+                                      fetch_retail_items(region)))
+    n = write_vm_csv(all_rows, os.path.join(args.out_dir, 'vms.csv'))
+    print(f'wrote {n} rows to {args.out_dir}/vms.csv')
+
+
+if __name__ == '__main__':
+    main()

@@ -270,16 +270,20 @@ class InferenceEngine:
         # The cached decode path implements the llama architecture;
         # reject family knobs it would silently get wrong (windowed
         # cache masking, GeGLU, post-norms, softcaps are future work).
+        # getattr: non-llama config classes (MoeConfig) lack these
+        # fields entirely — absent must read as 'default', not crash.
         unsupported = {
-            'activation': config.activation != 'silu',
-            'tied_embeddings': config.tied_embeddings,
-            'embed_scale': config.embed_scale,
-            'norm_plus_one': config.norm_plus_one,
-            'post_norms': config.post_norms,
-            'attn_logit_softcap': config.attn_logit_softcap is not None,
+            'activation': getattr(config, 'activation', 'silu') != 'silu',
+            'tied_embeddings': getattr(config, 'tied_embeddings', False),
+            'embed_scale': getattr(config, 'embed_scale', False),
+            'norm_plus_one': getattr(config, 'norm_plus_one', False),
+            'post_norms': getattr(config, 'post_norms', False),
+            'attn_logit_softcap':
+                getattr(config, 'attn_logit_softcap', None) is not None,
             'final_logit_softcap':
-                config.final_logit_softcap is not None,
-            'sliding_window': config.sliding_window is not None,
+                getattr(config, 'final_logit_softcap', None) is not None,
+            'sliding_window':
+                getattr(config, 'sliding_window', None) is not None,
         }
         bad = sorted(k for k, v in unsupported.items() if v)
         if bad:
@@ -307,6 +311,24 @@ class InferenceEngine:
     def finished(self) -> Dict[int, List[int]]:
         out, self._finished = self._finished, {}
         return out
+
+    def active_progress(self) -> Dict[int, List[int]]:
+        """request_id -> tokens generated so far for in-flight slots
+        (snapshot copies) — the server's token-streaming feed."""
+        return {s.request_id: list(s.generated)
+                for s in self.state.slots if s is not None}
+
+    def abort_all(self) -> None:
+        """Drop every queued and in-flight request (server error
+        recovery): slots free, cache lengths zeroed, nothing reported
+        as finished."""
+        self._queue.clear()
+        self._finished.clear()
+        for i, slot in enumerate(self.state.slots):
+            if slot is not None:
+                self.state.slots[i] = None
+                self.state.cache['length'] = \
+                    self.state.cache['length'].at[i].set(0)
 
     @property
     def has_work(self) -> bool:

@@ -6,6 +6,15 @@ The TPU-native replacement for `vllm serve ...` in reference recipes
   POST /generate          -> {"prompt_tokens": [...], "max_new_tokens": N,
                               "temperature": t, "top_k": k}
                              => {"tokens": [...]}
+                             with "stream": true => SSE: one
+                             `data: {"token": t}` per generated token,
+                             then `data: {"done": true, "tokens": [...]}`.
+
+Concurrency model (JetStream-style): ONE engine loop thread owns the
+TPU. HTTP handlers enqueue requests; the loop drains the queue before
+every step so new requests join the running decode batch mid-flight —
+continuous batching across concurrent HTTP requests, not serialized
+whole generations. Per-step progress snapshots feed token streaming.
 
 Token-id interface: tokenization happens client-side (transformers is
 available on dev boxes; the serving host stays tokenizer-free and the
@@ -14,45 +23,163 @@ engine stays model-agnostic).
 import argparse
 import asyncio
 import json
+import queue
 import threading
-from typing import Any, Dict
+from typing import Any, Dict, List, Optional
+
+
+class EngineLoop:
+    """Single thread owning the engine: submit via queue, results and
+    per-token progress delivered to per-request asyncio queues."""
+
+    class Watcher:
+        def __init__(self, loop: asyncio.AbstractEventLoop,
+                     stream: bool) -> None:
+            self.loop = loop
+            self.stream = stream
+            self.q: asyncio.Queue = asyncio.Queue()
+            self.sent = 0
+
+        def push(self, item) -> None:
+            self.loop.call_soon_threadsafe(self.q.put_nowait, item)
+
+    def __init__(self, engine) -> None:
+        self.engine = engine
+        self._submit_q: 'queue.Queue' = queue.Queue()
+        self._watchers: Dict[int, EngineLoop.Watcher] = {}
+        self._stop = False
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def submit(self, prompt: List[int], sampling,
+               stream: bool = False) -> 'EngineLoop.Watcher':
+        """Called from async handlers; returns the watcher whose queue
+        yields ('token', t)* then ('done', [tokens])."""
+        watcher = self.Watcher(asyncio.get_running_loop(), stream)
+        self._submit_q.put((prompt, sampling, watcher))
+        return watcher
+
+    def stop(self) -> None:
+        self._stop = True
+
+    def _drain_submissions(self) -> None:
+        while True:
+            try:
+                prompt, sampling, watcher = self._submit_q.get_nowait()
+            except queue.Empty:
+                return
+            rid = self.engine.submit(prompt, sampling)
+            self._watchers[rid] = watcher
+
+    def _run(self) -> None:
+        while not self._stop:
+            try:
+                self._tick()
+            except Exception as e:  # noqa: BLE001
+                # The engine thread must survive any step/prefill
+                # error (device OOM, transient XLA failure): fail the
+                # in-flight requests with an 'error' event — handlers
+                # turn it into a 500 — and keep serving. A dead loop
+                # thread would hang every request forever while
+                # /health kept saying ok.
+                for watcher in self._watchers.values():
+                    watcher.push(('error', str(e)))
+                self._watchers.clear()
+                try:
+                    self.engine.abort_all()
+                except Exception:  # noqa: BLE001 — keep the thread up
+                    pass
+
+    def _tick(self) -> None:
+        self._drain_submissions()
+        if not self.engine.has_work:
+            # Park on the queue instead of spinning the TPU thread.
+            try:
+                item = self._submit_q.get(timeout=0.2)
+            except queue.Empty:
+                return
+            self._submit_q.put(item)
+            return
+        self.engine.step()
+        progress = self.engine.active_progress()
+        finished = self.engine.finished()
+        for rid, tokens in {**progress, **finished}.items():
+            watcher = self._watchers.get(rid)
+            if watcher is not None and watcher.stream:
+                for t in tokens[watcher.sent:]:
+                    watcher.push(('token', t))
+                watcher.sent = len(tokens)
+        for rid, tokens in finished.items():
+            watcher = self._watchers.pop(rid, None)
+            if watcher is not None:
+                watcher.push(('done', tokens))
+
+
+def _parse_sampling(body: Dict[str, Any]):
+    from skypilot_tpu import inference as inf
+    return inf.SamplingParams(
+        temperature=float(body.get('temperature', 0.0)),
+        top_k=int(body.get('top_k', 0)),
+        max_new_tokens=int(body.get('max_new_tokens', 64)),
+        eos_token_id=body.get('eos_token_id'))
 
 
 def create_app(engine_holder: Dict[str, Any]):
     from aiohttp import web
 
     async def health(request):
-        ok = engine_holder.get('engine') is not None
+        ok = engine_holder.get('loop') is not None
         return web.json_response({'status': 'ok' if ok else 'loading'},
                                  status=200 if ok else 503)
 
     async def generate(request):
-        engine = engine_holder.get('engine')
-        if engine is None:
+        engine_loop: Optional[EngineLoop] = engine_holder.get('loop')
+        if engine_loop is None:
             return web.json_response({'error': 'model loading'},
                                      status=503)
         try:
             body = await request.json()
             prompt = [int(t) for t in body['prompt_tokens']]
+            sampling = _parse_sampling(body)
         except (json.JSONDecodeError, KeyError, TypeError, ValueError):
             return web.json_response(
-                {'error': 'need {"prompt_tokens": [ints]}'}, status=400)
-        from skypilot_tpu import inference as inf
-        params = inf.SamplingParams(
-            temperature=float(body.get('temperature', 0.0)),
-            top_k=int(body.get('top_k', 0)),
-            max_new_tokens=int(body.get('max_new_tokens', 64)),
-            eos_token_id=body.get('eos_token_id'))
-        lock: threading.Lock = engine_holder['lock']
-        loop = asyncio.get_running_loop()
+                {'error': 'need {"prompt_tokens": [ints]} with numeric '
+                          'sampling fields'}, status=400)
+        stream = bool(body.get('stream', False))
+        watcher = engine_loop.submit(prompt, sampling, stream=stream)
 
-        def _run():
-            with lock:
-                rid = engine.submit(prompt, params)
-                results = engine.run_to_completion()
-            return results[rid]
-        tokens = await loop.run_in_executor(None, _run)
-        return web.json_response({'tokens': tokens})
+        if not stream:
+            while True:
+                kind, payload = await watcher.q.get()
+                if kind == 'done':
+                    return web.json_response({'tokens': payload})
+                if kind == 'error':
+                    return web.json_response({'error': payload},
+                                             status=500)
+
+        resp = web.StreamResponse(headers={
+            'Content-Type': 'text/event-stream',
+            'Cache-Control': 'no-cache'})
+        await resp.prepare(request)
+        while True:
+            kind, payload = await watcher.q.get()
+            if kind == 'token':
+                await resp.write(
+                    f'data: {json.dumps({"token": payload})}\n\n'
+                    .encode())
+            elif kind == 'error':
+                await resp.write(
+                    f'data: {json.dumps({"error": payload})}\n\n'
+                    .encode())
+                break
+            else:
+                await resp.write(
+                    ('data: '
+                     f'{json.dumps({"done": True, "tokens": payload})}'
+                     '\n\n').encode())
+                break
+        await resp.write_eof()
+        return resp
 
     app = web.Application()
     app.router.add_get('/health', health)
@@ -65,7 +192,7 @@ def main() -> None:
     from aiohttp import web
     parser = argparse.ArgumentParser()
     parser.add_argument('--model', default='tiny',
-                        help='Config name from models.llama.CONFIGS')
+                        help='Config name resolvable by models.resolve')
     parser.add_argument('--port', type=int, default=8080)
     parser.add_argument('--batch-size', type=int, default=8)
     parser.add_argument('--max-seq-len', type=int, default=None)
@@ -73,21 +200,22 @@ def main() -> None:
                         help='Orbax checkpoint dir with model params')
     args = parser.parse_args()
 
-    holder: Dict[str, Any] = {'engine': None, 'lock': threading.Lock()}
+    holder: Dict[str, Any] = {'loop': None}
 
     def _load():
         import jax
         from skypilot_tpu import inference as inf
-        from skypilot_tpu.models import llama
-        config = llama.CONFIGS[args.model]
+        from skypilot_tpu import models as models_lib
+        family, config = models_lib.resolve(args.model)
         if args.checkpoint:
             from skypilot_tpu.train import checkpoints
             params = checkpoints.restore_params(args.checkpoint, config)
         else:
-            params = llama.init_params(config, jax.random.key(0))
-        holder['engine'] = inf.InferenceEngine(
+            params = family.init_params(config, jax.random.key(0))
+        engine = inf.InferenceEngine(
             params, config, batch_size=args.batch_size,
             max_seq_len=args.max_seq_len)
+        holder['loop'] = EngineLoop(engine)
 
     threading.Thread(target=_load, daemon=True).start()
     web.run_app(create_app(holder), port=args.port, print=None)

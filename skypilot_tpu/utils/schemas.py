@@ -22,7 +22,7 @@ _INT = {'type': 'integer'}
 _NUM = {'type': 'number'}
 _NULL_OK_STR = {'type': ['string', 'null']}
 # YAML authors write `cpus: 8`, `cpus: 8+`, `memory: 64`: accept both.
-_NUM_OR_STR = {'type': ['number', 'string']}
+_NUM_OR_STR = {'type': ['number', 'string', 'null']}
 _STR_DICT = {'type': 'object',
              'additionalProperties': {
                  'type': ['string', 'number', 'boolean', 'null']}}
@@ -70,8 +70,8 @@ def _resources_properties() -> Dict[str, Any]:
         'region': _NULL_OK_STR,
         'zone': _NULL_OK_STR,
         'accelerators': _ACCELERATORS,
-        'cpus': {**_NUM_OR_STR, 'type': ['number', 'string', 'null']},
-        'memory': {**_NUM_OR_STR, 'type': ['number', 'string', 'null']},
+        'cpus': _NUM_OR_STR,
+        'memory': _NUM_OR_STR,
         'instance_type': _NULL_OK_STR,
         'use_spot': _BOOL,
         'disk_size': _INT,

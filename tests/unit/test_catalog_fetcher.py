@@ -88,6 +88,129 @@ def test_commitment_skus_excluded(tmp_path):
     assert rows[0]['price_per_chip'] == pytest.approx(1.2)
 
 
+class TestAwsFetcher:
+    """fetch_aws against a canned offers file (reference tests mock
+    the boto3 pricing client the same way)."""
+
+    OFFERS = {
+        'products': {
+            'SKU1': {'attributes': {
+                'instanceType': 'm6i.2xlarge', 'vcpu': '8',
+                'memory': '32 GiB', 'operatingSystem': 'Linux',
+                'tenancy': 'Shared', 'preInstalledSw': 'NA',
+                'capacitystatus': 'Used'}},
+            # Windows twin must be filtered out even though cheaper.
+            'SKU2': {'attributes': {
+                'instanceType': 'm6i.2xlarge', 'vcpu': '8',
+                'memory': '32 GiB', 'operatingSystem': 'Windows',
+                'tenancy': 'Shared', 'preInstalledSw': 'NA',
+                'capacitystatus': 'Used'}},
+            'SKU3': {'attributes': {
+                'instanceType': 'p4d.24xlarge', 'vcpu': '96',
+                'memory': '1,152 GiB', 'operatingSystem': 'Linux',
+                'tenancy': 'Shared', 'preInstalledSw': 'NA',
+                'capacitystatus': 'Used'}},
+            'SKU4': {'attributes': {   # not in the curated set
+                'instanceType': 'x2gd.medium', 'vcpu': '1',
+                'memory': '16 GiB', 'operatingSystem': 'Linux',
+                'tenancy': 'Shared'}},
+        },
+        'terms': {'OnDemand': {
+            'SKU1': {'T': {'priceDimensions': {'D': {
+                'pricePerUnit': {'USD': '0.384'}}}}},
+            'SKU2': {'T': {'priceDimensions': {'D': {
+                'pricePerUnit': {'USD': '0.10'}}}}},
+            'SKU3': {'T': {'priceDimensions': {'D': {
+                'pricePerUnit': {'USD': '32.7726'}}}}},
+            'SKU4': {'T': {'priceDimensions': {'D': {
+                'pricePerUnit': {'USD': '0.0835'}}}}},
+        }},
+    }
+
+    def test_rows_filtered_and_mapped(self):
+        from skypilot_tpu.catalog.data_fetchers import fetch_aws
+        rows = fetch_aws.fetch_vm_rows(
+            'us-east-1', self.OFFERS,
+            spot_prices={'p4d.24xlarge': 9.83})
+        by_type = {r['instance_type']: r for r in rows}
+        assert set(by_type) == {'m6i.2xlarge', 'p4d.24xlarge'}
+        m6i = by_type['m6i.2xlarge']
+        assert m6i['price'] == 0.384 and m6i['cpus'] == 8
+        assert m6i['spot_price'] == ''   # none supplied
+        p4d = by_type['p4d.24xlarge']
+        assert p4d['accelerator_name'] == 'A100-80GB'
+        assert p4d['accelerator_count'] == 8
+        assert p4d['memory_gb'] == 1152.0
+        assert p4d['spot_price'] == 9.83
+
+    def test_csv_write(self, tmp_path):
+        from skypilot_tpu.catalog.data_fetchers import fetch_aws
+        rows = fetch_aws.fetch_vm_rows('us-east-1', self.OFFERS)
+        path = tmp_path / 'vms.csv'
+        assert fetch_aws.write_vm_csv(rows, str(path)) == 2
+        import pandas as pd
+        df = pd.read_csv(path)
+        assert list(df['instance_type']) == ['m6i.2xlarge',
+                                             'p4d.24xlarge']
+
+
+class TestAzureFetcher:
+    """fetch_azure against canned Retail Prices pages."""
+
+    ITEMS = [
+        {'armSkuName': 'Standard_D8s_v5', 'retailPrice': 0.384,
+         'meterName': 'D8s v5', 'productName': 'Dsv5 Series',
+         'unitOfMeasure': '1 Hour'},
+        {'armSkuName': 'Standard_D8s_v5', 'retailPrice': 0.092,
+         'meterName': 'D8s v5 Spot', 'productName': 'Dsv5 Series',
+         'unitOfMeasure': '1 Hour'},
+        # Windows & Low Priority must not leak into the columns.
+        {'armSkuName': 'Standard_D8s_v5', 'retailPrice': 0.05,
+         'meterName': 'D8s v5', 'productName': 'Dsv5 Series Windows',
+         'unitOfMeasure': '1 Hour'},
+        {'armSkuName': 'Standard_D8s_v5', 'retailPrice': 0.01,
+         'meterName': 'D8s v5 Low Priority',
+         'productName': 'Dsv5 Series', 'unitOfMeasure': '1 Hour'},
+        {'armSkuName': 'Standard_NC24ads_A100_v4', 'retailPrice': 3.67,
+         'meterName': 'NC24ads A100 v4',
+         'productName': 'NCads A100 v4 Series',
+         'unitOfMeasure': '1 Hour'},
+        {'armSkuName': 'Standard_Unknown_v9', 'retailPrice': 1.0,
+         'meterName': 'x', 'productName': 'x',
+         'unitOfMeasure': '1 Hour'},
+    ]
+
+    def test_rows_joined_with_specs(self):
+        from skypilot_tpu.catalog.data_fetchers import fetch_azure
+        rows = fetch_azure.fetch_vm_rows('eastus', self.ITEMS)
+        by_type = {r['instance_type']: r for r in rows}
+        assert set(by_type) == {'Standard_D8s_v5',
+                                'Standard_NC24ads_A100_v4'}
+        d8 = by_type['Standard_D8s_v5']
+        assert d8['price'] == 0.384 and d8['spot_price'] == 0.092
+        nc = by_type['Standard_NC24ads_A100_v4']
+        assert nc['accelerator_name'] == 'A100-80GB'
+        assert nc['spot_price'] == ''
+
+    def test_pagination_followed(self):
+        from skypilot_tpu.catalog.data_fetchers import fetch_azure
+        pages = {
+            'first': {'Items': self.ITEMS[:2], 'NextPageLink': 'second'},
+            'second': {'Items': self.ITEMS[2:]},
+        }
+        calls = []
+
+        def fake_get(url):
+            key = ('first' if 'prices.azure.com' in url else url)
+            calls.append(key)
+            return pages[key]
+
+        items = fetch_azure.fetch_retail_items('eastus',
+                                               http_get=fake_get)
+        assert len(items) == len(self.ITEMS)
+        assert calls == ['first', 'second']
+
+
 class TestVmFetcher:
 
     def test_vm_rows_assembled_from_core_ram_gpu_skus(self, monkeypatch):
