@@ -9,7 +9,6 @@ stream its log. The server process is shared by all clients on a machine
 import asyncio
 import json
 import os
-import urllib.parse
 from typing import Any, Dict
 
 import skypilot_tpu
@@ -127,124 +126,51 @@ async def _handle_stream(request):
 
 
 async def _handle_dashboard(request):
-    """Minimal server-rendered dashboard: clusters / managed jobs /
-    services / recent requests (reference ships a 15k-LoC Next.js app;
-    this is the read-only core of it)."""
+    """The SPA shell with initial state embedded (dashboard.py).
+
+    Built in an executor: summary() may probe cloud credentials
+    (subprocesses with multi-second timeouts) on a cold cache, which
+    must not stall the event loop for every concurrent route."""
     from aiohttp import web
-    import html as html_lib
 
-    def _rows(items, cols):
-        out = ''
-        for item in items:
-            cells = ''
-            for c in cols:
-                value = str(item.get(c, ''))
-                if c != 'logs':  # logs cells carry trusted <a> markup
-                    value = html_lib.escape(value)
-                cells += f'<td>{value}</td>'
-            out += f'<tr>{cells}</tr>'
-        return out or f'<tr><td colspan={len(cols)}>none</td></tr>'
-
-    from skypilot_tpu import state as cluster_state
-    # Dashboard is the admin view: show every workspace.
-    clusters = [{
-        'name': r['name'], 'workspace': r['workspace'],
-        'status': r['status'].value,
-        'resources': r['resources_str'], 'nodes': r['num_nodes'],
-    } for r in cluster_state.get_clusters(all_workspaces=True)]
-
-    jobs: list = []
-    try:
-        from skypilot_tpu.jobs import state as jobs_state
-        jobs = [{
-            'id': j['job_id'], 'name': j['name'],
-            'status': j['status'].value,
-            'recoveries': j['recovery_count'],
-            'logs': f'<a href="/dashboard/jobs/{j["job_id"]}/log">'
-                    'view</a>',
-        } for j in jobs_state.get_jobs()]
-    except Exception:  # noqa: BLE001
-        pass
-
-    services: list = []
-    try:
-        from skypilot_tpu.serve import serve_state
-        services = [{
-            'name': s['name'], 'status': s['status'].value,
-            'endpoint': f'http://127.0.0.1:{s["lb_port"]}',
-            'logs': ('<a href="/dashboard/services/'
-                     + urllib.parse.quote(str(s['name']), safe='')
-                     + '/log">view</a>'),
-        } for s in serve_state.get_services()]
-    except Exception:  # noqa: BLE001
-        pass
-
-    reqs = [{
-        'id': r['request_id'], 'name': r['name'],
-        'status': r['status'].value,
-        'logs': f'<a href="/dashboard/requests/{r["request_id"]}/log">'
-                'view</a>',
-    } for r in requests_db.list_requests(25)]
-
-    def _table(title, items, cols):
-        head = ''.join(f'<th>{c}</th>' for c in cols)
-        return (f'<h2>{title}</h2><table border=1 cellpadding=4 '
-                f'cellspacing=0><tr>{head}</tr>{_rows(items, cols)}'
-                '</table>')
-
-    body = (
-        '<html><head><title>skypilot-tpu</title>'
-        '<meta http-equiv="refresh" content="10"></head><body>'
-        f'<h1>skypilot-tpu v{skypilot_tpu.__version__}</h1>'
-        + _table('Clusters', clusters,
-                 ['name', 'workspace', 'status', 'resources', 'nodes'])
-        + _table('Managed jobs', jobs,
-                 ['id', 'name', 'status', 'recoveries', 'logs'])
-        + _table('Services', services,
-                 ['name', 'status', 'endpoint', 'logs'])
-        + _table('Recent requests', reqs,
-                 ['id', 'name', 'status', 'logs'])
-        + '</body></html>')
-    return web.Response(text=body, content_type='text/html')
+    from skypilot_tpu.server import dashboard
+    loop = asyncio.get_running_loop()
+    text = await loop.run_in_executor(None, dashboard.page)
+    return web.Response(text=text, content_type='text/html')
 
 
-def _tail_file(path: str, limit: int = 200_000) -> str:
-    """Last `limit` bytes of a file without reading the whole thing."""
-    try:
-        with open(path, 'rb') as f:
-            f.seek(0, os.SEEK_END)
-            size = f.tell()
-            f.seek(max(0, size - limit))
-            return f.read().decode('utf-8', errors='replace')
-    except FileNotFoundError:
-        return '(no log yet)'
+async def _handle_dashboard_summary(request):
+    from skypilot_tpu.server import dashboard
+    loop = asyncio.get_running_loop()
+    return _json_response(await loop.run_in_executor(None,
+                                                     dashboard.summary))
 
 
-def _log_page(title: str, text: str) -> str:
-    import html as html_lib
-    return (
-        '<html><head><title>' + html_lib.escape(title) + '</title>'
-        '<meta http-equiv="refresh" content="5"></head>'
-        '<body style="font-family:monospace">'
-        f'<h2>{html_lib.escape(title)}</h2>'
-        '<a href="/dashboard">&larr; dashboard</a>'
-        f'<pre>{html_lib.escape(text)}</pre>'
-        '</body></html>')
+def _log_response(request, title: str, path: str):
+    """JS-polling log viewer page, or the raw tail for ?raw=1 (what
+    the page's poller fetches)."""
+    from aiohttp import web
+
+    from skypilot_tpu.server import dashboard
+    text = dashboard.tail_file(path)
+    if request.query.get('raw'):
+        return web.Response(text=text, content_type='text/plain')
+    return web.Response(text=dashboard.log_page(title, text),
+                        content_type='text/html')
 
 
 async def _handle_request_log(request):
     """Log viewer for one API request (reference dashboard's xterm log
-    viewer, served as auto-refreshing HTML here)."""
+    viewer)."""
     from aiohttp import web
     request_id = request.match_info['request_id']
     record = requests_db.get_request(request_id)
     if record is None:
         raise web.HTTPNotFound(text='No such request')
-    text = _tail_file(requests_db.request_log_path(request_id))
     title = f'request {request_id} [{record["name"]}] ' \
             f'{record["status"].value}'
-    return web.Response(text=_log_page(title, text),
-                        content_type='text/html')
+    return _log_response(request, title,
+                         requests_db.request_log_path(request_id))
 
 
 async def _handle_job_log(request):
@@ -258,11 +184,10 @@ async def _handle_job_log(request):
     record = jobs_state.get_job(job_id)
     if record is None:
         raise web.HTTPNotFound(text='No such managed job')
-    text = _tail_file(jobs_state.controller_log_path(job_id))
     title = f'managed job {job_id} [{record["name"]}] ' \
             f'{record["status"].value}'
-    return web.Response(text=_log_page(title, text),
-                        content_type='text/html')
+    return _log_response(request, title,
+                         jobs_state.controller_log_path(job_id))
 
 
 async def _handle_service_log(request):
@@ -272,9 +197,8 @@ async def _handle_service_log(request):
     from skypilot_tpu.serve import serve_state
     if serve_state.get_service(name) is None:
         raise web.HTTPNotFound(text='No such service')
-    text = _tail_file(serve_state.controller_log_path(name))
-    return web.Response(text=_log_page(f'service {name}', text),
-                        content_type='text/html')
+    return _log_response(request, f'service {name}',
+                         serve_state.controller_log_path(name))
 
 
 async def _handle_health(request):
@@ -308,6 +232,8 @@ def create_app():
     app.on_startup.append(_recover_orphans)
     app.router.add_get(f'{API_PREFIX}/health', _handle_health)
     app.router.add_get('/dashboard', _handle_dashboard)
+    app.router.add_get('/dashboard/api/summary',
+                       _handle_dashboard_summary)
     app.router.add_get('/dashboard/requests/{request_id}/log',
                        _handle_request_log)
     app.router.add_get('/dashboard/jobs/{job_id}/log', _handle_job_log)

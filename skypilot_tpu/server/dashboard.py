@@ -1,0 +1,231 @@
+"""Dashboard: single-file SPA served by the API server.
+
+Reference analog: sky/dashboard/src/ (15.4k-LoC Next.js app with
+clusters/jobs/services/infra pages and an xterm log viewer). Ours is
+a dependency-free single-file app — the server renders one HTML shell
+with the initial state embedded, and vanilla JS re-fetches
+`/dashboard/api/summary` every few seconds for live tables plus a
+polling log viewer with follow. No build step: the whole UI ships in
+this module, works from `tsky api start` with zero assets.
+"""
+import json
+import os
+from typing import Any, Dict, List
+
+import skypilot_tpu
+from skypilot_tpu.server import requests_db
+
+
+def summary() -> Dict[str, Any]:
+    """Everything the SPA shows, in one JSON document."""
+    from skypilot_tpu import state as cluster_state
+    clusters = [{
+        'name': r['name'], 'workspace': r['workspace'],
+        'status': r['status'].value, 'resources': r['resources_str'],
+        'nodes': r['num_nodes'],
+    } for r in cluster_state.get_clusters(all_workspaces=True)]
+
+    jobs: List[Dict[str, Any]] = []
+    try:
+        from skypilot_tpu.jobs import state as jobs_state
+        jobs = [{
+            'id': j['job_id'], 'name': j['name'],
+            'status': j['status'].value,
+            'recoveries': j['recovery_count'],
+            'log': f'/dashboard/jobs/{j["job_id"]}/log',
+        } for j in jobs_state.get_jobs()]
+    except Exception:  # noqa: BLE001 — jobs DB may not exist yet
+        pass
+
+    services: List[Dict[str, Any]] = []
+    try:
+        import urllib.parse
+        from skypilot_tpu.serve import serve_state
+        services = [{
+            'name': s['name'], 'status': s['status'].value,
+            'endpoint': f'http://127.0.0.1:{s["lb_port"]}',
+            'log': ('/dashboard/services/'
+                    + urllib.parse.quote(str(s['name']), safe='')
+                    + '/log'),
+        } for s in serve_state.get_services()]
+    except Exception:  # noqa: BLE001
+        pass
+
+    requests = [{
+        'id': r['request_id'], 'name': r['name'],
+        'status': r['status'].value,
+        'log': f'/dashboard/requests/{r["request_id"]}/log',
+    } for r in requests_db.list_requests(50)]
+
+    infra: List[Dict[str, Any]] = []
+    try:
+        from skypilot_tpu import check as check_lib
+        from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+        enabled = set(check_lib.get_cached_enabled_clouds_or_refresh())
+        infra = [{'cloud': name,
+                  'enabled': name in enabled}
+                 for name in sorted(CLOUD_REGISTRY.names())]
+    except Exception:  # noqa: BLE001
+        pass
+
+    return {'version': skypilot_tpu.__version__, 'clusters': clusters,
+            'jobs': jobs, 'services': services, 'requests': requests,
+            'infra': infra}
+
+
+_CSS = """
+body{margin:0;font:13px/1.5 -apple-system,'Segoe UI',sans-serif;
+     background:#0d1117;color:#c9d1d9}
+header{display:flex;align-items:baseline;gap:16px;padding:10px 20px;
+       background:#161b22;border-bottom:1px solid #30363d}
+h1{font-size:16px;margin:0;color:#e6edf3}
+#ver{color:#8b949e;font-size:12px}
+nav{display:flex;gap:4px;margin-left:auto}
+nav button{background:none;border:none;color:#8b949e;padding:6px 12px;
+           cursor:pointer;border-radius:6px;font-size:13px}
+nav button.active{background:#21262d;color:#e6edf3}
+main{padding:16px 20px;max-width:1100px}
+table{border-collapse:collapse;width:100%;margin-top:8px}
+th{font-size:11px;text-transform:uppercase;letter-spacing:.05em;
+   color:#8b949e;text-align:left;padding:6px 10px;
+   border-bottom:1px solid #30363d}
+td{padding:6px 10px;border-bottom:1px solid #21262d}
+tr:hover td{background:#161b22}
+.chip{display:inline-block;padding:1px 8px;border-radius:10px;
+      font-size:11px;font-weight:600}
+.ok{background:#1a3524;color:#3fb950}.bad{background:#3d1418;
+    color:#f85149}.warn{background:#3a2d12;color:#d29922}
+.dim{background:#21262d;color:#8b949e}
+a{color:#58a6ff;text-decoration:none}
+.empty{color:#484f58;padding:14px 10px}
+#updated{color:#484f58;font-size:11px;margin-top:14px}
+"""
+
+_JS = """
+const OK=['UP','READY','RUNNING','SUCCEEDED'],
+      BAD=['FAILED','FAILED_NO_RESOURCE','FAILED_CONTROLLER','NOT_READY'],
+      TABS={clusters:['name','workspace','status','resources','nodes'],
+            jobs:['id','name','status','recoveries','log'],
+            services:['name','status','endpoint','log'],
+            requests:['id','name','status','log'],
+            infra:['cloud','enabled']};
+let state=window.__initial__, tab='clusters';
+function chip(v){const s=String(v);
+  const cls=OK.includes(s)?'ok':BAD.includes(s)?'bad':
+    ['PENDING','PROVISIONING','RECOVERING','STARTING','INIT','STOPPED']
+      .includes(s)?'warn':'dim';
+  const e=document.createElement('span');e.className='chip '+cls;
+  e.textContent=s;return e}
+function cell(col,v){const td=document.createElement('td');
+  if(col==='status')td.appendChild(chip(v));
+  else if(col==='enabled')td.appendChild(chip(v?'enabled':'disabled'));
+  else if(col==='log'){const a=document.createElement('a');
+    a.href=v;a.textContent='view';td.appendChild(a)}
+  else if(col==='endpoint'){const a=document.createElement('a');
+    a.href=v;a.textContent=v;td.appendChild(a)}
+  else td.textContent=v==null?'':v;
+  return td}
+function render(){
+  const cols=TABS[tab],rows=state[tab]||[];
+  const table=document.createElement('table');
+  const hr=document.createElement('tr');
+  cols.forEach(c=>{const th=document.createElement('th');
+    th.textContent=c;hr.appendChild(th)});
+  table.appendChild(hr);
+  rows.forEach(r=>{const tr=document.createElement('tr');
+    cols.forEach(c=>tr.appendChild(cell(c,r[c])));
+    table.appendChild(tr)});
+  const m=document.getElementById('content');m.innerHTML='';
+  if(rows.length)m.appendChild(table);
+  else{const d=document.createElement('div');d.className='empty';
+    d.textContent='nothing here yet';m.appendChild(d)}
+  document.getElementById('updated').textContent=
+    'updated '+new Date().toLocaleTimeString();
+  document.querySelectorAll('nav button').forEach(b=>
+    b.classList.toggle('active',b.dataset.tab===tab));
+}
+function pick(t){tab=t;render()}
+async function refresh(){
+  try{const r=await fetch('/dashboard/api/summary');
+    if(r.ok){state=await r.json();render()}}catch(e){}}
+document.querySelectorAll('nav button').forEach(b=>
+  b.addEventListener('click',()=>pick(b.dataset.tab)));
+render();setInterval(refresh,5000);
+"""
+
+
+def page() -> str:
+    initial = json.dumps(summary())
+    tabs = ''.join(
+        f'<button data-tab="{t}">{label}</button>'
+        for t, label in [('clusters', 'Clusters'),
+                         ('jobs', 'Managed jobs'),
+                         ('services', 'Services'),
+                         ('requests', 'Requests'),
+                         ('infra', 'Infra')])
+    # </script>-safe embedding of the initial state.
+    initial = initial.replace('</', '<\\/')
+    return (
+        '<!doctype html><html><head><title>skypilot-tpu</title>'
+        f'<style>{_CSS}</style></head><body>'
+        f'<header><h1>skypilot-tpu</h1>'
+        f'<span id="ver">v{skypilot_tpu.__version__}</span>'
+        f'<nav>{tabs}</nav></header>'
+        '<main><div id="content"></div><div id="updated"></div></main>'
+        f'<script>window.__initial__={initial};{_JS}</script>'
+        '</body></html>')
+
+
+# --- log viewer -------------------------------------------------------------
+
+def tail_file(path: str, limit: int = 200_000) -> str:
+    """Last `limit` bytes of a file without reading the whole thing."""
+    try:
+        with open(path, 'rb') as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - limit))
+            return f.read().decode('utf-8', errors='replace')
+    except FileNotFoundError:
+        return '(no log yet)'
+
+
+_LOG_CSS = """
+body{margin:0;background:#0d1117;color:#c9d1d9;
+     font:12px/1.45 ui-monospace,Menlo,monospace}
+header{position:sticky;top:0;display:flex;gap:14px;align-items:center;
+       padding:8px 16px;background:#161b22;
+       border-bottom:1px solid #30363d;font-family:sans-serif}
+pre{margin:0;padding:12px 16px;white-space:pre-wrap;
+    word-break:break-all}
+a{color:#58a6ff;text-decoration:none}
+label{color:#8b949e;font-size:12px}
+"""
+
+_LOG_JS = """
+const pre=document.getElementById('log'),
+      follow=document.getElementById('follow');
+async function poll(){
+  try{const r=await fetch(location.pathname+'?raw=1');
+    if(r.ok){const t=await r.text();
+      if(t!==pre.textContent){pre.textContent=t;
+        if(follow.checked)window.scrollTo(0,document.body.scrollHeight)}}}
+  catch(e){}}
+setInterval(poll,2000);
+if(follow.checked)window.scrollTo(0,document.body.scrollHeight);
+"""
+
+
+def log_page(title: str, text: str) -> str:
+    import html as html_lib
+    return (
+        '<!doctype html><html><head>'
+        f'<title>{html_lib.escape(title)}</title>'
+        f'<style>{_LOG_CSS}</style></head><body>'
+        '<header><a href="/dashboard">&larr; dashboard</a>'
+        f'<strong>{html_lib.escape(title)}</strong>'
+        '<label style="margin-left:auto">'
+        '<input type="checkbox" id="follow" checked> follow</label>'
+        '</header>'
+        f'<pre id="log">{html_lib.escape(text)}</pre>'
+        f'<script>{_LOG_JS}</script></body></html>')

@@ -113,6 +113,33 @@ def test_dashboard_log_viewer(server):
         assert e.code == 404
 
 
+def test_dashboard_spa_api(server):
+    """The SPA's live-refresh surface: JSON summary + raw log tails
+    (what the embedded JS polls)."""
+    import json as json_lib
+    import urllib.request
+    from skypilot_tpu.client import sdk
+    request_id = sdk.status()
+    sdk.get(request_id, timeout=30)
+    with urllib.request.urlopen(f'{server.url}/dashboard/api/summary',
+                                timeout=10) as resp:
+        data = json_lib.loads(resp.read())
+    assert set(data) >= {'version', 'clusters', 'jobs', 'services',
+                         'requests', 'infra'}
+    ids = [r['id'] for r in data['requests']]
+    assert request_id in ids
+    row = next(r for r in data['requests'] if r['id'] == request_id)
+    assert row['status'] == 'SUCCEEDED'
+    # infra lists every registered cloud with enablement flags.
+    clouds = {i['cloud'] for i in data['infra']}
+    assert {'gcp', 'aws', 'lambda', 'runpod', 'local'} <= clouds
+    # raw tail for the JS poller is plain text, not HTML.
+    with urllib.request.urlopen(
+            f'{server.url}/dashboard/requests/{request_id}/log?raw=1',
+            timeout=10) as resp:
+        assert resp.headers['Content-Type'].startswith('text/plain')
+
+
 def test_ssh_print_command_local_and_guards(server, enable_clouds):
     enable_clouds('local')
     import skypilot_tpu as sky
