@@ -1,0 +1,50 @@
+"""Fluidstack adaptor: api-key REST v1 API.
+
+Reference analog: sky/provision/fluidstack/fluidstack_utils.py (the
+reference wraps the same platform API with `requests`). Credential:
+FLUIDSTACK_API_KEY env var or ~/.fluidstack/api_key.
+"""
+from typing import Dict, Optional
+
+from skypilot_tpu.adaptors import rest
+
+API_ENDPOINT = 'https://platform.fluidstack.io'
+CREDENTIALS_PATH = '~/.fluidstack/api_key'
+
+RestApiError = rest.RestApiError
+
+
+def get_api_key() -> Optional[str]:
+    return rest.env_or_file_credential('FLUIDSTACK_API_KEY',
+                                       CREDENTIALS_PATH)
+
+
+def _make_client() -> rest.RestClient:
+    def _headers() -> Dict[str, str]:
+        key = get_api_key()
+        if not key:
+            from skypilot_tpu import exceptions
+            raise exceptions.ProvisionError(
+                'Fluidstack API key not found; set FLUIDSTACK_API_KEY '
+                f'or create {CREDENTIALS_PATH}.')
+        return {'api-key': key}
+
+    return rest.RestClient(
+        API_ENDPOINT, _headers,
+        error_code_fn=lambda payload: payload.get('error', ''))
+
+
+_slot = rest.ClientSlot(_make_client)
+client = _slot.get
+set_client_factory = _slot.set_factory
+
+
+def classify_api_error(err: RestApiError):
+    from skypilot_tpu import exceptions
+    text = str(err).lower()
+    if 'no capacity' in text or 'unavailable' in text or \
+            err.status == 503:
+        return exceptions.CapacityError(str(err))
+    if 'quota' in text or 'limit' in text:
+        return exceptions.QuotaExceededError(str(err))
+    return err

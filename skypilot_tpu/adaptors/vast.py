@@ -1,0 +1,54 @@
+"""Vast.ai adaptor: api-key REST v0 API.
+
+Reference analog: sky/provision/vast/utils.py (the reference drives
+the `vastai_sdk`; the public console API is plain JSON). Vast is a
+spot-like GPU MARKET: capacity is discovered by searching offers
+('bundles') and an instance is created by accepting an offer ('ask').
+Credential: VAST_API_KEY env var or ~/.vast_api_key (the vast CLI's
+drop location).
+"""
+from typing import Dict, Optional
+
+from skypilot_tpu.adaptors import rest
+
+API_ENDPOINT = 'https://console.vast.ai'
+CREDENTIALS_PATH = '~/.vast_api_key'
+
+RestApiError = rest.RestApiError
+
+
+def get_api_key() -> Optional[str]:
+    return rest.env_or_file_credential('VAST_API_KEY', CREDENTIALS_PATH)
+
+
+def _make_client() -> rest.RestClient:
+    def _headers() -> Dict[str, str]:
+        key = get_api_key()
+        if not key:
+            from skypilot_tpu import exceptions
+            raise exceptions.ProvisionError(
+                'Vast API key not found; set VAST_API_KEY or create '
+                f'{CREDENTIALS_PATH}.')
+        return {'Authorization': f'Bearer {key}'}
+
+    return rest.RestClient(
+        API_ENDPOINT, _headers,
+        error_code_fn=lambda payload: payload.get('error', ''))
+
+
+_slot = rest.ClientSlot(_make_client)
+client = _slot.get
+set_client_factory = _slot.set_factory
+
+
+def classify_api_error(err: RestApiError):
+    from skypilot_tpu import exceptions
+    text = str(err).lower()
+    if ('no_such_ask' in text or 'ask is gone' in text
+            or 'no offers' in text or err.status == 410):
+        # The offer was taken by someone else — a capacity condition:
+        # retry elsewhere.
+        return exceptions.CapacityError(str(err))
+    if 'quota' in text or 'credit' in text:
+        return exceptions.QuotaExceededError(str(err))
+    return err

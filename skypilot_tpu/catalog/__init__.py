@@ -16,6 +16,8 @@ _CATALOG_MODULES = {
     'runpod': 'skypilot_tpu.catalog.runpod_catalog',
     'nebius': 'skypilot_tpu.catalog.nebius_catalog',
     'do': 'skypilot_tpu.catalog.do_catalog',
+    'fluidstack': 'skypilot_tpu.catalog.fluidstack_catalog',
+    'vast': 'skypilot_tpu.catalog.vast_catalog',
     'local': 'skypilot_tpu.catalog.local_catalog',
     'kubernetes': 'skypilot_tpu.catalog.kubernetes_catalog',
 }

@@ -1,0 +1,8 @@
+"""Fluidstack catalog: `<count>x_<GPU>` types from the shipped CSV.
+
+Reference analog: sky/catalog/fluidstack_catalog.py.
+"""
+from skypilot_tpu.catalog import common
+
+list_accelerators, get_feasible, validate_region_zone = \
+    common.make_vm_catalog('fluidstack', zones_modeled=False)

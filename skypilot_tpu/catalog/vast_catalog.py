@@ -1,0 +1,10 @@
+"""Vast catalog: indicative market floors from the shipped CSV.
+
+Reference analog: sky/catalog/vast_catalog.py. Actual prices come
+from the live offer search at provision time; the CSV rows let the
+optimizer rank Vast against fixed-price clouds.
+"""
+from skypilot_tpu.catalog import common
+
+list_accelerators, get_feasible, validate_region_zone = \
+    common.make_vm_catalog('vast', zones_modeled=False)

@@ -3,23 +3,27 @@ from skypilot_tpu.clouds.cloud import Cloud, CloudCapability
 from skypilot_tpu.clouds import aws as _aws  # noqa: F401 (registers)
 from skypilot_tpu.clouds import azure as _azure  # noqa: F401 (registers)
 from skypilot_tpu.clouds import do as _do  # noqa: F401 (registers)
+from skypilot_tpu.clouds import fluidstack as _fluidstack  # noqa: F401
 from skypilot_tpu.clouds import gcp as _gcp  # noqa: F401 (registers)
 from skypilot_tpu.clouds import lambda_cloud as _lambda  # noqa: F401
 from skypilot_tpu.clouds import local as _local  # noqa: F401 (registers)
 from skypilot_tpu.clouds import nebius as _nebius  # noqa: F401
 from skypilot_tpu.clouds import runpod as _runpod  # noqa: F401
 from skypilot_tpu.clouds import ssh as _ssh  # noqa: F401 (registers)
+from skypilot_tpu.clouds import vast as _vast  # noqa: F401 (registers)
 from skypilot_tpu.utils.registry import CLOUD_REGISTRY
 
 AWS = _aws.AWS
 Azure = _azure.Azure
 DigitalOcean = _do.DigitalOcean
+Fluidstack = _fluidstack.Fluidstack
 GCP = _gcp.GCP
 LambdaCloud = _lambda.LambdaCloud
 Local = _local.Local
 Nebius = _nebius.Nebius
 RunPod = _runpod.RunPod
 SSH = _ssh.SSHCloud
+Vast = _vast.Vast
 
 try:  # kubernetes is optional until round 2+
     from skypilot_tpu.clouds import kubernetes as _k8s  # noqa: F401
@@ -33,5 +37,5 @@ def get_cloud(name: str) -> Cloud:
 
 
 __all__ = ['Cloud', 'CloudCapability', 'AWS', 'Azure', 'DigitalOcean',
-           'GCP', 'LambdaCloud', 'Local', 'Nebius', 'RunPod', 'SSH',
-           'get_cloud', 'CLOUD_REGISTRY']
+           'Fluidstack', 'GCP', 'LambdaCloud', 'Local', 'Nebius',
+           'RunPod', 'SSH', 'Vast', 'get_cloud', 'CLOUD_REGISTRY']

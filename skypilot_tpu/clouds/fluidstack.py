@@ -1,0 +1,61 @@
+"""Fluidstack cloud policy — GPU neocloud with stop/start.
+
+Reference analog: sky/clouds/fluidstack.py. Catalog instance types
+are `<count>x_<GPU>` (split into gpu_type + gpu_count for the API).
+"""
+from typing import Dict, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.clouds import runpod as runpod_cloud
+from skypilot_tpu.utils import registry
+
+
+@registry.CLOUD_REGISTRY.register(name='fluidstack')
+class Fluidstack(cloud.Cloud):
+    NAME = 'fluidstack'
+    CAPABILITIES = frozenset({
+        cloud.CloudCapability.MULTI_NODE,
+        cloud.CloudCapability.STOP,
+        cloud.CloudCapability.AUTOSTOP,
+        cloud.CloudCapability.CUSTOM_IMAGE,
+    })
+    MAX_CLUSTER_NAME_LENGTH = 56
+
+    def provision_module(self) -> str:
+        return 'skypilot_tpu.provision.fluidstack'
+
+    def make_deploy_variables(self, resources, cluster_name_on_cloud: str,
+                              region: str, zone: Optional[str]
+                              ) -> Dict[str, object]:
+        resources.assert_launchable()
+        auth = self.authentication_config()
+        gpu_type, gpu_count = runpod_cloud.split_instance_type(
+            resources.instance_type)
+        variables: Dict[str, object] = {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region,
+            'zone': None,
+            'instance_type': resources.instance_type,
+            'gpu_type': gpu_type,
+            'gpu_count': gpu_count,
+            'use_spot': False,  # no spot market
+            'disk_size': resources.disk_size,
+            'ssh_user': 'ubuntu',
+            'ssh_private_key': auth.get('ssh_private_key'),
+            'num_nodes': None,  # filled by the provisioner
+        }
+        if resources.image_id:
+            variables['image_id'] = resources.image_id
+        return variables
+
+    def authentication_config(self) -> Dict[str, object]:
+        from skypilot_tpu import authentication
+        return authentication.authentication_config()
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.adaptors import fluidstack as adaptor
+        if adaptor.get_api_key():
+            return True, None
+        return False, ('Fluidstack API key not found. Set '
+                       'FLUIDSTACK_API_KEY or create '
+                       f'{adaptor.CREDENTIALS_PATH}.')

@@ -14,7 +14,6 @@ from typing import Any, Dict, List, Optional
 from skypilot_tpu import exceptions
 from skypilot_tpu.adaptors import azure as azure_adaptor
 from skypilot_tpu.provision import common
-from skypilot_tpu.utils import command_runner
 
 logger = logging.getLogger(__name__)
 
@@ -399,16 +398,8 @@ def open_ports(cluster_name_on_cloud: str, ports: List[str],
     })
 
 
-def get_command_runners(cluster_info: common.ClusterInfo
-                        ) -> List[command_runner.CommandRunner]:
-    runners: List[command_runner.CommandRunner] = []
+def get_command_runners(cluster_info: common.ClusterInfo):
     use_internal = bool(
         cluster_info.provider_config.get('use_internal_ips', False))
-    for inst in cluster_info.ordered_instances():
-        for host in inst.hosts:
-            runners.append(command_runner.SSHCommandRunner(
-                host.get_ip(use_internal=use_internal),
-                user=cluster_info.ssh_user or 'skytpu',
-                private_key=cluster_info.ssh_private_key,
-                port=host.ssh_port))
-    return runners
+    return common.ssh_command_runners(cluster_info, 'skytpu',
+                                      use_internal=use_internal)

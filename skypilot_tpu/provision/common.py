@@ -1,4 +1,5 @@
-"""Shared provision-layer types.
+"""Shared provision-layer types + the lifecycle plumbing every
+flat-VM cloud repeats.
 
 Reference analog: sky/provision/common.py (ProvisionConfig :39,
 ProvisionRecord :63, InstanceInfo :92, ClusterInfo :109). TPU-first shape:
@@ -8,7 +9,8 @@ fan out to all of them (reference num_ips_per_node,
 cloud_vm_ray_backend.py:2613).
 """
 import dataclasses
-from typing import Any, Dict, List, Optional
+import time
+from typing import Any, Callable, Dict, List, Optional
 
 
 @dataclasses.dataclass
@@ -100,3 +102,61 @@ class ClusterInfo:
     @property
     def num_instances(self) -> int:
         return len(self.instances)
+
+
+# --- lifecycle plumbing shared by the flat-VM clouds ------------------------
+
+def ssh_command_runners(cluster_info: ClusterInfo,
+                        default_user: str,
+                        use_internal: bool = False) -> List[Any]:
+    """One SSHCommandRunner per host, head-host first — the
+    get_command_runners body every SSH-reachable cloud shares."""
+    from skypilot_tpu.utils import command_runner
+    runners: List[Any] = []
+    for inst in cluster_info.ordered_instances():
+        for host in inst.hosts:
+            runners.append(command_runner.SSHCommandRunner(
+                host.get_ip(use_internal=use_internal),
+                user=cluster_info.ssh_user or default_user,
+                private_key=cluster_info.ssh_private_key,
+                port=host.ssh_port))
+    return runners
+
+
+def wait_until_running(list_instances: Callable[[], List[Any]],
+                       count: int,
+                       state_of: Callable[[Any], str],
+                       describe: Callable[[Any], str],
+                       timeout: float = 900.0,
+                       poll_seconds: float = 5.0) -> None:
+    """Poll until `count` LIVE instances are all 'running'.
+
+    Terminated/stopping leftovers (lingering API entries after a
+    down, dying failover remnants) are excluded from the convergence
+    check so a relaunch can't dead-wait on them.
+    """
+    from skypilot_tpu import exceptions
+    deadline = time.time() + timeout
+    while True:
+        instances = list_instances()
+        live = [i for i in instances
+                if state_of(i) not in ('terminated', 'stopping')]
+        if len(live) >= count and all(state_of(i) == 'running'
+                                      for i in live):
+            return
+        if time.time() > deadline:
+            states = {describe(i): state_of(i) for i in instances}
+            raise exceptions.ProvisionError(
+                f'Timed out waiting for running: {states}')
+        time.sleep(poll_seconds)
+
+
+def refuse_unresumable(state: Optional[str], name: str) -> None:
+    """Shared launch-loop guard: an instance in a transitional state
+    ('stopping') must block relaunch — creating a same-name twin
+    would orphan a billing instance."""
+    from skypilot_tpu import exceptions
+    if state is not None:
+        raise exceptions.ProvisionError(
+            f'Instance {name} is {state}; cannot make progress '
+            '(retry when it settles).')

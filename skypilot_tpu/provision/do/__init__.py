@@ -8,13 +8,11 @@ a fingerprint-derived name; power_off/power_on give real stop/resume
 """
 import hashlib
 import logging
-import time
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.adaptors import do as do_adaptor
 from skypilot_tpu.provision import common
-from skypilot_tpu.utils import command_runner
 
 logger = logging.getLogger(__name__)
 
@@ -150,18 +148,10 @@ def run_instances(region: str, cluster_name_on_cloud: str,
 def _wait_active(client, cluster_name_on_cloud: str, count: int,
                  region: Optional[str] = None,
                  timeout: float = 900.0) -> None:
-    deadline = time.time() + timeout
-    while True:
-        droplets = _cluster_droplets(client, cluster_name_on_cloud,
-                                     region=region)
-        if len(droplets) >= count and all(
-                _droplet_state(d) == 'running' for d in droplets):
-            return
-        if time.time() > deadline:
-            raise exceptions.ProvisionError(
-                'Timed out waiting for active: '
-                f'{ {d["name"]: _droplet_state(d) for d in droplets} }')
-        time.sleep(5.0)
+    common.wait_until_running(
+        lambda: _cluster_droplets(client, cluster_name_on_cloud,
+                                  region=region),
+        count, _droplet_state, lambda d: d['name'], timeout=timeout)
 
 
 def wait_instances(region: str, cluster_name_on_cloud: str,
@@ -250,14 +240,5 @@ def get_cluster_info(region: str, cluster_name_on_cloud: str,
         ssh_private_key=provider_config.get('ssh_private_key'))
 
 
-def get_command_runners(cluster_info: common.ClusterInfo
-                        ) -> List[command_runner.CommandRunner]:
-    runners: List[command_runner.CommandRunner] = []
-    for inst in cluster_info.ordered_instances():
-        for host in inst.hosts:
-            runners.append(command_runner.SSHCommandRunner(
-                host.get_ip(use_internal=False),
-                user=cluster_info.ssh_user or 'root',
-                private_key=cluster_info.ssh_private_key,
-                port=host.ssh_port))
-    return runners
+def get_command_runners(cluster_info: common.ClusterInfo):
+    return common.ssh_command_runners(cluster_info, 'root')

@@ -14,13 +14,11 @@ derived from the public key fingerprint.
 import hashlib
 import logging
 import re
-import time
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.adaptors import lambda_cloud as lambda_adaptor
 from skypilot_tpu.provision import common
-from skypilot_tpu.utils import command_runner
 
 logger = logging.getLogger(__name__)
 
@@ -106,21 +104,9 @@ def run_instances(region: str, cluster_name_on_cloud: str,
 
 def _wait_active(client, cluster_name_on_cloud: str, count: int,
                  timeout: float = 900.0) -> None:
-    deadline = time.time() + timeout
-    while True:
-        instances = _cluster_instances(client, cluster_name_on_cloud)
-        # Old terminated entries linger in /instances after a down;
-        # they must not block a relaunch's convergence check.
-        live = [i for i in instances
-                if _state(i) not in ('terminated', 'stopping')]
-        if len(live) >= count and all(_state(i) == 'running'
-                                      for i in live):
-            return
-        if time.time() > deadline:
-            raise exceptions.ProvisionError(
-                f'Timed out waiting for active: '
-                f'{ {i["name"]: _state(i) for i in instances} }')
-        time.sleep(5.0)
+    common.wait_until_running(
+        lambda: _cluster_instances(client, cluster_name_on_cloud),
+        count, _state, lambda i: i['name'], timeout=timeout)
 
 
 def wait_instances(region: str, cluster_name_on_cloud: str,
@@ -187,14 +173,5 @@ def get_cluster_info(region: str, cluster_name_on_cloud: str,
         ssh_private_key=provider_config.get('ssh_private_key'))
 
 
-def get_command_runners(cluster_info: common.ClusterInfo
-                        ) -> List[command_runner.CommandRunner]:
-    runners: List[command_runner.CommandRunner] = []
-    for inst in cluster_info.ordered_instances():
-        for host in inst.hosts:
-            runners.append(command_runner.SSHCommandRunner(
-                host.get_ip(use_internal=False),
-                user=cluster_info.ssh_user or 'ubuntu',
-                private_key=cluster_info.ssh_private_key,
-                port=host.ssh_port))
-    return runners
+def get_command_runners(cluster_info: common.ClusterInfo):
+    return common.ssh_command_runners(cluster_info, 'ubuntu')

@@ -10,13 +10,11 @@ pod); terminate deletes it.
 """
 import logging
 import re
-import time
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.adaptors import runpod as runpod_adaptor
 from skypilot_tpu.provision import common
-from skypilot_tpu.utils import command_runner
 
 logger = logging.getLogger(__name__)
 
@@ -101,18 +99,9 @@ def run_instances(region: str, cluster_name_on_cloud: str,
 
 def _wait_running(client, cluster_name_on_cloud: str, count: int,
                   timeout: float = 900.0) -> None:
-    deadline = time.time() + timeout
-    while True:
-        pods = _cluster_pods(client, cluster_name_on_cloud)
-        live = [p for p in pods if _status(p) != 'terminated']
-        if len(live) >= count and all(_status(p) == 'running'
-                                      for p in live):
-            return
-        if time.time() > deadline:
-            raise exceptions.ProvisionError(
-                'Timed out waiting for running: '
-                f'{ {p["name"]: _status(p) for p in pods} }')
-        time.sleep(5.0)
+    common.wait_until_running(
+        lambda: _cluster_pods(client, cluster_name_on_cloud),
+        count, _status, lambda p: p['name'], timeout=timeout)
 
 
 def wait_instances(region: str, cluster_name_on_cloud: str,
@@ -208,14 +197,5 @@ def get_cluster_info(region: str, cluster_name_on_cloud: str,
         ssh_private_key=provider_config.get('ssh_private_key'))
 
 
-def get_command_runners(cluster_info: common.ClusterInfo
-                        ) -> List[command_runner.CommandRunner]:
-    runners: List[command_runner.CommandRunner] = []
-    for inst in cluster_info.ordered_instances():
-        for host in inst.hosts:
-            runners.append(command_runner.SSHCommandRunner(
-                host.get_ip(use_internal=False),
-                user=cluster_info.ssh_user or 'root',
-                private_key=cluster_info.ssh_private_key,
-                port=host.ssh_port))
-    return runners
+def get_command_runners(cluster_info: common.ClusterInfo):
+    return common.ssh_command_runners(cluster_info, 'root')

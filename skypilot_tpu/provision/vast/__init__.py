@@ -1,0 +1,214 @@
+"""Vast.ai provisioner — GPU market behind the uniform interface.
+
+Reference analog: sky/provision/vast/instance.py. Vast is an OFFER
+MARKET, not a fleet API: capacity is found by searching bundles
+(offers), and an instance is created by accepting an offer id ('ask').
+Placement therefore re-searches on every launch; a vanished offer is
+a CapacityError so the failover engine retries with the next one.
+Labels carry our deterministic `<cluster>-<i>` identity.
+"""
+import logging
+import re
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.adaptors import vast as vast_adaptor
+from skypilot_tpu.provision import common
+
+logger = logging.getLogger(__name__)
+
+_STATE_MAP = {
+    'created': 'pending',
+    'loading': 'pending',
+    'running': 'running',
+    'stopping': 'stopping',
+    'stopped': 'stopped',
+    'exited': 'stopped',
+    'offline': 'terminated',
+}
+
+
+def _state(inst: Dict[str, Any]) -> str:
+    status = (inst.get('actual_status')
+              or inst.get('intended_status') or '')
+    return _STATE_MAP.get(str(status).lower(), 'pending')
+
+
+def _cluster_instances(client, cluster_name_on_cloud: str
+                       ) -> List[Dict[str, Any]]:
+    pattern = re.compile(re.escape(cluster_name_on_cloud) + r'-\d+$')
+    resp = client.request('GET', '/api/v0/instances/')
+    return [i for i in resp.get('instances', [])
+            if pattern.fullmatch(i.get('label') or '')]
+
+
+# Catalog accelerator names -> Vast's live gpu_name vocabulary (the
+# market names cards with spaces and interface suffixes).
+_GPU_NAME_MAP = {
+    'RTX4090': 'RTX 4090',
+    'RTX3090': 'RTX 3090',
+    'RTXA6000': 'RTX A6000',
+    'A100-80GB': 'A100 SXM4',
+    'A100': 'A100 PCIE',
+    'H100': 'H100 SXM',
+    'H200': 'H200',
+    'L40S': 'L40S',
+}
+
+
+def search_offers(client, gpu_name: str, gpu_count: int,
+                  region: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Rentable offers for the GPU shape, cheapest first."""
+    query: Dict[str, Any] = {
+        'gpu_name': {'eq': _GPU_NAME_MAP.get(gpu_name, gpu_name)},
+        'num_gpus': {'eq': gpu_count},
+        'rentable': {'eq': True},
+        'order': [['dph_total', 'asc']],
+        'type': 'on-demand',
+    }
+    if region:
+        query['geolocation'] = {'eq': region}
+    resp = client.request('PUT', '/api/v0/bundles/',
+                          json_body={'q': query})
+    return resp.get('offers', [])
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    client = vast_adaptor.client()
+    nc = {**config.provider_config, **config.node_config}
+    existing = {i['label']: i for i in _cluster_instances(
+        client, cluster_name_on_cloud)}
+    created: List[str] = []
+    resumed: List[str] = []
+    try:
+        for i in range(config.count):
+            name = f'{cluster_name_on_cloud}-{i}'
+            inst = existing.get(name)
+            state = _state(inst) if inst else None
+            if state in ('running', 'pending'):
+                continue
+            if state == 'stopped':
+                if not config.resume_stopped_nodes:
+                    raise exceptions.ProvisionError(
+                        f'Instance {name} is stopped; pass '
+                        'resume_stopped_nodes to restart it.')
+                client.request('PUT',
+                               f'/api/v0/instances/{inst["id"]}/',
+                               json_body={'state': 'running'})
+                resumed.append(name)
+                continue
+            common.refuse_unresumable(state, name)
+            offers = search_offers(
+                client, nc.get('gpu_type', ''),
+                int(nc.get('gpu_count', 1)),
+                region if region != 'any' else None)
+            if not offers:
+                raise exceptions.CapacityError(
+                    f'Vast: no rentable offers for '
+                    f'{nc.get("gpu_type")}:{nc.get("gpu_count")} '
+                    f'in {region}')
+            ask_id = offers[0]['id']
+            client.request('PUT', f'/api/v0/asks/{ask_id}/',
+                           json_body={
+                               'client_id': 'me',
+                               'image': nc.get('image_id') or
+                               'ubuntu:22.04',
+                               'label': name,
+                               # mkdir first: stock container images
+                               # ship without ~/.ssh.
+                               'onstart': ('mkdir -p ~/.ssh && echo "'
+                                           + config.authentication_config
+                                           .get('ssh_public_key_content',
+                                                '')
+                                           + '" >> ~/.ssh/authorized_keys'
+                                           ),
+                               'runtype': 'ssh',
+                               'disk': float(nc.get('disk_size', 64)),
+                           })
+            created.append(name)
+        _wait_running(client, cluster_name_on_cloud, config.count,
+                      timeout=float(config.provider_config.get(
+                          'provision_timeout', 900)))
+    except vast_adaptor.RestApiError as e:
+        raise vast_adaptor.classify_api_error(e) from e
+    return common.ProvisionRecord(
+        provider_name='vast', region=region, zone=None,
+        cluster_name_on_cloud=cluster_name_on_cloud,
+        head_instance_id=f'{cluster_name_on_cloud}-0',
+        created_instance_ids=created, resumed_instance_ids=resumed)
+
+
+def _wait_running(client, cluster_name_on_cloud: str, count: int,
+                  timeout: float = 900.0) -> None:
+    common.wait_until_running(
+        lambda: _cluster_instances(client, cluster_name_on_cloud),
+        count, _state, lambda i: i['label'], timeout=timeout)
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: Optional[str] = None) -> None:
+    del region, cluster_name_on_cloud, state  # run_instances waits
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Dict[str, Any]) -> None:
+    client = vast_adaptor.client()
+    for inst in _cluster_instances(client, cluster_name_on_cloud):
+        if _state(inst) == 'running':
+            client.request('PUT', f'/api/v0/instances/{inst["id"]}/',
+                           json_body={'state': 'stopped'})
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Dict[str, Any]) -> None:
+    client = vast_adaptor.client()
+    for inst in _cluster_instances(client, cluster_name_on_cloud):
+        client.request('DELETE', f'/api/v0/instances/{inst["id"]}/')
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Dict[str, Any]
+                    ) -> Dict[str, Optional[str]]:
+    client = vast_adaptor.client()
+    out: Dict[str, Optional[str]] = {}
+    for inst in _cluster_instances(client, cluster_name_on_cloud):
+        state = _state(inst)
+        if state == 'terminated':
+            continue
+        out[inst['label']] = state
+    return out
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Dict[str, Any]) -> common.ClusterInfo:
+    del region
+    client = vast_adaptor.client()
+    instances: Dict[str, common.InstanceInfo] = {}
+    head_name = f'{cluster_name_on_cloud}-0'
+    head_id: Optional[str] = None
+    for inst in _cluster_instances(client, cluster_name_on_cloud):
+        if _state(inst) != 'running':
+            continue
+        name = inst['label']
+        instances[name] = common.InstanceInfo(
+            instance_id=name,
+            hosts=[common.HostInfo(
+                host_id=str(inst['id']),
+                internal_ip=inst.get('public_ipaddr', ''),
+                external_ip=inst.get('public_ipaddr'),
+                ssh_port=int(inst.get('ssh_port') or 22))],
+            status='running', tags={})
+        if name == head_name:
+            head_id = name
+    if head_id is None and instances:
+        head_id = sorted(instances)[0]
+    return common.ClusterInfo(
+        instances=instances, head_instance_id=head_id,
+        provider_name='vast', provider_config=provider_config,
+        ssh_user='root',
+        ssh_private_key=provider_config.get('ssh_private_key'))
+
+
+def get_command_runners(cluster_info: common.ClusterInfo):
+    return common.ssh_command_runners(cluster_info, 'root')

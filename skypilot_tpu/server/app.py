@@ -148,13 +148,18 @@ async def _handle_dashboard_summary(request):
 
 def _log_response(request, title: str, path: str):
     """JS-polling log viewer page, or the raw tail for ?raw=1 (what
-    the page's poller fetches)."""
+    the page's poller fetches). The raw response carries the CURRENT
+    title (status included) in a header so the viewer's status chip
+    tracks RUNNING -> SUCCEEDED without a reload."""
     from aiohttp import web
 
     from skypilot_tpu.server import dashboard
     text = dashboard.tail_file(path)
     if request.query.get('raw'):
-        return web.Response(text=text, content_type='text/plain')
+        # HTTP headers are latin-1; task names may not be.
+        safe_title = title.encode('ascii', 'replace').decode()
+        return web.Response(text=text, content_type='text/plain',
+                            headers={'X-Log-Title': safe_title})
     return web.Response(text=dashboard.log_page(title, text),
                         content_type='text/html')
 

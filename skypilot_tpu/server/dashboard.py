@@ -118,7 +118,9 @@ function chip(v){const s=String(v);
   e.textContent=s;return e}
 function cell(col,v){const td=document.createElement('td');
   if(col==='status')td.appendChild(chip(v));
-  else if(col==='enabled')td.appendChild(chip(v?'enabled':'disabled'));
+  else if(col==='enabled'){const e=document.createElement('span');
+    e.className='chip '+(v?'ok':'dim');
+    e.textContent=v?'enabled':'disabled';td.appendChild(e)}
   else if(col==='log'){const a=document.createElement('a');
     a.href=v;a.textContent='view';td.appendChild(a)}
   else if(col==='endpoint'){const a=document.createElement('a');
@@ -204,10 +206,14 @@ label{color:#8b949e;font-size:12px}
 
 _LOG_JS = """
 const pre=document.getElementById('log'),
-      follow=document.getElementById('follow');
+      follow=document.getElementById('follow'),
+      titleEl=document.getElementById('title');
 async function poll(){
   try{const r=await fetch(location.pathname+'?raw=1');
     if(r.ok){const t=await r.text();
+      const title=r.headers.get('X-Log-Title');
+      if(title&&title!==titleEl.textContent){
+        titleEl.textContent=title;document.title=title}
       if(t!==pre.textContent){pre.textContent=t;
         if(follow.checked)window.scrollTo(0,document.body.scrollHeight)}}}
   catch(e){}}
@@ -223,7 +229,7 @@ def log_page(title: str, text: str) -> str:
         f'<title>{html_lib.escape(title)}</title>'
         f'<style>{_LOG_CSS}</style></head><body>'
         '<header><a href="/dashboard">&larr; dashboard</a>'
-        f'<strong>{html_lib.escape(title)}</strong>'
+        f'<strong id="title">{html_lib.escape(title)}</strong>'
         '<label style="margin-left:auto">'
         '<input type="checkbox" id="follow" checked> follow</label>'
         '</header>'

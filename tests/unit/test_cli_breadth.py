@@ -133,11 +133,13 @@ def test_dashboard_spa_api(server):
     # infra lists every registered cloud with enablement flags.
     clouds = {i['cloud'] for i in data['infra']}
     assert {'gcp', 'aws', 'lambda', 'runpod', 'local'} <= clouds
-    # raw tail for the JS poller is plain text, not HTML.
+    # raw tail for the JS poller is plain text carrying the live
+    # title (status) so the viewer header tracks state changes.
     with urllib.request.urlopen(
             f'{server.url}/dashboard/requests/{request_id}/log?raw=1',
             timeout=10) as resp:
         assert resp.headers['Content-Type'].startswith('text/plain')
+        assert 'SUCCEEDED' in resp.headers['X-Log-Title']
 
 
 def test_ssh_print_command_local_and_guards(server, enable_clouds):

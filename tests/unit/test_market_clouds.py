@@ -1,0 +1,250 @@
+"""Fluidstack + Vast provisioners against in-memory fake APIs.
+
+Vast's offer-market model gets its own coverage: launches accept the
+cheapest matching offer, and an empty offer book is a CapacityError
+the failover engine can act on.
+"""
+import itertools
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.adaptors import fluidstack as fs_adaptor
+from skypilot_tpu.adaptors import vast as vast_adaptor
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision import fluidstack as fs_provision
+from skypilot_tpu.provision import vast as vast_provision
+
+
+def _config(instance_type, count=1, **node):
+    return common.ProvisionConfig(
+        provider_config={'region': 'norway'},
+        authentication_config={'ssh_user': 'ubuntu',
+                               'ssh_public_key_content': 'ssh-ed25519 K'},
+        node_config={'instance_type': instance_type, **node},
+        count=count)
+
+
+# ----------------------------------------------------------- fluidstack
+
+class FakeFluidstack:
+    def __init__(self):
+        self.instances = {}
+        self.ssh_keys = []
+        self._ids = itertools.count()
+
+    def request(self, method, path, params=None, json_body=None):
+        if path == '/ssh_keys' and method == 'GET':
+            return {'ssh_keys': list(self.ssh_keys)}
+        if path == '/ssh_keys' and method == 'POST':
+            self.ssh_keys.append(dict(json_body))
+            return dict(json_body)
+        if path == '/instances' and method == 'GET':
+            return {'instances': list(self.instances.values())}
+        if path == '/instances' and method == 'POST':
+            iid = f'fs-{next(self._ids)}'
+            self.instances[iid] = {
+                'id': iid, 'name': json_body['name'],
+                'status': 'running', 'ip_address': '185.0.0.4',
+                'private_ip': '10.3.0.4', '_spec': json_body}
+            return self.instances[iid]
+        if method == 'PUT' and path.endswith('/stop'):
+            self.instances[path.split('/')[2]]['status'] = 'stopped'
+            return {}
+        if method == 'PUT' and path.endswith('/start'):
+            self.instances[path.split('/')[2]]['status'] = 'running'
+            return {}
+        if method == 'DELETE':
+            del self.instances[path.split('/')[2]]
+            return {}
+        raise AssertionError(f'unexpected {method} {path}')
+
+
+@pytest.fixture
+def fake_fs():
+    api = FakeFluidstack()
+    fs_adaptor.set_client_factory(lambda: api)
+    yield api
+    fs_adaptor.set_client_factory(
+        lambda: (_ for _ in ()).throw(AssertionError('no client')))
+
+
+def test_fluidstack_lifecycle(fake_fs):
+    record = fs_provision.run_instances(
+        'norway', 'fs1', _config('8x_H100', gpu_type='H100',
+                                 gpu_count=8))
+    assert record.created_instance_ids == ['fs1-0']
+    inst = next(iter(fake_fs.instances.values()))
+    assert inst['_spec']['gpu_count'] == 8
+    assert len(fake_fs.ssh_keys) == 1
+    info = fs_provision.get_cluster_info('norway', 'fs1', {})
+    assert info.get_head_instance().hosts[0].external_ip == '185.0.0.4'
+    fs_provision.stop_instances('fs1', {})
+    assert fs_provision.query_instances('fs1', {}) == {
+        'fs1-0': 'stopped'}
+    record = fs_provision.run_instances(
+        'norway', 'fs1', _config('8x_H100', gpu_type='H100',
+                                 gpu_count=8))
+    assert record.resumed_instance_ids == ['fs1-0']
+    fs_provision.terminate_instances('fs1', {})
+    assert fs_provision.query_instances('fs1', {}) == {}
+
+
+# ----------------------------------------------------------------- vast
+
+class FakeVast:
+    def __init__(self):
+        self.offers = []
+        self.instances = {}
+        self._ids = itertools.count(500)
+        self.accepted_asks = []
+
+    def request(self, method, path, params=None, json_body=None):
+        if path == '/api/v0/bundles/' and method == 'PUT':
+            q = json_body['q']
+            matching = [o for o in self.offers
+                        if o['gpu_name'] == q['gpu_name']['eq']
+                        and o['num_gpus'] == q['num_gpus']['eq']]
+            return {'offers': sorted(matching,
+                                     key=lambda o: o['dph_total'])}
+        if path == '/api/v0/instances/' and method == 'GET':
+            return {'instances': list(self.instances.values())}
+        if method == 'PUT' and path.startswith('/api/v0/asks/'):
+            ask_id = int(path.split('/')[4])
+            offer = next(o for o in self.offers if o['id'] == ask_id)
+            self.accepted_asks.append(ask_id)
+            iid = next(self._ids)
+            self.instances[iid] = {
+                'id': iid, 'label': json_body['label'],
+                'actual_status': 'running',
+                'public_ipaddr': '72.0.0.9', 'ssh_port': 34022,
+                '_offer': offer, '_spec': json_body}
+            return {'success': True, 'new_contract': iid}
+        if method == 'PUT' and path.startswith('/api/v0/instances/'):
+            iid = int(path.split('/')[4])
+            self.instances[iid]['actual_status'] = (
+                'stopped' if json_body['state'] == 'stopped'
+                else 'running')
+            return {}
+        if method == 'DELETE':
+            del self.instances[int(path.split('/')[4])]
+            return {}
+        raise AssertionError(f'unexpected {method} {path}')
+
+
+@pytest.fixture
+def fake_vast():
+    api = FakeVast()
+    vast_adaptor.set_client_factory(lambda: api)
+    yield api
+    vast_adaptor.set_client_factory(
+        lambda: (_ for _ in ()).throw(AssertionError('no client')))
+
+
+def test_vast_accepts_cheapest_offer(fake_vast):
+    fake_vast.offers = [
+        {'id': 1, 'gpu_name': 'H100 SXM', 'num_gpus': 8,
+         'dph_total': 19.0},
+        {'id': 2, 'gpu_name': 'H100 SXM', 'num_gpus': 8,
+         'dph_total': 14.5},
+        {'id': 3, 'gpu_name': 'H100 SXM', 'num_gpus': 1,
+         'dph_total': 2.0},
+    ]
+    record = vast_provision.run_instances(
+        'any', 'v1', _config('8x_H100', gpu_type='H100', gpu_count=8))
+    assert record.created_instance_ids == ['v1-0']
+    assert fake_vast.accepted_asks == [2]  # cheapest 8xH100 offer
+    inst = next(iter(fake_vast.instances.values()))
+    assert 'ssh-ed25519 K' in inst['_spec']['onstart']
+    info = vast_provision.get_cluster_info('any', 'v1', {})
+    host = info.get_head_instance().hosts[0]
+    assert host.ssh_port == 34022  # market boxes expose mapped ports
+    runners = vast_provision.get_command_runners(info)
+    assert runners[0].port == 34022
+
+
+def test_vast_empty_offer_book_is_capacity_error(fake_vast):
+    with pytest.raises(exceptions.CapacityError):
+        vast_provision.run_instances(
+            'any', 'v2', _config('8x_H100', gpu_type='H100',
+                                 gpu_count=8))
+
+
+def test_vast_gpu_name_mapping(fake_vast):
+    """Catalog names must translate to Vast's live vocabulary
+    ('RTX4090' -> 'RTX 4090'), or no offer would ever match."""
+    fake_vast.offers = [
+        {'id': 4, 'gpu_name': 'RTX 4090', 'num_gpus': 1,
+         'dph_total': 0.38},
+        {'id': 5, 'gpu_name': 'A100 SXM4', 'num_gpus': 8,
+         'dph_total': 8.9},
+    ]
+    client = vast_adaptor.client()
+    assert [o['id'] for o in vast_provision.search_offers(
+        client, 'RTX4090', 1)] == [4]
+    assert [o['id'] for o in vast_provision.search_offers(
+        client, 'A100-80GB', 8)] == [5]
+
+
+def test_stopping_state_refuses_duplicate_creation(fake_vast,
+                                                   fake_fs):
+    """A 'stopping' instance must block relaunch instead of spawning
+    a same-name twin that would be orphaned (and billed) forever."""
+    fake_vast.offers = [{'id': 9, 'gpu_name': 'H100 SXM',
+                         'num_gpus': 1, 'dph_total': 2.0}]
+    vast_provision.run_instances(
+        'any', 'v1', _config('1x_H100', gpu_type='H100', gpu_count=1))
+    iid = next(iter(fake_vast.instances))
+    fake_vast.instances[iid]['actual_status'] = 'stopping'
+    with pytest.raises(exceptions.ProvisionError, match='stopping'):
+        vast_provision.run_instances(
+            'any', 'v1', _config('1x_H100', gpu_type='H100',
+                                 gpu_count=1))
+    assert len(fake_vast.instances) == 1
+
+    fs_provision.run_instances(
+        'norway', 'fs1', _config('1x_H100', gpu_type='H100',
+                                 gpu_count=1))
+    fid = next(iter(fake_fs.instances))
+    fake_fs.instances[fid]['status'] = 'stopping'
+    with pytest.raises(exceptions.ProvisionError, match='stopping'):
+        fs_provision.run_instances(
+            'norway', 'fs1', _config('1x_H100', gpu_type='H100',
+                                     gpu_count=1))
+    assert len(fake_fs.instances) == 1
+
+
+def test_vast_stop_resume_terminate(fake_vast):
+    fake_vast.offers = [
+        {'id': 9, 'gpu_name': 'RTX 4090', 'num_gpus': 1,
+         'dph_total': 0.4}]
+    vast_provision.run_instances(
+        'any', 'v1', _config('1x_RTX4090', gpu_type='RTX4090',
+                             gpu_count=1))
+    vast_provision.stop_instances('v1', {})
+    assert vast_provision.query_instances('v1', {}) == {
+        'v1-0': 'stopped'}
+    record = vast_provision.run_instances(
+        'any', 'v1', _config('1x_RTX4090', gpu_type='RTX4090',
+                             gpu_count=1))
+    assert record.resumed_instance_ids == ['v1-0']
+    vast_provision.terminate_instances('v1', {})
+    assert vast_provision.query_instances('v1', {}) == {}
+
+
+def test_twelve_cloud_registry(enable_clouds):
+    """All 12 infra targets registered; optimizer ranks across the two
+    market clouds (vast's 8xH100 floor $15.60 < fluidstack $23.12)."""
+    from skypilot_tpu import Dag, Resources, Task
+    from skypilot_tpu.clouds import CLOUD_REGISTRY
+    from skypilot_tpu.optimizer import Optimizer
+    assert {'gcp', 'aws', 'azure', 'kubernetes', 'ssh', 'local',
+            'lambda', 'runpod', 'nebius', 'do', 'fluidstack',
+            'vast'} <= set(CLOUD_REGISTRY.names())
+    enable_clouds('fluidstack', 'vast')
+    with Dag() as dag:
+        t = Task('t', run='true')
+        t.set_resources(Resources(accelerators='H100:8'))
+        dag.add(t)
+    Optimizer.optimize(dag, quiet=True)
+    assert t.best_resources.cloud == 'vast'
