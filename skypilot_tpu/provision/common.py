@@ -151,6 +151,19 @@ def wait_until_running(list_instances: Callable[[], List[Any]],
         time.sleep(poll_seconds)
 
 
+def require_public_key(authentication_config: Dict[str, Any]) -> str:
+    """The cluster SSH public key, or a clear error NOW — registering
+    an empty key account-wide launches instances nobody can reach,
+    failing much later with a confusing auth error."""
+    from skypilot_tpu import exceptions
+    key = (authentication_config or {}).get('ssh_public_key_content')
+    if not key:
+        raise exceptions.ProvisionError(
+            'No SSH public key configured for this launch '
+            '(authentication_config.ssh_public_key_content is empty).')
+    return key
+
+
 def refuse_unresumable(state: Optional[str], name: str) -> None:
     """Shared launch-loop guard: an instance in a transitional state
     ('stopping') must block relaunch — creating a same-name twin

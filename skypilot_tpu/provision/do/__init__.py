@@ -103,8 +103,8 @@ def run_instances(region: str, cluster_name_on_cloud: str,
     resumed: List[str] = []
     try:
         key_id = _ensure_ssh_key(
-            client, config.authentication_config.get(
-                'ssh_public_key_content', ''))
+            client,
+            common.require_public_key(config.authentication_config))
         for i in range(config.count):
             name = f'{cluster_name_on_cloud}-{i}'
             droplet = existing.get(name)

@@ -70,8 +70,8 @@ def run_instances(region: str, cluster_name_on_cloud: str,
     created: List[str] = []
     try:
         key_name = _ensure_ssh_key(
-            client, config.authentication_config.get(
-                'ssh_public_key_content', ''))
+            client,
+            common.require_public_key(config.authentication_config))
         for i in range(config.count):
             name = f'{cluster_name_on_cloud}-{i}'
             if name in alive:

@@ -25,7 +25,19 @@ _MAX_PARALLEL = {'long': 4, 'short': 16}
 _CANCEL_GRACE_SECONDS = float(os.environ.get(
     'SKYTPU_CANCEL_GRACE_SECONDS', '5'))
 
-_mp = multiprocessing.get_context('fork')
+_mp_fork = multiprocessing.get_context('fork')
+_mp_spawn = multiprocessing.get_context('spawn')
+
+
+def _mp_context():
+    """fork is the fast path; but forking a parent whose threads hold
+    jax's internal locks deadlocks ~2% of children (the server itself
+    never imports jax — test processes and embedded uses do). Spawn
+    costs a fresh interpreter but can't inherit a held lock."""
+    import sys
+    if 'jax' in sys.modules:
+        return _mp_spawn
+    return _mp_fork
 
 
 def register(name: str):
@@ -41,6 +53,8 @@ def _run_in_child(request_id: str, name: str,
     os.setsid()  # own process group => cancellable subtree
     from skypilot_tpu.utils import context as context_lib
     context_lib.install_sigterm_handler()
+    from skypilot_tpu.server import impl  # noqa: F401 — spawn-start
+    del impl                              # children need the REGISTRY
     requests_db.reset_for_tests()  # never share the parent's connection
     log_path = requests_db.request_log_path(request_id)
     log_fd = os.open(log_path, os.O_WRONLY | os.O_APPEND | os.O_CREAT,
@@ -106,9 +120,9 @@ class Executor:
                 return  # cancelled while queued
             # daemon: a wedged worker must never block process exit
             # (it is SIGTERMed by mp atexit instead of joined).
-            proc = _mp.Process(target=_run_in_child,
-                               args=(request_id, name, payload),
-                               daemon=True)
+            proc = _mp_context().Process(
+                target=_run_in_child,
+                args=(request_id, name, payload), daemon=True)
             proc.start()
             with self._lock:
                 self._procs[request_id] = proc

@@ -8,11 +8,12 @@ becomes an async request executed by the executor; clients poll
 import enum
 import json
 import os
+import random
 import sqlite3
 import threading
 import time
 import uuid
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from skypilot_tpu.utils import paths
 
@@ -65,6 +66,7 @@ def _get_conn() -> sqlite3.Connection:
             _conn = sqlite3.connect(path, check_same_thread=False,
                                     timeout=30.0)
             _conn.execute('PRAGMA journal_mode=WAL')
+            _conn.execute('PRAGMA busy_timeout=30000')
             _conn.execute("""
                 CREATE TABLE IF NOT EXISTS requests (
                     request_id TEXT PRIMARY KEY,
@@ -93,17 +95,56 @@ def reset_for_tests() -> None:
         _conn_path = None
 
 
+def _write_with_retry(op: Callable[[], None], what: str,
+                      attempts: int = 6) -> None:
+    """SQLite can return SQLITE_BUSY *immediately* (not honoring
+    busy_timeout) on write-upgrade contention; under a 50-way forked
+    writer storm that loses terminal-status writes and strands
+    requests as RUNNING forever. Jittered retries make the write
+    stick."""
+    import logging
+    for attempt in range(attempts):
+        try:
+            op()
+            return
+        except sqlite3.OperationalError as e:
+            msg = str(e).lower()
+            if 'locked' not in msg and 'busy' not in msg:
+                raise
+            # A commit-time BUSY leaves the implicit transaction OPEN
+            # on the shared connection: without rollback the retried
+            # INSERT would hit its own half-done write (UNIQUE
+            # constraint) and the open tx would leak into whichever
+            # write commits next.
+            try:
+                with _lock:
+                    if _conn is not None:
+                        _conn.rollback()
+            except sqlite3.Error:
+                pass
+            if attempt == attempts - 1:
+                raise
+            logging.getLogger(__name__).warning(
+                '%s: SQLITE_BUSY, retry %d/%d', what, attempt + 1,
+                attempts - 1)
+            time.sleep(0.2 * (2 ** attempt) * (0.5 + random.random()))
+
+
 def create_request(name: str, payload: Dict[str, Any],
                    schedule: str = 'long') -> str:
     request_id = uuid.uuid4().hex[:16]
     conn = _get_conn()
-    with _lock:
-        conn.execute(
-            'INSERT INTO requests (request_id, name, payload, status, '
-            'schedule, created_at) VALUES (?,?,?,?,?,?)',
-            (request_id, name, json.dumps(payload),
-             RequestStatus.PENDING.value, schedule, time.time()))
-        conn.commit()
+
+    def _op():
+        with _lock:
+            conn.execute(
+                'INSERT INTO requests (request_id, name, payload, '
+                'status, schedule, created_at) VALUES (?,?,?,?,?,?)',
+                (request_id, name, json.dumps(payload),
+                 RequestStatus.PENDING.value, schedule, time.time()))
+            conn.commit()
+
+    _write_with_retry(_op, 'create_request')
     # Touch the log file so streams can open it immediately.
     open(request_log_path(request_id), 'a', encoding='utf-8').close()
     return request_id
@@ -111,27 +152,36 @@ def create_request(name: str, payload: Dict[str, Any],
 
 def set_running(request_id: str, pid: int) -> None:
     conn = _get_conn()
-    with _lock:
-        conn.execute(
-            'UPDATE requests SET status=?, started_at=?, pid=? '
-            'WHERE request_id=? AND status=?',
-            (RequestStatus.RUNNING.value, time.time(), pid, request_id,
-             RequestStatus.PENDING.value))
-        conn.commit()
+
+    def _op():
+        with _lock:
+            conn.execute(
+                'UPDATE requests SET status=?, started_at=?, pid=? '
+                'WHERE request_id=? AND status=?',
+                (RequestStatus.RUNNING.value, time.time(), pid,
+                 request_id, RequestStatus.PENDING.value))
+            conn.commit()
+
+    _write_with_retry(_op, 'set_running')
 
 
 def set_result(request_id: str, result: Any) -> None:
     conn = _get_conn()
-    with _lock:
-        # Status guard mirrors set_error: a request cancelled while the
-        # forked worker was finishing must stay CANCELLED.
-        conn.execute(
-            'UPDATE requests SET status=?, finished_at=?, result=? '
-            'WHERE request_id=? AND status IN (?,?)',
-            (RequestStatus.SUCCEEDED.value, time.time(),
-             json.dumps(result), request_id,
-             RequestStatus.PENDING.value, RequestStatus.RUNNING.value))
-        conn.commit()
+
+    def _op():
+        with _lock:
+            # Status guard mirrors set_error: a request cancelled while
+            # the forked worker was finishing must stay CANCELLED.
+            conn.execute(
+                'UPDATE requests SET status=?, finished_at=?, result=? '
+                'WHERE request_id=? AND status IN (?,?)',
+                (RequestStatus.SUCCEEDED.value, time.time(),
+                 json.dumps(result), request_id,
+                 RequestStatus.PENDING.value,
+                 RequestStatus.RUNNING.value))
+            conn.commit()
+
+    _write_with_retry(_op, 'set_result')
 
 
 def set_error(request_id: str, error: str,
@@ -139,13 +189,18 @@ def set_error(request_id: str, error: str,
     status = (RequestStatus.CANCELLED if cancelled else
               RequestStatus.FAILED)
     conn = _get_conn()
-    with _lock:
-        conn.execute(
-            'UPDATE requests SET status=?, finished_at=?, error=? '
-            'WHERE request_id=? AND status IN (?,?)',
-            (status.value, time.time(), error, request_id,
-             RequestStatus.PENDING.value, RequestStatus.RUNNING.value))
-        conn.commit()
+
+    def _op():
+        with _lock:
+            conn.execute(
+                'UPDATE requests SET status=?, finished_at=?, error=? '
+                'WHERE request_id=? AND status IN (?,?)',
+                (status.value, time.time(), error, request_id,
+                 RequestStatus.PENDING.value,
+                 RequestStatus.RUNNING.value))
+            conn.commit()
+
+    _write_with_retry(_op, 'set_error')
 
 
 _COLS = ('request_id, name, payload, status, schedule, created_at, '

@@ -1,0 +1,95 @@
+"""API-server load harness: concurrent request storm.
+
+Reference analog: tests/load_tests/test_load_on_server.py + README
+(the reference records 96.9% CPU / 11.78 GB RSS at 50 concurrent
+requests). Ours asserts the contract rather than recording numbers:
+under a 50-request storm every request completes, nothing 5xxes, the
+queue drains, and the server process's RSS stays bounded.
+"""
+import concurrent.futures
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from skypilot_tpu.client import sdk
+from skypilot_tpu.server import app as app_mod
+from skypilot_tpu.server import requests_db
+
+
+@pytest.fixture
+def server(monkeypatch):
+    requests_db.reset_for_tests()
+    with app_mod.ServerThread() as srv:
+        monkeypatch.setenv('SKYTPU_API_SERVER_URL', srv.url)
+        yield srv
+    requests_db.reset_for_tests()
+
+
+def _rss_mb(pid: int) -> float:
+    with open(f'/proc/{pid}/status', 'r', encoding='utf-8') as f:
+        for line in f:
+            if line.startswith('VmRSS:'):
+                return int(line.split()[1]) / 1024.0
+    return 0.0
+
+
+@pytest.mark.slow
+def test_fifty_concurrent_requests_complete(server, enable_clouds):
+    enable_clouds('local')
+    n = 50
+
+    def one(i):
+        t0 = time.time()
+        request_id = sdk.status()
+        result = sdk.get(request_id, timeout=120)
+        assert isinstance(result, list)
+        return time.time() - t0
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=n) as pool:
+        latencies = sorted(pool.map(one, range(n)))
+    # Everything completed; the SHORT-request pool kept the tail sane
+    # even with 50-way concurrency on one core.
+    assert len(latencies) == n
+    p95 = latencies[int(n * 0.95) - 1]
+    assert p95 < 90.0, f'p95 {p95:.1f}s'
+
+    # Queue drained: no request left PENDING/RUNNING.
+    records = requests_db.list_requests(200)
+    assert all(r['status'].is_terminal for r in records)
+
+    # Bounded memory on the serving process (reference envelope is
+    # 11.78 GB at this concurrency on a server VM; we only guard
+    # against runaway growth, not a specific number).
+    assert _rss_mb(os.getpid()) < 4096
+
+
+def test_storm_of_invalid_payloads_all_400(server):
+    """Malformed bodies must be rejected fast at the validation layer
+    — none may reach the executor or crash the server."""
+    n = 30
+
+    def one(i):
+        body = json.dumps({'bogus_field': i}).encode()
+        req = urllib.request.Request(
+            f'{server.url}/api/v1/launch', data=body,
+            headers={'Content-Type': 'application/json'},
+            method='POST')
+        try:
+            with urllib.request.urlopen(req, timeout=30):
+                return 200
+        except urllib.error.HTTPError as e:
+            return e.code
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=n) as pool:
+        codes = list(pool.map(one, range(n)))
+    assert all(c == 400 for c in codes), codes
+    # Server is still healthy afterwards.
+    with urllib.request.urlopen(f'{server.url}/api/v1/health',
+                                timeout=10) as resp:
+        assert resp.status == 200
+    # Nothing was enqueued for the executor.
+    assert requests_db.list_requests(10) == []
