@@ -74,7 +74,7 @@ def _user_data(config: common.ProvisionConfig) -> str:
     """cloud-init that authorizes our deterministic SSH key."""
     auth = config.authentication_config
     user = auth.get('ssh_user', 'skytpu')
-    pub = auth.get('ssh_public_key_content', '')
+    pub = common.require_public_key(auth)
     return (f'#cloud-config\n'
             f'users:\n'
             f'  - name: {user}\n'

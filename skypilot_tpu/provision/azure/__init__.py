@@ -219,8 +219,7 @@ def _create_vm(client, sub: str, rg: str, region: str, name: str,
                     'disablePasswordAuthentication': True,
                     'ssh': {'publicKeys': [{
                         'path': f'/home/{ssh_user}/.ssh/authorized_keys',
-                        'keyData': auth.get('ssh_public_key_content',
-                                            ''),
+                        'keyData': common.require_public_key(auth),
                     }]},
                 },
             },

@@ -102,8 +102,8 @@ def run_instances(region: str, cluster_name_on_cloud: str,
                 continue
             ssh_user = config.authentication_config.get(
                 'ssh_user', 'skytpu')
-            public_key = config.authentication_config.get(
-                'ssh_public_key_content', '')
+            public_key = common.require_public_key(
+                config.authentication_config)
             body = {
                 'metadata': {'parentId': project, 'name': name},
                 'spec': {

@@ -78,8 +78,8 @@ def run_instances(region: str, cluster_name_on_cloud: str,
                               else 'SECURE'),
                 'containerDiskInGb': int(nc.get('disk_size', 64)),
                 'ports': ['22/tcp'],
-                'env': {'PUBLIC_KEY': config.authentication_config.get(
-                    'ssh_public_key_content', '')},
+                'env': {'PUBLIC_KEY': common.require_public_key(
+                    config.authentication_config)},
                 'dataCenterIds': [region] if region else [],
                 'interruptible': bool(nc.get('use_spot')),
             }

@@ -118,9 +118,9 @@ def run_instances(region: str, cluster_name_on_cloud: str,
                                # mkdir first: stock container images
                                # ship without ~/.ssh.
                                'onstart': ('mkdir -p ~/.ssh && echo "'
-                                           + config.authentication_config
-                                           .get('ssh_public_key_content',
-                                                '')
+                                           + common.require_public_key(
+                                               config
+                                               .authentication_config)
                                            + '" >> ~/.ssh/authorized_keys'
                                            ),
                                'runtype': 'ssh',

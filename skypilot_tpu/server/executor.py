@@ -151,13 +151,27 @@ class Executor:
             try:
                 os.killpg(proc.pid, signal.SIGTERM)
             except ProcessLookupError:
-                return True
+                # Spawn-start window: the child interpreter hasn't run
+                # os.setsid() yet, so no pgid==pid group exists — but
+                # the process is very much alive and about to execute
+                # the request. Signal the pid directly and STILL run
+                # the escalation (returning here would let a
+                # "cancelled" request provision real resources).
+                try:
+                    proc.terminate()
+                except (ProcessLookupError, ValueError):
+                    pass
+
             def _escalate(p=proc):
                 p.join(timeout=_CANCEL_GRACE_SECONDS)
-                if p.is_alive() and p.pid:
+                if not p.is_alive() or not p.pid:
+                    return
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except ProcessLookupError:
                     try:
-                        os.killpg(p.pid, signal.SIGKILL)
-                    except ProcessLookupError:
+                        p.kill()
+                    except (ProcessLookupError, ValueError):
                         pass
             threading.Thread(target=_escalate, daemon=True).start()
         return True

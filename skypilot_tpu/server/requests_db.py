@@ -95,6 +95,25 @@ def reset_for_tests() -> None:
         _conn_path = None
 
 
+def _locked_write(conn: sqlite3.Connection, sql: str,
+                  params: tuple) -> None:
+    """Execute+commit under the module lock. On a BUSY commit the
+    half-done statement is rolled back INSIDE the same lock hold —
+    releasing the lock first would let another writer on the shared
+    connection commit our partial write, turning the retry into a
+    UNIQUE-constraint error."""
+    with _lock:
+        try:
+            conn.execute(sql, params)
+            conn.commit()
+        except sqlite3.OperationalError:
+            try:
+                conn.rollback()
+            except sqlite3.Error:
+                pass
+            raise
+
+
 def _write_with_retry(op: Callable[[], None], what: str,
                       attempts: int = 6) -> None:
     """SQLite can return SQLITE_BUSY *immediately* (not honoring
@@ -111,17 +130,6 @@ def _write_with_retry(op: Callable[[], None], what: str,
             msg = str(e).lower()
             if 'locked' not in msg and 'busy' not in msg:
                 raise
-            # A commit-time BUSY leaves the implicit transaction OPEN
-            # on the shared connection: without rollback the retried
-            # INSERT would hit its own half-done write (UNIQUE
-            # constraint) and the open tx would leak into whichever
-            # write commits next.
-            try:
-                with _lock:
-                    if _conn is not None:
-                        _conn.rollback()
-            except sqlite3.Error:
-                pass
             if attempt == attempts - 1:
                 raise
             logging.getLogger(__name__).warning(
@@ -134,17 +142,14 @@ def create_request(name: str, payload: Dict[str, Any],
                    schedule: str = 'long') -> str:
     request_id = uuid.uuid4().hex[:16]
     conn = _get_conn()
-
-    def _op():
-        with _lock:
-            conn.execute(
-                'INSERT INTO requests (request_id, name, payload, '
-                'status, schedule, created_at) VALUES (?,?,?,?,?,?)',
-                (request_id, name, json.dumps(payload),
-                 RequestStatus.PENDING.value, schedule, time.time()))
-            conn.commit()
-
-    _write_with_retry(_op, 'create_request')
+    _write_with_retry(
+        lambda: _locked_write(
+            conn,
+            'INSERT INTO requests (request_id, name, payload, '
+            'status, schedule, created_at) VALUES (?,?,?,?,?,?)',
+            (request_id, name, json.dumps(payload),
+             RequestStatus.PENDING.value, schedule, time.time())),
+        'create_request')
     # Touch the log file so streams can open it immediately.
     open(request_log_path(request_id), 'a', encoding='utf-8').close()
     return request_id
@@ -152,36 +157,29 @@ def create_request(name: str, payload: Dict[str, Any],
 
 def set_running(request_id: str, pid: int) -> None:
     conn = _get_conn()
-
-    def _op():
-        with _lock:
-            conn.execute(
-                'UPDATE requests SET status=?, started_at=?, pid=? '
-                'WHERE request_id=? AND status=?',
-                (RequestStatus.RUNNING.value, time.time(), pid,
-                 request_id, RequestStatus.PENDING.value))
-            conn.commit()
-
-    _write_with_retry(_op, 'set_running')
+    _write_with_retry(
+        lambda: _locked_write(
+            conn,
+            'UPDATE requests SET status=?, started_at=?, pid=? '
+            'WHERE request_id=? AND status=?',
+            (RequestStatus.RUNNING.value, time.time(), pid,
+             request_id, RequestStatus.PENDING.value)),
+        'set_running')
 
 
 def set_result(request_id: str, result: Any) -> None:
+    # Status guard mirrors set_error: a request cancelled while the
+    # forked worker was finishing must stay CANCELLED.
     conn = _get_conn()
-
-    def _op():
-        with _lock:
-            # Status guard mirrors set_error: a request cancelled while
-            # the forked worker was finishing must stay CANCELLED.
-            conn.execute(
-                'UPDATE requests SET status=?, finished_at=?, result=? '
-                'WHERE request_id=? AND status IN (?,?)',
-                (RequestStatus.SUCCEEDED.value, time.time(),
-                 json.dumps(result), request_id,
-                 RequestStatus.PENDING.value,
-                 RequestStatus.RUNNING.value))
-            conn.commit()
-
-    _write_with_retry(_op, 'set_result')
+    _write_with_retry(
+        lambda: _locked_write(
+            conn,
+            'UPDATE requests SET status=?, finished_at=?, result=? '
+            'WHERE request_id=? AND status IN (?,?)',
+            (RequestStatus.SUCCEEDED.value, time.time(),
+             json.dumps(result), request_id,
+             RequestStatus.PENDING.value, RequestStatus.RUNNING.value)),
+        'set_result')
 
 
 def set_error(request_id: str, error: str,
@@ -189,18 +187,14 @@ def set_error(request_id: str, error: str,
     status = (RequestStatus.CANCELLED if cancelled else
               RequestStatus.FAILED)
     conn = _get_conn()
-
-    def _op():
-        with _lock:
-            conn.execute(
-                'UPDATE requests SET status=?, finished_at=?, error=? '
-                'WHERE request_id=? AND status IN (?,?)',
-                (status.value, time.time(), error, request_id,
-                 RequestStatus.PENDING.value,
-                 RequestStatus.RUNNING.value))
-            conn.commit()
-
-    _write_with_retry(_op, 'set_error')
+    _write_with_retry(
+        lambda: _locked_write(
+            conn,
+            'UPDATE requests SET status=?, finished_at=?, error=? '
+            'WHERE request_id=? AND status IN (?,?)',
+            (status.value, time.time(), error, request_id,
+             RequestStatus.PENDING.value, RequestStatus.RUNNING.value)),
+        'set_error')
 
 
 _COLS = ('request_id, name, payload, status, schedule, created_at, '

@@ -90,6 +90,10 @@ def main() -> None:
     parser.add_argument('--mesh', default='fsdp=-1',
                         help='Comma-separated axis=size, e.g. '
                         'data=2,fsdp=4,tensor=2 (-1 fills).')
+    parser.add_argument('--attention', default=None,
+                        choices=['dense', 'blockwise', 'ring', 'flash'],
+                        help='Override the preset attention impl '
+                        '(ring = context-parallel long sequences).')
     args = parser.parse_args()
 
     spec = mesh_lib.MeshSpec.from_dict(dict(
@@ -98,7 +102,8 @@ def main() -> None:
     cfg = trainer_lib.TrainerConfig(
         model=args.model, batch_size=args.batch_size,
         seq_len=args.seq_len, max_steps=args.max_steps,
-        learning_rate=args.learning_rate)
+        learning_rate=args.learning_rate,
+        attention_impl=args.attention)
     fit(cfg, mesh, checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every)
 

@@ -33,10 +33,25 @@ class TrainerConfig:
     # Adam first moment dtype: 'bfloat16' halves its HBM footprint
     # (standard large-model practice); None keeps f32.
     mu_dtype: Optional[str] = None
+    # Override the preset's attention impl (dense/blockwise/ring/
+    # flash) — e.g. ring for context-parallel long-sequence runs.
+    attention_impl: Optional[str] = None
 
     def model_config(self):
+        import dataclasses as _dc
+
         import skypilot_tpu.models as models_lib
-        return models_lib.resolve(self.model)[1]
+        cfg = models_lib.resolve(self.model)[1]
+        if self.attention_impl is not None:
+            if not hasattr(cfg, 'attention_impl'):
+                # Never drop the override silently: running a
+                # long-context job with dense attention because the
+                # flag didn't apply is an OOM or a perf cliff.
+                raise ValueError(
+                    f'Model {self.model!r} does not support an '
+                    'attention override.')
+            cfg = _dc.replace(cfg, attention_impl=self.attention_impl)
+        return cfg
 
     def model_family(self):
         import skypilot_tpu.models as models_lib

@@ -86,6 +86,15 @@ def test_param_sharding_applied():
     assert spec[2] == 'tensor'
 
 
+def test_attention_impl_override():
+    """TrainerConfig.attention_impl (the `train.loop --attention` flag)
+    rewrites the preset's impl without mutating the preset."""
+    cfg = _train_cfg(attention_impl='ring')
+    assert cfg.model_config().attention_impl == 'ring'
+    assert llama.CONFIGS['tiny'].attention_impl == 'dense'
+    assert _train_cfg().model_config().attention_impl == 'dense'
+
+
 def test_ring_attention_model_matches_dense():
     """Same params+batch, dense vs ring impl → same loss."""
     ring_cfg = dataclasses.replace(TINY, attention_impl='ring')

@@ -1,0 +1,89 @@
+"""llm/ recipe gallery: every recipe must parse, resolve its model,
+and invoke only CLI flags that actually exist.
+
+Reference analog: the llm/ gallery is the reference's most-used user
+surface; a recipe that drifts from the trainer/server CLI is a
+production outage at launch time, so the gallery is linted in CI.
+"""
+import argparse
+import glob
+import os
+import re
+
+import pytest
+
+from skypilot_tpu import models as models_lib
+from skypilot_tpu import task as task_lib
+
+RECIPES = sorted(glob.glob(
+    os.path.join(os.path.dirname(__file__), '..', '..', 'llm',
+                 '*.yaml')))
+
+
+def _flags_of(parser: argparse.ArgumentParser):
+    out = set()
+    for action in parser._actions:  # noqa: SLF001 — lint-time only
+        out.update(a for a in action.option_strings)
+    return out
+
+
+def _parser_flags(module_main) -> set:
+    """Capture the ArgumentParser a main() builds without running it."""
+    captured = {}
+    orig = argparse.ArgumentParser.parse_args
+
+    def fake_parse(self, *a, **k):
+        captured['parser'] = self
+        raise SystemExit(0)
+
+    argparse.ArgumentParser.parse_args = fake_parse
+    try:
+        with pytest.raises(SystemExit):
+            module_main()
+    finally:
+        argparse.ArgumentParser.parse_args = orig
+    return _flags_of(captured['parser'])
+
+
+@pytest.fixture(scope='module')
+def trainer_flags():
+    from skypilot_tpu.train import loop
+    return _parser_flags(loop.main)
+
+
+@pytest.fixture(scope='module')
+def server_flags():
+    from skypilot_tpu.inference import server
+    return _parser_flags(server.main)
+
+
+def test_gallery_is_nonempty():
+    assert len(RECIPES) >= 6
+
+
+@pytest.mark.parametrize('path', RECIPES,
+                         ids=[os.path.basename(p) for p in RECIPES])
+def test_recipe_valid(path, trainer_flags, server_flags):
+    task = task_lib.Task.from_yaml(path)
+    assert task.run, path
+    run = task.run
+
+    # The model named in the run command must resolve.
+    model_match = re.search(r'--model\s+(\S+)', run)
+    assert model_match, 'recipe must name a --model'
+    models_lib.resolve(model_match.group(1))
+
+    # Every flag used must exist on the module being invoked.
+    if 'train.loop' in run:
+        known = trainer_flags
+    elif 'inference.server' in run:
+        known = server_flags
+    else:
+        raise AssertionError(f'unknown entrypoint in {path}')
+    used = set(re.findall(r'(--[a-z][a-z0-9-]*)', run))
+    unknown = used - known
+    assert not unknown, f'{path}: unknown flags {sorted(unknown)}'
+
+    # Serving recipes must probe the real health endpoint.
+    if task.service is not None:
+        assert task.service.readiness_probe.path == '/health'
