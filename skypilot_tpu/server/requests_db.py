@@ -66,7 +66,6 @@ def _get_conn() -> sqlite3.Connection:
             _conn = sqlite3.connect(path, check_same_thread=False,
                                     timeout=30.0)
             _conn.execute('PRAGMA journal_mode=WAL')
-            _conn.execute('PRAGMA busy_timeout=30000')
             _conn.execute("""
                 CREATE TABLE IF NOT EXISTS requests (
                     request_id TEXT PRIMARY KEY,
