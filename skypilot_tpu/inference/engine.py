@@ -61,11 +61,16 @@ def init_cache(config: llama.LlamaConfig, batch_size: int,
 
 def _cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                       q_positions: jax.Array,
-                      lengths: jax.Array) -> jax.Array:
+                      lengths: jax.Array,
+                      window: Optional[jax.Array] = None,
+                      softcap: Optional[float] = None) -> jax.Array:
     """Attention of q [B,T,H,D] against the padded cache [B,S,KV,D].
 
     Valid keys per slot b: positions < lengths[b] (the cache already
-    contains this step's keys). Masking by position keeps shapes static.
+    contains this step's keys). Masking by position keeps shapes
+    static. `window` (traced scalar, Mistral/Gemma local layers)
+    hides keys older than `window` positions; `softcap` applies
+    Gemma-style logit capping.
     """
     num_heads = q.shape[2]
     b, s, hkv, d = k_cache.shape
@@ -80,11 +85,16 @@ def _cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     scale = 1.0 / math.sqrt(q.shape[-1])
     scores = jnp.einsum('bqhd,bkhd->bhqk', q, k_cache,
                         preferred_element_type=jnp.float32) * scale
+    if softcap is not None:
+        scores = softcap * jnp.tanh(scores / softcap)
     k_pos = jnp.arange(s)
     # causal within the written region: key visible iff pos <= q_position
     # and pos < length.
     visible = (k_pos[None, None, :] <= q_positions[:, :, None]) & \
         (k_pos[None, None, :] < lengths[:, None, None])
+    if window is not None:
+        visible = visible & (
+            q_positions[:, :, None] - k_pos[None, None, :] < window)
     scores = jnp.where(visible[:, None], scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
     return jnp.einsum('bhqk,bkhd->bqhd', probs, v_cache)
@@ -94,15 +104,21 @@ def _layer_with_cache(x: jax.Array, layer_params: Params,
                       k_cache: jax.Array, v_cache: jax.Array,
                       positions: jax.Array, lengths: jax.Array,
                       write_at: jax.Array,
-                      config: llama.LlamaConfig
+                      config: llama.LlamaConfig,
+                      window: Optional[jax.Array] = None
                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One transformer layer over T new tokens with KV-cache update.
 
     x: [B,T,E]; positions: [B,T] global positions of the new tokens;
     write_at: [B] cache index where token 0 of this chunk lands.
+    Family knobs ((1+w) norms, GeGLU, post-norms, softcap, q scaling,
+    sliding window) mirror llama._layer exactly — the decode path must
+    compute what the training forward computes.
     """
     c = config
-    h = llama._rms_norm(x, layer_params['attn_norm'], c.rms_norm_eps)
+    plus_one = c.norm_plus_one
+    h = llama._rms_norm(x, layer_params['attn_norm'], c.rms_norm_eps,
+                        plus_one)
     q = jnp.einsum('bse,ehd->bshd', h, layer_params['wq'],
                    preferred_element_type=jnp.float32).astype(c.dtype)
     k = jnp.einsum('bse,ehd->bshd', h, layer_params['wk'],
@@ -111,6 +127,8 @@ def _layer_with_cache(x: jax.Array, layer_params: Params,
                    preferred_element_type=jnp.float32).astype(c.dtype)
     q = llama._rope(q, positions, c.rope_theta)
     k = llama._rope(k, positions, c.rope_theta)
+    if c.query_pre_attn_scalar is not None:
+        q = q * math.sqrt(c.head_dim / c.query_pre_attn_scalar)
 
     # Scatter the T new KV entries into the cache at write_at per slot.
     def write_one(cache_b, new_b, at_b):
@@ -119,20 +137,32 @@ def _layer_with_cache(x: jax.Array, layer_params: Params,
     k_cache = jax.vmap(write_one)(k_cache, k, write_at)
     v_cache = jax.vmap(write_one)(v_cache, v, write_at)
 
-    attn = _cached_attention(q, k_cache, v_cache, positions, lengths)
+    attn = _cached_attention(q, k_cache, v_cache, positions, lengths,
+                             window=window,
+                             softcap=c.attn_logit_softcap)
     attn_out = jnp.einsum('bshd,hde->bse', attn.astype(c.dtype),
                           layer_params['wo'],
                           preferred_element_type=jnp.float32).astype(c.dtype)
+    if c.post_norms:
+        attn_out = llama._rms_norm(attn_out,
+                                   layer_params['post_attn_norm'],
+                                   c.rms_norm_eps, plus_one)
     x = x + attn_out
 
-    h = llama._rms_norm(x, layer_params['mlp_norm'], c.rms_norm_eps)
+    h = llama._rms_norm(x, layer_params['mlp_norm'], c.rms_norm_eps,
+                        plus_one)
     gate = jnp.einsum('bse,em->bsm', h, layer_params['w_gate'],
                       preferred_element_type=jnp.float32)
     up = jnp.einsum('bse,em->bsm', h, layer_params['w_up'],
                     preferred_element_type=jnp.float32)
-    act = (jax.nn.silu(gate) * up).astype(c.dtype)
+    act_fn = (functools.partial(jax.nn.gelu, approximate=True)
+              if c.activation == 'gelu' else jax.nn.silu)
+    act = (act_fn(gate) * up).astype(c.dtype)
     down = jnp.einsum('bsm,me->bse', act, layer_params['w_down'],
                       preferred_element_type=jnp.float32).astype(c.dtype)
+    if c.post_norms:
+        down = llama._rms_norm(down, layer_params['post_mlp_norm'],
+                               c.rms_norm_eps, plus_one)
     return x + down, k_cache, v_cache
 
 
@@ -144,20 +174,44 @@ def _forward_with_cache(params: Params, tokens: jax.Array,
     """tokens [B,T] at `positions` → (logits [B,T,V], updated cache)."""
     c = config
     x = params['embed'].astype(c.dtype)[tokens]
+    if c.embed_scale:
+        x = x * jnp.asarray(math.sqrt(c.hidden_size), c.dtype)
 
-    def body(x, per_layer):
-        layer_params, k_cache, v_cache = per_layer
-        x, k_cache, v_cache = _layer_with_cache(
-            x, layer_params, k_cache, v_cache, positions, new_lengths,
-            write_at, c)
-        return x, (k_cache, v_cache)
+    if c.sliding_window is None:
+        def body(x, per_layer):
+            layer_params, k_cache, v_cache = per_layer
+            x, k_cache, v_cache = _layer_with_cache(
+                x, layer_params, k_cache, v_cache, positions,
+                new_lengths, write_at, c)
+            return x, (k_cache, v_cache)
 
-    x, (new_k, new_v) = lax.scan(body, x,
-                                 (params['layers'], cache['k'],
-                                  cache['v']))
-    x = llama._rms_norm(x, params['final_norm'], c.rms_norm_eps)
-    logits = jnp.einsum('bse,ev->bsv', x, params['lm_head'],
+        x, (new_k, new_v) = lax.scan(body, x,
+                                     (params['layers'], cache['k'],
+                                      cache['v']))
+    else:
+        # The shared schedule: cached decode and the training forward
+        # must window identically (llama.layer_windows).
+        windows = llama.layer_windows(c)
+
+        def body(x, per_layer):
+            layer_params, k_cache, v_cache, window = per_layer
+            x, k_cache, v_cache = _layer_with_cache(
+                x, layer_params, k_cache, v_cache, positions,
+                new_lengths, write_at, c, window=window)
+            return x, (k_cache, v_cache)
+
+        x, (new_k, new_v) = lax.scan(body, x,
+                                     (params['layers'], cache['k'],
+                                      cache['v'], windows))
+    x = llama._rms_norm(x, params['final_norm'], c.rms_norm_eps,
+                        c.norm_plus_one)
+    lm_head = (params['embed'].astype(c.dtype).T
+               if c.tied_embeddings else params['lm_head'])
+    logits = jnp.einsum('bse,ev->bsv', x, lm_head,
                         preferred_element_type=jnp.float32)
+    if c.final_logit_softcap is not None:
+        cap = c.final_logit_softcap
+        logits = cap * jnp.tanh(logits / cap)
     return logits, {'k': new_k, 'v': new_v, 'length': new_lengths}
 
 
@@ -267,29 +321,14 @@ class InferenceEngine:
                  batch_size: int = 8,
                  max_seq_len: Optional[int] = None,
                  seed: int = 0):
-        # The cached decode path implements the llama architecture;
-        # reject family knobs it would silently get wrong (windowed
-        # cache masking, GeGLU, post-norms, softcaps are future work).
-        # getattr: non-llama config classes (MoeConfig) lack these
-        # fields entirely — absent must read as 'default', not crash.
-        unsupported = {
-            'activation': getattr(config, 'activation', 'silu') != 'silu',
-            'tied_embeddings': getattr(config, 'tied_embeddings', False),
-            'embed_scale': getattr(config, 'embed_scale', False),
-            'norm_plus_one': getattr(config, 'norm_plus_one', False),
-            'post_norms': getattr(config, 'post_norms', False),
-            'attn_logit_softcap':
-                getattr(config, 'attn_logit_softcap', None) is not None,
-            'final_logit_softcap':
-                getattr(config, 'final_logit_softcap', None) is not None,
-            'sliding_window':
-                getattr(config, 'sliding_window', None) is not None,
-        }
-        bad = sorted(k for k, v in unsupported.items() if v)
-        if bad:
+        # The cached decode path mirrors the llama-core transformer
+        # (every family knob: window/GeGLU/post-norms/softcaps/tied
+        # embeddings). MoE routing has no cached implementation yet.
+        if not isinstance(config, llama.LlamaConfig):
             raise NotImplementedError(
-                'InferenceEngine supports the llama family only; '
-                f'config uses: {bad}')
+                'InferenceEngine serves llama-core families '
+                '(llama/gemma/mistral); got '
+                f'{type(config).__name__}.')
         self.params = params
         self.config = config
         self.state = DecodeState(config, batch_size, max_seq_len)

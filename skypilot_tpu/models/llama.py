@@ -211,6 +211,21 @@ def _embed_lookup(embed: jax.Array, tokens: jax.Array,
     return embed[tokens]
 
 
+def layer_windows(config: LlamaConfig) -> jax.Array:
+    """Per-layer sliding-window sizes [L] for the scan: local layers
+    get `sliding_window`, every `sliding_window_pattern`-th layer is
+    GLOBAL (sentinel 2**30 = effectively unwindowed). The training
+    forward and the cached decode path MUST share this schedule —
+    divergence is silent wrong decoding."""
+    idx = jnp.arange(config.num_layers)
+    if config.sliding_window_pattern > 1:
+        is_global = (idx + 1) % config.sliding_window_pattern == 0
+    else:
+        is_global = jnp.zeros_like(idx, jnp.bool_)
+    return jnp.where(is_global, jnp.int32(2**30),
+                     jnp.int32(config.sliding_window))
+
+
 def _rms_norm(x: jax.Array, weight: jax.Array, eps: float,
               plus_one: bool = False) -> jax.Array:
     x32 = x.astype(jnp.float32)
@@ -324,12 +339,7 @@ def forward(params: Params,
         # Per-layer local/global alternation rides the scan as a
         # traced window scalar (gemma2-style every-Nth-global; one
         # compiled layer body, no unrolling).
-        idx = jnp.arange(c.num_layers)
-        is_global = ((idx + 1) % c.sliding_window_pattern == 0) \
-            if c.sliding_window_pattern > 1 else jnp.zeros_like(idx,
-                                                               jnp.bool_)
-        windows = jnp.where(is_global, jnp.int32(2**30),
-                            jnp.int32(c.sliding_window))
+        windows = layer_windows(c)
 
         def scan_body(x, xs):
             layer_params, window = xs

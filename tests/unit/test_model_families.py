@@ -105,18 +105,38 @@ def test_gemma_forward_softcap_bound():
     assert np.abs(logits).max() <= cfg.final_logit_softcap + 1e-4
 
 
-def test_inference_engine_rejects_unsupported_families():
-    """The cached decode path is llama-only today; gemma/mistral
-    configs must be rejected loudly, not silently mis-decoded."""
+@pytest.mark.parametrize('family,model', [(gemma, 'tiny-gemma'),
+                                          (mistral, 'tiny-mistral')])
+def test_cached_decode_matches_forward(family, model):
+    """The KV-cache engine must reproduce the training forward
+    token-for-token for EVERY llama-core family — including windowed
+    layers once generation passes the window (prompt+steps > 16) and
+    gemma's softcap/post-norm/tied-embedding stack."""
+    # The oracle lives with the engine tests; family.forward IS
+    # llama.forward (config-driven core), so it applies unchanged.
+    from tests.unit.test_inference import _greedy_reference
     from skypilot_tpu import inference
-    cfg = gemma.CONFIGS['tiny-gemma']
-    params = gemma.init_params(cfg, jax.random.key(0))
-    with pytest.raises(NotImplementedError, match='sliding_window'):
+    cfg = family.CONFIGS[model]
+    params = family.init_params(cfg, jax.random.key(3))
+    prompt = [5, 9, 2, 14, 7, 11, 3, 8, 1, 12]      # 10 tokens
+    steps = 12                                       # crosses window 16
+    ref = _greedy_reference(params, cfg, prompt, steps)
+    engine = inference.InferenceEngine(params, cfg, batch_size=2,
+                                       max_seq_len=64)
+    rid = engine.submit(prompt, inference.SamplingParams(
+        temperature=0.0, max_new_tokens=steps))
+    assert engine.run_to_completion()[rid] == ref
+
+
+def test_inference_engine_rejects_moe():
+    """MoE routing has no cached decode yet — loud error, not silent
+    mis-decoding."""
+    from skypilot_tpu import inference
+    from skypilot_tpu.models import moe
+    cfg = moe.CONFIGS['tiny-moe']
+    params = moe.init_params(cfg, jax.random.key(0))
+    with pytest.raises(NotImplementedError, match='llama-core'):
         inference.InferenceEngine(params, cfg, batch_size=1)
-    mcfg = mistral.CONFIGS['tiny-mistral']
-    mparams = mistral.init_params(mcfg, jax.random.key(0))
-    with pytest.raises(NotImplementedError, match='sliding_window'):
-        inference.InferenceEngine(mparams, mcfg, batch_size=1)
 
 
 def test_resolve_finds_all_families():
