@@ -105,8 +105,12 @@ def test_gemma_forward_softcap_bound():
     assert np.abs(logits).max() <= cfg.final_logit_softcap + 1e-4
 
 
-@pytest.mark.parametrize('family,model', [(gemma, 'tiny-gemma'),
-                                          (mistral, 'tiny-mistral')])
+@pytest.mark.parametrize('family,model', [
+    (gemma, 'tiny-gemma'),
+    # mistral = the window knob alone, a strict subset of gemma's
+    # stack — redundant in default runs, kept for -m slow.
+    pytest.param(mistral, 'tiny-mistral', marks=pytest.mark.slow),
+])
 def test_cached_decode_matches_forward(family, model):
     """The KV-cache engine must reproduce the training forward
     token-for-token for EVERY llama-core family — including windowed
