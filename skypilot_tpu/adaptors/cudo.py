@@ -1,0 +1,59 @@
+"""Cudo Compute adaptor: api-key REST v1 API.
+
+Reference analog: sky/provision/cudo/ (the reference drives the
+cudo-compute SDK; the public REST surface at rest.compute.cudo.org is
+plain JSON). Credential: CUDO_API_KEY env var or ~/.config/cudo/
+cudo.yml (`key: <key>` line, the cudoctl drop location); the parent
+project comes from config or CUDO_PROJECT_ID.
+"""
+import os
+from typing import Dict, Optional
+
+from skypilot_tpu.adaptors import rest
+
+API_ENDPOINT = 'https://rest.compute.cudo.org'
+CREDENTIALS_PATH = '~/.config/cudo/cudo.yml'
+
+RestApiError = rest.RestApiError
+
+
+def get_api_key() -> Optional[str]:
+    return rest.env_or_file_credential('CUDO_API_KEY',
+                                       CREDENTIALS_PATH,
+                                       line_keys=('key', 'api_key'),
+                                       sep=':')
+
+
+def default_project_id() -> Optional[str]:
+    return os.environ.get('CUDO_PROJECT_ID')
+
+
+def _make_client() -> rest.RestClient:
+    def _headers() -> Dict[str, str]:
+        key = get_api_key()
+        if not key:
+            from skypilot_tpu import exceptions
+            raise exceptions.ProvisionError(
+                'Cudo API key not found; set CUDO_API_KEY or '
+                f'create {CREDENTIALS_PATH}.')
+        return {'Authorization': f'Bearer {key}'}
+
+    return rest.RestClient(
+        API_ENDPOINT, _headers,
+        error_code_fn=lambda payload: payload.get('code', ''))
+
+
+_slot = rest.ClientSlot(_make_client)
+client = _slot.get
+set_client_factory = _slot.set_factory
+
+
+def classify_api_error(err: RestApiError):
+    from skypilot_tpu import exceptions
+    text = str(err).lower()
+    if 'no host available' in text or 'out of stock' in text or \
+            err.status == 503:
+        return exceptions.CapacityError(str(err))
+    if 'quota' in text or 'limit' in text:
+        return exceptions.QuotaExceededError(str(err))
+    return err

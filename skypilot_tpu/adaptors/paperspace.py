@@ -1,0 +1,52 @@
+"""Paperspace adaptor: api-key REST v1 API.
+
+Reference analog: sky/provision/paperspace/utils.py (the reference
+uses `requests` against the same public API). Credential:
+PAPERSPACE_API_KEY env var or ~/.paperspace/credentials.toml
+(`api_key = "<key>"`, the pspace CLI drop location).
+"""
+from typing import Dict, Optional
+
+from skypilot_tpu.adaptors import rest
+
+API_ENDPOINT = 'https://api.paperspace.com/v1'
+CREDENTIALS_PATH = '~/.paperspace/credentials.toml'
+
+RestApiError = rest.RestApiError
+
+
+def get_api_key() -> Optional[str]:
+    return rest.env_or_file_credential('PAPERSPACE_API_KEY',
+                                       CREDENTIALS_PATH,
+                                       line_keys=('api_key', 'apiKey'))
+
+
+def _make_client() -> rest.RestClient:
+    def _headers() -> Dict[str, str]:
+        key = get_api_key()
+        if not key:
+            from skypilot_tpu import exceptions
+            raise exceptions.ProvisionError(
+                'Paperspace API key not found; set PAPERSPACE_API_KEY '
+                f'or create {CREDENTIALS_PATH}.')
+        return {'Authorization': f'Bearer {key}'}
+
+    return rest.RestClient(
+        API_ENDPOINT, _headers,
+        error_code_fn=lambda payload: str(payload.get('error', '')))
+
+
+_slot = rest.ClientSlot(_make_client)
+client = _slot.get
+set_client_factory = _slot.set_factory
+
+
+def classify_api_error(err: RestApiError):
+    from skypilot_tpu import exceptions
+    text = str(err).lower()
+    if 'out of capacity' in text or 'no available' in text or \
+            err.status == 503:
+        return exceptions.CapacityError(str(err))
+    if 'quota' in text or 'limit' in text:
+        return exceptions.QuotaExceededError(str(err))
+    return err

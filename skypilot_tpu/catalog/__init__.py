@@ -18,6 +18,8 @@ _CATALOG_MODULES = {
     'do': 'skypilot_tpu.catalog.do_catalog',
     'fluidstack': 'skypilot_tpu.catalog.fluidstack_catalog',
     'vast': 'skypilot_tpu.catalog.vast_catalog',
+    'cudo': 'skypilot_tpu.catalog.cudo_catalog',
+    'paperspace': 'skypilot_tpu.catalog.paperspace_catalog',
     'local': 'skypilot_tpu.catalog.local_catalog',
     'kubernetes': 'skypilot_tpu.catalog.kubernetes_catalog',
 }

@@ -1,0 +1,8 @@
+"""Cudo catalog: machine-type slugs from the shipped CSV.
+
+Reference analog: sky/catalog/cudo_catalog.py.
+"""
+from skypilot_tpu.catalog import common
+
+list_accelerators, get_feasible, validate_region_zone = \
+    common.make_vm_catalog('cudo', zones_modeled=False)

@@ -1,0 +1,8 @@
+"""Paperspace catalog: machine types from the shipped CSV.
+
+Reference analog: sky/catalog/paperspace_catalog.py.
+"""
+from skypilot_tpu.catalog import common
+
+list_accelerators, get_feasible, validate_region_zone = \
+    common.make_vm_catalog('paperspace', zones_modeled=False)

@@ -2,8 +2,10 @@
 from skypilot_tpu.clouds.cloud import Cloud, CloudCapability
 from skypilot_tpu.clouds import aws as _aws  # noqa: F401 (registers)
 from skypilot_tpu.clouds import azure as _azure  # noqa: F401 (registers)
+from skypilot_tpu.clouds import cudo as _cudo  # noqa: F401 (registers)
 from skypilot_tpu.clouds import do as _do  # noqa: F401 (registers)
 from skypilot_tpu.clouds import fluidstack as _fluidstack  # noqa: F401
+from skypilot_tpu.clouds import paperspace as _paperspace  # noqa: F401
 from skypilot_tpu.clouds import gcp as _gcp  # noqa: F401 (registers)
 from skypilot_tpu.clouds import lambda_cloud as _lambda  # noqa: F401
 from skypilot_tpu.clouds import local as _local  # noqa: F401 (registers)
@@ -15,8 +17,10 @@ from skypilot_tpu.utils.registry import CLOUD_REGISTRY
 
 AWS = _aws.AWS
 Azure = _azure.Azure
+Cudo = _cudo.Cudo
 DigitalOcean = _do.DigitalOcean
 Fluidstack = _fluidstack.Fluidstack
+Paperspace = _paperspace.Paperspace
 GCP = _gcp.GCP
 LambdaCloud = _lambda.LambdaCloud
 Local = _local.Local
@@ -36,6 +40,7 @@ def get_cloud(name: str) -> Cloud:
     return CLOUD_REGISTRY.get(name)()
 
 
-__all__ = ['Cloud', 'CloudCapability', 'AWS', 'Azure', 'DigitalOcean',
-           'Fluidstack', 'GCP', 'LambdaCloud', 'Local', 'Nebius',
-           'RunPod', 'SSH', 'Vast', 'get_cloud', 'CLOUD_REGISTRY']
+__all__ = ['Cloud', 'CloudCapability', 'AWS', 'Azure', 'Cudo',
+           'DigitalOcean', 'Fluidstack', 'GCP', 'LambdaCloud', 'Local',
+           'Nebius', 'Paperspace', 'RunPod', 'SSH', 'Vast',
+           'get_cloud', 'CLOUD_REGISTRY']

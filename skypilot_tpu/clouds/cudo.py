@@ -1,0 +1,58 @@
+"""Cudo Compute policy — project-scoped GPU/CPU VMs with stop/start.
+
+Reference analog: sky/clouds/cudo.py. Machine types are
+`<family>-<gpus>x<gpu>` style slugs carried verbatim in the catalog.
+"""
+from typing import Dict, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.utils import registry
+
+
+@registry.CLOUD_REGISTRY.register(name='cudo')
+class Cudo(cloud.Cloud):
+    NAME = 'cudo'
+    CAPABILITIES = frozenset({
+        cloud.CloudCapability.MULTI_NODE,
+        cloud.CloudCapability.STOP,
+        cloud.CloudCapability.AUTOSTOP,
+        cloud.CloudCapability.CUSTOM_IMAGE,
+        cloud.CloudCapability.HOST_CONTROLLERS,
+    })
+    MAX_CLUSTER_NAME_LENGTH = 56
+
+    def provision_module(self) -> str:
+        return 'skypilot_tpu.provision.cudo'
+
+    def make_deploy_variables(self, resources, cluster_name_on_cloud: str,
+                              region: str, zone: Optional[str]
+                              ) -> Dict[str, object]:
+        resources.assert_launchable()
+        from skypilot_tpu import config as config_lib
+        auth = self.authentication_config()
+        variables: Dict[str, object] = {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region,
+            'zone': None,
+            'instance_type': resources.instance_type,
+            'use_spot': False,  # no spot market
+            'disk_size': resources.disk_size,
+            'project_id': config_lib.get_nested(('cudo', 'project_id')),
+            'ssh_user': 'root',
+            'ssh_private_key': auth.get('ssh_private_key'),
+            'num_nodes': None,  # filled by the provisioner
+        }
+        if resources.image_id:
+            variables['image_id'] = resources.image_id
+        return variables
+
+    def authentication_config(self) -> Dict[str, object]:
+        from skypilot_tpu import authentication
+        return authentication.authentication_config()
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.adaptors import cudo as adaptor
+        if adaptor.get_api_key():
+            return True, None
+        return False, ('Cudo API key not found. Set CUDO_API_KEY or '
+                       f'create {adaptor.CREDENTIALS_PATH}.')

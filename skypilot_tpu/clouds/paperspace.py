@@ -1,0 +1,56 @@
+"""Paperspace policy — GPU machines with stop/start.
+
+Reference analog: sky/clouds/paperspace.py.
+"""
+from typing import Dict, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.utils import registry
+
+
+@registry.CLOUD_REGISTRY.register(name='paperspace')
+class Paperspace(cloud.Cloud):
+    NAME = 'paperspace'
+    CAPABILITIES = frozenset({
+        cloud.CloudCapability.MULTI_NODE,
+        cloud.CloudCapability.STOP,
+        cloud.CloudCapability.AUTOSTOP,
+        cloud.CloudCapability.CUSTOM_IMAGE,
+        cloud.CloudCapability.HOST_CONTROLLERS,
+    })
+    MAX_CLUSTER_NAME_LENGTH = 56
+
+    def provision_module(self) -> str:
+        return 'skypilot_tpu.provision.paperspace'
+
+    def make_deploy_variables(self, resources, cluster_name_on_cloud: str,
+                              region: str, zone: Optional[str]
+                              ) -> Dict[str, object]:
+        resources.assert_launchable()
+        auth = self.authentication_config()
+        variables: Dict[str, object] = {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region,
+            'zone': None,
+            'instance_type': resources.instance_type,
+            'use_spot': False,  # no spot market
+            'disk_size': resources.disk_size,
+            'ssh_user': 'paperspace',
+            'ssh_private_key': auth.get('ssh_private_key'),
+            'num_nodes': None,  # filled by the provisioner
+        }
+        if resources.image_id:
+            variables['image_id'] = resources.image_id
+        return variables
+
+    def authentication_config(self) -> Dict[str, object]:
+        from skypilot_tpu import authentication
+        return authentication.authentication_config()
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.adaptors import paperspace as adaptor
+        if adaptor.get_api_key():
+            return True, None
+        return False, ('Paperspace API key not found. Set '
+                       'PAPERSPACE_API_KEY or create '
+                       f'{adaptor.CREDENTIALS_PATH}.')

@@ -266,6 +266,11 @@ CONFIG_SCHEMA: Dict[str, Any] = {
                 'subnet_id': _STR,
             },
         },
+        'cudo': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {'project_id': _STR},
+        },
         'kubernetes': {
             'type': 'object',
             'additionalProperties': False,

@@ -1,0 +1,205 @@
+"""Cudo + Paperspace provisioners against in-memory fake APIs."""
+import itertools
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.adaptors import cudo as cudo_adaptor
+from skypilot_tpu.adaptors import paperspace as ps_adaptor
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision import cudo as cudo_provision
+from skypilot_tpu.provision import paperspace as ps_provision
+
+
+def _config(instance_type, count=1, extra_pc=None):
+    return common.ProvisionConfig(
+        provider_config={'region': 'r1', **(extra_pc or {})},
+        authentication_config={'ssh_user': 'root',
+                               'ssh_public_key_content': 'ssh-ed25519 K'},
+        node_config={'instance_type': instance_type},
+        count=count)
+
+
+# ------------------------------------------------------------------ cudo
+
+CUDO_PC = {'project_id': 'proj-9'}
+
+
+class FakeCudo:
+    def __init__(self):
+        self.vms = {}
+
+    def request(self, method, path, params=None, json_body=None):
+        if path == '/v1/projects/proj-9/vms' and method == 'GET':
+            return {'VMs': list(self.vms.values())}
+        if path == '/v1/projects/proj-9/vm' and method == 'POST':
+            vm_id = json_body['vmId']
+            assert json_body['customSshKeys'] == ['ssh-ed25519 K']
+            self.vms[vm_id] = {
+                'id': vm_id, 'state': 'ACTIVE',
+                'nics': [{'internalIpAddress': '10.4.0.2',
+                          'externalIpAddress': '91.0.0.3'}],
+                '_spec': json_body}
+            return self.vms[vm_id]
+        if method == 'POST' and path.endswith('/stop'):
+            self.vms[path.split('/')[-2]]['state'] = 'STOPPED'
+            return {}
+        if method == 'POST' and path.endswith('/start'):
+            self.vms[path.split('/')[-2]]['state'] = 'ACTIVE'
+            return {}
+        if method == 'POST' and path.endswith('/terminate'):
+            del self.vms[path.split('/')[-2]]
+            return {}
+        raise AssertionError(f'unexpected {method} {path}')
+
+
+@pytest.fixture
+def fake_cudo():
+    api = FakeCudo()
+    cudo_adaptor.set_client_factory(lambda: api)
+    yield api
+    cudo_adaptor.set_client_factory(
+        lambda: (_ for _ in ()).throw(AssertionError('no client')))
+
+
+def test_cudo_lifecycle(fake_cudo):
+    record = cudo_provision.run_instances(
+        'se-smedjebacken-1', 'cu1',
+        _config('epyc-8x-h100', extra_pc=CUDO_PC))
+    assert record.created_instance_ids == ['cu1-0']
+    info = cudo_provision.get_cluster_info('se-smedjebacken-1', 'cu1',
+                                           dict(CUDO_PC))
+    host = info.get_head_instance().hosts[0]
+    assert host.internal_ip == '10.4.0.2'
+    assert host.external_ip == '91.0.0.3'
+    cudo_provision.stop_instances('cu1', dict(CUDO_PC))
+    assert cudo_provision.query_instances('cu1', dict(CUDO_PC)) == {
+        'cu1-0': 'stopped'}
+    record = cudo_provision.run_instances(
+        'se-smedjebacken-1', 'cu1',
+        _config('epyc-8x-h100', extra_pc=CUDO_PC))
+    assert record.resumed_instance_ids == ['cu1-0']
+    cudo_provision.terminate_instances('cu1', dict(CUDO_PC))
+    assert cudo_provision.query_instances('cu1', dict(CUDO_PC)) == {}
+
+
+def test_cudo_requires_project(fake_cudo, monkeypatch):
+    monkeypatch.delenv('CUDO_PROJECT_ID', raising=False)
+    with pytest.raises(exceptions.ProvisionError, match='project id'):
+        cudo_provision.run_instances('r', 'cu1',
+                                     _config('standard-8-32'))
+
+
+# ------------------------------------------------------------ paperspace
+
+class FakePaperspace:
+    page_size = 100  # tests shrink this to exercise pagination
+
+    def __init__(self):
+        self.machines = {}
+        self._ids = itertools.count(9000)
+        self.fail_create_with = None
+
+    def request(self, method, path, params=None, json_body=None):
+        if path == '/machines' and method == 'GET':
+            items = sorted(self.machines.values(),
+                           key=lambda m: m['id'])
+            start = int(params.get('after') or 0)
+            page = items[start:start + self.page_size]
+            resp = {'items': page,
+                    'hasMore': start + self.page_size < len(items)}
+            if resp['hasMore']:
+                resp['nextPage'] = str(start + self.page_size)
+            return resp
+        if path == '/machines' and method == 'POST':
+            if self.fail_create_with is not None:
+                raise self.fail_create_with
+            mid = str(next(self._ids))
+            assert 'ssh-ed25519 K' in json_body['startupScript']
+            self.machines[mid] = {
+                'id': mid, 'name': json_body['name'], 'state': 'ready',
+                'publicIp': '74.0.0.8', 'privateIp': '10.5.0.8',
+                '_spec': json_body}
+            return self.machines[mid]
+        if method == 'PATCH' and path.endswith('/stop'):
+            self.machines[path.split('/')[2]]['state'] = 'off'
+            return {}
+        if method == 'PATCH' and path.endswith('/start'):
+            self.machines[path.split('/')[2]]['state'] = 'ready'
+            return {}
+        if method == 'DELETE':
+            del self.machines[path.split('/')[2]]
+            return {}
+        raise AssertionError(f'unexpected {method} {path}')
+
+
+@pytest.fixture
+def fake_ps():
+    api = FakePaperspace()
+    ps_adaptor.set_client_factory(lambda: api)
+    yield api
+    ps_adaptor.set_client_factory(
+        lambda: (_ for _ in ()).throw(AssertionError('no client')))
+
+
+def test_paperspace_lifecycle(fake_ps):
+    record = ps_provision.run_instances('East Coast (NY2)', 'ps1',
+                                        _config('A100-80Gx8', count=2))
+    assert len(record.created_instance_ids) == 2
+    info = ps_provision.get_cluster_info('East Coast (NY2)', 'ps1', {})
+    assert info.num_instances == 2
+    assert info.get_head_instance().hosts[0].external_ip == '74.0.0.8'
+    ps_provision.stop_instances('ps1', {})
+    assert set(ps_provision.query_instances('ps1', {}).values()) == {
+        'stopped'}
+    record = ps_provision.run_instances('East Coast (NY2)', 'ps1',
+                                        _config('A100-80Gx8', count=2))
+    assert sorted(record.resumed_instance_ids) == ['ps1-0', 'ps1-1']
+    ps_provision.terminate_instances('ps1', {})
+    assert ps_provision.query_instances('ps1', {}) == {}
+
+
+def test_paperspace_ssh_key_targets_paperspace_home(fake_ps):
+    """Startup scripts run as root: the key must land in the
+    paperspace user's authorized_keys, not /root's."""
+    ps_provision.run_instances('East Coast (NY2)', 'ps1',
+                               _config('C5'))
+    script = next(iter(fake_ps.machines.values()))['_spec'][
+        'startupScript']
+    assert '/home/paperspace/.ssh/authorized_keys' in script
+    assert 'chown -R paperspace:paperspace' in script
+    assert '~' not in script
+
+
+def test_paperspace_pagination_followed(fake_ps):
+    """terminate must sweep machines past page 1 (billed leaks)."""
+    fake_ps.page_size = 2
+    ps_provision.run_instances('East Coast (NY2)', 'ps1',
+                               _config('C5', count=5))
+    assert len(ps_provision.query_instances('ps1', {})) == 5
+    ps_provision.terminate_instances('ps1', {})
+    assert fake_ps.machines == {}
+
+
+def test_paperspace_capacity_taxonomy(fake_ps):
+    fake_ps.fail_create_with = ps_adaptor.RestApiError(
+        'Machine type out of capacity in region', status=500)
+    with pytest.raises(exceptions.CapacityError):
+        ps_provision.run_instances('East Coast (NY2)', 'ps2',
+                                   _config('H100x8'))
+
+
+def test_fourteen_cloud_registry(enable_clouds):
+    from skypilot_tpu.clouds import CLOUD_REGISTRY
+    assert {'cudo', 'paperspace'} <= set(CLOUD_REGISTRY.names())
+    assert len(CLOUD_REGISTRY.names()) >= 14
+    # Both catalogs feed the optimizer.
+    from skypilot_tpu import Dag, Resources, Task
+    from skypilot_tpu.optimizer import Optimizer
+    enable_clouds('cudo', 'paperspace')
+    with Dag() as dag:
+        t = Task('t', run='true')
+        t.set_resources(Resources(accelerators='H100:8'))
+        dag.add(t)
+    Optimizer.optimize(dag, quiet=True)
+    assert t.best_resources.cloud == 'cudo'  # $22.32 < $47.60
