@@ -341,6 +341,10 @@ class InferenceEngine:
 
     def submit(self, prompt_tokens: List[int],
                sampling: Optional[SamplingParams] = None) -> int:
+        if not prompt_tokens:
+            # Prefill gathers last-token logits at prompt_len-1; an
+            # empty prompt would wrap to index -1 and sample garbage.
+            raise ValueError('prompt_tokens must be non-empty')
         request_id = self._next_id
         self._next_id += 1
         self._queue.append((request_id, list(prompt_tokens),

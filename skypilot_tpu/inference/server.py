@@ -68,7 +68,14 @@ class EngineLoop:
                 prompt, sampling, watcher = self._submit_q.get_nowait()
             except queue.Empty:
                 return
-            rid = self.engine.submit(prompt, sampling)
+            try:
+                rid = self.engine.submit(prompt, sampling)
+            except Exception as e:  # noqa: BLE001
+                # The watcher is not registered yet, so the _run error
+                # handler can't reach it — fail it here or its HTTP
+                # handler awaits forever.
+                watcher.push(('error', str(e)))
+                continue
             self._watchers[rid] = watcher
 
     def _run(self) -> None:
@@ -145,6 +152,11 @@ def create_app(engine_holder: Dict[str, Any]):
             return web.json_response(
                 {'error': 'need {"prompt_tokens": [ints]} with numeric '
                           'sampling fields'}, status=400)
+        if not prompt:
+            # An empty prompt would gather "last-token" logits at index
+            # -1 and sample from a meaningless position.
+            return web.json_response(
+                {'error': 'prompt_tokens must be non-empty'}, status=400)
         stream = bool(body.get('stream', False))
         watcher = engine_loop.submit(prompt, sampling, stream=stream)
 

@@ -64,8 +64,13 @@ def run_instances(region: str, cluster_name_on_cloud: str,
     client = lambda_adaptor.client()
     nc = {**config.provider_config, **config.node_config}
     existing = _cluster_instances(client, cluster_name_on_cloud)
-    alive = {inst['name']: inst for inst in existing
+    # Duplicate names can coexist briefly (e.g. a terminating twin
+    # alongside its replacement), so classify per-name over ALL
+    # same-name instances rather than last-listed-wins.
+    alive = {inst['name'] for inst in existing
              if _state(inst) in ('running', 'pending')}
+    stopping = {inst['name'] for inst in existing
+                if _state(inst) == 'stopping'} - alive
 
     created: List[str] = []
     try:
@@ -76,6 +81,8 @@ def run_instances(region: str, cluster_name_on_cloud: str,
             name = f'{cluster_name_on_cloud}-{i}'
             if name in alive:
                 continue
+            if name in stopping:
+                common.refuse_unresumable('stopping', name)
             resp = client.request(
                 'POST', '/instance-operations/launch',
                 json_body={
