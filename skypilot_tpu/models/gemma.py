@@ -34,6 +34,9 @@ _GEMMA2 = dict(
     final_logit_softcap=30.0,
     sliding_window=4096,
     sliding_window_pattern=2,   # alternate local / global
+    # The pallas kernel runs window+softcap in-kernel (traced per-layer
+    # window scalar), so gemma-2 trains on the fast path.
+    attention_impl='flash',
 )
 
 CONFIGS = {
@@ -56,5 +59,8 @@ CONFIGS = {
         vocab_size=256, hidden_size=64, intermediate_size=128,
         num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
         max_seq_len=128, dtype=jnp.float32, remat=False,
-        **{**_GEMMA2, 'sliding_window': 16}),
+        # dense on CPU tests (interpret-mode pallas is slow); the
+        # flash-vs-dense forward equality is covered explicitly in
+        # tests/unit/test_model_families.py.
+        **{**_GEMMA2, 'sliding_window': 16, 'attention_impl': 'dense'}),
 }

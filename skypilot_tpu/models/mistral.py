@@ -19,7 +19,8 @@ CONFIGS = {
     'mistral-7b': LlamaConfig(
         vocab_size=32000, hidden_size=4096, intermediate_size=14336,
         num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128,
-        max_seq_len=8192, rope_theta=10000.0, sliding_window=4096),
+        max_seq_len=8192, rope_theta=10000.0, sliding_window=4096,
+        attention_impl='flash'),
     # CPU-test scale; window < seq so the mask matters.
     'tiny-mistral': LlamaConfig(
         vocab_size=256, hidden_size=64, intermediate_size=128,

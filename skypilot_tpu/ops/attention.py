@@ -296,9 +296,10 @@ def attention(q: jax.Array,
     """Dispatch: 'dense' | 'blockwise' | 'ring' | 'flash' (TPU pallas).
 
     window/softcap (sliding-window local attention, Gemma logit
-    capping) are handled by the dense and blockwise paths; the flash
-    kernel falls back to blockwise when they're set, and ring rejects
-    them (a window never spans the context shards ring targets).
+    capping) run in-kernel on the flash path (window may be a traced
+    per-layer scalar); the only flash fallback is a non-causal window,
+    which goes to blockwise. ring rejects them (a window never spans
+    the context shards ring targets).
     """
     if impl == 'ring':
         if mesh is None:
@@ -308,16 +309,16 @@ def attention(q: jax.Array,
                              'window/softcap; use blockwise')
         return ring_attention(q, k, v, mesh, causal=causal,
                               block_size=block_size)
-    if impl == 'blockwise' or (impl == 'flash' and
-                               (window is not None or
-                                softcap is not None)):
+    if impl == 'blockwise' or (impl == 'flash' and window is not None
+                               and not causal):
         return blockwise_attention(q, k, v, causal=causal,
                                    block_size=block_size,
                                    window=window, softcap=softcap)
     if impl == 'flash':
         from skypilot_tpu.ops import flash_attention as fa
-        return fa.flash_attention(q, k, v, causal,
-                                  block_size, block_size)
+        return fa.flash_attention(q, k, v, causal, block_size,
+                                  block_size, window=window,
+                                  softcap=softcap)
     if impl == 'dense':
         return dense_attention(q, k, v, causal=causal, window=window,
                                softcap=softcap)

@@ -18,6 +18,17 @@ matmul, no stored probs) and apply delta = rowsum(do·o).
 GQA is folded into the index maps: kv blocks for head h come from kv
 head h // (num_heads // num_kv_heads), so no materialized repeat.
 
+Sliding windows (Mistral, Gemma-2 local layers): the window size is a
+RUNTIME int32 scalar living in SMEM, because the model stacks scan one
+compiled layer body over a per-layer window schedule
+(models/llama.py `layer_windows` — traced values, one compilation).
+Block pairs with no (q_pos, k_pos) satisfying
+`k_pos <= q_pos < k_pos + window` skip their matmuls entirely via
+`pl.when`, so a 4k window over a 32k sequence does ~window/seq of the
+full-causal FLOPs. Gemma attn-logit softcapping (cap·tanh(s/cap)) is a
+static per-model constant compiled into the kernel; backward folds the
+(1 - tanh²) Jacobian into ds.
+
 No reference equivalent (SkyPilot ships no kernels; SURVEY.md §2.11).
 """
 from __future__ import annotations
@@ -28,6 +39,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -35,8 +47,47 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
-                l_ref, *, causal: bool, scale: float, bq: int, bk: int,
+def _score_mods(s, q_start, k_start, w_ref, *, causal, windowed, softcap,
+                bq, bk):
+    """Softcap then mask a [bq, bk] score tile; returns (s, tanh_t).
+
+    tanh_t is the pre-mask tanh(s/cap) the backward kernels need for the
+    softcap Jacobian (None when softcap is off).
+    """
+    t = None
+    if softcap is not None:
+        t = jnp.tanh(s / softcap)
+        s = softcap * t
+    if causal or windowed:
+        q_pos = q_start + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_pos = k_start + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = None
+        if causal:
+            mask = q_pos >= k_pos
+        if windowed:
+            wm = q_pos - k_pos < w_ref[0]
+            mask = wm if mask is None else mask & wm
+        s = jnp.where(mask, s, _NEG_INF)
+    return s, t
+
+
+def _block_visible(q_start, k_start, w_ref, *, causal, windowed, bq, bk):
+    """Traced predicate: does ANY (q, k) pair in this block tile satisfy
+    the causal+window mask `k <= q < k + window`? The valid k-range for
+    the q tile is (q_start - window, q_start + bq - 1]; overlap with the
+    k tile gives the two comparisons below."""
+    cond = None
+    if causal:
+        cond = k_start < q_start + bq
+    if windowed:
+        wc = k_start + bk + w_ref[0] > q_start + 1
+        cond = wc if cond is None else cond & wc
+    return cond  # None = statically always visible
+
+
+def _fwd_kernel(w_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
+                m_ref, l_ref, *, causal: bool, windowed: bool,
+                softcap: Optional[float], scale: float, bq: int, bk: int,
                 n_kv_blocks: int):
     iq = pl.program_id(2)
     ik = pl.program_id(3)
@@ -59,10 +110,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk] f32
-        if causal:
-            q_pos = q_start + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            k_pos = k_start + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        s, _ = _score_mods(s, q_start, k_start, w_ref, causal=causal,
+                           windowed=windowed, softcap=softcap, bq=bq,
+                           bk=bk)
         m_prev = m_ref[:, :1]                         # [bq, 1]
         m_blk = jnp.max(s, axis=1, keepdims=True)     # [bq, 1]
         m_new = jnp.maximum(m_prev, m_blk)
@@ -77,11 +127,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
         acc_ref[:] = acc_ref[:] * correction + pv
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
 
-    if causal:
-        # Skip kv blocks strictly above the causal diagonal.
-        pl.when(k_start < q_start + bq)(_compute)
-    else:
+    visible = _block_visible(q_start, k_start, w_ref, causal=causal,
+                             windowed=windowed, bq=bq, bk=bk)
+    if visible is None:
         _compute()
+    else:
+        pl.when(visible)(_compute)
 
     @pl.when(ik == n_kv_blocks - 1)
     def _finalize():
@@ -97,8 +148,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
         lse_ref[0, 0] = lse
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_acc, *, causal: bool, scale: float, bq: int, bk: int,
+def _dq_kernel(w_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, dq_acc, *, causal: bool, windowed: bool,
+               softcap: Optional[float], scale: float, bq: int, bk: int,
                n_kv_blocks: int):
     iq = pl.program_id(2)
     ik = pl.program_id(3)
@@ -120,32 +172,37 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
-        if causal:
-            q_pos = q_start + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            k_pos = k_start + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        s, t = _score_mods(s, q_start, k_start, w_ref, causal=causal,
+                           windowed=windowed, softcap=softcap, bq=bq,
+                           bk=bk)
         p = jnp.exp(s - lse)                           # [bq, bk]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)        # [bq, bk]
-        ds = p * (dp - delta) * scale                  # [bq, bk]
+        ds = p * (dp - delta)                          # [bq, bk]
+        if t is not None:
+            ds = ds * (1.0 - t * t)                    # softcap Jacobian
+        ds = ds * scale
         dq_acc[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)        # [bq, d]
 
-    if causal:
-        pl.when(k_start < q_start + bq)(_compute)
-    else:
+    visible = _block_visible(q_start, k_start, w_ref, causal=causal,
+                             windowed=windowed, bq=bq, bk=bk)
+    if visible is None:
         _compute()
+    else:
+        pl.when(visible)(_compute)
 
     @pl.when(ik == n_kv_blocks - 1)
     def _store():
         dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _dkv_kernel(w_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_acc, dv_acc, *, causal: bool,
-                scale: float, bq: int, bk: int, n_q_blocks: int):
+                windowed: bool, softcap: Optional[float], scale: float,
+                bq: int, bk: int, n_q_blocks: int):
     ik = pl.program_id(2)
     iq = pl.program_id(3)
 
@@ -167,10 +224,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
-        if causal:
-            q_pos = q_start + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            k_pos = k_start + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        s, t = _score_mods(s, q_start, k_start, w_ref, causal=causal,
+                           windowed=windowed, softcap=softcap, bq=bq,
+                           bk=bk)
         p = jnp.exp(s - lse)                           # [bq, bk]
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -178,17 +234,27 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)        # [bq, bk]
-        ds = p * (dp - delta) * scale                  # [bq, bk]
+        ds = p * (dp - delta)                          # [bq, bk]
+        if t is not None:
+            ds = ds * (1.0 - t * t)                    # softcap Jacobian
+        ds = ds * scale
         dk_acc[:] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)        # [bk, d]
 
+    # Visibility is symmetric in the block pair: reuse the same
+    # predicate (the causal term reads "some query in the q tile can
+    # see this kv tile").
+    cond = None
     if causal:
-        # Skip q blocks entirely above the diagonal (no query in the
-        # block can see this kv block).
-        pl.when(q_start + bq > k_start)(_compute)
-    else:
+        cond = q_start + bq > k_start
+    if windowed:
+        wc = k_start + bk + w_ref[0] > q_start + 1
+        cond = wc if cond is None else cond & wc
+    if cond is None:
         _compute()
+    else:
+        pl.when(cond)(_compute)
 
     @pl.when(iq == n_q_blocks - 1)
     def _store():
@@ -205,9 +271,13 @@ def _blocks(s_q: int, s_kv: int, block_q: int, block_k: int):
     return bq, bk, s_q // bq, s_kv // bk
 
 
+_SMEM_SPEC = pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
 def _flash_fwd_impl(q: jax.Array, k: jax.Array, v: jax.Array,
-                    causal: bool, block_q: int, block_k: int,
-                    interpret: bool):
+                    window: jax.Array, causal: bool, windowed: bool,
+                    block_q: int, block_k: int,
+                    softcap: Optional[float], interpret: bool):
     b, s_q, h, d = q.shape
     s_kv, h_kv = k.shape[1], k.shape[2]
     group = h // h_kv
@@ -220,12 +290,13 @@ def _flash_fwd_impl(q: jax.Array, k: jax.Array, v: jax.Array,
     vt = jnp.swapaxes(v, 1, 2)
 
     kernel = functools.partial(
-        _fwd_kernel, causal=causal, scale=scale, bq=bq, bk=bk,
-        n_kv_blocks=n_k)
+        _fwd_kernel, causal=causal, windowed=windowed, softcap=softcap,
+        scale=scale, bq=bq, bk=bk, n_kv_blocks=n_k)
     out, lse = pl.pallas_call(
         kernel,
         grid=(b, h, n_q, n_k),
         in_specs=[
+            _SMEM_SPEC,
             pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik:
                          (b_, h_, iq, 0)),
             pl.BlockSpec((1, 1, bk, d), lambda b_, h_, iq, ik:
@@ -251,12 +322,12 @@ def _flash_fwd_impl(q: jax.Array, k: jax.Array, v: jax.Array,
             pltpu.VMEM((bq, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(qt, kt, vt)
+    )(window, qt, kt, vt)
     return jnp.swapaxes(out, 1, 2), lse
 
 
-def _flash_bwd_impl(q, k, v, o, lse, do, causal, block_q, block_k,
-                    interpret):
+def _flash_bwd_impl(q, k, v, o, lse, do, window, causal, windowed,
+                    block_q, block_k, softcap, interpret):
     b, s_q, h, d = q.shape
     s_kv, h_kv = k.shape[1], k.shape[2]
     group = h // h_kv
@@ -280,15 +351,17 @@ def _flash_bwd_impl(q, k, v, o, lse, do, causal, block_q, block_k,
                             lambda b_, h_, iq, ik: (b_, h_, iq, 0))
 
     dqt = pl.pallas_call(
-        functools.partial(_dq_kernel, causal=causal, scale=scale, bq=bq,
-                          bk=bk, n_kv_blocks=n_k),
+        functools.partial(_dq_kernel, causal=causal, windowed=windowed,
+                          softcap=softcap, scale=scale, bq=bq, bk=bk,
+                          n_kv_blocks=n_k),
         grid=(b, h, n_q, n_k),
-        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        in_specs=[_SMEM_SPEC, q_spec, kv_spec, kv_spec, q_spec, row_spec,
+                  row_spec],
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((b, h, s_q, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-    )(qt, kt, vt, dot, lse, delta)
+    )(window, qt, kt, vt, dot, lse, delta)
 
     # dk/dv: kv-block major, q sequential innermost. Per-head partials;
     # GQA groups summed below.
@@ -301,11 +374,12 @@ def _flash_bwd_impl(q, k, v, o, lse, do, causal, block_q, block_k,
     row_spec2 = pl.BlockSpec((1, 1, bq, 1),
                              lambda b_, h_, ik, iq: (b_, h_, iq, 0))
     dkt_h, dvt_h = pl.pallas_call(
-        functools.partial(_dkv_kernel, causal=causal, scale=scale, bq=bq,
-                          bk=bk, n_q_blocks=n_q),
+        functools.partial(_dkv_kernel, causal=causal, windowed=windowed,
+                          softcap=softcap, scale=scale, bq=bq, bk=bk,
+                          n_q_blocks=n_q),
         grid=(b, h, n_k, n_q),
-        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2,
-                  row_spec2],
+        in_specs=[_SMEM_SPEC, q_spec2, kv_spec2, kv_spec2, q_spec2,
+                  row_spec2, row_spec2],
         out_specs=[kv_out_spec, kv_out_spec],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, s_kv, d), k.dtype),
@@ -314,7 +388,7 @@ def _flash_bwd_impl(q, k, v, o, lse, do, causal, block_q, block_k,
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
         interpret=interpret,
-    )(qt, kt, vt, dot, lse, delta)
+    )(window, qt, kt, vt, dot, lse, delta)
 
     dq = jnp.swapaxes(dqt, 1, 2)
     if group > 1:
@@ -329,26 +403,48 @@ def _use_interpret() -> bool:
     return jax.default_backend() != 'tpu'
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                    causal: bool = True, block_q: int = 512,
-                    block_k: int = 512) -> jax.Array:
-    """Flash attention. q:[B,Sq,H,D], k/v:[B,Skv,Hkv,D] → [B,Sq,H,D]."""
-    out, _ = _flash_fwd_impl(q, k, v, causal, block_q, block_k,
-                             interpret=_use_interpret())
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, window, causal, windowed, block_q, block_k, softcap):
+    out, _ = _flash_fwd_impl(q, k, v, window, causal, windowed, block_q,
+                             block_k, softcap, interpret=_use_interpret())
     return out
 
 
-def _fwd(q, k, v, causal, block_q, block_k):
-    out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k,
+def _fwd(q, k, v, window, causal, windowed, block_q, block_k, softcap):
+    out, lse = _flash_fwd_impl(q, k, v, window, causal, windowed,
+                               block_q, block_k, softcap,
                                interpret=_use_interpret())
-    return out, (q, k, v, out, lse)
+    return out, (q, k, v, window, out, lse)
 
 
-def _bwd(causal, block_q, block_k, res, g):
-    q, k, v, o, lse = res
-    return _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k,
-                           interpret=_use_interpret())
+def _bwd(causal, windowed, block_q, block_k, softcap, res, g):
+    q, k, v, window, o, lse = res
+    dq, dk, dv = _flash_bwd_impl(q, k, v, o, lse, g, window, causal,
+                                 windowed, block_q, block_k, softcap,
+                                 interpret=_use_interpret())
+    # int32 window takes a float0 cotangent (no gradient flows to it).
+    return dq, dk, dv, np.zeros((1,), dtype=jax.dtypes.float0)
 
 
-flash_attention.defvjp(_fwd, _bwd)
+_flash.defvjp(_fwd, _bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True, block_q: int = 512,
+                    block_k: int = 512,
+                    window: Optional[jax.Array] = None,
+                    softcap: Optional[float] = None) -> jax.Array:
+    """Flash attention. q:[B,Sq,H,D], k/v:[B,Skv,Hkv,D] → [B,Sq,H,D].
+
+    window: sliding-window size — position q attends k iff
+    q_pos - k_pos < window. May be a traced int32 scalar (the model
+    stacks scan per-layer windows through one compiled body); requires
+    causal. softcap: static Gemma-style logit cap, cap·tanh(s/cap).
+    """
+    if window is not None and not causal:
+        raise ValueError('flash window support is causal-only; use '
+                         'blockwise for non-causal windows')
+    windowed = window is not None
+    w = jnp.asarray(window if windowed else 0, jnp.int32).reshape(1)
+    return _flash(q, k, v, w, causal, windowed, block_q, block_k,
+                  None if softcap is None else float(softcap))

@@ -123,6 +123,101 @@ class TestFlash:
         with pytest.raises(ValueError):
             fa.flash_attention(q, q[:, :, :2], q[:, :, :2], True, 32, 32)
 
+    @pytest.mark.parametrize('window', [8, 24, 64, 2**30])
+    def test_window_matches_dense(self, qkv, window):
+        """Sliding window incl. block-skip (window smaller than a
+        16-wide block span) and the global-layer sentinel."""
+        q, k, v = qkv
+        from skypilot_tpu.ops import flash_attention as fa
+        dense = attention_ops.dense_attention(q, k, v, causal=True,
+                                              window=window)
+        flash = fa.flash_attention(q, k, v, True, 16, 16, window=window)
+        np.testing.assert_allclose(np.asarray(dense), np.asarray(flash),
+                                   atol=2e-5)
+
+    @pytest.mark.parametrize('window', [8, 24])
+    def test_window_grads_match_dense(self, qkv, window):
+        q, k, v = qkv
+        from skypilot_tpu.ops import flash_attention as fa
+
+        def loss(fn):
+            return lambda q_, k_, v_: (fn(q_, k_, v_) ** 2).sum()
+
+        gd = jax.grad(loss(functools.partial(
+            attention_ops.dense_attention, causal=True, window=window)),
+            argnums=(0, 1, 2))(q, k, v)
+        gf = jax.grad(loss(lambda q_, k_, v_: fa.flash_attention(
+            q_, k_, v_, True, 16, 16, window=window)),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gd, gf):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4)
+
+    def test_traced_window_in_scan(self, qkv):
+        """The model stacks scan ONE compiled layer body over a
+        per-layer window schedule — the kernel must take the window as
+        a runtime scalar (models/llama.py layer_windows)."""
+        q, k, v = qkv
+        from skypilot_tpu.ops import flash_attention as fa
+        windows = jax.numpy.array([8, 2**30, 24], jax.numpy.int32)
+
+        @jax.jit
+        def scan_fn(q_, k_, v_):
+            def body(carry, w):
+                return carry, fa.flash_attention(q_, k_, v_, True, 16,
+                                                 16, window=w)
+            _, outs = jax.lax.scan(body, 0, windows)
+            return outs
+
+        outs = scan_fn(q, k, v)
+        for i, w in enumerate([8, 2**30, 24]):
+            dense = attention_ops.dense_attention(q, k, v, causal=True,
+                                                  window=w)
+            np.testing.assert_allclose(np.asarray(dense),
+                                       np.asarray(outs[i]), atol=2e-5)
+
+    @pytest.mark.parametrize('window', [None, 16])
+    def test_softcap_matches_dense(self, qkv, window):
+        """Gemma-2 logit softcapping, fwd + grads, with and without a
+        window on top."""
+        q, k, v = qkv
+        from skypilot_tpu.ops import flash_attention as fa
+        cap = 20.0
+        dense_fn = functools.partial(attention_ops.dense_attention,
+                                     causal=True, window=window,
+                                     softcap=cap)
+        flash_fn = lambda q_, k_, v_: fa.flash_attention(  # noqa: E731
+            q_, k_, v_, True, 16, 16, window=window, softcap=cap)
+        np.testing.assert_allclose(np.asarray(dense_fn(q, k, v)),
+                                   np.asarray(flash_fn(q, k, v)),
+                                   atol=2e-5)
+
+        def loss(fn):
+            return lambda q_, k_, v_: (fn(q_, k_, v_) ** 2).sum()
+
+        gd = jax.grad(loss(dense_fn), argnums=(0, 1, 2))(q, k, v)
+        gf = jax.grad(loss(flash_fn), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gd, gf):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4)
+
+    def test_window_dispatch_uses_flash(self, qkv, monkeypatch):
+        """attention(impl='flash', window=...) must stay on the kernel
+        (the r2 fallback sent gemma/mistral off the fast path)."""
+        q, k, v = qkv
+        from skypilot_tpu.ops import flash_attention as fa
+        called = {}
+        orig = fa.flash_attention
+
+        def spy(*args, **kwargs):
+            called['yes'] = True
+            return orig(*args, **kwargs)
+
+        monkeypatch.setattr(fa, 'flash_attention', spy)
+        attention_ops.attention(q, k, v, causal=True, impl='flash',
+                                window=16, softcap=30.0)
+        assert called.get('yes')
+
 
 def test_unknown_impl_raises(qkv):
     q, k, v = qkv

@@ -70,6 +70,24 @@ def test_softcap_blockwise_matches_dense():
                            atol=1e-4)
 
 
+@pytest.mark.parametrize('tiny', ['tiny-gemma', 'tiny-mistral'])
+def test_family_forward_flash_matches_dense(tiny):
+    """Gemma-2 (softcap + alternating local/global) and Mistral (all
+    local) must produce the same logits on the pallas fast path as on
+    dense — the whole windowed-flash point is that these families never
+    silently leave the kernel."""
+    _, cfg = resolve(tiny)
+    flash_cfg = dataclasses.replace(cfg, attention_impl='flash',
+                                    attention_block_size=16)
+    params = llama.init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (1, 48), 0,
+                                cfg.vocab_size, jnp.int32)
+    dense_logits = np.asarray(llama.forward(params, tokens, cfg))
+    flash_logits = np.asarray(llama.forward(params, tokens, flash_cfg))
+    np.testing.assert_allclose(dense_logits, flash_logits, atol=2e-4,
+                               rtol=2e-4)
+
+
 def test_ring_rejects_window():
     mesh = make_mesh(MeshSpec(data=1, context=8, fsdp=1))
     q = jnp.zeros((1, 16, 2, 8))
