@@ -30,9 +30,14 @@ import jax.numpy as jnp
 from jax import lax
 
 from skypilot_tpu.models import llama
+from skypilot_tpu.models import moe as moe_lib
 
 Params = Dict[str, Any]
 Cache = Dict[str, jax.Array]
+# Engine-servable config types: the llama core (llama/gemma/mistral)
+# and the MoE family. Both are frozen dataclasses (hashable -> valid
+# jit static args) exposing num_layers/num_kv_heads/head_dim/dtype.
+ModelConfig = Any
 
 _NEG_INF = -1e30
 
@@ -100,23 +105,25 @@ def _cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     return jnp.einsum('bhqk,bkhd->bqhd', probs, v_cache)
 
 
-def _layer_with_cache(x: jax.Array, layer_params: Params,
-                      k_cache: jax.Array, v_cache: jax.Array,
-                      positions: jax.Array, lengths: jax.Array,
-                      write_at: jax.Array,
-                      config: llama.LlamaConfig,
-                      window: Optional[jax.Array] = None
-                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """One transformer layer over T new tokens with KV-cache update.
+def _attn_with_cache(x: jax.Array, layer_params: Params,
+                     k_cache: jax.Array, v_cache: jax.Array,
+                     positions: jax.Array, lengths: jax.Array,
+                     write_at: jax.Array, config: ModelConfig,
+                     window: Optional[jax.Array] = None
+                     ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Attention block over T new tokens with KV-cache update; shared
+    by the llama-core and MoE cached layers (MoE reuses llama's
+    attention, models/moe.py `_layer`).
 
     x: [B,T,E]; positions: [B,T] global positions of the new tokens;
     write_at: [B] cache index where token 0 of this chunk lands.
-    Family knobs ((1+w) norms, GeGLU, post-norms, softcap, q scaling,
-    sliding window) mirror llama._layer exactly — the decode path must
-    compute what the training forward computes.
+    Family knobs ((1+w) norms, softcap, q scaling, sliding window)
+    mirror llama._layer exactly — the decode path must compute what
+    the training forward computes. getattr defaults cover configs
+    (MoeConfig) that don't carry a knob at all.
     """
     c = config
-    plus_one = c.norm_plus_one
+    plus_one = getattr(c, 'norm_plus_one', False)
     h = llama._rms_norm(x, layer_params['attn_norm'], c.rms_norm_eps,
                         plus_one)
     q = jnp.einsum('bse,ehd->bshd', h, layer_params['wq'],
@@ -127,8 +134,9 @@ def _layer_with_cache(x: jax.Array, layer_params: Params,
                    preferred_element_type=jnp.float32).astype(c.dtype)
     q = llama._rope(q, positions, c.rope_theta)
     k = llama._rope(k, positions, c.rope_theta)
-    if c.query_pre_attn_scalar is not None:
-        q = q * math.sqrt(c.head_dim / c.query_pre_attn_scalar)
+    qpa = getattr(c, 'query_pre_attn_scalar', None)
+    if qpa is not None:
+        q = q * math.sqrt(c.head_dim / qpa)
 
     # Scatter the T new KV entries into the cache at write_at per slot.
     def write_one(cache_b, new_b, at_b):
@@ -139,15 +147,31 @@ def _layer_with_cache(x: jax.Array, layer_params: Params,
 
     attn = _cached_attention(q, k_cache, v_cache, positions, lengths,
                              window=window,
-                             softcap=c.attn_logit_softcap)
+                             softcap=getattr(c, 'attn_logit_softcap',
+                                             None))
     attn_out = jnp.einsum('bshd,hde->bse', attn.astype(c.dtype),
                           layer_params['wo'],
                           preferred_element_type=jnp.float32).astype(c.dtype)
-    if c.post_norms:
+    if getattr(c, 'post_norms', False):
         attn_out = llama._rms_norm(attn_out,
                                    layer_params['post_attn_norm'],
                                    c.rms_norm_eps, plus_one)
-    x = x + attn_out
+    return x + attn_out, k_cache, v_cache
+
+
+def _layer_with_cache(x: jax.Array, layer_params: Params,
+                      k_cache: jax.Array, v_cache: jax.Array,
+                      positions: jax.Array, lengths: jax.Array,
+                      write_at: jax.Array,
+                      config: llama.LlamaConfig,
+                      window: Optional[jax.Array] = None
+                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One llama-core layer (attention + dense GLU MLP) with cache."""
+    c = config
+    plus_one = c.norm_plus_one
+    x, k_cache, v_cache = _attn_with_cache(
+        x, layer_params, k_cache, v_cache, positions, lengths, write_at,
+        c, window=window)
 
     h = llama._rms_norm(x, layer_params['mlp_norm'], c.rms_norm_eps,
                         plus_one)
@@ -166,12 +190,59 @@ def _layer_with_cache(x: jax.Array, layer_params: Params,
     return x + down, k_cache, v_cache
 
 
+def _moe_layer_with_cache(x: jax.Array, layer_params: Params,
+                          k_cache: jax.Array, v_cache: jax.Array,
+                          positions: jax.Array, lengths: jax.Array,
+                          write_at: jax.Array, config: Any
+                          ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One MoE layer (llama attention + routed expert MLP) with cache.
+
+    Routing needs no cache of its own — it is per-token feedforward —
+    so MoE decode is the shared KV machinery plus `moe._moe_mlp`
+    (aux loss discarded; it only regularizes training).
+    """
+    c = config
+    x, k_cache, v_cache = _attn_with_cache(
+        x, layer_params, k_cache, v_cache, positions, lengths, write_at,
+        c)
+    h = llama._rms_norm(x, layer_params['mlp_norm'], c.rms_norm_eps)
+    out, _aux = moe_lib._moe_mlp(h, layer_params, c)
+    return x + out, k_cache, v_cache
+
+
+def _moe_forward_with_cache(params: Params, tokens: jax.Array,
+                            cache: Cache, positions: jax.Array,
+                            write_at: jax.Array, new_lengths: jax.Array,
+                            config: Any) -> Tuple[jax.Array, Cache]:
+    """MoE variant of `_forward_with_cache` (plain norms, untied
+    lm_head, no windows/softcaps — models/moe.py `forward`)."""
+    c = config
+    x = params['embed'].astype(c.dtype)[tokens]
+
+    def body(x, per_layer):
+        layer_params, k_cache, v_cache = per_layer
+        x, k_cache, v_cache = _moe_layer_with_cache(
+            x, layer_params, k_cache, v_cache, positions, new_lengths,
+            write_at, c)
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = lax.scan(body, x, (params['layers'], cache['k'],
+                                           cache['v']))
+    x = llama._rms_norm(x, params['final_norm'], c.rms_norm_eps)
+    logits = jnp.einsum('bse,ev->bsv', x, params['lm_head'],
+                        preferred_element_type=jnp.float32)
+    return logits, {'k': new_k, 'v': new_v, 'length': new_lengths}
+
+
 def _forward_with_cache(params: Params, tokens: jax.Array,
                         cache: Cache, positions: jax.Array,
                         write_at: jax.Array, new_lengths: jax.Array,
-                        config: llama.LlamaConfig
+                        config: ModelConfig
                         ) -> Tuple[jax.Array, Cache]:
     """tokens [B,T] at `positions` → (logits [B,T,V], updated cache)."""
+    if isinstance(config, moe_lib.MoeConfig):
+        return _moe_forward_with_cache(params, tokens, cache, positions,
+                                       write_at, new_lengths, config)
     c = config
     x = params['embed'].astype(c.dtype)[tokens]
     if c.embed_scale:
@@ -323,12 +394,24 @@ class InferenceEngine:
                  seed: int = 0):
         # The cached decode path mirrors the llama-core transformer
         # (every family knob: window/GeGLU/post-norms/softcaps/tied
-        # embeddings). MoE routing has no cached implementation yet.
-        if not isinstance(config, llama.LlamaConfig):
+        # embeddings) and the MoE family (routed expert MLP).
+        if not isinstance(config, (llama.LlamaConfig,
+                                   moe_lib.MoeConfig)):
             raise NotImplementedError(
                 'InferenceEngine serves llama-core families '
-                '(llama/gemma/mistral); got '
+                '(llama/gemma/mistral) and MoE; got '
                 f'{type(config).__name__}.')
+        if isinstance(config, moe_lib.MoeConfig):
+            # Serving must be deterministic: GShard capacity drops are
+            # a shape-dependent training-throughput trade, and the
+            # padded prefill sees different shapes than the training
+            # forward. top-k experts are distinct per token, so cap =
+            # tokens (capacity_factor = X/k) guarantees zero drops.
+            exact_cf = (config.num_experts /
+                        config.num_experts_per_tok)
+            if config.capacity_factor < exact_cf:
+                config = dataclasses.replace(config,
+                                             capacity_factor=exact_cf)
         self.params = params
         self.config = config
         self.state = DecodeState(config, batch_size, max_seq_len)

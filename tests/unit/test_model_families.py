@@ -150,15 +150,48 @@ def test_cached_decode_matches_forward(family, model):
     assert engine.run_to_completion()[rid] == ref
 
 
-def test_inference_engine_rejects_moe():
-    """MoE routing has no cached decode yet — loud error, not silent
-    mis-decoding."""
+def test_moe_cached_decode_matches_forward():
+    """MoE serving: the KV-cache engine must reproduce the full MoE
+    forward token-for-token. The engine raises capacity_factor to
+    X/k (drop-free routing) because GShard capacity drops are
+    shape-dependent and the padded prefill sees different shapes than
+    a full forward — the oracle runs at the same exact capacity."""
     from skypilot_tpu import inference
     from skypilot_tpu.models import moe
     cfg = moe.CONFIGS['tiny-moe']
-    params = moe.init_params(cfg, jax.random.key(0))
+    params = moe.init_params(cfg, jax.random.key(3))
+    exact = dataclasses.replace(
+        cfg, capacity_factor=cfg.num_experts / cfg.num_experts_per_tok)
+
+    prompt = [5, 9, 2, 14, 7, 11, 3, 8]
+    steps = 6
+    tokens = list(prompt)
+    ref = []
+    for _ in range(steps):
+        arr = jnp.array([tokens + [0] * (64 - len(tokens))], jnp.int32)
+        logits, _aux = moe.forward(params, arr, exact)
+        nxt = int(jnp.argmax(logits[0, len(tokens) - 1]))
+        ref.append(nxt)
+        tokens.append(nxt)
+
+    engine = inference.InferenceEngine(params, cfg, batch_size=2,
+                                       max_seq_len=64)
+    assert engine.config.capacity_factor == 2.0  # raised from 1.25
+    rid = engine.submit(prompt, inference.SamplingParams(
+        temperature=0.0, max_new_tokens=steps))
+    assert engine.run_to_completion()[rid] == ref
+
+
+def test_inference_engine_rejects_unknown_config():
+    """Non-transformer configs get a loud error, not silent
+    mis-decoding."""
+    from skypilot_tpu import inference
+
+    class NotAConfig:
+        pass
+
     with pytest.raises(NotImplementedError, match='llama-core'):
-        inference.InferenceEngine(params, cfg, batch_size=1)
+        inference.InferenceEngine({}, NotAConfig(), batch_size=1)
 
 
 def test_resolve_finds_all_families():
