@@ -1,0 +1,35 @@
+import sys, time, json
+import jax, jax.numpy as jnp
+from skypilot_tpu.parallel import mesh as mesh_lib
+from skypilot_tpu.train import trainer as train_lib
+
+model, seq, batch = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+extra = dict(a.split('=') for a in sys.argv[4:])
+import dataclasses as dc
+cfg = train_lib.TrainerConfig(model=model, batch_size=batch, seq_len=seq,
+                              max_steps=100, warmup_steps=10, mu_dtype='bfloat16')
+mcfg = cfg.model_config()
+if 'layers' in extra:
+    import skypilot_tpu.models as M
+    base = M.resolve(model)[1]
+    patched = dc.replace(base, num_layers=int(extra['layers']))
+    M.llama.CONFIGS[model] = patched
+    mcfg = cfg.model_config()
+mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(fsdp=-1))
+state = train_lib.make_train_state(cfg, mesh)
+batch_d = train_lib.synthetic_batch(cfg, mesh)
+step = train_lib.make_train_step(cfg, mesh)
+with mesh_lib.use_mesh(mesh):
+    for _ in range(2):
+        state, m = step(state, batch_d); loss = float(m['loss'])
+    ts = []
+    for _ in range(6):
+        t0=time.perf_counter(); state, m = step(state, batch_d); loss=float(m['loss'])
+        ts.append(time.perf_counter()-t0)
+ts.sort(); dt = ts[len(ts)//2]
+tok_s = cfg.batch_size*cfg.seq_len/dt
+chip = train_lib.detect_chip()
+m = train_lib.mfu(tok_s, mcfg, cfg.seq_len, train_lib.PEAK_FLOPS[chip], 1)
+print(json.dumps({'model': model, 'layers': mcfg.num_layers, 'seq': seq, 'batch': batch,
+                  'params': mcfg.num_params(), 'median_step_s': round(dt,4),
+                  'tok_s_chip': round(tok_s,1), 'mfu': round(m,4), 'loss': round(loss,3)}))

@@ -1,21 +1,40 @@
-"""Benchmark: Llama train-step tokens/sec/chip + MFU on the local chip(s).
+"""Benchmark: Llama train-step MFU (8B-shaped) + decode throughput.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
 
-Baseline: BASELINE.md's north-star of >=40% MFU for Llama finetune
-(the reference publishes no model-compute numbers — it is an
-orchestrator; SURVEY.md §6). vs_baseline = achieved_mfu / 0.40.
+Primary metric: train tokens/s/chip on `bench-8b` — the EXACT
+llama3-8B layer geometry (4096/14336, 32q/8kv, head 128) with depth
+and vocab cut to fit one 16G-HBM chip next to AdamW state; lax.scan
+makes per-layer cost uniform, so the MFU transfers to the real 8B.
+vs_baseline = achieved_mfu / 0.40 (BASELINE.md north star: >=40% MFU
+for the Llama-3-8B finetune; the reference publishes no model-compute
+numbers — it is an orchestrator, SURVEY.md §6).
 
-Robustness: every timed step materializes the loss (true device sync —
+extra.decode: serving throughput through the KV-cache engine's
+compiled path — prefill tokens/s and per-step decode tokens/s/chip
+over a batch sweep (BASELINE.md: "tokens/sec/chip — Llama-3-8B serve").
+The decode loop runs ON DEVICE (lax.scan over the cached forward) so
+the number measures the chip, not the relay RTT of this harness.
+
+Robustness: every timed step materializes a scalar (true device sync —
 async dispatch through remote relays can make block_until_ready
-unreliable), and the loop stops at a wall-clock budget so a slow
+unreliable), and each phase stops at a wall-clock budget so a slow
 environment still reports a result.
 """
+import functools
 import json
+import sys
 import time
 
-_TIME_BUDGET_S = 240.0
+
+def _progress(msg: str) -> None:
+    """Stage markers on stderr (stdout carries only the JSON line)."""
+    print(f'[bench {time.strftime("%H:%M:%S")}] {msg}', file=sys.stderr,
+          flush=True)
+
+_TRAIN_BUDGET_S = 240.0
+_DECODE_BUDGET_S = 180.0
 _MAX_STEPS = 10
 _INIT_RETRIES = 3
 _INIT_BACKOFF_S = 30.0
@@ -52,19 +71,14 @@ def _init_backend():
                        f'attempts: {last_err}')
 
 
-def main() -> None:
-    jax, devices = _init_backend()
-
+def _train_bench(jax, n_devices: int, on_tpu: bool):
     from skypilot_tpu.parallel import mesh as mesh_lib
     from skypilot_tpu.train import trainer as train_lib
 
-    n_devices = len(devices)
-    on_tpu = devices[0].platform == 'tpu'
-
-    # Bench config: ~1B model on TPU. seq 4096 / batch 1 / bf16 Adam
-    # momentum measured fastest on a ~16G-HBM chip (flash attention +
-    # fused CE keep activations within budget); tiny on CPU.
-    model = 'bench-1b' if on_tpu else 'tiny'
+    # Largest 8B-geometry config one 16G v5e holds: 5 layers, seq 4096,
+    # per-chip batch 1 (6 layers / seq 8192 / batch 2 all OOM); flash
+    # block 1024 per the r2 sweep. CPU runs use the tiny preset.
+    model = 'bench-8b' if on_tpu else 'tiny'
     seq_len = 4096 if on_tpu else 128
     per_chip_batch = 1 if on_tpu else 2
 
@@ -79,60 +93,170 @@ def main() -> None:
     )
     mcfg = cfg.model_config()
 
+    _progress(f'train: init {model} state')
     state = train_lib.make_train_state(cfg, mesh)
     batch = train_lib.synthetic_batch(cfg, mesh)
     step = train_lib.make_train_step(cfg, mesh)
 
+    _progress('train: compile + warmup')
     t_start = time.perf_counter()
     step_times = []
+    loss = float('nan')
     with mesh_lib.use_mesh(mesh):
         # Warmup: compile + 2 steps (each synced via float()).
         for _ in range(3):
             state, metrics = step(state, batch)
             loss = float(metrics['loss'])
-            if time.perf_counter() - t_start > _TIME_BUDGET_S:
+            if time.perf_counter() - t_start > _TRAIN_BUDGET_S:
                 break
+        _progress('train: timing steps')
         while (len(step_times) < _MAX_STEPS and
-               time.perf_counter() - t_start < _TIME_BUDGET_S):
+               time.perf_counter() - t_start < _TRAIN_BUDGET_S):
             t0 = time.perf_counter()
             state, metrics = step(state, batch)
             loss = float(metrics['loss'])  # device sync
             step_times.append(time.perf_counter() - t0)
 
     if not step_times:
-        print(json.dumps({
-            'metric': 'llama_train_tokens_per_sec_per_chip',
-            'value': 0.0, 'unit': 'tokens/s/chip', 'vs_baseline': 0.0,
-            'extra': {'error': 'no step finished within budget'},
-        }))
-        return
+        raise RuntimeError('no train step finished within budget')
 
     # Median step time is robust to stragglers.
     step_times.sort()
     dt = step_times[len(step_times) // 2]
     tokens_per_step = cfg.batch_size * cfg.seq_len
     tokens_per_sec = tokens_per_step / dt
-    tokens_per_sec_chip = tokens_per_sec / n_devices
 
     chip = train_lib.detect_chip()
     peak = train_lib.PEAK_FLOPS[chip]
     mfu = train_lib.mfu(tokens_per_sec, mcfg, cfg.seq_len, peak,
                         n_devices)
+    return {
+        'model': model, 'chip': chip,
+        'tokens_per_sec_per_chip': round(tokens_per_sec / n_devices, 2),
+        'mfu': round(mfu, 4),
+        'seq_len': cfg.seq_len,
+        'global_batch': cfg.batch_size,
+        'model_params': mcfg.num_params(),
+        'median_step_s': round(dt, 4),
+        'steps_timed': len(step_times),
+        'final_loss': round(loss, 4),
+    }
+
+
+def _decode_bench(jax, on_tpu: bool):
+    """Prefill + decode throughput through the engine's compiled path.
+
+    Decode runs as lax.scan over the cached forward (greedy), so one
+    host sync covers `steps` tokens — measuring the chip rather than
+    the host/relay round-trip that the step-at-a-time engine loop
+    would pay in this harness.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    from skypilot_tpu.inference import engine as eng
+    from skypilot_tpu.models import resolve
+
+    model = 'bench-1b' if on_tpu else 'tiny'
+    max_seq = 2048 if on_tpu else 64
+    prompt_len = 512 if on_tpu else 16
+    steps = 64 if on_tpu else 4
+    batch_sizes = (1, 8, 32) if on_tpu else (2,)
+
+    _progress(f'decode: init {model} params')
+    family, cfg = resolve(model)
+    params = jax.jit(functools.partial(family.init_params, cfg))(
+        jax.random.key(0))
+
+    def run_decode(params, cache, last, n_steps):
+        def body(carry, _):
+            cache, last = carry
+            lengths = cache['length']
+            positions = lengths[:, None]
+            new_lengths = lengths + 1
+            logits, cache = eng._forward_with_cache(
+                params, last[:, None], cache, positions, lengths,
+                new_lengths, cfg)
+            nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+            return (cache, nxt), nxt
+        (cache, last), toks = lax.scan(body, (cache, last), None,
+                                       length=n_steps)
+        return toks
+
+    t_start = time.perf_counter()
+    sweep = {}
+    for b in batch_sizes:
+        if time.perf_counter() - t_start > _DECODE_BUDGET_S:
+            break
+        _progress(f'decode: batch {b}')
+        cache = eng.init_cache(cfg, b, max_seq)
+        prompts = jax.random.randint(jax.random.key(1), (b, prompt_len),
+                                     0, cfg.vocab_size, jnp.int32)
+        lengths = jnp.full((b,), prompt_len, jnp.int32)
+        slots = jnp.arange(b, dtype=jnp.int32)
+
+        # Prefill (compile, then timed runs against a fresh cache).
+        logits, filled = eng.prefill(params, prompts, lengths, cache,
+                                     slots, cfg)
+        float(logits.sum())
+        prefill_ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            logits, filled = eng.prefill(params, prompts, lengths,
+                                         cache, slots, cfg)
+            float(logits.sum())
+            prefill_ts.append(time.perf_counter() - t0)
+
+        last = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        decode = jax.jit(run_decode, static_argnames=('n_steps',))
+        toks = decode(params, filled, last, steps)
+        float(toks.sum())  # compile + sync
+        decode_ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            toks = decode(params, filled, last, steps)
+            float(toks.sum())
+            decode_ts.append(time.perf_counter() - t0)
+        prefill_dt = min(prefill_ts)
+        decode_dt = min(decode_ts)
+        sweep[str(b)] = {
+            'prefill_tokens_per_sec': round(b * prompt_len / prefill_dt,
+                                            1),
+            'decode_tokens_per_sec': round(b * steps / decode_dt, 1),
+            'decode_step_ms': round(decode_dt / steps * 1e3, 3),
+        }
+    best = max((v['decode_tokens_per_sec'] for v in sweep.values()),
+               default=0.0)
+    return {
+        'model': model, 'prompt_len': prompt_len,
+        'decode_steps': steps, 'max_seq': max_seq,
+        'batch_sweep': sweep,
+        'best_decode_tokens_per_sec_per_chip': best,
+    }
+
+
+def main() -> None:
+    jax, devices = _init_backend()
+    n_devices = len(devices)
+    on_tpu = devices[0].platform == 'tpu'
+
+    train = _train_bench(jax, n_devices, on_tpu)
+
+    try:
+        decode = _decode_bench(jax, on_tpu)
+    except Exception as e:  # noqa: BLE001 — decode bench is additive
+        decode = {'error': f'{type(e).__name__}: {e}'}
 
     result = {
-        'metric': f'llama_{model}_train_tokens_per_sec_per_chip_{chip}',
-        'value': round(tokens_per_sec_chip, 2),
+        'metric': (f'llama_{train["model"]}_train_tokens_per_sec_'
+                   f'per_chip_{train["chip"]}'),
+        'value': train['tokens_per_sec_per_chip'],
         'unit': 'tokens/s/chip',
-        'vs_baseline': round(mfu / 0.40, 4),
+        'vs_baseline': round(train['mfu'] / 0.40, 4),
         'extra': {
-            'mfu': round(mfu, 4),
             'n_devices': n_devices,
-            'seq_len': cfg.seq_len,
-            'global_batch': cfg.batch_size,
-            'model_params': mcfg.num_params(),
-            'median_step_s': round(dt, 4),
-            'steps_timed': len(step_times),
-            'final_loss': round(loss, 4),
+            **{k: v for k, v in train.items() if k != 'model'},
+            'decode': decode,
         },
     }
     print(json.dumps(result))

@@ -111,6 +111,16 @@ CONFIGS: Dict[str, LlamaConfig] = {
                             num_heads=16, num_kv_heads=8, head_dim=128,
                             max_seq_len=2048, attention_impl='flash',
                             attention_block_size=1024),
+    # llama3-8B-SHAPED single-chip bench: exact 8B layer geometry
+    # (4096/14336, 32q/8kv, head 128) so per-layer MFU transfers to the
+    # real 8B (lax.scan makes per-layer cost uniform), with depth and
+    # vocab cut to fit a 16G-HBM v5e chip next to AdamW state
+    # (params+grads+bf16 mu+f32 nu ≈ 10 bytes/param).
+    'bench-8b': LlamaConfig(vocab_size=32768, hidden_size=4096,
+                            intermediate_size=14336, num_layers=5,
+                            num_heads=32, num_kv_heads=8, head_dim=128,
+                            max_seq_len=4096, attention_impl='flash',
+                            attention_block_size=1024),
 }
 
 

@@ -1,18 +1,16 @@
-"""Cudo Compute provisioner — project-scoped VMs behind the uniform
-interface.
+"""Cudo Compute provisioner — project-scoped VMs on the shared REST
+driver.
 
 Reference analog: sky/provision/cudo/. VMs live under a project (like
 Nebius); ids are our deterministic `<cluster>-<i>` names directly
 (Cudo vm ids are caller-chosen), which makes every lookup exact.
 """
-import logging
-from typing import Any, Dict, List, Optional
+import re
+from typing import Any, Dict, List
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.adaptors import cudo as cudo_adaptor
-from skypilot_tpu.provision import common
-
-logger = logging.getLogger(__name__)
+from skypilot_tpu.provision import common, rest_driver
 
 _STATE_MAP = {
     'PENDING': 'pending',
@@ -27,154 +25,77 @@ _STATE_MAP = {
 }
 
 
-def _project(pc: Dict[str, Any]) -> str:
+def _resolve_project(client, ctx: rest_driver.Ctx) -> None:
+    del client
+    pc = ctx.provider_config
     project = pc.get('project_id') or cudo_adaptor.default_project_id()
     if not project:
         raise exceptions.ProvisionError(
             'Cudo project id missing: set cudo.project_id in config '
             'or CUDO_PROJECT_ID.')
     pc['project_id'] = project
-    return project
+    ctx.data['project'] = project
 
 
 def _state(vm: Dict[str, Any]) -> str:
     return _STATE_MAP.get(str(vm.get('state', '')).upper(), 'pending')
 
 
-def _cluster_vms(client, project: str, cluster_name_on_cloud: str
-                 ) -> List[Dict[str, Any]]:
-    import re
-    pattern = re.compile(re.escape(cluster_name_on_cloud) + r'-\d+$')
-    resp = client.request('GET', f'/v1/projects/{project}/vms')
+def _list(client, ctx: rest_driver.Ctx) -> List[Dict[str, Any]]:
+    pattern = re.compile(re.escape(ctx.cluster) + r'-\d+$')
+    resp = client.request('GET',
+                          f'/v1/projects/{ctx.data["project"]}/vms')
     return [vm for vm in resp.get('VMs', resp.get('vms', []))
             if pattern.fullmatch(vm.get('id') or '')]
 
 
-def run_instances(region: str, cluster_name_on_cloud: str,
-                  config: common.ProvisionConfig) -> common.ProvisionRecord:
-    pc = config.provider_config
-    project = _project(pc)
-    client = cudo_adaptor.client()
-    nc = {**pc, **config.node_config}
-    existing = {vm['id']: vm for vm in _cluster_vms(
-        client, project, cluster_name_on_cloud)}
-    created: List[str] = []
-    resumed: List[str] = []
-    try:
-        public_key = common.require_public_key(
-            config.authentication_config)
-        for i in range(config.count):
-            name = f'{cluster_name_on_cloud}-{i}'
-            vm = existing.get(name)
-            state = _state(vm) if vm else None
-            if state in ('running', 'pending'):
-                continue
-            if state == 'stopped':
-                if not config.resume_stopped_nodes:
-                    raise exceptions.ProvisionError(
-                        f'VM {name} is stopped; pass '
-                        'resume_stopped_nodes to restart it.')
-                client.request(
-                    'POST', f'/v1/projects/{project}/vms/{name}/start')
-                resumed.append(name)
-                continue
-            common.refuse_unresumable(state, name)
-            client.request(
-                'POST', f'/v1/projects/{project}/vm', json_body={
-                    'vmId': name,
-                    'machineType': nc.get('instance_type', ''),
-                    'dataCenterId': region,
-                    'bootDiskImageId':
-                        nc.get('image_id') or 'ubuntu-2204-nvidia-535',
-                    'bootDiskSizeGib': int(nc.get('disk_size', 100)),
-                    'sshKeySource': 'SSH_KEY_SOURCE_NONE',
-                    'customSshKeys': [public_key],
-                })
-            created.append(name)
-        common.wait_until_running(
-            lambda: _cluster_vms(client, project, cluster_name_on_cloud),
-            config.count, _state, lambda v: v['id'],
-            timeout=float(pc.get('provision_timeout', 900)))
-    except cudo_adaptor.RestApiError as e:
-        raise cudo_adaptor.classify_api_error(e) from e
-    return common.ProvisionRecord(
-        provider_name='cudo', region=region, zone=None,
-        cluster_name_on_cloud=cluster_name_on_cloud,
-        head_instance_id=f'{cluster_name_on_cloud}-0',
-        created_instance_ids=created, resumed_instance_ids=resumed)
+def _create(client, ctx: rest_driver.Ctx, name: str) -> None:
+    nc = ctx.nc
+    client.request(
+        'POST', f'/v1/projects/{ctx.data["project"]}/vm', json_body={
+            'vmId': name,
+            'machineType': nc.get('instance_type', ''),
+            'dataCenterId': ctx.region,
+            'bootDiskImageId':
+                nc.get('image_id') or 'ubuntu-2204-nvidia-535',
+            'bootDiskSizeGib': int(nc.get('disk_size', 100)),
+            'sshKeySource': 'SSH_KEY_SOURCE_NONE',
+            'customSshKeys': [common.require_public_key(
+                ctx.config.authentication_config)],
+        })
 
 
-def wait_instances(region: str, cluster_name_on_cloud: str,
-                   state: Optional[str] = None) -> None:
-    del region, cluster_name_on_cloud, state  # run_instances waits
+def _host_info(vm: Dict[str, Any]) -> common.HostInfo:
+    nic = (vm.get('nics') or [{}])[0]
+    return common.HostInfo(
+        host_id=vm['id'],
+        internal_ip=nic.get('internalIpAddress', ''),
+        external_ip=nic.get('externalIpAddress') or
+        vm.get('externalIpAddress'))
 
 
-def stop_instances(cluster_name_on_cloud: str,
-                   provider_config: Dict[str, Any]) -> None:
-    project = _project(provider_config)
-    client = cudo_adaptor.client()
-    for vm in _cluster_vms(client, project, cluster_name_on_cloud):
-        if _state(vm) == 'running':
-            client.request(
-                'POST',
-                f'/v1/projects/{project}/vms/{vm["id"]}/stop')
+_SPEC = rest_driver.RestVmSpec(
+    provider='cudo',
+    adaptor=cudo_adaptor,
+    ssh_user='root',
+    list_instances=_list,
+    state=_state,
+    name_of=lambda vm: vm['id'],
+    create=_create,
+    host_info=_host_info,
+    terminate=lambda client, ctx, vm: client.request(
+        'POST',
+        f'/v1/projects/{ctx.data["project"]}/vms/{vm["id"]}/terminate'),
+    # FAILED VMs map to 'terminated' but still hold quota: terminate
+    # them too.
+    terminate_terminated=True,
+    stop=lambda client, ctx, vm: client.request(
+        'POST',
+        f'/v1/projects/{ctx.data["project"]}/vms/{vm["id"]}/stop'),
+    resume=lambda client, ctx, vm: client.request(
+        'POST',
+        f'/v1/projects/{ctx.data["project"]}/vms/{vm["id"]}/start'),
+    prepare_context=_resolve_project,
+)
 
-
-def terminate_instances(cluster_name_on_cloud: str,
-                        provider_config: Dict[str, Any]) -> None:
-    project = _project(provider_config)
-    client = cudo_adaptor.client()
-    for vm in _cluster_vms(client, project, cluster_name_on_cloud):
-        client.request(
-            'POST',
-            f'/v1/projects/{project}/vms/{vm["id"]}/terminate')
-
-
-def query_instances(cluster_name_on_cloud: str,
-                    provider_config: Dict[str, Any]
-                    ) -> Dict[str, Optional[str]]:
-    project = _project(provider_config)
-    client = cudo_adaptor.client()
-    out: Dict[str, Optional[str]] = {}
-    for vm in _cluster_vms(client, project, cluster_name_on_cloud):
-        state = _state(vm)
-        if state == 'terminated':
-            continue
-        out[vm['id']] = state
-    return out
-
-
-def get_cluster_info(region: str, cluster_name_on_cloud: str,
-                     provider_config: Dict[str, Any]) -> common.ClusterInfo:
-    del region
-    project = _project(provider_config)
-    client = cudo_adaptor.client()
-    instances: Dict[str, common.InstanceInfo] = {}
-    head_name = f'{cluster_name_on_cloud}-0'
-    head_id: Optional[str] = None
-    for vm in _cluster_vms(client, project, cluster_name_on_cloud):
-        if _state(vm) != 'running':
-            continue
-        name = vm['id']
-        nic = (vm.get('nics') or [{}])[0]
-        instances[name] = common.InstanceInfo(
-            instance_id=name,
-            hosts=[common.HostInfo(
-                host_id=name,
-                internal_ip=nic.get('internalIpAddress', ''),
-                external_ip=nic.get('externalIpAddress') or
-                vm.get('externalIpAddress'))],
-            status='running', tags={})
-        if name == head_name:
-            head_id = name
-    if head_id is None and instances:
-        head_id = sorted(instances)[0]
-    return common.ClusterInfo(
-        instances=instances, head_instance_id=head_id,
-        provider_name='cudo', provider_config=provider_config,
-        ssh_user=provider_config.get('ssh_user', 'root'),
-        ssh_private_key=provider_config.get('ssh_private_key'))
-
-
-def get_command_runners(cluster_info: common.ClusterInfo):
-    return common.ssh_command_runners(cluster_info, 'root')
+rest_driver.RestVmDriver(_SPEC).export(globals())

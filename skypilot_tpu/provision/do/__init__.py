@@ -1,4 +1,4 @@
-"""DigitalOcean provisioner — droplets behind the uniform interface.
+"""DigitalOcean provisioner — droplets on the shared REST driver.
 
 Reference analog: sky/provision/do/instance.py. Droplets are tagged
 `skytpu:<cluster>` (tags are DO's native grouping primitive) and named
@@ -7,14 +7,10 @@ a fingerprint-derived name; power_off/power_on give real stop/resume
 (disk persists, billing drops to disk-only).
 """
 import hashlib
-import logging
 from typing import Any, Dict, List, Optional
 
-from skypilot_tpu import exceptions
 from skypilot_tpu.adaptors import do as do_adaptor
-from skypilot_tpu.provision import common
-
-logger = logging.getLogger(__name__)
+from skypilot_tpu.provision import common, rest_driver
 
 _DEFAULT_IMAGE = 'ubuntu-22-04-x64'
 
@@ -30,20 +26,17 @@ def _droplet_state(droplet: Dict[str, Any]) -> str:
             'archive': 'terminated'}.get(status, 'pending')
 
 
-def _cluster_droplets(client, cluster_name_on_cloud: str,
-                      region: Optional[str] = None
-                      ) -> List[Dict[str, Any]]:
-    """Tag-matched droplets; `region` narrows to one region so a
-    failover retry elsewhere never adopts a dying droplet from the
-    failed region (teardown/query stay region-global)."""
+def _list(client, ctx: rest_driver.Ctx) -> List[Dict[str, Any]]:
+    """Tag-matched droplets; ctx.region (set for launch/query/info,
+    None for stop/terminate) narrows to one region so a failover retry
+    elsewhere never adopts a dying droplet from the failed region."""
     resp = client.request(
         'GET', '/v2/droplets',
-        params={'tag_name': _tag(cluster_name_on_cloud),
-                'per_page': '200'})
+        params={'tag_name': _tag(ctx.cluster), 'per_page': '200'})
     droplets = resp.get('droplets', [])
-    if region is not None:
+    if ctx.region is not None:
         droplets = [d for d in droplets
-                    if (d.get('region') or {}).get('slug') == region]
+                    if (d.get('region') or {}).get('slug') == ctx.region]
     return droplets
 
 
@@ -73,172 +66,80 @@ def _find_key_id(client, public_key: str) -> Optional[int]:
         page += 1
 
 
-def _ensure_ssh_key(client, public_key: str) -> int:
-    """Idempotently register the cluster public key; returns its id."""
+def _ensure_ssh_key(client, ctx: rest_driver.Ctx) -> None:
+    """Idempotently register the cluster public key; stashes its id."""
+    public_key = common.require_public_key(
+        ctx.config.authentication_config)
     key_id = _find_key_id(client, public_key)
-    if key_id is not None:
-        return key_id
-    digest = hashlib.sha256(public_key.encode()).hexdigest()[:12]
-    try:
-        created = client.request('POST', '/v2/account/keys',
-                                 json_body={'name': f'skytpu-{digest}',
-                                            'public_key': public_key})
-    except do_adaptor.RestApiError as e:
-        if e.status == 422:  # raced: registered since our scan
+    if key_id is None:
+        digest = hashlib.sha256(public_key.encode()).hexdigest()[:12]
+        try:
+            created = client.request(
+                'POST', '/v2/account/keys',
+                json_body={'name': f'skytpu-{digest}',
+                           'public_key': public_key})
+            key_id = created['ssh_key']['id']
+        except do_adaptor.RestApiError as e:
+            if e.status != 422:  # 422 = raced: registered since scan
+                raise
             key_id = _find_key_id(client, public_key)
-            if key_id is not None:
-                return key_id
-        raise
-    return created['ssh_key']['id']
+            if key_id is None:
+                raise
+    ctx.data['key_id'] = key_id
 
 
-def run_instances(region: str, cluster_name_on_cloud: str,
-                  config: common.ProvisionConfig) -> common.ProvisionRecord:
-    client = do_adaptor.client()
-    nc = {**config.provider_config, **config.node_config}
-    existing = {d['name']: d
-                for d in _cluster_droplets(client, cluster_name_on_cloud,
-                                           region=region)}
-    created: List[str] = []
-    resumed: List[str] = []
+def _create(client, ctx: rest_driver.Ctx, name: str) -> None:
+    nc = ctx.nc
+    body = {
+        'name': name,
+        'region': ctx.region,
+        'size': nc['instance_type'],
+        'image': nc.get('image_id') or _DEFAULT_IMAGE,
+        'ssh_keys': [ctx.data['key_id']],
+        'tags': [_tag(ctx.cluster)],
+        'monitoring': False,
+    }
+    client.request('POST', '/v2/droplets', json_body=body)
+
+
+def _terminate_all(client, ctx: rest_driver.Ctx) -> None:
     try:
-        key_id = _ensure_ssh_key(
-            client,
-            common.require_public_key(config.authentication_config))
-        for i in range(config.count):
-            name = f'{cluster_name_on_cloud}-{i}'
-            droplet = existing.get(name)
-            state = _droplet_state(droplet) if droplet else None
-            if state in ('running', 'pending'):
-                continue
-            if state == 'stopped':
-                if not config.resume_stopped_nodes:
-                    raise exceptions.ProvisionError(
-                        f'Droplet {name} is stopped; pass '
-                        'resume_stopped_nodes to restart it.')
-                client.request(
-                    'POST', f'/v2/droplets/{droplet["id"]}/actions',
-                    json_body={'type': 'power_on'})
-                resumed.append(name)
-                continue
-            body = {
-                'name': name,
-                'region': region,
-                'size': nc['instance_type'],
-                'image': nc.get('image_id') or _DEFAULT_IMAGE,
-                'ssh_keys': [key_id],
-                'tags': [_tag(cluster_name_on_cloud)],
-                'monitoring': False,
-            }
-            client.request('POST', '/v2/droplets', json_body=body)
-            created.append(name)
-        _wait_active(client, cluster_name_on_cloud, config.count,
-                     region=region,
-                     timeout=float(config.provider_config.get(
-                         'provision_timeout', 900)))
-    except do_adaptor.RestApiError as e:
-        raise do_adaptor.classify_api_error(e) from e
-    return common.ProvisionRecord(
-        provider_name='do', region=region, zone=None,
-        cluster_name_on_cloud=cluster_name_on_cloud,
-        head_instance_id=f'{cluster_name_on_cloud}-0',
-        created_instance_ids=created, resumed_instance_ids=resumed)
-
-
-def _wait_active(client, cluster_name_on_cloud: str, count: int,
-                 region: Optional[str] = None,
-                 timeout: float = 900.0) -> None:
-    common.wait_until_running(
-        lambda: _cluster_droplets(client, cluster_name_on_cloud,
-                                  region=region),
-        count, _droplet_state, lambda d: d['name'], timeout=timeout)
-
-
-def wait_instances(region: str, cluster_name_on_cloud: str,
-                   state: Optional[str] = None) -> None:
-    del region, cluster_name_on_cloud, state  # run_instances waits
-
-
-def stop_instances(cluster_name_on_cloud: str,
-                   provider_config: Dict[str, Any]) -> None:
-    client = do_adaptor.client()
-    for droplet in _cluster_droplets(client, cluster_name_on_cloud):
-        if _droplet_state(droplet) == 'running':
-            client.request('POST',
-                           f'/v2/droplets/{droplet["id"]}/actions',
-                           json_body={'type': 'power_off'})
-
-
-def terminate_instances(cluster_name_on_cloud: str,
-                        provider_config: Dict[str, Any]) -> None:
-    client = do_adaptor.client()
-    try:
-        client.request(
-            'DELETE', '/v2/droplets',
-            params={'tag_name': _tag(cluster_name_on_cloud)})
+        client.request('DELETE', '/v2/droplets',
+                       params={'tag_name': _tag(ctx.cluster)})
     except do_adaptor.RestApiError as e:
         if e.status != 404:
             raise
 
 
-def query_instances(cluster_name_on_cloud: str,
-                    provider_config: Dict[str, Any]
-                    ) -> Dict[str, Optional[str]]:
-    client = do_adaptor.client()
-    out: Dict[str, Optional[str]] = {}
-    # Scope to the handle's region when known: names collide across
-    # regions after a failover, and a dying other-region droplet must
-    # not shadow the real node's status.
-    for droplet in _cluster_droplets(client, cluster_name_on_cloud,
-                                     region=provider_config.get('region')):
-        state = _droplet_state(droplet)
-        if state == 'terminated':
-            continue
-        out[droplet['name']] = state
-    return out
-
-
-def _ips(droplet: Dict[str, Any]) -> Dict[str, Optional[str]]:
+def _host_info(droplet: Dict[str, Any]) -> common.HostInfo:
     internal, external = '', None
     for net in droplet.get('networks', {}).get('v4', []):
         if net.get('type') == 'private':
             internal = net.get('ip_address', '')
         elif net.get('type') == 'public':
             external = net.get('ip_address')
-    return {'internal': internal or (external or ''),
-            'external': external}
+    return common.HostInfo(host_id=str(droplet['id']),
+                           internal_ip=internal or (external or ''),
+                           external_ip=external)
 
 
-def get_cluster_info(region: str, cluster_name_on_cloud: str,
-                     provider_config: Dict[str, Any]) -> common.ClusterInfo:
-    client = do_adaptor.client()
-    instances: Dict[str, common.InstanceInfo] = {}
-    head_name = f'{cluster_name_on_cloud}-0'
-    head_id: Optional[str] = None
-    # Region-scoped: a same-name droplet lingering in a failed-over
-    # region must not supply the head IP.
-    for droplet in _cluster_droplets(client, cluster_name_on_cloud,
-                                     region=region):
-        if _droplet_state(droplet) != 'running':
-            continue
-        name = droplet['name']
-        ips = _ips(droplet)
-        instances[name] = common.InstanceInfo(
-            instance_id=name,
-            hosts=[common.HostInfo(host_id=str(droplet['id']),
-                                   internal_ip=ips['internal'],
-                                   external_ip=ips['external'])],
-            status='running', tags={})
-        if name == head_name:
-            head_id = name
-    if head_id is None and instances:
-        head_id = sorted(instances)[0]
-    return common.ClusterInfo(
-        instances=instances, head_instance_id=head_id,
-        provider_name='do', provider_config=provider_config,
-        ssh_user='root',
-        ssh_private_key=provider_config.get('ssh_private_key'))
+_SPEC = rest_driver.RestVmSpec(
+    provider='do',
+    adaptor=do_adaptor,
+    ssh_user='root',
+    list_instances=_list,
+    state=_droplet_state,
+    name_of=lambda d: d['name'],
+    create=_create,
+    host_info=_host_info,
+    terminate_all=_terminate_all,
+    stop=lambda client, ctx, d: client.request(
+        'POST', f'/v2/droplets/{d["id"]}/actions',
+        json_body={'type': 'power_off'}),
+    resume=lambda client, ctx, d: client.request(
+        'POST', f'/v2/droplets/{d["id"]}/actions',
+        json_body={'type': 'power_on'}),
+    prepare_launch=_ensure_ssh_key,
+)
 
-
-def get_command_runners(cluster_info: common.ClusterInfo):
-    return common.ssh_command_runners(cluster_info, 'root')
+rest_driver.RestVmDriver(_SPEC).export(globals())

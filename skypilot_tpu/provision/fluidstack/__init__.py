@@ -1,4 +1,4 @@
-"""Fluidstack provisioner — GPU neocloud behind the uniform interface.
+"""Fluidstack provisioner — GPU neocloud on the shared REST driver.
 
 Reference analog: sky/provision/fluidstack/instance.py. Plain
 instance lifecycle (create/list/stop/start/delete) with the SSH key
@@ -6,15 +6,11 @@ registered account-wide at launch; instances carry our deterministic
 `<cluster>-<i>` names.
 """
 import hashlib
-import logging
 import re
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List
 
-from skypilot_tpu import exceptions
 from skypilot_tpu.adaptors import fluidstack as fs_adaptor
-from skypilot_tpu.provision import common
-
-logger = logging.getLogger(__name__)
+from skypilot_tpu.provision import common, rest_driver
 
 _STATUS_MAP = {
     'pending': 'pending',
@@ -32,9 +28,8 @@ def _state(inst: Dict[str, Any]) -> str:
                            'pending')
 
 
-def _cluster_instances(client, cluster_name_on_cloud: str
-                       ) -> List[Dict[str, Any]]:
-    pattern = re.compile(re.escape(cluster_name_on_cloud) + r'-\d+$')
+def _list(client, ctx: rest_driver.Ctx) -> List[Dict[str, Any]]:
+    pattern = re.compile(re.escape(ctx.cluster) + r'-\d+$')
     resp = client.request('GET', '/instances')
     instances = resp if isinstance(resp, list) else resp.get(
         'instances', [])
@@ -42,140 +37,53 @@ def _cluster_instances(client, cluster_name_on_cloud: str
             if pattern.fullmatch(i.get('name') or '')]
 
 
-def _ensure_ssh_key(client, public_key: str) -> str:
+def _ensure_ssh_key(client, ctx: rest_driver.Ctx) -> None:
+    public_key = common.require_public_key(
+        ctx.config.authentication_config)
     digest = hashlib.sha256(public_key.encode()).hexdigest()[:12]
     key_name = f'skytpu-{digest}'
     resp = client.request('GET', '/ssh_keys')
     keys = resp if isinstance(resp, list) else resp.get('ssh_keys', [])
-    for key in keys:
-        if key.get('name') == key_name:
-            return key_name
-    client.request('POST', '/ssh_keys',
-                   json_body={'name': key_name,
-                              'public_key': public_key})
-    return key_name
+    if not any(key.get('name') == key_name for key in keys):
+        client.request('POST', '/ssh_keys',
+                       json_body={'name': key_name,
+                                  'public_key': public_key})
+    ctx.data['key_name'] = key_name
 
 
-def run_instances(region: str, cluster_name_on_cloud: str,
-                  config: common.ProvisionConfig) -> common.ProvisionRecord:
-    client = fs_adaptor.client()
-    nc = {**config.provider_config, **config.node_config}
-    existing = {i['name']: i for i in _cluster_instances(
-        client, cluster_name_on_cloud)}
-    created: List[str] = []
-    resumed: List[str] = []
-    try:
-        key_name = _ensure_ssh_key(
-            client,
-            common.require_public_key(config.authentication_config))
-        for i in range(config.count):
-            name = f'{cluster_name_on_cloud}-{i}'
-            inst = existing.get(name)
-            state = _state(inst) if inst else None
-            if state in ('running', 'pending'):
-                continue
-            if state == 'stopped':
-                if not config.resume_stopped_nodes:
-                    raise exceptions.ProvisionError(
-                        f'Instance {name} is stopped; pass '
-                        'resume_stopped_nodes to restart it.')
-                client.request('PUT',
-                               f'/instances/{inst["id"]}/start')
-                resumed.append(name)
-                continue
-            common.refuse_unresumable(state, name)
-            client.request('POST', '/instances', json_body={
-                'name': name,
-                'gpu_type': nc.get('gpu_type', ''),
-                'gpu_count': int(nc.get('gpu_count', 1)),
-                'ssh_key': key_name,
-                'operating_system_label':
-                    nc.get('image_id') or 'ubuntu_22_04_lts_nvidia',
-                'region': region,
-            })
-            created.append(name)
-        _wait_running(client, cluster_name_on_cloud, config.count,
-                      timeout=float(config.provider_config.get(
-                          'provision_timeout', 900)))
-    except fs_adaptor.RestApiError as e:
-        raise fs_adaptor.classify_api_error(e) from e
-    return common.ProvisionRecord(
-        provider_name='fluidstack', region=region, zone=None,
-        cluster_name_on_cloud=cluster_name_on_cloud,
-        head_instance_id=f'{cluster_name_on_cloud}-0',
-        created_instance_ids=created, resumed_instance_ids=resumed)
+def _create(client, ctx: rest_driver.Ctx, name: str) -> None:
+    nc = ctx.nc
+    client.request('POST', '/instances', json_body={
+        'name': name,
+        'gpu_type': nc.get('gpu_type', ''),
+        'gpu_count': int(nc.get('gpu_count', 1)),
+        'ssh_key': ctx.data['key_name'],
+        'operating_system_label':
+            nc.get('image_id') or 'ubuntu_22_04_lts_nvidia',
+        'region': ctx.region,
+    })
 
 
-def _wait_running(client, cluster_name_on_cloud: str, count: int,
-                  timeout: float = 900.0) -> None:
-    common.wait_until_running(
-        lambda: _cluster_instances(client, cluster_name_on_cloud),
-        count, _state, lambda i: i['name'], timeout=timeout)
+_SPEC = rest_driver.RestVmSpec(
+    provider='fluidstack',
+    adaptor=fs_adaptor,
+    ssh_user='ubuntu',
+    list_instances=_list,
+    state=_state,
+    name_of=lambda inst: inst['name'],
+    create=_create,
+    host_info=lambda inst: common.HostInfo(
+        host_id=str(inst['id']),
+        internal_ip=inst.get('private_ip', '') or
+        inst.get('ip_address', ''),
+        external_ip=inst.get('ip_address')),
+    terminate=lambda client, ctx, inst: client.request(
+        'DELETE', f'/instances/{inst["id"]}'),
+    stop=lambda client, ctx, inst: client.request(
+        'PUT', f'/instances/{inst["id"]}/stop'),
+    resume=lambda client, ctx, inst: client.request(
+        'PUT', f'/instances/{inst["id"]}/start'),
+    prepare_launch=_ensure_ssh_key,
+)
 
-
-def wait_instances(region: str, cluster_name_on_cloud: str,
-                   state: Optional[str] = None) -> None:
-    del region, cluster_name_on_cloud, state  # run_instances waits
-
-
-def stop_instances(cluster_name_on_cloud: str,
-                   provider_config: Dict[str, Any]) -> None:
-    client = fs_adaptor.client()
-    for inst in _cluster_instances(client, cluster_name_on_cloud):
-        if _state(inst) == 'running':
-            client.request('PUT', f'/instances/{inst["id"]}/stop')
-
-
-def terminate_instances(cluster_name_on_cloud: str,
-                        provider_config: Dict[str, Any]) -> None:
-    client = fs_adaptor.client()
-    for inst in _cluster_instances(client, cluster_name_on_cloud):
-        if _state(inst) != 'terminated':
-            client.request('DELETE', f'/instances/{inst["id"]}')
-
-
-def query_instances(cluster_name_on_cloud: str,
-                    provider_config: Dict[str, Any]
-                    ) -> Dict[str, Optional[str]]:
-    client = fs_adaptor.client()
-    out: Dict[str, Optional[str]] = {}
-    for inst in _cluster_instances(client, cluster_name_on_cloud):
-        state = _state(inst)
-        if state == 'terminated':
-            continue
-        out[inst['name']] = state
-    return out
-
-
-def get_cluster_info(region: str, cluster_name_on_cloud: str,
-                     provider_config: Dict[str, Any]) -> common.ClusterInfo:
-    del region
-    client = fs_adaptor.client()
-    instances: Dict[str, common.InstanceInfo] = {}
-    head_name = f'{cluster_name_on_cloud}-0'
-    head_id: Optional[str] = None
-    for inst in _cluster_instances(client, cluster_name_on_cloud):
-        if _state(inst) != 'running':
-            continue
-        name = inst['name']
-        instances[name] = common.InstanceInfo(
-            instance_id=name,
-            hosts=[common.HostInfo(
-                host_id=str(inst['id']),
-                internal_ip=inst.get('private_ip', '') or
-                inst.get('ip_address', ''),
-                external_ip=inst.get('ip_address'))],
-            status='running', tags={})
-        if name == head_name:
-            head_id = name
-    if head_id is None and instances:
-        head_id = sorted(instances)[0]
-    return common.ClusterInfo(
-        instances=instances, head_instance_id=head_id,
-        provider_name='fluidstack', provider_config=provider_config,
-        ssh_user=provider_config.get('ssh_user', 'ubuntu'),
-        ssh_private_key=provider_config.get('ssh_private_key'))
-
-
-def get_command_runners(cluster_info: common.ClusterInfo):
-    return common.ssh_command_runners(cluster_info, 'ubuntu')
+rest_driver.RestVmDriver(_SPEC).export(globals())

@@ -1,4 +1,4 @@
-"""Lambda Cloud provisioner — GPU neocloud behind the uniform interface.
+"""Lambda Cloud provisioner — GPU neocloud on the shared REST driver.
 
 Reference analog: sky/provision/lambda_cloud/instance.py. The API is
 launch/list/terminate only (no stop, no custom images, no port
@@ -12,15 +12,12 @@ idempotently register the cluster keypair under a deterministic name
 derived from the public key fingerprint.
 """
 import hashlib
-import logging
 import re
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.adaptors import lambda_cloud as lambda_adaptor
-from skypilot_tpu.provision import common
-
-logger = logging.getLogger(__name__)
+from skypilot_tpu.provision import common, rest_driver
 
 _STATE_MAP = {
     'booting': 'pending',
@@ -45,140 +42,62 @@ def _state(inst: Dict[str, Any]) -> str:
     return _STATE_MAP.get(inst.get('status', ''), 'pending')
 
 
-def _ensure_ssh_key(client, public_key: str) -> str:
-    """Idempotently register the cluster public key; returns its name."""
+def _ensure_ssh_key(client, ctx: rest_driver.Ctx) -> None:
+    """Idempotently register the cluster public key under a
+    fingerprint-derived name; stashes the name for _create."""
+    public_key = common.require_public_key(
+        ctx.config.authentication_config)
     digest = hashlib.sha256(public_key.encode()).hexdigest()[:12]
     key_name = f'skytpu-{digest}'
     existing = client.request('GET', '/ssh-keys')
-    for key in existing.get('data', []):
-        if key.get('name') == key_name:
-            return key_name
-    client.request('POST', '/ssh-keys',
-                   json_body={'name': key_name,
-                              'public_key': public_key})
-    return key_name
+    if not any(key.get('name') == key_name
+               for key in existing.get('data', [])):
+        client.request('POST', '/ssh-keys',
+                       json_body={'name': key_name,
+                                  'public_key': public_key})
+    ctx.data['key_name'] = key_name
 
 
-def run_instances(region: str, cluster_name_on_cloud: str,
-                  config: common.ProvisionConfig) -> common.ProvisionRecord:
-    client = lambda_adaptor.client()
-    nc = {**config.provider_config, **config.node_config}
-    existing = _cluster_instances(client, cluster_name_on_cloud)
-    # Duplicate names can coexist briefly (e.g. a terminating twin
-    # alongside its replacement), so classify per-name over ALL
-    # same-name instances rather than last-listed-wins.
-    alive = {inst['name'] for inst in existing
-             if _state(inst) in ('running', 'pending')}
-    stopping = {inst['name'] for inst in existing
-                if _state(inst) == 'stopping'} - alive
-
-    created: List[str] = []
-    try:
-        key_name = _ensure_ssh_key(
-            client,
-            common.require_public_key(config.authentication_config))
-        for i in range(config.count):
-            name = f'{cluster_name_on_cloud}-{i}'
-            if name in alive:
-                continue
-            if name in stopping:
-                common.refuse_unresumable('stopping', name)
-            resp = client.request(
-                'POST', '/instance-operations/launch',
-                json_body={
-                    'region_name': region,
-                    'instance_type_name': nc['instance_type'],
-                    'ssh_key_names': [key_name],
-                    'quantity': 1,
-                    'name': name,
-                })
-            ids = resp.get('data', {}).get('instance_ids', [])
-            if not ids:
-                raise exceptions.ProvisionError(
-                    f'Lambda launch returned no instance id for {name}')
-            created.append(name)
-        _wait_active(client, cluster_name_on_cloud, config.count,
-                     timeout=float(config.provider_config.get(
-                         'provision_timeout', 900)))
-    except lambda_adaptor.RestApiError as e:
-        raise lambda_adaptor.classify_api_error(e) from e
-    return common.ProvisionRecord(
-        provider_name='lambda', region=region, zone=None,
-        cluster_name_on_cloud=cluster_name_on_cloud,
-        head_instance_id=f'{cluster_name_on_cloud}-0',
-        created_instance_ids=created, resumed_instance_ids=[])
+def _create(client, ctx: rest_driver.Ctx, name: str) -> None:
+    resp = client.request(
+        'POST', '/instance-operations/launch',
+        json_body={
+            'region_name': ctx.region,
+            'instance_type_name': ctx.nc['instance_type'],
+            'ssh_key_names': [ctx.data['key_name']],
+            'quantity': 1,
+            'name': name,
+        })
+    if not resp.get('data', {}).get('instance_ids', []):
+        raise exceptions.ProvisionError(
+            f'Lambda launch returned no instance id for {name}')
 
 
-def _wait_active(client, cluster_name_on_cloud: str, count: int,
-                 timeout: float = 900.0) -> None:
-    common.wait_until_running(
-        lambda: _cluster_instances(client, cluster_name_on_cloud),
-        count, _state, lambda i: i['name'], timeout=timeout)
-
-
-def wait_instances(region: str, cluster_name_on_cloud: str,
-                   state: Optional[str] = None) -> None:
-    del region, cluster_name_on_cloud, state  # run_instances waits
-
-
-def stop_instances(cluster_name_on_cloud: str,
-                   provider_config: Dict[str, Any]) -> None:
-    raise exceptions.NotSupportedError(
-        'Lambda Cloud cannot stop instances; use terminate (down).')
-
-
-def terminate_instances(cluster_name_on_cloud: str,
-                        provider_config: Dict[str, Any]) -> None:
-    client = lambda_adaptor.client()
+def _terminate_all(client, ctx: rest_driver.Ctx) -> None:
     ids = [inst['id']
-           for inst in _cluster_instances(client, cluster_name_on_cloud)
+           for inst in _cluster_instances(client, ctx.cluster)
            if _state(inst) not in ('terminated', 'stopping')]
-    if not ids:
-        return
-    client.request('POST', '/instance-operations/terminate',
-                   json_body={'instance_ids': ids})
+    if ids:
+        client.request('POST', '/instance-operations/terminate',
+                       json_body={'instance_ids': ids})
 
 
-def query_instances(cluster_name_on_cloud: str,
-                    provider_config: Dict[str, Any]
-                    ) -> Dict[str, Optional[str]]:
-    client = lambda_adaptor.client()
-    out: Dict[str, Optional[str]] = {}
-    for inst in _cluster_instances(client, cluster_name_on_cloud):
-        state = _state(inst)
-        if state == 'terminated':
-            continue
-        out[inst['name']] = state
-    return out
+_SPEC = rest_driver.RestVmSpec(
+    provider='lambda',
+    adaptor=lambda_adaptor,
+    ssh_user='ubuntu',
+    list_instances=lambda client, ctx: _cluster_instances(client,
+                                                          ctx.cluster),
+    state=_state,
+    name_of=lambda inst: inst['name'],
+    create=_create,
+    host_info=lambda inst: common.HostInfo(
+        host_id=inst['id'],
+        internal_ip=inst.get('private_ip', ''),
+        external_ip=inst.get('ip')),
+    terminate_all=_terminate_all,
+    # No stop/resume: Lambda has no stopped state at all.
+    prepare_launch=_ensure_ssh_key,
+)
 
-
-def get_cluster_info(region: str, cluster_name_on_cloud: str,
-                     provider_config: Dict[str, Any]) -> common.ClusterInfo:
-    del region
-    client = lambda_adaptor.client()
-    instances: Dict[str, common.InstanceInfo] = {}
-    head_id: Optional[str] = None
-    head_name = f'{cluster_name_on_cloud}-0'
-    for inst in _cluster_instances(client, cluster_name_on_cloud):
-        if _state(inst) != 'running':
-            continue
-        name = inst['name']
-        instances[name] = common.InstanceInfo(
-            instance_id=name,
-            hosts=[common.HostInfo(host_id=inst['id'],
-                                   internal_ip=inst.get('private_ip', ''),
-                                   external_ip=inst.get('ip'))],
-            status='running', tags={})
-        if name == head_name:
-            head_id = name
-    if head_id is None and instances:
-        head_id = sorted(instances)[0]
-    return common.ClusterInfo(
-        instances=instances, head_instance_id=head_id,
-        provider_name='lambda', provider_config=provider_config,
-        ssh_user='ubuntu',
-        ssh_private_key=provider_config.get('ssh_private_key'))
-
-
-def get_command_runners(cluster_info: common.ClusterInfo):
-    return common.ssh_command_runners(cluster_info, 'ubuntu')
+rest_driver.RestVmDriver(_SPEC).export(globals())

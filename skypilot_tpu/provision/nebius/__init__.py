@@ -1,5 +1,5 @@
-"""Nebius AI Cloud provisioner — H100/H200 platforms behind the
-uniform interface.
+"""Nebius AI Cloud provisioner — H100/H200 platforms on the shared
+REST driver.
 
 Reference analog: sky/provision/nebius/instance.py (692 LoC over the
 SDK). Instances live under a parent project; names are deterministic
@@ -8,15 +8,12 @@ split of the catalog instance type (`<platform>_<preset>`, e.g.
 `gpu-h100-sxm_8gpu-128vcpu-1600gb`). Stop/start are first-class, so
 autostop can stop (unlike the terminate-only neoclouds).
 """
-import logging
 import re
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.adaptors import nebius as nebius_adaptor
-from skypilot_tpu.provision import common
-
-logger = logging.getLogger(__name__)
+from skypilot_tpu.provision import common, rest_driver
 
 _BASE = '/compute/v1/instances'
 
@@ -31,14 +28,16 @@ _STATE_MAP = {
 }
 
 
-def _project(pc: Dict[str, Any]) -> str:
+def _resolve_project(client, ctx: rest_driver.Ctx) -> None:
+    del client
+    pc = ctx.provider_config
     project = pc.get('project_id') or nebius_adaptor.default_project_id()
     if not project:
         raise exceptions.ProvisionError(
             'Nebius project id missing: set nebius.project_id in config '
             'or NEBIUS_PROJECT_ID.')
     pc['project_id'] = project
-    return project
+    ctx.data['project'] = project
 
 
 def _state(inst: Dict[str, Any]) -> str:
@@ -46,16 +45,15 @@ def _state(inst: Dict[str, Any]) -> str:
         inst.get('status', {}).get('state', ''), 'pending')
 
 
-def _cluster_instances(client, project: str, cluster_name_on_cloud: str
-                       ) -> List[Dict[str, Any]]:
+def _list(client, ctx: rest_driver.Ctx) -> List[Dict[str, Any]]:
     # Exact `<cluster>-<index>` match (a bare prefix would also catch
     # cluster 'train-2' when tearing down 'train'), following
     # nextPageToken so big projects can't truncate a cluster away.
-    pattern = re.compile(re.escape(cluster_name_on_cloud) + r'-\d+$')
+    pattern = re.compile(re.escape(ctx.cluster) + r'-\d+$')
     out: List[Dict[str, Any]] = []
     page_token = ''
     while True:
-        params = {'parentId': project, 'pageSize': '500'}
+        params = {'parentId': ctx.data['project'], 'pageSize': '500'}
         if page_token:
             params['pageToken'] = page_token
         resp = client.request('GET', _BASE, params=params)
@@ -73,162 +71,71 @@ def split_instance_type(instance_type: str) -> Dict[str, str]:
     return {'platform': platform, 'preset': preset}
 
 
-def run_instances(region: str, cluster_name_on_cloud: str,
-                  config: common.ProvisionConfig) -> common.ProvisionRecord:
-    pc = config.provider_config
-    project = _project(pc)
-    client = nebius_adaptor.client()
-    nc = {**pc, **config.node_config}
+def _create(client, ctx: rest_driver.Ctx, name: str) -> None:
+    nc = ctx.nc
     spec_bits = split_instance_type(nc.get('instance_type', ''))
-    existing = {i['metadata']['name']: i for i in _cluster_instances(
-        client, project, cluster_name_on_cloud)}
-    created: List[str] = []
-    resumed: List[str] = []
-    try:
-        for i in range(config.count):
-            name = f'{cluster_name_on_cloud}-{i}'
-            inst = existing.get(name)
-            state = _state(inst) if inst else None
-            if state in ('running', 'pending'):
-                continue
-            if state == 'stopped':
-                if not config.resume_stopped_nodes:
-                    raise exceptions.ProvisionError(
-                        f'Instance {name} is stopped; pass '
-                        'resume_stopped_nodes to restart it.')
-                client.request(
-                    'POST', f'{_BASE}/{inst["metadata"]["id"]}:start')
-                resumed.append(name)
-                continue
-            ssh_user = config.authentication_config.get(
-                'ssh_user', 'skytpu')
-            public_key = common.require_public_key(
-                config.authentication_config)
-            body = {
-                'metadata': {'parentId': project, 'name': name},
-                'spec': {
-                    'resources': {
-                        'platform': spec_bits['platform'],
-                        'preset': spec_bits['preset'],
-                    },
-                    'bootDisk': {
-                        'attachMode': 'READ_WRITE',
-                        'sizeGibibytes': int(nc.get('disk_size', 256)),
-                        'sourceImageFamily':
-                            nc.get('image_id') or 'ubuntu22.04-driverless',
-                    },
-                    'networkInterfaces': [{
-                        'name': 'eth0',
-                        'subnetId': nc.get('subnet_id', ''),
-                        'ipAddress': {},
-                        'publicIpAddress': {},
-                    }],
-                    'cloudInitUserData': (
-                        '#cloud-config\n'
-                        f'users:\n'
-                        f'  - name: {ssh_user}\n'
-                        '    sudo: ALL=(ALL) NOPASSWD:ALL\n'
-                        '    shell: /bin/bash\n'
-                        '    ssh_authorized_keys:\n'
-                        f'      - {public_key}\n'),
-                },
-            }
-            client.request('POST', _BASE, json_body=body)
-            created.append(name)
-        _wait_running(client, project, cluster_name_on_cloud,
-                      config.count,
-                      timeout=float(pc.get('provision_timeout', 900)))
-    except nebius_adaptor.RestApiError as e:
-        raise nebius_adaptor.classify_api_error(e) from e
-    return common.ProvisionRecord(
-        provider_name='nebius', region=region, zone=None,
-        cluster_name_on_cloud=cluster_name_on_cloud,
-        head_instance_id=f'{cluster_name_on_cloud}-0',
-        created_instance_ids=created, resumed_instance_ids=resumed)
+    ssh_user = ctx.config.authentication_config.get('ssh_user', 'skytpu')
+    public_key = common.require_public_key(
+        ctx.config.authentication_config)
+    body = {
+        'metadata': {'parentId': ctx.data['project'], 'name': name},
+        'spec': {
+            'resources': {
+                'platform': spec_bits['platform'],
+                'preset': spec_bits['preset'],
+            },
+            'bootDisk': {
+                'attachMode': 'READ_WRITE',
+                'sizeGibibytes': int(nc.get('disk_size', 256)),
+                'sourceImageFamily':
+                    nc.get('image_id') or 'ubuntu22.04-driverless',
+            },
+            'networkInterfaces': [{
+                'name': 'eth0',
+                'subnetId': nc.get('subnet_id', ''),
+                'ipAddress': {},
+                'publicIpAddress': {},
+            }],
+            'cloudInitUserData': (
+                '#cloud-config\n'
+                f'users:\n'
+                f'  - name: {ssh_user}\n'
+                '    sudo: ALL=(ALL) NOPASSWD:ALL\n'
+                '    shell: /bin/bash\n'
+                '    ssh_authorized_keys:\n'
+                f'      - {public_key}\n'),
+        },
+    }
+    client.request('POST', _BASE, json_body=body)
 
 
-def _wait_running(client, project: str, cluster_name_on_cloud: str,
-                  count: int, timeout: float = 900.0) -> None:
-    common.wait_until_running(
-        lambda: _cluster_instances(client, project,
-                                   cluster_name_on_cloud),
-        count, _state, lambda i: i['metadata']['name'],
-        timeout=timeout)
+def _host_info(inst: Dict[str, Any]) -> common.HostInfo:
+    nic = (inst.get('status', {}).get('networkInterfaces') or [{}])[0]
+    return common.HostInfo(
+        host_id=inst['metadata']['id'],
+        internal_ip=nic.get('ipAddress', {}).get('address', ''),
+        external_ip=nic.get('publicIpAddress', {}).get('address'))
 
 
-def wait_instances(region: str, cluster_name_on_cloud: str,
-                   state: Optional[str] = None) -> None:
-    del region, cluster_name_on_cloud, state  # run_instances waits
+_SPEC = rest_driver.RestVmSpec(
+    provider='nebius',
+    adaptor=nebius_adaptor,
+    ssh_user='skytpu',
+    list_instances=_list,
+    state=_state,
+    name_of=lambda inst: inst['metadata']['name'],
+    create=_create,
+    host_info=_host_info,
+    terminate=lambda client, ctx, inst: client.request(
+        'DELETE', f'{_BASE}/{inst["metadata"]["id"]}'),
+    # ERROR-state instances map to 'terminated' but still hold quota:
+    # delete them too.
+    terminate_terminated=True,
+    stop=lambda client, ctx, inst: client.request(
+        'POST', f'{_BASE}/{inst["metadata"]["id"]}:stop'),
+    resume=lambda client, ctx, inst: client.request(
+        'POST', f'{_BASE}/{inst["metadata"]["id"]}:start'),
+    prepare_context=_resolve_project,
+)
 
-
-def stop_instances(cluster_name_on_cloud: str,
-                   provider_config: Dict[str, Any]) -> None:
-    project = _project(provider_config)
-    client = nebius_adaptor.client()
-    for inst in _cluster_instances(client, project,
-                                   cluster_name_on_cloud):
-        if _state(inst) == 'running':
-            client.request('POST',
-                           f'{_BASE}/{inst["metadata"]["id"]}:stop')
-
-
-def terminate_instances(cluster_name_on_cloud: str,
-                        provider_config: Dict[str, Any]) -> None:
-    project = _project(provider_config)
-    client = nebius_adaptor.client()
-    for inst in _cluster_instances(client, project,
-                                   cluster_name_on_cloud):
-        client.request('DELETE', f'{_BASE}/{inst["metadata"]["id"]}')
-
-
-def query_instances(cluster_name_on_cloud: str,
-                    provider_config: Dict[str, Any]
-                    ) -> Dict[str, Optional[str]]:
-    project = _project(provider_config)
-    client = nebius_adaptor.client()
-    out: Dict[str, Optional[str]] = {}
-    for inst in _cluster_instances(client, project,
-                                   cluster_name_on_cloud):
-        state = _state(inst)
-        if state == 'terminated':
-            continue
-        out[inst['metadata']['name']] = state
-    return out
-
-
-def get_cluster_info(region: str, cluster_name_on_cloud: str,
-                     provider_config: Dict[str, Any]) -> common.ClusterInfo:
-    del region
-    project = _project(provider_config)
-    client = nebius_adaptor.client()
-    instances: Dict[str, common.InstanceInfo] = {}
-    head_name = f'{cluster_name_on_cloud}-0'
-    head_id: Optional[str] = None
-    for inst in _cluster_instances(client, project,
-                                   cluster_name_on_cloud):
-        if _state(inst) != 'running':
-            continue
-        name = inst['metadata']['name']
-        nic = (inst.get('status', {}).get('networkInterfaces')
-               or [{}])[0]
-        instances[name] = common.InstanceInfo(
-            instance_id=name,
-            hosts=[common.HostInfo(
-                host_id=inst['metadata']['id'],
-                internal_ip=nic.get('ipAddress', {}).get('address', ''),
-                external_ip=nic.get('publicIpAddress', {})
-                .get('address'))],
-            status='running', tags={})
-        if name == head_name:
-            head_id = name
-    if head_id is None and instances:
-        head_id = sorted(instances)[0]
-    return common.ClusterInfo(
-        instances=instances, head_instance_id=head_id,
-        provider_name='nebius', provider_config=provider_config,
-        ssh_user=provider_config.get('ssh_user', 'skytpu'),
-        ssh_private_key=provider_config.get('ssh_private_key'))
-
-
-def get_command_runners(cluster_info: common.ClusterInfo):
-    return common.ssh_command_runners(cluster_info, 'skytpu')
+rest_driver.RestVmDriver(_SPEC).export(globals())

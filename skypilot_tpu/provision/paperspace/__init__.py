@@ -1,19 +1,15 @@
-"""Paperspace provisioner — GPU machines behind the uniform interface.
+"""Paperspace provisioner — GPU machines on the shared REST driver.
 
 Reference analog: sky/provision/paperspace/instance.py. Machines have
 server-assigned ids; our deterministic `<cluster>-<i>` identity rides
 the machine NAME. Stop/start are first-class; startup script installs
 the cluster SSH key.
 """
-import logging
 import re
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List
 
-from skypilot_tpu import exceptions
 from skypilot_tpu.adaptors import paperspace as ps_adaptor
-from skypilot_tpu.provision import common
-
-logger = logging.getLogger(__name__)
+from skypilot_tpu.provision import common, rest_driver
 
 _STATE_MAP = {
     'provisioning': 'pending',
@@ -33,9 +29,8 @@ def _state(machine: Dict[str, Any]) -> str:
                           'pending')
 
 
-def _cluster_machines(client, cluster_name_on_cloud: str
-                      ) -> List[Dict[str, Any]]:
-    pattern = re.compile(re.escape(cluster_name_on_cloud) + r'-\d+$')
+def _list(client, ctx: rest_driver.Ctx) -> List[Dict[str, Any]]:
+    pattern = re.compile(re.escape(ctx.cluster) + r'-\d+$')
     out: List[Dict[str, Any]] = []
     page = None
     while True:
@@ -55,128 +50,49 @@ def _cluster_machines(client, cluster_name_on_cloud: str
         page = next_page
 
 
-def run_instances(region: str, cluster_name_on_cloud: str,
-                  config: common.ProvisionConfig) -> common.ProvisionRecord:
-    client = ps_adaptor.client()
-    nc = {**config.provider_config, **config.node_config}
-    existing = {m['name']: m for m in _cluster_machines(
-        client, cluster_name_on_cloud)}
-    created: List[str] = []
-    resumed: List[str] = []
-    try:
-        public_key = common.require_public_key(
-            config.authentication_config)
-        for i in range(config.count):
-            name = f'{cluster_name_on_cloud}-{i}'
-            machine = existing.get(name)
-            state = _state(machine) if machine else None
-            if state in ('running', 'pending'):
-                continue
-            if state == 'stopped':
-                if not config.resume_stopped_nodes:
-                    raise exceptions.ProvisionError(
-                        f'Machine {name} is stopped; pass '
-                        'resume_stopped_nodes to restart it.')
-                client.request(
-                    'PATCH', f'/machines/{machine["id"]}/start')
-                resumed.append(name)
-                continue
-            common.refuse_unresumable(state, name)
-            client.request('POST', '/machines', json_body={
-                'name': name,
-                'machineType': nc.get('instance_type', ''),
-                'templateId': nc.get('image_id') or 'tkni3aa4',
-                'region': region,
-                'diskSize': int(nc.get('disk_size', 100)),
-                'publicIpType': 'dynamic',
-                # Startup scripts run as root: write to the paperspace
-                # user's home EXPLICITLY (~ would be /root, stranding
-                # the key); single quotes keep the key literal.
-                'startupScript': (
-                    'mkdir -p /home/paperspace/.ssh && '
-                    f"echo '{public_key}' "
-                    '>> /home/paperspace/.ssh/authorized_keys && '
-                    'chown -R paperspace:paperspace '
-                    '/home/paperspace/.ssh'),
-            })
-            created.append(name)
-        common.wait_until_running(
-            lambda: _cluster_machines(client, cluster_name_on_cloud),
-            config.count, _state, lambda m: m['name'],
-            timeout=float(config.provider_config.get(
-                'provision_timeout', 900)))
-    except ps_adaptor.RestApiError as e:
-        raise ps_adaptor.classify_api_error(e) from e
-    return common.ProvisionRecord(
-        provider_name='paperspace', region=region, zone=None,
-        cluster_name_on_cloud=cluster_name_on_cloud,
-        head_instance_id=f'{cluster_name_on_cloud}-0',
-        created_instance_ids=created, resumed_instance_ids=resumed)
+def _create(client, ctx: rest_driver.Ctx, name: str) -> None:
+    nc = ctx.nc
+    public_key = common.require_public_key(
+        ctx.config.authentication_config)
+    client.request('POST', '/machines', json_body={
+        'name': name,
+        'machineType': nc.get('instance_type', ''),
+        'templateId': nc.get('image_id') or 'tkni3aa4',
+        'region': ctx.region,
+        'diskSize': int(nc.get('disk_size', 100)),
+        'publicIpType': 'dynamic',
+        # Startup scripts run as root: write to the paperspace user's
+        # home EXPLICITLY (~ would be /root, stranding the key);
+        # single quotes keep the key literal.
+        'startupScript': (
+            'mkdir -p /home/paperspace/.ssh && '
+            f"echo '{public_key}' "
+            '>> /home/paperspace/.ssh/authorized_keys && '
+            'chown -R paperspace:paperspace /home/paperspace/.ssh'),
+    })
 
 
-def wait_instances(region: str, cluster_name_on_cloud: str,
-                   state: Optional[str] = None) -> None:
-    del region, cluster_name_on_cloud, state  # run_instances waits
+_SPEC = rest_driver.RestVmSpec(
+    provider='paperspace',
+    adaptor=ps_adaptor,
+    ssh_user='paperspace',
+    list_instances=_list,
+    state=_state,
+    name_of=lambda m: m['name'],
+    create=_create,
+    host_info=lambda m: common.HostInfo(
+        host_id=str(m['id']),
+        internal_ip=m.get('privateIp', '') or m.get('publicIp', ''),
+        external_ip=m.get('publicIp')),
+    terminate=lambda client, ctx, m: client.request(
+        'DELETE', f'/machines/{m["id"]}'),
+    # 'deleted' machines 404 on DELETE but the old per-cloud code
+    # deleted unconditionally; keep skipping only nothing.
+    terminate_terminated=True,
+    stop=lambda client, ctx, m: client.request(
+        'PATCH', f'/machines/{m["id"]}/stop'),
+    resume=lambda client, ctx, m: client.request(
+        'PATCH', f'/machines/{m["id"]}/start'),
+)
 
-
-def stop_instances(cluster_name_on_cloud: str,
-                   provider_config: Dict[str, Any]) -> None:
-    client = ps_adaptor.client()
-    for machine in _cluster_machines(client, cluster_name_on_cloud):
-        if _state(machine) == 'running':
-            client.request('PATCH',
-                           f'/machines/{machine["id"]}/stop')
-
-
-def terminate_instances(cluster_name_on_cloud: str,
-                        provider_config: Dict[str, Any]) -> None:
-    client = ps_adaptor.client()
-    for machine in _cluster_machines(client, cluster_name_on_cloud):
-        client.request('DELETE', f'/machines/{machine["id"]}')
-
-
-def query_instances(cluster_name_on_cloud: str,
-                    provider_config: Dict[str, Any]
-                    ) -> Dict[str, Optional[str]]:
-    client = ps_adaptor.client()
-    out: Dict[str, Optional[str]] = {}
-    for machine in _cluster_machines(client, cluster_name_on_cloud):
-        state = _state(machine)
-        if state == 'terminated':
-            continue
-        out[machine['name']] = state
-    return out
-
-
-def get_cluster_info(region: str, cluster_name_on_cloud: str,
-                     provider_config: Dict[str, Any]) -> common.ClusterInfo:
-    del region
-    client = ps_adaptor.client()
-    instances: Dict[str, common.InstanceInfo] = {}
-    head_name = f'{cluster_name_on_cloud}-0'
-    head_id: Optional[str] = None
-    for machine in _cluster_machines(client, cluster_name_on_cloud):
-        if _state(machine) != 'running':
-            continue
-        name = machine['name']
-        instances[name] = common.InstanceInfo(
-            instance_id=name,
-            hosts=[common.HostInfo(
-                host_id=str(machine['id']),
-                internal_ip=machine.get('privateIp', '') or
-                machine.get('publicIp', ''),
-                external_ip=machine.get('publicIp'))],
-            status='running', tags={})
-        if name == head_name:
-            head_id = name
-    if head_id is None and instances:
-        head_id = sorted(instances)[0]
-    return common.ClusterInfo(
-        instances=instances, head_instance_id=head_id,
-        provider_name='paperspace', provider_config=provider_config,
-        ssh_user=provider_config.get('ssh_user', 'paperspace'),
-        ssh_private_key=provider_config.get('ssh_private_key'))
-
-
-def get_command_runners(cluster_info: common.ClusterInfo):
-    return common.ssh_command_runners(cluster_info, 'paperspace')
+rest_driver.RestVmDriver(_SPEC).export(globals())

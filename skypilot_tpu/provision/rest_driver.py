@@ -1,0 +1,284 @@
+"""Shared lifecycle driver for REST-API VM clouds.
+
+Eight neoclouds (lambda/runpod/nebius/do/fluidstack/vast/cudo/
+paperspace) speak the same lifecycle dialect — list instances, map a
+cloud status word onto {pending,running,stopping,stopped,terminated},
+launch `<cluster>-<i>`-named nodes skipping the live ones, resume the
+stopped ones, refuse relaunch over a dying twin, poll until running,
+classify API errors into the failover taxonomy. Only the endpoints,
+payloads and field names differ. This module owns the dialect ONCE;
+each cloud contributes a declarative `RestVmSpec` (status map, create
+payload, host-address extraction, optional key/project setup).
+
+Reference analog: each of sky/provision/{lambda_cloud,runpod,do,
+fluidstack,vast,cudo,paperspace}/instance.py re-implements this loop
+per cloud (400-900 LoC each); factoring it is the TPU-repo design
+choice, not a translation.
+
+Driver-wide guarantees (each previously hand-rolled per cloud, with
+drift — e.g. nebius would relaunch over a 'stopping' twin, vast
+refused relaunch over a terminated leftover):
+- duplicate-name safety: liveness is judged across ALL same-name
+  instances, never last-listed-wins;
+- a name whose only live record is 'stopping' refuses relaunch
+  (`common.refuse_unresumable`);
+- terminated leftovers never block relaunch;
+- stop on a stop-incapable cloud raises NotSupportedError;
+- every REST error is re-raised through the cloud's
+  `classify_api_error` so capacity/auth failures hit the failover
+  engine with the right taxonomy.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+
+
+@dataclasses.dataclass
+class Ctx:
+    """Per-operation context handed to every spec callback."""
+    cluster: str
+    region: Optional[str]
+    provider_config: Dict[str, Any]
+    # provider_config ∪ node_config (launch ops only).
+    nc: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    config: Optional[common.ProvisionConfig] = None
+    # prepare_context/prepare_launch outputs (project id, key name, …).
+    data: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class RestVmSpec:
+    """What a REST cloud must declare; everything else is the driver."""
+    provider: str
+    adaptor: Any                 # client() + RestApiError + classify_api_error
+    ssh_user: str
+    # (client, ctx) -> instances of THIS cluster (exact-name matched).
+    list_instances: Callable[[Any, Ctx], List[Dict[str, Any]]]
+    # instance -> canonical state word.
+    state: Callable[[Dict[str, Any]], str]
+    # instance -> its `<cluster>-<i>` name.
+    name_of: Callable[[Dict[str, Any]], str]
+    # (client, ctx, name): POST the create call for one node.
+    create: Callable[[Any, Ctx, str], None]
+    # instance -> HostInfo (address/port extraction).
+    host_info: Callable[[Dict[str, Any]], common.HostInfo]
+    # Per-instance teardown; or terminate_all for bulk APIs.
+    terminate: Optional[Callable[[Any, Ctx, Dict[str, Any]], None]] = None
+    terminate_all: Optional[Callable[[Any, Ctx], None]] = None
+    # Omitted => the cloud cannot stop/resume (NotSupportedError).
+    stop: Optional[Callable[[Any, Ctx, Dict[str, Any]], None]] = None
+    resume: Optional[Callable[[Any, Ctx, Dict[str, Any]], None]] = None
+    # Runs for EVERY operation (cheap context: project resolution).
+    prepare_context: Optional[Callable[[Any, Ctx], None]] = None
+    # Runs before launching only (SSH key registration, …).
+    prepare_launch: Optional[Callable[[Any, Ctx], None]] = None
+    # Terminate instances in these states ('terminated' is skipped
+    # unless listed — deleting a gone instance 404s on most clouds).
+    terminate_terminated: bool = False
+
+
+class RestVmDriver:
+    """Binds a RestVmSpec to the uniform provisioner interface; cloud
+    modules re-export the bound methods as their module functions."""
+
+    def __init__(self, spec: RestVmSpec):
+        self.spec = spec
+
+    # -- helpers -------------------------------------------------------------
+
+    def _ctx(self, cluster: str, region: Optional[str],
+             provider_config: Dict[str, Any],
+             config: Optional[common.ProvisionConfig] = None) -> Ctx:
+        nc: Dict[str, Any] = {}
+        if config is not None:
+            nc = {**config.provider_config, **config.node_config}
+        return Ctx(cluster=cluster, region=region,
+                   provider_config=provider_config, nc=nc, config=config)
+
+    def _classified(self, fn):
+        try:
+            return fn()
+        except self.spec.adaptor.RestApiError as e:
+            raise self.spec.adaptor.classify_api_error(e) from e
+
+    # -- uniform interface ---------------------------------------------------
+
+    def run_instances(self, region: str, cluster_name_on_cloud: str,
+                      config: common.ProvisionConfig
+                      ) -> common.ProvisionRecord:
+        spec = self.spec
+        client = spec.adaptor.client()
+        ctx = self._ctx(cluster_name_on_cloud, region,
+                        config.provider_config, config)
+        created: List[str] = []
+        resumed: List[str] = []
+
+        def _launch():
+            if spec.prepare_context:
+                spec.prepare_context(client, ctx)
+            if spec.prepare_launch:
+                spec.prepare_launch(client, ctx)
+            existing = spec.list_instances(client, ctx)
+            # Classify per NAME over all same-name instances: a
+            # terminating twin can coexist with its live replacement,
+            # and liveness must win over last-listed order.
+            alive, stopping = set(), set()
+            stopped: Dict[str, Dict[str, Any]] = {}
+            for inst in existing:
+                name, state = spec.name_of(inst), spec.state(inst)
+                if state in ('running', 'pending'):
+                    alive.add(name)
+                elif state == 'stopped':
+                    stopped.setdefault(name, inst)
+                elif state == 'stopping':
+                    stopping.add(name)
+            stopping -= alive
+
+            for i in range(config.count):
+                name = f'{cluster_name_on_cloud}-{i}'
+                if name in alive:
+                    continue
+                if name in stopped:
+                    if not config.resume_stopped_nodes:
+                        raise exceptions.ProvisionError(
+                            f'Instance {name} is stopped; pass '
+                            'resume_stopped_nodes to restart it.')
+                    if spec.resume is None:
+                        raise exceptions.NotSupportedError(
+                            f'{spec.provider} cannot resume stopped '
+                            f'instance {name}.')
+                    spec.resume(client, ctx, stopped[name])
+                    resumed.append(name)
+                    continue
+                if name in stopping:
+                    common.refuse_unresumable('stopping', name)
+                spec.create(client, ctx, name)
+                created.append(name)
+            common.wait_until_running(
+                lambda: spec.list_instances(client, ctx),
+                config.count, spec.state, spec.name_of,
+                timeout=float(config.provider_config.get(
+                    'provision_timeout', 900)))
+
+        self._classified(_launch)
+        return common.ProvisionRecord(
+            provider_name=spec.provider, region=region, zone=None,
+            cluster_name_on_cloud=cluster_name_on_cloud,
+            head_instance_id=f'{cluster_name_on_cloud}-0',
+            created_instance_ids=created, resumed_instance_ids=resumed)
+
+    def wait_instances(self, region: str, cluster_name_on_cloud: str,
+                       state: Optional[str] = None) -> None:
+        del region, cluster_name_on_cloud, state  # run_instances waits
+
+    def stop_instances(self, cluster_name_on_cloud: str,
+                       provider_config: Dict[str, Any]) -> None:
+        spec = self.spec
+        if spec.stop is None:
+            raise exceptions.NotSupportedError(
+                f'{spec.provider} cannot stop instances; use terminate '
+                '(down).')
+        client = spec.adaptor.client()
+        ctx = self._ctx(cluster_name_on_cloud, None, provider_config)
+
+        def _stop():
+            if spec.prepare_context:
+                spec.prepare_context(client, ctx)
+            for inst in spec.list_instances(client, ctx):
+                if spec.state(inst) == 'running':
+                    spec.stop(client, ctx, inst)
+
+        self._classified(_stop)
+
+    def terminate_instances(self, cluster_name_on_cloud: str,
+                            provider_config: Dict[str, Any]) -> None:
+        spec = self.spec
+        client = spec.adaptor.client()
+        ctx = self._ctx(cluster_name_on_cloud, None, provider_config)
+
+        def _terminate():
+            if spec.prepare_context:
+                spec.prepare_context(client, ctx)
+            if spec.terminate_all is not None:
+                spec.terminate_all(client, ctx)
+                return
+            for inst in spec.list_instances(client, ctx):
+                state = spec.state(inst)
+                if state == 'terminated' and not spec.terminate_terminated:
+                    continue
+                spec.terminate(client, ctx, inst)
+
+        self._classified(_terminate)
+
+    def query_instances(self, cluster_name_on_cloud: str,
+                        provider_config: Dict[str, Any]
+                        ) -> Dict[str, Optional[str]]:
+        spec = self.spec
+        client = spec.adaptor.client()
+        # Region-scoped where the cloud's listing supports it: names
+        # collide across regions after a failover, and a dying
+        # other-region twin must not shadow the real node's status.
+        ctx = self._ctx(cluster_name_on_cloud,
+                        provider_config.get('region'), provider_config)
+
+        def _query():
+            if spec.prepare_context:
+                spec.prepare_context(client, ctx)
+            out: Dict[str, Optional[str]] = {}
+            for inst in spec.list_instances(client, ctx):
+                state = spec.state(inst)
+                if state == 'terminated':
+                    continue
+                out[spec.name_of(inst)] = state
+            return out
+
+        return self._classified(_query)
+
+    def get_cluster_info(self, region: str, cluster_name_on_cloud: str,
+                         provider_config: Dict[str, Any]
+                         ) -> common.ClusterInfo:
+        spec = self.spec
+        client = spec.adaptor.client()
+        ctx = self._ctx(cluster_name_on_cloud, region, provider_config)
+
+        def _info():
+            if spec.prepare_context:
+                spec.prepare_context(client, ctx)
+            instances: Dict[str, common.InstanceInfo] = {}
+            head_name = f'{cluster_name_on_cloud}-0'
+            head_id: Optional[str] = None
+            for inst in spec.list_instances(client, ctx):
+                if spec.state(inst) != 'running':
+                    continue
+                name = spec.name_of(inst)
+                instances[name] = common.InstanceInfo(
+                    instance_id=name, hosts=[spec.host_info(inst)],
+                    status='running', tags={})
+                if name == head_name:
+                    head_id = name
+            if head_id is None and instances:
+                head_id = sorted(instances)[0]
+            return common.ClusterInfo(
+                instances=instances, head_instance_id=head_id,
+                provider_name=spec.provider,
+                provider_config=provider_config,
+                ssh_user=provider_config.get('ssh_user', spec.ssh_user),
+                ssh_private_key=provider_config.get('ssh_private_key'))
+
+        return self._classified(_info)
+
+    def get_command_runners(self, cluster_info: common.ClusterInfo):
+        return common.ssh_command_runners(cluster_info,
+                                          self.spec.ssh_user)
+
+    def export(self, module_globals: Dict[str, Any]) -> None:
+        """Install the bound methods as the module-level provisioner
+        interface (`run_instances`, `stop_instances`, ...)."""
+        for fn in ('run_instances', 'wait_instances', 'stop_instances',
+                   'terminate_instances', 'query_instances',
+                   'get_cluster_info', 'get_command_runners'):
+            module_globals[fn] = getattr(self, fn)

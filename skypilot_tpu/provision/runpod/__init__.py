@@ -1,5 +1,5 @@
-"""RunPod provisioner — container-based GPU cloud behind the uniform
-interface.
+"""RunPod provisioner — container-based GPU cloud on the shared REST
+driver.
 
 Reference analog: sky/provision/runpod/instance.py (944 LoC over the
 GraphQL SDK). A RunPod "instance" is a pod: we launch one pod per node
@@ -8,15 +8,11 @@ public port mapping for 22/tcp, and map desiredStatus RUNNING/EXITED
 onto running/stopped. Stop keeps the volume (resume restarts the same
 pod); terminate deletes it.
 """
-import logging
 import re
 from typing import Any, Dict, List, Optional
 
-from skypilot_tpu import exceptions
 from skypilot_tpu.adaptors import runpod as runpod_adaptor
-from skypilot_tpu.provision import common
-
-logger = logging.getLogger(__name__)
+from skypilot_tpu.provision import common, rest_driver
 
 _DEFAULT_IMAGE = 'runpod/base:0.6.2-cuda12.4.1'
 
@@ -45,97 +41,22 @@ def _cluster_pods(client, cluster_name_on_cloud: str
     return [p for p in pods if pattern.fullmatch(p.get('name') or '')]
 
 
-def run_instances(region: str, cluster_name_on_cloud: str,
-                  config: common.ProvisionConfig) -> common.ProvisionRecord:
-    client = runpod_adaptor.client()
-    nc = {**config.provider_config, **config.node_config}
-    existing = {p['name']: p
-                for p in _cluster_pods(client, cluster_name_on_cloud)}
-    created: List[str] = []
-    resumed: List[str] = []
-    try:
-        for i in range(config.count):
-            name = f'{cluster_name_on_cloud}-{i}'
-            pod = existing.get(name)
-            status = _status(pod) if pod else None
-            if status in ('running', 'pending'):
-                continue
-            if status == 'stopped':
-                if not config.resume_stopped_nodes:
-                    raise exceptions.ProvisionError(
-                        f'Pod {name} is stopped; pass '
-                        'resume_stopped_nodes to restart it.')
-                client.request('POST', f'/pods/{pod["id"]}/start')
-                resumed.append(name)
-                continue
-            body = {
-                'name': name,
-                'imageName': nc.get('image_id') or _DEFAULT_IMAGE,
-                'gpuTypeIds': [nc['gpu_type']] if nc.get('gpu_type')
-                else [],
-                'gpuCount': int(nc.get('gpu_count', 0)),
-                'cloudType': ('COMMUNITY' if nc.get('use_spot')
-                              else 'SECURE'),
-                'containerDiskInGb': int(nc.get('disk_size', 64)),
-                'ports': ['22/tcp'],
-                'env': {'PUBLIC_KEY': common.require_public_key(
-                    config.authentication_config)},
-                'dataCenterIds': [region] if region else [],
-                'interruptible': bool(nc.get('use_spot')),
-            }
-            client.request('POST', '/pods', json_body=body)
-            created.append(name)
-        _wait_running(client, cluster_name_on_cloud, config.count,
-                      timeout=float(config.provider_config.get(
-                          'provision_timeout', 900)))
-    except runpod_adaptor.RestApiError as e:
-        raise runpod_adaptor.classify_api_error(e) from e
-    return common.ProvisionRecord(
-        provider_name='runpod', region=region, zone=None,
-        cluster_name_on_cloud=cluster_name_on_cloud,
-        head_instance_id=f'{cluster_name_on_cloud}-0',
-        created_instance_ids=created, resumed_instance_ids=resumed)
-
-
-def _wait_running(client, cluster_name_on_cloud: str, count: int,
-                  timeout: float = 900.0) -> None:
-    common.wait_until_running(
-        lambda: _cluster_pods(client, cluster_name_on_cloud),
-        count, _status, lambda p: p['name'], timeout=timeout)
-
-
-def wait_instances(region: str, cluster_name_on_cloud: str,
-                   state: Optional[str] = None) -> None:
-    del region, cluster_name_on_cloud, state  # run_instances waits
-
-
-def stop_instances(cluster_name_on_cloud: str,
-                   provider_config: Dict[str, Any]) -> None:
-    client = runpod_adaptor.client()
-    for pod in _cluster_pods(client, cluster_name_on_cloud):
-        if _status(pod) == 'running':
-            client.request('POST', f'/pods/{pod["id"]}/stop')
-
-
-def terminate_instances(cluster_name_on_cloud: str,
-                        provider_config: Dict[str, Any]) -> None:
-    client = runpod_adaptor.client()
-    for pod in _cluster_pods(client, cluster_name_on_cloud):
-        if _status(pod) != 'terminated':
-            client.request('DELETE', f'/pods/{pod["id"]}')
-
-
-def query_instances(cluster_name_on_cloud: str,
-                    provider_config: Dict[str, Any]
-                    ) -> Dict[str, Optional[str]]:
-    client = runpod_adaptor.client()
-    out: Dict[str, Optional[str]] = {}
-    for pod in _cluster_pods(client, cluster_name_on_cloud):
-        status = _status(pod)
-        if status == 'terminated':
-            continue
-        out[pod['name']] = status
-    return out
+def _create(client, ctx: rest_driver.Ctx, name: str) -> None:
+    nc = ctx.nc
+    body = {
+        'name': name,
+        'imageName': nc.get('image_id') or _DEFAULT_IMAGE,
+        'gpuTypeIds': [nc['gpu_type']] if nc.get('gpu_type') else [],
+        'gpuCount': int(nc.get('gpu_count', 0)),
+        'cloudType': 'COMMUNITY' if nc.get('use_spot') else 'SECURE',
+        'containerDiskInGb': int(nc.get('disk_size', 64)),
+        'ports': ['22/tcp'],
+        'env': {'PUBLIC_KEY': common.require_public_key(
+            ctx.config.authentication_config)},
+        'dataCenterIds': [ctx.region] if ctx.region else [],
+        'interruptible': bool(nc.get('use_spot')),
+    }
+    client.request('POST', '/pods', json_body=body)
 
 
 def _ssh_endpoint(pod: Dict[str, Any]) -> Optional[Dict[str, Any]]:
@@ -166,36 +87,29 @@ def _ssh_endpoint(pod: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     return None
 
 
-def get_cluster_info(region: str, cluster_name_on_cloud: str,
-                     provider_config: Dict[str, Any]) -> common.ClusterInfo:
-    del region
-    client = runpod_adaptor.client()
-    instances: Dict[str, common.InstanceInfo] = {}
-    head_name = f'{cluster_name_on_cloud}-0'
-    head_id: Optional[str] = None
-    for pod in _cluster_pods(client, cluster_name_on_cloud):
-        if _status(pod) != 'running':
-            continue
-        name = pod['name']
-        endpoint = _ssh_endpoint(pod) or {}
-        internal = pod.get('internalIp') or endpoint.get('ip') or ''
-        instances[name] = common.InstanceInfo(
-            instance_id=name,
-            hosts=[common.HostInfo(
-                host_id=pod['id'], internal_ip=internal,
-                external_ip=endpoint.get('ip'),
-                ssh_port=endpoint.get('port', 22))],
-            status='running', tags={})
-        if name == head_name:
-            head_id = name
-    if head_id is None and instances:
-        head_id = sorted(instances)[0]
-    return common.ClusterInfo(
-        instances=instances, head_instance_id=head_id,
-        provider_name='runpod', provider_config=provider_config,
-        ssh_user='root',
-        ssh_private_key=provider_config.get('ssh_private_key'))
+def _host_info(pod: Dict[str, Any]) -> common.HostInfo:
+    endpoint = _ssh_endpoint(pod) or {}
+    internal = pod.get('internalIp') or endpoint.get('ip') or ''
+    return common.HostInfo(host_id=pod['id'], internal_ip=internal,
+                           external_ip=endpoint.get('ip'),
+                           ssh_port=endpoint.get('port', 22))
 
 
-def get_command_runners(cluster_info: common.ClusterInfo):
-    return common.ssh_command_runners(cluster_info, 'root')
+_SPEC = rest_driver.RestVmSpec(
+    provider='runpod',
+    adaptor=runpod_adaptor,
+    ssh_user='root',
+    list_instances=lambda client, ctx: _cluster_pods(client, ctx.cluster),
+    state=_status,
+    name_of=lambda pod: pod['name'],
+    create=_create,
+    host_info=_host_info,
+    terminate=lambda client, ctx, pod: client.request(
+        'DELETE', f'/pods/{pod["id"]}'),
+    stop=lambda client, ctx, pod: client.request(
+        'POST', f'/pods/{pod["id"]}/stop'),
+    resume=lambda client, ctx, pod: client.request(
+        'POST', f'/pods/{pod["id"]}/start'),
+)
+
+rest_driver.RestVmDriver(_SPEC).export(globals())

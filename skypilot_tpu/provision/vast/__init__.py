@@ -1,4 +1,4 @@
-"""Vast.ai provisioner — GPU market behind the uniform interface.
+"""Vast.ai provisioner — GPU market on the shared REST driver.
 
 Reference analog: sky/provision/vast/instance.py. Vast is an OFFER
 MARKET, not a fleet API: capacity is found by searching bundles
@@ -7,15 +7,12 @@ Placement therefore re-searches on every launch; a vanished offer is
 a CapacityError so the failover engine retries with the next one.
 Labels carry our deterministic `<cluster>-<i>` identity.
 """
-import logging
 import re
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.adaptors import vast as vast_adaptor
-from skypilot_tpu.provision import common
-
-logger = logging.getLogger(__name__)
+from skypilot_tpu.provision import common, rest_driver
 
 _STATE_MAP = {
     'created': 'pending',
@@ -34,9 +31,8 @@ def _state(inst: Dict[str, Any]) -> str:
     return _STATE_MAP.get(str(status).lower(), 'pending')
 
 
-def _cluster_instances(client, cluster_name_on_cloud: str
-                       ) -> List[Dict[str, Any]]:
-    pattern = re.compile(re.escape(cluster_name_on_cloud) + r'-\d+$')
+def _list(client, ctx: rest_driver.Ctx) -> List[Dict[str, Any]]:
+    pattern = re.compile(re.escape(ctx.cluster) + r'-\d+$')
     resp = client.request('GET', '/api/v0/instances/')
     return [i for i in resp.get('instances', [])
             if pattern.fullmatch(i.get('label') or '')]
@@ -73,142 +69,54 @@ def search_offers(client, gpu_name: str, gpu_count: int,
     return resp.get('offers', [])
 
 
-def run_instances(region: str, cluster_name_on_cloud: str,
-                  config: common.ProvisionConfig) -> common.ProvisionRecord:
-    client = vast_adaptor.client()
-    nc = {**config.provider_config, **config.node_config}
-    existing = {i['label']: i for i in _cluster_instances(
-        client, cluster_name_on_cloud)}
-    created: List[str] = []
-    resumed: List[str] = []
-    try:
-        for i in range(config.count):
-            name = f'{cluster_name_on_cloud}-{i}'
-            inst = existing.get(name)
-            state = _state(inst) if inst else None
-            if state in ('running', 'pending'):
-                continue
-            if state == 'stopped':
-                if not config.resume_stopped_nodes:
-                    raise exceptions.ProvisionError(
-                        f'Instance {name} is stopped; pass '
-                        'resume_stopped_nodes to restart it.')
-                client.request('PUT',
-                               f'/api/v0/instances/{inst["id"]}/',
-                               json_body={'state': 'running'})
-                resumed.append(name)
-                continue
-            common.refuse_unresumable(state, name)
-            offers = search_offers(
-                client, nc.get('gpu_type', ''),
-                int(nc.get('gpu_count', 1)),
-                region if region != 'any' else None)
-            if not offers:
-                raise exceptions.CapacityError(
-                    f'Vast: no rentable offers for '
-                    f'{nc.get("gpu_type")}:{nc.get("gpu_count")} '
-                    f'in {region}')
-            ask_id = offers[0]['id']
-            client.request('PUT', f'/api/v0/asks/{ask_id}/',
-                           json_body={
-                               'client_id': 'me',
-                               'image': nc.get('image_id') or
-                               'ubuntu:22.04',
-                               'label': name,
-                               # mkdir first: stock container images
-                               # ship without ~/.ssh.
-                               'onstart': ('mkdir -p ~/.ssh && echo "'
-                                           + common.require_public_key(
-                                               config
-                                               .authentication_config)
-                                           + '" >> ~/.ssh/authorized_keys'
-                                           ),
-                               'runtype': 'ssh',
-                               'disk': float(nc.get('disk_size', 64)),
-                           })
-            created.append(name)
-        _wait_running(client, cluster_name_on_cloud, config.count,
-                      timeout=float(config.provider_config.get(
-                          'provision_timeout', 900)))
-    except vast_adaptor.RestApiError as e:
-        raise vast_adaptor.classify_api_error(e) from e
-    return common.ProvisionRecord(
-        provider_name='vast', region=region, zone=None,
-        cluster_name_on_cloud=cluster_name_on_cloud,
-        head_instance_id=f'{cluster_name_on_cloud}-0',
-        created_instance_ids=created, resumed_instance_ids=resumed)
+def _create(client, ctx: rest_driver.Ctx, name: str) -> None:
+    """Accept the cheapest live offer for the GPU shape."""
+    nc = ctx.nc
+    offers = search_offers(
+        client, nc.get('gpu_type', ''), int(nc.get('gpu_count', 1)),
+        ctx.region if ctx.region != 'any' else None)
+    if not offers:
+        raise exceptions.CapacityError(
+            f'Vast: no rentable offers for '
+            f'{nc.get("gpu_type")}:{nc.get("gpu_count")} in '
+            f'{ctx.region}')
+    ask_id = offers[0]['id']
+    client.request('PUT', f'/api/v0/asks/{ask_id}/', json_body={
+        'client_id': 'me',
+        'image': nc.get('image_id') or 'ubuntu:22.04',
+        'label': name,
+        # mkdir first: stock container images ship without ~/.ssh.
+        'onstart': ('mkdir -p ~/.ssh && echo "'
+                    + common.require_public_key(
+                        ctx.config.authentication_config)
+                    + '" >> ~/.ssh/authorized_keys'),
+        'runtype': 'ssh',
+        'disk': float(nc.get('disk_size', 64)),
+    })
 
 
-def _wait_running(client, cluster_name_on_cloud: str, count: int,
-                  timeout: float = 900.0) -> None:
-    common.wait_until_running(
-        lambda: _cluster_instances(client, cluster_name_on_cloud),
-        count, _state, lambda i: i['label'], timeout=timeout)
+_SPEC = rest_driver.RestVmSpec(
+    provider='vast',
+    adaptor=vast_adaptor,
+    ssh_user='root',
+    list_instances=_list,
+    state=_state,
+    name_of=lambda inst: inst['label'],
+    create=_create,
+    host_info=lambda inst: common.HostInfo(
+        host_id=str(inst['id']),
+        internal_ip=inst.get('public_ipaddr', ''),
+        external_ip=inst.get('public_ipaddr'),
+        ssh_port=int(inst.get('ssh_port') or 22)),
+    terminate=lambda client, ctx, inst: client.request(
+        'DELETE', f'/api/v0/instances/{inst["id"]}/'),
+    terminate_terminated=True,
+    stop=lambda client, ctx, inst: client.request(
+        'PUT', f'/api/v0/instances/{inst["id"]}/',
+        json_body={'state': 'stopped'}),
+    resume=lambda client, ctx, inst: client.request(
+        'PUT', f'/api/v0/instances/{inst["id"]}/',
+        json_body={'state': 'running'}),
+)
 
-
-def wait_instances(region: str, cluster_name_on_cloud: str,
-                   state: Optional[str] = None) -> None:
-    del region, cluster_name_on_cloud, state  # run_instances waits
-
-
-def stop_instances(cluster_name_on_cloud: str,
-                   provider_config: Dict[str, Any]) -> None:
-    client = vast_adaptor.client()
-    for inst in _cluster_instances(client, cluster_name_on_cloud):
-        if _state(inst) == 'running':
-            client.request('PUT', f'/api/v0/instances/{inst["id"]}/',
-                           json_body={'state': 'stopped'})
-
-
-def terminate_instances(cluster_name_on_cloud: str,
-                        provider_config: Dict[str, Any]) -> None:
-    client = vast_adaptor.client()
-    for inst in _cluster_instances(client, cluster_name_on_cloud):
-        client.request('DELETE', f'/api/v0/instances/{inst["id"]}/')
-
-
-def query_instances(cluster_name_on_cloud: str,
-                    provider_config: Dict[str, Any]
-                    ) -> Dict[str, Optional[str]]:
-    client = vast_adaptor.client()
-    out: Dict[str, Optional[str]] = {}
-    for inst in _cluster_instances(client, cluster_name_on_cloud):
-        state = _state(inst)
-        if state == 'terminated':
-            continue
-        out[inst['label']] = state
-    return out
-
-
-def get_cluster_info(region: str, cluster_name_on_cloud: str,
-                     provider_config: Dict[str, Any]) -> common.ClusterInfo:
-    del region
-    client = vast_adaptor.client()
-    instances: Dict[str, common.InstanceInfo] = {}
-    head_name = f'{cluster_name_on_cloud}-0'
-    head_id: Optional[str] = None
-    for inst in _cluster_instances(client, cluster_name_on_cloud):
-        if _state(inst) != 'running':
-            continue
-        name = inst['label']
-        instances[name] = common.InstanceInfo(
-            instance_id=name,
-            hosts=[common.HostInfo(
-                host_id=str(inst['id']),
-                internal_ip=inst.get('public_ipaddr', ''),
-                external_ip=inst.get('public_ipaddr'),
-                ssh_port=int(inst.get('ssh_port') or 22))],
-            status='running', tags={})
-        if name == head_name:
-            head_id = name
-    if head_id is None and instances:
-        head_id = sorted(instances)[0]
-    return common.ClusterInfo(
-        instances=instances, head_instance_id=head_id,
-        provider_name='vast', provider_config=provider_config,
-        ssh_user='root',
-        ssh_private_key=provider_config.get('ssh_private_key'))
-
-
-def get_command_runners(cluster_info: common.ClusterInfo):
-    return common.ssh_command_runners(cluster_info, 'root')
+rest_driver.RestVmDriver(_SPEC).export(globals())
