@@ -1,0 +1,50 @@
+"""Hyperbolic adaptor: bearer-token marketplace REST API.
+
+Reference analog: sky/provision/hyperbolic/utils.py (requests against
+api.hyperbolic.xyz). Credential: HYPERBOLIC_API_KEY env var or
+~/.hyperbolic/api_key (bare token file).
+"""
+from typing import Dict, Optional
+
+from skypilot_tpu.adaptors import rest
+
+API_ENDPOINT = 'https://api.hyperbolic.xyz'
+CREDENTIALS_PATH = '~/.hyperbolic/api_key'
+
+RestApiError = rest.RestApiError
+
+
+def get_api_key() -> Optional[str]:
+    return rest.env_or_file_credential('HYPERBOLIC_API_KEY',
+                                       CREDENTIALS_PATH)
+
+
+def _make_client() -> rest.RestClient:
+    def _headers() -> Dict[str, str]:
+        key = get_api_key()
+        if not key:
+            from skypilot_tpu import exceptions
+            raise exceptions.ProvisionError(
+                'Hyperbolic API key not found; set HYPERBOLIC_API_KEY '
+                f'or create {CREDENTIALS_PATH}.')
+        return {'Authorization': f'Bearer {key}'}
+
+    return rest.RestClient(
+        API_ENDPOINT, _headers,
+        error_code_fn=lambda payload: payload.get('error_code', ''))
+
+
+_slot = rest.ClientSlot(_make_client)
+client = _slot.get
+set_client_factory = _slot.set_factory
+
+
+def classify_api_error(err: RestApiError):
+    from skypilot_tpu import exceptions
+    text = str(err).lower()
+    if ('no machines available' in text or 'out of capacity' in text
+            or 'insufficient' in text or err.status == 503):
+        return exceptions.CapacityError(str(err))
+    if 'quota' in text or 'limit' in text:
+        return exceptions.QuotaExceededError(str(err))
+    return err

@@ -1,0 +1,164 @@
+"""OCI adaptor: request-signed core-services REST API.
+
+Reference analog: sky/adaptors/oci.py (oci SDK). The SDK's transport
+is the signed REST API at iaas.{region}.oraclecloud.com; we sign
+requests directly (draft-cavage HTTP signatures, RSA-SHA256 over
+(request-target)/date/host, plus content headers on writes) with the
+`cryptography` package, from the standard ~/.oci/config profile
+(user/fingerprint/tenancy/region/key_file).
+"""
+import base64
+import configparser
+import datetime
+import email.utils
+import hashlib
+import json
+import os
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, Optional
+
+from skypilot_tpu.adaptors import rest
+
+CONFIG_PATH = '~/.oci/config'
+API_VERSION = '20160918'
+
+RestApiError = rest.RestApiError
+
+
+def load_config(profile: str = 'DEFAULT') -> Optional[Dict[str, str]]:
+    """The ~/.oci/config profile as a dict, or None if unusable."""
+    path = os.path.expanduser(os.environ.get('OCI_CONFIG_PATH',
+                                             CONFIG_PATH))
+    if not os.path.isfile(path):
+        return None
+    parser = configparser.ConfigParser()
+    try:
+        parser.read(path)
+    except configparser.Error:
+        return None
+    section = dict(parser.defaults())
+    if parser.has_section(profile):
+        section.update(parser.items(profile))
+    required = ('user', 'fingerprint', 'tenancy', 'region', 'key_file')
+    if not all(section.get(k) for k in required):
+        return None
+    return section
+
+
+def default_compartment_id() -> Optional[str]:
+    cfg = load_config()
+    return os.environ.get('OCI_COMPARTMENT_ID') or (
+        cfg.get('tenancy') if cfg else None)
+
+
+class OciSigner:
+    """draft-cavage HTTP signature over OCI's required header set."""
+
+    def __init__(self, config: Dict[str, str]):
+        from cryptography.hazmat.primitives import serialization
+        self._key_id = (f'{config["tenancy"]}/{config["user"]}/'
+                        f'{config["fingerprint"]}')
+        key_path = os.path.expanduser(config['key_file'])
+        with open(key_path, 'rb') as f:
+            self._key = serialization.load_pem_private_key(
+                f.read(), password=None)
+
+    def sign_headers(self, method: str, url: str,
+                     body: Optional[bytes]) -> Dict[str, str]:
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric import padding
+        parsed = urllib.parse.urlsplit(url)
+        target = parsed.path + (f'?{parsed.query}' if parsed.query
+                                else '')
+        date = email.utils.formatdate(usegmt=True)
+        headers = {'date': date, 'host': parsed.netloc}
+        to_sign = ['(request-target)', 'date', 'host']
+        lines = [f'(request-target): {method.lower()} {target}',
+                 f'date: {date}', f'host: {parsed.netloc}']
+        if method.upper() in ('POST', 'PUT', 'PATCH'):
+            body = body or b''
+            sha = base64.b64encode(
+                hashlib.sha256(body).digest()).decode()
+            headers['x-content-sha256'] = sha
+            headers['content-type'] = 'application/json'
+            headers['content-length'] = str(len(body))
+            to_sign += ['x-content-sha256', 'content-type',
+                        'content-length']
+            lines += [f'x-content-sha256: {sha}',
+                      'content-type: application/json',
+                      f'content-length: {len(body)}']
+        signature = base64.b64encode(self._key.sign(
+            '\n'.join(lines).encode(), padding.PKCS1v15(),
+            hashes.SHA256())).decode()
+        headers['authorization'] = (
+            'Signature version="1",'
+            f'keyId="{self._key_id}",'
+            'algorithm="rsa-sha256",'
+            f'headers="{" ".join(to_sign)}",'
+            f'signature="{signature}"')
+        return headers
+
+
+class OciClient:
+    """Signed JSON client for the core-services API (region from the
+    profile; paths are rooted at /<API_VERSION>)."""
+
+    def __init__(self) -> None:
+        config = load_config()
+        if config is None:
+            from skypilot_tpu import exceptions
+            raise exceptions.ProvisionError(
+                f'OCI config not found/incomplete at {CONFIG_PATH} '
+                '(need user/fingerprint/tenancy/region/key_file).')
+        self._config = config
+        self._signer = OciSigner(config)
+        self._base = (f'https://iaas.{config["region"]}.oraclecloud.com'
+                      f'/{API_VERSION}')
+
+    def request(self, method: str, path: str,
+                params: Optional[Dict[str, str]] = None,
+                json_body: Optional[Any] = None) -> Any:
+        url = f'{self._base}{path}'
+        if params:
+            url += f'?{urllib.parse.urlencode(params)}'
+        body = (json.dumps(json_body).encode()
+                if json_body is not None else None)
+        headers = self._signer.sign_headers(method, url, body)
+        req = urllib.request.Request(url, data=body, headers=headers,
+                                     method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                payload = resp.read()
+        except urllib.error.HTTPError as e:
+            text = e.read().decode(errors='replace')
+            code = ''
+            try:
+                code = json.loads(text).get('code', '')
+            except (json.JSONDecodeError, AttributeError):
+                pass
+            raise RestApiError(f'{method} {path}: HTTP {e.code}: '
+                               f'{text[:500]}', code=code,
+                               status=e.code) from e
+        except urllib.error.URLError as e:
+            raise RestApiError(f'{method} {path}: {e.reason}') from e
+        return json.loads(payload) if payload else {}
+
+
+_slot = rest.ClientSlot(OciClient)
+client = _slot.get
+set_client_factory = _slot.set_factory
+
+
+def classify_api_error(err: RestApiError):
+    from skypilot_tpu import exceptions
+    code = getattr(err, 'code', '')
+    text = str(err).lower()
+    if code in ('OutOfHostCapacity', 'InternalError') and \
+            'capacity' in text or 'out of host capacity' in text:
+        return exceptions.CapacityError(str(err))
+    if code in ('LimitExceeded', 'QuotaExceeded') or 'quota' in text:
+        return exceptions.QuotaExceededError(str(err))
+    if err.status == 429:
+        return exceptions.CapacityError(str(err))
+    return err

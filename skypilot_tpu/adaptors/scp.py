@@ -1,0 +1,100 @@
+"""SCP (Samsung Cloud Platform) adaptor: HMAC-signed open API.
+
+Reference analog: sky/adaptors/scp.py + sky/provision/scp/instance.py
+(requests with AccessKey/Secret HMAC headers). Credential:
+SCP_ACCESS_KEY/SCP_SECRET_KEY/SCP_PROJECT_ID env vars or
+~/.scp/scp_credential (`access_key = ...` lines, the reference's drop
+location). Every request carries the signed header set
+(X-Cmp-AccessKey, X-Cmp-Timestamp, X-Cmp-Signature over
+method+url+timestamp+access key+project id).
+"""
+import base64
+import hashlib
+import hmac
+import os
+import time
+import urllib.parse
+from typing import Any, Dict, Optional
+
+from skypilot_tpu.adaptors import rest
+
+API_ENDPOINT = 'https://openapi.samsungsdscloud.com'
+CREDENTIALS_PATH = '~/.scp/scp_credential'
+
+RestApiError = rest.RestApiError
+
+
+def _credential(env: str, keys: tuple) -> Optional[str]:
+    return rest.env_or_file_credential(env, CREDENTIALS_PATH,
+                                       line_keys=keys, sep='=')
+
+
+def get_access_key() -> Optional[str]:
+    return _credential('SCP_ACCESS_KEY', ('access_key',))
+
+
+def get_secret_key() -> Optional[str]:
+    return _credential('SCP_SECRET_KEY', ('secret_key',))
+
+
+def get_project_id() -> Optional[str]:
+    return _credential('SCP_PROJECT_ID', ('project_id',))
+
+
+class ScpClient:
+    """Signed JSON client (signature = HMAC-SHA256 of
+    method+url+timestamp+access_key+project_id, base64)."""
+
+    def __init__(self) -> None:
+        self._access = get_access_key()
+        self._secret = get_secret_key()
+        self._project = get_project_id()
+        if not (self._access and self._secret and self._project):
+            from skypilot_tpu import exceptions
+            raise exceptions.ProvisionError(
+                'SCP credentials not found; set SCP_ACCESS_KEY/'
+                'SCP_SECRET_KEY/SCP_PROJECT_ID or create '
+                f'{CREDENTIALS_PATH}.')
+
+    def request(self, method: str, path: str,
+                params: Optional[Dict[str, str]] = None,
+                json_body: Optional[Any] = None) -> Any:
+        url = f'{API_ENDPOINT}{path}'
+        if params:
+            url += f'?{urllib.parse.urlencode(params)}'
+        timestamp = str(int(time.time() * 1000))
+        message = (method.upper() + url + timestamp + self._access +
+                   self._project)
+        signature = base64.b64encode(
+            hmac.new(self._secret.encode(), message.encode(),
+                     hashlib.sha256).digest()).decode()
+
+        def _headers() -> Dict[str, str]:
+            return {
+                'X-Cmp-AccessKey': self._access,
+                'X-Cmp-Timestamp': timestamp,
+                'X-Cmp-Signature': signature,
+                'X-Cmp-ProjectId': self._project,
+            }
+
+        inner = rest.RestClient(
+            API_ENDPOINT, _headers,
+            error_code_fn=lambda payload: payload.get('errorCode', ''))
+        return inner.request(method, path, params=params,
+                             json_body=json_body)
+
+
+_slot = rest.ClientSlot(ScpClient)
+client = _slot.get
+set_client_factory = _slot.set_factory
+
+
+def classify_api_error(err: RestApiError):
+    from skypilot_tpu import exceptions
+    text = str(err).lower()
+    if ('not enough' in text or 'capacity' in text or 'sold out' in text
+            or err.status == 503):
+        return exceptions.CapacityError(str(err))
+    if 'quota' in text or 'limit exceeded' in text:
+        return exceptions.QuotaExceededError(str(err))
+    return err

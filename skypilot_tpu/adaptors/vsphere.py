@@ -1,0 +1,119 @@
+"""vSphere adaptor: vCenter Automation (REST) API with session auth.
+
+Reference analog: sky/adaptors/vsphere.py + sky/provision/vsphere/
+(pyvmomi + the vCenter REST SDK). The Automation API is plain JSON:
+POST /api/session with basic auth yields a token sent as
+`vmware-api-session-id` on every call. Credentials/endpoint:
+VSPHERE_SERVER / VSPHERE_USERNAME / VSPHERE_PASSWORD env vars or
+~/.vsphere/credentials.yaml (`server:`/`username:`/`password:` lines).
+"""
+import base64
+import json
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, Optional
+
+from skypilot_tpu.adaptors import rest
+
+CREDENTIALS_PATH = '~/.vsphere/credentials.yaml'
+
+RestApiError = rest.RestApiError
+
+
+def _credential(env: str, keys: tuple) -> Optional[str]:
+    return rest.env_or_file_credential(env, CREDENTIALS_PATH,
+                                       line_keys=keys, sep=':')
+
+
+def get_server() -> Optional[str]:
+    return _credential('VSPHERE_SERVER', ('server', 'host'))
+
+
+def get_username() -> Optional[str]:
+    return _credential('VSPHERE_USERNAME', ('username', 'user'))
+
+
+def get_password() -> Optional[str]:
+    return _credential('VSPHERE_PASSWORD', ('password',))
+
+
+class VsphereClient:
+    """Session-token JSON client against one vCenter."""
+
+    def __init__(self) -> None:
+        server = get_server()
+        user = get_username()
+        password = get_password()
+        if not (server and user and password):
+            from skypilot_tpu import exceptions
+            raise exceptions.ProvisionError(
+                'vSphere credentials not found; set VSPHERE_SERVER/'
+                'VSPHERE_USERNAME/VSPHERE_PASSWORD or create '
+                f'{CREDENTIALS_PATH}.')
+        self._base = f'https://{server}'
+        self._user = user
+        self._password = password
+        self._session: Optional[str] = None
+        self._lock = threading.Lock()
+
+    def _session_token(self, refresh: bool = False) -> str:
+        with self._lock:
+            if self._session and not refresh:
+                return self._session
+            basic = base64.b64encode(
+                f'{self._user}:{self._password}'.encode()).decode()
+            req = urllib.request.Request(
+                f'{self._base}/api/session', method='POST',
+                headers={'Authorization': f'Basic {basic}'})
+            try:
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    token = json.loads(resp.read())
+            except Exception as e:  # noqa: BLE001
+                raise RestApiError(f'vSphere session: {e}') from e
+            self._session = token
+            return token
+
+    def request(self, method: str, path: str,
+                params: Optional[Dict[str, str]] = None,
+                json_body: Optional[Any] = None) -> Any:
+        url = f'{self._base}{path}'
+        if params:
+            url += f'?{urllib.parse.urlencode(params)}'
+        body = (json.dumps(json_body).encode()
+                if json_body is not None else None)
+        for attempt in range(2):
+            headers = {
+                'vmware-api-session-id':
+                    self._session_token(refresh=attempt > 0),
+                'Content-Type': 'application/json',
+            }
+            req = urllib.request.Request(url, data=body,
+                                         headers=headers, method=method)
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    payload = resp.read()
+                return json.loads(payload) if payload else {}
+            except urllib.error.HTTPError as e:
+                if e.code == 401 and attempt == 0:
+                    continue  # session expired: re-auth once
+                text = e.read().decode(errors='replace')
+                raise RestApiError(f'{method} {path}: HTTP {e.code}: '
+                                   f'{text[:500]}', status=e.code) from e
+            except urllib.error.URLError as e:
+                raise RestApiError(f'{method} {path}: {e.reason}') from e
+
+
+_slot = rest.ClientSlot(VsphereClient)
+client = _slot.get
+set_client_factory = _slot.set_factory
+
+
+def classify_api_error(err: RestApiError):
+    from skypilot_tpu import exceptions
+    text = str(err).lower()
+    if ('insufficient' in text or 'no hosts' in text
+            or 'resource' in text and 'unavailable' in text):
+        return exceptions.CapacityError(str(err))
+    return err

@@ -20,6 +20,11 @@ _CATALOG_MODULES = {
     'vast': 'skypilot_tpu.catalog.vast_catalog',
     'cudo': 'skypilot_tpu.catalog.cudo_catalog',
     'paperspace': 'skypilot_tpu.catalog.paperspace_catalog',
+    'oci': 'skypilot_tpu.catalog.oci_catalog',
+    'ibm': 'skypilot_tpu.catalog.ibm_catalog',
+    'scp': 'skypilot_tpu.catalog.scp_catalog',
+    'vsphere': 'skypilot_tpu.catalog.vsphere_catalog',
+    'hyperbolic': 'skypilot_tpu.catalog.hyperbolic_catalog',
     'local': 'skypilot_tpu.catalog.local_catalog',
     'kubernetes': 'skypilot_tpu.catalog.kubernetes_catalog',
 }

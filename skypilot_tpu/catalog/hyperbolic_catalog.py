@@ -1,0 +1,9 @@
+"""Hyperbolic catalog: marketplace GPU shapes from the shipped CSV
+(indicative floor prices — the live market decides).
+
+Reference analog: sky/catalog/hyperbolic_catalog.py.
+"""
+from skypilot_tpu.catalog import common
+
+list_accelerators, get_feasible, validate_region_zone = \
+    common.make_vm_catalog('hyperbolic', zones_modeled=False)

@@ -1,0 +1,8 @@
+"""IBM VPC catalog: Gen-2 profiles from the shipped CSV.
+
+Reference analog: sky/catalog/ibm_catalog.py.
+"""
+from skypilot_tpu.catalog import common
+
+list_accelerators, get_feasible, validate_region_zone = \
+    common.make_vm_catalog('ibm', zones_modeled=True)

@@ -1,0 +1,8 @@
+"""OCI catalog: compute shapes from the shipped CSV.
+
+Reference analog: sky/catalog/oci_catalog.py.
+"""
+from skypilot_tpu.catalog import common
+
+list_accelerators, get_feasible, validate_region_zone = \
+    common.make_vm_catalog('oci', zones_modeled=True)

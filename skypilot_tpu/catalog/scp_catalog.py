@@ -1,0 +1,8 @@
+"""SCP catalog: server types from the shipped CSV.
+
+Reference analog: sky/catalog/scp_catalog.py.
+"""
+from skypilot_tpu.catalog import common
+
+list_accelerators, get_feasible, validate_region_zone = \
+    common.make_vm_catalog('scp', zones_modeled=False)

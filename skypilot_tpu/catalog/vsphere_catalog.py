@@ -1,0 +1,10 @@
+"""vSphere catalog: synthetic cpu/mem profiles from the shipped CSV
+(an on-prem vCenter has no price list; costs are configured
+estimates so the optimizer can still rank).
+
+Reference analog: sky/catalog/vsphere_catalog.py.
+"""
+from skypilot_tpu.catalog import common
+
+list_accelerators, get_feasible, validate_region_zone = \
+    common.make_vm_catalog('vsphere', zones_modeled=False)

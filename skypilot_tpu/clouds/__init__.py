@@ -7,12 +7,17 @@ from skypilot_tpu.clouds import do as _do  # noqa: F401 (registers)
 from skypilot_tpu.clouds import fluidstack as _fluidstack  # noqa: F401
 from skypilot_tpu.clouds import paperspace as _paperspace  # noqa: F401
 from skypilot_tpu.clouds import gcp as _gcp  # noqa: F401 (registers)
+from skypilot_tpu.clouds import hyperbolic as _hyperbolic  # noqa: F401
+from skypilot_tpu.clouds import ibm as _ibm  # noqa: F401 (registers)
 from skypilot_tpu.clouds import lambda_cloud as _lambda  # noqa: F401
 from skypilot_tpu.clouds import local as _local  # noqa: F401 (registers)
 from skypilot_tpu.clouds import nebius as _nebius  # noqa: F401
+from skypilot_tpu.clouds import oci as _oci  # noqa: F401 (registers)
 from skypilot_tpu.clouds import runpod as _runpod  # noqa: F401
+from skypilot_tpu.clouds import scp as _scp  # noqa: F401 (registers)
 from skypilot_tpu.clouds import ssh as _ssh  # noqa: F401 (registers)
 from skypilot_tpu.clouds import vast as _vast  # noqa: F401 (registers)
+from skypilot_tpu.clouds import vsphere as _vsphere  # noqa: F401
 from skypilot_tpu.utils.registry import CLOUD_REGISTRY
 
 AWS = _aws.AWS
@@ -22,12 +27,17 @@ DigitalOcean = _do.DigitalOcean
 Fluidstack = _fluidstack.Fluidstack
 Paperspace = _paperspace.Paperspace
 GCP = _gcp.GCP
+Hyperbolic = _hyperbolic.Hyperbolic
+IBM = _ibm.IBM
 LambdaCloud = _lambda.LambdaCloud
 Local = _local.Local
 Nebius = _nebius.Nebius
+OCI = _oci.OCI
 RunPod = _runpod.RunPod
+SCP = _scp.SCP
 SSH = _ssh.SSHCloud
 Vast = _vast.Vast
+Vsphere = _vsphere.Vsphere
 
 try:  # kubernetes is optional until round 2+
     from skypilot_tpu.clouds import kubernetes as _k8s  # noqa: F401
@@ -41,6 +51,7 @@ def get_cloud(name: str) -> Cloud:
 
 
 __all__ = ['Cloud', 'CloudCapability', 'AWS', 'Azure', 'Cudo',
-           'DigitalOcean', 'Fluidstack', 'GCP', 'LambdaCloud', 'Local',
-           'Nebius', 'Paperspace', 'RunPod', 'SSH', 'Vast',
+           'DigitalOcean', 'Fluidstack', 'GCP', 'Hyperbolic', 'IBM',
+           'LambdaCloud', 'Local', 'Nebius', 'OCI', 'Paperspace',
+           'RunPod', 'SCP', 'SSH', 'Vast', 'Vsphere',
            'get_cloud', 'CLOUD_REGISTRY']

@@ -1,0 +1,61 @@
+"""Hyperbolic policy — GPU marketplace, terminate-only.
+
+Reference analog: sky/clouds/hyperbolic.py (276 LoC). Catalog
+instance types are `<count>x_<GPU>` (RunPod convention); the
+provisioner asks the market for the cheapest matching machine, so
+catalog prices are indicative floors.
+"""
+from typing import Dict, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.clouds import runpod as runpod_cloud
+from skypilot_tpu.utils import registry
+
+
+@registry.CLOUD_REGISTRY.register(name='hyperbolic')
+class Hyperbolic(cloud.Cloud):
+    NAME = 'hyperbolic'
+    # Terminate-only market: no stop, so autostop must tear down.
+    CAPABILITIES = frozenset({
+        cloud.CloudCapability.CUSTOM_IMAGE,
+    })
+    MAX_CLUSTER_NAME_LENGTH = 56
+
+    def provision_module(self) -> str:
+        return 'skypilot_tpu.provision.hyperbolic'
+
+    def make_deploy_variables(self, resources, cluster_name_on_cloud: str,
+                              region: str, zone: Optional[str]
+                              ) -> Dict[str, object]:
+        resources.assert_launchable()
+        auth = self.authentication_config()
+        gpu_type, gpu_count = runpod_cloud.split_instance_type(
+            resources.instance_type)
+        variables: Dict[str, object] = {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region,
+            'zone': None,
+            'instance_type': resources.instance_type,
+            'gpu_type': gpu_type,
+            'gpu_count': gpu_count,
+            'use_spot': False,
+            'disk_size': resources.disk_size,
+            'ssh_user': 'ubuntu',
+            'ssh_private_key': auth.get('ssh_private_key'),
+            'num_nodes': None,  # filled by the provisioner
+        }
+        if resources.image_id:
+            variables['image_id'] = resources.image_id
+        return variables
+
+    def authentication_config(self) -> Dict[str, object]:
+        from skypilot_tpu import authentication
+        return authentication.authentication_config()
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.adaptors import hyperbolic as adaptor
+        if adaptor.get_api_key():
+            return True, None
+        return False, ('Hyperbolic API key not found. Set '
+                       'HYPERBOLIC_API_KEY or create '
+                       f'{adaptor.CREDENTIALS_PATH}.')

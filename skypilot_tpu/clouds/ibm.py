@@ -1,0 +1,64 @@
+"""IBM Cloud policy — Gen-2 VPC instances with stop/start.
+
+Reference analog: sky/clouds/ibm.py (517 LoC over ibm_vpc). Profiles
+(e.g. gx2-8x64x1v100) are catalog rows; vpc/subnet come from config.
+"""
+from typing import Dict, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.utils import registry
+
+
+@registry.CLOUD_REGISTRY.register(name='ibm')
+class IBM(cloud.Cloud):
+    NAME = 'ibm'
+    CAPABILITIES = frozenset({
+        cloud.CloudCapability.MULTI_NODE,
+        cloud.CloudCapability.STOP,
+        cloud.CloudCapability.AUTOSTOP,
+        cloud.CloudCapability.CUSTOM_IMAGE,
+        cloud.CloudCapability.STORAGE_MOUNT,
+        cloud.CloudCapability.HOST_CONTROLLERS,
+    })
+    MAX_CLUSTER_NAME_LENGTH = 56
+
+    def provision_module(self) -> str:
+        return 'skypilot_tpu.provision.ibm'
+
+    def make_deploy_variables(self, resources, cluster_name_on_cloud: str,
+                              region: str, zone: Optional[str]
+                              ) -> Dict[str, object]:
+        resources.assert_launchable()
+        from skypilot_tpu import config as config_lib
+        auth = self.authentication_config()
+        variables: Dict[str, object] = {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region,
+            'zone': zone,
+            'instance_type': resources.instance_type,
+            'use_spot': False,  # VPC has no spot market
+            'disk_size': resources.disk_size,
+            'vpc_id': config_lib.get_nested(('ibm', 'vpc_id'),
+                                            default=''),
+            'subnet_id': config_lib.get_nested(('ibm', 'subnet_id'),
+                                               default=''),
+            'default_image_id': config_lib.get_nested(
+                ('ibm', 'image_id'), default=''),
+            'ssh_user': 'ubuntu',
+            'ssh_private_key': auth.get('ssh_private_key'),
+            'num_nodes': None,  # filled by the provisioner
+        }
+        if resources.image_id:
+            variables['image_id'] = resources.image_id
+        return variables
+
+    def authentication_config(self) -> Dict[str, object]:
+        from skypilot_tpu import authentication
+        return authentication.authentication_config()
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.adaptors import ibm as adaptor
+        if adaptor.get_api_key():
+            return True, None
+        return False, ('IBM API key not found. Set IBM_API_KEY or '
+                       f'create {adaptor.CREDENTIALS_PATH}.')

@@ -1,0 +1,66 @@
+"""OCI cloud policy — compartment-scoped compute with stop/start.
+
+Reference analog: sky/clouds/oci.py (655 LoC over the oci SDK).
+Shapes are catalog rows (VM.Standard / VM.GPU / BM.GPU); the
+availability domain rides the zone column.
+"""
+from typing import Dict, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.utils import registry
+
+
+@registry.CLOUD_REGISTRY.register(name='oci')
+class OCI(cloud.Cloud):
+    NAME = 'oci'
+    CAPABILITIES = frozenset({
+        cloud.CloudCapability.MULTI_NODE,
+        cloud.CloudCapability.STOP,
+        cloud.CloudCapability.AUTOSTOP,
+        cloud.CloudCapability.CUSTOM_IMAGE,
+        cloud.CloudCapability.STORAGE_MOUNT,
+        cloud.CloudCapability.HOST_CONTROLLERS,
+    })
+    MAX_CLUSTER_NAME_LENGTH = 56
+
+    def provision_module(self) -> str:
+        return 'skypilot_tpu.provision.oci'
+
+    def make_deploy_variables(self, resources, cluster_name_on_cloud: str,
+                              region: str, zone: Optional[str]
+                              ) -> Dict[str, object]:
+        resources.assert_launchable()
+        from skypilot_tpu import config as config_lib
+        auth = self.authentication_config()
+        variables: Dict[str, object] = {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region,
+            'zone': zone,
+            'availability_domain': zone,
+            'instance_type': resources.instance_type,
+            'use_spot': False,  # preemptible shapes not modeled yet
+            'disk_size': resources.disk_size,
+            'compartment_id': config_lib.get_nested(
+                ('oci', 'compartment_id')),
+            'subnet_id': config_lib.get_nested(('oci', 'subnet_id'),
+                                               default=''),
+            'default_image_id': config_lib.get_nested(
+                ('oci', 'image_id'), default=''),
+            'ssh_user': 'ubuntu',
+            'ssh_private_key': auth.get('ssh_private_key'),
+            'num_nodes': None,  # filled by the provisioner
+        }
+        if resources.image_id:
+            variables['image_id'] = resources.image_id
+        return variables
+
+    def authentication_config(self) -> Dict[str, object]:
+        from skypilot_tpu import authentication
+        return authentication.authentication_config()
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.adaptors import oci as adaptor
+        if adaptor.load_config() is not None:
+            return True, None
+        return False, ('OCI config not found. Create ~/.oci/config '
+                       'with user/fingerprint/tenancy/region/key_file.')

@@ -1,0 +1,60 @@
+"""SCP (Samsung Cloud Platform) policy — signed open-API VMs.
+
+Reference analog: sky/clouds/scp.py (379 LoC). Server types are
+catalog rows; the service zone is the region.
+"""
+from typing import Dict, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.utils import registry
+
+
+@registry.CLOUD_REGISTRY.register(name='scp')
+class SCP(cloud.Cloud):
+    NAME = 'scp'
+    CAPABILITIES = frozenset({
+        cloud.CloudCapability.STOP,
+        cloud.CloudCapability.AUTOSTOP,
+        cloud.CloudCapability.CUSTOM_IMAGE,
+    })
+    # SCP rejects long resource names (reference caps at 40).
+    MAX_CLUSTER_NAME_LENGTH = 40
+
+    def provision_module(self) -> str:
+        return 'skypilot_tpu.provision.scp'
+
+    def make_deploy_variables(self, resources, cluster_name_on_cloud: str,
+                              region: str, zone: Optional[str]
+                              ) -> Dict[str, object]:
+        resources.assert_launchable()
+        from skypilot_tpu import config as config_lib
+        auth = self.authentication_config()
+        variables: Dict[str, object] = {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region,
+            'zone': None,
+            'instance_type': resources.instance_type,
+            'use_spot': False,
+            'disk_size': resources.disk_size,
+            'default_image_id': config_lib.get_nested(
+                ('scp', 'image_id'), default=''),
+            'ssh_user': 'root',
+            'ssh_private_key': auth.get('ssh_private_key'),
+            'num_nodes': None,  # filled by the provisioner
+        }
+        if resources.image_id:
+            variables['image_id'] = resources.image_id
+        return variables
+
+    def authentication_config(self) -> Dict[str, object]:
+        from skypilot_tpu import authentication
+        return authentication.authentication_config()
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.adaptors import scp as adaptor
+        if (adaptor.get_access_key() and adaptor.get_secret_key()
+                and adaptor.get_project_id()):
+            return True, None
+        return False, ('SCP credentials not found. Set SCP_ACCESS_KEY/'
+                       'SCP_SECRET_KEY/SCP_PROJECT_ID or create '
+                       f'{adaptor.CREDENTIALS_PATH}.')

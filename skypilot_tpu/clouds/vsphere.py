@@ -1,0 +1,72 @@
+"""vSphere policy — on-prem vCenter clusters behind the cloud
+interface.
+
+Reference analog: sky/clouds/vsphere.py (331 LoC). Instance types are
+synthetic cpu/memory profiles (`cpu<N>-mem<M>`) from the catalog — an
+on-prem vCenter has no price list, so costs are configured estimates;
+VMs clone from a template (image_id).
+"""
+from typing import Dict, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.utils import registry
+
+
+@registry.CLOUD_REGISTRY.register(name='vsphere')
+class Vsphere(cloud.Cloud):
+    NAME = 'vsphere'
+    CAPABILITIES = frozenset({
+        cloud.CloudCapability.MULTI_NODE,
+        cloud.CloudCapability.STOP,
+        cloud.CloudCapability.AUTOSTOP,
+        cloud.CloudCapability.CUSTOM_IMAGE,
+    })
+    # vCenter display names cap at 80; keep margin for -<i> suffixes.
+    MAX_CLUSTER_NAME_LENGTH = 56
+
+    def provision_module(self) -> str:
+        return 'skypilot_tpu.provision.vsphere'
+
+    def make_deploy_variables(self, resources, cluster_name_on_cloud: str,
+                              region: str, zone: Optional[str]
+                              ) -> Dict[str, object]:
+        resources.assert_launchable()
+        from skypilot_tpu import config as config_lib
+        auth = self.authentication_config()
+        variables: Dict[str, object] = {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region,
+            'zone': None,
+            'instance_type': resources.instance_type,
+            'use_spot': False,
+            'disk_size': resources.disk_size,
+            'template': config_lib.get_nested(('vsphere', 'template'),
+                                              default=''),
+            'resource_pool': config_lib.get_nested(
+                ('vsphere', 'resource_pool'), default=''),
+            'datastore': config_lib.get_nested(('vsphere', 'datastore'),
+                                               default=''),
+            'customization_spec': config_lib.get_nested(
+                ('vsphere', 'customization_spec'), default=''),
+            'ssh_user': config_lib.get_nested(('vsphere', 'ssh_user'),
+                                              default='ubuntu'),
+            'ssh_private_key': auth.get('ssh_private_key'),
+            'num_nodes': None,  # filled by the provisioner
+        }
+        if resources.image_id:
+            variables['image_id'] = resources.image_id
+        return variables
+
+    def authentication_config(self) -> Dict[str, object]:
+        from skypilot_tpu import authentication
+        return authentication.authentication_config()
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.adaptors import vsphere as adaptor
+        if (adaptor.get_server() and adaptor.get_username()
+                and adaptor.get_password()):
+            return True, None
+        return False, ('vSphere credentials not found. Set '
+                       'VSPHERE_SERVER/VSPHERE_USERNAME/'
+                       'VSPHERE_PASSWORD or create '
+                       f'{adaptor.CREDENTIALS_PATH}.')

@@ -1,0 +1,83 @@
+"""Hyperbolic provisioner — GPU marketplace on the shared REST driver.
+
+Reference analog: sky/provision/hyperbolic/instance.py + utils.py.
+Like Vast, Hyperbolic is a market: `create-cheapest` accepts a GPU
+shape and picks the cheapest live offer; an empty book is a
+CapacityError so the failover engine moves on. Instances carry our
+deterministic `<cluster>-<i>` identity in their metadata name;
+terminate-only (no stop).
+"""
+import re
+from typing import Any, Dict, List
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.adaptors import hyperbolic as hyp_adaptor
+from skypilot_tpu.provision import common, rest_driver
+
+_STATE_MAP = {
+    'creating': 'pending',
+    'starting': 'pending',
+    'provisioning': 'pending',
+    'online': 'running',
+    'ready': 'running',
+    'stopping': 'stopping',
+    'terminating': 'stopping',
+    'offline': 'terminated',
+    'terminated': 'terminated',
+    'failed': 'terminated',
+}
+
+
+def _state(inst: Dict[str, Any]) -> str:
+    return _STATE_MAP.get(str(inst.get('status', '')).lower(),
+                          'pending')
+
+
+def _name(inst: Dict[str, Any]) -> str:
+    meta = inst.get('metadata') or {}
+    return inst.get('name') or meta.get('name') or ''
+
+
+def _list(client, ctx: rest_driver.Ctx) -> List[Dict[str, Any]]:
+    pattern = re.compile(re.escape(ctx.cluster) + r'-\d+$')
+    resp = client.request('GET', '/v1/marketplace/instances')
+    return [i for i in resp.get('instances', [])
+            if pattern.fullmatch(_name(i))]
+
+
+def _create(client, ctx: rest_driver.Ctx, name: str) -> None:
+    nc = ctx.nc
+    resp = client.request(
+        'POST', '/v2/marketplace/instances/create-cheapest',
+        json_body={
+            'gpu_model': nc.get('gpu_type', ''),
+            'gpu_count': int(nc.get('gpu_count', 1)),
+            'metadata': {'name': name},
+            'ssh_public_key': common.require_public_key(
+                ctx.config.authentication_config),
+        })
+    if not (resp.get('instance_id') or resp.get('id')):
+        raise exceptions.CapacityError(
+            f'Hyperbolic: no machine available for '
+            f'{nc.get("gpu_type")}:{nc.get("gpu_count")}')
+
+
+_SPEC = rest_driver.RestVmSpec(
+    provider='hyperbolic',
+    adaptor=hyp_adaptor,
+    ssh_user='ubuntu',
+    list_instances=_list,
+    state=_state,
+    name_of=_name,
+    create=_create,
+    host_info=lambda inst: common.HostInfo(
+        host_id=str(inst['id']),
+        internal_ip=inst.get('ip', ''),
+        external_ip=inst.get('ip'),
+        ssh_port=int(inst.get('ssh_port') or 22)),
+    terminate=lambda client, ctx, inst: client.request(
+        'POST', '/v1/marketplace/instances/terminate',
+        json_body={'id': inst['id']}),
+)
+
+rest_driver.RestVmDriver(_SPEC).export(globals())

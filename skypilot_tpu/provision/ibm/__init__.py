@@ -1,0 +1,145 @@
+"""IBM Cloud VPC provisioner on the shared REST driver.
+
+Reference analog: sky/clouds/ibm.py + the legacy ibm node provider
+(ibm_vpc SDK). Gen-2 VPC instances carry our deterministic
+`<cluster>-<i>` names; the cluster SSH key is idempotently registered
+as a VPC key, and a floating IP is attached at create time for public
+reachability (VPC private IPs aren't routable from outside).
+Stop/start are instance actions, so autostop can stop.
+"""
+import hashlib
+import re
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.adaptors import ibm as ibm_adaptor
+from skypilot_tpu.provision import common, rest_driver
+
+_STATE_MAP = {
+    'pending': 'pending',
+    'starting': 'pending',
+    'restarting': 'pending',
+    'resuming': 'pending',
+    'running': 'running',
+    'stopping': 'stopping',
+    'pausing': 'stopping',
+    'deleting': 'stopping',
+    'stopped': 'stopped',
+    'paused': 'stopped',
+    'failed': 'terminated',
+}
+
+
+def _region(ctx: rest_driver.Ctx) -> Optional[str]:
+    return ctx.region or ctx.provider_config.get('region')
+
+
+def _state(inst: Dict[str, Any]) -> str:
+    return _STATE_MAP.get(str(inst.get('status', '')).lower(),
+                          'pending')
+
+
+def _list(client, ctx: rest_driver.Ctx) -> List[Dict[str, Any]]:
+    pattern = re.compile(re.escape(ctx.cluster) + r'-\d+$')
+    region = _region(ctx)
+    resp = client.request('GET', '/v1/instances',
+                          params={'limit': '100'}, region=region)
+    instances = [i for i in resp.get('instances', [])
+                 if pattern.fullmatch(i.get('name') or '')]
+    if any(_state(i) == 'running' and 'floating_ip' not in i
+           for i in instances):
+        fips = client.request('GET', '/v1/floating_ips',
+                              params={'limit': '100'}, region=region)
+        by_nic = {}
+        for fip in fips.get('floating_ips', []):
+            target = fip.get('target') or {}
+            if target.get('id'):
+                by_nic[target['id']] = fip.get('address')
+        for inst in instances:
+            nic = inst.get('primary_network_interface') or {}
+            inst['floating_ip'] = by_nic.get(nic.get('id'))
+    return instances
+
+
+def _ensure_ssh_key(client, ctx: rest_driver.Ctx) -> None:
+    """Idempotently register the cluster public key as a VPC key."""
+    public_key = common.require_public_key(
+        ctx.config.authentication_config)
+    digest = hashlib.sha256(public_key.encode()).hexdigest()[:12]
+    key_name = f'skytpu-{digest}'
+    region = _region(ctx)
+    existing = client.request('GET', '/v1/keys',
+                              params={'limit': '100'}, region=region)
+    for key in existing.get('keys', []):
+        if key.get('name') == key_name:
+            ctx.data['key_id'] = key['id']
+            return
+    created = client.request('POST', '/v1/keys', json_body={
+        'name': key_name, 'public_key': public_key, 'type': 'rsa',
+    }, region=region)
+    ctx.data['key_id'] = created['id']
+
+
+def _create(client, ctx: rest_driver.Ctx, name: str) -> None:
+    nc = ctx.nc
+    region = _region(ctx)
+    body = {
+        'name': name,
+        'zone': {'name': nc.get('zone') or f'{region}-1'},
+        'profile': {'name': nc.get('instance_type', '')},
+        'vpc': {'id': nc.get('vpc_id', '')},
+        'image': {'id': nc.get('image_id') or nc.get('default_image_id',
+                                                     '')},
+        'primary_network_interface': {
+            'subnet': {'id': nc.get('subnet_id', '')},
+        },
+        'keys': [{'id': ctx.data['key_id']}],
+        'boot_volume_attachment': {
+            'volume': {
+                'capacity': int(nc.get('disk_size', 100)),
+                'profile': {'name': 'general-purpose'},
+            },
+        },
+    }
+    inst = client.request('POST', '/v1/instances', json_body=body,
+                          region=region)
+    nic = inst.get('primary_network_interface') or {}
+    if nic.get('id'):
+        # Public reachability: attach a floating IP to the primary NIC.
+        client.request('POST', '/v1/floating_ips', json_body={
+            'name': f'{name}-fip',
+            'target': {'id': nic['id']},
+        }, region=region)
+
+
+def _host_info(inst: Dict[str, Any]) -> common.HostInfo:
+    nic = inst.get('primary_network_interface') or {}
+    internal = (nic.get('primary_ip') or {}).get('address') or \
+        nic.get('primary_ipv4_address', '')
+    return common.HostInfo(host_id=inst['id'], internal_ip=internal,
+                           external_ip=inst.get('floating_ip'))
+
+
+_SPEC = rest_driver.RestVmSpec(
+    provider='ibm',
+    adaptor=ibm_adaptor,
+    ssh_user='ubuntu',
+    list_instances=_list,
+    state=_state,
+    name_of=lambda inst: inst['name'],
+    create=_create,
+    host_info=_host_info,
+    terminate=lambda client, ctx, inst: client.request(
+        'DELETE', f'/v1/instances/{inst["id"]}',
+        region=_region(ctx)),
+    # 'failed' maps to terminated but still exists: delete it too.
+    terminate_terminated=True,
+    stop=lambda client, ctx, inst: client.request(
+        'POST', f'/v1/instances/{inst["id"]}/actions',
+        json_body={'type': 'stop'}, region=_region(ctx)),
+    resume=lambda client, ctx, inst: client.request(
+        'POST', f'/v1/instances/{inst["id"]}/actions',
+        json_body={'type': 'start'}, region=_region(ctx)),
+    prepare_launch=_ensure_ssh_key,
+)
+
+rest_driver.RestVmDriver(_SPEC).export(globals())

@@ -1,0 +1,133 @@
+"""OCI provisioner — compartment-scoped compute on the shared REST
+driver.
+
+Reference analog: sky/provision/oci/instance.py + query_utils.py (oci
+SDK). Instances live in a compartment; our deterministic
+`<cluster>-<i>` identity rides displayName. Start/stop are instance
+actions; addresses come from the instance's VNIC (ListVnicAttachments
+→ GetVnic), which `_list` resolves for running instances so the
+driver's host_info stays a pure extraction.
+"""
+import re
+from typing import Any, Dict, List
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.adaptors import oci as oci_adaptor
+from skypilot_tpu.provision import common, rest_driver
+
+_STATE_MAP = {
+    'MOVING': 'pending',
+    'PROVISIONING': 'pending',
+    'CREATING_IMAGE': 'pending',
+    'STARTING': 'pending',
+    'RUNNING': 'running',
+    'STOPPING': 'stopping',
+    'TERMINATING': 'stopping',
+    'STOPPED': 'stopped',
+    'TERMINATED': 'terminated',
+}
+
+
+def _compartment(ctx: rest_driver.Ctx) -> str:
+    pc = ctx.provider_config
+    compartment = (pc.get('compartment_id')
+                   or oci_adaptor.default_compartment_id())
+    if not compartment:
+        raise exceptions.ProvisionError(
+            'OCI compartment id missing: set oci.compartment_id in '
+            'config or OCI_COMPARTMENT_ID (or a tenancy in '
+            '~/.oci/config).')
+    pc['compartment_id'] = compartment
+    ctx.data['compartment'] = compartment
+    return compartment
+
+
+def _resolve_compartment(client, ctx: rest_driver.Ctx) -> None:
+    del client
+    _compartment(ctx)
+
+
+def _state(inst: Dict[str, Any]) -> str:
+    return _STATE_MAP.get(inst.get('lifecycleState', ''), 'pending')
+
+
+def _vnic_ips(client, compartment: str, inst: Dict[str, Any]) -> None:
+    """Stash privateIp/publicIp on the instance dict from its VNIC."""
+    attachments = client.request(
+        'GET', '/vnicAttachments/',
+        params={'compartmentId': compartment,
+                'instanceId': inst['id']})
+    items = (attachments if isinstance(attachments, list)
+             else attachments.get('items', []))
+    for att in items:
+        if att.get('lifecycleState') not in (None, 'ATTACHED'):
+            continue
+        vnic = client.request('GET', f'/vnics/{att["vnicId"]}')
+        inst['privateIp'] = vnic.get('privateIp', '')
+        inst['publicIp'] = vnic.get('publicIp')
+        return
+
+
+def _list(client, ctx: rest_driver.Ctx) -> List[Dict[str, Any]]:
+    compartment = ctx.data.get('compartment') or _compartment(ctx)
+    pattern = re.compile(re.escape(ctx.cluster) + r'-\d+$')
+    resp = client.request('GET', '/instances/',
+                          params={'compartmentId': compartment})
+    items = resp if isinstance(resp, list) else resp.get('items', [])
+    out = [i for i in items
+           if pattern.fullmatch(i.get('displayName') or '')]
+    for inst in out:
+        if _state(inst) == 'running' and 'privateIp' not in inst:
+            _vnic_ips(client, compartment, inst)
+    return out
+
+
+def _create(client, ctx: rest_driver.Ctx, name: str) -> None:
+    nc = ctx.nc
+    ad = nc.get('availability_domain') or nc.get('zone')
+    if not ad:
+        raise exceptions.ProvisionError(
+            'OCI launch needs an availability domain (zone).')
+    body = {
+        'availabilityDomain': ad,
+        'compartmentId': ctx.data['compartment'],
+        'displayName': name,
+        'shape': nc.get('instance_type', ''),
+        'metadata': {'ssh_authorized_keys': common.require_public_key(
+            ctx.config.authentication_config)},
+        'sourceDetails': {
+            'sourceType': 'image',
+            'imageId': nc.get('image_id') or nc.get('default_image_id',
+                                                    ''),
+            'bootVolumeSizeInGBs': int(nc.get('disk_size', 100)),
+        },
+        'createVnicDetails': {
+            'assignPublicIp': True,
+            'subnetId': nc.get('subnet_id', ''),
+        },
+    }
+    client.request('POST', '/instances/', json_body=body)
+
+
+_SPEC = rest_driver.RestVmSpec(
+    provider='oci',
+    adaptor=oci_adaptor,
+    ssh_user='ubuntu',
+    list_instances=_list,
+    state=_state,
+    name_of=lambda inst: inst['displayName'],
+    create=_create,
+    host_info=lambda inst: common.HostInfo(
+        host_id=inst['id'],
+        internal_ip=inst.get('privateIp', ''),
+        external_ip=inst.get('publicIp')),
+    terminate=lambda client, ctx, inst: client.request(
+        'DELETE', f'/instances/{inst["id"]}'),
+    stop=lambda client, ctx, inst: client.request(
+        'POST', f'/instances/{inst["id"]}', params={'action': 'STOP'}),
+    resume=lambda client, ctx, inst: client.request(
+        'POST', f'/instances/{inst["id"]}', params={'action': 'START'}),
+    prepare_context=_resolve_compartment,
+)
+
+rest_driver.RestVmDriver(_SPEC).export(globals())

@@ -1,0 +1,87 @@
+"""SCP (Samsung Cloud Platform) provisioner on the shared REST driver.
+
+Reference analog: sky/provision/scp/instance.py (signed open-API
+requests). Virtual servers carry our deterministic `<cluster>-<i>`
+names; the service zone is the region; the cluster SSH key rides the
+init script. Stop/start are first-class.
+"""
+import re
+from typing import Any, Dict, List
+
+from skypilot_tpu.adaptors import scp as scp_adaptor
+from skypilot_tpu.provision import common, rest_driver
+
+_BASE = '/virtual-server/v2/virtual-servers'
+
+_STATE_MAP = {
+    'CREATING': 'pending',
+    'EDITING': 'pending',
+    'STARTING': 'pending',
+    'RESTARTING': 'pending',
+    'RUNNING': 'running',
+    'STOPPING': 'stopping',
+    'TERMINATING': 'stopping',
+    'STOPPED': 'stopped',
+    'TERMINATED': 'terminated',
+    'ERROR': 'terminated',
+}
+
+
+def _state(server: Dict[str, Any]) -> str:
+    return _STATE_MAP.get(
+        str(server.get('virtualServerState', '')).upper(), 'pending')
+
+
+def _list(client, ctx: rest_driver.Ctx) -> List[Dict[str, Any]]:
+    pattern = re.compile(re.escape(ctx.cluster) + r'-\d+$')
+    resp = client.request('GET', _BASE, params={'size': '200'})
+    items = resp.get('contents', resp.get('content', []))
+    return [s for s in items
+            if pattern.fullmatch(s.get('virtualServerName') or '')]
+
+
+def _create(client, ctx: rest_driver.Ctx, name: str) -> None:
+    nc = ctx.nc
+    public_key = common.require_public_key(
+        ctx.config.authentication_config)
+    client.request('POST', _BASE, json_body={
+        'virtualServerName': name,
+        'serverType': nc.get('instance_type', ''),
+        'serviceZoneId': ctx.region,
+        'imageId': nc.get('image_id') or nc.get('default_image_id', ''),
+        'blockStorage': {
+            'blockStorageName': f'{name}-boot',
+            'diskSize': int(nc.get('disk_size', 100)),
+        },
+        'nic': {'natEnabled': True},
+        'initialScript': {
+            'encodingType': 'plain',
+            'initialScriptShell': 'bash',
+            'initialScriptContent': (
+                'mkdir -p /root/.ssh && '
+                f"echo '{public_key}' >> /root/.ssh/authorized_keys"),
+        },
+    })
+
+
+_SPEC = rest_driver.RestVmSpec(
+    provider='scp',
+    adaptor=scp_adaptor,
+    ssh_user='root',
+    list_instances=_list,
+    state=_state,
+    name_of=lambda s: s['virtualServerName'],
+    create=_create,
+    host_info=lambda s: common.HostInfo(
+        host_id=str(s['virtualServerId']),
+        internal_ip=s.get('ip', ''),
+        external_ip=s.get('natIp')),
+    terminate=lambda client, ctx, s: client.request(
+        'DELETE', f'{_BASE}/{s["virtualServerId"]}'),
+    stop=lambda client, ctx, s: client.request(
+        'POST', f'{_BASE}/{s["virtualServerId"]}/stop'),
+    resume=lambda client, ctx, s: client.request(
+        'POST', f'{_BASE}/{s["virtualServerId"]}/start'),
+)
+
+rest_driver.RestVmDriver(_SPEC).export(globals())

@@ -1,0 +1,113 @@
+"""vSphere provisioner — on-prem vCenter VMs on the shared REST
+driver.
+
+Reference analog: sky/provision/vsphere/instance.py (pyvmomi clone
+from template + guest customization). The Automation API model: VMs
+are CLONED from a template named in the resources' image_id (or
+provider config `template`), carry our deterministic `<cluster>-<i>`
+names, and power on after clone. Guest addresses come from
+/guest/networking/interfaces, resolved in `_list` for powered-on VMs.
+SSH identity is expected to be baked into the template (the standard
+on-prem pattern); an optional customization spec name is passed
+through.
+"""
+import re
+from typing import Any, Dict, List
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.adaptors import vsphere as vsphere_adaptor
+from skypilot_tpu.provision import common, rest_driver
+
+_VM = '/api/vcenter/vm'
+
+_STATE_MAP = {
+    'POWERED_ON': 'running',
+    'POWERED_OFF': 'stopped',
+    'SUSPENDED': 'stopped',
+}
+
+
+def _state(vm: Dict[str, Any]) -> str:
+    return _STATE_MAP.get(str(vm.get('power_state', '')).upper(),
+                          'pending')
+
+
+def _guest_ip(client, vm: Dict[str, Any]) -> None:
+    try:
+        nics = client.request(
+            'GET', f'{_VM}/{vm["vm"]}/guest/networking/interfaces')
+    except vsphere_adaptor.RestApiError:
+        return  # tools not ready yet: stay IP-less until next poll
+    for nic in nics if isinstance(nics, list) else []:
+        for addr in (nic.get('ip', {}).get('ip_addresses') or []):
+            if addr.get('state') in (None, 'PREFERRED') and \
+                    ':' not in addr.get('ip_address', ''):
+                vm['ip_address'] = addr['ip_address']
+                return
+
+
+def _list(client, ctx: rest_driver.Ctx) -> List[Dict[str, Any]]:
+    pattern = re.compile(re.escape(ctx.cluster) + r'-\d+$')
+    resp = client.request('GET', _VM)
+    vms = [v for v in (resp if isinstance(resp, list) else [])
+           if pattern.fullmatch(v.get('name') or '')]
+    for vm in vms:
+        if _state(vm) == 'running' and 'ip_address' not in vm:
+            _guest_ip(client, vm)
+    return vms
+
+
+def _create(client, ctx: rest_driver.Ctx, name: str) -> None:
+    nc = ctx.nc
+    template = nc.get('image_id') or nc.get('template')
+    if not template:
+        raise exceptions.ProvisionError(
+            'vSphere needs a template VM: set image_id (template name) '
+            'in resources or vsphere.template in config.')
+    body: Dict[str, Any] = {
+        'source': template,
+        'name': name,
+        'power_on': True,
+    }
+    placement = {
+        key: nc[key] for key in ('folder', 'resource_pool',
+                                 'datastore', 'cluster', 'host')
+        if nc.get(key)
+    }
+    if placement:
+        body['placement'] = placement
+    if nc.get('customization_spec'):
+        body['customization_spec'] = nc['customization_spec']
+    client.request('POST', _VM, params={'action': 'clone'},
+                   json_body=body)
+
+
+def _power(client, vm_id: str, action: str) -> None:
+    client.request('POST', f'{_VM}/{vm_id}/power',
+                   params={'action': action})
+
+
+def _terminate(client, ctx: rest_driver.Ctx, vm: Dict[str, Any]) -> None:
+    if _state(vm) == 'running':
+        _power(client, vm['vm'], 'stop')  # cannot delete a live VM
+    client.request('DELETE', f'{_VM}/{vm["vm"]}')
+
+
+_SPEC = rest_driver.RestVmSpec(
+    provider='vsphere',
+    adaptor=vsphere_adaptor,
+    ssh_user='ubuntu',
+    list_instances=_list,
+    state=_state,
+    name_of=lambda vm: vm['name'],
+    create=_create,
+    host_info=lambda vm: common.HostInfo(
+        host_id=vm['vm'],
+        internal_ip=vm.get('ip_address', ''),
+        external_ip=vm.get('ip_address')),
+    terminate=_terminate,
+    stop=lambda client, ctx, vm: _power(client, vm['vm'], 'stop'),
+    resume=lambda client, ctx, vm: _power(client, vm['vm'], 'start'),
+)
+
+rest_driver.RestVmDriver(_SPEC).export(globals())

@@ -1,0 +1,492 @@
+"""OCI, IBM, SCP, vSphere, Hyperbolic provisioners against in-memory
+fake APIs — the last five clouds of the 19-cloud matrix.
+
+Each fake models the cloud's own API dialect (lifecycle states,
+identity field, address shape) so the real provisioner + shared REST
+driver run unmodified against it.
+"""
+import itertools
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.adaptors import hyperbolic as hyp_adaptor
+from skypilot_tpu.adaptors import ibm as ibm_adaptor
+from skypilot_tpu.adaptors import oci as oci_adaptor
+from skypilot_tpu.adaptors import scp as scp_adaptor
+from skypilot_tpu.adaptors import vsphere as vsphere_adaptor
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision import hyperbolic as hyp_provision
+from skypilot_tpu.provision import ibm as ibm_provision
+from skypilot_tpu.provision import oci as oci_provision
+from skypilot_tpu.provision import scp as scp_provision
+from skypilot_tpu.provision import vsphere as vsphere_provision
+
+
+def _config(instance_type, count=1, extra_pc=None, extra_nc=None):
+    return common.ProvisionConfig(
+        provider_config={'region': 'r1', **(extra_pc or {})},
+        authentication_config={'ssh_user': 'root',
+                               'ssh_public_key_content': 'ssh-ed25519 K'},
+        node_config={'instance_type': instance_type,
+                     **(extra_nc or {})},
+        count=count)
+
+
+def _install(adaptor, api):
+    adaptor.set_client_factory(lambda: api)
+
+
+def _uninstall(adaptor):
+    adaptor.set_client_factory(
+        lambda: (_ for _ in ()).throw(AssertionError('no client')))
+
+
+# ------------------------------------------------------------------- oci
+
+OCI_PC = {'compartment_id': 'ocid1.compartment.oc1..aaa'}
+
+
+class FakeOci:
+    def __init__(self):
+        self.instances = {}
+        self._ids = itertools.count(100)
+        self.fail_create_with = None
+
+    def request(self, method, path, params=None, json_body=None):
+        params = params or {}
+        if path == '/instances/' and method == 'GET':
+            assert params['compartmentId'] == OCI_PC['compartment_id']
+            return list(self.instances.values())
+        if path == '/instances/' and method == 'POST':
+            if self.fail_create_with is not None:
+                raise self.fail_create_with
+            oid = f'ocid1.instance.oc1..{next(self._ids)}'
+            assert json_body['metadata']['ssh_authorized_keys'] == \
+                'ssh-ed25519 K'
+            assert json_body['availabilityDomain']
+            self.instances[oid] = {
+                'id': oid, 'displayName': json_body['displayName'],
+                'lifecycleState': 'RUNNING', '_spec': json_body}
+            return self.instances[oid]
+        if path.startswith('/instances/ocid1') and method == 'POST':
+            inst = self.instances[path.split('/')[2]]
+            inst['lifecycleState'] = ('STOPPED'
+                                      if params['action'] == 'STOP'
+                                      else 'RUNNING')
+            return inst
+        if path.startswith('/instances/') and method == 'DELETE':
+            del self.instances[path.split('/')[2]]
+            return {}
+        if path == '/vnicAttachments/' and method == 'GET':
+            return [{'vnicId': 'vnic-1',
+                     'instanceId': params['instanceId'],
+                     'lifecycleState': 'ATTACHED'}]
+        if path.startswith('/vnics/') and method == 'GET':
+            return {'privateIp': '10.0.0.5', 'publicIp': '129.0.0.9'}
+        raise AssertionError(f'unexpected {method} {path}')
+
+
+@pytest.fixture
+def fake_oci():
+    api = FakeOci()
+    _install(oci_adaptor, api)
+    yield api
+    _uninstall(oci_adaptor)
+
+
+def test_oci_lifecycle(fake_oci):
+    record = oci_provision.run_instances(
+        'us-ashburn-1', 'oc1',
+        _config('VM.GPU.A10.1', extra_pc=OCI_PC,
+                extra_nc={'zone': 'AD-1', 'subnet_id': 'subnet-1',
+                          'image_id': 'ocid1.image.oc1..img'}))
+    assert record.created_instance_ids == ['oc1-0']
+    info = oci_provision.get_cluster_info('us-ashburn-1', 'oc1',
+                                          dict(OCI_PC))
+    host = info.get_head_instance().hosts[0]
+    assert host.internal_ip == '10.0.0.5'
+    assert host.external_ip == '129.0.0.9'
+    oci_provision.stop_instances('oc1', dict(OCI_PC))
+    assert oci_provision.query_instances('oc1', dict(OCI_PC)) == {
+        'oc1-0': 'stopped'}
+    record = oci_provision.run_instances(
+        'us-ashburn-1', 'oc1',
+        _config('VM.GPU.A10.1', extra_pc=OCI_PC,
+                extra_nc={'zone': 'AD-1'}))
+    assert record.resumed_instance_ids == ['oc1-0']
+    oci_provision.terminate_instances('oc1', dict(OCI_PC))
+    assert oci_provision.query_instances('oc1', dict(OCI_PC)) == {}
+
+
+def test_oci_requires_compartment(fake_oci, monkeypatch):
+    monkeypatch.delenv('OCI_COMPARTMENT_ID', raising=False)
+    monkeypatch.setattr(oci_adaptor, 'load_config', lambda *a: None)
+    with pytest.raises(exceptions.ProvisionError, match='compartment'):
+        oci_provision.run_instances('r', 'oc1',
+                                    _config('VM.Standard.E4.Flex.8-128'))
+
+
+def test_oci_capacity_taxonomy(fake_oci):
+    fake_oci.fail_create_with = oci_adaptor.RestApiError(
+        'Out of host capacity.', code='OutOfHostCapacity', status=500)
+    with pytest.raises(exceptions.CapacityError):
+        oci_provision.run_instances(
+            'us-ashburn-1', 'oc2',
+            _config('BM.GPU.H100.8', extra_pc=OCI_PC,
+                    extra_nc={'zone': 'AD-1'}))
+
+
+def test_oci_signer_roundtrip(tmp_path, monkeypatch):
+    """The draft-cavage signature must verify against the public key
+    and cover the OCI-required header set."""
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import padding, rsa
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    pem = key.private_bytes(serialization.Encoding.PEM,
+                            serialization.PrivateFormat.PKCS8,
+                            serialization.NoEncryption())
+    key_file = tmp_path / 'oci.pem'
+    key_file.write_bytes(pem)
+    signer = oci_adaptor.OciSigner({
+        'tenancy': 'ocid1.tenancy.oc1..t', 'user': 'ocid1.user.oc1..u',
+        'fingerprint': 'aa:bb', 'key_file': str(key_file)})
+    url = ('https://iaas.us-ashburn-1.oraclecloud.com/20160918/'
+           'instances/?compartmentId=c1')
+    headers = signer.sign_headers('GET', url, None)
+    assert headers['host'] == 'iaas.us-ashburn-1.oraclecloud.com'
+    auth = headers['authorization']
+    assert 'keyId="ocid1.tenancy.oc1..t/ocid1.user.oc1..u/aa:bb"' in auth
+    assert 'headers="(request-target) date host"' in auth
+    import base64
+    import re
+    signature = base64.b64decode(
+        re.search(r'signature="([^"]+)"', auth).group(1))
+    signing_string = ('(request-target): get /20160918/instances/'
+                      '?compartmentId=c1\n'
+                      f'date: {headers["date"]}\n'
+                      'host: iaas.us-ashburn-1.oraclecloud.com')
+    key.public_key().verify(signature, signing_string.encode(),
+                            padding.PKCS1v15(), hashes.SHA256())
+    # POST adds the content headers to the signed set.
+    post = signer.sign_headers('POST', url, b'{"a":1}')
+    assert 'x-content-sha256' in post
+    assert 'content-length' in post['authorization']
+
+
+# ------------------------------------------------------------------- ibm
+
+class FakeIbm:
+    def __init__(self):
+        self.instances = {}
+        self.keys = []
+        self.fips = []
+        self._ids = itertools.count(500)
+        self.regions_seen = set()
+
+    def request(self, method, path, params=None, json_body=None,
+                region=None):
+        self.regions_seen.add(region)
+        if path == '/v1/instances' and method == 'GET':
+            return {'instances': list(self.instances.values())}
+        if path == '/v1/keys' and method == 'GET':
+            return {'keys': self.keys}
+        if path == '/v1/keys' and method == 'POST':
+            key = {'id': f'key-{next(self._ids)}', **json_body}
+            self.keys.append(key)
+            return key
+        if path == '/v1/instances' and method == 'POST':
+            iid = f'inst-{next(self._ids)}'
+            assert json_body['keys'], 'instance must carry the VPC key'
+            inst = {
+                'id': iid, 'name': json_body['name'],
+                'status': 'running',
+                'primary_network_interface': {
+                    'id': f'nic-{iid}',
+                    'primary_ip': {'address': '10.240.0.7'}},
+                '_spec': json_body}
+            self.instances[iid] = inst
+            return inst
+        if path == '/v1/floating_ips' and method == 'POST':
+            fip = {'address': '150.0.0.4', 'target': json_body['target']}
+            self.fips.append(fip)
+            return fip
+        if path == '/v1/floating_ips' and method == 'GET':
+            return {'floating_ips': self.fips}
+        if method == 'POST' and path.endswith('/actions'):
+            inst = self.instances[path.split('/')[3]]
+            inst['status'] = ('stopped' if json_body['type'] == 'stop'
+                              else 'running')
+            return {}
+        if method == 'DELETE' and path.startswith('/v1/instances/'):
+            del self.instances[path.split('/')[3]]
+            return {}
+        raise AssertionError(f'unexpected {method} {path}')
+
+
+@pytest.fixture
+def fake_ibm():
+    api = FakeIbm()
+    _install(ibm_adaptor, api)
+    yield api
+    _uninstall(ibm_adaptor)
+
+
+def test_ibm_lifecycle_key_and_floating_ip(fake_ibm):
+    cfg = _config('gx2-8x64x1v100', extra_pc={'region': 'us-south'},
+                  extra_nc={'zone': 'us-south-1', 'vpc_id': 'vpc-1',
+                            'subnet_id': 'sub-1', 'image_id': 'img-1'})
+    record = ibm_provision.run_instances('us-south', 'ib1', cfg)
+    assert record.created_instance_ids == ['ib1-0']
+    # The cluster key was registered once and a floating IP attached.
+    assert len(fake_ibm.keys) == 1
+    assert len(fake_ibm.fips) == 1
+    info = ibm_provision.get_cluster_info('us-south', 'ib1',
+                                          {'region': 'us-south'})
+    host = info.get_head_instance().hosts[0]
+    assert host.internal_ip == '10.240.0.7'
+    assert host.external_ip == '150.0.0.4'
+    ibm_provision.stop_instances('ib1', {'region': 'us-south'})
+    assert ibm_provision.query_instances('ib1', {
+        'region': 'us-south'}) == {'ib1-0': 'stopped'}
+    record = ibm_provision.run_instances('us-south', 'ib1', cfg)
+    assert record.resumed_instance_ids == ['ib1-0']
+    ibm_provision.terminate_instances('ib1', {'region': 'us-south'})
+    assert ibm_provision.query_instances('ib1',
+                                         {'region': 'us-south'}) == {}
+    # Every call carried the cluster's region to the regional API.
+    assert fake_ibm.regions_seen == {'us-south'}
+
+
+def test_ibm_key_reused_across_launches(fake_ibm):
+    cfg = _config('bx2-8x32', extra_pc={'region': 'us-south'})
+    ibm_provision.run_instances('us-south', 'ib1', cfg)
+    ibm_provision.run_instances('us-south', 'ib2', cfg)
+    assert len(fake_ibm.keys) == 1  # second launch reuses the VPC key
+
+
+# ------------------------------------------------------------------- scp
+
+class FakeScp:
+    def __init__(self):
+        self.servers = {}
+        self._ids = itertools.count(700)
+
+    def request(self, method, path, params=None, json_body=None):
+        base = '/virtual-server/v2/virtual-servers'
+        if path == base and method == 'GET':
+            return {'contents': list(self.servers.values())}
+        if path == base and method == 'POST':
+            sid = str(next(self._ids))
+            script = json_body['initialScript']['initialScriptContent']
+            assert 'ssh-ed25519 K' in script
+            self.servers[sid] = {
+                'virtualServerId': sid,
+                'virtualServerName': json_body['virtualServerName'],
+                'virtualServerState': 'RUNNING',
+                'ip': '192.168.0.9', 'natIp': '211.0.0.7',
+                '_spec': json_body}
+            return self.servers[sid]
+        if method == 'POST' and path.endswith('/stop'):
+            self.servers[path.split('/')[-2]]['virtualServerState'] = \
+                'STOPPED'
+            return {}
+        if method == 'POST' and path.endswith('/start'):
+            self.servers[path.split('/')[-2]]['virtualServerState'] = \
+                'RUNNING'
+            return {}
+        if method == 'DELETE':
+            del self.servers[path.split('/')[-1]]
+            return {}
+        raise AssertionError(f'unexpected {method} {path}')
+
+
+@pytest.fixture
+def fake_scp():
+    api = FakeScp()
+    _install(scp_adaptor, api)
+    yield api
+    _uninstall(scp_adaptor)
+
+
+def test_scp_lifecycle(fake_scp):
+    record = scp_provision.run_instances('KR-WEST-1', 'sc1',
+                                         _config('g1v8m64-t4'))
+    assert record.created_instance_ids == ['sc1-0']
+    info = scp_provision.get_cluster_info('KR-WEST-1', 'sc1', {})
+    host = info.get_head_instance().hosts[0]
+    assert host.internal_ip == '192.168.0.9'
+    assert host.external_ip == '211.0.0.7'
+    scp_provision.stop_instances('sc1', {})
+    assert scp_provision.query_instances('sc1', {}) == {
+        'sc1-0': 'stopped'}
+    record = scp_provision.run_instances('KR-WEST-1', 'sc1',
+                                         _config('g1v8m64-t4'))
+    assert record.resumed_instance_ids == ['sc1-0']
+    scp_provision.terminate_instances('sc1', {})
+    assert scp_provision.query_instances('sc1', {}) == {}
+
+
+# --------------------------------------------------------------- vsphere
+
+class FakeVsphere:
+    def __init__(self):
+        self.vms = {}
+        self._ids = itertools.count(10)
+        self.tools_ready = True
+
+    def request(self, method, path, params=None, json_body=None):
+        params = params or {}
+        if path == '/api/vcenter/vm' and method == 'GET':
+            return [dict(v) for v in self.vms.values()]
+        if path == '/api/vcenter/vm' and method == 'POST':
+            assert params.get('action') == 'clone'
+            assert json_body['source'], 'clone needs a template'
+            vm_id = f'vm-{next(self._ids)}'
+            self.vms[vm_id] = {
+                'vm': vm_id, 'name': json_body['name'],
+                'power_state': ('POWERED_ON' if json_body['power_on']
+                                else 'POWERED_OFF'),
+                '_spec': json_body}
+            return vm_id
+        if method == 'GET' and path.endswith(
+                '/guest/networking/interfaces'):
+            if not self.tools_ready:
+                raise vsphere_adaptor.RestApiError('tools not running',
+                                                   status=503)
+            return [{'ip': {'ip_addresses': [
+                {'ip_address': '10.30.0.4', 'state': 'PREFERRED'}]}}]
+        if method == 'POST' and path.endswith('/power'):
+            vm = self.vms[path.split('/')[4]]
+            vm['power_state'] = ('POWERED_OFF'
+                                 if params['action'] == 'stop'
+                                 else 'POWERED_ON')
+            return {}
+        if method == 'DELETE':
+            vm = self.vms[path.split('/')[4]]
+            assert vm['power_state'] != 'POWERED_ON', \
+                'cannot delete a powered-on VM'
+            del self.vms[path.split('/')[4]]
+            return {}
+        raise AssertionError(f'unexpected {method} {path}')
+
+
+@pytest.fixture
+def fake_vsphere():
+    api = FakeVsphere()
+    _install(vsphere_adaptor, api)
+    yield api
+    _uninstall(vsphere_adaptor)
+
+
+def test_vsphere_lifecycle(fake_vsphere):
+    cfg = _config('cpu8-mem32', extra_nc={'template': 'ubuntu-tmpl'})
+    record = vsphere_provision.run_instances('on-prem', 'vs1', cfg)
+    assert record.created_instance_ids == ['vs1-0']
+    info = vsphere_provision.get_cluster_info('on-prem', 'vs1', {})
+    assert info.get_head_instance().hosts[0].internal_ip == '10.30.0.4'
+    vsphere_provision.stop_instances('vs1', {})
+    assert vsphere_provision.query_instances('vs1', {}) == {
+        'vs1-0': 'stopped'}
+    record = vsphere_provision.run_instances('on-prem', 'vs1', cfg)
+    assert record.resumed_instance_ids == ['vs1-0']
+    # terminate powers off the live VM before deleting (the fake
+    # asserts delete-while-on is rejected).
+    vsphere_provision.terminate_instances('vs1', {})
+    assert vsphere_provision.query_instances('vs1', {}) == {}
+
+
+def test_vsphere_requires_template(fake_vsphere):
+    with pytest.raises(exceptions.ProvisionError, match='template'):
+        vsphere_provision.run_instances('on-prem', 'vs1',
+                                        _config('cpu8-mem32'))
+
+
+def test_vsphere_ip_less_until_tools_ready(fake_vsphere):
+    """Guest-tools lag must not fail listing — the VM just stays
+    IP-less until the next poll."""
+    cfg = _config('cpu8-mem32', extra_nc={'template': 'ubuntu-tmpl'})
+    vsphere_provision.run_instances('on-prem', 'vs1', cfg)
+    fake_vsphere.tools_ready = False
+    info = vsphere_provision.get_cluster_info('on-prem', 'vs1', {})
+    assert info.get_head_instance().hosts[0].internal_ip == ''
+
+
+# ------------------------------------------------------------ hyperbolic
+
+class FakeHyperbolic:
+    def __init__(self):
+        self.instances = {}
+        self._ids = itertools.count(40)
+        self.sold_out = False
+
+    def request(self, method, path, params=None, json_body=None):
+        if path == '/v1/marketplace/instances' and method == 'GET':
+            return {'instances': list(self.instances.values())}
+        if path == '/v2/marketplace/instances/create-cheapest':
+            if self.sold_out:
+                return {}
+            iid = f'hyp-{next(self._ids)}'
+            assert json_body['ssh_public_key'] == 'ssh-ed25519 K'
+            self.instances[iid] = {
+                'id': iid, 'status': 'online',
+                'metadata': {'name': json_body['metadata']['name']},
+                'ip': '203.0.113.9', 'ssh_port': 2222,
+                '_spec': json_body}
+            return {'instance_id': iid}
+        if path == '/v1/marketplace/instances/terminate':
+            del self.instances[json_body['id']]
+            return {}
+        raise AssertionError(f'unexpected {method} {path}')
+
+
+@pytest.fixture
+def fake_hyp():
+    api = FakeHyperbolic()
+    _install(hyp_adaptor, api)
+    yield api
+    _uninstall(hyp_adaptor)
+
+
+def test_hyperbolic_lifecycle(fake_hyp):
+    record = hyp_provision.run_instances(
+        'any', 'hy1', _config('1x_H100',
+                              extra_nc={'gpu_type': 'H100',
+                                        'gpu_count': 1}))
+    assert record.created_instance_ids == ['hy1-0']
+    info = hyp_provision.get_cluster_info('any', 'hy1', {})
+    host = info.get_head_instance().hosts[0]
+    assert host.external_ip == '203.0.113.9'
+    assert host.ssh_port == 2222
+    with pytest.raises(exceptions.NotSupportedError):
+        hyp_provision.stop_instances('hy1', {})
+    hyp_provision.terminate_instances('hy1', {})
+    assert hyp_provision.query_instances('hy1', {}) == {}
+
+
+def test_hyperbolic_empty_market_is_capacity_error(fake_hyp):
+    fake_hyp.sold_out = True
+    with pytest.raises(exceptions.CapacityError):
+        hyp_provision.run_instances(
+            'any', 'hy2', _config('8x_H100',
+                                  extra_nc={'gpu_type': 'H100',
+                                            'gpu_count': 8}))
+
+
+# ------------------------------------------------------------- the matrix
+
+def test_nineteen_cloud_registry(enable_clouds):
+    from skypilot_tpu.clouds import CLOUD_REGISTRY
+    names = set(CLOUD_REGISTRY.names())
+    assert {'oci', 'ibm', 'scp', 'vsphere', 'hyperbolic'} <= names
+    assert len(names) >= 19
+    # All five catalogs feed the optimizer; cheapest H100 host wins.
+    from skypilot_tpu import Dag, Resources, Task
+    from skypilot_tpu.optimizer import Optimizer
+    enable_clouds('oci', 'ibm', 'scp', 'vsphere', 'hyperbolic')
+    with Dag() as dag:
+        t = Task('t', run='true')
+        t.set_resources(Resources(accelerators='H100:1'))
+        dag.add(t)
+    Optimizer.optimize(dag, quiet=True)
+    assert t.best_resources.cloud == 'hyperbolic'  # $1.99 market floor
