@@ -24,6 +24,8 @@ class StoreType(enum.Enum):
     S3 = 's3'
     AZURE = 'azure'
     R2 = 'r2'         # Cloudflare R2 (S3-compatible endpoint)
+    COS = 'cos'       # IBM Cloud Object Storage (S3-compatible)
+    OCI = 'oci'       # OCI Object Storage (S3-compatibility API)
     LOCAL = 'local'   # directory-backed fake for tests/dev
 
     @classmethod
@@ -40,6 +42,10 @@ class StoreType(enum.Enum):
             return cls.AZURE
         if url.startswith('r2://'):
             return cls.R2
+        if url.startswith('cos://'):
+            return cls.COS
+        if url.startswith('oci://'):
+            return cls.OCI
         if url.startswith('local://'):
             return cls.LOCAL
         raise exceptions.StorageError(f'Cannot infer store from {url!r}')
@@ -210,25 +216,33 @@ class AzureBlobStore(AbstractStore):
         return f'az://{self.name}'
 
 
-class R2Store(S3Store):
-    """Cloudflare R2: the S3 API with a per-account endpoint; every aws
-    CLI call gains --endpoint-url $R2_ENDPOINT_URL (reference R2Store,
-    sky/data/storage.py:3285)."""
+class EndpointS3Store(S3Store):
+    """Base for S3-compatible stores behind a custom endpoint: every
+    aws CLI call gains --endpoint-url. Subclasses resolve the endpoint
+    (env var first, then config)."""
 
-    TYPE = StoreType.R2
+    _ENV_VAR = ''
+    _CONFIG_KEY: tuple = ()
 
-    @staticmethod
-    def _endpoint() -> str:
-        endpoint = os.environ.get('R2_ENDPOINT_URL')
+    @classmethod
+    def _endpoint(cls) -> str:
+        endpoint = os.environ.get(cls._ENV_VAR)
         if not endpoint:
             from skypilot_tpu import config as config_lib
-            endpoint = config_lib.get_nested(('r2', 'endpoint_url'),
+            endpoint = config_lib.get_nested(cls._CONFIG_KEY,
                                              default=None)
         if not endpoint:
+            endpoint = cls._default_endpoint()
+        if not endpoint:
             raise exceptions.StorageError(
-                'R2 needs an endpoint: set R2_ENDPOINT_URL or '
-                'r2.endpoint_url in config.')
+                f'{cls.TYPE.value} needs an endpoint: set '
+                f'{cls._ENV_VAR} or {".".join(cls._CONFIG_KEY)} in '
+                'config.')
         return endpoint
+
+    @classmethod
+    def _default_endpoint(cls) -> Optional[str]:
+        return None
 
     def _aws(self, *args: str) -> List[str]:
         return ['aws', '--endpoint-url', self._endpoint(), *args]
@@ -241,11 +255,11 @@ class R2Store(S3Store):
 
     def create(self) -> None:
         _run_cli(self._aws('s3', 'mb', f's3://{self.name}'),
-                 f'creating r2://{self.name}')
+                 f'creating {self.url()}')
 
     def delete(self) -> None:
         _run_cli(self._aws('s3', 'rb', '--force', f's3://{self.name}'),
-                 f'deleting r2://{self.name}')
+                 f'deleting {self.url()}')
 
     def upload(self, source: str) -> None:
         source = os.path.expanduser(source)
@@ -258,8 +272,57 @@ class R2Store(S3Store):
             _run_cli(self._aws('s3', 'cp', source, f's3://{self.name}/'),
                      f'uploading {source}')
 
-    def url(self) -> str:
-        return f'r2://{self.name}'
+
+class R2Store(EndpointS3Store):
+    """Cloudflare R2: the S3 API with a per-account endpoint
+    (reference R2Store, sky/data/storage.py:3285)."""
+
+    TYPE = StoreType.R2
+    _ENV_VAR = 'R2_ENDPOINT_URL'
+    _CONFIG_KEY = ('r2', 'endpoint_url')
+
+
+class IbmCosStore(EndpointS3Store):
+    """IBM Cloud Object Storage through its S3-compatible API with
+    HMAC credentials (reference IBMCosStore, sky/data/storage.py:3763
+    — ours rides the aws CLI against the regional COS endpoint
+    instead of binding ibm_boto3)."""
+
+    TYPE = StoreType.COS
+    _ENV_VAR = 'COS_ENDPOINT_URL'
+    _CONFIG_KEY = ('ibm', 'cos_endpoint_url')
+
+    @classmethod
+    def _default_endpoint(cls) -> Optional[str]:
+        region = os.environ.get('IBM_COS_REGION') or \
+            os.environ.get('IBM_REGION')
+        if not region:
+            return None
+        return (f'https://s3.{region}.cloud-object-storage'
+                '.appdomain.cloud')
+
+
+class OciStore(EndpointS3Store):
+    """OCI Object Storage through its S3-compatibility API
+    (reference OciStore, sky/data/storage.py:4227 — ours rides the
+    aws CLI against {namespace}.compat.objectstorage.{region}
+    instead of binding the oci SDK)."""
+
+    TYPE = StoreType.OCI
+    _ENV_VAR = 'OCI_S3_ENDPOINT_URL'
+    _CONFIG_KEY = ('oci', 's3_endpoint_url')
+
+    @classmethod
+    def _default_endpoint(cls) -> Optional[str]:
+        namespace = os.environ.get('OCI_NAMESPACE')
+        if not namespace:
+            return None
+        from skypilot_tpu.adaptors import oci as oci_adaptor
+        config = oci_adaptor.load_config()
+        if not config:
+            return None
+        return (f'https://{namespace}.compat.objectstorage.'
+                f'{config["region"]}.oraclecloud.com')
 
 
 class LocalStore(AbstractStore):
@@ -307,6 +370,8 @@ _STORE_CLASSES: Dict[StoreType, Type[AbstractStore]] = {
     StoreType.S3: S3Store,
     StoreType.AZURE: AzureBlobStore,
     StoreType.R2: R2Store,
+    StoreType.COS: IbmCosStore,
+    StoreType.OCI: OciStore,
     StoreType.LOCAL: LocalStore,
 }
 

@@ -11,11 +11,17 @@ from typing import Dict, List
 from skypilot_tpu import exceptions
 
 
-def _r2_endpoint() -> str:
-    """Resolved CLIENT-side (config/env) and baked into the remote
+_ENDPOINT_STORES = ('r2', 'cos', 'oci')
+
+
+def _s3_endpoint(store_type: str) -> str:
+    """Endpoint of an S3-compatible store (R2 / IBM COS / OCI),
+    resolved CLIENT-side (config/env) and baked into the remote
     command — cluster hosts don't inherit the client's env."""
     from skypilot_tpu.data import storage as storage_lib
-    return shlex.quote(storage_lib.R2Store._endpoint())  # noqa: SLF001
+    cls = storage_lib._STORE_CLASSES[  # noqa: SLF001
+        storage_lib.StoreType(store_type)]
+    return shlex.quote(cls._endpoint())  # noqa: SLF001
 
 _GCSFUSE_INSTALL = (
     'command -v gcsfuse >/dev/null 2>&1 || '
@@ -56,10 +62,10 @@ def mount_cmd(store_type: str, bucket: str, mount_path: str,
         if store_type == 's3':
             return (f'mkdir -p {q_path} && '
                     f'aws s3 sync s3://{q_bucket} {q_path}')
-        if store_type == 'r2':
+        if store_type in _ENDPOINT_STORES:
             return (f'mkdir -p {q_path} && '
                     f'aws s3 sync s3://{q_bucket} {q_path} '
-                    f'--endpoint-url {_r2_endpoint()}')
+                    f'--endpoint-url {_s3_endpoint(store_type)}')
         if store_type == 'azure':
             return (f'mkdir -p {q_path} && az storage blob '
                     f'download-batch --destination {q_path} '
@@ -72,11 +78,13 @@ def mount_cmd(store_type: str, bucket: str, mount_path: str,
     if store_type == 's3':
         return (f'{_GOOFYS_INSTALL} && ' + _mount_guard(
             q_path, f'goofys {q_bucket} {q_path}'))
-    if store_type == 'r2':
-        # R2 is S3-compatible: goofys with the account endpoint.
+    if store_type in _ENDPOINT_STORES:
+        # R2 / IBM COS / OCI are S3-compatible: goofys with the
+        # store's endpoint.
         return (f'{_GOOFYS_INSTALL} && ' + _mount_guard(
             q_path,
-            f'goofys --endpoint {_r2_endpoint()} {q_bucket} {q_path}'))
+            f'goofys --endpoint {_s3_endpoint(store_type)} '
+            f'{q_bucket} {q_path}'))
     if store_type == 'azure':
         return (f'{_BLOBFUSE2_INSTALL} && ' + _mount_guard(
             q_path,

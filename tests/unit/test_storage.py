@@ -175,7 +175,7 @@ class TestStoreRegistry:
 
     def test_all_store_types_instantiable(self):
         schemes = {'gcs': 'gs', 's3': 's3', 'azure': 'az', 'r2': 'r2',
-                   'local': 'local'}
+                   'cos': 'cos', 'oci': 'oci', 'local': 'local'}
         for st in storage_lib.StoreType:
             store = storage_lib.make_store(st, 'bname')
             assert store.TYPE == st
@@ -193,6 +193,39 @@ class TestStoreRegistry:
             storage_lib.StoreType.AZURE
         assert storage_lib.StoreType.from_url('r2://b') == \
             storage_lib.StoreType.R2
+        assert storage_lib.StoreType.from_url('cos://b') == \
+            storage_lib.StoreType.COS
+        assert storage_lib.StoreType.from_url('oci://b') == \
+            storage_lib.StoreType.OCI
+
+    def test_cos_endpoint_from_region(self, monkeypatch):
+        """COS derives the regional endpoint when only a region is
+        configured; an explicit endpoint var wins."""
+        monkeypatch.delenv('COS_ENDPOINT_URL', raising=False)
+        monkeypatch.setenv('IBM_COS_REGION', 'eu-de')
+        assert storage_lib.IbmCosStore._endpoint() == \
+            'https://s3.eu-de.cloud-object-storage.appdomain.cloud'
+        monkeypatch.setenv('COS_ENDPOINT_URL', 'https://cos.example')
+        assert storage_lib.IbmCosStore._endpoint() == \
+            'https://cos.example'
+
+    def test_oci_endpoint_from_namespace(self, monkeypatch):
+        monkeypatch.delenv('OCI_S3_ENDPOINT_URL', raising=False)
+        monkeypatch.setenv('OCI_NAMESPACE', 'mytenancy')
+        from skypilot_tpu.adaptors import oci as oci_adaptor
+        monkeypatch.setattr(oci_adaptor, 'load_config',
+                            lambda *a: {'region': 'us-ashburn-1'})
+        assert storage_lib.OciStore._endpoint() == \
+            ('https://mytenancy.compat.objectstorage.'
+             'us-ashburn-1.oraclecloud.com')
+
+    def test_cos_mount_and_copy_use_endpoint(self, monkeypatch):
+        monkeypatch.setenv('COS_ENDPOINT_URL', 'https://cos.example')
+        from skypilot_tpu.data import storage_mounting
+        cmd = storage_mounting.mount_cmd('cos', 'buck', '/data')
+        assert 'goofys --endpoint https://cos.example buck /data' in cmd
+        copy = storage_mounting.mount_cmd('cos', 'b', '/d', mode='COPY')
+        assert '--endpoint-url https://cos.example' in copy
 
 
 class TestDataTransfer:
