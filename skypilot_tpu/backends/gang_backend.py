@@ -87,6 +87,22 @@ class RetryingProvisioner:
             self, cluster_name: str, cluster_name_on_cloud: str,
             to_provision: resources_lib.Resources,
             num_nodes: int) -> provision_common.ProvisionRecord:
+        # Defense in depth behind the optimizer's optimize-time
+        # exclusion: callers that hand-build resources must not reach
+        # a cloud that can't satisfy them (it would fail mid-provision
+        # with a billed partial cluster).
+        from skypilot_tpu.optimizer import Optimizer
+
+        class _NodesOnly:
+            def __init__(self, n):
+                self.num_nodes = n
+        gaps = Optimizer.capability_gaps(self.cloud,
+                                         _NodesOnly(num_nodes),
+                                         to_provision)
+        if gaps:
+            raise exceptions.NotSupportedError(
+                f'{self.cloud.NAME} lacks required capabilities: '
+                f'{", ".join(gaps)} (for {to_provision}).')
         rows = self.cloud.get_feasible(to_provision)
         if not rows:
             raise exceptions.ResourcesUnavailableError(

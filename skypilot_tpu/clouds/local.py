@@ -18,6 +18,10 @@ class Local(cloud.Cloud):
         cloud.CloudCapability.AUTOSTOP,
         cloud.CloudCapability.OPEN_PORTS,
         cloud.CloudCapability.STOP,
+        # "Nodes" are host processes: the gang path exercises real
+        # multi-node coordination on one machine.
+        cloud.CloudCapability.MULTI_NODE,
+        cloud.CloudCapability.HOST_CONTROLLERS,
     })
 
     def supports_for(self, cap: cloud.CloudCapability, resources) -> bool:

@@ -225,13 +225,45 @@ class Optimizer:
     # --- candidate fill -----------------------------------------------------
 
     @staticmethod
+    def required_capabilities(task, res) -> List['clouds_lib.CloudCapability']:
+        """Capabilities this (task, resources) pair demands of a cloud
+        (reference CloudImplementationFeatures,
+        /root/reference/sky/clouds/cloud.py:32 — feature mismatches
+        must be optimize-time exclusions, not provision-time
+        failures)."""
+        caps = clouds_lib.CloudCapability
+        required = []
+        if task is not None and task.num_nodes > 1:
+            required.append(caps.MULTI_NODE)
+        if res.use_spot:
+            required.append(caps.SPOT_INSTANCE)
+        if res.ports:
+            required.append(caps.OPEN_PORTS)
+        if res.image_id:
+            required.append(caps.CUSTOM_IMAGE)
+        return required
+
+    @staticmethod
+    def capability_gaps(cloud, task, res) -> List[str]:
+        """Names of required capabilities `cloud` lacks for this
+        placement (per-resource nuances via supports_for)."""
+        supports = getattr(cloud, 'supports_for',
+                           lambda cap, _res: cloud.supports(cap))
+        return [cap.value
+                for cap in Optimizer.required_capabilities(task, res)
+                if not supports(cap, res)]
+
+    @staticmethod
     def _fill_in_launchable_resources(
         task, blocked_resources: Optional[List] = None
     ) -> List[Tuple[resources_lib.Resources, float]]:
-        """All launchable (resources, $/hr for the whole task) candidates."""
+        """All launchable (resources, $/hr for the whole task)
+        candidates. Clouds missing a required capability are excluded
+        up front; the reasons surface in the no-candidates error."""
         enabled = check_lib.get_cached_enabled_clouds_or_refresh(
             raise_if_no_cloud_access=True)
         out: List[Tuple[resources_lib.Resources, float]] = []
+        excluded: Dict[str, List[str]] = {}
         for base in task.resources:
             for res in base.get_candidate_set():
                 target_clouds = ([res.cloud] if res.cloud is not None
@@ -240,12 +272,24 @@ class Optimizer:
                     if cloud_name not in enabled:
                         continue
                     cloud = clouds_lib.get_cloud(cloud_name)
+                    gaps = Optimizer.capability_gaps(cloud, task, res)
+                    if gaps:
+                        excluded[cloud_name] = gaps
+                        continue
                     for row in cloud.get_feasible(res):
                         launchable = Optimizer._make_launchable(res, row)
                         if Optimizer._blocked(launchable, blocked_resources):
                             continue
                         hourly = row.cost(res.use_spot) * task.num_nodes
                         out.append((launchable, hourly))
+        if not out and excluded:
+            reasons = '; '.join(
+                f'{name} lacks {", ".join(gaps)}'
+                for name, gaps in sorted(excluded.items()))
+            raise exceptions.ResourcesUnavailableError(
+                f'No launchable resources satisfy task '
+                f'{task.name!r}: {sorted(task.resources, key=repr)} '
+                f'(capability exclusions: {reasons})')
         return out
 
     @staticmethod

@@ -161,3 +161,54 @@ class TestCrossCloud:
             dag.add(t)
         Optimizer.optimize(dag, quiet=True)
         assert t.best_resources.cloud == 'gcp'
+
+    def test_capability_mismatch_excluded_at_optimize_time(
+            self, enable_clouds):
+        """A cloud missing a required capability is excluded when
+        candidates are filled, with the reason in the error — not at
+        provision time (reference CloudImplementationFeatures,
+        sky/clouds/cloud.py:32)."""
+        import pytest
+
+        from skypilot_tpu import exceptions
+        # Hyperbolic has no MULTI_NODE: a 2-node task must not land
+        # there even when it is the only enabled cloud.
+        enable_clouds('hyperbolic')
+        with Dag() as dag:
+            t = Task('t', run='true')
+            t.num_nodes = 2
+            t.set_resources(Resources(accelerators='H100:1'))
+            dag.add(t)
+        with pytest.raises(exceptions.ResourcesUnavailableError,
+                           match='hyperbolic lacks multi_node'):
+            Optimizer.optimize(dag, quiet=True)
+
+    def test_capability_mismatch_falls_over_to_capable_cloud(
+            self, enable_clouds):
+        """With a capable cloud also enabled, the optimizer routes
+        around the incapable one silently."""
+        enable_clouds('hyperbolic', 'scp')
+        with Dag() as dag:
+            t = Task('t', run='true')
+            t.num_nodes = 2  # scp lacks MULTI_NODE too...
+            t.set_resources(Resources(accelerators='V100:1'))
+            dag.add(t)
+        enable_clouds('hyperbolic', 'ibm')  # ...ibm has it
+        Optimizer.optimize(dag, quiet=True)
+        assert t.best_resources.cloud == 'ibm'
+
+    def test_provisioner_asserts_capabilities(self):
+        """Bypassing the optimizer still can't reach an incapable
+        cloud: the retrying provisioner refuses before any API call."""
+        import pytest
+
+        from skypilot_tpu import clouds as clouds_lib
+        from skypilot_tpu import exceptions
+        from skypilot_tpu.backends import gang_backend
+        prov = gang_backend.RetryingProvisioner(
+            clouds_lib.get_cloud('hyperbolic'))
+        with pytest.raises(exceptions.NotSupportedError,
+                           match='multi_node'):
+            prov.provision_with_retries(
+                'c', 'c-abc', Resources(accelerators='H100:1'),
+                num_nodes=2)
