@@ -81,6 +81,15 @@ class GCP(cloud.Cloud):
             variables['tpu_vm'] = False
             if resources.image_id:
                 variables['image_id'] = resources.image_id
+            # MIG/DWS queued capacity + persistent-disk volumes
+            # (reference mig_utils.py / volume_utils.py).
+            if config_lib.get_nested(('gcp', 'use_mig'), default=False):
+                variables['use_mig'] = True
+                variables['run_duration'] = config_lib.get_nested(
+                    ('gcp', 'run_duration'), default=0)
+        volumes = config_lib.get_nested(('gcp', 'volumes'), default=None)
+        if volumes:
+            variables['volumes'] = volumes
         return variables
 
     def authentication_config(self) -> Dict[str, object]:

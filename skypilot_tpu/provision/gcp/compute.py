@@ -113,7 +113,11 @@ def _create_body(config: common.ProvisionConfig, index: int,
     if ssh_pub:
         body['metadata']['items'].append(
             {'key': 'ssh-keys', 'value': f'{ssh_user}:{ssh_pub}'})
-    startup = nc.get('startup_script')
+    startup = nc.get('startup_script') or ''
+    if nc.get('volumes'):
+        from skypilot_tpu.provision.gcp import volumes as volumes_lib
+        mount = volumes_lib.mount_script(nc['volumes'])
+        startup = f'{startup}\n{mount}' if startup else mount
     if startup:
         body['metadata']['items'].append(
             {'key': 'startup-script', 'value': startup})
@@ -134,6 +138,27 @@ def run_instances(region: str, cluster_name_on_cloud: str,
     pc = config.provider_config
     project, zone = _project_zone(pc)
     t = gcp_adaptor.transport()
+
+    if pc.get('use_mig'):
+        # MIG/DWS path: template properties are the VM body minus the
+        # per-instance name and with an unqualified machineType.
+        from skypilot_tpu.provision.gcp import mig as mig_lib
+        from skypilot_tpu.provision.gcp import volumes as volumes_lib
+        props = _create_body(config, 0, cluster_name_on_cloud, project,
+                             zone)
+        props.pop('name')
+        props['machineType'] = props['machineType'].rsplit('/', 1)[-1]
+        props['labels'].pop(HEAD_LABEL, None)
+
+        def _list():
+            return _list_cluster_vms(project, zone,
+                                     cluster_name_on_cloud)
+
+        record = mig_lib.run_instances(region, cluster_name_on_cloud,
+                                       config, _list, props)
+        volumes_lib.create_and_attach_all(config, cluster_name_on_cloud,
+                                          record.created_instance_ids)
+        return record
 
     existing = {vm['name']: vm
                 for vm in _list_cluster_vms(project, zone,
@@ -166,6 +191,12 @@ def run_instances(region: str, cluster_name_on_cloud: str,
     for op in ops:
         _wait_zone_op(project, zone, op,
                       timeout=float(pc.get('provision_timeout', 600)))
+    if pc.get('volumes'):
+        from skypilot_tpu.provision.gcp import volumes as volumes_lib
+        node_names = [f'{cluster_name_on_cloud}-{i}'
+                      for i in range(config.count)]
+        volumes_lib.create_and_attach_all(config, cluster_name_on_cloud,
+                                          node_names)
     return common.ProvisionRecord(
         provider_name='gcp', region=pc.get('region', zone.rsplit('-', 1)[0]),
         zone=zone, cluster_name_on_cloud=cluster_name_on_cloud,
@@ -189,6 +220,17 @@ def terminate_instances(cluster_name_on_cloud: str,
                         provider_config: Dict[str, Any]) -> None:
     project, zone = _project_zone(provider_config)
     t = gcp_adaptor.transport()
+    if provider_config.get('use_mig'):
+        # Deleting member VMs directly would just make the MIG heal
+        # them: tear down resize requests + group + template instead.
+        from skypilot_tpu.provision.gcp import mig as mig_lib
+        from skypilot_tpu.provision.gcp import volumes as volumes_lib
+        region = provider_config.get('region',
+                                     zone.rsplit('-', 1)[0])
+        mig_lib.cancel_and_delete(project, region, zone,
+                                  cluster_name_on_cloud)
+        volumes_lib.delete_all(provider_config, cluster_name_on_cloud)
+        return
     ops = []
     for vm in _list_cluster_vms(project, zone, cluster_name_on_cloud):
         try:
@@ -200,6 +242,10 @@ def terminate_instances(cluster_name_on_cloud: str,
                 raise
     for op in ops:
         _wait_zone_op(project, zone, op)
+    if provider_config.get('volumes'):
+        # After the VMs are gone (a PD can't be deleted while attached).
+        from skypilot_tpu.provision.gcp import volumes as volumes_lib
+        volumes_lib.delete_all(provider_config, cluster_name_on_cloud)
 
 
 def query_instances(cluster_name_on_cloud: str,

@@ -73,6 +73,9 @@ class FakeGcpApi:
         return max(1, -(-chips // per_host))
 
     def _compute(self, method, url, params, body):
+        if '/instanceTemplates' in url or '/instanceGroupManagers' in \
+                url or '/disks' in url or '/attachDisk' in url:
+            return self._mig_vol(method, url, params, body)
         if method == 'GET' and url.endswith('/instances'):
             flt = params.get('filter', '')
             m = re.search(r'labels\.(\S+)=(\S+)', flt)
@@ -102,6 +105,95 @@ class FakeGcpApi:
         if method == 'POST' and url.endswith('/firewalls'):
             return {'status': 'DONE'}
         raise AssertionError(f'unexpected compute call {method} {url}')
+
+    def _not_found(self):
+        raise gcp_adaptor.GcpApiError('not found', status=404)
+
+    def _mig_vol(self, method, url, params, body):
+        """Instance templates, MIGs, resize requests, disks."""
+        if not hasattr(self, 'templates'):
+            self.templates = {}
+            self.migs = {}
+            self.resize_requests = []
+            self.disks = {}
+            self.attachments = []
+        tail = url.rsplit('/', 1)[-1]
+        if '/instanceTemplates' in url:
+            if method == 'POST':
+                self.templates[body['name']] = body
+                return {'status': 'DONE'}
+            if method == 'GET':
+                if tail in self.templates:
+                    return self.templates[tail]
+                self._not_found()
+            if method == 'DELETE':
+                if self.templates.pop(tail, None) is None:
+                    self._not_found()
+                return {'status': 'DONE'}
+        if url.endswith(':cancel'):
+            return {'status': 'DONE'}
+        if '/resizeRequests' in url:
+            if method == 'POST':
+                self.resize_requests.append(body)
+                # Capacity granted: materialize labeled MIG VMs.
+                group = url.split('/instanceGroupManagers/')[1].split(
+                    '/')[0]
+                mig = self.migs[group]
+                template = self.templates[
+                    mig['instanceTemplate'].rsplit('/', 1)[-1]]
+                for _ in range(body['resizeBy']):
+                    name = (f'{mig["baseInstanceName"]}-'
+                            f'{len(self.vms):04x}')
+                    self.vms[name] = {
+                        'name': name, 'status': 'RUNNING',
+                        'labels': dict(
+                            template['properties']['labels']),
+                        'networkInterfaces': [{
+                            'networkIP': f'10.9.0.{len(self.vms) + 1}',
+                            'accessConfigs': [{
+                                'natIP': f'34.9.0.{len(self.vms) + 1}'
+                            }],
+                        }],
+                    }
+                return {'status': 'DONE'}
+            if method == 'GET':
+                return {'items': list(self.resize_requests)}
+        if '/instanceGroupManagers' in url:
+            if method == 'POST':
+                self.migs[body['name']] = body
+                return {'status': 'DONE'}
+            if method == 'GET':
+                if tail in self.migs:
+                    return self.migs[tail]
+                self._not_found()
+            if method == 'DELETE':
+                if self.migs.pop(tail, None) is None:
+                    self._not_found()
+                # Deleting the group deletes its VMs.
+                base = None
+                for m in list(self.vms):
+                    if m.startswith(tail.replace('skytpu-mig-', '')):
+                        base = m
+                        del self.vms[m]
+                del base
+                return {'status': 'DONE'}
+        if url.endswith('/attachDisk'):
+            self.attachments.append((url.split('/instances/')[1]
+                                     .split('/')[0], body['deviceName']))
+            return {'status': 'DONE'}
+        if '/disks' in url:
+            if method == 'POST':
+                self.disks[body['name']] = body
+                return {'status': 'DONE'}
+            if method == 'GET':
+                if tail in self.disks:
+                    return self.disks[tail]
+                self._not_found()
+            if method == 'DELETE':
+                if self.disks.pop(tail, None) is None:
+                    self._not_found()
+                return {'status': 'DONE'}
+        raise AssertionError(f'unexpected mig/vol call {method} {url}')
 
 
 @pytest.fixture
@@ -241,3 +333,84 @@ def test_compute_vm_lifecycle(fake_api):
         'running'}
     gcp_provision.terminate_instances('ctrl', pc)
     assert gcp_provision.query_instances('ctrl', pc) == {}
+
+
+# --------------------------------------------------------------- MIG/DWS
+
+def _vm_config(count=1, extra_pc=None):
+    return common.ProvisionConfig(
+        provider_config={'project_id': 'proj', 'zone': 'us-central1-a',
+                         'region': 'us-central1', 'tpu_vm': False,
+                         **(extra_pc or {})},
+        authentication_config={'ssh_user': 'skytpu',
+                               'ssh_public_key_content': 'ssh-ed25519 K'},
+        node_config={'instance_type': 'a2-highgpu-8g'},
+        count=count)
+
+
+def test_mig_dws_provision_and_teardown(fake_api):
+    """use_mig routes through template + MIG + DWS resize request;
+    terminate cancels requests and deletes group + template (member
+    VMs go with the group, never one-by-one — the MIG would heal
+    them)."""
+    cfg = _vm_config(count=2, extra_pc={'use_mig': True,
+                                        'run_duration': 3600})
+    record = gcp_provision.run_instances('us-central1', 'mg1', cfg)
+    assert len(record.created_instance_ids) == 2
+    # Template carries no-reservation affinity; resize request carries
+    # the DWS run duration.
+    template = fake_api.templates['skytpu-it-mg1']
+    assert template['properties']['reservationAffinity'][
+        'consumeReservationType'] == 'NO_RESERVATION'
+    assert fake_api.resize_requests[0]['requestedRunDuration'][
+        'seconds'] == 3600
+    # The labeled VMs flow through the normal query path.
+    assert len(gcp_provision.query_instances(
+        'mg1', dict(cfg.provider_config))) == 2
+    info = gcp_provision.get_cluster_info('us-central1', 'mg1',
+                                          dict(cfg.provider_config))
+    assert info.num_instances == 2
+    gcp_provision.terminate_instances('mg1', dict(cfg.provider_config))
+    assert fake_api.migs == {}
+    assert fake_api.templates == {}
+
+
+def test_mig_rerun_is_idempotent(fake_api):
+    """A second run_instances with capacity already up must not grow
+    the group again."""
+    cfg = _vm_config(count=2, extra_pc={'use_mig': True})
+    gcp_provision.run_instances('us-central1', 'mg2', cfg)
+    n_requests = len(fake_api.resize_requests)
+    gcp_provision.run_instances('us-central1', 'mg2', cfg)
+    assert len(fake_api.resize_requests) == n_requests
+
+
+# --------------------------------------------------------------- volumes
+
+def test_volumes_created_attached_mounted(fake_api):
+    """Declared volumes: per-node PD created + attached, mount script
+    rides the startup script with a device wait loop."""
+    cfg = _vm_config(count=2, extra_pc={'volumes': [
+        {'name': 'data', 'size_gb': 200, 'mount_path': '/data'}]})
+    gcp_provision.run_instances('us-central1', 'vol1', cfg)
+    assert set(fake_api.disks) == {'data-0', 'data-1'}
+    assert fake_api.disks['data-0']['sizeGb'] == '200'
+    assert ('vol1-0', 'data') in fake_api.attachments
+    assert ('vol1-1', 'data') in fake_api.attachments
+    startup = [i['value'] for i in
+               fake_api.vms['vol1-0']['metadata']['items']
+               if i['key'] == 'startup-script'][0]
+    assert '/dev/disk/by-id/google-data' in startup
+    assert 'mkfs.ext4' in startup and 'mount' in startup
+    assert 'seq 1 60' in startup  # waits for the attach to land
+    gcp_provision.terminate_instances('vol1', dict(cfg.provider_config))
+    assert fake_api.disks == {}
+
+
+def test_kept_volume_survives_terminate(fake_api):
+    cfg = _vm_config(extra_pc={'volumes': [
+        {'name': 'keepme', 'size_gb': 50, 'mount_path': '/d',
+         'keep': True}]})
+    gcp_provision.run_instances('us-central1', 'vol2', cfg)
+    gcp_provision.terminate_instances('vol2', dict(cfg.provider_config))
+    assert 'keepme-0' in fake_api.disks
