@@ -209,6 +209,11 @@ def initialize_distributed(coordinator: Optional[str] = None,
     if num_processes <= 1 or not coordinator:
         return False
     import jax
+    # Idempotent: mesh_from_env and user code may both bootstrap.
+    state = getattr(getattr(jax._src, 'distributed', None),  # noqa: SLF001
+                    'global_state', None)
+    if state is not None and getattr(state, 'client', None) is not None:
+        return True
     jax.distributed.initialize(coordinator_address=coordinator,
                                num_processes=num_processes,
                                process_id=process_id)
