@@ -200,6 +200,25 @@ def create_app(engine_holder: Dict[str, Any]):
     return app
 
 
+def _watch_parent() -> None:
+    """Exit when the launching process dies (reparent to init): a
+    serve replica's server must die with its gang job, and a tooling
+    run's server must die with its shell — never linger holding the
+    accelerator. Hygiene contract: zero live framework processes after
+    the thing that started them is gone."""
+    import os
+    import time
+    interval = float(os.environ.get('SKYTPU_WATCHDOG_INTERVAL', '5'))
+
+    def _loop():
+        while True:
+            if os.getppid() == 1:
+                os._exit(0)  # noqa: SLF001 — the TPU thread never joins
+            time.sleep(interval)
+
+    threading.Thread(target=_loop, daemon=True).start()
+
+
 def main() -> None:
     from aiohttp import web
     parser = argparse.ArgumentParser()
@@ -210,7 +229,12 @@ def main() -> None:
     parser.add_argument('--max-seq-len', type=int, default=None)
     parser.add_argument('--checkpoint', default=None,
                         help='Orbax checkpoint dir with model params')
+    parser.add_argument('--no-exit-with-parent', action='store_true',
+                        help='Keep serving after the launcher exits '
+                             '(deliberate daemonization only)')
     args = parser.parse_args()
+    if not args.no_exit_with_parent:
+        _watch_parent()
 
     holder: Dict[str, Any] = {'loop': None}
 

@@ -8,6 +8,7 @@ stream its log. The server process is shared by all clients on a machine
 """
 import asyncio
 import json
+import logging
 import os
 from typing import Any, Dict
 
@@ -231,10 +232,36 @@ async def _recover_orphans(app):
     await asyncio.get_running_loop().run_in_executor(None, _recover)
 
 
+async def _state_dir_watchdog(app):
+    """A server whose state dir vanished is an orphan serving garbage
+    (a deleted temp HOME from tests/tooling, an uninstalled
+    deployment): exit instead of lingering forever. Hygiene contract:
+    zero live framework processes within ~60s of their state being
+    removed."""
+    import asyncio
+
+    from skypilot_tpu.utils import paths
+
+    state_dir = paths.state_dir()
+    interval = float(os.environ.get('SKYTPU_WATCHDOG_INTERVAL', '30'))
+
+    async def _watch():
+        while True:
+            await asyncio.sleep(interval)
+            if not os.path.isdir(state_dir):
+                logging.getLogger(__name__).warning(
+                    'state dir %s vanished; exiting', state_dir)
+                os._exit(0)  # noqa: SLF001 — run_app has no loop left
+
+    app['state_watchdog'] = asyncio.get_running_loop().create_task(
+        _watch())
+
+
 def create_app():
     from aiohttp import web
     app = web.Application(middlewares=auth.middlewares())
     app.on_startup.append(_recover_orphans)
+    app.on_startup.append(_state_dir_watchdog)
     app.router.add_get(f'{API_PREFIX}/health', _handle_health)
     app.router.add_get('/dashboard', _handle_dashboard)
     app.router.add_get('/dashboard/api/summary',

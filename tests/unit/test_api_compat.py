@@ -1,0 +1,104 @@
+"""Backward-compat matrix: old clients against the current server.
+
+Reference analog: tests/test_api_compatibility.py +
+tests/smoke_tests/backward_compat/test_backward_compat.py. The
+contract this pins down:
+- an OLD client (required fields only — optional fields were added
+  later) is accepted for EVERY command in the schema registry;
+- optional-field defaults are stable (an old client's behavior cannot
+  drift when the server grows new knobs);
+- a NEWER client's unknown field fails closed with a 400 naming the
+  field (never a 500 deep in a worker);
+- version-skew rejection is mutual and instructive (426 both ways) —
+  the handshake itself is covered in test_server_auth.
+"""
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from skypilot_tpu.server import app as app_mod
+from skypilot_tpu.server import payloads
+from skypilot_tpu.server import requests_db
+
+
+@pytest.fixture
+def server(monkeypatch):
+    requests_db.reset_for_tests()
+    with app_mod.ServerThread() as srv:
+        monkeypatch.setenv('SKYTPU_API_SERVER_URL', srv.url)
+        yield srv
+    requests_db.reset_for_tests()
+
+
+def _post(url, path, payload):
+    req = urllib.request.Request(
+        f'{url}/api/v1{path}', data=json.dumps(payload).encode(),
+        headers={'Content-Type': 'application/json'}, method='POST')
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return resp.status, json.loads(resp.read() or b'{}')
+
+
+def _minimal_value(field: payloads.Field):
+    """Synthesize a value an oldest-possible client would send."""
+    t = field.types[0]
+    if field.choices:
+        return field.choices[0]
+    return {str: 'x', int: 1, float: 1.0, bool: False, dict: {},
+            list: []}[t]
+
+
+def _minimal_payload(schema):
+    return {name: _minimal_value(field)
+            for name, field in schema.items() if field.required}
+
+
+def test_minimal_payload_accepted_for_every_command(server):
+    """Old clients send only the fields that existed when they
+    shipped; required-only must be accepted (202, queued) for every
+    command — no silent dependency on a newer optional field."""
+    for name, schema in payloads.SCHEMAS.items():
+        status, body = _post(server.url, f'/{name}',
+                             _minimal_payload(schema))
+        assert status == 202, (name, status, body)
+        assert body.get('request_id'), name
+
+
+def test_unknown_field_fails_closed_per_command(server):
+    """A newer client's field the server doesn't know yet: clean 400
+    naming the field for EVERY command, never a 500."""
+    for name, schema in payloads.SCHEMAS.items():
+        payload = _minimal_payload(schema)
+        payload['field_from_the_future'] = 1
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(server.url, f'/{name}', payload)
+        assert err.value.code == 400, name
+        body = json.loads(err.value.read())
+        assert any('field_from_the_future' in e
+                   for e in body['errors']), name
+
+
+def test_optional_defaults_are_stable():
+    """The defaults an old client relies on. Changing one silently
+    changes every deployed old client's behavior — this list must only
+    change with an API_VERSION bump."""
+    launch = payloads.SCHEMAS['launch']
+    assert launch['dryrun'].default is False
+    assert launch['detach_run'].default is False
+    assert launch['retry_until_up'].default is False
+    assert launch['minimize'].default == 'COST'
+    status = payloads.SCHEMAS['status']
+    assert status['refresh'].default is False
+    assert status['cluster_names'].required is False
+
+
+def test_validated_payload_fills_old_client_gaps():
+    """validate() must materialize defaults for fields an old client
+    never sent, so handlers see a complete payload."""
+    body, errors = payloads.validate('launch', {
+        'task': {'run': 'true'}, 'cluster_name': 'c'})
+    assert errors == []
+    assert body['dryrun'] is False
+    assert body['minimize'] == 'COST'
+    assert body['envs'] is None or isinstance(body['envs'], dict)

@@ -201,3 +201,71 @@ class TestRuntimeDependencySetup:
                            match='apt lock held'):
             provisioner.setup_runtime_dependencies([runner], retries=2,
                                                    retry_gap=0.0)
+
+
+class TestServerWatchdogs:
+    """Framework daemons must not outlive what started them (r2
+    finding: inference/API servers leaked from deleted temp HOMEs)."""
+
+    def test_api_server_exits_when_state_dir_vanishes(self, tmp_path):
+        import shutil
+        import urllib.request
+        home = tmp_path / 'wdhome'
+        (home / '.skytpu').mkdir(parents=True)
+        env = {**os.environ, 'HOME': str(home),
+               'SKYTPU_STATE_DIR': str(home / '.skytpu'),
+               'SKYTPU_WATCHDOG_INTERVAL': '0.3',
+               'SKYTPU_API_TOKEN': ''}
+        port = 19473
+        proc = subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.server.app', '--port',
+             str(port)], env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        try:
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                try:
+                    urllib.request.urlopen(
+                        f'http://127.0.0.1:{port}/api/v1/health',
+                        timeout=1).read()
+                    break
+                except OSError:
+                    time.sleep(0.3)
+            else:
+                raise TimeoutError('server never became healthy')
+            shutil.rmtree(home / '.skytpu')
+            deadline = time.time() + 15
+            while time.time() < deadline and proc.poll() is None:
+                time.sleep(0.2)
+            assert proc.poll() is not None, \
+                'server lingered after its state dir vanished'
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait()
+
+    def test_inference_server_exits_with_parent(self, tmp_path):
+        """The server is started by a short-lived wrapper; when the
+        wrapper dies the server must exit (ppid watch), not hold the
+        accelerator forever."""
+        marker = tmp_path / 'server.pid'
+        wrapper = (
+            'import subprocess, sys, os\n'
+            f'p = subprocess.Popen([sys.executable, "-m", '
+            f'"skypilot_tpu.inference.server", "--model", "tiny", '
+            f'"--port", "19474"])\n'
+            f'open({str(marker)!r}, "w").write(str(p.pid))\n'
+            # Wrapper exits immediately; the server reparents to init.
+        )
+        env = {**os.environ, 'SKYTPU_WATCHDOG_INTERVAL': '0.3',
+               'JAX_PLATFORMS': 'cpu'}
+        subprocess.run([sys.executable, '-c', wrapper], env=env,
+                       check=True, cwd='/root/repo')
+        pid = int(marker.read_text())
+        deadline = time.time() + 20
+        while time.time() < deadline and _alive(pid):
+            time.sleep(0.2)
+        alive = _alive(pid)
+        if alive:
+            os.kill(pid, signal.SIGKILL)
+        assert not alive, 'inference server lingered after parent died'
