@@ -147,22 +147,87 @@ async def _handle_dashboard_summary(request):
                                                      dashboard.summary))
 
 
-def _log_response(request, title: str, path: str):
-    """JS-polling log viewer page, or the raw tail for ?raw=1 (what
-    the page's poller fetches). The raw response carries the CURRENT
-    title (status included) in a header so the viewer's status chip
-    tracks RUNNING -> SUCCEEDED without a reload."""
+async def _handle_dashboard_detail(request):
+    """Per-entity detail documents (cluster job queue, managed-job
+    lifecycle, service replicas, per-cloud catalog)."""
     from aiohttp import web
 
     from skypilot_tpu.server import dashboard
-    text = dashboard.tail_file(path)
+    kind = request.match_info['kind']
+    key = request.match_info['key']
+    loop = asyncio.get_running_loop()
+    doc = await loop.run_in_executor(None, dashboard.detail, kind, key)
+    if doc is None:
+        raise web.HTTPNotFound(text=f'No such {kind[:-1]}: {key}')
+    return _json_response(doc)
+
+
+async def _handle_login_page(request):
+    from aiohttp import web
+
+    from skypilot_tpu import users
+    from skypilot_tpu.server import dashboard
+    if not users.auth_required():
+        raise web.HTTPSeeOther('/dashboard')  # open local mode
+    return web.Response(text=dashboard.login_page(),
+                        content_type='text/html')
+
+
+async def _handle_login(request):
+    """Exchange a valid API token for the browser session cookie."""
+    from aiohttp import web
+
+    from skypilot_tpu import users
+    try:
+        body = await request.json()
+        token = str(body.get('token', ''))
+    except Exception:  # noqa: BLE001
+        raise web.HTTPBadRequest(text='need {"token": ...}')
+    if users.auth_required() and users.user_for_token(token) is None:
+        raise web.HTTPUnauthorized(text='invalid token')
+    resp = _json_response({'ok': True})
+    resp.set_cookie(auth.TOKEN_COOKIE, token, httponly=True,
+                    samesite='Lax', max_age=7 * 24 * 3600)
+    return resp
+
+
+async def _handle_logout(request):
+    from aiohttp import web
+    resp = web.HTTPSeeOther('/dashboard/login')
+    resp.del_cookie(auth.TOKEN_COOKIE)
+    return resp
+
+
+def _log_response(request, title: str, path: str):
+    """JS-polling log viewer page, or the raw INCREMENTAL tail for
+    ?raw=1&offset=N (the page's follow poller appends only new bytes;
+    X-Log-Offset carries the next offset). The raw response also
+    carries the CURRENT title (status included) in a header so the
+    viewer's status chip tracks RUNNING -> SUCCEEDED without a
+    reload."""
+    from aiohttp import web
+
+    from skypilot_tpu.server import dashboard
     if request.query.get('raw'):
+        try:
+            offset = int(request.query.get('offset', '0'))
+        except ValueError:
+            offset = 0
+        chunk = dashboard.read_from(path, offset)
         # HTTP headers are latin-1; task names may not be.
         safe_title = title.encode('ascii', 'replace').decode()
-        return web.Response(text=text, content_type='text/plain',
-                            headers={'X-Log-Title': safe_title})
-    return web.Response(text=dashboard.log_page(title, text),
-                        content_type='text/html')
+        return web.Response(
+            text=chunk['text'], content_type='text/plain',
+            headers={'X-Log-Title': safe_title,
+                     'X-Log-Offset': str(chunk['offset']),
+                     'X-Log-Size': str(chunk['size'])})
+    # Initial page load: a bounded tail, with the poller continuing
+    # from its end.
+    text = dashboard.tail_file(path)
+    chunk = dashboard.read_from(path, 0, limit=0)
+    return web.Response(
+        text=dashboard.log_page(title, text, offset=chunk['size']),
+        content_type='text/html')
 
 
 async def _handle_request_log(request):
@@ -264,8 +329,13 @@ def create_app():
     app.on_startup.append(_state_dir_watchdog)
     app.router.add_get(f'{API_PREFIX}/health', _handle_health)
     app.router.add_get('/dashboard', _handle_dashboard)
+    app.router.add_get('/dashboard/login', _handle_login_page)
+    app.router.add_post('/dashboard/api/login', _handle_login)
+    app.router.add_get('/dashboard/logout', _handle_logout)
     app.router.add_get('/dashboard/api/summary',
                        _handle_dashboard_summary)
+    app.router.add_get('/dashboard/api/{kind}/{key}',
+                       _handle_dashboard_detail)
     app.router.add_get('/dashboard/requests/{request_id}/log',
                        _handle_request_log)
     app.router.add_get('/dashboard/jobs/{job_id}/log', _handle_job_log)

@@ -18,8 +18,13 @@ from skypilot_tpu.users import permission
 API_VERSION = 1
 VERSION_HEADER = 'X-Skytpu-Api-Version'
 
-# Paths every client may hit without auth (health is the handshake).
-_OPEN_PATHS = ('/api/v1/health',)
+# Paths every client may hit without auth (health is the handshake;
+# the login pair is how browsers GET a credential in the first place).
+_OPEN_PATHS = ('/api/v1/health', '/dashboard/login',
+               '/dashboard/api/login')
+
+# Browser session cookie set by /dashboard/api/login (HttpOnly).
+TOKEN_COOKIE = 'skytpu_token'
 
 
 def _token_from_request(request) -> Optional[str]:
@@ -33,7 +38,8 @@ def _token_from_request(request) -> Optional[str]:
             return password or None
         except (ValueError, UnicodeDecodeError):
             return None
-    return None
+    # Browsers: the login cookie (dashboard pages and their fetches).
+    return request.cookies.get(TOKEN_COOKIE)
 
 
 def middlewares():
@@ -74,6 +80,13 @@ def middlewares():
             return await handler(request)
         user = users.user_for_token(_token_from_request(request))
         if user is None:
+            # A human loading a dashboard page gets the login page,
+            # not a bare 401 (API fetches under /dashboard/api keep
+            # the 401 so the SPA can redirect itself).
+            if (request.method == 'GET'
+                    and request.path.startswith('/dashboard')
+                    and not request.path.startswith('/dashboard/api')):
+                raise web.HTTPSeeOther('/dashboard/login')
             raise web.HTTPUnauthorized(
                 text='Missing or invalid API token.',
                 headers={'WWW-Authenticate': 'Bearer'})

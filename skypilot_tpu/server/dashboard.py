@@ -1,23 +1,28 @@
 """Dashboard: single-file SPA served by the API server.
 
 Reference analog: sky/dashboard/src/ (15.4k-LoC Next.js app with
-clusters/jobs/services/infra pages and an xterm log viewer). Ours is
-a dependency-free single-file app — the server renders one HTML shell
-with the initial state embedded, and vanilla JS re-fetches
-`/dashboard/api/summary` every few seconds for live tables plus a
-polling log viewer with follow. No build step: the whole UI ships in
-this module, works from `tsky api start` with zero assets.
+clusters/jobs/services/infra pages and an xterm log viewer). Ours is a
+dependency-free single-file app — the server renders one HTML shell
+with the initial state embedded, and vanilla JS hash-routes between
+list views and per-entity DETAIL pages (cluster job queue, managed-job
+lifecycle, service replicas, per-cloud catalog), re-fetching
+`/dashboard/api/*` for live data. Logs stream incrementally: the
+viewer polls with a byte offset and appends only the new tail. With
+token auth enabled, browsers authenticate through /dashboard/login
+(HttpOnly cookie); API clients keep using bearer headers. No build
+step: the whole UI ships in this module, works from `tsky api start`
+with zero assets.
 """
 import json
 import os
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 import skypilot_tpu
 from skypilot_tpu.server import requests_db
 
 
 def summary() -> Dict[str, Any]:
-    """Everything the SPA shows, in one JSON document."""
+    """Everything the SPA's list views show, in one JSON document."""
     from skypilot_tpu import state as cluster_state
     clusters = [{
         'name': r['name'], 'workspace': r['workspace'],
@@ -73,17 +78,168 @@ def summary() -> Dict[str, Any]:
             'infra': infra}
 
 
+# --- detail documents (one JSON per entity page) ---------------------------
+
+def _cluster_detail(name: str) -> Optional[Dict[str, Any]]:
+    from skypilot_tpu import state as cluster_state
+    rec = cluster_state.get_cluster_from_name(name)
+    if rec is None:
+        return None
+    out: Dict[str, Any] = {
+        'kind': 'cluster', 'name': name,
+        'fields': {
+            'status': rec['status'].value,
+            'workspace': rec['workspace'],
+            'resources': rec['resources_str'],
+            'nodes': rec['num_nodes'],
+            'autostop': rec.get('autostop_str') or '-',
+            'launched': rec.get('launched_at') or '-',
+        },
+    }
+    # The cluster's own job queue (skylet job table), newest first.
+    try:
+        handle = rec['handle']
+        from skypilot_tpu.skylet import job_lib
+        queue = job_lib.get_jobs(handle.runtime_dir)
+        out['rows'] = {
+            'title': 'job queue',
+            'columns': ['id', 'name', 'status', 'exit_code',
+                        'submitted'],
+            'items': [{
+                'id': j['job_id'], 'name': j.get('name') or '-',
+                'status': j['status'].value,
+                'exit_code': j.get('exit_code'),
+                'submitted': j.get('submitted_at') or '-',
+            } for j in reversed(queue)],
+        }
+    except Exception:  # noqa: BLE001 — remote/downed clusters
+        out['rows'] = {'title': 'job queue',
+                       'columns': ['id', 'name', 'status'],
+                       'items': []}
+    return out
+
+
+def _job_detail(job_id: str) -> Optional[Dict[str, Any]]:
+    try:
+        jid = int(job_id)
+    except ValueError:
+        return None
+    from skypilot_tpu.jobs import state as jobs_state
+    rec = jobs_state.get_job(jid)
+    if rec is None:
+        return None
+    return {
+        'kind': 'job', 'name': f'managed job {jid}',
+        'fields': {
+            'name': rec['name'],
+            'status': rec['status'].value,
+            'recoveries': rec['recovery_count'],
+            'cluster': rec.get('cluster_name') or '-',
+            'submitted': rec.get('submitted_at') or '-',
+        },
+        'log': f'/dashboard/jobs/{jid}/log',
+    }
+
+
+def _service_detail(name: str) -> Optional[Dict[str, Any]]:
+    import urllib.parse
+    from skypilot_tpu.serve import serve_state
+    rec = serve_state.get_service(name)
+    if rec is None:
+        return None
+    replicas = []
+    try:
+        replicas = serve_state.get_replicas(name)
+    except Exception:  # noqa: BLE001
+        pass
+    return {
+        'kind': 'service', 'name': name,
+        'fields': {
+            'status': rec['status'].value,
+            'endpoint': f'http://127.0.0.1:{rec["lb_port"]}',
+            'policy': str(rec.get('policy') or '-'),
+        },
+        'rows': {
+            'title': 'replicas',
+            'columns': ['id', 'status', 'cluster', 'launched'],
+            'items': [{
+                'id': r.get('replica_id'),
+                'status': (r['status'].value
+                           if hasattr(r.get('status'), 'value')
+                           else str(r.get('status'))),
+                'cluster': r.get('cluster_name') or '-',
+                'launched': r.get('launched_at') or '-',
+            } for r in replicas],
+        },
+        'log': ('/dashboard/services/'
+                + urllib.parse.quote(str(name), safe='') + '/log'),
+    }
+
+
+def _infra_detail(cloud: str) -> Optional[Dict[str, Any]]:
+    from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+    if cloud not in CLOUD_REGISTRY.names():
+        return None
+    enabled = False
+    try:
+        from skypilot_tpu import check as check_lib
+        enabled = cloud in set(
+            check_lib.get_cached_enabled_clouds_or_refresh())
+    except Exception:  # noqa: BLE001
+        pass
+    items: List[Dict[str, Any]] = []
+    try:
+        from skypilot_tpu.catalog import common as cat_common
+        df = cat_common.read_catalog(cloud, 'vms')
+        for row in list(df.itertuples())[:200]:
+            info = cat_common.vm_row_to_info(cloud, row)
+            items.append({
+                'instance_type': info.instance_type,
+                'accelerators': (f'{info.accelerator_name}:'
+                                 f'{info.accelerator_count:g}'
+                                 if info.accelerator_name else '-'),
+                'cpus': info.cpus, 'memory_gb': info.memory_gb,
+                'price': f'$ {info.price:.2f}',
+                'region': info.region,
+            })
+    except Exception:  # noqa: BLE001 — catalog-less clouds (k8s, ssh)
+        pass
+    return {
+        'kind': 'infra', 'name': cloud,
+        'fields': {'enabled': 'enabled' if enabled else 'disabled',
+                   'offerings': len(items)},
+        'rows': {'title': 'catalog', 'columns': [
+            'instance_type', 'accelerators', 'cpus', 'memory_gb',
+            'price', 'region'], 'items': items},
+    }
+
+
+_DETAIL_FNS = {
+    'clusters': _cluster_detail,
+    'jobs': _job_detail,
+    'services': _service_detail,
+    'infra': _infra_detail,
+}
+
+
+def detail(kind: str, key: str) -> Optional[Dict[str, Any]]:
+    fn = _DETAIL_FNS.get(kind)
+    return fn(key) if fn is not None else None
+
+
 _CSS = """
 body{margin:0;font:13px/1.5 -apple-system,'Segoe UI',sans-serif;
      background:#0d1117;color:#c9d1d9}
 header{display:flex;align-items:baseline;gap:16px;padding:10px 20px;
        background:#161b22;border-bottom:1px solid #30363d}
 h1{font-size:16px;margin:0;color:#e6edf3}
+h2{font-size:14px;margin:18px 0 4px;color:#e6edf3}
 #ver{color:#8b949e;font-size:12px}
 nav{display:flex;gap:4px;margin-left:auto}
-nav button{background:none;border:none;color:#8b949e;padding:6px 12px;
-           cursor:pointer;border-radius:6px;font-size:13px}
+nav button,#logout{background:none;border:none;color:#8b949e;
+    padding:6px 12px;cursor:pointer;border-radius:6px;font-size:13px}
 nav button.active{background:#21262d;color:#e6edf3}
+#logout{color:#484f58}
 main{padding:16px 20px;max-width:1100px}
 table{border-collapse:collapse;width:100%;margin-top:8px}
 th{font-size:11px;text-transform:uppercase;letter-spacing:.05em;
@@ -91,6 +247,7 @@ th{font-size:11px;text-transform:uppercase;letter-spacing:.05em;
    border-bottom:1px solid #30363d}
 td{padding:6px 10px;border-bottom:1px solid #21262d}
 tr:hover td{background:#161b22}
+tr.click{cursor:pointer}
 .chip{display:inline-block;padding:1px 8px;border-radius:10px;
       font-size:11px;font-weight:600}
 .ok{background:#1a3524;color:#3fb950}.bad{background:#3d1418;
@@ -99,17 +256,28 @@ tr:hover td{background:#161b22}
 a{color:#58a6ff;text-decoration:none}
 .empty{color:#484f58;padding:14px 10px}
 #updated{color:#484f58;font-size:11px;margin-top:14px}
+dl{display:grid;grid-template-columns:140px 1fr;gap:4px 14px;
+   margin:10px 0;max-width:560px}
+dt{color:#8b949e}
+dd{margin:0;color:#e6edf3}
+.crumb{color:#8b949e;font-size:12px;margin-bottom:6px}
 """
 
 _JS = """
-const OK=['UP','READY','RUNNING','SUCCEEDED'],
+const OK=['UP','READY','RUNNING','SUCCEEDED','enabled'],
       BAD=['FAILED','FAILED_NO_RESOURCE','FAILED_CONTROLLER','NOT_READY'],
       TABS={clusters:['name','workspace','status','resources','nodes'],
             jobs:['id','name','status','recoveries','log'],
             services:['name','status','endpoint','log'],
             requests:['id','name','status','log'],
-            infra:['cloud','enabled']};
-let state=window.__initial__, tab='clusters';
+            infra:['cloud','enabled']},
+      DETAIL_KEY={clusters:'name',jobs:'id',services:'name',
+                  infra:'cloud'};
+let state=window.__initial__;
+function route(){
+  const h=(location.hash||'#/clusters').slice(2).split('/');
+  return {tab:h[0]||'clusters',
+          key:h.length>1?decodeURIComponent(h.slice(1).join('/')):null}}
 function chip(v){const s=String(v);
   const cls=OK.includes(s)?'ok':BAD.includes(s)?'bad':
     ['PENDING','PROVISIONING','RECOVERING','STARTING','INIT','STOPPED']
@@ -118,17 +286,18 @@ function chip(v){const s=String(v);
   e.textContent=s;return e}
 function cell(col,v){const td=document.createElement('td');
   if(col==='status')td.appendChild(chip(v));
-  else if(col==='enabled'){const e=document.createElement('span');
-    e.className='chip '+(v?'ok':'dim');
-    e.textContent=v?'enabled':'disabled';td.appendChild(e)}
+  else if(col==='enabled')td.appendChild(chip(v?'enabled':'disabled'));
   else if(col==='log'){const a=document.createElement('a');
-    a.href=v;a.textContent='view';td.appendChild(a)}
+    a.href=v;a.textContent='view';
+    a.addEventListener('click',e=>e.stopPropagation());
+    td.appendChild(a)}
   else if(col==='endpoint'){const a=document.createElement('a');
-    a.href=v;a.textContent=v;td.appendChild(a)}
+    a.href=v;a.textContent=v;
+    a.addEventListener('click',e=>e.stopPropagation());
+    td.appendChild(a)}
   else td.textContent=v==null?'':v;
   return td}
-function render(){
-  const cols=TABS[tab],rows=state[tab]||[];
+function makeTable(cols,rows,clickTab){
   const table=document.createElement('table');
   const hr=document.createElement('tr');
   cols.forEach(c=>{const th=document.createElement('th');
@@ -136,22 +305,69 @@ function render(){
   table.appendChild(hr);
   rows.forEach(r=>{const tr=document.createElement('tr');
     cols.forEach(c=>tr.appendChild(cell(c,r[c])));
+    if(clickTab&&DETAIL_KEY[clickTab]){tr.className='click';
+      tr.addEventListener('click',()=>{location.hash=
+        '#/'+clickTab+'/'+encodeURIComponent(r[DETAIL_KEY[clickTab]])})}
     table.appendChild(tr)});
+  return table}
+function renderList(tab){
   const m=document.getElementById('content');m.innerHTML='';
-  if(rows.length)m.appendChild(table);
+  const rows=state[tab]||[];
+  if(rows.length)m.appendChild(makeTable(TABS[tab],rows,tab));
   else{const d=document.createElement('div');d.className='empty';
-    d.textContent='nothing here yet';m.appendChild(d)}
-  document.getElementById('updated').textContent=
-    'updated '+new Date().toLocaleTimeString();
+    d.textContent='nothing here yet';m.appendChild(d)}}
+function renderDetail(doc,tab){
+  const m=document.getElementById('content');m.innerHTML='';
+  const crumb=document.createElement('div');crumb.className='crumb';
+  const back=document.createElement('a');back.href='#/'+tab;
+  back.textContent='← '+tab;crumb.appendChild(back);
+  m.appendChild(crumb);
+  const h=document.createElement('h2');h.textContent=doc.name;
+  m.appendChild(h);
+  const dl=document.createElement('dl');
+  Object.entries(doc.fields||{}).forEach(([k,v])=>{
+    const dt=document.createElement('dt');dt.textContent=k;
+    const dd=document.createElement('dd');
+    if(k==='status'||k==='enabled')dd.appendChild(chip(v));
+    else if(k==='endpoint'){const a=document.createElement('a');
+      a.href=v;a.textContent=v;dd.appendChild(a)}
+    else dd.textContent=v==null?'':v;
+    dl.appendChild(dt);dl.appendChild(dd)});
+  m.appendChild(dl);
+  if(doc.log){const p=document.createElement('p');
+    const a=document.createElement('a');a.href=doc.log;
+    a.textContent='controller log';p.appendChild(a);m.appendChild(p)}
+  if(doc.rows){const h2=document.createElement('h2');
+    h2.textContent=doc.rows.title;m.appendChild(h2);
+    if(doc.rows.items.length)
+      m.appendChild(makeTable(doc.rows.columns,doc.rows.items,null));
+    else{const d=document.createElement('div');d.className='empty';
+      d.textContent='nothing here yet';m.appendChild(d)}}}
+async function render(){
+  const {tab,key}=route();
   document.querySelectorAll('nav button').forEach(b=>
     b.classList.toggle('active',b.dataset.tab===tab));
+  if(key){
+    try{const r=await fetch('/dashboard/api/'+tab+'/'+
+        encodeURIComponent(key));
+      if(r.status===401){location.href='/dashboard/login';return}
+      if(r.ok){renderDetail(await r.json(),tab)}
+      else{const m=document.getElementById('content');
+        m.innerHTML='<div class="empty">not found</div>'}}
+    catch(e){}
+  }else{renderList(tab)}
+  document.getElementById('updated').textContent=
+    'updated '+new Date().toLocaleTimeString();
 }
-function pick(t){tab=t;render()}
 async function refresh(){
   try{const r=await fetch('/dashboard/api/summary');
+    if(r.status===401){location.href='/dashboard/login';return}
     if(r.ok){state=await r.json();render()}}catch(e){}}
 document.querySelectorAll('nav button').forEach(b=>
-  b.addEventListener('click',()=>pick(b.dataset.tab)));
+  b.addEventListener('click',()=>{location.hash='#/'+b.dataset.tab}));
+window.addEventListener('hashchange',render);
+document.getElementById('logout').addEventListener('click',()=>{
+  location.href='/dashboard/logout'});
 render();setInterval(refresh,5000);
 """
 
@@ -172,10 +388,53 @@ def page() -> str:
         f'<style>{_CSS}</style></head><body>'
         f'<header><h1>skypilot-tpu</h1>'
         f'<span id="ver">v{skypilot_tpu.__version__}</span>'
-        f'<nav>{tabs}</nav></header>'
+        f'<nav>{tabs}</nav>'
+        '<button id="logout" title="log out">logout</button></header>'
         '<main><div id="content"></div><div id="updated"></div></main>'
         f'<script>window.__initial__={initial};{_JS}</script>'
         '</body></html>')
+
+
+# --- login page -------------------------------------------------------------
+
+_LOGIN_CSS = """
+body{margin:0;display:grid;place-items:center;height:100vh;
+     font:13px/1.5 -apple-system,'Segoe UI',sans-serif;
+     background:#0d1117;color:#c9d1d9}
+form{background:#161b22;border:1px solid #30363d;border-radius:8px;
+     padding:28px 32px;display:flex;flex-direction:column;gap:12px;
+     min-width:300px}
+h1{font-size:15px;margin:0;color:#e6edf3}
+input{background:#0d1117;border:1px solid #30363d;border-radius:6px;
+      color:#e6edf3;padding:8px 10px;font-size:13px}
+button{background:#238636;border:none;border-radius:6px;color:#fff;
+       padding:8px;cursor:pointer;font-size:13px}
+#err{color:#f85149;font-size:12px;min-height:16px;margin:0}
+"""
+
+_LOGIN_JS = """
+document.querySelector('form').addEventListener('submit',async e=>{
+  e.preventDefault();
+  const token=document.getElementById('token').value.trim();
+  const r=await fetch('/dashboard/api/login',{method:'POST',
+    headers:{'Content-Type':'application/json'},
+    body:JSON.stringify({token})});
+  if(r.ok){location.href='/dashboard'}
+  else{document.getElementById('err').textContent=
+    'invalid token';}
+});
+"""
+
+
+def login_page() -> str:
+    return (
+        '<!doctype html><html><head><title>skypilot-tpu login</title>'
+        f'<style>{_LOGIN_CSS}</style></head><body>'
+        '<form><h1>skypilot-tpu</h1>'
+        '<input id="token" type="password" placeholder="API token" '
+        'autofocus>'
+        '<p id="err"></p><button type="submit">Sign in</button></form>'
+        f'<script>{_LOGIN_JS}</script></body></html>')
 
 
 # --- log viewer -------------------------------------------------------------
@@ -190,6 +449,25 @@ def tail_file(path: str, limit: int = 200_000) -> str:
             return f.read().decode('utf-8', errors='replace')
     except FileNotFoundError:
         return '(no log yet)'
+
+
+def read_from(path: str, offset: int, limit: int = 500_000
+              ) -> Dict[str, Any]:
+    """Incremental tail: bytes [offset, offset+limit) + the new offset
+    (the follow-mode poller appends only what's new; a truncated/
+    rotated file resets to a full tail)."""
+    try:
+        with open(path, 'rb') as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            if offset > size:  # truncated/rotated underneath us
+                offset = 0
+            f.seek(offset)
+            data = f.read(limit)
+            return {'text': data.decode('utf-8', errors='replace'),
+                    'offset': offset + len(data), 'size': size}
+    except FileNotFoundError:
+        return {'text': '', 'offset': 0, 'size': 0}
 
 
 _LOG_CSS = """
@@ -208,21 +486,26 @@ _LOG_JS = """
 const pre=document.getElementById('log'),
       follow=document.getElementById('follow'),
       titleEl=document.getElementById('title');
+let offset=window.__offset__;
 async function poll(){
-  try{const r=await fetch(location.pathname+'?raw=1');
+  try{const r=await fetch(location.pathname+'?raw=1&offset='+offset);
+    if(r.status===401){location.href='/dashboard/login';return}
     if(r.ok){const t=await r.text();
       const title=r.headers.get('X-Log-Title');
       if(title&&title!==titleEl.textContent){
         titleEl.textContent=title;document.title=title}
-      if(t!==pre.textContent){pre.textContent=t;
-        if(follow.checked)window.scrollTo(0,document.body.scrollHeight)}}}
+      const next=parseInt(r.headers.get('X-Log-Offset')||offset);
+      if(next<offset){pre.textContent=''}  // rotated: start over
+      if(t){pre.textContent+=t;
+        if(follow.checked)window.scrollTo(0,document.body.scrollHeight)}
+      offset=next}}
   catch(e){}}
-setInterval(poll,2000);
+setInterval(poll,1500);
 if(follow.checked)window.scrollTo(0,document.body.scrollHeight);
 """
 
 
-def log_page(title: str, text: str) -> str:
+def log_page(title: str, text: str, offset: int = 0) -> str:
     import html as html_lib
     return (
         '<!doctype html><html><head>'
@@ -234,4 +517,5 @@ def log_page(title: str, text: str) -> str:
         '<input type="checkbox" id="follow" checked> follow</label>'
         '</header>'
         f'<pre id="log">{html_lib.escape(text)}</pre>'
-        f'<script>{_LOG_JS}</script></body></html>')
+        f'<script>window.__offset__={int(offset)};{_LOG_JS}'
+        '</script></body></html>')
