@@ -612,12 +612,25 @@ def api_info():
               help='API server URL (e.g. http://host:46590).')
 @click.option('--token', default=None,
               help='Bearer token; prompted for when omitted.')
-def api_login(endpoint, token):
+@click.option('--browser', is_flag=True,
+              help='Sign in through the server dashboard in a browser '
+                   'instead of pasting a token (reference '
+                   'sky/client/oauth.py flow).')
+def api_login(endpoint, token, browser):
     """Store API server endpoint + token in the user config
     (reference sky api login / client/oauth.py)."""
     import os as _os
     import yaml as _yaml
     from skypilot_tpu import config as config_lib
+    if browser and token is None:
+        from skypilot_tpu import exceptions as _exc
+        from skypilot_tpu.client import oauth
+        from skypilot_tpu.client import sdk as _sdk
+        target = (endpoint or _sdk.api_server_url()).rstrip('/')
+        try:
+            token = oauth.browser_login(target) or None
+        except _exc.SkyTpuError as e:
+            raise click.ClickException(str(e))
     if token is None:
         token = click.prompt('API token', hide_input=True, default='',
                              show_default=False) or None

@@ -1,0 +1,69 @@
+"""Browser login flow for `tsky api login --browser`.
+
+Reference analog: sky/client/oauth.py (OAuth-proxy callback listener).
+The shape is the same localhost-callback dance: the CLI opens the
+server's `/dashboard/cli-auth?port=N` page in a browser, the user
+authenticates there (cookie login if not already signed in), and the
+server redirects to `http://127.0.0.1:N/callback?token=...` where the
+CLI's one-shot listener catches the credential. No retyping tokens
+into terminals, and the token never transits anything but the user's
+own browser and loopback.
+"""
+import http.server
+import threading
+import urllib.parse
+import webbrowser
+from typing import Optional
+
+from skypilot_tpu import exceptions
+
+_SUCCESS_PAGE = (b'<!doctype html><html><body style="font-family:'
+                 b'sans-serif;background:#0d1117;color:#c9d1d9;'
+                 b'display:grid;place-items:center;height:100vh">'
+                 b'<div>Logged in &mdash; you can close this tab and '
+                 b'return to the terminal.</div></body></html>')
+
+
+class _Callback(http.server.BaseHTTPRequestHandler):
+    token: Optional[str] = None
+    event: threading.Event
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        parsed = urllib.parse.urlsplit(self.path)
+        if parsed.path != '/callback':
+            self.send_error(404)
+            return
+        params = urllib.parse.parse_qs(parsed.query)
+        type(self).token = params.get('token', [''])[0]
+        self.send_response(200)
+        self.send_header('Content-Type', 'text/html')
+        self.end_headers()
+        self.wfile.write(_SUCCESS_PAGE)
+        type(self).event.set()
+
+    def log_message(self, *args):  # quiet
+        del args
+
+
+def browser_login(endpoint: str, timeout: float = 180.0,
+                  open_browser=webbrowser.open) -> str:
+    """Run the callback listener, open the auth page, return the
+    token the server hands back (empty string = open local mode)."""
+    handler = type('Handler', (_Callback,), {
+        'token': None, 'event': threading.Event()})
+    server = http.server.HTTPServer(('127.0.0.1', 0), handler)
+    port = server.server_address[1]
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    url = f'{endpoint.rstrip("/")}/dashboard/cli-auth?port={port}'
+    try:
+        open_browser(url)
+        print(f'Opening {url}\n(waiting for browser sign-in...)')
+        if not handler.event.wait(timeout):
+            raise exceptions.SkyTpuError(
+                f'Browser login timed out after {timeout:.0f}s; '
+                'use `tsky api login --token ...` instead.')
+        return handler.token or ''
+    finally:
+        server.shutdown()
+        thread.join(timeout=5)

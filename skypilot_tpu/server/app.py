@@ -167,9 +167,13 @@ async def _handle_login_page(request):
 
     from skypilot_tpu import users
     from skypilot_tpu.server import dashboard
+    # Post-login destination: dashboard paths only (no open redirect).
+    nxt = request.query.get('next', '/dashboard')
+    if not nxt.startswith('/dashboard') or nxt.startswith('//'):
+        nxt = '/dashboard'
     if not users.auth_required():
-        raise web.HTTPSeeOther('/dashboard')  # open local mode
-    return web.Response(text=dashboard.login_page(),
+        raise web.HTTPSeeOther(nxt)  # open local mode
+    return web.Response(text=dashboard.login_page(next_url=nxt),
                         content_type='text/html')
 
 
@@ -196,6 +200,28 @@ async def _handle_logout(request):
     resp = web.HTTPSeeOther('/dashboard/login')
     resp.del_cookie(auth.TOKEN_COOKIE)
     return resp
+
+
+async def _handle_cli_auth(request):
+    """Hand the signed-in browser user's token to a waiting CLI
+    (client/oauth.py): redirect to its loopback callback. Auth
+    middleware has already run, so an anonymous browser got bounced
+    through /dashboard/login first (with ?next= back here)."""
+    from aiohttp import web
+
+    from skypilot_tpu import users
+    try:
+        port = int(request.query['port'])
+        if not 0 < port < 65536:
+            raise ValueError
+    except (KeyError, ValueError):
+        raise web.HTTPBadRequest(text='need ?port=<cli callback port>')
+    import urllib.parse
+    user = request.get('user', users.DEFAULT_USER)
+    token = user.token or ''
+    raise web.HTTPFound(
+        f'http://127.0.0.1:{port}/callback?'
+        + urllib.parse.urlencode({'token': token}))
 
 
 def _log_response(request, title: str, path: str):
@@ -332,6 +358,7 @@ def create_app():
     app.router.add_get('/dashboard/login', _handle_login_page)
     app.router.add_post('/dashboard/api/login', _handle_login)
     app.router.add_get('/dashboard/logout', _handle_logout)
+    app.router.add_get('/dashboard/cli-auth', _handle_cli_auth)
     app.router.add_get('/dashboard/api/summary',
                        _handle_dashboard_summary)
     app.router.add_get('/dashboard/api/{kind}/{key}',

@@ -86,7 +86,9 @@ def middlewares():
             if (request.method == 'GET'
                     and request.path.startswith('/dashboard')
                     and not request.path.startswith('/dashboard/api')):
-                raise web.HTTPSeeOther('/dashboard/login')
+                import urllib.parse
+                nxt = urllib.parse.quote(request.path_qs, safe='')
+                raise web.HTTPSeeOther(f'/dashboard/login?next={nxt}')
             raise web.HTTPUnauthorized(
                 text='Missing or invalid API token.',
                 headers={'WWW-Authenticate': 'Bearer'})

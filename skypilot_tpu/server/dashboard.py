@@ -419,14 +419,14 @@ document.querySelector('form').addEventListener('submit',async e=>{
   const r=await fetch('/dashboard/api/login',{method:'POST',
     headers:{'Content-Type':'application/json'},
     body:JSON.stringify({token})});
-  if(r.ok){location.href='/dashboard'}
+  if(r.ok){location.href=window.__next__}
   else{document.getElementById('err').textContent=
     'invalid token';}
 });
 """
 
 
-def login_page() -> str:
+def login_page(next_url: str = '/dashboard') -> str:
     return (
         '<!doctype html><html><head><title>skypilot-tpu login</title>'
         f'<style>{_LOGIN_CSS}</style></head><body>'
@@ -434,7 +434,8 @@ def login_page() -> str:
         '<input id="token" type="password" placeholder="API token" '
         'autofocus>'
         '<p id="err"></p><button type="submit">Sign in</button></form>'
-        f'<script>{_LOGIN_JS}</script></body></html>')
+        f'<script>window.__next__={json.dumps(next_url)};{_LOGIN_JS}'
+        '</script></body></html>')
 
 
 # --- log viewer -------------------------------------------------------------

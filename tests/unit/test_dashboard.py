@@ -10,6 +10,7 @@ logs that append instead of refetching.
 import json
 import os
 import urllib.error
+import urllib.parse
 import urllib.request
 
 import pytest
@@ -65,7 +66,8 @@ class TestBrowserAuth:
         with pytest.raises(urllib.error.HTTPError) as err:
             _get(server.url, '/dashboard', follow=False)
         assert err.value.code == 303
-        assert err.value.headers['Location'] == '/dashboard/login'
+        assert err.value.headers['Location'].startswith(
+            '/dashboard/login')
         # The login page itself is reachable without credentials.
         resp = _get(server.url, '/dashboard/login')
         assert resp.status == 200
@@ -193,3 +195,63 @@ class TestIncrementalLogs:
                     f'/dashboard/requests/{request_id}/log'
                     f'?raw=1&offset={total}')
         assert int(resp.headers['X-Log-Size']) >= total
+
+
+class TestCliBrowserLogin:
+    """`tsky api login --browser`: the localhost-callback flow
+    (reference sky/client/oauth.py)."""
+
+    def test_cli_auth_redirects_token_to_callback(self, server):
+        _auth_on()
+        resp_err = None
+        try:
+            _get(server.url, '/dashboard/cli-auth?port=45555',
+                 cookie='skytpu_token=tok-admin', follow=False)
+        except urllib.error.HTTPError as e:
+            resp_err = e
+        assert resp_err is not None and resp_err.code == 302
+        assert resp_err.headers['Location'] == \
+            'http://127.0.0.1:45555/callback?token=tok-admin'
+
+    def test_anonymous_cli_auth_bounces_through_login_with_next(
+            self, server):
+        _auth_on()
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(server.url, '/dashboard/cli-auth?port=1234',
+                 follow=False)
+        assert err.value.code == 303
+        loc = err.value.headers['Location']
+        assert loc.startswith('/dashboard/login?next=')
+        assert 'cli-auth' in urllib.parse.unquote(loc)
+        # The login page embeds the destination for its JS.
+        page = _get(server.url, loc).read().decode()
+        assert '/dashboard/cli-auth?port=1234' in page
+
+    def test_open_redirect_rejected(self, server):
+        _auth_on()
+        page = _get(server.url,
+                    '/dashboard/login?next=https://evil.example'
+                    ).read().decode()
+        assert 'evil.example' not in page
+
+    def test_browser_login_end_to_end(self, server):
+        """The real client listener against the real server: the
+        'browser' is a urllib hop following the server's redirect to
+        the CLI's loopback callback."""
+        _auth_on()
+        from skypilot_tpu.client import oauth
+
+        def fake_browser(url):
+            # A signed-in browser visiting the cli-auth page.
+            import threading
+
+            def _go():
+                req = urllib.request.Request(
+                    url, headers={'Cookie': 'skytpu_token=tok-admin'})
+                urllib.request.urlopen(req, timeout=10).read()
+            threading.Thread(target=_go, daemon=True).start()
+            return True
+
+        token = oauth.browser_login(server.url, timeout=20,
+                                    open_browser=fake_browser)
+        assert token == 'tok-admin'
