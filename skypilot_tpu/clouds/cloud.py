@@ -23,6 +23,9 @@ class CloudCapability(enum.Enum):
     TPU = 'tpu'
     CUSTOM_IMAGE = 'custom_image'
     HOST_CONTROLLERS = 'host_controllers'
+    # Controller hosts the infra resurrects itself (k8s Deployments);
+    # reference HIGH_AVAILABILITY_CONTROLLERS (sky/clouds/cloud.py:32).
+    HA_CONTROLLERS = 'ha_controllers'
 
 
 class Cloud:

@@ -21,6 +21,7 @@ class Kubernetes(cloud.Cloud):
         cloud.CloudCapability.TPU,
         cloud.CloudCapability.CUSTOM_IMAGE,
         cloud.CloudCapability.HOST_CONTROLLERS,
+        cloud.CloudCapability.HA_CONTROLLERS,
         cloud.CloudCapability.STORAGE_MOUNT,
     })
     MAX_CLUSTER_NAME_LENGTH = 53  # pod-name suffix room under 63
@@ -46,6 +47,14 @@ class Kubernetes(cloud.Cloud):
             'image_id': resources.image_id,
             'labels': dict(resources.labels),
         }
+        # HA (Deployment-backed) controller hosts
+        # (reference HIGH_AVAILABILITY_CONTROLLERS).
+        overrides = resources.cluster_config_overrides
+        if overrides.get('ha'):
+            variables['ha'] = True
+            if overrides.get('recovery_command'):
+                variables['recovery_command'] = \
+                    overrides['recovery_command']
         gen = resources.tpu_gen
         if gen is not None:
             chips = resources.tpu_num_chips

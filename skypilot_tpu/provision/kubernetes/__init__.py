@@ -91,6 +91,47 @@ def _pod_manifest(config: common.ProvisionConfig, index: int,
     return manifest
 
 
+def _deployment_manifest(config: common.ProvisionConfig,
+                         cluster_name_on_cloud: str) -> Dict[str, Any]:
+    """HA controller host: a single-replica Deployment (Recreate) so
+    kubernetes resurrects the pod on node/container failure; the
+    recovery command re-primes the restarted pod (skylet restart +
+    controller crash-resume) before the steady-state sleep.
+
+    Reference analog: HIGH_AVAILABILITY_CONTROLLERS
+    (sky/clouds/cloud.py:32) + the ha_recovery re-run script in
+    sky/templates/kubernetes-ray.yml.j2.
+    """
+    nc = {**config.provider_config, **config.node_config}
+    pod = _pod_manifest(config, 0, cluster_name_on_cloud)
+    pod_spec = pod['spec']
+    labels = pod['metadata']['labels']
+    recovery = nc.get('recovery_command')
+    if recovery:
+        pod_spec['containers'][0]['command'] = [
+            '/bin/bash', '-c', f'({recovery}); sleep infinity']
+    # The Deployment owns restarts; the pod must not refuse them.
+    pod_spec['restartPolicy'] = 'Always'
+    return {
+        'apiVersion': 'apps/v1',
+        'kind': 'Deployment',
+        'metadata': {
+            'name': f'{cluster_name_on_cloud}-ha',
+            'labels': dict(labels),
+        },
+        'spec': {
+            'replicas': 1,
+            # Never two controllers at once (duplicate schedulers
+            # would double-launch jobs): kill-then-recreate.
+            'strategy': {'type': 'Recreate'},
+            'selector': {'matchLabels': {
+                CLUSTER_LABEL: cluster_name_on_cloud}},
+            'template': {'metadata': {'labels': dict(labels)},
+                         'spec': pod_spec},
+        },
+    }
+
+
 def run_instances(region: str, cluster_name_on_cloud: str,
                   config: common.ProvisionConfig) -> common.ProvisionRecord:
     del region  # k8s "region" is the context/namespace
@@ -98,6 +139,24 @@ def run_instances(region: str, cluster_name_on_cloud: str,
     existing = query_instances(cluster_name_on_cloud,
                                config.provider_config)
     created: List[str] = []
+    if config.provider_config.get('ha'):
+        if config.count != 1:
+            raise exceptions.ProvisionError(
+                'HA (Deployment-backed) clusters are single-node '
+                'controller hosts; got count='
+                f'{config.count}.')
+        if not any(s in ('running', 'pending')
+                   for s in existing.values()):
+            _kubectl(['apply', '-f', '-'], namespace=namespace,
+                     input_data=json.dumps(_deployment_manifest(
+                         config, cluster_name_on_cloud)))
+            created.append(f'{cluster_name_on_cloud}-ha')
+        return common.ProvisionRecord(
+            provider_name='kubernetes',
+            region=namespace, zone=None,
+            cluster_name_on_cloud=cluster_name_on_cloud,
+            head_instance_id=f'{cluster_name_on_cloud}-ha',
+            created_instance_ids=created)
     for i in range(config.count):
         name = _pod_name(cluster_name_on_cloud, i)
         if existing.get(name) in ('running', 'pending'):
@@ -168,6 +227,12 @@ def stop_instances(cluster_name_on_cloud: str,
 def terminate_instances(cluster_name_on_cloud: str,
                         provider_config: Dict[str, Any]) -> None:
     namespace = provider_config.get('namespace', 'default')
+    if provider_config.get('ha'):
+        # Deployment first or it would just heal the deleted pods.
+        _kubectl(['delete', 'deployments', '-l',
+                  f'{CLUSTER_LABEL}={cluster_name_on_cloud}',
+                  '--ignore-not-found=true', '--wait=false'],
+                 namespace=namespace)
     _kubectl(['delete', 'pods', '-l',
               f'{CLUSTER_LABEL}={cluster_name_on_cloud}',
               '--ignore-not-found=true', '--wait=false'],

@@ -54,14 +54,41 @@ def controller_mode(kind: str) -> str:
 
 def controller_resources(kind: str):
     """Resources for the controller cluster: config overrides merged
-    onto defaults (reference Controllers.controller_resources)."""
+    onto defaults (reference Controllers.controller_resources). With
+    `{kind}.controller.ha: true` the resources carry the HA cluster
+    overrides (Deployment-backed host + restart recovery command) for
+    clouds with the HA_CONTROLLERS capability — kubernetes."""
     from skypilot_tpu import config as config_lib
     from skypilot_tpu import resources as resources_lib
     spec = CONTROLLERS[kind]
     cfg = dict(spec.default_resources)
     cfg.update(config_lib.get_nested((kind, 'controller', 'resources'),
                                      default=None) or {})
-    return resources_lib.Resources.from_yaml_config(cfg)
+    res = resources_lib.Resources.from_yaml_config(cfg)
+    if config_lib.get_nested((kind, 'controller', 'ha'),
+                             default=False):
+        res = res.copy(_cluster_config_overrides={
+            **res.cluster_config_overrides,
+            'ha': True,
+            'recovery_command': ha_recovery_command(),
+        })
+    return res
+
+
+def ha_recovery_command() -> str:
+    """What a resurrected controller pod runs before steady state:
+    restart the skylet, then crash-resume every controller that was
+    mid-flight when the old pod died (reference ha_recovery script in
+    sky/templates/kubernetes-ray.yml.j2; resume machinery:
+    jobs/scheduler.recover_orphaned_controllers)."""
+    from skypilot_tpu.provision import provisioner
+    pkg = provisioner._PKG_REMOTE_DIR  # noqa: SLF001
+    return (f'export PYTHONPATH={pkg}:$PYTHONPATH; '
+            'nohup python3 -m skypilot_tpu.skylet.skylet '
+            '>/tmp/skytpu-ha-skylet.log 2>&1 & '
+            'python3 -c "from skypilot_tpu.jobs import scheduler; '
+            'scheduler.recover_orphaned_controllers()" '
+            '>/tmp/skytpu-ha-recover.log 2>&1 || true')
 
 
 def ensure_controller_cluster(kind: str):

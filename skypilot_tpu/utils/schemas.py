@@ -146,7 +146,8 @@ STORAGE_SCHEMA: Dict[str, Any] = {
     'properties': {
         'name': _STR,
         'source': _NULL_OK_STR,
-        'store': {'enum': ['gcs', 's3', 'azure', 'r2', 'local', None]},
+        'store': {'enum': ['gcs', 's3', 'azure', 'r2', 'cos', 'oci',
+                           'local', None]},
         'mode': {'enum': ['MOUNT', 'COPY', 'mount', 'copy']},
         'persistent': _BOOL,
     },
@@ -194,6 +195,8 @@ _CONTROLLER_SECTION = {
             'properties': {
                 'mode': {'enum': ['consolidated', 'dedicated']},
                 'resources': RESOURCES_SCHEMA,
+                # Deployment-backed controller host (kubernetes).
+                'ha': _BOOL,
             },
         },
         # 2-hop file-mount staging bucket (controller_utils).
@@ -201,7 +204,8 @@ _CONTROLLER_SECTION = {
             'type': 'object',
             'additionalProperties': False,
             'properties': {
-                'store': {'enum': ['gcs', 's3', 'azure', 'r2', 'local']},
+                'store': {'enum': ['gcs', 's3', 'azure', 'r2', 'cos',
+                                   'oci', 'local']},
                 'name': _STR,
             },
         },
@@ -240,6 +244,19 @@ CONFIG_SCHEMA: Dict[str, Any] = {
                 'network': _STR,
                 'subnetwork': _STR,
                 'use_internal_ips': _BOOL,
+                # MIG/DWS queued capacity + persistent-disk volumes.
+                'use_mig': _BOOL,
+                'run_duration': _INT,
+                'volumes': {'type': 'array', 'items': {
+                    'type': 'object',
+                    'additionalProperties': False,
+                    'properties': {
+                        'name': _STR,
+                        'size_gb': _INT,
+                        'type': _STR,
+                        'mount_path': _STR,
+                        'keep': _BOOL,
+                    }}},
             },
         },
         'aws': {
@@ -280,6 +297,42 @@ CONFIG_SCHEMA: Dict[str, Any] = {
             'type': 'object',
             'additionalProperties': False,
             'properties': {'endpoint_url': _STR},
+        },
+        'oci': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'compartment_id': _STR,
+                'subnet_id': _STR,
+                'image_id': _STR,
+                's3_endpoint_url': _STR,
+            },
+        },
+        'ibm': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'vpc_id': _STR,
+                'subnet_id': _STR,
+                'image_id': _STR,
+                'cos_endpoint_url': _STR,
+            },
+        },
+        'scp': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {'image_id': _STR},
+        },
+        'vsphere': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'template': _STR,
+                'resource_pool': _STR,
+                'datastore': _STR,
+                'customization_spec': _STR,
+                'ssh_user': _STR,
+            },
         },
         'ssh': {
             'type': 'object',

@@ -40,6 +40,21 @@ if args[0] == 'apply':
             len(os.listdir(state_dir)) + 1)
         with open(pod_path(manifest['metadata']['name']), 'w') as f:
             json.dump(manifest, f)
+    elif manifest['kind'] == 'Deployment':
+        # The deployment controller: materialize one template pod with
+        # a hash-suffixed name, as the real one would.
+        with open(os.path.join(state_dir,
+                               f'dep_{ns}__{manifest["metadata"]["name"]}.json'),
+                  'w') as f:
+            json.dump(manifest, f)
+        tmpl = manifest['spec']['template']
+        pod = {'apiVersion': 'v1', 'kind': 'Pod',
+               'metadata': {'name': manifest['metadata']['name'] + '-7f9c4d',
+                            'labels': tmpl['metadata']['labels']},
+               'spec': tmpl['spec'],
+               'status': {'phase': 'Running', 'podIP': '10.244.0.99'}}
+        with open(pod_path(pod['metadata']['name']), 'w') as f:
+            json.dump(pod, f)
     else:  # Service etc: record only
         with open(os.path.join(state_dir, f'svc_{manifest["metadata"]["name"]}'), 'w') as f:
             json.dump(manifest, f)
@@ -66,6 +81,15 @@ if args[:2] == ['delete', 'pods']:
     for p in load_pods():
         if match(p, selector):
             os.unlink(pod_path(p['metadata']['name']))
+    sys.exit(0)
+
+if args[:2] == ['delete', 'deployments']:
+    selector = args[args.index('-l') + 1]
+    for fn in list(os.listdir(state_dir)):
+        if fn.startswith(f'dep_{ns}__'):
+            dep = json.load(open(os.path.join(state_dir, fn)))
+            if match(dep, selector):
+                os.unlink(os.path.join(state_dir, fn))
     sys.exit(0)
 
 if args[0] == 'exec':
@@ -155,3 +179,68 @@ def test_command_runner_exec(fake_kubectl):
                               require_outputs=True)
     assert rc == 0
     assert 'hello-from-pod' in out
+
+
+def test_ha_controller_deployment(fake_kubectl):
+    """HA controller host: Deployment-backed (Recreate, replicas=1)
+    with the recovery command wrapping the steady-state sleep; the
+    materialized pod flows through the normal label-based query/info
+    paths, and terminate removes deployment + pod (deployment FIRST,
+    or it would heal the pod back)."""
+    cfg = common.ProvisionConfig(
+        provider_config={'namespace': 'default', 'ha': True,
+                         'recovery_command': 'echo recovered'},
+        authentication_config={},
+        node_config={'cpus': 4},
+        count=1)
+    record = k8s_provision.run_instances('default', 'hac', cfg)
+    assert record.created_instance_ids == ['hac-ha']
+    dep = json.load(open(
+        fake_kubectl / 'dep_default__hac-ha.json'))
+    assert dep['spec']['replicas'] == 1
+    assert dep['spec']['strategy'] == {'type': 'Recreate'}
+    command = dep['spec']['template']['spec']['containers'][0]['command']
+    assert '(echo recovered); sleep infinity' in command[-1]
+    assert dep['spec']['template']['spec']['restartPolicy'] == 'Always'
+    # The deployment's pod shows up through the normal paths.
+    statuses = k8s_provision.query_instances('hac',
+                                             dict(cfg.provider_config))
+    assert list(statuses.values()) == ['running']
+    info = k8s_provision.get_cluster_info('default', 'hac',
+                                          dict(cfg.provider_config))
+    assert info.get_head_instance() is not None
+    # Re-run is idempotent while the pod lives.
+    record2 = k8s_provision.run_instances('default', 'hac', cfg)
+    assert record2.created_instance_ids == []
+    k8s_provision.terminate_instances('hac', dict(cfg.provider_config))
+    assert k8s_provision.query_instances(
+        'hac', dict(cfg.provider_config)) == {}
+    assert not (fake_kubectl / 'dep_default__hac-ha.json').exists()
+
+
+def test_ha_controller_resources_carry_overrides(monkeypatch, tmp_path):
+    """jobs.controller.ha: true threads the HA overrides into the
+    controller resources (consumed by the k8s cloud's deploy vars)."""
+    import yaml
+    cfg_path = tmp_path / 'config.yaml'
+    cfg_path.write_text(yaml.safe_dump({
+        'jobs': {'controller': {'ha': True}}}))
+    monkeypatch.setenv('SKYTPU_CONFIG', str(cfg_path))
+    from skypilot_tpu import config as config_lib
+    config_lib.reload()
+    from skypilot_tpu.utils import controller_utils
+    res = controller_utils.controller_resources('jobs')
+    assert res.cluster_config_overrides['ha'] is True
+    assert 'recover_orphaned_controllers' in \
+        res.cluster_config_overrides['recovery_command']
+    from skypilot_tpu.clouds import kubernetes as k8s_cloud
+    # The optimizer's _make_launchable carries the overrides through
+    # explicitly; mirror that here.
+    launchable = res.copy(infra='kubernetes/default',
+                          instance_type='cpu4',
+                          _cluster_config_overrides=dict(
+                              res.cluster_config_overrides))
+    variables = k8s_cloud.Kubernetes().make_deploy_variables(
+        launchable, 'hac', 'default', None)
+    assert variables['ha'] is True
+    assert 'skylet' in variables['recovery_command']
