@@ -1,0 +1,150 @@
+"""Data-plane utilities: URL handling, parallel transfer fan-out,
+bucket inventory.
+
+Reference analog: sky/data/data_utils.py:1 (865 LoC: split_*_path URL
+parsing, parallel multipart upload pools, Rclone plumbing). The
+TPU-repo cut keeps the same capabilities over the CLI-driven stores:
+URL parsing for every supported scheme, a shared bounded-parallel
+fan-out with full error aggregation (used for many-file uploads and
+bucket-to-bucket sweeps), and bucket inventory helpers.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import os
+import subprocess
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+
+_SCHEMES = {
+    'gs': 'gcs', 'gcs': 'gcs', 's3': 's3', 'az': 'azure', 'r2': 'r2',
+    'cos': 'cos', 'oci': 'oci', 'local': 'local',
+}
+
+
+def is_cloud_url(path: str) -> bool:
+    scheme, sep, _ = path.partition('://')
+    return bool(sep) and scheme in _SCHEMES
+
+
+def split_bucket_url(url: str) -> Tuple[str, str, str]:
+    """'gs://bucket/a/b' -> ('gcs', 'bucket', 'a/b').
+
+    Reference analog: data_utils.split_s3_path / split_gcs_path /
+    split_az_path — one parser for every scheme instead of one
+    function per cloud.
+    """
+    scheme, sep, rest = url.partition('://')
+    if not sep or scheme not in _SCHEMES:
+        raise exceptions.StorageError(f'Not a bucket URL: {url!r}')
+    bucket, _, key = rest.partition('/')
+    if not bucket:
+        raise exceptions.StorageError(f'No bucket in URL: {url!r}')
+    return _SCHEMES[scheme], bucket, key
+
+
+def parallel_transfer(items: Iterable,
+                      fn: Callable,
+                      max_workers: int = 8,
+                      what: str = 'transfer') -> List:
+    """Run `fn(item)` over a bounded thread pool; every failure is
+    collected and reported together (a 1000-file upload must not die
+    silently at file 734 — reference run_upload_cli pools)."""
+    items = list(items)
+    if not items:
+        return []
+    results: List = [None] * len(items)
+    errors: List[str] = []
+    workers = max(1, min(max_workers, len(items)))
+    with concurrent.futures.ThreadPoolExecutor(workers) as pool:
+        futures = {pool.submit(fn, item): i
+                   for i, item in enumerate(items)}
+        for future in concurrent.futures.as_completed(futures):
+            i = futures[future]
+            try:
+                results[i] = future.result()
+            except Exception as e:  # noqa: BLE001 — aggregated below
+                errors.append(f'{items[i]}: {e}')
+    if errors:
+        summary = '; '.join(errors[:5])
+        more = f' (+{len(errors) - 5} more)' if len(errors) > 5 else ''
+        raise exceptions.StorageError(
+            f'{what}: {len(errors)}/{len(items)} failed: '
+            f'{summary}{more}')
+    return results
+
+
+def upload_files(store, paths: List[str], max_workers: int = 8) -> None:
+    """Fan N individual files into a store concurrently (each via the
+    store's own single-file upload path)."""
+    parallel_transfer(
+        [os.path.expanduser(p) for p in paths], store.upload,
+        max_workers=max_workers,
+        what=f'upload to {store.url()}')
+
+
+def list_local_files(source: str) -> List[str]:
+    """All files under a dir (or the file itself), .skyignore-aware."""
+    from skypilot_tpu.utils import storage_utils
+    source = os.path.expanduser(source)
+    if os.path.isfile(source):
+        return [source]
+    excludes = storage_utils.skyignore_excludes(source)
+    import fnmatch
+    out: List[str] = []
+    for root, _dirs, files in os.walk(source):
+        for fname in files:
+            full = os.path.join(root, fname)
+            rel = os.path.relpath(full, source)
+            if any(fnmatch.fnmatch(rel, pat) or fnmatch.fnmatch(
+                    os.path.basename(rel), pat) for pat in excludes):
+                continue
+            out.append(full)
+    return sorted(out)
+
+
+def bucket_du(url: str) -> Optional[int]:
+    """Total bytes in a bucket/prefix via the store CLI (None when the
+    CLI cannot answer). Reference analog: the s3/gsutil du helpers."""
+    store_type, bucket, key = split_bucket_url(url)
+    target = f'{bucket}/{key}' if key else bucket
+    if store_type == 'gcs':
+        argv = ['gsutil', 'du', '-s', f'gs://{target}']
+    elif store_type == 's3':
+        argv = ['aws', 's3', 'ls', '--summarize', '--recursive',
+                f's3://{target}']
+    elif store_type == 'local':
+        from skypilot_tpu.data import storage as storage_lib
+        root = os.path.join(storage_lib.LocalStore.root(), target)
+        total = 0
+        for dirpath, _dirs, files in os.walk(root):
+            for fname in files:
+                total += os.path.getsize(os.path.join(dirpath, fname))
+        return total
+    else:
+        return None
+    proc = subprocess.run(argv, capture_output=True, text=True,
+                          timeout=300, check=False)
+    if proc.returncode != 0:
+        return None
+    out = proc.stdout.strip().splitlines()
+    if not out:
+        return 0
+    if store_type == 'gcs':
+        return int(out[-1].split()[0])
+    for line in reversed(out):  # aws: "Total Size: N"
+        if 'Total Size' in line:
+            return int(line.split(':')[1].strip().split()[0])
+    return None
+
+
+def verify_upload(source: str, store) -> Dict[str, int]:
+    """Cheap post-upload verification: local file count vs a bucket
+    listing count where the store can list (LocalStore always can)."""
+    local_files = list_local_files(source)
+    report = {'local_files': len(local_files)}
+    lister = getattr(store, 'list_files', None)
+    if lister is not None:
+        report['remote_files'] = len(lister())
+    return report

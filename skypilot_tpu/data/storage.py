@@ -364,6 +364,15 @@ class LocalStore(AbstractStore):
         else:
             shutil.copy2(source, self._dir())
 
+    def list_files(self) -> List[str]:
+        """Bucket-relative paths of every object (verification)."""
+        out: List[str] = []
+        for root, _dirs, files in os.walk(self._dir()):
+            for fname in files:
+                out.append(os.path.relpath(os.path.join(root, fname),
+                                           self._dir()))
+        return sorted(out)
+
 
 _STORE_CLASSES: Dict[StoreType, Type[AbstractStore]] = {
     StoreType.GCS: GcsStore,
@@ -409,6 +418,20 @@ class Storage:
         else:
             store_type = StoreType.GCS
         self.store = make_store(store_type, name)
+        # Multi-store: the same named storage can be replicated into
+        # several stores (reference Storage.stores,
+        # sky/data/storage.py:520); `store` stays the PRIMARY (what
+        # mounts use).
+        self.stores: Dict[StoreType, AbstractStore] = {
+            store_type: self.store}
+
+    def add_store(self, store: str) -> AbstractStore:
+        """Replicate this storage into another store type; sync() and
+        delete() then cover every registered store."""
+        store_type = StoreType(store.lower())
+        if store_type not in self.stores:
+            self.stores[store_type] = make_store(store_type, self.name)
+        return self.stores[store_type]
 
     @classmethod
     def from_yaml_config(cls, cfg: Dict[str, Any]) -> 'Storage':
@@ -429,11 +452,19 @@ class Storage:
         return cfg
 
     def sync(self) -> None:
-        """Ensure the bucket exists; upload source if local."""
-        if not self.store.exists():
-            self.store.create()
-        if self.source and '://' not in self.source:
-            self.store.upload(self.source)
+        """Ensure every registered bucket exists; upload source if
+        local. Multiple stores sync CONCURRENTLY (data_utils pool)."""
+        from skypilot_tpu.data import data_utils
+
+        def _sync_one(store: AbstractStore) -> None:
+            if not store.exists():
+                store.create()
+            if self.source and '://' not in self.source:
+                store.upload(self.source)
+
+        data_utils.parallel_transfer(
+            list(self.stores.values()), _sync_one,
+            what=f'sync storage {self.name!r}')
         if self.persistent:
             from skypilot_tpu import state as state_lib
             state_lib.add_or_update_storage(self.name,
@@ -441,7 +472,10 @@ class Storage:
                                             self.source)
 
     def delete(self) -> None:
-        self.store.delete()
+        from skypilot_tpu.data import data_utils
+        data_utils.parallel_transfer(
+            list(self.stores.values()), lambda s: s.delete(),
+            what=f'delete storage {self.name!r}')
         from skypilot_tpu import state as state_lib
         state_lib.remove_storage(self.name)
 

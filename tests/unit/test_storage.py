@@ -256,3 +256,93 @@ class TestDataTransfer:
         assert 's3://a' in calls[1]
         data_transfer.transfer('s3://a', 's3://b')
         assert calls[2][:3] == ['aws', 's3', 'sync']
+
+
+class TestDataUtils:
+    """URL parsing + parallel fan-out + multi-store Storage
+    (reference sky/data/data_utils.py:1, Storage.stores :520)."""
+
+    def test_split_bucket_url(self):
+        from skypilot_tpu.data import data_utils
+        assert data_utils.split_bucket_url('gs://b/a/c.txt') == \
+            ('gcs', 'b', 'a/c.txt')
+        assert data_utils.split_bucket_url('s3://b') == ('s3', 'b', '')
+        assert data_utils.split_bucket_url('cos://b/k') == \
+            ('cos', 'b', 'k')
+        with pytest.raises(Exception):
+            data_utils.split_bucket_url('/local/path')
+        assert data_utils.is_cloud_url('r2://x')
+        assert not data_utils.is_cloud_url('/tmp/x')
+
+    def test_parallel_transfer_aggregates_failures(self):
+        from skypilot_tpu import exceptions
+        from skypilot_tpu.data import data_utils
+
+        def work(i):
+            if i % 3 == 0:
+                raise RuntimeError(f'boom {i}')
+            return i * 2
+
+        with pytest.raises(exceptions.StorageError) as err:
+            data_utils.parallel_transfer(range(9), work, what='probe')
+        # 0,3,6 failed; every failure is named, none silently dropped.
+        assert '3/9 failed' in str(err.value)
+        assert data_utils.parallel_transfer([1, 2], work) == [2, 4]
+
+    def test_list_local_files_respects_skyignore(self, tmp_path):
+        from skypilot_tpu.data import data_utils
+        (tmp_path / 'keep.txt').write_text('x')
+        (tmp_path / 'drop.log').write_text('x')
+        (tmp_path / '.skyignore').write_text('*.log\n')
+        files = data_utils.list_local_files(str(tmp_path))
+        names = [os.path.basename(f) for f in files]
+        assert 'keep.txt' in names
+        assert 'drop.log' not in names
+
+    def test_parallel_upload_files(self, tmp_path):
+        from skypilot_tpu.data import data_utils
+        store = storage_lib.LocalStore('pupload')
+        store.create()
+        paths = []
+        for i in range(6):
+            p = tmp_path / f'f{i}.txt'
+            p.write_text(str(i))
+            paths.append(str(p))
+        data_utils.upload_files(store, paths, max_workers=3)
+        assert len(store.list_files()) == 6
+        store.delete()
+
+    def test_multi_store_sync_and_delete(self, tmp_path, monkeypatch):
+        """One named storage replicated into two stores: sync covers
+        both, delete tears both down."""
+        src = tmp_path / 'data'
+        src.mkdir()
+        (src / 'a.txt').write_text('hello')
+        storage = storage_lib.Storage(name='multi', source=str(src),
+                                      store='local', persistent=False)
+        # A second local-backed "store" type: fake another store by
+        # registering a second LocalStore-like class under R2.
+        class FakeR2(storage_lib.LocalStore):
+            TYPE = storage_lib.StoreType.R2
+
+            def _dir(self):
+                return os.path.join(self.root(), 'r2-' + self.name)
+        monkeypatch.setitem(storage_lib._STORE_CLASSES,
+                            storage_lib.StoreType.R2, FakeR2)
+        storage.add_store('r2')
+        storage.sync()
+        assert storage_lib.LocalStore('multi').exists()
+        assert FakeR2('multi').exists()
+        assert (len(storage.stores) == 2)
+        storage.delete()
+        assert not storage_lib.LocalStore('multi').exists()
+        assert not FakeR2('multi').exists()
+
+    def test_bucket_du_local(self, tmp_path):
+        from skypilot_tpu.data import data_utils
+        store = storage_lib.LocalStore('dubucket')
+        store.create()
+        (tmp_path / 'x.bin').write_bytes(b'abcde')
+        store.upload(str(tmp_path / 'x.bin'))
+        assert data_utils.bucket_du('local://dubucket') == 5
+        store.delete()
