@@ -24,6 +24,7 @@ environment still reports a result.
 """
 import functools
 import json
+import os
 import sys
 import time
 
@@ -48,25 +49,47 @@ def _error_line(msg: str) -> None:
     }))
 
 
+_INIT_ATTACH_TIMEOUT_S = 120.0
+
+
 def _init_backend():
-    """jax backend init with retry — TPU attach can be transiently
-    UNAVAILABLE (axon tunnel warm-up); retry with backoff before
-    giving up with a JSON error line instead of a traceback."""
+    """jax backend init with retry AND a hard attach timeout — the
+    axon tunnel can be transiently UNAVAILABLE (RuntimeError) or, when
+    wedged, BLOCK inside jax.devices() forever; both must end in a
+    JSON error line, never a hung driver run."""
+    import threading
+
     import jax
     last_err = None
     for attempt in range(_INIT_RETRIES):
-        try:
-            devices = jax.devices()
-            return jax, devices
-        except RuntimeError as e:
-            last_err = e
+        result = {}
+
+        def _attach():
             try:
-                from jax.extend import backend as _jexb
-                _jexb.clear_backends()
-            except Exception:
-                pass
-            if attempt < _INIT_RETRIES - 1:
-                time.sleep(_INIT_BACKOFF_S)
+                result['devices'] = jax.devices()
+            except Exception as e:  # noqa: BLE001 — reported below
+                result['error'] = e
+
+        t = threading.Thread(target=_attach, daemon=True)
+        t.start()
+        t.join(_INIT_ATTACH_TIMEOUT_S)
+        if t.is_alive():
+            # The runtime lock is stuck inside that thread: do NOT
+            # touch clear_backends (it would block the main thread on
+            # the same lock) — report and bail out.
+            raise RuntimeError(
+                f'jax.devices() hung > {_INIT_ATTACH_TIMEOUT_S:.0f}s '
+                '(wedged accelerator tunnel?)')
+        if 'devices' in result:
+            return jax, result['devices']
+        last_err = result['error']
+        try:
+            from jax.extend import backend as _jexb
+            _jexb.clear_backends()
+        except Exception:
+            pass
+        if attempt < _INIT_RETRIES - 1:
+            time.sleep(_INIT_BACKOFF_S)
     raise RuntimeError(f'backend init failed after {_INIT_RETRIES} '
                        f'attempts: {last_err}')
 
@@ -267,3 +290,7 @@ if __name__ == '__main__':
         main()
     except Exception as e:  # noqa: BLE001 — always emit the JSON line
         _error_line(f'{type(e).__name__}: {e}')
+        sys.stdout.flush()
+        # A wedged attach leaves a stuck non-daemon-ish runtime thread
+        # behind; the JSON line is out, so end the process for real.
+        os._exit(1)  # noqa: SLF001
