@@ -274,6 +274,32 @@ def _blocks(s_q: int, s_kv: int, block_q: int, block_k: int):
 _SMEM_SPEC = pl.BlockSpec(memory_space=pltpu.SMEM)
 
 
+def _clamped_kv_index(iq, ik, w_ref, *, bq: int, bk: int, n_k: int):
+    """KV block index with masked steps pinned to a visible block.
+
+    For q tile [iq*bq, iq*bq+bq) under a sliding window the visible
+    kv columns are (iq*bq - w, iq*bq + bq - 1]; grid steps outside
+    that range re-fetch the boundary block instead of DMAing a tile
+    the kernel will skip anyway (pallas elides the copy when the
+    mapped index doesn't change) — HBM traffic drops to O(window)
+    per q tile on long sequences.
+    """
+    w = w_ref[0]
+    lo = jnp.maximum((iq * bq - w + 1) // bk, 0)
+    hi = jnp.minimum((iq * bq + bq - 1) // bk, n_k - 1)
+    return jnp.clip(ik, lo, hi)
+
+
+def _clamped_q_index(ik, iq, w_ref, *, bq: int, bk: int, n_q: int):
+    """Mirror of _clamped_kv_index for the dkv grid (q innermost):
+    visible q rows for kv tile [ik*bk, ik*bk+bk) are
+    [ik*bk, ik*bk + bk - 1 + w - 1]."""
+    w = w_ref[0]
+    lo = jnp.maximum((ik * bk) // bq, 0)
+    hi = jnp.minimum((ik * bk + bk + w - 2) // bq, n_q - 1)
+    return jnp.clip(iq, lo, hi)
+
+
 def _flash_fwd_impl(q: jax.Array, k: jax.Array, v: jax.Array,
                     window: jax.Array, causal: bool, windowed: bool,
                     block_q: int, block_k: int,
@@ -292,6 +318,46 @@ def _flash_fwd_impl(q: jax.Array, k: jax.Array, v: jax.Array,
     kernel = functools.partial(
         _fwd_kernel, causal=causal, windowed=windowed, softcap=softcap,
         scale=scale, bq=bq, bk=bk, n_kv_blocks=n_k)
+    if windowed and causal:
+        # Scalar-prefetch grid: the window rides into the INDEX MAPS,
+        # so fully-masked kv steps re-fetch the boundary block (no new
+        # DMA) while pl.when skips their compute.
+        def kv_map(b_, h_, iq, ik, w_ref):
+            ik_c = _clamped_kv_index(iq, ik, w_ref, bq=bq, bk=bk,
+                                     n_k=n_k)
+            return (b_, h_ // group, ik_c, 0)
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, h, n_q, n_k),
+            in_specs=[
+                pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik, w:
+                             (b_, h_, iq, 0)),
+                pl.BlockSpec((1, 1, bk, d), kv_map),
+                pl.BlockSpec((1, 1, bk, d), kv_map),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik, w:
+                             (b_, h_, iq, 0)),
+                pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, iq, ik, w:
+                             (b_, h_, iq, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bq, d), jnp.float32),
+                pltpu.VMEM((bq, 1), jnp.float32),
+                pltpu.VMEM((bq, 1), jnp.float32),
+            ],
+        )
+        out, lse = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=[
+                jax.ShapeDtypeStruct((b, h, s_q, d), q.dtype),
+                jax.ShapeDtypeStruct((b, h, s_q, 1), jnp.float32),
+            ],
+            interpret=interpret,
+        )(window, qt, kt, vt)
+        return jnp.swapaxes(out, 1, 2), lse
     out, lse = pl.pallas_call(
         kernel,
         grid=(b, h, n_q, n_k),
@@ -343,6 +409,82 @@ def _flash_bwd_impl(q, k, v, o, lse, do, window, causal, windowed,
                     jnp.swapaxes(o, 1, 2).astype(jnp.float32),
                     axis=-1, keepdims=True)            # [B,H,Sq,1] f32
 
+    dq_kernel = functools.partial(
+        _dq_kernel, causal=causal, windowed=windowed, softcap=softcap,
+        scale=scale, bq=bq, bk=bk, n_kv_blocks=n_k)
+    dkv_kernel = functools.partial(
+        _dkv_kernel, causal=causal, windowed=windowed, softcap=softcap,
+        scale=scale, bq=bq, bk=bk, n_q_blocks=n_q)
+
+    if windowed and causal:
+        # Scalar-prefetch grids: masked steps re-fetch the boundary
+        # block (see _clamped_kv_index) instead of DMAing skipped
+        # tiles.
+        def kv_map(b_, h_, iq, ik, w_ref):
+            ik_c = _clamped_kv_index(iq, ik, w_ref, bq=bq, bk=bk,
+                                     n_k=n_k)
+            return (b_, h_ // group, ik_c, 0)
+
+        q_specp = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik, w:
+                               (b_, h_, iq, 0))
+        row_specp = pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, iq, ik,
+                                 w: (b_, h_, iq, 0))
+        kv_specp = pl.BlockSpec((1, 1, bk, d), kv_map)
+        dqt = pl.pallas_call(
+            dq_kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(b, h, n_q, n_k),
+                in_specs=[q_specp, kv_specp, kv_specp, q_specp,
+                          row_specp, row_specp],
+                out_specs=q_specp,
+                scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+            ),
+            out_shape=jax.ShapeDtypeStruct((b, h, s_q, d), q.dtype),
+            interpret=interpret,
+        )(window, qt, kt, vt, dot, lse, delta)
+
+        def q_map(b_, h_, ik, iq, w_ref):
+            iq_c = _clamped_q_index(ik, iq, w_ref, bq=bq, bk=bk,
+                                    n_q=n_q)
+            return (b_, h_, iq_c, 0)
+
+        def row_map(b_, h_, ik, iq, w_ref):
+            iq_c = _clamped_q_index(ik, iq, w_ref, bq=bq, bk=bk,
+                                    n_q=n_q)
+            return (b_, h_, iq_c, 0)
+
+        q_spec2p = pl.BlockSpec((1, 1, bq, d), q_map)
+        row_spec2p = pl.BlockSpec((1, 1, bq, 1), row_map)
+        kv_spec2p = pl.BlockSpec((1, 1, bk, d), lambda b_, h_, ik, iq,
+                                 w: (b_, h_ // group, ik, 0))
+        kv_out_specp = pl.BlockSpec((1, 1, bk, d), lambda b_, h_, ik,
+                                    iq, w: (b_, h_, ik, 0))
+        dkt_h, dvt_h = pl.pallas_call(
+            dkv_kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(b, h, n_k, n_q),
+                in_specs=[q_spec2p, kv_spec2p, kv_spec2p, q_spec2p,
+                          row_spec2p, row_spec2p],
+                out_specs=[kv_out_specp, kv_out_specp],
+                scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                                pltpu.VMEM((bk, d), jnp.float32)],
+            ),
+            out_shape=[
+                jax.ShapeDtypeStruct((b, h, s_kv, d), k.dtype),
+                jax.ShapeDtypeStruct((b, h, s_kv, d), v.dtype),
+            ],
+            interpret=interpret,
+        )(window, qt, kt, vt, dot, lse, delta)
+        dq = jnp.swapaxes(dqt, 1, 2)
+        if group > 1:
+            dkt_h = dkt_h.reshape(b, h_kv, group, s_kv, d).sum(axis=2)
+            dvt_h = dvt_h.reshape(b, h_kv, group, s_kv, d).sum(axis=2)
+        dk = jnp.swapaxes(dkt_h, 1, 2).astype(k.dtype)
+        dv = jnp.swapaxes(dvt_h, 1, 2).astype(v.dtype)
+        return dq, dk, dv
+
     q_spec = pl.BlockSpec((1, 1, bq, d),
                           lambda b_, h_, iq, ik: (b_, h_, iq, 0))
     kv_spec = pl.BlockSpec((1, 1, bk, d),
@@ -351,9 +493,7 @@ def _flash_bwd_impl(q, k, v, o, lse, do, window, causal, windowed,
                             lambda b_, h_, iq, ik: (b_, h_, iq, 0))
 
     dqt = pl.pallas_call(
-        functools.partial(_dq_kernel, causal=causal, windowed=windowed,
-                          softcap=softcap, scale=scale, bq=bq, bk=bk,
-                          n_kv_blocks=n_k),
+        dq_kernel,
         grid=(b, h, n_q, n_k),
         in_specs=[_SMEM_SPEC, q_spec, kv_spec, kv_spec, q_spec, row_spec,
                   row_spec],
@@ -374,9 +514,7 @@ def _flash_bwd_impl(q, k, v, o, lse, do, window, causal, windowed,
     row_spec2 = pl.BlockSpec((1, 1, bq, 1),
                              lambda b_, h_, ik, iq: (b_, h_, iq, 0))
     dkt_h, dvt_h = pl.pallas_call(
-        functools.partial(_dkv_kernel, causal=causal, windowed=windowed,
-                          softcap=softcap, scale=scale, bq=bq, bk=bk,
-                          n_q_blocks=n_q),
+        dkv_kernel,
         grid=(b, h, n_k, n_q),
         in_specs=[_SMEM_SPEC, q_spec2, kv_spec2, kv_spec2, q_spec2,
                   row_spec2, row_spec2],
