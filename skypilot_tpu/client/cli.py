@@ -144,14 +144,15 @@ def status(clusters, refresh):
     fmt = '{:<20} {:<28} {:<10} {:<8} {}'
     click.echo(fmt.format('NAME', 'RESOURCES', 'STATUS', 'NODES',
                           'AUTOSTOP'))
+    from skypilot_tpu.utils import log_utils
     for r in records:
         autostop = r.get('autostop') or {}
         autostop_str = (f'{autostop.get("idle_minutes")}m'
                         f'{" (down)" if autostop.get("down") else ""}'
                         if autostop else '-')
         click.echo(fmt.format(r['name'], r.get('resources_str') or '-',
-                              r['status'], r.get('num_nodes') or 1,
-                              autostop_str))
+                              log_utils.colorize_status(r['status']),
+                              r.get('num_nodes') or 1, autostop_str))
 
 
 @cli.command()

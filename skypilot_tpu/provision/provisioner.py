@@ -127,7 +127,7 @@ def post_provision_runtime_setup(provider_name: str, cluster_name: str,
     skylet. Returns the runtime dir. Idempotent."""
     from skypilot_tpu.utils import rich_utils
     runners = provision.get_command_runners(provider_name, cluster_info)
-    with rich_utils.status(
+    with rich_utils.safe_status(
             f'[{cluster_name}] waiting for {len(runners)} host(s)'
             ) as spinner:
         wait_for_connection(runners)

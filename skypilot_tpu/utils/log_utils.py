@@ -1,4 +1,8 @@
-"""Table/duration formatting helpers for CLI output."""
+"""Table/duration/status formatting + streaming line processors.
+
+Reference analog: sky/utils/log_utils.py (623 LoC: colored statuses,
+RayUpLineProcessor-style streaming log parsers, table helpers)."""
+import sys
 import time
 from typing import List, Optional
 
@@ -35,6 +39,76 @@ def format_table(headers: List[str], rows: List[List[str]]) -> str:
     for row in rows:
         lines.append('  '.join(str(c).ljust(w) for c, w in zip(row, widths)))
     return '\n'.join(lines)
+
+
+# Status word -> ANSI color class (green/red/yellow/dim), mirroring the
+# dashboard's chip classes so terminal and browser read the same.
+_GREEN = ('UP', 'READY', 'RUNNING', 'SUCCEEDED', 'HEALTHY', 'enabled')
+_RED = ('FAILED', 'FAILED_NO_RESOURCE', 'FAILED_CONTROLLER',
+        'NOT_READY', 'UNHEALTHY', 'CANCELLED')
+_YELLOW = ('PENDING', 'PROVISIONING', 'RECOVERING', 'STARTING', 'INIT',
+           'STOPPED', 'STOPPING', 'SHUTTING_DOWN', 'SUBMITTED')
+
+
+def colorize_status(status: str, out=None) -> str:
+    """ANSI-colored status word on TTYs; plain text through pipes (CI
+    logs must stay grep-able)."""
+    out = out or sys.stdout
+    if not getattr(out, 'isatty', lambda: False)():
+        return status
+    if status in _GREEN:
+        code = '32'
+    elif status in _RED:
+        code = '31'
+    elif status in _YELLOW:
+        code = '33'
+    else:
+        code = '2'
+    return f'\x1b[{code}m{status}\x1b[0m'
+
+
+class LineProcessor:
+    """Streaming log parser: feed lines as they arrive, derive UX
+    state (reference RayUpLineProcessor / SkyLocalUpLineProcessor).
+    Subclasses override process_line."""
+
+    def __enter__(self) -> 'LineProcessor':
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+    def process_line(self, line: str) -> None:
+        del line
+
+
+class ProvisionLogProcessor(LineProcessor):
+    """Drives a rich_utils.Status from provision-stream lines: phase
+    markers update the spinner message; failures are collected for the
+    post-mortem instead of scrolling away."""
+
+    _PHASES = (
+        ('waiting for', 'Waiting for instances'),
+        ('starting skylet', 'Starting skylet'),
+        ('setup:', 'Running setup'),
+        ('[gang] run:', 'Running'),
+    )
+
+    def __init__(self, status=None) -> None:
+        self.status = status
+        self.phase = 'Provisioning'
+        self.errors: List[str] = []
+
+    def process_line(self, line: str) -> None:
+        lowered = line.lower()
+        for marker, phase in self._PHASES:
+            if marker in lowered:
+                self.phase = phase
+                if self.status is not None:
+                    self.status.update(phase)
+                break
+        if 'error' in lowered or 'failed' in lowered:
+            self.errors.append(line.strip())
 
 
 def readable_time_duration(start: Optional[float],

@@ -68,3 +68,67 @@ class Status:
 
 def status(message: str, out=None) -> Status:
     return Status(message, out=out)
+
+
+# --- nesting / quiet handling (reference rich_utils client_status) ---------
+
+_ACTIVE: list = []
+
+
+class _NestedStatus:
+    """Re-enter the live spinner instead of stacking a second one:
+    inner scopes update the outer message and restore it on exit."""
+
+    def __init__(self, outer: Status, message: str) -> None:
+        self._outer = outer
+        self._message = message
+        self._saved: Optional[str] = None
+
+    def __enter__(self):
+        self._saved = self._outer._message  # noqa: SLF001
+        self._outer.update(self._message)
+        return self._outer
+
+    def __exit__(self, *exc) -> None:
+        if self._saved is not None:
+            self._outer.update(self._saved)
+
+
+class _NullStatus:
+    def update(self, message: str) -> None:
+        del message
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+def safe_status(message: str, out=None):
+    """The status everyone should use: quiet under SKYTPU_QUIET, joins
+    a live spinner instead of fighting it, plain Status otherwise
+    (reference safe_status/client_status)."""
+    import os
+    if os.environ.get('SKYTPU_QUIET'):
+        return _NullStatus()
+    if _ACTIVE:
+        return _NestedStatus(_ACTIVE[-1], message)
+    outer = Status(message, out=out)
+    orig_enter, orig_exit = outer.__enter__, outer.__exit__
+
+    class _Tracked:
+        def update(self, m):
+            outer.update(m)
+
+        def __enter__(self):
+            orig_enter()
+            _ACTIVE.append(outer)
+            return outer
+
+        def __exit__(self, *exc):
+            if _ACTIVE and _ACTIVE[-1] is outer:
+                _ACTIVE.pop()
+            orig_exit(*exc)
+
+    return _Tracked()

@@ -134,3 +134,60 @@ class TestRichUtils:
         text = out.getvalue()
         assert 'working' in text
         assert text.endswith('\r\x1b[2K')  # line cleared on exit
+
+
+class TestUxHelpers:
+    """Colored statuses, streaming line processors, nested status
+    (reference log_utils/rich_utils depth)."""
+
+    def test_colorize_only_on_tty(self):
+        import io
+
+        from skypilot_tpu.utils import log_utils
+
+        class Tty(io.StringIO):
+            def isatty(self):
+                return True
+
+        assert log_utils.colorize_status('UP', out=io.StringIO()) == \
+            'UP'
+        colored = log_utils.colorize_status('UP', out=Tty())
+        assert '\x1b[32m' in colored and 'UP' in colored
+        assert '\x1b[31m' in log_utils.colorize_status('FAILED',
+                                                       out=Tty())
+        assert '\x1b[33m' in log_utils.colorize_status('PENDING',
+                                                       out=Tty())
+
+    def test_provision_line_processor_phases_and_errors(self):
+        from skypilot_tpu.utils import log_utils
+
+        class Spy:
+            messages = []
+
+            def update(self, m):
+                self.messages.append(m)
+
+        spy = Spy()
+        with log_utils.ProvisionLogProcessor(spy) as proc:
+            proc.process_line('[c1] waiting for 2 host(s)')
+            proc.process_line('[c1] starting skylet')
+            proc.process_line('[gang] run: launching on 2 node(s)')
+            proc.process_line('node-1 FAILED: exit 7')
+        assert spy.messages == ['Waiting for instances',
+                                'Starting skylet', 'Running']
+        assert proc.errors == ['node-1 FAILED: exit 7']
+
+    def test_safe_status_nests_and_respects_quiet(self, monkeypatch):
+        import io
+
+        from skypilot_tpu.utils import rich_utils
+        out = io.StringIO()
+        with rich_utils.safe_status('outer', out=out) as outer:
+            with rich_utils.safe_status('inner') as inner:
+                assert inner is outer  # joined, not stacked
+            # Outer message restored after the nested scope.
+            assert outer._message == 'outer'  # noqa: SLF001
+        assert rich_utils._ACTIVE == []  # noqa: SLF001
+        monkeypatch.setenv('SKYTPU_QUIET', '1')
+        with rich_utils.safe_status('silent') as st:
+            st.update('nothing prints')
