@@ -150,9 +150,12 @@ def status(clusters, refresh):
         autostop_str = (f'{autostop.get("idle_minutes")}m'
                         f'{" (down)" if autostop.get("down") else ""}'
                         if autostop else '-')
+        # Pad the PLAIN word first: ANSI codes must not count toward
+        # the column width or colored rows shift the table.
+        status_cell = log_utils.colorize_status(f'{r["status"]:<10}')
         click.echo(fmt.format(r['name'], r.get('resources_str') or '-',
-                              log_utils.colorize_status(r['status']),
-                              r.get('num_nodes') or 1, autostop_str))
+                              status_cell, r.get('num_nodes') or 1,
+                              autostop_str))
 
 
 @cli.command()
@@ -629,10 +632,16 @@ def api_login(endpoint, token, browser):
         from skypilot_tpu.client import sdk as _sdk
         target = (endpoint or _sdk.api_server_url()).rstrip('/')
         try:
-            token = oauth.browser_login(target) or None
+            token = oauth.browser_login(target)
         except _exc.SkyTpuError as e:
             raise click.ClickException(str(e))
-    if token is None:
+        if token == '':
+            # Open local mode: the handoff SUCCEEDED and there is no
+            # token to store — don't fall into the paste prompt.
+            click.echo('Server is in open local mode; no token '
+                       'needed.')
+            token = None
+    elif token is None:
         token = click.prompt('API token', hide_input=True, default='',
                              show_default=False) or None
     cfg_path = _os.path.expanduser(config_lib.USER_CONFIG_PATH)

@@ -209,10 +209,16 @@ def _watch_parent() -> None:
     import os
     import time
     interval = float(os.environ.get('SKYTPU_WATCHDOG_INTERVAL', '5'))
+    original = os.getppid()
+    if original == 1:
+        # Launched by a PID-1 shell/init (container entrypoints): a
+        # reparent is undetectable, so the watchdog stands down — the
+        # pod's lifecycle owns the process there anyway.
+        return
 
     def _loop():
         while True:
-            if os.getppid() == 1:
+            if os.getppid() != original:
                 os._exit(0)  # noqa: SLF001 — the TPU thread never joins
             time.sleep(interval)
 

@@ -116,7 +116,8 @@ def _create_body(config: common.ProvisionConfig, index: int,
     startup = nc.get('startup_script') or ''
     if nc.get('volumes'):
         from skypilot_tpu.provision.gcp import volumes as volumes_lib
-        mount = volumes_lib.mount_script(nc['volumes'])
+        mount = volumes_lib.mount_script(nc['volumes'],
+                                         cluster_name_on_cloud)
         startup = f'{startup}\n{mount}' if startup else mount
     if startup:
         body['metadata']['items'].append(

@@ -56,30 +56,41 @@ def attach_volume(project: str, zone: str, vm_name: str,
             raise
 
 
-def delete_volume(project: str, zone: str, name: str) -> None:
+def delete_volume(project: str, zone: str, name: str) -> bool:
+    """Delete; returns False when the disk didn't exist."""
     t = gcp_adaptor.transport()
     try:
         t.request('DELETE', f'{_zone_url(project, zone)}/disks/{name}')
+        return True
     except gcp_adaptor.GcpApiError as e:
         if e.status != 404:
             raise
+        return False
+
+
+def _device_base(spec: Dict[str, Any],
+                 cluster_name_on_cloud: str) -> str:
+    """ONE name rule for attach + mount: a divergence here means the
+    startup script waits on a device that never appears."""
+    return spec.get('name') or f'{cluster_name_on_cloud}-vol'
 
 
 def volume_names(spec: Dict[str, Any], cluster_name_on_cloud: str,
                  node_index: int) -> Dict[str, str]:
     """Disk + device names for one volume on one node. Per-node disks
     (a PD attaches read-write to one VM)."""
-    base = spec.get('name') or f'{cluster_name_on_cloud}-vol'
+    base = _device_base(spec, cluster_name_on_cloud)
     return {'disk': f'{base}-{node_index}', 'device': base}
 
 
-def mount_script(volumes: List[Dict[str, Any]]) -> str:
+def mount_script(volumes: List[Dict[str, Any]],
+                 cluster_name_on_cloud: str) -> str:
     """Startup-script fragment: wait for each device, format if blank,
     mount at the declared path. Runs as root at boot, AFTER the
     provisioner attaches the disk — hence the wait loop."""
     lines = []
     for spec in volumes:
-        device = spec.get('name', 'vol')
+        device = _device_base(spec, cluster_name_on_cloud)
         path = spec['mount_path']
         dev = f'/dev/disk/by-id/google-{device}'
         lines.append(
@@ -114,9 +125,12 @@ def create_and_attach_all(config: common.ProvisionConfig,
 
 
 def delete_all(provider_config: Dict[str, Any],
-               cluster_name_on_cloud: str, max_nodes: int = 16) -> None:
+               cluster_name_on_cloud: str,
+               max_nodes: int = 1024) -> None:
     """Best-effort volume teardown at cluster terminate (only volumes
-    not marked keep: true)."""
+    not marked keep: true). Per-node disk names are dense (-0..-N-1),
+    so the sweep walks upward and stops at the first index that never
+    existed — no silent leak past an arbitrary cap."""
     volumes = provider_config.get('volumes') or []
     if not volumes:
         return
@@ -128,7 +142,8 @@ def delete_all(provider_config: Dict[str, Any],
         for i in range(max_nodes):
             names = volume_names(spec, cluster_name_on_cloud, i)
             try:
-                delete_volume(project, zone, names['disk'])
+                if not delete_volume(project, zone, names['disk']):
+                    break  # dense names: first miss = past the end
             except gcp_adaptor.GcpApiError as e:
                 # Best-effort: a disk still detaching (VM deletion op
                 # in flight) must not fail the whole teardown.

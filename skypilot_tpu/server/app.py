@@ -202,26 +202,43 @@ async def _handle_logout(request):
     return resp
 
 
-async def _handle_cli_auth(request):
-    """Hand the signed-in browser user's token to a waiting CLI
-    (client/oauth.py): redirect to its loopback callback. Auth
-    middleware has already run, so an anonymous browser got bounced
-    through /dashboard/login first (with ?next= back here)."""
+def _cli_auth_port(request) -> int:
     from aiohttp import web
-
-    from skypilot_tpu import users
     try:
         port = int(request.query['port'])
         if not 0 < port < 65536:
             raise ValueError
     except (KeyError, ValueError):
         raise web.HTTPBadRequest(text='need ?port=<cli callback port>')
+    return port
+
+
+async def _handle_cli_auth(request):
+    """CLI sign-in confirmation page. A bare GET must NOT hand out the
+    token: SameSite=Lax cookies ride top-level GET navigations, so a
+    malicious page could drive the browser here and deliver the token
+    to whatever listens on the victim's localhost port. The page shows
+    an explicit Authorize button whose same-origin POST
+    (/dashboard/api/cli-auth) does the handoff — cross-site POSTs
+    don't carry the Lax cookie, so the click can't be forged."""
+    from skypilot_tpu.server import dashboard
+    from aiohttp import web
+    port = _cli_auth_port(request)
+    return web.Response(text=dashboard.cli_auth_page(port),
+                        content_type='text/html')
+
+
+async def _handle_cli_auth_grant(request):
+    """The authorized (same-origin POST) half of the CLI handoff:
+    returns the loopback callback URL carrying the user's token."""
+    from skypilot_tpu import users
+    port = _cli_auth_port(request)
     import urllib.parse
     user = request.get('user', users.DEFAULT_USER)
     token = user.token or ''
-    raise web.HTTPFound(
-        f'http://127.0.0.1:{port}/callback?'
-        + urllib.parse.urlencode({'token': token}))
+    return _json_response({
+        'redirect': f'http://127.0.0.1:{port}/callback?'
+                    + urllib.parse.urlencode({'token': token})})
 
 
 def _log_response(request, title: str, path: str):
@@ -359,6 +376,8 @@ def create_app():
     app.router.add_post('/dashboard/api/login', _handle_login)
     app.router.add_get('/dashboard/logout', _handle_logout)
     app.router.add_get('/dashboard/cli-auth', _handle_cli_auth)
+    app.router.add_post('/dashboard/api/cli-auth',
+                        _handle_cli_auth_grant)
     app.router.add_get('/dashboard/api/summary',
                        _handle_dashboard_summary)
     app.router.add_get('/dashboard/api/{kind}/{key}',

@@ -438,6 +438,33 @@ def login_page(next_url: str = '/dashboard') -> str:
         '</script></body></html>')
 
 
+_CLI_AUTH_JS = """
+document.querySelector('button').addEventListener('click',async()=>{
+  const r=await fetch('/dashboard/api/cli-auth?port='+window.__port__,
+                      {method:'POST'});
+  if(r.ok){const body=await r.json();location.href=body.redirect}
+  else{document.getElementById('err').textContent='authorization '+
+    'failed ('+r.status+')'}
+});
+"""
+
+
+def cli_auth_page(port: int) -> str:
+    """Explicit-consent page for `tsky api login --browser` (the
+    same-origin POST is the CSRF boundary — see app._handle_cli_auth)."""
+    return (
+        '<!doctype html><html><head><title>Authorize CLI</title>'
+        f'<style>{_LOGIN_CSS}</style></head><body>'
+        '<form onsubmit="return false"><h1>Authorize CLI sign-in?</h1>'
+        f'<p style="color:#8b949e;margin:0">A `tsky api login '
+        f'--browser` run on this machine (port {int(port)}) is asking '
+        'for your API token. Only continue if you started it.</p>'
+        '<p id="err"></p>'
+        '<button type="button">Authorize</button></form>'
+        f'<script>window.__port__={int(port)};{_CLI_AUTH_JS}'
+        '</script></body></html>')
+
+
 # --- log viewer -------------------------------------------------------------
 
 def tail_file(path: str, limit: int = 200_000) -> str:

@@ -56,11 +56,12 @@ def colorize_status(status: str, out=None) -> str:
     out = out or sys.stdout
     if not getattr(out, 'isatty', lambda: False)():
         return status
-    if status in _GREEN:
+    word = status.strip()  # callers pre-pad for table columns
+    if word in _GREEN:
         code = '32'
-    elif status in _RED:
+    elif word in _RED:
         code = '31'
-    elif status in _YELLOW:
+    elif word in _YELLOW:
         code = '33'
     else:
         code = '2'

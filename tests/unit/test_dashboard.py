@@ -201,16 +201,23 @@ class TestCliBrowserLogin:
     """`tsky api login --browser`: the localhost-callback flow
     (reference sky/client/oauth.py)."""
 
-    def test_cli_auth_redirects_token_to_callback(self, server):
+    def test_cli_auth_get_is_consent_page_post_grants(self, server):
+        """A bare GET must NOT hand the token out (a cross-site page
+        can drive top-level GETs with the Lax cookie attached): it
+        renders the consent page; the same-origin POST does the
+        grant."""
         _auth_on()
-        resp_err = None
-        try:
-            _get(server.url, '/dashboard/cli-auth?port=45555',
-                 cookie='skytpu_token=tok-admin', follow=False)
-        except urllib.error.HTTPError as e:
-            resp_err = e
-        assert resp_err is not None and resp_err.code == 302
-        assert resp_err.headers['Location'] == \
+        page = _get(server.url, '/dashboard/cli-auth?port=45555',
+                    cookie='skytpu_token=tok-admin').read().decode()
+        assert 'Authorize' in page
+        assert 'tok-admin' not in page  # token never in the GET body
+        req = urllib.request.Request(
+            f'{server.url}/dashboard/api/cli-auth?port=45555',
+            data=b'', method='POST',
+            headers={'Cookie': 'skytpu_token=tok-admin'})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            body = json.loads(resp.read())
+        assert body['redirect'] == \
             'http://127.0.0.1:45555/callback?token=tok-admin'
 
     def test_anonymous_cli_auth_bounces_through_login_with_next(
@@ -236,19 +243,27 @@ class TestCliBrowserLogin:
 
     def test_browser_login_end_to_end(self, server):
         """The real client listener against the real server: the
-        'browser' is a urllib hop following the server's redirect to
-        the CLI's loopback callback."""
+        'browser' loads the consent page, clicks Authorize (the
+        same-origin POST), and follows the granted redirect to the
+        CLI's loopback callback."""
         _auth_on()
         from skypilot_tpu.client import oauth
 
         def fake_browser(url):
-            # A signed-in browser visiting the cli-auth page.
             import threading
 
             def _go():
-                req = urllib.request.Request(
-                    url, headers={'Cookie': 'skytpu_token=tok-admin'})
-                urllib.request.urlopen(req, timeout=10).read()
+                cookie = {'Cookie': 'skytpu_token=tok-admin'}
+                page = urllib.request.urlopen(urllib.request.Request(
+                    url, headers=cookie), timeout=10).read().decode()
+                assert 'Authorize' in page
+                port = url.rsplit('port=', 1)[1]
+                grant = urllib.request.urlopen(urllib.request.Request(
+                    f'{server.url}/dashboard/api/cli-auth?port={port}',
+                    data=b'', method='POST', headers=cookie),
+                    timeout=10)
+                redirect = json.loads(grant.read())['redirect']
+                urllib.request.urlopen(redirect, timeout=10).read()
             threading.Thread(target=_go, daemon=True).start()
             return True
 

@@ -250,12 +250,16 @@ class TestServerWatchdogs:
         accelerator forever."""
         marker = tmp_path / 'server.pid'
         wrapper = (
-            'import subprocess, sys, os\n'
+            'import subprocess, sys, os, time\n'
             f'p = subprocess.Popen([sys.executable, "-m", '
             f'"skypilot_tpu.inference.server", "--model", "tiny", '
             f'"--port", "19474"])\n'
             f'open({str(marker)!r}, "w").write(str(p.pid))\n'
-            # Wrapper exits immediately; the server reparents to init.
+            # Stay alive long enough for the server to capture its
+            # real ppid (a launcher that dies before that looks like
+            # a container PID-1 parent, where the watchdog stands
+            # down by design), then die -> the server must follow.
+            'time.sleep(6)\n'
         )
         env = {**os.environ, 'SKYTPU_WATCHDOG_INTERVAL': '0.3',
                'JAX_PLATFORMS': 'cpu'}
