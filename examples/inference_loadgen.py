@@ -1,0 +1,110 @@
+"""HTTP load generator for the in-tree inference server.
+
+Reference analog: tests/load_tests/ (locust against the API server) —
+this one speaks the serving contract: N concurrent clients stream
+tokens from /generate and the report carries the serving numbers that
+matter (time-to-first-token, per-stream decode rate, aggregate
+tokens/s, request latency percentiles).
+
+    python3 examples/inference_loadgen.py \
+        --url http://HOST:8080 --concurrency 16 --requests 64 \
+        --prompt-len 128 --max-new-tokens 64
+
+Prints ONE JSON line so it can feed dashboards/CI the same way
+bench.py does.
+"""
+import argparse
+import asyncio
+import json
+import random
+import time
+
+
+async def _one_request(session, url: str, prompt_len: int,
+                       max_new_tokens: int):
+    prompt = [random.randint(1, 200) for _ in range(prompt_len)]
+    t0 = time.perf_counter()
+    ttft = None
+    tokens = 0
+    async with session.post(
+            f'{url}/generate',
+            json={'prompt_tokens': prompt,
+                  'max_new_tokens': max_new_tokens,
+                  'stream': True}) as resp:
+        resp.raise_for_status()
+        async for raw in resp.content:
+            line = raw.decode().strip()
+            if not line.startswith('data: '):
+                continue
+            event = json.loads(line[6:])
+            if 'token' in event:
+                tokens += 1
+                if ttft is None:
+                    ttft = time.perf_counter() - t0
+            elif 'error' in event:
+                raise RuntimeError(event['error'])
+    return {'latency': time.perf_counter() - t0,
+            'ttft': ttft if ttft is not None else float('nan'),
+            'tokens': tokens}
+
+
+def _pct(values, q):
+    values = sorted(values)
+    if not values:
+        return float('nan')
+    return values[min(len(values) - 1, int(q * len(values)))]
+
+
+async def run(url: str, concurrency: int, requests: int,
+              prompt_len: int, max_new_tokens: int):
+    import aiohttp
+    sem = asyncio.Semaphore(concurrency)
+    results = []
+
+    async with aiohttp.ClientSession() as session:
+        async def bounded():
+            async with sem:
+                results.append(await _one_request(
+                    session, url, prompt_len, max_new_tokens))
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*[bounded() for _ in range(requests)])
+        wall = time.perf_counter() - t0
+
+    total_tokens = sum(r['tokens'] for r in results)
+    lat = [r['latency'] for r in results]
+    ttft = [r['ttft'] for r in results]
+    return {
+        'metric': 'serve_decode_tokens_per_sec',
+        'value': round(total_tokens / wall, 2),
+        'unit': 'tokens/s',
+        'extra': {
+            'requests': requests,
+            'concurrency': concurrency,
+            'prompt_len': prompt_len,
+            'max_new_tokens': max_new_tokens,
+            'wall_s': round(wall, 3),
+            'ttft_p50_s': round(_pct(ttft, 0.5), 4),
+            'ttft_p95_s': round(_pct(ttft, 0.95), 4),
+            'latency_p50_s': round(_pct(lat, 0.5), 4),
+            'latency_p95_s': round(_pct(lat, 0.95), 4),
+        },
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--url', default='http://127.0.0.1:8080')
+    parser.add_argument('--concurrency', type=int, default=8)
+    parser.add_argument('--requests', type=int, default=32)
+    parser.add_argument('--prompt-len', type=int, default=128)
+    parser.add_argument('--max-new-tokens', type=int, default=64)
+    args = parser.parse_args()
+    report = asyncio.run(run(args.url.rstrip('/'), args.concurrency,
+                             args.requests, args.prompt_len,
+                             args.max_new_tokens))
+    print(json.dumps(report))
+
+
+if __name__ == '__main__':
+    main()

@@ -285,3 +285,40 @@ def test_cache_slot_reuse_isolation(tiny):
     ref = _greedy_reference(params, config, [42, 43], 3)
     assert second[r2] == ref
     assert first[r1] != second[r2] or True  # isolation asserted via ref
+
+
+def test_loadgen_against_tiny_server(tiny):
+    """The serve load generator end-to-end against a live engine:
+    concurrent streamed requests, sane report shape."""
+    import asyncio
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        'inference_loadgen', 'examples/inference_loadgen.py')
+    loadgen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(loadgen)
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from skypilot_tpu.inference import server as srv
+
+    config, params = tiny
+    engine = inference.InferenceEngine(params, config, batch_size=2,
+                                       max_seq_len=64)
+
+    async def drive():
+        holder = {'loop': srv.EngineLoop(engine)}
+        client = TestClient(TestServer(srv.create_app(holder)))
+        await client.start_server()
+        try:
+            url = str(client.make_url('')).rstrip('/')
+            return await loadgen.run(url, concurrency=2, requests=4,
+                                     prompt_len=8, max_new_tokens=4)
+        finally:
+            holder['loop'].stop()
+            await client.close()
+
+    report = asyncio.run(drive())
+    assert report['metric'] == 'serve_decode_tokens_per_sec'
+    assert report['value'] > 0
+    assert report['extra']['requests'] == 4
+    assert report['extra']['ttft_p50_s'] > 0
