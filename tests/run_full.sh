@@ -1,0 +1,8 @@
+#!/bin/bash
+# The whole test matrix: the default suite AND the compile-heavy slow
+# set (deselected by default for iteration speed). Run this before
+# releases / at round end so slow-set regressions can't slip through.
+set -e
+cd "$(dirname "$0")/.."
+python -m pytest tests/ -q
+python -m pytest tests/ -q -m slow
