@@ -79,16 +79,15 @@ def _cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     """
     num_heads = q.shape[2]
     b, s, hkv, d = k_cache.shape
-    if hkv != num_heads:
-        reps = num_heads // hkv
-        k_cache = jnp.broadcast_to(
-            k_cache[:, :, :, None, :], (b, s, hkv, reps, d)
-        ).reshape(b, s, num_heads, d)
-        v_cache = jnp.broadcast_to(
-            v_cache[:, :, :, None, :], (b, s, hkv, reps, d)
-        ).reshape(b, s, num_heads, d)
-    scale = 1.0 / math.sqrt(q.shape[-1])
-    scores = jnp.einsum('bqhd,bkhd->bhqk', q, k_cache,
+    t = q.shape[1]
+    group = num_heads // hkv
+    # Grouped-query form: decode is bandwidth-bound on the cache read,
+    # so NEVER materialize the KV broadcast to all query heads (it
+    # multiplies HBM traffic by H/KV) — fold the group axis into the
+    # einsums instead.
+    qg = q.reshape(b, t, hkv, group, d)
+    scale = 1.0 / math.sqrt(d)
+    scores = jnp.einsum('btkgd,bskd->bkgts', qg, k_cache,
                         preferred_element_type=jnp.float32) * scale
     if softcap is not None:
         scores = softcap * jnp.tanh(scores / softcap)
@@ -100,9 +99,11 @@ def _cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     if window is not None:
         visible = visible & (
             q_positions[:, :, None] - k_pos[None, None, :] < window)
-    scores = jnp.where(visible[:, None], scores, _NEG_INF)
+    # visible: [B,T,S] → broadcast over (kv-head, group).
+    scores = jnp.where(visible[:, None, None], scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
-    return jnp.einsum('bhqk,bkhd->bqhd', probs, v_cache)
+    out = jnp.einsum('bkgts,bskd->btkgd', probs, v_cache)
+    return out.reshape(b, t, num_heads, d)
 
 
 def _attn_with_cache(x: jax.Array, layer_params: Params,
