@@ -93,3 +93,26 @@ def test_restore_params_for_inference(tmp_path):
     checkpoints.save_train_state(ckpt, state, step=7)
     params = checkpoints.restore_params(ckpt, cfg.model_config())
     _tree_equal(state['params'], params)
+
+
+def test_moe_checkpoint_serves(tmp_path):
+    """The serve-from-checkpoint path for the MoE family: params saved
+    by training restore structure-driven and decode through the
+    engine (llm/serve-moe.yaml's --checkpoint contract)."""
+    import jax
+
+    from skypilot_tpu import inference
+    from skypilot_tpu.models import moe
+    from skypilot_tpu.train import checkpoints
+
+    cfg = moe.CONFIGS['tiny-moe']
+    params = moe.init_params(cfg, jax.random.key(5))
+    checkpoints.save_train_state(str(tmp_path), {'params': params},
+                                 step=1)
+    restored = checkpoints.restore_params(str(tmp_path), cfg)
+    engine = inference.InferenceEngine(restored, cfg, batch_size=1,
+                                       max_seq_len=32)
+    rid = engine.submit([3, 1, 4], inference.SamplingParams(
+        temperature=0.0, max_new_tokens=3))
+    out = engine.run_to_completion()[rid]
+    assert len(out) == 3
