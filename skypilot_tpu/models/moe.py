@@ -277,8 +277,11 @@ def loss_fn(params: Params, batch: Dict[str, jax.Array],
     if mask is None:
         mask = jnp.ones_like(tokens, jnp.float32)
     mask = mask.astype(jnp.float32).at[:, -1].set(0.0)
-    logprobs = jax.nn.log_softmax(logits, axis=-1)
-    token_ll = jnp.take_along_axis(
-        logprobs, targets[..., None], axis=-1)[..., 0]
-    ce = -jnp.sum(token_ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    # Fused CE (same trade as llama.loss_fn): target logit minus
+    # logsumexp, never materializing the [B,S,V] log-probs tensor.
+    target_logit = jnp.take_along_axis(
+        logits, targets[..., None], axis=-1)[..., 0]
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    ce = -jnp.sum((target_logit - lse) * mask) / \
+        jnp.maximum(jnp.sum(mask), 1.0)
     return ce + config.router_aux_loss_coef * aux_loss
