@@ -51,17 +51,30 @@ class SamplingParams:
 
 
 def init_cache(config: llama.LlamaConfig, batch_size: int,
-               max_seq_len: Optional[int] = None) -> Cache:
-    """Zeroed KV cache + per-slot lengths."""
+               max_seq_len: Optional[int] = None,
+               mesh: Optional[Any] = None) -> Cache:
+    """Zeroed KV cache + per-slot lengths. With a mesh, KV heads shard
+    over the tensor axis — serving models whose weights+cache exceed
+    one chip (the v5e-8 Llama-3-8B target) is a sharded-decode
+    problem, not a bigger-chip problem."""
     c = config
     s = max_seq_len or c.max_seq_len
     shape = (c.num_layers, batch_size, s, c.num_kv_heads, c.head_dim)
-    return {
+    cache = {
         'k': jnp.zeros(shape, c.dtype),
         'v': jnp.zeros(shape, c.dtype),
         # Per-slot number of valid cache positions.
         'length': jnp.zeros((batch_size,), jnp.int32),
     }
+    if mesh is not None:
+        from skypilot_tpu.parallel import sharding as sharding_lib
+        kv_sh = sharding_lib.named_sharding(
+            mesh, (None, None, None, 'kv_heads', None))
+        rep = sharding_lib.named_sharding(mesh, (None,))
+        cache = {'k': jax.device_put(cache['k'], kv_sh),
+                 'v': jax.device_put(cache['v'], kv_sh),
+                 'length': jax.device_put(cache['length'], rep)}
+    return cache
 
 
 def _cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
@@ -372,11 +385,13 @@ class DecodeState:
     """Host-side view of the device cache + slots."""
 
     def __init__(self, config: llama.LlamaConfig, batch_size: int,
-                 max_seq_len: Optional[int] = None):
+                 max_seq_len: Optional[int] = None,
+                 mesh: Optional[Any] = None):
         self.config = config
         self.batch_size = batch_size
         self.max_seq_len = max_seq_len or config.max_seq_len
-        self.cache = init_cache(config, batch_size, self.max_seq_len)
+        self.cache = init_cache(config, batch_size, self.max_seq_len,
+                                mesh=mesh)
         self.last_tokens = jnp.zeros((batch_size,), jnp.int32)
         self.slots: List[Optional[_Slot]] = [None] * batch_size
 
@@ -392,7 +407,8 @@ class InferenceEngine:
     def __init__(self, params: Params, config: llama.LlamaConfig,
                  batch_size: int = 8,
                  max_seq_len: Optional[int] = None,
-                 seed: int = 0):
+                 seed: int = 0,
+                 mesh: Optional[Any] = None):
         # The cached decode path mirrors the llama-core transformer
         # (every family knob: window/GeGLU/post-norms/softcaps/tied
         # embeddings) and the MoE family (routed expert MLP).
@@ -413,9 +429,22 @@ class InferenceEngine:
             if config.capacity_factor < exact_cf:
                 config = dataclasses.replace(config,
                                              capacity_factor=exact_cf)
+        self.mesh = mesh
+        if mesh is not None:
+            # Tensor-parallel serving: params shard by their logical
+            # axes (heads/mlp/vocab over 'tensor'); GSPMD propagates
+            # through the cached forward, inserting the decode
+            # all-reduces the same way the training step gets them.
+            from skypilot_tpu.parallel import sharding as sharding_lib
+            logical = (moe_lib.param_logical_axes(config)
+                       if isinstance(config, moe_lib.MoeConfig)
+                       else llama.param_logical_axes(config))
+            params = jax.device_put(
+                params, sharding_lib.tree_shardings(mesh, logical))
         self.params = params
         self.config = config
-        self.state = DecodeState(config, batch_size, max_seq_len)
+        self.state = DecodeState(config, batch_size, max_seq_len,
+                                 mesh=mesh)
         self._queue: List[Tuple[int, List[int], SamplingParams]] = []
         self._finished: Dict[int, List[int]] = {}
         self._next_id = 0
@@ -474,6 +503,13 @@ class InferenceEngine:
 
     # -- internals -----------------------------------------------------------
 
+    def _mesh_ctx(self):
+        import contextlib
+        if self.mesh is None:
+            return contextlib.nullcontext()
+        from skypilot_tpu.parallel import mesh as mesh_lib
+        return mesh_lib.use_mesh(self.mesh)
+
     def _insert_from_queue(self) -> None:
         free = [i for i, s in enumerate(self.state.slots) if s is None]
         if not free or not self._queue:
@@ -500,9 +536,10 @@ class InferenceEngine:
             jnp.int32)
         lengths = jnp.array([len(t) for _, t, _ in inserts], jnp.int32)
         slot_arr = jnp.array(slot_ids, jnp.int32)
-        logits, self.state.cache = prefill(
-            self.params, padded, lengths, self.state.cache, slot_arr,
-            self.config)
+        with self._mesh_ctx():
+            logits, self.state.cache = prefill(
+                self.params, padded, lengths, self.state.cache,
+                slot_arr, self.config)
         # First generated token comes straight from prefill logits.
         self._key, sub = jax.random.split(self._key)
         temps = jnp.array([s.temperature for _, _, s in inserts],
@@ -547,9 +584,10 @@ class InferenceEngine:
             [s.params.top_k if s else 0 for s in self.state.slots],
             jnp.int32)
         active = jnp.array(active_mask)
-        next_tokens, self.state.cache = decode_step(
-            self.params, self.state.cache, self.state.last_tokens, active,
-            temps, topks, sub, self.config)
+        with self._mesh_ctx():
+            next_tokens, self.state.cache = decode_step(
+                self.params, self.state.cache, self.state.last_tokens,
+                active, temps, topks, sub, self.config)
         self.state.last_tokens = next_tokens
         tokens_host = jax.device_get(next_tokens)
         for i, slot in enumerate(self.state.slots):

@@ -235,6 +235,10 @@ def main() -> None:
     parser.add_argument('--max-seq-len', type=int, default=None)
     parser.add_argument('--checkpoint', default=None,
                         help='Orbax checkpoint dir with model params')
+    parser.add_argument('--mesh', default=None,
+                        help='Shard serving over a device mesh, e.g. '
+                             'tensor=8 on a v5e-8 (models whose '
+                             'weights+cache exceed one chip).')
     parser.add_argument('--no-exit-with-parent', action='store_true',
                         help='Keep serving after the launcher exits '
                              '(deliberate daemonization only)')
@@ -249,6 +253,12 @@ def main() -> None:
         from skypilot_tpu import inference as inf
         from skypilot_tpu import models as models_lib
         family, config = models_lib.resolve(args.model)
+        mesh = None
+        if args.mesh:
+            from skypilot_tpu.parallel import mesh as mesh_lib
+            spec = mesh_lib.MeshSpec.from_dict(dict(
+                kv.split('=') for kv in args.mesh.split(',')))
+            mesh = mesh_lib.mesh_from_env(spec)
         if args.checkpoint:
             from skypilot_tpu.train import checkpoints
             params = checkpoints.restore_params(args.checkpoint, config)
@@ -256,7 +266,7 @@ def main() -> None:
             params = family.init_params(config, jax.random.key(0))
         engine = inf.InferenceEngine(
             params, config, batch_size=args.batch_size,
-            max_seq_len=args.max_seq_len)
+            max_seq_len=args.max_seq_len, mesh=mesh)
         holder['loop'] = EngineLoop(engine)
 
     threading.Thread(target=_load, daemon=True).start()
