@@ -2,14 +2,22 @@
 
 Reference analog: sky/client/oauth.py (OAuth-proxy callback listener).
 The shape is the same localhost-callback dance: the CLI opens the
-server's `/dashboard/cli-auth?port=N` page in a browser, the user
-authenticates there (cookie login if not already signed in), and the
-server redirects to `http://127.0.0.1:N/callback?token=...` where the
-CLI's one-shot listener catches the credential. No retyping tokens
-into terminals, and the token never transits anything but the user's
-own browser and loopback.
+server's `/dashboard/cli-auth?port=N&state=S` page in a browser, the
+user authenticates there (cookie login if not already signed in), and
+the page POSTs the token to `http://127.0.0.1:N/callback` — in the
+request body, so the credential never appears in a URL (browser
+history, proxy logs); a `?token=` GET redirect remains as a degraded
+fallback for browsers that block page->loopback fetches (Chrome
+Private Network Access on insecure public origins). Either way the
+delivery must echo the CLI's single-use random `state`: the listener
+sits on an open loopback port any web page can POST to, and without
+the nonce an attacker could fix the session by racing their own token
+into the CLI (classic OAuth login-CSRF — the state parameter exists
+for exactly this).
 """
+import hmac
 import http.server
+import secrets
 import threading
 import urllib.parse
 import webbrowser
@@ -26,15 +34,82 @@ _SUCCESS_PAGE = (b'<!doctype html><html><body style="font-family:'
 
 class _Callback(http.server.BaseHTTPRequestHandler):
     token: Optional[str] = None
+    state: str = ''
     event: threading.Event
 
+    def _accept(self, params) -> bool:
+        """Shared delivery rule for both verbs: a token field must be
+        present (a field-less probe from a port scanner must not
+        complete the flow — an empty result means 'open local mode'
+        to the caller, which would silently drop auth; `token=`
+        present-but-empty IS a real grant: open-mode servers have no
+        token to hand out), and the state nonce must echo ours (an
+        arbitrary web page can reach this listener; without the nonce
+        it could fix the session with an attacker token)."""
+        if 'token' not in params:
+            self.send_error(400, explain='missing token field')
+            return False
+        if 'state' not in params:
+            # A token WITHOUT a state is an old server's redirect
+            # delivery — fail fast and say so instead of 403-looping
+            # until the CLI's 180s timeout.
+            self.send_error(
+                403, explain='no state: this API server is too old '
+                'for --browser login; use `tsky api login --token`')
+            return False
+        got = params['state'][0]
+        # bytes comparison: compare_digest raises on non-ASCII str.
+        if not hmac.compare_digest(got.encode(),
+                                   type(self).state.encode()):
+            self.send_error(403, explain='state mismatch')
+            return False
+        type(self).token = params['token'][0]
+        return True
+
+    def do_POST(self):  # noqa: N802 — http.server API
+        """Primary path: the consent page POSTs token/state
+        (urlencoded body). The CORS header lets the page's
+        cross-origin fetch read the 200 and render its own success
+        state."""
+        if urllib.parse.urlsplit(self.path).path != '/callback':
+            self.send_error(404)
+            return
+        length = int(self.headers.get('Content-Length') or 0)
+        body = self.rfile.read(length).decode('utf-8', errors='replace')
+        params = urllib.parse.parse_qs(body, keep_blank_values=True)
+        if not self._accept(params):
+            return
+        self.send_response(200)
+        self.send_header('Access-Control-Allow-Origin', '*')
+        self.send_header('Content-Type', 'text/plain')
+        self.end_headers()
+        self.wfile.write(b'ok')
+        type(self).event.set()
+
+    def do_OPTIONS(self):  # noqa: N802 — http.server API
+        """CORS preflight: browsers enforcing Private/Local Network
+        Access preflight public-origin -> 127.0.0.1 fetches; without
+        this the POST handoff dies with a 501."""
+        self.send_response(204)
+        self.send_header('Access-Control-Allow-Origin', '*')
+        self.send_header('Access-Control-Allow-Methods', 'POST')
+        self.send_header('Access-Control-Allow-Headers',
+                         'Content-Type')
+        self.send_header('Access-Control-Allow-Private-Network', 'true')
+        self.end_headers()
+
     def do_GET(self):  # noqa: N802 — http.server API
+        """Fallback for browsers whose page->loopback fetch is blocked
+        (the consent page redirects here with token+state in the
+        query). Same delivery rule as do_POST."""
         parsed = urllib.parse.urlsplit(self.path)
         if parsed.path != '/callback':
             self.send_error(404)
             return
-        params = urllib.parse.parse_qs(parsed.query)
-        type(self).token = params.get('token', [''])[0]
+        params = urllib.parse.parse_qs(parsed.query,
+                                       keep_blank_values=True)
+        if not self._accept(params):
+            return
         self.send_response(200)
         self.send_header('Content-Type', 'text/html')
         self.end_headers()
@@ -49,13 +124,15 @@ def browser_login(endpoint: str, timeout: float = 180.0,
                   open_browser=webbrowser.open) -> str:
     """Run the callback listener, open the auth page, return the
     token the server hands back (empty string = open local mode)."""
+    state = secrets.token_urlsafe(16)
     handler = type('Handler', (_Callback,), {
-        'token': None, 'event': threading.Event()})
+        'token': None, 'state': state, 'event': threading.Event()})
     server = http.server.HTTPServer(('127.0.0.1', 0), handler)
     port = server.server_address[1]
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
-    url = f'{endpoint.rstrip("/")}/dashboard/cli-auth?port={port}'
+    url = (f'{endpoint.rstrip("/")}/dashboard/cli-auth?port={port}'
+           f'&state={state}')
     try:
         open_browser(url)
         print(f'Opening {url}\n(waiting for browser sign-in...)')

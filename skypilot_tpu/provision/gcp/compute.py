@@ -155,10 +155,15 @@ def run_instances(region: str, cluster_name_on_cloud: str,
             return _list_cluster_vms(project, zone,
                                      cluster_name_on_cloud)
 
-        record = mig_lib.run_instances(region, cluster_name_on_cloud,
-                                       config, _list, props)
-        volumes_lib.create_and_attach_all(config, cluster_name_on_cloud,
-                                          record.created_instance_ids)
+        record, node_names = mig_lib.run_instances(
+            region, cluster_name_on_cloud, config, _list, props)
+        if pc.get('volumes'):
+            # Attach to every live node, not just the newly created
+            # delta: create_and_attach_all is idempotent (disks key by
+            # VM-name suffix), and a relaunch must heal a node whose
+            # attach was interrupted last time.
+            volumes_lib.create_and_attach_all(
+                config, cluster_name_on_cloud, node_names)
         return record
 
     existing = {vm['name']: vm

@@ -15,7 +15,7 @@ paths; terminate detects the MIG and tears down group + template
 """
 import logging
 import time
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Tuple
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.adaptors import gcp as gcp_adaptor
@@ -165,12 +165,16 @@ def cancel_and_delete(project: str, region: str, zone: str,
 def run_instances(region: str, cluster_name_on_cloud: str,
                   config: common.ProvisionConfig, list_vms,
                   template_properties: Dict[str, Any]
-                  ) -> common.ProvisionRecord:
+                  ) -> Tuple[common.ProvisionRecord, List[str]]:
     """MIG/DWS provisioning path (compute.run_instances dispatches
-    here on gcp.use_mig)."""
+    here on gcp.use_mig). Returns the record plus ALL running node
+    names — the caller's volume attach wants the full membership, and
+    returning it avoids a second listing (and the churn window between
+    two listings)."""
     pc = config.provider_config
     project, zone = pc['project_id'], pc['zone']
     existing = [vm for vm in list_vms() if vm.get('status') == 'RUNNING']
+    existing_names = {vm['name'] for vm in existing}
     missing = config.count - len(existing)
     if missing > 0:
         template_url = ensure_instance_template(
@@ -185,8 +189,13 @@ def run_instances(region: str, cluster_name_on_cloud: str,
     else:
         vms = existing
     names = sorted(vm['name'] for vm in vms)
+    # Only the delta is "created": pre-existing RUNNING VMs on a
+    # relaunch already bootstrapped, and callers acting on new nodes
+    # (volume attach, first-boot setup) must not see them as fresh —
+    # same contract as the plain-compute path.
+    created = sorted(set(names) - existing_names)
     return common.ProvisionRecord(
         provider_name='gcp', region=region, zone=zone,
         cluster_name_on_cloud=cluster_name_on_cloud,
         head_instance_id=names[0],
-        created_instance_ids=names, resumed_instance_ids=[])
+        created_instance_ids=created, resumed_instance_ids=[]), names

@@ -3,15 +3,23 @@
 Reference analog: sky/provision/gcp/volume_utils.py:1 (create/attach
 network volumes + device resolution). Volumes are declared in config
 (`gcp.volumes: [{name, size_gb, type, mount_path}]`); run_instances
-creates each disk idempotently, attaches it per node
-(`<name>-<node-index>` for multi-node clusters), and the generated
-mount script (format-if-blank + fstab) rides the VM startup script —
-the standard GCP boot-time pattern, with a wait loop because the
-attach lands after VM create.
+creates each disk idempotently, attaches it per node, and the
+generated mount script (format-if-blank + fstab) rides the VM startup
+script — the standard GCP boot-time pattern, with a wait loop because
+the attach lands after VM create.
+
+Disk naming: `{base}-{node_key}`, where node_key is the node index
+for dense plain-compute names and the VM name's unique suffix for MIG
+nodes (MIG names are `{cluster}-{random}`, so a positional index
+would remap disks across nodes whenever membership churns). Teardown
+enumerates by `{base}-` prefix instead of walking indices, so holes
+from partial teardowns can't hide surviving disks.
 """
 import logging
+import re
 from typing import Any, Dict, List
 
+from skypilot_tpu import exceptions
 from skypilot_tpu.adaptors import gcp as gcp_adaptor
 from skypilot_tpu.provision import common
 
@@ -23,27 +31,47 @@ def _zone_url(project: str, zone: str) -> str:
             f'{zone}')
 
 
+CLUSTER_LABEL = 'skytpu-cluster'
+
+
 def ensure_volume(project: str, zone: str, name: str, size_gb: int,
-                  disk_type: str = 'pd-balanced') -> str:
-    """Idempotently create a persistent disk; returns its URL."""
+                  disk_type: str = 'pd-balanced',
+                  cluster_name_on_cloud: str = '') -> str:
+    """Idempotently create a persistent disk; returns its URL. The
+    cluster label scopes ownership both ways: teardown must not sweep
+    another cluster's same-named disks, and the exists-path must not
+    silently ADOPT them — attaching another cluster's surviving
+    `keep: true` disk would hand its data to the wrong cluster."""
     t = gcp_adaptor.transport()
     url = f'{_zone_url(project, zone)}/disks'
     try:
-        t.request('GET', f'{url}/{name}')
+        existing = t.request('GET', f'{url}/{name}')
+        owner = (existing.get('labels') or {}).get(CLUSTER_LABEL)
+        if (owner and cluster_name_on_cloud
+                and owner != cluster_name_on_cloud):
+            raise exceptions.ProvisionError(
+                f'Disk {name} already exists and belongs to cluster '
+                f'{owner!r}; rename this volume or delete that disk.')
     except gcp_adaptor.GcpApiError as e:
         if e.status != 404:
             raise
-        t.request('POST', url, json_body={
+        body = {
             'name': name,
             'sizeGb': str(size_gb),
             'type': f'zones/{zone}/diskTypes/{disk_type}',
-        })
+        }
+        if cluster_name_on_cloud:
+            body['labels'] = {CLUSTER_LABEL: cluster_name_on_cloud}
+        t.request('POST', url, json_body=body)
     return f'{url}/{name}'
 
 
 def attach_volume(project: str, zone: str, vm_name: str,
                   disk_url: str, device_name: str) -> None:
-    """Attach (idempotent: 400 'already attached' is success)."""
+    """Attach. Idempotent ONLY for 'already attached to this same VM';
+    'already being used by <some other instance>' must surface — the
+    node would otherwise boot diskless while its startup script waits
+    on a device that never appears."""
     t = gcp_adaptor.transport()
     try:
         t.request(
@@ -52,8 +80,15 @@ def attach_volume(project: str, zone: str, vm_name: str,
             json_body={'source': disk_url, 'deviceName': device_name,
                        'mode': 'READ_WRITE'})
     except gcp_adaptor.GcpApiError as e:
-        if 'already' not in str(e).lower():
-            raise
+        msg = str(e)
+        # Exact path-segment match: 'c-1' must not match a message
+        # naming '.../instances/c-10'.
+        same_vm = re.search(
+            rf'instances/{re.escape(vm_name)}(?![-\w])', msg)
+        if 'already' in msg.lower() and (same_vm or
+                                         f"'{vm_name}'" in msg):
+            return
+        raise
 
 
 def delete_volume(project: str, zone: str, name: str) -> bool:
@@ -68,19 +103,78 @@ def delete_volume(project: str, zone: str, name: str) -> bool:
         return False
 
 
-def _device_base(spec: Dict[str, Any],
-                 cluster_name_on_cloud: str) -> str:
+def list_cluster_disks(project: str, zone: str, prefix: str,
+                       cluster_name_on_cloud: str) -> List[str]:
+    """Names of this cluster's disks under `prefix`. Two guards: the
+    remainder must be a single token (no '-'), so a sibling volume
+    named `{base}-extra` isn't swept; and a disk labeled as belonging
+    to a DIFFERENT cluster is skipped — two clusters declaring a
+    volume with the same `name` coexist (suffix keying), and one's
+    teardown must not delete the other's data. Unlabeled disks
+    (created before labels existed) keep the prefix-only rule."""
+    t = gcp_adaptor.transport()
+    names: List[str] = []
+    page_token = None
+    while True:
+        params = {'filter': f'name eq {prefix}.*'}
+        if page_token:
+            params['pageToken'] = page_token
+        listing = t.request('GET', f'{_zone_url(project, zone)}/disks',
+                            params=params)
+        for item in listing.get('items', []):
+            name = item.get('name', '')
+            rest = name[len(prefix):]
+            if not (name.startswith(prefix) and rest
+                    and '-' not in rest):
+                continue
+            owner = (item.get('labels') or {}).get(CLUSTER_LABEL)
+            if owner:
+                if owner != cluster_name_on_cloud:
+                    continue
+            elif not rest.isdigit():
+                # Unlabeled disks predate the ownership label; only
+                # the legacy dense-numeric form is ours to sweep — a
+                # hand-created 'data-backup' next to a volume named
+                # 'data' must survive.
+                continue
+            names.append(name)
+        page_token = listing.get('nextPageToken')
+        if not page_token:
+            return names
+
+
+def _device_base(spec: Dict[str, Any], cluster_name_on_cloud: str,
+                 vol_index: int) -> str:
     """ONE name rule for attach + mount: a divergence here means the
-    startup script waits on a device that never appears."""
-    return spec.get('name') or f'{cluster_name_on_cloud}-vol'
+    startup script waits on a device that never appears. The first
+    unnamed volume keeps the historical `{cluster}-vol` base (disks
+    provisioned before the index suffix existed must keep resolving);
+    later unnamed volumes get their list index so two anonymous
+    volumes can't collide on disk/device name."""
+    if spec.get('name'):
+        return spec['name']
+    suffix = '' if vol_index == 0 else str(vol_index)
+    return f'{cluster_name_on_cloud}-vol{suffix}'
+
+
+def _node_key(vm_name: str, node_index: int,
+              cluster_name_on_cloud: str) -> str:
+    """Disk-name key for one node. Dense plain-compute names
+    (`{cluster}-{i}`) keep the index (historical naming); anything
+    else — MIG names are `{cluster}-{random}` — keys by the VM name's
+    unique suffix, which is stable across membership churn where a
+    positional index is not."""
+    if vm_name == f'{cluster_name_on_cloud}-{node_index}':
+        return str(node_index)
+    return vm_name.rsplit('-', 1)[-1]
 
 
 def volume_names(spec: Dict[str, Any], cluster_name_on_cloud: str,
-                 node_index: int) -> Dict[str, str]:
+                 vol_index: int, node_key: str) -> Dict[str, str]:
     """Disk + device names for one volume on one node. Per-node disks
     (a PD attaches read-write to one VM)."""
-    base = _device_base(spec, cluster_name_on_cloud)
-    return {'disk': f'{base}-{node_index}', 'device': base}
+    base = _device_base(spec, cluster_name_on_cloud, vol_index)
+    return {'disk': f'{base}-{node_key}', 'device': base}
 
 
 def mount_script(volumes: List[Dict[str, Any]],
@@ -89,9 +183,13 @@ def mount_script(volumes: List[Dict[str, Any]],
     mount at the declared path. Runs as root at boot, AFTER the
     provisioner attaches the disk — hence the wait loop."""
     lines = []
-    for spec in volumes:
-        device = _device_base(spec, cluster_name_on_cloud)
-        path = spec['mount_path']
+    for vi, spec in enumerate(volumes):
+        device = _device_base(spec, cluster_name_on_cloud, vi)
+        path = spec.get('mount_path')
+        if not path:
+            # Attach-only volume: the device shows up under
+            # /dev/disk/by-id/google-<name>; the user mounts it.
+            continue
         dev = f'/dev/disk/by-id/google-{device}'
         lines.append(
             f'for i in $(seq 1 60); do [ -e {dev} ] && break; sleep 2; '
@@ -114,38 +212,43 @@ def create_and_attach_all(config: common.ProvisionConfig,
         return
     project, zone = pc['project_id'], pc['zone']
     for i, vm_name in enumerate(node_names):
-        for spec in volumes:
-            names = volume_names(spec, cluster_name_on_cloud, i)
+        key = _node_key(vm_name, i, cluster_name_on_cloud)
+        for vi, spec in enumerate(volumes):
+            names = volume_names(spec, cluster_name_on_cloud, vi, key)
             disk_url = ensure_volume(
                 project, zone, names['disk'],
                 int(spec.get('size_gb', 100)),
-                spec.get('type', 'pd-balanced'))
+                spec.get('type', 'pd-balanced'),
+                cluster_name_on_cloud=cluster_name_on_cloud)
             attach_volume(project, zone, vm_name, disk_url,
                           names['device'])
 
 
 def delete_all(provider_config: Dict[str, Any],
-               cluster_name_on_cloud: str,
-               max_nodes: int = 1024) -> None:
+               cluster_name_on_cloud: str) -> None:
     """Best-effort volume teardown at cluster terminate (only volumes
-    not marked keep: true). Per-node disk names are dense (-0..-N-1),
-    so the sweep walks upward and stops at the first index that never
-    existed — no silent leak past an arbitrary cap."""
+    not marked keep: true). Enumerates surviving disks by name prefix
+    rather than walking indices, so holes from partial teardowns or
+    MIG name churn can't shadow disks into a silent leak."""
     volumes = provider_config.get('volumes') or []
     if not volumes:
         return
     project, zone = provider_config['project_id'], \
         provider_config['zone']
-    for spec in volumes:
+    for vi, spec in enumerate(volumes):
         if spec.get('keep'):
             continue
-        for i in range(max_nodes):
-            names = volume_names(spec, cluster_name_on_cloud, i)
+        base = _device_base(spec, cluster_name_on_cloud, vi)
+        try:
+            names = list_cluster_disks(project, zone, f'{base}-',
+                                       cluster_name_on_cloud)
+        except gcp_adaptor.GcpApiError as e:
+            logger.warning('volume listing for %s- failed: %s', base, e)
+            continue
+        for name in names:
             try:
-                if not delete_volume(project, zone, names['disk']):
-                    break  # dense names: first miss = past the end
+                delete_volume(project, zone, name)
             except gcp_adaptor.GcpApiError as e:
                 # Best-effort: a disk still detaching (VM deletion op
                 # in flight) must not fail the whole teardown.
-                logger.warning('volume %s delete failed: %s',
-                               names['disk'], e)
+                logger.warning('volume %s delete failed: %s', name, e)

@@ -213,6 +213,20 @@ def _cli_auth_port(request) -> int:
     return port
 
 
+def _cli_auth_state(request) -> str:
+    """The CLI's single-use nonce, echoed back through the token
+    delivery so the loopback listener can reject tokens it didn't ask
+    for (login-CSRF). Charset-restricted because it is reflected into
+    the consent page."""
+    import re
+
+    from aiohttp import web
+    state = request.query.get('state', '')
+    if not re.fullmatch(r'[A-Za-z0-9_-]{0,128}', state):
+        raise web.HTTPBadRequest(text='malformed ?state')
+    return state
+
+
 async def _handle_cli_auth(request):
     """CLI sign-in confirmation page. A bare GET must NOT hand out the
     token: SameSite=Lax cookies ride top-level GET navigations, so a
@@ -224,21 +238,25 @@ async def _handle_cli_auth(request):
     from skypilot_tpu.server import dashboard
     from aiohttp import web
     port = _cli_auth_port(request)
-    return web.Response(text=dashboard.cli_auth_page(port),
+    state = _cli_auth_state(request)
+    return web.Response(text=dashboard.cli_auth_page(port, state),
                         content_type='text/html')
 
 
 async def _handle_cli_auth_grant(request):
     """The authorized (same-origin POST) half of the CLI handoff:
-    returns the loopback callback URL carrying the user's token."""
+    returns the loopback callback URL plus the token. The page JS
+    POSTs the token to that URL in the request BODY — never in a
+    redirect query string, which would park the long-lived credential
+    in browser history and any proxy/autocomplete logging of loopback
+    URLs."""
     from skypilot_tpu import users
     port = _cli_auth_port(request)
-    import urllib.parse
     user = request.get('user', users.DEFAULT_USER)
     token = user.token or ''
     return _json_response({
-        'redirect': f'http://127.0.0.1:{port}/callback?'
-                    + urllib.parse.urlencode({'token': token})})
+        'post': f'http://127.0.0.1:{port}/callback',
+        'token': token})
 
 
 def _log_response(request, title: str, path: str):

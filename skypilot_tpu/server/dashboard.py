@@ -440,18 +440,39 @@ def login_page(next_url: str = '/dashboard') -> str:
 
 _CLI_AUTH_JS = """
 document.querySelector('button').addEventListener('click',async()=>{
+  const err=document.getElementById('err');
   const r=await fetch('/dashboard/api/cli-auth?port='+window.__port__,
                       {method:'POST'});
-  if(r.ok){const body=await r.json();location.href=body.redirect}
-  else{document.getElementById('err').textContent='authorization '+
-    'failed ('+r.status+')'}
+  if(!r.ok){err.textContent='authorization failed ('+r.status+')';return}
+  const body=await r.json();
+  const delivery={token:body.token,state:window.__state__};
+  try{
+    // Token travels in the POST body to the CLI's loopback listener
+    // (urlencoded = CORS simple request, no preflight) -- never in a
+    // URL, so it can't land in browser history or proxy logs. The
+    // state nonce proves this delivery answers the CLI's request.
+    const cb=await fetch(body.post,{method:'POST',
+      body:new URLSearchParams(delivery)});
+    if(!cb.ok)throw new Error('callback '+cb.status);
+    document.body.innerHTML='<form><h1>Logged in</h1>'+
+      '<p style="color:#8b949e">You can close this tab and return '+
+      'to the terminal.</p></form>';
+  }catch(e){
+    // Fallback: a browser that blocks page->loopback fetches
+    // outright (Chrome Private Network Access from an insecure
+    // public origin rejects before the preflight) still gets the
+    // token via a top-level redirect. Only this degraded path puts
+    // the token in a URL.
+    location.href=body.post+'?'+new URLSearchParams(delivery);
+  }
 });
 """
 
 
-def cli_auth_page(port: int) -> str:
+def cli_auth_page(port: int, state: str = '') -> str:
     """Explicit-consent page for `tsky api login --browser` (the
-    same-origin POST is the CSRF boundary — see app._handle_cli_auth)."""
+    same-origin POST is the CSRF boundary — see app._handle_cli_auth;
+    `state` is the CLI's nonce, echoed through the token delivery)."""
     return (
         '<!doctype html><html><head><title>Authorize CLI</title>'
         f'<style>{_LOGIN_CSS}</style></head><body>'
@@ -461,7 +482,8 @@ def cli_auth_page(port: int) -> str:
         'for your API token. Only continue if you started it.</p>'
         '<p id="err"></p>'
         '<button type="button">Authorize</button></form>'
-        f'<script>window.__port__={int(port)};{_CLI_AUTH_JS}'
+        f'<script>window.__port__={int(port)};'
+        f'window.__state__={json.dumps(state)};{_CLI_AUTH_JS}'
         '</script></body></html>')
 
 

@@ -217,8 +217,11 @@ class TestCliBrowserLogin:
             headers={'Cookie': 'skytpu_token=tok-admin'})
         with urllib.request.urlopen(req, timeout=10) as resp:
             body = json.loads(resp.read())
-        assert body['redirect'] == \
-            'http://127.0.0.1:45555/callback?token=tok-admin'
+        # Token rides the grant JSON + a loopback POST body — never a
+        # redirect URL (would persist in browser history/proxy logs).
+        assert body['post'] == 'http://127.0.0.1:45555/callback'
+        assert body['token'] == 'tok-admin'
+        assert 'redirect' not in body
 
     def test_anonymous_cli_auth_bounces_through_login_with_next(
             self, server):
@@ -244,8 +247,8 @@ class TestCliBrowserLogin:
     def test_browser_login_end_to_end(self, server):
         """The real client listener against the real server: the
         'browser' loads the consent page, clicks Authorize (the
-        same-origin POST), and follows the granted redirect to the
-        CLI's loopback callback."""
+        same-origin POST), and POSTs the granted token to the CLI's
+        loopback callback — token in the body, never in a URL."""
         _auth_on()
         from skypilot_tpu.client import oauth
 
@@ -262,11 +265,60 @@ class TestCliBrowserLogin:
                     f'{server.url}/dashboard/api/cli-auth?port={port}',
                     data=b'', method='POST', headers=cookie),
                     timeout=10)
-                redirect = json.loads(grant.read())['redirect']
-                urllib.request.urlopen(redirect, timeout=10).read()
+                state = url.rsplit('state=', 1)[1].split('&')[0]
+                body = json.loads(grant.read())
+                # A delivery with the WRONG state must be rejected
+                # (login-CSRF: any page can POST to the listener).
+                try:
+                    urllib.request.urlopen(urllib.request.Request(
+                        body['post'],
+                        data=urllib.parse.urlencode(
+                            {'token': 'evil', 'state': 'wrong'}
+                        ).encode(), method='POST'), timeout=10)
+                    raise AssertionError('forged state accepted')
+                except urllib.error.HTTPError as e:
+                    assert e.code == 403
+                resp = urllib.request.urlopen(urllib.request.Request(
+                    body['post'],
+                    data=urllib.parse.urlencode(
+                        {'token': body['token'],
+                         'state': state}).encode(),
+                    method='POST'), timeout=10)
+                assert resp.headers['Access-Control-Allow-Origin'] == '*'
             threading.Thread(target=_go, daemon=True).start()
             return True
 
         token = oauth.browser_login(server.url, timeout=20,
                                     open_browser=fake_browser)
         assert token == 'tok-admin'
+
+    def test_redirect_fallback_requires_state(self, server):
+        """The GET fallback (PNA-blocked browsers redirect with
+        token+state in the query) delivers only with the right state;
+        probes without the nonce are rejected."""
+        del server
+        from skypilot_tpu.client import oauth
+
+        def fake_browser(url):
+            import threading
+            port = url.rsplit('port=', 1)[1].split('&')[0]
+            state = url.rsplit('state=', 1)[1].split('&')[0]
+
+            def _go():
+                base = f'http://127.0.0.1:{port}/callback'
+                for probe in ('', '?token=evil',
+                              '?token=evil&state=nope'):
+                    try:
+                        urllib.request.urlopen(base + probe,
+                                               timeout=10).read()
+                        raise AssertionError(f'accepted {probe!r}')
+                    except urllib.error.HTTPError as e:
+                        assert e.code in (400, 403)
+                urllib.request.urlopen(
+                    f'{base}?token=fb&state={state}', timeout=10).read()
+            threading.Thread(target=_go, daemon=True).start()
+            return True
+
+        token = oauth.browser_login('http://127.0.0.1:1', timeout=20,
+                                    open_browser=fake_browser)
+        assert token == 'fb'

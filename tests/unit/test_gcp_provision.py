@@ -185,6 +185,8 @@ class FakeGcpApi:
             if method == 'POST':
                 self.disks[body['name']] = body
                 return {'status': 'DONE'}
+            if method == 'GET' and url.endswith('/disks'):
+                return {'items': [dict(d) for d in self.disks.values()]}
             if method == 'GET':
                 if tail in self.disks:
                     return self.disks[tail]
@@ -414,3 +416,82 @@ def test_kept_volume_survives_terminate(fake_api):
     gcp_provision.run_instances('us-central1', 'vol2', cfg)
     gcp_provision.terminate_instances('vol2', dict(cfg.provider_config))
     assert 'keepme-0' in fake_api.disks
+
+
+def test_two_unnamed_volumes_do_not_collide(fake_api):
+    """Two volumes without `name` must land on distinct disks/devices
+    (the first keeps the historical `<cluster>-vol` base); a volume
+    without mount_path is attach-only — present, but absent from the
+    mount script."""
+    cfg = _vm_config(count=1, extra_pc={'volumes': [
+        {'size_gb': 10, 'mount_path': '/a'},
+        {'size_gb': 20}]})
+    gcp_provision.run_instances('us-central1', 'vol3', cfg)
+    assert set(fake_api.disks) == {'vol3-vol-0', 'vol3-vol1-0'}
+    assert {d for _, d in fake_api.attachments} == \
+        {'vol3-vol', 'vol3-vol1'}
+    startup = [i['value'] for i in
+               fake_api.vms['vol3-0']['metadata']['items']
+               if i['key'] == 'startup-script'][0]
+    assert '/dev/disk/by-id/google-vol3-vol ' in startup
+    assert 'google-vol3-vol1' not in startup  # attach-only: no mount
+    gcp_provision.terminate_instances('vol3', dict(cfg.provider_config))
+    assert fake_api.disks == {}
+
+
+def test_same_named_volumes_across_clusters_isolated(fake_api):
+    """Two MIG clusters declaring a volume with the same `name`
+    coexist (VM-suffix keying gives distinct disks) AND one's
+    teardown must not sweep the other's — the cluster label scopes
+    the prefix listing."""
+    vols = {'use_mig': True,
+            'volumes': [{'name': 'data', 'size_gb': 10,
+                         'mount_path': '/d'}]}
+    cfg_a = _vm_config(count=1, extra_pc=dict(vols))
+    cfg_b = _vm_config(count=1, extra_pc=dict(vols))
+    gcp_provision.run_instances('us-central1', 'clua', cfg_a)
+    gcp_provision.run_instances('us-central1', 'club', cfg_b)
+    assert len(fake_api.disks) == 2
+    gcp_provision.terminate_instances('clua',
+                                      dict(cfg_a.provider_config))
+    # club's labeled disk survived clua's prefix sweep.
+    owners = {(d.get('labels') or {}).get('skytpu-cluster')
+              for d in fake_api.disks.values()}
+    assert owners == {'club'}
+
+
+def test_kept_volume_not_adopted_by_other_cluster(fake_api):
+    """A surviving `keep: true` disk belongs to its cluster: another
+    cluster declaring the same volume name must fail loudly, not
+    silently mount the first cluster's data."""
+    from skypilot_tpu import exceptions
+    vols = {'volumes': [{'name': 'data', 'size_gb': 10,
+                         'mount_path': '/d', 'keep': True}]}
+    cfg_a = _vm_config(count=1, extra_pc=dict(vols))
+    gcp_provision.run_instances('us-central1', 'owna', cfg_a)
+    gcp_provision.terminate_instances('owna', dict(cfg_a.provider_config))
+    assert 'data-0' in fake_api.disks  # kept
+    cfg_b = _vm_config(count=1, extra_pc=dict(vols))
+    with pytest.raises(exceptions.ProvisionError, match='owna'):
+        gcp_provision.run_instances('us-central1', 'ownb', cfg_b)
+
+
+def test_mig_volumes_keyed_by_vm_name_suffix(fake_api):
+    """MIG VM names carry random suffixes, so per-node disks key by
+    that suffix (positional indices would remap disks across nodes on
+    membership churn); teardown sweeps them by prefix listing."""
+    cfg = _vm_config(count=2, extra_pc={
+        'use_mig': True,
+        'volumes': [{'name': 'data', 'size_gb': 30,
+                     'mount_path': '/data'}]})
+    gcp_provision.run_instances('us-central1', 'mg3', cfg)
+    vm_names = [n for n in fake_api.vms if n.startswith('mg3-')]
+    expected = {f'data-{n.rsplit("-", 1)[-1]}' for n in vm_names}
+    assert set(fake_api.disks) == expected
+    # Relaunch with capacity up: nothing is "created", attach heals
+    # idempotently, disk set unchanged.
+    record = gcp_provision.run_instances('us-central1', 'mg3', cfg)
+    assert record.created_instance_ids == []
+    assert set(fake_api.disks) == expected
+    gcp_provision.terminate_instances('mg3', dict(cfg.provider_config))
+    assert fake_api.disks == {}
