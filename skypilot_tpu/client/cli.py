@@ -562,6 +562,191 @@ def storage_delete_cmd(names, all_storage, yes):
 
 
 @cli.group()
+def workspace():
+    """Manage workspaces (reference sky/workspaces/core.py CRUD)."""
+
+
+def _spec_from_flags(description, allowed_clouds, private,
+                     allowed_users):
+    """Only the flags given reach the server (update MERGES; omitted
+    fields keep their value). The literal `none` clears a list."""
+    def _listy(value):
+        if value.lower() == 'none':
+            return None
+        return [v.strip() for v in value.split(',')]
+    spec = {}
+    if description is not None:
+        spec['description'] = description
+    if allowed_clouds:
+        spec['allowed_clouds'] = _listy(allowed_clouds)
+    if private is not None:
+        spec['private'] = private
+    if allowed_users:
+        spec['allowed_users'] = _listy(allowed_users)
+    return spec
+
+
+_WS_FLAGS = [
+    click.option('--description', default=None),
+    click.option('--allowed-clouds', default=None,
+                 help='Comma-separated cloud allowlist for launches '
+                      'in this workspace (`none` clears it).'),
+    click.option('--private/--no-private', default=None,
+                 help='Restrict commands to --allowed-users.'),
+    click.option('--allowed-users', default=None,
+                 help='Comma-separated user names (with --private; '
+                      '`none` clears the list).'),
+]
+
+
+def _with_ws_flags(fn):
+    for flag in reversed(_WS_FLAGS):
+        fn = flag(fn)
+    return fn
+
+
+@workspace.command('list')
+def workspace_list():
+    """Workspaces with their policy and live-resource counts."""
+    from skypilot_tpu.client import sdk
+    fmt = '{:<16} {:<9} {:<9} {:<20} {}'
+    click.echo(fmt.format('NAME', 'CLUSTERS', 'STORAGE', 'CLOUDS',
+                          'DESCRIPTION'))
+    for ws in sdk.workspaces_list():
+        clouds = ','.join(ws.get('allowed_clouds') or []) or '(all)'
+        if ws.get('private'):
+            clouds += ' [private]'
+        click.echo(fmt.format(
+            ws['name'], ws['active']['clusters'],
+            ws['active']['storage'], clouds,
+            ws.get('description') or ''))
+
+
+@workspace.command('create')
+@click.argument('name')
+@_with_ws_flags
+def workspace_create(name, description, allowed_clouds, private,
+                     allowed_users):
+    """Create a workspace."""
+    from skypilot_tpu.client import sdk
+    ws = sdk.workspace_create(name, _spec_from_flags(
+        description, allowed_clouds, private, allowed_users))
+    click.echo(f'Created workspace {ws["name"]!r}.')
+
+
+@workspace.command('update')
+@click.argument('name')
+@_with_ws_flags
+def workspace_update(name, description, allowed_clouds, private,
+                     allowed_users):
+    """Replace a workspace's policy (refused while narrowing under
+    live resources)."""
+    from skypilot_tpu.client import sdk
+    ws = sdk.workspace_update(name, _spec_from_flags(
+        description, allowed_clouds, private, allowed_users))
+    click.echo(f'Updated workspace {ws["name"]!r}.')
+
+
+@workspace.command('delete')
+@click.argument('name')
+@click.option('--yes', '-y', is_flag=True)
+def workspace_delete(name, yes):
+    """Delete a workspace (refused while it has live resources)."""
+    from skypilot_tpu.client import sdk
+    if not yes:
+        click.confirm(f'Delete workspace {name!r}?', abort=True)
+    sdk.workspace_delete(name)
+    click.echo(f'Deleted workspace {name!r}.')
+
+
+@cli.group()
+def user():
+    """Manage API users (reference sky/users/server.py CRUD)."""
+
+
+@user.command('list')
+def user_list():
+    """All users: config-declared and API-created."""
+    from skypilot_tpu.client import sdk
+    fmt = '{:<16} {:<8} {:<14} {:<8} {}'
+    click.echo(fmt.format('NAME', 'ROLE', 'WORKSPACE', 'SOURCE',
+                          'STATE'))
+    for u in sdk.users_list():
+        click.echo(fmt.format(
+            u['name'], u['role'], u['workspace'], u['source'],
+            'disabled' if u.get('disabled') else 'active'))
+
+
+@user.command('add')
+@click.argument('name')
+@click.option('--role', default='user',
+              type=click.Choice(['admin', 'user', 'viewer']))
+@click.option('--workspace', default='default')
+def user_add(name, role, workspace):
+    """Create a user; prints the generated token ONCE."""
+    from skypilot_tpu.client import sdk
+    doc = sdk.user_create(name, role=role, workspace=workspace)
+    click.echo(f'Created user {doc["name"]!r} (role {doc["role"]}, '
+               f'workspace {doc["workspace"]}).')
+    click.echo(f'Token (shown once): {doc["token"]}')
+
+
+@user.command('rotate')
+@click.argument('name')
+def user_rotate(name):
+    """Invalidate the user's token and print the new one ONCE."""
+    from skypilot_tpu.client import sdk
+    doc = sdk.user_rotate(name)
+    click.echo(f'New token for {name!r} (shown once): {doc["token"]}')
+
+
+@user.command('set-role')
+@click.argument('name')
+@click.argument('role', type=click.Choice(['admin', 'user', 'viewer']))
+def user_set_role(name, role):
+    from skypilot_tpu.client import sdk
+    sdk.user_update(name, role=role)
+    click.echo(f'User {name!r} is now role {role}.')
+
+
+@user.command('set-workspace')
+@click.argument('name')
+@click.argument('workspace')
+def user_set_workspace(name, workspace):
+    from skypilot_tpu.client import sdk
+    sdk.user_update(name, workspace=workspace)
+    click.echo(f'User {name!r} now works in {workspace!r}.')
+
+
+@user.command('disable')
+@click.argument('name')
+def user_disable(name):
+    """Reject the user's token without deleting the account."""
+    from skypilot_tpu.client import sdk
+    sdk.user_update(name, disabled=True)
+    click.echo(f'User {name!r} disabled.')
+
+
+@user.command('enable')
+@click.argument('name')
+def user_enable(name):
+    from skypilot_tpu.client import sdk
+    sdk.user_update(name, disabled=False)
+    click.echo(f'User {name!r} enabled.')
+
+
+@user.command('rm')
+@click.argument('name')
+@click.option('--yes', '-y', is_flag=True)
+def user_rm(name, yes):
+    from skypilot_tpu.client import sdk
+    if not yes:
+        click.confirm(f'Delete user {name!r}?', abort=True)
+    sdk.user_delete(name)
+    click.echo(f'Deleted user {name!r}.')
+
+
+@cli.group()
 def api():
     """Manage the API server."""
 

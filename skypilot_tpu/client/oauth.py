@@ -35,6 +35,7 @@ _SUCCESS_PAGE = (b'<!doctype html><html><body style="font-family:'
 class _Callback(http.server.BaseHTTPRequestHandler):
     token: Optional[str] = None
     state: str = ''
+    error: Optional[str] = None
     event: threading.Event
 
     def _accept(self, params) -> bool:
@@ -51,11 +52,15 @@ class _Callback(http.server.BaseHTTPRequestHandler):
             return False
         if 'state' not in params:
             # A token WITHOUT a state is an old server's redirect
-            # delivery — fail fast and say so instead of 403-looping
-            # until the CLI's 180s timeout.
-            self.send_error(
-                403, explain='no state: this API server is too old '
-                'for --browser login; use `tsky api login --token`')
+            # delivery — fail fast IN THE TERMINAL (set error + wake
+            # browser_login) instead of 403-looping a message into a
+            # browser tab until the CLI's 180s timeout.
+            type(self).error = (
+                'This API server is too old for --browser login '
+                '(it delivered a token without the state nonce); '
+                'use `tsky api login --token ...` instead.')
+            self.send_error(403, explain='no state (old server)')
+            type(self).event.set()
             return False
         got = params['state'][0]
         # bytes comparison: compare_digest raises on non-ASCII str.
@@ -126,7 +131,8 @@ def browser_login(endpoint: str, timeout: float = 180.0,
     token the server hands back (empty string = open local mode)."""
     state = secrets.token_urlsafe(16)
     handler = type('Handler', (_Callback,), {
-        'token': None, 'state': state, 'event': threading.Event()})
+        'token': None, 'state': state, 'error': None,
+        'event': threading.Event()})
     server = http.server.HTTPServer(('127.0.0.1', 0), handler)
     port = server.server_address[1]
     thread = threading.Thread(target=server.serve_forever, daemon=True)
@@ -140,7 +146,10 @@ def browser_login(endpoint: str, timeout: float = 180.0,
             raise exceptions.SkyTpuError(
                 f'Browser login timed out after {timeout:.0f}s; '
                 'use `tsky api login --token ...` instead.')
-        return handler.token or ''
+        if handler.token is None:
+            raise exceptions.SkyTpuError(
+                handler.error or 'Browser login failed.')
+        return handler.token
     finally:
         server.shutdown()
         thread.join(timeout=5)

@@ -342,6 +342,67 @@ def accelerators(name_filter: Optional[str] = None) -> str:
     return _submit('accelerators', {'name_filter': name_filter})
 
 
+# --- admin: workspaces + users (synchronous CRUD, not queued) --------------
+
+def workspaces_list() -> List[Dict[str, Any]]:
+    ensure_server_running()
+    return _request_raw('GET', '/workspaces')
+
+
+def workspace_create(name: str, spec: Dict[str, Any]) -> Dict[str, Any]:
+    ensure_server_running()
+    return _request_raw('POST', '/workspaces',
+                        {'name': name, **spec})
+
+
+def workspace_update(name: str, spec: Dict[str, Any]) -> Dict[str, Any]:
+    ensure_server_running()
+    return _request_raw('PUT', f'/workspaces/{name}', spec)
+
+
+def workspace_delete(name: str) -> Dict[str, Any]:
+    ensure_server_running()
+    return _request_raw('DELETE', f'/workspaces/{name}')
+
+
+def users_list() -> List[Dict[str, Any]]:
+    ensure_server_running()
+    return _request_raw('GET', '/users')
+
+
+def user_create(name: str, role: str = 'user',
+                workspace: str = 'default') -> Dict[str, Any]:
+    """Returns the doc with the generated token (echoed exactly once)."""
+    ensure_server_running()
+    return _request_raw('POST', '/users',
+                        {'name': name, 'role': role,
+                         'workspace': workspace})
+
+
+def user_rotate(name: str) -> Dict[str, Any]:
+    ensure_server_running()
+    return _request_raw('POST', f'/users/{name}/rotate', {})
+
+
+def user_update(name: str, role: Optional[str] = None,
+                workspace: Optional[str] = None,
+                disabled: Optional[bool] = None) -> Dict[str, Any]:
+    ensure_server_running()
+    payload: Dict[str, Any] = {}
+    if role is not None:
+        payload['role'] = role
+    if workspace is not None:
+        payload['workspace'] = workspace
+    if disabled is not None:
+        payload['disabled'] = disabled
+    return _request_raw('PUT', f'/users/{name}', payload)
+
+
+def user_delete(name: str) -> Dict[str, Any]:
+    ensure_server_running()
+    return _request_raw('DELETE', f'/users/{name}')
+
+
 def api_server_pid() -> Optional[int]:
     """Pid of the (local) API server from its health endpoint."""
     try:

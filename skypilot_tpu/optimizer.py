@@ -17,6 +17,7 @@ from skypilot_tpu import check as check_lib
 from skypilot_tpu import clouds as clouds_lib
 from skypilot_tpu import exceptions
 from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import state
 from skypilot_tpu.catalog.common import InstanceTypeInfo
 from skypilot_tpu.utils import accelerators as acc_lib
 
@@ -262,6 +263,20 @@ class Optimizer:
         up front; the reasons surface in the no-candidates error."""
         enabled = check_lib.get_cached_enabled_clouds_or_refresh(
             raise_if_no_cloud_access=True)
+        # Workspace policy: a workspace may pin its launches to a
+        # cloud subset (workspaces/core.py allowed_clouds) — enforced
+        # here so disallowed clouds are never even candidates.
+        from skypilot_tpu import workspaces
+        ws_clouds = workspaces.allowed_clouds(state.active_workspace())
+        if ws_clouds is not None:
+            allowed = {c.lower() for c in ws_clouds}
+            enabled = [c for c in enabled if c.lower() in allowed]
+            if not enabled:
+                raise exceptions.ResourcesUnavailableError(
+                    f'Workspace {state.active_workspace()!r} allows '
+                    f'only clouds {sorted(allowed)}, none of which '
+                    'are enabled. Run `tsky check` or widen the '
+                    'workspace policy.')
         out: List[Tuple[resources_lib.Resources, float]] = []
         excluded: Dict[str, List[str]] = {}
         for base in task.resources:

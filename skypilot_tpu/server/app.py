@@ -333,6 +333,121 @@ async def _handle_service_log(request):
                          serve_state.controller_log_path(name))
 
 
+def _require_admin(request):
+    from aiohttp import web
+
+    from skypilot_tpu import users
+    user = request.get('user', users.DEFAULT_USER)
+    if user.role != users.ROLE_ADMIN:
+        raise web.HTTPForbidden(
+            text=f'User {user.name!r} (role {user.role}) may not '
+                 'administer users/workspaces.')
+    return user
+
+
+async def _admin_body(request) -> Dict[str, Any]:
+    from aiohttp import web
+    try:
+        body = await request.json()
+    except json.JSONDecodeError:
+        raise web.HTTPBadRequest(text='need a JSON body')
+    if not isinstance(body, dict):
+        raise web.HTTPBadRequest(text='need a JSON object body')
+    return body
+
+
+def _admin_call(fn, *args, **kwargs):
+    """Run a workspaces/users core call, mapping its error taxonomy
+    onto HTTP (ValueError → 400, in-use guard → 409)."""
+    from aiohttp import web
+
+    from skypilot_tpu import workspaces
+    try:
+        return fn(*args, **kwargs)
+    except workspaces.WorkspaceInUseError as e:
+        raise web.HTTPConflict(text=str(e))
+    except ValueError as e:
+        raise web.HTTPBadRequest(text=str(e))
+
+
+async def _handle_workspaces_list(request):
+    from skypilot_tpu import workspaces
+    return _json_response(workspaces.list_workspaces())
+
+
+async def _handle_workspace_create(request):
+    """Reference sky/workspaces/server.py create → core.py:256."""
+    from skypilot_tpu import workspaces
+    _require_admin(request)
+    body = await _admin_body(request)
+    name = str(body.pop('name', ''))
+    return _json_response(_admin_call(workspaces.create, name, body),
+                          status=201)
+
+
+async def _handle_workspace_update(request):
+    from skypilot_tpu import workspaces
+    _require_admin(request)
+    body = await _admin_body(request)
+    name = request.match_info['name']
+    return _json_response(_admin_call(workspaces.update, name, body))
+
+
+async def _handle_workspace_delete(request):
+    """Reference sky/workspaces/core.py:304 — 409 while clusters or
+    storage are live in the workspace."""
+    from skypilot_tpu import workspaces
+    _require_admin(request)
+    _admin_call(workspaces.delete, request.match_info['name'])
+    return _json_response({'deleted': request.match_info['name']})
+
+
+async def _handle_users_list(request):
+    from skypilot_tpu.users import store
+    _require_admin(request)
+    return _json_response(store.list_users())
+
+
+async def _handle_user_create(request):
+    """Reference sky/users/server.py user creation; the response is
+    the ONLY place the generated token is ever echoed."""
+    from skypilot_tpu import users
+    from skypilot_tpu.users import store
+    _require_admin(request)
+    body = await _admin_body(request)
+    doc = _admin_call(
+        store.create_user, str(body.get('name', '')),
+        role=str(body.get('role', users.ROLE_USER)),
+        workspace=str(body.get('workspace', users.DEFAULT_WORKSPACE)))
+    return _json_response(doc, status=201)
+
+
+async def _handle_user_rotate(request):
+    from skypilot_tpu.users import store
+    _require_admin(request)
+    doc = _admin_call(store.rotate_token, request.match_info['name'])
+    return _json_response(doc)
+
+
+async def _handle_user_update(request):
+    from skypilot_tpu.users import store
+    _require_admin(request)
+    body = await _admin_body(request)
+    disabled = body.get('disabled')
+    doc = _admin_call(
+        store.update_user, request.match_info['name'],
+        role=body.get('role'), workspace=body.get('workspace'),
+        disabled=None if disabled is None else bool(disabled))
+    return _json_response(doc)
+
+
+async def _handle_user_delete(request):
+    from skypilot_tpu.users import store
+    _require_admin(request)
+    _admin_call(store.delete_user, request.match_info['name'])
+    return _json_response({'deleted': request.match_info['name']})
+
+
 async def _handle_health(request):
     return _json_response({
         'status': 'healthy',
@@ -415,6 +530,23 @@ def create_app():
     from skypilot_tpu.server import ws_proxy
     app.router.add_get(f'{API_PREFIX}/clusters/{{cluster}}/shell',
                        ws_proxy.handle_ws_shell)
+    # Admin CRUD (registered before the catch-all command POST).
+    app.router.add_get(f'{API_PREFIX}/workspaces',
+                       _handle_workspaces_list)
+    app.router.add_post(f'{API_PREFIX}/workspaces',
+                        _handle_workspace_create)
+    app.router.add_put(f'{API_PREFIX}/workspaces/{{name}}',
+                       _handle_workspace_update)
+    app.router.add_delete(f'{API_PREFIX}/workspaces/{{name}}',
+                          _handle_workspace_delete)
+    app.router.add_get(f'{API_PREFIX}/users', _handle_users_list)
+    app.router.add_post(f'{API_PREFIX}/users', _handle_user_create)
+    app.router.add_post(f'{API_PREFIX}/users/{{name}}/rotate',
+                        _handle_user_rotate)
+    app.router.add_put(f'{API_PREFIX}/users/{{name}}',
+                       _handle_user_update)
+    app.router.add_delete(f'{API_PREFIX}/users/{{name}}',
+                          _handle_user_delete)
     app.router.add_post(f'{API_PREFIX}/{{name}}', _handle_command)
     return app
 

@@ -99,10 +99,18 @@ def middlewares():
 
 
 def check_command_allowed(request, name: str) -> None:
-    """RBAC gate for command POSTs (403 on role violation)."""
+    """RBAC gate for command POSTs (403 on role violation), plus the
+    private-workspace gate: commands in a `private: true` workspace
+    require membership in its allowed_users (admins pass)."""
     from aiohttp import web
     user = request.get('user', users.DEFAULT_USER)
     if not permission.allowed(user, name):
         raise web.HTTPForbidden(
             text=f'User {user.name!r} (role {user.role}) may not run '
                  f'{name!r}.')
+    from skypilot_tpu import workspaces
+    if not workspaces.user_may_act_in(user.name, user.role,
+                                      user.workspace):
+        raise web.HTTPForbidden(
+            text=f'Workspace {user.workspace!r} is private and user '
+                 f'{user.name!r} is not in its allowed_users.')

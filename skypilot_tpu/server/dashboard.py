@@ -446,25 +446,30 @@ document.querySelector('button').addEventListener('click',async()=>{
   if(!r.ok){err.textContent='authorization failed ('+r.status+')';return}
   const body=await r.json();
   const delivery={token:body.token,state:window.__state__};
+  let cb=null;
   try{
     // Token travels in the POST body to the CLI's loopback listener
     // (urlencoded = CORS simple request, no preflight) -- never in a
     // URL, so it can't land in browser history or proxy logs. The
     // state nonce proves this delivery answers the CLI's request.
-    const cb=await fetch(body.post,{method:'POST',
+    cb=await fetch(body.post,{method:'POST',
       body:new URLSearchParams(delivery)});
-    if(!cb.ok)throw new Error('callback '+cb.status);
-    document.body.innerHTML='<form><h1>Logged in</h1>'+
-      '<p style="color:#8b949e">You can close this tab and return '+
-      'to the terminal.</p></form>';
   }catch(e){
-    // Fallback: a browser that blocks page->loopback fetches
-    // outright (Chrome Private Network Access from an insecure
-    // public origin rejects before the preflight) still gets the
-    // token via a top-level redirect. Only this degraded path puts
-    // the token in a URL.
+    // fetch THREW = the request never reached the listener (Chrome
+    // Private Network Access blocks page->loopback from insecure
+    // public origins before any preflight). Top-level redirects are
+    // exempt, so fall back to one -- the only degraded path that
+    // puts the token in a URL. An HTTP error (403 stale state etc.)
+    // must NOT land here: the listener is reachable and re-sending
+    // the token in a URL would only leak it.
     location.href=body.post+'?'+new URLSearchParams(delivery);
+    return;
   }
+  if(!cb.ok){err.textContent='the CLI listener rejected the '+
+    'delivery ('+cb.status+') -- is another login running?';return}
+  document.body.innerHTML='<form><h1>Logged in</h1>'+
+    '<p style="color:#8b949e">You can close this tab and return '+
+    'to the terminal.</p></form>';
 });
 """
 

@@ -52,6 +52,13 @@ def _get_conn() -> sqlite3.Connection:
         return _conn
 
 
+def connection() -> sqlite3.Connection:
+    """The shared state-DB connection, for sibling stores (workspaces,
+    users) that live in the same sqlite file and want the same WAL /
+    busy-timeout discipline."""
+    return _get_conn()
+
+
 def reset_for_tests() -> None:
     global _conn, _conn_path
     with _lock:

@@ -45,7 +45,8 @@ class User:
 DEFAULT_USER = User(name='default', role=ROLE_ADMIN)
 
 
-def configured_users() -> List[User]:
+def configured_users_from_config() -> List[User]:
+    """Users declared in the config file only (no DB users)."""
     from skypilot_tpu import config as config_lib
     raw = config_lib.get_nested(('api_server', 'users'), default=None)
     users: List[User] = []
@@ -62,11 +63,26 @@ def configured_users() -> List[User]:
     return users
 
 
+def configured_users() -> List[User]:
+    """All users the auth layer accepts: config-declared plus enabled
+    DB users (users/store.py CRUD); config wins on name collisions."""
+    users = configured_users_from_config()
+    names = {u.name for u in users}
+    from skypilot_tpu.users import store
+    users.extend(u for u in store.enabled_db_users()
+                 if u.name not in names)
+    return users
+
+
 def auth_required() -> bool:
+    """Auth posture comes from the CONFIG only (the flag or declared
+    users). API-created DB users deliberately don't flip it: an admin
+    adding a user in open local mode must not lock every tokenless
+    client (themselves included) out of the server."""
     from skypilot_tpu import config as config_lib
     if config_lib.get_nested(('api_server', 'auth'), default=False):
         return True
-    return bool(configured_users())
+    return bool(configured_users_from_config())
 
 
 def user_for_token(token: Optional[str]) -> Optional[User]:

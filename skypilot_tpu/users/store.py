@@ -1,0 +1,191 @@
+"""DB-backed user management: add / rotate / disable / delete over
+the API, next to the config-declared user list.
+
+Reference analog: sky/users/server.py (user CRUD endpoints + service
+accounts) and sky/global_user_state user tables. Two sources feed the
+auth layer:
+
+  1. config users (`api_server.users` in ~/.skytpu/config.yaml) —
+     declarative, operator-managed, immutable through the API (the
+     API answering "edit your config file" beats two writers fighting
+     over one YAML document);
+  2. DB users (this module) — created through `tsky user add` /
+     POST /api/v1/users, with server-generated tokens, rotation, and
+     disable without delete.
+
+On a name collision the config entry wins (the operator's file is
+the higher authority). Tokens are stored in the server's state DB the
+same way the config stores them — the DB file lives under the
+server's state dir with user-only permissions.
+"""
+import secrets
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import state
+from skypilot_tpu import users as users_lib
+
+
+_table_ready_for: Optional[str] = None
+
+
+def _ensure_table() -> None:
+    """Once per process per DB path: user_for_token runs on EVERY
+    authenticated request, and schema DDL + commit there would
+    serialize the API server on sqlite write locks."""
+    global _table_ready_for
+    from skypilot_tpu.utils import paths
+    path = paths.state_db_path()
+    if _table_ready_for == path:
+        return
+    conn = state.connection()
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS users (
+            name TEXT PRIMARY KEY,
+            token TEXT,
+            role TEXT,
+            workspace TEXT,
+            disabled INTEGER DEFAULT 0,
+            created_at INTEGER
+        )""")
+    conn.commit()
+    _table_ready_for = path
+
+
+def _new_token() -> str:
+    return f'sky-{secrets.token_urlsafe(24)}'
+
+
+def _row_to_doc(row, with_token: bool = False) -> Dict[str, Any]:
+    name, token, role, workspace, disabled, created_at = row
+    doc = {'name': name, 'role': role, 'workspace': workspace,
+           'disabled': bool(disabled), 'created_at': created_at,
+           'source': 'db'}
+    if with_token:
+        doc['token'] = token
+    return doc
+
+
+def list_users() -> List[Dict[str, Any]]:
+    """Merged listing: config users (tokens never echoed) + DB users
+    (disabled ones included — the point of disable is to keep them
+    visible)."""
+    _ensure_table()
+    conn = state.connection()
+    db_rows = conn.execute(
+        'SELECT name, token, role, workspace, disabled, created_at '
+        'FROM users ORDER BY name').fetchall()
+    config_names = set()
+    out = []
+    for u in users_lib.configured_users_from_config():
+        config_names.add(u.name)
+        out.append({'name': u.name, 'role': u.role,
+                    'workspace': u.workspace, 'disabled': False,
+                    'created_at': None, 'source': 'config'})
+    for row in db_rows:
+        if row[0] in config_names:
+            continue  # config wins on collisions
+        out.append(_row_to_doc(row))
+    return out
+
+
+def get_user(name: str) -> Optional[Dict[str, Any]]:
+    _ensure_table()
+    conn = state.connection()
+    row = conn.execute(
+        'SELECT name, token, role, workspace, disabled, created_at '
+        'FROM users WHERE name=?', (name,)).fetchone()
+    return _row_to_doc(row) if row else None
+
+
+def enabled_db_users() -> List['users_lib.User']:
+    """The DB users the auth layer accepts tokens from."""
+    _ensure_table()
+    conn = state.connection()
+    rows = conn.execute(
+        'SELECT name, token, role, workspace FROM users '
+        'WHERE disabled=0').fetchall()
+    return [users_lib.User(name=r[0], token=r[1], role=r[2],
+                           workspace=r[3] or users_lib.DEFAULT_WORKSPACE)
+            for r in rows]
+
+
+def _check_name_free(name: str) -> None:
+    if any(u.name == name
+           for u in users_lib.configured_users_from_config()):
+        raise ValueError(
+            f'User {name!r} is declared in the server config file; '
+            'manage it by editing api_server.users there.')
+
+
+def create_user(name: str, role: str = users_lib.ROLE_USER,
+                workspace: str = users_lib.DEFAULT_WORKSPACE
+                ) -> Dict[str, Any]:
+    """Add a user; returns the doc INCLUDING the generated token —
+    the only time it is ever echoed."""
+    _ensure_table()
+    if not name or not name.replace('-', '').replace('_', '').isalnum():
+        raise ValueError(f'User name {name!r} must be alphanumeric '
+                         'with - or _')
+    if role not in users_lib.ROLES:
+        raise ValueError(f'Unknown role {role!r} '
+                         f'(one of {users_lib.ROLES})')
+    _check_name_free(name)
+    if get_user(name) is not None:
+        raise ValueError(f'User {name!r} already exists.')
+    conn = state.connection()
+    token = _new_token()
+    conn.execute(
+        'INSERT INTO users (name, token, role, workspace, disabled, '
+        'created_at) VALUES (?, ?, ?, ?, 0, ?)',
+        (name, token, role, workspace, int(time.time())))
+    conn.commit()
+    doc = get_user(name)
+    doc['token'] = token
+    return doc
+
+
+def rotate_token(name: str) -> Dict[str, Any]:
+    """Invalidate the old token, return the new one (once)."""
+    _require_db_user(name)
+    conn = state.connection()
+    token = _new_token()
+    conn.execute('UPDATE users SET token=? WHERE name=?', (token, name))
+    conn.commit()
+    doc = get_user(name)
+    doc['token'] = token
+    return doc
+
+
+def update_user(name: str, role: Optional[str] = None,
+                workspace: Optional[str] = None,
+                disabled: Optional[bool] = None) -> Dict[str, Any]:
+    _require_db_user(name)
+    if role is not None and role not in users_lib.ROLES:
+        raise ValueError(f'Unknown role {role!r} '
+                         f'(one of {users_lib.ROLES})')
+    conn = state.connection()
+    if role is not None:
+        conn.execute('UPDATE users SET role=? WHERE name=?',
+                     (role, name))
+    if workspace is not None:
+        conn.execute('UPDATE users SET workspace=? WHERE name=?',
+                     (workspace, name))
+    if disabled is not None:
+        conn.execute('UPDATE users SET disabled=? WHERE name=?',
+                     (1 if disabled else 0, name))
+    conn.commit()
+    return get_user(name)
+
+
+def delete_user(name: str) -> None:
+    _require_db_user(name)
+    conn = state.connection()
+    conn.execute('DELETE FROM users WHERE name=?', (name,))
+    conn.commit()
+
+
+def _require_db_user(name: str) -> None:
+    _check_name_free(name)
+    if get_user(name) is None:
+        raise ValueError(f'No such user {name!r}.')

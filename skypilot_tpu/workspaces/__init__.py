@@ -1,0 +1,10 @@
+"""Workspace registry — multi-tenancy boundaries over one API server.
+
+Reference analog: sky/workspaces/core.py (CRUD with active-resource
+guards at :210 update, :256 create, :304 delete) + workspaces/server.py
+REST routes. See core.py for the TPU-build design notes.
+"""
+from skypilot_tpu.workspaces.core import (  # noqa: F401
+    DEFAULT_WORKSPACE, WorkspaceInUseError, active_resources,
+    allowed_clouds, create, delete, get, list_workspaces, update,
+    user_may_act_in)

@@ -1,0 +1,244 @@
+"""Workspace CRUD with active-resource guards.
+
+Reference analog: sky/workspaces/core.py — create (:256), update
+(:210), delete (:304, refusing while clusters/jobs are live in the
+workspace). The reference stores workspaces as a `workspaces:` section
+of the user config and serializes edits through a file lock; ours live
+in the server's state DB (the same sqlite file as clusters/storage),
+which gives the CRUD endpoints transactional updates for free and
+keeps the config file a declarative input rather than a mutable
+store. The `default` workspace always exists and cannot be deleted.
+
+Spec fields (all optional):
+    description:    free text
+    allowed_clouds: list — optimize-time filter; a launch in this
+                    workspace only considers these clouds
+                    (enforced in optimizer._fill_in_launchable_resources)
+    private:        bool — when true, only `allowed_users` + admins
+                    may run commands in the workspace (enforced in
+                    server.auth.check_command_allowed)
+    allowed_users:  list of user names (with private: true)
+"""
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import state
+
+DEFAULT_WORKSPACE = 'default'
+
+_SPEC_KEYS = frozenset(
+    {'description', 'allowed_clouds', 'private', 'allowed_users'})
+
+
+class WorkspaceInUseError(exceptions.SkyTpuError):
+    """Mutation refused because live resources exist in the workspace."""
+
+
+_table_ready_for: Optional[str] = None
+
+
+def _ensure_table() -> None:
+    """Once per process per DB path (tests re-point the state dir):
+    schema DDL + commit per REQUEST would serialize the API server on
+    sqlite write locks."""
+    global _table_ready_for
+    from skypilot_tpu.utils import paths
+    path = paths.state_db_path()
+    if _table_ready_for == path:
+        return
+    conn = state.connection()
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS workspaces (
+            name TEXT PRIMARY KEY,
+            spec_json TEXT,
+            created_at INTEGER
+        )""")
+    conn.commit()
+    _table_ready_for = path
+
+
+def _validate_spec(spec: Dict[str, Any]) -> Dict[str, Any]:
+    bad = set(spec) - _SPEC_KEYS
+    if bad:
+        raise ValueError(
+            f'Unknown workspace spec keys: {sorted(bad)} '
+            f'(allowed: {sorted(_SPEC_KEYS)})')
+    for key in ('allowed_clouds', 'allowed_users'):
+        if key in spec and not (
+                isinstance(spec[key], list)
+                and all(isinstance(x, str) for x in spec[key])):
+            raise ValueError(f'{key} must be a list of strings')
+    if 'allowed_clouds' in spec:
+        from skypilot_tpu import clouds as clouds_lib
+        known = set(clouds_lib.CLOUD_REGISTRY.names())
+        unknown = [c for c in spec['allowed_clouds'] if c not in known]
+        if unknown:
+            raise ValueError(f'Unknown clouds in allowed_clouds: '
+                             f'{unknown}')
+    if 'private' in spec and not isinstance(spec['private'], bool):
+        raise ValueError('private must be a boolean')
+    if 'description' in spec and not isinstance(spec['description'],
+                                                str):
+        raise ValueError('description must be a string')
+    return spec
+
+
+def active_resources(name: str) -> Dict[str, int]:
+    """Live resources pinning a workspace: clusters (any status —
+    STOPPED still owns disks) and storage objects."""
+    conn = state.connection()
+    clusters = conn.execute(
+        'SELECT COUNT(*) FROM clusters WHERE workspace=?',
+        (name,)).fetchone()[0]
+    storage = conn.execute(
+        'SELECT COUNT(*) FROM storage WHERE workspace=?',
+        (name,)).fetchone()[0]
+    return {'clusters': clusters, 'storage': storage}
+
+
+def _row_to_doc(name: str, spec_json: str,
+                created_at: Optional[int]) -> Dict[str, Any]:
+    doc = {'name': name, 'created_at': created_at}
+    doc.update(json.loads(spec_json) if spec_json else {})
+    doc['active'] = active_resources(name)
+    return doc
+
+
+def list_workspaces() -> List[Dict[str, Any]]:
+    """All workspaces, `default` first (it exists implicitly even on a
+    fresh DB)."""
+    _ensure_table()
+    conn = state.connection()
+    rows = conn.execute(
+        'SELECT name, spec_json, created_at FROM workspaces '
+        'ORDER BY name').fetchall()
+    docs = [_row_to_doc(*row) for row in rows]
+    if not any(d['name'] == DEFAULT_WORKSPACE for d in docs):
+        docs.insert(0, _row_to_doc(DEFAULT_WORKSPACE, '', None))
+    return docs
+
+
+def get(name: str) -> Optional[Dict[str, Any]]:
+    _ensure_table()
+    conn = state.connection()
+    row = conn.execute(
+        'SELECT name, spec_json, created_at FROM workspaces '
+        'WHERE name=?', (name,)).fetchone()
+    if row is None:
+        if name == DEFAULT_WORKSPACE:
+            return _row_to_doc(DEFAULT_WORKSPACE, '', None)
+        return None
+    return _row_to_doc(*row)
+
+
+def create(name: str, spec: Optional[Dict[str, Any]] = None
+           ) -> Dict[str, Any]:
+    """Reference sky/workspaces/core.py:256."""
+    _ensure_table()
+    if not name or not name.replace('-', '').replace('_', '').isalnum():
+        raise ValueError(
+            f'Workspace name {name!r} must be alphanumeric with - or _')
+    spec = _validate_spec(spec or {})
+    conn = state.connection()
+    if get(name) is not None:
+        raise ValueError(f'Workspace {name!r} already exists.')
+    conn.execute(
+        'INSERT INTO workspaces (name, spec_json, created_at) '
+        'VALUES (?, ?, ?)', (name, json.dumps(spec), int(time.time())))
+    conn.commit()
+    return get(name)
+
+
+def update(name: str, spec: Dict[str, Any]) -> Dict[str, Any]:
+    """MERGE into a workspace's spec: keys present in `spec` replace,
+    keys set to None are cleared, omitted keys keep their value — a
+    description edit must not silently strip a private workspace's
+    policy. Refused while the workspace has live resources UNLESS the
+    change is additive-safe (description edits, widening
+    allowed_clouds/allowed_users) — narrowing policy under running
+    clusters is how you strand resources you can no longer manage
+    (reference sky/workspaces/core.py:210 takes the same
+    no-active-resources stance)."""
+    _ensure_table()
+    current = get(name)
+    if current is None:
+        raise ValueError(f'No workspace {name!r}.')
+    cleared = {k for k, v in spec.items() if v is None}
+    spec = _validate_spec({k: v for k, v in spec.items()
+                           if v is not None})
+    if bad := cleared - _SPEC_KEYS:
+        raise ValueError(f'Unknown workspace spec keys: {sorted(bad)}')
+    current_spec = {k: v for k, v in current.items()
+                    if k in _SPEC_KEYS}
+    merged = {k: v for k, v in {**current_spec, **spec}.items()
+              if k not in cleared}
+    active = active_resources(name)
+    if any(active.values()) and _narrows(current, merged):
+        raise WorkspaceInUseError(
+            f'Workspace {name!r} has live resources ({active}); '
+            'narrowing its policy now could strand them. Tear them '
+            'down first.')
+    conn = state.connection()
+    conn.execute(
+        'INSERT INTO workspaces (name, spec_json, created_at) '
+        'VALUES (?, ?, ?) ON CONFLICT(name) DO UPDATE SET '
+        'spec_json=excluded.spec_json',
+        (name, json.dumps(merged), int(time.time())))
+    conn.commit()
+    return get(name)
+
+
+def _narrows(current: Dict[str, Any], new_spec: Dict[str, Any]) -> bool:
+    """Does new_spec restrict where/who relative to current?"""
+    def _shrinks(key: str) -> bool:
+        old = current.get(key)
+        new = new_spec.get(key)
+        if new is None:
+            return False  # absent = unrestricted
+        if old is None:
+            return True   # restricted where it wasn't
+        return not set(old) <= set(new)
+    return (_shrinks('allowed_clouds') or _shrinks('allowed_users')
+            or bool(new_spec.get('private'))
+            and not current.get('private'))
+
+
+def delete(name: str) -> None:
+    """Reference sky/workspaces/core.py:304 — refuses while clusters
+    or storage are live in the workspace."""
+    _ensure_table()
+    if name == DEFAULT_WORKSPACE:
+        raise ValueError('The default workspace cannot be deleted.')
+    if get(name) is None:
+        raise ValueError(f'No workspace {name!r}.')
+    active = active_resources(name)
+    if any(active.values()):
+        raise WorkspaceInUseError(
+            f'Workspace {name!r} still has live resources '
+            f'({active["clusters"]} clusters, {active["storage"]} '
+            'storage objects); tear them down first.')
+    conn = state.connection()
+    conn.execute('DELETE FROM workspaces WHERE name=?', (name,))
+    conn.commit()
+
+
+def allowed_clouds(name: str) -> Optional[List[str]]:
+    """The optimize-time cloud filter for a workspace (None = no
+    restriction)."""
+    doc = get(name)
+    if doc is None:
+        return None
+    clouds = doc.get('allowed_clouds')
+    return list(clouds) if clouds else None
+
+
+def user_may_act_in(user_name: str, role: str, workspace: str) -> bool:
+    """Private-workspace gate (admins always pass)."""
+    if role == 'admin':
+        return True
+    doc = get(workspace)
+    if doc is None or not doc.get('private'):
+        return True
+    return user_name in (doc.get('allowed_users') or [])

@@ -1,0 +1,263 @@
+"""Workspace + user CRUD over the API, with active-resource guards
+and policy enforcement.
+
+Reference analog: sky/workspaces/core.py (:256 create, :210 update,
+:304 delete-refusing-while-active), sky/users/server.py (user CRUD +
+token lifecycle). These tests drive the REAL REST endpoints through
+ServerThread and the client SDK.
+"""
+import os
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import state
+from skypilot_tpu import users
+from skypilot_tpu import workspaces
+from skypilot_tpu.client import sdk
+from skypilot_tpu.server import app as app_mod
+from skypilot_tpu.server import requests_db
+
+
+def _auth_on(extra_users=''):
+    cfg_path = os.path.expanduser('~/.skytpu/config.yaml')
+    os.makedirs(os.path.dirname(cfg_path), exist_ok=True)
+    with open(cfg_path, 'w', encoding='utf-8') as f:
+        f.write('api_server:\n'
+                '  auth: true\n'
+                '  users:\n'
+                '    - name: root\n'
+                '      token: tok-admin\n'
+                '      role: admin\n' + extra_users)
+    from skypilot_tpu import config as config_lib
+    config_lib.reload()
+
+
+@pytest.fixture
+def server(monkeypatch):
+    requests_db.reset_for_tests()
+    with app_mod.ServerThread() as srv:
+        monkeypatch.setenv('SKYTPU_API_SERVER_URL', srv.url)
+        monkeypatch.setenv('SKYTPU_API_TOKEN', 'tok-admin')
+        _auth_on()
+        yield srv
+    requests_db.reset_for_tests()
+
+
+def _as(monkeypatch, token):
+    monkeypatch.setenv('SKYTPU_API_TOKEN', token)
+
+
+class TestWorkspaceCrud:
+
+    def test_lifecycle(self, server):
+        assert [w['name'] for w in sdk.workspaces_list()] == ['default']
+        ws = sdk.workspace_create('team-x', {
+            'description': 'research', 'allowed_clouds': ['local']})
+        assert ws['allowed_clouds'] == ['local']
+        names = [w['name'] for w in sdk.workspaces_list()]
+        assert names == ['default', 'team-x']
+        ws = sdk.workspace_update('team-x', {'description': 'renamed'})
+        assert ws['description'] == 'renamed'
+        sdk.workspace_delete('team-x')
+        assert [w['name'] for w in sdk.workspaces_list()] == ['default']
+
+    def test_update_merges_not_replaces(self, server):
+        """A description edit must not silently strip policy; None
+        explicitly clears a field."""
+        sdk.workspace_create('locked', {
+            'private': True, 'allowed_users': ['alice'],
+            'allowed_clouds': ['local']})
+        ws = sdk.workspace_update('locked', {'description': 'notes'})
+        assert ws['private'] is True
+        assert ws['allowed_users'] == ['alice']
+        assert ws['allowed_clouds'] == ['local']
+        assert ws['description'] == 'notes'
+        ws = sdk.workspace_update('locked', {'allowed_clouds': None})
+        assert 'allowed_clouds' not in ws  # cleared
+        assert ws['private'] is True       # untouched
+
+    def test_default_undeletable_and_bad_specs(self, server):
+        with pytest.raises(exceptions.ApiServerError,
+                           match='cannot be deleted'):
+            sdk.workspace_delete('default')
+        with pytest.raises(exceptions.ApiServerError,
+                           match='Unknown workspace spec'):
+            sdk.workspace_create('w1', {'nope': 1})
+        with pytest.raises(exceptions.ApiServerError,
+                           match='Unknown clouds'):
+            sdk.workspace_create('w1',
+                                 {'allowed_clouds': ['atlantis']})
+
+    def test_delete_refused_while_active(self, server, monkeypatch):
+        """Reference sky/workspaces/core.py:304 — live clusters pin
+        the workspace."""
+        sdk.workspace_create('busy', {})
+        monkeypatch.setenv('SKYTPU_WORKSPACE', 'busy')
+        state.add_or_update_cluster('c1', handle=None,
+                                    requested_resources_str='{}',
+                                    num_nodes=1, ready=True)
+        monkeypatch.delenv('SKYTPU_WORKSPACE')
+        with pytest.raises(exceptions.ApiServerError,
+                           match='live resources'):
+            sdk.workspace_delete('busy')
+        # Narrowing policy under live resources is refused too
+        # (core.py:210 stance)...
+        with pytest.raises(exceptions.ApiServerError,
+                           match='live resources'):
+            sdk.workspace_update('busy', {'allowed_clouds': ['gcp']})
+        # ...but an additive/descriptive change is fine.
+        sdk.workspace_update('busy', {'description': 'still busy'})
+        state.remove_cluster('c1', terminate=True)
+        sdk.workspace_delete('busy')
+
+    def test_admin_only(self, server, monkeypatch):
+        _auth_on('    - name: bob\n'
+                 '      token: tok-bob\n'
+                 '      role: user\n')
+        _as(monkeypatch, 'tok-bob')
+        assert [w['name'] for w in sdk.workspaces_list()] == \
+            ['default']  # reads are for everyone
+        with pytest.raises(exceptions.PermissionDeniedError):
+            sdk.workspace_create('nope', {})
+        with pytest.raises(exceptions.PermissionDeniedError):
+            sdk.users_list()
+
+
+class TestUserCrud:
+
+    def test_token_lifecycle(self, server, monkeypatch):
+        doc = sdk.user_create('carol', role='viewer')
+        token = doc.pop('token')
+        assert token.startswith('sky-')
+        # The token authenticates; a viewer can read workspaces but
+        # not administer users.
+        _as(monkeypatch, token)
+        assert sdk.workspaces_list()
+        with pytest.raises(exceptions.PermissionDeniedError):
+            sdk.users_list()
+        # Rotation invalidates the old token exactly once.
+        _as(monkeypatch, 'tok-admin')
+        new_token = sdk.user_rotate('carol')['token']
+        assert new_token != token
+        _as(monkeypatch, token)
+        with pytest.raises(exceptions.PermissionDeniedError):
+            sdk.workspaces_list()
+        _as(monkeypatch, new_token)
+        assert sdk.workspaces_list()
+        # Disable rejects the CURRENT token; enable restores it.
+        _as(monkeypatch, 'tok-admin')
+        sdk.user_update('carol', disabled=True)
+        _as(monkeypatch, new_token)
+        with pytest.raises(exceptions.PermissionDeniedError):
+            sdk.workspaces_list()
+        _as(monkeypatch, 'tok-admin')
+        sdk.user_update('carol', disabled=False)
+        _as(monkeypatch, new_token)
+        assert sdk.workspaces_list()
+        # Delete removes the account entirely.
+        _as(monkeypatch, 'tok-admin')
+        sdk.user_delete('carol')
+        assert 'carol' not in [u['name'] for u in sdk.users_list()]
+        _as(monkeypatch, new_token)
+        with pytest.raises(exceptions.PermissionDeniedError):
+            sdk.workspaces_list()
+
+    def test_listing_merges_config_and_db(self, server):
+        sdk.user_create('dave', role='user', workspace='default')
+        listing = {u['name']: u for u in sdk.users_list()}
+        assert listing['root']['source'] == 'config'
+        assert listing['dave']['source'] == 'db'
+        # Config users never echo tokens in listings.
+        assert 'token' not in listing['root']
+        assert 'token' not in listing['dave']
+
+    def test_config_users_immutable_via_api(self, server):
+        for call in (lambda: sdk.user_rotate('root'),
+                     lambda: sdk.user_update('root', role='viewer'),
+                     lambda: sdk.user_delete('root'),
+                     lambda: sdk.user_create('root')):
+            with pytest.raises(exceptions.ApiServerError,
+                               match='config'):
+                call()
+
+    def test_bad_inputs(self, server):
+        with pytest.raises(exceptions.ApiServerError,
+                           match='Unknown role'):
+            sdk.user_create('x1', role='emperor')
+        with pytest.raises(exceptions.ApiServerError,
+                           match='alphanumeric'):
+            sdk.user_create('bad name!')
+        with pytest.raises(exceptions.ApiServerError,
+                           match='No such user'):
+            sdk.user_rotate('ghost')
+
+
+class TestPolicyEnforcement:
+
+    def test_private_workspace_gate(self, server, monkeypatch):
+        """Commands in a private workspace require membership."""
+        sdk.workspace_create('secret', {
+            'private': True, 'allowed_users': ['alice']})
+        _auth_on('    - name: alice\n'
+                 '      token: tok-alice\n'
+                 '      role: user\n'
+                 '      workspace: secret\n'
+                 '    - name: mallory\n'
+                 '      token: tok-mal\n'
+                 '      role: user\n'
+                 '      workspace: secret\n')
+        _as(monkeypatch, 'tok-mal')
+        with pytest.raises(exceptions.PermissionDeniedError,
+                           match='private'):
+            sdk.get(sdk.status())
+        _as(monkeypatch, 'tok-alice')
+        sdk.get(sdk.status())  # member: allowed
+
+    def test_allowed_clouds_filters_optimizer(self, monkeypatch,
+                                              enable_clouds):
+        """A workspace cloud allowlist excludes other clouds at
+        optimize time."""
+        from skypilot_tpu import Dag, Resources, Task
+        from skypilot_tpu.optimizer import Optimizer
+        enable_clouds('gcp', 'local')
+        workspaces.create('cpu-only', {'allowed_clouds': ['local']})
+        monkeypatch.setenv('SKYTPU_WORKSPACE', 'cpu-only')
+        with Dag() as dag:
+            t = Task('t', run='true')
+            t.set_resources(Resources())
+            dag.add(t)
+        Optimizer.optimize(dag, quiet=True)
+        assert t.best_resources.cloud == 'local'
+        # A TPU task can't run in a local-only workspace.
+        with Dag() as dag:
+            t2 = Task('t2', run='true')
+            t2.set_resources(Resources(accelerators='tpu-v5e:8'))
+            dag.add(t2)
+        with pytest.raises(exceptions.ResourcesUnavailableError):
+            Optimizer.optimize(dag, quiet=True)
+        # Nonexistent-workspace context: unrestricted (open posture).
+        monkeypatch.setenv('SKYTPU_WORKSPACE', 'ghost')
+        with Dag() as dag:
+            t3 = Task('t3', run='true')
+            t3.set_resources(Resources(accelerators='tpu-v5e:8'))
+            dag.add(t3)
+        Optimizer.optimize(dag, quiet=True)
+        assert t3.best_resources.cloud == 'gcp'
+
+    def test_user_workspace_rides_commands(self, server, monkeypatch):
+        """A user's clusters land in their workspace: another
+        workspace's listing doesn't show them (existing threading,
+        re-pinned here against the CRUD'd workspace)."""
+        sdk.workspace_create('team-y', {})
+        _auth_on('    - name: erin\n'
+                 '      token: tok-erin\n'
+                 '      role: user\n'
+                 '      workspace: team-y\n')
+        monkeypatch.setenv('SKYTPU_WORKSPACE', 'team-y')
+        state.add_or_update_cluster('yc', handle=None,
+                                    requested_resources_str='{}',
+                                    num_nodes=1, ready=True)
+        monkeypatch.delenv('SKYTPU_WORKSPACE')
+        assert workspaces.active_resources('team-y')['clusters'] == 1
+        assert workspaces.get('team-y')['active']['clusters'] == 1

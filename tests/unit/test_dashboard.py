@@ -295,7 +295,8 @@ class TestCliBrowserLogin:
     def test_redirect_fallback_requires_state(self, server):
         """The GET fallback (PNA-blocked browsers redirect with
         token+state in the query) delivers only with the right state;
-        probes without the nonce are rejected."""
+        probes without a token field or with a wrong nonce are
+        rejected WITHOUT completing or aborting the flow."""
         del server
         from skypilot_tpu.client import oauth
 
@@ -306,8 +307,7 @@ class TestCliBrowserLogin:
 
             def _go():
                 base = f'http://127.0.0.1:{port}/callback'
-                for probe in ('', '?token=evil',
-                              '?token=evil&state=nope'):
+                for probe in ('', '?token=evil&state=nope'):
                     try:
                         urllib.request.urlopen(base + probe,
                                                timeout=10).read()
@@ -322,3 +322,29 @@ class TestCliBrowserLogin:
         token = oauth.browser_login('http://127.0.0.1:1', timeout=20,
                                     open_browser=fake_browser)
         assert token == 'fb'
+
+    def test_old_server_fails_fast_with_actionable_error(self, server):
+        """A token delivery WITHOUT a state nonce is an old server's
+        redirect: the CLI must fail immediately with a version-skew
+        message, not burn the full timeout."""
+        del server
+        from skypilot_tpu import exceptions as exc
+        from skypilot_tpu.client import oauth
+
+        def fake_browser(url):
+            import threading
+            port = url.rsplit('port=', 1)[1].split('&')[0]
+
+            def _go():
+                try:
+                    urllib.request.urlopen(
+                        f'http://127.0.0.1:{port}/callback?token=old',
+                        timeout=10).read()
+                except urllib.error.HTTPError as e:
+                    assert e.code == 403
+            threading.Thread(target=_go, daemon=True).start()
+            return True
+
+        with pytest.raises(exc.SkyTpuError, match='too old'):
+            oauth.browser_login('http://127.0.0.1:1', timeout=20,
+                                open_browser=fake_browser)
