@@ -38,6 +38,20 @@ class _Callback(http.server.BaseHTTPRequestHandler):
     error: Optional[str] = None
     event: threading.Event
 
+    def _deny(self, code: int, msg: str) -> None:
+        """Refusals carry the CORS header too: without it the consent
+        page's fetch sees a 403 as a TypeError — indistinguishable
+        from a network block — and its PNA fallback would redirect
+        the token into a URL, the exact leak the POST path exists to
+        avoid."""
+        body = msg.encode()
+        self.send_response(code)
+        self.send_header('Access-Control-Allow-Origin', '*')
+        self.send_header('Content-Type', 'text/plain')
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def _accept(self, params) -> bool:
         """Shared delivery rule for both verbs: a token field must be
         present (a field-less probe from a port scanner must not
@@ -48,7 +62,7 @@ class _Callback(http.server.BaseHTTPRequestHandler):
         arbitrary web page can reach this listener; without the nonce
         it could fix the session with an attacker token)."""
         if 'token' not in params:
-            self.send_error(400, explain='missing token field')
+            self._deny(400, 'missing token field')
             return False
         if 'state' not in params:
             # A token WITHOUT a state is an old server's redirect
@@ -59,14 +73,14 @@ class _Callback(http.server.BaseHTTPRequestHandler):
                 'This API server is too old for --browser login '
                 '(it delivered a token without the state nonce); '
                 'use `tsky api login --token ...` instead.')
-            self.send_error(403, explain='no state (old server)')
+            self._deny(403, 'no state (old server)')
             type(self).event.set()
             return False
         got = params['state'][0]
         # bytes comparison: compare_digest raises on non-ASCII str.
         if not hmac.compare_digest(got.encode(),
                                    type(self).state.encode()):
-            self.send_error(403, explain='state mismatch')
+            self._deny(403, 'state mismatch')
             return False
         type(self).token = params['token'][0]
         return True

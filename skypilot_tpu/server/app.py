@@ -448,6 +448,25 @@ async def _handle_user_delete(request):
     return _json_response({'deleted': request.match_info['name']})
 
 
+async def _handle_shell_page(request):
+    """In-browser terminal for a cluster (attaches to the ws proxy).
+    Page requires WRITE privilege up front — the ws it opens enforces
+    the same, but failing at page load beats a dead terminal."""
+    from aiohttp import web
+
+    from skypilot_tpu.server import dashboard
+    auth.check_command_allowed(request, 'exec')
+    return web.Response(
+        text=dashboard.shell_page(request.match_info['name']),
+        content_type='text/html')
+
+
+async def _handle_config_doc(request):
+    from skypilot_tpu.server import dashboard
+    _require_admin(request)
+    return _json_response(dashboard.config_doc())
+
+
 async def _handle_health(request):
     return _json_response({
         'status': 'healthy',
@@ -520,6 +539,9 @@ def create_app():
     app.router.add_get('/dashboard/jobs/{job_id}/log', _handle_job_log)
     app.router.add_get('/dashboard/services/{name}/log',
                        _handle_service_log)
+    app.router.add_get('/dashboard/clusters/{name}/shell',
+                       _handle_shell_page)
+    app.router.add_get('/dashboard/api/config', _handle_config_doc)
     app.router.add_get(f'{API_PREFIX}/requests', _handle_list_requests)
     app.router.add_get(f'{API_PREFIX}/requests/{{request_id}}',
                        _handle_get_request)

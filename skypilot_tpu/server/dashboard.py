@@ -95,6 +95,7 @@ def _cluster_detail(name: str) -> Optional[Dict[str, Any]]:
             'autostop': rec.get('autostop_str') or '-',
             'launched': rec.get('launched_at') or '-',
         },
+        'shell': f'/dashboard/clusters/{name}/shell',
     }
     # The cluster's own job queue (skylet job table), newest first.
     try:
@@ -261,6 +262,19 @@ dl{display:grid;grid-template-columns:140px 1fr;gap:4px 14px;
 dt{color:#8b949e}
 dd{margin:0;color:#e6edf3}
 .crumb{color:#8b949e;font-size:12px;margin-bottom:6px}
+button.mini{background:#21262d;border:1px solid #30363d;
+    color:#c9d1d9;padding:2px 8px;margin-right:4px;border-radius:6px;
+    cursor:pointer;font-size:11px}
+button.mini:hover{background:#30363d}
+.adm-form{display:flex;gap:8px;margin-top:14px;align-items:center}
+.adm-form input,.adm-form select{background:#0d1117;color:#c9d1d9;
+    border:1px solid #30363d;border-radius:6px;padding:5px 8px;
+    font-size:12px}
+.adm-err{background:#3d1418;color:#f85149;padding:6px 10px;
+    border-radius:6px;margin-bottom:8px;font-size:12px;
+    white-space:pre-wrap}
+pre.cfg{background:#161b22;border:1px solid #30363d;border-radius:6px;
+    padding:12px;overflow:auto;font:12px/1.45 ui-monospace,monospace}
 """
 
 _JS = """
@@ -337,16 +351,124 @@ function renderDetail(doc,tab){
   if(doc.log){const p=document.createElement('p');
     const a=document.createElement('a');a.href=doc.log;
     a.textContent='controller log';p.appendChild(a);m.appendChild(p)}
+  if(doc.shell){const p=document.createElement('p');
+    const a=document.createElement('a');a.href=doc.shell;
+    a.textContent='open shell';p.appendChild(a);m.appendChild(p)}
   if(doc.rows){const h2=document.createElement('h2');
     h2.textContent=doc.rows.title;m.appendChild(h2);
     if(doc.rows.items.length)
       m.appendChild(makeTable(doc.rows.columns,doc.rows.items,null));
     else{const d=document.createElement('div');d.className='empty';
       d.textContent='nothing here yet';m.appendChild(d)}}}
+// --- admin: workspaces + users (REST CRUD, admin-gated server-side) ------
+async function api(method,path,body){
+  const r=await fetch('/api/v1'+path,{method,
+    headers:body?{'Content-Type':'application/json'}:{},
+    body:body?JSON.stringify(body):undefined});
+  if(r.status===401){location.href='/dashboard/login';throw 0}
+  const text=await r.text();
+  if(!r.ok)throw new Error(text||r.status);
+  return text?JSON.parse(text):null}
+function el(tag,attrs,...kids){const e=document.createElement(tag);
+  Object.entries(attrs||{}).forEach(([k,v])=>{
+    if(k==='onclick')e.addEventListener('click',v);
+    else if(k==='class')e.className=v;else e[k]=v});
+  kids.forEach(k=>e.appendChild(typeof k==='string'?
+    document.createTextNode(k):k));return e}
+function btn(label,fn){return el('button',{class:'mini',onclick:fn},label)}
+function showErr(m,e){m.prepend(el('div',{class:'adm-err'},String(e)))}
+async function renderWorkspaces(){
+  const m=document.getElementById('content');m.innerHTML='';
+  let rows;try{rows=await api('GET','/workspaces')}catch(e){return}
+  const table=el('table',{},el('tr',{},...['name','clusters','storage',
+    'allowed clouds','private','description',''].map(c=>el('th',{},c))));
+  rows.forEach(w=>{
+    table.appendChild(el('tr',{},
+      el('td',{},w.name),
+      el('td',{},String(w.active.clusters)),
+      el('td',{},String(w.active.storage)),
+      el('td',{},(w.allowed_clouds||[]).join(', ')||'(all)'),
+      el('td',{},w.private?('yes: '+(w.allowed_users||[]).join(', '))
+                :'no'),
+      el('td',{},w.description||''),
+      el('td',{},...(w.name==='default'?[]:[btn('delete',async()=>{
+        if(!confirm('Delete workspace '+w.name+'?'))return;
+        try{await api('DELETE','/workspaces/'+
+          encodeURIComponent(w.name));renderWorkspaces()}
+        catch(e){showErr(m,e)}})]))))});
+  m.appendChild(table);
+  const form=el('div',{class:'adm-form'},
+    el('input',{id:'ws-name',placeholder:'name'}),
+    el('input',{id:'ws-desc',placeholder:'description'}),
+    el('input',{id:'ws-clouds',placeholder:'allowed clouds (a,b)'}),
+    btn('create workspace',async()=>{
+      const spec={};
+      const d=document.getElementById('ws-desc').value;
+      const c=document.getElementById('ws-clouds').value;
+      if(d)spec.description=d;
+      if(c)spec.allowed_clouds=c.split(',').map(s=>s.trim());
+      try{await api('POST','/workspaces',
+        {name:document.getElementById('ws-name').value,...spec});
+        renderWorkspaces()}catch(e){showErr(m,e)}}));
+  m.appendChild(form)}
+async function renderUsers(){
+  const m=document.getElementById('content');m.innerHTML='';
+  let rows;try{rows=await api('GET','/users')}
+  catch(e){m.innerHTML='<div class="empty">admin only</div>';return}
+  const table=el('table',{},el('tr',{},...['name','role','workspace',
+    'source','state',''].map(c=>el('th',{},c))));
+  rows.forEach(u=>{
+    const acts=[];
+    if(u.source==='db'){
+      acts.push(btn('rotate',async()=>{
+        try{const doc=await api('POST','/users/'+
+          encodeURIComponent(u.name)+'/rotate',{});
+          alert('New token for '+u.name+' (shown once):\\n'+doc.token);
+          renderUsers()}catch(e){showErr(m,e)}}));
+      acts.push(btn(u.disabled?'enable':'disable',async()=>{
+        try{await api('PUT','/users/'+encodeURIComponent(u.name),
+          {disabled:!u.disabled});renderUsers()}
+        catch(e){showErr(m,e)}}));
+      acts.push(btn('delete',async()=>{
+        if(!confirm('Delete user '+u.name+'?'))return;
+        try{await api('DELETE','/users/'+encodeURIComponent(u.name));
+          renderUsers()}catch(e){showErr(m,e)}}))}
+    table.appendChild(el('tr',{},
+      el('td',{},u.name),el('td',{},u.role),el('td',{},u.workspace),
+      el('td',{},u.source),
+      el('td',{},u.disabled?'disabled':'active'),
+      el('td',{},...acts)))});
+  m.appendChild(table);
+  const form=el('div',{class:'adm-form'},
+    el('input',{id:'u-name',placeholder:'name'}),
+    el('select',{id:'u-role'},...['user','viewer','admin'].map(r=>
+      el('option',{value:r},r))),
+    el('input',{id:'u-ws',placeholder:'workspace',value:'default'}),
+    btn('add user',async()=>{
+      try{const doc=await api('POST','/users',
+        {name:document.getElementById('u-name').value,
+         role:document.getElementById('u-role').value,
+         workspace:document.getElementById('u-ws').value});
+        alert('Token for '+doc.name+' (shown once):\\n'+doc.token);
+        renderUsers()}catch(e){showErr(m,e)}}));
+  m.appendChild(form)}
+async function renderConfig(){
+  const m=document.getElementById('content');m.innerHTML='';
+  try{const r=await fetch('/dashboard/api/config');
+    if(r.status===401){location.href='/dashboard/login';return}
+    if(!r.ok){m.innerHTML='<div class="empty">admin only</div>';return}
+    const doc=await r.json();
+    m.appendChild(el('div',{class:'crumb'},
+      'effective server config (secrets redacted) -- edit '+
+      doc.path+' and it reloads on the next request'));
+    m.appendChild(el('pre',{class:'cfg'},doc.yaml))}catch(e){}}
 async function render(){
   const {tab,key}=route();
   document.querySelectorAll('nav button').forEach(b=>
     b.classList.toggle('active',b.dataset.tab===tab));
+  if(tab==='workspaces'){renderWorkspaces();return}
+  if(tab==='users'){renderUsers();return}
+  if(tab==='config'){renderConfig();return}
   if(key){
     try{const r=await fetch('/dashboard/api/'+tab+'/'+
         encodeURIComponent(key));
@@ -372,6 +494,18 @@ render();setInterval(refresh,5000);
 """
 
 
+def script_embed(value: Any) -> str:
+    """json.dumps for inline <script> blocks: a value containing
+    '</script>' (e.g. a crafted cluster name or ?next= target —
+    aiohttp decodes %2F in path segments) would terminate the script
+    element and inject markup on the dashboard origin, and
+    '<!--<script' sequences flip the HTML parser's script-data
+    escaping states. \\uXXXX-escape the trigger characters — they can
+    only occur inside JSON strings, where the escapes are valid."""
+    return (json.dumps(value).replace('<', '\\u003c')
+            .replace('>', '\\u003e').replace('&', '\\u0026'))
+
+
 def page() -> str:
     initial = json.dumps(summary())
     tabs = ''.join(
@@ -380,9 +514,11 @@ def page() -> str:
                          ('jobs', 'Managed jobs'),
                          ('services', 'Services'),
                          ('requests', 'Requests'),
-                         ('infra', 'Infra')])
-    # </script>-safe embedding of the initial state.
-    initial = initial.replace('</', '<\\/')
+                         ('infra', 'Infra'),
+                         ('workspaces', 'Workspaces'),
+                         ('users', 'Users'),
+                         ('config', 'Config')])
+    initial = initial.replace('</', '<\\/')  # see script_embed
     return (
         '<!doctype html><html><head><title>skypilot-tpu</title>'
         f'<style>{_CSS}</style></head><body>'
@@ -434,7 +570,7 @@ def login_page(next_url: str = '/dashboard') -> str:
         '<input id="token" type="password" placeholder="API token" '
         'autofocus>'
         '<p id="err"></p><button type="submit">Sign in</button></form>'
-        f'<script>window.__next__={json.dumps(next_url)};{_LOGIN_JS}'
+        f'<script>window.__next__={script_embed(next_url)};{_LOGIN_JS}'
         '</script></body></html>')
 
 
@@ -488,7 +624,7 @@ def cli_auth_page(port: int, state: str = '') -> str:
         '<p id="err"></p>'
         '<button type="button">Authorize</button></form>'
         f'<script>window.__port__={int(port)};'
-        f'window.__state__={json.dumps(state)};{_CLI_AUTH_JS}'
+        f'window.__state__={script_embed(state)};{_CLI_AUTH_JS}'
         '</script></body></html>')
 
 
@@ -574,3 +710,154 @@ def log_page(title: str, text: str, offset: int = 0) -> str:
         f'<pre id="log">{html_lib.escape(text)}</pre>'
         f'<script>window.__offset__={int(offset)};{_LOG_JS}'
         '</script></body></html>')
+
+
+# --- in-browser shell -------------------------------------------------------
+
+_TERM_CSS = """
+body{margin:0;background:#0d1117;color:#c9d1d9;
+     font:13px/1.5 -apple-system,'Segoe UI',sans-serif}
+header{display:flex;gap:12px;padding:8px 16px;background:#161b22;
+       border-bottom:1px solid #30363d;align-items:baseline}
+a{color:#58a6ff;text-decoration:none}
+#status{margin-left:auto;color:#8b949e;font-size:12px}
+#term{margin:0;padding:10px 14px;white-space:pre;overflow:auto;
+      height:calc(100vh - 56px);box-sizing:border-box;outline:none;
+      font:13px/1.35 ui-monospace,'SF Mono',Menlo,monospace}
+#term .cur{background:#c9d1d9;color:#0d1117}
+"""
+
+# A deliberately small terminal: enough VT handling for shells, REPLs
+# and line editors (CR/LF/BS, CSI K/J/C/D/H, SGR stripped), speaking
+# the ws proxy's raw-bytes protocol (server/ws_proxy.py). The
+# reference ships xterm.js; ours is dependency-free by design — the
+# whole dashboard is one self-contained document.
+_TERM_JS = r"""
+const term=document.getElementById('term'),
+      status=document.getElementById('status');
+let lines=[''],row=0,col=0;
+function clamp(){if(row>=lines.length)lines.push('');
+  if(col<0)col=0}
+function put(ch){clamp();const l=lines[row];
+  lines[row]=l.length>col?l.slice(0,col)+ch+l.slice(col+1)
+    :l+' '.repeat(col-l.length)+ch;col++}
+function csi(params,fin){const n=parseInt(params.split(';')[0]||'1');
+  if(fin==='K'){clamp();lines[row]=lines[row].slice(0,col)}
+  else if(fin==='J'){lines=[''];row=0;col=0}
+  else if(fin==='H'){row=0;col=0}
+  else if(fin==='C')col+=n;
+  else if(fin==='D')col=Math.max(0,col-n)}
+let esc='';
+function write(text){
+  for(const ch of text){
+    if(esc){esc+=ch;
+      if(esc[1]==='['){if(/[@-~]/.test(ch)){
+        csi(esc.slice(2,-1),ch);esc=''}}
+      else if(esc[1]===']'){if(ch==='\x07')esc=''}
+      else esc='';
+      continue}
+    if(ch==='\x1b')esc=ch;
+    else if(ch==='\n'){row++;clamp();col=0}
+    else if(ch==='\r')col=0;
+    else if(ch==='\b')col=Math.max(0,col-1);
+    else if(ch==='\x07'){}
+    else put(ch)}
+  if(lines.length>2000)lines=lines.slice(lines.length-2000);
+  render()}
+function render(){clamp();
+  const out=lines.map((l,i)=>{
+    if(i!==row)return l;
+    const c=l.length>col?l[col]:' ';
+    return l.slice(0,col)+'\x00'+c+'\x01'+l.slice(col+1)});
+  term.textContent='';
+  out.forEach((l,i)=>{
+    const[pre,rest]=l.split('\x00');
+    term.appendChild(document.createTextNode(pre??l));
+    if(rest!==undefined){
+      const[cur,post]=[rest.slice(0,1),rest.slice(2)];
+      const s=document.createElement('span');s.className='cur';
+      s.textContent=cur;term.appendChild(s);
+      term.appendChild(document.createTextNode(post))}
+    if(i<out.length-1)term.appendChild(document.createTextNode('\n'))});
+  term.scrollTop=term.scrollHeight}
+const proto=location.protocol==='https:'?'wss':'ws';
+const cols=Math.max(20,Math.floor(term.clientWidth/7.8)),
+      rows=Math.max(5,Math.floor(term.clientHeight/17.5));
+const ws=new WebSocket(proto+'://'+location.host+
+  '/api/v1/clusters/'+encodeURIComponent(window.__cluster__)+
+  '/shell?rows='+rows+'&cols='+cols);
+ws.binaryType='arraybuffer';
+const dec=new TextDecoder(),enc=new TextEncoder();
+ws.onopen=()=>{status.textContent='connected';term.focus()};
+ws.onclose=()=>{status.textContent='disconnected'};
+ws.onerror=()=>{status.textContent='connection failed'};
+ws.onmessage=e=>{
+  if(typeof e.data==='string'){
+    if(e.data.startsWith('__SKYTPU_EXIT__'))
+      status.textContent='shell exited ('+
+        e.data.slice('__SKYTPU_EXIT__'.length)+')';
+    return}
+  write(dec.decode(new Uint8Array(e.data),{stream:true}))};
+function send(s){if(ws.readyState===1)ws.send(enc.encode(s))}
+const KEYS={Enter:'\r',Backspace:'\x7f',Tab:'\t',Escape:'\x1b',
+  ArrowUp:'\x1b[A',ArrowDown:'\x1b[B',ArrowRight:'\x1b[C',
+  ArrowLeft:'\x1b[D',Home:'\x1b[H',End:'\x1b[F',Delete:'\x1b[3~',
+  PageUp:'\x1b[5~',PageDown:'\x1b[6~'};
+term.addEventListener('keydown',e=>{
+  if(e.ctrlKey&&e.key.length===1){
+    const c=e.key.toLowerCase().charCodeAt(0);
+    if(c>=97&&c<=122){send(String.fromCharCode(c-96));
+      e.preventDefault();return}}
+  if(e.metaKey||e.ctrlKey)return; // leave copy/paste etc. alone
+  if(KEYS[e.key]){send(KEYS[e.key]);e.preventDefault()}
+  else if(e.key.length===1){send(e.key);e.preventDefault()}});
+term.addEventListener('paste',e=>{
+  send(e.clipboardData.getData('text'));e.preventDefault()});
+"""
+
+
+def shell_page(cluster: str) -> str:
+    """The in-browser terminal attached to the ws shell proxy
+    (reference dashboard's xterm-based pod shell)."""
+    import html as html_lib
+    safe = html_lib.escape(cluster)
+    return (
+        '<!doctype html><html><head>'
+        f'<title>shell: {safe}</title>'
+        f'<style>{_TERM_CSS}</style></head><body>'
+        '<header><a href="/dashboard">&larr; dashboard</a>'
+        f'<strong>{safe}</strong>'
+        '<span id="status">connecting…</span></header>'
+        '<pre id="term" tabindex="0"></pre>'
+        f'<script>window.__cluster__={script_embed(cluster)};'
+        f'{_TERM_JS}</script></body></html>')
+
+
+# --- config view ------------------------------------------------------------
+
+_REDACT_KEYS = ('token', 'password', 'secret', 'key')
+
+
+def _redact(obj):
+    if isinstance(obj, dict):
+        return {k: ('*****' if isinstance(v, str)
+                    and any(s in k.lower() for s in _REDACT_KEYS)
+                    else _redact(v))
+                for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_redact(v) for v in obj]
+    return obj
+
+
+def config_doc() -> Dict[str, Any]:
+    """Effective layered config with credentials redacted (the
+    reference dashboard's config page; ours is read-only — the file
+    stays the source of truth and reloads per request)."""
+    import yaml
+
+    from skypilot_tpu import config as config_lib
+    return {
+        'path': config_lib.USER_CONFIG_PATH,
+        'yaml': yaml.safe_dump(_redact(config_lib.to_dict()),
+                               default_flow_style=False) or '',
+    }

@@ -59,6 +59,31 @@ def connection() -> sqlite3.Connection:
     return _get_conn()
 
 
+def valid_identifier(name: str) -> bool:
+    """One naming rule for API-created entities (workspaces, users)."""
+    return bool(name) and \
+        name.replace('-', '').replace('_', '').isalnum()
+
+
+class TableOnce:
+    """Run a sibling store's DDL once per process per DB path (tests
+    re-point the state dir). DDL + commit per request would serialize
+    the API server on sqlite write locks."""
+
+    def __init__(self, ddl: str) -> None:
+        self._ddl = ddl
+        self._ready_for: Optional[str] = None
+
+    def ensure(self) -> None:
+        path = paths.state_db_path()
+        if self._ready_for == path:
+            return
+        conn = _get_conn()
+        conn.execute(self._ddl)
+        conn.commit()
+        self._ready_for = path
+
+
 def reset_for_tests() -> None:
     global _conn, _conn_path
     with _lock:

@@ -26,30 +26,16 @@ from skypilot_tpu import state
 from skypilot_tpu import users as users_lib
 
 
-_table_ready_for: Optional[str] = None
-
-
-def _ensure_table() -> None:
-    """Once per process per DB path: user_for_token runs on EVERY
-    authenticated request, and schema DDL + commit there would
-    serialize the API server on sqlite write locks."""
-    global _table_ready_for
-    from skypilot_tpu.utils import paths
-    path = paths.state_db_path()
-    if _table_ready_for == path:
-        return
-    conn = state.connection()
-    conn.execute("""
-        CREATE TABLE IF NOT EXISTS users (
-            name TEXT PRIMARY KEY,
-            token TEXT,
-            role TEXT,
-            workspace TEXT,
-            disabled INTEGER DEFAULT 0,
-            created_at INTEGER
-        )""")
-    conn.commit()
-    _table_ready_for = path
+_table = state.TableOnce("""
+    CREATE TABLE IF NOT EXISTS users (
+        name TEXT PRIMARY KEY,
+        token TEXT,
+        role TEXT,
+        workspace TEXT,
+        disabled INTEGER DEFAULT 0,
+        created_at INTEGER
+    )""")
+_ensure_table = _table.ensure
 
 
 def _new_token() -> str:
@@ -124,7 +110,7 @@ def create_user(name: str, role: str = users_lib.ROLE_USER,
     """Add a user; returns the doc INCLUDING the generated token —
     the only time it is ever echoed."""
     _ensure_table()
-    if not name or not name.replace('-', '').replace('_', '').isalnum():
+    if not state.valid_identifier(name):
         raise ValueError(f'User name {name!r} must be alphanumeric '
                          'with - or _')
     if role not in users_lib.ROLES:

@@ -36,27 +36,13 @@ class WorkspaceInUseError(exceptions.SkyTpuError):
     """Mutation refused because live resources exist in the workspace."""
 
 
-_table_ready_for: Optional[str] = None
-
-
-def _ensure_table() -> None:
-    """Once per process per DB path (tests re-point the state dir):
-    schema DDL + commit per REQUEST would serialize the API server on
-    sqlite write locks."""
-    global _table_ready_for
-    from skypilot_tpu.utils import paths
-    path = paths.state_db_path()
-    if _table_ready_for == path:
-        return
-    conn = state.connection()
-    conn.execute("""
-        CREATE TABLE IF NOT EXISTS workspaces (
-            name TEXT PRIMARY KEY,
-            spec_json TEXT,
-            created_at INTEGER
-        )""")
-    conn.commit()
-    _table_ready_for = path
+_table = state.TableOnce("""
+    CREATE TABLE IF NOT EXISTS workspaces (
+        name TEXT PRIMARY KEY,
+        spec_json TEXT,
+        created_at INTEGER
+    )""")
+_ensure_table = _table.ensure
 
 
 def _validate_spec(spec: Dict[str, Any]) -> Dict[str, Any]:
@@ -137,10 +123,13 @@ def create(name: str, spec: Optional[Dict[str, Any]] = None
            ) -> Dict[str, Any]:
     """Reference sky/workspaces/core.py:256."""
     _ensure_table()
-    if not name or not name.replace('-', '').replace('_', '').isalnum():
+    if not state.valid_identifier(name):
         raise ValueError(
             f'Workspace name {name!r} must be alphanumeric with - or _')
-    spec = _validate_spec(spec or {})
+    # None-valued keys mean "unset" (the CLI's `none` literal) — on
+    # create that's simply absence.
+    spec = _validate_spec({k: v for k, v in (spec or {}).items()
+                           if v is not None})
     conn = state.connection()
     if get(name) is not None:
         raise ValueError(f'Workspace {name!r} already exists.')
@@ -190,19 +179,33 @@ def update(name: str, spec: Dict[str, Any]) -> Dict[str, Any]:
     return get(name)
 
 
-def _narrows(current: Dict[str, Any], new_spec: Dict[str, Any]) -> bool:
-    """Does new_spec restrict where/who relative to current?"""
-    def _shrinks(key: str) -> bool:
-        old = current.get(key)
-        new = new_spec.get(key)
+def _narrows(current: Dict[str, Any], merged: Dict[str, Any]) -> bool:
+    """Does the MERGED spec restrict where/who relative to current?
+
+    Clouds: absent list = unrestricted, so clearing widens. Access is
+    the opposite polarity: on a private workspace an absent
+    allowed_users means NOBODY (but admins) — clearing it narrows
+    maximally, so the who-may-act check compares effective member
+    sets, not raw keys."""
+    def _cloud_shrinks() -> bool:
+        old = current.get('allowed_clouds')
+        new = merged.get('allowed_clouds')
         if new is None:
-            return False  # absent = unrestricted
+            return False
         if old is None:
-            return True   # restricted where it wasn't
+            return True
         return not set(old) <= set(new)
-    return (_shrinks('allowed_clouds') or _shrinks('allowed_users')
-            or bool(new_spec.get('private'))
-            and not current.get('private'))
+
+    def _access_shrinks() -> bool:
+        if not merged.get('private'):
+            return False  # open to all = widest
+        new_users = set(merged.get('allowed_users') or [])
+        if not current.get('private'):
+            return True   # was open, now member-gated
+        old_users = set(current.get('allowed_users') or [])
+        return not old_users <= new_users
+
+    return _cloud_shrinks() or _access_shrinks()
 
 
 def delete(name: str) -> None:

@@ -77,6 +77,27 @@ class TestWorkspaceCrud:
         assert 'allowed_clouds' not in ws  # cleared
         assert ws['private'] is True       # untouched
 
+    def test_clearing_members_of_active_private_ws_refused(
+            self, server, monkeypatch):
+        """On a private workspace, NO allowed_users means nobody:
+        clearing the list narrows access and must hit the
+        live-resources guard (it would strand alice's cluster)."""
+        sdk.workspace_create('secret', {
+            'private': True, 'allowed_users': ['alice']})
+        monkeypatch.setenv('SKYTPU_WORKSPACE', 'secret')
+        state.add_or_update_cluster('sc', handle=None,
+                                    requested_resources_str='{}',
+                                    num_nodes=1, ready=True)
+        monkeypatch.delenv('SKYTPU_WORKSPACE')
+        with pytest.raises(exceptions.ApiServerError,
+                           match='live resources'):
+            sdk.workspace_update('secret', {'allowed_users': None})
+        # Adding a member widens: allowed even while active.
+        ws = sdk.workspace_update(
+            'secret', {'allowed_users': ['alice', 'bob']})
+        assert ws['allowed_users'] == ['alice', 'bob']
+        state.remove_cluster('sc', terminate=True)
+
     def test_default_undeletable_and_bad_specs(self, server):
         with pytest.raises(exceptions.ApiServerError,
                            match='cannot be deleted'):

@@ -45,7 +45,7 @@ class _NoRedirect(urllib.request.HTTPRedirectHandler):
         return None
 
 
-def _auth_on():
+def _auth_on(extra_users=''):
     cfg_path = os.path.expanduser('~/.skytpu/config.yaml')
     os.makedirs(os.path.dirname(cfg_path), exist_ok=True)
     with open(cfg_path, 'w', encoding='utf-8') as f:
@@ -54,7 +54,7 @@ def _auth_on():
                 '  users:\n'
                 '    - name: root\n'
                 '      token: tok-admin\n'
-                '      role: admin\n')
+                '      role: admin\n' + extra_users)
     from skypilot_tpu import config as config_lib
     config_lib.reload()
 
@@ -195,6 +195,102 @@ class TestIncrementalLogs:
                     f'/dashboard/requests/{request_id}/log'
                     f'?raw=1&offset={total}')
         assert int(resp.headers['X-Log-Size']) >= total
+
+
+class TestAdminSurfaces:
+    """Workspace/user/config admin pages + the in-browser shell
+    (reference dashboard's admin + xterm surfaces)."""
+
+    def test_page_has_admin_tabs(self, server):
+        page = _get(server.url, '/dashboard').read().decode()
+        for tab in ('workspaces', 'users', 'config'):
+            assert f'data-tab="{tab}"' in page
+        assert 'renderWorkspaces' in page and 'renderUsers' in page
+
+    def test_config_doc_admin_gated_and_redacted(self, server):
+        _auth_on()
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(server.url, '/dashboard/api/config')
+        assert err.value.code == 401
+        doc = json.loads(_get(
+            server.url, '/dashboard/api/config',
+            cookie='skytpu_token=tok-admin').read())
+        assert 'tok-admin' not in doc['yaml']
+        assert '*****' in doc['yaml']
+        assert 'auth: true' in doc['yaml']
+
+    def test_shell_page_rbac(self, server):
+        """The terminal page needs WRITE privilege (a shell is
+        arbitrary execution) — viewers get 403, not a dead page."""
+        _auth_on('    - name: carol\n'
+                 '      token: tok-view\n'
+                 '      role: viewer\n')
+        page = _get(server.url, '/dashboard/clusters/c1/shell',
+                    cookie='skytpu_token=tok-admin').read().decode()
+        assert 'id="term"' in page and '/shell?rows=' in page
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(server.url, '/dashboard/clusters/c1/shell',
+                 cookie='skytpu_token=tok-view')
+        assert err.value.code == 403
+
+    def test_script_embeds_are_closing_tag_safe(self, server):
+        """A crafted cluster name / ?next= containing '</script>'
+        must not escape the inline script block (aiohttp decodes
+        %2F inside path segments)."""
+        _auth_on()
+        evil = 'x</script><script>evil()</script>'
+        page = _get(server.url,
+                    '/dashboard/clusters/'
+                    + urllib.parse.quote(evil, safe='')
+                    + '/shell',
+                    cookie='skytpu_token=tok-admin').read().decode()
+        assert '<script>evil()' not in page
+        assert '</script><script>' not in page
+        assert '\\u003c' in page  # escaped embedding survived
+        login = _get(server.url,
+                     '/dashboard/login?next='
+                     + urllib.parse.quote('/dashboard' + evil)
+                     ).read().decode()
+        assert '<script>evil()' not in login
+
+    def test_browser_shell_end_to_end(self, server, monkeypatch,
+                                      enable_clouds):
+        """The terminal page's wire contract against a REAL local
+        cluster: cookie-auth websocket, binary frames both ways, exit
+        sentinel — exactly what the page's JS speaks."""
+        import asyncio
+
+        import aiohttp
+
+        enable_clouds('local')
+        monkeypatch.setenv('SKYTPU_API_SERVER_URL', server.url)
+        import skypilot_tpu as sky
+        from skypilot_tpu import task as task_lib
+        sky.launch(task_lib.Task(run='true', name='s'),
+                   cluster_name='shc')
+        _auth_on()
+
+        async def drive():
+            url = (f'{server.url}/api/v1/clusters/shc/shell'
+                   '?rows=24&cols=80')
+            out = b''
+            async with aiohttp.ClientSession(
+                    cookies={'skytpu_token': 'tok-admin'}) as session:
+                async with session.ws_connect(url) as ws:
+                    await ws.send_bytes(b'echo brow$((3+4))ser\n')
+                    await ws.send_bytes(b'exit\n')
+                    async for msg in ws:
+                        if msg.type == aiohttp.WSMsgType.BINARY:
+                            out += msg.data
+                        elif msg.type == aiohttp.WSMsgType.TEXT:
+                            assert msg.data.startswith(
+                                '__SKYTPU_EXIT__')
+                            break
+            return out
+
+        out = asyncio.run(asyncio.wait_for(drive(), timeout=60))
+        assert b'brow7ser' in out
+        sky.down('shc')
 
 
 class TestCliBrowserLogin:
