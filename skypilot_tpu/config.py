@@ -26,6 +26,7 @@ ENV_VAR_CONFIG = 'SKYTPU_CONFIG'
 _local = threading.local()
 _cache_lock = threading.Lock()
 _cached: Optional[Dict[str, Any]] = None
+_cached_sig: Optional[Tuple] = None
 
 
 def _after_fork_in_child() -> None:
@@ -61,10 +62,35 @@ def _load_file(path: str) -> Dict[str, Any]:
     return data
 
 
+def _layer_paths() -> Tuple[str, ...]:
+    layers = [USER_CONFIG_PATH, PROJECT_CONFIG_PATH]
+    env_path = os.environ.get(ENV_VAR_CONFIG)
+    if env_path:
+        layers.append(env_path)
+    return tuple(os.path.abspath(os.path.expanduser(p))
+                 for p in layers)
+
+
+def _signature() -> Tuple:
+    """File identity of every config layer. The cache invalidates on
+    ANY change so edits are live: a token revoked in config.yaml must
+    stop authenticating on the next request, not at the next server
+    restart. (A stat per layer per read — a few µs — buys that.)"""
+    sig = []
+    for path in _layer_paths():
+        try:
+            st = os.stat(path)
+            sig.append((path, st.st_mtime_ns, st.st_size))
+        except OSError:
+            sig.append((path, None, None))
+    return tuple(sig)
+
+
 def _base_config() -> Dict[str, Any]:
-    global _cached
+    global _cached, _cached_sig
     with _cache_lock:
-        if _cached is None:
+        sig = _signature()
+        if _cached is None or sig != _cached_sig:
             merged: Dict[str, Any] = {}
             for layer in (USER_CONFIG_PATH, PROJECT_CONFIG_PATH):
                 merged = _deep_merge(merged, _load_file(layer))
@@ -72,14 +98,16 @@ def _base_config() -> Dict[str, Any]:
             if env_path:
                 merged = _deep_merge(merged, _load_file(env_path))
             _cached = merged
+            _cached_sig = sig
         return _cached
 
 
 def reload() -> None:
     """Drop the cached merged config (tests, config edits)."""
-    global _cached
+    global _cached, _cached_sig
     with _cache_lock:
         _cached = None
+        _cached_sig = None
 
 
 def _effective() -> Dict[str, Any]:

@@ -454,10 +454,14 @@ async def _handle_shell_page(request):
     the same, but failing at page load beats a dead terminal."""
     from aiohttp import web
 
+    from skypilot_tpu import state
     from skypilot_tpu.server import dashboard
     auth.check_command_allowed(request, 'exec')
+    name = request.match_info['name']
+    if state.get_cluster_from_name(name) is None:
+        raise web.HTTPNotFound(text=f'No such cluster: {name}')
     return web.Response(
-        text=dashboard.shell_page(request.match_info['name']),
+        text=dashboard.shell_page(name),
         content_type='text/html')
 
 

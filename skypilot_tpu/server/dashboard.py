@@ -379,7 +379,8 @@ function btn(label,fn){return el('button',{class:'mini',onclick:fn},label)}
 function showErr(m,e){m.prepend(el('div',{class:'adm-err'},String(e)))}
 async function renderWorkspaces(){
   const m=document.getElementById('content');m.innerHTML='';
-  let rows;try{rows=await api('GET','/workspaces')}catch(e){return}
+  let rows;try{rows=await api('GET','/workspaces')}
+  catch(e){showErr(m,e);return}
   const table=el('table',{},el('tr',{},...['name','clusters','storage',
     'allowed clouds','private','description',''].map(c=>el('th',{},c))));
   rows.forEach(w=>{
@@ -461,7 +462,8 @@ async function renderConfig(){
     m.appendChild(el('div',{class:'crumb'},
       'effective server config (secrets redacted) -- edit '+
       doc.path+' and it reloads on the next request'));
-    m.appendChild(el('pre',{class:'cfg'},doc.yaml))}catch(e){}}
+    m.appendChild(el('pre',{class:'cfg'},doc.yaml))}
+  catch(e){showErr(m,e)}}
 async function render(){
   const {tab,key}=route();
   document.querySelectorAll('nav button').forEach(b=>
@@ -507,7 +509,7 @@ def script_embed(value: Any) -> str:
 
 
 def page() -> str:
-    initial = json.dumps(summary())
+    initial = script_embed(summary())
     tabs = ''.join(
         f'<button data-tab="{t}">{label}</button>'
         for t, label in [('clusters', 'Clusters'),
@@ -518,7 +520,6 @@ def page() -> str:
                          ('workspaces', 'Workspaces'),
                          ('users', 'Users'),
                          ('config', 'Config')])
-    initial = initial.replace('</', '<\\/')  # see script_embed
     return (
         '<!doctype html><html><head><title>skypilot-tpu</title>'
         f'<style>{_CSS}</style></head><body>'
@@ -762,7 +763,8 @@ function write(text){
     else if(ch==='\b')col=Math.max(0,col-1);
     else if(ch==='\x07'){}
     else put(ch)}
-  if(lines.length>2000)lines=lines.slice(lines.length-2000);
+  const over=lines.length-2000;
+  if(over>0){lines=lines.slice(over);row=Math.max(0,row-over)}
   render()}
 function render(){clamp();
   const out=lines.map((l,i)=>{

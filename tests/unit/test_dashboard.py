@@ -221,10 +221,15 @@ class TestAdminSurfaces:
 
     def test_shell_page_rbac(self, server):
         """The terminal page needs WRITE privilege (a shell is
-        arbitrary execution) — viewers get 403, not a dead page."""
+        arbitrary execution) — viewers get 403, unknown clusters 404,
+        not a dead page."""
+        from skypilot_tpu import state
         _auth_on('    - name: carol\n'
                  '      token: tok-view\n'
                  '      role: viewer\n')
+        state.add_or_update_cluster('c1', handle=None,
+                                    requested_resources_str='{}',
+                                    num_nodes=1, ready=True)
         page = _get(server.url, '/dashboard/clusters/c1/shell',
                     cookie='skytpu_token=tok-admin').read().decode()
         assert 'id="term"' in page and '/shell?rows=' in page
@@ -232,13 +237,49 @@ class TestAdminSurfaces:
             _get(server.url, '/dashboard/clusters/c1/shell',
                  cookie='skytpu_token=tok-view')
         assert err.value.code == 403
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(server.url, '/dashboard/clusters/ghost/shell',
+                 cookie='skytpu_token=tok-admin')
+        assert err.value.code == 404
+
+    def test_config_edits_are_live_without_restart(self, server):
+        """mtime-based invalidation: a token added to config.yaml
+        authenticates on the next request; a removed one stops. No
+        reload() call, no server restart."""
+        import time as time_lib
+        _auth_on()
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(server.url, '/dashboard/api/config',
+                 cookie='skytpu_token=tok-new')
+        assert err.value.code == 401
+        time_lib.sleep(0.01)  # distinct mtime_ns
+        # Rewrite the config WITHOUT calling config.reload().
+        cfg_path = os.path.expanduser('~/.skytpu/config.yaml')
+        with open(cfg_path, 'w', encoding='utf-8') as f:
+            f.write('api_server:\n'
+                    '  auth: true\n'
+                    '  users:\n'
+                    '    - name: fresh\n'
+                    '      token: tok-new\n'
+                    '      role: admin\n')
+        doc = json.loads(_get(server.url, '/dashboard/api/config',
+                              cookie='skytpu_token=tok-new').read())
+        assert 'fresh' in doc['yaml']
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(server.url, '/dashboard/api/config',
+                 cookie='skytpu_token=tok-admin')  # revoked
+        assert err.value.code == 401
 
     def test_script_embeds_are_closing_tag_safe(self, server):
         """A crafted cluster name / ?next= containing '</script>'
         must not escape the inline script block (aiohttp decodes
         %2F inside path segments)."""
+        from skypilot_tpu import state
         _auth_on()
         evil = 'x</script><script>evil()</script>'
+        state.add_or_update_cluster(evil, handle=None,
+                                    requested_resources_str='{}',
+                                    num_nodes=1, ready=True)
         page = _get(server.url,
                     '/dashboard/clusters/'
                     + urllib.parse.quote(evil, safe='')
