@@ -1,0 +1,422 @@
+"""Catalog fetchers for the REST clouds — one driver, per-cloud
+row extractors.
+
+Reference analog: sky/catalog/data_fetchers/fetch_{vast,fluidstack,
+cudo,hyperbolic,lambda_cloud,ibm,vsphere}.py — the reference ships
+one script per cloud; ours factors the shared 80% (client dispatch,
+uniform CSV schema, defensive parsing, README refresh notes) into
+this driver, the same compression the provision layer applies via
+provision/rest_driver.py. Every extractor goes through the cloud's
+injectable adaptor client, so tests feed fake payloads and the
+offline CSVs get golden-file coverage.
+
+Usage:
+    python -m skypilot_tpu.catalog.data_fetchers.fetch_market vast
+    python -m skypilot_tpu.catalog.data_fetchers.fetch_market --all
+
+Every row lands in the uniform vms.csv schema:
+    instance_type, accelerator_name, accelerator_count, cpus,
+    memory_gb, price, spot_price, region, zone
+"""
+import argparse
+import csv
+import importlib
+import os
+import re
+from typing import Any, Callable, Dict, List, Optional
+
+FIELDS = ['instance_type', 'accelerator_name', 'accelerator_count',
+          'cpus', 'memory_gb', 'price', 'spot_price', 'region', 'zone']
+
+
+def _row(instance_type: str, price: float, region: str,
+         accelerator_name: str = '', accelerator_count: int = 0,
+         cpus: Any = '', memory_gb: Any = '', spot_price: Any = '',
+         zone: str = '') -> Dict[str, Any]:
+    return {'instance_type': instance_type,
+            'accelerator_name': accelerator_name,
+            'accelerator_count': accelerator_count,
+            'cpus': cpus, 'memory_gb': memory_gb,
+            'price': round(float(price), 4),
+            'spot_price': (round(float(spot_price), 4)
+                           if spot_price not in ('', None) else ''),
+            'region': region, 'zone': zone}
+
+
+def _client(adaptor_name: str):
+    mod = importlib.import_module(
+        f'skypilot_tpu.adaptors.{adaptor_name}')
+    return mod.client()
+
+
+_INTERFACE_TOKENS = frozenset(
+    {'SXM', 'SXM2', 'SXM3', 'SXM4', 'SXM5', 'PCIE', 'NVL', 'NVLINK'})
+
+
+def _norm_gpu(name: str) -> str:
+    """Cloud GPU spellings → the catalog's canonical vocabulary
+    ('RTX4090', 'A100-80GB', 'H100', 'RTXA6000'). The optimizer
+    matches accelerator names by EXACT string (catalog/common.py) and
+    provisioners map them back to cloud vocabulary, so a refresh must
+    not invent a third spelling: interface tokens drop, memory-size
+    tokens keep a '-' separator, everything else concatenates."""
+    tokens = [t for t in re.split(r'[\s_-]+', name.upper())
+              if t and t not in _INTERFACE_TOKENS]
+    out = ''
+    for tok in tokens:
+        out += f'-{tok}' if tok.endswith('GB') and out else tok
+    return out
+
+
+# --- per-cloud extractors ---------------------------------------------------
+
+def fetch_lambda() -> List[Dict[str, Any]]:
+    """GET /instance-types (reference fetch_lambda_cloud.py:18): each
+    entry carries price_cents_per_hour + vcpus/memory/gpu specs and
+    the regions with capacity."""
+    resp = _client('lambda_cloud').request('GET', '/instance-types')
+    rows = []
+    for entry in (resp.get('data') or {}).values():
+        it = entry.get('instance_type') or {}
+        name = it.get('name', '')
+        specs = it.get('specs') or {}
+        gpus = int(specs.get('gpus', 0) or 0)
+        # 'gpu_8x_a100_80gb_sxm4' -> A100-80GB x8 (catalog drops the
+        # interface suffix; see _norm_gpu).
+        m = re.match(r'gpu_(\d+)x_([a-z0-9_]+)', name)
+        acc = _norm_gpu(m.group(2)) if m else ''
+        for region in entry.get('regions_with_capacity_available', []):
+            rows.append(_row(
+                name, float(it.get('price_cents_per_hour', 0)) / 100,
+                region.get('name', ''), accelerator_name=acc,
+                accelerator_count=gpus,
+                cpus=specs.get('vcpus', ''),
+                memory_gb=specs.get('memory_gib', '')))
+    return rows
+
+
+def fetch_vast() -> List[Dict[str, Any]]:
+    """GET /api/v0/bundles (the console search API the CLI's
+    `search offers` wraps; reference fetch_vast.py builds the same
+    rows from vastai_sdk.search_offers): one row per verified
+    rentable offer class, min_bid as the spot column."""
+    resp = _client('vast').request(
+        'GET', '/api/v0/bundles/',
+        params={'q': '{"rentable": {"eq": true}, '
+                     '"verified": {"eq": true}}'})
+    rows = []
+    for offer in resp.get('offers', []):
+        n = int(offer.get('num_gpus', 0) or 0)
+        gpu = _norm_gpu(str(offer.get('gpu_name', '')))
+        if not n or not gpu:
+            continue
+        rows.append(_row(
+            f'{n}x_{gpu}', offer.get('dph_total', 0) or 0,
+            str(offer.get('geolocation') or 'any'),
+            accelerator_name=gpu, accelerator_count=n,
+            cpus=offer.get('cpu_cores_effective', ''),
+            memory_gb=round(float(offer.get('cpu_ram', 0) or 0) / 1024,
+                            1),
+            spot_price=offer.get('min_bid', '')))
+    return rows
+
+
+def fetch_fluidstack() -> List[Dict[str, Any]]:
+    """GET /list_available_configurations (reference
+    fetch_fluidstack.py:14): plans priced per-GPU-hour across counts
+    and regions."""
+    resp = _client('fluidstack').request(
+        'GET', '/list_available_configurations')
+    plans = resp if isinstance(resp, list) else resp.get('plans', [])
+    rows = []
+    for plan in plans:
+        gpu = _norm_gpu(str(plan.get('gpu_type', '')))
+        per_gpu = float(plan.get('price_per_gpu_hr', 0) or 0)
+        if not gpu or per_gpu <= 0:
+            continue
+        counts = plan.get('gpu_counts') or [1]
+        for count in counts:
+            for region in plan.get('regions') or ['generic']:
+                rows.append(_row(
+                    f'{count}x_{gpu}', per_gpu * count, str(region),
+                    accelerator_name=gpu, accelerator_count=int(count),
+                    cpus=plan.get('cpu_count', ''),
+                    memory_gb=plan.get('ram_gb', '')))
+    return rows
+
+
+def fetch_cudo() -> List[Dict[str, Any]]:
+    """GET /v1/vms/machine-types (reference fetch_cudo.py walks the
+    same machine-type listing: total_price_hr per data center)."""
+    resp = _client('cudo').request('GET', '/v1/vms/machine-types')
+    rows = []
+    for mt in (resp.get('machineTypes') or resp.get('hostConfigs')
+               or []):
+        price = mt.get('totalPriceHr') or mt.get('total_price_hr') or {}
+        value = float(price.get('value', 0) or 0)
+        name = mt.get('machineType') or mt.get('id', '')
+        if not name or value <= 0:
+            continue
+        gpu = _norm_gpu(str(mt.get('gpuModel', '') or ''))
+        # GPU count: explicit field, else the catalog's '-<N>x-' name
+        # convention (epyc-8x-a100-80), else 1 for a GPU machine.
+        count = int(mt.get('gpu', 0) or mt.get('gpuCount', 0) or 0)
+        if not count and gpu:
+            m = re.search(r'(\d+)x', name)
+            count = int(m.group(1)) if m else 1
+        rows.append(_row(
+            name, value,
+            mt.get('dataCenterId', '') or mt.get('data_center_id', ''),
+            accelerator_name=gpu, accelerator_count=count,
+            cpus=mt.get('vcpu', ''), memory_gb=mt.get('memoryGib', '')))
+    return rows
+
+
+def fetch_hyperbolic() -> List[Dict[str, Any]]:
+    """GET /v2/skypilot/catalog (reference fetch_hyperbolic.py:11) —
+    the marketplace publishes a ready-made catalog document."""
+    resp = _client('hyperbolic').request('GET', '/v2/skypilot/catalog')
+    rows = []
+    for inst in resp.get('instances', []):
+        rows.append(_row(
+            inst.get('instance_type', ''),
+            inst.get('price', 0) or 0,
+            str(inst.get('region', 'any')),
+            accelerator_name=inst.get('gpu_model', ''),
+            accelerator_count=int(inst.get('gpu_count', 0) or 0),
+            cpus=inst.get('cpu_count', ''),
+            memory_gb=inst.get('ram_gb', '')))
+    return [r for r in rows if r['instance_type'] and r['price'] > 0]
+
+
+def fetch_do() -> List[Dict[str, Any]]:
+    """GET /v2/sizes — DigitalOcean's public size listing carries
+    hourly prices and per-size region availability."""
+    client = _client('do')
+    rows = []
+    page = '/v2/sizes'
+    params: Optional[Dict[str, str]] = {'per_page': '200'}
+    while page:
+        resp = client.request('GET', page, params=params)
+        for size in resp.get('sizes', []):
+            if not size.get('available', True):
+                continue
+            gpu_info = size.get('gpu_info') or {}
+            gpu = str(gpu_info.get('model', '') or '').upper()
+            for region in size.get('regions', []):
+                rows.append(_row(
+                    size.get('slug', ''),
+                    size.get('price_hourly', 0) or 0, region,
+                    accelerator_name=gpu,
+                    accelerator_count=int(gpu_info.get('count', 0)
+                                          or 0),
+                    cpus=size.get('vcpus', ''),
+                    memory_gb=round(
+                        float(size.get('memory', 0) or 0) / 1024, 1)))
+        nxt = (resp.get('links') or {}).get('pages', {}).get('next')
+        page = None
+        if nxt:
+            # The API hands back a absolute next URL; keep the path+q.
+            page = nxt.split('digitalocean.com')[-1]
+            params = None
+    return [r for r in rows if r['instance_type']]
+
+
+def fetch_ibm() -> List[Dict[str, Any]]:
+    """Per-region GET /v1/instance/profiles (reference
+    fetch_ibm.py:87). The profiles API carries shapes but NOT prices —
+    prices are merged from the existing CSV when present (IBM
+    publishes pricing only through its catalog console), so a refresh
+    updates availability/shape truth without zeroing cost data."""
+    client = _client('ibm')
+    import os as _os
+    regions = [r.strip() for r in _os.environ.get(
+        'IBM_CATALOG_REGIONS', 'us-south,us-east,eu-de,jp-tok'
+    ).split(',') if r.strip()]
+    old_prices = _existing_prices('ibm')
+    rows = []
+    for region in regions:
+        resp = client.request('GET', '/v1/instance/profiles',
+                              region=region)
+        for prof in resp.get('profiles', []):
+            name = prof.get('name', '')
+            gpu_model = ((prof.get('gpu_model') or {}).get('values')
+                         or [''])[0]
+            gpu_count = (prof.get('gpu_count') or {}).get('value', 0)
+            price = old_prices.get((name, region), '')
+            rows.append(_row(
+                name, price or 0, region,
+                accelerator_name=str(gpu_model).replace(' ', '-'),
+                accelerator_count=int(gpu_count or 0),
+                cpus=(prof.get('vcpu_count') or {}).get('value', ''),
+                memory_gb=(prof.get('memory') or {}).get('value', ''),
+                zone=f'{region}-1'))
+    return [r for r in rows if r['instance_type']]
+
+
+def fetch_oci() -> List[Dict[str, Any]]:
+    """GET /shapes (OCI core API; needs a compartment). Like IBM,
+    shape truth comes from the API and prices merge from the existing
+    CSV (OCI's price list is a separate unauthenticated service not
+    modeled here)."""
+    from skypilot_tpu.adaptors import oci as oci_adaptor
+    client = _client('oci')
+    config = oci_adaptor.load_config()
+    resp = client.request(
+        'GET', '/shapes',
+        params={'compartmentId': config.get('tenancy', '')})
+    shapes = resp if isinstance(resp, list) else resp.get('items', [])
+    old_prices = _existing_prices('oci')
+    region = config.get('region', '')
+    rows = []
+    for shape in shapes:
+        name = shape.get('shape', '')
+        gpus = int(shape.get('gpus', 0) or 0)
+        price = old_prices.get((name, region), '')
+        rows.append(_row(
+            name, price or 0, region,
+            accelerator_name=(shape.get('gpuDescription') or ''
+                              ).replace(' ', '-'),
+            accelerator_count=gpus,
+            cpus=shape.get('ocpus', '') or shape.get('vcpus', ''),
+            memory_gb=shape.get('memoryInGBs', '')))
+    return [r for r in rows if r['instance_type']]
+
+
+def fetch_scp() -> List[Dict[str, Any]]:
+    """GET /v3/products/virtual-servers — SCP's product listing with
+    hourly unit prices per server type."""
+    resp = _client('scp').request('GET', '/v3/products/virtual-servers')
+    rows = []
+    for item in resp.get('contents', []):
+        name = item.get('serverType') or item.get('productName', '')
+        price = item.get('pricePerHour') or item.get('unitPrice', 0)
+        if not name:
+            continue
+        rows.append(_row(
+            name, price or 0, item.get('region', 'KR-WEST-1'),
+            cpus=item.get('cpuCount', ''),
+            memory_gb=item.get('memorySize', '')))
+    return [r for r in rows if r['price'] > 0]
+
+
+# The vsphere catalog's capacity-class model: cpuN-memM rows with
+# NOMINAL prices (0.025 $/cpu/hr) that exist only to rank on-prem
+# capacity among clouds and by size — on-prem isn't billed hourly.
+_VSPHERE_CLASSES = (4, 8, 16, 32, 64)
+_VSPHERE_PRICE_PER_CPU = 0.025
+
+
+def fetch_vsphere() -> List[Dict[str, Any]]:
+    """GET /api/vcenter/host (reference fetch_vsphere.py builds from
+    the same vCenter inventory). Emits the catalog's capacity-class
+    rows (cpuN-mem{4N}) up to the largest CONNECTED host, preserving
+    the checked-in model — recipes pin instance types like cpu8-mem32
+    and must survive a refresh. GPU classes stay hand-curated: the
+    host listing doesn't expose PCI inventory."""
+    resp = _client('vsphere').request('GET', '/api/vcenter/host')
+    hosts = resp if isinstance(resp, list) else resp.get('items', [])
+    max_cpus = 0
+    for host in hosts:
+        if str(host.get('connection_state',
+                        'CONNECTED')) != 'CONNECTED':
+            continue
+        max_cpus = max(max_cpus, int(host.get('cpu_count', 0) or 0))
+    rows = []
+    for cpus in _VSPHERE_CLASSES:
+        if cpus > max_cpus:
+            break
+        mem = cpus * 4
+        rows.append(_row(
+            f'cpu{cpus}-mem{mem}', _VSPHERE_PRICE_PER_CPU * cpus,
+            'on-prem', cpus=cpus, memory_gb=mem))
+    return rows
+
+
+def _existing_prices(cloud: str) -> Dict[tuple, float]:
+    """(instance_type, region) -> price from the checked-in CSV, for
+    clouds whose API has shapes but not prices."""
+    path = os.path.join(os.path.dirname(__file__), '..', 'data', cloud,
+                        'vms.csv')
+    out: Dict[tuple, float] = {}
+    try:
+        with open(path, newline='', encoding='utf-8') as f:
+            for row in csv.DictReader(f):
+                try:
+                    out[(row['instance_type'], row['region'])] = \
+                        float(row['price'])
+                except (KeyError, ValueError):
+                    continue
+    except OSError:
+        pass
+    return out
+
+
+SPECS: Dict[str, Callable[[], List[Dict[str, Any]]]] = {
+    'lambda': fetch_lambda,
+    'vast': fetch_vast,
+    'fluidstack': fetch_fluidstack,
+    'cudo': fetch_cudo,
+    'hyperbolic': fetch_hyperbolic,
+    'do': fetch_do,
+    'ibm': fetch_ibm,
+    'oci': fetch_oci,
+    'scp': fetch_scp,
+    'vsphere': fetch_vsphere,
+}
+
+
+def write_csv(rows: List[Dict[str, Any]], path: str) -> int:
+    rows = sorted(rows, key=lambda r: (r['instance_type'], r['region'],
+                                       r['zone']))
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', newline='', encoding='utf-8') as f:
+        writer = csv.DictWriter(f, fieldnames=FIELDS)
+        writer.writeheader()
+        for row in rows:
+            writer.writerow(row)
+    return len(rows)
+
+
+def refresh(cloud: str, out_dir: Optional[str] = None) -> int:
+    """Fetch one cloud's rows and rewrite its vms.csv; returns the
+    row count. Raises if the cloud has no fetcher (see data/<cloud>/
+    README.md for the manual path)."""
+    if cloud not in SPECS:
+        raise ValueError(
+            f'No fetcher for {cloud!r} (have: {sorted(SPECS)}). '
+            f'See catalog/data/{cloud}/README.md for its refresh '
+            'path.')
+    rows = SPECS[cloud]()
+    if not rows:
+        raise ValueError(
+            f'{cloud}: the API returned zero usable rows; refusing '
+            'to overwrite the existing catalog with an empty file.')
+    out_dir = out_dir or os.path.join(os.path.dirname(__file__), '..',
+                                      'data', cloud)
+    return write_csv(rows, os.path.join(out_dir, 'vms.csv'))
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(
+        description='Refresh REST-cloud catalog CSVs from live APIs.')
+    parser.add_argument('clouds', nargs='*',
+                        help=f'any of: {", ".join(sorted(SPECS))}')
+    parser.add_argument('--all', action='store_true')
+    parser.add_argument('--out-dir', default=None,
+                        help='override output dir (default: in-tree '
+                             'catalog/data/<cloud>/)')
+    args = parser.parse_args()
+    clouds = sorted(SPECS) if args.all else args.clouds
+    if not clouds:
+        parser.error('name at least one cloud, or pass --all')
+    for cloud in clouds:
+        try:
+            n = refresh(cloud, args.out_dir)
+            print(f'{cloud}: wrote {n} rows')
+        except Exception as e:  # noqa: BLE001 — per-cloud isolation
+            print(f'{cloud}: FAILED: {e}')
+
+
+if __name__ == '__main__':
+    main()

@@ -276,3 +276,253 @@ class TestVmFetcher:
         with open(path) as f:
             parsed = list(csv.DictReader(f))
         assert parsed[0]['instance_type'].startswith('n2-standard-')
+
+
+# --- fetch_market: the shared REST-cloud fetch driver -----------------------
+
+class FakeRest:
+    """Records requests, returns canned payloads keyed by path."""
+
+    def __init__(self, payloads):
+        self.payloads = payloads
+        self.calls = []
+
+    def request(self, method, path, params=None, json_body=None,
+                **kwargs):
+        self.calls.append((method, path, params, kwargs))
+        for key, payload in self.payloads.items():
+            if path.startswith(key):
+                return payload(params, kwargs) if callable(payload) \
+                    else payload
+        raise AssertionError(f'unexpected path {path}')
+
+
+@pytest.fixture
+def market(monkeypatch):
+    """Inject a FakeRest into one adaptor; restore after."""
+
+    def _install(adaptor_name, payloads):
+        import importlib
+        mod = importlib.import_module(
+            f'skypilot_tpu.adaptors.{adaptor_name}')
+        fake = FakeRest(payloads)
+        mod.set_client_factory(lambda: fake)
+        installed.append(mod)
+        return fake
+
+    installed = []
+    yield _install
+    for mod in installed:
+        mod.set_client_factory(lambda: (_ for _ in ()).throw(
+            AssertionError('no client')))
+
+
+def test_fetch_lambda_rows(market):
+    from skypilot_tpu.catalog.data_fetchers import fetch_market
+    market('lambda_cloud', {'/instance-types': {'data': {
+        'gpu_8x_a100_80gb_sxm4': {
+            'instance_type': {
+                'name': 'gpu_8x_a100_80gb_sxm4',
+                'price_cents_per_hour': 1072,
+                'specs': {'vcpus': 124, 'memory_gib': 1800, 'gpus': 8},
+            },
+            'regions_with_capacity_available': [
+                {'name': 'us-east-1'}, {'name': 'us-west-2'}],
+        },
+        'cpu_4x_general': {
+            'instance_type': {
+                'name': 'cpu_4x_general',
+                'price_cents_per_hour': 9,
+                'specs': {'vcpus': 4, 'memory_gib': 16, 'gpus': 0},
+            },
+            'regions_with_capacity_available': [{'name': 'us-east-1'}],
+        },
+    }}})
+    rows = fetch_market.fetch_lambda()
+    assert len(rows) == 3
+    big = [r for r in rows if r['region'] == 'us-west-2'][0]
+    assert big['instance_type'] == 'gpu_8x_a100_80gb_sxm4'
+    # Interface suffix dropped: the catalog's canonical vocabulary
+    # (optimizer matches accelerator names by exact string).
+    assert big['accelerator_name'] == 'A100-80GB'
+    assert big['accelerator_count'] == 8
+    assert big['price'] == 10.72
+    cpu = [r for r in rows if r['instance_type'] == 'cpu_4x_general'][0]
+    assert cpu['accelerator_count'] == 0
+
+
+def test_fetch_vast_rows(market):
+    from skypilot_tpu.catalog.data_fetchers import fetch_market
+    fake = market('vast', {'/api/v0/bundles': {'offers': [
+        {'num_gpus': 4, 'gpu_name': 'RTX 4090', 'dph_total': 1.6,
+         'min_bid': 0.8, 'cpu_cores_effective': 32, 'cpu_ram': 131072,
+         'geolocation': 'Sweden'},
+        {'num_gpus': 0, 'gpu_name': '', 'dph_total': 0.1},  # skipped
+    ]}})
+    rows = fetch_market.fetch_vast()
+    assert len(rows) == 1
+    row = rows[0]
+    # Matches the checked-in vast vocabulary ('4x_RTX4090'), which
+    # the provisioner's GPU-name map is keyed on.
+    assert row['instance_type'] == '4x_RTX4090'
+    assert row['accelerator_name'] == 'RTX4090'
+    assert row['spot_price'] == 0.8 and row['memory_gb'] == 128.0
+    assert 'rentable' in (fake.calls[0][2] or {}).get('q', '')
+
+
+def test_fetch_fluidstack_and_hyperbolic(market):
+    from skypilot_tpu.catalog.data_fetchers import fetch_market
+    market('fluidstack', {'/list_available_configurations': [
+        {'gpu_type': 'A100_80GB', 'price_per_gpu_hr': '1.25',
+         'gpu_counts': [1, 2], 'regions': ['norway'],
+         'cpu_count': 28, 'ram_gb': 120}]})
+    rows = fetch_market.fetch_fluidstack()
+    assert {r['instance_type'] for r in rows} == \
+        {'1x_A100-80GB', '2x_A100-80GB'}
+    assert [r['price'] for r in sorted(rows, key=lambda r:
+            r['instance_type'])] == [1.25, 2.5]
+
+    market('hyperbolic', {'/v2/skypilot/catalog': {'instances': [
+        {'instance_type': '1x_H100', 'price': 1.99, 'region': 'us',
+         'gpu_model': 'H100', 'gpu_count': 1, 'cpu_count': 26,
+         'ram_gb': 200},
+        {'instance_type': '', 'price': 1}]}})
+    rows = fetch_market.fetch_hyperbolic()
+    assert len(rows) == 1 and rows[0]['accelerator_name'] == 'H100'
+
+
+def test_fetch_do_paginates(market):
+    from skypilot_tpu.catalog.data_fetchers import fetch_market
+    page2 = {'sizes': [
+        {'slug': 'gpu-h100x1-80gb', 'vcpus': 20, 'memory': 245760,
+         'price_hourly': 3.39, 'regions': ['tor1'], 'available': True,
+         'gpu_info': {'model': 'h100', 'count': 1}}], 'links': {}}
+    page1 = {'sizes': [
+        {'slug': 's-2vcpu-4gb', 'vcpus': 2, 'memory': 4096,
+         'price_hourly': 0.0357, 'regions': ['nyc3', 'sfo3'],
+         'available': True},
+        {'slug': 'gone-size', 'available': False, 'regions': ['nyc3'],
+         'price_hourly': 1}],
+        'links': {'pages': {'next':
+            'https://api.digitalocean.com/v2/sizes?page=2'}}}
+
+    def sizes(params, kwargs):
+        if params and params.get('per_page'):
+            return page1
+        return page2
+    market('do', {'/v2/sizes': sizes})
+    rows = fetch_market.fetch_do()
+    assert len(rows) == 3  # 2 regions + 1 GPU row; unavailable skipped
+    gpu = [r for r in rows if r['accelerator_count']][0]
+    assert gpu['accelerator_name'] == 'H100'
+    assert gpu['memory_gb'] == 240.0
+
+
+def test_fetch_ibm_merges_existing_prices(market, monkeypatch,
+                                          tmp_path):
+    from skypilot_tpu.catalog.data_fetchers import fetch_market
+    monkeypatch.setenv('IBM_CATALOG_REGIONS', 'us-south')
+    market('ibm', {'/v1/instance/profiles': {'profiles': [
+        {'name': 'bx2-8x32', 'vcpu_count': {'value': 8},
+         'memory': {'value': 32}},
+        {'name': 'gx2-8x64x1v100', 'vcpu_count': {'value': 8},
+         'memory': {'value': 64},
+         'gpu_model': {'values': ['V100']},
+         'gpu_count': {'value': 1}},
+    ]}})
+    rows = fetch_market.fetch_ibm()
+    by_name = {r['instance_type']: r for r in rows}
+    # bx2-8x32 @ us-south exists in the checked-in CSV: price carried.
+    assert by_name['bx2-8x32']['price'] == 0.38
+    assert by_name['gx2-8x64x1v100']['accelerator_name'] == 'V100'
+
+
+def test_fetch_vsphere_inventory(market):
+    """Capacity classes (the checked-in catalog model: recipes pin
+    cpu8-mem32 style types) bounded by the largest CONNECTED host."""
+    from skypilot_tpu.catalog.data_fetchers import fetch_market
+    market('vsphere', {'/api/vcenter/host': [
+        {'host': 'host-1', 'name': 'esx1', 'cpu_count': 16,
+         'memory_gb': 512, 'connection_state': 'CONNECTED'},
+        {'host': 'host-2', 'name': 'esx2', 'cpu_count': 64,
+         'connection_state': 'DISCONNECTED'},
+    ]})
+    rows = fetch_market.fetch_vsphere()
+    assert [r['instance_type'] for r in rows] == \
+        ['cpu4-mem16', 'cpu8-mem32', 'cpu16-mem64']
+    assert rows[1]['price'] == 0.2  # nominal ranking price
+    assert all(r['region'] == 'on-prem' for r in rows)
+
+
+def test_refresh_writes_csv_and_refuses_empty(market, tmp_path):
+    from skypilot_tpu.catalog.data_fetchers import fetch_market
+    market('scp', {'/v3/products/virtual-servers': {'contents': [
+        {'serverType': 's1v2m4', 'pricePerHour': 0.05,
+         'region': 'kr-west-1', 'cpuCount': 2, 'memorySize': 4}]}})
+    n = fetch_market.refresh('scp', out_dir=str(tmp_path))
+    assert n == 1
+    with open(tmp_path / 'vms.csv', newline='') as f:
+        got = list(csv.DictReader(f))
+    assert got[0]['instance_type'] == 's1v2m4'
+    assert got[0]['price'] == '0.05'
+    # Empty API result must never blank a catalog.
+    market('scp', {'/v3/products/virtual-servers': {'contents': []}})
+    with pytest.raises(ValueError, match='zero usable rows'):
+        fetch_market.refresh('scp', out_dir=str(tmp_path))
+    with pytest.raises(ValueError, match='No fetcher'):
+        fetch_market.refresh('nebius')
+
+
+def test_every_catalog_dir_documents_refresh():
+    """Each cloud's data dir must say how its CSV gets refreshed
+    (fetcher command or manual source)."""
+    import glob
+    import os
+    base = os.path.join(os.path.dirname(__file__), '..', '..',
+                        'skypilot_tpu', 'catalog', 'data')
+    dirs = [d for d in glob.glob(os.path.join(base, '*'))
+            if os.path.isdir(d)]
+    assert len(dirs) >= 16
+    for d in dirs:
+        assert os.path.isfile(os.path.join(d, 'README.md')), \
+            f'{os.path.basename(d)} has no refresh README'
+
+
+def test_fetch_cudo_and_oci(market, monkeypatch):
+    from skypilot_tpu.adaptors import oci as oci_adaptor
+    from skypilot_tpu.catalog.data_fetchers import fetch_market
+    market('cudo', {'/v1/vms/machine-types': {'machineTypes': [
+        {'machineType': 'epyc-8x-a100-80',
+         'totalPriceHr': {'value': '12.40'}, 'vcpu': 128,
+         'memoryGib': 960, 'gpuModel': 'A100 80GB',
+         'dataCenterId': 'se-smedjebacken-1'},
+        {'machineType': 'epyc-rome-rtxa4000',
+         'totalPriceHr': {'value': '0.35'}, 'vcpu': 4,
+         'memoryGib': 16, 'gpuModel': 'RTX A4000',
+         'dataCenterId': 'se-smedjebacken-1'},
+        {'machineType': 'free-tier', 'totalPriceHr': {'value': '0'}},
+    ]}})
+    rows = fetch_market.fetch_cudo()
+    by_name = {r['instance_type']: r for r in rows}
+    assert set(by_name) == {'epyc-8x-a100-80', 'epyc-rome-rtxa4000'}
+    # GPU count parses from the catalog's '-<N>x-' name convention.
+    assert by_name['epyc-8x-a100-80']['accelerator_count'] == 8
+    assert by_name['epyc-8x-a100-80']['accelerator_name'] == 'A100-80GB'
+    assert by_name['epyc-rome-rtxa4000']['accelerator_name'] == \
+        'RTXA4000'
+    assert by_name['epyc-rome-rtxa4000']['accelerator_count'] == 1
+
+    monkeypatch.setattr(
+        oci_adaptor, 'load_config',
+        lambda: {'tenancy': 'ocid1.tenancy.x', 'region': 'us-ashburn-1'})
+    market('oci', {'/shapes': {'items': [
+        {'shape': 'VM.Standard.E4.Flex', 'ocpus': 4,
+         'memoryInGBs': 64, 'gpus': 0},
+        {'shape': 'BM.GPU.A100-v2.8', 'ocpus': 128, 'memoryInGBs': 2048,
+         'gpus': 8, 'gpuDescription': 'NVIDIA A100 80GB'},
+    ]}})
+    rows = fetch_market.fetch_oci()
+    by_name = {r['instance_type']: r for r in rows}
+    assert by_name['BM.GPU.A100-v2.8']['accelerator_count'] == 8
+    assert by_name['BM.GPU.A100-v2.8']['accelerator_name'] == \
+        'NVIDIA-A100-80GB'
