@@ -227,14 +227,16 @@ def fetch_ibm() -> List[Dict[str, Any]]:
     fetch_ibm.py:87). The profiles API carries shapes but NOT prices —
     prices are merged from the existing CSV when present (IBM
     publishes pricing only through its catalog console), so a refresh
-    updates availability/shape truth without zeroing cost data."""
+    updates availability/shape truth without zeroing cost data.
+    Profiles with NO known price are skipped — a $0 row would outrank
+    every honestly-priced instance in the optimizer."""
     client = _client('ibm')
-    import os as _os
-    regions = [r.strip() for r in _os.environ.get(
+    regions = [r.strip() for r in os.environ.get(
         'IBM_CATALOG_REGIONS', 'us-south,us-east,eu-de,jp-tok'
     ).split(',') if r.strip()]
     old_prices = _existing_prices('ibm')
     rows = []
+    skipped = 0
     for region in regions:
         resp = client.request('GET', '/v1/instance/profiles',
                               region=region)
@@ -243,14 +245,20 @@ def fetch_ibm() -> List[Dict[str, Any]]:
             gpu_model = ((prof.get('gpu_model') or {}).get('values')
                          or [''])[0]
             gpu_count = (prof.get('gpu_count') or {}).get('value', 0)
-            price = old_prices.get((name, region), '')
+            price = old_prices.get((name, region))
+            if not price:
+                skipped += 1
+                continue
             rows.append(_row(
-                name, price or 0, region,
+                name, price, region,
                 accelerator_name=str(gpu_model).replace(' ', '-'),
                 accelerator_count=int(gpu_count or 0),
                 cpus=(prof.get('vcpu_count') or {}).get('value', ''),
                 memory_gb=(prof.get('memory') or {}).get('value', ''),
                 zone=f'{region}-1'))
+    if skipped:
+        print(f'ibm: skipped {skipped} profiles with no known price '
+              '(add them to data/ibm/vms.csv by hand to include them)')
     return [r for r in rows if r['instance_type']]
 
 
@@ -269,17 +277,25 @@ def fetch_oci() -> List[Dict[str, Any]]:
     old_prices = _existing_prices('oci')
     region = config.get('region', '')
     rows = []
+    skipped = 0
     for shape in shapes:
         name = shape.get('shape', '')
         gpus = int(shape.get('gpus', 0) or 0)
-        price = old_prices.get((name, region), '')
+        price = old_prices.get((name, region))
+        if not price:
+            # A $0 row would outrank every honestly-priced instance.
+            skipped += 1
+            continue
         rows.append(_row(
-            name, price or 0, region,
+            name, price, region,
             accelerator_name=(shape.get('gpuDescription') or ''
                               ).replace(' ', '-'),
             accelerator_count=gpus,
             cpus=shape.get('ocpus', '') or shape.get('vcpus', ''),
             memory_gb=shape.get('memoryInGBs', '')))
+    if skipped:
+        print(f'oci: skipped {skipped} shapes with no known price '
+              '(add them to data/oci/vms.csv by hand to include them)')
     return [r for r in rows if r['instance_type']]
 
 
@@ -410,12 +426,18 @@ def main() -> None:
     clouds = sorted(SPECS) if args.all else args.clouds
     if not clouds:
         parser.error('name at least one cloud, or pass --all')
+    failed = 0
     for cloud in clouds:
         try:
             n = refresh(cloud, args.out_dir)
             print(f'{cloud}: wrote {n} rows')
         except Exception as e:  # noqa: BLE001 — per-cloud isolation
             print(f'{cloud}: FAILED: {e}')
+            failed += 1
+    if failed:
+        # Cron/CI must see a failed refresh, not ship stale CSVs
+        # behind an exit-0.
+        raise SystemExit(1)
 
 
 if __name__ == '__main__':

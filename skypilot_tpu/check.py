@@ -26,35 +26,74 @@ def _after_fork_in_child() -> None:
 os.register_at_fork(after_in_child=_after_fork_in_child)
 
 
-def check_credentials(cloud_names: Optional[List[str]] = None
+def check_credentials(cloud_names: Optional[List[str]] = None,
+                      probe: bool = False
                       ) -> Dict[str, Tuple[bool, Optional[str]]]:
-    """Probe credentials for each cloud; returns {cloud: (ok, reason)}."""
-    results: Dict[str, Tuple[bool, Optional[str]]] = {}
-    for name in cloud_names or CLOUD_REGISTRY.names():
+    """Check credentials for each cloud; returns {cloud: (ok, reason)}.
+
+    probe=False: local presence checks only (key file / env exists) —
+    offline and instant. probe=True: additionally makes one cheap
+    AUTHENTICATED API call per present-credential cloud (reference
+    sky/check.py:53 check_capabilities), in parallel — a revoked key
+    disables the cloud HERE with its name on it, instead of failing
+    over mid-provision."""
+    import concurrent.futures
+
+    names = list(cloud_names or CLOUD_REGISTRY.names())
+
+    def _one(name: str) -> Tuple[bool, Optional[str]]:
         cloud = clouds_lib.get_cloud(name)
         try:
-            results[name] = cloud.check_credentials()
+            if probe:
+                return cloud.probe_credentials()
+            return cloud.check_credentials()
         except Exception as e:  # noqa: BLE001 — a broken SDK != fatal
-            results[name] = (False, f'credential check error: {e}')
-    return results
+            return False, f'credential check error: {e}'
+
+    if not probe or len(names) <= 1:
+        return {name: _one(name) for name in names}
+    with concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(8, len(names))) as pool:
+        futures = {name: pool.submit(_one, name) for name in names}
+        return {name: fut.result() for name, fut in futures.items()}
 
 
-def check(refresh: bool = True, quiet: bool = True) -> List[str]:
-    """Probe all clouds, persist the enabled set, return it."""
+def check(refresh: bool = True, quiet: bool = True,
+          probe: bool = False) -> List[str]:
+    """Check all clouds, persist the enabled set + per-cloud detail,
+    return the enabled list."""
+    import time
+
     allowed = config_lib.get_nested(('allowed_clouds',), None)
     names = [n for n in CLOUD_REGISTRY.names()
              if allowed is None or n in allowed]
-    results = check_credentials(names)
+    results = check_credentials(names, probe=probe)
     enabled = sorted(n for n, (ok, _) in results.items() if ok)
     path = os.path.expanduser(_CACHE_PATH)
     os.makedirs(os.path.dirname(path), exist_ok=True)
+    details = {name: {'ok': ok, 'reason': reason,
+                      'probed': probe,
+                      'checked_at': int(time.time())}
+               for name, (ok, reason) in results.items()}
     with _lock, open(path, 'w', encoding='utf-8') as f:
-        json.dump({'enabled': enabled}, f)
+        json.dump({'enabled': enabled, 'details': details}, f)
     if not quiet:
         for name, (ok, reason) in sorted(results.items()):
             mark = 'enabled' if ok else f'disabled: {reason}'
             print(f'  {name}: {mark}')
     return enabled
+
+
+def cached_details() -> Dict[str, Dict]:
+    """Per-cloud result of the last check (reason, probed flag,
+    timestamp) — what `tsky check`/the dashboard display without
+    re-probing."""
+    path = os.path.expanduser(_CACHE_PATH)
+    try:
+        with open(path, 'r', encoding='utf-8') as f:
+            return json.load(f).get('details', {})
+    except (json.JSONDecodeError, OSError):
+        return {}
 
 
 def get_cached_enabled_clouds_or_refresh(

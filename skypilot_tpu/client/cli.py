@@ -252,10 +252,35 @@ def logs(cluster, job_id, no_follow, tail):
 
 
 @cli.command()
-def check():
-    """Probe cloud credentials and cache enabled clouds."""
+@click.option('--no-probe', is_flag=True,
+              help='Skip the per-cloud authenticated API probes '
+                   '(presence checks only; offline).')
+def check(no_probe):
+    """Probe cloud credentials and cache enabled clouds.
+
+    By default each present credential is VERIFIED with one cheap
+    authenticated API call, so a revoked key fails here with the
+    cloud named — not as a mid-provision failover."""
     from skypilot_tpu.client import sdk
-    enabled = sdk.get(sdk.check())
+    result = sdk.get(sdk.check(probe=not no_probe, verbose=True),
+                     timeout=180)
+    details = result.get('details', {})
+    enabled = result.get('enabled', [])
+    for name in sorted(details):
+        d = details[name]
+        reason = str(d.get('reason') or '')
+        if d.get('ok'):
+            if 'inconclusive' in reason:
+                click.echo(f'  {name}: enabled ({reason})')
+            else:
+                kind = ('verified' if d.get('probed')
+                        else 'credentials found')
+                click.echo(f'  {name}: enabled ({kind})')
+        elif 'reject' in reason.lower() or 'probe' in reason.lower():
+            # Rejected/broken credentials are loud (these phrasings
+            # come from cloud.py's probe taxonomy, not free text);
+            # absent ones are the normal case and stay quiet.
+            click.echo(f'  {name}: DISABLED: {reason}')
     if enabled:
         click.echo('Enabled infra: ' + ', '.join(enabled))
     else:

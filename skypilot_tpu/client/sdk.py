@@ -253,8 +253,8 @@ def cost_report() -> str:
     return _submit('cost_report', {})
 
 
-def check() -> str:
-    return _submit('check', {})
+def check(probe: bool = False, verbose: bool = False) -> str:
+    return _submit('check', {'probe': probe, 'verbose': verbose})
 
 
 def optimize(task, minimize: str = 'COST') -> str:

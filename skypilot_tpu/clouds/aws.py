@@ -69,3 +69,16 @@ class AWS(cloud.Cloud):
         return False, ('AWS credentials not found; set AWS_ACCESS_KEY_ID/'
                        'AWS_SECRET_ACCESS_KEY or populate '
                        '~/.aws/credentials.')
+
+    def probe_credentials(self):
+        """Authenticated probe: DescribeRegions with the configured
+        keys (reference sky/check.py:53)."""
+        ok, reason = self.check_credentials()
+        if not ok:
+            return ok, reason
+        from skypilot_tpu.adaptors import aws as adaptor
+        try:
+            adaptor.client('us-east-1').call('DescribeRegions')
+        except Exception as e:  # noqa: BLE001
+            return self._classify_probe_error(e)
+        return True, None

@@ -78,3 +78,17 @@ class Azure(cloud.Cloud):
             pass
         return False, ('Azure credentials not found. Run `az login` or '
                        'set AZURE_SUBSCRIPTION_ID.')
+
+    def probe_credentials(self):
+        """Authenticated probe: read the configured subscription."""
+        ok, reason = self.check_credentials()
+        if not ok:
+            return ok, reason
+        from skypilot_tpu.adaptors import azure as adaptor
+        try:
+            sub = adaptor.default_subscription()
+            adaptor.client().request(
+                'GET', f'/subscriptions/{sub}?api-version=2021-04-01')
+        except Exception as e:  # noqa: BLE001
+            return self._classify_probe_error(e)
+        return True, None

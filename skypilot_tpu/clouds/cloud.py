@@ -73,9 +73,49 @@ class Cloud:
 
     # --- credentials --------------------------------------------------------
 
+    # (adaptor module, GET path, params) for the generic authenticated
+    # probe; None = this cloud only gets the local presence check.
+    PROBE: Optional[Tuple[str, str, Optional[Dict[str, str]]]] = None
+
     def check_credentials(self) -> Tuple[bool, Optional[str]]:
-        """(ok, reason-if-not)."""
+        """(ok, reason-if-not) — LOCAL presence check only (key file /
+        env var exists). Cheap, offline."""
         return False, f'{self.NAME}: no credential check implemented'
+
+    def probe_credentials(self) -> Tuple[bool, Optional[str]]:
+        """Presence check + one cheap AUTHENTICATED list call
+        (reference sky/check.py:53 check_capabilities): a revoked key
+        must fail at `tsky check` with this cloud's name on it, not
+        as a mid-provision failover. Only 401/403-class rejections
+        disable the cloud — a malformed-request 4xx still proves the
+        credential was accepted."""
+        ok, reason = self.check_credentials()
+        if not ok or self.PROBE is None:
+            return ok, reason
+        import importlib
+        adaptor_name, path, params = self.PROBE
+        mod = importlib.import_module(
+            f'skypilot_tpu.adaptors.{adaptor_name}')
+        try:
+            mod.client().request('GET', path, params=params)
+        except Exception as e:  # noqa: BLE001 — taxonomy below
+            return self._classify_probe_error(e)
+        return True, None
+
+    def _classify_probe_error(self, e: Exception
+                              ) -> Tuple[bool, Optional[str]]:
+        """Only a definitive auth rejection (401/403) disables the
+        cloud. Any other API status proves the credential was
+        accepted; transport-level failures (DNS, 503 maintenance) are
+        INCONCLUSIVE — a transient outage during `tsky check` must
+        not strip a validly-credentialed cloud from the enabled set."""
+        status = getattr(e, 'status', None)
+        if status in (401, 403):
+            return False, (f'{self.NAME}: credentials present but '
+                           f'REJECTED by the API: {e}')
+        if status is not None and 400 <= status < 500:
+            return True, None  # authenticated; our probe was imperfect
+        return True, f'{self.NAME}: probe inconclusive: {e}'
 
     def authentication_config(self) -> Dict[str, object]:
         """SSH identity for reaching this cloud's instances

@@ -50,6 +50,9 @@ class Cudo(cloud.Cloud):
         from skypilot_tpu import authentication
         return authentication.authentication_config()
 
+    # Cheap authenticated probe for `tsky check` (clouds/cloud.py).
+    PROBE = ('cudo', '/v1/projects', None)
+
     def check_credentials(self) -> Tuple[bool, Optional[str]]:
         from skypilot_tpu.adaptors import cudo as adaptor
         if adaptor.get_api_key():

@@ -52,6 +52,9 @@ class Fluidstack(cloud.Cloud):
         from skypilot_tpu import authentication
         return authentication.authentication_config()
 
+    # Cheap authenticated probe for `tsky check` (clouds/cloud.py).
+    PROBE = ('fluidstack', '/instances', None)
+
     def check_credentials(self) -> Tuple[bool, Optional[str]]:
         from skypilot_tpu.adaptors import fluidstack as adaptor
         if adaptor.get_api_key():

@@ -114,3 +114,20 @@ class GCP(cloud.Cloud):
             pass
         return False, ('GCP credentials not found. Run `gcloud auth '
                        'application-default login`.')
+
+    def probe_credentials(self):
+        """Authenticated probe: one zones.list page against the
+        default project (reference sky/check.py:53)."""
+        ok, reason = self.check_credentials()
+        if not ok:
+            return ok, reason
+        from skypilot_tpu.adaptors import gcp as adaptor
+        try:
+            project = adaptor.default_project()
+            adaptor.transport().request(
+                'GET',
+                f'{adaptor.COMPUTE_API}/projects/{project}/zones',
+                params={'maxResults': '1'})
+        except Exception as e:  # noqa: BLE001
+            return self._classify_probe_error(e)
+        return True, None

@@ -56,6 +56,9 @@ class IBM(cloud.Cloud):
         from skypilot_tpu import authentication
         return authentication.authentication_config()
 
+    # Cheap authenticated probe for `tsky check` (clouds/cloud.py).
+    PROBE = ('ibm', '/v1/keys', {'limit': '1'})
+
     def check_credentials(self) -> Tuple[bool, Optional[str]]:
         from skypilot_tpu.adaptors import ibm as adaptor
         if adaptor.get_api_key():

@@ -78,3 +78,23 @@ class Kubernetes(cloud.Cloud):
             return False, 'kubectl has no current context configured.'
         except (FileNotFoundError, subprocess.TimeoutExpired):
             return False, 'kubectl not found on PATH.'
+
+    def probe_credentials(self):
+        """Authenticated probe: list one node — a kubeconfig whose
+        token expired fails here, not at pod creation."""
+        ok, reason = self.check_credentials()
+        if not ok:
+            return ok, reason
+        try:
+            proc = subprocess.run(
+                ['kubectl', 'get', 'nodes', '-o', 'name',
+                 '--request-timeout=10s'],
+                capture_output=True, timeout=15, check=False)
+        except (FileNotFoundError, subprocess.TimeoutExpired) as e:
+            return False, f'kubernetes: probe failed: {e}'
+        if proc.returncode != 0:
+            return False, ('kubernetes: kubectl authentication '
+                           'rejected: '
+                           + proc.stderr.decode(errors="replace")
+                           .strip()[:200])
+        return True, None

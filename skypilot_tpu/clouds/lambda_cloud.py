@@ -44,6 +44,9 @@ class LambdaCloud(cloud.Cloud):
         from skypilot_tpu import authentication
         return authentication.authentication_config()
 
+    # Cheap authenticated probe for `tsky check` (clouds/cloud.py).
+    PROBE = ('lambda_cloud', '/instances', None)
+
     def check_credentials(self) -> Tuple[bool, Optional[str]]:
         from skypilot_tpu.adaptors import lambda_cloud as adaptor
         if adaptor.get_api_key():

@@ -55,6 +55,9 @@ class Nebius(cloud.Cloud):
         from skypilot_tpu import authentication
         return authentication.authentication_config()
 
+    # Cheap authenticated probe for `tsky check` (clouds/cloud.py).
+    PROBE = ('nebius', '/compute/v1/instances', {'pageSize': '1'})
+
     def check_credentials(self) -> Tuple[bool, Optional[str]]:
         from skypilot_tpu.adaptors import nebius as adaptor
         if adaptor.get_iam_token():

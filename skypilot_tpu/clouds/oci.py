@@ -64,3 +64,20 @@ class OCI(cloud.Cloud):
             return True, None
         return False, ('OCI config not found. Create ~/.oci/config '
                        'with user/fingerprint/tenancy/region/key_file.')
+
+    def probe_credentials(self):
+        """Authenticated probe: one instance-list page in the tenancy
+        compartment (proves the signing key is accepted)."""
+        ok, reason = self.check_credentials()
+        if not ok:
+            return ok, reason
+        from skypilot_tpu.adaptors import oci as adaptor
+        try:
+            config = adaptor.load_config()
+            adaptor.client().request(
+                'GET', '/instances/',
+                params={'compartmentId': config.get('tenancy', ''),
+                        'limit': '1'})
+        except Exception as e:  # noqa: BLE001
+            return self._classify_probe_error(e)
+        return True, None

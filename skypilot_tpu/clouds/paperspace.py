@@ -47,6 +47,9 @@ class Paperspace(cloud.Cloud):
         from skypilot_tpu import authentication
         return authentication.authentication_config()
 
+    # Cheap authenticated probe for `tsky check` (clouds/cloud.py).
+    PROBE = ('paperspace', '/machines', {'limit': '1'})
+
     def check_credentials(self) -> Tuple[bool, Optional[str]]:
         from skypilot_tpu.adaptors import paperspace as adaptor
         if adaptor.get_api_key():

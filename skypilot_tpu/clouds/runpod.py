@@ -63,6 +63,9 @@ class RunPod(cloud.Cloud):
         from skypilot_tpu import authentication
         return authentication.authentication_config()
 
+    # Cheap authenticated probe for `tsky check` (clouds/cloud.py).
+    PROBE = ('runpod', '/pods', None)
+
     def check_credentials(self) -> Tuple[bool, Optional[str]]:
         from skypilot_tpu.adaptors import runpod as adaptor
         if adaptor.get_api_key():

@@ -50,6 +50,9 @@ class SCP(cloud.Cloud):
         from skypilot_tpu import authentication
         return authentication.authentication_config()
 
+    # Cheap authenticated probe for `tsky check` (clouds/cloud.py).
+    PROBE = ('scp', '/virtual-server/v2/virtual-servers', {'size': '1'})
+
     def check_credentials(self) -> Tuple[bool, Optional[str]]:
         from skypilot_tpu.adaptors import scp as adaptor
         if (adaptor.get_access_key() and adaptor.get_secret_key()

@@ -61,6 +61,9 @@ class Vsphere(cloud.Cloud):
         from skypilot_tpu import authentication
         return authentication.authentication_config()
 
+    # Cheap authenticated probe for `tsky check` (clouds/cloud.py).
+    PROBE = ('vsphere', '/api/vcenter/host', None)
+
     def check_credentials(self) -> Tuple[bool, Optional[str]]:
         from skypilot_tpu.adaptors import vsphere as adaptor
         if (adaptor.get_server() and adaptor.get_username()

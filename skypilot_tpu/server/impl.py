@@ -142,9 +142,16 @@ def cost_report(payload: Dict[str, Any]) -> List[Dict[str, Any]]:
 
 
 @executor.register('check')
-def check(payload: Dict[str, Any]) -> List[str]:
+def check(payload: Dict[str, Any]):
+    """Default (old clients): the enabled list. verbose=True adds the
+    per-cloud probe detail; probe=True makes the authenticated calls
+    (reference sky/check.py:53)."""
     from skypilot_tpu import check as check_lib
-    return check_lib.check(refresh=True, quiet=True)
+    enabled = check_lib.check(refresh=True, quiet=True,
+                              probe=bool(payload.get('probe')))
+    if not payload.get('verbose'):
+        return enabled
+    return {'enabled': enabled, 'details': check_lib.cached_details()}
 
 
 @executor.register('optimize')

@@ -83,7 +83,10 @@ SCHEMAS: Dict[str, Dict[str, Field]] = {
         'tail': _opt(int, default=0),
     },
     'cost_report': {},
-    'check': {},
+    'check': {
+        'probe': _opt(bool, default=False),
+        'verbose': _opt(bool, default=False),
+    },
     'optimize': {
         'task': _TASK,
         'minimize': _opt(str, choices=('COST', 'TIME'), default='COST'),

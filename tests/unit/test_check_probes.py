@@ -1,0 +1,123 @@
+"""`tsky check` credential probes: a present-but-revoked key fails at
+check time with the cloud named, not as a mid-provision failover.
+
+Reference analog: sky/check.py:53 `check_capabilities` — real
+per-cloud API validation behind the check command.
+"""
+import json
+import os
+
+import pytest
+
+from skypilot_tpu import check as check_lib
+from skypilot_tpu import clouds as clouds_lib
+from skypilot_tpu.adaptors import rest
+from skypilot_tpu.adaptors import vast as vast_adaptor
+
+
+class _Raises:
+    def __init__(self, exc):
+        self.exc = exc
+
+    def request(self, *a, **k):
+        raise self.exc
+
+
+class _Records:
+    def __init__(self):
+        self.calls = []
+
+    def request(self, method, path, params=None, json_body=None):
+        self.calls.append((method, path))
+        return {}
+
+
+@pytest.fixture
+def vast_key(monkeypatch):
+    monkeypatch.setattr(vast_adaptor, 'get_api_key', lambda: 'k-123')
+    yield
+    vast_adaptor.set_client_factory(lambda: (_ for _ in ()).throw(
+        AssertionError('no client')))
+
+
+@pytest.fixture
+def only_vast_and_local(monkeypatch):
+    """Scope check() to clouds under test: without this, a dev/CI box
+    with real env credentials (AWS_ACCESS_KEY_ID, KUBECONFIG, ...)
+    would make LIVE authenticated calls from a unit test."""
+    cfg_path = os.path.expanduser('~/.skytpu/config.yaml')
+    os.makedirs(os.path.dirname(cfg_path), exist_ok=True)
+    with open(cfg_path, 'w', encoding='utf-8') as f:
+        f.write('allowed_clouds: [vast, local]\n')
+    from skypilot_tpu import config as config_lib
+    config_lib.reload()
+
+
+def test_revoked_key_fails_probe_with_cloud_named(vast_key):
+    vast_adaptor.set_client_factory(lambda: _Raises(
+        rest.RestApiError('GET /instances: HTTP 401: bad key',
+                          status=401)))
+    cloud = clouds_lib.get_cloud('vast')
+    # Presence says fine; the probe says no.
+    assert cloud.check_credentials() == (True, None)
+    ok, reason = cloud.probe_credentials()
+    assert not ok
+    assert 'vast' in reason and 'REJECTED' in reason
+
+
+def test_malformed_request_4xx_still_counts_authenticated(vast_key):
+    vast_adaptor.set_client_factory(lambda: _Raises(
+        rest.RestApiError('GET: HTTP 404: moved', status=404)))
+    assert clouds_lib.get_cloud('vast').probe_credentials() == \
+        (True, None)
+
+
+def test_transport_failure_is_inconclusive_not_disabling(vast_key):
+    """A DNS failure or 503 during check must not strip a validly-
+    credentialed cloud from the enabled set (transient outage)."""
+    vast_adaptor.set_client_factory(lambda: _Raises(
+        rest.RestApiError('GET /instances: connection refused')))
+    ok, reason = clouds_lib.get_cloud('vast').probe_credentials()
+    assert ok and 'inconclusive' in reason
+    vast_adaptor.set_client_factory(lambda: _Raises(
+        rest.RestApiError('HTTP 503: maintenance', status=503)))
+    ok, reason = clouds_lib.get_cloud('vast').probe_credentials()
+    assert ok and 'inconclusive' in reason
+
+
+def test_probe_hits_the_list_endpoint(vast_key):
+    fake = _Records()
+    vast_adaptor.set_client_factory(lambda: fake)
+    assert clouds_lib.get_cloud('vast').probe_credentials() == \
+        (True, None)
+    assert fake.calls == [('GET', '/api/v0/instances/')]
+
+
+def test_check_with_probe_caches_details(vast_key, only_vast_and_local,
+                                         monkeypatch):
+    """check(probe=True): rejected cloud excluded from enabled, and
+    the cached details carry the per-cloud reason + probed flag."""
+    vast_adaptor.set_client_factory(lambda: _Raises(
+        rest.RestApiError('HTTP 403: key disabled', status=403)))
+    enabled = check_lib.check(quiet=True, probe=True)
+    assert 'vast' not in enabled
+    assert 'local' in enabled  # presence-only clouds unaffected
+    details = check_lib.cached_details()
+    assert details['vast']['ok'] is False
+    assert 'REJECTED' in details['vast']['reason']
+    assert details['vast']['probed'] is True
+    assert details['local']['ok'] is True
+    # The cache file itself holds both keys (old readers only look at
+    # 'enabled', which keeps its shape).
+    with open(os.path.expanduser('~/.skytpu/enabled_clouds.json')) as f:
+        doc = json.load(f)
+    assert set(doc) == {'enabled', 'details'}
+
+
+def test_check_without_probe_never_calls_apis(vast_key,
+                                              only_vast_and_local):
+    vast_adaptor.set_client_factory(lambda: (_ for _ in ()).throw(
+        AssertionError('probe must not run')))
+    enabled = check_lib.check(quiet=True, probe=False)
+    assert 'vast' in enabled  # presence passes; no API call made
+    assert check_lib.cached_details()['vast']['probed'] is False
