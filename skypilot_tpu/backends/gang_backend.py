@@ -239,11 +239,32 @@ class GangBackend(backend_lib.Backend[ClusterHandle]):
                             launched: resources_lib.Resources) -> None:
         autostop = launched.autostop
         if autostop is None or not autostop.enabled:
+            # UNSET gets the local default; an EXPLICIT opt-out
+            # (autostop: false) is the user saying "stay up" and wins.
+            if autostop is None and str(launched.cloud) == 'local':
+                self._set_default_local_autostop(handle)
             return
         # TPU slices cannot stop — force down (reference
         # clouds/gcp.py:216-226).
         down = autostop.down or launched.is_tpu
         self.set_autostop(handle, autostop.idle_minutes, down)
+
+    def _set_default_local_autostop(self, handle: ClusterHandle) -> None:
+        """Local-cloud clusters run on the user's OWN machine, and an
+        abandoned session would leave its skylet ticking forever (the
+        hygiene contract says zero daemons after the work is gone).
+        Default: terminate after local.default_autostop_minutes idle
+        (4h if unset; 0 disables). Explicit user autostop wins."""
+        from skypilot_tpu import config as config_lib
+        minutes = config_lib.get_nested(
+            ('local', 'default_autostop_minutes'), default=240)
+        try:
+            minutes = float(minutes)
+        except (TypeError, ValueError):
+            return
+        if minutes <= 0:
+            return
+        self.set_autostop(handle, minutes, down=True)
 
     # --- sync ---------------------------------------------------------------
 

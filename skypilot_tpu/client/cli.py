@@ -276,10 +276,12 @@ def check(no_probe):
                 kind = ('verified' if d.get('probed')
                         else 'credentials found')
                 click.echo(f'  {name}: enabled ({kind})')
-        elif 'reject' in reason.lower() or 'probe' in reason.lower():
+        elif ('reject' in reason.lower() or 'probe' in reason.lower()
+              or 'error' in reason.lower()):
             # Rejected/broken credentials are loud (these phrasings
-            # come from cloud.py's probe taxonomy, not free text);
-            # absent ones are the normal case and stay quiet.
+            # come from cloud.py's probe taxonomy and check.py's
+            # exception wrapper, not free text); absent ones are the
+            # normal case and stay quiet.
             click.echo(f'  {name}: DISABLED: {reason}')
     if enabled:
         click.echo('Enabled infra: ' + ', '.join(enabled))

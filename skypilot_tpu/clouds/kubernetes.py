@@ -91,10 +91,16 @@ class Kubernetes(cloud.Cloud):
                  '--request-timeout=10s'],
                 capture_output=True, timeout=15, check=False)
         except (FileNotFoundError, subprocess.TimeoutExpired) as e:
-            return False, f'kubernetes: probe failed: {e}'
+            # Same taxonomy as _classify_probe_error: unreachable is
+            # INCONCLUSIVE, not a credential failure.
+            return True, f'kubernetes: probe inconclusive: {e}'
         if proc.returncode != 0:
-            return False, ('kubernetes: kubectl authentication '
-                           'rejected: '
-                           + proc.stderr.decode(errors="replace")
-                           .strip()[:200])
+            stderr = proc.stderr.decode(errors='replace').strip()
+            lowered = stderr.lower()
+            if ('unauthorized' in lowered or 'forbidden' in lowered
+                    or 'must be logged in' in lowered):
+                return False, ('kubernetes: kubectl authentication '
+                               f'rejected: {stderr[:200]}')
+            return True, ('kubernetes: probe inconclusive: '
+                          f'{stderr[:200]}')
         return True, None

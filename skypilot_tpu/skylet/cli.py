@@ -140,7 +140,7 @@ def main(argv: Optional[list] = None) -> int:
     p.set_defaults(fn=_cmd_tail)
 
     p = sub.add_parser('set-autostop')
-    p.add_argument('--idle-minutes', type=int, default=5)
+    p.add_argument('--idle-minutes', type=float, default=5)
     p.add_argument('--down', action='store_true')
     p.add_argument('--cancel', action='store_true')
     p.add_argument('--provider-name', default='local')

@@ -339,6 +339,15 @@ CONFIG_SCHEMA: Dict[str, Any] = {
             'additionalProperties': False,
             'properties': {'node_pools': {'type': 'object'}},
         },
+        'local': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                # Abandoned local clusters leak skylet daemons on the
+                # user's own machine; 0 disables the default reaper.
+                'default_autostop_minutes': {'type': 'number'},
+            },
+        },
         'jobs': _CONTROLLER_SECTION,
         'serve': _CONTROLLER_SECTION,
         'logs': {
