@@ -1,0 +1,55 @@
+"""Smoke-run environment: isolated HOME, local cloud enabled, a
+dedicated API server on a non-default port (a real user's server on
+46590 must never be touched), torn down with the session."""
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+
+@pytest.fixture(scope='session')
+def smoke_env(tmp_path_factory):
+    home = tmp_path_factory.mktemp('smoke-home')
+    state = home / '.skytpu'
+    state.mkdir()
+    # local always; gcp so the dry-run target has an enabled cloud.
+    (state / 'enabled_clouds.json').write_text(
+        json.dumps({'enabled': ['gcp', 'local']}))
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        port = s.getsockname()[1]
+    env = {**os.environ,
+           'HOME': str(home),
+           'SKYTPU_API_SERVER_URL': f'http://127.0.0.1:{port}',
+           'SKYTPU_SERVE_LOOP_INTERVAL': '0.5',
+           'JAX_PLATFORMS': 'cpu'}
+    server = subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.server.app',
+         '--port', str(port)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    deadline = time.time() + 30
+    import urllib.request
+    while time.time() < deadline:
+        try:
+            urllib.request.urlopen(
+                f'http://127.0.0.1:{port}/api/v1/health', timeout=2)
+            break
+        except OSError:
+            time.sleep(0.5)
+    else:
+        server.kill()
+        raise RuntimeError('smoke API server failed to start')
+    old = dict(os.environ)
+    os.environ.update(env)
+    yield env
+    os.environ.clear()
+    os.environ.update(old)
+    server.terminate()
+    try:
+        server.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        server.kill()

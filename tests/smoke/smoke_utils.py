@@ -1,0 +1,94 @@
+"""Declarative smoke harness: Test tuples driving the REAL tsky CLI.
+
+Reference analog: tests/smoke_tests/smoke_tests_utils.py:292 (the
+`Test(name, commands, teardown, timeout)` tuple) and :426
+(`run_one_test`: sequential shell commands, streamed log, teardown
+always runs). This is the third level of the test pyramid (SURVEY §4):
+unit tests fake the clouds, the local-cloud e2e runs real processes,
+and smoke tests drive the shipped CLI binary the way a user does —
+today against the local cloud and GCP dry-runs, and against real
+cloud projects the day credentials are pointed at them.
+
+Gating: smoke tests only run under `pytest -m smoke` (deselected by
+default); tests that would touch a REAL cloud additionally skip
+unless SKYTPU_SMOKE_REAL_GCP=1.
+"""
+import dataclasses
+import os
+import subprocess
+import sys
+import tempfile
+from typing import List, Optional
+
+TSKY = [sys.executable, '-m', 'skypilot_tpu.client.cli']
+
+
+@dataclasses.dataclass
+class Test:
+    __test__ = False  # a data tuple, not a pytest collectable
+    name: str
+    commands: List[str]
+    teardown: Optional[str] = None
+    timeout: int = 900
+
+    def echo(self, message: str) -> None:
+        print(f'[smoke:{self.name}] {message}', flush=True)
+
+
+def _run_shell(command: str, log, timeout: int) -> int:
+    """One command under bash with `tsky` aliased to this checkout's
+    CLI; output streams to the log file (tail it live while a smoke
+    run is in flight, exactly like the reference harness)."""
+    tsky = ' '.join(TSKY)
+    proc = subprocess.run(
+        ['bash', '-c', f'set -o pipefail; {command}'],
+        stdout=log, stderr=subprocess.STDOUT, timeout=timeout,
+        env={**os.environ, 'TSKY': tsky},
+        check=False)
+    return proc.returncode
+
+
+def run_one_test(test: Test) -> None:
+    """Reference smoke_tests_utils.py:426 — run commands in order,
+    fail fast on the first non-zero exit (with the log path in the
+    message), ALWAYS run teardown."""
+    log = tempfile.NamedTemporaryFile(
+        mode='w', prefix=f'skytpu-smoke-{test.name}-', suffix='.log',
+        delete=False)
+    test.echo(f'log: {log.name}')
+    failed_at: Optional[str] = None
+    try:
+        with log:
+            try:
+                for command in test.commands:
+                    test.echo(command)
+                    log.write(f'\n$ {command}\n')
+                    log.flush()
+                    rc = _run_shell(command, log, test.timeout)
+                    if rc != 0:
+                        failed_at = command
+                        break
+            except subprocess.TimeoutExpired:
+                # A hung command must still reach teardown — leaking
+                # a real cluster is worse than a late failure.
+                failed_at = f'{command} (timed out after ' \
+                            f'{test.timeout}s)'
+            if test.teardown:
+                test.echo(f'teardown: {test.teardown}')
+                log.write(f'\n$ [teardown] {test.teardown}\n')
+                log.flush()
+                try:
+                    _run_shell(test.teardown, log, test.timeout)
+                except subprocess.TimeoutExpired:
+                    test.echo('teardown timed out')
+    finally:
+        if failed_at is not None:
+            tail = ''
+            try:
+                with open(log.name, encoding='utf-8') as f:
+                    tail = ''.join(f.readlines()[-30:])
+            except OSError:
+                pass
+            raise AssertionError(
+                f'smoke test {test.name!r} failed at: {failed_at}\n'
+                f'log: {log.name}\n--- tail ---\n{tail}')

@@ -1,0 +1,116 @@
+"""Smoke suite: declarative Test tuples over the real tsky CLI.
+
+Run with:  python -m pytest -m smoke tests/smoke/ -q
+(deselected from the default run; SKYTPU_SMOKE_REAL_GCP=1 additionally
+enables tests that would touch a real GCP project.)
+
+Reference analog: tests/smoke_tests/ — same harness shape
+(smoke_utils.Test / run_one_test), so pointing a test at a real cloud
+is editing a tuple, not writing a framework.
+"""
+import os
+import tempfile
+
+import pytest
+
+from tests.smoke import smoke_utils
+from tests.smoke.smoke_utils import Test
+
+pytestmark = pytest.mark.smoke
+
+
+def test_local_cluster_lifecycle(smoke_env):
+    smoke_utils.run_one_test(Test(
+        'local-lifecycle',
+        [
+            '$TSKY launch -c smoke1 --infra local -- echo smoke-ran',
+            '$TSKY status | grep smoke1',
+            '$TSKY queue smoke1 | grep SUCCEEDED',
+            '$TSKY exec smoke1 -- echo exec-ran',
+            '$TSKY logs smoke1 1 --no-follow | grep smoke-ran',
+            '$TSKY autostop smoke1 -i 30 --down',
+            '$TSKY status | grep smoke1 | grep -i up',
+        ],
+        teardown='echo y | $TSKY down smoke1',
+        timeout=300,
+    ))
+
+
+def test_managed_job_lifecycle(smoke_env):
+    yaml = tempfile.NamedTemporaryFile(
+        mode='w', suffix='.yaml', delete=False)
+    yaml.write('name: smokejob\n'
+               'resources:\n  infra: local\n'
+               'run: echo managed-smoke-ran\n')
+    yaml.close()
+    smoke_utils.run_one_test(Test(
+        'managed-job',
+        [
+            f'$TSKY jobs launch {yaml.name} --name smokejob '
+            '--detach-run',
+            'for i in $(seq 1 60); do '
+            '  $TSKY jobs queue | grep smokejob | '
+            '    grep -q SUCCEEDED && break; sleep 2; done; '
+            '$TSKY jobs queue | grep smokejob | grep SUCCEEDED',
+        ],
+        teardown='$TSKY jobs cancel --all --yes || true',
+        timeout=300,
+    ))
+
+
+def test_serve_lifecycle(smoke_env):
+    yaml = tempfile.NamedTemporaryFile(
+        mode='w', suffix='.yaml', delete=False)
+    yaml.write('name: smokesvc\n'
+               'resources:\n  infra: local\n'
+               'service:\n'
+               '  readiness_probe:\n    path: /\n'
+               '    initial_delay_seconds: 60\n'
+               '  replica_port: 18732\n'
+               '  replicas: 1\n'
+               'run: cd /tmp && exec python3 -m http.server 18732\n')
+    yaml.close()
+    smoke_utils.run_one_test(Test(
+        'serve-lifecycle',
+        [
+            f'$TSKY serve up {yaml.name} -n smokesvc',
+            'for i in $(seq 1 90); do '
+            '  $TSKY serve status | grep smokesvc | '
+            '    grep -q READY && break; sleep 2; done; '
+            '$TSKY serve status | grep smokesvc | grep READY',
+        ],
+        teardown='echo y | $TSKY serve down smokesvc --purge',
+        timeout=600,
+    ))
+
+
+def test_gcp_dryrun_optimizes_without_credentials(smoke_env):
+    """The GCP target exercises catalog + optimizer through the real
+    CLI with --dryrun (no API calls, no credentials): the shape every
+    real-cloud smoke test will take."""
+    smoke_utils.run_one_test(Test(
+        'gcp-dryrun',
+        [
+            '$TSKY launch -c smokegcp --infra gcp --gpus tpu-v5e:8 '
+            '--dryrun -- echo never-runs',
+        ],
+        timeout=300,
+    ))
+
+
+@pytest.mark.skipif(
+    os.environ.get('SKYTPU_SMOKE_REAL_GCP') != '1',
+    reason='set SKYTPU_SMOKE_REAL_GCP=1 with real GCP credentials')
+def test_real_gcp_cluster_lifecycle(smoke_env):
+    """The day a real project is pointed at this suite, this runs a
+    full provision/teardown — until then it documents the shape."""
+    smoke_utils.run_one_test(Test(
+        'real-gcp',
+        [
+            '$TSKY launch -c smokegcp-real --infra gcp --cpus 2 '
+            '-- echo real-gcp-ran',
+            '$TSKY status | grep smokegcp-real | grep -i up',
+        ],
+        teardown='echo y | $TSKY down smokegcp-real',
+        timeout=1800,
+    ))
