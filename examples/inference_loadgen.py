@@ -55,13 +55,42 @@ def _pct(values, q):
     return values[min(len(values) - 1, int(q * len(values)))]
 
 
+async def _wait_ready(session, url: str, timeout: float) -> None:
+    """Block until /health says ok — the first compile of a big model
+    takes minutes, and crashing on the 503s it serves meanwhile would
+    make this tool useless for exactly the runs that matter."""
+    deadline = time.perf_counter() + timeout
+    while True:
+        try:
+            async with session.get(f'{url}/health') as resp:
+                doc = await resp.json()
+                if doc.get('status') == 'ok':
+                    return
+        except Exception:  # noqa: BLE001 — server may not be up yet
+            pass
+        if time.perf_counter() > deadline:
+            raise RuntimeError(
+                f'server at {url} not ready after {timeout:.0f}s')
+        await asyncio.sleep(2.0)
+
+
 async def run(url: str, concurrency: int, requests: int,
-              prompt_len: int, max_new_tokens: int):
+              prompt_len: int, max_new_tokens: int,
+              ready_timeout: float = 900.0):
     import aiohttp
     sem = asyncio.Semaphore(concurrency)
     results = []
 
-    async with aiohttp.ClientSession() as session:
+    # No total timeout: /health=ok only means params loaded — the
+    # first /generate pays the full jit compile (minutes on a big
+    # model) and must not be killed by aiohttp's default 300s cap.
+    timeout = aiohttp.ClientTimeout(total=None, sock_connect=30)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        await _wait_ready(session, url, ready_timeout)
+        # Untimed warmup: absorb the first-request compile so the
+        # measured window reports serving, not compilation.
+        await _one_request(session, url, prompt_len, max_new_tokens)
+
         async def bounded():
             async with sem:
                 results.append(await _one_request(
@@ -99,10 +128,14 @@ def main() -> None:
     parser.add_argument('--requests', type=int, default=32)
     parser.add_argument('--prompt-len', type=int, default=128)
     parser.add_argument('--max-new-tokens', type=int, default=64)
+    parser.add_argument('--ready-timeout', type=float, default=900.0,
+                        help='seconds to wait for /health=ok (first '
+                             'compile of a big model takes minutes)')
     args = parser.parse_args()
     report = asyncio.run(run(args.url.rstrip('/'), args.concurrency,
                              args.requests, args.prompt_len,
-                             args.max_new_tokens))
+                             args.max_new_tokens,
+                             ready_timeout=args.ready_timeout))
     print(json.dumps(report))
 
 
