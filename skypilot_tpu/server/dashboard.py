@@ -131,6 +131,7 @@ def _job_detail(job_id: str) -> Optional[Dict[str, Any]]:
         return None
     return {
         'kind': 'job', 'name': f'managed job {jid}',
+        'entity_id': jid,  # action payloads need the bare id
         'fields': {
             'name': rec['name'],
             'status': rec['status'].value,
@@ -354,12 +355,43 @@ function renderDetail(doc,tab){
   if(doc.shell){const p=document.createElement('p');
     const a=document.createElement('a');a.href=doc.shell;
     a.textContent='open shell';p.appendChild(a);m.appendChild(p)}
+  renderActions(m,doc,tab);
   if(doc.rows){const h2=document.createElement('h2');
     h2.textContent=doc.rows.title;m.appendChild(h2);
     if(doc.rows.items.length)
       m.appendChild(makeTable(doc.rows.columns,doc.rows.items,null));
     else{const d=document.createElement('div');d.className='empty';
       d.textContent='nothing here yet';m.appendChild(d)}}}
+// --- entity actions (async commands; RBAC enforced server-side) ----------
+const ACTIONS={
+  clusters:[['stop','stop',d=>({cluster_name:d.name})],
+            ['down','down',d=>({cluster_name:d.name})]],
+  jobs:[['cancel','jobs_cancel',d=>({job_ids:[d.entity_id]})]],
+  services:[['down','serve_down',d=>({service_name:d.name})]]};
+// Fired actions survive the 5s auto-refresh re-render: a destructive
+// button must not silently re-arm while its command is in flight.
+const firedActions=new Set();
+function renderActions(m,doc,tab){
+  const acts=ACTIONS[tab]||[];
+  if(!acts.length)return;
+  const p=document.createElement('p');
+  acts.forEach(([label,cmd,payload])=>{
+    const key=tab+'/'+doc.name+'/'+label;
+    const b=btn(label,async()=>{
+      if(!confirm(label+' '+doc.name+'?'))return;
+      b.disabled=true;firedActions.add(key);
+      try{
+        const body=await api('POST','/'+cmd,payload(doc));
+        b.textContent=label+': request '+
+          ((body&&body.request_id)||'sent');
+        setTimeout(refresh,1500);
+      }catch(e){
+        firedActions.delete(key);b.disabled=false;showErr(m,e)}});
+    if(firedActions.has(key)){
+      b.disabled=true;b.textContent=label+': requested'}
+    p.appendChild(b)});
+  m.appendChild(p)}
+
 // --- admin: workspaces + users (REST CRUD, admin-gated server-side) ------
 async function api(method,path,body){
   const r=await fetch('/api/v1'+path,{method,

@@ -294,6 +294,44 @@ class TestAdminSurfaces:
                      ).read().decode()
         assert '<script>evil()' not in login
 
+    def test_browser_action_buttons_wire_path(self, server,
+                                              monkeypatch,
+                                              enable_clouds):
+        """The detail-page action buttons POST commands with cookie
+        auth exactly like any API client: down a real local cluster
+        from 'the browser'."""
+        import time as time_lib
+
+        enable_clouds('local')
+        monkeypatch.setenv('SKYTPU_API_SERVER_URL', server.url)
+        import skypilot_tpu as sky
+        from skypilot_tpu import state
+        from skypilot_tpu import task as task_lib
+        sky.launch(task_lib.Task(run='true', name='s'),
+                   cluster_name='btnc')
+        _auth_on()
+        # The detail doc the page renders from:
+        doc = json.loads(_get(
+            server.url, '/dashboard/api/clusters/btnc',
+            cookie='skytpu_token=tok-admin').read())
+        assert doc['name'] == 'btnc'
+        # The 'down' button's POST:
+        req = urllib.request.Request(
+            f'{server.url}/api/v1/down',
+            data=json.dumps({'cluster_name': 'btnc'}).encode(),
+            headers={'Content-Type': 'application/json',
+                     'Cookie': 'skytpu_token=tok-admin'},
+            method='POST')
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            body = json.loads(resp.read())
+        assert body['request_id']
+        deadline = time_lib.time() + 60
+        while time_lib.time() < deadline:
+            if state.get_cluster_from_name('btnc') is None:
+                break
+            time_lib.sleep(0.5)
+        assert state.get_cluster_from_name('btnc') is None
+
     def test_browser_shell_end_to_end(self, server, monkeypatch,
                                       enable_clouds):
         """The terminal page's wire contract against a REAL local
