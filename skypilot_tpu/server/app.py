@@ -471,6 +471,30 @@ async def _handle_config_doc(request):
     return _json_response(dashboard.config_doc())
 
 
+async def _handle_config_save(request):
+    """Admin config editor save: schema-validate, then write the USER
+    config file atomically with 0600 (it carries tokens). The mtime
+    invalidation in config.py makes the edit live on the next
+    request. Redacted '*****' values are rejected — a save of the
+    redacted VIEW would destroy every secret in the file."""
+    from aiohttp import web
+
+    from skypilot_tpu.server import dashboard
+    _require_admin(request)
+    body = await _admin_body(request)
+    text = body.get('yaml')
+    if not isinstance(text, str):
+        raise web.HTTPBadRequest(text='need {"yaml": "..."}')
+    try:
+        dashboard.save_config(text,
+                              expected_etag=str(body.get('etag') or ''))
+    except dashboard.ConfigConflictError as e:
+        raise web.HTTPConflict(text=str(e))
+    except ValueError as e:
+        raise web.HTTPBadRequest(text=str(e))
+    return _json_response({'saved': True})
+
+
 async def _handle_health(request):
     return _json_response({
         'status': 'healthy',
@@ -546,6 +570,7 @@ def create_app():
     app.router.add_get('/dashboard/clusters/{name}/shell',
                        _handle_shell_page)
     app.router.add_get('/dashboard/api/config', _handle_config_doc)
+    app.router.add_post('/dashboard/api/config', _handle_config_save)
     app.router.add_get(f'{API_PREFIX}/requests', _handle_list_requests)
     app.router.add_get(f'{API_PREFIX}/requests/{{request_id}}',
                        _handle_get_request)

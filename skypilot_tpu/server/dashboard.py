@@ -276,6 +276,10 @@ button.mini:hover{background:#30363d}
     white-space:pre-wrap}
 pre.cfg{background:#161b22;border:1px solid #30363d;border-radius:6px;
     padding:12px;overflow:auto;font:12px/1.45 ui-monospace,monospace}
+textarea.cfg-edit{width:100%;min-height:220px;background:#0d1117;
+    color:#c9d1d9;border:1px solid #30363d;border-radius:6px;
+    padding:10px;font:12px/1.45 ui-monospace,monospace;
+    box-sizing:border-box}
 """
 
 _JS = """
@@ -492,10 +496,25 @@ async function renderConfig(){
     if(!r.ok){m.innerHTML='<div class="empty">admin only</div>';return}
     const doc=await r.json();
     m.appendChild(el('div',{class:'crumb'},
-      'effective server config (secrets redacted) -- edit '+
-      doc.path+' and it reloads on the next request'));
-    m.appendChild(el('pre',{class:'cfg'},doc.yaml))}
-  catch(e){showErr(m,e)}}
+      'effective config, all layers merged (secrets redacted)'));
+    m.appendChild(el('pre',{class:'cfg'},doc.yaml));
+    m.appendChild(el('h2',{},'edit '+doc.path));
+    const ta=el('textarea',{class:'cfg-edit',spellcheck:false});
+    ta.value=doc.raw;
+    m.appendChild(ta);
+    m.appendChild(el('div',{class:'adm-form'},
+      btn('save (validates first; edits are live)',async()=>{
+        try{
+          const resp=await fetch('/dashboard/api/config',{
+            method:'POST',
+            headers:{'Content-Type':'application/json'},
+            body:JSON.stringify({yaml:ta.value,etag:doc.etag})});
+          if(resp.status===401){location.href='/dashboard/login';
+            return}
+          if(!resp.ok)throw new Error(await resp.text());
+          renderConfig();
+        }catch(e){showErr(m,e)}})));
+  }catch(e){showErr(m,e)}}
 async function render(){
   const {tab,key}=route();
   document.querySelectorAll('nav button').forEach(b=>
@@ -884,14 +903,105 @@ def _redact(obj):
 
 
 def config_doc() -> Dict[str, Any]:
-    """Effective layered config with credentials redacted (the
-    reference dashboard's config page; ours is read-only — the file
-    stays the source of truth and reloads per request)."""
+    """The config page's document: the redacted EFFECTIVE (layered)
+    view, plus the raw USER config file for the editor — editing the
+    redacted view would clobber every secret on save, so the editor
+    round-trips the file itself (admin-gated; an admin can read that
+    file anyway)."""
     import yaml
 
     from skypilot_tpu import config as config_lib
+    path = os.path.expanduser(config_lib.USER_CONFIG_PATH)
+    try:
+        with open(path, 'r', encoding='utf-8') as f:
+            raw = f.read()
+    except OSError:
+        raw = ''
+    import hashlib
     return {
         'path': config_lib.USER_CONFIG_PATH,
         'yaml': yaml.safe_dump(_redact(config_lib.to_dict()),
                                default_flow_style=False) or '',
+        'raw': raw,
+        # Editor concurrency token: a save against a stale snapshot
+        # must 409, not silently revert another admin's change.
+        'etag': hashlib.sha256(raw.encode()).hexdigest()[:16],
     }
+
+
+class ConfigConflictError(ValueError):
+    """The on-disk config changed since the editor loaded it."""
+
+
+def _has_redacted_value(obj) -> bool:
+    """A '*****' VALUE in the parsed config is the redacted view
+    leaking into the editor (comments/banners with asterisks parse
+    away and are fine)."""
+    if isinstance(obj, dict):
+        return any(_has_redacted_value(v) for v in obj.values())
+    if isinstance(obj, list):
+        return any(_has_redacted_value(v) for v in obj)
+    return obj == '*****'
+
+
+def save_config(text: str, expected_etag: str = '') -> None:
+    """Validate + atomically write the USER config file (0600 from
+    creation: it carries tokens). Raises ValueError with every schema
+    violation listed — the editor shows them inline — and
+    ConfigConflictError when the file changed since `expected_etag`
+    was read (last-write-wins would silently revert another admin's
+    token revocation)."""
+    import hashlib
+    import tempfile
+
+    import yaml
+
+    from skypilot_tpu import config as config_lib
+    from skypilot_tpu import exceptions
+    from skypilot_tpu.utils import schemas
+    try:
+        data = yaml.safe_load(text)
+    except yaml.YAMLError as e:
+        raise ValueError(f'Not valid YAML: {e}')
+    if data is None:
+        data = {}
+    if not isinstance(data, dict):
+        raise ValueError('Config must be a YAML mapping.')
+    if _has_redacted_value(data):
+        raise ValueError(
+            "The config contains redacted '*****' values — saving "
+            'them would destroy the real secrets. Edit the raw file '
+            'content instead.')
+    try:
+        schemas.validate_config(data, path='(dashboard editor)')
+    except exceptions.ConfigError as e:
+        raise ValueError(str(e))
+    path = os.path.expanduser(config_lib.USER_CONFIG_PATH)
+    if expected_etag:
+        try:
+            with open(path, 'r', encoding='utf-8') as f:
+                current = f.read()
+        except OSError:
+            current = ''
+        if hashlib.sha256(
+                current.encode()).hexdigest()[:16] != expected_etag:
+            raise ConfigConflictError(
+                'The config file changed since this editor loaded it; '
+                'reload the page and re-apply your edit.')
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                               prefix='.config-edit-')
+    try:
+        os.fchmod(fd, 0o600)
+        with os.fdopen(fd, 'w', encoding='utf-8') as f:
+            f.write(text)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    # Close the same-size/same-mtime_ns stat window on coarse-
+    # timestamp filesystems: the save must be live NOW, in-process.
+    config_lib.reload()

@@ -219,6 +219,64 @@ class TestAdminSurfaces:
         assert '*****' in doc['yaml']
         assert 'auth: true' in doc['yaml']
 
+    def test_config_editor_saves_validates_and_goes_live(self, server):
+        """The admin config editor: schema-validated atomic save that
+        takes effect on the next request; redacted placeholders and
+        invalid YAML are rejected."""
+        _auth_on()
+
+        def _post(yaml_text, cookie='skytpu_token=tok-admin',
+                  etag=''):
+            req = urllib.request.Request(
+                f'{server.url}/dashboard/api/config',
+                data=json.dumps({'yaml': yaml_text,
+                                 'etag': etag}).encode(),
+                headers={'Content-Type': 'application/json',
+                         'Cookie': cookie},
+                method='POST')
+            return urllib.request.urlopen(req, timeout=10)
+
+        # The doc carries the raw file for the editor.
+        doc = json.loads(_get(
+            server.url, '/dashboard/api/config',
+            cookie='skytpu_token=tok-admin').read())
+        assert 'tok-admin' in doc['raw']       # raw file, unredacted
+        assert 'tok-admin' not in doc['yaml']  # view stays redacted
+
+        # Invalid schema: every violation listed, file untouched.
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post('api_server:\n  nonsense_key: 1\n')
+        assert err.value.code == 400
+        assert 'nonsense_key' in err.value.read().decode()
+        # Redacted placeholder VALUE: refused (would clobber secrets)
+        # — but asterisks in comments are fine.
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post('api_server:\n  token: "*****"\n')
+        assert err.value.code == 400
+        _post('# ***** banner *****\n' + doc['raw'], etag=doc['etag'])
+        doc = json.loads(_get(
+            server.url, '/dashboard/api/config',
+            cookie='skytpu_token=tok-admin').read())
+        assert doc['raw'].startswith('# ***** banner')
+        # A stale etag 409s instead of silently reverting another
+        # admin's save.
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(doc['raw'], etag='0' * 16)
+        assert err.value.code == 409
+        # Valid save: live on the next request (new token works,
+        # old one is gone).
+        _post(doc['raw'].replace('tok-admin', 'tok-next'))
+        assert json.loads(_get(
+            server.url, '/dashboard/api/config',
+            cookie='skytpu_token=tok-next').read())['raw']
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(server.url, '/dashboard/api/config',
+                 cookie='skytpu_token=tok-admin')
+        assert err.value.code == 401
+        # File perms stay tight (it carries tokens).
+        cfg_path = os.path.expanduser('~/.skytpu/config.yaml')
+        assert oct(os.stat(cfg_path).st_mode & 0o777) == '0o600'
+
     def test_shell_page_rbac(self, server):
         """The terminal page needs WRITE privilege (a shell is
         arbitrary execution) — viewers get 403, unknown clusters 404,
