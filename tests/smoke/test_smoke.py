@@ -84,6 +84,27 @@ def test_serve_lifecycle(smoke_env):
     ))
 
 
+def test_workspace_and_user_admin(smoke_env):
+    """The multi-tenancy surface through the real CLI (open local
+    mode: the default user is admin)."""
+    smoke_utils.run_one_test(Test(
+        'admin-crud',
+        [
+            '$TSKY workspace create smokews --allowed-clouds local '
+            '--description smoke',
+            '$TSKY workspace list | grep smokews | grep local',
+            '$TSKY user add smokeuser --role viewer | grep "shown once"',
+            '$TSKY user list | grep smokeuser | grep viewer',
+            '$TSKY user disable smokeuser',
+            '$TSKY user list | grep smokeuser | grep disabled',
+            '$TSKY user rm smokeuser -y',
+            '$TSKY workspace delete smokews -y',
+            '! $TSKY workspace list | grep smokews',
+        ],
+        timeout=300,
+    ))
+
+
 def test_gcp_dryrun_optimizes_without_credentials(smoke_env):
     """The GCP target exercises catalog + optimizer through the real
     CLI with --dryrun (no API calls, no credentials): the shape every
