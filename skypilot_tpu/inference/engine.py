@@ -52,13 +52,29 @@ class SamplingParams:
 
 def init_cache(config: llama.LlamaConfig, batch_size: int,
                max_seq_len: Optional[int] = None,
-               mesh: Optional[Any] = None) -> Cache:
+               mesh: Optional[Any] = None,
+               pad_to: int = 1) -> Cache:
     """Zeroed KV cache + per-slot lengths. With a mesh, KV heads shard
-    over the tensor axis — serving models whose weights+cache exceed
-    one chip (the v5e-8 Llama-3-8B target) is a sharded-decode
-    problem, not a bigger-chip problem."""
+    over the tensor axis AND the sequence dim shards over the context
+    axis — serving models whose weights+cache exceed one chip (the
+    v5e-8 Llama-3-8B target) is a sharded-decode problem, not a
+    bigger-chip problem, and a LONG-CONTEXT cache (1M tokens of KV
+    dwarfs the weights) is a sequence-sharding problem: each chip
+    stores S/context positions, GSPMD partitions the attention
+    reduction across the shards (distributed-softmax combine over
+    ICI), and decode stays token-for-token identical to one chip
+    (test_inference context-parallel equivalence)."""
     c = config
     s = max_seq_len or c.max_seq_len
+    # Round the padded length up so (a) chunked prefill's last chunk
+    # never runs past the cache (a clamped dynamic_update_slice would
+    # silently overwrite earlier positions) and (b) the sharded
+    # sequence dim divides the context axis evenly (a user's
+    # --max-seq-len must not crash on divisibility). Extra positions
+    # sit beyond every slot's `length` and are invisible to the mask.
+    ctx = int(mesh.shape.get('context', 1)) if mesh is not None else 1
+    multiple = math.lcm(max(1, pad_to), ctx)
+    s = -(-s // multiple) * multiple
     shape = (c.num_layers, batch_size, s, c.num_kv_heads, c.head_dim)
     cache = {
         'k': jnp.zeros(shape, c.dtype),
@@ -69,7 +85,7 @@ def init_cache(config: llama.LlamaConfig, batch_size: int,
     if mesh is not None:
         from skypilot_tpu.parallel import sharding as sharding_lib
         kv_sh = sharding_lib.named_sharding(
-            mesh, (None, None, None, 'kv_heads', None))
+            mesh, (None, None, 'seq', 'kv_heads', None))
         rep = sharding_lib.named_sharding(mesh, (None,))
         cache = {'k': jax.device_put(cache['k'], kv_sh),
                  'v': jax.device_put(cache['v'], kv_sh),
@@ -224,12 +240,12 @@ def _moe_layer_with_cache(x: jax.Array, layer_params: Params,
     return x + out, k_cache, v_cache
 
 
-def _moe_forward_with_cache(params: Params, tokens: jax.Array,
-                            cache: Cache, positions: jax.Array,
-                            write_at: jax.Array, new_lengths: jax.Array,
-                            config: Any) -> Tuple[jax.Array, Cache]:
-    """MoE variant of `_forward_with_cache` (plain norms, untied
-    lm_head, no windows/softcaps — models/moe.py `forward`)."""
+def _moe_hidden_with_cache(params: Params, tokens: jax.Array,
+                           cache: Cache, positions: jax.Array,
+                           write_at: jax.Array, new_lengths: jax.Array,
+                           config: Any) -> Tuple[jax.Array, Cache]:
+    """MoE variant of `_hidden_with_cache` (plain norms, no
+    windows/softcaps — models/moe.py `forward`)."""
     c = config
     x = params['embed'].astype(c.dtype)[tokens]
 
@@ -243,20 +259,21 @@ def _moe_forward_with_cache(params: Params, tokens: jax.Array,
     x, (new_k, new_v) = lax.scan(body, x, (params['layers'], cache['k'],
                                            cache['v']))
     x = llama._rms_norm(x, params['final_norm'], c.rms_norm_eps)
-    logits = jnp.einsum('bse,ev->bsv', x, params['lm_head'],
-                        preferred_element_type=jnp.float32)
-    return logits, {'k': new_k, 'v': new_v, 'length': new_lengths}
+    return x, {'k': new_k, 'v': new_v, 'length': new_lengths}
 
 
-def _forward_with_cache(params: Params, tokens: jax.Array,
-                        cache: Cache, positions: jax.Array,
-                        write_at: jax.Array, new_lengths: jax.Array,
-                        config: ModelConfig
-                        ) -> Tuple[jax.Array, Cache]:
-    """tokens [B,T] at `positions` → (logits [B,T,V], updated cache)."""
+def _hidden_with_cache(params: Params, tokens: jax.Array,
+                       cache: Cache, positions: jax.Array,
+                       write_at: jax.Array, new_lengths: jax.Array,
+                       config: ModelConfig
+                       ) -> Tuple[jax.Array, Cache]:
+    """tokens [B,T] at `positions` → (final-norm hidden states
+    [B,T,E], updated cache) — the transformer stack WITHOUT the
+    lm_head projection, so chunked prefill can project only the
+    tokens it actually samples from."""
     if isinstance(config, moe_lib.MoeConfig):
-        return _moe_forward_with_cache(params, tokens, cache, positions,
-                                       write_at, new_lengths, config)
+        return _moe_hidden_with_cache(params, tokens, cache, positions,
+                                      write_at, new_lengths, config)
     c = config
     x = params['embed'].astype(c.dtype)[tokens]
     if c.embed_scale:
@@ -290,46 +307,108 @@ def _forward_with_cache(params: Params, tokens: jax.Array,
                                       cache['v'], windows))
     x = llama._rms_norm(x, params['final_norm'], c.rms_norm_eps,
                         c.norm_plus_one)
+    return x, {'k': new_k, 'v': new_v, 'length': new_lengths}
+
+
+def _project_logits(x: jax.Array, params: Params,
+                    config: ModelConfig) -> jax.Array:
+    """Final-norm hidden states → logits (tied embeddings + softcap
+    knobs live here, shared by every caller)."""
+    c = config
+    if isinstance(c, moe_lib.MoeConfig):
+        return jnp.einsum('...e,ev->...v', x, params['lm_head'],
+                          preferred_element_type=jnp.float32)
     lm_head = (params['embed'].astype(c.dtype).T
                if c.tied_embeddings else params['lm_head'])
-    logits = jnp.einsum('bse,ev->bsv', x, lm_head,
+    logits = jnp.einsum('...e,ev->...v', x, lm_head,
                         preferred_element_type=jnp.float32)
     if c.final_logit_softcap is not None:
         cap = c.final_logit_softcap
         logits = cap * jnp.tanh(logits / cap)
-    return logits, {'k': new_k, 'v': new_v, 'length': new_lengths}
+    return logits
 
 
-@functools.partial(jax.jit, static_argnames=('config',))
+def _forward_with_cache(params: Params, tokens: jax.Array,
+                        cache: Cache, positions: jax.Array,
+                        write_at: jax.Array, new_lengths: jax.Array,
+                        config: ModelConfig
+                        ) -> Tuple[jax.Array, Cache]:
+    """tokens [B,T] at `positions` → (logits [B,T,V], updated cache)."""
+    x, new_cache = _hidden_with_cache(params, tokens, cache, positions,
+                                      write_at, new_lengths, config)
+    return _project_logits(x, params, config), new_cache
+
+
 def prefill(params: Params, tokens: jax.Array, prompt_lengths: jax.Array,
             cache: Cache, slot_ids: jax.Array,
             config: llama.LlamaConfig) -> Tuple[jax.Array, Cache]:
     """Process padded prompts [N,P] into cache slots `slot_ids` [N].
 
-    Returns last-token logits [N,V] (at each prompt's true last position)
-    and the updated cache. Right-padded prompts: positions beyond
-    prompt_lengths[i] are masked out of every slot's visible region
-    because length is set to the true prompt length.
-    """
-    n, p = tokens.shape
-    # Gather the target slots' caches, run, scatter back.
+    Returns last-token logits [N,V] (at each prompt's true last
+    position) and the updated cache. Right-padded prompts: positions
+    beyond prompt_lengths[i] are masked out of every slot's visible
+    region because length is set to the true prompt length. One-shot
+    prefill IS the single-chunk case of prefill_chunked — one code
+    path, one masking contract."""
+    return prefill_chunked(params, tokens, prompt_lengths, cache,
+                           slot_ids, config, chunk=tokens.shape[1])
+
+
+@functools.partial(jax.jit, static_argnames=('config', 'chunk'))
+def prefill_chunked(params: Params, tokens: jax.Array,
+                    prompt_lengths: jax.Array, cache: Cache,
+                    slot_ids: jax.Array, config: llama.LlamaConfig,
+                    chunk: int) -> Tuple[jax.Array, Cache]:
+    """Prefill [N, K*chunk] tokens as a lax.scan of `chunk`-wide
+    forward passes (K=1 is plain one-shot prefill). The dense
+    cached-attention scores are [.., T, S]: one-shot prefill at
+    T = S = 128k would build a terabyte-scale tensor, while chunking
+    bounds T at `chunk` so peak memory is S/T-fold smaller — the
+    difference between a long-context recipe that serves and one that
+    OOMs at the first real prompt. The scan carries only each slot's
+    last-token HIDDEN state [N,E]; the full-vocab lm_head projection
+    runs ONCE after the scan, not per chunk. Numerically identical to
+    one-shot prefill (equivalence-tested)."""
+    n, padded_len = tokens.shape
+    n_chunks = padded_len // chunk
     sub_cache = {
         'k': cache['k'][:, slot_ids],
         'v': cache['v'][:, slot_ids],
     }
-    positions = jnp.broadcast_to(jnp.arange(p)[None], (n, p))
-    write_at = jnp.zeros((n,), jnp.int32)
-    logits, new_sub = _forward_with_cache(
-        params, tokens, sub_cache, positions, write_at, prompt_lengths,
-        config)
+    embed_dim = params['embed'].shape[-1]
+
+    def body(carry, chunk_tokens):
+        kv, last_hidden, start = carry
+        positions = start + jnp.broadcast_to(jnp.arange(chunk)[None],
+                                             (n, chunk))
+        write_at = jnp.full((n,), start, jnp.int32)
+        visible = jnp.minimum(prompt_lengths, start + chunk)
+        x, out = _hidden_with_cache(
+            params, chunk_tokens, kv, positions, write_at, visible,
+            config)
+        kv = {'k': out['k'], 'v': out['v']}  # carry shape must match
+        # Keep each slot's TRUE last token's hidden state, whichever
+        # chunk it lands in.
+        last_idx = prompt_lengths - 1
+        in_chunk = (last_idx >= start) & (last_idx < start + chunk)
+        gathered = jnp.take_along_axis(
+            x, jnp.clip(last_idx - start, 0, chunk - 1)[:, None, None],
+            axis=1)[:, 0]
+        last_hidden = jnp.where(in_chunk[:, None], gathered,
+                                last_hidden)
+        return (kv, last_hidden, start + chunk), None
+
+    init_hidden = jnp.zeros((n, embed_dim), config.dtype)
+    chunks = jnp.moveaxis(
+        tokens.reshape(n, n_chunks, chunk), 1, 0)  # [K, N, chunk]
+    (kv, last_hidden, _), _ = lax.scan(
+        body, (sub_cache, init_hidden, jnp.int32(0)), chunks)
     new_cache = {
-        'k': cache['k'].at[:, slot_ids].set(new_sub['k']),
-        'v': cache['v'].at[:, slot_ids].set(new_sub['v']),
+        'k': cache['k'].at[:, slot_ids].set(kv['k']),
+        'v': cache['v'].at[:, slot_ids].set(kv['v']),
         'length': cache['length'].at[slot_ids].set(prompt_lengths),
     }
-    last = jnp.take_along_axis(
-        logits, (prompt_lengths - 1)[:, None, None], axis=1)[:, 0]
-    return last, new_cache
+    return _project_logits(last_hidden, params, config), new_cache
 
 
 def _sample(logits: jax.Array, temperature: jax.Array, top_k: jax.Array,
@@ -386,12 +465,15 @@ class DecodeState:
 
     def __init__(self, config: llama.LlamaConfig, batch_size: int,
                  max_seq_len: Optional[int] = None,
-                 mesh: Optional[Any] = None):
+                 mesh: Optional[Any] = None,
+                 prefill_chunk: int = 0):
         self.config = config
         self.batch_size = batch_size
         self.max_seq_len = max_seq_len or config.max_seq_len
+        pad_to = (prefill_chunk
+                  if 0 < prefill_chunk < self.max_seq_len else 1)
         self.cache = init_cache(config, batch_size, self.max_seq_len,
-                                mesh=mesh)
+                                mesh=mesh, pad_to=pad_to)
         self.last_tokens = jnp.zeros((batch_size,), jnp.int32)
         self.slots: List[Optional[_Slot]] = [None] * batch_size
 
@@ -408,7 +490,8 @@ class InferenceEngine:
                  batch_size: int = 8,
                  max_seq_len: Optional[int] = None,
                  seed: int = 0,
-                 mesh: Optional[Any] = None):
+                 mesh: Optional[Any] = None,
+                 prefill_chunk: int = 1024):
         # The cached decode path mirrors the llama-core transformer
         # (every family knob: window/GeGLU/post-norms/softcaps/tied
         # embeddings) and the MoE family (routed expert MLP).
@@ -443,8 +526,13 @@ class InferenceEngine:
                 params, sharding_lib.tree_shardings(mesh, logical))
         self.params = params
         self.config = config
+        # Prompts longer than this prefill as a scan of chunk-wide
+        # passes (prefill_chunked): bounds the [T,S] score tensor so
+        # 128k prompts fit HBM.
+        self.prefill_chunk = prefill_chunk
         self.state = DecodeState(config, batch_size, max_seq_len,
-                                 mesh=mesh)
+                                 mesh=mesh,
+                                 prefill_chunk=prefill_chunk)
         self._queue: List[Tuple[int, List[int], SamplingParams]] = []
         self._finished: Dict[int, List[int]] = {}
         self._next_id = 0
@@ -531,15 +619,20 @@ class InferenceEngine:
         while bucket < max_len:
             bucket *= 2
         bucket = min(bucket, self.state.max_seq_len - 1)
+        # Long prompts scan chunk-wide passes; short ones are the
+        # single-chunk case of the same path.
+        chunk = (self.prefill_chunk
+                 if 0 < self.prefill_chunk < bucket else bucket)
+        bucket = -(-bucket // chunk) * chunk
         padded = jnp.array(
             [t + [0] * (bucket - len(t)) for _, t, _ in inserts],
             jnp.int32)
         lengths = jnp.array([len(t) for _, t, _ in inserts], jnp.int32)
         slot_arr = jnp.array(slot_ids, jnp.int32)
         with self._mesh_ctx():
-            logits, self.state.cache = prefill(
+            logits, self.state.cache = prefill_chunked(
                 self.params, padded, lengths, self.state.cache,
-                slot_arr, self.config)
+                slot_arr, self.config, chunk)
         # First generated token comes straight from prefill logits.
         self._key, sub = jax.random.split(self._key)
         temps = jnp.array([s.temperature for _, _, s in inserts],

@@ -239,6 +239,10 @@ def main() -> None:
                         help='Shard serving over a device mesh, e.g. '
                              'tensor=8 on a v5e-8 (models whose '
                              'weights+cache exceed one chip).')
+    parser.add_argument('--prefill-chunk', type=int, default=1024,
+                        help='Prompts longer than this prefill as a '
+                             'scan of chunk-wide passes (bounds HBM '
+                             'for long-context prompts); 0 disables.')
     parser.add_argument('--no-exit-with-parent', action='store_true',
                         help='Keep serving after the launcher exits '
                              '(deliberate daemonization only)')
@@ -266,7 +270,8 @@ def main() -> None:
             params = family.init_params(config, jax.random.key(0))
         engine = inf.InferenceEngine(
             params, config, batch_size=args.batch_size,
-            max_seq_len=args.max_seq_len, mesh=mesh)
+            max_seq_len=args.max_seq_len, mesh=mesh,
+            prefill_chunk=args.prefill_chunk)
         holder['loop'] = EngineLoop(engine)
 
     threading.Thread(target=_load, daemon=True).start()
