@@ -23,6 +23,7 @@ import csv
 import importlib
 import os
 import re
+import urllib.parse
 from typing import Any, Callable, Dict, List, Optional
 
 FIELDS = ['instance_type', 'accelerator_name', 'accelerator_count',
@@ -50,7 +51,10 @@ def _client(adaptor_name: str):
 
 
 _INTERFACE_TOKENS = frozenset(
-    {'SXM', 'SXM2', 'SXM3', 'SXM4', 'SXM5', 'PCIE', 'NVL', 'NVLINK'})
+    {'SXM', 'SXM2', 'SXM3', 'SXM4', 'SXM5', 'PCIE', 'NVL', 'NVLINK',
+     # Vendor/marketing noise: 'NVIDIA A10 GPU' must land on the same
+     # canonical 'A10' the checked-in CSVs use, not a third spelling.
+     'NVIDIA', 'AMD', 'INTEL', 'GPU', 'GPUS', 'TENSOR', 'CORE'})
 
 
 def _norm_gpu(name: str) -> str:
@@ -58,8 +62,9 @@ def _norm_gpu(name: str) -> str:
     ('RTX4090', 'A100-80GB', 'H100', 'RTXA6000'). The optimizer
     matches accelerator names by EXACT string (catalog/common.py) and
     provisioners map them back to cloud vocabulary, so a refresh must
-    not invent a third spelling: interface tokens drop, memory-size
-    tokens keep a '-' separator, everything else concatenates."""
+    not invent a third spelling: interface and vendor tokens drop,
+    memory-size tokens keep a '-' separator, everything else
+    concatenates."""
     tokens = [t for t in re.split(r'[\s_-]+', name.upper())
               if t and t not in _INTERFACE_TOKENS]
     out = ''
@@ -202,7 +207,7 @@ def fetch_do() -> List[Dict[str, Any]]:
             if not size.get('available', True):
                 continue
             gpu_info = size.get('gpu_info') or {}
-            gpu = str(gpu_info.get('model', '') or '').upper()
+            gpu = _norm_gpu(str(gpu_info.get('model', '') or ''))
             for region in size.get('regions', []):
                 rows.append(_row(
                     size.get('slug', ''),
@@ -216,8 +221,12 @@ def fetch_do() -> List[Dict[str, Any]]:
         nxt = (resp.get('links') or {}).get('pages', {}).get('next')
         page = None
         if nxt:
-            # The API hands back a absolute next URL; keep the path+q.
-            page = nxt.split('digitalocean.com')[-1]
+            # The API hands back the next URL (absolute or relative);
+            # parse properly — a hostname change or relative link must
+            # not leak a full URL into the request path.
+            parts = urllib.parse.urlsplit(nxt)
+            page = parts.path + (f'?{parts.query}' if parts.query
+                                 else '')
             params = None
     return [r for r in rows if r['instance_type']]
 
@@ -251,7 +260,7 @@ def fetch_ibm() -> List[Dict[str, Any]]:
                 continue
             rows.append(_row(
                 name, price, region,
-                accelerator_name=str(gpu_model).replace(' ', '-'),
+                accelerator_name=_norm_gpu(str(gpu_model)),
                 accelerator_count=int(gpu_count or 0),
                 cpus=(prof.get('vcpu_count') or {}).get('value', ''),
                 memory_gb=(prof.get('memory') or {}).get('value', ''),
@@ -275,6 +284,7 @@ def fetch_oci() -> List[Dict[str, Any]]:
         params={'compartmentId': config.get('tenancy', '')})
     shapes = resp if isinstance(resp, list) else resp.get('items', [])
     old_prices = _existing_prices('oci')
+    old_zones = _existing_zones('oci')
     region = config.get('region', '')
     rows = []
     skipped = 0
@@ -288,11 +298,15 @@ def fetch_oci() -> List[Dict[str, Any]]:
             continue
         rows.append(_row(
             name, price, region,
-            accelerator_name=(shape.get('gpuDescription') or ''
-                              ).replace(' ', '-'),
+            # 'NVIDIA A10 GPU' -> 'A10': must match the canonical names
+            # already in data/oci/vms.csv, and AD zones merge from the
+            # CSV the same way prices do (the shapes API has neither).
+            accelerator_name=_norm_gpu(shape.get('gpuDescription')
+                                       or ''),
             accelerator_count=gpus,
             cpus=shape.get('ocpus', '') or shape.get('vcpus', ''),
-            memory_gb=shape.get('memoryInGBs', '')))
+            memory_gb=shape.get('memoryInGBs', ''),
+            zone=old_zones.get((name, region), '')))
     if skipped:
         print(f'oci: skipped {skipped} shapes with no known price '
               '(add them to data/oci/vms.csv by hand to include them)')
@@ -349,22 +363,40 @@ def fetch_vsphere() -> List[Dict[str, Any]]:
     return rows
 
 
+def _existing_csv_rows(cloud: str) -> List[Dict[str, str]]:
+    """Rows of the checked-in data/<cloud>/vms.csv ([] if absent)."""
+    path = os.path.join(os.path.dirname(__file__), '..', 'data', cloud,
+                        'vms.csv')
+    try:
+        with open(path, newline='', encoding='utf-8') as f:
+            return list(csv.DictReader(f))
+    except OSError:
+        return []
+
+
 def _existing_prices(cloud: str) -> Dict[tuple, float]:
     """(instance_type, region) -> price from the checked-in CSV, for
     clouds whose API has shapes but not prices."""
-    path = os.path.join(os.path.dirname(__file__), '..', 'data', cloud,
-                        'vms.csv')
     out: Dict[tuple, float] = {}
-    try:
-        with open(path, newline='', encoding='utf-8') as f:
-            for row in csv.DictReader(f):
-                try:
-                    out[(row['instance_type'], row['region'])] = \
-                        float(row['price'])
-                except (KeyError, ValueError):
-                    continue
-    except OSError:
-        pass
+    for row in _existing_csv_rows(cloud):
+        try:
+            out[(row['instance_type'], row['region'])] = \
+                float(row['price'])
+        except (KeyError, ValueError):
+            continue
+    return out
+
+
+def _existing_zones(cloud: str) -> Dict[tuple, str]:
+    """(instance_type, region) -> zone from the checked-in CSV, for
+    clouds whose shapes API carries no zone (e.g. OCI availability
+    domains like 'kWVD:US-ASHBURN-AD-1')."""
+    out: Dict[tuple, str] = {}
+    for row in _existing_csv_rows(cloud):
+        zone = (row.get('zone') or '').strip()
+        if zone:
+            out.setdefault((row.get('instance_type', ''),
+                            row.get('region', '')), zone)
     return out
 
 

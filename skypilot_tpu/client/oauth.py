@@ -52,7 +52,7 @@ class _Callback(http.server.BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
-    def _accept(self, params) -> bool:
+    def _accept(self, params, via_redirect: bool = False) -> bool:
         """Shared delivery rule for both verbs: a token field must be
         present (a field-less probe from a port scanner must not
         complete the flow — an empty result means 'open local mode'
@@ -65,16 +65,32 @@ class _Callback(http.server.BaseHTTPRequestHandler):
             self._deny(400, 'missing token field')
             return False
         if 'state' not in params:
-            # A token WITHOUT a state is an old server's redirect
-            # delivery — fail fast IN THE TERMINAL (set error + wake
-            # browser_login) instead of 403-looping a message into a
-            # browser tab until the CLI's 180s timeout.
-            type(self).error = (
-                'This API server is too old for --browser login '
-                '(it delivered a token without the state nonce); '
-                'use `tsky api login --token ...` instead.')
-            self._deny(403, 'no state (old server)')
-            type(self).event.set()
+            if via_redirect:
+                # A token WITHOUT a state on the GET path is an old
+                # server's redirect delivery — fail fast IN THE
+                # TERMINAL (set error + wake browser_login) instead of
+                # 403-looping a message into a browser tab until the
+                # CLI's 180s timeout. Deliberate trade-off: a drive-by
+                # page CAN fire this GET and abort the flow (it cannot
+                # steal anything, only deny) — the message below names
+                # both causes so interference isn't misdiagnosed as
+                # version skew.
+                type(self).error = (
+                    'Received a token without the state nonce. Either '
+                    'this API server is too old for --browser login, '
+                    'or a local web page interfered with the flow; '
+                    'retry, or use `tsky api login --token ...`.')
+                self._deny(403, 'no state (old server)')
+                type(self).event.set()
+                return False
+            # A state-less POST is never an old server (old servers
+            # redirect; they don't POST) — it's a drive-by cross-origin
+            # POST from some web page (the request executes even though
+            # the response is CORS-opaque). Refuse WITHOUT waking the
+            # login flow: aborting here would let any page kill an
+            # in-flight `tsky api login --browser` and misdiagnose it
+            # as version skew.
+            self._deny(403, 'missing state')
             return False
         got = params['state'][0]
         # bytes comparison: compare_digest raises on non-ASCII str.
@@ -127,7 +143,7 @@ class _Callback(http.server.BaseHTTPRequestHandler):
             return
         params = urllib.parse.parse_qs(parsed.query,
                                        keep_blank_values=True)
-        if not self._accept(params):
+        if not self._accept(params, via_redirect=True):
             return
         self.send_response(200)
         self.send_header('Content-Type', 'text/html')

@@ -97,7 +97,11 @@ class Kubernetes(cloud.Cloud):
         if proc.returncode != 0:
             stderr = proc.stderr.decode(errors='replace').strip()
             lowered = stderr.lower()
-            if ('unauthorized' in lowered or 'forbidden' in lowered
+            # 'forbidden' means AUTHENTICATED but not authorized for
+            # this verb — a namespace-scoped kubeconfig commonly lacks
+            # cluster-wide `get nodes`. Only definitive auth rejections
+            # disable the cloud; RBAC scoping is inconclusive.
+            if ('unauthorized' in lowered
                     or 'must be logged in' in lowered):
                 return False, ('kubernetes: kubectl authentication '
                                f'rejected: {stderr[:200]}')

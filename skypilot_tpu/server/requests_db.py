@@ -58,31 +58,37 @@ def request_log_path(request_id: str) -> str:
     return os.path.join(d, f'{request_id}.log')
 
 
-def _get_conn() -> sqlite3.Connection:
+def _get_conn_locked() -> sqlite3.Connection:
+    """Return the shared connection; caller must hold `_lock`.
+
+    Creating/validating the connection under the same lock hold as the
+    write that uses it is what makes `reset_for_tests` (which closes the
+    connection under `_lock`) race-free: a close can never interleave
+    between "fetch conn" and "execute".
+    """
     global _conn, _conn_path
     path = requests_db_path()
-    with _lock:
-        if _conn is None or _conn_path != path:
-            _conn = sqlite3.connect(path, check_same_thread=False,
-                                    timeout=30.0)
-            _conn.execute('PRAGMA journal_mode=WAL')
-            _conn.execute("""
-                CREATE TABLE IF NOT EXISTS requests (
-                    request_id TEXT PRIMARY KEY,
-                    name TEXT,
-                    payload TEXT,
-                    status TEXT,
-                    schedule TEXT,
-                    created_at REAL,
-                    started_at REAL,
-                    finished_at REAL,
-                    result TEXT,
-                    error TEXT,
-                    pid INTEGER
-                )""")
-            _conn.commit()
-            _conn_path = path
-        return _conn
+    if _conn is None or _conn_path != path:
+        _conn = sqlite3.connect(path, check_same_thread=False,
+                                timeout=30.0)
+        _conn.execute('PRAGMA journal_mode=WAL')
+        _conn.execute("""
+            CREATE TABLE IF NOT EXISTS requests (
+                request_id TEXT PRIMARY KEY,
+                name TEXT,
+                payload TEXT,
+                status TEXT,
+                schedule TEXT,
+                created_at REAL,
+                started_at REAL,
+                finished_at REAL,
+                result TEXT,
+                error TEXT,
+                pid INTEGER
+            )""")
+        _conn.commit()
+        _conn_path = path
+    return _conn
 
 
 def reset_for_tests() -> None:
@@ -94,14 +100,18 @@ def reset_for_tests() -> None:
         _conn_path = None
 
 
-def _locked_write(conn: sqlite3.Connection, sql: str,
-                  params: tuple) -> None:
-    """Execute+commit under the module lock. On a BUSY commit the
-    half-done statement is rolled back INSIDE the same lock hold —
-    releasing the lock first would let another writer on the shared
-    connection commit our partial write, turning the retry into a
-    UNIQUE-constraint error."""
+def _locked_write(sql: str, params: tuple) -> None:
+    """Execute+commit under the module lock. The connection is resolved
+    INSIDE the lock hold (see `_get_conn_locked`) so a concurrent
+    `reset_for_tests` close cannot leave us a dead handle — the round-4
+    shutdown race was a writer thread using a connection closed between
+    fetch and execute, surfacing as an uncatchable ProgrammingError in a
+    daemon thread. On a BUSY commit the half-done statement is rolled
+    back INSIDE the same lock hold — releasing the lock first would let
+    another writer on the shared connection commit our partial write,
+    turning the retry into a UNIQUE-constraint error."""
     with _lock:
+        conn = _get_conn_locked()
         try:
             conn.execute(sql, params)
             conn.commit()
@@ -140,10 +150,8 @@ def _write_with_retry(op: Callable[[], None], what: str,
 def create_request(name: str, payload: Dict[str, Any],
                    schedule: str = 'long') -> str:
     request_id = uuid.uuid4().hex[:16]
-    conn = _get_conn()
     _write_with_retry(
         lambda: _locked_write(
-            conn,
             'INSERT INTO requests (request_id, name, payload, '
             'status, schedule, created_at) VALUES (?,?,?,?,?,?)',
             (request_id, name, json.dumps(payload),
@@ -155,10 +163,8 @@ def create_request(name: str, payload: Dict[str, Any],
 
 
 def set_running(request_id: str, pid: int) -> None:
-    conn = _get_conn()
     _write_with_retry(
         lambda: _locked_write(
-            conn,
             'UPDATE requests SET status=?, started_at=?, pid=? '
             'WHERE request_id=? AND status=?',
             (RequestStatus.RUNNING.value, time.time(), pid,
@@ -169,10 +175,8 @@ def set_running(request_id: str, pid: int) -> None:
 def set_result(request_id: str, result: Any) -> None:
     # Status guard mirrors set_error: a request cancelled while the
     # forked worker was finishing must stay CANCELLED.
-    conn = _get_conn()
     _write_with_retry(
         lambda: _locked_write(
-            conn,
             'UPDATE requests SET status=?, finished_at=?, result=? '
             'WHERE request_id=? AND status IN (?,?)',
             (RequestStatus.SUCCEEDED.value, time.time(),
@@ -185,10 +189,8 @@ def set_error(request_id: str, error: str,
               cancelled: bool = False) -> None:
     status = (RequestStatus.CANCELLED if cancelled else
               RequestStatus.FAILED)
-    conn = _get_conn()
     _write_with_retry(
         lambda: _locked_write(
-            conn,
             'UPDATE requests SET status=?, finished_at=?, error=? '
             'WHERE request_id=? AND status IN (?,?)',
             (status.value, time.time(), error, request_id,
@@ -219,16 +221,19 @@ def _row_to_record(row) -> Dict[str, Any]:
 
 
 def get_request(request_id: str) -> Optional[Dict[str, Any]]:
-    conn = _get_conn()
-    row = conn.execute(
-        f'SELECT {_COLS} FROM requests WHERE request_id=?',
-        (request_id,)).fetchone()
+    # Reads resolve the connection inside the lock hold too — the same
+    # fetch/close race closed for writers applies to a poller thread
+    # racing reset_for_tests.
+    with _lock:
+        row = _get_conn_locked().execute(
+            f'SELECT {_COLS} FROM requests WHERE request_id=?',
+            (request_id,)).fetchone()
     return _row_to_record(row) if row else None
 
 
 def list_requests(limit: int = 100) -> List[Dict[str, Any]]:
-    conn = _get_conn()
-    rows = conn.execute(
-        f'SELECT {_COLS} FROM requests ORDER BY created_at DESC LIMIT ?',
-        (limit,)).fetchall()
+    with _lock:
+        rows = _get_conn_locked().execute(
+            f'SELECT {_COLS} FROM requests ORDER BY created_at DESC '
+            'LIMIT ?', (limit,)).fetchall()
     return [_row_to_record(r) for r in rows]

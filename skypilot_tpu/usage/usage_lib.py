@@ -39,6 +39,26 @@ def spool_path() -> str:
     return os.path.join(paths.state_dir(), 'usage_events.jsonl')
 
 
+# The spool doubles as an audit log but must not grow unboundedly on a
+# long-lived API server: at the cap it rotates to ONE .1 generation
+# (append-heavy workloads lose at most the oldest half of history).
+_MAX_SPOOL_BYTES = int(os.environ.get('SKYTPU_USAGE_SPOOL_MAX_BYTES',
+                                      str(8 * 1024 * 1024)))
+
+
+def _rotate_locked(path: str) -> None:
+    """Caller holds `_lock`. Rotate spool -> spool.1 when over cap."""
+    try:
+        if os.path.getsize(path) < _MAX_SPOOL_BYTES:
+            return
+    except OSError:
+        return
+    try:
+        os.replace(path, path + '.1')
+    except OSError:
+        pass
+
+
 def record_event(event_name: str, **fields: Any
                  ) -> Optional[Dict[str, Any]]:
     """Append one event; ship best-effort if an endpoint is set."""
@@ -51,8 +71,10 @@ def record_event(event_name: str, **fields: Any
         'run_id': common_utils.get_usage_run_id(),
         **fields,
     }
-    with _lock, open(spool_path(), 'a', encoding='utf-8') as f:
-        f.write(json.dumps(event) + '\n')
+    with _lock:
+        _rotate_locked(spool_path())
+        with open(spool_path(), 'a', encoding='utf-8') as f:
+            f.write(json.dumps(event) + '\n')
     endpoint = os.environ.get(_ENDPOINT_ENV)
     if endpoint:
         # Ship from a daemon thread: callers may be on the API server's

@@ -19,6 +19,7 @@ same way the config stores them — the DB file lives under the
 server's state dir with user-only permissions.
 """
 import secrets
+import sqlite3
 import time
 from typing import Any, Dict, List, Optional
 
@@ -121,11 +122,18 @@ def create_user(name: str, role: str = users_lib.ROLE_USER,
         raise ValueError(f'User {name!r} already exists.')
     conn = state.connection()
     token = _new_token()
-    conn.execute(
-        'INSERT INTO users (name, token, role, workspace, disabled, '
-        'created_at) VALUES (?, ?, ?, ?, 0, ?)',
-        (name, token, role, workspace, int(time.time())))
-    conn.commit()
+    try:
+        conn.execute(
+            'INSERT INTO users (name, token, role, workspace, disabled, '
+            'created_at) VALUES (?, ?, ?, ?, 0, ?)',
+            (name, token, role, workspace, int(time.time())))
+        conn.commit()
+    except sqlite3.IntegrityError as e:
+        # Concurrent create raced the pre-check; same error as the
+        # pre-check, not a raw 500. Rollback releases the implicit
+        # write transaction on the shared connection.
+        conn.rollback()
+        raise ValueError(f'User {name!r} already exists.') from e
     doc = get_user(name)
     doc['token'] = token
     return doc

@@ -20,6 +20,7 @@ Spec fields (all optional):
     allowed_users:  list of user names (with private: true)
 """
 import json
+import sqlite3
 import time
 from typing import Any, Dict, List, Optional
 
@@ -133,10 +134,19 @@ def create(name: str, spec: Optional[Dict[str, Any]] = None
     conn = state.connection()
     if get(name) is not None:
         raise ValueError(f'Workspace {name!r} already exists.')
-    conn.execute(
-        'INSERT INTO workspaces (name, spec_json, created_at) '
-        'VALUES (?, ?, ?)', (name, json.dumps(spec), int(time.time())))
-    conn.commit()
+    try:
+        conn.execute(
+            'INSERT INTO workspaces (name, spec_json, created_at) '
+            'VALUES (?, ?, ?)',
+            (name, json.dumps(spec), int(time.time())))
+        conn.commit()
+    except sqlite3.IntegrityError as e:
+        # Two concurrent creates raced the pre-check; surface the same
+        # 400-mapped error the pre-check produces, not a raw 500. The
+        # rollback releases the implicit write transaction — leaving it
+        # open would hold the WAL lock on the shared connection.
+        conn.rollback()
+        raise ValueError(f'Workspace {name!r} already exists.') from e
     return get(name)
 
 

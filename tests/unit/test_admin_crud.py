@@ -62,6 +62,25 @@ class TestWorkspaceCrud:
         sdk.workspace_delete('team-x')
         assert [w['name'] for w in sdk.workspaces_list()] == ['default']
 
+    def test_concurrent_create_race_is_400_not_500(self, server,
+                                                   monkeypatch):
+        """Two concurrent creates of the same name: the loser's INSERT
+        hits the UNIQUE constraint after the pre-check passed. It must
+        surface as the same 'already exists' ValueError (HTTP 400),
+        not an unhandled sqlite3.IntegrityError (500). Simulated by
+        blinding the pre-check."""
+        workspaces.create('race-ws')
+        monkeypatch.setattr(workspaces.core, 'get', lambda name: None)
+        with pytest.raises(ValueError, match='already exists'):
+            workspaces.create('race-ws')
+        from skypilot_tpu.users import store as users_store
+        users_store.create_user('race-u')
+        monkeypatch.setattr(users_store, 'get_user', lambda name: None)
+        monkeypatch.setattr(users_store, '_check_name_free',
+                            lambda name: None)
+        with pytest.raises(ValueError, match='already exists'):
+            users_store.create_user('race-u')
+
     def test_update_merges_not_replaces(self, server):
         """A description edit must not silently strip policy; None
         explicitly clears a field."""

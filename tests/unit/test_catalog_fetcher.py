@@ -524,5 +524,12 @@ def test_fetch_cudo_and_oci(market, monkeypatch):
     rows = fetch_market.fetch_oci()
     by_name = {r['instance_type']: r for r in rows}
     assert by_name['BM.GPU.A100-v2.8']['accelerator_count'] == 8
+    # Vendor prefix drops: a refresh must land on the SAME canonical
+    # name the checked-in CSV uses, or the optimizer (exact-string
+    # matching) would lose every OCI GPU shape.
     assert by_name['BM.GPU.A100-v2.8']['accelerator_name'] == \
-        'NVIDIA-A100-80GB'
+        'A100-80GB'
+    # Zone (availability domain) merges from the existing CSV — the
+    # shapes API has no zone field.
+    assert by_name['BM.GPU.A100-v2.8']['zone'] == \
+        'kWVD:US-ASHBURN-AD-1'

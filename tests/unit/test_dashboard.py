@@ -556,6 +556,44 @@ class TestCliBrowserLogin:
                                     open_browser=fake_browser)
         assert token == 'fb'
 
+    def test_stateless_post_does_not_abort_login(self, server):
+        """A state-less POST is a drive-by (any web page can fire a
+        cross-origin POST at the loopback listener — the request
+        executes even though the response is CORS-opaque). It must
+        403 WITHOUT waking/aborting the flow; only the GET fallback
+        treats state-lessness as an old-server signal. The real
+        delivery afterwards must still succeed."""
+        del server
+        from skypilot_tpu.client import oauth
+
+        def fake_browser(url):
+            import threading
+            port = url.rsplit('port=', 1)[1].split('&')[0]
+            state = url.rsplit('state=', 1)[1].split('&')[0]
+
+            def _go():
+                base = f'http://127.0.0.1:{port}/callback'
+                # Drive-by: token but no state, via POST.
+                try:
+                    urllib.request.urlopen(urllib.request.Request(
+                        base, data=b'token=evil', method='POST'),
+                        timeout=10).read()
+                    raise AssertionError('state-less POST accepted')
+                except urllib.error.HTTPError as e:
+                    assert e.code == 403
+                # Flow must still be alive: real delivery completes.
+                urllib.request.urlopen(urllib.request.Request(
+                    base,
+                    data=urllib.parse.urlencode(
+                        {'token': 'real', 'state': state}).encode(),
+                    method='POST'), timeout=10).read()
+            threading.Thread(target=_go, daemon=True).start()
+            return True
+
+        token = oauth.browser_login('http://127.0.0.1:1', timeout=20,
+                                    open_browser=fake_browser)
+        assert token == 'real'
+
     def test_old_server_fails_fast_with_actionable_error(self, server):
         """A token delivery WITHOUT a state nonce is an old server's
         redirect: the CLI must fail immediately with a version-skew

@@ -244,3 +244,42 @@ def test_ha_controller_resources_carry_overrides(monkeypatch, tmp_path):
         launchable, 'hac', 'default', None)
     assert variables['ha'] is True
     assert 'skylet' in variables['recovery_command']
+
+
+def test_probe_forbidden_is_inconclusive_not_rejected(monkeypatch):
+    """A namespace-scoped kubeconfig commonly lacks cluster-wide
+    `get nodes` — a 403 Forbidden means AUTHENTICATED but not
+    authorized for that verb. Only definitive auth rejections
+    (unauthorized / must be logged in) disable the cloud."""
+    from skypilot_tpu.clouds import kubernetes as k8s_cloud
+
+    class FakeProc:
+        def __init__(self, rc, stdout=b'', stderr=b''):
+            self.returncode = rc
+            self.stdout = stdout
+            self.stderr = stderr
+
+    responses = {}
+
+    def fake_run(cmd, **kwargs):
+        del kwargs
+        if cmd[:2] == ['kubectl', 'config']:
+            return FakeProc(0, stdout=b'ctx')
+        return responses['nodes']
+
+    monkeypatch.setattr(subprocess, 'run', fake_run)
+    cloud_obj = k8s_cloud.Kubernetes()
+
+    responses['nodes'] = FakeProc(
+        1, stderr=b'Error from server (Forbidden): nodes is forbidden: '
+                  b'User "dev" cannot list resource "nodes"')
+    ok, reason = cloud_obj.probe_credentials()
+    assert ok, reason
+    assert 'inconclusive' in (reason or '')
+
+    responses['nodes'] = FakeProc(
+        1, stderr=b'error: You must be logged in to the server '
+                  b'(Unauthorized)')
+    ok, reason = cloud_obj.probe_credentials()
+    assert not ok
+    assert 'rejected' in reason

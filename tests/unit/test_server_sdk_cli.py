@@ -122,3 +122,19 @@ def test_usage_events_recorded(server):
               open(usage_lib.spool_path())]
     assert any(e['event'] == 'api.request' and e['name'] == 'status'
                for e in events)
+
+
+def test_usage_spool_rotates_at_cap(monkeypatch, tmp_path):
+    """The spool is an audit log but must not grow unboundedly on a
+    long-lived server: past the cap it rotates to one .1 generation."""
+    from skypilot_tpu.usage import usage_lib
+    monkeypatch.setattr(usage_lib, '_MAX_SPOOL_BYTES', 512)
+    monkeypatch.setattr(usage_lib.paths, 'state_dir',
+                        lambda: str(tmp_path))
+    for _ in range(40):
+        usage_lib.record_event('spam', blob='x' * 64)
+    spool = usage_lib.spool_path()
+    assert os.path.exists(spool + '.1')
+    assert os.path.getsize(spool) < 512 + 4096  # capped, not unbounded
+    # Rotation keeps exactly one generation.
+    assert not os.path.exists(spool + '.2')
