@@ -43,6 +43,24 @@ def main():
         assert e < 1.0, (n, e)
     print("WINDOWED FLASH COMPILES AND MATCHES ON TPU")
 
+    # q_offset (rectangular cached-prefill) mode — the path unsharded
+    # TPU serving now takes by default (engine.py _use_flash): a
+    # [B,T] chunk at cache offset `off` against the full [B,S] cache
+    # must match dense offset-causal attention, compiled by Mosaic
+    # (the CPU suite only ever interprets it).
+    off = 512
+    T = 512
+    qc = q[:, off:off + T]
+    out = jax.jit(lambda qc, k, v, o: fa.flash_attention(
+        qc, k, v, True, 256, 512, q_offset=o))(qc, k, v, jnp.int32(off))
+    full = att.dense_attention(q, k, v, causal=True)
+    ref = full[:, off:off + T]
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32) -
+                                ref.astype(jnp.float32))))
+    print("q_offset prefill fwd max err:", err)
+    assert err < 0.05, err
+    print("OFFSET (CACHED-PREFILL) FLASH COMPILES AND MATCHES ON TPU")
+
 
 if __name__ == "__main__":
     main()

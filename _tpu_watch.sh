@@ -1,0 +1,116 @@
+#!/bin/bash
+# TPU-recovery watcher (VERDICT r4 #1a): loop FOREVER, single instance
+# under flock, log every probe, and write every artifact INSIDE the
+# repo so the end-of-round driver snapshot carries it.
+#
+# The axon tunnel to the one real v5e chip wedges for hours at a time
+# (rounds 3-4 lost their whole perf axis to this). The moment a probe
+# succeeds, capture in order:
+#   1. python bench.py            -> BENCH_recovered.json (repo root)
+#   2. python -u _tpu_flash_check.py -> _tpu_recovery/flash_check.log
+# and touch _tpu_recovery/capture_done once BOTH are good so a healthy
+# chip isn't re-benched forever. Delete capture_done to re-arm (e.g.
+# after improving bench.py).
+#
+# Coordination: every chip user (this watcher, manual runs) must hold
+# _tpu_recovery/chip.lock — two processes attaching the single-tenant
+# tunnel at once is exactly how it wedges (observed 22:22Z: a stray
+# skylet starved the flash check into UNAVAILABLE after 25 min).
+set -u
+REPO=/root/repo
+DIR=$REPO/_tpu_recovery
+mkdir -p "$DIR"
+cd "$REPO"
+
+exec 9>"$DIR/watch.lock"
+if ! flock -n 9; then
+    echo "another watcher holds $DIR/watch.lock; exiting" >&2
+    exit 0
+fi
+
+log() { echo "$(date -u +%FT%TZ) $*" >> "$DIR/watch.log"; }
+
+probe() {
+    # Hard timeout: a wedged tunnel BLOCKS inside jax.devices();
+    # `timeout` kills the probe so no half-attached process lingers.
+    timeout 150 python -c \
+        "import jax; assert jax.devices()[0].platform == 'tpu'" \
+        > /dev/null 2>&1
+}
+
+bench_good() {
+    # Good = value > 0 AND a decode sweep with at least one non-error
+    # batch entry (the r4 capture had train-only; re-arm for decode).
+    python - "$1" <<'EOF'
+import json, sys
+try:
+    d = json.load(open(sys.argv[1]))
+except Exception:
+    sys.exit(1)
+if not d.get('value'):
+    sys.exit(1)
+sweep = (d.get('extra') or {}).get('decode', {}).get('batch_sweep', {})
+ok = [v for v in sweep.values() if isinstance(v, dict) and 'error' not in v]
+sys.exit(0 if ok else 1)
+EOF
+}
+
+capture() {
+    (
+        flock 8
+        log "capture: bench.py starting"
+        if timeout 900 python bench.py > "$DIR/bench_out.json.tmp" \
+                2> "$DIR/bench_err.log"; then
+            if bench_good "$DIR/bench_out.json.tmp"; then
+                mv "$DIR/bench_out.json.tmp" "$DIR/bench_out.json"
+                cp "$DIR/bench_out.json" "$REPO/BENCH_recovered.json"
+                log "capture: bench OK -> BENCH_recovered.json"
+            elif [ ! -f "$REPO/BENCH_recovered.json" ]; then
+                # Partial (e.g. train-only) beats nothing.
+                mv "$DIR/bench_out.json.tmp" "$DIR/bench_out.json"
+                cp "$DIR/bench_out.json" "$REPO/BENCH_recovered.json"
+                log "capture: bench partial -> BENCH_recovered.json"
+            else
+                log "capture: bench weaker than existing; kept old"
+            fi
+        else
+            log "capture: bench.py failed rc=$?"
+        fi
+        if ! grep -q '^rc=0$' "$DIR/flash_check.log" 2>/dev/null; then
+            log "capture: flash check starting"
+            timeout 2400 python -u _tpu_flash_check.py \
+                > "$DIR/flash_check.log.tmp" 2>&1
+            echo "rc=$?" >> "$DIR/flash_check.log.tmp"
+            mv "$DIR/flash_check.log.tmp" "$DIR/flash_check.log"
+            if grep -q '^rc=0$' "$DIR/flash_check.log"; then
+                # Durable (tracked) copy: _tpu_recovery/ is gitignored.
+                cp "$DIR/flash_check.log" "$REPO/FLASHCHECK_recovered.log"
+            fi
+            log "capture: flash check $(tail -1 "$DIR/flash_check.log")"
+        fi
+        if [ -f "$REPO/BENCH_recovered.json" ] \
+                && bench_good "$REPO/BENCH_recovered.json" \
+                && grep -q '^rc=0$' "$DIR/flash_check.log" 2>/dev/null; then
+            touch "$DIR/capture_done"
+            log "capture: COMPLETE (bench + flash both good)"
+        fi
+    ) 8>"$DIR/chip.lock"
+}
+
+log "watcher started (pid $$)"
+n=0
+while true; do
+    n=$((n + 1))
+    if probe; then
+        log "probe $n: UP"
+        echo "TPU UP as of $(date -u +%FT%TZ) (probe $n)" > "$DIR/status"
+        if [ ! -f "$DIR/capture_done" ]; then
+            capture
+        fi
+        sleep 1800
+    else
+        log "probe $n: down"
+        echo "TPU DOWN as of $(date -u +%FT%TZ) (probe $n)" > "$DIR/status"
+        sleep 300
+    fi
+done
