@@ -166,6 +166,9 @@ def _train_bench(jax, n_devices: int, on_tpu: bool):
     }
 
 
+_REAL_8B_LAYERS = 32
+
+
 def _decode_bench(jax, on_tpu: bool):
     """Prefill + decode throughput through the engine's compiled path.
 
@@ -173,6 +176,17 @@ def _decode_bench(jax, on_tpu: bool):
     host sync covers `steps` tokens — measuring the chip rather than
     the host/relay round-trip that the step-at-a-time engine loop
     would pay in this harness.
+
+    Honest-reporting note: bench-8b keeps llama3-8B's exact LAYER
+    geometry but only 5 of 32 layers.  Per-layer decode cost transfers;
+    whole-model decode throughput does NOT (decode is weight/KV-bandwidth
+    bound and scales with total depth).  Every sweep entry therefore
+    reports `decode_step_ms_per_layer` and a conservative
+    `est_real8b_decode_tokens_per_sec` (raw step time scaled by
+    32/num_layers — conservative because the non-layer cost, embedding +
+    LM head, is scaled up with it), and the raw 5-layer number is
+    labelled as such.  A larger batch that exhausts HBM records an
+    'oom' entry instead of clobbering the sweep.
     """
     import jax.numpy as jnp
     from jax import lax
@@ -180,15 +194,11 @@ def _decode_bench(jax, on_tpu: bool):
     from skypilot_tpu.inference import engine as eng
     from skypilot_tpu.models import resolve
 
-    # bench-8b: the EXACT llama3-8B layer geometry (depth/vocab cut to
-    # fit one chip) — per-layer decode cost transfers to the real 8B,
-    # so this IS the single-chip proxy for BASELINE.md's "tokens/s/chip
-    # — Llama-3-8B serve" north star.
     model = 'bench-8b' if on_tpu else 'tiny'
     max_seq = 2048 if on_tpu else 64
     prompt_len = 512 if on_tpu else 16
     steps = 64 if on_tpu else 4
-    batch_sizes = (1, 8, 32) if on_tpu else (2,)
+    batch_sizes = (1, 8, 16, 32) if on_tpu else (2,)
 
     _progress(f'decode: init {model} params')
     family, cfg = resolve(model)
@@ -210,55 +220,88 @@ def _decode_bench(jax, on_tpu: bool):
                                        length=n_steps)
         return toks
 
+    n_layers = cfg.num_layers
+    depth_scale = _REAL_8B_LAYERS / n_layers
+
     t_start = time.perf_counter()
     sweep = {}
     for b in batch_sizes:
         if time.perf_counter() - t_start > _DECODE_BUDGET_S:
             break
         _progress(f'decode: batch {b}')
-        cache = eng.init_cache(cfg, b, max_seq)
-        prompts = jax.random.randint(jax.random.key(1), (b, prompt_len),
-                                     0, cfg.vocab_size, jnp.int32)
-        lengths = jnp.full((b,), prompt_len, jnp.int32)
-        slots = jnp.arange(b, dtype=jnp.int32)
+        cache = filled = logits = toks = last = None
+        try:
+            cache = eng.init_cache(cfg, b, max_seq)
+            prompts = jax.random.randint(jax.random.key(1),
+                                         (b, prompt_len),
+                                         0, cfg.vocab_size, jnp.int32)
+            lengths = jnp.full((b,), prompt_len, jnp.int32)
+            slots = jnp.arange(b, dtype=jnp.int32)
 
-        # Prefill (compile, then timed runs against a fresh cache).
-        logits, filled = eng.prefill(params, prompts, lengths, cache,
-                                     slots, cfg)
-        float(logits.sum())
-        prefill_ts = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            logits, filled = eng.prefill(params, prompts, lengths,
-                                         cache, slots, cfg)
+            # Prefill (compile, then timed runs against a fresh cache).
+            # use_flash matches what unsharded TPU serving actually
+            # runs (engine.py _use_flash): the Pallas prefill path.
+            logits, filled = eng.prefill(params, prompts, lengths, cache,
+                                         slots, cfg, use_flash=on_tpu)
             float(logits.sum())
-            prefill_ts.append(time.perf_counter() - t0)
+            prefill_ts = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                logits, filled = eng.prefill(params, prompts, lengths,
+                                             cache, slots, cfg,
+                                             use_flash=on_tpu)
+                float(logits.sum())
+                prefill_ts.append(time.perf_counter() - t0)
 
-        last = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        decode = jax.jit(run_decode, static_argnames=('n_steps',))
-        toks = decode(params, filled, last, steps)
-        float(toks.sum())  # compile + sync
-        decode_ts = []
-        for _ in range(3):
-            t0 = time.perf_counter()
+            last = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            decode = jax.jit(run_decode, static_argnames=('n_steps',))
             toks = decode(params, filled, last, steps)
-            float(toks.sum())
-            decode_ts.append(time.perf_counter() - t0)
+            float(toks.sum())  # compile + sync
+            decode_ts = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                toks = decode(params, filled, last, steps)
+                float(toks.sum())
+                decode_ts.append(time.perf_counter() - t0)
+        except Exception as e:  # noqa: BLE001 — keep partial sweep
+            msg = f'{type(e).__name__}: {e}'
+            oom = 'RESOURCE_EXHAUSTED' in msg or 'Out of memory' in msg
+            sweep[str(b)] = {'error': 'oom' if oom else msg[:200]}
+            # Drop this batch's buffers before trying anything else.
+            cache = filled = logits = toks = last = None
+            import gc
+            gc.collect()
+            if oom:
+                continue  # larger batches will OOM too, but the budget
+                # guard bounds the loop; record each honestly.
+            break
         prefill_dt = min(prefill_ts)
         decode_dt = min(decode_ts)
+        step_ms = decode_dt / steps * 1e3
         sweep[str(b)] = {
             'prefill_tokens_per_sec': round(b * prompt_len / prefill_dt,
                                             1),
-            'decode_tokens_per_sec': round(b * steps / decode_dt, 1),
-            'decode_step_ms': round(decode_dt / steps * 1e3, 3),
+            f'decode_tokens_per_sec_{n_layers}layer': round(
+                b * steps / decode_dt, 1),
+            'decode_step_ms': round(step_ms, 3),
+            'decode_step_ms_per_layer': round(step_ms / n_layers, 4),
+            'est_real8b_decode_tokens_per_sec': round(
+                b * steps / (decode_dt * depth_scale), 1),
         }
-    best = max((v['decode_tokens_per_sec'] for v in sweep.values()),
-               default=0.0)
+        # Free the cache copies before the next (larger) batch.
+        cache = filled = logits = toks = last = None
+    ok = [v for v in sweep.values() if 'error' not in v]
+    best_raw = max((v[f'decode_tokens_per_sec_{n_layers}layer']
+                    for v in ok), default=0.0)
+    best_8b = max((v['est_real8b_decode_tokens_per_sec'] for v in ok),
+                  default=0.0)
     return {
         'model': model, 'prompt_len': prompt_len,
         'decode_steps': steps, 'max_seq': max_seq,
+        'num_layers': n_layers, 'real_8b_layers': _REAL_8B_LAYERS,
         'batch_sweep': sweep,
-        'best_decode_tokens_per_sec_per_chip': best,
+        f'best_decode_tokens_per_sec_per_chip_{n_layers}layer': best_raw,
+        'best_est_real8b_decode_tokens_per_sec_per_chip': best_8b,
     }
 
 
@@ -268,6 +311,12 @@ def main() -> None:
     on_tpu = devices[0].platform == 'tpu'
 
     train = _train_bench(jax, n_devices, on_tpu)
+
+    # Release the train state (params + AdamW moments) before decode
+    # re-initializes params next to a KV cache — on one 16G chip the
+    # leftovers are the difference between a full sweep and an OOM.
+    import gc
+    gc.collect()
 
     try:
         decode = _decode_bench(jax, on_tpu)

@@ -93,11 +93,29 @@ def init_cache(config: llama.LlamaConfig, batch_size: int,
     return cache
 
 
+def _flash_prefill_ok(t: int, s: int, d: int) -> bool:
+    """Can the Pallas flash kernel serve a [T]-query chunk against an
+    [S]-position cache? Shapes are static at trace time, so this is a
+    compile-time routing decision, not a runtime branch."""
+    if t < 2:
+        return False
+    bq, bk = min(512, t), min(512, s)
+    if t % bq or s % bk:
+        return False
+    if jax.default_backend() == 'tpu':
+        # Mosaic tiling: bf16 tiles are (16, 128), and the [bq, bk]
+        # score tile needs bk on a lane multiple.
+        if d % 128 or t % 16 or bk % 128:
+            return False
+    return True
+
+
 def _cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                       q_positions: jax.Array,
                       lengths: jax.Array,
                       window: Optional[jax.Array] = None,
-                      softcap: Optional[float] = None) -> jax.Array:
+                      softcap: Optional[float] = None,
+                      q_offset: Optional[jax.Array] = None) -> jax.Array:
     """Attention of q [B,T,H,D] against the padded cache [B,S,KV,D].
 
     Valid keys per slot b: positions < lengths[b] (the cache already
@@ -105,7 +123,27 @@ def _cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     static. `window` (traced scalar, Mistral/Gemma local layers)
     hides keys older than `window` positions; `softcap` applies
     Gemma-style logit capping.
+
+    `q_offset` (traced scalar; prefill chunks only, where every slot's
+    chunk starts at the same cache position) routes through the Pallas
+    flash kernel instead of materializing the dense [.., T, S] scores:
+    online softmax keeps the tile in VMEM and kv blocks past the
+    causal frontier are skipped at the DMA level, so a 128k-context
+    chunked prefill reads O(frontier) HBM per chunk instead of O(S).
+    Numerics: rows within a slot's prompt see exactly the keys the
+    dense mask allows (k <= q_pos, all within this request's written
+    region); rows beyond the prompt are garbage on BOTH paths and are
+    discarded by prefill's last-token gather, so routing is
+    equivalence-tested end-to-end (test_inference.py).
     """
+    if q_offset is not None and _flash_prefill_ok(
+            q.shape[1], k_cache.shape[1], q.shape[3]):
+        from skypilot_tpu.ops import flash_attention as fa_lib
+        return fa_lib.flash_attention(
+            q, k_cache, v_cache, causal=True,
+            block_q=min(512, q.shape[1]),
+            block_k=min(512, k_cache.shape[1]),
+            window=window, softcap=softcap, q_offset=q_offset)
     num_heads = q.shape[2]
     b, s, hkv, d = k_cache.shape
     t = q.shape[1]
@@ -139,7 +177,8 @@ def _attn_with_cache(x: jax.Array, layer_params: Params,
                      k_cache: jax.Array, v_cache: jax.Array,
                      positions: jax.Array, lengths: jax.Array,
                      write_at: jax.Array, config: ModelConfig,
-                     window: Optional[jax.Array] = None
+                     window: Optional[jax.Array] = None,
+                     q_offset: Optional[jax.Array] = None
                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Attention block over T new tokens with KV-cache update; shared
     by the llama-core and MoE cached layers (MoE reuses llama's
@@ -178,7 +217,8 @@ def _attn_with_cache(x: jax.Array, layer_params: Params,
     attn = _cached_attention(q, k_cache, v_cache, positions, lengths,
                              window=window,
                              softcap=getattr(c, 'attn_logit_softcap',
-                                             None))
+                                             None),
+                             q_offset=q_offset)
     attn_out = jnp.einsum('bshd,hde->bse', attn.astype(c.dtype),
                           layer_params['wo'],
                           preferred_element_type=jnp.float32).astype(c.dtype)
@@ -194,14 +234,15 @@ def _layer_with_cache(x: jax.Array, layer_params: Params,
                       positions: jax.Array, lengths: jax.Array,
                       write_at: jax.Array,
                       config: llama.LlamaConfig,
-                      window: Optional[jax.Array] = None
+                      window: Optional[jax.Array] = None,
+                      q_offset: Optional[jax.Array] = None
                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One llama-core layer (attention + dense GLU MLP) with cache."""
     c = config
     plus_one = c.norm_plus_one
     x, k_cache, v_cache = _attn_with_cache(
         x, layer_params, k_cache, v_cache, positions, lengths, write_at,
-        c, window=window)
+        c, window=window, q_offset=q_offset)
 
     h = llama._rms_norm(x, layer_params['mlp_norm'], c.rms_norm_eps,
                         plus_one)
@@ -223,7 +264,8 @@ def _layer_with_cache(x: jax.Array, layer_params: Params,
 def _moe_layer_with_cache(x: jax.Array, layer_params: Params,
                           k_cache: jax.Array, v_cache: jax.Array,
                           positions: jax.Array, lengths: jax.Array,
-                          write_at: jax.Array, config: Any
+                          write_at: jax.Array, config: Any,
+                          q_offset: Optional[jax.Array] = None
                           ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One MoE layer (llama attention + routed expert MLP) with cache.
 
@@ -234,7 +276,7 @@ def _moe_layer_with_cache(x: jax.Array, layer_params: Params,
     c = config
     x, k_cache, v_cache = _attn_with_cache(
         x, layer_params, k_cache, v_cache, positions, lengths, write_at,
-        c)
+        c, q_offset=q_offset)
     h = llama._rms_norm(x, layer_params['mlp_norm'], c.rms_norm_eps)
     out, _aux = moe_lib._moe_mlp(h, layer_params, c)
     return x + out, k_cache, v_cache
@@ -243,7 +285,9 @@ def _moe_layer_with_cache(x: jax.Array, layer_params: Params,
 def _moe_hidden_with_cache(params: Params, tokens: jax.Array,
                            cache: Cache, positions: jax.Array,
                            write_at: jax.Array, new_lengths: jax.Array,
-                           config: Any) -> Tuple[jax.Array, Cache]:
+                           config: Any,
+                           q_offset: Optional[jax.Array] = None
+                           ) -> Tuple[jax.Array, Cache]:
     """MoE variant of `_hidden_with_cache` (plain norms, no
     windows/softcaps — models/moe.py `forward`)."""
     c = config
@@ -253,7 +297,7 @@ def _moe_hidden_with_cache(params: Params, tokens: jax.Array,
         layer_params, k_cache, v_cache = per_layer
         x, k_cache, v_cache = _moe_layer_with_cache(
             x, layer_params, k_cache, v_cache, positions, new_lengths,
-            write_at, c)
+            write_at, c, q_offset=q_offset)
         return x, (k_cache, v_cache)
 
     x, (new_k, new_v) = lax.scan(body, x, (params['layers'], cache['k'],
@@ -265,7 +309,8 @@ def _moe_hidden_with_cache(params: Params, tokens: jax.Array,
 def _hidden_with_cache(params: Params, tokens: jax.Array,
                        cache: Cache, positions: jax.Array,
                        write_at: jax.Array, new_lengths: jax.Array,
-                       config: ModelConfig
+                       config: ModelConfig,
+                       q_offset: Optional[jax.Array] = None
                        ) -> Tuple[jax.Array, Cache]:
     """tokens [B,T] at `positions` → (final-norm hidden states
     [B,T,E], updated cache) — the transformer stack WITHOUT the
@@ -273,7 +318,8 @@ def _hidden_with_cache(params: Params, tokens: jax.Array,
     tokens it actually samples from."""
     if isinstance(config, moe_lib.MoeConfig):
         return _moe_hidden_with_cache(params, tokens, cache, positions,
-                                      write_at, new_lengths, config)
+                                      write_at, new_lengths, config,
+                                      q_offset=q_offset)
     c = config
     x = params['embed'].astype(c.dtype)[tokens]
     if c.embed_scale:
@@ -284,7 +330,7 @@ def _hidden_with_cache(params: Params, tokens: jax.Array,
             layer_params, k_cache, v_cache = per_layer
             x, k_cache, v_cache = _layer_with_cache(
                 x, layer_params, k_cache, v_cache, positions,
-                new_lengths, write_at, c)
+                new_lengths, write_at, c, q_offset=q_offset)
             return x, (k_cache, v_cache)
 
         x, (new_k, new_v) = lax.scan(body, x,
@@ -299,7 +345,8 @@ def _hidden_with_cache(params: Params, tokens: jax.Array,
             layer_params, k_cache, v_cache, window = per_layer
             x, k_cache, v_cache = _layer_with_cache(
                 x, layer_params, k_cache, v_cache, positions,
-                new_lengths, write_at, c, window=window)
+                new_lengths, write_at, c, window=window,
+                q_offset=q_offset)
             return x, (k_cache, v_cache)
 
         x, (new_k, new_v) = lax.scan(body, x,
@@ -341,7 +388,8 @@ def _forward_with_cache(params: Params, tokens: jax.Array,
 
 def prefill(params: Params, tokens: jax.Array, prompt_lengths: jax.Array,
             cache: Cache, slot_ids: jax.Array,
-            config: llama.LlamaConfig) -> Tuple[jax.Array, Cache]:
+            config: llama.LlamaConfig,
+            use_flash: bool = False) -> Tuple[jax.Array, Cache]:
     """Process padded prompts [N,P] into cache slots `slot_ids` [N].
 
     Returns last-token logits [N,V] (at each prompt's true last
@@ -351,14 +399,17 @@ def prefill(params: Params, tokens: jax.Array, prompt_lengths: jax.Array,
     prefill IS the single-chunk case of prefill_chunked — one code
     path, one masking contract."""
     return prefill_chunked(params, tokens, prompt_lengths, cache,
-                           slot_ids, config, chunk=tokens.shape[1])
+                           slot_ids, config, chunk=tokens.shape[1],
+                           use_flash=use_flash)
 
 
-@functools.partial(jax.jit, static_argnames=('config', 'chunk'))
+@functools.partial(jax.jit,
+                   static_argnames=('config', 'chunk', 'use_flash'))
 def prefill_chunked(params: Params, tokens: jax.Array,
                     prompt_lengths: jax.Array, cache: Cache,
                     slot_ids: jax.Array, config: llama.LlamaConfig,
-                    chunk: int) -> Tuple[jax.Array, Cache]:
+                    chunk: int,
+                    use_flash: bool = False) -> Tuple[jax.Array, Cache]:
     """Prefill [N, K*chunk] tokens as a lax.scan of `chunk`-wide
     forward passes (K=1 is plain one-shot prefill). The dense
     cached-attention scores are [.., T, S]: one-shot prefill at
@@ -368,7 +419,14 @@ def prefill_chunked(params: Params, tokens: jax.Array,
     OOMs at the first real prompt. The scan carries only each slot's
     last-token HIDDEN state [N,E]; the full-vocab lm_head projection
     runs ONCE after the scan, not per chunk. Numerically identical to
-    one-shot prefill (equivalence-tested)."""
+    one-shot prefill (equivalence-tested).
+
+    use_flash routes each chunk's attention through the Pallas flash
+    kernel (see _cached_attention): VMEM online-softmax instead of the
+    dense [.., T, S] scores, and DMA-level skipping of cache blocks
+    past the causal frontier — the FLOPs/HBM fix on top of chunking's
+    memory fix. Unsharded serving only: pallas_call has no GSPMD
+    partitioning rules, so the engine enables it when mesh is None."""
     n, padded_len = tokens.shape
     n_chunks = padded_len // chunk
     sub_cache = {
@@ -385,7 +443,7 @@ def prefill_chunked(params: Params, tokens: jax.Array,
         visible = jnp.minimum(prompt_lengths, start + chunk)
         x, out = _hidden_with_cache(
             params, chunk_tokens, kv, positions, write_at, visible,
-            config)
+            config, q_offset=start if use_flash else None)
         kv = {'k': out['k'], 'v': out['v']}  # carry shape must match
         # Keep each slot's TRUE last token's hidden state, whichever
         # chunk it lands in.
@@ -491,7 +549,8 @@ class InferenceEngine:
                  max_seq_len: Optional[int] = None,
                  seed: int = 0,
                  mesh: Optional[Any] = None,
-                 prefill_chunk: int = 1024):
+                 prefill_chunk: int = 1024,
+                 use_flash: Optional[bool] = None):
         # The cached decode path mirrors the llama-core transformer
         # (every family knob: window/GeGLU/post-norms/softcaps/tied
         # embeddings) and the MoE family (routed expert MLP).
@@ -513,6 +572,20 @@ class InferenceEngine:
                 config = dataclasses.replace(config,
                                              capacity_factor=exact_cf)
         self.mesh = mesh
+        # Flash prefill is an unsharded-TPU-path optimization:
+        # pallas_call has no GSPMD partitioning rules (a sharded cache
+        # would be all-gathered into every chip, defeating context
+        # sharding), and off-TPU the kernel runs in interpret mode —
+        # far slower than the dense XLA path. use_flash=True forces it
+        # (CPU equivalence/long-context tests).
+        if use_flash and mesh is not None:
+            raise ValueError(
+                'use_flash=True is incompatible with a sharded engine '
+                '(pallas_call has no GSPMD partitioning rules); omit '
+                'use_flash or serve unsharded.')
+        if use_flash is None:
+            use_flash = mesh is None and jax.default_backend() == 'tpu'
+        self._use_flash = bool(use_flash)
         if mesh is not None:
             # Tensor-parallel serving: params shard by their logical
             # axes (heads/mlp/vocab over 'tensor'); GSPMD propagates
@@ -632,7 +705,8 @@ class InferenceEngine:
         with self._mesh_ctx():
             logits, self.state.cache = prefill_chunked(
                 self.params, padded, lengths, self.state.cache,
-                slot_arr, self.config, chunk)
+                slot_arr, self.config, chunk,
+                use_flash=self._use_flash)
         # First generated token comes straight from prefill logits.
         self._key, sub = jax.random.split(self._key)
         temps = jnp.array([s.temperature for _, _, s in inserts],

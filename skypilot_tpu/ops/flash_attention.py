@@ -53,13 +53,20 @@ def _score_mods(s, q_start, k_start, w_ref, *, causal, windowed, softcap,
 
     tanh_t is the pre-mask tanh(s/cap) the backward kernels need for the
     softcap Jacobian (None when softcap is off).
+
+    w_ref is the 2-element SMEM scalar block [window, q_offset]:
+    q_offset shifts every query's GLOBAL position (cached-prefill
+    chunks attend a cache much longer than the chunk; a chunk starting
+    at cache position `off` must mask as if its rows were rows
+    off..off+bq). Square training attention passes offset 0.
     """
     t = None
     if softcap is not None:
         t = jnp.tanh(s / softcap)
         s = softcap * t
     if causal or windowed:
-        q_pos = q_start + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        q_pos = (w_ref[1] + q_start +
+                 lax.broadcasted_iota(jnp.int32, (bq, bk), 0))
         k_pos = k_start + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         mask = None
         if causal:
@@ -75,12 +82,13 @@ def _block_visible(q_start, k_start, w_ref, *, causal, windowed, bq, bk):
     """Traced predicate: does ANY (q, k) pair in this block tile satisfy
     the causal+window mask `k <= q < k + window`? The valid k-range for
     the q tile is (q_start - window, q_start + bq - 1]; overlap with the
-    k tile gives the two comparisons below."""
+    k tile gives the two comparisons below. Query positions are global:
+    local tile row + w_ref[1] offset."""
     cond = None
     if causal:
-        cond = k_start < q_start + bq
+        cond = k_start < w_ref[1] + q_start + bq
     if windowed:
-        wc = k_start + bk + w_ref[0] > q_start + 1
+        wc = k_start + bk + w_ref[0] > w_ref[1] + q_start + 1
         cond = wc if cond is None else cond & wc
     return cond  # None = statically always visible
 
@@ -247,9 +255,9 @@ def _dkv_kernel(w_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     # see this kv tile").
     cond = None
     if causal:
-        cond = q_start + bq > k_start
+        cond = w_ref[1] + q_start + bq > k_start
     if windowed:
-        wc = k_start + bk + w_ref[0] > q_start + 1
+        wc = k_start + bk + w_ref[0] > w_ref[1] + q_start + 1
         cond = wc if cond is None else cond & wc
     if cond is None:
         _compute()
@@ -274,36 +282,47 @@ def _blocks(s_q: int, s_kv: int, block_q: int, block_k: int):
 _SMEM_SPEC = pl.BlockSpec(memory_space=pltpu.SMEM)
 
 
-def _clamped_kv_index(iq, ik, w_ref, *, bq: int, bk: int, n_k: int):
+def _clamped_kv_index(iq, ik, w_ref, *, bq: int, bk: int, n_k: int,
+                      windowed: bool = True):
     """KV block index with masked steps pinned to a visible block.
 
-    For q tile [iq*bq, iq*bq+bq) under a sliding window the visible
-    kv columns are (iq*bq - w, iq*bq + bq - 1]; grid steps outside
+    For q tile at GLOBAL rows [off + iq*bq, off + iq*bq + bq) the
+    causally visible kv columns end at off + iq*bq + bq - 1, and under
+    a sliding window start after off + iq*bq - w; grid steps outside
     that range re-fetch the boundary block instead of DMAing a tile
     the kernel will skip anyway (pallas elides the copy when the
-    mapped index doesn't change) — HBM traffic drops to O(window)
-    per q tile on long sequences.
+    mapped index doesn't change). For windowed training that makes HBM
+    traffic O(window) per q tile; for offset-causal cached prefill it
+    means kv blocks past the causal frontier — most of a long cache on
+    early chunks — are never read at all.
     """
-    w = w_ref[0]
-    lo = jnp.maximum((iq * bq - w + 1) // bk, 0)
-    hi = jnp.minimum((iq * bq + bq - 1) // bk, n_k - 1)
+    off = w_ref[1]
+    hi = jnp.minimum((off + iq * bq + bq - 1) // bk, n_k - 1)
+    lo = 0
+    if windowed:
+        lo = jnp.maximum((off + iq * bq - w_ref[0] + 1) // bk, 0)
     return jnp.clip(ik, lo, hi)
 
 
-def _clamped_q_index(ik, iq, w_ref, *, bq: int, bk: int, n_q: int):
+def _clamped_q_index(ik, iq, w_ref, *, bq: int, bk: int, n_q: int,
+                     windowed: bool = True):
     """Mirror of _clamped_kv_index for the dkv grid (q innermost):
-    visible q rows for kv tile [ik*bk, ik*bk+bk) are
-    [ik*bk, ik*bk + bk - 1 + w - 1]."""
-    w = w_ref[0]
-    lo = jnp.maximum((ik * bk) // bq, 0)
-    hi = jnp.minimum((ik * bk + bk + w - 2) // bq, n_q - 1)
+    visible q rows (global = local + off) for kv tile
+    [ik*bk, ik*bk+bk) are [ik*bk, ik*bk + bk - 1 + w - 1]."""
+    off = w_ref[1]
+    lo = jnp.maximum((ik * bk - off) // bq, 0)
+    hi = n_q - 1
+    if windowed:
+        hi = jnp.minimum((ik * bk + bk + w_ref[0] - 2 - off) // bq,
+                         n_q - 1)
     return jnp.clip(iq, lo, hi)
 
 
 def _flash_fwd_impl(q: jax.Array, k: jax.Array, v: jax.Array,
                     window: jax.Array, causal: bool, windowed: bool,
                     block_q: int, block_k: int,
-                    softcap: Optional[float], interpret: bool):
+                    softcap: Optional[float], interpret: bool,
+                    offset_mode: bool = False):
     b, s_q, h, d = q.shape
     s_kv, h_kv = k.shape[1], k.shape[2]
     group = h // h_kv
@@ -318,13 +337,13 @@ def _flash_fwd_impl(q: jax.Array, k: jax.Array, v: jax.Array,
     kernel = functools.partial(
         _fwd_kernel, causal=causal, windowed=windowed, softcap=softcap,
         scale=scale, bq=bq, bk=bk, n_kv_blocks=n_k)
-    if windowed and causal:
-        # Scalar-prefetch grid: the window rides into the INDEX MAPS,
-        # so fully-masked kv steps re-fetch the boundary block (no new
-        # DMA) while pl.when skips their compute.
+    if causal and (windowed or offset_mode):
+        # Scalar-prefetch grid: the window/offset scalars ride into the
+        # INDEX MAPS, so fully-masked kv steps re-fetch the boundary
+        # block (no new DMA) while pl.when skips their compute.
         def kv_map(b_, h_, iq, ik, w_ref):
             ik_c = _clamped_kv_index(iq, ik, w_ref, bq=bq, bk=bk,
-                                     n_k=n_k)
+                                     n_k=n_k, windowed=windowed)
             return (b_, h_ // group, ik_c, 0)
 
         grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -393,7 +412,8 @@ def _flash_fwd_impl(q: jax.Array, k: jax.Array, v: jax.Array,
 
 
 def _flash_bwd_impl(q, k, v, o, lse, do, window, causal, windowed,
-                    block_q, block_k, softcap, interpret):
+                    block_q, block_k, softcap, interpret,
+                    offset_mode=False):
     b, s_q, h, d = q.shape
     s_kv, h_kv = k.shape[1], k.shape[2]
     group = h // h_kv
@@ -416,13 +436,13 @@ def _flash_bwd_impl(q, k, v, o, lse, do, window, causal, windowed,
         _dkv_kernel, causal=causal, windowed=windowed, softcap=softcap,
         scale=scale, bq=bq, bk=bk, n_q_blocks=n_q)
 
-    if windowed and causal:
+    if causal and (windowed or offset_mode):
         # Scalar-prefetch grids: masked steps re-fetch the boundary
         # block (see _clamped_kv_index) instead of DMAing skipped
         # tiles.
         def kv_map(b_, h_, iq, ik, w_ref):
             ik_c = _clamped_kv_index(iq, ik, w_ref, bq=bq, bk=bk,
-                                     n_k=n_k)
+                                     n_k=n_k, windowed=windowed)
             return (b_, h_ // group, ik_c, 0)
 
         q_specp = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik, w:
@@ -446,12 +466,12 @@ def _flash_bwd_impl(q, k, v, o, lse, do, window, causal, windowed,
 
         def q_map(b_, h_, ik, iq, w_ref):
             iq_c = _clamped_q_index(ik, iq, w_ref, bq=bq, bk=bk,
-                                    n_q=n_q)
+                                    n_q=n_q, windowed=windowed)
             return (b_, h_, iq_c, 0)
 
         def row_map(b_, h_, ik, iq, w_ref):
             iq_c = _clamped_q_index(ik, iq, w_ref, bq=bq, bk=bk,
-                                    n_q=n_q)
+                                    n_q=n_q, windowed=windowed)
             return (b_, h_, iq_c, 0)
 
         q_spec2p = pl.BlockSpec((1, 1, bq, d), q_map)
@@ -541,27 +561,33 @@ def _use_interpret() -> bool:
     return jax.default_backend() != 'tpu'
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q, k, v, window, causal, windowed, block_q, block_k, softcap):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, window, causal, windowed, block_q, block_k, softcap,
+           offset_mode):
     out, _ = _flash_fwd_impl(q, k, v, window, causal, windowed, block_q,
-                             block_k, softcap, interpret=_use_interpret())
+                             block_k, softcap, interpret=_use_interpret(),
+                             offset_mode=offset_mode)
     return out
 
 
-def _fwd(q, k, v, window, causal, windowed, block_q, block_k, softcap):
+def _fwd(q, k, v, window, causal, windowed, block_q, block_k, softcap,
+         offset_mode):
     out, lse = _flash_fwd_impl(q, k, v, window, causal, windowed,
                                block_q, block_k, softcap,
-                               interpret=_use_interpret())
+                               interpret=_use_interpret(),
+                               offset_mode=offset_mode)
     return out, (q, k, v, window, out, lse)
 
 
-def _bwd(causal, windowed, block_q, block_k, softcap, res, g):
+def _bwd(causal, windowed, block_q, block_k, softcap, offset_mode, res,
+         g):
     q, k, v, window, o, lse = res
     dq, dk, dv = _flash_bwd_impl(q, k, v, o, lse, g, window, causal,
                                  windowed, block_q, block_k, softcap,
-                                 interpret=_use_interpret())
-    # int32 window takes a float0 cotangent (no gradient flows to it).
-    return dq, dk, dv, np.zeros((1,), dtype=jax.dtypes.float0)
+                                 interpret=_use_interpret(),
+                                 offset_mode=offset_mode)
+    # int32 scalars take a float0 cotangent (no gradient flows to them).
+    return dq, dk, dv, np.zeros((2,), dtype=jax.dtypes.float0)
 
 
 _flash.defvjp(_fwd, _bwd)
@@ -571,18 +597,35 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True, block_q: int = 512,
                     block_k: int = 512,
                     window: Optional[jax.Array] = None,
-                    softcap: Optional[float] = None) -> jax.Array:
+                    softcap: Optional[float] = None,
+                    q_offset: Optional[jax.Array] = None) -> jax.Array:
     """Flash attention. q:[B,Sq,H,D], k/v:[B,Skv,Hkv,D] → [B,Sq,H,D].
 
     window: sliding-window size — position q attends k iff
     q_pos - k_pos < window. May be a traced int32 scalar (the model
     stacks scan per-layer windows through one compiled body); requires
     causal. softcap: static Gemma-style logit cap, cap·tanh(s/cap).
+
+    q_offset (traced int32 scalar, requires causal): global position of
+    q row 0 — rectangular cached-prefill attention where a [B,T] chunk
+    starting at cache position `q_offset` attends a [B,S_kv] KV cache.
+    Row t masks as global position q_offset + t, and kv blocks past
+    the causal frontier are skipped at the DMA level (the chunked
+    long-context prefill cost is O(frontier), not O(S_kv)).
     """
     if window is not None and not causal:
         raise ValueError('flash window support is causal-only; use '
                          'blockwise for non-causal windows')
+    if q_offset is not None and not causal:
+        raise ValueError('q_offset (cached-prefill attention) requires '
+                         'causal masking')
     windowed = window is not None
-    w = jnp.asarray(window if windowed else 0, jnp.int32).reshape(1)
-    return _flash(q, k, v, w, causal, windowed, block_q, block_k,
-                  None if softcap is None else float(softcap))
+    offset_mode = q_offset is not None
+    scalars = jnp.stack([
+        jnp.asarray(window if windowed else 0, jnp.int32).reshape(()),
+        jnp.asarray(q_offset if offset_mode else 0,
+                    jnp.int32).reshape(()),
+    ])
+    return _flash(q, k, v, scalars, causal, windowed, block_q, block_k,
+                  None if softcap is None else float(softcap),
+                  offset_mode)

@@ -15,12 +15,15 @@ from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.utils import paths
 
-_lock = threading.Lock()
+# Reentrant: sibling stores hold write_lock() around their
+# execute+commit/rollback sequences and resolve the connection INSIDE
+# the hold (connection() re-takes this lock).
+_lock = threading.RLock()
 
 
 def _after_fork_in_child() -> None:
     global _lock, _conn, _conn_path
-    _lock = threading.Lock()
+    _lock = threading.RLock()
     _conn = None
     _conn_path = None
 
@@ -59,6 +62,17 @@ def connection() -> sqlite3.Connection:
     return _get_conn()
 
 
+def write_lock() -> threading.RLock:
+    """Serializes writes on the shared connection. Two threads
+    interleaving execute/commit/rollback on ONE sqlite3 connection
+    share its implicit transaction: thread B's rollback (e.g. on an
+    IntegrityError from a racing duplicate create) would discard
+    thread A's executed-but-uncommitted INSERT. Sibling stores hold
+    this around every write sequence; reentrant so connection() can be
+    resolved inside the hold."""
+    return _lock
+
+
 def valid_identifier(name: str) -> bool:
     """One naming rule for API-created entities (workspaces, users)."""
     return bool(name) and \
@@ -78,9 +92,13 @@ class TableOnce:
         path = paths.state_db_path()
         if self._ready_for == path:
             return
-        conn = _get_conn()
-        conn.execute(self._ddl)
-        conn.commit()
+        # Under the module lock: a bare execute+commit on the shared
+        # connection would commit another thread's half-done write
+        # sequence (the exact interleave write_lock() exists to stop).
+        with _lock:
+            conn = _get_conn()
+            conn.execute(self._ddl)
+            conn.commit()
         self._ready_for = path
 
 

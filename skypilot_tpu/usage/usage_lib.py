@@ -42,8 +42,14 @@ def spool_path() -> str:
 # The spool doubles as an audit log but must not grow unboundedly on a
 # long-lived API server: at the cap it rotates to ONE .1 generation
 # (append-heavy workloads lose at most the oldest half of history).
-_MAX_SPOOL_BYTES = int(os.environ.get('SKYTPU_USAGE_SPOOL_MAX_BYTES',
-                                      str(8 * 1024 * 1024)))
+try:
+    _MAX_SPOOL_BYTES = int(
+        os.environ.get('SKYTPU_USAGE_SPOOL_MAX_BYTES',
+                       str(8 * 1024 * 1024)))
+except ValueError:
+    # A malformed tuning knob must not take down every CLI/server
+    # import; fall back to the default.
+    _MAX_SPOOL_BYTES = 8 * 1024 * 1024
 
 
 def _rotate_locked(path: str) -> None:

@@ -120,21 +120,25 @@ def create_user(name: str, role: str = users_lib.ROLE_USER,
     _check_name_free(name)
     if get_user(name) is not None:
         raise ValueError(f'User {name!r} already exists.')
-    conn = state.connection()
     token = _new_token()
-    try:
-        conn.execute(
-            'INSERT INTO users (name, token, role, workspace, disabled, '
-            'created_at) VALUES (?, ?, ?, ?, 0, ?)',
-            (name, token, role, workspace, int(time.time())))
-        conn.commit()
-    except sqlite3.IntegrityError as e:
-        # Concurrent create raced the pre-check; same error as the
-        # pre-check, not a raw 500. Rollback releases the implicit
-        # write transaction on the shared connection.
-        conn.rollback()
-        raise ValueError(f'User {name!r} already exists.') from e
-    doc = get_user(name)
+    with state.write_lock():
+        conn = state.connection()
+        try:
+            conn.execute(
+                'INSERT INTO users (name, token, role, workspace, '
+                'disabled, created_at) VALUES (?, ?, ?, ?, 0, ?)',
+                (name, token, role, workspace, int(time.time())))
+            conn.commit()
+        except sqlite3.IntegrityError as e:
+            # Concurrent create raced the pre-check; same error as the
+            # pre-check, not a raw 500. Rollback releases the implicit
+            # write transaction; the write_lock hold is what makes it
+            # safe (it can't discard another thread's pending write).
+            conn.rollback()
+            raise ValueError(f'User {name!r} already exists.') from e
+        # Re-read INSIDE the hold: after release, a concurrent delete
+        # could make this None and turn success into a 500.
+        doc = get_user(name)
     doc['token'] = token
     return doc
 
@@ -142,11 +146,15 @@ def create_user(name: str, role: str = users_lib.ROLE_USER,
 def rotate_token(name: str) -> Dict[str, Any]:
     """Invalidate the old token, return the new one (once)."""
     _require_db_user(name)
-    conn = state.connection()
     token = _new_token()
-    conn.execute('UPDATE users SET token=? WHERE name=?', (token, name))
-    conn.commit()
-    doc = get_user(name)
+    with state.write_lock():
+        conn = state.connection()
+        conn.execute('UPDATE users SET token=? WHERE name=?',
+                     (token, name))
+        conn.commit()
+        doc = get_user(name)
+    if doc is None:
+        raise ValueError(f'User {name!r} was deleted concurrently.')
     doc['token'] = token
     return doc
 
@@ -158,25 +166,30 @@ def update_user(name: str, role: Optional[str] = None,
     if role is not None and role not in users_lib.ROLES:
         raise ValueError(f'Unknown role {role!r} '
                          f'(one of {users_lib.ROLES})')
-    conn = state.connection()
-    if role is not None:
-        conn.execute('UPDATE users SET role=? WHERE name=?',
-                     (role, name))
-    if workspace is not None:
-        conn.execute('UPDATE users SET workspace=? WHERE name=?',
-                     (workspace, name))
-    if disabled is not None:
-        conn.execute('UPDATE users SET disabled=? WHERE name=?',
-                     (1 if disabled else 0, name))
-    conn.commit()
-    return get_user(name)
+    with state.write_lock():
+        conn = state.connection()
+        if role is not None:
+            conn.execute('UPDATE users SET role=? WHERE name=?',
+                         (role, name))
+        if workspace is not None:
+            conn.execute('UPDATE users SET workspace=? WHERE name=?',
+                         (workspace, name))
+        if disabled is not None:
+            conn.execute('UPDATE users SET disabled=? WHERE name=?',
+                         (1 if disabled else 0, name))
+        conn.commit()
+        doc = get_user(name)
+    if doc is None:
+        raise ValueError(f'User {name!r} was deleted concurrently.')
+    return doc
 
 
 def delete_user(name: str) -> None:
     _require_db_user(name)
-    conn = state.connection()
-    conn.execute('DELETE FROM users WHERE name=?', (name,))
-    conn.commit()
+    with state.write_lock():
+        conn = state.connection()
+        conn.execute('DELETE FROM users WHERE name=?', (name,))
+        conn.commit()
 
 
 def _require_db_user(name: str) -> None:

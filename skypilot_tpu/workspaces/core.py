@@ -131,23 +131,29 @@ def create(name: str, spec: Optional[Dict[str, Any]] = None
     # create that's simply absence.
     spec = _validate_spec({k: v for k, v in (spec or {}).items()
                            if v is not None})
-    conn = state.connection()
     if get(name) is not None:
         raise ValueError(f'Workspace {name!r} already exists.')
-    try:
-        conn.execute(
-            'INSERT INTO workspaces (name, spec_json, created_at) '
-            'VALUES (?, ?, ?)',
-            (name, json.dumps(spec), int(time.time())))
-        conn.commit()
-    except sqlite3.IntegrityError as e:
-        # Two concurrent creates raced the pre-check; surface the same
-        # 400-mapped error the pre-check produces, not a raw 500. The
-        # rollback releases the implicit write transaction — leaving it
-        # open would hold the WAL lock on the shared connection.
-        conn.rollback()
-        raise ValueError(f'Workspace {name!r} already exists.') from e
-    return get(name)
+    with state.write_lock():
+        conn = state.connection()
+        try:
+            conn.execute(
+                'INSERT INTO workspaces (name, spec_json, created_at) '
+                'VALUES (?, ?, ?)',
+                (name, json.dumps(spec), int(time.time())))
+            conn.commit()
+        except sqlite3.IntegrityError as e:
+            # Two concurrent creates raced the pre-check; surface the
+            # same 400-mapped error the pre-check produces, not a raw
+            # 500. The rollback releases the implicit write transaction
+            # — and the write_lock hold is what makes it safe (it can't
+            # discard another thread's pending write on the shared
+            # connection).
+            conn.rollback()
+            raise ValueError(f'Workspace {name!r} already exists.') \
+                from e
+        # Re-read INSIDE the hold: after release, a concurrent delete
+        # could make this None and turn success into a 500.
+        return get(name)
 
 
 def update(name: str, spec: Dict[str, Any]) -> Dict[str, Any]:
@@ -161,32 +167,39 @@ def update(name: str, spec: Dict[str, Any]) -> Dict[str, Any]:
     (reference sky/workspaces/core.py:210 takes the same
     no-active-resources stance)."""
     _ensure_table()
-    current = get(name)
-    if current is None:
-        raise ValueError(f'No workspace {name!r}.')
-    cleared = {k for k, v in spec.items() if v is None}
-    spec = _validate_spec({k: v for k, v in spec.items()
-                           if v is not None})
-    if bad := cleared - _SPEC_KEYS:
-        raise ValueError(f'Unknown workspace spec keys: {sorted(bad)}')
-    current_spec = {k: v for k, v in current.items()
-                    if k in _SPEC_KEYS}
-    merged = {k: v for k, v in {**current_spec, **spec}.items()
-              if k not in cleared}
-    active = active_resources(name)
-    if any(active.values()) and _narrows(current, merged):
-        raise WorkspaceInUseError(
-            f'Workspace {name!r} has live resources ({active}); '
-            'narrowing its policy now could strand them. Tear them '
-            'down first.')
-    conn = state.connection()
-    conn.execute(
-        'INSERT INTO workspaces (name, spec_json, created_at) '
-        'VALUES (?, ?, ?) ON CONFLICT(name) DO UPDATE SET '
-        'spec_json=excluded.spec_json',
-        (name, json.dumps(merged), int(time.time())))
-    conn.commit()
-    return get(name)
+    # The whole read-merge-write runs under the write lock: merging
+    # from a read taken outside it would let two concurrent updates
+    # both merge from the same original and the loser's fields vanish
+    # (a description edit silently stripping policy — the exact thing
+    # the merge contract forbids).
+    with state.write_lock():
+        current = get(name)
+        if current is None:
+            raise ValueError(f'No workspace {name!r}.')
+        cleared = {k for k, v in spec.items() if v is None}
+        spec = _validate_spec({k: v for k, v in spec.items()
+                               if v is not None})
+        if bad := cleared - _SPEC_KEYS:
+            raise ValueError(
+                f'Unknown workspace spec keys: {sorted(bad)}')
+        current_spec = {k: v for k, v in current.items()
+                        if k in _SPEC_KEYS}
+        merged = {k: v for k, v in {**current_spec, **spec}.items()
+                  if k not in cleared}
+        active = active_resources(name)
+        if any(active.values()) and _narrows(current, merged):
+            raise WorkspaceInUseError(
+                f'Workspace {name!r} has live resources ({active}); '
+                'narrowing its policy now could strand them. Tear '
+                'them down first.')
+        conn = state.connection()
+        conn.execute(
+            'INSERT INTO workspaces (name, spec_json, created_at) '
+            'VALUES (?, ?, ?) ON CONFLICT(name) DO UPDATE SET '
+            'spec_json=excluded.spec_json',
+            (name, json.dumps(merged), int(time.time())))
+        conn.commit()
+        return get(name)
 
 
 def _narrows(current: Dict[str, Any], merged: Dict[str, Any]) -> bool:
@@ -224,17 +237,22 @@ def delete(name: str) -> None:
     _ensure_table()
     if name == DEFAULT_WORKSPACE:
         raise ValueError('The default workspace cannot be deleted.')
-    if get(name) is None:
-        raise ValueError(f'No workspace {name!r}.')
-    active = active_resources(name)
-    if any(active.values()):
-        raise WorkspaceInUseError(
-            f'Workspace {name!r} still has live resources '
-            f'({active["clusters"]} clusters, {active["storage"]} '
-            'storage objects); tear them down first.')
-    conn = state.connection()
-    conn.execute('DELETE FROM workspaces WHERE name=?', (name,))
-    conn.commit()
+    # Guards run under the same lock as the delete: a cluster launch
+    # registering into this workspace serializes on write_lock too, so
+    # the no-live-resources check can't go stale before the DELETE
+    # lands (same TOCTOU close as update()).
+    with state.write_lock():
+        if get(name) is None:
+            raise ValueError(f'No workspace {name!r}.')
+        active = active_resources(name)
+        if any(active.values()):
+            raise WorkspaceInUseError(
+                f'Workspace {name!r} still has live resources '
+                f'({active["clusters"]} clusters, {active["storage"]} '
+                'storage objects); tear them down first.')
+        conn = state.connection()
+        conn.execute('DELETE FROM workspaces WHERE name=?', (name,))
+        conn.commit()
 
 
 def allowed_clouds(name: str) -> Optional[List[str]]:

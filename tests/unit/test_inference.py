@@ -430,3 +430,119 @@ def test_tensor_parallel_engine_matches_unsharded(tiny):
     # must not be fully replicated on one device.
     wq = sharded.params['layers']['wq']
     assert len(wq.sharding.device_set) > 1
+
+
+def test_flash_prefill_matches_dense_prefill(tiny):
+    """VERDICT r4 #2: chunked prefill routed through the Pallas flash
+    kernel (q_offset mode — online softmax against the KV cache, kv
+    blocks past the causal frontier never fetched) must produce the
+    same logits and the same cache as the dense [.., T, S] path, for
+    mixed prompt lengths whose garbage rows exercise the masking
+    difference between the two paths."""
+    import numpy as np
+
+    from skypilot_tpu.inference import engine as eng
+
+    config, params = tiny
+    prompts = [list(range(3, 25)), list(range(40, 45))]
+    maxlen = 32
+    padded = jnp.array([p + [0] * (maxlen - len(p)) for p in prompts],
+                       jnp.int32)
+    lengths = jnp.array([len(p) for p in prompts], jnp.int32)
+    slots = jnp.arange(2, dtype=jnp.int32)
+
+    def run(use_flash):
+        cache = eng.init_cache(config, 2, 64)
+        return eng.prefill_chunked(params, padded, lengths, cache,
+                                   slots, config, chunk=8,
+                                   use_flash=use_flash)
+
+    logits_d, cache_d = run(False)
+    logits_f, cache_f = run(True)
+    np.testing.assert_allclose(np.asarray(logits_f),
+                               np.asarray(logits_d),
+                               rtol=2e-4, atol=2e-4)
+    # Cache must agree at every VISIBLE position (beyond each slot's
+    # length the two paths legitimately write different garbage).
+    for b, n in enumerate([len(p) for p in prompts]):
+        for name in ('k', 'v'):
+            np.testing.assert_allclose(
+                np.asarray(cache_f[name][:, b, :n]),
+                np.asarray(cache_d[name][:, b, :n]),
+                rtol=2e-4, atol=2e-4)
+    assert jnp.array_equal(cache_f['length'], cache_d['length'])
+
+
+@pytest.mark.parametrize('knobs', [
+    dict(sliding_window=6, sliding_window_pattern=2),
+    dict(attn_logit_softcap=50.0, query_pre_attn_scalar=16.0),
+])
+def test_flash_prefill_family_knobs_match_dense(tiny, knobs):
+    """Flash prefill under the family knobs that change the attention
+    math itself — Mistral/Gemma sliding windows (per-layer traced
+    scalars) and Gemma-2 logit softcapping — stays equivalent to the
+    dense path."""
+    import dataclasses
+
+    import numpy as np
+
+    from skypilot_tpu.inference import engine as eng
+
+    config, params = tiny
+    config = dataclasses.replace(config, **knobs)
+    prompts = [list(range(3, 25)), list(range(40, 45))]
+    maxlen = 32
+    padded = jnp.array([p + [0] * (maxlen - len(p)) for p in prompts],
+                       jnp.int32)
+    lengths = jnp.array([len(p) for p in prompts], jnp.int32)
+    slots = jnp.arange(2, dtype=jnp.int32)
+
+    def run(use_flash):
+        cache = eng.init_cache(config, 2, 64)
+        return eng.prefill_chunked(params, padded, lengths, cache,
+                                   slots, config, chunk=8,
+                                   use_flash=use_flash)
+
+    logits_d, _ = run(False)
+    logits_f, _ = run(True)
+    np.testing.assert_allclose(np.asarray(logits_f),
+                               np.asarray(logits_d),
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.slow
+def test_long_context_16k_prefill_and_context_sharded_decode(tiny):
+    """VERDICT r4 #3/#6: the long-context serving path at a length
+    where it matters. A 16k-token prompt runs through (a) the
+    unsharded engine — flash chunked prefill, the kernel's frontier
+    skipping doing real work across 8 chunks of 2048 — and (b) a
+    context-sharded engine (dense GSPMD path, cache sequence dim split
+    over the context axis), and both must greedy-decode the same
+    continuation."""
+    config, params = tiny
+    import dataclasses
+
+    from skypilot_tpu.parallel import MeshSpec, make_mesh
+
+    config = dataclasses.replace(config, max_seq_len=32768)
+    prompt_len = 16384
+    steps = 4
+    prompt = [int(i % 251) + 1 for i in range(prompt_len)]
+
+    flash_engine = inference.InferenceEngine(
+        params, config, batch_size=1, max_seq_len=prompt_len + 64,
+        prefill_chunk=2048, use_flash=True)
+    rid = flash_engine.submit(prompt, inference.SamplingParams(
+        temperature=0.0, max_new_tokens=steps))
+    flash_tokens = flash_engine.run_to_completion()[rid]
+    assert len(flash_tokens) == steps
+
+    mesh = make_mesh(MeshSpec(data=1, fsdp=4, context=2))
+    sharded = inference.InferenceEngine(
+        params, config, batch_size=1, max_seq_len=prompt_len + 64,
+        mesh=mesh, prefill_chunk=2048)
+    k = sharded.state.cache['k']
+    assert k.sharding.shard_shape(k.shape)[2] * 2 == k.shape[2]
+    rid = sharded.submit(prompt, inference.SamplingParams(
+        temperature=0.0, max_new_tokens=steps))
+    assert sharded.run_to_completion()[rid] == flash_tokens
