@@ -189,7 +189,7 @@ class GangBackend(backend_lib.Backend[ClusterHandle]):
             cloud.NAME, record_p.region, cluster_name_on_cloud,
             self._deploy_variables(cloud, launched, cluster_name_on_cloud,
                                    record_p))
-        rt = provisioner.post_provision_runtime_setup(
+        rt, epoch = provisioner.post_provision_runtime_setup(
             cloud.NAME, cluster_name, cluster_info,
             stream_logs=stream_logs)
         handle = ClusterHandle(
@@ -208,7 +208,7 @@ class GangBackend(backend_lib.Backend[ClusterHandle]):
         state.add_or_update_cluster(
             cluster_name, handle,
             repr(launched), task.num_nodes, ready=True,
-            cluster_hash=cluster_hash)
+            cluster_hash=cluster_hash, epoch=epoch)
         self._maybe_set_autostop(handle, launched)
         return handle
 

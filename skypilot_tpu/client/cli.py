@@ -141,9 +141,9 @@ def status(clusters, refresh):
     if not records:
         click.echo('No existing clusters.')
         return
-    fmt = '{:<20} {:<28} {:<10} {:<8} {}'
+    fmt = '{:<20} {:<28} {:<10} {:<8} {:<10} {}'
     click.echo(fmt.format('NAME', 'RESOURCES', 'STATUS', 'NODES',
-                          'AUTOSTOP'))
+                          'AUTOSTOP', 'HEARTBEAT'))
     from skypilot_tpu.utils import log_utils
     for r in records:
         autostop = r.get('autostop') or {}
@@ -155,7 +155,10 @@ def status(clusters, refresh):
         status_cell = log_utils.colorize_status(f'{r["status"]:<10}')
         click.echo(fmt.format(r['name'], r.get('resources_str') or '-',
                               status_cell, r.get('num_nodes') or 1,
-                              autostop_str))
+                              autostop_str,
+                              log_utils.heartbeat_str(
+                                  r.get('heartbeat_age_s'),
+                                  r.get('status'))))
 
 
 @cli.command()

@@ -59,6 +59,13 @@ def status(cluster_names: Optional[List[str]] = None,
                 state.update_cluster_status(r['name'], live)
                 r['status'] = live
         records = [r for r in records if r['status'] is not None]
+    # Liveness telemetry (skylet HeartbeatEvent), attached AFTER any
+    # refresh: reconciling a cluster to STOPPED drops its beat, and the
+    # returned records must agree with that.
+    heartbeats = state.get_heartbeats()
+    for r in records:
+        hb = heartbeats.get(r['name'])
+        r['heartbeat_age_s'] = hb['age_s'] if hb else None
     return records
 
 

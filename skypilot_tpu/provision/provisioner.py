@@ -13,7 +13,7 @@ import os
 import shlex
 import sys
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from skypilot_tpu import exceptions
 from skypilot_tpu import provision
@@ -116,15 +116,28 @@ def build_topology(cluster_name: str, cluster_info: common.ClusterInfo,
                 host['ssh_port'] = h.ssh_port
             hosts.append(host)
         nodes.append({'instance_id': inst.instance_id, 'hosts': hosts})
-    return {'cluster_name': cluster_name, 'nodes': nodes,
-            'epoch': epoch or uuid.uuid4().hex}
+    topology = {'cluster_name': cluster_name, 'nodes': nodes,
+                'epoch': epoch or uuid.uuid4().hex}
+    # Liveness heartbeats (skylet HeartbeatEvent -> POST /api/v1/
+    # heartbeat). The API server advertises its URL to executor
+    # workers via env (app._advertise_url); config wins for
+    # deployments where clusters reach the server through ingress.
+    from skypilot_tpu import config as config_lib
+    hb_url = config_lib.get_nested(('heartbeat', 'url'),
+                                   os.environ.get('SKYTPU_API_SERVER_URL'))
+    if hb_url:
+        topology['heartbeat'] = {'url': hb_url}
+    return topology
 
 
 def post_provision_runtime_setup(provider_name: str, cluster_name: str,
                                  cluster_info: common.ClusterInfo,
-                                 stream_logs: bool = False) -> str:
+                                 stream_logs: bool = False
+                                 ) -> Tuple[str, str]:
     """Make the cluster runnable: connectivity, topology file, package,
-    skylet. Returns the runtime dir. Idempotent."""
+    skylet. Returns (runtime dir, topology epoch). Idempotent. The
+    epoch is recorded in the cluster record so heartbeats from a
+    previous incarnation of a same-named cluster are rejected."""
     from skypilot_tpu.utils import rich_utils
     runners = provision.get_command_runners(provider_name, cluster_info)
     with rich_utils.safe_status(
@@ -164,7 +177,7 @@ def post_provision_runtime_setup(provider_name: str, cluster_name: str,
         # Optional external log shipping (config logs.store).
         from skypilot_tpu.logs import agent as logs_agent
         logs_agent.setup_agent_on_cluster(runners, rt, cluster_name)
-    return rt
+    return rt, topology['epoch']
 
 
 def _existing_epoch(head, local: bool, rt: str) -> Optional[str]:

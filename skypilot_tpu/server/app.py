@@ -504,6 +504,50 @@ async def _handle_health(request):
     })
 
 
+_HEARTBEAT_MAX_BYTES = 16 * 1024
+
+
+async def _handle_heartbeat(request):
+    """Cluster liveness heartbeat from a skylet (reference skylet
+    events.py:94 UsageHeartbeatReportEvent, re-pointed at our own
+    server). Unauthenticated by design — clusters don't hold user
+    tokens — so the handler only timestamps clusters the server
+    already knows about and caps the payload."""
+    from aiohttp import web
+    # Read to EOF or just past the cap (a single .read(n) may return a
+    # partial body when it spans several network reads).
+    chunks = []
+    remaining = _HEARTBEAT_MAX_BYTES + 1
+    while remaining > 0:
+        chunk = await request.content.read(remaining)
+        if not chunk:
+            break
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    raw = b''.join(chunks)
+    if len(raw) > _HEARTBEAT_MAX_BYTES:
+        raise web.HTTPRequestEntityTooLarge(
+            max_size=_HEARTBEAT_MAX_BYTES, actual_size=len(raw))
+    try:
+        body = json.loads(raw)
+    except ValueError:
+        body = None
+    if not isinstance(body, dict):
+        raise web.HTTPBadRequest(text='Heartbeat must be a JSON object.')
+    cluster_name = body.get('cluster_name')
+    if not isinstance(cluster_name, str) or not cluster_name:
+        raise web.HTTPBadRequest(text='Missing cluster_name.')
+    from skypilot_tpu import state as cluster_state
+    accepted = cluster_state.record_heartbeat(
+        cluster_name, str(body.get('epoch') or '') or None,
+        {'jobs': body.get('jobs') or {},
+         'skylet_pid': body.get('skylet_pid'),
+         'reported_time': body.get('time')})
+    if not accepted:
+        raise web.HTTPNotFound(text=f'Unknown cluster {cluster_name!r}.')
+    return _json_response({'recorded': True})
+
+
 async def _recover_orphans(app):
     """Server (re)start: controllers died with the previous process —
     restart them in resume mode (reference jobs controller is_resume).
@@ -551,6 +595,7 @@ def create_app():
     app.on_startup.append(_recover_orphans)
     app.on_startup.append(_state_dir_watchdog)
     app.router.add_get(f'{API_PREFIX}/health', _handle_health)
+    app.router.add_post(f'{API_PREFIX}/heartbeat', _handle_heartbeat)
     app.router.add_get('/dashboard', _handle_dashboard)
     app.router.add_get('/dashboard/login', _handle_login_page)
     app.router.add_post('/dashboard/api/login', _handle_login)
@@ -602,8 +647,30 @@ def create_app():
     return app
 
 
+def _advertise_url(host: str, port: int) -> None:
+    """Record the server's own URL so provisioning code (running in
+    forked executor workers, which inherit this env) can hand it to
+    clusters for heartbeats. SKYTPU_HEARTBEAT_URL overrides when the
+    bound address isn't what clusters can reach (e.g. behind ingress)."""
+    advertised = os.environ.get('SKYTPU_HEARTBEAT_URL')
+    if not advertised:
+        if host in ('0.0.0.0', '::'):
+            # A wildcard bind means remote clusters exist that can't
+            # reach "127.0.0.1" — advertising it would silently kill
+            # heartbeats in exactly the multi-machine deployment they
+            # exist for. Local clusters still work; warn the operator.
+            logging.getLogger(__name__).warning(
+                'Server bound to %s without SKYTPU_HEARTBEAT_URL (or '
+                'config heartbeat.url): remote clusters cannot report '
+                'liveness heartbeats; local ones still can.', host)
+            host = '127.0.0.1'
+        advertised = f'http://{host}:{port}'
+    os.environ['SKYTPU_API_SERVER_URL'] = advertised
+
+
 def run(host: str = '127.0.0.1', port: int = DEFAULT_PORT) -> None:
     from aiohttp import web
+    _advertise_url(host, port)
     web.run_app(create_app(), host=host, port=port, print=None)
 
 
@@ -615,6 +682,7 @@ class ServerThread:
         self._loop = None
         self._runner = None
         self._thread = None
+        self._prev_advertised = os.environ.get('SKYTPU_API_SERVER_URL')
 
     def __enter__(self) -> 'ServerThread':
         import threading
@@ -633,6 +701,7 @@ class ServerThread:
                 await site.start()
                 sock = site._server.sockets[0]  # noqa: SLF001
                 self.port = sock.getsockname()[1]
+                _advertise_url('127.0.0.1', self.port)
             self._loop.run_until_complete(_start())
             ready.set()
             self._loop.run_forever()
@@ -647,6 +716,12 @@ class ServerThread:
         return f'http://127.0.0.1:{self.port}'
 
     def __exit__(self, *exc) -> None:
+        # Undo _advertise_url: a later build_topology in this process
+        # must not embed this (now dead) ephemeral port.
+        if self._prev_advertised is None:
+            os.environ.pop('SKYTPU_API_SERVER_URL', None)
+        else:
+            os.environ['SKYTPU_API_SERVER_URL'] = self._prev_advertised
         if self._loop is not None:
             async def _stop():
                 if self._runner is not None:

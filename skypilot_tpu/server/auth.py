@@ -19,8 +19,10 @@ API_VERSION = 1
 VERSION_HEADER = 'X-Skytpu-Api-Version'
 
 # Paths every client may hit without auth (health is the handshake;
-# the login pair is how browsers GET a credential in the first place).
-_OPEN_PATHS = ('/api/v1/health', '/dashboard/login',
+# the login pair is how browsers GET a credential in the first place;
+# heartbeat is cluster telemetry — skylets hold no user tokens, and the
+# handler only timestamps clusters the server already knows).
+_OPEN_PATHS = ('/api/v1/health', '/api/v1/heartbeat', '/dashboard/login',
                '/dashboard/api/login')
 
 # Browser session cookie set by /dashboard/api/login (HttpOnly).

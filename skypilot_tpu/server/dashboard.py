@@ -24,10 +24,18 @@ from skypilot_tpu.server import requests_db
 def summary() -> Dict[str, Any]:
     """Everything the SPA's list views show, in one JSON document."""
     from skypilot_tpu import state as cluster_state
+    from skypilot_tpu.utils import log_utils
+    heartbeats = cluster_state.get_heartbeats()
+
+    def _hb(rec):
+        hb = heartbeats.get(rec['name'])
+        return log_utils.heartbeat_str(hb['age_s'] if hb else None,
+                                       rec['status'].value)
+
     clusters = [{
         'name': r['name'], 'workspace': r['workspace'],
         'status': r['status'].value, 'resources': r['resources_str'],
-        'nodes': r['num_nodes'],
+        'nodes': r['num_nodes'], 'heartbeat': _hb(r),
     } for r in cluster_state.get_clusters(all_workspaces=True)]
 
     jobs: List[Dict[str, Any]] = []
@@ -285,7 +293,8 @@ textarea.cfg-edit{width:100%;min-height:220px;background:#0d1117;
 _JS = """
 const OK=['UP','READY','RUNNING','SUCCEEDED','enabled'],
       BAD=['FAILED','FAILED_NO_RESOURCE','FAILED_CONTROLLER','NOT_READY'],
-      TABS={clusters:['name','workspace','status','resources','nodes'],
+      TABS={clusters:['name','workspace','status','resources','nodes',
+                      'heartbeat'],
             jobs:['id','name','status','recoveries','log'],
             services:['name','status','endpoint','log'],
             requests:['id','name','status','log'],

@@ -1,12 +1,15 @@
 """Skylet daemon events, ticked by skylet.py.
 
 Reference analog: sky/skylet/events.py:65-243 (AutostopEvent,
-JobSchedulerEvent, ...).
+JobSchedulerEvent, UsageHeartbeatReportEvent :94).
 """
+import json
+import os
 import time
 import traceback
 
 from skypilot_tpu.skylet import autostop_lib
+from skypilot_tpu.skylet import constants
 from skypilot_tpu.skylet import job_lib
 
 
@@ -46,3 +49,56 @@ class AutostopEvent(SkyletEvent):
     def _run(self) -> None:
         if autostop_lib.should_autostop(self.rt):
             autostop_lib.execute_autostop(self.rt)
+
+
+class HeartbeatEvent(SkyletEvent):
+    """POST a liveness/usage heartbeat to the API server.
+
+    Reference analog: sky/skylet/events.py:94
+    (UsageHeartbeatReportEvent, which ships a heartbeat message to the
+    usage endpoint every 600s). Ours targets the framework's own API
+    server — the topology file carries the server URL at provision time
+    — so `tsky status` and the dashboard can tell a live cluster from a
+    stale record without a cloud probe. Best-effort: a missing/
+    unreachable server must never disturb the daemon.
+    """
+    EVENT_INTERVAL_SECONDS = 60
+
+    def _run(self) -> None:
+        try:
+            with open(constants.topology_path(self.rt), 'r',
+                      encoding='utf-8') as f:
+                topology = json.load(f)
+        except (OSError, ValueError):
+            return
+        url = (topology.get('heartbeat') or {}).get('url')
+        if not url:
+            return
+        counts = {}
+        try:
+            for job in job_lib.get_jobs(self.rt):
+                status = job['status'].value
+                counts[status] = counts.get(status, 0) + 1
+        except Exception:  # noqa: BLE001 — job DB may not exist yet
+            pass
+        payload = {
+            'cluster_name': topology.get('cluster_name'),
+            'epoch': topology.get('epoch'),
+            'time': time.time(),
+            'skylet_pid': os.getpid(),
+            'jobs': counts,
+        }
+        self._post(url.rstrip('/') + '/api/v1/heartbeat', payload)
+
+    @staticmethod
+    def _post(endpoint: str, payload: dict) -> None:
+        import urllib.request
+        try:
+            req = urllib.request.Request(
+                endpoint, data=json.dumps(payload).encode(),
+                headers={'Content-Type': 'application/json'},
+                method='POST')
+            with urllib.request.urlopen(req, timeout=5):
+                pass
+        except Exception:  # noqa: BLE001 — liveness must never break skylet
+            pass

@@ -22,7 +22,8 @@ def main() -> None:
     with open(constants.skylet_pid_path(rt), 'w', encoding='utf-8') as f:
         f.write(str(os.getpid()))
 
-    evts = [events.JobSchedulerEvent(rt), events.AutostopEvent(rt)]
+    evts = [events.JobSchedulerEvent(rt), events.AutostopEvent(rt),
+            events.HeartbeatEvent(rt)]
     epoch = constants.topology_epoch(rt)
     while True:
         # The topology file IS the cluster (written once per provision,

@@ -152,12 +152,21 @@ def _create_tables(conn: sqlite3.Connection) -> None:
             last_use TEXT,
             workspace TEXT DEFAULT 'default'
         )""")
-    # Migration for pre-workspace DBs.
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS heartbeats (
+            cluster_name TEXT PRIMARY KEY,
+            last_seen REAL,
+            epoch TEXT,
+            payload TEXT
+        )""")
+    # Migrations for pre-workspace / pre-heartbeat DBs.
     cols = [r[1] for r in conn.execute('PRAGMA table_info(clusters)')]
     if 'workspace' not in cols:
         conn.execute(
             "ALTER TABLE clusters ADD COLUMN workspace TEXT "
             "DEFAULT 'default'")
+    if 'epoch' not in cols:
+        conn.execute('ALTER TABLE clusters ADD COLUMN epoch TEXT')
     conn.commit()
 
 
@@ -167,35 +176,40 @@ def add_or_update_cluster(cluster_name: str, handle: Any,
                           requested_resources_str: str, num_nodes: int,
                           ready: bool,
                           autostop: Optional[Dict[str, Any]] = None,
-                          cluster_hash: Optional[str] = None) -> None:
+                          cluster_hash: Optional[str] = None,
+                          epoch: Optional[str] = None) -> None:
     conn = _get_conn()
     status = ClusterStatus.UP if ready else ClusterStatus.INIT
     now = int(time.time())
     with _lock:
         existing = conn.execute(
-            'SELECT launched_at FROM clusters WHERE name=?',
+            'SELECT launched_at, epoch FROM clusters WHERE name=?',
             (cluster_name,)).fetchone()
         launched_at = existing[0] if existing else now
+        # Keep a known epoch when the caller has none (e.g. a status
+        # update that didn't re-run provisioning).
+        epoch = epoch or (existing[1] if existing else None)
         conn.execute(
             """INSERT INTO clusters
                (name, launched_at, handle, last_use, status, autostop_json,
                 owner, workspace, cluster_hash, resources_json, num_nodes,
-                to_down)
-               VALUES (?,?,?,?,?,?,?,?,?,?,?,?)
+                to_down, epoch)
+               VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)
                ON CONFLICT(name) DO UPDATE SET
                  handle=excluded.handle, last_use=excluded.last_use,
                  status=excluded.status,
                  autostop_json=excluded.autostop_json,
                  cluster_hash=excluded.cluster_hash,
                  resources_json=excluded.resources_json,
-                 num_nodes=excluded.num_nodes""",
+                 num_nodes=excluded.num_nodes,
+                 epoch=excluded.epoch""",
             (cluster_name, launched_at, pickle.dumps(handle),
              str(int(now)), status.value,
              json.dumps(autostop) if autostop else None,
              os.environ.get('SKYTPU_USER') or os.environ.get(
                  'USER', 'unknown'),
              active_workspace(), cluster_hash,
-             requested_resources_str, num_nodes, 0))
+             requested_resources_str, num_nodes, 0, epoch))
         conn.commit()
 
 
@@ -205,6 +219,11 @@ def update_cluster_status(cluster_name: str,
     with _lock:
         conn.execute('UPDATE clusters SET status=? WHERE name=?',
                      (status.value, cluster_name))
+        if status != ClusterStatus.UP:
+            # A stopped cluster's silence is expected: drop the beat so
+            # status shows '-' instead of an ever-growing age.
+            conn.execute('DELETE FROM heartbeats WHERE cluster_name=?',
+                         (cluster_name,))
         conn.commit()
 
 
@@ -256,6 +275,10 @@ def remove_cluster(cluster_name: str, terminate: bool) -> None:
             conn.execute(
                 'UPDATE clusters SET status=?, handle=handle WHERE name=?',
                 (ClusterStatus.STOPPED.value, cluster_name))
+        # Either way the skylet is gone (or expected silent): drop the
+        # beat so status shows '-' instead of an ever-growing age.
+        conn.execute('DELETE FROM heartbeats WHERE cluster_name=?',
+                     (cluster_name,))
         conn.commit()
 
 
@@ -321,6 +344,59 @@ def get_cluster_history() -> List[Dict[str, Any]]:
     return [{'cluster_hash': r[0], 'name': r[1], 'launched_at': r[2],
              'duration_s': r[3], 'resources_str': r[4], 'num_nodes': r[5]}
             for r in rows]
+
+
+# --- cluster liveness heartbeats (reference skylet events.py:94
+# UsageHeartbeatReportEvent; ours lands in the state DB so status/
+# dashboard can tell a live cluster record from a stale one) -----------------
+
+def record_heartbeat(cluster_name: str, epoch: Optional[str],
+                     payload: Optional[Dict[str, Any]] = None) -> bool:
+    """Record a liveness heartbeat. Only known clusters are accepted,
+    and when the cluster record carries a provision epoch the beat must
+    match it — a leaked skylet from a previous incarnation of a
+    same-named cluster (or a forger on the unauthenticated endpoint,
+    who can't know the random epoch) must not keep the record looking
+    live. Returns False when refused."""
+    conn = _get_conn()
+    with _lock:
+        known = conn.execute(
+            'SELECT epoch FROM clusters WHERE name=?',
+            (cluster_name,)).fetchone()
+        if not known:
+            return False
+        expected_epoch = known[0]
+        if expected_epoch and epoch != expected_epoch:
+            return False
+        conn.execute(
+            """INSERT INTO heartbeats (cluster_name, last_seen, epoch,
+                                       payload)
+               VALUES (?,?,?,?)
+               ON CONFLICT(cluster_name) DO UPDATE SET
+                 last_seen=excluded.last_seen, epoch=excluded.epoch,
+                 payload=excluded.payload""",
+            (cluster_name, time.time(), epoch,
+             json.dumps(payload) if payload else None))
+        conn.commit()
+    return True
+
+
+def get_heartbeats() -> Dict[str, Dict[str, Any]]:
+    """cluster_name -> {last_seen, age_s, epoch, payload}."""
+    conn = _get_conn()
+    rows = conn.execute(
+        'SELECT cluster_name, last_seen, epoch, payload '
+        'FROM heartbeats').fetchall()
+    now = time.time()
+    out = {}
+    for name, last_seen, epoch, payload in rows:
+        out[name] = {
+            'last_seen': last_seen,
+            'age_s': max(0.0, now - last_seen),
+            'epoch': epoch,
+            'payload': json.loads(payload) if payload else None,
+        }
+    return out
 
 
 # --- storage registry (reference global_user_state storage table :104) ------

@@ -112,6 +112,25 @@ class ProvisionLogProcessor(LineProcessor):
             self.errors.append(line.strip())
 
 
+# A cluster whose skylet has gone quiet for this long is flagged stale
+# (HeartbeatEvent ticks every 60s; 3 missed beats + slack).
+HEARTBEAT_STALE_SECONDS = 240.0
+
+
+def heartbeat_str(age_s: Optional[float], status: Optional[str] = None
+                  ) -> str:
+    """Render a liveness-heartbeat age for status tables: '32s ago',
+    '5m ago (stale)', or '-' when the cluster has never reported (a
+    STOPPED cluster's silence is expected, not stale)."""
+    if age_s is None:
+        return '-'
+    now = time.time()
+    rendered = readable_time_duration(now - age_s, now) + ' ago'
+    if age_s > HEARTBEAT_STALE_SECONDS and status not in ('STOPPED', None):
+        rendered += ' (stale)'
+    return rendered
+
+
 def readable_time_duration(start: Optional[float],
                            end: Optional[float] = None,
                            absolute: bool = False) -> str:

@@ -1,0 +1,203 @@
+"""Cluster liveness heartbeats: skylet event -> API server -> status.
+
+Reference analog: sky/skylet/events.py:94 (UsageHeartbeatReportEvent) —
+the reference ships heartbeats to its usage endpoint; ours land in the
+API server's state DB so `tsky status` and the dashboard can tell a
+live cluster record from a stale one.
+"""
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from skypilot_tpu import state
+from skypilot_tpu.server import app as app_mod
+from skypilot_tpu.server import requests_db
+from skypilot_tpu.skylet import constants as skylet_constants
+from skypilot_tpu.skylet import events
+from skypilot_tpu.utils import log_utils
+
+
+@pytest.fixture
+def server():
+    requests_db.reset_for_tests()
+    with app_mod.ServerThread() as srv:
+        yield srv
+    requests_db.reset_for_tests()
+
+
+def _register_cluster(name='hb-test'):
+    state.add_or_update_cluster(name, handle=None,
+                                requested_resources_str='local',
+                                num_nodes=1, ready=True)
+    return name
+
+
+def _post_heartbeat(url, payload):
+    req = urllib.request.Request(
+        f'{url}/api/v1/heartbeat', data=json.dumps(payload).encode(),
+        headers={'Content-Type': 'application/json'}, method='POST')
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return resp.status
+
+
+class TestHeartbeatEndpoint:
+
+    def test_known_cluster_recorded(self, server):
+        name = _register_cluster()
+        status = _post_heartbeat(server.url, {
+            'cluster_name': name, 'epoch': 'e1',
+            'jobs': {'RUNNING': 2}, 'skylet_pid': 1234,
+            'time': time.time()})
+        assert status == 200
+        beats = state.get_heartbeats()
+        assert name in beats
+        assert beats[name]['age_s'] < 60
+        assert beats[name]['epoch'] == 'e1'
+        assert beats[name]['payload']['jobs'] == {'RUNNING': 2}
+
+    def test_stale_incarnation_refused(self, server):
+        """A leaked skylet from a torn-down incarnation (old epoch)
+        must not keep the re-provisioned record looking live."""
+        name = 'hb-epoch'
+        state.add_or_update_cluster(name, handle=None,
+                                    requested_resources_str='local',
+                                    num_nodes=1, ready=True,
+                                    epoch='current-epoch')
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post_heartbeat(server.url, {
+                'cluster_name': name, 'epoch': 'old-epoch'})
+        assert err.value.code == 404
+        assert name not in state.get_heartbeats()
+        assert _post_heartbeat(server.url, {
+            'cluster_name': name, 'epoch': 'current-epoch'}) == 200
+        assert name in state.get_heartbeats()
+
+    def test_unknown_cluster_refused(self, server):
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post_heartbeat(server.url, {'cluster_name': 'nope'})
+        assert err.value.code == 404
+        assert state.get_heartbeats() == {}
+
+    def test_no_auth_required(self, server):
+        """Skylets hold no user tokens: the endpoint must stay open
+        even when the server has users configured (auth._OPEN_PATHS)."""
+        from skypilot_tpu.server import auth
+        assert '/api/v1/heartbeat' in auth._OPEN_PATHS  # noqa: SLF001
+
+    def test_oversized_payload_refused(self, server):
+        name = _register_cluster()
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post_heartbeat(server.url, {
+                'cluster_name': name, 'junk': 'x' * 32768})
+        assert err.value.code == 413
+
+    def test_non_object_refused(self, server):
+        req = urllib.request.Request(
+            f'{server.url}/api/v1/heartbeat', data=b'[1,2]',
+            headers={'Content-Type': 'application/json'}, method='POST')
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req, timeout=10)
+        assert err.value.code == 400
+
+
+class TestSkyletHeartbeatEvent:
+
+    def _write_topology(self, tmp_path, name, url):
+        rt = tmp_path / 'rt'
+        rt.mkdir(exist_ok=True)
+        topology = {'cluster_name': name, 'epoch': 'ep-1', 'nodes': [],
+                    'heartbeat': {'url': url}}
+        with open(skylet_constants.topology_path(str(rt)), 'w',
+                  encoding='utf-8') as f:
+            json.dump(topology, f)
+        return str(rt)
+
+    def test_event_posts_to_server(self, server, tmp_path):
+        name = _register_cluster('hb-skylet')
+        rt = self._write_topology(tmp_path, name, server.url)
+        events.HeartbeatEvent(rt)._run()  # noqa: SLF001
+        beats = state.get_heartbeats()
+        assert name in beats
+        assert beats[name]['epoch'] == 'ep-1'
+
+    def test_event_without_url_is_noop(self, tmp_path):
+        rt = tmp_path / 'rt'
+        rt.mkdir()
+        topology = {'cluster_name': 'c', 'epoch': 'e', 'nodes': []}
+        with open(skylet_constants.topology_path(str(rt)), 'w',
+                  encoding='utf-8') as f:
+            json.dump(topology, f)
+        events.HeartbeatEvent(str(rt))._run()  # noqa: SLF001
+
+    def test_event_survives_dead_server(self, tmp_path):
+        name = 'hb-dead'
+        rt = self._write_topology(tmp_path, name,
+                                  'http://127.0.0.1:1/')
+        events.HeartbeatEvent(str(rt))._run()  # noqa: SLF001 — no raise
+
+
+class TestStatusSurfacing:
+
+    def test_core_status_attaches_age(self, server):
+        name = _register_cluster('hb-status')
+        _post_heartbeat(server.url, {'cluster_name': name})
+        from skypilot_tpu import core
+        rec = [r for r in core.status() if r['name'] == name][0]
+        assert rec['heartbeat_age_s'] is not None
+        assert rec['heartbeat_age_s'] < 60
+        other = _register_cluster('hb-silent')
+        rec = [r for r in core.status() if r['name'] == other][0]
+        assert rec['heartbeat_age_s'] is None
+
+    def test_heartbeat_str_rendering(self):
+        assert log_utils.heartbeat_str(None) == '-'
+        assert log_utils.heartbeat_str(5, 'UP') == '5s ago'
+        assert log_utils.heartbeat_str(120, 'UP') == '2m ago'
+        assert 'stale' in log_utils.heartbeat_str(600, 'UP')
+        # A stopped cluster's silence is expected, not stale.
+        assert 'stale' not in log_utils.heartbeat_str(600, 'STOPPED')
+
+    def test_dashboard_summary_includes_heartbeat(self, server):
+        name = _register_cluster('hb-dash')
+        _post_heartbeat(server.url, {'cluster_name': name})
+        from skypilot_tpu.server import dashboard
+        row = [c for c in dashboard.summary()['clusters']
+               if c['name'] == name][0]
+        assert row['heartbeat'].endswith('ago')
+
+    def test_removal_clears_heartbeat(self, server):
+        name = _register_cluster('hb-gone')
+        _post_heartbeat(server.url, {'cluster_name': name})
+        state.remove_cluster(name, terminate=True)
+        assert name not in state.get_heartbeats()
+
+    def test_stop_clears_heartbeat(self, server):
+        """Both stop paths (teardown + refresh reconciliation) must
+        drop the beat: a STOPPED cluster's age must not grow forever."""
+        name = _register_cluster('hb-stop')
+        _post_heartbeat(server.url, {'cluster_name': name})
+        state.remove_cluster(name, terminate=False)
+        assert name not in state.get_heartbeats()
+        _post_heartbeat(server.url, {'cluster_name': name})
+        state.update_cluster_status(name, state.ClusterStatus.STOPPED)
+        assert name not in state.get_heartbeats()
+
+
+class TestTopologyPlumbing:
+
+    def test_build_topology_embeds_url(self, monkeypatch):
+        from skypilot_tpu.provision import common as provision_common
+        from skypilot_tpu.provision import provisioner
+        monkeypatch.setenv('SKYTPU_API_SERVER_URL',
+                           'http://127.0.0.1:9999')
+        info = provision_common.ClusterInfo(
+            instances={}, head_instance_id=None, provider_name='local',
+            provider_config={'runtime_dir': '/tmp/x'})
+        topo = provisioner.build_topology('c1', info)
+        assert topo['heartbeat'] == {'url': 'http://127.0.0.1:9999'}
+        monkeypatch.delenv('SKYTPU_API_SERVER_URL')
+        topo = provisioner.build_topology('c1', info)
+        assert 'heartbeat' not in topo
