@@ -7,6 +7,7 @@ stream its log. The server process is shared by all clients on a machine
 (auto-started by the SDK, sky/server/common.py pattern).
 """
 import asyncio
+import functools
 import json
 import logging
 import os
@@ -538,11 +539,17 @@ async def _handle_heartbeat(request):
     if not isinstance(cluster_name, str) or not cluster_name:
         raise web.HTTPBadRequest(text='Missing cluster_name.')
     from skypilot_tpu import state as cluster_state
-    accepted = cluster_state.record_heartbeat(
-        cluster_name, str(body.get('epoch') or '') or None,
-        {'jobs': body.get('jobs') or {},
-         'skylet_pid': body.get('skylet_pid'),
-         'reported_time': body.get('time')})
+    # In an executor: the sqlite write (lock + commit, 30s busy
+    # timeout) must not stall the event loop — least of all on an
+    # unauthenticated endpoint.
+    loop = asyncio.get_running_loop()
+    accepted = await loop.run_in_executor(
+        None, functools.partial(
+            cluster_state.record_heartbeat,
+            cluster_name, str(body.get('epoch') or '') or None,
+            {'jobs': body.get('jobs') or {},
+             'skylet_pid': body.get('skylet_pid'),
+             'reported_time': body.get('time')}))
     if not accepted:
         raise web.HTTPNotFound(text=f'Unknown cluster {cluster_name!r}.')
     return _json_response({'recorded': True})

@@ -352,22 +352,32 @@ def get_cluster_history() -> List[Dict[str, Any]]:
 
 def record_heartbeat(cluster_name: str, epoch: Optional[str],
                      payload: Optional[Dict[str, Any]] = None) -> bool:
-    """Record a liveness heartbeat. Only known clusters are accepted,
-    and when the cluster record carries a provision epoch the beat must
-    match it — a leaked skylet from a previous incarnation of a
-    same-named cluster (or a forger on the unauthenticated endpoint,
-    who can't know the random epoch) must not keep the record looking
-    live. Returns False when refused."""
+    """Record a liveness heartbeat. Only known, non-STOPPED clusters
+    are accepted (a skylet outliving `tsky stop` by a couple of minutes
+    must not resurrect the beat the stop just dropped), and when the
+    cluster record carries a provision epoch the beat must match it —
+    a leaked skylet from a previous incarnation of a same-named cluster
+    (or a forger on the unauthenticated endpoint, who can't know the
+    random epoch) must not keep the record looking live. Pre-epoch
+    records (migrated DBs) adopt the first reported epoch, so the
+    protection reaches clusters provisioned before the column existed.
+    Returns False when refused."""
     conn = _get_conn()
     with _lock:
         known = conn.execute(
-            'SELECT epoch FROM clusters WHERE name=?',
+            'SELECT epoch, status FROM clusters WHERE name=?',
             (cluster_name,)).fetchone()
         if not known:
             return False
-        expected_epoch = known[0]
+        expected_epoch, status = known
+        if status == ClusterStatus.STOPPED.value:
+            return False
         if expected_epoch and epoch != expected_epoch:
             return False
+        if not expected_epoch and epoch:
+            # Trust-on-first-use backfill for pre-epoch records.
+            conn.execute('UPDATE clusters SET epoch=? WHERE name=?',
+                         (epoch, cluster_name))
         conn.execute(
             """INSERT INTO heartbeats (cluster_name, last_seen, epoch,
                                        payload)

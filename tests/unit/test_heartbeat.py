@@ -181,9 +181,23 @@ class TestStatusSurfacing:
         _post_heartbeat(server.url, {'cluster_name': name})
         state.remove_cluster(name, terminate=False)
         assert name not in state.get_heartbeats()
-        _post_heartbeat(server.url, {'cluster_name': name})
-        state.update_cluster_status(name, state.ClusterStatus.STOPPED)
+        # A skylet outliving the stop by a couple of minutes must not
+        # resurrect the beat the stop just dropped.
+        with pytest.raises(urllib.error.HTTPError):
+            _post_heartbeat(server.url, {'cluster_name': name})
         assert name not in state.get_heartbeats()
+
+    def test_epoch_backfill_on_first_beat(self, server):
+        """Pre-epoch records (migrated DBs) adopt the first reported
+        epoch, locking out other epochs from then on."""
+        name = _register_cluster('hb-tofu')  # no epoch on the record
+        assert _post_heartbeat(server.url, {
+            'cluster_name': name, 'epoch': 'first'}) == 200
+        with pytest.raises(urllib.error.HTTPError):
+            _post_heartbeat(server.url, {
+                'cluster_name': name, 'epoch': 'second'})
+        assert _post_heartbeat(server.url, {
+            'cluster_name': name, 'epoch': 'first'}) == 200
 
 
 class TestTopologyPlumbing:
