@@ -16,10 +16,13 @@ reached only through user DeepSpeed recipes). TPU-native design:
   DCN — `pipe` sits outer in the mesh for exactly that reason), and it
   is differentiable: jax.grad produces the reverse schedule without a
   hand-written backward pass.
-
-Composes with batch-dim sharding (`data`/`fsdp` on the microbatch dim)
-inside the same shard_map; tensor/context parallelism operate within a
-stage and are not combined with `pipe` here.
+- The shard_map is PARTIAL-MANUAL (`axis_names={'pipe'}`): only the
+  pipeline axis is manual; every other mesh axis (data/fsdp/tensor/
+  context) stays in GSPMD auto mode INSIDE the stage program, so
+  layer_fn's sharding constraints partition each stage's compute over
+  tensor/context and its microbatch over data/fsdp — the full
+  pp x tp x sp x dp factorization of a 405B-class run in one mesh,
+  with XLA inserting the intra-stage collectives.
 """
 from __future__ import annotations
 
@@ -64,25 +67,20 @@ def pipeline_apply(layer_fn: Callable[[Any, jax.Array], jax.Array],
     x_mb = x.reshape(m, batch // m, *x.shape[1:])
 
     from jax.sharding import PartitionSpec as P
-    batch_axes = tuple(a for a in ('data', 'fsdp') if a in mesh.shape)
-    batch_div = 1
-    for a in batch_axes:
-        batch_div *= mesh.shape[a]
-    if (batch // m) % max(batch_div, 1):
-        batch_axes = ()  # tiny test batches: replicate instead
-    mb_spec = P(None, batch_axes or None)
+    # Partial-manual: specs only place the MANUAL `pipe` axis (params'
+    # stacked layer dim; the output's per-stage slot dim). Every other
+    # mesh axis stays auto — GSPMD propagates/constrains data/fsdp/
+    # tensor/context shardings straight through the stage program.
+    param_spec = jax.tree.map(lambda _: P('pipe'), stacked_params)
     # Output gains a leading `pipe` dim (one slot per stage); only the
     # last stage's slot holds finished microbatches — sliced below,
     # which avoids an all_gather inside the pipeline body.
-    out_spec = P('pipe', None, batch_axes or None)
-    param_spec = jax.tree.map(lambda _: P('pipe'), stacked_params)
-
     fn = functools.partial(_stage_program, layer_fn=layer_fn,
                            num_stages=num_stages, num_microbatches=m)
     mapped = jax.shard_map(
-        fn, mesh=mesh,
-        in_specs=(param_spec, mb_spec),
-        out_specs=out_spec)
+        fn, mesh=mesh, axis_names=frozenset({'pipe'}),
+        in_specs=(param_spec, P()),
+        out_specs=P('pipe'))
     out_mb = mapped(stacked_params, x_mb)[num_stages - 1]
     return out_mb.reshape(batch, *x.shape[1:])
 
@@ -162,9 +160,10 @@ def llama_pipeline_forward(params: Any, tokens: jax.Array, config: Any,
     """llama.forward with the layer stack pipelined over `pipe`.
 
     Embedding / final norm / lm_head are tiny next to the layer stack
-    and run replicated on every stage; attention inside a stage runs
-    without mesh collectives (pipe composes with batch-dim sharding,
-    not tensor/context parallelism).
+    and run replicated on every stage. Inside a stage the layer runs
+    with its normal sharding constraints over the mesh's AUTO axes
+    (partial-manual shard_map), so pp composes with tensor/context/
+    data/fsdp parallelism in one mesh — the 405B factorization.
     """
     from skypilot_tpu.models import llama
 
@@ -172,10 +171,13 @@ def llama_pipeline_forward(params: Any, tokens: jax.Array, config: Any,
     positions = jnp.arange(tokens.shape[1])
     x = llama._embed_lookup(  # noqa: SLF001
         params['embed'].astype(c.dtype), tokens, None)
+    # Non-pipe axes are auto inside the stage program: hand the mesh to
+    # the layer so attention/mlp keep their tensor/context constraints.
+    inner_mesh = mesh if len(dict(mesh.shape)) > 1 else None
 
     def layer_fn(layer_params, h):
         return llama._layer(h, layer_params, config=c,  # noqa: SLF001
-                            positions=positions, mesh=None)
+                            positions=positions, mesh=inner_mesh)
 
     if c.remat:
         layer_fn_wrapped = jax.checkpoint(

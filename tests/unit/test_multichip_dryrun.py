@@ -1,0 +1,46 @@
+"""The driver's multichip dryrun contract, at and beyond its n=8 scale.
+
+`__graft_entry__.dryrun_multichip(8)` is what the round driver runs on
+a virtual 8-device CPU mesh; the slow n=16 case adds the 405B-shaped
+factorization (pipe x tensor x context x fsdp ALL >1 in one mesh —
+VERDICT r4 #9) that n=8 cannot express. Each case runs in a fresh
+subprocess because the XLA virtual device count is fixed at backend
+init (this pytest process is pinned to 8 by conftest).
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _run_dryrun(n_devices: int) -> str:
+    env = dict(os.environ)
+    env['PALLAS_AXON_POOL_IPS'] = ''   # skip axon registration entirely
+    env['JAX_PLATFORMS'] = 'cpu'
+    env['XLA_FLAGS'] = (
+        f'--xla_force_host_platform_device_count={n_devices}')
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, '__graft_entry__.py'),
+         str(n_devices)],
+        env=env, cwd=_REPO, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    return proc.stdout
+
+
+@pytest.mark.slow
+def test_dryrun_16_devices_405b_shaped():
+    out = _run_dryrun(16)
+    assert '405b-shaped (pp=2, tp=2, sp=2, fsdp=2)' in out, out
+    assert 'OK' in out
+
+
+@pytest.mark.slow
+def test_dryrun_8_devices_driver_contract():
+    out = _run_dryrun(8)
+    assert 'tp/sp/dp/fsdp + pp + ep + serve-tp OK' in out, out
+    # n=8 must NOT attempt the 16-device factorization.
+    assert '405b-shaped' not in out
