@@ -24,6 +24,7 @@ no-auth-proxy default.
 """
 import dataclasses
 import hmac
+import os
 from typing import Dict, List, Optional
 
 ROLE_ADMIN = 'admin'
@@ -63,24 +64,43 @@ def configured_users_from_config() -> List[User]:
     return users
 
 
+def bootstrap_admin() -> Optional[User]:
+    """Deployment bootstrap credential: containerized servers (the Helm
+    chart's auth Secret) inject SKYTPU_BOOTSTRAP_ADMIN_TOKEN so a fresh
+    install has exactly one admin, who then creates real users over the
+    API. Config/DB users named 'admin' shadow it."""
+    token = os.environ.get('SKYTPU_BOOTSTRAP_ADMIN_TOKEN')
+    if not token:
+        return None
+    return User(name='admin', role=ROLE_ADMIN, token=token)
+
+
 def configured_users() -> List[User]:
     """All users the auth layer accepts: config-declared plus enabled
-    DB users (users/store.py CRUD); config wins on name collisions."""
+    DB users (users/store.py CRUD) plus the env bootstrap admin;
+    config wins on name collisions."""
     users = configured_users_from_config()
     names = {u.name for u in users}
     from skypilot_tpu.users import store
     users.extend(u for u in store.enabled_db_users()
                  if u.name not in names)
+    names = {u.name for u in users}
+    boot = bootstrap_admin()
+    if boot is not None and boot.name not in names:
+        users.append(boot)
     return users
 
 
 def auth_required() -> bool:
-    """Auth posture comes from the CONFIG only (the flag or declared
-    users). API-created DB users deliberately don't flip it: an admin
-    adding a user in open local mode must not lock every tokenless
-    client (themselves included) out of the server."""
+    """Auth posture comes from the CONFIG (the flag or declared users)
+    or a deployment bootstrap token. API-created DB users deliberately
+    don't flip it: an admin adding a user in open local mode must not
+    lock every tokenless client (themselves included) out of the
+    server."""
     from skypilot_tpu import config as config_lib
     if config_lib.get_nested(('api_server', 'auth'), default=False):
+        return True
+    if bootstrap_admin() is not None:
         return True
     return bool(configured_users_from_config())
 

@@ -1,0 +1,220 @@
+"""Deployment packaging: Dockerfile + Helm chart render/lint.
+
+Reference analog: charts/skypilot/ (unittests/ render the templates)
+and Dockerfile_k8s:1. No helm/docker binaries exist in CI, so the
+templates restrict themselves to a renderable Go-template subset
+(plain `{{ .Values... }}` substitution, `{{- if }}`/`{{- end }}`
+blocks, one `| indent N` filter) and this test renders them with that
+subset and yaml-validates every emitted document. `helm template`
+accepts the same files unchanged.
+"""
+import os
+import re
+
+import pytest
+import yaml
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_CHART = os.path.join(_REPO, 'deploy', 'charts', 'skypilot-tpu')
+_DOCKERFILE = os.path.join(_REPO, 'deploy', 'Dockerfile')
+
+
+# --- a faithful subset of helm's template language ------------------------
+
+def _lookup(ctx, dotted):
+    cur = ctx
+    for part in dotted.split('.'):
+        if not part:
+            continue
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def _render(text, ctx):
+    # {{- if .Path }} ... {{- end }} (innermost-first, no else).
+    if_block = re.compile(
+        r'\{\{-? if (\.[\w.]+) \}\}\n?'
+        r'((?:(?!\{\{-? (?:if|end))[\s\S])*?)'
+        r'\{\{-? end \}\}\n?')
+    while True:
+        m = if_block.search(text)
+        if m is None:
+            break
+        body = m.group(2) if _lookup(ctx, m.group(1)[1:]) else ''
+        text = text[:m.start()] + body + text[m.end():]
+
+    def _sub(m):
+        expr = m.group(1).strip()
+        filt = None
+        if '|' in expr:
+            expr, filt = (p.strip() for p in expr.split('|', 1))
+        value = _lookup(ctx, expr.lstrip('.'))
+        assert value is not None, f'unresolved template value {expr!r}'
+        if filt:
+            fm = re.fullmatch(r'indent (\d+)', filt)
+            assert fm, f'unsupported filter {filt!r} (keep the subset!)'
+            pad = ' ' * int(fm.group(1))
+            return '\n'.join(pad + line for line in str(value).splitlines())
+        return str(value)
+
+    return re.sub(r'\{\{ ([^}]+) \}\}', _sub, text)
+
+
+def _chart_context(**value_overrides):
+    with open(os.path.join(_CHART, 'values.yaml'), encoding='utf-8') as f:
+        values = yaml.safe_load(f)
+
+    def merge(base, over):
+        for k, v in over.items():
+            if isinstance(v, dict) and isinstance(base.get(k), dict):
+                merge(base[k], v)
+            else:
+                base[k] = v
+    merge(values, value_overrides)
+    with open(os.path.join(_CHART, 'Chart.yaml'), encoding='utf-8') as f:
+        chart = yaml.safe_load(f)
+    return {'Values': values,
+            'Release': {'Name': 'tsky', 'Namespace': 'default'},
+            'Chart': {'Name': chart['name'],
+                      'AppVersion': chart['appVersion']}}
+
+
+def _render_chart(**value_overrides):
+    ctx = _chart_context(**value_overrides)
+    docs = {}
+    tdir = os.path.join(_CHART, 'templates')
+    for name in sorted(os.listdir(tdir)):
+        with open(os.path.join(tdir, name), encoding='utf-8') as f:
+            rendered = _render(f.read(), ctx)
+        for doc in yaml.safe_load_all(rendered):
+            if doc:
+                docs[(doc['kind'], doc['metadata']['name'])] = doc
+    return docs
+
+
+class TestChart:
+
+    def test_chart_metadata(self):
+        with open(os.path.join(_CHART, 'Chart.yaml'),
+                  encoding='utf-8') as f:
+            chart = yaml.safe_load(f)
+        assert chart['apiVersion'] == 'v2'
+        assert chart['name'] == 'skypilot-tpu'
+
+    def test_default_render_is_valid_k8s(self):
+        docs = _render_chart(auth={'adminToken': 'tok-123'})
+        kinds = {k for k, _ in docs}
+        assert {'Deployment', 'Service', 'PersistentVolumeClaim',
+                'ConfigMap', 'Secret'} <= kinds
+        for doc in docs.values():
+            assert doc['apiVersion']
+            assert doc['metadata']['name'].startswith('tsky-')
+
+    def test_deployment_wiring(self):
+        docs = _render_chart(auth={'adminToken': 'tok-123'})
+        dep = docs[('Deployment', 'tsky-api')]
+        pod = dep['spec']['template']['spec']
+        [container] = pod['containers']
+        assert container['command'] == \
+            ['python', '-m', 'skypilot_tpu.server.app']
+        assert container['args'][-1] == '46590'
+        # State volume rides the chart's PVC.
+        assert any(v.get('persistentVolumeClaim', {}).get('claimName')
+                   == 'tsky-state' for v in pod['volumes'])
+        # Auth secret feeds the env var the server's bootstrap_admin
+        # reads (skypilot_tpu/users).
+        env = {e['name']: e for e in container['env']}
+        ref = env['SKYTPU_BOOTSTRAP_ADMIN_TOKEN']['valueFrom']
+        assert ref['secretKeyRef'] == {'name': 'tsky-auth',
+                                       'key': 'admin-token'}
+        # Health endpoints match the server's real route.
+        assert dep['spec']['template']['spec']['containers'][0][
+            'readinessProbe']['httpGet']['path'] == '/api/v1/health'
+
+    def test_service_targets_port(self):
+        docs = _render_chart()
+        svc = docs[('Service', 'tsky-api')]
+        [port] = svc['spec']['ports']
+        assert port['port'] == 46590
+
+    def test_auth_disabled_drops_secret_and_env(self):
+        docs = _render_chart(auth={'enabled': False})
+        assert ('Secret', 'tsky-auth') not in docs
+        dep = docs[('Deployment', 'tsky-api')]
+        env = {e['name'] for e in
+               dep['spec']['template']['spec']['containers'][0]['env']}
+        assert 'SKYTPU_BOOTSTRAP_ADMIN_TOKEN' not in env
+
+    def test_ingress_renders_when_enabled(self):
+        docs = _render_chart(ingress={'enabled': True,
+                                      'tlsSecretName': 'tls-cert'})
+        ing = docs[('Ingress', 'tsky-dashboard')]
+        rule = ing['spec']['rules'][0]
+        assert rule['host'] == 'skypilot-tpu.example.com'
+        backend = rule['http']['paths'][0]['backend']['service']
+        assert backend['name'] == 'tsky-api'
+        assert ing['spec']['tls'][0]['secretName'] == 'tls-cert'
+        # Disabled by default.
+        assert ('Ingress', 'tsky-dashboard') not in _render_chart()
+
+    def test_config_indent(self):
+        docs = _render_chart(server={'config': 'api_server:\n  auth: true\n'})
+        cm = docs[('ConfigMap', 'tsky-config')]
+        inner = yaml.safe_load(cm['data']['config.yaml'])
+        assert inner == {'api_server': {'auth': True}}
+
+
+class TestDockerfile:
+
+    def test_dockerfile_structure(self):
+        with open(_DOCKERFILE, encoding='utf-8') as f:
+            content = f.read()
+        assert content.startswith('#')
+        assert 'FROM python:3.12-slim' in content
+        assert 'pip install --no-cache-dir .' in content
+        assert 'EXPOSE 46590' in content
+        assert 'skypilot_tpu.server.app' in content
+        # The copied paths must exist relative to the build context
+        # (repo root).
+        for rel in ('pyproject.toml', 'README.md', 'skypilot_tpu'):
+            assert os.path.exists(os.path.join(_REPO, rel)), rel
+
+    def test_state_dir_is_the_volume(self):
+        with open(_DOCKERFILE, encoding='utf-8') as f:
+            content = f.read()
+        assert 'ENV SKYTPU_STATE_DIR=/var/lib/skypilot-tpu' in content
+        assert 'VOLUME /var/lib/skypilot-tpu' in content
+
+
+class TestBootstrapAdmin:
+    """The env credential the chart's Secret feeds (users package)."""
+
+    def test_bootstrap_token_enables_auth(self, monkeypatch):
+        from skypilot_tpu import users
+        monkeypatch.delenv('SKYTPU_BOOTSTRAP_ADMIN_TOKEN', raising=False)
+        assert not users.auth_required()
+        monkeypatch.setenv('SKYTPU_BOOTSTRAP_ADMIN_TOKEN', 's3cret')
+        assert users.auth_required()
+        assert users.user_for_token('s3cret').role == users.ROLE_ADMIN
+        assert users.user_for_token('wrong') is None
+
+    def test_config_admin_shadows_bootstrap(self, monkeypatch, tmp_path):
+        from skypilot_tpu import config as config_lib
+        from skypilot_tpu import users
+        cfg = tmp_path / 'config.yaml'
+        cfg.write_text('api_server:\n  users:\n'
+                       '    - {name: admin, token: cfg-tok, role: viewer}\n')
+        monkeypatch.setenv('SKYTPU_CONFIG', str(cfg))
+        monkeypatch.setenv('SKYTPU_BOOTSTRAP_ADMIN_TOKEN', 'env-tok')
+        config_lib.reload()
+        try:
+            admins = [u for u in users.configured_users()
+                      if u.name == 'admin']
+            assert len(admins) == 1
+            assert admins[0].token == 'cfg-tok'
+        finally:
+            monkeypatch.delenv('SKYTPU_CONFIG')
+            config_lib.reload()
