@@ -155,7 +155,10 @@ capture() {
             touch "$DIR/capture_done"
             log "capture: COMPLETE (bench + flash + loadgen all good)"
         fi
-    ) 8>"$DIR/chip.lock"
+    ) 8>"$DIR/chip.lock" 9>&-
+    # 9>&-: the capture subshell (bench/flash/loadgen, up to ~75 min)
+    # must not inherit the watch.lock fd — an orphan would block a
+    # restarted watcher exactly like the sleep children used to.
 }
 
 log "watcher started (pid $$)"

@@ -359,8 +359,11 @@ def record_heartbeat(cluster_name: str, epoch: Optional[str],
     a leaked skylet from a previous incarnation of a same-named cluster
     (or a forger on the unauthenticated endpoint, who can't know the
     random epoch) must not keep the record looking live. Pre-epoch
-    records (migrated DBs) adopt the first reported epoch, so the
-    protection reaches clusters provisioned before the column existed.
+    records (migrated DBs) accept any beat but do NOT adopt its epoch:
+    trust-on-first-use would let whoever posts first (possibly a
+    forger) define the epoch and lock out the real skylet; the
+    protection instead begins at the cluster's next provision, which
+    records a genuine epoch.
     Returns False when refused."""
     conn = _get_conn()
     with _lock:
@@ -374,10 +377,6 @@ def record_heartbeat(cluster_name: str, epoch: Optional[str],
             return False
         if expected_epoch and epoch != expected_epoch:
             return False
-        if not expected_epoch and epoch:
-            # Trust-on-first-use backfill for pre-epoch records.
-            conn.execute('UPDATE clusters SET epoch=? WHERE name=?',
-                         (epoch, cluster_name))
         conn.execute(
             """INSERT INTO heartbeats (cluster_name, last_seen, epoch,
                                        payload)

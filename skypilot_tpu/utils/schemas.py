@@ -370,6 +370,17 @@ CONFIG_SCHEMA: Dict[str, Any] = {
                 'endpoint': _STR,
             },
         },
+        # Cluster liveness heartbeats (skylet -> API server). `url`
+        # overrides the server's advertised address when clusters
+        # reach it through ingress (provision/provisioner.py
+        # build_topology).
+        'heartbeat': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'url': _STR,
+            },
+        },
     },
 }
 

@@ -187,17 +187,25 @@ class TestStatusSurfacing:
             _post_heartbeat(server.url, {'cluster_name': name})
         assert name not in state.get_heartbeats()
 
-    def test_epoch_backfill_on_first_beat(self, server):
-        """Pre-epoch records (migrated DBs) adopt the first reported
-        epoch, locking out other epochs from then on."""
+    def test_pre_epoch_record_accepts_without_adopting(self, server):
+        """Migrated (epoch-less) records accept beats but must NOT
+        adopt the first reported epoch — trust-on-first-use would let
+        a forger define the epoch and lock out the real skylet."""
         name = _register_cluster('hb-tofu')  # no epoch on the record
         assert _post_heartbeat(server.url, {
-            'cluster_name': name, 'epoch': 'first'}) == 200
+            'cluster_name': name, 'epoch': 'forged'}) == 200
+        # A different epoch (the real skylet's) still gets through.
+        assert _post_heartbeat(server.url, {
+            'cluster_name': name, 'epoch': 'genuine'}) == 200
+        # The next provision records a genuine epoch; from then on
+        # mismatches are refused.
+        state.add_or_update_cluster(name, handle=None,
+                                    requested_resources_str='local',
+                                    num_nodes=1, ready=True,
+                                    epoch='genuine')
         with pytest.raises(urllib.error.HTTPError):
             _post_heartbeat(server.url, {
-                'cluster_name': name, 'epoch': 'second'})
-        assert _post_heartbeat(server.url, {
-            'cluster_name': name, 'epoch': 'first'}) == 200
+                'cluster_name': name, 'epoch': 'forged'})
 
 
 class TestTopologyPlumbing:
