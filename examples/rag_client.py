@@ -1,0 +1,141 @@
+"""RAG client for llm/rag-serve.yaml: retrieve, stuff, generate.
+
+Retrieval is client-side and dependency-free — a BM25-lite scorer
+over a directory of .txt/.md files — because the serving host is
+tokenizer-free by design (token-id interface). Tokenization uses
+transformers when available (real deployments) and falls back to a
+byte-level encoding (tests, toy models).
+
+    python3 examples/rag_client.py --url http://HOST:8080 \
+        --corpus ./docs --question "how does autostop work?" \
+        --top-k-docs 3 --max-new-tokens 256
+
+Prints one JSON line: retrieved files, prompt size, generated tokens
+(and text when a real tokenizer is in play).
+"""
+import argparse
+import glob
+import json
+import math
+import os
+import re
+import urllib.request
+from collections import Counter
+from typing import List, Optional, Tuple
+
+
+def _terms(text: str) -> List[str]:
+    return re.findall(r'[a-z0-9]+', text.lower())
+
+
+def retrieve(corpus_dir: str, question: str, top_k: int
+             ) -> List[Tuple[str, str]]:
+    """BM25-lite (k1=1.5, b=0.75) over *.txt/*.md files."""
+    paths = sorted(glob.glob(os.path.join(corpus_dir, '**', '*.txt'),
+                             recursive=True) +
+                   glob.glob(os.path.join(corpus_dir, '**', '*.md'),
+                             recursive=True))
+    if not paths:
+        raise SystemExit(f'No .txt/.md documents under {corpus_dir}')
+    docs = []
+    for path in paths:
+        with open(path, encoding='utf-8', errors='replace') as f:
+            docs.append((path, f.read()))
+    doc_terms = [Counter(_terms(text)) for _, text in docs]
+    avg_len = sum(sum(c.values()) for c in doc_terms) / len(doc_terms)
+    n = len(docs)
+    q_terms = _terms(question)
+    # Document frequencies once up front — recomputing per scored
+    # document would make retrieval O(docs^2 x terms).
+    df = {term: sum(1 for c in doc_terms if term in c)
+          for term in set(q_terms)}
+    k1, b = 1.5, 0.75
+
+    def score(counts: Counter) -> float:
+        length = sum(counts.values()) or 1
+        s = 0.0
+        for term in q_terms:
+            tf = counts.get(term, 0)
+            if not tf:
+                continue
+            idf = math.log(1 + (n - df[term] + 0.5) / (df[term] + 0.5))
+            s += idf * tf * (k1 + 1) / (
+                tf + k1 * (1 - b + b * length / avg_len))
+        return s
+
+    ranked = sorted(zip(docs, doc_terms), key=lambda p: -score(p[1]))
+    return [doc for doc, _ in ranked[:top_k]]
+
+
+class _Tokenizer:
+    """transformers tokenizer when available; byte-level fallback."""
+
+    def __init__(self, name: Optional[str]) -> None:
+        self.hf = None
+        if name:
+            from transformers import AutoTokenizer
+            self.hf = AutoTokenizer.from_pretrained(name)
+
+    def encode(self, text: str, vocab_cap: int) -> List[int]:
+        if self.hf is not None:
+            return self.hf.encode(text)
+        # Byte fallback, wrapped into the serving model's vocab; offset
+        # 1 keeps 0 free (a common pad id).
+        return [1 + (b % (vocab_cap - 1)) for b in text.encode()]
+
+    def decode(self, tokens: List[int]) -> Optional[str]:
+        if self.hf is not None:
+            return self.hf.decode(tokens)
+        return None
+
+
+def generate(url: str, prompt_tokens: List[int], max_new_tokens: int,
+             temperature: float) -> List[int]:
+    req = urllib.request.Request(
+        url.rstrip('/') + '/generate',
+        data=json.dumps({'prompt_tokens': prompt_tokens,
+                         'max_new_tokens': max_new_tokens,
+                         'temperature': temperature}).encode(),
+        headers={'Content-Type': 'application/json'}, method='POST')
+    with urllib.request.urlopen(req, timeout=600) as resp:
+        return json.loads(resp.read())['tokens']
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--url', required=True)
+    parser.add_argument('--corpus', required=True)
+    parser.add_argument('--question', required=True)
+    parser.add_argument('--top-k-docs', type=int, default=3)
+    parser.add_argument('--max-new-tokens', type=int, default=256)
+    parser.add_argument('--temperature', type=float, default=0.0)
+    parser.add_argument('--tokenizer', default=None,
+                        help='HF tokenizer name (byte fallback if unset)')
+    parser.add_argument('--max-context-chars', type=int, default=8000)
+    parser.add_argument('--vocab-cap', type=int, default=256,
+                        help='Byte-fallback vocab bound (the serving '
+                             "model's vocab_size)")
+    args = parser.parse_args()
+
+    hits = retrieve(args.corpus, args.question, args.top_k_docs)
+    context = '\n\n'.join(
+        f'[{os.path.basename(p)}]\n{text}' for p, text in hits)
+    context = context[:args.max_context_chars]
+    prompt = (f'Use the context to answer.\n\nContext:\n{context}\n\n'
+              f'Question: {args.question}\nAnswer:')
+
+    tok = _Tokenizer(args.tokenizer)
+    prompt_tokens = tok.encode(prompt, args.vocab_cap)
+    tokens = generate(args.url, prompt_tokens, args.max_new_tokens,
+                      args.temperature)
+    print(json.dumps({
+        'retrieved': [p for p, _ in hits],
+        'prompt_tokens': len(prompt_tokens),
+        'generated_tokens': len(tokens),
+        'tokens': tokens,
+        'text': tok.decode(tokens),
+    }))
+
+
+if __name__ == '__main__':
+    main()

@@ -5,9 +5,39 @@ ships no inference code, only recipes that shell out to vLLM
 (llm/vllm/serve.yaml; SURVEY.md §2.11). This subsystem is additive:
 `serve:` recipes point at `python -m skypilot_tpu.inference.server`.
 """
+from typing import Optional
+
 from skypilot_tpu.inference.engine import (DecodeState, InferenceEngine,
                                            SamplingParams, decode_step,
                                            init_cache, prefill)
 
 __all__ = ['DecodeState', 'InferenceEngine', 'SamplingParams',
-           'decode_step', 'init_cache', 'prefill']
+           'build_engine', 'decode_step', 'init_cache', 'prefill']
+
+
+def build_engine(model: str, *, checkpoint: Optional[str] = None,
+                 mesh_arg: Optional[str] = None, batch_size: int = 8,
+                 max_seq_len: Optional[int] = None,
+                 prefill_chunk: int = 1024) -> InferenceEngine:
+    """One engine-construction path for every entrypoint (HTTP server,
+    offline batch): resolve the model, build the mesh from a
+    'tensor=8,context=2'-style arg, restore or random-init params."""
+    import jax
+
+    from skypilot_tpu import models as models_lib
+
+    family, config = models_lib.resolve(model)
+    mesh = None
+    if mesh_arg:
+        from skypilot_tpu.parallel import mesh as mesh_lib
+        spec = mesh_lib.MeshSpec.from_dict(dict(
+            kv.split('=') for kv in mesh_arg.split(',')))
+        mesh = mesh_lib.mesh_from_env(spec)
+    if checkpoint:
+        from skypilot_tpu.train import checkpoints
+        params = checkpoints.restore_params(checkpoint, config)
+    else:
+        params = family.init_params(config, jax.random.key(0))
+    return InferenceEngine(params, config, batch_size=batch_size,
+                           max_seq_len=max_seq_len, mesh=mesh,
+                           prefill_chunk=prefill_chunk)

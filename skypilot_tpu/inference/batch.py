@@ -1,0 +1,99 @@
+"""Offline batch inference: JSONL in, JSONL out, no HTTP.
+
+The TPU-native analog of the reference's batch-inference recipes
+(reference `examples/` run vLLM offline scripts on provisioned GPUs;
+sky itself ships no engine — SURVEY.md §2.11). Rides the same
+InferenceEngine as the server, so continuous batching packs the
+request list into the fixed decode batch and slots recycle as
+sequences finish.
+
+    python3 -m skypilot_tpu.inference.batch \
+        --model llama3-8b --checkpoint /ckpts/llama3-8b \
+        --input prompts.jsonl --output completions.jsonl \
+        --batch-size 32 --max-new-tokens 256
+
+Input lines: {"prompt_tokens": [...]} (+ optional per-line
+"max_new_tokens", "temperature", "top_k", "id"). Output lines carry
+the input id (or line index), the generated tokens, and timing.
+Token-id interface like the server: tokenization is the caller's.
+"""
+import argparse
+import json
+import sys
+import time
+from typing import Any, Dict, List
+
+
+def run_batch(engine, requests: List[Dict[str, Any]],
+              default_sampling) -> List[Dict[str, Any]]:
+    """Submit every request, drain to completion, preserve order."""
+    from skypilot_tpu import inference as inf
+
+    rid_to_idx = {}
+    for idx, req in enumerate(requests):
+        sampling = inf.SamplingParams(
+            temperature=float(req.get('temperature',
+                                      default_sampling.temperature)),
+            top_k=int(req.get('top_k', default_sampling.top_k)),
+            max_new_tokens=int(req.get('max_new_tokens',
+                                       default_sampling.max_new_tokens)),
+            eos_token_id=req.get('eos_token_id',
+                                 default_sampling.eos_token_id))
+        rid = engine.submit(req['prompt_tokens'], sampling)
+        rid_to_idx[rid] = idx
+
+    t0 = time.perf_counter()
+    finished = engine.run_to_completion()
+    elapsed = time.perf_counter() - t0
+    total_tokens = sum(len(t) for t in finished.values())
+    out = [None] * len(requests)
+    for rid, tokens in finished.items():
+        idx = rid_to_idx[rid]
+        out[idx] = {
+            'id': requests[idx].get('id', idx),
+            'tokens': tokens,
+            'num_tokens': len(tokens),
+        }
+    sys.stderr.write(
+        f'[batch] {len(requests)} requests, {total_tokens} tokens in '
+        f'{elapsed:.1f}s ({total_tokens / max(elapsed, 1e-9):.0f} tok/s)\n')
+    return out
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--model', default='tiny')
+    parser.add_argument('--checkpoint', default=None)
+    parser.add_argument('--input', required=True,
+                        help='JSONL with {"prompt_tokens": [...]} lines')
+    parser.add_argument('--output', required=True)
+    parser.add_argument('--batch-size', type=int, default=8)
+    parser.add_argument('--max-seq-len', type=int, default=None)
+    parser.add_argument('--max-new-tokens', type=int, default=64)
+    parser.add_argument('--temperature', type=float, default=0.0)
+    parser.add_argument('--top-k', type=int, default=0)
+    parser.add_argument('--mesh', default=None,
+                        help='Shard over a device mesh, e.g. tensor=8')
+    args = parser.parse_args()
+
+    from skypilot_tpu import inference as inf
+
+    with open(args.input, encoding='utf-8') as f:
+        requests = [json.loads(line) for line in f if line.strip()]
+    if not requests:
+        raise SystemExit(f'No requests in {args.input}')
+
+    engine = inf.build_engine(
+        args.model, checkpoint=args.checkpoint, mesh_arg=args.mesh,
+        batch_size=args.batch_size, max_seq_len=args.max_seq_len)
+    default_sampling = inf.SamplingParams(
+        temperature=args.temperature, top_k=args.top_k,
+        max_new_tokens=args.max_new_tokens)
+    results = run_batch(engine, requests, default_sampling)
+    with open(args.output, 'w', encoding='utf-8') as f:
+        for rec in results:
+            f.write(json.dumps(rec) + '\n')
+
+
+if __name__ == '__main__':
+    main()

@@ -201,6 +201,10 @@ def _attn_with_cache(x: jax.Array, layer_params: Params,
                    preferred_element_type=jnp.float32).astype(c.dtype)
     v = jnp.einsum('bse,ehd->bshd', h, layer_params['wv'],
                    preferred_element_type=jnp.float32).astype(c.dtype)
+    if getattr(c, 'attn_qkv_bias', False):
+        q = q + layer_params['bq']
+        k = k + layer_params['bk']
+        v = v + layer_params['bv']
     q = llama._rope(q, positions, c.rope_theta)
     k = llama._rope(k, positions, c.rope_theta)
     qpa = getattr(c, 'query_pre_attn_scalar', None)

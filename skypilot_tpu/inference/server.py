@@ -253,24 +253,10 @@ def main() -> None:
     holder: Dict[str, Any] = {'loop': None}
 
     def _load():
-        import jax
         from skypilot_tpu import inference as inf
-        from skypilot_tpu import models as models_lib
-        family, config = models_lib.resolve(args.model)
-        mesh = None
-        if args.mesh:
-            from skypilot_tpu.parallel import mesh as mesh_lib
-            spec = mesh_lib.MeshSpec.from_dict(dict(
-                kv.split('=') for kv in args.mesh.split(',')))
-            mesh = mesh_lib.mesh_from_env(spec)
-        if args.checkpoint:
-            from skypilot_tpu.train import checkpoints
-            params = checkpoints.restore_params(args.checkpoint, config)
-        else:
-            params = family.init_params(config, jax.random.key(0))
-        engine = inf.InferenceEngine(
-            params, config, batch_size=args.batch_size,
-            max_seq_len=args.max_seq_len, mesh=mesh,
+        engine = inf.build_engine(
+            args.model, checkpoint=args.checkpoint, mesh_arg=args.mesh,
+            batch_size=args.batch_size, max_seq_len=args.max_seq_len,
             prefill_chunk=args.prefill_chunk)
         holder['loop'] = EngineLoop(engine)
 

@@ -17,11 +17,13 @@ def resolve(name: str) -> Tuple[Any, Any]:
     from skypilot_tpu.models import gemma
     from skypilot_tpu.models import mistral
     from skypilot_tpu.models import moe
-    for family in (gemma, mistral, moe):
+    from skypilot_tpu.models import qwen
+    for family in (gemma, mistral, moe, qwen):
         if name in family.CONFIGS:
             return family, family.CONFIGS[name]
     known = (sorted(llama.CONFIGS) + sorted(gemma.CONFIGS) +
-             sorted(mistral.CONFIGS) + sorted(moe.CONFIGS))
+             sorted(mistral.CONFIGS) + sorted(moe.CONFIGS) +
+             sorted(qwen.CONFIGS))
     raise ValueError(f'Unknown model {name!r}; available: {known}')
 
 

@@ -66,6 +66,7 @@ class LlamaConfig:
     sliding_window: Optional[int] = None          # mistral/gemma2 local
     # every Nth layer is GLOBAL (gemma2 alternates: 2); 1 = all local.
     sliding_window_pattern: int = 1
+    attn_qkv_bias: bool = False         # qwen2: bias on q/k/v projections
 
     def num_params(self) -> int:
         e, m, v = self.hidden_size, self.intermediate_size, self.vocab_size
@@ -95,6 +96,10 @@ CONFIGS: Dict[str, LlamaConfig] = {
     'llama3-1b': LlamaConfig(vocab_size=128256, hidden_size=2048,
                              intermediate_size=8192, num_layers=16,
                              num_heads=32, num_kv_heads=8, head_dim=64),
+    # DeepSeek-R1-Distill-Llama-8B: the published distill checkpoints
+    # are exactly llama3-8b geometry (distillation changed weights,
+    # not architecture) — an alias so recipes/checkpoints resolve.
+    'deepseek-r1-distill-8b': LlamaConfig(attention_impl='flash'),
     # Small configs for CPU tests / dryruns. head count divisible by
     # tensor axis; seq divisible by context axis.
     'tiny': LlamaConfig(vocab_size=256, hidden_size=64,
@@ -143,6 +148,10 @@ def param_logical_axes(config: LlamaConfig) -> Params:
     if config.post_norms:
         layers['post_attn_norm'] = ('layers', 'embed')
         layers['post_mlp_norm'] = ('layers', 'embed')
+    if config.attn_qkv_bias:
+        layers['bq'] = ('layers', 'heads', 'head_dim')
+        layers['bk'] = ('layers', 'kv_heads', 'head_dim')
+        layers['bv'] = ('layers', 'kv_heads', 'head_dim')
     out = {
         'embed': ('vocab', 'embed'),
         'layers': layers,
@@ -181,6 +190,10 @@ def init_params(config: LlamaConfig, key: jax.Array) -> Params:
     if c.post_norms:
         layers['post_attn_norm'] = norm_init((L, e), dt)
         layers['post_mlp_norm'] = norm_init((L, e), dt)
+    if c.attn_qkv_bias:
+        layers['bq'] = jnp.zeros((L, h, d), dt)
+        layers['bk'] = jnp.zeros((L, kv, d), dt)
+        layers['bv'] = jnp.zeros((L, kv, d), dt)
     out = {
         'embed': normal(keys[0], (c.vocab_size, e), e),
         'layers': layers,
@@ -280,6 +293,10 @@ def _layer(x: jax.Array,
                    preferred_element_type=jnp.float32).astype(c.dtype)
     v = jnp.einsum('bse,ehd->bshd', h, layer_params['wv'],
                    preferred_element_type=jnp.float32).astype(c.dtype)
+    if c.attn_qkv_bias:
+        q = q + layer_params['bq']
+        k = k + layer_params['bk']
+        v = v + layer_params['bv']
     q = sharding.shard(q, ('batch', 'seq', 'heads', 'head_dim'), rules)
     k = sharding.shard(k, ('batch', 'seq', 'kv_heads', 'head_dim'), rules)
     q = _rope(q, positions, c.rope_theta)

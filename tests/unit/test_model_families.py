@@ -13,7 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from skypilot_tpu.models import gemma, llama, mistral, resolve
+from skypilot_tpu.models import gemma, llama, mistral, qwen, resolve
 from skypilot_tpu.ops import attention as attention_ops
 from skypilot_tpu.parallel import MeshSpec, make_mesh, use_mesh
 from skypilot_tpu.train import trainer
@@ -125,6 +125,12 @@ def test_gemma_forward_softcap_bound():
 
 @pytest.mark.parametrize('family,model', [
     (gemma, 'tiny-gemma'),
+    # qwen = the qkv-bias knob (zero-init biases would hide a wiring
+    # bug, so its init test below perturbs them; here random params
+    # include nonzero biases after one train step is too slow — the
+    # decode oracle uses init params whose biases are zeros, so ALSO
+    # covered by the perturbed-bias test).
+    (qwen, 'tiny-qwen'),
     # mistral = the window knob alone, a strict subset of gemma's
     # stack — redundant in default runs, kept for -m slow.
     pytest.param(mistral, 'tiny-mistral', marks=pytest.mark.slow),
@@ -195,8 +201,9 @@ def test_inference_engine_rejects_unknown_config():
 
 
 def test_resolve_finds_all_families():
-    for name in ('gemma2-9b', 'mistral-7b', 'tiny-gemma',
-                 'tiny-mistral'):
+    for name in ('gemma2-9b', 'mistral-7b', 'qwen2-7b',
+                 'qwen2.5-72b', 'deepseek-r1-distill-8b',
+                 'tiny-gemma', 'tiny-mistral', 'tiny-qwen'):
         family, cfg = resolve(name)
         assert hasattr(family, 'forward')
         assert cfg.num_layers > 0
@@ -226,3 +233,53 @@ def test_family_loss_decreases(model):
             state, metrics = step(state, batch)
             losses.append(float(metrics['loss']))
     assert losses[-1] < losses[0], losses
+
+
+# --- qwen: biased q/k/v projections ----------------------------------------
+
+def test_qwen_bias_params_and_axes_mirror():
+    """bq/bk/bv exist with stacked shapes, and the logical-axes tree
+    mirrors the param tree exactly (trainer sharding maps over both
+    in lockstep — a mismatch breaks every sharded run)."""
+    cfg = qwen.CONFIGS['tiny-qwen']
+    params = qwen.init_params(cfg, jax.random.key(0))
+    layers = params['layers']
+    L, h, kv, d = (cfg.num_layers, cfg.num_heads, cfg.num_kv_heads,
+                   cfg.head_dim)
+    assert layers['bq'].shape == (L, h, d)
+    assert layers['bk'].shape == (L, kv, d)
+    assert layers['bv'].shape == (L, kv, d)
+    axes = qwen.param_logical_axes(cfg)
+    axes_structure = jax.tree.structure(
+        axes, is_leaf=lambda x: isinstance(x, tuple))
+    assert jax.tree.structure(params) == axes_structure
+
+
+def test_qwen_bias_actually_feeds_attention():
+    """With nonzero biases the forward must differ from the zero-bias
+    forward (zero-init would hide dead wiring), and the KV-cache
+    decode must still match the training forward token-for-token."""
+    from tests.unit.test_inference import _greedy_reference
+    from skypilot_tpu import inference
+    cfg = qwen.CONFIGS['tiny-qwen']
+    params = qwen.init_params(cfg, jax.random.key(1))
+    tokens = jnp.array([[5, 9, 2, 14, 7, 11, 3, 8]], jnp.int32)
+    base = qwen.forward(params, tokens, cfg)
+
+    perturbed = jax.tree_util.tree_map(lambda x: x, params)  # copy tree
+    for name in ('bq', 'bk', 'bv'):
+        leaf = perturbed['layers'][name]
+        perturbed['layers'][name] = 0.3 * jax.random.normal(
+            jax.random.key(hash(name) % 2**31), leaf.shape,
+            leaf.dtype)
+    biased = qwen.forward(perturbed, tokens, cfg)
+    assert not bool(jnp.allclose(base, biased, atol=1e-4)), \
+        'bias params have no effect on the forward'
+
+    prompt = [5, 9, 2, 14, 7, 11, 3, 8]
+    ref = _greedy_reference(perturbed, cfg, prompt, 8)
+    engine = inference.InferenceEngine(perturbed, cfg, batch_size=2,
+                                       max_seq_len=64)
+    rid = engine.submit(prompt, inference.SamplingParams(
+        temperature=0.0, max_new_tokens=8))
+    assert engine.run_to_completion()[rid] == ref
