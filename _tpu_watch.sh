@@ -63,7 +63,10 @@ try:
     d = json.load(open(sys.argv[1]))
 except Exception:
     sys.exit(1)
-sys.exit(0 if d.get('ttft_p50_s') else 1)
+# The loadgen emits the bench.py one-line schema: metrics live under
+# 'extra' (top-level has only metric/value/unit).
+ok = d.get('ttft_p50_s') or (d.get('extra') or {}).get('ttft_p50_s')
+sys.exit(0 if ok else 1)
 EOF
 }
 

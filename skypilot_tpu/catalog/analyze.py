@@ -1,0 +1,331 @@
+"""Catalog QA + refresh-diff tooling.
+
+Reference analog: `sky/catalog/data_fetchers/analyze.py:1` — an ad-hoc
+script that diffs a freshly fetched aws/azure/gcp catalog against the
+checked-in copy and writes `*_diff.csv` files. Redesigned here as a
+catalog *health gate* that covers every checked-in cloud:
+
+- `qa`: structural checks per catalog (schema, duplicate offer keys,
+  non-positive prices, spot > on-demand, accelerator name/count
+  mismatches, non-canonical GPU spellings per the fetcher vocabulary)
+  plus cross-cloud checks (per-GPU price outliers, single-cloud
+  accelerator vocabulary). Errors exit non-zero so CI — and
+  `tests/unit/test_catalog_analyze.py`, which runs the gate over the
+  shipped CSVs — keeps all 16 catalogs honest, the job the reference
+  does by hand-running analyze.py after a fetch.
+- `diff`: what a refresh changed — offers added/removed and price
+  moves, keyed on (instance_type, region, zone, accelerator), for
+  reviewing a `fetch_market`/`fetch_gcp` run before committing it.
+
+Everything returns plain dataclasses; the CLI renders text or JSON.
+"""
+import argparse
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional
+
+from skypilot_tpu.catalog import common
+from skypilot_tpu.catalog.data_fetchers.fetch_market import _norm_gpu
+
+_VM_COLUMNS = ['instance_type', 'accelerator_name', 'accelerator_count',
+               'cpus', 'memory_gb', 'price', 'spot_price', 'region',
+               'zone']
+# One offer = one priced (shape, placement) pair; duplicates make the
+# optimizer's cheapest-row choice arbitrary.
+_OFFER_KEY = ['instance_type', 'region', 'zone', 'accelerator_name',
+              'accelerator_count']
+# Cross-cloud per-GPU price spread beyond this ratio of the median is
+# almost always a fetcher unit bug (cents vs dollars, per-node vs
+# per-GPU), not a real market price.
+_PRICE_OUTLIER_RATIO = 8.0
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    severity: str  # 'error' | 'warn'
+    cloud: str
+    check: str
+    detail: str
+
+    def render(self) -> str:
+        return f'[{self.severity}] {self.cloud}: {self.check}: {self.detail}'
+
+
+@dataclasses.dataclass
+class DiffResult:
+    cloud: str
+    added: List[str]
+    removed: List[str]
+    price_changed: List[str]  # 'key: old -> new'
+
+    @property
+    def total(self) -> int:
+        return len(self.added) + len(self.removed) + len(self.price_changed)
+
+
+def _clouds(data_dir: str) -> List[str]:
+    return sorted(d for d in os.listdir(data_dir)
+                  if os.path.isfile(os.path.join(data_dir, d, 'vms.csv')))
+
+
+def _load(data_dir: str, cloud: str, name: str = 'vms'):
+    import pandas as pd
+    path = os.path.join(data_dir, cloud, f'{name}.csv')
+    if not os.path.isfile(path):
+        return pd.DataFrame()
+    return pd.read_csv(path)
+
+
+def _offer_key(row) -> str:
+    import pandas as pd
+    parts = []
+    for col in _OFFER_KEY:
+        v = row[col]
+        parts.append('' if (v is None or (isinstance(v, float)
+                                          and pd.isna(v))) else str(v))
+    return '/'.join(parts)
+
+
+# --- per-catalog QA ---------------------------------------------------------
+
+def qa_vms(cloud: str, df) -> List[Finding]:
+    """Structural checks over one cloud's vms.csv."""
+    import pandas as pd
+    findings: List[Finding] = []
+
+    def err(check: str, detail: str) -> None:
+        findings.append(Finding('error', cloud, check, detail))
+
+    missing = [c for c in _VM_COLUMNS if c not in df.columns]
+    if missing:
+        err('schema', f'missing columns {missing}')
+        return findings  # row checks would only cascade
+    if not len(df):
+        err('empty', 'catalog has zero rows')
+        return findings
+
+    keys = df.apply(_offer_key, axis=1)
+    for key, n in keys.value_counts().items():
+        if n > 1:
+            err('duplicate-offer', f'{key} appears {n} times')
+
+    for _, row in df.iterrows():
+        key = _offer_key(row)
+        price = row['price']
+        if pd.isna(price) or float(price) <= 0:
+            err('bad-price', f'{key}: price={price!r}')
+            continue
+        spot = row['spot_price']
+        if not pd.isna(spot) and float(spot) > float(price):
+            err('spot-above-ondemand',
+                f'{key}: spot {spot} > on-demand {price}')
+        acc = row['accelerator_name']
+        acc = '' if pd.isna(acc) else str(acc)
+        try:
+            count = float(row['accelerator_count'])
+        except (TypeError, ValueError):
+            count = float('nan')
+        if count != count:  # NaN: empty or non-numeric cell
+            # NaN fails both <=0 and >0, so without this branch a
+            # malformed count sails through the row checks AND poisons
+            # the cross-cloud per-GPU price math.
+            err('accelerator-count',
+                f'{key}: count {row["accelerator_count"]!r} is not a '
+                'number')
+            continue
+        if acc and count <= 0:
+            err('accelerator-count',
+                f'{key}: name {acc!r} but count {count}')
+        if not acc and count > 0:
+            err('accelerator-count',
+                f'{key}: count {count} but no accelerator name')
+        if acc and not acc.startswith('tpu-'):
+            canonical = _norm_gpu(acc)
+            if canonical != acc:
+                # Exact-string matching end to end (fetch_market._norm_gpu
+                # docstring): a third spelling is unmatchable.
+                err('non-canonical-accelerator',
+                    f'{key}: {acc!r} should be {canonical!r}')
+    return findings
+
+
+def qa_tpus(cloud: str, df) -> List[Finding]:
+    """gcp/tpus.csv uses a per-chip schema; same price invariants."""
+    import pandas as pd
+    findings: List[Finding] = []
+    need = ['generation', 'region', 'zone', 'price_per_chip',
+            'spot_price_per_chip']
+    missing = [c for c in need if c not in df.columns]
+    if missing:
+        return [Finding('error', cloud, 'schema',
+                        f'tpus.csv missing columns {missing}')]
+    keys = df.apply(lambda r: f"{r['generation']}/{r['zone']}", axis=1)
+    for key, n in keys.value_counts().items():
+        if n > 1:
+            findings.append(Finding('error', cloud, 'duplicate-offer',
+                                    f'tpus.csv {key} appears {n} times'))
+    for _, row in df.iterrows():
+        key = f"{row['generation']}/{row['zone']}"
+        price = row['price_per_chip']
+        if pd.isna(price) or float(price) <= 0:
+            findings.append(Finding('error', cloud, 'bad-price',
+                                    f'tpus.csv {key}: {price!r}'))
+            continue
+        spot = row['spot_price_per_chip']
+        if not pd.isna(spot) and float(spot) > float(price):
+            findings.append(Finding(
+                'error', cloud, 'spot-above-ondemand',
+                f'tpus.csv {key}: spot {spot} > on-demand {price}'))
+    return findings
+
+
+# --- cross-cloud QA ---------------------------------------------------------
+
+def qa_cross_cloud(frames: Dict[str, 'object']) -> List[Finding]:
+    """Checks that only make sense across the whole fleet of catalogs:
+    per-GPU price outliers (unit bugs) and accelerators only one cloud
+    claims to sell (vocabulary drift a per-file check can't see)."""
+    import pandas as pd
+    findings: List[Finding] = []
+    # accelerator -> [(cloud, key, per_gpu_price)]
+    per_gpu: Dict[str, List] = {}
+    for cloud, df in frames.items():
+        if not len(df) or 'accelerator_name' not in df.columns:
+            continue
+        for _, row in df.iterrows():
+            acc = row['accelerator_name']
+            if pd.isna(acc) or not str(acc):
+                continue
+            try:
+                count = float(row['accelerator_count'])
+            except (TypeError, ValueError):
+                continue  # already an error in qa_vms
+            price = row['price']
+            if (pd.isna(count) or count <= 0 or pd.isna(price)
+                    or float(price) <= 0):
+                continue  # already an error in qa_vms
+            per_gpu.setdefault(str(acc), []).append(
+                (cloud, _offer_key(row), float(price) / count))
+    import statistics
+    for acc, rows in sorted(per_gpu.items()):
+        clouds = sorted({c for c, _, _ in rows})
+        if len(clouds) == 1 and not acc.startswith('tpu-'):
+            findings.append(Finding(
+                'warn', clouds[0], 'single-cloud-accelerator',
+                f'{acc!r} is sold only here — spelling drift from the '
+                f'shared vocabulary, or genuinely exclusive'))
+        if len(rows) < 3:
+            continue
+        med = statistics.median(p for _, _, p in rows)
+        for cloud, key, p in rows:
+            if p > med * _PRICE_OUTLIER_RATIO or p < med / _PRICE_OUTLIER_RATIO:
+                findings.append(Finding(
+                    'warn', cloud, 'price-outlier',
+                    f'{acc} at ${p:.2f}/GPU/hr vs cross-cloud median '
+                    f'${med:.2f} ({key})'))
+    return findings
+
+
+def run_qa(data_dir: Optional[str] = None) -> List[Finding]:
+    data_dir = data_dir or common._DATA_DIR
+    findings: List[Finding] = []
+    frames = {}
+    for cloud in _clouds(data_dir):
+        df = _load(data_dir, cloud)
+        frames[cloud] = df
+        findings.extend(qa_vms(cloud, df))
+        tpus = _load(data_dir, cloud, 'tpus')
+        if len(tpus):
+            findings.extend(qa_tpus(cloud, tpus))
+    findings.extend(qa_cross_cloud(frames))
+    return findings
+
+
+# --- refresh diff -----------------------------------------------------------
+
+def diff_catalogs(cloud: str, old_df, new_df) -> DiffResult:
+    """What changed between the checked-in catalog and a fresh fetch,
+    keyed on the offer tuple (the reference's `resource_diff` merge,
+    sky/catalog/data_fetchers/analyze.py:14, plus removals and price
+    moves it doesn't report)."""
+    import pandas as pd
+
+    def index(df):
+        out = {}
+        if not len(df):
+            return out
+        for _, row in df.iterrows():
+            price, spot = row['price'], row['spot_price']
+            # NaN != NaN, so unguarded NaNs report an unchanged offer
+            # as a price move on every diff.
+            out[_offer_key(row)] = (
+                None if pd.isna(price) else float(price),
+                None if pd.isna(spot) else float(spot))
+        return out
+
+    old, new = index(old_df), index(new_df)
+    added = sorted(k for k in new if k not in old)
+    removed = sorted(k for k in old if k not in new)
+    changed = []
+    for key in sorted(set(old) & set(new)):
+        if old[key] != new[key]:
+            changed.append(f'{key}: {old[key]} -> {new[key]}')
+    return DiffResult(cloud, added, removed, changed)
+
+
+def run_diff(new_dir: str,
+             data_dir: Optional[str] = None,
+             clouds: Optional[List[str]] = None) -> List[DiffResult]:
+    data_dir = data_dir or common._DATA_DIR
+    clouds = clouds or _clouds(new_dir)
+    return [diff_catalogs(c, _load(data_dir, c), _load(new_dir, c))
+            for c in clouds]
+
+
+# --- CLI --------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description='Catalog QA gate and refresh differ.')
+    sub = parser.add_subparsers(dest='cmd', required=True)
+    qa_p = sub.add_parser('qa', help='health-check the checked-in CSVs')
+    qa_p.add_argument('--data-dir', default=None)
+    qa_p.add_argument('--strict', action='store_true',
+                      help='exit non-zero on warnings too')
+    diff_p = sub.add_parser('diff', help='compare a fresh fetch')
+    diff_p.add_argument('new_dir', help='dir with <cloud>/vms.csv from '
+                                        'a fetcher --out-dir run')
+    diff_p.add_argument('--data-dir', default=None)
+    for p in (qa_p, diff_p):
+        p.add_argument('--json', action='store_true',
+                       help='machine-readable output')
+    args = parser.parse_args(argv)
+
+    if args.cmd == 'qa':
+        findings = run_qa(args.data_dir)
+        errors = [f for f in findings if f.severity == 'error']
+        if args.json:
+            print(json.dumps([dataclasses.asdict(f) for f in findings],
+                             indent=1))
+        else:
+            for f in findings:
+                print(f.render())
+            print(f'{len(errors)} errors, {len(findings) - len(errors)} '
+                  'warnings')
+        return 1 if errors or (args.strict and findings) else 0
+
+    results = run_diff(args.new_dir, args.data_dir)
+    if args.json:
+        print(json.dumps([dataclasses.asdict(r) for r in results], indent=1))
+    else:
+        for r in results:
+            print(f'=> {r.cloud}: +{len(r.added)} offers, '
+                  f'-{len(r.removed)}, {len(r.price_changed)} price moves')
+            for line in (r.added[:5] + r.removed[:5] + r.price_changed[:5]):
+                print(f'   {line}')
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

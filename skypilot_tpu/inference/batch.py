@@ -43,7 +43,12 @@ def run_batch(engine, requests: List[Dict[str, Any]],
         rid_to_idx[rid] = idx
 
     t0 = time.perf_counter()
-    finished = engine.run_to_completion()
+    # run_to_completion caps at 100k steps per call; large batches
+    # (prompts × max_new_tokens ≫ batch_size × 100k) need more, so
+    # drain until the engine is truly idle rather than truncating.
+    finished: Dict[int, List[int]] = {}
+    while engine.has_work:
+        finished.update(engine.run_to_completion())
     elapsed = time.perf_counter() - t0
     total_tokens = sum(len(t) for t in finished.values())
     out = [None] * len(requests)
@@ -54,6 +59,14 @@ def run_batch(engine, requests: List[Dict[str, Any]],
             'tokens': tokens,
             'num_tokens': len(tokens),
         }
+    missing = [requests[i].get('id', i)
+               for i, rec in enumerate(out) if rec is None]
+    if missing:
+        # A silent null line in the output JSONL looks like success to
+        # downstream consumers; fail the job instead.
+        raise RuntimeError(
+            f'{len(missing)} requests never finished '
+            f'(first few ids: {missing[:5]})')
     sys.stderr.write(
         f'[batch] {len(requests)} requests, {total_tokens} tokens in '
         f'{elapsed:.1f}s ({total_tokens / max(elapsed, 1e-9):.0f} tok/s)\n')

@@ -1,0 +1,205 @@
+"""Catalog QA gate + refresh differ (catalog/analyze.py).
+
+The first test IS the gate the tool exists for: the shipped CSVs must
+be error-free, so a bad catalog commit fails CI the way the reference
+keeps catalogs honest by hand-running its analyze.py
+(sky/catalog/data_fetchers/analyze.py:1). The rest exercise each check
+on synthetic fixtures.
+"""
+import os
+
+import pandas as pd
+import pytest
+
+from skypilot_tpu.catalog import analyze
+
+
+def _df(rows):
+    return pd.DataFrame(rows, columns=analyze._VM_COLUMNS)
+
+
+def _row(**kw):
+    base = {'instance_type': 'g1', 'accelerator_name': 'A100-80GB',
+            'accelerator_count': 8, 'cpus': 96, 'memory_gb': 768,
+            'price': 12.0, 'spot_price': 4.0, 'region': 'r1',
+            'zone': 'r1-a'}
+    base.update(kw)
+    return base
+
+
+class TestShippedCatalogs:
+
+    def test_qa_gate_zero_errors(self):
+        findings = analyze.run_qa()
+        errors = [f for f in findings if f.severity == 'error']
+        assert not errors, '\n'.join(f.render() for f in errors)
+
+    def test_cli_qa_exits_zero(self, capsys):
+        assert analyze.main(['qa']) == 0
+        assert 'errors' in capsys.readouterr().out
+
+    def test_cli_json_flag_after_subcommand(self, capsys):
+        import json as json_mod
+        assert analyze.main(['qa', '--json']) == 0
+        findings = json_mod.loads(capsys.readouterr().out)
+        assert all({'severity', 'cloud', 'check', 'detail'} <= set(f)
+                   for f in findings)
+
+    def test_covers_every_shipped_cloud(self):
+        # The gate must not silently skip a catalog dir.
+        clouds = analyze._clouds(analyze.common._DATA_DIR)
+        assert len(clouds) >= 16
+        assert {'aws', 'gcp', 'azure', 'lambda'} <= set(clouds)
+
+
+class TestVmChecks:
+
+    def check(self, rows):
+        return {f.check for f in analyze.qa_vms('c', _df(rows))}
+
+    def test_clean_row_passes(self):
+        assert self.check([_row()]) == set()
+
+    def test_duplicate_offer(self):
+        assert 'duplicate-offer' in self.check([_row(), _row()])
+
+    def test_bad_price(self):
+        assert 'bad-price' in self.check([_row(price=0)])
+        assert 'bad-price' in self.check([_row(price=None)])
+
+    def test_spot_above_ondemand(self):
+        assert 'spot-above-ondemand' in self.check(
+            [_row(price=1.0, spot_price=2.0)])
+
+    def test_missing_spot_ok(self):
+        assert self.check([_row(spot_price=None)]) == set()
+
+    def test_accelerator_count_mismatch(self):
+        assert 'accelerator-count' in self.check(
+            [_row(accelerator_count=0)])
+        assert 'accelerator-count' in self.check(
+            [_row(accelerator_name=None, accelerator_count=4)])
+
+    def test_cpu_only_row_ok(self):
+        assert self.check(
+            [_row(accelerator_name=None, accelerator_count=0)]) == set()
+
+    def test_non_canonical_accelerator(self):
+        # The exact failure ADVICE r4 flagged in fetch_oci: vendor
+        # prefix spellings are unmatchable by the optimizer.
+        assert 'non-canonical-accelerator' in self.check(
+            [_row(accelerator_name='NVIDIA-A100-80GB')])
+        assert 'non-canonical-accelerator' in self.check(
+            [_row(accelerator_name='A100-80GB-SXM4')])
+
+    def test_tpu_names_exempt_from_gpu_vocabulary(self):
+        assert self.check(
+            [_row(accelerator_name='tpu-v5e', accelerator_count=4)]) == set()
+
+    def test_missing_column_is_schema_error(self):
+        df = _df([_row()]).drop(columns=['price'])
+        assert {f.check for f in analyze.qa_vms('c', df)} == {'schema'}
+
+    def test_nan_count_is_an_error_not_a_pass(self):
+        # NaN fails both <=0 and >0; the gate must not let an empty
+        # count cell through (nor crash on a non-numeric one).
+        assert 'accelerator-count' in self.check(
+            [_row(accelerator_count=None)])
+        assert 'accelerator-count' in self.check(
+            [_row(accelerator_count='eight')])
+
+    def test_nan_count_excluded_from_cross_cloud_prices(self):
+        frames = {'a': _df([_row(accelerator_count=None)]),
+                  'b': _df([_row()]), 'c': _df([_row()])}
+        # Must neither crash nor produce NaN-poisoned outliers.
+        warns = analyze.qa_cross_cloud(frames)
+        assert not [f for f in warns if f.check == 'price-outlier']
+
+
+class TestTpuChecks:
+
+    def test_shipped_gcp_tpus_clean(self):
+        df = pd.read_csv(os.path.join(analyze.common._DATA_DIR, 'gcp',
+                                      'tpus.csv'))
+        assert analyze.qa_tpus('gcp', df) == []
+
+    def test_spot_above_ondemand(self):
+        df = pd.DataFrame([{'generation': 'tpu-v5e', 'region': 'r',
+                            'zone': 'r-a', 'price_per_chip': 1.0,
+                            'spot_price_per_chip': 2.0}])
+        assert [f.check for f in analyze.qa_tpus('gcp', df)] == [
+            'spot-above-ondemand']
+
+
+class TestCrossCloud:
+
+    def test_price_outlier_flags_unit_bug(self):
+        # One cloud reporting cents-as-dollars: 100x the median.
+        frames = {
+            'a': _df([_row(price=8.0)]),
+            'b': _df([_row(price=10.0)]),
+            'c': _df([_row(price=1000.0)]),
+        }
+        warns = analyze.qa_cross_cloud(frames)
+        assert any(f.check == 'price-outlier' and f.cloud == 'c'
+                   for f in warns)
+
+    def test_agreeing_prices_pass(self):
+        frames = {'a': _df([_row(price=8.0)]),
+                  'b': _df([_row(price=10.0)]),
+                  'c': _df([_row(price=12.0)])}
+        assert not [f for f in analyze.qa_cross_cloud(frames)
+                    if f.check == 'price-outlier']
+
+    def test_single_cloud_vocab_warns(self):
+        frames = {'a': _df([_row(accelerator_name='B300',
+                                 accelerator_count=8)])}
+        warns = analyze.qa_cross_cloud(frames)
+        assert any(f.check == 'single-cloud-accelerator' for f in warns)
+
+
+class TestDiff:
+
+    def test_added_removed_and_price_moves(self, tmp_path):
+        old_dir = tmp_path / 'old'
+        new_dir = tmp_path / 'new'
+        for d in (old_dir, new_dir):
+            (d / 'x').mkdir(parents=True)
+        _df([_row(), _row(instance_type='gone')]).to_csv(
+            old_dir / 'x' / 'vms.csv', index=False)
+        _df([_row(price=13.0), _row(instance_type='fresh')]).to_csv(
+            new_dir / 'x' / 'vms.csv', index=False)
+        (res,) = analyze.run_diff(str(new_dir), data_dir=str(old_dir))
+        assert res.cloud == 'x'
+        assert len(res.added) == 1 and 'fresh' in res.added[0]
+        assert len(res.removed) == 1 and 'gone' in res.removed[0]
+        assert len(res.price_changed) == 1 and '13.0' in res.price_changed[0]
+        assert res.total == 3
+
+    def test_identical_catalogs_diff_empty(self, tmp_path):
+        old_dir = tmp_path / 'old'
+        new_dir = tmp_path / 'new'
+        for d in (old_dir, new_dir):
+            (d / 'x').mkdir(parents=True)
+            _df([_row()]).to_csv(d / 'x' / 'vms.csv', index=False)
+        (res,) = analyze.run_diff(str(new_dir), data_dir=str(old_dir))
+        assert res.total == 0
+
+    def test_identical_nan_prices_are_not_a_price_move(self, tmp_path):
+        # NaN != NaN: an unguarded tuple compare reports an unchanged
+        # priceless offer as changed on every diff, forever.
+        old_dir = tmp_path / 'old'
+        new_dir = tmp_path / 'new'
+        for d in (old_dir, new_dir):
+            (d / 'x').mkdir(parents=True)
+            _df([_row(price=None, spot_price=None)]).to_csv(
+                d / 'x' / 'vms.csv', index=False)
+        (res,) = analyze.run_diff(str(new_dir), data_dir=str(old_dir))
+        assert res.price_changed == []
+
+    def test_cli_diff(self, tmp_path, capsys):
+        new_dir = tmp_path / 'new'
+        (new_dir / 'aws').mkdir(parents=True)
+        _df([_row()]).to_csv(new_dir / 'aws' / 'vms.csv', index=False)
+        assert analyze.main(['diff', str(new_dir)]) == 0
+        assert 'aws' in capsys.readouterr().out

@@ -290,20 +290,31 @@ def _run_train_recipe(run: str, tmp_path) -> None:
 def _run_serve_recipe(run: str, port: int) -> None:
     import json
     import subprocess
+    import tempfile
     import time
     import urllib.error
     import urllib.request
+    # Server logs go to a file, not a PIPE nobody drains: past a pipe
+    # buffer of JAX logs the server's write() would block and the test
+    # would "time out waiting for health" instead of reporting why.
+    logf = tempfile.NamedTemporaryFile('w+', suffix='.serve.log',
+                                       delete=False)
     proc = subprocess.Popen(run, shell=True, env=_subprocess_env(),
-                            stdout=subprocess.PIPE,
-                            stderr=subprocess.STDOUT, text=True)
+                            stdout=logf, stderr=subprocess.STDOUT,
+                            text=True)
+
+    def _log_tail() -> str:
+        logf.flush()
+        with open(logf.name, encoding='utf-8', errors='replace') as f:
+            return f.read()[-3000:]
+
     try:
         deadline = time.time() + 300
         url = f'http://127.0.0.1:{port}'
         while time.time() < deadline:
             if proc.poll() is not None:
                 raise AssertionError(
-                    f'server died rc={proc.returncode}: '
-                    f'{proc.stdout.read()[-3000:]}')
+                    f'server died rc={proc.returncode}: {_log_tail()}')
             try:
                 with urllib.request.urlopen(url + '/health',
                                             timeout=2):
@@ -311,7 +322,8 @@ def _run_serve_recipe(run: str, port: int) -> None:
             except (urllib.error.URLError, ConnectionError, OSError):
                 time.sleep(1)
         else:
-            raise AssertionError('server never became healthy')
+            raise AssertionError(
+                f'server never became healthy: {_log_tail()}')
         req = urllib.request.Request(
             url + '/generate',
             data=json.dumps({'prompt_tokens': [3, 7, 11],
