@@ -41,6 +41,16 @@ _OFFER_KEY = ['instance_type', 'region', 'zone', 'accelerator_name',
 _PRICE_OUTLIER_RATIO = 8.0
 
 
+def _num(v) -> float:
+    """Cell → float; NaN for missing OR non-numeric. Pandas loads a
+    mixed column as object, so a fetcher bug like '$1.20' arrives as
+    str — the gate must report it, not crash on float()."""
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return float('nan')
+
+
 @dataclasses.dataclass(frozen=True)
 class Finding:
     severity: str  # 'error' | 'warn'
@@ -112,20 +122,21 @@ def qa_vms(cloud: str, df) -> List[Finding]:
 
     for _, row in df.iterrows():
         key = _offer_key(row)
-        price = row['price']
-        if pd.isna(price) or float(price) <= 0:
-            err('bad-price', f'{key}: price={price!r}')
+        price = _num(row['price'])
+        if price != price or price <= 0:  # NaN, non-numeric, or <= 0
+            err('bad-price', f'{key}: price={row["price"]!r}')
             continue
-        spot = row['spot_price']
-        if not pd.isna(spot) and float(spot) > float(price):
-            err('spot-above-ondemand',
-                f'{key}: spot {spot} > on-demand {price}')
+        raw_spot = row['spot_price']
+        if not pd.isna(raw_spot):  # missing spot is legitimate
+            spot = _num(raw_spot)
+            if spot != spot:
+                err('bad-price', f'{key}: spot_price={raw_spot!r}')
+            elif spot > price:
+                err('spot-above-ondemand',
+                    f'{key}: spot {raw_spot} > on-demand {price}')
         acc = row['accelerator_name']
         acc = '' if pd.isna(acc) else str(acc)
-        try:
-            count = float(row['accelerator_count'])
-        except (TypeError, ValueError):
-            count = float('nan')
+        count = _num(row['accelerator_count'])
         if count != count:  # NaN: empty or non-numeric cell
             # NaN fails both <=0 and >0, so without this branch a
             # malformed count sails through the row checks AND poisons
@@ -167,16 +178,24 @@ def qa_tpus(cloud: str, df) -> List[Finding]:
                                     f'tpus.csv {key} appears {n} times'))
     for _, row in df.iterrows():
         key = f"{row['generation']}/{row['zone']}"
-        price = row['price_per_chip']
-        if pd.isna(price) or float(price) <= 0:
-            findings.append(Finding('error', cloud, 'bad-price',
-                                    f'tpus.csv {key}: {price!r}'))
-            continue
-        spot = row['spot_price_per_chip']
-        if not pd.isna(spot) and float(spot) > float(price):
+        price = _num(row['price_per_chip'])
+        if price != price or price <= 0:
             findings.append(Finding(
-                'error', cloud, 'spot-above-ondemand',
-                f'tpus.csv {key}: spot {spot} > on-demand {price}'))
+                'error', cloud, 'bad-price',
+                f'tpus.csv {key}: {row["price_per_chip"]!r}'))
+            continue
+        raw_spot = row['spot_price_per_chip']
+        if not pd.isna(raw_spot):
+            spot = _num(raw_spot)
+            if spot != spot:
+                findings.append(Finding(
+                    'error', cloud, 'bad-price',
+                    f'tpus.csv {key}: spot {raw_spot!r}'))
+            elif spot > price:
+                findings.append(Finding(
+                    'error', cloud, 'spot-above-ondemand',
+                    f'tpus.csv {key}: spot {raw_spot} > on-demand '
+                    f'{price}'))
     return findings
 
 
@@ -191,22 +210,19 @@ def qa_cross_cloud(frames: Dict[str, 'object']) -> List[Finding]:
     # accelerator -> [(cloud, key, per_gpu_price)]
     per_gpu: Dict[str, List] = {}
     for cloud, df in frames.items():
-        if not len(df) or 'accelerator_name' not in df.columns:
-            continue
+        if not len(df) or any(c not in df.columns for c in _VM_COLUMNS):
+            continue  # schema error already reported by qa_vms
         for _, row in df.iterrows():
             acc = row['accelerator_name']
             if pd.isna(acc) or not str(acc):
                 continue
-            try:
-                count = float(row['accelerator_count'])
-            except (TypeError, ValueError):
-                continue  # already an error in qa_vms
-            price = row['price']
-            if (pd.isna(count) or count <= 0 or pd.isna(price)
-                    or float(price) <= 0):
+            count = _num(row['accelerator_count'])
+            price = _num(row['price'])
+            if (count != count or count <= 0
+                    or price != price or price <= 0):
                 continue  # already an error in qa_vms
             per_gpu.setdefault(str(acc), []).append(
-                (cloud, _offer_key(row), float(price) / count))
+                (cloud, _offer_key(row), price / count))
     import statistics
     for acc, rows in sorted(per_gpu.items()):
         clouds = sorted({c for c, _, _ in rows})
@@ -256,12 +272,14 @@ def diff_catalogs(cloud: str, old_df, new_df) -> DiffResult:
         if not len(df):
             return out
         for _, row in df.iterrows():
-            price, spot = row['price'], row['spot_price']
             # NaN != NaN, so unguarded NaNs report an unchanged offer
-            # as a price move on every diff.
+            # as a price move on every diff; _num also absorbs
+            # non-numeric cells (qa reports those, diff must not die).
+            price = _num(row['price'])
+            spot = _num(row['spot_price'])
             out[_offer_key(row)] = (
-                None if pd.isna(price) else float(price),
-                None if pd.isna(spot) else float(spot))
+                None if price != price else price,
+                None if spot != spot else spot)
         return out
 
     old, new = index(old_df), index(new_df)

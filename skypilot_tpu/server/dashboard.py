@@ -271,6 +271,15 @@ dl{display:grid;grid-template-columns:140px 1fr;gap:4px 14px;
 dt{color:#8b949e}
 dd{margin:0;color:#e6edf3}
 .crumb{color:#8b949e;font-size:12px;margin-bottom:6px}
+.toolbar{display:flex;gap:10px;align-items:center;margin-top:10px}
+.toolbar input{background:#0d1117;color:#c9d1d9;
+    border:1px solid #30363d;border-radius:6px;padding:5px 8px;
+    font-size:12px;width:220px}
+.count{color:#8b949e;font-size:11px}
+th.sort{cursor:pointer;user-select:none}
+th.sort:hover{color:#e6edf3}
+.pager{display:flex;gap:8px;align-items:center;margin-top:10px;
+    color:#8b949e;font-size:12px}
 button.mini{background:#21262d;border:1px solid #30363d;
     color:#c9d1d9;padding:2px 8px;margin-right:4px;border-radius:6px;
     cursor:pointer;font-size:11px}
@@ -325,11 +334,31 @@ function cell(col,v){const td=document.createElement('td');
     td.appendChild(a)}
   else td.textContent=v==null?'':v;
   return td}
-function makeTable(cols,rows,clickTab){
+// Per-tab table view state (filter text, sort column/direction,
+// page). Lives outside the DOM so the 5s auto-refresh re-render
+// can't reset what the user set up.
+const PAGE_SIZE=25,VIEW={};
+function view(tab){
+  return VIEW[tab]||(VIEW[tab]={q:'',sort:null,dir:1,page:0})}
+function rowText(r){return Object.values(r)
+  .map(v=>String(v==null?'':v)).join(' ').toLowerCase()}
+function cmp(a,b){
+  const sa=String(a==null?'':a),sb=String(b==null?'':b);
+  // Number(), not parseFloat(): '45s ago' must compare as a string,
+  // not as 45 (units would invert the order vs '2m ago').
+  const na=Number(sa),nb=Number(sb);
+  if(sa!==''&&sb!==''&&!isNaN(na)&&!isNaN(nb))return na-nb;
+  return sa.localeCompare(sb)}
+function makeTable(cols,rows,clickTab,v){
   const table=document.createElement('table');
   const hr=document.createElement('tr');
   cols.forEach(c=>{const th=document.createElement('th');
-    th.textContent=c;hr.appendChild(th)});
+    th.textContent=c+(v&&v.sort===c?(v.dir>0?' \\u25b2':' \\u25bc'):'');
+    if(v){th.className='sort';
+      th.addEventListener('click',()=>{
+        if(v.sort===c)v.dir=-v.dir;else{v.sort=c;v.dir=1}
+        renderList(clickTab)})}
+    hr.appendChild(th)});
   table.appendChild(hr);
   rows.forEach(r=>{const tr=document.createElement('tr');
     cols.forEach(c=>tr.appendChild(cell(c,r[c])));
@@ -339,11 +368,35 @@ function makeTable(cols,rows,clickTab){
     table.appendChild(tr)});
   return table}
 function renderList(tab){
-  const m=document.getElementById('content');m.innerHTML='';
-  const rows=state[tab]||[];
-  if(rows.length)m.appendChild(makeTable(TABS[tab],rows,tab));
-  else{const d=document.createElement('div');d.className='empty';
-    d.textContent='nothing here yet';m.appendChild(d)}}
+  const m=document.getElementById('content');
+  const act=document.activeElement;
+  const hadFocus=act&&act.id==='flt';
+  const caret=hadFocus?act.selectionStart:0;
+  m.innerHTML='';
+  const v=view(tab),all=state[tab]||[];
+  const q=v.q.toLowerCase();
+  let rows=q?all.filter(r=>rowText(r).includes(q)):all.slice();
+  if(v.sort)rows.sort((a,b)=>cmp(a[v.sort],b[v.sort])*v.dir);
+  const pages=Math.max(1,Math.ceil(rows.length/PAGE_SIZE));
+  if(v.page>=pages)v.page=pages-1;
+  const slice=rows.slice(v.page*PAGE_SIZE,(v.page+1)*PAGE_SIZE);
+  const inp=el('input',{id:'flt',placeholder:'filter',value:v.q});
+  inp.addEventListener('input',()=>{v.q=inp.value;v.page=0;
+    renderList(tab)});
+  m.appendChild(el('div',{class:'toolbar'},inp,
+    el('span',{class:'count'},rows.length===all.length?
+      String(all.length):rows.length+' of '+all.length)));
+  if(slice.length)m.appendChild(makeTable(TABS[tab],slice,tab,v));
+  else m.appendChild(el('div',{class:'empty'},
+    q?'no matches':'nothing here yet'));
+  if(pages>1)m.appendChild(el('div',{class:'pager'},
+    btn('\\u2039 prev',()=>{if(v.page>0){v.page--;renderList(tab)}}),
+    el('span',{},'page '+(v.page+1)+' / '+pages),
+    btn('next \\u203a',()=>{
+      if(v.page<pages-1){v.page++;renderList(tab)}})));
+  if(hadFocus){const f=document.getElementById('flt');f.focus();
+    const p=Math.min(caret,f.value.length);
+    f.setSelectionRange(p,p)}}
 function renderDetail(doc,tab){
   const m=document.getElementById('content');m.innerHTML='';
   const crumb=document.createElement('div');crumb.className='crumb';

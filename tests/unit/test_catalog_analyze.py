@@ -108,6 +108,12 @@ class TestVmChecks:
         assert 'accelerator-count' in self.check(
             [_row(accelerator_count='eight')])
 
+    def test_non_numeric_price_is_bad_price_not_crash(self):
+        # '$1.20' isn't in pandas' NA set: it must surface as a
+        # finding, not a ValueError traceback with zero findings.
+        assert 'bad-price' in self.check([_row(price='$1.20')])
+        assert 'bad-price' in self.check([_row(spot_price='n/a')])
+
     def test_nan_count_excluded_from_cross_cloud_prices(self):
         frames = {'a': _df([_row(accelerator_count=None)]),
                   'b': _df([_row()]), 'c': _df([_row()])}
@@ -156,6 +162,21 @@ class TestCrossCloud:
                                  accelerator_count=8)])}
         warns = analyze.qa_cross_cloud(frames)
         assert any(f.check == 'single-cloud-accelerator' for f in warns)
+
+    def test_schema_broken_frame_skipped_not_crashed(self):
+        # A frame missing 'price' already produced a schema error in
+        # qa_vms; the cross-cloud pass must skip it, not KeyError and
+        # mask that finding.
+        broken = _df([_row()]).drop(columns=['price'])
+        frames = {'a': broken, 'b': _df([_row()])}
+        analyze.qa_cross_cloud(frames)  # must not raise
+
+    def test_run_qa_reports_schema_error_end_to_end(self, tmp_path):
+        (tmp_path / 'x').mkdir()
+        _df([_row()]).drop(columns=['price']).to_csv(
+            tmp_path / 'x' / 'vms.csv', index=False)
+        findings = analyze.run_qa(str(tmp_path))
+        assert any(f.check == 'schema' for f in findings)
 
 
 class TestDiff:

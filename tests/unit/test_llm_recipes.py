@@ -335,6 +335,8 @@ def _run_serve_recipe(run: str, port: int) -> None:
     finally:
         proc.kill()
         proc.wait(timeout=30)
+        logf.close()
+        os.unlink(logf.name)
 
 
 def _run_batch_recipe(run: str, tmp_path) -> None:
