@@ -68,6 +68,9 @@ class DiffResult:
     added: List[str]
     removed: List[str]
     price_changed: List[str]  # 'key: old -> new'
+    # Set when either side is schema-broken (missing columns): the
+    # differ reports it instead of KeyErroring over QA's own finding.
+    error: Optional[str] = None
 
     @property
     def total(self) -> int:
@@ -267,6 +270,14 @@ def diff_catalogs(cloud: str, old_df, new_df) -> DiffResult:
     moves it doesn't report)."""
     import pandas as pd
 
+    needed = set(_OFFER_KEY) | {'price', 'spot_price'}
+    for side, df in (('checked-in', old_df), ('fetched', new_df)):
+        missing = [c for c in sorted(needed) if c not in df.columns]
+        if len(df.columns) and missing:
+            return DiffResult(cloud, [], [], [],
+                              error=f'{side} catalog is missing '
+                                    f'columns {missing}')
+
     def index(df):
         out = {}
         if not len(df):
@@ -338,11 +349,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(json.dumps([dataclasses.asdict(r) for r in results], indent=1))
     else:
         for r in results:
+            if r.error:
+                print(f'=> {r.cloud}: ERROR: {r.error}')
+                continue
             print(f'=> {r.cloud}: +{len(r.added)} offers, '
                   f'-{len(r.removed)}, {len(r.price_changed)} price moves')
             for line in (r.added[:5] + r.removed[:5] + r.price_changed[:5]):
                 print(f'   {line}')
-    return 0
+    return 1 if any(r.error for r in results) else 0
 
 
 if __name__ == '__main__':

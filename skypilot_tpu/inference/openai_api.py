@@ -1,0 +1,390 @@
+"""OpenAI-compatible API for the inference server.
+
+The reference's serving recipes (llm/vllm/serve.yaml:26,
+llm/sglang/README.md, llm/tgi/) all expose the OpenAI HTTP surface
+from a third-party engine; here the in-tree TPU engine speaks it
+natively, so any OpenAI SDK / curl script pointed at a tsky service
+endpoint works unchanged:
+
+  GET  /v1/models           -> the one served model
+  POST /v1/completions      -> text or token-id prompts (the OpenAI
+                               spec allows both), optional SSE stream
+  POST /v1/chat/completions -> messages through the tokenizer's chat
+                               template, optional SSE stream
+
+Text in/out needs a tokenizer: pass --tokenizer (a HF tokenizer dir /
+name loaded via transformers) to `inference.server`. Without one the
+server stays tokenizer-free and /v1/completions still accepts
+token-id prompts (returning a `tokens` field and `"text": null`);
+string prompts, chat, and `stop` strings then 400/501 with a clear
+message.
+
+Deliberate scope (documented, enforced with 400s rather than silently
+wrong results): n=1 per prompt (batch by sending a prompt LIST —
+continuous batching packs them), no logprobs/echo/best_of, top_p only
+at its 1.0 no-op default (the engine samples with top_k; see
+engine.SamplingParams). `stop` strings truncate the emitted text; the
+slot still decodes to its natural end (no per-request abort), so cost
+is bounded by max_tokens.
+"""
+import asyncio
+import json
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def load_tokenizer(name_or_path: str):
+    """HF tokenizer via transformers (baked into the image); loaded
+    lazily off the serving thread by server._load."""
+    from transformers import AutoTokenizer
+    return AutoTokenizer.from_pretrained(name_or_path)
+
+
+class _BadRequest(Exception):
+    pass
+
+
+def _normalize_prompts(prompt: Any, tokenizer) -> List[List[int]]:
+    """OpenAI `prompt` → list of token lists. The spec allows a
+    string, a list of strings, a token array, or a list of token
+    arrays."""
+    if isinstance(prompt, str):
+        if tokenizer is None:
+            raise _BadRequest(
+                'string prompts need a server-side tokenizer; start '
+                'the server with --tokenizer, or send token ids')
+        return [tokenizer.encode(prompt)]
+    if isinstance(prompt, list) and prompt:
+        if all(isinstance(p, str) for p in prompt):
+            if tokenizer is None:
+                raise _BadRequest(
+                    'string prompts need a server-side tokenizer; '
+                    'start the server with --tokenizer, or send '
+                    'token ids')
+            return [tokenizer.encode(p) for p in prompt]
+        if all(isinstance(p, int) and not isinstance(p, bool)
+               for p in prompt):
+            return [list(prompt)]
+        if all(isinstance(p, list) and p
+               and all(isinstance(t, int) and not isinstance(t, bool)
+                       for t in p) for p in prompt):
+            return [list(p) for p in prompt]
+    raise _BadRequest(
+        'prompt must be a string, a list of strings, a token array, '
+        'or a list of non-empty token arrays')
+
+
+def _parse_common(body: Dict[str, Any], tokenizer):
+    """Shared request validation → (SamplingParams, stop strings)."""
+    from skypilot_tpu.inference.engine import SamplingParams
+    for field, ok in (('n', lambda v: v in (None, 1)),
+                      ('best_of', lambda v: v in (None, 1)),
+                      # logprobs=0 is a real request in the OpenAI
+                      # spec (logprob of the sampled token), so only
+                      # absence passes — falsy 0 must 400 too.
+                      ('logprobs', lambda v: v is None),
+                      ('echo', lambda v: not v),
+                      ('top_p', lambda v: v is None or v == 1
+                       or v == 1.0)):
+        if not ok(body.get(field)):
+            raise _BadRequest(
+                f'{field}={body.get(field)!r} is not supported; this '
+                'server samples with top_k (see --help) and batches '
+                'via prompt lists')
+    stop = body.get('stop')
+    if stop is None:
+        stops: List[str] = []
+    elif isinstance(stop, str):
+        stops = [stop]
+    elif (isinstance(stop, list)
+          and all(isinstance(s, str) and s for s in stop)):
+        stops = list(stop)
+    else:
+        raise _BadRequest('stop must be a string or list of strings')
+    if stops and tokenizer is None:
+        raise _BadRequest('stop strings need a server-side tokenizer '
+                          '(--tokenizer)')
+    eos = body.get('eos_token_id')
+    if eos is None and tokenizer is not None:
+        eos = tokenizer.eos_token_id
+    try:
+        sampling = SamplingParams(
+            temperature=float(body.get('temperature', 1.0)),
+            top_k=int(body.get('top_k', 0)),
+            max_new_tokens=int(body.get('max_tokens', 16)),
+            eos_token_id=eos)
+    except (TypeError, ValueError) as e:
+        raise _BadRequest(f'bad sampling field: {e}') from e
+    return sampling, stops
+
+
+def _finish_reason(tokens: List[int], sampling) -> str:
+    return ('length' if len(tokens) >= sampling.max_new_tokens
+            else 'stop')
+
+
+def _decode(tokenizer, tokens: List[int]) -> str:
+    """skip_special_tokens: the engine finishes WITH the eos id in the
+    generated tokens, and OpenAI text must not carry '</s>' /
+    '<|eot_id|>' junk."""
+    return tokenizer.decode(tokens, skip_special_tokens=True)
+
+
+def _stable_len(text: str) -> int:
+    """Length of the emission-safe prefix: byte-level BPE decode of a
+    token prefix can end in U+FFFD while a multi-byte char is split
+    across tokens — never emit that tail (the next token replaces it
+    with the real char and the text can even shrink)."""
+    n = len(text)
+    while n > 0 and text[n - 1] == '\ufffd':
+        n -= 1
+    return n
+
+
+def _apply_stops(text: str, stops: List[str]) -> Tuple[str, bool]:
+    cut = min((text.find(s) for s in stops if s in text),
+              default=-1)
+    if cut >= 0:
+        return text[:cut], True
+    return text, False
+
+
+async def _collect(watcher) -> List[int]:
+    while True:
+        kind, payload = await watcher.q.get()
+        if kind == 'done':
+            return payload
+        if kind == 'error':
+            raise RuntimeError(payload)
+
+
+def add_openai_routes(app, holder: Dict[str, Any]) -> None:
+    """Mount /v1 on the server's aiohttp app. `holder` is the same
+    dict server.main feeds create_app: 'loop' (EngineLoop),
+    'model_name', 'tokenizer' (optional)."""
+    from aiohttp import web
+
+    def _model_name() -> str:
+        return holder.get('model_name') or 'model'
+
+    async def models(request):
+        return web.json_response({
+            'object': 'list',
+            'data': [{'id': _model_name(), 'object': 'model',
+                      'owned_by': 'skypilot-tpu'}]})
+
+    def _ready():
+        loop = holder.get('loop')
+        if loop is None:
+            raise web.HTTPServiceUnavailable(
+                text=json.dumps({'error': 'model loading'}),
+                content_type='application/json')
+        return loop
+
+    async def completions(request):
+        return await _serve(request, chat=False)
+
+    async def chat_completions(request):
+        return await _serve(request, chat=True)
+
+    async def _serve(request, chat: bool):
+        engine_loop = _ready()
+        tokenizer = holder.get('tokenizer')
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _err400('body must be JSON')
+        try:
+            sampling, stops = _parse_common(body, tokenizer)
+            if chat:
+                prompts = [_chat_prompt(body, tokenizer)]
+            else:
+                prompts = _normalize_prompts(body.get('prompt'),
+                                             tokenizer)
+        except _BadRequest as e:
+            return _err400(str(e))
+        stream = bool(body.get('stream', False))
+        rid = (f'chatcmpl-{uuid.uuid4().hex}' if chat
+               else f'cmpl-{uuid.uuid4().hex}')
+        created = int(time.time())
+        watchers = [engine_loop.submit(p, sampling, stream=stream)
+                    for p in prompts]
+        if stream:
+            return await _stream(request, watchers, prompts, sampling,
+                                 stops, tokenizer, rid, created, chat)
+        try:
+            outs = await asyncio.gather(*map(_collect, watchers))
+        except RuntimeError as e:
+            return web.json_response({'error': str(e)}, status=500)
+        choices = []
+        for i, tokens in enumerate(outs):
+            finish = _finish_reason(tokens, sampling)
+            text = None
+            if tokenizer is not None:
+                text, stopped = _apply_stops(
+                    _decode(tokenizer, tokens), stops)
+                if stopped:
+                    finish = 'stop'
+            if chat:
+                choices.append({
+                    'index': i, 'finish_reason': finish,
+                    'message': {'role': 'assistant', 'content': text}})
+            else:
+                choice = {'index': i, 'text': text,
+                          'finish_reason': finish}
+                if tokenizer is None:
+                    choice['tokens'] = tokens  # documented extension
+                choices.append(choice)
+        n_prompt = sum(len(p) for p in prompts)
+        n_out = sum(len(t) for t in outs)
+        return web.json_response({
+            'id': rid,
+            'object': 'chat.completion' if chat else 'text_completion',
+            'created': created, 'model': _model_name(),
+            'choices': choices,
+            'usage': {'prompt_tokens': n_prompt,
+                      'completion_tokens': n_out,
+                      'total_tokens': n_prompt + n_out}})
+
+    async def _stream(request, watchers, prompts, sampling, stops,
+                      tokenizer, rid, created, chat):
+        resp = web.StreamResponse(headers={
+            'Content-Type': 'text/event-stream',
+            'Cache-Control': 'no-cache'})
+        await resp.prepare(request)
+
+        def chunk(i: int, delta_text: Optional[str],
+                  finish: Optional[str], first: bool,
+                  tokens: Optional[List[int]] = None) -> bytes:
+            if chat:
+                delta: Dict[str, Any] = {}
+                if first:
+                    delta['role'] = 'assistant'
+                if delta_text:
+                    delta['content'] = delta_text
+                choice: Dict[str, Any] = {'index': i, 'delta': delta,
+                                          'finish_reason': finish}
+            else:
+                choice = {'index': i, 'text': delta_text or '',
+                          'finish_reason': finish}
+                if tokens is not None:
+                    choice['tokens'] = tokens
+            doc = {'id': rid,
+                   'object': ('chat.completion.chunk' if chat
+                              else 'text_completion'),
+                   'created': created, 'model': _model_name(),
+                   'choices': [choice]}
+            return f'data: {json.dumps(doc)}\n\n'.encode()
+
+        # Merge every watcher's queue into one event stream.
+        merged: asyncio.Queue = asyncio.Queue()
+
+        async def pump(i: int, watcher):
+            while True:
+                kind, payload = await watcher.q.get()
+                await merged.put((i, kind, payload))
+                if kind in ('done', 'error'):
+                    return
+
+        pumps = [asyncio.ensure_future(pump(i, w))
+                 for i, w in enumerate(watchers)]
+        # Hold back a stop-string prefix: a stop split across deltas
+        # must never be half-emitted.
+        holdback = max((len(s) for s in stops), default=1) - 1
+        state = [{'tokens': [], 'emitted': 0, 'first': True,
+                  'live': True} for _ in watchers]
+        pending = len(watchers)
+        try:
+            while pending:
+                i, kind, payload = await merged.get()
+                st = state[i]
+                if kind == 'error':
+                    await resp.write(
+                        f'data: {json.dumps({"error": payload})}\n\n'
+                        .encode())
+                    pending -= 1
+                    continue
+                if not st['live']:
+                    if kind == 'done':
+                        pending -= 1
+                    continue
+                if kind == 'token':
+                    st['tokens'].append(payload)
+                    if tokenizer is None:
+                        await resp.write(chunk(i, None, None,
+                                               st['first'],
+                                               tokens=[payload]))
+                        st['first'] = False
+                        continue
+                    text = _decode(tokenizer, st['tokens'])
+                    cut_text, stopped = _apply_stops(text, stops)
+                    if stopped:
+                        delta = cut_text[st['emitted']:]
+                        await resp.write(chunk(i, delta, 'stop',
+                                               st['first']))
+                        st['live'] = False
+                        st['first'] = False
+                        continue
+                    safe = _stable_len(text) - (holdback if stops
+                                                else 0)
+                    if safe > st['emitted']:
+                        delta = text[st['emitted']:safe]
+                        await resp.write(chunk(i, delta, None,
+                                               st['first']))
+                        st['emitted'] = safe
+                        st['first'] = False
+                else:  # done
+                    pending -= 1
+                    tokens = payload
+                    finish = _finish_reason(tokens, sampling)
+                    if tokenizer is None:
+                        await resp.write(chunk(i, None, finish,
+                                               st['first'],
+                                               tokens=tokens[
+                                                   len(st['tokens']):]))
+                        continue
+                    text = _decode(tokenizer, tokens)
+                    cut_text, stopped = _apply_stops(text, stops)
+                    if stopped:
+                        finish = 'stop'
+                    delta = cut_text[st['emitted']:]
+                    await resp.write(chunk(i, delta, finish,
+                                           st['first']))
+                    st['first'] = False
+            await resp.write(b'data: [DONE]\n\n')
+        finally:
+            for p in pumps:
+                p.cancel()
+        await resp.write_eof()
+        return resp
+
+    def _err400(msg: str):
+        return web.json_response(
+            {'error': {'message': msg, 'type': 'invalid_request_error'}},
+            status=400)
+
+    def _chat_prompt(body: Dict[str, Any], tokenizer) -> List[int]:
+        if tokenizer is None:
+            raise _BadRequest(
+                'chat completions need a server-side tokenizer '
+                '(--tokenizer) with a chat template')
+        messages = body.get('messages')
+        if (not isinstance(messages, list) or not messages
+                or not all(isinstance(m, dict) and 'role' in m
+                           and 'content' in m for m in messages)):
+            raise _BadRequest(
+                'messages must be a non-empty list of '
+                '{"role", "content"} objects')
+        try:
+            ids = tokenizer.apply_chat_template(
+                messages, add_generation_prompt=True, tokenize=True)
+        except Exception as e:  # noqa: BLE001 — template errors are 400s
+            raise _BadRequest(f'chat template failed: {e}') from e
+        if not ids:
+            raise _BadRequest('chat template produced an empty prompt')
+        return list(ids)
+
+    app.router.add_get('/v1/models', models)
+    app.router.add_post('/v1/completions', completions)
+    app.router.add_post('/v1/chat/completions', chat_completions)

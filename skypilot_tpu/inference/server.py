@@ -197,6 +197,8 @@ def create_app(engine_holder: Dict[str, Any]):
     app.router.add_get('/health', health)
     app.router.add_get('/', health)
     app.router.add_post('/generate', generate)
+    from skypilot_tpu.inference import openai_api
+    openai_api.add_openai_routes(app, engine_holder)
     return app
 
 
@@ -239,6 +241,15 @@ def main() -> None:
                         help='Shard serving over a device mesh, e.g. '
                              'tensor=8 on a v5e-8 (models whose '
                              'weights+cache exceed one chip).')
+    parser.add_argument('--tokenizer', default=None,
+                        help='HF tokenizer dir/name (transformers). '
+                             'Enables text prompts, chat templates, '
+                             'and stop strings on the /v1 OpenAI '
+                             'endpoints; without it the server stays '
+                             'tokenizer-free (token-id interface).')
+    parser.add_argument('--served-model-name', default=None,
+                        help='Model id reported by /v1/models '
+                             '(default: --model)')
     parser.add_argument('--prefill-chunk', type=int, default=1024,
                         help='Prompts longer than this prefill as a '
                              'scan of chunk-wide passes (bounds HBM '
@@ -250,10 +261,16 @@ def main() -> None:
     if not args.no_exit_with_parent:
         _watch_parent()
 
-    holder: Dict[str, Any] = {'loop': None}
+    holder: Dict[str, Any] = {
+        'loop': None, 'tokenizer': None,
+        'model_name': args.served_model_name or args.model}
 
     def _load():
         from skypilot_tpu import inference as inf
+        if args.tokenizer:
+            from skypilot_tpu.inference import openai_api
+            holder['tokenizer'] = openai_api.load_tokenizer(
+                args.tokenizer)
         engine = inf.build_engine(
             args.model, checkpoint=args.checkpoint, mesh_arg=args.mesh,
             batch_size=args.batch_size, max_seq_len=args.max_seq_len,

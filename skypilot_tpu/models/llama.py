@@ -100,6 +100,36 @@ CONFIGS: Dict[str, LlamaConfig] = {
     # are exactly llama3-8b geometry (distillation changed weights,
     # not architecture) — an alias so recipes/checkpoints resolve.
     'deepseek-r1-distill-8b': LlamaConfig(attention_impl='flash'),
+    # Llama-2 generation (ref recipes llm/llama-2/, llm/vicuna-llama-2/):
+    # MHA (kv_heads == heads), 4k context, rope theta 1e4, 32000 vocab.
+    'llama2-7b': LlamaConfig(vocab_size=32000, hidden_size=4096,
+                             intermediate_size=11008, num_layers=32,
+                             num_heads=32, num_kv_heads=32,
+                             head_dim=128, max_seq_len=4096,
+                             rope_theta=10000.0,
+                             attention_impl='flash'),
+    'llama2-13b': LlamaConfig(vocab_size=32000, hidden_size=5120,
+                              intermediate_size=13824, num_layers=40,
+                              num_heads=40, num_kv_heads=40,
+                              head_dim=128, max_seq_len=4096,
+                              rope_theta=10000.0,
+                              attention_impl='flash'),
+    # CodeLlama (ref llm/codellama/): llama2-7b geometry retuned for
+    # 16k code context — rope theta 1e6, vocab 32016 (infill specials).
+    'codellama-7b': LlamaConfig(vocab_size=32016, hidden_size=4096,
+                                intermediate_size=11008,
+                                num_layers=32, num_heads=32,
+                                num_kv_heads=32, head_dim=128,
+                                max_seq_len=16384,
+                                rope_theta=1000000.0,
+                                attention_impl='flash'),
+    # Yi-6B (ref llm/yi/): llama arch with aggressive GQA (4 kv heads)
+    # and a 64000 bilingual vocab.
+    'yi-6b': LlamaConfig(vocab_size=64000, hidden_size=4096,
+                         intermediate_size=11008, num_layers=32,
+                         num_heads=32, num_kv_heads=4, head_dim=128,
+                         max_seq_len=4096, rope_theta=5000000.0,
+                         attention_impl='flash'),
     # Small configs for CPU tests / dryruns. head count divisible by
     # tensor axis; seq divisible by context axis.
     'tiny': LlamaConfig(vocab_size=256, hidden_size=64,
@@ -123,7 +153,9 @@ CONFIGS: Dict[str, LlamaConfig] = {
     # (params+grads+bf16 mu+f32 nu ≈ 10 bytes/param). Measured on
     # v5e (2026-07-30): 11,529 tok/s/chip, 53.6% MFU at seq 4096,
     # batch 1, median step 355 ms (6 layers / seq 8192 / batch 2 all
-    # OOM; block 1024 per the r2 sweep).
+    # OOM; block 1024 per the r2 sweep). Remat variants re-measured
+    # 2026-07-31: dots 53.8%, save_attn 53.7% (wash), remat=False
+    # fails to compile (HBM) — dots stays.
     'bench-8b': LlamaConfig(vocab_size=32768, hidden_size=4096,
                             intermediate_size=14336, num_layers=5,
                             num_heads=32, num_kv_heads=8, head_dim=128,

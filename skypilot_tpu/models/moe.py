@@ -75,6 +75,14 @@ class MoeConfig:
 
 CONFIGS: Dict[str, MoeConfig] = {
     'mixtral-8x7b': MoeConfig(),
+    # DBRX-style fine-grained MoE (ref llm/dbrx/): more, smaller
+    # experts with a wider top-k (16 choose 4) and a 32k context.
+    'dbrx-moe': MoeConfig(vocab_size=100352, hidden_size=6144,
+                          intermediate_size=10752, num_layers=40,
+                          num_heads=48, num_kv_heads=8, head_dim=128,
+                          max_seq_len=32768, num_experts=16,
+                          num_experts_per_tok=4,
+                          attention_impl='flash'),
     'tiny-moe': MoeConfig(vocab_size=256, hidden_size=64,
                           intermediate_size=128, num_layers=2,
                           num_heads=4, num_kv_heads=2, head_dim=16,

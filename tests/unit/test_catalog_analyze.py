@@ -218,6 +218,21 @@ class TestDiff:
         (res,) = analyze.run_diff(str(new_dir), data_dir=str(old_dir))
         assert res.price_changed == []
 
+    def test_schema_broken_side_reports_error_not_keyerror(
+            self, tmp_path, capsys):
+        old_dir = tmp_path / 'old'
+        new_dir = tmp_path / 'new'
+        (old_dir / 'x').mkdir(parents=True)
+        (new_dir / 'x').mkdir(parents=True)
+        _df([_row()]).to_csv(old_dir / 'x' / 'vms.csv', index=False)
+        _df([_row()]).drop(columns=['spot_price']).to_csv(
+            new_dir / 'x' / 'vms.csv', index=False)
+        (res,) = analyze.run_diff(str(new_dir), data_dir=str(old_dir))
+        assert res.error and 'spot_price' in res.error
+        assert analyze.main(['diff', str(new_dir),
+                             '--data-dir', str(old_dir)]) == 1
+        assert 'ERROR' in capsys.readouterr().out
+
     def test_cli_diff(self, tmp_path, capsys):
         new_dir = tmp_path / 'new'
         (new_dir / 'aws').mkdir(parents=True)

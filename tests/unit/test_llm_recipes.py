@@ -103,8 +103,12 @@ def _tiny_run(run: str, tmpdir: str, port: int = 0) -> str:
                  f'--checkpoint-dir {tmpdir}/ckpt', run)
     run = re.sub(r'--checkpoint-every\s+\d+', '--checkpoint-every 10',
                  run)
-    # Serve: random-init weights (no GCS checkpoint on a laptop).
+    # Serve: random-init weights (no GCS checkpoint on a laptop), and
+    # token-id mode (no mounted tokenizer; the dedicated /v1 test
+    # below injects a toy one).
     run = re.sub(r'--checkpoint\s+/\S+', '', run)
+    run = re.sub(r'--tokenizer\s+/\S+', '', run)
+    run = re.sub(r'--prefill-chunk\s+\d+', '--prefill-chunk 16', run)
     if port:
         run = re.sub(r'--port\s+\d+', f'--port {port}', run)
     return run
@@ -374,6 +378,84 @@ def test_recipe_executes(path, tmp_path):
         _run_batch_recipe(run, tmp_path)
     else:
         raise AssertionError(f'unknown entrypoint in {path}')
+
+
+@pytest.mark.slow
+def test_openai_recipe_serves_v1(tmp_path):
+    """llm/serve-openai-api.yaml end-to-end INCLUDING the /v1 text
+    surface: the recipe's server + an offline toy tokenizer answer a
+    chat completion the way an OpenAI SDK would call it."""
+    import json
+    import subprocess
+    import time
+    import urllib.request
+
+    from tokenizers import Tokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+    from transformers import PreTrainedTokenizerFast
+    words = ['[UNK]', '</s>', 'hello', 'world']
+    words += [f'w{i}' for i in range(len(words), 256)]
+    tok = Tokenizer(WordLevel({w: i for i, w in enumerate(words)},
+                              unk_token='[UNK]'))
+    tok.pre_tokenizer = Whitespace()
+    fast = PreTrainedTokenizerFast(tokenizer_object=tok,
+                                   unk_token='[UNK]',
+                                   eos_token='</s>')
+    fast.chat_template = (
+        "{% for m in messages %}{{ m['content'] }} {% endfor %}")
+    tokdir = tmp_path / 'tok'
+    fast.save_pretrained(str(tokdir))
+
+    path = os.path.join(os.path.dirname(__file__), '..', '..', 'llm',
+                        'serve-openai-api.yaml')
+    task = task_lib.Task.from_yaml(path)
+    port = _free_port()
+    run = _tiny_run(task.run, str(tmp_path), port=port)
+    # rstrip: the recipe run ends with a newline — a bare append would
+    # become a SECOND shell command and the server would start
+    # tokenizer-free.
+    run = run.rstrip() + f' --tokenizer {tokdir}'
+    logf = open(tmp_path / 'serve.log', 'w')
+    proc = subprocess.Popen(run, shell=True, env=_subprocess_env(),
+                            stdout=logf, stderr=subprocess.STDOUT,
+                            text=True)
+    try:
+        url = f'http://127.0.0.1:{port}'
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    open(tmp_path / 'serve.log').read()[-3000:])
+            try:
+                with urllib.request.urlopen(url + '/health',
+                                            timeout=2):
+                    break
+            except OSError:
+                time.sleep(1)
+        else:
+            raise AssertionError('never healthy: ' + open(
+                tmp_path / 'serve.log').read()[-3000:])
+        req = urllib.request.Request(
+            url + '/v1/chat/completions',
+            data=json.dumps({
+                'messages': [{'role': 'user',
+                              'content': 'hello world'}],
+                'max_tokens': 4, 'temperature': 0}).encode(),
+            headers={'Content-Type': 'application/json'})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            doc = json.loads(resp.read())
+        (choice,) = doc['choices']
+        assert choice['message']['role'] == 'assistant'
+        assert isinstance(choice['message']['content'], str)
+        assert doc['model'] == 'llama-3-8b'  # --served-model-name
+        models = json.loads(urllib.request.urlopen(
+            url + '/v1/models', timeout=10).read())
+        assert models['data'][0]['id'] == 'llama-3-8b'
+    finally:
+        proc.kill()
+        proc.wait(timeout=30)
+        logf.close()
 
 
 def test_rag_client_retrieval(tmp_path):

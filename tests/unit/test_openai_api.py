@@ -1,0 +1,363 @@
+"""OpenAI-compatible /v1 endpoints on the inference server.
+
+Reference analog: the reference's serving recipes all front third-party
+OpenAI-speaking engines (llm/vllm/serve.yaml:26, llm/sglang/,
+llm/tgi/); here the surface is native. The toy tokenizer is built
+offline (WordLevel over a 256-word vocab matching tiny's vocab_size)
+so decode works for any sampled id.
+"""
+import asyncio
+import json
+
+import jax
+import pytest
+
+from skypilot_tpu import inference
+from skypilot_tpu.inference import server as srv
+from skypilot_tpu.models import llama
+
+
+@pytest.fixture(scope='module')
+def tiny():
+    config = llama.CONFIGS['tiny']
+    params = llama.init_params(config, jax.random.key(7))
+    return config, params
+
+
+@pytest.fixture(scope='module')
+def toytok(tmp_path_factory):
+    from tokenizers import Tokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+    from transformers import AutoTokenizer, PreTrainedTokenizerFast
+    words = ['[UNK]', '</s>', 'hello', 'world', 'foo', 'bar', 'stop',
+             'go']
+    words += [f'w{i}' for i in range(len(words), 256)]
+    vocab = {w: i for i, w in enumerate(words)}
+    tok = Tokenizer(WordLevel(vocab, unk_token='[UNK]'))
+    tok.pre_tokenizer = Whitespace()
+    fast = PreTrainedTokenizerFast(tokenizer_object=tok,
+                                   unk_token='[UNK]', eos_token='</s>')
+    fast.chat_template = (
+        "{% for m in messages %}{{ m['content'] }} {% endfor %}")
+    path = tmp_path_factory.mktemp('toytok')
+    fast.save_pretrained(str(path))
+    return AutoTokenizer.from_pretrained(str(path))
+
+
+def _drive(tiny, tokenizer, coro_fn, batch_size=2):
+    """Run `coro_fn(client)` against a fresh app/engine."""
+    from aiohttp.test_utils import TestClient, TestServer
+    config, params = tiny
+    engine = inference.InferenceEngine(params, config,
+                                       batch_size=batch_size,
+                                       max_seq_len=64)
+    holder = {'loop': srv.EngineLoop(engine), 'tokenizer': tokenizer,
+              'model_name': 'tiny'}
+
+    async def run():
+        client = TestClient(TestServer(srv.create_app(holder)))
+        await client.start_server()
+        try:
+            return await coro_fn(client)
+        finally:
+            await client.close()
+            holder['loop'].stop()
+
+    return asyncio.new_event_loop().run_until_complete(run())
+
+
+def _sse_events(text):
+    out = []
+    for block in text.split('\n\n'):
+        if block.startswith('data: '):
+            out.append(block[len('data: '):])
+    return out
+
+
+class TestModels:
+
+    def test_lists_served_model(self, tiny):
+        async def go(client):
+            r = await client.get('/v1/models')
+            assert r.status == 200
+            doc = await r.json()
+            assert doc['data'][0]['id'] == 'tiny'
+        _drive(tiny, None, go)
+
+
+class TestCompletions:
+
+    def test_token_ids_without_tokenizer(self, tiny):
+        async def go(client):
+            r = await client.post('/v1/completions', json={
+                'prompt': [3, 17, 42], 'max_tokens': 4,
+                'temperature': 0})
+            assert r.status == 200
+            doc = await r.json()
+            (choice,) = doc['choices']
+            assert choice['text'] is None
+            assert len(choice['tokens']) == 4
+            assert choice['finish_reason'] == 'length'
+            assert doc['usage'] == {'prompt_tokens': 3,
+                                    'completion_tokens': 4,
+                                    'total_tokens': 7}
+            assert doc['object'] == 'text_completion'
+        _drive(tiny, None, go)
+
+    def test_string_prompt_with_tokenizer(self, tiny, toytok):
+        async def go(client):
+            r = await client.post('/v1/completions', json={
+                'prompt': 'hello world foo', 'max_tokens': 4,
+                'temperature': 0})
+            assert r.status == 200
+            doc = await r.json()
+            (choice,) = doc['choices']
+            assert isinstance(choice['text'], str) and choice['text']
+            assert 'tokens' not in choice
+            assert doc['usage']['prompt_tokens'] == 3
+        _drive(tiny, toytok, go)
+
+    def test_string_prompt_without_tokenizer_400(self, tiny):
+        async def go(client):
+            r = await client.post('/v1/completions',
+                                  json={'prompt': 'hello'})
+            assert r.status == 400
+            doc = await r.json()
+            assert 'tokenizer' in doc['error']['message']
+        _drive(tiny, None, go)
+
+    def test_prompt_batch_preserves_order(self, tiny, toytok):
+        async def go(client):
+            r = await client.post('/v1/completions', json={
+                'prompt': ['hello world', 'foo bar go'],
+                'max_tokens': 3, 'temperature': 0})
+            doc = await r.json()
+            assert [c['index'] for c in doc['choices']] == [0, 1]
+            assert doc['usage']['prompt_tokens'] == 5
+            assert doc['usage']['completion_tokens'] == 6
+        _drive(tiny, toytok, go)
+
+    def test_unsupported_fields_400(self, tiny, toytok):
+        async def go(client):
+            for body in ({'prompt': 'hello', 'n': 2},
+                         {'prompt': 'hello', 'echo': True},
+                         {'prompt': 'hello', 'logprobs': 3},
+                         # logprobs=0 is a REAL request in the spec
+                         # (sampled-token logprob) — silently ignoring
+                         # falsy 0 would be wrong, not lenient.
+                         {'prompt': 'hello', 'logprobs': 0},
+                         {'prompt': 'hello', 'top_p': 0.5},
+                         {'prompt': 'hello', 'best_of': 4}):
+                r = await client.post('/v1/completions', json=body)
+                assert r.status == 400, body
+            # top_p at its no-op default is accepted:
+            r = await client.post('/v1/completions', json={
+                'prompt': 'hello', 'top_p': 1.0, 'max_tokens': 2,
+                'temperature': 0})
+            assert r.status == 200
+        _drive(tiny, toytok, go)
+
+    def test_stop_string_truncates(self, tiny, toytok):
+        async def go(client):
+            base = await client.post('/v1/completions', json={
+                'prompt': 'hello world', 'max_tokens': 6,
+                'temperature': 0})
+            words = (await base.json())['choices'][0]['text'].split()
+            assert len(words) >= 2
+            r = await client.post('/v1/completions', json={
+                'prompt': 'hello world', 'max_tokens': 6,
+                'temperature': 0, 'stop': words[1]})
+            doc = await r.json()
+            (choice,) = doc['choices']
+            # Greedy decode repeats, so truncation lands before the
+            # second word.
+            assert choice['text'].split() == words[:1]
+            assert choice['finish_reason'] == 'stop'
+        _drive(tiny, toytok, go)
+
+    def test_stop_without_tokenizer_400(self, tiny):
+        async def go(client):
+            r = await client.post('/v1/completions', json={
+                'prompt': [1, 2], 'stop': 'x'})
+            assert r.status == 400
+        _drive(tiny, None, go)
+
+    def test_bad_prompts_400(self, tiny, toytok):
+        async def go(client):
+            for prompt in (None, [], [[]], [1.5, 2], [True, False],
+                           {'a': 1}):
+                r = await client.post('/v1/completions',
+                                      json={'prompt': prompt})
+                assert r.status == 400, prompt
+        _drive(tiny, toytok, go)
+
+
+class TestDecodeHygiene:
+
+    def test_decode_skips_special_tokens(self, tiny):
+        """The engine finishes WITH the eos id in the generated
+        tokens; the decode contract must strip registered specials so
+        '</s>'-style junk never reaches an OpenAI client."""
+        calls = []
+
+        class StubTok:
+            eos_token_id = None  # don't trigger early eos
+
+            def encode(self, s):
+                return [2, 3]
+
+            def decode(self, tokens, skip_special_tokens=False):
+                calls.append(skip_special_tokens)
+                return ' '.join(f'w{t}' for t in tokens)
+
+        async def go(client):
+            r = await client.post('/v1/completions', json={
+                'prompt': 'x', 'max_tokens': 3, 'temperature': 0})
+            assert r.status == 200
+        _drive(tiny, StubTok(), go)
+        assert calls and all(calls)
+
+    def test_stable_len_excludes_partial_utf8(self):
+        from skypilot_tpu.inference import openai_api as oai
+        assert oai._stable_len('hello') == 5
+        # Byte-level BPE mid-char: trailing U+FFFD must be held back.
+        assert oai._stable_len('hé�') == 2
+        assert oai._stable_len('a��') == 1
+        assert oai._stable_len('�') == 0
+        # Interior (already-final) replacement chars are the decoded
+        # truth, not a partial char — only the tail is unstable.
+        assert oai._stable_len('a�b') == 3
+
+
+class TestChatCompletions:
+
+    def test_chat_roundtrip(self, tiny, toytok):
+        async def go(client):
+            r = await client.post('/v1/chat/completions', json={
+                'messages': [{'role': 'user',
+                              'content': 'hello world'}],
+                'max_tokens': 4, 'temperature': 0})
+            assert r.status == 200
+            doc = await r.json()
+            assert doc['object'] == 'chat.completion'
+            (choice,) = doc['choices']
+            assert choice['message']['role'] == 'assistant'
+            assert isinstance(choice['message']['content'], str)
+            assert doc['usage']['prompt_tokens'] == 2
+        _drive(tiny, toytok, go)
+
+    def test_chat_without_tokenizer_400(self, tiny):
+        async def go(client):
+            r = await client.post('/v1/chat/completions', json={
+                'messages': [{'role': 'user', 'content': 'hi'}]})
+            assert r.status == 400
+        _drive(tiny, None, go)
+
+    def test_bad_messages_400(self, tiny, toytok):
+        async def go(client):
+            for messages in (None, [], 'hi', [{'role': 'user'}]):
+                r = await client.post('/v1/chat/completions',
+                                      json={'messages': messages})
+                assert r.status == 400, messages
+        _drive(tiny, toytok, go)
+
+
+class TestStreaming:
+
+    def test_stream_matches_nonstream(self, tiny, toytok):
+        async def go(client):
+            full = await client.post('/v1/completions', json={
+                'prompt': 'hello world', 'max_tokens': 5,
+                'temperature': 0})
+            want = (await full.json())['choices'][0]['text']
+            r = await client.post('/v1/completions', json={
+                'prompt': 'hello world', 'max_tokens': 5,
+                'temperature': 0, 'stream': True})
+            assert r.status == 200
+            assert r.headers['Content-Type'].startswith(
+                'text/event-stream')
+            events = _sse_events(await r.text())
+            assert events[-1] == '[DONE]'
+            text = ''
+            finish = None
+            for ev in events[:-1]:
+                doc = json.loads(ev)
+                (choice,) = doc['choices']
+                text += choice['text']
+                finish = choice['finish_reason'] or finish
+            assert text == want
+            assert finish == 'length'
+        _drive(tiny, toytok, go)
+
+    def test_stream_token_mode(self, tiny):
+        async def go(client):
+            r = await client.post('/v1/completions', json={
+                'prompt': [3, 17, 42], 'max_tokens': 4,
+                'temperature': 0, 'stream': True})
+            events = _sse_events(await r.text())
+            assert events[-1] == '[DONE]'
+            tokens = []
+            for ev in events[:-1]:
+                doc = json.loads(ev)
+                tokens.extend(doc['choices'][0].get('tokens') or [])
+            assert len(tokens) == 4
+        _drive(tiny, None, go)
+
+    def test_stream_chat_deltas(self, tiny, toytok):
+        async def go(client):
+            r = await client.post('/v1/chat/completions', json={
+                'messages': [{'role': 'user', 'content': 'hello'}],
+                'max_tokens': 3, 'temperature': 0, 'stream': True})
+            events = _sse_events(await r.text())
+            assert events[-1] == '[DONE]'
+            first = json.loads(events[0])
+            assert first['object'] == 'chat.completion.chunk'
+            assert first['choices'][0]['delta'].get('role') == (
+                'assistant')
+            content = ''.join(
+                json.loads(ev)['choices'][0]['delta'].get('content', '')
+                for ev in events[:-1])
+            assert content.strip()
+        _drive(tiny, toytok, go)
+
+    def test_stream_stop_holds_back_prefix(self, tiny, toytok):
+        async def go(client):
+            base = await client.post('/v1/completions', json={
+                'prompt': 'hello world', 'max_tokens': 6,
+                'temperature': 0})
+            words = (await base.json())['choices'][0]['text'].split()
+            r = await client.post('/v1/completions', json={
+                'prompt': 'hello world', 'max_tokens': 6,
+                'temperature': 0, 'stream': True, 'stop': words[1]})
+            events = _sse_events(await r.text())
+            text = ''.join(json.loads(ev)['choices'][0]['text']
+                           for ev in events[:-1])
+            assert words[1] not in text
+            finishes = [json.loads(ev)['choices'][0]['finish_reason']
+                        for ev in events[:-1]]
+            assert finishes[-1] == 'stop'
+        _drive(tiny, toytok, go)
+
+
+class TestLoading:
+
+    def test_503_while_loading(self, tiny):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        async def run():
+            holder = {'loop': None, 'tokenizer': None,
+                      'model_name': 'tiny'}
+            client = TestClient(TestServer(srv.create_app(holder)))
+            await client.start_server()
+            try:
+                r = await client.post('/v1/completions',
+                                      json={'prompt': [1]})
+                assert r.status == 503
+                r2 = await client.post('/v1/chat/completions', json={
+                    'messages': [{'role': 'user', 'content': 'x'}]})
+                assert r2.status == 503
+            finally:
+                await client.close()
+
+        asyncio.new_event_loop().run_until_complete(run())
