@@ -87,6 +87,10 @@ def main() -> None:
     parser.add_argument('--top-k', type=int, default=0)
     parser.add_argument('--mesh', default=None,
                         help='Shard over a device mesh, e.g. tensor=8')
+    parser.add_argument('--kv-quant', default='none',
+                        choices=['none', 'int8'],
+                        help='int8 KV cache (see inference.server '
+                             '--help)')
     args = parser.parse_args()
 
     from skypilot_tpu import inference as inf
@@ -98,7 +102,8 @@ def main() -> None:
 
     engine = inf.build_engine(
         args.model, checkpoint=args.checkpoint, mesh_arg=args.mesh,
-        batch_size=args.batch_size, max_seq_len=args.max_seq_len)
+        batch_size=args.batch_size, max_seq_len=args.max_seq_len,
+        kv_quant=args.kv_quant)
     default_sampling = inf.SamplingParams(
         temperature=args.temperature, top_k=args.top_k,
         max_new_tokens=args.max_new_tokens)

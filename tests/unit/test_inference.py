@@ -546,3 +546,100 @@ def test_long_context_16k_prefill_and_context_sharded_decode(tiny):
     rid = sharded.submit(prompt, inference.SamplingParams(
         temperature=0.0, max_new_tokens=steps))
     assert sharded.run_to_completion()[rid] == flash_tokens
+
+
+class TestKvQuant:
+    """int8 KV cache (engine.quantize_kv / kv_quant='int8'): half the
+    cache HBM traffic and footprint for absmax error far below bf16
+    attention noise. Reference analog: none in-tree (vLLM's fp8 KV
+    cache is the ecosystem equivalent)."""
+
+    def test_quantize_roundtrip_error_bound(self):
+        import numpy as np
+        x = jax.random.normal(jax.random.key(3), (4, 7, 2, 32),
+                              jnp.bfloat16) * 3.0
+        q = inference.engine.quantize_kv(x)
+        assert q['q'].dtype == jnp.int8
+        assert q['s'].shape == x.shape[:-1]
+        back = (q['q'].astype(jnp.float32)
+                * q['s'][..., None])
+        ref = np.asarray(x, np.float32)
+        denom = np.abs(ref).max(axis=-1, keepdims=True)
+        rel = np.abs(np.asarray(back) - ref) / np.maximum(denom, 1e-9)
+        # absmax int8: max error is (scale/2)/amax = 1/254 per row.
+        assert rel.max() <= (1 / 254) + 1e-3
+
+    def test_zero_rows_are_safe(self):
+        q = inference.engine.quantize_kv(jnp.zeros((2, 3, 4)))
+        assert int(jnp.max(jnp.abs(q['q']))) == 0
+        assert bool(jnp.all(jnp.isfinite(q['s'])))
+
+    def test_greedy_decode_matches_bf16_engine(self, tiny):
+        config, params = tiny
+        prompt = [5, 11, 2, 9]
+        steps = 8
+        base = inference.InferenceEngine(params, config, batch_size=2,
+                                         max_seq_len=64)
+        rid = base.submit(prompt, inference.SamplingParams(
+            temperature=0.0, max_new_tokens=steps))
+        expected = base.run_to_completion()[rid]
+
+        quant = inference.InferenceEngine(params, config, batch_size=2,
+                                          max_seq_len=64,
+                                          kv_quant='int8')
+        cache_k = quant.state.cache['k']
+        assert cache_k['q'].dtype == jnp.int8
+        rid = quant.submit(prompt, inference.SamplingParams(
+            temperature=0.0, max_new_tokens=steps))
+        got = quant.run_to_completion()[rid]
+        # ~0.4% quantization noise should not flip greedy argmaxes on
+        # this model; if an argmax tie ever flips a tail token, the
+        # shared prefix still proves the path end to end.
+        assert got[:4] == expected[:4]
+        assert len(got) == len(expected)
+
+    def test_chunked_prefill_with_quant_cache(self, tiny):
+        """Chunked prefill writes quantized chunks; decode reads them
+        back — the long-context composition."""
+        config, params = tiny
+        prompt = list(range(2, 50))  # 3 chunks of 16
+        eng = inference.InferenceEngine(params, config, batch_size=2,
+                                        max_seq_len=64,
+                                        prefill_chunk=16,
+                                        kv_quant='int8')
+        rid = eng.submit(prompt, inference.SamplingParams(
+            temperature=0.0, max_new_tokens=4))
+        out = eng.run_to_completion()[rid]
+        assert len(out) == 4
+        assert all(0 <= t < config.vocab_size for t in out)
+
+    def test_quant_composes_with_sharded_mesh(self, tiny):
+        """int8 cache + tensor×context mesh: the quantized leaves
+        shard like the bf16 cache did (seq over context, kv_heads
+        over tensor)."""
+        from skypilot_tpu.parallel import MeshSpec, make_mesh
+
+        config, params = tiny
+        mesh = make_mesh(MeshSpec(data=1, fsdp=2, context=2, tensor=2))
+        eng = inference.InferenceEngine(params, config, batch_size=2,
+                                        max_seq_len=64, mesh=mesh,
+                                        kv_quant='int8')
+        kq = eng.state.cache['k']['q']
+        assert kq.sharding.shard_shape(kq.shape)[2] == 32
+        rid = eng.submit([5, 11, 2, 9], inference.SamplingParams(
+            temperature=0.0, max_new_tokens=4))
+        out = eng.run_to_completion()[rid]
+        assert len(out) == 4
+
+    def test_use_flash_conflict_raises(self, tiny):
+        config, params = tiny
+        with pytest.raises(ValueError, match='kv_quant'):
+            inference.InferenceEngine(params, config, batch_size=2,
+                                      max_seq_len=64, use_flash=True,
+                                      kv_quant='int8')
+
+    def test_bad_quant_mode_raises(self, tiny):
+        config, params = tiny
+        with pytest.raises(ValueError, match='int8'):
+            inference.InferenceEngine(params, config, batch_size=2,
+                                      max_seq_len=64, kv_quant='fp4')
