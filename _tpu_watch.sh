@@ -77,7 +77,8 @@ capture_loadgen() {
     # No --no-exit-with-parent: the server must die with this subshell
     # so a killed watcher can't leak an 8B server holding the chip.
     python -m skypilot_tpu.inference.server --model bench-8b \
-        --port 8193 --batch-size 16 --max-seq-len 2048 \
+        --port 8193 --batch-size 32 --max-seq-len 2048 \
+        --kv-quant int8 \
         > "$DIR/serve.log" 2>&1 &
     local srv=$!
     sleep 10
