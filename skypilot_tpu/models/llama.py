@@ -123,6 +123,14 @@ CONFIGS: Dict[str, LlamaConfig] = {
                                 max_seq_len=16384,
                                 rope_theta=1000000.0,
                                 attention_impl='flash'),
+    # Llama-3.2 small models (ref llm/llama-3_2/): 1B/3B for edge and
+    # cheap serving; 3B = 28 layers of 3072/8192 with GQA-8.
+    'llama32-3b': LlamaConfig(vocab_size=128256, hidden_size=3072,
+                              intermediate_size=8192, num_layers=28,
+                              num_heads=24, num_kv_heads=8,
+                              head_dim=128, max_seq_len=8192,
+                              tied_embeddings=True,
+                              attention_impl='flash'),
     # Yi-6B (ref llm/yi/): llama arch with aggressive GQA (4 kv heads)
     # and a 64000 bilingual vocab.
     'yi-6b': LlamaConfig(vocab_size=64000, hidden_size=4096,

@@ -40,3 +40,8 @@ CONFIGS = {
         max_seq_len=128, dtype=jnp.float32, remat=False,
         rope_theta=1000000.0, attn_qkv_bias=True),
 }
+
+# DeepSeek-R1-Distill-Qwen-7B (ref llm/deepseek-r1-distilled/): the
+# qwen2-7b architecture with distilled weights — a true alias (same
+# frozen config object) so the shapes can never silently diverge.
+CONFIGS['deepseek-r1-distill-qwen-7b'] = CONFIGS['qwen2-7b']
