@@ -1,0 +1,69 @@
+"""Talk to a tsky service through its OpenAI-compatible API.
+
+Works against any endpoint serving `llm/serve-openai-api.yaml` (or a
+local `python -m skypilot_tpu.inference.server --tokenizer ...`).
+Plain stdlib so it runs anywhere; the official `openai` SDK works the
+same way — point `base_url` at the endpoint.
+
+    python3 examples/openai_client.py --url http://HOST:8080 \
+        --prompt "Explain TPUs in one sentence." --stream
+"""
+import argparse
+import json
+import sys
+import urllib.request
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--url', required=True,
+                        help='Service endpoint (no /v1 suffix)')
+    parser.add_argument('--prompt', default='Hello!')
+    parser.add_argument('--max-tokens', type=int, default=64)
+    parser.add_argument('--temperature', type=float, default=0.7)
+    parser.add_argument('--stream', action='store_true')
+    parser.add_argument('--completions', action='store_true',
+                        help='Use /v1/completions instead of chat')
+    args = parser.parse_args()
+
+    if args.completions:
+        path, body = '/v1/completions', {
+            'prompt': args.prompt, 'max_tokens': args.max_tokens,
+            'temperature': args.temperature, 'stream': args.stream}
+    else:
+        path, body = '/v1/chat/completions', {
+            'messages': [{'role': 'user', 'content': args.prompt}],
+            'max_tokens': args.max_tokens,
+            'temperature': args.temperature, 'stream': args.stream}
+
+    req = urllib.request.Request(
+        args.url.rstrip('/') + path, data=json.dumps(body).encode(),
+        headers={'Content-Type': 'application/json'})
+    with urllib.request.urlopen(req, timeout=300) as resp:
+        if not args.stream:
+            doc = json.loads(resp.read())
+            choice = doc['choices'][0]
+            text = (choice.get('text')
+                    or choice.get('message', {}).get('content'))
+            print(text)
+            usage = doc['usage']
+            print(f"[{usage['prompt_tokens']} prompt + "
+                  f"{usage['completion_tokens']} completion tokens]",
+                  file=sys.stderr)
+            return
+        for raw in resp:
+            line = raw.decode().strip()
+            if not line.startswith('data: '):
+                continue
+            payload = line[len('data: '):]
+            if payload == '[DONE]':
+                break
+            choice = json.loads(payload)['choices'][0]
+            delta = (choice.get('text')
+                     or choice.get('delta', {}).get('content') or '')
+            print(delta, end='', flush=True)
+        print()
+
+
+if __name__ == '__main__':
+    main()

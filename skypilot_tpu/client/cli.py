@@ -592,6 +592,36 @@ def storage_delete_cmd(names, all_storage, yes):
 
 
 @cli.group()
+def catalog():
+    """Inspect and QA the instance/price catalogs."""
+
+
+@catalog.command('qa')
+@click.option('--strict', is_flag=True,
+              help='Exit non-zero on warnings too.')
+@click.option('--json', 'as_json', is_flag=True)
+def catalog_qa_cmd(strict, as_json):
+    """Health-check the shipped catalog CSVs (duplicate offers, bad or
+    inverted prices, accelerator vocabulary drift, cross-cloud price
+    outliers). The same gate runs in CI."""
+    from skypilot_tpu.catalog import analyze
+    args = ['qa'] + (['--strict'] if strict else []) + \
+        (['--json'] if as_json else [])
+    raise SystemExit(analyze.main(args))
+
+
+@catalog.command('diff')
+@click.argument('new_dir')
+@click.option('--json', 'as_json', is_flag=True)
+def catalog_diff_cmd(new_dir, as_json):
+    """Compare a fresh fetcher run (--out-dir) against the shipped
+    catalogs: offers added/removed and price moves per cloud."""
+    from skypilot_tpu.catalog import analyze
+    args = ['diff', new_dir] + (['--json'] if as_json else [])
+    raise SystemExit(analyze.main(args))
+
+
+@cli.group()
 def workspace():
     """Manage workspaces (reference sky/workspaces/core.py CRUD)."""
 

@@ -266,8 +266,8 @@ def _attn_with_cache(x: jax.Array, layer_params: Params,
         q = q + layer_params['bq']
         k = k + layer_params['bk']
         v = v + layer_params['bv']
-    q = llama._rope(q, positions, c.rope_theta)
-    k = llama._rope(k, positions, c.rope_theta)
+    q = llama._rope(q, positions, c)
+    k = llama._rope(k, positions, c)
     qpa = getattr(c, 'query_pre_attn_scalar', None)
     if qpa is not None:
         q = q * math.sqrt(c.head_dim / qpa)

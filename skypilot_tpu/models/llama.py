@@ -67,6 +67,15 @@ class LlamaConfig:
     # every Nth layer is GLOBAL (gemma2 alternates: 2); 1 = all local.
     sliding_window_pattern: int = 1
     attn_qkv_bias: bool = False         # qwen2: bias on q/k/v projections
+    # llama3-style rope scaling (HF rope_scaling {'rope_type':
+    # 'llama3'}): Llama-3.1 (factor 8) and 3.2 (factor 32) checkpoints
+    # are TRAINED with rescaled low-frequency dims at every position,
+    # so serving them without it decodes off-distribution even at
+    # short contexts. None = unscaled (llama2/llama3.0/qwen/...).
+    rope_scaling_factor: Optional[float] = None
+    rope_scaling_low_freq_factor: float = 1.0
+    rope_scaling_high_freq_factor: float = 4.0
+    rope_scaling_original_max: int = 8192
 
     def num_params(self) -> int:
         e, m, v = self.hidden_size, self.intermediate_size, self.vocab_size
@@ -99,7 +108,10 @@ CONFIGS: Dict[str, LlamaConfig] = {
     # DeepSeek-R1-Distill-Llama-8B: the published distill checkpoints
     # are exactly llama3-8b geometry (distillation changed weights,
     # not architecture) — an alias so recipes/checkpoints resolve.
-    'deepseek-r1-distill-8b': LlamaConfig(attention_impl='flash'),
+    # Base is Llama-3.1-8B, which is TRAINED with llama3 rope scaling
+    # (factor 8) — serving without it decodes off-distribution.
+    'deepseek-r1-distill-8b': LlamaConfig(attention_impl='flash',
+                                          rope_scaling_factor=8.0),
     # Llama-2 generation (ref recipes llm/llama-2/, llm/vicuna-llama-2/):
     # MHA (kv_heads == heads), 4k context, rope theta 1e4, 32000 vocab.
     'llama2-7b': LlamaConfig(vocab_size=32000, hidden_size=4096,
@@ -124,12 +136,14 @@ CONFIGS: Dict[str, LlamaConfig] = {
                                 rope_theta=1000000.0,
                                 attention_impl='flash'),
     # Llama-3.2 small models (ref llm/llama-3_2/): 1B/3B for edge and
-    # cheap serving; 3B = 28 layers of 3072/8192 with GQA-8.
+    # cheap serving; 3B = 28 layers of 3072/8192 with GQA-8, trained
+    # with llama3 rope scaling at factor 32.
     'llama32-3b': LlamaConfig(vocab_size=128256, hidden_size=3072,
                               intermediate_size=8192, num_layers=28,
                               num_heads=24, num_kv_heads=8,
                               head_dim=128, max_seq_len=8192,
                               tied_embeddings=True,
+                              rope_scaling_factor=32.0,
                               attention_impl='flash'),
     # Yi-6B (ref llm/yi/): llama arch with aggressive GQA (4 kv heads)
     # and a 64000 bilingual vocab.
@@ -300,10 +314,32 @@ def _rms_norm(x: jax.Array, weight: jax.Array, eps: float,
     return normed * (1.0 + weight) if plus_one else normed * weight
 
 
-def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+def _rope_freqs(d_half: int, config) -> jax.Array:
+    """Inverse frequencies, with optional llama3-style scaling
+    (HF `rope_scaling` rope_type='llama3': wavelengths longer than
+    original_max/low_freq_factor divide by `factor`, shorter than
+    original_max/high_freq_factor stay, the band between interpolates
+    smoothly). getattr defaults: MoeConfig carries no scaling knobs."""
+    c = config
+    freqs = c.rope_theta ** (-jnp.arange(0, d_half, dtype=jnp.float32)
+                             / d_half)
+    factor = getattr(c, 'rope_scaling_factor', None)
+    if factor is None:
+        return freqs
+    lo = c.rope_scaling_low_freq_factor
+    hi = c.rope_scaling_high_freq_factor
+    orig = c.rope_scaling_original_max
+    wavelen = 2.0 * math.pi / freqs
+    smooth = jnp.clip((orig / wavelen - lo) / (hi - lo), 0.0, 1.0)
+    interp = (1.0 - smooth) * freqs / factor + smooth * freqs
+    return jnp.where(wavelen > orig / lo, freqs / factor,
+                     jnp.where(wavelen < orig / hi, freqs, interp))
+
+
+def _rope(x: jax.Array, positions: jax.Array, config) -> jax.Array:
     """Rotary embedding. x: [B,S,H,D], positions: [S] or [B,S]."""
     d = x.shape[-1]
-    freqs = theta ** (-jnp.arange(0, d // 2, dtype=jnp.float32) / (d // 2))
+    freqs = _rope_freqs(d // 2, config)
     angles = positions.astype(jnp.float32)[..., None] * freqs  # [...,S,D/2]
     if angles.ndim == 2:  # [S, D/2] → broadcast over batch
         angles = angles[None]
@@ -339,8 +375,8 @@ def _layer(x: jax.Array,
         v = v + layer_params['bv']
     q = sharding.shard(q, ('batch', 'seq', 'heads', 'head_dim'), rules)
     k = sharding.shard(k, ('batch', 'seq', 'kv_heads', 'head_dim'), rules)
-    q = _rope(q, positions, c.rope_theta)
-    k = _rope(k, positions, c.rope_theta)
+    q = _rope(q, positions, c)
+    k = _rope(k, positions, c)
     if c.query_pre_attn_scalar is not None:
         # attention scales by head_dim^-0.5; fold in the ratio so the
         # effective scale is query_pre_attn_scalar^-0.5 (gemma2-27b).

@@ -231,8 +231,8 @@ def _layer(x: jax.Array, layer_params: Params, config: MoeConfig,
                    preferred_element_type=jnp.float32).astype(c.dtype)
     v = jnp.einsum('bse,ehd->bshd', h, layer_params['wv'],
                    preferred_element_type=jnp.float32).astype(c.dtype)
-    q = llama._rope(q, positions, c.rope_theta)
-    k = llama._rope(k, positions, c.rope_theta)
+    q = llama._rope(q, positions, c)
+    k = llama._rope(k, positions, c)
     attn = attention_ops.attention(
         q, k, v, causal=True, impl=c.attention_impl, mesh=mesh,
         block_size=c.attention_block_size)

@@ -6,6 +6,8 @@ is llama with bias terms on the attention input projections
 (`attn_qkv_bias`), a 152k vocab, and rope theta 1e6; small variants
 tie embeddings. Shapes follow the published Qwen2/2.5 configs.
 """
+import dataclasses
+
 import jax.numpy as jnp
 
 from skypilot_tpu.models import llama
@@ -42,6 +44,10 @@ CONFIGS = {
 }
 
 # DeepSeek-R1-Distill-Qwen-7B (ref llm/deepseek-r1-distilled/): the
-# qwen2-7b architecture with distilled weights — a true alias (same
-# frozen config object) so the shapes can never silently diverge.
-CONFIGS['deepseek-r1-distill-qwen-7b'] = CONFIGS['qwen2-7b']
+# qwen2-7b geometry with distilled weights. Derived via replace() so
+# the SHAPES can never silently diverge, but rope_theta differs: the
+# distill's base is Qwen2.5-MATH-7B, trained at theta 1e4 (not the
+# chat model's 1e6) — serving with the wrong theta misplaces every
+# position.
+CONFIGS['deepseek-r1-distill-qwen-7b'] = dataclasses.replace(
+    CONFIGS['qwen2-7b'], rope_theta=10000.0)

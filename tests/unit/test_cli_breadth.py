@@ -264,3 +264,26 @@ def test_api_login_stores_credentials(server):
         assert sdk.api_server_url() == 'http://far:46590'
     finally:
         os.environ['SKYTPU_API_SERVER_URL'] = ''
+
+
+def test_catalog_qa_and_diff(tmp_path):
+    """tsky catalog qa/diff wrap the analyzer gate (catalog/analyze.py)
+    with its exit-code contract."""
+    runner = CliRunner()
+    res = runner.invoke(cli_mod.cli, ['catalog', 'qa'])
+    assert res.exit_code == 0, res.output
+    assert 'errors' in res.output
+    # Warnings exist in the shipped catalogs (single-cloud GPUs), so
+    # --strict flips the exit code without changing the findings.
+    strict = runner.invoke(cli_mod.cli, ['catalog', 'qa', '--strict'])
+    assert strict.exit_code == 1
+
+    new_dir = tmp_path / 'fresh'
+    (new_dir / 'aws').mkdir(parents=True)
+    import shutil
+    from skypilot_tpu.catalog import common as cat_common
+    shutil.copy(cat_common.catalog_path('aws', 'vms'),
+                new_dir / 'aws' / 'vms.csv')
+    res = runner.invoke(cli_mod.cli, ['catalog', 'diff', str(new_dir)])
+    assert res.exit_code == 0, res.output
+    assert '+0 offers, -0, 0 price moves' in res.output

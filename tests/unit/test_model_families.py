@@ -283,3 +283,91 @@ def test_qwen_bias_actually_feeds_attention():
     rid = engine.submit(prompt, inference.SamplingParams(
         temperature=0.0, max_new_tokens=8))
     assert engine.run_to_completion()[rid] == ref
+
+
+class TestRopeScaling:
+    """llama3-style rope scaling (Llama-3.1/3.2 checkpoints): the
+    frequency transform must match the published formula or real
+    weights decode off-distribution at every position."""
+
+    def _hf_reference(self, freqs, factor, lo, hi, orig):
+        # Independent reimplementation of HF's llama3 rope scaling.
+        import numpy as np
+        out = []
+        for f in np.asarray(freqs, np.float64):
+            wavelen = 2 * np.pi / f
+            if wavelen < orig / hi:
+                out.append(f)
+            elif wavelen > orig / lo:
+                out.append(f / factor)
+            else:
+                smooth = (orig / wavelen - lo) / (hi - lo)
+                out.append((1 - smooth) * f / factor + smooth * f)
+        return np.array(out, np.float64)
+
+    def test_matches_hf_formula(self):
+        import dataclasses
+
+        import numpy as np
+        from skypilot_tpu.models import llama
+        cfg = dataclasses.replace(llama.CONFIGS['llama3-8b'],
+                                  rope_scaling_factor=8.0)
+        base = np.asarray(llama._rope_freqs(
+            64, dataclasses.replace(cfg, rope_scaling_factor=None)))
+        scaled = np.asarray(llama._rope_freqs(64, cfg))
+        want = self._hf_reference(base, 8.0, 1.0, 4.0, 8192)
+        np.testing.assert_allclose(scaled, want, rtol=1e-5)
+        # The transform must actually bite: lowest frequencies shrink
+        # by the full factor, highest stay identical.
+        assert scaled[-1] < base[-1] / 7.9
+        assert scaled[0] == base[0]
+
+    def test_none_is_unscaled(self):
+        import numpy as np
+        from skypilot_tpu.models import llama
+        cfg = llama.CONFIGS['llama3-8b']
+        assert cfg.rope_scaling_factor is None
+        freqs = np.asarray(llama._rope_freqs(64, cfg))
+        want = cfg.rope_theta ** (-np.arange(64) / 64)
+        np.testing.assert_allclose(freqs, want, rtol=1e-6)
+
+    def test_checkpoint_presets_carry_training_rope(self):
+        from skypilot_tpu.models import llama, qwen
+        # Llama-3.1-based distill: factor 8; Llama-3.2-3B: factor 32.
+        assert llama.CONFIGS[
+            'deepseek-r1-distill-8b'].rope_scaling_factor == 8.0
+        assert llama.CONFIGS['llama32-3b'].rope_scaling_factor == 32.0
+        # Qwen distill base is Qwen2.5-MATH (theta 1e4, not 1e6) but
+        # identical shapes.
+        r1q = qwen.CONFIGS['deepseek-r1-distill-qwen-7b']
+        q2 = qwen.CONFIGS['qwen2-7b']
+        assert r1q.rope_theta == 10000.0
+        assert (r1q.hidden_size, r1q.num_layers, r1q.num_heads) == \
+            (q2.hidden_size, q2.num_layers, q2.num_heads)
+
+    def test_scaled_rope_flows_through_forward_and_decode(self):
+        """A scaled tiny config trains and decodes consistently —
+        cached decode must apply the same frequencies as the training
+        forward (they share _rope via the config)."""
+        import dataclasses
+
+        import jax
+        from skypilot_tpu import inference
+        from skypilot_tpu.models import llama
+        cfg = dataclasses.replace(llama.CONFIGS['tiny'],
+                                  rope_scaling_factor=4.0,
+                                  rope_scaling_original_max=64)
+        params = llama.init_params(cfg, jax.random.key(0))
+        prompt = [5, 11, 2]
+        eng = inference.InferenceEngine(params, cfg, batch_size=1,
+                                        max_seq_len=64)
+        rid = eng.submit(prompt, inference.SamplingParams(
+            temperature=0.0, max_new_tokens=6))
+        got = eng.run_to_completion()[rid]
+        # Greedy reference through the training forward:
+        import jax.numpy as jnp
+        toks = list(prompt)
+        for _ in range(6):
+            logits = llama.forward(params, jnp.array([toks]), cfg)
+            toks.append(int(jnp.argmax(logits[0, -1])))
+        assert got == toks[len(prompt):]
