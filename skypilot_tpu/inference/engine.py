@@ -46,8 +46,18 @@ _NEG_INF = -1e30
 class SamplingParams:
     temperature: float = 0.0     # 0 => greedy
     top_k: int = 0               # 0 => no top-k filtering
+    top_p: float = 1.0           # 1 => no nucleus filtering
     max_new_tokens: int = 128
     eos_token_id: Optional[int] = None
+
+    def __post_init__(self):
+        # Validate at the source so EVERY entry point (HTTP /generate,
+        # /v1, batch, direct engine use) is covered: top_p <= 0 would
+        # empty the nucleus, filter all logits to -inf, and sample
+        # UNIFORMLY over the vocab — garbage with a 200 status.
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(
+                f'top_p must be in (0, 1], got {self.top_p}')
 
 
 def quantize_kv(x: jax.Array) -> Dict[str, jax.Array]:
@@ -550,18 +560,33 @@ def prefill_chunked(params: Params, tokens: jax.Array,
 
 
 def _sample(logits: jax.Array, temperature: jax.Array, top_k: jax.Array,
-            key: jax.Array) -> jax.Array:
-    """Per-slot temperature/top-k sampling; temperature 0 => greedy."""
+            top_p: jax.Array, key: jax.Array) -> jax.Array:
+    """Per-slot temperature/top-k/top-p sampling; temperature 0 =>
+    greedy. Both filters reduce to a per-row logit threshold, so the
+    batch shares one sort and one where()."""
     vocab = logits.shape[-1]
     greedy = jnp.argmax(logits, axis=-1)
-    # top-k filter (top_k == 0 -> keep all).
     sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+    # top-k threshold (top_k == 0 -> keep all).
     k_idx = jnp.clip(top_k - 1, 0, vocab - 1)
     kth = jnp.where(
         top_k > 0,
         jnp.take_along_axis(sorted_logits, k_idx[:, None], axis=-1)[:, 0],
         jnp.full((logits.shape[0],), -jnp.inf, logits.dtype))
-    filtered = jnp.where(logits >= kth[:, None], logits, _NEG_INF)
+    # top-p (nucleus) threshold: probability mass measured at the
+    # sampling temperature (vLLM/HF convention); a token is in the
+    # nucleus when the mass BEFORE it is < top_p, so the crossing
+    # token stays and the first token always qualifies.
+    scaled_sorted = sorted_logits / jnp.maximum(temperature,
+                                               1e-6)[:, None]
+    probs = jax.nn.softmax(scaled_sorted, axis=-1)
+    in_nucleus = (jnp.cumsum(probs, axis=-1) - probs) < top_p[:, None]
+    pth = jnp.min(jnp.where(in_nucleus, sorted_logits, jnp.inf),
+                  axis=-1)
+    pth = jnp.where(top_p >= 1.0,
+                    jnp.full_like(pth, -jnp.inf), pth)
+    thresh = jnp.maximum(kth, pth)
+    filtered = jnp.where(logits >= thresh[:, None], logits, _NEG_INF)
     scaled = filtered / jnp.maximum(temperature, 1e-6)[:, None]
     sampled = jax.random.categorical(key, scaled, axis=-1)
     return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)
@@ -570,7 +595,7 @@ def _sample(logits: jax.Array, temperature: jax.Array, top_k: jax.Array,
 @functools.partial(jax.jit, static_argnames=('config',))
 def decode_step(params: Params, cache: Cache, last_tokens: jax.Array,
                 active: jax.Array, temperature: jax.Array,
-                top_k: jax.Array, key: jax.Array,
+                top_k: jax.Array, top_p: jax.Array, key: jax.Array,
                 config: llama.LlamaConfig
                 ) -> Tuple[jax.Array, Cache]:
     """One token for every slot [B]; inactive slots don't advance."""
@@ -581,7 +606,7 @@ def decode_step(params: Params, cache: Cache, last_tokens: jax.Array,
     logits, new_cache = _forward_with_cache(
         params, last_tokens[:, None], cache, positions, lengths,
         jnp.where(active, new_lengths, lengths), config)
-    next_tokens = _sample(logits[:, 0], temperature, top_k, key)
+    next_tokens = _sample(logits[:, 0], temperature, top_k, top_p, key)
     next_tokens = jnp.where(active, next_tokens, last_tokens)
     # Inactive slots must not grow; restore their cache rows lazily via
     # length (stale writes beyond `length` are invisible to the mask).
@@ -728,6 +753,20 @@ class InferenceEngine:
         return {s.request_id: list(s.generated)
                 for s in self.state.slots if s is not None}
 
+    def abort(self, request_id: int) -> None:
+        """Drop ONE queued or in-flight request (client disconnect,
+        server-side stop strings): its slot frees for the next insert
+        and nothing is reported in finished(). Unknown ids are a
+        no-op — the request may have finished in the same tick."""
+        self._queue = [(rid, t, s) for rid, t, s in self._queue
+                       if rid != request_id]
+        self._finished.pop(request_id, None)
+        for i, slot in enumerate(self.state.slots):
+            if slot is not None and slot.request_id == request_id:
+                self.state.slots[i] = None
+                self.state.cache['length'] = \
+                    self.state.cache['length'].at[i].set(0)
+
     def abort_all(self) -> None:
         """Drop every queued and in-flight request (server error
         recovery): slots free, cache lengths zeroed, nothing reported
@@ -805,7 +844,8 @@ class InferenceEngine:
         temps = jnp.array([s.temperature for _, _, s in inserts],
                           jnp.float32)
         topks = jnp.array([s.top_k for _, _, s in inserts], jnp.int32)
-        first = _sample(logits, temps, topks, sub)
+        topps = jnp.array([s.top_p for _, _, s in inserts], jnp.float32)
+        first = _sample(logits, temps, topks, topps, sub)
         first_host = jax.device_get(first)
         last = jax.device_get(self.state.last_tokens).copy()
         for i, slot in enumerate(slot_ids):
@@ -843,11 +883,14 @@ class InferenceEngine:
         topks = jnp.array(
             [s.params.top_k if s else 0 for s in self.state.slots],
             jnp.int32)
+        topps = jnp.array(
+            [s.params.top_p if s else 1.0 for s in self.state.slots],
+            jnp.float32)
         active = jnp.array(active_mask)
         with self._mesh_ctx():
             next_tokens, self.state.cache = decode_step(
                 self.params, self.state.cache, self.state.last_tokens,
-                active, temps, topks, sub, self.config)
+                active, temps, topks, topps, sub, self.config)
         self.state.last_tokens = next_tokens
         tokens_host = jax.device_get(next_tokens)
         for i, slot in enumerate(self.state.slots):

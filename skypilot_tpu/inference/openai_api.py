@@ -19,13 +19,14 @@ token-id prompts (returning a `tokens` field and `"text": null`);
 string prompts, chat, and `stop` strings then 400/501 with a clear
 message.
 
-Deliberate scope (documented, enforced with 400s rather than silently
-wrong results): n=1 per prompt (batch by sending a prompt LIST —
-continuous batching packs them), no logprobs/echo/best_of, top_p only
-at its 1.0 no-op default (the engine samples with top_k; see
-engine.SamplingParams). `stop` strings truncate the emitted text; the
-slot still decodes to its natural end (no per-request abort), so cost
-is bounded by max_tokens.
+Sampling: temperature, top_k, and top_p (nucleus) all map straight to
+engine.SamplingParams. Deliberate scope (documented, enforced with
+400s rather than silently wrong results): n=1 per prompt (batch by
+sending a prompt LIST — continuous batching packs them), no
+logprobs/echo/best_of. `stop` strings truncate the emitted text; in
+streaming mode the hit also aborts the request (engine.abort) so the
+slot frees immediately, while non-stream requests — whose text is
+only known at the end — decode to their natural end.
 """
 import asyncio
 import json
@@ -84,9 +85,7 @@ def _parse_common(body: Dict[str, Any], tokenizer):
                       # spec (logprob of the sampled token), so only
                       # absence passes — falsy 0 must 400 too.
                       ('logprobs', lambda v: v is None),
-                      ('echo', lambda v: not v),
-                      ('top_p', lambda v: v is None or v == 1
-                       or v == 1.0)):
+                      ('echo', lambda v: not v)):
         if not ok(body.get(field)):
             raise _BadRequest(
                 f'{field}={body.get(field)!r} is not supported; this '
@@ -109,9 +108,16 @@ def _parse_common(body: Dict[str, Any], tokenizer):
     if eos is None and tokenizer is not None:
         eos = tokenizer.eos_token_id
     try:
+        # Explicit null is valid per the OpenAI spec (= default); only
+        # a PRESENT non-null value is parsed, and 0 still rejects.
+        raw_top_p = body.get('top_p')
+        top_p = 1.0 if raw_top_p is None else float(raw_top_p)
+        if not 0.0 < top_p <= 1.0:
+            raise _BadRequest(f'top_p must be in (0, 1], got {top_p}')
         sampling = SamplingParams(
             temperature=float(body.get('temperature', 1.0)),
             top_k=int(body.get('top_k', 0)),
+            top_p=top_p,
             max_new_tokens=int(body.get('max_tokens', 16)),
             eos_token_id=eos)
     except (TypeError, ValueError) as e:
@@ -211,12 +217,25 @@ def add_openai_routes(app, holder: Dict[str, Any]) -> None:
         watchers = [engine_loop.submit(p, sampling, stream=stream)
                     for p in prompts]
         if stream:
-            return await _stream(request, watchers, prompts, sampling,
-                                 stops, tokenizer, rid, created, chat)
+            return await _stream(request, engine_loop, watchers,
+                                 prompts, sampling, stops, tokenizer,
+                                 rid, created, chat)
         try:
             outs = await asyncio.gather(*map(_collect, watchers))
         except RuntimeError as e:
+            # One prompt failed: the 500 covers the whole request, so
+            # free the SIBLING slots too — gather leaves their
+            # _collect tasks running and they'd ghost-decode to
+            # max_tokens.
+            for w in watchers:
+                engine_loop.abort(w)
             return web.json_response({'error': str(e)}, status=500)
+        except asyncio.CancelledError:
+            # Client gone: free the decode slots instead of letting
+            # ghosts run to max_tokens.
+            for w in watchers:
+                engine_loop.abort(w)
+            raise
         choices = []
         for i, tokens in enumerate(outs):
             finish = _finish_reason(tokens, sampling)
@@ -247,8 +266,8 @@ def add_openai_routes(app, holder: Dict[str, Any]) -> None:
                       'completion_tokens': n_out,
                       'total_tokens': n_prompt + n_out}})
 
-    async def _stream(request, watchers, prompts, sampling, stops,
-                      tokenizer, rid, created, chat):
+    async def _stream(request, engine_loop, watchers, prompts,
+                      sampling, stops, tokenizer, rid, created, chat):
         resp = web.StreamResponse(headers={
             'Content-Type': 'text/event-stream',
             'Cache-Control': 'no-cache'})
@@ -293,8 +312,17 @@ def add_openai_routes(app, holder: Dict[str, Any]) -> None:
         # must never be half-emitted.
         holdback = max((len(s) for s in stops), default=1) - 1
         state = [{'tokens': [], 'emitted': 0, 'first': True,
-                  'live': True} for _ in watchers]
+                  'live': True, 'counted': False} for _ in watchers]
         pending = len(watchers)
+
+        def finish_one(st):
+            nonlocal pending
+            # Exactly-once: a stop-aborted request may still race a
+            # 'done' from the same engine tick.
+            if not st['counted']:
+                st['counted'] = True
+                pending -= 1
+
         try:
             while pending:
                 i, kind, payload = await merged.get()
@@ -303,11 +331,11 @@ def add_openai_routes(app, holder: Dict[str, Any]) -> None:
                     await resp.write(
                         f'data: {json.dumps({"error": payload})}\n\n'
                         .encode())
-                    pending -= 1
+                    finish_one(st)
                     continue
                 if not st['live']:
                     if kind == 'done':
-                        pending -= 1
+                        finish_one(st)
                     continue
                 if kind == 'token':
                     st['tokens'].append(payload)
@@ -325,6 +353,10 @@ def add_openai_routes(app, holder: Dict[str, Any]) -> None:
                                                st['first']))
                         st['live'] = False
                         st['first'] = False
+                        # The useful output ended here: free the slot
+                        # instead of decoding to max_tokens.
+                        engine_loop.abort(watchers[i])
+                        finish_one(st)
                         continue
                     safe = _stable_len(text) - (holdback if stops
                                                 else 0)
@@ -335,7 +367,7 @@ def add_openai_routes(app, holder: Dict[str, Any]) -> None:
                         st['emitted'] = safe
                         st['first'] = False
                 else:  # done
-                    pending -= 1
+                    finish_one(st)
                     tokens = payload
                     finish = _finish_reason(tokens, sampling)
                     if tokenizer is None:
@@ -353,6 +385,12 @@ def add_openai_routes(app, holder: Dict[str, Any]) -> None:
                                            st['first']))
                     st['first'] = False
             await resp.write(b'data: [DONE]\n\n')
+        except (asyncio.CancelledError, ConnectionResetError):
+            # Client gone mid-stream: free every slot still decoding.
+            for i, st in enumerate(state):
+                if st['live']:
+                    engine_loop.abort(watchers[i])
+            raise
         finally:
             for p in pumps:
                 p.cancel()

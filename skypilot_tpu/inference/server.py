@@ -39,6 +39,7 @@ class EngineLoop:
             self.stream = stream
             self.q: asyncio.Queue = asyncio.Queue()
             self.sent = 0
+            self.aborted = False
 
         def push(self, item) -> None:
             self.loop.call_soon_threadsafe(self.q.put_nowait, item)
@@ -46,6 +47,7 @@ class EngineLoop:
     def __init__(self, engine) -> None:
         self.engine = engine
         self._submit_q: 'queue.Queue' = queue.Queue()
+        self._abort_q: 'queue.Queue' = queue.Queue()
         self._watchers: Dict[int, EngineLoop.Watcher] = {}
         self._stop = False
         self._thread = threading.Thread(target=self._run, daemon=True)
@@ -62,12 +64,21 @@ class EngineLoop:
     def stop(self) -> None:
         self._stop = True
 
+    def abort(self, watcher: 'EngineLoop.Watcher') -> None:
+        """Free a request's slot (HTTP client gone, or a server-side
+        stop ended the useful output): called from async handlers,
+        applied by the engine thread before its next step."""
+        watcher.aborted = True
+        self._abort_q.put(watcher)
+
     def _drain_submissions(self) -> None:
         while True:
             try:
                 prompt, sampling, watcher = self._submit_q.get_nowait()
             except queue.Empty:
                 return
+            if watcher.aborted:
+                continue  # client vanished before the engine saw it
             try:
                 rid = self.engine.submit(prompt, sampling)
             except Exception as e:  # noqa: BLE001
@@ -77,6 +88,17 @@ class EngineLoop:
                 watcher.push(('error', str(e)))
                 continue
             self._watchers[rid] = watcher
+
+    def _drain_aborts(self) -> None:
+        while True:
+            try:
+                target = self._abort_q.get_nowait()
+            except queue.Empty:
+                return
+            for rid, watcher in list(self._watchers.items()):
+                if watcher is target:
+                    self._watchers.pop(rid)
+                    self.engine.abort(rid)
 
     def _run(self) -> None:
         while not self._stop:
@@ -99,6 +121,7 @@ class EngineLoop:
 
     def _tick(self) -> None:
         self._drain_submissions()
+        self._drain_aborts()
         if not self.engine.has_work:
             # Park on the queue instead of spinning the TPU thread.
             try:
@@ -127,6 +150,7 @@ def _parse_sampling(body: Dict[str, Any]):
     return inf.SamplingParams(
         temperature=float(body.get('temperature', 0.0)),
         top_k=int(body.get('top_k', 0)),
+        top_p=float(body.get('top_p', 1.0)),
         max_new_tokens=int(body.get('max_new_tokens', 64)),
         eos_token_id=body.get('eos_token_id'))
 
@@ -160,38 +184,45 @@ def create_app(engine_holder: Dict[str, Any]):
         stream = bool(body.get('stream', False))
         watcher = engine_loop.submit(prompt, sampling, stream=stream)
 
-        if not stream:
+        # A vanished client (handler cancelled, connection reset) must
+        # free its decode slot — otherwise ghosts occupy the batch
+        # until max_new_tokens.
+        try:
+            if not stream:
+                while True:
+                    kind, payload = await watcher.q.get()
+                    if kind == 'done':
+                        return web.json_response({'tokens': payload})
+                    if kind == 'error':
+                        return web.json_response({'error': payload},
+                                                 status=500)
+
+            resp = web.StreamResponse(headers={
+                'Content-Type': 'text/event-stream',
+                'Cache-Control': 'no-cache'})
+            await resp.prepare(request)
             while True:
                 kind, payload = await watcher.q.get()
-                if kind == 'done':
-                    return web.json_response({'tokens': payload})
-                if kind == 'error':
-                    return web.json_response({'error': payload},
-                                             status=500)
-
-        resp = web.StreamResponse(headers={
-            'Content-Type': 'text/event-stream',
-            'Cache-Control': 'no-cache'})
-        await resp.prepare(request)
-        while True:
-            kind, payload = await watcher.q.get()
-            if kind == 'token':
-                await resp.write(
-                    f'data: {json.dumps({"token": payload})}\n\n'
-                    .encode())
-            elif kind == 'error':
-                await resp.write(
-                    f'data: {json.dumps({"error": payload})}\n\n'
-                    .encode())
-                break
-            else:
-                await resp.write(
-                    ('data: '
-                     f'{json.dumps({"done": True, "tokens": payload})}'
-                     '\n\n').encode())
-                break
-        await resp.write_eof()
-        return resp
+                if kind == 'token':
+                    await resp.write(
+                        f'data: {json.dumps({"token": payload})}\n\n'
+                        .encode())
+                elif kind == 'error':
+                    await resp.write(
+                        f'data: {json.dumps({"error": payload})}\n\n'
+                        .encode())
+                    break
+                else:
+                    await resp.write(
+                        ('data: '
+                         f'{json.dumps({"done": True, "tokens": payload})}'
+                         '\n\n').encode())
+                    break
+            await resp.write_eof()
+            return resp
+        except (asyncio.CancelledError, ConnectionResetError):
+            engine_loop.abort(watcher)
+            raise
 
     app = web.Application()
     app.router.add_get('/health', health)

@@ -643,3 +643,107 @@ class TestKvQuant:
         with pytest.raises(ValueError, match='int8'):
             inference.InferenceEngine(params, config, batch_size=2,
                                       max_seq_len=64, kv_quant='fp4')
+
+
+class TestAbortAndTopP:
+    """Per-request abort (client disconnects, server-side stops) and
+    nucleus sampling."""
+
+    def test_abort_in_flight_frees_slot(self, tiny):
+        config, params = tiny
+        eng = inference.InferenceEngine(params, config, batch_size=1,
+                                        max_seq_len=64)
+        keep = eng.submit([5, 11], inference.SamplingParams(
+            temperature=0.0, max_new_tokens=4))
+        ghost = eng.submit([9, 8], inference.SamplingParams(
+            temperature=0.0, max_new_tokens=40))
+        eng.step()  # ghost queued behind the 1-slot batch? keep first
+        # Whichever is decoding, abort the long one; the short one
+        # must finish and the slot must recycle.
+        eng.abort(ghost)
+        out = eng.run_to_completion()
+        assert keep in out and len(out[keep]) == 4
+        assert ghost not in out
+        assert not eng.has_work
+
+    def test_abort_queued_request(self, tiny):
+        config, params = tiny
+        eng = inference.InferenceEngine(params, config, batch_size=1,
+                                        max_seq_len=64)
+        a = eng.submit([5], inference.SamplingParams(
+            temperature=0.0, max_new_tokens=2))
+        b = eng.submit([7], inference.SamplingParams(
+            temperature=0.0, max_new_tokens=2))  # waits in queue
+        eng.abort(b)
+        out = eng.run_to_completion()
+        assert a in out and b not in out
+
+    def test_abort_unknown_id_noop(self, tiny):
+        config, params = tiny
+        eng = inference.InferenceEngine(params, config, batch_size=1,
+                                        max_seq_len=64)
+        eng.abort(12345)  # must not raise
+
+    def test_engine_loop_abort_via_watcher(self, tiny):
+        import asyncio
+        import time as time_lib
+
+        from skypilot_tpu.inference import server as srv
+        config, params = tiny
+        engine = inference.InferenceEngine(params, config,
+                                           batch_size=1,
+                                           max_seq_len=64)
+
+        async def drive():
+            loop = srv.EngineLoop(engine)
+            try:
+                ghost = loop.submit([3, 4], inference.SamplingParams(
+                    temperature=0.0, max_new_tokens=50), stream=False)
+                await asyncio.sleep(0.3)  # let it start decoding
+                loop.abort(ghost)
+                keep = loop.submit([5, 6], inference.SamplingParams(
+                    temperature=0.0, max_new_tokens=3), stream=False)
+                deadline = time_lib.time() + 30
+                while time_lib.time() < deadline:
+                    kind, payload = await asyncio.wait_for(
+                        keep.q.get(), timeout=30)
+                    if kind == 'done':
+                        assert len(payload) == 3
+                        return
+                raise AssertionError('keep request never finished')
+            finally:
+                loop.stop()
+
+        asyncio.new_event_loop().run_until_complete(drive())
+
+    def test_top_p_tiny_nucleus_is_greedy(self, tiny, engine2):
+        """top_p→0 keeps only the argmax: sampling at temperature 1
+        must match greedy decoding."""
+        config, params = tiny
+        prompt = [5, 11, 2]
+        rid_g = engine2.submit(prompt, inference.SamplingParams(
+            temperature=0.0, max_new_tokens=5))
+        greedy = engine2.run_to_completion()[rid_g]
+        rid_p = engine2.submit(prompt, inference.SamplingParams(
+            temperature=1.0, top_p=1e-6, max_new_tokens=5))
+        nucleus = engine2.run_to_completion()[rid_p]
+        assert nucleus == greedy
+
+    def test_bad_top_p_rejected_at_the_source(self):
+        """SamplingParams validates so EVERY entry point (HTTP,
+        batch, direct) rejects the uniform-garbage configuration."""
+        with pytest.raises(ValueError, match='top_p'):
+            inference.SamplingParams(top_p=0.0)
+        with pytest.raises(ValueError, match='top_p'):
+            inference.SamplingParams(top_p=1.5)
+
+    def test_top_p_one_is_noop_filter(self, tiny, engine2):
+        """top_p=1.0 must not alter the sampled distribution's
+        support: all sampled tokens stay within the vocab and the
+        request completes (smoke for the threshold disable path)."""
+        config, _ = tiny
+        rid = engine2.submit([5, 11], inference.SamplingParams(
+            temperature=1.0, top_p=1.0, max_new_tokens=5))
+        out = engine2.run_to_completion()[rid]
+        assert len(out) == 5
+        assert all(0 <= t < config.vocab_size for t in out)

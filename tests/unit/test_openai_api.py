@@ -147,15 +147,35 @@ class TestCompletions:
                          # (sampled-token logprob) — silently ignoring
                          # falsy 0 would be wrong, not lenient.
                          {'prompt': 'hello', 'logprobs': 0},
-                         {'prompt': 'hello', 'top_p': 0.5},
+                         {'prompt': 'hello', 'top_p': 0.0},
+                         {'prompt': 'hello', 'top_p': 1.5},
                          {'prompt': 'hello', 'best_of': 4}):
                 r = await client.post('/v1/completions', json=body)
                 assert r.status == 400, body
-            # top_p at its no-op default is accepted:
+        _drive(tiny, toytok, go)
+
+    def test_top_p_null_is_default(self, tiny, toytok):
+        # Explicit null is valid per the spec (nullable field).
+        async def go(client):
             r = await client.post('/v1/completions', json={
-                'prompt': 'hello', 'top_p': 1.0, 'max_tokens': 2,
+                'prompt': 'hello', 'top_p': None, 'max_tokens': 2,
                 'temperature': 0})
             assert r.status == 200
+        _drive(tiny, toytok, go)
+
+    def test_top_p_supported(self, tiny, toytok):
+        async def go(client):
+            # A vanishingly small nucleus keeps only the argmax, so
+            # top_p sampling at temperature 1 must reproduce greedy.
+            greedy = await client.post('/v1/completions', json={
+                'prompt': 'hello world', 'max_tokens': 4,
+                'temperature': 0})
+            want = (await greedy.json())['choices'][0]['text']
+            r = await client.post('/v1/completions', json={
+                'prompt': 'hello world', 'max_tokens': 4,
+                'temperature': 1.0, 'top_p': 1e-6})
+            assert r.status == 200
+            assert (await r.json())['choices'][0]['text'] == want
         _drive(tiny, toytok, go)
 
     def test_stop_string_truncates(self, tiny, toytok):
