@@ -236,20 +236,20 @@ def _decode_bench(jax, on_tpu: bool):
         slots = jnp.arange(b, dtype=jnp.int32)
 
         # Prefill (compile, then timed runs against a fresh cache).
-        # bf16 cache: use_flash matches what unsharded TPU serving
-        # actually runs (engine.py _use_flash, the Pallas prefill
-        # path). int8 cache: flash reads bf16, so chunked dense —
-        # chunk 128 bounds the [.., T, S] scores.
+        # use_flash matches what unsharded TPU serving actually runs
+        # (engine.py _use_flash): the bf16 Pallas kernel, or
+        # flash_attention_quant reading the int8 cache directly.
         if kv_quant == 'none':
             def pf():
                 return eng.prefill(params, prompts, lengths, cache,
                                    slots, cfg, use_flash=on_tpu)
         else:
-            chunk = 128 if on_tpu else 8
+            chunk = 512 if on_tpu else 8
             def pf():
                 return eng.prefill_chunked(params, prompts, lengths,
                                            cache, slots, cfg,
-                                           chunk=chunk)
+                                           chunk=chunk,
+                                           use_flash=on_tpu)
         logits, filled = pf()
         float(logits.sum())
         prefill_ts = []

@@ -185,16 +185,23 @@ def _cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     equivalence-tested end-to-end (test_inference.py).
     """
     quant = _is_quant(k_cache)
-    if (q_offset is not None and not quant and _flash_prefill_ok(
-            q.shape[1], k_cache.shape[1], q.shape[3])):
+    k_arr = k_cache['q'] if quant else k_cache
+    if (q_offset is not None and _flash_prefill_ok(
+            q.shape[1], k_arr.shape[1], q.shape[3])):
         from skypilot_tpu.ops import flash_attention as fa_lib
+        if quant:
+            return fa_lib.flash_attention_quant(
+                q, k_cache['q'], k_cache['s'],
+                v_cache['q'], v_cache['s'], causal=True,
+                block_q=min(512, q.shape[1]),
+                block_k=min(512, k_arr.shape[1]),
+                window=window, softcap=softcap, q_offset=q_offset)
         return fa_lib.flash_attention(
             q, k_cache, v_cache, causal=True,
             block_q=min(512, q.shape[1]),
             block_k=min(512, k_cache.shape[1]),
             window=window, softcap=softcap, q_offset=q_offset)
     num_heads = q.shape[2]
-    k_arr = k_cache['q'] if quant else k_cache
     b, s, hkv, d = k_arr.shape
     t = q.shape[1]
     group = num_heads // hkv
@@ -691,17 +698,8 @@ class InferenceEngine:
                 'use_flash=True is incompatible with a sharded engine '
                 '(pallas_call has no GSPMD partitioning rules); omit '
                 'use_flash or serve unsharded.')
-        if use_flash and kv_quant != 'none':
-            # The Pallas kernel reads bf16 k/v; a quantized cache
-            # routes through the dense chunked path (still
-            # memory-bounded) rather than silently dequantizing the
-            # whole cache per chunk.
-            raise ValueError(
-                'use_flash=True is incompatible with kv_quant '
-                '(the flash kernel reads bf16 caches); omit one.')
         if use_flash is None:
-            use_flash = (mesh is None and kv_quant == 'none'
-                         and jax.default_backend() == 'tpu')
+            use_flash = mesh is None and jax.default_backend() == 'tpu'
         self._use_flash = bool(use_flash)
         if mesh is not None:
             # Tensor-parallel serving: params shard by their logical

@@ -88,9 +88,9 @@ def _parse_common(body: Dict[str, Any], tokenizer):
                       ('echo', lambda v: not v)):
         if not ok(body.get(field)):
             raise _BadRequest(
-                f'{field}={body.get(field)!r} is not supported; this '
-                'server samples with top_k (see --help) and batches '
-                'via prompt lists')
+                f'{field}={body.get(field)!r} is not supported; '
+                'sampling is temperature/top_k/top_p, and batching is '
+                'via prompt lists (continuous batching packs them)')
     stop = body.get('stop')
     if stop is None:
         stops: List[str] = []

@@ -93,10 +93,21 @@ def _block_visible(q_start, k_start, w_ref, *, causal, windowed, bq, bk):
     return cond  # None = statically always visible
 
 
-def _fwd_kernel(w_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
-                m_ref, l_ref, *, causal: bool, windowed: bool,
-                softcap: Optional[float], scale: float, bq: int, bk: int,
-                n_kv_blocks: int):
+def _fwd_kernel(w_ref, q_ref, k_ref, v_ref, *rest, causal: bool,
+                windowed: bool, softcap: Optional[float], scale: float,
+                bq: int, bk: int, n_kv_blocks: int,
+                quant: bool = False):
+    # quant mode (int8 KV cache, engine.quantize_kv): k/v arrive int8
+    # with per-position f32 absmax scales riding two extra refs. The
+    # scale is constant over the contracted D axis, so it factors out
+    # of both dots: scores scale by ks per kv COLUMN, and vs folds
+    # into p before the pv dot. HBM reads the cache at half width.
+    if quant:
+        (ks_ref, vs_ref, o_ref, lse_ref,
+         acc_ref, m_ref, l_ref) = rest
+    else:
+        ks_ref = vs_ref = None
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
     iq = pl.program_id(2)
     ik = pl.program_id(3)
 
@@ -113,11 +124,17 @@ def _fwd_kernel(w_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
         # Keep operands in their native (bf16) dtype so the MXU runs at
         # full rate; accumulate f32 via preferred_element_type.
         q = q_ref[0, 0]                               # [bq, d]
-        k = k_ref[0, 0]                               # [bk, d]
-        v = v_ref[0, 0]                               # [bk, d]
+        if quant:
+            k = k_ref[0, 0].astype(q.dtype)
+            v = v_ref[0, 0].astype(q.dtype)
+        else:
+            k = k_ref[0, 0]                           # [bk, d]
+            v = v_ref[0, 0]                           # [bk, d]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk] f32
+        if quant:
+            s = s * ks_ref[0, 0][:, 0][None, :]       # per-column ks
         s, _ = _score_mods(s, q_start, k_start, w_ref, causal=causal,
                            windowed=windowed, softcap=softcap, bq=bq,
                            bk=bk)
@@ -129,6 +146,8 @@ def _fwd_kernel(w_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
         correction = jnp.exp(m_prev - safe_m)         # [bq, 1]
         l_ref[:] = (l_ref[:] * correction +
                     jnp.sum(p, axis=1, keepdims=True))
+        if quant:
+            p = p * vs_ref[0, 0][:, 0][None, :]       # fold vs into p
         pv = jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)       # [bq, d]
@@ -322,7 +341,10 @@ def _flash_fwd_impl(q: jax.Array, k: jax.Array, v: jax.Array,
                     window: jax.Array, causal: bool, windowed: bool,
                     block_q: int, block_k: int,
                     softcap: Optional[float], interpret: bool,
-                    offset_mode: bool = False):
+                    offset_mode: bool = False,
+                    k_scale: Optional[jax.Array] = None,
+                    v_scale: Optional[jax.Array] = None):
+    quant = k_scale is not None
     b, s_q, h, d = q.shape
     s_kv, h_kv = k.shape[1], k.shape[2]
     group = h // h_kv
@@ -333,10 +355,16 @@ def _flash_fwd_impl(q: jax.Array, k: jax.Array, v: jax.Array,
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
+    extra = []
+    if quant:
+        # Scales [B,S,KV] → [B,KV,S,1] so a (1,1,bk,1) block rides the
+        # same kv index map as its int8 tensor.
+        extra = [jnp.swapaxes(k_scale, 1, 2)[..., None],
+                 jnp.swapaxes(v_scale, 1, 2)[..., None]]
 
     kernel = functools.partial(
         _fwd_kernel, causal=causal, windowed=windowed, softcap=softcap,
-        scale=scale, bq=bq, bk=bk, n_kv_blocks=n_k)
+        scale=scale, bq=bq, bk=bk, n_kv_blocks=n_k, quant=quant)
     if causal and (windowed or offset_mode):
         # Scalar-prefetch grid: the window/offset scalars ride into the
         # INDEX MAPS, so fully-masked kv steps re-fetch the boundary
@@ -346,15 +374,18 @@ def _flash_fwd_impl(q: jax.Array, k: jax.Array, v: jax.Array,
                                      n_k=n_k, windowed=windowed)
             return (b_, h_ // group, ik_c, 0)
 
+        in_specs = [
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik, w:
+                         (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, bk, d), kv_map),
+            pl.BlockSpec((1, 1, bk, d), kv_map),
+        ]
+        if quant:
+            in_specs += [pl.BlockSpec((1, 1, bk, 1), kv_map)] * 2
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(b, h, n_q, n_k),
-            in_specs=[
-                pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik, w:
-                             (b_, h_, iq, 0)),
-                pl.BlockSpec((1, 1, bk, d), kv_map),
-                pl.BlockSpec((1, 1, bk, d), kv_map),
-            ],
+            in_specs=in_specs,
             out_specs=[
                 pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik, w:
                              (b_, h_, iq, 0)),
@@ -375,20 +406,25 @@ def _flash_fwd_impl(q: jax.Array, k: jax.Array, v: jax.Array,
                 jax.ShapeDtypeStruct((b, h, s_q, 1), jnp.float32),
             ],
             interpret=interpret,
-        )(window, qt, kt, vt)
+        )(window, qt, kt, vt, *extra)
         return jnp.swapaxes(out, 1, 2), lse
+    in_specs = [
+        _SMEM_SPEC,
+        pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik:
+                     (b_, h_, iq, 0)),
+        pl.BlockSpec((1, 1, bk, d), lambda b_, h_, iq, ik:
+                     (b_, h_ // group, ik, 0)),
+        pl.BlockSpec((1, 1, bk, d), lambda b_, h_, iq, ik:
+                     (b_, h_ // group, ik, 0)),
+    ]
+    if quant:
+        in_specs += [pl.BlockSpec((1, 1, bk, 1),
+                                  lambda b_, h_, iq, ik:
+                                  (b_, h_ // group, ik, 0))] * 2
     out, lse = pl.pallas_call(
         kernel,
         grid=(b, h, n_q, n_k),
-        in_specs=[
-            _SMEM_SPEC,
-            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik:
-                         (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, iq, ik:
-                         (b_, h_ // group, ik, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, iq, ik:
-                         (b_, h_ // group, ik, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik:
                          (b_, h_, iq, 0)),
@@ -407,7 +443,7 @@ def _flash_fwd_impl(q: jax.Array, k: jax.Array, v: jax.Array,
             pltpu.VMEM((bq, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(window, qt, kt, vt)
+    )(window, qt, kt, vt, *extra)
     return jnp.swapaxes(out, 1, 2), lse
 
 
@@ -591,6 +627,46 @@ def _bwd(causal, windowed, block_q, block_k, softcap, offset_mode, res,
 
 
 _flash.defvjp(_fwd, _bwd)
+
+
+def flash_attention_quant(q: jax.Array, k_q: jax.Array,
+                          k_scale: jax.Array, v_q: jax.Array,
+                          v_scale: jax.Array,
+                          causal: bool = True, block_q: int = 512,
+                          block_k: int = 512,
+                          window: Optional[jax.Array] = None,
+                          softcap: Optional[float] = None,
+                          q_offset: Optional[jax.Array] = None
+                          ) -> jax.Array:
+    """Flash attention over an int8 KV cache (engine.quantize_kv
+    layout): k_q/v_q [B,Skv,Hkv,D] int8, scales [B,Skv,Hkv] f32.
+
+    Forward-only (serving prefill — training keeps bf16 caches): the
+    per-position scale factors out of the contracted D axis, so the
+    kernel reads the cache at half the HBM width, dequantizes in
+    VMEM, and applies ks to the score columns / folds vs into p. The
+    q_offset / window / softcap machinery (incl. DMA-level skipping of
+    blocks past the causal frontier) is shared with flash_attention —
+    this is what lets long-context chunked prefill compose with the
+    int8 cache instead of falling back to dense O(S)-per-chunk reads.
+    """
+    if window is not None and not causal:
+        raise ValueError('flash window support is causal-only')
+    if q_offset is not None and not causal:
+        raise ValueError('q_offset requires causal masking')
+    windowed = window is not None
+    offset_mode = q_offset is not None
+    scalars = jnp.stack([
+        jnp.asarray(window if windowed else 0, jnp.int32).reshape(()),
+        jnp.asarray(q_offset if offset_mode else 0,
+                    jnp.int32).reshape(()),
+    ])
+    out, _ = _flash_fwd_impl(
+        q, k_q, v_q, scalars, causal, windowed, block_q, block_k,
+        None if softcap is None else float(softcap),
+        interpret=_use_interpret(), offset_mode=offset_mode,
+        k_scale=k_scale, v_scale=v_scale)
+    return out
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,

@@ -631,12 +631,16 @@ class TestKvQuant:
         out = eng.run_to_completion()[rid]
         assert len(out) == 4
 
-    def test_use_flash_conflict_raises(self, tiny):
+    def test_use_flash_composes_with_quant(self, tiny):
+        """flash_attention_quant reads the int8 cache directly, so
+        use_flash + kv_quant is a supported (and on TPU, the default)
+        combination; equivalence vs the dense path is covered in
+        test_attention.py::TestQuantFlash."""
         config, params = tiny
-        with pytest.raises(ValueError, match='kv_quant'):
-            inference.InferenceEngine(params, config, batch_size=2,
-                                      max_seq_len=64, use_flash=True,
-                                      kv_quant='int8')
+        eng = inference.InferenceEngine(params, config, batch_size=2,
+                                        max_seq_len=64, use_flash=True,
+                                        kv_quant='int8')
+        assert eng._use_flash
 
     def test_bad_quant_mode_raises(self, tiny):
         config, params = tiny
