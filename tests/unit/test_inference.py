@@ -548,6 +548,30 @@ def test_long_context_16k_prefill_and_context_sharded_decode(tiny):
     assert sharded.run_to_completion()[rid] == flash_tokens
 
 
+@pytest.mark.slow
+def test_long_context_16k_int8_flash_matches_dense(tiny):
+    """The llm/serve-long-context.yaml composition at a length that
+    matters: a 16k prompt over an int8 cache through (a) the quant
+    flash prefill kernel and (b) the dense chunked path. Same
+    quantized numbers in, only the kernel differs — the greedy
+    continuations must match token for token."""
+    import dataclasses
+
+    config, params = tiny
+    config = dataclasses.replace(config, max_seq_len=32768)
+    prompt = [int(i % 251) + 1 for i in range(16384)]
+    outs = {}
+    for use_flash in (True, False):
+        eng = inference.InferenceEngine(
+            params, config, batch_size=1, max_seq_len=16384 + 64,
+            prefill_chunk=2048, kv_quant='int8', use_flash=use_flash)
+        rid = eng.submit(prompt, inference.SamplingParams(
+            temperature=0.0, max_new_tokens=4))
+        outs[use_flash] = eng.run_to_completion()[rid]
+    assert outs[True] == outs[False]
+    assert len(outs[True]) == 4
+
+
 class TestKvQuant:
     """int8 KV cache (engine.quantize_kv / kv_quant='int8'): half the
     cache HBM traffic and footprint for absmax error far below bf16
