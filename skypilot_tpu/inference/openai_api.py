@@ -85,7 +85,17 @@ def _parse_common(body: Dict[str, Any], tokenizer):
                       # spec (logprob of the sampled token), so only
                       # absence passes — falsy 0 must 400 too.
                       ('logprobs', lambda v: v is None),
-                      ('echo', lambda v: not v)):
+                      ('echo', lambda v: not v),
+                      # Honoring json_object/json_schema would require
+                      # constrained decoding; silently returning free
+                      # text to a client that asked for JSON is worse
+                      # than a 400.
+                      ('response_format',
+                       lambda v: v is None or (isinstance(v, dict)
+                                               and v.get('type')
+                                               in (None, 'text'))),
+                      ('tools', lambda v: not v),
+                      ('tool_choice', lambda v: v in (None, 'none'))):
         if not ok(body.get(field)):
             raise _BadRequest(
                 f'{field}={body.get(field)!r} is not supported; '

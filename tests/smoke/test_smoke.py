@@ -135,3 +135,42 @@ def test_real_gcp_cluster_lifecycle(smoke_env):
         teardown='echo y | $TSKY down smokegcp-real',
         timeout=1800,
     ))
+
+
+def test_serve_openai_surface(smoke_env):
+    """The OpenAI surface through the REAL serve stack: tsky serve up
+    an in-tree engine replica, wait READY, then an OpenAI-style
+    completion (token-array prompt — no tokenizer mounted) against
+    the replica's /v1 endpoint."""
+    yaml = tempfile.NamedTemporaryFile(
+        mode='w', suffix='.yaml', delete=False)
+    yaml.write('name: smokeoai\n'
+               'resources:\n  infra: local\n'
+               'service:\n'
+               '  readiness_probe:\n    path: /health\n'
+               '    initial_delay_seconds: 120\n'
+               '  replica_port: 18734\n'
+               '  replicas: 1\n'
+               'run: exec env JAX_PLATFORMS=cpu python3 -m '
+               'skypilot_tpu.inference.server --model tiny '
+               '--port 18734 --batch-size 2\n')
+    yaml.close()
+    smoke_utils.run_one_test(Test(
+        'serve-openai-surface',
+        [
+            f'$TSKY serve up {yaml.name} -n smokeoai',
+            'for i in $(seq 1 120); do '
+            '  $TSKY serve status | grep smokeoai | '
+            '    grep -q READY && break; sleep 2; done; '
+            '$TSKY serve status | grep smokeoai | grep READY',
+            'curl -sf http://127.0.0.1:18734/v1/models | '
+            '  grep -q tiny',
+            'curl -sf http://127.0.0.1:18734/v1/completions '
+            '  -H "Content-Type: application/json" '
+            '  -d \'{"prompt": [3, 7, 11], "max_tokens": 3, '
+            '       "temperature": 0}\' | '
+            '  grep -q text_completion',
+        ],
+        teardown='echo y | $TSKY serve down smokeoai --purge',
+        timeout=600,
+    ))

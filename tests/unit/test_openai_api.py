@@ -149,9 +149,22 @@ class TestCompletions:
                          {'prompt': 'hello', 'logprobs': 0},
                          {'prompt': 'hello', 'top_p': 0.0},
                          {'prompt': 'hello', 'top_p': 1.5},
-                         {'prompt': 'hello', 'best_of': 4}):
+                         {'prompt': 'hello', 'best_of': 4},
+                         # Constrained decoding / tools we can't
+                         # honor must 400, not silently free-text.
+                         {'prompt': 'hello', 'response_format':
+                          {'type': 'json_object'}},
+                         {'prompt': 'hello',
+                          'tools': [{'type': 'function'}]},
+                         {'prompt': 'hello', 'tool_choice': 'auto'}):
                 r = await client.post('/v1/completions', json=body)
                 assert r.status == 400, body
+            # The no-op spellings stay accepted:
+            r = await client.post('/v1/completions', json={
+                'prompt': 'hello', 'max_tokens': 2, 'temperature': 0,
+                'response_format': {'type': 'text'},
+                'tool_choice': 'none'})
+            assert r.status == 200
         _drive(tiny, toytok, go)
 
     def test_top_p_null_is_default(self, tiny, toytok):
