@@ -567,10 +567,14 @@ def prefill_chunked(params: Params, tokens: jax.Array,
 
 
 def _sample(logits: jax.Array, temperature: jax.Array, top_k: jax.Array,
-            top_p: jax.Array, key: jax.Array) -> jax.Array:
+            top_p: jax.Array, key: jax.Array
+            ) -> Tuple[jax.Array, jax.Array]:
     """Per-slot temperature/top-k/top-p sampling; temperature 0 =>
     greedy. Both filters reduce to a per-row logit threshold, so the
-    batch shares one sort and one where()."""
+    batch shares one sort and one where(). Returns (tokens [B] int32,
+    logprobs [B] f32) — the chosen token's log-probability under the
+    RAW model distribution (OpenAI `logprobs` semantics), not the
+    filtered/tempered one."""
     vocab = logits.shape[-1]
     greedy = jnp.argmax(logits, axis=-1)
     sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
@@ -596,7 +600,13 @@ def _sample(logits: jax.Array, temperature: jax.Array, top_k: jax.Array,
     filtered = jnp.where(logits >= thresh[:, None], logits, _NEG_INF)
     scaled = filtered / jnp.maximum(temperature, 1e-6)[:, None]
     sampled = jax.random.categorical(key, scaled, axis=-1)
-    return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)
+    tokens = jnp.where(temperature <= 0.0, greedy,
+                       sampled).astype(jnp.int32)
+    raw_logprobs = jax.nn.log_softmax(logits.astype(jnp.float32),
+                                      axis=-1)
+    chosen = jnp.take_along_axis(raw_logprobs, tokens[:, None],
+                                 axis=-1)[:, 0]
+    return tokens, chosen
 
 
 @functools.partial(jax.jit, static_argnames=('config',))
@@ -604,8 +614,9 @@ def decode_step(params: Params, cache: Cache, last_tokens: jax.Array,
                 active: jax.Array, temperature: jax.Array,
                 top_k: jax.Array, top_p: jax.Array, key: jax.Array,
                 config: llama.LlamaConfig
-                ) -> Tuple[jax.Array, Cache]:
-    """One token for every slot [B]; inactive slots don't advance."""
+                ) -> Tuple[jax.Array, jax.Array, Cache]:
+    """One token for every slot [B]; inactive slots don't advance.
+    Returns (tokens, raw-model logprobs of each token, cache)."""
     b = last_tokens.shape[0]
     lengths = cache['length']
     positions = lengths[:, None]  # next position per slot
@@ -613,12 +624,13 @@ def decode_step(params: Params, cache: Cache, last_tokens: jax.Array,
     logits, new_cache = _forward_with_cache(
         params, last_tokens[:, None], cache, positions, lengths,
         jnp.where(active, new_lengths, lengths), config)
-    next_tokens = _sample(logits[:, 0], temperature, top_k, top_p, key)
+    next_tokens, logprobs = _sample(logits[:, 0], temperature, top_k,
+                                    top_p, key)
     next_tokens = jnp.where(active, next_tokens, last_tokens)
     # Inactive slots must not grow; restore their cache rows lazily via
     # length (stale writes beyond `length` are invisible to the mask).
     new_cache['length'] = new_lengths
-    return next_tokens, new_cache
+    return next_tokens, logprobs, new_cache
 
 
 @dataclasses.dataclass
@@ -626,6 +638,7 @@ class _Slot:
     request_id: int
     params: SamplingParams
     generated: List[int]
+    logprobs: List[float]
     prompt_len: int
     done: bool = False
 
@@ -724,6 +737,8 @@ class InferenceEngine:
                                  kv_quant=kv_quant)
         self._queue: List[Tuple[int, List[int], SamplingParams]] = []
         self._finished: Dict[int, List[int]] = {}
+        self._finished_logprobs: Dict[int, List[float]] = {}
+        self._last_logprobs: Dict[int, List[float]] = {}
         self._next_id = 0
         self._key = jax.random.key(seed)
 
@@ -743,6 +758,22 @@ class InferenceEngine:
 
     def finished(self) -> Dict[int, List[int]]:
         out, self._finished = self._finished, {}
+        # Logprobs move to a one-drain holding slot: callers that
+        # never ask for them (run_to_completion loops, batch jobs)
+        # must not accumulate one float per generated token forever.
+        # Empty drains leave the slot alone so a drain-until-idle loop
+        # doesn't wipe the last batch's logprobs.
+        if out:
+            self._last_logprobs = self._finished_logprobs
+            self._finished_logprobs = {}
+        return out
+
+    def finished_logprobs(self) -> Dict[int, List[float]]:
+        """Raw-model logprobs of each generated token, for the
+        requests reported by the MOST RECENT finished() call — read
+        them in the same tick (the server loop does); the next
+        finished() drain replaces them."""
+        out, self._last_logprobs = self._last_logprobs, {}
         return out
 
     def active_progress(self) -> Dict[int, List[int]]:
@@ -759,6 +790,8 @@ class InferenceEngine:
         self._queue = [(rid, t, s) for rid, t, s in self._queue
                        if rid != request_id]
         self._finished.pop(request_id, None)
+        self._finished_logprobs.pop(request_id, None)
+        self._last_logprobs.pop(request_id, None)
         for i, slot in enumerate(self.state.slots):
             if slot is not None and slot.request_id == request_id:
                 self.state.slots[i] = None
@@ -771,6 +804,8 @@ class InferenceEngine:
         as finished."""
         self._queue.clear()
         self._finished.clear()
+        self._finished_logprobs.clear()
+        self._last_logprobs.clear()
         for i, slot in enumerate(self.state.slots):
             if slot is not None:
                 self.state.slots[i] = None
@@ -812,7 +847,7 @@ class InferenceEngine:
             request_id, tokens, sampling = self._queue.pop(0)
             tokens = tokens[:self.state.max_seq_len - 1]
             self.state.slots[slot] = _Slot(request_id, sampling, [],
-                                           len(tokens))
+                                           [], len(tokens))
             inserts.append((request_id, tokens, sampling))
             slot_ids.append(slot)
         # Bucket the pad length to powers of two so prefill compiles a
@@ -843,12 +878,13 @@ class InferenceEngine:
                           jnp.float32)
         topks = jnp.array([s.top_k for _, _, s in inserts], jnp.int32)
         topps = jnp.array([s.top_p for _, _, s in inserts], jnp.float32)
-        first = _sample(logits, temps, topks, topps, sub)
-        first_host = jax.device_get(first)
+        first, first_lp = _sample(logits, temps, topks, topps, sub)
+        first_host, lp_host = jax.device_get((first, first_lp))
         last = jax.device_get(self.state.last_tokens).copy()
         for i, slot in enumerate(slot_ids):
             token = int(first_host[i])
             self.state.slots[slot].generated.append(token)
+            self.state.slots[slot].logprobs.append(float(lp_host[i]))
             last[slot] = token
         self.state.last_tokens = jnp.asarray(last)
 
@@ -863,6 +899,7 @@ class InferenceEngine:
                     self.state.max_seq_len - 1)
             if hit_eos or full or len(slot.generated) >= s.max_new_tokens:
                 self._finished[slot.request_id] = slot.generated
+                self._finished_logprobs[slot.request_id] = slot.logprobs
                 self.state.slots[i] = None
                 # Free the cache slot by zeroing its length.
                 self.state.cache['length'] = \
@@ -886,12 +923,15 @@ class InferenceEngine:
             jnp.float32)
         active = jnp.array(active_mask)
         with self._mesh_ctx():
-            next_tokens, self.state.cache = decode_step(
+            next_tokens, logprobs, self.state.cache = decode_step(
                 self.params, self.state.cache, self.state.last_tokens,
                 active, temps, topks, topps, sub, self.config)
         self.state.last_tokens = next_tokens
-        tokens_host = jax.device_get(next_tokens)
+        # ONE host sync for both arrays: a second blocking device_get
+        # on the hot decode loop is pure added latency.
+        tokens_host, lp_host = jax.device_get((next_tokens, logprobs))
         for i, slot in enumerate(self.state.slots):
             if slot is not None:
                 slot.generated.append(int(tokens_host[i]))
+                slot.logprobs.append(float(lp_host[i]))
         self._evict_finished()

@@ -20,13 +20,16 @@ string prompts, chat, and `stop` strings then 400/501 with a clear
 message.
 
 Sampling: temperature, top_k, and top_p (nucleus) all map straight to
-engine.SamplingParams. Deliberate scope (documented, enforced with
-400s rather than silently wrong results): n=1 per prompt (batch by
-sending a prompt LIST — continuous batching packs them), no
-logprobs/echo/best_of. `stop` strings truncate the emitted text; in
-streaming mode the hit also aborts the request (engine.abort) so the
-slot frees immediately, while non-stream requests — whose text is
-only known at the end — decode to their natural end.
+engine.SamplingParams. Sampled-token logprobs are supported
+(completions `logprobs: 0`, chat `logprobs: true`; non-streaming).
+Deliberate scope (documented, enforced with 400s rather than silently
+wrong results): n=1 per prompt (batch by sending a prompt LIST —
+continuous batching packs them), no top-N logprob alternatives, no
+echo/best_of/tools/constrained response_format. `stop` strings
+truncate the emitted text; in streaming mode the hit also aborts the
+request (engine.abort) so the slot frees immediately, while
+non-stream requests — whose text is only known at the end — decode to
+their natural end.
 """
 import asyncio
 import json
@@ -76,15 +79,20 @@ def _normalize_prompts(prompt: Any, tokenizer) -> List[List[int]]:
         'or a list of non-empty token arrays')
 
 
-def _parse_common(body: Dict[str, Any], tokenizer):
-    """Shared request validation → (SamplingParams, stop strings)."""
+def _parse_common(body: Dict[str, Any], tokenizer, chat: bool):
+    """Shared request validation → (SamplingParams, stop strings,
+    want_logprobs)."""
     from skypilot_tpu.inference.engine import SamplingParams
+    # Sampled-token logprobs are supported (completions `logprobs: 0`,
+    # chat `logprobs: true` with top_logprobs absent/0); top-N
+    # alternatives are NOT, so those 400 rather than returning fewer
+    # alternatives than asked.
+    lp_ok = ((lambda v: v in (None, False, True)) if chat
+             else (lambda v: v is None or v == 0))
     for field, ok in (('n', lambda v: v in (None, 1)),
                       ('best_of', lambda v: v in (None, 1)),
-                      # logprobs=0 is a real request in the OpenAI
-                      # spec (logprob of the sampled token), so only
-                      # absence passes — falsy 0 must 400 too.
-                      ('logprobs', lambda v: v is None),
+                      ('logprobs', lp_ok),
+                      ('top_logprobs', lambda v: v in (None, 0)),
                       ('echo', lambda v: not v),
                       # Honoring json_object/json_schema would require
                       # constrained decoding; silently returning free
@@ -132,12 +140,69 @@ def _parse_common(body: Dict[str, Any], tokenizer):
             eos_token_id=eos)
     except (TypeError, ValueError) as e:
         raise _BadRequest(f'bad sampling field: {e}') from e
-    return sampling, stops
+    raw_lp = body.get('logprobs')
+    want_logprobs = (raw_lp is True) if chat else (raw_lp == 0 and
+                                                  raw_lp is not False
+                                                  and raw_lp is not None)
+    if want_logprobs and body.get('stream'):
+        raise _BadRequest('logprobs are supported on non-streaming '
+                          'requests only')
+    return sampling, stops, want_logprobs
 
 
 def _finish_reason(tokens: List[int], sampling) -> str:
     return ('length' if len(tokens) >= sampling.max_new_tokens
             else 'stop')
+
+
+def _logprobs_doc(tokens: List[int], logprobs: Optional[List[float]],
+                  tokenizer, chat: bool,
+                  text_len: Optional[int]) -> Dict[str, Any]:
+    """Sampled-token logprobs in each endpoint's schema (raw-model
+    distribution, engine._sample). Token strings need a tokenizer;
+    without one, token IDS stand in (the module's documented
+    tokenizer-free extension).
+
+    `text_len`: length of the RETURNED completion text (after stop
+    truncation / special stripping) — entries must cover exactly the
+    emitted text, so tokens whose text starts at/after that boundary
+    (post-stop decode, the eos id) are dropped. None = token-id mode,
+    keep everything.
+    """
+    lps = list(logprobs or [])
+    if tokenizer is None:
+        return {'tokens': list(tokens), 'token_logprobs': lps,
+                'top_logprobs': None, 'text_offset': None}
+    # One incremental pass: token j's text spans
+    # [prefix_lens[j], prefix_lens[j+1]) of the decoded completion.
+    prefix_lens = [len(_decode(tokenizer, tokens[:j]))
+                   for j in range(len(tokens) + 1)]
+    keep = len(tokens)
+    if text_len is not None:
+        # Longest PREFIX of tokens whose whole non-empty spans fit in
+        # the returned text: drops everything decoded past a stop
+        # string, the stop token itself, and the stripped trailing
+        # eos (empty span). Prefix (not per-token filter) so the
+        # token/logprob/offset arrays can never misalign.
+        keep = 0
+        for j in range(len(tokens)):
+            if prefix_lens[j] < prefix_lens[j + 1] <= text_len:
+                keep = j + 1
+            else:
+                break
+    tok_strs = tokenizer.convert_ids_to_tokens(tokens[:keep])
+    lps = lps[:keep]
+    if chat:
+        return {'content': [
+            # top_logprobs/bytes are schema-required on every entry
+            # (the official SDK validates them); empty/utf8 values
+            # match "no alternatives requested".
+            {'token': t, 'logprob': lp, 'top_logprobs': [],
+             'bytes': list(str(t).encode('utf-8'))}
+            for t, lp in zip(tok_strs, lps)]}
+    return {'tokens': tok_strs, 'token_logprobs': lps,
+            'top_logprobs': None,
+            'text_offset': prefix_lens[:keep]}
 
 
 def _decode(tokenizer, tokens: List[int]) -> str:
@@ -212,7 +277,8 @@ def add_openai_routes(app, holder: Dict[str, Any]) -> None:
         except json.JSONDecodeError:
             return _err400('body must be JSON')
         try:
-            sampling, stops = _parse_common(body, tokenizer)
+            sampling, stops, want_logprobs = _parse_common(
+                body, tokenizer, chat)
             if chat:
                 prompts = [_chat_prompt(body, tokenizer)]
             else:
@@ -255,15 +321,28 @@ def add_openai_routes(app, holder: Dict[str, Any]) -> None:
                     _decode(tokenizer, tokens), stops)
                 if stopped:
                     finish = 'stop'
+            lp_doc = None
+            if want_logprobs:
+                # to_thread: the incremental prefix decode is O(n²)
+                # in completion length — keep it off the event loop.
+                lp_doc = await asyncio.to_thread(
+                    _logprobs_doc, tokens, watchers[i].logprobs,
+                    tokenizer, chat,
+                    len(text) if text is not None else None)
             if chat:
-                choices.append({
+                choice = {
                     'index': i, 'finish_reason': finish,
-                    'message': {'role': 'assistant', 'content': text}})
+                    'message': {'role': 'assistant', 'content': text}}
+                if want_logprobs:
+                    choice['logprobs'] = lp_doc
+                choices.append(choice)
             else:
                 choice = {'index': i, 'text': text,
                           'finish_reason': finish}
                 if tokenizer is None:
                     choice['tokens'] = tokens  # documented extension
+                if want_logprobs:
+                    choice['logprobs'] = lp_doc
                 choices.append(choice)
         n_prompt = sum(len(p) for p in prompts)
         n_out = sum(len(t) for t in outs)

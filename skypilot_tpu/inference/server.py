@@ -40,6 +40,10 @@ class EngineLoop:
             self.q: asyncio.Queue = asyncio.Queue()
             self.sent = 0
             self.aborted = False
+            # Raw-model logprobs of the generated tokens, set by the
+            # engine thread BEFORE the 'done' push (the queue handoff
+            # orders the write for the reading handler).
+            self.logprobs: Optional[List[float]] = None
 
         def push(self, item) -> None:
             self.loop.call_soon_threadsafe(self.q.put_nowait, item)
@@ -133,6 +137,7 @@ class EngineLoop:
         self.engine.step()
         progress = self.engine.active_progress()
         finished = self.engine.finished()
+        finished_lps = self.engine.finished_logprobs()
         for rid, tokens in {**progress, **finished}.items():
             watcher = self._watchers.get(rid)
             if watcher is not None and watcher.stream:
@@ -142,6 +147,7 @@ class EngineLoop:
         for rid, tokens in finished.items():
             watcher = self._watchers.pop(rid, None)
             if watcher is not None:
+                watcher.logprobs = finished_lps.get(rid)
                 watcher.push(('done', tokens))
 
 
@@ -182,6 +188,7 @@ def create_app(engine_holder: Dict[str, Any]):
             return web.json_response(
                 {'error': 'prompt_tokens must be non-empty'}, status=400)
         stream = bool(body.get('stream', False))
+        want_logprobs = bool(body.get('logprobs', False))
         watcher = engine_loop.submit(prompt, sampling, stream=stream)
 
         # A vanished client (handler cancelled, connection reset) must
@@ -192,7 +199,10 @@ def create_app(engine_holder: Dict[str, Any]):
                 while True:
                     kind, payload = await watcher.q.get()
                     if kind == 'done':
-                        return web.json_response({'tokens': payload})
+                        doc = {'tokens': payload}
+                        if want_logprobs:
+                            doc['logprobs'] = watcher.logprobs
+                        return web.json_response(doc)
                     if kind == 'error':
                         return web.json_response({'error': payload},
                                                  status=500)

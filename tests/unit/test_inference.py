@@ -775,3 +775,49 @@ class TestAbortAndTopP:
         out = engine2.run_to_completion()[rid]
         assert len(out) == 5
         assert all(0 <= t < config.vocab_size for t in out)
+
+
+def test_logprobs_match_full_forward_oracle(tiny):
+    """finished_logprobs() must be the raw-model log-probabilities of
+    each generated token, verified against log_softmax over the
+    no-cache full forward at every step."""
+    import math as math_lib
+
+    config, params = tiny
+    prompt = [3, 17, 42, 9]
+    steps = 6
+    eng = inference.InferenceEngine(params, config, batch_size=1,
+                                    max_seq_len=64)
+    rid = eng.submit(prompt, inference.SamplingParams(
+        temperature=0.0, max_new_tokens=steps))
+    tokens = eng.run_to_completion()[rid]
+    lps = eng.finished_logprobs()  # already drained? run_to_completion
+    # drains finished() only; logprobs parallel dict still holds rid.
+    assert rid in lps
+    got = lps[rid]
+    assert len(got) == steps
+
+    seq = list(prompt)
+    for step, (tok, lp) in enumerate(zip(tokens, got)):
+        arr = jnp.array([seq + [0] * (_REF_PAD - len(seq))], jnp.int32)
+        logits = llama.forward(params, arr, config)[0, len(seq) - 1]
+        ref = jax.nn.log_softmax(logits.astype(jnp.float32))[tok]
+        assert math_lib.isfinite(lp) and lp <= 0.0
+        assert abs(float(ref) - lp) < 1e-3, (step, float(ref), lp)
+        seq.append(tok)
+
+
+def test_finished_logprobs_do_not_accumulate(tiny):
+    """Callers that drain finished() without ever reading logprobs
+    (run_to_completion loops, batch jobs) must not leak one float per
+    generated token forever."""
+    config, params = tiny
+    eng = inference.InferenceEngine(params, config, batch_size=1,
+                                    max_seq_len=64)
+    for _ in range(3):
+        rid = eng.submit([5, 9], inference.SamplingParams(
+            temperature=0.0, max_new_tokens=2))
+        eng.run_to_completion()
+    # At most the LAST drain's worth is retained.
+    assert len(eng._last_logprobs) <= 1
+    assert not eng._finished_logprobs

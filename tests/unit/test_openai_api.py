@@ -142,11 +142,9 @@ class TestCompletions:
         async def go(client):
             for body in ({'prompt': 'hello', 'n': 2},
                          {'prompt': 'hello', 'echo': True},
+                         # top-N alternatives are not supported
+                         # (sampled-token logprobs via 0/true are).
                          {'prompt': 'hello', 'logprobs': 3},
-                         # logprobs=0 is a REAL request in the spec
-                         # (sampled-token logprob) — silently ignoring
-                         # falsy 0 would be wrong, not lenient.
-                         {'prompt': 'hello', 'logprobs': 0},
                          {'prompt': 'hello', 'top_p': 0.0},
                          {'prompt': 'hello', 'top_p': 1.5},
                          {'prompt': 'hello', 'best_of': 4},
@@ -394,3 +392,115 @@ class TestLoading:
                 await client.close()
 
         asyncio.new_event_loop().run_until_complete(run())
+
+
+class TestLogprobs:
+    """Sampled-token logprobs: completions `logprobs: 0`, chat
+    `logprobs: true`; raw-model distribution, non-streaming only."""
+
+    def test_completions_logprobs_zero(self, tiny, toytok):
+        async def go(client):
+            r = await client.post('/v1/completions', json={
+                'prompt': 'hello world', 'max_tokens': 4,
+                'temperature': 0, 'logprobs': 0})
+            assert r.status == 200
+            (choice,) = (await r.json())['choices']
+            lp = choice['logprobs']
+            assert len(lp['token_logprobs']) == 4
+            assert all(isinstance(v, float) and v <= 0.0
+                       for v in lp['token_logprobs'])
+            assert len(lp['tokens']) == 4
+            assert lp['top_logprobs'] is None
+            assert lp['text_offset'][0] == 0
+            assert lp['text_offset'] == sorted(lp['text_offset'])
+        _drive(tiny, toytok, go)
+
+    def test_completions_without_logprobs_omits_field(self, tiny,
+                                                      toytok):
+        async def go(client):
+            r = await client.post('/v1/completions', json={
+                'prompt': 'hello', 'max_tokens': 2, 'temperature': 0})
+            (choice,) = (await r.json())['choices']
+            assert 'logprobs' not in choice
+        _drive(tiny, toytok, go)
+
+    def test_chat_logprobs_true(self, tiny, toytok):
+        async def go(client):
+            r = await client.post('/v1/chat/completions', json={
+                'messages': [{'role': 'user', 'content': 'hello'}],
+                'max_tokens': 3, 'temperature': 0, 'logprobs': True})
+            assert r.status == 200
+            (choice,) = (await r.json())['choices']
+            content = choice['logprobs']['content']
+            assert len(content) == 3
+            assert all('token' in c and c['logprob'] <= 0.0
+                       for c in content)
+        _drive(tiny, toytok, go)
+
+    def test_token_mode_logprobs_use_ids(self, tiny):
+        async def go(client):
+            r = await client.post('/v1/completions', json={
+                'prompt': [3, 7, 11], 'max_tokens': 3,
+                'temperature': 0, 'logprobs': 0})
+            (choice,) = (await r.json())['choices']
+            lp = choice['logprobs']
+            assert lp['tokens'] == choice['tokens']  # ids stand in
+            assert lp['text_offset'] is None
+        _drive(tiny, None, go)
+
+    def test_streaming_logprobs_400(self, tiny, toytok):
+        async def go(client):
+            r = await client.post('/v1/completions', json={
+                'prompt': 'hello', 'logprobs': 0, 'stream': True})
+            assert r.status == 400
+            r2 = await client.post('/v1/chat/completions', json={
+                'messages': [{'role': 'user', 'content': 'x'}],
+                'logprobs': True, 'stream': True})
+            assert r2.status == 400
+        _drive(tiny, toytok, go)
+
+    def test_stop_truncation_aligns_logprobs(self, tiny, toytok):
+        """Entries must cover exactly the RETURNED text: tokens the
+        model decoded past the stop string are dropped from
+        tokens/token_logprobs/text_offset."""
+        async def go(client):
+            base = await client.post('/v1/completions', json={
+                'prompt': 'hello world', 'max_tokens': 6,
+                'temperature': 0})
+            words = (await base.json())['choices'][0]['text'].split()
+            r = await client.post('/v1/completions', json={
+                'prompt': 'hello world', 'max_tokens': 6,
+                'temperature': 0, 'stop': words[1], 'logprobs': 0})
+            (choice,) = (await r.json())['choices']
+            lp = choice['logprobs']
+            n = len(lp['tokens'])
+            assert n == len(lp['token_logprobs']) == \
+                len(lp['text_offset'])
+            # Only the pre-stop token(s) survive, and every offset
+            # lies inside the returned text.
+            assert n == 1
+            assert all(off < len(choice['text']) or
+                       len(choice['text']) == 0
+                       for off in lp['text_offset'])
+        _drive(tiny, toytok, go)
+
+    def test_chat_entries_carry_schema_keys(self, tiny, toytok):
+        """The official SDK validates top_logprobs and bytes on every
+        content entry."""
+        async def go(client):
+            r = await client.post('/v1/chat/completions', json={
+                'messages': [{'role': 'user', 'content': 'hello'}],
+                'max_tokens': 2, 'temperature': 0, 'logprobs': True})
+            (choice,) = (await r.json())['choices']
+            for entry in choice['logprobs']['content']:
+                assert entry['top_logprobs'] == []
+                assert isinstance(entry['bytes'], list)
+        _drive(tiny, toytok, go)
+
+    def test_chat_logprobs_int_still_400(self, tiny, toytok):
+        async def go(client):
+            r = await client.post('/v1/chat/completions', json={
+                'messages': [{'role': 'user', 'content': 'x'}],
+                'logprobs': 2})
+            assert r.status == 400
+        _drive(tiny, toytok, go)
