@@ -26,6 +26,8 @@ async def _one_request(session, url: str, prompt_len: int,
     t0 = time.perf_counter()
     ttft = None
     tokens = 0
+    last_token_at = None
+    gaps = []
     async with session.post(
             f'{url}/generate',
             json={'prompt_tokens': prompt,
@@ -38,14 +40,22 @@ async def _one_request(session, url: str, prompt_len: int,
                 continue
             event = json.loads(line[6:])
             if 'token' in event:
+                now = time.perf_counter()
                 tokens += 1
                 if ttft is None:
-                    ttft = time.perf_counter() - t0
+                    ttft = now - t0
+                else:
+                    # Inter-token gap: decode-stream smoothness —
+                    # spikes here are other requests' prefills
+                    # stalling the shared decode batch.
+                    gaps.append(now - last_token_at)
+                last_token_at = now
             elif 'error' in event:
                 raise RuntimeError(event['error'])
     return {'latency': time.perf_counter() - t0,
             'ttft': ttft if ttft is not None else float('nan'),
-            'tokens': tokens}
+            'tokens': tokens,
+            'gaps': gaps}
 
 
 def _pct(values, q):
@@ -103,6 +113,7 @@ async def run(url: str, concurrency: int, requests: int,
     total_tokens = sum(r['tokens'] for r in results)
     lat = [r['latency'] for r in results]
     ttft = [r['ttft'] for r in results]
+    gaps = [g for r in results for g in r['gaps']]
     return {
         'metric': 'serve_decode_tokens_per_sec',
         'value': round(total_tokens / wall, 2),
@@ -117,6 +128,9 @@ async def run(url: str, concurrency: int, requests: int,
             'ttft_p95_s': round(_pct(ttft, 0.95), 4),
             'latency_p50_s': round(_pct(lat, 0.5), 4),
             'latency_p95_s': round(_pct(lat, 0.95), 4),
+            # Inter-token latency: stream smoothness under load.
+            'itl_p50_s': round(_pct(gaps, 0.5), 4),
+            'itl_p99_s': round(_pct(gaps, 0.99), 4),
         },
     }
 
