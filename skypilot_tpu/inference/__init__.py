@@ -19,7 +19,9 @@ def build_engine(model: str, *, checkpoint: Optional[str] = None,
                  mesh_arg: Optional[str] = None, batch_size: int = 8,
                  max_seq_len: Optional[int] = None,
                  prefill_chunk: int = 1024,
-                 kv_quant: str = 'none') -> InferenceEngine:
+                 kv_quant: str = 'none',
+                 prefill_interleave: Optional[int] = None
+                 ) -> InferenceEngine:
     """One engine-construction path for every entrypoint (HTTP server,
     offline batch): resolve the model, build the mesh from a
     'tensor=8,context=2'-style arg, restore or random-init params."""
@@ -42,4 +44,5 @@ def build_engine(model: str, *, checkpoint: Optional[str] = None,
     return InferenceEngine(params, config, batch_size=batch_size,
                            max_seq_len=max_seq_len, mesh=mesh,
                            prefill_chunk=prefill_chunk,
-                           kv_quant=kv_quant)
+                           kv_quant=kv_quant,
+                           prefill_interleave=prefill_interleave)

@@ -103,7 +103,11 @@ def main() -> None:
     engine = inf.build_engine(
         args.model, checkpoint=args.checkpoint, mesh_arg=args.mesh,
         batch_size=args.batch_size, max_seq_len=args.max_seq_len,
-        kv_quant=args.kv_quant)
+        kv_quant=args.kv_quant,
+        # Offline: no in-flight streams to protect, and interleaving
+        # would serialize long-prompt prefill one slot at a time —
+        # keep the N-wide batched chunk scan.
+        prefill_interleave=0)
     default_sampling = inf.SamplingParams(
         temperature=args.temperature, top_k=args.top_k,
         max_new_tokens=args.max_new_tokens)

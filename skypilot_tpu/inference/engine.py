@@ -566,6 +566,53 @@ def prefill_chunked(params: Params, tokens: jax.Array,
     return _project_logits(last_hidden, params, config), new_cache
 
 
+@functools.partial(jax.jit,
+                   static_argnames=('config', 'chunk', 'use_flash'))
+def prefill_chunk_at(params: Params, chunk_tokens: jax.Array,
+                     start: jax.Array, visible: jax.Array,
+                     cache: Cache, slot_ids: jax.Array,
+                     config: llama.LlamaConfig, chunk: int,
+                     use_flash: bool = False
+                     ) -> Tuple[jax.Array, Cache]:
+    """ONE [N, chunk] slab of prompt written at cache position `start`
+    for `slot_ids` — the incremental step of INTERLEAVED prefill.
+
+    A 128k prompt prefilled whole stalls every in-flight decode stream
+    for seconds; engine.step() instead advances a long prompt one
+    chunk per tick with this, so the stall other streams see is one
+    chunk (~tens of ms), while a lone long prompt's total prefill time
+    is unchanged (it was a serial chunk scan anyway). Returns the
+    chunk's hidden states [N, chunk, E] (the caller samples the first
+    token from the final chunk) and the updated cache; `visible` [N]
+    becomes each slot's cache length (masks unwritten positions)."""
+    sub_cache = {
+        'k': jax.tree.map(lambda a: a[:, slot_ids], cache['k']),
+        'v': jax.tree.map(lambda a: a[:, slot_ids], cache['v']),
+    }
+    n = chunk_tokens.shape[0]
+    positions = start + jnp.broadcast_to(jnp.arange(chunk)[None],
+                                         (n, chunk))
+    # (start is traced: broadcast, don't jnp.full with it.)
+    write_at = jnp.zeros((n,), jnp.int32) + start
+    x, out = _hidden_with_cache(
+        params, chunk_tokens, sub_cache, positions, write_at, visible,
+        config, q_offset=start if use_flash else None)
+    new_cache = {
+        'k': jax.tree.map(lambda a, b: a.at[:, slot_ids].set(b),
+                          cache['k'], out['k']),
+        'v': jax.tree.map(lambda a, b: a.at[:, slot_ids].set(b),
+                          cache['v'], out['v']),
+        'length': cache['length'].at[slot_ids].set(visible),
+    }
+    return x, new_cache
+
+
+# Jitted entry for the per-prompt final-chunk projection in
+# _advance_prefill (the batched paths project inside their own jits).
+_project_logits_jit = functools.partial(
+    jax.jit, static_argnames=('config',))(_project_logits)
+
+
 def _sample(logits: jax.Array, temperature: jax.Array, top_k: jax.Array,
             top_p: jax.Array, key: jax.Array
             ) -> Tuple[jax.Array, jax.Array]:
@@ -641,6 +688,10 @@ class _Slot:
     logprobs: List[float]
     prompt_len: int
     done: bool = False
+    # Interleaved prefill: the full prompt while chunks are still
+    # being written (None once decoding), and the next write position.
+    pending: Optional[List[int]] = None
+    pos: int = 0
 
 
 class DecodeState:
@@ -678,7 +729,8 @@ class InferenceEngine:
                  mesh: Optional[Any] = None,
                  prefill_chunk: int = 1024,
                  use_flash: Optional[bool] = None,
-                 kv_quant: str = 'none'):
+                 kv_quant: str = 'none',
+                 prefill_interleave: Optional[int] = None):
         # The cached decode path mirrors the llama-core transformer
         # (every family knob: window/GeGLU/post-norms/softcaps/tied
         # embeddings) and the MoE family (routed expert MLP).
@@ -731,6 +783,18 @@ class InferenceEngine:
         # passes (prefill_chunked): bounds the [T,S] score tensor so
         # 128k prompts fit HBM.
         self.prefill_chunk = prefill_chunk
+        # Prompts LONGER than this prefill one chunk per step()
+        # (interleaved with decode) so in-flight streams stall one
+        # chunk, not a whole long prompt; shorter prompts keep the
+        # batched one-shot path. None -> 4 chunks; 0 disables.
+        if prefill_interleave is None:
+            prefill_interleave = 4 * prefill_chunk if prefill_chunk else 0
+        if prefill_chunk <= 0:
+            # Interleaving advances one CHUNK per step; without
+            # chunking an explicit threshold would park requests in a
+            # zero-progress prefill loop forever.
+            prefill_interleave = 0
+        self.prefill_interleave = prefill_interleave
         self.state = DecodeState(config, batch_size, max_seq_len,
                                  mesh=mesh,
                                  prefill_chunk=prefill_chunk,
@@ -846,10 +910,20 @@ class InferenceEngine:
             slot = free.pop(0)
             request_id, tokens, sampling = self._queue.pop(0)
             tokens = tokens[:self.state.max_seq_len - 1]
+            if (self.prefill_interleave
+                    and len(tokens) > self.prefill_interleave):
+                # LONG prompt: prefill one chunk per step() instead of
+                # stalling every in-flight stream for the whole thing.
+                self.state.slots[slot] = _Slot(
+                    request_id, sampling, [], [], len(tokens),
+                    pending=tokens, pos=0)
+                continue
             self.state.slots[slot] = _Slot(request_id, sampling, [],
                                            [], len(tokens))
             inserts.append((request_id, tokens, sampling))
             slot_ids.append(slot)
+        if not inserts:
+            return
         # Bucket the pad length to powers of two so prefill compiles a
         # bounded number of shapes (JetStream-style bucketing).
         max_len = max(len(t) for _, t, _ in inserts)
@@ -888,9 +962,58 @@ class InferenceEngine:
             last[slot] = token
         self.state.last_tokens = jnp.asarray(last)
 
+    def _advance_prefill(self) -> None:
+        """Advance the oldest mid-prefill slot by ONE chunk (the
+        interleaved-prefill tick). Total prefill time for a lone long
+        prompt is unchanged — the one-shot path was a serial chunk
+        scan too — but other streams now interleave a decode step
+        between chunks instead of stalling for the whole prompt."""
+        target = None
+        for i, slot in enumerate(self.state.slots):
+            if slot is not None and slot.pending is not None:
+                target = (i, slot)
+                break
+        if target is None:
+            return
+        i, slot = target
+        chunk = self.prefill_chunk
+        start = slot.pos
+        toks = slot.pending[start:start + chunk]
+        arr = jnp.array([toks + [0] * (chunk - len(toks))], jnp.int32)
+        visible = jnp.array([min(len(slot.pending), start + len(toks))],
+                            jnp.int32)
+        with self._mesh_ctx():
+            hidden, self.state.cache = prefill_chunk_at(
+                self.params, arr, jnp.int32(start), visible,
+                self.state.cache, jnp.array([i], jnp.int32),
+                self.config, chunk, use_flash=self._use_flash)
+        slot.pos = start + len(toks)
+        if slot.pos < len(slot.pending):
+            return
+        # Final chunk: sample the first generated token from the last
+        # prompt position's hidden state (same contract as the
+        # one-shot path's last-token gather).
+        last_idx = len(slot.pending) - 1 - start
+        logits = _project_logits_jit(hidden[:, last_idx], self.params,
+                                     self.config)
+        self._key, sub = jax.random.split(self._key)
+        first, first_lp = _sample(
+            logits,
+            jnp.array([slot.params.temperature], jnp.float32),
+            jnp.array([slot.params.top_k], jnp.int32),
+            jnp.array([slot.params.top_p], jnp.float32), sub)
+        first_host, lp_host = jax.device_get((first, first_lp))
+        token = int(first_host[0])
+        slot.generated.append(token)
+        slot.logprobs.append(float(lp_host[0]))
+        slot.pending = None
+        last = jax.device_get(self.state.last_tokens).copy()
+        last[i] = token
+        self.state.last_tokens = jnp.asarray(last)
+
     def _evict_finished(self) -> None:
         for i, slot in enumerate(self.state.slots):
-            if slot is None:
+            if slot is None or slot.pending is not None:
                 continue
             s = slot.params
             hit_eos = (s.eos_token_id is not None and slot.generated and
@@ -908,7 +1031,10 @@ class InferenceEngine:
     def step(self) -> None:
         self._evict_finished()
         self._insert_from_queue()
-        active_mask = [s is not None for s in self.state.slots]
+        self._advance_prefill()
+        # Slots mid-(interleaved-)prefill are not decoding yet.
+        active_mask = [s is not None and s.pending is None
+                       for s in self.state.slots]
         if not any(active_mask):
             return
         self._key, sub = jax.random.split(self._key)
@@ -931,7 +1057,10 @@ class InferenceEngine:
         # on the hot decode loop is pure added latency.
         tokens_host, lp_host = jax.device_get((next_tokens, logprobs))
         for i, slot in enumerate(self.state.slots):
-            if slot is not None:
+            # pending guard: a slot mid-(interleaved-)prefill was
+            # masked inactive in decode_step — appending its (stale)
+            # last_token here would be garbage output.
+            if slot is not None and slot.pending is None:
                 slot.generated.append(int(tokens_host[i]))
                 slot.logprobs.append(float(lp_host[i]))
         self._evict_finished()

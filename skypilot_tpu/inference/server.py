@@ -295,6 +295,13 @@ def main() -> None:
                         help='Prompts longer than this prefill as a '
                              'scan of chunk-wide passes (bounds HBM '
                              'for long-context prompts); 0 disables.')
+    parser.add_argument('--prefill-interleave', type=int,
+                        default=None,
+                        help='Prompts longer than this prefill one '
+                             'chunk per engine step, interleaved '
+                             'with decode (other streams stall one '
+                             'chunk, not the whole prompt). Default: '
+                             '4x --prefill-chunk; 0 disables.')
     parser.add_argument('--kv-quant', default='none',
                         choices=['none', 'int8'],
                         help='int8 KV cache: half the cache HBM '
@@ -321,7 +328,8 @@ def main() -> None:
         engine = inf.build_engine(
             args.model, checkpoint=args.checkpoint, mesh_arg=args.mesh,
             batch_size=args.batch_size, max_seq_len=args.max_seq_len,
-            prefill_chunk=args.prefill_chunk, kv_quant=args.kv_quant)
+            prefill_chunk=args.prefill_chunk, kv_quant=args.kv_quant,
+            prefill_interleave=args.prefill_interleave)
         holder['loop'] = EngineLoop(engine)
 
     threading.Thread(target=_load, daemon=True).start()
