@@ -161,8 +161,10 @@ def test_pipeline_runs_stages_sequentially(tmp_path):
     if record['status'] == jobs_state.ManagedJobStatus.PENDING:
         jobs_controller.start(job_id)
     else:
+        # 240s: the scheduler's controller subprocess runs two real
+        # stages; under a loaded CI host 90s flaked.
         _wait_status(job_id, {jobs_state.ManagedJobStatus.SUCCEEDED},
-                     timeout=90)
+                     timeout=240)
     record = jobs_state.get_job(job_id)
     assert record['status'] == jobs_state.ManagedJobStatus.SUCCEEDED
     assert os.path.exists(marker)
