@@ -20,8 +20,10 @@ def build_engine(model: str, *, checkpoint: Optional[str] = None,
                  max_seq_len: Optional[int] = None,
                  prefill_chunk: int = 1024,
                  kv_quant: str = 'none',
-                 prefill_interleave: Optional[int] = None
-                 ) -> InferenceEngine:
+                 prefill_interleave: Optional[int] = None,
+                 draft_model: Optional[str] = None,
+                 draft_checkpoint: Optional[str] = None,
+                 spec_k: int = 4) -> InferenceEngine:
     """One engine-construction path for every entrypoint (HTTP server,
     offline batch): resolve the model, build the mesh from a
     'tensor=8,context=2'-style arg, restore or random-init params."""
@@ -41,8 +43,19 @@ def build_engine(model: str, *, checkpoint: Optional[str] = None,
         params = checkpoints.restore_params(checkpoint, config)
     else:
         params = family.init_params(config, jax.random.key(0))
+    draft = None
+    if draft_model:
+        dfamily, dconfig = models_lib.resolve(draft_model)
+        if draft_checkpoint:
+            from skypilot_tpu.train import checkpoints
+            dparams = checkpoints.restore_params(draft_checkpoint,
+                                                 dconfig)
+        else:
+            dparams = dfamily.init_params(dconfig, jax.random.key(1))
+        draft = (dparams, dconfig)
     return InferenceEngine(params, config, batch_size=batch_size,
                            max_seq_len=max_seq_len, mesh=mesh,
                            prefill_chunk=prefill_chunk,
                            kv_quant=kv_quant,
-                           prefill_interleave=prefill_interleave)
+                           prefill_interleave=prefill_interleave,
+                           draft=draft, spec_k=spec_k)

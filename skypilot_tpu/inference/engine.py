@@ -680,6 +680,81 @@ def decode_step(params: Params, cache: Cache, last_tokens: jax.Array,
     return next_tokens, logprobs, new_cache
 
 
+@functools.partial(jax.jit,
+                   static_argnames=('k', 'config', 'draft_config'))
+def spec_step(params: Params, cache: Cache, draft_params: Params,
+              draft_cache: Cache, last_tokens: jax.Array,
+              active: jax.Array, k: int,
+              config: llama.LlamaConfig, draft_config: llama.LlamaConfig
+              ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array,
+                         Cache, Cache]:
+    """One GREEDY speculative round: the draft model proposes k tokens
+    (k cheap sequential decodes inside a lax.scan), the big model
+    verifies them in ONE [B, k] forward, and the longest matching
+    prefix (plus the big model's correction on the first mismatch) is
+    emitted — lossless: outputs are token-for-token what plain greedy
+    decode produces (oracle-tested), at up to k tokens per big-model
+    pass.
+
+    Cache bookkeeping rides the engine's length-masking design: both
+    models' caches hold keys for every token they were FED; after
+    acceptance the lengths roll back to the emitted count and stale
+    keys beyond are invisible (and rewritten when the corrected token
+    is fed next round). No bonus token on full acceptance — the
+    emitted tail then equals the last drafted token, keeping the
+    draft/big caches position-aligned without a catch-up pass.
+
+    Returns (tokens [B,k], logprobs [B,k], emit_count [B],
+    new_last_tokens [B], cache, draft_cache).
+    """
+    def draft_body(carry, _):
+        dc, last = carry
+        lengths = dc['length']
+        logits, dc = _forward_with_cache(
+            draft_params, last[:, None], dc, lengths[:, None], lengths,
+            jnp.where(active, lengths + 1, lengths), draft_config)
+        nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+        nxt = jnp.where(active, nxt, last)
+        return (dc, nxt), nxt
+
+    (draft_cache, _), drafts = lax.scan(
+        draft_body, (draft_cache, last_tokens), None, length=k)
+    drafts = jnp.swapaxes(drafts, 0, 1)              # [B, k]
+
+    # Verify: feed [last, d1..d_{k-1}] at positions L..L+k-1 — the
+    # logits at step j predict position L+j+1, i.e. the token d_{j+1}
+    # claims to be.
+    L = cache['length']
+    inputs = jnp.concatenate([last_tokens[:, None], drafts[:, :k - 1]],
+                             axis=1)                 # [B, k]
+    positions = L[:, None] + jnp.arange(k)[None]
+    logits, cache = _forward_with_cache(
+        params, inputs, cache, positions, L,
+        jnp.where(active, L + k, L), config)         # [B, k, V]
+    preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)   # [B, k]
+    lps = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+    match = (drafts == preds)
+    # m = longest matching prefix length in [0, k].
+    m = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+    emit = jnp.where(m < k, m + 1, k)                # correction or full
+    idx = jnp.arange(k)[None]
+    corr = jnp.take_along_axis(preds, jnp.minimum(m, k - 1)[:, None],
+                               axis=1)[:, 0]         # pred at pos m
+    tokens_out = jnp.where(idx < m[:, None], drafts,
+                           jnp.where(idx == m[:, None], corr[:, None],
+                                     0))
+    chosen_lp = jnp.take_along_axis(
+        lps, tokens_out[..., None], axis=-1)[..., 0]  # [B, k]
+    new_last = jnp.where(m < k, corr, drafts[:, k - 1])
+    new_last = jnp.where(active, new_last, last_tokens)
+    new_len = jnp.where(active, L + emit, L)
+    cache['length'] = new_len
+    draft_cache['length'] = new_len
+    emit = jnp.where(active, emit, 0)
+    return tokens_out, chosen_lp, emit, new_last, cache, draft_cache
+
+
 @dataclasses.dataclass
 class _Slot:
     request_id: int
@@ -701,7 +776,8 @@ class DecodeState:
                  max_seq_len: Optional[int] = None,
                  mesh: Optional[Any] = None,
                  prefill_chunk: int = 0,
-                 kv_quant: str = 'none'):
+                 kv_quant: str = 'none',
+                 draft_config: Optional[llama.LlamaConfig] = None):
         self.config = config
         self.batch_size = batch_size
         self.max_seq_len = max_seq_len or config.max_seq_len
@@ -710,6 +786,12 @@ class DecodeState:
         self.cache = init_cache(config, batch_size, self.max_seq_len,
                                 mesh=mesh, pad_to=pad_to,
                                 kv_quant=kv_quant)
+        # Speculative decoding: the draft model mirrors the cache
+        # (bf16 — the draft is small by construction).
+        self.draft_cache = (
+            init_cache(draft_config, batch_size, self.max_seq_len,
+                       mesh=mesh, pad_to=pad_to)
+            if draft_config is not None else None)
         self.last_tokens = jnp.zeros((batch_size,), jnp.int32)
         self.slots: List[Optional[_Slot]] = [None] * batch_size
 
@@ -730,7 +812,9 @@ class InferenceEngine:
                  prefill_chunk: int = 1024,
                  use_flash: Optional[bool] = None,
                  kv_quant: str = 'none',
-                 prefill_interleave: Optional[int] = None):
+                 prefill_interleave: Optional[int] = None,
+                 draft: Optional[Tuple[Params, Any]] = None,
+                 spec_k: int = 4):
         # The cached decode path mirrors the llama-core transformer
         # (every family knob: window/GeGLU/post-norms/softcaps/tied
         # embeddings) and the MoE family (routed expert MLP).
@@ -787,6 +871,7 @@ class InferenceEngine:
         # (interleaved with decode) so in-flight streams stall one
         # chunk, not a whole long prompt; shorter prompts keep the
         # batched one-shot path. None -> 4 chunks; 0 disables.
+        explicit_interleave = prefill_interleave is not None
         if prefill_interleave is None:
             prefill_interleave = 4 * prefill_chunk if prefill_chunk else 0
         if prefill_chunk <= 0:
@@ -794,11 +879,36 @@ class InferenceEngine:
             # chunking an explicit threshold would park requests in a
             # zero-progress prefill loop forever.
             prefill_interleave = 0
+        # Speculative decoding (draft-propose / big-verify, greedy,
+        # lossless — see spec_step). v1 scope: the draft cache must
+        # track every prompt, which the one-shot prefill path does;
+        # interleaved prefill is disabled when a draft is attached.
+        self._draft_params = self._draft_config = None
+        self.spec_k = spec_k
+        if draft is not None:
+            dparams, dconfig = draft
+            if dconfig.vocab_size != config.vocab_size:
+                raise ValueError(
+                    'draft model must share the vocab: '
+                    f'{dconfig.vocab_size} != {config.vocab_size}')
+            if spec_k < 1:
+                raise ValueError(f'spec_k must be >= 1, got {spec_k}')
+            if explicit_interleave and prefill_interleave > 0:
+                # Never silently reinstate the long-prompt stalls the
+                # operator explicitly configured interleaving against.
+                raise ValueError(
+                    'prefill_interleave is incompatible with a draft '
+                    'model (the draft cache needs one-shot prefill); '
+                    'drop one of the two.')
+            self._draft_params = dparams
+            self._draft_config = dconfig
+            prefill_interleave = 0
         self.prefill_interleave = prefill_interleave
         self.state = DecodeState(config, batch_size, max_seq_len,
                                  mesh=mesh,
                                  prefill_chunk=prefill_chunk,
-                                 kv_quant=kv_quant)
+                                 kv_quant=kv_quant,
+                                 draft_config=self._draft_config)
         self._queue: List[Tuple[int, List[int], SamplingParams]] = []
         self._finished: Dict[int, List[int]] = {}
         self._finished_logprobs: Dict[int, List[float]] = {}
@@ -858,9 +968,7 @@ class InferenceEngine:
         self._last_logprobs.pop(request_id, None)
         for i, slot in enumerate(self.state.slots):
             if slot is not None and slot.request_id == request_id:
-                self.state.slots[i] = None
-                self.state.cache['length'] = \
-                    self.state.cache['length'].at[i].set(0)
+                self._free_slot(i)
 
     def abort_all(self) -> None:
         """Drop every queued and in-flight request (server error
@@ -872,9 +980,7 @@ class InferenceEngine:
         self._last_logprobs.clear()
         for i, slot in enumerate(self.state.slots):
             if slot is not None:
-                self.state.slots[i] = None
-                self.state.cache['length'] = \
-                    self.state.cache['length'].at[i].set(0)
+                self._free_slot(i)
 
     @property
     def has_work(self) -> bool:
@@ -946,6 +1052,14 @@ class InferenceEngine:
                 self.params, padded, lengths, self.state.cache,
                 slot_arr, self.config, chunk,
                 use_flash=self._use_flash)
+            if self._draft_params is not None:
+                # Speculative decoding: the draft cache must hold the
+                # prompt too (its logits are discarded — the big
+                # model's prefill logits sample the first token).
+                _, self.state.draft_cache = prefill_chunked(
+                    self._draft_params, padded, lengths,
+                    self.state.draft_cache, slot_arr,
+                    self._draft_config, chunk, use_flash=False)
         # First generated token comes straight from prefill logits.
         self._key, sub = jax.random.split(self._key)
         temps = jnp.array([s.temperature for _, _, s in inserts],
@@ -1011,6 +1125,43 @@ class InferenceEngine:
         last[i] = token
         self.state.last_tokens = jnp.asarray(last)
 
+    def _free_slot(self, i: int) -> None:
+        """Release slot i: cache lengths zero (stale keys invisible),
+        draft cache mirrored."""
+        self.state.slots[i] = None
+        self.state.cache['length'] = \
+            self.state.cache['length'].at[i].set(0)
+        if self.state.draft_cache is not None:
+            self.state.draft_cache['length'] = \
+                self.state.draft_cache['length'].at[i].set(0)
+
+    def _spec_round(self, active_mask: List[bool]) -> None:
+        active = jnp.array(active_mask)
+        with self._mesh_ctx():
+            (tokens_out, lps_out, emit, new_last, self.state.cache,
+             self.state.draft_cache) = spec_step(
+                self.params, self.state.cache, self._draft_params,
+                self.state.draft_cache, self.state.last_tokens,
+                active, self.spec_k, self.config, self._draft_config)
+        self.state.last_tokens = new_last
+        toks_host, lps_host, emit_host = jax.device_get(
+            (tokens_out, lps_out, emit))
+        for i, slot in enumerate(self.state.slots):
+            if slot is None or slot.pending is not None:
+                continue
+            s = slot.params
+            budget = s.max_new_tokens - len(slot.generated)
+            for j in range(min(int(emit_host[i]), budget)):
+                tok = int(toks_host[i, j])
+                slot.generated.append(tok)
+                slot.logprobs.append(float(lps_host[i, j]))
+                if (s.eos_token_id is not None
+                        and tok == s.eos_token_id):
+                    # Tokens past eos within the round are discarded;
+                    # the slot evicts right after (length zeroed), so
+                    # the cache's extra keys are never visible.
+                    break
+
     def _evict_finished(self) -> None:
         for i, slot in enumerate(self.state.slots):
             if slot is None or slot.pending is not None:
@@ -1023,10 +1174,7 @@ class InferenceEngine:
             if hit_eos or full or len(slot.generated) >= s.max_new_tokens:
                 self._finished[slot.request_id] = slot.generated
                 self._finished_logprobs[slot.request_id] = slot.logprobs
-                self.state.slots[i] = None
-                # Free the cache slot by zeroing its length.
-                self.state.cache['length'] = \
-                    self.state.cache['length'].at[i].set(0)
+                self._free_slot(i)
 
     def step(self) -> None:
         self._evict_finished()
@@ -1037,6 +1185,25 @@ class InferenceEngine:
                        for s in self.state.slots]
         if not any(active_mask):
             return
+        if (self._draft_params is not None
+                and all(s.params.temperature <= 0.0
+                        for s in self.state.slots
+                        if s is not None and s.pending is None)):
+            # Greedy batch + draft attached: speculative round
+            # (lossless; up to spec_k tokens per big-model pass).
+            # Near the cache end the k-wide verify slab would CLAMP
+            # (dynamic_update_slice) and silently overwrite valid
+            # keys — fall back to plain decode for the step instead;
+            # the near-full slot evicts via the `full` bound shortly.
+            k_leaf = self.state.cache['k']
+            padded = (k_leaf['q'] if _is_quant(k_leaf)
+                      else k_leaf).shape[2]
+            lengths_host = jax.device_get(self.state.cache['length'])
+            if all(int(lengths_host[i]) + self.spec_k <= padded
+                   for i, on in enumerate(active_mask) if on):
+                self._spec_round(active_mask)
+                self._evict_finished()
+                return
         self._key, sub = jax.random.split(self._key)
         temps = jnp.array(
             [s.params.temperature if s else 0.0

@@ -911,3 +911,134 @@ class TestInterleavedPrefill:
                 temperature=0.0, max_new_tokens=4))
             outs[interleave] = eng.run_to_completion()[rid]
         assert outs[16] == outs[0]
+
+
+class TestSpeculativeDecoding:
+    """Draft-propose / big-verify greedy decoding (engine.spec_step):
+    LOSSLESS — the output must be token-for-token what plain greedy
+    produces, whatever the draft proposes."""
+
+    def _greedy(self, params, config, prompt, steps, **kw):
+        eng = inference.InferenceEngine(params, config, batch_size=2,
+                                        max_seq_len=64, **kw)
+        rid = eng.submit(prompt, inference.SamplingParams(
+            temperature=0.0, max_new_tokens=steps))
+        out = eng.run_to_completion()[rid]
+        lps = eng.finished_logprobs().get(rid)
+        return out, lps
+
+    def test_same_weights_draft_matches_plain(self, tiny):
+        """Draft == big model: every proposal accepted, output and
+        logprobs identical to non-speculative greedy."""
+        import numpy as np
+        config, params = tiny
+        prompt = [3, 17, 42, 9]
+        base, base_lps = self._greedy(params, config, prompt, 8)
+        spec, spec_lps = self._greedy(params, config, prompt, 8,
+                                      draft=(params, config), spec_k=4)
+        assert spec == base
+        np.testing.assert_allclose(spec_lps, base_lps, atol=1e-3)
+
+    def test_adversarial_draft_still_lossless(self, tiny):
+        """A DIFFERENT random draft (near-zero acceptance) must not
+        change the output — only the speed."""
+        config, params = tiny
+        draft_params = llama.init_params(config, jax.random.key(99))
+        prompt = [5, 11, 2]
+        base, _ = self._greedy(params, config, prompt, 8)
+        spec, _ = self._greedy(params, config, prompt, 8,
+                               draft=(draft_params, config), spec_k=4)
+        assert spec == base
+
+    def test_small_draft_architecture(self, tiny):
+        """Draft with a different (smaller) architecture, same vocab —
+        the deployment shape."""
+        import dataclasses
+        config, params = tiny
+        dconfig = dataclasses.replace(config, num_layers=1,
+                                      hidden_size=32,
+                                      intermediate_size=64,
+                                      num_heads=2, num_kv_heads=1,
+                                      head_dim=16)
+        dparams = llama.init_params(dconfig, jax.random.key(5))
+        prompt = [7, 3, 9, 1]
+        base, _ = self._greedy(params, config, prompt, 10)
+        spec, _ = self._greedy(params, config, prompt, 10,
+                               draft=(dparams, dconfig), spec_k=3)
+        assert spec == base
+
+    def test_eos_inside_spec_round(self, tiny):
+        """An eos accepted mid-round must finish the request exactly
+        there, matching the plain path."""
+        config, params = tiny
+        prompt = [3, 17, 42, 9]
+        base, _ = self._greedy(params, config, prompt, 12)
+        eos = base[5]  # force an eos the model WILL emit mid-round
+
+        def run(**kw):
+            eng = inference.InferenceEngine(
+                params, config, batch_size=2, max_seq_len=64, **kw)
+            rid = eng.submit(prompt, inference.SamplingParams(
+                temperature=0.0, max_new_tokens=12, eos_token_id=eos))
+            return eng.run_to_completion()[rid]
+
+        assert run(draft=(params, config), spec_k=4) == run()
+
+    def test_continuous_batching_under_spec(self, tiny):
+        """Multiple requests share spec rounds; slot recycling works."""
+        config, params = tiny
+        prompts = [[1, 2, 3], [10, 20, 30, 40], [7]]
+        refs = {i: self._greedy(params, config, p, 5)[0]
+                for i, p in enumerate(prompts)}
+        eng = inference.InferenceEngine(params, config, batch_size=2,
+                                        max_seq_len=64,
+                                        draft=(params, config),
+                                        spec_k=3)
+        rids = {eng.submit(p, inference.SamplingParams(
+            temperature=0.0, max_new_tokens=5)): i
+            for i, p in enumerate(prompts)}
+        results = eng.run_to_completion()
+        for rid, idx in rids.items():
+            assert results[rid] == refs[idx], f'prompt {idx} diverged'
+
+    def test_sampled_requests_fall_back(self, tiny):
+        """A temperature>0 request in the batch disables spec for the
+        step (falls back to the normal path) without breaking."""
+        config, params = tiny
+        eng = inference.InferenceEngine(params, config, batch_size=2,
+                                        max_seq_len=64,
+                                        draft=(params, config))
+        g = eng.submit([3, 4], inference.SamplingParams(
+            temperature=0.0, max_new_tokens=4))
+        s = eng.submit([5, 6], inference.SamplingParams(
+            temperature=1.0, max_new_tokens=4))
+        out = eng.run_to_completion()
+        assert len(out[g]) == 4 and len(out[s]) == 4
+
+    def test_vocab_mismatch_rejected(self, tiny):
+        import dataclasses
+        config, params = tiny
+        bad = dataclasses.replace(config, vocab_size=128)
+        with pytest.raises(ValueError, match='vocab'):
+            inference.InferenceEngine(params, config, batch_size=1,
+                                      draft=(params, bad))
+
+    def test_near_cache_end_falls_back_not_corrupts(self, tiny):
+        """A verify slab that would run past the cache end CLAMPS in
+        dynamic_update_slice and overwrites valid keys — near the end
+        the engine must fall back to plain decode for the step and
+        stay token-for-token lossless."""
+        config, params = tiny
+        prompt = [int(i % 251) + 1 for i in range(57)]
+        base, _ = self._greedy(params, config, prompt, 10)
+        spec, _ = self._greedy(params, config, prompt, 10,
+                               draft=(params, config), spec_k=4)
+        assert spec == base
+
+    def test_explicit_interleave_plus_draft_rejected(self, tiny):
+        config, params = tiny
+        with pytest.raises(ValueError, match='interleave'):
+            inference.InferenceEngine(params, config, batch_size=1,
+                                      max_seq_len=64,
+                                      prefill_interleave=2048,
+                                      draft=(params, config))
