@@ -109,6 +109,10 @@ def _tiny_run(run: str, tmpdir: str, port: int = 0) -> str:
     run = re.sub(r'--checkpoint\s+/\S+', '', run)
     run = re.sub(r'--tokenizer\s+/\S+', '', run)
     run = re.sub(r'--prefill-chunk\s+\d+', '--prefill-chunk 16', run)
+    # Speculative recipes: tiny draft, random-init (same vocab as the
+    # tiny main model, so the spec path executes end to end).
+    run = re.sub(r'--draft-model\s+\S+', '--draft-model tiny', run)
+    run = re.sub(r'--draft-checkpoint\s+/\S+', '', run)
     if port:
         run = re.sub(r'--port\s+\d+', f'--port {port}', run)
     return run
