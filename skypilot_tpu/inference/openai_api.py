@@ -22,10 +22,12 @@ message.
 Sampling: temperature, top_k, and top_p (nucleus) all map straight to
 engine.SamplingParams. Sampled-token logprobs are supported
 (completions `logprobs: 0`, chat `logprobs: true`; non-streaming).
-Deliberate scope (documented, enforced with 400s rather than silently
-wrong results): n=1 per prompt (batch by sending a prompt LIST —
-continuous batching packs them), no top-N logprob alternatives, no
-echo/best_of/tools/constrained response_format. `stop` strings
+n>1 fans a prompt into n engine requests (each pays its own prefill;
+index = prompt_i*n + j) and `echo` prepends the prompt
+(non-streaming). Deliberate scope (documented, enforced with 400s
+rather than silently wrong results): no top-N logprob alternatives,
+no best_of/tools/constrained response_format, no echo+logprobs (that
+means prompt scoring in the spec). `stop` strings
 truncate the emitted text; in streaming mode the hit also aborts the
 request (engine.abort) so the slot frees immediately, while
 non-stream requests — whose text is only known at the end — decode to
@@ -43,6 +45,11 @@ def load_tokenizer(name_or_path: str):
     lazily off the serving thread by server._load."""
     from transformers import AutoTokenizer
     return AutoTokenizer.from_pretrained(name_or_path)
+
+
+# n>1 fans one prompt into n engine requests (continuous batching
+# packs them); cap it so one call can't monopolize the decode batch.
+_MAX_N = 8
 
 
 class _BadRequest(Exception):
@@ -81,7 +88,7 @@ def _normalize_prompts(prompt: Any, tokenizer) -> List[List[int]]:
 
 def _parse_common(body: Dict[str, Any], tokenizer, chat: bool):
     """Shared request validation → (SamplingParams, stop strings,
-    want_logprobs)."""
+    want_logprobs, n, echo)."""
     from skypilot_tpu.inference.engine import SamplingParams
     # Sampled-token logprobs are supported (completions `logprobs: 0`,
     # chat `logprobs: true` with top_logprobs absent/0); top-N
@@ -89,11 +96,15 @@ def _parse_common(body: Dict[str, Any], tokenizer, chat: bool):
     # alternatives than asked.
     lp_ok = ((lambda v: v in (None, False, True)) if chat
              else (lambda v: v is None or v == 0))
-    for field, ok in (('n', lambda v: v in (None, 1)),
+    for field, ok in (('n', lambda v: v is None
+                       or (isinstance(v, int)
+                           and not isinstance(v, bool)
+                           and 1 <= v <= _MAX_N)),
                       ('best_of', lambda v: v in (None, 1)),
                       ('logprobs', lp_ok),
                       ('top_logprobs', lambda v: v in (None, 0)),
-                      ('echo', lambda v: not v),
+                      ('echo', lambda v: v in (None, False)
+                       or (not chat and v is True)),
                       # Honoring json_object/json_schema would require
                       # constrained decoding; silently returning free
                       # text to a client that asked for JSON is worse
@@ -147,7 +158,22 @@ def _parse_common(body: Dict[str, Any], tokenizer, chat: bool):
     if want_logprobs and body.get('stream'):
         raise _BadRequest('logprobs are supported on non-streaming '
                           'requests only')
-    return sampling, stops, want_logprobs
+    n = body.get('n') or 1
+    if body.get('best_of') is not None and body['best_of'] < n:
+        raise _BadRequest(f'best_of={body["best_of"]} must be >= '
+                          f'n={n}')
+    echo = bool(body.get('echo', False))
+    if echo and want_logprobs:
+        # Prompt-token logprobs (what echo+logprobs means in the
+        # spec) would need a scoring pass we don't run.
+        raise _BadRequest('echo with logprobs is not supported')
+    if echo and tokenizer is None and isinstance(body.get('prompt'),
+                                                 str):
+        raise _BadRequest('echo needs a tokenizer for string prompts')
+    if echo and body.get('stream'):
+        raise _BadRequest('echo is supported on non-streaming '
+                          'requests only')
+    return sampling, stops, want_logprobs, n, echo
 
 
 def _finish_reason(tokens: List[int], sampling) -> str:
@@ -277,7 +303,7 @@ def add_openai_routes(app, holder: Dict[str, Any]) -> None:
         except json.JSONDecodeError:
             return _err400('body must be JSON')
         try:
-            sampling, stops, want_logprobs = _parse_common(
+            sampling, stops, want_logprobs, n, echo = _parse_common(
                 body, tokenizer, chat)
             if chat:
                 prompts = [_chat_prompt(body, tokenizer)]
@@ -290,6 +316,25 @@ def add_openai_routes(app, holder: Dict[str, Any]) -> None:
         rid = (f'chatcmpl-{uuid.uuid4().hex}' if chat
                else f'cmpl-{uuid.uuid4().hex}')
         created = int(time.time())
+        # n>1: one engine request per choice (index = prompt_i*n + j,
+        # the OpenAI layout); sampled choices diverge via the
+        # engine's advancing PRNG, greedy ones are identical (spec
+        # behavior). Each choice pays its own prefill.
+        n_prompt = sum(len(p) for p in prompts)  # billed once, per spec
+        # Echo must return the client's EXACT prompt text when they
+        # sent strings — decode(encode(s)) is lossy for normalizing
+        # tokenizers. Token-array prompts fall back to decode (text
+        # mode) or prepend the ids (token mode).
+        raw_prompt = body.get('prompt')
+        if echo and isinstance(raw_prompt, str):
+            echo_texts: List[Optional[str]] = [raw_prompt]
+        elif (echo and isinstance(raw_prompt, list) and raw_prompt
+              and all(isinstance(p, str) for p in raw_prompt)):
+            echo_texts = list(raw_prompt)
+        else:
+            echo_texts = [None] * len(prompts)
+        echo_texts = [t for t in echo_texts for _ in range(n)]
+        prompts = [p for p in prompts for _ in range(n)]
         watchers = [engine_loop.submit(p, sampling, stream=stream)
                     for p in prompts]
         if stream:
@@ -321,6 +366,11 @@ def add_openai_routes(app, holder: Dict[str, Any]) -> None:
                     _decode(tokenizer, tokens), stops)
                 if stopped:
                     finish = 'stop'
+                if echo:
+                    prefix = (echo_texts[i]
+                              if echo_texts[i] is not None
+                              else _decode(tokenizer, prompts[i]))
+                    text = prefix + text
             lp_doc = None
             if want_logprobs:
                 # to_thread: the incremental prefix decode is O(n²)
@@ -340,11 +390,11 @@ def add_openai_routes(app, holder: Dict[str, Any]) -> None:
                 choice = {'index': i, 'text': text,
                           'finish_reason': finish}
                 if tokenizer is None:
-                    choice['tokens'] = tokens  # documented extension
+                    choice['tokens'] = (list(prompts[i]) + tokens
+                                        if echo else tokens)
                 if want_logprobs:
                     choice['logprobs'] = lp_doc
                 choices.append(choice)
-        n_prompt = sum(len(p) for p in prompts)
         n_out = sum(len(t) for t in outs)
         return web.json_response({
             'id': rid,

@@ -140,8 +140,12 @@ class TestCompletions:
 
     def test_unsupported_fields_400(self, tiny, toytok):
         async def go(client):
-            for body in ({'prompt': 'hello', 'n': 2},
-                         {'prompt': 'hello', 'echo': True},
+            for body in ({'prompt': 'hello', 'n': 99},
+                         {'prompt': 'hello', 'n': 0},
+                         {'prompt': 'hello', 'echo': True,
+                          'logprobs': 0},
+                         {'prompt': 'hello', 'echo': True,
+                          'stream': True},
                          # top-N alternatives are not supported
                          # (sampled-token logprobs via 0/true are).
                          {'prompt': 'hello', 'logprobs': 3},
@@ -504,3 +508,93 @@ class TestLogprobs:
                 'logprobs': 2})
             assert r.status == 400
         _drive(tiny, toytok, go)
+
+
+class TestNAndEcho:
+    """n>1 (parallel choices) and echo (prompt prepended)."""
+
+    def test_n_choices_greedy_identical(self, tiny, toytok):
+        async def go(client):
+            r = await client.post('/v1/completions', json={
+                'prompt': 'hello world', 'max_tokens': 3,
+                'temperature': 0, 'n': 3})
+            doc = await r.json()
+            assert [c['index'] for c in doc['choices']] == [0, 1, 2]
+            texts = {c['text'] for c in doc['choices']}
+            assert len(texts) == 1  # greedy: all identical, per spec
+            # prompt billed once, completions summed
+            assert doc['usage']['prompt_tokens'] == 2
+            assert doc['usage']['completion_tokens'] == 9
+        _drive(tiny, toytok, go, batch_size=4)
+
+    def test_n_with_prompt_list_index_layout(self, tiny, toytok):
+        async def go(client):
+            r = await client.post('/v1/completions', json={
+                'prompt': ['hello', 'world'], 'max_tokens': 2,
+                'temperature': 0, 'n': 2})
+            doc = await r.json()
+            assert [c['index'] for c in doc['choices']] == [0, 1, 2, 3]
+            # 0,1 share prompt 'hello'; 2,3 share 'world'.
+            assert doc['choices'][0]['text'] == doc['choices'][1]['text']
+            assert doc['choices'][2]['text'] == doc['choices'][3]['text']
+        _drive(tiny, toytok, go, batch_size=4)
+
+    def test_n_chat_sampled_diverge_eventually(self, tiny, toytok):
+        async def go(client):
+            r = await client.post('/v1/chat/completions', json={
+                'messages': [{'role': 'user', 'content': 'hello'}],
+                'max_tokens': 8, 'temperature': 1.0, 'n': 4})
+            doc = await r.json()
+            assert len(doc['choices']) == 4
+            for c in doc['choices']:
+                assert isinstance(c['message']['content'], str)
+        _drive(tiny, toytok, go, batch_size=4)
+
+    def test_echo_prepends_prompt(self, tiny, toytok):
+        async def go(client):
+            plain = await client.post('/v1/completions', json={
+                'prompt': 'hello world', 'max_tokens': 3,
+                'temperature': 0})
+            completion = (await plain.json())['choices'][0]['text']
+            r = await client.post('/v1/completions', json={
+                'prompt': 'hello world', 'max_tokens': 3,
+                'temperature': 0, 'echo': True})
+            (choice,) = (await r.json())['choices']
+            assert choice['text'] == 'hello world' + completion
+        _drive(tiny, toytok, go)
+
+    def test_echo_token_mode(self, tiny):
+        async def go(client):
+            r = await client.post('/v1/completions', json={
+                'prompt': [3, 7, 11], 'max_tokens': 2,
+                'temperature': 0, 'echo': True})
+            (choice,) = (await r.json())['choices']
+            assert choice['tokens'][:3] == [3, 7, 11]
+            assert len(choice['tokens']) == 5
+        _drive(tiny, None, go)
+
+    def test_echo_returns_exact_original_string(self, tiny, toytok):
+        # decode(encode(s)) is lossy (e.g. whitespace collapse); the
+        # echoed prefix must be byte-identical to what was sent.
+        async def go(client):
+            prompt = 'hello   world'   # toy tokenizer collapses runs
+            r = await client.post('/v1/completions', json={
+                'prompt': prompt, 'max_tokens': 2,
+                'temperature': 0, 'echo': True})
+            (choice,) = (await r.json())['choices']
+            assert choice['text'].startswith(prompt)
+        _drive(tiny, toytok, go)
+
+    def test_best_of_below_n_400(self, tiny, toytok):
+        async def go(client):
+            r = await client.post('/v1/completions', json={
+                'prompt': 'hello', 'n': 3, 'best_of': 1})
+            assert r.status == 400
+        _drive(tiny, toytok, go)
+
+    def test_echo_string_without_tokenizer_400(self, tiny):
+        async def go(client):
+            r = await client.post('/v1/completions', json={
+                'prompt': 'hello', 'echo': True})
+            assert r.status == 400
+        _drive(tiny, None, go)
