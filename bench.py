@@ -345,7 +345,16 @@ def _decode_bench(jax, on_tpu: bool):
 
 
 def main() -> None:
-    jax, devices = _init_backend()
+    try:
+        jax, devices = _init_backend()
+    except Exception as e:  # noqa: BLE001 — the docstring contract:
+        # EVERY failure mode ends in a JSON line on stdout (a wedged
+        # tunnel raises from the attach thread; a bare traceback
+        # would leave the driver's BENCH_rN with no parseable record
+        # — the committed BENCH_recovered.json then carries the
+        # evidence, and this line says why the live run had none).
+        _error_line(f'{type(e).__name__}: {e}')
+        raise SystemExit(1)
     n_devices = len(devices)
     on_tpu = devices[0].platform == 'tpu'
 
