@@ -23,6 +23,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import math
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -31,6 +32,7 @@ from jax import lax
 
 from skypilot_tpu.models import llama
 from skypilot_tpu.models import moe as moe_lib
+from skypilot_tpu.observability import instruments as obs
 
 Params = Dict[str, Any]
 Cache = Dict[str, jax.Array]
@@ -928,6 +930,7 @@ class InferenceEngine:
         self._next_id += 1
         self._queue.append((request_id, list(prompt_tokens),
                             sampling or SamplingParams()))
+        obs.QUEUE_DEPTH.set(len(self._queue))
         return request_id
 
     def finished(self) -> Dict[int, List[int]]:
@@ -961,19 +964,26 @@ class InferenceEngine:
         server-side stop strings): its slot frees for the next insert
         and nothing is reported in finished(). Unknown ids are a
         no-op — the request may have finished in the same tick."""
+        before = len(self._queue)
         self._queue = [(rid, t, s) for rid, t, s in self._queue
                        if rid != request_id]
+        aborted = before - len(self._queue)
         self._finished.pop(request_id, None)
         self._finished_logprobs.pop(request_id, None)
         self._last_logprobs.pop(request_id, None)
         for i, slot in enumerate(self.state.slots):
             if slot is not None and slot.request_id == request_id:
                 self._free_slot(i)
+                aborted += 1
+        if aborted:
+            obs.REQUESTS_ABORTED.inc(aborted)
+        self._update_gauges()
 
     def abort_all(self) -> None:
         """Drop every queued and in-flight request (server error
         recovery): slots free, cache lengths zeroed, nothing reported
         as finished."""
+        aborted = len(self._queue)
         self._queue.clear()
         self._finished.clear()
         self._finished_logprobs.clear()
@@ -981,6 +991,10 @@ class InferenceEngine:
         for i, slot in enumerate(self.state.slots):
             if slot is not None:
                 self._free_slot(i)
+                aborted += 1
+        if aborted:
+            obs.REQUESTS_ABORTED.inc(aborted)
+        self._update_gauges()
 
     @property
     def has_work(self) -> bool:
@@ -1016,6 +1030,11 @@ class InferenceEngine:
             slot = free.pop(0)
             request_id, tokens, sampling = self._queue.pop(0)
             tokens = tokens[:self.state.max_seq_len - 1]
+            # Counted POST-truncation, at insert: the counter must
+            # reflect tokens the engine actually prefills, or
+            # prompt-side throughput read from /metrics deltas
+            # over-reports for over-length prompts.
+            obs.PROMPT_TOKENS.inc(len(tokens))
             if (self.prefill_interleave
                     and len(tokens) > self.prefill_interleave):
                 # LONG prompt: prefill one chunk per step() instead of
@@ -1047,6 +1066,7 @@ class InferenceEngine:
             jnp.int32)
         lengths = jnp.array([len(t) for _, t, _ in inserts], jnp.int32)
         slot_arr = jnp.array(slot_ids, jnp.int32)
+        t_prefill = time.perf_counter()
         with self._mesh_ctx():
             logits, self.state.cache = prefill_chunked(
                 self.params, padded, lengths, self.state.cache,
@@ -1068,6 +1088,9 @@ class InferenceEngine:
         topps = jnp.array([s.top_p for _, _, s in inserts], jnp.float32)
         first, first_lp = _sample(logits, temps, topks, topps, sub)
         first_host, lp_host = jax.device_get((first, first_lp))
+        # The device_get above is the sync point: the observed latency
+        # covers the whole batched prefill, not just its dispatch.
+        obs.PREFILL_SECONDS.observe(time.perf_counter() - t_prefill)
         last = jax.device_get(self.state.last_tokens).copy()
         for i, slot in enumerate(slot_ids):
             token = int(first_host[i])
@@ -1075,6 +1098,7 @@ class InferenceEngine:
             self.state.slots[slot].logprobs.append(float(lp_host[i]))
             last[slot] = token
         self.state.last_tokens = jnp.asarray(last)
+        obs.GENERATED_TOKENS.inc(len(slot_ids))
 
     def _advance_prefill(self) -> None:
         """Advance the oldest mid-prefill slot by ONE chunk (the
@@ -1096,6 +1120,7 @@ class InferenceEngine:
         arr = jnp.array([toks + [0] * (chunk - len(toks))], jnp.int32)
         visible = jnp.array([min(len(slot.pending), start + len(toks))],
                             jnp.int32)
+        t_prefill = time.perf_counter()
         with self._mesh_ctx():
             hidden, self.state.cache = prefill_chunk_at(
                 self.params, arr, jnp.int32(start), visible,
@@ -1103,6 +1128,10 @@ class InferenceEngine:
                 self.config, chunk, use_flash=self._use_flash)
         slot.pos = start + len(toks)
         if slot.pos < len(slot.pending):
+            # No observation for non-final chunks: they don't sync
+            # (that overlap IS the point of interleaving), and a
+            # dispatch-only timing would drown the histogram in
+            # microsecond samples that contradict its help string.
             return
         # Final chunk: sample the first generated token from the last
         # prompt position's hidden state (same contract as the
@@ -1117,6 +1146,7 @@ class InferenceEngine:
             jnp.array([slot.params.top_k], jnp.int32),
             jnp.array([slot.params.top_p], jnp.float32), sub)
         first_host, lp_host = jax.device_get((first, first_lp))
+        obs.PREFILL_SECONDS.observe(time.perf_counter() - t_prefill)
         token = int(first_host[0])
         slot.generated.append(token)
         slot.logprobs.append(float(lp_host[0]))
@@ -1124,6 +1154,7 @@ class InferenceEngine:
         last = jax.device_get(self.state.last_tokens).copy()
         last[i] = token
         self.state.last_tokens = jnp.asarray(last)
+        obs.GENERATED_TOKENS.inc(1)
 
     def _free_slot(self, i: int) -> None:
         """Release slot i: cache lengths zero (stale keys invisible),
@@ -1137,6 +1168,7 @@ class InferenceEngine:
 
     def _spec_round(self, active_mask: List[bool]) -> None:
         active = jnp.array(active_mask)
+        t_step = time.perf_counter()
         with self._mesh_ctx():
             (tokens_out, lps_out, emit, new_last, self.state.cache,
              self.state.draft_cache) = spec_step(
@@ -1146,6 +1178,8 @@ class InferenceEngine:
         self.state.last_tokens = new_last
         toks_host, lps_host, emit_host = jax.device_get(
             (tokens_out, lps_out, emit))
+        obs.DECODE_STEP_SECONDS.observe(time.perf_counter() - t_step)
+        emitted = 0
         for i, slot in enumerate(self.state.slots):
             if slot is None or slot.pending is not None:
                 continue
@@ -1155,12 +1189,15 @@ class InferenceEngine:
                 tok = int(toks_host[i, j])
                 slot.generated.append(tok)
                 slot.logprobs.append(float(lps_host[i, j]))
+                emitted += 1
                 if (s.eos_token_id is not None
                         and tok == s.eos_token_id):
                     # Tokens past eos within the round are discarded;
                     # the slot evicts right after (length zeroed), so
                     # the cache's extra keys are never visible.
                     break
+        if emitted:
+            obs.GENERATED_TOKENS.inc(emitted)
 
     def _evict_finished(self) -> None:
         for i, slot in enumerate(self.state.slots):
@@ -1175,6 +1212,22 @@ class InferenceEngine:
                 self._finished[slot.request_id] = slot.generated
                 self._finished_logprobs[slot.request_id] = slot.logprobs
                 self._free_slot(i)
+                obs.REQUESTS_FINISHED.inc()
+
+    def _update_gauges(self) -> None:
+        """Refresh the continuous-batching gauges from HOST-side slot
+        state — no device sync on the hot path (slot bookkeeping
+        mirrors the device cache lengths exactly)."""
+        slots = self.state.slots
+        active = sum(1 for s in slots if s is not None)
+        obs.BATCH_SLOTS_ACTIVE.set(active)
+        obs.BATCH_OCCUPANCY.set(active / max(1, len(slots)))
+        obs.QUEUE_DEPTH.set(len(self._queue))
+        used = sum((s.pos if s.pending is not None
+                    else s.prompt_len + len(s.generated))
+                   for s in slots if s is not None)
+        obs.KV_CACHE_UTILIZATION.set(
+            used / max(1, len(slots) * self.state.max_seq_len))
 
     def step(self) -> None:
         self._evict_finished()
@@ -1184,6 +1237,7 @@ class InferenceEngine:
         active_mask = [s is not None and s.pending is None
                        for s in self.state.slots]
         if not any(active_mask):
+            self._update_gauges()
             return
         if (self._draft_params is not None
                 and all(s.params.temperature <= 0.0
@@ -1203,6 +1257,7 @@ class InferenceEngine:
                    for i, on in enumerate(active_mask) if on):
                 self._spec_round(active_mask)
                 self._evict_finished()
+                self._update_gauges()
                 return
         self._key, sub = jax.random.split(self._key)
         temps = jnp.array(
@@ -1215,6 +1270,7 @@ class InferenceEngine:
             [s.params.top_p if s else 1.0 for s in self.state.slots],
             jnp.float32)
         active = jnp.array(active_mask)
+        t_step = time.perf_counter()
         with self._mesh_ctx():
             next_tokens, logprobs, self.state.cache = decode_step(
                 self.params, self.state.cache, self.state.last_tokens,
@@ -1223,6 +1279,8 @@ class InferenceEngine:
         # ONE host sync for both arrays: a second blocking device_get
         # on the hot decode loop is pure added latency.
         tokens_host, lp_host = jax.device_get((next_tokens, logprobs))
+        obs.DECODE_STEP_SECONDS.observe(time.perf_counter() - t_step)
+        emitted = 0
         for i, slot in enumerate(self.state.slots):
             # pending guard: a slot mid-(interleaved-)prefill was
             # masked inactive in decode_step — appending its (stale)
@@ -1230,4 +1288,7 @@ class InferenceEngine:
             if slot is not None and slot.pending is None:
                 slot.generated.append(int(tokens_host[i]))
                 slot.logprobs.append(float(lp_host[i]))
+                emitted += 1
+        obs.GENERATED_TOKENS.inc(emitted)
         self._evict_finished()
+        self._update_gauges()

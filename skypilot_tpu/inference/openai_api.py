@@ -39,6 +39,12 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
+from skypilot_tpu import sky_logging
+from skypilot_tpu.observability import tracing
+from skypilot_tpu.utils import timeline
+
+logger = sky_logging.init_logger(__name__)
+
 
 def load_tokenizer(name_or_path: str):
     """HF tokenizer via transformers (baked into the image); loaded
@@ -312,10 +318,28 @@ def add_openai_routes(app, holder: Dict[str, Any]) -> None:
                                              tokenizer)
         except _BadRequest as e:
             return _err400(str(e))
-        stream = bool(body.get('stream', False))
         rid = (f'chatcmpl-{uuid.uuid4().hex}' if chat
                else f'cmpl-{uuid.uuid4().hex}')
+        # The OpenAI response id doubles as the tracing request id:
+        # log lines (rid=...) and timeline spans carry the exact id
+        # the client sees in the response body. A scoped bind (not a
+        # bare one): aiohttp serves successive keep-alive requests on
+        # ONE connection task, so an un-reset contextvar would leak
+        # this id into the next request's logs wherever these routes
+        # are mounted without the observability middleware.
+        with tracing.request_scope(rid):
+            return await _respond(request, chat, engine_loop,
+                                  tokenizer, body, sampling, stops,
+                                  want_logprobs, n, echo, rid, prompts)
+
+    async def _respond(request, chat, engine_loop, tokenizer, body,
+                       sampling, stops, want_logprobs, n, echo, rid,
+                       prompts):
+        stream = bool(body.get('stream', False))
         created = int(time.time())
+        logger.info('%s: %d prompt(s), n=%d, stream=%s',
+                    'chat.completions' if chat else 'completions',
+                    len(prompts), n, stream)
         # n>1: one engine request per choice (index = prompt_i*n + j,
         # the OpenAI layout); sampled choices diverge via the
         # engine's advancing PRNG, greedy ones are identical (spec
@@ -342,7 +366,8 @@ def add_openai_routes(app, holder: Dict[str, Any]) -> None:
                                  prompts, sampling, stops, tokenizer,
                                  rid, created, chat)
         try:
-            outs = await asyncio.gather(*map(_collect, watchers))
+            with timeline.Event('openai.generate'):
+                outs = await asyncio.gather(*map(_collect, watchers))
         except RuntimeError as e:
             # One prompt failed: the 500 covers the whole request, so
             # free the SIBLING slots too — gather leaves their

@@ -27,6 +27,18 @@ import queue
 import threading
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu import sky_logging
+from skypilot_tpu.observability import instruments as obs
+from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.observability import tracing
+from skypilot_tpu.utils import timeline
+
+# Explicit name, not __name__: `python -m skypilot_tpu.inference.server`
+# imports this module as __main__, which would fall outside the
+# skypilot_tpu logger hierarchy (and its stderr handler) — INFO lines
+# like the per-request rid= correlation line would be silently dropped.
+logger = sky_logging.init_logger('skypilot_tpu.inference.server')
+
 
 class EngineLoop:
     """Single thread owning the engine: submit via queue, results and
@@ -166,8 +178,20 @@ def create_app(engine_holder: Dict[str, Any]):
 
     async def health(request):
         ok = engine_holder.get('loop') is not None
-        return web.json_response({'status': 'ok' if ok else 'loading'},
-                                 status=200 if ok else 503)
+        doc: Dict[str, Any] = {'status': 'ok' if ok else 'loading'}
+        if ok:
+            # Liveness detail from the engine gauges: readiness probes
+            # (and operators) can tell "up" from "wedged at capacity"
+            # without a device sync.
+            doc['engine'] = {
+                'queue_depth': int(obs.QUEUE_DEPTH.value()),
+                'in_flight': int(obs.BATCH_SLOTS_ACTIVE.value()),
+                'batch_occupancy': obs.BATCH_OCCUPANCY.value(),
+                'kv_cache_utilization':
+                    obs.KV_CACHE_UTILIZATION.value(),
+            }
+        return web.json_response(doc, status=200 if ok else 503)
+
 
     async def generate(request):
         engine_loop: Optional[EngineLoop] = engine_holder.get('loop')
@@ -189,54 +213,62 @@ def create_app(engine_holder: Dict[str, Any]):
                 {'error': 'prompt_tokens must be non-empty'}, status=400)
         stream = bool(body.get('stream', False))
         want_logprobs = bool(body.get('logprobs', False))
-        watcher = engine_loop.submit(prompt, sampling, stream=stream)
-
+        # The middleware bound a request scope; log the acceptance so
+        # the `rid=` line and the timeline span below carry the SAME
+        # ID — per-request correlation across logs and Chrome trace.
+        logger.info('generate: %d prompt token(s), max_new_tokens=%d, '
+                    'stream=%s', len(prompt), sampling.max_new_tokens,
+                    stream)
         # A vanished client (handler cancelled, connection reset) must
         # free its decode slot — otherwise ghosts occupy the batch
         # until max_new_tokens.
-        try:
-            if not stream:
+        with timeline.Event('inference.generate'):
+            watcher = engine_loop.submit(prompt, sampling,
+                                         stream=stream)
+            try:
+                if not stream:
+                    while True:
+                        kind, payload = await watcher.q.get()
+                        if kind == 'done':
+                            doc = {'tokens': payload}
+                            if want_logprobs:
+                                doc['logprobs'] = watcher.logprobs
+                            return web.json_response(doc)
+                        if kind == 'error':
+                            return web.json_response(
+                                {'error': payload}, status=500)
+
+                resp = web.StreamResponse(headers={
+                    'Content-Type': 'text/event-stream',
+                    'Cache-Control': 'no-cache'})
+                await resp.prepare(request)
                 while True:
                     kind, payload = await watcher.q.get()
-                    if kind == 'done':
-                        doc = {'tokens': payload}
-                        if want_logprobs:
-                            doc['logprobs'] = watcher.logprobs
-                        return web.json_response(doc)
-                    if kind == 'error':
-                        return web.json_response({'error': payload},
-                                                 status=500)
+                    if kind == 'token':
+                        await resp.write(
+                            f'data: {json.dumps({"token": payload})}\n\n'
+                            .encode())
+                    elif kind == 'error':
+                        await resp.write(
+                            f'data: {json.dumps({"error": payload})}\n\n'
+                            .encode())
+                        break
+                    else:
+                        await resp.write(
+                            ('data: '
+                             f'{json.dumps({"done": True, "tokens": payload})}'
+                             '\n\n').encode())
+                        break
+                await resp.write_eof()
+                return resp
+            except (asyncio.CancelledError, ConnectionResetError):
+                engine_loop.abort(watcher)
+                raise
 
-            resp = web.StreamResponse(headers={
-                'Content-Type': 'text/event-stream',
-                'Cache-Control': 'no-cache'})
-            await resp.prepare(request)
-            while True:
-                kind, payload = await watcher.q.get()
-                if kind == 'token':
-                    await resp.write(
-                        f'data: {json.dumps({"token": payload})}\n\n'
-                        .encode())
-                elif kind == 'error':
-                    await resp.write(
-                        f'data: {json.dumps({"error": payload})}\n\n'
-                        .encode())
-                    break
-                else:
-                    await resp.write(
-                        ('data: '
-                         f'{json.dumps({"done": True, "tokens": payload})}'
-                         '\n\n').encode())
-                    break
-            await resp.write_eof()
-            return resp
-        except (asyncio.CancelledError, ConnectionResetError):
-            engine_loop.abort(watcher)
-            raise
-
-    app = web.Application()
+    app = web.Application(middlewares=[obs.http_middleware('inference')])
     app.router.add_get('/health', health)
     app.router.add_get('/', health)
+    app.router.add_get('/metrics', metrics_lib.aiohttp_handler)
     app.router.add_post('/generate', generate)
     from skypilot_tpu.inference import openai_api
     openai_api.add_openai_routes(app, engine_holder)

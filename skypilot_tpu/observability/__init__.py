@@ -1,0 +1,19 @@
+"""Unified metrics + tracing: the observability substrate.
+
+Three pieces, dependency-free by design (serving hosts stay lean):
+
+- `metrics`: thread-safe Counter/Gauge/Histogram registry with
+  Prometheus text exposition — `metrics.generate_text()` is the
+  /metrics payload on all three HTTP planes (API server, inference
+  server, serve load balancer).
+- `tracing`: a contextvar request ID that flows into `sky_logging`
+  lines (`rid=...`) and `utils.timeline` span args, correlating logs
+  with Chrome-trace spans per request.
+- `instruments`: the skytpu_* catalog — every hot-path metric the
+  north-star numbers depend on (engine step latency, batch occupancy,
+  token counters, serve-plane gauges, heartbeats, train MFU).
+"""
+from skypilot_tpu.observability import metrics
+from skypilot_tpu.observability import tracing
+
+__all__ = ['metrics', 'tracing']

@@ -1,0 +1,59 @@
+"""Request-scoped tracing: one contextvar request ID per request.
+
+The correlation key that unifies the three observability surfaces: a
+handler binds an ID once, and from then on (within that task/thread
+context) every `sky_logging` log line carries `rid=<id>` and every
+`timeline.Event` span records it in its trace args — so a slow span in
+a Chrome trace resolves to the exact log lines (and vice versa)
+without timestamp archaeology.
+
+    from skypilot_tpu.observability import tracing
+    with tracing.request_scope() as rid:          # or request_scope(rid)
+        logger.info('handling')                   # ... rid=req-ab12...
+        with timeline.Event('engine.generate'):   # args.request_id set
+            ...
+
+contextvars propagate through `await` and `asyncio` task creation, so
+one bind at the top of an aiohttp handler covers everything the
+request touches on the event loop. Code that hops threads must rebind
+(`bind()` the id it carried over).
+"""
+import contextlib
+import contextvars
+import uuid
+from typing import Iterator, Optional
+
+_request_id: contextvars.ContextVar[Optional[str]] = \
+    contextvars.ContextVar('skytpu_request_id', default=None)
+
+
+def get_request_id() -> Optional[str]:
+    """The current request's ID, or None outside any request scope."""
+    return _request_id.get()
+
+
+def new_request_id(prefix: str = 'req') -> str:
+    return f'{prefix}-{uuid.uuid4().hex[:12]}'
+
+
+def bind(request_id: str) -> contextvars.Token:
+    """Set the ID in the current context; returns the reset token.
+    Prefer request_scope() — bind() is for thread hops where a with
+    block can't span the handoff."""
+    return _request_id.set(request_id)
+
+
+def unbind(token: contextvars.Token) -> None:
+    _request_id.reset(token)
+
+
+@contextlib.contextmanager
+def request_scope(request_id: Optional[str] = None) -> Iterator[str]:
+    """Bind `request_id` (or a fresh one) for the duration of the
+    block; yields the bound ID."""
+    rid = request_id or new_request_id()
+    token = _request_id.set(rid)
+    try:
+        yield rid
+    finally:
+        _request_id.reset(token)

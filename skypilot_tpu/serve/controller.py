@@ -12,6 +12,7 @@ import os
 import time
 import traceback
 
+from skypilot_tpu.observability import instruments as obs
 from skypilot_tpu.serve import autoscalers
 from skypilot_tpu.serve import load_balancer as lb_lib
 from skypilot_tpu.serve import replica_managers
@@ -102,10 +103,11 @@ class ServeController:
             for r in surge[:self.spec.min_replicas + 1])
         if isinstance(self.autoscaler,
                       autoscalers.FallbackRequestRateAutoscaler):
-            self._scale_mixed(live, protected)
+            target = self._scale_mixed(live, protected)
         else:
             decision = self.autoscaler.decide(
                 len(ready), len(live), self.lb.tracker.qps())
+            target = decision.target_replicas
             if decision.target_replicas > len(live):
                 self.manager.scale_up(
                     decision.target_replicas - len(live))
@@ -115,7 +117,25 @@ class ServeController:
                     self.manager.scale_down(
                         _pick_victims(live, n, protected))
 
+        self._export_metrics(replicas, live, target)
         self._set_health_status(live, ready)
+
+    def _export_metrics(self, replicas, live, target) -> None:
+        """Serve-plane gauges: replica counts per lifecycle state plus
+        autoscaler target vs. actual — the launch→ready gap and
+        scaling lag become scrapes instead of log archaeology. Every
+        state is set each tick (including to 0) so a drained state's
+        stale gauge can't linger."""
+        counts = {state: 0 for state in serve_state.ReplicaStatus}
+        for r in replicas:
+            counts[r['status']] = counts.get(r['status'], 0) + 1
+        for state, n in counts.items():
+            obs.SERVE_REPLICAS.labels(service=self.service_name,
+                                      state=state.value).set(n)
+        obs.AUTOSCALER_TARGET_REPLICAS.labels(
+            service=self.service_name).set(target)
+        obs.AUTOSCALER_ACTUAL_REPLICAS.labels(
+            service=self.service_name).set(len(live))
 
     def _set_health_status(self, live, ready) -> None:
         status = (serve_state.ServiceStatus.READY if ready else
@@ -123,11 +143,11 @@ class ServeController:
                    serve_state.ServiceStatus.REPLICA_INIT))
         serve_state.set_service_status(self.service_name, status)
 
-    def _scale_mixed(self, live, protected=frozenset()) -> None:
+    def _scale_mixed(self, live, protected=frozenset()) -> int:
         """Spot fleet with on-demand fallback: reconcile the two pools
         separately toward the mixed decision. `protected` replicas
         (rolling-update surge) are never victims and grant their pool
-        an equal headroom allowance."""
+        an equal headroom allowance. Returns the combined target."""
         spot = [r for r in live if r.get('use_spot')]
         ondemand = [r for r in live if not r.get('use_spot')]
         ready_spot = [r for r in spot
@@ -150,6 +170,7 @@ class ServeController:
 
         reconcile(spot, decision.target_spot, True)
         reconcile(ondemand, decision.target_ondemand, False)
+        return decision.target_spot + decision.target_ondemand
 
     def _maybe_reload_spec(self, service) -> None:
         """Pick up a version bump from `serve update` (new task YAML)."""

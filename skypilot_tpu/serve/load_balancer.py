@@ -11,6 +11,8 @@ import threading
 import time
 from typing import List, Optional
 
+from skypilot_tpu.observability import instruments as obs
+from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.serve import load_balancing_policies as lb_policies
 
 _QPS_WINDOW_SECONDS = 60.0
@@ -60,9 +62,11 @@ class LoadBalancer:
         self.tracker.record()
         target = self.policy.select()
         if target is None:
+            obs.LB_NO_REPLICA.inc()
             return web.Response(
                 status=503,
                 text='No ready replicas. Retry shortly.\n')
+        obs.LB_REPLICA_REQUESTS.labels(replica=target).inc()
         url = target.rstrip('/') + '/' + request.match_info['tail']
         if request.query_string:
             url += f'?{request.query_string}'
@@ -97,6 +101,7 @@ class LoadBalancer:
                     await response.write_eof()
                     return response
         except (OSError, aiohttp.ClientError) as e:
+            obs.LB_PROXY_ERRORS.inc()
             if response is None or not response.prepared:
                 return web.Response(status=502,
                                     text=f'Upstream error: {e}\n')
@@ -112,6 +117,9 @@ class LoadBalancer:
         from aiohttp import web
         app = web.Application(client_max_size=1024 * 1024 * 256)
         app.router.add_get('/internal/stats', self._handle_stats)
+        # Registered before the catch-all proxy: the LB's own metrics,
+        # not a replica's (a replica's /metrics is scraped directly).
+        app.router.add_get('/metrics', metrics_lib.aiohttp_handler)
         app.router.add_route('*', '/{tail:.*}', self._handle_proxy)
         return app
 

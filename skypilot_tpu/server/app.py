@@ -14,6 +14,8 @@ import os
 from typing import Any, Dict
 
 import skypilot_tpu
+from skypilot_tpu.observability import instruments as obs
+from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.server import auth
 from skypilot_tpu.server import executor
 from skypilot_tpu.server import impl  # noqa: F401 — populates REGISTRY
@@ -505,6 +507,12 @@ async def _handle_health(request):
     })
 
 
+# /metrics: Prometheus text exposition of everything this process
+# touched (HTTP plane counters, heartbeat staleness gauges,
+# executor-side engine/train series when co-located).
+_handle_metrics = metrics_lib.aiohttp_handler
+
+
 _HEARTBEAT_MAX_BYTES = 16 * 1024
 
 
@@ -549,9 +557,16 @@ async def _handle_heartbeat(request):
             cluster_name, str(body.get('epoch') or '') or None,
             {'jobs': body.get('jobs') or {},
              'skylet_pid': body.get('skylet_pid'),
-             'reported_time': body.get('time')}))
+             'reported_time': body.get('time'),
+             'sent': body.get('sent')}))
     if not accepted:
         raise web.HTTPNotFound(text=f'Unknown cluster {cluster_name!r}.')
+    # Staleness becomes a scrape, not a log grep: alert on
+    # time() - skytpu_heartbeat_last_timestamp_seconds{cluster=...}.
+    import time as time_lib
+    obs.HEARTBEATS_RECEIVED.labels(cluster=cluster_name).inc()
+    obs.HEARTBEAT_LAST_TIMESTAMP.labels(cluster=cluster_name).set(
+        time_lib.time())
     return _json_response({'recorded': True})
 
 
@@ -598,10 +613,16 @@ async def _state_dir_watchdog(app):
 
 def create_app():
     from aiohttp import web
-    app = web.Application(middlewares=auth.middlewares())
+    # The observability middleware runs OUTERMOST: it binds the
+    # request-ID scope the auth middleware reuses for its response
+    # header, and its counters see the final status of every request
+    # (including auth 401s).
+    app = web.Application(middlewares=[obs.http_middleware('api')]
+                          + auth.middlewares())
     app.on_startup.append(_recover_orphans)
     app.on_startup.append(_state_dir_watchdog)
     app.router.add_get(f'{API_PREFIX}/health', _handle_health)
+    app.router.add_get('/metrics', _handle_metrics)
     app.router.add_post(f'{API_PREFIX}/heartbeat', _handle_heartbeat)
     app.router.add_get('/dashboard', _handle_dashboard)
     app.router.add_get('/dashboard/login', _handle_login_page)

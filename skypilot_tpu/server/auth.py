@@ -11,6 +11,7 @@ import uuid
 from typing import Optional
 
 from skypilot_tpu import users
+from skypilot_tpu.observability import tracing
 from skypilot_tpu.users import permission
 
 # Bumped on breaking API changes; the server accepts equal versions and
@@ -22,6 +23,11 @@ VERSION_HEADER = 'X-Skytpu-Api-Version'
 # the login pair is how browsers GET a credential in the first place;
 # heartbeat is cluster telemetry — skylets hold no user tokens, and the
 # handler only timestamps clusters the server already knows).
+# /metrics is deliberately NOT here: its heartbeat series carry cluster
+# names, which are user data on a multi-user server — in open local
+# mode (no users configured) it works unauthenticated like everything
+# else, and with users configured the scraper presents a bearer token
+# (standard Prometheus `authorization` scrape config).
 _OPEN_PATHS = ('/api/v1/health', '/api/v1/heartbeat', '/dashboard/login',
                '/dashboard/api/login')
 
@@ -49,7 +55,12 @@ def middlewares():
 
     @web.middleware
     async def request_id_middleware(request, handler):
-        request['request_uuid'] = uuid.uuid4().hex[:12]
+        # The observability middleware (instruments.http_middleware)
+        # runs outermost and binds the tracing contextvar; reuse its
+        # ID so the response header, the rid= log lines and the
+        # timeline span args all carry the same value.
+        request['request_uuid'] = (tracing.get_request_id()
+                                   or uuid.uuid4().hex[:12])
         response = await handler(request)
         try:
             response.headers['X-Skytpu-Request-Id'] = \

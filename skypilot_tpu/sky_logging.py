@@ -17,8 +17,24 @@ import sys
 import threading
 from typing import Optional
 
-_FORMAT = '%(levelname).1s %(asctime)s %(name)s:%(lineno)d] %(message)s'
+from skypilot_tpu.observability import tracing
+
+_FORMAT = ('%(levelname).1s %(asctime)s %(name)s:%(lineno)d]'
+           '%(skytpu_rid)s %(message)s')
 _DATE_FORMAT = '%m-%d %H:%M:%S'
+
+
+class RequestIdFilter(logging.Filter):
+    """Stamps records with the contextvar request ID (as ` rid=<id>`,
+    or '' outside any request scope) so log lines correlate with
+    timeline spans carrying the same ID. A filter rather than a
+    formatter: it composes with any formatter and runs exactly once
+    per record."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        rid = tracing.get_request_id()
+        record.skytpu_rid = f' rid={rid}' if rid else ''
+        return True
 
 _lock = threading.Lock()
 _root_initialized = False
@@ -59,6 +75,7 @@ def _ensure_root_handler() -> None:
             handler = logging.StreamHandler(sys.stderr)
             handler.setFormatter(
                 logging.Formatter(_FORMAT, datefmt=_DATE_FORMAT))
+            handler.addFilter(RequestIdFilter())
             root.addHandler(handler)
         root.propagate = False
         _root_initialized = True

@@ -81,18 +81,31 @@ class HeartbeatEvent(SkyletEvent):
                 counts[status] = counts.get(status, 0) + 1
         except Exception:  # noqa: BLE001 — job DB may not exist yet
             pass
+        from skypilot_tpu.observability import instruments as obs
         payload = {
             'cluster_name': topology.get('cluster_name'),
             'epoch': topology.get('epoch'),
             'time': time.time(),
             'skylet_pid': os.getpid(),
             'jobs': counts,
+            # Delivery history piggybacked on the beat itself: the
+            # skylet exposes no /metrics endpoint, so the counter
+            # rides to the API server (stored in the heartbeat
+            # payload) where gaps — beats sent but not received, or
+            # prior delivery errors — become visible controller-side.
+            'sent': {
+                'ok': int(obs.HEARTBEATS_SENT.value(outcome='ok')),
+                'error': int(obs.HEARTBEATS_SENT.value(
+                    outcome='error')),
+            },
         }
         self._post(url.rstrip('/') + '/api/v1/heartbeat', payload)
 
     @staticmethod
-    def _post(endpoint: str, payload: dict) -> None:
+    def _post(endpoint: str, payload: dict) -> bool:
         import urllib.request
+
+        from skypilot_tpu.observability import instruments as obs
         try:
             req = urllib.request.Request(
                 endpoint, data=json.dumps(payload).encode(),
@@ -101,4 +114,7 @@ class HeartbeatEvent(SkyletEvent):
             with urllib.request.urlopen(req, timeout=5):
                 pass
         except Exception:  # noqa: BLE001 — liveness must never break skylet
-            pass
+            obs.HEARTBEATS_SENT.labels(outcome='error').inc()
+            return False
+        obs.HEARTBEATS_SENT.labels(outcome='ok').inc()
+        return True

@@ -15,6 +15,7 @@ from typing import Any, Callable, Dict, Optional
 
 import jax
 
+from skypilot_tpu.observability import instruments as obs
 from skypilot_tpu.parallel import mesh as mesh_lib
 from skypilot_tpu.train import checkpoints
 from skypilot_tpu.train import trainer as trainer_lib
@@ -54,8 +55,19 @@ def fit(cfg: trainer_lib.TrainerConfig,
     t_last = time.perf_counter()
     metrics = {}
     with mesh_lib.use_mesh(mesh):
+        t_step = time.perf_counter()
         for i in range(start_step, cfg.max_steps):
             state, metrics = step_fn(state, batch_fn(i))
+            # Same registry the serving planes scrape: per-step wall
+            # time (async dispatch included — the loss read below is
+            # the sync point each log window), token count, and step
+            # progress, so a training replica's /metrics (or a test)
+            # yields tokens/sec/chip from two scrapes.
+            now = time.perf_counter()
+            obs.TRAIN_STEP_SECONDS.observe(now - t_step)
+            t_step = now
+            obs.TRAIN_TOKENS.inc(tokens_per_step)
+            obs.TRAIN_STEP.set(i + 1)
             if (i + 1) % log_every == 0:
                 loss = float(metrics['loss'])
                 dt = time.perf_counter() - t_last
@@ -63,6 +75,8 @@ def fit(cfg: trainer_lib.TrainerConfig,
                 tps = tokens_per_step * log_every / dt
                 mfu = trainer_lib.mfu(tps, mcfg, cfg.seq_len, peak,
                                       jax.device_count())
+                obs.TRAIN_MFU.set(mfu)
+                obs.TRAIN_LOSS.set(loss)
                 log_fn(f'[fit] step {i + 1}/{cfg.max_steps} '
                        f'loss={loss:.4f} tokens/s={tps:.0f} '
                        f'mfu={mfu:.2%}')

@@ -12,6 +12,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu.observability import tracing
+
 _ENV_VAR = 'SKYTPU_TIMELINE'
 _events: List[Dict[str, Any]] = []
 _lock = threading.Lock()
@@ -53,8 +55,17 @@ class Event:
             'pid': os.getpid(),
             'tid': threading.get_ident() % (1 << 31),
         }
+        args: Dict[str, Any] = {}
         if self._message:
-            event['args'] = {'message': self._message}
+            args['message'] = self._message
+        # Correlation: the contextvar request ID (observability.tracing)
+        # lands in the span args, so a slow span in the Chrome trace
+        # resolves to the exact `rid=` log lines of the same request.
+        request_id = tracing.get_request_id()
+        if request_id is not None:
+            args['request_id'] = request_id
+        if args:
+            event['args'] = args
         with _lock:
             _events.append(event)
 
@@ -79,16 +90,28 @@ def save(path: Optional[str] = None) -> Optional[str]:
     path = path or os.environ.get(_ENV_VAR)
     if not path:
         return None
+    # Take-and-clear: an explicit save() followed by the atexit flush
+    # (or two explicit saves) must not write a second per-PID file
+    # duplicating every span already on disk.
     with _lock:
         events = list(_events)
+        _events.clear()
     if not events:
         return None
-    path = os.path.expanduser(path)
-    os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
-    # One file per process: the server's forked workers each trace.
-    if os.path.exists(path):
-        root, ext = os.path.splitext(path)
-        path = f'{root}.{os.getpid()}{ext}'
-    with open(path, 'w', encoding='utf-8') as f:
-        json.dump({'traceEvents': events}, f)
+    try:
+        path = os.path.expanduser(path)
+        os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
+        # One file per process: the server's forked workers each trace.
+        if os.path.exists(path):
+            root, ext = os.path.splitext(path)
+            path = f'{root}.{os.getpid()}{ext}'
+        with open(path, 'w', encoding='utf-8') as f:
+            json.dump({'traceEvents': events}, f)
+    except OSError:
+        # Failed write (full/unwritable disk): put the spans back so a
+        # later save() — e.g. the atexit flush — can retry instead of
+        # silently losing the whole trace.
+        with _lock:
+            _events[:0] = events
+        raise
     return path

@@ -1,0 +1,450 @@
+"""Unified metrics + tracing layer (skypilot_tpu/observability).
+
+Covers: registry semantics (labels, cardinality guard, concurrent
+increments), Prometheus text-format golden output, request-ID
+propagation into log records and timeline span args, and the /metrics
+round trip on each of the three HTTP planes — including the
+acceptance path: a tiny CPU generation moves
+skytpu_generated_tokens_total / the decode-step histogram / the
+batch-occupancy gauge, and the request's ID shows up in BOTH the
+timeline trace args and the structured log line.
+"""
+import asyncio
+import json
+import logging
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.observability import instruments
+from skypilot_tpu.observability import metrics
+from skypilot_tpu.observability import tracing
+from skypilot_tpu.utils import timeline
+
+
+class TestCounter:
+
+    def test_inc_and_value(self):
+        reg = metrics.Registry()
+        c = metrics.Counter('skytpu_widgets_total', 'Widgets.',
+                            registry=reg)
+        assert c.value() == 0
+        c.inc()
+        c.inc(2.5)
+        assert c.value() == 3.5
+
+    def test_negative_inc_rejected(self):
+        reg = metrics.Registry()
+        c = metrics.Counter('skytpu_x_total', 'X.', registry=reg)
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_labels_are_distinct_series(self):
+        reg = metrics.Registry()
+        c = metrics.Counter('skytpu_reqs_total', 'Reqs.',
+                            labelnames=('code',), registry=reg)
+        c.labels(code='200').inc(3)
+        c.labels(code='500').inc()
+        assert c.value(code='200') == 3
+        assert c.value(code='500') == 1
+        assert c.value(code='404') == 0
+
+    def test_wrong_labels_rejected(self):
+        reg = metrics.Registry()
+        c = metrics.Counter('skytpu_l_total', 'L.',
+                            labelnames=('a',), registry=reg)
+        with pytest.raises(ValueError):
+            c.labels(b='x')
+        with pytest.raises(ValueError):
+            c.inc()  # labelled metric needs .labels()
+
+    def test_cardinality_guard_collapses_overflow(self):
+        reg = metrics.Registry()
+        c = metrics.Counter('skytpu_many_total', 'Many.',
+                            labelnames=('k',), registry=reg)
+        for i in range(metrics.MAX_LABEL_SETS + 50):
+            c.labels(k=f'v{i}').inc()
+        series = c.samples()
+        # Capped at MAX_LABEL_SETS + the single overflow series.
+        assert len(series) <= metrics.MAX_LABEL_SETS + 1
+        assert sum(v for _, _, v in series) == metrics.MAX_LABEL_SETS + 50
+
+    def test_concurrent_increments_lose_nothing(self):
+        reg = metrics.Registry()
+        c = metrics.Counter('skytpu_conc_total', 'Conc.', registry=reg)
+        n, per = 8, 2000
+
+        def work():
+            for _ in range(per):
+                c.inc()
+
+        threads = [threading.Thread(target=work) for _ in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value() == n * per
+
+
+class TestGauge:
+
+    def test_set_inc_dec(self):
+        reg = metrics.Registry()
+        g = metrics.Gauge('skytpu_depth', 'Depth.', registry=reg)
+        g.set(5)
+        g.inc()
+        g.dec(2)
+        assert g.value() == 4
+
+
+class TestHistogram:
+
+    def test_bucket_counts(self):
+        reg = metrics.Registry()
+        h = metrics.Histogram('skytpu_lat_seconds', 'Lat.',
+                              buckets=(0.1, 1.0, 10.0), registry=reg)
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        cumulative, total, n = h.child_snapshot()
+        assert cumulative == [1, 3, 4, 5]  # le=0.1, 1, 10, +Inf
+        assert n == 5
+        assert total == pytest.approx(56.05)
+
+    def test_boundary_lands_in_its_bucket(self):
+        """Prometheus buckets are le= (inclusive upper bound)."""
+        reg = metrics.Registry()
+        h = metrics.Histogram('skytpu_b_seconds', 'B.',
+                              buckets=(1.0, 2.0), registry=reg)
+        h.observe(1.0)
+        cumulative, _, _ = h.child_snapshot()
+        assert cumulative == [1, 1, 1]
+
+    def test_unsorted_buckets_rejected(self):
+        reg = metrics.Registry()
+        with pytest.raises(ValueError):
+            metrics.Histogram('skytpu_bad_seconds', 'Bad.',
+                              buckets=(1.0, 0.5), registry=reg)
+        with pytest.raises(ValueError):
+            metrics.Histogram('skytpu_bad2_seconds', 'Bad.',
+                              buckets=(), registry=reg)
+
+
+class TestRegistry:
+
+    def test_bad_names_rejected(self):
+        reg = metrics.Registry()
+        for bad in ('widgets_total', 'skytpu_CamelCase', 'skytpu-dash'):
+            with pytest.raises(ValueError):
+                metrics.Counter(bad, 'Bad.', registry=reg)
+
+    def test_help_required(self):
+        reg = metrics.Registry()
+        with pytest.raises(ValueError):
+            metrics.Counter('skytpu_nohelp_total', '  ', registry=reg)
+
+    def test_duplicate_name_rejected(self):
+        reg = metrics.Registry()
+        metrics.Counter('skytpu_dup_total', 'A.', registry=reg)
+        with pytest.raises(ValueError):
+            metrics.Counter('skytpu_dup_total', 'B.', registry=reg)
+
+    def test_text_format_golden(self):
+        """Byte-exact exposition: the contract any scraper parses."""
+        reg = metrics.Registry()
+        c = metrics.Counter('skytpu_requests_total', 'Total requests.',
+                            labelnames=('code',), registry=reg)
+        c.labels(code='200').inc(2)
+        g = metrics.Gauge('skytpu_queue_depth2', 'Queue depth.',
+                          registry=reg)
+        g.set(3)
+        h = metrics.Histogram('skytpu_step_seconds', 'Step latency.',
+                              buckets=(0.1, 1.0), registry=reg)
+        h.observe(0.05)
+        h.observe(0.5)
+        assert reg.generate_text() == (
+            '# HELP skytpu_queue_depth2 Queue depth.\n'
+            '# TYPE skytpu_queue_depth2 gauge\n'
+            'skytpu_queue_depth2 3\n'
+            '# HELP skytpu_requests_total Total requests.\n'
+            '# TYPE skytpu_requests_total counter\n'
+            'skytpu_requests_total{code="200"} 2\n'
+            '# HELP skytpu_step_seconds Step latency.\n'
+            '# TYPE skytpu_step_seconds histogram\n'
+            'skytpu_step_seconds_bucket{le="0.1"} 1\n'
+            'skytpu_step_seconds_bucket{le="1"} 2\n'
+            'skytpu_step_seconds_bucket{le="+Inf"} 2\n'
+            'skytpu_step_seconds_sum 0.55\n'
+            'skytpu_step_seconds_count 2\n')
+
+    def test_label_values_escaped(self):
+        reg = metrics.Registry()
+        c = metrics.Counter('skytpu_esc_total', 'Esc.',
+                            labelnames=('path',), registry=reg)
+        c.labels(path='a"b\\c\nd').inc()
+        text = reg.generate_text()
+        assert r'path="a\"b\\c\nd"' in text
+
+
+class TestTracing:
+
+    def test_scope_binds_and_restores(self):
+        assert tracing.get_request_id() is None
+        with tracing.request_scope('req-1') as rid:
+            assert rid == 'req-1'
+            assert tracing.get_request_id() == 'req-1'
+            with tracing.request_scope() as inner:
+                assert tracing.get_request_id() == inner != 'req-1'
+            assert tracing.get_request_id() == 'req-1'
+        assert tracing.get_request_id() is None
+
+    def test_log_records_carry_rid(self):
+        """The sky_logging handler formats ` rid=<id>` inside a scope
+        and nothing outside one."""
+        formatter = logging.Formatter(sky_logging._FORMAT)  # noqa: SLF001
+        fltr = sky_logging.RequestIdFilter()
+
+        def fmt(msg):
+            record = logging.LogRecord('skypilot_tpu.t', logging.INFO,
+                                       'f.py', 1, msg, (), None)
+            assert fltr.filter(record)
+            return formatter.format(record)
+
+        with tracing.request_scope('req-log-1'):
+            assert 'rid=req-log-1' in fmt('inside')
+        assert 'rid=' not in fmt('outside')
+
+    def test_timeline_spans_carry_rid(self, tmp_path, monkeypatch):
+        trace = tmp_path / 'trace.json'
+        monkeypatch.setenv('SKYTPU_TIMELINE', str(trace))
+        monkeypatch.setattr(timeline, '_events', [])
+        with tracing.request_scope('req-span-1'):
+            with timeline.Event('traced', 'msg'):
+                pass
+        with timeline.Event('untraced'):
+            pass
+        data = json.load(open(timeline.save()))
+        by_name = {e['name']: e for e in data['traceEvents']}
+        assert by_name['traced']['args']['request_id'] == 'req-span-1'
+        assert by_name['traced']['args']['message'] == 'msg'
+        assert 'request_id' not in by_name['untraced'].get('args', {})
+
+
+def _parse_prom(text):
+    """{series{labels} -> float} from exposition text."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith('#'):
+            continue
+        key, _, value = line.rpartition(' ')
+        out[key] = float(value)
+    return out
+
+
+class TestInferenceServerMetrics:
+    """The acceptance path: /metrics on the inference server."""
+
+    def _drive(self, coro_fn, tmp_path, monkeypatch):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from skypilot_tpu import inference
+        from skypilot_tpu.inference import server as srv
+        from skypilot_tpu.models import llama
+
+        trace = tmp_path / 'trace.json'
+        monkeypatch.setenv('SKYTPU_TIMELINE', str(trace))
+        monkeypatch.setattr(timeline, '_events', [])
+        config = llama.CONFIGS['tiny']
+        params = llama.init_params(config, jax.random.key(0))
+        engine = inference.InferenceEngine(params, config,
+                                           batch_size=2, max_seq_len=64)
+        holder = {'loop': srv.EngineLoop(engine), 'tokenizer': None,
+                  'model_name': 'tiny'}
+
+        async def run():
+            client = TestClient(TestServer(srv.create_app(holder)))
+            await client.start_server()
+            try:
+                return await coro_fn(client)
+            finally:
+                await client.close()
+                holder['loop'].stop()
+
+        return asyncio.new_event_loop().run_until_complete(run())
+
+    def test_generation_moves_counters_and_correlates_rid(
+            self, tmp_path, monkeypatch):
+        log_lines = []
+
+        class Capture(logging.Handler):
+            def emit(self, record):
+                log_lines.append(self.format(record))
+
+        capture = Capture()
+        capture.setFormatter(logging.Formatter(
+            sky_logging._FORMAT))  # noqa: SLF001
+        capture.addFilter(sky_logging.RequestIdFilter())
+        root = logging.getLogger('skypilot_tpu')
+        root.addHandler(capture)
+
+        rid = 'test-rid-0123'
+        before = instruments.GENERATED_TOKENS.value()
+        _, _, steps_before = \
+            instruments.DECODE_STEP_SECONDS.child_snapshot()
+
+        async def go(client):
+            r = await client.post(
+                '/generate',
+                json={'prompt_tokens': [3, 5, 7],
+                      'max_new_tokens': 6, 'temperature': 0.0},
+                headers={'X-Request-ID': rid})
+            assert r.status == 200
+            doc = await r.json()
+            assert len(doc['tokens']) == 6
+            m = await client.get('/metrics')
+            assert m.status == 200
+            return await m.text()
+
+        try:
+            text = self._drive(go, tmp_path, monkeypatch)
+        finally:
+            root.removeHandler(capture)
+
+        # Valid Prometheus text with the acceptance series, and the
+        # counters MOVED for this generation.
+        series = _parse_prom(text)
+        assert series['skytpu_generated_tokens_total'] >= before + 6
+        assert instruments.GENERATED_TOKENS.value() >= before + 6
+        assert series['skytpu_prompt_tokens_total'] >= 3
+        assert 'skytpu_decode_step_seconds_bucket{le="+Inf"}' in series
+        _, _, steps_after = \
+            instruments.DECODE_STEP_SECONDS.child_snapshot()
+        assert steps_after > steps_before
+        assert 'skytpu_batch_occupancy' in series  # the gauge exposes
+        assert 'skytpu_kv_cache_utilization' in series
+        assert '# TYPE skytpu_decode_step_seconds histogram' in text
+
+        # Same request ID in the structured log line AND the timeline
+        # span args.
+        rid_lines = [ln for ln in log_lines if f'rid={rid}' in ln]
+        assert rid_lines, log_lines
+        assert any('generate' in ln for ln in rid_lines)
+        data = json.load(open(timeline.save()))
+        spans = [e for e in data['traceEvents']
+                 if e['name'] == 'inference.generate']
+        assert spans and spans[0]['args']['request_id'] == rid
+
+    def test_health_reports_engine_detail(self, tmp_path, monkeypatch):
+        async def go(client):
+            r = await client.get('/health')
+            assert r.status == 200
+            return await r.json()
+
+        doc = self._drive(go, tmp_path, monkeypatch)
+        engine = doc['engine']
+        assert set(engine) >= {'queue_depth', 'in_flight',
+                               'batch_occupancy',
+                               'kv_cache_utilization'}
+        assert engine['queue_depth'] == 0
+
+
+class TestApiServerMetrics:
+
+    def test_metrics_endpoint_and_heartbeat_series(self):
+        from skypilot_tpu import state
+        from skypilot_tpu.server import app as app_mod
+        from skypilot_tpu.server import requests_db
+
+        requests_db.reset_for_tests()
+        before = instruments.HEARTBEATS_RECEIVED.value(
+            cluster='hb-metrics')
+        with app_mod.ServerThread() as srv:
+            state.add_or_update_cluster(
+                'hb-metrics', handle=None,
+                requested_resources_str='local', num_nodes=1,
+                ready=True)
+            req = urllib.request.Request(
+                f'{srv.url}/api/v1/heartbeat',
+                data=json.dumps({'cluster_name': 'hb-metrics'}).encode(),
+                headers={'Content-Type': 'application/json'},
+                method='POST')
+            with urllib.request.urlopen(req, timeout=10):
+                pass
+            with urllib.request.urlopen(f'{srv.url}/metrics',
+                                        timeout=10) as resp:
+                assert resp.status == 200
+                text = resp.read().decode()
+        requests_db.reset_for_tests()
+        series = _parse_prom(text)
+        assert series[
+            'skytpu_heartbeats_received_total{cluster="hb-metrics"}'] \
+            == before + 1
+        assert series[
+            'skytpu_heartbeat_last_timestamp_seconds'
+            '{cluster="hb-metrics"}'] > 0
+        # The HTTP plane counters saw the heartbeat POST itself.
+        assert any(k.startswith('skytpu_http_requests_total{')
+                   and 'plane="api"' in k for k in series)
+
+
+class TestSkyletHeartbeatMetrics:
+
+    def test_sent_counter_tracks_outcome(self):
+        from skypilot_tpu.skylet import events
+
+        errs = instruments.HEARTBEATS_SENT.value(outcome='error')
+        assert not events.HeartbeatEvent._post(  # noqa: SLF001
+            'http://127.0.0.1:1/api/v1/heartbeat', {})
+        assert instruments.HEARTBEATS_SENT.value(outcome='error') == \
+            errs + 1
+
+
+class TestLoadBalancerMetrics:
+
+    def test_metrics_endpoint_and_no_replica_counter(self):
+        from skypilot_tpu.serve import load_balancer as lb_lib
+
+        before = instruments.LB_NO_REPLICA.value()
+        lb = lb_lib.LoadBalancer(port=0)
+        port = lb.start()
+        try:
+            url = f'http://127.0.0.1:{port}'
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(f'{url}/anything', timeout=10)
+            assert err.value.code == 503
+            with urllib.request.urlopen(f'{url}/metrics',
+                                        timeout=10) as resp:
+                text = resp.read().decode()
+        finally:
+            lb.stop()
+        series = _parse_prom(text)
+        assert series['skytpu_lb_no_replica_total'] == before + 1
+        assert '# TYPE skytpu_lb_replica_requests_total counter' in text
+
+
+class TestTrainLoopMetrics:
+
+    def test_fit_emits_step_tokens_mfu(self):
+        from skypilot_tpu.parallel import mesh as mesh_lib
+        from skypilot_tpu.train import loop as loop_lib
+        from skypilot_tpu.train import trainer as trainer_lib
+
+        tokens_before = instruments.TRAIN_TOKENS.value()
+        _, _, steps_before = \
+            instruments.TRAIN_STEP_SECONDS.child_snapshot()
+        mesh = mesh_lib.mesh_from_env(
+            mesh_lib.MeshSpec.from_dict({'fsdp': '-1'}))
+        cfg = trainer_lib.TrainerConfig(model='tiny', batch_size=8,
+                                        seq_len=16, warmup_steps=1,
+                                        learning_rate=1e-2, max_steps=2)
+        loop_lib.fit(cfg, mesh, log_every=1, log_fn=lambda *_: None)
+        assert instruments.TRAIN_TOKENS.value() == \
+            tokens_before + 2 * 8 * 16
+        _, _, steps_after = \
+            instruments.TRAIN_STEP_SECONDS.child_snapshot()
+        assert steps_after == steps_before + 2
+        assert instruments.TRAIN_STEP.value() == 2
+        assert instruments.TRAIN_LOSS.value() > 0

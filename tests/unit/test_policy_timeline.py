@@ -71,3 +71,31 @@ def test_timeline_disabled_is_free(monkeypatch):
         pass
     assert timeline._events == []
     assert timeline.save() is None
+
+
+def test_timeline_save_flushes_once(tmp_path, monkeypatch):
+    """An explicit save() followed by the atexit flush must not write
+    a second per-PID file duplicating every span: save() clears what
+    it wrote."""
+    trace = tmp_path / 'trace.json'
+    monkeypatch.setenv('SKYTPU_TIMELINE', str(trace))
+    monkeypatch.setattr(timeline, '_events', [])
+    with timeline.Event('one'):
+        pass
+    assert timeline.save() == str(trace)
+    # Nothing new since the flush: the (atexit) re-save is a no-op, not
+    # a duplicate <trace>.<pid>.json.
+    assert timeline.save() is None
+    assert timeline._events == []
+    # New spans after a flush land in a per-PID file containing ONLY
+    # the new spans.
+    with timeline.Event('two'):
+        pass
+    second = timeline.save()
+    assert second is not None and second != str(trace)
+    names = [e['name']
+             for e in json.load(open(second))['traceEvents']]
+    assert names == ['two']
+    first = [e['name']
+             for e in json.load(open(trace))['traceEvents']]
+    assert first == ['one']
