@@ -293,6 +293,16 @@ def add_openai_routes(app, holder: Dict[str, Any]) -> None:
             raise web.HTTPServiceUnavailable(
                 text=json.dumps({'error': 'model loading'}),
                 content_type='application/json')
+        # Lazy import: server.py imports this module inside
+        # create_app, so a module-level back-import would be cyclic.
+        from skypilot_tpu.inference import server as server_lib
+        limit = server_lib.shed_limit(holder)
+        if limit is not None:
+            raise web.HTTPServiceUnavailable(
+                headers={'Retry-After': '1'},
+                text=json.dumps(
+                    {'error': f'overloaded: queue depth >= {limit}'}),
+                content_type='application/json')
         return loop
 
     async def completions(request):

@@ -23,6 +23,7 @@ engine stays model-agnostic).
 import argparse
 import asyncio
 import json
+import os
 import queue
 import threading
 from typing import Any, Dict, List, Optional
@@ -163,6 +164,28 @@ class EngineLoop:
                 watcher.push(('done', tokens))
 
 
+def shed_limit(engine_holder: Dict[str, Any]) -> Optional[int]:
+    """Load shedding: the queue-depth limit, if the engine is at/over
+    it right now (else None). Beyond the limit a request would only
+    age in the queue past any client timeout — a fast 503 +
+    Retry-After lets the LB (or client) try another replica instead
+    of letting requests pile up. Limit source: holder
+    'max_queue_depth' (--max-queue-depth) or SKYTPU_MAX_QUEUE_DEPTH;
+    0/unset disables."""
+    limit = engine_holder.get('max_queue_depth')
+    if limit is None:
+        try:
+            limit = int(os.environ.get('SKYTPU_MAX_QUEUE_DEPTH', '0'))
+        except ValueError:
+            # A typo'd env var must never 500 every request; shedding
+            # just stays off.
+            limit = 0
+    if limit and obs.QUEUE_DEPTH.value() >= limit:
+        obs.REQUESTS_SHED.inc()
+        return int(limit)
+    return None
+
+
 def _parse_sampling(body: Dict[str, Any]):
     from skypilot_tpu import inference as inf
     return inf.SamplingParams(
@@ -198,6 +221,11 @@ def create_app(engine_holder: Dict[str, Any]):
         if engine_loop is None:
             return web.json_response({'error': 'model loading'},
                                      status=503)
+        limit = shed_limit(engine_holder)
+        if limit is not None:
+            return web.json_response(
+                {'error': f'overloaded: queue depth >= {limit}'},
+                status=503, headers={'Retry-After': '1'})
         try:
             body = await request.json()
             prompt = [int(t) for t in body['prompt_tokens']]
@@ -308,6 +336,11 @@ def main() -> None:
     parser.add_argument('--port', type=int, default=8080)
     parser.add_argument('--batch-size', type=int, default=8)
     parser.add_argument('--max-seq-len', type=int, default=None)
+    parser.add_argument('--max-queue-depth', type=int, default=None,
+                        help='Shed load (503 + Retry-After) once this '
+                             'many requests are queued ahead of the '
+                             'decode batch (default: env '
+                             'SKYTPU_MAX_QUEUE_DEPTH; 0 disables).')
     parser.add_argument('--checkpoint', default=None,
                         help='Orbax checkpoint dir with model params')
     parser.add_argument('--mesh', default=None,
@@ -360,7 +393,8 @@ def main() -> None:
 
     holder: Dict[str, Any] = {
         'loop': None, 'tokenizer': None,
-        'model_name': args.served_model_name or args.model}
+        'model_name': args.served_model_name or args.model,
+        'max_queue_depth': args.max_queue_depth}
 
     def _load():
         from skypilot_tpu import inference as inf

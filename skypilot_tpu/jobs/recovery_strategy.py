@@ -7,29 +7,51 @@ TPU-first: recovery ALWAYS terminates the old slice first — preempted
 TPU slices hold quota until deleted and cannot restart in place
 (reference clouds/gcp.py:1066) — then relaunches, either in the same
 placement first (FAILOVER) or immediately elsewhere (EAGER_NEXT_REGION).
+
+Relaunch attempts run under the shared resilience retry policy:
+exponential backoff with full jitter (a pod-scale preemption sends
+every recovering job at the same regional API at once) bounded by BOTH
+an attempt count and a total recovery deadline — time-to-give-up is
+what the operator actually cares about, not attempt arithmetic.
 """
 import os
 import time
-from typing import Optional
+from typing import Callable, Optional
 
 from skypilot_tpu import exceptions
+from skypilot_tpu.resilience import faults
+from skypilot_tpu.resilience import retries
 from skypilot_tpu.utils import registry
 
 STRATEGY_REGISTRY = registry.Registry('recovery strategy')
 DEFAULT_STRATEGY = 'EAGER_NEXT_REGION'
 
-_LAUNCH_RETRY_GAP_SECONDS = float(
-    os.environ.get('SKYTPU_JOBS_RETRY_GAP', '10'))
+
+def _retry_gap_seconds() -> float:
+    """Read at call time, never import time: controllers are spawned
+    and tests set SKYTPU_JOBS_RETRY_GAP after this module loads."""
+    return float(os.environ.get('SKYTPU_JOBS_RETRY_GAP', '10'))
+
+
+def _recovery_deadline_seconds() -> Optional[float]:
+    raw = os.environ.get('SKYTPU_JOBS_RECOVERY_DEADLINE', '')
+    return float(raw) if raw else None
 
 
 class StrategyExecutor:
     """Launch/recover one managed job's cluster."""
 
     def __init__(self, task, cluster_name: str,
-                 max_launch_retries: int = 3) -> None:
+                 max_launch_retries: int = 3,
+                 recovery_deadline_seconds: Optional[float] = None,
+                 sleep_fn: Callable[[float], None] = time.sleep,
+                 now_fn: Callable[[], float] = time.monotonic) -> None:
         self.task = task
         self.cluster_name = cluster_name
         self.max_launch_retries = max_launch_retries
+        self.recovery_deadline_seconds = recovery_deadline_seconds
+        self._sleep_fn = sleep_fn
+        self._now_fn = now_fn
 
     # -- hooks ---------------------------------------------------------------
 
@@ -52,6 +74,8 @@ class StrategyExecutor:
 
     def _launch_once(self, blocked=None) -> int:
         from skypilot_tpu import execution
+        faults.inject('provision.launch',
+                      env_exc=exceptions.ResourcesUnavailableError)
         job_id, _ = execution.launch(
             self.task, cluster_name=self.cluster_name,
             stream_logs=True, detach_run=True,
@@ -59,21 +83,49 @@ class StrategyExecutor:
         assert job_id is not None
         return job_id
 
+    def _retry_policy(self) -> retries.RetryPolicy:
+        gap = _retry_gap_seconds()
+        deadline = self.recovery_deadline_seconds
+        if deadline is None:
+            deadline = _recovery_deadline_seconds()
+        return retries.RetryPolicy(
+            max_attempts=self.max_launch_retries,
+            base_delay=gap, max_delay=max(gap * 8, gap),
+            deadline=deadline)
+
     def _launch_with_retries(self, blocked=None) -> int:
-        last_exc: Optional[Exception] = None
-        for attempt in range(self.max_launch_retries):
-            try:
-                return self._launch_once(blocked if attempt == 0 else None)
-            except exceptions.ResourcesUnavailableError as e:
-                last_exc = e
-                time.sleep(_LAUNCH_RETRY_GAP_SECONDS * (attempt + 1))
-            except exceptions.CommandError as e:
-                last_exc = e
+        attempt_no = {'n': 0}
+
+        def _once() -> int:
+            i = attempt_no['n']
+            attempt_no['n'] += 1
+            return self._launch_once(blocked if i == 0 else None)
+
+        def _on_retry(exc: BaseException, attempt: int) -> None:
+            # A failed command leaves a half-set-up cluster behind;
+            # tear it down before the relaunch. Capacity errors leave
+            # nothing (the launch failed before create).
+            if isinstance(exc, exceptions.CommandError):
                 self._terminate_cluster()
-                time.sleep(_LAUNCH_RETRY_GAP_SECONDS)
-        raise exceptions.ManagedJobReachedMaxRetriesError(
-            f'Failed to (re)launch {self.cluster_name!r} after '
-            f'{self.max_launch_retries} attempts: {last_exc}')
+
+        try:
+            return retries.call(
+                _once, policy=self._retry_policy(),
+                retry_on=(exceptions.ResourcesUnavailableError,
+                          exceptions.CommandError),
+                on_retry=_on_retry,
+                describe=f'launch {self.cluster_name!r}',
+                sleep_fn=self._sleep_fn, now_fn=self._now_fn)
+        except (exceptions.ResourcesUnavailableError,
+                exceptions.CommandError) as e:
+            if isinstance(e, exceptions.CommandError):
+                # on_retry only fires BETWEEN attempts: a final
+                # failed command still leaves a half-set-up,
+                # quota-holding cluster to tear down.
+                self._terminate_cluster()
+            raise exceptions.ManagedJobReachedMaxRetriesError(
+                f'Failed to (re)launch {self.cluster_name!r} after '
+                f'{attempt_no["n"]} attempt(s): {e}') from e
 
     @classmethod
     def make(cls, strategy: str, task, cluster_name: str

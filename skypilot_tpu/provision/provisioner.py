@@ -12,12 +12,14 @@ import json
 import os
 import shlex
 import sys
-import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from skypilot_tpu import exceptions
 from skypilot_tpu import provision
 from skypilot_tpu.provision import common
+from skypilot_tpu.resilience import faults
+# Aliased: setup_runtime_dependencies has a `retries` parameter.
+from skypilot_tpu.resilience import retries as retries_lib
 from skypilot_tpu.skylet import constants as skylet_constants
 from skypilot_tpu.utils import command_runner as runner_lib
 
@@ -28,6 +30,7 @@ def bulk_provision(provider_name: str, region: str, zone: Optional[str],
                    cluster_name_on_cloud: str,
                    config: common.ProvisionConfig
                    ) -> common.ProvisionRecord:
+    faults.inject('provision.launch', env_exc=exceptions.ProvisionError)
     record = provision.run_instances(provider_name, region,
                                      cluster_name_on_cloud, config)
     provision.wait_instances(provider_name, region, cluster_name_on_cloud,
@@ -68,17 +71,30 @@ def _parallel_over_hosts(fn: Callable, runners: List,
 def wait_for_connection(runners: List[runner_lib.CommandRunner],
                         timeout: float = 600.0) -> None:
     """Block until every host answers a trivial command (reference
-    wait_for_ssh :365); hosts are polled in parallel."""
-    deadline = time.time() + timeout
+    wait_for_ssh :365); hosts are polled in parallel. Fixed-interval
+    poll (no jitter: one host hammers nobody) under the shared retry
+    policy's deadline budget. The deadline is WALL-CLOCK from entry,
+    shared by all hosts: queued hosts (pool capped at 32) must not
+    each restart the budget."""
+    import time
+    deadline_ts = time.monotonic() + timeout
 
     def _wait_one(runner):
-        while True:
-            if runner.check_connection():
-                return
-            if time.time() > deadline:
+        remaining = deadline_ts - time.monotonic()
+        if remaining <= 0:
+            raise exceptions.ClusterSetUpError(
+                f'unreachable after {timeout:.0f}s')
+        policy = retries_lib.RetryPolicy(
+            max_attempts=None, base_delay=5.0, max_delay=5.0,
+            deadline=remaining, exponential=False, jitter=False)
+
+        def _check() -> None:
+            if not runner.check_connection():
                 raise exceptions.ClusterSetUpError(
                     f'unreachable after {timeout:.0f}s')
-            time.sleep(5)
+        retries_lib.call(_check, policy=policy,
+                         retry_on=(exceptions.ClusterSetUpError,),
+                         describe=f'connection wait ({runner.node_id})')
 
     _parallel_over_hosts(_wait_one, runners, 'connection wait')
 
@@ -216,20 +232,23 @@ def setup_runtime_dependencies(
         retry_gap: float = _SETUP_RETRY_GAP_SECONDS) -> None:
     """Probe + install the host runtime with retries: first boots race
     cloud-init/apt locks, so one failed install must not fail the whole
-    provision."""
+    provision. Full-jitter backoff de-synchronizes a pod's worth of
+    hosts all racing the same first-boot apt lock."""
+    policy = retries_lib.RetryPolicy(
+        max_attempts=retries, base_delay=retry_gap,
+        max_delay=retry_gap * 4)
+
     def _setup_one(runner):
-        last = ''
-        for attempt in range(retries):
+        def _probe_install() -> None:
             rc, out, err = runner.run(
                 f'{_RUNTIME_PROBE} && ({_RUNTIME_INSTALL})',
                 require_outputs=True)
-            if rc == 0:
-                return
-            last = err or out
-            if attempt < retries - 1:
-                time.sleep(retry_gap)
-        raise exceptions.ClusterSetUpError(
-            f'after {retries} attempts: {last}')
+            if rc != 0:
+                raise exceptions.ClusterSetUpError(
+                    f'runtime setup failed: {err or out}')
+        retries_lib.call(_probe_install, policy=policy,
+                         retry_on=(exceptions.ClusterSetUpError,),
+                         describe=f'runtime setup ({runner.node_id})')
 
     _parallel_over_hosts(_setup_one, runners, 'runtime setup')
 

@@ -1,0 +1,20 @@
+"""Resilience subsystem: retries, circuit breakers, fault injection.
+
+TPU capacity is the most preemption-prone in the fleet, so recovery is
+the product, not an edge case. This package is the single place the
+stack's failure handling lives:
+
+- `retries`: one retry policy (exponential backoff, full jitter,
+  per-attempt timeout, overall deadline budget) replacing ad-hoc
+  sleep loops in the recovery, provision, and serve planes.
+- `circuit`: thread-safe circuit breakers keyed by target (replica
+  endpoints, probe URLs), exported as `skytpu_circuit_*` series.
+- `faults`: a deterministic fault-injection registry — named fault
+  points that tests arm with fail-N-times / latency / fail-forever
+  behaviors, so chaos scenarios run as ordinary tier-1 unit tests.
+"""
+from skypilot_tpu.resilience import circuit
+from skypilot_tpu.resilience import faults
+from skypilot_tpu.resilience import retries
+
+__all__ = ['circuit', 'faults', 'retries']

@@ -1,0 +1,235 @@
+"""Deterministic fault injection: named points the stack checks inline.
+
+Chaos testing without a chaos fleet: hot paths call
+`faults.inject('<point>')` at the moments that fail in production
+(cloud launch, readiness probe, upstream proxy hop, checkpoint write,
+heartbeat receipt). Unarmed, an inject is a dict lookup — effectively
+free. Armed (by a test, or by the SKYTPU_FAULTS env var on a live
+process), it raises a configured exception and/or adds latency for a
+bounded number of hits, so failure-handling paths run as ordinary,
+deterministic tier-1 unit tests.
+
+The point catalog below is the single source of truth:
+tests/unit/test_fault_points_lint.py asserts every name matches the
+naming regex, is unique, and is documented in
+docs/guides/resilience.md — injection points stay discoverable as
+they spread.
+
+Arming from a test:
+
+    faults.arm('lb.upstream', times=1, exc=OSError('injected'))
+    ...
+    faults.reset()   # in teardown
+
+Arming from the environment (read at inject time, so a late export
+still takes effect — no import-order trap):
+
+    SKYTPU_FAULTS='checkpoint.save:2,probe.http:forever'
+
+Env grammar: comma-separated `point[:times[:latency_seconds]]` where
+times is an int or `forever`. Env-armed faults raise FaultInjected.
+"""
+import os
+import re
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.observability import instruments as obs
+
+logger = sky_logging.init_logger(__name__)
+
+POINT_RE = re.compile(r'^[a-z][a-z0-9_]*(\.[a-z][a-z0-9_]*)+$')
+
+
+class FaultInjected(Exception):
+    """Default exception an armed fault raises (env-armed faults
+    always raise this; tests usually arm the exception type the call
+    site actually handles, e.g. OSError for the LB upstream hop)."""
+
+
+# -- the fault-point catalog ----------------------------------------------
+# Declared centrally (like observability/instruments.py) so the lint
+# and the docs cover the whole namespace by importing one module.
+
+_POINTS: Dict[str, str] = {}
+
+
+def declare(name: str, description: str) -> str:
+    if not POINT_RE.fullmatch(name):
+        raise ValueError(
+            f'fault point {name!r} must match {POINT_RE.pattern} '
+            '(plane.operation, lowercase)')
+    if name in _POINTS:
+        raise ValueError(f'duplicate fault point {name!r}')
+    if not description or len(description.strip()) < 10:
+        raise ValueError(f'fault point {name!r} needs a description')
+    _POINTS[name] = description
+    return name
+
+
+PROVISION_LAUNCH = declare(
+    'provision.launch',
+    'Launching a cluster/replica through the provision plane (cloud '
+    'API create + execution.launch call sites).')
+PROBE_HTTP = declare(
+    'probe.http',
+    'One readiness-probe HTTP round against a replica endpoint.')
+LB_UPSTREAM = declare(
+    'lb.upstream',
+    'The load balancer contacting one upstream replica for a proxied '
+    'request (fires before any response bytes are written).')
+CHECKPOINT_SAVE = declare(
+    'checkpoint.save',
+    'Writing one training checkpoint (orbax save + completeness '
+    'sentinel).')
+HEARTBEAT_RECV = declare(
+    'heartbeat.recv',
+    'The API server accepting one skylet liveness heartbeat.')
+
+
+def registered_points() -> Dict[str, str]:
+    return dict(_POINTS)
+
+
+# -- arming ----------------------------------------------------------------
+
+# Default-exception sentinel: distinct from None (None = latency-only
+# fault). A fresh FaultInjected is constructed per firing — a shared
+# instance raised concurrently would race on __traceback__.
+_DEFAULT_EXC = object()
+
+
+class _Arm:
+    __slots__ = ('times', 'exc', 'latency', 'hits', 'from_env')
+
+    def __init__(self, times: Optional[int], exc,
+                 latency: float, from_env: bool = False):
+        self.times = times          # None = forever
+        self.exc = exc              # None = latency-only fault
+        self.latency = latency
+        self.hits = 0
+        # Env-armed faults carry no exception type of their own: the
+        # call site supplies one via inject(env_exc=...) so the
+        # failure looks like the real thing to its handlers.
+        self.from_env = from_env
+
+
+_lock = threading.Lock()
+_armed: Dict[str, _Arm] = {}
+_env_cache_raw: Optional[str] = None
+
+
+def arm(point: str, times: Optional[int] = 1,
+        exc=_DEFAULT_EXC,
+        latency: float = 0.0) -> None:
+    """Arm `point` to fail the next `times` injections (None=forever)
+    with `exc` (None = add latency only), after `latency` seconds."""
+    if point not in _POINTS:
+        raise ValueError(f'unknown fault point {point!r}; declared: '
+                         f'{sorted(_POINTS)}')
+    if times is not None and times < 1:
+        raise ValueError('times must be >= 1 or None (forever)')
+    with _lock:
+        _armed[point] = _Arm(times, exc, latency)
+
+
+def disarm(point: str) -> None:
+    with _lock:
+        _armed.pop(point, None)
+
+
+def reset() -> None:
+    """Disarm everything (test teardown)."""
+    global _env_cache_raw
+    with _lock:
+        _armed.clear()
+        _env_cache_raw = None
+
+
+def hits(point: str) -> int:
+    """How many times `point` actually fired (test assertions)."""
+    with _lock:
+        a = _armed.get(point)
+        return a.hits if a is not None else 0
+
+
+def _load_env_locked() -> None:
+    """Re-parse SKYTPU_FAULTS whenever its raw value changes: read at
+    inject time, never cached at import (the import-time-env trap that
+    bit SKYTPU_JOBS_RETRY_GAP)."""
+    global _env_cache_raw
+    raw = os.environ.get('SKYTPU_FAULTS', '')
+    if raw == _env_cache_raw:
+        return
+    _env_cache_raw = raw
+    # The env var is authoritative for env-armed points: a changed or
+    # unset value must DISARM what it no longer lists (a chaos drill
+    # must end when the operator unsets the variable).
+    for point in [p for p, a in _armed.items() if a.from_env]:
+        del _armed[point]
+    for spec in filter(None, (s.strip() for s in raw.split(','))):
+        parts = spec.split(':')
+        point = parts[0]
+        if point not in _POINTS:
+            logger.warning('SKYTPU_FAULTS: unknown point %r ignored',
+                           point)
+            continue
+        try:
+            times: Optional[int] = 1
+            if len(parts) > 1:
+                times = (None if parts[1] == 'forever'
+                         else int(parts[1]))
+            latency = float(parts[2]) if len(parts) > 2 else 0.0
+        except ValueError:
+            # A typo'd env var must never take down the hot path it
+            # was meant to test.
+            logger.warning('SKYTPU_FAULTS: malformed spec %r ignored',
+                           spec)
+            continue
+        existing = _armed.get(point)
+        if existing is not None and not existing.from_env:
+            # arm() (a test's explicit choice) outranks the env.
+            continue
+        _armed[point] = _Arm(times, _DEFAULT_EXC, latency,
+                             from_env=True)
+
+
+def inject(point: str,
+           sleep_fn: Callable[[float], None] = time.sleep,
+           env_exc: Optional[type] = None) -> None:
+    """The hot-path hook: no-op unless `point` is armed.
+
+    `env_exc` is the exception type an ENV-armed fault raises at this
+    call site — the type the surrounding handlers treat as a real
+    failure (e.g. OSError on the LB upstream hop), so chaos armed via
+    SKYTPU_FAULTS exercises the recovery path instead of crashing it.
+    Code-armed faults always raise exactly what the test supplied.
+    """
+    with _lock:
+        _load_env_locked()
+        a = _armed.get(point)
+        if a is None:
+            return
+        if a.times is not None and a.hits >= a.times:
+            return
+        a.hits += 1
+        latency, exc = a.latency, a.exc
+        if exc is _DEFAULT_EXC:
+            exc_type = (env_exc if (a.from_env and env_exc is not None)
+                        else FaultInjected)
+            exc = exc_type(f'injected fault at {point}')
+    obs.FAULTS_INJECTED.labels(point=point).inc()
+    logger.warning('fault injected at %s (latency=%.2fs, exc=%r)',
+                   point, latency, exc)
+    if latency > 0:
+        sleep_fn(latency)
+    if exc is not None:
+        raise exc
+
+
+def armed_points() -> List[str]:
+    with _lock:
+        _load_env_locked()
+        return sorted(_armed)

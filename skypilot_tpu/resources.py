@@ -326,16 +326,31 @@ class Resources:
             'use_spot', 'disk_size', 'disk_tier', 'ports', 'image_id',
             'labels', 'autostop', 'job_recovery', 'any_of',
         }
-        # Back-compat sugar: cloud/region/zone keys fold into infra.
+        # Back-compat sugar: cloud/region/zone keys fold into infra,
+        # inheriting whatever pieces an existing infra string already
+        # pins — `copy(zone=...)` on a task with `infra: gcp/region`
+        # (the spot placer steering a replica) must keep the region.
         if any(k in config for k in ('cloud', 'region', 'zone')):
+            existing = infra_utils.InfraInfo.from_str(
+                config.pop('infra', None))
+            cloud = config.pop('cloud', None)
+            region = config.pop('region', None)
+            zone = config.pop('zone', None)
+            # Overriding a coarser field invalidates the finer ones
+            # it used to scope: copy(region=...) must not keep the
+            # old region's zone.
+            if cloud:
+                existing.region = existing.zone = None
+            if region:
+                existing.zone = None
             info = infra_utils.InfraInfo(
-                cloud=config.pop('cloud', None),
-                region=config.pop('region', None),
-                zone=config.pop('zone', None))
+                cloud=cloud or existing.cloud,
+                region=region or existing.region,
+                zone=zone or existing.zone)
             if info.zone and not info.region:
                 raise exceptions.InvalidResourcesError(
                     'zone requires region to be set')
-            config.setdefault('infra', info.to_str() or None)
+            config['infra'] = info.to_str() or None
         unknown = set(config) - known
         if unknown:
             raise exceptions.InvalidResourcesError(

@@ -13,6 +13,8 @@ from typing import List, Optional
 
 from skypilot_tpu.observability import instruments as obs
 from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.resilience import circuit
+from skypilot_tpu.resilience import faults
 from skypilot_tpu.serve import load_balancing_policies as lb_policies
 
 _QPS_WINDOW_SECONDS = 60.0
@@ -41,12 +43,29 @@ class LoadBalancer:
         self.policy = lb_policies.make_policy(policy_name)
         self.port = port
         self.tracker = RequestRateTracker()
+        # Replica endpoints that keep failing at the transport layer
+        # get routed around instead of 502ing live traffic.
+        self.breaker = circuit.CircuitBreaker(
+            'lb', failure_threshold=3, recovery_timeout=15.0)
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._runner = None
         self._thread: Optional[threading.Thread] = None
 
     def set_replicas(self, urls: List[str]) -> None:
+        old = set(self.policy.replicas) - set(urls)
         self.policy.set_replicas(urls)
+        for gone in old:
+            self.breaker.forget(gone)
+
+    def _candidates(self) -> List[str]:
+        """Upstream try-order: the policy's pick first, then every
+        other replica — a failed upstream must not 502 the client
+        while healthy replicas exist."""
+        first = self.policy.select()
+        if first is None:
+            return []
+        rest = [r for r in self.policy.replicas if r != first]
+        return [first] + rest
 
     # -- aiohttp handlers ----------------------------------------------------
 
@@ -59,59 +78,98 @@ class LoadBalancer:
 
     async def _handle_proxy(self, request):
         from aiohttp import ClientSession, ClientTimeout, web
+        import aiohttp
         self.tracker.record()
-        target = self.policy.select()
-        if target is None:
+        candidates = self._candidates()
+        if not candidates:
             obs.LB_NO_REPLICA.inc()
             return web.Response(
-                status=503,
+                status=503, headers={'Retry-After': '1'},
                 text='No ready replicas. Retry shortly.\n')
-        obs.LB_REPLICA_REQUESTS.labels(replica=target).inc()
-        url = target.rstrip('/') + '/' + request.match_info['tail']
-        if request.query_string:
-            url += f'?{request.query_string}'
-        import aiohttp
         body = await request.read()
-        self.policy.on_request_start(target)
-        response = None
-        try:
-            async with ClientSession(
-                    timeout=ClientTimeout(total=3600)) as session:
-                async with session.request(
+        tail = request.match_info['tail']
+        last_error: Optional[BaseException] = None
+        attempted = 0
+        for target in candidates:
+            if not self.breaker.allow(target):
+                continue
+            attempted += 1
+            if attempted > 1:
+                obs.LB_UPSTREAM_RETRIES.inc()
+            obs.LB_REPLICA_REQUESTS.labels(replica=target).inc()
+            url = target.rstrip('/') + '/' + tail
+            if request.query_string:
+                url += f'?{request.query_string}'
+            self.policy.on_request_start(target)
+            session = upstream = None
+            try:
+                # Phase 1 — contact the upstream. Failures here are
+                # the REPLICA's: feed the breaker, fail over.
+                try:
+                    faults.inject('lb.upstream', env_exc=OSError)
+                    session = ClientSession(
+                        timeout=ClientTimeout(total=3600))
+                    upstream = await session.request(
                         request.method, url, data=body,
-                        headers={k: v for k, v in request.headers.items()
-                                 if k.lower() not in ('host',
-                                                      'content-length')},
-                        allow_redirects=False) as upstream:
-                    # Stream the upstream body chunk-by-chunk: LLM
-                    # serving fronts SSE/chunked token streams, which
-                    # must flow as generated, not after completion.
-                    response = web.StreamResponse(
-                        status=upstream.status,
                         headers={k: v
-                                 for k, v in upstream.headers.items()
+                                 for k, v in request.headers.items()
                                  if k.lower() not in (
-                                     'transfer-encoding',
-                                     'content-length',
-                                     'connection')})
+                                     'host', 'content-length')},
+                        allow_redirects=False)
+                except (OSError, aiohttp.ClientError) as e:
+                    obs.LB_PROXY_ERRORS.inc()
+                    self.breaker.record_failure(target)
+                    last_error = e
+                    # Nothing written: fail over to the next replica.
+                    continue
+                # The replica answered: success for breaker purposes.
+                # Errors past this point interleave upstream reads
+                # with CLIENT-socket writes — blaming the replica
+                # here would let one dead client open circuits on
+                # healthy replicas.
+                self.breaker.record_success(target)
+                # Stream the upstream body chunk-by-chunk: LLM
+                # serving fronts SSE/chunked token streams, which
+                # must flow as generated, not after completion.
+                response = web.StreamResponse(
+                    status=upstream.status,
+                    headers={k: v
+                             for k, v in upstream.headers.items()
+                             if k.lower() not in (
+                                 'transfer-encoding',
+                                 'content-length',
+                                 'connection')})
+                try:
                     await response.prepare(request)
-                    async for chunk in upstream.content.iter_chunked(
-                            64 * 1024):
+                    async for chunk in \
+                            upstream.content.iter_chunked(64 * 1024):
                         await response.write(chunk)
                     await response.write_eof()
                     return response
-        except (OSError, aiohttp.ClientError) as e:
-            obs.LB_PROXY_ERRORS.inc()
-            if response is None or not response.prepared:
-                return web.Response(status=502,
-                                    text=f'Upstream error: {e}\n')
-            # Headers (and possibly bytes) already went out: the only
-            # honest signal left is truncating the stream.
-            with contextlib.suppress(Exception):
-                await response.write_eof()
-            return response
-        finally:
-            self.policy.on_request_end(target)
+                except (OSError, aiohttp.ClientError):
+                    obs.LB_PROXY_ERRORS.inc()
+                    # Headers (and possibly bytes) may already be
+                    # out: a retry would corrupt the stream — the
+                    # only honest signal left is truncating it.
+                    with contextlib.suppress(Exception):
+                        await response.write_eof()
+                    return response
+            finally:
+                self.policy.on_request_end(target)
+                if upstream is not None:
+                    upstream.close()
+                if session is not None:
+                    await session.close()
+        if last_error is None:
+            # Candidates existed but every circuit was open.
+            obs.LB_NO_REPLICA.inc()
+            return web.Response(
+                status=503, headers={'Retry-After': '1'},
+                text='All replicas are circuit-open. Retry shortly.\n')
+        return web.Response(
+            status=502,
+            text=f'All {attempted} upstream(s) failed; last error: '
+                 f'{last_error}\n')
 
     def _create_app(self):
         from aiohttp import web

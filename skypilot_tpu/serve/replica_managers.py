@@ -6,19 +6,32 @@ through the normal stack (optimizer -> provision -> gang run), so TPU
 replicas get slice semantics (preempted -> terminate+relaunch) for free.
 """
 import logging
+import os
 import threading
 import time
 import urllib.error
 import urllib.request
-from typing import Dict, List, Optional
+from typing import Dict, List, NamedTuple, Optional
 
 from skypilot_tpu import exceptions
+from skypilot_tpu.resilience import circuit
+from skypilot_tpu.resilience import faults
+from skypilot_tpu.resilience import retries
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve import service_spec as spec_lib
 
 logger = logging.getLogger(__name__)
 
 _MAX_CONSECUTIVE_FAILURES = 3
+
+
+class ProbeResult(NamedTuple):
+    """One probe outcome, with the failure mode preserved — refused
+    (app not listening yet) vs timeout (wedged) vs HTTP 5xx (up but
+    erroring) drive different operator diagnoses, so they must not
+    collapse into one boolean at the source."""
+    ok: bool
+    detail: str
 
 
 def replica_cluster_name(service_name: str, replica_id: int) -> str:
@@ -36,6 +49,18 @@ class ReplicaManager:
         if spec.use_spot and spec.spot_zones:
             from skypilot_tpu.serve import spot_placer as placer_lib
             self.spot_placer = placer_lib.SpotPlacer(list(spec.spot_zones))
+        # A flapping endpoint must not eat a full probe timeout every
+        # round: past the failure threshold its circuit opens and
+        # probes short-circuit until the recovery window passes. The
+        # threshold sits BELOW the replacement threshold so the final
+        # pre-replacement round fast-fails instead of burning another
+        # full probe timeout (equal thresholds would open the circuit
+        # on the same round that forgets the endpoint).
+        self._probe_breaker = circuit.CircuitBreaker(
+            'probe',
+            failure_threshold=max(1, _MAX_CONSECUTIVE_FAILURES - 1),
+            recovery_timeout=float(
+                os.environ.get('SKYTPU_PROBE_BREAKER_RECOVERY', '30')))
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -77,9 +102,27 @@ class ReplicaManager:
                         use_spot: bool, zone: Optional[str]) -> None:
         try:
             from skypilot_tpu import execution
-            execution.launch(self._replica_task(use_spot, zone),
-                             cluster_name=cluster,
-                             stream_logs=False, detach_run=True)
+
+            def _launch_once() -> None:
+                faults.inject(
+                    'provision.launch',
+                    env_exc=exceptions.ResourcesUnavailableError)
+                execution.launch(self._replica_task(use_spot, zone),
+                                 cluster_name=cluster,
+                                 stream_logs=False, detach_run=True)
+
+            # Transient capacity/setup errors retry under the shared
+            # policy; anything else fails the replica immediately.
+            gap = float(os.environ.get('SKYTPU_SERVE_LAUNCH_RETRY_GAP',
+                                       '10'))
+            retries.call(
+                _launch_once,
+                policy=retries.RetryPolicy(max_attempts=3,
+                                           base_delay=gap,
+                                           max_delay=gap * 8),
+                retry_on=(exceptions.ResourcesUnavailableError,
+                          exceptions.ClusterSetUpError),
+                describe=f'launch replica {replica_id}')
             serve_state.set_replica_status(
                 self.service_name, replica_id,
                 serve_state.ReplicaStatus.STARTING,
@@ -118,7 +161,13 @@ class ReplicaManager:
 
     def scale_down(self, replica_ids: List[int]) -> None:
         from skypilot_tpu import core
+        by_id = {r['replica_id']: r
+                 for r in serve_state.get_replicas(self.service_name)}
         for replica_id in replica_ids:
+            gone = by_id.get(replica_id)
+            if gone is not None and gone.get('endpoint'):
+                # Dead endpoints must not linger as open circuits.
+                self._probe_breaker.forget(gone['endpoint'])
             serve_state.set_replica_status(
                 self.service_name, replica_id,
                 serve_state.ReplicaStatus.SHUTTING_DOWN)
@@ -136,12 +185,24 @@ class ReplicaManager:
 
     # -- probing -------------------------------------------------------------
 
-    def _probe_replica(self, replica: Dict) -> bool:
+    def _probe_replica(self, replica: Dict) -> ProbeResult:
         endpoint = replica['endpoint']
         if not endpoint:
-            return False
+            return ProbeResult(False, 'no_endpoint')
         url = endpoint.rstrip('/') + self.spec.readiness_probe.path
+        # STARTING replicas bypass the breaker: refusals while the app
+        # boots are EXPECTED, and an open circuit here would suppress
+        # the very probe that detects the app coming up — the replica
+        # would blow its grace window unprobed and crash-loop.
+        starting = (replica.get('status') ==
+                    serve_state.ReplicaStatus.STARTING)
+        if not starting and not self._probe_breaker.allow(endpoint):
+            # Open circuit: fail fast instead of burning a full probe
+            # timeout on an endpoint that just failed repeatedly.
+            return ProbeResult(False, 'circuit_open')
+        detail = 'error'
         try:
+            faults.inject('probe.http', env_exc=ConnectionRefusedError)
             req = urllib.request.Request(url)
             post = self.spec.readiness_probe.post_data
             if post is not None:
@@ -152,9 +213,32 @@ class ReplicaManager:
             with urllib.request.urlopen(
                     req,
                     timeout=self.spec.readiness_probe.timeout_seconds):
-                return True
-        except (urllib.error.URLError, OSError, ValueError):
-            return False
+                self._probe_breaker.record_success(endpoint)
+                return ProbeResult(True, 'ok')
+        except urllib.error.HTTPError as e:
+            detail = f'http_{e.code}'
+        except urllib.error.URLError as e:
+            detail = self._classify_probe_error(e.reason)
+        except (TimeoutError, OSError, ValueError) as e:
+            detail = self._classify_probe_error(e)
+        except faults.FaultInjected:
+            detail = 'injected'
+        if not starting:
+            # Boot-time refusals are expected and must not raise the
+            # circuit-open alarm on every normal scale-up.
+            self._probe_breaker.record_failure(endpoint)
+        logger.debug('Probe of replica %s failed: %s (%s)',
+                     replica['replica_id'], detail, url)
+        return ProbeResult(False, detail)
+
+    @staticmethod
+    def _classify_probe_error(reason) -> str:
+        if isinstance(reason, ConnectionRefusedError):
+            return 'refused'
+        if isinstance(reason, (TimeoutError, )) or \
+                'timed out' in str(reason):
+            return 'timeout'
+        return f'error:{type(reason).__name__}'
 
     def _cluster_lost(self, replica: Dict) -> bool:
         from skypilot_tpu import state as state_lib
@@ -189,7 +273,8 @@ class ReplicaManager:
                         self.service_name, replica['replica_id'],
                         status, endpoint=endpoint)
                     replica = dict(replica, endpoint=endpoint)
-            if self._probe_replica(replica):
+            probe = self._probe_replica(replica)
+            if probe.ok:
                 serve_state.clear_replica_failures(
                     self.service_name, replica['replica_id'])
                 if status != serve_state.ReplicaStatus.READY:
@@ -201,6 +286,9 @@ class ReplicaManager:
             else:
                 failures = serve_state.bump_replica_failures(
                     self.service_name, replica['replica_id'])
+                logger.info('Replica %s probe failed (%s), %d '
+                            'consecutive', replica['replica_id'],
+                            probe.detail, failures)
                 if status == serve_state.ReplicaStatus.READY:
                     serve_state.set_replica_status(
                         self.service_name, replica['replica_id'],
@@ -209,7 +297,20 @@ class ReplicaManager:
                     # Probe failures during startup are expected until
                     # initial_delay_seconds; past it, the app is deemed
                     # crashed and the replica is replaced.
-                    age = time.time() - (replica['launched_at'] or 0)
+                    launched_at = replica['launched_at']
+                    if launched_at is None:
+                        # A None launched_at must not compute an age
+                        # of ~Unix-epoch and instantly blow the grace
+                        # window: grant the full window from now.
+                        launched_at = time.time()
+                        logger.warning(
+                            'Replica %s is STARTING with no '
+                            'launched_at; granting grace from now',
+                            replica['replica_id'])
+                        serve_state.set_replica_launched_at(
+                            self.service_name, replica['replica_id'],
+                            launched_at)
+                    age = time.time() - launched_at
                     if age > self.spec.readiness_probe. \
                             initial_delay_seconds:
                         self.scale_down([replica['replica_id']])

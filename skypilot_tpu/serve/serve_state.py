@@ -234,6 +234,18 @@ def set_replica_status(service_name: str, replica_id: int,
         conn.commit()
 
 
+def set_replica_launched_at(service_name: str, replica_id: int,
+                            launched_at: float) -> None:
+    """Repair a missing launch timestamp (probe grace-window anchor)."""
+    conn = _get_conn()
+    with _lock:
+        conn.execute(
+            'UPDATE replicas SET launched_at=? '
+            'WHERE service_name=? AND replica_id=?',
+            (launched_at, service_name, replica_id))
+        conn.commit()
+
+
 def bump_replica_failures(service_name: str, replica_id: int) -> int:
     conn = _get_conn()
     with _lock:

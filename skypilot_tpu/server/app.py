@@ -523,6 +523,11 @@ async def _handle_heartbeat(request):
     tokens — so the handler only timestamps clusters the server
     already knows about and caps the payload."""
     from aiohttp import web
+    from skypilot_tpu.resilience import faults
+    # Chaos hook: a dropped heartbeat must look exactly like a network
+    # loss to the sending skylet (which retries) and leave staleness
+    # gauges untouched.
+    faults.inject('heartbeat.recv')
     # Read to EOF or just past the cap (a single .read(n) may return a
     # partial body when it spans several network reads).
     chunks = []

@@ -10,9 +10,21 @@ recovery after preemption resume training instead of restarting.
 from __future__ import annotations
 
 import os
-from typing import Any, Dict, Optional
+import threading
+from typing import Any, Dict, List, Optional
 
 import jax
+
+from skypilot_tpu.resilience import faults
+
+# Completeness sentinel: written only AFTER orbax's async write fully
+# flushed. latest_step requires it, so a host killed mid-save can
+# never be resumed from a torn checkpoint — the orbax tmp marker alone
+# does not cover the window between array commit and metadata flush.
+COMPLETE_SENTINEL = '.skytpu-complete'
+
+_pending_lock = threading.Lock()
+_pending: List[threading.Thread] = []
 
 
 def _checkpointer():
@@ -20,30 +32,70 @@ def _checkpointer():
     return ocp.StandardCheckpointer()
 
 
+def _mark_complete(path: str) -> None:
+    with open(os.path.join(path, COMPLETE_SENTINEL), 'w',
+              encoding='utf-8') as f:
+        f.write('complete\n')
+
+
 def save_train_state(ckpt_dir: str, state: Dict[str, Any],
                      step: Optional[int] = None,
                      wait: bool = True) -> str:
-    """Save {params, opt_state, step} under ckpt_dir/<step>."""
+    """Save {params, opt_state, step} under ckpt_dir/<step>.
+
+    wait=False returns once the async write is dispatched; the
+    completeness sentinel is written by a background finalizer after
+    the write flushes (join it with `flush()`), so the checkpoint
+    becomes visible to latest_step only when it is actually durable.
+    """
     if step is None:
         step = int(jax.device_get(state.get('step', 0)))
     path = os.path.join(os.path.abspath(os.path.expanduser(ckpt_dir)),
                         str(step))
+    faults.inject('checkpoint.save')
     ckptr = _checkpointer()
     ckptr.save(path, state, force=True)
     if wait:
         ckptr.wait_until_finished()
+        _mark_complete(path)
+        return path
+
+    def _finalize():
+        ckptr.wait_until_finished()
+        _mark_complete(path)
+
+    thread = threading.Thread(target=_finalize, daemon=True)
+    with _pending_lock:
+        # Prune finished finalizers: periodic async savers must not
+        # grow this list for the life of the process.
+        _pending[:] = [t for t in _pending if t.is_alive()]
+        _pending.append(thread)
+    thread.start()
     return path
 
 
+def flush() -> None:
+    """Join every in-flight async save (end-of-run barrier; tests)."""
+    with _pending_lock:
+        threads, _pending[:] = list(_pending), []
+    for t in threads:
+        t.join()
+
+
 def latest_step(ckpt_dir: str) -> Optional[int]:
+    """Newest COMPLETE step. Torn checkpoints — orbax tmp marker
+    present, or completeness sentinel missing (killed mid-save, or an
+    async save still flushing) — are never resume candidates."""
     ckpt_dir = os.path.abspath(os.path.expanduser(ckpt_dir))
     if not os.path.isdir(ckpt_dir):
         return None
     steps = []
     for name in os.listdir(ckpt_dir):
         full = os.path.join(ckpt_dir, name)
-        if name.isdigit() and os.path.isdir(full) and not os.path.exists(
-                os.path.join(full, '.orbax-checkpoint-tmp')):
+        if (name.isdigit() and os.path.isdir(full) and
+                not os.path.exists(
+                    os.path.join(full, '.orbax-checkpoint-tmp')) and
+                os.path.exists(os.path.join(full, COMPLETE_SENTINEL))):
             steps.append(int(name))
     return max(steps) if steps else None
 

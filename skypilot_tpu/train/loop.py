@@ -10,6 +10,7 @@ managed jobs relaunch this program; it finds the latest checkpoint in
 from __future__ import annotations
 
 import argparse
+import os
 import time
 from typing import Any, Callable, Dict, Optional
 
@@ -17,8 +18,26 @@ import jax
 
 from skypilot_tpu.observability import instruments as obs
 from skypilot_tpu.parallel import mesh as mesh_lib
+from skypilot_tpu.resilience import retries
 from skypilot_tpu.train import checkpoints
 from skypilot_tpu.train import trainer as trainer_lib
+
+
+def _save_with_retries(checkpoint_dir: str, state: Dict[str, Any],
+                       step: int) -> None:
+    """A transient save failure (GCS blip, FUSE hiccup) must not kill
+    a multi-hour run — retry under the shared policy; give up only
+    after the budget and let the caller's exception surface."""
+    retries.call(
+        lambda: checkpoints.save_train_state(checkpoint_dir, state,
+                                             step=step),
+        policy=retries.RetryPolicy(
+            max_attempts=3,
+            base_delay=float(
+                os.environ.get('SKYTPU_CKPT_RETRY_GAP', '2')),
+            max_delay=30.0),
+        retry_on=(Exception,),
+        describe=f'checkpoint save step {step}')
 
 
 def fit(cfg: trainer_lib.TrainerConfig,
@@ -40,6 +59,19 @@ def fit(cfg: trainer_lib.TrainerConfig,
                 state)
             state = checkpoints.restore_train_state(
                 checkpoint_dir, abstract, step=step)
+            # Restored arrays are COMMITTED to their shardings. Fresh
+            # state may carry leaves jit left on one device
+            # (optimizer.init without out_shardings) — harmless while
+            # uncommitted, but restored-committed, a mixed device set
+            # fails the next jitted step. Replicate any narrow leaf
+            # across the full mesh so resume == fresh behavior.
+            from jax.sharding import NamedSharding, PartitionSpec
+            full_set = set(mesh.devices.flat)
+            state = jax.tree.map(
+                lambda x: x if set(x.sharding.device_set) == full_set
+                else jax.device_put(
+                    x, NamedSharding(mesh, PartitionSpec())),
+                state)
             start_step = step
             log_fn(f'[fit] resumed from step {step}')
 
@@ -82,12 +114,10 @@ def fit(cfg: trainer_lib.TrainerConfig,
                        f'mfu={mfu:.2%}')
             if checkpoint_dir is not None and \
                     (i + 1) % checkpoint_every == 0:
-                checkpoints.save_train_state(checkpoint_dir, state,
-                                             step=i + 1)
+                _save_with_retries(checkpoint_dir, state, step=i + 1)
     if checkpoint_dir is not None and \
             checkpoints.latest_step(checkpoint_dir) != cfg.max_steps:
-        checkpoints.save_train_state(checkpoint_dir, state,
-                                     step=cfg.max_steps)
+        _save_with_retries(checkpoint_dir, state, step=cfg.max_steps)
     return {'state': state, 'metrics': metrics,
             'final_step': cfg.max_steps}
 

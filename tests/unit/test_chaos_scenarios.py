@@ -1,0 +1,440 @@
+"""Chaos scenarios: armed fault points drive end-to-end failure
+stories through the real code paths — deterministically (injected
+faults and dead ports, never sleeps-as-synchronization).
+
+Stories:
+- an armed `lb.upstream` fault makes the first upstream hop fail; the
+  LB retries the next READY replica and the client sees 200 (502 only
+  when every candidate is exhausted);
+- a flapping replica trips its circuit breaker; the LB routes around
+  it and the open circuit is visible as a `skytpu_*` gauge in a real
+  /metrics scrape;
+- a spot replica preempted mid-probe is replaced and the placer
+  steers the replacement away from the preempted zone;
+- checkpoint save fails twice then succeeds; the third attempt lands
+  and `latest_step` resumes from it; torn checkpoints are invisible;
+- an armed `heartbeat.recv` fault drops one heartbeat without
+  corrupting staleness bookkeeping.
+"""
+import http.server
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from skypilot_tpu.observability import instruments as obs
+from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.resilience import circuit
+from skypilot_tpu.resilience import faults
+from skypilot_tpu.resilience import retries
+
+# A port with no listener: connect() fails fast with ECONNREFUSED.
+DEAD = 'http://127.0.0.1:1'
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+class _Upstream(http.server.BaseHTTPRequestHandler):
+    status = 200
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+        body = b'{"ok": true}'
+        self.send_response(self.status)
+        self.send_header('Content-Type', 'application/json')
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture
+def upstream():
+    """A real local HTTP replica answering 200."""
+    server = http.server.ThreadingHTTPServer(('127.0.0.1', 0),
+                                             _Upstream)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        yield f'http://127.0.0.1:{server.server_address[1]}'
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+@pytest.fixture
+def lb(upstream):
+    from skypilot_tpu.serve import load_balancer as lb_lib
+    balancer = lb_lib.LoadBalancer(policy_name='round_robin')
+    port = balancer.start()
+    try:
+        yield balancer, f'http://127.0.0.1:{port}', upstream
+    finally:
+        balancer.stop()
+
+
+def _get(url, timeout=10):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+# --- LB failover ------------------------------------------------------------
+
+class TestLoadBalancerFailover:
+
+    def test_upstream_fault_retries_next_ready_replica(self, lb):
+        balancer, lb_url, good = lb
+        # Fault fires once, BEFORE any bytes are written: the request
+        # must fail over to the next candidate and the client must
+        # never see the failure.
+        balancer.set_replicas([DEAD, good])
+        faults.arm('lb.upstream', times=1,
+                   exc=OSError('injected upstream failure'))
+        before = obs.LB_UPSTREAM_RETRIES.value()
+        status, body = _get(lb_url + '/healthz')
+        assert status == 200
+        assert json.loads(body) == {'ok': True}
+        assert obs.LB_UPSTREAM_RETRIES.value() == before + 1
+
+    def test_502_only_when_all_candidates_exhausted(self, lb):
+        balancer, lb_url, good = lb
+        balancer.set_replicas([DEAD, good])
+        # Fail-forever: every candidate's hop raises.
+        faults.arm('lb.upstream', times=None,
+                   exc=OSError('injected: total upstream outage'))
+        status, body = _get(lb_url + '/x')
+        assert status == 502
+        assert b'upstream(s) failed' in body
+
+    def test_no_replicas_is_503_with_retry_after(self, lb):
+        balancer, lb_url, _ = lb
+        balancer.set_replicas([])
+        try:
+            with urllib.request.urlopen(lb_url + '/x', timeout=10):
+                raise AssertionError('expected 503')
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            assert e.headers.get('Retry-After') == '1'
+
+    def test_flapping_replica_trips_breaker_and_is_routed_around(
+            self, lb):
+        balancer, lb_url, good = lb
+        balancer.set_replicas([DEAD, good])
+        # Every request: round-robin alternates the first pick, but
+        # failover guarantees 200 while DEAD accumulates transport
+        # failures (real ECONNREFUSED, no fault needed).
+        for _ in range(8):
+            status, _body = _get(lb_url + '/healthz')
+            assert status == 200
+        assert balancer.breaker.state(DEAD) == circuit.State.OPEN
+        # The open circuit is a scrapeable gauge on the LB's own
+        # /metrics endpoint (acceptance criterion).
+        status, text = _get(lb_url + '/metrics')
+        assert status == 200
+        line = ('skytpu_circuit_state{breaker="lb",'
+                f'target="{DEAD}"}} 1')
+        assert line in text.decode()
+
+    def test_forgotten_replica_clears_circuit(self, lb):
+        balancer, _lb_url, good = lb
+        balancer.breaker.record_failure(DEAD)
+        balancer.set_replicas([good])  # DEAD removed from rotation
+        assert balancer.breaker.state(DEAD) == circuit.State.CLOSED
+
+
+# --- probe classification + breaker ----------------------------------------
+
+def _manager(spec_cfg=None):
+    from skypilot_tpu.serve import replica_managers
+    from skypilot_tpu.serve import service_spec as spec_lib
+    cfg = {'readiness_probe': {'path': '/', 'timeout_seconds': 2}}
+    cfg.update(spec_cfg or {})
+    spec = spec_lib.ServiceSpec.from_yaml_config(cfg)
+    return replica_managers.ReplicaManager('chaos-svc', task=None,
+                                          spec=spec)
+
+
+class TestProbeFailureModes:
+
+    def test_refused_vs_5xx_distinguished(self, upstream):
+        _Upstream.status = 500
+        try:
+            mgr = _manager()
+            r = mgr._probe_replica({'replica_id': 1,
+                                    'endpoint': upstream})
+            assert r == (False, 'http_500')
+            r = mgr._probe_replica({'replica_id': 2, 'endpoint': DEAD})
+            assert r == (False, 'refused')
+        finally:
+            _Upstream.status = 200
+
+    def test_injected_probe_fault(self, upstream):
+        mgr = _manager()
+        faults.arm('probe.http', times=1)
+        r = mgr._probe_replica({'replica_id': 1, 'endpoint': upstream})
+        assert r == (False, 'injected')
+        # Disarmed now: the same endpoint probes healthy.
+        r = mgr._probe_replica({'replica_id': 1, 'endpoint': upstream})
+        assert r == (True, 'ok')
+
+    def test_starting_replica_bypasses_open_breaker(self):
+        """A STARTING replica must ALWAYS get a real probe: refusals
+        while the app boots are expected, and a suppressed probe
+        would blow the grace window unobserved (crash loop)."""
+        from skypilot_tpu.serve import serve_state
+        mgr = _manager()
+        for _ in range(3):
+            mgr._probe_replica({'replica_id': 1, 'endpoint': DEAD})
+        assert mgr._probe_replica(
+            {'replica_id': 1, 'endpoint': DEAD}).detail == \
+            'circuit_open'
+        # Same endpoint, STARTING status: the probe really goes out.
+        r = mgr._probe_replica(
+            {'replica_id': 1, 'endpoint': DEAD,
+             'status': serve_state.ReplicaStatus.STARTING})
+        assert r.detail == 'refused'
+
+    def test_consecutive_probe_failures_open_breaker(self):
+        mgr = _manager()
+        replica = {'replica_id': 1, 'endpoint': DEAD}
+        for _ in range(3):
+            assert not mgr._probe_replica(replica).ok
+        # Breaker open: the next probe short-circuits (no network).
+        r = mgr._probe_replica(replica)
+        assert r == (False, 'circuit_open')
+        assert obs.CIRCUIT_STATE.value(breaker='probe',
+                                       target=DEAD) == 1.0
+        # ... and the open circuit renders in the exposition payload.
+        assert 'skytpu_circuit_state{breaker="probe"' in \
+            metrics_lib.generate_text()
+
+
+# --- preemption story -------------------------------------------------------
+
+class _SyncThread:
+    """Deterministic stand-in for threading.Thread: runs inline."""
+
+    def __init__(self, target, args=(), daemon=None):
+        self._target, self._args = target, args
+
+    def start(self):
+        self._target(*self._args)
+
+
+class TestSpotPreemptionStory:
+
+    def test_preempted_replica_replaced_away_from_zone(
+            self, monkeypatch):
+        """A spot replica preempted mid-probe is replaced and the
+        placer steers the replacement away from its zone."""
+        from skypilot_tpu import core, execution, state as state_lib
+        from skypilot_tpu.serve import replica_managers, serve_state
+        serve_state.reset_for_tests()
+        launches = []
+        monkeypatch.setattr(execution, 'launch',
+                            lambda task, cluster_name, **kw:
+                            launches.append(cluster_name) or (1, None))
+        monkeypatch.setattr(core, 'down', lambda name, purge=False: None)
+        # Cluster records: every cluster is "lost" (preempted).
+        monkeypatch.setattr(state_lib, 'get_cluster_from_name',
+                            lambda name: None)
+        monkeypatch.setattr(replica_managers.threading, 'Thread',
+                            _SyncThread)
+
+        from skypilot_tpu import resources as resources_lib
+        from skypilot_tpu import task as task_lib
+        mgr = _manager({'replica_policy': {
+            'min_replicas': 1, 'use_spot': True,
+            'spot_zones': ['us-a', 'us-b', 'us-c']}})
+        task = task_lib.Task(run='echo replica')
+        task.set_resources(resources_lib.Resources(
+            infra='gcp/us-central2'))
+        mgr.task = task
+        serve_state.add_replica('chaos-svc', 1, 'c1', version=1,
+                                use_spot=True, zone='us-a')
+        serve_state.set_replica_status(
+            'chaos-svc', 1, serve_state.ReplicaStatus.READY,
+            endpoint=DEAD)
+
+        mgr.probe_all()
+
+        # The preempted zone is demoted...
+        assert mgr.spot_placer.preemptive_zones == ['us-a']
+        # ...and the replacement replica launched somewhere else, on
+        # spot, synchronously via the faked launch.
+        replicas = serve_state.get_replicas('chaos-svc')
+        assert len(replicas) == 1
+        assert replicas[0]['cluster_name'] != 'c1'  # a NEW replica
+        assert replicas[0]['use_spot'] is True
+        assert replicas[0]['zone'] in ('us-b', 'us-c')
+        assert replicas[0]['status'] == \
+            serve_state.ReplicaStatus.STARTING
+        assert launches  # the replacement actually launched
+
+
+class TestStartingGraceWindow:
+
+    def test_missing_launched_at_gets_fresh_grace_window(
+            self, monkeypatch):
+        """STARTING replica with launched_at=None must NOT be
+        instantly replaced (age used to compute as ~55 years)."""
+        from skypilot_tpu import state as state_lib
+        from skypilot_tpu.serve import serve_state
+        serve_state.reset_for_tests()
+
+        class Handle:
+            def head_ip(self):
+                return None
+
+        monkeypatch.setattr(state_lib, 'get_cluster_from_name',
+                            lambda name: {'handle': Handle()})
+        mgr = _manager({'readiness_probe': {
+            'path': '/', 'initial_delay_seconds': 600,
+            'timeout_seconds': 2}})
+        serve_state.add_replica('chaos-svc', 1, 'c1', version=1)
+        serve_state.set_replica_status(
+            'chaos-svc', 1, serve_state.ReplicaStatus.STARTING,
+            endpoint=DEAD)
+        # Simulate the anomaly: no launch timestamp recorded.
+        conn = serve_state._get_conn()  # noqa: SLF001 — test rig
+        conn.execute('UPDATE replicas SET launched_at=NULL')
+        conn.commit()
+
+        mgr.probe_all()
+
+        replicas = serve_state.get_replicas('chaos-svc')
+        # Still the SAME replica, still within its (fresh) grace
+        # window — and the timestamp was repaired in state.
+        assert [r['replica_id'] for r in replicas] == [1]
+        assert replicas[0]['status'] == \
+            serve_state.ReplicaStatus.STARTING
+        assert replicas[0]['launched_at'] is not None
+
+
+# --- checkpoint story -------------------------------------------------------
+
+class TestCheckpointChaos:
+
+    def test_save_fails_twice_then_third_attempt_lands(self, tmp_path):
+        import jax.numpy as jnp
+        from skypilot_tpu.train import checkpoints
+        state = {'x': jnp.arange(8, dtype=jnp.float32)}
+        faults.arm('checkpoint.save', times=2,
+                   exc=RuntimeError('injected save failure'))
+        slept = []
+        retries.call(
+            lambda: checkpoints.save_train_state(
+                str(tmp_path / 'ckpt'), state, step=7),
+            policy=retries.RetryPolicy(max_attempts=3, base_delay=1.0),
+            retry_on=(RuntimeError,), sleep_fn=slept.append)
+        assert faults.hits('checkpoint.save') == 2
+        assert len(slept) == 2
+        assert checkpoints.latest_step(str(tmp_path / 'ckpt')) == 7
+
+    def test_exhausted_budget_surfaces_failure(self, tmp_path):
+        import jax.numpy as jnp
+        from skypilot_tpu.train import checkpoints
+        faults.arm('checkpoint.save', times=None,
+                   exc=RuntimeError('disk gone'))
+        with pytest.raises(RuntimeError, match='disk gone'):
+            retries.call(
+                lambda: checkpoints.save_train_state(
+                    str(tmp_path / 'ckpt'),
+                    {'x': jnp.zeros(2)}, step=1),
+                policy=retries.RetryPolicy(max_attempts=2,
+                                           base_delay=1.0),
+                retry_on=(RuntimeError,), sleep_fn=lambda dt: None)
+        assert checkpoints.latest_step(str(tmp_path / 'ckpt')) is None
+
+
+# --- load shedding ----------------------------------------------------------
+
+class TestLoadShedding:
+
+    def test_generate_sheds_past_queue_threshold(self):
+        """Queue depth at/over the limit: 503 + Retry-After BEFORE the
+        request touches the engine; under the limit it proceeds."""
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from skypilot_tpu.inference import server as srv
+        holder = {'loop': object(), 'max_queue_depth': 2}
+
+        async def run():
+            client = TestClient(TestServer(srv.create_app(holder)))
+            await client.start_server()
+            try:
+                obs.QUEUE_DEPTH.set(5)
+                shed_before = obs.REQUESTS_SHED.value()
+                resp = await client.post(
+                    '/generate', json={'prompt_tokens': [1]})
+                assert resp.status == 503
+                assert resp.headers['Retry-After'] == '1'
+                assert 'overloaded' in (await resp.json())['error']
+                assert obs.REQUESTS_SHED.value() == shed_before + 1
+                # The OpenAI surface sheds through the same gate.
+                resp = await client.post(
+                    '/v1/completions',
+                    json={'prompt': [1], 'model': 'tiny'})
+                assert resp.status == 503
+                assert resp.headers['Retry-After'] == '1'
+            finally:
+                obs.QUEUE_DEPTH.set(0)
+                await client.close()
+
+        asyncio.run(run())
+
+    def test_disabled_by_default(self):
+        from skypilot_tpu.inference import server as srv
+        obs.QUEUE_DEPTH.set(10 ** 6)
+        try:
+            assert srv.shed_limit({'loop': object()}) is None
+        finally:
+            obs.QUEUE_DEPTH.set(0)
+
+
+# --- heartbeat story --------------------------------------------------------
+
+class TestHeartbeatChaos:
+
+    def test_dropped_heartbeat_then_recovery(self):
+        from skypilot_tpu import state
+        from skypilot_tpu.server import app as app_mod
+        from skypilot_tpu.server import requests_db
+        requests_db.reset_for_tests()
+        state.add_or_update_cluster('hb-chaos', handle=None,
+                                    requested_resources_str='local',
+                                    num_nodes=1, ready=True)
+        payload = json.dumps(
+            {'cluster_name': 'hb-chaos'}).encode()
+        with app_mod.ServerThread() as srv:
+            faults.arm('heartbeat.recv', times=1)
+            req = urllib.request.Request(
+                f'{srv.url}/api/v1/heartbeat', data=payload,
+                headers={'Content-Type': 'application/json'},
+                method='POST')
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(req, timeout=10)
+            # The drop left no staleness record behind...
+            assert 'hb-chaos' not in state.get_heartbeats()
+            # ...and the very next heartbeat lands.
+            req = urllib.request.Request(
+                f'{srv.url}/api/v1/heartbeat', data=payload,
+                headers={'Content-Type': 'application/json'},
+                method='POST')
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert resp.status == 200
+            assert 'hb-chaos' in state.get_heartbeats()
+        requests_db.reset_for_tests()

@@ -95,6 +95,44 @@ def test_restore_params_for_inference(tmp_path):
     _tree_equal(state['params'], params)
 
 
+def test_torn_checkpoint_never_resumed(tmp_path):
+    """A host killed mid-save leaves a step dir WITHOUT the
+    completeness sentinel: latest_step must skip it and fall back to
+    the last complete step (or None)."""
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(fsdp=-1))
+    state = trainer_lib.make_train_state(_cfg(), mesh)
+    ckpt = str(tmp_path / 'ckpt')
+    checkpoints.save_train_state(ckpt, state, step=2)
+    assert checkpoints.latest_step(ckpt) == 2
+
+    # Torn save at step 5: orbax wrote arrays but the process died
+    # before the sentinel (simulated by deleting it).
+    checkpoints.save_train_state(ckpt, state, step=5)
+    os.remove(os.path.join(ckpt, '5', checkpoints.COMPLETE_SENTINEL))
+    assert checkpoints.latest_step(ckpt) == 2
+
+    # A hand-made step dir with data but no sentinel is torn too.
+    os.makedirs(os.path.join(ckpt, '9'))
+    assert checkpoints.latest_step(ckpt) == 2
+
+
+def test_async_save_becomes_visible_after_flush(tmp_path):
+    """wait=False: the sentinel lands only after the async write
+    flushes — flush() is the deterministic barrier (no sleeps)."""
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(fsdp=-1))
+    state = trainer_lib.make_train_state(_cfg(), mesh)
+    ckpt = str(tmp_path / 'ckpt')
+    checkpoints.save_train_state(ckpt, state, step=3, wait=False)
+    checkpoints.flush()
+    assert checkpoints.latest_step(ckpt) == 3
+    # And the flushed checkpoint restores.
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                       sharding=x.sharding), state)
+    restored = checkpoints.restore_train_state(ckpt, abstract)
+    _tree_equal(state['params'], restored['params'])
+
+
 def test_moe_checkpoint_serves(tmp_path):
     """The serve-from-checkpoint path for the MoE family: params saved
     by training restore structure-driven and decode through the

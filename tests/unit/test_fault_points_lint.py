@@ -1,0 +1,82 @@
+"""Fault-point namespace lint (style of test_metrics_lint.py): name
+drift in the chaos-injection catalog fails tier-1, not debugging
+sessions.
+
+Importing the faults module registers the whole catalog; this pass
+asserts the naming/uniqueness/documentation contract over ALL of
+them — a typo'd point name would otherwise silently never fire.
+"""
+import os
+import re
+
+from skypilot_tpu.resilience import faults
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_GUIDE = os.path.join(_REPO, 'docs', 'guides', 'resilience.md')
+
+
+def _points():
+    points = faults.registered_points()
+    assert len(points) >= 5, 'fault-point catalog went missing'
+    return points
+
+
+def test_every_point_matches_naming_regex():
+    for name in _points():
+        assert faults.POINT_RE.fullmatch(name), (
+            f'{name}: fault points are dotted plane.operation names')
+
+
+def test_every_point_has_description():
+    for name, desc in _points().items():
+        assert desc and len(desc.strip()) >= 10, name
+
+
+def test_points_documented_in_resilience_guide():
+    """Every registered point appears in docs/guides/resilience.md —
+    injection points stay discoverable as they spread."""
+    with open(_GUIDE, encoding='utf-8') as f:
+        text = f.read()
+    missing = [p for p in _points() if f'`{p}`' not in text]
+    assert not missing, (
+        f'fault points undocumented in guides/resilience.md: {missing}')
+
+
+def test_documented_points_exist():
+    """No doc rot in the other direction either: every `a.b` code
+    literal in the guide's fault-point table is a real point."""
+    with open(_GUIDE, encoding='utf-8') as f:
+        text = f.read()
+    table = re.findall(r'^\| `([a-z][a-z0-9_.]*)` \|', text,
+                       flags=re.MULTILINE)
+    assert table, 'guide lost its fault-point table'
+    registered = set(_points())
+    ghosts = [p for p in table if '.' in p and p not in registered]
+    assert not ghosts, f'guide documents unknown fault points: {ghosts}'
+
+
+def test_declare_rejects_bad_names():
+    import pytest
+    with pytest.raises(ValueError):
+        faults.declare('NoDots', 'a description long enough')
+    with pytest.raises(ValueError):
+        faults.declare('probe.http', 'duplicate of an existing point')
+
+
+def test_armed_injection_is_observable():
+    """An armed point increments skytpu_faults_injected_total — chaos
+    runs are visible in the same scrape as everything else."""
+    from skypilot_tpu.observability import instruments as obs
+    faults.reset()
+    try:
+        faults.arm('provision.launch', times=1)
+        before = obs.FAULTS_INJECTED.value(point='provision.launch')
+        try:
+            faults.inject('provision.launch')
+        except faults.FaultInjected:
+            pass
+        assert obs.FAULTS_INJECTED.value(
+            point='provision.launch') == before + 1
+    finally:
+        faults.reset()

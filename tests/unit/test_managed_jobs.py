@@ -26,8 +26,8 @@ def jobs_env(monkeypatch, tmp_path):
     monkeypatch.setenv('SKYTPU_JOBS_POLL_INTERVAL', '0.3')
     monkeypatch.setenv('SKYTPU_JOBS_RETRY_GAP', '0.2')
     jobs_controller._POLL_INTERVAL_SECONDS = 0.3
-    import skypilot_tpu.jobs.recovery_strategy as rs
-    rs._LAUNCH_RETRY_GAP_SECONDS = 0.2
+    # SKYTPU_JOBS_RETRY_GAP above is enough: recovery_strategy reads
+    # it at call time now, not import time.
     cache = os.path.join(os.path.expanduser('~/.skytpu'))
     os.makedirs(cache, exist_ok=True)
     with open(os.path.join(cache, 'enabled_clouds.json'), 'w',

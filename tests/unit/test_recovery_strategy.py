@@ -1,0 +1,203 @@
+"""Recovery strategies, driven by a faked execution.launch.
+
+Covers FAILOVER's two-phase same-placement-then-free behavior,
+EAGER_NEXT_REGION's blocked-resources pass-through on the first
+attempt only, the call-time SKYTPU_JOBS_RETRY_GAP read, and the total
+recovery deadline budget — all with injected clocks (no sleeping).
+"""
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.jobs import recovery_strategy
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+        self.sleeps = []
+
+    def now(self):
+        return self.t
+
+    def sleep(self, dt):
+        self.sleeps.append(dt)
+        self.t += dt
+
+
+class LaunchLog:
+    """Scripted execution.launch: pops one outcome per call and
+    records the blocked_resources each attempt carried."""
+
+    def __init__(self, outcomes):
+        self.outcomes = list(outcomes)
+        self.blocked_seen = []
+
+    def __call__(self, task, cluster_name, stream_logs, detach_run,
+                 blocked_resources=None):
+        self.blocked_seen.append(blocked_resources)
+        out = self.outcomes.pop(0)
+        if isinstance(out, Exception):
+            raise out
+        return out, None  # (job_id, handle)
+
+
+@pytest.fixture
+def harness(monkeypatch):
+    """Fake the launch/teardown/state collaborators; return hooks."""
+    from skypilot_tpu import core, execution, state as state_lib
+    downs = []
+    monkeypatch.setattr(core, 'down',
+                        lambda name, purge=False: downs.append(name))
+    monkeypatch.setattr(state_lib, 'get_cluster_from_name',
+                        lambda name: None)
+
+    def install(outcomes):
+        log = LaunchLog(outcomes)
+        monkeypatch.setattr(execution, 'launch', log)
+        return log
+
+    return {'install': install, 'downs': downs,
+            'monkeypatch': monkeypatch}
+
+
+def _executor(strategy, clock, **kw):
+    impl = recovery_strategy.STRATEGY_REGISTRY.get(strategy)
+    return impl(task=object(), cluster_name='job-cluster',
+                sleep_fn=clock.sleep, now_fn=clock.now, **kw)
+
+
+def test_failover_two_phase_same_placement_then_free(harness):
+    """Phase 1 retries the SAME placement once (no blocked resources);
+    on capacity failure phase 2 re-enters the retry loop with free
+    placement."""
+    clock = FakeClock()
+    log = harness['install']([
+        exceptions.ResourcesUnavailableError('zone dry'),  # phase 1
+        exceptions.ResourcesUnavailableError('still dry'),  # phase 2 a1
+        7,                                                  # phase 2 a2
+    ])
+    ex = _executor('FAILOVER', clock)
+    job_id = ex.recover()
+    assert job_id == 7
+    # The old slice is terminated BEFORE any relaunch (TPU slices hold
+    # quota until deleted).
+    assert harness['downs'][0] == 'job-cluster'
+    # No attempt ever carried blocked resources: FAILOVER wants the
+    # same placement first and a free optimizer pick second.
+    assert log.blocked_seen == [None, None, None]
+
+
+def test_failover_phase1_success_skips_retry_loop(harness):
+    clock = FakeClock()
+    log = harness['install']([3])
+    ex = _executor('FAILOVER', clock)
+    assert ex.recover() == 3
+    assert log.blocked_seen == [None]
+    assert clock.sleeps == []
+
+
+def test_eager_blocks_preempted_placement_on_first_attempt_only(
+        harness):
+    """EAGER_NEXT_REGION blocks the preempted resources immediately —
+    but ONLY on the first attempt; later attempts free the optimizer
+    to pick anywhere (including the original zone, which may have
+    recovered)."""
+    clock = FakeClock()
+
+    class Handle:
+        launched_resources = 'tpu-v5e-8@us-central2-b'
+
+    harness['monkeypatch'].setattr(
+        'skypilot_tpu.state.get_cluster_from_name',
+        lambda name: {'handle': Handle()})
+    log = harness['install']([
+        exceptions.ResourcesUnavailableError('next region dry too'),
+        11,
+    ])
+    ex = _executor('EAGER_NEXT_REGION', clock)
+    assert ex.recover() == 11
+    assert log.blocked_seen == [['tpu-v5e-8@us-central2-b'], None]
+
+
+def test_retry_gap_env_read_at_call_time(harness, monkeypatch):
+    """SKYTPU_JOBS_RETRY_GAP set AFTER module import must be honored
+    (it used to be read once at import time and silently ignored)."""
+    monkeypatch.setenv('SKYTPU_JOBS_RETRY_GAP', '4')
+    clock = FakeClock()
+    harness['install']([
+        exceptions.ResourcesUnavailableError('dry'), 5])
+    ex = _executor('EAGER_NEXT_REGION', clock)
+    assert ex.recover() == 5
+    # One backoff happened, drawn from the 4s gap (full jitter caps
+    # the delay at base*2^0 = 4s for the first retry).
+    assert len(clock.sleeps) == 1
+    assert 0.0 <= clock.sleeps[0] <= 4.0
+
+
+def test_command_error_terminates_before_relaunch(harness):
+    """A failed launch command leaves a half-set-up cluster: it must
+    be torn down between attempts."""
+    clock = FakeClock()
+    harness['install']([
+        exceptions.CommandError(1, 'setup.sh', 'boom'), 9])
+    ex = _executor('EAGER_NEXT_REGION', clock)
+    assert ex.recover() == 9
+    # recover() tears down once up front + once after the failure.
+    assert harness['downs'].count('job-cluster') == 2
+
+
+def test_final_command_error_still_tears_down_cluster(harness):
+    """Exhaustion on a CommandError must terminate the half-set-up
+    cluster before raising — it holds TPU quota until deleted."""
+    clock = FakeClock()
+    harness['install'](
+        [exceptions.CommandError(1, 'setup.sh', f'boom {i}')
+         for i in range(3)])
+    ex = _executor('EAGER_NEXT_REGION', clock)
+    with pytest.raises(exceptions.ManagedJobReachedMaxRetriesError):
+        ex.recover()
+    # 1 up-front + 1 per between-attempt retry (x2) + 1 on the final
+    # failure = 4 teardowns.
+    assert harness['downs'].count('job-cluster') == 4
+
+
+def test_exhaustion_raises_managed_job_error(harness):
+    clock = FakeClock()
+    harness['install'](
+        [exceptions.ResourcesUnavailableError(f'dry {i}')
+         for i in range(3)])
+    ex = _executor('EAGER_NEXT_REGION', clock)
+    with pytest.raises(exceptions.ManagedJobReachedMaxRetriesError,
+                       match='3 attempt'):
+        ex.recover()
+
+
+def test_recovery_deadline_bounds_total_time(harness):
+    """With a recovery deadline the executor gives up when the budget
+    is spent, not after a fixed attempt count."""
+    clock = FakeClock()
+    log = harness['install'](
+        [exceptions.ResourcesUnavailableError(f'dry {i}')
+         for i in range(50)])
+    ex = _executor('EAGER_NEXT_REGION', clock,
+                   max_launch_retries=50,
+                   recovery_deadline_seconds=30.0)
+    with pytest.raises(exceptions.ManagedJobReachedMaxRetriesError):
+        ex.recover()
+    # Far fewer than 50 attempts ran, and no sleep was scheduled past
+    # the 30s budget.
+    assert len(log.blocked_seen) < 50
+    assert clock.t <= 30.0
+
+
+def test_recovery_deadline_env(harness, monkeypatch):
+    monkeypatch.setenv('SKYTPU_JOBS_RECOVERY_DEADLINE', '15')
+    monkeypatch.setenv('SKYTPU_JOBS_RETRY_GAP', '10')
+    clock = FakeClock()
+    harness['install'](
+        [exceptions.ResourcesUnavailableError(f'dry {i}')
+         for i in range(50)])
+    ex = _executor('EAGER_NEXT_REGION', clock, max_launch_retries=50)
+    with pytest.raises(exceptions.ManagedJobReachedMaxRetriesError):
+        ex.recover()
+    assert clock.t <= 15.0

@@ -1,0 +1,391 @@
+"""Resilience primitives: retry policy, circuit breaker, fault registry.
+
+Everything runs on injected clocks/sleeps/rngs — zero real sleeping,
+fully deterministic schedules.
+"""
+import threading
+
+import pytest
+
+from skypilot_tpu.observability import instruments as obs
+from skypilot_tpu.resilience import circuit
+from skypilot_tpu.resilience import faults
+from skypilot_tpu.resilience import retries
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+class FakeClock:
+    """now() advances only via sleep() — exact schedules, no waiting."""
+
+    def __init__(self):
+        self.t = 0.0
+        self.sleeps = []
+
+    def now(self):
+        return self.t
+
+    def sleep(self, dt):
+        self.sleeps.append(dt)
+        self.t += dt
+
+
+# --- retries ----------------------------------------------------------------
+
+class TestRetryPolicy:
+
+    def test_succeeds_after_transient_failures(self):
+        clock = FakeClock()
+        attempts = []
+
+        def fn():
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise ValueError('transient')
+            return 'ok'
+
+        out = retries.call(
+            fn, policy=retries.RetryPolicy(max_attempts=5,
+                                           base_delay=1.0),
+            retry_on=(ValueError,), sleep_fn=clock.sleep,
+            now_fn=clock.now, rng=lambda: 1.0)
+        assert out == 'ok'
+        assert len(attempts) == 3
+        # Exponential: 1*2^0, 1*2^1 (rng pinned at 1.0 = max jitter).
+        assert clock.sleeps == [1.0, 2.0]
+
+    def test_exhaustion_reraises_last_error(self):
+        clock = FakeClock()
+        with pytest.raises(ValueError, match='always'):
+            retries.call(
+                lambda: (_ for _ in ()).throw(ValueError('always')),
+                policy=retries.RetryPolicy(max_attempts=3,
+                                           base_delay=1.0),
+                retry_on=(ValueError,), sleep_fn=clock.sleep,
+                now_fn=clock.now, rng=lambda: 1.0)
+        assert clock.sleeps == [1.0, 2.0]  # between 3 attempts
+
+    def test_non_retryable_error_propagates_immediately(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise KeyError('wrong type')
+
+        with pytest.raises(KeyError):
+            retries.call(fn, policy=retries.RetryPolicy(max_attempts=5),
+                         retry_on=(ValueError,),
+                         sleep_fn=lambda dt: None)
+        assert len(calls) == 1
+
+    def test_full_jitter_bounded_by_cap(self):
+        policy = retries.RetryPolicy(max_attempts=10, base_delay=2.0,
+                                     max_delay=10.0)
+        # attempt 0 cap=2, attempt 3 cap=16 -> clamped to 10.
+        assert policy.delay(0, rng=lambda: 1.0) == 2.0
+        assert policy.delay(3, rng=lambda: 1.0) == 10.0
+        assert policy.delay(3, rng=lambda: 0.25) == 2.5
+        assert policy.delay(3, rng=lambda: 0.0) == 0.0
+
+    def test_deadline_budget_stops_retrying(self):
+        clock = FakeClock()
+        attempts = []
+
+        def fn():
+            attempts.append(1)
+            raise ValueError('slow resource')
+
+        with pytest.raises(ValueError):
+            retries.call(
+                fn,
+                policy=retries.RetryPolicy(max_attempts=100,
+                                           base_delay=10.0,
+                                           jitter=False,
+                                           exponential=False,
+                                           deadline=25.0),
+                retry_on=(ValueError,), sleep_fn=clock.sleep,
+                now_fn=clock.now)
+        # t=0 fail, sleep 10; t=10 fail, sleep 10; t=20 fail:
+        # next sleep would land at t=30 > 25 -> give up.
+        assert len(attempts) == 3
+
+    def test_unbounded_attempts_require_deadline(self):
+        with pytest.raises(ValueError):
+            retries.RetryPolicy(max_attempts=None)
+        retries.RetryPolicy(max_attempts=None, deadline=60.0)  # ok
+
+    def test_on_retry_hook_fires_between_attempts(self):
+        seen = []
+        with pytest.raises(ValueError):
+            retries.call(
+                lambda: (_ for _ in ()).throw(ValueError('x')),
+                policy=retries.RetryPolicy(max_attempts=3,
+                                           base_delay=0.0),
+                retry_on=(ValueError,),
+                on_retry=lambda e, n: seen.append((str(e), n)),
+                sleep_fn=lambda dt: None)
+        assert seen == [('x', 1), ('x', 2)]
+
+    def test_decorator_form(self):
+        calls = []
+
+        @retries.retrying(retries.RetryPolicy(max_attempts=2,
+                                              base_delay=0.0),
+                          retry_on=(ValueError,),
+                          sleep_fn=lambda dt: None)
+        def flaky(x):
+            calls.append(x)
+            if len(calls) < 2:
+                raise ValueError('once')
+            return x * 2
+
+        assert flaky(21) == 42
+        assert calls == [21, 21]
+
+    def test_attempt_timeout_counts_as_failure(self):
+        release = threading.Event()
+        attempts = []
+
+        def fn():
+            attempts.append(1)
+            if len(attempts) == 1:
+                release.wait(5.0)  # first attempt hangs
+                return 'late'
+            return 'fast'
+
+        try:
+            out = retries.call(
+                fn,
+                policy=retries.RetryPolicy(max_attempts=2,
+                                           base_delay=0.0,
+                                           attempt_timeout=0.1),
+                retry_on=(TimeoutError,), sleep_fn=lambda dt: None)
+        finally:
+            release.set()  # unblock the abandoned worker thread
+        assert out == 'fast'
+        assert len(attempts) == 2
+
+
+# --- circuit breaker --------------------------------------------------------
+
+class TestCircuitBreaker:
+
+    def _breaker(self, **kw):
+        clock = FakeClock()
+        kw.setdefault('failure_threshold', 3)
+        kw.setdefault('recovery_timeout', 30.0)
+        return circuit.CircuitBreaker('test', now_fn=clock.now,
+                                      **kw), clock
+
+    def test_closed_until_threshold(self):
+        b, _ = self._breaker()
+        for _ in range(2):
+            b.record_failure('r1')
+        assert b.state('r1') == circuit.State.CLOSED
+        assert b.allow('r1')
+        b.record_failure('r1')
+        assert b.state('r1') == circuit.State.OPEN
+        assert not b.allow('r1')
+
+    def test_targets_are_independent(self):
+        b, _ = self._breaker(failure_threshold=1)
+        b.record_failure('bad')
+        assert not b.allow('bad')
+        assert b.allow('good')
+        assert b.state('good') == circuit.State.CLOSED
+
+    def test_success_resets_failure_streak(self):
+        b, _ = self._breaker(failure_threshold=3)
+        b.record_failure('r')
+        b.record_failure('r')
+        b.record_success('r')
+        b.record_failure('r')
+        b.record_failure('r')
+        assert b.state('r') == circuit.State.CLOSED
+
+    def test_half_open_after_recovery_then_close_on_success(self):
+        b, clock = self._breaker(failure_threshold=1,
+                                 recovery_timeout=30.0)
+        b.record_failure('r')
+        assert not b.allow('r')
+        clock.t = 31.0
+        assert b.allow('r')  # trial call admitted
+        assert b.state('r') == circuit.State.HALF_OPEN
+        assert not b.allow('r')  # half_open_max_calls=1
+        b.record_success('r')
+        assert b.state('r') == circuit.State.CLOSED
+        assert b.allow('r')
+
+    def test_half_open_failure_reopens(self):
+        b, clock = self._breaker(failure_threshold=1,
+                                 recovery_timeout=30.0)
+        b.record_failure('r')
+        clock.t = 31.0
+        assert b.allow('r')
+        b.record_failure('r')
+        assert b.state('r') == circuit.State.OPEN
+        clock.t = 60.0  # timer restarted at t=31: still open
+        assert not b.allow('r')
+        clock.t = 62.0
+        assert b.allow('r')
+
+    def test_half_open_trial_slot_expires_if_outcome_never_reported(
+            self):
+        """A trial caller that vanishes (client disconnect mid-proxy)
+        must not wedge the target rejected forever: trial slots
+        replenish after another recovery window."""
+        b, clock = self._breaker(failure_threshold=1,
+                                 recovery_timeout=30.0)
+        b.record_failure('r')
+        clock.t = 31.0
+        assert b.allow('r')   # trial admitted; outcome never reported
+        assert not b.allow('r')
+        clock.t = 62.0        # another recovery window elapsed
+        assert b.allow('r')   # fresh trial slot
+        b.record_success('r')
+        assert b.state('r') == circuit.State.CLOSED
+
+    def test_forget_clears_target(self):
+        b, _ = self._breaker(failure_threshold=1)
+        b.record_failure('r')
+        b.forget('r')
+        assert b.state('r') == circuit.State.CLOSED
+        assert b.allow('r')
+
+    def test_state_exported_as_gauge(self):
+        b, _ = self._breaker(failure_threshold=1)
+        b.record_failure('ep1')
+        assert obs.CIRCUIT_STATE.value(breaker='test',
+                                       target='ep1') == 1.0
+        assert obs.CIRCUIT_OPEN.value(breaker='test',
+                                      target='ep1') >= 1.0
+        b.record_success('ep1')
+        assert obs.CIRCUIT_STATE.value(breaker='test',
+                                       target='ep1') == 0.0
+
+    def test_thread_safety_smoke(self):
+        b, _ = self._breaker(failure_threshold=5)
+
+        def hammer():
+            for _ in range(200):
+                b.record_failure('r')
+                b.allow('r')
+                b.record_success('r')
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert b.state('r') in (circuit.State.CLOSED,
+                                circuit.State.OPEN)
+
+
+# --- fault registry ---------------------------------------------------------
+
+class TestFaults:
+
+    def test_unarmed_inject_is_noop(self):
+        faults.inject('probe.http')  # no raise
+
+    def test_unknown_point_rejected(self):
+        with pytest.raises(ValueError, match='unknown fault point'):
+            faults.arm('no.such.point')
+
+    def test_fail_n_times_then_recover(self):
+        faults.arm('checkpoint.save', times=2,
+                   exc=RuntimeError('disk blip'))
+        for _ in range(2):
+            with pytest.raises(RuntimeError):
+                faults.inject('checkpoint.save')
+        faults.inject('checkpoint.save')  # armed count exhausted
+        assert faults.hits('checkpoint.save') == 2
+
+    def test_fail_forever(self):
+        faults.arm('probe.http', times=None)
+        for _ in range(5):
+            with pytest.raises(faults.FaultInjected):
+                faults.inject('probe.http')
+        assert faults.hits('probe.http') == 5
+
+    def test_latency_only_fault(self):
+        slept = []
+        faults.arm('lb.upstream', times=1, exc=None, latency=0.25)
+        faults.inject('lb.upstream', sleep_fn=slept.append)
+        assert slept == [0.25]
+
+    def test_custom_exception_type(self):
+        faults.arm('lb.upstream', times=1, exc=OSError('conn reset'))
+        with pytest.raises(OSError, match='conn reset'):
+            faults.inject('lb.upstream')
+
+    def test_env_armed_at_inject_time(self, monkeypatch):
+        # Set AFTER import/reset: must still take effect (the
+        # read-at-call-time contract).
+        monkeypatch.setenv('SKYTPU_FAULTS', 'heartbeat.recv:2')
+        with pytest.raises(faults.FaultInjected):
+            faults.inject('heartbeat.recv')
+        with pytest.raises(faults.FaultInjected):
+            faults.inject('heartbeat.recv')
+        faults.inject('heartbeat.recv')  # exhausted
+
+    def test_env_forever_and_unknown_ignored(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_FAULTS',
+                           'bogus.point:3, probe.http:forever')
+        assert 'probe.http' in faults.armed_points()
+        with pytest.raises(faults.FaultInjected):
+            faults.inject('probe.http')
+
+    def test_env_armed_fault_raises_call_site_type(self, monkeypatch):
+        """An env-armed fault must look like the REAL failure to the
+        call site's handlers (env_exc), not a FaultInjected the
+        surrounding code never catches."""
+        monkeypatch.setenv('SKYTPU_FAULTS', 'lb.upstream:1')
+        with pytest.raises(OSError):
+            faults.inject('lb.upstream', env_exc=OSError)
+        faults.reset()
+        # Code-armed faults keep exactly what the test supplied, even
+        # when the call site passes env_exc.
+        faults.arm('lb.upstream', times=1, exc=ValueError('mine'))
+        with pytest.raises(ValueError, match='mine'):
+            faults.inject('lb.upstream', env_exc=OSError)
+
+    def test_env_malformed_spec_never_breaks_hot_path(self,
+                                                     monkeypatch):
+        monkeypatch.setenv('SKYTPU_FAULTS',
+                           'probe.http:notanint,lb.upstream:1')
+        faults.inject('probe.http')  # malformed spec ignored
+        with pytest.raises(faults.FaultInjected):
+            faults.inject('lb.upstream')
+
+    def test_unsetting_env_disarms(self, monkeypatch):
+        """A chaos drill ends when the operator unsets SKYTPU_FAULTS:
+        env-armed points must disarm, not persist to restart."""
+        monkeypatch.setenv('SKYTPU_FAULTS', 'probe.http:forever')
+        with pytest.raises(faults.FaultInjected):
+            faults.inject('probe.http')
+        monkeypatch.setenv('SKYTPU_FAULTS', '')
+        faults.inject('probe.http')  # disarmed
+        # Code-armed faults survive env changes.
+        faults.arm('lb.upstream', times=1)
+        monkeypatch.setenv('SKYTPU_FAULTS', 'checkpoint.save:1')
+        with pytest.raises(faults.FaultInjected):
+            faults.inject('lb.upstream')
+
+    def test_injection_counter(self):
+        before = obs.FAULTS_INJECTED.value(point='probe.http')
+        faults.arm('probe.http', times=1)
+        with pytest.raises(faults.FaultInjected):
+            faults.inject('probe.http')
+        assert obs.FAULTS_INJECTED.value(
+            point='probe.http') == before + 1
+
+    def test_catalog_is_populated(self):
+        points = faults.registered_points()
+        assert {'provision.launch', 'probe.http', 'lb.upstream',
+                'checkpoint.save', 'heartbeat.recv'} <= set(points)

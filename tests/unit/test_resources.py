@@ -115,6 +115,22 @@ class TestResources:
             Resources.from_yaml_config(
                 {'cloud': 'gcp', 'zone': 'us-central1-a'})
 
+    def test_copy_zone_inherits_region_from_infra(self):
+        """The spot placer's r.copy(zone=...) on a task pinned to
+        `infra: gcp/<region>` must keep the region."""
+        r = Resources(infra='gcp/us-central2')
+        z = r.copy(zone='us-central2-b')
+        assert (z.cloud, z.region, z.zone) == (
+            'gcp', 'us-central2', 'us-central2-b')
+
+    def test_copy_coarser_field_drops_finer_inherited(self):
+        r = Resources(infra='gcp/us-central1/us-central1-a')
+        moved = r.copy(region='us-west1')
+        assert (moved.region, moved.zone) == ('us-west1', None)
+        other_cloud = r.copy(cloud='aws')
+        assert (other_cloud.cloud, other_cloud.region,
+                other_cloud.zone) == ('aws', None, None)
+
     def test_any_of_expansion(self):
         r = Resources.from_yaml_config({
             'any_of': [{'infra': 'gcp', 'accelerators': 'tpu-v5e:8'},
