@@ -1,0 +1,30 @@
+"""skytpu-lint: AST-based static analysis as a CI gate.
+
+The repo proved mechanical enforcement twice before this package
+existed (the metrics-namespace and fault-point lint tests); this
+unifies them behind one checker plugin API and adds the checks that
+guard the ROADMAP's trace-correctness and concurrency refactors:
+
+  trace-safety      host effects / tracer coercions / closure mutation
+                    inside jax.jit / shard_map / lax control-flow
+  env-registry      every SKYTPU_* var declared once in
+                    skypilot_tpu/envs.py; env read at call time only
+  async-discipline  no blocking calls inside `async def`; no
+                    leak-prone bare asyncio.gather fan-outs
+  lock-discipline   shared module state mutated only under the
+                    module's lock
+  metrics-names     the skytpu_* metric naming/help/bucket contract
+  fault-points      the chaos-injection catalog contract
+
+CLI:  python -m skypilot_tpu.analysis [paths...]
+          --checks a,b --format text|json
+          --baseline PATH --write-baseline
+
+Pre-existing debt lives in a committed baseline file
+(.skytpu-lint-baseline.json) so the gate fails only on NEW findings;
+see docs/guides/static-analysis.md.
+"""
+from skypilot_tpu.analysis.core import (Checker, Finding, all_checkers,
+                                        register, run)
+
+__all__ = ['Checker', 'Finding', 'all_checkers', 'register', 'run']

@@ -1,0 +1,78 @@
+"""Committed-baseline handling: pre-existing debt must not block the
+gate, new findings must.
+
+The baseline maps content fingerprints (check|rule|path|source-line,
+no line numbers) to an allowed count. A finding is 'baselined' while
+occurrences of its fingerprint stay within that count; the excess —
+and any unknown fingerprint — is NEW and fails the gate. Fixing a
+baselined finding never breaks the gate (stale entries are just dead
+weight; `--write-baseline` prunes them).
+"""
+import collections
+import json
+import os
+from typing import Dict, List, Sequence, Tuple
+
+from skypilot_tpu.analysis.core import Finding
+
+DEFAULT_BASENAME = '.skytpu-lint-baseline.json'
+_VERSION = 1
+
+
+def default_path(root: str) -> str:
+    return os.path.join(root, DEFAULT_BASENAME)
+
+
+def load(path: str) -> Dict[str, Dict[str, object]]:
+    """fingerprint -> entry ({check, rule, path, snippet, count})."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding='utf-8') as f:
+        doc = json.load(f)
+    if doc.get('version') != _VERSION:
+        raise ValueError(
+            f'{path}: unsupported baseline version {doc.get("version")!r}')
+    entries = doc.get('entries', {})
+    if not isinstance(entries, dict):
+        raise ValueError(f'{path}: entries must be a mapping')
+    return entries
+
+
+def write(path: str, findings: Sequence[Finding]) -> None:
+    counts: Dict[str, int] = collections.Counter(
+        f.fingerprint() for f in findings)
+    entries = {}
+    for f in findings:
+        fp = f.fingerprint()
+        if fp in entries:
+            continue
+        entries[fp] = {
+            'check': f.check,
+            'rule': f.rule,
+            'path': f.path,
+            'snippet': f.snippet or f.message,
+            'count': counts[fp],
+        }
+    doc = {'version': _VERSION,
+           'entries': dict(sorted(entries.items()))}
+    with open(path, 'w', encoding='utf-8') as out:
+        json.dump(doc, out, indent=1, sort_keys=False)
+        out.write('\n')
+
+
+def partition(findings: Sequence[Finding],
+              entries: Dict[str, Dict[str, object]],
+              ) -> Tuple[List[Finding], List[Finding]]:
+    """Split into (new, baselined): each fingerprint absorbs up to its
+    baseline count, in file order; the rest is new."""
+    budget = {fp: int(e.get('count', 1)) for fp, e in entries.items()}
+    new: List[Finding] = []
+    baselined: List[Finding] = []
+    for f in findings:
+        fp = f.fingerprint()
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+            baselined.append(f)
+        else:
+            new.append(f)
+    return new, baselined

@@ -1,0 +1,125 @@
+"""async-discipline: the event loop must never block, tasks must not
+leak.
+
+  blocking-call   time.sleep / requests.* / urllib / sync sockets /
+                  subprocess / open() directly inside an `async def`
+                  body stalls EVERY in-flight request on that loop —
+                  on the serve planes that is every token stream
+                  behind the LB. Use asyncio.sleep, aiohttp, or
+                  asyncio.to_thread.
+  task-leak       `asyncio.gather(*<freshly created coroutines>)`
+                  without return_exceptions=True: when one coroutine
+                  raises, gather returns immediately but the SIBLING
+                  coroutines keep running detached — nothing holds a
+                  handle to cancel them (the openai_api _collect leak,
+                  ADVICE.md round 5). Either pass
+                  return_exceptions=True, or create named tasks first
+                  (asyncio.ensure_future/create_task) and cancel the
+                  survivors in the error path.
+
+Nested synchronous `def`s inside an async function are exempt from
+blocking-call: they run wherever they are called (often under
+to_thread / run_in_executor).
+"""
+import ast
+from typing import Iterable, List, Optional, Set
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis.core import Checker, Finding, register
+
+_BLOCKING_CALLS = {
+    'time.sleep',
+    'urllib.request.urlopen',
+    'socket.create_connection',
+    'subprocess.run', 'subprocess.call', 'subprocess.check_call',
+    'subprocess.check_output', 'subprocess.Popen',
+    'os.system', 'os.wait', 'os.waitpid',
+    'open',
+}
+_BLOCKING_PREFIXES = ('requests.',)
+
+
+def _blocking_name(node: ast.Call) -> Optional[str]:
+    name = core.dotted_name(node.func)
+    if name is None:
+        return None
+    if name in _BLOCKING_CALLS or name.startswith(_BLOCKING_PREFIXES):
+        return name
+    return None
+
+
+def _async_body_nodes(fn: ast.AsyncFunctionDef) -> Iterable[ast.AST]:
+    """Walk fn's body, skipping nested (a)sync function/lambda
+    subtrees — nested async defs are visited in their own right by the
+    outer loop; nested sync defs run off-loop."""
+    stack: List[ast.AST] = []
+    for stmt in fn.body:
+        stack.append(stmt)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_spawned_coroutine(arg: ast.AST) -> bool:
+    """True when a gather argument is a coroutine created in place —
+    the shapes that leave no cancellable handle behind: f(x),
+    *map(f, xs), *[f(x) for x in xs], *(f(x) for x in xs)."""
+    if isinstance(arg, ast.Starred):
+        inner = arg.value
+        return isinstance(inner, (ast.Call, ast.ListComp,
+                                  ast.GeneratorExp))
+    return isinstance(arg, (ast.Call, ast.Await))
+
+
+@register
+class AsyncDisciplineChecker(Checker):
+    name = 'async-discipline'
+    description = ('no blocking calls inside async def; no leak-prone '
+                   'bare asyncio.gather fan-outs')
+
+    def check_file(self, path: str, rel: str, tree: ast.AST,
+                   source: str) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        seen: Set[int] = set()
+
+        def emit(node: ast.AST, rule: str, message: str) -> None:
+            if (node.lineno, rule) in seen:
+                return
+            seen.add((node.lineno, rule))
+            findings.append(Finding(
+                check=self.name, rule=rule, path=rel,
+                line=node.lineno, message=message,
+                snippet=core.source_line(source, node.lineno)))
+
+        for fn in ast.walk(tree):
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            for node in _async_body_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                blocking = _blocking_name(node)
+                if blocking is not None:
+                    emit(node, 'blocking-call',
+                         f'{blocking}() blocks the event loop inside '
+                         f'async `{fn.name}` — every in-flight '
+                         'request on this loop stalls; use the async '
+                         'equivalent or asyncio.to_thread')
+                name = core.dotted_name(node.func)
+                if name in ('asyncio.gather', 'gather'):
+                    has_re = any(kw.arg == 'return_exceptions'
+                                 for kw in node.keywords)
+                    if not has_re and any(_is_spawned_coroutine(a)
+                                          for a in node.args):
+                        emit(node, 'task-leak',
+                             'asyncio.gather over in-place coroutines '
+                             'without return_exceptions=True: when '
+                             'one raises, the siblings keep running '
+                             'with no handle left to cancel them — '
+                             'create tasks first and cancel survivors '
+                             'on error, or pass '
+                             'return_exceptions=True')
+        return findings

@@ -1,0 +1,159 @@
+"""env-registry: every SKYTPU_* knob declared once, read at call time.
+
+Three rules:
+
+  undeclared        a 'SKYTPU_*' string literal that names no variable
+                    declared in skypilot_tpu/envs.py — knobs must be
+                    enumerable (docs, tooling) from ONE place.
+  import-time-read  any environment read executed at module scope.
+                    Controllers are spawned and tests set env vars
+                    after import; a module-level read freezes the
+                    default forever (the SKYTPU_JOBS_RETRY_GAP trap).
+  direct-read       os.environ/os.getenv with a SKYTPU_* literal
+                    outside envs.py — the registry owns parsing and
+                    defaults; ad-hoc reads reintroduce drift.
+
+Declared names come from importing skypilot_tpu.envs (the registry is
+the single source of truth, so the checker asks it, not a parallel
+AST parse that could diverge).
+"""
+import ast
+import re
+from typing import FrozenSet, Iterable, List, Optional, Set
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis.core import Checker, Finding, register
+
+_ENV_NAME_RE = re.compile(r'^SKYTPU_[A-Z0-9_]+$')
+_REGISTRY_REL = 'skypilot_tpu/envs.py'
+
+
+def _declared_names() -> FrozenSet[str]:
+    from skypilot_tpu import envs
+    return envs.declared_names()
+
+
+def _is_environ_read(node: ast.AST) -> Optional[ast.AST]:
+    """The env-name argument node if `node` reads the environment
+    (os.environ.get/os.getenv call, or os.environ[...] subscript in a
+    load context), else None."""
+    if isinstance(node, ast.Call):
+        name = core.dotted_name(node.func)
+        if name is None:
+            return None
+        if name.endswith('environ.get') or name.split('.')[-1] == \
+                'getenv':
+            return node.args[0] if node.args else node
+        return None
+    if isinstance(node, ast.Subscript) and isinstance(node.ctx,
+                                                      ast.Load):
+        name = core.dotted_name(node.value)
+        if name is not None and name.endswith('environ'):
+            return node.slice
+        return None
+    return None
+
+
+def _is_registry_read(node: ast.AST) -> bool:
+    """envs.SKYTPU_X.get(...) / .raw() / .is_set() call."""
+    if not isinstance(node, ast.Call):
+        return False
+    name = core.dotted_name(node.func)
+    if name is None:
+        return False
+    parts = name.split('.')
+    return (len(parts) >= 3 and parts[-1] in ('get', 'raw', 'is_set')
+            and _ENV_NAME_RE.fullmatch(parts[-2]) is not None)
+
+
+def _module_scope_nodes(tree: ast.AST) -> Iterable[ast.AST]:
+    """Every node reachable at import time: module-level statements
+    and class bodies, but not function/lambda BODIES. Decorator
+    expressions and parameter defaults DO execute at import — a read
+    frozen into `def f(gap=envs.X.get())` is exactly the trap this
+    rule exists for — so those subtrees are walked."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(tree))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            if not isinstance(node, ast.Lambda):
+                stack.extend(node.decorator_list)
+            stack.extend(node.args.defaults)
+            stack.extend(d for d in node.args.kw_defaults
+                         if d is not None)
+            continue  # the body itself is deferred to call time
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _docstring_linenos(tree: ast.AST) -> Set[int]:
+    """Line spans of docstrings (their SKYTPU_ mentions are prose)."""
+    out: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.FunctionDef,
+                             ast.AsyncFunctionDef, ast.ClassDef)):
+            body = node.body
+            if body and isinstance(body[0], ast.Expr) and isinstance(
+                    body[0].value, ast.Constant) and isinstance(
+                    body[0].value.value, str):
+                doc = body[0].value
+                end = getattr(doc, 'end_lineno', doc.lineno)
+                out.update(range(doc.lineno, end + 1))
+    return out
+
+
+@register
+class EnvRegistryChecker(Checker):
+    name = 'env-registry'
+    description = ('SKYTPU_* vars declared once in envs.py and read '
+                   'at call time through the registry')
+
+    def check_file(self, path: str, rel: str, tree: ast.AST,
+                   source: str) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        rel_posix = rel.replace('\\', '/')
+        in_registry = (rel_posix.endswith(_REGISTRY_REL)
+                       or rel_posix == 'envs.py')
+        declared = _declared_names()
+        doc_lines = _docstring_linenos(tree)
+
+        def emit(node: ast.AST, rule: str, message: str) -> None:
+            findings.append(Finding(
+                check=self.name, rule=rule, path=rel,
+                line=node.lineno, message=message,
+                snippet=core.source_line(source, node.lineno)))
+
+        # import-time-read: anything env-shaped at module scope.
+        for node in _module_scope_nodes(tree):
+            if _is_environ_read(node) is not None or \
+                    _is_registry_read(node):
+                emit(node, 'import-time-read',
+                     'environment read at import time freezes the '
+                     'value before controllers/tests can set it; '
+                     'read inside the function that uses it')
+
+        for node in ast.walk(tree):
+            # undeclared: exact SKYTPU_* literals must be registered.
+            if isinstance(node, ast.Constant) and isinstance(
+                    node.value, str) and _ENV_NAME_RE.fullmatch(
+                    node.value):
+                if in_registry or node.lineno in doc_lines:
+                    continue
+                if node.value not in declared:
+                    emit(node, 'undeclared',
+                         f'{node.value} is not declared in '
+                         'skypilot_tpu/envs.py; declare it (name, '
+                         'type, default, doc) before reading it')
+            # direct-read: SKYTPU literals must go through the
+            # registry, which owns parsing and defaults.
+            if not in_registry:
+                arg = _is_environ_read(node)
+                if arg is not None and isinstance(arg, ast.Constant) \
+                        and isinstance(arg.value, str) and \
+                        _ENV_NAME_RE.fullmatch(arg.value):
+                    emit(node, 'direct-read',
+                         f'read {arg.value} through '
+                         f'envs.{arg.value}.get() so parsing and '
+                         'defaults stay centralized')
+        return findings

@@ -1,0 +1,89 @@
+"""fault-points: the chaos-injection catalog contract, migrated from
+the bespoke tests/unit/test_fault_points_lint.py into a checker.
+
+Project-level: importing skypilot_tpu.resilience.faults registers the
+whole catalog; the rules assert naming/documentation over ALL of it —
+a typo'd point name would otherwise silently never fire, and an
+undocumented one is undiscoverable to chaos drills.
+test_fault_points_lint.py remains as a thin wrapper so the existing
+tier-1 test names survive.
+"""
+import os
+import re
+from typing import Iterable, List, Sequence
+
+from skypilot_tpu.analysis.core import Checker, Finding, register
+
+_CATALOG = 'skypilot_tpu/resilience/faults.py'
+_GUIDE = os.path.join('docs', 'guides', 'resilience.md')
+
+
+def findings_for_rule(rule: str, root: str) -> List[Finding]:
+    """All findings for one sub-rule (the thin test wrappers key off
+    this)."""
+    return [f for f in FaultPointsChecker().check_project(root, ())
+            if f.rule == rule]
+
+
+@register
+class FaultPointsChecker(Checker):
+    name = 'fault-points'
+    description = ('fault-injection point naming + guide '
+                   'documentation contract over the registered '
+                   'catalog')
+
+    def check_project(self, root: str,
+                      files: Sequence[str]) -> Iterable[Finding]:
+        from skypilot_tpu.resilience import faults
+
+        findings: List[Finding] = []
+
+        def emit(rule: str, message: str, path: str = _CATALOG) -> None:
+            findings.append(Finding(
+                check=self.name, rule=rule, path=path, line=0,
+                message=message, snippet=message))
+
+        points = faults.registered_points()
+        if len(points) < 5:
+            emit('catalog-present',
+                 f'fault-point catalog went missing ({len(points)} '
+                 'points registered; expected >= 5)')
+            return findings
+
+        for name, desc in points.items():
+            if not faults.POINT_RE.fullmatch(name):
+                emit('point-name',
+                     f'{name}: fault points are dotted '
+                     'plane.operation names')
+            if not desc or len(desc.strip()) < 10:
+                emit('point-description',
+                     f'{name}: describe the failure the point '
+                     'injects')
+
+        guide_path = os.path.join(root, _GUIDE)
+        try:
+            with open(guide_path, encoding='utf-8') as f:
+                text = f.read()
+        except OSError:
+            emit('point-documented',
+                 f'{_GUIDE} is missing; fault points must stay '
+                 'discoverable', path=_GUIDE.replace(os.sep, '/'))
+            return findings
+        for point in points:
+            if f'`{point}`' not in text:
+                emit('point-documented',
+                     f'{point} undocumented in {_GUIDE}; injection '
+                     'points stay discoverable as they spread')
+        table = re.findall(r'^\| `([a-z][a-z0-9_.]*)` \|', text,
+                           flags=re.MULTILINE)
+        if not table:
+            emit('doc-ghost', 'guide lost its fault-point table',
+                 path=_GUIDE.replace(os.sep, '/'))
+        else:
+            registered = set(points)
+            for p in table:
+                if '.' in p and p not in registered:
+                    emit('doc-ghost',
+                         f'guide documents unknown fault point {p}',
+                         path=_GUIDE.replace(os.sep, '/'))
+        return findings

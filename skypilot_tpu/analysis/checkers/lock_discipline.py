@@ -1,0 +1,148 @@
+"""lock-discipline: shared module state mutates only under the
+module's lock.
+
+Applies to modules that define a module-level threading.Lock/RLock
+(the serve/controller state pattern: serve_state.py, jobs/state.py,
+requests_db.py, state.py, ...). Two rules:
+
+  sqlite-write-outside-lock  .execute()/.executemany() with a literal
+                             write statement (INSERT/UPDATE/DELETE/
+                             REPLACE/CREATE/ALTER/DROP) lexically
+                             outside `with <lock>`. The connections are
+                             shared across the API server's threads;
+                             an unlocked write interleaves with
+                             another thread's write+commit pair.
+  global-write-outside-lock  a function rebinding module globals
+                             (`global x; x = ...`) outside
+                             `with <lock>`.
+
+Functions that rebind the lock itself are exempt: you cannot hold a
+lock you are replacing (the os.register_at_fork child handlers — the
+child is single-threaded by construction).
+"""
+import ast
+from typing import Iterable, List, Optional, Set
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis.core import Checker, Finding, register
+
+_WRITE_PREFIXES = ('INSERT', 'UPDATE', 'DELETE', 'REPLACE', 'CREATE',
+                   'ALTER', 'DROP')
+
+
+def _module_locks(tree: ast.Module) -> Set[str]:
+    """Module-level names bound to threading.Lock()/RLock()."""
+    locks: Set[str] = set()
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        value = node.value
+        if not isinstance(value, ast.Call):
+            continue
+        name = core.dotted_name(value.func)
+        if name is None or name.split('.')[-1] not in ('Lock', 'RLock'):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                locks.add(t.id)
+    return locks
+
+
+def _under_lock(node: ast.AST, locks: Set[str]) -> bool:
+    """Is `node` lexically inside `with <lock>` for a module lock
+    (directly, or via a local alias of self._lock-style attributes
+    whose terminal name is a module lock name)?"""
+    current = getattr(node, 'skytpu_parent', None)
+    while current is not None:
+        if isinstance(current, ast.With):
+            for item in current.items:
+                expr = item.context_expr
+                # with _lock:  /  with _lock, other:  /  with x._lock:
+                name = core.dotted_name(expr)
+                if name is not None and name.split('.')[-1] in locks:
+                    return True
+        if isinstance(current, (ast.FunctionDef,
+                                ast.AsyncFunctionDef)) and \
+                _is_locked_helper(current):
+            return True
+        current = getattr(current, 'skytpu_parent', None)
+    return False
+
+
+def _is_locked_helper(fn: ast.AST) -> bool:
+    """Helpers named *_locked declare (and document) that the caller
+    holds the lock — the convention serve_state/usage_lib already
+    use."""
+    return getattr(fn, 'name', '').endswith('_locked')
+
+
+@register
+class LockDisciplineChecker(Checker):
+    name = 'lock-discipline'
+    description = ('shared module state (sqlite writes, globals) '
+                   'mutated only under the module lock')
+
+    def check_file(self, path: str, rel: str, tree: ast.AST,
+                   source: str) -> Iterable[Finding]:
+        if not isinstance(tree, ast.Module):
+            return ()
+        locks = _module_locks(tree)
+        if not locks:
+            return ()
+        findings: List[Finding] = []
+
+        def emit(node: ast.AST, rule: str, message: str) -> None:
+            findings.append(Finding(
+                check=self.name, rule=rule, path=rel,
+                line=node.lineno, message=message,
+                snippet=core.source_line(source, node.lineno)))
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute) and node.func.attr in (
+                    'execute', 'executemany', 'executescript'):
+                sql = node.args[0] if node.args else None
+                if isinstance(sql, ast.Constant) and isinstance(
+                        sql.value, str) and sql.value.lstrip().upper(
+                        ).startswith(_WRITE_PREFIXES):
+                    if not _under_lock(node, locks):
+                        emit(node, 'sqlite-write-outside-lock',
+                             'sqlite write outside `with '
+                             f'{sorted(locks)[0]}`: the connection is '
+                             'shared across server threads, so an '
+                             'unlocked write interleaves with another '
+                             "thread's write+commit")
+
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            globals_declared: Set[str] = set()
+            for node in fn.body:
+                if isinstance(node, ast.Global):
+                    globals_declared.update(node.names)
+            if not globals_declared:
+                continue
+            if globals_declared & locks:
+                # Rebinding the lock itself (fork-child handlers):
+                # you cannot hold a lock you are replacing.
+                continue
+            stack: List[ast.AST] = list(fn.body)
+            while stack:
+                node = stack.pop()
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef,
+                                     ast.Lambda)):
+                    continue  # nested scope: its own global decls rule
+                stack.extend(ast.iter_child_nodes(node))
+                if isinstance(node, ast.Assign):
+                    hit = [n.id for t in node.targets
+                           for n in ast.walk(t)
+                           if isinstance(n, ast.Name)
+                           and n.id in globals_declared]
+                    if hit and not _under_lock(node, locks):
+                        emit(node, 'global-write-outside-lock',
+                             f'global `{hit[0]}` rebound outside '
+                             f'`with {sorted(locks)[0]}`; another '
+                             'thread can observe the torn update')
+        return findings

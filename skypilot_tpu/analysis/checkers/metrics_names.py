@@ -1,0 +1,105 @@
+"""metrics-names: the skytpu_* metric contract, migrated from the
+bespoke tests/unit/test_metrics_lint.py into a checker.
+
+Project-level (not AST): importing the instrument catalog registers
+every hot-path metric in the default registry; the rules then assert
+the naming/help/bucket contract over ALL of them, so a typo'd metric
+name breaks CI instead of silently producing a series no alert
+matches. test_metrics_lint.py remains as a thin wrapper so the
+existing tier-1 test names survive.
+"""
+import math
+import re
+from typing import Iterable, List, Sequence
+
+from skypilot_tpu.analysis.core import Checker, Finding, register
+
+_NAME_RE = re.compile(r'^skytpu_[a-z0-9_]+$')
+_LABEL_RE = re.compile(r'^[a-z_][a-z0-9_]*$')
+_CATALOG = 'skypilot_tpu/observability/instruments.py'
+
+
+def findings_for_rule(rule: str) -> List[Finding]:
+    """All findings for one sub-rule (the thin test wrappers key off
+    this)."""
+    return [f for f in MetricsNamesChecker().check_project('', ())
+            if f.rule == rule]
+
+
+@register
+class MetricsNamesChecker(Checker):
+    name = 'metrics-names'
+    description = ('skytpu_* metric naming/help/bucket/label contract '
+                   'over the registered instrument catalog')
+
+    def check_project(self, root: str,
+                      files: Sequence[str]) -> Iterable[Finding]:
+        from skypilot_tpu.observability import \
+            instruments  # noqa: F401 — registers the catalog
+        from skypilot_tpu.observability import metrics
+
+        findings: List[Finding] = []
+
+        def emit(rule: str, message: str) -> None:
+            findings.append(Finding(
+                check=self.name, rule=rule, path=_CATALOG, line=0,
+                message=message, snippet=message))
+
+        found = metrics.REGISTRY.metrics()
+        if len(found) < 20:
+            emit('catalog-present',
+                 f'instrument catalog went missing ({len(found)} '
+                 'metrics registered; expected >= 20)')
+            return findings
+
+        for m in found:
+            if not _NAME_RE.fullmatch(m.name):
+                emit('name-namespace',
+                     f'{m.name}: metric names are skytpu_[a-z0-9_]+')
+            if not (m.help and m.help.strip()) or \
+                    len(m.help.strip()) < 10:
+                emit('help-text',
+                     f'{m.name}: help strings are sentences, not '
+                     'stubs')
+            if isinstance(m, metrics.Counter):
+                if not m.name.endswith('_total'):
+                    emit('counter-suffix',
+                         f'{m.name}: Prometheus counters end in '
+                         '_total')
+            elif m.name.endswith('_total'):
+                emit('counter-suffix',
+                     f'{m.name}: _total is reserved for counters')
+            if isinstance(m, metrics.Histogram):
+                if not m.buckets:
+                    emit('histogram-buckets',
+                         f'{m.name}: histograms declare buckets')
+                elif list(m.buckets) != sorted(set(m.buckets)):
+                    emit('histogram-buckets',
+                         f'{m.name}: buckets must be strictly '
+                         'increasing')
+                elif any(b == math.inf for b in m.buckets):
+                    emit('histogram-buckets',
+                         f'{m.name}: +Inf bucket is implicit')
+                if not m.name.endswith('_seconds'):
+                    emit('histogram-buckets',
+                         f'{m.name}: our histograms measure latency; '
+                         'name the unit')
+            for label in m.labelnames:
+                if not _LABEL_RE.fullmatch(label) or label == 'le':
+                    emit('label-names',
+                         f'{m.name}.{label}: invalid or reserved '
+                         'label name')
+
+        text = metrics.REGISTRY.generate_text()
+        for line in text.strip().splitlines():
+            if line.startswith('#'):
+                if not re.match(
+                        r'^# (HELP|TYPE) skytpu_[a-z0-9_]+ ', line):
+                    emit('exposition', f'bad comment line: {line!r}')
+                continue
+            if not re.match(
+                    r'^skytpu_[a-z0-9_]+(\{[^{}]*\})? '
+                    r'([-+]?\d+(\.\d+)?([eE][-+]?\d+)?|\+Inf|-Inf|NaN)$',
+                    line):
+                emit('exposition', f'bad sample line: {line!r}')
+        return findings

@@ -1,0 +1,317 @@
+"""trace-safety: what must not happen inside jax-traced code.
+
+JAX runs the Python body of a jitted/shard_mapped/lax-control-flow
+function ONCE, at trace time, with abstract tracers. Three classes of
+bug follow, all silent until a recompile or a wrong number:
+
+  host-call         print/time/file/network I/O runs at trace time
+                    (once, not per step) or crashes under a tracer —
+                    either way it is not doing what the author meant.
+  tracer-coercion   .item()/.tolist()/float()/int()/np.asarray on a
+                    traced value forces a host sync (or a trace-time
+                    ConcretizationTypeError on data-dependent values).
+  closure-mutation  assigning through a closed-over/global name from
+                    inside traced code bakes the trace-time value in;
+                    the mutation happens once, not per call.
+
+Trace scopes are found statically: functions decorated with
+jax.jit/pjit (directly or via functools.partial), functions passed to
+jit/pjit/shard_map/vmap/pmap/grad, and bodies handed to
+lax.scan/while_loop/fori_loop/cond/switch. Parameters named in literal
+`static_argnames` are exempt from tracer-coercion (they are real
+Python values, not tracers).
+"""
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis.core import Checker, Finding, register
+
+# Terminal attribute names that mean "the callable argument(s) get
+# traced". Value: positional indices of the traced callables.
+_WRAPPERS: Dict[str, Tuple[int, ...]] = {
+    'jit': (0,),
+    'pjit': (0,),
+    'shard_map': (0,),
+    'vmap': (0,),
+    'pmap': (0,),
+    'grad': (0,),
+    'value_and_grad': (0,),
+    'remat': (0,),
+    'checkpoint': (0,),
+    'scan': (0,),
+    'while_loop': (0, 1),
+    'fori_loop': (2,),
+    'cond': (1, 2),
+    'switch': (1, 2, 3, 4, 5),
+}
+# Bare-name calls are ambiguous ('scan' could be anything); only these
+# are unmistakable without a jax/lax prefix.
+_BARE_WRAPPERS = {'jit', 'pjit', 'shard_map'}
+
+_HOST_CALLS = {
+    'print', 'input', 'breakpoint', 'open',
+    'time.time', 'time.sleep', 'time.monotonic', 'time.perf_counter',
+    'time.process_time',
+    'os.getenv', 'os.system', 'os.environ.get',
+    'urllib.request.urlopen', 'socket.create_connection',
+    'socket.socket', 'subprocess.run', 'subprocess.Popen',
+    'subprocess.check_output', 'subprocess.check_call',
+}
+_HOST_PREFIXES = ('requests.',)
+
+_COERCION_METHODS = {'item', 'tolist'}
+_COERCION_CALLS = {'float', 'int', 'bool', 'complex'}
+_NUMPY_COERCIONS = {'np.asarray', 'np.array', 'numpy.asarray',
+                    'numpy.array'}
+
+# NOTE: 'update' is deliberately absent — it is the name of optax's
+# PURE GradientTransformation.update (trainer step functions call it
+# on a closed-over transform), and dict.update through a closure is
+# caught by the assignment rule in practice.
+_MUTATING_METHODS = {'append', 'extend', 'insert', 'remove', 'pop',
+                     'clear', 'add', 'setdefault', 'popitem',
+                     'discard'}
+
+
+def _is_wrapper(func: ast.AST) -> Optional[Tuple[int, ...]]:
+    """Positional indices of traced callables if `func` is a jax
+    tracing wrapper, else None."""
+    name = core.dotted_name(func)
+    if name is None:
+        return None
+    parts = name.split('.')
+    leaf = parts[-1]
+    if leaf not in _WRAPPERS:
+        return None
+    if len(parts) == 1:
+        return _WRAPPERS[leaf] if leaf in _BARE_WRAPPERS else None
+    # Require a jax-ish qualifier: jax.jit, jax.lax.scan, lax.scan,
+    # jax.experimental.shard_map.shard_map ... but not self.scan().
+    if any(p in ('jax', 'lax', 'pjit', 'shard_map') for p in parts[:-1]):
+        return _WRAPPERS[leaf]
+    return None
+
+
+def _partial_wrapped(call: ast.Call) -> Optional[ast.Call]:
+    """functools.partial(jax.jit, ...) -> a synthetic view of the
+    inner wrapper call (so static_argnames kwargs are readable)."""
+    name = core.dotted_name(call.func)
+    if name not in ('functools.partial', 'partial'):
+        return None
+    if not call.args:
+        return None
+    inner = call.args[0]
+    if _is_wrapper(inner) is None:
+        return None
+    synthetic = ast.Call(func=inner, args=[], keywords=call.keywords)
+    return synthetic
+
+
+def _static_params(call: Optional[ast.Call]) -> Set[str]:
+    """Literal static_argnames from a jit call, best-effort."""
+    if call is None:
+        return set()
+    for kw in call.keywords:
+        if kw.arg != 'static_argnames':
+            continue
+        value = kw.value
+        if isinstance(value, ast.Constant) and isinstance(value.value,
+                                                         str):
+            return {value.value}
+        if isinstance(value, (ast.Tuple, ast.List)):
+            return {e.value for e in value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)}
+    return set()
+
+
+class _ScopeIndex:
+    """Map function/lambda nodes to the trace scopes they define."""
+
+    def __init__(self, tree: ast.AST) -> None:
+        self.by_name: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.by_name.setdefault(node.name, []).append(node)
+        # node -> static params for that trace entry
+        self.traced: Dict[ast.AST, Set[str]] = {}
+
+    def mark(self, target: ast.AST, static: Set[str]) -> None:
+        if isinstance(target, ast.Lambda) or isinstance(
+                target, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            prev = self.traced.get(target, set())
+            self.traced[target] = prev | static
+        elif isinstance(target, ast.Name):
+            for fn in self.by_name.get(target.id, []):
+                prev = self.traced.get(fn, set())
+                self.traced[fn] = prev | static
+
+
+def _collect_trace_scopes(tree: ast.AST) -> Dict[ast.AST, Set[str]]:
+    index = _ScopeIndex(tree)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                if _is_wrapper(deco) is not None:
+                    index.mark(node, set())
+                elif isinstance(deco, ast.Call):
+                    synthetic = _partial_wrapped(deco)
+                    if synthetic is not None:
+                        index.mark(node, _static_params(synthetic))
+                    elif _is_wrapper(deco.func) is not None:
+                        index.mark(node, _static_params(deco))
+        if isinstance(node, ast.Call):
+            indices = _is_wrapper(node.func)
+            if indices is None:
+                continue
+            static = _static_params(node)
+            for i in indices:
+                if i < len(node.args):
+                    index.mark(node.args[i], static)
+    return index.traced
+
+
+def _param_names(fn: ast.AST) -> Set[str]:
+    args = fn.args
+    names = {a.arg for a in args.args + args.kwonlyargs
+             + getattr(args, 'posonlyargs', [])}
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    return names
+
+
+def _bound_names(fn: ast.AST) -> Set[str]:
+    """Names bound anywhere inside `fn`: params, assignments, loop
+    targets, withitems, comprehension targets, nested def names."""
+    bound = _param_names(fn)
+
+    def visit_target(t: ast.AST) -> None:
+        # Only Store-context Names BIND: `cache[k] = v` mutates cache
+        # (Load on the base) without binding it.
+        for n in ast.walk(t):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                bound.add(n.id)
+
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            bound.add(node.name)
+            bound |= _param_names(node)
+        elif isinstance(node, ast.Lambda):
+            bound |= _param_names(node)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                visit_target(t)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign,
+                               ast.For, ast.AsyncFor)):
+            visit_target(node.target)
+        elif isinstance(node, (ast.withitem,)):
+            if node.optional_vars is not None:
+                visit_target(node.optional_vars)
+        elif isinstance(node, ast.comprehension):
+            visit_target(node.target)
+        elif isinstance(node, ast.ExceptHandler) and node.name:
+            bound.add(node.name)
+    return bound
+
+
+def _base_name(node: ast.AST) -> Optional[str]:
+    """Leftmost Name of a Subscript/Attribute chain."""
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+@register
+class TraceSafetyChecker(Checker):
+    name = 'trace-safety'
+    description = ('host effects, tracer-to-host coercions, and '
+                   'closure mutation inside jax-traced code')
+
+    def check_file(self, path: str, rel: str, tree: ast.AST,
+                   source: str) -> Iterable[Finding]:
+        traced = _collect_trace_scopes(tree)
+        findings: List[Finding] = []
+        seen: Set[Tuple[int, int, str]] = set()
+
+        def emit(node: ast.AST, rule: str, message: str) -> None:
+            key = (node.lineno, node.col_offset, rule)
+            if key in seen:
+                return
+            seen.add(key)
+            findings.append(Finding(
+                check=self.name, rule=rule, path=rel,
+                line=node.lineno, message=message,
+                snippet=core.source_line(source, node.lineno)))
+
+        for fn, static in traced.items():
+            params = _param_names(fn) - static
+            bound = _bound_names(fn)
+            fn_name = getattr(fn, 'name', '<lambda>')
+            for node in ast.walk(fn):
+                self._check_node(node, fn_name, params, bound, static,
+                                 emit)
+        return findings
+
+    def _check_node(self, node: ast.AST, fn_name: str,
+                    tracer_params: Set[str], bound: Set[str],
+                    static: Set[str], emit) -> None:
+        if isinstance(node, ast.Call):
+            name = core.dotted_name(node.func)
+            if name in _HOST_CALLS or (
+                    name and name.startswith(_HOST_PREFIXES)):
+                emit(node, 'host-call',
+                     f'{name}() inside traced `{fn_name}` runs at '
+                     'trace time (once), not per step — hoist it out '
+                     'of the traced function')
+            elif name in _NUMPY_COERCIONS:
+                args = node.args
+                if args and isinstance(args[0], ast.Name) and \
+                        args[0].id in tracer_params:
+                    emit(node, 'tracer-coercion',
+                         f'{name}({args[0].id}) forces the traced '
+                         'value to host; use jnp, or mark the arg '
+                         'static')
+            elif name in _COERCION_CALLS:
+                args = node.args
+                if len(args) == 1 and isinstance(args[0], ast.Name) \
+                        and args[0].id in tracer_params:
+                    emit(node, 'tracer-coercion',
+                         f'{name}({args[0].id}) on a traced value '
+                         'raises ConcretizationTypeError (or silently '
+                         'bakes in the trace-time value); mark the '
+                         'parameter static or keep it a jnp array')
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _COERCION_METHODS:
+                emit(node, 'tracer-coercion',
+                     f'.{node.func.attr}() inside traced `{fn_name}` '
+                     'forces a device->host sync per trace; return '
+                     'the array instead')
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _MUTATING_METHODS:
+                base = _base_name(node.func.value)
+                if base is not None and base not in bound:
+                    emit(node, 'closure-mutation',
+                         f'.{node.func.attr}() mutates closed-over '
+                         f'`{base}` inside traced `{fn_name}`; the '
+                         'mutation happens once at trace time')
+        elif isinstance(node, (ast.Global, ast.Nonlocal)):
+            emit(node, 'closure-mutation',
+                 f'{type(node).__name__.lower()} inside traced '
+                 f'`{fn_name}`: rebinding outer state from traced '
+                 'code happens at trace time, not per call')
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if isinstance(t, (ast.Subscript, ast.Attribute)):
+                    base = _base_name(t)
+                    if base is not None and base not in bound:
+                        emit(node, 'closure-mutation',
+                             f'assignment through closed-over '
+                             f'`{base}` inside traced `{fn_name}` '
+                             'is a trace-time effect')

@@ -1,0 +1,92 @@
+"""CLI for skytpu-lint: `python -m skypilot_tpu.analysis`.
+
+Exit codes: 0 clean (or baselined-only), 1 new findings, 2 usage
+error. `--write-baseline` accepts the current findings as debt (and
+prunes fixed entries); the gate then fails only on NEW findings.
+"""
+import argparse
+import json
+import sys
+from typing import List, Optional, Sequence
+
+from skypilot_tpu.analysis import baseline as baseline_lib
+from skypilot_tpu.analysis import core
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog='python -m skypilot_tpu.analysis',
+        description='skytpu-lint: AST-based static analysis CI gate.')
+    p.add_argument('paths', nargs='*',
+                   help='files/dirs to scan (default: skypilot_tpu/)')
+    p.add_argument('--checks',
+                   help='comma-separated checker names '
+                        '(default: all; see --list-checks)')
+    p.add_argument('--format', choices=('text', 'json'),
+                   default='text')
+    p.add_argument('--baseline',
+                   help='baseline file (default: '
+                        f'<repo>/{baseline_lib.DEFAULT_BASENAME})')
+    p.add_argument('--no-baseline', action='store_true',
+                   help='report every finding, baselined or not')
+    p.add_argument('--write-baseline', action='store_true',
+                   help='accept current findings as the new baseline')
+    p.add_argument('--list-checks', action='store_true')
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = _parser().parse_args(argv)
+    root = core.repo_root()
+
+    if args.list_checks:
+        for name, cls in sorted(core.all_checkers().items()):
+            print(f'{name:18s} {cls.description}')
+        return 0
+
+    checks = None
+    if args.checks:
+        checks = [c.strip() for c in args.checks.split(',')
+                  if c.strip()]
+    try:
+        findings, suppressed = core.run(paths=args.paths or None,
+                                        checks=checks, root=root)
+    except ValueError as e:
+        print(f'error: {e}', file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or baseline_lib.default_path(root)
+    if args.write_baseline:
+        baseline_lib.write(baseline_path, findings)
+        print(f'wrote {len(findings)} finding(s) to {baseline_path}')
+        return 0
+
+    try:
+        entries = {} if args.no_baseline else baseline_lib.load(
+            baseline_path)
+    except ValueError as e:  # covers json.JSONDecodeError
+        print(f'error: bad baseline {baseline_path}: {e}',
+              file=sys.stderr)
+        return 2
+    new, baselined = baseline_lib.partition(findings, entries)
+
+    if args.format == 'json':
+        print(json.dumps({
+            'new': [f.to_dict() for f in new],
+            'baselined': [f.to_dict() for f in baselined],
+            'suppressed_count': suppressed,
+            'checks': sorted(checks or core.all_checkers()),
+        }, indent=1))
+    else:
+        for f in new:
+            print(f'{f.location()}: [{f.check}/{f.rule}] {f.message}')
+            if f.snippet:
+                print(f'    {f.snippet}')
+        summary = (f'{len(new)} new finding(s), {len(baselined)} '
+                   f'baselined, {suppressed} inline-suppressed')
+        print(summary)
+    return 1 if new else 0
+
+
+if __name__ == '__main__':  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,182 @@
+"""Checker plugin API and file-walking runner for skytpu-lint.
+
+A checker sees each file's parsed AST once (`check_file`) and/or the
+whole project at the end (`check_project`, for contracts that live in
+runtime registries rather than syntax — metrics catalog, fault
+points). Findings are plain data; fingerprints are content-based
+(path + rule + source line, NOT line numbers) so the committed
+baseline survives unrelated edits above a finding.
+"""
+import ast
+import dataclasses
+import hashlib
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Type
+
+# Inline escape hatch: a finding whose source line carries
+# `skytpu-lint: ignore[<rule-or-check>, ...]` is suppressed. Use it for
+# the rare deliberate violation (e.g. fork handlers replacing a lock);
+# use the baseline for bulk pre-existing debt.
+SUPPRESS_MARKER = 'skytpu-lint: ignore['
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    check: str      # checker name, e.g. 'trace-safety'
+    rule: str       # sub-rule, e.g. 'host-call'
+    path: str       # repo-relative, forward slashes
+    line: int       # 1-based; 0 for project-level findings
+    message: str
+    snippet: str = ''   # stripped source line (fingerprint basis)
+
+    def fingerprint(self) -> str:
+        basis = '|'.join((self.check, self.rule, self.path,
+                          self.snippet or self.message))
+        return hashlib.sha1(basis.encode()).hexdigest()[:16]
+
+    def location(self) -> str:
+        return f'{self.path}:{self.line}' if self.line else self.path
+
+    def to_dict(self) -> Dict[str, object]:
+        d = dataclasses.asdict(self)
+        d['fingerprint'] = self.fingerprint()
+        return d
+
+
+class Checker:
+    """Base class. Subclasses set `name`/`description` and override
+    one or both hooks; `register` makes them CLI-selectable."""
+    name: str = ''
+    description: str = ''
+
+    def check_file(self, path: str, rel: str, tree: ast.AST,
+                   source: str) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, root: str,
+                      files: Sequence[str]) -> Iterable[Finding]:
+        return ()
+
+
+_CHECKERS: Dict[str, Type[Checker]] = {}
+
+
+def register(cls: Type[Checker]) -> Type[Checker]:
+    assert cls.name, cls
+    assert cls.name not in _CHECKERS, f'duplicate checker {cls.name}'
+    _CHECKERS[cls.name] = cls
+    return cls
+
+
+def all_checkers() -> Dict[str, Type[Checker]]:
+    """name -> checker class, importing the built-in set."""
+    from skypilot_tpu.analysis import checkers  # noqa: F401 — registers
+    return dict(_CHECKERS)
+
+
+def repo_root() -> str:
+    """The checkout root (parent of the skypilot_tpu package)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _iter_py_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith('.py'):
+                out.append(os.path.abspath(p))
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ('__pycache__', '.git')]
+            out.extend(os.path.join(os.path.abspath(dirpath), f)
+                       for f in sorted(filenames) if f.endswith('.py'))
+    return sorted(set(out))
+
+
+def _suppressed(finding: Finding, lines: Sequence[str]) -> bool:
+    if not (0 < finding.line <= len(lines)):
+        return False
+    line = lines[finding.line - 1]
+    start = line.find(SUPPRESS_MARKER)
+    if start < 0:
+        return False
+    start += len(SUPPRESS_MARKER)
+    end = line.find(']', start)
+    if end < 0:
+        return False
+    names = {n.strip() for n in line[start:end].split(',')}
+    return finding.rule in names or finding.check in names
+
+
+def annotate_parents(tree: ast.AST) -> None:
+    """Stamp every node with `.skytpu_parent` (checkers walk up for
+    with-lock / module-scope questions)."""
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child.skytpu_parent = node  # type: ignore[attr-defined]
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return '.'.join(reversed(parts))
+    return None
+
+
+def run(paths: Optional[Sequence[str]] = None,
+        checks: Optional[Sequence[str]] = None,
+        root: Optional[str] = None,
+        ) -> Tuple[List[Finding], int]:
+    """Run checkers over paths (default: skypilot_tpu/ under the repo
+    root). Returns (findings, suppressed_count); findings are sorted
+    and inline-suppressed ones already removed."""
+    root = root or repo_root()
+    if not paths:
+        paths = [os.path.join(root, 'skypilot_tpu')]
+    available = all_checkers()
+    if checks:
+        unknown = sorted(set(checks) - set(available))
+        if unknown:
+            raise ValueError(
+                f'unknown checks {unknown}; have {sorted(available)}')
+        selected = [available[c]() for c in checks]
+    else:
+        selected = [cls() for cls in available.values()]
+
+    files = _iter_py_files(paths)
+    findings: List[Finding] = []
+    suppressed = 0
+    for path in files:
+        try:
+            with open(path, encoding='utf-8') as f:
+                source = f.read()
+            tree = ast.parse(source, filename=path)
+        except (OSError, SyntaxError):
+            continue  # unparseable files are some other gate's problem
+        annotate_parents(tree)
+        rel = os.path.relpath(path, root).replace(os.sep, '/')
+        lines = source.splitlines()
+        for checker in selected:
+            for finding in checker.check_file(path, rel, tree, source):
+                if _suppressed(finding, lines):
+                    suppressed += 1
+                else:
+                    findings.append(finding)
+    for checker in selected:
+        findings.extend(checker.check_project(root, files))
+    findings.sort(key=lambda f: (f.path, f.line, f.check, f.rule))
+    return findings, suppressed
+
+
+def source_line(source: str, lineno: int) -> str:
+    lines = source.splitlines()
+    if 0 < lineno <= len(lines):
+        return lines[lineno - 1].strip()
+    return ''

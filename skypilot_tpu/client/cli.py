@@ -917,10 +917,10 @@ def api_login(endpoint, token, browser):
 @api.command('stop')
 def api_stop():
     """Stop the local API server (reference `sky api stop`)."""
-    import os as _os
     import signal as _signal
+    from skypilot_tpu import envs
     from skypilot_tpu.client import sdk
-    if _os.environ.get('SKYTPU_API_SERVER_URL'):
+    if envs.SKYTPU_API_SERVER_URL.is_set():
         raise click.ClickException(
             'Refusing to stop a remote API server '
             '(SKYTPU_API_SERVER_URL is set); unset it to manage the '

@@ -14,6 +14,7 @@ import urllib.parse
 import urllib.request
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu import envs
 from skypilot_tpu import exceptions
 from skypilot_tpu.server import app as server_app
 from skypilot_tpu.utils import paths
@@ -22,7 +23,7 @@ _API_PREFIX = server_app.API_PREFIX
 
 
 def api_server_url() -> str:
-    url = os.environ.get('SKYTPU_API_SERVER_URL')
+    url = envs.SKYTPU_API_SERVER_URL.get()
     if url:
         return url.rstrip('/')
     from skypilot_tpu import config as config_lib
@@ -34,7 +35,7 @@ def api_server_url() -> str:
 
 def api_token() -> Optional[str]:
     """Bearer token for the API server (env wins over config)."""
-    token = os.environ.get('SKYTPU_API_TOKEN')
+    token = envs.SKYTPU_API_TOKEN.get()
     if token:
         return token
     from skypilot_tpu import config as config_lib
@@ -101,7 +102,7 @@ def ensure_server_running(start_timeout: float = 30.0) -> None:
     @check_server_healthy_or_start, sky/server/common.py)."""
     if server_healthy():
         return
-    if os.environ.get('SKYTPU_API_SERVER_URL'):
+    if envs.SKYTPU_API_SERVER_URL.is_set():
         raise exceptions.ApiServerError(
             f'Configured API server {api_server_url()} is unreachable.')
     log_path = os.path.join(paths.client_logs_dir(), 'api_server.log')

@@ -19,9 +19,11 @@ from typing import Any, Dict, Iterator, Optional, Tuple
 
 import yaml
 
+from skypilot_tpu import envs
+
 USER_CONFIG_PATH = '~/.skytpu/config.yaml'
 PROJECT_CONFIG_PATH = '.skytpu.yaml'
-ENV_VAR_CONFIG = 'SKYTPU_CONFIG'
+ENV_VAR_CONFIG = envs.SKYTPU_CONFIG.name
 
 _local = threading.local()
 _cache_lock = threading.Lock()
@@ -64,7 +66,7 @@ def _load_file(path: str) -> Dict[str, Any]:
 
 def _layer_paths() -> Tuple[str, ...]:
     layers = [USER_CONFIG_PATH, PROJECT_CONFIG_PATH]
-    env_path = os.environ.get(ENV_VAR_CONFIG)
+    env_path = envs.SKYTPU_CONFIG.get()
     if env_path:
         layers.append(env_path)
     return tuple(os.path.abspath(os.path.expanduser(p))
@@ -94,7 +96,7 @@ def _base_config() -> Dict[str, Any]:
             merged: Dict[str, Any] = {}
             for layer in (USER_CONFIG_PATH, PROJECT_CONFIG_PATH):
                 merged = _deep_merge(merged, _load_file(layer))
-            env_path = os.environ.get(ENV_VAR_CONFIG)
+            env_path = envs.SKYTPU_CONFIG.get()
             if env_path:
                 merged = _deep_merge(merged, _load_file(env_path))
             _cached = merged

@@ -1,0 +1,287 @@
+"""Central registry of every SKYTPU_* environment variable.
+
+One declaration per knob — name, type, default, and a docstring — so
+the surface area of env-driven behavior is enumerable (docs, `tsky
+env` tooling, the static-analysis gate) instead of scattered across
+`os.environ.get` call sites with drifting defaults.
+
+Contract (enforced by `skypilot_tpu.analysis`'s env-registry checker):
+
+  * every `'SKYTPU_*'` string literal in the codebase must name a
+    variable declared here;
+  * values are read at CALL time, never at import time — controllers
+    are spawned and tests set env vars after modules load, so an
+    import-time read silently freezes the default (the trap that bit
+    SKYTPU_JOBS_RETRY_GAP before PR 2);
+  * reads go through `EnvVar.get()`, which parses by declared type and
+    falls back to the default on malformed values — a typo'd tuning
+    knob degrades to the default instead of crashing every import or
+    500ing every request.
+
+This module must stay dependency-free (stdlib only): it is imported by
+logging, paths, and config bootstrap code.
+"""
+import dataclasses
+import os
+from typing import Any, Dict, FrozenSet, Optional
+
+_FALSEY = ('0', 'false', 'no', 'off')
+_UNSET = object()
+
+_REGISTRY: Dict[str, 'EnvVar'] = {}
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvVar:
+    """One declared environment variable. `type` is one of str, int,
+    float, bool, or list (comma-separated values)."""
+    name: str
+    type: type
+    default: Any
+    doc: str
+
+    def raw(self) -> Optional[str]:
+        """The exact string in the environment (None when unset).
+        For save/restore dances; normal reads use get()."""
+        return os.environ.get(self.name)
+
+    def is_set(self) -> bool:
+        return bool(os.environ.get(self.name))
+
+    def get(self, default: Any = _UNSET, strict: bool = False) -> Any:
+        """Parse the variable by its declared type, at call time.
+
+        Unset or empty reads return the default (the declared one, or
+        the per-call override for knobs whose default differs by
+        plane). Malformed values also return the default: a typo'd
+        TUNING knob must never take down an import or a request path.
+        `strict=True` raises on malformed values instead — for
+        identity-contract vars (gang coordinates) where silently
+        falling back to a default (e.g. process_id=0 on two hosts)
+        corrupts the job rather than degrading it.
+        """
+        fallback = self.default if default is _UNSET else default
+        value = os.environ.get(self.name)
+        if value is None:
+            return fallback
+        if value == '':
+            # Set-but-empty is a distinct failure in strict mode: a
+            # templating bug (VAR=$rank with rank unset) must not
+            # silently collapse every host onto the default identity.
+            if strict:
+                raise ValueError(
+                    f'{self.name} is set but empty; expected a '
+                    f'{self.type.__name__}')
+            return fallback
+        if self.type is bool:
+            return value.strip().lower() not in _FALSEY
+        if self.type is list:
+            return [p.strip() for p in value.split(',') if p.strip()]
+        try:
+            return self.type(value)
+        except (TypeError, ValueError):
+            if strict:
+                raise ValueError(
+                    f'{self.name}={value!r} is not a valid '
+                    f'{self.type.__name__}') from None
+            return fallback
+
+
+def declare(name: str, type_: type, default: Any, doc: str) -> EnvVar:
+    """Register one variable. Names are unique and SKYTPU_-prefixed."""
+    if not name.startswith('SKYTPU_') or not name.isupper():
+        raise ValueError(f'env var {name!r} must be SKYTPU_UPPER_CASE')
+    if name in _REGISTRY:
+        raise ValueError(f'env var {name!r} declared twice')
+    if type_ not in (str, int, float, bool, list):
+        raise ValueError(f'{name}: unsupported type {type_!r}')
+    if not doc or len(doc.strip()) < 10:
+        raise ValueError(f'{name}: declare a real docstring')
+    var = EnvVar(name=name, type=type_, default=default, doc=doc)
+    _REGISTRY[name] = var
+    return var
+
+
+def declared() -> Dict[str, EnvVar]:
+    """Name -> EnvVar for every declared variable (a copy)."""
+    return dict(_REGISTRY)
+
+
+def declared_names() -> FrozenSet[str]:
+    return frozenset(_REGISTRY)
+
+
+# --- client / CLI -----------------------------------------------------------
+
+SKYTPU_API_SERVER_URL = declare(
+    'SKYTPU_API_SERVER_URL', str, None,
+    'Remote API server endpoint; unset means auto-start/use the local '
+    'server. Also inherited by executor workers so provisioned '
+    'clusters learn where to send heartbeats.')
+SKYTPU_API_TOKEN = declare(
+    'SKYTPU_API_TOKEN', str, None,
+    'Bearer token for the API server; wins over api_server.token in '
+    'config.')
+SKYTPU_CONFIG = declare(
+    'SKYTPU_CONFIG', str, None,
+    'Path of an extra config layer merged over user/project config.')
+SKYTPU_STATE_DIR = declare(
+    'SKYTPU_STATE_DIR', str, None,
+    'Client-side state root; defaults to ~/.skytpu.')
+SKYTPU_WORKSPACE = declare(
+    'SKYTPU_WORKSPACE', str, 'default',
+    'Workspace this request acts in (set by the API server from the '
+    'authenticated user).')
+SKYTPU_USER = declare(
+    'SKYTPU_USER', str, None,
+    'Acting username override; falls back to $USER.')
+SKYTPU_QUIET = declare(
+    'SKYTPU_QUIET', bool, False,
+    'Suppress interactive spinners/status output (scripting, CI).')
+
+# --- logging / diagnostics --------------------------------------------------
+
+SKYTPU_DEBUG = declare(
+    'SKYTPU_DEBUG', bool, False,
+    'Log everything at DEBUG.')
+SKYTPU_DEBUG_MODULES = declare(
+    'SKYTPU_DEBUG_MODULES', list, (),
+    'Comma-separated dotted-name fragments; matching modules log at '
+    'DEBUG.')
+SKYTPU_MINIMIZE_LOGGING = declare(
+    'SKYTPU_MINIMIZE_LOGGING', bool, False,
+    'WARNING+ only (scripting/CI).')
+SKYTPU_TIMELINE = declare(
+    'SKYTPU_TIMELINE', str, None,
+    'Path to write the chrome://tracing timeline to; unset disables '
+    'timeline recording.')
+
+# --- API server -------------------------------------------------------------
+
+SKYTPU_HEARTBEAT_URL = declare(
+    'SKYTPU_HEARTBEAT_URL', str, None,
+    'URL clusters should send liveness heartbeats to, when the bound '
+    'address is not reachable from them (e.g. behind ingress).')
+SKYTPU_WATCHDOG_INTERVAL = declare(
+    'SKYTPU_WATCHDOG_INTERVAL', float, 30.0,
+    'Seconds between watchdog checks (server state-dir watchdog; the '
+    'inference server parent-death watchdog overrides the default to '
+    '5s).')
+SKYTPU_CANCEL_GRACE_SECONDS = declare(
+    'SKYTPU_CANCEL_GRACE_SECONDS', float, 5.0,
+    'Cooperative-cancellation grace before a request worker is '
+    'SIGKILLed.')
+SKYTPU_BOOTSTRAP_ADMIN_TOKEN = declare(
+    'SKYTPU_BOOTSTRAP_ADMIN_TOKEN', str, None,
+    'Deployment bootstrap credential: a fresh install has exactly one '
+    'admin, who then creates real users over the API.')
+
+# --- inference --------------------------------------------------------------
+
+SKYTPU_MAX_QUEUE_DEPTH = declare(
+    'SKYTPU_MAX_QUEUE_DEPTH', int, 0,
+    'Inference-server load shedding: queue depth beyond which requests '
+    'get a fast 503 + Retry-After. 0/unset disables.')
+
+# --- serve plane ------------------------------------------------------------
+
+SKYTPU_SERVE_LOOP_INTERVAL = declare(
+    'SKYTPU_SERVE_LOOP_INTERVAL', float, 10.0,
+    'Seconds between serve-controller probe/autoscale/sync iterations.')
+SKYTPU_SERVE_LAUNCH_RETRY_GAP = declare(
+    'SKYTPU_SERVE_LAUNCH_RETRY_GAP', float, 10.0,
+    'Base backoff between replica launch retries.')
+SKYTPU_PROBE_BREAKER_RECOVERY = declare(
+    'SKYTPU_PROBE_BREAKER_RECOVERY', float, 30.0,
+    'Seconds an open probe circuit waits before a half-open retry.')
+
+# --- managed jobs -----------------------------------------------------------
+
+SKYTPU_JOBS_POLL_INTERVAL = declare(
+    'SKYTPU_JOBS_POLL_INTERVAL', float, 15.0,
+    'Seconds between managed-job controller poll iterations.')
+SKYTPU_JOBS_RETRY_GAP = declare(
+    'SKYTPU_JOBS_RETRY_GAP', float, 10.0,
+    'Base backoff between managed-job recovery launch attempts.')
+SKYTPU_JOBS_RECOVERY_DEADLINE = declare(
+    'SKYTPU_JOBS_RECOVERY_DEADLINE', float, None,
+    'Total seconds a managed-job recovery may keep retrying; unset '
+    'means no deadline.')
+SKYTPU_JOBS_MAX_CONCURRENT_LAUNCHES = declare(
+    'SKYTPU_JOBS_MAX_CONCURRENT_LAUNCHES', int, 8,
+    'Cap on managed-job controller processes in the launching phase.')
+
+# --- provisioning / execution ----------------------------------------------
+
+SKYTPU_RETRY_UNTIL_UP_GAP = declare(
+    'SKYTPU_RETRY_UNTIL_UP_GAP', float, 300.0,
+    'Seconds between full provision-failover rounds under '
+    '--retry-until-up.')
+
+# --- training ---------------------------------------------------------------
+
+SKYTPU_CKPT_RETRY_GAP = declare(
+    'SKYTPU_CKPT_RETRY_GAP', float, 2.0,
+    'Base backoff between checkpoint-save retries.')
+
+# --- usage telemetry --------------------------------------------------------
+
+SKYTPU_DISABLE_USAGE_COLLECTION = declare(
+    'SKYTPU_DISABLE_USAGE_COLLECTION', bool, False,
+    'Disable usage-event recording and shipping entirely.')
+SKYTPU_USAGE_ENDPOINT = declare(
+    'SKYTPU_USAGE_ENDPOINT', str, None,
+    'HTTP endpoint usage events POST to, best-effort; unset means '
+    'spool-only.')
+SKYTPU_USAGE_SPOOL_MAX_BYTES = declare(
+    'SKYTPU_USAGE_SPOOL_MAX_BYTES', int, 8 * 1024 * 1024,
+    'Spool size at which usage_events.jsonl rotates to one .1 '
+    'generation.')
+
+# --- resilience / chaos -----------------------------------------------------
+
+SKYTPU_FAULTS = declare(
+    'SKYTPU_FAULTS', str, '',
+    'Comma-separated fault-injection specs '
+    '(point[:times|forever[:latency]]), re-read at inject time.')
+
+# --- on-cluster runtime (the gang contract; injected per job process) -------
+
+SKYTPU_RUNTIME_DIR = declare(
+    'SKYTPU_RUNTIME_DIR', str, None,
+    'On-cluster runtime root; defaults to ~/.skytpu_runtime. The local '
+    'cloud gives every cluster its own runtime on one machine.')
+SKYTPU_NUM_NODES = declare(
+    'SKYTPU_NUM_NODES', int, 1,
+    'Injected into job processes: logical nodes (slices) in the gang.')
+SKYTPU_NODE_RANK = declare(
+    'SKYTPU_NODE_RANK', int, 0,
+    'Injected into job processes: this host\'s slice index.')
+SKYTPU_NODE_IPS = declare(
+    'SKYTPU_NODE_IPS', str, '',
+    'Injected into job processes: newline-separated head-host IPs.')
+SKYTPU_NUM_PROCESSES = declare(
+    'SKYTPU_NUM_PROCESSES', int, 1,
+    'Injected into job processes: total host processes in the gang.')
+SKYTPU_PROCESS_ID = declare(
+    'SKYTPU_PROCESS_ID', int, 0,
+    'Injected into job processes: global host index of this process.')
+SKYTPU_COORDINATOR_ADDR = declare(
+    'SKYTPU_COORDINATOR_ADDR', str, None,
+    'Injected into job processes: ip:port of process 0 for '
+    'jax.distributed.initialize.')
+SKYTPU_JOB_ID = declare(
+    'SKYTPU_JOB_ID', str, None,
+    'Injected into job processes: the cluster-local job id.')
+SKYTPU_CLUSTER_NAME = declare(
+    'SKYTPU_CLUSTER_NAME', str, None,
+    'Injected into job processes: name of the cluster running the job.')
+SKYTPU_ACCELERATORS_PER_NODE = declare(
+    'SKYTPU_ACCELERATORS_PER_NODE', int, 0,
+    'Injected into job processes: accelerator chips per logical node.')
+
+# --- test / dev -------------------------------------------------------------
+
+SKYTPU_SMOKE_REAL_GCP = declare(
+    'SKYTPU_SMOKE_REAL_GCP', bool, False,
+    'Opt smoke tests into touching real GCP with real credentials.')

@@ -8,10 +8,10 @@ provision_with_retries drives this inside the backend; ours splits it so
 the optimizer stays the single source of placement truth).
 """
 import enum
-import os
 import time
 from typing import List, Optional, Tuple
 
+from skypilot_tpu import envs
 from skypilot_tpu import dag as dag_lib
 from skypilot_tpu import exceptions
 from skypilot_tpu import optimizer as optimizer_lib
@@ -68,7 +68,7 @@ def launch(task_or_dag, *, cluster_name: str,
     reuse = (existing is not None and existing['handle'] is not None and
              existing['status'] == state.ClusterStatus.UP)
 
-    retry_gap = float(os.environ.get('SKYTPU_RETRY_UNTIL_UP_GAP', '300'))
+    retry_gap = envs.SKYTPU_RETRY_UNTIL_UP_GAP.get()
     handle = None
     while handle is None:
         blocked: List = list(blocked_resources or [])

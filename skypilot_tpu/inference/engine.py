@@ -905,6 +905,27 @@ class InferenceEngine:
             self._draft_params = dparams
             self._draft_config = dconfig
             prefill_interleave = 0
+        # Interleaving prefills one CHUNK per step via a chunk-wide
+        # dynamic_update_slice into the cache, and DecodeState only
+        # pads the cache to the chunk when prefill_chunk < max_seq_len
+        # (pad_to falls back to 1 otherwise). An explicit interleave
+        # threshold with an over-wide chunk would therefore die at
+        # trace time on the first long prompt — validate here, at
+        # construction, where the operator can see it.
+        eff_max_seq_len = max_seq_len or config.max_seq_len
+        if prefill_interleave > 0 and prefill_chunk >= eff_max_seq_len:
+            if explicit_interleave:
+                raise ValueError(
+                    f'prefill_interleave={prefill_interleave} needs '
+                    f'prefill_chunk ({prefill_chunk}) < max_seq_len '
+                    f'({eff_max_seq_len}): interleaved prefill writes '
+                    'chunk-wide slices into a cache padded to the '
+                    'chunk; lower prefill_chunk or drop '
+                    'prefill_interleave.')
+            # Implicit default: a chunk this wide one-shots every
+            # admissible prompt anyway — disable interleaving rather
+            # than trace a slice wider than the cache.
+            prefill_interleave = 0
         self.prefill_interleave = prefill_interleave
         self.state = DecodeState(config, batch_size, max_seq_len,
                                  mesh=mesh,

@@ -375,20 +375,33 @@ def add_openai_routes(app, holder: Dict[str, Any]) -> None:
             return await _stream(request, engine_loop, watchers,
                                  prompts, sampling, stops, tokenizer,
                                  rid, created, chat)
+        # Named tasks, not bare coroutines: when one _collect raises,
+        # gather returns immediately but the SIBLINGS keep waiting on
+        # their queues — and after abort() those queues never receive
+        # 'done', so bare coroutines would pend forever (one leaked
+        # task + queue per failed multi-choice request). Tasks leave a
+        # handle to cancel.
+        collectors = [asyncio.ensure_future(_collect(w))
+                      for w in watchers]
         try:
             with timeline.Event('openai.generate'):
-                outs = await asyncio.gather(*map(_collect, watchers))
+                outs = await asyncio.gather(*collectors)
         except RuntimeError as e:
             # One prompt failed: the 500 covers the whole request, so
-            # free the SIBLING slots too — gather leaves their
-            # _collect tasks running and they'd ghost-decode to
-            # max_tokens.
+            # free the SIBLING slots too and reap their collectors.
+            for c in collectors:
+                c.cancel()
             for w in watchers:
                 engine_loop.abort(w)
+            # Let the cancellations land so no task outlives the
+            # request (they finish synchronously on this loop).
+            await asyncio.gather(*collectors, return_exceptions=True)
             return web.json_response({'error': str(e)}, status=500)
         except asyncio.CancelledError:
             # Client gone: free the decode slots instead of letting
             # ghosts run to max_tokens.
+            for c in collectors:
+                c.cancel()
             for w in watchers:
                 engine_loop.abort(w)
             raise

@@ -28,6 +28,7 @@ import queue
 import threading
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu import envs
 from skypilot_tpu import sky_logging
 from skypilot_tpu.observability import instruments as obs
 from skypilot_tpu.observability import metrics as metrics_lib
@@ -174,12 +175,9 @@ def shed_limit(engine_holder: Dict[str, Any]) -> Optional[int]:
     0/unset disables."""
     limit = engine_holder.get('max_queue_depth')
     if limit is None:
-        try:
-            limit = int(os.environ.get('SKYTPU_MAX_QUEUE_DEPTH', '0'))
-        except ValueError:
-            # A typo'd env var must never 500 every request; shedding
-            # just stays off.
-            limit = 0
+        # Registry read: a typo'd env var falls back to the declared
+        # default (0 = shedding off) instead of 500ing every request.
+        limit = envs.SKYTPU_MAX_QUEUE_DEPTH.get()
     if limit and obs.QUEUE_DEPTH.value() >= limit:
         obs.REQUESTS_SHED.inc()
         return int(limit)
@@ -311,7 +309,7 @@ def _watch_parent() -> None:
     the thing that started them is gone."""
     import os
     import time
-    interval = float(os.environ.get('SKYTPU_WATCHDOG_INTERVAL', '5'))
+    interval = envs.SKYTPU_WATCHDOG_INTERVAL.get(default=5.0)
     original = os.getppid()
     if original == 1:
         # Launched by a PID-1 shell/init (container entrypoints): a

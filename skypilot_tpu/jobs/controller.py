@@ -13,6 +13,7 @@ import time
 import traceback
 from typing import Any, Dict, Optional
 
+from skypilot_tpu import envs
 from skypilot_tpu import exceptions
 from skypilot_tpu.jobs import recovery_strategy
 from skypilot_tpu.jobs import state as jobs_state
@@ -20,8 +21,10 @@ from skypilot_tpu.skylet import job_lib
 
 logger = logging.getLogger(__name__)
 
-_POLL_INTERVAL_SECONDS = float(
-    os.environ.get('SKYTPU_JOBS_POLL_INTERVAL', '15'))
+def _poll_interval_seconds() -> float:
+    """Read at call time: tests and operators tune the poll cadence
+    after this module is imported."""
+    return envs.SKYTPU_JOBS_POLL_INTERVAL.get()
 
 
 class JobsController:
@@ -233,7 +236,7 @@ class JobsController:
                 jobs_state.set_status(self.job_id,
                                       jobs_state.ManagedJobStatus.CANCELLED)
                 return False
-            time.sleep(_POLL_INTERVAL_SECONDS)
+            time.sleep(_poll_interval_seconds())
 
     def _cancel_cluster_job(self, cluster_job_id: int) -> None:
         from skypilot_tpu import core

@@ -14,11 +14,11 @@ every recovering job at the same regional API at once) bounded by BOTH
 an attempt count and a total recovery deadline — time-to-give-up is
 what the operator actually cares about, not attempt arithmetic.
 """
-import os
 import time
 from typing import Callable, Optional
 
 from skypilot_tpu import exceptions
+from skypilot_tpu import envs
 from skypilot_tpu.resilience import faults
 from skypilot_tpu.resilience import retries
 from skypilot_tpu.utils import registry
@@ -30,12 +30,11 @@ DEFAULT_STRATEGY = 'EAGER_NEXT_REGION'
 def _retry_gap_seconds() -> float:
     """Read at call time, never import time: controllers are spawned
     and tests set SKYTPU_JOBS_RETRY_GAP after this module loads."""
-    return float(os.environ.get('SKYTPU_JOBS_RETRY_GAP', '10'))
+    return envs.SKYTPU_JOBS_RETRY_GAP.get()
 
 
 def _recovery_deadline_seconds() -> Optional[float]:
-    raw = os.environ.get('SKYTPU_JOBS_RECOVERY_DEADLINE', '')
-    return float(raw) if raw else None
+    return envs.SKYTPU_JOBS_RECOVERY_DEADLINE.get()
 
 
 class StrategyExecutor:

@@ -10,10 +10,13 @@ import subprocess
 import sys
 from typing import Optional
 
+from skypilot_tpu import envs
 from skypilot_tpu.jobs import state as jobs_state
 
-_MAX_CONCURRENT_LAUNCHES = int(
-    os.environ.get('SKYTPU_JOBS_MAX_CONCURRENT_LAUNCHES', '8'))
+def _max_concurrent_launches() -> int:
+    """Read at call time: the cap is an operator knob, tunable on a
+    live server without restarting it."""
+    return envs.SKYTPU_JOBS_MAX_CONCURRENT_LAUNCHES.get()
 
 
 def _start_controller(job_id: int, resume: bool = False) -> None:
@@ -108,7 +111,7 @@ def maybe_schedule_next_jobs() -> int:
     started = 0
     in_flight = jobs_state.num_launching_jobs()
     for job in jobs_state.get_jobs([jobs_state.ManagedJobStatus.PENDING]):
-        if in_flight >= _MAX_CONCURRENT_LAUNCHES:
+        if in_flight >= _max_concurrent_launches():
             break
         if not jobs_state.try_claim_pending(job['job_id']):
             continue  # another process claimed it

@@ -341,6 +341,13 @@ class Registry:
                     f'duplicate metric name {metric.name!r}')
             self._metrics[metric.name] = metric
 
+    def unregister(self, metric: Metric) -> None:
+        """Remove one metric (lint/test fixtures that must not leave a
+        deliberately bad metric behind); unknown metrics are a no-op."""
+        with self._lock:
+            if self._metrics.get(metric.name) is metric:
+                del self._metrics[metric.name]
+
     def metrics(self) -> List[Metric]:
         with self._lock:
             return [self._metrics[k] for k in sorted(self._metrics)]

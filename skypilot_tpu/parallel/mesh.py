@@ -198,14 +198,17 @@ def initialize_distributed(coordinator: Optional[str] = None,
     False (no-op) for single-process jobs so the same program runs
     unmodified on one host.
     """
-    from skypilot_tpu.skylet import constants
+    from skypilot_tpu import envs
 
-    coordinator = coordinator or os.environ.get(constants.ENV_COORDINATOR)
+    coordinator = coordinator or envs.SKYTPU_COORDINATOR_ADDR.get()
+    # strict: these are the gang IDENTITY contract, not tuning knobs —
+    # a corrupted SKYTPU_PROCESS_ID silently parsing to the default 0
+    # would put two hosts at process_id=0 (hung rendezvous) or run a
+    # multi-host job un-distributed (wrong results). Fail loud.
     if num_processes is None:
-        num_processes = int(
-            os.environ.get(constants.ENV_NUM_PROCESSES, '1'))
+        num_processes = envs.SKYTPU_NUM_PROCESSES.get(strict=True)
     if process_id is None:
-        process_id = int(os.environ.get(constants.ENV_PROCESS_ID, '0'))
+        process_id = envs.SKYTPU_PROCESS_ID.get(strict=True)
     if num_processes <= 1 or not coordinator:
         return False
     import jax

@@ -16,6 +16,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from skypilot_tpu import exceptions
 from skypilot_tpu import provision
+from skypilot_tpu import envs
 from skypilot_tpu.provision import common
 from skypilot_tpu.resilience import faults
 # Aliased: setup_runtime_dependencies has a `retries` parameter.
@@ -140,7 +141,7 @@ def build_topology(cluster_name: str, cluster_info: common.ClusterInfo,
     # deployments where clusters reach the server through ingress.
     from skypilot_tpu import config as config_lib
     hb_url = config_lib.get_nested(('heartbeat', 'url'),
-                                   os.environ.get('SKYTPU_API_SERVER_URL'))
+                                   envs.SKYTPU_API_SERVER_URL.get())
     if hb_url:
         topology['heartbeat'] = {'url': hb_url}
     return topology

@@ -29,13 +29,13 @@ still takes effect — no import-order trap):
 Env grammar: comma-separated `point[:times[:latency_seconds]]` where
 times is an int or `forever`. Env-armed faults raise FaultInjected.
 """
-import os
 import re
 import threading
 import time
 from typing import Callable, Dict, List, Optional
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu import envs
 from skypilot_tpu.observability import instruments as obs
 
 logger = sky_logging.init_logger(__name__)
@@ -160,7 +160,7 @@ def _load_env_locked() -> None:
     inject time, never cached at import (the import-time-env trap that
     bit SKYTPU_JOBS_RETRY_GAP)."""
     global _env_cache_raw
-    raw = os.environ.get('SKYTPU_FAULTS', '')
+    raw = envs.SKYTPU_FAULTS.get()
     if raw == _env_cache_raw:
         return
     _env_cache_raw = raw

@@ -12,6 +12,7 @@ import os
 import time
 import traceback
 
+from skypilot_tpu import envs
 from skypilot_tpu.observability import instruments as obs
 from skypilot_tpu.serve import autoscalers
 from skypilot_tpu.serve import load_balancer as lb_lib
@@ -21,8 +22,10 @@ from skypilot_tpu.serve import service_spec as spec_lib
 
 logger = logging.getLogger(__name__)
 
-_LOOP_INTERVAL_SECONDS = float(
-    os.environ.get('SKYTPU_SERVE_LOOP_INTERVAL', '10'))
+def _loop_interval_seconds() -> float:
+    """Read at call time: controllers are spawned as fresh processes
+    and tests tune the cadence after import."""
+    return envs.SKYTPU_SERVE_LOOP_INTERVAL.get()
 
 
 def _pick_victims(pool, n, protected=frozenset()):
@@ -62,7 +65,7 @@ class ServeController:
             self.manager.scale_up(self.spec.min_replicas)
             while not self._stop:
                 self._step()
-                time.sleep(_LOOP_INTERVAL_SECONDS)
+                time.sleep(_loop_interval_seconds())
         except BaseException:  # noqa: BLE001
             traceback.print_exc()
             serve_state.set_service_status(

@@ -6,7 +6,6 @@ through the normal stack (optimizer -> provision -> gang run), so TPU
 replicas get slice semantics (preempted -> terminate+relaunch) for free.
 """
 import logging
-import os
 import threading
 import time
 import urllib.error
@@ -14,6 +13,7 @@ import urllib.request
 from typing import Dict, List, NamedTuple, Optional
 
 from skypilot_tpu import exceptions
+from skypilot_tpu import envs
 from skypilot_tpu.resilience import circuit
 from skypilot_tpu.resilience import faults
 from skypilot_tpu.resilience import retries
@@ -59,8 +59,7 @@ class ReplicaManager:
         self._probe_breaker = circuit.CircuitBreaker(
             'probe',
             failure_threshold=max(1, _MAX_CONSECUTIVE_FAILURES - 1),
-            recovery_timeout=float(
-                os.environ.get('SKYTPU_PROBE_BREAKER_RECOVERY', '30')))
+            recovery_timeout=envs.SKYTPU_PROBE_BREAKER_RECOVERY.get())
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -113,8 +112,7 @@ class ReplicaManager:
 
             # Transient capacity/setup errors retry under the shared
             # policy; anything else fails the replica immediately.
-            gap = float(os.environ.get('SKYTPU_SERVE_LAUNCH_RETRY_GAP',
-                                       '10'))
+            gap = envs.SKYTPU_SERVE_LAUNCH_RETRY_GAP.get()
             retries.call(
                 _launch_once,
                 policy=retries.RetryPolicy(max_attempts=3,

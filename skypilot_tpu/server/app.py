@@ -14,6 +14,7 @@ import os
 from typing import Any, Dict
 
 import skypilot_tpu
+from skypilot_tpu import envs
 from skypilot_tpu.observability import instruments as obs
 from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.server import auth
@@ -87,6 +88,16 @@ async def _handle_cancel_request(request):
     return _json_response({'cancelled': ok})
 
 
+def _read_log_from(log_path: str, pos: int) -> bytes:
+    """Sync log read, run off-loop via asyncio.to_thread."""
+    try:
+        with open(log_path, 'rb') as f:
+            f.seek(pos)
+            return f.read()
+    except FileNotFoundError:
+        return b''
+
+
 async def _handle_stream(request):
     """Chunked-stream a request's log until it reaches a terminal state.
 
@@ -105,12 +116,10 @@ async def _handle_stream(request):
     log_path = requests_db.request_log_path(request_id)
     pos = 0
     while True:
-        try:
-            with open(log_path, 'rb') as f:
-                f.seek(pos)
-                chunk = f.read()
-        except FileNotFoundError:
-            chunk = b''
+        # to_thread: a log read on NFS/FUSE-backed state dirs can
+        # stall for seconds, and this loop runs on the loop serving
+        # every other client.
+        chunk = await asyncio.to_thread(_read_log_from, log_path, pos)
         if chunk:
             pos += len(chunk)
             await resp.write(chunk)
@@ -118,9 +127,8 @@ async def _handle_stream(request):
         if not follow or record is None or record['status'].is_terminal:
             if follow and record is not None:
                 # Drain anything written between read and status check.
-                with open(log_path, 'rb') as f:
-                    f.seek(pos)
-                    tail_chunk = f.read()
+                tail_chunk = await asyncio.to_thread(
+                    _read_log_from, log_path, pos)
                 if tail_chunk:
                     await resp.write(tail_chunk)
             break
@@ -602,7 +610,7 @@ async def _state_dir_watchdog(app):
     from skypilot_tpu.utils import paths
 
     state_dir = paths.state_dir()
-    interval = float(os.environ.get('SKYTPU_WATCHDOG_INTERVAL', '30'))
+    interval = envs.SKYTPU_WATCHDOG_INTERVAL.get()
 
     async def _watch():
         while True:
@@ -685,7 +693,7 @@ def _advertise_url(host: str, port: int) -> None:
     forked executor workers, which inherit this env) can hand it to
     clusters for heartbeats. SKYTPU_HEARTBEAT_URL overrides when the
     bound address isn't what clusters can reach (e.g. behind ingress)."""
-    advertised = os.environ.get('SKYTPU_HEARTBEAT_URL')
+    advertised = envs.SKYTPU_HEARTBEAT_URL.get()
     if not advertised:
         if host in ('0.0.0.0', '::'):
             # A wildcard bind means remote clusters exist that can't
@@ -715,7 +723,7 @@ class ServerThread:
         self._loop = None
         self._runner = None
         self._thread = None
-        self._prev_advertised = os.environ.get('SKYTPU_API_SERVER_URL')
+        self._prev_advertised = envs.SKYTPU_API_SERVER_URL.raw()
 
     def __enter__(self) -> 'ServerThread':
         import threading

@@ -14,6 +14,7 @@ import threading
 import traceback
 from typing import Any, Callable, Dict, Optional
 
+from skypilot_tpu import envs
 from skypilot_tpu.server import requests_db
 
 # name -> callable(payload) -> JSON-able result. Populated by impl.py.
@@ -21,9 +22,6 @@ REGISTRY: Dict[str, Callable[[Dict[str, Any]], Any]] = {}
 
 # Parallelism caps (reference sizes these by host memory; executor.py:588).
 _MAX_PARALLEL = {'long': 4, 'short': 16}
-# Cooperative-cancellation grace before SIGKILL.
-_CANCEL_GRACE_SECONDS = float(os.environ.get(
-    'SKYTPU_CANCEL_GRACE_SECONDS', '5'))
 
 _mp_fork = multiprocessing.get_context('fork')
 _mp_spawn = multiprocessing.get_context('spawn')
@@ -163,7 +161,9 @@ class Executor:
                     pass
 
             def _escalate(p=proc):
-                p.join(timeout=_CANCEL_GRACE_SECONDS)
+                # Cooperative-cancellation grace before SIGKILL;
+                # read at call time so operators can tune it live.
+                p.join(timeout=envs.SKYTPU_CANCEL_GRACE_SECONDS.get())
                 if not p.is_alive() or not p.pid:
                     return
                 try:

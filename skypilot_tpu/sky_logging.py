@@ -12,11 +12,11 @@ Env vars:
     SKYTPU_MINIMIZE_LOGGING=1       WARNING+ only (scripting/CI)
 """
 import logging
-import os
 import sys
 import threading
 from typing import Optional
 
+from skypilot_tpu import envs
 from skypilot_tpu.observability import tracing
 
 _FORMAT = ('%(levelname).1s %(asctime)s %(name)s:%(lineno)d]'
@@ -41,17 +41,15 @@ _root_initialized = False
 
 
 def _debug_all() -> bool:
-    return os.environ.get('SKYTPU_DEBUG', '').lower() in ('1', 'true')
+    return envs.SKYTPU_DEBUG.get()
 
 
 def _debug_fragments():
-    raw = os.environ.get('SKYTPU_DEBUG_MODULES', '')
-    return [f.strip() for f in raw.split(',') if f.strip()]
+    return envs.SKYTPU_DEBUG_MODULES.get()
 
 
 def _minimized() -> bool:
-    return os.environ.get('SKYTPU_MINIMIZE_LOGGING', '').lower() in (
-        '1', 'true')
+    return envs.SKYTPU_MINIMIZE_LOGGING.get()
 
 
 def _level_for(name: str) -> int:

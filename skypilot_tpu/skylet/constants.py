@@ -7,20 +7,25 @@ runtime on one machine.
 """
 import os
 
+from skypilot_tpu import envs
+
 DEFAULT_RUNTIME_DIR = '~/.skytpu_runtime'
-RUNTIME_DIR_ENV_VAR = 'SKYTPU_RUNTIME_DIR'
+RUNTIME_DIR_ENV_VAR = envs.SKYTPU_RUNTIME_DIR.name
 
 # Env vars injected into every job process (the reference's SKYPILOT_NODE_*
 # contract, cloud_vm_ray_backend.py:606-670, re-spelled for jax).
-ENV_NUM_NODES = 'SKYTPU_NUM_NODES'            # logical nodes (slices)
-ENV_NODE_RANK = 'SKYTPU_NODE_RANK'            # this host's slice index
-ENV_NODE_IPS = 'SKYTPU_NODE_IPS'              # newline-sep head-host IPs
-ENV_NUM_PROCESSES = 'SKYTPU_NUM_PROCESSES'    # total host processes
-ENV_PROCESS_ID = 'SKYTPU_PROCESS_ID'          # global host index
-ENV_COORDINATOR = 'SKYTPU_COORDINATOR_ADDR'   # ip:port of process 0
-ENV_JOB_ID = 'SKYTPU_JOB_ID'
-ENV_CLUSTER_NAME = 'SKYTPU_CLUSTER_NAME'
-ENV_ACCELERATORS_PER_NODE = 'SKYTPU_ACCELERATORS_PER_NODE'
+# Derived from the central registry (envs.py, stdlib-only): the gang
+# WRITERS (skylet/gang.py, job_lib.py) and READERS (parallel/mesh.py)
+# share one source of truth for the names.
+ENV_NUM_NODES = envs.SKYTPU_NUM_NODES.name    # logical nodes (slices)
+ENV_NODE_RANK = envs.SKYTPU_NODE_RANK.name    # this host's slice index
+ENV_NODE_IPS = envs.SKYTPU_NODE_IPS.name      # newline-sep head-host IPs
+ENV_NUM_PROCESSES = envs.SKYTPU_NUM_PROCESSES.name  # total host procs
+ENV_PROCESS_ID = envs.SKYTPU_PROCESS_ID.name  # global host index
+ENV_COORDINATOR = envs.SKYTPU_COORDINATOR_ADDR.name  # ip:port of proc 0
+ENV_JOB_ID = envs.SKYTPU_JOB_ID.name
+ENV_CLUSTER_NAME = envs.SKYTPU_CLUSTER_NAME.name
+ENV_ACCELERATORS_PER_NODE = envs.SKYTPU_ACCELERATORS_PER_NODE.name
 
 # jax.distributed / multi-slice (DCN) coordinates. Within one slice libtpu
 # does its own ICI rendezvous; across slices (one logical node == one
@@ -38,8 +43,9 @@ SKYLET_DAEMON_INTERVAL_SECONDS = 20
 
 
 def runtime_dir() -> str:
-    d = os.environ.get(RUNTIME_DIR_ENV_VAR,
-                       os.path.expanduser(DEFAULT_RUNTIME_DIR))
+    from skypilot_tpu import envs
+    d = envs.SKYTPU_RUNTIME_DIR.get() or \
+        os.path.expanduser(DEFAULT_RUNTIME_DIR)
     os.makedirs(d, exist_ok=True)
     return d
 

@@ -13,6 +13,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu import envs
 from skypilot_tpu.utils import paths
 
 # Reentrant: sibling stores hold write_lock() around their
@@ -50,7 +51,7 @@ def _get_conn() -> sqlite3.Connection:
             _conn = sqlite3.connect(path, check_same_thread=False,
                                     timeout=30.0)
             _conn.execute('PRAGMA journal_mode=WAL')
-            _create_tables(_conn)
+            _create_tables_locked(_conn)
             _conn_path = path
         return _conn
 
@@ -111,7 +112,9 @@ def reset_for_tests() -> None:
         _conn_path = None
 
 
-def _create_tables(conn: sqlite3.Connection) -> None:
+def _create_tables_locked(conn: sqlite3.Connection) -> None:
+    """Caller holds `_lock` (_get_conn does): DDL + migrations
+    write on the shared connection."""
     conn.execute("""
         CREATE TABLE IF NOT EXISTS clusters (
             name TEXT PRIMARY KEY,
@@ -206,7 +209,7 @@ def add_or_update_cluster(cluster_name: str, handle: Any,
             (cluster_name, launched_at, pickle.dumps(handle),
              str(int(now)), status.value,
              json.dumps(autostop) if autostop else None,
-             os.environ.get('SKYTPU_USER') or os.environ.get(
+             envs.SKYTPU_USER.get() or os.environ.get(
                  'USER', 'unknown'),
              active_workspace(), cluster_hash,
              requested_resources_str, num_nodes, 0, epoch))
@@ -285,7 +288,7 @@ def remove_cluster(cluster_name: str, terminate: bool) -> None:
 def active_workspace() -> str:
     """The workspace this request acts in (set by the API server from
     the authenticated user; 'default' in open local mode)."""
-    return os.environ.get('SKYTPU_WORKSPACE', 'default')
+    return envs.SKYTPU_WORKSPACE.get()
 
 
 def _row_to_record(row) -> Dict[str, Any]:

@@ -10,12 +10,12 @@ managed jobs relaunch this program; it finds the latest checkpoint in
 from __future__ import annotations
 
 import argparse
-import os
 import time
 from typing import Any, Callable, Dict, Optional
 
 import jax
 
+from skypilot_tpu import envs
 from skypilot_tpu.observability import instruments as obs
 from skypilot_tpu.parallel import mesh as mesh_lib
 from skypilot_tpu.resilience import retries
@@ -33,8 +33,7 @@ def _save_with_retries(checkpoint_dir: str, state: Dict[str, Any],
                                              step=step),
         policy=retries.RetryPolicy(
             max_attempts=3,
-            base_delay=float(
-                os.environ.get('SKYTPU_CKPT_RETRY_GAP', '2')),
+            base_delay=envs.SKYTPU_CKPT_RETRY_GAP.get(),
             max_delay=30.0),
         retry_on=(Exception,),
         describe=f'checkpoint save step {step}')

@@ -14,11 +14,10 @@ import time
 import urllib.request
 from typing import Any, Dict, Optional
 
+from skypilot_tpu import envs
 from skypilot_tpu.utils import common_utils
 from skypilot_tpu.utils import paths
 
-_ENDPOINT_ENV = 'SKYTPU_USAGE_ENDPOINT'
-_DISABLE_ENV = 'SKYTPU_DISABLE_USAGE_COLLECTION'
 _lock = threading.Lock()
 
 
@@ -32,30 +31,33 @@ os.register_at_fork(after_in_child=_after_fork_in_child)
 
 
 def disabled() -> bool:
-    return os.environ.get(_DISABLE_ENV, '') not in ('', '0', 'false')
+    raw = envs.SKYTPU_DISABLE_USAGE_COLLECTION.raw()
+    if not raw:
+        return False
+    # Fail-safe for a privacy flag: ANY non-empty value except an
+    # explicit '0'/'false' disables (the pre-registry contract) — an
+    # operator's SKYTPU_DISABLE_USAGE_COLLECTION=off must not silently
+    # re-enable telemetry under the registry's stricter bool parse.
+    return raw.strip().lower() not in ('0', 'false')
 
 
 def spool_path() -> str:
     return os.path.join(paths.state_dir(), 'usage_events.jsonl')
 
 
-# The spool doubles as an audit log but must not grow unboundedly on a
-# long-lived API server: at the cap it rotates to ONE .1 generation
-# (append-heavy workloads lose at most the oldest half of history).
-try:
-    _MAX_SPOOL_BYTES = int(
-        os.environ.get('SKYTPU_USAGE_SPOOL_MAX_BYTES',
-                       str(8 * 1024 * 1024)))
-except ValueError:
-    # A malformed tuning knob must not take down every CLI/server
-    # import; fall back to the default.
-    _MAX_SPOOL_BYTES = 8 * 1024 * 1024
+def _max_spool_bytes() -> int:
+    """The spool doubles as an audit log but must not grow unboundedly
+    on a long-lived API server: at the cap it rotates to ONE .1
+    generation (append-heavy workloads lose at most the oldest half of
+    history). Read at call time through the registry — a malformed
+    knob falls back to the default instead of taking down imports."""
+    return envs.SKYTPU_USAGE_SPOOL_MAX_BYTES.get()
 
 
 def _rotate_locked(path: str) -> None:
     """Caller holds `_lock`. Rotate spool -> spool.1 when over cap."""
     try:
-        if os.path.getsize(path) < _MAX_SPOOL_BYTES:
+        if os.path.getsize(path) < _max_spool_bytes():
             return
     except OSError:
         return
@@ -81,7 +83,7 @@ def record_event(event_name: str, **fields: Any
         _rotate_locked(spool_path())
         with open(spool_path(), 'a', encoding='utf-8') as f:
             f.write(json.dumps(event) + '\n')
-    endpoint = os.environ.get(_ENDPOINT_ENV)
+    endpoint = envs.SKYTPU_USAGE_ENDPOINT.get()
     if endpoint:
         # Ship from a daemon thread: callers may be on the API server's
         # event loop, and a slow endpoint must cost them nothing.

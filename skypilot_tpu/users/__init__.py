@@ -24,8 +24,9 @@ no-auth-proxy default.
 """
 import dataclasses
 import hmac
-import os
 from typing import Dict, List, Optional
+
+from skypilot_tpu import envs
 
 ROLE_ADMIN = 'admin'
 ROLE_USER = 'user'
@@ -69,7 +70,7 @@ def bootstrap_admin() -> Optional[User]:
     chart's auth Secret) inject SKYTPU_BOOTSTRAP_ADMIN_TOKEN so a fresh
     install has exactly one admin, who then creates real users over the
     API. Config/DB users named 'admin' shadow it."""
-    token = os.environ.get('SKYTPU_BOOTSTRAP_ADMIN_TOKEN')
+    token = envs.SKYTPU_BOOTSTRAP_ADMIN_TOKEN.get()
     if not token:
         return None
     return User(name='admin', role=ROLE_ADMIN, token=token)

@@ -21,6 +21,7 @@ import os
 import shlex
 from typing import Any, Dict, Optional
 
+from skypilot_tpu import envs
 from skypilot_tpu import exceptions
 
 
@@ -141,7 +142,7 @@ def translate_local_file_mounts(task, store_type: Optional[str] = None):
     from skypilot_tpu.data import storage as storage_lib
     store_type = store_type or config_lib.get_nested(
         ('jobs', 'bucket', 'store'), default='local')
-    user = os.environ.get('SKYTPU_USER') or os.environ.get('USER', 'u')
+    user = envs.SKYTPU_USER.get() or os.environ.get('USER', 'u')
 
     def _bucketize(local_path: str, remote_dst: str) -> None:
         digest = hashlib.sha1(

@@ -1,10 +1,11 @@
 """Canonical on-disk locations (client side and on-cluster runtime)."""
 import os
+from skypilot_tpu import envs
 
 
 def state_dir() -> str:
     """Client-side state root (~/.skytpu or $SKYTPU_STATE_DIR)."""
-    d = os.environ.get('SKYTPU_STATE_DIR', os.path.expanduser('~/.skytpu'))
+    d = envs.SKYTPU_STATE_DIR.get() or os.path.expanduser('~/.skytpu')
     os.makedirs(d, exist_ok=True)
     return d
 

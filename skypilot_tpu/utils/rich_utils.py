@@ -109,8 +109,8 @@ def safe_status(message: str, out=None):
     """The status everyone should use: quiet under SKYTPU_QUIET, joins
     a live spinner instead of fighting it, plain Status otherwise
     (reference safe_status/client_status)."""
-    import os
-    if os.environ.get('SKYTPU_QUIET'):
+    from skypilot_tpu import envs
+    if envs.SKYTPU_QUIET.get():
         return _NullStatus()
     if _ACTIVE:
         return _NestedStatus(_ACTIVE[-1], message)

@@ -12,23 +12,24 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu import envs
 from skypilot_tpu.observability import tracing
 
-_ENV_VAR = 'SKYTPU_TIMELINE'
 _events: List[Dict[str, Any]] = []
 _lock = threading.Lock()
 _registered = False
 
 
 def enabled() -> bool:
-    return bool(os.environ.get(_ENV_VAR))
+    return envs.SKYTPU_TIMELINE.is_set()
 
 
 def _ensure_flush_registered() -> None:
     global _registered
-    if not _registered:
-        atexit.register(save)
-        _registered = True
+    with _lock:
+        if not _registered:
+            atexit.register(save)
+            _registered = True
 
 
 class Event:
@@ -87,7 +88,7 @@ def event(fn=None, *, name: Optional[str] = None):
 
 def save(path: Optional[str] = None) -> Optional[str]:
     """Write accumulated events as a Chrome trace; returns the path."""
-    path = path or os.environ.get(_ENV_VAR)
+    path = path or envs.SKYTPU_TIMELINE.get()
     if not path:
         return None
     # Take-and-clear: an explicit save() followed by the atexit flush

@@ -4,5 +4,8 @@
 # releases / at round end so slow-set regressions can't slip through.
 set -e
 cd "$(dirname "$0")/.."
+# Static-analysis gate first: a lint finding fails fast, before the
+# compile-heavy suites spend minutes.
+python -m skypilot_tpu.analysis
 python -m pytest tests/ -q
 python -m pytest tests/ -q -m slow

@@ -1,0 +1,439 @@
+"""Fixture tests for every skytpu-lint checker: for each rule, a
+snippet that MUST flag and a sibling that MUST pass — the checkers
+stay honest in both directions (no silent rule rot, no false-positive
+creep on the idioms the codebase actually uses).
+"""
+import os
+import textwrap
+from typing import List
+
+import pytest
+
+from skypilot_tpu.analysis import baseline as baseline_lib
+from skypilot_tpu.analysis import core
+
+
+def _run_snippet(tmp_path, source: str, check: str,
+                 filename: str = 'snippet.py') -> List[core.Finding]:
+    path = tmp_path / filename
+    path.write_text(textwrap.dedent(source))
+    findings, _ = core.run(paths=[str(path)], checks=[check],
+                           root=str(tmp_path))
+    return findings
+
+
+def _rules(findings) -> List[str]:
+    return [f.rule for f in findings]
+
+
+# --- trace-safety -----------------------------------------------------------
+
+def test_trace_safety_flags_host_call_in_jitted_fn(tmp_path):
+    findings = _run_snippet(tmp_path, """
+        import functools
+        import time
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=('config',))
+        def step(params, batch, config):
+            start = time.time()
+            print('step!')
+            return params
+    """, 'trace-safety')
+    assert _rules(findings).count('host-call') == 2
+
+
+def test_trace_safety_flags_body_passed_to_lax(tmp_path):
+    findings = _run_snippet(tmp_path, """
+        from jax import lax
+
+        def body(carry, x):
+            carry.append(x)          # closed-over? no: param — ok
+            print('traced')          # host call — flag
+            return carry, x
+
+        def outer(xs):
+            return lax.scan(body, [], xs)
+    """, 'trace-safety')
+    assert 'host-call' in _rules(findings)
+
+
+def test_trace_safety_flags_tracer_coercion(tmp_path):
+    findings = _run_snippet(tmp_path, """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x) + x.item()
+    """, 'trace-safety')
+    assert _rules(findings).count('tracer-coercion') == 2
+
+
+def test_trace_safety_flags_closure_mutation(tmp_path):
+    findings = _run_snippet(tmp_path, """
+        import jax
+
+        CACHE = {}
+
+        @jax.jit
+        def f(x):
+            CACHE['latest'] = x
+            return x
+    """, 'trace-safety')
+    assert 'closure-mutation' in _rules(findings)
+
+
+def test_trace_safety_passes_clean_jitted_fn(tmp_path):
+    """The idioms the engine actually uses must NOT flag: static
+    params through int(), param-dict mutation, jnp calls, module
+    constants."""
+    findings = _run_snippet(tmp_path, """
+        import functools
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        SCALE = 2.0
+
+        @functools.partial(jax.jit, static_argnames=('width',))
+        def f(cache, x, width):
+            w = int(width)               # static arg: a real int
+            cache['length'] = x + w      # param mutation: a pytree
+            return jnp.sum(x) * SCALE
+
+        def host_helper(x):
+            print('not traced; fine')
+            return float(x)
+    """, 'trace-safety')
+    assert findings == []
+
+
+# --- env-registry -----------------------------------------------------------
+
+def test_env_registry_flags_undeclared_var(tmp_path):
+    findings = _run_snippet(tmp_path, """
+        import os
+        def f():
+            return os.environ.get('SKYTPU_TOTALLY_FAKE_KNOB')
+    """, 'env-registry')
+    assert 'undeclared' in _rules(findings)
+
+
+def test_env_registry_flags_import_time_read(tmp_path):
+    findings = _run_snippet(tmp_path, """
+        import os
+        TIMEOUT = float(os.environ.get('SKYTPU_DEBUG', '0'))
+    """, 'env-registry')
+    assert 'import-time-read' in _rules(findings)
+
+
+def test_env_registry_flags_default_arg_and_decorator_reads(tmp_path):
+    """Parameter defaults and decorator expressions execute at import
+    time — the rule must reach into them even though bodies are
+    deferred."""
+    findings = _run_snippet(tmp_path, """
+        import os
+
+        def retry(gap):
+            def wrap(f):
+                return f
+            return wrap
+
+        def poll(interval=float(os.environ.get('SKYTPU_DEBUG', '0'))):
+            return interval
+
+        @retry(gap=os.environ.get('SKYTPU_QUIET'))
+        def job():
+            pass
+    """, 'env-registry')
+    assert _rules(findings).count('import-time-read') == 2
+
+
+def test_env_registry_flags_direct_read(tmp_path):
+    findings = _run_snippet(tmp_path, """
+        import os
+        def f():
+            return os.environ.get('SKYTPU_DEBUG')
+    """, 'env-registry')
+    assert 'direct-read' in _rules(findings)
+
+
+def test_env_registry_passes_registry_read_at_call_time(tmp_path):
+    findings = _run_snippet(tmp_path, """
+        from skypilot_tpu import envs
+
+        def f():
+            return envs.SKYTPU_DEBUG.get()
+
+        def g():
+            # Non-SKYTPU vars are not ours to police.
+            import os
+            return os.environ.get('USER', 'nobody')
+    """, 'env-registry')
+    assert findings == []
+
+
+# --- async-discipline -------------------------------------------------------
+
+def test_async_discipline_flags_blocking_calls(tmp_path):
+    findings = _run_snippet(tmp_path, """
+        import time
+        import requests
+
+        async def handler(request):
+            time.sleep(1)
+            return requests.get('http://x')
+    """, 'async-discipline')
+    assert _rules(findings).count('blocking-call') == 2
+
+
+def test_async_discipline_flags_bare_gather_fanout(tmp_path):
+    findings = _run_snippet(tmp_path, """
+        import asyncio
+
+        async def fan_out(collect, watchers):
+            return await asyncio.gather(*map(collect, watchers))
+    """, 'async-discipline')
+    assert 'task-leak' in _rules(findings)
+
+
+def test_async_discipline_passes_tasks_and_return_exceptions(tmp_path):
+    findings = _run_snippet(tmp_path, """
+        import asyncio
+        import time
+
+        async def good(collect, watchers):
+            tasks = [asyncio.ensure_future(collect(w))
+                     for w in watchers]
+            try:
+                return await asyncio.gather(*tasks)
+            except RuntimeError:
+                for t in tasks:
+                    t.cancel()
+                await asyncio.gather(*tasks, return_exceptions=True)
+                raise
+
+        async def also_good(coros):
+            await asyncio.sleep(0.1)
+            return await asyncio.gather(*map(tuple, coros),
+                                        return_exceptions=True)
+
+        def sync_helper():
+            time.sleep(1)  # not async: fine (to_thread targets)
+    """, 'async-discipline')
+    assert findings == []
+
+
+# --- lock-discipline --------------------------------------------------------
+
+def test_lock_discipline_flags_unlocked_sqlite_write(tmp_path):
+    findings = _run_snippet(tmp_path, """
+        import threading
+        _lock = threading.Lock()
+
+        def save(conn, x):
+            conn.execute('INSERT INTO t VALUES (?)', (x,))
+            conn.commit()
+    """, 'lock-discipline')
+    assert 'sqlite-write-outside-lock' in _rules(findings)
+
+
+def test_lock_discipline_flags_unlocked_global_write(tmp_path):
+    findings = _run_snippet(tmp_path, """
+        import threading
+        _lock = threading.Lock()
+        _cache = None
+
+        def refresh(v):
+            global _cache
+            _cache = v
+    """, 'lock-discipline')
+    assert 'global-write-outside-lock' in _rules(findings)
+
+
+def test_lock_discipline_passes_locked_and_fork_handler(tmp_path):
+    findings = _run_snippet(tmp_path, """
+        import threading
+        _lock = threading.Lock()
+        _cache = None
+
+        def save(conn, x):
+            with _lock:
+                conn.execute('INSERT INTO t VALUES (?)', (x,))
+                conn.commit()
+
+        def refresh(v):
+            global _cache
+            with _lock:
+                _cache = v
+
+        def _migrate_locked(conn):
+            # *_locked convention: caller holds the lock.
+            conn.execute('ALTER TABLE t ADD COLUMN y')
+
+        def _after_fork_in_child():
+            # Rebinds the lock itself: exempt by construction.
+            global _lock, _cache
+            _lock = threading.Lock()
+            _cache = None
+
+        def read(conn):
+            return conn.execute('SELECT * FROM t').fetchall()
+    """, 'lock-discipline')
+    assert findings == []
+
+
+def test_lock_discipline_ignores_modules_without_module_lock(tmp_path):
+    findings = _run_snippet(tmp_path, """
+        def save(conn, x):
+            conn.execute('INSERT INTO t VALUES (?)', (x,))
+    """, 'lock-discipline')
+    assert findings == []
+
+
+# --- migrated runtime checkers (must-pass over the real repo; the
+# --- must-flag direction is covered by their unit contract) ------------------
+
+def test_metrics_names_checker_clean_on_repo():
+    from skypilot_tpu.analysis.checkers import metrics_names
+    assert list(metrics_names.MetricsNamesChecker().check_project(
+        core.repo_root(), ())) == []
+
+
+def test_fault_points_checker_clean_on_repo():
+    from skypilot_tpu.analysis.checkers import fault_points
+    assert list(fault_points.FaultPointsChecker().check_project(
+        core.repo_root(), ())) == []
+
+
+def test_fault_points_checker_flags_missing_guide(tmp_path):
+    """Must-flag direction: a root without docs/guides/resilience.md
+    (or with an empty one) produces point-documented findings."""
+    from skypilot_tpu.analysis.checkers import fault_points
+    findings = list(fault_points.FaultPointsChecker().check_project(
+        str(tmp_path), ()))
+    assert any(f.rule == 'point-documented' for f in findings)
+
+
+def test_metrics_names_checker_flags_bad_metric():
+    """Must-flag direction: a deliberately bad metric registered in
+    the live registry is caught, then cleaned up."""
+    from skypilot_tpu.analysis.checkers import metrics_names
+    from skypilot_tpu.observability import metrics
+    bad = metrics.Counter('skytpu_bad_lint_fixture',
+                          'A deliberately miscounted fixture metric.')
+    try:
+        findings = list(metrics_names.MetricsNamesChecker()
+                        .check_project(core.repo_root(), ()))
+        assert any(f.rule == 'counter-suffix'
+                   and 'skytpu_bad_lint_fixture' in f.message
+                   for f in findings)
+    finally:
+        metrics.REGISTRY.unregister(bad)
+
+
+# --- inline suppression -----------------------------------------------------
+
+def test_inline_suppression_silences_named_rule(tmp_path):
+    findings = _run_snippet(tmp_path, """
+        import os
+        def f():
+            return os.environ.get('SKYTPU_DEBUG')  # skytpu-lint: ignore[direct-read]
+    """, 'env-registry')
+    assert findings == []
+
+
+# --- baseline round-trip ----------------------------------------------------
+
+def test_baseline_round_trip(tmp_path):
+    """Suppressed findings stay suppressed across a re-run; a NEW
+    finding still fails; fixing a baselined finding never fails."""
+    src = tmp_path / 'mod.py'
+    src.write_text(textwrap.dedent("""
+        import os
+        def f():
+            return os.environ.get('SKYTPU_DEBUG')
+    """))
+    findings, _ = core.run(paths=[str(src)], checks=['env-registry'],
+                           root=str(tmp_path))
+    assert findings, 'fixture must produce debt to baseline'
+
+    bl_path = str(tmp_path / 'baseline.json')
+    baseline_lib.write(bl_path, findings)
+
+    # Unchanged code: everything baselined, nothing new.
+    again, _ = core.run(paths=[str(src)], checks=['env-registry'],
+                        root=str(tmp_path))
+    new, baselined = baseline_lib.partition(
+        again, baseline_lib.load(bl_path))
+    assert new == [] and len(baselined) == len(findings)
+
+    # Line drift above the finding must not invalidate the baseline
+    # (fingerprints are content-based, not line-number-based).
+    src.write_text('# a new header comment\n' + src.read_text())
+    drifted, _ = core.run(paths=[str(src)], checks=['env-registry'],
+                          root=str(tmp_path))
+    new, _ = baseline_lib.partition(drifted,
+                                    baseline_lib.load(bl_path))
+    assert new == []
+
+    # A genuinely new finding fails even with the baseline.
+    src.write_text(src.read_text() + textwrap.dedent("""
+        def g():
+            return os.environ.get('SKYTPU_QUIET')
+    """))
+    grown, _ = core.run(paths=[str(src)], checks=['env-registry'],
+                        root=str(tmp_path))
+    new, _ = baseline_lib.partition(grown, baseline_lib.load(bl_path))
+    assert len(new) == 1 and 'SKYTPU_QUIET' in new[0].message
+
+    # Fixing the original finding: stale baseline entries are inert.
+    src.write_text('def empty():\n    return None\n')
+    fixed, _ = core.run(paths=[str(src)], checks=['env-registry'],
+                        root=str(tmp_path))
+    new, baselined = baseline_lib.partition(
+        fixed, baseline_lib.load(bl_path))
+    assert new == [] and baselined == []
+
+
+def test_baseline_counts_absorb_duplicates_not_extras(tmp_path):
+    """Two identical-line findings baseline as count=2; a third
+    occurrence of the same line is NEW."""
+    body = ("import os\n"
+            "def f():\n"
+            "    return os.environ.get('SKYTPU_DEBUG')\n"
+            "def g():\n"
+            "    return os.environ.get('SKYTPU_DEBUG')\n")
+    src = tmp_path / 'dup.py'
+    src.write_text(body)
+    findings, _ = core.run(paths=[str(src)], checks=['env-registry'],
+                           root=str(tmp_path))
+    assert len(findings) == 2
+    bl_path = str(tmp_path / 'baseline.json')
+    baseline_lib.write(bl_path, findings)
+
+    src.write_text(body + "def h():\n"
+                          "    return os.environ.get('SKYTPU_DEBUG')\n")
+    grown, _ = core.run(paths=[str(src)], checks=['env-registry'],
+                        root=str(tmp_path))
+    new, baselined = baseline_lib.partition(
+        grown, baseline_lib.load(bl_path))
+    assert len(baselined) == 2 and len(new) == 1
+
+
+def test_unknown_check_name_is_an_error():
+    with pytest.raises(ValueError):
+        core.run(checks=['no-such-check'])
+
+
+def test_all_five_issue_checkers_registered():
+    names = set(core.all_checkers())
+    assert {'trace-safety', 'env-registry', 'async-discipline',
+            'lock-discipline', 'metrics-names',
+            'fault-points'} <= names
+
+
+def test_committed_baseline_is_loadable():
+    path = baseline_lib.default_path(core.repo_root())
+    assert os.path.exists(path), 'commit the baseline file'
+    baseline_lib.load(path)  # must not raise

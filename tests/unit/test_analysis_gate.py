@@ -1,0 +1,64 @@
+"""The tier-1 static-analysis gate: `python -m skypilot_tpu.analysis`
+must run clean (zero unsuppressed, un-baselined findings) over
+skypilot_tpu/ — a NEW trace-safety / env-registry / async-discipline /
+lock-discipline / metrics / fault-point violation fails CI here.
+
+Shells the real CLI (json mode) so the gate exercises exactly what CI
+and operators run, not a parallel in-process path.
+"""
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _run_cli(*args: str) -> 'subprocess.CompletedProcess':
+    return subprocess.run(
+        [sys.executable, '-m', 'skypilot_tpu.analysis', *args],
+        capture_output=True, text=True, cwd=_REPO, timeout=300,
+        env={**os.environ, 'JAX_PLATFORMS': 'cpu'})
+
+
+def test_analysis_runs_clean_over_package():
+    proc = _run_cli('--format', 'json')
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc['new'] == [], json.dumps(doc['new'], indent=1)
+    # Every checker participated.
+    assert {'trace-safety', 'env-registry', 'async-discipline',
+            'lock-discipline', 'metrics-names',
+            'fault-points'} <= set(doc['checks'])
+
+
+def test_cli_exits_nonzero_on_new_finding(tmp_path):
+    bad = tmp_path / 'bad.py'
+    bad.write_text("import os\n"
+                   "FROZEN = os.environ.get('SKYTPU_DEBUG', '')\n")
+    proc = _run_cli(str(bad), '--checks', 'env-registry',
+                    '--format', 'json')
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    rules = {f['rule'] for f in doc['new']}
+    assert 'import-time-read' in rules
+
+
+def test_cli_list_checks():
+    proc = _run_cli('--list-checks')
+    assert proc.returncode == 0
+    for name in ('trace-safety', 'env-registry', 'async-discipline',
+                 'lock-discipline', 'metrics-names', 'fault-points'):
+        assert name in proc.stdout
+
+
+def test_cli_text_format_reports_location_and_rule(tmp_path):
+    bad = tmp_path / 'bad.py'
+    bad.write_text("import time\n"
+                   "async def h():\n"
+                   "    time.sleep(1)\n")
+    proc = _run_cli(str(bad), '--checks', 'async-discipline')
+    assert proc.returncode == 1
+    assert 'bad.py:3' in proc.stdout
+    assert '[async-discipline/blocking-call]' in proc.stdout

@@ -1,0 +1,83 @@
+"""The central SKYTPU_* registry: declaration hygiene and call-time
+parse semantics (tuning knobs fail open, identity vars fail loud)."""
+import pytest
+
+from skypilot_tpu import envs
+
+
+def test_every_declared_var_has_type_default_doc():
+    declared = envs.declared()
+    assert len(declared) >= 36, 'registry went missing'
+    for name, var in declared.items():
+        assert name == var.name
+        assert var.type in (str, int, float, bool, list), name
+        assert var.doc and len(var.doc.strip()) >= 10, name
+
+
+def test_get_reads_at_call_time(monkeypatch):
+    monkeypatch.delenv('SKYTPU_JOBS_RETRY_GAP', raising=False)
+    assert envs.SKYTPU_JOBS_RETRY_GAP.get() == 10.0
+    monkeypatch.setenv('SKYTPU_JOBS_RETRY_GAP', '0.5')
+    assert envs.SKYTPU_JOBS_RETRY_GAP.get() == 0.5
+
+
+def test_malformed_tuning_knob_falls_back_to_default(monkeypatch):
+    monkeypatch.setenv('SKYTPU_MAX_QUEUE_DEPTH', 'banana')
+    assert envs.SKYTPU_MAX_QUEUE_DEPTH.get() == 0
+
+
+def test_strict_get_raises_on_malformed_identity_var(monkeypatch):
+    monkeypatch.setenv('SKYTPU_PROCESS_ID', 'O7')
+    with pytest.raises(ValueError, match='SKYTPU_PROCESS_ID'):
+        envs.SKYTPU_PROCESS_ID.get(strict=True)
+    # Set-but-empty is a templating bug, not "unset": fail loud too.
+    monkeypatch.setenv('SKYTPU_PROCESS_ID', '')
+    with pytest.raises(ValueError, match='set but empty'):
+        envs.SKYTPU_PROCESS_ID.get(strict=True)
+    # Genuinely unset (single-host run): default applies even in
+    # strict mode.
+    monkeypatch.delenv('SKYTPU_PROCESS_ID')
+    assert envs.SKYTPU_PROCESS_ID.get(strict=True) == 0
+    monkeypatch.setenv('SKYTPU_PROCESS_ID', '7')
+    assert envs.SKYTPU_PROCESS_ID.get(strict=True) == 7
+
+
+def test_bool_and_list_parsing(monkeypatch):
+    monkeypatch.setenv('SKYTPU_DEBUG', 'yes')
+    assert envs.SKYTPU_DEBUG.get() is True
+    monkeypatch.setenv('SKYTPU_DEBUG', 'off')
+    assert envs.SKYTPU_DEBUG.get() is False
+    monkeypatch.setenv('SKYTPU_DEBUG_MODULES', ' serve, jobs ,')
+    assert envs.SKYTPU_DEBUG_MODULES.get() == ['serve', 'jobs']
+
+
+def test_empty_value_means_default(monkeypatch):
+    monkeypatch.setenv('SKYTPU_JOBS_RECOVERY_DEADLINE', '')
+    assert envs.SKYTPU_JOBS_RECOVERY_DEADLINE.get() is None
+
+
+def test_per_call_default_override(monkeypatch):
+    monkeypatch.delenv('SKYTPU_WATCHDOG_INTERVAL', raising=False)
+    assert envs.SKYTPU_WATCHDOG_INTERVAL.get() == 30.0
+    assert envs.SKYTPU_WATCHDOG_INTERVAL.get(default=5.0) == 5.0
+
+
+def test_declare_rejects_bad_declarations():
+    with pytest.raises(ValueError):
+        envs.declare('NOT_OUR_PREFIX', str, None, 'long enough doc')
+    with pytest.raises(ValueError):
+        envs.declare('SKYTPU_DEBUG', bool, False, 'duplicate, rejected')
+    with pytest.raises(ValueError):
+        envs.declare('SKYTPU_NEW_STUBBY', str, None, 'short')
+
+
+def test_usage_disable_flag_fails_safe(monkeypatch):
+    """A privacy flag must not silently re-enable telemetry under the
+    registry's stricter bool parse: any non-empty value except an
+    explicit 0/false disables."""
+    from skypilot_tpu.usage import usage_lib
+    for value, want in (('1', True), ('off', True), ('no', True),
+                        ('weird', True), ('0', False),
+                        ('false', False), ('', False)):
+        monkeypatch.setenv('SKYTPU_DISABLE_USAGE_COLLECTION', value)
+        assert usage_lib.disabled() is want, value

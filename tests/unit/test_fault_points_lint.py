@@ -1,59 +1,50 @@
-"""Fault-point namespace lint (style of test_metrics_lint.py): name
-drift in the chaos-injection catalog fails tier-1, not debugging
-sessions.
+"""Fault-point namespace lint: name drift in the chaos-injection
+catalog fails tier-1, not debugging sessions.
 
-Importing the faults module registers the whole catalog; this pass
-asserts the naming/uniqueness/documentation contract over ALL of
-them — a typo'd point name would otherwise silently never fire.
+Since the static-analysis PR the naming/documentation rules are a thin
+wrapper over the migrated `fault-points` checker (skypilot_tpu/
+analysis/checkers/fault_points.py) — same contract, same tier-1 test
+names, one implementation shared with `python -m
+skypilot_tpu.analysis`. The behavioral tests (declare() validation,
+injection observability) stay here: they exercise the runtime, not the
+catalog contract.
 """
 import os
-import re
 
+from skypilot_tpu.analysis.checkers import fault_points
 from skypilot_tpu.resilience import faults
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
-_GUIDE = os.path.join(_REPO, 'docs', 'guides', 'resilience.md')
 
 
-def _points():
-    points = faults.registered_points()
-    assert len(points) >= 5, 'fault-point catalog went missing'
-    return points
+def _assert_clean(rule: str) -> None:
+    findings = fault_points.findings_for_rule(rule, _REPO)
+    assert not findings, '\n'.join(f.message for f in findings)
+
+
+def test_catalog_registered():
+    _assert_clean('catalog-present')
 
 
 def test_every_point_matches_naming_regex():
-    for name in _points():
-        assert faults.POINT_RE.fullmatch(name), (
-            f'{name}: fault points are dotted plane.operation names')
+    _assert_clean('point-name')
 
 
 def test_every_point_has_description():
-    for name, desc in _points().items():
-        assert desc and len(desc.strip()) >= 10, name
+    _assert_clean('point-description')
 
 
 def test_points_documented_in_resilience_guide():
     """Every registered point appears in docs/guides/resilience.md —
     injection points stay discoverable as they spread."""
-    with open(_GUIDE, encoding='utf-8') as f:
-        text = f.read()
-    missing = [p for p in _points() if f'`{p}`' not in text]
-    assert not missing, (
-        f'fault points undocumented in guides/resilience.md: {missing}')
+    _assert_clean('point-documented')
 
 
 def test_documented_points_exist():
     """No doc rot in the other direction either: every `a.b` code
     literal in the guide's fault-point table is a real point."""
-    with open(_GUIDE, encoding='utf-8') as f:
-        text = f.read()
-    table = re.findall(r'^\| `([a-z][a-z0-9_.]*)` \|', text,
-                       flags=re.MULTILINE)
-    assert table, 'guide lost its fault-point table'
-    registered = set(_points())
-    ghosts = [p for p in table if '.' in p and p not in registered]
-    assert not ghosts, f'guide documents unknown fault points: {ghosts}'
+    _assert_clean('doc-ghost')
 
 
 def test_declare_rejects_bad_names():

@@ -25,9 +25,8 @@ def jobs_env(monkeypatch, tmp_path):
     subprocesses see it too."""
     monkeypatch.setenv('SKYTPU_JOBS_POLL_INTERVAL', '0.3')
     monkeypatch.setenv('SKYTPU_JOBS_RETRY_GAP', '0.2')
-    jobs_controller._POLL_INTERVAL_SECONDS = 0.3
-    # SKYTPU_JOBS_RETRY_GAP above is enough: recovery_strategy reads
-    # it at call time now, not import time.
+    # The env vars above are enough: the controller and
+    # recovery_strategy read them at call time now, not import time.
     cache = os.path.join(os.path.expanduser('~/.skytpu'))
     os.makedirs(cache, exist_ok=True)
     with open(os.path.join(cache, 'enabled_clouds.json'), 'w',

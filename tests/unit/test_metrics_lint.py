@@ -1,78 +1,43 @@
 """Metric-namespace lint: name drift fails tier-1, not dashboards.
 
-Importing the instrument catalog registers every hot-path metric in
-the default registry; this pass then asserts the naming/help/bucket
-contract over ALL of them — a typo'd metric name or an unsorted
-bucket list breaks here, in CI, instead of silently producing a
-series no alert matches.
+Since the static-analysis PR this is a thin wrapper over the migrated
+`metrics-names` checker (skypilot_tpu/analysis/checkers/
+metrics_names.py) — same contract, same tier-1 test names, one
+implementation shared with `python -m skypilot_tpu.analysis`.
 """
-import math
-import re
-
-from skypilot_tpu.observability import instruments  # noqa: F401 — registers
-from skypilot_tpu.observability import metrics
-
-_NAME_RE = re.compile(r'^skytpu_[a-z0-9_]+$')
+from skypilot_tpu.analysis.checkers import metrics_names
 
 
-def _all_metrics():
-    found = metrics.REGISTRY.metrics()
-    assert len(found) >= 20, 'instrument catalog went missing'
-    return found
+def _assert_clean(rule: str) -> None:
+    findings = metrics_names.findings_for_rule(rule)
+    assert not findings, '\n'.join(f.message for f in findings)
+
+
+def test_catalog_registered():
+    _assert_clean('catalog-present')
 
 
 def test_every_metric_name_in_namespace():
-    for m in _all_metrics():
-        assert _NAME_RE.fullmatch(m.name), m.name
+    _assert_clean('name-namespace')
 
 
 def test_every_metric_has_help():
-    for m in _all_metrics():
-        assert m.help and m.help.strip(), m.name
-        # Help strings are sentences, not stubs.
-        assert len(m.help.strip()) >= 10, m.name
+    _assert_clean('help-text')
 
 
 def test_counters_end_in_total():
-    for m in _all_metrics():
-        if isinstance(m, metrics.Counter):
-            assert m.name.endswith('_total'), (
-                f'{m.name}: Prometheus counters end in _total')
-        else:
-            assert not m.name.endswith('_total'), (
-                f'{m.name}: _total is reserved for counters')
+    _assert_clean('counter-suffix')
 
 
 def test_histogram_buckets_monotonic_and_finite():
-    for m in _all_metrics():
-        if not isinstance(m, metrics.Histogram):
-            continue
-        assert m.buckets, m.name
-        assert list(m.buckets) == sorted(set(m.buckets)), (
-            f'{m.name}: buckets must be strictly increasing')
-        assert all(b != math.inf for b in m.buckets), (
-            f'{m.name}: +Inf bucket is implicit')
-        assert m.name.endswith('_seconds'), (
-            f'{m.name}: our histograms measure latency; name the unit')
+    _assert_clean('histogram-buckets')
 
 
 def test_label_names_valid():
-    label_re = re.compile(r'^[a-z_][a-z0-9_]*$')
-    for m in _all_metrics():
-        for label in m.labelnames:
-            assert label_re.fullmatch(label), f'{m.name}.{label}'
-            assert label != 'le', f'{m.name}: le is reserved'
+    _assert_clean('label-names')
 
 
 def test_exposition_parses():
     """The full catalog renders to exposition format without error and
     every non-comment line is `series value`."""
-    text = metrics.REGISTRY.generate_text()
-    for line in text.strip().splitlines():
-        if line.startswith('#'):
-            assert re.match(r'^# (HELP|TYPE) skytpu_[a-z0-9_]+ ', line)
-            continue
-        assert re.match(
-            r'^skytpu_[a-z0-9_]+(\{[^{}]*\})? '
-            r'([-+]?\d+(\.\d+)?([eE][-+]?\d+)?|\+Inf|-Inf|NaN)$',
-            line), line
+    _assert_clean('exposition')

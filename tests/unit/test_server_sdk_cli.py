@@ -128,7 +128,7 @@ def test_usage_spool_rotates_at_cap(monkeypatch, tmp_path):
     """The spool is an audit log but must not grow unboundedly on a
     long-lived server: past the cap it rotates to one .1 generation."""
     from skypilot_tpu.usage import usage_lib
-    monkeypatch.setattr(usage_lib, '_MAX_SPOOL_BYTES', 512)
+    monkeypatch.setenv('SKYTPU_USAGE_SPOOL_MAX_BYTES', '512')
     monkeypatch.setattr(usage_lib.paths, 'state_dir',
                         lambda: str(tmp_path))
     for _ in range(40):
