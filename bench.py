@@ -43,9 +43,13 @@ _INIT_BACKOFF_S = 30.0
 
 
 def _error_line(msg: str) -> None:
+    # rc is part of the payload (not just the process exit) so a
+    # driver-captured BENCH_*.json is self-describing evidence — the
+    # same honesty contract fleetsim's SLO_*.json reports carry.
     print(json.dumps({
         'metric': 'llama_train_tokens_per_sec_per_chip',
         'value': 0.0, 'unit': 'tokens/s/chip', 'vs_baseline': 0.0,
+        'rc': 1,
         'extra': {'error': msg},
     }))
 
@@ -377,6 +381,7 @@ def main() -> None:
         'value': train['tokens_per_sec_per_chip'],
         'unit': 'tokens/s/chip',
         'vs_baseline': round(train['mfu'] / 0.40, 4),
+        'rc': 0,
         'extra': {
             'n_devices': n_devices,
             **{k: v for k, v in train.items() if k != 'model'},

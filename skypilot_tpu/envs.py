@@ -245,6 +245,37 @@ SKYTPU_FAULTS = declare(
     'Comma-separated fault-injection specs '
     '(point[:times|forever[:latency]]), re-read at inject time.')
 
+# --- serve LB streaming -----------------------------------------------------
+
+SKYTPU_LB_STREAM_READ_TIMEOUT = declare(
+    'SKYTPU_LB_STREAM_READ_TIMEOUT', float, 120.0,
+    'Seconds the LB waits for the NEXT chunk from an upstream that '
+    'already sent response bytes; a wedged upstream terminates the '
+    'client stream instead of hanging it. 0 disables.')
+
+# --- fleet simulation / soak harness ----------------------------------------
+
+SKYTPU_FLEETSIM_SEED = declare(
+    'SKYTPU_FLEETSIM_SEED', int, 0,
+    'Deterministic RNG seed for fleetsim traffic and replica latency '
+    'distributions; one seed reproduces one soak run exactly.')
+SKYTPU_FLEETSIM_TICK_SECONDS = declare(
+    'SKYTPU_FLEETSIM_TICK_SECONDS', float, 0.0,
+    'Override the scenario-declared virtual-clock tick (simulated '
+    'seconds per controller step). 0/unset keeps the scenario value.')
+SKYTPU_FLEETSIM_SCALE = declare(
+    'SKYTPU_FLEETSIM_SCALE', float, 1.0,
+    'Multiplier on scenario replica counts and traffic rates, so CI '
+    'tiers can shrink a 1000-replica soak without editing scenarios.')
+SKYTPU_FLEETSIM_OUT_DIR = declare(
+    'SKYTPU_FLEETSIM_OUT_DIR', str, None,
+    'Directory SLO_<scenario>.json reports are written to; unset '
+    'means the current working directory.')
+SKYTPU_FLEETSIM_MAX_WALL_SECONDS = declare(
+    'SKYTPU_FLEETSIM_MAX_WALL_SECONDS', float, 300.0,
+    'Wall-clock abort budget for one scenario run: a wedged sim '
+    'fails its SLO report (rc=1) instead of hanging CI.')
+
 # --- on-cluster runtime (the gang contract; injected per job process) -------
 
 SKYTPU_RUNTIME_DIR = declare(

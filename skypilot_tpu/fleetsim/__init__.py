@@ -1,0 +1,25 @@
+"""Fleet-scale soak harness: O(1000) simulated replicas on a virtual
+clock, driving the REAL serve control plane.
+
+The components under test are not mocks: `runner.FleetSim` constructs
+the production `serve.controller.ServeController`, the production
+`serve.load_balancer.LoadBalancer` routing/breaker discipline and the
+production autoscalers, and injects (a) a `clock.VirtualClock` through
+the same seams `resilience.retries` already exposes and (b) a
+`replicas.SimFleet` of mock replica processes in place of the cloud
+replica manager. 30 simulated minutes of thousand-replica chaos run in
+seconds of wall time, and `slo.SLOEvaluator` asserts SLOs (TTFT p95,
+rolling-update error rate, post-zone-loss time-to-ready) from the live
+`skytpu_*` metrics registry — never from log scraping.
+
+Entry points:
+
+    python -m skypilot_tpu.fleetsim --scenario zone_loss
+    tests/unit/test_fleetsim.py (tier-1 smoke; full soaks are -m slow)
+
+See docs/guides/fleet-soak.md for scenario/chaos/SLO syntax.
+"""
+from skypilot_tpu.fleetsim.clock import VirtualClock
+from skypilot_tpu.fleetsim.runner import SCENARIOS, FleetSim, Scenario
+
+__all__ = ['FleetSim', 'SCENARIOS', 'Scenario', 'VirtualClock']

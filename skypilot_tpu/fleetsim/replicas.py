@@ -1,0 +1,283 @@
+"""Mock replica processes: the fleet the real controller reconciles.
+
+`SimFleet` implements the exact surface `serve.controller.
+ServeController` needs from a replica manager (scale_up / scale_down /
+probe_all / ready_endpoints / terminate_all) and keeps the REAL
+serve_state DB as its source of truth — the controller's rolling
+updates, surge protection and autoscaling read the same rows they
+would in production. What is simulated is the replica itself:
+startup latency, per-request TTFT / decode-latency distributions
+(lognormal, seeded), and death.
+
+Chaos composition: replicas die THROUGH the resilience.faults
+registry. A zone marked lost routes every kill through the
+`fleet.zone_loss` point; a preemption wave kills exactly as many spot
+replicas as the point's armed `times` bound. Kills are therefore
+visible in `skytpu_faults_injected_total` and can be armed from
+SKYTPU_FAULTS like any other fault.
+"""
+import dataclasses
+import enum
+from typing import Dict, List, Optional
+
+from skypilot_tpu.observability import instruments as obs
+from skypilot_tpu.resilience import faults
+from skypilot_tpu.serve import serve_state
+
+
+class ReplicaKilled(Exception):
+    """Raised through a fleet.* fault point to kill one replica."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaProfile:
+    """Latency/capacity shape of one mock replica process."""
+    startup_median_s: float = 60.0     # provision + model load
+    startup_sigma: float = 0.35        # lognormal spread
+    ttft_median_s: float = 0.35        # unloaded time-to-first-token
+    ttft_sigma: float = 0.45
+    decode_per_token_s: float = 0.03   # per generated token
+    tokens_median: int = 64            # generated tokens per request
+    concurrency: int = 16              # decode slots per replica
+
+    def service_mean_s(self) -> float:
+        """Mean busy time one request costs a decode slot."""
+        return self.ttft_median_s + \
+            self.tokens_median * self.decode_per_token_s
+
+
+class _State(enum.Enum):
+    PROVISIONING = 'PROVISIONING'
+    STARTING = 'STARTING'
+    READY = 'READY'
+    DEAD = 'DEAD'
+
+
+class SimReplica:
+    __slots__ = ('replica_id', 'zone', 'use_spot', 'endpoint', 'state',
+                 'provision_done', 'ready_at', 'tick_requests',
+                 'tick_busy_s')
+
+    def __init__(self, replica_id: int, zone: Optional[str],
+                 use_spot: bool, created_at: float,
+                 startup_s: float) -> None:
+        self.replica_id = replica_id
+        self.zone = zone
+        self.use_spot = use_spot
+        self.endpoint = f'http://replica-{replica_id}.sim:8080'
+        self.state = _State.PROVISIONING
+        # Cluster up (endpoint known) well before the app is ready —
+        # mirrors PROVISIONING -> STARTING -> READY in production.
+        self.provision_done = created_at + 0.25 * startup_s
+        self.ready_at = created_at + startup_s
+        self.tick_requests = 0
+        self.tick_busy_s = 0.0
+
+
+class SimFleet:
+    """The controller-facing replica manager for a simulated fleet."""
+
+    def __init__(self, service_name: str, clock, rng,
+                 profile: ReplicaProfile,
+                 zones: Optional[List[str]] = None,
+                 default_use_spot: bool = False) -> None:
+        self.service_name = service_name
+        self.profile = profile
+        self.zones = list(zones or [])
+        self.default_use_spot = default_use_spot
+        self._clock = clock
+        self._rng = rng
+        self._replicas: Dict[int, SimReplica] = {}
+        self._by_endpoint: Dict[str, SimReplica] = {}
+        self._lost_zones: set = set()
+        self._preemption_pending = False
+        self._tick_seconds = 1.0
+
+    # -- chaos hooks ---------------------------------------------------------
+
+    def mark_zone_lost(self, zone: str) -> None:
+        self._lost_zones.add(zone)
+
+    def restore_zone(self, zone: str) -> None:
+        self._lost_zones.discard(zone)
+
+    def begin_preemption_wave(self) -> None:
+        """Kill spot replicas through `fleet.preemption_wave` on the
+        next probe sweep; the point's armed `times` bound is the wave
+        size."""
+        self._preemption_pending = True
+
+    # -- the ReplicaManager surface ------------------------------------------
+
+    def scale_up(self, n: int = 1,
+                 use_spot: Optional[bool] = None) -> List[int]:
+        service = serve_state.get_service(self.service_name)
+        version = service['version'] if service else 1
+        spot = self.default_use_spot if use_spot is None else use_spot
+        now = self._clock.now()
+        launched = []
+        for _ in range(n):
+            rid = serve_state.next_replica_id(self.service_name)
+            zone = self._pick_zone()
+            startup = self._rng.lognormvariate(
+                _mu(self.profile.startup_median_s),
+                self.profile.startup_sigma)
+            r = SimReplica(rid, zone, spot, now, startup)
+            self._replicas[rid] = r
+            self._by_endpoint[r.endpoint] = r
+            serve_state.add_replica(self.service_name, rid,
+                                    f'sim-{self.service_name}-{rid}',
+                                    version, use_spot=spot, zone=zone)
+            launched.append(rid)
+        return launched
+
+    def _pick_zone(self) -> Optional[str]:
+        usable = [z for z in self.zones if z not in self._lost_zones]
+        if not usable:
+            return None
+        counts = {z: 0 for z in usable}
+        for r in self._replicas.values():
+            if r.state != _State.DEAD and r.zone in counts:
+                counts[r.zone] += 1
+        return min(usable, key=lambda z: (counts[z], z))
+
+    def scale_down(self, replica_ids: List[int]) -> None:
+        for rid in replica_ids:
+            r = self._replicas.pop(rid, None)
+            if r is not None:
+                self._by_endpoint.pop(r.endpoint, None)
+            serve_state.set_replica_status(
+                self.service_name, rid,
+                serve_state.ReplicaStatus.SHUTTING_DOWN)
+            serve_state.remove_replica(self.service_name, rid)
+
+    def terminate_all(self) -> None:
+        self.scale_down(list(self._replicas))
+
+    def probe_all(self) -> None:
+        """One reconcile sweep on the virtual clock: chaos kills,
+        then startup transitions, then replacement of dead replicas —
+        the same replace-on-loss behavior the real manager has."""
+        self._chaos_sweep()
+        now = self._clock.now()
+        dead = []
+        for r in list(self._replicas.values()):
+            if r.state == _State.DEAD:
+                dead.append(r)
+                continue
+            if r.state == _State.PROVISIONING and \
+                    now >= r.provision_done:
+                r.state = _State.STARTING
+                serve_state.set_replica_status(
+                    self.service_name, r.replica_id,
+                    serve_state.ReplicaStatus.STARTING,
+                    endpoint=r.endpoint)
+            if r.state == _State.STARTING and now >= r.ready_at:
+                r.state = _State.READY
+                serve_state.set_replica_status(
+                    self.service_name, r.replica_id,
+                    serve_state.ReplicaStatus.READY)
+        for r in dead:
+            serve_state.set_replica_status(
+                self.service_name, r.replica_id,
+                serve_state.ReplicaStatus.PREEMPTED)
+            self.scale_down([r.replica_id])
+            self.scale_up(1, use_spot=r.use_spot)
+
+    def ready_endpoints(self) -> List[str]:
+        return [r.endpoint for r in self._replicas.values()
+                if r.state == _State.READY]
+
+    # -- chaos sweep ---------------------------------------------------------
+
+    def _chaos_sweep(self) -> None:
+        order = list(self._replicas.values())
+        if self._preemption_pending:
+            # Shuffled so an armed `times=N` wave hits a random N
+            # spot replicas, not the N oldest.
+            self._rng.shuffle(order)
+        for r in order:
+            if r.state == _State.DEAD:
+                continue
+            if r.zone is not None and r.zone in self._lost_zones:
+                try:
+                    faults.inject('fleet.zone_loss',
+                                  sleep_fn=self._clock.sleep,
+                                  env_exc=ReplicaKilled)
+                except Exception:  # noqa: BLE001 — armed exc = a kill
+                    r.state = _State.DEAD
+                    continue
+            if self._preemption_pending and r.use_spot:
+                try:
+                    faults.inject('fleet.preemption_wave',
+                                  sleep_fn=self._clock.sleep,
+                                  env_exc=ReplicaKilled)
+                except Exception:  # noqa: BLE001 — armed exc = a kill
+                    r.state = _State.DEAD
+        self._preemption_pending = False
+
+    # -- the traffic-facing surface ------------------------------------------
+
+    def begin_tick(self, tick_seconds: float) -> None:
+        self._tick_seconds = max(tick_seconds, 1e-9)
+        for r in self._replicas.values():
+            r.tick_requests = 0
+            r.tick_busy_s = 0.0
+
+    def handle_request(self, endpoint: str):
+        """One request hitting `endpoint`. Returns (ttft_s, total_s)
+        on success, None when the replica is gone or not serving (the
+        LB's dispatch() treats that as a transport failure and fails
+        over)."""
+        r = self._by_endpoint.get(endpoint)
+        if r is None or r.state != _State.READY:
+            return None
+        p = self.profile
+        # Per-tick utilization of this replica's decode slots; TTFT
+        # inflates hyperbolically toward saturation (open-loop
+        # arrivals queue behind busy slots).
+        rho = r.tick_busy_s / (self._tick_seconds * p.concurrency)
+        ttft = self._rng.lognormvariate(_mu(p.ttft_median_s),
+                                        p.ttft_sigma)
+        ttft /= max(0.05, 1.0 - min(rho, 0.95))
+        tokens = max(1, int(self._rng.lognormvariate(
+            _mu(float(p.tokens_median)), 0.5)))
+        total = ttft + tokens * p.decode_per_token_s
+        r.tick_requests += 1
+        r.tick_busy_s += total
+        return ttft, total
+
+    def end_tick(self) -> None:
+        """Publish fleet-wide pressure to the same gauges the engine
+        exports in production (skytpu_queue_depth,
+        skytpu_kv_cache_utilization) so MetricsSignalSource — and
+        therefore the autoscaler under test — reads real registry
+        series."""
+        p = self.profile
+        queued = 0.0
+        utils = []
+        for r in self._replicas.values():
+            if r.state != _State.READY:
+                continue
+            cap = self._tick_seconds * p.concurrency
+            rho = r.tick_busy_s / cap if cap else 0.0
+            utils.append(min(1.0, rho))
+            excess_s = max(0.0, r.tick_busy_s - cap)
+            queued += excess_s / max(p.service_mean_s(), 1e-9)
+        obs.QUEUE_DEPTH.set(queued)
+        obs.KV_CACHE_UTILIZATION.set(
+            sum(utils) / len(utils) if utils else 0.0)
+
+    # -- introspection --------------------------------------------------------
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for r in self._replicas.values():
+            out[r.state.value] = out.get(r.state.value, 0) + 1
+        return out
+
+
+def _mu(median: float) -> float:
+    """ln(median) — the lognormal mu that yields this median."""
+    import math
+    return math.log(max(median, 1e-9))

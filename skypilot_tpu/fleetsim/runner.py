@@ -1,0 +1,437 @@
+"""Scenario runner: the real control plane, a simulated fleet.
+
+One `FleetSim.run()` wires the production `ServeController` (real
+reconcile loop, real rolling updates, real autoscalers) and the
+production `LoadBalancer` routing/breaker discipline to a `SimFleet`
+of mock replicas on a `VirtualClock`, replays open-loop traffic
+through `LoadBalancer.dispatch`, fires the scenario's chaos schedule,
+and evaluates SLOs from the live metrics registry into
+`SLO_<scenario>.json`.
+
+Determinism: one seed reproduces one run bit-for-bit (seeded RNGs,
+virtual clock, deterministic fault registry). Wall time is bounded by
+SKYTPU_FLEETSIM_MAX_WALL_SECONDS — a wedged sim writes a failing
+report instead of hanging CI.
+"""
+import dataclasses
+import random
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from skypilot_tpu import envs
+from skypilot_tpu.fleetsim import chaos as chaos_lib
+from skypilot_tpu.fleetsim import clock as clock_lib
+from skypilot_tpu.fleetsim import replicas as replicas_lib
+from skypilot_tpu.fleetsim import slo as slo_lib
+from skypilot_tpu.fleetsim import traffic as traffic_lib
+from skypilot_tpu.observability import instruments as obs
+from skypilot_tpu.resilience import faults
+from skypilot_tpu.serve import controller as controller_lib
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve import serve_state
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    """One declarative soak: fleet shape, traffic, chaos, SLOs.
+
+    `replicas` and any `max_replicas` in `policy` scale with
+    SKYTPU_FLEETSIM_SCALE; per-replica knobs do not. `chaos` uses the
+    event dicts documented in chaos.py; `slos` are slo.py assertion
+    objects."""
+    name: str
+    description: str
+    replicas: int
+    duration_s: float
+    tick_s: float
+    warmup_s: float
+    traffic: Any                       # traffic_lib.parse() input
+    profile: replicas_lib.ReplicaProfile
+    zones: Tuple[str, ...] = ('zone-a', 'zone-b', 'zone-c')
+    policy: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    lb_policy: str = 'round_robin'
+    chaos: Tuple[Dict[str, Any], ...] = ()
+    slos: Tuple[Any, ...] = ()
+    # Fraction of the pre-event READY count at which a chaos event
+    # (zone loss, preemption wave) counts as recovered.
+    recovery_threshold: float = 0.95
+
+
+class FleetSim:
+
+    def __init__(self, scenario: Scenario,
+                 seed: Optional[int] = None,
+                 out_dir: Optional[str] = None) -> None:
+        self.scenario = scenario
+        self.seed = envs.SKYTPU_FLEETSIM_SEED.get() if seed is None \
+            else seed
+        self.out_dir = out_dir or \
+            envs.SKYTPU_FLEETSIM_OUT_DIR.get() or '.'
+        self.scale = max(1e-3, envs.SKYTPU_FLEETSIM_SCALE.get())
+        self.tick_s = envs.SKYTPU_FLEETSIM_TICK_SECONDS.get() or \
+            scenario.tick_s
+        self.service_name = f'fleetsim-{scenario.name}'
+
+    # -- setup ---------------------------------------------------------------
+
+    def _service_config(self, n_replicas: int) -> Dict[str, Any]:
+        policy: Dict[str, Any] = {'min_replicas': n_replicas}
+        for key, value in self.scenario.policy.items():
+            if key == 'max_replicas':
+                value = max(n_replicas, int(round(value * self.scale)))
+            policy[key] = value
+        return {
+            'readiness_probe': {'path': '/health',
+                                'initial_delay_seconds': 1200,
+                                'timeout_seconds': 5},
+            'replica_policy': policy,
+            'load_balancing_policy': self.scenario.lb_policy,
+        }
+
+    # -- the run -------------------------------------------------------------
+
+    def run(self) -> Dict[str, Any]:
+        sc = self.scenario
+        wall_start = time.monotonic()
+        wall_budget = envs.SKYTPU_FLEETSIM_MAX_WALL_SECONDS.get()
+        n_replicas = max(1, int(round(sc.replicas * self.scale)))
+
+        service_cfg = self._service_config(n_replicas)
+        serve_state.remove_service(self.service_name)  # stale runs
+        serve_state.add_service(
+            self.service_name,
+            {'run': 'true', 'service': service_cfg},
+            lb_port=0, controller_port=0)
+
+        vclock = clock_lib.VirtualClock()
+        fleet_rng = random.Random(self.seed)
+        traffic_rng = random.Random(self.seed + 1)
+        fleet = replicas_lib.SimFleet(
+            self.service_name, vclock, fleet_rng, sc.profile,
+            zones=list(sc.zones),
+            default_use_spot=bool(
+                service_cfg['replica_policy'].get('use_spot')))
+        lb = lb_lib.LoadBalancer(sc.lb_policy, now_fn=vclock.now)
+        ctl = controller_lib.ServeController(
+            self.service_name, manager=fleet, lb=lb,
+            now_fn=vclock.now, sleep_fn=vclock.sleep)
+        serve_state.set_service_status(
+            self.service_name, serve_state.ServiceStatus.REPLICA_INIT)
+        fleet.scale_up(n_replicas)
+
+        curve = traffic_lib.parse(sc.traffic)
+        if self.scale != 1.0:
+            curve = traffic_lib.scaled(curve, self.scale)
+        evaluator = slo_lib.SLOEvaluator(sc.slos)
+        # Recovery series persist across scenarios in one process: a
+        # previous run's "recovered in 12s" must not satisfy THIS
+        # run's GaugeWithin if its chaos event never fires. -1 is the
+        # documented "no recovery happened" value, which fails lo=0.
+        for _series, labels, _value in \
+                obs.FLEETSIM_RECOVERY_SECONDS.samples():
+            obs.FLEETSIM_RECOVERY_SECONDS.labels(
+                **dict(labels)).set(-1.0)
+        evaluator.mark('start')
+        schedule = chaos_lib.ChaosSchedule.from_config(sc.chaos)
+
+        recovery_pending: Dict[str, Dict[str, float]] = {}
+        outcomes: Dict[str, int] = {}
+        controller_crashes = 0
+        requests = 0
+        warmup_marked = False
+        aborted: Optional[str] = None
+        ticks = 0
+
+        def send(url: str) -> bool:
+            result = fleet.handle_request(url)
+            if result is None:
+                return False
+            ttft, total = result
+            obs.FLEETSIM_TTFT_SECONDS.observe(ttft)
+            obs.FLEETSIM_REQUEST_SECONDS.observe(total)
+            return True
+
+        crash: Optional[BaseException] = None
+        t = 0.0
+        try:
+            while t < sc.duration_s - 1e-9:
+                if time.monotonic() - wall_start > wall_budget:
+                    aborted = (f'wall budget {wall_budget:.0f}s '
+                               f'exceeded at simulated t={t:.0f}s')
+                    break
+                injected_before = obs.FAULTS_INJECTED.value(
+                    point='controller.step')
+                try:
+                    ctl._step()  # noqa: SLF001 — the sim drives the loop
+                except (faults.FaultInjected, RuntimeError):
+                    # Count as a chaos-induced crash ONLY when the
+                    # controller.step point actually fired this tick
+                    # (RuntimeError is its declared env_exc, so
+                    # SKYTPU_FAULTS-armed drills land here too); a
+                    # RuntimeError from a real controller bug must
+                    # stay loud — this harness exists to CATCH
+                    # controller regressions, not absorb them.
+                    if obs.FAULTS_INJECTED.value(
+                            point='controller.step') == injected_before:
+                        raise
+                    controller_crashes += 1
+                # One timeline: latency faults advance the virtual
+                # clock inside _step (a stalled controller), and the
+                # chaos/traffic/recovery bookkeeping must not lag it.
+                t = vclock.now()
+                ticks += 1
+                ready = obs.SERVE_REPLICAS.value(
+                    service=self.service_name, state='READY')
+                # Recovery checks BEFORE new events: a fresh event's
+                # target must never be satisfied by the pre-kill
+                # gauge.
+                for event_name, info in list(recovery_pending.items()):
+                    if ready >= info['target']:
+                        obs.FLEETSIM_RECOVERY_SECONDS.labels(
+                            event=event_name).set(t - info['t'])
+                        del recovery_pending[event_name]
+                for ev in schedule.pop_due(t):
+                    self._apply_event(ev, fleet, evaluator,
+                                      recovery_pending, ready, t)
+                fleet.begin_tick(self.tick_s)
+                for _ in range(curve.arrivals(traffic_rng, t,
+                                              t + self.tick_s)):
+                    outcome = lb.dispatch(send)
+                    outcomes[outcome] = outcomes.get(outcome, 0) + 1
+                    obs.FLEETSIM_REQUESTS.labels(
+                        outcome=outcome).inc()
+                    requests += 1
+                fleet.end_tick()
+                t = vclock.advance(self.tick_s)
+                if not warmup_marked and t >= sc.warmup_s:
+                    evaluator.mark('warmup_end')
+                    warmup_marked = True
+        except Exception as e:  # noqa: BLE001 — reported + re-raised
+            crash = e
+
+        evaluator.mark('end')
+        replicas_driven = serve_state.next_replica_id(
+            self.service_name) - 1
+        # Cleanup BEFORE evaluation/reporting — even a crash (or a
+        # bug in the evaluator) must not leak armed faults, service
+        # rows, or pressure gauges into the next scenario of this
+        # session.
+        faults.reset()
+        fleet.terminate_all()
+        serve_state.remove_service(self.service_name)
+        obs.QUEUE_DEPTH.set(0)
+        obs.KV_CACHE_UTILIZATION.set(0)
+
+        results = evaluator.evaluate()
+        extra = {
+            'description': sc.description,
+            'seed': self.seed,
+            'scale': self.scale,
+            'replicas_configured': n_replicas,
+            'replicas_driven': replicas_driven,
+            'simulated_seconds': round(t, 3),
+            'ticks': ticks,
+            'tick_seconds': self.tick_s,
+            'wall_seconds': round(time.monotonic() - wall_start, 3),
+            'requests': requests,
+            'outcomes': outcomes,
+            'controller_crashes': controller_crashes,
+            'unrecovered_events': sorted(recovery_pending),
+            'aborted': aborted,
+            'error': (f'{type(crash).__name__}: {crash}'
+                      if crash is not None else None),
+        }
+        path, rc = slo_lib.write_report(
+            self.out_dir, sc.name, results, extra=extra,
+            rc_override=1 if (aborted or crash is not None) else None)
+        if crash is not None:
+            # The failing SLO_*.json is on disk and state is clean;
+            # now fail loudly with the original traceback.
+            raise crash
+        return {'rc': rc, 'report_path': path, 'asserts': results,
+                'extra': extra}
+
+    # -- chaos actions -------------------------------------------------------
+
+    def _apply_event(self, ev: chaos_lib.ChaosEvent, fleet, evaluator,
+                     recovery_pending: Dict[str, Dict[str, float]],
+                     ready: float, t: float) -> None:
+        kw = ev.kwargs
+        sc = self.scenario
+        if ev.action == 'zone_loss':
+            faults.arm('fleet.zone_loss', times=None)
+            fleet.mark_zone_lost(kw['zone'])
+            obs.FLEETSIM_RECOVERY_SECONDS.labels(
+                event='zone_loss').set(-1.0)
+            recovery_pending['zone_loss'] = {
+                't': t, 'target': ready * sc.recovery_threshold}
+        elif ev.action == 'zone_restore':
+            fleet.restore_zone(kw['zone'])
+            if not fleet._lost_zones:  # noqa: SLF001 — sim-internal
+                faults.disarm('fleet.zone_loss')
+        elif ev.action == 'preemption_wave':
+            count = max(1, int(round(kw['count'] * self.scale)))
+            faults.arm('fleet.preemption_wave', times=count)
+            fleet.begin_preemption_wave()
+            obs.FLEETSIM_RECOVERY_SECONDS.labels(
+                event='preemption_wave').set(-1.0)
+            recovery_pending['preemption_wave'] = {
+                't': t, 'target': ready * sc.recovery_threshold}
+        elif ev.action == 'rolling_update':
+            service = serve_state.get_service(self.service_name)
+            serve_state.set_service_version(
+                self.service_name, service['version'] + 1,
+                service['task_yaml'])
+            evaluator.mark('update_start')
+        elif ev.action == 'arm_fault':
+            times = kw.get('times', 1)
+            arm_kwargs = {
+                'times': None if times == 'forever' else times,
+                'latency': kw.get('latency', 0.0),
+            }
+            if kw.get('latency_only'):
+                # exc=None arms a pure slowdown — e.g. a STALLED
+                # controller tick, as opposed to a crashed one.
+                arm_kwargs['exc'] = None
+            faults.arm(kw['point'], **arm_kwargs)
+        elif ev.action == 'disarm_fault':
+            faults.disarm(kw['point'])
+        elif ev.action == 'mark':
+            evaluator.mark(kw['name'])
+
+
+# -- the scenario catalog -----------------------------------------------------
+
+_SMOKE_PROFILE = replicas_lib.ReplicaProfile(
+    startup_median_s=6.0, startup_sigma=0.3,
+    ttft_median_s=0.3, ttft_sigma=0.4,
+    decode_per_token_s=0.02, tokens_median=32, concurrency=8)
+
+_FLEET_PROFILE = replicas_lib.ReplicaProfile(
+    startup_median_s=60.0, startup_sigma=0.35,
+    ttft_median_s=0.35, ttft_sigma=0.45,
+    decode_per_token_s=0.03, tokens_median=64, concurrency=8)
+
+
+SCENARIOS: Dict[str, Scenario] = {}
+
+
+def register(scenario: Scenario) -> Scenario:
+    if scenario.name in SCENARIOS:
+        raise ValueError(f'duplicate scenario {scenario.name!r}')
+    SCENARIOS[scenario.name] = scenario
+    return scenario
+
+
+register(Scenario(
+    name='smoke',
+    description=('Tier-1 gate: ~50 replicas, 90 simulated seconds, '
+                 'one zone loss + one rolling update; asserts TTFT '
+                 'p95, update error rate, and time-to-ready.'),
+    replicas=48,
+    duration_s=90.0, tick_s=2.0, warmup_s=24.0,
+    traffic={'kind': 'constant', 'qps': 120.0},
+    profile=_SMOKE_PROFILE,
+    policy={'max_replicas': 60, 'target_qps_per_replica': 3.0,
+            'target_queue_per_replica': 4.0,
+            'upscale_delay_seconds': 10,
+            'downscale_delay_seconds': 120},
+    # round_robin: requests inside one tick dispatch with zero
+    # simulated overlap, so least_load would see in_flight == 0
+    # everywhere and degenerate to hammering the first replica.
+    lb_policy='round_robin',
+    chaos=(
+        {'at': 40.0, 'action': 'zone_loss', 'zone': 'zone-a'},
+        {'at': 46.0, 'action': 'zone_restore', 'zone': 'zone-a'},
+        {'at': 60.0, 'action': 'rolling_update'},
+    ),
+    slos=(
+        slo_lib.HistQuantileBelow('ttft_p95', threshold=2.0),
+        slo_lib.RatioBelow('error_rate', threshold=0.005),
+        slo_lib.RatioBelow('update_error_rate', threshold=0.005,
+                           window=('update_start', 'end')),
+        slo_lib.GaugeWithin('zone_loss_recovery', threshold=40.0,
+                            labels=(('event', 'zone_loss'),)),
+    ),
+))
+
+register(Scenario(
+    name='zone_loss',
+    description=('The acceptance soak: 1000+ replicas across three '
+                 'zones, a full zone killed and later restored, '
+                 'recovery on the virtual clock.'),
+    replicas=1002,
+    duration_s=900.0, tick_s=5.0, warmup_s=220.0,
+    traffic={'kind': 'diurnal', 'base_qps': 700.0, 'peak_qps': 1000.0,
+             'period_s': 1800.0, 'phase_s': 450.0},
+    profile=_FLEET_PROFILE,
+    policy={'max_replicas': 1100, 'target_qps_per_replica': 1.2,
+            'target_queue_per_replica': 4.0,
+            'upscale_delay_seconds': 30,
+            'downscale_delay_seconds': 600},
+    chaos=(
+        {'at': 300.0, 'action': 'zone_loss', 'zone': 'zone-a'},
+        {'at': 600.0, 'action': 'zone_restore', 'zone': 'zone-a'},
+    ),
+    slos=(
+        slo_lib.HistQuantileBelow('ttft_p95', threshold=3.0),
+        slo_lib.RatioBelow('error_rate', threshold=0.005),
+        slo_lib.RatioBelow(
+            'unavailable_rate', threshold=0.002,
+            num_values=('no_replica', 'all_open')),
+        slo_lib.GaugeWithin('zone_loss_recovery', threshold=300.0,
+                            labels=(('event', 'zone_loss'),)),
+    ),
+))
+
+register(Scenario(
+    name='rolling_update',
+    description=('200 replicas under sustained traffic through a '
+                 'rolling update: the surge/retire pacing must hold '
+                 'error rate and TTFT.'),
+    replicas=200,
+    duration_s=600.0, tick_s=5.0, warmup_s=180.0,
+    traffic={'kind': 'burst',
+             'inner': {'kind': 'constant', 'qps': 260.0},
+             'burst_qps': 120.0, 'at': 420.0, 'duration_s': 90.0},
+    profile=_FLEET_PROFILE,
+    policy={'max_replicas': 260, 'target_qps_per_replica': 1.6,
+            'target_queue_per_replica': 4.0,
+            'upscale_delay_seconds': 30,
+            'downscale_delay_seconds': 600},
+    chaos=(
+        {'at': 200.0, 'action': 'rolling_update'},
+    ),
+    slos=(
+        slo_lib.HistQuantileBelow('ttft_p95', threshold=3.0),
+        slo_lib.RatioBelow('update_error_rate', threshold=0.005,
+                           window=('update_start', 'end')),
+        slo_lib.RatioBelow('error_rate', threshold=0.005),
+    ),
+))
+
+register(Scenario(
+    name='preemption_wave',
+    description=('A spot fleet with dynamic on-demand fallback loses '
+                 'half its replicas in one preemption wave; the '
+                 'fallback autoscaler must cover the gap.'),
+    replicas=300,
+    duration_s=720.0, tick_s=5.0, warmup_s=260.0,
+    traffic={'kind': 'constant', 'qps': 320.0},
+    profile=_FLEET_PROFILE,
+    policy={'max_replicas': 400, 'target_qps_per_replica': 1.2,
+            'use_spot': True,
+            'base_ondemand_fallback_replicas': 10,
+            'dynamic_ondemand_fallback': True,
+            'upscale_delay_seconds': 30,
+            'downscale_delay_seconds': 600},
+    chaos=(
+        {'at': 320.0, 'action': 'preemption_wave', 'count': 150},
+    ),
+    slos=(
+        slo_lib.HistQuantileBelow('ttft_p95', threshold=4.5),
+        slo_lib.RatioBelow('error_rate', threshold=0.01),
+        slo_lib.GaugeWithin('preemption_recovery', threshold=300.0,
+                            labels=(('event', 'preemption_wave'),)),
+    ),
+))

@@ -1,0 +1,216 @@
+"""SLO assertions evaluated from the live skytpu_* metrics registry.
+
+The whole point of the observability layer (PR 1) was that scale and
+robustness claims become *scrapes*, not log archaeology — so the soak
+harness asserts its SLOs against the exact series /metrics would
+expose: histogram quantiles from bucket deltas, error rates from
+counter deltas between named window marks, recovery times from
+gauges. No log parsing anywhere.
+
+Reports land as SLO_<scenario>.json with the same honesty schema the
+bench channel uses: `{rc, scenario, asserts: [...]}` where rc != 0
+means at least one assertion failed (or the run itself died) — a
+driver can gate on rc without parsing assertion bodies.
+"""
+import dataclasses
+import json
+import math
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from skypilot_tpu.observability import metrics as metrics_lib
+
+_DEFAULT_WINDOW = ('warmup_end', 'end')
+
+
+@dataclasses.dataclass(frozen=True)
+class HistQuantileBelow:
+    """q-quantile of a histogram's window delta stays under
+    `threshold`. The quantile is resolved to the bucket upper bound
+    (conservative: the true value is <= the reported one)."""
+    name: str
+    threshold: float
+    metric: str = 'skytpu_fleetsim_ttft_seconds'
+    q: float = 0.95
+    window: Tuple[str, str] = _DEFAULT_WINDOW
+    min_count: int = 1   # zero-sample windows FAIL — silence hides bugs
+
+
+@dataclasses.dataclass(frozen=True)
+class RatioBelow:
+    """sum(counter{label in num_values}) / sum(counter) over the
+    window stays under `threshold` (e.g. hard-error rate during a
+    rolling update)."""
+    name: str
+    threshold: float
+    metric: str = 'skytpu_fleetsim_requests_total'
+    label: str = 'outcome'
+    num_values: Tuple[str, ...] = ('error',)
+    window: Tuple[str, str] = _DEFAULT_WINDOW
+    min_total: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class GaugeWithin:
+    """Current gauge value sits in [lo, threshold] — recovery-time
+    gauges report -1 while recovery never happened, so lo=0 makes
+    'never recovered' a failure, not a pass."""
+    name: str
+    threshold: float
+    metric: str = 'skytpu_fleetsim_recovery_seconds'
+    labels: Tuple[Tuple[str, str], ...] = ()
+    lo: float = 0.0
+
+
+SLOAssert = (HistQuantileBelow, RatioBelow, GaugeWithin)
+
+
+class SLOEvaluator:
+    """Snapshots the registry at named marks; evaluates window deltas.
+
+    Snapshot-and-delta (rather than absolute reads) matters because
+    the registry is process-global: a tier-1 test session runs many
+    scenarios back-to-back and each one's SLOs must only see its own
+    traffic.
+    """
+
+    def __init__(self, asserts: Sequence) -> None:
+        self.asserts = list(asserts)
+        self._marks: Dict[str, Dict] = {}
+
+    def _needed_metrics(self) -> List[str]:
+        return sorted({a.metric for a in self.asserts
+                       if not isinstance(a, GaugeWithin)})
+
+    def mark(self, name: str) -> None:
+        snap = {}
+        for mname in self._needed_metrics():
+            metric = metrics_lib.REGISTRY.get(mname)
+            if metric is not None:
+                snap[mname] = {(series, labels): value
+                               for series, labels, value
+                               in metric.samples()}
+        self._marks[name] = snap
+
+    def _delta(self, metric: str, window: Tuple[str, str]
+               ) -> Optional[Dict]:
+        start, end = window
+        if start not in self._marks or end not in self._marks:
+            return None
+        s0 = self._marks[start].get(metric, {})
+        s1 = self._marks[end].get(metric, {})
+        return {key: value - s0.get(key, 0.0)
+                for key, value in s1.items()}
+
+    # -- per-kind evaluation --------------------------------------------------
+
+    def _eval_quantile(self, a: HistQuantileBelow) -> Dict:
+        delta = self._delta(a.metric, a.window)
+        if delta is None:
+            return _result(a, math.nan, False,
+                           f'window {a.window} never marked')
+        buckets: List[Tuple[float, float]] = []
+        count = 0.0
+        for (series, labels), value in delta.items():
+            if series == f'{a.metric}_bucket':
+                le = dict(labels)['le']
+                bound = math.inf if le == '+Inf' else float(le)
+                buckets.append((bound, value))
+            elif series == f'{a.metric}_count':
+                count += value
+        if count < a.min_count:
+            return _result(a, math.nan, False,
+                           f'only {int(count)} samples in window '
+                           f'(min {a.min_count})')
+        value = math.inf
+        for bound, cum in sorted(buckets):
+            if cum >= a.q * count:
+                value = bound
+                break
+        return _result(a, value, value <= a.threshold,
+                       f'p{int(a.q * 100)} over {int(count)} samples')
+
+    def _eval_ratio(self, a: RatioBelow) -> Dict:
+        delta = self._delta(a.metric, a.window)
+        if delta is None:
+            return _result(a, math.nan, False,
+                           f'window {a.window} never marked')
+        num = total = 0.0
+        for (series, labels), value in delta.items():
+            if series != a.metric:
+                continue
+            total += value
+            if dict(labels).get(a.label) in a.num_values:
+                num += value
+        if total < a.min_total:
+            return _result(a, math.nan, False,
+                           f'only {int(total)} events in window '
+                           f'(min {a.min_total})')
+        ratio = num / total
+        return _result(a, ratio, ratio <= a.threshold,
+                       f'{int(num)}/{int(total)} '
+                       f'{"|".join(a.num_values)}')
+
+    def _eval_gauge(self, a: GaugeWithin) -> Dict:
+        metric = metrics_lib.REGISTRY.get(a.metric)
+        if metric is None:
+            return _result(a, math.nan, False,
+                           f'{a.metric} not registered')
+        # Existence check first: a never-touched series reads 0.0
+        # through value(), and 0.0 sits inside [lo, threshold] — a
+        # chaos event that never fired must not report "recovered in
+        # 0s".
+        want = dict(a.labels)
+        value = None
+        for series, labels, sample in metric.samples():
+            if series == a.metric and dict(labels) == want:
+                value = sample
+                break
+        if value is None:
+            return _result(a, math.nan, False,
+                           'series never written — did its chaos '
+                           'event fire?')
+        return _result(a, value, a.lo <= value <= a.threshold,
+                       f'bounds [{a.lo}, {a.threshold}]')
+
+    def evaluate(self) -> List[Dict]:
+        out = []
+        for a in self.asserts:
+            if isinstance(a, HistQuantileBelow):
+                out.append(self._eval_quantile(a))
+            elif isinstance(a, RatioBelow):
+                out.append(self._eval_ratio(a))
+            elif isinstance(a, GaugeWithin):
+                out.append(self._eval_gauge(a))
+            else:
+                raise TypeError(f'unknown SLO assert {a!r}')
+        return out
+
+
+def _result(a, value: float, ok: bool, detail: str) -> Dict:
+    if value != value:  # NaN is not JSON-portable
+        value = None
+    elif value in (math.inf, -math.inf):
+        value = 'inf'
+    return {'name': a.name, 'metric': a.metric, 'ok': bool(ok),
+            'value': value, 'threshold': a.threshold, 'detail': detail}
+
+
+def write_report(out_dir: str, scenario: str, results: List[Dict],
+                 extra: Optional[Dict] = None,
+                 rc_override: Optional[int] = None) -> Tuple[str, int]:
+    """Write SLO_<scenario>.json in the shared `{rc, scenario,
+    asserts}` evidence schema; returns (path, rc). rc_override forces
+    a non-zero rc for runs that died before evaluating (a crashed soak
+    must not look like a passing one)."""
+    rc = rc_override if rc_override is not None else \
+        (0 if results and all(r['ok'] for r in results) else 1)
+    path = os.path.join(out_dir, f'SLO_{scenario}.json')
+    payload = {'rc': rc, 'scenario': scenario, 'asserts': results,
+               'extra': extra or {}}
+    tmp = path + '.tmp'
+    with open(tmp, 'w', encoding='utf-8') as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write('\n')
+    os.replace(tmp, path)
+    return path, rc

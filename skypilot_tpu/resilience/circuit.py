@@ -98,6 +98,15 @@ class CircuitBreaker:
             t = self._targets.get(target)
             return t.state if t is not None else State.CLOSED
 
+    def snapshot(self) -> Dict[str, State]:
+        """Target -> state for every tracked target, WITHOUT driving
+        the open -> half-open transition (allow() mutates; a stats
+        endpoint polled by dashboards must not burn half-open trial
+        slots)."""
+        with self._lock:
+            return {target: t.state
+                    for target, t in self._targets.items()}
+
     # -- outcome feedback ----------------------------------------------------
 
     def record_success(self, target: str) -> None:

@@ -87,6 +87,26 @@ CHECKPOINT_SAVE = declare(
 HEARTBEAT_RECV = declare(
     'heartbeat.recv',
     'The API server accepting one skylet liveness heartbeat.')
+LB_UPSTREAM_MIDSTREAM = declare(
+    'lb.upstream_midstream',
+    'The load balancer reading the NEXT body chunk from an upstream '
+    'that already sent response bytes (fires mid-stream, after the '
+    'client saw headers — failover is no longer possible).')
+CONTROLLER_STEP = declare(
+    'controller.step',
+    'One serve-controller reconcile tick (probe -> autoscale -> LB '
+    'sync); arming with latency simulates a stalled controller, with '
+    'an exception a crashed tick.')
+FLEET_ZONE_LOSS = declare(
+    'fleet.zone_loss',
+    'One replica killed by a simulated zone outage (fleetsim chaos '
+    'schedules arm this while a zone is marked lost; each firing is '
+    'one replica down).')
+FLEET_PREEMPTION_WAVE = declare(
+    'fleet.preemption_wave',
+    'One spot replica killed by a simulated preemption wave; the '
+    'armed `times` bound IS the wave size, so '
+    'SKYTPU_FAULTS=fleet.preemption_wave:300 preempts 300 replicas.')
 
 
 def registered_points() -> Dict[str, str]:

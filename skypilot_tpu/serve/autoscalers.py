@@ -1,8 +1,12 @@
-"""Autoscalers: QPS-target scaling with hysteresis.
+"""Autoscalers: QPS-target scaling with hysteresis + load signals.
 
 Reference analog: sky/serve/autoscalers.py (`Autoscaler` :116,
 `RequestRateAutoscaler` :441: target_qps_per_replica with
 upscale/downscale delays so transient spikes/dips don't thrash).
+Beyond the reference: `LoadSignals` feeds engine-side pressure (queue
+depth, KV-cache utilization from the `skytpu_*` registry) into the
+same hysteresis pipeline, so scaling can react to saturation the
+request *rate* alone can't see (long prompts, slow decodes).
 """
 import dataclasses
 import time
@@ -17,6 +21,39 @@ class ScalingDecision:
     reason: str = ''
 
 
+@dataclasses.dataclass(frozen=True)
+class LoadSignals:
+    """One reading of the fleet's load beyond raw request rate.
+
+    queue_depth is fleet-wide requests accepted but not yet decoding;
+    kv_util is the mean fraction of KV-cache positions holding live
+    tokens (0-1). None means "signal unavailable" — scaling then
+    falls back to pure request rate.
+    """
+    queue_depth: Optional[float] = None
+    kv_util: Optional[float] = None
+
+
+class MetricsSignalSource:
+    """Reads LoadSignals off THIS process's skytpu_* registry
+    (skytpu_queue_depth / skytpu_kv_cache_utilization) — the same
+    series /metrics exposes, so what the autoscaler acted on is
+    always scrape-able after the fact.
+
+    Scope caveat: those gauges are written by whatever shares the
+    process — the fleet simulator's SimFleet, or a co-located engine.
+    A production controller whose replicas run elsewhere reads 0.0
+    (signals absent, scaling falls back to request rate) until a
+    scraping source is wired in: the controller takes any object with
+    read() via its signal_source seam, and aggregating replica
+    /metrics into one is the ROADMAP item-3 follow-up."""
+
+    def read(self) -> LoadSignals:
+        from skypilot_tpu.observability import instruments as obs
+        return LoadSignals(queue_depth=obs.QUEUE_DEPTH.value(),
+                           kv_util=obs.KV_CACHE_UTILIZATION.value())
+
+
 class Autoscaler:
     def __init__(self, spec: spec_lib.ServiceSpec) -> None:
         self.spec = spec
@@ -25,7 +62,8 @@ class Autoscaler:
         self.spec = spec
 
     def decide(self, num_ready: int, num_total: int,
-               qps: Optional[float]) -> ScalingDecision:
+               qps: Optional[float],
+               signals: Optional[LoadSignals] = None) -> ScalingDecision:
         raise NotImplementedError
 
 
@@ -33,7 +71,8 @@ class FixedReplicaAutoscaler(Autoscaler):
     """No autoscaling: hold min_replicas."""
 
     def decide(self, num_ready: int, num_total: int,
-               qps: Optional[float]) -> ScalingDecision:
+               qps: Optional[float],
+               signals: Optional[LoadSignals] = None) -> ScalingDecision:
         return ScalingDecision(self.spec.min_replicas, 'fixed')
 
 
@@ -47,21 +86,39 @@ class RequestRateAutoscaler(Autoscaler):
         self._upscale_since: Optional[float] = None
         self._downscale_since: Optional[float] = None
 
-    def _desired(self, qps: float) -> int:
+    def _desired(self, qps: float,
+                 signals: Optional[LoadSignals] = None) -> int:
         import math
         target = self.spec.target_qps_per_replica
         desired = math.ceil(qps / target) if target else \
             self.spec.min_replicas
+        # Pressure signals only ever RAISE the rate-derived target:
+        # queue depth / KV saturation mean the current fleet is behind
+        # even if qps looks fine; their absence (or low values) must
+        # not fight the rate signal downward.
+        if signals is not None:
+            tqd = self.spec.target_queue_per_replica
+            if tqd and signals.queue_depth:
+                desired = max(desired,
+                              math.ceil(signals.queue_depth / tqd))
+            kv_hi = self.spec.kv_util_upscale_threshold
+            if kv_hi is not None and signals.kv_util is not None and \
+                    signals.kv_util >= kv_hi:
+                # Saturated caches: one more replica per decision
+                # round — bounded pressure relief, hysteresis still
+                # paces the actual resize.
+                desired += 1
         lo = self.spec.min_replicas
         hi = self.spec.max_replicas or max(lo, desired)
         return max(lo, min(hi, desired))
 
     def decide(self, num_ready: int, num_total: int,
-               qps: Optional[float]) -> ScalingDecision:
+               qps: Optional[float],
+               signals: Optional[LoadSignals] = None) -> ScalingDecision:
         if qps is None:
             return ScalingDecision(max(num_total, self.spec.min_replicas),
                                    'no traffic data')
-        desired = self._desired(qps)
+        desired = self._desired(qps, signals)
         now = self._now()
         if desired > num_total:
             self._downscale_since = None
@@ -112,13 +169,15 @@ class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
 
     def decide_mixed(self, num_ready_spot: int, num_spot: int,
                      num_ondemand: int,
-                     qps: Optional[float]) -> MixedScalingDecision:
+                     qps: Optional[float],
+                     signals: Optional[LoadSignals] = None
+                     ) -> MixedScalingDecision:
         base = self.spec.base_ondemand_fallback_replicas
         dynamic = self.spec.dynamic_ondemand_fallback
         current = num_spot + num_ondemand
         # Hysteresis-filtered total target over the whole fleet.
         total = self.decide(num_ready_spot + num_ondemand, current,
-                            qps).target_replicas
+                            qps, signals).target_replicas
         if total == current:
             # Hold: no resize is due (at target, or a scale is pending
             # its hysteresis delay) — keep the pools as they are, only
@@ -126,8 +185,27 @@ class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
             spot_target, ondemand_target = num_spot, num_ondemand
             if dynamic:
                 shortfall = max(0, num_spot - num_ready_spot)
-                ondemand_target = min(max(total, num_ondemand),
-                                      num_ondemand + shortfall)
+                # Cap the cover at what the RATE actually needs beyond
+                # ready spot. Capping at the hysteresis-held `total`
+                # (== current) compounds instead: every tick's cover
+                # inflates `current`, which licenses a bigger cover
+                # next tick — during a spot stockout that launched
+                # shortfall-many NEW on-demand replicas per tick,
+                # unboundedly (caught by the fleetsim preemption_wave
+                # soak: 4416 replicas driven for a 300-replica fleet).
+                if qps is None:
+                    cover_cap = num_ondemand
+                else:
+                    cover_cap = max(0, self._desired(qps, signals)
+                                    - num_ready_spot)
+                ondemand_target = min(num_ondemand + shortfall,
+                                      max(num_ondemand, cover_cap))
+                if self.spec.max_replicas is not None:
+                    # The user's hard spend ceiling outranks cover:
+                    # spot pool + cover together never exceed it.
+                    ondemand_target = min(
+                        ondemand_target,
+                        max(0, self.spec.max_replicas - num_spot))
         else:
             spot_target = max(0, total - base)
             ondemand_target = min(base, total)
@@ -141,12 +219,16 @@ class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
             f'total={total} spot_ready={num_ready_spot}')
 
 
-def make_autoscaler(spec: spec_lib.ServiceSpec) -> Autoscaler:
+def make_autoscaler(spec: spec_lib.ServiceSpec,
+                    now_fn=time.time) -> Autoscaler:
+    """now_fn is the hysteresis clock seam: the fleet simulator runs
+    upscale/downscale delays on a virtual clock, production uses
+    time.time."""
     if spec.use_spot and (spec.base_ondemand_fallback_replicas > 0
                           or spec.dynamic_ondemand_fallback):
-        return FallbackRequestRateAutoscaler(spec)
+        return FallbackRequestRateAutoscaler(spec, now_fn=now_fn)
     if spec.max_replicas is not None and \
             spec.max_replicas > spec.min_replicas and \
             spec.target_qps_per_replica is not None:
-        return RequestRateAutoscaler(spec)
+        return RequestRateAutoscaler(spec, now_fn=now_fn)
     return FixedReplicaAutoscaler(spec)

@@ -11,9 +11,11 @@ import logging
 import os
 import time
 import traceback
+from typing import Callable, Optional
 
 from skypilot_tpu import envs
 from skypilot_tpu.observability import instruments as obs
+from skypilot_tpu.resilience import faults
 from skypilot_tpu.serve import autoscalers
 from skypilot_tpu.serve import load_balancer as lb_lib
 from skypilot_tpu.serve import replica_managers
@@ -39,8 +41,21 @@ def _pick_victims(pool, n, protected=frozenset()):
 
 
 class ServeController:
+    """One service's reconcile loop.
 
-    def __init__(self, service_name: str) -> None:
+    `manager`, `lb`, `now_fn` and `sleep_fn` are injection seams: the
+    fleet simulator (skypilot_tpu/fleetsim) drives this EXACT class
+    against thousands of mock replicas on a virtual clock, so the
+    reconcile logic soak-tested in CI is the code production runs —
+    the same discipline resilience/retries.py uses for its clocks.
+    """
+
+    def __init__(self, service_name: str,
+                 manager=None, lb=None,
+                 now_fn: Callable[[], float] = time.time,
+                 sleep_fn: Callable[[float], None] = time.sleep,
+                 signal_source: Optional[
+                     autoscalers.MetricsSignalSource] = None) -> None:
         self.service_name = service_name
         service = serve_state.get_service(service_name)
         assert service is not None, service_name
@@ -48,11 +63,18 @@ class ServeController:
         self.task = task_lib.Task.from_yaml_config(service['task_yaml'])
         assert self.task.service is not None
         self.spec: spec_lib.ServiceSpec = self.task.service
-        self.manager = replica_managers.ReplicaManager(
-            service_name, self.task, self.spec)
-        self.autoscaler = autoscalers.make_autoscaler(self.spec)
-        self.lb = lb_lib.LoadBalancer(self.spec.load_balancing_policy,
-                                      port=service['lb_port'])
+        self.manager = manager if manager is not None else \
+            replica_managers.ReplicaManager(
+                service_name, self.task, self.spec)
+        self.autoscaler = autoscalers.make_autoscaler(self.spec,
+                                                      now_fn=now_fn)
+        self.lb = lb if lb is not None else lb_lib.LoadBalancer(
+            self.spec.load_balancing_policy, port=service['lb_port'],
+            now_fn=now_fn)
+        self.signals = signal_source if signal_source is not None \
+            else autoscalers.MetricsSignalSource()
+        self._now = now_fn
+        self._sleep = sleep_fn
         self._stop = False
 
     def run(self) -> None:
@@ -65,7 +87,7 @@ class ServeController:
             self.manager.scale_up(self.spec.min_replicas)
             while not self._stop:
                 self._step()
-                time.sleep(_loop_interval_seconds())
+                self._sleep(_loop_interval_seconds())
         except BaseException:  # noqa: BLE001
             traceback.print_exc()
             serve_state.set_service_status(
@@ -73,6 +95,10 @@ class ServeController:
             raise
 
     def _step(self) -> None:
+        # Armed with latency this models a stalled controller, with an
+        # exception a crashed tick — chaos schedules exercise both.
+        faults.inject('controller.step', sleep_fn=self._sleep,
+                      env_exc=RuntimeError)
         service = serve_state.get_service(self.service_name)
         if service is None or \
                 service['status'] == serve_state.ServiceStatus.SHUTTING_DOWN:
@@ -109,7 +135,8 @@ class ServeController:
             target = self._scale_mixed(live, protected)
         else:
             decision = self.autoscaler.decide(
-                len(ready), len(live), self.lb.tracker.qps())
+                len(ready), len(live), self.lb.tracker.qps(),
+                self.signals.read())
             target = decision.target_replicas
             if decision.target_replicas > len(live):
                 self.manager.scale_up(
@@ -157,7 +184,7 @@ class ServeController:
                       if r['status'] == serve_state.ReplicaStatus.READY]
         decision = self.autoscaler.decide_mixed(
             len(ready_spot), len(spot), len(ondemand),
-            self.lb.tracker.qps())
+            self.lb.tracker.qps(), self.signals.read())
 
         def reconcile(pool, target, use_spot):
             if target > len(pool):

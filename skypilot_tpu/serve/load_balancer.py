@@ -7,10 +7,12 @@ QPS window the controller's autoscaler reads via /internal/stats.
 import asyncio
 import collections
 import contextlib
+import itertools
 import threading
 import time
-from typing import List, Optional
+from typing import Callable, List, Optional
 
+from skypilot_tpu import envs
 from skypilot_tpu.observability import instruments as obs
 from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.resilience import circuit
@@ -21,16 +23,17 @@ _QPS_WINDOW_SECONDS = 60.0
 
 
 class RequestRateTracker:
-    def __init__(self) -> None:
+    def __init__(self, now_fn: Callable[[], float] = time.time) -> None:
         self._times = collections.deque()
         self._lock = threading.Lock()
+        self._now = now_fn
 
     def record(self) -> None:
         with self._lock:
-            self._times.append(time.time())
+            self._times.append(self._now())
 
     def qps(self) -> float:
-        cutoff = time.time() - _QPS_WINDOW_SECONDS
+        cutoff = self._now() - _QPS_WINDOW_SECONDS
         with self._lock:
             while self._times and self._times[0] < cutoff:
                 self._times.popleft()
@@ -39,14 +42,19 @@ class RequestRateTracker:
 
 class LoadBalancer:
     def __init__(self, policy_name: str = 'least_load',
-                 port: int = 0) -> None:
+                 port: int = 0,
+                 now_fn: Callable[[], float] = time.time) -> None:
         self.policy = lb_policies.make_policy(policy_name)
         self.port = port
-        self.tracker = RequestRateTracker()
+        self.tracker = RequestRateTracker(now_fn)
         # Replica endpoints that keep failing at the transport layer
-        # get routed around instead of 502ing live traffic.
+        # get routed around instead of 502ing live traffic. now_fn is
+        # the clock seam: the fleet simulator runs breaker recovery
+        # windows on its virtual clock; the production default keeps
+        # the breaker on monotonic time (immune to wall-clock jumps).
         self.breaker = circuit.CircuitBreaker(
-            'lb', failure_threshold=3, recovery_timeout=15.0)
+            'lb', failure_threshold=3, recovery_timeout=15.0,
+            now_fn=(time.monotonic if now_fn is time.time else now_fn))
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._runner = None
         self._thread: Optional[threading.Thread] = None
@@ -57,31 +65,86 @@ class LoadBalancer:
         for gone in old:
             self.breaker.forget(gone)
 
-    def _candidates(self) -> List[str]:
+    def _failover_order(self):
         """Upstream try-order: the policy's pick first, then every
         other replica — a failed upstream must not 502 the client
-        while healthy replicas exist."""
+        while healthy replicas exist. None when the rotation is
+        empty; otherwise a LAZY iterator (the common case consumes
+        one element, and a 1000-replica rotation must not allocate a
+        full list per request). Shared by the HTTP proxy AND
+        dispatch(), so the simulator routes exactly like production."""
         first = self.policy.select()
         if first is None:
-            return []
-        rest = [r for r in self.policy.replicas if r != first]
-        return [first] + rest
+            return None
+        return itertools.chain(
+            (first,), (r for r in self.policy.replicas if r != first))
+
+    # -- the simulator / non-HTTP seam ---------------------------------------
+
+    def dispatch(self, send: Callable[[str], bool]) -> str:
+        """Route ONE request through the real policy + breaker +
+        failover discipline without the HTTP layer — the fleet
+        simulator's seam into this LB. `send(url)` performs the
+        request against one upstream and returns success; failures
+        feed the breaker and fail over exactly like _handle_proxy's
+        pre-bytes phase. Returns 'ok', 'no_replica' (empty rotation),
+        'all_open' (candidates exist, every circuit open) or 'error'
+        (every attempted upstream failed)."""
+        self.tracker.record()
+        candidates = self._failover_order()
+        if candidates is None:
+            obs.LB_NO_REPLICA.inc()
+            return 'no_replica'
+        attempted = 0
+        for target in candidates:
+            if not self.breaker.allow(target):
+                continue
+            attempted += 1
+            if attempted > 1:
+                obs.LB_UPSTREAM_RETRIES.inc()
+            obs.LB_REPLICA_REQUESTS.labels(replica=target).inc()
+            self.policy.on_request_start(target)
+            try:
+                ok = send(target)
+            finally:
+                self.policy.on_request_end(target)
+            if ok:
+                self.breaker.record_success(target)
+                return 'ok'
+            obs.LB_PROXY_ERRORS.inc()
+            self.breaker.record_failure(target)
+        if attempted == 0:
+            obs.LB_NO_REPLICA.inc()
+            return 'all_open'
+        return 'error'
 
     # -- aiohttp handlers ----------------------------------------------------
 
     async def _handle_stats(self, request):
         from aiohttp import web
+        # Per-replica circuit state + how many replicas are actually
+        # routable: when traffic shifts, operators (and the soak
+        # harness) can see WHY from this one endpoint. snapshot() is
+        # non-mutating — polling stats must not burn half-open trials.
+        states = self.breaker.snapshot()
+        replicas = list(self.policy.replicas)
+        breakers = {
+            url: states.get(url, circuit.State.CLOSED).name.lower()
+            for url in replicas}
         return web.json_response({
             'qps': self.tracker.qps(),
-            'replicas': list(self.policy.replicas),
+            'replicas': replicas,
+            'breakers': breakers,
+            'candidates': sum(1 for s in breakers.values()
+                              if s != 'open'),
         })
 
     async def _handle_proxy(self, request):
         from aiohttp import ClientSession, ClientTimeout, web
         import aiohttp
         self.tracker.record()
-        candidates = self._candidates()
-        if not candidates:
+        candidates = self._failover_order()
+        if candidates is None:
             obs.LB_NO_REPLICA.inc()
             return web.Response(
                 status=503, headers={'Retry-After': '1'},
@@ -141,19 +204,57 @@ class LoadBalancer:
                                  'connection')})
                 try:
                     await response.prepare(request)
-                    async for chunk in \
-                            upstream.content.iter_chunked(64 * 1024):
-                        await response.write(chunk)
-                    await response.write_eof()
-                    return response
                 except (OSError, aiohttp.ClientError):
-                    obs.LB_PROXY_ERRORS.inc()
-                    # Headers (and possibly bytes) may already be
-                    # out: a retry would corrupt the stream — the
-                    # only honest signal left is truncating it.
-                    with contextlib.suppress(Exception):
-                        await response.write_eof()
+                    # Client socket failed before headers went out.
                     return response
+                # Per-READ timeout (not a session-wide sock_read,
+                # which would also cap time-to-first-byte and fail
+                # slow prefills onto the breaker): only the gap
+                # between chunks of an ALREADY-STARTED stream is
+                # bounded — a wedged upstream mid-stream must
+                # terminate the client's response, not hang it.
+                read_gap = envs.SKYTPU_LB_STREAM_READ_TIMEOUT.get()
+                while True:
+                    # Upstream reads and client writes fail for
+                    # DIFFERENT parties; keep them in separate try
+                    # blocks so a dead replica is never blamed on the
+                    # client or vice versa.
+                    try:
+                        faults.inject('lb.upstream_midstream',
+                                      env_exc=OSError)
+                        chunk = await asyncio.wait_for(
+                            upstream.content.readany(),
+                            timeout=read_gap if read_gap > 0
+                            else None)
+                    except (asyncio.TimeoutError, OSError,
+                            aiohttp.ClientError):
+                        # The upstream died AFTER bytes went out: a
+                        # retry would corrupt the stream, and a clean
+                        # write_eof would forge a COMPLETE chunked
+                        # response out of a truncated one. The only
+                        # honest signal left is closing the client
+                        # connection mid-body.
+                        obs.LB_PROXY_ERRORS.inc()
+                        obs.LB_MIDSTREAM_FAILURES.inc()
+                        response.force_close()
+                        with contextlib.suppress(Exception):
+                            request.transport.close()
+                        return response
+                    if not chunk:
+                        break
+                    try:
+                        await response.write(chunk)
+                    except (OSError, aiohttp.ClientError):
+                        # The CLIENT went away; the replica is fine.
+                        return response
+                try:
+                    await response.write_eof()
+                except (OSError, aiohttp.ClientError):
+                    # Client vanished between last chunk and EOF —
+                    # also not the replica's fault, and not worth an
+                    # unhandled-error traceback.
+                    pass
+                return response
             finally:
                 self.policy.on_request_end(target)
                 if upstream is not None:

@@ -50,6 +50,12 @@ class ServiceSpec:
     spot_zones: Optional[list] = None
     base_ondemand_fallback_replicas: int = 0
     dynamic_ondemand_fallback: bool = False
+    # Metrics-driven scaling signals (beyond raw request rate): queued
+    # requests per replica the fleet should absorb, and the KV-cache
+    # utilization above which decode capacity counts as saturated.
+    # None disables the respective signal.
+    target_queue_per_replica: Optional[float] = None
+    kv_util_upscale_threshold: Optional[float] = None
 
     @classmethod
     def from_yaml_config(cls, cfg: Dict[str, Any]) -> 'ServiceSpec':
@@ -82,6 +88,10 @@ class ServiceSpec:
                 policy.get('base_ondemand_fallback_replicas', 0)),
             dynamic_ondemand_fallback=bool(
                 policy.get('dynamic_ondemand_fallback', False)),
+            target_queue_per_replica=policy.get(
+                'target_queue_per_replica'),
+            kv_util_upscale_threshold=policy.get(
+                'kv_util_upscale_threshold'),
         )
         if spec.max_replicas is not None and \
                 spec.max_replicas < spec.min_replicas:
@@ -123,6 +133,12 @@ class ServiceSpec:
             pol['max_replicas'] = self.max_replicas
         if self.target_qps_per_replica is not None:
             pol['target_qps_per_replica'] = self.target_qps_per_replica
+        if self.target_queue_per_replica is not None:
+            pol['target_queue_per_replica'] = \
+                self.target_queue_per_replica
+        if self.kv_util_upscale_threshold is not None:
+            pol['kv_util_upscale_threshold'] = \
+                self.kv_util_upscale_threshold
         if self.use_spot:
             pol['use_spot'] = True
             if self.spot_zones:

@@ -135,6 +135,8 @@ SERVICE_SCHEMA: Dict[str, Any] = {
                 'spot_zones': {'type': 'array', 'items': _STR},
                 'base_ondemand_fallback_replicas': _INT,
                 'dynamic_ondemand_fallback': _BOOL,
+                'target_queue_per_replica': _NUM,
+                'kv_util_upscale_threshold': _NUM,
             },
         },
     },

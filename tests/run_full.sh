@@ -9,3 +9,11 @@ cd "$(dirname "$0")/.."
 python -m skypilot_tpu.analysis
 python -m pytest tests/ -q
 python -m pytest tests/ -q -m slow
+# Fleet-scale soak gate: every registered scenario through the CLI
+# (virtual clock; minutes of simulated chaos, seconds of wall time).
+# Non-zero rc == an SLO regression; SLO_<scenario>.json carries the
+# evidence. JAX_PLATFORMS=cpu keeps the sim off any real accelerator.
+for scenario in smoke zone_loss rolling_update preemption_wave; do
+    JAX_PLATFORMS=cpu python -m skypilot_tpu.fleetsim \
+        --scenario "$scenario" --out /tmp
+done

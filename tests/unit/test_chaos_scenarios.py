@@ -152,6 +152,53 @@ class TestLoadBalancerFailover:
         balancer.set_replicas([good])  # DEAD removed from rotation
         assert balancer.breaker.state(DEAD) == circuit.State.CLOSED
 
+    def test_midstream_upstream_death_terminates_stream(self, lb):
+        """Upstream dies AFTER response bytes went out: the client's
+        connection is CLOSED (honest truncation, counted in
+        skytpu_lb_midstream_failures_total) — never a forged complete
+        response, never a hang, and never blamed on the replica's
+        breaker."""
+        import http.client
+        balancer, lb_url, good = lb
+        balancer.set_replicas([good])
+        faults.arm('lb.upstream_midstream', times=1,
+                   exc=OSError('injected upstream death mid-stream'))
+        before = obs.LB_MIDSTREAM_FAILURES.value()
+        body = None
+        try:
+            with urllib.request.urlopen(lb_url + '/healthz',
+                                        timeout=10) as resp:
+                assert resp.status == 200  # headers were already out
+                body = resp.read()
+        except (http.client.HTTPException, ConnectionError,
+                urllib.error.URLError):
+            pass  # truncated/reset stream — the honest outcomes
+        assert not body, 'truncated stream forged a complete body'
+        assert obs.LB_MIDSTREAM_FAILURES.value() == before + 1
+        # Mid-stream death is NOT a pre-bytes transport failure: the
+        # replica answered, so its circuit must stay closed.
+        assert balancer.breaker.state(good) == circuit.State.CLOSED
+        # Disarmed: the very next request streams cleanly end-to-end.
+        status, clean = _get(lb_url + '/healthz')
+        assert status == 200
+        assert json.loads(clean) == {'ok': True}
+
+    def test_stats_expose_breaker_states_and_candidates(self, lb):
+        """/internal/stats shows WHY traffic shifted: per-replica
+        circuit state plus the routable candidate count."""
+        balancer, lb_url, good = lb
+        balancer.set_replicas([DEAD, good])
+        for _ in range(8):
+            status, _body = _get(lb_url + '/healthz')
+            assert status == 200
+        status, raw = _get(lb_url + '/internal/stats')
+        assert status == 200
+        stats = json.loads(raw)
+        assert stats['breakers'][DEAD] == 'open'
+        assert stats['breakers'][good] == 'closed'
+        assert stats['candidates'] == 1
+        assert sorted(stats['replicas']) == sorted([DEAD, good])
+
 
 # --- probe classification + breaker ----------------------------------------
 

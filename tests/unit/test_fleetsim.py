@@ -1,0 +1,396 @@
+"""Fleet soak harness: component units + the tier-1 smoke scenario.
+
+The smoke scenario is the CI gate the ISSUE's acceptance names: ~50
+replicas through a zone loss AND a rolling update on the virtual
+clock, with TTFT p95, update error rate, and post-zone-loss
+time-to-ready all asserted from the live skytpu_* metrics registry.
+Full-scale soaks (1000+ replicas) are `-m slow` and also run via
+tests/run_full.sh.
+"""
+import json
+import os
+import random
+import time
+
+import pytest
+
+from skypilot_tpu.fleetsim import chaos as chaos_lib
+from skypilot_tpu.fleetsim import clock as clock_lib
+from skypilot_tpu.fleetsim import replicas as replicas_lib
+from skypilot_tpu.fleetsim import runner as runner_lib
+from skypilot_tpu.fleetsim import slo as slo_lib
+from skypilot_tpu.fleetsim import traffic as traffic_lib
+from skypilot_tpu.observability import instruments as obs
+from skypilot_tpu.resilience import faults
+from skypilot_tpu.serve import serve_state
+
+SVC = 'fleetsim-test'
+
+
+@pytest.fixture(autouse=True)
+def clean_sim_state():
+    faults.reset()
+    serve_state.reset_for_tests()
+    yield
+    faults.reset()
+    serve_state.reset_for_tests()
+
+
+# --- virtual clock ----------------------------------------------------------
+
+class TestVirtualClock:
+
+    def test_advance_and_sleep_move_time(self):
+        clk = clock_lib.VirtualClock()
+        assert clk.now() == 0.0
+        clk.advance(5.0)
+        clk.sleep(2.5)
+        assert clk.now() == 7.5
+
+    def test_rewind_rejected(self):
+        with pytest.raises(ValueError):
+            clock_lib.VirtualClock().advance(-1.0)
+
+
+# --- traffic ----------------------------------------------------------------
+
+class TestTraffic:
+
+    def test_same_seed_same_arrivals(self):
+        curve = traffic_lib.parse({'kind': 'constant', 'qps': 50.0})
+        a = [curve.arrivals(random.Random(3), t, t + 5) for t in
+             range(0, 50, 5)]
+        b = [curve.arrivals(random.Random(3), t, t + 5) for t in
+             range(0, 50, 5)]
+        assert a == b
+        assert sum(a) > 0
+
+    def test_diurnal_stays_within_band(self):
+        curve = traffic_lib.DiurnalTraffic(10.0, 50.0, period_s=600.0)
+        rates = [curve.rate(t) for t in range(0, 600, 7)]
+        assert min(rates) >= 10.0 - 1e-9
+        assert max(rates) <= 50.0 + 1e-9
+
+    def test_burst_adds_only_inside_window(self):
+        curve = traffic_lib.parse({
+            'kind': 'burst', 'inner': {'kind': 'constant', 'qps': 5.0},
+            'burst_qps': 20.0, 'at': 100.0, 'duration_s': 50.0})
+        assert curve.rate(99.0) == 5.0
+        assert curve.rate(100.0) == 25.0
+        assert curve.rate(149.9) == 25.0
+        assert curve.rate(150.0) == 5.0
+
+    def test_trace_replay_is_a_step_function(self):
+        curve = traffic_lib.TraceTraffic([[0, 2.0], [60, 8.0],
+                                          [120, 1.0]])
+        assert curve.rate(30) == 2.0
+        assert curve.rate(60) == 8.0
+        assert curve.rate(500) == 1.0
+
+    def test_poisson_zero_rate(self):
+        assert traffic_lib.poisson(random.Random(0), 0.0) == 0
+
+
+# --- chaos schedules --------------------------------------------------------
+
+class TestChaosSchedule:
+
+    def test_events_fire_in_order_once(self):
+        sched = chaos_lib.ChaosSchedule.from_config([
+            {'at': 30, 'action': 'rolling_update'},
+            {'at': 10, 'action': 'zone_loss', 'zone': 'z'},
+        ])
+        assert [e.action for e in sched.pop_due(10.0)] == ['zone_loss']
+        assert sched.pop_due(10.0) == []
+        assert [e.action for e in sched.pop_due(99.0)] == \
+            ['rolling_update']
+        assert sched.remaining() == 0
+
+    def test_unknown_action_rejected(self):
+        with pytest.raises(ValueError):
+            chaos_lib.ChaosEvent(1.0, 'meteor_strike')
+
+
+# --- SLO evaluation from the registry ---------------------------------------
+
+class TestSLOEvaluator:
+
+    def test_quantile_from_bucket_deltas(self):
+        slos = [slo_lib.HistQuantileBelow('p95', threshold=2.0,
+                                          window=('a', 'b'))]
+        ev = slo_lib.SLOEvaluator(slos)
+        ev.mark('a')
+        for _ in range(95):
+            obs.FLEETSIM_TTFT_SECONDS.observe(0.3)
+        for _ in range(5):
+            obs.FLEETSIM_TTFT_SECONDS.observe(9.0)
+        ev.mark('b')
+        (result,) = ev.evaluate()
+        # p95 resolves to the bucket bound holding the 95th sample.
+        assert result['ok'] and result['value'] == 0.35
+
+    def test_zero_sample_window_fails(self):
+        ev = slo_lib.SLOEvaluator([slo_lib.HistQuantileBelow(
+            'p95', threshold=2.0, window=('a', 'b'))])
+        ev.mark('a')
+        ev.mark('b')
+        (result,) = ev.evaluate()
+        assert not result['ok'] and 'samples' in result['detail']
+
+    def test_ratio_over_window(self):
+        ev = slo_lib.SLOEvaluator([slo_lib.RatioBelow(
+            'err', threshold=0.1, window=('a', 'b'))])
+        ev.mark('a')
+        for _ in range(98):
+            obs.FLEETSIM_REQUESTS.labels(outcome='ok').inc()
+        for _ in range(2):
+            obs.FLEETSIM_REQUESTS.labels(outcome='error').inc()
+        ev.mark('b')
+        (result,) = ev.evaluate()
+        assert result['ok'] and abs(result['value'] - 0.02) < 1e-9
+
+    def test_never_fired_event_gauge_fails(self):
+        """A gauge series that was never written must FAIL, not read
+        as 0.0 'recovered instantly' — a retimed/misspelled chaos
+        event must not green-light its recovery SLO."""
+        ev = slo_lib.SLOEvaluator([slo_lib.GaugeWithin(
+            'rec', threshold=60.0,
+            labels=(('event', 'never_happened_ev'),))])
+        (result,) = ev.evaluate()
+        assert not result['ok']
+        assert 'never written' in result['detail']
+
+    def test_unrecovered_gauge_fails(self):
+        obs.FLEETSIM_RECOVERY_SECONDS.labels(event='test_ev').set(-1.0)
+        ev = slo_lib.SLOEvaluator([slo_lib.GaugeWithin(
+            'rec', threshold=60.0, labels=(('event', 'test_ev'),))])
+        (result,) = ev.evaluate()
+        assert not result['ok']
+        obs.FLEETSIM_RECOVERY_SECONDS.labels(event='test_ev').set(12.0)
+        (result,) = ev.evaluate()
+        assert result['ok'] and result['value'] == 12.0
+
+    def test_missing_window_mark_fails(self):
+        ev = slo_lib.SLOEvaluator([slo_lib.RatioBelow(
+            'err', threshold=0.1, window=('never', 'end'))])
+        ev.mark('end')
+        (result,) = ev.evaluate()
+        assert not result['ok'] and 'never marked' in result['detail']
+
+    def test_report_schema_and_rc(self, tmp_path):
+        path, rc = slo_lib.write_report(
+            str(tmp_path), 'unit',
+            [{'name': 'x', 'metric': 'm', 'ok': True, 'value': 1,
+              'threshold': 2, 'detail': ''}])
+        data = json.loads(open(path).read())
+        assert rc == 0 and data['rc'] == 0
+        assert data['scenario'] == 'unit'
+        assert isinstance(data['asserts'], list)
+        _, rc = slo_lib.write_report(
+            str(tmp_path), 'unit', [], rc_override=1)
+        assert rc == 1
+
+
+# --- the simulated fleet ----------------------------------------------------
+
+def _fleet(clk=None, zones=('za', 'zb')):
+    serve_state.add_service(SVC, {'run': 'true'}, lb_port=0,
+                            controller_port=0)
+    clk = clk or clock_lib.VirtualClock()
+    profile = replicas_lib.ReplicaProfile(
+        startup_median_s=10.0, startup_sigma=0.0)
+    fleet = replicas_lib.SimFleet(SVC, clk, random.Random(0), profile,
+                                  zones=list(zones))
+    return fleet, clk
+
+
+class TestSimFleet:
+
+    def test_startup_lifecycle_on_virtual_clock(self):
+        fleet, clk = _fleet()
+        fleet.scale_up(4)
+        fleet.probe_all()
+        assert fleet.ready_endpoints() == []
+        rows = serve_state.get_replicas(SVC)
+        assert {r['status'] for r in rows} == \
+            {serve_state.ReplicaStatus.PROVISIONING}
+        clk.advance(3.0)   # past provision_done (25% of startup)
+        fleet.probe_all()
+        assert {r['status'] for r in serve_state.get_replicas(SVC)} \
+            == {serve_state.ReplicaStatus.STARTING}
+        clk.advance(8.0)   # past ready_at
+        fleet.probe_all()
+        assert len(fleet.ready_endpoints()) == 4
+        # Zones balanced between za/zb.
+        zones = [r['zone'] for r in serve_state.get_replicas(SVC)]
+        assert zones.count('za') == zones.count('zb') == 2
+
+    def test_zone_loss_kills_through_fault_point_and_replaces(self):
+        fleet, clk = _fleet()
+        fleet.scale_up(4)
+        clk.advance(11.0)
+        fleet.probe_all()
+        before = obs.FAULTS_INJECTED.value(point='fleet.zone_loss')
+        faults.arm('fleet.zone_loss', times=None)
+        fleet.mark_zone_lost('za')
+        fleet.probe_all()
+        faults.disarm('fleet.zone_loss')
+        # Both za replicas died via the fault point...
+        assert obs.FAULTS_INJECTED.value(point='fleet.zone_loss') == \
+            before + 2
+        # ...and were replaced into the surviving zone.
+        rows = serve_state.get_replicas(SVC)
+        assert len(rows) == 4
+        assert all(r['zone'] == 'zb' for r in rows
+                   if r['status'] ==
+                   serve_state.ReplicaStatus.PROVISIONING)
+
+    def test_preemption_wave_size_is_the_armed_times_bound(self):
+        fleet, clk = _fleet()
+        fleet.scale_up(6, use_spot=True)
+        clk.advance(11.0)
+        fleet.probe_all()
+        faults.arm('fleet.preemption_wave', times=2)
+        fleet.begin_preemption_wave()
+        fleet.probe_all()
+        # Exactly 2 of 6 died (times bound), both replaced.
+        assert len(fleet.ready_endpoints()) == 4
+        assert len(serve_state.get_replicas(SVC)) == 6
+
+    def test_handle_request_latencies_and_dead_endpoint(self):
+        fleet, clk = _fleet()
+        fleet.scale_up(1)
+        clk.advance(11.0)
+        fleet.probe_all()
+        fleet.begin_tick(5.0)
+        (endpoint,) = fleet.ready_endpoints()
+        ttft, total = fleet.handle_request(endpoint)
+        assert 0 < ttft < total
+        assert fleet.handle_request('http://gone.sim:8080') is None
+        fleet.end_tick()
+
+
+# --- the tier-1 smoke scenario (the CI gate) --------------------------------
+
+class TestSmokeScenario:
+
+    def test_smoke_scenario_passes_slos(self, tmp_path):
+        sim = runner_lib.FleetSim(runner_lib.SCENARIOS['smoke'],
+                                  seed=0, out_dir=str(tmp_path))
+        report = sim.run()
+        by_name = {r['name']: r for r in report['asserts']}
+        # The acceptance trio, asserted from the live registry (the
+        # evaluator reads metric objects, nothing parses logs):
+        assert by_name['ttft_p95']['ok'], by_name['ttft_p95']
+        assert by_name['update_error_rate']['ok'], \
+            by_name['update_error_rate']
+        assert by_name['zone_loss_recovery']['ok'], \
+            by_name['zone_loss_recovery']
+        assert report['rc'] == 0, report['asserts']
+        # Real traffic flowed through the real LB dispatch discipline.
+        assert report['extra']['requests'] > 1000
+        assert report['extra']['replicas_driven'] >= 48
+        # The machine-readable evidence artifact, in the shared
+        # {rc, scenario, asserts} schema.
+        data = json.loads(
+            open(os.path.join(str(tmp_path), 'SLO_smoke.json')).read())
+        assert data['rc'] == 0
+        assert data['scenario'] == 'smoke'
+        assert all('threshold' in a for a in data['asserts'])
+
+    def test_controller_stall_and_crash_fault_modes(self, tmp_path):
+        """`controller.step` has two chaos modes: latency_only arms a
+        STALLED tick (clock advances, no crash), a plain arm a
+        CRASHED tick (counted, run continues)."""
+        base = runner_lib.SCENARIOS['smoke']
+        import dataclasses
+        scenario = dataclasses.replace(
+            base, name='smoke_stall',
+            duration_s=30.0, warmup_s=10.0,
+            chaos=(
+                {'at': 12.0, 'action': 'arm_fault',
+                 'point': 'controller.step', 'times': 1,
+                 'latency': 4.0, 'latency_only': True},
+                {'at': 18.0, 'action': 'arm_fault',
+                 'point': 'controller.step', 'times': 1},
+            ),
+            slos=(slo_lib.RatioBelow('error_rate', threshold=1.0),))
+        before = obs.FAULTS_INJECTED.value(point='controller.step')
+        report = runner_lib.FleetSim(scenario, seed=0,
+                                     out_dir=str(tmp_path)).run()
+        assert obs.FAULTS_INJECTED.value(point='controller.step') == \
+            before + 2
+        # Only the second arm (no latency_only) crashed the tick.
+        assert report['extra']['controller_crashes'] == 1
+
+
+    def test_crash_writes_failing_report_and_cleans_up(self, tmp_path):
+        """A run that dies mid-loop must still write an rc=1 report,
+        disarm every fault and drop its service rows — then re-raise
+        so the failure is loud. A crashed soak must never look like a
+        passing one OR poison the next scenario."""
+        import dataclasses
+        base = runner_lib.SCENARIOS['smoke']
+        scenario = dataclasses.replace(
+            base, name='smoke_crash', duration_s=20.0, warmup_s=5.0,
+            # Malformed event: zone_loss without a zone -> KeyError
+            # AFTER fleet.zone_loss was armed forever.
+            chaos=({'at': 4.0, 'action': 'zone_loss'},),
+            slos=(slo_lib.RatioBelow('error_rate', threshold=1.0),))
+        with pytest.raises(KeyError):
+            runner_lib.FleetSim(scenario, seed=0,
+                                out_dir=str(tmp_path)).run()
+        data = json.loads(open(os.path.join(
+            str(tmp_path), 'SLO_smoke_crash.json')).read())
+        assert data['rc'] == 1
+        assert 'KeyError' in data['extra']['error']
+        assert faults.armed_points() == []
+        assert serve_state.get_service('fleetsim-smoke_crash') is None
+
+
+# --- full-scale soaks (slow; also run via tests/run_full.sh) ----------------
+
+@pytest.mark.slow
+class TestFullSoaks:
+
+    def _run(self, name, tmp_path):
+        sim = runner_lib.FleetSim(runner_lib.SCENARIOS[name], seed=0,
+                                  out_dir=str(tmp_path))
+        report = sim.run()
+        assert report['rc'] == 0, report['asserts']
+        return report
+
+    def test_zone_loss_acceptance(self, tmp_path):
+        """The ISSUE acceptance bar: >= 1000 replicas through zone
+        loss + recovery on the virtual clock in < 60s wall."""
+        start = time.monotonic()
+        report = self._run('zone_loss', tmp_path)
+        wall = time.monotonic() - start
+        assert report['extra']['replicas_driven'] >= 1000
+        assert wall < 60.0, f'soak took {wall:.1f}s wall'
+        assert report['extra']['unrecovered_events'] == []
+
+    def test_rolling_update_soak(self, tmp_path):
+        self._run('rolling_update', tmp_path)
+
+    def test_preemption_wave_soak(self, tmp_path):
+        """Also the regression harness for the decide_mixed fallback
+        runaway: a bounded fleet proves the hold branch no longer
+        compounds the spot shortfall."""
+        report = self._run('preemption_wave', tmp_path)
+        assert report['extra']['replicas_driven'] < 1200, \
+            'fallback autoscaler relaunched unboundedly'
+
+
+# --- CLI --------------------------------------------------------------------
+
+class TestCLI:
+
+    def test_list_and_bad_scenario(self, capsys):
+        from skypilot_tpu.fleetsim.__main__ import main
+        assert main(['--list']) == 0
+        out = capsys.readouterr().out
+        for name in runner_lib.SCENARIOS:
+            assert name in out
+        with pytest.raises(SystemExit):
+            main(['--scenario', 'nope'])

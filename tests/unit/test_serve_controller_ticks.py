@@ -96,6 +96,9 @@ def ctl(tmp_path, monkeypatch):
     c.manager = FakeManager(SVC)
     c.autoscaler = autoscalers.make_autoscaler(c.spec)
     c.lb = FakeLB()
+    c.signals = autoscalers.MetricsSignalSource()
+    c._now = lambda: 0.0
+    c._sleep = lambda dt: None
     c._stop = False
     c._loaded_version = 1
     # Spec reload pulls from the stored task_yaml; keep the fixture's

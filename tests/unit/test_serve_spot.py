@@ -120,6 +120,87 @@ class TestFallbackAutoscaler:
         assert d.target_spot == 0
         assert d.target_ondemand == 2
 
+    def test_stockout_hold_does_not_compound_fallback(self):
+        """Regression (caught by the fleetsim preemption_wave soak):
+        while ZERO spot replicas are ready, repeated hold-branch
+        decisions must cap the on-demand cover at the rate-derived
+        need. The old cap was the hysteresis-held `current`, which
+        the previous tick's cover had just inflated — so every tick
+        launched shortfall-many NEW on-demand replicas, unboundedly
+        (4416 replicas driven for a 300-replica fleet)."""
+        a, _ = self._autoscaler(base=0, dynamic=True)
+        # Tick 1: 4 spot requested, none ready -> cover with 4 OD.
+        d = a.decide_mixed(num_ready_spot=0, num_spot=4,
+                           num_ondemand=0, qps=40.0)
+        assert d.target_ondemand == 4
+        # Ticks 2..5: fleet now 4 spot + 4 OD; the cover must HOLD at
+        # 4, not grow by the shortfall again each tick.
+        for _ in range(4):
+            d = a.decide_mixed(num_ready_spot=0, num_spot=4,
+                               num_ondemand=4, qps=40.0)
+            assert d.target_spot == 4
+            assert d.target_ondemand == 4, d
+
+    def test_stockout_cover_respects_max_replicas_ceiling(self):
+        """The hold-branch cover must honor the user's hard spend
+        ceiling: spot pool + on-demand cover together never exceed
+        max_replicas, even when the rate-derived need alone would."""
+        a, _ = self._autoscaler(base=0, dynamic=True, target_qps=10,
+                                max_replicas=10)
+        # 8 spot requested (0 ready), demand wants 10 total: the
+        # cover is capped at max_replicas - num_spot = 2, not 10.
+        d = a.decide_mixed(num_ready_spot=0, num_spot=8,
+                           num_ondemand=0, qps=100.0)
+        assert d.target_spot == 8
+        assert d.target_ondemand == 2
+        assert d.target_replicas <= a.spec.max_replicas
+
+    def test_all_spot_preempted_simultaneously(self):
+        """A whole-pool preemption wave: every spot replica gone from
+        READY at once. Dynamic fallback covers the full rate-derived
+        need; recovery shrinks the cover only through hysteresis."""
+        a, t = self._autoscaler(base=1, dynamic=True)
+        # Steady state first: 4 total (3 spot + 1 base OD).
+        d = a.decide_mixed(num_ready_spot=3, num_spot=3,
+                           num_ondemand=1, qps=40.0)
+        assert (d.target_spot, d.target_ondemand) == (3, 1)
+        # Wave: all 3 spot preempted but still in the pool
+        # (replacements relaunching). OD covers the whole target.
+        d = a.decide_mixed(num_ready_spot=0, num_spot=3,
+                           num_ondemand=1, qps=40.0)
+        assert d.target_spot == 3
+        assert d.target_ondemand == 4  # 1 + shortfall, capped at need
+        # Spot fully recovered: the cover is reclaimed only after the
+        # downscale delay (no thrash on a flapping pool).
+        d = a.decide_mixed(num_ready_spot=3, num_spot=3,
+                           num_ondemand=4, qps=40.0)
+        assert d.target_replicas == 7, 'shrink must wait out delay'
+        t['now'] += a.spec.downscale_delay_seconds + 1
+        d = a.decide_mixed(num_ready_spot=3, num_spot=3,
+                           num_ondemand=4, qps=40.0)
+        assert (d.target_spot, d.target_ondemand) == (3, 1)
+
+    def test_target_clamps_at_min_and_max(self):
+        """Clamping: a QPS collapse floors at min_replicas, a spike
+        ceilings at max_replicas — in BOTH pools combined."""
+        a, t = self._autoscaler(base=1, dynamic=False,
+                                max_replicas=10)
+        # Spike way past capacity: total clamps to max (10).
+        d = a.decide_mixed(2, 2, 1, qps=10000.0)
+        assert d.target_replicas == 3  # pending upscale delay
+        t['now'] += a.spec.upscale_delay_seconds + 1
+        d = a.decide_mixed(2, 2, 1, qps=10000.0)
+        assert d.target_replicas == 10
+        assert (d.target_spot, d.target_ondemand) == (9, 1)
+        # Collapse to zero traffic: total floors at min_replicas (2),
+        # base OD preserved inside it.
+        d = a.decide_mixed(9, 9, 1, qps=0.0)
+        assert d.target_replicas == 10  # downscale timer just started
+        t['now'] += a.spec.downscale_delay_seconds + 1
+        d = a.decide_mixed(9, 9, 1, qps=0.0)
+        assert d.target_replicas == 2
+        assert (d.target_spot, d.target_ondemand) == (1, 1)
+
     def test_mixed_scaling_respects_hysteresis(self):
         a, t = self._autoscaler(base=0, dynamic=True)
         # Fleet at 2 (min); a qps spike must wait out upscale_delay.
