@@ -118,6 +118,10 @@ async def run(url: str, concurrency: int, requests: int,
         'metric': 'serve_decode_tokens_per_sec',
         'value': round(total_tokens / wall, 2),
         'unit': 'tokens/s',
+        # rc in the payload: a driver-captured LOADGEN_*.json is
+        # self-describing evidence — the same {rc, ...} honesty
+        # schema BENCH_*.json and fleetsim's SLO_*.json carry.
+        'rc': 0,
         'extra': {
             'requests': requests,
             'concurrency': concurrency,
@@ -146,10 +150,20 @@ def main() -> None:
                         help='seconds to wait for /health=ok (first '
                              'compile of a big model takes minutes)')
     args = parser.parse_args()
-    report = asyncio.run(run(args.url.rstrip('/'), args.concurrency,
-                             args.requests, args.prompt_len,
-                             args.max_new_tokens,
-                             ready_timeout=args.ready_timeout))
+    try:
+        report = asyncio.run(run(args.url.rstrip('/'),
+                                 args.concurrency,
+                                 args.requests, args.prompt_len,
+                                 args.max_new_tokens,
+                                 ready_timeout=args.ready_timeout))
+    except Exception as e:  # noqa: BLE001 — the honesty contract:
+        # EVERY failure mode still emits one parseable JSON line with
+        # rc=1, never a bare traceback a driver can't gate on.
+        print(json.dumps({
+            'metric': 'serve_decode_tokens_per_sec', 'value': 0.0,
+            'unit': 'tokens/s', 'rc': 1,
+            'extra': {'error': f'{type(e).__name__}: {e}'}}))
+        raise SystemExit(1)
     print(json.dumps(report))
 
 

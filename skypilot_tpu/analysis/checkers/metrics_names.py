@@ -80,10 +80,10 @@ class MetricsNamesChecker(Checker):
                 elif any(b == math.inf for b in m.buckets):
                     emit('histogram-buckets',
                          f'{m.name}: +Inf bucket is implicit')
-                if not m.name.endswith('_seconds'):
+                if not m.name.endswith(('_seconds', '_tokens')):
                     emit('histogram-buckets',
-                         f'{m.name}: our histograms measure latency; '
-                         'name the unit')
+                         f'{m.name}: histograms name their unit '
+                         'suffix (_seconds, _tokens)')
             for label in m.labelnames:
                 if not _LABEL_RE.fullmatch(label) or label == 'le':
                     emit('label-names',

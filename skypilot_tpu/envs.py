@@ -182,6 +182,39 @@ SKYTPU_MAX_QUEUE_DEPTH = declare(
     'SKYTPU_MAX_QUEUE_DEPTH', int, 0,
     'Inference-server load shedding: queue depth beyond which requests '
     'get a fast 503 + Retry-After. 0/unset disables.')
+SKYTPU_DECODE_FUSE_STEPS = declare(
+    'SKYTPU_DECODE_FUSE_STEPS', int, 8,
+    'Decode steps fused into ONE device dispatch per engine host step '
+    '(lax.fori_loop with donated KV buffers). 1 falls back to '
+    'host-stepped decode (one dispatch per token).')
+SKYTPU_KV_QUANT = declare(
+    'SKYTPU_KV_QUANT', str, 'auto',
+    'Default KV-cache quantization for engines constructed without an '
+    'explicit kv_quant: none | int8 | auto (int8 on TPU, none '
+    'elsewhere — int8 halves HBM traffic; CPU runs keep bf16 '
+    'exactness).')
+SKYTPU_KV_PAGE_SIZE = declare(
+    'SKYTPU_KV_PAGE_SIZE', int, 64,
+    'Positions per KV-cache page for the paged (block) allocator; '
+    'engines built without an explicit kv_page_size use this. '
+    '0 disables paging (dense per-slot cache). Sharded (mesh) '
+    'engines always run dense.')
+SKYTPU_KV_PAGES = declare(
+    'SKYTPU_KV_PAGES', int, 0,
+    'Paged KV pool size in pages (plus one reserved scratch page). '
+    '0 sizes the pool to the dense equivalent '
+    '(batch_size * pages-per-slot); smaller values oversubscribe and '
+    'queue requests until pages free.')
+SKYTPU_PREFILL_INTERLEAVE = declare(
+    'SKYTPU_PREFILL_INTERLEAVE', int, -1,
+    'Default interleaved-prefill threshold (tokens) for engines built '
+    'without an explicit prefill_interleave: prompts longer than this '
+    'prefill one chunk per engine step. -1 keeps the built-in default '
+    '(4x prefill_chunk); 0 disables interleaving.')
+SKYTPU_SPEC_K = declare(
+    'SKYTPU_SPEC_K', int, 4,
+    'Speculative-decoding draft length: tokens the draft model '
+    'proposes per big-model verify pass when a draft is attached.')
 
 # --- serve plane ------------------------------------------------------------
 

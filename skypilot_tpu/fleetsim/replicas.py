@@ -31,17 +31,35 @@ class ReplicaKilled(Exception):
 
 @dataclasses.dataclass(frozen=True)
 class ReplicaProfile:
-    """Latency/capacity shape of one mock replica process."""
+    """Latency/capacity shape of one mock replica process.
+
+    Two decode parameterizations:
+    - legacy: `decode_per_token_s` (flat per-token cost, no histogram
+      traffic) — the pre-fused-engine model, kept so existing
+      scenarios reproduce bit-for-bit;
+    - fused-loop: `decode_step_s` > 0 models the engine's
+      device-resident rounds — each request costs
+      ceil(tokens / fused_steps) HOST steps whose latencies are
+      sampled lognormally around `decode_step_s` and observed into
+      the REAL skytpu_decode_step_seconds histogram, so SLOs gate the
+      same series production scrapes.
+    """
     startup_median_s: float = 60.0     # provision + model load
     startup_sigma: float = 0.35        # lognormal spread
     ttft_median_s: float = 0.35        # unloaded time-to-first-token
     ttft_sigma: float = 0.45
-    decode_per_token_s: float = 0.03   # per generated token
+    decode_per_token_s: float = 0.03   # per generated token (legacy)
     tokens_median: int = 64            # generated tokens per request
     concurrency: int = 16              # decode slots per replica
+    decode_step_s: float = 0.0         # fused host-step median; 0=off
+    decode_step_sigma: float = 0.3
+    fused_steps: int = 8               # device steps per host step
 
     def service_mean_s(self) -> float:
         """Mean busy time one request costs a decode slot."""
+        if self.decode_step_s > 0:
+            host_steps = -(-self.tokens_median // self.fused_steps)
+            return self.ttft_median_s + host_steps * self.decode_step_s
         return self.ttft_median_s + \
             self.tokens_median * self.decode_per_token_s
 
@@ -242,7 +260,20 @@ class SimFleet:
         ttft /= max(0.05, 1.0 - min(rho, 0.95))
         tokens = max(1, int(self._rng.lognormvariate(
             _mu(float(p.tokens_median)), 0.5)))
-        total = ttft + tokens * p.decode_per_token_s
+        if p.decode_step_s > 0:
+            # Fused-loop parameterization: the request decodes as
+            # ceil(tokens / fused_steps) host rounds, each observed
+            # into the engine's decode-step histogram — the signal
+            # the fused_decode scenario's SLO asserts on.
+            decode = 0.0
+            for _ in range(-(-tokens // p.fused_steps)):
+                step = self._rng.lognormvariate(_mu(p.decode_step_s),
+                                                p.decode_step_sigma)
+                obs.DECODE_STEP_SECONDS.observe(step)
+                decode += step
+            total = ttft + decode
+        else:
+            total = ttft + tokens * p.decode_per_token_s
         r.tick_requests += 1
         r.tick_busy_s += total
         return ttft, total

@@ -356,6 +356,45 @@ register(Scenario(
 ))
 
 register(Scenario(
+    name='fused_decode',
+    description=('Device-resident decode gate (ROADMAP items 2+5): '
+                 'replica latency parameterized by FUSED-LOOP host-'
+                 'step time (each request = ceil(tokens/8) host '
+                 'rounds observed into skytpu_decode_step_seconds); '
+                 'SLOs assert the decode-step p95 and TTFT p95 the '
+                 'fused engine must hold, from the same registry '
+                 'series production scrapes. A mid-run slowdown '
+                 'burst must not break the budget.'),
+    replicas=60,
+    duration_s=120.0, tick_s=2.0, warmup_s=30.0,
+    traffic={'kind': 'burst',
+             'inner': {'kind': 'constant', 'qps': 120.0},
+             'burst_qps': 60.0, 'at': 70.0, 'duration_s': 30.0},
+    profile=replicas_lib.ReplicaProfile(
+        startup_median_s=6.0, startup_sigma=0.3,
+        ttft_median_s=0.3, ttft_sigma=0.4,
+        tokens_median=48, concurrency=8,
+        # v5e bench anchor: ~34 tok/s device-resident at batch 1 ->
+        # ~0.12 s per 8-token fused round per slot.
+        decode_step_s=0.12, decode_step_sigma=0.3, fused_steps=8),
+    policy={'max_replicas': 80, 'target_qps_per_replica': 3.0,
+            'target_queue_per_replica': 4.0,
+            'upscale_delay_seconds': 10,
+            'downscale_delay_seconds': 120},
+    lb_policy='round_robin',
+    slos=(
+        # The new decode-step-latency signal (the fused engine's own
+        # histogram), p95 resolved from bucket deltas: one fused
+        # round must stay within the interactive budget.
+        slo_lib.HistQuantileBelow(
+            'decode_step_p95', threshold=0.25,
+            metric='skytpu_decode_step_seconds'),
+        slo_lib.HistQuantileBelow('ttft_p95', threshold=2.0),
+        slo_lib.RatioBelow('error_rate', threshold=0.005),
+    ),
+))
+
+register(Scenario(
     name='zone_loss',
     description=('The acceptance soak: 1000+ replicas across three '
                  'zones, a full zone killed and later restored, '

@@ -9,21 +9,26 @@ from typing import Optional
 
 from skypilot_tpu.inference.engine import (DecodeState, InferenceEngine,
                                            SamplingParams, decode_step,
+                                           fused_decode_steps,
                                            init_cache, prefill)
 
 __all__ = ['DecodeState', 'InferenceEngine', 'SamplingParams',
-           'build_engine', 'decode_step', 'init_cache', 'prefill']
+           'build_engine', 'decode_step', 'fused_decode_steps',
+           'init_cache', 'prefill']
 
 
 def build_engine(model: str, *, checkpoint: Optional[str] = None,
                  mesh_arg: Optional[str] = None, batch_size: int = 8,
                  max_seq_len: Optional[int] = None,
                  prefill_chunk: int = 1024,
-                 kv_quant: str = 'none',
+                 kv_quant: str = 'auto',
                  prefill_interleave: Optional[int] = None,
                  draft_model: Optional[str] = None,
                  draft_checkpoint: Optional[str] = None,
-                 spec_k: int = 4) -> InferenceEngine:
+                 spec_k: Optional[int] = None,
+                 decode_fuse_steps: Optional[int] = None,
+                 kv_page_size: Optional[int] = None,
+                 kv_pages: Optional[int] = None) -> InferenceEngine:
     """One engine-construction path for every entrypoint (HTTP server,
     offline batch): resolve the model, build the mesh from a
     'tensor=8,context=2'-style arg, restore or random-init params."""
@@ -58,4 +63,7 @@ def build_engine(model: str, *, checkpoint: Optional[str] = None,
                            prefill_chunk=prefill_chunk,
                            kv_quant=kv_quant,
                            prefill_interleave=prefill_interleave,
-                           draft=draft, spec_k=spec_k)
+                           draft=draft, spec_k=spec_k,
+                           decode_fuse_steps=decode_fuse_steps,
+                           kv_page_size=kv_page_size,
+                           kv_pages=kv_pages)

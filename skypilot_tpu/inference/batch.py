@@ -87,10 +87,21 @@ def main() -> None:
     parser.add_argument('--top-k', type=int, default=0)
     parser.add_argument('--mesh', default=None,
                         help='Shard over a device mesh, e.g. tensor=8')
-    parser.add_argument('--kv-quant', default='none',
-                        choices=['none', 'int8'],
+    parser.add_argument('--kv-quant', default='auto',
+                        choices=['auto', 'none', 'int8'],
                         help='int8 KV cache (see inference.server '
-                             '--help)')
+                             '--help); auto = int8 on TPU, none '
+                             'elsewhere.')
+    parser.add_argument('--decode-fuse-steps', type=int, default=None,
+                        help='Device decode steps per host dispatch '
+                             '(default: SKYTPU_DECODE_FUSE_STEPS; '
+                             '1 = host-stepped).')
+    parser.add_argument('--kv-page-size', type=int, default=None,
+                        help='Positions per KV page (default: '
+                             'SKYTPU_KV_PAGE_SIZE; 0 = dense cache).')
+    parser.add_argument('--kv-pages', type=int, default=None,
+                        help='Paged KV pool size in pages (0/default '
+                             '= dense-equivalent).')
     args = parser.parse_args()
 
     from skypilot_tpu import inference as inf
@@ -104,6 +115,8 @@ def main() -> None:
         args.model, checkpoint=args.checkpoint, mesh_arg=args.mesh,
         batch_size=args.batch_size, max_seq_len=args.max_seq_len,
         kv_quant=args.kv_quant,
+        decode_fuse_steps=args.decode_fuse_steps,
+        kv_page_size=args.kv_page_size, kv_pages=args.kv_pages,
         # Offline: no in-flight streams to protect, and interleaving
         # would serialize long-prompt prefill one slot at a time —
         # keep the N-wide batched chunk scan.

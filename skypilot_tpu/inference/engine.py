@@ -13,6 +13,16 @@ TPU-first design:
   slots between steps (JetStream-style).
 - Per-slot sampling params (temperature/top-k) are jnp arrays, so mixed
   greedy/sampled batches run in the same compiled step.
+- Decode is DEVICE-RESIDENT by default: `fused_decode_steps` runs N
+  decode steps per host round-trip inside one lax.while_loop with the
+  cache and token buffers donated, returning only per-slot emitted
+  tokens + counts to the host — the host-dispatch RTT is paid once per
+  N tokens instead of per token (SKYTPU_DECODE_FUSE_STEPS).
+- KV storage defaults to PAGED (block) allocation on unsharded
+  engines: k/v live in a pool of fixed-size pages ([L, P, page, KV, D])
+  indexed through per-slot block tables, so sequences join and leave
+  the continuous batch by editing table VALUES — shapes never change,
+  membership churn compiles nothing.
 
 Reference analog: none — SkyPilot recipes shell out to vLLM
 (llm/vllm/serve.yaml:26); this replaces that external dependency with a
@@ -30,6 +40,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from skypilot_tpu import envs
 from skypilot_tpu.models import llama
 from skypilot_tpu.models import moe as moe_lib
 from skypilot_tpu.observability import instruments as obs
@@ -81,11 +92,84 @@ def _is_quant(kv) -> bool:
     return isinstance(kv, dict)
 
 
+def _is_paged(cache: Cache) -> bool:
+    return 'table' in cache
+
+
+def cache_capacity(cache: Cache) -> int:
+    """Logical KV positions addressable per slot (static, from
+    shapes): dense caches read it off the sequence axis, paged caches
+    off table width x page size."""
+    k = cache['k']
+    leaf = k['q'] if _is_quant(k) else k
+    if _is_paged(cache):
+        return int(cache['table'].shape[1]) * int(leaf.shape[2])
+    return int(leaf.shape[2])
+
+
+def _paged_read(pages, table: jax.Array):
+    """Per-layer page pool -> per-slot dense view.
+
+    pages: [P, page, KV, D] (raw) or {'q': [P, page, KV, D],
+    's': [P, page, KV]}; table: [B, W] page indices. Returns the
+    logically-contiguous [B, W*page, ...] view the (unchanged) dense
+    attention math consumes. The gather materializes one LAYER's view
+    at a time (this runs inside the layer scan), so peak extra memory
+    is one layer's cache, not the model's. Unallocated table entries
+    point at the reserved scratch page 0 — garbage positions there sit
+    beyond every slot's `length` and are invisible to the mask.
+    """
+    def read_leaf(leaf):
+        page = leaf.shape[1]
+        flat = leaf.reshape((-1,) + leaf.shape[2:])
+        idx = (table[:, :, None] * page
+               + jnp.arange(page)[None, None, :]).reshape(
+                   table.shape[0], -1)
+        return flat[idx]
+
+    if _is_quant(pages):
+        return {'q': read_leaf(pages['q']), 's': read_leaf(pages['s'])}
+    return read_leaf(pages)
+
+
+def _paged_write(pages, new: jax.Array, table: jax.Array,
+                 write_at: jax.Array):
+    """Scatter T new KV rows per slot into the page pool.
+
+    new: [B, T, KV, D] landing at logical positions write_at[b]..+T-1,
+    routed through each slot's block table. Slots own their pages
+    exclusively, so indices never collide across slots; writes that
+    resolve to the scratch page (inactive slots, unallocated tail) are
+    garbage by construction and invisible beyond `length`.
+    """
+    def write_leaf(leaf, new_leaf):
+        page = leaf.shape[1]
+        flat = leaf.reshape((-1,) + leaf.shape[2:])
+        t = new_leaf.shape[1]
+        pos = write_at[:, None] + jnp.arange(t)[None]
+        # Clip like the dense path's dynamic_update_slice clamp — but
+        # safer: an out-of-range position resolves through the table's
+        # last entry (scratch for any slot not allocated to the brim)
+        # instead of overwriting a valid key.
+        pos = jnp.clip(pos, 0, table.shape[1] * page - 1)
+        pidx = jnp.take_along_axis(table, pos // page, axis=1)
+        idx = pidx * page + pos % page
+        return flat.at[idx].set(new_leaf).reshape(leaf.shape)
+
+    if _is_quant(pages):
+        newq = quantize_kv(new)
+        return {'q': write_leaf(pages['q'], newq['q']),
+                's': write_leaf(pages['s'], newq['s'])}
+    return write_leaf(pages, new)
+
+
 def init_cache(config: llama.LlamaConfig, batch_size: int,
                max_seq_len: Optional[int] = None,
                mesh: Optional[Any] = None,
                pad_to: int = 1,
-               kv_quant: str = 'none') -> Cache:
+               kv_quant: str = 'none',
+               page_size: int = 0,
+               num_pages: int = 0) -> Cache:
     """Zeroed KV cache + per-slot lengths. With a mesh, KV heads shard
     over the tensor axis AND the sequence dim shards over the context
     axis — serving models whose weights+cache exceed one chip (the
@@ -109,6 +193,38 @@ def init_cache(config: llama.LlamaConfig, batch_size: int,
     s = -(-s // multiple) * multiple
     if kv_quant not in ('none', 'int8'):
         raise ValueError(f'kv_quant must be none|int8, got {kv_quant!r}')
+    if page_size > 0:
+        if mesh is not None:
+            # Page indirection has no GSPMD partitioning story (the
+            # gather would all-gather the pool); sharded engines keep
+            # the dense layout whose seq dim context-shards.
+            raise ValueError('paged KV (page_size > 0) is incompatible '
+                             'with a sharded cache; serve unsharded or '
+                             'set page_size=0')
+        s = -(-s // math.lcm(multiple, page_size)) * \
+            math.lcm(multiple, page_size)
+        w = s // page_size
+        # Pool default: the dense-equivalent page count, plus page 0
+        # reserved as the scratch page every empty table entry points
+        # at. Smaller pools oversubscribe; the engine's allocator then
+        # queues requests whose reservation does not fit.
+        p = (num_pages + 1) if num_pages > 0 else (batch_size * w + 1)
+        shape = (c.num_layers, p, page_size, c.num_kv_heads, c.head_dim)
+
+        def kv_zeros():
+            if kv_quant == 'int8':
+                return {'q': jnp.zeros(shape, jnp.int8),
+                        's': jnp.zeros(shape[:-1], jnp.float32)}
+            return jnp.zeros(shape, c.dtype)
+
+        return {
+            'k': kv_zeros(),
+            'v': kv_zeros(),
+            'length': jnp.zeros((batch_size,), jnp.int32),
+            # Per-slot block table: logical position pos lives in
+            # pages[table[b, pos // page_size], pos % page_size].
+            'table': jnp.zeros((batch_size, w), jnp.int32),
+        }
     shape = (c.num_layers, batch_size, s, c.num_kv_heads, c.head_dim)
 
     def kv_zeros():
@@ -258,7 +374,8 @@ def _attn_with_cache(x: jax.Array, layer_params: Params,
                      positions: jax.Array, lengths: jax.Array,
                      write_at: jax.Array, config: ModelConfig,
                      window: Optional[jax.Array] = None,
-                     q_offset: Optional[jax.Array] = None
+                     q_offset: Optional[jax.Array] = None,
+                     table: Optional[jax.Array] = None
                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Attention block over T new tokens with KV-cache update; shared
     by the llama-core and MoE cached layers (MoE reuses llama's
@@ -270,6 +387,12 @@ def _attn_with_cache(x: jax.Array, layer_params: Params,
     mirror llama._layer exactly — the decode path must compute what
     the training forward computes. getattr defaults cover configs
     (MoeConfig) that don't carry a knob at all.
+
+    `table` ([B, W] page indices) switches the cache leaves to the
+    PAGED layout: writes scatter through the table, reads gather a
+    per-slot dense view, and the attention math below is byte-for-byte
+    the dense path's — paging is pure indirection, never different
+    numerics.
     """
     c = config
     plus_one = getattr(c, 'norm_plus_one', False)
@@ -297,6 +420,8 @@ def _attn_with_cache(x: jax.Array, layer_params: Params,
                                                axis=0)
 
     def kv_write(cache_kv, new):
+        if table is not None:
+            return _paged_write(cache_kv, new, table, write_at)
         if _is_quant(cache_kv):
             newq = quantize_kv(new)
             return {'q': jax.vmap(write_one)(cache_kv['q'], newq['q'],
@@ -308,7 +433,12 @@ def _attn_with_cache(x: jax.Array, layer_params: Params,
     k_cache = kv_write(k_cache, k)
     v_cache = kv_write(v_cache, v)
 
-    attn = _cached_attention(q, k_cache, v_cache, positions, lengths,
+    if table is not None:
+        k_read = _paged_read(k_cache, table)
+        v_read = _paged_read(v_cache, table)
+    else:
+        k_read, v_read = k_cache, v_cache
+    attn = _cached_attention(q, k_read, v_read, positions, lengths,
                              window=window,
                              softcap=getattr(c, 'attn_logit_softcap',
                                              None),
@@ -329,14 +459,15 @@ def _layer_with_cache(x: jax.Array, layer_params: Params,
                       write_at: jax.Array,
                       config: llama.LlamaConfig,
                       window: Optional[jax.Array] = None,
-                      q_offset: Optional[jax.Array] = None
+                      q_offset: Optional[jax.Array] = None,
+                      table: Optional[jax.Array] = None
                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One llama-core layer (attention + dense GLU MLP) with cache."""
     c = config
     plus_one = c.norm_plus_one
     x, k_cache, v_cache = _attn_with_cache(
         x, layer_params, k_cache, v_cache, positions, lengths, write_at,
-        c, window=window, q_offset=q_offset)
+        c, window=window, q_offset=q_offset, table=table)
 
     h = llama._rms_norm(x, layer_params['mlp_norm'], c.rms_norm_eps,
                         plus_one)
@@ -359,7 +490,8 @@ def _moe_layer_with_cache(x: jax.Array, layer_params: Params,
                           k_cache: jax.Array, v_cache: jax.Array,
                           positions: jax.Array, lengths: jax.Array,
                           write_at: jax.Array, config: Any,
-                          q_offset: Optional[jax.Array] = None
+                          q_offset: Optional[jax.Array] = None,
+                          table: Optional[jax.Array] = None
                           ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One MoE layer (llama attention + routed expert MLP) with cache.
 
@@ -370,7 +502,7 @@ def _moe_layer_with_cache(x: jax.Array, layer_params: Params,
     c = config
     x, k_cache, v_cache = _attn_with_cache(
         x, layer_params, k_cache, v_cache, positions, lengths, write_at,
-        c, q_offset=q_offset)
+        c, q_offset=q_offset, table=table)
     h = llama._rms_norm(x, layer_params['mlp_norm'], c.rms_norm_eps)
     out, _aux = moe_lib._moe_mlp(h, layer_params, c)
     return x + out, k_cache, v_cache
@@ -385,19 +517,23 @@ def _moe_hidden_with_cache(params: Params, tokens: jax.Array,
     """MoE variant of `_hidden_with_cache` (plain norms, no
     windows/softcaps — models/moe.py `forward`)."""
     c = config
+    table = cache.get('table')
     x = params['embed'].astype(c.dtype)[tokens]
 
     def body(x, per_layer):
         layer_params, k_cache, v_cache = per_layer
         x, k_cache, v_cache = _moe_layer_with_cache(
             x, layer_params, k_cache, v_cache, positions, new_lengths,
-            write_at, c, q_offset=q_offset)
+            write_at, c, q_offset=q_offset, table=table)
         return x, (k_cache, v_cache)
 
     x, (new_k, new_v) = lax.scan(body, x, (params['layers'], cache['k'],
                                            cache['v']))
     x = llama._rms_norm(x, params['final_norm'], c.rms_norm_eps)
-    return x, {'k': new_k, 'v': new_v, 'length': new_lengths}
+    out = {'k': new_k, 'v': new_v, 'length': new_lengths}
+    if table is not None:
+        out['table'] = table
+    return x, out
 
 
 def _hidden_with_cache(params: Params, tokens: jax.Array,
@@ -415,6 +551,7 @@ def _hidden_with_cache(params: Params, tokens: jax.Array,
                                       write_at, new_lengths, config,
                                       q_offset=q_offset)
     c = config
+    table = cache.get('table')
     x = params['embed'].astype(c.dtype)[tokens]
     if c.embed_scale:
         x = x * jnp.asarray(math.sqrt(c.hidden_size), c.dtype)
@@ -424,7 +561,8 @@ def _hidden_with_cache(params: Params, tokens: jax.Array,
             layer_params, k_cache, v_cache = per_layer
             x, k_cache, v_cache = _layer_with_cache(
                 x, layer_params, k_cache, v_cache, positions,
-                new_lengths, write_at, c, q_offset=q_offset)
+                new_lengths, write_at, c, q_offset=q_offset,
+                table=table)
             return x, (k_cache, v_cache)
 
         x, (new_k, new_v) = lax.scan(body, x,
@@ -440,7 +578,7 @@ def _hidden_with_cache(params: Params, tokens: jax.Array,
             x, k_cache, v_cache = _layer_with_cache(
                 x, layer_params, k_cache, v_cache, positions,
                 new_lengths, write_at, c, window=window,
-                q_offset=q_offset)
+                q_offset=q_offset, table=table)
             return x, (k_cache, v_cache)
 
         x, (new_k, new_v) = lax.scan(body, x,
@@ -448,7 +586,10 @@ def _hidden_with_cache(params: Params, tokens: jax.Array,
                                       cache['v'], windows))
     x = llama._rms_norm(x, params['final_norm'], c.rms_norm_eps,
                         c.norm_plus_one)
-    return x, {'k': new_k, 'v': new_v, 'length': new_lengths}
+    out = {'k': new_k, 'v': new_v, 'length': new_lengths}
+    if table is not None:
+        out['table'] = table
+    return x, out
 
 
 def _project_logits(x: jax.Array, params: Params,
@@ -523,13 +664,22 @@ def prefill_chunked(params: Params, tokens: jax.Array,
     partitioning rules, so the engine enables it when mesh is None."""
     n, padded_len = tokens.shape
     n_chunks = padded_len // chunk
-    # tree.map: each of k/v is either a raw [L,B,S,KV,D] array or a
-    # quantized {'q','s'} dict of arrays; slot gather/scatter applies
-    # leaf-wise either way.
-    sub_cache = {
-        'k': jax.tree.map(lambda a: a[:, slot_ids], cache['k']),
-        'v': jax.tree.map(lambda a: a[:, slot_ids], cache['v']),
-    }
+    paged = _is_paged(cache)
+    if paged:
+        # Paged cache: no slot gather/scatter — the sub-table IS the
+        # slot subset, writes land in the pool directly (each slot
+        # owns its pages exclusively), and the whole pool rides the
+        # scan carry (updated in place by XLA).
+        sub_cache = {'k': cache['k'], 'v': cache['v'],
+                     'table': cache['table'][slot_ids]}
+    else:
+        # tree.map: each of k/v is either a raw [L,B,S,KV,D] array or
+        # a quantized {'q','s'} dict of arrays; slot gather/scatter
+        # applies leaf-wise either way.
+        sub_cache = {
+            'k': jax.tree.map(lambda a: a[:, slot_ids], cache['k']),
+            'v': jax.tree.map(lambda a: a[:, slot_ids], cache['v']),
+        }
     embed_dim = params['embed'].shape[-1]
 
     def body(carry, chunk_tokens):
@@ -542,6 +692,8 @@ def prefill_chunked(params: Params, tokens: jax.Array,
             params, chunk_tokens, kv, positions, write_at, visible,
             config, q_offset=start if use_flash else None)
         kv = {'k': out['k'], 'v': out['v']}  # carry shape must match
+        if paged:
+            kv['table'] = out['table']
         # Keep each slot's TRUE last token's hidden state, whichever
         # chunk it lands in.
         last_idx = prompt_lengths - 1
@@ -558,13 +710,20 @@ def prefill_chunked(params: Params, tokens: jax.Array,
         tokens.reshape(n, n_chunks, chunk), 1, 0)  # [K, N, chunk]
     (kv, last_hidden, _), _ = lax.scan(
         body, (sub_cache, init_hidden, jnp.int32(0)), chunks)
-    new_cache = {
-        'k': jax.tree.map(lambda a, b: a.at[:, slot_ids].set(b),
-                          cache['k'], kv['k']),
-        'v': jax.tree.map(lambda a, b: a.at[:, slot_ids].set(b),
-                          cache['v'], kv['v']),
-        'length': cache['length'].at[slot_ids].set(prompt_lengths),
-    }
+    if paged:
+        new_cache = {
+            'k': kv['k'], 'v': kv['v'],
+            'length': cache['length'].at[slot_ids].set(prompt_lengths),
+            'table': cache['table'],
+        }
+    else:
+        new_cache = {
+            'k': jax.tree.map(lambda a, b: a.at[:, slot_ids].set(b),
+                              cache['k'], kv['k']),
+            'v': jax.tree.map(lambda a, b: a.at[:, slot_ids].set(b),
+                              cache['v'], kv['v']),
+            'length': cache['length'].at[slot_ids].set(prompt_lengths),
+        }
     return _project_logits(last_hidden, params, config), new_cache
 
 
@@ -587,15 +746,26 @@ def prefill_chunk_at(params: Params, chunk_tokens: jax.Array,
     chunk's hidden states [N, chunk, E] (the caller samples the first
     token from the final chunk) and the updated cache; `visible` [N]
     becomes each slot's cache length (masks unwritten positions)."""
-    sub_cache = {
-        'k': jax.tree.map(lambda a: a[:, slot_ids], cache['k']),
-        'v': jax.tree.map(lambda a: a[:, slot_ids], cache['v']),
-    }
     n = chunk_tokens.shape[0]
     positions = start + jnp.broadcast_to(jnp.arange(chunk)[None],
                                          (n, chunk))
     # (start is traced: broadcast, don't jnp.full with it.)
     write_at = jnp.zeros((n,), jnp.int32) + start
+    if _is_paged(cache):
+        sub_cache = {'k': cache['k'], 'v': cache['v'],
+                     'table': cache['table'][slot_ids]}
+        x, out = _hidden_with_cache(
+            params, chunk_tokens, sub_cache, positions, write_at,
+            visible, config, q_offset=start if use_flash else None)
+        return x, {
+            'k': out['k'], 'v': out['v'],
+            'length': cache['length'].at[slot_ids].set(visible),
+            'table': cache['table'],
+        }
+    sub_cache = {
+        'k': jax.tree.map(lambda a: a[:, slot_ids], cache['k']),
+        'v': jax.tree.map(lambda a: a[:, slot_ids], cache['v']),
+    }
     x, out = _hidden_with_cache(
         params, chunk_tokens, sub_cache, positions, write_at, visible,
         config, q_offset=start if use_flash else None)
@@ -680,6 +850,86 @@ def decode_step(params: Params, cache: Cache, last_tokens: jax.Array,
     # length (stale writes beyond `length` are invisible to the mask).
     new_cache['length'] = new_lengths
     return next_tokens, logprobs, new_cache
+
+
+@functools.partial(jax.jit,
+                   static_argnames=('config', 'n_steps'),
+                   donate_argnums=(1, 2))
+def fused_decode_steps(params: Params, cache: Cache,
+                       last_tokens: jax.Array, active: jax.Array,
+                       temperature: jax.Array, top_k: jax.Array,
+                       top_p: jax.Array, eos_ids: jax.Array,
+                       budgets: jax.Array, max_len: jax.Array,
+                       key: jax.Array, config: llama.LlamaConfig,
+                       n_steps: int
+                       ) -> Tuple[jax.Array, jax.Array, jax.Array,
+                                  jax.Array, Cache]:
+    """Up to `n_steps` decode steps per HOST round-trip: the
+    device-resident decode loop.
+
+    The host-stepped engine pays one dispatch + one device->host sync
+    per token — at small batch that RTT, not the chip, is the decode
+    ceiling (~10 vs ~34 tok/s measured at batch 1 on v5e). This runs
+    the same per-token math as `decode_step` inside a lax.while_loop
+    (exiting early once every slot is done), so one dispatch covers up
+    to N tokens for every slot; the cache and
+    last-token buffer are DONATED (no per-step reallocation — XLA
+    updates the KV pool in place), and only the per-slot emitted
+    tokens/logprobs/counts return to the host.
+
+    Per-slot early exit stays exact: a slot deactivates the moment it
+    emits `eos_ids[b]`, exhausts `budgets[b]` (remaining
+    max_new_tokens), or reaches `max_len` cache positions — the same
+    three bounds the host's `_evict_finished` enforces — and emits
+    nothing further inside the round (its `emitted` count gates what
+    the host appends). Greedy output is token-for-token identical to
+    host-stepped decode; sampled slots consume a per-step subkey split
+    from `key`.
+
+    Returns (tokens [B, n_steps], logprobs [B, n_steps],
+    emitted [B], new_last_tokens [B], cache).
+    """
+    b = last_tokens.shape[0]
+
+    def cond(carry):
+        # while_loop, not fori_loop: once EVERY slot has deactivated
+        # (eos/budget/cache bound), the remaining iterations would be
+        # full forward passes producing nothing — exit instead. Worst
+        # case for a fori: a batch-1 request with 2 budget tokens
+        # under n_steps=8 would burn 6 dead forwards per round.
+        i = carry[0]
+        active = carry[3]
+        return (i < n_steps) & jnp.any(active)
+
+    def body(carry):
+        i, cache, last, active, emitted, toks, lps, key = carry
+        key, sub = jax.random.split(key)
+        lengths = cache['length']
+        new_lengths = jnp.where(active, lengths + 1, lengths)
+        logits, cache = _forward_with_cache(
+            params, last[:, None], cache, lengths[:, None], lengths,
+            new_lengths, config)
+        nxt, lp = _sample(logits[:, 0], temperature, top_k, top_p, sub)
+        nxt = jnp.where(active, nxt, last)
+        cache['length'] = new_lengths
+        toks = toks.at[:, i].set(nxt)
+        lps = lps.at[:, i].set(lp)
+        emitted = emitted + active.astype(jnp.int32)
+        # Deactivate AFTER emitting token i (the eos itself is
+        # reported, mirroring host-stepped behavior).
+        done = ((nxt == eos_ids) | (emitted >= budgets)
+                | (new_lengths >= max_len))
+        active = active & ~done
+        return (i + 1, cache, nxt, active, emitted, toks, lps, key)
+
+    toks = jnp.zeros((b, n_steps), jnp.int32)
+    lps = jnp.zeros((b, n_steps), jnp.float32)
+    emitted = jnp.zeros((b,), jnp.int32)
+    (_i, cache, last, _active, emitted, toks, lps, _key) = \
+        lax.while_loop(cond, body,
+                       (jnp.int32(0), cache, last_tokens, active,
+                        emitted, toks, lps, key))
+    return toks, lps, emitted, last, cache
 
 
 @functools.partial(jax.jit,
@@ -779,7 +1029,9 @@ class DecodeState:
                  mesh: Optional[Any] = None,
                  prefill_chunk: int = 0,
                  kv_quant: str = 'none',
-                 draft_config: Optional[llama.LlamaConfig] = None):
+                 draft_config: Optional[llama.LlamaConfig] = None,
+                 page_size: int = 0,
+                 num_pages: int = 0):
         self.config = config
         self.batch_size = batch_size
         self.max_seq_len = max_seq_len or config.max_seq_len
@@ -787,12 +1039,23 @@ class DecodeState:
                   if 0 < prefill_chunk < self.max_seq_len else 1)
         self.cache = init_cache(config, batch_size, self.max_seq_len,
                                 mesh=mesh, pad_to=pad_to,
-                                kv_quant=kv_quant)
+                                kv_quant=kv_quant,
+                                page_size=page_size,
+                                num_pages=num_pages)
         # Speculative decoding: the draft model mirrors the cache
-        # (bf16 — the draft is small by construction).
+        # (bf16 — the draft is small by construction). With paging the
+        # draft shares the MAIN cache's page geometry (same table
+        # width and pool indices), so the engine applies one
+        # allocation decision to both tables.
+        draft_pages = num_pages
+        if draft_config is not None and page_size > 0:
+            k = self.cache['k']
+            leaf = k['q'] if _is_quant(k) else k
+            draft_pages = int(leaf.shape[1]) - 1
         self.draft_cache = (
             init_cache(draft_config, batch_size, self.max_seq_len,
-                       mesh=mesh, pad_to=pad_to)
+                       mesh=mesh, pad_to=pad_to, page_size=page_size,
+                       num_pages=draft_pages)
             if draft_config is not None else None)
         self.last_tokens = jnp.zeros((batch_size,), jnp.int32)
         self.slots: List[Optional[_Slot]] = [None] * batch_size
@@ -801,9 +1064,18 @@ class DecodeState:
 class InferenceEngine:
     """Continuous batching over a fixed slot count.
 
-    submit() enqueues prompts; step() prefills into free slots and runs
-    one decode step for all active slots; results stream out of
-    `finished()`.
+    submit() enqueues prompts; step() prefills into free slots and
+    runs one decode ROUND for all active slots (a fused device loop of
+    up to `decode_fuse_steps` tokens per host dispatch); results
+    stream out of `finished()`.
+
+    The fast path IS the default path: fused device-resident decode
+    (SKYTPU_DECODE_FUSE_STEPS), paged KV allocation on unsharded
+    engines (SKYTPU_KV_PAGE_SIZE), interleaved prefill for long
+    prompts, int8 KV on TPU (SKYTPU_KV_QUANT=auto), and — when a draft
+    model is attached — speculative rounds for greedy batches. Every
+    default is env-overridable through the envs.py registry; explicit
+    constructor arguments win over both.
     """
 
     def __init__(self, params: Params, config: llama.LlamaConfig,
@@ -813,10 +1085,13 @@ class InferenceEngine:
                  mesh: Optional[Any] = None,
                  prefill_chunk: int = 1024,
                  use_flash: Optional[bool] = None,
-                 kv_quant: str = 'none',
+                 kv_quant: str = 'auto',
                  prefill_interleave: Optional[int] = None,
                  draft: Optional[Tuple[Params, Any]] = None,
-                 spec_k: int = 4):
+                 spec_k: Optional[int] = None,
+                 decode_fuse_steps: Optional[int] = None,
+                 kv_page_size: Optional[int] = None,
+                 kv_pages: Optional[int] = None):
         # The cached decode path mirrors the llama-core transformer
         # (every family knob: window/GeGLU/post-norms/softcaps/tied
         # embeddings) and the MoE family (routed expert MLP).
@@ -852,6 +1127,36 @@ class InferenceEngine:
         if use_flash is None:
             use_flash = mesh is None and jax.default_backend() == 'tpu'
         self._use_flash = bool(use_flash)
+        # --- the fast-serving defaults (env-overridable, ROADMAP 2) --
+        # int8 KV: 'auto' resolves through the registry, then to the
+        # backend — int8 halves cache HBM traffic on TPU; CPU (tests,
+        # oracles) keeps bf16 exactness.
+        if kv_quant in (None, 'auto'):
+            kv_quant = envs.SKYTPU_KV_QUANT.get()
+        if kv_quant == 'auto':
+            kv_quant = ('int8' if jax.default_backend() == 'tpu'
+                        else 'none')
+        # Fused decode: N device steps per host dispatch.
+        if decode_fuse_steps is None:
+            decode_fuse_steps = envs.SKYTPU_DECODE_FUSE_STEPS.get()
+        self.decode_fuse_steps = max(1, int(decode_fuse_steps))
+        # Paged KV: explicit page size + sharded cache is a hard error
+        # (no GSPMD story for the page gather); the default silently
+        # stays dense under a mesh, where the seq dim context-shards.
+        explicit_paged = kv_page_size is not None
+        if kv_page_size is None:
+            kv_page_size = envs.SKYTPU_KV_PAGE_SIZE.get()
+        if mesh is not None:
+            if explicit_paged and kv_page_size > 0:
+                raise ValueError(
+                    'kv_page_size is incompatible with a sharded '
+                    'engine (the page gather has no GSPMD '
+                    'partitioning rules); omit kv_page_size or serve '
+                    'unsharded.')
+            kv_page_size = 0
+        self.kv_page_size = max(0, int(kv_page_size))
+        if kv_pages is None:
+            kv_pages = envs.SKYTPU_KV_PAGES.get()
         if mesh is not None:
             # Tensor-parallel serving: params shard by their logical
             # axes (heads/mlp/vocab over 'tensor'); GSPMD propagates
@@ -872,8 +1177,13 @@ class InferenceEngine:
         # Prompts LONGER than this prefill one chunk per step()
         # (interleaved with decode) so in-flight streams stall one
         # chunk, not a whole long prompt; shorter prompts keep the
-        # batched one-shot path. None -> 4 chunks; 0 disables.
+        # batched one-shot path. None -> env (default: 4 chunks);
+        # 0 disables.
         explicit_interleave = prefill_interleave is not None
+        if prefill_interleave is None:
+            env_interleave = envs.SKYTPU_PREFILL_INTERLEAVE.get()
+            if env_interleave is not None and env_interleave >= 0:
+                prefill_interleave = env_interleave
         if prefill_interleave is None:
             prefill_interleave = 4 * prefill_chunk if prefill_chunk else 0
         if prefill_chunk <= 0:
@@ -886,7 +1196,10 @@ class InferenceEngine:
         # track every prompt, which the one-shot prefill path does;
         # interleaved prefill is disabled when a draft is attached.
         self._draft_params = self._draft_config = None
-        self.spec_k = spec_k
+        if spec_k is None:
+            spec_k = envs.SKYTPU_SPEC_K.get()
+        self.spec_k = int(spec_k)
+        spec_k = self.spec_k
         if draft is not None:
             dparams, dconfig = draft
             if dconfig.vocab_size != config.vocab_size:
@@ -931,7 +1244,27 @@ class InferenceEngine:
                                  mesh=mesh,
                                  prefill_chunk=prefill_chunk,
                                  kv_quant=kv_quant,
-                                 draft_config=self._draft_config)
+                                 draft_config=self._draft_config,
+                                 page_size=self.kv_page_size,
+                                 num_pages=max(0, int(kv_pages)))
+        # Logical positions addressable per slot (>= max_seq_len; the
+        # paged cache rounds up to a page multiple).
+        self._capacity = cache_capacity(self.state.cache)
+        # Host-side page allocator: pages 1..P-1 are allocatable (page
+        # 0 is the scratch page every empty table entry targets, so a
+        # freed slot's decode writes can never land in a page that was
+        # handed to another request). Allocation decisions apply to
+        # the draft cache's table too — the geometries match.
+        self._page_alloc: List[int] = []
+        self._slot_pages: List[List[int]] = [[] for _ in
+                                             range(batch_size)]
+        self._pages_total = 0
+        if _is_paged(self.state.cache):
+            k = self.state.cache['k']
+            leaf = k['q'] if _is_quant(k) else k
+            self._pages_total = int(leaf.shape[1]) - 1
+            self._page_alloc = list(range(1, self._pages_total + 1))
+        self._fused_dispatches = 0
         self._queue: List[Tuple[int, List[int], SamplingParams]] = []
         self._finished: Dict[int, List[int]] = {}
         self._finished_logprobs: Dict[int, List[float]] = {}
@@ -947,6 +1280,20 @@ class InferenceEngine:
             # Prefill gathers last-token logits at prompt_len-1; an
             # empty prompt would wrap to index -1 and sample garbage.
             raise ValueError('prompt_tokens must be non-empty')
+        if self.kv_page_size:
+            # A reservation that exceeds the whole pool can NEVER be
+            # admitted (it would park at the queue head forever,
+            # starving everything behind it) — fail loud here, where
+            # the server loop turns it into a request error.
+            need = self._pages_needed(
+                len(prompt_tokens[:self.state.max_seq_len - 1]),
+                (sampling or SamplingParams()).max_new_tokens)
+            if need > self._pages_total:
+                raise ValueError(
+                    f'request needs {need} KV pages (prompt + '
+                    f'max_new_tokens) but the pool holds only '
+                    f'{self._pages_total}; shorten the request or '
+                    'raise kv_pages.')
         request_id = self._next_id
         self._next_id += 1
         self._queue.append((request_id, list(prompt_tokens),
@@ -1030,6 +1377,10 @@ class InferenceEngine:
             self.step()
             results.update(self.finished())
             steps += 1
+        # A fused round can finish EVERYTHING inside an earlier,
+        # externally-driven step(); drain those results rather than
+        # strand them (has_work is already False on entry then).
+        results.update(self.finished())
         return results
 
     # -- internals -----------------------------------------------------------
@@ -1041,6 +1392,24 @@ class InferenceEngine:
         from skypilot_tpu.parallel import mesh as mesh_lib
         return mesh_lib.use_mesh(self.mesh)
 
+    def _pages_needed(self, prompt_len: int, max_new: int) -> int:
+        """Worst-case pages a request can touch: prompt + generation
+        budget + the speculative verify slab, capped at capacity."""
+        slack = self.spec_k if self._draft_params is not None else 0
+        reserve = min(prompt_len + max_new + slack, self._capacity)
+        return -(-reserve // self.kv_page_size)
+
+    def _set_table_rows(self, slot: int, pages: List[int]) -> None:
+        """Point slot `slot`'s block-table row (main + draft caches)
+        at `pages`; unassigned tail entries target scratch page 0."""
+        w = self.state.cache['table'].shape[1]
+        row = jnp.array(pages + [0] * (w - len(pages)), jnp.int32)
+        self.state.cache['table'] = \
+            self.state.cache['table'].at[slot].set(row)
+        if self.state.draft_cache is not None:
+            self.state.draft_cache['table'] = \
+                self.state.draft_cache['table'].at[slot].set(row)
+
     def _insert_from_queue(self) -> None:
         free = [i for i, s in enumerate(self.state.slots) if s is None]
         if not free or not self._queue:
@@ -1048,9 +1417,24 @@ class InferenceEngine:
         inserts: List[Tuple[int, List[int], SamplingParams]] = []
         slot_ids: List[int] = []
         while free and self._queue:
+            if self.kv_page_size:
+                # Page admission BEFORE popping: an oversubscribed
+                # pool holds the request at the queue head (FIFO — no
+                # starving big requests) until evictions free pages.
+                _rid, peek_tokens, peek_sampling = self._queue[0]
+                need = self._pages_needed(
+                    len(peek_tokens[:self.state.max_seq_len - 1]),
+                    peek_sampling.max_new_tokens)
+                if need > len(self._page_alloc):
+                    break
             slot = free.pop(0)
             request_id, tokens, sampling = self._queue.pop(0)
             tokens = tokens[:self.state.max_seq_len - 1]
+            if self.kv_page_size:
+                pages = self._page_alloc[:need]
+                del self._page_alloc[:need]
+                self._slot_pages[slot] = pages
+                self._set_table_rows(slot, pages)
             # Counted POST-truncation, at insert: the counter must
             # reflect tokens the engine actually prefills, or
             # prompt-side throughput read from /metrics deltas
@@ -1179,13 +1563,20 @@ class InferenceEngine:
 
     def _free_slot(self, i: int) -> None:
         """Release slot i: cache lengths zero (stale keys invisible),
-        draft cache mirrored."""
+        draft cache mirrored; with paging, the slot's pages return to
+        the pool and its table row resets to the scratch page — an
+        empty slot's masked decode writes must never land in a page
+        that was re-issued to another request."""
         self.state.slots[i] = None
         self.state.cache['length'] = \
             self.state.cache['length'].at[i].set(0)
         if self.state.draft_cache is not None:
             self.state.draft_cache['length'] = \
                 self.state.draft_cache['length'].at[i].set(0)
+        if self.kv_page_size and self._slot_pages[i]:
+            self._page_alloc.extend(self._slot_pages[i])
+            self._slot_pages[i] = []
+            self._set_table_rows(i, [])
 
     def _spec_round(self, active_mask: List[bool]) -> None:
         active = jnp.array(active_mask)
@@ -1200,6 +1591,8 @@ class InferenceEngine:
         toks_host, lps_host, emit_host = jax.device_get(
             (tokens_out, lps_out, emit))
         obs.DECODE_STEP_SECONDS.observe(time.perf_counter() - t_step)
+        obs.DECODE_HOST_STEPS.inc()
+        self._fused_dispatches += 1
         emitted = 0
         for i, slot in enumerate(self.state.slots):
             if slot is None or slot.pending is not None:
@@ -1219,6 +1612,7 @@ class InferenceEngine:
                     break
         if emitted:
             obs.GENERATED_TOKENS.inc(emitted)
+            obs.DECODE_TOKENS_PER_STEP.observe(emitted)
 
     def _evict_finished(self) -> None:
         for i, slot in enumerate(self.state.slots):
@@ -1249,6 +1643,9 @@ class InferenceEngine:
                    for s in slots if s is not None)
         obs.KV_CACHE_UTILIZATION.set(
             used / max(1, len(slots) * self.state.max_seq_len))
+        if self.kv_page_size:
+            obs.KV_PAGES_TOTAL.set(self._pages_total)
+            obs.KV_PAGES_FREE.set(len(self._page_alloc))
 
     def step(self) -> None:
         self._evict_finished()
@@ -1270,9 +1667,7 @@ class InferenceEngine:
             # (dynamic_update_slice) and silently overwrite valid
             # keys — fall back to plain decode for the step instead;
             # the near-full slot evicts via the `full` bound shortly.
-            k_leaf = self.state.cache['k']
-            padded = (k_leaf['q'] if _is_quant(k_leaf)
-                      else k_leaf).shape[2]
+            padded = cache_capacity(self.state.cache)
             lengths_host = jax.device_get(self.state.cache['length'])
             if all(int(lengths_host[i]) + self.spec_k <= padded
                    for i, on in enumerate(active_mask) if on):
@@ -1291,25 +1686,59 @@ class InferenceEngine:
             [s.params.top_p if s else 1.0 for s in self.state.slots],
             jnp.float32)
         active = jnp.array(active_mask)
+        # Device-resident decode: ONE dispatch + ONE sync for up to
+        # decode_fuse_steps tokens per slot. Per-slot eos/budget/
+        # cache-full bounds ride along so the fused round never
+        # over-generates past what host-stepped decode would emit.
+        budgets = jnp.array(
+            [max(0, s.params.max_new_tokens - len(s.generated))
+             if (s is not None and s.pending is None) else 0
+             for s in self.state.slots], jnp.int32)
+        eos_arr = jnp.array(
+            [s.params.eos_token_id
+             if (s is not None and s.pending is None
+                 and s.params.eos_token_id is not None) else -1
+             for s in self.state.slots], jnp.int32)
+        # Cache-full bound, EXACTLY the host's eviction inequality:
+        # _evict_finished stops at prompt_len + generated >=
+        # max_seq_len - 1, and length = prompt_len + generated - 1
+        # (the first token is sampled from prefill without a cache
+        # write), so the device must deactivate at new_lengths >=
+        # max_seq_len - 2 — one off and the fused round emits a token
+        # host-stepped decode would not.
+        max_len = jnp.int32(self.state.max_seq_len - 2)
         t_step = time.perf_counter()
         with self._mesh_ctx():
-            next_tokens, logprobs, self.state.cache = decode_step(
-                self.params, self.state.cache, self.state.last_tokens,
-                active, temps, topks, topps, sub, self.config)
-        self.state.last_tokens = next_tokens
-        # ONE host sync for both arrays: a second blocking device_get
+            toks, lps, emitted_dev, new_last, self.state.cache = \
+                fused_decode_steps(
+                    self.params, self.state.cache,
+                    self.state.last_tokens, active, temps, topks,
+                    topps, eos_arr, budgets, max_len, sub,
+                    self.config, self.decode_fuse_steps)
+        self.state.last_tokens = new_last
+        # ONE host sync for every output: a second blocking device_get
         # on the hot decode loop is pure added latency.
-        tokens_host, lp_host = jax.device_get((next_tokens, logprobs))
+        toks_host, lps_host, emit_host = jax.device_get(
+            (toks, lps, emitted_dev))
         obs.DECODE_STEP_SECONDS.observe(time.perf_counter() - t_step)
+        obs.DECODE_HOST_STEPS.inc()
+        self._fused_dispatches += 1
         emitted = 0
         for i, slot in enumerate(self.state.slots):
             # pending guard: a slot mid-(interleaved-)prefill was
-            # masked inactive in decode_step — appending its (stale)
-            # last_token here would be garbage output.
-            if slot is not None and slot.pending is None:
-                slot.generated.append(int(tokens_host[i]))
-                slot.logprobs.append(float(lp_host[i]))
+            # masked inactive in the fused loop — appending its
+            # (stale) last_token here would be garbage output.
+            if slot is None or slot.pending is not None:
+                continue
+            for j in range(int(emit_host[i])):
+                slot.generated.append(int(toks_host[i, j]))
+                slot.logprobs.append(float(lps_host[i, j]))
                 emitted += 1
+        # Per-TOKEN accounting for a multi-token host step: the
+        # throughput counters must never undercount N fused tokens as
+        # one (rate(generated)/rate(host_steps) = amortization).
         obs.GENERATED_TOKENS.inc(emitted)
+        if emitted:
+            obs.DECODE_TOKENS_PER_STEP.observe(emitted)
         self._evict_finished()
         self._update_gauges()

@@ -14,7 +14,10 @@ Concurrency model (JetStream-style): ONE engine loop thread owns the
 TPU. HTTP handlers enqueue requests; the loop drains the queue before
 every step so new requests join the running decode batch mid-flight —
 continuous batching across concurrent HTTP requests, not serialized
-whole generations. Per-step progress snapshots feed token streaming.
+whole generations. Per-step progress snapshots feed token streaming;
+one engine step is a fused device round that can emit SEVERAL tokens
+per slot, so the streaming drain pushes every not-yet-sent token, not
+one per tick, and aborts are re-applied right after each round.
 
 Token-id interface: tokenization happens client-side (transformers is
 available on dev boxes; the serving host stays tokenizer-free and the
@@ -149,6 +152,12 @@ class EngineLoop:
             self._submit_q.put(item)
             return
         self.engine.step()
+        # Drain aborts AGAIN before fanning out events: one step() is
+        # now a fused multi-token round (tens of ms to seconds), and a
+        # client that vanished mid-round must free its slot BEFORE the
+        # next round rather than burn another N tokens — and its
+        # already-popped watcher must not receive the round's tokens.
+        self._drain_aborts()
         progress = self.engine.active_progress()
         finished = self.engine.finished()
         finished_lps = self.engine.finished_logprobs()
@@ -368,7 +377,9 @@ def main() -> None:
                              '(the draft cache needs one-shot '
                              'prefill).')
     parser.add_argument('--draft-checkpoint', default=None)
-    parser.add_argument('--spec-k', type=int, default=4)
+    parser.add_argument('--spec-k', type=int, default=None,
+                        help='Draft tokens per speculative round '
+                             '(default: SKYTPU_SPEC_K).')
     parser.add_argument('--prefill-interleave', type=int,
                         default=None,
                         help='Prompts longer than this prefill one '
@@ -376,12 +387,32 @@ def main() -> None:
                              'with decode (other streams stall one '
                              'chunk, not the whole prompt). Default: '
                              '4x --prefill-chunk; 0 disables.')
-    parser.add_argument('--kv-quant', default='none',
-                        choices=['none', 'int8'],
+    parser.add_argument('--kv-quant', default='auto',
+                        choices=['auto', 'none', 'int8'],
                         help='int8 KV cache: half the cache HBM '
                              'traffic and footprint (2x decode batch '
                              'in the same memory) for ~0.4%% absmax '
-                             'quantization error.')
+                             'quantization error. auto (the default) '
+                             'resolves via SKYTPU_KV_QUANT: int8 on '
+                             'TPU, none elsewhere.')
+    parser.add_argument('--decode-fuse-steps', type=int, default=None,
+                        help='Decode steps fused into one device '
+                             'dispatch per engine host step '
+                             '(lax.fori_loop, donated KV buffers). '
+                             'Default: SKYTPU_DECODE_FUSE_STEPS (8); '
+                             '1 falls back to host-stepped decode.')
+    parser.add_argument('--kv-page-size', type=int, default=None,
+                        help='Positions per KV-cache page (paged '
+                             'block allocation: slots join/leave the '
+                             'batch by table edits, never recompiles).'
+                             ' Default: SKYTPU_KV_PAGE_SIZE (64); 0 '
+                             'runs the dense per-slot cache. Sharded '
+                             '(--mesh) engines are always dense.')
+    parser.add_argument('--kv-pages', type=int, default=None,
+                        help='Paged KV pool size in pages; 0/default '
+                             'sizes the pool to the dense equivalent. '
+                             'Smaller pools oversubscribe and queue '
+                             'requests until pages free.')
     parser.add_argument('--no-exit-with-parent', action='store_true',
                         help='Keep serving after the launcher exits '
                              '(deliberate daemonization only)')
@@ -407,7 +438,9 @@ def main() -> None:
             prefill_interleave=args.prefill_interleave,
             draft_model=args.draft_model,
             draft_checkpoint=args.draft_checkpoint,
-            spec_k=args.spec_k)
+            spec_k=args.spec_k,
+            decode_fuse_steps=args.decode_fuse_steps,
+            kv_page_size=args.kv_page_size, kv_pages=args.kv_pages)
         holder['loop'] = EngineLoop(engine)
 
     threading.Thread(target=_load, daemon=True).start()

@@ -58,6 +58,66 @@ def test_trace_safety_flags_body_passed_to_lax(tmp_path):
     assert 'host-call' in _rules(findings)
 
 
+def test_trace_safety_flags_while_loop_decode_body(tmp_path):
+    """The fused-decode shape: bodies handed to lax.while_loop /
+    lax.fori_loop are trace scopes — host calls and closure mutation
+    inside them run once at trace time, not per decode step."""
+    findings = _run_snippet(tmp_path, """
+        import time
+        from jax import lax
+
+        EMITTED = []
+
+        def decode(cache, last, n):
+            def cond(carry):
+                cache, last, i = carry
+                return i < n
+
+            def body(carry):
+                cache, last, i = carry
+                t0 = time.perf_counter()   # host call — flag
+                EMITTED.append(last)       # closure mutation — flag
+                return (cache, last, i + 1)
+
+            return lax.while_loop(cond, body, (cache, last, 0))
+
+        def decode_fori(cache, n):
+            def body(i, carry):
+                print('step', i)           # host call — flag
+                return carry
+
+            return lax.fori_loop(0, n, body, cache)
+    """, 'trace-safety')
+    rules = _rules(findings)
+    assert rules.count('host-call') == 2
+    assert 'closure-mutation' in rules
+
+
+def test_trace_safety_passes_clean_fused_decode_body(tmp_path):
+    """The idioms the REAL fused loop uses (carry unpack/rebind,
+    jnp ops, buffer .at[].set, key splits) must not flag."""
+    findings = _run_snippet(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        def fused(params, cache, last, active, key, n):
+            def body(i, carry):
+                cache, last, active, toks, key = carry
+                key, sub = jax.random.split(key)
+                lengths = cache['length']
+                cache['length'] = jnp.where(active, lengths + 1,
+                                            lengths)
+                toks = toks.at[:, i].set(last)
+                return (cache, last, active, toks, key)
+
+            toks = jnp.zeros((last.shape[0], n), jnp.int32)
+            return lax.fori_loop(0, n, body,
+                                 (cache, last, active, toks, key))
+    """, 'trace-safety')
+    assert findings == []
+
+
 def test_trace_safety_flags_tracer_coercion(tmp_path):
     findings = _run_snippet(tmp_path, """
         import jax

@@ -298,6 +298,29 @@ class TestSmokeScenario:
         assert data['scenario'] == 'smoke'
         assert all('threshold' in a for a in data['asserts'])
 
+    def test_fused_decode_scenario_gates_decode_step_signal(
+            self, tmp_path):
+        """ROADMAP item 5 REMAINING: the fused_decode scenario drives
+        replica distributions parameterized by fused-loop host-step
+        time and asserts the p95 of the REAL
+        skytpu_decode_step_seconds histogram (bucket deltas over the
+        warmup..end window) — the engine's new decode-step-latency
+        signal has soak coverage."""
+        sim = runner_lib.FleetSim(
+            runner_lib.SCENARIOS['fused_decode'], seed=0,
+            out_dir=str(tmp_path))
+        report = sim.run()
+        by_name = {r['name']: r for r in report['asserts']}
+        assert by_name['decode_step_p95']['ok'], \
+            by_name['decode_step_p95']
+        assert by_name['decode_step_p95']['metric'] == \
+            'skytpu_decode_step_seconds'
+        # The p95 resolved from real bucket bounds, not a stub value.
+        assert 0 < by_name['decode_step_p95']['value'] <= 0.25
+        assert by_name['ttft_p95']['ok'], by_name['ttft_p95']
+        assert report['rc'] == 0, report['asserts']
+        assert report['extra']['requests'] > 1000
+
     def test_controller_stall_and_crash_fault_modes(self, tmp_path):
         """`controller.step` has two chaos modes: latency_only arms a
         STALLED tick (clock advances, no crash), a plain arm a

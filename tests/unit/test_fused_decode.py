@@ -1,0 +1,389 @@
+"""Device-resident decode loop + paged KV: the default fast path.
+
+Acceptance (ISSUE 10): the CPU smoke here proves >= 4 decode steps per
+host dispatch with donated KV buffers, and that membership churn (slot
+join/leave) causes ZERO recompilation with the paged cache. Fused and
+paged are both DEFAULTS — most tests construct the engine with no
+flags and assert the fast path is what they got.
+"""
+import jax
+import jax.numpy as jnp
+import pytest
+
+from skypilot_tpu import inference
+from skypilot_tpu.inference import engine as eng_lib
+from skypilot_tpu.models import llama
+from skypilot_tpu.observability import instruments as obs
+
+
+@pytest.fixture(scope='module')
+def tiny():
+    config = llama.CONFIGS['tiny']
+    params = llama.init_params(config, jax.random.key(7))
+    return config, params
+
+
+_REF_PAD = 40
+
+
+def _greedy_reference(params, config, prompt, steps):
+    """Argmax over a FULL forward pass each step (no cache)."""
+    tokens = list(prompt)
+    out = []
+    for _ in range(steps):
+        assert len(tokens) <= _REF_PAD
+        arr = jnp.array([tokens + [0] * (_REF_PAD - len(tokens))],
+                        jnp.int32)
+        logits = llama.forward(params, arr, config)
+        nxt = int(jnp.argmax(logits[0, len(tokens) - 1]))
+        out.append(nxt)
+        tokens.append(nxt)
+    return out
+
+
+def _greedy(max_new):
+    return inference.SamplingParams(temperature=0.0,
+                                    max_new_tokens=max_new)
+
+
+class TestFusedDecodeSmoke:
+    """The acceptance smoke: fused decode is the default, amortizes
+    >= 4 device steps per host dispatch, donates the KV cache, and
+    matches the no-cache oracle token-for-token."""
+
+    def test_defaults_are_the_fast_path(self, tiny):
+        config, params = tiny
+        eng = inference.InferenceEngine(params, config, batch_size=2,
+                                        max_seq_len=64)
+        assert eng.decode_fuse_steps >= 4          # fused by default
+        assert eng.kv_page_size > 0                # paged by default
+        assert eng_lib._is_paged(eng.state.cache)
+
+    def test_four_plus_steps_per_dispatch_matches_oracle(self, tiny):
+        config, params = tiny
+        prompt = [3, 17, 42, 9, 105, 8]
+        steps = 16
+        ref = _greedy_reference(params, config, prompt, steps)
+        eng = inference.InferenceEngine(params, config, batch_size=2,
+                                        max_seq_len=64, seed=123)
+        rid = eng.submit(prompt, _greedy(steps))
+        out = eng.run_to_completion()
+        assert out[rid] == ref
+        # Prefill emits the first token; the remaining 15 decode
+        # tokens rode eng._fused_dispatches host dispatches.
+        assert eng._fused_dispatches > 0
+        per_dispatch = (steps - 1) / eng._fused_dispatches
+        assert per_dispatch >= 4, (steps, eng._fused_dispatches)
+
+    def test_kv_buffers_are_donated(self, tiny):
+        """The fused loop donates the cache + last-token buffers: the
+        pre-round device arrays must be CONSUMED (deleted), not
+        copied — that is the no-per-step-reallocation contract."""
+        config, params = tiny
+        eng = inference.InferenceEngine(params, config, batch_size=2,
+                                        max_seq_len=64)
+        eng.submit([1, 2, 3], _greedy(30))
+        eng.step()                       # prefill + first fused round
+        k_before = eng.state.cache['k']
+        last_before = eng.state.last_tokens
+        eng.step()                       # pure fused round
+        assert k_before.is_deleted()
+        assert last_before.is_deleted()
+
+    def test_fused_matches_host_stepped(self, tiny):
+        """decode_fuse_steps=1 (the legacy host-stepped loop) and the
+        fused default must emit identical greedy tokens AND logprobs."""
+        import numpy as np
+        config, params = tiny
+        prompt = [5, 11, 2, 9]
+
+        def run(**kw):
+            eng = inference.InferenceEngine(
+                params, config, batch_size=2, max_seq_len=64, **kw)
+            rid = eng.submit(prompt, _greedy(10))
+            toks = eng.run_to_completion()[rid]
+            return toks, eng.finished_logprobs()[rid]
+
+        fused_t, fused_lp = run()
+        host_t, host_lp = run(decode_fuse_steps=1)
+        assert fused_t == host_t
+        np.testing.assert_allclose(fused_lp, host_lp, atol=1e-4)
+
+    def test_cache_full_bound_matches_host_stepped(self, tiny):
+        """A request bounded by the CACHE (not budget/eos) must emit
+        exactly as many tokens fused as host-stepped: the device
+        deactivation inequality mirrors _evict_finished's, accounting
+        for length = prompt + generated - 1 (the first token comes
+        from prefill without a cache write)."""
+        config, params = tiny
+        prompt = [int(i % 251) + 1 for i in range(20)]
+
+        def run(fuse):
+            eng = inference.InferenceEngine(
+                params, config, batch_size=1, max_seq_len=26,
+                kv_quant='none', decode_fuse_steps=fuse)
+            rid = eng.submit(prompt, _greedy(50))  # cache binds first
+            return eng.run_to_completion()[rid]
+
+        host = run(1)
+        fused = run(8)
+        assert fused == host
+        # The bound itself: prompt + generated == max_seq_len - 1.
+        assert len(host) == 26 - 1 - len(prompt)
+
+    def test_eos_mid_round_stops_exactly(self, tiny):
+        """An eos hit inside the fused round must end the request AT
+        the eos — later loop iterations' tokens are never emitted."""
+        config, params = tiny
+        prompt = [3, 17, 42]
+        ref = _greedy_reference(params, config, prompt, 12)
+        eos = ref[2]
+        eng = inference.InferenceEngine(params, config, batch_size=2,
+                                        max_seq_len=64)
+        rid = eng.submit(prompt, inference.SamplingParams(
+            temperature=0.0, max_new_tokens=12, eos_token_id=eos))
+        out = eng.run_to_completion()[rid]
+        assert out == ref[:3] and out[-1] == eos
+
+
+class TestPagedKv:
+    """Paged (block) KV allocation: pure indirection — identical
+    tokens, zero recompiles on membership churn, page recycling."""
+
+    def test_paged_matches_dense(self, tiny):
+        config, params = tiny
+        prompt = [3, 17, 42, 9]
+
+        def run(**kw):
+            eng = inference.InferenceEngine(
+                params, config, batch_size=2, max_seq_len=64,
+                kv_quant='none', **kw)
+            rid = eng.submit(prompt, _greedy(8))
+            return eng.run_to_completion()[rid]
+
+        assert run(kv_page_size=16) == run(kv_page_size=0)
+
+    def test_membership_churn_zero_recompiles(self, tiny):
+        """The acceptance bar: slots joining and leaving the batch
+        (different prompt lengths, eos exits, aborts) must never
+        recompile the fused decode loop — churn edits table/length
+        VALUES, shapes stay put."""
+        config, params = tiny
+        eng = inference.InferenceEngine(params, config, batch_size=2,
+                                        max_seq_len=64)
+        eng.submit([1, 2, 3], _greedy(4))
+        eng.run_to_completion()          # warm the compile cache
+        warm = eng_lib.fused_decode_steps._cache_size()
+        for prompt in ([5] * 3, [7] * 17, [9] * 30, [2] * 5,
+                       [4] * 24):
+            eng.submit(list(prompt), _greedy(4))
+            eng.run_to_completion()
+        # Churn with aborts mixed in.
+        ghost = eng.submit([8, 9], _greedy(40))
+        eng.step()
+        eng.abort(ghost)
+        eng.submit([6, 6], _greedy(3))
+        eng.run_to_completion()
+        assert eng_lib.fused_decode_steps._cache_size() == warm
+
+    def test_pages_recycle_and_reused_slot_is_clean(self, tiny):
+        config, params = tiny
+        eng = inference.InferenceEngine(params, config, batch_size=1,
+                                        max_seq_len=64,
+                                        kv_page_size=16,
+                                        kv_quant='none')
+        eng.submit([1, 2, 3, 4, 5], _greedy(3))
+        eng.run_to_completion()
+        assert len(eng._page_alloc) == eng._pages_total
+        # The reused slot's table was scratch-reset: the second
+        # request must match the oracle, never see stale KV.
+        ref = _greedy_reference(params, config, [42, 43], 3)
+        rid = eng.submit([42, 43], _greedy(3))
+        assert eng.run_to_completion()[rid] == ref
+
+    def test_oversubscribed_pool_queues_until_pages_free(self, tiny):
+        config, params = tiny
+        # Pool of 2 pages (page 16): one request's reservation
+        # (prompt 4 + 4 new -> 1 page) fits; admitting both up front
+        # would need more than the pool for longer prompts.
+        eng = inference.InferenceEngine(params, config, batch_size=2,
+                                        max_seq_len=64,
+                                        kv_page_size=16, kv_pages=2,
+                                        kv_quant='none')
+        r1 = eng.submit(list(range(2, 30)), _greedy(4))
+        r2 = eng.submit(list(range(3, 31)), _greedy(4))
+        eng.step()
+        # Second request held back: its 2-page reservation exceeds
+        # the free pool while r1 holds 2 pages.
+        assert any(s is None for s in eng.state.slots)
+        out = eng.run_to_completion()
+        assert r1 in out and r2 in out   # completes after r1 frees
+        assert len(eng._page_alloc) == eng._pages_total
+
+    def test_request_larger_than_pool_rejected_at_submit(self, tiny):
+        """A reservation no amount of waiting can satisfy must fail
+        LOUD at submit (the server turns it into a request error) —
+        never park at the queue head starving everything behind it."""
+        config, params = tiny
+        eng = inference.InferenceEngine(params, config, batch_size=2,
+                                        max_seq_len=64,
+                                        kv_page_size=16, kv_pages=1,
+                                        kv_quant='none')
+        with pytest.raises(ValueError, match='pages'):
+            eng.submit(list(range(2, 40)),
+                       _greedy(20))   # ~58 positions -> 4 pages > 1
+        # A small request still fits the 1-page pool.
+        rid = eng.submit([5, 6], _greedy(3))
+        assert len(eng.run_to_completion()[rid]) == 3
+
+    def test_explicit_paging_with_mesh_rejected(self, tiny):
+        from skypilot_tpu.parallel import MeshSpec, make_mesh
+        config, params = tiny
+        mesh = make_mesh(MeshSpec(data=1, fsdp=4, tensor=2))
+        with pytest.raises(ValueError, match='page'):
+            inference.InferenceEngine(params, config, batch_size=2,
+                                      max_seq_len=64, mesh=mesh,
+                                      kv_page_size=16)
+        # Default paging silently stays dense under a mesh.
+        eng = inference.InferenceEngine(params, config, batch_size=2,
+                                        max_seq_len=64, mesh=mesh)
+        assert not eng_lib._is_paged(eng.state.cache)
+
+    def test_paged_composes_with_int8_and_spec(self, tiny):
+        config, params = tiny
+        prompt = [3, 17, 42, 9]
+        base = inference.InferenceEngine(
+            params, config, batch_size=2, max_seq_len=64,
+            kv_page_size=0, kv_quant='none', decode_fuse_steps=1)
+        rid = base.submit(prompt, _greedy(8))
+        expected = base.run_to_completion()[rid]
+        spec = inference.InferenceEngine(
+            params, config, batch_size=2, max_seq_len=64,
+            kv_page_size=16, kv_quant='none',
+            draft=(params, config), spec_k=4)
+        assert eng_lib._is_paged(spec.state.cache)
+        assert eng_lib._is_paged(spec.state.draft_cache)
+        rid = spec.submit(prompt, _greedy(8))
+        assert spec.run_to_completion()[rid] == expected
+        quant = inference.InferenceEngine(
+            params, config, batch_size=2, max_seq_len=64,
+            kv_page_size=16, kv_quant='int8')
+        rid = quant.submit(prompt, _greedy(8))
+        got = quant.run_to_completion()[rid]
+        assert got[:4] == expected[:4] and len(got) == 8
+
+
+class TestAbortRacingFusedRounds:
+    """abort()/abort_all() landing between fused rounds: slots free,
+    pages return, nothing is reported, the batch keeps serving."""
+
+    def test_abort_between_rounds_frees_slot_and_pages(self, tiny):
+        config, params = tiny
+        eng = inference.InferenceEngine(params, config, batch_size=2,
+                                        max_seq_len=64)
+        keep = eng.submit([5, 6], _greedy(20))
+        ghost = eng.submit([9, 8], _greedy(50))
+        eng.step()                       # both mid-generation
+        eng.abort(ghost)
+        out = eng.run_to_completion()
+        assert keep in out and len(out[keep]) == 20
+        assert ghost not in out
+        assert not eng.has_work
+        assert len(eng._page_alloc) == eng._pages_total
+
+    def test_abort_all_mid_round_then_fresh_request(self, tiny):
+        config, params = tiny
+        eng = inference.InferenceEngine(params, config, batch_size=2,
+                                        max_seq_len=64)
+        eng.submit([5, 6], _greedy(40))
+        eng.submit([7, 8], _greedy(40))
+        eng.step()
+        eng.abort_all()
+        assert not eng.has_work
+        assert len(eng._page_alloc) == eng._pages_total
+        ref = _greedy_reference(params, config, [5, 6], 3)
+        rid = eng.submit([5, 6], _greedy(3))
+        assert eng.run_to_completion()[rid] == ref
+
+    def test_engine_loop_abort_applies_right_after_round(self, tiny):
+        """The server loop re-drains aborts immediately after step():
+        a watcher aborted during a fused round must not receive that
+        round's tokens and its slot frees before the next round."""
+        import asyncio
+
+        from skypilot_tpu.inference import server as srv
+        config, params = tiny
+        engine = inference.InferenceEngine(params, config,
+                                           batch_size=1,
+                                           max_seq_len=64)
+
+        async def drive():
+            loop = srv.EngineLoop(engine)
+            try:
+                ghost = loop.submit([3, 4], _greedy(60),
+                                    stream=True)
+                await asyncio.sleep(0.2)  # a round or two runs
+                loop.abort(ghost)
+                keep = loop.submit([5, 6], _greedy(3),
+                                   stream=False)
+                kind, payload = await asyncio.wait_for(keep.q.get(),
+                                                       timeout=30)
+                while kind != 'done':
+                    kind, payload = await asyncio.wait_for(
+                        keep.q.get(), timeout=30)
+                assert len(payload) == 3
+                # Aborted watcher got no event after the abort landed.
+                sent_at_abort = ghost.q.qsize()
+                await asyncio.sleep(0.1)
+                assert ghost.q.qsize() == sent_at_abort
+            finally:
+                loop.stop()
+
+        asyncio.new_event_loop().run_until_complete(drive())
+
+
+class TestFusedMetricsSemantics:
+    """Satellite: per-token counters and per-host-step instruments
+    must not undercount when one host step emits N tokens — asserted
+    against the live registry."""
+
+    def test_generated_tokens_count_every_fused_token(self, tiny):
+        config, params = tiny
+        eng = inference.InferenceEngine(params, config, batch_size=2,
+                                        max_seq_len=64)
+        gen_before = obs.GENERATED_TOKENS.value()
+        host_before = obs.DECODE_HOST_STEPS.value()
+        _, tps_sum_before, tps_n_before = \
+            obs.DECODE_TOKENS_PER_STEP.child_snapshot()
+        rids = [eng.submit([3, 17, 42], _greedy(13)),
+                eng.submit([9, 8], _greedy(13))]
+        out = eng.run_to_completion()
+        produced = sum(len(out[r]) for r in rids)
+        assert produced == 26
+        # Every token counted, though host steps were few.
+        assert obs.GENERATED_TOKENS.value() == gen_before + produced
+        host_steps = obs.DECODE_HOST_STEPS.value() - host_before
+        assert 0 < host_steps < produced / 4  # amortization visible
+        # The per-host-step histogram sums to the DECODE tokens (all
+        # generated minus the two prefill-sampled first tokens).
+        _, tps_sum, tps_n = obs.DECODE_TOKENS_PER_STEP.child_snapshot()
+        assert tps_sum - tps_sum_before == produced - len(rids)
+        assert tps_n - tps_n_before == host_steps
+
+    def test_gauges_reflect_post_round_state(self, tiny):
+        config, params = tiny
+        eng = inference.InferenceEngine(params, config, batch_size=2,
+                                        max_seq_len=64)
+        eng.submit([1, 2, 3, 4], _greedy(30))
+        eng.step()
+        # One slot holds prompt + a full fused round of tokens.
+        assert obs.BATCH_SLOTS_ACTIVE.value() == 1
+        assert obs.BATCH_OCCUPANCY.value() == 0.5
+        used = obs.KV_CACHE_UTILIZATION.value()
+        slot = [s for s in eng.state.slots if s is not None][0]
+        expect = (slot.prompt_len + len(slot.generated)) / (2 * 64)
+        assert abs(used - expect) < 1e-9
+        assert obs.KV_PAGES_TOTAL.value() == eng._pages_total
+        assert obs.KV_PAGES_FREE.value() == len(eng._page_alloc)
+        eng.run_to_completion()
+        assert obs.KV_PAGES_FREE.value() == eng._pages_total

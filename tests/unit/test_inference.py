@@ -97,8 +97,14 @@ def test_http_server_continuous_batching_and_streaming(tiny):
     from skypilot_tpu.inference import server as srv
 
     config, params = tiny
+    # decode_fuse_steps=2: the default fused round (8) finishes these
+    # short generations inside ONE step, so the per-step concurrency
+    # probe below would only ever see evicted slots. Two tokens per
+    # round keeps the requests in flight across several observable
+    # steps while still exercising the fused path.
     engine = inference.InferenceEngine(params, config, batch_size=2,
-                                       max_seq_len=64)
+                                       max_seq_len=64,
+                                       decode_fuse_steps=2)
     # Record how many requests were in flight at each decode step.
     concurrency = []
     orig_step = engine.step
@@ -847,11 +853,14 @@ class TestInterleavedPrefill:
 
     def test_decode_streams_progress_during_long_prefill(self, tiny):
         """The point of interleaving: while a long prompt prefills,
-        an in-flight stream keeps emitting ~one token per step."""
+        an in-flight stream keeps emitting ~one token per step.
+        decode_fuse_steps=1 keeps the per-step granularity this probe
+        measures (the default fused round emits bursts)."""
         config, params = tiny
         eng = inference.InferenceEngine(
             params, config, batch_size=2, max_seq_len=64,
-            prefill_chunk=4, prefill_interleave=8)
+            prefill_chunk=4, prefill_interleave=8,
+            decode_fuse_steps=1)
         active = eng.submit([5, 9], inference.SamplingParams(
             temperature=0.0, max_new_tokens=30))
         eng.step()  # active slot prefills (short path) + first token
@@ -872,9 +881,13 @@ class TestInterleavedPrefill:
 
     def test_short_prompts_keep_batched_path(self, tiny):
         config, params = tiny
+        # decode_fuse_steps=1: the default fused round would finish
+        # and EVICT this short request inside the first step; the
+        # probe below inspects the live slot.
         eng = inference.InferenceEngine(
             params, config, batch_size=2, max_seq_len=64,
-            prefill_chunk=8, prefill_interleave=16)
+            prefill_chunk=8, prefill_interleave=16,
+            decode_fuse_steps=1)
         eng.submit([1, 2, 3], inference.SamplingParams(
             temperature=0.0, max_new_tokens=5))
         eng.step()
