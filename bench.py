@@ -423,6 +423,107 @@ def _engine_loop_bench(jax, on_tpu: bool):
             'kv_paged': paged_state['paged'], 'batch_sweep': out}
 
 
+def _prefix_cache_bench(jax, on_tpu: bool):
+    """Warm-vs-cold TTFT through the REAL engine (ISSUE 11 evidence
+    channel): prompt families share a long prefix; the first request
+    per family prefills cold and publishes its pages into the radix
+    prefix cache, later ones match the prefix, map its pages COW, and
+    prefill only the short tail. TTFT is measured per request as
+    submit -> first generated token through engine.step(). Greedy
+    outputs are cross-checked token-for-token against a cache-off
+    engine — a speedup that changed tokens would be a lie."""
+    import functools as _ft
+
+    from skypilot_tpu import inference as inf
+    from skypilot_tpu.models import resolve
+
+    model = 'bench-8b' if on_tpu else 'tiny'
+    _family, cfg = resolve(model)
+    params = jax.jit(_ft.partial(_family.init_params, cfg))(
+        jax.random.key(0))
+    # The prefix must dominate TTFT for the ratio to mean anything:
+    # engine-level TTFT includes the first fused decode round, which
+    # warm and cold requests pay alike.
+    prefix_len = 1024
+    tail_len = 16
+    families = 3
+    warm_per_family = 3
+    max_seq = 2048
+    new_tokens = 8
+
+    prefixes = [[(f * 131 + j * 7) % 197 + 1
+                 for j in range(prefix_len)] for f in range(families)]
+
+    def prompt_of(f: int, r: int):
+        return prefixes[f] + [(f * 17 + r * 29 + j) % 191 + 1
+                              for j in range(tail_len)]
+
+    def build(prefix_on: bool):
+        return inf.InferenceEngine(
+            params, cfg, batch_size=4, max_seq_len=max_seq,
+            kv_quant='none', prefix_cache=prefix_on)
+
+    def ttft_of(eng, prompt):
+        rid = eng.submit(list(prompt), inf.SamplingParams(
+            temperature=0.0, max_new_tokens=new_tokens))
+        done = {}
+        t0 = time.perf_counter()
+        ttft = None
+        while ttft is None:
+            eng.step()
+            if eng.active_progress().get(rid):
+                ttft = time.perf_counter() - t0
+            done.update(eng.finished())
+            if rid in done:
+                ttft = ttft or time.perf_counter() - t0
+        while eng.has_work:
+            eng.step()
+            done.update(eng.finished())
+        done.update(eng.finished())
+        return ttft, done[rid]
+
+    eng = build(True)
+    # Warmup: absorb every compile (cold prefill widths, warm tail
+    # bucket, fused loop) on a throwaway family-shaped prompt.
+    ttft_of(eng, [(j * 13) % 173 + 1 for j in range(prefix_len)]
+            + [5] * tail_len)
+    ttft_of(eng, [(j * 13) % 173 + 1 for j in range(prefix_len)]
+            + [6] * tail_len)
+
+    cold, warm, outputs = [], [], {}
+    for f in range(families):
+        t, toks = ttft_of(eng, prompt_of(f, 0))
+        cold.append(t)
+        outputs[(f, 0)] = toks
+        for r in range(1, 1 + warm_per_family):
+            t, toks = ttft_of(eng, prompt_of(f, r))
+            warm.append(t)
+            outputs[(f, r)] = toks
+
+    off = build(False)
+    ttft_of(off, [(j * 13) % 173 + 1 for j in range(prefix_len)]
+            + [5] * tail_len)
+    identical = True
+    for (f, r), toks in outputs.items():
+        _t, ref = ttft_of(off, prompt_of(f, r))
+        if ref != toks:
+            identical = False
+            break
+
+    cold_p50 = sorted(cold)[len(cold) // 2]
+    warm_p50 = sorted(warm)[len(warm) // 2]
+    return {
+        'model': model,
+        'prefix_len': prefix_len, 'tail_len': tail_len,
+        'families': families,
+        'warm_requests': len(warm), 'cold_requests': len(cold),
+        'ttft_cold_p50_s': round(cold_p50, 5),
+        'ttft_warm_p50_s': round(warm_p50, 5),
+        'warm_speedup': round(cold_p50 / warm_p50, 2),
+        'greedy_outputs_identical_cache_on_off': identical,
+    }
+
+
 def main() -> None:
     try:
         jax, devices = _init_backend()
@@ -456,6 +557,13 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — additive, like decode
         engine_loop = {'error': f'{type(e).__name__}: {e}'}
 
+    gc.collect()
+    try:
+        _progress('prefix-cache: warm vs cold TTFT')
+        prefix_cache = _prefix_cache_bench(jax, on_tpu)
+    except Exception as e:  # noqa: BLE001 — additive, like decode
+        prefix_cache = {'error': f'{type(e).__name__}: {e}'}
+
     result = {
         'metric': (f'llama_{train["model"]}_train_tokens_per_sec_'
                    f'per_chip_{train["chip"]}'),
@@ -468,6 +576,7 @@ def main() -> None:
             **{k: v for k, v in train.items() if k != 'model'},
             'decode': decode,
             'engine_loop': engine_loop,
+            'prefix_cache': prefix_cache,
         },
     }
     print(json.dumps(result))

@@ -205,6 +205,19 @@ SKYTPU_KV_PAGES = declare(
     '0 sizes the pool to the dense equivalent '
     '(batch_size * pages-per-slot); smaller values oversubscribe and '
     'queue requests until pages free.')
+SKYTPU_PREFIX_CACHE = declare(
+    'SKYTPU_PREFIX_CACHE', bool, True,
+    'Cross-request prefix KV reuse: index finished requests\' paged '
+    'KV in a radix tree so a new prompt sharing a cached prefix maps '
+    'those pages copy-on-write into its block table and prefills only '
+    'from the first unmatched token. Applies to paged, unsharded, '
+    'draft-free engines; false disables.')
+SKYTPU_PREFIX_CACHE_MAX_PAGES = declare(
+    'SKYTPU_PREFIX_CACHE_MAX_PAGES', int, 0,
+    'Cap on KV pages the prefix cache may retain after publishing a '
+    'finished request (LRU-evicted down to the cap). 0 bounds the '
+    'cache only by the page pool itself — live requests always '
+    'reclaim cold refcount-0 cache pages on demand.')
 SKYTPU_PREFILL_INTERLEAVE = declare(
     'SKYTPU_PREFILL_INTERLEAVE', int, -1,
     'Default interleaved-prefill threshold (tokens) for engines built '

@@ -54,14 +54,28 @@ class ReplicaProfile:
     decode_step_s: float = 0.0         # fused host-step median; 0=off
     decode_step_sigma: float = 0.3
     fused_steps: int = 8               # device steps per host step
+    # Prefix-cache term (ISSUE 11): fraction of requests whose prompt
+    # prefix is warm in the replica's radix cache. A hit skips the
+    # matched span's prefill — its TTFT sample scales by
+    # warm_ttft_factor BEFORE load inflation (warm requests still
+    # queue behind busy slots) — and hits/misses/reused-tokens land
+    # in the REAL skytpu_prefix_cache_* counters, so the
+    # shared_prefix scenario's hit-ratio SLO reads the same series a
+    # production engine exports.
+    prefix_hit_ratio: float = 0.0      # 0 = no prefix-cache modeling
+    warm_ttft_factor: float = 0.12     # warm TTFT / cold TTFT
+    shared_prefix_tokens: int = 0      # reused tokens per hit
 
     def service_mean_s(self) -> float:
         """Mean busy time one request costs a decode slot."""
+        ttft = self.ttft_median_s
+        if self.prefix_hit_ratio > 0:
+            ttft *= (1.0 - self.prefix_hit_ratio
+                     * (1.0 - self.warm_ttft_factor))
         if self.decode_step_s > 0:
             host_steps = -(-self.tokens_median // self.fused_steps)
-            return self.ttft_median_s + host_steps * self.decode_step_s
-        return self.ttft_median_s + \
-            self.tokens_median * self.decode_per_token_s
+            return ttft + host_steps * self.decode_step_s
+        return ttft + self.tokens_median * self.decode_per_token_s
 
 
 class _State(enum.Enum):
@@ -257,6 +271,16 @@ class SimFleet:
         rho = r.tick_busy_s / (self._tick_seconds * p.concurrency)
         ttft = self._rng.lognormvariate(_mu(p.ttft_median_s),
                                         p.ttft_sigma)
+        if p.prefix_hit_ratio > 0:
+            if self._rng.random() < p.prefix_hit_ratio:
+                # Warm prefix: the matched span's prefill is skipped.
+                ttft *= p.warm_ttft_factor
+                obs.PREFIX_CACHE_HITS.inc()
+                if p.shared_prefix_tokens:
+                    obs.PREFIX_CACHE_REUSED_TOKENS.inc(
+                        p.shared_prefix_tokens)
+            else:
+                obs.PREFIX_CACHE_MISSES.inc()
         ttft /= max(0.05, 1.0 - min(rho, 0.95))
         tokens = max(1, int(self._rng.lognormvariate(
             _mu(float(p.tokens_median)), 0.5)))

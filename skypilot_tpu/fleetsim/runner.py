@@ -395,6 +395,51 @@ register(Scenario(
 ))
 
 register(Scenario(
+    name='shared_prefix',
+    description=('Prefix-cache gate (ROADMAP item 3 / ISSUE 11): '
+                 'traffic dominated by shared system-prompt prefixes '
+                 'against replicas with a radix prefix cache. Hits '
+                 'skip the matched span\'s prefill (warm TTFT ~ '
+                 '0.1x cold) and land in the REAL '
+                 'skytpu_prefix_cache_* counters; the SLO gates the '
+                 'hit RATIO from counter deltas plus the warm-'
+                 'traffic TTFT p95 the cache must buy. A mid-run '
+                 'burst (new tenants = cold prefixes) must not break '
+                 'either.'),
+    replicas=48,
+    duration_s=120.0, tick_s=2.0, warmup_s=30.0,
+    traffic={'kind': 'burst',
+             'inner': {'kind': 'constant', 'qps': 120.0},
+             'burst_qps': 40.0, 'at': 70.0, 'duration_s': 20.0},
+    profile=replicas_lib.ReplicaProfile(
+        startup_median_s=6.0, startup_sigma=0.3,
+        ttft_median_s=0.45, ttft_sigma=0.4,
+        tokens_median=48, concurrency=8,
+        decode_step_s=0.12, decode_step_sigma=0.3, fused_steps=8,
+        # ~87% of steady traffic shares a warm 512-token prefix;
+        # warm TTFT is ~a tenth of cold (the loadgen-measured shape).
+        prefix_hit_ratio=0.87, warm_ttft_factor=0.1,
+        shared_prefix_tokens=512),
+    policy={'max_replicas': 64, 'target_qps_per_replica': 3.0,
+            'target_queue_per_replica': 4.0,
+            'upscale_delay_seconds': 10,
+            'downscale_delay_seconds': 120},
+    lb_policy='round_robin',
+    slos=(
+        # The cache-hit-ratio gate, from counter DELTAS of the same
+        # skytpu_prefix_cache_* series a production engine exports.
+        slo_lib.CounterRatioAbove(
+            'cache_hit_ratio', threshold=0.75,
+            num_metric='skytpu_prefix_cache_hits_total',
+            den_metrics=('skytpu_prefix_cache_hits_total',
+                         'skytpu_prefix_cache_misses_total')),
+        # Warm-dominated traffic must beat the cold-engine budget.
+        slo_lib.HistQuantileBelow('ttft_p95', threshold=1.0),
+        slo_lib.RatioBelow('error_rate', threshold=0.005),
+    ),
+))
+
+register(Scenario(
     name='zone_loss',
     description=('The acceptance soak: 1000+ replicas across three '
                  'zones, a full zone killed and later restored, '

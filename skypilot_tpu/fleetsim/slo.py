@@ -51,6 +51,20 @@ class RatioBelow:
 
 
 @dataclasses.dataclass(frozen=True)
+class CounterRatioAbove:
+    """delta(num_metric) / sum(delta(den_metrics)) over the window
+    stays >= `threshold` — a ratio across SEPARATE unlabeled counters
+    (e.g. the prefix-cache hit ratio, hits / (hits + misses), from
+    skytpu_prefix_cache_{hits,misses}_total deltas)."""
+    name: str
+    threshold: float
+    num_metric: str
+    den_metrics: Tuple[str, ...]
+    window: Tuple[str, str] = _DEFAULT_WINDOW
+    min_total: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
 class GaugeWithin:
     """Current gauge value sits in [lo, threshold] — recovery-time
     gauges report -1 while recovery never happened, so lo=0 makes
@@ -62,7 +76,8 @@ class GaugeWithin:
     lo: float = 0.0
 
 
-SLOAssert = (HistQuantileBelow, RatioBelow, GaugeWithin)
+SLOAssert = (HistQuantileBelow, RatioBelow, CounterRatioAbove,
+             GaugeWithin)
 
 
 class SLOEvaluator:
@@ -79,8 +94,16 @@ class SLOEvaluator:
         self._marks: Dict[str, Dict] = {}
 
     def _needed_metrics(self) -> List[str]:
-        return sorted({a.metric for a in self.asserts
-                       if not isinstance(a, GaugeWithin)})
+        needed = set()
+        for a in self.asserts:
+            if isinstance(a, GaugeWithin):
+                continue
+            if isinstance(a, CounterRatioAbove):
+                needed.add(a.num_metric)
+                needed.update(a.den_metrics)
+            else:
+                needed.add(a.metric)
+        return sorted(needed)
 
     def mark(self, name: str) -> None:
         snap = {}
@@ -151,6 +174,29 @@ class SLOEvaluator:
                        f'{int(num)}/{int(total)} '
                        f'{"|".join(a.num_values)}')
 
+    def _eval_counter_ratio(self, a: CounterRatioAbove) -> Dict:
+        num_delta = 0.0
+        total = 0.0
+        for metric in dict.fromkeys((a.num_metric,) + a.den_metrics):
+            delta = self._delta(metric, a.window)
+            if delta is None:
+                return _result(a, math.nan, False,
+                               f'window {a.window} never marked')
+            value = sum(v for (series, _labels), v in delta.items()
+                        if series == metric)
+            if metric == a.num_metric:
+                num_delta = value
+            if metric in a.den_metrics:
+                total += value
+        if total < a.min_total:
+            return _result(a, math.nan, False,
+                           f'only {int(total)} events in window '
+                           f'(min {a.min_total})')
+        ratio = num_delta / total
+        return _result(a, ratio, ratio >= a.threshold,
+                       f'{int(num_delta)}/{int(total)} '
+                       f'{a.num_metric} (>= bound)')
+
     def _eval_gauge(self, a: GaugeWithin) -> Dict:
         metric = metrics_lib.REGISTRY.get(a.metric)
         if metric is None:
@@ -180,6 +226,8 @@ class SLOEvaluator:
                 out.append(self._eval_quantile(a))
             elif isinstance(a, RatioBelow):
                 out.append(self._eval_ratio(a))
+            elif isinstance(a, CounterRatioAbove):
+                out.append(self._eval_counter_ratio(a))
             elif isinstance(a, GaugeWithin):
                 out.append(self._eval_gauge(a))
             else:
@@ -192,7 +240,8 @@ def _result(a, value: float, ok: bool, detail: str) -> Dict:
         value = None
     elif value in (math.inf, -math.inf):
         value = 'inf'
-    return {'name': a.name, 'metric': a.metric, 'ok': bool(ok),
+    metric = getattr(a, 'metric', None) or getattr(a, 'num_metric', '')
+    return {'name': a.name, 'metric': metric, 'ok': bool(ok),
             'value': value, 'threshold': a.threshold, 'detail': detail}
 
 

@@ -28,7 +28,10 @@ def build_engine(model: str, *, checkpoint: Optional[str] = None,
                  spec_k: Optional[int] = None,
                  decode_fuse_steps: Optional[int] = None,
                  kv_page_size: Optional[int] = None,
-                 kv_pages: Optional[int] = None) -> InferenceEngine:
+                 kv_pages: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None,
+                 prefix_cache_max_pages: Optional[int] = None
+                 ) -> InferenceEngine:
     """One engine-construction path for every entrypoint (HTTP server,
     offline batch): resolve the model, build the mesh from a
     'tensor=8,context=2'-style arg, restore or random-init params."""
@@ -66,4 +69,6 @@ def build_engine(model: str, *, checkpoint: Optional[str] = None,
                            draft=draft, spec_k=spec_k,
                            decode_fuse_steps=decode_fuse_steps,
                            kv_page_size=kv_page_size,
-                           kv_pages=kv_pages)
+                           kv_pages=kv_pages,
+                           prefix_cache=prefix_cache,
+                           prefix_cache_max_pages=prefix_cache_max_pages)

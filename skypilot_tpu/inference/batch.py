@@ -102,6 +102,18 @@ def main() -> None:
     parser.add_argument('--kv-pages', type=int, default=None,
                         help='Paged KV pool size in pages (0/default '
                              '= dense-equivalent).')
+    parser.add_argument('--prefix-cache', default='auto',
+                        choices=['auto', 'on', 'off'],
+                        help='Cross-request prefix KV reuse (radix '
+                             'cache over paged KV): batches whose '
+                             'prompts share long prefixes prefill '
+                             'only the unmatched tails. auto = '
+                             'SKYTPU_PREFIX_CACHE (on).')
+    parser.add_argument('--prefix-cache-max-pages', type=int,
+                        default=None,
+                        help='Cap on pages the prefix cache retains '
+                             '(default: SKYTPU_PREFIX_CACHE_MAX_PAGES'
+                             '; 0 = pool-bounded).')
     args = parser.parse_args()
 
     from skypilot_tpu import inference as inf
@@ -117,6 +129,9 @@ def main() -> None:
         kv_quant=args.kv_quant,
         decode_fuse_steps=args.decode_fuse_steps,
         kv_page_size=args.kv_page_size, kv_pages=args.kv_pages,
+        prefix_cache=(None if args.prefix_cache == 'auto'
+                      else args.prefix_cache == 'on'),
+        prefix_cache_max_pages=args.prefix_cache_max_pages,
         # Offline: no in-flight streams to protect, and interleaving
         # would serialize long-prompt prefill one slot at a time —
         # keep the N-wide batched chunk scan.

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from skypilot_tpu import envs
+from skypilot_tpu.inference import prefix_cache as prefix_lib
 from skypilot_tpu.models import llama
 from skypilot_tpu.models import moe as moe_lib
 from skypilot_tpu.observability import instruments as obs
@@ -161,6 +162,19 @@ def _paged_write(pages, new: jax.Array, table: jax.Array,
         return {'q': write_leaf(pages['q'], newq['q']),
                 's': write_leaf(pages['s'], newq['s'])}
     return write_leaf(pages, new)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _copy_pool_page(pool, src: jax.Array, dst: jax.Array):
+    """Copy page `src` onto page `dst` across every layer of one page
+    pool ([L, P, page, ...] leaves, raw or {'q','s'} quantized) — the
+    device half of copy-on-write: a write about to land in a SHARED
+    page first lands its victim in a private copy, so the radix
+    cache's original bytes survive for the next match. `src`/`dst`
+    are traced scalars (one compile serves every copy) and the pool
+    is donated (XLA edits it in place, no second pool in HBM)."""
+    return jax.tree.map(lambda leaf: leaf.at[:, dst].set(leaf[:, src]),
+                        pool)
 
 
 def init_cache(config: llama.LlamaConfig, batch_size: int,
@@ -1019,6 +1033,9 @@ class _Slot:
     # being written (None once decoding), and the next write position.
     pending: Optional[List[int]] = None
     pos: int = 0
+    # The truncated prompt, kept for the prefix cache: publishing a
+    # finished request's pages needs the token sequence its KV holds.
+    prompt: List[int] = dataclasses.field(default_factory=list)
 
 
 class DecodeState:
@@ -1091,7 +1108,9 @@ class InferenceEngine:
                  spec_k: Optional[int] = None,
                  decode_fuse_steps: Optional[int] = None,
                  kv_page_size: Optional[int] = None,
-                 kv_pages: Optional[int] = None):
+                 kv_pages: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None,
+                 prefix_cache_max_pages: Optional[int] = None):
         # The cached decode path mirrors the llama-core transformer
         # (every family knob: window/GeGLU/post-norms/softcaps/tied
         # embeddings) and the MoE family (routed expert MLP).
@@ -1258,12 +1277,35 @@ class InferenceEngine:
         self._page_alloc: List[int] = []
         self._slot_pages: List[List[int]] = [[] for _ in
                                              range(batch_size)]
+        # Per-slot table indices that are COW-mapped from the prefix
+        # cache: reads are free, a write there must copy the page
+        # private first (_cow_slot_page).
+        self._slot_shared: List[set] = [set() for _ in
+                                        range(batch_size)]
         self._pages_total = 0
         if _is_paged(self.state.cache):
             k = self.state.cache['k']
             leaf = k['q'] if _is_quant(k) else k
             self._pages_total = int(leaf.shape[1]) - 1
             self._page_alloc = list(range(1, self._pages_total + 1))
+        # Cross-request prefix KV reuse (ROADMAP item 3): a radix
+        # index over the page pool. Needs the paged layout (reuse is
+        # table edits over shared pages), a draft-free engine (the
+        # draft cache's pages hold DRAFT KV — reusing only the main
+        # model's would desynchronize the pair), and chunked prefill
+        # (warm tails resume through prefill_chunk_at).
+        if prefix_cache is None:
+            prefix_cache = envs.SKYTPU_PREFIX_CACHE.get()
+        if prefix_cache_max_pages is None:
+            prefix_cache_max_pages = \
+                envs.SKYTPU_PREFIX_CACHE_MAX_PAGES.get()
+        self.prefix_cache_max_pages = max(0, int(prefix_cache_max_pages))
+        self._prefix: Optional[prefix_lib.RadixPrefixCache] = None
+        if (prefix_cache and self.kv_page_size
+                and self._draft_params is None
+                and self.prefill_chunk > 0):
+            self._prefix = prefix_lib.RadixPrefixCache(
+                self.kv_page_size)
         self._fused_dispatches = 0
         self._queue: List[Tuple[int, List[int], SamplingParams]] = []
         self._finished: Dict[int, List[int]] = {}
@@ -1360,6 +1402,11 @@ class InferenceEngine:
             if slot is not None:
                 self._free_slot(i)
                 aborted += 1
+        if self._prefix is not None:
+            # Error recovery must not trust (or leak) cached KV: drop
+            # the whole index; with every slot freed above, nothing
+            # is pinned and every page returns to the pool.
+            self._page_alloc.extend(self._prefix.clear())
         if aborted:
             obs.REQUESTS_ABORTED.inc(aborted)
         self._update_gauges()
@@ -1417,39 +1464,101 @@ class InferenceEngine:
         inserts: List[Tuple[int, List[int], SamplingParams]] = []
         slot_ids: List[int] = []
         while free and self._queue:
+            matched: Optional[prefix_lib.MatchResult] = None
             if self.kv_page_size:
                 # Page admission BEFORE popping: an oversubscribed
                 # pool holds the request at the queue head (FIFO — no
                 # starving big requests) until evictions free pages.
                 _rid, peek_tokens, peek_sampling = self._queue[0]
+                peek_trunc = peek_tokens[:self.state.max_seq_len - 1]
                 need = self._pages_needed(
-                    len(peek_tokens[:self.state.max_seq_len - 1]),
-                    peek_sampling.max_new_tokens)
-                if need > len(self._page_alloc):
-                    break
+                    len(peek_trunc), peek_sampling.max_new_tokens)
+                need_private = need
+                if self._prefix is not None:
+                    # Hit/miss decided HERE, before scheduling
+                    # prefill: matched full pages map COW into the
+                    # table instead of being recomputed. acquire()
+                    # BEFORE any reclaim below — eviction must never
+                    # harvest the very pages this request matched.
+                    matched = self._prefix.match(peek_trunc)
+                    if matched.pages:
+                        self._prefix.acquire(matched.pages)
+                    # A fully-cached prompt still needs last-token
+                    # logits: its final page is re-written (one
+                    # token), which COWs it — one extra private page.
+                    cow = 1 if (matched.pages and matched.tokens
+                                >= len(peek_trunc)) else 0
+                    need_private = need - len(matched.pages) + cow
+                if need_private > len(self._page_alloc):
+                    # Live requests outrank cached history: reclaim
+                    # cold refcount-0 prefix-cache pages (LRU) before
+                    # queueing the request.
+                    if self._prefix is not None:
+                        self._reclaim(
+                            need_private - len(self._page_alloc))
+                    if need_private > len(self._page_alloc):
+                        if matched is not None and matched.pages:
+                            self._prefix.release(matched.pages)
+                        break
             slot = free.pop(0)
             request_id, tokens, sampling = self._queue.pop(0)
             tokens = tokens[:self.state.max_seq_len - 1]
             if self.kv_page_size:
-                pages = self._page_alloc[:need]
-                del self._page_alloc[:need]
-                self._slot_pages[slot] = pages
-                self._set_table_rows(slot, pages)
+                fresh = self._page_alloc[:need_private]
+                del self._page_alloc[:need_private]
+                if matched is not None and matched.pages:
+                    # COW-map the matched pages at the head of the
+                    # table; the one extra `cow` page (full-match
+                    # case) rides at the END of `fresh` and is
+                    # consumed by _cow_slot_page below.
+                    pages = list(matched.pages) + fresh
+                    self._slot_pages[slot] = pages[:need]
+                    self._slot_shared[slot] = set(
+                        range(len(matched.pages)))
+                    if len(pages) > need:
+                        self._page_alloc[:0] = pages[need:]
+                else:
+                    self._slot_pages[slot] = fresh
+                    self._slot_shared[slot] = set()
+                self._set_table_rows(slot, self._slot_pages[slot])
             # Counted POST-truncation, at insert: the counter must
             # reflect tokens the engine actually prefills, or
             # prompt-side throughput read from /metrics deltas
             # over-reports for over-length prompts.
             obs.PROMPT_TOKENS.inc(len(tokens))
+            if self._prefix is not None:
+                if matched is not None and matched.pages:
+                    obs.PREFIX_CACHE_HITS.inc()
+                else:
+                    obs.PREFIX_CACHE_MISSES.inc()
+            if matched is not None and matched.pages:
+                # WARM request: prefill resumes from the first
+                # unmatched token via the prefill_chunk_at path (the
+                # pending machinery interleaved prefill already has).
+                # A fully-cached prompt re-runs only its LAST token —
+                # that write lands in the final shared page, so COW
+                # copies it private first; near-zero TTFT either way.
+                start = matched.tokens
+                if start >= len(tokens):
+                    start = len(tokens) - 1
+                    self._cow_slot_page(
+                        slot, start // self.kv_page_size)
+                obs.PREFIX_CACHE_REUSED_TOKENS.inc(start)
+                self.state.slots[slot] = _Slot(
+                    request_id, sampling, [], [], len(tokens),
+                    pending=tokens, pos=start, prompt=tokens)
+                continue
             if (self.prefill_interleave
                     and len(tokens) > self.prefill_interleave):
                 # LONG prompt: prefill one chunk per step() instead of
                 # stalling every in-flight stream for the whole thing.
                 self.state.slots[slot] = _Slot(
                     request_id, sampling, [], [], len(tokens),
-                    pending=tokens, pos=0)
+                    pending=tokens, pos=0, prompt=tokens)
                 continue
             self.state.slots[slot] = _Slot(request_id, sampling, [],
-                                           [], len(tokens))
+                                           [], len(tokens),
+                                           prompt=tokens)
             inserts.append((request_id, tokens, sampling))
             slot_ids.append(slot)
         if not inserts:
@@ -1505,23 +1614,111 @@ class InferenceEngine:
         self.state.last_tokens = jnp.asarray(last)
         obs.GENERATED_TOKENS.inc(len(slot_ids))
 
-    def _advance_prefill(self) -> None:
-        """Advance the oldest mid-prefill slot by ONE chunk (the
-        interleaved-prefill tick). Total prefill time for a lone long
-        prompt is unchanged — the one-shot path was a serial chunk
-        scan too — but other streams now interleave a decode step
-        between chunks instead of stalling for the whole prompt."""
-        target = None
-        for i, slot in enumerate(self.state.slots):
-            if slot is not None and slot.pending is not None:
-                target = (i, slot)
-                break
-        if target is None:
+    # -- prefix-cache page machinery -----------------------------------------
+
+    def _reclaim(self, n_pages: int) -> None:
+        """Live requests outrank cached history: LRU-evict up to
+        `n_pages` cold refcount-0 prefix-cache pages back into the
+        free pool. Pages pinned by in-flight slots are structurally
+        untouchable (refcount > 0 leaves are skipped)."""
+        if self._prefix is None:
             return
-        i, slot = target
+        freed = self._prefix.evict_lru(n_pages)
+        if freed:
+            self._page_alloc.extend(freed)
+            obs.PREFIX_CACHE_EVICTIONS.inc(len(freed))
+
+    def _enforce_cache_cap(self) -> None:
+        """Hold the radix index at SKYTPU_PREFIX_CACHE_MAX_PAGES
+        after a publish (0 = bounded only by the pool)."""
+        cap = self.prefix_cache_max_pages
+        if self._prefix is None or not cap:
+            return
+        over = self._prefix.num_pages() - cap
+        if over > 0:
+            self._reclaim(over)
+
+    def _cow_slot_page(self, i: int, idx: int) -> None:
+        """Copy-on-write: slot i's table entry `idx` maps a page
+        SHARED with the radix cache and is about to be written — copy
+        it into a private page first (device copy + table edit), so
+        the cached original survives for the next match."""
+        src = self._slot_pages[i][idx]
+        if not self._page_alloc:
+            self._reclaim(1)
+        if not self._page_alloc:
+            # Admission reserved one page per possible COW, so this
+            # is a bookkeeping bug, not a load condition.
+            raise RuntimeError(
+                'COW needs a free page but the pool is empty')
+        dst = self._page_alloc.pop(0)
+        src_a, dst_a = jnp.int32(src), jnp.int32(dst)
+        self.state.cache['k'] = _copy_pool_page(
+            self.state.cache['k'], src_a, dst_a)
+        self.state.cache['v'] = _copy_pool_page(
+            self.state.cache['v'], src_a, dst_a)
+        self._slot_pages[i][idx] = dst
+        self._slot_shared[i].discard(idx)
+        self._set_table_rows(i, self._slot_pages[i])
+        self._prefix.release([src])
+        if not self._prefix.owns(src):
+            self._page_alloc.append(src)
+
+    def _cow_guard(self, i: int, first_pos: int,
+                   last_pos: int) -> None:
+        """Before writes land at positions [first_pos, last_pos] of
+        slot i, COW any shared page in that span. The engine keeps
+        writes out of shared spans by construction (matches are
+        page-aligned and below the prefill resume point), so this
+        fires only for the full-prompt-match last page — but every
+        write path runs it, so a shared page can never be scribbled
+        on no matter how the paths evolve."""
+        shared = self._slot_shared[i]
+        if not shared:
+            return
+        page = self.kv_page_size
+        for idx in range(first_pos // page, last_pos // page + 1):
+            if idx in shared:
+                self._cow_slot_page(i, idx)
+
+    # -- interleaved / resumed prefill ---------------------------------------
+
+    def _advance_prefill(self) -> None:
+        """Advance mid-prefill slots: ONE long-prompt chunk per step
+        (the interleaved-prefill tick — other streams stall one
+        chunk, not a whole prompt) plus EVERY slot whose remainder
+        fits a single narrow chunk (warm prefix-cache tails must not
+        queue a tick each behind one another; their forwards are
+        bucket-width, near-free)."""
+        long_done = False
+        for i, slot in enumerate(self.state.slots):
+            if slot is None or slot.pending is None:
+                continue
+            remaining = len(slot.pending) - slot.pos
+            if remaining > self.prefill_chunk:
+                if long_done:
+                    continue
+                long_done = True
+            self._advance_prefill_slot(i, slot)
+
+    def _advance_prefill_slot(self, i: int, slot: _Slot) -> None:
+        """One chunk of prefill for slot i, at the narrowest
+        power-of-two bucket that covers the remainder: a 16-token
+        warm tail must not pay a 1024-wide forward — that width IS
+        the warm TTFT. Bucketing keeps the compiled-shape count
+        bounded (like insert's pad bucketing)."""
         chunk = self.prefill_chunk
         start = slot.pos
+        remaining = len(slot.pending) - start
+        if remaining < chunk:
+            bucket = 16
+            while bucket < remaining:
+                bucket *= 2
+            chunk = min(chunk, bucket)
         toks = slot.pending[start:start + chunk]
+        # The whole chunk width writes (padding included) — COW any
+        # shared page in its way before dispatch.
+        self._cow_guard(i, start, start + chunk - 1)
         arr = jnp.array([toks + [0] * (chunk - len(toks))], jnp.int32)
         visible = jnp.array([min(len(slot.pending), start + len(toks))],
                             jnp.int32)
@@ -1561,22 +1758,62 @@ class InferenceEngine:
         self.state.last_tokens = jnp.asarray(last)
         obs.GENERATED_TOKENS.inc(1)
 
-    def _free_slot(self, i: int) -> None:
+    def _free_slot(self, i: int, publish: bool = False) -> None:
         """Release slot i: cache lengths zero (stale keys invisible),
         draft cache mirrored; with paging, the slot's pages return to
         the pool and its table row resets to the scratch page — an
         empty slot's masked decode writes must never land in a page
-        that was re-issued to another request."""
+        that was re-issued to another request.
+
+        With the prefix cache, `publish=True` (normal completion)
+        hands the slot's full prompt+generated pages to the radix
+        index instead of freeing them — the whole point of reuse —
+        and COW pins on matched pages release either way; eviction of
+        the published pages is then LRU at refcount 0."""
+        slot = self.state.slots[i]
         self.state.slots[i] = None
         self.state.cache['length'] = \
             self.state.cache['length'].at[i].set(0)
         if self.state.draft_cache is not None:
             self.state.draft_cache['length'] = \
                 self.state.draft_cache['length'].at[i].set(0)
-        if self.kv_page_size and self._slot_pages[i]:
-            self._page_alloc.extend(self._slot_pages[i])
-            self._slot_pages[i] = []
-            self._set_table_rows(i, [])
+        if not (self.kv_page_size and self._slot_pages[i]):
+            self._slot_shared[i] = set()
+            return
+        pages = self._slot_pages[i]
+        shared_pages = [pages[j] for j in sorted(self._slot_shared[i])]
+        self._slot_pages[i] = []
+        self._slot_shared[i] = set()
+        self._set_table_rows(i, [])
+        published_upto = 0
+        if (publish and self._prefix is not None and slot is not None
+                and slot.pending is None and slot.generated):
+            # Positions 0..length-1 hold the KV of prompt +
+            # generated[:-1] (the last sampled token was never fed
+            # back); publish the FULL pages of that span.
+            length = slot.prompt_len + len(slot.generated) - 1
+            full = length // self.kv_page_size
+            if full > 0:
+                seq = (slot.prompt
+                       + slot.generated)[:full * self.kv_page_size]
+                leftover = self._prefix.insert(seq, pages[:full])
+                published_upto = full
+                # Duplicates — the same span was published first
+                # under other page ids — return to the pool; pages
+                # this slot had MATCHED from the tree re-walk their
+                # own nodes and are never reported back.
+                self._page_alloc.extend(leftover)
+        if self._prefix is not None and shared_pages:
+            self._prefix.release(shared_pages)
+            # A released page the tree no longer owns (post-clear)
+            # must return to the pool rather than leak.
+            self._page_alloc.extend(
+                p for p in shared_pages if not self._prefix.owns(p))
+        shared_set = set(shared_pages)
+        self._page_alloc.extend(
+            p for j, p in enumerate(pages)
+            if j >= published_upto and p not in shared_set)
+        self._enforce_cache_cap()
 
     def _spec_round(self, active_mask: List[bool]) -> None:
         active = jnp.array(active_mask)
@@ -1626,7 +1863,9 @@ class InferenceEngine:
             if hit_eos or full or len(slot.generated) >= s.max_new_tokens:
                 self._finished[slot.request_id] = slot.generated
                 self._finished_logprobs[slot.request_id] = slot.logprobs
-                self._free_slot(i)
+                # Normal completion PUBLISHES the slot's pages into
+                # the radix prefix cache instead of freeing them.
+                self._free_slot(i, publish=True)
                 obs.REQUESTS_FINISHED.inc()
 
     def _update_gauges(self) -> None:
@@ -1646,6 +1885,14 @@ class InferenceEngine:
         if self.kv_page_size:
             obs.KV_PAGES_TOTAL.set(self._pages_total)
             obs.KV_PAGES_FREE.set(len(self._page_alloc))
+            # Pool composition: free + cached (radix tree) + private
+            # (slot-exclusive) = total — the split that explains a
+            # dropped hit ratio (no cached pages left to match).
+            cached = (self._prefix.num_pages()
+                      if self._prefix is not None else 0)
+            obs.PREFIX_CACHE_PAGES.set(cached)
+            obs.KV_PAGES_PRIVATE.set(
+                self._pages_total - len(self._page_alloc) - cached)
 
     def step(self) -> None:
         self._evict_finished()
@@ -1657,6 +1904,17 @@ class InferenceEngine:
         if not any(active_mask):
             self._update_gauges()
             return
+        if self._prefix is not None:
+            # Decode writes landing in a shared page COW it first
+            # (unreachable by construction — matches are page-aligned
+            # below the resume point — but enforced on every path).
+            for i, on in enumerate(active_mask):
+                if not on or not self._slot_shared[i]:
+                    continue
+                s = self.state.slots[i]
+                length = s.prompt_len + len(s.generated) - 1
+                self._cow_guard(i, length,
+                                length + self.decode_fuse_steps - 1)
         if (self._draft_params is not None
                 and all(s.params.temperature <= 0.0
                         for s in self.state.slots

@@ -219,6 +219,23 @@ def create_app(engine_holder: Dict[str, Any]):
                 'batch_occupancy': obs.BATCH_OCCUPANCY.value(),
                 'kv_cache_utilization':
                     obs.KV_CACHE_UTILIZATION.value(),
+                # Page-pool composition: utilization alone can't say
+                # WHY a hit ratio dropped — no free pages, or no
+                # cached pages left to match.
+                'kv_pages': {
+                    'total': int(obs.KV_PAGES_TOTAL.value()),
+                    'free': int(obs.KV_PAGES_FREE.value()),
+                    'cached': int(obs.PREFIX_CACHE_PAGES.value()),
+                    'private': int(obs.KV_PAGES_PRIVATE.value()),
+                },
+                'prefix_cache': {
+                    'hits': int(obs.PREFIX_CACHE_HITS.value()),
+                    'misses': int(obs.PREFIX_CACHE_MISSES.value()),
+                    'reused_tokens':
+                        int(obs.PREFIX_CACHE_REUSED_TOKENS.value()),
+                    'evictions':
+                        int(obs.PREFIX_CACHE_EVICTIONS.value()),
+                },
             }
         return web.json_response(doc, status=200 if ok else 503)
 
@@ -413,6 +430,23 @@ def main() -> None:
                              'sizes the pool to the dense equivalent. '
                              'Smaller pools oversubscribe and queue '
                              'requests until pages free.')
+    parser.add_argument('--prefix-cache', default='auto',
+                        choices=['auto', 'on', 'off'],
+                        help='Cross-request prefix KV reuse: finished '
+                             'requests\' pages stay indexed in a '
+                             'radix tree; a new prompt sharing a '
+                             'cached prefix maps those pages COW and '
+                             'prefills only the unmatched tail '
+                             '(near-zero warm TTFT). auto (default) '
+                             'resolves via SKYTPU_PREFIX_CACHE (on); '
+                             'paged, unsharded, draft-free engines '
+                             'only.')
+    parser.add_argument('--prefix-cache-max-pages', type=int,
+                        default=None,
+                        help='Cap on KV pages the prefix cache '
+                             'retains (LRU-evicted down to it). '
+                             'Default: SKYTPU_PREFIX_CACHE_MAX_PAGES '
+                             '(0 = bounded by the pool only).')
     parser.add_argument('--no-exit-with-parent', action='store_true',
                         help='Keep serving after the launcher exits '
                              '(deliberate daemonization only)')
@@ -440,7 +474,10 @@ def main() -> None:
             draft_checkpoint=args.draft_checkpoint,
             spec_k=args.spec_k,
             decode_fuse_steps=args.decode_fuse_steps,
-            kv_page_size=args.kv_page_size, kv_pages=args.kv_pages)
+            kv_page_size=args.kv_page_size, kv_pages=args.kv_pages,
+            prefix_cache=(None if args.prefix_cache == 'auto'
+                          else args.prefix_cache == 'on'),
+            prefix_cache_max_pages=args.prefix_cache_max_pages)
         holder['loop'] = EngineLoop(engine)
 
     threading.Thread(target=_load, daemon=True).start()

@@ -137,6 +137,25 @@ class LoadBalancer:
             'breakers': breakers,
             'candidates': sum(1 for s in breakers.values()
                               if s != 'open'),
+            # Engine pressure from the process-local registry (real
+            # series in co-located/fleetsim deployments): utilization
+            # alone can't explain a dropped prefix-cache hit ratio —
+            # the free/cached/private page split can.
+            'engine': {
+                'queue_depth': obs.QUEUE_DEPTH.value(),
+                'kv_cache_utilization':
+                    obs.KV_CACHE_UTILIZATION.value(),
+                'kv_pages': {
+                    'total': int(obs.KV_PAGES_TOTAL.value()),
+                    'free': int(obs.KV_PAGES_FREE.value()),
+                    'cached': int(obs.PREFIX_CACHE_PAGES.value()),
+                    'private': int(obs.KV_PAGES_PRIVATE.value()),
+                },
+                'prefix_cache_hits':
+                    int(obs.PREFIX_CACHE_HITS.value()),
+                'prefix_cache_misses':
+                    int(obs.PREFIX_CACHE_MISSES.value()),
+            },
         })
 
     async def _handle_proxy(self, request):

@@ -118,6 +118,49 @@ def test_trace_safety_passes_clean_fused_decode_body(tmp_path):
     assert findings == []
 
 
+def test_trace_safety_passes_cow_page_copy_helper(tmp_path):
+    """The prefix-cache COW write helper's idiom (ISSUE 11): a jitted
+    donated page-pool copy — tree.map over raw/quantized leaves with
+    traced src/dst indices and .at[:, dst].set — is trace-clean and
+    must not flag."""
+    findings = _run_snippet(tmp_path, """
+        import functools
+
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def copy_pool_page(pool, src, dst):
+            return jax.tree.map(
+                lambda leaf: leaf.at[:, dst].set(leaf[:, src]), pool)
+    """, 'trace-safety')
+    assert findings == []
+
+
+def test_trace_safety_flags_host_bookkeeping_in_cow_helper(tmp_path):
+    """The broken twin: COW bookkeeping (shared-page sets, refcount
+    dicts, allocator pops) is HOST state — mutating it inside the
+    jitted copy runs once at trace time and silently corrupts the
+    allocator on every later call."""
+    findings = _run_snippet(tmp_path, """
+        import functools
+
+        import jax
+
+        SHARED = set()
+        FREE_PAGES = [1, 2, 3]
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def copy_pool_page(pool, src, dst):
+            SHARED.discard(int(src))         # tracer coercion — flag
+            FREE_PAGES.append(dst)           # closure mutation — flag
+            return jax.tree.map(
+                lambda leaf: leaf.at[:, dst].set(leaf[:, src]), pool)
+    """, 'trace-safety')
+    rules = _rules(findings)
+    assert 'tracer-coercion' in rules
+    assert 'closure-mutation' in rules
+
+
 def test_trace_safety_flags_tracer_coercion(tmp_path):
     findings = _run_snippet(tmp_path, """
         import jax

@@ -149,6 +149,37 @@ class TestSLOEvaluator:
         (result,) = ev.evaluate()
         assert result['ok'] and abs(result['value'] - 0.02) < 1e-9
 
+    def test_counter_ratio_across_metrics(self):
+        """CounterRatioAbove: a ratio across SEPARATE counters (the
+        prefix-cache hit ratio), from window deltas."""
+        ev = slo_lib.SLOEvaluator([slo_lib.CounterRatioAbove(
+            'hit_ratio', threshold=0.6,
+            num_metric='skytpu_prefix_cache_hits_total',
+            den_metrics=('skytpu_prefix_cache_hits_total',
+                         'skytpu_prefix_cache_misses_total'),
+            window=('a', 'b'))])
+        ev.mark('a')
+        for _ in range(8):
+            obs.PREFIX_CACHE_HITS.inc()
+        for _ in range(2):
+            obs.PREFIX_CACHE_MISSES.inc()
+        ev.mark('b')
+        (result,) = ev.evaluate()
+        assert result['ok'] and abs(result['value'] - 0.8) < 1e-9
+        assert result['metric'] == 'skytpu_prefix_cache_hits_total'
+
+    def test_counter_ratio_zero_events_fails(self):
+        ev = slo_lib.SLOEvaluator([slo_lib.CounterRatioAbove(
+            'hit_ratio', threshold=0.5,
+            num_metric='skytpu_prefix_cache_hits_total',
+            den_metrics=('skytpu_prefix_cache_hits_total',
+                         'skytpu_prefix_cache_misses_total'),
+            window=('a', 'b'))])
+        ev.mark('a')
+        ev.mark('b')
+        (result,) = ev.evaluate()
+        assert not result['ok'] and 'events' in result['detail']
+
     def test_never_fired_event_gauge_fails(self):
         """A gauge series that was never written must FAIL, not read
         as 0.0 'recovered instantly' — a retimed/misspelled chaos
@@ -269,6 +300,40 @@ class TestSimFleet:
         assert fleet.handle_request('http://gone.sim:8080') is None
         fleet.end_tick()
 
+    def test_prefix_hit_term_counts_and_speeds_warm_requests(self):
+        serve_state.add_service(SVC, {'run': 'true'}, lb_port=0,
+                                controller_port=0)
+        clk = clock_lib.VirtualClock()
+        profile = replicas_lib.ReplicaProfile(
+            startup_median_s=10.0, startup_sigma=0.0,
+            ttft_median_s=0.5, ttft_sigma=0.0,
+            prefix_hit_ratio=0.5, warm_ttft_factor=0.1,
+            shared_prefix_tokens=256, concurrency=1000)
+        fleet = replicas_lib.SimFleet(SVC, clk, random.Random(0),
+                                      profile, zones=['za'])
+        fleet.scale_up(1)
+        clk.advance(11.0)
+        fleet.probe_all()
+        (endpoint,) = fleet.ready_endpoints()
+        h0 = obs.PREFIX_CACHE_HITS.value()
+        m0 = obs.PREFIX_CACHE_MISSES.value()
+        r0 = obs.PREFIX_CACHE_REUSED_TOKENS.value()
+        fleet.begin_tick(1000.0)
+        ttfts = [fleet.handle_request(endpoint)[0]
+                 for _ in range(200)]
+        fleet.end_tick()
+        hits = obs.PREFIX_CACHE_HITS.value() - h0
+        misses = obs.PREFIX_CACHE_MISSES.value() - m0
+        assert hits + misses == 200
+        assert 60 < hits < 140            # ~half, seeded rng
+        assert obs.PREFIX_CACHE_REUSED_TOKENS.value() - r0 == \
+            hits * 256
+        # Warm samples are a tenth of cold (sigma 0: bimodal, up to
+        # the tiny within-tick load inflation).
+        warm = [t for t in ttfts if t < 0.25]
+        assert len(warm) == hits
+        assert all(abs(t - 0.05) < 1e-3 for t in warm)
+
 
 # --- the tier-1 smoke scenario (the CI gate) --------------------------------
 
@@ -320,6 +385,30 @@ class TestSmokeScenario:
         assert by_name['ttft_p95']['ok'], by_name['ttft_p95']
         assert report['rc'] == 0, report['asserts']
         assert report['extra']['requests'] > 1000
+
+    def test_shared_prefix_scenario_gates_hit_ratio(self, tmp_path):
+        """ISSUE 11 satellite: the shared_prefix scenario models a
+        prefix-hit-ratio replica term and gates the cache hit RATIO
+        from counter deltas of the REAL skytpu_prefix_cache_*
+        registry series (the ones the engine exports), plus the
+        warm-traffic TTFT p95 the cache is supposed to buy."""
+        sim = runner_lib.FleetSim(
+            runner_lib.SCENARIOS['shared_prefix'], seed=0,
+            out_dir=str(tmp_path))
+        report = sim.run()
+        by_name = {r['name']: r for r in report['asserts']}
+        hit = by_name['cache_hit_ratio']
+        assert hit['ok'], hit
+        assert hit['metric'] == 'skytpu_prefix_cache_hits_total'
+        # The ratio resolved from real counter deltas, near the
+        # profile's configured 0.87 (not a stub or an absolute read).
+        assert 0.75 <= hit['value'] <= 1.0
+        assert by_name['ttft_p95']['ok'], by_name['ttft_p95']
+        assert report['rc'] == 0, report['asserts']
+        assert report['extra']['requests'] > 1000
+        data = json.loads(open(os.path.join(
+            str(tmp_path), 'SLO_shared_prefix.json')).read())
+        assert data['rc'] == 0 and data['scenario'] == 'shared_prefix'
 
     def test_controller_stall_and_crash_fault_modes(self, tmp_path):
         """`controller.step` has two chaos modes: latency_only arms a

@@ -1,0 +1,375 @@
+"""Cross-request prefix KV reuse: radix cache over paged blocks.
+
+Acceptance (ISSUE 11): matched full prompt pages map copy-on-write
+into the new slot's block table (table edits only — zero recompiles,
+asserted via the PR 10 CI pattern), prefill runs only from the first
+unmatched token, eviction is LRU over refcounted pages (refcount > 0
+is never reclaimed), and greedy output with the cache enabled is
+token-for-token what the cache-off engine produces.
+"""
+import jax
+import jax.numpy as jnp
+import pytest
+
+from skypilot_tpu import inference
+from skypilot_tpu.inference import engine as eng_lib
+from skypilot_tpu.inference.prefix_cache import RadixPrefixCache
+from skypilot_tpu.models import llama
+from skypilot_tpu.observability import instruments as obs
+
+
+@pytest.fixture(scope='module')
+def tiny():
+    config = llama.CONFIGS['tiny']
+    params = llama.init_params(config, jax.random.key(7))
+    return config, params
+
+
+def _greedy(max_new):
+    return inference.SamplingParams(temperature=0.0,
+                                    max_new_tokens=max_new)
+
+
+def _engine(params, config, **kw):
+    kw.setdefault('batch_size', 2)
+    kw.setdefault('max_seq_len', 128)
+    kw.setdefault('kv_page_size', 8)
+    kw.setdefault('kv_quant', 'none')
+    return inference.InferenceEngine(params, config, **kw)
+
+
+# --- the radix tree itself (pure host bookkeeping) --------------------------
+
+class TestRadixTree:
+
+    def test_match_insert_full_pages_only(self):
+        t = RadixPrefixCache(4)
+        toks = list(range(12))
+        assert t.insert(toks, [1, 2, 3]) == []
+        m = t.match(toks + [99])
+        assert m.pages == [1, 2, 3] and m.tokens == 12
+        # A partial final page never matches: 10 tokens = 2 full pages.
+        m = t.match(toks[:10])
+        assert m.pages == [1, 2] and m.tokens == 8
+        # Shorter than one page: no match.
+        assert t.match(toks[:3]).pages == []
+
+    def test_match_splits_edge_at_divergence(self):
+        t = RadixPrefixCache(4)
+        t.insert(list(range(12)), [1, 2, 3])
+        # Shares pages [1, 2], diverges in the third page.
+        m = t.match(list(range(8)) + [50] * 4)
+        assert m.pages == [1, 2] and m.tokens == 8
+        # The split left both spans matchable.
+        assert t.match(list(range(12))).pages == [1, 2, 3]
+
+    def test_insert_splits_and_branches(self):
+        t = RadixPrefixCache(4)
+        t.insert(list(range(12)), [1, 2, 3])
+        branch = list(range(8)) + [50] * 8
+        assert t.insert(branch, [1, 2, 7, 8]) == []
+        assert t.num_pages() == 5
+        assert t.match(branch).pages == [1, 2, 7, 8]
+        assert t.match(list(range(12))).pages == [1, 2, 3]
+
+    def test_duplicate_publish_returns_leftovers(self):
+        t = RadixPrefixCache(4)
+        t.insert(list(range(12)), [1, 2, 3])
+        # Same tokens under different ids: tree keeps its copy.
+        assert t.insert(list(range(12)), [1, 9, 3]) == [9]
+        assert t.num_pages() == 3
+
+    def test_refcount_lifecycle_guards_eviction(self):
+        t = RadixPrefixCache(4)
+        t.insert(list(range(12)), [1, 2, 3])
+        t.insert(list(range(8)) + [50] * 8, [1, 2, 7, 8])
+        t.acquire([1, 2])
+        freed = t.evict_lru(100)
+        # rc-0 leaves went; the pinned [1, 2] prefix did not.
+        assert sorted(freed) == [3, 7, 8]
+        assert t.evict_lru(100) == []      # pinned leaf skipped
+        t.release([1, 2])
+        assert sorted(t.evict_lru(100)) == [1, 2]
+        assert t.num_pages() == 0
+
+    def test_eviction_trims_leaf_tail_first(self):
+        t = RadixPrefixCache(4)
+        t.insert(list(range(16)), [1, 2, 3, 4])
+        assert t.evict_lru(2) == [3, 4]
+        # The head of the span stays matchable.
+        m = t.match(list(range(16)))
+        assert m.pages == [1, 2] and m.tokens == 8
+
+    def test_clear_returns_unpinned_only(self):
+        t = RadixPrefixCache(4)
+        t.insert(list(range(12)), [1, 2, 3])
+        t.acquire([1])
+        assert sorted(t.clear()) == [2, 3]
+        assert not t.owns(1)               # holder decides its fate
+        t.release([1])
+
+
+# --- engine integration: hits, equivalence, COW -----------------------------
+
+class TestPrefixReuse:
+
+    def test_warm_request_hits_and_reuses_tokens(self, tiny):
+        config, params = tiny
+        eng = _engine(params, config)
+        prefix = [i % 97 + 1 for i in range(40)]
+        eng.submit(prefix + [7, 8], _greedy(6))
+        eng.run_to_completion()
+        hits0 = obs.PREFIX_CACHE_HITS.value()
+        reused0 = obs.PREFIX_CACHE_REUSED_TOKENS.value()
+        eng.submit(prefix + [9, 10, 11], _greedy(6))
+        eng.run_to_completion()
+        assert obs.PREFIX_CACHE_HITS.value() == hits0 + 1
+        # 40 prefix tokens = 5 full pages skipped by prefill.
+        assert obs.PREFIX_CACHE_REUSED_TOKENS.value() == reused0 + 40
+
+    def test_greedy_equivalence_cache_on_vs_off(self, tiny):
+        """The acceptance bar: warm-path greedy output is
+        token-for-token what the cache-off engine produces."""
+        config, params = tiny
+        prefix = [i % 97 + 1 for i in range(40)]
+        tails = ([7, 8], [9, 10, 11], [12], [9, 10, 99])
+        on = _engine(params, config)
+        got = {}
+        for tail in tails:                # sequential: later ones warm
+            rid = on.submit(prefix + list(tail), _greedy(6))
+            got[tuple(tail)] = on.run_to_completion()[rid]
+        assert obs.PREFIX_CACHE_HITS.value() > 0
+        off = _engine(params, config, prefix_cache=False)
+        for tail in tails:
+            rid = off.submit(prefix + list(tail), _greedy(6))
+            assert off.run_to_completion()[rid] == got[tuple(tail)], \
+                f'tail {tail} diverged with the cache on'
+
+    def test_full_prompt_match_cows_last_page(self, tiny):
+        """An exactly-cached page-multiple prompt re-runs only its
+        LAST token; that write lands in the final shared page, which
+        COW copies private first — the cached original must survive
+        byte-for-byte for the next match."""
+        config, params = tiny
+        eng = _engine(params, config)
+        prompt = [i % 89 + 1 for i in range(48)]      # 6 full pages
+        r1 = eng.submit(list(prompt), _greedy(4))
+        out1 = eng.run_to_completion()[r1]
+        cached_before = eng._prefix.num_pages()
+        hits0 = obs.PREFIX_CACHE_HITS.value()
+        r2 = eng.submit(list(prompt), _greedy(4))
+        out2 = eng.run_to_completion()[r2]
+        assert out2 == out1
+        assert obs.PREFIX_CACHE_HITS.value() == hits0 + 1
+        # Third run still matches and still agrees: the COW protected
+        # the cached page from r2's re-write.
+        r3 = eng.submit(list(prompt), _greedy(4))
+        assert eng.run_to_completion()[r3] == out1
+        assert eng._prefix.num_pages() >= cached_before
+        off = _engine(params, config, prefix_cache=False)
+        r4 = off.submit(list(prompt), _greedy(4))
+        assert off.run_to_completion()[r4] == out1
+
+    def test_cow_on_decode_write_copies_shared_page(self, tiny):
+        """The decode-path COW guard: a decode write aimed at a
+        shared page copies it into a private page (refcount drops,
+        table repointed, cached bytes intact) before the round."""
+        config, params = tiny
+        eng = _engine(params, config)
+        prefix = [i % 97 + 1 for i in range(40)]
+        eng.submit(prefix + [7, 8], _greedy(6))
+        eng.run_to_completion()
+        rid = eng.submit(prefix + [9], _greedy(20))
+        eng.step()                         # warm tail prefill
+        eng.step()                         # decoding with shared head
+        i = next(i for i, s in enumerate(eng.state.slots)
+                 if s is not None and s.request_id == rid)
+        shared_before = set(eng._slot_shared[i])
+        assert shared_before                # head pages still shared
+        idx = min(shared_before)
+        src = eng._slot_pages[i][idx]
+        assert eng._prefix.refcount(src) == 1
+        k_before = jax.device_get(
+            eng.state.cache['k'][:, src]).copy()
+        # Force the guard on a page decode would otherwise never
+        # touch: it must COW, not scribble.
+        eng._cow_guard(i, idx * eng.kv_page_size,
+                       idx * eng.kv_page_size)
+        assert idx not in eng._slot_shared[i]
+        dst = eng._slot_pages[i][idx]
+        assert dst != src
+        assert eng._prefix.refcount(src) == 0
+        import numpy as np
+        np.testing.assert_array_equal(
+            jax.device_get(eng.state.cache['k'][:, src]), k_before)
+        np.testing.assert_array_equal(
+            jax.device_get(eng.state.cache['k'][:, dst]), k_before)
+        # The request still finishes correctly on its private copy.
+        out = eng.run_to_completion()[rid]
+        off = _engine(params, config, prefix_cache=False)
+        r2 = off.submit(prefix + [9], _greedy(20))
+        assert off.run_to_completion()[r2] == out
+
+    def test_sampled_requests_publish_real_token_sequence(self, tiny):
+        """Published pages must index the tokens actually fed back —
+        for sampled requests that is the sampled sequence, and a
+        later greedy request with a different tail must not match
+        beyond the true shared span."""
+        config, params = tiny
+        eng = _engine(params, config, seed=3)
+        prefix = [i % 97 + 1 for i in range(40)]
+        eng.submit(prefix + [7], inference.SamplingParams(
+            temperature=0.9, top_k=8, max_new_tokens=8))
+        eng.run_to_completion()
+        rid = eng.submit(prefix + [7, 9, 9], _greedy(5))
+        out = eng.run_to_completion()[rid]
+        off = _engine(params, config, prefix_cache=False)
+        r2 = off.submit(prefix + [7, 9, 9], _greedy(5))
+        assert off.run_to_completion()[r2] == out
+
+
+# --- eviction / oversubscription --------------------------------------------
+
+class TestLruEviction:
+
+    def test_oversubscribed_pool_reclaims_lru_pages(self, tiny):
+        """Live admissions outrank cached history: when the free pool
+        is short, refcount-0 cache pages are LRU-evicted — and the
+        pool invariant free + cached + private == total holds."""
+        config, params = tiny
+        eng = _engine(params, config, max_seq_len=64, kv_pages=5)
+        e0 = obs.PREFIX_CACHE_EVICTIONS.value()
+        eng.submit(list(range(2, 20)), _greedy(4))   # 3 pages
+        eng.run_to_completion()
+        assert eng._prefix.num_pages() > 0
+        r2 = eng.submit(list(range(3, 30)), _greedy(4))  # 4 pages
+        out = eng.run_to_completion()
+        assert r2 in out and len(out[r2]) == 4
+        assert obs.PREFIX_CACHE_EVICTIONS.value() > e0
+        assert (len(eng._page_alloc) + eng._prefix.num_pages()
+                == eng._pages_total)
+
+    def test_refcounted_pages_never_reclaimed(self, tiny):
+        """The acceptance bar: an oversubscribed pool must never
+        reclaim a page with refcount > 0 — a warm request mid-flight
+        keeps its shared head while another request squeezes in."""
+        config, params = tiny
+        eng = _engine(params, config, max_seq_len=64, kv_pages=8)
+        prefix = [i % 97 + 1 for i in range(16)]     # 2 full pages
+        eng.submit(prefix + [5], _greedy(4))
+        eng.run_to_completion()
+        rid = eng.submit(prefix + [6], _greedy(12))  # warm, pins head
+        eng.step()
+        i = next(i for i, s in enumerate(eng.state.slots)
+                 if s is not None)
+        pinned = [eng._slot_pages[i][j]
+                  for j in sorted(eng._slot_shared[i])]
+        assert pinned and all(
+            eng._prefix.refcount(p) == 1 for p in pinned)
+        # Pressure: a request whose reservation forces reclaim.
+        r3 = eng.submit(list(range(2, 30)), _greedy(4))
+        out = eng.run_to_completion()
+        assert rid in out and r3 in out
+        # The pinned pages were never handed to another owner: the
+        # warm request's output matches the cache-off oracle.
+        off = _engine(params, config, max_seq_len=64,
+                      prefix_cache=False)
+        ra = off.submit(prefix + [6], _greedy(12))
+        assert off.run_to_completion()[ra] == out[rid]
+
+    def test_max_pages_cap_trims_lru_tail(self, tiny):
+        config, params = tiny
+        eng = _engine(params, config, prefix_cache_max_pages=3)
+        pre = [i % 53 + 1 for i in range(40)]
+        eng.submit(list(pre), _greedy(4))
+        eng.run_to_completion()
+        assert eng._prefix.num_pages() == 3
+        # Tail-trimmed, so the HEAD of the span still matches.
+        assert eng._prefix.match(pre).tokens == 24
+
+    def test_abort_releases_pins_without_publishing(self, tiny):
+        config, params = tiny
+        eng = _engine(params, config)
+        prefix = [i % 97 + 1 for i in range(40)]
+        eng.submit(prefix + [7], _greedy(4))
+        eng.run_to_completion()
+        cached = eng._prefix.num_pages()
+        ghost = eng.submit(prefix + [8], _greedy(50))
+        eng.step()
+        eng.abort(ghost)
+        # Nothing new published, no pin leaked, pool balanced.
+        assert eng._prefix.num_pages() == cached
+        assert (len(eng._page_alloc) + eng._prefix.num_pages()
+                == eng._pages_total)
+        rid = eng.submit(prefix + [7], _greedy(4))
+        assert len(eng.run_to_completion()[rid]) == 4
+
+
+# --- churn == zero recompiles (the PR 10 CI pattern) ------------------------
+
+class TestChurnZeroRecompile:
+
+    def test_hit_miss_evict_churn_never_recompiles(self, tiny):
+        """Hit admission, COW copies, publishes, and LRU evictions
+        are all table-value edits + a dedicated page-copy jit — the
+        fused decode loop's compile cache must stay flat."""
+        config, params = tiny
+        eng = _engine(params, config)
+        pre = [i % 61 + 1 for i in range(32)]
+        eng.submit(pre + [5], _greedy(4))
+        eng.run_to_completion()
+        eng.submit(pre + [6, 7], _greedy(4))     # warm the hit path
+        eng.run_to_completion()
+        warm = eng_lib.fused_decode_steps._cache_size()
+        for tail in ([8], [9, 10], [11] * 5):    # hits
+            eng.submit(pre + list(tail), _greedy(4))
+            eng.run_to_completion()
+        eng.submit(list(pre), _greedy(4))        # full-match COW
+        eng.run_to_completion()
+        eng.submit([3] * 70, _greedy(4))         # miss + pressure
+        eng.run_to_completion()
+        ghost = eng.submit(pre + [12], _greedy(40))
+        eng.step()
+        eng.abort(ghost)                         # pin release churn
+        eng.run_to_completion()
+        assert eng_lib.fused_decode_steps._cache_size() == warm
+
+
+# --- observability -----------------------------------------------------------
+
+class TestPrefixCacheObservability:
+
+    def test_page_pool_composition_gauges(self, tiny):
+        config, params = tiny
+        eng = _engine(params, config)
+        prefix = [i % 97 + 1 for i in range(40)]
+        eng.submit(prefix + [7], _greedy(4))
+        eng.run_to_completion()
+        assert obs.KV_PAGES_FREE.value() == len(eng._page_alloc)
+        assert obs.PREFIX_CACHE_PAGES.value() == \
+            eng._prefix.num_pages() > 0
+        assert obs.KV_PAGES_PRIVATE.value() == 0   # all published
+        rid = eng.submit(prefix + [8], _greedy(30))
+        eng.step()
+        # Mid-flight: private pages are the warm request's tail.
+        assert obs.KV_PAGES_PRIVATE.value() == (
+            eng._pages_total - len(eng._page_alloc)
+            - eng._prefix.num_pages()) > 0
+        eng.run_to_completion()
+
+    def test_disabled_engine_counts_nothing(self, tiny):
+        config, params = tiny
+        eng = _engine(params, config, prefix_cache=False)
+        h0 = obs.PREFIX_CACHE_HITS.value()
+        m0 = obs.PREFIX_CACHE_MISSES.value()
+        eng.submit([i % 97 + 1 for i in range(40)], _greedy(4))
+        eng.run_to_completion()
+        assert obs.PREFIX_CACHE_HITS.value() == h0
+        assert obs.PREFIX_CACHE_MISSES.value() == m0
+        assert eng._prefix is None
+
+    def test_draft_model_disables_prefix_cache(self, tiny):
+        config, params = tiny
+        eng = _engine(params, config, draft=(params, config),
+                      spec_k=2)
+        assert eng._prefix is None
