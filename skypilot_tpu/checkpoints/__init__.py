@@ -1,0 +1,41 @@
+"""Real-checkpoint plane: HF safetensors import/export on engine
+geometry.
+
+The gap this closes (ROADMAP item 1): the engine, the `llm/` recipe
+gallery, and every bench number ran on random weights because nothing
+could load a real pretrained checkpoint. Now:
+
+  * `load_params(dir)` — streaming HF->engine import (family
+    auto-detected from config.json; peak host memory O(largest
+    tensor), shards `jax.device_put` under the sharding rules).
+  * `export_params(params, config, dir)` — the round trip for
+    fine-tuned weights (sharded safetensors + index + config.json).
+  * `is_hf_checkpoint(dir)` — the auto-detection every
+    `--checkpoint` flag (inference server, batch, train loop) routes
+    through: HF dir vs Orbax dir, no new flags.
+  * `python -m skypilot_tpu.checkpoints` — inspect / import /
+    verify / export from the shell.
+
+Dependency-free by design: `safetensors_io` owns the format (header
+JSON + mmap'd lazy views), so serving hosts stay off `safetensors`/
+`torch`.
+"""
+from skypilot_tpu.checkpoints.hf_export import (ExportStats,
+                                                export_params,
+                                                hf_config_dict)
+from skypilot_tpu.checkpoints.hf_import import (HFImportError,
+                                                ImportStats,
+                                                detect_config,
+                                                infer_family,
+                                                is_hf_checkpoint,
+                                                load_params)
+from skypilot_tpu.checkpoints.safetensors_io import (
+    CheckpointFormatError, CheckpointReader, ShardedWriter,
+    write_safetensors)
+
+__all__ = [
+    'CheckpointFormatError', 'CheckpointReader', 'ExportStats',
+    'HFImportError', 'ImportStats', 'ShardedWriter', 'detect_config',
+    'export_params', 'hf_config_dict', 'infer_family',
+    'is_hf_checkpoint', 'load_params', 'write_safetensors',
+]

@@ -1,0 +1,150 @@
+"""Engine pytree -> HF safetensors: the round-trip for fine-tuned
+weights.
+
+A model fine-tuned by `train/loop.py` leaves as Orbax train state;
+this turns its params back into the HF layout (sharded
+`model-0000i-of-0000n.safetensors` + index + `config.json`) so the
+artifact is consumable by the whole HF ecosystem — and re-importable
+by `hf_import`, which is what the byte-equality round-trip test
+pins.
+
+Streaming symmetrically with the importer: one LAYER slice is pulled
+off device at a time (`np.asarray(stacked[i])`), inverse-transformed,
+and handed to the ShardedWriter, which appends bytes straight to the
+shard's payload file. Peak host memory is O(largest tensor).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.checkpoints import hf_import
+from skypilot_tpu.checkpoints import safetensors_io
+from skypilot_tpu.models import llama
+from skypilot_tpu.observability import instruments as obs
+
+logger = sky_logging.init_logger('skypilot_tpu.checkpoints.hf_export')
+
+
+@dataclasses.dataclass
+class ExportStats:
+    seconds: float = 0.0
+    bytes_written: int = 0
+    tensors: int = 0
+    shards: int = 0
+
+
+def hf_config_dict(config: llama.LlamaConfig,
+                   family: Optional[str] = None) -> Dict[str, Any]:
+    """LlamaConfig -> the config.json the detector round-trips. Every
+    geometry knob the importer reads is written explicitly — defaults
+    drifting between HF versions must not change what re-imports."""
+    c = config
+    family = family or hf_import.infer_family(c)
+    import jax.numpy as jnp
+    torch_dtype = ('float32' if jnp.dtype(c.dtype) == jnp.float32
+                   else 'bfloat16')
+    out: Dict[str, Any] = {
+        'model_type': family,
+        'architectures': [{
+            'llama': 'LlamaForCausalLM',
+            'gemma': 'GemmaForCausalLM',
+            'gemma2': 'Gemma2ForCausalLM',
+            'mistral': 'MistralForCausalLM',
+            'qwen2': 'Qwen2ForCausalLM',
+        }[family]],
+        'vocab_size': c.vocab_size,
+        'hidden_size': c.hidden_size,
+        'intermediate_size': c.intermediate_size,
+        'num_hidden_layers': c.num_layers,
+        'num_attention_heads': c.num_heads,
+        'num_key_value_heads': c.num_kv_heads,
+        'head_dim': c.head_dim,
+        'max_position_embeddings': c.max_seq_len,
+        'rope_theta': c.rope_theta,
+        'rms_norm_eps': c.rms_norm_eps,
+        'tie_word_embeddings': c.tied_embeddings,
+        'torch_dtype': torch_dtype,
+    }
+    if c.rope_scaling_factor is not None:
+        out['rope_scaling'] = {
+            'rope_type': 'llama3',
+            'factor': c.rope_scaling_factor,
+            'low_freq_factor': c.rope_scaling_low_freq_factor,
+            'high_freq_factor': c.rope_scaling_high_freq_factor,
+            'original_max_position_embeddings':
+                c.rope_scaling_original_max,
+        }
+    if family == 'mistral' or (family == 'qwen2'
+                               and c.sliding_window is not None):
+        out['sliding_window'] = c.sliding_window
+        if family == 'qwen2':
+            out['use_sliding_window'] = True
+    if family == 'gemma2':
+        out['attn_logit_softcapping'] = c.attn_logit_softcap
+        out['final_logit_softcapping'] = c.final_logit_softcap
+        out['sliding_window'] = c.sliding_window
+        if c.query_pre_attn_scalar is not None:
+            out['query_pre_attn_scalar'] = c.query_pre_attn_scalar
+    return out
+
+
+def export_params(params: Dict[str, Any],
+                  config: llama.LlamaConfig,
+                  out_dir: str,
+                  family: Optional[str] = None,
+                  max_shard_bytes: int = 5 * 2**30) -> ExportStats:
+    """Write `params` (the `llama.init_params` pytree) as an HF
+    checkpoint dir. Tensor order is HF's: embeddings, then layers in
+    order (so a shard holds consecutive layers and the importer's
+    layer-major streaming pass reads each shard once), then final
+    norm / lm_head."""
+    t0 = time.perf_counter()
+    c = config
+    out_dir = os.path.abspath(os.path.expanduser(out_dir))
+    specs = {spec.key: spec for spec in hf_import.param_specs(c)}
+    writer = safetensors_io.ShardedWriter(
+        out_dir, max_shard_bytes=max_shard_bytes,
+        metadata={'format': 'pt'})
+    stats = ExportStats()
+
+    def add(spec_key: str, hf_name: str, arr) -> None:
+        host = hf_import._to_hf(specs[spec_key], np.asarray(arr), c)
+        writer.add(hf_name, host)
+        stats.bytes_written += host.nbytes
+        stats.tensors += 1
+
+    add('embed', specs['embed'].hf, params['embed'])
+    layer_keys = [k for k in specs if specs[k].stacked]
+    for i in range(c.num_layers):
+        for key in layer_keys:
+            # One [i] slice off device at a time: device->host copy
+            # of a single layer's tensor, never the stacked array.
+            add(key, specs[key].hf.format(i=i),
+                params['layers'][key][i])
+    add('final_norm', specs['final_norm'].hf, params['final_norm'])
+    if not c.tied_embeddings:
+        add('lm_head', specs['lm_head'].hf, params['lm_head'])
+    written = writer.close()
+    stats.shards = sum(1 for fn in written
+                       if fn.endswith('.safetensors'))
+
+    with open(os.path.join(out_dir, hf_import.CONFIG_FILENAME), 'w',
+              encoding='utf-8') as f:
+        json.dump(hf_config_dict(c, family), f, indent=2,
+                  sort_keys=True)
+
+    stats.seconds = time.perf_counter() - t0
+    obs.CKPT_EXPORT_SECONDS.observe(stats.seconds)
+    obs.CKPT_EXPORT_BYTES.inc(stats.bytes_written)
+    logger.info('hf export: %d tensors / %.1f MiB -> %s '
+                '(%d shard(s)) in %.2fs', stats.tensors,
+                stats.bytes_written / 2**20, out_dir, stats.shards,
+                stats.seconds)
+    return stats

@@ -1,0 +1,633 @@
+"""HF safetensors -> engine geometry: streaming weight import.
+
+Three jobs, all host-memory-bounded:
+
+1. `detect_config()` — `config.json` -> the in-tree `LlamaConfig`
+   family knobs (llama / llama3-rope-scaling / gemma / gemma2 /
+   mistral / qwen2), the same knob set `models/llama.py` already
+   serves, so a detected checkpoint runs through the UNMODIFIED
+   engine.
+2. A name-mapping table: HF's per-layer `[out, in]` projection
+   weights -> the stacked-scan pytree's `ehd`/`em` einsum layouts
+   (transposes + head reshapes; tied-embedding and (1+w)-norm
+   handling are family knobs, not special cases here).
+3. `load_params()` — the layer-streaming loader: one shard slice is
+   read (mmap view), transformed, and `jax.device_put` under the
+   `parallel.sharding` rules per LAYER; a jitted donated
+   `dynamic_update_index_in_dim` lands it in the stacked device
+   buffer. Peak host memory is O(largest tensor + one stacked
+   layer), never O(model) — `ImportStats.peak_host_bytes` proves it
+   and `bench.py _hf_import_bench` measures it.
+
+Knobs: SKYTPU_HF_IMPORT_STRICT (unexpected tensors are errors, not
+warnings) and SKYTPU_HF_IMPORT_CONCURRENCY (read/transform threads
+running ahead of device placement; memory bound scales by the
+thread count).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+from jax import lax
+
+from skypilot_tpu import envs
+from skypilot_tpu import sky_logging
+from skypilot_tpu.checkpoints import safetensors_io
+from skypilot_tpu.models import llama
+from skypilot_tpu.observability import instruments as obs
+from skypilot_tpu.parallel import sharding as sharding_lib
+
+logger = sky_logging.init_logger('skypilot_tpu.checkpoints.hf_import')
+
+CONFIG_FILENAME = 'config.json'
+
+# HF names that are legitimately present but carry no engine weight:
+# old llama exports persisted rotary tables; tied checkpoints may
+# still ship an lm_head copy (handled separately).
+_IGNORABLE_SUFFIXES = ('.rotary_emb.inv_freq',)
+
+SUPPORTED_FAMILIES = ('llama', 'gemma', 'gemma2', 'mistral', 'qwen2')
+
+
+class HFImportError(ValueError):
+    """A checkpoint that cannot map onto engine geometry. The message
+    always names the offending tensors/fields — 'loud, actionable'
+    is the contract the round-trip tests assert on."""
+
+
+def is_hf_checkpoint(path: str) -> bool:
+    """Does `path` look like an HF safetensors checkpoint dir?
+    (config.json presence is checked later, with a pointed error —
+    a directory full of shards but no config is an HF dir with a
+    problem, not an Orbax dir.)"""
+    path = os.path.abspath(os.path.expanduser(path))
+    if os.path.isfile(path):
+        return path.endswith('.safetensors')
+    if not os.path.isdir(path):
+        return False
+    if os.path.exists(os.path.join(path,
+                                   safetensors_io.INDEX_FILENAME)):
+        return True
+    return any(fn.endswith('.safetensors') for fn in os.listdir(path))
+
+
+# --- config.json -> LlamaConfig ---------------------------------------------
+
+
+def _read_config_json(ckpt_dir: str) -> Dict[str, Any]:
+    root = os.path.abspath(os.path.expanduser(ckpt_dir))
+    if os.path.isfile(root):
+        # A bare model.safetensors path is a valid checkpoint handle
+        # (CheckpointReader accepts it); its config.json sits beside.
+        root = os.path.dirname(root)
+    path = os.path.join(root, CONFIG_FILENAME)
+    if not os.path.exists(path):
+        raise HFImportError(
+            f'{ckpt_dir}: safetensors shards found but no '
+            f'{CONFIG_FILENAME} — HF checkpoints carry the model '
+            'geometry there; re-download the full snapshot or write '
+            'one matching the architecture.')
+    with open(path, encoding='utf-8') as f:
+        try:
+            return json.load(f)
+        except json.JSONDecodeError as e:
+            raise HFImportError(
+                f'{path}: invalid JSON ({e})') from None
+
+
+def _dtype_of(cfg: Dict[str, Any]):
+    import jax.numpy as jnp
+    tag = cfg.get('torch_dtype', 'bfloat16')
+    if tag == 'float32':
+        return jnp.float32
+    # float16 checkpoints serve as bf16: same storage cost, TPU-native
+    # arithmetic, and the engine's matmuls accumulate f32 either way.
+    return jnp.bfloat16
+
+
+def _rope_scaling_knobs(cfg: Dict[str, Any],
+                        family: str) -> Dict[str, Any]:
+    """Validated for EVERY family: a yarn/linear-scaled qwen2 or
+    mistral checkpoint served without its scaling decodes
+    off-distribution exactly like a llama3.1 would — the guard must
+    not be family-gated."""
+    rs = cfg.get('rope_scaling')
+    if not rs:
+        return {}
+    kind = rs.get('rope_type', rs.get('type'))
+    if kind == 'default':
+        return {}
+    if kind != 'llama3' or family != 'llama':
+        raise HFImportError(
+            f'rope_scaling type {kind!r} on family {family!r} is not '
+            "supported (only llama's llama3 scheme maps onto the "
+            "engine's rope); serving this checkpoint without it "
+            'would decode off-distribution.')
+    if 'factor' not in rs:
+        raise HFImportError(
+            "rope_scaling is missing required key 'factor' — "
+            'truncated or hand-written config.json.')
+    return {
+        'rope_scaling_factor': float(rs['factor']),
+        'rope_scaling_low_freq_factor':
+            float(rs.get('low_freq_factor', 1.0)),
+        'rope_scaling_high_freq_factor':
+            float(rs.get('high_freq_factor', 4.0)),
+        'rope_scaling_original_max':
+            int(rs.get('original_max_position_embeddings', 8192)),
+    }
+
+
+def _require(cfg: Dict[str, Any], key: str) -> Any:
+    """Geometry keys have no sane default — absence is an actionable
+    error, not a KeyError traceback."""
+    if key not in cfg:
+        raise HFImportError(
+            f'config.json is missing required key {key!r} '
+            f'(model_type {cfg.get("model_type")!r}) — incomplete '
+            'download, or a hand-written config missing the model '
+            'geometry.')
+    return cfg[key]
+
+
+def detect_config(ckpt_dir: str) -> Tuple[str, llama.LlamaConfig]:
+    """config.json -> (family name, LlamaConfig). The knob mapping is
+    the inverse of what `models/{gemma,mistral,qwen}.py` hardcode for
+    their presets — one source of geometry, the checkpoint's own."""
+    cfg = _read_config_json(ckpt_dir)
+    family = cfg.get('model_type')
+    if family not in SUPPORTED_FAMILIES:
+        raise HFImportError(
+            f'model_type {family!r} is not an importable family; '
+            f'supported: {list(SUPPORTED_FAMILIES)}')
+
+    def opt(key: str, default: Any) -> Any:
+        """Absent key -> the HF-config default. An EXPLICIT null stays
+        None — 'softcapping disabled' must not silently re-enable."""
+        return cfg[key] if key in cfg else default
+
+    heads = int(_require(cfg, 'num_attention_heads'))
+    hidden = int(_require(cfg, 'hidden_size'))
+    kw: Dict[str, Any] = dict(
+        vocab_size=int(_require(cfg, 'vocab_size')),
+        hidden_size=hidden,
+        intermediate_size=int(_require(cfg, 'intermediate_size')),
+        num_layers=int(_require(cfg, 'num_hidden_layers')),
+        num_heads=heads,
+        num_kv_heads=int(cfg.get('num_key_value_heads') or heads),
+        head_dim=int(cfg.get('head_dim') or hidden // heads),
+        max_seq_len=int(cfg.get('max_position_embeddings') or 8192),
+        rope_theta=float(cfg.get('rope_theta') or 10000.0),
+        rms_norm_eps=float(cfg.get('rms_norm_eps') or 1e-5),
+        tied_embeddings=bool(cfg.get('tie_word_embeddings', False)),
+        dtype=_dtype_of(cfg),
+    )
+    kw.update(_rope_scaling_knobs(cfg, family))
+    if family == 'mistral':
+        if cfg.get('sliding_window'):
+            kw.update(sliding_window=int(cfg['sliding_window']),
+                      sliding_window_pattern=1)
+    elif family == 'qwen2':
+        kw.update(attn_qkv_bias=True)
+        if cfg.get('use_sliding_window') and cfg.get('sliding_window'):
+            kw.update(sliding_window=int(cfg['sliding_window']),
+                      sliding_window_pattern=1)
+    elif family in ('gemma', 'gemma2'):
+        # Gemma DEFAULTS to tied embeddings, but an untied finetune
+        # (explicit tie_word_embeddings=false with a trained lm_head)
+        # must keep its head — forcing True would silently drop it.
+        kw.update(activation='gelu',
+                  tied_embeddings=bool(
+                      cfg.get('tie_word_embeddings', True)),
+                  embed_scale=True, norm_plus_one=True)
+        if family == 'gemma2':
+            asc = opt('attn_logit_softcapping', 50.0)
+            fsc = opt('final_logit_softcapping', 30.0)
+            window = opt('sliding_window', 4096)
+            kw.update(
+                post_norms=True,
+                attn_logit_softcap=(None if asc is None
+                                    else float(asc)),
+                final_logit_softcap=(None if fsc is None
+                                     else float(fsc)))
+            if window is not None:
+                # HF encodes the local/global alternation in code,
+                # not config: every 2nd gemma2 layer is global.
+                kw.update(sliding_window=int(window),
+                          sliding_window_pattern=2)
+            qpa = cfg.get('query_pre_attn_scalar')
+            if qpa is not None and float(qpa) != float(kw['head_dim']):
+                kw.update(query_pre_attn_scalar=float(qpa))
+    return family, llama.LlamaConfig(**kw)
+
+
+def infer_family(config: llama.LlamaConfig) -> str:
+    """LlamaConfig knobs -> HF model_type (the export direction)."""
+    if config.norm_plus_one:
+        return 'gemma2' if config.post_norms else 'gemma'
+    if config.attn_qkv_bias:
+        return 'qwen2'
+    if config.sliding_window is not None and \
+            config.sliding_window_pattern == 1:
+        return 'mistral'
+    return 'llama'
+
+
+# --- the HF-name <-> stacked-pytree mapping table ---------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorSpec:
+    """One engine param leaf <-> one HF tensor (per layer when
+    stacked). `kind` names the layout transform:
+
+      none       copy as-is (norms, embed [vocab, e])
+      linear     HF [out, in]        -> [in, out]       (mlp, lm_head)
+      in_heads   HF [H*D, e]         -> [e, H, D]       (q/k/v proj)
+      out_heads  HF [e, H*D]         -> [H, D, e]       (o proj)
+      head_bias  HF [H*D]            -> [H, D]          (qwen2 bias)
+    """
+    key: str
+    hf: str
+    kind: str
+    stacked: bool = True
+    heads: int = 0
+
+
+def param_specs(config: llama.LlamaConfig) -> List[TensorSpec]:
+    """Mirror of `llama.init_params`' pytree, leaf for leaf — the
+    mapping and the model can't drift apart without the round-trip
+    test failing on structure."""
+    c = config
+    pre = 'model.layers.{i}.'
+    # Pre-MLP norm: plain families reuse HF's post_attention_layernorm
+    # slot; post-norm families (gemma2) have four norms with distinct
+    # HF names.
+    mlp_norm_hf = (pre + 'pre_feedforward_layernorm.weight'
+                   if c.post_norms
+                   else pre + 'post_attention_layernorm.weight')
+    specs = [
+        TensorSpec('attn_norm', pre + 'input_layernorm.weight', 'none'),
+        TensorSpec('wq', pre + 'self_attn.q_proj.weight', 'in_heads',
+                   heads=c.num_heads),
+        TensorSpec('wk', pre + 'self_attn.k_proj.weight', 'in_heads',
+                   heads=c.num_kv_heads),
+        TensorSpec('wv', pre + 'self_attn.v_proj.weight', 'in_heads',
+                   heads=c.num_kv_heads),
+        TensorSpec('wo', pre + 'self_attn.o_proj.weight', 'out_heads',
+                   heads=c.num_heads),
+        TensorSpec('mlp_norm', mlp_norm_hf, 'none'),
+        TensorSpec('w_gate', pre + 'mlp.gate_proj.weight', 'linear'),
+        TensorSpec('w_up', pre + 'mlp.up_proj.weight', 'linear'),
+        TensorSpec('w_down', pre + 'mlp.down_proj.weight', 'linear'),
+    ]
+    if c.post_norms:
+        specs += [
+            TensorSpec('post_attn_norm',
+                       pre + 'post_attention_layernorm.weight', 'none'),
+            TensorSpec('post_mlp_norm',
+                       pre + 'post_feedforward_layernorm.weight',
+                       'none'),
+        ]
+    if c.attn_qkv_bias:
+        specs += [
+            TensorSpec('bq', pre + 'self_attn.q_proj.bias',
+                       'head_bias', heads=c.num_heads),
+            TensorSpec('bk', pre + 'self_attn.k_proj.bias',
+                       'head_bias', heads=c.num_kv_heads),
+            TensorSpec('bv', pre + 'self_attn.v_proj.bias',
+                       'head_bias', heads=c.num_kv_heads),
+        ]
+    specs += [
+        TensorSpec('embed', 'model.embed_tokens.weight', 'none',
+                   stacked=False),
+        TensorSpec('final_norm', 'model.norm.weight', 'none',
+                   stacked=False),
+    ]
+    if not c.tied_embeddings:
+        specs.append(TensorSpec('lm_head', 'lm_head.weight', 'linear',
+                                stacked=False))
+    return specs
+
+
+def is_ignorable(name: str, config: llama.LlamaConfig) -> bool:
+    """HF tensors that are legitimately present but carry no engine
+    weight — ONE predicate shared by the importer's strict check and
+    the verify CLI, so the two can never drift."""
+    if name.endswith(_IGNORABLE_SUFFIXES):
+        return True
+    return config.tied_embeddings and name == 'lm_head.weight'
+
+
+def expected_hf_names(config: llama.LlamaConfig) -> List[str]:
+    names = []
+    for spec in param_specs(config):
+        if spec.stacked:
+            names.extend(spec.hf.format(i=i)
+                         for i in range(config.num_layers))
+        else:
+            names.append(spec.hf)
+    return names
+
+
+def _engine_shape(spec: TensorSpec,
+                  config: llama.LlamaConfig) -> Tuple[int, ...]:
+    """The engine-layout shape `_to_engine` produces (per layer for
+    stacked specs) — known statically, so stacked device buffers can
+    be allocated before any tensor is read."""
+    c = config
+    if spec.kind == 'in_heads':
+        return (c.hidden_size, spec.heads, c.head_dim)
+    if spec.kind == 'out_heads':
+        return (spec.heads, c.head_dim, c.hidden_size)
+    if spec.kind == 'head_bias':
+        return (spec.heads, c.head_dim)
+    hf = _hf_shape(spec, c)
+    return hf[::-1] if spec.kind == 'linear' else hf
+
+
+def _hf_shape(spec: TensorSpec,
+              config: llama.LlamaConfig) -> Tuple[int, ...]:
+    """The shape the HF tensor must have, from the config geometry."""
+    c = config
+    e, m, d = c.hidden_size, c.intermediate_size, c.head_dim
+    if spec.kind == 'in_heads':
+        return (spec.heads * d, e)
+    if spec.kind == 'out_heads':
+        return (e, spec.heads * d)
+    if spec.kind == 'head_bias':
+        return (spec.heads * d,)
+    if spec.kind == 'linear':
+        return {'w_gate': (m, e), 'w_up': (m, e), 'w_down': (e, m),
+                'lm_head': (c.vocab_size, e)}[spec.key]
+    return {'attn_norm': (e,), 'mlp_norm': (e,),
+            'post_attn_norm': (e,), 'post_mlp_norm': (e,),
+            'final_norm': (e,),
+            'embed': (c.vocab_size, e)}[spec.key]
+
+
+def _to_engine(spec: TensorSpec, arr: np.ndarray,
+               config: llama.LlamaConfig, np_dtype) -> np.ndarray:
+    """HF layout -> engine layout, one contiguous host copy."""
+    d = config.head_dim
+    e = config.hidden_size
+    if spec.kind == 'in_heads':
+        arr = arr.T.reshape(e, spec.heads, d)
+    elif spec.kind == 'out_heads':
+        arr = arr.T.reshape(spec.heads, d, e)
+    elif spec.kind == 'head_bias':
+        arr = arr.reshape(spec.heads, d)
+    elif spec.kind == 'linear':
+        arr = arr.T
+    out = np.ascontiguousarray(arr, dtype=np_dtype)
+    if not out.flags.owndata:
+        # Already-contiguous same-dtype tensors come back as VIEWS
+        # onto the shard's mmap — and jax.device_put on CPU may
+        # zero-copy alias them, pinning the mapping open for the
+        # params' lifetime (and faulting shard pages as "device"
+        # reads). The importer's contract is an OWNED host copy whose
+        # lifetime the budget accounting controls.
+        out = out.copy()
+    return out
+
+
+def _to_hf(spec: TensorSpec, arr: np.ndarray,
+           config: llama.LlamaConfig) -> np.ndarray:
+    """Engine layout -> HF layout (exact inverse of `_to_engine`)."""
+    d = config.head_dim
+    e = config.hidden_size
+    if spec.kind == 'in_heads':
+        arr = arr.reshape(e, spec.heads * d).T
+    elif spec.kind == 'out_heads':
+        arr = arr.reshape(spec.heads * d, e).T
+    elif spec.kind == 'head_bias':
+        arr = arr.reshape(spec.heads * d)
+    elif spec.kind == 'linear':
+        arr = arr.T
+    return np.ascontiguousarray(arr)
+
+
+# --- streaming loader -------------------------------------------------------
+
+
+class _HostBudget:
+    """Live-host-copy accounting (thread-safe: prefetch workers add
+    from their threads). The streaming claim is ASSERTED against
+    `peak` in tests, not just narrated."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.live = 0
+        self.peak = 0
+
+    def add(self, n: int) -> None:
+        with self._lock:
+            self.live += n
+            self.peak = max(self.peak, self.live)
+
+    def sub(self, n: int) -> None:
+        with self._lock:
+            self.live -= n
+
+
+@dataclasses.dataclass
+class ImportStats:
+    seconds: float = 0.0
+    bytes_read: int = 0
+    tensors: int = 0
+    shards: int = 0
+    peak_host_bytes: int = 0
+    largest_tensor_bytes: int = 0
+    stacked_layer_bytes: int = 0   # largest single-layer slice placed
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _place_layer(stacked: jax.Array, layer: jax.Array,
+                 idx: jax.Array) -> jax.Array:
+    """Land one layer's weights in the stacked device buffer: donated
+    so XLA writes in place (no second stacked copy in HBM), traced
+    `idx` so ONE compile per (shape, dtype) serves every layer."""
+    return lax.dynamic_update_index_in_dim(stacked, layer, idx, 0)
+
+
+def _alloc_stacked(shape, np_dtype, mesh, axes):
+    """Zeroed stacked buffer, created ALREADY sharded (jit with
+    out_shardings places without a host-side materialization)."""
+    import jax.numpy as jnp
+    dtype = jnp.dtype(np_dtype)
+    if mesh is None:
+        return jax.jit(lambda: jnp.zeros(shape, dtype))()
+    sh = sharding_lib.named_sharding(mesh, axes)
+    return jax.jit(lambda: jnp.zeros(shape, dtype),
+                   out_shardings=sh)()
+
+
+def load_params(
+        ckpt_dir: str,
+        config: Optional[llama.LlamaConfig] = None,
+        mesh: Optional[Any] = None,
+        strict: Optional[bool] = None,
+        concurrency: Optional[int] = None,
+) -> Tuple[Dict[str, Any], llama.LlamaConfig, ImportStats]:
+    """Import an HF safetensors checkpoint onto engine geometry.
+
+    Returns (params pytree matching `llama.init_params`, the config
+    actually served — detected from config.json unless passed in —
+    and the import stats)."""
+    t0 = time.perf_counter()
+    if config is None:
+        _family, config = detect_config(ckpt_dir)
+    if strict is None:
+        strict = envs.SKYTPU_HF_IMPORT_STRICT.get()
+    if concurrency is None:
+        concurrency = envs.SKYTPU_HF_IMPORT_CONCURRENCY.get()
+    concurrency = max(1, int(concurrency))
+    c = config
+    np_dtype = np.dtype(c.dtype)
+    specs = param_specs(c)
+    logical = llama.param_logical_axes(c)
+    budget = _HostBudget()
+    stats = ImportStats()
+
+    with safetensors_io.CheckpointReader(ckpt_dir) as reader:
+        stats.shards = reader.num_shards
+        _check_names(reader, c, strict)
+
+        stats_lock = threading.Lock()
+
+        def fetch(spec: TensorSpec, hf_name: str) -> np.ndarray:
+            tensor = reader.tensor(hf_name)
+            want = _hf_shape(spec, c)
+            if tensor.shape != want:
+                raise HFImportError(
+                    f'{hf_name}: shape {tensor.shape} does not match '
+                    f'config geometry {want} (shard {tensor.shard}) — '
+                    'wrong config.json for these weights?')
+            host = _to_engine(spec, tensor.read(), c, np_dtype)
+            budget.add(host.nbytes)
+            # fetch() runs in prefetch threads under concurrency > 1;
+            # the read-modify-writes below need the same locking the
+            # budget gets, or the streaming evidence under-counts.
+            with stats_lock:
+                stats.bytes_read += tensor.nbytes
+                stats.tensors += 1
+                stats.largest_tensor_bytes = max(
+                    stats.largest_tensor_bytes, tensor.nbytes)
+                if spec.stacked:
+                    stats.stacked_layer_bytes = max(
+                        stats.stacked_layer_bytes, host.nbytes)
+            return host
+
+        def place_full(spec: TensorSpec) -> jax.Array:
+            host = fetch(spec, spec.hf)
+            sh = (sharding_lib.named_sharding(mesh, logical[spec.key])
+                  if mesh is not None else None)
+            dev = (jax.device_put(host, sh) if sh is not None
+                   else jax.device_put(host))
+            dev.block_until_ready()
+            budget.sub(host.nbytes)
+            return dev
+
+        stacked_specs = [s for s in specs if s.stacked]
+        bufs: Dict[str, jax.Array] = {
+            s.key: _alloc_stacked(
+                (c.num_layers,) + _engine_shape(s, c), np_dtype,
+                mesh, logical['layers'][s.key])
+            for s in stacked_specs}
+        layer_sh = {
+            s.key: (sharding_lib.named_sharding(
+                mesh, logical['layers'][s.key][1:])
+                if mesh is not None else None)
+            for s in stacked_specs}
+
+        def place_one(spec: TensorSpec, host: np.ndarray,
+                      i: int) -> None:
+            sh = layer_sh[spec.key]
+            dev = (jax.device_put(host, sh) if sh is not None
+                   else jax.device_put(host))
+            bufs[spec.key] = _place_layer(bufs[spec.key], dev, i)
+            budget.sub(host.nbytes)
+
+        # LAYER-major iteration — the order the exporter writes and
+        # HF checkpoints ship (a shard holds consecutive layers), so
+        # a whole-model import reads each shard's pages ONCE instead
+        # of once per stacked key (which would thrash the page cache
+        # on models larger than host RAM).
+        items = [(i, s) for i in range(c.num_layers)
+                 for s in stacked_specs]
+        if concurrency > 1 and items:
+            # Read/transform ahead of placement: at most
+            # `concurrency` transformed tensors live at once (the
+            # documented memory/speed trade).
+            import concurrent.futures as cf
+            with cf.ThreadPoolExecutor(concurrency) as pool:
+                pending = []
+                for i, spec in items:
+                    pending.append((i, spec, pool.submit(
+                        fetch, spec, spec.hf.format(i=i))))
+                    if len(pending) >= concurrency:
+                        j, sp, fut = pending.pop(0)
+                        place_one(sp, fut.result(), j)
+                for j, sp, fut in pending:
+                    place_one(sp, fut.result(), j)
+        else:
+            for i, spec in items:
+                place_one(spec, fetch(spec, spec.hf.format(i=i)), i)
+        for buf in bufs.values():
+            buf.block_until_ready()
+
+        out: Dict[str, Any] = {'layers': bufs}
+        for spec in specs:
+            if not spec.stacked:
+                out[spec.key] = place_full(spec)
+
+    stats.peak_host_bytes = budget.peak
+    stats.seconds = time.perf_counter() - t0
+    obs.CKPT_IMPORT_SECONDS.observe(stats.seconds)
+    obs.CKPT_IMPORT_BYTES.inc(stats.bytes_read)
+    obs.CKPT_IMPORT_TENSORS.inc(stats.tensors)
+    logger.info(
+        'hf import: %d tensors / %.1f MiB from %d shard(s) in %.2fs '
+        '(peak host %.1f MiB)', stats.tensors,
+        stats.bytes_read / 2**20, stats.shards, stats.seconds,
+        stats.peak_host_bytes / 2**20)
+    return out, config, stats
+
+
+def _check_names(reader: safetensors_io.CheckpointReader,
+                 config: llama.LlamaConfig, strict: bool) -> None:
+    """Missing tensors are ALWAYS fatal (params can't be built);
+    unexpected ones are fatal under SKYTPU_HF_IMPORT_STRICT (the
+    default — an extra tensor usually means the wrong config.json or
+    a mis-detected family) and logged otherwise."""
+    present = set(reader.names())
+    expected = set(expected_hf_names(config))
+    missing = sorted(expected - present)
+    if missing:
+        head = ', '.join(missing[:4])
+        raise HFImportError(
+            f'checkpoint is missing {len(missing)} expected '
+            f'tensor(s): {head}{", ..." if len(missing) > 4 else ""} '
+            '— torn download, or config.json geometry (layers/heads/'
+            'tied embeddings) does not match these weights.')
+    extra = sorted(name for name in present - expected
+                   if not is_ignorable(name, config))
+    if extra:
+        head = ', '.join(extra[:4])
+        msg = (f'checkpoint carries {len(extra)} unexpected '
+               f'tensor(s): {head}'
+               f'{", ..." if len(extra) > 4 else ""} — wrong family '
+               'detection, or weights this engine would silently '
+               'drop. Set SKYTPU_HF_IMPORT_STRICT=0 to import '
+               'anyway.')
+        if strict:
+            raise HFImportError(msg)
+        logger.warning('hf import (non-strict): %s', msg)

@@ -1,0 +1,486 @@
+"""Dependency-free safetensors reader/writer (mmap'd lazy views).
+
+The serving host must load real HuggingFace checkpoints WITHOUT
+growing its dependency set (no `safetensors`, no `torch` — the
+import path runs on every replica). The format is simple enough to
+own outright:
+
+    [8 bytes LE u64: header length N][N bytes JSON header][payload]
+
+where the header maps tensor name -> {"dtype", "shape",
+"data_offsets": [begin, end]} (offsets relative to the payload start)
+plus an optional "__metadata__" string map. Multi-shard checkpoints
+add `model.safetensors.index.json` with {"weight_map": {name ->
+shard filename}}.
+
+Memory model: a shard is mmap'd once; `LazyTensor.read()` returns a
+zero-copy numpy view onto the mapping, so bytes enter RSS only as
+they are touched and leave with OS page reclaim. Anything that must
+COPY (dtype casts, the transposes in hf_import) happens downstream,
+where the importer accounts for it — peak host memory for a whole-
+model import stays O(largest tensor), never O(model).
+
+bf16 has no stdlib-numpy dtype; `ml_dtypes` provides it and is
+already a jax dependency, so no new package enters the image.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import mmap
+import os
+import struct
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+import ml_dtypes
+import numpy as np
+
+INDEX_FILENAME = 'model.safetensors.index.json'
+
+# safetensors dtype tag <-> numpy dtype. Every tag a Llama-family HF
+# checkpoint can carry; BOOL/U8/I64 cover tokenizer-adjacent extras.
+_DTYPES: Dict[str, np.dtype] = {
+    'F64': np.dtype(np.float64),
+    'F32': np.dtype(np.float32),
+    'F16': np.dtype(np.float16),
+    'BF16': np.dtype(ml_dtypes.bfloat16),
+    'I64': np.dtype(np.int64),
+    'I32': np.dtype(np.int32),
+    'I16': np.dtype(np.int16),
+    'I8': np.dtype(np.int8),
+    'U8': np.dtype(np.uint8),
+    'BOOL': np.dtype(np.bool_),
+}
+_DTYPE_TAGS = {v: k for k, v in _DTYPES.items()}
+# ml_dtypes floats (BF16) report numpy kind 'V', not 'f' — dtype.kind
+# checks silently misclassify them, so float-ness is decided against
+# this explicit set.
+_FLOAT_DTYPES = frozenset(
+    _DTYPES[tag] for tag in ('F64', 'F32', 'F16', 'BF16'))
+
+
+def is_float_dtype(dtype: Any) -> bool:
+    """Is this a safetensors float dtype (incl. bf16, whose numpy
+    kind is 'V')?"""
+    return np.dtype(dtype) in _FLOAT_DTYPES
+
+# One header must not be able to OOM the reader before validation: HF
+# headers for 100B-class models are ~10MB; 512MB is absurdly past any
+# real checkpoint and still a safe single allocation.
+_MAX_HEADER_BYTES = 512 * 1024 * 1024
+
+
+class CheckpointFormatError(ValueError):
+    """A safetensors file/dir that violates the format contract.
+
+    Always carries an actionable message (which file, which tensor,
+    what was expected) — a corrupted multi-gigabyte download must
+    fail loudly at open, not decode garbage."""
+
+
+def dtype_tag(dtype: Any) -> str:
+    """numpy (or jax) dtype -> safetensors tag ('BF16', 'F32', ...)."""
+    np_dtype = np.dtype(dtype)
+    tag = _DTYPE_TAGS.get(np_dtype)
+    if tag is None:
+        raise CheckpointFormatError(
+            f'dtype {np_dtype} has no safetensors encoding; supported: '
+            f'{sorted(_DTYPES)}')
+    return tag
+
+
+@dataclasses.dataclass(frozen=True)
+class LazyTensor:
+    """One tensor's header entry + a window onto its shard's mmap.
+
+    `read()` is zero-copy: a numpy view over the mapped bytes. The
+    caller owns any materializing transform (cast/transpose) and its
+    memory accounting."""
+    name: str
+    dtype: np.dtype
+    shape: Tuple[int, ...]
+    nbytes: int
+    shard: str                    # shard filename (diagnostics)
+    _mm: mmap.mmap = dataclasses.field(repr=False)
+    _start: int = 0               # absolute offset into the shard file
+
+    def read(self) -> np.ndarray:
+        flat = np.frombuffer(self._mm, dtype=self.dtype,
+                             count=int(np.prod(self.shape, dtype=np.int64))
+                             if self.shape else 1,
+                             offset=self._start)
+        return flat.reshape(self.shape)
+
+
+def _parse_header(raw: bytes, path: str) -> Dict[str, Any]:
+    try:
+        header = json.loads(raw.decode('utf-8'))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise CheckpointFormatError(
+            f'{path}: header is not valid JSON ({e})') from None
+    if not isinstance(header, dict):
+        raise CheckpointFormatError(
+            f'{path}: header must be a JSON object, got '
+            f'{type(header).__name__}')
+    return header
+
+
+class SafeTensorsFile:
+    """One mmap'd .safetensors shard: header parsed and validated at
+    open, tensors exposed as LazyTensor views."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._file = open(path, 'rb')  # noqa: SIM115 — lives with self
+        try:
+            size = os.fstat(self._file.fileno()).st_size
+            if size < 8:
+                raise CheckpointFormatError(
+                    f'{path}: {size} bytes is too short to hold the '
+                    '8-byte header length')
+            (header_len,) = struct.unpack('<Q', self._file.read(8))
+            if header_len > _MAX_HEADER_BYTES or 8 + header_len > size:
+                raise CheckpointFormatError(
+                    f'{path}: header length {header_len} exceeds the '
+                    f'file ({size} bytes) — truncated or corrupt')
+            header = _parse_header(self._file.read(header_len), path)
+            self.metadata: Dict[str, str] = header.pop('__metadata__',
+                                                       {}) or {}
+            self._mm = mmap.mmap(self._file.fileno(), 0,
+                                 access=mmap.ACCESS_READ)
+            payload_start = 8 + header_len
+            payload_size = size - payload_start
+            self.tensors: Dict[str, LazyTensor] = {}
+            spans: List[Tuple[int, int, str]] = []
+            for name, entry in header.items():
+                self.tensors[name] = self._entry(
+                    name, entry, payload_start, payload_size)
+                begin, end = entry['data_offsets']
+                spans.append((int(begin), int(end), name))
+            # Offsets must tile the payload exactly: gaps mean a
+            # truncated rewrite, overlaps mean aliased garbage.
+            spans.sort()
+            cursor = 0
+            for begin, end, name in spans:
+                if begin != cursor:
+                    raise CheckpointFormatError(
+                        f'{path}: tensor {name!r} starts at payload '
+                        f'offset {begin}, expected {cursor} (gap or '
+                        'overlap — corrupt header)')
+                cursor = end
+            if cursor != payload_size:
+                raise CheckpointFormatError(
+                    f'{path}: payload is {payload_size} bytes but the '
+                    f'header accounts for {cursor} — truncated file '
+                    'or stale header')
+        except Exception:
+            self._file.close()
+            raise
+
+    def _entry(self, name: str, entry: Any, payload_start: int,
+               payload_size: int) -> LazyTensor:
+        if not isinstance(entry, dict) or not all(
+                k in entry for k in ('dtype', 'shape', 'data_offsets')):
+            raise CheckpointFormatError(
+                f'{self.path}: tensor {name!r} entry must carry '
+                'dtype/shape/data_offsets')
+        tag = entry['dtype']
+        if tag not in _DTYPES:
+            raise CheckpointFormatError(
+                f'{self.path}: tensor {name!r} has unsupported dtype '
+                f'{tag!r}; supported: {sorted(_DTYPES)}')
+        dtype = _DTYPES[tag]
+        shape = tuple(int(d) for d in entry['shape'])
+        begin, end = (int(v) for v in entry['data_offsets'])
+        count = 1
+        for d in shape:
+            count *= d
+        expected = count * dtype.itemsize
+        if begin < 0 or end < begin or end > payload_size:
+            raise CheckpointFormatError(
+                f'{self.path}: tensor {name!r} data_offsets '
+                f'[{begin}, {end}) fall outside the {payload_size}-'
+                'byte payload — truncated file or corrupt header')
+        if end - begin != expected:
+            raise CheckpointFormatError(
+                f'{self.path}: tensor {name!r} spans {end - begin} '
+                f'bytes but shape {shape} x {tag} needs {expected}')
+        return LazyTensor(name=name, dtype=dtype, shape=shape,
+                          nbytes=expected, shard=os.path.basename(
+                              self.path),
+                          _mm=self._mm, _start=payload_start + begin)
+
+    def close(self) -> None:
+        self._mm.close()
+        self._file.close()
+
+    def __enter__(self) -> 'SafeTensorsFile':
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class CheckpointReader:
+    """A checkpoint directory (or single file): every shard's tensors
+    behind one name -> LazyTensor namespace.
+
+    Resolution order matches HF: `model.safetensors.index.json` names
+    the shards when present (and only THOSE files are opened — the
+    index is the source of truth); otherwise every *.safetensors file
+    in the directory is a shard."""
+
+    def __init__(self, path: str):
+        path = os.path.abspath(os.path.expanduser(path))
+        self.path = path
+        self._files: List[SafeTensorsFile] = []
+        self.tensors: Dict[str, LazyTensor] = {}
+        self.weight_map: Dict[str, str] = {}
+        if os.path.isfile(path):
+            shard_paths = [path]
+        else:
+            index_path = os.path.join(path, INDEX_FILENAME)
+            if os.path.exists(index_path):
+                with open(index_path, encoding='utf-8') as f:
+                    try:
+                        index = json.load(f)
+                    except json.JSONDecodeError as e:
+                        raise CheckpointFormatError(
+                            f'{index_path}: invalid JSON ({e})'
+                        ) from None
+                weight_map = index.get('weight_map')
+                if not isinstance(weight_map, dict) or not weight_map:
+                    raise CheckpointFormatError(
+                        f'{index_path}: missing/empty "weight_map"')
+                self.weight_map = dict(weight_map)
+                shard_paths = [os.path.join(path, fn) for fn in
+                               sorted(set(weight_map.values()))]
+                missing = [p for p in shard_paths
+                           if not os.path.exists(p)]
+                if missing:
+                    raise CheckpointFormatError(
+                        f'{index_path} names shards that do not '
+                        f'exist: {[os.path.basename(p) for p in missing]}')
+            else:
+                shard_paths = sorted(
+                    os.path.join(path, fn) for fn in os.listdir(path)
+                    if fn.endswith('.safetensors'))
+                if not shard_paths:
+                    raise CheckpointFormatError(
+                        f'{path}: no *.safetensors shards and no '
+                        f'{INDEX_FILENAME}')
+        try:
+            for shard_path in shard_paths:
+                shard = SafeTensorsFile(shard_path)
+                self._files.append(shard)
+                for name, tensor in shard.tensors.items():
+                    if name in self.tensors:
+                        raise CheckpointFormatError(
+                            f'tensor {name!r} appears in both '
+                            f'{self.tensors[name].shard} and '
+                            f'{tensor.shard}')
+                    self.tensors[name] = tensor
+        except Exception:
+            self.close()
+            raise
+        # Index entries must resolve: a weight_map naming a tensor the
+        # shard does not contain is the classic torn-download state.
+        for name, fn in self.weight_map.items():
+            got = self.tensors.get(name)
+            if got is None or got.shard != fn:
+                raise CheckpointFormatError(
+                    f'{INDEX_FILENAME} maps {name!r} -> {fn!r} but the '
+                    f'shard holds '
+                    f'{"nothing" if got is None else got.shard!r}')
+
+    def names(self) -> List[str]:
+        return sorted(self.tensors)
+
+    def tensor(self, name: str) -> LazyTensor:
+        try:
+            return self.tensors[name]
+        except KeyError:
+            raise KeyError(
+                f'{self.path}: no tensor {name!r}; nearest: '
+                f'{_nearest(name, self.tensors)}') from None
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(t.nbytes for t in self.tensors.values())
+
+    @property
+    def num_shards(self) -> int:
+        return len(self._files)
+
+    def close(self) -> None:
+        for f in self._files:
+            f.close()
+
+    def __enter__(self) -> 'CheckpointReader':
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _nearest(name: str, names: Iterable[str], k: int = 3) -> List[str]:
+    """Cheap suggestion list for error messages: longest shared
+    prefix wins (HF names are dotted paths, so this surfaces the
+    right layer/projection neighborhood without a distance lib)."""
+    def shared(a: str, b: str) -> int:
+        n = 0
+        for ca, cb in zip(a, b):
+            if ca != cb:
+                break
+            n += 1
+        return n
+    return sorted(names, key=lambda other: -shared(name, other))[:k]
+
+
+def write_safetensors(path: str, tensors: Dict[str, np.ndarray],
+                      metadata: Optional[Dict[str, str]] = None) -> int:
+    """Write one shard; returns payload bytes written.
+
+    Accepts numpy arrays (jax arrays should be np.asarray'd by the
+    caller, one tensor at a time — that is what keeps export
+    streaming). Insertion order is preserved so offsets are
+    deterministic for a given tensor sequence."""
+    header: Dict[str, Any] = {}
+    if metadata:
+        header['__metadata__'] = dict(metadata)
+    cursor = 0
+    arrays: List[np.ndarray] = []
+    for name, arr in tensors.items():
+        arr = np.ascontiguousarray(arr)
+        arrays.append(arr)
+        header[name] = {
+            'dtype': dtype_tag(arr.dtype),
+            'shape': list(arr.shape),
+            'data_offsets': [cursor, cursor + arr.nbytes],
+        }
+        cursor += arr.nbytes
+    raw = json.dumps(header, separators=(',', ':')).encode('utf-8')
+    tmp = path + '.tmp'
+    with open(tmp, 'wb') as f:
+        f.write(struct.pack('<Q', len(raw)))
+        f.write(raw)
+        for arr in arrays:
+            arr.tofile(f)  # straight from the buffer, no bytes copy
+    os.replace(tmp, path)  # no torn shards on a crashed export
+    return cursor
+
+
+class ShardedWriter:
+    """Streaming multi-shard writer: add() tensors one at a time; a
+    new shard starts when the current one would exceed
+    `max_shard_bytes`. close() renames shards to the HF
+    `model-0000i-of-0000n.safetensors` scheme and writes the index
+    (single-shard checkpoints collapse to `model.safetensors`, no
+    index — exactly what HF emits).
+
+    Streaming for real: each tensor's bytes land in the shard's
+    payload temp file inside add() — the writer never holds more than
+    the ONE tensor the caller just passed, so exporting a model keeps
+    peak host memory O(largest tensor) symmetrically with the
+    importer. Finalizing a shard prepends the header and streams the
+    payload file-to-file (shutil.copyfileobj, constant memory)."""
+
+    def __init__(self, out_dir: str, max_shard_bytes: int = 5 * 2**30,
+                 metadata: Optional[Dict[str, str]] = None):
+        if max_shard_bytes <= 0:
+            raise ValueError('max_shard_bytes must be positive')
+        self.out_dir = os.path.abspath(os.path.expanduser(out_dir))
+        os.makedirs(self.out_dir, exist_ok=True)
+        self.max_shard_bytes = max_shard_bytes
+        self.metadata = metadata
+        self._header: Dict[str, Any] = {}
+        self._payload = None          # open temp file of raw bytes
+        self._payload_path: Optional[str] = None
+        self._current_bytes = 0
+        # Finalized-but-unnamed shards: (tmp path, names). The final
+        # i-of-n names need n, known only at close().
+        self._done: List[Tuple[str, List[str]]] = []
+        self._total = 0
+
+    def add(self, name: str, arr: np.ndarray) -> None:
+        if name in self._header or any(
+                name in names for _, names in self._done):
+            raise ValueError(f'tensor {name!r} added twice')
+        arr = np.ascontiguousarray(arr)
+        if self._payload is not None and \
+                self._current_bytes + arr.nbytes > self.max_shard_bytes:
+            self._finish_shard()
+        if self._payload is None:
+            self._payload_path = os.path.join(
+                self.out_dir, f'.shard-{len(self._done):05d}.payload')
+            self._payload = open(self._payload_path, 'wb')  # noqa: SIM115
+            self._header = {}
+            self._current_bytes = 0
+        self._header[name] = {
+            'dtype': dtype_tag(arr.dtype),
+            'shape': list(arr.shape),
+            'data_offsets': [self._current_bytes,
+                             self._current_bytes + arr.nbytes],
+        }
+        # tofile() streams from the array's own buffer — tobytes()
+        # would materialize a second full copy and double the
+        # documented O(largest tensor) export peak.
+        arr.tofile(self._payload)
+        self._current_bytes += arr.nbytes
+        self._total += arr.nbytes
+
+    def _finish_shard(self) -> None:
+        import shutil
+        if self._payload is None:
+            return
+        self._payload.close()
+        header: Dict[str, Any] = {}
+        if self.metadata:
+            header['__metadata__'] = dict(self.metadata)
+        header.update(self._header)
+        raw = json.dumps(header, separators=(',', ':')).encode('utf-8')
+        tmp = self._payload_path + '.shard'
+        with open(tmp, 'wb') as out, \
+                open(self._payload_path, 'rb') as payload:
+            out.write(struct.pack('<Q', len(raw)))
+            out.write(raw)
+            shutil.copyfileobj(payload, out)
+        os.remove(self._payload_path)
+        self._done.append((tmp, list(self._header)))
+        self._payload = self._payload_path = None
+        self._header, self._current_bytes = {}, 0
+
+    def close(self) -> List[str]:
+        """Finalize every shard + index; returns written filenames.
+
+        Stale artifacts from a PREVIOUS export into the same dir are
+        removed: a leftover index (or leftover shards) would stay
+        authoritative for the reader and silently serve the old
+        weights — same hygiene as HF's save_pretrained."""
+        self._finish_shard()
+        if not self._done:
+            raise ValueError('no tensors were added')
+        n = len(self._done)
+        written: List[str] = []
+        weight_map: Dict[str, str] = {}
+        for i, (tmp, names) in enumerate(self._done):
+            fn = ('model.safetensors' if n == 1 else
+                  f'model-{i + 1:05d}-of-{n:05d}.safetensors')
+            os.replace(tmp, os.path.join(self.out_dir, fn))
+            for name in names:
+                weight_map[name] = fn
+            written.append(fn)
+        if n > 1:
+            index = {'metadata': {'total_size': self._total},
+                     'weight_map': weight_map}
+            with open(os.path.join(self.out_dir, INDEX_FILENAME), 'w',
+                      encoding='utf-8') as f:
+                json.dump(index, f, indent=2, sort_keys=True)
+            written.append(INDEX_FILENAME)
+        keep = set(written)
+        for fn in os.listdir(self.out_dir):
+            if fn in keep:
+                continue
+            if fn.endswith('.safetensors') or fn == INDEX_FILENAME:
+                os.remove(os.path.join(self.out_dir, fn))
+        return written

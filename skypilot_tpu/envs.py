@@ -229,6 +229,21 @@ SKYTPU_SPEC_K = declare(
     'Speculative-decoding draft length: tokens the draft model '
     'proposes per big-model verify pass when a draft is attached.')
 
+# --- checkpoints (HF safetensors import/export) -----------------------------
+
+SKYTPU_HF_IMPORT_STRICT = declare(
+    'SKYTPU_HF_IMPORT_STRICT', bool, True,
+    'HF checkpoint import: fail on tensors that do not map onto the '
+    'engine pytree (usually a wrong config.json or mis-detected '
+    'family). 0 downgrades unexpected-tensor errors to warnings; '
+    'missing tensors are always fatal.')
+SKYTPU_HF_IMPORT_CONCURRENCY = declare(
+    'SKYTPU_HF_IMPORT_CONCURRENCY', int, 1,
+    'Shard read/transform threads running ahead of device placement '
+    'during HF checkpoint import. 1 is fully synchronous; N>1 keeps '
+    'up to N transformed layer tensors on the host at once (memory/'
+    'speed trade on top of the O(largest tensor) floor).')
+
 # --- serve plane ------------------------------------------------------------
 
 SKYTPU_SERVE_LOOP_INTERVAL = declare(

@@ -34,7 +34,13 @@ def build_engine(model: str, *, checkpoint: Optional[str] = None,
                  ) -> InferenceEngine:
     """One engine-construction path for every entrypoint (HTTP server,
     offline batch): resolve the model, build the mesh from a
-    'tensor=8,context=2'-style arg, restore or random-init params."""
+    'tensor=8,context=2'-style arg, restore or random-init params.
+
+    `checkpoint` auto-detects its layout: an HF safetensors dir
+    (config.json + *.safetensors) streams in through
+    `skypilot_tpu.checkpoints` with the geometry the checkpoint
+    declares; anything else restores as an Orbax train checkpoint
+    with the named model's geometry."""
     import jax
 
     from skypilot_tpu import models as models_lib
@@ -46,18 +52,29 @@ def build_engine(model: str, *, checkpoint: Optional[str] = None,
         spec = mesh_lib.MeshSpec.from_dict(dict(
             kv.split('=') for kv in mesh_arg.split(',')))
         mesh = mesh_lib.mesh_from_env(spec)
-    if checkpoint:
+
+    def _restore(ckpt_path, cfg):
+        from skypilot_tpu import checkpoints as ckpt_lib
+        if ckpt_lib.is_hf_checkpoint(ckpt_path):
+            # The checkpoint's own config.json wins over the --model
+            # preset: serving HF weights with mismatched geometry
+            # would be silent garbage, and the detector carries every
+            # family knob the engine honors.
+            params, detected, _stats = ckpt_lib.load_params(
+                ckpt_path, mesh=mesh)
+            return params, detected
         from skypilot_tpu.train import checkpoints
-        params = checkpoints.restore_params(checkpoint, config)
+        return checkpoints.restore_params(ckpt_path, cfg), cfg
+
+    if checkpoint:
+        params, config = _restore(checkpoint, config)
     else:
         params = family.init_params(config, jax.random.key(0))
     draft = None
     if draft_model:
         dfamily, dconfig = models_lib.resolve(draft_model)
         if draft_checkpoint:
-            from skypilot_tpu.train import checkpoints
-            dparams = checkpoints.restore_params(draft_checkpoint,
-                                                 dconfig)
+            dparams, dconfig = _restore(draft_checkpoint, dconfig)
         else:
             dparams = dfamily.init_params(dconfig, jax.random.key(1))
         draft = (dparams, dconfig)

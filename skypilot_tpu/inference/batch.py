@@ -12,6 +12,10 @@ sequences finish.
         --input prompts.jsonl --output completions.jsonl \
         --batch-size 32 --max-new-tokens 256
 
+`--checkpoint` takes either layout, auto-detected: an HF safetensors
+dir (streamed import, geometry from its config.json) or an Orbax
+train checkpoint (see skypilot_tpu/checkpoints/).
+
 Input lines: {"prompt_tokens": [...]} (+ optional per-line
 "max_new_tokens", "temperature", "top_k", "id"). Output lines carry
 the input id (or line index), the generated tokens, and timing.
@@ -76,7 +80,9 @@ def run_batch(engine, requests: List[Dict[str, Any]],
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--model', default='tiny')
-    parser.add_argument('--checkpoint', default=None)
+    parser.add_argument('--checkpoint', default=None,
+                        help='HF safetensors dir or Orbax checkpoint '
+                             'dir (layout auto-detected).')
     parser.add_argument('--input', required=True,
                         help='JSONL with {"prompt_tokens": [...]} lines')
     parser.add_argument('--output', required=True)

@@ -366,7 +366,11 @@ def main() -> None:
                              'decode batch (default: env '
                              'SKYTPU_MAX_QUEUE_DEPTH; 0 disables).')
     parser.add_argument('--checkpoint', default=None,
-                        help='Orbax checkpoint dir with model params')
+                        help='Checkpoint dir with model params: an '
+                             'HF safetensors dir (config.json + '
+                             '*.safetensors; geometry auto-detected, '
+                             'streamed import) or an Orbax train '
+                             'checkpoint — layout auto-detected.')
     parser.add_argument('--mesh', default=None,
                         help='Shard serving over a device mesh, e.g. '
                              'tensor=8 on a v5e-8 (models whose '

@@ -129,7 +129,16 @@ def abstract_train_state(cfg, mesh) -> Dict[str, Any]:
 def restore_params(ckpt_dir: str, config,
                    mesh: Optional[Any] = None) -> Dict[str, Any]:
     """Restore just model params (inference path). Accepts checkpoints
-    saved either as bare params or as full train state."""
+    saved either as bare params or as full train state — and, via
+    auto-detection, an HF safetensors dir: a pretrained download
+    passed where an Orbax dir was expected streams in through the
+    importer (with the geometry its own config.json declares) instead
+    of dying in FileNotFoundError."""
+    from skypilot_tpu import checkpoints as hf_ckpts
+    if hf_ckpts.is_hf_checkpoint(ckpt_dir):
+        params, _detected, _stats = hf_ckpts.load_params(ckpt_dir,
+                                                         mesh=mesh)
+        return params
     del config  # shapes come from checkpoint metadata
     step = latest_step(ckpt_dir)
     if step is None:

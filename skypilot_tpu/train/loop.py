@@ -45,8 +45,15 @@ def fit(cfg: trainer_lib.TrainerConfig,
         checkpoint_dir: Optional[str] = None,
         checkpoint_every: int = 100,
         log_every: int = 10,
+        init_checkpoint: Optional[str] = None,
         log_fn=print) -> Dict[str, Any]:
-    """Train to cfg.max_steps; resume from checkpoint_dir if present."""
+    """Train to cfg.max_steps; resume from checkpoint_dir if present.
+
+    `init_checkpoint` seeds the STARTING params (the finetune case):
+    an HF safetensors dir streams in through the importer, an Orbax
+    dir restores params — auto-detected either way. A resume
+    checkpoint in `checkpoint_dir` wins over it (mid-run preemption
+    recovery must continue the finetune, not restart it)."""
     state = trainer_lib.make_train_state(cfg, mesh)
     start_step = 0
     if checkpoint_dir is not None:
@@ -73,6 +80,36 @@ def fit(cfg: trainer_lib.TrainerConfig,
                 state)
             start_step = step
             log_fn(f'[fit] resumed from step {step}')
+
+    if init_checkpoint is not None and start_step == 0:
+        import jax.numpy as jnp
+        loaded = checkpoints.restore_params(
+            init_checkpoint, cfg.model_config(), mesh=mesh)
+        # Land every leaf on the train state's sharding/dtype: the
+        # tree.map fails LOUDLY on a structure or shape mismatch
+        # (wrong family/geometry for this TrainerConfig), instead of
+        # training a silently half-initialized model.
+        def _adopt(cur, new):
+            if cur.shape != new.shape:
+                raise ValueError(
+                    f'--checkpoint geometry mismatch: leaf shape '
+                    f'{new.shape} vs model {cur.shape} — does '
+                    f'--model match the checkpoint?')
+            return jax.device_put(jnp.asarray(new, cur.dtype),
+                                  cur.sharding)
+
+        try:
+            state['params'] = jax.tree.map(_adopt, state['params'],
+                                           loaded)
+        except ValueError as e:
+            # jax's pytree structure errors dump whole arrays; keep
+            # the detail but lead with what the operator must fix.
+            raise ValueError(
+                f'--checkpoint geometry mismatch: {init_checkpoint!r} '
+                f'does not hold params for model {cfg.model!r} '
+                '(different family knobs — tied embeddings, biases, '
+                f'post-norms — or sizes): {str(e)[:500]}') from None
+        log_fn(f'[fit] initialized params from {init_checkpoint}')
 
     step_fn = trainer_lib.make_train_step(cfg, mesh)
     if batch_fn is None:
@@ -130,6 +167,12 @@ def main() -> None:
     parser.add_argument('--learning-rate', type=float, default=3e-4)
     parser.add_argument('--checkpoint-dir', default=None)
     parser.add_argument('--checkpoint-every', type=int, default=100)
+    parser.add_argument('--checkpoint', default=None,
+                        help='Initial weights for a finetune: an HF '
+                             'safetensors dir (streamed import) or '
+                             'an Orbax params checkpoint — layout '
+                             'auto-detected. A resume checkpoint in '
+                             '--checkpoint-dir takes precedence.')
     parser.add_argument('--mesh', default='fsdp=-1',
                         help='Comma-separated axis=size, e.g. '
                         'data=2,fsdp=4,tensor=2 (-1 fills).')
@@ -148,7 +191,8 @@ def main() -> None:
         learning_rate=args.learning_rate,
         attention_impl=args.attention)
     fit(cfg, mesh, checkpoint_dir=args.checkpoint_dir,
-        checkpoint_every=args.checkpoint_every)
+        checkpoint_every=args.checkpoint_every,
+        init_checkpoint=args.checkpoint)
 
 
 if __name__ == '__main__':

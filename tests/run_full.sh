@@ -18,3 +18,22 @@ for scenario in smoke fused_decode shared_prefix zone_loss \
     JAX_PLATFORMS=cpu python -m skypilot_tpu.fleetsim \
         --scenario "$scenario" --out /tmp
 done
+# HF checkpoint round-trip smoke: export the tiny model (multi-shard)
+# then the import + verify CLIs must exit 0 — the same commands an
+# operator runs against a real pretrained download.
+ckpt_dir=$(mktemp -d)
+JAX_PLATFORMS=cpu python - "$ckpt_dir" <<'EOF'
+import sys
+
+import jax
+
+from skypilot_tpu import checkpoints
+from skypilot_tpu.models import llama
+
+cfg = llama.CONFIGS['tiny']
+checkpoints.export_params(llama.init_params(cfg, jax.random.key(0)),
+                          cfg, sys.argv[1], max_shard_bytes=200 * 1024)
+EOF
+JAX_PLATFORMS=cpu python -m skypilot_tpu.checkpoints verify "$ckpt_dir"
+JAX_PLATFORMS=cpu python -m skypilot_tpu.checkpoints import "$ckpt_dir"
+rm -rf "$ckpt_dir"

@@ -161,6 +161,50 @@ def test_trace_safety_flags_host_bookkeeping_in_cow_helper(tmp_path):
     assert 'closure-mutation' in rules
 
 
+def test_trace_safety_passes_hf_import_placement_helper(tmp_path):
+    """The HF-import hot loop's idiom (ISSUE 12): the jitted donated
+    layer-placement helper — dynamic_update_index_in_dim with a
+    traced layer index — is trace-clean and must not flag."""
+    findings = _run_snippet(tmp_path, """
+        import functools
+
+        import jax
+        from jax import lax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def place_layer(stacked, layer, idx):
+            return lax.dynamic_update_index_in_dim(stacked, layer,
+                                                   idx, 0)
+    """, 'trace-safety')
+    assert findings == []
+
+
+def test_trace_safety_flags_host_io_in_placement_helper(tmp_path):
+    """The broken twin: shard reads, progress accounting, or metrics
+    inside the jitted placement helper run ONCE at trace time — every
+    later layer would silently re-place the traced layer's bytes (and
+    the budget accounting would lie)."""
+    findings = _run_snippet(tmp_path, """
+        import functools
+
+        import jax
+        from jax import lax
+
+        LIVE_BYTES = []
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def place_layer(stacked, reader, name, idx):
+            layer = reader.tensor(name).read()   # host I/O — flag
+            LIVE_BYTES.append(idx)               # closure mutation — flag
+            print('placing', name)               # host call — flag
+            return lax.dynamic_update_index_in_dim(stacked, layer,
+                                                   idx, 0)
+    """, 'trace-safety')
+    rules = _rules(findings)
+    assert 'host-call' in rules
+    assert 'closure-mutation' in rules
+
+
 def test_trace_safety_flags_tracer_coercion(tmp_path):
     findings = _run_snippet(tmp_path, """
         import jax

@@ -1,0 +1,616 @@
+"""HF checkpoint import/export: round-trip, streaming, and failure
+contracts (ISSUE 12 acceptance).
+
+Pinned here, on CPU, in tier-1:
+  * export -> import of the tiny model is BYTE-identical, and greedy
+    decoding through the real engine (prefix cache on and off)
+    matches the directly-built engine token for token;
+  * importing a multi-shard fixture never materializes the full
+    param set on host (`ImportStats.peak_host_bytes`, the lazy-view
+    accounting, stays O(largest tensor + one stacked layer));
+  * a hand-written HF-layout fixture (real HF key names, multi-shard
+    index, tied embeddings) maps exactly, and a deliberately-missing
+    or -extra key dies with a loud, actionable error;
+  * `python -m skypilot_tpu.checkpoints verify` exits 0 on the
+    fixture and nonzero with a per-tensor report on a corrupted copy.
+"""
+import dataclasses
+import json
+import os
+import struct
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu import checkpoints as ckpt_lib
+from skypilot_tpu import inference
+from skypilot_tpu.checkpoints import __main__ as ckpt_cli
+from skypilot_tpu.checkpoints import hf_import
+from skypilot_tpu.checkpoints import safetensors_io
+from skypilot_tpu.models import gemma
+from skypilot_tpu.models import llama
+from skypilot_tpu.models import mistral
+from skypilot_tpu.models import qwen
+
+
+def _tree_equal(a, b) -> None:
+    la, ta = jax.tree.flatten(a)
+    lb, tb = jax.tree.flatten(b)
+    assert ta == tb
+    for x, y in zip(la, lb):
+        assert x.shape == y.shape and x.dtype == y.dtype
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _greedy(engine, prompt, max_new=8):
+    rid = engine.submit(list(prompt),
+                        inference.SamplingParams(temperature=0.0,
+                                                 max_new_tokens=max_new))
+    done = {}
+    while engine.has_work:
+        done.update(engine.run_to_completion())
+    return done[rid]
+
+
+# --- round trip -------------------------------------------------------------
+
+
+@pytest.mark.parametrize('name,family', [
+    ('tiny', llama), ('tiny-gemma', gemma),
+    ('tiny-mistral', mistral), ('tiny-qwen', qwen)])
+def test_round_trip_byte_identical(tmp_path, name, family):
+    """Every family knob the exporter writes must survive the
+    detector: (1+w) norms, post-norms, tied embeddings, qkv bias,
+    sliding windows."""
+    config = family.CONFIGS[name]
+    params = family.init_params(config, jax.random.key(3))
+    out = str(tmp_path / 'hf')
+    ckpt_lib.export_params(params, config, out,
+                           max_shard_bytes=200 * 1024)
+    restored, detected, _stats = ckpt_lib.load_params(out)
+    _tree_equal(params, restored)
+    # The geometry knobs the engine actually computes with round-trip
+    # exactly (presentation knobs like remat/attention_impl may not).
+    for knob in ('vocab_size', 'hidden_size', 'intermediate_size',
+                 'num_layers', 'num_heads', 'num_kv_heads', 'head_dim',
+                 'rope_theta', 'rms_norm_eps', 'tied_embeddings',
+                 'activation', 'norm_plus_one', 'post_norms',
+                 'embed_scale', 'attn_qkv_bias', 'sliding_window',
+                 'attn_logit_softcap', 'final_logit_softcap',
+                 'rope_scaling_factor'):
+        assert getattr(detected, knob) == getattr(config, knob), knob
+
+
+def test_round_trip_greedy_equivalent_through_engine(tmp_path):
+    """build_engine(--checkpoint <hf dir>) must decode exactly what
+    an engine holding the original params decodes — with the prefix
+    cache on AND off (the default path and the plain path)."""
+    config = llama.CONFIGS['tiny']
+    params = llama.init_params(config, jax.random.key(11))
+    out = str(tmp_path / 'hf')
+    ckpt_lib.export_params(params, config, out)
+    prompt = [(7 * i) % 199 + 1 for i in range(12)]
+    for prefix_cache in (True, False):
+        direct = inference.InferenceEngine(
+            params, config, batch_size=2, max_seq_len=64,
+            kv_quant='none', prefix_cache=prefix_cache)
+        imported = inference.build_engine(
+            'tiny', checkpoint=out, batch_size=2, max_seq_len=64,
+            kv_quant='none', prefix_cache=prefix_cache)
+        assert _greedy(direct, prompt) == _greedy(imported, prompt), \
+            f'prefix_cache={prefix_cache}'
+
+
+def test_rope_scaling_round_trips(tmp_path):
+    config = dataclasses.replace(llama.CONFIGS['tiny'],
+                                 rope_scaling_factor=8.0,
+                                 rope_scaling_original_max=64)
+    params = llama.init_params(config, jax.random.key(0))
+    out = str(tmp_path / 'hf')
+    ckpt_lib.export_params(params, config, out)
+    _family, detected = ckpt_lib.detect_config(out)
+    assert detected.rope_scaling_factor == 8.0
+    assert detected.rope_scaling_original_max == 64
+
+
+# --- hand-written HF fixture ------------------------------------------------
+
+_FIX = dict(vocab_size=32, hidden_size=8, intermediate_size=16,
+            num_layers=2, num_heads=2, num_kv_heads=1, head_dim=4)
+
+
+def _write_raw_safetensors(path, tensors):
+    """A from-scratch writer (not safetensors_io): the reader must
+    accept bytes WE didn't produce, or the fixture proves nothing
+    about real HF files."""
+    header = {}
+    cursor = 0
+    for name, arr in tensors.items():
+        header[name] = {'dtype': 'F32', 'shape': list(arr.shape),
+                        'data_offsets': [cursor, cursor + arr.nbytes]}
+        cursor += arr.nbytes
+    raw = json.dumps(header).encode()
+    with open(path, 'wb') as f:
+        f.write(struct.pack('<Q', len(raw)))
+        f.write(raw)
+        for arr in tensors.values():
+            f.write(np.ascontiguousarray(arr).tobytes())
+
+
+def _hf_fixture(tmp_path, tied=True, drop=None, extra=None):
+    """Real HF key names, two shards + index, tied embeddings by
+    default, plus a legacy rotary_emb extra that must be ignored."""
+    f = _FIX
+    rng = np.random.RandomState(0)
+    e, m, d = f['hidden_size'], f['intermediate_size'], f['head_dim']
+    h, kv = f['num_heads'], f['num_kv_heads']
+
+    def w(*shape):
+        return rng.randn(*shape).astype(np.float32)
+
+    tensors = {'model.embed_tokens.weight': w(f['vocab_size'], e)}
+    for i in range(f['num_layers']):
+        pre = f'model.layers.{i}.'
+        tensors.update({
+            pre + 'input_layernorm.weight': w(e),
+            pre + 'self_attn.q_proj.weight': w(h * d, e),
+            pre + 'self_attn.k_proj.weight': w(kv * d, e),
+            pre + 'self_attn.v_proj.weight': w(kv * d, e),
+            pre + 'self_attn.o_proj.weight': w(e, h * d),
+            pre + 'post_attention_layernorm.weight': w(e),
+            pre + 'mlp.gate_proj.weight': w(m, e),
+            pre + 'mlp.up_proj.weight': w(m, e),
+            pre + 'mlp.down_proj.weight': w(e, m),
+        })
+    tensors['model.norm.weight'] = w(e)
+    if not tied:
+        tensors['lm_head.weight'] = w(f['vocab_size'], e)
+    # Legacy HF llama exports carry rotary tables; import ignores them
+    # even under strict.
+    tensors['model.layers.0.self_attn.rotary_emb.inv_freq'] = w(d // 2)
+    if drop:
+        del tensors[drop]
+    if extra:
+        tensors[extra] = w(e)
+
+    names = sorted(tensors)
+    half = names[:len(names) // 2]
+    shards = {'model-00001-of-00002.safetensors':
+              {n: tensors[n] for n in half},
+              'model-00002-of-00002.safetensors':
+              {n: tensors[n] for n in names if n not in half}}
+    out = tmp_path / 'hand-fixture'
+    out.mkdir(exist_ok=True)
+    weight_map = {}
+    for fn, shard in shards.items():
+        _write_raw_safetensors(str(out / fn), shard)
+        weight_map.update({n: fn for n in shard})
+    with open(out / safetensors_io.INDEX_FILENAME, 'w') as fh:
+        json.dump({'metadata': {'total_size': sum(
+            t.nbytes for t in tensors.values())},
+            'weight_map': weight_map}, fh)
+    with open(out / 'config.json', 'w') as fh:
+        json.dump({
+            'model_type': 'llama',
+            'vocab_size': f['vocab_size'], 'hidden_size': e,
+            'intermediate_size': m,
+            'num_hidden_layers': f['num_layers'],
+            'num_attention_heads': h, 'num_key_value_heads': kv,
+            'head_dim': d, 'max_position_embeddings': 64,
+            'rope_theta': 10000.0, 'rms_norm_eps': 1e-5,
+            'tie_word_embeddings': tied, 'torch_dtype': 'float32',
+        }, fh)
+    return str(out), tensors
+
+
+def test_hand_written_fixture_maps_exactly(tmp_path):
+    out, tensors = _hf_fixture(tmp_path, tied=True)
+    params, config, stats = ckpt_lib.load_params(out)
+    assert config.tied_embeddings and 'lm_head' not in params
+    assert stats.shards == 2
+    f = _FIX
+    e, d, h, kv = (f['hidden_size'], f['head_dim'], f['num_heads'],
+                   f['num_kv_heads'])
+    for i in range(f['num_layers']):
+        pre = f'model.layers.{i}.'
+        np.testing.assert_array_equal(
+            np.asarray(params['layers']['wq'][i]),
+            tensors[pre + 'self_attn.q_proj.weight'].T.reshape(e, h, d))
+        np.testing.assert_array_equal(
+            np.asarray(params['layers']['wk'][i]),
+            tensors[pre + 'self_attn.k_proj.weight'].T.reshape(e, kv, d))
+        np.testing.assert_array_equal(
+            np.asarray(params['layers']['wo'][i]),
+            tensors[pre + 'self_attn.o_proj.weight'].T.reshape(h, d, e))
+        np.testing.assert_array_equal(
+            np.asarray(params['layers']['w_down'][i]),
+            tensors[pre + 'mlp.down_proj.weight'].T)
+    np.testing.assert_array_equal(
+        np.asarray(params['embed']),
+        tensors['model.embed_tokens.weight'])
+
+
+def test_missing_key_is_loud_and_actionable(tmp_path):
+    out, _ = _hf_fixture(tmp_path, tied=True,
+                         drop='model.layers.1.mlp.up_proj.weight')
+    with pytest.raises(hf_import.HFImportError) as err:
+        ckpt_lib.load_params(out)
+    msg = str(err.value)
+    assert 'model.layers.1.mlp.up_proj.weight' in msg
+    assert 'missing' in msg
+
+
+def test_extra_key_strict_vs_relaxed(tmp_path, monkeypatch):
+    out, _ = _hf_fixture(tmp_path, tied=True,
+                         extra='model.layers.0.mystery.weight')
+    with pytest.raises(hf_import.HFImportError) as err:
+        ckpt_lib.load_params(out)
+    msg = str(err.value)
+    assert 'model.layers.0.mystery.weight' in msg
+    assert 'SKYTPU_HF_IMPORT_STRICT' in msg
+    # Relaxed via the registry knob: imports with a warning.
+    monkeypatch.setenv('SKYTPU_HF_IMPORT_STRICT', '0')
+    params, _config, _stats = ckpt_lib.load_params(out)
+    assert 'wq' in params['layers']
+
+
+def test_untied_fixture_requires_lm_head(tmp_path):
+    out, tensors = _hf_fixture(tmp_path, tied=False)
+    params, config, _stats = ckpt_lib.load_params(out)
+    assert not config.tied_embeddings
+    np.testing.assert_array_equal(np.asarray(params['lm_head']),
+                                  tensors['lm_head.weight'].T)
+
+
+def test_wrong_geometry_names_the_tensor(tmp_path):
+    out, _ = _hf_fixture(tmp_path)
+    cfg_path = os.path.join(out, 'config.json')
+    with open(cfg_path) as fh:
+        cfg = json.load(fh)
+    cfg['num_key_value_heads'] = 2  # fixture weights are GQA-1
+    with open(cfg_path, 'w') as fh:
+        json.dump(cfg, fh)
+    with pytest.raises(hf_import.HFImportError) as err:
+        ckpt_lib.load_params(out)
+    assert 'k_proj' in str(err.value)
+
+
+# --- streaming --------------------------------------------------------------
+
+
+def test_streaming_peak_host_is_tensor_bounded(tmp_path):
+    """The acceptance bound: peak host bytes <= O(largest tensor +
+    one stacked layer slice), asserted from the import accounting —
+    on a deep-narrow model where the FULL param set is many times
+    that bound, so buffering the model would fail the assert."""
+    config = llama.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_layers=12, num_heads=4, num_kv_heads=2, head_dim=8,
+        max_seq_len=32, dtype=jnp.float32, remat=False)
+    params = llama.init_params(config, jax.random.key(1))
+    out = str(tmp_path / 'deep')
+    ckpt_lib.export_params(params, config, out,
+                           max_shard_bytes=64 * 1024)
+    restored, _config, stats = ckpt_lib.load_params(out)
+    _tree_equal(params, restored)
+    assert stats.shards > 1, 'fixture must be multi-shard'
+    total = sum(leaf.nbytes for leaf in jax.tree.leaves(params))
+    bound = stats.largest_tensor_bytes + stats.stacked_layer_bytes
+    assert stats.peak_host_bytes <= bound, (
+        f'peak {stats.peak_host_bytes} > largest-tensor+layer bound '
+        f'{bound}')
+    assert stats.peak_host_bytes * 4 < total, (
+        'peak host memory tracked O(model); streaming is broken')
+
+
+def test_concurrent_import_identical_and_bounded(tmp_path,
+                                                 monkeypatch):
+    config = llama.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_layers=8, num_heads=4, num_kv_heads=2, head_dim=8,
+        max_seq_len=32, dtype=jnp.float32, remat=False)
+    params = llama.init_params(config, jax.random.key(2))
+    out = str(tmp_path / 'conc')
+    ckpt_lib.export_params(params, config, out,
+                           max_shard_bytes=64 * 1024)
+    restored, _config, stats = ckpt_lib.load_params(out,
+                                                    concurrency=4)
+    _tree_equal(params, restored)
+    # Concurrency multiplies the in-flight layer copies, not the
+    # model: bound scales with the thread count only.
+    bound = stats.largest_tensor_bytes + 5 * stats.stacked_layer_bytes
+    assert stats.peak_host_bytes <= bound
+
+
+# --- family detection -------------------------------------------------------
+
+
+def _detect(tmp_path, cfg):
+    d = tmp_path / 'cfg'
+    d.mkdir(exist_ok=True)
+    with open(d / 'config.json', 'w') as fh:
+        json.dump(cfg, fh)
+    return ckpt_lib.detect_config(str(d))
+
+
+_BASE_CFG = dict(vocab_size=64, hidden_size=16, intermediate_size=32,
+                 num_hidden_layers=2, num_attention_heads=4,
+                 num_key_value_heads=2, max_position_embeddings=64,
+                 rope_theta=10000.0, rms_norm_eps=1e-6,
+                 torch_dtype='bfloat16')
+
+
+def test_detect_llama3_rope_scaling(tmp_path):
+    family, config = _detect(tmp_path, {
+        'model_type': 'llama', **_BASE_CFG,
+        'rope_scaling': {'rope_type': 'llama3', 'factor': 32.0,
+                         'low_freq_factor': 1.0,
+                         'high_freq_factor': 4.0,
+                         'original_max_position_embeddings': 8192}})
+    assert family == 'llama'
+    assert config.rope_scaling_factor == 32.0
+    assert config.head_dim == 4  # hidden // heads default
+    assert config.dtype == jnp.bfloat16
+
+
+def test_detect_rejects_unknown_rope_scaling(tmp_path):
+    with pytest.raises(hf_import.HFImportError):
+        _detect(tmp_path, {'model_type': 'llama', **_BASE_CFG,
+                           'rope_scaling': {'type': 'yarn',
+                                            'factor': 4.0}})
+
+
+def test_detect_gemma2(tmp_path):
+    family, config = _detect(tmp_path, {
+        'model_type': 'gemma2', **_BASE_CFG, 'head_dim': 16,
+        'attn_logit_softcapping': 50.0,
+        'final_logit_softcapping': 30.0, 'sliding_window': 32,
+        'query_pre_attn_scalar': 144.0,
+        'tie_word_embeddings': True})
+    assert family == 'gemma2'
+    assert config.norm_plus_one and config.post_norms
+    assert config.tied_embeddings and config.embed_scale
+    assert config.activation == 'gelu'
+    assert config.sliding_window == 32
+    assert config.sliding_window_pattern == 2
+    assert config.query_pre_attn_scalar == 144.0
+    assert config.head_dim == 16
+
+
+def test_detect_mistral_and_qwen2(tmp_path):
+    family, config = _detect(tmp_path, {
+        'model_type': 'mistral', **_BASE_CFG, 'sliding_window': 32})
+    assert family == 'mistral'
+    assert config.sliding_window == 32
+    assert config.sliding_window_pattern == 1
+    family, config = _detect(tmp_path, {
+        'model_type': 'qwen2', **_BASE_CFG,
+        'tie_word_embeddings': True})
+    assert family == 'qwen2'
+    assert config.attn_qkv_bias and config.tied_embeddings
+    assert config.sliding_window is None  # use_sliding_window unset
+
+
+def test_detect_unknown_family_is_loud(tmp_path):
+    with pytest.raises(hf_import.HFImportError) as err:
+        _detect(tmp_path, {'model_type': 'mamba', **_BASE_CFG})
+    assert 'mamba' in str(err.value)
+
+
+def test_detect_missing_geometry_key_is_actionable(tmp_path):
+    cfg = {'model_type': 'llama', **_BASE_CFG}
+    del cfg['intermediate_size']
+    with pytest.raises(hf_import.HFImportError) as err:
+        _detect(tmp_path, cfg)
+    assert 'intermediate_size' in str(err.value)
+
+
+def test_detect_rejects_rope_scaling_on_every_family(tmp_path):
+    """A yarn-scaled qwen2 served unscaled decodes off-distribution
+    exactly like a llama would — the guard must not be
+    family-gated."""
+    for family in ('qwen2', 'mistral', 'gemma2'):
+        with pytest.raises(hf_import.HFImportError):
+            _detect(tmp_path, {'model_type': family, **_BASE_CFG,
+                               'rope_scaling': {'type': 'yarn',
+                                                'factor': 4.0}})
+
+
+def test_detect_gemma2_explicit_null_softcaps_stay_off(tmp_path):
+    """HF treats null softcapping as DISABLED; absent means the
+    Gemma2Config default. null must not silently re-enable 50/30."""
+    _family, config = _detect(tmp_path, {
+        'model_type': 'gemma2', **_BASE_CFG, 'head_dim': 16,
+        'attn_logit_softcapping': None,
+        'final_logit_softcapping': None, 'sliding_window': None})
+    assert config.attn_logit_softcap is None
+    assert config.final_logit_softcap is None
+    assert config.sliding_window is None
+
+
+def test_detect_untied_gemma_keeps_lm_head(tmp_path):
+    """Gemma defaults to tied embeddings, but an untied finetune's
+    trained lm_head must survive detection (forcing tied would
+    silently drop it and serve embed.T logits)."""
+    _family, config = _detect(tmp_path, {
+        'model_type': 'gemma2', **_BASE_CFG, 'head_dim': 16,
+        'tie_word_embeddings': False})
+    assert not config.tied_embeddings
+    _family, config = _detect(tmp_path, {
+        'model_type': 'gemma', **_BASE_CFG, 'head_dim': 16})
+    assert config.tied_embeddings  # absent -> the gemma default
+
+
+def test_detect_rope_scaling_missing_factor(tmp_path):
+    with pytest.raises(hf_import.HFImportError) as err:
+        _detect(tmp_path, {'model_type': 'llama', **_BASE_CFG,
+                           'rope_scaling': {'rope_type': 'llama3'}})
+    assert 'factor' in str(err.value)
+
+
+def test_load_params_from_bare_safetensors_path(tmp_path):
+    """A path to model.safetensors itself (not its dir) is a valid
+    --checkpoint handle; config.json is found beside it."""
+    config = llama.CONFIGS['tiny']
+    params = llama.init_params(config, jax.random.key(6))
+    out = str(tmp_path / 'hf')
+    ckpt_lib.export_params(params, config, out)
+    shard = os.path.join(out, 'model.safetensors')
+    restored, _config, _stats = ckpt_lib.load_params(shard)
+    _tree_equal(params, restored)
+
+
+def test_reexport_removes_stale_shards_and_index(tmp_path):
+    """Re-exporting into a dir that held a multi-shard export must
+    not leave the old index authoritative (it would silently serve
+    the previous weights)."""
+    out = str(tmp_path / 'hf')
+    config = llama.CONFIGS['tiny']
+    old = llama.init_params(config, jax.random.key(1))
+    ckpt_lib.export_params(old, config, out,
+                           max_shard_bytes=200 * 1024)
+    assert os.path.exists(
+        os.path.join(out, safetensors_io.INDEX_FILENAME))
+    new = llama.init_params(config, jax.random.key(2))
+    ckpt_lib.export_params(new, config, out)  # single shard now
+    assert not os.path.exists(
+        os.path.join(out, safetensors_io.INDEX_FILENAME))
+    assert sorted(fn for fn in os.listdir(out)
+                  if fn.endswith('.safetensors')) == \
+        ['model.safetensors']
+    restored, _config, _stats = ckpt_lib.load_params(out)
+    _tree_equal(new, restored)
+
+
+def test_is_hf_checkpoint_vs_orbax(tmp_path):
+    hf_dir = tmp_path / 'hf'
+    hf_dir.mkdir()
+    (hf_dir / 'model.safetensors').write_bytes(b'')
+    assert ckpt_lib.is_hf_checkpoint(str(hf_dir))
+    orbax_dir = tmp_path / 'orbax'
+    (orbax_dir / '100').mkdir(parents=True)
+    assert not ckpt_lib.is_hf_checkpoint(str(orbax_dir))
+    assert not ckpt_lib.is_hf_checkpoint(str(tmp_path / 'nowhere'))
+
+
+# --- wiring -----------------------------------------------------------------
+
+
+def test_restore_params_delegates_hf_dirs(tmp_path):
+    """An HF dir passed where an Orbax dir is expected imports
+    instead of dying in FileNotFoundError (train-loop finetune
+    path)."""
+    from skypilot_tpu.train import checkpoints as train_ckpts
+    config = llama.CONFIGS['tiny']
+    params = llama.init_params(config, jax.random.key(5))
+    out = str(tmp_path / 'hf')
+    ckpt_lib.export_params(params, config, out)
+    restored = train_ckpts.restore_params(out, config)
+    _tree_equal(params, restored)
+
+
+def test_fit_init_checkpoint_seeds_params(tmp_path):
+    """train/loop.py --checkpoint: the finetune starts FROM the
+    imported weights (and a geometry mismatch dies loudly instead of
+    training a half-initialized model)."""
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    from skypilot_tpu.train import loop as train_loop
+    from skypilot_tpu.train import trainer as trainer_lib
+
+    config = llama.CONFIGS['tiny']
+    params = llama.init_params(config, jax.random.key(21))
+    out = str(tmp_path / 'hf')
+    ckpt_lib.export_params(params, config, out)
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(fsdp=-1))
+    cfg = trainer_lib.TrainerConfig(model='tiny', batch_size=8,
+                                    seq_len=16, max_steps=1)
+    seen = []
+    result = train_loop.fit(cfg, mesh, init_checkpoint=out,
+                            log_fn=seen.append)
+    assert any('initialized params from' in line for line in seen)
+    assert result['final_step'] == 1
+
+    bad = trainer_lib.TrainerConfig(model='tiny-gemma', batch_size=8,
+                                    seq_len=16, max_steps=1)
+    with pytest.raises(ValueError, match='geometry mismatch'):
+        train_loop.fit(bad, mesh, init_checkpoint=out)
+
+
+# --- verify CLI -------------------------------------------------------------
+
+
+def test_verify_cli_clean_and_corrupted(tmp_path, capsys):
+    out, _ = _hf_fixture(tmp_path, tied=True)
+    assert ckpt_cli.main(['verify', out]) == 0
+    assert 'VERIFY OK' in capsys.readouterr().out
+
+    # Corrupt a copy: overwrite one tensor's payload with NaNs.
+    import shutil
+    bad = str(tmp_path / 'corrupt')
+    shutil.copytree(out, bad)
+    shard = os.path.join(bad, 'model-00002-of-00002.safetensors')
+    size = os.path.getsize(shard)
+    with open(shard, 'r+b') as fh:
+        fh.seek(size - 16)
+        fh.write(struct.pack('<f', float('nan')) * 4)
+    assert ckpt_cli.main(['verify', bad]) == 1
+    report = capsys.readouterr().out
+    assert 'VERIFY FAILED' in report
+    assert 'non-finite' in report
+
+    # --against pins the diff to the tensors that changed.
+    assert ckpt_cli.main(['verify', bad, '--against', out]) == 1
+    report = capsys.readouterr().out
+    assert 'values differ' in report
+
+
+def test_single_file_checkpoint_reader(tmp_path):
+    """A lone .safetensors path (no dir, no index) is a valid
+    checkpoint handle for the reader and is_hf_checkpoint."""
+    path = str(tmp_path / 'model.safetensors')
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    safetensors_io.write_safetensors(path, {'w': arr})
+    assert ckpt_lib.is_hf_checkpoint(path)
+    with safetensors_io.CheckpointReader(path) as reader:
+        assert reader.names() == ['w']
+        np.testing.assert_array_equal(reader.tensor('w').read(), arr)
+
+
+def test_verify_catches_bf16_nan(tmp_path, capsys):
+    """bf16 — the dominant dtype of real HF checkpoints — has numpy
+    kind 'V'; the finite scan must not silently skip it."""
+    config = dataclasses.replace(llama.CONFIGS['tiny'],
+                                 dtype=jnp.bfloat16)
+    params = llama.init_params(config, jax.random.key(4))
+    out = str(tmp_path / 'bf16')
+    ckpt_lib.export_params(params, config, out)
+    assert ckpt_cli.main(['verify', out]) == 0
+    shard = os.path.join(out, 'model.safetensors')
+    size = os.path.getsize(shard)
+    with open(shard, 'r+b') as fh:
+        fh.seek(size - 16)
+        fh.write(b'\xc0\x7f' * 8)  # bf16 NaN pattern
+    capsys.readouterr()
+    assert ckpt_cli.main(['verify', out]) == 1
+    assert 'non-finite' in capsys.readouterr().out
+
+
+def test_verify_cli_truncated_shard(tmp_path):
+    out, _ = _hf_fixture(tmp_path, tied=True)
+    shard = os.path.join(out, 'model-00001-of-00002.safetensors')
+    with open(shard, 'r+b') as fh:
+        fh.truncate(os.path.getsize(shard) - 64)
+    assert ckpt_cli.main(['verify', out]) == 1
+
+
+def test_import_cli_reports_stats(tmp_path, capsys):
+    config = llama.CONFIGS['tiny']
+    params = llama.init_params(config, jax.random.key(9))
+    out = str(tmp_path / 'hf')
+    ckpt_lib.export_params(params, config, out)
+    assert ckpt_cli.main(['import', out]) == 0
+    doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert doc['rc'] == 0 and doc['tensors'] == 21
+    assert doc['peak_host_bytes'] <= doc['largest_tensor_bytes'] * 2
