@@ -524,6 +524,100 @@ def _prefix_cache_bench(jax, on_tpu: bool):
     }
 
 
+def _fused_spec_bench(jax, on_tpu: bool):
+    """Per-round vs FUSED speculative decode through the REAL engine
+    (ISSUE 13 evidence channel): same engine, same correlated draft
+    (draft == main params -> near-total acceptance, the spec
+    best-case that maximizes tokens per verify pass), only
+    spec_fuse_rounds differs — 1 (one host dispatch + output sync
+    per spec_k-token round, the pre-fusion cadence) vs the default 8
+    (one dispatch per rounds x spec_k tokens). Greedy outputs are
+    cross-checked token-for-token against per-round spec AND a
+    non-speculative engine, and membership churn against the fused
+    kernel's compile-cache size — a speedup that changed tokens or
+    recompiled per join/leave would be a lie."""
+    import functools as _ft
+
+    from skypilot_tpu import inference as inf
+    from skypilot_tpu.inference import engine as eng_lib
+    from skypilot_tpu.models import resolve
+
+    model = 'bench-8b' if on_tpu else 'tiny'
+    _family, cfg = resolve(model)
+    params = jax.jit(_ft.partial(_family.init_params, cfg))(
+        jax.random.key(0))
+    # Small batch is where the dispatch RTT (the thing fusion
+    # amortizes) dominates — the same regime the fused-decode bench
+    # targets.
+    b = 8 if on_tpu else 2
+    prompt_len = 128 if on_tpu else 8
+    new_tokens = 128 if on_tpu else 96
+    max_seq = 512 if on_tpu else 128
+    spec_k = 4
+    fuse_rounds = 8
+    prompts = [[(i * 7 + j) % 97 + 1 for j in range(prompt_len)]
+               for i in range(b)]
+
+    def build(rounds, draft=True):
+        return inf.InferenceEngine(
+            params, cfg, batch_size=b, max_seq_len=max_seq,
+            kv_quant='none',
+            draft=(params, cfg) if draft else None,
+            spec_k=spec_k, spec_fuse_rounds=rounds)
+
+    def drive(eng):
+        rids = [eng.submit(p, inf.SamplingParams(
+            temperature=0.0, max_new_tokens=new_tokens))
+            for p in prompts]
+        t0 = time.perf_counter()
+        done = eng.run_to_completion()
+        dt = time.perf_counter() - t0
+        outs = [done[r] for r in rids]
+        return sum(len(v) for v in outs), dt, outs
+
+    def measure(rounds, draft=True):
+        eng = build(rounds, draft=draft)
+        drive(eng)                       # compile + warmup
+        tokens, dt, outs = drive(eng)
+        return tokens / dt, outs
+
+    per_round_tps, per_round_out = measure(1)
+    fused_tps, fused_out = measure(fuse_rounds)
+    _, plain_out = measure(1, draft=False)
+    identical = (fused_out == per_round_out == plain_out)
+
+    # Membership churn against the warmed fused kernel: joins/leaves
+    # with different prompt lengths, budgets, and an abort must not
+    # recompile (shapes are static; churn edits VALUES).
+    churn_eng = build(fuse_rounds)
+    churn_eng.submit([3, 1, 4], inf.SamplingParams(
+        temperature=0.0, max_new_tokens=4))
+    churn_eng.run_to_completion()
+    warm = eng_lib.fused_spec_rounds._cache_size()
+    for n, budget in ((5, 3), (17, 9), (29, 6)):
+        churn_eng.submit([(n + j) % 97 + 1 for j in range(n)],
+                         inf.SamplingParams(temperature=0.0,
+                                            max_new_tokens=budget))
+        churn_eng.run_to_completion()
+    ghost = churn_eng.submit([8, 9], inf.SamplingParams(
+        temperature=0.0, max_new_tokens=40))
+    churn_eng.step()
+    churn_eng.abort(ghost)
+    churn_eng.run_to_completion()
+    churn_ok = eng_lib.fused_spec_rounds._cache_size() == warm
+
+    return {
+        'model': model, 'batch': b, 'prompt_len': prompt_len,
+        'max_new_tokens': new_tokens, 'spec_k': spec_k,
+        'spec_fuse_rounds': fuse_rounds,
+        'per_round_tokens_per_sec': round(per_round_tps, 2),
+        'fused_tokens_per_sec': round(fused_tps, 2),
+        'fused_speedup': round(fused_tps / per_round_tps, 3),
+        'greedy_outputs_identical_fused_per_round_nonspec': identical,
+        'churn_zero_recompile': churn_ok,
+    }
+
+
 def _hf_import_bench(jax, on_tpu: bool):
     """Streaming HF checkpoint import, MEASURED (ISSUE 12 evidence
     channel): export a mid-size synthetic checkpoint, then import it
@@ -642,6 +736,13 @@ def main() -> None:
 
     gc.collect()
     try:
+        _progress('fused-spec: per-round vs fused speculative decode')
+        fused_spec = _fused_spec_bench(jax, on_tpu)
+    except Exception as e:  # noqa: BLE001 — additive, like decode
+        fused_spec = {'error': f'{type(e).__name__}: {e}'}
+
+    gc.collect()
+    try:
         _progress('hf-import: streaming import wall time + peak RSS')
         hf_import = _hf_import_bench(jax, on_tpu)
     except Exception as e:  # noqa: BLE001 — additive, like decode
@@ -660,6 +761,7 @@ def main() -> None:
             'decode': decode,
             'engine_loop': engine_loop,
             'prefix_cache': prefix_cache,
+            'fused_spec': fused_spec,
             'hf_import': hf_import,
         },
     }

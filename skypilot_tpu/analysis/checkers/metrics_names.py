@@ -80,10 +80,11 @@ class MetricsNamesChecker(Checker):
                 elif any(b == math.inf for b in m.buckets):
                     emit('histogram-buckets',
                          f'{m.name}: +Inf bucket is implicit')
-                if not m.name.endswith(('_seconds', '_tokens')):
+                if not m.name.endswith(('_seconds', '_tokens',
+                                        '_per_round')):
                     emit('histogram-buckets',
                          f'{m.name}: histograms name their unit '
-                         'suffix (_seconds, _tokens)')
+                         'suffix (_seconds, _tokens, _per_round)')
             for label in m.labelnames:
                 if not _LABEL_RE.fullmatch(label) or label == 'le':
                     emit('label-names',

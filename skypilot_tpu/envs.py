@@ -228,6 +228,13 @@ SKYTPU_SPEC_K = declare(
     'SKYTPU_SPEC_K', int, 4,
     'Speculative-decoding draft length: tokens the draft model '
     'proposes per big-model verify pass when a draft is attached.')
+SKYTPU_SPEC_FUSE_ROUNDS = declare(
+    'SKYTPU_SPEC_FUSE_ROUNDS', int, 8,
+    'Speculative draft/verify rounds fused into ONE device dispatch '
+    'per engine host step (donated-buffer lax.while_loop; up to '
+    'rounds * SKYTPU_SPEC_K tokens per round-trip), aligned with '
+    'SKYTPU_DECODE_FUSE_STEPS by default. 1 falls back to one host '
+    'dispatch per speculative round.')
 
 # --- checkpoints (HF safetensors import/export) -----------------------------
 

@@ -18,6 +18,7 @@ SKYTPU_FAULTS like any other fault.
 """
 import dataclasses
 import enum
+import math
 from typing import Dict, List, Optional
 
 from skypilot_tpu.observability import instruments as obs
@@ -65,6 +66,26 @@ class ReplicaProfile:
     prefix_hit_ratio: float = 0.0      # 0 = no prefix-cache modeling
     warm_ttft_factor: float = 0.12     # warm TTFT / cold TTFT
     shared_prefix_tokens: int = 0      # reused tokens per hit
+    # Speculative decode term (ISSUE 13): spec_k > 0 models fused
+    # draft-propose/verify rounds — each round the draft proposes
+    # spec_k tokens, a leading run of Bernoulli(spec_accept_prob)
+    # matches is accepted (plus the big-model correction on a
+    # mismatch), and hits land in the REAL skytpu_spec_* counters so
+    # the spec_decode scenario's acceptance-ratio SLO reads the same
+    # series a production engine exports. Host dispatches cover
+    # spec_fuse_rounds rounds each; decode_step_s stays the
+    # per-DISPATCH latency knob (one skytpu_decode_step_seconds
+    # sample per dispatch, like the fused engine).
+    spec_k: int = 0                    # 0 = no speculative modeling
+    spec_accept_prob: float = 0.0      # per-draft-token match prob
+    spec_fuse_rounds: int = 8          # rounds per host dispatch
+
+    def spec_mean_emit(self) -> float:
+        """Expected tokens one speculative round emits (accepted
+        leading run + the correction on a mismatch, capped at k)."""
+        p, k = self.spec_accept_prob, self.spec_k
+        e_m = sum(p ** j for j in range(1, k + 1))
+        return min(float(k), e_m + 1.0 - p ** k)
 
     def service_mean_s(self) -> float:
         """Mean busy time one request costs a decode slot."""
@@ -73,6 +94,11 @@ class ReplicaProfile:
             ttft *= (1.0 - self.prefix_hit_ratio
                      * (1.0 - self.warm_ttft_factor))
         if self.decode_step_s > 0:
+            if self.spec_k > 0:
+                rounds = math.ceil(self.tokens_median
+                                   / max(self.spec_mean_emit(), 1.0))
+                dispatches = -(-rounds // self.spec_fuse_rounds)
+                return ttft + dispatches * self.decode_step_s
             host_steps = -(-self.tokens_median // self.fused_steps)
             return ttft + host_steps * self.decode_step_s
         return ttft + self.tokens_median * self.decode_per_token_s
@@ -284,7 +310,36 @@ class SimFleet:
         ttft /= max(0.05, 1.0 - min(rho, 0.95))
         tokens = max(1, int(self._rng.lognormvariate(
             _mu(float(p.tokens_median)), 0.5)))
-        if p.decode_step_s > 0:
+        if p.decode_step_s > 0 and p.spec_k > 0:
+            # Fused-SPECULATIVE parameterization: rounds propose
+            # spec_k drafts, accept a leading Bernoulli run (+ the
+            # correction), and land in the REAL skytpu_spec_*
+            # counters; one host dispatch covers spec_fuse_rounds
+            # rounds and observes one decode-step sample — the two
+            # signals the spec_decode scenario's SLOs gate.
+            decode = 0.0
+            remaining = tokens
+            while remaining > 0:
+                for _ in range(max(1, p.spec_fuse_rounds)):
+                    if remaining <= 0:
+                        break
+                    m = 0
+                    while (m < p.spec_k
+                           and self._rng.random() < p.spec_accept_prob):
+                        m += 1
+                    emit = p.spec_k if m >= p.spec_k else m + 1
+                    emit = min(emit, remaining)
+                    obs.SPEC_ROUNDS.inc()
+                    obs.SPEC_PROPOSED_TOKENS.inc(p.spec_k)
+                    obs.SPEC_ACCEPTED_TOKENS.inc(min(m, emit))
+                    obs.SPEC_ACCEPTED_PER_ROUND.observe(min(m, emit))
+                    remaining -= emit
+                step = self._rng.lognormvariate(_mu(p.decode_step_s),
+                                                p.decode_step_sigma)
+                obs.DECODE_STEP_SECONDS.observe(step)
+                decode += step
+            total = ttft + decode
+        elif p.decode_step_s > 0:
             # Fused-loop parameterization: the request decodes as
             # ceil(tokens / fused_steps) host rounds, each observed
             # into the engine's decode-step histogram — the signal
@@ -334,5 +389,4 @@ class SimFleet:
 
 def _mu(median: float) -> float:
     """ln(median) — the lognormal mu that yields this median."""
-    import math
     return math.log(max(median, 1e-9))

@@ -395,6 +395,51 @@ register(Scenario(
 ))
 
 register(Scenario(
+    name='spec_decode',
+    description=('Fused speculative decode gate (ISSUE 13): replicas '
+                 'model device-resident draft/verify rounds (spec_k '
+                 'drafts per round, a leading Bernoulli run '
+                 'accepted, spec_fuse_rounds rounds per host '
+                 'dispatch). SLOs gate the decode-step p95 AND the '
+                 'draft acceptance ratio from deltas of the REAL '
+                 'skytpu_spec_* counters — the same series the '
+                 'engine exports. A mid-run burst must not break '
+                 'either.'),
+    replicas=60,
+    duration_s=120.0, tick_s=2.0, warmup_s=30.0,
+    traffic={'kind': 'burst',
+             'inner': {'kind': 'constant', 'qps': 120.0},
+             'burst_qps': 60.0, 'at': 70.0, 'duration_s': 30.0},
+    profile=replicas_lib.ReplicaProfile(
+        startup_median_s=6.0, startup_sigma=0.3,
+        ttft_median_s=0.3, ttft_sigma=0.4,
+        tokens_median=48, concurrency=8,
+        # One host dispatch = up to 8 fused spec rounds; the v5e
+        # fused-round anchor scaled for the deeper on-device loop.
+        decode_step_s=0.12, decode_step_sigma=0.3,
+        spec_k=4, spec_accept_prob=0.8, spec_fuse_rounds=8),
+    policy={'max_replicas': 80, 'target_qps_per_replica': 3.0,
+            'target_queue_per_replica': 4.0,
+            'upscale_delay_seconds': 10,
+            'downscale_delay_seconds': 120},
+    lb_policy='round_robin',
+    slos=(
+        slo_lib.HistQuantileBelow(
+            'decode_step_p95', threshold=0.25,
+            metric='skytpu_decode_step_seconds'),
+        # The acceptance-ratio gate, from counter DELTAS of the same
+        # skytpu_spec_* series a production spec engine exports:
+        # E[leading 0.8-run capped at 4] / 4 ~= 0.59 steady-state.
+        slo_lib.CounterRatioAbove(
+            'spec_acceptance', threshold=0.45,
+            num_metric='skytpu_spec_accepted_tokens_total',
+            den_metrics=('skytpu_spec_proposed_tokens_total',)),
+        slo_lib.HistQuantileBelow('ttft_p95', threshold=2.0),
+        slo_lib.RatioBelow('error_rate', threshold=0.005),
+    ),
+))
+
+register(Scenario(
     name='shared_prefix',
     description=('Prefix-cache gate (ROADMAP item 3 / ISSUE 11): '
                  'traffic dominated by shared system-prompt prefixes '

@@ -10,11 +10,12 @@ from typing import Optional
 from skypilot_tpu.inference.engine import (DecodeState, InferenceEngine,
                                            SamplingParams, decode_step,
                                            fused_decode_steps,
+                                           fused_spec_rounds,
                                            init_cache, prefill)
 
 __all__ = ['DecodeState', 'InferenceEngine', 'SamplingParams',
            'build_engine', 'decode_step', 'fused_decode_steps',
-           'init_cache', 'prefill']
+           'fused_spec_rounds', 'init_cache', 'prefill']
 
 
 def build_engine(model: str, *, checkpoint: Optional[str] = None,
@@ -26,6 +27,7 @@ def build_engine(model: str, *, checkpoint: Optional[str] = None,
                  draft_model: Optional[str] = None,
                  draft_checkpoint: Optional[str] = None,
                  spec_k: Optional[int] = None,
+                 spec_fuse_rounds: Optional[int] = None,
                  decode_fuse_steps: Optional[int] = None,
                  kv_page_size: Optional[int] = None,
                  kv_pages: Optional[int] = None,
@@ -84,6 +86,7 @@ def build_engine(model: str, *, checkpoint: Optional[str] = None,
                            kv_quant=kv_quant,
                            prefill_interleave=prefill_interleave,
                            draft=draft, spec_k=spec_k,
+                           spec_fuse_rounds=spec_fuse_rounds,
                            decode_fuse_steps=decode_fuse_steps,
                            kv_page_size=kv_page_size,
                            kv_pages=kv_pages,

@@ -93,6 +93,20 @@ def main() -> None:
     parser.add_argument('--top-k', type=int, default=0)
     parser.add_argument('--mesh', default=None,
                         help='Shard over a device mesh, e.g. tensor=8')
+    parser.add_argument('--draft-model', default=None,
+                        help='Speculative decoding: a small same-vocab '
+                             'draft model proposes spec-k tokens per '
+                             'big-model verify pass (greedy requests; '
+                             'lossless). See inference.server --help.')
+    parser.add_argument('--draft-checkpoint', default=None)
+    parser.add_argument('--spec-k', type=int, default=None,
+                        help='Draft tokens per speculative round '
+                             '(default: SKYTPU_SPEC_K).')
+    parser.add_argument('--spec-fuse-rounds', type=int, default=None,
+                        help='Speculative rounds fused into one '
+                             'device dispatch per host step (default: '
+                             'SKYTPU_SPEC_FUSE_ROUNDS; 1 = one '
+                             'dispatch per round).')
     parser.add_argument('--kv-quant', default='auto',
                         choices=['auto', 'none', 'int8'],
                         help='int8 KV cache (see inference.server '
@@ -133,6 +147,10 @@ def main() -> None:
         args.model, checkpoint=args.checkpoint, mesh_arg=args.mesh,
         batch_size=args.batch_size, max_seq_len=args.max_seq_len,
         kv_quant=args.kv_quant,
+        draft_model=args.draft_model,
+        draft_checkpoint=args.draft_checkpoint,
+        spec_k=args.spec_k,
+        spec_fuse_rounds=args.spec_fuse_rounds,
         decode_fuse_steps=args.decode_fuse_steps,
         kv_page_size=args.kv_page_size, kv_pages=args.kv_pages,
         prefix_cache=(None if args.prefix_cache == 'auto'

@@ -23,6 +23,13 @@ TPU-first design:
   indexed through per-slot block tables, so sequences join and leave
   the continuous batch by editing table VALUES — shapes never change,
   membership churn compiles nothing.
+- Speculative decode is device-resident too: with a draft attached,
+  `fused_spec_rounds` runs up to SKYTPU_SPEC_FUSE_ROUNDS full
+  draft-propose/verify/accept rounds inside one donated-buffer
+  lax.while_loop, so a single host dispatch emits up to
+  N*spec_k tokens per slot and the fused-loop and speculative gains
+  COMPOUND instead of the spec path dropping back to one dispatch
+  (plus a blocking length sync) per round.
 
 Reference analog: none — SkyPilot recipes shell out to vLLM
 (llm/vllm/serve.yaml:26); this replaces that external dependency with a
@@ -38,6 +45,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from skypilot_tpu import envs
@@ -947,20 +955,48 @@ def fused_decode_steps(params: Params, cache: Cache,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=('k', 'config', 'draft_config'))
-def spec_step(params: Params, cache: Cache, draft_params: Params,
-              draft_cache: Cache, last_tokens: jax.Array,
-              active: jax.Array, k: int,
-              config: llama.LlamaConfig, draft_config: llama.LlamaConfig
-              ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array,
-                         Cache, Cache]:
-    """One GREEDY speculative round: the draft model proposes k tokens
-    (k cheap sequential decodes inside a lax.scan), the big model
-    verifies them in ONE [B, k] forward, and the longest matching
-    prefix (plus the big model's correction on the first mismatch) is
-    emitted — lossless: outputs are token-for-token what plain greedy
-    decode produces (oracle-tested), at up to k tokens per big-model
-    pass.
+                   static_argnames=('k', 'n_rounds', 'config',
+                                    'draft_config'),
+                   donate_argnums=(1, 3, 4))
+def fused_spec_rounds(params: Params, cache: Cache,
+                      draft_params: Params, draft_cache: Cache,
+                      last_tokens: jax.Array, active: jax.Array,
+                      eos_ids: jax.Array, budgets: jax.Array,
+                      max_len: jax.Array, slab_cap: jax.Array,
+                      config: llama.LlamaConfig,
+                      draft_config: llama.LlamaConfig,
+                      k: int, n_rounds: int
+                      ) -> Tuple[jax.Array, jax.Array, jax.Array,
+                                 jax.Array, jax.Array, jax.Array,
+                                 jax.Array, Cache, Cache]:
+    """Up to `n_rounds` GREEDY speculative rounds per HOST dispatch:
+    the device-resident speculative decode loop.
+
+    One ROUND is the draft-propose/verify/accept cycle: the draft
+    model proposes k tokens (k cheap sequential decodes inside a
+    lax.scan), the big model verifies them in ONE [B, k] forward, and
+    the longest matching prefix (plus the big model's correction on
+    the first mismatch) is emitted — lossless: outputs are
+    token-for-token what plain greedy decode produces (oracle-tested),
+    at up to k tokens per big-model pass. Pre-fusion the engine paid
+    one host dispatch PLUS a blocking `device_get(cache['length'])`
+    sync per round, so the measured spec gain and the fused-loop gain
+    never compounded; this runs the rounds inside a lax.while_loop
+    with the MAIN and DRAFT caches and the last-token buffer DONATED,
+    emitting up to n_rounds*k tokens per slot per round-trip and
+    returning only per-slot emitted tokens/logprobs/counts — the same
+    contract as `fused_decode_steps`.
+
+    Per-slot bounds live ON DEVICE, mirroring the host's semantics
+    exactly: the budget caps each round's emission (then deactivates),
+    the first eos inside the budgeted span ends the request AT the
+    eos, `max_len` deactivates at the cache-full eviction bound, and
+    when any live slot's next k-wide verify slab would no longer fit
+    `slab_cap` (the padded per-slot capacity) the WHOLE batch ends
+    its burst without that round — the host then re-dispatches down
+    the plain-decode path, exactly where the pre-fusion host-side
+    length check would have sent it. The loop exits early once every
+    slot has deactivated.
 
     Cache bookkeeping rides the engine's length-masking design: both
     models' caches hold keys for every token they were FED; after
@@ -970,55 +1006,143 @@ def spec_step(params: Params, cache: Cache, draft_params: Params,
     emitted tail then equals the last drafted token, keeping the
     draft/big caches position-aligned without a catch-up pass.
 
-    Returns (tokens [B,k], logprobs [B,k], emit_count [B],
-    new_last_tokens [B], cache, draft_cache).
+    Returns (tokens [B, n_rounds*k] packed per slot, logprobs
+    [B, n_rounds*k], emitted [B], new_last_tokens [B], rounds_run
+    (scalar), proposed_tokens (scalar), accepted [B, n_rounds]
+    drafted-tokens-accepted per round (-1 where the slot sat out),
+    cache, draft_cache).
     """
-    def draft_body(carry, _):
-        dc, last = carry
-        lengths = dc['length']
-        logits, dc = _forward_with_cache(
-            draft_params, last[:, None], dc, lengths[:, None], lengths,
-            jnp.where(active, lengths + 1, lengths), draft_config)
-        nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
-        nxt = jnp.where(active, nxt, last)
-        return (dc, nxt), nxt
-
-    (draft_cache, _), drafts = lax.scan(
-        draft_body, (draft_cache, last_tokens), None, length=k)
-    drafts = jnp.swapaxes(drafts, 0, 1)              # [B, k]
-
-    # Verify: feed [last, d1..d_{k-1}] at positions L..L+k-1 — the
-    # logits at step j predict position L+j+1, i.e. the token d_{j+1}
-    # claims to be.
-    L = cache['length']
-    inputs = jnp.concatenate([last_tokens[:, None], drafts[:, :k - 1]],
-                             axis=1)                 # [B, k]
-    positions = L[:, None] + jnp.arange(k)[None]
-    logits, cache = _forward_with_cache(
-        params, inputs, cache, positions, L,
-        jnp.where(active, L + k, L), config)         # [B, k, V]
-    preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)   # [B, k]
-    lps = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-
-    match = (drafts == preds)
-    # m = longest matching prefix length in [0, k].
-    m = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
-    emit = jnp.where(m < k, m + 1, k)                # correction or full
+    b = last_tokens.shape[0]
+    width = n_rounds * k
     idx = jnp.arange(k)[None]
-    corr = jnp.take_along_axis(preds, jnp.minimum(m, k - 1)[:, None],
-                               axis=1)[:, 0]         # pred at pos m
-    tokens_out = jnp.where(idx < m[:, None], drafts,
-                           jnp.where(idx == m[:, None], corr[:, None],
-                                     0))
-    chosen_lp = jnp.take_along_axis(
-        lps, tokens_out[..., None], axis=-1)[..., 0]  # [B, k]
-    new_last = jnp.where(m < k, corr, drafts[:, k - 1])
-    new_last = jnp.where(active, new_last, last_tokens)
-    new_len = jnp.where(active, L + emit, L)
-    cache['length'] = new_len
-    draft_cache['length'] = new_len
-    emit = jnp.where(active, emit, 0)
-    return tokens_out, chosen_lp, emit, new_last, cache, draft_cache
+
+    def cond(carry):
+        # while_loop, not fori_loop: once EVERY slot has deactivated
+        # (eos/budget/cache/slab bound) the remaining rounds would be
+        # k+1 dead forward passes each — exit instead.
+        r = carry[0]
+        act = carry[4]
+        return (r < n_rounds) & jnp.any(act)
+
+    def body(carry):
+        (r, cache, draft_cache, last, act, emitted, toks, lps,
+         accepted, proposed) = carry
+        L = cache['length']
+
+        def draft_body(dcarry, _):
+            dc, dlast = dcarry
+            lengths = dc['length']
+            logits, dc = _forward_with_cache(
+                draft_params, dlast[:, None], dc, lengths[:, None],
+                lengths, jnp.where(act, lengths + 1, lengths),
+                draft_config)
+            nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+            nxt = jnp.where(act, nxt, dlast)
+            return (dc, nxt), nxt
+
+        (draft_cache, _), drafts = lax.scan(
+            draft_body, (draft_cache, last), None, length=k)
+        drafts = jnp.swapaxes(drafts, 0, 1)          # [B, k]
+
+        # Verify: feed [last, d1..d_{k-1}] at positions L..L+k-1 —
+        # the logits at step j predict position L+j+1, i.e. the token
+        # d_{j+1} claims to be.
+        inputs = jnp.concatenate([last[:, None], drafts[:, :k - 1]],
+                                 axis=1)             # [B, k]
+        positions = L[:, None] + jnp.arange(k)[None]
+        logits, cache = _forward_with_cache(
+            params, inputs, cache, positions, L,
+            jnp.where(act, L + k, L), config)        # [B, k, V]
+        preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        lp_full = jax.nn.log_softmax(logits.astype(jnp.float32),
+                                     axis=-1)
+
+        match = (drafts == preds)
+        # m = longest matching prefix length in [0, k].
+        m = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                    axis=1)
+        emit = jnp.where(m < k, m + 1, k)            # corr or full
+        corr = jnp.take_along_axis(preds,
+                                   jnp.minimum(m, k - 1)[:, None],
+                                   axis=1)[:, 0]     # pred at pos m
+        tokens_out = jnp.where(idx < m[:, None], drafts,
+                               jnp.where(idx == m[:, None],
+                                         corr[:, None], 0))
+        chosen_lp = jnp.take_along_axis(
+            lp_full, tokens_out[..., None], axis=-1)[..., 0]
+
+        # Truncate exactly like the host's per-round append loop did
+        # (the remaining budget bounds the range, and the first eos
+        # INSIDE that range ends the emission at the eos — tokens
+        # past it within the round are discarded) PLUS the cache-full
+        # bound non-spec decode enforces: emission stops once
+        # new_len reaches max_len, so spec output stays
+        # token-for-token identical to non-spec decode even when the
+        # CACHE (not eos/budget) ends the request.
+        emit_b = jnp.minimum(emit, jnp.maximum(budgets - emitted, 0))
+        emit_b = jnp.minimum(emit_b, jnp.maximum(max_len - L, 0))
+        is_eos = (tokens_out == eos_ids[:, None]) & \
+            (idx < emit_b[:, None])
+        has_eos = jnp.any(is_eos, axis=1)
+        emit_eff = jnp.where(has_eos,
+                             jnp.argmax(is_eos, axis=1) + 1, emit_b)
+        emit_eff = jnp.where(act, emit_eff, 0)
+
+        # Pack this round's tokens at each slot's running offset.
+        # Positions past emit_eff hold garbage that the NEXT round's
+        # write (whose base advances by emit_eff) overwrites; the
+        # final tail beyond `emitted` is never read by the host.
+        rows = jnp.arange(b)[:, None]
+        cols = emitted[:, None] + idx
+        toks = toks.at[rows, cols].set(tokens_out)
+        lps = lps.at[rows, cols].set(chosen_lp)
+
+        new_len = jnp.where(act, L + emit_eff, L)
+        cache['length'] = new_len
+        draft_cache['length'] = new_len
+        last_tok = jnp.take_along_axis(
+            tokens_out, jnp.clip(emit_eff - 1, 0, k - 1)[:, None],
+            axis=1)[:, 0]
+        last = jnp.where(act & (emit_eff > 0), last_tok, last)
+        # Acceptance accounting (the skytpu_spec_* instruments):
+        # accepted counts DRAFTED tokens emitted — the big-model
+        # correction was not drafted and is excluded.
+        accepted = accepted.at[:, r].set(
+            jnp.where(act, jnp.minimum(m, emit_eff), -1))
+        proposed = proposed + k * jnp.sum(act.astype(jnp.int32))
+        emitted = emitted + emit_eff
+
+        # Deactivate AFTER emitting (the eos itself is reported);
+        # max_len mirrors _evict_finished's cache-full inequality and
+        # `fits` is the verify-slab bound that replaced the host-side
+        # length sync. The slab bound ends the burst for the WHOLE
+        # batch, not just the near-full slot: an inactive-but-alive
+        # slot would keep receiving k-wide verify writes in later
+        # rounds, and on a DENSE cache the dynamic_update_slice clamp
+        # would shift them onto visible positions — corrupting keys a
+        # slot that resumes via plain decode still reads. (done slots
+        # are safe either way: the host evicts them this same step,
+        # so their rows are never read again.) This is exactly where
+        # the pre-fusion host check sent the whole batch too.
+        done = has_eos | (emitted >= budgets) | (new_len >= max_len)
+        act = act & ~done
+        all_fit = jnp.all(jnp.where(act, (new_len + k) <= slab_cap,
+                                    True))
+        act = act & all_fit
+        return (r + 1, cache, draft_cache, last, act, emitted, toks,
+                lps, accepted, proposed)
+
+    toks = jnp.zeros((b, width), jnp.int32)
+    lps = jnp.zeros((b, width), jnp.float32)
+    accepted = jnp.full((b, n_rounds), -1, jnp.int32)
+    (rounds, cache, draft_cache, last, _act, emitted, toks, lps,
+     accepted, proposed) = lax.while_loop(
+        cond, body,
+        (jnp.int32(0), cache, draft_cache, last_tokens, active,
+         jnp.zeros((b,), jnp.int32), toks, lps, accepted,
+         jnp.int32(0)))
+    return (toks, lps, emitted, last, rounds, proposed, accepted,
+            cache, draft_cache)
 
 
 @dataclasses.dataclass
@@ -1090,7 +1214,9 @@ class InferenceEngine:
     (SKYTPU_DECODE_FUSE_STEPS), paged KV allocation on unsharded
     engines (SKYTPU_KV_PAGE_SIZE), interleaved prefill for long
     prompts, int8 KV on TPU (SKYTPU_KV_QUANT=auto), and — when a draft
-    model is attached — speculative rounds for greedy batches. Every
+    model is attached — device-resident speculative rounds for greedy
+    batches (SKYTPU_SPEC_FUSE_ROUNDS draft/verify rounds per host
+    dispatch). Every
     default is env-overridable through the envs.py registry; explicit
     constructor arguments win over both.
     """
@@ -1106,6 +1232,7 @@ class InferenceEngine:
                  prefill_interleave: Optional[int] = None,
                  draft: Optional[Tuple[Params, Any]] = None,
                  spec_k: Optional[int] = None,
+                 spec_fuse_rounds: Optional[int] = None,
                  decode_fuse_steps: Optional[int] = None,
                  kv_page_size: Optional[int] = None,
                  kv_pages: Optional[int] = None,
@@ -1211,14 +1338,20 @@ class InferenceEngine:
             # zero-progress prefill loop forever.
             prefill_interleave = 0
         # Speculative decoding (draft-propose / big-verify, greedy,
-        # lossless — see spec_step). v1 scope: the draft cache must
-        # track every prompt, which the one-shot prefill path does;
+        # lossless — see fused_spec_rounds). v1 scope: the draft cache
+        # must track every prompt, which the one-shot prefill does;
         # interleaved prefill is disabled when a draft is attached.
         self._draft_params = self._draft_config = None
         if spec_k is None:
             spec_k = envs.SKYTPU_SPEC_K.get()
         self.spec_k = int(spec_k)
         spec_k = self.spec_k
+        # Speculative rounds fused per host dispatch (device-resident
+        # draft/verify loop); 1 = one dispatch per spec_k-token round
+        # (the pre-fusion cadence).
+        if spec_fuse_rounds is None:
+            spec_fuse_rounds = envs.SKYTPU_SPEC_FUSE_ROUNDS.get()
+        self.spec_fuse_rounds = max(1, int(spec_fuse_rounds))
         if draft is not None:
             dparams, dconfig = draft
             if dconfig.vocab_size != config.vocab_size:
@@ -1815,38 +1948,87 @@ class InferenceEngine:
             if j >= published_upto and p not in shared_set)
         self._enforce_cache_cap()
 
+    def _slot_bounds(self) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """Per-slot device bounds shared by BOTH fused kernels
+        (plain decode and speculative): remaining token budgets,
+        eos ids (-1 = none, tokens are non-negative), and the
+        cache-full deactivation length. One construction site — spec
+        and non-spec emission bounds must never desynchronize, or the
+        token-for-token equivalence between the two paths breaks.
+
+        The cache-full bound is EXACTLY the host's eviction
+        inequality: _evict_finished stops at prompt_len + generated
+        >= max_seq_len - 1, and length = prompt_len + generated - 1
+        (the first token is sampled from prefill without a cache
+        write), so the device must deactivate at new_lengths >=
+        max_seq_len - 2 — one off and a fused round emits a token
+        host-stepped decode would not."""
+        slots = self.state.slots
+        budgets = jnp.array(
+            [max(0, s.params.max_new_tokens - len(s.generated))
+             if (s is not None and s.pending is None) else 0
+             for s in slots], jnp.int32)
+        eos_arr = jnp.array(
+            [s.params.eos_token_id
+             if (s is not None and s.pending is None
+                 and s.params.eos_token_id is not None) else -1
+             for s in slots], jnp.int32)
+        max_len = jnp.int32(self.state.max_seq_len - 2)
+        return budgets, eos_arr, max_len
+
     def _spec_round(self, active_mask: List[bool]) -> None:
+        """ONE speculative host dispatch: up to `spec_fuse_rounds`
+        draft/verify rounds run device-resident (fused_spec_rounds),
+        emitting up to spec_fuse_rounds * spec_k tokens per slot.
+        Budget/eos truncation happens ON DEVICE, so the host loop
+        appends exactly `emitted[i]` tokens — same contract as the
+        fused decode path."""
+        slots = self.state.slots
         active = jnp.array(active_mask)
+        budgets, eos_arr, max_len = self._slot_bounds()
+        slab_cap = jnp.int32(self._capacity)
         t_step = time.perf_counter()
         with self._mesh_ctx():
-            (tokens_out, lps_out, emit, new_last, self.state.cache,
-             self.state.draft_cache) = spec_step(
+            (toks, lps, emitted_dev, new_last, rounds_dev,
+             proposed_dev, accepted_dev, self.state.cache,
+             self.state.draft_cache) = fused_spec_rounds(
                 self.params, self.state.cache, self._draft_params,
                 self.state.draft_cache, self.state.last_tokens,
-                active, self.spec_k, self.config, self._draft_config)
+                active, eos_arr, budgets, max_len, slab_cap,
+                config=self.config, draft_config=self._draft_config,
+                k=self.spec_k, n_rounds=self.spec_fuse_rounds)
         self.state.last_tokens = new_last
-        toks_host, lps_host, emit_host = jax.device_get(
-            (tokens_out, lps_out, emit))
+        # ONE host sync for every output — the speculative hot path
+        # issues no other device->host transfer (the per-round length
+        # check that used to block here reads host bookkeeping now).
+        (toks_host, lps_host, emit_host, rounds_host, proposed_host,
+         acc_host) = jax.device_get(
+            (toks, lps, emitted_dev, rounds_dev, proposed_dev,
+             accepted_dev))
         obs.DECODE_STEP_SECONDS.observe(time.perf_counter() - t_step)
         obs.DECODE_HOST_STEPS.inc()
         self._fused_dispatches += 1
+        obs.SPEC_ROUNDS.inc(int(rounds_host))
+        obs.SPEC_PROPOSED_TOKENS.inc(int(proposed_host))
+        # acc_host is numpy off the single device_get; -1 marks
+        # (slot, round) cells the slot sat out. Acceptance values are
+        # bounded ints in [0, spec_k], so the histogram drain is
+        # O(spec_k) bulk observes (one lock acquire per distinct
+        # value), never a per-(slot, round) python loop on the
+        # dispatch path.
+        valid = acc_host[acc_host >= 0]
+        for value, n in zip(*np.unique(valid, return_counts=True)):
+            obs.SPEC_ACCEPTED_PER_ROUND.observe_count(float(value),
+                                                      int(n))
+        obs.SPEC_ACCEPTED_TOKENS.inc(int(valid.sum()))
         emitted = 0
-        for i, slot in enumerate(self.state.slots):
+        for i, slot in enumerate(slots):
             if slot is None or slot.pending is not None:
                 continue
-            s = slot.params
-            budget = s.max_new_tokens - len(slot.generated)
-            for j in range(min(int(emit_host[i]), budget)):
-                tok = int(toks_host[i, j])
-                slot.generated.append(tok)
+            for j in range(int(emit_host[i])):
+                slot.generated.append(int(toks_host[i, j]))
                 slot.logprobs.append(float(lps_host[i, j]))
                 emitted += 1
-                if (s.eos_token_id is not None
-                        and tok == s.eos_token_id):
-                    # Tokens past eos within the round are discarded;
-                    # the slot evicts right after (length zeroed), so
-                    # the cache's extra keys are never visible.
-                    break
         if emitted:
             obs.GENERATED_TOKENS.inc(emitted)
             obs.DECODE_TOKENS_PER_STEP.observe(emitted)
@@ -1919,15 +2101,27 @@ class InferenceEngine:
                 and all(s.params.temperature <= 0.0
                         for s in self.state.slots
                         if s is not None and s.pending is None)):
-            # Greedy batch + draft attached: speculative round
-            # (lossless; up to spec_k tokens per big-model pass).
-            # Near the cache end the k-wide verify slab would CLAMP
-            # (dynamic_update_slice) and silently overwrite valid
-            # keys — fall back to plain decode for the step instead;
-            # the near-full slot evicts via the `full` bound shortly.
-            padded = cache_capacity(self.state.cache)
-            lengths_host = jax.device_get(self.state.cache['length'])
-            if all(int(lengths_host[i]) + self.spec_k <= padded
+            # Greedy batch + draft attached: fused speculative rounds
+            # (lossless; up to spec_fuse_rounds * spec_k tokens per
+            # big-model dispatch). Near the cache end the k-wide
+            # verify slab would CLAMP (dynamic_update_slice) and
+            # silently overwrite valid keys — fall back to plain
+            # decode for the step instead; the near-full slot evicts
+            # via the `full` bound shortly. The bound reads HOST slot
+            # bookkeeping (a decoding slot's device length is exactly
+            # prompt_len + generated - 1: prefill wrote the prompt,
+            # the first token was sampled without a cache write, and
+            # every later emission advanced length with it) — the
+            # blocking device_get this check used to issue was one
+            # extra RTT on every speculative round.
+            padded = self._capacity
+
+            def _slab_fits(i: int) -> bool:
+                s = self.state.slots[i]
+                return (s.prompt_len + len(s.generated) - 1
+                        + self.spec_k) <= padded
+
+            if all(_slab_fits(i)
                    for i, on in enumerate(active_mask) if on):
                 self._spec_round(active_mask)
                 self._evict_finished()
@@ -1946,25 +2140,10 @@ class InferenceEngine:
         active = jnp.array(active_mask)
         # Device-resident decode: ONE dispatch + ONE sync for up to
         # decode_fuse_steps tokens per slot. Per-slot eos/budget/
-        # cache-full bounds ride along so the fused round never
+        # cache-full bounds ride along (shared with the speculative
+        # kernel — see _slot_bounds) so the fused round never
         # over-generates past what host-stepped decode would emit.
-        budgets = jnp.array(
-            [max(0, s.params.max_new_tokens - len(s.generated))
-             if (s is not None and s.pending is None) else 0
-             for s in self.state.slots], jnp.int32)
-        eos_arr = jnp.array(
-            [s.params.eos_token_id
-             if (s is not None and s.pending is None
-                 and s.params.eos_token_id is not None) else -1
-             for s in self.state.slots], jnp.int32)
-        # Cache-full bound, EXACTLY the host's eviction inequality:
-        # _evict_finished stops at prompt_len + generated >=
-        # max_seq_len - 1, and length = prompt_len + generated - 1
-        # (the first token is sampled from prefill without a cache
-        # write), so the device must deactivate at new_lengths >=
-        # max_seq_len - 2 — one off and the fused round emits a token
-        # host-stepped decode would not.
-        max_len = jnp.int32(self.state.max_seq_len - 2)
+        budgets, eos_arr, max_len = self._slot_bounds()
         t_step = time.perf_counter()
         with self._mesh_ctx():
             toks, lps, emitted_dev, new_last, self.state.cache = \

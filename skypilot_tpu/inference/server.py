@@ -16,8 +16,11 @@ every step so new requests join the running decode batch mid-flight —
 continuous batching across concurrent HTTP requests, not serialized
 whole generations. Per-step progress snapshots feed token streaming;
 one engine step is a fused device round that can emit SEVERAL tokens
-per slot, so the streaming drain pushes every not-yet-sent token, not
-one per tick, and aborts are re-applied right after each round.
+per slot (up to decode-fuse-steps, or spec-fuse-rounds x spec-k when
+a draft model runs fused speculative bursts), so the streaming drain
+pushes every not-yet-sent token, not one per tick, and aborts are
+re-applied right after each round — a client that vanishes mid-burst
+frees its slot before the next burst.
 
 Token-id interface: tokenization happens client-side (transformers is
 available on dev boxes; the serving host stays tokenizer-free and the
@@ -236,6 +239,16 @@ def create_app(engine_holder: Dict[str, Any]):
                     'evictions':
                         int(obs.PREFIX_CACHE_EVICTIONS.value()),
                 },
+                # Speculative decode visibility (zeros without a
+                # draft model): acceptance rate over a window is the
+                # accepted/proposed counter-delta ratio.
+                'spec': {
+                    'rounds': int(obs.SPEC_ROUNDS.value()),
+                    'proposed_tokens':
+                        int(obs.SPEC_PROPOSED_TOKENS.value()),
+                    'accepted_tokens':
+                        int(obs.SPEC_ACCEPTED_TOKENS.value()),
+                },
             }
         return web.json_response(doc, status=200 if ok else 503)
 
@@ -401,6 +414,13 @@ def main() -> None:
     parser.add_argument('--spec-k', type=int, default=None,
                         help='Draft tokens per speculative round '
                              '(default: SKYTPU_SPEC_K).')
+    parser.add_argument('--spec-fuse-rounds', type=int, default=None,
+                        help='Speculative draft/verify rounds fused '
+                             'into one device dispatch per host step '
+                             '(donated-buffer lax.while_loop; up to '
+                             'rounds x spec-k tokens per round-trip). '
+                             'Default: SKYTPU_SPEC_FUSE_ROUNDS (8); '
+                             '1 = one dispatch per round.')
     parser.add_argument('--prefill-interleave', type=int,
                         default=None,
                         help='Prompts longer than this prefill one '
@@ -477,6 +497,7 @@ def main() -> None:
             draft_model=args.draft_model,
             draft_checkpoint=args.draft_checkpoint,
             spec_k=args.spec_k,
+            spec_fuse_rounds=args.spec_fuse_rounds,
             decode_fuse_steps=args.decode_fuse_steps,
             kv_page_size=args.kv_page_size, kv_pages=args.kv_pages,
             prefix_cache=(None if args.prefix_cache == 'auto'

@@ -261,6 +261,18 @@ class _HistogramChild:
             self._sum += value
             self._count += 1
 
+    def observe_count(self, value: float, n: int) -> None:
+        """Record `value` as n identical samples under ONE lock
+        acquire — the hot-path bulk form (e.g. per-round speculative
+        acceptance counts drained batch-at-a-time per dispatch)."""
+        if n <= 0:
+            return
+        idx = bisect.bisect_left(self._buckets, value)
+        with self._lock:
+            self._counts[idx] += n
+            self._sum += value * n
+            self._count += n
+
     def snapshot(self) -> Tuple[List[int], float, int]:
         with self._lock:
             return list(self._counts), self._sum, self._count
@@ -289,6 +301,11 @@ class Histogram(Metric):
 
     def observe(self, value: float) -> None:
         self._default_child().observe(value)
+
+    def observe_count(self, value: float, n: int) -> None:
+        """n identical samples, one lock acquire (see
+        _HistogramChild.observe_count)."""
+        self._default_child().observe_count(value, n)
 
     def child_snapshot(self, **labels: str):
         """(cumulative bucket counts, sum, count) for one series —

@@ -118,6 +118,102 @@ def test_trace_safety_passes_clean_fused_decode_body(tmp_path):
     assert findings == []
 
 
+def test_trace_safety_passes_scan_inside_while_loop_spec_body(
+        tmp_path):
+    """The REAL fused-spec idiom (ISSUE 13): a draft lax.scan NESTED
+    inside a lax.while_loop round body — carry unpack/rebind, jnp
+    accept/rollback math, packed .at[rows, cols].set writes — is
+    trace-clean in both scopes and must not flag."""
+    findings = _run_snippet(tmp_path, """
+        import jax.numpy as jnp
+        from jax import lax
+
+        def fused_spec(params, cache, draft_cache, last, active,
+                       budgets, k, n_rounds):
+            def cond(carry):
+                r = carry[0]
+                act = carry[4]
+                return (r < n_rounds) & jnp.any(act)
+
+            def body(carry):
+                r, cache, draft_cache, last, act, emitted, toks = carry
+                lengths = cache['length']
+
+                def draft_body(dcarry, _):
+                    dc, dlast = dcarry
+                    nxt = jnp.where(act, dlast + 1, dlast)
+                    dc['length'] = jnp.where(act, dc['length'] + 1,
+                                             dc['length'])
+                    return (dc, nxt), nxt
+
+                (draft_cache, _), drafts = lax.scan(
+                    draft_body, (draft_cache, last), None, length=k)
+                drafts = jnp.swapaxes(drafts, 0, 1)
+                match = (drafts == drafts)
+                m = jnp.sum(jnp.cumprod(match.astype(jnp.int32),
+                                        axis=1), axis=1)
+                emit = jnp.minimum(m + 1, budgets - emitted)
+                rows = jnp.arange(last.shape[0])[:, None]
+                cols = emitted[:, None] + jnp.arange(k)[None]
+                toks = toks.at[rows, cols].set(drafts)
+                cache['length'] = jnp.where(act, lengths + emit,
+                                            lengths)
+                draft_cache['length'] = cache['length']
+                emitted = emitted + emit
+                act = act & (emitted < budgets)
+                return (r + 1, cache, draft_cache, last, act,
+                        emitted, toks)
+
+            toks = jnp.zeros((last.shape[0], n_rounds * k), jnp.int32)
+            return lax.while_loop(
+                cond, body,
+                (jnp.int32(0), cache, draft_cache, last, active,
+                 jnp.zeros_like(last), toks))
+    """, 'trace-safety')
+    assert findings == []
+
+
+def test_trace_safety_flags_host_state_in_spec_round_body(tmp_path):
+    """The broken twin: host bookkeeping inside the speculative round
+    body — timing, acceptance counters, emitted-token lists — runs
+    ONCE at trace time, so the metrics would lie and the host would
+    never see the tokens. Flags in the while_loop body AND the nested
+    draft scan."""
+    findings = _run_snippet(tmp_path, """
+        import time
+
+        import jax.numpy as jnp
+        from jax import lax
+
+        ACCEPTED = []
+
+        def fused_spec(cache, draft_cache, last, k, n_rounds):
+            def cond(carry):
+                return carry[0] < n_rounds
+
+            def body(carry):
+                r, cache, draft_cache, last = carry
+                t0 = time.perf_counter()     # host call — flag
+
+                def draft_body(dcarry, _):
+                    dc, dlast = dcarry
+                    ACCEPTED.append(dlast)   # closure mutation — flag
+                    print('draft', dlast)    # host call — flag
+                    return (dc, dlast), dlast
+
+                (draft_cache, _), drafts = lax.scan(
+                    draft_body, (draft_cache, last), None, length=k)
+                return (r + 1, cache, draft_cache, last)
+
+            return lax.while_loop(cond, body,
+                                  (jnp.int32(0), cache, draft_cache,
+                                   last))
+    """, 'trace-safety')
+    rules = _rules(findings)
+    assert rules.count('host-call') == 2
+    assert 'closure-mutation' in rules
+
+
 def test_trace_safety_passes_cow_page_copy_helper(tmp_path):
     """The prefix-cache COW write helper's idiom (ISSUE 11): a jitted
     donated page-pool copy — tree.map over raw/quantized leaves with

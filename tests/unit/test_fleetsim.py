@@ -386,6 +386,35 @@ class TestSmokeScenario:
         assert report['rc'] == 0, report['asserts']
         assert report['extra']['requests'] > 1000
 
+    def test_spec_decode_scenario_gates_acceptance_ratio(
+            self, tmp_path):
+        """ISSUE 13 satellite: the spec_decode scenario models
+        fused draft/verify rounds per host dispatch and gates the
+        draft acceptance RATIO from counter deltas of the REAL
+        skytpu_spec_* registry series (the ones the engine exports),
+        plus the decode-step p95 one fused speculative dispatch must
+        hold."""
+        sim = runner_lib.FleetSim(
+            runner_lib.SCENARIOS['spec_decode'], seed=0,
+            out_dir=str(tmp_path))
+        report = sim.run()
+        by_name = {r['name']: r for r in report['asserts']}
+        acc = by_name['spec_acceptance']
+        assert acc['ok'], acc
+        assert acc['metric'] == 'skytpu_spec_accepted_tokens_total'
+        # The ratio resolved from real counter deltas, near the
+        # profile's expected ~0.59 (not a stub or an absolute read).
+        assert 0.45 <= acc['value'] <= 0.75
+        assert by_name['decode_step_p95']['ok'], \
+            by_name['decode_step_p95']
+        assert by_name['decode_step_p95']['metric'] == \
+            'skytpu_decode_step_seconds'
+        assert report['rc'] == 0, report['asserts']
+        assert report['extra']['requests'] > 1000
+        data = json.loads(open(os.path.join(
+            str(tmp_path), 'SLO_spec_decode.json')).read())
+        assert data['rc'] == 0 and data['scenario'] == 'spec_decode'
+
     def test_shared_prefix_scenario_gates_hit_ratio(self, tmp_path):
         """ISSUE 11 satellite: the shared_prefix scenario models a
         prefix-hit-ratio replica term and gates the cache hit RATIO

@@ -1,0 +1,444 @@
+"""Fully device-resident speculative decode (ISSUE 13).
+
+Acceptance: fused speculative rounds (`fused_spec_rounds`, a donated-
+buffer lax.while_loop running up to SKYTPU_SPEC_FUSE_ROUNDS
+draft/verify rounds per host dispatch) must be greedy
+token-for-token identical to the per-round cadence
+(spec_fuse_rounds=1) AND to non-speculative decode; membership churn
+must not recompile the kernel; and the speculative hot path must
+issue exactly ONE device->host transfer per engine step — the
+per-round blocking `device_get(cache['length'])` check is gone,
+replaced by host-side slot bookkeeping.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu import inference
+from skypilot_tpu.inference import engine as eng_lib
+from skypilot_tpu.models import llama
+from skypilot_tpu.observability import instruments as obs
+
+
+@pytest.fixture(scope='module')
+def tiny():
+    config = llama.CONFIGS['tiny']
+    params = llama.init_params(config, jax.random.key(7))
+    return config, params
+
+
+_REF_PAD = 40
+
+
+def _greedy_reference(params, config, prompt, steps):
+    """Argmax over a FULL forward pass each step (no cache)."""
+    tokens = list(prompt)
+    out = []
+    for _ in range(steps):
+        assert len(tokens) <= _REF_PAD
+        arr = jnp.array([tokens + [0] * (_REF_PAD - len(tokens))],
+                        jnp.int32)
+        logits = llama.forward(params, arr, config)
+        nxt = int(jnp.argmax(logits[0, len(tokens) - 1]))
+        out.append(nxt)
+        tokens.append(nxt)
+    return out
+
+
+def _greedy(max_new, eos=None):
+    return inference.SamplingParams(temperature=0.0,
+                                    max_new_tokens=max_new,
+                                    eos_token_id=eos)
+
+
+def _spec_engine(params, config, draft=None, **kw):
+    kw.setdefault('batch_size', 2)
+    kw.setdefault('max_seq_len', 64)
+    return inference.InferenceEngine(
+        params, config, draft=draft or (params, config), spec_k=4,
+        **kw)
+
+
+class TestFusedSpecSmoke:
+    """The acceptance smoke: fused spec is the default when a draft is
+    attached, amortizes several rounds per host dispatch, and is
+    greedy-identical to per-round spec and non-spec decode."""
+
+    def test_defaults_fuse_multiple_rounds(self, tiny):
+        config, params = tiny
+        eng = _spec_engine(params, config)
+        assert eng.spec_fuse_rounds >= 4          # fused by default
+        assert eng.decode_fuse_steps >= 4
+        assert eng_lib._is_paged(eng.state.cache)
+
+    def test_fused_matches_per_round_and_non_spec(self, tiny):
+        config, params = tiny
+        prompt = [3, 17, 42, 9]
+        steps = 16
+        ref = _greedy_reference(params, config, prompt, steps)
+
+        def run(**kw):
+            eng = _spec_engine(params, config, **kw) if kw.get(
+                'draft') is not False else inference.InferenceEngine(
+                params, config, batch_size=2, max_seq_len=64)
+            rid = eng.submit(prompt, _greedy(steps))
+            toks = eng.run_to_completion()[rid]
+            return toks, eng.finished_logprobs()[rid], eng
+
+        plain, plain_lp, _ = run(draft=False)
+        fused, fused_lp, fused_eng = run(spec_fuse_rounds=8)
+        per_round, per_round_lp, pr_eng = run(spec_fuse_rounds=1)
+        assert plain == ref
+        assert fused == ref
+        assert per_round == ref
+        np.testing.assert_allclose(fused_lp, plain_lp, atol=1e-3)
+        np.testing.assert_allclose(fused_lp, per_round_lp, atol=1e-5)
+        # The amortization itself: the 15 decode tokens rode FEWER
+        # host dispatches fused than per-round (4 rounds in 1).
+        assert fused_eng._fused_dispatches < pr_eng._fused_dispatches
+
+    def test_one_dispatch_emits_n_times_spec_k_tokens(self, tiny):
+        """A correlated draft (same weights) accepts every proposal:
+        spec_fuse_rounds * spec_k decode tokens per host dispatch."""
+        config, params = tiny
+        eng = _spec_engine(params, config, spec_fuse_rounds=8)
+        rid = eng.submit([3, 17, 42, 9, 105, 8], _greedy(33))
+        out = eng.run_to_completion()[rid]
+        assert len(out) == 33
+        # 1 prefill token + 32 decode tokens == 8 rounds x spec_k 4
+        # in exactly ONE fused dispatch.
+        assert eng._fused_dispatches == 1
+
+    def test_adversarial_draft_stays_lossless_fused(self, tiny):
+        """A different random draft (near-zero acceptance) through
+        MULTI-ROUND fused spec must still match plain greedy."""
+        config, params = tiny
+        draft_params = llama.init_params(config, jax.random.key(99))
+        prompt = [5, 11, 2]
+        ref = _greedy_reference(params, config, prompt, 12)
+        eng = _spec_engine(params, config,
+                           draft=(draft_params, config),
+                           spec_fuse_rounds=8)
+        rid = eng.submit(prompt, _greedy(12))
+        assert eng.run_to_completion()[rid] == ref
+
+    def test_eos_mid_burst_stops_exactly(self, tiny):
+        """An eos accepted anywhere inside the multi-round burst must
+        end the request AT the eos — later rounds' tokens are never
+        emitted (device-side truncation, no host post-filtering)."""
+        config, params = tiny
+        prompt = [3, 17, 42]
+        ref = _greedy_reference(params, config, prompt, 12)
+        eos = ref[2]
+        eng = _spec_engine(params, config, spec_fuse_rounds=8)
+        rid = eng.submit(prompt, _greedy(12, eos=eos))
+        out = eng.run_to_completion()[rid]
+        assert out == ref[:3] and out[-1] == eos
+
+    def test_cache_and_draft_buffers_are_donated(self, tiny):
+        """The fused spec loop donates BOTH caches + the last-token
+        buffer: the pre-round device arrays must be CONSUMED
+        (deleted), not copied."""
+        config, params = tiny
+        eng = _spec_engine(params, config, kv_quant='none')
+        eng.submit([1, 2, 3], _greedy(60))
+        eng.step()                       # prefill + first spec burst
+        k_before = eng.state.cache['k']
+        dk_before = eng.state.draft_cache['k']
+        last_before = eng.state.last_tokens
+        eng.step()                       # pure fused spec burst
+        assert k_before.is_deleted()
+        assert dk_before.is_deleted()
+        assert last_before.is_deleted()
+
+
+class TestSpecHotPathTransfers:
+    """Satellite: the per-round blocking device_get(cache['length'])
+    is gone — the verify-slab bound derives from host-side slot
+    bookkeeping, so one engine step issues exactly ONE device->host
+    transfer (the output drain)."""
+
+    def test_single_device_get_per_spec_step(self, tiny, monkeypatch):
+        config, params = tiny
+        eng = _spec_engine(params, config, spec_fuse_rounds=2)
+        eng.submit([3, 17, 42, 9], _greedy(50))
+        eng.step()                       # prefill (its syncs are fine)
+        rounds0 = obs.SPEC_ROUNDS.value()
+        calls = []
+        real = jax.device_get
+
+        def counting(*args, **kwargs):
+            calls.append(args)
+            return real(*args, **kwargs)
+
+        monkeypatch.setattr(jax, 'device_get', counting)
+        steps = 4
+        for _ in range(steps):
+            eng.step()
+        # Every step took the SPEC path...
+        assert obs.SPEC_ROUNDS.value() > rounds0
+        # ...and each issued exactly one transfer: the output tuple.
+        assert len(calls) == steps, [len(a) for a in calls]
+
+    def test_near_capacity_falls_back_without_device_sync(
+            self, tiny, monkeypatch):
+        """A slot whose verify slab no longer fits routes the batch
+        down the plain fused-decode path — decided from host
+        bookkeeping, still one transfer per step, and the output
+        still matches the host-stepped oracle."""
+        config, params = tiny
+        prompt = [int(i % 251) + 1 for i in range(20)]
+
+        def run(**kw):
+            eng = inference.InferenceEngine(
+                params, config, batch_size=1, max_seq_len=32,
+                kv_quant='none', **kw)
+            rid = eng.submit(prompt, _greedy(50))  # cache binds first
+            return eng.run_to_completion()[rid], eng
+
+        host, _ = run(decode_fuse_steps=1)
+        calls = []
+        real = jax.device_get
+
+        def counting(*args, **kwargs):
+            calls.append(args)
+            return real(*args, **kwargs)
+
+        monkeypatch.setattr(jax, 'device_get', counting)
+        spec, eng = run(draft=(params, config), spec_k=4,
+                        spec_fuse_rounds=4)
+        assert spec == host
+        # Prefill issues 2 gets (sample + last_tokens); every decode
+        # step after it — spec burst or plain-decode fallback — one.
+        assert len(calls) == 2 + eng._fused_dispatches
+
+
+class TestDenseCacheNearCapacity:
+    """Regression: on a DENSE cache a slot parked by the verify-slab
+    bound mid-burst must not keep receiving clamped k-wide writes
+    while other slots hold the loop open — the dynamic_update_slice
+    clamp would shift them onto VISIBLE positions and corrupt keys
+    the slot still reads when it resumes via plain decode. The slab
+    bound therefore ends the burst for the whole batch."""
+
+    def test_dense_slab_parked_slot_output_uncorrupted(self, tiny):
+        config, params = tiny
+        # Adversarial draft: ~1 token per round, so slot A's length
+        # creeps through the slab-parked-but-not-done window
+        # (S - k < length < max_len) while slot B stays active.
+        draft_params = llama.init_params(config, jax.random.key(99))
+        prompt_a = [int(i % 251) + 1 for i in range(20)]
+        prompt_b = [5, 6]
+
+        def run(**kw):
+            eng = inference.InferenceEngine(
+                params, config, batch_size=2, max_seq_len=32,
+                kv_page_size=0, kv_quant='none', **kw)
+            ra = eng.submit(prompt_a, _greedy(50))  # cache binds first
+            rb = eng.submit(prompt_b, _greedy(50))
+            out, lps = {}, {}
+            while eng.has_work:
+                eng.step()
+                done = eng.finished()
+                out.update(done)
+                if done:
+                    lps.update(eng.finished_logprobs())
+            return out[ra], out[rb], lps[ra], lps[rb]
+
+        host_a, host_b, hlp_a, hlp_b = run(decode_fuse_steps=1)
+        spec_a, spec_b, slp_a, slp_b = run(
+            draft=(draft_params, config), spec_k=4, spec_fuse_rounds=8)
+        assert spec_a == host_a
+        assert spec_b == host_b
+        # Logprobs catch what argmax can hide: a clamped write onto a
+        # visible position perturbs the resumed slot's distribution
+        # (measured 0.016 under the per-slot-deactivation bug) even
+        # when the emitted tokens happen to survive.
+        np.testing.assert_allclose(slp_a, hlp_a, atol=1e-3)
+        np.testing.assert_allclose(slp_b, hlp_b, atol=1e-3)
+
+
+class TestFusedSpecChurn:
+    """Membership churn (joins, leaves, aborts, varying prompt
+    lengths and budgets) edits table/length/budget VALUES — the spec
+    kernel must never recompile."""
+
+    def test_membership_churn_zero_recompiles(self, tiny):
+        config, params = tiny
+        eng = _spec_engine(params, config, spec_fuse_rounds=4)
+        eng.submit([1, 2, 3], _greedy(4))
+        eng.run_to_completion()          # warm the compile cache
+        warm = eng_lib.fused_spec_rounds._cache_size()
+        for prompt in ([5] * 3, [7] * 17, [9] * 30, [2] * 5,
+                       [4] * 24):
+            eng.submit(list(prompt), _greedy(4))
+            eng.run_to_completion()
+        # Churn with aborts mixed in.
+        ghost = eng.submit([8, 9], _greedy(40))
+        eng.step()
+        eng.abort(ghost)
+        eng.submit([6, 6], _greedy(3))
+        eng.run_to_completion()
+        assert eng_lib.fused_spec_rounds._cache_size() == warm
+
+
+class TestAbortRacingSpecBursts:
+    """abort()/abort_all() landing between fused spec bursts: slots
+    free, pages return, nothing is reported, the batch keeps
+    serving."""
+
+    def test_abort_between_bursts_frees_slot_and_pages(self, tiny):
+        config, params = tiny
+        eng = _spec_engine(params, config)
+        keep = eng.submit([5, 6], _greedy(20))
+        ghost = eng.submit([9, 8], _greedy(50))
+        eng.step()                       # both mid-generation
+        eng.abort(ghost)
+        out = eng.run_to_completion()
+        assert keep in out and len(out[keep]) == 20
+        assert ghost not in out
+        assert not eng.has_work
+        assert len(eng._page_alloc) == eng._pages_total
+
+    def test_abort_all_mid_burst_then_fresh_request(self, tiny):
+        config, params = tiny
+        eng = _spec_engine(params, config)
+        eng.submit([5, 6], _greedy(40))
+        eng.submit([7, 8], _greedy(40))
+        eng.step()
+        eng.abort_all()
+        assert not eng.has_work
+        assert len(eng._page_alloc) == eng._pages_total
+        ref = _greedy_reference(params, config, [5, 6], 3)
+        rid = eng.submit([5, 6], _greedy(3))
+        assert eng.run_to_completion()[rid] == ref
+
+    def test_engine_loop_abort_racing_spec_burst(self, tiny):
+        """The server loop re-drains aborts immediately after step():
+        a watcher aborted during a fused SPEC burst (now up to
+        rounds x spec_k tokens) must not receive that burst's tokens
+        and its slot frees before the next burst."""
+        import asyncio
+
+        from skypilot_tpu.inference import server as srv
+        config, params = tiny
+        engine = _spec_engine(params, config, batch_size=1)
+
+        async def drive():
+            loop = srv.EngineLoop(engine)
+            try:
+                ghost = loop.submit([3, 4], _greedy(60), stream=True)
+                await asyncio.sleep(0.2)  # a burst or two runs
+                loop.abort(ghost)
+                keep = loop.submit([5, 6], _greedy(3), stream=False)
+                kind, payload = await asyncio.wait_for(keep.q.get(),
+                                                       timeout=30)
+                while kind != 'done':
+                    kind, payload = await asyncio.wait_for(
+                        keep.q.get(), timeout=30)
+                assert len(payload) == 3
+                # Aborted watcher got no event after the abort landed.
+                sent_at_abort = ghost.q.qsize()
+                await asyncio.sleep(0.1)
+                assert ghost.q.qsize() == sent_at_abort
+            finally:
+                loop.stop()
+
+        asyncio.new_event_loop().run_until_complete(drive())
+
+
+class TestPagedDraftCacheBounds:
+    """Satellite: paged draft caches share the main pool geometry and
+    the insert-time reservation includes the spec_k verify slab, so
+    an oversubscribed pool queues (never corrupts) and every page
+    returns when spec requests drain."""
+
+    def test_oversubscribed_pool_queues_and_completes(self, tiny):
+        config, params = tiny
+        eng = _spec_engine(params, config, kv_page_size=16, kv_pages=3,
+                           kv_quant='none')
+        assert eng_lib._is_paged(eng.state.draft_cache)
+        r1 = eng.submit(list(range(2, 30)), _greedy(4))
+        r2 = eng.submit(list(range(3, 31)), _greedy(4))
+        eng.step()
+        # Second request held back: its reservation (prompt + budget
+        # + spec_k slab) exceeds the free pool while r1 holds pages.
+        assert any(s is None for s in eng.state.slots)
+        out = eng.run_to_completion()
+        assert r1 in out and r2 in out   # completes after r1 frees
+        assert len(eng._page_alloc) == eng._pages_total
+
+    def test_reservation_covers_the_verify_slab(self, tiny):
+        """The worst-case reservation includes spec_k extra positions
+        (the verify slab writes k keys past the accepted length);
+        without the slack a boundary-length request would need a page
+        it never reserved."""
+        config, params = tiny
+        eng = _spec_engine(params, config, kv_page_size=16,
+                           kv_quant='none')
+        # prompt 12 + budget 4 == 16 fits one page exactly, but the
+        # 4-wide verify slab crosses into a second page.
+        assert eng._pages_needed(12, 4) == 2
+        no_spec = inference.InferenceEngine(
+            params, config, batch_size=2, max_seq_len=64,
+            kv_page_size=16, kv_quant='none')
+        assert no_spec._pages_needed(12, 4) == 1
+
+
+class TestSpecObservability:
+    """Satellite: the skytpu_spec_* instruments make speculative
+    decode visible — rounds, proposed/accepted tokens (acceptance =
+    counter-delta ratio), and the per-round acceptance histogram."""
+
+    def test_correlated_draft_acceptance_is_total(self, tiny):
+        config, params = tiny
+        eng = _spec_engine(params, config, spec_fuse_rounds=8)
+        r0 = obs.SPEC_ROUNDS.value()
+        p0 = obs.SPEC_PROPOSED_TOKENS.value()
+        a0 = obs.SPEC_ACCEPTED_TOKENS.value()
+        _, h_sum0, h_n0 = obs.SPEC_ACCEPTED_PER_ROUND.child_snapshot()
+        rid = eng.submit([3, 17, 42, 9], _greedy(17))
+        out = eng.run_to_completion()[rid]
+        assert len(out) == 17
+        rounds = obs.SPEC_ROUNDS.value() - r0
+        proposed = obs.SPEC_PROPOSED_TOKENS.value() - p0
+        accepted = obs.SPEC_ACCEPTED_TOKENS.value() - a0
+        # 16 decode tokens at spec_k=4, same-weights draft: 4 rounds,
+        # every proposal accepted.
+        assert rounds == 4
+        assert proposed == 16
+        assert accepted == 16
+        # One histogram sample per (slot, round).
+        _, h_sum, h_n = obs.SPEC_ACCEPTED_PER_ROUND.child_snapshot()
+        assert h_n - h_n0 == rounds
+        assert h_sum - h_sum0 == accepted
+
+    def test_adversarial_draft_acceptance_is_partial(self, tiny):
+        config, params = tiny
+        draft_params = llama.init_params(config, jax.random.key(99))
+        eng = _spec_engine(params, config,
+                           draft=(draft_params, config))
+        p0 = obs.SPEC_PROPOSED_TOKENS.value()
+        a0 = obs.SPEC_ACCEPTED_TOKENS.value()
+        eng.submit([3, 17, 42, 9], _greedy(12))
+        eng.run_to_completion()
+        proposed = obs.SPEC_PROPOSED_TOKENS.value() - p0
+        accepted = obs.SPEC_ACCEPTED_TOKENS.value() - a0
+        assert proposed > 0
+        assert 0 <= accepted < proposed  # acceptance ratio < 1
+
+    def test_generated_tokens_count_every_burst_token(self, tiny):
+        config, params = tiny
+        eng = _spec_engine(params, config, spec_fuse_rounds=8)
+        gen0 = obs.GENERATED_TOKENS.value()
+        host0 = obs.DECODE_HOST_STEPS.value()
+        rids = [eng.submit([3, 17, 42], _greedy(13)),
+                eng.submit([9, 8], _greedy(13))]
+        out = eng.run_to_completion()
+        produced = sum(len(out[r]) for r in rids)
+        assert produced == 26
+        assert obs.GENERATED_TOKENS.value() == gen0 + produced
+        host_steps = obs.DECODE_HOST_STEPS.value() - host0
+        # Fused spec amortization: far fewer host steps than tokens.
+        assert 0 < host_steps < produced / 4

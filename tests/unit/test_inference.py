@@ -927,7 +927,8 @@ class TestInterleavedPrefill:
 
 
 class TestSpeculativeDecoding:
-    """Draft-propose / big-verify greedy decoding (engine.spec_step):
+    """Draft-propose / big-verify greedy decoding
+    (engine.fused_spec_rounds):
     LOSSLESS — the output must be token-for-token what plain greedy
     produces, whatever the draft proposes."""
 
