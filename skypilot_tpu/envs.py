@@ -197,8 +197,17 @@ SKYTPU_KV_PAGE_SIZE = declare(
     'SKYTPU_KV_PAGE_SIZE', int, 64,
     'Positions per KV-cache page for the paged (block) allocator; '
     'engines built without an explicit kv_page_size use this. '
-    '0 disables paging (dense per-slot cache). Sharded (mesh) '
-    'engines always run dense.')
+    '0 disables paging (dense per-slot cache). Applies to unsharded '
+    'AND tensor-sharded engines (see SKYTPU_KV_PAGES_SHARDED); '
+    'context-sharded meshes keep the dense layout.')
+SKYTPU_KV_PAGES_SHARDED = declare(
+    'SKYTPU_KV_PAGES_SHARDED', bool, True,
+    'Whether engines on a tensor-sharded mesh default to the PAGED '
+    'KV layout (the page pool shards its KV-heads axis over the '
+    'tensor axis; block tables stay replicated). 0 keeps sharded '
+    'engines dense by default; an explicit kv_page_size always wins. '
+    'Context-sharded meshes ignore this and stay dense (pages '
+    'indirect the sequence dim the context axis partitions).')
 SKYTPU_KV_PAGES = declare(
     'SKYTPU_KV_PAGES', int, 0,
     'Paged KV pool size in pages (plus one reserved scratch page). '
@@ -210,8 +219,9 @@ SKYTPU_PREFIX_CACHE = declare(
     'Cross-request prefix KV reuse: index finished requests\' paged '
     'KV in a radix tree so a new prompt sharing a cached prefix maps '
     'those pages copy-on-write into its block table and prefills only '
-    'from the first unmatched token. Applies to paged, unsharded, '
-    'draft-free engines; false disables.')
+    'from the first unmatched token. Applies to paged, draft-free '
+    'engines — tensor-sharded meshes included (the index is host-side '
+    'bookkeeping over page ids); false disables.')
 SKYTPU_PREFIX_CACHE_MAX_PAGES = declare(
     'SKYTPU_PREFIX_CACHE_MAX_PAGES', int, 0,
     'Cap on KV pages the prefix cache may retain after publishing a '

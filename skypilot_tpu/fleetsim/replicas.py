@@ -79,6 +79,30 @@ class ReplicaProfile:
     spec_k: int = 0                    # 0 = no speculative modeling
     spec_accept_prob: float = 0.0      # per-draft-token match prob
     spec_fuse_rounds: int = 8          # rounds per host dispatch
+    # Sharded replica topology (ISSUE 14): each replica is one
+    # `--mesh`-sharded engine, e.g. (('tensor', 4),) for a v5e-4
+    # tensor split. `decode_step_s`/`ttft_median_s` stay the
+    # per-DISPATCH knobs the operator measures ON that topology (the
+    # fused round already includes the per-layer ICI all-reduces), so
+    # mesh_shape does not rescale latencies — it declares the
+    # topology and enforces the engine's own composition rule: a
+    # context-sharded replica runs the DENSE layout, so modeling a
+    # prefix-cache hit ratio there would gate an SLO on counters the
+    # real engine could never emit (validated in __post_init__).
+    mesh_shape: tuple = ()             # (('tensor', 4),) etc.
+
+    def __post_init__(self):
+        ways = dict(self.mesh_shape)
+        if self.prefix_hit_ratio > 0 and ways.get('context', 1) > 1:
+            raise ValueError(
+                'prefix_hit_ratio > 0 needs the paged KV layout, but '
+                'a context-sharded replica (mesh_shape context > 1) '
+                'runs dense — drop the context axis or the prefix '
+                'term (mirrors the engine rule: pages never compose '
+                'with context sharding).')
+
+    def mesh_ways(self, axis: str) -> int:
+        return dict(self.mesh_shape).get(axis, 1)
 
     def spec_mean_emit(self) -> float:
         """Expected tokens one speculative round emits (accepted

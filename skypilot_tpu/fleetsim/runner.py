@@ -485,6 +485,53 @@ register(Scenario(
 ))
 
 register(Scenario(
+    name='sharded_serve',
+    description=('Sharded fast path gate (ISSUE 14): every replica '
+                 'is one tensor=4-sharded engine running the paged '
+                 'KV pool (KV heads sharded, tables replicated) '
+                 'with the radix prefix cache ON — the composition '
+                 'PR 14 unlocked. decode_step_s is the per-dispatch '
+                 'fused-round latency measured on that topology '
+                 '(ICI all-reduces included); SLOs gate the '
+                 'decode-step p95 AND the prefix hit ratio from the '
+                 'live skytpu_* registry, the same series a sharded '
+                 'production engine exports. A mid-run burst (new '
+                 'tenants = cold prefixes) must not break either.'),
+    replicas=48,
+    duration_s=120.0, tick_s=2.0, warmup_s=30.0,
+    traffic={'kind': 'burst',
+             'inner': {'kind': 'constant', 'qps': 120.0},
+             'burst_qps': 40.0, 'at': 70.0, 'duration_s': 20.0},
+    profile=replicas_lib.ReplicaProfile(
+        startup_median_s=6.0, startup_sigma=0.3,
+        ttft_median_s=0.45, ttft_sigma=0.4,
+        tokens_median=48, concurrency=8,
+        # Fused-round dispatch on the 4-way tensor split: the v5e
+        # fused anchor plus the measured per-layer all-reduce tax.
+        decode_step_s=0.15, decode_step_sigma=0.3, fused_steps=8,
+        prefix_hit_ratio=0.8, warm_ttft_factor=0.12,
+        shared_prefix_tokens=512,
+        mesh_shape=(('tensor', 4),)),
+    policy={'max_replicas': 64, 'target_qps_per_replica': 3.0,
+            'target_queue_per_replica': 4.0,
+            'upscale_delay_seconds': 10,
+            'downscale_delay_seconds': 120},
+    lb_policy='round_robin',
+    slos=(
+        slo_lib.HistQuantileBelow(
+            'decode_step_p95', threshold=0.3,
+            metric='skytpu_decode_step_seconds'),
+        slo_lib.CounterRatioAbove(
+            'prefix_hit_ratio', threshold=0.7,
+            num_metric='skytpu_prefix_cache_hits_total',
+            den_metrics=('skytpu_prefix_cache_hits_total',
+                         'skytpu_prefix_cache_misses_total')),
+        slo_lib.HistQuantileBelow('ttft_p95', threshold=1.5),
+        slo_lib.RatioBelow('error_rate', threshold=0.005),
+    ),
+))
+
+register(Scenario(
     name='zone_loss',
     description=('The acceptance soak: 1000+ replicas across three '
                  'zones, a full zone killed and later restored, '

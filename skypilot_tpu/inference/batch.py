@@ -118,7 +118,9 @@ def main() -> None:
                              '1 = host-stepped).')
     parser.add_argument('--kv-page-size', type=int, default=None,
                         help='Positions per KV page (default: '
-                             'SKYTPU_KV_PAGE_SIZE; 0 = dense cache).')
+                             'SKYTPU_KV_PAGE_SIZE; 0 = dense cache). '
+                             'Pages compose with --mesh tensor=N; '
+                             'context-sharded meshes stay dense.')
     parser.add_argument('--kv-pages', type=int, default=None,
                         help='Paged KV pool size in pages (0/default '
                              '= dense-equivalent).')

@@ -18,11 +18,15 @@ TPU-first design:
   cache and token buffers donated, returning only per-slot emitted
   tokens + counts to the host — the host-dispatch RTT is paid once per
   N tokens instead of per token (SKYTPU_DECODE_FUSE_STEPS).
-- KV storage defaults to PAGED (block) allocation on unsharded
-  engines: k/v live in a pool of fixed-size pages ([L, P, page, KV, D])
-  indexed through per-slot block tables, so sequences join and leave
-  the continuous batch by editing table VALUES — shapes never change,
-  membership churn compiles nothing.
+- KV storage defaults to PAGED (block) allocation: k/v live in a pool
+  of fixed-size pages ([L, P, page, KV, D]) indexed through per-slot
+  block tables, so sequences join and leave the continuous batch by
+  editing table VALUES — shapes never change, membership churn
+  compiles nothing. Under a tensor-parallel mesh the pool shards its
+  KV-heads axis over 'tensor' (the dense cache's rule) while tables
+  stay host-side/replicated, so the gather partitions per chip;
+  context-sharded meshes keep the dense layout (pages indirect the
+  sequence dim the context axis partitions).
 - Speculative decode is device-resident too: with a draft attached,
   `fused_spec_rounds` runs up to SKYTPU_SPEC_FUSE_ROUNDS full
   draft-propose/verify/accept rounds inside one donated-buffer
@@ -53,6 +57,7 @@ from skypilot_tpu.inference import prefix_cache as prefix_lib
 from skypilot_tpu.models import llama
 from skypilot_tpu.models import moe as moe_lib
 from skypilot_tpu.observability import instruments as obs
+from skypilot_tpu.parallel import sharding as sharding_lib
 
 Params = Dict[str, Any]
 Cache = Dict[str, jax.Array]
@@ -116,6 +121,18 @@ def cache_capacity(cache: Cache) -> int:
     return int(leaf.shape[2])
 
 
+def _shard_pages(leaf, stacked: bool = False):
+    """Sharding annotation for a page-pool leaf or its gathered
+    per-slot view: KV heads over 'tensor', everything else replicated
+    (sharding.kv_page_axes — one construction site). Under a
+    tensor-parallel mesh this pins GSPMD to the trivial partitioning
+    of the page gather/scatter — every chip gathers its own
+    head-slice of the same pages, never an all-gathered pool; off a
+    mesh it is a no-op (sharding.shard falls back)."""
+    return sharding_lib.shard(
+        leaf, sharding_lib.kv_page_axes(leaf.ndim, stacked=stacked))
+
+
 def _paged_read(pages, table: jax.Array):
     """Per-layer page pool -> per-slot dense view.
 
@@ -127,6 +144,11 @@ def _paged_read(pages, table: jax.Array):
     is one layer's cache, not the model's. Unallocated table entries
     point at the reserved scratch page 0 — garbage positions there sit
     beyond every slot's `length` and are invisible to the mask.
+
+    Under a tensor-sharded mesh the pool leaves shard on KV heads
+    while `table` (host-built) is replicated, so the gather reads
+    only local head-slices; the annotation keeps the view sharded
+    like the dense cache would be.
     """
     def read_leaf(leaf):
         page = leaf.shape[1]
@@ -134,7 +156,7 @@ def _paged_read(pages, table: jax.Array):
         idx = (table[:, :, None] * page
                + jnp.arange(page)[None, None, :]).reshape(
                    table.shape[0], -1)
-        return flat[idx]
+        return _shard_pages(flat[idx])
 
     if _is_quant(pages):
         return {'q': read_leaf(pages['q']), 's': read_leaf(pages['s'])}
@@ -163,7 +185,8 @@ def _paged_write(pages, new: jax.Array, table: jax.Array,
         pos = jnp.clip(pos, 0, table.shape[1] * page - 1)
         pidx = jnp.take_along_axis(table, pos // page, axis=1)
         idx = pidx * page + pos % page
-        return flat.at[idx].set(new_leaf).reshape(leaf.shape)
+        return _shard_pages(
+            flat.at[idx].set(new_leaf).reshape(leaf.shape))
 
     if _is_quant(pages):
         newq = quantize_kv(new)
@@ -180,9 +203,14 @@ def _copy_pool_page(pool, src: jax.Array, dst: jax.Array):
     page first lands its victim in a private copy, so the radix
     cache's original bytes survive for the next match. `src`/`dst`
     are traced scalars (one compile serves every copy) and the pool
-    is donated (XLA edits it in place, no second pool in HBM)."""
-    return jax.tree.map(lambda leaf: leaf.at[:, dst].set(leaf[:, src]),
-                        pool)
+    is donated (XLA edits it in place, no second pool in HBM). On a
+    tensor-sharded pool the copy is per-chip (each chip copies its
+    own head-slice); the annotation keeps the donated output on the
+    input's sharding instead of letting GSPMD re-lay it out."""
+    return jax.tree.map(
+        lambda leaf: _shard_pages(
+            leaf.at[:, dst].set(leaf[:, src]), stacked=True),
+        pool)
 
 
 def init_cache(config: llama.LlamaConfig, batch_size: int,
@@ -201,7 +229,10 @@ def init_cache(config: llama.LlamaConfig, batch_size: int,
     stores S/context positions, GSPMD partitions the attention
     reduction across the shards (distributed-softmax combine over
     ICI), and decode stays token-for-token identical to one chip
-    (test_inference context-parallel equivalence)."""
+    (test_inference context-parallel equivalence). With page_size > 0
+    AND a mesh, the page pool shards its KV-heads axis over 'tensor'
+    (tables/lengths replicated) — but never composes with a context
+    axis > 1 (loud error below)."""
     c = config
     s = max_seq_len or c.max_seq_len
     # Round the padded length up so (a) chunked prefill's last chunk
@@ -216,13 +247,20 @@ def init_cache(config: llama.LlamaConfig, batch_size: int,
     if kv_quant not in ('none', 'int8'):
         raise ValueError(f'kv_quant must be none|int8, got {kv_quant!r}')
     if page_size > 0:
-        if mesh is not None:
-            # Page indirection has no GSPMD partitioning story (the
-            # gather would all-gather the pool); sharded engines keep
-            # the dense layout whose seq dim context-shards.
-            raise ValueError('paged KV (page_size > 0) is incompatible '
-                             'with a sharded cache; serve unsharded or '
-                             'set page_size=0')
+        if ctx > 1:
+            # Pages indirect the SEQUENCE dim — exactly the dim the
+            # context axis partitions. Splitting a page across chips
+            # would turn every table lookup into a cross-chip gather,
+            # so pages + 'context' stays a LOUD error: long-context
+            # meshes keep the dense layout, whose seq dim
+            # context-shards natively.
+            raise ValueError(
+                'paged KV (page_size > 0) is incompatible with a '
+                "context-sharded cache (mesh axis 'context' > 1): "
+                'pages indirect the sequence dim the context axis '
+                'partitions. Drop the context axis (tensor-sharded '
+                'meshes page fine) or set page_size=0 for the dense '
+                'layout, whose sequence dim context-shards.')
         s = -(-s // math.lcm(multiple, page_size)) * \
             math.lcm(multiple, page_size)
         w = s // page_size
@@ -233,19 +271,41 @@ def init_cache(config: llama.LlamaConfig, batch_size: int,
         p = (num_pages + 1) if num_pages > 0 else (batch_size * w + 1)
         shape = (c.num_layers, p, page_size, c.num_kv_heads, c.head_dim)
 
+        if mesh is None:
+            def zeros(shape_, dtype, _axes):
+                return jnp.zeros(shape_, dtype)
+        else:
+            # The pool shards its KV-HEADS axis over 'tensor' — the
+            # same rule the dense cache uses — while tables, lengths,
+            # and every gather index stay host-built and replicated,
+            # so the page gather/scatter partitions per chip with no
+            # pool all-gather (see sharding.kv_page_axes). Allocate
+            # DIRECTLY sharded (jit out_shardings): a transient
+            # unsharded pool on one chip would OOM exactly the
+            # weights+cache-exceed-one-chip deployments this layout
+            # serves.
+            def zeros(shape_, dtype, axes):
+                sh = sharding_lib.named_sharding(mesh, axes)
+                return jax.jit(lambda: jnp.zeros(shape_, dtype),
+                               out_shardings=sh)()
+
+        pool_axes = sharding_lib.kv_page_axes(len(shape), stacked=True)
+        sc_axes = sharding_lib.kv_page_axes(len(shape) - 1,
+                                            stacked=True)
+
         def kv_zeros():
             if kv_quant == 'int8':
-                return {'q': jnp.zeros(shape, jnp.int8),
-                        's': jnp.zeros(shape[:-1], jnp.float32)}
-            return jnp.zeros(shape, c.dtype)
+                return {'q': zeros(shape, jnp.int8, pool_axes),
+                        's': zeros(shape[:-1], jnp.float32, sc_axes)}
+            return zeros(shape, c.dtype, pool_axes)
 
         return {
             'k': kv_zeros(),
             'v': kv_zeros(),
-            'length': jnp.zeros((batch_size,), jnp.int32),
+            'length': zeros((batch_size,), jnp.int32, (None,)),
             # Per-slot block table: logical position pos lives in
             # pages[table[b, pos // page_size], pos % page_size].
-            'table': jnp.zeros((batch_size, w), jnp.int32),
+            'table': zeros((batch_size, w), jnp.int32, (None, None)),
         }
     shape = (c.num_layers, batch_size, s, c.num_kv_heads, c.head_dim)
 
@@ -262,7 +322,6 @@ def init_cache(config: llama.LlamaConfig, batch_size: int,
         'length': jnp.zeros((batch_size,), jnp.int32),
     }
     if mesh is not None:
-        from skypilot_tpu.parallel import sharding as sharding_lib
         kv_sh = sharding_lib.named_sharding(
             mesh, (None, None, 'seq', 'kv_heads', None))
         # Scales drop the trailing D axis but shard identically.
@@ -1211,8 +1270,10 @@ class InferenceEngine:
     stream out of `finished()`.
 
     The fast path IS the default path: fused device-resident decode
-    (SKYTPU_DECODE_FUSE_STEPS), paged KV allocation on unsharded
-    engines (SKYTPU_KV_PAGE_SIZE), interleaved prefill for long
+    (SKYTPU_DECODE_FUSE_STEPS), paged KV allocation
+    (SKYTPU_KV_PAGE_SIZE — on tensor-sharded meshes too, where the
+    pool shards KV heads over 'tensor'; context-sharded meshes keep
+    the dense layout), interleaved prefill for long
     prompts, int8 KV on TPU (SKYTPU_KV_QUANT=auto), and — when a draft
     model is attached — device-resident speculative rounds for greedy
     batches (SKYTPU_SPEC_FUSE_ROUNDS draft/verify rounds per host
@@ -1286,20 +1347,24 @@ class InferenceEngine:
         if decode_fuse_steps is None:
             decode_fuse_steps = envs.SKYTPU_DECODE_FUSE_STEPS.get()
         self.decode_fuse_steps = max(1, int(decode_fuse_steps))
-        # Paged KV: explicit page size + sharded cache is a hard error
-        # (no GSPMD story for the page gather); the default silently
-        # stays dense under a mesh, where the seq dim context-shards.
+        # Paged KV composes with TENSOR-parallel meshes: the pool
+        # shards its KV-heads axis over 'tensor' (the dense cache's
+        # own rule) while block tables and gather indices stay
+        # host-built/replicated, so the page gather partitions
+        # per-chip with no pool all-gather. Context-sharded meshes
+        # are the exception — pages indirect the sequence dim the
+        # context axis partitions — so an EXPLICIT page size there is
+        # a loud error (init_cache raises) while the default keeps
+        # the dense layout, whose seq dim context-shards.
+        # SKYTPU_KV_PAGES_SHARDED=0 pins sharded engines dense by
+        # default (explicit kv_page_size still wins).
         explicit_paged = kv_page_size is not None
         if kv_page_size is None:
             kv_page_size = envs.SKYTPU_KV_PAGE_SIZE.get()
-        if mesh is not None:
-            if explicit_paged and kv_page_size > 0:
-                raise ValueError(
-                    'kv_page_size is incompatible with a sharded '
-                    'engine (the page gather has no GSPMD '
-                    'partitioning rules); omit kv_page_size or serve '
-                    'unsharded.')
-            kv_page_size = 0
+        if mesh is not None and not explicit_paged:
+            if (int(mesh.shape.get('context', 1)) > 1
+                    or not envs.SKYTPU_KV_PAGES_SHARDED.get()):
+                kv_page_size = 0
         self.kv_page_size = max(0, int(kv_page_size))
         if kv_pages is None:
             kv_pages = envs.SKYTPU_KV_PAGES.get()
@@ -1308,7 +1373,6 @@ class InferenceEngine:
             # axes (heads/mlp/vocab over 'tensor'); GSPMD propagates
             # through the cached forward, inserting the decode
             # all-reduces the same way the training step gets them.
-            from skypilot_tpu.parallel import sharding as sharding_lib
             logical = (moe_lib.param_logical_axes(config)
                        if isinstance(config, moe_lib.MoeConfig)
                        else llama.param_logical_axes(config))
@@ -1786,10 +1850,11 @@ class InferenceEngine:
                 'COW needs a free page but the pool is empty')
         dst = self._page_alloc.pop(0)
         src_a, dst_a = jnp.int32(src), jnp.int32(dst)
-        self.state.cache['k'] = _copy_pool_page(
-            self.state.cache['k'], src_a, dst_a)
-        self.state.cache['v'] = _copy_pool_page(
-            self.state.cache['v'], src_a, dst_a)
+        with self._mesh_ctx():
+            self.state.cache['k'] = _copy_pool_page(
+                self.state.cache['k'], src_a, dst_a)
+            self.state.cache['v'] = _copy_pool_page(
+                self.state.cache['v'], src_a, dst_a)
         self._slot_pages[i][idx] = dst
         self._slot_shared[i].discard(idx)
         self._set_table_rows(i, self._slot_pages[i])

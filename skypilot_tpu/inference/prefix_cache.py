@@ -15,6 +15,11 @@ Division of labor with the engine:
     engine's moves (table edits, exactly like PR 10's membership
     churn). Pool accounting (what returns to the free list, the
     eviction trigger, the max-pages cap) is the engine's too.
+    Because nothing here is device-resident, the index composes with
+    TENSOR-SHARDED page pools unchanged: page ids name whole
+    pages whose KV-heads axis happens to shard over the mesh, and
+    only the engine's jitted COW copy (`_copy_pool_page`) carries a
+    sharding annotation.
   - Granularity is the page: only FULL pages are indexed (a partial
     page's tail would hold garbage for a shorter prompt that matched
     it). Matching therefore reuses `page_size * k` tokens and prefill

@@ -447,8 +447,12 @@ def main() -> None:
                              'block allocation: slots join/leave the '
                              'batch by table edits, never recompiles).'
                              ' Default: SKYTPU_KV_PAGE_SIZE (64); 0 '
-                             'runs the dense per-slot cache. Sharded '
-                             '(--mesh) engines are always dense.')
+                             'runs the dense per-slot cache. '
+                             'Tensor-sharded meshes (--mesh tensor=N) '
+                             'page too — the pool shards KV heads; '
+                             'context-sharded meshes keep the dense '
+                             'layout (explicit page size there is an '
+                             'error).')
     parser.add_argument('--kv-pages', type=int, default=None,
                         help='Paged KV pool size in pages; 0/default '
                              'sizes the pool to the dense equivalent. '
@@ -463,8 +467,8 @@ def main() -> None:
                              'prefills only the unmatched tail '
                              '(near-zero warm TTFT). auto (default) '
                              'resolves via SKYTPU_PREFIX_CACHE (on); '
-                             'paged, unsharded, draft-free engines '
-                             'only.')
+                             'paged, draft-free engines only '
+                             '(tensor-sharded meshes included).')
     parser.add_argument('--prefix-cache-max-pages', type=int,
                         default=None,
                         help='Cap on KV pages the prefix cache '

@@ -73,6 +73,34 @@ def shard(x: Any,
         return x
 
 
+def kv_page_axes(ndim: int, stacked: bool = False
+                 ) -> Tuple[Optional[str], ...]:
+    """Logical axes of a paged-KV pool leaf (or its per-slot gathered
+    view) — ONE construction site for the pool's sharding story.
+
+    The pool shards its KV-HEADS axis over 'tensor' (the same rule the
+    dense cache uses) and nothing else: page/position axes stay
+    replicated because the block tables and gather indices are
+    host-built and identical on every chip, so the page gather/scatter
+    partitions trivially — each chip touches its own head-slice of the
+    same pages, no all-gather of the pool.
+
+    Leaf ranks covered (quantized scale leaves drop the trailing D):
+      stacked pool      [L, P, page, KV(, D)]  -> stacked=True
+      per-layer pool    [P, page, KV(, D)]     -> stacked=False
+      gathered view     [B, S, KV(, D)]        -> stacked=False
+    """
+    lead = 3 if stacked else 2
+    if ndim not in (lead + 1, lead + 2):
+        raise ValueError(
+            f'kv_page_axes: rank-{ndim} leaf does not look like a '
+            f'{"stacked " if stacked else ""}page-pool leaf')
+    axes: Tuple[Optional[str], ...] = (None,) * lead + ('kv_heads',)
+    if ndim == lead + 2:
+        axes += (None,)
+    return axes
+
+
 def named_sharding(mesh: Any,
                    logical_axes: Sequence[Optional[str]],
                    rules: Optional[Rules] = None) -> Any:

@@ -257,6 +257,64 @@ def test_trace_safety_flags_host_bookkeeping_in_cow_helper(tmp_path):
     assert 'closure-mutation' in rules
 
 
+def test_trace_safety_passes_sharded_page_gather_idiom(tmp_path):
+    """The sharded paged-KV idiom (ISSUE 14): a page gather/scatter
+    wrapped in a logical-axis `with_sharding_constraint` (via
+    sharding.shard) inside the jitted decode body — pure array ops
+    plus a sharding annotation — is trace-clean and must not flag."""
+    findings = _run_snippet(tmp_path, """
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        from skypilot_tpu.parallel import sharding as sharding_lib
+
+        def _shard_pages(leaf):
+            return sharding_lib.shard(
+                leaf, sharding_lib.kv_page_axes(leaf.ndim))
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def paged_read_step(pool, table):
+            def read_leaf(leaf):
+                page = leaf.shape[1]
+                flat = leaf.reshape((-1,) + leaf.shape[2:])
+                idx = (table[:, :, None] * page
+                       + jnp.arange(page)[None, None, :]).reshape(
+                           table.shape[0], -1)
+                return _shard_pages(flat[idx])
+            return jax.tree.map(read_leaf, pool)
+    """, 'trace-safety')
+    assert findings == []
+
+
+def test_trace_safety_flags_host_state_in_sharded_gather(tmp_path):
+    """The broken twin: deriving gather indices from HOST allocator
+    state (list pops, int() on a traced table entry) inside the
+    jitted sharded gather freezes one allocation at trace time —
+    every later request would silently read the traced request's
+    pages."""
+    findings = _run_snippet(tmp_path, """
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        FREE_PAGES = [1, 2, 3]
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def paged_read_step(pool, first_page):
+            page_id = int(first_page)        # tracer coercion — flag
+            FREE_PAGES.pop(0)                # closure mutation — flag
+            print('gathering', page_id)      # host call — flag
+            return pool[:, page_id]
+    """, 'trace-safety')
+    rules = _rules(findings)
+    assert 'tracer-coercion' in rules
+    assert 'closure-mutation' in rules
+    assert 'host-call' in rules
+
+
 def test_trace_safety_passes_hf_import_placement_helper(tmp_path):
     """The HF-import hot loop's idiom (ISSUE 12): the jitted donated
     layer-placement helper — dynamic_update_index_in_dim with a

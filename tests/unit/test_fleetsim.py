@@ -334,6 +334,25 @@ class TestSimFleet:
         assert len(warm) == hits
         assert all(abs(t - 0.05) < 1e-3 for t in warm)
 
+    def test_mesh_shape_declares_topology_and_enforces_gate(self):
+        """ISSUE 14: mesh_shape declares the replica's sharded
+        topology, and the profile enforces the ENGINE's composition
+        rule — a context-sharded replica runs dense, so a prefix-hit
+        term there would gate on counters the real engine can never
+        emit."""
+        p = replicas_lib.ReplicaProfile(
+            mesh_shape=(('tensor', 4),), prefix_hit_ratio=0.8)
+        assert p.mesh_ways('tensor') == 4
+        assert p.mesh_ways('context') == 1
+        with pytest.raises(ValueError, match='context'):
+            replicas_lib.ReplicaProfile(
+                mesh_shape=(('tensor', 2), ('context', 2)),
+                prefix_hit_ratio=0.8)
+        # Context sharding without the prefix term is fine (dense
+        # long-context replicas are a real topology).
+        replicas_lib.ReplicaProfile(
+            mesh_shape=(('tensor', 2), ('context', 2)))
+
 
 # --- the tier-1 smoke scenario (the CI gate) --------------------------------
 
@@ -438,6 +457,36 @@ class TestSmokeScenario:
         data = json.loads(open(os.path.join(
             str(tmp_path), 'SLO_shared_prefix.json')).read())
         assert data['rc'] == 0 and data['scenario'] == 'shared_prefix'
+
+    def test_sharded_serve_scenario_gates_decode_and_hit_ratio(
+            self, tmp_path):
+        """ISSUE 14 satellite: the sharded_serve scenario drives
+        tensor=4-sharded replicas (paged pool + prefix cache — the
+        composition this PR unlocked) and gates BOTH the
+        decode-step p95 and the prefix hit ratio from the live
+        skytpu_* registry series."""
+        sim = runner_lib.FleetSim(
+            runner_lib.SCENARIOS['sharded_serve'], seed=0,
+            out_dir=str(tmp_path))
+        assert runner_lib.SCENARIOS['sharded_serve'].profile \
+            .mesh_ways('tensor') == 4
+        report = sim.run()
+        by_name = {r['name']: r for r in report['asserts']}
+        assert by_name['decode_step_p95']['ok'], \
+            by_name['decode_step_p95']
+        assert by_name['decode_step_p95']['metric'] == \
+            'skytpu_decode_step_seconds'
+        hit = by_name['prefix_hit_ratio']
+        assert hit['ok'], hit
+        assert hit['metric'] == 'skytpu_prefix_cache_hits_total'
+        # Resolved from real counter deltas near the configured 0.8.
+        assert 0.7 <= hit['value'] <= 1.0
+        assert by_name['ttft_p95']['ok'], by_name['ttft_p95']
+        assert report['rc'] == 0, report['asserts']
+        assert report['extra']['requests'] > 1000
+        data = json.loads(open(os.path.join(
+            str(tmp_path), 'SLO_sharded_serve.json')).read())
+        assert data['rc'] == 0 and data['scenario'] == 'sharded_serve'
 
     def test_controller_stall_and_crash_fault_modes(self, tmp_path):
         """`controller.step` has two chaos modes: latency_only arms a

@@ -239,17 +239,43 @@ class TestPagedKv:
         rid = eng.submit([5, 6], _greedy(3))
         assert len(eng.run_to_completion()[rid]) == 3
 
-    def test_explicit_paging_with_mesh_rejected(self, tiny):
+    def test_paging_mesh_gates(self, tiny, monkeypatch):
+        """ISSUE 14 contract: pages compose with TENSOR-sharded
+        meshes (the pool's KV-heads axis shards over 'tensor'; it is
+        now the sharded default too), while a CONTEXT-sharded mesh
+        keeps the dense layout — explicit pages there are a loud
+        error, the default silently stays dense (the seq dim
+        context-shards)."""
         from skypilot_tpu.parallel import MeshSpec, make_mesh
         config, params = tiny
         mesh = make_mesh(MeshSpec(data=1, fsdp=4, tensor=2))
-        with pytest.raises(ValueError, match='page'):
-            inference.InferenceEngine(params, config, batch_size=2,
-                                      max_seq_len=64, mesh=mesh,
-                                      kv_page_size=16)
-        # Default paging silently stays dense under a mesh.
+        eng = inference.InferenceEngine(params, config, batch_size=2,
+                                        max_seq_len=64, mesh=mesh,
+                                        kv_page_size=16)
+        assert eng_lib._is_paged(eng.state.cache)
+        k = eng.state.cache['k']
+        # The pool really shards: KV-heads axis split over 'tensor'.
+        assert (k.sharding.shard_shape(k.shape)[3]
+                == config.num_kv_heads // 2)
+        # Paging is the sharded DEFAULT on tensor meshes...
+        monkeypatch.delenv('SKYTPU_KV_PAGES_SHARDED', raising=False)
         eng = inference.InferenceEngine(params, config, batch_size=2,
                                         max_seq_len=64, mesh=mesh)
+        assert eng_lib._is_paged(eng.state.cache)
+        # ...unless SKYTPU_KV_PAGES_SHARDED pins sharded engines dense.
+        monkeypatch.setenv('SKYTPU_KV_PAGES_SHARDED', '0')
+        eng = inference.InferenceEngine(params, config, batch_size=2,
+                                        max_seq_len=64, mesh=mesh)
+        assert not eng_lib._is_paged(eng.state.cache)
+        monkeypatch.delenv('SKYTPU_KV_PAGES_SHARDED')
+        cmesh = make_mesh(MeshSpec(data=1, fsdp=2, context=2, tensor=2))
+        with pytest.raises(ValueError, match='context'):
+            inference.InferenceEngine(params, config, batch_size=2,
+                                      max_seq_len=64, mesh=cmesh,
+                                      kv_page_size=16)
+        # Default paging silently stays dense under a context mesh.
+        eng = inference.InferenceEngine(params, config, batch_size=2,
+                                        max_seq_len=64, mesh=cmesh)
         assert not eng_lib._is_paged(eng.state.cache)
 
     def test_paged_composes_with_int8_and_spec(self, tiny):
