@@ -16,7 +16,10 @@ bench.py does.
 import argparse
 import asyncio
 import json
+import os
 import random
+import subprocess
+import sys
 import time
 
 
@@ -241,6 +244,191 @@ async def run(url: str, concurrency: int, requests: int,
     }
 
 
+# --- multi-replica LB comparison (the prefix-affinity capstone) -------------
+
+def _free_port() -> int:
+    import socket
+    s = socket.socket()
+    s.bind(('127.0.0.1', 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+async def _replica_health(session, url: str):
+    try:
+        async with session.get(f'{url}/health') as resp:
+            return await resp.json()
+    except Exception:  # noqa: BLE001 — snapshot is best-effort
+        return {}
+
+
+async def _lb_pass(url: str, replica_urls, families: int,
+                   prompt_len: int, tail_len: int,
+                   max_new_tokens: int, concurrency: int,
+                   warm_rounds: int):
+    """One policy's measurement: FRESH prompt families (the previous
+    pass's warm caches must never masquerade as this pass's), one
+    COLD request per family seeding the fleet through the LB, then
+    `warm_rounds x families` CONCURRENT warm requests — concurrency
+    matters, because a sequential warm phase would let even a
+    scatter policy land every request on one (warm) replica."""
+    import aiohttp
+    rng = random.Random()
+    prefixes = [[rng.randint(1, 200) for _ in range(prompt_len)]
+                for _ in range(families)]
+
+    def make_prompt(family: int):
+        return prefixes[family] + [rng.randint(1, 200)
+                                   for _ in range(tail_len)]
+
+    timeout = aiohttp.ClientTimeout(total=None, sock_connect=30)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        hits_before = {}
+        for r in replica_urls:
+            doc = await _replica_health(session, r)
+            hits_before[r] = doc.get('engine', {}).get(
+                'prefix_cache', {}).get('hits', 0)
+        cold = [await _one_request(session, url, prompt_len,
+                                   max_new_tokens,
+                                   prompt=make_prompt(f))
+                for f in range(families)]
+        sem = asyncio.Semaphore(concurrency)
+        warm = []
+
+        async def bounded(f: int):
+            async with sem:
+                warm.append(await _one_request(
+                    session, url, prompt_len, max_new_tokens,
+                    prompt=make_prompt(f)))
+
+        await asyncio.gather(*[bounded(i % families)
+                               for i in range(warm_rounds * families)])
+        # Per-replica hit deltas: WHERE the warm traffic actually
+        # found its pages — the routing story behind the p50s.
+        replica_hits = {}
+        for r in replica_urls:
+            doc = await _replica_health(session, r)
+            replica_hits[r] = doc.get('engine', {}).get(
+                'prefix_cache', {}).get('hits', 0) - hits_before[r]
+        try:
+            async with session.get(f'{url}/internal/stats') as resp:
+                routing = (await resp.json()).get('routing', {})
+        except Exception:  # noqa: BLE001 — stats are evidence, not gating
+            routing = {}
+    return {
+        'ttft_cold_p50_s': round(_pct([r['ttft'] for r in cold],
+                                      0.5), 4),
+        'ttft_warm_p50_s': round(_pct([r['ttft'] for r in warm],
+                                      0.5), 4),
+        'ttft_warm_p95_s': round(_pct([r['ttft'] for r in warm],
+                                      0.95), 4),
+        'warm_requests': len(warm),
+        'replica_warm_hits': replica_hits,
+        'lb_routing': routing,
+    }
+
+
+def run_lb_compare(args):
+    """The real-process capstone: N real inference servers behind the
+    REAL HTTP LoadBalancer, the shared-prefix workload measured once
+    per routing policy. With least_load, warm requests scatter — a
+    family's pages are warm on ~1/N of the fleet. With
+    prefix_affinity, the LB's fingerprint index pins each family to
+    the replica that prefilled it. Same servers, fresh families per
+    pass, so the ratio isolates ROUTING."""
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    sys.path.insert(0, repo_root)
+    from skypilot_tpu.serve import load_balancer as lb_lib
+
+    families = args.shared_prefix or 6
+    ports = [_free_port() for _ in range(args.lb_replicas)]
+    urls = [f'http://127.0.0.1:{p}' for p in ports]
+    max_seq = max(2048,
+                  args.prompt_len + args.tail_len
+                  + args.max_new_tokens + 64)
+    procs = []
+    log = open(args.lb_server_log, 'ab') if args.lb_server_log \
+        else subprocess.DEVNULL
+    try:
+        for port in ports:
+            procs.append(subprocess.Popen(
+                [sys.executable, '-m', 'skypilot_tpu.inference.server',
+                 '--model', 'tiny', '--port', str(port),
+                 '--batch-size', '8', '--max-seq-len', str(max_seq)],
+                cwd=repo_root, stdout=log, stderr=log))
+
+        async def _prepare():
+            import aiohttp
+            timeout = aiohttp.ClientTimeout(total=None,
+                                            sock_connect=30)
+            async with aiohttp.ClientSession(
+                    timeout=timeout) as session:
+                for url in urls:
+                    await _wait_ready(session, url,
+                                      args.ready_timeout)
+                    # Per-server warmup at the MEASURED shapes:
+                    # every replica pays its prefill/decode compiles
+                    # now, not inside either policy's cold phase.
+                    await _one_request(
+                        session, url,
+                        args.prompt_len + args.tail_len,
+                        args.max_new_tokens)
+
+        asyncio.run(_prepare())
+
+        passes = {}
+        for policy in (args.lb_baseline_policy, args.lb_policy):
+            lb = lb_lib.LoadBalancer(policy, honor_env_policy=False)
+            lb.set_replicas(urls)
+            lb_port = lb.start()
+            try:
+                passes[policy] = asyncio.run(_lb_pass(
+                    f'http://127.0.0.1:{lb_port}', urls, families,
+                    args.prompt_len, args.tail_len,
+                    args.max_new_tokens, args.concurrency,
+                    args.lb_warm_rounds))
+            finally:
+                lb.stop()
+    finally:
+        for proc in procs:
+            proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        if log is not subprocess.DEVNULL:
+            log.close()
+
+    base = passes[args.lb_baseline_policy]
+    aff = passes[args.lb_policy]
+    warm_aff = aff['ttft_warm_p50_s']
+    speedup = round(base['ttft_warm_p50_s'] / warm_aff, 2) \
+        if warm_aff else 0.0
+    return {
+        'metric': 'lb_affinity_warm_ttft_speedup',
+        'value': speedup,
+        'unit': 'x',
+        # rc=0 only when affinity actually improved warm TTFT p50
+        # through the live fleet — the capstone's acceptance bar.
+        'rc': 0 if speedup >= args.lb_min_speedup else 1,
+        'extra': {
+            'workload': 'lb_compare',
+            'replicas': args.lb_replicas,
+            'families': families,
+            'prefix_len': args.prompt_len,
+            'tail_len': args.tail_len,
+            'max_new_tokens': args.max_new_tokens,
+            'concurrency': args.concurrency,
+            'warm_rounds': args.lb_warm_rounds,
+            'policies': {args.lb_baseline_policy: base,
+                         args.lb_policy: aff},
+        },
+    }
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--url', default='http://127.0.0.1:8080')
@@ -261,11 +449,40 @@ def main() -> None:
     parser.add_argument('--tail-len', type=int, default=16,
                         help='Unique tokens appended per request in '
                              'the --shared-prefix workload.')
+    parser.add_argument('--lb-replicas', type=int, default=0,
+                        metavar='N',
+                        help='Multi-replica LB comparison: launch N '
+                             'real inference servers behind the real '
+                             'HTTP load balancer and measure the '
+                             '--shared-prefix workload once per '
+                             'routing policy (--lb-policy vs '
+                             '--lb-baseline-policy). 0 = off.')
+    parser.add_argument('--lb-policy', default='prefix_affinity',
+                        help='Routing policy under test in the '
+                             '--lb-replicas comparison.')
+    parser.add_argument('--lb-baseline-policy', default='least_load',
+                        help='Baseline routing policy in the '
+                             '--lb-replicas comparison.')
+    parser.add_argument('--lb-warm-rounds', type=int, default=4,
+                        help='Concurrent warm requests per family '
+                             'per policy pass in the --lb-replicas '
+                             'comparison.')
+    parser.add_argument('--lb-min-speedup', type=float, default=1.2,
+                        help='Warm-TTFT p50 speedup (baseline/'
+                             'affinity) below which the --lb-replicas '
+                             'comparison reports rc=1.')
+    parser.add_argument('--lb-server-log', default=None,
+                        help='File the launched replica servers '
+                             'append stdout/stderr to (default: '
+                             'discarded).')
     args = parser.parse_args()
-    metric = ('serve_warm_prefix_ttft_speedup' if args.shared_prefix
-              else 'serve_decode_tokens_per_sec')
+    metric = ('lb_affinity_warm_ttft_speedup' if args.lb_replicas
+              else 'serve_warm_prefix_ttft_speedup'
+              if args.shared_prefix else 'serve_decode_tokens_per_sec')
     try:
-        if args.shared_prefix:
+        if args.lb_replicas:
+            report = run_lb_compare(args)
+        elif args.shared_prefix:
             report = asyncio.run(run_shared_prefix(
                 args.url.rstrip('/'), args.concurrency,
                 args.requests, args.prompt_len, args.max_new_tokens,
@@ -282,11 +499,16 @@ def main() -> None:
         # rc=1, never a bare traceback a driver can't gate on.
         print(json.dumps({
             'metric': metric, 'value': 0.0,
-            'unit': 'x' if args.shared_prefix else 'tokens/s',
+            'unit': ('x' if args.shared_prefix or args.lb_replicas
+                     else 'tokens/s'),
             'rc': 1,
             'extra': {'error': f'{type(e).__name__}: {e}'}}))
         raise SystemExit(1)
     print(json.dumps(report))
+    if report.get('rc'):
+        # The comparison ran but missed its bar: the JSON line above
+        # carries the evidence; the exit code makes it gateable.
+        raise SystemExit(1)
 
 
 if __name__ == '__main__':

@@ -331,6 +331,47 @@ SKYTPU_LB_STREAM_READ_TIMEOUT = declare(
     'already sent response bytes; a wedged upstream terminates the '
     'client stream instead of hanging it. 0 disables.')
 
+# --- serve LB routing (prefix affinity + replica pools) ---------------------
+
+SKYTPU_LB_POLICY = declare(
+    'SKYTPU_LB_POLICY', str, None,
+    'Override the load-balancing policy the service spec picked '
+    '(round_robin / least_load / prefix_affinity) without editing the '
+    'task YAML — an operator escape hatch for live A/B routing runs.')
+SKYTPU_LB_AFFINITY_BOUND = declare(
+    'SKYTPU_LB_AFFINITY_BOUND', float, 2.0,
+    'Bounded-load constant c for prefix-affinity routing: the affine '
+    'replica is skipped (least-load fallback) once its load would '
+    'exceed ceil(c * (total_load + 1) / replicas) — affinity must '
+    'never create a hotspot.')
+SKYTPU_LB_AFFINITY_PAGE_TOKENS = declare(
+    'SKYTPU_LB_AFFINITY_PAGE_TOKENS', int, 64,
+    'Token-page granularity of the LB\'s prompt-prefix fingerprint '
+    'index. Match the engine\'s SKYTPU_KV_PAGE_SIZE so LB affinity '
+    'decisions align with what the replica radix cache can actually '
+    'reuse.')
+SKYTPU_LB_AFFINITY_MAX_ENTRIES = declare(
+    'SKYTPU_LB_AFFINITY_MAX_ENTRIES', int, 65536,
+    'LRU cap on prompt-prefix fingerprints the LB affinity index '
+    'holds (each entry maps one page-aligned prefix to the replicas '
+    'that served it).')
+SKYTPU_LB_AFFINITY_LOAD_WINDOW = declare(
+    'SKYTPU_LB_AFFINITY_LOAD_WINDOW', float, 1.0,
+    'Seconds of recent request starts counted (on top of in-flight '
+    'requests) as a replica\'s load in the bounded-load check — '
+    'protects against a burst of simultaneous dispatches to one warm '
+    'replica. 0 uses pure in-flight load.')
+SKYTPU_LB_POOL_PROMPT_THRESHOLD = declare(
+    'SKYTPU_LB_POOL_PROMPT_THRESHOLD', int, 1024,
+    'Prompt-token count at or above which a request counts as '
+    'long-prompt for replica-pool routing (long-prompt + short-gen '
+    'requests prefer the prefill-role pool).')
+SKYTPU_LB_POOL_MAX_NEW_THRESHOLD = declare(
+    'SKYTPU_LB_POOL_MAX_NEW_THRESHOLD', int, 32,
+    'max_new_tokens at or below which a request counts as short-gen '
+    'for replica-pool routing; paired with '
+    'SKYTPU_LB_POOL_PROMPT_THRESHOLD.')
+
 # --- fleet simulation / soak harness ----------------------------------------
 
 SKYTPU_FLEETSIM_SEED = declare(

@@ -19,7 +19,8 @@ SKYTPU_FAULTS like any other fault.
 import dataclasses
 import enum
 import math
-from typing import Dict, List, Optional
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.observability import instruments as obs
 from skypilot_tpu.resilience import faults
@@ -66,6 +67,15 @@ class ReplicaProfile:
     prefix_hit_ratio: float = 0.0      # 0 = no prefix-cache modeling
     warm_ttft_factor: float = 0.12     # warm TTFT / cold TTFT
     shared_prefix_tokens: int = 0      # reused tokens per hit
+    # CONTENT-aware prefix cache (ISSUE 15): capacity > 0 replaces
+    # the random hit model above with a per-replica LRU over the
+    # prefix keys the replica has actually served — a hit happens iff
+    # THIS replica saw THIS prefix family recently, so the fleet-wide
+    # hit ratio becomes a function of ROUTING (prefix-affinity keeps
+    # families pinned, least_load scatters them), which is exactly
+    # what the prefix_affinity scenario measures. Hits/misses land in
+    # the same REAL skytpu_prefix_cache_* counters.
+    prefix_cache_capacity: int = 0     # prefix families cached; 0=off
     # Speculative decode term (ISSUE 13): spec_k > 0 models fused
     # draft-propose/verify rounds — each round the draft proposes
     # spec_k tokens, a leading run of Bernoulli(spec_accept_prob)
@@ -93,7 +103,9 @@ class ReplicaProfile:
 
     def __post_init__(self):
         ways = dict(self.mesh_shape)
-        if self.prefix_hit_ratio > 0 and ways.get('context', 1) > 1:
+        if (self.prefix_hit_ratio > 0
+                or self.prefix_cache_capacity > 0) and \
+                ways.get('context', 1) > 1:
             raise ValueError(
                 'prefix_hit_ratio > 0 needs the paged KV layout, but '
                 'a context-sharded replica (mesh_shape context > 1) '
@@ -138,14 +150,15 @@ class _State(enum.Enum):
 class SimReplica:
     __slots__ = ('replica_id', 'zone', 'use_spot', 'endpoint', 'state',
                  'provision_done', 'ready_at', 'tick_requests',
-                 'tick_busy_s')
+                 'tick_busy_s', 'pool', 'prefix_cache')
 
     def __init__(self, replica_id: int, zone: Optional[str],
                  use_spot: bool, created_at: float,
-                 startup_s: float) -> None:
+                 startup_s: float, pool: Optional[str] = None) -> None:
         self.replica_id = replica_id
         self.zone = zone
         self.use_spot = use_spot
+        self.pool = pool
         self.endpoint = f'http://replica-{replica_id}.sim:8080'
         self.state = _State.PROVISIONING
         # Cluster up (endpoint known) well before the app is ready —
@@ -154,6 +167,10 @@ class SimReplica:
         self.ready_at = created_at + startup_s
         self.tick_requests = 0
         self.tick_busy_s = 0.0
+        # Content-aware radix-cache model: LRU over served prefix
+        # keys (a fresh replica boots COLD — routing has to re-warm
+        # it, exactly like production churn).
+        self.prefix_cache: 'OrderedDict' = OrderedDict()
 
 
 class SimFleet:
@@ -162,9 +179,15 @@ class SimFleet:
     def __init__(self, service_name: str, clock, rng,
                  profile: ReplicaProfile,
                  zones: Optional[List[str]] = None,
-                 default_use_spot: bool = False) -> None:
+                 default_use_spot: bool = False,
+                 pool_profiles: Optional[
+                     Dict[str, ReplicaProfile]] = None) -> None:
         self.service_name = service_name
         self.profile = profile
+        # Disaggregated pools: per-pool latency/capacity shapes
+        # (prefill-heavy vs decode-heavy hardware); replicas in an
+        # unlisted pool fall back to the default profile.
+        self.pool_profiles = dict(pool_profiles or {})
         self.zones = list(zones or [])
         self.default_use_spot = default_use_spot
         self._clock = clock
@@ -174,6 +197,11 @@ class SimFleet:
         self._lost_zones: set = set()
         self._preemption_pending = False
         self._tick_seconds = 1.0
+
+    def profile_for(self, pool: Optional[str]) -> ReplicaProfile:
+        if pool is not None and pool in self.pool_profiles:
+            return self.pool_profiles[pool]
+        return self.profile
 
     # -- chaos hooks ---------------------------------------------------------
 
@@ -192,24 +220,27 @@ class SimFleet:
     # -- the ReplicaManager surface ------------------------------------------
 
     def scale_up(self, n: int = 1,
-                 use_spot: Optional[bool] = None) -> List[int]:
+                 use_spot: Optional[bool] = None,
+                 pool: Optional[str] = None) -> List[int]:
         service = serve_state.get_service(self.service_name)
         version = service['version'] if service else 1
         spot = self.default_use_spot if use_spot is None else use_spot
+        profile = self.profile_for(pool)
         now = self._clock.now()
         launched = []
         for _ in range(n):
             rid = serve_state.next_replica_id(self.service_name)
             zone = self._pick_zone()
             startup = self._rng.lognormvariate(
-                _mu(self.profile.startup_median_s),
-                self.profile.startup_sigma)
-            r = SimReplica(rid, zone, spot, now, startup)
+                _mu(profile.startup_median_s),
+                profile.startup_sigma)
+            r = SimReplica(rid, zone, spot, now, startup, pool=pool)
             self._replicas[rid] = r
             self._by_endpoint[r.endpoint] = r
             serve_state.add_replica(self.service_name, rid,
                                     f'sim-{self.service_name}-{rid}',
-                                    version, use_spot=spot, zone=zone)
+                                    version, use_spot=spot, zone=zone,
+                                    pool=pool)
             launched.append(rid)
         return launched
 
@@ -264,7 +295,9 @@ class SimFleet:
                 self.service_name, r.replica_id,
                 serve_state.ReplicaStatus.PREEMPTED)
             self.scale_down([r.replica_id])
-            self.scale_up(1, use_spot=r.use_spot)
+            # Replacement stays in the dead replica's pool: a lost
+            # prefill replica must not come back decode-shaped.
+            self.scale_up(1, use_spot=r.use_spot, pool=r.pool)
 
     def ready_endpoints(self) -> List[str]:
         return [r.endpoint for r in self._replicas.values()
@@ -306,22 +339,46 @@ class SimFleet:
             r.tick_requests = 0
             r.tick_busy_s = 0.0
 
-    def handle_request(self, endpoint: str):
+    def handle_request(self, endpoint: str,
+                       context: Optional[Dict[str, Any]] = None):
         """One request hitting `endpoint`. Returns (ttft_s, total_s)
         on success, None when the replica is gone or not serving (the
         LB's dispatch() treats that as a transport failure and fails
-        over)."""
+        over). `context` is the same routing context the LB peeked —
+        content-aware replicas key their prefix-cache model off its
+        `prefix_key`."""
         r = self._by_endpoint.get(endpoint)
         if r is None or r.state != _State.READY:
             return None
-        p = self.profile
+        p = self.profile_for(r.pool)
         # Per-tick utilization of this replica's decode slots; TTFT
         # inflates hyperbolically toward saturation (open-loop
         # arrivals queue behind busy slots).
         rho = r.tick_busy_s / (self._tick_seconds * p.concurrency)
         ttft = self._rng.lognormvariate(_mu(p.ttft_median_s),
                                         p.ttft_sigma)
-        if p.prefix_hit_ratio > 0:
+        if p.prefix_cache_capacity > 0 and context is not None:
+            # Content-aware model: warm iff THIS replica served THIS
+            # prefix recently — the hit ratio is now a routing
+            # outcome, not a profile constant. A request with no
+            # prefix key (unique long prompt) is an honest miss.
+            key = context.get('prefix_key')
+            if key is not None and key in r.prefix_cache:
+                r.prefix_cache.move_to_end(key)
+                ttft *= p.warm_ttft_factor
+                obs.PREFIX_CACHE_HITS.inc()
+                reused = context.get('prefix_tokens',
+                                     p.shared_prefix_tokens)
+                if reused:
+                    obs.PREFIX_CACHE_REUSED_TOKENS.inc(reused)
+            else:
+                obs.PREFIX_CACHE_MISSES.inc()
+                if key is not None:
+                    r.prefix_cache[key] = True
+                    while len(r.prefix_cache) > \
+                            p.prefix_cache_capacity:
+                        r.prefix_cache.popitem(last=False)
+        elif p.prefix_hit_ratio > 0:
             if self._rng.random() < p.prefix_hit_ratio:
                 # Warm prefix: the matched span's prefill is skipped.
                 ttft *= p.warm_ttft_factor
@@ -386,21 +443,33 @@ class SimFleet:
         exports in production (skytpu_queue_depth,
         skytpu_kv_cache_utilization) so MetricsSignalSource — and
         therefore the autoscaler under test — reads real registry
-        series."""
-        p = self.profile
+        series. Pooled replicas ALSO publish per-pool series
+        (skytpu_pool_queue_depth{pool=...}) — the signals each
+        pool's autoscaler consumes."""
         queued = 0.0
         utils = []
+        by_pool: Dict[str, List] = {}
         for r in self._replicas.values():
             if r.state != _State.READY:
                 continue
+            p = self.profile_for(r.pool)
             cap = self._tick_seconds * p.concurrency
             rho = r.tick_busy_s / cap if cap else 0.0
             utils.append(min(1.0, rho))
             excess_s = max(0.0, r.tick_busy_s - cap)
-            queued += excess_s / max(p.service_mean_s(), 1e-9)
+            q = excess_s / max(p.service_mean_s(), 1e-9)
+            queued += q
+            if r.pool is not None:
+                by_pool.setdefault(r.pool, []).append(
+                    (min(1.0, rho), q))
         obs.QUEUE_DEPTH.set(queued)
         obs.KV_CACHE_UTILIZATION.set(
             sum(utils) / len(utils) if utils else 0.0)
+        for pool, samples in by_pool.items():
+            obs.POOL_QUEUE_DEPTH.labels(pool=pool).set(
+                sum(q for _rho, q in samples))
+            obs.POOL_KV_UTILIZATION.labels(pool=pool).set(
+                sum(rho for rho, _q in samples) / len(samples))
 
     # -- introspection --------------------------------------------------------
 

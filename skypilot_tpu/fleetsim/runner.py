@@ -26,6 +26,7 @@ from skypilot_tpu.fleetsim import slo as slo_lib
 from skypilot_tpu.fleetsim import traffic as traffic_lib
 from skypilot_tpu.observability import instruments as obs
 from skypilot_tpu.resilience import faults
+from skypilot_tpu.serve import autoscalers as autoscalers_lib
 from skypilot_tpu.serve import controller as controller_lib
 from skypilot_tpu.serve import load_balancer as lb_lib
 from skypilot_tpu.serve import serve_state
@@ -55,6 +56,69 @@ class Scenario:
     # Fraction of the pre-event READY count at which a chaos event
     # (zone loss, preemption wave) counts as recovered.
     recovery_threshold: float = 0.95
+    # Disaggregated replica pools: name -> PoolSpec config dict (the
+    # service-spec 'pools' section; min/max_replicas scale with
+    # SKYTPU_FLEETSIM_SCALE). With pools set, `replicas`/`policy` are
+    # ignored — the pools ARE the scaling envelope — and
+    # `pool_profiles` shapes each pool's replicas.
+    pools: Optional[Dict[str, Dict[str, Any]]] = None
+    pool_profiles: Optional[
+        Dict[str, replicas_lib.ReplicaProfile]] = None
+    # Shared-prefix request mix driven through the LB's content
+    # seam: every arrival carries a routing context (prompt tokens,
+    # max_new_tokens, prefix key) the real policy routes on. See
+    # _PrefixWorkload for the knobs.
+    workload: Optional[Dict[str, Any]] = None
+    # A/B comparison: run the scenario a second time under this LB
+    # policy (fresh fleet, same seed/traffic), evaluate
+    # `baseline_slos` over that pass, and gate the primary pass's
+    # cache-hit ratio at >= min_hit_ratio_improvement x the
+    # baseline's — one report carries both sides.
+    compare_lb_policy: Optional[str] = None
+    baseline_slos: Tuple[Any, ...] = ()
+    min_hit_ratio_improvement: float = 2.0
+
+
+class _PrefixWorkload:
+    """Shared-prefix traffic: `families` prompt families, each a
+    page-aligned common prefix plus a per-request random tail (the
+    shared-system-prompt shape of production traffic), mixed with a
+    `long_prompt` fraction of unique long-prompt/short-gen requests
+    (the prefill-pool shape). Deterministic per seed."""
+
+    def __init__(self, cfg: Dict[str, Any], seed: int) -> None:
+        rng = random.Random(seed)
+        self.families = int(cfg.get('families', 48))
+        self.prefix_tokens = int(cfg.get('prefix_tokens', 512))
+        self.tail_tokens = int(cfg.get('tail_tokens', 16))
+        self.max_new_tokens = int(cfg.get('max_new_tokens', 48))
+        long_cfg = cfg.get('long_prompt') or {}
+        self.long_fraction = float(long_cfg.get('fraction', 0.0))
+        self.long_tokens = int(long_cfg.get('prompt_tokens', 2048))
+        self.long_max_new = int(long_cfg.get('max_new_tokens', 16))
+        self._prefixes = [
+            [rng.randint(1, 30000)
+             for _ in range(self.prefix_tokens)]
+            for _ in range(self.families)]
+        self._rng = random.Random(seed + 7)
+
+    def next_context(self) -> Dict[str, Any]:
+        rng = self._rng
+        if self.long_fraction and rng.random() < self.long_fraction:
+            return {
+                'prompt_tokens': [rng.randint(1, 30000)
+                                  for _ in range(self.long_tokens)],
+                'max_new_tokens': self.long_max_new,
+            }
+        f = rng.randrange(self.families)
+        return {
+            'prompt_tokens': self._prefixes[f]
+            + [rng.randint(1, 30000)
+               for _ in range(self.tail_tokens)],
+            'max_new_tokens': self.max_new_tokens,
+            'prefix_key': ('family', f),
+            'prefix_tokens': self.prefix_tokens,
+        }
 
 
 class FleetSim:
@@ -74,29 +138,114 @@ class FleetSim:
 
     # -- setup ---------------------------------------------------------------
 
-    def _service_config(self, n_replicas: int) -> Dict[str, Any]:
+    def _scaled_pools(self) -> Dict[str, Dict[str, Any]]:
+        pools: Dict[str, Dict[str, Any]] = {}
+        for name, cfg in (self.scenario.pools or {}).items():
+            pool = dict(cfg)
+            pool['min_replicas'] = max(1, int(round(
+                cfg.get('min_replicas', 1) * self.scale)))
+            if cfg.get('max_replicas'):
+                pool['max_replicas'] = max(
+                    pool['min_replicas'],
+                    int(round(cfg['max_replicas'] * self.scale)))
+            pools[name] = pool
+        return pools
+
+    def _service_config(self, n_replicas: int,
+                        lb_policy: str) -> Dict[str, Any]:
+        probe = {'path': '/health', 'initial_delay_seconds': 1200,
+                 'timeout_seconds': 5}
+        if self.scenario.pools:
+            return {
+                'readiness_probe': probe,
+                'pools': self._scaled_pools(),
+                'load_balancing_policy': lb_policy,
+            }
         policy: Dict[str, Any] = {'min_replicas': n_replicas}
         for key, value in self.scenario.policy.items():
             if key == 'max_replicas':
                 value = max(n_replicas, int(round(value * self.scale)))
             policy[key] = value
         return {
-            'readiness_probe': {'path': '/health',
-                                'initial_delay_seconds': 1200,
-                                'timeout_seconds': 5},
+            'readiness_probe': probe,
             'replica_policy': policy,
-            'load_balancing_policy': self.scenario.lb_policy,
+            'load_balancing_policy': lb_policy,
         }
 
     # -- the run -------------------------------------------------------------
 
     def run(self) -> Dict[str, Any]:
+        """One scenario run: the primary pass under `lb_policy`, and
+        — when `compare_lb_policy` is set — a second pass under the
+        comparison policy (fresh fleet, identical seeds/traffic) so
+        one report carries the A/B (plus the hit-ratio-improvement
+        gate) instead of two reports an operator has to line up."""
         sc = self.scenario
         wall_start = time.monotonic()
-        wall_budget = envs.SKYTPU_FLEETSIM_MAX_WALL_SECONDS.get()
-        n_replicas = max(1, int(round(sc.replicas * self.scale)))
+        primary = self._run_pass(sc.lb_policy, sc.slos, wall_start)
+        baseline = None
+        if sc.compare_lb_policy and primary['crash'] is None and \
+                not primary['aborted']:
+            baseline = self._run_pass(sc.compare_lb_policy,
+                                      sc.baseline_slos, wall_start)
+        results = list(primary['results'])
+        extra = dict(primary['extra'])
+        aborted = primary['aborted']
+        crash = primary['crash']
+        if baseline is not None:
+            results += baseline['results']
+            extra['baseline'] = baseline['extra']
+            aborted = aborted or baseline['aborted']
+            crash = crash or baseline['crash']
+            if crash is None and not aborted:
+                results.append(self._improvement_assert(results))
+        path, rc = slo_lib.write_report(
+            self.out_dir, sc.name, results, extra=extra,
+            rc_override=1 if (aborted or crash is not None) else None)
+        if crash is not None:
+            # The failing SLO_*.json is on disk and state is clean;
+            # now fail loudly with the original traceback.
+            raise crash
+        return {'rc': rc, 'report_path': path, 'asserts': results,
+                'extra': extra}
 
-        service_cfg = self._service_config(n_replicas)
+    def _improvement_assert(self, results) -> Dict[str, Any]:
+        """The A/B gate: primary cache-hit ratio must beat the
+        baseline's by min_hit_ratio_improvement x. Synthesized from
+        the two passes' evaluated ratios (both resolved from live
+        counter deltas), reported in the same assert schema."""
+        by_name = {r['name']: r for r in results}
+        sc = self.scenario
+        a = by_name.get('cache_hit_ratio', {}).get('value')
+        b = by_name.get('baseline_cache_hit_ratio', {}).get('value')
+        if a is None or b is None:
+            return {'name': 'hit_ratio_improvement',
+                    'metric': 'skytpu_prefix_cache_hits_total',
+                    'ok': False, 'value': None,
+                    'threshold': sc.min_hit_ratio_improvement,
+                    'detail': 'hit-ratio asserts missing from one '
+                              'pass'}
+        improvement = a / max(b, 1e-9)
+        return {'name': 'hit_ratio_improvement',
+                'metric': 'skytpu_prefix_cache_hits_total',
+                'ok': improvement >= sc.min_hit_ratio_improvement,
+                'value': round(improvement, 3),
+                'threshold': sc.min_hit_ratio_improvement,
+                'detail': f'{sc.lb_policy} {a:.3f} vs '
+                          f'{sc.compare_lb_policy} {b:.3f}'}
+
+    def _run_pass(self, lb_policy: str, slos,
+                  wall_start: float) -> Dict[str, Any]:
+        sc = self.scenario
+        wall_budget = envs.SKYTPU_FLEETSIM_MAX_WALL_SECONDS.get()
+        pools = self._scaled_pools() if sc.pools else None
+        if pools:
+            n_replicas = sum(p['min_replicas']
+                             for p in pools.values())
+        else:
+            n_replicas = max(1, int(round(sc.replicas * self.scale)))
+
+        service_cfg = self._service_config(n_replicas, lb_policy)
         serve_state.remove_service(self.service_name)  # stale runs
         serve_state.add_service(
             self.service_name,
@@ -109,20 +258,31 @@ class FleetSim:
         fleet = replicas_lib.SimFleet(
             self.service_name, vclock, fleet_rng, sc.profile,
             zones=list(sc.zones),
-            default_use_spot=bool(
-                service_cfg['replica_policy'].get('use_spot')))
-        lb = lb_lib.LoadBalancer(sc.lb_policy, now_fn=vclock.now)
+            default_use_spot=bool(not pools and service_cfg[
+                'replica_policy'].get('use_spot')),
+            pool_profiles=sc.pool_profiles)
+        lb = lb_lib.LoadBalancer(lb_policy, now_fn=vclock.now,
+                                 honor_env_policy=False)
         ctl = controller_lib.ServeController(
             self.service_name, manager=fleet, lb=lb,
-            now_fn=vclock.now, sleep_fn=vclock.sleep)
+            now_fn=vclock.now, sleep_fn=vclock.sleep,
+            signal_source=autoscalers_lib.MetricsSignalSource(
+                ttft_metric='skytpu_fleetsim_ttft_seconds'))
         serve_state.set_service_status(
             self.service_name, serve_state.ServiceStatus.REPLICA_INIT)
-        fleet.scale_up(n_replicas)
+        if pools:
+            for pool_name, pool_cfg in pools.items():
+                fleet.scale_up(pool_cfg['min_replicas'],
+                               pool=pool_name)
+        else:
+            fleet.scale_up(n_replicas)
+        workload = _PrefixWorkload(sc.workload, self.seed + 2) \
+            if sc.workload else None
 
         curve = traffic_lib.parse(sc.traffic)
         if self.scale != 1.0:
             curve = traffic_lib.scaled(curve, self.scale)
-        evaluator = slo_lib.SLOEvaluator(sc.slos)
+        evaluator = slo_lib.SLOEvaluator(slos)
         # Recovery series persist across scenarios in one process: a
         # previous run's "recovered in 12s" must not satisfy THIS
         # run's GaugeWithin if its chaos event never fires. -1 is the
@@ -142,8 +302,8 @@ class FleetSim:
         aborted: Optional[str] = None
         ticks = 0
 
-        def send(url: str) -> bool:
-            result = fleet.handle_request(url)
+        def send(url: str, context=None) -> bool:
+            result = fleet.handle_request(url, context=context)
             if result is None:
                 return False
             ttft, total = result
@@ -196,7 +356,11 @@ class FleetSim:
                 fleet.begin_tick(self.tick_s)
                 for _ in range(curve.arrivals(traffic_rng, t,
                                               t + self.tick_s)):
-                    outcome = lb.dispatch(send)
+                    ctx = workload.next_context() \
+                        if workload is not None else None
+                    outcome = lb.dispatch(
+                        lambda url, _ctx=ctx: send(url, _ctx),
+                        context=ctx)
                     outcomes[outcome] = outcomes.get(outcome, 0) + 1
                     obs.FLEETSIM_REQUESTS.labels(
                         outcome=outcome).inc()
@@ -214,21 +378,26 @@ class FleetSim:
             self.service_name) - 1
         # Cleanup BEFORE evaluation/reporting — even a crash (or a
         # bug in the evaluator) must not leak armed faults, service
-        # rows, or pressure gauges into the next scenario of this
-        # session.
+        # rows, or pressure gauges into the next scenario (or the
+        # comparison pass) of this session.
         faults.reset()
         fleet.terminate_all()
         serve_state.remove_service(self.service_name)
         obs.QUEUE_DEPTH.set(0)
         obs.KV_CACHE_UTILIZATION.set(0)
+        for gauge in (obs.POOL_QUEUE_DEPTH, obs.POOL_KV_UTILIZATION):
+            for _series, labels, _value in gauge.samples():
+                gauge.labels(**dict(labels)).set(0)
 
         results = evaluator.evaluate()
         extra = {
             'description': sc.description,
+            'lb_policy': lb_policy,
             'seed': self.seed,
             'scale': self.scale,
             'replicas_configured': n_replicas,
             'replicas_driven': replicas_driven,
+            'pools': sorted(pools) if pools else None,
             'simulated_seconds': round(t, 3),
             'ticks': ticks,
             'tick_seconds': self.tick_s,
@@ -241,15 +410,8 @@ class FleetSim:
             'error': (f'{type(crash).__name__}: {crash}'
                       if crash is not None else None),
         }
-        path, rc = slo_lib.write_report(
-            self.out_dir, sc.name, results, extra=extra,
-            rc_override=1 if (aborted or crash is not None) else None)
-        if crash is not None:
-            # The failing SLO_*.json is on disk and state is clean;
-            # now fail loudly with the original traceback.
-            raise crash
-        return {'rc': rc, 'report_path': path, 'asserts': results,
-                'extra': extra}
+        return {'results': results, 'extra': extra, 'crash': crash,
+                'aborted': aborted}
 
     # -- chaos actions -------------------------------------------------------
 
@@ -528,6 +690,108 @@ register(Scenario(
                          'skytpu_prefix_cache_misses_total')),
         slo_lib.HistQuantileBelow('ttft_p95', threshold=1.5),
         slo_lib.RatioBelow('error_rate', threshold=0.005),
+    ),
+))
+
+register(Scenario(
+    name='prefix_affinity',
+    description=('Content-aware serve plane gate (ISSUE 15): a '
+                 'multi-pool fleet (prefill-role + decode-role '
+                 'replicas, each pool scaled by its own signal-'
+                 'driven autoscaler) serving shared-prefix traffic '
+                 'through the REAL LB dispatch + PrefixAffinityPolicy'
+                 '. Replicas model CONTENT-aware radix caches (LRU '
+                 'over served prefix families), so the fleet hit '
+                 'ratio is a routing outcome: affinity keeps '
+                 'families pinned to warm replicas, the least_load '
+                 'baseline pass (same seed, fresh fleet) scatters '
+                 'them. One report gates the affinity hit ratio, '
+                 'warm TTFT p95, decode-step p95 AND the >= 2x '
+                 'hit-ratio improvement over the baseline.'),
+    replicas=30,                       # informational; pools govern
+    duration_s=90.0, tick_s=2.0, warmup_s=24.0,
+    traffic={'kind': 'constant', 'qps': 120.0},
+    profile=_SMOKE_PROFILE,            # fallback only; pools below
+    pools={
+        'prefill': {'role': 'prefill', 'min_replicas': 6,
+                    'max_replicas': 10,
+                    'target_queue_per_replica': 4.0,
+                    'ttft_p95_upscale_threshold': 3.0,
+                    'upscale_delay_seconds': 10,
+                    'downscale_delay_seconds': 120},
+        'decode': {'role': 'decode', 'min_replicas': 24,
+                   'max_replicas': 32,
+                   'target_queue_per_replica': 4.0,
+                   'kv_util_upscale_threshold': 0.85,
+                   'decode_step_p95_upscale_threshold': 0.35,
+                   'upscale_delay_seconds': 10,
+                   'downscale_delay_seconds': 120},
+    },
+    pool_profiles={
+        # Prefill-heavy hardware: absorbs unique 2048-token prompts;
+        # no prefix-cache term (unique prompts never re-match).
+        'prefill': replicas_lib.ReplicaProfile(
+            startup_median_s=6.0, startup_sigma=0.3,
+            ttft_median_s=0.7, ttft_sigma=0.4,
+            tokens_median=16, concurrency=8,
+            decode_step_s=0.12, decode_step_sigma=0.3,
+            fused_steps=8),
+        # Decode-heavy hardware with a content-aware radix cache: 8
+        # prefix families per replica — fleet capacity 8 x 24 = 192
+        # family-slots for 48 families, so ROUTING decides whether a
+        # family's requests find their warm replica.
+        'decode': replicas_lib.ReplicaProfile(
+            startup_median_s=6.0, startup_sigma=0.3,
+            ttft_median_s=0.45, ttft_sigma=0.4,
+            tokens_median=48, concurrency=8,
+            decode_step_s=0.12, decode_step_sigma=0.3,
+            fused_steps=8,
+            prefix_cache_capacity=8, warm_ttft_factor=0.1,
+            shared_prefix_tokens=512),
+    },
+    workload={'families': 48, 'prefix_tokens': 512, 'tail_tokens': 16,
+              'max_new_tokens': 48,
+              'long_prompt': {'fraction': 0.15,
+                              'prompt_tokens': 2048,
+                              'max_new_tokens': 16}},
+    lb_policy='prefix_affinity',
+    compare_lb_policy='least_load',
+    min_hit_ratio_improvement=2.0,
+    slos=(
+        # The fleet-wide cache-hit-ratio gate, from deltas of the
+        # REAL skytpu_prefix_cache_* counters the decode replicas
+        # increment — >= 0.6 is the ISSUE acceptance bar.
+        slo_lib.CounterRatioAbove(
+            'cache_hit_ratio', threshold=0.6,
+            num_metric='skytpu_prefix_cache_hits_total',
+            den_metrics=('skytpu_prefix_cache_hits_total',
+                         'skytpu_prefix_cache_misses_total')),
+        # The median proves warm domination (warm chat TTFT ~0.045s;
+        # a scattered fleet's p50 sits at COLD ~0.45s): affinity has
+        # to buy an order of magnitude here or the ratio above is
+        # hollow.
+        slo_lib.HistQuantileBelow('ttft_p50', threshold=0.35, q=0.5),
+        # The tail carries the 15% unique long-prompt class (cold by
+        # construction) — the budget is the mixed-workload one, not
+        # the warm one.
+        slo_lib.HistQuantileBelow('ttft_p95', threshold=2.0),
+        slo_lib.HistQuantileBelow(
+            'decode_step_p95', threshold=0.35,
+            metric='skytpu_decode_step_seconds'),
+        slo_lib.RatioBelow('error_rate', threshold=0.005),
+    ),
+    # The baseline pass RESOLVES its ratio/latency values without
+    # gating them (threshold 0 / huge): a deliberately-bad baseline
+    # failing its own SLOs must not fail the report — the comparison
+    # assert is the gate.
+    baseline_slos=(
+        slo_lib.CounterRatioAbove(
+            'baseline_cache_hit_ratio', threshold=0.0,
+            num_metric='skytpu_prefix_cache_hits_total',
+            den_metrics=('skytpu_prefix_cache_hits_total',
+                         'skytpu_prefix_cache_misses_total')),
+        slo_lib.HistQuantileBelow('baseline_ttft_p95',
+                                  threshold=1e9),
     ),
 ))
 

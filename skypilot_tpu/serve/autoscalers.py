@@ -10,7 +10,7 @@ request *rate* alone can't see (long prompts, slow decodes).
 """
 import dataclasses
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from skypilot_tpu.serve import service_spec as spec_lib
 
@@ -25,33 +25,123 @@ class ScalingDecision:
 class LoadSignals:
     """One reading of the fleet's load beyond raw request rate.
 
-    queue_depth is fleet-wide requests accepted but not yet decoding;
-    kv_util is the mean fraction of KV-cache positions holding live
-    tokens (0-1). None means "signal unavailable" — scaling then
-    falls back to pure request rate.
+    queue_depth is requests accepted but not yet decoding; kv_util
+    is the mean fraction of KV-cache positions holding live tokens
+    (0-1); ttft_p95 / decode_step_p95 are windowed latency quantiles
+    (seconds) resolved from histogram bucket deltas — the saturation
+    signals the per-pool autoscalers breach-test. None means "signal
+    unavailable" — scaling then falls back to whatever signals
+    remain (ultimately request rate).
     """
     queue_depth: Optional[float] = None
     kv_util: Optional[float] = None
+    ttft_p95: Optional[float] = None
+    decode_step_p95: Optional[float] = None
+
+
+# Below this many histogram samples in a read window, a p95 is noise,
+# not a signal — report it unavailable instead.
+_P95_MIN_SAMPLES = 5
 
 
 class MetricsSignalSource:
-    """Reads LoadSignals off THIS process's skytpu_* registry
-    (skytpu_queue_depth / skytpu_kv_cache_utilization) — the same
-    series /metrics exposes, so what the autoscaler acted on is
+    """Reads LoadSignals off THIS process's skytpu_* registry — the
+    same series /metrics exposes, so what the autoscaler acted on is
     always scrape-able after the fact.
 
-    Scope caveat: those gauges are written by whatever shares the
+    Gauges (queue depth, KV utilization) read instantaneously, with
+    per-pool series (skytpu_pool_queue_depth{pool=...}) preferred and
+    the fleet-wide gauge as fallback when a pool series was never
+    written. Latency p95s resolve from histogram bucket DELTAS
+    between successive read_pools() calls (the same
+    bucket-upper-bound convention fleetsim's SLO evaluator uses), so
+    one controller tick sees that tick's latency, not the process
+    lifetime's.
+
+    Scope caveat: these series are written by whatever shares the
     process — the fleet simulator's SimFleet, or a co-located engine.
     A production controller whose replicas run elsewhere reads 0.0
     (signals absent, scaling falls back to request rate) until a
     scraping source is wired in: the controller takes any object with
-    read() via its signal_source seam, and aggregating replica
-    /metrics into one is the ROADMAP item-3 follow-up."""
+    read()/read_pools() via its signal_source seam, and aggregating
+    replica /metrics into one is the ROADMAP item-2 follow-up."""
+
+    def __init__(self, ttft_metric: str = 'skytpu_prefill_seconds',
+                 decode_step_metric: str = 'skytpu_decode_step_seconds'
+                 ) -> None:
+        self.ttft_metric = ttft_metric
+        self.decode_step_metric = decode_step_metric
+        self._snaps: Dict[str, Dict] = {}
+
+    def _pool_gauge(self, gauge, pool: Optional[str],
+                    fallback) -> float:
+        """Per-pool series when it exists, fleet-wide otherwise: a
+        never-written labeled gauge reads 0.0 through value(), which
+        would look like 'no pressure' — existence-check instead."""
+        if pool is not None:
+            for series, labels, value in gauge.samples():
+                if dict(labels).get('pool') == pool:
+                    return value
+        return fallback.value()
+
+    def _p95_delta(self, metric_name: str) -> Optional[float]:
+        import math
+        from skypilot_tpu.observability import metrics as metrics_lib
+        metric = metrics_lib.REGISTRY.get(metric_name)
+        if metric is None:
+            return None
+        snap = {(series, labels): value
+                for series, labels, value in metric.samples()}
+        prev = self._snaps.get(metric_name, {})
+        self._snaps[metric_name] = snap
+        buckets = []
+        count = 0.0
+        for (series, labels), value in snap.items():
+            delta = value - prev.get((series, labels), 0.0)
+            if series == f'{metric_name}_bucket':
+                le = dict(labels)['le']
+                bound = math.inf if le == '+Inf' else float(le)
+                buckets.append((bound, delta))
+            elif series == f'{metric_name}_count':
+                count += delta
+        if count < _P95_MIN_SAMPLES:
+            return None
+        top_finite = None
+        for bound, cum in sorted(buckets):
+            if bound != math.inf:
+                top_finite = bound
+            if cum >= 0.95 * count:
+                # A p95 past the top finite bucket is still a BREACH
+                # signal, not a missing one: report the top finite
+                # bound as a known floor — returning None here would
+                # blind the pool autoscaler exactly at worst
+                # saturation.
+                return top_finite if bound == math.inf else bound
+        return None
 
     def read(self) -> LoadSignals:
         from skypilot_tpu.observability import instruments as obs
         return LoadSignals(queue_depth=obs.QUEUE_DEPTH.value(),
                            kv_util=obs.KV_CACHE_UTILIZATION.value())
+
+    def read_pools(self, pools) -> Dict[Optional[str], LoadSignals]:
+        """One snapshot for all pools: the histogram windows are
+        consumed ONCE per call (per-pool calls would hand the delta
+        to whichever pool asked first)."""
+        from skypilot_tpu.observability import instruments as obs
+        ttft_p95 = self._p95_delta(self.ttft_metric)
+        decode_p95 = self._p95_delta(self.decode_step_metric)
+        out: Dict[Optional[str], LoadSignals] = {}
+        for pool in pools:
+            out[pool] = LoadSignals(
+                queue_depth=self._pool_gauge(
+                    obs.POOL_QUEUE_DEPTH, pool, obs.QUEUE_DEPTH),
+                kv_util=self._pool_gauge(
+                    obs.POOL_KV_UTILIZATION, pool,
+                    obs.KV_CACHE_UTILIZATION),
+                ttft_p95=ttft_p95,
+                decode_step_p95=decode_p95)
+        return out
 
 
 class Autoscaler:
@@ -217,6 +307,67 @@ class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
         return MixedScalingDecision(
             spot_target, ondemand_target,
             f'total={total} spot_ready={num_ready_spot}')
+
+
+class PoolAutoscaler(RequestRateAutoscaler):
+    """Signal-driven scaling for ONE named replica pool.
+
+    The pool's role picks its saturation signals via the PoolSpec
+    thresholds: a prefill pool scales on queue depth + TTFT p95, a
+    decode pool on KV utilization + decode-step p95 — never raw
+    request rate alone (target_qps_per_replica is optional and, when
+    set, interprets the FLEET rate as a floor, since per-pool request
+    rates are not separable at the tracker). Inherits the
+    upscale/downscale hysteresis so p95 blips don't thrash the pool.
+    """
+
+    def __init__(self, pool: spec_lib.PoolSpec,
+                 now_fn=time.time) -> None:
+        # PoolSpec quacks like the spec the hysteresis base class
+        # reads (min/max_replicas, delays); Autoscaler.__init__ just
+        # stores it.
+        super().__init__(pool, now_fn=now_fn)
+
+    def _desired(self, qps: float,
+                 signals: Optional[LoadSignals] = None) -> int:
+        import math
+        p = self.spec
+        desired = p.min_replicas
+        if p.target_qps_per_replica:
+            desired = max(desired,
+                          math.ceil(qps / p.target_qps_per_replica))
+        # Pressure signals only ever RAISE the target (same rule as
+        # the fleet-wide autoscaler): their absence must not fight
+        # the other signals downward.
+        if signals is not None:
+            if p.target_queue_per_replica and signals.queue_depth:
+                desired = max(
+                    desired, math.ceil(signals.queue_depth
+                                       / p.target_queue_per_replica))
+            for value, threshold in (
+                    (signals.kv_util, p.kv_util_upscale_threshold),
+                    (signals.ttft_p95, p.ttft_p95_upscale_threshold),
+                    (signals.decode_step_p95,
+                     p.decode_step_p95_upscale_threshold)):
+                if threshold is not None and value is not None and \
+                        value >= threshold:
+                    # One extra replica per breached signal per
+                    # decision round: bounded relief, hysteresis
+                    # still paces the resize.
+                    desired += 1
+        hi = p.max_replicas if p.max_replicas is not None else \
+            max(p.min_replicas, desired)
+        return max(p.min_replicas, min(hi, desired))
+
+
+def make_pool_autoscalers(spec: spec_lib.ServiceSpec,
+                          now_fn=time.time
+                          ) -> Dict[str, PoolAutoscaler]:
+    """One PoolAutoscaler per named pool (empty for poolless specs)."""
+    if not spec.pools:
+        return {}
+    return {name: PoolAutoscaler(pool, now_fn=now_fn)
+            for name, pool in spec.pools.items()}
 
 
 def make_autoscaler(spec: spec_lib.ServiceSpec,

@@ -68,6 +68,11 @@ class ServeController:
                 service_name, self.task, self.spec)
         self.autoscaler = autoscalers.make_autoscaler(self.spec,
                                                       now_fn=now_fn)
+        # Disaggregated pools: one signal-driven autoscaler per named
+        # pool; empty for legacy poolless specs (the fleet-wide
+        # autoscaler above governs those).
+        self.pool_autoscalers = autoscalers.make_pool_autoscalers(
+            self.spec, now_fn=now_fn)
         self.lb = lb if lb is not None else lb_lib.LoadBalancer(
             self.spec.load_balancing_policy, port=service['lb_port'],
             now_fn=now_fn)
@@ -84,7 +89,11 @@ class ServeController:
             self.lb.start()
             serve_state.set_service_status(
                 self.service_name, serve_state.ServiceStatus.REPLICA_INIT)
-            self.manager.scale_up(self.spec.min_replicas)
+            if self.spec.pools:
+                for name, pool in self.spec.pools.items():
+                    self.manager.scale_up(pool.min_replicas, pool=name)
+            else:
+                self.manager.scale_up(self.spec.min_replicas)
             while not self._stop:
                 self._step()
                 self._sleep(_loop_interval_seconds())
@@ -109,12 +118,21 @@ class ServeController:
         updating = self._rolling_update(service)
         replicas = serve_state.get_replicas(self.service_name)
         ready = self.manager.ready_endpoints()
-        self.lb.set_replicas(ready)
 
         live = [r for r in replicas
                 if r['status'] not in (
                     serve_state.ReplicaStatus.SHUTTING_DOWN,
                     serve_state.ReplicaStatus.FAILED)]
+        if self.spec.pools:
+            # Pool-aware LB sync: the routing layer needs each ready
+            # endpoint's pool ROLE to steer request shapes.
+            self.lb.set_replicas(
+                ready, pools=self._pool_role_map(replicas))
+            target = self._scale_pools(service, live, ready, updating)
+            self._export_metrics(replicas, live, target)
+            self._set_health_status(live, ready)
+            return
+        self.lb.set_replicas(ready)
         # During a rolling update the ROLLOUT owns replacing old
         # replicas; the autoscaler must neither kill the new-version
         # surge replicas nor treat them as excess. Protection is
@@ -149,6 +167,69 @@ class ServeController:
 
         self._export_metrics(replicas, live, target)
         self._set_health_status(live, ready)
+
+    # -- replica pools --------------------------------------------------------
+
+    def _pool_name_of(self, replica) -> str:
+        """A row's pool, defaulting unpooled strays (pre-migration
+        rows) into the first declared pool so they stay governed."""
+        pool = replica.get('pool')
+        if pool in self.spec.pools:
+            return pool
+        return next(iter(self.spec.pools))
+
+    def _pool_role_map(self, replicas) -> dict:
+        return {
+            r['endpoint']: self.spec.pools[self._pool_name_of(r)].role
+            for r in replicas
+            if r['endpoint'] and
+            r['status'] == serve_state.ReplicaStatus.READY}
+
+    def _scale_pools(self, service, live, ready, updating) -> int:
+        """Per-pool reconcile: each pool's signal-driven autoscaler
+        sees only its own replicas and its own pressure signals (one
+        shared snapshot per tick so pools never race each other for
+        the histogram windows). Returns the combined target."""
+        names = list(self.spec.pools)
+        reader = getattr(self.signals, 'read_pools', None)
+        signals = reader(names) if reader is not None else \
+            {name: self.signals.read() for name in names}
+        qps = self.lb.tracker.qps()
+        ready_set = set(ready)
+        total_target = 0
+        for name, pool in self.spec.pools.items():
+            pool_live = [r for r in live
+                         if self._pool_name_of(r) == name]
+            pool_ready = [r for r in pool_live
+                          if r['endpoint'] in ready_set]
+            # Same surge-protection rule as the fleet-wide path,
+            # scoped to this pool's rollout entitlement.
+            surge = sorted(
+                (r for r in pool_live
+                 if updating and r['version'] >= service['version']),
+                key=lambda r: -r['replica_id'])
+            protected = frozenset(
+                r['replica_id']
+                for r in surge[:pool.min_replicas + 1])
+            decision = self.pool_autoscalers[name].decide(
+                len(pool_ready), len(pool_live), qps,
+                signals.get(name))
+            target = decision.target_replicas
+            total_target += target
+            if target > len(pool_live):
+                self.manager.scale_up(target - len(pool_live),
+                                      pool=name)
+            else:
+                n = len(pool_live) - target - len(protected)
+                if n > 0:
+                    self.manager.scale_down(
+                        _pick_victims(pool_live, n, protected))
+            obs.POOL_TARGET_REPLICAS.labels(
+                service=self.service_name, pool=name).set(target)
+            obs.POOL_READY_REPLICAS.labels(
+                service=self.service_name, pool=name).set(
+                    len(pool_ready))
+        return total_target
 
     def _export_metrics(self, replicas, live, target) -> None:
         """Serve-plane gauges: replica counts per lifecycle state plus
@@ -212,15 +293,43 @@ class ServeController:
         self.manager.task = self.task
         self.manager.spec = self.spec
         self.autoscaler.update_spec(self.spec)
+        # Pool membership may have changed shape entirely (pools
+        # added/dropped): rebuild rather than patch, but preserve
+        # each surviving pool's hysteresis clock state.
+        fresh = autoscalers.make_pool_autoscalers(self.spec,
+                                                  now_fn=self._now)
+        for name, scaler in fresh.items():
+            old = self.pool_autoscalers.get(name)
+            if old is not None:
+                old.update_spec(scaler.spec)
+                fresh[name] = old
+        self.pool_autoscalers = fresh
         self._loaded_version = service['version']
 
     def _rolling_update(self, service) -> bool:
         """Replace old-version replicas one at a time, never dropping
         below the ready set (reference rolling update,
-        replica_managers.py version tracking). Returns True while an
-        update is in progress (old-version replicas still live)."""
-        version = service['version']
+        replica_managers.py version tracking). With pools, each pool
+        rolls independently (its own surge, its own min_replicas
+        floor) — a slow prefill-pool rollout must not stall decode's.
+        Returns True while an update is in progress (old-version
+        replicas still live)."""
         replicas = serve_state.get_replicas(self.service_name)
+        if self.spec.pools:
+            updating = False
+            for name, pool in self.spec.pools.items():
+                rows = [r for r in replicas
+                        if self._pool_name_of(r) == name]
+                updating |= self._rolling_update_pool(
+                    service, rows, pool.min_replicas, pool=name)
+            return updating
+        return self._rolling_update_pool(
+            service, replicas, self.spec.min_replicas, pool=None)
+
+    def _rolling_update_pool(self, service, replicas,
+                             min_replicas: int,
+                             pool: Optional[str]) -> bool:
+        version = service['version']
         old = [r for r in replicas if r['version'] < version and
                r['status'] not in (serve_state.ReplicaStatus.SHUTTING_DOWN,
                                    serve_state.ReplicaStatus.FAILED)]
@@ -239,9 +348,12 @@ class ServeController:
         # only while (old_ready + new_ready) stays above min_replicas —
         # retiring per tick merely because SOME new replica is ready
         # would collapse serving capacity while later surges boot.
-        if len(new_live) < self.spec.min_replicas + 1 and \
+        if len(new_live) < min_replicas + 1 and \
                 len(new_live) == len(new_ready):
-            self.manager.scale_up(1)
+            if pool is None:
+                self.manager.scale_up(1)
+            else:
+                self.manager.scale_up(1, pool=pool)
         if new_ready:
             old_ready = [r for r in old if r['status'] ==
                          serve_state.ReplicaStatus.READY]
@@ -252,7 +364,7 @@ class ServeController:
                              key=lambda r: r['replica_id'])
                 self.manager.scale_down([victim['replica_id']])
             elif old_ready and len(old_ready) + len(new_ready) > \
-                    self.spec.min_replicas:
+                    min_replicas:
                 victim = min(old_ready, key=lambda r: r['replica_id'])
                 self.manager.scale_down([victim['replica_id']])
         return True

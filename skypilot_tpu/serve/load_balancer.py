@@ -8,9 +8,10 @@ import asyncio
 import collections
 import contextlib
 import itertools
+import json
 import threading
 import time
-from typing import Callable, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from skypilot_tpu import envs
 from skypilot_tpu.observability import instruments as obs
@@ -20,6 +21,66 @@ from skypilot_tpu.resilience import faults
 from skypilot_tpu.serve import load_balancing_policies as lb_policies
 
 _QPS_WINDOW_SECONDS = 60.0
+# Bodies above this are never JSON-parsed for routing context: the
+# peek must stay O(prompt), not O(attachment).
+_CONTEXT_PEEK_MAX_BYTES = 4 * 1024 * 1024
+
+
+def request_context(body: Optional[bytes],
+                    content_type: Optional[str],
+                    content_length: Optional[int]
+                    ) -> Optional[Dict[str, Any]]:
+    """Peek the routing context out of an already-buffered request
+    body. Only declared-length JSON bodies are parsed — a streamed
+    (chunked, no content-length) upload is proxied as before and
+    routes context-free, never buffered twice or parsed
+    speculatively. Returns {'prompt_tokens', 'max_new_tokens'} or
+    None when the request carries nothing routable."""
+    if (not body or content_type != 'application/json'
+            or content_length is None
+            or content_length > _CONTEXT_PEEK_MAX_BYTES):
+        return None
+    try:
+        doc = json.loads(body)
+    except (ValueError, UnicodeDecodeError):
+        return None
+    if not isinstance(doc, dict):
+        return None
+    ctx: Dict[str, Any] = {}
+    tokens = doc.get('prompt_tokens')
+    if isinstance(tokens, list) and tokens and \
+            all(isinstance(t, int) for t in tokens):
+        ctx['prompt_tokens'] = tokens
+    elif isinstance(doc.get('prompt'), str) and doc['prompt']:
+        ctx['prompt'] = doc['prompt']
+    else:
+        return None
+    max_new = doc.get('max_new_tokens')
+    if isinstance(max_new, int):
+        ctx['max_new_tokens'] = max_new
+    return ctx
+
+
+def classify_pool_role(context: Optional[Dict[str, Any]]
+                       ) -> Optional[str]:
+    """Request shape -> pool role: long-prompt AND short-gen requests
+    prefer the prefill-heavy pool; everything else with routable
+    content is decode-bound. None (no context) routes unrestricted."""
+    if not context:
+        return None
+    tokens = context.get('prompt_tokens')
+    if tokens:
+        prompt_len = len(tokens)
+    else:
+        # The threshold is token-denominated; a raw string is ~4
+        # chars/token — estimate rather than misclassify every
+        # medium-length string prompt as long.
+        prompt_len = len(context.get('prompt') or '') // 4
+    max_new = context.get('max_new_tokens', 64)
+    if prompt_len >= envs.SKYTPU_LB_POOL_PROMPT_THRESHOLD.get() and \
+            max_new <= envs.SKYTPU_LB_POOL_MAX_NEW_THRESHOLD.get():
+        return 'prefill'
+    return 'decode'
 
 
 class RequestRateTracker:
@@ -43,9 +104,25 @@ class RequestRateTracker:
 class LoadBalancer:
     def __init__(self, policy_name: str = 'least_load',
                  port: int = 0,
-                 now_fn: Callable[[], float] = time.time) -> None:
-        self.policy = lb_policies.make_policy(policy_name)
+                 now_fn: Callable[[], float] = time.time,
+                 honor_env_policy: bool = True) -> None:
+        # SKYTPU_LB_POLICY outranks the spec: live routing A/Bs must
+        # not require a task-YAML edit + version bump. Callers that
+        # ARE the A/B (fleetsim's comparison passes, the loadgen
+        # capstone) pass honor_env_policy=False — a stray exported
+        # override silently running both passes on one policy would
+        # turn the comparison into a phantom regression.
+        self.policy_name = policy_name
+        if honor_env_policy:
+            self.policy_name = envs.SKYTPU_LB_POLICY.get() or \
+                policy_name
+        self.policy = lb_policies.make_policy(
+            self.policy_name,
+            now_fn=(time.monotonic if now_fn is time.time else now_fn))
         self.port = port
+        # url -> pool ROLE ('prefill'/'decode'/'general'); empty means
+        # no pool routing (single undifferentiated fleet).
+        self._pool_roles: Dict[str, str] = {}
         self.tracker = RequestRateTracker(now_fn)
         # Replica endpoints that keep failing at the transport layer
         # get routed around instead of 502ing live traffic. now_fn is
@@ -59,39 +136,76 @@ class LoadBalancer:
         self._runner = None
         self._thread: Optional[threading.Thread] = None
 
-    def set_replicas(self, urls: List[str]) -> None:
+    def set_replicas(self, urls: List[str],
+                     pools: Optional[Dict[str, str]] = None) -> None:
+        """`pools` maps url -> pool role; None keeps the previous
+        mapping (or no pools at all) so poolless callers are
+        untouched."""
         old = set(self.policy.replicas) - set(urls)
         self.policy.set_replicas(urls)
+        if pools is not None:
+            self._pool_roles = dict(pools)
         for gone in old:
             self.breaker.forget(gone)
+            self._pool_roles.pop(gone, None)
 
-    def _failover_order(self):
-        """Upstream try-order: the policy's pick first, then every
-        other replica — a failed upstream must not 502 the client
-        while healthy replicas exist. None when the rotation is
-        empty; otherwise a LAZY iterator (the common case consumes
-        one element, and a 1000-replica rotation must not allocate a
-        full list per request). Shared by the HTTP proxy AND
-        dispatch(), so the simulator routes exactly like production."""
-        first = self.policy.select()
+    def _pool_candidates(self, context) -> Optional[List[str]]:
+        """Replica-pool slice for this request's shape, or None for
+        no restriction (no pools configured, no routable context, or
+        the preferred pool currently has no ready replica — shape
+        preference must never 503 a servable request)."""
+        if not self._pool_roles:
+            return None
+        role = classify_pool_role(context)
+        if role is None:
+            return None
+        urls = [r for r in self.policy.replicas
+                if self._pool_roles.get(r) == role]
+        if not urls:
+            return None
+        obs.LB_POOL_REQUESTS.labels(pool=role).inc()
+        return urls
+
+    def _failover_order(self, context=None):
+        """Upstream try-order: the policy's pick first, then the rest
+        of its pool, then every other replica — a failed upstream
+        must not 502 the client while healthy replicas exist. None
+        when the rotation is empty; otherwise a LAZY iterator (the
+        common case consumes one element, and a 1000-replica rotation
+        must not allocate a full list per request). Shared by the
+        HTTP proxy AND dispatch(), so the simulator routes exactly
+        like production."""
+        pool = self._pool_candidates(context)
+        first = self.policy.select(context=context, candidates=pool)
         if first is None:
             return None
+        if pool is None:
+            return itertools.chain(
+                (first,),
+                (r for r in self.policy.replicas if r != first))
+        pool_set = set(pool)
         return itertools.chain(
-            (first,), (r for r in self.policy.replicas if r != first))
+            (first,), (r for r in pool if r != first),
+            (r for r in self.policy.replicas
+             if r != first and r not in pool_set))
 
     # -- the simulator / non-HTTP seam ---------------------------------------
 
-    def dispatch(self, send: Callable[[str], bool]) -> str:
+    def dispatch(self, send: Callable[[str], bool],
+                 context: Optional[Dict[str, Any]] = None) -> str:
         """Route ONE request through the real policy + breaker +
         failover discipline without the HTTP layer — the fleet
         simulator's seam into this LB. `send(url)` performs the
         request against one upstream and returns success; failures
         feed the breaker and fail over exactly like _handle_proxy's
-        pre-bytes phase. Returns 'ok', 'no_replica' (empty rotation),
-        'all_open' (candidates exist, every circuit open) or 'error'
-        (every attempted upstream failed)."""
+        pre-bytes phase. `context` is the routing context the HTTP
+        path peeks from JSON bodies (prompt tokens, max_new_tokens)
+        — content-aware policies and pool routing consume it here
+        exactly as in production. Returns 'ok', 'no_replica' (empty
+        rotation), 'all_open' (candidates exist, every circuit open)
+        or 'error' (every attempted upstream failed)."""
         self.tracker.record()
-        candidates = self._failover_order()
+        candidates = self._failover_order(context)
         if candidates is None:
             obs.LB_NO_REPLICA.inc()
             return 'no_replica'
@@ -103,7 +217,7 @@ class LoadBalancer:
             if attempted > 1:
                 obs.LB_UPSTREAM_RETRIES.inc()
             obs.LB_REPLICA_REQUESTS.labels(replica=target).inc()
-            self.policy.on_request_start(target)
+            self.policy.on_request_start(target, context=context)
             try:
                 ok = send(target)
             finally:
@@ -137,6 +251,23 @@ class LoadBalancer:
             'breakers': breakers,
             'candidates': sum(1 for s in breakers.values()
                               if s != 'open'),
+            # WHY traffic shifted: the policy's affinity-table shape
+            # (per-replica indexed-prefix counts) plus the hit/miss/
+            # bounded-load counters. A dropped fleet cache-hit ratio
+            # reads differently when affinity misses spiked (index
+            # churn / cold prefixes) vs when fallbacks spiked (a hot
+            # family overflowing its affine replica).
+            'routing': {
+                'policy': self.policy_name,
+                'pools': dict(self._pool_roles),
+                'affinity': {
+                    **self.policy.stats(),
+                    'hits': int(obs.LB_AFFINITY_HITS.value()),
+                    'misses': int(obs.LB_AFFINITY_MISSES.value()),
+                    'fallbacks':
+                        int(obs.LB_AFFINITY_FALLBACKS.value()),
+                },
+            },
             # Engine pressure from the process-local registry (real
             # series in co-located/fleetsim deployments): utilization
             # alone can't explain a dropped prefix-cache hit ratio —
@@ -162,13 +293,20 @@ class LoadBalancer:
         from aiohttp import ClientSession, ClientTimeout, web
         import aiohttp
         self.tracker.record()
-        candidates = self._failover_order()
+        # The retry discipline already buffers the body once (a
+        # failed-over request must replay identical bytes); the
+        # routing peek reuses THAT buffer — request_context refuses
+        # undeclared-length/oversized bodies, so streamed uploads are
+        # never parsed, only proxied.
+        body = await request.read()
+        context = request_context(body, request.content_type,
+                                  request.content_length)
+        candidates = self._failover_order(context)
         if candidates is None:
             obs.LB_NO_REPLICA.inc()
             return web.Response(
                 status=503, headers={'Retry-After': '1'},
                 text='No ready replicas. Retry shortly.\n')
-        body = await request.read()
         tail = request.match_info['tail']
         last_error: Optional[BaseException] = None
         attempted = 0
@@ -182,7 +320,7 @@ class LoadBalancer:
             url = target.rstrip('/') + '/' + tail
             if request.query_string:
                 url += f'?{request.query_string}'
-            self.policy.on_request_start(target)
+            self.policy.on_request_start(target, context=context)
             session = upstream = None
             try:
                 # Phase 1 — contact the upstream. Failures here are

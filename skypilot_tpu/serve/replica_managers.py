@@ -64,14 +64,18 @@ class ReplicaManager:
     # -- lifecycle -----------------------------------------------------------
 
     def scale_up(self, n: int = 1,
-                 use_spot: Optional[bool] = None) -> List[int]:
+                 use_spot: Optional[bool] = None,
+                 pool: Optional[str] = None) -> List[int]:
         """Launch n new replica clusters in BACKGROUND threads so the
         control loop keeps probing healthy replicas while slices
         provision (TPU pods can take many minutes; reference replica
         manager launches async the same way).
 
         use_spot overrides the spec default (the fallback autoscaler
-        launches on-demand replicas into a spot service).
+        launches on-demand replicas into a spot service); `pool`
+        names the replica pool the new replicas belong to — its
+        PoolSpec resource overrides shape the launched cluster
+        (prefill-heavy vs decode-heavy hardware).
         """
         launched = []
         service = serve_state.get_service(self.service_name)
@@ -88,17 +92,19 @@ class ReplicaManager:
                         counts[r['zone']] = counts.get(r['zone'], 0) + 1
                 zone = self.spot_placer.select(counts)
             serve_state.add_replica(self.service_name, replica_id, cluster,
-                                    version, use_spot=spot, zone=zone)
+                                    version, use_spot=spot, zone=zone,
+                                    pool=pool)
             thread = threading.Thread(
                 target=self._launch_replica,
-                args=(replica_id, cluster, spot, zone),
+                args=(replica_id, cluster, spot, zone, pool),
                 daemon=True)
             thread.start()
             launched.append(replica_id)
         return launched
 
     def _launch_replica(self, replica_id: int, cluster: str,
-                        use_spot: bool, zone: Optional[str]) -> None:
+                        use_spot: bool, zone: Optional[str],
+                        pool: Optional[str] = None) -> None:
         try:
             from skypilot_tpu import execution
 
@@ -106,7 +112,8 @@ class ReplicaManager:
                 faults.inject(
                     'provision.launch',
                     env_exc=exceptions.ResourcesUnavailableError)
-                execution.launch(self._replica_task(use_spot, zone),
+                execution.launch(self._replica_task(use_spot, zone,
+                                                    pool=pool),
                                  cluster_name=cluster,
                                  stream_logs=False, detach_run=True)
 
@@ -132,14 +139,24 @@ class ReplicaManager:
                 serve_state.ReplicaStatus.FAILED)
 
     def _replica_task(self, use_spot: bool = False,
-                      zone: Optional[str] = None):
+                      zone: Optional[str] = None,
+                      pool: Optional[str] = None):
         """A fresh Task per replica (Tasks hold best_resources state),
         with the placer's spot/zone decision applied to every resource
-        option."""
+        option and the pool's resource overrides (distinct hardware
+        per pool role) merged over the task's own `resources:`."""
         from skypilot_tpu import task as task_lib
-        task = task_lib.Task.from_yaml_config(self.task.to_yaml_config())
-        # Apply whenever the service runs mixed pools: an on-demand
-        # fallback replica must override a task-level use_spot: true.
+        cfg = self.task.to_yaml_config()
+        pool_spec = (self.spec.pools or {}).get(pool) \
+            if pool is not None else None
+        if pool_spec is not None and pool_spec.resources:
+            resources = dict(cfg.get('resources') or {})
+            resources.update(pool_spec.resources)
+            cfg['resources'] = resources
+        task = task_lib.Task.from_yaml_config(cfg)
+        # Apply whenever the service runs mixed spot pools: an
+        # on-demand fallback replica must override a task-level
+        # use_spot: true.
         if self.spec.use_spot or use_spot or zone is not None:
             task.set_resources([
                 r.copy(use_spot=use_spot,
@@ -262,7 +279,11 @@ class ReplicaManager:
                 if replica.get('use_spot') and self.spot_placer:
                     self.spot_placer.handle_preemption(replica.get('zone'))
                 self.scale_down([replica['replica_id']])
-                self.scale_up(1, use_spot=replica.get('use_spot'))
+                # Replacement keeps the dead replica's pool: a lost
+                # decode replica must not come back on base-task
+                # hardware outside its pool's scaling envelope.
+                self.scale_up(1, use_spot=replica.get('use_spot'),
+                              pool=replica.get('pool'))
                 continue
             if replica['endpoint'] is None:
                 endpoint = self._endpoint_for(replica['cluster_name'])
@@ -312,11 +333,11 @@ class ReplicaManager:
                     if age > self.spec.readiness_probe. \
                             initial_delay_seconds:
                         self.scale_down([replica['replica_id']])
-                        self.scale_up(1)
+                        self.scale_up(1, pool=replica.get('pool'))
                 elif failures >= _MAX_CONSECUTIVE_FAILURES:
                     # Persistent failure: replace the replica.
                     self.scale_down([replica['replica_id']])
-                    self.scale_up(1)
+                    self.scale_up(1, pool=replica.get('pool'))
 
     def ready_endpoints(self) -> List[str]:
         return [r['endpoint']

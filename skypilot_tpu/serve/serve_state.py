@@ -95,6 +95,7 @@ def _get_conn() -> sqlite3.Connection:
                     consecutive_failures INTEGER DEFAULT 0,
                     use_spot INTEGER DEFAULT 0,
                     zone TEXT,
+                    pool TEXT,
                     PRIMARY KEY (service_name, replica_id)
                 )""")
             cols = [r[1] for r in _conn.execute(
@@ -103,6 +104,9 @@ def _get_conn() -> sqlite3.Connection:
                 _conn.execute('ALTER TABLE replicas ADD COLUMN '
                               'use_spot INTEGER DEFAULT 0')
                 _conn.execute('ALTER TABLE replicas ADD COLUMN zone TEXT')
+            if 'pool' not in cols:  # pre-pool DBs
+                _conn.execute('ALTER TABLE replicas ADD COLUMN '
+                              'pool TEXT')
             _conn.commit()
             _conn_path = path
         return _conn
@@ -203,16 +207,17 @@ def _service_row(row) -> Dict[str, Any]:
 
 def add_replica(service_name: str, replica_id: int, cluster_name: str,
                 version: int, use_spot: bool = False,
-                zone: Optional[str] = None) -> None:
+                zone: Optional[str] = None,
+                pool: Optional[str] = None) -> None:
     conn = _get_conn()
     with _lock:
         conn.execute(
             'INSERT OR REPLACE INTO replicas (service_name, replica_id, '
-            'cluster_name, status, version, launched_at, use_spot, zone) '
-            'VALUES (?,?,?,?,?,?,?,?)',
+            'cluster_name, status, version, launched_at, use_spot, '
+            'zone, pool) VALUES (?,?,?,?,?,?,?,?,?)',
             (service_name, replica_id, cluster_name,
              ReplicaStatus.PROVISIONING.value, version, time.time(),
-             int(use_spot), zone))
+             int(use_spot), zone, pool))
         conn.commit()
 
 
@@ -284,14 +289,14 @@ def get_replicas(service_name: str) -> List[Dict[str, Any]]:
     conn = _get_conn()
     rows = conn.execute(
         'SELECT service_name, replica_id, cluster_name, status, version, '
-        'endpoint, launched_at, consecutive_failures, use_spot, zone '
-        'FROM replicas WHERE service_name=? ORDER BY replica_id',
+        'endpoint, launched_at, consecutive_failures, use_spot, zone, '
+        'pool FROM replicas WHERE service_name=? ORDER BY replica_id',
         (service_name,)).fetchall()
     return [{
         'service_name': r[0], 'replica_id': r[1], 'cluster_name': r[2],
         'status': ReplicaStatus(r[3]), 'version': r[4], 'endpoint': r[5],
         'launched_at': r[6], 'consecutive_failures': r[7],
-        'use_spot': bool(r[8]), 'zone': r[9],
+        'use_spot': bool(r[8]), 'zone': r[9], 'pool': r[10],
     } for r in rows]
 
 

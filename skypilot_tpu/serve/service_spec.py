@@ -31,6 +31,95 @@ class ReadinessProbe:
             f'Invalid readiness_probe: {cfg!r}')
 
 
+_POOL_ROLES = ('prefill', 'decode', 'general')
+
+
+@dataclasses.dataclass
+class PoolSpec:
+    """One named replica pool: a role (what request shape it serves),
+    its own scaling envelope, and the saturation signals its
+    autoscaler consumes. Disaggregated prefill/decode serving
+    (ROADMAP item 2): prefill-heavy and decode-heavy hardware scale
+    independently, each on the signal that actually saturates it —
+    never raw request rate alone.
+    """
+    name: str
+    role: str = 'general'
+    min_replicas: int = 1
+    max_replicas: Optional[int] = None
+    target_qps_per_replica: Optional[float] = None
+    target_queue_per_replica: Optional[float] = None
+    kv_util_upscale_threshold: Optional[float] = None
+    # p95 breach thresholds (seconds): one extra replica per decision
+    # round while breached — bounded pressure relief, the shared
+    # hysteresis paces the actual resize.
+    ttft_p95_upscale_threshold: Optional[float] = None
+    decode_step_p95_upscale_threshold: Optional[float] = None
+    upscale_delay_seconds: int = 300
+    downscale_delay_seconds: int = 1200
+    # Per-pool resource overrides merged over the task's resources:
+    # a prefill pool runs compute-heavy slices, a decode pool
+    # memory-heavy ones.
+    resources: Optional[Dict[str, Any]] = None
+
+    @classmethod
+    def from_config(cls, name: str, cfg: Dict[str, Any],
+                    defaults: 'ServiceSpec') -> 'PoolSpec':
+        role = cfg.get('role', 'general')
+        if role not in _POOL_ROLES:
+            raise exceptions.InvalidTaskError(
+                f'service: pool {name!r} role {role!r} invalid; one '
+                f'of {", ".join(_POOL_ROLES)}')
+        max_replicas = cfg.get('max_replicas')
+        spec = cls(
+            name=name,
+            role=role,
+            min_replicas=int(cfg.get('min_replicas', 1)),
+            max_replicas=int(max_replicas) if max_replicas else None,
+            target_qps_per_replica=cfg.get('target_qps_per_replica'),
+            target_queue_per_replica=cfg.get(
+                'target_queue_per_replica'),
+            kv_util_upscale_threshold=cfg.get(
+                'kv_util_upscale_threshold'),
+            ttft_p95_upscale_threshold=cfg.get(
+                'ttft_p95_upscale_threshold'),
+            decode_step_p95_upscale_threshold=cfg.get(
+                'decode_step_p95_upscale_threshold'),
+            upscale_delay_seconds=int(cfg.get(
+                'upscale_delay_seconds',
+                defaults.upscale_delay_seconds)),
+            downscale_delay_seconds=int(cfg.get(
+                'downscale_delay_seconds',
+                defaults.downscale_delay_seconds)),
+            resources=cfg.get('resources'),
+        )
+        if spec.min_replicas < 0:
+            raise exceptions.InvalidTaskError(
+                f'service: pool {name!r} min_replicas < 0')
+        if spec.max_replicas is not None and \
+                spec.max_replicas < spec.min_replicas:
+            raise exceptions.InvalidTaskError(
+                f'service: pool {name!r} max_replicas < min_replicas')
+        return spec
+
+    def to_config(self) -> Dict[str, Any]:
+        cfg: Dict[str, Any] = {
+            'role': self.role,
+            'min_replicas': self.min_replicas,
+            'upscale_delay_seconds': self.upscale_delay_seconds,
+            'downscale_delay_seconds': self.downscale_delay_seconds,
+        }
+        for key in ('max_replicas', 'target_qps_per_replica',
+                    'target_queue_per_replica',
+                    'kv_util_upscale_threshold',
+                    'ttft_p95_upscale_threshold',
+                    'decode_step_p95_upscale_threshold', 'resources'):
+            value = getattr(self, key)
+            if value is not None:
+                cfg[key] = value
+        return cfg
+
+
 @dataclasses.dataclass
 class ServiceSpec:
     readiness_probe: ReadinessProbe
@@ -56,6 +145,11 @@ class ServiceSpec:
     # None disables the respective signal.
     target_queue_per_replica: Optional[float] = None
     kv_util_upscale_threshold: Optional[float] = None
+    # Disaggregated replica pools: name -> PoolSpec. None means one
+    # undifferentiated fleet governed by replica_policy (the legacy
+    # path, untouched). With pools, min/max_replicas above are the
+    # pool sums (derived, for consumers that think fleet-wide).
+    pools: Optional[Dict[str, PoolSpec]] = None
 
     @classmethod
     def from_yaml_config(cls, cfg: Dict[str, Any]) -> 'ServiceSpec':
@@ -65,6 +159,8 @@ class ServiceSpec:
             raise exceptions.InvalidTaskError(
                 'service: requires a readiness_probe')
         rp = ReadinessProbe.from_config(cfg['readiness_probe'])
+        if cfg.get('pools') is not None:
+            return cls._from_pools_config(cfg, rp)
         replicas = cfg.get('replicas')
         policy = cfg.get('replica_policy') or {}
         min_replicas = int(policy.get('min_replicas',
@@ -111,7 +207,61 @@ class ServiceSpec:
                 'target_qps_per_replica')
         return spec
 
+    @classmethod
+    def _from_pools_config(cls, cfg: Dict[str, Any],
+                           rp: ReadinessProbe) -> 'ServiceSpec':
+        if cfg.get('replica_policy') or cfg.get('replicas'):
+            raise exceptions.InvalidTaskError(
+                'service: pools and replica_policy/replicas are '
+                'mutually exclusive — each pool declares its own '
+                'scaling envelope')
+        defaults = cls(readiness_probe=rp)
+        pools: Dict[str, PoolSpec] = {}
+        for name, pool_cfg in cfg['pools'].items():
+            pools[name] = PoolSpec.from_config(name, pool_cfg or {},
+                                               defaults)
+        if not pools:
+            raise exceptions.InvalidTaskError(
+                'service: pools requires at least one pool')
+        total_min = sum(p.min_replicas for p in pools.values())
+        if total_min < 1:
+            raise exceptions.InvalidTaskError(
+                'service: pool min_replicas must sum to >= 1')
+        maxes = [p.max_replicas for p in pools.values()]
+        total_max = sum(m for m in maxes if m is not None) \
+            if all(m is not None for m in maxes) else None
+        return cls(
+            readiness_probe=rp,
+            min_replicas=total_min,
+            max_replicas=total_max,
+            replica_port=int(cfg.get('replica_port', 8080)),
+            load_balancing_policy=cfg.get('load_balancing_policy',
+                                          'least_load'),
+            pools=pools,
+        )
+
     def to_yaml_config(self) -> Dict[str, Any]:
+        if self.pools is not None:
+            cfg: Dict[str, Any] = {
+                'readiness_probe': {
+                    'path': self.readiness_probe.path,
+                    'initial_delay_seconds':
+                        self.readiness_probe.initial_delay_seconds,
+                    'timeout_seconds':
+                        self.readiness_probe.timeout_seconds,
+                },
+                'replica_port': self.replica_port,
+                'load_balancing_policy': self.load_balancing_policy,
+                'pools': {name: pool.to_config()
+                          for name, pool in self.pools.items()},
+            }
+            if self.readiness_probe.post_data is not None:
+                cfg['readiness_probe']['post_data'] = \
+                    self.readiness_probe.post_data
+            return cfg
+        return self._to_yaml_config_poolless()
+
+    def _to_yaml_config_poolless(self) -> Dict[str, Any]:
         cfg: Dict[str, Any] = {
             'readiness_probe': {
                 'path': self.readiness_probe.path,

@@ -121,7 +121,31 @@ SERVICE_SCHEMA: Dict[str, Any] = {
         },
         'replica_port': _INT,
         'replicas': _INT,
-        'load_balancing_policy': {'enum': ['round_robin', 'least_load']},
+        'load_balancing_policy': {'enum': ['round_robin', 'least_load',
+                                           'prefix_affinity']},
+        # Disaggregated replica pools (prefill-heavy vs decode-heavy
+        # hardware scaling independently); mutually exclusive with
+        # replica_policy, enforced by ServiceSpec validation.
+        'pools': {
+            'type': 'object',
+            'additionalProperties': {
+                'type': 'object',
+                'additionalProperties': False,
+                'properties': {
+                    'role': {'enum': ['prefill', 'decode', 'general']},
+                    'min_replicas': _INT,
+                    'max_replicas': _INT,
+                    'target_qps_per_replica': _NUM,
+                    'target_queue_per_replica': _NUM,
+                    'kv_util_upscale_threshold': _NUM,
+                    'ttft_p95_upscale_threshold': _NUM,
+                    'decode_step_p95_upscale_threshold': _NUM,
+                    'upscale_delay_seconds': _NUM,
+                    'downscale_delay_seconds': _NUM,
+                    'resources': {'type': 'object'},
+                },
+            },
+        },
         'replica_policy': {
             'type': 'object',
             'additionalProperties': False,

@@ -14,7 +14,8 @@ python -m pytest tests/ -q -m slow
 # Non-zero rc == an SLO regression; SLO_<scenario>.json carries the
 # evidence. JAX_PLATFORMS=cpu keeps the sim off any real accelerator.
 for scenario in smoke fused_decode spec_decode shared_prefix \
-        sharded_serve zone_loss rolling_update preemption_wave; do
+        sharded_serve prefix_affinity zone_loss rolling_update \
+        preemption_wave; do
     JAX_PLATFORMS=cpu python -m skypilot_tpu.fleetsim \
         --scenario "$scenario" --out /tmp
 done

@@ -334,6 +334,87 @@ class TestSimFleet:
         assert len(warm) == hits
         assert all(abs(t - 0.05) < 1e-3 for t in warm)
 
+    def test_content_aware_prefix_cache_is_a_routing_outcome(self):
+        """ISSUE 15: with prefix_cache_capacity the hit model is
+        CONTENT-aware — the same family hitting the same replica
+        stays warm, scattering it across replicas re-misses, and LRU
+        capacity evicts the coldest family."""
+        serve_state.add_service(SVC, {'run': 'true'}, lb_port=0,
+                                controller_port=0)
+        clk = clock_lib.VirtualClock()
+        profile = replicas_lib.ReplicaProfile(
+            startup_median_s=10.0, startup_sigma=0.0,
+            ttft_median_s=0.5, ttft_sigma=0.0,
+            prefix_cache_capacity=2, warm_ttft_factor=0.1,
+            concurrency=1000)
+        fleet = replicas_lib.SimFleet(SVC, clk, random.Random(0),
+                                      profile, zones=['za'])
+        fleet.scale_up(2)
+        clk.advance(11.0)
+        fleet.probe_all()
+        e1, e2 = sorted(fleet.ready_endpoints())
+        fleet.begin_tick(1000.0)
+        h0, m0 = (obs.PREFIX_CACHE_HITS.value(),
+                  obs.PREFIX_CACHE_MISSES.value())
+
+        def ctx(fam):
+            return {'prefix_key': ('family', fam),
+                    'prefix_tokens': 128}
+
+        # Pinned family: first request cold, rest warm on e1...
+        assert fleet.handle_request(e1, context=ctx(1))[0] > 0.4
+        for _ in range(3):
+            assert fleet.handle_request(e1, context=ctx(1))[0] < 0.1
+        # ...but the SAME family is cold on e2 (content, not luck).
+        assert fleet.handle_request(e2, context=ctx(1))[0] > 0.4
+        assert obs.PREFIX_CACHE_HITS.value() - h0 == 3
+        assert obs.PREFIX_CACHE_MISSES.value() - m0 == 2
+        # Capacity 2: families 2,3 evict family 1 from e1's LRU.
+        fleet.handle_request(e1, context=ctx(2))
+        fleet.handle_request(e1, context=ctx(3))
+        assert fleet.handle_request(e1, context=ctx(1))[0] > 0.4
+        # A request with no prefix key is an honest miss.
+        m1 = obs.PREFIX_CACHE_MISSES.value()
+        fleet.handle_request(e1, context={'prompt_tokens': [1, 2]})
+        assert obs.PREFIX_CACHE_MISSES.value() == m1 + 1
+        fleet.end_tick()
+
+    def test_pool_profiles_and_pool_gauges(self):
+        serve_state.add_service(SVC, {'run': 'true'}, lb_port=0,
+                                controller_port=0)
+        clk = clock_lib.VirtualClock()
+        base = replicas_lib.ReplicaProfile(
+            startup_median_s=10.0, startup_sigma=0.0,
+            ttft_median_s=0.5, ttft_sigma=0.0)
+        prefill = replicas_lib.ReplicaProfile(
+            startup_median_s=10.0, startup_sigma=0.0,
+            ttft_median_s=2.0, ttft_sigma=0.0, concurrency=4)
+        fleet = replicas_lib.SimFleet(
+            SVC, clk, random.Random(0), base, zones=['za'],
+            pool_profiles={'prefill': prefill})
+        fleet.scale_up(1, pool='prefill')
+        fleet.scale_up(1, pool='decode')
+        clk.advance(11.0)
+        fleet.probe_all()
+        rows = {r['replica_id']: r['pool']
+                for r in serve_state.get_replicas(SVC)}
+        assert sorted(rows.values()) == ['decode', 'prefill']
+        # Pool profile drives the latency shape.
+        by_pool = {r.pool: r.endpoint
+                   for r in fleet._replicas.values()}  # noqa: SLF001
+        fleet.begin_tick(100.0)
+        assert fleet.handle_request(by_pool['prefill'])[0] > 1.5
+        assert fleet.handle_request(by_pool['decode'])[0] < 1.0
+        fleet.end_tick()
+        # Per-pool pressure series written for the pool autoscalers.
+        assert obs.POOL_KV_UTILIZATION.value(pool='prefill') > 0
+        assert obs.POOL_KV_UTILIZATION.value(pool='decode') > 0
+
+    def test_capacity_profile_rejects_context_sharding(self):
+        with pytest.raises(ValueError, match='context'):
+            replicas_lib.ReplicaProfile(
+                mesh_shape=(('context', 2),), prefix_cache_capacity=4)
+
     def test_mesh_shape_declares_topology_and_enforces_gate(self):
         """ISSUE 14: mesh_shape declares the replica's sharded
         topology, and the profile enforces the ENGINE's composition
@@ -487,6 +568,46 @@ class TestSmokeScenario:
         data = json.loads(open(os.path.join(
             str(tmp_path), 'SLO_sharded_serve.json')).read())
         assert data['rc'] == 0 and data['scenario'] == 'sharded_serve'
+
+    def test_prefix_affinity_scenario_gates_hit_ratio_vs_baseline(
+            self, tmp_path):
+        """ISSUE 15 acceptance: the prefix_affinity scenario drives a
+        multi-pool fleet with CONTENT-aware replica caches through
+        the real LB dispatch + PrefixAffinityPolicy, and gates (a)
+        fleet cache-hit ratio >= 0.6 under affinity routing, (b)
+        >= 2x hit-ratio improvement over the least_load baseline
+        pass IN THE SAME REPORT, (c) warm TTFT p50/p95."""
+        sim = runner_lib.FleetSim(
+            runner_lib.SCENARIOS['prefix_affinity'], seed=0,
+            out_dir=str(tmp_path))
+        report = sim.run()
+        by_name = {r['name']: r for r in report['asserts']}
+        hit = by_name['cache_hit_ratio']
+        assert hit['ok'], hit
+        assert hit['metric'] == 'skytpu_prefix_cache_hits_total'
+        assert hit['value'] >= 0.6
+        base = by_name['baseline_cache_hit_ratio']
+        # The baseline pass scattered the same traffic: its ratio is
+        # a real counter-delta number, well below affinity's.
+        assert 0.0 < base['value'] < hit['value']
+        imp = by_name['hit_ratio_improvement']
+        assert imp['ok'], imp
+        assert imp['value'] >= 2.0
+        # Warm-dominated median vs the mixed-workload tail budget.
+        assert by_name['ttft_p50']['ok'], by_name['ttft_p50']
+        assert by_name['ttft_p95']['ok'], by_name['ttft_p95']
+        assert report['rc'] == 0, report['asserts']
+        # Both passes pushed real traffic through the real LB.
+        assert report['extra']['requests'] > 1000
+        assert report['extra']['lb_policy'] == 'prefix_affinity'
+        assert report['extra']['baseline']['lb_policy'] == \
+            'least_load'
+        assert report['extra']['baseline']['requests'] > 1000
+        assert report['extra']['pools'] == ['decode', 'prefill']
+        data = json.loads(open(os.path.join(
+            str(tmp_path), 'SLO_prefix_affinity.json')).read())
+        assert data['rc'] == 0
+        assert data['scenario'] == 'prefix_affinity'
 
     def test_controller_stall_and_crash_fault_modes(self, tmp_path):
         """`controller.step` has two chaos modes: latency_only arms a

@@ -1,0 +1,396 @@
+"""Prefix-affinity LB routing (ISSUE 15 tentpole).
+
+Covers the policy seam (content-aware select with candidates), the
+fingerprint index, the bounded-load hotspot guard (the acceptance
+bar: one dominant prefix family cannot push its affine replica past
+c x the fleet mean while other replicas idle), the LB's JSON context
+peek, pool-role routing through the real dispatch() seam, and the
+in-flight accounting honesty of the failover path (satellite: a
+pre-bytes upstream failure must not leak on_request_start
+increments).
+"""
+import json
+
+import pytest
+
+from skypilot_tpu.observability import instruments as obs
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve import load_balancing_policies as lb_policies
+
+
+def _ctx(tokens, max_new=8):
+    return {'prompt_tokens': list(tokens), 'max_new_tokens': max_new}
+
+
+def _family(fid, length=128):
+    return [fid * 1000 + (i % 64) for i in range(length)]
+
+
+@pytest.fixture
+def no_load_window(monkeypatch):
+    """Pure in-flight bounded load: unit tests drive concurrency
+    explicitly via on_request_start, so the recency term would
+    double-count."""
+    monkeypatch.setenv('SKYTPU_LB_AFFINITY_LOAD_WINDOW', '0')
+
+
+# --- make_policy ------------------------------------------------------------
+
+def test_make_policy_unknown_name_lists_valid():
+    with pytest.raises(ValueError) as err:
+        lb_policies.make_policy('power_of_two')
+    msg = str(err.value)
+    for name in ('round_robin', 'least_load', 'prefix_affinity'):
+        assert name in msg
+
+
+def test_registry_has_affinity():
+    policy = lb_policies.make_policy('prefix_affinity')
+    assert isinstance(policy, lb_policies.PrefixAffinityPolicy)
+    # And it is a least-load policy underneath (fallback discipline).
+    assert isinstance(policy, lb_policies.LeastLoadPolicy)
+
+
+# --- the affinity index -----------------------------------------------------
+
+class TestAffinityIndex:
+
+    def test_family_sticks_to_its_seeded_replica(self, no_load_window):
+        pol = lb_policies.make_policy('prefix_affinity')
+        pol.set_replicas(['a', 'b', 'c'])
+        fam = _family(1)
+        first = pol.select(context=_ctx(fam + [7]))
+        pol.on_request_start(first, context=_ctx(fam + [7]))
+        pol.on_request_end(first)
+        # Every later request of the family (different tails) routes
+        # to the same replica: its pages are warm there.
+        for i in range(10):
+            ctx = _ctx(fam + [100 + i])
+            assert pol.select(context=ctx) == first
+            pol.on_request_start(first, context=ctx)
+            pol.on_request_end(first)
+
+    def test_distinct_families_spread(self, no_load_window):
+        pol = lb_policies.make_policy('prefix_affinity')
+        pol.set_replicas(['a', 'b', 'c'])
+        homes = {}
+        for fid in range(9):
+            ctx = _ctx(_family(fid))
+            url = pol.select(context=ctx)
+            pol.on_request_start(url, context=ctx)
+            pol.on_request_end(url)
+            homes[fid] = url
+        # The least-load tie-break rotation seeds families across the
+        # fleet instead of collapsing them onto list position zero.
+        assert len(set(homes.values())) == 3
+
+    def test_deeper_match_wins(self, no_load_window):
+        pol = lb_policies.make_policy('prefix_affinity')
+        pol.set_replicas(['a', 'b'])
+        short = _family(3, length=64)           # one page
+        long = _family(3, length=192)           # three pages
+        pol.on_request_start('a', context=_ctx(short))
+        pol.on_request_end('a')
+        pol.on_request_start('b', context=_ctx(long))
+        pol.on_request_end('b')
+        # A long-prompt request matches 1 page on 'a' but 3 on 'b'.
+        assert pol.select(context=_ctx(long + [5])) == 'b'
+
+    def test_no_context_is_least_load_not_a_miss(self):
+        pol = lb_policies.make_policy('prefix_affinity')
+        pol.set_replicas(['a', 'b'])
+        misses = obs.LB_AFFINITY_MISSES.value()
+        assert pol.select() in ('a', 'b')
+        assert pol.select(context={'prompt_tokens': []}) in ('a', 'b')
+        assert obs.LB_AFFINITY_MISSES.value() == misses
+
+    def test_short_prompt_no_full_page_routes_without_index(self):
+        pol = lb_policies.make_policy('prefix_affinity')
+        pol.set_replicas(['a', 'b'])
+        ctx = _ctx([1, 2, 3])                   # under one page
+        url = pol.select(context=ctx)
+        pol.on_request_start(url, context=ctx)
+        pol.on_request_end(url)
+        assert pol.stats()['entries'] == 0
+
+    def test_string_prompt_fingerprints(self, no_load_window):
+        pol = lb_policies.make_policy('prefix_affinity')
+        pol.set_replicas(['a', 'b'])
+        prompt = 'You are a helpful assistant. ' * 10  # > 64 bytes
+        ctx = {'prompt': prompt, 'max_new_tokens': 8}
+        url = pol.select(context=ctx)
+        pol.on_request_start(url, context=ctx)
+        pol.on_request_end(url)
+        assert pol.select(context={'prompt': prompt + ' More.',
+                                   'max_new_tokens': 8}) == url
+
+    def test_hit_miss_counters(self, no_load_window):
+        pol = lb_policies.make_policy('prefix_affinity')
+        pol.set_replicas(['a', 'b'])
+        h0, m0 = (obs.LB_AFFINITY_HITS.value(),
+                  obs.LB_AFFINITY_MISSES.value())
+        ctx = _ctx(_family(5))
+        url = pol.select(context=ctx)                 # miss
+        pol.on_request_start(url, context=ctx)
+        pol.on_request_end(url)
+        pol.select(context=_ctx(_family(5) + [9]))    # hit
+        assert obs.LB_AFFINITY_MISSES.value() == m0 + 1
+        assert obs.LB_AFFINITY_HITS.value() == h0 + 1
+
+    def test_lru_cap_bounds_index(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_LB_AFFINITY_MAX_ENTRIES', '8')
+        pol = lb_policies.make_policy('prefix_affinity')
+        pol.set_replicas(['a'])
+        for fid in range(20):
+            ctx = _ctx(_family(fid, length=128))      # 2 entries each
+            pol.on_request_start('a', context=ctx)
+            pol.on_request_end('a')
+        stats = pol.stats()
+        assert stats['entries'] <= 8
+        assert stats['per_replica_entries']['a'] == stats['entries']
+
+    def test_stats_shape(self):
+        pol = lb_policies.make_policy('prefix_affinity')
+        pol.set_replicas(['a', 'b'])
+        stats = pol.stats()
+        assert set(stats) >= {'entries', 'page_tokens', 'bound',
+                              'per_replica_entries', 'in_flight'}
+
+
+# --- bounded load (the hotspot acceptance bar) ------------------------------
+
+class TestBoundedLoad:
+
+    def test_hot_family_cannot_hotspot_affine_replica(
+            self, no_load_window):
+        """ONE dominant prefix family, requests held in flight: the
+        affine replica's queue depth must stay within c x the fleet
+        mean — overflow spills to least-load (and warms the spill
+        target), it never piles up."""
+        pol = lb_policies.make_policy('prefix_affinity')
+        replicas = ['a', 'b', 'c', 'd']
+        pol.set_replicas(replicas)
+        fam = _family(1)
+        f0 = obs.LB_AFFINITY_FALLBACKS.value()
+        c = 2.0
+        for i in range(40):
+            ctx = _ctx(fam + [i])
+            url = pol.select(context=ctx)
+            pol.on_request_start(url, context=ctx)   # never completes
+            loads = [pol._in_flight.get(r, 0) for r in replicas]  # noqa: SLF001
+            total = sum(loads)
+            cap = -(-c * (total - 1 + 1) // len(replicas))
+            assert max(loads) <= cap + 1, (i, loads)
+        loads = {r: pol._in_flight.get(r, 0) for r in replicas}  # noqa: SLF001
+        # The hot family spilled beyond its single affine replica...
+        assert sum(1 for v in loads.values() if v > 0) >= 2, loads
+        # ...and stayed within the bounded-load envelope (c = 2
+        # permits concentrating on as few as n/c replicas — max load
+        # <= c x fleet mean is the contract, not uniform spread).
+        mean = sum(loads.values()) / len(loads)
+        assert max(loads.values()) <= c * mean + 1, loads
+        # ...and the guard actually fired.
+        assert obs.LB_AFFINITY_FALLBACKS.value() > f0
+
+    def test_idle_fleet_keeps_affinity(self, no_load_window):
+        """With requests COMPLETING (no standing load) affinity never
+        spills: the guard is load-triggered, not probabilistic."""
+        pol = lb_policies.make_policy('prefix_affinity')
+        pol.set_replicas(['a', 'b', 'c'])
+        fam = _family(2)
+        ctx = _ctx(fam)
+        home = pol.select(context=ctx)
+        pol.on_request_start(home, context=ctx)
+        pol.on_request_end(home)
+        f0 = obs.LB_AFFINITY_FALLBACKS.value()
+        for i in range(20):
+            ctx = _ctx(fam + [i])
+            url = pol.select(context=ctx)
+            assert url == home
+            pol.on_request_start(url, context=ctx)
+            pol.on_request_end(url)
+        assert obs.LB_AFFINITY_FALLBACKS.value() == f0
+
+
+# --- the LB context peek ----------------------------------------------------
+
+class TestRequestContext:
+
+    def test_json_prompt_tokens(self):
+        body = json.dumps({'prompt_tokens': [1, 2, 3],
+                           'max_new_tokens': 4}).encode()
+        ctx = lb_lib.request_context(body, 'application/json',
+                                     len(body))
+        assert ctx == {'prompt_tokens': [1, 2, 3],
+                       'max_new_tokens': 4}
+
+    def test_streamed_body_not_parsed(self):
+        """No declared content-length (chunked upload) -> never
+        parsed: the peek must not buffer-and-parse streams."""
+        body = json.dumps({'prompt_tokens': [1, 2, 3]}).encode()
+        assert lb_lib.request_context(body, 'application/json',
+                                      None) is None
+
+    def test_non_json_and_garbage(self):
+        assert lb_lib.request_context(b'hello', 'text/plain', 5) is None
+        assert lb_lib.request_context(b'{broken', 'application/json',
+                                      7) is None
+        assert lb_lib.request_context(b'[1,2]', 'application/json',
+                                      5) is None
+        assert lb_lib.request_context(b'', 'application/json', 0) is None
+
+    def test_string_prompt(self):
+        body = json.dumps({'prompt': 'hi there'}).encode()
+        ctx = lb_lib.request_context(body, 'application/json',
+                                     len(body))
+        assert ctx == {'prompt': 'hi there'}
+
+    def test_oversized_body_skipped(self):
+        body = json.dumps({'prompt_tokens': [1] * 10}).encode()
+        assert lb_lib.request_context(
+            body, 'application/json', 5 * 1024 * 1024) is None
+
+    def test_classify_pool_role(self):
+        assert lb_lib.classify_pool_role(None) is None
+        long_short = {'prompt_tokens': [0] * 2048,
+                      'max_new_tokens': 8}
+        assert lb_lib.classify_pool_role(long_short) == 'prefill'
+        chat = {'prompt_tokens': [0] * 100, 'max_new_tokens': 64}
+        assert lb_lib.classify_pool_role(chat) == 'decode'
+        long_long = {'prompt_tokens': [0] * 2048,
+                     'max_new_tokens': 256}
+        assert lb_lib.classify_pool_role(long_long) == 'decode'
+
+    def test_classify_string_prompt_in_token_units(self):
+        """The threshold is TOKEN-denominated: a ~1500-char string
+        (~375 tokens) is a normal prompt, not a prefill-pool one."""
+        medium = {'prompt': 'x' * 1500, 'max_new_tokens': 8}
+        assert lb_lib.classify_pool_role(medium) == 'decode'
+        huge = {'prompt': 'x' * 8192, 'max_new_tokens': 8}
+        assert lb_lib.classify_pool_role(huge) == 'prefill'
+
+
+# --- pool routing through the real dispatch seam ----------------------------
+
+class TestPoolRouting:
+
+    def _lb(self, policy='least_load'):
+        lb = lb_lib.LoadBalancer(policy)
+        lb.set_replicas(['p1', 'p2', 'd1', 'd2'],
+                        pools={'p1': 'prefill', 'p2': 'prefill',
+                               'd1': 'decode', 'd2': 'decode'})
+        return lb
+
+    def test_shape_routes_to_role(self):
+        lb = self._lb()
+        hits = []
+        ctx = {'prompt_tokens': [0] * 2048, 'max_new_tokens': 8}
+        assert lb.dispatch(lambda url: hits.append(url) or True,
+                           context=ctx) == 'ok'
+        assert hits[0] in ('p1', 'p2')
+        hits.clear()
+        ctx = {'prompt_tokens': [0] * 64, 'max_new_tokens': 64}
+        assert lb.dispatch(lambda url: hits.append(url) or True,
+                           context=ctx) == 'ok'
+        assert hits[0] in ('d1', 'd2')
+
+    def test_no_context_routes_anywhere(self):
+        lb = self._lb('round_robin')
+        hits = []
+        for _ in range(4):
+            lb.dispatch(lambda url: hits.append(url) or True)
+        assert set(hits) == {'p1', 'p2', 'd1', 'd2'}
+
+    def test_empty_pool_falls_back_to_fleet(self):
+        lb = lb_lib.LoadBalancer('least_load')
+        lb.set_replicas(['d1'], pools={'d1': 'decode'})
+        hits = []
+        ctx = {'prompt_tokens': [0] * 2048, 'max_new_tokens': 8}
+        # Prefill-shaped request, no prefill replicas: must still
+        # serve (shape preference never 503s a servable request).
+        assert lb.dispatch(lambda url: hits.append(url) or True,
+                           context=ctx) == 'ok'
+        assert hits == ['d1']
+
+    def test_failover_leaves_pool_last(self):
+        lb = self._lb()
+        attempts = []
+
+        def send(url):
+            attempts.append(url)
+            return len(attempts) >= 3   # first two upstreams fail
+
+        ctx = {'prompt_tokens': [0] * 2048, 'max_new_tokens': 8}
+        assert lb.dispatch(send, context=ctx) == 'ok'
+        # Both prefill replicas tried BEFORE any decode one.
+        assert set(attempts[:2]) == {'p1', 'p2'}
+        assert attempts[2] in ('d1', 'd2')
+
+
+# --- failover in-flight accounting (the satellite) --------------------------
+
+class TestFailoverAccounting:
+
+    def test_least_load_no_leak_when_upstream_fails_pre_bytes(self):
+        """_failover_order retries walk several upstreams; every
+        attempted target's on_request_start must be balanced by
+        on_request_end even when the send fails — a leaked increment
+        would permanently bias least-load away from a replica that
+        had one bad moment."""
+        lb = lb_lib.LoadBalancer('least_load')
+        lb.set_replicas(['a', 'b', 'c'])
+
+        calls = []
+
+        def failing_send(url):
+            calls.append(url)
+            return False
+
+        assert lb.dispatch(failing_send) == 'error'
+        assert len(calls) == 3
+        in_flight = lb.policy.stats()['in_flight']
+        assert in_flight == {'a': 0, 'b': 0, 'c': 0}
+
+    def test_partial_failover_balances_too(self):
+        lb = lb_lib.LoadBalancer('least_load')
+        lb.set_replicas(['a', 'b'])
+
+        def send(url):
+            return url == 'b'
+
+        assert lb.dispatch(send) == 'ok'
+        assert lb.policy.stats()['in_flight'] == {'a': 0, 'b': 0}
+
+    def test_send_exception_still_balances(self):
+        lb = lb_lib.LoadBalancer('least_load')
+        lb.set_replicas(['a'])
+
+        def boom(url):
+            raise RuntimeError('client died')
+
+        with pytest.raises(RuntimeError):
+            lb.dispatch(boom)
+        assert lb.policy.stats()['in_flight'] == {'a': 0}
+
+    def test_affinity_no_leak_on_failover(self, no_load_window):
+        lb = lb_lib.LoadBalancer('prefix_affinity')
+        lb.set_replicas(['a', 'b'])
+        ctx = _ctx(_family(9))
+        assert lb.dispatch(lambda url: False, context=ctx) == 'error'
+        assert lb.policy.stats()['in_flight'] == {'a': 0, 'b': 0}
+
+
+# --- env override -----------------------------------------------------------
+
+def test_lb_policy_env_override(monkeypatch):
+    monkeypatch.setenv('SKYTPU_LB_POLICY', 'prefix_affinity')
+    lb = lb_lib.LoadBalancer('least_load')
+    assert lb.policy_name == 'prefix_affinity'
+    assert isinstance(lb.policy, lb_policies.PrefixAffinityPolicy)
+    # A/B comparison callers opt out: a stray exported override must
+    # not silently run both passes on one policy.
+    lb = lb_lib.LoadBalancer('least_load', honor_env_policy=False)
+    assert lb.policy_name == 'least_load'
+    monkeypatch.delenv('SKYTPU_LB_POLICY')
+    lb = lb_lib.LoadBalancer('least_load')
+    assert lb.policy_name == 'least_load'

@@ -1,0 +1,391 @@
+"""Disaggregated replica pools (ISSUE 15): spec parsing, state
+persistence, per-pool signal-driven autoscaling, and the controller's
+per-pool reconcile/rolling-update paths — driven against the real
+serve_state DB with a fake manager, the same idiom as
+test_serve_controller_ticks.py.
+"""
+import pytest
+
+from skypilot_tpu.observability import instruments as obs
+from skypilot_tpu.serve import autoscalers
+from skypilot_tpu.serve import controller as controller_lib
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve import service_spec as spec_lib
+
+SVC = 'poolsvc'
+R = serve_state.ReplicaStatus
+
+
+def _pool_spec(**overrides):
+    cfg = {
+        'readiness_probe': '/health',
+        'load_balancing_policy': 'prefix_affinity',
+        'pools': {
+            'prefill': {'role': 'prefill', 'min_replicas': 2,
+                        'max_replicas': 4,
+                        'target_queue_per_replica': 4.0,
+                        'ttft_p95_upscale_threshold': 2.0,
+                        'upscale_delay_seconds': 0,
+                        'downscale_delay_seconds': 0},
+            'decode': {'role': 'decode', 'min_replicas': 3,
+                       'max_replicas': 6,
+                       'target_queue_per_replica': 4.0,
+                       'kv_util_upscale_threshold': 0.85,
+                       'decode_step_p95_upscale_threshold': 0.3,
+                       'upscale_delay_seconds': 0,
+                       'downscale_delay_seconds': 0},
+        },
+    }
+    cfg.update(overrides)
+    return spec_lib.ServiceSpec.from_yaml_config(cfg)
+
+
+# --- spec -------------------------------------------------------------------
+
+class TestPoolSpec:
+
+    def test_parse_and_derived_bounds(self):
+        spec = _pool_spec()
+        assert set(spec.pools) == {'prefill', 'decode'}
+        assert spec.pools['prefill'].role == 'prefill'
+        assert spec.min_replicas == 5          # pool mins summed
+        assert spec.max_replicas == 10         # pool maxes summed
+        assert spec.load_balancing_policy == 'prefix_affinity'
+
+    def test_round_trip(self):
+        spec = _pool_spec()
+        again = spec_lib.ServiceSpec.from_yaml_config(
+            spec.to_yaml_config())
+        assert set(again.pools) == set(spec.pools)
+        assert again.pools['decode'].kv_util_upscale_threshold == 0.85
+        assert again.pools['prefill'].ttft_p95_upscale_threshold == 2.0
+        assert again.pools['decode'].min_replicas == 3
+
+    def test_pools_exclusive_with_replica_policy(self):
+        with pytest.raises(Exception, match='mutually exclusive'):
+            _pool_spec(replica_policy={'min_replicas': 1})
+
+    def test_bad_role_rejected(self):
+        with pytest.raises(Exception):
+            spec_lib.ServiceSpec.from_yaml_config({
+                'readiness_probe': '/',
+                'pools': {'x': {'role': 'training'}}})
+
+    def test_pool_max_below_min_rejected(self):
+        with pytest.raises(Exception, match='max_replicas'):
+            spec_lib.ServiceSpec.from_yaml_config({
+                'readiness_probe': '/',
+                'pools': {'x': {'min_replicas': 3,
+                                'max_replicas': 1}}})
+
+    def test_resources_override_round_trips(self):
+        spec = spec_lib.ServiceSpec.from_yaml_config({
+            'readiness_probe': '/',
+            'pools': {'prefill': {
+                'role': 'prefill',
+                'resources': {'accelerators': 'tpu-v5e-8'}}}})
+        again = spec_lib.ServiceSpec.from_yaml_config(
+            spec.to_yaml_config())
+        assert again.pools['prefill'].resources == \
+            {'accelerators': 'tpu-v5e-8'}
+
+
+# --- state ------------------------------------------------------------------
+
+class TestPoolState:
+
+    def setup_method(self):
+        serve_state.reset_for_tests()
+        serve_state.add_service(SVC, {'run': 'true'}, lb_port=0,
+                                controller_port=0)
+
+    def teardown_method(self):
+        serve_state.reset_for_tests()
+
+    def test_pool_column_persists(self):
+        serve_state.add_replica(SVC, 1, 'c-1', 1, pool='prefill')
+        serve_state.add_replica(SVC, 2, 'c-2', 1)
+        rows = {r['replica_id']: r
+                for r in serve_state.get_replicas(SVC)}
+        assert rows[1]['pool'] == 'prefill'
+        assert rows[2]['pool'] is None
+
+
+# --- per-pool autoscaler ----------------------------------------------------
+
+class TestPoolAutoscaler:
+
+    def _scaler(self, name='decode'):
+        spec = _pool_spec()
+        return autoscalers.PoolAutoscaler(spec.pools[name],
+                                          now_fn=lambda: 0.0)
+
+    def test_queue_depth_scales_pool(self):
+        a = self._scaler()
+        sig = autoscalers.LoadSignals(queue_depth=20.0)
+        d = a.decide(3, 3, qps=0.0, signals=sig)
+        assert d.target_replicas == 5          # ceil(20/4), delay 0
+
+    def test_p95_breach_adds_one_per_round(self):
+        a = self._scaler()
+        sig = autoscalers.LoadSignals(decode_step_p95=0.5, kv_util=0.9)
+        d = a.decide(3, 3, qps=0.0, signals=sig)
+        # min 3 + one per breached signal (kv + decode p95) = 5.
+        assert d.target_replicas == 5
+
+    def test_unbreached_signals_hold_min(self):
+        a = self._scaler()
+        sig = autoscalers.LoadSignals(queue_depth=0.0, kv_util=0.1,
+                                      decode_step_p95=0.05)
+        d = a.decide(3, 3, qps=0.0, signals=sig)
+        assert d.target_replicas == 3
+
+    def test_max_clamp(self):
+        a = self._scaler()
+        sig = autoscalers.LoadSignals(queue_depth=1000.0)
+        d = a.decide(3, 3, qps=0.0, signals=sig)
+        assert d.target_replicas == 6          # pool max
+
+    def test_prefill_pool_uses_ttft_signal(self):
+        a = self._scaler('prefill')
+        hot = autoscalers.LoadSignals(ttft_p95=3.0)
+        assert a.decide(2, 2, qps=0.0,
+                        signals=hot).target_replicas == 3
+        cool = autoscalers.LoadSignals(ttft_p95=0.5)
+        assert a.decide(2, 2, qps=0.0,
+                        signals=cool).target_replicas == 2
+
+    def test_absent_signals_never_scale_down_below_min(self):
+        a = self._scaler()
+        d = a.decide(3, 3, qps=0.0, signals=autoscalers.LoadSignals())
+        assert d.target_replicas == 3
+
+
+# --- signal source ----------------------------------------------------------
+
+class TestMetricsSignalSourcePools:
+
+    def test_p95_from_histogram_deltas(self):
+        src = autoscalers.MetricsSignalSource(
+            ttft_metric='skytpu_fleetsim_ttft_seconds')
+        src.read_pools(['decode'])             # baseline snapshot
+        for _ in range(95):
+            obs.FLEETSIM_TTFT_SECONDS.observe(0.3)
+        for _ in range(5):
+            obs.FLEETSIM_TTFT_SECONDS.observe(9.0)
+        sig = src.read_pools(['decode'])['decode']
+        assert sig.ttft_p95 == 0.35            # bucket upper bound
+        # The window was consumed: a third read with no new samples
+        # reports the signal unavailable, not stale.
+        assert src.read_pools(['decode'])['decode'].ttft_p95 is None
+
+    def test_p95_past_top_bucket_reports_known_floor_not_none(self):
+        """Samples beyond the top finite bucket are a BREACH signal:
+        the source must report the top finite bound, not go blind at
+        worst saturation."""
+        src = autoscalers.MetricsSignalSource(
+            ttft_metric='skytpu_fleetsim_ttft_seconds')
+        src.read_pools(['decode'])
+        for _ in range(20):
+            obs.FLEETSIM_TTFT_SECONDS.observe(500.0)  # past 60s top
+        sig = src.read_pools(['decode'])['decode']
+        assert sig.ttft_p95 == 60.0
+
+    def test_pool_gauge_preferred_global_fallback(self):
+        src = autoscalers.MetricsSignalSource()
+        obs.QUEUE_DEPTH.set(7.0)
+        obs.POOL_QUEUE_DEPTH.labels(pool='prefill').set(3.0)
+        sigs = src.read_pools(['prefill', 'never_written'])
+        assert sigs['prefill'].queue_depth == 3.0
+        assert sigs['never_written'].queue_depth == 7.0
+        obs.QUEUE_DEPTH.set(0.0)
+        obs.POOL_QUEUE_DEPTH.labels(pool='prefill').set(0.0)
+
+
+# --- controller per-pool reconcile ------------------------------------------
+
+class FakeManager:
+    def __init__(self, service_name):
+        self.service_name = service_name
+        self.version = 1
+        self.scale_up_pools = []
+
+    def probe_all(self):
+        pass
+
+    def scale_up(self, n=1, use_spot=None, pool=None):
+        for _ in range(n):
+            rid = serve_state.next_replica_id(self.service_name)
+            serve_state.add_replica(self.service_name, rid, f'c-{rid}',
+                                    self.version, pool=pool)
+            self.scale_up_pools.append(pool)
+
+    def scale_down(self, replica_ids):
+        for rid in replica_ids:
+            serve_state.set_replica_status(self.service_name, rid,
+                                           R.SHUTTING_DOWN)
+
+    def ready_endpoints(self):
+        return [f'http://r{r["replica_id"]}'
+                for r in serve_state.get_replicas(self.service_name)
+                if r['status'] == R.READY]
+
+    def terminate_all(self):
+        pass
+
+
+class FakeTracker:
+    qps_value = 0.0
+
+    def qps(self):
+        return self.qps_value
+
+
+class FakeLB:
+    def __init__(self):
+        self.tracker = FakeTracker()
+        self.replicas = []
+        self.pools = None
+
+    def set_replicas(self, endpoints, pools=None):
+        self.replicas = endpoints
+        self.pools = pools
+
+    def stop(self):
+        pass
+
+
+class FakeSignals:
+    """Deterministic per-pool signals (read_pools contract)."""
+
+    def __init__(self):
+        self.by_pool = {}
+
+    def read(self):
+        return autoscalers.LoadSignals()
+
+    def read_pools(self, pools):
+        return {p: self.by_pool.get(p, autoscalers.LoadSignals())
+                for p in pools}
+
+
+@pytest.fixture
+def ctl():
+    serve_state.reset_for_tests()
+    serve_state.add_service(SVC, {'run': 'true'}, lb_port=0,
+                            controller_port=0)
+    c = object.__new__(controller_lib.ServeController)
+    c.service_name = SVC
+    c.spec = _pool_spec()
+    c.manager = FakeManager(SVC)
+    c.autoscaler = autoscalers.make_autoscaler(c.spec)
+    c.pool_autoscalers = autoscalers.make_pool_autoscalers(
+        c.spec, now_fn=lambda: 0.0)
+    c.lb = FakeLB()
+    c.signals = FakeSignals()
+    c._now = lambda: 0.0
+    c._sleep = lambda dt: None
+    c._stop = False
+    c._loaded_version = 1
+    c._maybe_reload_spec = lambda service: None
+    yield c
+    serve_state.reset_for_tests()
+
+
+def _mark_ready(*rids):
+    for rid in rids:
+        serve_state.set_replica_status(SVC, rid, R.READY,
+                                       endpoint=f'http://r{rid}')
+
+
+def _live_by_pool():
+    out = {}
+    for r in serve_state.get_replicas(SVC):
+        if r['status'] not in (R.SHUTTING_DOWN, R.FAILED):
+            out.setdefault(r['pool'], []).append(r['replica_id'])
+    return out
+
+
+class TestControllerPools:
+
+    def _seed(self, ctl):
+        ctl.manager.scale_up(2, pool='prefill')   # 1,2
+        ctl.manager.scale_up(3, pool='decode')    # 3,4,5
+        _mark_ready(1, 2, 3, 4, 5)
+
+    def test_steady_state_no_churn(self, ctl):
+        self._seed(ctl)
+        for _ in range(3):
+            ctl._step()
+        assert _live_by_pool() == {'prefill': [1, 2],
+                                   'decode': [3, 4, 5]}
+
+    def test_lb_gets_pool_roles(self, ctl):
+        self._seed(ctl)
+        ctl._step()
+        assert sorted(ctl.lb.replicas) == [f'http://r{i}'
+                                           for i in range(1, 6)]
+        assert ctl.lb.pools['http://r1'] == 'prefill'
+        assert ctl.lb.pools['http://r5'] == 'decode'
+
+    def test_pool_signal_scales_only_its_pool(self, ctl):
+        self._seed(ctl)
+        ctl.signals.by_pool['decode'] = autoscalers.LoadSignals(
+            queue_depth=20.0)                   # wants ceil(20/4)=5
+        ctl._step()
+        pools = _live_by_pool()
+        assert len(pools['decode']) == 5
+        assert len(pools['prefill']) == 2       # untouched
+        assert ctl.manager.scale_up_pools[-2:] == ['decode', 'decode']
+
+    def test_pressure_release_scales_pool_back_down(self, ctl):
+        self._seed(ctl)
+        ctl.signals.by_pool['decode'] = autoscalers.LoadSignals(
+            queue_depth=20.0)
+        ctl._step()
+        ctl.signals.by_pool['decode'] = autoscalers.LoadSignals()
+        ctl._step()
+        assert len(_live_by_pool()['decode']) == 3
+
+    def test_pool_gauges_exported(self, ctl):
+        self._seed(ctl)
+        ctl.signals.by_pool['decode'] = autoscalers.LoadSignals(
+            queue_depth=20.0)
+        ctl._step()
+        assert obs.POOL_TARGET_REPLICAS.value(
+            service=SVC, pool='decode') == 5
+        assert obs.POOL_READY_REPLICAS.value(
+            service=SVC, pool='prefill') == 2
+
+    def test_rolling_update_per_pool(self, ctl):
+        """Each pool rolls independently: one surge per pool, old
+        replicas retired only while the POOL's ready floor holds."""
+        self._seed(ctl)
+        ctl._step()
+        serve_state.set_service_version(SVC, 2, {'run': 'true'})
+        ctl.manager.version = 2
+        ctl._step()
+        pools = _live_by_pool()
+        # One v2 surge launched in EACH pool.
+        assert len(pools['prefill']) == 3
+        assert len(pools['decode']) == 4
+        surges = {r['pool']: r['replica_id']
+                  for r in serve_state.get_replicas(SVC)
+                  if r['version'] == 2}
+        assert set(surges) == {'prefill', 'decode'}
+        # Ready surges retire old replicas pool-locally.
+        _mark_ready(*surges.values())
+        ctl._step()
+        pools = _live_by_pool()
+        assert 1 not in pools['prefill']        # oldest prefill gone
+        assert 3 not in pools['decode']         # oldest decode gone
+
+    def test_dead_pool_replica_respawns_into_pool(self, ctl):
+        self._seed(ctl)
+        ctl._step()
+        serve_state.set_replica_status(SVC, 1, R.FAILED)
+        ctl._step()
+        pools = _live_by_pool()
+        # Pool autoscaler relaunched into prefill, not decode.
+        assert len(pools['prefill']) == 2
+        assert len(pools['decode']) == 3
