@@ -852,6 +852,113 @@ def _sharded_paged_bench(jax, on_tpu: bool):
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
+def _trace_overhead_bench(jax, on_tpu: bool):
+    """Span-tracing cost through the REAL engine (PR-16 evidence
+    channel): decode-step p50 with tracing at the default sampling
+    rate vs fully OFF. SKYTPU_TRACE_MAX_SPANS=0 is the off switch —
+    it short-circuits the engine's _trace_begin, so the per-request
+    trace dicts stay empty and every _trace_phase call is a dict-miss
+    no-op. ONE engine serves every round (rebuilding would add
+    compile/allocator variance that dwarfs the microseconds under
+    test), conditions alternate off/on across six rounds, and each
+    condition keeps its best (min) p50 — min-of-medians is robust to
+    scheduler noise. The bar: <= 2% overhead."""
+    import functools as _ft
+
+    from skypilot_tpu import inference as inf
+    from skypilot_tpu.models import resolve
+    from skypilot_tpu.observability import spans
+
+    model = 'bench-8b' if on_tpu else 'tiny'
+    _family, cfg = resolve(model)
+    params = jax.jit(_ft.partial(_family.init_params, cfg))(
+        jax.random.key(0))
+    b = 8
+    prompt_len = 128 if on_tpu else 8
+    new_tokens = 64 if on_tpu else 48
+    max_seq = 512 if on_tpu else 64
+
+    # Default fuse depth (the shipped serving config): the claim is
+    # overhead under the configuration people actually run.
+    eng = inf.InferenceEngine(
+        params, cfg, batch_size=b, max_seq_len=max_seq,
+        kv_quant='none')
+    prompts = [[(i * 7 + j) % 97 + 1 for j in range(prompt_len)]
+               for i in range(b)]
+
+    def drive(waves: int):
+        steps = []
+        for _ in range(waves):
+            for p in prompts:
+                eng.submit(p, inf.SamplingParams(
+                    temperature=0.0, max_new_tokens=new_tokens))
+            while eng.has_work:
+                t0 = time.perf_counter()
+                eng.step()
+                steps.append(time.perf_counter() - t0)
+            eng.finished()
+        return steps
+
+    def _p50(steps) -> float:
+        steps = sorted(steps)
+        return steps[len(steps) // 2]
+
+    saved = os.environ.get('SKYTPU_TRACE_MAX_SPANS')
+    try:
+        # Finest-grain interleaving with PAIRED ratios in RANDOMIZED
+        # order: host noise (CPU boost windows, scheduler
+        # interference, noisy neighbors) comes in multi-second
+        # bursts, so any statistic that compares off-aggregate vs
+        # on-aggregate bills a burst to whichever condition caught
+        # more of it. Instead each adjacent (off wave, on wave)
+        # pair — tens of ms apart, inside the same burst — yields
+        # one on/off ratio of its median step, and the claim is the
+        # MEDIAN ratio across pairs: bursts cancel within a pair,
+        # stragglers land in the tails the median ignores, and the
+        # seeded per-pair order shuffle keeps periodic host load
+        # from aliasing onto one condition.
+        import random as _random
+        order_rng = _random.Random(0)
+        drive(1)                     # compile + warmup
+        results = {'off': [], 'on': []}
+        ratios = []
+        pair = [('off', '0'), ('on', None)]
+        rounds = 100
+        for _ in range(rounds // 2):
+            wave = {}
+            order_rng.shuffle(pair)
+            for mode, max_spans in pair:
+                if max_spans is None:
+                    os.environ.pop('SKYTPU_TRACE_MAX_SPANS', None)
+                else:
+                    os.environ['SKYTPU_TRACE_MAX_SPANS'] = max_spans
+                wave[mode] = drive(1)
+                results[mode].extend(wave[mode])
+                spans.COLLECTOR.clear()
+            ratios.append(_p50(wave['on']) / _p50(wave['off']))
+        ratio = _p50(ratios)
+        results = {k: _p50(v) for k, v in results.items()}
+    finally:
+        if saved is None:
+            os.environ.pop('SKYTPU_TRACE_MAX_SPANS', None)
+        else:
+            os.environ['SKYTPU_TRACE_MAX_SPANS'] = saved
+
+    from skypilot_tpu import envs as _envs
+    overhead = ratio - 1.0
+    return {
+        'model': model, 'batch': b,
+        'max_new_tokens': new_tokens,
+        'sample_rate': _envs.SKYTPU_TRACE_SAMPLE.get(),
+        'decode_step_p50_off_ms': round(results['off'] * 1e3, 4),
+        'decode_step_p50_on_ms': round(results['on'] * 1e3, 4),
+        'overhead_frac': round(overhead, 4),
+        'rounds': rounds,
+        'threshold_frac': 0.02,
+        'rc': 0 if overhead <= 0.02 else 1,
+    }
+
+
 def main() -> None:
     try:
         jax, devices = _init_backend()
@@ -914,6 +1021,13 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — additive, like decode
         sharded_paged = {'error': f'{type(e).__name__}: {e}'}
 
+    gc.collect()
+    try:
+        _progress('trace-overhead: decode-step p50, tracing on vs off')
+        trace_overhead = _trace_overhead_bench(jax, on_tpu)
+    except Exception as e:  # noqa: BLE001 — additive, like decode
+        trace_overhead = {'error': f'{type(e).__name__}: {e}'}
+
     result = {
         'metric': (f'llama_{train["model"]}_train_tokens_per_sec_'
                    f'per_chip_{train["chip"]}'),
@@ -930,6 +1044,7 @@ def main() -> None:
             'fused_spec': fused_spec,
             'hf_import': hf_import,
             'sharded_paged': sharded_paged,
+            'trace_overhead': trace_overhead,
         },
     }
     print(json.dumps(result))

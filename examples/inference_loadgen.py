@@ -38,6 +38,10 @@ async def _one_request(session, url: str, prompt_len: int,
                   'max_new_tokens': max_new_tokens,
                   'stream': True}) as resp:
         resp.raise_for_status()
+        # Server (or LB) stamps the request's trace id on every
+        # response; carrying it per-result lets the report name the
+        # exact traces worth pulling from /internal/trace.
+        trace_id = resp.headers.get('X-Trace-ID')
         async for raw in resp.content:
             line = raw.decode().strip()
             if not line.startswith('data: '):
@@ -59,7 +63,20 @@ async def _one_request(session, url: str, prompt_len: int,
     return {'latency': time.perf_counter() - t0,
             'ttft': ttft if ttft is not None else float('nan'),
             'tokens': tokens,
-            'gaps': gaps}
+            'gaps': gaps,
+            'trace': trace_id}
+
+
+def _slowest_traces(results, n=5):
+    """The n slowest requests by TTFT that carried a trace id —
+    `python -m skypilot_tpu.observability.trace_dump --trace-id <id>`
+    turns each into a span tree. NaN TTFTs (zero-token responses)
+    sort last by exclusion."""
+    timed = [r for r in results
+             if r.get('trace') and r['ttft'] == r['ttft']]
+    timed.sort(key=lambda r: r['ttft'], reverse=True)
+    return [{'trace_id': r['trace'], 'ttft_s': round(r['ttft'], 4)}
+            for r in timed[:n]]
 
 
 def _pct(values, q):
@@ -185,6 +202,11 @@ async def run_shared_prefix(url: str, concurrency: int,
                                  if storm else None),
             'storm_ttft_p95_s': (round(_pct(storm_ttft, 0.95), 4)
                                  if storm else None),
+            # The triage jump-off: which exact requests paid the tail.
+            'slowest_traces': {
+                'cold': _slowest_traces(cold),
+                'warm': _slowest_traces(warm + storm),
+            },
         },
     }
 
@@ -240,6 +262,7 @@ async def run(url: str, concurrency: int, requests: int,
             # Inter-token latency: stream smoothness under load.
             'itl_p50_s': round(_pct(gaps, 0.5), 4),
             'itl_p99_s': round(_pct(gaps, 0.99), 4),
+            'slowest_traces': _slowest_traces(results),
         },
     }
 

@@ -98,9 +98,16 @@ class MetricsNamesChecker(Checker):
                         r'^# (HELP|TYPE) skytpu_[a-z0-9_]+ ', line):
                     emit('exposition', f'bad comment line: {line!r}')
                 continue
+            # Optional OpenMetrics exemplar suffix on histogram
+            # bucket lines: `... 5 # {trace_id="<id>"} 0.042`.
             if not re.match(
                     r'^skytpu_[a-z0-9_]+(\{[^{}]*\})? '
-                    r'([-+]?\d+(\.\d+)?([eE][-+]?\d+)?|\+Inf|-Inf|NaN)$',
+                    r'([-+]?\d+(\.\d+)?([eE][-+]?\d+)?|\+Inf|-Inf|NaN)'
+                    r'( # \{trace_id="[0-9a-zA-Z_-]+"\} '
+                    r'([-+]?\d+(\.\d+)?([eE][-+]?\d+)?))?$',
                     line):
                 emit('exposition', f'bad sample line: {line!r}')
+            if ' # {' in line and '_bucket' not in line:
+                emit('exposition',
+                     f'exemplar on a non-bucket line: {line!r}')
         return findings

@@ -372,6 +372,31 @@ SKYTPU_LB_POOL_MAX_NEW_THRESHOLD = declare(
     'for replica-pool routing; paired with '
     'SKYTPU_LB_POOL_PROMPT_THRESHOLD.')
 
+# --- distributed request tracing --------------------------------------------
+
+SKYTPU_TRACE_SAMPLE = declare(
+    'SKYTPU_TRACE_SAMPLE', float, 0.01,
+    'Head-sampling rate for request span trees (0..1). Errored and '
+    'slow requests are kept regardless of the coin; 1.0 keeps every '
+    'trace (debug / smoke runs).')
+SKYTPU_TRACE_MAX_SPANS = declare(
+    'SKYTPU_TRACE_MAX_SPANS', int, 20000,
+    'Process-wide cap on buffered spans (active + completed). Over '
+    'the cap the collector evicts the oldest completed trees, then '
+    'drops new spans (counted, never raised).')
+SKYTPU_TRACE_RECORDER_CAPACITY = declare(
+    'SKYTPU_TRACE_RECORDER_CAPACITY', int, 32,
+    'Completed span trees kept in the per-process flight-recorder '
+    'ring (dumped on SLO breach / breaker open).')
+SKYTPU_TRACE_SLOW_SECONDS = declare(
+    'SKYTPU_TRACE_SLOW_SECONDS', float, 5.0,
+    'Trace trees whose wall duration meets this threshold are kept '
+    'even when the head-sampling coin said drop.')
+SKYTPU_TRACE_DUMP_DIR = declare(
+    'SKYTPU_TRACE_DUMP_DIR', str, None,
+    'When set, the LB dumps the flight-recorder ring here as '
+    'TRACE_<reason>_<pid>.json whenever a circuit breaker opens.')
+
 # --- fleet simulation / soak harness ----------------------------------------
 
 SKYTPU_FLEETSIM_SEED = declare(

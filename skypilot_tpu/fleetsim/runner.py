@@ -851,6 +851,37 @@ register(Scenario(
 ))
 
 register(Scenario(
+    name='trace_breach',
+    description=('DELIBERATELY-FAILING flight-recorder drill (not in '
+                 'the tier-1/run_full pass set): a small fleet loses '
+                 'a zone with no restore, and an unmeetable TTFT '
+                 'target forces rc=1 — the point is the failing '
+                 'report itself, which must carry the span flight '
+                 'recorder (lb.proxy/lb.upstream trees including the '
+                 'error-marked failovers the zone loss caused).'),
+    replicas=12,
+    duration_s=40.0, tick_s=2.0, warmup_s=10.0,
+    traffic={'kind': 'constant', 'qps': 30.0},
+    profile=_SMOKE_PROFILE,
+    policy={'max_replicas': 16, 'target_qps_per_replica': 3.0,
+            'target_queue_per_replica': 4.0,
+            'upscale_delay_seconds': 10,
+            'downscale_delay_seconds': 120},
+    lb_policy='round_robin',
+    chaos=(
+        {'at': 20.0, 'action': 'zone_loss', 'zone': 'zone-a'},
+    ),
+    slos=(
+        # Unmeetable on purpose: no simulated fleet serves sub-0.1ms
+        # TTFT, so this report always lands with rc=1 and therefore
+        # always exercises the flight-recorder path.
+        slo_lib.HistQuantileBelow('ttft_p95_unmeetable',
+                                  threshold=0.0001),
+        slo_lib.RatioBelow('error_rate', threshold=0.005),
+    ),
+))
+
+register(Scenario(
     name='preemption_wave',
     description=('A spot fleet with dynamic on-demand fallback loses '
                  'half its replicas in one preemption wave; the '

@@ -19,6 +19,7 @@ import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.observability import spans as spans_lib
 
 _DEFAULT_WINDOW = ('warmup_end', 'end')
 
@@ -257,6 +258,13 @@ def write_report(out_dir: str, scenario: str, results: List[Dict],
     path = os.path.join(out_dir, f'SLO_{scenario}.json')
     payload = {'rc': rc, 'scenario': scenario, 'asserts': results,
                'extra': extra or {}}
+    if rc != 0:
+        # A failing report carries the span flight recorder: the last
+        # completed request trees (LB legs + per-attempt outcomes)
+        # from THIS process, so a breach is triaged from the report
+        # alone — which requests, through which replicas, how slow —
+        # instead of from a re-run with tracing turned up.
+        payload['flight_recorder'] = spans_lib.COLLECTOR.recent_trees()
     tmp = path + '.tmp'
     with open(tmp, 'w', encoding='utf-8') as f:
         json.dump(payload, f, indent=2, sort_keys=True)

@@ -57,6 +57,7 @@ from skypilot_tpu.inference import prefix_cache as prefix_lib
 from skypilot_tpu.models import llama
 from skypilot_tpu.models import moe as moe_lib
 from skypilot_tpu.observability import instruments as obs
+from skypilot_tpu.observability import spans
 from skypilot_tpu.parallel import sharding as sharding_lib
 
 Params = Dict[str, Any]
@@ -1504,6 +1505,27 @@ class InferenceEngine:
             self._prefix = prefix_lib.RadixPrefixCache(
                 self.kv_page_size)
         self._fused_dispatches = 0
+        # Per-request span parents: engine phases (admission wait,
+        # prefix match, prefill chunks, fused decode rounds, COW
+        # copies) record against the context captured at submit() —
+        # either the server's request span (the EngineLoop rebinds it
+        # across the thread hop) or an engine-owned root when nothing
+        # upstream is tracing. Timing is stamped host-side AROUND the
+        # jitted dispatches, never inside them (trace-safety rule).
+        self._req_trace: Dict[int, spans.SpanContext] = {}
+        self._req_submit_t: Dict[int, float] = {}
+        self._req_wait_t: Dict[int, float] = {}
+        # Head-sample coin cached at submit: _trace_exemplar runs per
+        # dispatch and must not take the collector lock per slot. A
+        # trace promoted to kept later (error/slow) just misses
+        # exemplar attachment — documented as under-report-only.
+        self._req_kept: Dict[int, bool] = {}
+        # Phase spans buffer request-locally as raw tuples — the
+        # engine loop is single-threaded, so these appends are
+        # lock-free — and flush to the collector once per request at
+        # _trace_finish. A fused decode dispatch therefore pays one
+        # list.append per active slot, not a locked collector insert.
+        self._req_phases: Dict[int, List[tuple]] = {}
         self._queue: List[Tuple[int, List[int], SamplingParams]] = []
         self._finished: Dict[int, List[int]] = {}
         self._finished_logprobs: Dict[int, List[float]] = {}
@@ -1538,6 +1560,7 @@ class InferenceEngine:
         self._queue.append((request_id, list(prompt_tokens),
                             sampling or SamplingParams()))
         obs.QUEUE_DEPTH.set(len(self._queue))
+        self._trace_begin(request_id)
         return request_id
 
     def finished(self) -> Dict[int, List[int]]:
@@ -1584,6 +1607,7 @@ class InferenceEngine:
                 aborted += 1
         if aborted:
             obs.REQUESTS_ABORTED.inc(aborted)
+        self._trace_finish(request_id)
         self._update_gauges()
 
     def abort_all(self) -> None:
@@ -1606,6 +1630,8 @@ class InferenceEngine:
             self._page_alloc.extend(self._prefix.clear())
         if aborted:
             obs.REQUESTS_ABORTED.inc(aborted)
+        for rid in list(self._req_trace):
+            self._trace_finish(rid)
         self._update_gauges()
 
     @property
@@ -1636,6 +1662,62 @@ class InferenceEngine:
         from skypilot_tpu.parallel import mesh as mesh_lib
         return mesh_lib.use_mesh(self.mesh)
 
+    # -- span plumbing (host-side phase attribution) -------------------------
+
+    def _trace_begin(self, request_id: int) -> None:
+        """Capture the span parent for this request at submit time —
+        the caller's context (server span, fleetsim dispatch span) or
+        an engine-owned root when nothing upstream traces.
+        SKYTPU_TRACE_MAX_SPANS=0 turns phase tracing off entirely
+        (the overhead-bench baseline)."""
+        if envs.SKYTPU_TRACE_MAX_SPANS.get() <= 0:
+            return
+        ctx = spans.current_context()
+        if ctx is None:
+            ctx = spans.SpanContext(spans.new_trace_id(),
+                                    spans.new_span_id())
+        spans.COLLECTOR.start_trace(ctx.trace_id)
+        self._req_trace[request_id] = ctx
+        self._req_kept[request_id] = \
+            spans.COLLECTOR.is_kept(ctx.trace_id)
+        self._req_submit_t[request_id] = time.time()
+        self._req_phases[request_id] = []
+
+    def _trace_phase(self, request_id: int, name: str, start: float,
+                     end: float, **attrs) -> None:
+        buf = self._req_phases.get(request_id)
+        if buf is not None:
+            buf.append((name, start, end, attrs))
+
+    def _trace_finish(self, request_id: int) -> None:
+        """Completion/abort: flush the buffered phase spans and
+        release the parent. For server-owned traces the HTTP span is
+        still open, so finish_trace is a no-op and the middleware's
+        scope exit finalizes; engine-owned traces finalize here."""
+        ctx = self._req_trace.pop(request_id, None)
+        phases = self._req_phases.pop(request_id, None)
+        self._req_kept.pop(request_id, None)
+        self._req_submit_t.pop(request_id, None)
+        self._req_wait_t.pop(request_id, None)
+        if ctx is None:
+            return
+        for name, start, end, attrs in phases or ():
+            spans.COLLECTOR.record_span(
+                f'engine.{name}', trace_id=ctx.trace_id,
+                parent_id=ctx.span_id, start=start, end=end,
+                attrs=attrs)
+        spans.COLLECTOR.finish_trace(ctx.trace_id)
+
+    def _trace_exemplar(self, request_ids) -> Optional[str]:
+        """A kept trace ID among `request_ids`, for histogram
+        exemplars on batched observations (first kept wins). Reads
+        the coin cached at submit — no collector lock per slot per
+        dispatch."""
+        for rid in request_ids:
+            if self._req_kept.get(rid):
+                return self._req_trace[rid].trace_id
+        return None
+
     def _pages_needed(self, prompt_len: int, max_new: int) -> int:
         """Worst-case pages a request can touch: prompt + generation
         budget + the speculative verify slab, capped at capacity."""
@@ -1662,6 +1744,7 @@ class InferenceEngine:
         slot_ids: List[int] = []
         while free and self._queue:
             matched: Optional[prefix_lib.MatchResult] = None
+            t_match: Optional[Tuple[float, float]] = None
             if self.kv_page_size:
                 # Page admission BEFORE popping: an oversubscribed
                 # pool holds the request at the queue head (FIFO — no
@@ -1677,7 +1760,9 @@ class InferenceEngine:
                     # table instead of being recomputed. acquire()
                     # BEFORE any reclaim below — eviction must never
                     # harvest the very pages this request matched.
+                    t_match0 = time.time()
                     matched = self._prefix.match(peek_trunc)
+                    t_match = (t_match0, time.time())
                     if matched.pages:
                         self._prefix.acquire(matched.pages)
                     # A fully-cached prompt still needs last-token
@@ -1696,9 +1781,31 @@ class InferenceEngine:
                     if need_private > len(self._page_alloc):
                         if matched is not None and matched.pages:
                             self._prefix.release(matched.pages)
+                        # Stamp the start of the head request's pool
+                        # wait (once): the span records at admission.
+                        if _rid in self._req_trace:
+                            self._req_wait_t.setdefault(
+                                _rid, time.time())
                         break
             slot = free.pop(0)
             request_id, tokens, sampling = self._queue.pop(0)
+            if request_id in self._req_trace:
+                now = time.time()
+                submit_t = self._req_submit_t.pop(request_id, None)
+                if submit_t is not None:
+                    self._trace_phase(request_id, 'admission_wait',
+                                      submit_t, now)
+                wait_t = self._req_wait_t.pop(request_id, None)
+                if wait_t is not None:
+                    self._trace_phase(request_id, 'page_pool_wait',
+                                      wait_t, now)
+                if t_match is not None:
+                    n_pages = len(matched.pages) if matched else 0
+                    self._trace_phase(
+                        request_id, 'prefix_match', t_match[0],
+                        t_match[1], matched_pages=n_pages,
+                        matched_tokens=(matched.tokens
+                                        if n_pages else 0))
             tokens = tokens[:self.state.max_seq_len - 1]
             if self.kv_page_size:
                 fresh = self._page_alloc[:need_private]
@@ -1778,6 +1885,7 @@ class InferenceEngine:
         lengths = jnp.array([len(t) for _, t, _ in inserts], jnp.int32)
         slot_arr = jnp.array(slot_ids, jnp.int32)
         t_prefill = time.perf_counter()
+        w_prefill = time.time()
         with self._mesh_ctx():
             logits, self.state.cache = prefill_chunked(
                 self.params, padded, lengths, self.state.cache,
@@ -1801,7 +1909,14 @@ class InferenceEngine:
         first_host, lp_host = jax.device_get((first, first_lp))
         # The device_get above is the sync point: the observed latency
         # covers the whole batched prefill, not just its dispatch.
-        obs.PREFILL_SECONDS.observe(time.perf_counter() - t_prefill)
+        obs.PREFILL_SECONDS.observe(
+            time.perf_counter() - t_prefill,
+            trace_id=self._trace_exemplar(r for r, _, _ in inserts))
+        w_end = time.time()
+        for rid, t, _s in inserts:
+            self._trace_phase(rid, 'prefill', w_prefill, w_end,
+                              bucket=bucket, chunk=chunk,
+                              prompt_tokens=len(t))
         last = jax.device_get(self.state.last_tokens).copy()
         for i, slot in enumerate(slot_ids):
             token = int(first_host[i])
@@ -1850,11 +1965,19 @@ class InferenceEngine:
                 'COW needs a free page but the pool is empty')
         dst = self._page_alloc.pop(0)
         src_a, dst_a = jnp.int32(src), jnp.int32(dst)
+        w_cow = time.time()
         with self._mesh_ctx():
             self.state.cache['k'] = _copy_pool_page(
                 self.state.cache['k'], src_a, dst_a)
             self.state.cache['v'] = _copy_pool_page(
                 self.state.cache['v'], src_a, dst_a)
+        cow_slot = self.state.slots[i]
+        if cow_slot is not None:
+            # Dispatch-only timing (COW never syncs — that's the
+            # point); the span marks THAT a copy happened and which
+            # page, for the warm-TTFT attribution story.
+            self._trace_phase(cow_slot.request_id, 'cow_copy', w_cow,
+                              time.time(), page=src)
         self._slot_pages[i][idx] = dst
         self._slot_shared[i].discard(idx)
         self._set_table_rows(i, self._slot_pages[i])
@@ -1921,6 +2044,7 @@ class InferenceEngine:
         visible = jnp.array([min(len(slot.pending), start + len(toks))],
                             jnp.int32)
         t_prefill = time.perf_counter()
+        w_chunk = time.time()
         with self._mesh_ctx():
             hidden, self.state.cache = prefill_chunk_at(
                 self.params, arr, jnp.int32(start), visible,
@@ -1932,6 +2056,11 @@ class InferenceEngine:
             # (that overlap IS the point of interleaving), and a
             # dispatch-only timing would drown the histogram in
             # microsecond samples that contradict its help string.
+            # The SPAN still records (dispatch-only, final=False) —
+            # per-chunk attribution is what the span tree is FOR.
+            self._trace_phase(slot.request_id, 'prefill_chunk',
+                              w_chunk, time.time(), width=chunk,
+                              pos=start, final=False)
             return
         # Final chunk: sample the first generated token from the last
         # prompt position's hidden state (same contract as the
@@ -1946,7 +2075,12 @@ class InferenceEngine:
             jnp.array([slot.params.top_k], jnp.int32),
             jnp.array([slot.params.top_p], jnp.float32), sub)
         first_host, lp_host = jax.device_get((first, first_lp))
-        obs.PREFILL_SECONDS.observe(time.perf_counter() - t_prefill)
+        obs.PREFILL_SECONDS.observe(
+            time.perf_counter() - t_prefill,
+            trace_id=self._trace_exemplar((slot.request_id,)))
+        self._trace_phase(slot.request_id, 'prefill_chunk', w_chunk,
+                          time.time(), width=chunk, pos=start,
+                          final=True)
         token = int(first_host[0])
         slot.generated.append(token)
         slot.logprobs.append(float(lp_host[0]))
@@ -2053,6 +2187,7 @@ class InferenceEngine:
         budgets, eos_arr, max_len = self._slot_bounds()
         slab_cap = jnp.int32(self._capacity)
         t_step = time.perf_counter()
+        w_step = time.time()
         with self._mesh_ctx():
             (toks, lps, emitted_dev, new_last, rounds_dev,
              proposed_dev, accepted_dev, self.state.cache,
@@ -2070,7 +2205,12 @@ class InferenceEngine:
          acc_host) = jax.device_get(
             (toks, lps, emitted_dev, rounds_dev, proposed_dev,
              accepted_dev))
-        obs.DECODE_STEP_SECONDS.observe(time.perf_counter() - t_step)
+        w_end = time.time()
+        obs.DECODE_STEP_SECONDS.observe(
+            time.perf_counter() - t_step,
+            trace_id=self._trace_exemplar(
+                s.request_id for s in slots
+                if s is not None and s.pending is None))
         obs.DECODE_HOST_STEPS.inc()
         self._fused_dispatches += 1
         obs.SPEC_ROUNDS.inc(int(rounds_host))
@@ -2094,6 +2234,10 @@ class InferenceEngine:
                 slot.generated.append(int(toks_host[i, j]))
                 slot.logprobs.append(float(lps_host[i, j]))
                 emitted += 1
+            self._trace_phase(slot.request_id, 'spec_decode', w_step,
+                              w_end, tokens=int(emit_host[i]),
+                              rounds=int(rounds_host),
+                              proposed=int(proposed_host))
         if emitted:
             obs.GENERATED_TOKENS.inc(emitted)
             obs.DECODE_TOKENS_PER_STEP.observe(emitted)
@@ -2114,6 +2258,7 @@ class InferenceEngine:
                 # the radix prefix cache instead of freeing them.
                 self._free_slot(i, publish=True)
                 obs.REQUESTS_FINISHED.inc()
+                self._trace_finish(slot.request_id)
 
     def _update_gauges(self) -> None:
         """Refresh the continuous-batching gauges from HOST-side slot
@@ -2210,6 +2355,7 @@ class InferenceEngine:
         # over-generates past what host-stepped decode would emit.
         budgets, eos_arr, max_len = self._slot_bounds()
         t_step = time.perf_counter()
+        w_step = time.time()
         with self._mesh_ctx():
             toks, lps, emitted_dev, new_last, self.state.cache = \
                 fused_decode_steps(
@@ -2222,7 +2368,12 @@ class InferenceEngine:
         # on the hot decode loop is pure added latency.
         toks_host, lps_host, emit_host = jax.device_get(
             (toks, lps, emitted_dev))
-        obs.DECODE_STEP_SECONDS.observe(time.perf_counter() - t_step)
+        w_end = time.time()
+        obs.DECODE_STEP_SECONDS.observe(
+            time.perf_counter() - t_step,
+            trace_id=self._trace_exemplar(
+                s.request_id for s in self.state.slots
+                if s is not None and s.pending is None))
         obs.DECODE_HOST_STEPS.inc()
         self._fused_dispatches += 1
         emitted = 0
@@ -2236,6 +2387,9 @@ class InferenceEngine:
                 slot.generated.append(int(toks_host[i, j]))
                 slot.logprobs.append(float(lps_host[i, j]))
                 emitted += 1
+            self._trace_phase(slot.request_id, 'decode', w_step,
+                              w_end, tokens=int(emit_host[i]),
+                              fused_steps=self.decode_fuse_steps)
         # Per-TOKEN accounting for a multi-token host step: the
         # throughput counters must never undercount N fused tokens as
         # one (rate(generated)/rate(host_steps) = amortization).

@@ -38,6 +38,7 @@ from skypilot_tpu import envs
 from skypilot_tpu import sky_logging
 from skypilot_tpu.observability import instruments as obs
 from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.observability import spans
 from skypilot_tpu.observability import tracing
 from skypilot_tpu.utils import timeline
 
@@ -82,7 +83,14 @@ class EngineLoop:
         """Called from async handlers; returns the watcher whose queue
         yields ('token', t)* then ('done', [tokens])."""
         watcher = self.Watcher(asyncio.get_running_loop(), stream)
-        self._submit_q.put((prompt, sampling, watcher))
+        # contextvars do NOT cross the queue into the engine thread:
+        # capture the (rid, span context) pair HERE, on the event
+        # loop, so the engine thread can rebind it and the engine's
+        # phase spans parent on the request's server span instead of
+        # starting orphan traces.
+        self._submit_q.put((prompt, sampling, watcher,
+                            tracing.get_request_id(),
+                            spans.current_context()))
         return watcher
 
     def stop(self) -> None:
@@ -98,11 +106,19 @@ class EngineLoop:
     def _drain_submissions(self) -> None:
         while True:
             try:
-                prompt, sampling, watcher = self._submit_q.get_nowait()
+                prompt, sampling, watcher, req_id, span_ctx = \
+                    self._submit_q.get_nowait()
             except queue.Empty:
                 return
             if watcher.aborted:
                 continue  # client vanished before the engine saw it
+            # Rebind the handler's request context across the thread
+            # hop for the duration of engine.submit(): the engine
+            # captures spans.current_context() per request there, and
+            # any submit-path log line keeps its rid=.
+            rid_token = tracing.bind(req_id) if req_id else None
+            ctx_token = spans.bind_context(span_ctx) \
+                if span_ctx is not None else None
             try:
                 rid = self.engine.submit(prompt, sampling)
             except Exception as e:  # noqa: BLE001
@@ -111,6 +127,11 @@ class EngineLoop:
                 # handler awaits forever.
                 watcher.push(('error', str(e)))
                 continue
+            finally:
+                if ctx_token is not None:
+                    spans.unbind_context(ctx_token)
+                if rid_token is not None:
+                    tracing.unbind(rid_token)
             self._watchers[rid] = watcher
 
     def _drain_aborts(self) -> None:
@@ -330,10 +351,34 @@ def create_app(engine_holder: Dict[str, Any]):
                 engine_loop.abort(watcher)
                 raise
 
+    async def internal_trace(request):
+        trace_id = request.query.get('trace_id')
+        if not trace_id:
+            # Index view: what the flight recorder currently holds.
+            trees = spans.COLLECTOR.recent_trees()
+            return web.json_response({'traces': [
+                {'trace_id': t['trace_id'], 'error': t['error'],
+                 'duration': t['duration'],
+                 'spans': len(t['spans'])} for t in trees]})
+        records = spans.COLLECTOR.spans_for(trace_id)
+        if not records:
+            return web.json_response(
+                {'error': f'unknown trace_id {trace_id!r} (dropped by '
+                          'sampling, evicted, or never seen here)'},
+                status=404)
+        return web.json_response({
+            'trace_id': trace_id,
+            'spans': records,
+            'tree': spans.tree_view(records),
+            'traceEvents':
+                spans.to_chrome_trace(records)['traceEvents'],
+        })
+
     app = web.Application(middlewares=[obs.http_middleware('inference')])
     app.router.add_get('/health', health)
     app.router.add_get('/', health)
     app.router.add_get('/metrics', metrics_lib.aiohttp_handler)
+    app.router.add_get('/internal/trace', internal_trace)
     app.router.add_post('/generate', generate)
     from skypilot_tpu.inference import openai_api
     openai_api.add_openai_routes(app, engine_holder)

@@ -245,7 +245,8 @@ class Gauge(_ScalarMetric):
 
 
 class _HistogramChild:
-    __slots__ = ('_buckets', '_counts', '_sum', '_count', '_lock')
+    __slots__ = ('_buckets', '_counts', '_sum', '_count', '_lock',
+                 '_exemplars')
 
     def __init__(self, buckets: Sequence[float]):
         self._buckets = buckets
@@ -253,15 +254,24 @@ class _HistogramChild:
         self._sum = 0.0
         self._count = 0
         self._lock = threading.Lock()
+        # Last (trace_id, value) landing in each bucket — OpenMetrics
+        # exemplars, the bridge from "p99 regressed" to "pull THIS
+        # trace". Last-wins per bucket keeps it O(buckets) forever.
+        self._exemplars: List[Optional[Tuple[str, float]]] = \
+            [None] * (len(buckets) + 1)
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float,
+                trace_id: Optional[str] = None) -> None:
         idx = bisect.bisect_left(self._buckets, value)
         with self._lock:
             self._counts[idx] += 1
             self._sum += value
             self._count += 1
+            if trace_id:
+                self._exemplars[idx] = (trace_id, float(value))
 
-    def observe_count(self, value: float, n: int) -> None:
+    def observe_count(self, value: float, n: int,
+                      trace_id: Optional[str] = None) -> None:
         """Record `value` as n identical samples under ONE lock
         acquire — the hot-path bulk form (e.g. per-round speculative
         acceptance counts drained batch-at-a-time per dispatch)."""
@@ -272,10 +282,16 @@ class _HistogramChild:
             self._counts[idx] += n
             self._sum += value * n
             self._count += n
+            if trace_id:
+                self._exemplars[idx] = (trace_id, float(value))
 
     def snapshot(self) -> Tuple[List[int], float, int]:
         with self._lock:
             return list(self._counts), self._sum, self._count
+
+    def exemplars(self) -> List[Optional[Tuple[str, float]]]:
+        with self._lock:
+            return list(self._exemplars)
 
 
 class Histogram(Metric):
@@ -299,13 +315,15 @@ class Histogram(Metric):
     def _make_child(self) -> _HistogramChild:
         return _HistogramChild(self.buckets)
 
-    def observe(self, value: float) -> None:
-        self._default_child().observe(value)
+    def observe(self, value: float,
+                trace_id: Optional[str] = None) -> None:
+        self._default_child().observe(value, trace_id=trace_id)
 
-    def observe_count(self, value: float, n: int) -> None:
+    def observe_count(self, value: float, n: int,
+                      trace_id: Optional[str] = None) -> None:
         """n identical samples, one lock acquire (see
         _HistogramChild.observe_count)."""
-        self._default_child().observe_count(value, n)
+        self._default_child().observe_count(value, n, trace_id=trace_id)
 
     def child_snapshot(self, **labels: str):
         """(cumulative bucket counts, sum, count) for one series —
@@ -341,6 +359,61 @@ class Histogram(Metric):
             out.append((f'{self.name}_sum', base, total))
             out.append((f'{self.name}_count', base, float(n)))
         return out
+
+    def exemplars(self) -> List[Dict[str, object]]:
+        """[{labels, le, trace_id, value}] for every bucket holding an
+        exemplar — the /internal/stats JSON form."""
+        with self._lock:
+            items = sorted(self._children.items())
+        out: List[Dict[str, object]] = []
+        bounds = [_format_value(b) for b in self.buckets] + ['+Inf']
+        for key, child in items:
+            for le, ex in zip(bounds, child.exemplars()):
+                if ex is None:
+                    continue
+                out.append({
+                    'labels': dict(zip(self.labelnames, key)),
+                    'le': le,
+                    'trace_id': ex[0],
+                    'value': ex[1],
+                })
+        return out
+
+    def collect_text(self) -> str:
+        """Histogram exposition with OpenMetrics-style exemplar
+        suffixes on bucket lines: `... 5 # {trace_id="..."} 0.042`.
+        Exemplar-free buckets render exactly as before, so plain
+        0.0.4 scrapers keep parsing every series."""
+        lines = [f'# HELP {self.name} {_escape_help(self.help)}',
+                 f'# TYPE {self.name} {self.type_name}']
+        with self._lock:
+            items = sorted(self._children.items())
+        for key, child in items:
+            counts, total, n = child.snapshot()
+            exemplars = child.exemplars()
+            base_names = self.labelnames + ('le',)
+            running = 0
+            bounds = ([_format_value(b) for b in self.buckets]
+                      + ['+Inf'])
+            cumulative = []
+            for c in counts:
+                running += c
+                cumulative.append(running)
+            for bound, cum, ex in zip(bounds, cumulative, exemplars):
+                line = (f'{self.name}_bucket'
+                        f'{_render_labels(base_names, key + (bound,))}'
+                        f' {_format_value(cum)}')
+                if ex is not None:
+                    line += (f' # {{trace_id='
+                             f'"{_escape_label_value(ex[0])}"}} '
+                             f'{_format_value(ex[1])}')
+                lines.append(line)
+            base = _render_labels(self.labelnames, key)
+            lines.append(f'{self.name}_sum{base} '
+                         f'{_format_value(total)}')
+            lines.append(f'{self.name}_count{base} '
+                         f'{_format_value(float(n))}')
+        return '\n'.join(lines)
 
 
 class Registry:
@@ -385,6 +458,20 @@ REGISTRY = Registry()
 
 def generate_text() -> str:
     return REGISTRY.generate_text()
+
+
+def exemplars_snapshot(registry: Optional[Registry] = None
+                       ) -> Dict[str, List[Dict[str, object]]]:
+    """histogram name -> exemplar rows, for /internal/stats (only
+    histograms that hold at least one exemplar appear)."""
+    reg = registry if registry is not None else REGISTRY
+    out: Dict[str, List[Dict[str, object]]] = {}
+    for metric in reg.metrics():
+        if isinstance(metric, Histogram):
+            rows = metric.exemplars()
+            if rows:
+                out[metric.name] = rows
+    return out
 
 
 async def aiohttp_handler(request):

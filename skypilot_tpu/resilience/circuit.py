@@ -18,7 +18,7 @@ the load balancer records outcomes from its asyncio thread.
 import enum
 import threading
 import time
-from typing import Callable, Dict
+from typing import Callable, Dict, Optional
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.observability import instruments as obs
@@ -52,7 +52,8 @@ class CircuitBreaker:
                  failure_threshold: int = 3,
                  recovery_timeout: float = 30.0,
                  half_open_max_calls: int = 1,
-                 now_fn: Callable[[], float] = time.monotonic):
+                 now_fn: Callable[[], float] = time.monotonic,
+                 on_open: Optional[Callable[[str], None]] = None):
         if failure_threshold < 1:
             raise ValueError('failure_threshold must be >= 1')
         if recovery_timeout < 0:
@@ -61,6 +62,11 @@ class CircuitBreaker:
         self.failure_threshold = failure_threshold
         self.recovery_timeout = recovery_timeout
         self.half_open_max_calls = half_open_max_calls
+        # Fired (outside the breaker lock) each time a target's
+        # circuit transitions to OPEN — the LB hooks its trace
+        # flight-recorder dump here, so the evidence of WHAT was
+        # failing ships the moment the breaker gives up on a target.
+        self._on_open = on_open
         self._now = now_fn
         self._lock = threading.Lock()
         self._targets: Dict[str, _Target] = {}
@@ -120,6 +126,7 @@ class CircuitBreaker:
             t.half_open_inflight = 0
 
     def record_failure(self, target: str) -> None:
+        opened = False
         with self._lock:
             t = self._targets.setdefault(target, _Target())
             t.failures += 1
@@ -129,12 +136,22 @@ class CircuitBreaker:
                 self._set_state(t, target, State.OPEN)
                 t.opened_at = self._now()
                 t.half_open_inflight = 0
+                opened = True
                 obs.CIRCUIT_OPEN.labels(breaker=self.name,
                                         target=target).inc()
                 logger.warning(
                     'circuit %s/%s OPEN after %d consecutive '
                     'failure(s); retry in %.0fs', self.name, target,
                     t.failures, self.recovery_timeout)
+        if opened and self._on_open is not None:
+            # Outside the lock: the callback may query this breaker
+            # (or do slow I/O like a trace dump) without deadlocking
+            # the record path.
+            try:
+                self._on_open(target)
+            except Exception:  # diagnostics must never break serving
+                logger.warning('on_open callback failed for %s/%s',
+                               self.name, target, exc_info=True)
 
     def forget(self, target: str) -> None:
         """Drop a target (replica scaled down): its gauge reads closed

@@ -16,6 +16,7 @@ from typing import Any, Callable, Dict, List, Optional
 from skypilot_tpu import envs
 from skypilot_tpu.observability import instruments as obs
 from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.observability import spans
 from skypilot_tpu.resilience import circuit
 from skypilot_tpu.resilience import faults
 from skypilot_tpu.serve import load_balancing_policies as lb_policies
@@ -131,7 +132,8 @@ class LoadBalancer:
         # the breaker on monotonic time (immune to wall-clock jumps).
         self.breaker = circuit.CircuitBreaker(
             'lb', failure_threshold=3, recovery_timeout=15.0,
-            now_fn=(time.monotonic if now_fn is time.time else now_fn))
+            now_fn=(time.monotonic if now_fn is time.time else now_fn),
+            on_open=self._dump_on_breaker_open)
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._runner = None
         self._thread: Optional[threading.Thread] = None
@@ -148,6 +150,15 @@ class LoadBalancer:
         for gone in old:
             self.breaker.forget(gone)
             self._pool_roles.pop(gone, None)
+
+    def _dump_on_breaker_open(self, target: str) -> None:
+        """A circuit opening means this LB just gave up on a replica —
+        dump the span flight recorder so the trees leading up to the
+        failures survive for offline triage. No-op unless
+        SKYTPU_TRACE_DUMP_DIR is set."""
+        out_dir = envs.SKYTPU_TRACE_DUMP_DIR.get()
+        if out_dir:
+            spans.dump_flight_recorder(out_dir, 'breaker_open')
 
     def _pool_candidates(self, context) -> Optional[List[str]]:
         """Replica-pool slice for this request's shape, or None for
@@ -203,8 +214,23 @@ class LoadBalancer:
         — content-aware policies and pool routing consume it here
         exactly as in production. Returns 'ok', 'no_replica' (empty
         rotation), 'all_open' (candidates exist, every circuit open)
-        or 'error' (every attempted upstream failed)."""
+        or 'error' (every attempted upstream failed).
+
+        Each dispatch records the same lb.proxy/lb.upstream span
+        shape as the HTTP proxy, so fleetsim's flight recorder holds
+        real routing trees when an SLO assert fails."""
         self.tracker.record()
+        root_attrs: Dict[str, Any] = {'transport': 'dispatch'}
+        with spans.span('lb.proxy', attrs=root_attrs) as root:
+            result = self._dispatch_traced(send, context, root)
+            root_attrs['result'] = result
+            if result != 'ok':
+                spans.COLLECTOR.mark_error(root.trace_id)
+            return result
+
+    def _dispatch_traced(self, send: Callable[[str], bool],
+                         context: Optional[Dict[str, Any]],
+                         root: spans.SpanContext) -> str:
         candidates = self._failover_order(context)
         if candidates is None:
             obs.LB_NO_REPLICA.inc()
@@ -218,8 +244,12 @@ class LoadBalancer:
                 obs.LB_UPSTREAM_RETRIES.inc()
             obs.LB_REPLICA_REQUESTS.labels(replica=target).inc()
             self.policy.on_request_start(target, context=context)
+            leg_attrs: Dict[str, Any] = {'replica': target,
+                                         'attempt': attempted}
             try:
-                ok = send(target)
+                with spans.span('lb.upstream', attrs=leg_attrs):
+                    ok = send(target)
+                    leg_attrs['ok'] = bool(ok)
             finally:
                 self.policy.on_request_end(target)
             if ok:
@@ -227,6 +257,10 @@ class LoadBalancer:
                 return 'ok'
             obs.LB_PROXY_ERRORS.inc()
             self.breaker.record_failure(target)
+            # Failed legs make the trace keep-worthy even when a later
+            # leg succeeds: the breaker-open dump should contain the
+            # requests that fed the breaker.
+            spans.COLLECTOR.mark_error(root.trace_id)
         if attempted == 0:
             obs.LB_NO_REPLICA.inc()
             return 'all_open'
@@ -251,6 +285,11 @@ class LoadBalancer:
             'breakers': breakers,
             'candidates': sum(1 for s in breakers.values()
                               if s != 'open'),
+            # Per-bucket exemplars from the LB's own histograms:
+            # each carries the trace id of a request that landed in
+            # that bucket — the jump-off from "p99 spiked" to the
+            # exact span tree of a request that paid it.
+            'exemplars': metrics_lib.exemplars_snapshot(),
             # WHY traffic shifted: the policy's affinity-table shape
             # (per-replica indexed-prefix counts) plus the hit/miss/
             # bounded-load counters. A dropped fleet cache-hit ratio
@@ -290,8 +329,7 @@ class LoadBalancer:
         })
 
     async def _handle_proxy(self, request):
-        from aiohttp import ClientSession, ClientTimeout, web
-        import aiohttp
+        from aiohttp import web
         self.tracker.record()
         # The retry discipline already buffers the body once (a
         # failed-over request must replay identical bytes); the
@@ -301,6 +339,38 @@ class LoadBalancer:
         body = await request.read()
         context = request_context(body, request.content_type,
                                   request.content_length)
+        # Join the caller's trace when it sent a traceparent; root a
+        # new one otherwise. Every proxied leg carries a fresh
+        # traceparent downstream and every response carries X-Trace-ID
+        # back, so a slow request's tree is one /internal/trace query
+        # away.
+        inbound = spans.parse_traceparent(
+            request.headers.get(spans.TRACEPARENT_HEADER))
+        root_attrs: Dict[str, Any] = {'method': request.method,
+                                      'path': request.rel_url.path}
+        with spans.span('lb.proxy', parent=inbound,
+                        attrs=root_attrs) as root:
+            response = await self._proxy_traced(request, body,
+                                                context, root)
+            root_attrs['status'] = response.status
+            if response.status >= 500:
+                spans.COLLECTOR.mark_error(root.trace_id)
+            if not response.prepared:
+                # Streamed responses already sent their headers (the
+                # trace header was stamped before prepare()).
+                response.headers.setdefault(
+                    spans.TRACE_ID_RESPONSE_HEADER, root.trace_id)
+            return response
+
+    async def _proxy_traced(self, request, body, context,
+                            root: spans.SpanContext):
+        """One routing pass under `root`'s trace: upstreams tried in
+        failover order, each attempt wrapped in an lb.upstream span
+        whose OWN id rides the outgoing traceparent — the replica's
+        server span parents on the leg that actually reached it, so
+        failover attempts stay separable in the merged tree."""
+        from aiohttp import ClientSession, ClientTimeout, web
+        import aiohttp
         candidates = self._failover_order(context)
         if candidates is None:
             obs.LB_NO_REPLICA.inc()
@@ -322,6 +392,11 @@ class LoadBalancer:
                 url += f'?{request.query_string}'
             self.policy.on_request_start(target, context=context)
             session = upstream = None
+            leg_attrs: Dict[str, Any] = {'replica': target,
+                                         'attempt': attempted}
+            leg_scope = contextlib.ExitStack()
+            leg_ctx = leg_scope.enter_context(
+                spans.span('lb.upstream', attrs=leg_attrs))
             try:
                 # Phase 1 — contact the upstream. Failures here are
                 # the REPLICA's: feed the breaker, fail over.
@@ -329,17 +404,27 @@ class LoadBalancer:
                     faults.inject('lb.upstream', env_exc=OSError)
                     session = ClientSession(
                         timeout=ClientTimeout(total=3600))
+                    # Strip any inbound traceparent: the replica must
+                    # parent on THIS leg, not on the client's span.
+                    hdrs = {k: v
+                            for k, v in request.headers.items()
+                            if k.lower() not in (
+                                'host', 'content-length',
+                                spans.TRACEPARENT_HEADER)}
+                    hdrs[spans.TRACEPARENT_HEADER] = \
+                        spans.format_traceparent(leg_ctx)
                     upstream = await session.request(
                         request.method, url, data=body,
-                        headers={k: v
-                                 for k, v in request.headers.items()
-                                 if k.lower() not in (
-                                     'host', 'content-length')},
-                        allow_redirects=False)
+                        headers=hdrs, allow_redirects=False)
                 except (OSError, aiohttp.ClientError) as e:
                     obs.LB_PROXY_ERRORS.inc()
                     self.breaker.record_failure(target)
                     last_error = e
+                    leg_attrs['error'] = type(e).__name__
+                    # A failed leg makes the trace keep-worthy even if
+                    # a later leg succeeds: the breaker-open dump must
+                    # contain the requests that fed the breaker.
+                    spans.COLLECTOR.mark_error(leg_ctx.trace_id)
                     # Nothing written: fail over to the next replica.
                     continue
                 # The replica answered: success for breaker purposes.
@@ -348,6 +433,7 @@ class LoadBalancer:
                 # here would let one dead client open circuits on
                 # healthy replicas.
                 self.breaker.record_success(target)
+                leg_attrs['status'] = upstream.status
                 # Stream the upstream body chunk-by-chunk: LLM
                 # serving fronts SSE/chunked token streams, which
                 # must flow as generated, not after completion.
@@ -359,6 +445,9 @@ class LoadBalancer:
                                  'transfer-encoding',
                                  'content-length',
                                  'connection')})
+                # Before prepare(): headers are immutable afterwards.
+                response.headers[spans.TRACE_ID_RESPONSE_HEADER] = \
+                    leg_ctx.trace_id
                 try:
                     await response.prepare(request)
                 except (OSError, aiohttp.ClientError):
@@ -393,6 +482,8 @@ class LoadBalancer:
                         # connection mid-body.
                         obs.LB_PROXY_ERRORS.inc()
                         obs.LB_MIDSTREAM_FAILURES.inc()
+                        leg_attrs['midstream_error'] = True
+                        spans.COLLECTOR.mark_error(leg_ctx.trace_id)
                         response.force_close()
                         with contextlib.suppress(Exception):
                             request.transport.close()
@@ -413,6 +504,7 @@ class LoadBalancer:
                     pass
                 return response
             finally:
+                leg_scope.close()
                 self.policy.on_request_end(target)
                 if upstream is not None:
                     upstream.close()
@@ -429,10 +521,55 @@ class LoadBalancer:
             text=f'All {attempted} upstream(s) failed; last error: '
                  f'{last_error}\n')
 
+    async def _handle_trace(self, request):
+        """Merged trace view: the LB's own spans for a trace id plus,
+        best-effort, whatever each ready replica's /internal/trace
+        knows about it — one query returns the LB leg AND the
+        replica's server/engine phases under one tree."""
+        from aiohttp import ClientSession, ClientTimeout, web
+        import aiohttp
+        trace_id = request.query.get('trace_id')
+        if not trace_id:
+            trees = spans.COLLECTOR.recent_trees()
+            return web.json_response({'traces': [
+                {'trace_id': t['trace_id'], 'error': t['error'],
+                 'duration': t['duration'],
+                 'spans': len(t['spans'])} for t in trees]})
+        records = list(spans.COLLECTOR.spans_for(trace_id))
+        for target in list(self.policy.replicas):
+            url = target.rstrip('/') + '/internal/trace'
+            try:
+                async with ClientSession(
+                        timeout=ClientTimeout(total=2)) as session:
+                    async with session.get(
+                            url, params={'trace_id': trace_id}) as r:
+                        if r.status != 200:
+                            continue
+                        doc = await r.json()
+            except (OSError, aiohttp.ClientError, ValueError,
+                    asyncio.TimeoutError):
+                # A replica that is down (or never saw the trace)
+                # contributes nothing; the LB's own legs still render.
+                continue
+            records.extend(doc.get('spans') or [])
+        if not records:
+            return web.json_response(
+                {'error': f'unknown trace_id {trace_id!r} (dropped by '
+                          'sampling, evicted, or never seen here)'},
+                status=404)
+        return web.json_response({
+            'trace_id': trace_id,
+            'spans': records,
+            'tree': spans.tree_view(records),
+            'traceEvents':
+                spans.to_chrome_trace(records)['traceEvents'],
+        })
+
     def _create_app(self):
         from aiohttp import web
         app = web.Application(client_max_size=1024 * 1024 * 256)
         app.router.add_get('/internal/stats', self._handle_stats)
+        app.router.add_get('/internal/trace', self._handle_trace)
         # Registered before the catch-all proxy: the LB's own metrics,
         # not a replica's (a replica's /metrics is scraped directly).
         app.router.add_get('/metrics', metrics_lib.aiohttp_handler)

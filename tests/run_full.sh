@@ -19,6 +19,69 @@ for scenario in smoke fused_decode spec_decode shared_prefix \
     JAX_PLATFORMS=cpu python -m skypilot_tpu.fleetsim \
         --scenario "$scenario" --out /tmp
 done
+# Flight-recorder drill: trace_breach fails BY DESIGN (unmeetable
+# TTFT target + zone loss) — the gate is that the failing report
+# carries the span flight recorder, not that it passes.
+breach_dir=$(mktemp -d)
+JAX_PLATFORMS=cpu python -m skypilot_tpu.fleetsim \
+    --scenario trace_breach --out "$breach_dir" && exit 1 || true
+JAX_PLATFORMS=cpu python - "$breach_dir" <<'EOF'
+import json, sys
+doc = json.load(open(f'{sys.argv[1]}/SLO_trace_breach.json'))
+assert doc['rc'] != 0, 'trace_breach unexpectedly passed'
+trees = doc.get('flight_recorder', [])
+assert trees, 'failing report carried no flight-recorder trees'
+names = {s['name'] for t in trees for s in t['spans']}
+assert {'lb.proxy', 'lb.upstream'} <= names, names
+print(f'flight recorder: {len(trees)} tree(s) in failing report')
+EOF
+rm -rf "$breach_dir"
+# Distributed-trace smoke: one real server, one traced request, and
+# /internal/trace must return a well-formed tree with prefill and
+# decode engine phases under the server's request span.
+JAX_PLATFORMS=cpu SKYTPU_TRACE_SAMPLE=1 python - <<'EOF'
+import json, subprocess, sys, time, urllib.request
+
+proc = subprocess.Popen(
+    [sys.executable, '-m', 'skypilot_tpu.inference.server',
+     '--model', 'tiny', '--port', '18321', '--batch-size', '4',
+     '--max-seq-len', '128'],
+    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+try:
+    base = 'http://127.0.0.1:18321'
+    for _ in range(120):
+        try:
+            doc = json.load(urllib.request.urlopen(
+                f'{base}/health', timeout=2))
+            if doc.get('status') == 'ok':
+                break
+        except Exception:
+            time.sleep(1)
+    else:
+        raise SystemExit('server never became ready')
+    req = urllib.request.Request(
+        f'{base}/generate',
+        data=json.dumps({'prompt_tokens': [5, 6, 7, 8],
+                         'max_new_tokens': 8}).encode(),
+        headers={'Content-Type': 'application/json'})
+    resp = urllib.request.urlopen(req, timeout=300)
+    trace_id = resp.headers.get('X-Trace-ID')
+    assert trace_id, 'response carried no X-Trace-ID'
+    resp.read()
+    time.sleep(1)   # let the engine thread finish its spans
+    tree = json.load(urllib.request.urlopen(
+        f'{base}/internal/trace?trace_id={trace_id}', timeout=10))
+    names = {s['name'] for s in tree['spans']}
+    assert 'inference.request' in names, names
+    assert 'engine.prefill' in names, names
+    assert 'engine.decode' in names, names
+    assert tree['tree'], 'empty tree view'
+    print(f'trace smoke: {len(tree["spans"])} span(s) for '
+          f'{trace_id}: {sorted(names)}')
+finally:
+    proc.terminate()
+    proc.wait(timeout=10)
+EOF
 # HF checkpoint round-trip smoke: export the tiny model (multi-shard)
 # then the import + verify CLIs must exit 0 — the same commands an
 # operator runs against a real pretrained download.

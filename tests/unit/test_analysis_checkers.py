@@ -409,6 +409,48 @@ def test_trace_safety_passes_clean_jitted_fn(tmp_path):
     assert findings == []
 
 
+def test_trace_safety_passes_host_span_stamps_around_dispatch(
+        tmp_path):
+    """The span-plane idiom: wall-clock stamps taken AROUND a jitted
+    dispatch (never inside it) and recorded after the fact must pass —
+    this is exactly how the engine times its phase spans."""
+    findings = _run_snippet(tmp_path, """
+        import time
+        import jax
+
+        @jax.jit
+        def decode_step(state, x):
+            return state + x
+
+        def timed_step(collector, trace_id, parent_id, state, x):
+            t0 = time.time()
+            out = decode_step(state, x)
+            out.block_until_ready()
+            collector.record_span('engine.decode', trace_id=trace_id,
+                                  parent_id=parent_id, start=t0,
+                                  end=time.time())
+            return out
+    """, 'trace-safety')
+    assert findings == []
+
+
+def test_trace_safety_flags_span_stamp_inside_jitted_body(tmp_path):
+    """The anti-idiom: stamping span times INSIDE the jitted body runs
+    once at trace time and then lies forever — must flag."""
+    findings = _run_snippet(tmp_path, """
+        import time
+        import jax
+
+        @jax.jit
+        def decode_step(state, x):
+            t0 = time.time()
+            out = state + x
+            elapsed = time.time() - t0
+            return out, elapsed
+    """, 'trace-safety')
+    assert _rules(findings).count('host-call') == 2
+
+
 # --- env-registry -----------------------------------------------------------
 
 def test_env_registry_flags_undeclared_var(tmp_path):
@@ -628,6 +670,49 @@ def test_metrics_names_checker_flags_bad_metric():
                         .check_project(core.repo_root(), ()))
         assert any(f.rule == 'counter-suffix'
                    and 'skytpu_bad_lint_fixture' in f.message
+                   for f in findings)
+    finally:
+        metrics.REGISTRY.unregister(bad)
+
+
+def test_metrics_names_exposition_accepts_bucket_exemplar():
+    """Must-pass direction: an OpenMetrics exemplar suffix on a
+    histogram bucket line is valid exposition, not name drift."""
+    from skypilot_tpu.analysis.checkers import metrics_names
+    from skypilot_tpu.observability import metrics
+    hist = metrics.Histogram('skytpu_exemplar_fixture_seconds',
+                             'A fixture histogram with an exemplar.',
+                             buckets=(0.1, 1.0))
+    try:
+        hist.observe(0.05, trace_id='a1b2c3d4' * 4)
+        findings = list(metrics_names.MetricsNamesChecker()
+                        .check_project(core.repo_root(), ()))
+        assert not [f for f in findings if f.rule == 'exposition'], \
+            [f.message for f in findings]
+    finally:
+        metrics.REGISTRY.unregister(hist)
+
+
+def test_metrics_names_exposition_flags_non_bucket_exemplar():
+    """Must-flag direction: an exemplar suffix anywhere but a
+    `_bucket` line (sum, count, counters) is malformed exposition."""
+    from skypilot_tpu.analysis.checkers import metrics_names
+    from skypilot_tpu.observability import metrics
+
+    class _BadExemplarCounter(metrics.Counter):
+        def collect_text(self):
+            return ('# HELP skytpu_bad_exemplar_total A fixture.\n'
+                    '# TYPE skytpu_bad_exemplar_total counter\n'
+                    'skytpu_bad_exemplar_total 1 '
+                    '# {trace_id="abc"} 1')
+
+    bad = _BadExemplarCounter('skytpu_bad_exemplar_total',
+                              'A fixture.')
+    try:
+        findings = list(metrics_names.MetricsNamesChecker()
+                        .check_project(core.repo_root(), ()))
+        assert any(f.rule == 'exposition'
+                   and 'non-bucket' in f.message
                    for f in findings)
     finally:
         metrics.REGISTRY.unregister(bad)

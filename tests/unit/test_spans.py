@@ -1,0 +1,429 @@
+"""Span-plane contract tests: the collector's bounds and sampling
+semantics, W3C traceparent propagation, exemplar gating, the flight
+recorder, and the EngineLoop thread-hop regression (engine phase
+spans must parent on the request's server span, not start orphan
+traces).
+
+Every collector test pins the knobs through the constructor so the
+suite never depends on (or mutates) the SKYTPU_TRACE_* environment.
+"""
+import asyncio
+import os
+import threading
+
+import pytest
+
+from skypilot_tpu.observability import metrics
+from skypilot_tpu.observability import spans
+
+
+def _collector(**kw):
+    defaults = dict(sample_rate=1.0, max_spans=10_000,
+                    recorder_capacity=64, slow_seconds=1e9)
+    defaults.update(kw)
+    return spans.SpanCollector(**defaults)
+
+
+# --- traceparent propagation ------------------------------------------------
+
+def test_traceparent_round_trip():
+    ctx = spans.SpanContext(trace_id=spans.new_trace_id(),
+                            span_id=spans.new_span_id())
+    header = spans.format_traceparent(ctx)
+    assert header == f'00-{ctx.trace_id}-{ctx.span_id}-01'
+    assert spans.parse_traceparent(header) == ctx
+
+
+@pytest.mark.parametrize('bad', [
+    None,
+    '',
+    'not-a-traceparent',
+    '00-abc-def-01',                                   # wrong lengths
+    '00-' + 'g' * 32 + '-' + '1' * 16 + '-01',         # non-hex trace
+    '00-' + '1' * 32 + '-' + 'z' * 16 + '-01',         # non-hex span
+    '00-' + '0' * 32 + '-' + '1' * 16 + '-01',         # all-zero trace
+    '00-' + '1' * 32 + '-' + '0' * 16 + '-01',         # all-zero span
+    '00-' + '1' * 32 + '-' + '1' * 16,                 # missing flags
+    'zz-' + '1' * 32 + '-' + '1' * 16 + '-01',         # bad version
+    '00-' + '1' * 32 + '-' + '1' * 16 + '-01-extra',   # trailing part
+])
+def test_traceparent_rejects_malformed(bad):
+    assert spans.parse_traceparent(bad) is None
+
+
+def test_traceparent_tolerates_whitespace():
+    tid, sid = '2' * 32, '3' * 16
+    ctx = spans.parse_traceparent(f'  00-{tid}-{sid}-01\n')
+    assert ctx == spans.SpanContext(trace_id=tid, span_id=sid)
+
+
+# --- collector bounds -------------------------------------------------------
+
+def test_collector_never_exceeds_max_spans():
+    coll = _collector(max_spans=40, recorder_capacity=1000)
+    for _ in range(30):
+        tid = spans.new_trace_id()
+        for _ in range(5):
+            coll.record_span('s', trace_id=tid, start=0.0, end=0.1)
+            assert coll.span_count() <= 40
+        coll.finish_trace(tid)
+        assert coll.span_count() <= 40
+
+
+def test_collector_drops_when_active_trace_fills_cap():
+    """One giant in-flight trace: once the cap is hit and there are
+    no completed trees to evict, new spans are counted as dropped —
+    never buffered past the bound, never raised as errors."""
+    coll = _collector(max_spans=25)
+    tid = spans.new_trace_id()
+    for _ in range(100):
+        coll.record_span('s', trace_id=tid, start=0.0, end=0.1)
+    assert coll.span_count() <= 25
+    assert coll.dropped_spans == 75
+    assert len(coll.spans_for(tid)) == 25
+
+
+def test_eviction_prefers_completed_trees_over_active():
+    coll = _collector(max_spans=10, recorder_capacity=1000)
+    done = spans.new_trace_id()
+    for _ in range(6):
+        coll.record_span('old', trace_id=done, start=0.0, end=0.1)
+    coll.finish_trace(done)
+    live = spans.new_trace_id()
+    for _ in range(8):
+        coll.record_span('new', trace_id=live, start=0.0, end=0.1)
+    # The completed tree was evicted to make room; nothing dropped.
+    assert coll.spans_for(done) == []
+    assert len(coll.spans_for(live)) == 8
+    assert coll.dropped_spans == 0
+
+
+def test_recorder_ring_keeps_newest_last():
+    coll = _collector(recorder_capacity=3)
+    tids = []
+    for i in range(5):
+        tid = spans.new_trace_id()
+        tids.append(tid)
+        coll.record_span(f's{i}', trace_id=tid, start=float(i),
+                         end=float(i) + 0.1)
+        coll.finish_trace(tid)
+    trees = coll.recent_trees()
+    assert [t['trace_id'] for t in trees] == tids[-3:]
+    assert coll.recent_trees(limit=1)[0]['trace_id'] == tids[-1]
+
+
+# --- head sampling ----------------------------------------------------------
+
+def test_sample_zero_drops_clean_traces():
+    coll = _collector(sample_rate=0.0)
+    tid = spans.new_trace_id()
+    coll.record_span('a', trace_id=tid, start=1.0, end=1.1)
+    coll.finish_trace(tid)
+    assert coll.spans_for(tid) == []
+    assert coll.recent_trees() == []
+    assert coll.span_count() == 0
+
+
+def test_sample_zero_keeps_errored_trace_via_status():
+    coll = _collector(sample_rate=0.0)
+    tid = spans.new_trace_id()
+    coll.record_span('a', trace_id=tid, start=1.0, end=1.1,
+                     status='error')
+    coll.finish_trace(tid)
+    trees = coll.recent_trees()
+    assert len(trees) == 1
+    assert trees[0]['trace_id'] == tid and trees[0]['error']
+
+
+def test_sample_zero_keeps_errored_trace_via_mark_error():
+    """The LB marks a trace errored when a failover leg dies even if a
+    later leg succeeds — those traces feed breaker-open dumps."""
+    coll = _collector(sample_rate=0.0)
+    tid = spans.new_trace_id()
+    coll.record_span('leg', trace_id=tid, start=1.0, end=1.1)
+    coll.mark_error(tid)
+    coll.record_span('leg', trace_id=tid, start=1.1, end=1.2)
+    coll.finish_trace(tid)
+    assert len(coll.spans_for(tid)) == 2
+
+
+def test_sample_zero_keeps_slow_trace():
+    coll = _collector(sample_rate=0.0, slow_seconds=0.05)
+    tid = spans.new_trace_id()
+    coll.record_span('slow', trace_id=tid, start=1.0, end=1.2)
+    coll.finish_trace(tid)
+    assert len(coll.recent_trees()) == 1
+
+
+def test_finish_trace_waits_for_open_scopes():
+    coll = _collector(sample_rate=1.0)
+    tid = spans.new_trace_id()
+    coll.note_open(tid)
+    coll.record_span('child', trace_id=tid, start=0.0, end=0.1)
+    coll.finish_trace(tid)            # no-op: a scope is still live
+    assert coll.recent_trees() == []
+    coll.note_close(tid)              # last scope exits -> finalize
+    assert len(coll.recent_trees()) == 1
+
+
+# --- the span() scope -------------------------------------------------------
+
+def test_span_scope_nests_children_via_contextvar():
+    coll = _collector()
+    with spans.span('root', collector=coll) as root:
+        assert spans.current_context() == root
+        with spans.span('child', collector=coll) as child:
+            assert child.trace_id == root.trace_id
+    assert spans.current_context() is None
+    by_name = {s['name']: s for s in coll.spans_for(root.trace_id)}
+    assert by_name['root']['parent_id'] is None
+    assert by_name['child']['parent_id'] == root.span_id
+
+
+def test_span_scope_attrs_mutated_mid_scope_are_recorded():
+    coll = _collector()
+    attrs = {'replica': 'r0'}
+    with spans.span('lb.upstream', attrs=attrs,
+                    collector=coll) as ctx:
+        attrs['status'] = 503
+    (record,) = coll.spans_for(ctx.trace_id)
+    assert record['attrs'] == {'replica': 'r0', 'status': 503}
+
+
+def test_span_scope_exception_marks_error_and_keeps_trace():
+    coll = _collector(sample_rate=0.0)
+    with pytest.raises(RuntimeError):
+        with spans.span('boom', collector=coll) as ctx:
+            raise RuntimeError('dispatch failed')
+    (record,) = coll.spans_for(ctx.trace_id)
+    assert record['status'] == 'error'
+    assert coll.recent_trees()[0]['error']
+
+
+def test_span_scope_joins_explicit_remote_parent():
+    coll = _collector()
+    remote = spans.SpanContext(trace_id='a' * 32, span_id='b' * 16)
+    with spans.span('inference.request', parent=remote,
+                    collector=coll) as ctx:
+        assert ctx.trace_id == remote.trace_id
+    (record,) = coll.spans_for(remote.trace_id)
+    assert record['parent_id'] == remote.span_id
+
+
+# --- concurrency: asyncio + threads must not cross-link ---------------------
+
+def test_threads_and_tasks_do_not_cross_link_parents():
+    coll = _collector()
+    thread_traces = []
+
+    def worker():
+        with spans.span('root', collector=coll) as root:
+            with spans.span('child', collector=coll):
+                pass
+        thread_traces.append(root.trace_id)
+
+    async def task_worker():
+        with spans.span('root', collector=coll) as root:
+            await asyncio.sleep(0.001)   # force task interleaving
+            with spans.span('child', collector=coll):
+                await asyncio.sleep(0.001)
+        return root.trace_id
+
+    async def run_tasks():
+        return await asyncio.gather(*[task_worker()
+                                      for _ in range(8)])
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    task_traces = asyncio.new_event_loop().run_until_complete(
+        run_tasks())
+    for t in threads:
+        t.join()
+
+    all_traces = thread_traces + list(task_traces)
+    assert len(set(all_traces)) == 16   # nobody joined a stranger
+    for tid in all_traces:
+        by_name = {s['name']: s for s in coll.spans_for(tid)}
+        assert set(by_name) == {'root', 'child'}
+        assert by_name['root']['parent_id'] is None
+        assert by_name['child']['parent_id'] == \
+            by_name['root']['span_id']
+
+
+# --- EngineLoop thread hop (regression) -------------------------------------
+
+class _CaptureEngine:
+    """Engine stand-in: records what span context the engine thread
+    sees at submit() time (the real engine captures it exactly
+    there)."""
+
+    def __init__(self):
+        self.captured = []
+        self._next_rid = 0
+
+    def submit(self, prompt, sampling):
+        self.captured.append(spans.current_context())
+        self._next_rid += 1
+        return self._next_rid
+
+    @property
+    def has_work(self):
+        return False
+
+    def step(self):
+        pass
+
+    def active_progress(self):
+        return {}
+
+    def finished(self):
+        return {}
+
+    def finished_logprobs(self):
+        return {}
+
+    def abort(self, rid):
+        pass
+
+    def abort_all(self):
+        pass
+
+
+def test_engine_loop_rebinds_span_context_across_thread_hop():
+    """Contextvars do not cross the submit queue: EngineLoop must
+    capture the handler's span context on the event loop and rebind
+    it on the engine thread — otherwise every engine phase span
+    starts an orphan trace instead of parenting on the request."""
+    from skypilot_tpu.inference import server as srv
+    coll = _collector()
+    eng = _CaptureEngine()
+    loop = srv.EngineLoop(eng)
+    try:
+        async def drain_to(n):
+            for _ in range(500):
+                if len(eng.captured) >= n:
+                    return
+                await asyncio.sleep(0.01)
+
+        async def go():
+            with spans.span('inference.request',
+                            collector=coll) as ctx:
+                loop.submit([1, 2], None)
+            # Wait for the engine thread to drain the traced request
+            # BEFORE submitting the untraced one: the idle-park path
+            # re-queues items, so back-to-back submits can reorder.
+            await drain_to(1)
+            loop.submit([3], None)   # no ambient span for this one
+            await drain_to(2)
+            return ctx
+        ctx = asyncio.new_event_loop().run_until_complete(go())
+    finally:
+        loop.stop()
+    assert len(eng.captured) >= 2, 'engine thread never drained'
+    # The traced request's context crossed the hop intact...
+    assert eng.captured[0] == ctx
+    # ...and was unbound afterwards: the untraced request must NOT
+    # inherit the previous request's trace.
+    assert eng.captured[1] is None
+
+
+# --- exemplars --------------------------------------------------------------
+
+def test_exemplar_trace_id_gates_on_kept(monkeypatch):
+    monkeypatch.setattr(spans, 'COLLECTOR',
+                        _collector(sample_rate=1.0))
+    kept = spans.new_trace_id()
+    spans.COLLECTOR.start_trace(kept)
+    assert spans.exemplar_trace_id(kept) == kept
+
+    monkeypatch.setattr(spans, 'COLLECTOR',
+                        _collector(sample_rate=0.0))
+    dropped = spans.new_trace_id()
+    spans.COLLECTOR.start_trace(dropped)
+    assert spans.exemplar_trace_id(dropped) is None
+    assert spans.exemplar_trace_id(None) is None
+
+
+def test_histogram_exposition_renders_exemplar_on_bucket_line():
+    hist = metrics.Histogram('skytpu_span_fixture_seconds',
+                             'Span-test fixture histogram.',
+                             buckets=(0.1, 1.0))
+    try:
+        hist.observe(0.05, trace_id='deadbeef' * 4)
+        hist.observe(0.5)    # exemplar-free bucket
+        text = hist.collect_text()
+        lines = text.splitlines()
+        tagged = [ln for ln in lines if ' # {' in ln]
+        assert tagged == [
+            'skytpu_span_fixture_seconds_bucket{le="0.1"} 1 '
+            '# {trace_id="' + 'deadbeef' * 4 + '"} 0.05']
+        # sum/count and exemplar-free buckets stay plain 0.0.4 format.
+        assert any(ln == 'skytpu_span_fixture_seconds_bucket'
+                   '{le="1"} 2' for ln in lines)
+        assert not any(' # {' in ln for ln in lines
+                       if '_bucket' not in ln)
+        rows = hist.exemplars()
+        assert rows == [{'labels': {}, 'le': '0.1',
+                         'trace_id': 'deadbeef' * 4, 'value': 0.05}]
+    finally:
+        metrics.REGISTRY.unregister(hist)
+
+
+# --- export forms -----------------------------------------------------------
+
+def _records():
+    return [
+        {'name': 'lb.proxy', 'trace_id': 't', 'span_id': 'a',
+         'parent_id': None, 'start': 1.0, 'end': 1.5,
+         'attrs': {'status': 200}, 'status': 'ok'},
+        {'name': 'lb.upstream', 'trace_id': 't', 'span_id': 'b',
+         'parent_id': 'a', 'start': 1.1, 'end': 1.4, 'attrs': {},
+         'status': 'ok'},
+        {'name': 'inference.request', 'trace_id': 't', 'span_id': 'c',
+         'parent_id': 'remote-parent', 'start': 1.2, 'end': 1.3,
+         'attrs': {}, 'status': 'error'},
+    ]
+
+
+def test_to_chrome_trace_converts_to_complete_events():
+    doc = spans.to_chrome_trace(_records())
+    events = doc['traceEvents']
+    assert [e['ph'] for e in events] == ['X'] * 3
+    proxy = events[0]
+    assert proxy['ts'] == 1.0 * 1e6
+    assert proxy['dur'] == pytest.approx(0.5e6)
+    assert proxy['args']['status'] == 200        # attr, not span status
+    assert events[1]['args']['parent_id'] == 'a'
+    assert events[2]['args']['status'] == 'error'
+
+
+def test_tree_view_nests_and_surfaces_remote_parents_as_roots():
+    roots = spans.tree_view(_records())
+    # The cross-process span (parent lives in the LB) is a root here.
+    assert [r['name'] for r in roots] == ['lb.proxy',
+                                         'inference.request']
+    proxy = roots[0]
+    assert [c['name'] for c in proxy['children']] == ['lb.upstream']
+
+
+# --- flight recorder --------------------------------------------------------
+
+def test_dump_flight_recorder_writes_ring(tmp_path):
+    coll = _collector()
+    tid = spans.new_trace_id()
+    coll.record_span('lb.proxy', trace_id=tid, start=0.0, end=0.2)
+    coll.finish_trace(tid)
+    path = spans.dump_flight_recorder(str(tmp_path), 'breaker_open',
+                                      collector=coll)
+    assert path == os.path.join(
+        str(tmp_path), f'TRACE_breaker_open_{os.getpid()}.json')
+    import json
+    doc = json.load(open(path))
+    assert doc['reason'] == 'breaker_open'
+    assert doc['trees'][0]['trace_id'] == tid
+
+
+def test_dump_flight_recorder_empty_ring_is_none(tmp_path):
+    assert spans.dump_flight_recorder(
+        str(tmp_path), 'noop', collector=_collector()) is None
