@@ -452,6 +452,175 @@ def run_lb_compare(args):
     }
 
 
+# --- preemption drill (the migration capstone) ------------------------------
+
+def run_kill_replica(args):
+    """The preemption drill: N real inference servers behind the REAL
+    HTTP load balancer, every client streaming concurrently, and at
+    `--kill-replica-at` seconds one replica gets SIGTERM — the spot
+    preemption signal. The dying replica drains (snapshotting the
+    decodes it can't finish inside SKYTPU_DRAIN_DEADLINE_SECONDS),
+    the LB restores each snapshot on a survivor, and every client
+    stream must still complete with its FULL token count and no
+    visible error. rc=0 iff at least one request actually migrated
+    and none failed — a drill where the kill missed every stream is
+    a failed drill, not a pass."""
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    sys.path.insert(0, repo_root)
+    import signal
+
+    from skypilot_tpu.observability import instruments as obs
+    from skypilot_tpu.serve import load_balancer as lb_lib
+
+    n = args.lb_replicas if args.lb_replicas >= 2 else 2
+    ports = [_free_port() for _ in range(n)]
+    urls = [f'http://127.0.0.1:{p}' for p in ports]
+    max_seq = max(2048, args.prompt_len + args.max_new_tokens + 64)
+    env = dict(os.environ,
+               SKYTPU_DRAIN_DEADLINE_SECONDS=str(
+                   args.drain_deadline))
+    procs = []
+    log = open(args.lb_server_log, 'ab') if args.lb_server_log \
+        else subprocess.DEVNULL
+    results = []
+    errors = []
+    try:
+        for port in ports:
+            procs.append(subprocess.Popen(
+                [sys.executable, '-m', 'skypilot_tpu.inference.server',
+                 '--model', 'tiny', '--port', str(port),
+                 '--batch-size', str(max(8, args.concurrency)),
+                 '--max-seq-len', str(max_seq)],
+                cwd=repo_root, env=env, stdout=log, stderr=log))
+
+        async def _prepare():
+            import aiohttp
+            timeout = aiohttp.ClientTimeout(total=None,
+                                            sock_connect=30)
+            async with aiohttp.ClientSession(
+                    timeout=timeout) as session:
+                for url in urls:
+                    await _wait_ready(session, url,
+                                      args.ready_timeout)
+                    # Absorb each replica's prefill/decode compiles
+                    # now — a compile stall inside the measured run
+                    # would masquerade as an interruption gap.
+                    await _one_request(session, url,
+                                       args.prompt_len, 8)
+
+        asyncio.run(_prepare())
+
+        lb = lb_lib.LoadBalancer('round_robin',
+                                 honor_env_policy=False)
+        lb.set_replicas(urls)
+        lb_port = lb.start()
+        before = {
+            'attempts': obs.MIGRATION_ATTEMPTS.value(),
+            'successes': obs.MIGRATION_SUCCESSES.value(),
+            'failures': obs.MIGRATION_FAILURES.value(),
+            'midstream': obs.LB_MIDSTREAM_FAILURES.value(),
+        }
+
+        async def _drill():
+            import aiohttp
+            sem = asyncio.Semaphore(args.concurrency)
+            timeout = aiohttp.ClientTimeout(total=None,
+                                            sock_connect=30)
+            lb_url = f'http://127.0.0.1:{lb_port}'
+            async with aiohttp.ClientSession(
+                    timeout=timeout) as session:
+
+                async def bounded():
+                    async with sem:
+                        try:
+                            results.append(await _one_request(
+                                session, lb_url, args.prompt_len,
+                                args.max_new_tokens))
+                        except Exception as e:  # noqa: BLE001 — a
+                            # failed stream is DATA here (the
+                            # failed-vs-migrated split), not an abort.
+                            errors.append(f'{type(e).__name__}: {e}')
+
+                async def killer():
+                    await asyncio.sleep(args.kill_replica_at)
+                    procs[0].send_signal(signal.SIGTERM)
+
+                await asyncio.gather(
+                    killer(), *[bounded()
+                                for _ in range(args.requests)])
+
+        t0 = time.perf_counter()
+        asyncio.run(_drill())
+        wall = time.perf_counter() - t0
+        lb.stop()
+    finally:
+        for proc in procs:
+            proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        if log is not subprocess.DEVNULL:
+            log.close()
+
+    migrated = int(obs.MIGRATION_SUCCESSES.value()
+                   - before['successes'])
+    attempts = int(obs.MIGRATION_ATTEMPTS.value()
+                   - before['attempts'])
+    mig_failures = int(obs.MIGRATION_FAILURES.value()
+                       - before['failures'])
+    midstream = int(obs.LB_MIDSTREAM_FAILURES.value()
+                    - before['midstream'])
+    # A stream that "completed" short of its token budget dropped
+    # tokens somewhere — that is a failure, whatever the LB counted.
+    short = [r for r in results
+             if r['tokens'] != args.max_new_tokens]
+    failed = len(errors) + len(short) + mig_failures + midstream
+    # Client-visible interruption: each stream's WORST inter-token
+    # gap. The `migrated` largest ones are the interrupted
+    # population (a migrated stream's gap spans drain + snapshot +
+    # restore and dwarfs normal ITL); their p50/p95 is the number
+    # the SLO cares about.
+    max_gaps = sorted((max(r['gaps']) for r in results if r['gaps']),
+                      reverse=True)
+    interrupted = sorted(max_gaps[:migrated])
+    return {
+        'metric': 'serve_preemption_migrated_requests',
+        'value': migrated,
+        'unit': 'requests',
+        'rc': 0 if migrated > 0 and failed == 0 else 1,
+        'extra': {
+            'workload': 'kill_replica',
+            'replicas': n,
+            'requests': args.requests,
+            'concurrency': args.concurrency,
+            'prompt_len': args.prompt_len,
+            'max_new_tokens': args.max_new_tokens,
+            'kill_replica_at_s': args.kill_replica_at,
+            'drain_deadline_s': args.drain_deadline,
+            'wall_s': round(wall, 3),
+            'completed_requests': len(results),
+            'migrated_requests': migrated,
+            'failed_requests': failed,
+            'migration_attempts': attempts,
+            'migration_failures': mig_failures,
+            'lb_midstream_failures': midstream,
+            'short_streams': len(short),
+            'client_errors': errors[:5],
+            'interruption_p50_s': (round(_pct(interrupted, 0.5), 4)
+                                   if interrupted else None),
+            'interruption_p95_s': (round(_pct(interrupted, 0.95), 4)
+                                   if interrupted else None),
+            # Steady-state ITL for contrast: the gap a NON-migrated
+            # stream's worst hiccup shows.
+            'max_gap_p50_s': (round(_pct(max_gaps, 0.5), 4)
+                              if max_gaps else None),
+        },
+    }
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--url', default='http://127.0.0.1:8080')
@@ -498,12 +667,29 @@ def main() -> None:
                         help='File the launched replica servers '
                              'append stdout/stderr to (default: '
                              'discarded).')
+    parser.add_argument('--kill-replica-at', type=float, default=None,
+                        metavar='T',
+                        help='Preemption drill: launch replicas '
+                             '(--lb-replicas, min 2) behind the real '
+                             'LB, SIGTERM one of them T seconds into '
+                             'the streaming run, and report the '
+                             'migrated-vs-failed split plus the '
+                             'client-visible interruption gap. rc=0 '
+                             'iff migrated > 0 and failed == 0.')
+    parser.add_argument('--drain-deadline', type=float, default=0.3,
+                        help='SKYTPU_DRAIN_DEADLINE_SECONDS handed to '
+                             'the launched replicas in the '
+                             '--kill-replica-at drill.')
     args = parser.parse_args()
-    metric = ('lb_affinity_warm_ttft_speedup' if args.lb_replicas
+    metric = ('serve_preemption_migrated_requests'
+              if args.kill_replica_at is not None
+              else 'lb_affinity_warm_ttft_speedup' if args.lb_replicas
               else 'serve_warm_prefix_ttft_speedup'
               if args.shared_prefix else 'serve_decode_tokens_per_sec')
     try:
-        if args.lb_replicas:
+        if args.kill_replica_at is not None:
+            report = run_kill_replica(args)
+        elif args.lb_replicas:
             report = run_lb_compare(args)
         elif args.shared_prefix:
             report = asyncio.run(run_shared_prefix(
@@ -522,7 +708,9 @@ def main() -> None:
         # rc=1, never a bare traceback a driver can't gate on.
         print(json.dumps({
             'metric': metric, 'value': 0.0,
-            'unit': ('x' if args.shared_prefix or args.lb_replicas
+            'unit': ('requests' if args.kill_replica_at is not None
+                     else 'x'
+                     if args.shared_prefix or args.lb_replicas
                      else 'tokens/s'),
             'rc': 1,
             'extra': {'error': f'{type(e).__name__}: {e}'}}))

@@ -323,6 +323,30 @@ SKYTPU_FAULTS = declare(
     'Comma-separated fault-injection specs '
     '(point[:times|forever[:latency]]), re-read at inject time.')
 
+# --- preemption-safe serving (drain + mid-stream migration) ------------------
+
+SKYTPU_MIGRATION_ENABLE = declare(
+    'SKYTPU_MIGRATION_ENABLE', bool, True,
+    'Mid-stream request migration: on replica drain or upstream '
+    'death the LB fetches the request\'s KV snapshot and resumes it '
+    'on another replica. Off, every interrupted stream takes the '
+    'honest-termination path.')
+SKYTPU_DRAIN_DEADLINE_SECONDS = declare(
+    'SKYTPU_DRAIN_DEADLINE_SECONDS', float, 10.0,
+    'Seconds /internal/drain waits for in-flight requests to finish '
+    'naturally before snapshotting the stragglers for migration '
+    '(spot preemption notice is ~30s; leave headroom for restore).')
+SKYTPU_MIGRATION_DEADLINE_SECONDS = declare(
+    'SKYTPU_MIGRATION_DEADLINE_SECONDS', float, 15.0,
+    'Total wall-clock budget for one stream migration on the LB '
+    '(snapshot fetch + restore attempts across replicas); past it '
+    'the stream falls back to honest termination.')
+SKYTPU_MIGRATION_MAX_BYTES = declare(
+    'SKYTPU_MIGRATION_MAX_BYTES', int, 256 * 1024 * 1024,
+    'Cap on one request\'s serialized KV snapshot; snapshot_request '
+    'refuses larger blobs (the request honest-terminates instead of '
+    'shipping an unbounded payload through the LB).')
+
 # --- serve LB streaming -----------------------------------------------------
 
 SKYTPU_LB_STREAM_READ_TIMEOUT = declare(

@@ -15,6 +15,11 @@ Actions (see docs/guides/fleet-soak.md for the full reference):
                                      zone_restore
   zone_restore     {zone}            the zone is schedulable again
   preemption_wave  {count}           kill `count` random spot replicas
+  preempt_replicas {count}           preemption notices land on the
+                                     `count` busiest READY replicas
+                                     (arms `replica.preempt`); their
+                                     in-flight decodes attempt the
+                                     snapshot -> migrate ladder
   rolling_update   {}                bump the service version (the
                                      controller's real rolling-update
                                      machinery takes over)
@@ -30,7 +35,8 @@ import dataclasses
 from typing import Any, Dict, Iterable, List
 
 _ACTIONS = ('zone_loss', 'zone_restore', 'preemption_wave',
-            'rolling_update', 'arm_fault', 'disarm_fault', 'mark')
+            'preempt_replicas', 'rolling_update', 'arm_fault',
+            'disarm_fault', 'mark')
 
 
 @dataclasses.dataclass(frozen=True)

@@ -100,6 +100,18 @@ class ReplicaProfile:
     # prefix-cache hit ratio there would gate an SLO on counters the
     # real engine could never emit (validated in __post_init__).
     mesh_shape: tuple = ()             # (('tensor', 4),) etc.
+    # Preemption-safe serving term (ISSUE 17): > 0 turns on mid-decode
+    # migration modeling. When a chaos kill lands on a READY replica,
+    # each busy decode slot attempts the drain -> snapshot -> restore
+    # ladder onto a surviving READY replica; attempts/successes/
+    # failures and the client-visible interruption gap land in the
+    # REAL skytpu_migration_* series the production LB emits, so the
+    # preemption_migration scenario's SLOs read the same counters a
+    # live fleet scrapes. A failed ladder (no survivor, or an armed
+    # `lb.migrate` fault) is an honest termination — it ALSO counts
+    # skytpu_lb_midstream_failures_total, mirroring the LB.
+    migration_latency_s: float = 0.0   # snapshot+restore median; 0=off
+    migration_latency_sigma: float = 0.4
 
     def __post_init__(self):
         ways = dict(self.mesh_shape)
@@ -196,6 +208,7 @@ class SimFleet:
         self._by_endpoint: Dict[str, SimReplica] = {}
         self._lost_zones: set = set()
         self._preemption_pending = False
+        self._preempt_pending = 0
         self._tick_seconds = 1.0
 
     def profile_for(self, pool: Optional[str]) -> ReplicaProfile:
@@ -216,6 +229,14 @@ class SimFleet:
         next probe sweep; the point's armed `times` bound is the wave
         size."""
         self._preemption_pending = True
+
+    def begin_preempt(self, count: int) -> None:
+        """Kill the `count` BUSIEST ready replicas through
+        `replica.preempt` on the next probe sweep — a preemption
+        notice landing on replicas that hold in-flight decodes, the
+        case the snapshot/migrate ladder exists for. The point's
+        armed `times` bound caps how many actually die."""
+        self._preempt_pending = max(self._preempt_pending, int(count))
 
     # -- the ReplicaManager surface ------------------------------------------
 
@@ -321,6 +342,7 @@ class SimFleet:
                                   env_exc=ReplicaKilled)
                 except Exception:  # noqa: BLE001 — armed exc = a kill
                     r.state = _State.DEAD
+                    self._migrate_inflight(r)
                     continue
             if self._preemption_pending and r.use_spot:
                 try:
@@ -329,7 +351,66 @@ class SimFleet:
                                   env_exc=ReplicaKilled)
                 except Exception:  # noqa: BLE001 — armed exc = a kill
                     r.state = _State.DEAD
+                    self._migrate_inflight(r)
         self._preemption_pending = False
+        if self._preempt_pending:
+            # Preemption notices target the BUSIEST ready replicas —
+            # the ones whose in-flight decodes the migration ladder
+            # has to rescue.
+            busy = sorted(
+                (r for r in self._replicas.values()
+                 if r.state == _State.READY),
+                key=lambda r: (-r.tick_requests, r.replica_id))
+            for r in busy[:self._preempt_pending]:
+                try:
+                    faults.inject('replica.preempt',
+                                  sleep_fn=self._clock.sleep,
+                                  env_exc=ReplicaKilled)
+                except Exception:  # noqa: BLE001 — armed exc = a kill
+                    r.state = _State.DEAD
+                    self._migrate_inflight(r)
+            self._preempt_pending = 0
+
+    def _migrate_inflight(self, r: 'SimReplica') -> None:
+        """The drain -> snapshot -> migrate ladder for the requests a
+        killed replica held mid-decode. One attempt per busy decode
+        slot (last tick's dispatch count, capped at the profile's
+        concurrency); each succeeds iff a READY survivor exists and
+        the `lb.migrate` point doesn't fire, observing the modeled
+        interruption gap into the real migration histograms. The
+        failure rung mirrors the LB's honest termination: the client
+        stream dies and skytpu_lb_midstream_failures_total counts it."""
+        p = self.profile_for(r.pool)
+        if p.migration_latency_s <= 0:
+            return
+        inflight = min(p.concurrency, r.tick_requests)
+        targets = [
+            x for x in self._replicas.values()
+            if x is not r and x.state == _State.READY
+            and (x.zone is None or x.zone not in self._lost_zones)]
+        for _ in range(inflight):
+            obs.MIGRATION_ATTEMPTS.inc()
+            ok = bool(targets)
+            if ok:
+                try:
+                    faults.inject('lb.migrate',
+                                  sleep_fn=self._clock.sleep,
+                                  env_exc=OSError)
+                except Exception:  # noqa: BLE001 — armed = a failure
+                    ok = False
+            if not ok:
+                obs.MIGRATION_FAILURES.inc()
+                obs.LB_MIDSTREAM_FAILURES.inc()
+                continue
+            gap = self._rng.lognormvariate(
+                _mu(p.migration_latency_s), p.migration_latency_sigma)
+            obs.MIGRATION_SECONDS.observe(gap)
+            obs.MIGRATION_INTERRUPTION_SECONDS.observe(gap)
+            obs.MIGRATION_SUCCESSES.inc()
+            # The survivor absorbs the decode remainder (half a mean
+            # service time of extra busy-slot load, on average).
+            tgt = self._rng.choice(targets)
+            tgt.tick_busy_s += 0.5 * p.service_mean_s()
 
     # -- the traffic-facing surface ------------------------------------------
 

@@ -59,6 +59,7 @@ from skypilot_tpu.models import moe as moe_lib
 from skypilot_tpu.observability import instruments as obs
 from skypilot_tpu.observability import spans
 from skypilot_tpu.parallel import sharding as sharding_lib
+from skypilot_tpu.resilience import faults
 
 Params = Dict[str, Any]
 Cache = Dict[str, jax.Array]
@@ -212,6 +213,132 @@ def _copy_pool_page(pool, src: jax.Array, dst: jax.Array):
         lambda leaf: _shard_pages(
             leaf.at[:, dst].set(leaf[:, src]), stacked=True),
         pool)
+
+
+@jax.jit
+def _gather_pool_pages(pool, pages: jax.Array):
+    """Gather `pages` ([W] int32, scratch-padded to the table width so
+    one compile serves every request) out of a page pool's [L, P,
+    page, ...] leaves -> [L, W, page, ...] per leaf. The snapshot half
+    of migration: NOT donated — the pool keeps serving the other
+    slots while the blob is cut."""
+    return jax.tree.map(lambda leaf: leaf[:, pages], pool)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _splice_pool_pages(pool, pages: jax.Array, data):
+    """Scatter restored KV pages back into a pool: `data` leaves are
+    [L, W, page, ...] (scratch-padded like the gather, so the splice
+    compiles once per engine) landing at page ids `pages` [W].
+    Padding entries target the reserved scratch page 0, whose
+    contents are garbage by contract. Donated + sharded exactly like
+    _copy_pool_page: the restore edits the pool in place and a
+    tensor-sharded pool splices per-chip head-slices."""
+    return jax.tree.map(
+        lambda leaf, d: _shard_pages(
+            leaf.at[:, pages].set(d), stacked=True),
+        pool, data)
+
+
+@jax.jit
+def _gather_dense_row(cache_kv, slot: jax.Array):
+    """One slot's full dense-cache row per leaf: [L, B, S, ...] ->
+    [L, S, ...]. `slot` is traced (one compile serves every slot)."""
+    return jax.tree.map(lambda leaf: leaf[:, slot], cache_kv)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _splice_dense_row(cache_kv, slot: jax.Array, data):
+    """Write a restored [L, S, ...] row back into slot `slot` of a
+    dense cache's [L, B, S, ...] leaves (zero-padded to S host-side,
+    so the splice compiles once regardless of request length)."""
+    return jax.tree.map(
+        lambda leaf, d: leaf.at[:, slot].set(d), cache_kv, data)
+
+
+# -- request snapshot blobs (preemption-safe serving) ----------------------
+# Wire format (versioned, integrity-checked — a truncated or bit-
+# flipped blob must fail loudly, never splice garbage KV):
+#   magic(8) | version u32 | header_len u32 | header JSON |
+#   array payload (raw C-order bytes, concatenated in header order) |
+#   crc32 u32 over everything after the magic.
+_SNAP_MAGIC = b'SKTPUSNP'
+SNAPSHOT_VERSION = 1
+
+
+class SnapshotError(ValueError):
+    """A migration blob that cannot be trusted or applied: bad magic,
+    version mismatch, truncation, CRC failure, or an engine-geometry
+    mismatch (page size / layer count / dtype). Restore refuses
+    loudly — splicing a wrong-shaped snapshot would corrupt KV."""
+
+
+def _snapshot_pack(header: Dict[str, Any],
+                   arrays: List[Tuple[str, np.ndarray]]) -> bytes:
+    import json
+    import struct
+    import zlib
+    header = dict(header)
+    header['arrays'] = [
+        {'name': name, 'dtype': str(a.dtype), 'shape': list(a.shape)}
+        for name, a in arrays]
+    hj = json.dumps(header).encode('utf-8')
+    payload = b''.join(np.ascontiguousarray(a).tobytes()
+                       for _, a in arrays)
+    body = (struct.pack('<II', SNAPSHOT_VERSION, len(hj))
+            + hj + payload)
+    return (_SNAP_MAGIC + body
+            + struct.pack('<I', zlib.crc32(body)))
+
+
+def _snapshot_unpack(blob: bytes
+                     ) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
+    import json
+    import struct
+    import zlib
+    if not isinstance(blob, (bytes, bytearray)):
+        raise SnapshotError('snapshot blob must be bytes')
+    blob = bytes(blob)
+    if len(blob) < len(_SNAP_MAGIC) + 12:
+        raise SnapshotError(
+            f'snapshot blob truncated ({len(blob)} bytes)')
+    if blob[:len(_SNAP_MAGIC)] != _SNAP_MAGIC:
+        raise SnapshotError('bad snapshot magic — not a migration blob')
+    body, (crc,) = blob[len(_SNAP_MAGIC):-4], struct.unpack(
+        '<I', blob[-4:])
+    if zlib.crc32(body) != crc:
+        raise SnapshotError('snapshot CRC mismatch — blob corrupted '
+                            'in transit')
+    version, hlen = struct.unpack('<II', body[:8])
+    if version != SNAPSHOT_VERSION:
+        raise SnapshotError(
+            f'snapshot version {version} != supported '
+            f'{SNAPSHOT_VERSION}')
+    if len(body) < 8 + hlen:
+        raise SnapshotError('snapshot blob truncated inside header')
+    try:
+        header = json.loads(body[8:8 + hlen].decode('utf-8'))
+    except ValueError as e:
+        raise SnapshotError(f'snapshot header unparseable: {e}') from e
+    arrays: Dict[str, np.ndarray] = {}
+    off = 8 + hlen
+    for spec in header.get('arrays', ()):
+        dtype = np.dtype(spec['dtype'])
+        shape = tuple(spec['shape'])
+        nbytes = dtype.itemsize * int(np.prod(shape, dtype=np.int64))
+        if off + nbytes > len(body):
+            raise SnapshotError(
+                f'snapshot blob truncated inside array '
+                f'{spec["name"]!r}')
+        arrays[spec['name']] = np.frombuffer(
+            body, dtype=dtype, count=int(np.prod(shape,
+                                                 dtype=np.int64)),
+            offset=off).reshape(shape)
+        off += nbytes
+    if off != len(body):
+        raise SnapshotError(
+            f'{len(body) - off} trailing bytes after snapshot arrays')
+    return header, arrays
 
 
 def init_cache(config: llama.LlamaConfig, batch_size: int,
@@ -1652,6 +1779,269 @@ class InferenceEngine:
         # strand them (has_work is already False on entry then).
         results.update(self.finished())
         return results
+
+    # -- request migration (snapshot / restore) ------------------------------
+
+    def snapshot_request(self, request_id: int) -> bytes:
+        """Serialize one queued or in-flight request into a versioned
+        migration blob: its block-table-mapped KV pages (dense: the
+        slot's cache row) plus host bookkeeping — prompt, generated
+        tokens, logprobs, sampling state, lengths. Non-destructive:
+        the request keeps decoding until the caller abort()s it, so a
+        failed handoff loses nothing. Queued and still-prefilling
+        requests snapshot as host state only (no KV — prefill repays
+        on restore; no tokens were generated yet, so the stream
+        contract is unaffected)."""
+        faults.inject('engine.snapshot')
+        with spans.span('engine.snapshot',
+                        attrs={'request_id': request_id}):
+            return self._snapshot_locked(request_id)
+
+    def _snapshot_locked(self, request_id: int) -> bytes:
+        for rid, tokens, sampling in self._queue:
+            if rid == request_id:
+                return self._pack_host_only(request_id, tokens,
+                                            sampling)
+        for i, slot in enumerate(self.state.slots):
+            if slot is not None and slot.request_id == request_id:
+                break
+        else:
+            raise KeyError(
+                f'request {request_id} is not queued or in flight '
+                '(finished or aborted — nothing to snapshot)')
+        if slot.pending is not None:
+            # Mid-prefill: no generated tokens exist, so dropping the
+            # partial KV and re-prefilling on the restore side keeps
+            # the stream token-for-token identical at the cost of one
+            # repaid prefill.
+            return self._pack_host_only(request_id, slot.pending,
+                                        slot.params)
+        if self._draft_params is not None:
+            raise SnapshotError(
+                'speculative engines are not migratable (the draft '
+                'cache pages would desynchronize); drop the draft or '
+                'let the request honest-terminate')
+        length = slot.prompt_len + len(slot.generated) - 1
+        header = {
+            'fmt': 'skytpu-kv-snapshot',
+            'request_id': request_id,
+            'prompt': list(slot.prompt),
+            'generated': list(slot.generated),
+            'logprobs': list(slot.logprobs),
+            'prompt_len': slot.prompt_len,
+            'sampling': dataclasses.asdict(slot.params),
+            'length': length,
+            'max_seq_len': self.state.max_seq_len,
+            'page_size': self.kv_page_size,
+            'layout': 'paged' if self.kv_page_size else 'dense',
+        }
+        kv = {'k': self.state.cache['k'], 'v': self.state.cache['v']}
+        if self.kv_page_size:
+            page = self.kv_page_size
+            n_used = -(-length // page)
+            w = int(self.state.cache['table'].shape[1])
+            ids = self._slot_pages[i][:n_used] + [0] * (w - n_used)
+            with self._mesh_ctx():
+                got = _gather_pool_pages(kv, jnp.array(ids, jnp.int32))
+            host = jax.device_get(got)
+            host = jax.tree.map(lambda a: a[:, :n_used], host)
+        else:
+            with self._mesh_ctx():
+                got = _gather_dense_row(kv, jnp.int32(i))
+            host = jax.device_get(got)
+            host = jax.tree.map(lambda a: a[:, :length], host)
+        arrays: List[Tuple[str, np.ndarray]] = []
+        for name in ('k', 'v'):
+            leaf = host[name]
+            if _is_quant(leaf):
+                arrays.append((f'{name}.q', leaf['q']))
+                arrays.append((f'{name}.s', leaf['s']))
+            else:
+                arrays.append((name, leaf))
+        nbytes = sum(a.nbytes for _, a in arrays)
+        cap = envs.SKYTPU_MIGRATION_MAX_BYTES.get()
+        if cap and nbytes > cap:
+            raise SnapshotError(
+                f'snapshot payload is {nbytes} bytes, over '
+                f'SKYTPU_MIGRATION_MAX_BYTES={cap}; the request '
+                'honest-terminates instead of shipping it')
+        return _snapshot_pack(header, arrays)
+
+    def _pack_host_only(self, request_id: int, tokens: List[int],
+                        sampling: SamplingParams) -> bytes:
+        return _snapshot_pack({
+            'fmt': 'skytpu-kv-snapshot',
+            'request_id': request_id,
+            'prompt': list(tokens),
+            'generated': [],
+            'logprobs': [],
+            'prompt_len': len(tokens),
+            'sampling': dataclasses.asdict(sampling),
+            'length': 0,
+            'max_seq_len': self.state.max_seq_len,
+            'page_size': self.kv_page_size,
+            'layout': 'none',
+        }, [])
+
+    def restore_request(self, blob: bytes) -> int:
+        """Splice a snapshot_request blob into this engine and resume
+        it: pages come from the ordinary allocator, land via one
+        compiled scatter + block-table edits, and the next step()
+        continues inside the fused decode loop at the next token —
+        greedy output token-for-token identical to an uninterrupted
+        run. Returns the NEW request id (ids are engine-local).
+        Raises SnapshotError for blobs that cannot be trusted or do
+        not fit this engine's geometry, RuntimeError when the engine
+        lacks a free slot / free pages (the caller re-routes)."""
+        header, arrays = _snapshot_unpack(blob)
+        with spans.span('engine.restore',
+                        attrs={'origin_request_id':
+                               header.get('request_id')}):
+            return self._restore_locked(header, arrays)
+
+    def _restore_locked(self, header: Dict[str, Any],
+                        arrays: Dict[str, np.ndarray]) -> int:
+        try:
+            sampling = SamplingParams(**header['sampling'])
+            prompt = [int(t) for t in header['prompt']]
+            generated = [int(t) for t in header['generated']]
+            logprobs = [float(x) for x in header['logprobs']]
+            prompt_len = int(header['prompt_len'])
+            length = int(header['length'])
+            layout = header['layout']
+        except (KeyError, TypeError, ValueError) as e:
+            raise SnapshotError(
+                f'snapshot header missing/malformed field: {e}') from e
+        if layout == 'none' or not generated:
+            # Host-only snapshot: prefill repays from scratch; token
+            # stream starts at zero either way.
+            return self.submit(prompt, sampling)
+        if self._draft_params is not None:
+            raise SnapshotError(
+                'speculative engines are not migratable; restore on '
+                'a draft-free replica')
+        want_layout = 'paged' if self.kv_page_size else 'dense'
+        if layout != want_layout:
+            raise SnapshotError(
+                f'snapshot layout {layout!r} != engine layout '
+                f'{want_layout!r}')
+        if self.kv_page_size and \
+                int(header['page_size']) != self.kv_page_size:
+            raise SnapshotError(
+                f'snapshot page_size {header["page_size"]} != engine '
+                f'page_size {self.kv_page_size}')
+        if int(header['max_seq_len']) != self.state.max_seq_len:
+            # The eviction bound (max_seq_len - 1) shapes WHEN a
+            # request stops — restoring across different bounds could
+            # finish early/late vs the uninterrupted run.
+            raise SnapshotError(
+                f'snapshot max_seq_len {header["max_seq_len"]} != '
+                f'engine max_seq_len {self.state.max_seq_len}')
+        if length != prompt_len + len(generated) - 1:
+            raise SnapshotError(
+                f'snapshot length {length} inconsistent with '
+                f'prompt_len {prompt_len} + {len(generated)} '
+                'generated tokens')
+        free = [i for i, s in enumerate(self.state.slots)
+                if s is None]
+        if not free:
+            raise RuntimeError(
+                'restore refused: no free slot (try another replica)')
+        i = free[0]
+        kv = {'k': self.state.cache['k'], 'v': self.state.cache['v']}
+        page = self.kv_page_size
+        n_used = -(-length // page) if page else 0
+
+        def check_and_get(name, pool_leaf, quant_part=None):
+            key = name if quant_part is None else \
+                f'{name}.{quant_part}'
+            if key not in arrays:
+                raise SnapshotError(f'snapshot missing array {key!r}')
+            arr = arrays[key]
+            tail = (pool_leaf.shape[2:] if page
+                    else pool_leaf.shape[3:])
+            want_rows = n_used if page else length
+            if (arr.shape[0] != pool_leaf.shape[0]
+                    or arr.shape[1] != want_rows
+                    or tuple(arr.shape[2:]) != tuple(tail)):
+                raise SnapshotError(
+                    f'snapshot array {key!r} shape {arr.shape} does '
+                    f'not fit engine leaf {pool_leaf.shape}')
+            if str(arr.dtype) != str(pool_leaf.dtype):
+                raise SnapshotError(
+                    f'snapshot array {key!r} dtype {arr.dtype} != '
+                    f'engine dtype {pool_leaf.dtype}')
+            return arr
+
+        def build(name):
+            pool_leaf = kv[name]
+            if _is_quant(pool_leaf):
+                return {'q': check_and_get(name, pool_leaf['q'], 'q'),
+                        's': check_and_get(name, pool_leaf['s'], 's')}
+            return check_and_get(name, pool_leaf)
+
+        data = {'k': build('k'), 'v': build('v')}
+        if page:
+            w = int(self.state.cache['table'].shape[1])
+            if n_used > w:
+                raise SnapshotError(
+                    f'snapshot spans {n_used} pages, over the table '
+                    f'width {w}')
+            need = max(n_used, self._pages_needed(
+                prompt_len, sampling.max_new_tokens))
+            if need > len(self._page_alloc):
+                self._reclaim(need - len(self._page_alloc))
+            if need > len(self._page_alloc):
+                raise RuntimeError(
+                    f'restore refused: needs {need} free KV pages, '
+                    f'pool has {len(self._page_alloc)} (try another '
+                    'replica)')
+            pages = self._page_alloc[:need]
+            del self._page_alloc[:need]
+            ids = pages[:n_used] + [0] * (w - n_used)
+
+            def pad_pool(arr):
+                out = np.zeros((arr.shape[0], w) + arr.shape[2:],
+                               dtype=arr.dtype)
+                out[:, :n_used] = arr
+                return out
+
+            with self._mesh_ctx():
+                spliced = _splice_pool_pages(
+                    kv, jnp.array(ids, jnp.int32),
+                    jax.tree.map(pad_pool, data))
+            self._slot_pages[i] = pages
+            self._slot_shared[i] = set()
+            self._set_table_rows(i, pages)
+        else:
+            k_leaf = kv['k']['q'] if _is_quant(kv['k']) else kv['k']
+            seq_cap = int(k_leaf.shape[2])
+
+            def pad_dense(arr):
+                out = np.zeros(
+                    (arr.shape[0], seq_cap) + arr.shape[2:],
+                    dtype=arr.dtype)
+                out[:, :length] = arr
+                return out
+
+            with self._mesh_ctx():
+                spliced = _splice_dense_row(
+                    kv, jnp.int32(i), jax.tree.map(pad_dense, data))
+        self.state.cache['k'] = spliced['k']
+        self.state.cache['v'] = spliced['v']
+        self.state.cache['length'] = \
+            self.state.cache['length'].at[i].set(length)
+        last = jax.device_get(self.state.last_tokens).copy()
+        last[i] = generated[-1]
+        self.state.last_tokens = jnp.asarray(last)
+        request_id = self._next_id
+        self._next_id += 1
+        self._trace_begin(request_id)
+        self.state.slots[i] = _Slot(request_id, sampling, generated,
+                                    logprobs, prompt_len,
+                                    prompt=prompt)
+        self._update_gauges()
+        return request_id
 
     # -- internals -----------------------------------------------------------
 

@@ -28,11 +28,15 @@ engine stays model-agnostic).
 """
 import argparse
 import asyncio
+import base64
+import functools
 import json
 import os
 import queue
 import threading
-from typing import Any, Dict, List, Optional
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
 
 from skypilot_tpu import envs
 from skypilot_tpu import sky_logging
@@ -61,6 +65,10 @@ class EngineLoop:
             self.q: asyncio.Queue = asyncio.Queue()
             self.sent = 0
             self.aborted = False
+            # Migration identity: the opaque key the LB can quote at
+            # /internal/snapshot, and the engine rid once admitted.
+            self.key: Optional[str] = None
+            self.rid: Optional[int] = None
             # Raw-model logprobs of the generated tokens, set by the
             # engine thread BEFORE the 'done' push (the queue handoff
             # orders the write for the reading handler).
@@ -73,25 +81,109 @@ class EngineLoop:
         self.engine = engine
         self._submit_q: 'queue.Queue' = queue.Queue()
         self._abort_q: 'queue.Queue' = queue.Queue()
+        # Engine-thread command channel: drain/snapshot must touch
+        # engine state from HTTP handlers, and the engine is
+        # single-thread-owned — closures run between ticks instead.
+        self._cmd_q: 'queue.Queue' = queue.Queue()
         self._watchers: Dict[int, EngineLoop.Watcher] = {}
+        self._by_key: Dict[str, EngineLoop.Watcher] = {}
         self._stop = False
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
     def submit(self, prompt: List[int], sampling,
-               stream: bool = False) -> 'EngineLoop.Watcher':
+               stream: bool = False,
+               key: Optional[str] = None) -> 'EngineLoop.Watcher':
         """Called from async handlers; returns the watcher whose queue
         yields ('token', t)* then ('done', [tokens])."""
         watcher = self.Watcher(asyncio.get_running_loop(), stream)
+        watcher.key = key
         # contextvars do NOT cross the queue into the engine thread:
         # capture the (rid, span context) pair HERE, on the event
         # loop, so the engine thread can rebind it and the engine's
         # phase spans parent on the request's server span instead of
         # starting orphan traces.
-        self._submit_q.put((prompt, sampling, watcher,
+        self._submit_q.put(('gen', prompt, sampling, watcher,
                             tracing.get_request_id(),
                             spans.current_context()))
         return watcher
+
+    def restore(self, blob: bytes, sent: int = 0,
+                stream: bool = True,
+                key: Optional[str] = None) -> 'EngineLoop.Watcher':
+        """Splice a migration blob into this engine (engine thread
+        does the actual restore): the watcher streams only tokens
+        PAST `sent` — the count the client already received — so the
+        continued stream never duplicates or drops a token."""
+        watcher = self.Watcher(asyncio.get_running_loop(), stream)
+        watcher.key = key
+        watcher.sent = max(0, int(sent))
+        self._submit_q.put(('restore', blob, None, watcher,
+                            tracing.get_request_id(),
+                            spans.current_context()))
+        return watcher
+
+    def run_on_engine(self, fn):
+        """Run `fn` on the engine thread between ticks; returns a
+        concurrent.futures.Future (await via asyncio.wrap_future)."""
+        import concurrent.futures
+        fut: 'concurrent.futures.Future' = concurrent.futures.Future()
+        self._cmd_q.put((fn, fut))
+        return fut
+
+    def has_pending(self) -> bool:
+        """Any request still queued, admitted, or streaming — the
+        drain loop polls this before snapshotting stragglers."""
+        return bool(self._watchers) or not self._submit_q.empty()
+
+    # -- engine-thread-only helpers (call via run_on_engine) -----------------
+
+    def snapshot_inflight(self) -> List[Tuple['EngineLoop.Watcher',
+                                              bytes]]:
+        """Snapshot-and-abort every remaining request (drain's
+        finish-or-snapshot step). Stream watchers get a terminal
+        ('migrate', {snapshot, sent}) event — the blob rides the
+        existing SSE stream to the LB; non-stream watchers get the
+        same event and their handler answers 409 with the blob."""
+        out: List[Tuple[EngineLoop.Watcher, bytes]] = []
+        for rid, watcher in list(self._watchers.items()):
+            self._watchers.pop(rid, None)
+            if watcher.key:
+                self._by_key.pop(watcher.key, None)
+            if watcher.aborted:
+                # An abort racing the drain: the client is gone, so
+                # there is nothing to migrate — free the slot.
+                self.engine.abort(rid)
+                continue
+            try:
+                blob = self.engine.snapshot_request(rid)
+            except Exception as e:  # noqa: BLE001
+                watcher.push(('error',
+                              f'drain snapshot failed: {e}'))
+                self.engine.abort(rid)
+                continue
+            self.engine.abort(rid)
+            watcher.push(('migrate', {
+                'snapshot': base64.b64encode(blob).decode('ascii'),
+                'sent': watcher.sent}))
+            out.append((watcher, blob))
+        return out
+
+    def snapshot_by_key(self, key: str) -> Tuple[bytes, int]:
+        """Snapshot-and-abort ONE request by its migration key (the
+        LB's mid-stream-death path). Returns (blob, tokens the server
+        already pushed to the now-dead stream). KeyError when the
+        request already finished or was never here."""
+        watcher = self._by_key.pop(key, None)
+        if watcher is None or watcher.rid is None:
+            raise KeyError(f'unknown migration key {key!r}')
+        blob = self.engine.snapshot_request(watcher.rid)
+        self.engine.abort(watcher.rid)
+        self._watchers.pop(watcher.rid, None)
+        sent = watcher.sent
+        # Unblock the (dead-connection) handler still awaiting events.
+        watcher.push(('error', 'request migrated away'))
+        return blob, sent
 
     def stop(self) -> None:
         self._stop = True
@@ -103,36 +195,63 @@ class EngineLoop:
         watcher.aborted = True
         self._abort_q.put(watcher)
 
+    def _process_submission(self, item) -> None:
+        kind, payload, sampling, watcher, req_id, span_ctx = item
+        if watcher.aborted:
+            return  # client vanished before the engine saw it
+        # Rebind the handler's request context across the thread
+        # hop for the duration of engine.submit(): the engine
+        # captures spans.current_context() per request there, and
+        # any submit-path log line keeps its rid=.
+        rid_token = tracing.bind(req_id) if req_id else None
+        ctx_token = spans.bind_context(span_ctx) \
+            if span_ctx is not None else None
+        try:
+            if kind == 'restore':
+                rid = self.engine.restore_request(payload)
+            else:
+                rid = self.engine.submit(payload, sampling)
+        except Exception as e:  # noqa: BLE001
+            # The watcher is not registered yet, so the _run error
+            # handler can't reach it — fail it here or its HTTP
+            # handler awaits forever. Restore rejections keep their
+            # exception type: SnapshotError (bad blob — don't retry
+            # elsewhere) vs RuntimeError (this replica is full — DO
+            # retry elsewhere) drive different LB decisions.
+            msg = (f'{type(e).__name__}: {e}' if kind == 'restore'
+                   else str(e))
+            watcher.push(('error', msg))
+            return
+        finally:
+            if ctx_token is not None:
+                spans.unbind_context(ctx_token)
+            if rid_token is not None:
+                tracing.unbind(rid_token)
+        watcher.rid = rid
+        self._watchers[rid] = watcher
+        if watcher.key:
+            self._by_key[watcher.key] = watcher
+
     def _drain_submissions(self) -> None:
         while True:
             try:
-                prompt, sampling, watcher, req_id, span_ctx = \
-                    self._submit_q.get_nowait()
+                item = self._submit_q.get_nowait()
             except queue.Empty:
                 return
-            if watcher.aborted:
-                continue  # client vanished before the engine saw it
-            # Rebind the handler's request context across the thread
-            # hop for the duration of engine.submit(): the engine
-            # captures spans.current_context() per request there, and
-            # any submit-path log line keeps its rid=.
-            rid_token = tracing.bind(req_id) if req_id else None
-            ctx_token = spans.bind_context(span_ctx) \
-                if span_ctx is not None else None
+            self._process_submission(item)
+
+    def _drain_commands(self) -> None:
+        while True:
             try:
-                rid = self.engine.submit(prompt, sampling)
-            except Exception as e:  # noqa: BLE001
-                # The watcher is not registered yet, so the _run error
-                # handler can't reach it — fail it here or its HTTP
-                # handler awaits forever.
-                watcher.push(('error', str(e)))
-                continue
-            finally:
-                if ctx_token is not None:
-                    spans.unbind_context(ctx_token)
-                if rid_token is not None:
-                    tracing.unbind(rid_token)
-            self._watchers[rid] = watcher
+                fn, fut = self._cmd_q.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                result = fn()
+            except Exception as e:  # noqa: BLE001 — future carries it
+                fut.set_exception(e)
+            else:
+                fut.set_result(result)
 
     def _drain_aborts(self) -> None:
         while True:
@@ -143,6 +262,8 @@ class EngineLoop:
             for rid, watcher in list(self._watchers.items()):
                 if watcher is target:
                     self._watchers.pop(rid)
+                    if watcher.key:
+                        self._by_key.pop(watcher.key, None)
                     self.engine.abort(rid)
 
     def _run(self) -> None:
@@ -159,12 +280,14 @@ class EngineLoop:
                 for watcher in self._watchers.values():
                     watcher.push(('error', str(e)))
                 self._watchers.clear()
+                self._by_key.clear()
                 try:
                     self.engine.abort_all()
                 except Exception:  # noqa: BLE001 — keep the thread up
                     pass
 
     def _tick(self) -> None:
+        self._drain_commands()
         self._drain_submissions()
         self._drain_aborts()
         if not self.engine.has_work:
@@ -173,7 +296,13 @@ class EngineLoop:
                 item = self._submit_q.get(timeout=0.2)
             except queue.Empty:
                 return
-            self._submit_q.put(item)
+            # Process the popped item HERE, in pop order. Re-putting
+            # it at the queue's tail (the old code) reordered it
+            # behind anything enqueued during the park — back-to-back
+            # submissions could swap admission order, and with them
+            # slot assignment and trace parentage. FIFO is part of
+            # the admission contract.
+            self._process_submission(item)
             return
         self.engine.step()
         # Drain aborts AGAIN before fanning out events: one step() is
@@ -194,6 +323,8 @@ class EngineLoop:
         for rid, tokens in finished.items():
             watcher = self._watchers.pop(rid, None)
             if watcher is not None:
+                if watcher.key:
+                    self._by_key.pop(watcher.key, None)
                 watcher.logprobs = finished_lps.get(rid)
                 watcher.push(('done', tokens))
 
@@ -279,6 +410,12 @@ def create_app(engine_holder: Dict[str, Any]):
         if engine_loop is None:
             return web.json_response({'error': 'model loading'},
                                      status=503)
+        if engine_holder.get('draining'):
+            # Drain protocol: no new admissions once /internal/drain
+            # (or SIGTERM) fired — this replica is about to vanish.
+            return web.json_response(
+                {'error': 'replica draining'},
+                status=503, headers={'Retry-After': '1'})
         limit = shed_limit(engine_holder)
         if limit is not None:
             return web.json_response(
@@ -308,9 +445,12 @@ def create_app(engine_holder: Dict[str, Any]):
         # A vanished client (handler cancelled, connection reset) must
         # free its decode slot — otherwise ghosts occupy the batch
         # until max_new_tokens.
+        # Migration key: opaque handle the LB quotes back at
+        # /internal/snapshot if this request's stream dies mid-flight.
+        key = uuid.uuid4().hex
         with timeline.Event('inference.generate'):
             watcher = engine_loop.submit(prompt, sampling,
-                                         stream=stream)
+                                         stream=stream, key=key)
             try:
                 if not stream:
                     while True:
@@ -320,13 +460,22 @@ def create_app(engine_holder: Dict[str, Any]):
                             if want_logprobs:
                                 doc['logprobs'] = watcher.logprobs
                             return web.json_response(doc)
+                        if kind == 'migrate':
+                            # Drain caught this non-stream request:
+                            # hand the blob back so the caller (LB)
+                            # can finish it elsewhere.
+                            return web.json_response(
+                                {'error': 'replica draining',
+                                 'migrate': payload}, status=409,
+                                headers={'X-SkyTPU-Migrate': '1'})
                         if kind == 'error':
                             return web.json_response(
                                 {'error': payload}, status=500)
 
                 resp = web.StreamResponse(headers={
                     'Content-Type': 'text/event-stream',
-                    'Cache-Control': 'no-cache'})
+                    'Cache-Control': 'no-cache',
+                    'X-SkyTPU-Migration-Key': key})
                 await resp.prepare(request)
                 while True:
                     kind, payload = await watcher.q.get()
@@ -334,6 +483,16 @@ def create_app(engine_holder: Dict[str, Any]):
                         await resp.write(
                             f'data: {json.dumps({"token": payload})}\n\n'
                             .encode())
+                    elif kind == 'migrate':
+                        # Drain snapshotted this stream: the blob rides
+                        # the stream as the terminal event. The LB's
+                        # managed path intercepts it and restores on
+                        # another replica; a bare client sees a clearly
+                        # non-token terminal frame.
+                        await resp.write(
+                            f'data: {json.dumps({"migrate": payload})}\n\n'
+                            .encode())
+                        break
                     elif kind == 'error':
                         await resp.write(
                             f'data: {json.dumps({"error": payload})}\n\n'
@@ -374,11 +533,162 @@ def create_app(engine_holder: Dict[str, Any]):
                 spans.to_chrome_trace(records)['traceEvents'],
         })
 
+    async def internal_drain(request):
+        """Graceful drain: stop admission, give in-flight requests
+        SKYTPU_DRAIN_DEADLINE_SECONDS to finish naturally, then
+        snapshot-and-abort the stragglers. Stream stragglers get their
+        blob as a terminal `migrate` SSE event (the LB intercepts it);
+        blobs whose stream already detached come back in this
+        response so nothing is stranded on a dying replica."""
+        engine_loop: Optional[EngineLoop] = engine_holder.get('loop')
+        if engine_loop is None:
+            return web.json_response({'status': 'empty'})
+        engine_holder['draining'] = True
+        try:
+            deadline_s = float(request.query.get(
+                'deadline', envs.SKYTPU_DRAIN_DEADLINE_SECONDS.get()))
+        except (TypeError, ValueError):
+            deadline_s = envs.SKYTPU_DRAIN_DEADLINE_SECONDS.get()
+        deadline = time.monotonic() + max(0.0, deadline_s)
+        while engine_loop.has_pending() and \
+                time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        snapshots = await asyncio.wrap_future(
+            engine_loop.run_on_engine(engine_loop.snapshot_inflight))
+        # Give handlers one beat to flush the terminal migrate frames
+        # before the caller acts on "drained" (e.g. kills us).
+        await asyncio.sleep(0.1)
+        return web.json_response({
+            'status': 'drained',
+            'finished_naturally': not snapshots,
+            'snapshots': [
+                {'snapshot': base64.b64encode(blob).decode('ascii'),
+                 'sent': watcher.sent}
+                for watcher, blob in snapshots
+                if not watcher.stream],
+            'migrated_streams': sum(
+                1 for watcher, _ in snapshots if watcher.stream),
+        })
+
+    async def internal_snapshot(request):
+        """Mid-stream-death path: the LB lost this replica's stream
+        (or wants the request off this replica) and quotes the
+        X-SkyTPU-Migration-Key it saw on the response headers."""
+        engine_loop: Optional[EngineLoop] = engine_holder.get('loop')
+        key = request.query.get('key')
+        if engine_loop is None or not key:
+            return web.json_response(
+                {'error': 'need ?key= and a live engine'}, status=400)
+        try:
+            blob, sent = await asyncio.wrap_future(
+                engine_loop.run_on_engine(
+                    functools.partial(engine_loop.snapshot_by_key,
+                                      key)))
+        except KeyError:
+            return web.json_response(
+                {'error': f'unknown migration key {key!r} (request '
+                          'finished, aborted, or never admitted '
+                          'here)'}, status=404)
+        except Exception as e:  # noqa: BLE001 — snapshot refusal
+            return web.json_response({'error': str(e)}, status=500)
+        return web.Response(
+            body=blob,
+            content_type='application/octet-stream',
+            headers={'X-SkyTPU-Sent': str(sent)})
+
+    async def internal_restore(request):
+        """Splice a migration blob into this engine and resume decode.
+        ?sent=N tokens were already delivered to the client — the
+        resumed stream starts at token N+1 (no duplicates, no drops).
+        Pre-stream failures answer 409 so the LB tries the next
+        replica; SnapshotError (untrusted blob) answers 400."""
+        engine_loop: Optional[EngineLoop] = engine_holder.get('loop')
+        if engine_loop is None:
+            return web.json_response({'error': 'model loading'},
+                                     status=503)
+        if engine_holder.get('draining'):
+            return web.json_response(
+                {'error': 'replica draining'}, status=503,
+                headers={'Retry-After': '1'})
+        blob = await request.read()
+        try:
+            sent = max(0, int(request.query.get('sent', '0')))
+        except ValueError:
+            return web.json_response({'error': 'bad ?sent='},
+                                     status=400)
+        stream = request.query.get('stream', '1') not in ('0', 'false')
+        key = uuid.uuid4().hex
+        watcher = engine_loop.restore(blob, sent=sent, stream=stream,
+                                      key=key)
+        # The engine thread admits (or rejects) the blob; the FIRST
+        # queue event tells us which, while the response status is
+        # still open — a rejected blob must 4xx/409, not start an SSE
+        # stream that instantly errors.
+        kind, payload = await watcher.q.get()
+        if kind == 'error':
+            # SnapshotError = the blob itself is bad (retrying on
+            # another replica can't help) -> 400. Anything else
+            # (capacity, transient) -> 409 so the LB tries the next
+            # candidate.
+            bad_blob = str(payload).startswith('SnapshotError')
+            return web.json_response({'error': payload},
+                                     status=400 if bad_blob else 409)
+        try:
+            if not stream:
+                while True:
+                    if kind == 'done':
+                        return web.json_response({'tokens': payload})
+                    if kind == 'migrate':
+                        return web.json_response(
+                            {'error': 'replica draining',
+                             'migrate': payload}, status=409,
+                            headers={'X-SkyTPU-Migrate': '1'})
+                    if kind == 'error':
+                        return web.json_response({'error': payload},
+                                                 status=500)
+                    kind, payload = await watcher.q.get()
+
+            resp = web.StreamResponse(headers={
+                'Content-Type': 'text/event-stream',
+                'Cache-Control': 'no-cache',
+                'X-SkyTPU-Migration-Key': key})
+            await resp.prepare(request)
+            while True:
+                if kind == 'token':
+                    await resp.write(
+                        f'data: {json.dumps({"token": payload})}\n\n'
+                        .encode())
+                elif kind == 'migrate':
+                    await resp.write(
+                        f'data: {json.dumps({"migrate": payload})}\n\n'
+                        .encode())
+                    break
+                elif kind == 'error':
+                    await resp.write(
+                        f'data: {json.dumps({"error": payload})}\n\n'
+                        .encode())
+                    break
+                else:
+                    await resp.write(
+                        ('data: '
+                         f'{json.dumps({"done": True, "tokens": payload})}'
+                         '\n\n').encode())
+                    break
+                kind, payload = await watcher.q.get()
+            await resp.write_eof()
+            return resp
+        except (asyncio.CancelledError, ConnectionResetError):
+            engine_loop.abort(watcher)
+            raise
+
     app = web.Application(middlewares=[obs.http_middleware('inference')])
     app.router.add_get('/health', health)
     app.router.add_get('/', health)
     app.router.add_get('/metrics', metrics_lib.aiohttp_handler)
     app.router.add_get('/internal/trace', internal_trace)
+    app.router.add_post('/internal/drain', internal_drain)
+    app.router.add_get('/internal/snapshot', internal_snapshot)
+    app.router.add_post('/internal/restore', internal_restore)
     app.router.add_post('/generate', generate)
     from skypilot_tpu.inference import openai_api
     openai_api.add_openai_routes(app, engine_holder)
@@ -555,7 +865,40 @@ def main() -> None:
         holder['loop'] = EngineLoop(engine)
 
     threading.Thread(target=_load, daemon=True).start()
-    web.run_app(create_app(holder), port=args.port, print=None)
+
+    def _drain_and_exit() -> None:
+        """SIGTERM = preemption notice: stop admission, let in-flight
+        requests finish within the drain deadline, snapshot the
+        stragglers so their streams carry a terminal migrate event
+        the LB can act on, then exit."""
+        holder['draining'] = True
+        engine_loop: Optional[EngineLoop] = holder.get('loop')
+        if engine_loop is not None:
+            deadline = (time.monotonic()
+                        + envs.SKYTPU_DRAIN_DEADLINE_SECONDS.get())
+            while engine_loop.has_pending() and \
+                    time.monotonic() < deadline:
+                time.sleep(0.05)
+            try:
+                engine_loop.run_on_engine(
+                    engine_loop.snapshot_inflight).result(timeout=30)
+            except Exception as e:  # noqa: BLE001 — exit regardless
+                logger.warning('drain snapshot on SIGTERM failed: %s',
+                               e)
+            # One beat for handlers to flush terminal migrate frames.
+            time.sleep(1.0)
+        os._exit(0)  # noqa: SLF001 — the TPU thread never joins
+
+    def _on_sigterm(signum, frame):  # noqa: ARG001
+        # Never block in a signal handler: the drain loop sleeps.
+        threading.Thread(target=_drain_and_exit, daemon=True).start()
+
+    import signal
+    signal.signal(signal.SIGTERM, _on_sigterm)
+    # handle_signals=False: aiohttp's own SIGTERM hook would tear the
+    # loop down immediately, racing the drain above.
+    web.run_app(create_app(holder), port=args.port, print=None,
+                handle_signals=False)
 
 
 if __name__ == '__main__':

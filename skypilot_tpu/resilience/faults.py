@@ -107,6 +107,21 @@ FLEET_PREEMPTION_WAVE = declare(
     'One spot replica killed by a simulated preemption wave; the '
     'armed `times` bound IS the wave size, so '
     'SKYTPU_FAULTS=fleet.preemption_wave:300 preempts 300 replicas.')
+REPLICA_PREEMPT = declare(
+    'replica.preempt',
+    'One replica receiving a preemption notice mid-decode (fleetsim '
+    'chaos arms this to kill replicas that hold in-flight requests, '
+    'exercising the drain -> snapshot -> migrate ladder).')
+ENGINE_SNAPSHOT = declare(
+    'engine.snapshot',
+    'Serializing one in-flight request\'s KV pages + host state into '
+    'a migration blob (fires before any device reads, so an armed '
+    'fault models a snapshot that never materializes).')
+LB_MIGRATE = declare(
+    'lb.migrate',
+    'The load balancer migrating one interrupted stream: snapshot '
+    'fetch + restore re-route (fires once per interrupted request, '
+    'before the first restore attempt).')
 
 
 def registered_points() -> Dict[str, str]:

@@ -5,6 +5,7 @@ Reference analog: sky/serve/load_balancer.py:23 (`SkyServeLoadBalancer`
 QPS window the controller's autoscaler reads via /internal/stats.
 """
 import asyncio
+import base64
 import collections
 import contextlib
 import itertools
@@ -19,6 +20,7 @@ from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.observability import spans
 from skypilot_tpu.resilience import circuit
 from skypilot_tpu.resilience import faults
+from skypilot_tpu.resilience import retries
 from skypilot_tpu.serve import load_balancing_policies as lb_policies
 
 _QPS_WINDOW_SECONDS = 60.0
@@ -60,6 +62,20 @@ def request_context(body: Optional[bytes],
     if isinstance(max_new, int):
         ctx['max_new_tokens'] = max_new
     return ctx
+
+
+def _sse_frame_doc(frame: bytes) -> Optional[Dict[str, Any]]:
+    """The JSON dict of one SSE frame's `data:` line, or None for
+    frames the managed relay should pass through uninterpreted
+    (comments, keep-alives, non-JSON payloads)."""
+    for line in frame.split(b'\n'):
+        if line.startswith(b'data: '):
+            try:
+                doc = json.loads(line[6:])
+            except (ValueError, UnicodeDecodeError):
+                return None
+            return doc if isinstance(doc, dict) else None
+    return None
 
 
 def classify_pool_role(context: Optional[Dict[str, Any]]
@@ -460,6 +476,20 @@ class LoadBalancer:
                 # bounded — a wedged upstream mid-stream must
                 # terminate the client's response, not hang it.
                 read_gap = envs.SKYTPU_LB_STREAM_READ_TIMEOUT.get()
+                mig_key = upstream.headers.get(
+                    'X-SkyTPU-Migration-Key')
+                if (mig_key and context is not None
+                        and upstream.status == 200
+                        and (upstream.headers.get('Content-Type')
+                             or '').startswith('text/event-stream')
+                        and envs.SKYTPU_MIGRATION_ENABLE.get()):
+                    # Migratable token stream: relay frame-aware so an
+                    # interruption (drain's terminal migrate event, or
+                    # the upstream dying mid-stream) can be resumed on
+                    # another replica instead of honest-terminated.
+                    return await self._relay_managed(
+                        request, response, upstream, target, mig_key,
+                        context, read_gap, leg_attrs, leg_ctx)
                 while True:
                     # Upstream reads and client writes fail for
                     # DIFFERENT parties; keep them in separate try
@@ -520,6 +550,212 @@ class LoadBalancer:
             status=502,
             text=f'All {attempted} upstream(s) failed; last error: '
                  f'{last_error}\n')
+
+    async def _relay_managed(self, request, response, upstream,
+                             target, mig_key, context, read_gap,
+                             leg_attrs, leg_ctx):
+        """Frame-aware SSE relay for migratable generate streams.
+
+        Token frames are forwarded verbatim and COUNTED — that count
+        is the ground truth of what the client has seen, and rides
+        `?sent=` into /internal/restore so the resumed stream starts
+        at exactly the next unseen token (no duplicates, no drops).
+        Two interruption shapes trigger migration: the upstream
+        draining (its terminal `migrate` SSE event carries the blob),
+        and the upstream dying mid-read (the blob is fetched from
+        /internal/snapshot by migration key — the replica process may
+        still be alive behind a dead connection or an injected
+        transport fault). Honest termination (PR 9) is the last rung:
+        only when migration fails inside its deadline budget."""
+        import aiohttp
+        state = {'sent': 0, 'last_token': time.monotonic()}
+        own: List[Any] = []  # (session, upstream) from migrations
+        cur_up, cur_target, cur_key = upstream, target, mig_key
+        try:
+            while True:
+                buf = b''
+                migrate_payload = None
+                interrupted = False
+                while not interrupted and migrate_payload is None:
+                    try:
+                        faults.inject('lb.upstream_midstream',
+                                      env_exc=OSError)
+                        chunk = await asyncio.wait_for(
+                            cur_up.content.readany(),
+                            timeout=read_gap if read_gap > 0
+                            else None)
+                    except (asyncio.TimeoutError, OSError,
+                            aiohttp.ClientError):
+                        interrupted = True
+                        break
+                    if not chunk:
+                        # EOF without a terminal frame: the upstream
+                        # vanished mid-stream.
+                        interrupted = True
+                        break
+                    buf += chunk
+                    while b'\n\n' in buf:
+                        frame, buf = buf.split(b'\n\n', 1)
+                        doc = _sse_frame_doc(frame)
+                        if doc is not None and 'migrate' in doc:
+                            migrate_payload = doc['migrate']
+                            break
+                        if doc is None or 'token' in doc:
+                            if doc is not None:
+                                state['sent'] += 1
+                                state['last_token'] = time.monotonic()
+                            try:
+                                await response.write(frame + b'\n\n')
+                            except (OSError, aiohttp.ClientError):
+                                return response  # client went away
+                            continue
+                        # done / error: terminal, forward verbatim.
+                        try:
+                            await response.write(frame + b'\n\n')
+                            await response.write_eof()
+                        except (OSError, aiohttp.ClientError):
+                            pass
+                        return response
+                new = await self._migrate_stream(
+                    context, state, cur_target, cur_key,
+                    migrate_payload)
+                if new is None:
+                    # Failure ladder's last rung: honest termination.
+                    obs.LB_PROXY_ERRORS.inc()
+                    obs.LB_MIDSTREAM_FAILURES.inc()
+                    leg_attrs['midstream_error'] = True
+                    spans.COLLECTOR.mark_error(leg_ctx.trace_id)
+                    response.force_close()
+                    with contextlib.suppress(Exception):
+                        request.transport.close()
+                    return response
+                session2, up2, cur_target, cur_key = new
+                own.append((session2, up2))
+                cur_up = up2
+                # Loop: the restored stream is itself migratable.
+        finally:
+            for s, u in own:
+                u.close()
+                await s.close()
+
+    async def _fetch_snapshot(self, target: str, key: str,
+                              deadline: float) -> Optional[bytes]:
+        """GET the request's KV snapshot off the interrupted replica
+        by migration key; None when it can't be had (replica truly
+        dead, request already finished, key unknown)."""
+        from aiohttp import ClientSession, ClientTimeout
+        import aiohttp
+        if not key:
+            return None
+        budget = deadline - time.monotonic()
+        if budget <= 0:
+            return None
+        try:
+            async with ClientSession(timeout=ClientTimeout(
+                    total=max(0.1, min(5.0, budget)))) as session:
+                async with session.get(
+                        target.rstrip('/') + '/internal/snapshot',
+                        params={'key': key}) as r:
+                    if r.status != 200:
+                        return None
+                    return await r.read()
+        except (OSError, aiohttp.ClientError, asyncio.TimeoutError):
+            return None
+
+    async def _migrate_stream(self, context, state, dead_target,
+                              dead_key, migrate_payload):
+        """Resume one interrupted stream on another replica: blob from
+        the drain event (or fetched by key), restored pool-preferred
+        in failover order under the migration deadline budget.
+        Returns (session, upstream, target, new_key) or None — the
+        caller honest-terminates on None."""
+        from aiohttp import ClientSession, ClientTimeout
+        import aiohttp
+        policy = retries.RetryPolicy(
+            deadline=envs.SKYTPU_MIGRATION_DEADLINE_SECONDS.get(),
+            base_delay=0.1, max_delay=1.0)
+        deadline = time.monotonic() + (policy.deadline or 0.0)
+        obs.MIGRATION_ATTEMPTS.inc()
+        t0 = time.monotonic()
+        attrs: Dict[str, Any] = {'from': dead_target,
+                                 'sent': state['sent']}
+        with spans.span('lb.migrate', attrs=attrs):
+            try:
+                faults.inject('lb.migrate', env_exc=OSError)
+                blob: Optional[bytes] = None
+                if migrate_payload is not None:
+                    try:
+                        blob = base64.b64decode(
+                            migrate_payload.get('snapshot') or '')
+                    except (ValueError, TypeError):
+                        blob = None
+                if not blob:
+                    blob = await self._fetch_snapshot(
+                        dead_target, dead_key, deadline)
+                if not blob:
+                    raise OSError('no snapshot available for the '
+                                  'interrupted stream')
+                if len(blob) > envs.SKYTPU_MIGRATION_MAX_BYTES.get():
+                    raise OSError(
+                        f'snapshot is {len(blob)} bytes, over '
+                        'SKYTPU_MIGRATION_MAX_BYTES')
+                attrs['blob_bytes'] = len(blob)
+                delay = policy.base_delay
+                while True:
+                    candidates = self._failover_order(context)
+                    for cand in candidates or ():
+                        if cand == dead_target or \
+                                not self.breaker.allow(cand):
+                            continue
+                        if time.monotonic() >= deadline:
+                            break
+                        url = (cand.rstrip('/') + '/internal/restore'
+                               f'?sent={state["sent"]}&stream=1')
+                        session = ClientSession(
+                            timeout=ClientTimeout(total=3600))
+                        try:
+                            up = await session.request(
+                                'POST', url, data=blob,
+                                headers={'Content-Type':
+                                         'application/octet-stream'})
+                        except (OSError, aiohttp.ClientError):
+                            await session.close()
+                            self.breaker.record_failure(cand)
+                            continue
+                        if up.status == 400:
+                            # The blob itself is bad — no other
+                            # replica will accept it either.
+                            up.close()
+                            await session.close()
+                            raise OSError(
+                                'restore rejected the snapshot blob')
+                        if up.status != 200:
+                            # Capacity/draining (409/503): next one.
+                            up.close()
+                            await session.close()
+                            continue
+                        self.breaker.record_success(cand)
+                        attrs['to'] = cand
+                        obs.MIGRATION_SUCCESSES.inc()
+                        obs.MIGRATION_SECONDS.observe(
+                            time.monotonic() - t0)
+                        obs.MIGRATION_INTERRUPTION_SECONDS.observe(
+                            time.monotonic() - state['last_token'])
+                        return (session, up, cand,
+                                up.headers.get(
+                                    'X-SkyTPU-Migration-Key') or '')
+                    if time.monotonic() + delay >= deadline:
+                        raise OSError('no replica could restore the '
+                                      'stream inside the migration '
+                                      'deadline')
+                    # READY sets change under us (a drained replica's
+                    # successor registering): wait and re-list.
+                    await asyncio.sleep(delay)
+                    delay = min(delay * 2, policy.max_delay)
+            except (OSError, aiohttp.ClientError) as e:
+                attrs['error'] = str(e)
+                obs.MIGRATION_FAILURES.inc()
+                return None
 
     async def _handle_trace(self, request):
         """Merged trace view: the LB's own spans for a trace id plus,

@@ -15,7 +15,7 @@ python -m pytest tests/ -q -m slow
 # evidence. JAX_PLATFORMS=cpu keeps the sim off any real accelerator.
 for scenario in smoke fused_decode spec_decode shared_prefix \
         sharded_serve prefix_affinity zone_loss rolling_update \
-        preemption_wave; do
+        preemption_wave preemption_migration; do
     JAX_PLATFORMS=cpu python -m skypilot_tpu.fleetsim \
         --scenario "$scenario" --out /tmp
 done
@@ -101,3 +101,108 @@ EOF
 JAX_PLATFORMS=cpu python -m skypilot_tpu.checkpoints verify "$ckpt_dir"
 JAX_PLATFORMS=cpu python -m skypilot_tpu.checkpoints import "$ckpt_dir"
 rm -rf "$ckpt_dir"
+# Preemption-migration smoke: two real servers; stream from A, drain A
+# mid-stream (the preemption notice), splice the migrate blob into B,
+# and the combined client stream must equal an uninterrupted greedy
+# run — token for token, no duplicates, no drops.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import base64, json, subprocess, sys, threading, time
+import urllib.error, urllib.request
+
+PORT_A, PORT_B = 18341, 18342
+ARGS = ['--model', 'tiny', '--batch-size', '2',
+        '--decode-fuse-steps', '2', '--max-seq-len', '2048']
+
+def wait_health(port):
+    for _ in range(120):
+        try:
+            with urllib.request.urlopen(
+                    f'http://127.0.0.1:{port}/health', timeout=2) as r:
+                if r.status == 200:
+                    return
+        except Exception:
+            pass
+        time.sleep(1)
+    raise SystemExit(f'server on {port} never became healthy')
+
+def post(port, path, body, timeout=300):
+    raw = isinstance(body, bytes)
+    req = urllib.request.Request(
+        f'http://127.0.0.1:{port}{path}',
+        data=body if raw else json.dumps(body).encode(),
+        headers={'Content-Type': 'application/octet-stream' if raw
+                 else 'application/json'})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+def sse_events(resp):
+    buf = b''
+    while True:
+        chunk = resp.read(1)
+        if not chunk:
+            return
+        buf += chunk
+        while b'\n\n' in buf:
+            frame, buf = buf.split(b'\n\n', 1)
+            for line in frame.split(b'\n'):
+                if line.startswith(b'data: '):
+                    yield json.loads(line[6:])
+
+procs = [subprocess.Popen(
+    [sys.executable, '-m', 'skypilot_tpu.inference.server',
+     '--port', str(port)] + ARGS,
+    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    for port in (PORT_A, PORT_B)]
+try:
+    wait_health(PORT_A)
+    wait_health(PORT_B)
+    body = {'prompt_tokens': list(range(7, 19)),
+            'max_new_tokens': 1200, 'temperature': 0.0}
+    with post(PORT_B, '/generate', body) as r:
+        ref = json.loads(r.read())['tokens']
+    assert len(ref) == 1200, len(ref)
+
+    resp = post(PORT_A, '/generate', dict(body, stream=True))
+    assert resp.headers.get('X-SkyTPU-Migration-Key')
+    got, migrate, t = [], None, None
+    def drain():
+        post(PORT_A, '/internal/drain?deadline=0.05', {}).read()
+    for ev in sse_events(resp):
+        if 'token' in ev:
+            got.append(ev['token'])
+            if t is None:
+                t = threading.Thread(target=drain)
+                t.start()
+        elif 'migrate' in ev:
+            migrate = ev['migrate']
+            break
+        else:
+            raise SystemExit(f'unexpected frame: {ev}')
+    assert migrate is not None, f'drain never landed; got {len(got)}'
+    t.join(timeout=30)
+    assert migrate['sent'] == len(got)
+    try:  # the draining replica must refuse new admissions
+        post(PORT_A, '/generate', body).read()
+        raise SystemExit('draining replica accepted a request')
+    except urllib.error.HTTPError as e:
+        assert e.code == 503, e.code
+
+    blob = base64.b64decode(migrate['snapshot'])
+    r2 = post(PORT_B, f'/internal/restore?sent={len(got)}&stream=1',
+              blob)
+    rest, done_tokens = [], None
+    for ev in sse_events(r2):
+        if 'token' in ev:
+            rest.append(ev['token'])
+        elif 'done' in ev:
+            done_tokens = ev['tokens']
+            break
+        else:
+            raise SystemExit(f'unexpected frame: {ev}')
+    assert got + rest == ref, 'client stream != uninterrupted run'
+    assert done_tokens == ref, 'done payload != full token list'
+    print(f'drain smoke: {len(got)} streamed on A + {len(rest)} '
+          f'restored on B == uninterrupted reference')
+finally:
+    for p in procs:
+        p.kill()
+EOF

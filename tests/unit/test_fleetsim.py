@@ -539,6 +539,39 @@ class TestSmokeScenario:
             str(tmp_path), 'SLO_shared_prefix.json')).read())
         assert data['rc'] == 0 and data['scenario'] == 'shared_prefix'
 
+    def test_preemption_migration_scenario_gates_success_ratio(
+            self, tmp_path):
+        """ISSUE 17 satellite: the preemption_migration scenario
+        kills the busiest replicas mid-decode (replica.preempt) and
+        gates the snapshot/restore ladder on the REAL
+        skytpu_migration_* series: success RATIO >= 0.9 from counter
+        deltas and the client-visible interruption-gap p95 from
+        bucket deltas. The armed lb.migrate fault forces exactly two
+        honest terminations, so both rungs of the ladder are
+        exercised in one report."""
+        sim = runner_lib.FleetSim(
+            runner_lib.SCENARIOS['preemption_migration'], seed=0,
+            out_dir=str(tmp_path))
+        report = sim.run()
+        by_name = {r['name']: r for r in report['asserts']}
+        ratio = by_name['migration_success']
+        assert ratio['ok'], ratio
+        assert ratio['metric'] == 'skytpu_migration_successes_total'
+        # >= 0.9 but < 1.0: the two forced lb.migrate failures landed
+        # (the failure rung ran), yet the fleet still cleared the bar.
+        assert 0.9 <= ratio['value'] < 1.0, ratio
+        gap = by_name['migration_interruption_p95']
+        assert gap['ok'], gap
+        assert gap['metric'] == 'skytpu_migration_interruption_seconds'
+        assert 0 < gap['value'] <= 2.0
+        assert by_name['ttft_p95']['ok'], by_name['ttft_p95']
+        assert report['rc'] == 0, report['asserts']
+        assert report['extra']['requests'] > 1000
+        data = json.loads(open(os.path.join(
+            str(tmp_path), 'SLO_preemption_migration.json')).read())
+        assert data['rc'] == 0
+        assert data['scenario'] == 'preemption_migration'
+
     def test_sharded_serve_scenario_gates_decode_and_hit_ratio(
             self, tmp_path):
         """ISSUE 14 satellite: the sharded_serve scenario drives

@@ -1,0 +1,546 @@
+"""Preemption-safe serving (ISSUE 17): KV snapshot, drain, migration.
+
+The acceptance oracle is greedy token-for-token identity: a request
+snapshotted mid-decode, aborted, and restored into ANOTHER engine must
+emit exactly what an uninterrupted run emits — for the dense cache,
+the paged pool with the prefix cache on and off, the int8-quantized
+pool, and a tensor-sharded paged pool on the conftest-forced 8-device
+CPU mesh. Around the oracle: the blob format rejects truncated/
+corrupted/version-mismatched payloads loudly, restore respects the
+pool invariant (free + cached + private == total), splicing compiles
+nothing new (fused_decode_steps._cache_size()), and the serve plane
+(EngineLoop drain, /internal/* endpoints, LB managed relay) carries a
+client stream across a drain with no duplicated or dropped tokens.
+"""
+import asyncio
+import struct
+import time
+import zlib
+
+import jax
+import numpy as np
+import pytest
+
+from skypilot_tpu import inference
+from skypilot_tpu.inference import engine as eng_lib
+from skypilot_tpu.models import llama
+from skypilot_tpu.observability import instruments as obs
+
+
+@pytest.fixture(scope='module')
+def tiny():
+    config = llama.CONFIGS['tiny']
+    params = llama.init_params(config, jax.random.key(7))
+    return config, params
+
+
+def _greedy(max_new):
+    return inference.SamplingParams(temperature=0.0,
+                                    max_new_tokens=max_new)
+
+
+def _engine(params, config, **kw):
+    kw.setdefault('batch_size', 2)
+    kw.setdefault('max_seq_len', 64)
+    kw.setdefault('prefill_chunk', 16)
+    kw.setdefault('kv_quant', 'none')
+    # The default fused round (8) finishes short generations inside
+    # one dispatch — 2 tokens per round keeps requests interruptible
+    # mid-decode.
+    kw.setdefault('decode_fuse_steps', 2)
+    return inference.InferenceEngine(params, config, **kw)
+
+
+def _mesh(tensor=2):
+    from skypilot_tpu.parallel import MeshSpec, make_mesh
+    return make_mesh(MeshSpec(data=1, fsdp=8 // tensor, tensor=tensor))
+
+
+_PROMPT = [3, 17, 42, 9, 105, 8]
+_STEPS = 16
+
+
+def _drive_until(eng, rid, n_tokens):
+    """Step until the request has generated >= n_tokens (and is still
+    in flight); returns the tokens so far."""
+    for _ in range(200):
+        eng.step()
+        assert rid not in eng.finished(), \
+            'request finished before the mid-decode snapshot point'
+        prog = dict(eng.active_progress())
+        if len(prog.get(rid, ())) >= n_tokens:
+            return list(prog[rid])
+    raise AssertionError('never reached the snapshot point')
+
+
+def _migrate_mid_decode(src, dst, prompt=None, steps=_STEPS, mid=5):
+    """Snapshot `src`'s request after `mid` tokens, abort it, restore
+    into `dst`, run to completion. Returns (mid_tokens, final)."""
+    prompt = list(prompt or _PROMPT)
+    rid = src.submit(prompt, _greedy(steps))
+    mid_tokens = _drive_until(src, rid, mid)
+    blob = src.snapshot_request(rid)
+    src.abort(rid)
+    rid2 = dst.restore_request(blob)
+    final = dst.run_to_completion()[rid2]
+    assert final[:len(mid_tokens)] == mid_tokens, \
+        'restored run rewrote already-streamed tokens'
+    return mid_tokens, final
+
+
+class TestGreedyIdentity:
+    """Mid-decode migration is invisible in the token stream."""
+
+    def test_paged_prefix_off(self, tiny):
+        config, params = tiny
+        ref_eng = _engine(params, config, prefix_cache=False)
+        rid = ref_eng.submit(list(_PROMPT), _greedy(_STEPS))
+        ref = ref_eng.run_to_completion()[rid]
+        src = _engine(params, config, prefix_cache=False)
+        dst = _engine(params, config, prefix_cache=False)
+        _, final = _migrate_mid_decode(src, dst)
+        assert final == ref
+
+    def test_paged_prefix_on_with_shared_pages(self, tiny):
+        """The migrated request holds COW-shared prefix pages on the
+        source — the snapshot gathers them like any other page, and
+        the restore side owns them privately."""
+        config, params = tiny
+        ref_eng = _engine(params, config, prefix_cache=True)
+        rid = ref_eng.submit(list(_PROMPT), _greedy(_STEPS))
+        ref = ref_eng.run_to_completion()[rid]
+        src = _engine(params, config, prefix_cache=True)
+        dst = _engine(params, config, prefix_cache=True)
+        # Warm the source's prefix cache with the same prompt so the
+        # migrated request admits with shared pages.
+        warm = src.submit(list(_PROMPT), _greedy(4))
+        src.run_to_completion()
+        assert warm is not None
+        _, final = _migrate_mid_decode(src, dst)
+        assert final == ref
+
+    def test_int8_quantized_pool(self, tiny):
+        config, params = tiny
+        ref_eng = _engine(params, config, kv_quant='int8')
+        rid = ref_eng.submit(list(_PROMPT), _greedy(_STEPS))
+        ref = ref_eng.run_to_completion()[rid]
+        src = _engine(params, config, kv_quant='int8')
+        dst = _engine(params, config, kv_quant='int8')
+        _, final = _migrate_mid_decode(src, dst)
+        assert final == ref
+
+    def test_dense(self, tiny):
+        config, params = tiny
+        ref_eng = _engine(params, config, kv_page_size=0)
+        rid = ref_eng.submit(list(_PROMPT), _greedy(_STEPS))
+        ref = ref_eng.run_to_completion()[rid]
+        src = _engine(params, config, kv_page_size=0)
+        dst = _engine(params, config, kv_page_size=0)
+        _, final = _migrate_mid_decode(src, dst)
+        assert final == ref
+
+    def test_sharded_paged(self, tiny):
+        """Tensor-sharded pool -> tensor-sharded pool on the forced
+        8-device CPU mesh: gather/splice round-trip through
+        _shard_pages keeps the migrated stream identical."""
+        config, params = tiny
+        ref_eng = _engine(params, config, kv_page_size=8)
+        rid = ref_eng.submit(list(_PROMPT), _greedy(_STEPS))
+        ref = ref_eng.run_to_completion()[rid]
+        src = _engine(params, config, kv_page_size=8,
+                      mesh=_mesh(tensor=2))
+        dst = _engine(params, config, kv_page_size=8,
+                      mesh=_mesh(tensor=2))
+        _, final = _migrate_mid_decode(src, dst)
+        assert final == ref
+
+
+class TestBlobFormat:
+    """The wire blob is versioned, checksummed, and validated before
+    any engine state is touched."""
+
+    def _mk_blob(self, tiny, mid=5):
+        config, params = tiny
+        src = _engine(params, config, prefix_cache=False)
+        rid = src.submit(list(_PROMPT), _greedy(_STEPS))
+        _drive_until(src, rid, mid)
+        return src.snapshot_request(rid)
+
+    def test_roundtrip_spliced_pages_byte_equal(self, tiny):
+        """Snapshot -> restore -> re-snapshot: the spliced pages must
+        match the original payload byte for byte."""
+        config, params = tiny
+        src = _engine(params, config, prefix_cache=False)
+        dst = _engine(params, config, prefix_cache=False)
+        rid = src.submit(list(_PROMPT), _greedy(_STEPS))
+        _drive_until(src, rid, 5)
+        blob = src.snapshot_request(rid)
+        rid2 = dst.restore_request(blob)
+        blob2 = dst.snapshot_request(rid2)
+        h1, a1 = eng_lib._snapshot_unpack(blob)
+        h2, a2 = eng_lib._snapshot_unpack(blob2)
+        assert h1['generated'] == h2['generated']
+        assert h1['prompt'] == h2['prompt']
+        assert h1['length'] == h2['length']
+        assert sorted(a1) == sorted(a2)
+        for name in a1:
+            np.testing.assert_array_equal(a1[name], a2[name])
+
+    def test_truncated_rejected(self, tiny):
+        blob = self._mk_blob(tiny)
+        with pytest.raises(eng_lib.SnapshotError):
+            eng_lib._snapshot_unpack(blob[:-7])
+        with pytest.raises(eng_lib.SnapshotError):
+            eng_lib._snapshot_unpack(blob[:15])
+
+    def test_corrupted_rejected(self, tiny):
+        blob = bytearray(self._mk_blob(tiny))
+        blob[len(blob) // 2] ^= 0xFF
+        with pytest.raises(eng_lib.SnapshotError,
+                           match='CRC'):
+            eng_lib._snapshot_unpack(bytes(blob))
+
+    def test_garbage_rejected(self, tiny):
+        config, params = tiny
+        dst = _engine(params, config)
+        with pytest.raises(eng_lib.SnapshotError):
+            dst.restore_request(b'not a snapshot at all')
+
+    def test_version_mismatch_rejected(self, tiny):
+        blob = self._mk_blob(tiny)
+        magic = eng_lib._SNAP_MAGIC
+        body = blob[len(magic):-4]
+        _, hlen = struct.unpack_from('<II', body)
+        body = struct.pack('<II', eng_lib.SNAPSHOT_VERSION + 1,
+                           hlen) + body[8:]
+        forged = magic + body + struct.pack(
+            '<I', zlib.crc32(body) & 0xFFFFFFFF)
+        with pytest.raises(eng_lib.SnapshotError, match='version'):
+            eng_lib._snapshot_unpack(forged)
+
+    def test_geometry_mismatch_rejected(self, tiny):
+        config, params = tiny
+        blob = self._mk_blob(tiny)
+        dense = _engine(params, config, kv_page_size=0)
+        with pytest.raises(eng_lib.SnapshotError, match='layout'):
+            dense.restore_request(blob)
+        other_page = _engine(params, config, kv_page_size=4)
+        with pytest.raises(eng_lib.SnapshotError, match='page_size'):
+            other_page.restore_request(blob)
+        other_len = _engine(params, config, max_seq_len=48)
+        with pytest.raises(eng_lib.SnapshotError, match='max_seq_len'):
+            other_len.restore_request(blob)
+
+    def test_size_cap_refuses_loudly(self, tiny, monkeypatch):
+        config, params = tiny
+        monkeypatch.setenv('SKYTPU_MIGRATION_MAX_BYTES', '16')
+        src = _engine(params, config, prefix_cache=False)
+        rid = src.submit(list(_PROMPT), _greedy(_STEPS))
+        _drive_until(src, rid, 3)
+        with pytest.raises(eng_lib.SnapshotError,
+                           match='MIGRATION_MAX_BYTES'):
+            src.snapshot_request(rid)
+
+    def test_queued_request_snapshots_host_only(self, tiny):
+        """A queue-parked request has no KV yet: its blob is host
+        state only, and restoring is an ordinary submit (prefill
+        repays; zero tokens were streamed, so the contract holds)."""
+        config, params = tiny
+        src = _engine(params, config, prefix_cache=False)
+        # Fill both slots so the third request parks in the queue.
+        for p in ([1, 2, 3], [4, 5, 6]):
+            src.submit(p, _greedy(_STEPS))
+        src.step()
+        rid = src.submit(list(_PROMPT), _greedy(_STEPS))
+        blob = src.snapshot_request(rid)
+        header, arrays = eng_lib._snapshot_unpack(blob)
+        assert header['layout'] == 'none'
+        assert not arrays
+        src.abort(rid)
+        dst = _engine(params, config, prefix_cache=False)
+        rid2 = dst.restore_request(blob)
+        final = dst.run_to_completion()[rid2]
+        ref_eng = _engine(params, config, prefix_cache=False)
+        rr = ref_eng.submit(list(_PROMPT), _greedy(_STEPS))
+        assert final == ref_eng.run_to_completion()[rr]
+
+    def test_finished_request_not_snapshotable(self, tiny):
+        config, params = tiny
+        src = _engine(params, config)
+        rid = src.submit(list(_PROMPT), _greedy(4))
+        src.run_to_completion()
+        with pytest.raises(KeyError):
+            src.snapshot_request(rid)
+
+
+class TestPoolInvariants:
+    """Restore goes through the ordinary allocator: nothing leaks,
+    nothing double-books, nothing recompiles."""
+
+    def test_free_cached_private_accounting(self, tiny):
+        config, params = tiny
+        src = _engine(params, config, prefix_cache=True)
+        dst = _engine(params, config, prefix_cache=True)
+        rid = src.submit(list(_PROMPT), _greedy(_STEPS))
+        _drive_until(src, rid, 5)
+        blob = src.snapshot_request(rid)
+        src.abort(rid)
+
+        def accounted(eng):
+            free = len(eng._page_alloc)
+            cached = eng._prefix.num_pages() if eng._prefix else 0
+            private = sum(
+                len(set(pages) - eng._slot_shared[i])
+                for i, pages in enumerate(eng._slot_pages))
+            return free + cached + private
+
+        rid2 = dst.restore_request(blob)
+        assert accounted(dst) == dst._pages_total
+        out = dst.run_to_completion()
+        assert rid2 in out
+        assert accounted(dst) == dst._pages_total
+        # Source side: the abort returned the pages.
+        assert accounted(src) == src._pages_total
+
+    def test_restore_splice_zero_recompiles(self, tiny):
+        """Splicing into a WARM engine compiles nothing: the gather/
+        scatter jits pad to the table width, so one compile per
+        engine geometry covers every request shape."""
+        config, params = tiny
+        src = _engine(params, config, prefix_cache=False)
+        dst = _engine(params, config, prefix_cache=False)
+        # Warm both engines end to end (prefill + fused decode +
+        # snapshot/restore kernels).
+        rid = src.submit([9, 8, 7], _greedy(6))
+        _drive_until(src, rid, 2)
+        b0 = src.snapshot_request(rid)
+        src.abort(rid)
+        dst.run_to_completion()  # no-op, warms nothing yet
+        dst.restore_request(b0)
+        dst.run_to_completion()
+        warm_fused = eng_lib.fused_decode_steps._cache_size()
+        # A second migration of a different-shape request: zero new
+        # compiles anywhere on the fused path.
+        rid = src.submit(list(_PROMPT), _greedy(_STEPS))
+        _drive_until(src, rid, 5)
+        blob = src.snapshot_request(rid)
+        src.abort(rid)
+        rid2 = dst.restore_request(blob)
+        out = dst.run_to_completion()
+        assert rid2 in out
+        assert eng_lib.fused_decode_steps._cache_size() == warm_fused
+
+    def test_restore_refuses_when_full_then_fits(self, tiny):
+        """Capacity refusal is a RuntimeError (the LB's cue to try
+        another replica), not a SnapshotError — and the same blob
+        restores fine once a slot frees."""
+        config, params = tiny
+        src = _engine(params, config, prefix_cache=False)
+        rid = src.submit(list(_PROMPT), _greedy(_STEPS))
+        _drive_until(src, rid, 5)
+        blob = src.snapshot_request(rid)
+        src.abort(rid)
+        dst = _engine(params, config, prefix_cache=False)
+        occupants = [dst.submit(p, _greedy(_STEPS))
+                     for p in ([1, 2, 3], [4, 5, 6])]
+        dst.step()
+        with pytest.raises(RuntimeError, match='no free slot'):
+            dst.restore_request(blob)
+        for o in occupants:
+            dst.abort(o)
+        rid2 = dst.restore_request(blob)
+        out = dst.run_to_completion()
+        assert rid2 in out
+
+
+class TestEngineLoopDrain:
+    """The serve-plane half: snapshot_inflight, abort races, FIFO."""
+
+    def _loop_engine(self, tiny):
+        config, params = tiny
+        from skypilot_tpu.inference import server as srv
+        return srv, _engine(params, config, prefix_cache=False)
+
+    def test_abort_racing_drain_is_not_migrated(self, tiny):
+        """A client that vanished as the drain fired must be freed,
+        not snapshotted: watcher.aborted is set synchronously, and
+        snapshot_inflight runs BEFORE the abort queue drains."""
+        srv, engine = self._loop_engine(tiny)
+        loop = srv.EngineLoop(engine)
+
+        async def go():
+            w = loop.submit(list(_PROMPT), _greedy(200), stream=True)
+            for _ in range(500):
+                if w.rid is not None:
+                    break
+                await asyncio.sleep(0.02)
+            assert w.rid is not None
+            loop.abort(w)
+            return await asyncio.wrap_future(
+                loop.run_on_engine(loop.snapshot_inflight)), w
+
+        try:
+            snaps, w = asyncio.new_event_loop().run_until_complete(
+                go())
+            assert snaps == []          # nothing migrated
+            assert not loop._watchers   # nothing left registered
+            deadline = time.time() + 5
+            while engine.has_work and time.time() < deadline:
+                time.sleep(0.05)
+            assert not engine.has_work  # the slot was freed
+        finally:
+            loop.stop()
+
+    def test_drain_snapshots_streams_with_sent_count(self, tiny):
+        """snapshot_inflight hands each live stream a terminal
+        migrate event whose `sent` equals the tokens already pushed —
+        the LB's no-dup/no-drop anchor."""
+        srv, engine = self._loop_engine(tiny)
+        loop = srv.EngineLoop(engine)
+
+        async def go():
+            w = loop.submit(list(_PROMPT), _greedy(200), stream=True)
+            # Let a few tokens stream.
+            seen = []
+            while len(seen) < 3:
+                kind, payload = await asyncio.wait_for(
+                    w.q.get(), timeout=30)
+                assert kind == 'token', (kind, payload)
+                seen.append(payload)
+            snaps = await asyncio.wrap_future(
+                loop.run_on_engine(loop.snapshot_inflight))
+            # Drain the queue to the terminal migrate event.
+            while True:
+                kind, payload = await asyncio.wait_for(
+                    w.q.get(), timeout=30)
+                if kind != 'token':
+                    break
+                seen.append(payload)
+            return snaps, seen, kind, payload
+
+        try:
+            snaps, seen, kind, payload = \
+                asyncio.new_event_loop().run_until_complete(go())
+            assert kind == 'migrate'
+            assert len(snaps) == 1
+            assert payload['sent'] == snaps[0][0].sent
+            assert payload['snapshot']
+            # The blob resumes exactly past what the watcher pushed.
+            import base64
+            blob = base64.b64decode(payload['snapshot'])
+            header, _ = eng_lib._snapshot_unpack(blob)
+            assert header['generated'][:len(seen)] == seen
+        finally:
+            loop.stop()
+
+
+_LB_PROMPT = list(range(7, 19))
+
+
+def test_client_stream_survives_drain_through_lb(tiny):
+    """The full ladder in-process: two replica servers behind the real
+    LoadBalancer; replica A drains mid-stream; the CLIENT's stream
+    (read through the LB) must carry every token exactly once and end
+    with a normal done frame. Migration counters move; the honest-
+    termination counter does not."""
+    from aiohttp import ClientSession
+    from aiohttp.test_utils import TestServer
+    from skypilot_tpu.inference import server as srv
+    from skypilot_tpu.serve import load_balancer as lb_lib
+
+    config, params = tiny
+    eng_a = _engine(params, config, max_seq_len=128,
+                    prefix_cache=False)
+    eng_b = _engine(params, config, max_seq_len=128,
+                    prefix_cache=False)
+    # Throttle A so the drain deterministically lands mid-stream; B
+    # (the restore target) runs at full speed.
+    orig_step = eng_a.step
+
+    def slow_step():
+        time.sleep(0.05)
+        orig_step()
+
+    eng_a.step = slow_step
+
+    ref_eng = _engine(params, config, max_seq_len=128,
+                      prefix_cache=False)
+    rr = ref_eng.submit(list(_LB_PROMPT), _greedy(64))
+    ref = ref_eng.run_to_completion()[rr]
+    assert len(ref) == 64
+
+    holder_a = {'loop': srv.EngineLoop(eng_a)}
+    holder_b = {'loop': srv.EngineLoop(eng_b)}
+    lb = lb_lib.LoadBalancer(policy_name='round_robin',
+                             honor_env_policy=False)
+
+    mig0 = obs.MIGRATION_SUCCESSES.value()
+    fail0 = obs.LB_MIDSTREAM_FAILURES.value()
+
+    async def go():
+        server_a = TestServer(srv.create_app(holder_a))
+        server_b = TestServer(srv.create_app(holder_b))
+        await server_a.start_server()
+        await server_b.start_server()
+        lb.set_replicas([f'http://127.0.0.1:{server_a.port}',
+                         f'http://127.0.0.1:{server_b.port}'])
+        lb_port = lb.start()
+        try:
+            async with ClientSession() as session:
+                async with session.post(
+                        f'http://127.0.0.1:{lb_port}/generate',
+                        json={'prompt_tokens': _LB_PROMPT,
+                              'max_new_tokens': 64,
+                              'temperature': 0.0,
+                              'stream': True}) as resp:
+                    assert resp.status == 200
+                    got, done_tokens = [], None
+                    drain_task = None
+                    buf = b''
+                    async for chunk in resp.content.iter_any():
+                        buf += chunk
+                        while b'\n\n' in buf:
+                            frame, buf = buf.split(b'\n\n', 1)
+                            import json as json_lib
+                            doc = None
+                            for line in frame.split(b'\n'):
+                                if line.startswith(b'data: '):
+                                    doc = json_lib.loads(line[6:])
+                            if doc is None:
+                                continue
+                            assert 'migrate' not in doc, \
+                                'migrate frame leaked to the client'
+                            assert 'error' not in doc, doc
+                            if 'token' in doc:
+                                got.append(doc['token'])
+                                if len(got) == 3 and \
+                                        drain_task is None:
+                                    drain_task = asyncio.ensure_future(
+                                        session.post(
+                                            'http://127.0.0.1:'
+                                            f'{server_a.port}'
+                                            '/internal/drain'
+                                            '?deadline=0.05',
+                                            json={}))
+                            else:
+                                done_tokens = doc.get('tokens')
+                    if drain_task is not None:
+                        await drain_task
+                    return got, done_tokens
+        finally:
+            lb.stop()
+            await server_a.close()
+            await server_b.close()
+
+    try:
+        got, done_tokens = asyncio.new_event_loop()\
+            .run_until_complete(go())
+    finally:
+        holder_a['loop'].stop()
+        holder_b['loop'].stop()
+    assert got == ref, (
+        f'client stream diverged: {len(got)} tokens vs {len(ref)}')
+    assert done_tokens == ref
+    assert obs.MIGRATION_SUCCESSES.value() >= mig0 + 1
+    assert obs.LB_MIDSTREAM_FAILURES.value() == fail0, \
+        'a migrated stream must not count as honest termination'

@@ -311,8 +311,10 @@ def test_engine_loop_rebinds_span_context_across_thread_hop():
                             collector=coll) as ctx:
                 loop.submit([1, 2], None)
             # Wait for the engine thread to drain the traced request
-            # BEFORE submitting the untraced one: the idle-park path
-            # re-queues items, so back-to-back submits can reorder.
+            # BEFORE submitting the untraced one, so captured[0] is
+            # unambiguously the traced submit (admission is FIFO —
+            # see test_idle_park_preserves_fifo_order — but this test
+            # is about context binding, not ordering).
             await drain_to(1)
             loop.submit([3], None)   # no ambient span for this one
             await drain_to(2)
@@ -326,6 +328,58 @@ def test_engine_loop_rebinds_span_context_across_thread_hop():
     # ...and was unbound afterwards: the untraced request must NOT
     # inherit the previous request's trace.
     assert eng.captured[1] is None
+
+
+def test_idle_park_preserves_fifo_order():
+    """Regression (ISSUE 17 satellite): the idle-park path used to
+    pop a submission off the queue and RE-PUT it at the tail — a
+    second request enqueued during the park would then be admitted
+    FIRST, swapping slot assignment and trace parentage for
+    back-to-back submissions. The park must process the popped item
+    in pop order.
+
+    The race is reproduced deterministically: the park's timed get()
+    is intercepted to deliver item A while item B lands on the queue
+    — exactly the window the old code lost."""
+    from skypilot_tpu.inference import server as srv
+    eng = _CaptureEngine()
+    loop = srv.EngineLoop(eng)
+    # Drive ticks by hand: the background thread would race the
+    # intercepted queue.
+    loop.stop()
+    loop._thread.join(timeout=10)
+    assert not loop._thread.is_alive()
+
+    aio = asyncio.new_event_loop()
+    try:
+        watcher_a = srv.EngineLoop.Watcher(aio, False)
+        watcher_b = srv.EngineLoop.Watcher(aio, False)
+        item_a = ('gen', [1, 1], None, watcher_a, None, None)
+        item_b = ('gen', [2, 2], None, watcher_b, None, None)
+        orig_get = loop._submit_q.get
+        fired = []
+
+        def park_get(*args, **kwargs):
+            if 'timeout' in kwargs and not fired:
+                # The idle park: A arrives, and B lands right behind
+                # it while the pop is still in flight. One-shot — the
+                # next tick's park must see the real (drained) queue.
+                fired.append(1)
+                loop._submit_q.put(item_b)
+                return item_a
+            return orig_get(*args, **kwargs)
+
+        loop._submit_q.get = park_get
+        try:
+            loop._tick()   # idle park pops A; B is now queued
+            loop._tick()   # drains B
+        finally:
+            loop._submit_q.get = orig_get
+        assert [w.rid for w in (watcher_a, watcher_b)] == [1, 2], \
+            'idle-park requeue reordered back-to-back submissions'
+        assert len(eng.captured) == 2
+    finally:
+        aio.close()
 
 
 # --- exemplars --------------------------------------------------------------
