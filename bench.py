@@ -959,6 +959,51 @@ def _trace_overhead_bench(jax, on_tpu: bool):
     }
 
 
+_LINT_ONLY_FLAG = '--lint-only'
+_LINT_BUDGET_S = 30.0
+
+
+def _lint_bench():
+    """The full ten-checker skytpu-lint pass over the repo, timed.
+
+    Two claims ride the wall-clock bar: the shared parse cache means
+    each file is parsed EXACTLY once per run (checkers receive
+    ParsedFile objects, never re-read the tree), and per-function
+    CFGs are memoized on the file, not per checker (cfg_requests >
+    cfg_builds whenever two flow checkers visit the same function).
+    Either regressing is what would push a pre-commit lint past the
+    30s bar as the tree and checker count grow."""
+    from skypilot_tpu.analysis import core as lint_core
+    import skypilot_tpu.analysis.checkers  # noqa: F401 — registers
+
+    parse_before = lint_core.PARSE_CALLS
+    t0 = time.perf_counter()
+    findings, suppressed = lint_core.run()
+    wall = time.perf_counter() - t0
+    stats = dict(lint_core.LAST_RUN_STATS)
+    parse_delta = lint_core.PARSE_CALLS - parse_before
+
+    one_parse_per_file = parse_delta == stats.get('parsed', -1)
+    cfg_memoized = stats.get('cfg_requests', 0) >= \
+        stats.get('cfg_builds', 1)
+    ok = (wall <= _LINT_BUDGET_S and one_parse_per_file
+          and cfg_memoized)
+    return {
+        'wall_s': round(wall, 3),
+        'budget_s': _LINT_BUDGET_S,
+        'files': stats.get('files', 0),
+        'parsed': stats.get('parsed', 0),
+        'parse_calls': parse_delta,
+        'one_parse_per_file': one_parse_per_file,
+        'cfg_builds': stats.get('cfg_builds', 0),
+        'cfg_requests': stats.get('cfg_requests', 0),
+        'checkers': len(lint_core.all_checkers()),
+        'findings': len(findings),
+        'suppressed': suppressed,
+        'rc': 0 if ok else 1,
+    }
+
+
 def main() -> None:
     try:
         jax, devices = _init_backend()
@@ -1028,6 +1073,12 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — additive, like decode
         trace_overhead = {'error': f'{type(e).__name__}: {e}'}
 
+    try:
+        _progress('lint: full ten-checker static-analysis pass')
+        lint = _lint_bench()
+    except Exception as e:  # noqa: BLE001 — additive, like decode
+        lint = {'error': f'{type(e).__name__}: {e}'}
+
     result = {
         'metric': (f'llama_{train["model"]}_train_tokens_per_sec_'
                    f'per_chip_{train["chip"]}'),
@@ -1045,6 +1096,7 @@ def main() -> None:
             'hf_import': hf_import,
             'sharded_paged': sharded_paged,
             'trace_overhead': trace_overhead,
+            'lint': lint,
         },
     }
     print(json.dumps(result))
@@ -1056,6 +1108,12 @@ if __name__ == '__main__':
         # forced by the parent's env; print ONE JSON line and exit.
         print(json.dumps(_sharded_paged_body()))
         sys.exit(0)
+    if _LINT_ONLY_FLAG in sys.argv:
+        # Standalone lint bench: no accelerator needed — the lint
+        # evidence (BENCH_lint.json) regenerates in seconds.
+        lint = _lint_bench()
+        print(json.dumps(lint))
+        sys.exit(lint['rc'])
     try:
         main()
     except Exception as e:  # noqa: BLE001 — always emit the JSON line

@@ -94,8 +94,12 @@ class ClientSlot:
         os.register_at_fork(after_in_child=self._after_fork_in_child)
 
     def _after_fork_in_child(self) -> None:
+        # The fork child is single-threaded by construction (only the
+        # forking thread survives), and the parent's lock may be held
+        # by a thread that no longer exists — so replace the lock and
+        # drop the client WITHOUT taking it.
         self._lock = threading.Lock()
-        self._client = None
+        self._client = None  # skytpu-lint: ignore[unguarded-mutation]
 
     def set_factory(self, factory: Callable[[], Any]) -> None:
         with self._lock:

@@ -1,12 +1,21 @@
 """Committed-baseline handling: pre-existing debt must not block the
 gate, new findings must.
 
-The baseline maps content fingerprints (check|rule|path|source-line,
-no line numbers) to an allowed count. A finding is 'baselined' while
-occurrences of its fingerprint stay within that count; the excess —
-and any unknown fingerprint — is NEW and fails the gate. Fixing a
-baselined finding never breaks the gate (stale entries are just dead
-weight; `--write-baseline` prunes them).
+The baseline maps content fingerprints (check|rule|path|normalized
+STATEMENT text — no line numbers, no single-physical-line coupling)
+to an allowed count. A finding is 'baselined' while occurrences of
+its fingerprint stay within that count; the excess — and any unknown
+fingerprint — is NEW and fails the gate. Fixing a baselined finding
+never breaks the gate (stale entries are just dead weight;
+`--write-baseline` prunes them).
+
+Version 2 moved the fingerprint basis from one stripped source line
+to the whole normalized statement: a v1 baseline entry resurrected
+the moment black-style rewrapping moved part of a multi-line call
+onto another physical line. `migrate()` rewrites a v1 file in place,
+carrying counts over by matching the CURRENT findings' v1-style
+fingerprints against the old entries — exact, no heuristics — and
+dropping entries that match nothing (they were stale anyway).
 """
 import collections
 import json
@@ -16,7 +25,7 @@ from typing import Dict, List, Sequence, Tuple
 from skypilot_tpu.analysis.core import Finding
 
 DEFAULT_BASENAME = '.skytpu-lint-baseline.json'
-_VERSION = 1
+_VERSION = 2
 
 
 def default_path(root: str) -> str:
@@ -24,24 +33,29 @@ def default_path(root: str) -> str:
 
 
 def load(path: str) -> Dict[str, Dict[str, object]]:
-    """fingerprint -> entry ({check, rule, path, snippet, count})."""
+    """fingerprint -> entry ({check, rule, path, statement, count})."""
     if not os.path.exists(path):
         return {}
     with open(path, encoding='utf-8') as f:
         doc = json.load(f)
-    if doc.get('version') != _VERSION:
+    version = doc.get('version')
+    if version == 1:
         raise ValueError(
-            f'{path}: unsupported baseline version {doc.get("version")!r}')
+            f'{path} is a v1 (line-snippet) baseline; run '
+            '`python -m skypilot_tpu.analysis --migrate-baseline` '
+            'to rewrite it in place')
+    if version != _VERSION:
+        raise ValueError(
+            f'{path}: unsupported baseline version {version!r}')
     entries = doc.get('entries', {})
     if not isinstance(entries, dict):
         raise ValueError(f'{path}: entries must be a mapping')
     return entries
 
 
-def write(path: str, findings: Sequence[Finding]) -> None:
-    counts: Dict[str, int] = collections.Counter(
-        f.fingerprint() for f in findings)
-    entries = {}
+def _entries_for(findings: Sequence[Finding],
+                 counts: Dict[str, int]) -> Dict[str, Dict[str, object]]:
+    entries: Dict[str, Dict[str, object]] = {}
     for f in findings:
         fp = f.fingerprint()
         if fp in entries:
@@ -50,14 +64,59 @@ def write(path: str, findings: Sequence[Finding]) -> None:
             'check': f.check,
             'rule': f.rule,
             'path': f.path,
-            'snippet': f.snippet or f.message,
+            'statement': f.statement or f.snippet or f.message,
             'count': counts[fp],
         }
+    return entries
+
+
+def write(path: str, findings: Sequence[Finding]) -> None:
+    counts: Dict[str, int] = collections.Counter(
+        f.fingerprint() for f in findings)
     doc = {'version': _VERSION,
-           'entries': dict(sorted(entries.items()))}
+           'entries': dict(sorted(_entries_for(findings,
+                                               counts).items()))}
     with open(path, 'w', encoding='utf-8') as out:
         json.dump(doc, out, indent=1, sort_keys=False)
         out.write('\n')
+
+
+def migrate(path: str, findings: Sequence[Finding]) -> int:
+    """Rewrite a v1 baseline as v2 in place, preserving each entry's
+    count by matching the current findings' v1 fingerprints. Returns
+    the number of entries carried over; no-op (returning -1) when the
+    file is already v2 or absent."""
+    if not os.path.exists(path):
+        return -1
+    with open(path, encoding='utf-8') as f:
+        doc = json.load(f)
+    if doc.get('version') == _VERSION:
+        return -1
+    if doc.get('version') != 1:
+        raise ValueError(
+            f'{path}: cannot migrate version {doc.get("version")!r}')
+    old_entries = doc.get('entries', {})
+
+    kept: List[Finding] = []
+    counts: Dict[str, int] = {}
+    for f in findings:
+        old = old_entries.get(f.legacy_fingerprint())
+        if old is None:
+            continue
+        fp = f.fingerprint()
+        if fp not in counts:
+            kept.append(f)
+        # The old COUNT is the accepted debt level; distinct current
+        # findings sharing one new fingerprint still only get the old
+        # budget, not one budget each.
+        counts[fp] = max(counts.get(fp, 0), int(old.get('count', 1)))
+    new_doc = {'version': _VERSION,
+               'entries': dict(sorted(_entries_for(kept,
+                                                   counts).items()))}
+    with open(path, 'w', encoding='utf-8') as out:
+        json.dump(new_doc, out, indent=1, sort_keys=False)
+        out.write('\n')
+    return len(kept)
 
 
 def partition(findings: Sequence[Finding],

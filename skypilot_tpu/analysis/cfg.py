@@ -1,0 +1,442 @@
+"""Per-function control-flow graphs for flow-aware skytpu-lint rules.
+
+The graph is STATEMENT-granular: one node per executed statement (or
+per compound-statement HEADER — an `if`/`while` node models only its
+test, a `with` node only its context expressions; their bodies are
+separate nodes). Three synthetic nodes complete every graph: `entry`,
+`exit` (normal completion / return) and `raise_exit` (an exception
+escaping the function).
+
+Edges carry a kind:
+
+  normal     sequential flow, branch arms, loop entry/exit
+  exception  a statement that can raise, to the innermost handler
+             (or `raise_exit`); assert-failure; unmatched-handler
+             dispatch
+
+What the model gets right, because the checkers need it:
+
+  * `try`/`except`/`else`: every can-raise statement in the try body
+    has an exception edge to each handler AND (unmatched case) onward
+    to the outer handler / `raise_exit`.
+  * `finally`: the final body is DUPLICATED per continuation (normal,
+    exception, return, break, continue), so a release that lives in a
+    `finally` satisfies resource-pairing on the exception path too —
+    no merged over-approximation that would let a leak hide.
+  * `with`: the header can raise; body exceptions still propagate
+    (``__exit__`` observes, it does not swallow) — lexical lock
+    coverage is the With body's job, not the graph's.
+  * loops: back edges exist (body tail -> header), so cycle queries
+    (`host-sync-budget`'s sync-in-loop rule) see them; `break` skips
+    the `else:` clause, `continue` returns to the header.
+
+Can-raise is deliberately coarse-but-calibrated: a statement gets an
+exception edge iff it contains a call/await (or IS a raise/assert).
+Pure name/constant shuffling does not fork the graph — that keeps
+resource-pairing findings about real raise sites, not `x = y`.
+"""
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# Bench hook: total build() invocations. core.ParsedFile memoizes per
+# function node, so over a full check_project run this must equal the
+# number of DISTINCT functions whose CFG any checker asked for — the
+# committed lint bench asserts exactly that (memoize per file, not per
+# checker).
+BUILD_CALLS = 0
+
+NORMAL = 'normal'
+EXCEPTION = 'exception'
+
+# Finally-duplication guard: nested finally bodies multiply; past this
+# depth the builder reuses the normal-continuation copy for every exit
+# kind (an over-approximation no real code in this tree reaches).
+_MAX_FINALLY_DEPTH = 8
+
+
+class Node:
+    """One executed statement (or a synthetic entry/exit/raise node).
+    A statement can be wrapped by SEVERAL nodes when it sits in a
+    `finally` body (one copy per continuation)."""
+
+    __slots__ = ('stmt', 'kind', 'succs', 'index')
+
+    def __init__(self, stmt: Optional[ast.stmt], kind: str,
+                 index: int) -> None:
+        self.stmt = stmt
+        self.kind = kind          # 'entry' | 'exit' | 'raise' | 'stmt'
+        self.succs: List[Tuple['Node', str]] = []
+        self.index = index        # creation order; stable for sorting
+
+    @property
+    def lineno(self) -> int:
+        return getattr(self.stmt, 'lineno', 0)
+
+    def add(self, target: Optional['Node'], kind: str) -> None:
+        if target is None:
+            return
+        for t, k in self.succs:
+            if t is target and k == kind:
+                return
+        self.succs.append((target, kind))
+
+    def __repr__(self) -> str:  # pragma: no cover — debugging aid
+        what = (f'{type(self.stmt).__name__}@{self.lineno}'
+                if self.stmt is not None else self.kind)
+        return f'<Node {self.index} {what}>'
+
+
+class CFG:
+    def __init__(self, fn: ast.AST) -> None:
+        self.fn = fn
+        self.nodes: List[Node] = []
+        self.entry = self._node(None, 'entry')
+        self.exit = self._node(None, 'exit')
+        self.raise_exit = self._node(None, 'raise')
+        self._by_stmt: Dict[int, List[Node]] = {}
+        self._cyclic: Optional[Set[int]] = None
+
+    def _node(self, stmt: Optional[ast.stmt], kind: str = 'stmt'
+              ) -> Node:
+        n = Node(stmt, kind, len(self.nodes))
+        self.nodes.append(n)
+        if stmt is not None:
+            self._by_stmt.setdefault(id(stmt), []).append(n)
+        return n
+
+    def nodes_for(self, stmt: ast.stmt) -> List[Node]:
+        """Every node wrapping `stmt` (finally bodies duplicate)."""
+        return self._by_stmt.get(id(stmt), [])
+
+    def terminals(self) -> Tuple[Node, Node]:
+        return self.exit, self.raise_exit
+
+    # -- cycle queries (loop back edges) ---------------------------------
+
+    def cyclic_nodes(self) -> Set[int]:
+        """Indices of nodes on some cycle (loop bodies): SCCs of size
+        > 1 plus self-loops, via iterative Tarjan."""
+        if self._cyclic is not None:
+            return self._cyclic
+        index_of: Dict[int, int] = {}
+        low: Dict[int, int] = {}
+        on_stack: Set[int] = set()
+        stack: List[int] = []
+        cyclic: Set[int] = set()
+        counter = [0]
+
+        for root in self.nodes:
+            if root.index in index_of:
+                continue
+            work: List[Tuple[Node, int]] = [(root, 0)]
+            while work:
+                node, si = work[-1]
+                if si == 0:
+                    index_of[node.index] = low[node.index] = counter[0]
+                    counter[0] += 1
+                    stack.append(node.index)
+                    on_stack.add(node.index)
+                recursed = False
+                succs = node.succs
+                while si < len(succs):
+                    child = succs[si][0]
+                    si += 1
+                    if child.index not in index_of:
+                        work[-1] = (node, si)
+                        work.append((child, 0))
+                        recursed = True
+                        break
+                    if child.index in on_stack:
+                        low[node.index] = min(low[node.index],
+                                              index_of[child.index])
+                if recursed:
+                    continue
+                work[-1] = (node, si)
+                if si >= len(succs):
+                    work.pop()
+                    if low[node.index] == index_of[node.index]:
+                        comp: List[int] = []
+                        while True:
+                            w = stack.pop()
+                            on_stack.discard(w)
+                            comp.append(w)
+                            if w == node.index:
+                                break
+                        if len(comp) > 1:
+                            cyclic.update(comp)
+                        elif any(t.index == node.index
+                                 for t, _ in node.succs):
+                            cyclic.add(node.index)
+                    if work:
+                        parent = work[-1][0]
+                        low[parent.index] = min(low[parent.index],
+                                                low[node.index])
+        self._cyclic = cyclic
+        return cyclic
+
+
+class _Frame:
+    """Where control goes from inside the statement list being built:
+    fall-through, break, continue, return, and raised exceptions."""
+
+    __slots__ = ('follow', 'brk', 'cont', 'ret', 'exc', 'fin_depth')
+
+    def __init__(self, follow: Node, brk: Optional[Node],
+                 cont: Optional[Node], ret: Node, exc: Node,
+                 fin_depth: int = 0) -> None:
+        self.follow = follow
+        self.brk = brk
+        self.cont = cont
+        self.ret = ret
+        self.exc = exc
+        self.fin_depth = fin_depth
+
+    def at(self, **kw) -> '_Frame':
+        f = _Frame(self.follow, self.brk, self.cont, self.ret,
+                   self.exc, self.fin_depth)
+        for k, v in kw.items():
+            setattr(f, k, v)
+        return f
+
+
+# Builtins whose calls the graph treats as non-raising — `if x >
+# len(self._q):` forking an exception edge would drown resource-
+# pairing in paths no real program takes.
+_SAFE_BUILTINS = {'len', 'isinstance', 'issubclass', 'range', 'id',
+                  'hasattr'}
+
+
+def _raising_call(n: ast.AST) -> bool:
+    if isinstance(n, ast.Await):
+        return True
+    if not isinstance(n, ast.Call):
+        return False
+    return not (isinstance(n.func, ast.Name)
+                and n.func.id in _SAFE_BUILTINS)
+
+
+def _catches_all(handler: ast.ExceptHandler) -> bool:
+    """Bare `except:` or `except BaseException:` — guaranteed to
+    match, so nothing escapes the dispatch to the enclosing scope."""
+    if handler.type is None:
+        return True
+    types = handler.type.elts if isinstance(handler.type, ast.Tuple) \
+        else [handler.type]
+    for t in types:
+        name = t.attr if isinstance(t, ast.Attribute) else (
+            t.id if isinstance(t, ast.Name) else None)
+        if name == 'BaseException':
+            return True
+    return False
+
+
+def _contains_call(exprs: Iterable[Optional[ast.AST]]) -> bool:
+    for expr in exprs:
+        if expr is None:
+            continue
+        for n in ast.walk(expr):
+            if _raising_call(n):
+                return True
+    return False
+
+
+def _stmt_can_raise(stmt: ast.stmt) -> bool:
+    """Coarse: the statement contains a call/await outside any nested
+    function/lambda body (nested bodies do not run here)."""
+    stack: List[ast.AST] = [stmt]
+    while stack:
+        n = stack.pop()
+        if _raising_call(n):
+            return True
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)) and n is not stmt:
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+    return False
+
+
+class _Builder:
+    def __init__(self, fn: ast.AST) -> None:
+        self.cfg = CFG(fn)
+
+    def build(self) -> CFG:
+        cfg = self.cfg
+        frame = _Frame(follow=cfg.exit, brk=None, cont=None,
+                       ret=cfg.exit, exc=cfg.raise_exit)
+        first = self._stmts(self.cfg.fn.body, frame)
+        cfg.entry.add(first, NORMAL)
+        return cfg
+
+    def _stmts(self, stmts: Sequence[ast.stmt], frame: _Frame) -> Node:
+        nxt = frame.follow
+        for stmt in reversed(stmts):
+            nxt = self._stmt(stmt, frame.at(follow=nxt))
+        return nxt
+
+    def _stmt(self, stmt: ast.stmt, frame: _Frame) -> Node:
+        if isinstance(stmt, ast.If):
+            return self._if(stmt, frame)
+        if isinstance(stmt, (ast.While,)):
+            return self._while(stmt, frame)
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            return self._for(stmt, frame)
+        if isinstance(stmt, ast.Try) or (
+                hasattr(ast, 'TryStar')
+                and isinstance(stmt, getattr(ast, 'TryStar'))):
+            return self._try(stmt, frame)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return self._with(stmt, frame)
+        if isinstance(stmt, ast.Return):
+            node = self.cfg._node(stmt)
+            if _contains_call([stmt.value]):
+                node.add(frame.exc, EXCEPTION)
+            node.add(frame.ret, NORMAL)
+            return node
+        if isinstance(stmt, ast.Raise):
+            node = self.cfg._node(stmt)
+            node.add(frame.exc, EXCEPTION)
+            return node
+        if isinstance(stmt, ast.Break):
+            node = self.cfg._node(stmt)
+            node.add(frame.brk or frame.follow, NORMAL)
+            return node
+        if isinstance(stmt, ast.Continue):
+            node = self.cfg._node(stmt)
+            node.add(frame.cont or frame.follow, NORMAL)
+            return node
+        if isinstance(stmt, ast.Assert):
+            node = self.cfg._node(stmt)
+            node.add(frame.follow, NORMAL)
+            node.add(frame.exc, EXCEPTION)
+            return node
+        if hasattr(ast, 'Match') and isinstance(
+                stmt, getattr(ast, 'Match')):
+            return self._match(stmt, frame)
+        # Simple statement (incl. nested def/class, import, expr,
+        # assignments, global/nonlocal, pass, delete).
+        node = self.cfg._node(stmt)
+        node.add(frame.follow, NORMAL)
+        if _stmt_can_raise(stmt):
+            node.add(frame.exc, EXCEPTION)
+        return node
+
+    def _if(self, stmt: ast.If, frame: _Frame) -> Node:
+        node = self.cfg._node(stmt)
+        then_entry = self._stmts(stmt.body, frame)
+        else_entry = self._stmts(stmt.orelse, frame) \
+            if stmt.orelse else frame.follow
+        node.add(then_entry, NORMAL)
+        node.add(else_entry, NORMAL)
+        if _contains_call([stmt.test]):
+            node.add(frame.exc, EXCEPTION)
+        return node
+
+    def _while(self, stmt: ast.While, frame: _Frame) -> Node:
+        header = self.cfg._node(stmt)
+        orelse_entry = self._stmts(stmt.orelse, frame) \
+            if stmt.orelse else frame.follow
+        body_entry = self._stmts(
+            stmt.body,
+            frame.at(follow=header, brk=frame.follow, cont=header))
+        header.add(body_entry, NORMAL)
+        header.add(orelse_entry, NORMAL)
+        if _contains_call([stmt.test]):
+            header.add(frame.exc, EXCEPTION)
+        return header
+
+    def _for(self, stmt: ast.stmt, frame: _Frame) -> Node:
+        header = self.cfg._node(stmt)
+        orelse_entry = self._stmts(stmt.orelse, frame) \
+            if stmt.orelse else frame.follow
+        body_entry = self._stmts(
+            stmt.body,
+            frame.at(follow=header, brk=frame.follow, cont=header))
+        header.add(body_entry, NORMAL)
+        header.add(orelse_entry, NORMAL)
+        # Iterator construction/advancement can raise.
+        header.add(frame.exc, EXCEPTION)
+        return header
+
+    def _with(self, stmt: ast.stmt, frame: _Frame) -> Node:
+        header = self.cfg._node(stmt)
+        body_entry = self._stmts(stmt.body, frame)
+        header.add(body_entry, NORMAL)
+        # __enter__ / the context expressions can raise.
+        header.add(frame.exc, EXCEPTION)
+        return header
+
+    def _match(self, stmt: ast.stmt, frame: _Frame) -> Node:
+        header = self.cfg._node(stmt)
+        for case in stmt.cases:
+            header.add(self._stmts(case.body, frame), NORMAL)
+        header.add(frame.follow, NORMAL)  # no case matched
+        if _contains_call([stmt.subject]):
+            header.add(frame.exc, EXCEPTION)
+        return header
+
+    def _try(self, stmt: ast.stmt, frame: _Frame) -> Node:
+        if stmt.finalbody:
+            depth = frame.fin_depth + 1
+            if depth > _MAX_FINALLY_DEPTH:
+                # Pathological nesting: stop duplicating, route every
+                # continuation through one copy (over-approximation).
+                fin = self._stmts(stmt.finalbody,
+                                  frame.at(fin_depth=depth))
+                inner = frame.at(follow=fin, exc=fin, ret=fin,
+                                 brk=fin if frame.brk else None,
+                                 cont=fin if frame.cont else None,
+                                 fin_depth=depth)
+                return self._try_core(stmt, inner, frame)
+            base = frame.at(fin_depth=depth)
+            fin_follow = self._stmts(stmt.finalbody, base)
+            fin_exc = self._stmts(stmt.finalbody,
+                                  base.at(follow=frame.exc))
+            fin_ret = self._stmts(stmt.finalbody,
+                                  base.at(follow=frame.ret))
+            fin_brk = self._stmts(stmt.finalbody,
+                                  base.at(follow=frame.brk)) \
+                if frame.brk is not None else None
+            fin_cont = self._stmts(stmt.finalbody,
+                                   base.at(follow=frame.cont)) \
+                if frame.cont is not None else None
+            inner = frame.at(follow=fin_follow, exc=fin_exc,
+                             ret=fin_ret, brk=fin_brk, cont=fin_cont,
+                             fin_depth=depth)
+            return self._try_core(stmt, inner, frame)
+        return self._try_core(stmt, frame, frame)
+
+    def _try_core(self, stmt: ast.stmt, inner: _Frame,
+                  outer: _Frame) -> Node:
+        """Build try/except/else with `inner` as the continuation set
+        (already routed through finally copies when one exists)."""
+        # Unmatched-exception dispatch: raising statements in the try
+        # body reach each handler, and — no handler guaranteed to
+        # match — continue to the enclosing handler too.
+        if stmt.handlers:
+            disp = self.cfg._node(None, 'dispatch')
+            for handler in stmt.handlers:
+                h_entry = self._stmts(handler.body, inner)
+                disp.add(h_entry, NORMAL)
+            if not any(_catches_all(h) for h in stmt.handlers):
+                disp.add(inner.exc, EXCEPTION)
+            body_exc: Node = disp
+        else:
+            body_exc = inner.exc
+        orelse_entry = self._stmts(stmt.orelse, inner) \
+            if stmt.orelse else inner.follow
+        body_entry = self._stmts(
+            stmt.body, inner.at(follow=orelse_entry, exc=body_exc))
+        return body_entry
+
+
+def build(fn: ast.AST) -> CFG:
+    """CFG for one FunctionDef/AsyncFunctionDef (or Lambda: trivial).
+    Nested function bodies are opaque single statements — ask for
+    their own CFG."""
+    global BUILD_CALLS
+    BUILD_CALLS += 1
+    if isinstance(fn, ast.Lambda):
+        cfg = CFG(fn)
+        cfg.entry.add(cfg.exit, NORMAL)
+        return cfg
+    return _Builder(fn).build()

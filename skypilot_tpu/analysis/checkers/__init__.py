@@ -1,7 +1,11 @@
 """Built-in checkers. Importing this package registers all of them."""
 from skypilot_tpu.analysis.checkers import async_discipline  # noqa: F401
+from skypilot_tpu.analysis.checkers import donation_discipline  # noqa: F401
 from skypilot_tpu.analysis.checkers import env_registry  # noqa: F401
 from skypilot_tpu.analysis.checkers import fault_points  # noqa: F401
+from skypilot_tpu.analysis.checkers import host_sync_budget  # noqa: F401
+from skypilot_tpu.analysis.checkers import lock_coverage  # noqa: F401
 from skypilot_tpu.analysis.checkers import lock_discipline  # noqa: F401
 from skypilot_tpu.analysis.checkers import metrics_names  # noqa: F401
+from skypilot_tpu.analysis.checkers import resource_pairing  # noqa: F401
 from skypilot_tpu.analysis.checkers import trace_safety  # noqa: F401

@@ -81,8 +81,7 @@ class AsyncDisciplineChecker(Checker):
     description = ('no blocking calls inside async def; no leak-prone '
                    'bare asyncio.gather fan-outs')
 
-    def check_file(self, path: str, rel: str, tree: ast.AST,
-                   source: str) -> Iterable[Finding]:
+    def check_file(self, pf: core.ParsedFile) -> Iterable[Finding]:
         findings: List[Finding] = []
         seen: Set[int] = set()
 
@@ -90,12 +89,9 @@ class AsyncDisciplineChecker(Checker):
             if (node.lineno, rule) in seen:
                 return
             seen.add((node.lineno, rule))
-            findings.append(Finding(
-                check=self.name, rule=rule, path=rel,
-                line=node.lineno, message=message,
-                snippet=core.source_line(source, node.lineno)))
+            findings.append(pf.finding(self.name, rule, node, message))
 
-        for fn in ast.walk(tree):
+        for fn in ast.walk(pf.tree):
             if not isinstance(fn, ast.AsyncFunctionDef):
                 continue
             for node in _async_body_nodes(fn):

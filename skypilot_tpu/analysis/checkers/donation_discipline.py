@@ -1,0 +1,321 @@
+"""donation-discipline: a buffer donated to a jit call is DEAD until
+rebound; any later read on a downstream path is a use-after-free.
+
+With `donate_argnums`/`donate_argnames`, XLA is free to alias the
+donated input's memory for the outputs — reading the Python handle
+afterwards observes whatever the kernel scribbled there (on TPU:
+garbage that often LOOKS plausible; the PR 13 dual-cache lesson was
+exactly this, fixed by threading the returned cache back instead of
+touching the argument again).
+
+Statically: collect the file's donating callables —
+
+  @functools.partial(jax.jit, donate_argnums=(0,))
+  def step_fn(cache, x): ...
+  fast = jax.jit(step_fn, donate_argnums=(0,))
+
+— then at every bare-name call site of one, resolve the donated
+argument expressions (name or attribute chain: `cache`,
+`self.state.cache`) and walk the CFG forward from the call statement.
+A statement that rebinds the chain (or a prefix — rebinding
+`self.state` rebinds `self.state.cache`) kills the walk on that path;
+a statement that READS the chain (or anything under it) first flags
+`use-after-donate`. The donating statement itself rebinding the chain
+(`cache = fast(cache, x)`) is the blessed pattern and exempt, unless
+a loop back-edge brings execution back to it with the chain still
+dead.
+"""
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, \
+    Tuple
+
+from skypilot_tpu.analysis import core, dataflow
+from skypilot_tpu.analysis.core import Checker, Finding, register
+
+
+class _Donor:
+    """One donating callable: positional indices and keyword names
+    whose call-site arguments die."""
+
+    __slots__ = ('argnums', 'argnames')
+
+    def __init__(self, argnums: Set[int], argnames: Set[str]) -> None:
+        self.argnums = argnums
+        self.argnames = argnames
+
+
+def _literal_ints(node: ast.AST) -> Set[int]:
+    out: Set[int] = set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        out.add(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value,
+                                                          int):
+                out.add(e.value)
+    return out
+
+
+def _literal_strs(node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.add(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value,
+                                                          str):
+                out.add(e.value)
+    return out
+
+
+def _donation_kwargs(call: ast.Call) -> Optional[_Donor]:
+    argnums: Set[int] = set()
+    argnames: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == 'donate_argnums':
+            argnums |= _literal_ints(kw.value)
+        elif kw.arg == 'donate_argnames':
+            argnames |= _literal_strs(kw.value)
+    if argnums or argnames:
+        return _Donor(argnums, argnames)
+    return None
+
+
+def _is_jit(func: ast.AST) -> bool:
+    name = core.dotted_name(func)
+    if name is None:
+        return False
+    parts = name.split('.')
+    return parts[-1] in ('jit', 'pjit') and (
+        len(parts) == 1 or 'jax' in parts or 'pjit' in parts[:-1])
+
+
+def collect_donors(tree: ast.AST) -> Dict[str, _Donor]:
+    """name -> donation spec, for names callable in this file."""
+    donors: Dict[str, _Donor] = {}
+    for node in ast.walk(tree):
+        # @functools.partial(jax.jit, donate_argnums=...) / @jax.jit(...)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                if not isinstance(deco, ast.Call):
+                    continue
+                deco_name = core.dotted_name(deco.func)
+                is_partial_jit = (
+                    deco_name in ('functools.partial', 'partial')
+                    and deco.args and _is_jit(deco.args[0]))
+                if is_partial_jit or _is_jit(deco.func):
+                    donor = _donation_kwargs(deco)
+                    if donor is not None:
+                        donors[node.name] = donor
+        # fast = jax.jit(fn, donate_argnums=...)
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Call):
+            call = node.value
+            inner: Optional[ast.Call] = None
+            if _is_jit(call.func):
+                inner = call
+            elif (core.dotted_name(call.func) in ('functools.partial',
+                                                  'partial')
+                  and call.args and _is_jit(call.args[0])):
+                inner = call
+            if inner is None:
+                continue
+            donor = _donation_kwargs(inner)
+            if donor is None:
+                continue
+            for t in node.targets:
+                tname = core.dotted_name(t)
+                if tname is not None:
+                    donors[tname] = donor
+    return donors
+
+
+def _assigned_chains(stmt: ast.stmt) -> Set[str]:
+    """Dotted chains (re)bound by `stmt` — plain names and attribute
+    chains; tuple targets are unpacked."""
+    chains: Set[str] = set()
+
+    def target(t: ast.AST) -> None:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                target(e)
+            return
+        if isinstance(t, ast.Starred):
+            target(t.value)
+            return
+        name = core.dotted_name(t)
+        if name is not None:
+            chains.add(name)
+
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            target(t)
+    elif isinstance(stmt, ast.AnnAssign):
+        target(stmt.target)
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        target(stmt.target)
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                target(item.optional_vars)
+    return chains
+
+
+def _kills(chains: Set[str], dead: str) -> bool:
+    """Does rebinding any of `chains` resurrect `dead`? True when a
+    chain equals the dead chain or is a strict prefix of it."""
+    for c in chains:
+        if c == dead or dead.startswith(c + '.'):
+            return True
+    return False
+
+
+def _reads_of(stmt: ast.stmt, dead: str,
+              skip_call: Optional[ast.Call] = None) -> List[ast.AST]:
+    """Load-context references to `dead` (or anything under it) in the
+    expressions `stmt` evaluates. `skip_call` exempts the donating
+    call's own arguments (they are the donation, not a use-after)."""
+    hits: List[ast.AST] = []
+    stack: List[ast.AST] = list(_scan_roots(stmt))
+    while stack:
+        node = stack.pop()
+        if node is skip_call:
+            continue
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, (ast.Name, ast.Attribute)) and isinstance(
+                getattr(node, 'ctx', None), ast.Load):
+            name = core.dotted_name(node)
+            if name is not None and (name == dead
+                                     or name.startswith(dead + '.')):
+                hits.append(node)
+                continue  # children are part of the same chain
+        stack.extend(ast.iter_child_nodes(node))
+    return hits
+
+
+def _scan_roots(stmt: ast.stmt) -> List[ast.AST]:
+    """Expressions this statement's CFG node evaluates (headers only
+    for compound statements)."""
+    if isinstance(stmt, ast.If):
+        return [stmt.test]
+    if isinstance(stmt, ast.While):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [item.context_expr for item in stmt.items]
+    if isinstance(stmt, ast.Try) or (
+            hasattr(ast, 'TryStar')
+            and isinstance(stmt, getattr(ast, 'TryStar'))):
+        return []
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return []
+    return [stmt]
+
+
+def _own_statements(fn: ast.AST) -> Iterable[ast.stmt]:
+    """Statements executed in `fn`'s own frame (nested defs opaque)."""
+    stack: List[ast.stmt] = list(fn.body)
+    while stack:
+        stmt = stack.pop()
+        yield stmt
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for field in ('body', 'orelse', 'finalbody'):
+            stack.extend(getattr(stmt, field, ()))
+        for handler in getattr(stmt, 'handlers', ()):
+            stack.extend(handler.body)
+        for case in getattr(stmt, 'cases', ()):
+            stack.extend(case.body)
+
+
+@register
+class DonationDisciplineChecker(Checker):
+    name = 'donation-discipline'
+    description = ('arguments donated to a jit call are dead until '
+                   'rebound; downstream reads flag')
+
+    def check_file(self, pf: core.ParsedFile) -> Iterable[Finding]:
+        donors = collect_donors(pf.tree)
+        if not donors:
+            return ()
+        findings: List[Finding] = []
+        for fn in ast.walk(pf.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(self._check_fn(pf, fn, donors))
+        return findings
+
+    def _check_fn(self, pf: core.ParsedFile, fn: ast.AST,
+                  donors: Dict[str, _Donor]) -> Iterable[Finding]:
+        sites: List[Tuple[ast.stmt, ast.Call, str]] = []
+        for stmt in _own_statements(fn):
+            for node in _scan_roots(stmt):
+                for sub in ast.walk(node):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    callee = core.dotted_name(sub.func)
+                    donor = donors.get(callee or '')
+                    if donor is None:
+                        continue
+                    for dead in self._donated_chains(sub, donor):
+                        sites.append((stmt, sub, dead))
+        if not sites:
+            return
+
+        graph: Optional[object] = None
+        reported: Set[Tuple[int, str]] = set()
+        for stmt, call, dead in sites:
+            # `cache = fast(cache, x)` — the donating statement itself
+            # rebinds the chain, so it is alive again at every
+            # successor (including its own loop back edge). Nothing
+            # downstream can read the dead handle.
+            if _kills(_assigned_chains(stmt), dead):
+                continue
+            if graph is None:
+                graph = pf.cfg(fn)
+            for start in graph.nodes_for(stmt):
+                # Walk the call statement's SUCCESSORS: the donating
+                # statement's own argument reads are the donation.
+                for node in dataflow.forward_reach(
+                        start,
+                        stop=lambda n: n.stmt is not None and _kills(
+                            _assigned_chains(n.stmt), dead)):
+                    if node.stmt is None:
+                        continue
+                    # Reaching the donating statement AGAIN (loop
+                    # back edge) donates an already-dead buffer — its
+                    # argument reads are genuine findings, so no
+                    # skip_call here.
+                    reads = _reads_of(node.stmt, dead)
+                    if not reads:
+                        continue
+                    key = (node.stmt.lineno, dead)
+                    if key in reported:
+                        continue
+                    reported.add(key)
+                    yield pf.finding(
+                        self.name, 'use-after-donate', node.stmt,
+                        f'`{dead}` was donated to `'
+                        f'{core.dotted_name(call.func)}` on line '
+                        f'{stmt.lineno} (donate_argnums aliases its '
+                        'buffer for the outputs) and is read here '
+                        'before being rebound — thread the returned '
+                        'value instead of the dead handle')
+
+    @staticmethod
+    def _donated_chains(call: ast.Call, donor: _Donor) -> List[str]:
+        chains: List[str] = []
+        for i in donor.argnums:
+            if i < len(call.args):
+                name = core.dotted_name(call.args[i])
+                if name is not None:
+                    chains.append(name)
+        for kw in call.keywords:
+            if kw.arg in donor.argnames:
+                name = core.dotted_name(kw.value)
+                if name is not None:
+                    chains.append(name)
+        return chains

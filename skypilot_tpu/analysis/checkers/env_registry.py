@@ -109,20 +109,17 @@ class EnvRegistryChecker(Checker):
     description = ('SKYTPU_* vars declared once in envs.py and read '
                    'at call time through the registry')
 
-    def check_file(self, path: str, rel: str, tree: ast.AST,
-                   source: str) -> Iterable[Finding]:
+    def check_file(self, pf: core.ParsedFile) -> Iterable[Finding]:
+        tree = pf.tree
         findings: List[Finding] = []
-        rel_posix = rel.replace('\\', '/')
+        rel_posix = pf.rel.replace('\\', '/')
         in_registry = (rel_posix.endswith(_REGISTRY_REL)
                        or rel_posix == 'envs.py')
         declared = _declared_names()
         doc_lines = _docstring_linenos(tree)
 
         def emit(node: ast.AST, rule: str, message: str) -> None:
-            findings.append(Finding(
-                check=self.name, rule=rule, path=rel,
-                line=node.lineno, message=message,
-                snippet=core.source_line(source, node.lineno)))
+            findings.append(pf.finding(self.name, rule, node, message))
 
         # import-time-read: anything env-shaped at module scope.
         for node in _module_scope_nodes(tree):

@@ -10,9 +10,10 @@ tier-1 test names survive.
 """
 import os
 import re
-from typing import Iterable, List, Sequence
+from typing import Iterable, List
 
-from skypilot_tpu.analysis.core import Checker, Finding, register
+from skypilot_tpu.analysis.core import Checker, Finding, Project, \
+    register
 
 _CATALOG = 'skypilot_tpu/resilience/faults.py'
 _GUIDE = os.path.join('docs', 'guides', 'resilience.md')
@@ -21,7 +22,8 @@ _GUIDE = os.path.join('docs', 'guides', 'resilience.md')
 def findings_for_rule(rule: str, root: str) -> List[Finding]:
     """All findings for one sub-rule (the thin test wrappers key off
     this)."""
-    return [f for f in FaultPointsChecker().check_project(root, ())
+    project = Project(root=root, files=[])
+    return [f for f in FaultPointsChecker().check_project(project)
             if f.rule == rule]
 
 
@@ -32,9 +34,9 @@ class FaultPointsChecker(Checker):
                    'documentation contract over the registered '
                    'catalog')
 
-    def check_project(self, root: str,
-                      files: Sequence[str]) -> Iterable[Finding]:
+    def check_project(self, project: Project) -> Iterable[Finding]:
         from skypilot_tpu.resilience import faults
+        root = project.root
 
         findings: List[Finding] = []
 

@@ -1,0 +1,165 @@
+"""host-sync-budget: device->host syncs on annotated hot paths stay
+within a declared per-function budget.
+
+Opt-in via annotation — on the `def` line or the line directly above
+it:
+
+    # skytpu-lint: hot-path[1]
+    def step(self): ...
+
+Every device->host synchronization point inside the function then
+counts against budget N along the WORST single execution path (CFG
+acyclic max-path — branches don't double count, `if/else` with one
+sync per arm costs 1, not 2):
+
+  sync-budget   the worst path through the function performs more
+                than N syncs — the PR 13 regression class (engine
+                step must drain tokens+logprobs+emitted in exactly
+                ONE jax.device_get; the runtime transfer-count tests
+                catch it on the live path, this catches it in review).
+  sync-in-loop  a sync inside a loop body: per-iteration cost is
+                unbounded, no budget covers it.
+
+What counts as a sync: jax.device_get, .item()/.tolist(),
+.block_until_ready(), np.asarray/np.array on a non-literal, and
+bool() of an array-shaped expression (name/attribute/subscript —
+`bool(mask)` forces the value to host; `bool(flag_int)` inside a
+hot-path function is noise worth renaming). Nested function bodies
+are not counted — they do not run in this frame.
+"""
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from skypilot_tpu.analysis import core, dataflow
+from skypilot_tpu.analysis.core import Checker, Finding, register
+
+HOT_PATH_RE = re.compile(r'skytpu-lint:\s*hot-path\[(\d+)\]')
+
+_SYNC_METHODS = {'item', 'tolist', 'block_until_ready'}
+_NUMPY_COERCIONS = {'np.asarray', 'np.array', 'numpy.asarray',
+                    'numpy.array'}
+
+
+def _sync_exprs(exprs: Iterable[ast.AST]) -> List[ast.AST]:
+    """Sync points among `exprs` (nested function bodies excluded)."""
+    out: List[ast.AST] = []
+    stack: List[ast.AST] = [e for e in exprs if e is not None]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+        if not isinstance(node, ast.Call):
+            continue
+        name = core.dotted_name(node.func)
+        if name is not None and name.split('.')[-1] == 'device_get':
+            out.append(node)
+        elif isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _SYNC_METHODS:
+            out.append(node)
+        elif name in _NUMPY_COERCIONS:
+            if node.args and not isinstance(node.args[0],
+                                            ast.Constant):
+                out.append(node)
+        elif name == 'bool' and len(node.args) == 1 and isinstance(
+                node.args[0], (ast.Name, ast.Attribute,
+                               ast.Subscript)):
+            out.append(node)
+    return out
+
+
+def _stmt_scan_exprs(stmt: ast.stmt) -> List[ast.AST]:
+    """The expressions a CFG node for `stmt` actually evaluates: the
+    whole statement when simple, only the header when compound (the
+    body belongs to other nodes)."""
+    if isinstance(stmt, ast.If):
+        return [stmt.test]
+    if isinstance(stmt, ast.While):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [item.context_expr for item in stmt.items]
+    if isinstance(stmt, ast.Try) or (
+            hasattr(ast, 'TryStar')
+            and isinstance(stmt, getattr(ast, 'TryStar'))):
+        return []
+    if hasattr(ast, 'Match') and isinstance(stmt,
+                                            getattr(ast, 'Match')):
+        return [stmt.subject]
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return []
+    return [stmt]
+
+
+def hot_path_budget(fn: ast.AST, lines: List[str]) -> Optional[int]:
+    """The declared budget N when `fn` carries a hot-path[N]
+    annotation on its def line or the line directly above."""
+    lineno = getattr(fn, 'lineno', 0)
+    for idx in (lineno - 1, lineno - 2):
+        if 0 <= idx < len(lines):
+            m = HOT_PATH_RE.search(lines[idx])
+            if m:
+                return int(m.group(1))
+    return None
+
+
+@register
+class HostSyncBudgetChecker(Checker):
+    name = 'host-sync-budget'
+    description = ('device->host syncs on `# skytpu-lint: hot-path[N]`'
+                   ' functions stay within the declared budget')
+
+    def check_file(self, pf: core.ParsedFile) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for fn in ast.walk(pf.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            budget = hot_path_budget(fn, pf.lines)
+            if budget is None:
+                continue
+            findings.extend(self._check_fn(pf, fn, budget))
+        return findings
+
+    def _check_fn(self, pf: core.ParsedFile, fn: ast.AST,
+                  budget: int) -> Iterable[Finding]:
+        graph = pf.cfg(fn)
+        weight: Dict[int, int] = {}
+        sync_stmts: Dict[int, ast.stmt] = {}
+        for node in graph.nodes:
+            if node.stmt is None:
+                continue
+            syncs = _sync_exprs(_stmt_scan_exprs(node.stmt))
+            if syncs:
+                weight[node.index] = len(syncs)
+                sync_stmts[node.index] = node.stmt
+        if not weight:
+            return
+
+        cyclic = graph.cyclic_nodes()
+        looped: Set[int] = set()  # stmt ids already reported
+        for idx, stmt in sync_stmts.items():
+            if idx in cyclic and id(stmt) not in looped:
+                looped.add(id(stmt))
+                yield pf.finding(
+                    self.name, 'sync-in-loop', stmt,
+                    f'device->host sync inside a loop in hot-path '
+                    f'`{fn.name}`: per-iteration cost is unbounded — '
+                    'hoist the sync out of the loop (batch the '
+                    'transfer) or drop the hot-path annotation')
+
+        total, witness = dataflow.max_weight_path(graph, weight)
+        if total > budget:
+            sync_lines = sorted({n.lineno for n in witness})
+            yield pf.finding(
+                self.name, 'sync-budget', fn,
+                f'hot-path `{fn.name}` declares budget '
+                f'{budget} but its worst path performs {total} '
+                f'device->host sync(s) (lines '
+                f'{", ".join(map(str, sync_lines))}) — combine '
+                'transfers into one jax.device_get of a tuple, or '
+                'raise the declared budget if the cost is intended')

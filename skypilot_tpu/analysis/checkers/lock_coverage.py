@@ -1,0 +1,264 @@
+"""lock-coverage: per-class inference of which attributes a lock
+guards, then flagging mutations that skip the lock.
+
+lock-discipline covers MODULE-level lock/state pairs; this checker
+covers the threaded-CLASS pattern (SpanCollector, LoadBalancer,
+executors, adaptor caches): a class that creates `self._lock =
+threading.Lock()` and mutates shared attributes under `with
+self._lock:` has declared, implicitly, that those attributes are
+lock-guarded everywhere. The PR 16/17 bugs were exactly a mutation
+added later on a path that skipped the lock.
+
+Inference: for each class owning a Lock/RLock/Condition attribute,
+the GUARDED set is every `self.X` mutated (assigned, aug-assigned,
+deleted, or hit with a mutator method like .append/.pop/.update)
+inside any `with self.<lock>:` body in the class. A mutation of a
+guarded attribute elsewhere must then be covered by one of:
+
+  * lexical containment in a `with self.<lock>:` body
+  * the enclosing method being named `*_locked` (the repo's
+    caller-holds-the-lock convention)
+  * `__init__`/`__new__` (the object is not yet shared)
+  * flow-sensitive coverage: a `self.<lock>.acquire()` dominating the
+    mutation with no intervening release (must-hold dataflow over the
+    method's CFG — the try/finally acquire pattern)
+
+Everything else flags `unguarded-mutation`. Single-threaded-by-
+construction classes (EngineLoop's queue ownership) simply have no
+lock attribute and are never visited.
+"""
+import ast
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, \
+    Tuple
+
+from skypilot_tpu.analysis import core, dataflow
+from skypilot_tpu.analysis.core import Checker, Finding, register
+
+_LOCK_TYPES = {'Lock', 'RLock', 'Condition'}
+_MUTATOR_METHODS = {'append', 'extend', 'insert', 'remove', 'pop',
+                    'clear', 'add', 'discard', 'popitem',
+                    'setdefault', 'update'}
+_EXEMPT_METHODS = {'__init__', '__new__', '__del__'}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'X' when node is exactly `self.X` (or a subscript/attribute
+    chain rooted there: self.X[k], self.X.y -> 'X')."""
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        if isinstance(node, ast.Attribute) and isinstance(
+                node.value, ast.Name) and node.value.id == 'self':
+            return node.attr
+        node = node.value
+    return None
+
+
+def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
+    locks: Set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not isinstance(node.value, ast.Call):
+            continue
+        name = core.dotted_name(node.value.func)
+        if name is None or name.split('.')[-1] not in _LOCK_TYPES:
+            continue
+        for t in node.targets:
+            attr = _self_attr(t)
+            if attr is not None:
+                locks.add(attr)
+    return locks
+
+
+def _mutations(root: ast.AST) -> List[Tuple[ast.AST, str]]:
+    """(node, attr) for every self.<attr> mutation under `root`.
+    Nested functions still mutate the same object (often from yet
+    another thread), so they are walked; only nested CLASS bodies —
+    a different `self` — are skipped."""
+    out: List[Tuple[ast.AST, str]] = []
+    stack: List[ast.AST] = [root]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.ClassDef) and node is not root:
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                targets = t.elts if isinstance(
+                    t, (ast.Tuple, ast.List)) else [t]
+                for tt in targets:
+                    # Plain rebinding `self.X = ...` of the whole
+                    # attribute is a single store; item/field writes
+                    # through it are the racy shape too.
+                    attr = _self_attr(tt)
+                    if attr is not None:
+                        out.append((node, attr))
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            attr = _self_attr(node.target)
+            if attr is not None and (
+                    not isinstance(node, ast.AnnAssign)
+                    or node.value is not None):
+                out.append((node, attr))
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    out.append((node, attr))
+        elif isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute) and \
+                node.func.attr in _MUTATOR_METHODS:
+            attr = _self_attr(node.func.value)
+            if attr is not None:
+                out.append((node, attr))
+    return out
+
+
+def _with_locks(stmt: ast.AST, locks: Set[str]) -> Set[str]:
+    """Lock attrs entered by a With statement's items."""
+    held: Set[str] = set()
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            attr = _self_attr(item.context_expr)
+            if attr in locks:
+                held.add(attr)
+    return held
+
+
+def _lexically_locked(node: ast.AST, locks: Set[str]) -> bool:
+    cur = getattr(node, 'skytpu_parent', None)
+    while cur is not None:
+        if _with_locks(cur, locks):
+            return True
+        cur = getattr(cur, 'skytpu_parent', None)
+    return False
+
+
+def _enclosing_method(node: ast.AST) -> Optional[ast.AST]:
+    cur = getattr(node, 'skytpu_parent', None)
+    while cur is not None and not isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        cur = getattr(cur, 'skytpu_parent', None)
+    return cur
+
+
+def _lock_call_attr(stmt: ast.stmt, locks: Set[str],
+                    verb: str) -> FrozenSet[str]:
+    """Lock attrs on which `stmt` calls self.<lock>.<verb>()."""
+    hit: Set[str] = set()
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute) and node.func.attr == verb:
+            attr = _self_attr(node.func.value)
+            if attr in locks:
+                hit.add(attr)
+    return frozenset(hit)
+
+
+@register
+class LockCoverageChecker(Checker):
+    name = 'lock-coverage'
+    description = ('attributes a class mutates under `with self._lock:`'
+                   ' are mutated under it everywhere')
+
+    def check_file(self, pf: core.ParsedFile) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for cls in ast.walk(pf.tree):
+            if isinstance(cls, ast.ClassDef):
+                findings.extend(self._check_class(pf, cls))
+        return findings
+
+    def _check_class(self, pf: core.ParsedFile,
+                     cls: ast.ClassDef) -> Iterable[Finding]:
+        locks = _lock_attrs(cls)
+        if not locks:
+            return
+        guarded: Set[str] = set()
+        for node in ast.walk(cls):
+            if _with_locks(node, locks):
+                for _, attr in _mutations(node):
+                    guarded.add(attr)
+        guarded -= locks
+        if not guarded:
+            return
+
+        # Flow-held cache: method node -> must-hold state (built only
+        # for methods that call .acquire() on a class lock).
+        held_cache: Dict[int, Optional[Dict[int, FrozenSet[str]]]] = {}
+
+        reported: Set[Tuple[int, str]] = set()
+        for method in cls.body:
+            if not isinstance(method, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                continue
+            if method.name in _EXEMPT_METHODS or \
+                    method.name.endswith('_locked'):
+                continue
+            for node, attr in _mutations(method):
+                if attr not in guarded:
+                    continue
+                if _lexically_locked(node, locks):
+                    continue
+                if self._flow_held(pf, method, node, locks,
+                                   held_cache):
+                    continue
+                key = (node.lineno, attr)
+                if key in reported:
+                    continue
+                reported.add(key)
+                yield pf.finding(
+                    self.name, 'unguarded-mutation', node,
+                    f'`self.{attr}` is lock-guarded in '
+                    f'`{cls.name}` (mutated under `with self.'
+                    f'{sorted(locks)[0]}:` elsewhere) but mutated '
+                    f'here in `{method.name}` without the lock — '
+                    'take the lock, or rename the method *_locked '
+                    'if every caller already holds it')
+
+    def _flow_held(self, pf: core.ParsedFile, method: ast.AST,
+                   node: ast.AST, locks: Set[str],
+                   cache: Dict[int, Optional[Dict[int,
+                                                  FrozenSet[str]]]],
+                   ) -> bool:
+        """Is some class lock guaranteed held at `node` via explicit
+        acquire()/release() calls (the try/finally pattern)?"""
+        key = id(method)
+        if key not in cache:
+            uses_acquire = any(
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == 'acquire'
+                and _self_attr(n.func.value) in locks
+                for n in ast.walk(method))
+            if not uses_acquire:
+                cache[key] = None
+            else:
+                graph = pf.cfg(method)
+                state = dataflow.must_hold(
+                    graph,
+                    acquires=lambda nd: _lock_call_attr(
+                        nd.stmt, locks, 'acquire')
+                    if nd.stmt is not None else frozenset(),
+                    releases=lambda nd: _lock_call_attr(
+                        nd.stmt, locks, 'release')
+                    if nd.stmt is not None else frozenset(),
+                    universe=frozenset(locks))
+                # Collapse to stmt-id -> held (any CFG copy).
+                by_stmt: Dict[int, FrozenSet[str]] = {}
+                for g_node in graph.nodes:
+                    if g_node.stmt is None:
+                        continue
+                    prev = by_stmt.get(id(g_node.stmt))
+                    cur = state[g_node.index]
+                    by_stmt[id(g_node.stmt)] = (
+                        cur if prev is None else (prev & cur))
+                cache[key] = by_stmt
+        by_stmt = cache[key]
+        if by_stmt is None:
+            return False
+        stmt = pf.statement_of(node)
+        if stmt is None:
+            return False
+        held = by_stmt.get(id(stmt), frozenset())
+        # The acquiring statement itself: held-on-entry is empty but
+        # the mutation runs after acquire() only if it IS the acquire
+        # statement — rare; treat entry-state as the answer.
+        return bool(held)

@@ -82,8 +82,8 @@ class LockDisciplineChecker(Checker):
     description = ('shared module state (sqlite writes, globals) '
                    'mutated only under the module lock')
 
-    def check_file(self, path: str, rel: str, tree: ast.AST,
-                   source: str) -> Iterable[Finding]:
+    def check_file(self, pf: core.ParsedFile) -> Iterable[Finding]:
+        tree = pf.tree
         if not isinstance(tree, ast.Module):
             return ()
         locks = _module_locks(tree)
@@ -92,10 +92,7 @@ class LockDisciplineChecker(Checker):
         findings: List[Finding] = []
 
         def emit(node: ast.AST, rule: str, message: str) -> None:
-            findings.append(Finding(
-                check=self.name, rule=rule, path=rel,
-                line=node.lineno, message=message,
-                snippet=core.source_line(source, node.lineno)))
+            findings.append(pf.finding(self.name, rule, node, message))
 
         for node in ast.walk(tree):
             if isinstance(node, ast.Call) and isinstance(

@@ -10,9 +10,10 @@ existing tier-1 test names survive.
 """
 import math
 import re
-from typing import Iterable, List, Sequence
+from typing import Iterable, List
 
-from skypilot_tpu.analysis.core import Checker, Finding, register
+from skypilot_tpu.analysis.core import Checker, Finding, Project, \
+    register
 
 _NAME_RE = re.compile(r'^skytpu_[a-z0-9_]+$')
 _LABEL_RE = re.compile(r'^[a-z_][a-z0-9_]*$')
@@ -22,7 +23,8 @@ _CATALOG = 'skypilot_tpu/observability/instruments.py'
 def findings_for_rule(rule: str) -> List[Finding]:
     """All findings for one sub-rule (the thin test wrappers key off
     this)."""
-    return [f for f in MetricsNamesChecker().check_project('', ())
+    project = Project(root='', files=[])
+    return [f for f in MetricsNamesChecker().check_project(project)
             if f.rule == rule]
 
 
@@ -32,8 +34,7 @@ class MetricsNamesChecker(Checker):
     description = ('skytpu_* metric naming/help/bucket/label contract '
                    'over the registered instrument catalog')
 
-    def check_project(self, root: str,
-                      files: Sequence[str]) -> Iterable[Finding]:
+    def check_project(self, project: Project) -> Iterable[Finding]:
         from skypilot_tpu.observability import \
             instruments  # noqa: F401 — registers the catalog
         from skypilot_tpu.observability import metrics

@@ -1,0 +1,251 @@
+"""resource-pairing: every acquire reaches a release on every
+outgoing path — exception edges included.
+
+The prefix cache pins pages by refcount (`RadixPrefixCache.acquire`)
+and the page allocator hands out reservations; a path that leaves the
+function without releasing or publishing them leaks the pin forever —
+under load the allocator then OOMs slots that are actually free (the
+PR 11/17 class). The flow check: from each acquire statement, can the
+function's exit — or, the case unit tests must catch, its
+RAISE exit — be reached without passing a satisfying statement?
+
+Satisfying statements, per acquire:
+
+  * a release-verb call (`release`/`free`/`free_pages`/
+    `release_pages`/`unpin`) on the SAME receiver chain
+    (`self._prefix.acquire(...)` pairs with `self._prefix.release(...)`)
+  * ownership transfer: a `return` whose value mentions the
+    acquire's bound name(s) (the caller now owns the pin), or the
+    acquire statement itself being a `return`
+  * publish: an assignment that stores a bound name into an
+    attribute/subscript (e.g. `self._slot_pages[slot] = pages` — the
+    tracked structure now owns the pages and frees them on its own
+    path)
+  * an explicit annotation on a line: `# skytpu-lint:
+    releases[<receiver>]` for hand-off shapes the matcher cannot see
+
+Lock-shaped receivers (`lock`/`sem`/`cond` in the chain) are excluded
+— lock.acquire pairing is lock-coverage's domain, and `with` handles
+it anyway.
+"""
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, \
+    Tuple
+
+from skypilot_tpu.analysis import core, dataflow
+from skypilot_tpu.analysis.core import Checker, Finding, register
+
+RELEASE_MARKER = 'skytpu-lint: releases['
+
+_ACQUIRE_VERBS = {'acquire', 'reserve', 'reserve_pages'}
+_RELEASE_VERBS = {'release', 'free', 'free_pages', 'release_pages',
+                  'unpin', 'publish'}
+_LOCKISH = ('lock', 'sem', 'cond', 'mutex')
+
+
+def _receiver_of(call: ast.Call, verbs: Set[str]) -> Optional[str]:
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    if call.func.attr not in verbs:
+        return None
+    return core.dotted_name(call.func.value)
+
+
+def _is_lockish(receiver: str) -> bool:
+    low = receiver.lower()
+    return any(token in low for token in _LOCKISH)
+
+
+def _marker_releases(line: str) -> Set[str]:
+    """Receivers named by `# skytpu-lint: releases[a, b]` on a line."""
+    start = line.find(RELEASE_MARKER)
+    if start < 0:
+        return set()
+    start += len(RELEASE_MARKER)
+    end = line.find(']', start)
+    if end < 0:
+        return set()
+    return {n.strip() for n in line[start:end].split(',') if n.strip()}
+
+
+def _walk_shallow(root: ast.AST) -> Iterable[ast.AST]:
+    """Walk skipping nested function/lambda bodies."""
+    stack: List[ast.AST] = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not root:
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _stmt_header(stmt: ast.stmt) -> List[ast.AST]:
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [item.context_expr for item in stmt.items]
+    if isinstance(stmt, ast.Try) or (
+            hasattr(ast, 'TryStar')
+            and isinstance(stmt, getattr(ast, 'TryStar'))):
+        return []
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return []
+    return [stmt]
+
+
+class _Acquire:
+    __slots__ = ('stmt', 'call', 'receiver', 'bound')
+
+    def __init__(self, stmt: ast.stmt, call: ast.Call, receiver: str,
+                 bound: Set[str]) -> None:
+        self.stmt = stmt
+        self.call = call
+        self.receiver = receiver
+        self.bound = bound  # names the acquire's result binds
+
+
+def _mentions(expr: Optional[ast.AST], names: Set[str]) -> bool:
+    if expr is None or not names:
+        return False
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name) and n.id in names:
+            return True
+    return False
+
+
+def _has_release_call(root: ast.AST, receiver: str) -> bool:
+    for sub in ast.walk(root):
+        if isinstance(sub, ast.Call):
+            recv = _receiver_of(sub, _RELEASE_VERBS)
+            if recv is not None and recv == receiver:
+                return True
+    return False
+
+
+def _satisfies(stmt: ast.stmt, acq: _Acquire,
+               line_text: str) -> bool:
+    """Does executing `stmt` discharge the acquire's obligation?"""
+    if acq.receiver in _marker_releases(line_text):
+        return True
+    # An `if` whose subtree releases the receiver counts as the
+    # discharge ATTEMPT: the guard (`if pinned:` / `if matched.pages:`)
+    # is usually correlated with whether the acquire ran at all —
+    # branch-sensitivity the CFG cannot express. The path-blindness
+    # tradeoff (a release hidden behind an unrelated rare condition
+    # also satisfies) is documented; the exception-edge cases the
+    # rule exists for never involve such a guard.
+    if isinstance(stmt, ast.If) and _has_release_call(stmt,
+                                                      acq.receiver):
+        return True
+    for node in _stmt_header(stmt):
+        if _has_release_call(node, acq.receiver):
+            return True
+        # Hand-off into a callee that takes ownership by name:
+        # cache.insert(..., pages) etc. is NOT assumed; use the
+        # releases[...] marker for those.
+    if isinstance(stmt, ast.Return) and _mentions(stmt.value,
+                                                  acq.bound):
+        return True
+    if isinstance(stmt, ast.Assign):
+        stores_tracked = any(
+            isinstance(t, (ast.Attribute, ast.Subscript))
+            for t in stmt.targets)
+        if stores_tracked and _mentions(stmt.value, acq.bound):
+            return True
+    return False
+
+
+@register
+class ResourcePairingChecker(Checker):
+    name = 'resource-pairing'
+    description = ('acquire/reserve calls reach a release/publish on '
+                   'every outgoing path, exception edges included')
+
+    def check_file(self, pf: core.ParsedFile) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for fn in ast.walk(pf.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(self._check_fn(pf, fn))
+        return findings
+
+    def _line(self, pf: core.ParsedFile, stmt: ast.stmt) -> str:
+        end = getattr(stmt, 'end_lineno', stmt.lineno)
+        return ' '.join(pf.lines[stmt.lineno - 1:end])
+
+    def _check_fn(self, pf: core.ParsedFile,
+                  fn: ast.AST) -> Iterable[Finding]:
+        acquires: List[_Acquire] = []
+        for stmt in self._own_statements(fn):
+            for root in _stmt_header(stmt):
+                for sub in ast.walk(root):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    recv = _receiver_of(sub, _ACQUIRE_VERBS)
+                    if recv is None or _is_lockish(recv):
+                        continue
+                    bound = dataflow.assigned_names(stmt)
+                    acquires.append(_Acquire(stmt, sub, recv, bound))
+        if not acquires:
+            return
+
+        graph = pf.cfg(fn)
+        for acq in acquires:
+            line_of: Dict[int, str] = {}
+
+            def satisfied(node) -> bool:
+                if node.stmt is None:
+                    return False
+                text = line_of.get(node.index)
+                if text is None:
+                    text = self._line(pf, node.stmt)
+                    line_of[node.index] = text
+                return _satisfies(node.stmt, acq, text)
+
+            # The acquire statement may itself discharge (same-line
+            # release, `return self._alloc.reserve(n)`).
+            if isinstance(acq.stmt, ast.Return) or _satisfies(
+                    acq.stmt, acq, self._line(pf, acq.stmt)):
+                continue
+            exit_node, raise_node = graph.terminals()
+            for start in graph.nodes_for(acq.stmt):
+                # The acquire's OWN exception edge is exempt: if
+                # acquire() raises, the pin was never taken.
+                hit = dataflow.reach_avoiding(
+                    start, {exit_node.index, raise_node.index},
+                    blocked=satisfied, skip_start_exception=True)
+                if hit is None:
+                    continue
+                via = ('an exception path'
+                       if hit.index == raise_node.index
+                       else 'a normal path')
+                yield pf.finding(
+                    self.name, 'unreleased-acquire', acq.stmt,
+                    f'`{acq.receiver}.{acq.call.func.attr}(...)` can '
+                    f'leave `{fn.name}` via {via} without a matching '
+                    f'release/publish on `{acq.receiver}` — wrap the '
+                    'region in try/except (releasing on error), move '
+                    'the release into a finally, or annotate the '
+                    f'hand-off line with `# skytpu-lint: '
+                    f'releases[{acq.receiver}]`')
+                break
+        return
+
+    @staticmethod
+    def _own_statements(fn: ast.AST) -> Iterable[ast.stmt]:
+        stack: List[ast.stmt] = list(fn.body)
+        while stack:
+            stmt = stack.pop()
+            yield stmt
+            if isinstance(stmt, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                continue
+            for field in ('body', 'orelse', 'finalbody'):
+                stack.extend(getattr(stmt, field, ()))
+            for handler in getattr(stmt, 'handlers', ()):
+                stack.extend(handler.body)
+            for case in getattr(stmt, 'cases', ()):
+                stack.extend(case.body)

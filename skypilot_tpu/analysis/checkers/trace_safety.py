@@ -232,9 +232,8 @@ class TraceSafetyChecker(Checker):
     description = ('host effects, tracer-to-host coercions, and '
                    'closure mutation inside jax-traced code')
 
-    def check_file(self, path: str, rel: str, tree: ast.AST,
-                   source: str) -> Iterable[Finding]:
-        traced = _collect_trace_scopes(tree)
+    def check_file(self, pf: core.ParsedFile) -> Iterable[Finding]:
+        traced = _collect_trace_scopes(pf.tree)
         findings: List[Finding] = []
         seen: Set[Tuple[int, int, str]] = set()
 
@@ -243,10 +242,7 @@ class TraceSafetyChecker(Checker):
             if key in seen:
                 return
             seen.add(key)
-            findings.append(Finding(
-                check=self.name, rule=rule, path=rel,
-                line=node.lineno, message=message,
-                snippet=core.source_line(source, node.lineno)))
+            findings.append(pf.finding(self.name, rule, node, message))
 
         for fn, static in traced.items():
             params = _param_names(fn) - static

@@ -3,9 +3,14 @@
 Exit codes: 0 clean (or baselined-only), 1 new findings, 2 usage
 error. `--write-baseline` accepts the current findings as debt (and
 prunes fixed entries); the gate then fails only on NEW findings.
+`--changed-only` narrows the scan to files touched since a git base
+ref — the fast pre-gate pass in tests/run_full.sh; `--format github`
+emits ::error workflow annotations.
 """
 import argparse
 import json
+import os
+import subprocess
 import sys
 from typing import List, Optional, Sequence
 
@@ -16,13 +21,13 @@ from skypilot_tpu.analysis import core
 def _parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog='python -m skypilot_tpu.analysis',
-        description='skytpu-lint: AST-based static analysis CI gate.')
+        description='skytpu-lint: flow-aware static analysis CI gate.')
     p.add_argument('paths', nargs='*',
                    help='files/dirs to scan (default: skypilot_tpu/)')
     p.add_argument('--checks',
                    help='comma-separated checker names '
                         '(default: all; see --list-checks)')
-    p.add_argument('--format', choices=('text', 'json'),
+    p.add_argument('--format', choices=('text', 'json', 'github'),
                    default='text')
     p.add_argument('--baseline',
                    help='baseline file (default: '
@@ -31,8 +36,56 @@ def _parser() -> argparse.ArgumentParser:
                    help='report every finding, baselined or not')
     p.add_argument('--write-baseline', action='store_true',
                    help='accept current findings as the new baseline')
+    p.add_argument('--migrate-baseline', action='store_true',
+                   help='rewrite a v1 baseline in place as v2 '
+                        '(statement-text fingerprints), keeping counts')
+    p.add_argument('--changed-only', nargs='?', const='HEAD',
+                   metavar='BASE_REF',
+                   help='lint only .py files changed vs BASE_REF '
+                        '(git diff --name-only; default HEAD). '
+                        'Exits 0 when nothing relevant changed.')
     p.add_argument('--list-checks', action='store_true')
     return p
+
+
+def changed_files(root: str, base_ref: str) -> Optional[List[str]]:
+    """Repo files changed vs base_ref (staged, unstaged, and — for a
+    non-HEAD ref — committed), or None when git itself fails (caller
+    falls back to a full scan rather than silently passing).
+
+    Filtered to the default scan surface (skypilot_tpu/) so the
+    changed-only pass is a faster-but-equivalent subset of the full
+    gate — it must never flag a file the full gate doesn't lint."""
+    try:
+        proc = subprocess.run(
+            ['git', 'diff', '--name-only', base_ref, '--'],
+            cwd=root, capture_output=True, text=True, timeout=60)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0:
+        return None
+    surface = os.path.join(root, 'skypilot_tpu') + os.sep
+    out: List[str] = []
+    for rel in proc.stdout.splitlines():
+        rel = rel.strip()
+        if not rel.endswith('.py'):
+            continue
+        path = os.path.join(root, rel)
+        if not path.startswith(surface):
+            continue
+        if os.path.exists(path):  # deleted files need no lint
+            out.append(path)
+    return out
+
+
+def _emit_github(findings: Sequence[core.Finding]) -> None:
+    for f in findings:
+        # %0A is the workflow-command newline escape.
+        msg = f'[{f.check}/{f.rule}] {f.message}'.replace(
+            '\n', '%0A')
+        line = f',line={f.line}' if f.line else ''
+        print(f'::error file={f.path}{line},'
+              f'title=skytpu-lint {f.check}/{f.rule}::{msg}')
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -48,14 +101,45 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.checks:
         checks = [c.strip() for c in args.checks.split(',')
                   if c.strip()]
+
+    paths = args.paths or None
+    if args.changed_only:
+        if paths:
+            print('error: --changed-only and explicit paths are '
+                  'mutually exclusive', file=sys.stderr)
+            return 2
+        changed = changed_files(root, args.changed_only)
+        if changed is None:
+            print('skytpu-lint: git diff failed; falling back to a '
+                  'full scan', file=sys.stderr)
+        elif not changed:
+            print('skytpu-lint: no changed .py files vs '
+                  f'{args.changed_only}; nothing to lint')
+            return 0
+        else:
+            paths = changed
+
     try:
-        findings, suppressed = core.run(paths=args.paths or None,
-                                        checks=checks, root=root)
+        findings, suppressed = core.run(paths=paths, checks=checks,
+                                        root=root)
     except ValueError as e:
         print(f'error: {e}', file=sys.stderr)
         return 2
 
     baseline_path = args.baseline or baseline_lib.default_path(root)
+    if args.migrate_baseline:
+        try:
+            carried = baseline_lib.migrate(baseline_path, findings)
+        except ValueError as e:
+            print(f'error: {e}', file=sys.stderr)
+            return 2
+        if carried < 0:
+            print(f'{baseline_path}: already current; nothing to do')
+        else:
+            print(f'migrated {baseline_path} to v2 '
+                  f'({carried} entr{"y" if carried == 1 else "ies"} '
+                  'carried over)')
+        return 0
     if args.write_baseline:
         baseline_lib.write(baseline_path, findings)
         print(f'wrote {len(findings)} finding(s) to {baseline_path}')
@@ -77,6 +161,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             'suppressed_count': suppressed,
             'checks': sorted(checks or core.all_checkers()),
         }, indent=1))
+    elif args.format == 'github':
+        _emit_github(new)
+        print(f'{len(new)} new finding(s), {len(baselined)} '
+              f'baselined, {suppressed} inline-suppressed')
     else:
         for f in new:
             print(f'{f.location()}: [{f.check}/{f.rule}] {f.message}')

@@ -1,11 +1,17 @@
 """Checker plugin API and file-walking runner for skytpu-lint.
 
-A checker sees each file's parsed AST once (`check_file`) and/or the
-whole project at the end (`check_project`, for contracts that live in
-runtime registries rather than syntax — metrics catalog, fault
-points). Findings are plain data; fingerprints are content-based
-(path + rule + source line, NOT line numbers) so the committed
-baseline survives unrelated edits above a finding.
+v2 (flow-aware): the runner parses every file ONCE into a
+`ParsedFile` (tree + source + lazily built, memoized per-function
+CFGs) and hands the same object to every checker — ten checkers, one
+parse, one CFG per function regardless of how many rules walk it.
+Checkers see each file (`check_file(pf)`) and/or the whole project at
+the end (`check_project(project)`, for contracts that live in runtime
+registries rather than syntax — metrics catalog, fault points).
+
+Findings are plain data; fingerprints are content-based (check + rule
++ path + normalized STATEMENT text, never line numbers) so the
+committed baseline survives unrelated edits above a finding and
+reformatting within one.
 """
 import ast
 import dataclasses
@@ -13,11 +19,22 @@ import hashlib
 import os
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Type
 
+from skypilot_tpu.analysis import cfg as cfg_mod
+
 # Inline escape hatch: a finding whose source line carries
 # `skytpu-lint: ignore[<rule-or-check>, ...]` is suppressed. Use it for
 # the rare deliberate violation (e.g. fork handlers replacing a lock);
 # use the baseline for bulk pre-existing debt.
 SUPPRESS_MARKER = 'skytpu-lint: ignore['
+
+# Total ast.parse calls made by run() since import — the lint bench
+# asserts a full check_project pass parses each file exactly once
+# (PR 3's trace_safety/lock_discipline each re-parsed on their own).
+PARSE_CALLS = 0
+
+# Filled in by run(): files scanned / parsed, CFG requests vs actual
+# builds (requests > builds proves the per-file memoization works).
+LAST_RUN_STATS: Dict[str, int] = {}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -27,9 +44,18 @@ class Finding:
     path: str       # repo-relative, forward slashes
     line: int       # 1-based; 0 for project-level findings
     message: str
-    snippet: str = ''   # stripped source line (fingerprint basis)
+    snippet: str = ''    # stripped source line (display)
+    statement: str = ''  # normalized enclosing statement (fingerprint)
 
     def fingerprint(self) -> str:
+        basis = '|'.join((self.check, self.rule, self.path,
+                          self.statement or self.snippet
+                          or self.message))
+        return hashlib.sha1(basis.encode()).hexdigest()[:16]
+
+    def legacy_fingerprint(self) -> str:
+        """The v1 (pre-statement) fingerprint — baseline migration
+        matches old entries through this."""
         basis = '|'.join((self.check, self.rule, self.path,
                           self.snippet or self.message))
         return hashlib.sha1(basis.encode()).hexdigest()[:16]
@@ -43,18 +69,98 @@ class Finding:
         return d
 
 
+# Statement types whose source segment spans a whole block — for
+# fingerprints only their header (through the line before the body)
+# identifies them.
+_COMPOUND = (ast.If, ast.While, ast.For, ast.AsyncFor, ast.With,
+             ast.AsyncWith, ast.Try, ast.FunctionDef,
+             ast.AsyncFunctionDef, ast.ClassDef)
+_STATEMENT_TEXT_CAP = 300
+
+
+class ParsedFile:
+    """One parsed module, shared by every checker in a run: AST with
+    parent links, source, and a per-function CFG cache (built on
+    first request, reused across checkers)."""
+
+    def __init__(self, path: str, rel: str, tree: ast.AST,
+                 source: str) -> None:
+        self.path = path
+        self.rel = rel
+        self.tree = tree
+        self.source = source
+        self.lines = source.splitlines()
+        self._cfgs: Dict[int, cfg_mod.CFG] = {}
+        self.cfg_requests = 0
+
+    def cfg(self, fn: ast.AST) -> cfg_mod.CFG:
+        """The function's CFG, built at most once per file per run —
+        never once per checker."""
+        self.cfg_requests += 1
+        key = id(fn)
+        got = self._cfgs.get(key)
+        if got is None:
+            got = cfg_mod.build(fn)
+            self._cfgs[key] = got
+        return got
+
+    def cfg_builds(self) -> int:
+        return len(self._cfgs)
+
+    def statement_of(self, node: ast.AST) -> Optional[ast.stmt]:
+        """The nearest enclosing statement (the node itself if it is
+        one); needs annotate_parents."""
+        cur: Optional[ast.AST] = node
+        while cur is not None and not isinstance(cur, ast.stmt):
+            cur = getattr(cur, 'skytpu_parent', None)
+        return cur
+
+    def statement_text(self, node: ast.AST) -> str:
+        """Whitespace-normalized text of the enclosing statement —
+        header only for compound statements — used as the fingerprint
+        basis so findings survive pure line drift."""
+        stmt = self.statement_of(node)
+        if stmt is None:
+            line = getattr(node, 'lineno', 0)
+            return source_line(self.source, line)
+        start = stmt.lineno
+        if isinstance(stmt, _COMPOUND) and stmt.body:
+            end = max(start, stmt.body[0].lineno - 1)
+        else:
+            end = getattr(stmt, 'end_lineno', start)
+        text = ' '.join(
+            part for raw in self.lines[start - 1:end]
+            for part in raw.split())
+        return text[:_STATEMENT_TEXT_CAP]
+
+    def finding(self, check: str, rule: str, node: ast.AST,
+                message: str) -> Finding:
+        line = getattr(node, 'lineno', 0)
+        return Finding(check=check, rule=rule, path=self.rel,
+                       line=line, message=message,
+                       snippet=source_line(self.source, line),
+                       statement=self.statement_text(node))
+
+
+@dataclasses.dataclass
+class Project:
+    """What check_project sees: the repo root, every ParsedFile from
+    this run, and the raw path list (including unparseable files)."""
+    root: str
+    files: List[ParsedFile]
+    paths: List[str] = dataclasses.field(default_factory=list)
+
+
 class Checker:
     """Base class. Subclasses set `name`/`description` and override
     one or both hooks; `register` makes them CLI-selectable."""
     name: str = ''
     description: str = ''
 
-    def check_file(self, path: str, rel: str, tree: ast.AST,
-                   source: str) -> Iterable[Finding]:
+    def check_file(self, pf: ParsedFile) -> Iterable[Finding]:
         return ()
 
-    def check_project(self, root: str,
-                      files: Sequence[str]) -> Iterable[Finding]:
+    def check_project(self, project: Project) -> Iterable[Finding]:
         return ()
 
 
@@ -112,7 +218,7 @@ def _suppressed(finding: Finding, lines: Sequence[str]) -> bool:
 
 def annotate_parents(tree: ast.AST) -> None:
     """Stamp every node with `.skytpu_parent` (checkers walk up for
-    with-lock / module-scope questions)."""
+    with-lock / module-scope / enclosing-statement questions)."""
     for node in ast.walk(tree):
         for child in ast.iter_child_nodes(node):
             child.skytpu_parent = node  # type: ignore[attr-defined]
@@ -128,6 +234,22 @@ def dotted_name(node: ast.AST) -> Optional[str]:
         parts.append(node.id)
         return '.'.join(reversed(parts))
     return None
+
+
+def parse_file(path: str, root: str) -> Optional[ParsedFile]:
+    """Parse one file into a ParsedFile (None if unreadable or
+    syntactically broken — some other gate's problem)."""
+    global PARSE_CALLS
+    try:
+        with open(path, encoding='utf-8') as f:
+            source = f.read()
+        PARSE_CALLS += 1
+        tree = ast.parse(source, filename=path)
+    except (OSError, SyntaxError):
+        return None
+    annotate_parents(tree)
+    rel = os.path.relpath(path, root).replace(os.sep, '/')
+    return ParsedFile(path, rel, tree, source)
 
 
 def run(paths: Optional[Sequence[str]] = None,
@@ -151,27 +273,31 @@ def run(paths: Optional[Sequence[str]] = None,
         selected = [cls() for cls in available.values()]
 
     files = _iter_py_files(paths)
+    parsed: List[ParsedFile] = []
+    for path in files:
+        pf = parse_file(path, root)
+        if pf is not None:
+            parsed.append(pf)
+
     findings: List[Finding] = []
     suppressed = 0
-    for path in files:
-        try:
-            with open(path, encoding='utf-8') as f:
-                source = f.read()
-            tree = ast.parse(source, filename=path)
-        except (OSError, SyntaxError):
-            continue  # unparseable files are some other gate's problem
-        annotate_parents(tree)
-        rel = os.path.relpath(path, root).replace(os.sep, '/')
-        lines = source.splitlines()
+    for pf in parsed:
         for checker in selected:
-            for finding in checker.check_file(path, rel, tree, source):
-                if _suppressed(finding, lines):
+            for finding in checker.check_file(pf):
+                if _suppressed(finding, pf.lines):
                     suppressed += 1
                 else:
                     findings.append(finding)
+    project = Project(root=root, files=parsed, paths=list(files))
     for checker in selected:
-        findings.extend(checker.check_project(root, files))
+        findings.extend(checker.check_project(project))
     findings.sort(key=lambda f: (f.path, f.line, f.check, f.rule))
+
+    LAST_RUN_STATS.clear()
+    LAST_RUN_STATS.update(
+        files=len(files), parsed=len(parsed),
+        cfg_builds=sum(pf.cfg_builds() for pf in parsed),
+        cfg_requests=sum(pf.cfg_requests for pf in parsed))
     return findings, suppressed
 
 

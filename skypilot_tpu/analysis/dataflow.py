@@ -1,0 +1,319 @@
+"""Dataflow queries over analysis.cfg graphs.
+
+Everything here is deliberately small and worklist-based — the lint
+bench holds the full ten-checker repo pass under a 30s wall bar, so
+each query is linear-ish in graph size:
+
+  max_weight_path      longest acyclic-path weight sum (host-sync
+                       budgets: the worst single execution of a
+                       function, loops collapsed via SCC condensation
+                       so a sync in a loop contributes its SCC total)
+  reach_avoiding       can `start` reach any `target` without passing
+                       through a blocking node (resource-pairing: an
+                       acquire that reaches exit avoiding every
+                       release is a leak)
+  forward_reach        plain forward reachability with per-node stop
+                       predicate (donation-discipline: walk from the
+                       dispatch site, stop at rebinds, flag reads)
+  must_hold            forward must-analysis (meet = AND) of which
+                       lock objects are held at each node
+                       (lock-coverage beyond lexical `with` bodies)
+  reaching_definitions classic may-analysis of name -> def sites
+"""
+import ast
+from typing import Callable, Dict, FrozenSet, Iterable, List, \
+    Optional, Sequence, Set, Tuple
+
+from . import cfg as cfg_mod
+from .cfg import CFG, Node
+
+
+def _condense(graph: CFG) -> Tuple[Dict[int, int], List[Set[int]],
+                                   Dict[int, Set[int]]]:
+    """SCC condensation: (node index -> scc id, scc id -> member
+    indices, scc id -> successor scc ids). Iterative Tarjan; scc ids
+    are emitted in reverse topological order (successors first)."""
+    index_of: Dict[int, int] = {}
+    low: Dict[int, int] = {}
+    on_stack: Set[int] = set()
+    stack: List[int] = []
+    comp_of: Dict[int, int] = {}
+    comps: List[Set[int]] = []
+    counter = [0]
+
+    for root in graph.nodes:
+        if root.index in index_of:
+            continue
+        work: List[Tuple[Node, int]] = [(root, 0)]
+        while work:
+            node, si = work[-1]
+            if si == 0:
+                index_of[node.index] = low[node.index] = counter[0]
+                counter[0] += 1
+                stack.append(node.index)
+                on_stack.add(node.index)
+            advanced = False
+            succs = node.succs
+            while si < len(succs):
+                child = succs[si][0]
+                si += 1
+                if child.index not in index_of:
+                    work[-1] = (node, si)
+                    work.append((child, 0))
+                    advanced = True
+                    break
+                if child.index in on_stack:
+                    low[node.index] = min(low[node.index],
+                                          index_of[child.index])
+            if advanced:
+                continue
+            work[-1] = (node, si)
+            if si >= len(succs):
+                work.pop()
+                if low[node.index] == index_of[node.index]:
+                    members: Set[int] = set()
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        members.add(w)
+                        if w == node.index:
+                            break
+                    cid = len(comps)
+                    comps.append(members)
+                    for m in members:
+                        comp_of[m] = cid
+                if work:
+                    parent = work[-1][0]
+                    low[parent.index] = min(low[parent.index],
+                                            low[node.index])
+
+    comp_succs: Dict[int, Set[int]] = {i: set() for i in
+                                       range(len(comps))}
+    for node in graph.nodes:
+        cid = comp_of[node.index]
+        for child, _ in node.succs:
+            ccid = comp_of[child.index]
+            if ccid != cid:
+                comp_succs[cid].add(ccid)
+    return comp_of, comps, comp_succs
+
+
+def max_weight_path(graph: CFG, weight: Dict[int, int],
+                    ) -> Tuple[int, List[Node]]:
+    """Maximum sum of `weight[node.index]` over any execution path
+    from entry. Cycles are condensed: every weighted node in an SCC
+    counts once toward the SCC's weight (the budget checker reports
+    loops separately via sync-in-loop). Returns (max weight, the
+    weighted nodes on one witness path, program order)."""
+    comp_of, comps, comp_succs = _condense(graph)
+    n = len(comps)
+    # comps is emitted successors-first, so ascending id IS a safe
+    # evaluation order for the longest-path DP over the DAG.
+    best: List[int] = [0] * n
+    choice: List[Optional[int]] = [None] * n
+    for cid in range(n):
+        w = sum(weight.get(m, 0) for m in comps[cid])
+        succ_best, succ_pick = 0, None
+        for s in comp_succs[cid]:
+            if best[s] > succ_best:
+                succ_best, succ_pick = best[s], s
+        best[cid] = w + succ_best
+        choice[cid] = succ_pick
+    start = comp_of[graph.entry.index]
+    total = best[start]
+    witness: List[Node] = []
+    cid: Optional[int] = start
+    by_index = {node.index: node for node in graph.nodes}
+    while cid is not None:
+        for m in sorted(comps[cid]):
+            if weight.get(m, 0):
+                witness.append(by_index[m])
+        cid = choice[cid]
+    witness.sort(key=lambda node: (node.lineno, node.index))
+    return total, witness
+
+
+def reach_avoiding(start: Node, targets: Set[int],
+                   blocked: Callable[[Node], bool],
+                   skip_start_exception: bool = False,
+                   ) -> Optional[Node]:
+    """BFS from `start`'s successors: can control reach a node whose
+    index is in `targets` while never passing THROUGH a node for
+    which blocked() is true? Blocked nodes are absorbing (the path is
+    satisfied there, we do not continue past them). Returns the first
+    reached target node, else None.
+
+    `skip_start_exception` drops the START node's own exception edge
+    from the seed frontier — an acquire() that itself raises never
+    obtained the resource, so that edge is not a leak path."""
+    seen: Set[int] = set()
+    frontier: List[Node] = [
+        t for t, kind in start.succs
+        if not (skip_start_exception and kind == cfg_mod.EXCEPTION)]
+    while frontier:
+        node = frontier.pop()
+        if node.index in seen:
+            continue
+        seen.add(node.index)
+        if node.index in targets:
+            return node
+        if blocked(node):
+            continue
+        frontier.extend(t for t, _ in node.succs)
+    return None
+
+
+def forward_reach(start: Node, stop: Callable[[Node], bool],
+                  include_start: bool = False) -> Iterable[Node]:
+    """Yield every node reachable from `start` without passing
+    through a node where stop() is true. Stop nodes themselves are
+    yielded (a statement can both read and rebind — the caller
+    inspects evaluation order) but not traversed past."""
+    seen: Set[int] = set()
+    frontier: List[Node] = [start] if include_start \
+        else [t for t, _ in start.succs]
+    while frontier:
+        node = frontier.pop()
+        if node.index in seen:
+            continue
+        seen.add(node.index)
+        yield node
+        if stop(node):
+            continue
+        frontier.extend(t for t, _ in node.succs)
+
+
+def must_hold(graph: CFG,
+              acquires: Callable[[Node], FrozenSet[str]],
+              releases: Callable[[Node], FrozenSet[str]],
+              universe: FrozenSet[str],
+              ) -> Dict[int, FrozenSet[str]]:
+    """Forward must-analysis: the set of lock names guaranteed held
+    ON ENTRY to each node.  out(n) = (in(n) | acquires(n)) -
+    releases(n); in(n) = intersection over preds.  Exception edges
+    participate (a raise mid-critical-section still holds the lock
+    until a handler releases it)."""
+    preds: Dict[int, List[int]] = {node.index: []
+                                   for node in graph.nodes}
+    by_index: Dict[int, Node] = {}
+    for node in graph.nodes:
+        by_index[node.index] = node
+        for child, _ in node.succs:
+            preds[child.index].append(node.index)
+
+    state_in: Dict[int, FrozenSet[str]] = {
+        node.index: universe for node in graph.nodes}
+    state_in[graph.entry.index] = frozenset()
+
+    out_of: Dict[int, FrozenSet[str]] = {}
+
+    def flow(idx: int) -> FrozenSet[str]:
+        node = by_index[idx]
+        return (state_in[idx] | acquires(node)) - releases(node)
+
+    work = [node.index for node in graph.nodes]
+    while work:
+        idx = work.pop()
+        if idx == graph.entry.index:
+            new_in: FrozenSet[str] = frozenset()
+        else:
+            ps = preds[idx]
+            if not ps:
+                new_in = frozenset()
+            else:
+                acc: Optional[FrozenSet[str]] = None
+                for p in ps:
+                    o = out_of.get(p)
+                    if o is None:
+                        o = flow(p)
+                    acc = o if acc is None else (acc & o)
+                new_in = acc if acc is not None else frozenset()
+        if new_in != state_in[idx] or idx not in out_of:
+            state_in[idx] = new_in
+            new_out = flow(idx)
+            if out_of.get(idx) != new_out:
+                out_of[idx] = new_out
+                for child, _ in by_index[idx].succs:
+                    work.append(child.index)
+            else:
+                out_of[idx] = new_out
+    return state_in
+
+
+def assigned_names(stmt: ast.stmt) -> Set[str]:
+    """Plain names (re)bound by a statement — assignment targets,
+    aug-assign, for targets, with ... as, except ... as, imports."""
+    names: Set[str] = set()
+
+    def targets(node: ast.AST) -> None:
+        for t in ast.walk(node):
+            if isinstance(t, ast.Name):
+                names.add(t.id)
+
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            targets(t)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets(stmt.target)
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        targets(stmt.target)
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                targets(item.optional_vars)
+    elif isinstance(stmt, (ast.Import, ast.ImportFrom)):
+        for alias in stmt.names:
+            names.add((alias.asname or alias.name).split('.')[0])
+    elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+        names.add(stmt.name)
+    return names
+
+
+def reaching_definitions(graph: CFG,
+                         ) -> Dict[int, Dict[str, Set[int]]]:
+    """May-analysis: for each node, name -> the node indices whose
+    (re)binding of that name can reach it. Parameter bindings appear
+    under the entry node's index."""
+    gen: Dict[int, Set[str]] = {}
+    for node in graph.nodes:
+        if node.stmt is not None:
+            gen[node.index] = assigned_names(node.stmt)
+        elif node.kind == 'entry':
+            params: Set[str] = set()
+            fn = graph.fn
+            args = getattr(fn, 'args', None)
+            if args is not None:
+                for a in (list(args.posonlyargs) + list(args.args)
+                          + list(args.kwonlyargs)):
+                    params.add(a.arg)
+                if args.vararg:
+                    params.add(args.vararg.arg)
+                if args.kwarg:
+                    params.add(args.kwarg.arg)
+            gen[node.index] = params
+        else:
+            gen[node.index] = set()
+
+    state: Dict[int, Dict[str, Set[int]]] = {
+        node.index: {} for node in graph.nodes}
+    work: List[Node] = [graph.entry]
+    while work:
+        node = work.pop()
+        out: Dict[str, Set[int]] = dict(state[node.index])
+        for name in gen[node.index]:
+            out[name] = {node.index}
+        for child, _ in node.succs:
+            tgt = state[child.index]
+            changed = False
+            for name, defs in out.items():
+                cur = tgt.get(name)
+                if cur is None:
+                    tgt[name] = set(defs)
+                    changed = True
+                elif not defs <= cur:
+                    cur.update(defs)
+                    changed = True
+            if changed:
+                work.append(child)
+    return state

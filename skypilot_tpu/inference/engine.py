@@ -1797,6 +1797,7 @@ class InferenceEngine:
                         attrs={'request_id': request_id}):
             return self._snapshot_locked(request_id)
 
+    # skytpu-lint: hot-path[1]
     def _snapshot_locked(self, request_id: int) -> bytes:
         for rid, tokens, sampling in self._queue:
             if rid == request_id:
@@ -1899,6 +1900,7 @@ class InferenceEngine:
                                header.get('request_id')}):
             return self._restore_locked(header, arrays)
 
+    # skytpu-lint: hot-path[1]
     def _restore_locked(self, header: Dict[str, Any],
                         arrays: Dict[str, np.ndarray]) -> int:
         try:
@@ -2126,6 +2128,7 @@ class InferenceEngine:
             self.state.draft_cache['table'] = \
                 self.state.draft_cache['table'].at[slot].set(row)
 
+    # skytpu-lint: hot-path[1]
     def _insert_from_queue(self) -> None:
         free = [i for i, s in enumerate(self.state.slots) if s is None]
         if not free or not self._queue:
@@ -2135,86 +2138,106 @@ class InferenceEngine:
         while free and self._queue:
             matched: Optional[prefix_lib.MatchResult] = None
             t_match: Optional[Tuple[float, float]] = None
-            if self.kv_page_size:
-                # Page admission BEFORE popping: an oversubscribed
-                # pool holds the request at the queue head (FIFO — no
-                # starving big requests) until evictions free pages.
-                _rid, peek_tokens, peek_sampling = self._queue[0]
-                peek_trunc = peek_tokens[:self.state.max_seq_len - 1]
-                need = self._pages_needed(
-                    len(peek_trunc), peek_sampling.max_new_tokens)
-                need_private = need
-                if self._prefix is not None:
-                    # Hit/miss decided HERE, before scheduling
-                    # prefill: matched full pages map COW into the
-                    # table instead of being recomputed. acquire()
-                    # BEFORE any reclaim below — eviction must never
-                    # harvest the very pages this request matched.
-                    t_match0 = time.time()
-                    matched = self._prefix.match(peek_trunc)
-                    t_match = (t_match0, time.time())
-                    if matched.pages:
-                        self._prefix.acquire(matched.pages)
-                    # A fully-cached prompt still needs last-token
-                    # logits: its final page is re-written (one
-                    # token), which COWs it — one extra private page.
-                    cow = 1 if (matched.pages and matched.tokens
-                                >= len(peek_trunc)) else 0
-                    need_private = need - len(matched.pages) + cow
-                if need_private > len(self._page_alloc):
-                    # Live requests outrank cached history: reclaim
-                    # cold refcount-0 prefix-cache pages (LRU) before
-                    # queueing the request.
+            pinned: List[int] = []
+            try:
+                if self.kv_page_size:
+                    # Page admission BEFORE popping: an oversubscribed
+                    # pool holds the request at the queue head (FIFO —
+                    # no starving big requests) until evictions free
+                    # pages.
+                    _rid, peek_tokens, peek_sampling = self._queue[0]
+                    peek_trunc = peek_tokens[
+                        :self.state.max_seq_len - 1]
+                    need = self._pages_needed(
+                        len(peek_trunc), peek_sampling.max_new_tokens)
+                    need_private = need
                     if self._prefix is not None:
-                        self._reclaim(
-                            need_private - len(self._page_alloc))
+                        # Hit/miss decided HERE, before scheduling
+                        # prefill: matched full pages map COW into the
+                        # table instead of being recomputed. acquire()
+                        # BEFORE any reclaim below — eviction must
+                        # never harvest the very pages this request
+                        # matched.
+                        t_match0 = time.time()
+                        matched = self._prefix.match(peek_trunc)
+                        t_match = (t_match0, time.time())
+                        if matched.pages:
+                            self._prefix.acquire(matched.pages)
+                            pinned = list(matched.pages)
+                        # A fully-cached prompt still needs last-token
+                        # logits: its final page is re-written (one
+                        # token), which COWs it — one extra private
+                        # page.
+                        cow = 1 if (matched.pages and matched.tokens
+                                    >= len(peek_trunc)) else 0
+                        need_private = need - len(matched.pages) + cow
                     if need_private > len(self._page_alloc):
-                        if matched is not None and matched.pages:
-                            self._prefix.release(matched.pages)
-                        # Stamp the start of the head request's pool
-                        # wait (once): the span records at admission.
-                        if _rid in self._req_trace:
-                            self._req_wait_t.setdefault(
-                                _rid, time.time())
-                        break
-            slot = free.pop(0)
-            request_id, tokens, sampling = self._queue.pop(0)
-            if request_id in self._req_trace:
-                now = time.time()
-                submit_t = self._req_submit_t.pop(request_id, None)
-                if submit_t is not None:
-                    self._trace_phase(request_id, 'admission_wait',
-                                      submit_t, now)
-                wait_t = self._req_wait_t.pop(request_id, None)
-                if wait_t is not None:
-                    self._trace_phase(request_id, 'page_pool_wait',
-                                      wait_t, now)
-                if t_match is not None:
-                    n_pages = len(matched.pages) if matched else 0
-                    self._trace_phase(
-                        request_id, 'prefix_match', t_match[0],
-                        t_match[1], matched_pages=n_pages,
-                        matched_tokens=(matched.tokens
-                                        if n_pages else 0))
-            tokens = tokens[:self.state.max_seq_len - 1]
-            if self.kv_page_size:
-                fresh = self._page_alloc[:need_private]
-                del self._page_alloc[:need_private]
-                if matched is not None and matched.pages:
-                    # COW-map the matched pages at the head of the
-                    # table; the one extra `cow` page (full-match
-                    # case) rides at the END of `fresh` and is
-                    # consumed by _cow_slot_page below.
-                    pages = list(matched.pages) + fresh
-                    self._slot_pages[slot] = pages[:need]
-                    self._slot_shared[slot] = set(
-                        range(len(matched.pages)))
-                    if len(pages) > need:
-                        self._page_alloc[:0] = pages[need:]
-                else:
-                    self._slot_pages[slot] = fresh
-                    self._slot_shared[slot] = set()
-                self._set_table_rows(slot, self._slot_pages[slot])
+                        # Live requests outrank cached history:
+                        # reclaim cold refcount-0 prefix-cache pages
+                        # (LRU) before queueing the request.
+                        if self._prefix is not None:
+                            self._reclaim(
+                                need_private - len(self._page_alloc))
+                        if need_private > len(self._page_alloc):
+                            if pinned:
+                                self._prefix.release(pinned)
+                                pinned = []
+                            # Stamp the start of the head request's
+                            # pool wait (once): the span records at
+                            # admission.
+                            if _rid in self._req_trace:
+                                self._req_wait_t.setdefault(
+                                    _rid, time.time())
+                            break
+                slot = free.pop(0)
+                request_id, tokens, sampling = self._queue.pop(0)
+                if request_id in self._req_trace:
+                    now = time.time()
+                    submit_t = self._req_submit_t.pop(request_id, None)
+                    if submit_t is not None:
+                        self._trace_phase(request_id, 'admission_wait',
+                                          submit_t, now)
+                    wait_t = self._req_wait_t.pop(request_id, None)
+                    if wait_t is not None:
+                        self._trace_phase(request_id, 'page_pool_wait',
+                                          wait_t, now)
+                    if t_match is not None:
+                        n_pages = len(matched.pages) if matched else 0
+                        self._trace_phase(
+                            request_id, 'prefix_match', t_match[0],
+                            t_match[1], matched_pages=n_pages,
+                            matched_tokens=(matched.tokens
+                                            if n_pages else 0))
+                tokens = tokens[:self.state.max_seq_len - 1]
+                if self.kv_page_size:
+                    fresh = self._page_alloc[:need_private]
+                    del self._page_alloc[:need_private]
+                    if matched is not None and matched.pages:
+                        # COW-map the matched pages at the head of the
+                        # table; the one extra `cow` page (full-match
+                        # case) rides at the END of `fresh` and is
+                        # consumed by _cow_slot_page below.
+                        pages = list(matched.pages) + fresh
+                        self._slot_pages[slot] = pages[:need]
+                        self._slot_shared[slot] = set(
+                            range(len(matched.pages)))
+                        if len(pages) > need:
+                            self._page_alloc[:0] = pages[need:]
+                    else:
+                        self._slot_pages[slot] = fresh
+                        self._slot_shared[slot] = set()
+                    self._set_table_rows(slot, self._slot_pages[slot])
+                # The slot's page list owns the pins from here on:
+                # _free_slot releases shared pages when the slot dies.
+                pinned = []
+            except BaseException:
+                # Anything failing between acquire() and the publish
+                # into _slot_pages would otherwise leak the pins
+                # forever (refcount never drops, the allocator slowly
+                # starves). Release before propagating.
+                if pinned and self._prefix is not None:
+                    self._prefix.release(pinned)
+                raise
             # Counted POST-truncation, at insert: the counter must
             # reflect tokens the engine actually prefills, or
             # prompt-side throughput read from /metrics deltas
@@ -2296,7 +2319,11 @@ class InferenceEngine:
         topks = jnp.array([s.top_k for _, _, s in inserts], jnp.int32)
         topps = jnp.array([s.top_p for _, _, s in inserts], jnp.float32)
         first, first_lp = _sample(logits, temps, topks, topps, sub)
-        first_host, lp_host = jax.device_get((first, first_lp))
+        # ONE host sync for the whole insert: sampled tokens,
+        # logprobs, and the last-token row all ride the same
+        # device_get (the hot-path[1] budget).
+        first_host, lp_host, last = jax.device_get(
+            (first, first_lp, self.state.last_tokens))
         # The device_get above is the sync point: the observed latency
         # covers the whole batched prefill, not just its dispatch.
         obs.PREFILL_SECONDS.observe(
@@ -2307,7 +2334,7 @@ class InferenceEngine:
             self._trace_phase(rid, 'prefill', w_prefill, w_end,
                               bucket=bucket, chunk=chunk,
                               prompt_tokens=len(t))
-        last = jax.device_get(self.state.last_tokens).copy()
+        last = last.copy()
         for i, slot in enumerate(slot_ids):
             token = int(first_host[i])
             self.state.slots[slot].generated.append(token)
@@ -2340,6 +2367,7 @@ class InferenceEngine:
         if over > 0:
             self._reclaim(over)
 
+    # skytpu-lint: hot-path[1]
     def _cow_slot_page(self, i: int, idx: int) -> None:
         """Copy-on-write: slot i's table entry `idx` maps a page
         SHARED with the radix cache and is about to be written — copy
@@ -2412,6 +2440,7 @@ class InferenceEngine:
                 long_done = True
             self._advance_prefill_slot(i, slot)
 
+    # skytpu-lint: hot-path[1]
     def _advance_prefill_slot(self, i: int, slot: _Slot) -> None:
         """One chunk of prefill for slot i, at the narrowest
         power-of-two bucket that covers the remainder: a 16-token
@@ -2464,7 +2493,10 @@ class InferenceEngine:
             jnp.array([slot.params.temperature], jnp.float32),
             jnp.array([slot.params.top_k], jnp.int32),
             jnp.array([slot.params.top_p], jnp.float32), sub)
-        first_host, lp_host = jax.device_get((first, first_lp))
+        # ONE host sync for the final chunk: token, logprob, and the
+        # last-token row share the device_get (hot-path[1] budget).
+        first_host, lp_host, last = jax.device_get(
+            (first, first_lp, self.state.last_tokens))
         obs.PREFILL_SECONDS.observe(
             time.perf_counter() - t_prefill,
             trace_id=self._trace_exemplar((slot.request_id,)))
@@ -2475,7 +2507,7 @@ class InferenceEngine:
         slot.generated.append(token)
         slot.logprobs.append(float(lp_host[0]))
         slot.pending = None
-        last = jax.device_get(self.state.last_tokens).copy()
+        last = last.copy()
         last[i] = token
         self.state.last_tokens = jnp.asarray(last)
         obs.GENERATED_TOKENS.inc(1)
@@ -2565,6 +2597,7 @@ class InferenceEngine:
         max_len = jnp.int32(self.state.max_seq_len - 2)
         return budgets, eos_arr, max_len
 
+    # skytpu-lint: hot-path[1]
     def _spec_round(self, active_mask: List[bool]) -> None:
         """ONE speculative host dispatch: up to `spec_fuse_rounds`
         draft/verify rounds run device-resident (fused_spec_rounds),
@@ -2676,6 +2709,7 @@ class InferenceEngine:
             obs.KV_PAGES_PRIVATE.set(
                 self._pages_total - len(self._page_alloc) - cached)
 
+    # skytpu-lint: hot-path[1]
     def step(self) -> None:
         self._evict_finished()
         self._insert_from_queue()

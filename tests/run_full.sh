@@ -5,7 +5,11 @@
 set -e
 cd "$(dirname "$0")/.."
 # Static-analysis gate first: a lint finding fails fast, before the
-# compile-heavy suites spend minutes.
+# compile-heavy suites spend minutes. The changed-only pass surfaces
+# findings in the files being worked on within a second or two; the
+# full pass behind it still catches cross-file and project-scope
+# drift.
+python -m skypilot_tpu.analysis --changed-only HEAD --format github
 python -m skypilot_tpu.analysis
 python -m pytest tests/ -q
 python -m pytest tests/ -q -m slow

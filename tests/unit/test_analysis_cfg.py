@@ -1,0 +1,175 @@
+"""Unit tests for the statement-level CFG the flow checkers stand on:
+try/finally duplication, with-statement exception edges, loop
+back-edges, and except-dispatch escape semantics.
+"""
+import ast
+import textwrap
+
+from skypilot_tpu.analysis import cfg as cfg_mod
+from skypilot_tpu.analysis import dataflow
+
+
+def _fn(source: str) -> ast.FunctionDef:
+    tree = ast.parse(textwrap.dedent(source))
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return node
+    raise AssertionError('no function in fixture')
+
+
+def _stmt_on_line(fn: ast.AST, lineno: int) -> ast.stmt:
+    best = None
+    for node in ast.walk(fn):
+        if isinstance(node, ast.stmt) and node.lineno == lineno:
+            best = node
+    assert best is not None, f'no statement on line {lineno}'
+    return best
+
+
+def _find(fn: ast.AST, needle: str, source: str) -> ast.stmt:
+    lines = textwrap.dedent(source).splitlines()
+    for i, line in enumerate(lines, 1):
+        if needle in line:
+            return _stmt_on_line(fn, i)
+    raise AssertionError(f'{needle!r} not in fixture')
+
+
+def test_try_finally_runs_on_every_continuation():
+    """The finalbody is duplicated per continuation: neither the
+    normal exit, the raise exit, nor the return path can bypass it."""
+    src = """
+        def f(x):
+            try:
+                step(x)
+                return x
+            finally:
+                cleanup()
+    """
+    fn = _fn(src)
+    graph = cfg_mod.build(fn)
+    cleanup = _find(fn, 'cleanup()', src)
+    # Normal, exception, and return continuations each get their own
+    # copy of the finalbody.
+    copies = graph.nodes_for(cleanup)
+    assert len(copies) >= 2
+    exit_node, raise_node = graph.terminals()
+    blocked_ids = {n.index for n in copies}
+    step = _find(fn, 'step(x)', src)
+    for start in graph.nodes_for(step):
+        # step() raises -> must pass through a finally copy first.
+        hit = dataflow.reach_avoiding(
+            start, {exit_node.index, raise_node.index},
+            blocked=lambda n: n.index in blocked_ids)
+        assert hit is None, 'a path escaped the finally'
+
+
+def test_with_statement_has_exception_edge_and_body_flow():
+    src = """
+        def f(res):
+            with res.open() as h:
+                use(h)
+            done()
+    """
+    fn = _fn(src)
+    graph = cfg_mod.build(fn)
+    exit_node, raise_node = graph.terminals()
+    with_stmt = _find(fn, 'with res.open()', src)
+    (wnode,) = graph.nodes_for(with_stmt)
+    kinds = {kind for _, kind in wnode.succs}
+    # Entering the context can raise; the body is the normal edge.
+    assert cfg_mod.EXCEPTION in kinds and cfg_mod.NORMAL in kinds
+    use = _find(fn, 'use(h)', src)
+    (unode,) = graph.nodes_for(use)
+    # The body call can raise out of the function...
+    assert any(t.index == raise_node.index for t, k in unode.succs
+               if k == cfg_mod.EXCEPTION)
+    # ...and normally falls through to the statement after the with.
+    done = _find(fn, 'done()', src)
+    hit = dataflow.reach_avoiding(
+        unode, {graph.nodes_for(done)[0].index}, blocked=lambda n: False)
+    assert hit is not None
+
+
+def test_loop_back_edges_mark_cyclic_nodes():
+    src = """
+        def f(items):
+            total = 0
+            for x in items:
+                total += use(x)
+            while total > 0:
+                total = shrink(total)
+            return total
+    """
+    fn = _fn(src)
+    graph = cfg_mod.build(fn)
+    cyclic = graph.cyclic_nodes()
+    for needle in ('total += use(x)', 'total = shrink(total)'):
+        stmt = _find(fn, needle, src)
+        assert all(n.index in cyclic for n in graph.nodes_for(stmt)), \
+            f'{needle!r} not recognized as loop body'
+    for needle in ('total = 0', 'return total'):
+        stmt = _find(fn, needle, src)
+        assert all(n.index not in cyclic
+                   for n in graph.nodes_for(stmt)), \
+            f'{needle!r} wrongly marked cyclic'
+
+
+def _escapes_handler(src: str) -> bool:
+    """Can the try body's exception reach the raise exit without
+    entering the handler body?"""
+    fn = _fn(src)
+    graph = cfg_mod.build(fn)
+    _, raise_node = graph.terminals()
+    risky = _find(fn, 'risky()', src)
+    handled = _find(fn, 'handled()', src)
+    handler_ids = {n.index for n in graph.nodes_for(handled)}
+    for start in graph.nodes_for(risky):
+        hit = dataflow.reach_avoiding(
+            start, {raise_node.index},
+            blocked=lambda n: n.index in handler_ids)
+        if hit is not None:
+            return True
+    return False
+
+
+def test_narrow_except_lets_exceptions_escape():
+    assert _escapes_handler("""
+        def f():
+            try:
+                risky()
+            except ValueError:
+                handled()
+    """)
+
+
+def test_bare_except_catches_everything():
+    assert not _escapes_handler("""
+        def f():
+            try:
+                risky()
+            except:
+                handled()
+    """)
+
+
+def test_base_exception_handler_catches_everything():
+    assert not _escapes_handler("""
+        def f():
+            try:
+                risky()
+            except BaseException:
+                handled()
+    """)
+
+
+def test_safe_builtins_do_not_fork_exception_edges():
+    src = """
+        def f(xs):
+            n = len(xs)
+            return n
+    """
+    fn = _fn(src)
+    graph = cfg_mod.build(fn)
+    stmt = _find(fn, 'n = len(xs)', src)
+    (node,) = graph.nodes_for(stmt)
+    assert all(k == cfg_mod.NORMAL for _, k in node.succs)

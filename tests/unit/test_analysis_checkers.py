@@ -3,6 +3,7 @@ snippet that MUST flag and a sibling that MUST pass — the checkers
 stay honest in both directions (no silent rule rot, no false-positive
 creep on the idioms the codebase actually uses).
 """
+import json
 import os
 import textwrap
 from typing import List
@@ -24,6 +25,12 @@ def _run_snippet(tmp_path, source: str, check: str,
 
 def _rules(findings) -> List[str]:
     return [f.rule for f in findings]
+
+
+def _project(root: str) -> core.Project:
+    """A files-less Project for exercising project-scope checkers
+    directly (they read the tree themselves)."""
+    return core.Project(root=root, files=[])
 
 
 # --- trace-safety -----------------------------------------------------------
@@ -640,13 +647,13 @@ def test_lock_discipline_ignores_modules_without_module_lock(tmp_path):
 def test_metrics_names_checker_clean_on_repo():
     from skypilot_tpu.analysis.checkers import metrics_names
     assert list(metrics_names.MetricsNamesChecker().check_project(
-        core.repo_root(), ())) == []
+        _project(core.repo_root()))) == []
 
 
 def test_fault_points_checker_clean_on_repo():
     from skypilot_tpu.analysis.checkers import fault_points
     assert list(fault_points.FaultPointsChecker().check_project(
-        core.repo_root(), ())) == []
+        _project(core.repo_root()))) == []
 
 
 def test_fault_points_checker_flags_missing_guide(tmp_path):
@@ -654,7 +661,7 @@ def test_fault_points_checker_flags_missing_guide(tmp_path):
     (or with an empty one) produces point-documented findings."""
     from skypilot_tpu.analysis.checkers import fault_points
     findings = list(fault_points.FaultPointsChecker().check_project(
-        str(tmp_path), ()))
+        _project(str(tmp_path))))
     assert any(f.rule == 'point-documented' for f in findings)
 
 
@@ -667,7 +674,7 @@ def test_metrics_names_checker_flags_bad_metric():
                           'A deliberately miscounted fixture metric.')
     try:
         findings = list(metrics_names.MetricsNamesChecker()
-                        .check_project(core.repo_root(), ()))
+                        .check_project(_project(core.repo_root())))
         assert any(f.rule == 'counter-suffix'
                    and 'skytpu_bad_lint_fixture' in f.message
                    for f in findings)
@@ -686,7 +693,7 @@ def test_metrics_names_exposition_accepts_bucket_exemplar():
     try:
         hist.observe(0.05, trace_id='a1b2c3d4' * 4)
         findings = list(metrics_names.MetricsNamesChecker()
-                        .check_project(core.repo_root(), ()))
+                        .check_project(_project(core.repo_root())))
         assert not [f for f in findings if f.rule == 'exposition'], \
             [f.message for f in findings]
     finally:
@@ -710,7 +717,7 @@ def test_metrics_names_exposition_flags_non_bucket_exemplar():
                               'A fixture.')
     try:
         findings = list(metrics_names.MetricsNamesChecker()
-                        .check_project(core.repo_root(), ()))
+                        .check_project(_project(core.repo_root())))
         assert any(f.rule == 'exposition'
                    and 'non-bucket' in f.message
                    for f in findings)
@@ -812,14 +819,474 @@ def test_unknown_check_name_is_an_error():
         core.run(checks=['no-such-check'])
 
 
-def test_all_five_issue_checkers_registered():
+def test_all_ten_checkers_registered():
     names = set(core.all_checkers())
     assert {'trace-safety', 'env-registry', 'async-discipline',
-            'lock-discipline', 'metrics-names',
-            'fault-points'} <= names
+            'lock-discipline', 'metrics-names', 'fault-points',
+            'host-sync-budget', 'donation-discipline',
+            'resource-pairing', 'lock-coverage'} <= names
 
 
 def test_committed_baseline_is_loadable():
     path = baseline_lib.default_path(core.repo_root())
     assert os.path.exists(path), 'commit the baseline file'
     baseline_lib.load(path)  # must not raise
+
+
+# --- host-sync-budget -------------------------------------------------------
+
+def test_host_sync_budget_flags_over_budget_path(tmp_path):
+    findings = _run_snippet(tmp_path, """
+        import jax
+
+        # skytpu-lint: hot-path[1]
+        def step(state):
+            toks = jax.device_get(state.tokens)
+            mask = jax.device_get(state.mask)
+            return toks, mask
+    """, 'host-sync-budget')
+    assert 'sync-budget' in _rules(findings)
+
+
+def test_host_sync_budget_counts_item_and_coercions(tmp_path):
+    findings = _run_snippet(tmp_path, """
+        import numpy as np
+
+        # skytpu-lint: hot-path[0]
+        def peek(state):
+            if bool(state.flag):
+                return state.count.item()
+            return np.asarray(state.tokens)
+    """, 'host-sync-budget')
+    assert 'sync-budget' in _rules(findings)
+
+
+def test_host_sync_budget_flags_sync_in_loop(tmp_path):
+    findings = _run_snippet(tmp_path, """
+        import jax
+
+        # skytpu-lint: hot-path[1]
+        def drain(state, slots):
+            for slot in slots:
+                token = jax.device_get(state.last[slot])
+            return token
+    """, 'host-sync-budget')
+    assert 'sync-in-loop' in _rules(findings)
+
+
+def test_host_sync_budget_passes_branches_sharing_the_budget(tmp_path):
+    """An if/else where EACH arm syncs once is still a max-path of
+    one — the budget is per execution, not per occurrence."""
+    findings = _run_snippet(tmp_path, """
+        import jax
+
+        # skytpu-lint: hot-path[1]
+        def snapshot(state, quantized):
+            if quantized:
+                host = jax.device_get(state.packed)
+            else:
+                host = jax.device_get(state.raw)
+            return host
+    """, 'host-sync-budget')
+    assert findings == []
+
+
+def test_host_sync_budget_ignores_unannotated_functions(tmp_path):
+    findings = _run_snippet(tmp_path, """
+        import jax
+
+        def debug_dump(state):
+            a = jax.device_get(state.a)
+            b = jax.device_get(state.b)
+            return a, b
+    """, 'host-sync-budget')
+    assert findings == []
+
+
+# --- donation-discipline ----------------------------------------------------
+
+def test_donation_flags_read_after_donate(tmp_path):
+    findings = _run_snippet(tmp_path, """
+        import functools
+
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def fast(cache, x):
+            return cache
+
+        def run(cache, x):
+            out = fast(cache, x)
+            return cache['length']
+    """, 'donation-discipline')
+    assert 'use-after-donate' in _rules(findings)
+
+
+def test_donation_flags_read_on_exception_path(tmp_path):
+    """The handler-only read: reachable exclusively via the CFG's
+    exception edge out of emit() — a straight-line walk misses it."""
+    findings = _run_snippet(tmp_path, """
+        import functools
+
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def fast(cache, x):
+            return cache
+
+        def run(cache, x, log):
+            out = fast(cache, x)
+            try:
+                emit(out)
+            except Exception:
+                log.warning('emit failed for %s', cache)
+            return out
+    """, 'donation-discipline')
+    assert 'use-after-donate' in _rules(findings)
+
+
+def test_donation_flags_loop_back_edge_re_donation(tmp_path):
+    """A loop that donates the same handle every iteration feeds a
+    dead buffer back in on iteration two — the back edge reaches the
+    donating statement with the chain still dead."""
+    findings = _run_snippet(tmp_path, """
+        import functools
+
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def fast(cache, x):
+            return cache
+
+        def run(cache, xs):
+            for x in xs:
+                out = fast(cache, x)
+            return out
+    """, 'donation-discipline')
+    assert 'use-after-donate' in _rules(findings)
+
+
+def test_donation_passes_rebound_handle(tmp_path):
+    """The blessed pattern: the donated name is rebound by the very
+    call (or a prefix rebind downstream) before any later read."""
+    findings = _run_snippet(tmp_path, """
+        import functools
+
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def fast(cache, x):
+            return cache
+
+        def run(cache, xs):
+            for x in xs:
+                cache = fast(cache, x)
+            return cache['length']
+
+        def run_attr(state, x):
+            state.cache = fast(state.cache, x)
+            return state.cache
+    """, 'donation-discipline')
+    assert findings == []
+
+
+def test_donation_prefix_rebind_resurrects_chain(tmp_path):
+    findings = _run_snippet(tmp_path, """
+        import functools
+
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def fast(cache, x):
+            return cache
+
+        def run(state, x, fresh):
+            out = fast(state.cache, x)
+            state = fresh(out)
+            return state.cache
+    """, 'donation-discipline')
+    assert findings == []
+
+
+# --- resource-pairing -------------------------------------------------------
+
+def test_resource_pairing_flags_exception_path_leak(tmp_path):
+    """The seeded acquire-leak: the release exists on the normal path,
+    but the call between acquire and release can raise — the
+    exception EDGE leaks the pin. Straight-line scans pass this."""
+    findings = _run_snippet(tmp_path, """
+        class Admitter:
+            def admit(self, toks):
+                pages = self._prefix.match(toks)
+                self._prefix.acquire(pages)
+                self._dispatch(pages)
+                self._prefix.release(pages)
+    """, 'resource-pairing')
+    assert 'use-after-donate' not in _rules(findings)
+    assert 'unreleased-acquire' in _rules(findings)
+    assert 'exception path' in findings[0].message
+
+
+def test_resource_pairing_flags_normal_path_leak(tmp_path):
+    findings = _run_snippet(tmp_path, """
+        class Pool:
+            def grab(self, n):
+                pages = self._alloc.reserve(n)
+                self._count += n
+                return None
+    """, 'resource-pairing')
+    assert 'unreleased-acquire' in _rules(findings)
+
+
+def test_resource_pairing_passes_handler_release(tmp_path):
+    findings = _run_snippet(tmp_path, """
+        class Admitter:
+            def admit(self, toks):
+                pages = self._prefix.match(toks)
+                self._prefix.acquire(pages)
+                try:
+                    self._dispatch(pages)
+                except BaseException:
+                    self._prefix.release(pages)
+                    raise
+                self._prefix.release(pages)
+    """, 'resource-pairing')
+    assert findings == []
+
+
+def test_resource_pairing_passes_finally_release(tmp_path):
+    findings = _run_snippet(tmp_path, """
+        class Admitter:
+            def admit(self, toks):
+                pages = self._prefix.match(toks)
+                self._prefix.acquire(pages)
+                try:
+                    self._dispatch(pages)
+                finally:
+                    self._prefix.release(pages)
+    """, 'resource-pairing')
+    assert findings == []
+
+
+def test_resource_pairing_passes_ownership_transfers(tmp_path):
+    """Publishing into a tracked structure, returning the pages, and
+    the releases[...] marker all discharge the obligation."""
+    findings = _run_snippet(tmp_path, """
+        class Pool:
+            def publish(self, slot, n):
+                pages = self._alloc.reserve(n)
+                self._slot_pages[slot] = pages
+
+            def hand_out(self, n):
+                pages = self._alloc.reserve(n)
+                return pages
+
+            def forward(self, key, n):
+                pages = self._alloc.reserve(n)
+                self._cache.insert(key, pages)  # skytpu-lint: releases[self._alloc]
+    """, 'resource-pairing')
+    assert findings == []
+
+
+def test_resource_pairing_accepts_guarded_release_attempt(tmp_path):
+    """The engine's branch-correlated shape: acquire under `if
+    matched:`, release under the correlated `if matched:` inside the
+    shortage branch. Path-blind analysis sees an infeasible leak;
+    the if-subtree rule treats the attempted discharge as enough."""
+    findings = _run_snippet(tmp_path, """
+        class Admitter:
+            def admit(self, toks):
+                matched = self._prefix.match(toks)
+                if matched:
+                    self._prefix.acquire(matched)
+                if self._full():
+                    if matched:
+                        self._prefix.release(matched)
+                    return None
+                self._slots[0] = matched
+    """, 'resource-pairing')
+    assert findings == []
+
+
+def test_resource_pairing_skips_lock_receivers(tmp_path):
+    findings = _run_snippet(tmp_path, """
+        class Worker:
+            def poke(self):
+                self._lock.acquire()
+                self._count += 1
+    """, 'resource-pairing')
+    assert findings == []
+
+
+# --- lock-coverage ----------------------------------------------------------
+
+def test_lock_coverage_flags_unguarded_mutation(tmp_path):
+    findings = _run_snippet(tmp_path, """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def add(self, x):
+                with self._lock:
+                    self._items.append(x)
+
+            def clear_fast(self):
+                self._items = []
+    """, 'lock-coverage')
+    assert _rules(findings) == ['unguarded-mutation']
+    assert '_items' in findings[0].message
+
+
+def test_lock_coverage_passes_conventional_escapes(tmp_path):
+    """with-body mutation, *_locked methods, __init__, and the
+    explicit acquire/try/finally/release pattern are all covered."""
+    findings = _run_snippet(tmp_path, """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+                self._hits = 0
+
+            def add(self, x):
+                with self._lock:
+                    self._items.append(x)
+                    self._hits += 1
+
+            def _clear_locked(self):
+                self._items = []
+
+            def drain(self):
+                self._lock.acquire()
+                try:
+                    out = list(self._items)
+                    self._items = []
+                    self._hits += 1
+                finally:
+                    self._lock.release()
+                return out
+    """, 'lock-coverage')
+    assert findings == []
+
+
+def test_lock_coverage_flags_mutation_after_flow_release(tmp_path):
+    """must_hold is flow-sensitive: a mutation AFTER the release on
+    the same path is unguarded even though an acquire appears earlier
+    in the method."""
+    findings = _run_snippet(tmp_path, """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def add(self, x):
+                with self._lock:
+                    self._items.append(x)
+
+            def sloppy(self):
+                self._lock.acquire()
+                self._lock.release()
+                self._items = []
+    """, 'lock-coverage')
+    assert 'unguarded-mutation' in _rules(findings)
+
+
+def test_lock_coverage_ignores_unguarded_attributes(tmp_path):
+    """Attributes never mutated under the lock are outside the
+    inferred contract — single-owner state stays unflagged."""
+    findings = _run_snippet(tmp_path, """
+        import threading
+
+        class Mixed:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._shared = []
+                self._scratch = 0
+
+            def record(self, x):
+                with self._lock:
+                    self._shared.append(x)
+
+            def bump(self):
+                self._scratch += 1
+    """, 'lock-coverage')
+    assert findings == []
+
+
+def test_lock_coverage_walks_nested_worker_functions(tmp_path):
+    """A nested closure (thread target) mutating guarded state without
+    the lock is exactly the race the rule exists for."""
+    findings = _run_snippet(tmp_path, """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def add(self, x):
+                with self._lock:
+                    self._items.append(x)
+
+            def start(self):
+                def worker():
+                    self._items.append(None)
+                threading.Thread(target=worker).start()
+    """, 'lock-coverage')
+    assert 'unguarded-mutation' in _rules(findings)
+
+
+# --- baseline v2 migration --------------------------------------------------
+
+def test_baseline_v1_load_refuses_with_migrate_hint(tmp_path):
+    path = tmp_path / 'bl.json'
+    path.write_text(json.dumps({'version': 1, 'entries': {}}))
+    with pytest.raises(ValueError, match='migrate-baseline'):
+        baseline_lib.load(str(path))
+
+
+def test_baseline_v1_migrates_in_place_carrying_counts(tmp_path):
+    """A v1 (line-snippet) baseline rewrites to v2 in place: entries
+    matching a current finding's LEGACY fingerprint carry their count
+    into the statement-keyed scheme; stale entries drop."""
+    src = tmp_path / 'mod.py'
+    src.write_text("import os\n"
+                   "def f():\n"
+                   "    return os.environ.get('SKYTPU_DEBUG')\n"
+                   "def g():\n"
+                   "    return os.environ.get('SKYTPU_DEBUG')\n")
+    findings, _ = core.run(paths=[str(src)], checks=['env-registry'],
+                           root=str(tmp_path))
+    assert len(findings) == 2
+
+    legacy = findings[0].legacy_fingerprint()
+    v1 = {'version': 1,
+          'entries': {
+              legacy: {'check': findings[0].check,
+                       'rule': findings[0].rule,
+                       'path': findings[0].path,
+                       'snippet': findings[0].snippet,
+                       'count': 2},
+              'dead0000dead0000': {'check': 'env-registry',
+                                   'rule': 'direct-read',
+                                   'path': 'gone.py',
+                                   'snippet': 'x = 1',
+                                   'count': 5}}}
+    bl_path = tmp_path / 'bl.json'
+    bl_path.write_text(json.dumps(v1))
+
+    carried = baseline_lib.migrate(str(bl_path), findings)
+    assert carried == 1  # the stale entry dropped
+
+    entries = baseline_lib.load(str(bl_path))  # v2 now: loads clean
+    new, baselined = baseline_lib.partition(findings, entries)
+    assert new == [] and len(baselined) == 2  # count survived
+
+    # Idempotent: a second migrate is a no-op.
+    assert baseline_lib.migrate(str(bl_path), findings) == -1

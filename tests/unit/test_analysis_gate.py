@@ -27,10 +27,11 @@ def test_analysis_runs_clean_over_package():
     assert proc.returncode == 0, proc.stdout + proc.stderr
     doc = json.loads(proc.stdout)
     assert doc['new'] == [], json.dumps(doc['new'], indent=1)
-    # Every checker participated.
+    # Every checker participated — the four flow checkers included.
     assert {'trace-safety', 'env-registry', 'async-discipline',
-            'lock-discipline', 'metrics-names',
-            'fault-points'} <= set(doc['checks'])
+            'lock-discipline', 'metrics-names', 'fault-points',
+            'host-sync-budget', 'donation-discipline',
+            'resource-pairing', 'lock-coverage'} <= set(doc['checks'])
 
 
 def test_cli_exits_nonzero_on_new_finding(tmp_path):
@@ -49,7 +50,9 @@ def test_cli_list_checks():
     proc = _run_cli('--list-checks')
     assert proc.returncode == 0
     for name in ('trace-safety', 'env-registry', 'async-discipline',
-                 'lock-discipline', 'metrics-names', 'fault-points'):
+                 'lock-discipline', 'metrics-names', 'fault-points',
+                 'host-sync-budget', 'donation-discipline',
+                 'resource-pairing', 'lock-coverage'):
         assert name in proc.stdout
 
 
@@ -62,3 +65,23 @@ def test_cli_text_format_reports_location_and_rule(tmp_path):
     assert proc.returncode == 1
     assert 'bad.py:3' in proc.stdout
     assert '[async-discipline/blocking-call]' in proc.stdout
+
+
+def test_cli_github_format_emits_error_annotations(tmp_path):
+    bad = tmp_path / 'bad.py'
+    bad.write_text("import time\n"
+                   "async def h():\n"
+                   "    time.sleep(1)\n")
+    proc = _run_cli(str(bad), '--checks', 'async-discipline',
+                    '--format', 'github')
+    assert proc.returncode == 1
+    assert '::error file=' in proc.stdout
+    assert 'line=3' in proc.stdout
+    assert 'async-discipline/blocking-call' in proc.stdout
+
+
+def test_cli_changed_only_with_no_python_changes_is_clean():
+    """--changed-only against HEAD scans only modified .py files (none
+    on a clean tree) and exits 0 (the fast pre-gate in run_full.sh)."""
+    proc = _run_cli('--changed-only', 'HEAD')
+    assert proc.returncode == 0, proc.stdout + proc.stderr
