@@ -621,6 +621,337 @@ def run_kill_replica(args):
     }
 
 
+# --- disaggregated prefill/decode drill (the handoff capstone) --------------
+
+def _hist_quantile_delta(hist, before, after, q):
+    """Approximate quantile of the samples a histogram gained between
+    two child_snapshot() readings, resolved to the bucket upper bound
+    (the same convention fleetsim's SLO gate uses). None when the
+    window saw no samples or the quantile landed in +Inf."""
+    cum_b, _, n_b = before
+    cum_a, _, n_a = after
+    total = n_a - n_b
+    if total <= 0:
+        return None
+    rank = q * total
+    for bound, ca, cb in zip(hist.buckets, cum_a, cum_b):
+        if ca - cb >= rank:
+            return bound
+    return None
+
+
+async def _scrape_counter(session, url: str, name: str) -> float:
+    """Sum one counter family off a replica's /metrics endpoint
+    (label sets summed); 0.0 when the replica is unreachable."""
+    try:
+        async with session.get(f'{url}/metrics') as resp:
+            text = await resp.text()
+    except Exception:  # noqa: BLE001 — scrape is evidence, not gating
+        return 0.0
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith(f'{name} ') or line.startswith(f'{name}{{'):
+            try:
+                total += float(line.rsplit(' ', 1)[-1])
+            except ValueError:
+                pass
+    return total
+
+
+async def _disagg_pass(lb_url: str, seed: int, requests: int,
+                       concurrency: int, long_len: int,
+                       short_len: int, max_new: int, kill=None):
+    """One measured pass of the skewed long-prompt/short-gen streamed
+    workload: even requests are long (prefill-pool shape), odd ones
+    short (decode-pool shape); the SAME seed regenerates the SAME
+    prompts for the co-located baseline. `kill` = (at_seconds, proc)
+    SIGTERMs one replica mid-pass."""
+    import signal
+
+    import aiohttp
+    rng = random.Random(seed)
+    prompts = []
+    for i in range(requests):
+        n = long_len if i % 2 == 0 else short_len
+        prompts.append([rng.randint(1, 200) for _ in range(n)])
+    results, errors = [], []
+    sem = asyncio.Semaphore(concurrency)
+    timeout = aiohttp.ClientTimeout(total=None, sock_connect=30)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+
+        async def bounded(i: int):
+            async with sem:
+                # 503 is backpressure, not token loss: mid-kill the
+                # surviving decode replica absorbs the whole pool and
+                # sheds load (Retry-After) until the drain finishes.
+                # It surfaces from raise_for_status() BEFORE any
+                # token streams, so a retry never double-counts a
+                # partial stream. Anything else is DATA.
+                for _ in range(80):
+                    try:
+                        r = await _one_request(session, lb_url, 0,
+                                               max_new,
+                                               prompt=prompts[i])
+                        r['long'] = len(prompts[i]) >= long_len
+                        results.append(r)
+                        return
+                    except aiohttp.ClientResponseError as e:
+                        if e.status != 503:
+                            errors.append(f'{type(e).__name__}: {e}')
+                            return
+                        await asyncio.sleep(0.25)
+                    except Exception as e:  # noqa: BLE001 — a
+                        # failed stream is DATA (the failed count),
+                        # not an abort.
+                        errors.append(f'{type(e).__name__}: {e}')
+                        return
+                errors.append('503 backpressure never cleared')
+
+        tasks = [bounded(i) for i in range(requests)]
+        if kill is not None:
+            at, proc = kill
+
+            async def killer():
+                await asyncio.sleep(at)
+                proc.send_signal(signal.SIGTERM)
+
+            tasks.append(killer())
+        t0 = time.perf_counter()
+        await asyncio.gather(*tasks)
+        wall = time.perf_counter() - t0
+    return results, errors, wall
+
+
+def _disagg_phase_summary(results, errors, wall, max_new):
+    long_ttft = [r['ttft'] for r in results if r.get('long')]
+    short_ttft = [r['ttft'] for r in results if not r.get('long')]
+    short_streams = [r for r in results if r['tokens'] != max_new]
+    return {
+        'requests': len(results) + len(errors),
+        'failed': len(errors) + len(short_streams),
+        'short_streams': len(short_streams),
+        'client_errors': errors[:5],
+        'wall_s': round(wall, 3),
+        # TTFT per pool: long requests enter through the prefill
+        # pool, short ones through the decode pool.
+        'ttft_prefill_pool_p50_s': round(_pct(long_ttft, 0.5), 4),
+        'ttft_prefill_pool_p95_s': round(_pct(long_ttft, 0.95), 4),
+        'ttft_decode_pool_p50_s': round(_pct(short_ttft, 0.5), 4),
+        'ttft_decode_pool_p95_s': round(_pct(short_ttft, 0.95), 4),
+    }
+
+
+def run_disagg(args):
+    """The disaggregation capstone: two real replica pools behind the
+    REAL HTTP LoadBalancer. Long streamed prompts classify for the
+    prefill pool (the threshold env is set low), pause at the
+    prefill->decode boundary, and hand off onto the decode pool;
+    short requests route decode-side directly. Three phases, same
+    seed: a CO-LOCATED baseline (no pools, no handoff), the
+    disaggregated pass, and a chaos pass that SIGTERMs one decode
+    replica mid-run — the degradation ladder (decode-pool restore ->
+    co-located resume -> crash migration) must keep every stream
+    token-complete. rc=0 iff no phase failed a single request, the
+    disaggregated pass completed at least one handoff, and the chaos
+    pass still attempted them. Note the generation budget is capped
+    at 24 tokens so the long-prompt class stays short-gen (under
+    SKYTPU_LB_POOL_MAX_NEW_THRESHOLD) — the shape the two-leg route
+    exists for."""
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    sys.path.insert(0, repo_root)
+    from skypilot_tpu.observability import instruments as obs
+    from skypilot_tpu.serve import load_balancer as lb_lib
+
+    n_prefill = max(1, args.disagg_prefill)
+    n_decode = max(2, args.disagg_decode)
+    thr = args.disagg_prompt_threshold
+    long_len = max(args.prompt_len, 2 * thr)
+    short_len = max(8, thr // 4)
+    max_new = min(args.max_new_tokens, 24)
+    # The LB runs IN this process: the threshold env gates its
+    # classify/handoff decisions (the servers never read it).
+    os.environ['SKYTPU_LB_POOL_PROMPT_THRESHOLD'] = str(thr)
+    kill_at = (args.kill_replica_at
+               if args.kill_replica_at is not None else 1.5)
+
+    ports = [_free_port() for _ in range(n_prefill + n_decode)]
+    urls = [f'http://127.0.0.1:{p}' for p in ports]
+    prefill_urls = urls[:n_prefill]
+    decode_urls = urls[n_prefill:]
+    pools = {u: 'prefill' for u in prefill_urls}
+    pools.update({u: 'decode' for u in decode_urls})
+    max_seq = max(2048, long_len + max_new + 64)
+    env = dict(os.environ,
+               SKYTPU_DRAIN_DEADLINE_SECONDS=str(args.drain_deadline))
+    procs = []
+    log = open(args.lb_server_log, 'ab') if args.lb_server_log \
+        else subprocess.DEVNULL
+    try:
+        for port in ports:
+            procs.append(subprocess.Popen(
+                [sys.executable, '-m', 'skypilot_tpu.inference.server',
+                 '--model', 'tiny', '--port', str(port),
+                 '--batch-size', str(max(8, args.concurrency)),
+                 '--max-seq-len', str(max_seq)],
+                cwd=repo_root, env=env, stdout=log, stderr=log))
+
+        async def _prepare():
+            import aiohttp
+            timeout = aiohttp.ClientTimeout(total=None,
+                                            sock_connect=30)
+            async with aiohttp.ClientSession(
+                    timeout=timeout) as session:
+                for url in urls:
+                    await _wait_ready(session, url,
+                                      args.ready_timeout)
+                    # Absorb both shape classes' compiles on every
+                    # replica: any replica may host either leg.
+                    await _one_request(session, url, long_len,
+                                       max_new)
+                    await _one_request(session, url, short_len,
+                                       max_new)
+
+        asyncio.run(_prepare())
+
+        def counters():
+            return {
+                'attempts': obs.HANDOFF_ATTEMPTS.value(),
+                'successes': obs.HANDOFF_SUCCESSES.value(),
+                'fallbacks': obs.HANDOFF_FALLBACKS.value(),
+                'mig_attempts': obs.MIGRATION_ATTEMPTS.value(),
+                'mig_successes': obs.MIGRATION_SUCCESSES.value(),
+                'midstream': obs.LB_MIDSTREAM_FAILURES.value(),
+                'transfer': obs.HANDOFF_TRANSFER_SECONDS
+                            .child_snapshot(),
+            }
+
+        def deltas(before, after):
+            d = {k: int(after[k] - before[k])
+                 for k in before if k != 'transfer'}
+            d['transfer_p50_s'] = _hist_quantile_delta(
+                obs.HANDOFF_TRANSFER_SECONDS, before['transfer'],
+                after['transfer'], 0.5)
+            d['transfer_p95_s'] = _hist_quantile_delta(
+                obs.HANDOFF_TRANSFER_SECONDS, before['transfer'],
+                after['transfer'], 0.95)
+            return d
+
+        async def _lease_fallbacks():
+            import aiohttp
+            timeout = aiohttp.ClientTimeout(total=None,
+                                            sock_connect=30)
+            async with aiohttp.ClientSession(
+                    timeout=timeout) as session:
+                vals = [await _scrape_counter(
+                            session, u,
+                            'skytpu_handoff_fallbacks_total')
+                        for u in urls]
+                return sum(vals)
+
+        phases = {}
+        seed = 20240807
+        # Phase 1: co-located baseline — same servers, no pools, so
+        # no handoff flags and no two-leg route; SAME seed as the
+        # disaggregated pass.
+        lb = lb_lib.LoadBalancer('round_robin',
+                                 honor_env_policy=False)
+        lb.set_replicas(urls)
+        lb_port = lb.start()
+        try:
+            res, errs, wall = asyncio.run(_disagg_pass(
+                f'http://127.0.0.1:{lb_port}', seed, args.requests,
+                args.concurrency, long_len, short_len, max_new))
+        finally:
+            lb.stop()
+        phases['baseline'] = _disagg_phase_summary(
+            res, errs, wall, max_new)
+
+        # Phase 2: the disaggregated route.
+        lb = lb_lib.LoadBalancer('round_robin',
+                                 honor_env_policy=False)
+        lb.set_replicas(urls, pools=pools)
+        lb_port = lb.start()
+        c0 = counters()
+        lease0 = asyncio.run(_lease_fallbacks())
+        try:
+            res, errs, wall = asyncio.run(_disagg_pass(
+                f'http://127.0.0.1:{lb_port}', seed, args.requests,
+                args.concurrency, long_len, short_len, max_new))
+        finally:
+            lb.stop()
+        phases['disagg'] = _disagg_phase_summary(
+            res, errs, wall, max_new)
+        phases['disagg'].update(deltas(c0, counters()))
+        phases['disagg']['lease_expiry_fallbacks'] = int(
+            asyncio.run(_lease_fallbacks()) - lease0)
+
+        # Phase 3: chaos — SIGTERM one decode replica mid-pass; the
+        # ladder (and, for streams already restored onto the dying
+        # replica, the crash-migration backstop) must keep every
+        # stream token-complete.
+        lb = lb_lib.LoadBalancer('round_robin',
+                                 honor_env_policy=False)
+        lb.set_replicas(urls, pools=pools)
+        lb_port = lb.start()
+        c0 = counters()
+        try:
+            res, errs, wall = asyncio.run(_disagg_pass(
+                f'http://127.0.0.1:{lb_port}', seed + 1,
+                args.requests, args.concurrency, long_len,
+                short_len, max_new,
+                kill=(kill_at, procs[n_prefill + n_decode - 1])))
+        finally:
+            lb.stop()
+        phases['kill_decode'] = _disagg_phase_summary(
+            res, errs, wall, max_new)
+        phases['kill_decode'].update(deltas(c0, counters()))
+        phases['kill_decode']['kill_replica_at_s'] = kill_at
+    finally:
+        for proc in procs:
+            proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        if log is not subprocess.DEVNULL:
+            log.close()
+
+    failed = sum(p['failed'] for p in phases.values())
+    attempts = (phases['disagg']['attempts']
+                + phases['kill_decode']['attempts'])
+    successes = (phases['disagg']['successes']
+                 + phases['kill_decode']['successes'])
+    ratio = round(successes / attempts, 4) if attempts else 0.0
+    return {
+        'metric': 'serve_disagg_handoff_success_ratio',
+        'value': ratio,
+        'unit': 'ratio',
+        'rc': 0 if (failed == 0
+                    and phases['disagg']['successes'] > 0
+                    and phases['kill_decode']['attempts'] > 0) else 1,
+        'extra': {
+            'workload': 'disagg',
+            'prefill_replicas': n_prefill,
+            'decode_replicas': n_decode,
+            'prompt_threshold': thr,
+            'long_prompt_len': long_len,
+            'short_prompt_len': short_len,
+            'max_new_tokens': max_new,
+            'requests_per_phase': args.requests,
+            'concurrency': args.concurrency,
+            'failed_requests': failed,
+            'handoff_attempts': attempts,
+            'handoff_successes': successes,
+            'handoff_fallbacks': (
+                phases['disagg']['fallbacks']
+                + phases['kill_decode']['fallbacks']),
+            'phases': phases,
+        },
+    }
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--url', default='http://127.0.0.1:8080')
@@ -680,14 +1011,39 @@ def main() -> None:
                         help='SKYTPU_DRAIN_DEADLINE_SECONDS handed to '
                              'the launched replicas in the '
                              '--kill-replica-at drill.')
+    parser.add_argument('--disagg', action='store_true',
+                        help='Disaggregated prefill/decode drill: two '
+                             'real replica pools behind the real HTTP '
+                             'LB, a skewed long-prompt/short-gen '
+                             'streamed workload, a same-seed '
+                             'co-located baseline, and a chaos pass '
+                             'that SIGTERMs one decode replica '
+                             '(--kill-replica-at seconds into it, '
+                             'default 1.5). rc=0 iff zero failed '
+                             'streams across all phases and the '
+                             'handoff route actually ran.')
+    parser.add_argument('--disagg-prefill', type=int, default=1,
+                        help='Prefill-pool replica count in --disagg.')
+    parser.add_argument('--disagg-decode', type=int, default=2,
+                        help='Decode-pool replica count in --disagg '
+                             '(min 2: one gets SIGTERMed).')
+    parser.add_argument('--disagg-prompt-threshold', type=int,
+                        default=96,
+                        help='SKYTPU_LB_POOL_PROMPT_THRESHOLD set for '
+                             'the in-process LB in --disagg: long '
+                             'streamed prompts at/above it classify '
+                             'for the prefill pool.')
     args = parser.parse_args()
-    metric = ('serve_preemption_migrated_requests'
+    metric = ('serve_disagg_handoff_success_ratio' if args.disagg
+              else 'serve_preemption_migrated_requests'
               if args.kill_replica_at is not None
               else 'lb_affinity_warm_ttft_speedup' if args.lb_replicas
               else 'serve_warm_prefix_ttft_speedup'
               if args.shared_prefix else 'serve_decode_tokens_per_sec')
     try:
-        if args.kill_replica_at is not None:
+        if args.disagg:
+            report = run_disagg(args)
+        elif args.kill_replica_at is not None:
             report = run_kill_replica(args)
         elif args.lb_replicas:
             report = run_lb_compare(args)
@@ -708,7 +1064,9 @@ def main() -> None:
         # rc=1, never a bare traceback a driver can't gate on.
         print(json.dumps({
             'metric': metric, 'value': 0.0,
-            'unit': ('requests' if args.kill_replica_at is not None
+            'unit': ('ratio' if args.disagg
+                     else 'requests'
+                     if args.kill_replica_at is not None
                      else 'x'
                      if args.shared_prefix or args.lb_replicas
                      else 'tokens/s'),

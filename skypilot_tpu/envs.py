@@ -347,6 +347,27 @@ SKYTPU_MIGRATION_MAX_BYTES = declare(
     'refuses larger blobs (the request honest-terminates instead of '
     'shipping an unbounded payload through the LB).')
 
+# --- disaggregated prefill/decode (planned KV handoff) -----------------------
+
+SKYTPU_HANDOFF_LEASE_SECONDS = declare(
+    'SKYTPU_HANDOFF_LEASE_SECONDS', float, 5.0,
+    'Seconds a prefill replica holds a handoff-paused request\'s '
+    'slot live waiting for the LB to confirm the decode-leg restore '
+    'or call /internal/resume; past it the engine resumes decoding '
+    'locally (co-located fallback, never a lost token).')
+SKYTPU_HANDOFF_DEADLINE_SECONDS = declare(
+    'SKYTPU_HANDOFF_DEADLINE_SECONDS', float, 3.0,
+    'Total wall-clock budget for the LB\'s planned prefill->decode '
+    'handoff (restore attempts across the decode pool); past it the '
+    'LB resumes the request co-located on the prefill replica — a '
+    'counted fallback, never an error. Keep it under '
+    'SKYTPU_HANDOFF_LEASE_SECONDS or the lease resumes first.')
+SKYTPU_HANDOFF_MAX_BYTES = declare(
+    'SKYTPU_HANDOFF_MAX_BYTES', int, 256 * 1024 * 1024,
+    'Cap on a planned-handoff KV blob the LB will ship to the '
+    'decode pool; larger blobs skip the transfer and resume '
+    'co-located on the prefill replica (counted as a fallback).')
+
 # --- serve LB streaming -----------------------------------------------------
 
 SKYTPU_LB_STREAM_READ_TIMEOUT = declare(

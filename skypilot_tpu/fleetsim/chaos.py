@@ -15,11 +15,15 @@ Actions (see docs/guides/fleet-soak.md for the full reference):
                                      zone_restore
   zone_restore     {zone}            the zone is schedulable again
   preemption_wave  {count}           kill `count` random spot replicas
-  preempt_replicas {count}           preemption notices land on the
+  preempt_replicas {count, pool?}    preemption notices land on the
                                      `count` busiest READY replicas
                                      (arms `replica.preempt`); their
                                      in-flight decodes attempt the
-                                     snapshot -> migrate ladder
+                                     snapshot -> migrate ladder.
+                                     `pool` restricts the ranking to
+                                     one replica pool (e.g. the
+                                     decode pool holding handed-off
+                                     legs)
   rolling_update   {}                bump the service version (the
                                      controller's real rolling-update
                                      machinery takes over)

@@ -112,6 +112,19 @@ class ReplicaProfile:
     # skytpu_lb_midstream_failures_total, mirroring the LB.
     migration_latency_s: float = 0.0   # snapshot+restore median; 0=off
     migration_latency_sigma: float = 0.4
+    # Planned prefill->decode handoff (ISSUE 19): > 0 on a PREFILL-
+    # pool profile turns on two-leg modeling. A handoff-eligible
+    # request (the real lb.handoff_eligible predicate: streamed +
+    # tokenized + prefill-shaped) prefills here, then its decode
+    # remainder hands off to a READY decode-pool replica — the
+    # transfer gap sampled around this median lands in the REAL
+    # skytpu_handoff_transfer_seconds histogram, and attempts/
+    # successes/fallbacks land in the real skytpu_handoff_* counters
+    # the production LB emits. No decode-pool survivor (or an armed
+    # `lb.handoff` fault) is a COUNTED co-located fallback — the
+    # request still completes; a handoff is never a failure.
+    handoff_transfer_s: float = 0.0    # KV transfer median; 0 = off
+    handoff_transfer_sigma: float = 0.4
 
     def __post_init__(self):
         ways = dict(self.mesh_shape)
@@ -193,13 +206,18 @@ class SimFleet:
                  zones: Optional[List[str]] = None,
                  default_use_spot: bool = False,
                  pool_profiles: Optional[
-                     Dict[str, ReplicaProfile]] = None) -> None:
+                     Dict[str, ReplicaProfile]] = None,
+                 handoff_enabled: bool = True) -> None:
         self.service_name = service_name
         self.profile = profile
         # Disaggregated pools: per-pool latency/capacity shapes
         # (prefill-heavy vs decode-heavy hardware); replicas in an
         # unlisted pool fall back to the default profile.
         self.pool_profiles = dict(pool_profiles or {})
+        # False = the co-located baseline pass: handoff-eligible
+        # requests decode where they prefilled, even when the profile
+        # models a transfer cost.
+        self.handoff_enabled = handoff_enabled
         self.zones = list(zones or [])
         self.default_use_spot = default_use_spot
         self._clock = clock
@@ -209,6 +227,7 @@ class SimFleet:
         self._lost_zones: set = set()
         self._preemption_pending = False
         self._preempt_pending = 0
+        self._preempt_pool: Optional[str] = None
         self._tick_seconds = 1.0
 
     def profile_for(self, pool: Optional[str]) -> ReplicaProfile:
@@ -230,13 +249,18 @@ class SimFleet:
         size."""
         self._preemption_pending = True
 
-    def begin_preempt(self, count: int) -> None:
+    def begin_preempt(self, count: int,
+                      pool: Optional[str] = None) -> None:
         """Kill the `count` BUSIEST ready replicas through
         `replica.preempt` on the next probe sweep — a preemption
         notice landing on replicas that hold in-flight decodes, the
         case the snapshot/migrate ladder exists for. The point's
-        armed `times` bound caps how many actually die."""
+        armed `times` bound caps how many actually die. `pool`
+        restricts the busiest-first ranking to one pool (the
+        disaggregation scenario aims notices at the decode pool —
+        the replicas holding handed-off legs)."""
         self._preempt_pending = max(self._preempt_pending, int(count))
+        self._preempt_pool = pool
 
     # -- the ReplicaManager surface ------------------------------------------
 
@@ -359,7 +383,9 @@ class SimFleet:
             # has to rescue.
             busy = sorted(
                 (r for r in self._replicas.values()
-                 if r.state == _State.READY),
+                 if r.state == _State.READY
+                 and (self._preempt_pool is None
+                      or r.pool == self._preempt_pool)),
                 key=lambda r: (-r.tick_requests, r.replica_id))
             for r in busy[:self._preempt_pending]:
                 try:
@@ -370,6 +396,7 @@ class SimFleet:
                     r.state = _State.DEAD
                     self._migrate_inflight(r)
             self._preempt_pending = 0
+            self._preempt_pool = None
 
     def _migrate_inflight(self, r: 'SimReplica') -> None:
         """The drain -> snapshot -> migrate ladder for the requests a
@@ -515,9 +542,67 @@ class SimFleet:
             total = ttft + decode
         else:
             total = ttft + tokens * p.decode_per_token_s
+        if r.pool == 'decode':
+            # The population disaggregation protects: short requests
+            # served by the decode pool, free of long-prefill
+            # convoying. Gated against the co-located baseline.
+            obs.FLEETSIM_DECODE_TTFT_SECONDS.observe(ttft)
+        handed = self._maybe_handoff(r, p, context, ttft, total)
+        if handed is not None:
+            return handed
         r.tick_requests += 1
         r.tick_busy_s += total
         return ttft, total
+
+    def _maybe_handoff(self, r: 'SimReplica', p: ReplicaProfile,
+                       context: Optional[Dict[str, Any]],
+                       ttft: float, total: float):
+        """The planned two-leg route for one request that prefilled on
+        `r`: eligibility is the REAL LB predicate (streamed +
+        tokenized + prefill-shaped), the decode remainder moves to a
+        READY decode-pool survivor, and the transfer gap / outcome
+        counters land in the same skytpu_handoff_* series the
+        production LB emits. Returns (ttft, total) when the leg moved
+        (the caller must not re-account the request), or None for the
+        single-leg path — including the COUNTED co-located fallback,
+        which is a degraded success, never a failed request."""
+        from skypilot_tpu.serve import load_balancer as lb_lib
+        if (not self.handoff_enabled or p.handoff_transfer_s <= 0
+                or r.pool != 'prefill'
+                or not lb_lib.handoff_eligible(context)):
+            return None
+        obs.HANDOFF_ATTEMPTS.inc()
+        targets = [
+            x for x in self._replicas.values()
+            if x is not r and x.state == _State.READY
+            and x.pool == 'decode'
+            and (x.zone is None or x.zone not in self._lost_zones)]
+        ok = bool(targets)
+        if ok:
+            try:
+                faults.inject('lb.handoff',
+                              sleep_fn=self._clock.sleep,
+                              env_exc=OSError)
+            except Exception:  # noqa: BLE001 — armed = forced fallback
+                ok = False
+        if not ok:
+            obs.HANDOFF_FALLBACKS.inc()
+            return None
+        decode_s = max(0.0, total - ttft)
+        gap = self._rng.lognormvariate(
+            _mu(p.handoff_transfer_s), p.handoff_transfer_sigma)
+        obs.HANDOFF_TRANSFER_SECONDS.observe(gap)
+        obs.HANDOFF_SUCCESSES.inc()
+        tgt = self._rng.choice(targets)
+        # The decode remainder is billed at the source profile's
+        # decode parameterization (token count is the request's, not
+        # the hardware's) onto the TARGET's slots.
+        tgt.tick_busy_s += decode_s
+        r.tick_requests += 1
+        # The source slot stays live under the lease until the
+        # restore confirms — prefill work plus the transfer window.
+        r.tick_busy_s += ttft + gap
+        return ttft, ttft + gap + decode_s
 
     def end_tick(self) -> None:
         """Publish fleet-wide pressure to the same gauges the engine

@@ -77,6 +77,13 @@ class Scenario:
     compare_lb_policy: Optional[str] = None
     baseline_slos: Tuple[Any, ...] = ()
     min_hit_ratio_improvement: float = 2.0
+    # Disaggregation A/B: run a second pass with planned prefill->
+    # decode handoff DISABLED (same LB policy, same seed/traffic,
+    # fresh fleet) and evaluate `baseline_slos` over it — the
+    # co-located baseline the disaggregation scenario's decode-pool
+    # TTFT is read against, in the same report. Mutually exclusive
+    # with compare_lb_policy.
+    compare_handoff_off: bool = False
 
 
 class _PrefixWorkload:
@@ -96,6 +103,9 @@ class _PrefixWorkload:
         self.long_fraction = float(long_cfg.get('fraction', 0.0))
         self.long_tokens = int(long_cfg.get('prompt_tokens', 2048))
         self.long_max_new = int(long_cfg.get('max_new_tokens', 16))
+        # stream: True marks the long class as SSE clients — the
+        # shape lb.handoff_eligible() admits to the two-leg route.
+        self.long_stream = bool(long_cfg.get('stream', False))
         self._prefixes = [
             [rng.randint(1, 30000)
              for _ in range(self.prefix_tokens)]
@@ -105,11 +115,14 @@ class _PrefixWorkload:
     def next_context(self) -> Dict[str, Any]:
         rng = self._rng
         if self.long_fraction and rng.random() < self.long_fraction:
-            return {
+            ctx = {
                 'prompt_tokens': [rng.randint(1, 30000)
                                   for _ in range(self.long_tokens)],
                 'max_new_tokens': self.long_max_new,
             }
+            if self.long_stream:
+                ctx['stream'] = True
+            return ctx
         f = rng.randrange(self.families)
         return {
             'prompt_tokens': self._prefixes[f]
@@ -184,10 +197,19 @@ class FleetSim:
         wall_start = time.monotonic()
         primary = self._run_pass(sc.lb_policy, sc.slos, wall_start)
         baseline = None
-        if sc.compare_lb_policy and primary['crash'] is None and \
-                not primary['aborted']:
-            baseline = self._run_pass(sc.compare_lb_policy,
-                                      sc.baseline_slos, wall_start)
+        if primary['crash'] is None and not primary['aborted']:
+            if sc.compare_lb_policy:
+                baseline = self._run_pass(sc.compare_lb_policy,
+                                          sc.baseline_slos,
+                                          wall_start)
+            elif sc.compare_handoff_off:
+                # The co-located baseline: identical fleet/policy/
+                # seed, planned handoff off — decode legs stay where
+                # they prefilled.
+                baseline = self._run_pass(sc.lb_policy,
+                                          sc.baseline_slos,
+                                          wall_start,
+                                          handoff_enabled=False)
         results = list(primary['results'])
         extra = dict(primary['extra'])
         aborted = primary['aborted']
@@ -197,7 +219,7 @@ class FleetSim:
             extra['baseline'] = baseline['extra']
             aborted = aborted or baseline['aborted']
             crash = crash or baseline['crash']
-            if crash is None and not aborted:
+            if crash is None and not aborted and sc.compare_lb_policy:
                 results.append(self._improvement_assert(results))
         path, rc = slo_lib.write_report(
             self.out_dir, sc.name, results, extra=extra,
@@ -234,8 +256,8 @@ class FleetSim:
                 'detail': f'{sc.lb_policy} {a:.3f} vs '
                           f'{sc.compare_lb_policy} {b:.3f}'}
 
-    def _run_pass(self, lb_policy: str, slos,
-                  wall_start: float) -> Dict[str, Any]:
+    def _run_pass(self, lb_policy: str, slos, wall_start: float,
+                  handoff_enabled: bool = True) -> Dict[str, Any]:
         sc = self.scenario
         wall_budget = envs.SKYTPU_FLEETSIM_MAX_WALL_SECONDS.get()
         pools = self._scaled_pools() if sc.pools else None
@@ -260,7 +282,8 @@ class FleetSim:
             zones=list(sc.zones),
             default_use_spot=bool(not pools and service_cfg[
                 'replica_policy'].get('use_spot')),
-            pool_profiles=sc.pool_profiles)
+            pool_profiles=sc.pool_profiles,
+            handoff_enabled=handoff_enabled)
         lb = lb_lib.LoadBalancer(lb_policy, now_fn=vclock.now,
                                  honor_env_policy=False)
         ctl = controller_lib.ServeController(
@@ -398,6 +421,7 @@ class FleetSim:
             'replicas_configured': n_replicas,
             'replicas_driven': replicas_driven,
             'pools': sorted(pools) if pools else None,
+            'handoff_enabled': handoff_enabled,
             'simulated_seconds': round(t, 3),
             'ticks': ticks,
             'tick_seconds': self.tick_s,
@@ -442,7 +466,7 @@ class FleetSim:
         elif ev.action == 'preempt_replicas':
             count = max(1, int(round(kw['count'] * self.scale)))
             faults.arm('replica.preempt', times=count)
-            fleet.begin_preempt(count)
+            fleet.begin_preempt(count, pool=kw.get('pool'))
         elif ev.action == 'rolling_update':
             service = serve_state.get_service(self.service_name)
             serve_state.set_service_version(
@@ -959,5 +983,114 @@ register(Scenario(
             metric='skytpu_migration_interruption_seconds'),
         slo_lib.HistQuantileBelow('ttft_p95', threshold=2.0),
         slo_lib.RatioBelow('error_rate', threshold=0.01),
+    ),
+))
+
+register(Scenario(
+    name='disaggregation',
+    description=('Disaggregated prefill/decode gate (ISSUE 19): a '
+                 'skewed prompt/gen mix (35% streamed 2k-token/'
+                 '16-gen requests, the rest short interactive chat) '
+                 'through prefill + decode pools with PLANNED KV '
+                 'handoff: eligible requests prefill in the prefill '
+                 'pool, then their decode leg moves to a decode-pool '
+                 'replica (transfer gap -> the real '
+                 'skytpu_handoff_transfer_seconds). Chaos kills the '
+                 'busiest DECODE replicas mid-wave and an armed '
+                 'lb.handoff fault forces co-located fallbacks — '
+                 'both are COUNTED degradations, never failed '
+                 'requests. Gates the handoff success ratio, zero '
+                 'hard failures, transfer p95, and the decode-pool '
+                 'TTFT p95 with the co-located baseline pass (same '
+                 'seed, handoff off) in the same report.'),
+    replicas=18,                       # informational; pools govern
+    duration_s=240.0, tick_s=2.0, warmup_s=60.0,
+    traffic={'kind': 'constant', 'qps': 40.0},
+    profile=_SMOKE_PROFILE,            # fallback only; pools below
+    pools={
+        'prefill': {'role': 'prefill', 'min_replicas': 6,
+                    'max_replicas': 10,
+                    'target_queue_per_replica': 4.0,
+                    'ttft_p95_upscale_threshold': 3.0,
+                    'upscale_delay_seconds': 10,
+                    'downscale_delay_seconds': 120},
+        'decode': {'role': 'decode', 'min_replicas': 12,
+                   'max_replicas': 18,
+                   'target_queue_per_replica': 4.0,
+                   'kv_util_upscale_threshold': 0.85,
+                   'upscale_delay_seconds': 10,
+                   'downscale_delay_seconds': 120},
+    },
+    pool_profiles={
+        # Prefill-heavy hardware: absorbs the 2k-token prompts, then
+        # hands the decode remainder off (~0.3 s KV transfer, the
+        # paged-pool gather/splice envelope) — the slot stays live
+        # under the lease for the transfer window.
+        'prefill': replicas_lib.ReplicaProfile(
+            startup_median_s=6.0, startup_sigma=0.3,
+            ttft_median_s=0.7, ttft_sigma=0.4,
+            tokens_median=16, concurrency=8,
+            decode_step_s=0.12, decode_step_sigma=0.3,
+            fused_steps=8,
+            migration_latency_s=0.5,
+            handoff_transfer_s=0.3, handoff_transfer_sigma=0.4),
+        # Decode-heavy hardware: short interactive traffic plus the
+        # handed-off decode legs; killed replicas rescue their
+        # in-flight work through the PR 17 migration backstop.
+        'decode': replicas_lib.ReplicaProfile(
+            startup_median_s=6.0, startup_sigma=0.3,
+            ttft_median_s=0.35, ttft_sigma=0.4,
+            tokens_median=48, concurrency=8,
+            decode_step_s=0.12, decode_step_sigma=0.3,
+            fused_steps=8,
+            migration_latency_s=0.5),
+    },
+    workload={'families': 32, 'prefix_tokens': 256, 'tail_tokens': 16,
+              'max_new_tokens': 48,
+              'long_prompt': {'fraction': 0.35,
+                              'prompt_tokens': 2048,
+                              'max_new_tokens': 16,
+                              'stream': True}},
+    lb_policy='round_robin',
+    compare_handoff_off=True,
+    chaos=(
+        # Preemption notices land on the BUSIEST decode replicas —
+        # the ones holding handed-off legs — twice, mid-traffic.
+        {'at': 90.0, 'action': 'preempt_replicas', 'count': 2,
+         'pool': 'decode'},
+        # A few forced co-located fallbacks: the degradation rung
+        # must be exercised (and counted) without breaching 0.85.
+        {'at': 130.0, 'action': 'arm_fault', 'point': 'lb.handoff',
+         'times': 3},
+        {'at': 170.0, 'action': 'preempt_replicas', 'count': 2,
+         'pool': 'decode'},
+    ),
+    slos=(
+        slo_lib.CounterRatioAbove(
+            'handoff_success', threshold=0.85,
+            num_metric='skytpu_handoff_successes_total',
+            den_metrics=('skytpu_handoff_attempts_total',)),
+        # Zero hard failures: every degraded handoff must complete
+        # co-located, never 502.
+        slo_lib.RatioBelow('failed_requests', threshold=0.0),
+        slo_lib.HistQuantileBelow(
+            'handoff_transfer_p95', threshold=1.5,
+            metric='skytpu_handoff_transfer_seconds'),
+        slo_lib.HistQuantileBelow(
+            'decode_pool_ttft_p95', threshold=1.5,
+            metric='skytpu_fleetsim_decode_ttft_seconds'),
+        slo_lib.HistQuantileBelow('ttft_p95', threshold=3.0),
+    ),
+    # The co-located pass resolves the same series ungated: the
+    # report carries decode-pool TTFT with and without handoff side
+    # by side.
+    baseline_slos=(
+        slo_lib.HistQuantileBelow(
+            'baseline_decode_pool_ttft_p95', threshold=1e9,
+            metric='skytpu_fleetsim_decode_ttft_seconds'),
+        slo_lib.HistQuantileBelow('baseline_ttft_p95',
+                                  threshold=1e9),
+        slo_lib.RatioBelow('baseline_failed_requests',
+                           threshold=0.0),
     ),
 ))

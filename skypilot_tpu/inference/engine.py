@@ -1347,6 +1347,11 @@ class _Slot:
     # The truncated prompt, kept for the prefix cache: publishing a
     # finished request's pages needs the token sequence its KV holds.
     prompt: List[int] = dataclasses.field(default_factory=list)
+    # Disaggregated serving: True while the request is paused at the
+    # prefill->decode boundary under a handoff lease — the slot (and
+    # its KV) stays live, but the slot sits out decode dispatches
+    # until the lease expires or /internal/resume clears it.
+    handoff_pause: bool = False
 
 
 class DecodeState:
@@ -1654,6 +1659,13 @@ class InferenceEngine:
         # list.append per active slot, not a locked collector insert.
         self._req_phases: Dict[int, List[tuple]] = {}
         self._queue: List[Tuple[int, List[int], SamplingParams]] = []
+        # Disaggregated serving: request ids admitted with the handoff
+        # flag (pause at the prefill->decode boundary), the lease
+        # deadline per paused request, and the paused requests whose
+        # snapshot the server loop already exported as a handoff frame.
+        self._handoff_requests: set = set()
+        self._handoff_deadline: Dict[int, float] = {}
+        self._handoff_exported: set = set()
         self._finished: Dict[int, List[int]] = {}
         self._finished_logprobs: Dict[int, List[float]] = {}
         self._last_logprobs: Dict[int, List[float]] = {}
@@ -1663,7 +1675,14 @@ class InferenceEngine:
     # -- public --------------------------------------------------------------
 
     def submit(self, prompt_tokens: List[int],
-               sampling: Optional[SamplingParams] = None) -> int:
+               sampling: Optional[SamplingParams] = None,
+               handoff: bool = False) -> int:
+        """`handoff=True` (disaggregated serving) pauses the request
+        at the prefill->decode boundary — first generated token
+        emitted, slot held live under a lease — so the LB can restore
+        it onto the decode pool; on lease expiry or an explicit
+        resume it decodes here as if never flagged. Ignored on
+        speculative engines (their snapshots are refused anyway)."""
         if not prompt_tokens:
             # Prefill gathers last-token logits at prompt_len-1; an
             # empty prompt would wrap to index -1 and sample garbage.
@@ -1686,6 +1705,8 @@ class InferenceEngine:
         self._next_id += 1
         self._queue.append((request_id, list(prompt_tokens),
                             sampling or SamplingParams()))
+        if handoff and self._draft_params is None:
+            self._handoff_requests.add(request_id)
         obs.QUEUE_DEPTH.set(len(self._queue))
         self._trace_begin(request_id)
         return request_id
@@ -1724,6 +1745,7 @@ class InferenceEngine:
         before = len(self._queue)
         self._queue = [(rid, t, s) for rid, t, s in self._queue
                        if rid != request_id]
+        self._handoff_requests.discard(request_id)
         aborted = before - len(self._queue)
         self._finished.pop(request_id, None)
         self._finished_logprobs.pop(request_id, None)
@@ -1743,6 +1765,9 @@ class InferenceEngine:
         as finished."""
         aborted = len(self._queue)
         self._queue.clear()
+        self._handoff_requests.clear()
+        self._handoff_deadline.clear()
+        self._handoff_exported.clear()
         self._finished.clear()
         self._finished_logprobs.clear()
         self._last_logprobs.clear()
@@ -1766,6 +1791,16 @@ class InferenceEngine:
         return bool(self._queue) or any(
             s is not None for s in self.state.slots)
 
+    @property
+    def has_runnable_work(self) -> bool:
+        """has_work minus slots parked under a handoff lease: when
+        every live slot is paused there is nothing to compute until a
+        resume lands or a lease expires — the serving loop can poll
+        gently instead of spinning step() hot."""
+        return bool(self._queue) or any(
+            s is not None and not s.handoff_pause
+            for s in self.state.slots)
+
     def run_to_completion(self, max_steps: int = 100000
                           ) -> Dict[int, List[int]]:
         results: Dict[int, List[int]] = {}
@@ -1779,6 +1814,84 @@ class InferenceEngine:
         # strand them (has_work is already False on entry then).
         results.update(self.finished())
         return results
+
+    # -- planned prefill->decode handoff (disaggregated serving) -------------
+
+    def handoff_pending(self) -> List[int]:
+        """Requests paused at the prefill->decode boundary whose
+        snapshot has not been exported yet — the server loop turns
+        each into one non-terminal `handoff` SSE frame. Mid-prefill
+        and queued requests can never appear here: the pause only
+        happens after the first generated token exists, so an
+        exported blob always carries real KV (layout 'paged'/'dense',
+        never 'none')."""
+        return [s.request_id for s in self.state.slots
+                if s is not None and s.handoff_pause
+                and s.request_id not in self._handoff_exported]
+
+    def mark_handoff_exported(self, request_id: int) -> None:
+        self._handoff_exported.add(request_id)
+
+    def resume_handoff(self, request_id: int) -> bool:
+        """Resume local decode for a handoff-paused request (the LB's
+        ladder exhausted, or an explicit /internal/resume): the slot
+        simply rejoins the decode batch — a state transition on host
+        bookkeeping, zero recompiles, zero token loss. False when the
+        request is not paused here (already resumed by lease expiry,
+        finished, aborted, or never admitted)."""
+        for s in self.state.slots:
+            if s is not None and s.request_id == request_id:
+                if not s.handoff_pause:
+                    return False
+                s.handoff_pause = False
+                self._handoff_deadline.pop(request_id, None)
+                return True
+        return False
+
+    def _maybe_pause_handoff(self, slot: _Slot) -> None:
+        """Pause a handoff-flagged request now that its first token
+        exists — unless it already finished (nothing left to hand
+        off) or the engine can't snapshot it (draft attached). An
+        armed `engine.handoff_lease` fault refuses the lease: the
+        request decodes co-located and no frame is exported."""
+        rid = slot.request_id
+        if rid not in self._handoff_requests:
+            return
+        self._handoff_requests.discard(rid)
+        if self._draft_params is not None:
+            return
+        s = slot.params
+        done = (len(slot.generated) >= s.max_new_tokens
+                or (s.eos_token_id is not None and slot.generated
+                    and slot.generated[-1] == s.eos_token_id)
+                or (slot.prompt_len + len(slot.generated)
+                    >= self.state.max_seq_len - 1))
+        if done:
+            return
+        try:
+            faults.inject('engine.handoff_lease')
+        except Exception:  # noqa: BLE001 — chaos seam, not a failure
+            return
+        slot.handoff_pause = True
+        self._handoff_deadline[rid] = (
+            time.monotonic() + envs.SKYTPU_HANDOFF_LEASE_SECONDS.get())
+
+    def _expire_handoff_leases(self) -> None:
+        """Lease expiry is the engine-side fallback rung: the LB
+        never confirmed a decode-leg restore (or never called
+        /internal/resume), so the request resumes decoding locally —
+        counted as a fallback, never an error."""
+        if not self._handoff_deadline:
+            return
+        now = time.monotonic()
+        for slot in self.state.slots:
+            if slot is None or not slot.handoff_pause:
+                continue
+            deadline = self._handoff_deadline.get(slot.request_id)
+            if deadline is not None and now >= deadline:
+                slot.handoff_pause = False
+                self._handoff_deadline.pop(slot.request_id, None)
+                obs.HANDOFF_FALLBACKS.inc()
 
     # -- request migration (snapshot / restore) ------------------------------
 
@@ -2340,6 +2453,9 @@ class InferenceEngine:
             self.state.slots[slot].generated.append(token)
             self.state.slots[slot].logprobs.append(float(lp_host[i]))
             last[slot] = token
+            # First token exists: a handoff-flagged request pauses at
+            # the prefill->decode boundary instead of joining decode.
+            self._maybe_pause_handoff(self.state.slots[slot])
         self.state.last_tokens = jnp.asarray(last)
         obs.GENERATED_TOKENS.inc(len(slot_ids))
 
@@ -2511,6 +2627,10 @@ class InferenceEngine:
         last[i] = token
         self.state.last_tokens = jnp.asarray(last)
         obs.GENERATED_TOKENS.inc(1)
+        # Interleaved/warm prefill path hits the same prefill->decode
+        # boundary here: pause handoff-flagged requests before they
+        # join the decode batch.
+        self._maybe_pause_handoff(slot)
 
     def _free_slot(self, i: int, publish: bool = False) -> None:
         """Release slot i: cache lengths zero (stale keys invisible),
@@ -2526,6 +2646,15 @@ class InferenceEngine:
         the published pages is then LRU at refcount 0."""
         slot = self.state.slots[i]
         self.state.slots[i] = None
+        if slot is not None:
+            # Handoff bookkeeping dies with the slot: an abort racing
+            # a handoff must not leave a lease (or export marker)
+            # behind for a request id that no longer owns pages —
+            # resume_handoff on it is then a clean no-op, never a
+            # double free.
+            self._handoff_requests.discard(slot.request_id)
+            self._handoff_deadline.pop(slot.request_id, None)
+            self._handoff_exported.discard(slot.request_id)
         self.state.cache['length'] = \
             self.state.cache['length'].at[i].set(0)
         if self.state.draft_cache is not None:
@@ -2712,10 +2841,14 @@ class InferenceEngine:
     # skytpu-lint: hot-path[1]
     def step(self) -> None:
         self._evict_finished()
+        self._expire_handoff_leases()
         self._insert_from_queue()
         self._advance_prefill()
-        # Slots mid-(interleaved-)prefill are not decoding yet.
+        # Slots mid-(interleaved-)prefill are not decoding yet, and
+        # handoff-paused slots sit out decode until their lease
+        # expires or a resume clears them.
         active_mask = [s is not None and s.pending is None
+                       and not s.handoff_pause
                        for s in self.state.slots]
         if not any(active_mask):
             self._update_gauges()

@@ -69,6 +69,9 @@ class EngineLoop:
             # /internal/snapshot, and the engine rid once admitted.
             self.key: Optional[str] = None
             self.rid: Optional[int] = None
+            # Disaggregated serving: the request asked to pause at
+            # the prefill->decode boundary for a planned handoff.
+            self.handoff = False
             # Raw-model logprobs of the generated tokens, set by the
             # engine thread BEFORE the 'done' push (the queue handoff
             # orders the write for the reading handler).
@@ -93,11 +96,16 @@ class EngineLoop:
 
     def submit(self, prompt: List[int], sampling,
                stream: bool = False,
-               key: Optional[str] = None) -> 'EngineLoop.Watcher':
+               key: Optional[str] = None,
+               handoff: bool = False) -> 'EngineLoop.Watcher':
         """Called from async handlers; returns the watcher whose queue
-        yields ('token', t)* then ('done', [tokens])."""
+        yields ('token', t)* then ('done', [tokens]). `handoff=True`
+        (stream requests only) asks the engine to pause at the
+        prefill->decode boundary and export a non-terminal `handoff`
+        frame the LB restores onto the decode pool."""
         watcher = self.Watcher(asyncio.get_running_loop(), stream)
         watcher.key = key
+        watcher.handoff = bool(handoff and stream)
         # contextvars do NOT cross the queue into the engine thread:
         # capture the (rid, span context) pair HERE, on the event
         # loop, so the engine thread can rebind it and the engine's
@@ -185,6 +193,41 @@ class EngineLoop:
         watcher.push(('error', 'request migrated away'))
         return blob, sent
 
+    def resume_by_key(self, key: str) -> str:
+        """Resume a handoff-paused request locally (the LB's ladder
+        exhausted: co-located fallback). Returns 'resumed' when the
+        lease was still held, 'active' when the request is already
+        decoding here (lease expired first — same outcome, the
+        stream continues either way). KeyError when the request
+        finished, aborted, or was never admitted here."""
+        watcher = self._by_key.get(key)
+        if watcher is None or watcher.rid is None:
+            raise KeyError(f'unknown migration key {key!r}')
+        if self.engine.resume_handoff(watcher.rid):
+            # The LB counts this fallback (it owns the ladder); the
+            # engine's own counter increment is reserved for lease
+            # expiry, where no LB signal exists.
+            return 'resumed'
+        return 'active'
+
+    def abandon_by_key(self, key: str) -> None:
+        """Drop the co-located copy of a handed-off request: the LB
+        confirmed the decode-leg restore, so the lease-paused slot
+        (or its post-expiry local decode) frees NOW instead of
+        decoding a zombie duplicate — and, unlike letting the lease
+        expire, no fallback is counted for a handoff that SUCCEEDED.
+        KeyError when the request already finished, aborted, or was
+        never admitted here."""
+        watcher = self._by_key.pop(key, None)
+        if watcher is None or watcher.rid is None:
+            raise KeyError(f'unknown migration key {key!r}')
+        self._watchers.pop(watcher.rid, None)
+        self.engine.abort(watcher.rid)
+        # Unblock the handler still parked on the event queue; its
+        # write fails on the LB-closed connection and it exits.
+        watcher.push(('error', 'request handed off to the decode '
+                               'pool'))
+
     def stop(self) -> None:
         self._stop = True
 
@@ -209,7 +252,12 @@ class EngineLoop:
         try:
             if kind == 'restore':
                 rid = self.engine.restore_request(payload)
+            elif watcher.handoff:
+                rid = self.engine.submit(payload, sampling,
+                                         handoff=True)
             else:
+                # Plain submits keep the two-arg signature so engine
+                # stand-ins that predate handoff still duck-type.
                 rid = self.engine.submit(payload, sampling)
         except Exception as e:  # noqa: BLE001
             # The watcher is not registered yet, so the _run error
@@ -304,6 +352,18 @@ class EngineLoop:
             # the admission contract.
             self._process_submission(item)
             return
+        if not getattr(self.engine, 'has_runnable_work', True):
+            # Every live slot is parked under a handoff lease:
+            # nothing to compute until a resume command lands or a
+            # lease expires — park briefly instead of spinning the
+            # TPU thread (step() below still runs the lease-expiry
+            # check each pass).
+            try:
+                item = self._submit_q.get(timeout=0.005)
+            except queue.Empty:
+                pass
+            else:
+                self._process_submission(item)
         self.engine.step()
         # Drain aborts AGAIN before fanning out events: one step() is
         # now a fused multi-token round (tens of ms to seconds), and a
@@ -327,6 +387,35 @@ class EngineLoop:
                     self._by_key.pop(watcher.key, None)
                 watcher.logprobs = finished_lps.get(rid)
                 watcher.push(('done', tokens))
+        # Handoff export AFTER the token fan-out: the first generated
+        # token reaches the client through THIS replica's stream
+        # first, so the frame's sent-count already includes it and
+        # the decode-leg restore starts at exactly the next token.
+        for rid in self.engine.handoff_pending():
+            watcher = self._watchers.get(rid)
+            if watcher is None or watcher.aborted or \
+                    not watcher.stream:
+                # Nothing to export to (client gone, or a non-stream
+                # request slipped through): resume local decode — the
+                # request must never sit parked until lease expiry
+                # for want of a frame.
+                self.engine.mark_handoff_exported(rid)
+                self.engine.resume_handoff(rid)
+                continue
+            try:
+                with spans.span('engine.handoff_snapshot',
+                                attrs={'request_id': rid}):
+                    blob = self.engine.snapshot_request(rid)
+            except Exception:  # noqa: BLE001 — degrade, don't fail
+                # Unsnapshottable (size cap, injected fault): the
+                # planned handoff degrades to co-located decode.
+                self.engine.mark_handoff_exported(rid)
+                self.engine.resume_handoff(rid)
+                continue
+            self.engine.mark_handoff_exported(rid)
+            watcher.push(('handoff', {
+                'snapshot': base64.b64encode(blob).decode('ascii'),
+                'sent': watcher.sent}))
 
 
 def shed_limit(engine_holder: Dict[str, Any]) -> Optional[int]:
@@ -436,6 +525,13 @@ def create_app(engine_holder: Dict[str, Any]):
                 {'error': 'prompt_tokens must be non-empty'}, status=400)
         stream = bool(body.get('stream', False))
         want_logprobs = bool(body.get('logprobs', False))
+        # Disaggregated serving: the LB flags prefill-legs it intends
+        # to hand off to the decode pool. Stream requests only (the
+        # handoff frame rides the live SSE stream), and only while
+        # migration is enabled at all.
+        handoff = (stream
+                   and request.headers.get('X-SkyTPU-Handoff') == '1'
+                   and envs.SKYTPU_MIGRATION_ENABLE.get())
         # The middleware bound a request scope; log the acceptance so
         # the `rid=` line and the timeline span below carry the SAME
         # ID — per-request correlation across logs and Chrome trace.
@@ -450,7 +546,8 @@ def create_app(engine_holder: Dict[str, Any]):
         key = uuid.uuid4().hex
         with timeline.Event('inference.generate'):
             watcher = engine_loop.submit(prompt, sampling,
-                                         stream=stream, key=key)
+                                         stream=stream, key=key,
+                                         handoff=handoff)
             try:
                 if not stream:
                     while True:
@@ -482,6 +579,16 @@ def create_app(engine_holder: Dict[str, Any]):
                     if kind == 'token':
                         await resp.write(
                             f'data: {json.dumps({"token": payload})}\n\n'
+                            .encode())
+                    elif kind == 'handoff':
+                        # NON-terminal: the LB intercepts this frame
+                        # and restores the request onto the decode
+                        # pool. The slot here stays live under its
+                        # lease, so the stream stays open — the
+                        # co-located fallback (or lease expiry)
+                        # continues it with ordinary token frames.
+                        await resp.write(
+                            f'data: {json.dumps({"handoff": payload})}\n\n'
                             .encode())
                     elif kind == 'migrate':
                         # Drain snapshotted this stream: the blob rides
@@ -596,6 +703,46 @@ def create_app(engine_holder: Dict[str, Any]):
             content_type='application/octet-stream',
             headers={'X-SkyTPU-Sent': str(sent)})
 
+    async def internal_resume(request):
+        """Co-located fallback rung of the handoff ladder: the LB's
+        decode-pool restore attempts exhausted their budget, so the
+        handoff-paused request resumes decoding HERE — a state
+        transition, not a retry-from-scratch; the already-open client
+        stream just continues. Idempotent with lease expiry: a
+        request that already resumed answers 200/'active'.
+
+        ?abandon=1 is the opposite signal: the LB confirmed the
+        decode-leg restore elsewhere, so the co-located copy is
+        dropped (slot freed immediately, no fallback counted) rather
+        than resumed."""
+        engine_loop: Optional[EngineLoop] = engine_holder.get('loop')
+        key = request.query.get('key')
+        if engine_loop is None or not key:
+            return web.json_response(
+                {'error': 'need ?key= and a live engine'}, status=400)
+        if request.query.get('abandon'):
+            try:
+                await asyncio.wrap_future(
+                    engine_loop.run_on_engine(
+                        functools.partial(engine_loop.abandon_by_key,
+                                          key)))
+            except KeyError:
+                return web.json_response(
+                    {'error': f'unknown migration key {key!r}'},
+                    status=404)
+            return web.json_response({'status': 'abandoned'})
+        try:
+            status = await asyncio.wrap_future(
+                engine_loop.run_on_engine(
+                    functools.partial(engine_loop.resume_by_key,
+                                      key)))
+        except KeyError:
+            return web.json_response(
+                {'error': f'unknown migration key {key!r} (request '
+                          'finished, aborted, or never admitted '
+                          'here)'}, status=404)
+        return web.json_response({'status': status})
+
     async def internal_restore(request):
         """Splice a migration blob into this engine and resume decode.
         ?sent=N tokens were already delivered to the client — the
@@ -688,6 +835,8 @@ def create_app(engine_holder: Dict[str, Any]):
     app.router.add_get('/internal/trace', internal_trace)
     app.router.add_post('/internal/drain', internal_drain)
     app.router.add_get('/internal/snapshot', internal_snapshot)
+    app.router.add_post('/internal/resume', internal_resume)
+    app.router.add_get('/internal/resume', internal_resume)
     app.router.add_post('/internal/restore', internal_restore)
     app.router.add_post('/generate', generate)
     from skypilot_tpu.inference import openai_api

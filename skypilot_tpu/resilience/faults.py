@@ -122,6 +122,18 @@ LB_MIGRATE = declare(
     'The load balancer migrating one interrupted stream: snapshot '
     'fetch + restore re-route (fires once per interrupted request, '
     'before the first restore attempt).')
+LB_HANDOFF = declare(
+    'lb.handoff',
+    'The load balancer walking the planned prefill->decode handoff '
+    'ladder for one request (fires once per handoff frame, before '
+    'the first decode-pool restore attempt); an armed fault forces '
+    'the co-located /internal/resume fallback.')
+ENGINE_HANDOFF_LEASE = declare(
+    'engine.handoff_lease',
+    'The engine granting a handoff lease — pausing a request at the '
+    'prefill->decode boundary with its slot held live; an armed '
+    'fault refuses the lease, so the request decodes co-located and '
+    'no handoff frame is exported.')
 
 
 def registered_points() -> Dict[str, str]:

@@ -51,8 +51,19 @@ def request_context(body: Optional[bytes],
         return None
     ctx: Dict[str, Any] = {}
     tokens = doc.get('prompt_tokens')
-    if isinstance(tokens, list) and tokens and \
-            all(isinstance(t, int) for t in tokens):
+    if not (isinstance(tokens, list) and tokens
+            and all(isinstance(t, int) for t in tokens)):
+        # OpenAI-style bodies may carry the tokenized prompt under
+        # `prompt` (a list of ids): that IS a real token count —
+        # classifying it through the chars/4 string estimate (or not
+        # at all) would mis-gate the prompt threshold.
+        prompt = doc.get('prompt')
+        if isinstance(prompt, list) and prompt and \
+                all(isinstance(t, int) for t in prompt):
+            tokens = prompt
+        else:
+            tokens = None
+    if tokens is not None:
         ctx['prompt_tokens'] = tokens
     elif isinstance(doc.get('prompt'), str) and doc['prompt']:
         ctx['prompt'] = doc['prompt']
@@ -61,6 +72,11 @@ def request_context(body: Optional[bytes],
     max_new = doc.get('max_new_tokens')
     if isinstance(max_new, int):
         ctx['max_new_tokens'] = max_new
+    if doc.get('stream') is True:
+        # Only streamed requests can carry the non-terminal handoff
+        # frame; key added only when set so poolless callers see the
+        # same context dicts as before.
+        ctx['stream'] = True
     return ctx
 
 
@@ -98,6 +114,25 @@ def classify_pool_role(context: Optional[Dict[str, Any]]
             max_new <= envs.SKYTPU_LB_POOL_MAX_NEW_THRESHOLD.get():
         return 'prefill'
     return 'decode'
+
+
+def handoff_eligible(context: Optional[Dict[str, Any]]) -> bool:
+    """Whether a request may take the two-leg (prefill -> planned
+    handoff -> decode) route. Stricter than classify_pool_role on two
+    axes: only a prompt that arrived TOKENIZED counts — the ~4
+    chars/token string estimate must never gate
+    SKYTPU_LB_POOL_PROMPT_THRESHOLD for a handoff, since a mis-flagged
+    short request would pause at the boundary for nothing — and only a
+    streamed request can carry the non-terminal handoff frame. The
+    other half of the guard is engine-side and structural: the pause
+    only exists AFTER the first generated token, so a request still
+    queued or mid-prefill (whose snapshot would be a layout-'none'
+    host-only blob) can never export a handoff."""
+    if not context or not context.get('stream'):
+        return False
+    if not context.get('prompt_tokens'):
+        return False
+    return classify_pool_role(context) == 'prefill'
 
 
 class RequestRateTracker:
@@ -153,6 +188,15 @@ class LoadBalancer:
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._runner = None
         self._thread: Optional[threading.Thread] = None
+        # Fire-and-forget coroutines (handoff-source abandons): the
+        # event loop holds tasks weakly, so keep strong refs until
+        # each one finishes.
+        self._bg_tasks: set = set()
+
+    def _spawn_bg(self, coro) -> None:
+        task = asyncio.ensure_future(coro)
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
 
     def set_replicas(self, urls: List[str],
                      pools: Optional[Dict[str, str]] = None) -> None:
@@ -215,6 +259,25 @@ class LoadBalancer:
             (first,), (r for r in pool if r != first),
             (r for r in self.policy.replicas
              if r != first and r not in pool_set))
+
+    def _restore_candidates(self, context=None,
+                            role: str = 'decode') -> List[str]:
+        """Candidate order for RESTORE legs (planned handoff and crash
+        migration): the work remaining after any snapshot is
+        decode-only, so the decode pool's breaker-allowed replicas are
+        exhausted FIRST, then the rest of the fleet spills in. The
+        request's original shape classification must NOT drive this
+        order — it classified the *whole* request (long prompt =>
+        prefill pool), which is exactly wrong for the remainder, and
+        walking the shape-classified failover order let a general-pool
+        replica shadow an idle decode replica. Poolless deployments
+        degrade to plain fleet order."""
+        del context  # shape classification deliberately unused here
+        pool = [r for r in self.policy.replicas
+                if self._pool_roles.get(r) == role]
+        pool_set = set(pool)
+        return pool + [r for r in self.policy.replicas
+                       if r not in pool_set]
 
     # -- the simulator / non-HTTP seam ---------------------------------------
 
@@ -422,13 +485,24 @@ class LoadBalancer:
                         timeout=ClientTimeout(total=3600))
                     # Strip any inbound traceparent: the replica must
                     # parent on THIS leg, not on the client's span.
+                    # X-SkyTPU-Handoff is LB-owned too — only the
+                    # pool-routing decision below may set it.
                     hdrs = {k: v
                             for k, v in request.headers.items()
                             if k.lower() not in (
                                 'host', 'content-length',
+                                'x-skytpu-handoff',
                                 spans.TRACEPARENT_HEADER)}
                     hdrs[spans.TRACEPARENT_HEADER] = \
                         spans.format_traceparent(leg_ctx)
+                    if (self._pool_roles
+                            and handoff_eligible(context)
+                            and envs.SKYTPU_MIGRATION_ENABLE.get()):
+                        # Two-leg route: the prefill replica pauses at
+                        # the first token under a lease and exports a
+                        # non-terminal handoff frame; _relay_managed
+                        # walks the decode-leg ladder when it arrives.
+                        hdrs['X-SkyTPU-Handoff'] = '1'
                     upstream = await session.request(
                         request.method, url, data=body,
                         headers=hdrs, allow_redirects=False)
@@ -566,39 +640,41 @@ class LoadBalancer:
         /internal/snapshot by migration key — the replica process may
         still be alive behind a dead connection or an injected
         transport fault). Honest termination (PR 9) is the last rung:
-        only when migration fails inside its deadline budget."""
+        only when migration fails inside its deadline budget.
+
+        A NON-terminal `handoff` frame is the planned two-leg route:
+        the prefill replica paused at the first token with the slot
+        still live under a lease. The ladder (_handoff_stream) either
+        restores onto a decode-pool replica (switch upstreams, drop
+        any bytes buffered past the frame — they were never counted
+        into `sent`, so the restored stream re-sends them) or resumes
+        the SAME upstream co-located (keep reading, buffer intact —
+        tokens simply continue). Only if the prefill replica died too
+        does it fall through to the crash-migration rung with the
+        handoff blob already in hand."""
         import aiohttp
         state = {'sent': 0, 'last_token': time.monotonic()}
         own: List[Any] = []  # (session, upstream) from migrations
         cur_up, cur_target, cur_key = upstream, target, mig_key
+        buf = b''
         try:
             while True:
-                buf = b''
                 migrate_payload = None
+                handoff_payload = None
                 interrupted = False
-                while not interrupted and migrate_payload is None:
-                    try:
-                        faults.inject('lb.upstream_midstream',
-                                      env_exc=OSError)
-                        chunk = await asyncio.wait_for(
-                            cur_up.content.readany(),
-                            timeout=read_gap if read_gap > 0
-                            else None)
-                    except (asyncio.TimeoutError, OSError,
-                            aiohttp.ClientError):
-                        interrupted = True
-                        break
-                    if not chunk:
-                        # EOF without a terminal frame: the upstream
-                        # vanished mid-stream.
-                        interrupted = True
-                        break
-                    buf += chunk
+                while not interrupted and migrate_payload is None \
+                        and handoff_payload is None:
+                    # Drain frames already buffered BEFORE reading
+                    # more: a co-located fallback re-enters here with
+                    # leftover bytes that must not be dropped.
                     while b'\n\n' in buf:
                         frame, buf = buf.split(b'\n\n', 1)
                         doc = _sse_frame_doc(frame)
                         if doc is not None and 'migrate' in doc:
                             migrate_payload = doc['migrate']
+                            break
+                        if doc is not None and 'handoff' in doc:
+                            handoff_payload = doc['handoff']
                             break
                         if doc is None or 'token' in doc:
                             if doc is not None:
@@ -616,6 +692,59 @@ class LoadBalancer:
                         except (OSError, aiohttp.ClientError):
                             pass
                         return response
+                    if migrate_payload is not None or \
+                            handoff_payload is not None:
+                        break
+                    try:
+                        faults.inject('lb.upstream_midstream',
+                                      env_exc=OSError)
+                        chunk = await asyncio.wait_for(
+                            cur_up.content.readany(),
+                            timeout=read_gap if read_gap > 0
+                            else None)
+                    except (asyncio.TimeoutError, OSError,
+                            aiohttp.ClientError):
+                        interrupted = True
+                        break
+                    if not chunk:
+                        # EOF without a terminal frame: the upstream
+                        # vanished mid-stream.
+                        interrupted = True
+                        break
+                    buf += chunk
+                if handoff_payload is not None:
+                    res = await self._handoff_stream(
+                        context, state, cur_target, cur_key,
+                        handoff_payload)
+                    if isinstance(res, tuple):
+                        # The decode leg owns the request now: close
+                        # the prefill leg's response and tell the
+                        # replica to drop its copy. Left open, the
+                        # lease would expire into a zombie co-located
+                        # decode of the SAME tokens — wasted compute
+                        # and a spurious fallback count for a handoff
+                        # that succeeded.
+                        with contextlib.suppress(Exception):
+                            cur_up.close()
+                        self._spawn_bg(self._abandon_source(
+                            cur_target, cur_key))
+                        session2, up2, cur_target, cur_key = res
+                        own.append((session2, up2))
+                        cur_up = up2
+                        # Bytes past the handoff frame were never
+                        # counted into `sent`; the restored stream
+                        # re-sends them from ?sent= on.
+                        buf = b''
+                        continue
+                    if res == 'fallback':
+                        # Co-located resume: the prefill replica's
+                        # stream (and our buffer) just continues —
+                        # degraded success, never an error.
+                        continue
+                    # The prefill replica is unreachable too: crash
+                    # migration is the backstop, and the handoff
+                    # payload already carries the blob.
+                    migrate_payload = handoff_payload
                 new = await self._migrate_stream(
                     context, state, cur_target, cur_key,
                     migrate_payload)
@@ -632,6 +761,7 @@ class LoadBalancer:
                 session2, up2, cur_target, cur_key = new
                 own.append((session2, up2))
                 cur_up = up2
+                buf = b''
                 # Loop: the restored stream is itself migratable.
         finally:
             for s, u in own:
@@ -665,10 +795,11 @@ class LoadBalancer:
     async def _migrate_stream(self, context, state, dead_target,
                               dead_key, migrate_payload):
         """Resume one interrupted stream on another replica: blob from
-        the drain event (or fetched by key), restored pool-preferred
-        in failover order under the migration deadline budget.
-        Returns (session, upstream, target, new_key) or None — the
-        caller honest-terminates on None."""
+        the drain event (or fetched by key), restored decode-pool-
+        first (_restore_candidates — the remainder is decode-only
+        work) under the migration deadline budget. Returns (session,
+        upstream, target, new_key) or None — the caller
+        honest-terminates on None."""
         from aiohttp import ClientSession, ClientTimeout
         import aiohttp
         policy = retries.RetryPolicy(
@@ -702,7 +833,7 @@ class LoadBalancer:
                 attrs['blob_bytes'] = len(blob)
                 delay = policy.base_delay
                 while True:
-                    candidates = self._failover_order(context)
+                    candidates = self._restore_candidates(context)
                     for cand in candidates or ():
                         if cand == dead_target or \
                                 not self.breaker.allow(cand):
@@ -756,6 +887,171 @@ class LoadBalancer:
                 attrs['error'] = str(e)
                 obs.MIGRATION_FAILURES.inc()
                 return None
+
+    async def _handoff_stream(self, context, state, src_target,
+                              src_key, payload):
+        """Walk the planned prefill->decode handoff ladder for one
+        paused stream. Rungs, in order:
+
+        1. Restore onto a decode-pool candidate (_restore_candidates,
+           breaker-allowed, source excluded) under the
+           SKYTPU_HANDOFF_DEADLINE_SECONDS retry budget; the blob is
+           capped by SKYTPU_HANDOFF_MAX_BYTES.
+        2. On exhaustion, POST /internal/resume on the prefill
+           replica: its slot is still live under the lease, so the
+           co-located fallback is a state transition — the client
+           stream just continues. Counted as a handoff fallback,
+           never surfaced as an error.
+
+        Returns (session, upstream, target, new_key) after a
+        decode-leg restore, 'fallback' after a co-located resume, or
+        None when the prefill replica is unreachable too — the caller
+        then falls through to the crash-migration backstop with the
+        blob in hand."""
+        from aiohttp import ClientSession, ClientTimeout
+        import aiohttp
+        obs.HANDOFF_ATTEMPTS.inc()
+        policy = retries.RetryPolicy(
+            deadline=envs.SKYTPU_HANDOFF_DEADLINE_SECONDS.get(),
+            base_delay=0.05, max_delay=0.5)
+        t0 = time.monotonic()
+        deadline = t0 + (policy.deadline or 0.0)
+        attrs: Dict[str, Any] = {'from': src_target,
+                                 'sent': state['sent']}
+        with spans.span('lb.handoff', attrs=attrs):
+            try:
+                faults.inject('lb.handoff', env_exc=OSError)
+                try:
+                    blob = base64.b64decode(
+                        payload.get('snapshot') or '')
+                except (ValueError, TypeError):
+                    blob = b''
+                if not blob:
+                    raise OSError('handoff frame carried no snapshot')
+                if len(blob) > envs.SKYTPU_HANDOFF_MAX_BYTES.get():
+                    raise OSError(
+                        f'handoff blob is {len(blob)} bytes, over '
+                        'SKYTPU_HANDOFF_MAX_BYTES')
+                attrs['blob_bytes'] = len(blob)
+                delay = policy.base_delay
+                while True:
+                    candidates = [
+                        c for c in self._restore_candidates(context)
+                        if c != src_target]
+                    if not candidates:
+                        # Nothing to wait for: a one-replica fleet
+                        # resumes co-located immediately.
+                        raise OSError('no other replica to take the '
+                                      'decode leg')
+                    for cand in candidates:
+                        if not self.breaker.allow(cand):
+                            continue
+                        if time.monotonic() >= deadline:
+                            break
+                        url = (cand.rstrip('/') + '/internal/restore'
+                               f'?sent={state["sent"]}&stream=1')
+                        session = ClientSession(
+                            timeout=ClientTimeout(total=3600))
+                        try:
+                            up = await session.request(
+                                'POST', url, data=blob,
+                                headers={'Content-Type':
+                                         'application/octet-stream'})
+                        except (OSError, aiohttp.ClientError):
+                            await session.close()
+                            self.breaker.record_failure(cand)
+                            continue
+                        if up.status == 400:
+                            # Bad blob: no replica will take it; the
+                            # co-located original is still decodable.
+                            up.close()
+                            await session.close()
+                            raise OSError(
+                                'restore rejected the handoff blob')
+                        if up.status != 200:
+                            # Capacity/draining (409/503): next one.
+                            up.close()
+                            await session.close()
+                            continue
+                        self.breaker.record_success(cand)
+                        attrs['to'] = cand
+                        obs.HANDOFF_SUCCESSES.inc()
+                        obs.HANDOFF_TRANSFER_SECONDS.observe(
+                            time.monotonic() - t0)
+                        state['last_token'] = time.monotonic()
+                        return (session, up, cand,
+                                up.headers.get(
+                                    'X-SkyTPU-Migration-Key') or '')
+                    if time.monotonic() + delay >= deadline:
+                        raise OSError(
+                            'no decode-pool replica took the handoff '
+                            'inside SKYTPU_HANDOFF_DEADLINE_SECONDS')
+                    await asyncio.sleep(delay)
+                    delay = min(delay * 2, policy.max_delay)
+            except (OSError, aiohttp.ClientError) as e:
+                attrs['error'] = str(e)
+            status = await self._resume_local(src_target, src_key)
+            if status is not None:
+                attrs['fallback'] = 'resume'
+                if status == 'resumed':
+                    # 'active' means the lease already expired and
+                    # the ENGINE counted the fallback — counting here
+                    # too would double it.
+                    obs.HANDOFF_FALLBACKS.inc()
+                state['last_token'] = time.monotonic()
+                return 'fallback'
+            # The prefill replica is gone too; the lease would have
+            # resumed it if it were alive. Crash migration (caller)
+            # is the remaining rung.
+            attrs['fallback'] = 'migrate'
+            return None
+
+    async def _abandon_source(self, target: str, key: str) -> None:
+        """Best-effort: tell the prefill replica its copy of a
+        handed-off request is no longer needed (the decode-leg
+        restore was confirmed) so the lease-paused slot frees now.
+        Failure is harmless — the replica's own lease expiry (or the
+        write failure on our closed connection) reclaims the slot
+        eventually; this call only makes it prompt and keeps the
+        fallback counter honest."""
+        from aiohttp import ClientSession, ClientTimeout
+        if not key:
+            return
+        with contextlib.suppress(Exception):
+            async with ClientSession(
+                    timeout=ClientTimeout(total=5.0)) as session:
+                async with session.post(
+                        target.rstrip('/') + '/internal/resume',
+                        params={'key': key, 'abandon': '1'}):
+                    pass
+
+    async def _resume_local(self, target: str,
+                            key: str) -> Optional[str]:
+        """POST /internal/resume?key= on the prefill replica: flips
+        the lease-paused slot back to decoding — cheap, in-place, and
+        the already-open stream continues by itself. Returns the
+        replica's status ('resumed', or 'active' when the lease had
+        already expired and the slot resumed itself), or None when
+        the replica can't be reached or no longer knows the key."""
+        from aiohttp import ClientSession, ClientTimeout
+        import aiohttp
+        if not key:
+            return None
+        try:
+            async with ClientSession(
+                    timeout=ClientTimeout(total=5.0)) as session:
+                async with session.post(
+                        target.rstrip('/') + '/internal/resume',
+                        params={'key': key}) as r:
+                    if r.status != 200:
+                        return None
+                    try:
+                        doc = await r.json()
+                    except (ValueError, aiohttp.ClientError):
+                        return 'resumed'
+                    return str(doc.get('status') or 'resumed')
+        except (OSError, aiohttp.ClientError, asyncio.TimeoutError):
+            return None
 
     async def _handle_trace(self, request):
         """Merged trace view: the LB's own spans for a trace id plus,

@@ -1,0 +1,539 @@
+"""Disaggregated prefill->decode serving (ISSUE 19): planned KV
+handoff with a zero-token-loss degradation ladder.
+
+The acceptance oracle is the same greedy token-for-token identity as
+crash migration (PR 17), now for the PLANNED route: a handoff-flagged
+request pauses at the prefill->decode boundary (first token emitted,
+slot live under a lease), its snapshot restores into a decode engine,
+and the combined stream must equal an uninterrupted run — for the
+dense cache, the paged pool with the prefix cache on, and the
+int8-quantized pool. Every rung of the degradation ladder ends in the
+same stream: decode-pool restore, forced co-located resume (armed
+`lb.handoff` fault), and lease expiry (which also compiles nothing
+new). Around the oracle: the pool invariant (free + cached + private
+== total) holds on both replicas after handoff, fallback, and abort;
+an abort racing a handoff never double-frees; restore candidates walk
+the decode pool (breaker-allowed) before the general fleet; and
+handoff eligibility refuses string-estimated prompts and non-streamed
+requests outright.
+"""
+import asyncio
+import time
+
+import jax
+import pytest
+
+from skypilot_tpu import inference
+from skypilot_tpu.inference import engine as eng_lib
+from skypilot_tpu.models import llama
+from skypilot_tpu.observability import instruments as obs
+from skypilot_tpu.resilience import faults
+from skypilot_tpu.serve import load_balancer as lb_lib
+
+
+@pytest.fixture(scope='module')
+def tiny():
+    config = llama.CONFIGS['tiny']
+    params = llama.init_params(config, jax.random.key(7))
+    return config, params
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    faults.reset()
+
+
+def _greedy(max_new):
+    return inference.SamplingParams(temperature=0.0,
+                                    max_new_tokens=max_new)
+
+
+def _engine(params, config, **kw):
+    kw.setdefault('batch_size', 2)
+    kw.setdefault('max_seq_len', 64)
+    kw.setdefault('prefill_chunk', 16)
+    kw.setdefault('kv_quant', 'none')
+    kw.setdefault('decode_fuse_steps', 2)
+    return inference.InferenceEngine(params, config, **kw)
+
+
+_PROMPT = [3, 17, 42, 9, 105, 8]
+_STEPS = 16
+
+
+def _ref(params, config, prompt=None, steps=_STEPS, **kw):
+    eng = _engine(params, config, **kw)
+    rid = eng.submit(list(prompt or _PROMPT), _greedy(steps))
+    return eng.run_to_completion()[rid]
+
+
+def _drive_to_pause(eng, rid, max_steps=200):
+    """Step until the request parks at the prefill->decode boundary;
+    returns the tokens generated so far (>= 1: the pause only exists
+    once the first token does)."""
+    for _ in range(max_steps):
+        eng.step()
+        for s in eng.state.slots:
+            if s is not None and s.request_id == rid \
+                    and s.handoff_pause:
+                assert s.generated, \
+                    'paused before the first generated token'
+                return list(s.generated)
+        assert rid not in eng.finished(), \
+            'request finished before pausing at the boundary'
+    raise AssertionError('request never paused at the boundary')
+
+
+class TestHandoffIdentity:
+    """The planned two-leg route is invisible in the token stream."""
+
+    def _handoff(self, params, config, **kw):
+        ref = _ref(params, config, **kw)
+        src = _engine(params, config, **kw)
+        dst = _engine(params, config, **kw)
+        rid = src.submit(list(_PROMPT), _greedy(_STEPS),
+                         handoff=True)
+        mid = _drive_to_pause(src, rid)
+        assert rid in src.handoff_pending()
+        blob = src.snapshot_request(rid)
+        # The structural guard: a pause only exists after the first
+        # token, so an exported handoff blob ALWAYS carries real KV.
+        header, _ = eng_lib._snapshot_unpack(blob)
+        assert header['layout'] != 'none'
+        src.abort(rid)
+        rid2 = dst.restore_request(blob)
+        final = dst.run_to_completion()[rid2]
+        assert final[:len(mid)] == mid, \
+            'restored run rewrote already-streamed tokens'
+        assert final == ref
+        return src, dst
+
+    def test_paged_prefix_off(self, tiny):
+        config, params = tiny
+        self._handoff(params, config, prefix_cache=False)
+
+    def test_paged_prefix_on(self, tiny):
+        config, params = tiny
+        self._handoff(params, config, prefix_cache=True)
+
+    def test_int8_quantized_pool(self, tiny):
+        config, params = tiny
+        self._handoff(params, config, kv_quant='int8')
+
+    def test_dense(self, tiny):
+        config, params = tiny
+        self._handoff(params, config, kv_page_size=0)
+
+
+class TestLeaseSemantics:
+    """The lease holds the slot still, resumes it on expiry, and the
+    resume is a host-side state transition — zero recompiles."""
+
+    def test_paused_slot_does_not_decode(self, tiny, monkeypatch):
+        monkeypatch.setenv('SKYTPU_HANDOFF_LEASE_SECONDS', '30')
+        config, params = tiny
+        eng = _engine(params, config, prefix_cache=False)
+        rid = eng.submit(list(_PROMPT), _greedy(_STEPS),
+                         handoff=True)
+        mid = _drive_to_pause(eng, rid)
+        for _ in range(4):
+            eng.step()
+        assert eng.active_progress()[rid] == mid, \
+            'a lease-paused slot kept decoding'
+        assert not eng.has_runnable_work
+        # Explicit resume (the co-located fallback rung) is a state
+        # transition: the slot rejoins the batch and finishes with
+        # the uninterrupted stream.
+        assert eng.resume_handoff(rid)
+        assert not eng.resume_handoff(rid)  # second call: no-op
+        final = eng.run_to_completion()[rid]
+        assert final == _ref(params, config, prefix_cache=False)
+
+    def test_lease_expiry_resumes_local_zero_recompiles(
+            self, tiny, monkeypatch):
+        monkeypatch.setenv('SKYTPU_HANDOFF_LEASE_SECONDS', '0.15')
+        config, params = tiny
+        eng = _engine(params, config, prefix_cache=False)
+        # Warm the engine end to end so the fused-decode cache is
+        # settled before the handoff run.
+        ref = _ref(params, config, prefix_cache=False)
+        warm_rid = eng.submit(list(_PROMPT), _greedy(_STEPS))
+        assert eng.run_to_completion()[warm_rid] == ref
+        warm_fused = eng_lib.fused_decode_steps._cache_size()
+        fb0 = obs.HANDOFF_FALLBACKS.value()
+        rid = eng.submit(list(_PROMPT), _greedy(_STEPS),
+                         handoff=True)
+        mid = _drive_to_pause(eng, rid)
+        assert len(mid) < _STEPS
+        time.sleep(0.2)  # let the lease lapse
+        final = eng.run_to_completion()[rid]
+        assert final == ref
+        assert obs.HANDOFF_FALLBACKS.value() == fb0 + 1
+        assert eng_lib.fused_decode_steps._cache_size() == warm_fused
+
+
+class TestPoolInvariants:
+    """free + cached + private == total on both replicas, whatever
+    rung the request took — and aborts racing a handoff never
+    double-free."""
+
+    @staticmethod
+    def _accounted(eng):
+        free = len(eng._page_alloc)
+        cached = eng._prefix.num_pages() if eng._prefix else 0
+        private = sum(
+            len(set(pages) - eng._slot_shared[i])
+            for i, pages in enumerate(eng._slot_pages))
+        return free + cached + private
+
+    def test_invariant_after_handoff(self, tiny):
+        config, params = tiny
+        src = _engine(params, config, prefix_cache=True)
+        dst = _engine(params, config, prefix_cache=True)
+        rid = src.submit(list(_PROMPT), _greedy(_STEPS),
+                         handoff=True)
+        _drive_to_pause(src, rid)
+        blob = src.snapshot_request(rid)
+        src.abort(rid)
+        assert self._accounted(src) == src._pages_total
+        rid2 = dst.restore_request(blob)
+        assert self._accounted(dst) == dst._pages_total
+        assert rid2 in dst.run_to_completion()
+        assert self._accounted(dst) == dst._pages_total
+
+    def test_invariant_after_fallback(self, tiny, monkeypatch):
+        monkeypatch.setenv('SKYTPU_HANDOFF_LEASE_SECONDS', '30')
+        config, params = tiny
+        eng = _engine(params, config, prefix_cache=True)
+        rid = eng.submit(list(_PROMPT), _greedy(_STEPS),
+                         handoff=True)
+        _drive_to_pause(eng, rid)
+        assert eng.resume_handoff(rid)
+        assert rid in eng.run_to_completion()
+        assert self._accounted(eng) == eng._pages_total
+
+    def test_abort_racing_handoff_never_double_frees(
+            self, tiny, monkeypatch):
+        monkeypatch.setenv('SKYTPU_HANDOFF_LEASE_SECONDS', '30')
+        config, params = tiny
+        eng = _engine(params, config, prefix_cache=True)
+        rid = eng.submit(list(_PROMPT), _greedy(_STEPS),
+                         handoff=True)
+        _drive_to_pause(eng, rid)
+        eng.abort(rid)
+        assert self._accounted(eng) == eng._pages_total
+        # The abort swept every handoff structure: no stale lease, no
+        # export marker, and a late resume is a clean no-op.
+        assert not eng._handoff_deadline
+        assert rid not in eng.handoff_pending()
+        assert not eng.resume_handoff(rid)
+        eng.abort(rid)  # double abort: still a no-op
+        assert self._accounted(eng) == eng._pages_total
+        # The pool is intact: a fresh request runs to completion.
+        rid2 = eng.submit(list(_PROMPT), _greedy(_STEPS))
+        assert rid2 in eng.run_to_completion()
+        assert self._accounted(eng) == eng._pages_total
+
+
+class TestRestoreCandidateOrder:
+    """Restore legs exhaust the decode pool's breaker-allowed
+    replicas before any general-pool replica sees the blob."""
+
+    def test_decode_pool_first_breaker_skipped(self):
+        lb = lb_lib.LoadBalancer(policy_name='round_robin',
+                                 honor_env_policy=False)
+        d1, d2 = 'http://d1', 'http://d2'
+        g1, g2 = 'http://g1', 'http://g2'
+        lb.set_replicas([g1, d1, g2, d2],
+                        pools={d1: 'decode', d2: 'decode',
+                               g1: 'general', g2: 'general'})
+        order = lb._restore_candidates()
+        assert order[:2] == [d1, d2], \
+            'decode pool must lead the restore order'
+        assert set(order) == {d1, d2, g1, g2}
+        # The request's own shape must not reorder the restore walk:
+        # a long-prompt context classified 'prefill' still restores
+        # decode-pool-first (the remainder is decode-only work).
+        ctx = {'prompt_tokens': list(range(4096)),
+               'max_new_tokens': 4, 'stream': True}
+        assert lb._restore_candidates(ctx) == order
+        # Open d1's breaker: the ladder's walk skips it and tries the
+        # SECOND decode replica before any general-pool replica.
+        for _ in range(3):
+            lb.breaker.record_failure(d1)
+        assert not lb.breaker.allow(d1)
+        walk = [c for c in lb._restore_candidates()
+                if lb.breaker.allow(c)]
+        assert walk[0] == d2
+        assert walk.index(d2) < walk.index(g1)
+        assert walk.index(d2) < walk.index(g2)
+
+
+class TestHandoffEligibility:
+    """Only streamed requests whose prompt arrived TOKENIZED may take
+    the two-leg route; the chars/4 string estimate never gates it."""
+
+    def test_string_prompt_never_eligible(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_LB_POOL_PROMPT_THRESHOLD', '8')
+        ctx = {'prompt': 'x' * 4096, 'max_new_tokens': 4,
+               'stream': True}
+        # The shape classifier still calls it prefill (estimated)...
+        assert lb_lib.classify_pool_role(ctx) == 'prefill'
+        # ...but an ESTIMATED count must never flag a handoff.
+        assert not lb_lib.handoff_eligible(ctx)
+
+    def test_non_streamed_not_eligible(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_LB_POOL_PROMPT_THRESHOLD', '8')
+        ctx = {'prompt_tokens': list(range(32)), 'max_new_tokens': 4}
+        assert not lb_lib.handoff_eligible(ctx)
+
+    def test_tokenized_streamed_eligible(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_LB_POOL_PROMPT_THRESHOLD', '8')
+        ctx = {'prompt_tokens': list(range(32)), 'max_new_tokens': 4,
+               'stream': True}
+        assert lb_lib.handoff_eligible(ctx)
+
+    def test_decode_shaped_not_eligible(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_LB_POOL_PROMPT_THRESHOLD', '8')
+        ctx = {'prompt_tokens': list(range(32)),
+               'max_new_tokens': 512, 'stream': True}
+        assert not lb_lib.handoff_eligible(ctx)
+
+    def test_request_context_maps_tokenized_openai_prompt(
+            self, monkeypatch):
+        """An OpenAI-style body carrying the tokenized prompt under
+        `prompt` classifies by its REAL token count, not the chars/4
+        estimate of its string repr."""
+        import json as json_lib
+        monkeypatch.setenv('SKYTPU_LB_POOL_PROMPT_THRESHOLD', '8')
+        body = json_lib.dumps({'prompt': [5] * 32,
+                               'max_new_tokens': 4,
+                               'stream': True}).encode()
+        ctx = lb_lib.request_context(body, 'application/json',
+                                     len(body))
+        assert ctx['prompt_tokens'] == [5] * 32
+        assert ctx['stream'] is True
+        assert lb_lib.classify_pool_role(ctx) == 'prefill'
+        assert lb_lib.handoff_eligible(ctx)
+
+    def test_request_context_omits_stream_when_unset(self):
+        import json as json_lib
+        body = json_lib.dumps({'prompt_tokens': [1, 2, 3],
+                               'max_new_tokens': 4}).encode()
+        ctx = lb_lib.request_context(body, 'application/json',
+                                     len(body))
+        assert ctx == {'prompt_tokens': [1, 2, 3],
+                       'max_new_tokens': 4}
+
+
+_LB_PROMPT = list(range(7, 19))
+_LB_STEPS = 24
+
+
+async def _client_stream(session, url, prompt, max_new):
+    """POST a streamed generate through the LB; returns (tokens,
+    done_tokens). Fails the test if any internal frame (handoff,
+    migrate, error) leaks through."""
+    import json as json_lib
+    async with session.post(url, json={
+            'prompt_tokens': prompt, 'max_new_tokens': max_new,
+            'temperature': 0.0, 'stream': True}) as resp:
+        assert resp.status == 200, await resp.text()
+        got, done_tokens = [], None
+        buf = b''
+        async for chunk in resp.content.iter_any():
+            buf += chunk
+            while b'\n\n' in buf:
+                frame, buf = buf.split(b'\n\n', 1)
+                doc = None
+                for line in frame.split(b'\n'):
+                    if line.startswith(b'data: '):
+                        doc = json_lib.loads(line[6:])
+                if doc is None:
+                    continue
+                assert 'handoff' not in doc, \
+                    'handoff frame leaked to the client'
+                assert 'migrate' not in doc, \
+                    'migrate frame leaked to the client'
+                assert 'error' not in doc, doc
+                if 'token' in doc:
+                    got.append(doc['token'])
+                else:
+                    done_tokens = doc.get('tokens')
+        return got, done_tokens
+
+
+class TestServePlane:
+    """The full two-leg route through real HTTP: prefill replica ->
+    LB-intercepted handoff frame -> decode-pool restore (or forced
+    co-located fallback) — the client stream is identical either
+    way."""
+
+    def _serve(self, tiny, monkeypatch, n_decode=1, general=False):
+        """Build engines + ref; returns (engines dict, ref)."""
+        monkeypatch.setenv('SKYTPU_LB_POOL_PROMPT_THRESHOLD', '8')
+        # Only the explicit abandon (or a fallback resume) may free
+        # the prefill slot inside the test window — a short lease
+        # would mask a broken release path.
+        monkeypatch.setenv('SKYTPU_HANDOFF_LEASE_SECONDS', '30')
+        config, params = tiny
+        ref = _ref(params, config, prompt=_LB_PROMPT,
+                   steps=_LB_STEPS, max_seq_len=128,
+                   prefix_cache=False)
+        assert len(ref) == _LB_STEPS
+        def mk():
+            return _engine(params, config, max_seq_len=128,
+                           prefix_cache=False)
+
+        engines = {'prefill': mk()}
+        for i in range(n_decode):
+            engines[f'decode{i}'] = mk()
+        if general:
+            engines['general'] = mk()
+        return engines, ref
+
+    def test_planned_handoff_identity_and_pool_order(
+            self, tiny, monkeypatch):
+        """Happy path plus satellite 1 end to end: the breaker-open
+        decode replica is skipped, the second decode replica takes
+        the leg, the general pool never sees the blob — and the
+        prefill slot frees via the abandon signal long before its
+        30 s lease."""
+        from aiohttp import ClientSession
+        from aiohttp.test_utils import TestServer
+        from skypilot_tpu.inference import server as srv
+
+        engines, ref = self._serve(tiny, monkeypatch, n_decode=1,
+                                   general=True)
+        holders = {name: {'loop': srv.EngineLoop(eng)}
+                   for name, eng in engines.items()}
+        lb = lb_lib.LoadBalancer(policy_name='round_robin',
+                                 honor_env_policy=False)
+        c0 = {n: obs.__dict__[c].value() for n, c in [
+            ('att', 'HANDOFF_ATTEMPTS'),
+            ('succ', 'HANDOFF_SUCCESSES'),
+            ('fb', 'HANDOFF_FALLBACKS'),
+            ('mig', 'MIGRATION_ATTEMPTS'),
+            ('fail', 'LB_MIDSTREAM_FAILURES')]}
+
+        async def go():
+            servers = {n: TestServer(srv.create_app(h))
+                       for n, h in holders.items()}
+            for s in servers.values():
+                await s.start_server()
+            urls = {n: f'http://127.0.0.1:{s.port}'
+                    for n, s in servers.items()}
+            dead_decode = 'http://127.0.0.1:9'  # never listening
+            lb.set_replicas(
+                [urls['prefill'], dead_decode, urls['decode0'],
+                 urls['general']],
+                pools={urls['prefill']: 'prefill',
+                       dead_decode: 'decode',
+                       urls['decode0']: 'decode',
+                       urls['general']: 'general'})
+            for _ in range(3):  # force its breaker open
+                lb.breaker.record_failure(dead_decode)
+            assert not lb.breaker.allow(dead_decode)
+            lb_port = lb.start()
+            try:
+                async with ClientSession() as session:
+                    got, done = await _client_stream(
+                        session,
+                        f'http://127.0.0.1:{lb_port}/generate',
+                        _LB_PROMPT, _LB_STEPS)
+                # The abandon signal frees the prefill slot promptly
+                # (the lease alone would hold it 30 s).
+                deadline = time.time() + 5
+                while engines['prefill'].has_work and \
+                        time.time() < deadline:
+                    await asyncio.sleep(0.05)
+                return got, done
+            finally:
+                lb.stop()
+                for s in servers.values():
+                    await s.close()
+
+        try:
+            got, done = asyncio.new_event_loop().run_until_complete(
+                go())
+        finally:
+            for h in holders.values():
+                h['loop'].stop()
+        assert got == ref, (
+            f'client stream diverged: {len(got)} vs {len(ref)}')
+        assert done == ref
+        assert obs.HANDOFF_ATTEMPTS.value() == c0['att'] + 1
+        assert obs.HANDOFF_SUCCESSES.value() == c0['succ'] + 1
+        assert obs.HANDOFF_FALLBACKS.value() == c0['fb']
+        # A planned handoff is not a crash migration and never an
+        # honest termination.
+        assert obs.MIGRATION_ATTEMPTS.value() == c0['mig']
+        assert obs.LB_MIDSTREAM_FAILURES.value() == c0['fail']
+        # The decode replica took the leg; the general pool was never
+        # offered it.
+        assert engines['decode0']._next_id >= 1
+        assert engines['general']._next_id == 0
+        assert not engines['prefill'].has_work, \
+            'prefill slot still held after a confirmed handoff'
+
+    def test_forced_fallback_is_co_located_and_identical(
+            self, tiny, monkeypatch):
+        """Every rung short of the prefill replica chaos-killed: the
+        armed `lb.handoff` fault fails the decode-leg restore, the
+        ladder resumes the request co-located, the stream is
+        identical, and the degradation is COUNTED — never an
+        error."""
+        from aiohttp import ClientSession
+        from aiohttp.test_utils import TestServer
+        from skypilot_tpu.inference import server as srv
+
+        engines, ref = self._serve(tiny, monkeypatch, n_decode=1)
+        holders = {name: {'loop': srv.EngineLoop(eng)}
+                   for name, eng in engines.items()}
+        lb = lb_lib.LoadBalancer(policy_name='round_robin',
+                                 honor_env_policy=False)
+        faults.arm('lb.handoff', times=1, exc=OSError('chaos'))
+        att0 = obs.HANDOFF_ATTEMPTS.value()
+        succ0 = obs.HANDOFF_SUCCESSES.value()
+        fb0 = obs.HANDOFF_FALLBACKS.value()
+        fail0 = obs.LB_MIDSTREAM_FAILURES.value()
+
+        async def go():
+            servers = {n: TestServer(srv.create_app(h))
+                       for n, h in holders.items()}
+            for s in servers.values():
+                await s.start_server()
+            urls = {n: f'http://127.0.0.1:{s.port}'
+                    for n, s in servers.items()}
+            lb.set_replicas(
+                [urls['prefill'], urls['decode0']],
+                pools={urls['prefill']: 'prefill',
+                       urls['decode0']: 'decode'})
+            lb_port = lb.start()
+            try:
+                async with ClientSession() as session:
+                    return await _client_stream(
+                        session,
+                        f'http://127.0.0.1:{lb_port}/generate',
+                        _LB_PROMPT, _LB_STEPS)
+            finally:
+                lb.stop()
+                for s in servers.values():
+                    await s.close()
+
+        try:
+            got, done = asyncio.new_event_loop().run_until_complete(
+                go())
+        finally:
+            for h in holders.values():
+                h['loop'].stop()
+        assert got == ref, (
+            f'client stream diverged: {len(got)} vs {len(ref)}')
+        assert done == ref
+        assert obs.HANDOFF_ATTEMPTS.value() == att0 + 1
+        assert obs.HANDOFF_SUCCESSES.value() == succ0
+        assert obs.HANDOFF_FALLBACKS.value() == fb0 + 1
+        assert obs.LB_MIDSTREAM_FAILURES.value() == fail0
+        # The decode engine never saw the request.
+        assert engines['decode0']._next_id == 0

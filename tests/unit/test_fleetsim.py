@@ -572,6 +572,51 @@ class TestSmokeScenario:
         assert data['rc'] == 0
         assert data['scenario'] == 'preemption_migration'
 
+    def test_disaggregation_scenario_gates_handoff_ratio(
+            self, tmp_path):
+        """ISSUE 19 satellite: the disaggregation scenario pushes a
+        skewed prompt/gen mix through prefill + decode pools with
+        planned KV handoff, kills the busiest DECODE replicas
+        mid-wave, and gates the handoff success ratio (>= 0.85 from
+        skytpu_handoff_* counter deltas), ZERO failed requests, the
+        transfer p95, and the decode-pool TTFT p95 with the
+        co-located baseline pass (same seed, handoff off) in the
+        same report."""
+        sim = runner_lib.FleetSim(
+            runner_lib.SCENARIOS['disaggregation'], seed=0,
+            out_dir=str(tmp_path))
+        report = sim.run()
+        by_name = {r['name']: r for r in report['asserts']}
+        ratio = by_name['handoff_success']
+        assert ratio['ok'], ratio
+        assert ratio['metric'] == 'skytpu_handoff_successes_total'
+        # >= 0.85 but < 1.0: the armed lb.handoff fault forced a few
+        # counted co-located fallbacks — the degradation rung ran —
+        # yet the fleet still cleared the bar.
+        assert 0.85 <= ratio['value'] < 1.0, ratio
+        # A fallback is a degraded SUCCESS: zero hard failures even
+        # while chaos kills decode replicas mid-wave.
+        failed = by_name['failed_requests']
+        assert failed['ok'] and failed['value'] == 0.0, failed
+        assert by_name['baseline_failed_requests']['value'] == 0.0
+        transfer = by_name['handoff_transfer_p95']
+        assert transfer['ok'], transfer
+        assert transfer['metric'] == 'skytpu_handoff_transfer_seconds'
+        assert 0 < transfer['value'] <= 1.5
+        # Both sides of the A/B resolved the decode-pool TTFT series.
+        assert by_name['decode_pool_ttft_p95']['ok']
+        assert by_name['baseline_decode_pool_ttft_p95'][
+            'value'] is not None
+        assert report['rc'] == 0, report['asserts']
+        assert report['extra']['requests'] > 1000
+        assert report['extra']['handoff_enabled'] is True
+        assert report['extra']['baseline']['handoff_enabled'] is False
+        assert report['extra']['pools'] == ['decode', 'prefill']
+        data = json.loads(open(os.path.join(
+            str(tmp_path), 'SLO_disaggregation.json')).read())
+        assert data['rc'] == 0
+        assert data['scenario'] == 'disaggregation'
+
     def test_sharded_serve_scenario_gates_decode_and_hit_ratio(
             self, tmp_path):
         """ISSUE 14 satellite: the sharded_serve scenario drives
