@@ -241,6 +241,7 @@ async def run(url: str, concurrency: int, requests: int,
     lat = [r['latency'] for r in results]
     ttft = [r['ttft'] for r in results]
     gaps = [g for r in results for g in r['gaps']]
+    live = _live_telemetry(url)
     return {
         'metric': 'serve_decode_tokens_per_sec',
         'value': round(total_tokens / wall, 2),
@@ -263,8 +264,50 @@ async def run(url: str, concurrency: int, requests: int,
             'itl_p50_s': round(_pct(gaps, 0.5), 4),
             'itl_p99_s': round(_pct(gaps, 0.99), 4),
             'slowest_traces': _slowest_traces(results),
+            # What the server's OWN live telemetry plane said about
+            # this run: fired/cleared watchdog alerts plus the final
+            # windowed p95s from its /internal/timeseries ring — the
+            # operator's-alert view of the same wave (None when the
+            # server predates the plane or has it disabled).
+            'live_telemetry': live,
         },
     }
+
+
+def _live_telemetry(url: str, window: float = 120.0):
+    """Best-effort snapshot of a plane's live telemetry: watchdog
+    alert events plus windowed latency p95s queried back out of its
+    /internal/timeseries store. Never raises — loadgen's own numbers
+    stand alone when the endpoints are absent."""
+    import urllib.request
+
+    def _get(path: str):
+        with urllib.request.urlopen(url.rstrip('/') + path,
+                                    timeout=5) as r:
+            return json.loads(r.read().decode('utf-8'))
+
+    try:
+        alerts = _get('/internal/alerts')
+        out = {
+            'alerts': [{'rule': e.get('rule'),
+                        'state': e.get('state'),
+                        'value': e.get('value'),
+                        'detail': e.get('detail')}
+                       for e in alerts.get('events', [])],
+            'rules_firing': [r['name'] for r in
+                             alerts.get('rules', [])
+                             if r.get('firing')],
+        }
+        for key, metric in (
+                ('ttft_p95_window_s', 'skytpu_prefill_seconds'),
+                ('decode_step_p95_window_s',
+                 'skytpu_decode_step_seconds')):
+            doc = _get(f'/internal/timeseries?query=quantile'
+                       f'&metric={metric}&q=0.95&window={window}')
+            out[key] = doc.get('value')
+        return out
+    except Exception:  # noqa: BLE001 — evidence, not gating
+        return None
 
 
 # --- multi-replica LB comparison (the prefix-affinity capstone) -------------
@@ -464,14 +507,59 @@ def run_kill_replica(args):
     stream must still complete with its FULL token count and no
     visible error. rc=0 iff at least one request actually migrated
     and none failed — a drill where the kill missed every stream is
-    a failed drill, not a pass."""
+    a failed drill, not a pass.
+
+    The drill also exercises the FEDERATED watchdog end to end: the
+    LB (this process) scrapes every replica's /internal/timeseries
+    on its watchdog tick, so the SIGTERM must make its replica_up
+    rule FIRE (localized to the dead replica's series, flight
+    recorder dumped), and pruning the dead replica from the LB's set
+    — what the controller does once migration absorbed the load —
+    must CLEAR it. Both transitions gate rc."""
     repo_root = os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))
     sys.path.insert(0, repo_root)
     import signal
+    import tempfile
+    import urllib.request
+
+    # Tight telemetry cadence for the drill (seconds, not the
+    # production defaults) so fire->clear resolves within the run;
+    # setdefault keeps any operator-set values. Must happen before
+    # the replica env dict is built: replicas sample at the same
+    # cadence the LB scrapes.
+    os.environ.setdefault('SKYTPU_TS_SAMPLE_SECONDS', '1.0')
+    os.environ.setdefault('SKYTPU_WATCHDOG_TICK_SECONDS', '1.0')
+    dump_dir = os.environ.setdefault(
+        'SKYTPU_TRACE_DUMP_DIR',
+        tempfile.mkdtemp(prefix='skytpu_watchdog_'))
 
     from skypilot_tpu.observability import instruments as obs
     from skypilot_tpu.serve import load_balancer as lb_lib
+
+    def _lb_json(lb_port: int, path: str):
+        with urllib.request.urlopen(
+                f'http://127.0.0.1:{lb_port}{path}', timeout=5) as r:
+            return json.loads(r.read().decode('utf-8'))
+
+    def _wait_alert(lb_port: int, state: str,
+                    timeout_s: float = 45.0):
+        """Poll the LB's /internal/alerts for a replica_up event in
+        `state`; returns (event, snapshot) or (None, snapshot)."""
+        deadline = time.time() + timeout_s
+        doc = {}
+        while time.time() < deadline:
+            try:
+                doc = _lb_json(lb_port, '/internal/alerts')
+            except (OSError, ValueError):
+                doc = {}
+            events = [e for e in doc.get('events', [])
+                      if e.get('rule') == 'replica_up'
+                      and e.get('state') == state]
+            if events:
+                return events[-1], doc
+            time.sleep(0.5)
+        return None, doc
 
     n = args.lb_replicas if args.lb_replicas >= 2 else 2
     ports = [_free_port() for _ in range(n)]
@@ -553,6 +641,42 @@ def run_kill_replica(args):
         t0 = time.perf_counter()
         asyncio.run(_drill())
         wall = time.perf_counter() - t0
+
+        # Federated-watchdog phase. FIRE: the dead replica's scrape
+        # fails, its skytpu_replica_up series goes 0, and after the
+        # breach hysteresis the LB's replica_up rule fires (dumping
+        # the flight recorder + offending window to
+        # SKYTPU_TRACE_DUMP_DIR).
+        fire_event, _ = _wait_alert(lb_port, 'fire')
+        # Localization: the per-replica series must blame exactly
+        # the SIGTERMed replica — survivors stay at 1.
+        localization = {}
+        for url in urls:
+            try:
+                doc = _lb_json(
+                    lb_port,
+                    '/internal/timeseries?query=gauge'
+                    '&metric=skytpu_replica_up'
+                    f'&replica={url}')
+                value = doc.get('value') or {}
+                localization[url] = value.get('last')
+            except (OSError, ValueError):
+                localization[url] = None
+        # The federated view also answers fleet-vs-replica latency
+        # off the merged store (evidence the scrape path works, not
+        # a gate).
+        try:
+            fleet_ttft = _lb_json(
+                lb_port, '/internal/timeseries?query=quantile'
+                '&metric=skytpu_prefill_seconds&q=0.95'
+                '&window=120').get('value')
+        except (OSError, ValueError):
+            fleet_ttft = None
+        # CLEAR: prune the dead replica from the set — the
+        # controller's move once migration absorbed its load — and
+        # the rule (re-reading membership each tick) must clear.
+        lb.set_replicas(urls[1:])
+        clear_event, wd_snapshot = _wait_alert(lb_port, 'clear')
         lb.stop()
     finally:
         for proc in procs:
@@ -586,11 +710,19 @@ def run_kill_replica(args):
     max_gaps = sorted((max(r['gaps']) for r in results if r['gaps']),
                       reverse=True)
     interrupted = sorted(max_gaps[:migrated])
+    # The dead replica must be BLAMED (its up-series last sample 0)
+    # and every survivor exonerated (1) in the LB's federated store.
+    localized = (localization.get(urls[0]) == 0.0
+                 and all(localization.get(u) == 1.0
+                         for u in urls[1:]))
+    watchdog_ok = (fire_event is not None
+                   and clear_event is not None and localized)
     return {
         'metric': 'serve_preemption_migrated_requests',
         'value': migrated,
         'unit': 'requests',
-        'rc': 0 if migrated > 0 and failed == 0 else 1,
+        'rc': 0 if (migrated > 0 and failed == 0
+                    and watchdog_ok) else 1,
         'extra': {
             'workload': 'kill_replica',
             'replicas': n,
@@ -617,6 +749,19 @@ def run_kill_replica(args):
             # stream's worst hiccup shows.
             'max_gap_p50_s': (round(_pct(max_gaps, 0.5), 4)
                               if max_gaps else None),
+            # Federated-watchdog evidence: the LB's alert lifecycle
+            # around the kill, the per-replica blame, and the dumps
+            # an operator would triage from.
+            'watchdog': {
+                'fired': fire_event,
+                'cleared': clear_event,
+                'localization_up_last': localization,
+                'localized_to_killed_replica': localized,
+                'fleet_ttft_p95_window_s': fleet_ttft,
+                'dump_dir': dump_dir,
+                'dumps': (fire_event or {}).get('dumps', []),
+                'rules': (wd_snapshot or {}).get('rules', []),
+            },
         },
     }
 

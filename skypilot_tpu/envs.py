@@ -440,7 +440,60 @@ SKYTPU_TRACE_SLOW_SECONDS = declare(
 SKYTPU_TRACE_DUMP_DIR = declare(
     'SKYTPU_TRACE_DUMP_DIR', str, None,
     'When set, the LB dumps the flight-recorder ring here as '
-    'TRACE_<reason>_<pid>.json whenever a circuit breaker opens.')
+    'TRACE_<reason>_<pid>.json whenever a circuit breaker opens, and '
+    'the telemetry watchdog dumps the ring plus the offending metric '
+    'window as WATCHDOG_<rule>_<pid>.json whenever a rule fires.')
+
+# --- live telemetry plane (time-series ring + watchdog) ----------------------
+
+SKYTPU_TS_SAMPLE_SECONDS = declare(
+    'SKYTPU_TS_SAMPLE_SECONDS', float, 5.0,
+    'Seconds between background samples of the whole skytpu_* '
+    'registry into the in-process time-series ring (the store behind '
+    '/internal/timeseries). 0 disables the sampler thread.')
+SKYTPU_TS_CAPACITY = declare(
+    'SKYTPU_TS_CAPACITY', int, 240,
+    'Samples retained per series in the time-series ring (240 x the '
+    '5s default cadence = 20 minutes of history). Older samples fall '
+    'off the ring; memory stays hard-bounded.')
+SKYTPU_TS_MAX_SERIES = declare(
+    'SKYTPU_TS_MAX_SERIES', int, 4096,
+    'Hard cap on distinct series the time-series store retains. Past '
+    'the cap, new series only displace series that went stale '
+    '(stopped appearing in samples); fresh series are dropped and '
+    'counted, so label churn can never grow memory without bound.')
+SKYTPU_WATCHDOG_TICK_SECONDS = declare(
+    'SKYTPU_WATCHDOG_TICK_SECONDS', float, 15.0,
+    'Seconds between live watchdog rule evaluations over the '
+    'time-series store. 0 disables the watchdog thread. (Distinct '
+    'from SKYTPU_WATCHDOG_INTERVAL, the server state-dir watchdog.)')
+SKYTPU_WATCHDOG_RULES = declare(
+    'SKYTPU_WATCHDOG_RULES', str, None,
+    'Semicolon-separated live SLO rules, e.g. '
+    '"p95(skytpu_prefill_seconds)<0.5@60; '
+    'ratio(skytpu_spec_accepted_tokens_total/'
+    'skytpu_spec_proposed_tokens_total)>=0.5@120; '
+    'within(skytpu_kv_pages_free,1,inf); '
+    'anomaly(skytpu_decode_step_seconds)". See '
+    'docs/guides/observability.md for the grammar. Unset means the '
+    'built-in anomaly detectors only.')
+SKYTPU_WATCHDOG_WINDOW_SECONDS = declare(
+    'SKYTPU_WATCHDOG_WINDOW_SECONDS', float, 60.0,
+    'Default query window (seconds) for watchdog rules that do not '
+    'spell their own @window suffix.')
+SKYTPU_WATCHDOG_BREACH_TICKS = declare(
+    'SKYTPU_WATCHDOG_BREACH_TICKS', int, 2,
+    'Consecutive breached watchdog evaluations before a rule FIRES '
+    '(hysteresis against one-tick blips).')
+SKYTPU_WATCHDOG_CLEAR_TICKS = declare(
+    'SKYTPU_WATCHDOG_CLEAR_TICKS', int, 3,
+    'Consecutive healthy watchdog evaluations before a firing rule '
+    'CLEARS (hysteresis against boundary-hugging flapping).')
+SKYTPU_WATCHDOG_ANOMALY_Z = declare(
+    'SKYTPU_WATCHDOG_ANOMALY_Z', float, 8.0,
+    'Robust-z threshold for the EWMA anomaly detector over step-time '
+    'and TTFT series (deviation vs EWMA mean, scaled by an EWMA of '
+    'absolute deviation). 0 disables the built-in anomaly rules.')
 
 # --- fleet simulation / soak harness ----------------------------------------
 

@@ -25,6 +25,8 @@ from skypilot_tpu.fleetsim import replicas as replicas_lib
 from skypilot_tpu.fleetsim import slo as slo_lib
 from skypilot_tpu.fleetsim import traffic as traffic_lib
 from skypilot_tpu.observability import instruments as obs
+from skypilot_tpu.observability import timeseries as timeseries_lib
+from skypilot_tpu.observability import watchdog as watchdog_lib
 from skypilot_tpu.resilience import faults
 from skypilot_tpu.serve import autoscalers as autoscalers_lib
 from skypilot_tpu.serve import controller as controller_lib
@@ -84,6 +86,15 @@ class Scenario:
     # TTFT is read against, in the same report. Mutually exclusive
     # with compare_lb_policy.
     compare_handoff_off: bool = False
+    # LIVE watchdog rules (observability/watchdog.py objects) run
+    # against the virtual clock every sim tick once warmup ends: a
+    # private time-series store samples the rules' metrics per tick
+    # and the engine's fire/clear transitions land in the REAL
+    # skytpu_watchdog_alerts_total — which `slos` can then gate with
+    # CounterDeltaWithin (e.g. "fired during the outage, cleared
+    # after, silent before"). Keep these rules stateless (GaugeWithin
+    # etc.): the catalog entry is reused across passes.
+    watchdog: Tuple[Any, ...] = ()
 
 
 class _PrefixWorkload:
@@ -317,6 +328,21 @@ class FleetSim:
         evaluator.mark('start')
         schedule = chaos_lib.ChaosSchedule.from_config(sc.chaos)
 
+        # Live watchdog on the virtual clock: private store (one
+        # scenario's windows must not see another's samples), now_fn
+        # from the sim, ticked once per sim tick after warmup — the
+        # startup ramp (0 READY replicas) is not an outage.
+        wd = None
+        wd_store = None
+        wd_names = None
+        if sc.watchdog:
+            wd_store = timeseries_lib.TimeSeriesStore()
+            wd = watchdog_lib.Watchdog(rules=list(sc.watchdog),
+                                       store=wd_store,
+                                       now_fn=vclock.now)
+            wd_names = tuple({r.metric for r in sc.watchdog
+                              if getattr(r, 'metric', None)}) or None
+
         recovery_pending: Dict[str, Dict[str, float]] = {}
         outcomes: Dict[str, int] = {}
         controller_crashes = 0
@@ -393,6 +419,9 @@ class FleetSim:
                 if not warmup_marked and t >= sc.warmup_s:
                     evaluator.mark('warmup_end')
                     warmup_marked = True
+                if wd is not None and t >= sc.warmup_s:
+                    wd_store.sample_now(now=t, names=wd_names)
+                    wd.tick()
         except Exception as e:  # noqa: BLE001 — reported + re-raised
             crash = e
 
@@ -433,6 +462,7 @@ class FleetSim:
             'aborted': aborted,
             'error': (f'{type(crash).__name__}: {crash}'
                       if crash is not None else None),
+            'watchdog': wd.snapshot() if wd is not None else None,
         }
         return {'results': results, 'extra': extra, 'crash': crash,
                 'aborted': aborted}
@@ -820,6 +850,72 @@ register(Scenario(
                          'skytpu_prefix_cache_misses_total')),
         slo_lib.HistQuantileBelow('baseline_ttft_p95',
                                   threshold=1e9),
+    ),
+))
+
+register(Scenario(
+    name='watchdog',
+    description=('Live-watchdog gate (ISSUE 20): a two-zone fleet '
+                 'loses zone-a under sustained traffic; the LIVE '
+                 'watchdog (ticked on the virtual clock) watches '
+                 'READY replica count and must FIRE during the '
+                 'outage, stay silent before it, and CLEAR once '
+                 'replacement capacity lands in the surviving zone '
+                 '— all three transitions gated from deltas of the '
+                 'REAL skytpu_watchdog_alerts_total counter the '
+                 'engine increments.'),
+    replicas=40,
+    duration_s=220.0, tick_s=2.0, warmup_s=30.0,
+    traffic={'kind': 'constant', 'qps': 100.0},
+    profile=_SMOKE_PROFILE,
+    zones=('zone-a', 'zone-b'),
+    # Empty policy = FixedReplicaAutoscaler holding 40: the
+    # controller replaces the lost zone's replicas (SimFleet places
+    # new capacity only in surviving zones), which is exactly what
+    # clears the alert mid-outage.
+    lb_policy='round_robin',
+    chaos=(
+        {'at': 56.0, 'action': 'mark', 'name': 'pre_outage'},
+        {'at': 60.0, 'action': 'zone_loss', 'zone': 'zone-a'},
+        {'at': 160.0, 'action': 'zone_restore', 'zone': 'zone-a'},
+    ),
+    watchdog=(
+        # With 40 replicas over two zones, losing zone-a halves
+        # READY (~20 < 32); replacements restore it past the floor.
+        watchdog_lib.GaugeWithin(
+            'ready_replicas', 'skytpu_serve_replicas',
+            lo=32.0, hi=float('inf'),
+            labels={'service': 'fleetsim-watchdog',
+                    'state': 'READY'},
+            window=12.0),
+    ),
+    slos=(
+        # The three watchdog-transition gates, from counter deltas:
+        # silent before the outage...
+        slo_lib.CounterDeltaWithin(
+            'watchdog_silent_before_outage',
+            metric='skytpu_watchdog_alerts_total',
+            labels=(('rule', 'ready_replicas'), ('state', 'fire')),
+            min_delta=0.0, max_delta=0.0,
+            window=('start', 'pre_outage')),
+        # ...fired during it...
+        slo_lib.CounterDeltaWithin(
+            'watchdog_fired_on_outage',
+            metric='skytpu_watchdog_alerts_total',
+            labels=(('rule', 'ready_replicas'), ('state', 'fire')),
+            min_delta=1.0,
+            window=('pre_outage', 'end')),
+        # ...and cleared once replacements restored capacity.
+        slo_lib.CounterDeltaWithin(
+            'watchdog_cleared_on_recovery',
+            metric='skytpu_watchdog_alerts_total',
+            labels=(('rule', 'ready_replicas'), ('state', 'clear')),
+            min_delta=1.0,
+            window=('pre_outage', 'end')),
+        slo_lib.GaugeWithin('zone_loss_recovery', threshold=90.0,
+                            labels=(('event', 'zone_loss'),)),
+        slo_lib.HistQuantileBelow('ttft_p95', threshold=2.0),
+        slo_lib.RatioBelow('error_rate', threshold=0.01),
     ),
 ))
 

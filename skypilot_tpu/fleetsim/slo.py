@@ -77,8 +77,29 @@ class GaugeWithin:
     lo: float = 0.0
 
 
+@dataclasses.dataclass(frozen=True)
+class CounterDeltaWithin:
+    """delta(counter) over the window, summed across series matching
+    `labels` (subset match), sits in [min_delta, max_delta] — e.g.
+    'the watchdog fired at least once during the outage' (min 1) or
+    'it never fired before it' (max 0)."""
+    name: str
+    metric: str
+    min_delta: float = 0.0
+    max_delta: float = math.inf
+    labels: Tuple[Tuple[str, str], ...] = ()
+    window: Tuple[str, str] = _DEFAULT_WINDOW
+
+    @property
+    def threshold(self) -> float:
+        # _result() reports one scalar bound; the binding one here is
+        # the finite max when set, else the min.
+        return self.max_delta if math.isfinite(self.max_delta) \
+            else self.min_delta
+
+
 SLOAssert = (HistQuantileBelow, RatioBelow, CounterRatioAbove,
-             GaugeWithin)
+             GaugeWithin, CounterDeltaWithin)
 
 
 class SLOEvaluator:
@@ -220,6 +241,24 @@ class SLOEvaluator:
         return _result(a, value, a.lo <= value <= a.threshold,
                        f'bounds [{a.lo}, {a.threshold}]')
 
+    def _eval_counter_delta(self, a: CounterDeltaWithin) -> Dict:
+        delta = self._delta(a.metric, a.window)
+        if delta is None:
+            return _result(a, math.nan, False,
+                           f'window {a.window} never marked')
+        want = dict(a.labels)
+        total = 0.0
+        for (series, labels), value in delta.items():
+            if series != a.metric:
+                continue
+            have = dict(labels)
+            if all(have.get(k) == v for k, v in want.items()):
+                total += value
+        ok = a.min_delta <= total <= a.max_delta
+        return _result(a, total, ok,
+                       f'delta in [{a.min_delta:g}, {a.max_delta:g}]'
+                       f' over {a.window}')
+
     def evaluate(self) -> List[Dict]:
         out = []
         for a in self.asserts:
@@ -231,6 +270,8 @@ class SLOEvaluator:
                 out.append(self._eval_counter_ratio(a))
             elif isinstance(a, GaugeWithin):
                 out.append(self._eval_gauge(a))
+            elif isinstance(a, CounterDeltaWithin):
+                out.append(self._eval_counter_delta(a))
             else:
                 raise TypeError(f'unknown SLO assert {a!r}')
         return out

@@ -43,7 +43,9 @@ from skypilot_tpu import sky_logging
 from skypilot_tpu.observability import instruments as obs
 from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.observability import spans
+from skypilot_tpu.observability import timeseries as timeseries_lib
 from skypilot_tpu.observability import tracing
+from skypilot_tpu.observability import watchdog as watchdog_lib
 from skypilot_tpu.utils import timeline
 
 # Explicit name, not __name__: `python -m skypilot_tpu.inference.server`
@@ -833,6 +835,10 @@ def create_app(engine_holder: Dict[str, Any]):
     app.router.add_get('/', health)
     app.router.add_get('/metrics', metrics_lib.aiohttp_handler)
     app.router.add_get('/internal/trace', internal_trace)
+    app.router.add_get('/internal/timeseries',
+                       timeseries_lib.aiohttp_handler)
+    app.router.add_get('/internal/alerts',
+                       watchdog_lib.aiohttp_handler)
     app.router.add_post('/internal/drain', internal_drain)
     app.router.add_get('/internal/snapshot', internal_snapshot)
     app.router.add_post('/internal/resume', internal_resume)
@@ -985,6 +991,13 @@ def main() -> None:
     args = parser.parse_args()
     if not args.no_exit_with_parent:
         _watch_parent()
+
+    # Live telemetry plane: background registry sampler + SLO
+    # watchdog (each a no-op when its interval knob is 0). Started
+    # here rather than in create_app so embedding tests stay
+    # thread-free.
+    timeseries_lib.start_sampler()
+    watchdog_lib.start_watchdog()
 
     holder: Dict[str, Any] = {
         'loop': None, 'tokenizer': None,

@@ -25,7 +25,7 @@ import bisect
 import math
 import re
 import threading
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 CONTENT_TYPE = 'text/plain; version=0.0.4; charset=utf-8'
 
@@ -72,6 +72,67 @@ def _render_labels(names: Sequence[str], values: Sequence[str]) -> str:
     inner = ','.join(f'{n}="{_escape_label_value(v)}"'
                      for n, v in zip(names, values))
     return '{' + inner + '}'
+
+
+class HistPoint(NamedTuple):
+    """One histogram series in a FamilySnapshot: cumulative counts per
+    FINITE bucket (the +Inf cumulative equals `count`), plus the
+    per-bucket exemplar slots (len(buckets)+1, last is +Inf)."""
+    labelvalues: Tuple[str, ...]
+    cumulative: Tuple[float, ...]
+    sum: float
+    count: int
+    exemplars: Tuple[Optional[Tuple[str, float]], ...]
+
+
+class FamilySnapshot(NamedTuple):
+    """Structured snapshot of one metric family — the single source
+    both the text exposition and the time-series sampler consume, so
+    the two can never disagree. Scalar families (counter/gauge) carry
+    `scalars` in samples() triplet form; histogram families carry
+    `histograms` and a non-None `buckets`."""
+    name: str
+    kind: str
+    help: str
+    labelnames: Tuple[str, ...]
+    buckets: Optional[Tuple[float, ...]]
+    scalars: Tuple[Tuple[str, Tuple[Tuple[str, str], ...], float], ...]
+    histograms: Tuple[HistPoint, ...]
+
+
+def render_family(fam: FamilySnapshot) -> str:
+    """Prometheus text (0.0.4 + exemplar suffixes) for one family —
+    byte-identical to what the pre-collect() per-metric renderers
+    emitted, because scrapers and golden tests pin that format."""
+    lines = [f'# HELP {fam.name} {_escape_help(fam.help)}',
+             f'# TYPE {fam.name} {fam.kind}']
+    if fam.buckets is None:
+        for series, labelpairs, value in fam.scalars:
+            names = tuple(n for n, _ in labelpairs)
+            values = tuple(v for _, v in labelpairs)
+            lines.append(f'{series}{_render_labels(names, values)} '
+                         f'{_format_value(value)}')
+        return '\n'.join(lines)
+    base_names = fam.labelnames + ('le',)
+    bounds = [_format_value(b) for b in fam.buckets] + ['+Inf']
+    for point in fam.histograms:
+        cumulative = list(point.cumulative) + [point.count]
+        for bound, cum, ex in zip(bounds, cumulative,
+                                  point.exemplars):
+            line = (f'{fam.name}_bucket'
+                    f'{_render_labels(base_names, point.labelvalues + (bound,))}'
+                    f' {_format_value(cum)}')
+            if ex is not None:
+                line += (f' # {{trace_id='
+                         f'"{_escape_label_value(ex[0])}"}} '
+                         f'{_format_value(ex[1])}')
+            lines.append(line)
+        base = _render_labels(fam.labelnames, point.labelvalues)
+        lines.append(f'{fam.name}_sum{base} '
+                     f'{_format_value(point.sum)}')
+        lines.append(f'{fam.name}_count{base} '
+                     f'{_format_value(float(point.count))}')
+    return '\n'.join(lines)
 
 
 class Metric:
@@ -142,15 +203,15 @@ class Metric:
         """[(series_name, ((label, value), ...), value)] snapshot."""
         raise NotImplementedError
 
+    def collect(self) -> FamilySnapshot:
+        """One structured snapshot of this family (scalar form)."""
+        return FamilySnapshot(
+            name=self.name, kind=self.type_name, help=self.help,
+            labelnames=self.labelnames, buckets=None,
+            scalars=tuple(self.samples()), histograms=())
+
     def collect_text(self) -> str:
-        lines = [f'# HELP {self.name} {_escape_help(self.help)}',
-                 f'# TYPE {self.name} {self.type_name}']
-        for series, labelpairs, value in self.samples():
-            names = tuple(n for n, _ in labelpairs)
-            values = tuple(v for _, v in labelpairs)
-            lines.append(f'{series}{_render_labels(names, values)} '
-                         f'{_format_value(value)}')
-        return '\n'.join(lines)
+        return render_family(self.collect())
 
 
 class _CounterChild:
@@ -379,41 +440,29 @@ class Histogram(Metric):
                 })
         return out
 
-    def collect_text(self) -> str:
-        """Histogram exposition with OpenMetrics-style exemplar
-        suffixes on bucket lines: `... 5 # {trace_id="..."} 0.042`.
-        Exemplar-free buckets render exactly as before, so plain
-        0.0.4 scrapers keep parsing every series."""
-        lines = [f'# HELP {self.name} {_escape_help(self.help)}',
-                 f'# TYPE {self.name} {self.type_name}']
+    def collect(self) -> FamilySnapshot:
+        """Structured snapshot: cumulative finite-bucket counts, sum,
+        count, and exemplar slots per series. The text exposition
+        (with OpenMetrics-style exemplar suffixes on bucket lines:
+        `... 5 # {trace_id="..."} 0.042`) renders from exactly this,
+        as does the time-series sampler — one consistent pass."""
         with self._lock:
             items = sorted(self._children.items())
+        points = []
         for key, child in items:
             counts, total, n = child.snapshot()
-            exemplars = child.exemplars()
-            base_names = self.labelnames + ('le',)
-            running = 0
-            bounds = ([_format_value(b) for b in self.buckets]
-                      + ['+Inf'])
-            cumulative = []
-            for c in counts:
+            cumulative, running = [], 0
+            for c in counts[:-1]:
                 running += c
-                cumulative.append(running)
-            for bound, cum, ex in zip(bounds, cumulative, exemplars):
-                line = (f'{self.name}_bucket'
-                        f'{_render_labels(base_names, key + (bound,))}'
-                        f' {_format_value(cum)}')
-                if ex is not None:
-                    line += (f' # {{trace_id='
-                             f'"{_escape_label_value(ex[0])}"}} '
-                             f'{_format_value(ex[1])}')
-                lines.append(line)
-            base = _render_labels(self.labelnames, key)
-            lines.append(f'{self.name}_sum{base} '
-                         f'{_format_value(total)}')
-            lines.append(f'{self.name}_count{base} '
-                         f'{_format_value(float(n))}')
-        return '\n'.join(lines)
+                cumulative.append(float(running))
+            points.append(HistPoint(
+                labelvalues=key, cumulative=tuple(cumulative),
+                sum=total, count=n,
+                exemplars=tuple(child.exemplars())))
+        return FamilySnapshot(
+            name=self.name, kind=self.type_name, help=self.help,
+            labelnames=self.labelnames, buckets=self.buckets,
+            scalars=(), histograms=tuple(points))
 
 
 class Registry:
@@ -446,8 +495,19 @@ class Registry:
         with self._lock:
             return self._metrics.get(name)
 
+    def collect(self) -> List[FamilySnapshot]:
+        """Structured snapshot of every registered family, one
+        consistent pass in name order — the sampler-facing sibling of
+        generate_text() (which renders exactly this), so text
+        exposition and time-series sampling can never disagree."""
+        return [m.collect() for m in self.metrics()]
+
     def generate_text(self) -> str:
-        return '\n'.join(m.collect_text() for m in self.metrics()) + '\n'
+        # Per-metric collect_text(), not render_family(collect()):
+        # the default is identical, but subclasses (lint fixtures)
+        # may override the text form alone.
+        return '\n'.join(m.collect_text()
+                         for m in self.metrics()) + '\n'
 
 
 # The process-wide default registry: every plane (API server, inference

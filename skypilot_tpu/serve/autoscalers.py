@@ -64,14 +64,23 @@ class MetricsSignalSource:
     (signals absent, scaling falls back to request rate) until a
     scraping source is wired in: the controller takes any object with
     read()/read_pools() via its signal_source seam, and aggregating
-    replica /metrics into one is the ROADMAP item-2 follow-up."""
+    replica /metrics into one is the ROADMAP item-2 follow-up.
+
+    The histogram windows live in the shared time-series ring
+    (observability/timeseries.py): each read_pools() call appends one
+    targeted sample of just its two histograms and resolves the p95
+    from the bucket delta since its previous call — the identical
+    window any operator can query back out of /internal/timeseries,
+    instead of private snapshot bookkeeping only this object saw."""
 
     def __init__(self, ttft_metric: str = 'skytpu_prefill_seconds',
-                 decode_step_metric: str = 'skytpu_decode_step_seconds'
-                 ) -> None:
+                 decode_step_metric: str = 'skytpu_decode_step_seconds',
+                 store=None, now_fn=None) -> None:
         self.ttft_metric = ttft_metric
         self.decode_step_metric = decode_step_metric
-        self._snaps: Dict[str, Dict] = {}
+        self._store = store
+        self._now_fn = now_fn
+        self._last_read: Optional[float] = None
 
     def _pool_gauge(self, gauge, pool: Optional[str],
                     fallback) -> float:
@@ -84,26 +93,18 @@ class MetricsSignalSource:
                     return value
         return fallback.value()
 
-    def _p95_delta(self, metric_name: str) -> Optional[float]:
+    def _p95_delta(self, metric_name: str, now: float
+                   ) -> Optional[float]:
         import math
-        from skypilot_tpu.observability import metrics as metrics_lib
-        metric = metrics_lib.REGISTRY.get(metric_name)
-        if metric is None:
+        store = self._resolved_store()
+        # since=None on the first read means "everything so far" —
+        # the same lifetime-baseline first reading the old private
+        # snapshots produced.
+        delta = store.hist_delta(metric_name, window=None, now=now,
+                                 since=self._last_read)
+        if delta is None:
             return None
-        snap = {(series, labels): value
-                for series, labels, value in metric.samples()}
-        prev = self._snaps.get(metric_name, {})
-        self._snaps[metric_name] = snap
-        buckets = []
-        count = 0.0
-        for (series, labels), value in snap.items():
-            delta = value - prev.get((series, labels), 0.0)
-            if series == f'{metric_name}_bucket':
-                le = dict(labels)['le']
-                bound = math.inf if le == '+Inf' else float(le)
-                buckets.append((bound, delta))
-            elif series == f'{metric_name}_count':
-                count += delta
+        buckets, count = delta
         if count < _P95_MIN_SAMPLES:
             return None
         top_finite = None
@@ -119,6 +120,12 @@ class MetricsSignalSource:
                 return top_finite if bound == math.inf else bound
         return None
 
+    def _resolved_store(self):
+        if self._store is None:
+            from skypilot_tpu.observability import timeseries
+            self._store = timeseries.STORE
+        return self._store
+
     def read(self) -> LoadSignals:
         from skypilot_tpu.observability import instruments as obs
         return LoadSignals(queue_depth=obs.QUEUE_DEPTH.value(),
@@ -129,8 +136,16 @@ class MetricsSignalSource:
         consumed ONCE per call (per-pool calls would hand the delta
         to whichever pool asked first)."""
         from skypilot_tpu.observability import instruments as obs
-        ttft_p95 = self._p95_delta(self.ttft_metric)
-        decode_p95 = self._p95_delta(self.decode_step_metric)
+        now = (self._now_fn or time.time)()
+        # One targeted sample of just our two histograms — the whole
+        # registry is the background Sampler's job, not the
+        # controller tick's.
+        self._resolved_store().sample_now(
+            now=now, names=(self.ttft_metric,
+                            self.decode_step_metric))
+        ttft_p95 = self._p95_delta(self.ttft_metric, now)
+        decode_p95 = self._p95_delta(self.decode_step_metric, now)
+        self._last_read = now
         out: Dict[Optional[str], LoadSignals] = {}
         for pool in pools:
             out[pool] = LoadSignals(

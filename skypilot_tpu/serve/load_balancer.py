@@ -18,6 +18,8 @@ from skypilot_tpu import envs
 from skypilot_tpu.observability import instruments as obs
 from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.observability import spans
+from skypilot_tpu.observability import timeseries as timeseries_lib
+from skypilot_tpu.observability import watchdog as watchdog_lib
 from skypilot_tpu.resilience import circuit
 from skypilot_tpu.resilience import faults
 from skypilot_tpu.resilience import retries
@@ -188,6 +190,14 @@ class LoadBalancer:
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._runner = None
         self._thread: Optional[threading.Thread] = None
+        # Fleet telemetry federation: the LB's watchdog scrapes every
+        # replica's /internal/timeseries on its tick (pre_tick seam)
+        # into the shared store, each series stamped with a `replica`
+        # label — so /internal/timeseries here answers per-replica
+        # AND fleet-merged queries, and the watchdog's rules run over
+        # the whole fleet's series.
+        self._watchdog: Optional[watchdog_lib.Watchdog] = None
+        self._scrape_since: Dict[str, float] = {}
         # Fire-and-forget coroutines (handoff-source abandons): the
         # event loop holds tasks weakly, so keep strong refs until
         # each one finishes.
@@ -1097,6 +1107,47 @@ class LoadBalancer:
                 spans.to_chrome_trace(records)['traceEvents'],
         })
 
+    # -- fleet telemetry federation -------------------------------------------
+
+    def _scrape_replicas(self, wd: watchdog_lib.Watchdog) -> None:
+        """Watchdog pre_tick: pull every replica's retained series
+        (incrementally, via `since=`) into the shared store under a
+        `replica=<url>` label, and write the synthetic
+        skytpu_replica_up gauge per scrape outcome. Runs in the
+        watchdog's own thread — blocking urllib is fine here and
+        keeps the proxy's event loop out of it entirely."""
+        import urllib.request
+        store = wd.store
+        for target in list(self.policy.replicas):
+            url = (target.rstrip('/') + '/internal/timeseries')
+            since = self._scrape_since.get(target)
+            if since is not None:
+                url += f'?since={since}'
+            up = 0.0
+            try:
+                with urllib.request.urlopen(url, timeout=2) as r:
+                    doc = json.loads(r.read().decode('utf-8'))
+                store.ingest_dump(doc, extra_labels={'replica': target})
+                self._scrape_since[target] = float(
+                    doc.get('now') or 0.0) or self._scrape_since.get(
+                        target, 0.0)
+                up = 1.0
+            except (OSError, ValueError):
+                pass
+            store.add_sample('skytpu_replica_up', {'replica': target},
+                             up, now=wd.now_fn())
+
+    def _fleet_rules(self) -> List[Any]:
+        """The LB's live rules: whatever SKYTPU_WATCHDOG_RULES /
+        anomaly defaults say, plus replica liveness over the CURRENT
+        replica set — membership is re-read each tick, so pruning a
+        dead replica from the set clears its alert."""
+        rules = watchdog_lib.default_rules()
+        rules.append(watchdog_lib.ReplicaUp(
+            'replica_up',
+            replicas_fn=lambda: list(self.policy.replicas)))
+        return rules
+
     def _create_app(self):
         from aiohttp import web
         app = web.Application(client_max_size=1024 * 1024 * 256)
@@ -1105,6 +1156,15 @@ class LoadBalancer:
         # Registered before the catch-all proxy: the LB's own metrics,
         # not a replica's (a replica's /metrics is scraped directly).
         app.router.add_get('/metrics', metrics_lib.aiohttp_handler)
+        # Fleet-merged telemetry: the store behind these holds the
+        # LB's own series plus every replica's (replica-labeled), so
+        # one curl localizes a regression to a replica or the fleet.
+        app.router.add_get('/internal/timeseries',
+                           timeseries_lib.aiohttp_handler)
+        app.router.add_get('/internal/alerts',
+                           watchdog_lib.aiohttp_handler)
+        if self._watchdog is not None:
+            app['skytpu_watchdog'] = self._watchdog
         app.router.add_route('*', '/{tail:.*}', self._handle_proxy)
         return app
 
@@ -1112,6 +1172,16 @@ class LoadBalancer:
 
     def start(self) -> int:
         """Start in a daemon thread; returns the bound port."""
+        # The telemetry plane rides the LB lifecycle: local registry
+        # sampler plus a federated watchdog whose every tick first
+        # scrapes the replicas' series (each a no-op when its
+        # interval knob is 0).
+        timeseries_lib.start_sampler()
+        if envs.SKYTPU_WATCHDOG_TICK_SECONDS.get() > 0:
+            self._watchdog = watchdog_lib.Watchdog(
+                rules=self._fleet_rules(),
+                pre_tick=self._scrape_replicas)
+            self._watchdog.start()
         ready = threading.Event()
 
         def _serve():
@@ -1136,6 +1206,9 @@ class LoadBalancer:
         return self.port
 
     def stop(self) -> None:
+        if self._watchdog is not None:
+            self._watchdog.stop()
+            self._watchdog = None
         if self._loop is not None:
             async def _cleanup():
                 if self._runner is not None:

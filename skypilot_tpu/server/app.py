@@ -17,6 +17,8 @@ import skypilot_tpu
 from skypilot_tpu import envs
 from skypilot_tpu.observability import instruments as obs
 from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.observability import timeseries as timeseries_lib
+from skypilot_tpu.observability import watchdog as watchdog_lib
 from skypilot_tpu.server import auth
 from skypilot_tpu.server import executor
 from skypilot_tpu.server import impl  # noqa: F401 — populates REGISTRY
@@ -624,6 +626,13 @@ async def _state_dir_watchdog(app):
         _watch())
 
 
+async def _start_telemetry(app):  # noqa: ARG001
+    """Background registry sampler + SLO watchdog for the API plane
+    (daemon threads; each a no-op when its interval knob is 0)."""
+    timeseries_lib.start_sampler()
+    watchdog_lib.start_watchdog()
+
+
 def create_app():
     from aiohttp import web
     # The observability middleware runs OUTERMOST: it binds the
@@ -634,8 +643,13 @@ def create_app():
                           + auth.middlewares())
     app.on_startup.append(_recover_orphans)
     app.on_startup.append(_state_dir_watchdog)
+    app.on_startup.append(_start_telemetry)
     app.router.add_get(f'{API_PREFIX}/health', _handle_health)
     app.router.add_get('/metrics', _handle_metrics)
+    app.router.add_get('/internal/timeseries',
+                       timeseries_lib.aiohttp_handler)
+    app.router.add_get('/internal/alerts',
+                       watchdog_lib.aiohttp_handler)
     app.router.add_post(f'{API_PREFIX}/heartbeat', _handle_heartbeat)
     app.router.add_get('/dashboard', _handle_dashboard)
     app.router.add_get('/dashboard/login', _handle_login_page)

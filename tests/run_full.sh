@@ -18,8 +18,8 @@ python -m pytest tests/ -q -m slow
 # Non-zero rc == an SLO regression; SLO_<scenario>.json carries the
 # evidence. JAX_PLATFORMS=cpu keeps the sim off any real accelerator.
 for scenario in smoke fused_decode spec_decode shared_prefix \
-        sharded_serve prefix_affinity zone_loss rolling_update \
-        preemption_wave preemption_migration; do
+        sharded_serve prefix_affinity watchdog zone_loss \
+        rolling_update preemption_wave preemption_migration; do
     JAX_PLATFORMS=cpu python -m skypilot_tpu.fleetsim \
         --scenario "$scenario" --out /tmp
 done
@@ -207,6 +207,83 @@ try:
     print(f'drain smoke: {len(got)} streamed on A + {len(rest)} '
           f'restored on B == uninterrupted reference')
 finally:
+    for p in procs:
+        p.kill()
+EOF
+# Federated-watchdog smoke: two real servers behind a REAL load
+# balancer, telemetry cranked to a 0.5s cadence. SIGTERM one replica:
+# the LB's scrape loop writes skytpu_replica_up=0 for it, the
+# replica_up rule must FIRE on /internal/alerts (localized to the
+# dead replica), and pruning the dead replica from the set — the
+# controller's move — must CLEAR it. The degradation ladder end to
+# end, observed purely through the LB's own alert plane.
+JAX_PLATFORMS=cpu SKYTPU_TS_SAMPLE_SECONDS=0.5 \
+SKYTPU_WATCHDOG_TICK_SECONDS=0.5 python - <<'EOF'
+import json, signal, subprocess, sys, time, urllib.request
+
+PORT_A, PORT_B = 18361, 18362
+procs = [subprocess.Popen(
+    [sys.executable, '-m', 'skypilot_tpu.inference.server',
+     '--port', str(port), '--model', 'tiny', '--batch-size', '2',
+     '--max-seq-len', '128'],
+    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    for port in (PORT_A, PORT_B)]
+lb = None
+try:
+    for port in (PORT_A, PORT_B):
+        for _ in range(120):
+            try:
+                with urllib.request.urlopen(
+                        f'http://127.0.0.1:{port}/health',
+                        timeout=2) as r:
+                    if r.status == 200:
+                        break
+            except Exception:
+                time.sleep(1)
+        else:
+            raise SystemExit(f'server on {port} never became healthy')
+
+    from skypilot_tpu.serve import load_balancer as lb_lib
+    urls = [f'http://127.0.0.1:{p}' for p in (PORT_A, PORT_B)]
+    lb = lb_lib.LoadBalancer('round_robin', honor_env_policy=False)
+    lb.set_replicas(urls)
+    lb_port = lb.start()
+
+    def alerts():
+        with urllib.request.urlopen(
+                f'http://127.0.0.1:{lb_port}/internal/alerts',
+                timeout=5) as r:
+            return json.load(r)
+
+    def wait_event(state, timeout_s=60.0):
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            doc = alerts()
+            for ev in doc.get('events', ()):
+                if ev['rule'] == 'replica_up' and \
+                        ev['state'] == state:
+                    return ev
+            time.sleep(0.5)
+        raise SystemExit(
+            f'replica_up never reached {state!r}: {alerts()}')
+
+    # Both replicas up: give the scrape loop a few ticks and demand
+    # silence.
+    time.sleep(3)
+    doc = alerts()
+    assert not any(r['firing'] for r in doc['rules']), doc['rules']
+
+    procs[0].send_signal(signal.SIGTERM)
+    fired = wait_event('fire')
+    assert urls[0] in fired['detail'], fired
+
+    lb.set_replicas(urls[1:])      # the controller prunes the corpse
+    wait_event('clear')
+    print(f'watchdog smoke: replica_up fired on {urls[0]} '
+          f'and cleared after pruning')
+finally:
+    if lb is not None:
+        lb.stop()
     for p in procs:
         p.kill()
 EOF

@@ -1,0 +1,405 @@
+"""The in-process time-series ring (observability/timeseries.py).
+
+Covers the properties ISSUE 20 names as load-bearing: ring
+wraparound under a fixed capacity, counter-reset clamping (restarts
+must never produce negative rates), quantile-from-bucket-delta
+agreement with fleetsim's offline SLO evaluator on the same traffic,
+bounded memory under adversarial label churn, the dump/ingest
+federation round trip, and the windowed-query HTTP shapes.
+"""
+import json
+import math
+
+import pytest
+
+from skypilot_tpu.fleetsim import slo as slo_lib
+from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.observability import timeseries as ts_lib
+
+
+def _store(**kw):
+    kw.setdefault('registry', metrics_lib.Registry())
+    return ts_lib.TimeSeriesStore(**kw)
+
+
+class TestRing:
+
+    def test_wraparound_keeps_newest(self):
+        store = _store(capacity=5)
+        for i in range(20):
+            store.add_sample('skytpu_q_depth', {}, float(i),
+                             now=float(i))
+        stats = store.stats()
+        assert stats['series'] == 1
+        assert stats['samples'] == 5
+        got = store.gauge_stats('skytpu_q_depth', window=100.0,
+                                now=19.0)
+        # Only the 5 newest samples (15..19) survive the wrap.
+        assert got == {'min': 15.0, 'mean': 17.0, 'max': 19.0,
+                       'last': 19.0, 'count': 5.0}
+
+    def test_capacity_floor_is_two(self):
+        # A capacity of 1 could never answer a windowed delta.
+        store = _store(capacity=1)
+        assert store.stats()['capacity'] == 2
+
+    def test_window_excludes_old_samples(self):
+        store = _store()
+        for t in (0.0, 10.0, 20.0, 30.0):
+            store.add_sample('skytpu_q_depth', {}, t, now=t)
+        got = store.gauge_stats('skytpu_q_depth', window=15.0,
+                                now=30.0)
+        assert got['min'] == 20.0 and got['count'] == 2.0
+
+
+class TestCounterQueries:
+
+    def test_rate_and_increase(self):
+        store = _store()
+        for t in range(6):
+            store.add_sample('skytpu_reqs_total', {}, 2.0 * t,
+                             now=float(t), kind='counter')
+        assert store.counter_increase('skytpu_reqs_total',
+                                      window=10.0, now=5.0) == 10.0
+        assert store.counter_rate('skytpu_reqs_total',
+                                  window=10.0, now=5.0) == 2.0
+
+    def test_reset_clamped_never_negative(self):
+        store = _store()
+        # 0 -> 100, restart (drops to 3), -> 10: the true increase is
+        # 100 (pre-reset) + 3 (post-reset absolute) + 7 = 110 — never
+        # a negative contribution from the reset itself.
+        for t, v in ((0, 0.0), (1, 100.0), (2, 3.0), (3, 10.0)):
+            store.add_sample('skytpu_reqs_total', {}, v,
+                             now=float(t), kind='counter')
+        inc = store.counter_increase('skytpu_reqs_total',
+                                     window=10.0, now=3.0)
+        assert inc == 110.0
+        rate = store.counter_rate('skytpu_reqs_total',
+                                  window=10.0, now=3.0)
+        assert rate is not None and rate > 0
+
+    def test_none_without_two_samples(self):
+        store = _store()
+        store.add_sample('skytpu_reqs_total', {}, 5.0, now=0.0,
+                         kind='counter')
+        assert store.counter_increase('skytpu_reqs_total',
+                                      window=10.0, now=0.0) is None
+
+    def test_labels_subset_match(self):
+        store = _store()
+        for t in range(3):
+            store.add_sample('skytpu_reqs_total',
+                             {'outcome': 'ok', 'zone': 'a'},
+                             float(t), now=float(t), kind='counter')
+            store.add_sample('skytpu_reqs_total',
+                             {'outcome': 'error', 'zone': 'a'},
+                             10.0 * t, now=float(t), kind='counter')
+        assert store.counter_increase(
+            'skytpu_reqs_total', {'outcome': 'error'},
+            window=10.0, now=2.0) == 20.0
+        # No filter aggregates the fleet.
+        assert store.counter_increase(
+            'skytpu_reqs_total', window=10.0, now=2.0) == 22.0
+
+
+class TestHistogramQueries:
+
+    def _seed(self, reg, values, name='skytpu_ts_test_seconds'):
+        hist = metrics_lib.Histogram(
+            name, 'Test latency.', buckets=(0.1, 0.5, 1.0, 2.0),
+            registry=reg)
+        for v in values:
+            hist.observe(v)
+        return hist
+
+    def test_quantile_from_window_delta(self):
+        reg = metrics_lib.Registry()
+        store = ts_lib.TimeSeriesStore(registry=reg)
+        hist = self._seed(reg, [0.05] * 90 + [1.5] * 10)
+        store.sample_now(now=-50.0)     # out-of-window: aged series
+        store.sample_now(now=0.0)
+        # Second interval is all slow: the WINDOWED p95 must see only
+        # the delta, not the lifetime distribution.
+        for _ in range(100):
+            hist.observe(1.5)
+        store.sample_now(now=10.0)
+        p95 = store.hist_quantile('skytpu_ts_test_seconds', 0.95,
+                                  window=30.0, now=10.0)
+        assert p95 == 2.0
+        p50_lifetime = store.hist_quantile('skytpu_ts_test_seconds',
+                                           0.50, window=30.0, now=10.0)
+        assert p50_lifetime == 2.0
+
+    def test_quantile_agrees_with_fleetsim_slo(self):
+        """The live store and the offline SLOEvaluator must resolve
+        the SAME p95 from the same traffic window — both use the
+        bucket-upper-bound convention, so any disagreement is a bug
+        in one of the delta paths."""
+        name = 'skytpu_ts_agreement_seconds'
+        hist = metrics_lib.Histogram(
+            name, 'Agreement fixture.', buckets=(0.1, 0.5, 1.0, 2.0),
+            registry=metrics_lib.REGISTRY)
+        try:
+            store = ts_lib.TimeSeriesStore()
+            ev = slo_lib.SLOEvaluator([slo_lib.HistQuantileBelow(
+                'agree', threshold=10.0, metric=name, q=0.95,
+                window=('warmup_end', 'end'))])
+            # Pre-window traffic both sides must ignore (the extra
+            # out-of-window sample ages the series so the in-window
+            # baseline is a true baseline, not first-ever).
+            for _ in range(50):
+                hist.observe(1.5)
+            ev.mark('warmup_end')
+            store.sample_now(now=40.0, names=(name,))
+            store.sample_now(now=100.0, names=(name,))
+            for v in [0.05] * 90 + [0.3] * 8 + [1.5] * 2:
+                hist.observe(v)
+            ev.mark('end')
+            store.sample_now(now=160.0, names=(name,))
+            offline = ev.evaluate()[0]
+            live = store.hist_quantile(name, 0.95, window=60.0,
+                                       now=160.0)
+            assert offline['ok']
+            assert live == offline['value'] == 0.5
+        finally:
+            metrics_lib.REGISTRY.unregister(hist)
+
+    def test_young_series_reports_absolutes(self):
+        """A series whose whole (unwrapped) history fits in the window
+        uses a ZERO baseline: a freshly started server must answer
+        windowed quantiles for traffic it served before the sampler's
+        first pass — not report an empty window."""
+        reg = metrics_lib.Registry()
+        store = ts_lib.TimeSeriesStore(registry=reg)
+        hist = self._seed(reg, [0.05] * 90 + [1.5] * 10)
+        store.sample_now(now=0.0)       # first sample: carries all
+        hist.observe(0.05)
+        store.sample_now(now=1.0)
+        p95 = store.hist_quantile('skytpu_ts_test_seconds', 0.95,
+                                  window=60.0, now=1.0)
+        assert p95 == 2.0               # the 10 slow obs are visible
+        mean = store.hist_mean('skytpu_ts_test_seconds',
+                               window=60.0, now=1.0)
+        assert mean is not None and mean > 0
+
+    def test_restart_clamps_to_absolutes(self):
+        reg = metrics_lib.Registry()
+        store = ts_lib.TimeSeriesStore(registry=reg)
+        hist = self._seed(reg, [0.05] * 10)
+        store.sample_now(now=0.0)
+        # "Restart": a fresh histogram under the same name with fewer
+        # samples than the baseline.
+        reg.unregister(hist)
+        hist2 = self._seed(reg, [1.5] * 4)
+        store.sample_now(now=10.0)
+        pairs, count = store.hist_delta('skytpu_ts_test_seconds',
+                                        window=30.0, now=10.0)
+        assert count == 4.0
+        assert all(c >= 0 for _, c in pairs)
+        assert store.hist_quantile('skytpu_ts_test_seconds', 0.95,
+                                   window=30.0, now=10.0) == 2.0
+        del hist2
+
+    def test_hist_mean_windowed(self):
+        reg = metrics_lib.Registry()
+        store = ts_lib.TimeSeriesStore(registry=reg)
+        hist = self._seed(reg, [1.0] * 10)
+        store.sample_now(now=-50.0)     # out-of-window: aged series
+        store.sample_now(now=0.0)
+        for _ in range(10):
+            hist.observe(2.0)
+        store.sample_now(now=10.0)
+        mean = store.hist_mean('skytpu_ts_test_seconds',
+                               window=30.0, now=10.0)
+        assert mean == pytest.approx(2.0)
+
+    def test_quantile_min_count(self):
+        reg = metrics_lib.Registry()
+        store = ts_lib.TimeSeriesStore(registry=reg)
+        self._seed(reg, [0.05] * 3)
+        store.sample_now(now=0.0)
+        assert store.hist_quantile('skytpu_ts_test_seconds', 0.95,
+                                   window=30.0, now=0.0,
+                                   min_count=5) is None
+
+    def test_shared_quantile_convention(self):
+        buckets = [(0.1, 0.0), (0.5, 95.0), (1.0, 99.0),
+                   (math.inf, 100.0)]
+        assert ts_lib.quantile_from_buckets(buckets, 100.0,
+                                            0.95) == 0.5
+        assert ts_lib.quantile_from_buckets(buckets, 100.0,
+                                            0.999) == math.inf
+
+
+class TestBoundedMemory:
+
+    def test_label_churn_cannot_grow_memory(self):
+        """10k unique label sets against max_series=64: the store must
+        stay at the cap, drop the excess, and keep hard sample bounds
+        — this is the 'provably bounded under churn' acceptance."""
+        store = _store(capacity=8, max_series=64)
+        for i in range(10_000):
+            store.add_sample('skytpu_churn', {'id': str(i)}, 1.0,
+                             now=float(i))
+        stats = store.stats()
+        assert stats['series'] <= 64
+        assert stats['samples'] <= 64 * 8
+        assert stats['dropped_series'] + stats['evicted_series'] > 0
+
+    def test_stale_series_evicted_for_newcomers(self):
+        store = _store(capacity=4, max_series=2)
+        store.add_sample('skytpu_a', {}, 1.0, now=0.0)
+        store.add_sample('skytpu_b', {}, 1.0, now=1.0)
+        # a and b are now stale relative to this pass: c displaces
+        # the stalest (a).
+        store.add_sample('skytpu_c', {}, 1.0, now=2.0)
+        stats = store.stats()
+        assert stats['series'] == 2
+        assert stats['evicted_series'] == 1
+        assert store.gauge_stats('skytpu_a', window=10.0,
+                                 now=2.0) is None
+        assert store.gauge_stats('skytpu_c', window=10.0,
+                                 now=2.0) is not None
+
+    def test_same_pass_newcomer_drops_not_evicts(self):
+        """Series admitted in the SAME ingest pass are not eviction
+        candidates — an over-cap pass drops the excess newcomers
+        instead of thrashing the series it just admitted."""
+        reg = metrics_lib.Registry()
+        g1 = metrics_lib.Gauge('skytpu_live_a', 'A.', registry=reg)
+        g2 = metrics_lib.Gauge('skytpu_live_b', 'B.', registry=reg)
+        g1.set(1.0)
+        g2.set(2.0)
+        store = ts_lib.TimeSeriesStore(registry=reg, max_series=1,
+                                       capacity=4)
+        store.sample_now(now=0.0)
+        stats = store.stats()
+        assert stats['series'] == 1
+        assert stats['dropped_series'] >= 1
+        assert stats['evicted_series'] == 0
+
+
+class TestFederation:
+
+    def test_dump_ingest_round_trip(self):
+        reg = metrics_lib.Registry()
+        hist = metrics_lib.Histogram(
+            'skytpu_fed_seconds', 'Fed.', buckets=(0.5, 1.0),
+            registry=reg)
+        c = metrics_lib.Counter('skytpu_fed_total', 'Fed.',
+                                registry=reg)
+        for _ in range(4):
+            hist.observe(0.3)
+            c.inc()
+        replica = ts_lib.TimeSeriesStore(registry=reg)
+        replica.sample_now(now=5.0)
+        c.inc(6.0)
+        hist.observe(0.9)
+        replica.sample_now(now=10.0)
+
+        doc = json.loads(json.dumps(replica.dump()))  # wire trip
+        lb = _store()
+        n = lb.ingest_dump(doc, extra_labels={'replica': 'r1'})
+        assert n == 4  # 2 series x 2 samples
+        # The replica label scopes queries to one origin...
+        assert lb.counter_increase('skytpu_fed_total',
+                                   {'replica': 'r1'}, window=30.0,
+                                   now=10.0) == 6.0
+        # ...and the merged histogram answers fleet quantiles.
+        assert lb.hist_quantile('skytpu_fed_seconds', 0.95,
+                                window=30.0, now=10.0) == 1.0
+        # Nothing from another replica pollutes r1's view.
+        assert lb.counter_increase('skytpu_fed_total',
+                                   {'replica': 'r2'}, window=30.0,
+                                   now=10.0) is None
+
+    def test_dump_since_is_incremental(self):
+        store = _store()
+        for t in range(5):
+            store.add_sample('skytpu_g', {}, float(t), now=float(t))
+        doc = store.dump(since=2.0)
+        (row,) = doc['series']
+        assert [s[0] for s in row['samples']] == [3.0, 4.0]
+        assert store.dump(since=100.0)['series'] == []
+
+
+class TestQueryResponse:
+
+    def test_shapes(self):
+        store = _store()
+        for t in range(4):
+            store.add_sample('skytpu_q_total', {}, float(t),
+                             now=float(t), kind='counter')
+            store.add_sample('skytpu_q_depth', {'replica': 'r1'},
+                             2.0, now=float(t))
+        rate = ts_lib.query_response(
+            store, {'query': 'rate', 'metric': 'skytpu_q_total',
+                    'window': '10'})
+        assert rate['value'] == 1.0
+        gauge = ts_lib.query_response(
+            store, {'query': 'gauge', 'metric': 'skytpu_q_depth',
+                    'replica': 'r1', 'window': '10'})
+        assert gauge['value']['last'] == 2.0
+        assert gauge['labels'] == {'replica': 'r1'}
+        bad = ts_lib.query_response(store, {'query': 'nope'})
+        assert 'error' in bad
+
+    def test_inf_and_missing_are_json_safe(self):
+        reg = metrics_lib.Registry()
+        store = ts_lib.TimeSeriesStore(registry=reg)
+        hist = metrics_lib.Histogram(
+            'skytpu_q_seconds', 'Q.', buckets=(0.1,), registry=reg)
+        for _ in range(10):
+            hist.observe(5.0)  # all land in +Inf
+        store.sample_now(now=0.0)
+        doc = ts_lib.query_response(
+            store, {'query': 'quantile', 'metric': 'skytpu_q_seconds',
+                    'window': '10'})
+        assert doc['value'] == 'inf'
+        missing = ts_lib.query_response(
+            store, {'query': 'rate', 'metric': 'skytpu_absent',
+                    'window': '10'})
+        assert missing['value'] is None
+        json.dumps(doc), json.dumps(missing)
+
+
+class TestSampler:
+
+    def test_sampler_disabled_at_zero(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_TS_SAMPLE_SECONDS', '0')
+        s = ts_lib.Sampler(store=_store())
+        assert s.start() is False
+
+    def test_sampler_runs_and_stops(self):
+        reg = metrics_lib.Registry()
+        metrics_lib.Gauge('skytpu_s_depth', 'S.', registry=reg).set(1)
+        store = ts_lib.TimeSeriesStore(registry=reg)
+        s = ts_lib.Sampler(store=store, interval=0.01)
+        assert s.start()
+        deadline = 200
+        while store.stats()['samples'] == 0 and deadline:
+            deadline -= 1
+            import time
+            time.sleep(0.01)
+        s.stop()
+        assert store.stats()['samples'] > 0
+
+
+class TestEnvKnobs:
+
+    def test_defaults(self, monkeypatch):
+        for var in ('SKYTPU_TS_SAMPLE_SECONDS', 'SKYTPU_TS_CAPACITY',
+                    'SKYTPU_TS_MAX_SERIES'):
+            monkeypatch.delenv(var, raising=False)
+        from skypilot_tpu import envs
+        assert envs.SKYTPU_TS_SAMPLE_SECONDS.get() == 5.0
+        assert envs.SKYTPU_TS_CAPACITY.get() == 240
+        assert envs.SKYTPU_TS_MAX_SERIES.get() == 4096
+
+    def test_overrides(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_TS_CAPACITY', '16')
+        from skypilot_tpu import envs
+        assert envs.SKYTPU_TS_CAPACITY.get() == 16
+        store = ts_lib.TimeSeriesStore()
+        assert store.stats()['capacity'] == 16

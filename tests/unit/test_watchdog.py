@@ -1,0 +1,375 @@
+"""The continuous SLO watchdog (observability/watchdog.py).
+
+Covers the alerting discipline ISSUE 20 specifies: breach/clear
+hysteresis (a boundary-hugging signal must never flap an alert),
+fire -> clear lifecycle with the transition counter and evidence
+dumps, the EWMA robust-z anomaly detector catching a step change
+after warmup, the SKYTPU_WATCHDOG_RULES grammar round trip, and the
+ReplicaUp federation rule clearing when membership is pruned.
+"""
+import glob
+import json
+import math
+import os
+
+import pytest
+
+from skypilot_tpu.observability import instruments as obs
+from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.observability import timeseries as ts_lib
+from skypilot_tpu.observability import watchdog as wd_lib
+
+
+def _store():
+    return ts_lib.TimeSeriesStore(registry=metrics_lib.Registry())
+
+
+class _Clock:
+
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def now(self):
+        return self.t
+
+    def advance(self, dt=1.0):
+        self.t += dt
+        return self.t
+
+
+def _gauge_watchdog(store, clock, *, lo=0.0, hi=10.0,
+                    breach_ticks=2, clear_ticks=3, **kw):
+    rule = wd_lib.GaugeWithin('depth', 'skytpu_wd_depth',
+                              lo=lo, hi=hi, window=30.0)
+    return wd_lib.Watchdog(rules=[rule], store=store,
+                           now_fn=clock.now,
+                           breach_ticks=breach_ticks,
+                           clear_ticks=clear_ticks,
+                           window=30.0, **kw), rule
+
+
+class TestHysteresis:
+
+    def test_fire_needs_consecutive_breaches(self):
+        store, clock = _store(), _Clock()
+        wd, _ = _gauge_watchdog(store, clock, dump_evidence=False)
+        # One breach tick: no alert yet.
+        store.add_sample('skytpu_wd_depth', {}, 50.0,
+                         now=clock.advance())
+        assert wd.tick() == []
+        assert wd.snapshot()['rules'][0]['breach_streak'] == 1
+        # Second consecutive breach: FIRE.
+        store.add_sample('skytpu_wd_depth', {}, 50.0,
+                         now=clock.advance())
+        (event,) = wd.tick()
+        assert event['state'] == 'fire'
+        assert event['rule'] == 'depth'
+        assert wd.snapshot()['rules'][0]['firing'] is True
+
+    def test_boundary_hugging_signal_never_flaps(self):
+        """Alternating ok/breach samples with breach_ticks=2 must
+        never fire — and an alternating signal against clear_ticks=3
+        must never clear a firing alert either. No alert storms from
+        a signal that hugs its threshold."""
+        store, clock = _store(), _Clock()
+        wd, _ = _gauge_watchdog(store, clock, dump_evidence=False)
+        for i in range(40):
+            value = 50.0 if i % 2 else 5.0
+            store.add_sample('skytpu_wd_depth', {}, value,
+                             now=clock.advance())
+            assert wd.tick() == []
+        snap = wd.snapshot()['rules'][0]
+        assert snap['fired'] == 0 and snap['firing'] is False
+
+    def test_clear_needs_consecutive_clean_ticks(self):
+        store, clock = _store(), _Clock()
+        wd, _ = _gauge_watchdog(store, clock, dump_evidence=False)
+        for _ in range(2):
+            store.add_sample('skytpu_wd_depth', {}, 50.0,
+                             now=clock.advance())
+            wd.tick()
+        assert wd.snapshot()['rules'][0]['firing'] is True
+        # Two clean ticks: still firing (clear_ticks=3)...
+        for _ in range(2):
+            store.add_sample('skytpu_wd_depth', {}, 5.0,
+                             now=clock.advance())
+            assert wd.tick() == []
+        # ...the third clears.
+        store.add_sample('skytpu_wd_depth', {}, 5.0,
+                         now=clock.advance())
+        (event,) = wd.tick()
+        assert event['state'] == 'clear'
+        snap = wd.snapshot()['rules'][0]
+        assert snap['fired'] == 1 and snap['cleared'] == 1
+
+    def test_insufficient_data_holds_state(self):
+        """evaluate() -> None (no samples in window) advances NEITHER
+        streak: a scrape gap cannot clear a real alert."""
+        store, clock = _store(), _Clock()
+        wd, _ = _gauge_watchdog(store, clock, dump_evidence=False)
+        for _ in range(2):
+            store.add_sample('skytpu_wd_depth', {}, 50.0,
+                             now=clock.advance())
+            wd.tick()
+        assert wd.snapshot()['rules'][0]['firing'] is True
+        # 100s of silence: the window goes empty; ticks are no-ops.
+        for _ in range(10):
+            clock.advance(10.0)
+            assert wd.tick() == []
+        snap = wd.snapshot()['rules'][0]
+        assert snap['firing'] is True and snap['clear_streak'] == 0
+
+    def test_transitions_counted_in_registry(self):
+        store, clock = _store(), _Clock()
+        wd, _ = _gauge_watchdog(store, clock, dump_evidence=False)
+        fired = obs.WATCHDOG_ALERTS.labels(rule='depth',
+                                           state='fire')
+        cleared = obs.WATCHDOG_ALERTS.labels(rule='depth',
+                                             state='clear')
+        f0, c0 = fired.value(), cleared.value()
+        for _ in range(2):
+            store.add_sample('skytpu_wd_depth', {}, 50.0,
+                             now=clock.advance())
+            wd.tick()
+        for _ in range(3):
+            store.add_sample('skytpu_wd_depth', {}, 5.0,
+                             now=clock.advance())
+            wd.tick()
+        assert fired.value() == f0 + 1
+        assert cleared.value() == c0 + 1
+
+
+class TestEvidenceDump:
+
+    def test_fire_dumps_window_and_trace(self, tmp_path,
+                                         monkeypatch):
+        monkeypatch.setenv('SKYTPU_TRACE_DUMP_DIR', str(tmp_path))
+        store, clock = _store(), _Clock()
+        wd, _ = _gauge_watchdog(store, clock)
+        for _ in range(2):
+            store.add_sample('skytpu_wd_depth', {}, 50.0,
+                             now=clock.advance())
+            events = wd.tick()
+        (event,) = events
+        dumps = event['dumps']
+        wd_dump = [p for p in dumps if 'WATCHDOG_depth_' in p]
+        assert wd_dump, dumps
+        doc = json.loads(open(wd_dump[0]).read())
+        assert doc['rule'] == 'depth'
+        assert doc['value'] == 50.0
+        # The offending window rides along: the breached series with
+        # its retained samples, directly feedable to `top --file`.
+        names = [row['name'] for row in doc['window']['series']]
+        assert 'skytpu_wd_depth' in names
+        assert glob.glob(os.path.join(str(tmp_path),
+                                      'WATCHDOG_depth_*.json'))
+
+    def test_no_dump_dir_means_no_files(self, monkeypatch):
+        monkeypatch.delenv('SKYTPU_TRACE_DUMP_DIR', raising=False)
+        store, clock = _store(), _Clock()
+        wd, _ = _gauge_watchdog(store, clock)
+        for _ in range(2):
+            store.add_sample('skytpu_wd_depth', {}, 50.0,
+                             now=clock.advance())
+            events = wd.tick()
+        assert events[0].get('dumps') == []
+
+
+class TestRules:
+
+    def test_hist_quantile_rule(self):
+        reg = metrics_lib.Registry()
+        store = ts_lib.TimeSeriesStore(registry=reg)
+        hist = metrics_lib.Histogram(
+            'skytpu_wd_seconds', 'W.', buckets=(0.1, 0.5, 2.0),
+            registry=reg)
+        rule = wd_lib.HistQuantileBelow('p95', 'skytpu_wd_seconds',
+                                        threshold=0.5, window=30.0)
+        for _ in range(20):
+            hist.observe(0.05)
+        store.sample_now(now=0.0)
+        for _ in range(20):
+            hist.observe(1.5)
+        store.sample_now(now=10.0)
+        res = rule.evaluate(store, 10.0, 60.0)
+        assert res['breached'] and res['value'] == 2.0
+
+    def test_counter_ratio_rule(self):
+        store = _store()
+        for t in range(3):
+            store.add_sample('skytpu_hits_total', {}, 1.0 * t,
+                             now=float(t), kind='counter')
+            store.add_sample('skytpu_misses_total', {}, 9.0 * t,
+                             now=float(t), kind='counter')
+        rule = wd_lib.CounterRatioAbove(
+            'hit_ratio', 'skytpu_hits_total',
+            ('skytpu_hits_total', 'skytpu_misses_total'),
+            threshold=0.5, window=30.0)
+        res = rule.evaluate(store, 2.0, 60.0)
+        assert res['breached'] and res['value'] == pytest.approx(0.1)
+
+    def test_replica_up_fires_and_clears_on_pruning(self):
+        """The federation rule: a dead replica's up=0 breaches; the
+        rule re-reads membership each tick, so pruning the dead
+        replica CLEARS the alert without any new samples."""
+        store, clock = _store(), _Clock()
+        members = ['http://r1', 'http://r2']
+        rule = wd_lib.ReplicaUp('replica_up', lambda: members,
+                                window=30.0)
+        for url in members:
+            store.add_sample('skytpu_replica_up', {'replica': url},
+                             1.0, now=clock.advance())
+        res = rule.evaluate(store, clock.t, 60.0)
+        assert not res['breached']
+        store.add_sample('skytpu_replica_up',
+                         {'replica': 'http://r2'}, 0.0,
+                         now=clock.advance())
+        res = rule.evaluate(store, clock.t, 60.0)
+        assert res['breached'] and 'http://r2' in res['detail']
+        members.remove('http://r2')
+        res = rule.evaluate(store, clock.t, 60.0)
+        assert not res['breached']
+
+    def test_gauge_on_missing_modes(self):
+        store = _store()
+        skip = wd_lib.GaugeWithin('g', 'skytpu_absent', hi=1.0,
+                                  on_missing='skip')
+        breach = wd_lib.GaugeWithin('g', 'skytpu_absent', hi=1.0,
+                                    on_missing='breach')
+        assert skip.evaluate(store, 0.0, 60.0) is None
+        assert breach.evaluate(store, 0.0, 60.0)['breached']
+
+
+class TestAnomaly:
+
+    def test_step_change_detected_after_warmup(self):
+        store, clock = _store(), _Clock()
+        rule = wd_lib.AnomalyEWMA('anom', 'skytpu_wd_lat',
+                                  z_max=8.0, warmup_ticks=5,
+                                  window=30.0)
+        # Steady signal with small jitter through warmup + baseline.
+        for i in range(12):
+            value = 1.0 + 0.01 * (i % 3)
+            store.add_sample('skytpu_wd_lat', {}, value,
+                             now=clock.advance())
+            res = rule.evaluate(store, clock.t, 60.0)
+            assert not res['breached'], (i, res)
+        # 10x step: robust-z explodes past any sane bound.
+        store.add_sample('skytpu_wd_lat', {}, 10.0,
+                         now=clock.advance())
+        res = rule.evaluate(store, clock.t, 60.0)
+        assert res['breached'] and res['value'] > 8.0
+
+    def test_warmup_never_breaches(self):
+        store, clock = _store(), _Clock()
+        rule = wd_lib.AnomalyEWMA('anom', 'skytpu_wd_lat',
+                                  z_max=0.001, warmup_ticks=5,
+                                  window=30.0)
+        for i in range(5):
+            store.add_sample('skytpu_wd_lat', {}, float(i * i),
+                             now=clock.advance())
+            res = rule.evaluate(store, clock.t, 60.0)
+            assert not res['breached']
+            assert 'warmup' in res['detail']
+
+
+class TestRuleGrammar:
+
+    def test_round_trip(self):
+        rules = wd_lib.parse_rules(
+            'p95(skytpu_decode_step_seconds) < 0.5 @ 120; '
+            'ratio(skytpu_hits_total/skytpu_hits_total+'
+            'skytpu_misses_total) >= 0.8; '
+            'within(skytpu_batch_occupancy, 0, 64) @ 30; '
+            'anomaly(skytpu_prefill_seconds)')
+        kinds = [type(r).__name__ for r in rules]
+        assert kinds == ['HistQuantileBelow', 'CounterRatioAbove',
+                         'GaugeWithin', 'AnomalyEWMA']
+        p95, ratio, within, anom = rules
+        assert p95.q == 0.95 and p95.threshold == 0.5 \
+            and p95.window == 120.0
+        assert ratio.den_metrics == ('skytpu_hits_total',
+                                     'skytpu_misses_total')
+        assert within.lo == 0.0 and within.hi == 64.0 \
+            and within.window == 30.0
+        assert anom.metric == 'skytpu_prefill_seconds'
+        assert anom.window is None
+
+    @pytest.mark.parametrize('bad', [
+        'p95(m) > 0.5',            # quantile needs an upper bound
+        'ratio(a/b) < 0.5',        # ratio needs a lower bound
+        'ratio(nodenominator) >= 1',
+        'within(m, 1)',            # needs metric, lo, hi
+        'anomaly(m) < 3',          # takes no comparator
+        'bogus(m) < 1',
+        'p95(m) 0.5',              # missing comparator
+    ])
+    def test_garbage_raises(self, bad):
+        with pytest.raises(ValueError):
+            wd_lib.parse_rules(bad)
+
+    def test_empty_spec_is_empty(self):
+        assert wd_lib.parse_rules('') == []
+        assert wd_lib.parse_rules(' ; ; ') == []
+
+    def test_default_rules_from_env(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_WATCHDOG_RULES',
+                           'within(skytpu_q, 0, 9)')
+        monkeypatch.setenv('SKYTPU_WATCHDOG_ANOMALY_Z', '8')
+        rules = wd_lib.default_rules()
+        names = [r.name for r in rules]
+        assert 'within(skytpu_q,0,9)' in names
+        assert 'anomaly(decode_step)' in names
+        assert 'anomaly(ttft)' in names
+        monkeypatch.setenv('SKYTPU_WATCHDOG_ANOMALY_Z', '0')
+        assert len(wd_lib.default_rules()) == 1
+
+
+class TestEngine:
+
+    def test_pre_tick_runs_and_failure_is_contained(self):
+        store, clock = _store(), _Clock()
+        calls = []
+
+        def pre(wd):
+            calls.append(1)
+            raise RuntimeError('scrape down')
+
+        wd, _ = _gauge_watchdog(store, clock, dump_evidence=False,
+                                pre_tick=pre)
+        store.add_sample('skytpu_wd_depth', {}, 5.0,
+                         now=clock.advance())
+        wd.tick()  # must not raise
+        assert calls == [1]
+
+    def test_evaluate_error_is_contained(self):
+        class Broken:
+            name = 'broken'
+
+            def evaluate(self, store, now, default_window):
+                raise RuntimeError('boom')
+
+        store, clock = _store(), _Clock()
+        wd = wd_lib.Watchdog(rules=[Broken()], store=store,
+                             now_fn=clock.now, breach_ticks=1,
+                             clear_ticks=1, window=30.0)
+        assert wd.tick() == []
+        assert 'evaluate error' in \
+            wd.snapshot()['rules'][0]['detail']
+
+    def test_snapshot_is_json_portable(self):
+        store, clock = _store(), _Clock()
+        rule = wd_lib.GaugeWithin('inf_g', 'skytpu_wd_depth',
+                                  hi=math.inf, window=30.0)
+        wd = wd_lib.Watchdog(rules=[rule], store=store,
+                             now_fn=clock.now, breach_ticks=1,
+                             clear_ticks=1, window=30.0)
+        store.add_sample('skytpu_wd_depth', {}, 5.0,
+                         now=clock.advance())
+        wd.tick()
+        json.dumps(wd.snapshot())
+
+    def test_background_thread_disabled_at_zero(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_WATCHDOG_TICK_SECONDS', '0')
+        wd = wd_lib.Watchdog(rules=[], store=_store())
+        assert wd.start() is False
